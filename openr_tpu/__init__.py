@@ -1,0 +1,10 @@
+"""openr-tpu: a TPU-native distributed routing framework.
+
+Protocol plane: actor modules over typed replicate queues (Spark neighbor
+discovery, replicated KvStore LSDB, LinkMonitor, PrefixManager, Decision,
+Fib, ctrl API) — architecture per the reference (earies/openr), rebuilt
+idiomatically.  Compute plane: batched JAX/XLA SPF kernels in
+``openr_tpu.ops`` sharded over TPU meshes via ``openr_tpu.parallel``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,235 @@
+"""Actor runtime — the OpenrEventBase equivalent.
+
+The reference gives every module its own thread + folly::EventBase +
+FiberManager (openr/common/OpenrEventBase.h:28); modules talk only through
+queues.  Here every module is an `Actor` owning asyncio tasks ("fibers") on a
+shared event loop, talking only through `openr_tpu.messaging` queues — same
+single-writer discipline, no shared mutable state.
+
+Time is pluggable: `WallClock` for production, `SimClock` for deterministic
+discrete-event tests (the reference's timer-heavy FSM tests are wall-clock
+and slow; ours run in virtual time, mirroring the determinism goal of
+MockIoProvider-based testing, tests/mocks/MockIoProvider.h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import time
+from typing import Any, Callable, Coroutine, Dict, List, Optional
+
+
+class Clock:
+    """Time source. All protocol-plane sleeping/timing MUST go through this."""
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def now_ms(self) -> int:
+        return int(self.now() * 1000)
+
+    async def sleep(self, delay: float) -> None:
+        raise NotImplementedError
+
+
+class WallClock(Clock):
+    def now(self) -> float:
+        return time.monotonic()
+
+    async def sleep(self, delay: float) -> None:
+        await asyncio.sleep(max(0.0, delay))
+
+
+class SimClock(Clock):
+    """Deterministic discrete-event virtual clock.
+
+    Tasks `await clock.sleep(dt)`; a test driver calls `await run_for(dt)` /
+    `await run_until(t)` which advances virtual time event by event, letting
+    the loop quiesce between events.  Any real work (queue handoffs, FSM
+    transitions) happens during the quiesce rounds, so test outcomes are
+    independent of host scheduling.
+    """
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = start
+        self._heap: List = []
+        self._seq = itertools.count()
+        self.activity = 0  # bumped by sleepers waking; used for quiescing
+
+    def now(self) -> float:
+        return self._now
+
+    async def sleep(self, delay: float) -> None:
+        if delay <= 0:
+            await asyncio.sleep(0)
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        heapq.heappush(self._heap, (self._now + delay, next(self._seq), fut))
+        await fut
+
+    async def _settle(self) -> None:
+        # Let the asyncio ready-queue drain: plain yields until chained
+        # callbacks stop producing new ones.  Queue handoffs resolve futures
+        # synchronously, so a bounded number of yields reaches quiescence.
+        for _ in range(3):
+            before = self.activity
+            for _ in range(10):
+                await asyncio.sleep(0)
+            if self.activity == before:
+                return
+
+    async def run_until(self, deadline: float) -> None:
+        await self._settle()
+        while self._heap and self._heap[0][0] <= deadline:
+            t, _, fut = heapq.heappop(self._heap)
+            self._now = max(self._now, t)
+            if not fut.done():
+                self.activity += 1
+                fut.set_result(None)
+            await self._settle()
+        self._now = max(self._now, deadline)
+        await self._settle()
+
+    async def run_for(self, duration: float) -> None:
+        await self.run_until(self._now + duration)
+
+    def pending_timers(self) -> int:
+        return sum(1 for _, _, f in self._heap if not f.done())
+
+
+# ---------------------------------------------------------------------------
+# fb303-style counters (reference: fb303 ServiceData, used by every module)
+# ---------------------------------------------------------------------------
+
+
+class CounterMap:
+    """Flat counter namespace; `dump()` feeds the ctrl API `getCounters`."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, float] = {}
+
+    def bump(self, key: str, delta: float = 1) -> None:
+        self._counters[key] = self._counters.get(key, 0) + delta
+
+    def set(self, key: str, value: float) -> None:
+        self._counters[key] = value
+
+    def get(self, key: str) -> float:
+        return self._counters.get(key, 0)
+
+    def dump(self, prefix: str = "") -> Dict[str, float]:
+        if not prefix:
+            return dict(self._counters)
+        return {k: v for k, v in self._counters.items() if k.startswith(prefix)}
+
+    def clear(self) -> None:
+        self._counters.clear()
+
+
+class Actor:
+    """A protocol-plane module: a set of cooperating asyncio tasks with a
+    shared clock, counters, and an ordered stop.
+
+    Subclasses override `run()` (main fiber) and may `spawn()` more fibers.
+    Matches the reference's module lifecycle: constructed with its queues,
+    started on its own execution context, stopped by closing queues then
+    awaiting the tasks (openr/Main.cpp:231-470, 498-541).
+    """
+
+    def __init__(self, name: str, clock: Clock, counters: Optional[CounterMap] = None):
+        self.name = name
+        self.clock = clock
+        self.counters = counters if counters is not None else CounterMap()
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+        self.last_heartbeat: float = clock.now()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run(self) -> None:  # pragma: no cover - default no-op
+        return
+
+    def start(self) -> None:
+        self.spawn(self._run_wrapper(), name=f"{self.name}.main")
+
+    async def _run_wrapper(self) -> None:
+        try:
+            await self.run()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 - module crash is fatal in reference
+            import traceback
+
+            traceback.print_exc()
+            self.counters.bump(f"{self.name}.crash")
+            raise
+
+    def spawn(self, coro: Coroutine, name: str = "") -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(
+            coro, name=name or f"{self.name}.fiber{len(self._tasks)}"
+        )
+        self._tasks.append(task)
+        # Prune on completion: timer-heavy modules (throttle/debounce) spawn
+        # constantly; a long-lived daemon must not accumulate dead tasks.
+        task.add_done_callback(self._discard_task)
+        return task
+
+    def _discard_task(self, task: asyncio.Task) -> None:
+        try:
+            self._tasks.remove(task)
+        except ValueError:
+            pass
+        if not task.cancelled() and task.exception() is not None:
+            # Surface module-fiber crashes rather than swallowing them.
+            self.counters.bump(f"{self.name}.fiber_exception")
+
+    def spawn_queue_loop(self, rqueue, handler: Callable, name: str = "") -> asyncio.Task:
+        """The canonical module fiber: drain a queue until close
+        (reference pattern: `while (true) { auto maybe = q.get(); ... }`)."""
+
+        async def _loop():
+            from openr_tpu.messaging.queue import QueueClosedError
+
+            try:
+                while True:
+                    item = await rqueue.get()
+                    self.touch()
+                    r = handler(item)
+                    if asyncio.iscoroutine(r):
+                        await r
+            except QueueClosedError:
+                return
+
+        return self.spawn(_loop(), name=name or f"{self.name}.qloop")
+
+    async def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        tasks = list(self._tasks)  # done-callbacks mutate the live list
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks.clear()
+
+    # -- watchdog support --------------------------------------------------
+
+    def touch(self) -> None:
+        self.last_heartbeat = self.clock.now()
+
+    def schedule(self, delay: float, fn: Callable[[], Any], name: str = "") -> asyncio.Task:
+        """One-shot timer (OpenrEventBase::scheduleTimeout equivalent)."""
+
+        async def _timer():
+            await self.clock.sleep(delay)
+            r = fn()
+            if asyncio.iscoroutine(r):
+                await r
+
+        return self.spawn(_timer(), name=name or f"{self.name}.timer")

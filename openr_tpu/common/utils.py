@@ -1,0 +1,238 @@
+"""Timing utilities: exponential backoff, throttle, debounce, step detector.
+
+Semantic equivalents of the reference's common/ExponentialBackoff.h,
+AsyncThrottle.h, AsyncDebounce.h, StepDetector.h, adapted to the clock-driven
+asyncio runtime (all sleeping goes through `Clock` so tests can run in
+virtual time).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+from typing import Callable, Deque, Optional, Tuple
+
+from openr_tpu.common.runtime import Actor, Clock
+
+
+class ExponentialBackoff:
+    """Doubling retry backoff (reference: common/ExponentialBackoff.h).
+
+    reportError() doubles the current backoff starting from `initial` up to
+    `maximum`; reportSuccess() clears it.  Time comes from the shared clock.
+    """
+
+    def __init__(self, initial: float, maximum: float, clock: Clock) -> None:
+        assert initial > 0 and maximum >= initial
+        self._initial = initial
+        self._max = maximum
+        self._clock = clock
+        self._current = 0.0
+        self._last_error_time = 0.0
+
+    def can_try_now(self) -> bool:
+        return self.time_remaining_until_retry() <= 0
+
+    def report_success(self) -> None:
+        self._current = 0.0
+
+    def report_error(self) -> None:
+        self._last_error_time = self._clock.now()
+        if self._current == 0.0:
+            self._current = self._initial
+        else:
+            self._current = min(self._current * 2, self._max)
+
+    def report_status(self, ok: bool) -> None:
+        self.report_success() if ok else self.report_error()
+
+    def at_max_backoff(self) -> bool:
+        return self._current >= self._max
+
+    def get_current_backoff(self) -> float:
+        return self._current
+
+    def time_remaining_until_retry(self) -> float:
+        if self._current == 0.0:
+            return 0.0
+        return max(0.0, self._last_error_time + self._current - self._clock.now())
+
+
+class AsyncThrottle:
+    """Coalesce rapid invocations: `callback` runs at most once per `timeout`
+    window (reference: common/AsyncThrottle.h).
+
+    First call schedules the callback `timeout` later; calls while scheduled
+    are no-ops.
+    """
+
+    def __init__(
+        self, actor: Actor, timeout: float, callback: Callable[[], object]
+    ) -> None:
+        self._actor = actor
+        self._timeout = timeout
+        self._callback = callback
+        self._scheduled: Optional[asyncio.Task] = None
+
+    def __call__(self) -> None:
+        if self.is_active():
+            return
+        self._scheduled = self._actor.schedule(self._timeout, self._fire)
+
+    def _fire(self):
+        self._scheduled = None
+        return self._callback()
+
+    def is_active(self) -> bool:
+        return self._scheduled is not None and not self._scheduled.done()
+
+    def cancel(self) -> None:
+        if self._scheduled is not None:
+            self._scheduled.cancel()
+            self._scheduled = None
+
+
+class AsyncDebounce:
+    """Debounce with exponential hold-off (reference: common/AsyncDebounce.h).
+
+    Every invocation doubles the pending wait (min → max) and *reschedules*
+    the callback; once the timer fires, the backoff resets.  Used by Decision
+    for the 10–250 ms SPF rebuild window (Decision.cpp:114-120).
+    """
+
+    def __init__(
+        self,
+        actor: Actor,
+        min_backoff: float,
+        max_backoff: float,
+        callback: Callable[[], object],
+    ) -> None:
+        self._actor = actor
+        self._backoff = ExponentialBackoff(min_backoff, max_backoff, actor.clock)
+        self._callback = callback
+        self._scheduled: Optional[asyncio.Task] = None
+        self._deadline = 0.0
+
+    def __call__(self) -> None:
+        if not self._backoff.at_max_backoff():
+            self._backoff.report_error()
+            self._reschedule(self._backoff.get_current_backoff())
+        assert self.is_scheduled()
+
+    def _reschedule(self, delay: float) -> None:
+        if self._scheduled is not None:
+            self._scheduled.cancel()
+        self._deadline = self._actor.clock.now() + delay
+        self._scheduled = self._actor.schedule(delay, self._fire)
+
+    def _fire(self):
+        self._scheduled = None
+        self._backoff.report_success()
+        return self._callback()
+
+    def is_scheduled(self) -> bool:
+        return self._scheduled is not None and not self._scheduled.done()
+
+    def cancel_scheduled_timeout(self) -> None:
+        if self._scheduled is not None:
+            self._scheduled.cancel()
+            self._scheduled = None
+        self._backoff.report_success()
+
+
+class SlidingWindowAvg:
+    """Fixed-count sliding-window average (stand-in for
+    folly::BucketedTimeSeries as used by StepDetector)."""
+
+    def __init__(self, max_count: int) -> None:
+        self._max = max_count
+        self._vals: Deque[float] = collections.deque(maxlen=max_count)
+
+    def add(self, v: float) -> None:
+        self._vals.append(v)
+
+    def avg(self) -> float:
+        if not self._vals:
+            return 0.0
+        return sum(self._vals) / len(self._vals)
+
+    def count(self) -> int:
+        return len(self._vals)
+
+
+class StepDetector:
+    """Detect steps in a noisy time series (RTT) — fast vs slow sliding
+    window means with rising/falling-edge hysteresis plus an absolute
+    threshold for staircase drift (reference: common/StepDetector.h).
+
+    Used by Spark to report neighbor RTT changes only when meaningful
+    (Spark.h:327).
+    """
+
+    def __init__(
+        self,
+        step_cb: Callable[[float], None],
+        fast_window_size: int = 10,
+        slow_window_size: int = 60,
+        lower_threshold_pct: float = 2.0,
+        upper_threshold_pct: float = 5.0,
+        abs_threshold: float = 500.0,
+    ) -> None:
+        assert lower_threshold_pct < upper_threshold_pct
+        assert fast_window_size < slow_window_size
+        self._fast = SlidingWindowAvg(fast_window_size)
+        self._slow = SlidingWindowAvg(slow_window_size)
+        self._slow_size = slow_window_size
+        self._lo = lower_threshold_pct
+        self._hi = upper_threshold_pct
+        self._abs = abs_threshold
+        self._cb = step_cb
+        self._in_transit = False
+        self._last_avg = 0.0
+        self._last_avg_init = False
+
+    def add_value(self, val: float) -> None:
+        self._fast.add(val)
+        self._slow.add(val)
+        fast_avg = self._fast.avg()
+        slow_avg = self._slow.avg()
+
+        if not self._last_avg_init and self._slow.count() >= self._slow_size // 2:
+            self._last_avg = slow_avg
+            self._last_avg_init = True
+
+        if slow_avg == 0:
+            raise ZeroDivisionError("slow window average is zero")
+        diff = abs((fast_avg - slow_avg) / slow_avg) * 100
+
+        if self._in_transit:
+            if diff <= self._lo:
+                # falling edge: step complete, fast mean is the new level
+                self._in_transit = False
+                self._cb(fast_avg)
+                self._last_avg = fast_avg
+                self._last_avg_init = True
+                return
+        elif diff >= self._hi:
+            self._in_transit = True
+
+        # gradual drift missed by the edge detector
+        if (
+            diff <= self._lo
+            and self._last_avg_init
+            and abs(slow_avg - self._last_avg) >= self._abs
+        ):
+            self._cb(slow_avg)
+            self._last_avg = slow_avg
+
+
+def sanitize_name(name: str) -> str:
+    """Counter-key-safe node/area names."""
+    return name.replace(".", "_").replace("/", "_")
+
+
+class Throttle2Tuple:
+    """Helper: (initial, max) seconds pair for config plumbing."""
+
+    def __init__(self, pair: Tuple[float, float]):
+        self.initial, self.max = pair

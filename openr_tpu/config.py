@@ -1,0 +1,282 @@
+"""Typed configuration — the OpenrConfig equivalent.
+
+One JSON-serializable config object is the source of truth for every module
+(reference: openr/if/OpenrConfig.thrift:462-648, parsed/validated by
+openr/config/Config.cpp).  Defaults mirror the reference's IDL defaults.
+Runtime-mutable state (drain, overrides) does NOT live here — it goes
+through the ctrl API + PersistentStore, matching the reference.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from openr_tpu import constants as C
+from openr_tpu.types import (
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+    RouteComputationRules,
+)
+
+
+@dataclass
+class AreaConfig:
+    """One routing area (OpenrConfig.thrift:443-460): which neighbors and
+    interfaces participate, by regex."""
+
+    area_id: str = C.DEFAULT_AREA
+    neighbor_regexes: List[str] = field(default_factory=lambda: [".*"])
+    include_interface_regexes: List[str] = field(default_factory=lambda: [".*"])
+    exclude_interface_regexes: List[str] = field(default_factory=list)
+    redistribute_interface_regexes: List[str] = field(default_factory=list)
+    #: per-area flooding can be disabled (leaf areas)
+    import_policy: Optional[str] = None
+
+
+@dataclass
+class KvStoreConfig:
+    """OpenrConfig.thrift KvstoreConfig."""
+
+    key_ttl_ms: int = 300_000  # 5 min default ttl for flooded keys
+    ttl_decrement_ms: int = C.TTL_DECREMENT_MS
+    flood_rate_msgs_per_sec: int = 0  # 0 = unlimited
+    flood_rate_burst_size: int = 0
+    enable_flood_optimization: bool = False
+    is_flood_root: bool = False
+    self_originated_key_ttl_ms: int = 300_000
+
+
+@dataclass
+class DecisionConfig:
+    """OpenrConfig.thrift:102-117."""
+
+    debounce_min_ms: int = 10
+    debounce_max_ms: int = 250
+    unblock_initial_routes_ms: int = 120_000
+    save_rib_policy_min_ms: int = 10_000
+    save_rib_policy_max_ms: int = 60_000
+    enable_bgp_route_programming: bool = False
+
+
+@dataclass
+class LinkMonitorConfig:
+    """OpenrConfig.thrift:119-146."""
+
+    linkflap_initial_backoff_ms: int = 60_000
+    linkflap_max_backoff_ms: int = 300_000
+    use_rtt_metric: bool = True
+    enable_perf_measurement: bool = True
+
+
+@dataclass
+class StepDetectorConfig:
+    fast_window_size: int = 10
+    slow_window_size: int = 60
+    lower_threshold: int = 2
+    upper_threshold: int = 5
+    ads_threshold: int = 500
+
+
+@dataclass
+class SparkConfig:
+    """OpenrConfig.thrift:167-207."""
+
+    neighbor_discovery_port: int = C.SPARK_UDP_PORT
+    hello_time_s: float = C.SPARK_HELLO_TIME_S
+    fastinit_hello_time_ms: int = 500
+    handshake_time_ms: int = 500
+    heartbeat_time_s: float = C.SPARK_HEARTBEAT_TIME_S
+    hold_time_s: float = C.SPARK_HOLD_TIME_S
+    graceful_restart_time_s: float = C.SPARK_GR_HOLD_TIME_S
+    step_detector_conf: StepDetectorConfig = field(default_factory=StepDetectorConfig)
+    #: minimum/maximum neighbor discovery window during initialization
+    min_neighbor_discovery_interval_s: float = 2.0
+    max_neighbor_discovery_interval_s: float = 10.0
+
+
+@dataclass
+class WatchdogConfig:
+    """OpenrConfig.thrift:209-221."""
+
+    interval_s: float = 20.0
+    thread_timeout_s: float = 300.0
+    max_memory_mb: int = 0  # 0 = unlimited
+    max_queue_size: int = 100_000
+
+
+@dataclass
+class FibConfig:
+    enable_fib_service_waiting: bool = True
+    fib_port: int = 60100
+    route_delete_delay_ms: int = 1000
+
+
+@dataclass
+class MonitorConfig:
+    max_event_log: int = 100
+    enable_event_log_submission: bool = True
+
+
+@dataclass
+class OriginatedPrefix:
+    """Config-originated prefix w/ optional aggregation
+    (OpenrConfig.thrift:345-441)."""
+
+    prefix: str
+    forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
+    forwarding_algorithm: PrefixForwardingAlgorithm = (
+        PrefixForwardingAlgorithm.SP_ECMP
+    )
+    #: advertise only when >= this many more-specific routes are present
+    minimum_supporting_routes: int = 0
+    install_to_fib: bool = False
+    source_preference: int = C.DEFAULT_SOURCE_PREFERENCE
+    path_preference: int = C.DEFAULT_PATH_PREFERENCE
+    tags: Set[str] = field(default_factory=set)
+    min_nexthop: Optional[int] = None
+
+
+@dataclass
+class SegmentRoutingConfig:
+    enable_sr_mpls: bool = False
+    #: static node segment label per area; 0 = auto-allocate from node id
+    node_segment_label: Dict[str, int] = field(default_factory=dict)
+    enable_adj_labels: bool = False
+
+
+@dataclass
+class TpuComputeConfig:
+    """TPU compute-plane knobs (net-new vs the reference).
+
+    The Decision module solves SPF on-device in batches.  Topologies are
+    padded to (max_nodes, max_edges) buckets so the jit cache stays warm
+    across LSDB churn (SURVEY §7 hard-part 4).
+    """
+
+    enable_tpu_spf: bool = True
+    #: pad |V| and |E| up to the next bucket to stabilize compiled shapes
+    node_buckets: List[int] = field(default_factory=lambda: [16, 64, 256, 1024])
+    edge_bucket_multiplier: int = 8  # max_edges = multiplier * max_nodes
+    #: nexthop bitmask words (32 neighbors per word)
+    nexthop_words: int = 2
+    #: device mesh axis name for sharding what-if batches
+    batch_axis: str = "batch"
+
+
+@dataclass
+class OpenrConfig:
+    node_name: str = "node1"
+    domain: str = "openr"
+    areas: List[AreaConfig] = field(default_factory=lambda: [AreaConfig()])
+    listen_addr: str = "::"
+    openr_ctrl_port: int = C.OPENR_CTRL_PORT
+    dryrun: bool = False
+    enable_v4: bool = True
+    enable_netlink_fib_handler: bool = False
+    prefix_forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
+    prefix_forwarding_algorithm: PrefixForwardingAlgorithm = (
+        PrefixForwardingAlgorithm.SP_ECMP
+    )
+    route_computation_rules: RouteComputationRules = (
+        RouteComputationRules.SHORTEST_DISTANCE
+    )
+    kvstore_config: KvStoreConfig = field(default_factory=KvStoreConfig)
+    decision_config: DecisionConfig = field(default_factory=DecisionConfig)
+    link_monitor_config: LinkMonitorConfig = field(default_factory=LinkMonitorConfig)
+    spark_config: SparkConfig = field(default_factory=SparkConfig)
+    watchdog_config: WatchdogConfig = field(default_factory=WatchdogConfig)
+    fib_config: FibConfig = field(default_factory=FibConfig)
+    monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
+    originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
+    segment_routing_config: SegmentRoutingConfig = field(
+        default_factory=SegmentRoutingConfig
+    )
+    tpu_compute_config: TpuComputeConfig = field(default_factory=TpuComputeConfig)
+    #: enable best-route redistribution across areas (PrefixManager)
+    enable_best_route_selection: bool = True
+    persistent_store_path: str = "/tmp/openr_tpu_persistent_store.bin"
+    rib_policy_file: str = "/tmp/openr_tpu_rib_policy.bin"
+    enable_watchdog: bool = True
+    enable_perf_measurement: bool = True
+
+    # -- validation / derivation (reference: config/Config.cpp) ------------
+
+    def __post_init__(self) -> None:
+        if not self.areas:
+            raise ValueError("config must define at least one area")
+        ids = [a.area_id for a in self.areas]
+        if len(ids) != len(set(ids)):
+            raise ValueError(f"duplicate area ids: {ids}")
+        d = self.decision_config
+        if not (0 < d.debounce_min_ms <= d.debounce_max_ms):
+            raise ValueError("invalid decision debounce window")
+
+    def area_ids(self) -> List[str]:
+        return [a.area_id for a in self.areas]
+
+    def get_area(self, area_id: str) -> AreaConfig:
+        for a in self.areas:
+            if a.area_id == area_id:
+                return a
+        raise KeyError(area_id)
+
+    # -- (de)serialization -------------------------------------------------
+
+    def to_json(self) -> str:
+        import dataclasses
+
+        def enc(o):
+            if dataclasses.is_dataclass(o) and not isinstance(o, type):
+                return dataclasses.asdict(o)
+            if isinstance(o, set):
+                return sorted(o)
+            raise TypeError(type(o))
+
+        return json.dumps(self, default=enc, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "OpenrConfig":
+        raw = json.loads(text)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "OpenrConfig":
+        return _build_dataclass(cls, raw)
+
+    @classmethod
+    def load(cls, path: str) -> "OpenrConfig":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+
+def _build_dataclass(klass, d):
+    """Reconstruct nested config dataclasses from plain JSON dicts, driven
+    by resolved type annotations (so new nested sections need no registry)."""
+    import dataclasses
+    import enum as _enum
+    import typing
+
+    if not dataclasses.is_dataclass(klass) or not isinstance(d, dict):
+        return d
+    hints = typing.get_type_hints(klass)
+    kwargs = {}
+    for f in dataclasses.fields(klass):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        ft = hints.get(f.name)
+        origin = typing.get_origin(ft)
+        args = typing.get_args(ft)
+        if dataclasses.is_dataclass(ft):
+            v = _build_dataclass(ft, v)
+        elif isinstance(ft, type) and issubclass(ft, _enum.Enum):
+            v = ft(v)
+        elif origin in (list, typing.List) and args and isinstance(v, list):
+            if dataclasses.is_dataclass(args[0]):
+                v = [_build_dataclass(args[0], x) for x in v]
+        elif origin in (set, typing.Set) and isinstance(v, list):
+            v = set(v)
+        kwargs[f.name] = v
+    return klass(**kwargs)

@@ -1,0 +1,103 @@
+"""Protocol constants, mirroring the reference's common/Constants.h.
+
+Each constant cites its origin (file:line in /root/reference) so parity can
+be checked.  Times are float seconds unless the name says otherwise — the
+asyncio protocol plane works in seconds; the thrift reference works in
+std::chrono milliseconds.
+"""
+
+# -- generic backoff (Constants.h:55-56)
+INITIAL_BACKOFF_S = 0.064
+MAX_BACKOFF_S = 8.192
+
+# -- KvStore full-sync backoff (Constants.h:59-60)
+KVSTORE_SYNC_INITIAL_BACKOFF_S = 4.0
+KVSTORE_SYNC_MAX_BACKOFF_S = 256.0
+
+# -- Fib programming retry backoff (Constants.h:81-82)
+FIB_INITIAL_BACKOFF_S = 0.008
+FIB_MAX_BACKOFF_S = 4.096
+
+# -- PersistentStore save backoff (Constants.h:85-87)
+PERSISTENT_STORE_INITIAL_BACKOFF_S = 0.1
+PERSISTENT_STORE_MAX_BACKOFF_S = 5.0
+
+# -- LinkMonitor throttles (Constants.h:95-100)
+LINK_THROTTLE_TIMEOUT_S = 0.100
+LINK_IMMEDIATE_TIMEOUT_S = 0.001
+ADJACENCY_THROTTLE_TIMEOUT_S = 1.0
+
+# -- Spark (Constants.h:107-112, Spark.h:450)
+SPARK_MCAST_ADDR = "ff02::1"
+SPARK_UDP_PORT = 6666
+SPARK_MAX_ALLOWED_PPS = 50
+
+# -- link discovery bound during initialization (Constants.h:27)
+MAX_DURATION_LINK_DISCOVERY_S = 10.0
+
+# -- KvStore (Constants.h:153-198)
+FLOOD_TOPO_DUMP_INTERVAL_S = 300.0
+MAX_FULL_SYNC_PENDING_COUNT = 32  # parallel-sync fan-out cap (Constants.h:160)
+PARALLEL_SYNC_LIMIT_INITIAL = 2  # doubles to the cap (KvStore.h:550)
+UNDEFINED_VERSION = 0
+KVSTORE_CLEAR_THROTTLE_S = 0.010
+KVSTORE_SYNC_THROTTLE_S = 0.100
+FLOOD_PENDING_PUBLICATION_S = 0.100
+MAX_TTL_UPDATE_INTERVAL_S = 7200.0  # 2h (Constants.h:189)
+TTL_INFINITY = -(2**31)  # INT32_MIN sentinel (Constants.h:192)
+TTL_DECREMENT_MS = 1  # decrement before re-flood (Constants.h:196)
+TTL_THRESHOLD_MS = 500  # don't merge near-dead values (Constants.h:198)
+
+DEFAULT_AREA = "0"
+ADJ_DB_MARKER = "adj:"
+PREFIX_DB_MARKER = "prefix:"
+
+# -- perf/convergence (Constants.h:204-208)
+PERF_BUFFER_SIZE = 10
+CONVERGENCE_MAX_DURATION_S = 3.0
+LONG_POLL_REQ_HOLD_TIME_S = 20.0
+
+# -- route preference defaults (Constants.h:216-217)
+DEFAULT_PATH_PREFERENCE = 1000
+DEFAULT_SOURCE_PREFERENCE = 200
+
+LOCAL_ROUTE_NEXTHOP_V4 = "0.0.0.0"
+LOCAL_ROUTE_NEXTHOP_V6 = "::"
+
+# -- control plane (Constants.h:224)
+OPENR_CTRL_PORT = 2018
+
+# -- version handshake (Constants.h:238-241)
+OPENR_VERSION = 20200825
+OPENR_SUPPORTED_VERSION = 20200604
+
+# -- watchdog (Constants.h:244 + Watchdog defaults)
+MEMORY_THRESHOLD_TIME_S = 600.0
+
+# -- Decision debounce window (OpenrConfig.thrift:105-108)
+DECISION_DEBOUNCE_MIN_S = 0.010
+DECISION_DEBOUNCE_MAX_S = 0.250
+
+# -- Decision initialization forced unblock (OpenrConfig.thrift:116)
+UNBLOCK_INITIAL_ROUTES_S = 120.0
+
+# -- Spark timer defaults (OpenrConfig.thrift:167-207)
+SPARK_HELLO_TIME_S = 20.0
+SPARK_FASTINIT_HELLO_TIME_S = 0.5
+SPARK_HANDSHAKE_TIME_S = 0.5
+SPARK_HEARTBEAT_TIME_S = 3.0
+SPARK_HOLD_TIME_S = 30.0
+SPARK_GR_HOLD_TIME_S = 30.0
+
+# -- Fib (OpenrConfig route_delete_delay_ms default)
+ROUTE_DELETE_DELAY_S = 1.0
+
+# -- platform agent keepalive (Constants.h:133-136)
+PLATFORM_SYNC_INTERVAL_S = 60.0
+KEEP_ALIVE_CHECK_INTERVAL_S = 1.0
+
+# -- MPLS label ranges (reference MplsConstants)
+MPLS_MIN_LABEL = 16
+MPLS_MAX_LABEL = (1 << 20) - 1
+SR_GLOBAL_RANGE = (101, 49999)  # node segment labels
+SR_LOCAL_RANGE = (50000, 59999)  # adjacency segment labels

@@ -1,0 +1,214 @@
+"""In-process typed message queues — the only inter-module communication
+channel in the protocol plane.
+
+Mirrors the reference's messaging layer semantics
+(openr/messaging/Queue.h:42-84, ReplicateQueue.h:27-96): multi-reader
+replicated pub/sub, blocking reads, close() propagation, per-queue
+read/write/size stats consumed by the Watchdog.  The reference blocks folly
+fibers; here readers are asyncio coroutines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+from typing import Any, Callable, Deque, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class QueueClosedError(RuntimeError):
+    """Raised from get() once a closed queue has fully drained."""
+
+
+class RWQueue(Generic[T]):
+    """Unbounded FIFO with async blocking reads and close propagation
+    (reference: openr/messaging/Queue.h:42)."""
+
+    def __init__(self, name: str = "") -> None:
+        self.name = name
+        self._items: Deque[T] = collections.deque()
+        self._waiters: Deque[asyncio.Future] = collections.deque()
+        self._closed = False
+        self.num_writes = 0
+        self.num_reads = 0
+
+    def size(self) -> int:
+        return len(self._items)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def push(self, item: T) -> bool:
+        if self._closed:
+            return False
+        self.num_writes += 1
+        # Hand the item directly to a parked reader when one exists.
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                self.num_reads += 1
+                fut.set_result(item)
+                return True
+        self._items.append(item)
+        return True
+
+    async def get(self) -> T:
+        if self._items:
+            self.num_reads += 1
+            return self._items.popleft()
+        if self._closed:
+            raise QueueClosedError(self.name)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # Item was already delivered; hand it to the next parked
+                # reader (preserves FIFO), else back onto the queue.
+                item = fut.result()
+                self.num_reads -= 1
+                while self._waiters:
+                    nxt = self._waiters.popleft()
+                    if not nxt.done():
+                        self.num_reads += 1
+                        nxt.set_result(item)
+                        break
+                else:
+                    self._items.appendleft(item)
+            raise
+
+    def try_get(self) -> Optional[T]:
+        if self._items:
+            self.num_reads += 1
+            return self._items.popleft()
+        return None
+
+    def drain(self) -> List[T]:
+        """Pop everything currently queued without blocking."""
+        out = list(self._items)
+        self.num_reads += len(out)
+        self._items.clear()
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_exception(QueueClosedError(self.name))
+
+
+class RQueue(Generic[T]):
+    """Read-only handle onto a replicated stream, with an optional
+    per-reader filter (reference: ReplicateQueue::getReader(filters))."""
+
+    def __init__(
+        self,
+        queue: RWQueue[T],
+        filter_fn: Optional[Callable[[T], bool]] = None,
+    ) -> None:
+        self._q = queue
+        self._filter = filter_fn
+
+    @property
+    def name(self) -> str:
+        return self._q.name
+
+    def size(self) -> int:
+        return self._q.size()
+
+    @property
+    def closed(self) -> bool:
+        return self._q.closed
+
+    async def get(self) -> T:
+        while True:
+            item = await self._q.get()
+            if self._filter is None or self._filter(item):
+                return item
+
+    def try_get(self) -> Optional[T]:
+        while True:
+            item = self._q.try_get()
+            if item is None:
+                return None
+            if self._filter is None or self._filter(item):
+                return item
+
+    def _accepts(self, item: T) -> bool:
+        return self._filter is None or self._filter(item)
+
+    async def __aiter__(self):
+        try:
+            while True:
+                yield await self.get()
+        except QueueClosedError:
+            return
+
+
+class ReplicateQueue(Generic[T]):
+    """Multi-reader pub/sub: every push is replicated to every reader
+    (reference: openr/messaging/ReplicateQueue.h:27-96).
+
+    Readers created after a push do NOT see earlier items, matching the
+    reference.  ``close()`` closes every reader queue; late ``get_reader``
+    calls on a closed queue raise.
+    """
+
+    def __init__(self, name: str = "") -> None:
+        self.name = name
+        self._readers: List[RWQueue[T]] = []
+        self._reader_handles: List[RQueue[T]] = []
+        self._closed = False
+        self.num_writes = 0
+
+    def get_reader(
+        self, filter_fn: Optional[Callable[[T], bool]] = None, name: str = ""
+    ) -> RQueue[T]:
+        if self._closed:
+            raise QueueClosedError(self.name)
+        q: RWQueue[T] = RWQueue(name or f"{self.name}.reader{len(self._readers)}")
+        handle = RQueue(q, filter_fn)
+        self._readers.append(q)
+        self._reader_handles.append(handle)
+        return handle
+
+    def push(self, item: T) -> int:
+        """Replicate to all readers; returns number of readers reached."""
+        if self._closed:
+            return 0
+        self.num_writes += 1
+        n = 0
+        for q in self._readers:
+            if q.push(item):
+                n += 1
+        return n
+
+    def get_num_readers(self) -> int:
+        return len(self._readers)
+
+    def get_num_writes(self) -> int:
+        return self.num_writes
+
+    def max_backlog(self) -> int:
+        return max((q.size() for q in self._readers), default=0)
+
+    def open(self) -> None:
+        """Re-open a closed queue (reference ReplicateQueue::open)."""
+        self._closed = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # The reference clears the reader list on close
+        # (ReplicateQueue-inl.h:98-105) so a later open() starts fresh.
+        for q in self._readers:
+            q.close()
+        self._readers.clear()
+        self._reader_handles.clear()

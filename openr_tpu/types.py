@@ -1,0 +1,717 @@
+"""Core data model for openr-tpu.
+
+These are idiomatic Python dataclasses carrying the same information as the
+reference's thrift IDL (see /root/reference/openr/if/Types.thrift,
+KvStore.thrift, Network.thrift, OpenrConfig.thrift).  They are the wire/type
+contract (layer L0) shared by every module: the KvStore replicates serialized
+`AdjacencyDatabase` / `PrefixDatabase` objects, Decision consumes them, Fib
+programs `UnicastRoute`s derived from them.
+
+Design notes (TPU build):
+  * IP prefixes are canonical strings ("10.0.0.0/24", "::/0") rather than
+    packed binary — the host protocol plane never does per-packet work, and
+    strings keep the KvStore payloads debuggable.  The device compute plane
+    never sees prefixes as strings; they are interned to dense int ids by
+    ``openr_tpu.ops.csr`` before hitting the TPU.
+  * Everything is msgpack/JSON-serializable via ``to_wire``/``from_wire`` so
+    the RPC plane needs no IDL compiler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Enums (reference: openr/if/Types.thrift, OpenrConfig.thrift)
+# ---------------------------------------------------------------------------
+
+
+class DrainState(enum.IntEnum):
+    """Node drain state (Types.thrift:30-34)."""
+
+    UNDRAINED = 0
+    HARD_DRAINED = 1
+    SOFT_DRAINED = 2
+
+
+class SparkNeighState(enum.IntEnum):
+    """Spark neighbor FSM states (Types.thrift:51-57)."""
+
+    IDLE = 0
+    WARM = 1
+    NEGOTIATE = 2
+    ESTABLISHED = 3
+    RESTART = 4
+
+
+class SparkNeighEvent(enum.IntEnum):
+    """Spark neighbor FSM events (Types.thrift:59-69)."""
+
+    HELLO_RCVD_INFO = 0
+    HELLO_RCVD_NO_INFO = 1
+    HELLO_RCVD_RESTART = 2
+    HEARTBEAT_RCVD = 3
+    HANDSHAKE_RCVD = 4
+    HEARTBEAT_TIMER_EXPIRE = 5
+    NEGOTIATE_TIMER_EXPIRE = 6
+    GR_TIMER_EXPIRE = 7
+    NEGOTIATION_FAILURE = 8
+
+
+class PrefixForwardingType(enum.IntEnum):
+    """IP vs SR_MPLS forwarding (OpenrConfig.thrift:19-26)."""
+
+    IP = 0
+    SR_MPLS = 1
+
+
+class PrefixForwardingAlgorithm(enum.IntEnum):
+    """Route computation algorithm (OpenrConfig.thrift:28-41)."""
+
+    SP_ECMP = 0
+    KSP2_ED_ECMP = 1
+
+
+class RouteComputationRules(enum.IntEnum):
+    """Best-route selection algorithm (OpenrConfig.thrift:82-100)."""
+
+    SHORTEST_DISTANCE = 0
+    PER_AREA_SHORTEST_DISTANCE = 1
+
+
+class PrefixType(enum.IntEnum):
+    """Origin of a prefix advertisement (Network.thrift PrefixType)."""
+
+    LOOPBACK = 1
+    DEFAULT = 2
+    BGP = 3
+    PREFIX_ALLOCATOR = 4
+    BREEZE = 5
+    RIB = 6
+    CONFIG = 7
+    VIP = 8
+
+
+class KvStorePeerState(enum.IntEnum):
+    """KvStore peer FSM (KvStore.thrift:291-295)."""
+
+    IDLE = 0
+    SYNCING = 1
+    INITIALIZED = 2
+
+
+class KvStoreNoMergeReason(enum.IntEnum):
+    """Why an incoming (key, value) was not merged (KvStore.thrift:176-184)."""
+
+    UNKNOWN = 0
+    NO_MATCHED_KEY = 1
+    INVALID_TTL = 2
+    OLD_VERSION = 3
+    NO_NEED_TO_UPDATE = 4
+    LOOP_DETECTED = 5
+    INCONSISTENCY_DETECTED = 6
+
+
+class InitializationEvent(enum.IntEnum):
+    """Cold-start initialization sequence signals (KvStore.thrift:25-62,
+    docs/Protocol_Guide/Initialization_Process.md)."""
+
+    INITIALIZING = 0
+    AGENT_CONFIGURED = 1
+    LINK_DISCOVERED = 2
+    NEIGHBOR_DISCOVERED = 3
+    KVSTORE_SYNCED = 4
+    RIB_COMPUTED = 5
+    FIB_SYNCED = 6
+    PREFIX_DB_SYNCED = 7
+    INITIALIZED = 8
+
+
+class LinkStatusEnum(enum.IntEnum):
+    DOWN = 0
+    UP = 1
+
+
+# ---------------------------------------------------------------------------
+# Wire helpers
+# ---------------------------------------------------------------------------
+
+
+def _to_wire_value(v: Any) -> Any:
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return v.to_wire()  # type: ignore[union-attr]
+    if isinstance(v, enum.Enum):
+        return int(v.value)
+    if isinstance(v, dict):
+        return {k: _to_wire_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_to_wire_value(x) for x in v]
+    if isinstance(v, (set, frozenset)):
+        return sorted(_to_wire_value(x) for x in v)
+    return v
+
+
+class Wire:
+    """Mixin: flat dict serialization for RPC payloads and golden tests."""
+
+    def to_wire(self) -> Dict[str, Any]:
+        out = {}
+        for f in dataclasses.fields(self):  # type: ignore[arg-type]
+            out[f.name] = _to_wire_value(getattr(self, f.name))
+        return out
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]):
+        kwargs = {}
+        hints = {f.name: f for f in dataclasses.fields(cls)}  # type: ignore[arg-type]
+        for name, f in hints.items():
+            if name not in d:
+                continue
+            kwargs[name] = _from_wire_field(f.type, d[name])
+        return cls(**kwargs)  # type: ignore[call-arg]
+
+
+_WIRE_REGISTRY: Dict[str, type] = {}
+
+
+def _from_wire_field(type_str: Any, v: Any) -> Any:
+    # Best-effort reconstruction driven by the annotation string.  Nested
+    # dataclasses are registered in _WIRE_REGISTRY by name.
+    if v is None:
+        return None
+    s = str(type_str)
+    for name, klass in _WIRE_REGISTRY.items():
+        if s == name or s == f"Optional[{name}]":
+            return klass.from_wire(v) if isinstance(v, dict) else v
+        if s in (f"List[{name}]", f"list[{name}]") and isinstance(v, list):
+            return [klass.from_wire(x) if isinstance(x, dict) else x for x in v]
+        if (s.startswith("Dict[str, ") or s.startswith("dict[str, ")) and s.endswith(
+            f"{name}]"
+        ):
+            if isinstance(v, dict):
+                return {
+                    k: klass.from_wire(x) if isinstance(x, dict) else x
+                    for k, x in v.items()
+                }
+    if s.startswith("Set[") or s.startswith("set["):
+        return set(v)
+    if (s.startswith("Tuple[") or s.startswith("tuple[")) and isinstance(v, list):
+        return tuple(v)
+    if "Tuple[" in s and isinstance(v, dict):
+        # e.g. Dict[str, Tuple[int, int]] — rebuild tuple values
+        return {k: tuple(x) if isinstance(x, list) else x for k, x in v.items()}
+    for e in _ENUM_REGISTRY:
+        if s == e.__name__ or s == f"Optional[{e.__name__}]":
+            return e(v)
+    return v
+
+
+def _all_enums() -> List[type]:
+    import sys
+
+    mod = sys.modules[__name__]
+    return [
+        obj
+        for obj in vars(mod).values()
+        if isinstance(obj, type) and issubclass(obj, enum.Enum) and obj is not enum.Enum
+    ]
+
+
+# Populated at end of module import (after all enums are defined).
+_ENUM_REGISTRY: List[type] = []
+
+
+def wire_type(cls):
+    """Register a dataclass for nested from_wire reconstruction."""
+    _WIRE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def normalize_prefix(prefix: str) -> str:
+    """Canonicalize an IP prefix string (host bits zeroed)."""
+    return str(ipaddress.ip_network(prefix, strict=False))
+
+
+# ---------------------------------------------------------------------------
+# Performance-event breadcrumbs (Types.thrift:80-96)
+# ---------------------------------------------------------------------------
+
+
+@wire_type
+@dataclass
+class PerfEvent(Wire):
+    node_name: str
+    event_descr: str
+    unix_ts_ms: int = 0
+
+
+@wire_type
+@dataclass
+class PerfEvents(Wire):
+    """Ordered breadcrumb list for convergence-latency measurement; newest
+    event appended at the back (Types.thrift:88-96)."""
+
+    events: List[PerfEvent] = field(default_factory=list)
+
+    def add(self, node: str, descr: str, ts_ms: int) -> None:
+        self.events.append(PerfEvent(node, descr, ts_ms))
+
+    def total_duration_ms(self) -> int:
+        if len(self.events) < 2:
+            return 0
+        return self.events[-1].unix_ts_ms - self.events[0].unix_ts_ms
+
+
+# ---------------------------------------------------------------------------
+# Link-state types (Types.thrift:145-270)
+# ---------------------------------------------------------------------------
+
+
+@wire_type
+@dataclass
+class Adjacency(Wire):
+    """One established adjacency (Types.thrift:145-213)."""
+
+    other_node_name: str
+    if_name: str
+    metric: int = 1
+    #: SR adjacency-segment label; node-local, 0 = invalid (Types.thrift:174-179)
+    adj_label: int = 0
+    #: drain bit: adjacency unavailable for transit (Types.thrift:181-185)
+    is_overloaded: bool = False
+    #: round-trip time to neighbor, microseconds
+    rtt: int = 0
+    #: adjacency establishment time (s since epoch)
+    timestamp: int = 0
+    #: weighted-ECMP weight (unused by routing, carried for parity)
+    weight: int = 1
+    other_if_name: str = ""
+    #: if true, only the neighbor may use this adj for routing
+    #: (Types.thrift:206-212, used for initialization warm-up)
+    adj_only_used_by_other_node: bool = False
+    #: IPv6 link-local / IPv4 nexthop addresses of neighbor over if_name
+    next_hop_v6: str = ""
+    next_hop_v4: str = ""
+
+
+@wire_type
+@dataclass
+class LinkStatusRecords(Wire):
+    """if_name -> (LinkStatusEnum, unix_ts) (Types.thrift:99-133)."""
+
+    link_status_map: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+
+
+@wire_type
+@dataclass
+class AdjacencyDatabase(Wire):
+    """Per-(node, area) link state, flooded under key ``adj:<node>``
+    (Types.thrift:223-270)."""
+
+    this_node_name: str
+    is_overloaded: bool = False  # hard drain: no transit through this node
+    adjacencies: List[Adjacency] = field(default_factory=list)
+    #: SR nodal segment label, globally unique, 0 = invalid
+    node_label: int = 0
+    perf_events: Optional[PerfEvents] = None
+    area: str = "0"
+    #: soft drain: added to every link metric through this node
+    node_metric_increment_val: int = 0
+    link_status_records: Optional[LinkStatusRecords] = None
+
+
+# ---------------------------------------------------------------------------
+# Prefix types (Types.thrift:287-430)
+# ---------------------------------------------------------------------------
+
+
+@wire_type
+@dataclass(frozen=True)
+class PrefixMetrics(Wire):
+    """Best-prefix-selection metric chain (Types.thrift:287-347).
+
+    Tie-break order (openr/decision/PrefixState + RibEntry semantics):
+      1. drain_metric       prefer LOWER
+      2. path_preference    prefer HIGHER
+      3. source_preference  prefer HIGHER
+      4. distance           prefer LOWER
+    """
+
+    version: int = 1
+    drain_metric: int = 0
+    path_preference: int = 0
+    source_preference: int = 0
+    distance: int = 0
+
+    def sort_key(self) -> Tuple[int, int, int, int]:
+        """Lower sorts better."""
+        return (
+            self.drain_metric,
+            -self.path_preference,
+            -self.source_preference,
+            self.distance,
+        )
+
+
+@wire_type
+@dataclass
+class PrefixEntry(Wire):
+    """One advertised route (Types.thrift:349-413)."""
+
+    prefix: str
+    type: PrefixType = PrefixType.LOOPBACK
+    forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
+    forwarding_algorithm: PrefixForwardingAlgorithm = (
+        PrefixForwardingAlgorithm.SP_ECMP
+    )
+    #: if set, Decision withholds the route unless >= this many nexthops
+    min_nexthop: Optional[int] = None
+    metrics: PrefixMetrics = field(default_factory=PrefixMetrics)
+    tags: Set[str] = field(default_factory=set)
+    #: areas traversed; [0] = originating area, appended on redistribution;
+    #: used for inter-area loop prevention (Decision.cpp:762-773)
+    area_stack: List[str] = field(default_factory=list)
+    weight: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        self.prefix = normalize_prefix(self.prefix)
+
+
+@wire_type
+@dataclass
+class PrefixDatabase(Wire):
+    """Route advertisement flooded under ``prefix:<node>:[<prefix>]``
+    (Types.thrift:415-440)."""
+
+    this_node_name: str
+    prefix_entries: List[PrefixEntry] = field(default_factory=list)
+    perf_events: Optional[PerfEvents] = None
+    #: per-prefix-key deletion marker (reference advertises deletion by
+    #: flooding a PrefixDatabase with deletePrefix=true)
+    delete_prefix: bool = False
+    area: str = "0"
+
+
+# ---------------------------------------------------------------------------
+# KvStore types (KvStore.thrift:100-420)
+# ---------------------------------------------------------------------------
+
+
+@wire_type
+@dataclass
+class Value(Wire):
+    """Replicated KV value with eventual-consistency attributes
+    (KvStore.thrift:100-151).
+
+    Conflict resolution (KvStoreUtil.cpp:470 compareValues): higher
+    ``version`` wins; then higher ``originator_id``; then larger ``value``;
+    then higher ``ttl_version``.  Version 0 is undefined/uninitialized.
+    """
+
+    version: int = 0
+    originator_id: str = ""
+    #: opaque application payload; None = TTL-refresh-only update
+    value: Optional[bytes] = None
+    ttl: int = -1  # milliseconds; Constants.kTtlInfinity == INT32_MIN
+    ttl_version: int = 0
+    hash: Optional[int] = None
+
+    def to_wire(self) -> Dict[str, Any]:
+        d = super().to_wire()
+        if isinstance(d.get("value"), bytes):
+            d["value"] = d["value"].hex()
+            d["_value_hex"] = True
+        return d
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "Value":
+        d = dict(d)
+        if d.pop("_value_hex", False) and d.get("value") is not None:
+            d["value"] = bytes.fromhex(d["value"])
+        return super().from_wire(d)  # type: ignore[return-value]
+
+
+KeyVals = Dict[str, Value]
+
+
+@wire_type
+@dataclass
+class Publication(Wire):
+    """KvStore delta / dump / sync response (KvStore.thrift:347-400)."""
+
+    key_vals: Dict[str, Value] = field(default_factory=dict)
+    expired_keys: List[str] = field(default_factory=list)
+    #: flood-loop prevention: node ids this publication traversed
+    node_ids: Optional[List[str]] = None
+    #: full-sync response: keys the responder wants back from the initiator
+    tobe_updated_keys: Optional[List[str]] = None
+    area: str = "0"
+    timestamp_ms: Optional[int] = None
+
+
+@wire_type
+@dataclass
+class PeerSpec(Wire):
+    """How to reach a KvStore peer (KvStore.thrift PeerSpec)."""
+
+    peer_addr: str = ""
+    ctrl_port: int = 0
+    state: KvStorePeerState = KvStorePeerState.IDLE
+    flaps: int = 0
+    num_thrift_failures: int = 0
+
+
+@wire_type
+@dataclass
+class KvStoreAreaSummary(Wire):
+    area: str = "0"
+    peers_map: Dict[str, PeerSpec] = field(default_factory=dict)
+    key_vals_count: int = 0
+    key_vals_bytes: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Routes (Network.thrift UnicastRoute/MplsRoute, fib/)
+# ---------------------------------------------------------------------------
+
+
+class MplsActionCode(enum.IntEnum):
+    """MPLS label actions (Network.thrift MplsActionCode)."""
+
+    PUSH = 0
+    SWAP = 1
+    PHP = 2  # Penultimate hop popping: implicit-null
+    POP_AND_LOOKUP = 3
+
+
+@wire_type
+@dataclass(frozen=True)
+class MplsAction(Wire):
+    action: MplsActionCode = MplsActionCode.PHP
+    swap_label: Optional[int] = None
+    push_labels: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.push_labels is not None and not isinstance(self.push_labels, tuple):
+            object.__setattr__(self, "push_labels", tuple(self.push_labels))
+
+
+@wire_type
+@dataclass(frozen=True)
+class NextHop(Wire):
+    """A route nexthop (Network.thrift NextHopThrift): address + interface,
+    weight (0 = ECMP), optional MPLS action, and the metric/area it came
+    from."""
+
+    address: str = ""
+    if_name: str = ""
+    metric: int = 0
+    weight: int = 0
+    area: str = ""
+    neighbor_node_name: str = ""
+    mpls_action: Optional[MplsAction] = None
+
+
+@wire_type
+@dataclass
+class UnicastRoute(Wire):
+    dest: str = ""
+    next_hops: List[NextHop] = field(default_factory=list)
+
+
+@wire_type
+@dataclass
+class MplsRoute(Wire):
+    top_label: int = 0
+    next_hops: List[NextHop] = field(default_factory=list)
+
+
+@wire_type
+@dataclass
+class RouteDatabase(Wire):
+    this_node_name: str = ""
+    unicast_routes: List[UnicastRoute] = field(default_factory=list)
+    mpls_routes: List[MplsRoute] = field(default_factory=list)
+    perf_events: Optional[PerfEvents] = None
+
+
+@wire_type
+@dataclass
+class RouteDatabaseDelta(Wire):
+    unicast_routes_to_update: List[UnicastRoute] = field(default_factory=list)
+    unicast_routes_to_delete: List[str] = field(default_factory=list)
+    mpls_routes_to_update: List[MplsRoute] = field(default_factory=list)
+    mpls_routes_to_delete: List[int] = field(default_factory=list)
+    perf_events: Optional[PerfEvents] = None
+
+
+# ---------------------------------------------------------------------------
+# Module event types (queue payloads; common/LsdbTypes.h equivalents)
+# ---------------------------------------------------------------------------
+
+
+class NeighborEventType(enum.IntEnum):
+    """Spark -> LinkMonitor events (common/NeighborEvents in LsdbTypes.h)."""
+
+    NEIGHBOR_UP = 0
+    NEIGHBOR_DOWN = 1
+    NEIGHBOR_RESTARTED = 2
+    NEIGHBOR_RTT_CHANGE = 3
+    NEIGHBOR_RESTARTING = 4
+    NEIGHBOR_ADJ_SYNCED = 5
+
+
+@wire_type
+@dataclass
+class NeighborEvent(Wire):
+    event_type: NeighborEventType
+    node_name: str
+    area: str = "0"
+    local_if_name: str = ""
+    remote_if_name: str = ""
+    neighbor_addr_v6: str = ""
+    neighbor_addr_v4: str = ""
+    ctrl_port: int = 0
+    rtt_us: int = 0
+    kv_label: int = 0
+    adj_only_used_by_other_node: bool = False
+
+
+class PeerEventType(enum.IntEnum):
+    ADD = 0
+    DEL = 1
+
+
+@dataclass
+class PeerEvent:
+    """LinkMonitor -> KvStore/Decision peer changes, per area."""
+
+    area: str
+    peers_to_add: Dict[str, PeerSpec] = field(default_factory=dict)
+    peers_to_del: List[str] = field(default_factory=list)
+
+
+@dataclass
+class InterfaceInfo:
+    """Kernel view of one interface (Types.thrift:123-139)."""
+
+    if_name: str
+    is_up: bool = False
+    if_index: int = -1
+    networks: List[str] = field(default_factory=list)
+
+    def v6_link_local(self) -> Optional[str]:
+        for n in self.networks:
+            addr = ipaddress.ip_interface(n)
+            if addr.version == 6 and addr.is_link_local:
+                return str(addr.ip)
+        return None
+
+    def v4_addr(self) -> Optional[str]:
+        for n in self.networks:
+            addr = ipaddress.ip_interface(n)
+            if addr.version == 4:
+                return str(addr.ip)
+        return None
+
+
+@dataclass
+class InterfaceDatabase:
+    """LinkMonitor -> Spark interface snapshot."""
+
+    interfaces: Dict[str, InterfaceInfo] = field(default_factory=dict)
+
+
+class PrefixEventType(enum.IntEnum):
+    ADD_PREFIXES = 0
+    WITHDRAW_PREFIXES = 1
+    WITHDRAW_PREFIXES_BY_TYPE = 2
+    SYNC_PREFIXES_BY_TYPE = 3
+
+
+@dataclass
+class PrefixEvent:
+    """API/plugins -> PrefixManager advertisement requests."""
+
+    event_type: PrefixEventType
+    type: PrefixType = PrefixType.DEFAULT
+    prefixes: List[PrefixEntry] = field(default_factory=list)
+    dst_areas: Optional[Set[str]] = None
+
+
+class KvRequestType(enum.IntEnum):
+    PERSIST_KEY = 0
+    SET_KEY = 1
+    CLEAR_KEY = 2
+
+
+@dataclass
+class KeyValueRequest:
+    """PrefixManager/LinkMonitor -> KvStore self-originated key ops
+    (kvstore self-originated key API, KvStore.h:196-215)."""
+
+    request_type: KvRequestType
+    area: str
+    key: str
+    value: bytes = b""
+    version: Optional[int] = None
+
+
+@dataclass
+class AddressEvent:
+    """NeighborMonitor -> Spark (LAG down detection etc.)."""
+
+    address: str
+    is_reachable: bool
+
+
+@dataclass
+class LogSample:
+    """Structured event-log record -> Monitor (monitor/LogSample.h)."""
+
+    event: str
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    timestamp_ms: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Key naming (common/Constants + LsdbTypes key formats)
+# ---------------------------------------------------------------------------
+
+ADJ_DB_MARKER = "adj:"
+PREFIX_DB_MARKER = "prefix:"
+
+
+def adj_key(node: str) -> str:
+    return f"{ADJ_DB_MARKER}{node}"
+
+
+def prefix_key(node: str, prefix: str) -> str:
+    """Per-prefix key format ``prefix:<node>:[<prefix>]``
+    (common/LsdbTypes.h:437-458)."""
+    return f"{PREFIX_DB_MARKER}{node}:[{normalize_prefix(prefix)}]"
+
+
+def parse_adj_key(key: str) -> Optional[str]:
+    if not key.startswith(ADJ_DB_MARKER):
+        return None
+    return key[len(ADJ_DB_MARKER):]
+
+
+def parse_prefix_key(key: str) -> Optional[Tuple[str, str]]:
+    """Return (node, prefix) or None."""
+    if not key.startswith(PREFIX_DB_MARKER):
+        return None
+    body = key[len(PREFIX_DB_MARKER):]
+    if not body.endswith("]") or ":[" not in body:
+        return None
+    node, _, rest = body.partition(":[")
+    return node, rest[:-1]
+
+
+_ENUM_REGISTRY.extend(_all_enums())

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import so
+multi-chip sharding paths are exercised without TPU hardware."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def sim_loop():
+    """Fresh event loop + SimClock per test."""
+    from openr_tpu.common.runtime import SimClock
+
+    loop = asyncio.new_event_loop()
+    clock = SimClock()
+    try:
+        yield loop, clock
+    finally:
+        loop.close()

@@ -1,0 +1,171 @@
+"""Queue semantics tests (reference behavior: openr/messaging/tests)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.messaging.queue import QueueClosedError, ReplicateQueue, RWQueue
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_rwqueue_fifo_and_stats():
+    async def main():
+        q = RWQueue("q")
+        q.push(1)
+        q.push(2)
+        assert q.size() == 2
+        assert await q.get() == 1
+        assert await q.get() == 2
+        assert q.num_writes == 2 and q.num_reads == 2
+
+    run(main())
+
+
+def test_rwqueue_blocking_get_wakes_on_push():
+    async def main():
+        q = RWQueue("q")
+
+        async def reader():
+            return await q.get()
+
+        t = asyncio.ensure_future(reader())
+        await asyncio.sleep(0)
+        q.push(42)
+        assert await t == 42
+
+    run(main())
+
+
+def test_rwqueue_close_drains_then_raises():
+    async def main():
+        q = RWQueue("q")
+        q.push(1)
+        q.close()
+        assert not q.push(2)  # push after close rejected
+        assert await q.get() == 1  # drain allowed
+        with pytest.raises(QueueClosedError):
+            await q.get()
+
+    run(main())
+
+
+def test_rwqueue_close_wakes_blocked_readers():
+    async def main():
+        q = RWQueue("q")
+
+        async def reader():
+            with pytest.raises(QueueClosedError):
+                await q.get()
+            return "done"
+
+        t = asyncio.ensure_future(reader())
+        await asyncio.sleep(0)
+        q.close()
+        assert await t == "done"
+
+    run(main())
+
+
+def test_replicate_queue_fans_out_to_all_readers():
+    async def main():
+        rq = ReplicateQueue("rq")
+        r1 = rq.get_reader()
+        r2 = rq.get_reader()
+        assert rq.push("x") == 2
+        assert await r1.get() == "x"
+        assert await r2.get() == "x"
+        # late reader does not see earlier items
+        r3 = rq.get_reader()
+        rq.push("y")
+        assert await r3.get() == "y"
+        assert await r1.get() == "y"
+        assert rq.get_num_writes() == 2
+
+    run(main())
+
+
+def test_replicate_queue_reader_filter():
+    async def main():
+        rq = ReplicateQueue("rq")
+        evens = rq.get_reader(lambda x: x % 2 == 0)
+        alls = rq.get_reader()
+        for i in range(5):
+            rq.push(i)
+        assert evens.try_get() == 0
+        assert evens.try_get() == 2
+        assert evens.try_get() == 4
+        assert evens.try_get() is None
+        assert [alls.try_get() for _ in range(5)] == [0, 1, 2, 3, 4]
+
+    run(main())
+
+
+def test_replicate_queue_close_propagates():
+    async def main():
+        rq = ReplicateQueue("rq")
+        r = rq.get_reader()
+        rq.push(1)
+        rq.close()
+        assert rq.push(2) == 0
+        assert await r.get() == 1
+        with pytest.raises(QueueClosedError):
+            await r.get()
+        with pytest.raises(QueueClosedError):
+            rq.get_reader()
+
+    run(main())
+
+
+def test_replicate_queue_max_backlog():
+    async def main():
+        rq = ReplicateQueue("rq")
+        r1 = rq.get_reader()
+        r2 = rq.get_reader()
+        rq.push(1)
+        rq.push(2)
+        await r1.get()
+        assert rq.max_backlog() == 2  # r2 hasn't drained
+        _ = r2
+        del r2
+
+    run(main())
+
+
+def test_cancelled_reader_hands_item_to_next_waiter():
+    async def main():
+        q = RWQueue("q")
+        r1 = asyncio.ensure_future(q.get())
+        r2 = asyncio.ensure_future(q.get())
+        await asyncio.sleep(0)
+        q.push("x")  # delivered to r1's future
+        r1.cancel()  # r1 cancelled before resuming: item must go to r2
+        await asyncio.sleep(0)
+        assert await r2 == "x"
+        with pytest.raises(asyncio.CancelledError):
+            await r1
+        # stats: exactly one successful read
+        assert q.num_reads == 1
+
+    run(main())
+
+
+def test_replicate_close_clears_readers_then_open_fresh():
+    async def main():
+        rq = ReplicateQueue("rq")
+        rq.get_reader()
+        rq.get_reader()
+        rq.close()
+        assert rq.get_num_readers() == 0
+        rq.open()
+        r = rq.get_reader()
+        assert rq.push("a") == 1
+        assert await r.get() == "a"
+
+    run(main())

@@ -1,0 +1,165 @@
+"""Data-model round-trip and key-format tests."""
+
+from openr_tpu.config import AreaConfig, OpenrConfig
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PerfEvents,
+    PrefixEntry,
+    PrefixMetrics,
+    Publication,
+    Value,
+    adj_key,
+    normalize_prefix,
+    parse_adj_key,
+    parse_prefix_key,
+    prefix_key,
+)
+
+
+def test_adjacency_db_wire_roundtrip():
+    db = AdjacencyDatabase(
+        this_node_name="node1",
+        is_overloaded=True,
+        adjacencies=[
+            Adjacency("node2", "if_1_2_1", metric=10, adj_label=50001, rtt=1500),
+            Adjacency("node3", "if_1_3_1", metric=20, is_overloaded=True),
+        ],
+        node_label=101,
+        area="area1",
+        node_metric_increment_val=5,
+    )
+    wire = db.to_wire()
+    back = AdjacencyDatabase.from_wire(wire)
+    assert back == db
+    assert back.adjacencies[0].adj_label == 50001
+
+
+def test_prefix_entry_normalizes_and_roundtrips():
+    e = PrefixEntry(
+        prefix="10.1.2.3/16",
+        metrics=PrefixMetrics(path_preference=1000, source_preference=200),
+        tags={"b", "a"},
+        area_stack=["area1", "area2"],
+    )
+    assert e.prefix == "10.1.0.0/16"
+    back = PrefixEntry.from_wire(e.to_wire())
+    assert back == e
+    assert back.tags == {"a", "b"}
+    assert isinstance(back.metrics, PrefixMetrics)
+
+
+def test_prefix_metrics_sort_key_ordering():
+    # drain_metric (lower) > path_pref (higher) > src_pref (higher) > distance (lower)
+    best = PrefixMetrics(drain_metric=0, path_preference=1000, source_preference=200)
+    drained = PrefixMetrics(drain_metric=1, path_preference=9999)
+    lower_pp = PrefixMetrics(drain_metric=0, path_preference=500, source_preference=999)
+    farther = PrefixMetrics(
+        drain_metric=0, path_preference=1000, source_preference=200, distance=4
+    )
+    ms = [drained, farther, best, lower_pp]
+    ms.sort(key=lambda m: m.sort_key())
+    assert ms == [best, farther, lower_pp, drained]
+
+
+def test_value_bytes_wire_roundtrip():
+    v = Value(version=3, originator_id="node1", value=b"\x00\xffbinary", ttl=300000)
+    back = Value.from_wire(v.to_wire())
+    assert back == v
+
+
+def test_publication_roundtrip():
+    p = Publication(
+        key_vals={"adj:node1": Value(version=1, originator_id="node1", value=b"x")},
+        expired_keys=["prefix:gone"],
+        node_ids=["node1", "node2"],
+        area="a1",
+    )
+    back = Publication.from_wire(p.to_wire())
+    assert back == p
+    assert back.key_vals["adj:node1"].value == b"x"
+
+
+def test_key_formats():
+    assert adj_key("node-1.pod1") == "adj:node-1.pod1"
+    assert parse_adj_key("adj:node-1.pod1") == "node-1.pod1"
+    assert parse_adj_key("prefix:x") is None
+    k = prefix_key("node1", "2001:db8::1/128")
+    assert k == "prefix:node1:[2001:db8::1/128]"
+    assert parse_prefix_key(k) == ("node1", "2001:db8::1/128")
+    # node names may contain ':' -- parser splits at the ':[' boundary
+    k2 = prefix_key("rsw001.p001:x", "10.0.0.0/24")
+    assert parse_prefix_key(k2) == ("rsw001.p001:x", "10.0.0.0/24")
+    assert parse_prefix_key("prefix:no-bracket") is None
+
+
+def test_normalize_prefix():
+    assert normalize_prefix("10.0.0.5/8") == "10.0.0.0/8"
+    assert normalize_prefix("2001:DB8::5/64") == "2001:db8::/64"
+
+
+def test_perf_events_duration():
+    pe = PerfEvents()
+    pe.add("node1", "ADJ_RECEIVED", 100)
+    pe.add("node1", "ROUTES_BUILT", 250)
+    assert pe.total_duration_ms() == 150
+
+
+def test_config_json_roundtrip():
+    cfg = OpenrConfig(
+        node_name="rsw001",
+        areas=[AreaConfig(area_id="pod1"), AreaConfig(area_id="spine")],
+    )
+    cfg.decision_config.debounce_min_ms = 20
+    cfg.spark_config.hold_time_s = 15.0
+    text = cfg.to_json()
+    back = OpenrConfig.from_json(text)
+    assert back.node_name == "rsw001"
+    assert back.area_ids() == ["pod1", "spine"]
+    assert back.decision_config.debounce_min_ms == 20
+    assert back.spark_config.hold_time_s == 15.0
+    assert back.tpu_compute_config.node_buckets == [16, 64, 256, 1024]
+
+
+def test_config_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        OpenrConfig(areas=[])
+    with pytest.raises(ValueError):
+        OpenrConfig(areas=[AreaConfig("a"), AreaConfig("a")])
+
+
+def test_enum_fields_reconstruct_from_wire():
+    from openr_tpu.types import MplsAction, MplsActionCode, NeighborEvent, NeighborEventType
+
+    ev = NeighborEvent(NeighborEventType.NEIGHBOR_UP, "node2")
+    back = NeighborEvent.from_wire(ev.to_wire())
+    assert back.event_type is NeighborEventType.NEIGHBOR_UP
+    assert back.event_type.name == "NEIGHBOR_UP"
+    act = MplsAction(MplsActionCode.SWAP, swap_label=100)
+    back2 = MplsAction.from_wire(act.to_wire())
+    assert back2.action is MplsActionCode.SWAP
+
+
+def test_link_status_records_roundtrip():
+    from openr_tpu.types import LinkStatusRecords
+
+    db = AdjacencyDatabase(
+        this_node_name="n1",
+        link_status_records=LinkStatusRecords({"eth0": (1, 1234), "eth1": (0, 99)}),
+    )
+    back = AdjacencyDatabase.from_wire(db.to_wire())
+    assert back == db
+    assert back.link_status_records.link_status_map["eth0"] == (1, 1234)
+
+
+def test_config_originated_prefix_tags_set_roundtrip():
+    from openr_tpu.config import OriginatedPrefix
+
+    cfg = OpenrConfig(
+        originated_prefixes=[OriginatedPrefix("10.0.0.0/8", tags={"b", "a"})]
+    )
+    back = OpenrConfig.from_json(cfg.to_json())
+    assert back.originated_prefixes[0].tags == {"a", "b"}
+    assert isinstance(back.originated_prefixes[0].tags, set)
