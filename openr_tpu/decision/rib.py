@@ -1,0 +1,158 @@
+"""RIB entry types and route-update deltas
+(reference: openr/decision/RibEntry.h, RouteUpdate.h).
+
+`DecisionRouteDb` is the full computed RIB; `DecisionRouteUpdate` is the
+delta container pushed Decision → Fib → PrefixManager with FULL_SYNC or
+INCREMENTAL semantics (RouteUpdate.h:30-80).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from openr_tpu.types import (
+    MplsRoute,
+    NextHop,
+    PerfEvents,
+    PrefixEntry,
+    RouteDatabase,
+    RouteDatabaseDelta,
+    UnicastRoute,
+)
+
+
+@dataclass
+class RibUnicastEntry:
+    """One computed unicast route (RibEntry.h:60-140)."""
+
+    prefix: str
+    nexthops: Set[NextHop] = field(default_factory=set)
+    best_prefix_entry: PrefixEntry = field(default_factory=lambda: PrefixEntry("::/0"))
+    best_area: str = ""
+    do_not_install: bool = False
+    igp_cost: float = 0
+    #: was the local node's own advertisement part of best-path selection
+    local_prefix_considered: bool = False
+
+    def to_unicast_route(self) -> UnicastRoute:
+        return UnicastRoute(dest=self.prefix, next_hops=sorted_nexthops(self.nexthops))
+
+    def eq_ignoring_cost(self, other: "RibUnicastEntry") -> bool:
+        """Reference equality (RibEntry.h:82-87): igp_cost and best_area are
+        deliberately EXCLUDED so remote metric shifts that leave nexthops
+        unchanged do not churn the FIB."""
+        return (
+            self.prefix == other.prefix
+            and self.nexthops == other.nexthops
+            and self.best_prefix_entry == other.best_prefix_entry
+            and self.do_not_install == other.do_not_install
+            and self.local_prefix_considered == other.local_prefix_considered
+        )
+
+
+@dataclass
+class RibMplsEntry:
+    """One computed MPLS label route (RibEntry.h:150-198)."""
+
+    label: int
+    nexthops: Set[NextHop] = field(default_factory=set)
+
+    def to_mpls_route(self) -> MplsRoute:
+        return MplsRoute(top_label=self.label, next_hops=sorted_nexthops(self.nexthops))
+
+
+def sorted_nexthops(nhs) -> List[NextHop]:
+    return sorted(
+        nhs,
+        key=lambda nh: (nh.area, nh.neighbor_node_name, nh.if_name, nh.address),
+    )
+
+
+@dataclass
+class DecisionRouteDb:
+    """Full RIB keyed by prefix / label (RouteUpdate.h DecisionRouteDb)."""
+
+    unicast_routes: Dict[str, RibUnicastEntry] = field(default_factory=dict)
+    mpls_routes: Dict[int, RibMplsEntry] = field(default_factory=dict)
+
+    def add_unicast_route(self, entry: RibUnicastEntry) -> None:
+        self.unicast_routes[entry.prefix] = entry
+
+    def add_mpls_route(self, entry: RibMplsEntry) -> None:
+        self.mpls_routes[entry.label] = entry
+
+    def calculate_update(self, new_db: "DecisionRouteDb") -> "DecisionRouteUpdate":
+        """Diff self → new_db (reference DecisionRouteDb::calculateUpdate)."""
+        update = DecisionRouteUpdate(type=DecisionRouteUpdateType.INCREMENTAL)
+        for prefix, entry in new_db.unicast_routes.items():
+            old = self.unicast_routes.get(prefix)
+            if old is None or not old.eq_ignoring_cost(entry):
+                update.unicast_routes_to_update[prefix] = entry
+        for prefix in self.unicast_routes:
+            if prefix not in new_db.unicast_routes:
+                update.unicast_routes_to_delete.append(prefix)
+        for label, mentry in new_db.mpls_routes.items():
+            old_m = self.mpls_routes.get(label)
+            if old_m is None or old_m != mentry:
+                update.mpls_routes_to_update[label] = mentry
+        for label in self.mpls_routes:
+            if label not in new_db.mpls_routes:
+                update.mpls_routes_to_delete.append(label)
+        return update
+
+    def to_route_database(self, node_name: str = "") -> RouteDatabase:
+        return RouteDatabase(
+            this_node_name=node_name,
+            unicast_routes=[
+                e.to_unicast_route() for e in self.unicast_routes.values()
+            ],
+            mpls_routes=[e.to_mpls_route() for e in self.mpls_routes.values()],
+        )
+
+
+class DecisionRouteUpdateType(enum.IntEnum):
+    FULL_SYNC = 0
+    INCREMENTAL = 1
+
+
+@dataclass
+class DecisionRouteUpdate:
+    """Delta pushed on routeUpdatesQueue (RouteUpdate.h:30-184)."""
+
+    type: DecisionRouteUpdateType = DecisionRouteUpdateType.INCREMENTAL
+    unicast_routes_to_update: Dict[str, RibUnicastEntry] = field(default_factory=dict)
+    unicast_routes_to_delete: List[str] = field(default_factory=list)
+    mpls_routes_to_update: Dict[int, RibMplsEntry] = field(default_factory=dict)
+    mpls_routes_to_delete: List[int] = field(default_factory=list)
+    perf_events: Optional[PerfEvents] = None
+
+    def empty(self) -> bool:
+        return not (
+            self.unicast_routes_to_update
+            or self.unicast_routes_to_delete
+            or self.mpls_routes_to_update
+            or self.mpls_routes_to_delete
+        )
+
+    def size(self) -> int:
+        return (
+            len(self.unicast_routes_to_update)
+            + len(self.unicast_routes_to_delete)
+            + len(self.mpls_routes_to_update)
+            + len(self.mpls_routes_to_delete)
+        )
+
+    def to_route_database_delta(self) -> RouteDatabaseDelta:
+        return RouteDatabaseDelta(
+            unicast_routes_to_update=[
+                e.to_unicast_route() for e in self.unicast_routes_to_update.values()
+            ],
+            unicast_routes_to_delete=list(self.unicast_routes_to_delete),
+            mpls_routes_to_update=[
+                e.to_mpls_route() for e in self.mpls_routes_to_update.values()
+            ],
+            mpls_routes_to_delete=list(self.mpls_routes_to_delete),
+            perf_events=self.perf_events,
+        )

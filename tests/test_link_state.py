@@ -1,0 +1,230 @@
+"""LinkState scalar-core tests — golden semantics ported in spirit from
+openr/decision/tests/LinkStateTest.cpp."""
+
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.emulation.topology import (
+    build_adj_dbs,
+    grid_edges,
+    line_edges,
+    make_adjacency,
+    ring_edges,
+)
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+
+def make_link_state(edges, area="0", **kwargs) -> LinkState:
+    ls = LinkState(area)
+    for db in build_adj_dbs(edges, area=area, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def test_unidirectional_adjacency_makes_no_link():
+    ls = LinkState("0")
+    db_a = AdjacencyDatabase("a", adjacencies=[make_adjacency("a", "b")], area="0")
+    ls.update_adjacency_database(db_a)
+    assert ls.num_links() == 0
+    # b confirms -> link appears, topology changed
+    db_b = AdjacencyDatabase("b", adjacencies=[make_adjacency("b", "a")], area="0")
+    change = ls.update_adjacency_database(db_b)
+    assert change.topology_changed
+    assert ls.num_links() == 1
+
+
+def test_spf_line_metrics_and_nexthops():
+    ls = make_link_state(line_edges(4))  # node0-node1-node2-node3
+    res = ls.run_spf("node0")
+    assert res["node0"].metric == 0
+    assert res["node1"].metric == 1
+    assert res["node3"].metric == 3
+    assert res["node1"].next_hops == {"node1"}
+    assert res["node3"].next_hops == {"node1"}
+
+
+def test_spf_ecmp_diamond_all_shortest_paths():
+    #    a
+    #   / \
+    #  b   c
+    #   \ /
+    #    d
+    edges = [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+    ls = make_link_state(edges)
+    res = ls.run_spf("a")
+    assert res["d"].metric == 2
+    assert res["d"].next_hops == {"b", "c"}  # both equal-cost first-hops
+    assert len(res["d"].path_links) == 2
+
+
+def test_spf_asymmetric_metric_uses_max():
+    # soft-drain semantics: one side raises its metric, SPF uses max
+    edges = [("a", "b", 1), ("b", "a", 10), ("b", "c", 1), ("a", "c", 5)]
+    ls = make_link_state(edges)
+    res = ls.run_spf("a")
+    # a->b direct costs max(1,10)=10; a->c direct = 5; a->c->b = 5+1=6
+    assert res["b"].metric == 6
+    assert res["b"].next_hops == {"c"}
+
+
+def test_spf_node_overload_no_transit():
+    # b overloaded: a can still reach b but not THROUGH b
+    edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 10)]
+    ls = make_link_state(edges, overloaded=["b"])
+    res = ls.run_spf("a")
+    assert res["b"].metric == 1  # reachable
+    assert res["c"].metric == 10  # forced around b
+    assert res["c"].next_hops == {"c"}
+    # overloaded root still routes out of itself
+    res_b = ls.run_spf("b")
+    assert res_b["a"].metric == 1 and res_b["c"].metric == 1
+
+
+def test_spf_interface_overload_excludes_link():
+    edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 10)]
+    ls = make_link_state(edges)
+    # hard-drain interface a->b from a's side
+    db = AdjacencyDatabase(
+        "a",
+        adjacencies=[
+            make_adjacency("a", "b", 1, is_overloaded=True),
+            make_adjacency("a", "c", 10),
+        ],
+        area="0",
+    )
+    change = ls.update_adjacency_database(db)
+    assert change.topology_changed
+    res = ls.run_spf("a")
+    assert res["b"].metric == 11  # via c
+    assert res["c"].metric == 10
+
+
+def test_spf_hop_count_mode():
+    edges = [("a", "b", 100), ("b", "c", 100), ("a", "c", 500)]
+    ls = make_link_state(edges)
+    res = ls.run_spf("a", use_link_metric=False)
+    assert res["c"].metric == 1  # direct edge = 1 hop
+    assert res["b"].metric == 1
+
+
+def test_spf_memoization_and_invalidation():
+    ls = make_link_state(ring_edges(6))
+    ls.get_spf_result("node0")
+    ls.get_spf_result("node0")
+    assert ls.num_spf_runs == 1  # memoized
+    ls.get_spf_result("node0", use_link_metric=False)
+    assert ls.num_spf_runs == 2  # different key
+    # attribute-only change (adj label) does NOT invalidate
+    dbs = build_adj_dbs(ring_edges(6))
+    db = dbs["node0"]
+    for adj in db.adjacencies:
+        adj.adj_label = 50001
+    change = ls.update_adjacency_database(db)
+    assert change.link_attributes_changed and not change.topology_changed
+    ls.get_spf_result("node0")
+    assert ls.num_spf_runs == 2
+    # metric change DOES invalidate
+    for adj in db.adjacencies:
+        adj.metric = 7
+    change = ls.update_adjacency_database(db)
+    assert change.topology_changed
+    ls.get_spf_result("node0")
+    assert ls.num_spf_runs == 3
+
+
+def test_delete_adjacency_database():
+    ls = make_link_state(line_edges(3))
+    assert ls.has_node("node1")
+    change = ls.delete_adjacency_database("node1")
+    assert change.topology_changed
+    res = ls.run_spf("node0")
+    assert "node2" not in res  # partitioned
+
+
+def test_get_metric_a_to_b():
+    ls = make_link_state(line_edges(4))
+    assert ls.get_metric_from_a_to_b("node0", "node3") == 3
+    assert ls.get_metric_from_a_to_b("node0", "node0") == 0
+    ls.delete_adjacency_database("node3")
+    assert ls.get_metric_from_a_to_b("node0", "node3") is None
+
+
+def test_kth_paths_ring():
+    # square ring: two edge-disjoint paths between opposite corners
+    ls = make_link_state(ring_edges(4))
+    p1 = ls.get_kth_paths("node0", "node2", 1)
+    p2 = ls.get_kth_paths("node0", "node2", 2)
+    # k=1: both equal-cost 2-hop paths are edge-disjoint -> both traced
+    assert len(p1) == 2
+    assert all(len(p) == 2 for p in p1)
+    # k=2: all links already used by k=1 paths
+    assert p2 == []
+
+
+def test_kth_paths_unequal_cost_disjoint():
+    # path1: a-b-d (cost 2); path2: a-c-d (cost 4): k=2 finds the longer one
+    edges = [("a", "b", 1), ("b", "d", 1), ("a", "c", 2), ("c", "d", 2)]
+    ls = make_link_state(edges)
+    p1 = ls.get_kth_paths("a", "d", 1)
+    assert len(p1) == 1 and len(p1[0]) == 2
+    nodes1 = {l.n1 for l in p1[0]} | {l.n2 for l in p1[0]}
+    assert nodes1 == {"a", "b", "d"}
+    p2 = ls.get_kth_paths("a", "d", 2)
+    assert len(p2) == 1
+    nodes2 = {l.n1 for l in p2[0]} | {l.n2 for l in p2[0]}
+    assert nodes2 == {"a", "c", "d"}
+    # k=3: exhausted
+    assert ls.get_kth_paths("a", "d", 3) == []
+
+
+def test_adj_only_used_by_other_node():
+    # b is initializing: adj a->b marked adjOnlyUsedByOtherNode.
+    # From a's perspective (my_node_name=a) the link is unusable;
+    # from b's perspective it is usable.
+    adj_ab = make_adjacency("a", "b", 1, adj_only_used_by_other_node=True)
+    adj_ba = make_adjacency("b", "a", 1)
+    db_a = AdjacencyDatabase("a", adjacencies=[adj_ab], area="0")
+    db_b = AdjacencyDatabase("b", adjacencies=[adj_ba], area="0")
+
+    ls_a = LinkState("0", my_node_name="a")
+    ls_a.update_adjacency_database(db_a)
+    ls_a.update_adjacency_database(db_b)
+    res_a = ls_a.run_spf("a")
+    assert "b" not in res_a  # a must not route to/through initializing b
+
+    ls_b = LinkState("0", my_node_name="b")
+    ls_b.update_adjacency_database(db_a)
+    ls_b.update_adjacency_database(db_b)
+    res_b = ls_b.run_spf("b")
+    assert res_b["a"].metric == 1  # b may route through a
+
+
+def test_grid_spf_corner_to_corner():
+    n = 4
+    ls = make_link_state(grid_edges(n))
+    res = ls.run_spf("node0")
+    # manhattan distance to far corner
+    assert res[f"node{n * n - 1}"].metric == 2 * (n - 1)
+    # both directions out of the corner are equal-cost first hops
+    assert res[f"node{n * n - 1}"].next_hops == {"node1", f"node{n}"}
+
+
+def test_spf_root_missing_returns_root_only():
+    ls = make_link_state(line_edges(3))
+    res = ls.run_spf("ghost")
+    assert set(res) == {"ghost"}
+    assert res["ghost"].metric == 0
+
+
+def test_random_connected_edges_clamps_extra():
+    from openr_tpu.emulation.topology import random_connected_edges
+
+    edges = random_connected_edges(3, extra_edges=99, seed=1)
+    assert len(edges) == 3  # spanning tree (2) + max 1 chord, no hang
+
+
+def test_make_adjacency_deterministic_across_calls():
+    a1 = make_adjacency("x", "y")
+    a2 = make_adjacency("x", "y")
+    assert a1.next_hop_v6 == a2.next_hop_v6
+    assert a1.next_hop_v6.startswith("fe80::")
