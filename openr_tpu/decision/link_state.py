@@ -260,6 +260,10 @@ class LinkState:
     def links_from_node(self, node: str) -> Set[Link]:
         return self._link_map.get(node, set())
 
+    def all_links(self) -> List[Link]:
+        """All undirected links, in canonical order (stable across calls)."""
+        return sorted(self._all_links)
+
     def ordered_links_from_node(self, node: str) -> List[Link]:
         return sorted(self._link_map.get(node, set()))
 

@@ -359,7 +359,7 @@ class SpfSolver:
                 continue
             if forwarding_algorithm == PrefixForwardingAlgorithm.KSP2_ED_ECMP:
                 best_metric, nhs = self._select_best_paths_ksp2(
-                    prefix, selection, prefix_entries, area, link_state, is_v4
+                    selection, prefix_entries, area, link_state, is_v4
                 )
             else:
                 best_metric, nhs = self._select_best_paths_spf(
@@ -401,7 +401,6 @@ class SpfSolver:
 
     def _select_best_paths_ksp2(
         self,
-        prefix: str,
         selection: RouteSelectionResult,
         prefix_entries: PrefixEntries,
         area: str,
@@ -415,14 +414,14 @@ class SpfSolver:
         is SR_MPLS, non-shortest paths are pinned with a PUSH label stack of
         the downstream nodes' segment labels (top = second hop).
         """
-        paths: List[Tuple[Path, NodeAndArea]] = []
+        paths: List[Tuple[Path, int]] = []
         for na in selection.all_node_areas:
             if na[1] != area:
                 continue
             for k in (1, 2):
                 for p in link_state.get_kth_paths(self.my_node_name, na[0], k):
                     if p:
-                        paths.append((p, na))
+                        paths.append((p, sum(l.get_max_metric() for l in p)))
         if not paths:
             return INF, set()
 
@@ -432,12 +431,8 @@ class SpfSolver:
         )
         adj_dbs = link_state.get_adjacency_databases()
         next_hops: Set[NextHop] = set()
-        best_metric = INF
-        for path, _na in paths:
-            cost = sum(l.get_max_metric() for l in path)
-            best_metric = min(best_metric, cost)
-        for path, _na in paths:
-            cost = sum(l.get_max_metric() for l in path)
+        best_metric = min(cost for _, cost in paths)
+        for path, cost in paths:
             first = path[0]
             neighbor = first.get_other_node_name(self.my_node_name)
             mpls_action = None

@@ -1,0 +1,256 @@
+"""Topology encoding: LinkState graphs → padded device arrays.
+
+This is the host↔device bridge (SURVEY §7 hard-part 4): node names are
+interned to dense int ids, bidirectional links become two directed edges
+carrying the soft-drain MAX metric (LinkState.cpp:789 semantics), and
+everything is padded to shape buckets so the jit cache stays stable across
+LSDB churn.
+
+Layout (single topology; batch adds a leading dim):
+  * ``src[E], dst[E]`` int32 directed edge endpoints (padded with 0)
+  * ``w[E]`` float32 edge metric; ``INF`` for padding/down links
+  * ``edge_ok[E]`` bool validity (up, usable, not padding)
+  * ``overloaded[V]`` bool node hard-drain bits
+  * ``soft[V]`` int32 node soft-drain increments
+  * ``node_ok[V]`` bool validity
+  * ``link_index[E]`` int32: undirected link id for each directed edge, so
+    per-link what-if failure masks expand to both directions
+
+The decoder side keeps the symbol table and the per-root out-edge ranking
+used to map nexthop bitmask lanes back to `Link` objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from openr_tpu.decision.link_state import Link, LinkState
+
+INF = np.float32(np.inf)
+
+
+def bucket_for(value: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if value <= b:
+            return b
+    raise ValueError(f"{value} exceeds largest bucket {buckets[-1]}")
+
+
+@dataclasses.dataclass
+class EncodedTopology:
+    """Device-ready arrays + host-side decode tables for ONE topology."""
+
+    # device arrays (numpy; moved to device by the caller/jit)
+    src: np.ndarray  # [E] int32
+    dst: np.ndarray  # [E] int32
+    w: np.ndarray  # [E] float32
+    edge_ok: np.ndarray  # [E] bool
+    overloaded: np.ndarray  # [V] bool
+    soft: np.ndarray  # [V] int32
+    node_ok: np.ndarray  # [V] bool
+    link_index: np.ndarray  # [E] int32 (undirected link id, -1 pad)
+
+    # host decode tables
+    node_ids: Dict[str, int]
+    id_to_node: List[str]
+    links: List[Link]  # undirected link objects by link id
+    num_nodes: int
+    num_edges: int  # valid directed edges
+
+    @property
+    def padded_nodes(self) -> int:
+        return int(self.overloaded.shape[0])
+
+    @property
+    def padded_edges(self) -> int:
+        return int(self.src.shape[0])
+
+    def node_id(self, name: str) -> int:
+        return self.node_ids[name]
+
+    # -- nexthop lane decoding --------------------------------------------
+
+    def root_out_edges(self, root: str) -> List[Tuple[Link, str]]:
+        """Lane r of the nexthop bitmask (for SPF rooted at `root`)
+        corresponds to the r-th directed edge with src == root, in edge
+        order.  Returns [(link, neighbor_node_name)] by lane."""
+        rid = self.node_ids[root]
+        out = []
+        for e in range(self.padded_edges):
+            if self.src[e] == rid and self.link_index[e] >= 0:
+                link = self.links[self.link_index[e]]
+                out.append((link, self.id_to_node[self.dst[e]]))
+        return out
+
+    def max_out_degree(self) -> int:
+        valid = self.link_index >= 0
+        if not valid.any():
+            return 0
+        counts = np.bincount(self.src[valid], minlength=self.padded_nodes)
+        return int(counts.max())
+
+
+def encode_link_state(
+    link_state: LinkState,
+    node_bucket: Optional[int] = None,
+    edge_bucket: Optional[int] = None,
+    node_buckets: Sequence[int] = (16, 64, 256, 1024, 4096),
+    edge_multiplier: int = 8,
+    extra_nodes: Sequence[str] = (),
+) -> EncodedTopology:
+    """Encode one LinkState area graph.
+
+    Only up/usable links are emitted as valid edges (interface hard-drain
+    excluded here, exactly as Link::isUp excludes them from SPF).  Node
+    hard/soft drain bits ride separately so what-if sweeps can flip them
+    per snapshot.  `extra_nodes` forces symbol-table entries for nodes
+    known to other modules (e.g. advertisers with no adjacencies yet).
+    """
+    names = sorted(
+        set(link_state.get_adjacency_databases().keys())
+        | {n for n in extra_nodes}
+    )
+    node_ids = {n: i for i, n in enumerate(names)}
+    V = len(names)
+    padded_v = node_bucket or bucket_for(max(V, 1), node_buckets)
+
+    links = link_state.all_links()
+    directed: List[Tuple[int, int, float, bool, int]] = []
+    for li, link in enumerate(links):
+        m = float(link.get_max_metric())
+        ok = link.is_up()
+        a, b = node_ids[link.n1], node_ids[link.n2]
+        directed.append((a, b, m, ok, li))
+        directed.append((b, a, m, ok, li))
+    E = len(directed)
+    padded_e = edge_bucket or bucket_for(
+        max(E, 1), [b * edge_multiplier for b in node_buckets]
+    )
+    if padded_v < V:
+        raise ValueError(f"node bucket {padded_v} < {V} nodes")
+    if padded_e < E:
+        raise ValueError(f"edge bucket {padded_e} < {E} directed edges")
+
+    src = np.zeros(padded_e, np.int32)
+    dst = np.zeros(padded_e, np.int32)
+    w = np.full(padded_e, INF, np.float32)
+    edge_ok = np.zeros(padded_e, bool)
+    link_index = np.full(padded_e, -1, np.int32)
+    for e, (a, b, m, ok, li) in enumerate(directed):
+        src[e], dst[e], link_index[e] = a, b, li
+        if ok:
+            w[e] = m
+            edge_ok[e] = True
+
+    overloaded = np.zeros(padded_v, bool)
+    soft = np.zeros(padded_v, np.int32)
+    node_ok = np.zeros(padded_v, bool)
+    node_ok[:V] = True
+    for n, i in node_ids.items():
+        overloaded[i] = link_state.is_node_overloaded(n)
+        soft[i] = link_state.get_node_metric_increment(n)
+
+    return EncodedTopology(
+        src=src,
+        dst=dst,
+        w=w,
+        edge_ok=edge_ok,
+        overloaded=overloaded,
+        soft=soft,
+        node_ok=node_ok,
+        link_index=link_index,
+        node_ids=node_ids,
+        id_to_node=names,
+        links=links,
+        num_nodes=V,
+        num_edges=E,
+    )
+
+
+@dataclasses.dataclass
+class EncodedPrefixCandidates:
+    """Per-prefix candidate advertisements → device arrays.
+
+    Shapes [P, C]: for each of P prefixes, up to C candidate (node, metrics)
+    advertisements.  Used by the on-device best-route selection.
+    """
+
+    cand_node: np.ndarray  # [P, C] int32 node ids
+    cand_ok: np.ndarray  # [P, C] bool
+    drain_metric: np.ndarray  # [P, C] int32
+    path_pref: np.ndarray  # [P, C] int32
+    source_pref: np.ndarray  # [P, C] int32
+    distance: np.ndarray  # [P, C] int32
+    min_nexthop: np.ndarray  # [P, C] int32 (0 = unset)
+    prefixes: List[str]
+
+    @property
+    def num_prefixes(self) -> int:
+        return len(self.prefixes)
+
+
+def encode_prefix_candidates(
+    prefix_state,
+    topo: EncodedTopology,
+    area: str,
+    max_candidates: int = 8,
+) -> EncodedPrefixCandidates:
+    """Flatten PrefixState (for one area) into padded candidate arrays."""
+    prefixes = sorted(prefix_state.prefixes().keys())
+    P = max(len(prefixes), 1)
+    C = max_candidates
+    cand_node = np.zeros((P, C), np.int32)
+    cand_ok = np.zeros((P, C), bool)
+    drain = np.zeros((P, C), np.int32)
+    pp = np.zeros((P, C), np.int32)
+    sp = np.zeros((P, C), np.int32)
+    dist = np.zeros((P, C), np.int32)
+    minnh = np.zeros((P, C), np.int32)
+    for p, prefix in enumerate(prefixes):
+        c = 0
+        for (node, parea), entry in sorted(prefix_state.prefixes()[prefix].items()):
+            if parea != area or node not in topo.node_ids:
+                continue
+            if c >= C:
+                raise ValueError(
+                    f"prefix {prefix}: more than {C} candidates; raise "
+                    "max_candidates"
+                )
+            cand_node[p, c] = topo.node_ids[node]
+            cand_ok[p, c] = True
+            drain[p, c] = entry.metrics.drain_metric
+            pp[p, c] = entry.metrics.path_preference
+            sp[p, c] = entry.metrics.source_preference
+            dist[p, c] = entry.metrics.distance
+            minnh[p, c] = entry.min_nexthop or 0
+            c += 1
+    return EncodedPrefixCandidates(
+        cand_node=cand_node,
+        cand_ok=cand_ok,
+        drain_metric=drain,
+        path_pref=pp,
+        source_pref=sp,
+        distance=dist,
+        min_nexthop=minnh,
+        prefixes=prefixes,
+    )
+
+
+def link_failure_batch(
+    topo: EncodedTopology, failed_links_per_snapshot: List[List[int]]
+) -> np.ndarray:
+    """Build a [B, E] edge-enable mask from per-snapshot failed undirected
+    link ids — the 10k what-if perturbation encoding (base topology is
+    encoded once; the batch is just this mask)."""
+    B = len(failed_links_per_snapshot)
+    E = topo.padded_edges
+    mask = np.ones((B, E), bool)
+    for b, failed in enumerate(failed_links_per_snapshot):
+        if not failed:
+            continue
+        failed_set = np.isin(topo.link_index, np.asarray(failed, np.int32))
+        mask[b, failed_set] = False
+    return mask

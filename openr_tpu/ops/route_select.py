@@ -1,0 +1,181 @@
+"""On-device best-route selection — the batched buildRouteDb hot loop.
+
+Implements SpfSolver's per-prefix selection semantics
+(SpfSolver.cpp:161-312, 456-556; LsdbUtil.cpp:761-823) as a vectorized
+kernel over [P] prefixes × [C] candidate advertisements, given single-root
+SPF outputs (dist [V], nexthop lanes [V, D]):
+
+  1. reachability filter (candidate node reached by SPF)
+  2. hard-drain filter with all-drained fallback (filterHardDrainedNodes)
+  3. metric chain: NOT drained (drain_metric or node soft-drained)
+     ▸ higher path_preference ▸ higher source_preference
+  4. SHORTEST_DISTANCE on metrics.distance
+  5. skip-if-self (winners containing the root produce no route)
+  6. igp tie: winners at min SPF distance contribute their nexthop lanes
+  7. min-nexthop threshold gate (max over winners' requirements)
+
+Outputs per prefix: valid bit, igp metric, ECMP nexthop lane set.
+vmap over a leading batch axis for what-if sweeps.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from openr_tpu.ops.spf import BIG
+
+I32_MIN = jnp.int32(-(2**31))
+I32_MAX = jnp.int32(2**31 - 1)
+
+
+def select_routes_one(
+    cand_node,  # [P, C] int32
+    cand_ok,  # [P, C] bool
+    drain_metric,  # [P, C] int32
+    path_pref,  # [P, C] int32
+    source_pref,  # [P, C] int32
+    distance,  # [P, C] int32
+    min_nexthop,  # [P, C] int32 (0 = no requirement)
+    dist,  # [V] f32 SPF distances from the root
+    nh,  # [V, D] int8 nexthop lanes from the root
+    overloaded,  # [V] bool
+    soft,  # [V] int32 node soft-drain increments
+    root,  # scalar int32
+):
+    """Single-snapshot selection.  Returns (valid [P], metric [P],
+    nexthops [P, D] int8, num_nexthops [P])."""
+    cdist = dist[cand_node]  # [P, C]
+    reach = cand_ok & (cdist < BIG)
+
+    # hard-drain filter w/ fallback (SpfSolver.cpp:527-545)
+    hard = overloaded[cand_node]
+    nonhard = reach & ~hard
+    any_nonhard = jnp.any(nonhard, axis=1, keepdims=True)
+    use = jnp.where(any_nonhard, nonhard, reach)
+
+    # drain tie-break: advertised drain_metric OR locally soft-drained node
+    drained = (drain_metric > 0) | (soft[cand_node] > 0)
+    not_drained = (~drained).astype(jnp.int32)
+
+    def keep_max(mask, key):
+        best = jnp.max(jnp.where(mask, key, I32_MIN), axis=1, keepdims=True)
+        return mask & (key == best)
+
+    def keep_min(mask, key):
+        best = jnp.min(jnp.where(mask, key, I32_MAX), axis=1, keepdims=True)
+        return mask & (key == best)
+
+    use = keep_max(use, not_drained)
+    use = keep_max(use, path_pref)
+    use = keep_max(use, source_pref)
+    use = keep_min(use, distance)  # SHORTEST_DISTANCE algorithm
+
+    # skip-if-self: local advertisement among winners → no route
+    self_wins = jnp.any(use & (cand_node == root), axis=1)
+
+    # igp tie-break among winners → ECMP set (getNextHopsWithMetric)
+    best_igp = jnp.min(jnp.where(use, cdist, BIG), axis=1)  # [P]
+    winners = use & (cdist == best_igp[:, None])  # [P, C]
+
+    # union of winners' nexthop lanes
+    cand_nh = nh[cand_node]  # [P, C, D]
+    nh_out = jnp.max(
+        jnp.where(winners[:, :, None], cand_nh, jnp.int8(0)), axis=1
+    )  # [P, D]
+    num_nh = jnp.sum(nh_out.astype(jnp.int32), axis=1)  # [P]
+
+    # min-nexthop requirement: max over ALL selection winners, not just the
+    # IGP-min subset (getMinNextHopThreshold iterates allNodeAreas,
+    # SpfSolver.cpp:496-510)
+    req = jnp.max(jnp.where(use, min_nexthop, 0), axis=1)
+    valid = (
+        jnp.any(winners, axis=1)
+        & (~self_wins)
+        & (best_igp < BIG)
+        & (num_nh > 0)
+        & (num_nh >= req)
+    )
+    return valid, best_igp, nh_out, num_nh
+
+
+@jax.jit
+def batched_select_routes(
+    cand_node,
+    cand_ok,
+    drain_metric,
+    path_pref,
+    source_pref,
+    distance,
+    min_nexthop,
+    dist,  # [B, V]
+    nh,  # [B, V, D]
+    overloaded,  # [B, V]
+    soft,  # [B, V]
+    roots,  # [B]
+):
+    """Candidate tables shared across the batch; SPF state per snapshot."""
+
+    def one(d, n, ovl, sft, root):
+        return select_routes_one(
+            cand_node,
+            cand_ok,
+            drain_metric,
+            path_pref,
+            source_pref,
+            distance,
+            min_nexthop,
+            d,
+            n,
+            ovl,
+            sft,
+            root,
+        )
+
+    return jax.vmap(one)(dist, nh, overloaded, soft, roots)
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def spf_and_select(
+    src,
+    dst,
+    w,
+    edge_ok,
+    edge_enabled,  # [B, E]
+    overloaded,  # [B, V]
+    soft,  # [B, V]
+    roots,  # [B]
+    cand_node,
+    cand_ok,
+    drain_metric,
+    path_pref,
+    source_pref,
+    distance,
+    min_nexthop,
+    max_degree: int,
+):
+    """Fused what-if pipeline: batched SPF + batched route selection in one
+    jit so XLA overlaps the two phases and intermediates stay on device.
+    This is the flagship kernel behind bench.py and dryrun_multichip."""
+    from openr_tpu.ops.spf import spf_one
+
+    def one(edge_en, ovl, sft, root):
+        d, n = spf_one(src, dst, w, edge_ok & edge_en, ovl, root, max_degree)
+        return select_routes_one(
+            cand_node,
+            cand_ok,
+            drain_metric,
+            path_pref,
+            source_pref,
+            distance,
+            min_nexthop,
+            d,
+            n,
+            ovl,
+            sft,
+            root,
+        )
+
+    return jax.vmap(one)(edge_enabled, overloaded, soft, roots)

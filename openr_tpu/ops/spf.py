@@ -1,0 +1,207 @@
+"""Batched SPF kernels — the TPU replacement for LinkState::runSpf.
+
+Heap Dijkstra doesn't vectorize, so shortest paths are computed as a masked
+Bellman-Ford fixed point over the directed edge list (jnp.segment_min per
+relaxation round), followed by a shortest-path-DAG fixed point that
+propagates first-hop ("nexthop lane") sets as boolean matrices — the
+device analogue of NodeSpfResult.nextHops (LinkState.h:290-345).
+
+Reference-parity rules implemented on device:
+  * node hard-drain: an overloaded node receives traffic but never relaxes
+    its out-edges, except when it is the SPF root (LinkState.cpp:739-752)
+  * interface hard-drain / down links: excluded via `edge_ok`
+  * soft-drain max-directional-metric is already folded into `w` by the
+    encoder (LinkState.cpp:789)
+  * hop-count mode (useLinkMetric=false): pass `w = 1` weights
+  * all-shortest-paths: a nexthop lane r corresponds to the r-th out-edge
+    of the root; lane sets propagate along DAG edges with OR (segment_max
+    over int8), seeded at the root's direct successors
+
+Everything is shape-static and jit/vmap/shard_map-friendly: batches of
+topologies vmap over the leading axis; what-if sweeps reuse one edge list
+with a per-snapshot `edge_enabled` mask.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.float32(3.4e38)  # effectively-infinite distance, f32-safe
+
+
+def _can_transit(overloaded: jnp.ndarray, root: jnp.ndarray) -> jnp.ndarray:
+    """[V] bool: which nodes may relax their out-edges."""
+    v = overloaded.shape[0]
+    return (~overloaded) | (jnp.arange(v, dtype=jnp.int32) == root)
+
+
+def spf_distances(
+    src: jnp.ndarray,  # [E] int32
+    dst: jnp.ndarray,  # [E] int32
+    w: jnp.ndarray,  # [E] float32 (INF/BIG for down/pad edges)
+    edge_ok: jnp.ndarray,  # [E] bool
+    overloaded: jnp.ndarray,  # [V] bool
+    root: jnp.ndarray,  # scalar int32
+    max_iters: Optional[int] = None,
+) -> jnp.ndarray:
+    """Single-source shortest distances, one topology.  Returns [V] f32
+    with BIG for unreachable nodes.  vmap over the leading axis for
+    batches."""
+    V = overloaded.shape[0]
+    w = jnp.where(edge_ok, w, BIG).astype(jnp.float32)
+    dist0 = jnp.full((V,), BIG, jnp.float32).at[root].set(0.0)
+    transit = _can_transit(overloaded, root)
+    src_ok = transit[src] & edge_ok
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        d, _, i = state
+        cand = jnp.where(src_ok, d[src] + w, BIG)
+        best_in = jax.ops.segment_min(cand, dst, num_segments=V)
+        nd = jnp.minimum(d, best_in)
+        return nd, jnp.any(nd < d), i + 1
+
+    dist, _, _ = jax.lax.while_loop(cond, body, (dist0, jnp.bool_(True), jnp.int32(0)))
+    return dist
+
+
+def shortest_path_dag(
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    w: jnp.ndarray,
+    edge_ok: jnp.ndarray,
+    overloaded: jnp.ndarray,
+    root: jnp.ndarray,
+    dist: jnp.ndarray,  # [V] from spf_distances
+) -> jnp.ndarray:
+    """[E] bool: directed edges on some shortest path from root."""
+    transit = _can_transit(overloaded, root)
+    reached = dist[dst] < BIG
+    return (
+        edge_ok
+        & transit[src]
+        & reached
+        & (dist[src] + jnp.where(edge_ok, w, BIG) == dist[dst])
+    )
+
+
+def spf_nexthop_lanes(
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    w: jnp.ndarray,
+    edge_ok: jnp.ndarray,
+    overloaded: jnp.ndarray,
+    root: jnp.ndarray,
+    dist: jnp.ndarray,
+    max_degree: int,
+    max_iters: Optional[int] = None,
+) -> jnp.ndarray:
+    """All-shortest-paths first-hop sets as [V, D] int8 (0/1).
+
+    Lane r == the r-th directed out-edge of `root` in edge order (decode
+    with EncodedTopology.root_out_edges).  nh[v][r] == 1 iff some shortest
+    path root→v leaves root over that edge.
+    """
+    V = overloaded.shape[0]
+    E = src.shape[0]
+    D = max_degree
+    sp_edge = shortest_path_dag(src, dst, w, edge_ok, overloaded, root, dist)
+    is_root_out = src == root
+    # stable lane per root-out edge: rank among root-out edges in edge order
+    rank = jnp.cumsum(is_root_out.astype(jnp.int32)) - 1  # [E]
+    lanes = jnp.arange(D, dtype=jnp.int32)[None, :]  # [1, D]
+    seed = (is_root_out[:, None] & (rank[:, None] == lanes)).astype(jnp.int8)
+    sp_mask = sp_edge[:, None].astype(jnp.int8)  # [E, 1]
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    nh0 = jnp.zeros((V, D), jnp.int8)
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        nh, _, i = state
+        # contribution of edge e into dst[e]: the seed lane if it leaves the
+        # root, else the source node's accumulated lane set
+        contrib = jnp.where(is_root_out[:, None], seed, nh[src]) * sp_mask
+        new = jax.ops.segment_max(contrib, dst, num_segments=V)
+        new = jnp.maximum(new, nh)
+        return new, jnp.any(new != nh), i + 1
+
+    nh, _, _ = jax.lax.while_loop(cond, body, (nh0, jnp.bool_(True), jnp.int32(0)))
+    return nh
+
+
+def spf_one(
+    src,
+    dst,
+    w,
+    edge_ok,
+    overloaded,
+    root,
+    max_degree: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(dist [V], nexthop lanes [V, D]) for one topology + root."""
+    dist = spf_distances(src, dst, w, edge_ok, overloaded, root)
+    nh = spf_nexthop_lanes(
+        src, dst, w, edge_ok, overloaded, root, dist, max_degree
+    )
+    return dist, nh
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def batched_spf(
+    src,  # [E] shared edge list
+    dst,  # [E]
+    w,  # [E]
+    edge_ok,  # [E] static validity (padding, permanently-down links)
+    edge_enabled,  # [B, E] per-snapshot what-if mask
+    overloaded,  # [B, V] per-snapshot hard-drain bits
+    roots,  # [B] int32 SPF roots
+    max_degree: int,
+):
+    """The what-if sweep kernel: B topology snapshots (shared edge list,
+    per-snapshot edge/overload perturbations + roots) solved in parallel.
+
+    Returns (dist [B, V], nh [B, V, D]).
+    """
+
+    def one(edge_en, ovl, root):
+        return spf_one(
+            src, dst, w, edge_ok & edge_en, ovl, root, max_degree
+        )
+
+    return jax.vmap(one)(edge_enabled, overloaded, roots)
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def batched_spf_distinct(
+    src,  # [B, E] per-snapshot edge lists
+    dst,  # [B, E]
+    w,  # [B, E]
+    edge_ok,  # [B, E]
+    overloaded,  # [B, V]
+    roots,  # [B]
+    max_degree: int,
+):
+    """Fully distinct topologies per snapshot (different graphs padded to a
+    common bucket)."""
+
+    def one(s, d, ww, eo, ovl, root):
+        return spf_one(s, d, ww, eo, ovl, root, max_degree)
+
+    return jax.vmap(one)(src, dst, w, edge_ok, overloaded, roots)
+
+
+def hop_count_weights(w: jnp.ndarray) -> jnp.ndarray:
+    """useLinkMetric=false mode: every edge costs 1 (LinkState.cpp:789)."""
+    return jnp.ones_like(w)

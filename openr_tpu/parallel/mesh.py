@@ -1,0 +1,79 @@
+"""Device-mesh sharding for what-if topology sweeps.
+
+The reference's scale axis is N daemons on N network nodes; ours adds a
+compute axis: thousands of topology snapshots data-parallel over a TPU
+mesh (SURVEY §2.3, §5 "batched topology parallelism").  Batches shard on
+the ``batch`` axis; the (small) shared edge list and candidate tables are
+replicated.  XLA inserts the collectives; on multi-host TPU the same code
+runs over ICI/DCN unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(num_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.array(devices), (BATCH_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(BATCH_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Place [B, ...] arrays with B sharded across the mesh.  B must be a
+    multiple of the mesh size (pad snapshots with no-op perturbations)."""
+    sh = batch_sharding(mesh)
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def sharded_spf_and_select(mesh: Mesh, max_degree: int):
+    """Build the sharded flagship kernel: batch-sharded SPF + route
+    selection over the mesh.  Shared topology/candidate inputs are
+    replicated; per-snapshot inputs and all outputs are batch-sharded."""
+    from openr_tpu.ops.route_select import spf_and_select
+
+    b = NamedSharding(mesh, P(BATCH_AXIS))
+    r = NamedSharding(mesh, P())
+    fn = functools.partial(spf_and_select, max_degree=max_degree)
+    return jax.jit(
+        fn,
+        in_shardings=(
+            r,  # src
+            r,  # dst
+            r,  # w
+            r,  # edge_ok
+            b,  # edge_enabled [B, E]
+            b,  # overloaded [B, V]
+            b,  # soft [B, V]
+            b,  # roots [B]
+            r,  # cand_node
+            r,  # cand_ok
+            r,  # drain_metric
+            r,  # path_pref
+            r,  # source_pref
+            r,  # distance
+            r,  # min_nexthop
+        ),
+        out_shardings=(b, b, b, b),
+    )

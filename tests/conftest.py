@@ -3,12 +3,23 @@ multi-chip sharding paths are exercised without TPU hardware."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of harness-provided platform (a real-TPU session may
+# preset JAX_PLATFORMS or register a TPU plugin that overrides it via
+# jax.config): tests exercise the 8-device sharded code paths on a virtual
+# host mesh.  Set OPENR_TPU_TEST_PLATFORM to override.
+_platform = os.environ.get("OPENR_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if _platform == "cpu":
+    import jax
+
+    # a site hook may have force-selected an accelerator platform already
+    jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 

@@ -1,0 +1,340 @@
+"""Differential validation: batched JAX SPF + route selection vs the
+scalar oracle (LinkState/SpfSolver).  Runs on the 8-device virtual CPU
+mesh configured in conftest.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.topology import (
+    build_adj_dbs,
+    grid_edges,
+    random_connected_edges,
+    ring_edges,
+)
+from openr_tpu.ops.csr import (
+    encode_link_state,
+    encode_prefix_candidates,
+    link_failure_batch,
+)
+from openr_tpu.ops.route_select import batched_select_routes, spf_and_select
+from openr_tpu.ops.spf import BIG, batched_spf, spf_one
+from openr_tpu.types import PrefixEntry, PrefixMetrics
+
+
+def make_ls(edges, **kwargs) -> LinkState:
+    ls = LinkState("0")
+    for db in build_adj_dbs(edges, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def scalar_spf_arrays(ls: LinkState, topo, root: str):
+    """Scalar oracle → (dist array, nexthop-neighbor-set list) in id space."""
+    res = ls.run_spf(root)
+    V = topo.padded_nodes
+    dist = np.full(V, np.inf)
+    nhs = [set() for _ in range(V)]
+    for node, r in res.items():
+        i = topo.node_id(node)
+        dist[i] = r.metric
+        nhs[i] = set(r.next_hops)
+    return dist, nhs
+
+
+def kernel_spf(ls: LinkState, root: str, **enc_kwargs):
+    topo = encode_link_state(ls, **enc_kwargs)
+    D = max(topo.max_out_degree(), 1)
+    dist, nh = spf_one(
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.asarray(topo.overloaded),
+        jnp.int32(topo.node_id(root)),
+        D,
+    )
+    return topo, np.asarray(dist), np.asarray(nh)
+
+
+def decode_nh_neighbors(topo, root, nh_row) -> set:
+    out_edges = topo.root_out_edges(root)
+    return {
+        neighbor
+        for lane, (_, neighbor) in enumerate(out_edges)
+        if lane < nh_row.shape[0] and nh_row[lane]
+    }
+
+
+def assert_spf_parity(ls: LinkState, root: str):
+    topo, kdist, knh = kernel_spf(ls, root)
+    sdist, snhs = scalar_spf_arrays(ls, topo, root)
+    for i in range(topo.num_nodes):
+        if np.isinf(sdist[i]):
+            assert kdist[i] >= BIG, f"node {topo.id_to_node[i]} reachability"
+        else:
+            assert kdist[i] == pytest.approx(sdist[i]), topo.id_to_node[i]
+            got = decode_nh_neighbors(topo, root, knh[i])
+            assert got == snhs[i], (
+                f"nexthops for {topo.id_to_node[i]}: kernel {got} vs "
+                f"scalar {snhs[i]}"
+            )
+
+
+def test_parity_line():
+    assert_spf_parity(make_ls([("a", "b", 1), ("b", "c", 2)]), "a")
+
+
+def test_parity_ecmp_diamond():
+    edges = [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+    assert_spf_parity(make_ls(edges), "a")
+
+
+def test_parity_grid():
+    assert_spf_parity(make_ls(grid_edges(4)), "node0")
+    assert_spf_parity(make_ls(grid_edges(4)), "node5")
+
+
+def test_parity_overloaded_transit():
+    edges = [("a", "b", 1), ("b", "c", 1), ("a", "c", 10)]
+    assert_spf_parity(make_ls(edges, overloaded=["b"]), "a")
+    # overloaded root still relaxes
+    assert_spf_parity(make_ls(edges, overloaded=["a"]), "a")
+
+
+def test_parity_asymmetric_metrics_max_rule():
+    edges = [("a", "b", 1), ("b", "a", 10), ("b", "c", 1), ("a", "c", 5)]
+    assert_spf_parity(make_ls(edges), "a")
+
+
+def test_parity_partitioned_graph():
+    edges = [("a", "b", 1), ("x", "y", 1)]
+    assert_spf_parity(make_ls(edges), "a")
+
+
+def test_parity_random_wans():
+    for seed in range(6):
+        n = 24
+        edges = random_connected_edges(n, 30, seed=seed)
+        rng = np.random.default_rng(seed)
+        overloaded = [f"node{i}" for i in rng.choice(n, 3, replace=False)]
+        ls = make_ls(edges, overloaded=overloaded)
+        for root in ("node0", f"node{n - 1}"):
+            assert_spf_parity(ls, root)
+
+
+def test_batched_what_if_link_failures_match_scalar():
+    """Fail each ring link in its own snapshot; kernel batch must match a
+    scalar re-solve with that link removed."""
+    n = 6
+    edges = ring_edges(n)
+    ls = make_ls(edges)
+    topo = encode_link_state(ls)
+    D = max(topo.max_out_degree(), 1)
+    B = len(topo.links)
+    mask = link_failure_batch(topo, [[li] for li in range(B)])
+    dist, nh = batched_spf(
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.asarray(mask),
+        jnp.tile(jnp.asarray(topo.overloaded), (B, 1)),
+        jnp.zeros(B, jnp.int32),  # root node0 everywhere
+        D,
+    )
+    dist = np.asarray(dist)
+    for b, link in enumerate(topo.links):
+        # scalar: remove the failed link by running spf with links_to_ignore
+        res = ls.run_spf("node0", links_to_ignore=frozenset([link]))
+        for node, r in res.items():
+            assert dist[b, topo.node_id(node)] == pytest.approx(r.metric)
+        reached = {topo.node_id(x) for x in res}
+        for i in range(topo.num_nodes):
+            if i not in reached:
+                assert dist[b, i] >= BIG
+
+
+def select_parity_case(edges, advertisements, root, **ls_kwargs):
+    """advertisements: list of (node, prefix, metrics_kwargs)."""
+    ls = make_ls(edges, **ls_kwargs)
+    ps = PrefixState()
+    for node, prefix, mk in advertisements:
+        extra = {}
+        if "min_nexthop" in mk:
+            extra["min_nexthop"] = mk.pop("min_nexthop")
+        ps.update_prefix(
+            node, "0", PrefixEntry(prefix, metrics=PrefixMetrics(**mk), **extra)
+        )
+    solver = SpfSolver(root)
+    route_db = solver.build_route_db({"0": ls}, ps)
+
+    topo = encode_link_state(ls)
+    cands = encode_prefix_candidates(ps, topo, "0")
+    D = max(topo.max_out_degree(), 1)
+    valid, metric, nh_out, num_nh = spf_and_select(
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.ones((1, topo.padded_edges), bool),
+        jnp.asarray(topo.overloaded)[None],
+        jnp.asarray(topo.soft)[None],
+        jnp.asarray([topo.node_id(root)], jnp.int32),
+        jnp.asarray(cands.cand_node),
+        jnp.asarray(cands.cand_ok),
+        jnp.asarray(cands.drain_metric),
+        jnp.asarray(cands.path_pref),
+        jnp.asarray(cands.source_pref),
+        jnp.asarray(cands.distance),
+        jnp.asarray(cands.min_nexthop),
+        max_degree=D,
+    )
+    valid = np.asarray(valid)[0]
+    metric = np.asarray(metric)[0]
+    nh_out = np.asarray(nh_out)[0]
+
+    for p, prefix in enumerate(cands.prefixes):
+        scalar_route = route_db.unicast_routes.get(prefix) if route_db else None
+        if scalar_route is None:
+            assert not valid[p], f"{prefix}: kernel has route, scalar doesn't"
+            continue
+        assert valid[p], f"{prefix}: scalar has route, kernel doesn't"
+        assert metric[p] == pytest.approx(scalar_route.igp_cost), prefix
+        kernel_neighbors = decode_nh_neighbors(topo, root, nh_out[p])
+        scalar_neighbors = {
+            nh.neighbor_node_name for nh in scalar_route.nexthops
+        }
+        assert kernel_neighbors == scalar_neighbors, prefix
+
+
+def test_select_parity_basic_and_ecmp():
+    edges = [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+    select_parity_case(
+        edges,
+        [("d", "10.0.0.0/24", {}), ("b", "10.1.0.0/24", {})],
+        "a",
+    )
+
+
+def test_select_parity_preferences_and_self_skip():
+    edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1)]
+    select_parity_case(
+        edges,
+        [
+            ("b", "10.0.0.0/24", {"path_preference": 500}),
+            ("d", "10.0.0.0/24", {"path_preference": 1000}),
+            ("a", "10.3.0.0/24", {}),  # self-advertised -> no route
+            ("c", "10.4.0.0/24", {"min_nexthop": 2}),  # gate fails
+        ],
+        "a",
+    )
+
+
+def test_select_parity_drains():
+    edges = [("a", "b", 1), ("a", "c", 1), ("a", "d", 1)]
+    select_parity_case(
+        edges,
+        [
+            ("b", "10.0.0.0/24", {}),
+            ("c", "10.0.0.0/24", {}),
+            ("d", "10.0.0.0/24", {}),
+        ],
+        "a",
+        overloaded=["b"],
+        soft_drained={"c": 50},
+    )
+
+
+def test_select_parity_random():
+    rng = np.random.default_rng(42)
+    n = 16
+    edges = random_connected_edges(n, 16, seed=3)
+    ads = []
+    for p in range(12):
+        prefix = f"10.{p}.0.0/24"
+        for node in rng.choice(n, rng.integers(1, 4), replace=False):
+            ads.append(
+                (
+                    f"node{node}",
+                    prefix,
+                    {
+                        "path_preference": int(rng.choice([500, 1000])),
+                        "source_preference": int(rng.choice([100, 200])),
+                        "distance": int(rng.integers(0, 3)),
+                    },
+                )
+            )
+    select_parity_case(edges, ads, "node0")
+
+
+def test_sharded_kernel_on_virtual_mesh():
+    """The 8-device CPU mesh path: batch sharded across devices."""
+    from openr_tpu.parallel.mesh import make_mesh, shard_batch, sharded_spf_and_select
+
+    assert len(jax.devices()) == 8, jax.devices()
+    ls = make_ls(grid_edges(4))
+    ps = PrefixState()
+    ps.update_prefix("node15", "0", PrefixEntry("10.0.0.0/24"))
+    topo = encode_link_state(ls)
+    cands = encode_prefix_candidates(ps, topo, "0")
+    D = max(topo.max_out_degree(), 1)
+    mesh = make_mesh()
+    B = 16  # 2 per device
+    mask = np.ones((B, topo.padded_edges), bool)
+    # fail a different link in each snapshot (first 16 links)
+    for b in range(B):
+        mask[b, np.asarray(topo.link_index) == (b % len(topo.links))] = False
+    edge_en, ovl, soft, roots = shard_batch(
+        mesh,
+        mask,
+        np.tile(topo.overloaded, (B, 1)),
+        np.tile(topo.soft, (B, 1)),
+        np.zeros(B, np.int32),
+    )
+    kernel = sharded_spf_and_select(mesh, D)
+    valid, metric, nh, num = kernel(
+        topo.src,
+        topo.dst,
+        topo.w,
+        topo.edge_ok,
+        edge_en,
+        ovl,
+        soft,
+        roots,
+        cands.cand_node,
+        cands.cand_ok,
+        cands.drain_metric,
+        cands.path_pref,
+        cands.source_pref,
+        cands.distance,
+        cands.min_nexthop,
+    )
+    assert valid.shape == (B, 1)
+    assert bool(np.asarray(valid).all())  # grid survives any single failure
+    # output actually sharded over the mesh
+    assert len(valid.sharding.device_set) == 8
+    # spot-check one snapshot against scalar
+    li = 3
+    link = topo.links[li]
+    res = ls.run_spf("node0", links_to_ignore=frozenset([link]))
+    assert np.asarray(metric)[li, 0] == pytest.approx(res["node15"].metric)
+
+
+def test_select_parity_min_nexthop_on_farther_winner():
+    """min-nexthop must be the max over ALL selection winners, including
+    those losing the IGP tie (SpfSolver.cpp getMinNextHopThreshold)."""
+    edges = [("a", "b", 1), ("b", "c", 1)]
+    select_parity_case(
+        edges,
+        [
+            ("b", "10.0.0.0/24", {}),
+            ("c", "10.0.0.0/24", {"min_nexthop": 2}),  # farther winner gates
+        ],
+        "a",
+    )
