@@ -78,12 +78,11 @@ class EncodedTopology:
         corresponds to the r-th directed edge with src == root, in edge
         order.  Returns [(link, neighbor_node_name)] by lane."""
         rid = self.node_ids[root]
-        out = []
-        for e in range(self.padded_edges):
-            if self.src[e] == rid and self.link_index[e] >= 0:
-                link = self.links[self.link_index[e]]
-                out.append((link, self.id_to_node[self.dst[e]]))
-        return out
+        idx = np.nonzero((self.src == rid) & (self.link_index >= 0))[0]
+        return [
+            (self.links[self.link_index[e]], self.id_to_node[self.dst[e]])
+            for e in idx
+        ]
 
     def max_out_degree(self) -> int:
         valid = self.link_index >= 0
@@ -122,6 +121,15 @@ def encode_link_state(
     for li, link in enumerate(links):
         m = float(link.get_max_metric())
         ok = link.is_up()
+        if ok and m <= 0:
+            # The DAG-equality nexthop propagation assumes strictly positive
+            # metrics (a 0-cost edge would union lanes across equidistant
+            # nodes where heap Dijkstra keeps them distinct).  The reference
+            # never produces metric<=0 adjacencies; reject at the bridge.
+            raise ValueError(
+                f"non-positive metric {m} on {link}; device SPF requires "
+                "metrics >= 1"
+            )
         a, b = node_ids[link.n1], node_ids[link.n2]
         directed.append((a, b, m, ok, li))
         directed.append((b, a, m, ok, li))

@@ -159,6 +159,29 @@ def spf_one(
 
 
 @functools.partial(jax.jit, static_argnames=("max_degree",))
+def batched_spf_link_failures(
+    src,  # [E]
+    dst,  # [E]
+    w,  # [E]
+    edge_ok,  # [E]
+    link_index,  # [E] undirected link id per directed edge (-1 pad)
+    failed_link,  # [B] int32 failed undirected link id per snapshot (-1 none)
+    overloaded,  # [B, V]
+    roots,  # [B]
+    max_degree: int,
+):
+    """Single-link-failure what-if sweep with the perturbation expanded ON
+    DEVICE: the host ships one int32 per snapshot instead of a [B, E] mask,
+    eliminating the host→device bandwidth bottleneck on big sweeps."""
+
+    def one(fail, ovl, root):
+        enabled = link_index != fail
+        return spf_one(src, dst, w, edge_ok & enabled, ovl, root, max_degree)
+
+    return jax.vmap(one)(failed_link, overloaded, roots)
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
 def batched_spf(
     src,  # [E] shared edge list
     dst,  # [E]

@@ -35,10 +35,6 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def round_up(n: int, multiple: int) -> int:
-    return ((n + multiple - 1) // multiple) * multiple
-
-
 def shard_batch(mesh: Mesh, *arrays):
     """Place [B, ...] arrays with B sharded across the mesh.  B must be a
     multiple of the mesh size (pad snapshots with no-op perturbations)."""
@@ -53,8 +49,8 @@ def sharded_spf_and_select(mesh: Mesh, max_degree: int):
     replicated; per-snapshot inputs and all outputs are batch-sharded."""
     from openr_tpu.ops.route_select import spf_and_select
 
-    b = NamedSharding(mesh, P(BATCH_AXIS))
-    r = NamedSharding(mesh, P())
+    b = batch_sharding(mesh)
+    r = replicated(mesh)
     fn = functools.partial(spf_and_select, max_degree=max_degree)
     return jax.jit(
         fn,

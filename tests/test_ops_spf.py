@@ -338,3 +338,49 @@ def test_select_parity_min_nexthop_on_farther_winner():
         ],
         "a",
     )
+
+
+def test_batched_select_routes_on_precomputed_spf():
+    """Exercise the standalone selection kernel (select over already-solved
+    SPF state) and the zero-metric encode guard."""
+    edges = [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+    ls = make_ls(edges)
+    ps = PrefixState()
+    ps.update_prefix("d", "0", PrefixEntry("10.0.0.0/24"))
+    topo = encode_link_state(ls)
+    cands = encode_prefix_candidates(ps, topo, "0")
+    D = max(topo.max_out_degree(), 1)
+    B = 2
+    dist, nh = batched_spf(
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.ones((B, topo.padded_edges), bool),
+        jnp.tile(jnp.asarray(topo.overloaded), (B, 1)),
+        jnp.full(B, topo.node_id("a"), jnp.int32),
+        D,
+    )
+    valid, metric, nh_out, num = batched_select_routes(
+        jnp.asarray(cands.cand_node),
+        jnp.asarray(cands.cand_ok),
+        jnp.asarray(cands.drain_metric),
+        jnp.asarray(cands.path_pref),
+        jnp.asarray(cands.source_pref),
+        jnp.asarray(cands.distance),
+        jnp.asarray(cands.min_nexthop),
+        dist,
+        nh,
+        jnp.tile(jnp.asarray(topo.overloaded), (B, 1)),
+        jnp.tile(jnp.asarray(topo.soft), (B, 1)),
+        jnp.full(B, topo.node_id("a"), jnp.int32),
+    )
+    assert bool(np.asarray(valid).all())
+    assert np.asarray(metric)[0, 0] == 2.0
+    assert np.asarray(num)[0, 0] == 2  # ECMP over b and c
+
+
+def test_encode_rejects_zero_metric():
+    ls = make_ls([("a", "b", 0)])
+    with pytest.raises(ValueError, match="non-positive metric"):
+        encode_link_state(ls)
