@@ -1,0 +1,678 @@
+"""KvStore — per-area replicated, eventually-consistent key-value store.
+
+The LSDB replication layer (openr/kvstore/KvStore.h + KvStore-inl.h):
+  * conflict resolution via mergeKeyValues (openr_tpu.kvstore.merge)
+  * peer FSM IDLE → SYNCING → INITIALIZED with exponential backoff and
+    flap counting (KvStore.thrift:291-295, KvStore.h:455-473)
+  * 3-way anti-entropy full sync: hash dump → diff response →
+    finalizeFullSync push-back (KvStore-inl.h:2153, 2279, 2761)
+  * incremental flooding to INITIALIZED peers, excluding the sender, with
+    loop prevention via publication node_ids, TTL decrement, and a
+    token-bucket flood rate limit (KvStore-inl.h:2863-3150)
+  * per-key TTL countdown and expiry publication (KvStore.h:488-492)
+  * self-originated key persistence + TTL refresh + version guarding
+    (KvStore.h:196-215)
+  * initialKvStoreSynced signal once every peer of every area reaches
+    INITIALIZED (§3.3 of SURVEY)
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from openr_tpu import constants as C
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.common.utils import ExponentialBackoff
+from openr_tpu.config import KvStoreConfig
+from openr_tpu.kvstore.merge import dump_hashes, generate_hash, merge_key_values
+from openr_tpu.kvstore.transport import KvStoreTransport, KvStoreTransportError
+from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+from openr_tpu.types import (
+    InitializationEvent,
+    KeyValueRequest,
+    KvRequestType,
+    KvStoreAreaSummary,
+    KvStorePeerState,
+    PeerEvent,
+    PeerSpec,
+    Publication,
+    Value,
+)
+
+
+@dataclass
+class KvStorePeer:
+    """Peer session state (KvStore.h:330-473)."""
+
+    node_name: str
+    spec: PeerSpec
+    state: KvStorePeerState = KvStorePeerState.IDLE
+    backoff: ExponentialBackoff = None  # type: ignore[assignment]
+    flaps: int = 0
+    num_failures: int = 0
+    sync_task: Optional[asyncio.Task] = None
+
+
+@dataclass
+class SelfOriginatedValue:
+    """Locally-owned key we keep alive in the network (KvStore.h:196)."""
+
+    value: Value
+    keys_to_advertise: bool = True
+    ttl_refresh_task: Optional[asyncio.Task] = None
+
+
+class KvStoreDb:
+    """One area's store + peers (KvStoreDb, KvStore.h:36-560)."""
+
+    def __init__(
+        self,
+        actor: "KvStore",
+        area: str,
+        node_name: str,
+        config: KvStoreConfig,
+    ) -> None:
+        self.actor = actor
+        self.area = area
+        self.node_name = node_name
+        self.config = config
+        self.key_vals: Dict[str, Value] = {}
+        self.expiry: Dict[str, float] = {}  # key -> deadline (clock time)
+        self.peers: Dict[str, KvStorePeer] = {}
+        self.self_originated: Dict[str, SelfOriginatedValue] = {}
+        self.initial_synced = False
+        #: set once the first PeerEvent for this area arrives; gates the
+        #: KVSTORE_SYNCED signal so an empty store can't claim sync before
+        #: LinkMonitor has even told it who its peers are
+        self.peer_event_received = False
+
+    # -- counters ----------------------------------------------------------
+
+    def _bump(self, name: str, delta: float = 1) -> None:
+        self.actor.counters.bump(f"kvstore.{name}", delta)
+
+    # -- peer management (addThriftPeers/delThriftPeers) -------------------
+
+    def add_peers(self, peers: Dict[str, PeerSpec]) -> None:
+        for name, spec in peers.items():
+            existing = self.peers.get(name)
+            if existing is not None:
+                # peer re-add (e.g. graceful restart): reset to IDLE for
+                # a fresh full sync
+                existing.spec = spec
+                self._set_peer_state(existing, KvStorePeerState.IDLE)
+                existing.backoff.report_success()
+            else:
+                peer = KvStorePeer(
+                    node_name=name,
+                    spec=spec,
+                    backoff=ExponentialBackoff(
+                        C.KVSTORE_SYNC_INITIAL_BACKOFF_S,
+                        C.KVSTORE_SYNC_MAX_BACKOFF_S,
+                        self.actor.clock,
+                    ),
+                )
+                self.peers[name] = peer
+            self._schedule_peer_sync(self.peers[name])
+
+    def del_peers(self, names: List[str]) -> None:
+        for name in names:
+            peer = self.peers.pop(name, None)
+            if peer is not None and peer.sync_task is not None:
+                peer.sync_task.cancel()
+        self._maybe_signal_initial_synced()
+
+    def _set_peer_state(self, peer: KvStorePeer, state: KvStorePeerState) -> None:
+        if peer.state == state:
+            return
+        if peer.state == KvStorePeerState.INITIALIZED:
+            # leaving INITIALIZED == one flap (KvStore.thrift flaps field)
+            peer.flaps += 1
+        peer.state = state
+        peer.spec.state = state
+        self.actor.counters.set(
+            f"kvstore.{self.area}.peer.{peer.node_name}.state", int(state)
+        )
+
+    # -- full sync (requestThriftPeerSync, KvStore-inl.h:2153) -------------
+
+    def _schedule_peer_sync(self, peer: KvStorePeer) -> None:
+        if peer.sync_task is not None and not peer.sync_task.done():
+            peer.sync_task.cancel()
+        peer.sync_task = self.actor.spawn(
+            self._sync_peer(peer), name=f"kvstore.{self.area}.sync.{peer.node_name}"
+        )
+
+    async def _sync_peer(self, peer: KvStorePeer) -> None:
+        delay = peer.backoff.time_remaining_until_retry()
+        if delay > 0:
+            await self.actor.clock.sleep(delay)
+        # parallel-sync window: limit concurrent full syncs (2 → 32,
+        # KvStore.h:550, Constants.h:160)
+        while self.actor.num_active_syncs >= self.actor.parallel_sync_limit:
+            await self.actor.clock.sleep(0.05)
+        self._set_peer_state(peer, KvStorePeerState.SYNCING)
+        self.actor.num_active_syncs += 1
+        try:
+            hashes = dump_hashes(self.key_vals)
+            pub = await self.actor.transport.get_key_vals_filtered_area(
+                peer.node_name, self.area, hashes, self.node_name
+            )
+            self._bump("thrift.num_full_sync")
+            merged = self.merge_publication(pub, sender=peer.node_name)
+            # 3rd leg: push back keys the responder lacks/outdated
+            if pub.tobe_updated_keys:
+                back = {
+                    k: self._flood_copy(self.key_vals[k])
+                    for k in pub.tobe_updated_keys
+                    if k in self.key_vals
+                }
+                if back:
+                    await self.actor.transport.set_key_vals(
+                        peer.node_name,
+                        self.area,
+                        Publication(
+                            key_vals=back,
+                            area=self.area,
+                            node_ids=[self.node_name],
+                        ),
+                        self.node_name,
+                    )
+                    self._bump("thrift.num_finalized_sync")
+            peer.backoff.report_success()
+            self._set_peer_state(peer, KvStorePeerState.INITIALIZED)
+            # widen the parallel sync window on success (KvStore.h:550)
+            self.actor.parallel_sync_limit = min(
+                self.actor.parallel_sync_limit * 2, C.MAX_FULL_SYNC_PENDING_COUNT
+            )
+            self._maybe_signal_initial_synced()
+        except (KvStoreTransportError, asyncio.CancelledError) as e:
+            if isinstance(e, asyncio.CancelledError):
+                raise
+            peer.num_failures += 1
+            peer.backoff.report_error()
+            self._bump("thrift.num_full_sync_failure")
+            self._set_peer_state(peer, KvStorePeerState.IDLE)
+            self._schedule_peer_sync(peer)
+        finally:
+            self.actor.num_active_syncs -= 1
+
+    def _maybe_signal_initial_synced(self, grace_expired: bool = False) -> None:
+        """Signal only after LinkMonitor told us our peers (first PeerEvent)
+        — or after the link-discovery grace window for standalone stores
+        (Constants.h:27 kMaxDurationLinkDiscovery)."""
+        if self.initial_synced:
+            return
+        if not (self.peer_event_received or grace_expired):
+            return
+        if all(
+            p.state == KvStorePeerState.INITIALIZED for p in self.peers.values()
+        ):
+            self.initial_synced = True
+            self.actor.on_area_synced(self.area)
+
+    # -- responder side ----------------------------------------------------
+
+    def handle_full_sync_request(
+        self, key_val_hashes: Dict[str, Tuple[int, str, Optional[int]]], sender: str
+    ) -> Publication:
+        """Diff the initiator's digests against our store
+        (dumpDifference semantics): return values we have newer/missing,
+        and name keys where the initiator is ahead (tobeUpdatedKeys)."""
+        newer: Dict[str, Value] = {}
+        tobe_updated: List[str] = []
+        for key, value in self.key_vals.items():
+            theirs = key_val_hashes.get(key)
+            if theirs is None:
+                newer[key] = self._flood_copy(value)
+                continue
+            their_version, their_originator, their_hash = theirs
+            ours = (value.version, value.originator_id, value.hash)
+            if ours == (their_version, their_originator, their_hash):
+                continue
+            if (value.version, value.originator_id) >= (
+                their_version,
+                their_originator,
+            ):
+                newer[key] = self._flood_copy(value)
+            else:
+                tobe_updated.append(key)
+        for key in key_val_hashes:
+            if key not in self.key_vals:
+                tobe_updated.append(key)
+        return Publication(
+            key_vals=newer,
+            tobe_updated_keys=sorted(tobe_updated),
+            area=self.area,
+            node_ids=[self.node_name],
+        )
+
+    # -- merge + flood (KvStore-inl.h:2863-3150) ---------------------------
+
+    def _flood_copy(self, value: Value) -> Value:
+        """Copy with TTL decremented (Constants.h kTtlDecrement) so looping
+        values eventually die."""
+        ttl = value.ttl
+        if ttl != C.TTL_INFINITY:
+            ttl = ttl - C.TTL_DECREMENT_MS
+        return Value(
+            version=value.version,
+            originator_id=value.originator_id,
+            value=value.value,
+            ttl=ttl,
+            ttl_version=value.ttl_version,
+            hash=value.hash,
+        )
+
+    def merge_publication(
+        self, pub: Publication, sender: Optional[str] = None
+    ) -> Dict[str, Value]:
+        """Merge a peer publication; publishes + floods accepted updates.
+        Returns the accepted delta."""
+        # loop prevention (mergePublication: drop if our id already in path)
+        if pub.node_ids is not None and self.node_name in pub.node_ids:
+            self._bump("looped_publications")
+            return {}
+        result = merge_key_values(self.key_vals, pub.key_vals, sender=sender)
+        if result.inconsistency_detected_with_originator and sender in self.peers:
+            # force the peer back through full sync (peer → IDLE)
+            peer = self.peers[sender]
+            self._set_peer_state(peer, KvStorePeerState.IDLE)
+            self._schedule_peer_sync(peer)
+        self._refresh_expiries(result.key_vals)
+        self._guard_self_originated(result.key_vals)
+        if result.key_vals:
+            self._bump("received_key_vals", len(result.key_vals))
+            self.publish(
+                Publication(
+                    key_vals=dict(result.key_vals),
+                    area=self.area,
+                    node_ids=list(pub.node_ids or []),
+                ),
+                sender=sender,
+            )
+        return result.key_vals
+
+    def publish(self, pub: Publication, sender: Optional[str] = None) -> None:
+        """Push to local subscribers and flood to peers."""
+        self.actor.publications_queue.push(pub)
+        self._flood(pub, sender)
+
+    def _flood(self, pub: Publication, sender: Optional[str]) -> None:
+        node_ids = list(pub.node_ids or [])
+        if self.node_name not in node_ids:
+            node_ids.append(self.node_name)
+        flood_pub = Publication(
+            key_vals={k: self._flood_copy(v) for k, v in pub.key_vals.items()},
+            expired_keys=list(pub.expired_keys),
+            area=self.area,
+            node_ids=node_ids,
+        )
+        if not flood_pub.key_vals and not flood_pub.expired_keys:
+            return
+        for name, peer in self.peers.items():
+            if name == sender:
+                continue  # dedup: never reflect to the sender
+            if peer.state != KvStorePeerState.INITIALIZED:
+                continue
+            if name in (pub.node_ids or []):
+                continue  # path already visited this node
+            self.actor.spawn(
+                self._flood_to_peer(peer, flood_pub),
+                name=f"kvstore.{self.area}.flood.{name}",
+            )
+
+    async def _flood_to_peer(self, peer: KvStorePeer, pub: Publication) -> None:
+        # flood rate limit (config flood_rate, KvStore-inl.h rate limiter)
+        await self.actor.flood_limiter.acquire()
+        try:
+            await self.actor.transport.set_key_vals(
+                peer.node_name, self.area, pub, self.node_name
+            )
+            self._bump("thrift.num_flood_pub")
+        except KvStoreTransportError:
+            peer.num_failures += 1
+            self._bump("thrift.num_flood_key_vals_failure")
+            # flooding failures degrade the peer: force re-sync
+            self._set_peer_state(peer, KvStorePeerState.IDLE)
+            self._schedule_peer_sync(peer)
+
+    # -- TTL management (KvStore.h:488-492, -inl.h:2707) -------------------
+
+    def _refresh_expiries(self, key_vals: Dict[str, Value]) -> None:
+        now = self.actor.clock.now()
+        for key, value in key_vals.items():
+            if value.ttl == C.TTL_INFINITY:
+                self.expiry.pop(key, None)
+            else:
+                self.expiry[key] = now + value.ttl / 1000.0
+
+    def expire_keys(self) -> None:
+        """Drop keys whose TTL lapsed; publish expirations."""
+        now = self.actor.clock.now()
+        expired = [k for k, dl in self.expiry.items() if dl <= now]
+        if not expired:
+            return
+        for k in expired:
+            self.expiry.pop(k, None)
+            self.key_vals.pop(k, None)
+        self._bump("expired_keys", len(expired))
+        self.actor.publications_queue.push(
+            Publication(expired_keys=sorted(expired), area=self.area)
+        )
+
+    def next_expiry(self) -> Optional[float]:
+        return min(self.expiry.values()) if self.expiry else None
+
+    # -- self-originated keys (KvStore.h:196-215) --------------------------
+
+    def persist_self_originated_key(self, key: str, data: bytes) -> Value:
+        """Advertise and keep alive a locally-owned key; version guards
+        against overrides from the network."""
+        existing_store = self.key_vals.get(key)
+        existing_self = self.self_originated.get(key)
+        version = 1
+        if existing_self is not None:
+            if (
+                existing_self.value.value == data
+                and existing_store is not None
+                and existing_store.version == existing_self.value.version
+                and existing_store.originator_id == self.node_name
+            ):
+                # unchanged data still owned by us in the store: no-op
+                # (periodic re-persists must not churn versions network-wide)
+                return existing_self.value
+            version = existing_self.value.version + 1
+        elif existing_store is not None:
+            version = existing_store.version + 1
+        value = Value(
+            version=version,
+            originator_id=self.node_name,
+            value=data,
+            ttl=self.config.self_originated_key_ttl_ms,
+            ttl_version=0,
+        )
+        value.hash = generate_hash(value)
+        sov = SelfOriginatedValue(value=value)
+        old = self.self_originated.get(key)
+        if old is not None and old.ttl_refresh_task is not None:
+            old.ttl_refresh_task.cancel()
+        self.self_originated[key] = sov
+        sov.ttl_refresh_task = self.actor.spawn(
+            self._ttl_refresh_loop(key), name=f"kvstore.{self.area}.ttl.{key}"
+        )
+        self._apply_local(key, value)
+        return value
+
+    def set_self_originated_key(self, key: str, data: bytes, version: int) -> None:
+        """One-shot advertise (setKey): no persistence/refresh."""
+        if version == 0:
+            existing = self.key_vals.get(key)
+            version = (existing.version + 1) if existing is not None else 1
+        value = Value(
+            version=version,
+            originator_id=self.node_name,
+            value=data,
+            ttl=self.config.self_originated_key_ttl_ms,
+            ttl_version=0,
+        )
+        value.hash = generate_hash(value)
+        self._apply_local(key, value)
+
+    def erase_self_originated_key(self, key: str) -> None:
+        """Stop refreshing; the network expires the key naturally
+        (eraseKey semantics)."""
+        sov = self.self_originated.pop(key, None)
+        if sov is not None and sov.ttl_refresh_task is not None:
+            sov.ttl_refresh_task.cancel()
+
+    def _apply_local(self, key: str, value: Value) -> None:
+        merged = merge_key_values(self.key_vals, {key: value})
+        self._refresh_expiries(merged.key_vals)
+        if merged.key_vals:
+            self.publish(
+                Publication(
+                    key_vals=dict(merged.key_vals),
+                    area=self.area,
+                    node_ids=[],
+                )
+            )
+
+    def _guard_self_originated(self, accepted: Dict[str, Value]) -> None:
+        """If the network overrode one of our self-originated keys, bump our
+        version above the interloper and re-advertise."""
+        for key, value in accepted.items():
+            sov = self.self_originated.get(key)
+            if sov is None:
+                continue
+            if value.originator_id != self.node_name:
+                new_value = Value(
+                    version=value.version + 1,
+                    originator_id=self.node_name,
+                    value=sov.value.value,
+                    ttl=sov.value.ttl,
+                    ttl_version=0,
+                )
+                new_value.hash = generate_hash(new_value)
+                sov.value = new_value
+                self._apply_local(key, new_value)
+                self._bump("self_originated_key_guard")
+
+    async def _ttl_refresh_loop(self, key: str) -> None:
+        """Bump ttlVersion at 1/4 of the TTL interval
+        (advertiseTtlUpdates)."""
+        interval = max(self.config.self_originated_key_ttl_ms / 4000.0, 0.05)
+        while True:
+            await self.actor.clock.sleep(interval)
+            sov = self.self_originated.get(key)
+            if sov is None:
+                return
+            sov.value.ttl_version += 1
+            ttl_update = Value(
+                version=sov.value.version,
+                originator_id=self.node_name,
+                value=None,  # ttl-only update
+                ttl=sov.value.ttl,
+                ttl_version=sov.value.ttl_version,
+            )
+            merged = merge_key_values(self.key_vals, {key: ttl_update})
+            self._refresh_expiries(merged.key_vals)
+            if merged.key_vals:
+                self.publish(
+                    Publication(
+                        key_vals=dict(merged.key_vals),
+                        area=self.area,
+                        node_ids=[],
+                    )
+                )
+
+    # -- dumps -------------------------------------------------------------
+
+    def get_key_vals(self, keys: List[str]) -> Dict[str, Value]:
+        return {k: self.key_vals[k] for k in keys if k in self.key_vals}
+
+    def dump_all(self, prefix: str = "") -> Dict[str, Value]:
+        return {
+            k: v for k, v in self.key_vals.items() if k.startswith(prefix)
+        }
+
+    def summary(self) -> KvStoreAreaSummary:
+        return KvStoreAreaSummary(
+            area=self.area,
+            peers_map={n: p.spec for n, p in self.peers.items()},
+            key_vals_count=len(self.key_vals),
+            key_vals_bytes=sum(
+                len(v.value or b"") for v in self.key_vals.values()
+            ),
+        )
+
+
+class _RateLimiter:
+    """Token bucket on the shared clock; no-op when rate == 0."""
+
+    def __init__(self, clock: Clock, rate: float, burst: int) -> None:
+        self.clock = clock
+        self.rate = rate
+        self.burst = max(burst, 1)
+        self.tokens = float(self.burst)
+        self.last = clock.now()
+
+    async def acquire(self) -> None:
+        if self.rate <= 0:
+            return
+        while True:
+            now = self.clock.now()
+            self.tokens = min(
+                self.burst, self.tokens + (now - self.last) * self.rate
+            )
+            self.last = now
+            if self.tokens >= 1:
+                self.tokens -= 1
+                return
+            await self.clock.sleep((1 - self.tokens) / self.rate)
+
+
+class KvStore(Actor):
+    """The KvStore module: areas, queue plumbing, RPC dispatch
+    (openr/kvstore/KvStore.h:575-835)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config: KvStoreConfig,
+        areas: List[str],
+        transport: KvStoreTransport,
+        publications_queue: ReplicateQueue,
+        peer_updates_reader: Optional[RQueue] = None,
+        kv_request_reader: Optional[RQueue] = None,
+        initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
+        counters: Optional[CounterMap] = None,
+    ) -> None:
+        super().__init__("kvstore", clock, counters)
+        self.node_name = node_name
+        self.config = config
+        self.transport = transport
+        self.publications_queue = publications_queue
+        self.peer_updates_reader = peer_updates_reader
+        self.kv_request_reader = kv_request_reader
+        self.initialization_cb = initialization_cb
+        self.num_active_syncs = 0
+        self.parallel_sync_limit = C.PARALLEL_SYNC_LIMIT_INITIAL
+        self.flood_limiter = _RateLimiter(
+            clock, config.flood_rate_msgs_per_sec, config.flood_rate_burst_size
+        )
+        self.areas: Dict[str, KvStoreDb] = {
+            a: KvStoreDb(self, a, node_name, config) for a in areas
+        }
+        self._synced_areas: Set[str] = set()
+        self._initial_sync_signaled = False
+
+    # -- module lifecycle --------------------------------------------------
+
+    def start(self) -> None:
+        if self.peer_updates_reader is not None:
+            self.spawn_queue_loop(
+                self.peer_updates_reader, self._on_peer_event, "kvstore.peers"
+            )
+        if self.kv_request_reader is not None:
+            self.spawn_queue_loop(
+                self.kv_request_reader, self._on_kv_request, "kvstore.requests"
+            )
+        self.spawn(self._ttl_expiry_loop(), name="kvstore.ttl")
+        # standalone/leaf fallback: if no peer event ever arrives, declare
+        # sync after the link-discovery bound rather than hanging forever
+        self.schedule(C.MAX_DURATION_LINK_DISCOVERY_S, self._grace_sync_check)
+
+    def _grace_sync_check(self) -> None:
+        for db in self.areas.values():
+            db._maybe_signal_initial_synced(grace_expired=True)
+
+    async def _ttl_expiry_loop(self) -> None:
+        while True:
+            deadlines = [
+                db.next_expiry() for db in self.areas.values() if db.next_expiry()
+            ]
+            now = self.clock.now()
+            sleep_for = min(
+                [max(dl - now, 0.0) for dl in deadlines], default=0.5
+            )
+            await self.clock.sleep(min(sleep_for, 0.5))
+            for db in self.areas.values():
+                db.expire_keys()
+
+    # -- queue handlers ----------------------------------------------------
+
+    def _on_peer_event(self, event: PeerEvent) -> None:
+        db = self.areas.get(event.area)
+        if db is None:
+            return
+        db.peer_event_received = True
+        if event.peers_to_add:
+            db.add_peers(event.peers_to_add)
+        if event.peers_to_del:
+            db.del_peers(event.peers_to_del)
+        db._maybe_signal_initial_synced()
+
+    def _on_kv_request(self, req: KeyValueRequest) -> None:
+        db = self.areas.get(req.area)
+        if db is None:
+            return
+        if req.request_type == KvRequestType.PERSIST_KEY:
+            db.persist_self_originated_key(req.key, req.value)
+        elif req.request_type == KvRequestType.SET_KEY:
+            db.set_self_originated_key(req.key, req.value, req.version or 0)
+        elif req.request_type == KvRequestType.CLEAR_KEY:
+            db.erase_self_originated_key(req.key)
+
+    # -- transport-facing handlers (responder side) ------------------------
+
+    async def handle_full_sync_request(
+        self, area: str, key_val_hashes, sender: str
+    ) -> Publication:
+        db = self.areas.get(area)
+        if db is None:
+            raise KvStoreTransportError(f"unknown area {area}")
+        return db.handle_full_sync_request(key_val_hashes, sender)
+
+    async def handle_set_key_vals(
+        self, area: str, publication: Publication, sender: str
+    ) -> None:
+        db = self.areas.get(area)
+        if db is None:
+            raise KvStoreTransportError(f"unknown area {area}")
+        db.merge_publication(publication, sender=sender)
+
+    # -- public API (ctrl surface) -----------------------------------------
+
+    def set_key_vals(self, area: str, key_vals: Dict[str, Value]) -> None:
+        """API ingress (thrift setKvStoreKeyVals): merge + flood."""
+        db = self.areas[area]
+        db.merge_publication(Publication(key_vals=key_vals, area=area))
+
+    def get_key_vals(self, area: str, keys: List[str]) -> Dict[str, Value]:
+        return self.areas[area].get_key_vals(keys)
+
+    def dump_all(self, area: str, prefix: str = "") -> Dict[str, Value]:
+        return self.areas[area].dump_all(prefix)
+
+    def summaries(self) -> Dict[str, KvStoreAreaSummary]:
+        return {a: db.summary() for a, db in self.areas.items()}
+
+    def peer_state(self, area: str, peer: str) -> Optional[KvStorePeerState]:
+        p = self.areas[area].peers.get(peer)
+        return p.state if p is not None else None
+
+    # -- initialization sequencing ----------------------------------------
+
+    def on_area_synced(self, area: str) -> None:
+        self._synced_areas.add(area)
+        if self._initial_sync_signaled:
+            return
+        if self._synced_areas >= set(self.areas):
+            self._initial_sync_signaled = True
+            self.counters.bump("kvstore.initial_sync_complete")
+            if self.initialization_cb is not None:
+                self.initialization_cb(InitializationEvent.KVSTORE_SYNCED)

@@ -1,0 +1,207 @@
+"""mergeKeyValues — the eventual-consistency conflict-resolution core.
+
+Faithful port of openr/kvstore/KvStoreUtil.cpp:253-520 (getMergeType,
+mergeKeyValues, compareValues).  This is the second hot path after SPF
+(SURVEY §3.2) and is deliberately dependency-free so the C++ native
+implementation (openr_tpu/native) can mirror it 1:1.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional, Tuple
+
+from openr_tpu import constants as C
+from openr_tpu.types import KvStoreNoMergeReason, Value
+
+
+def generate_hash(value: Value) -> int:
+    """Stable 63-bit digest of (version, originatorId, value)
+    (reference generateHash in LsdbUtil)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(str(value.version).encode())
+    h.update(b"|")
+    h.update(value.originator_id.encode())
+    h.update(b"|")
+    if value.value is not None:
+        h.update(value.value)
+    return int.from_bytes(h.digest(), "big") & 0x7FFF_FFFF_FFFF_FFFF
+
+
+def is_valid_ttl(ttl: int) -> bool:
+    return ttl == C.TTL_INFINITY or ttl > 0
+
+
+def is_ttl_update(value: Value) -> bool:
+    """A value-less update only refreshes the TTL
+    (KvStoreUtil.cpp:104-106)."""
+    return value.value is None
+
+
+class ComparisonResult(enum.IntEnum):
+    TIED = 0
+    FIRST = 1
+    SECOND = 2
+    UNKNOWN = 3
+
+
+def compare_values(v1: Value, v2: Value) -> ComparisonResult:
+    """Which value wins? (KvStoreUtil.cpp:470-520)."""
+    if v1.version != v2.version:
+        return (
+            ComparisonResult.FIRST
+            if v1.version > v2.version
+            else ComparisonResult.SECOND
+        )
+    if v1.originator_id != v2.originator_id:
+        return (
+            ComparisonResult.FIRST
+            if v1.originator_id > v2.originator_id
+            else ComparisonResult.SECOND
+        )
+    if v1.hash is not None and v2.hash is not None and v1.hash == v2.hash:
+        if v1.ttl_version != v2.ttl_version:
+            return (
+                ComparisonResult.FIRST
+                if v1.ttl_version > v2.ttl_version
+                else ComparisonResult.SECOND
+            )
+        return ComparisonResult.TIED
+    if v1.value is not None and v2.value is not None:
+        if v1.value > v2.value:
+            return ComparisonResult.FIRST
+        if v1.value < v2.value:
+            return ComparisonResult.SECOND
+        return ComparisonResult.TIED
+    return ComparisonResult.UNKNOWN
+
+
+class MergeType(enum.IntEnum):
+    NO_UPDATE_NEEDED = 0
+    UPDATE_ALL_NEEDED = 1
+    UPDATE_TTL_NEEDED = 2
+    RESYNC_NEEDED = 3
+
+
+def _get_merge_type(
+    key: str,
+    value: Value,
+    store: Dict[str, Value],
+    sender: Optional[str],
+) -> Tuple[MergeType, Optional[KvStoreNoMergeReason]]:
+    """KvStoreUtil.cpp:253-378."""
+    existing = store.get(key)
+    my_version = existing.version if existing is not None else C.UNDEFINED_VERSION
+
+    if is_ttl_update(value):
+        # inconsistency: ttl update for a key we don't have, or with a
+        # different (version, originator) (isResyncNeeded,
+        # KvStoreUtil.cpp:133-200).  Triggers resync only when the sender IS
+        # the originator.
+        inconsistent = (
+            existing is None
+            or value.version != existing.version
+            or value.originator_id != existing.originator_id
+        )
+        if inconsistent:
+            if (sender or "") == value.originator_id:
+                return MergeType.RESYNC_NEEDED, (
+                    KvStoreNoMergeReason.INCONSISTENCY_DETECTED
+                )
+            return MergeType.NO_UPDATE_NEEDED, KvStoreNoMergeReason.NO_MATCHED_KEY
+        if value.ttl_version > existing.ttl_version:
+            return MergeType.UPDATE_TTL_NEEDED, None
+        return MergeType.NO_UPDATE_NEEDED, KvStoreNoMergeReason.NO_NEED_TO_UPDATE
+
+    # value-carrying update
+    if not (value.version > 0 and value.version >= my_version):
+        return MergeType.NO_UPDATE_NEEDED, KvStoreNoMergeReason.OLD_VERSION
+    if value.version > my_version:
+        return MergeType.UPDATE_ALL_NEEDED, None
+    assert existing is not None
+    if value.originator_id > existing.originator_id:
+        return MergeType.UPDATE_ALL_NEEDED, None
+    if value.originator_id == existing.originator_id:
+        # same version + originator: larger value wins; equal value falls
+        # through to ttlVersion
+        assert existing.value is not None, "stored value must carry data"
+        if value.value > existing.value:
+            return MergeType.UPDATE_ALL_NEEDED, None
+        if value.value == existing.value:
+            if value.ttl_version > existing.ttl_version:
+                return MergeType.UPDATE_TTL_NEEDED, None
+            return (
+                MergeType.NO_UPDATE_NEEDED,
+                KvStoreNoMergeReason.NO_NEED_TO_UPDATE,
+            )
+    return MergeType.NO_UPDATE_NEEDED, KvStoreNoMergeReason.NO_NEED_TO_UPDATE
+
+
+@dataclass
+class MergeResult:
+    """KvStoreMergeResult (KvStore.thrift:195-199)."""
+
+    key_vals: Dict[str, Value] = field(default_factory=dict)  # to flood
+    no_merge_reasons: Dict[str, KvStoreNoMergeReason] = field(default_factory=dict)
+    inconsistency_detected_with_originator: bool = False
+
+
+def merge_key_values(
+    store: Dict[str, Value],
+    key_vals: Dict[str, Value],
+    sender: Optional[str] = None,
+    key_filter=None,
+) -> MergeResult:
+    """Merge incoming key-vals into `store` in place; returns the accepted
+    delta (to announce/flood) and per-key rejection reasons
+    (KvStoreUtil.cpp:391-466)."""
+    result = MergeResult()
+    for key, value in key_vals.items():
+        if key_filter is not None and not key_filter(key, value):
+            result.no_merge_reasons[key] = KvStoreNoMergeReason.NO_MATCHED_KEY
+            continue
+        if not is_valid_ttl(value.ttl):
+            result.no_merge_reasons[key] = KvStoreNoMergeReason.INVALID_TTL
+            continue
+        merge_type, reason = _get_merge_type(key, value, store, sender)
+        if merge_type == MergeType.RESYNC_NEEDED:
+            result.inconsistency_detected_with_originator = True
+            result.no_merge_reasons[key] = (
+                KvStoreNoMergeReason.INCONSISTENCY_DETECTED
+            )
+            continue
+        if merge_type == MergeType.NO_UPDATE_NEEDED:
+            if reason is not None:
+                result.no_merge_reasons[key] = reason
+            continue
+        if merge_type == MergeType.UPDATE_ALL_NEEDED:
+            stored = Value(
+                version=value.version,
+                originator_id=value.originator_id,
+                value=value.value,
+                ttl=value.ttl,
+                ttl_version=value.ttl_version,
+                hash=value.hash if value.hash is not None else generate_hash(value),
+            )
+            store[key] = stored
+        else:  # UPDATE_TTL_NEEDED
+            existing = store[key]
+            existing.ttl = value.ttl
+            existing.ttl_version = value.ttl_version
+        result.key_vals[key] = value
+    return result
+
+
+def dump_hashes(
+    store: Dict[str, Value], keys: Optional[Iterable[str]] = None
+) -> Dict[str, Tuple[int, str, Optional[int]]]:
+    """(version, originatorId, hash) digests for full-sync
+    (dumpHashWithFilters)."""
+    src = keys if keys is not None else store.keys()
+    return {
+        k: (store[k].version, store[k].originator_id, store[k].hash)
+        for k in src
+        if k in store
+    }

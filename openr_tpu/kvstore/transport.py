@@ -1,0 +1,96 @@
+"""KvStore peer transport — the RPC plane between stores.
+
+The reference talks fbthrift over TCP (KvStore.h:460-466 templated client).
+Here the peer API is an abstract transport so the same KvStore runs over:
+  * `InProcessTransport` — N stores in one process with simulated latency
+    and failure injection (the KvStoreTestFixture/OpenrWrapper pattern,
+    multi-store tests in kvstore/tests/KvStoreTest.cpp run real thrift in
+    one process; ours runs in virtual time)
+  * a real socket transport (openr_tpu.ctrl) for multi-host deployment
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from openr_tpu.common.runtime import Clock
+from openr_tpu.types import Publication
+
+
+class KvStoreTransportError(RuntimeError):
+    pass
+
+
+class KvStoreTransport:
+    """Async peer API (mirrors the thrift KvStore service surface)."""
+
+    async def get_key_vals_filtered_area(
+        self,
+        peer_node: str,
+        area: str,
+        key_val_hashes: Dict[str, Tuple[int, str, Optional[int]]],
+        sender_id: str,
+    ) -> Publication:
+        """Full-sync request: send (version, originatorId, hash) digests;
+        responder returns newer values + tobe_updated_keys."""
+        raise NotImplementedError
+
+    async def set_key_vals(
+        self, peer_node: str, area: str, publication: Publication, sender_id: str
+    ) -> None:
+        """Flood/finalize: push key-vals into the peer's store."""
+        raise NotImplementedError
+
+
+class InProcessTransport(KvStoreTransport):
+    """Registry-based transport for in-process multi-store emulation.
+
+    Latency is served from the shared clock (virtual in tests).  Failure
+    injection mirrors `semifuture_injectThriftFailure` (KvStore.h:92):
+    `fail(a, b)` makes calls a→b raise until `heal(a, b)`.
+    """
+
+    def __init__(self, clock: Clock, latency_s: float = 0.0) -> None:
+        self.clock = clock
+        self.latency_s = latency_s
+        self._stores: Dict[str, object] = {}  # node -> KvStore actor
+        self._failed: Set[Tuple[str, str]] = set()
+        self.num_calls = 0
+
+    def register(self, node: str, store) -> None:
+        self._stores[node] = store
+
+    def unregister(self, node: str) -> None:
+        self._stores.pop(node, None)
+
+    def fail(self, src: str, dst: str) -> None:
+        self._failed.add((src, dst))
+
+    def heal(self, src: str, dst: str) -> None:
+        self._failed.discard((src, dst))
+
+    async def _call(self, src: str, dst: str, fn: Callable):
+        self.num_calls += 1
+        if self.latency_s:
+            await self.clock.sleep(self.latency_s)
+        if (src, dst) in self._failed or dst not in self._stores:
+            raise KvStoreTransportError(f"{src} -> {dst} unreachable")
+        return await fn(self._stores[dst])
+
+    async def get_key_vals_filtered_area(
+        self, peer_node, area, key_val_hashes, sender_id
+    ) -> Publication:
+        return await self._call(
+            sender_id,
+            peer_node,
+            lambda store: store.handle_full_sync_request(
+                area, key_val_hashes, sender_id
+            ),
+        )
+
+    async def set_key_vals(self, peer_node, area, publication, sender_id) -> None:
+        return await self._call(
+            sender_id,
+            peer_node,
+            lambda store: store.handle_set_key_vals(area, publication, sender_id),
+        )

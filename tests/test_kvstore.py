@@ -1,0 +1,358 @@
+"""Multi-store KvStore integration tests in virtual time — the
+KvStoreTest.cpp pattern (several real stores, real sync/flood over an
+in-process transport) without wall-clock flakiness."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu import constants as C
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import KvStoreConfig
+from openr_tpu.kvstore.kv_store import KvStore
+from openr_tpu.kvstore.merge import generate_hash
+from openr_tpu.kvstore.transport import InProcessTransport
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import (
+    InitializationEvent,
+    KeyValueRequest,
+    KvRequestType,
+    KvStorePeerState,
+    PeerEvent,
+    PeerSpec,
+    Publication,
+    Value,
+)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class Net:
+    """N KvStores over one InProcessTransport."""
+
+    def __init__(self, names, clock, latency=0.001, config=None):
+        self.clock = clock
+        self.transport = InProcessTransport(clock, latency_s=latency)
+        self.stores = {}
+        self.pubs = {}
+        self.peer_qs = {}
+        self.kv_qs = {}
+        self.init_events = {n: [] for n in names}
+        for n in names:
+            pub_q = ReplicateQueue(f"{n}.kvStoreUpdates")
+            peer_q = ReplicateQueue(f"{n}.peerUpdates")
+            kv_q = ReplicateQueue(f"{n}.kvRequests")
+            store = KvStore(
+                node_name=n,
+                clock=clock,
+                config=config or KvStoreConfig(),
+                areas=["0"],
+                transport=self.transport,
+                publications_queue=pub_q,
+                peer_updates_reader=peer_q.get_reader(),
+                kv_request_reader=kv_q.get_reader(),
+                initialization_cb=lambda ev, n=n: self.init_events[n].append(ev),
+            )
+            self.transport.register(n, store)
+            self.stores[n] = store
+            self.pubs[n] = pub_q
+            self.peer_qs[n] = peer_q
+            self.kv_qs[n] = kv_q
+            store.start()
+
+    def peer(self, a, b, bidir=True):
+        """Declare b as a's peer (and vice versa)."""
+        self.peer_qs[a].push(
+            PeerEvent(area="0", peers_to_add={b: PeerSpec(peer_addr=b)})
+        )
+        if bidir:
+            self.peer_qs[b].push(
+                PeerEvent(area="0", peers_to_add={a: PeerSpec(peer_addr=a)})
+            )
+
+    async def stop(self):
+        for s in self.stores.values():
+            await s.stop()
+
+
+def mkval(version=1, originator="x", data=b"d", ttl=300000):
+    val = Value(version=version, originator_id=originator, value=data, ttl=ttl)
+    val.hash = generate_hash(val)
+    return val
+
+
+def test_full_sync_three_way():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b"], clock)
+        # a knows k1 (newer), k2; b knows k1 (older) and k3 (which a lacks)
+        net.stores["a"].set_key_vals("0", {"k1": mkval(2, data=b"new")})
+        net.stores["a"].set_key_vals("0", {"k2": mkval(1)})
+        net.stores["b"].set_key_vals("0", {"k1": mkval(1, data=b"old")})
+        net.stores["b"].set_key_vals("0", {"k3": mkval(1)})
+        net.peer("a", "b")
+        await clock.run_for(10.0)
+        for n in ("a", "b"):
+            kv = net.stores[n].dump_all("0")
+            assert set(kv) == {"k1", "k2", "k3"}, n
+            assert kv["k1"].value == b"new", n
+        assert net.stores["a"].peer_state("0", "b") == KvStorePeerState.INITIALIZED
+        assert net.stores["b"].peer_state("0", "a") == KvStorePeerState.INITIALIZED
+        await net.stop()
+
+    run(main())
+
+
+def test_flood_through_line_topology():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b", "c"], clock)
+        net.peer("a", "b")
+        net.peer("b", "c")
+        await clock.run_for(10.0)
+        calls_before = net.transport.num_calls
+        net.stores["a"].set_key_vals("0", {"route": mkval(1, "a", b"payload")})
+        await clock.run_for(5.0)
+        assert net.stores["c"].dump_all("0")["route"].value == b"payload"
+        # ttl decremented along the flood path (a->b->c: 2 hops)
+        assert net.stores["c"].dump_all("0")["route"].ttl == 300000 - 2
+        # no flood storm: bounded number of messages for one update
+        assert net.transport.num_calls - calls_before <= 6
+        await net.stop()
+
+    run(main())
+
+
+def test_flood_loop_prevention_in_cycle():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b", "c"], clock)
+        net.peer("a", "b")
+        net.peer("b", "c")
+        net.peer("c", "a")
+        await clock.run_for(10.0)
+        calls_before = net.transport.num_calls
+        net.stores["a"].set_key_vals("0", {"k": mkval(1, "a")})
+        await clock.run_for(5.0)
+        for n in ("a", "b", "c"):
+            assert "k" in net.stores[n].dump_all("0")
+        # cycle must not echo forever
+        assert net.transport.num_calls - calls_before <= 10
+        await net.stop()
+
+    run(main())
+
+
+def test_publication_pushed_to_local_subscribers():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b"], clock)
+        reader = net.pubs["b"].get_reader()
+        net.peer("a", "b")
+        await clock.run_for(10.0)
+        net.stores["a"].set_key_vals("0", {"adj:a": mkval(1, "a")})
+        await clock.run_for(5.0)
+        pubs = []
+        while (p := reader.try_get()) is not None:
+            pubs.append(p)
+        assert any("adj:a" in p.key_vals for p in pubs)
+        await net.stop()
+
+    run(main())
+
+
+def test_ttl_expiry_publishes_expired_keys():
+    async def main():
+        clock = SimClock()
+        net = Net(["a"], clock)
+        reader = net.pubs["a"].get_reader()
+        net.stores["a"].set_key_vals("0", {"ephemeral": mkval(1, ttl=2000)})
+        await clock.run_for(1.0)
+        assert "ephemeral" in net.stores["a"].dump_all("0")
+        await clock.run_for(3.0)
+        assert "ephemeral" not in net.stores["a"].dump_all("0")
+        expired = []
+        while (p := reader.try_get()) is not None:
+            expired.extend(p.expired_keys)
+        assert "ephemeral" in expired
+        await net.stop()
+
+    run(main())
+
+
+def test_self_originated_persist_and_ttl_refresh():
+    async def main():
+        clock = SimClock()
+        cfg = KvStoreConfig(self_originated_key_ttl_ms=4000)
+        net = Net(["a", "b"], clock, config=cfg)
+        net.peer("a", "b")
+        await clock.run_for(10.0)
+        net.kv_qs["a"].push(
+            KeyValueRequest(KvRequestType.PERSIST_KEY, "0", "adj:a", b"mydata")
+        )
+        await clock.run_for(2.0)
+        assert net.stores["b"].dump_all("0")["adj:a"].value == b"mydata"
+        # survive well past the 4s ttl thanks to refreshes
+        await clock.run_for(20.0)
+        assert "adj:a" in net.stores["a"].dump_all("0")
+        assert "adj:a" in net.stores["b"].dump_all("0")
+        assert net.stores["b"].dump_all("0")["adj:a"].ttl_version > 0
+        # erase: stops refreshing, expires everywhere
+        net.kv_qs["a"].push(
+            KeyValueRequest(KvRequestType.CLEAR_KEY, "0", "adj:a")
+        )
+        await clock.run_for(10.0)
+        assert "adj:a" not in net.stores["a"].dump_all("0")
+        assert "adj:a" not in net.stores["b"].dump_all("0")
+        await net.stop()
+
+    run(main())
+
+
+def test_self_originated_key_guard_against_override():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b"], clock)
+        net.peer("a", "b")
+        await clock.run_for(10.0)
+        net.kv_qs["a"].push(
+            KeyValueRequest(KvRequestType.PERSIST_KEY, "0", "adj:a", b"mine")
+        )
+        await clock.run_for(2.0)
+        v1 = net.stores["a"].dump_all("0")["adj:a"].version
+        # intruder advertises the same key with a higher version
+        net.stores["b"].set_key_vals(
+            "0", {"adj:a": mkval(v1 + 3, "zzz-intruder", b"stolen")}
+        )
+        await clock.run_for(5.0)
+        for n in ("a", "b"):
+            kv = net.stores[n].dump_all("0")["adj:a"]
+            assert kv.originator_id == "a", n
+            assert kv.value == b"mine", n
+            assert kv.version > v1 + 3, n
+        await net.stop()
+
+    run(main())
+
+
+def test_peer_failure_backoff_and_recovery():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b"], clock)
+        net.transport.fail("a", "b")
+        net.peer("a", "b", bidir=False)
+        await clock.run_for(2.0)
+        assert net.stores["a"].peer_state("0", "b") == KvStorePeerState.IDLE
+        failures_early = net.stores["a"].areas["0"].peers["b"].num_failures
+        assert failures_early >= 1
+        # stays failing with exponential backoff (not hot-looping)
+        await clock.run_for(60.0)
+        failures_late = net.stores["a"].areas["0"].peers["b"].num_failures
+        assert failures_late < 12  # 4s initial backoff doubling
+        net.transport.heal("a", "b")
+        await clock.run_for(300.0)  # max backoff is 256s
+        assert net.stores["a"].peer_state("0", "b") == KvStorePeerState.INITIALIZED
+        await net.stop()
+
+    run(main())
+
+
+def test_kvstore_synced_initialization_event():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b", "c"], clock)
+        net.peer("a", "b")
+        net.peer("a", "c")
+        await clock.run_for(15.0)
+        assert InitializationEvent.KVSTORE_SYNCED in net.init_events["a"]
+        assert net.init_events["a"].count(InitializationEvent.KVSTORE_SYNCED) == 1
+        await net.stop()
+
+    run(main())
+
+
+def test_no_peer_store_synced_after_grace():
+    async def main():
+        clock = SimClock()
+        net = Net(["lonely"], clock)
+        await clock.run_for(1.0)
+        # must NOT claim sync before the link-discovery grace window
+        assert InitializationEvent.KVSTORE_SYNCED not in net.init_events["lonely"]
+        await clock.run_for(10.0)
+        assert InitializationEvent.KVSTORE_SYNCED in net.init_events["lonely"]
+        await net.stop()
+
+    run(main())
+
+
+def test_area_isolation():
+    async def main():
+        clock = SimClock()
+        transport = InProcessTransport(clock)
+        pub_q = ReplicateQueue("pub")
+        store = KvStore(
+            node_name="a",
+            clock=clock,
+            config=KvStoreConfig(),
+            areas=["area1", "area2"],
+            transport=transport,
+            publications_queue=pub_q,
+        )
+        transport.register("a", store)
+        store.start()
+        store.set_key_vals("area1", {"k": mkval()})
+        await clock.run_for(1.0)
+        assert "k" in store.dump_all("area1")
+        assert "k" not in store.dump_all("area2")
+        summaries = store.summaries()
+        assert summaries["area1"].key_vals_count == 1
+        assert summaries["area2"].key_vals_count == 0
+        await store.stop()
+
+    run(main())
+
+
+def test_repersist_identical_data_is_noop():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b"], clock)
+        net.peer("a", "b")
+        await clock.run_for(10.0)
+        for _ in range(3):
+            net.kv_qs["a"].push(
+                KeyValueRequest(KvRequestType.PERSIST_KEY, "0", "adj:a", b"same")
+            )
+            await clock.run_for(1.0)
+        assert net.stores["a"].dump_all("0")["adj:a"].version == 1
+        assert net.stores["b"].dump_all("0")["adj:a"].version == 1
+        # changed data DOES bump
+        net.kv_qs["a"].push(
+            KeyValueRequest(KvRequestType.PERSIST_KEY, "0", "adj:a", b"new")
+        )
+        await clock.run_for(1.0)
+        assert net.stores["a"].dump_all("0")["adj:a"].version == 2
+        await net.stop()
+
+    run(main())
+
+
+def test_flap_counter_counts_once_per_flap():
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b"], clock)
+        net.peer("a", "b", bidir=False)
+        await clock.run_for(5.0)
+        assert net.stores["a"].peer_state("0", "b") == KvStorePeerState.INITIALIZED
+        net.transport.fail("a", "b")
+        net.stores["a"].set_key_vals("0", {"k": mkval(1, "a")})
+        await clock.run_for(1.0)
+        assert net.stores["a"].areas["0"].peers["b"].flaps == 1
+        await net.stop()
+
+    run(main())

@@ -1,0 +1,196 @@
+"""mergeKeyValues conflict-resolution tests (semantics from
+openr/kvstore/tests/KvStoreUtilTest.cpp) + convergence property test."""
+
+import random
+
+from openr_tpu import constants as C
+from openr_tpu.kvstore.merge import (
+    ComparisonResult,
+    MergeResult,
+    compare_values,
+    dump_hashes,
+    generate_hash,
+    merge_key_values,
+)
+from openr_tpu.types import KvStoreNoMergeReason, Value
+
+
+def v(version=1, originator="node1", value=b"data", ttl=300000, ttl_version=0):
+    val = Value(
+        version=version,
+        originator_id=originator,
+        value=value,
+        ttl=ttl,
+        ttl_version=ttl_version,
+    )
+    val.hash = generate_hash(val)
+    return val
+
+
+def test_fresh_key_accepted():
+    store = {}
+    r = merge_key_values(store, {"k": v()})
+    assert "k" in r.key_vals and store["k"].value == b"data"
+    assert store["k"].hash is not None
+
+
+def test_invalid_ttl_rejected():
+    store = {}
+    r = merge_key_values(store, {"k": v(ttl=0), "j": v(ttl=-5)})
+    assert store == {}
+    assert r.no_merge_reasons["k"] == KvStoreNoMergeReason.INVALID_TTL
+    assert r.no_merge_reasons["j"] == KvStoreNoMergeReason.INVALID_TTL
+    # infinity is valid
+    r2 = merge_key_values(store, {"k": v(ttl=C.TTL_INFINITY)})
+    assert "k" in r2.key_vals
+
+
+def test_old_version_rejected():
+    store = {"k": v(version=5)}
+    r = merge_key_values(store, {"k": v(version=4, value=b"other")})
+    assert r.key_vals == {}
+    assert r.no_merge_reasons["k"] == KvStoreNoMergeReason.OLD_VERSION
+    assert store["k"].version == 5
+    # version 0 is undefined -> rejected even on empty store
+    r2 = merge_key_values({}, {"k": v(version=0)})
+    assert r2.no_merge_reasons["k"] == KvStoreNoMergeReason.OLD_VERSION
+
+
+def test_higher_version_wins():
+    store = {"k": v(version=1, value=b"old")}
+    r = merge_key_values(store, {"k": v(version=2, value=b"new")})
+    assert store["k"].value == b"new"
+    assert "k" in r.key_vals
+
+
+def test_same_version_higher_originator_wins():
+    store = {"k": v(originator="nodeA", value=b"a")}
+    r = merge_key_values(store, {"k": v(originator="nodeB", value=b"b")})
+    assert store["k"].originator_id == "nodeB"
+    assert "k" in r.key_vals
+    # lower originator loses
+    r2 = merge_key_values(store, {"k": v(originator="nodeA", value=b"zzz")})
+    assert store["k"].originator_id == "nodeB"
+    assert r2.no_merge_reasons["k"] == KvStoreNoMergeReason.NO_NEED_TO_UPDATE
+
+
+def test_same_version_originator_larger_value_wins():
+    store = {"k": v(value=b"aaa")}
+    r = merge_key_values(store, {"k": v(value=b"bbb")})
+    assert store["k"].value == b"bbb"
+    assert "k" in r.key_vals
+    r2 = merge_key_values(store, {"k": v(value=b"abc")})
+    assert store["k"].value == b"bbb"
+    assert r2.key_vals == {}
+
+
+def test_equal_value_higher_ttl_version_refreshes():
+    store = {"k": v(ttl_version=1)}
+    stored_obj = store["k"]
+    r = merge_key_values(store, {"k": v(ttl_version=3, ttl=60000)})
+    assert "k" in r.key_vals
+    assert store["k"] is stored_obj  # ttl-update mutates, not replaces
+    assert store["k"].ttl_version == 3
+    assert store["k"].ttl == 60000
+    # equal ttl_version: no-op
+    r2 = merge_key_values(store, {"k": v(ttl_version=3)})
+    assert r2.key_vals == {}
+
+
+def test_ttl_update_without_value():
+    store = {"k": v(ttl_version=0)}
+    ttl_up = Value(version=1, originator_id="node1", value=None, ttl=90000, ttl_version=2)
+    r = merge_key_values(store, {"k": ttl_up})
+    assert "k" in r.key_vals
+    assert store["k"].ttl == 90000 and store["k"].ttl_version == 2
+    assert store["k"].value == b"data"  # data preserved
+
+
+def test_ttl_update_missing_key_inconsistency():
+    ttl_up = Value(version=1, originator_id="node1", value=None, ttl=90000, ttl_version=2)
+    # sender is NOT originator: dropped quietly
+    r = merge_key_values({}, {"k": ttl_up}, sender="node9")
+    assert not r.inconsistency_detected_with_originator
+    assert r.no_merge_reasons["k"] == KvStoreNoMergeReason.NO_MATCHED_KEY
+    # sender IS originator: resync flag raised
+    r2 = merge_key_values({}, {"k": ttl_up}, sender="node1")
+    assert r2.inconsistency_detected_with_originator
+    assert r2.no_merge_reasons["k"] == KvStoreNoMergeReason.INCONSISTENCY_DETECTED
+
+
+def test_ttl_update_version_mismatch_inconsistency():
+    store = {"k": v(version=3)}
+    ttl_up = Value(version=2, originator_id="node1", value=None, ttl=90000, ttl_version=9)
+    r = merge_key_values(store, {"k": ttl_up}, sender="node1")
+    assert r.inconsistency_detected_with_originator
+
+
+def test_key_filter():
+    store = {}
+    r = merge_key_values(
+        store,
+        {"adj:x": v(), "prefix:y": v()},
+        key_filter=lambda k, _v: k.startswith("adj:"),
+    )
+    assert set(store) == {"adj:x"}
+    assert r.no_merge_reasons["prefix:y"] == KvStoreNoMergeReason.NO_MATCHED_KEY
+
+
+def test_compare_values():
+    assert compare_values(v(version=2), v(version=1)) == ComparisonResult.FIRST
+    assert (
+        compare_values(v(originator="a"), v(originator="b"))
+        == ComparisonResult.SECOND
+    )
+    a, b = v(ttl_version=5), v(ttl_version=2)
+    assert compare_values(a, b) == ComparisonResult.FIRST
+    assert compare_values(v(), v()) == ComparisonResult.TIED
+    assert (
+        compare_values(v(value=b"zz"), v(value=b"aa")) == ComparisonResult.FIRST
+    )
+
+
+def test_dump_hashes():
+    store = {"a": v(), "b": v(version=2)}
+    h = dump_hashes(store)
+    assert set(h) == {"a", "b"}
+    assert h["b"][0] == 2
+    assert dump_hashes(store, ["b", "missing"]) == {"b": h["b"]}
+
+
+def test_merge_convergence_property():
+    """Any interleaving of the same update set converges to one state."""
+    rng = random.Random(7)
+    updates = []
+    for i in range(200):
+        updates.append(
+            (
+                f"key{rng.randrange(12)}",
+                v(
+                    version=rng.randrange(1, 5),
+                    originator=f"node{rng.randrange(4)}",
+                    value=bytes([rng.randrange(256)]) * 3,
+                    ttl_version=rng.randrange(3),
+                ),
+            )
+        )
+    stores = [{} for _ in range(4)]
+    for store in stores:
+        order = updates[:]
+        rng.shuffle(order)
+        for key, value in order:
+            merge_key_values(store, {key: value})
+    # pairwise cross-merge (simulates anti-entropy sync)
+    for a in stores:
+        for b in stores:
+            merge_key_values(a, dict(b))
+    base = {
+        k: (val.version, val.originator_id, val.value, val.ttl_version)
+        for k, val in stores[0].items()
+    }
+    for store in stores[1:]:
+        got = {
+            k: (val.version, val.originator_id, val.value, val.ttl_version)
+            for k, val in store.items()
+        }
+        assert got == base
