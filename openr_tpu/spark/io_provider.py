@@ -1,0 +1,107 @@
+"""Spark I/O abstraction + simulated network.
+
+The reference isolates every Spark syscall behind `IoProvider`
+(openr/spark/IoProvider.h:28-70) precisely so tests can fake the network
+(tests/mocks/MockIoProvider.h).  We keep that seam: Spark only ever calls
+``send(if_name, payload)`` and receives ``(if_name, payload, recv_ts)``
+callbacks.
+
+`MockIoProvider` is the emulation backbone: a shared object holding the
+`ConnectedIfPairs` topology with per-link latency, delivering packets
+between in-process Spark instances on the shared (virtual) clock —
+the MockIoProvider.h:18-21 pattern.  `UdpIoProvider` (real IPv6 link-local
+multicast ff02::1:6666) plugs into the same seam for deployment.
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable, Dict, List, Tuple
+
+from openr_tpu.common.runtime import Actor, Clock
+
+# receiver callback: (if_name, payload, recv_time_s)
+RecvCallback = Callable[[str, dict, float], Awaitable[None]]
+
+
+class IoProvider:
+    def register(self, node: str, cb: RecvCallback) -> None:
+        raise NotImplementedError
+
+    def unregister(self, node: str) -> None:
+        """Stop delivering to `node` (called on Spark stop)."""
+
+    def send(self, node: str, if_name: str, payload: dict) -> None:
+        """Multicast `payload` out of (node, if_name)."""
+        raise NotImplementedError
+
+
+class MockIoProvider(IoProvider):
+    """Simulated L2 segments with per-pair latency.
+
+    ``connect_pair(n1, if1, n2, if2, latency)`` wires two interfaces
+    together (bidirectionally).  Packets sent on an interface are delivered
+    to every connected remote interface after its latency, via tasks on the
+    shared clock — deterministic under SimClock.
+    """
+
+    def __init__(self, clock: Clock) -> None:
+        self.clock = clock
+        self._receivers: Dict[str, RecvCallback] = {}
+        # (node, if) -> [(peer_node, peer_if, latency_s)]
+        self._pairs: Dict[Tuple[str, str], List[Tuple[str, str, float]]] = {}
+        self._pump = Actor("mock_io", clock)
+        self._partitioned: set = set()
+        self.packets_sent = 0
+        self.packets_delivered = 0
+
+    def register(self, node: str, cb: RecvCallback) -> None:
+        self._receivers[node] = cb
+
+    def unregister(self, node: str) -> None:
+        self._receivers.pop(node, None)
+
+    def connect_pair(
+        self, n1: str, if1: str, n2: str, if2: str, latency_s: float = 0.001
+    ) -> None:
+        self._pairs.setdefault((n1, if1), []).append((n2, if2, latency_s))
+        self._pairs.setdefault((n2, if2), []).append((n1, if1, latency_s))
+
+    def disconnect_pair(self, n1: str, if1: str, n2: str, if2: str) -> None:
+        self._pairs.get((n1, if1), [])[:] = [
+            e for e in self._pairs.get((n1, if1), []) if e[:2] != (n2, if2)
+        ]
+        self._pairs.get((n2, if2), [])[:] = [
+            e for e in self._pairs.get((n2, if2), []) if e[:2] != (n1, if1)
+        ]
+
+    def partition(self, n1: str, n2: str) -> None:
+        """Drop all packets between two nodes (both directions)."""
+        self._partitioned.add((n1, n2))
+        self._partitioned.add((n2, n1))
+
+    def heal(self, n1: str, n2: str) -> None:
+        self._partitioned.discard((n1, n2))
+        self._partitioned.discard((n2, n1))
+
+    def send(self, node: str, if_name: str, payload: dict) -> None:
+        self.packets_sent += 1
+        for peer_node, peer_if, latency in self._pairs.get((node, if_name), []):
+            if (node, peer_node) in self._partitioned:
+                continue
+            self._pump.spawn(
+                self._deliver(peer_node, peer_if, dict(payload), latency),
+                name=f"mockio.{node}->{peer_node}",
+            )
+
+    async def _deliver(
+        self, peer_node: str, peer_if: str, payload: dict, latency: float
+    ) -> None:
+        await self.clock.sleep(latency)
+        cb = self._receivers.get(peer_node)
+        if cb is None:
+            return
+        self.packets_delivered += 1
+        await cb(peer_if, payload, self.clock.now())
+
+    async def stop(self) -> None:
+        await self._pump.stop()

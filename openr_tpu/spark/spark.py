@@ -1,0 +1,758 @@
+"""Spark — neighbor discovery over link-local multicast.
+
+The reference protocol (openr/spark/Spark.{h,cpp}): periodic HelloMsg
+carrying reflected neighbor info (for mutual-visibility detection and RTT
+measurement), point-to-point HandshakeMsg negotiating area/ports/hold
+times, and per-interface HeartbeatMsg keepalives.  Per-neighbor FSM
+(Types.thrift:51-69, transition matrix Spark.cpp:96-165):
+
+    IDLE ─hello──▶ WARM ─hello-with-our-info──▶ NEGOTIATE ─handshake──▶
+    ESTABLISHED ─hello-no-info/hold-expire──▶ IDLE (down)
+    ESTABLISHED ─hello-restarting──▶ RESTART ─hello-with-info──▶ NEGOTIATE
+    NEGOTIATE ─negotiate-hold-expire/failure──▶ WARM
+    RESTART/WARM ─GR-hold-expire──▶ IDLE (down)
+
+Emits NeighborEvents to LinkMonitor on the neighborUpdatesQueue.  RTT is
+measured from the 4 reflected timestamps and filtered through StepDetector
+(Spark.h:327).  Fast-init hellos (solicitResponse) run at 500 ms during
+discovery windows; inbound packets are rate limited to 50 pps per
+interface (Constants.h:112).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+from openr_tpu import constants as C
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.common.utils import StepDetector
+from openr_tpu.config import SparkConfig
+from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+from openr_tpu.spark.io_provider import IoProvider
+from openr_tpu.types import (
+    InitializationEvent,
+    InterfaceDatabase,
+    NeighborEvent,
+    NeighborEventType,
+    SparkNeighEvent,
+    SparkNeighState,
+)
+
+# -- wire messages (thrift SparkHelloPacket equivalents) --------------------
+
+
+@dataclass
+class ReflectedNeighborInfo:
+    """Timestamps we reflect back to each neighbor (RTT + visibility)."""
+
+    seq_num: int = 0
+    last_nbr_msg_sent_ts_us: int = 0  # their hello's sent ts, as we saw it
+    last_my_msg_rcvd_ts_us: int = 0  # when we received their hello
+
+
+@dataclass
+class SparkHelloMsg:
+    node_name: str
+    if_name: str
+    seq_num: int
+    neighbor_infos: Dict[str, ReflectedNeighborInfo]
+    version: int = C.OPENR_VERSION
+    solicit_response: bool = False
+    restarting: bool = False
+    sent_ts_us: int = 0
+
+
+@dataclass
+class SparkHandshakeMsg:
+    node_name: str
+    is_adj_established: bool
+    hold_time_ms: int
+    graceful_restart_time_ms: int
+    transport_address_v6: str = ""
+    transport_address_v4: str = ""
+    openr_ctrl_port: int = C.OPENR_CTRL_PORT
+    area: str = C.DEFAULT_AREA
+    #: point-to-point: only this node should process the msg
+    neighbor_node_name: str = ""
+
+
+@dataclass
+class SparkHeartbeatMsg:
+    node_name: str
+    seq_num: int
+    hold_time_ms: int = 0
+    #: initialization flag: while true, the advertised adjacency may only be
+    #: used by the neighbor (Types.thrift:206-212)
+    adj_only_used_by_other_node: bool = False
+
+
+def _pack(msg) -> dict:
+    kind = type(msg).__name__
+    d = dataclasses.asdict(msg)
+    return {"kind": kind, "body": d}
+
+
+def _unpack(payload: dict):
+    kind, body = payload["kind"], dict(payload["body"])
+    if kind == "SparkHelloMsg":
+        body["neighbor_infos"] = {
+            k: ReflectedNeighborInfo(**v)
+            for k, v in body["neighbor_infos"].items()
+        }
+        return SparkHelloMsg(**body)
+    if kind == "SparkHandshakeMsg":
+        return SparkHandshakeMsg(**body)
+    if kind == "SparkHeartbeatMsg":
+        return SparkHeartbeatMsg(**body)
+    raise ValueError(kind)
+
+
+# -- FSM transition matrix (Spark.cpp:96-165) -------------------------------
+
+_S = SparkNeighState
+_E = SparkNeighEvent
+_STATE_MAP: Dict[SparkNeighState, Dict[SparkNeighEvent, SparkNeighState]] = {
+    _S.IDLE: {
+        _E.HELLO_RCVD_INFO: _S.WARM,
+        _E.HELLO_RCVD_NO_INFO: _S.WARM,
+    },
+    _S.WARM: {
+        _E.HELLO_RCVD_INFO: _S.NEGOTIATE,
+        _E.GR_TIMER_EXPIRE: _S.IDLE,
+    },
+    _S.NEGOTIATE: {
+        _E.HANDSHAKE_RCVD: _S.ESTABLISHED,
+        _E.NEGOTIATE_TIMER_EXPIRE: _S.WARM,
+        _E.GR_TIMER_EXPIRE: _S.IDLE,
+        _E.NEGOTIATION_FAILURE: _S.WARM,
+    },
+    _S.ESTABLISHED: {
+        _E.HELLO_RCVD_NO_INFO: _S.IDLE,
+        _E.HELLO_RCVD_RESTART: _S.RESTART,
+        _E.HEARTBEAT_RCVD: _S.ESTABLISHED,
+        _E.HEARTBEAT_TIMER_EXPIRE: _S.IDLE,
+    },
+    _S.RESTART: {
+        _E.HELLO_RCVD_INFO: _S.NEGOTIATE,
+        _E.GR_TIMER_EXPIRE: _S.IDLE,
+    },
+}
+
+
+def get_next_state(
+    state: SparkNeighState, event: SparkNeighEvent
+) -> Optional[SparkNeighState]:
+    return _STATE_MAP[state].get(event)
+
+
+@dataclass
+class SparkNeighbor:
+    """Tracked neighbor on one interface (Spark.cpp:180-240)."""
+
+    node_name: str
+    local_if_name: str
+    remote_if_name: str
+    seq_num: int
+    area: str
+    state: SparkNeighState = SparkNeighState.IDLE
+    event: Optional[SparkNeighEvent] = None
+    transport_address_v6: str = ""
+    transport_address_v4: str = ""
+    openr_ctrl_port: int = 0
+    rtt_us: int = 0
+    heartbeat_hold_time_s: float = C.SPARK_HOLD_TIME_S
+    gr_hold_time_s: float = C.SPARK_GR_HOLD_TIME_S
+    adj_only_used_by_other_node: bool = False
+    #: True between NEIGHBOR_UP and NEIGHBOR_DOWN notifications; teardown
+    #: paths call _neighbor_down unconditionally and this gates the event
+    reported_up: bool = False
+    # reflected timestamps
+    neighbor_timestamp_us: int = 0
+    local_timestamp_us: int = 0
+    # timers (tasks)
+    heartbeat_hold_task: Optional[asyncio.Task] = None
+    negotiate_task: Optional[asyncio.Task] = None
+    negotiate_hold_task: Optional[asyncio.Task] = None
+    gr_hold_task: Optional[asyncio.Task] = None
+    step_detector: Optional[StepDetector] = None
+
+    def cancel_timers(self) -> None:
+        for t in (
+            self.heartbeat_hold_task,
+            self.negotiate_task,
+            self.negotiate_hold_task,
+            self.gr_hold_task,
+        ):
+            if t is not None:
+                t.cancel()
+
+
+@dataclass
+class _TrackedInterface:
+    if_name: str
+    v6_addr: str = ""
+    v4_addr: str = ""
+    hello_task: Optional[asyncio.Task] = None
+    heartbeat_task: Optional[asyncio.Task] = None
+    # inbound rate limiting state
+    tokens: float = float(C.SPARK_MAX_ALLOWED_PPS)
+    tokens_ts: float = 0.0
+
+
+class Spark(Actor):
+    """The Spark module (openr/spark/Spark.h:60-600)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config: SparkConfig,
+        io: IoProvider,
+        neighbor_updates_queue: ReplicateQueue,
+        interface_updates_reader: Optional[RQueue] = None,
+        area_lookup: Optional[Callable[[str, str], Optional[str]]] = None,
+        initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
+        counters: Optional[CounterMap] = None,
+        adj_hold_until_initialized: bool = False,
+    ) -> None:
+        super().__init__("spark", clock, counters)
+        self.node_name = node_name
+        self.config = config
+        self.io = io
+        self.neighbor_updates_queue = neighbor_updates_queue
+        self.interface_updates_reader = interface_updates_reader
+        #: (neighbor_name, if_name) -> area; default places everyone in "0"
+        self.area_lookup = area_lookup or (lambda _n, _i: C.DEFAULT_AREA)
+        self.initialization_cb = initialization_cb
+        self.my_seq_num = 0
+        self.interfaces: Dict[str, _TrackedInterface] = {}
+        #: if_name -> {neighbor_name -> SparkNeighbor}
+        self.neighbors: Dict[str, Dict[str, SparkNeighbor]] = {}
+        self._fast_init_until = clock.now() + config.min_neighbor_discovery_interval_s
+        self._discovery_signaled = False
+        self._restarting = False
+        #: during cold start, advertise adjacencies as one-sided
+        self.adj_hold = adj_hold_until_initialized
+        io.register(node_name, self._on_packet)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self.interface_updates_reader is not None:
+            self.spawn_queue_loop(
+                self.interface_updates_reader,
+                self._on_interface_db,
+                "spark.interfaces",
+            )
+        # min window: signal early if discovery already completed; max
+        # window: signal unconditionally (Spark.h:558-570 bounded discovery)
+        self.schedule(
+            self.config.min_neighbor_discovery_interval_s,
+            self._maybe_signal_neighbor_discovered,
+        )
+        self.schedule(
+            self.config.max_neighbor_discovery_interval_s,
+            self._signal_neighbor_discovered,
+        )
+
+    async def stop_gracefully(self) -> None:
+        """Broadcast restarting hellos so peers hold adjacencies through our
+        restart (floodRestartingMsg, Spark.h:79)."""
+        self._restarting = True
+        for if_name in self.interfaces:
+            self._send_hello(if_name, restarting=True)
+
+    async def stop(self) -> None:
+        # a stopped node must leave the wire: no rx callback, no new fibers
+        self.io.unregister(self.node_name)
+        await super().stop()
+
+    # -- interface tracking ------------------------------------------------
+
+    def _on_interface_db(self, db: InterfaceDatabase) -> None:
+        up_now: Set[str] = set()
+        for if_name, info in db.interfaces.items():
+            if not info.is_up:
+                continue
+            up_now.add(if_name)
+            if if_name not in self.interfaces:
+                tracked = _TrackedInterface(
+                    if_name=if_name,
+                    v6_addr=info.v6_link_local() or "",
+                    v4_addr=info.v4_addr() or "",
+                    tokens_ts=self.clock.now(),
+                )
+                self.interfaces[if_name] = tracked
+                self.neighbors.setdefault(if_name, {})
+                tracked.hello_task = self.spawn(
+                    self._hello_loop(if_name), name=f"spark.hello.{if_name}"
+                )
+                tracked.heartbeat_task = self.spawn(
+                    self._heartbeat_loop(if_name), name=f"spark.beat.{if_name}"
+                )
+        for if_name in list(self.interfaces):
+            if if_name not in up_now:
+                self._remove_interface(if_name)
+
+    def _remove_interface(self, if_name: str) -> None:
+        tracked = self.interfaces.pop(if_name, None)
+        if tracked is None:
+            return
+        for t in (tracked.hello_task, tracked.heartbeat_task):
+            if t is not None:
+                t.cancel()
+        for neighbor in list(self.neighbors.get(if_name, {}).values()):
+            # notifies for ESTABLISHED *and* held (RESTART) adjacencies
+            self._neighbor_down(neighbor)
+            neighbor.cancel_timers()
+        self.neighbors.pop(if_name, None)
+
+    # -- periodic senders --------------------------------------------------
+
+    async def _hello_loop(self, if_name: str) -> None:
+        while True:
+            fast = self.clock.now() < self._fast_init_until
+            self._send_hello(if_name, solicit=fast)
+            await self.clock.sleep(
+                self.config.fastinit_hello_time_ms / 1000.0
+                if fast
+                else self.config.hello_time_s
+            )
+
+    async def _heartbeat_loop(self, if_name: str) -> None:
+        while True:
+            await self.clock.sleep(self.config.heartbeat_time_s)
+            if any(
+                n.state == SparkNeighState.ESTABLISHED
+                for n in self.neighbors.get(if_name, {}).values()
+            ):
+                self.io.send(
+                    self.node_name,
+                    if_name,
+                    _pack(
+                        SparkHeartbeatMsg(
+                            node_name=self.node_name,
+                            seq_num=self.my_seq_num,
+                            hold_time_ms=int(self.config.hold_time_s * 1000),
+                            adj_only_used_by_other_node=self.adj_hold,
+                        )
+                    ),
+                )
+
+    def _send_hello(
+        self, if_name: str, solicit: bool = False, restarting: bool = False
+    ) -> None:
+        if if_name not in self.interfaces:
+            return
+        self.my_seq_num += 1
+        infos: Dict[str, ReflectedNeighborInfo] = {}
+        for neighbor in self.neighbors.get(if_name, {}).values():
+            if neighbor.state == SparkNeighState.IDLE:
+                continue
+            infos[neighbor.node_name] = ReflectedNeighborInfo(
+                seq_num=neighbor.seq_num,
+                last_nbr_msg_sent_ts_us=neighbor.neighbor_timestamp_us,
+                last_my_msg_rcvd_ts_us=neighbor.local_timestamp_us,
+            )
+        msg = SparkHelloMsg(
+            node_name=self.node_name,
+            if_name=if_name,
+            seq_num=self.my_seq_num,
+            neighbor_infos=infos,
+            solicit_response=solicit,
+            restarting=restarting or self._restarting,
+            sent_ts_us=int(self.clock.now() * 1e6),
+        )
+        self.io.send(self.node_name, if_name, _pack(msg))
+        self.counters.bump("spark.hello.packets_sent")
+
+    def _send_handshake(
+        self, if_name: str, neighbor: SparkNeighbor, is_adj_established: bool
+    ) -> None:
+        tracked = self.interfaces.get(if_name)
+        if tracked is None:
+            return
+        msg = SparkHandshakeMsg(
+            node_name=self.node_name,
+            is_adj_established=is_adj_established,
+            hold_time_ms=int(self.config.hold_time_s * 1000),
+            graceful_restart_time_ms=int(
+                self.config.graceful_restart_time_s * 1000
+            ),
+            transport_address_v6=tracked.v6_addr,
+            transport_address_v4=tracked.v4_addr,
+            area=neighbor.area,
+            neighbor_node_name=neighbor.node_name,
+        )
+        self.io.send(self.node_name, if_name, _pack(msg))
+        self.counters.bump("spark.handshake.packets_sent")
+
+    # -- packet ingress ----------------------------------------------------
+
+    async def _on_packet(self, if_name: str, payload: dict, recv_ts: float) -> None:
+        if self._stopped:
+            return
+        tracked = self.interfaces.get(if_name)
+        if tracked is None:
+            return
+        # per-interface inbound rate limit (Constants.h:112, 50 pps)
+        now = self.clock.now()
+        tracked.tokens = min(
+            float(C.SPARK_MAX_ALLOWED_PPS),
+            tracked.tokens + (now - tracked.tokens_ts) * C.SPARK_MAX_ALLOWED_PPS,
+        )
+        tracked.tokens_ts = now
+        if tracked.tokens < 1:
+            self.counters.bump("spark.packet_dropped_rate_limit")
+            return
+        tracked.tokens -= 1
+
+        try:
+            msg = _unpack(payload)
+        except Exception:  # noqa: BLE001 - malformed packet
+            self.counters.bump("spark.packet_parse_error")
+            return
+        if msg.node_name == self.node_name:
+            return  # our own multicast echo
+        self.touch()
+        if isinstance(msg, SparkHelloMsg):
+            self._process_hello(msg, if_name, int(recv_ts * 1e6))
+        elif isinstance(msg, SparkHandshakeMsg):
+            self._process_handshake(msg, if_name)
+        elif isinstance(msg, SparkHeartbeatMsg):
+            self._process_heartbeat(msg, if_name)
+
+    # -- FSM helpers -------------------------------------------------------
+
+    def _transition(
+        self, neighbor: SparkNeighbor, event: SparkNeighEvent
+    ) -> SparkNeighState:
+        nxt = get_next_state(neighbor.state, event)
+        assert nxt is not None, f"unexpected {event} in {neighbor.state}"
+        neighbor.state = nxt
+        neighbor.event = event
+        self.counters.bump("spark.state_transitions")
+        return nxt
+
+    def _notify(self, etype: NeighborEventType, neighbor: SparkNeighbor) -> None:
+        self.neighbor_updates_queue.push(
+            NeighborEvent(
+                event_type=etype,
+                node_name=neighbor.node_name,
+                area=neighbor.area,
+                local_if_name=neighbor.local_if_name,
+                remote_if_name=neighbor.remote_if_name,
+                neighbor_addr_v6=neighbor.transport_address_v6,
+                neighbor_addr_v4=neighbor.transport_address_v4,
+                ctrl_port=neighbor.openr_ctrl_port,
+                rtt_us=neighbor.rtt_us,
+                adj_only_used_by_other_node=neighbor.adj_only_used_by_other_node,
+            )
+        )
+
+    def _neighbor_up(self, neighbor: SparkNeighbor) -> None:
+        neighbor.adj_only_used_by_other_node = self.adj_hold
+        neighbor.reported_up = True
+        if neighbor.gr_hold_task is not None:
+            neighbor.gr_hold_task.cancel()
+        self._notify(NeighborEventType.NEIGHBOR_UP, neighbor)
+        self._arm_heartbeat_hold(neighbor)
+        self._maybe_signal_neighbor_discovered()
+
+    def _neighbor_down(self, neighbor: SparkNeighbor) -> None:
+        """Safe to call from any teardown path; only notifies if the
+        adjacency was ever reported up (incl. held RESTART adjacencies)."""
+        if neighbor.reported_up:
+            neighbor.reported_up = False
+            self._notify(NeighborEventType.NEIGHBOR_DOWN, neighbor)
+
+    def _arm_heartbeat_hold(self, neighbor: SparkNeighbor) -> None:
+        if neighbor.heartbeat_hold_task is not None:
+            neighbor.heartbeat_hold_task.cancel()
+        neighbor.heartbeat_hold_task = self.spawn(
+            self._heartbeat_hold(neighbor),
+            name=f"spark.hold.{neighbor.node_name}",
+        )
+
+    async def _heartbeat_hold(self, neighbor: SparkNeighbor) -> None:
+        await self.clock.sleep(neighbor.heartbeat_hold_time_s)
+        if neighbor.state != SparkNeighState.ESTABLISHED:
+            return
+        self._transition(neighbor, SparkNeighEvent.HEARTBEAT_TIMER_EXPIRE)
+        self._neighbor_down(neighbor)
+        self._erase_neighbor(neighbor)
+
+    def _erase_neighbor(self, neighbor: SparkNeighbor) -> None:
+        neighbor.cancel_timers()
+        self.neighbors.get(neighbor.local_if_name, {}).pop(
+            neighbor.node_name, None
+        )
+
+    def _maybe_signal_neighbor_discovered(self) -> None:
+        """Signal once past the min discovery window with at least one
+        adjacency established (re-checked both on adjacency-up and at the
+        min-window timer)."""
+        if self._discovery_signaled:
+            return
+        if self.clock.now() >= self._fast_init_until and any(
+            n.state == SparkNeighState.ESTABLISHED
+            for per_if in self.neighbors.values()
+            for n in per_if.values()
+        ):
+            self._signal_neighbor_discovered()
+
+    def _signal_neighbor_discovered(self) -> None:
+        if self._discovery_signaled:
+            return
+        self._discovery_signaled = True
+        if self.initialization_cb is not None:
+            self.initialization_cb(InitializationEvent.NEIGHBOR_DISCOVERED)
+
+    # -- hello processing (Spark.cpp:1502-1754) ----------------------------
+
+    def _process_hello(
+        self, msg: SparkHelloMsg, if_name: str, recv_ts_us: int
+    ) -> None:
+        if not msg.if_name:
+            return
+        if msg.version < C.OPENR_SUPPORTED_VERSION:
+            self.counters.bump("spark.hello.invalid_version")
+            return
+        if_neighbors = self.neighbors.setdefault(if_name, {})
+        neighbor = if_neighbors.get(msg.node_name)
+        if neighbor is None:
+            area = self.area_lookup(msg.node_name, if_name)
+            if area is None:
+                self.counters.bump("spark.hello.no_area_match")
+                return
+            neighbor = SparkNeighbor(
+                node_name=msg.node_name,
+                local_if_name=if_name,
+                remote_if_name=msg.if_name,
+                seq_num=msg.seq_num,
+                area=area,
+                heartbeat_hold_time_s=self.config.hold_time_s,
+                gr_hold_time_s=self.config.graceful_restart_time_s,
+            )
+            neighbor.step_detector = StepDetector(
+                lambda rtt, n=neighbor: self._on_rtt_step(n, rtt),
+                fast_window_size=self.config.step_detector_conf.fast_window_size,
+                slow_window_size=self.config.step_detector_conf.slow_window_size,
+                lower_threshold_pct=self.config.step_detector_conf.lower_threshold,
+                upper_threshold_pct=self.config.step_detector_conf.upper_threshold,
+                abs_threshold=self.config.step_detector_conf.ads_threshold,
+            )
+            if_neighbors[msg.node_name] = neighbor
+
+        neighbor.neighbor_timestamp_us = msg.sent_ts_us
+        neighbor.local_timestamp_us = recv_ts_us
+
+        ts = msg.neighbor_infos.get(self.node_name)
+        if ts is not None:
+            self._update_rtt(neighbor, msg, ts, recv_ts_us)
+
+        if msg.solicit_response:
+            self._send_hello(if_name)
+
+        state = neighbor.state
+        if state == SparkNeighState.IDLE:
+            self._transition(neighbor, SparkNeighEvent.HELLO_RCVD_NO_INFO)
+            # WARM entries must not park forever if the peer vanishes
+            # before negotiation (matrix: WARM --GR_TIMER_EXPIRE--> IDLE)
+            self._arm_gr_hold(neighbor)
+        elif state == SparkNeighState.WARM:
+            neighbor.seq_num = msg.seq_num
+            if ts is None:
+                return  # neighbor doesn't see us yet
+            # guard against hellos reflecting our previous incarnation
+            if ts.seq_num >= self.my_seq_num:
+                return
+            self._start_negotiation(if_name, neighbor)
+            self._transition(neighbor, SparkNeighEvent.HELLO_RCVD_INFO)
+        elif state == SparkNeighState.ESTABLISHED:
+            cur_seq = neighbor.seq_num
+            neighbor.seq_num = msg.seq_num
+            if msg.restarting:
+                self._process_gr(neighbor)
+                return
+            # unidirectional-link detection: peer no longer sees us and its
+            # seq advanced (so it isn't a missed-restart) → tear down
+            if cur_seq < msg.seq_num and ts is None:
+                self._transition(neighbor, SparkNeighEvent.HELLO_RCVD_NO_INFO)
+                self._neighbor_down(neighbor)
+                self._erase_neighbor(neighbor)
+        elif state == SparkNeighState.RESTART:
+            if ts is None:
+                return
+            if neighbor.seq_num < msg.seq_num:
+                return  # missed all post-restart hellos; let GR timer decide
+            neighbor.seq_num = msg.seq_num
+            self._start_negotiation(if_name, neighbor)
+            self._transition(neighbor, SparkNeighEvent.HELLO_RCVD_INFO)
+
+    def _process_gr(self, neighbor: SparkNeighbor) -> None:
+        """Peer announced graceful restart (processGRMsg,
+        Spark.cpp:1418-1470): hold the adjacency, start GR timer."""
+        self._transition(neighbor, SparkNeighEvent.HELLO_RCVD_RESTART)
+        self._notify(NeighborEventType.NEIGHBOR_RESTARTING, neighbor)
+        if neighbor.heartbeat_hold_task is not None:
+            neighbor.heartbeat_hold_task.cancel()
+        self._arm_gr_hold(neighbor)
+
+    def _arm_gr_hold(self, neighbor: SparkNeighbor) -> None:
+        if neighbor.gr_hold_task is not None:
+            neighbor.gr_hold_task.cancel()
+        neighbor.gr_hold_task = self.spawn(
+            self._gr_hold(neighbor), name=f"spark.gr.{neighbor.node_name}"
+        )
+
+    async def _gr_hold(self, neighbor: SparkNeighbor) -> None:
+        await self.clock.sleep(neighbor.gr_hold_time_s)
+        if neighbor.state not in (SparkNeighState.RESTART, SparkNeighState.WARM):
+            return
+        self._transition(neighbor, SparkNeighEvent.GR_TIMER_EXPIRE)
+        self._neighbor_down(neighbor)
+        self._erase_neighbor(neighbor)
+
+    def _start_negotiation(self, if_name: str, neighbor: SparkNeighbor) -> None:
+        """Kick off handshake exchange (processNegotiation)."""
+        if neighbor.negotiate_task is not None:
+            neighbor.negotiate_task.cancel()
+        if neighbor.negotiate_hold_task is not None:
+            neighbor.negotiate_hold_task.cancel()
+        neighbor.negotiate_task = self.spawn(
+            self._negotiate_loop(if_name, neighbor),
+            name=f"spark.negotiate.{neighbor.node_name}",
+        )
+        neighbor.negotiate_hold_task = self.spawn(
+            self._negotiate_hold(neighbor),
+            name=f"spark.negotiate_hold.{neighbor.node_name}",
+        )
+
+    async def _negotiate_loop(self, if_name: str, neighbor: SparkNeighbor) -> None:
+        while True:
+            self._send_handshake(if_name, neighbor, False)
+            await self.clock.sleep(self.config.handshake_time_ms / 1000.0)
+
+    def _cancel_negotiation(self, neighbor: SparkNeighbor) -> None:
+        if neighbor.negotiate_task is not None:
+            neighbor.negotiate_task.cancel()
+        if neighbor.negotiate_hold_task is not None:
+            neighbor.negotiate_hold_task.cancel()
+
+    async def _negotiate_hold(self, neighbor: SparkNeighbor) -> None:
+        # 5 handshake attempts worth of time (Spark.h negotiation window)
+        await self.clock.sleep(5 * self.config.handshake_time_ms / 1000.0)
+        if neighbor.state != SparkNeighState.NEGOTIATE:
+            return
+        self._transition(neighbor, SparkNeighEvent.NEGOTIATE_TIMER_EXPIRE)
+        if neighbor.negotiate_task is not None:
+            neighbor.negotiate_task.cancel()
+        # back in WARM: re-arm expiry so the entry can't park forever
+        self._arm_gr_hold(neighbor)
+
+    # -- handshake processing (Spark.cpp:1755-1910) ------------------------
+
+    def _process_handshake(self, msg: SparkHandshakeMsg, if_name: str) -> None:
+        if msg.neighbor_node_name and msg.neighbor_node_name != self.node_name:
+            return  # point-to-point, not for us
+        if_neighbors = self.neighbors.setdefault(if_name, {})
+        neighbor = if_neighbors.get(msg.node_name)
+        if neighbor is None:
+            return
+        # quick convergence: if the peer hasn't established us, reply (but
+        # never solicit more handshakes when we've left NEGOTIATE — avoids
+        # packet ping-pong, Spark.cpp:1793-1810)
+        if not msg.is_adj_established:
+            self._send_handshake(
+                if_name, neighbor, neighbor.state != SparkNeighState.NEGOTIATE
+            )
+        if neighbor.state != SparkNeighState.NEGOTIATE:
+            return
+        neighbor.openr_ctrl_port = msg.openr_ctrl_port
+        neighbor.transport_address_v6 = msg.transport_address_v6
+        neighbor.transport_address_v4 = msg.transport_address_v4
+        neighbor.heartbeat_hold_time_s = min(
+            msg.hold_time_ms / 1000.0, self.config.hold_time_s
+        )
+        neighbor.gr_hold_time_s = min(
+            msg.graceful_restart_time_ms / 1000.0,
+            self.config.graceful_restart_time_s,
+        )
+        # area negotiation: the area the peer placed us in must match the
+        # area we placed the peer in (default area is wildcard-compatible)
+        if neighbor.area != msg.area and C.DEFAULT_AREA not in (
+            neighbor.area,
+            msg.area,
+        ):
+            self._transition(neighbor, SparkNeighEvent.NEGOTIATION_FAILURE)
+            self._cancel_negotiation(neighbor)
+            self._arm_gr_hold(neighbor)  # parked in WARM; expire eventually
+            self.counters.bump("spark.handshake.area_mismatch")
+            return
+        self._transition(neighbor, SparkNeighEvent.HANDSHAKE_RCVD)
+        self._cancel_negotiation(neighbor)
+        self._neighbor_up(neighbor)
+
+    # -- heartbeat processing (Spark.cpp:1911-1970) ------------------------
+
+    def _process_heartbeat(self, msg: SparkHeartbeatMsg, if_name: str) -> None:
+        if_neighbors = self.neighbors.get(if_name, {})
+        neighbor = if_neighbors.get(msg.node_name)
+        if neighbor is None:
+            return
+        if neighbor.state != SparkNeighState.ESTABLISHED:
+            if neighbor.state == SparkNeighState.WARM:
+                # unblock quickly: solicit a hello
+                self._send_hello(if_name, solicit=True)
+            return
+        self._transition(neighbor, SparkNeighEvent.HEARTBEAT_RCVD)
+        self._arm_heartbeat_hold(neighbor)
+        # initialization: peer cleared its one-sided-adjacency flag
+        if neighbor.adj_only_used_by_other_node and not (
+            msg.adj_only_used_by_other_node
+        ):
+            neighbor.adj_only_used_by_other_node = False
+            self._notify(NeighborEventType.NEIGHBOR_ADJ_SYNCED, neighbor)
+
+    # -- RTT (updateNeighborRtt, Spark.cpp:1330-1410) ----------------------
+
+    def _update_rtt(
+        self,
+        neighbor: SparkNeighbor,
+        msg: SparkHelloMsg,
+        ts: ReflectedNeighborInfo,
+        recv_ts_us: int,
+    ) -> None:
+        if not ts.last_nbr_msg_sent_ts_us or not ts.last_my_msg_rcvd_ts_us:
+            return
+        # rtt = (t4 - t1) - (t3 - t2): full loop minus neighbor hold time
+        rtt_us = (recv_ts_us - ts.last_nbr_msg_sent_ts_us) - (
+            msg.sent_ts_us - ts.last_my_msg_rcvd_ts_us
+        )
+        if rtt_us <= 0:
+            return
+        if neighbor.rtt_us == 0:
+            neighbor.rtt_us = rtt_us
+        if neighbor.step_detector is not None:
+            neighbor.step_detector.add_value(float(rtt_us))
+
+    def _on_rtt_step(self, neighbor: SparkNeighbor, new_rtt: float) -> None:
+        neighbor.rtt_us = int(new_rtt)
+        if neighbor.state == SparkNeighState.ESTABLISHED:
+            self._notify(NeighborEventType.NEIGHBOR_RTT_CHANGE, neighbor)
+
+    # -- introspection (ctrl surface) --------------------------------------
+
+    def get_neighbors(self) -> List[SparkNeighbor]:
+        return [
+            n for per_if in self.neighbors.values() for n in per_if.values()
+        ]
+
+    def mark_adj_synced(self) -> None:
+        """Initialization complete: clear the one-sided-adjacency hold; the
+        next heartbeats tell peers they may use our adjacencies globally."""
+        self.adj_hold = False

@@ -1,0 +1,453 @@
+"""Spark FSM + discovery tests over MockIoProvider in virtual time
+(scenarios ported in spirit from openr/spark/tests/SparkTest.cpp)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import SparkConfig
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.spark.io_provider import MockIoProvider
+from openr_tpu.spark.spark import Spark, get_next_state
+from openr_tpu.types import (
+    InitializationEvent,
+    InterfaceDatabase,
+    InterfaceInfo,
+    NeighborEventType,
+    SparkNeighEvent,
+    SparkNeighState,
+)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def fast_config(**kwargs) -> SparkConfig:
+    return SparkConfig(
+        hello_time_s=2.0,
+        fastinit_hello_time_ms=500,
+        handshake_time_ms=500,
+        heartbeat_time_s=1.0,
+        hold_time_s=3.0,
+        graceful_restart_time_s=6.0,
+        min_neighbor_discovery_interval_s=1.0,
+        max_neighbor_discovery_interval_s=5.0,
+        **kwargs,
+    )
+
+
+class Rig:
+    """N Spark instances over one MockIoProvider."""
+
+    def __init__(self, clock, names, config=None, area_lookup=None):
+        self.clock = clock
+        self.io = MockIoProvider(clock)
+        self.sparks = {}
+        self.events = {}
+        self.init_events = {n: [] for n in names}
+        for n in names:
+            q = ReplicateQueue(f"{n}.neighborEvents")
+            self.events[n] = q.get_reader()
+            self.sparks[n] = Spark(
+                node_name=n,
+                clock=clock,
+                config=config or fast_config(),
+                io=self.io,
+                neighbor_updates_queue=q,
+                area_lookup=area_lookup,
+                initialization_cb=lambda ev, n=n: self.init_events[n].append(ev),
+            )
+            self.sparks[n].start()
+
+    def up_interface(self, node, if_name, v6="fe80::1", v4="192.168.1.1"):
+        self.sparks[node]._on_interface_db(
+            InterfaceDatabase(
+                interfaces={
+                    if_name: InterfaceInfo(
+                        if_name=if_name,
+                        is_up=True,
+                        if_index=1,
+                        networks=[f"{v6}/64", f"{v4}/31"],
+                    )
+                }
+            )
+        )
+
+    def drain_events(self, node):
+        out = []
+        while (e := self.events[node].try_get()) is not None:
+            out.append(e)
+        return out
+
+    async def stop(self):
+        for s in self.sparks.values():
+            await s.stop()
+        await self.io.stop()
+
+
+def wire(rig, a, ifa, b, ifb, latency=0.001):
+    rig.io.connect_pair(a, ifa, b, ifb, latency)
+    rig.up_interface(a, ifa)
+    rig.up_interface(b, ifb)
+
+
+def test_fsm_matrix():
+    S, E = SparkNeighState, SparkNeighEvent
+    assert get_next_state(S.IDLE, E.HELLO_RCVD_INFO) == S.WARM
+    assert get_next_state(S.IDLE, E.HELLO_RCVD_NO_INFO) == S.WARM
+    assert get_next_state(S.WARM, E.HELLO_RCVD_INFO) == S.NEGOTIATE
+    assert get_next_state(S.NEGOTIATE, E.HANDSHAKE_RCVD) == S.ESTABLISHED
+    assert get_next_state(S.NEGOTIATE, E.NEGOTIATE_TIMER_EXPIRE) == S.WARM
+    assert get_next_state(S.NEGOTIATE, E.NEGOTIATION_FAILURE) == S.WARM
+    assert get_next_state(S.ESTABLISHED, E.HELLO_RCVD_NO_INFO) == S.IDLE
+    assert get_next_state(S.ESTABLISHED, E.HELLO_RCVD_RESTART) == S.RESTART
+    assert get_next_state(S.ESTABLISHED, E.HEARTBEAT_TIMER_EXPIRE) == S.IDLE
+    assert get_next_state(S.RESTART, E.HELLO_RCVD_INFO) == S.NEGOTIATE
+    assert get_next_state(S.RESTART, E.GR_TIMER_EXPIRE) == S.IDLE
+    assert get_next_state(S.WARM, E.HANDSHAKE_RCVD) is None  # invalid
+
+
+def test_two_nodes_establish_adjacency():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["alice", "bob"])
+        wire(rig, "alice", "if_a_b", "bob", "if_b_a")
+        await clock.run_for(5.0)
+        a_events = rig.drain_events("alice")
+        b_events = rig.drain_events("bob")
+        up_a = [e for e in a_events if e.event_type == NeighborEventType.NEIGHBOR_UP]
+        up_b = [e for e in b_events if e.event_type == NeighborEventType.NEIGHBOR_UP]
+        assert len(up_a) == 1 and up_a[0].node_name == "bob"
+        assert up_a[0].local_if_name == "if_a_b"
+        assert up_a[0].remote_if_name == "if_b_a"
+        assert up_a[0].area == "0"
+        assert len(up_b) == 1 and up_b[0].node_name == "alice"
+        n = rig.sparks["alice"].get_neighbors()[0]
+        assert n.state == SparkNeighState.ESTABLISHED
+        await rig.stop()
+
+    run(main())
+
+
+def test_heartbeats_keep_adjacency_alive():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        rig.drain_events("a")
+        # run far beyond hold time (3s): heartbeats every 1s keep it alive
+        await clock.run_for(60.0)
+        assert rig.drain_events("a") == []  # no down events
+        assert (
+            rig.sparks["a"].get_neighbors()[0].state == SparkNeighState.ESTABLISHED
+        )
+        await rig.stop()
+
+    run(main())
+
+
+def test_partition_triggers_hold_timer_down():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        rig.drain_events("a")
+        rig.io.partition("a", "b")
+        await clock.run_for(10.0)  # hold time 3s
+        downs = [
+            e
+            for e in rig.drain_events("a")
+            if e.event_type == NeighborEventType.NEIGHBOR_DOWN
+        ]
+        assert len(downs) == 1 and downs[0].node_name == "b"
+        assert rig.sparks["a"].get_neighbors() == []
+        await rig.stop()
+
+    run(main())
+
+
+def test_reconnect_after_partition_reestablishes():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        rig.drain_events("a")
+        rig.io.partition("a", "b")
+        await clock.run_for(10.0)
+        rig.drain_events("a")
+        rig.io.heal("a", "b")
+        # fast-init is over; hello period is 2s here
+        await clock.run_for(15.0)
+        ups = [
+            e
+            for e in rig.drain_events("a")
+            if e.event_type == NeighborEventType.NEIGHBOR_UP
+        ]
+        assert len(ups) == 1
+        await rig.stop()
+
+    run(main())
+
+
+def test_graceful_restart_holds_and_recovers():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        rig.drain_events("b")
+        # a announces graceful restart
+        await rig.sparks["a"].stop_gracefully()
+        await clock.run_for(1.0)
+        evs = rig.drain_events("b")
+        assert [e.event_type for e in evs] == [NeighborEventType.NEIGHBOR_RESTARTING]
+        assert (
+            rig.sparks["b"].get_neighbors()[0].state == SparkNeighState.RESTART
+        )
+        # a comes back as a fresh instance (new seq number space)
+        await rig.sparks["a"].stop()
+        q = ReplicateQueue("a2.neighborEvents")
+        rig.events["a"] = q.get_reader()
+        rig.sparks["a"] = Spark(
+            node_name="a",
+            clock=clock,
+            config=fast_config(),
+            io=rig.io,
+            neighbor_updates_queue=q,
+        )
+        rig.sparks["a"].start()
+        rig.up_interface("a", "if1")
+        await clock.run_for(5.0)
+        ups = [
+            e
+            for e in rig.drain_events("b")
+            if e.event_type == NeighborEventType.NEIGHBOR_UP
+        ]
+        assert len(ups) == 1  # adjacency re-established, no DOWN in between
+        await rig.stop()
+
+    run(main())
+
+
+def test_graceful_restart_expiry_brings_neighbor_down():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        rig.drain_events("b")
+        await rig.sparks["a"].stop_gracefully()
+        await rig.sparks["a"].stop()
+        rig.io.unregister("a")
+        # GR hold is 6s
+        await clock.run_for(10.0)
+        evs = [e.event_type for e in rig.drain_events("b")]
+        assert evs == [
+            NeighborEventType.NEIGHBOR_RESTARTING,
+            NeighborEventType.NEIGHBOR_DOWN,
+        ]
+        await rig.stop()
+
+    run(main())
+
+
+def test_interface_down_brings_neighbors_down():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        rig.drain_events("a")
+        # empty interface db: if1 is gone
+        rig.sparks["a"]._on_interface_db(InterfaceDatabase(interfaces={}))
+        await clock.run_for(1.0)
+        downs = [e.event_type for e in rig.drain_events("a")]
+        assert downs == [NeighborEventType.NEIGHBOR_DOWN]
+        await rig.stop()
+
+    run(main())
+
+
+def test_area_mismatch_blocks_adjacency():
+    async def main():
+        clock = SimClock()
+
+        def lookup(neighbor, if_name):
+            # a puts everyone in area "X"; b puts everyone in area "Y"
+            return {"a": "Y", "b": "X"}[neighbor]
+
+        rig = Rig(clock, ["a", "b"], area_lookup=lookup)
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(10.0)
+        assert rig.drain_events("a") == []
+        assert rig.drain_events("b") == []
+        states = [n.state for n in rig.sparks["a"].get_neighbors()]
+        assert SparkNeighState.ESTABLISHED not in states
+        assert rig.sparks["a"].counters.get("spark.handshake.area_mismatch") > 0
+        await rig.stop()
+
+    run(main())
+
+
+def test_rtt_measured_from_link_latency():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2", latency=0.005)  # 5ms one way
+        await clock.run_for(8.0)
+        n = rig.sparks["a"].get_neighbors()[0]
+        assert n.rtt_us == pytest.approx(10_000, rel=0.3)  # ~10ms round trip
+        up = [
+            e
+            for e in rig.drain_events("a")
+            if e.event_type == NeighborEventType.NEIGHBOR_UP
+        ][0]
+        assert up.rtt_us > 0
+        await rig.stop()
+
+    run(main())
+
+
+def test_neighbor_discovered_initialization_event():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(20.0)
+        assert InitializationEvent.NEIGHBOR_DISCOVERED in rig.init_events["a"]
+        assert rig.init_events["a"].count(InitializationEvent.NEIGHBOR_DISCOVERED) == 1
+        await rig.stop()
+
+    run(main())
+
+
+def test_malformed_packet_counted_not_crashing():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a"])
+        rig.up_interface("a", "if1")
+        await rig.sparks["a"]._on_packet("if1", {"kind": "garbage", "body": {}}, 0.0)
+        await rig.sparks["a"]._on_packet("if1", {"nonsense": 1}, 0.0)
+        assert rig.sparks["a"].counters.get("spark.packet_parse_error") == 2
+        await rig.stop()
+
+    run(main())
+
+
+def test_three_nodes_on_shared_segment():
+    """Multicast semantics: three nodes on one L2 segment all peer."""
+
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b", "c"])
+        # full mesh of if pairs simulates a shared segment
+        rig.io.connect_pair("a", "if1", "b", "if2")
+        rig.io.connect_pair("a", "if1", "c", "if3")
+        rig.io.connect_pair("b", "if2", "c", "if3")
+        rig.up_interface("a", "if1")
+        rig.up_interface("b", "if2")
+        rig.up_interface("c", "if3")
+        await clock.run_for(8.0)
+        for node in ("a", "b", "c"):
+            neighbors = {
+                n.node_name: n.state for n in rig.sparks[node].get_neighbors()
+            }
+            assert len(neighbors) == 2, (node, neighbors)
+            assert all(
+                s == SparkNeighState.ESTABLISHED for s in neighbors.values()
+            ), (node, neighbors)
+        await rig.stop()
+
+    run(main())
+
+
+def test_warm_neighbor_expires_on_unidirectional_link():
+    """A neighbor we hear but who never hears us must not park in WARM
+    forever (state leak on transient/one-way peers)."""
+
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        rig.io.connect_pair("a", "if1", "b", "if2")
+        rig.up_interface("a", "if1")
+        rig.up_interface("b", "if2")
+        # b -> a works; a -> b drops: b never sees a's hellos reflected
+        rig.io._partitioned.add(("a", "b"))  # a's packets to b dropped
+        await clock.run_for(3.0)
+        # a heard b -> WARM entry exists
+        states = [n.state for n in rig.sparks["a"].get_neighbors()]
+        assert states == [SparkNeighState.WARM]
+        # b vanishes entirely; the WARM entry must be reaped by GR hold (6s)
+        await rig.sparks["b"].stop()
+        await clock.run_for(10.0)
+        assert rig.sparks["a"].get_neighbors() == []
+        assert rig.drain_events("a") == []  # never up -> no DOWN event
+        await rig.stop()
+
+    run(main())
+
+
+def test_interface_down_during_peer_restart_reports_down():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        rig.drain_events("b")
+        await rig.sparks["a"].stop_gracefully()
+        await clock.run_for(1.0)
+        assert rig.sparks["b"].get_neighbors()[0].state == SparkNeighState.RESTART
+        rig.drain_events("b")
+        # b's interface goes away while holding the restarting adjacency
+        rig.sparks["b"]._on_interface_db(InterfaceDatabase(interfaces={}))
+        await clock.run_for(1.0)
+        evs = [e.event_type for e in rig.drain_events("b")]
+        assert evs == [NeighborEventType.NEIGHBOR_DOWN]
+        await rig.stop()
+
+    run(main())
+
+
+def test_stopped_spark_ignores_inbound():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        await rig.sparks["a"].stop()
+        sent_before = rig.io.packets_sent
+        await clock.run_for(30.0)
+        # a must not participate: no handshake/hello from a anymore
+        a_neighbors = rig.sparks["a"].get_neighbors()
+        for n in a_neighbors:
+            assert n.state != SparkNeighState.NEGOTIATE
+        # b times a out and tears down
+        assert any(
+            e.event_type == NeighborEventType.NEIGHBOR_DOWN
+            for e in rig.drain_events("b")
+        )
+        await rig.stop()
+
+    run(main())
+
+
+def test_neighbor_discovered_at_min_window_when_adjacency_early():
+    async def main():
+        clock = SimClock()
+        # min 1s; adjacency establishes ~1.5s with fast-init hellos
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(3.0)  # well before max window (5s)
+        assert InitializationEvent.NEIGHBOR_DISCOVERED in rig.init_events["a"]
+        await rig.stop()
+
+    run(main())
