@@ -1,0 +1,247 @@
+"""Decision compute backends: scalar (host) and TPU (batched kernels).
+
+The backend seam is exactly the reference's pure-compute boundary
+(SpfSolver takes LinkState/PrefixState in, RouteDb out, SpfSolver.h:136).
+`ScalarBackend` wraps the oracle SpfSolver.  `TpuBackend` runs the fused
+``spf_and_select`` kernel for the SP_ECMP single-area fast path and
+decodes device outputs back into RibUnicastEntries; KSP2 prefixes,
+multi-area selection, static routes, and MPLS label routes go through the
+scalar solver (they are small; the per-prefix SPF fan-out is what needed
+the device).  Both backends must produce identical RouteDbs — enforced by
+differential tests.
+"""
+
+from __future__ import annotations
+
+import copy
+import ipaddress
+from typing import Dict, Optional
+
+import numpy as np
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import DecisionRouteDb, RibUnicastEntry
+from openr_tpu.decision.spf_solver import SpfSolver, select_best_node_area
+from openr_tpu.types import (
+    NextHop,
+    PrefixForwardingAlgorithm,
+    RouteComputationRules,
+)
+
+
+class DecisionBackend:
+    def build_route_db(
+        self,
+        area_link_states: Dict[str, LinkState],
+        prefix_state: PrefixState,
+    ) -> Optional[DecisionRouteDb]:
+        raise NotImplementedError
+
+
+class ScalarBackend(DecisionBackend):
+    def __init__(self, solver: SpfSolver) -> None:
+        self.solver = solver
+
+    def build_route_db(self, area_link_states, prefix_state):
+        return self.solver.build_route_db(area_link_states, prefix_state)
+
+
+class TpuBackend(DecisionBackend):
+    """Device-accelerated buildRouteDb.
+
+    Topology and candidate tables are padded to buckets so the jit cache
+    stays warm across LSDB churn (SURVEY §7 hard-part 4).
+    """
+
+    def __init__(
+        self,
+        solver: SpfSolver,
+        node_buckets=(16, 64, 256, 1024, 4096),
+        cand_bucket: int = 8,
+    ) -> None:
+        self.solver = solver  # scalar fallback + MPLS/static/KSP2
+        self.node_buckets = tuple(node_buckets)
+        self.cand_bucket = cand_bucket
+        self.num_device_builds = 0
+        self.num_scalar_builds = 0
+
+    def build_route_db(self, area_link_states, prefix_state):
+        # the device kernel implements the default selection semantics
+        # (enabled best-route selection, SHORTEST_DISTANCE); anything else —
+        # and multi-area, where selection is global across areas — goes
+        # through the scalar oracle for exactness
+        if (
+            len(area_link_states) != 1
+            or not self.solver.enable_best_route_selection
+            or self.solver.route_selection_algorithm
+            != RouteComputationRules.SHORTEST_DISTANCE
+        ):
+            self.num_scalar_builds += 1
+            return self.solver.build_route_db(area_link_states, prefix_state)
+        try:
+            return self._build_single_area(area_link_states, prefix_state)
+        except ValueError:
+            # e.g. a prefix with more candidates than the device bucket —
+            # fall back rather than wedging the rebuild loop
+            self.num_scalar_builds += 1
+            return self.solver.build_route_db(area_link_states, prefix_state)
+
+    def _build_single_area(self, area_link_states, prefix_state):
+        import jax.numpy as jnp
+
+        from openr_tpu.ops.csr import encode_link_state, encode_prefix_candidates
+        from openr_tpu.ops.route_select import spf_and_select
+
+        (area, link_state), = area_link_states.items()
+        me = self.solver.my_node_name
+        if not link_state.has_node(me):
+            return None
+
+        topo = encode_link_state(link_state, node_buckets=self.node_buckets)
+        if me not in topo.node_ids:
+            return None
+        cands = encode_prefix_candidates(
+            prefix_state, topo, area, max_candidates=self.cand_bucket
+        )
+        prefixes = cands.prefixes
+        # separate KSP2 prefixes: scalar path
+        ksp2 = set()
+        for prefix, entries in prefix_state.prefixes().items():
+            if any(
+                e.forwarding_algorithm == PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                for e in entries.values()
+            ):
+                ksp2.add(prefix)
+
+        D = max(topo.max_out_degree(), 1)
+        valid, metric, nh_out, num_nh, winners = spf_and_select(
+            jnp.asarray(topo.src),
+            jnp.asarray(topo.dst),
+            jnp.asarray(topo.w),
+            jnp.asarray(topo.edge_ok),
+            jnp.ones((1, topo.padded_edges), bool),
+            jnp.asarray(topo.overloaded)[None],
+            jnp.asarray(topo.soft)[None],
+            jnp.asarray([topo.node_id(me)], jnp.int32),
+            jnp.asarray(cands.cand_node),
+            jnp.asarray(cands.cand_ok),
+            jnp.asarray(cands.drain_metric),
+            jnp.asarray(cands.path_pref),
+            jnp.asarray(cands.source_pref),
+            jnp.asarray(cands.distance),
+            jnp.asarray(cands.min_nexthop),
+            max_degree=D,
+        )
+        self.num_device_builds += 1
+        valid = np.asarray(valid)[0]
+        metric = np.asarray(metric)[0]
+        nh_out = np.asarray(nh_out)[0]
+        winners = np.asarray(winners)[0]
+
+        out_edges = topo.root_out_edges(me)
+        route_db = DecisionRouteDb()
+        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+        for p, prefix in enumerate(prefixes):
+            if prefix in ksp2:
+                entry = self.solver.create_route_for_prefix(
+                    prefix, area_link_states, prefix_state
+                )
+                if entry is not None:
+                    route_db.add_unicast_route(entry)
+                continue
+            if ipaddress.ip_network(prefix).version == 4 and not v4_ok:
+                continue
+            if not valid[p]:
+                continue
+            entry = self._decode_route(
+                prefix,
+                p,
+                metric,
+                nh_out,
+                winners,
+                cands,
+                out_edges,
+                area,
+                topo,
+                link_state,
+                prefix_state,
+            )
+            if entry is not None:
+                route_db.add_unicast_route(entry)
+
+        # static-route overlay + MPLS labels: scalar (small)
+        for prefix, sentry in self.solver.get_static_routes().items():
+            if prefix not in route_db.unicast_routes:
+                route_db.add_unicast_route(sentry)
+        if self.solver.enable_node_segment_label:
+            self.solver._build_node_label_routes(area_link_states, route_db)
+        return route_db
+
+    def _decode_route(
+        self,
+        prefix,
+        p,
+        metric,
+        nh_out,
+        winners,
+        cands,
+        out_edges,
+        area,
+        topo,
+        link_state,
+        prefix_state,
+    ) -> Optional[RibUnicastEntry]:
+        me = self.solver.my_node_name
+        entries = prefix_state.prefixes().get(prefix, {})
+        # winner candidates → (node, area) set
+        all_node_areas = set()
+        for c in range(cands.cand_node.shape[1]):
+            if winners[p, c]:
+                node_id = int(cands.cand_node[p, c])
+                all_node_areas.add((topo.id_to_node[node_id], area))
+        if not all_node_areas:
+            return None
+        best_node_area = select_best_node_area(all_node_areas, me)
+        best = entries.get(best_node_area)
+        if best is None:
+            return None
+        is_v4 = ipaddress.ip_network(prefix).version == 4
+        nexthops = set()
+        igp = float(metric[p])
+        for lane, (link, neighbor) in enumerate(out_edges):
+            if lane >= nh_out.shape[1] or not nh_out[p, lane]:
+                continue
+            nexthops.add(
+                NextHop(
+                    address=(
+                        link.get_nh_v4_from_node(me)
+                        if is_v4 and not self.solver.v4_over_v6_nexthop
+                        else link.get_nh_v6_from_node(me)
+                    ),
+                    if_name=link.get_iface_from_node(me),
+                    metric=int(igp),
+                    area=link.area,
+                    neighbor_node_name=neighbor,
+                )
+            )
+        if not nexthops:
+            return None
+        entry = copy.deepcopy(best)
+        if self.solver._is_node_drained(best_node_area, {area: link_state}):
+            entry.metrics = type(entry.metrics)(
+                version=entry.metrics.version,
+                drain_metric=1,
+                path_preference=entry.metrics.path_preference,
+                source_preference=entry.metrics.source_preference,
+                distance=entry.metrics.distance,
+            )
+        local_considered = any(n == me for (n, _a) in entries.keys())
+        return RibUnicastEntry(
+            prefix=prefix,
+            nexthops=nexthops,
+            best_prefix_entry=entry,
+            best_area=best_node_area[1],
+            igp_cost=igp,
+            local_prefix_considered=local_considered,
+        )

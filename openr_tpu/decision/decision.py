@@ -1,0 +1,323 @@
+"""Decision — LSDB consumption, debounced route rebuild, RIB publication.
+
+Reference: openr/decision/Decision.{h,cpp}:
+  * consumes KvStore publications (via the Dispatcher, ``adj:`` +
+    ``prefix:`` keys) → per-area LinkState + global PrefixState
+    (updateKeyInLsdb/deleteKeyFromLsdb, Decision.cpp:711-820)
+  * debounced rebuild (AsyncDebounce 10–250 ms, Decision.cpp:114-120)
+  * initialization gating: the first build waits for KVSTORE_SYNCED +
+    static routes, force-unblocked after unblock_initial_routes_ms
+    (Decision.cpp:963-1011); the first publication is FULL_SYNC, then
+    incremental deltas
+  * static routes from PrefixManager (staticRouteUpdatesQueue)
+  * RibPolicy application before publishing + TTL'd persistence
+    (Decision.cpp:634-708, 917-950)
+  * PerfEvents breadcrumbs carried LSDB → RIB for convergence tracing
+  * RIB_COMPUTED initialization event after the first build
+
+The compute itself runs behind a DecisionBackend (scalar oracle or TPU
+batched kernels) — the seam BASELINE.json pins at the plugin boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional, Set
+
+from openr_tpu import constants as C
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.common.utils import AsyncDebounce
+from openr_tpu.config import DecisionConfig
+from openr_tpu.decision.backend import DecisionBackend, ScalarBackend
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import (
+    DecisionRouteDb,
+    DecisionRouteUpdate,
+    DecisionRouteUpdateType,
+)
+from openr_tpu.decision.rib_policy import RibPolicy
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+from openr_tpu.types import (
+    AdjacencyDatabase,
+    InitializationEvent,
+    PerfEvents,
+    PrefixDatabase,
+    Publication,
+    parse_adj_key,
+    parse_prefix_key,
+)
+
+
+def deserialize_adj_db(data: bytes) -> AdjacencyDatabase:
+    return AdjacencyDatabase.from_wire(json.loads(data.decode()))
+
+
+def deserialize_prefix_db(data: bytes) -> PrefixDatabase:
+    return PrefixDatabase.from_wire(json.loads(data.decode()))
+
+
+class Decision(Actor):
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config: DecisionConfig,
+        route_updates_queue: ReplicateQueue,
+        kv_store_updates_reader: Optional[RQueue] = None,
+        static_routes_reader: Optional[RQueue] = None,
+        backend: Optional[DecisionBackend] = None,
+        solver: Optional[SpfSolver] = None,
+        initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
+        counters: Optional[CounterMap] = None,
+        rib_policy_file: str = "",
+    ) -> None:
+        super().__init__("decision", clock, counters)
+        self.node_name = node_name
+        self.config = config
+        self.route_updates_queue = route_updates_queue
+        self.kv_store_updates_reader = kv_store_updates_reader
+        self.static_routes_reader = static_routes_reader
+        self.solver = solver or SpfSolver(node_name)
+        self.backend = backend or ScalarBackend(self.solver)
+        self.initialization_cb = initialization_cb
+        self.rib_policy_file = rib_policy_file
+        self.area_link_states: Dict[str, LinkState] = {}
+        self.prefix_state = PrefixState()
+        self.route_db = DecisionRouteDb()
+        self.rib_policy: Optional[RibPolicy] = None
+        self.pending_perf_events: Optional[PerfEvents] = None
+        # initialization gating (Decision.cpp:963-1011)
+        self._kvstore_synced = False
+        self._unblocked = False
+        self._first_build_done = False
+        self._rebuild_pending = False
+        self._debounce = AsyncDebounce(
+            self,
+            config.debounce_min_ms / 1000.0,
+            config.debounce_max_ms / 1000.0,
+            self._rebuild_routes,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self.kv_store_updates_reader is not None:
+            self.spawn_queue_loop(
+                self.kv_store_updates_reader, self._on_publication, "decision.kv"
+            )
+        if self.static_routes_reader is not None:
+            self.spawn_queue_loop(
+                self.static_routes_reader, self._on_static_routes, "decision.static"
+            )
+        self._load_rib_policy()
+        # forced unblock of the initial build (unblock_initial_routes_ms)
+        self.schedule(
+            self.config.unblock_initial_routes_ms / 1000.0, self._force_unblock
+        )
+
+    def on_initialization_event(self, ev: InitializationEvent) -> None:
+        """Wired by the daemon: KVSTORE_SYNCED gates the initial build."""
+        if ev == InitializationEvent.KVSTORE_SYNCED:
+            self._kvstore_synced = True
+            self._maybe_unblock()
+
+    def _maybe_unblock(self) -> None:
+        if self._unblocked or not self._kvstore_synced:
+            return
+        self._unblocked = True
+        if self._rebuild_pending or not self._first_build_done:
+            self._debounce()
+
+    def _force_unblock(self) -> None:
+        if not self._unblocked:
+            self.counters.bump("decision.forced_initial_unblock")
+            self._unblocked = True
+            self._debounce()
+
+    # -- LSDB updates (processPublication, Decision.cpp:822) ---------------
+
+    def _get_link_state(self, area: str) -> LinkState:
+        if area not in self.area_link_states:
+            self.area_link_states[area] = LinkState(area, self.node_name)
+        return self.area_link_states[area]
+
+    def _on_publication(self, pub: Publication) -> None:
+        changed = False
+        area = pub.area
+        for key, value in pub.key_vals.items():
+            if value.value is None:
+                continue  # ttl-refresh only
+            changed |= self._update_key(area, key, value.value)
+        for key in pub.expired_keys:
+            changed |= self._delete_key(area, key)
+        if changed:
+            self.counters.bump("decision.lsdb_updates")
+            self._rebuild_pending = True
+            if self._unblocked:
+                self._debounce()
+
+    def _update_key(self, area: str, key: str, data: bytes) -> bool:
+        node = parse_adj_key(key)
+        if node is not None:
+            try:
+                adj_db = deserialize_adj_db(data)
+            except Exception:  # noqa: BLE001
+                self.counters.bump("decision.parse_errors")
+                return False
+            if adj_db.perf_events is not None:
+                self.pending_perf_events = adj_db.perf_events
+            ls = self._get_link_state(area)
+            change = ls.update_adjacency_database(adj_db)
+            return change.topology_changed or change.node_label_changed
+        parsed = parse_prefix_key(key)
+        if parsed is not None:
+            origin_node, prefix = parsed
+            try:
+                prefix_db = deserialize_prefix_db(data)
+            except Exception:  # noqa: BLE001
+                self.counters.bump("decision.parse_errors")
+                return False
+            if prefix_db.delete_prefix or not prefix_db.prefix_entries:
+                return bool(
+                    self.prefix_state.delete_prefix(origin_node, area, prefix)
+                )
+            changed = False
+            for entry in prefix_db.prefix_entries:
+                changed |= bool(
+                    self.prefix_state.update_prefix(origin_node, area, entry)
+                )
+            return changed
+        return False
+
+    def _delete_key(self, area: str, key: str) -> bool:
+        node = parse_adj_key(key)
+        if node is not None:
+            ls = self._get_link_state(area)
+            return ls.delete_adjacency_database(node).topology_changed
+        parsed = parse_prefix_key(key)
+        if parsed is not None:
+            origin_node, prefix = parsed
+            return bool(self.prefix_state.delete_prefix(origin_node, area, prefix))
+        return False
+
+    # -- static routes (PrefixManager originated w/ install_to_fib) --------
+
+    def _on_static_routes(self, update: DecisionRouteUpdate) -> None:
+        self.solver.update_static_unicast_routes(
+            update.unicast_routes_to_update,
+            update.unicast_routes_to_delete,
+        )
+        self._rebuild_pending = True
+        if self._unblocked:
+            self._debounce()
+
+    # -- rebuild (rebuildRoutes, Decision.cpp:885) -------------------------
+
+    def _rebuild_routes(self) -> None:
+        if not self._unblocked:
+            return
+        self._rebuild_pending = False
+        t0 = self.clock.now()
+        new_db = self.backend.build_route_db(
+            self.area_link_states, self.prefix_state
+        )
+        self.counters.bump("decision.route_build_runs")
+        if new_db is None:
+            return
+        if self.rib_policy is not None and self.rib_policy.is_active(self.clock):
+            self.rib_policy.apply_policy(new_db, self.clock)
+        update = self.route_db.calculate_update(new_db)
+        first = not self._first_build_done
+        if first:
+            update = DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update=dict(new_db.unicast_routes),
+                mpls_routes_to_update=dict(new_db.mpls_routes),
+            )
+        self.route_db = new_db
+        self.counters.set(
+            "decision.route_build_ms", (self.clock.now() - t0) * 1000.0
+        )
+        self.counters.set(
+            "decision.num_routes", len(new_db.unicast_routes)
+        )
+        if first or not update.empty():
+            pe = self.pending_perf_events or PerfEvents()
+            pe.add(self.node_name, "DECISION_ROUTE_BUILD", self.clock.now_ms())
+            update.perf_events = pe
+            self.pending_perf_events = None
+            self.route_updates_queue.push(update)
+        if first:
+            self._first_build_done = True
+            if self.initialization_cb is not None:
+                self.initialization_cb(InitializationEvent.RIB_COMPUTED)
+
+    # -- RibPolicy API (setRibPolicy, Decision.cpp:634) --------------------
+
+    def set_rib_policy(self, policy: RibPolicy) -> None:
+        self.rib_policy = policy
+        self._save_rib_policy()
+        self._rebuild_pending = True
+        if self._unblocked:
+            self._debounce()
+
+    def get_rib_policy(self) -> Optional[RibPolicy]:
+        return self.rib_policy
+
+    def clear_rib_policy(self) -> None:
+        self.rib_policy = None
+        if self.rib_policy_file and os.path.exists(self.rib_policy_file):
+            os.unlink(self.rib_policy_file)
+        self._rebuild_pending = True
+        if self._unblocked:
+            self._debounce()
+
+    def _save_rib_policy(self) -> None:
+        if not self.rib_policy_file or self.rib_policy is None:
+            return
+        with open(self.rib_policy_file, "w") as f:
+            f.write(self.rib_policy.to_json(self.clock))
+
+    def _load_rib_policy(self) -> None:
+        if not self.rib_policy_file or not os.path.exists(self.rib_policy_file):
+            return
+        try:
+            with open(self.rib_policy_file) as f:
+                self.rib_policy = RibPolicy.from_json(f.read(), self.clock)
+        except (ValueError, KeyError):
+            self.counters.bump("decision.rib_policy_load_errors")
+
+    # -- ctrl surface ------------------------------------------------------
+
+    def get_route_db(self) -> DecisionRouteDb:
+        return self.route_db
+
+    def get_adj_dbs(self, area: Optional[str] = None) -> List[AdjacencyDatabase]:
+        out = []
+        for a, ls in self.area_link_states.items():
+            if area is not None and a != area:
+                continue
+            out.extend(ls.get_adjacency_databases().values())
+        return out
+
+    def get_received_routes(self) -> Dict[str, dict]:
+        return {
+            prefix: {f"{n}@{a}": e.to_wire() for (n, a), e in entries.items()}
+            for prefix, entries in self.prefix_state.prefixes().items()
+        }
+
+    def compute_route_db_for_node(self, node: str) -> Optional[DecisionRouteDb]:
+        """What-if: the RouteDb as `node` would compute it
+        (getRouteDbComputed ctrl API)."""
+        solver = SpfSolver(
+            node,
+            enable_v4=self.solver.enable_v4,
+            enable_node_segment_label=self.solver.enable_node_segment_label,
+            enable_best_route_selection=self.solver.enable_best_route_selection,
+            v4_over_v6_nexthop=self.solver.v4_over_v6_nexthop,
+            route_selection_algorithm=self.solver.route_selection_algorithm,
+        )
+        return solver.build_route_db(self.area_link_states, self.prefix_state)

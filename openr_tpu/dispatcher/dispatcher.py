@@ -1,0 +1,78 @@
+"""Dispatcher — KvStore publication fan-out with key-prefix filtering.
+
+Reference: openr/dispatcher/Dispatcher.{h,cpp} + DispatcherQueue: sits
+between KvStore and its subscribers, replicating each publication to
+readers whose key-prefix filter matches at least one key (e.g. Decision
+subscribes to ``adj:`` + ``prefix:``, PrefixManager to ``prefix:`` —
+Main.cpp:316-326).  Publications are *narrowed* per subscriber: only
+matching key_vals/expired_keys are delivered.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+from openr_tpu.types import Publication
+
+
+class Dispatcher(Actor):
+    def __init__(
+        self,
+        clock: Clock,
+        kv_store_updates_reader: RQueue,
+        counters: Optional[CounterMap] = None,
+    ) -> None:
+        super().__init__("dispatcher", clock, counters)
+        self.kv_store_updates_reader = kv_store_updates_reader
+        #: (prefixes, queue) per subscriber
+        self._subscribers: List[Tuple[Tuple[str, ...], ReplicateQueue]] = []
+
+    def get_reader(
+        self, key_prefixes: Sequence[str] = (), name: str = ""
+    ) -> RQueue:
+        """Subscribe with key-prefix filters; empty = everything
+        (Dispatcher.h:53-54)."""
+        q = ReplicateQueue(name or f"dispatcher.sub{len(self._subscribers)}")
+        reader = q.get_reader(name=name)
+        self._subscribers.append((tuple(key_prefixes), q))
+        return reader
+
+    def start(self) -> None:
+        self.spawn_queue_loop(
+            self.kv_store_updates_reader, self._on_publication, "dispatcher.main"
+        )
+
+    def _on_publication(self, pub: Publication) -> None:
+        self.counters.bump("dispatcher.publications")
+        for prefixes, q in self._subscribers:
+            filtered = self._filter(pub, prefixes)
+            if filtered is not None:
+                q.push(filtered)
+
+    @staticmethod
+    def _filter(pub: Publication, prefixes: Tuple[str, ...]) -> Optional[Publication]:
+        if not prefixes:
+            return pub
+        kv = {
+            k: v
+            for k, v in pub.key_vals.items()
+            if any(k.startswith(p) for p in prefixes)
+        }
+        expired = [
+            k for k in pub.expired_keys if any(k.startswith(p) for p in prefixes)
+        ]
+        if not kv and not expired:
+            return None
+        return Publication(
+            key_vals=kv,
+            expired_keys=expired,
+            node_ids=pub.node_ids,
+            area=pub.area,
+            timestamp_ms=pub.timestamp_ms,
+        )
+
+    def get_filters(self) -> List[Tuple[str, ...]]:
+        """ctrl surface: per-subscriber filter dump (Dispatcher.h:53)."""
+        return [p for p, _ in self._subscribers]

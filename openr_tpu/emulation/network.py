@@ -1,0 +1,183 @@
+"""In-process multi-node emulation — the OpenrWrapper/OpenrSystemTest
+harness (reference: openr/tests/OpenrWrapper.h:37, OpenrSystemTest.cpp).
+
+Runs N complete OpenrNodes in one process over a simulated network
+(MockIoProvider for Spark multicast, InProcessTransport for KvStore RPC)
+with virtual time: whole-network convergence scenarios execute
+deterministically in milliseconds of wall clock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.common.runtime import Clock
+from openr_tpu.config import OpenrConfig, SparkConfig
+from openr_tpu.emulation.topology import Edge, if_name
+from openr_tpu.fib.fib import MockFibAgent
+from openr_tpu.kvstore.transport import InProcessTransport
+from openr_tpu.main import OpenrNode
+from openr_tpu.spark.io_provider import MockIoProvider
+from openr_tpu.types import InterfaceDatabase, InterfaceInfo, PrefixEntry
+
+
+def fast_spark_config() -> SparkConfig:
+    """Accelerated timers for emulation (the reference system tests use
+    shortened timers too; defaults converge in ~3s, OpenrSystemTest.cpp:38)."""
+    return SparkConfig(
+        hello_time_s=2.0,
+        fastinit_hello_time_ms=200,
+        handshake_time_ms=200,
+        heartbeat_time_s=1.0,
+        hold_time_s=3.0,
+        graceful_restart_time_s=6.0,
+        min_neighbor_discovery_interval_s=0.5,
+        max_neighbor_discovery_interval_s=4.0,
+    )
+
+
+class EmulatedNetwork:
+    """N OpenrNodes over a simulated network."""
+
+    def __init__(
+        self,
+        clock: Clock,
+        link_latency_s: float = 0.002,
+        kv_latency_s: float = 0.002,
+        use_tpu_backend: bool = False,
+        config_overrides=None,
+    ) -> None:
+        self.clock = clock
+        self.io = MockIoProvider(clock)
+        self.kv_transport = InProcessTransport(clock, latency_s=kv_latency_s)
+        self.link_latency_s = link_latency_s
+        self.use_tpu_backend = use_tpu_backend
+        self.config_overrides = config_overrides or (lambda cfg: None)
+        self.nodes: Dict[str, OpenrNode] = {}
+        self.agents: Dict[str, MockFibAgent] = {}
+        #: node -> {if_name -> InterfaceInfo}
+        self._interfaces: Dict[str, Dict[str, InterfaceInfo]] = {}
+        self._edges: List[Edge] = []
+
+    # -- construction ------------------------------------------------------
+
+    def add_node(self, name: str, config: Optional[OpenrConfig] = None) -> OpenrNode:
+        cfg = config or OpenrConfig(node_name=name)
+        cfg.node_name = name
+        cfg.spark_config = fast_spark_config()
+        cfg.decision_config.unblock_initial_routes_ms = 30_000
+        cfg.rib_policy_file = ""  # no cross-test persistence
+        self.config_overrides(cfg)
+        agent = MockFibAgent(self.clock)
+        node = OpenrNode(
+            config=cfg,
+            clock=self.clock,
+            io_provider=self.io,
+            kv_transport=self.kv_transport,
+            fib_agent=agent,
+            use_tpu_backend=self.use_tpu_backend,
+        )
+        self.kv_transport.register(name, node.kv_store)
+        self.nodes[name] = node
+        self.agents[name] = agent
+        self._interfaces[name] = {}
+        return node
+
+    def connect(self, a: str, b: str, latency_s: Optional[float] = None) -> None:
+        """Wire a point-to-point link a<->b (interfaces auto-named)."""
+        import zlib
+
+        ifa, ifb = if_name(a, b), if_name(b, a)
+        self.io.connect_pair(
+            a, ifa, b, ifb, latency_s if latency_s is not None else self.link_latency_s
+        )
+        # deterministic (crc32, not salted hash) and 32-bit-wide addresses
+        for node, ifn in ((a, ifa), (b, ifb)):
+            h = zlib.crc32(ifn.encode())
+            self._interfaces[node][ifn] = InterfaceInfo(
+                if_name=ifn,
+                is_up=True,
+                if_index=len(self._interfaces[node]) + 1,
+                networks=[f"fe80::{(h >> 16) & 0xFFFF:x}:{h & 0xFFFF:x}/64"],
+            )
+        self._edges.append((a, b, 1))
+
+    def build(self, edges: List[Edge]) -> None:
+        """Create nodes + links from an edge list (grid/fabric generators)."""
+        names = sorted({n for a, b, _ in edges for n in (a, b)})
+        for n in names:
+            self.add_node(n)
+        for a, b, _m in edges:
+            self.connect(a, b)
+
+    def start(self, advertise_loopbacks: bool = True) -> None:
+        for name, node in self.nodes.items():
+            node.start()
+            node.link_monitor.set_interfaces(
+                list(self._interfaces[name].values())
+            )
+            if advertise_loopbacks:
+                node.advertise_prefixes([PrefixEntry(self.loopback(name))])
+
+    @staticmethod
+    def loopback(name: str) -> str:
+        """Deterministic per-node loopback prefix."""
+        import zlib
+
+        h = zlib.crc32(name.encode())
+        return f"10.{(h >> 16) & 0xFF}.{(h >> 8) & 0xFF}.{h & 0xFF}/32"
+
+    # -- fault injection ---------------------------------------------------
+
+    def fail_link(self, a: str, b: str) -> None:
+        """Take the a<->b link down at both interfaces (netlink-down event)."""
+        ifa, ifb = if_name(a, b), if_name(b, a)
+        for node, ifn in ((a, ifa), (b, ifb)):
+            info = self._interfaces[node].get(ifn)
+            if info is not None:
+                info.is_up = False
+                self.nodes[node].link_monitor.set_interfaces(
+                    list(self._interfaces[node].values())
+                )
+
+    def restore_link(self, a: str, b: str) -> None:
+        ifa, ifb = if_name(a, b), if_name(b, a)
+        for node, ifn in ((a, ifa), (b, ifb)):
+            info = self._interfaces[node].get(ifn)
+            if info is not None:
+                info.is_up = True
+                self.nodes[node].link_monitor.set_interfaces(
+                    list(self._interfaces[node].values())
+                )
+
+    async def stop(self) -> None:
+        for node in self.nodes.values():
+            await node.stop()
+        await self.io.stop()
+
+    # -- assertions --------------------------------------------------------
+
+    def fib_routes(self, node: str) -> Dict[str, list]:
+        """Programmed routes at `node`: prefix -> sorted nexthop neighbor
+        names (from the mock agent = ground truth of programming)."""
+        agent = self.agents[node]
+        out = {}
+        for prefix, route in agent.unicast.items():
+            out[prefix] = sorted(
+                nh.neighbor_node_name for nh in route.next_hops
+            )
+        return out
+
+    def all_initialized(self) -> bool:
+        return all(n.initialized for n in self.nodes.values())
+
+    def converged_full_mesh(self) -> Tuple[bool, str]:
+        """Every node has a route to every other node's loopback."""
+        for src, node in self.nodes.items():
+            routes = self.fib_routes(src)
+            for dst in self.nodes:
+                if dst == src:
+                    continue
+                if self.loopback(dst) not in routes:
+                    return False, f"{src} missing route to {dst}"
+        return True, ""

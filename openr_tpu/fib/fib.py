@@ -1,0 +1,343 @@
+"""Fib — route programming with retries, sync, and dryrun.
+
+Reference: openr/fib/Fib.{h,cpp}: consumes DecisionRouteUpdates, programs
+them into a FibService agent (thrift to the platform in the reference; an
+abstract FibAgent here — Mock in-memory, Netlink via openr_tpu.platform,
+or dryrun log-only Fib.h:352), with:
+  * ordered programming: adds/updates immediately, deletes delayed by
+    route_delete_delay_ms (default 1s) to let penultimate hops reroute
+  * retry with exponential backoff on agent failure (retryRoutesTask,
+    Fib.cpp:983; Constants.h:81-82 8ms→4096ms)
+  * agent keepalive: aliveSince regression → full syncRoutes
+    (keepAliveTask, Fib.cpp:1057)
+  * publishes programmed deltas on fibRouteUpdatesQueue → PrefixManager
+  * streams updates to subscribers (ctrl surface)
+  * FIB_SYNCED initialization event after the first successful sync
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional
+
+from openr_tpu import constants as C
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.common.utils import ExponentialBackoff
+from openr_tpu.config import FibConfig
+from openr_tpu.decision.rib import (
+    DecisionRouteUpdate,
+    DecisionRouteUpdateType,
+    RibMplsEntry,
+    RibUnicastEntry,
+)
+from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+from openr_tpu.types import InitializationEvent, MplsRoute, UnicastRoute
+
+
+class FibAgentError(RuntimeError):
+    pass
+
+
+class FibAgent:
+    """Agent API (thrift FibService equivalent, if/Platform.thrift:78-160)."""
+
+    async def add_unicast_routes(self, routes: List[UnicastRoute]) -> None:
+        raise NotImplementedError
+
+    async def delete_unicast_routes(self, prefixes: List[str]) -> None:
+        raise NotImplementedError
+
+    async def add_mpls_routes(self, routes: List[MplsRoute]) -> None:
+        raise NotImplementedError
+
+    async def delete_mpls_routes(self, labels: List[int]) -> None:
+        raise NotImplementedError
+
+    async def sync_fib(
+        self, routes: List[UnicastRoute], mpls_routes: List[MplsRoute]
+    ) -> None:
+        raise NotImplementedError
+
+    async def alive_since(self) -> float:
+        raise NotImplementedError
+
+
+class MockFibAgent(FibAgent):
+    """In-memory agent (tests/mocks/MockNetlinkFibHandler.h pattern):
+    holds programmed state, supports failure injection and restart
+    simulation."""
+
+    def __init__(self, clock: Clock) -> None:
+        self.clock = clock
+        self.unicast: Dict[str, UnicastRoute] = {}
+        self.mpls: Dict[int, MplsRoute] = {}
+        self._alive_since = clock.now()
+        self.fail = False
+        self.num_sync = 0
+        self.num_add = 0
+        self.num_del = 0
+
+    def _check(self) -> None:
+        if self.fail:
+            raise FibAgentError("injected agent failure")
+
+    async def add_unicast_routes(self, routes: List[UnicastRoute]) -> None:
+        self._check()
+        self.num_add += len(routes)
+        for r in routes:
+            self.unicast[r.dest] = r
+
+    async def delete_unicast_routes(self, prefixes: List[str]) -> None:
+        self._check()
+        self.num_del += len(prefixes)
+        for p in prefixes:
+            self.unicast.pop(p, None)
+
+    async def add_mpls_routes(self, routes: List[MplsRoute]) -> None:
+        self._check()
+        for r in routes:
+            self.mpls[r.top_label] = r
+
+    async def delete_mpls_routes(self, labels: List[int]) -> None:
+        self._check()
+        for label in labels:
+            self.mpls.pop(label, None)
+
+    async def sync_fib(self, routes, mpls_routes) -> None:
+        self._check()
+        self.num_sync += 1
+        self.unicast = {r.dest: r for r in routes}
+        self.mpls = {r.top_label: r for r in mpls_routes}
+
+    async def alive_since(self) -> float:
+        self._check()
+        return self._alive_since
+
+    def restart(self) -> None:
+        """Simulate agent restart: programmed state lost, aliveSince bumps."""
+        self.unicast.clear()
+        self.mpls.clear()
+        self._alive_since = self.clock.now()
+
+
+class Fib(Actor):
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config: FibConfig,
+        agent: Optional[FibAgent],
+        route_updates_reader: RQueue,
+        fib_route_updates_queue: Optional[ReplicateQueue] = None,
+        initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
+        counters: Optional[CounterMap] = None,
+        dryrun: bool = False,
+    ) -> None:
+        super().__init__("fib", clock, counters)
+        self.node_name = node_name
+        self.config = config
+        self.agent = agent
+        self.dryrun = dryrun or agent is None
+        self.route_updates_reader = route_updates_reader
+        self.fib_route_updates_queue = fib_route_updates_queue
+        self.initialization_cb = initialization_cb
+        #: authoritative desired state (routeState_ in Fib.h)
+        self.unicast_routes: Dict[str, RibUnicastEntry] = {}
+        self.mpls_routes: Dict[int, RibMplsEntry] = {}
+        self._dirty = False  # programming failed; retry pending
+        self._backoff = ExponentialBackoff(
+            C.FIB_INITIAL_BACKOFF_S, C.FIB_MAX_BACKOFF_S, clock
+        )
+        self._synced = False
+        self._agent_alive_since: Optional[float] = None
+        self._retry_wakeup: Optional[asyncio.Future] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.spawn_queue_loop(
+            self.route_updates_reader, self._on_route_update, "fib.routes"
+        )
+        if not self.dryrun:
+            self.spawn(self._keepalive_loop(), name="fib.keepalive")
+            self.spawn(self._retry_loop(), name="fib.retry")
+
+    # -- route update processing (processDecisionRouteUpdate) --------------
+
+    async def _on_route_update(self, update: DecisionRouteUpdate) -> None:
+        if update.type == DecisionRouteUpdateType.FULL_SYNC:
+            self.unicast_routes = dict(update.unicast_routes_to_update)
+            self.mpls_routes = dict(update.mpls_routes_to_update)
+            await self._sync_routes()
+        else:
+            for prefix, entry in update.unicast_routes_to_update.items():
+                prior = self.unicast_routes.get(prefix)
+                self.unicast_routes[prefix] = entry
+                if (
+                    entry.do_not_install
+                    and prior is not None
+                    and not prior.do_not_install
+                ):
+                    # installed route flipped to do_not_install: withdraw it
+                    update.unicast_routes_to_delete.append(prefix)
+            for prefix in update.unicast_routes_to_delete:
+                if prefix not in update.unicast_routes_to_update:
+                    self.unicast_routes.pop(prefix, None)
+            for label, mentry in update.mpls_routes_to_update.items():
+                self.mpls_routes[label] = mentry
+            for label in update.mpls_routes_to_delete:
+                self.mpls_routes.pop(label, None)
+            await self._program_incremental(update)
+        # notify PrefixManager et al of (intended-as-)programmed routes
+        if self.fib_route_updates_queue is not None:
+            self.fib_route_updates_queue.push(update)
+        if update.perf_events is not None:
+            update.perf_events.add(
+                self.node_name, "FIB_ROUTES_PROGRAMMED", self.clock.now_ms()
+            )
+            self.counters.set(
+                "fib.convergence_time_ms", update.perf_events.total_duration_ms()
+            )
+
+    async def _program_incremental(self, update: DecisionRouteUpdate) -> None:
+        if self.dryrun:
+            self.counters.bump("fib.dryrun_updates")
+            self._mark_synced()
+            return
+        try:
+            adds = [
+                e.to_unicast_route()
+                for e in update.unicast_routes_to_update.values()
+                if not e.do_not_install
+            ]
+            if adds:
+                await self.agent.add_unicast_routes(adds)
+            if update.mpls_routes_to_update:
+                await self.agent.add_mpls_routes(
+                    [
+                        e.to_mpls_route()
+                        for e in update.mpls_routes_to_update.values()
+                    ]
+                )
+            # deletes are delayed to let the network reroute first
+            # (route_delete_delay_ms, OpenrConfig default 1s)
+            if update.unicast_routes_to_delete or update.mpls_routes_to_delete:
+                self.schedule(
+                    self.config.route_delete_delay_ms / 1000.0,
+                    lambda u=update: self._delayed_delete(u),
+                )
+            self._backoff.report_success()
+            self._mark_synced()
+        except FibAgentError:
+            self._mark_dirty()
+
+    def _delayed_delete(self, update: DecisionRouteUpdate):
+        async def _run():
+            try:
+                # skip deletes that were re-added as installable meanwhile
+                def still_wanted(p):
+                    e = self.unicast_routes.get(p)
+                    return e is not None and not e.do_not_install
+
+                dels = [
+                    p
+                    for p in update.unicast_routes_to_delete
+                    if not still_wanted(p)
+                ]
+                if dels:
+                    await self.agent.delete_unicast_routes(dels)
+                mdels = [
+                    l
+                    for l in update.mpls_routes_to_delete
+                    if l not in self.mpls_routes
+                ]
+                if mdels:
+                    await self.agent.delete_mpls_routes(mdels)
+            except FibAgentError:
+                self._mark_dirty()
+
+        return _run()
+
+    async def _sync_routes(self) -> None:
+        """Full state sync (syncRoutes, Fib.cpp:847)."""
+        if self.dryrun:
+            self.counters.bump("fib.dryrun_syncs")
+            self._mark_synced()
+            return
+        try:
+            await self.agent.sync_fib(
+                [
+                    e.to_unicast_route()
+                    for e in self.unicast_routes.values()
+                    if not e.do_not_install
+                ],
+                [e.to_mpls_route() for e in self.mpls_routes.values()],
+            )
+            self._backoff.report_success()
+            self.counters.bump("fib.num_sync")
+            self._mark_synced()
+        except FibAgentError:
+            self._mark_dirty()
+
+    def _mark_synced(self) -> None:
+        self._dirty = False
+        if not self._synced:
+            self._synced = True
+            if self.initialization_cb is not None:
+                self.initialization_cb(InitializationEvent.FIB_SYNCED)
+
+    def _mark_dirty(self) -> None:
+        self._dirty = True
+        self._backoff.report_error()
+        self.counters.bump("fib.programming_failures")
+        if self._retry_wakeup is not None and not self._retry_wakeup.done():
+            self._retry_wakeup.set_result(None)
+
+    # -- retry fiber (retryRoutesTask, Fib.cpp:983) ------------------------
+
+    async def _retry_loop(self) -> None:
+        while True:
+            if not self._dirty:
+                self._retry_wakeup = asyncio.get_running_loop().create_future()
+                await self._retry_wakeup
+            await self.clock.sleep(self._backoff.get_current_backoff())
+            if self._dirty:
+                await self._sync_routes()
+
+    # -- agent keepalive (keepAliveTask, Fib.cpp:1057) ---------------------
+
+    async def _keepalive_loop(self) -> None:
+        while True:
+            await self.clock.sleep(C.KEEP_ALIVE_CHECK_INTERVAL_S)
+            try:
+                alive = await self.agent.alive_since()
+            except FibAgentError:
+                continue
+            if self._agent_alive_since is None:
+                self._agent_alive_since = alive
+            elif alive != self._agent_alive_since:
+                # agent restarted: it lost all programmed state
+                self._agent_alive_since = alive
+                self.counters.bump("fib.agent_restarts")
+                await self._sync_routes()
+
+    # -- ctrl surface ------------------------------------------------------
+
+    def get_route_db(self) -> Dict[str, RibUnicastEntry]:
+        return dict(self.unicast_routes)
+
+    def get_mpls_route_db(self) -> Dict[int, RibMplsEntry]:
+        return dict(self.mpls_routes)
+
+    def get_unicast_routes_filtered(self, prefixes: List[str]) -> List[UnicastRoute]:
+        if not prefixes:
+            return [e.to_unicast_route() for e in self.unicast_routes.values()]
+        return [
+            e.to_unicast_route()
+            for p, e in self.unicast_routes.items()
+            if p in prefixes
+        ]
+
+    @property
+    def synced(self) -> bool:
+        return self._synced

@@ -1,0 +1,397 @@
+"""LinkMonitor — interface tracking, adjacency maintenance, drain ops.
+
+Reference: openr/link-monitor/LinkMonitor.{h,cpp}.  Responsibilities:
+  * track kernel interfaces (platform events + periodic sync) with
+    link-flap exponential backoff (OpenrConfig.thrift:119-146)
+  * publish the interface snapshot to Spark (interfaceUpdatesQueue)
+  * consume Spark NeighborEvents → per-area AdjacencyDatabase; advertise
+    ``adj:<node>`` into KvStore via kvRequestQueue (LinkMonitor.cpp:741)
+  * emit KvStore peer add/del on peerUpdatesQueue (restarting peers are
+    removed from flooding but their adjacency is held)
+  * drain operations: node overload (hard), node metric increment (soft),
+    per-link overload / metric override (LinkMonitor.h:107-150), persisted
+    across restarts via the config store
+  * RTT-based adjacency metric option (OpenrConfig.thrift:142-146)
+  * LINK_DISCOVERED initialization event after the first interface sync
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from openr_tpu import constants as C
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.common.utils import AsyncThrottle, ExponentialBackoff
+from openr_tpu.config import LinkMonitorConfig
+from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    InitializationEvent,
+    InterfaceDatabase,
+    InterfaceInfo,
+    KeyValueRequest,
+    KvRequestType,
+    NeighborEvent,
+    NeighborEventType,
+    PeerEvent,
+    PeerSpec,
+    PerfEvents,
+    adj_key,
+)
+
+
+def rtt_to_metric(rtt_us: int) -> int:
+    """RTT-proportional metric, 100us granularity (reference getRttMetric)."""
+    return max(1, rtt_us // 100)
+
+
+@dataclasses.dataclass
+class AdjacencyEntry:
+    """One established adjacency (link-monitor/AdjacencyEntry.h)."""
+
+    neighbor: str
+    area: str
+    local_if: str
+    remote_if: str
+    addr_v6: str = ""
+    addr_v4: str = ""
+    ctrl_port: int = 0
+    rtt_us: int = 0
+    metric_override: Optional[int] = None  # set-link-metric drain op
+    is_overloaded: bool = False  # link hard-drain
+    is_restarting: bool = False
+    adj_only_used_by_other_node: bool = False
+    timestamp: int = 0
+    adj_label: int = 0
+
+
+@dataclasses.dataclass
+class InterfaceEntry:
+    """Tracked interface w/ flap damping (link-monitor/InterfaceEntry.h)."""
+
+    info: InterfaceInfo
+    backoff: ExponentialBackoff = None  # type: ignore[assignment]
+    #: advertised to Spark only when up AND backoff inactive
+    active: bool = False
+    #: pending activation timer; re-flaps must cancel it or the stale timer
+    #: defeats the doubled damping window
+    activate_task: object = None
+
+
+class LinkMonitor(Actor):
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config: LinkMonitorConfig,
+        interface_updates_queue: ReplicateQueue,
+        peer_updates_queue: ReplicateQueue,
+        kv_request_queue: ReplicateQueue,
+        neighbor_updates_reader: Optional[RQueue] = None,
+        netlink_events_reader: Optional[RQueue] = None,
+        area_ids: Optional[List[str]] = None,
+        node_labels: Optional[Dict[str, int]] = None,  # area -> SR label
+        initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
+        counters: Optional[CounterMap] = None,
+        serialize_adj_db: Optional[Callable[[AdjacencyDatabase], bytes]] = None,
+    ) -> None:
+        super().__init__("link_monitor", clock, counters)
+        self.node_name = node_name
+        self.config = config
+        self.interface_updates_queue = interface_updates_queue
+        self.peer_updates_queue = peer_updates_queue
+        self.kv_request_queue = kv_request_queue
+        self.neighbor_updates_reader = neighbor_updates_reader
+        self.netlink_events_reader = netlink_events_reader
+        self.area_ids = area_ids or [C.DEFAULT_AREA]
+        self.node_labels = node_labels or {}
+        self.initialization_cb = initialization_cb
+        self.serialize_adj_db = serialize_adj_db or (
+            lambda db: __import__("json").dumps(db.to_wire()).encode()
+        )
+        self.interfaces: Dict[str, InterfaceEntry] = {}
+        #: (area, neighbor, local_if) -> AdjacencyEntry
+        self.adjacencies: Dict[Tuple[str, str, str], AdjacencyEntry] = {}
+        # drain state (persisted via config-store by the daemon wrapper)
+        self.node_overloaded = False
+        self.node_metric_increment = 0
+        self.link_overloads: Set[str] = set()  # if_names
+        self.link_metric_overrides: Dict[str, int] = {}
+        self._link_discovered_signaled = False
+        # throttles (Constants.h:95-100)
+        self._advertise_ifaces_throttle = AsyncThrottle(
+            self, C.LINK_THROTTLE_TIMEOUT_S, self._advertise_interfaces
+        )
+        self._advertise_adjs_throttle = AsyncThrottle(
+            self, C.ADJACENCY_THROTTLE_TIMEOUT_S, self._advertise_adjacencies
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self.neighbor_updates_reader is not None:
+            self.spawn_queue_loop(
+                self.neighbor_updates_reader, self._on_neighbor_event, "lm.neighbors"
+            )
+        if self.netlink_events_reader is not None:
+            self.spawn_queue_loop(
+                self.netlink_events_reader, self._on_interface_event, "lm.netlink"
+            )
+
+    # -- interface tracking ------------------------------------------------
+
+    def set_interfaces(self, infos: List[InterfaceInfo]) -> None:
+        """Full interface sync (platform getAllLinks); first call signals
+        LINK_DISCOVERED."""
+        seen = set()
+        for info in infos:
+            seen.add(info.if_name)
+            self._apply_interface(info)
+        for if_name in list(self.interfaces):
+            if if_name not in seen:
+                self._apply_interface(
+                    InterfaceInfo(if_name=if_name, is_up=False)
+                )
+        if not self._link_discovered_signaled:
+            self._link_discovered_signaled = True
+            if self.initialization_cb is not None:
+                self.initialization_cb(InitializationEvent.LINK_DISCOVERED)
+        self._advertise_ifaces_throttle()
+
+    def _on_interface_event(self, info: InterfaceInfo) -> None:
+        """Incremental netlink event."""
+        self._apply_interface(info)
+        self._advertise_ifaces_throttle()
+
+    def _apply_interface(self, info: InterfaceInfo) -> None:
+        entry = self.interfaces.get(info.if_name)
+        if entry is None:
+            entry = InterfaceEntry(
+                info=info,
+                backoff=ExponentialBackoff(
+                    self.config.linkflap_initial_backoff_ms / 1000.0,
+                    self.config.linkflap_max_backoff_ms / 1000.0,
+                    self.clock,
+                ),
+            )
+            self.interfaces[info.if_name] = entry
+            entry.active = info.is_up
+            return
+        was_up = entry.info.is_up
+        entry.info = info
+        if info.is_up and not was_up:
+            # flap damping: delay activation by current backoff
+            entry.backoff.report_error()
+            delay = entry.backoff.get_current_backoff()
+            self.counters.bump("link_monitor.link_flaps")
+            if entry.activate_task is not None:
+                entry.activate_task.cancel()
+            entry.activate_task = self.schedule(
+                delay, lambda e=entry: self._activate_interface(e)
+            )
+            entry.active = False
+        elif not info.is_up and was_up:
+            if entry.activate_task is not None:
+                entry.activate_task.cancel()
+                entry.activate_task = None
+            entry.active = False
+            # tear down adjacencies on this interface
+            for key, adj in list(self.adjacencies.items()):
+                if adj.local_if == info.if_name:
+                    self._remove_adjacency(key)
+
+    def _activate_interface(self, entry: InterfaceEntry) -> None:
+        if entry.info.is_up:
+            entry.active = True
+            self._advertise_ifaces_throttle()
+
+    def _advertise_interfaces(self) -> None:
+        db = InterfaceDatabase(
+            interfaces={
+                n: e.info for n, e in self.interfaces.items() if e.active
+            }
+        )
+        self.interface_updates_queue.push(db)
+
+    # -- neighbor events (LinkMonitor.h:176) -------------------------------
+
+    def _on_neighbor_event(self, ev: NeighborEvent) -> None:
+        key = (ev.area, ev.node_name, ev.local_if_name)
+        if ev.event_type == NeighborEventType.NEIGHBOR_UP:
+            self.adjacencies[key] = AdjacencyEntry(
+                neighbor=ev.node_name,
+                area=ev.area,
+                local_if=ev.local_if_name,
+                remote_if=ev.remote_if_name,
+                addr_v6=ev.neighbor_addr_v6,
+                addr_v4=ev.neighbor_addr_v4,
+                ctrl_port=ev.ctrl_port,
+                rtt_us=ev.rtt_us,
+                adj_only_used_by_other_node=ev.adj_only_used_by_other_node,
+                timestamp=int(self.clock.now()),
+            )
+            self._peer_up(ev)
+            self._advertise_adjs_throttle()
+        elif ev.event_type == NeighborEventType.NEIGHBOR_DOWN:
+            self._remove_adjacency(key)
+        elif ev.event_type == NeighborEventType.NEIGHBOR_RESTARTING:
+            adj = self.adjacencies.get(key)
+            if adj is not None:
+                adj.is_restarting = True
+            # remove from flooding topology while it restarts
+            self.peer_updates_queue.push(
+                PeerEvent(area=ev.area, peers_to_del=[ev.node_name])
+            )
+        elif ev.event_type == NeighborEventType.NEIGHBOR_RESTARTED:
+            adj = self.adjacencies.get(key)
+            if adj is not None:
+                adj.is_restarting = False
+            self._peer_up(ev)
+            self._advertise_adjs_throttle()
+        elif ev.event_type == NeighborEventType.NEIGHBOR_RTT_CHANGE:
+            adj = self.adjacencies.get(key)
+            if adj is not None:
+                adj.rtt_us = ev.rtt_us
+                if self.config.use_rtt_metric:
+                    self._advertise_adjs_throttle()
+        elif ev.event_type == NeighborEventType.NEIGHBOR_ADJ_SYNCED:
+            adj = self.adjacencies.get(key)
+            if adj is not None:
+                adj.adj_only_used_by_other_node = False
+                self._advertise_adjs_throttle()
+
+    def _peer_up(self, ev: NeighborEvent) -> None:
+        self.peer_updates_queue.push(
+            PeerEvent(
+                area=ev.area,
+                peers_to_add={
+                    ev.node_name: PeerSpec(
+                        peer_addr=ev.neighbor_addr_v6 or ev.node_name,
+                        ctrl_port=ev.ctrl_port,
+                    )
+                },
+            )
+        )
+
+    def _remove_adjacency(self, key: Tuple[str, str, str]) -> None:
+        adj = self.adjacencies.pop(key, None)
+        if adj is None:
+            return
+        # only delete the kvstore peer if no other adjacency to that node
+        # remains in the area
+        if not any(
+            a.neighbor == adj.neighbor and a.area == adj.area
+            for a in self.adjacencies.values()
+        ):
+            self.peer_updates_queue.push(
+                PeerEvent(area=adj.area, peers_to_del=[adj.neighbor])
+            )
+        self._advertise_adjs_throttle()
+
+    # -- adjacency advertisement (advertiseAdjacencies) --------------------
+
+    def _adjacency_metric(self, adj: AdjacencyEntry) -> int:
+        if adj.local_if in self.link_metric_overrides:
+            return self.link_metric_overrides[adj.local_if]
+        if adj.metric_override is not None:
+            return adj.metric_override
+        if self.config.use_rtt_metric and adj.rtt_us > 0:
+            return rtt_to_metric(adj.rtt_us)
+        return 1
+
+    def build_adjacency_database(self, area: str) -> AdjacencyDatabase:
+        adjacencies = []
+        for adj in self.adjacencies.values():
+            if adj.area != area:
+                continue
+            adjacencies.append(
+                Adjacency(
+                    other_node_name=adj.neighbor,
+                    if_name=adj.local_if,
+                    other_if_name=adj.remote_if,
+                    metric=self._adjacency_metric(adj),
+                    adj_label=adj.adj_label,
+                    is_overloaded=adj.is_overloaded
+                    or adj.local_if in self.link_overloads,
+                    rtt=adj.rtt_us,
+                    timestamp=adj.timestamp,
+                    next_hop_v6=adj.addr_v6,
+                    next_hop_v4=adj.addr_v4,
+                    adj_only_used_by_other_node=adj.adj_only_used_by_other_node,
+                )
+            )
+        adjacencies.sort(key=lambda a: (a.other_node_name, a.if_name))
+        db = AdjacencyDatabase(
+            this_node_name=self.node_name,
+            is_overloaded=self.node_overloaded,
+            adjacencies=adjacencies,
+            node_label=self.node_labels.get(area, 0),
+            area=area,
+            node_metric_increment_val=self.node_metric_increment,
+        )
+        pe = PerfEvents()
+        pe.add(self.node_name, "ADJ_DB_UPDATED", self.clock.now_ms())
+        db.perf_events = pe
+        return db
+
+    def _advertise_adjacencies(self) -> None:
+        for area in self.area_ids:
+            db = self.build_adjacency_database(area)
+            self.kv_request_queue.push(
+                KeyValueRequest(
+                    request_type=KvRequestType.PERSIST_KEY,
+                    area=area,
+                    key=adj_key(self.node_name),
+                    value=self.serialize_adj_db(db),
+                )
+            )
+        self.counters.bump("link_monitor.advertise_adj_db")
+
+    # -- drain / maintenance API (LinkMonitor.h:107-150) -------------------
+
+    def set_node_overload(self, overloaded: bool) -> None:
+        if self.node_overloaded != overloaded:
+            self.node_overloaded = overloaded
+            self._advertise_adjacencies()  # drain ops advertise immediately
+
+    def set_node_metric_increment(self, increment: int) -> None:
+        if self.node_metric_increment != increment:
+            self.node_metric_increment = increment
+            self._advertise_adjacencies()
+
+    def set_link_overload(self, if_name: str, overloaded: bool) -> None:
+        changed = (
+            if_name in self.link_overloads) != overloaded
+        if changed:
+            if overloaded:
+                self.link_overloads.add(if_name)
+            else:
+                self.link_overloads.discard(if_name)
+            self._advertise_adjacencies()
+
+    def set_link_metric(self, if_name: str, metric: Optional[int]) -> None:
+        if metric is None:
+            if self.link_metric_overrides.pop(if_name, None) is not None:
+                self._advertise_adjacencies()
+        elif self.link_metric_overrides.get(if_name) != metric:
+            self.link_metric_overrides[if_name] = metric
+            self._advertise_adjacencies()
+
+    def get_drain_state(self) -> dict:
+        return {
+            "node_overloaded": self.node_overloaded,
+            "node_metric_increment": self.node_metric_increment,
+            "link_overloads": sorted(self.link_overloads),
+            "link_metric_overrides": dict(self.link_metric_overrides),
+        }
+
+    def restore_drain_state(self, state: dict) -> None:
+        """Reload persisted drain config (config-store on restart)."""
+        self.node_overloaded = state.get("node_overloaded", False)
+        self.node_metric_increment = state.get("node_metric_increment", 0)
+        self.link_overloads = set(state.get("link_overloads", []))
+        self.link_metric_overrides = dict(
+            state.get("link_metric_overrides", {})
+        )

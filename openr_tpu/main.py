@@ -1,0 +1,313 @@
+"""OpenrNode — process bootstrap and module wiring (the Main.cpp of this
+framework).
+
+Constructs every queue and module, wires them exactly like the reference
+(openr/Main.cpp:152-226 queue graph, §1 of SURVEY), starts modules in
+dependency order and stops them in reverse (Main.cpp:231-470, 498-541):
+
+    routeUpdatesQueue          Decision → Fib
+    staticRouteUpdatesQueue    PrefixManager → Decision
+    fibRouteUpdatesQueue       Fib → PrefixManager
+    interfaceUpdatesQueue      LinkMonitor → Spark
+    neighborUpdatesQueue       Spark → LinkMonitor
+    prefixUpdatesQueue         api/plugins → PrefixManager
+    kvStoreUpdatesQueue        KvStore → Dispatcher → (Decision, …)
+    peerUpdatesQueue           LinkMonitor → KvStore
+    kvRequestQueue             PrefixManager/LinkMonitor → KvStore
+    logSampleQueue             anyone → Monitor
+
+Initialization events follow the reference's ordered cold-start sequence
+(docs/Protocol_Guide/Initialization_Process.md): INITIALIZING →
+AGENT_CONFIGURED → LINK_DISCOVERED → NEIGHBOR_DISCOVERED →
+KVSTORE_SYNCED → RIB_COMPUTED → FIB_SYNCED → PREFIX_DB_SYNCED →
+INITIALIZED.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from openr_tpu import constants as Const
+from openr_tpu.common.runtime import Clock, CounterMap
+from openr_tpu.config import OpenrConfig
+from openr_tpu.decision.backend import DecisionBackend, ScalarBackend, TpuBackend
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.dispatcher.dispatcher import Dispatcher
+from openr_tpu.fib.fib import Fib, FibAgent
+from openr_tpu.kvstore.kv_store import KvStore
+from openr_tpu.kvstore.transport import KvStoreTransport
+from openr_tpu.link_monitor.link_monitor import LinkMonitor
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.prefix_manager.prefix_manager import PrefixManager
+from openr_tpu.spark.io_provider import IoProvider
+from openr_tpu.spark.spark import Spark
+from openr_tpu.types import InitializationEvent, PrefixEntry, PrefixEvent, PrefixEventType, PrefixType
+
+
+class InitializationTracker:
+    """Collects module initialization signals; INITIALIZED when the full
+    chain has fired (KvStore.thrift:25-62)."""
+
+    REQUIRED = [
+        InitializationEvent.LINK_DISCOVERED,
+        InitializationEvent.NEIGHBOR_DISCOVERED,
+        InitializationEvent.KVSTORE_SYNCED,
+        InitializationEvent.RIB_COMPUTED,
+        InitializationEvent.FIB_SYNCED,
+        InitializationEvent.PREFIX_DB_SYNCED,
+    ]
+
+    def __init__(self) -> None:
+        self.events: List[InitializationEvent] = [
+            InitializationEvent.INITIALIZING
+        ]
+        self._listeners: List = []
+
+    def on_event(self, ev: InitializationEvent) -> None:
+        if ev in self.events:
+            return
+        self.events.append(ev)
+        for listener in self._listeners:
+            listener(ev)
+        if ev != InitializationEvent.INITIALIZED and all(
+            r in self.events for r in self.REQUIRED
+        ):
+            self.on_event(InitializationEvent.INITIALIZED)
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    @property
+    def initialized(self) -> bool:
+        return InitializationEvent.INITIALIZED in self.events
+
+
+def make_area_lookup(config: OpenrConfig):
+    """Deduce a neighbor's area from config regexes
+    (getNeighborArea, AreaConfig semantics OpenrConfig.thrift:443-460)."""
+    compiled = [
+        (
+            a.area_id,
+            [re.compile(p) for p in a.neighbor_regexes],
+            [re.compile(p) for p in a.include_interface_regexes],
+            [re.compile(p) for p in a.exclude_interface_regexes],
+        )
+        for a in config.areas
+    ]
+
+    def lookup(neighbor: str, if_name: str) -> Optional[str]:
+        for area_id, nbr_res, inc_res, exc_res in compiled:
+            if any(r.fullmatch(if_name) for r in exc_res):
+                continue
+            if not any(r.fullmatch(neighbor) for r in nbr_res):
+                continue
+            if inc_res and not any(r.fullmatch(if_name) for r in inc_res):
+                continue
+            return area_id
+        return None
+
+    return lookup
+
+
+class OpenrNode:
+    """One full routing node: all modules wired over typed queues."""
+
+    def __init__(
+        self,
+        config: OpenrConfig,
+        clock: Clock,
+        io_provider: IoProvider,
+        kv_transport: KvStoreTransport,
+        fib_agent: Optional[FibAgent] = None,
+        use_tpu_backend: Optional[bool] = None,
+    ) -> None:
+        self.config = config
+        self.clock = clock
+        self.name = config.node_name
+        self.counters = CounterMap()
+        self.init_tracker = InitializationTracker()
+        areas = config.area_ids()
+
+        # -- queues (Main.cpp:152-226) ------------------------------------
+        self.route_updates_q = ReplicateQueue("routeUpdates")
+        self.static_route_updates_q = ReplicateQueue("staticRouteUpdates")
+        self.fib_route_updates_q = ReplicateQueue("fibRouteUpdates")
+        self.interface_updates_q = ReplicateQueue("interfaceUpdates")
+        self.neighbor_updates_q = ReplicateQueue("neighborUpdates")
+        self.prefix_updates_q = ReplicateQueue("prefixUpdates")
+        self.kv_store_updates_q = ReplicateQueue("kvStoreUpdates")
+        self.peer_updates_q = ReplicateQueue("peerUpdates")
+        self.kv_request_q = ReplicateQueue("kvRequests")
+        self.log_sample_q = ReplicateQueue("logSamples")
+
+        # -- modules -------------------------------------------------------
+        on_init = self.init_tracker.on_event
+
+        self.kv_store = KvStore(
+            node_name=self.name,
+            clock=clock,
+            config=config.kvstore_config,
+            areas=areas,
+            transport=kv_transport,
+            publications_queue=self.kv_store_updates_q,
+            peer_updates_reader=self.peer_updates_q.get_reader(),
+            kv_request_reader=self.kv_request_q.get_reader(),
+            initialization_cb=on_init,
+            counters=self.counters,
+        )
+        self.dispatcher = Dispatcher(
+            clock,
+            self.kv_store_updates_q.get_reader(),
+            counters=self.counters,
+        )
+        sr = config.segment_routing_config
+        node_labels = (
+            {
+                a: sr.node_segment_label.get(a, 0)
+                for a in areas
+            }
+            if sr.enable_sr_mpls
+            else {}
+        )
+        self.link_monitor = LinkMonitor(
+            node_name=self.name,
+            clock=clock,
+            config=config.link_monitor_config,
+            interface_updates_queue=self.interface_updates_q,
+            peer_updates_queue=self.peer_updates_q,
+            kv_request_queue=self.kv_request_q,
+            neighbor_updates_reader=self.neighbor_updates_q.get_reader(),
+            area_ids=areas,
+            node_labels=node_labels,
+            initialization_cb=on_init,
+            counters=self.counters,
+        )
+        self.spark = Spark(
+            node_name=self.name,
+            clock=clock,
+            config=config.spark_config,
+            io=io_provider,
+            neighbor_updates_queue=self.neighbor_updates_q,
+            interface_updates_reader=self.interface_updates_q.get_reader(),
+            area_lookup=make_area_lookup(config),
+            initialization_cb=on_init,
+            counters=self.counters,
+        )
+        self.prefix_manager = PrefixManager(
+            node_name=self.name,
+            clock=clock,
+            kv_request_queue=self.kv_request_q,
+            static_route_updates_queue=self.static_route_updates_q,
+            prefix_updates_reader=self.prefix_updates_q.get_reader(),
+            fib_route_updates_reader=self.fib_route_updates_q.get_reader(),
+            areas=areas,
+            originated_prefixes=config.originated_prefixes,
+            initialization_cb=on_init,
+            counters=self.counters,
+        )
+        solver = SpfSolver(
+            self.name,
+            enable_v4=config.enable_v4,
+            enable_node_segment_label=sr.enable_sr_mpls,
+            route_selection_algorithm=config.route_computation_rules,
+        )
+        use_tpu = (
+            use_tpu_backend
+            if use_tpu_backend is not None
+            else config.tpu_compute_config.enable_tpu_spf
+        )
+        backend: DecisionBackend = (
+            TpuBackend(
+                solver,
+                node_buckets=tuple(config.tpu_compute_config.node_buckets),
+            )
+            if use_tpu
+            else ScalarBackend(solver)
+        )
+        self.decision = Decision(
+            node_name=self.name,
+            clock=clock,
+            config=config.decision_config,
+            route_updates_queue=self.route_updates_q,
+            kv_store_updates_reader=self.dispatcher.get_reader(
+                [Const.ADJ_DB_MARKER, Const.PREFIX_DB_MARKER], name="decision"
+            ),
+            static_routes_reader=self.static_route_updates_q.get_reader(),
+            solver=solver,
+            backend=backend,
+            initialization_cb=on_init,
+            counters=self.counters,
+            rib_policy_file=config.rib_policy_file if config.rib_policy_file else "",
+        )
+        self.init_tracker.add_listener(self.decision.on_initialization_event)
+        self.fib = Fib(
+            node_name=self.name,
+            clock=clock,
+            config=config.fib_config,
+            agent=fib_agent,
+            route_updates_reader=self.route_updates_q.get_reader(),
+            fib_route_updates_queue=self.fib_route_updates_q,
+            initialization_cb=on_init,
+            counters=self.counters,
+            dryrun=config.dryrun,
+        )
+        self._all_modules = [
+            self.kv_store,
+            self.dispatcher,
+            self.prefix_manager,
+            self.spark,
+            self.link_monitor,
+            self.decision,
+            self.fib,
+        ]
+        self._queues = [
+            self.route_updates_q,
+            self.static_route_updates_q,
+            self.fib_route_updates_q,
+            self.interface_updates_q,
+            self.neighbor_updates_q,
+            self.prefix_updates_q,
+            self.kv_store_updates_q,
+            self.peer_updates_q,
+            self.kv_request_q,
+            self.log_sample_q,
+        ]
+        self._started = False
+
+    # -- lifecycle (start order per Main.cpp:231-470) ----------------------
+
+    def start(self) -> None:
+        assert not self._started
+        self._started = True
+        for module in self._all_modules:
+            module.start()
+        self.init_tracker.on_event(InitializationEvent.AGENT_CONFIGURED)
+
+    async def stop(self) -> None:
+        # close queues first, then stop modules in reverse (Main.cpp:498)
+        for q in self._queues:
+            q.close()
+        for module in reversed(self._all_modules):
+            await module.stop()
+
+    # -- convenience API ---------------------------------------------------
+
+    def advertise_prefixes(
+        self, prefixes: List[PrefixEntry], type: PrefixType = PrefixType.LOOPBACK
+    ) -> None:
+        self.prefix_updates_q.push(
+            PrefixEvent(PrefixEventType.ADD_PREFIXES, type, prefixes)
+        )
+
+    def withdraw_prefixes(
+        self, prefixes: List[PrefixEntry], type: PrefixType = PrefixType.LOOPBACK
+    ) -> None:
+        self.prefix_updates_q.push(
+            PrefixEvent(PrefixEventType.WITHDRAW_PREFIXES, type, prefixes)
+        )
+
+    @property
+    def initialized(self) -> bool:
+        return self.init_tracker.initialized

@@ -98,7 +98,9 @@ def select_routes_one(
         & (num_nh > 0)
         & (num_nh >= req)
     )
-    return valid, best_igp, nh_out, num_nh
+    # `use` is the selection-winner set (allNodeAreas); the host needs it to
+    # recover bestNodeArea / best entry when decoding device results
+    return valid, best_igp, nh_out, num_nh, use
 
 
 @jax.jit

@@ -71,5 +71,5 @@ def sharded_spf_and_select(mesh: Mesh, max_degree: int):
             r,  # distance
             r,  # min_nexthop
         ),
-        out_shardings=(b, b, b, b),
+        out_shardings=(b, b, b, b, b),
     )

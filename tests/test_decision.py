@@ -1,0 +1,420 @@
+"""Decision actor tests (patterns from decision/tests/DecisionTest.cpp) +
+TPU-backend vs scalar-backend differential parity."""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import DecisionConfig
+from openr_tpu.decision.backend import ScalarBackend, TpuBackend
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.rib import DecisionRouteUpdate, DecisionRouteUpdateType
+from openr_tpu.decision.rib_policy import (
+    RibPolicy,
+    RibPolicyStatement,
+    RibRouteActionWeight,
+)
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.topology import build_adj_dbs, grid_edges, line_edges
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import (
+    InitializationEvent,
+    PrefixDatabase,
+    PrefixEntry,
+    Publication,
+    Value,
+    adj_key,
+    prefix_key,
+)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def adj_value(db, version=1):
+    return Value(
+        version=version,
+        originator_id=db.this_node_name,
+        value=json.dumps(db.to_wire()).encode(),
+        ttl=300000,
+    )
+
+
+def prefix_value(node, entry, version=1, area="0"):
+    db = PrefixDatabase(this_node_name=node, prefix_entries=[entry], area=area)
+    return Value(
+        version=version,
+        originator_id=node,
+        value=json.dumps(db.to_wire()).encode(),
+        ttl=300000,
+    )
+
+
+def topology_publication(edges, area="0", **kwargs):
+    dbs = build_adj_dbs(edges, area=area, **kwargs)
+    return Publication(
+        key_vals={adj_key(n): adj_value(db) for n, db in dbs.items()},
+        area=area,
+    )
+
+
+class Rig:
+    def __init__(self, clock, node="node0", config=None, backend=None):
+        self.routes_q = ReplicateQueue("routeUpdates")
+        self.routes_r = self.routes_q.get_reader()
+        self.kv_q = ReplicateQueue("kvpubs")
+        self.static_q = ReplicateQueue("static")
+        self.init_events = []
+        solver = SpfSolver(node)
+        self.decision = Decision(
+            node_name=node,
+            clock=clock,
+            config=config or DecisionConfig(unblock_initial_routes_ms=120000),
+            route_updates_queue=self.routes_q,
+            kv_store_updates_reader=self.kv_q.get_reader(),
+            static_routes_reader=self.static_q.get_reader(),
+            solver=solver,
+            backend=backend,
+            initialization_cb=self.init_events.append,
+        )
+        self.decision.start()
+
+    def drain(self):
+        out = []
+        while (u := self.routes_r.try_get()) is not None:
+            out.append(u)
+        return out
+
+
+def test_initial_build_gated_on_kvstore_sync():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock)
+        rig.kv_q.push(topology_publication(line_edges(3)))
+        rig.kv_q.push(
+            Publication(
+                key_vals={
+                    prefix_key("node2", "10.0.0.0/24"): prefix_value(
+                        "node2", PrefixEntry("10.0.0.0/24")
+                    )
+                }
+            )
+        )
+        await clock.run_for(5.0)
+        assert rig.drain() == []  # gated: no KVSTORE_SYNCED yet
+        rig.decision.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+        await clock.run_for(1.0)
+        updates = rig.drain()
+        assert len(updates) == 1
+        assert updates[0].type == DecisionRouteUpdateType.FULL_SYNC
+        assert "10.0.0.0/24" in updates[0].unicast_routes_to_update
+        assert InitializationEvent.RIB_COMPUTED in rig.init_events
+        assert updates[0].perf_events is not None
+        await rig.decision.stop()
+
+    run(main())
+
+
+def test_forced_unblock_after_timeout():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, config=DecisionConfig(unblock_initial_routes_ms=2000))
+        rig.kv_q.push(topology_publication(line_edges(2)))
+        await clock.run_for(1.0)
+        assert rig.drain() == []
+        await clock.run_for(2.0)  # forced unblock at 2s
+        updates = rig.drain()
+        assert updates and updates[0].type == DecisionRouteUpdateType.FULL_SYNC
+        assert rig.decision.counters.get("decision.forced_initial_unblock") == 1
+        await rig.decision.stop()
+
+    run(main())
+
+
+def test_incremental_updates_after_full_sync():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock)
+        rig.decision.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+        rig.kv_q.push(topology_publication(line_edges(3)))
+        rig.kv_q.push(
+            Publication(
+                key_vals={
+                    prefix_key("node2", "10.0.0.0/24"): prefix_value(
+                        "node2", PrefixEntry("10.0.0.0/24")
+                    )
+                }
+            )
+        )
+        await clock.run_for(2.0)
+        assert rig.drain()[0].type == DecisionRouteUpdateType.FULL_SYNC
+        # new prefix appears -> one INCREMENTAL update with only that route
+        rig.kv_q.push(
+            Publication(
+                key_vals={
+                    prefix_key("node1", "10.9.0.0/24"): prefix_value(
+                        "node1", PrefixEntry("10.9.0.0/24")
+                    )
+                }
+            )
+        )
+        await clock.run_for(2.0)
+        updates = rig.drain()
+        assert len(updates) == 1
+        assert updates[0].type == DecisionRouteUpdateType.INCREMENTAL
+        assert list(updates[0].unicast_routes_to_update) == ["10.9.0.0/24"]
+        # no-op publication (ttl refresh) -> no rebuild output
+        rig.kv_q.push(
+            Publication(
+                key_vals={
+                    adj_key("node1"): Value(
+                        version=1, originator_id="node1", value=None, ttl=60000,
+                        ttl_version=1,
+                    )
+                }
+            )
+        )
+        await clock.run_for(2.0)
+        assert rig.drain() == []
+        await rig.decision.stop()
+
+    run(main())
+
+
+def test_publication_storm_debounced_into_one_build():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock)
+        rig.decision.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+        rig.kv_q.push(topology_publication(line_edges(4)))
+        await clock.run_for(2.0)
+        rig.drain()
+        builds_before = rig.decision.counters.get("decision.route_build_runs")
+        # 20 rapid metric changes, 2ms apart
+        dbs = build_adj_dbs(line_edges(4))
+        for i in range(20):
+            for adj in dbs["node1"].adjacencies:
+                adj.metric = 2 + i
+            rig.kv_q.push(
+                Publication(
+                    key_vals={adj_key("node1"): adj_value(dbs["node1"], version=2 + i)}
+                )
+            )
+            await clock.run_for(0.002)
+        await clock.run_for(1.0)
+        builds = rig.decision.counters.get("decision.route_build_runs") - builds_before
+        assert builds <= 3  # debounce max 250ms coalesces the storm
+        await rig.decision.stop()
+
+    run(main())
+
+
+def test_expired_adj_key_removes_node():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock)
+        rig.decision.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+        rig.kv_q.push(topology_publication(line_edges(3)))
+        rig.kv_q.push(
+            Publication(
+                key_vals={
+                    prefix_key("node2", "10.0.0.0/24"): prefix_value(
+                        "node2", PrefixEntry("10.0.0.0/24")
+                    )
+                }
+            )
+        )
+        await clock.run_for(2.0)
+        assert "10.0.0.0/24" in rig.drain()[0].unicast_routes_to_update
+        # node2's adjacency expires -> route withdrawn
+        rig.kv_q.push(Publication(expired_keys=[adj_key("node2")]))
+        await clock.run_for(2.0)
+        updates = rig.drain()
+        assert updates and updates[0].unicast_routes_to_delete == ["10.0.0.0/24"]
+        await rig.decision.stop()
+
+    run(main())
+
+
+def test_rib_policy_apply_and_persist(tmp_path):
+    async def main():
+        clock = SimClock()
+        policy_file = str(tmp_path / "rib_policy.json")
+        rig = Rig(clock)
+        rig.decision.rib_policy_file = policy_file
+        rig.decision.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+        # diamond: two nexthops to node3's prefix
+        edges = [
+            ("node0", "node1", 1),
+            ("node0", "node2", 1),
+            ("node1", "node3", 1),
+            ("node2", "node3", 1),
+        ]
+        rig.kv_q.push(topology_publication(edges))
+        rig.kv_q.push(
+            Publication(
+                key_vals={
+                    prefix_key("node3", "10.0.0.0/24"): prefix_value(
+                        "node3", PrefixEntry("10.0.0.0/24")
+                    )
+                }
+            )
+        )
+        await clock.run_for(2.0)
+        route = rig.drain()[0].unicast_routes_to_update["10.0.0.0/24"]
+        assert len(route.nexthops) == 2
+        # policy: drop nexthops via node1, weight 3 elsewhere
+        policy = RibPolicy(
+            statements=[
+                RibPolicyStatement(
+                    name="drain-node1",
+                    prefixes=["10.0.0.0/24"],
+                    action=RibRouteActionWeight(
+                        default_weight=3, neighbor_to_weight={"node1": 0}
+                    ),
+                )
+            ],
+            valid_until=clock.now() + 60.0,
+        )
+        rig.decision.set_rib_policy(policy)
+        await clock.run_for(1.0)
+        updates = rig.drain()
+        assert updates
+        route = updates[-1].unicast_routes_to_update["10.0.0.0/24"]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {"node2"}
+        assert next(iter(route.nexthops)).weight == 3
+        # persisted with remaining ttl
+        saved = RibPolicy.from_json(open(policy_file).read(), clock)
+        assert saved is not None and saved.statements[0].name == "drain-node1"
+        await rig.decision.stop()
+
+    run(main())
+
+
+def test_compute_route_db_for_other_node():
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock)
+        rig.decision.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+        rig.kv_q.push(topology_publication(line_edges(3)))
+        rig.kv_q.push(
+            Publication(
+                key_vals={
+                    prefix_key("node0", "10.0.0.0/24"): prefix_value(
+                        "node0", PrefixEntry("10.0.0.0/24")
+                    )
+                }
+            )
+        )
+        await clock.run_for(2.0)
+        # from node2's perspective the route points toward node1
+        db = rig.decision.compute_route_db_for_node("node2")
+        nh = next(iter(db.unicast_routes["10.0.0.0/24"].nexthops))
+        assert nh.neighbor_node_name == "node1"
+        await rig.decision.stop()
+
+    run(main())
+
+
+def _routes_summary(db):
+    return {
+        p: (
+            round(e.igp_cost, 1),
+            sorted(nh.neighbor_node_name for nh in e.nexthops),
+            e.best_area,
+            e.best_prefix_entry.metrics.drain_metric,
+        )
+        for p, e in db.unicast_routes.items()
+    }
+
+
+def test_tpu_backend_matches_scalar_backend():
+    """The flagship seam: TpuBackend must produce the identical RouteDb."""
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+
+    edges = grid_edges(4)
+    dbs = build_adj_dbs(
+        edges, overloaded=["node5"], soft_drained={"node10": 60}
+    )
+    ls = LinkState("0", "node0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    ps.update_prefix("node15", "0", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("node12", "0", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("node3", "0", PrefixEntry("2001:db8::/64"))
+    ps.update_prefix("node5", "0", PrefixEntry("10.7.0.0/24"))  # hard-drained
+    ps.update_prefix("node10", "0", PrefixEntry("10.8.0.0/24"))  # soft-drained
+    ps.update_prefix("node0", "0", PrefixEntry("10.9.0.0/24"))  # self
+    ps.update_prefix(
+        "node9", "0", PrefixEntry("10.3.0.0/24", min_nexthop=5)
+    )  # gated
+
+    scalar_db = ScalarBackend(SpfSolver("node0")).build_route_db({"0": ls}, ps)
+    tpu_db = TpuBackend(SpfSolver("node0")).build_route_db({"0": ls}, ps)
+    assert _routes_summary(tpu_db) == _routes_summary(scalar_db)
+    # nexthop details too (addresses, interfaces)
+    for p in scalar_db.unicast_routes:
+        assert (
+            tpu_db.unicast_routes[p].nexthops
+            == scalar_db.unicast_routes[p].nexthops
+        ), p
+
+
+def test_tpu_backend_in_decision_actor():
+    async def main():
+        clock = SimClock()
+        solver = SpfSolver("node0")
+        rig = Rig(clock, backend=TpuBackend(solver))
+        rig.decision.solver = solver
+        rig.decision.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+        rig.kv_q.push(topology_publication(grid_edges(3)))
+        rig.kv_q.push(
+            Publication(
+                key_vals={
+                    prefix_key("node8", "10.0.0.0/24"): prefix_value(
+                        "node8", PrefixEntry("10.0.0.0/24")
+                    )
+                }
+            )
+        )
+        await clock.run_for(2.0)
+        updates = rig.drain()
+        assert updates and "10.0.0.0/24" in updates[0].unicast_routes_to_update
+        route = updates[0].unicast_routes_to_update["10.0.0.0/24"]
+        assert {nh.neighbor_node_name for nh in route.nexthops} == {
+            "node1",
+            "node3",
+        }
+        await rig.decision.stop()
+
+    run(main())
+
+
+def test_tpu_backend_falls_back_on_candidate_overflow():
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.emulation.topology import ring_edges
+
+    edges = ring_edges(12)
+    dbs = build_adj_dbs(edges)
+    ls = LinkState("0", "node0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    # 10 candidates > cand_bucket of 8 -> must fall back, not wedge
+    for i in range(1, 11):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry("10.0.0.0/24"))
+    backend = TpuBackend(SpfSolver("node0"))
+    db = backend.build_route_db({"0": ls}, ps)
+    assert backend.num_scalar_builds == 1
+    scalar = ScalarBackend(SpfSolver("node0")).build_route_db({"0": ls}, ps)
+    assert _routes_summary(db) == _routes_summary(scalar)

@@ -1,0 +1,608 @@
+"""LinkMonitor / Dispatcher / Fib / PrefixManager module tests
+(patterns from link-monitor/tests, fib/tests, prefix-manager/tests)."""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import FibConfig, LinkMonitorConfig, OriginatedPrefix
+from openr_tpu.decision.rib import (
+    DecisionRouteUpdate,
+    DecisionRouteUpdateType,
+    RibUnicastEntry,
+)
+from openr_tpu.dispatcher.dispatcher import Dispatcher
+from openr_tpu.fib.fib import Fib, FibAgentError, MockFibAgent
+from openr_tpu.link_monitor.link_monitor import LinkMonitor, rtt_to_metric
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.prefix_manager.prefix_manager import (
+    PrefixManager,
+    deserialize_prefix_db,
+)
+from openr_tpu.types import (
+    AdjacencyDatabase,
+    InitializationEvent,
+    InterfaceInfo,
+    KvRequestType,
+    NeighborEvent,
+    NeighborEventType,
+    NextHop,
+    PrefixEntry,
+    Publication,
+    Value,
+)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def neighbor_up(node="nbr1", area="0", local_if="if1", rtt=1000):
+    return NeighborEvent(
+        event_type=NeighborEventType.NEIGHBOR_UP,
+        node_name=node,
+        area=area,
+        local_if_name=local_if,
+        remote_if_name=f"r_{local_if}",
+        neighbor_addr_v6="fe80::99",
+        ctrl_port=2018,
+        rtt_us=rtt,
+    )
+
+
+class LmRig:
+    def __init__(self, clock, areas=None, config=None):
+        self.if_q = ReplicateQueue("ifaces")
+        self.peer_q = ReplicateQueue("peers")
+        self.kv_q = ReplicateQueue("kvreq")
+        self.nbr_q = ReplicateQueue("nbrs")
+        self.if_r = self.if_q.get_reader()
+        self.peer_r = self.peer_q.get_reader()
+        self.kv_r = self.kv_q.get_reader()
+        self.init_events = []
+        self.lm = LinkMonitor(
+            node_name="me",
+            clock=clock,
+            config=config or LinkMonitorConfig(linkflap_initial_backoff_ms=1000),
+            interface_updates_queue=self.if_q,
+            peer_updates_queue=self.peer_q,
+            kv_request_queue=self.kv_q,
+            neighbor_updates_reader=self.nbr_q.get_reader(),
+            area_ids=areas or ["0"],
+            node_labels={"0": 101},
+            initialization_cb=self.init_events.append,
+        )
+        self.lm.start()
+
+    def drain(self, reader):
+        out = []
+        while (x := reader.try_get()) is not None:
+            out.append(x)
+        return out
+
+    def last_adj_db(self):
+        reqs = self.drain(self.kv_r)
+        assert reqs, "no kv requests"
+        req = reqs[-1]
+        assert req.key == "adj:me"
+        return AdjacencyDatabase.from_wire(json.loads(req.value.decode()))
+
+
+def test_link_monitor_neighbor_up_advertises_adj_and_peer():
+    async def main():
+        clock = SimClock()
+        rig = LmRig(clock)
+        rig.nbr_q.push(neighbor_up(rtt=2500))
+        await clock.run_for(3.0)
+        peers = rig.drain(rig.peer_r)
+        assert peers and peers[0].peers_to_add["nbr1"].ctrl_port == 2018
+        db = rig.last_adj_db()
+        assert db.node_label == 101
+        assert len(db.adjacencies) == 1
+        adj = db.adjacencies[0]
+        assert adj.other_node_name == "nbr1"
+        assert adj.metric == rtt_to_metric(2500) == 25
+        assert adj.next_hop_v6 == "fe80::99"
+        assert db.perf_events is not None
+        await rig.lm.stop()
+
+    run(main())
+
+
+def test_link_monitor_neighbor_down_withdraws():
+    async def main():
+        clock = SimClock()
+        rig = LmRig(clock)
+        rig.nbr_q.push(neighbor_up())
+        await clock.run_for(3.0)
+        rig.drain(rig.peer_r)
+        rig.drain(rig.kv_r)
+        down = neighbor_up()
+        down.event_type = NeighborEventType.NEIGHBOR_DOWN
+        rig.nbr_q.push(down)
+        await clock.run_for(3.0)
+        peers = rig.drain(rig.peer_r)
+        assert peers and peers[0].peers_to_del == ["nbr1"]
+        assert rig.last_adj_db().adjacencies == []
+        await rig.lm.stop()
+
+    run(main())
+
+
+def test_link_monitor_restarting_keeps_adjacency_drops_peer():
+    async def main():
+        clock = SimClock()
+        rig = LmRig(clock)
+        rig.nbr_q.push(neighbor_up())
+        await clock.run_for(3.0)
+        rig.drain(rig.peer_r)
+        rig.drain(rig.kv_r)
+        ev = neighbor_up()
+        ev.event_type = NeighborEventType.NEIGHBOR_RESTARTING
+        rig.nbr_q.push(ev)
+        await clock.run_for(1.0)
+        peers = rig.drain(rig.peer_r)
+        assert peers and peers[0].peers_to_del == ["nbr1"]
+        # adjacency still advertised (GR hold)
+        assert rig.lm.build_adjacency_database("0").adjacencies != []
+        await rig.lm.stop()
+
+    run(main())
+
+
+def test_link_monitor_drain_ops():
+    async def main():
+        clock = SimClock()
+        rig = LmRig(clock)
+        rig.nbr_q.push(neighbor_up())
+        await clock.run_for(3.0)
+        rig.drain(rig.kv_r)
+        rig.lm.set_node_overload(True)
+        db = rig.last_adj_db()
+        assert db.is_overloaded
+        rig.lm.set_node_metric_increment(50)
+        assert rig.last_adj_db().node_metric_increment_val == 50
+        rig.lm.set_link_metric("if1", 999)
+        assert rig.last_adj_db().adjacencies[0].metric == 999
+        rig.lm.set_link_overload("if1", True)
+        assert rig.last_adj_db().adjacencies[0].is_overloaded
+        # drain state round-trips through persistence
+        state = rig.lm.get_drain_state()
+        rig.lm.set_node_overload(False)
+        rig.lm.restore_drain_state(state)
+        assert rig.lm.node_overloaded
+        await rig.lm.stop()
+
+    run(main())
+
+
+def test_link_monitor_interface_flap_backoff():
+    async def main():
+        clock = SimClock()
+        rig = LmRig(clock)
+        up = InterfaceInfo("eth0", is_up=True, if_index=3, networks=["fe80::1/64"])
+        down = InterfaceInfo("eth0", is_up=False, if_index=3)
+        rig.lm.set_interfaces([up])
+        await clock.run_for(1.0)
+        assert InitializationEvent.LINK_DISCOVERED in rig.init_events
+        dbs = rig.drain(rig.if_r)
+        assert dbs and "eth0" in dbs[-1].interfaces
+        # flap: down then up -> activation delayed by backoff (1s)
+        rig.lm._on_interface_event(down)
+        rig.lm._on_interface_event(up)
+        await clock.run_for(0.5)
+        dbs = rig.drain(rig.if_r)
+        assert all("eth0" not in d.interfaces for d in dbs)
+        await clock.run_for(1.0)
+        dbs = rig.drain(rig.if_r)
+        assert dbs and "eth0" in dbs[-1].interfaces
+        await rig.lm.stop()
+
+    run(main())
+
+
+def test_dispatcher_prefix_filtering():
+    async def main():
+        clock = SimClock()
+        src = ReplicateQueue("kvpubs")
+        d = Dispatcher(clock, src.get_reader())
+        adj_r = d.get_reader(["adj:"])
+        all_r = d.get_reader()
+        d.start()
+        src.push(
+            Publication(
+                key_vals={
+                    "adj:n1": Value(1, "n1", b"a"),
+                    "prefix:n1:[10.0.0.0/24]": Value(1, "n1", b"p"),
+                },
+                area="0",
+            )
+        )
+        src.push(Publication(key_vals={"prefix:n2:[10.1.0.0/24]": Value(1, "n2", b"p")}))
+        src.push(Publication(expired_keys=["adj:n3", "prefix:n3:[::/0]"]))
+        await clock.run_for(0.5)
+        adj_pubs = []
+        while (p := adj_r.try_get()) is not None:
+            adj_pubs.append(p)
+        # pub 2 had no adj keys -> not delivered at all
+        assert len(adj_pubs) == 2
+        assert set(adj_pubs[0].key_vals) == {"adj:n1"}  # narrowed
+        assert adj_pubs[1].expired_keys == ["adj:n3"]
+        all_pubs = []
+        while (p := all_r.try_get()) is not None:
+            all_pubs.append(p)
+        assert len(all_pubs) == 3
+        assert d.get_filters() == [("adj:",), ()]
+        await d.stop()
+
+    run(main())
+
+
+class FibRig:
+    def __init__(self, clock, dryrun=False, agent=None):
+        self.routes_q = ReplicateQueue("routeUpdates")
+        self.fib_out_q = ReplicateQueue("fibUpdates")
+        self.fib_out_r = self.fib_out_q.get_reader()
+        self.agent = agent if agent is not None else MockFibAgent(clock)
+        self.init_events = []
+        self.fib = Fib(
+            node_name="me",
+            clock=clock,
+            config=FibConfig(route_delete_delay_ms=1000),
+            agent=None if dryrun else self.agent,
+            route_updates_reader=self.routes_q.get_reader(),
+            fib_route_updates_queue=self.fib_out_q,
+            initialization_cb=self.init_events.append,
+            dryrun=dryrun,
+        )
+        self.fib.start()
+
+
+def route(prefix, nh="fe80::1"):
+    return RibUnicastEntry(prefix=prefix, nexthops={NextHop(address=nh, if_name="if1")})
+
+
+def test_fib_programs_and_publishes():
+    async def main():
+        clock = SimClock()
+        rig = FibRig(clock)
+        rig.routes_q.push(
+            DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update={"10.0.0.0/24": route("10.0.0.0/24")},
+            )
+        )
+        await clock.run_for(1.0)
+        assert "10.0.0.0/24" in rig.agent.unicast
+        assert rig.agent.num_sync == 1
+        assert InitializationEvent.FIB_SYNCED in rig.init_events
+        assert rig.fib_out_r.try_get() is not None  # republished downstream
+        # incremental add
+        rig.routes_q.push(
+            DecisionRouteUpdate(
+                unicast_routes_to_update={"10.1.0.0/24": route("10.1.0.0/24")}
+            )
+        )
+        await clock.run_for(1.0)
+        assert "10.1.0.0/24" in rig.agent.unicast
+        await rig.fib.stop()
+
+    run(main())
+
+
+def test_fib_delete_is_delayed():
+    async def main():
+        clock = SimClock()
+        rig = FibRig(clock)
+        rig.routes_q.push(
+            DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update={"10.0.0.0/24": route("10.0.0.0/24")},
+            )
+        )
+        await clock.run_for(0.5)
+        rig.routes_q.push(
+            DecisionRouteUpdate(unicast_routes_to_delete=["10.0.0.0/24"])
+        )
+        await clock.run_for(0.5)
+        assert "10.0.0.0/24" in rig.agent.unicast  # still there (delay 1s)
+        await clock.run_for(1.0)
+        assert "10.0.0.0/24" not in rig.agent.unicast
+        await rig.fib.stop()
+
+    run(main())
+
+
+def test_fib_retry_on_agent_failure():
+    async def main():
+        clock = SimClock()
+        rig = FibRig(clock)
+        rig.agent.fail = True
+        rig.routes_q.push(
+            DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update={"10.0.0.0/24": route("10.0.0.0/24")},
+            )
+        )
+        await clock.run_for(2.0)
+        assert rig.agent.unicast == {}
+        assert rig.fib.counters.get("fib.programming_failures") >= 1
+        rig.agent.fail = False
+        await clock.run_for(10.0)  # backoff max 4s
+        assert "10.0.0.0/24" in rig.agent.unicast
+        await rig.fib.stop()
+
+    run(main())
+
+
+def test_fib_agent_restart_triggers_resync():
+    async def main():
+        clock = SimClock()
+        rig = FibRig(clock)
+        rig.routes_q.push(
+            DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update={"10.0.0.0/24": route("10.0.0.0/24")},
+            )
+        )
+        await clock.run_for(3.0)
+        rig.agent.restart()
+        assert rig.agent.unicast == {}
+        await clock.run_for(3.0)  # keepalive every 1s
+        assert "10.0.0.0/24" in rig.agent.unicast
+        assert rig.fib.counters.get("fib.agent_restarts") == 1
+        await rig.fib.stop()
+
+    run(main())
+
+
+def test_fib_dryrun_mode():
+    async def main():
+        clock = SimClock()
+        rig = FibRig(clock, dryrun=True)
+        rig.routes_q.push(
+            DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update={"10.0.0.0/24": route("10.0.0.0/24")},
+            )
+        )
+        await clock.run_for(1.0)
+        assert InitializationEvent.FIB_SYNCED in rig.init_events
+        assert rig.fib.get_route_db().keys() == {"10.0.0.0/24"}
+        assert rig.agent.unicast == {}  # nothing touched the agent
+        await rig.fib.stop()
+
+    run(main())
+
+
+class PmRig:
+    def __init__(self, clock, areas=None, originated=None):
+        self.kv_q = ReplicateQueue("kvreq")
+        self.kv_r = self.kv_q.get_reader()
+        self.static_q = ReplicateQueue("static")
+        self.static_r = self.static_q.get_reader()
+        self.prefix_q = ReplicateQueue("prefixEvents")
+        self.fib_q = ReplicateQueue("fibUpdates")
+        self.init_events = []
+        self.pm = PrefixManager(
+            node_name="me",
+            clock=clock,
+            kv_request_queue=self.kv_q,
+            static_route_updates_queue=self.static_q,
+            prefix_updates_reader=self.prefix_q.get_reader(),
+            fib_route_updates_reader=self.fib_q.get_reader(),
+            areas=areas or ["0"],
+            originated_prefixes=originated,
+            initialization_cb=self.init_events.append,
+        )
+        self.pm.start()
+
+    def drain_kv(self):
+        out = []
+        while (x := self.kv_r.try_get()) is not None:
+            out.append(x)
+        return out
+
+
+def test_prefix_manager_advertise_withdraw():
+    async def main():
+        clock = SimClock()
+        rig = PmRig(clock)
+        await clock.run_for(0.5)
+        assert InitializationEvent.PREFIX_DB_SYNCED in rig.init_events
+        rig.drain_kv()
+        rig.pm.advertise([PrefixEntry("10.1.0.0/16")])
+        reqs = rig.drain_kv()
+        assert len(reqs) == 1
+        assert reqs[0].request_type == KvRequestType.PERSIST_KEY
+        assert reqs[0].key == "prefix:me:[10.1.0.0/16]"
+        db = deserialize_prefix_db(reqs[0].value)
+        assert db.prefix_entries[0].prefix == "10.1.0.0/16"
+        rig.pm.withdraw([PrefixEntry("10.1.0.0/16")])
+        reqs = rig.drain_kv()
+        assert any(r.request_type == KvRequestType.CLEAR_KEY for r in reqs)
+        await rig.pm.stop()
+
+    run(main())
+
+
+def test_prefix_manager_originated_aggregation():
+    async def main():
+        clock = SimClock()
+        rig = PmRig(
+            clock,
+            originated=[
+                OriginatedPrefix(
+                    "10.0.0.0/8", minimum_supporting_routes=2, install_to_fib=True
+                )
+            ],
+        )
+        await clock.run_for(0.5)
+        rig.drain_kv()
+        # one supporting route: not advertised yet
+        rig.fib_q.push(
+            DecisionRouteUpdate(
+                unicast_routes_to_update={"10.1.0.0/24": route("10.1.0.0/24")}
+            )
+        )
+        await clock.run_for(0.5)
+        assert not rig.pm.get_originated_prefixes()["10.0.0.0/8"]["advertised"]
+        # second: advertised + static route emitted
+        rig.fib_q.push(
+            DecisionRouteUpdate(
+                unicast_routes_to_update={"10.2.0.0/24": route("10.2.0.0/24")}
+            )
+        )
+        await clock.run_for(0.5)
+        assert rig.pm.get_originated_prefixes()["10.0.0.0/8"]["advertised"]
+        reqs = rig.drain_kv()
+        assert any(r.key == "prefix:me:[10.0.0.0/8]" for r in reqs)
+        st = rig.static_r.try_get()
+        assert st is not None and "10.0.0.0/8" in st.unicast_routes_to_update
+        # lose one: withdrawn
+        rig.fib_q.push(
+            DecisionRouteUpdate(unicast_routes_to_delete=["10.1.0.0/24"])
+        )
+        await clock.run_for(0.5)
+        assert not rig.pm.get_originated_prefixes()["10.0.0.0/8"]["advertised"]
+        reqs = rig.drain_kv()
+        assert any(r.request_type == KvRequestType.CLEAR_KEY for r in reqs)
+        await rig.pm.stop()
+
+    run(main())
+
+
+def test_prefix_manager_area_redistribution():
+    async def main():
+        clock = SimClock()
+        rig = PmRig(clock, areas=["A", "B"])
+        await clock.run_for(0.5)
+        rig.drain_kv()
+        # fib confirms a route learned in area A
+        entry = RibUnicastEntry(
+            prefix="10.5.0.0/24",
+            nexthops={NextHop(address="fe80::1")},
+            best_prefix_entry=PrefixEntry("10.5.0.0/24"),
+            best_area="A",
+            igp_cost=3,
+        )
+        rig.fib_q.push(
+            DecisionRouteUpdate(unicast_routes_to_update={"10.5.0.0/24": entry})
+        )
+        await clock.run_for(0.5)
+        reqs = rig.drain_kv()
+        assert len(reqs) == 1
+        assert reqs[0].area == "B"  # only into the other area
+        db = deserialize_prefix_db(reqs[0].value)
+        assert db.prefix_entries[0].area_stack == ["A"]
+        assert db.prefix_entries[0].metrics.distance == 3
+        # loop prevention: entry already through B never goes back into B
+        entry2 = RibUnicastEntry(
+            prefix="10.6.0.0/24",
+            nexthops={NextHop(address="fe80::1")},
+            best_prefix_entry=PrefixEntry("10.6.0.0/24", area_stack=["B"]),
+            best_area="A",
+            igp_cost=1,
+        )
+        rig.fib_q.push(
+            DecisionRouteUpdate(unicast_routes_to_update={"10.6.0.0/24": entry2})
+        )
+        await clock.run_for(0.5)
+        assert rig.drain_kv() == []
+        # route deleted -> redistribution withdrawn
+        rig.fib_q.push(
+            DecisionRouteUpdate(unicast_routes_to_delete=["10.5.0.0/24"])
+        )
+        await clock.run_for(0.5)
+        reqs = rig.drain_kv()
+        assert any(r.request_type == KvRequestType.CLEAR_KEY for r in reqs)
+        await rig.pm.stop()
+
+    run(main())
+
+
+def test_link_monitor_reflap_does_not_bypass_backoff():
+    async def main():
+        clock = SimClock()
+        rig = LmRig(clock)
+        up = InterfaceInfo("eth0", is_up=True, if_index=3, networks=["fe80::1/64"])
+        down = InterfaceInfo("eth0", is_up=False, if_index=3)
+        rig.lm.set_interfaces([up])
+        await clock.run_for(0.5)
+        rig.drain(rig.if_r)
+        # flap 1: backoff 1s, activation at t+1
+        rig.lm._on_interface_event(down)
+        rig.lm._on_interface_event(up)
+        await clock.run_for(0.6)
+        # flap 2 at t+0.6: backoff 2s, activation must be at t+2.6 ONLY
+        rig.lm._on_interface_event(down)
+        rig.lm._on_interface_event(up)
+        await clock.run_for(1.0)  # t+1.6: stale timer would have fired
+        dbs = rig.drain(rig.if_r)
+        assert all("eth0" not in d.interfaces for d in dbs), "stale activation"
+        await clock.run_for(1.5)  # t+3.1 > t+2.6
+        dbs = rig.drain(rig.if_r)
+        assert dbs and "eth0" in dbs[-1].interfaces
+        await rig.lm.stop()
+
+    run(main())
+
+
+def test_prefix_manager_same_prefix_two_types_deterministic():
+    async def main():
+        from openr_tpu.types import PrefixMetrics, PrefixType
+
+        clock = SimClock()
+        rig = PmRig(clock)
+        await clock.run_for(0.5)
+        rig.drain_kv()
+        rig.pm.advertise(
+            [PrefixEntry("10.1.0.0/16", metrics=PrefixMetrics(path_preference=100))],
+            type=PrefixType.LOOPBACK,
+        )
+        rig.pm.advertise(
+            [PrefixEntry("10.1.0.0/16", metrics=PrefixMetrics(path_preference=900))],
+            type=PrefixType.BREEZE,
+        )
+        reqs = rig.drain_kv()
+        db = deserialize_prefix_db(reqs[-1].value)
+        # best metrics (higher path_preference) wins regardless of order
+        assert db.prefix_entries[0].metrics.path_preference == 900
+        await rig.pm.stop()
+
+    run(main())
+
+
+def test_fib_do_not_install_transition_withdraws():
+    async def main():
+        clock = SimClock()
+        rig = FibRig(clock)
+        rig.routes_q.push(
+            DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update={"10.0.0.0/24": route("10.0.0.0/24")},
+            )
+        )
+        await clock.run_for(0.5)
+        assert "10.0.0.0/24" in rig.agent.unicast
+        # flip to do_not_install: must be withdrawn from the agent
+        flipped = route("10.0.0.0/24")
+        flipped.do_not_install = True
+        rig.routes_q.push(
+            DecisionRouteUpdate(unicast_routes_to_update={"10.0.0.0/24": flipped})
+        )
+        await clock.run_for(2.0)  # delete delay 1s
+        assert "10.0.0.0/24" not in rig.agent.unicast
+        # agent restart resync must NOT resurrect it
+        rig.agent.restart()
+        await clock.run_for(3.0)
+        assert "10.0.0.0/24" not in rig.agent.unicast
+        await rig.fib.stop()
+
+    run(main())

@@ -177,7 +177,7 @@ def select_parity_case(edges, advertisements, root, **ls_kwargs):
     topo = encode_link_state(ls)
     cands = encode_prefix_candidates(ps, topo, "0")
     D = max(topo.max_out_degree(), 1)
-    valid, metric, nh_out, num_nh = spf_and_select(
+    valid, metric, nh_out, num_nh, _winners = spf_and_select(
         jnp.asarray(topo.src),
         jnp.asarray(topo.dst),
         jnp.asarray(topo.w),
@@ -298,7 +298,7 @@ def test_sharded_kernel_on_virtual_mesh():
         np.zeros(B, np.int32),
     )
     kernel = sharded_spf_and_select(mesh, D)
-    valid, metric, nh, num = kernel(
+    valid, metric, nh, num, _w = kernel(
         topo.src,
         topo.dst,
         topo.w,
@@ -361,7 +361,7 @@ def test_batched_select_routes_on_precomputed_spf():
         jnp.full(B, topo.node_id("a"), jnp.int32),
         D,
     )
-    valid, metric, nh_out, num = batched_select_routes(
+    valid, metric, nh_out, num, _w = batched_select_routes(
         jnp.asarray(cands.cand_node),
         jnp.asarray(cands.cand_ok),
         jnp.asarray(cands.drain_metric),
