@@ -144,6 +144,7 @@ class Actor:
         self.counters = counters if counters is not None else CounterMap()
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
+        self._fiber_failed = False
         self.last_heartbeat: float = clock.now()
 
     # -- lifecycle ---------------------------------------------------------
@@ -182,8 +183,10 @@ class Actor:
         except ValueError:
             pass
         if not task.cancelled() and task.exception() is not None:
-            # Surface module-fiber crashes rather than swallowing them.
+            # Surface module-fiber crashes rather than swallowing them; the
+            # Watchdog stops refreshing this actor's heartbeat and fires.
             self.counters.bump(f"{self.name}.fiber_exception")
+            self._fiber_failed = True
 
     def spawn_queue_loop(self, rqueue, handler: Callable, name: str = "") -> asyncio.Task:
         """The canonical module fiber: drain a queue until close
@@ -222,6 +225,14 @@ class Actor:
 
     def touch(self) -> None:
         self.last_heartbeat = self.clock.now()
+
+    @property
+    def healthy(self) -> bool:
+        """No fiber has died with an exception and the actor is running.
+        The Watchdog refreshes heartbeats of healthy actors (the asyncio
+        analogue of the reference's evb no-op timer, Watchdog.cpp:71-98) so
+        an idle-but-alive module never reads as stalled."""
+        return not self._fiber_failed and not self._stopped
 
     def schedule(self, delay: float, fn: Callable[[], Any], name: str = "") -> asyncio.Task:
         """One-shot timer (OpenrEventBase::scheduleTimeout equivalent)."""

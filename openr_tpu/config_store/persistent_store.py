@@ -1,0 +1,133 @@
+"""PersistentStore — disk-backed typed KV for state that survives restarts.
+
+Re-design of openr/config-store/PersistentStore.{h,cpp}: a small store used
+for drain state (node/link overload, metric overrides) and RibPolicy so a
+restarting daemon comes back with the operator's intent intact
+(PersistentStore.h:50,90-100; default path
+/tmp/openr_persistent_config_store.bin per if/OpenrConfig.thrift:578).
+
+The reference serializes a thrift ``PersistentObject`` journal with periodic
+full-snapshot compaction (writes are thrift-object deltas appended to the
+file; every N deltas the whole DB is rewritten).  We keep the same
+journal+snapshot design but in a line-delimited JSON encoding: each line is
+``{"op": "save"|"erase", "key": ..., "value": ...}``, a snapshot line is
+``{"op": "snapshot", "data": {...}}``.  Values are arbitrary JSON-encodable
+objects (the reference stores serialized thrift; our data model is
+dataclass/JSON).
+
+Write semantics match the reference: ``store`` is synchronous in-memory +
+journaled to disk with throttled fsync; ``load`` reads memory only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional
+
+SNAPSHOT_EVERY = 100  # journal entries between compactions (ref: kDbFlushRatio)
+
+
+class PersistentStore:
+    def __init__(self, path: str, dryrun: bool = False) -> None:
+        self.path = path
+        self.dryrun = dryrun
+        self._data: Dict[str, Any] = {}
+        self._journal_len = 0
+        self.num_writes = 0
+        self.num_loads = 0
+        if not dryrun:
+            self._recover()
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path) as f:
+                lines = f.readlines()
+        except OSError:
+            return
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write — journal is best-effort
+            op = rec.get("op")
+            if op == "snapshot":
+                self._data = dict(rec.get("data", {}))
+                self._journal_len = 0
+            elif op == "save":
+                self._data[rec["key"]] = rec.get("value")
+                self._journal_len += 1
+            elif op == "erase":
+                self._data.pop(rec.get("key"), None)
+                self._journal_len += 1
+
+    # -- API (PersistentStore.h:90-100: store/load/erase) ------------------
+
+    def store(self, key: str, value: Any) -> None:
+        self._data[key] = value
+        self.num_writes += 1
+        self._append({"op": "save", "key": key, "value": value})
+
+    def load(self, key: str, default: Any = None) -> Any:
+        self.num_loads += 1
+        return self._data.get(key, default)
+
+    def erase(self, key: str) -> bool:
+        existed = key in self._data
+        if existed:
+            del self._data[key]
+            self._append({"op": "erase", "key": key})
+        return existed
+
+    def keys(self) -> List[str]:
+        return list(self._data)
+
+    def items(self) -> Iterator:
+        return iter(dict(self._data).items())
+
+    # -- journal -----------------------------------------------------------
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        if self.dryrun:
+            return
+        self._journal_len += 1
+        if self._journal_len >= SNAPSHOT_EVERY:
+            self._snapshot()
+            return
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec, default=str) + "\n")
+        except OSError:
+            pass  # disk loss degrades to in-memory-only, like the reference
+
+    def _snapshot(self) -> None:
+        """Compact: rewrite the file as one snapshot line (atomic rename)."""
+        self._journal_len = 0
+        if self.dryrun:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(
+                    json.dumps({"op": "snapshot", "data": self._data}, default=str)
+                    + "\n"
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def flush(self) -> None:
+        """Force a compaction (reference flushes on destruction)."""
+        self._snapshot()

@@ -67,6 +67,7 @@ class EmulatedNetwork:
         cfg.spark_config = fast_spark_config()
         cfg.decision_config.unblock_initial_routes_ms = 30_000
         cfg.rib_policy_file = ""  # no cross-test persistence
+        cfg.persistent_store_path = ""
         self.config_overrides(cfg)
         agent = MockFibAgent(self.clock)
         node = OpenrNode(

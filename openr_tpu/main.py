@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 from openr_tpu import constants as Const
 from openr_tpu.common.runtime import Clock, CounterMap
 from openr_tpu.config import OpenrConfig
+from openr_tpu.config_store.persistent_store import PersistentStore
 from openr_tpu.decision.backend import DecisionBackend, ScalarBackend, TpuBackend
 from openr_tpu.decision.decision import Decision
 from openr_tpu.decision.spf_solver import SpfSolver
@@ -40,10 +41,12 @@ from openr_tpu.kvstore.kv_store import KvStore
 from openr_tpu.kvstore.transport import KvStoreTransport
 from openr_tpu.link_monitor.link_monitor import LinkMonitor
 from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.monitor.monitor import Monitor
 from openr_tpu.prefix_manager.prefix_manager import PrefixManager
 from openr_tpu.spark.io_provider import IoProvider
 from openr_tpu.spark.spark import Spark
 from openr_tpu.types import InitializationEvent, PrefixEntry, PrefixEvent, PrefixEventType, PrefixType
+from openr_tpu.watchdog.watchdog import Watchdog
 
 
 class InitializationTracker:
@@ -253,7 +256,44 @@ class OpenrNode:
             counters=self.counters,
             dryrun=config.dryrun,
         )
+        # -- aux services (L6): config-store, monitor, watchdog ------------
+        # Drain state survives restarts via the persistent store
+        # (reference: LinkMonitor loads from PersistentStore on start,
+        # LinkMonitor.cpp constructor).
+        self.persistent_store = PersistentStore(
+            config.persistent_store_path or "",
+            dryrun=not config.persistent_store_path,
+        )
+        # node-scoped key so several nodes/daemons sharing one store file
+        # (emulation, multi-instance hosts) never cross-contaminate
+        self._drain_state_key = f"link-monitor-config:{self.name}"
+        drain = self.persistent_store.load(self._drain_state_key)
+        if drain:
+            self.link_monitor.restore_drain_state(drain)
+        self.monitor = Monitor(
+            node_name=self.name,
+            clock=clock,
+            log_sample_reader=self.log_sample_q.get_reader(),
+            counters=self.counters,
+            max_event_log_size=config.monitor_config.max_event_log,
+            enable_event_log_submission=(
+                config.monitor_config.enable_event_log_submission
+            ),
+        )
+        self.watchdog: Optional[Watchdog] = None
+        if config.enable_watchdog:
+            wd = config.watchdog_config
+            self.watchdog = Watchdog(
+                node_name=self.name,
+                clock=clock,
+                counters=self.counters,
+                interval_s=wd.interval_s,
+                thread_timeout_s=wd.thread_timeout_s,
+                max_memory_mb=wd.max_memory_mb,
+                max_queue_size=wd.max_queue_size,
+            )
         self._all_modules = [
+            self.monitor,
             self.kv_store,
             self.dispatcher,
             self.prefix_manager,
@@ -262,6 +302,10 @@ class OpenrNode:
             self.decision,
             self.fib,
         ]
+        if self.watchdog is not None:
+            self._all_modules.insert(0, self.watchdog)
+            for m in self._all_modules[1:]:
+                self.watchdog.add_actor(m)
         self._queues = [
             self.route_updates_q,
             self.static_route_updates_q,
@@ -274,6 +318,9 @@ class OpenrNode:
             self.kv_request_q,
             self.log_sample_q,
         ]
+        if self.watchdog is not None:
+            for q in self._queues:
+                self.watchdog.add_queue(q)
         self._started = False
 
     # -- lifecycle (start order per Main.cpp:231-470) ----------------------
@@ -291,6 +338,30 @@ class OpenrNode:
             q.close()
         for module in reversed(self._all_modules):
             await module.stop()
+        self.persistent_store.flush()
+
+    # -- drain ops (persisted, reference LinkMonitor::semifuture_set*) -----
+
+    def set_node_overload(self, overloaded: bool) -> None:
+        self.link_monitor.set_node_overload(overloaded)
+        self._persist_drain_state()
+
+    def set_node_metric_increment(self, increment: int) -> None:
+        self.link_monitor.set_node_metric_increment(increment)
+        self._persist_drain_state()
+
+    def set_link_overload(self, if_name: str, overloaded: bool) -> None:
+        self.link_monitor.set_link_overload(if_name, overloaded)
+        self._persist_drain_state()
+
+    def set_link_metric(self, if_name: str, metric: Optional[int]) -> None:
+        self.link_monitor.set_link_metric(if_name, metric)
+        self._persist_drain_state()
+
+    def _persist_drain_state(self) -> None:
+        self.persistent_store.store(
+            self._drain_state_key, self.link_monitor.get_drain_state()
+        )
 
     # -- convenience API ---------------------------------------------------
 

@@ -1,0 +1,130 @@
+"""Monitor — structured event-log sink + process metrics.
+
+TPU-native re-design of the reference monitor service
+(openr/monitor/{MonitorBase,Monitor,LogSample,SystemMetrics}.{h,cpp}):
+
+  * drains ``logSampleQueue`` (any module pushes ``LogSample`` records,
+    reference MonitorBase.h:32-51);
+  * every sample is stamped, normalized to JSON, kept in a bounded recent-log
+    ring (``max_event_log_size``) queryable via the ctrl API ``getEventLogs``
+    (if/OpenrCtrl.thrift:702);
+  * periodically samples process CPU / RSS into counters
+    (monitor/SystemMetrics.h:24-36 via /proc, no psutil dependency);
+  * counts received/dropped samples like the reference
+    (``monitor.log_sample_received`` etc.).
+
+Forwarding to an external log pipeline (Scuba in Meta's deployment) is a
+pluggable callback here, defaulting to a no-op — the OSS reference does the
+same (Monitor.cpp processes but does not export).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.messaging.queue import RQueue
+from openr_tpu.types import LogSample
+
+
+class SystemMetrics:
+    """CPU/RSS sampling from /proc (reference monitor/SystemMetrics.h:24-36
+    reads getrusage + /proc/self/statm)."""
+
+    def __init__(self) -> None:
+        self._last_cpu: Optional[float] = None
+        self._last_wall: Optional[float] = None
+        self._page_size = os.sysconf("SC_PAGE_SIZE")
+
+    def rss_bytes(self) -> Optional[int]:
+        try:
+            with open("/proc/self/statm") as f:
+                fields = f.read().split()
+            return int(fields[1]) * self._page_size
+        except (OSError, IndexError, ValueError):
+            return None
+
+    def cpu_pct(self) -> Optional[float]:
+        """Process CPU% since the previous call (first call returns None)."""
+        try:
+            cpu = sum(os.times()[:2])  # user + system
+        except OSError:
+            return None
+        wall = time.monotonic()
+        pct = None
+        if self._last_cpu is not None and wall > self._last_wall:
+            pct = 100.0 * (cpu - self._last_cpu) / (wall - self._last_wall)
+        self._last_cpu, self._last_wall = cpu, wall
+        return pct
+
+
+class Monitor(Actor):
+    """Event-log ring + metrics sampler (reference monitor/Monitor.h)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        log_sample_reader: RQueue,
+        counters: Optional[CounterMap] = None,
+        max_event_log_size: int = 100,
+        enable_event_log_submission: bool = True,
+        metrics_interval_s: float = 60.0,
+        forward_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ) -> None:
+        super().__init__("monitor", clock, counters)
+        self.node_name = node_name
+        self._reader = log_sample_reader
+        self._ring: Deque[str] = deque(maxlen=max_event_log_size)
+        self._submit = enable_event_log_submission
+        self._metrics_interval = metrics_interval_s
+        self._forward = forward_fn
+        self.system_metrics = SystemMetrics()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.spawn_queue_loop(self._reader, self.process_log_sample, "monitor.logs")
+        self.spawn(self._metrics_fiber(), "monitor.metrics")
+
+    async def _metrics_fiber(self) -> None:
+        while True:
+            self.sample_system_metrics()
+            await self.clock.sleep(self._metrics_interval)
+
+    # -- log samples (Monitor.cpp processEventLog) -------------------------
+
+    def process_log_sample(self, sample: LogSample) -> None:
+        self.counters.bump("monitor.log.sample_received")
+        if not self._submit:
+            self.counters.bump("monitor.log.sample_dropped")
+            return
+        record = {
+            "event": sample.event,
+            "node_name": self.node_name,
+            "timestamp_ms": sample.timestamp_ms or self.clock.now_ms(),
+            **sample.attributes,
+        }
+        self._ring.append(json.dumps(record, sort_keys=True, default=str))
+        if self._forward is not None:
+            self._forward(record)
+
+    def get_event_logs(self) -> List[str]:
+        """ctrl API getEventLogs (if/OpenrCtrl.thrift:702)."""
+        return list(self._ring)
+
+    # -- system metrics ----------------------------------------------------
+
+    def sample_system_metrics(self) -> None:
+        rss = self.system_metrics.rss_bytes()
+        if rss is not None:
+            self.counters.set("process.memory.rss", rss)
+        cpu = self.system_metrics.cpu_pct()
+        if cpu is not None:
+            self.counters.set("process.cpu.pct", cpu)
+        self.counters.set("process.uptime.seconds", self.clock.now())
+        self.touch()

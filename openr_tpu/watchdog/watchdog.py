@@ -1,0 +1,116 @@
+"""Watchdog — liveness guard over every module thread and queue.
+
+Re-design of openr/watchdog/Watchdog.{h,cpp}: the reference registers every
+module's EventBase (``addEvb``) and every inter-module queue (``addQueue``),
+then on a fixed interval checks (Watchdog.cpp:71-174)
+
+  * thread stall: evb heartbeat timestamp older than ``thread_timeout_s``;
+  * queue growth: accumulated reader backlog exceeding a threshold;
+  * memory: process RSS above ``max_memory_mb``;
+
+and ``fireCrash``es so a supervisor restarts the daemon.  Config knobs match
+if/OpenrConfig.thrift:209-221 (interval 20s, thread timeout 300s, memory cap).
+
+Here modules are asyncio ``Actor``s that bump ``last_heartbeat`` via
+``touch()``; queues are ``ReplicateQueue``s exposing ``max_backlog()``.
+``fire_crash`` is pluggable so tests observe instead of aborting — in
+production it raises SystemExit from the watchdog fiber, the supervisor's
+restart signal.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.monitor.monitor import SystemMetrics
+
+
+class Watchdog(Actor):
+    QUEUE_BACKLOG_LIMIT = 100_000  # reference: kMaxQueueSize sanity bound
+
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        counters: Optional[CounterMap] = None,
+        interval_s: float = 20.0,
+        thread_timeout_s: float = 300.0,
+        max_memory_mb: int = 0,  # 0 = unlimited
+        max_queue_size: int = QUEUE_BACKLOG_LIMIT,
+        fire_crash: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        super().__init__("watchdog", clock, counters)
+        self.node_name = node_name
+        self._interval = interval_s
+        self._thread_timeout = thread_timeout_s
+        self._max_memory_bytes = max_memory_mb * 1024 * 1024
+        self._max_queue_size = max_queue_size
+        self._actors: List[Actor] = []
+        self._queues: List = []
+        self._metrics = SystemMetrics()
+        self._fire_crash = fire_crash or self._default_fire_crash
+        self.crashed: Optional[str] = None  # first crash reason, for tests
+
+    # -- registration (Watchdog::addEvb / addQueue) ------------------------
+
+    def add_actor(self, actor: Actor) -> None:
+        self._actors.append(actor)
+
+    def add_queue(self, queue) -> None:
+        self._queues.append(queue)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.spawn(self._watch_fiber(), "watchdog.loop")
+
+    async def _watch_fiber(self) -> None:
+        while True:
+            await self.clock.sleep(self._interval)
+            self.touch()
+            self.check()
+
+    # -- checks (Watchdog.cpp:71-174) --------------------------------------
+
+    def check(self) -> None:
+        self.counters.bump("watchdog.checks")
+        now = self.clock.now()
+        for actor in self._actors:
+            if actor.healthy:
+                # The asyncio analogue of the reference's no-op evb timer:
+                # a live, uncrashed actor gets its timestamp refreshed, so
+                # only crashed modules (dead fibers) read as stalled.  An
+                # idle module on a quiet network is healthy, not stuck.
+                actor.touch()
+            stall = now - actor.last_heartbeat
+            self.counters.set(f"watchdog.stall_time_ms.{actor.name}", stall * 1000)
+            if stall > self._thread_timeout:
+                self._crash(
+                    f"Thread {actor.name} stuck for {stall:.0f}s "
+                    f"(limit {self._thread_timeout:.0f}s)"
+                )
+        for q in self._queues:
+            backlog = q.max_backlog()
+            self.counters.set(f"watchdog.queue_backlog.{q.name}", backlog)
+            if backlog > self._max_queue_size:
+                self._crash(
+                    f"Queue {q.name} backlog {backlog} exceeds "
+                    f"{self._max_queue_size}"
+                )
+        if self._max_memory_bytes:
+            rss = self._metrics.rss_bytes()
+            if rss is not None and rss > self._max_memory_bytes:
+                self._crash(
+                    f"Memory {rss} exceeds limit {self._max_memory_bytes}"
+                )
+
+    def _crash(self, reason: str) -> None:
+        self.counters.bump("watchdog.crashes")
+        if self.crashed is None:
+            self.crashed = reason
+        self._fire_crash(reason)
+
+    @staticmethod
+    def _default_fire_crash(reason: str) -> None:
+        raise SystemExit(f"watchdog: {reason}")
