@@ -1,0 +1,5 @@
+"""`python -m openr_tpu` → daemon runner (reference: openr/Main.cpp)."""
+
+from openr_tpu.daemon import main
+
+main()

@@ -1,0 +1,5 @@
+"""`python -m openr_tpu.cli` → breeze."""
+
+from openr_tpu.cli.breeze import main
+
+main()

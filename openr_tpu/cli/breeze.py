@@ -1,0 +1,471 @@
+"""breeze — operator CLI for openr-tpu.
+
+Re-design of the reference's `breeze` click CLI
+(openr/py/openr/cli/breeze.py:11-40): per-module command groups talking to
+a node's ctrl server.  Command tree mirrors the reference's clis/ packages
+(config, decision, fib, kvstore, lm, monitor, openr, perf, prefixmgr,
+spark, tech-support); transport is the framed-JSON ctrl client instead of
+a py3 thrift client (openr/py/openr/clients/openr_client.py).
+
+Usage:  python -m openr_tpu.cli.breeze --host <h> --port <p> <group> <cmd>
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Optional
+
+import click
+
+from openr_tpu import constants as Const
+from openr_tpu.ctrl.client import OpenrCtrlClient
+from openr_tpu.types import InitializationEvent, KvStorePeerState
+
+
+def _call(ctx: click.Context, method: str, **params: Any) -> Any:
+    host, port = ctx.obj["host"], ctx.obj["port"]
+
+    async def go():
+        async with OpenrCtrlClient(host=host, port=port) as client:
+            return await client.call(method, **params)
+
+    return asyncio.run(go())
+
+
+def _print(obj: Any) -> None:
+    click.echo(json.dumps(obj, indent=2, sort_keys=True, default=str))
+
+
+@click.group()
+@click.option("--host", default="127.0.0.1", help="ctrl server host")
+@click.option("--port", default=Const.OPENR_CTRL_PORT, help="ctrl server port")
+@click.pass_context
+def breeze(ctx: click.Context, host: str, port: int) -> None:
+    """breeze — CLI for Open/R-tpu (reference: py/openr/cli/breeze.py)."""
+    ctx.ensure_object(dict)
+    ctx.obj["host"] = host
+    ctx.obj["port"] = port
+
+
+# ------------------------------------------------------------------- openr
+
+
+@breeze.group()
+def openr() -> None:
+    """Node-level info."""
+
+
+@openr.command()
+@click.pass_context
+def version(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_openr_version"))
+
+
+@openr.command("node-name")
+@click.pass_context
+def node_name(ctx: click.Context) -> None:
+    click.echo(_call(ctx, "get_node_name"))
+
+
+@openr.command("init-events")
+@click.pass_context
+def init_events(ctx: click.Context) -> None:
+    evs = _call(ctx, "get_initialization_events")
+    for e in evs:
+        click.echo(InitializationEvent(e).name)
+
+
+# ------------------------------------------------------------------ config
+
+
+@breeze.group()
+def config() -> None:
+    """Running config."""
+
+
+@config.command("show")
+@click.pass_context
+def config_show(ctx: click.Context) -> None:
+    click.echo(_call(ctx, "get_running_config"))
+
+
+# ----------------------------------------------------------------- monitor
+
+
+@breeze.group()
+def monitor() -> None:
+    """Counters and event logs."""
+
+
+@monitor.command("counters")
+@click.option("--prefix", default="", help="counter-name prefix filter")
+@click.pass_context
+def monitor_counters(ctx: click.Context, prefix: str) -> None:
+    if prefix:
+        _print(_call(ctx, "get_regex_counters", prefix=prefix))
+    else:
+        _print(_call(ctx, "get_counters"))
+
+
+@monitor.command("logs")
+@click.pass_context
+def monitor_logs(ctx: click.Context) -> None:
+    for line in _call(ctx, "get_event_logs"):
+        click.echo(line)
+
+
+# ----------------------------------------------------------------- kvstore
+
+
+@breeze.group()
+def kvstore() -> None:
+    """Replicated LSDB store."""
+
+
+@kvstore.command("keys")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option("--prefix", default="")
+@click.pass_context
+def kvstore_keys(ctx: click.Context, area: str, prefix: str) -> None:
+    dump = _call(ctx, "dump_kv_store_area", prefix=prefix, area=area)
+    rows = [
+        (k, v.get("originator_id", ""), v.get("version", 0), v.get("ttl", 0))
+        for k, v in sorted(dump.items())
+    ]
+    click.echo(f"{'Key':40} {'Originator':12} {'Version':8} TTL")
+    for k, orig, ver, ttl in rows:
+        click.echo(f"{k:40} {orig:12} {ver:<8} {ttl}")
+
+
+@kvstore.command("key-vals")
+@click.argument("keys", nargs=-1, required=True)
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.pass_context
+def kvstore_key_vals(ctx: click.Context, keys: tuple, area: str) -> None:
+    _print(_call(ctx, "get_kv_store_key_vals_area", keys=list(keys), area=area))
+
+
+@kvstore.command("peers")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.pass_context
+def kvstore_peers(ctx: click.Context, area: str) -> None:
+    peers = _call(ctx, "get_kv_store_peers_area", area=area)
+    for name, state in sorted(peers.items()):
+        click.echo(f"{name:20} {KvStorePeerState(state).name}")
+
+
+@kvstore.command("summary")
+@click.pass_context
+def kvstore_summary(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_kv_store_area_summaries"))
+
+
+@kvstore.command("snoop")
+@click.option("--area", default=None)
+@click.option("--prefix", "prefixes", multiple=True)
+@click.option("--count", default=0, help="stop after N publications (0=forever)")
+@click.pass_context
+def kvstore_snoop(
+    ctx: click.Context, area: Optional[str], prefixes: tuple, count: int
+) -> None:
+    """Live-subscribe to KvStore deltas (reference: KvStoreSnooper)."""
+    host, port = ctx.obj["host"], ctx.obj["port"]
+
+    async def go():
+        async with OpenrCtrlClient(host=host, port=port) as client:
+            seen = 0
+            async for pub in client.stream(
+                "subscribe_and_get_kv_store",
+                key_prefixes=list(prefixes),
+                areas=[area] if area else None,
+            ):
+                click.echo(json.dumps(pub, sort_keys=True, default=str))
+                seen += 1
+                if count and seen >= count:
+                    return
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------- decision
+
+
+@breeze.group()
+def decision() -> None:
+    """Computed routes and topology."""
+
+
+@decision.command("routes")
+@click.option("--node", default=None, help="compute for another node")
+@click.pass_context
+def decision_routes(ctx: click.Context, node: Optional[str]) -> None:
+    if node:
+        _print(_call(ctx, "get_route_db_computed", node=node))
+    else:
+        _print(_call(ctx, "get_route_db"))
+
+
+@decision.command("adj")
+@click.option("--area", default=None)
+@click.pass_context
+def decision_adj(ctx: click.Context, area: Optional[str]) -> None:
+    dbs = _call(ctx, "get_decision_adjacency_dbs", area=area)
+    for db in dbs:
+        click.echo(
+            f"{db['this_node_name']} (area {db.get('area', '')}, "
+            f"overloaded={db.get('is_overloaded', False)})"
+        )
+        for adj in db.get("adjacencies", []):
+            click.echo(
+                f"  -> {adj['other_node_name']} via {adj['if_name']} "
+                f"metric {adj['metric']} rtt {adj.get('rtt', 0)}us"
+            )
+
+
+@decision.command("received-routes")
+@click.pass_context
+def decision_received_routes(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_received_routes"))
+
+
+@decision.command("rib-policy")
+@click.option("--set", "set_json", default=None, help="policy JSON")
+@click.option("--clear", is_flag=True)
+@click.pass_context
+def decision_rib_policy(
+    ctx: click.Context, set_json: Optional[str], clear: bool
+) -> None:
+    if clear:
+        _call(ctx, "clear_rib_policy")
+        click.echo("cleared")
+    elif set_json:
+        _call(ctx, "set_rib_policy", policy=json.loads(set_json))
+        click.echo("set")
+    else:
+        _print(_call(ctx, "get_rib_policy"))
+
+
+# --------------------------------------------------------------------- fib
+
+
+@breeze.group()
+def fib() -> None:
+    """Programmed routes."""
+
+
+@fib.command("routes")
+@click.pass_context
+def fib_routes(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_fib_routes"))
+
+
+@fib.command("unicast")
+@click.argument("prefixes", nargs=-1, required=True)
+@click.pass_context
+def fib_unicast(ctx: click.Context, prefixes: tuple) -> None:
+    _print(_call(ctx, "get_unicast_routes_filtered", prefixes=list(prefixes)))
+
+
+@fib.command("snoop")
+@click.option("--count", default=0)
+@click.pass_context
+def fib_snoop(ctx: click.Context, count: int) -> None:
+    """Live-subscribe to FIB deltas (subscribeAndGetFib)."""
+    host, port = ctx.obj["host"], ctx.obj["port"]
+
+    async def go():
+        async with OpenrCtrlClient(host=host, port=port) as client:
+            seen = 0
+            async for delta in client.stream("subscribe_and_get_fib"):
+                click.echo(json.dumps(delta, sort_keys=True, default=str))
+                seen += 1
+                if count and seen >= count:
+                    return
+
+    asyncio.run(go())
+
+
+# -------------------------------------------------------------------- perf
+
+
+@breeze.group()
+def perf() -> None:
+    """Convergence breadcrumbs."""
+
+
+@perf.command("fib")
+@click.pass_context
+def perf_fib(ctx: click.Context) -> None:
+    for events in _call(ctx, "get_perf_db"):
+        click.echo("---")
+        for ev in events.get("events", []):
+            click.echo(
+                f"{ev['node_name']:16} {ev['event_descr']:28} {ev['unix_ts_ms']}"
+            )
+
+
+# ---------------------------------------------------------------------- lm
+
+
+@breeze.group()
+def lm() -> None:
+    """LinkMonitor: interfaces, adjacencies, drain ops."""
+
+
+@lm.command("links")
+@click.pass_context
+def lm_links(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_interfaces"))
+
+
+@lm.command("adj")
+@click.option("--area", default=None)
+@click.pass_context
+def lm_adj(ctx: click.Context, area: Optional[str]) -> None:
+    _print(_call(ctx, "get_link_monitor_adjacencies", area=area))
+
+
+@lm.command("set-node-overload")
+@click.pass_context
+def lm_set_node_overload(ctx: click.Context) -> None:
+    _call(ctx, "set_node_overload")
+    click.echo("node overload set (drained)")
+
+
+@lm.command("unset-node-overload")
+@click.pass_context
+def lm_unset_node_overload(ctx: click.Context) -> None:
+    _call(ctx, "unset_node_overload")
+    click.echo("node overload unset (undrained)")
+
+
+@lm.command("set-link-overload")
+@click.argument("interface")
+@click.pass_context
+def lm_set_link_overload(ctx: click.Context, interface: str) -> None:
+    _call(ctx, "set_interface_overload", interface=interface)
+    click.echo(f"link overload set on {interface}")
+
+
+@lm.command("unset-link-overload")
+@click.argument("interface")
+@click.pass_context
+def lm_unset_link_overload(ctx: click.Context, interface: str) -> None:
+    _call(ctx, "unset_interface_overload", interface=interface)
+    click.echo(f"link overload unset on {interface}")
+
+
+@lm.command("set-link-metric")
+@click.argument("interface")
+@click.argument("metric", type=int)
+@click.pass_context
+def lm_set_link_metric(ctx: click.Context, interface: str, metric: int) -> None:
+    _call(ctx, "set_interface_metric", interface=interface, metric=metric)
+    click.echo(f"metric {metric} set on {interface}")
+
+
+@lm.command("unset-link-metric")
+@click.argument("interface")
+@click.pass_context
+def lm_unset_link_metric(ctx: click.Context, interface: str) -> None:
+    _call(ctx, "unset_interface_metric", interface=interface)
+    click.echo(f"metric override removed from {interface}")
+
+
+# --------------------------------------------------------------- prefixmgr
+
+
+@breeze.group()
+def prefixmgr() -> None:
+    """Advertised prefixes."""
+
+
+@prefixmgr.command("view")
+@click.pass_context
+def prefixmgr_view(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_advertised_routes"))
+
+
+@prefixmgr.command("advertise")
+@click.argument("prefixes", nargs=-1, required=True)
+@click.pass_context
+def prefixmgr_advertise(ctx: click.Context, prefixes: tuple) -> None:
+    _call(
+        ctx,
+        "advertise_prefixes",
+        prefixes=[{"prefix": p} for p in prefixes],
+    )
+    click.echo(f"advertised {len(prefixes)} prefix(es)")
+
+
+@prefixmgr.command("withdraw")
+@click.argument("prefixes", nargs=-1, required=True)
+@click.pass_context
+def prefixmgr_withdraw(ctx: click.Context, prefixes: tuple) -> None:
+    _call(
+        ctx,
+        "withdraw_prefixes",
+        prefixes=[{"prefix": p} for p in prefixes],
+    )
+    click.echo(f"withdrew {len(prefixes)} prefix(es)")
+
+
+# ------------------------------------------------------------------- spark
+
+
+@breeze.group()
+def spark() -> None:
+    """Neighbor discovery."""
+
+
+@spark.command("neighbors")
+@click.pass_context
+def spark_neighbors(ctx: click.Context) -> None:
+    nbrs = _call(ctx, "get_spark_neighbors")
+    click.echo(
+        f"{'Neighbor':16} {'State':14} {'Local If':16} {'Remote If':16} "
+        f"{'Area':6} RTT(us)"
+    )
+    for n in nbrs:
+        click.echo(
+            f"{n['node_name']:16} {n['state']:14} {n['local_if_name']:16} "
+            f"{n['remote_if_name']:16} {n['area']:6} {n['rtt_us']}"
+        )
+
+
+# ------------------------------------------------------------ tech-support
+
+
+@breeze.command("tech-support")
+@click.pass_context
+def tech_support(ctx: click.Context) -> None:
+    """One-shot dump of everything (reference: breeze tech-support)."""
+    sections = [
+        ("version", "get_openr_version", {}),
+        ("node", "get_node_name", {}),
+        ("initialization", "get_initialization_events", {}),
+        ("config", "get_running_config", {}),
+        ("interfaces", "get_interfaces", {}),
+        ("adjacencies", "get_decision_adjacency_dbs", {}),
+        ("routes", "get_route_db", {}),
+        ("fib", "get_fib_routes", {}),
+        ("kvstore-summary", "get_kv_store_area_summaries", {}),
+        ("advertised-routes", "get_advertised_routes", {}),
+        ("counters", "get_counters", {}),
+        ("event-logs", "get_event_logs", {}),
+    ]
+    for title, method, params in sections:
+        click.echo(f"\n================ {title} ================")
+        try:
+            _print(_call(ctx, method, **params))
+        except Exception as e:  # noqa: BLE001 - keep dumping other sections
+            click.echo(f"<error: {e}>")
+
+
+def main() -> None:
+    breeze(obj={})
+
+
+if __name__ == "__main__":
+    main()

@@ -227,6 +227,12 @@ class Actor:
         self.last_heartbeat = self.clock.now()
 
     @property
+    def fiber_failed(self) -> bool:
+        """True once any fiber died with an exception — the Watchdog
+        crashes the daemon promptly on this (watchdog.py)."""
+        return self._fiber_failed
+
+    @property
     def healthy(self) -> bool:
         """No fiber has died with an exception and the actor is running.
         The Watchdog refreshes heartbeats of healthy actors (the asyncio

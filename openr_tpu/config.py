@@ -196,6 +196,9 @@ class OpenrConfig:
     tpu_compute_config: TpuComputeConfig = field(default_factory=TpuComputeConfig)
     #: enable best-route redistribution across areas (PrefixManager)
     enable_best_route_selection: bool = True
+    #: "" disables persistence; the literal default is node-scoped in
+    #: __post_init__ — the store file is single-writer (journal compaction
+    #: is last-writer-wins), so two daemons must never share one file
     persistent_store_path: str = "/tmp/openr_tpu_persistent_store.bin"
     rib_policy_file: str = "/tmp/openr_tpu_rib_policy.bin"
     enable_watchdog: bool = True
@@ -212,6 +215,12 @@ class OpenrConfig:
         d = self.decision_config
         if not (0 < d.debounce_min_ms <= d.debounce_max_ms):
             raise ValueError("invalid decision debounce window")
+        if self.persistent_store_path == "/tmp/openr_tpu_persistent_store.bin":
+            # node-scope the default so co-hosted daemons never share a
+            # store file (compaction is last-writer-wins across processes)
+            self.persistent_store_path = (
+                f"/tmp/openr_tpu_persistent_store.{self.node_name}.bin"
+            )
 
     def area_ids(self) -> List[str]:
         return [a.area_id for a in self.areas]

@@ -1,0 +1,124 @@
+"""OpenrCtrl client — async RPC client for the framed-JSON ctrl protocol.
+
+The counterpart of the reference's py3 thrift client
+(openr/py/openr/clients/openr_client.py): the breeze CLI and any external
+agent talk to a node's ctrl server through this.  Supports unary calls,
+server-streams (async iterator), and cancellation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Any, AsyncIterator, Dict, Optional
+
+from openr_tpu.ctrl.server import read_frame, write_frame
+
+
+class OpenrCtrlError(RuntimeError):
+    pass
+
+
+class OpenrCtrlClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 2018) -> None:
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        #: id -> queue of incoming frames for that request
+        self._pending: Dict[int, asyncio.Queue] = {}
+        self._pump_task: Optional[asyncio.Task] = None
+        self._dead = False
+
+    async def connect(self) -> "OpenrCtrlClient":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self._pump_task = asyncio.ensure_future(self._pump())
+        return self
+
+    async def close(self) -> None:
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if self._writer is not None:
+            self._writer.close()
+
+    async def __aenter__(self) -> "OpenrCtrlClient":
+        return await self.connect()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- demux pump --------------------------------------------------------
+
+    async def _pump(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                if msg is None:  # connection closed
+                    return
+                q = self._pending.get(msg.get("id"))
+                if q is not None:
+                    q.put_nowait(msg)
+        finally:
+            # Dead pump (EOF, oversized frame, bad JSON, cancel) must wake
+            # every in-flight waiter — and fail future calls fast — instead
+            # of letting them block forever.
+            self._dead = True
+            for q in self._pending.values():
+                q.put_nowait(None)
+
+    # -- API ---------------------------------------------------------------
+
+    async def call(self, method: str, **params: Any) -> Any:
+        """Unary request/response."""
+        if self._dead:
+            raise OpenrCtrlError("connection closed")
+        rid = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._pending[rid] = q
+        try:
+            write_frame(self._writer, {"id": rid, "method": method, "params": params})
+            await self._writer.drain()
+            msg = await q.get()
+            if msg is None:
+                raise OpenrCtrlError("connection closed")
+            if "error" in msg:
+                raise OpenrCtrlError(msg["error"])
+            return msg.get("result")
+        finally:
+            self._pending.pop(rid, None)
+
+    async def stream(self, method: str, **params: Any) -> AsyncIterator[Any]:
+        """Server-stream; cancel by breaking out of the iterator."""
+        if self._dead:
+            raise OpenrCtrlError("connection closed")
+        rid = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._pending[rid] = q
+        write_frame(self._writer, {"id": rid, "method": method, "params": params})
+        await self._writer.drain()
+        try:
+            while True:
+                msg = await q.get()
+                if msg is None:
+                    raise OpenrCtrlError("connection closed")
+                if "error" in msg:
+                    raise OpenrCtrlError(msg["error"])
+                if msg.get("done"):
+                    return
+                yield msg.get("stream")
+        finally:
+            self._pending.pop(rid, None)
+            if self._writer is not None and not self._writer.is_closing():
+                write_frame(self._writer, {"id": rid, "cancel": True})
+                try:
+                    await self._writer.drain()
+                except (ConnectionError, BrokenPipeError):
+                    pass

@@ -1,0 +1,359 @@
+"""OpenrCtrlHandler — the operator/API surface of one node.
+
+Re-design of openr/ctrl-server/OpenrCtrlHandler.{h,cpp} (2,127 LoC, 84
+methods, service def if/OpenrCtrl.thrift:251-741): every module exposes its
+state through this single handler, plus server-streams for KvStore and FIB
+deltas (OpenrCtrlHandler.h:364-399) and a long-poll on adjacency keys
+(OpenrCtrlHandler.h:405, hold 20s per Constants.h:209).
+
+The reference fulfills each call as a folly::SemiFuture on the owning
+module's evb; here modules share one asyncio loop, so the handler calls
+module methods directly (same thread-safety guarantee: single loop) and
+async methods await.  Transport lives in ``openr_tpu.ctrl.server`` (framed
+JSON-RPC over TCP — the fbthrift Rocket equivalent for this framework);
+this class is transport-independent and usable in-process, which is how the
+emulation and tests drive it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from openr_tpu import constants as C
+from openr_tpu.decision.rib_policy import RibPolicy
+from openr_tpu.types import (
+    ADJ_DB_MARKER,
+    PrefixEntry,
+    PrefixType,
+    Publication,
+    Value,
+)
+
+
+#: a stream subscriber whose reader backlog grows past this is disconnected
+#: — bounds server memory AND keeps transient readers well under the
+#: Watchdog's queue-backlog crash threshold (watchdog.py)
+STREAM_BACKLOG_LIMIT = 10_000
+
+
+class OpenrCtrlHandler:
+    def __init__(self, node) -> None:
+        self.node = node
+
+    # ------------------------------------------------------------------ fb303
+    def get_counters(self) -> Dict[str, float]:
+        return self.node.counters.dump()
+
+    def get_regex_counters(self, prefix: str) -> Dict[str, float]:
+        return self.node.counters.dump(prefix)
+
+    def get_node_name(self) -> str:
+        return self.node.name
+
+    def get_openr_version(self) -> Dict[str, int]:
+        return {
+            "version": C.OPENR_VERSION,
+            "lowestSupportedVersion": C.OPENR_SUPPORTED_VERSION,
+        }
+
+    def get_build_info(self) -> Dict[str, str]:
+        return {"build_package": "openr-tpu", "build_mode": "tpu-native"}
+
+    def get_initialization_events(self) -> List[int]:
+        return [int(e) for e in self.node.init_tracker.events]
+
+    def initialization_converged(self) -> bool:
+        return self.node.initialized
+
+    def get_running_config(self) -> str:
+        return self.node.config.to_json()
+
+    # ------------------------------------------------- drain / maintenance
+    # (OpenrCtrl.thrift:333-420; LinkMonitor.h:107-150)
+
+    def set_node_overload(self) -> None:
+        self.node.set_node_overload(True)
+
+    def unset_node_overload(self) -> None:
+        self.node.set_node_overload(False)
+
+    def set_interface_overload(self, interface: str) -> None:
+        self.node.set_link_overload(interface, True)
+
+    def unset_interface_overload(self, interface: str) -> None:
+        self.node.set_link_overload(interface, False)
+
+    def set_interface_metric(self, interface: str, metric: int) -> None:
+        self.node.set_link_metric(interface, metric)
+
+    def unset_interface_metric(self, interface: str) -> None:
+        self.node.set_link_metric(interface, None)
+
+    def set_node_interface_metric_increment(self, increment: int) -> None:
+        self.node.set_node_metric_increment(increment)
+
+    def unset_node_interface_metric_increment(self) -> None:
+        self.node.set_node_metric_increment(0)
+
+    def get_interfaces(self) -> Dict[str, Any]:
+        lm = self.node.link_monitor
+        return {
+            "node_name": self.node.name,
+            "is_overloaded": lm.node_overloaded,
+            "interface_details": {
+                name: {
+                    "is_up": e.info.is_up,
+                    "metric_override": lm.link_metric_overrides.get(name),
+                    "is_overloaded": name in lm.link_overloads,
+                    "addresses": list(e.info.networks),
+                }
+                for name, e in lm.interfaces.items()
+            },
+        }
+
+    def get_link_monitor_adjacencies(
+        self, area: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        lm = self.node.link_monitor
+        areas = [area] if area else lm.area_ids
+        return [lm.build_adjacency_database(a).to_wire() for a in areas]
+
+    # ----------------------------------------------------------- prefix mgr
+    # (OpenrCtrl.thrift:425-460)
+
+    def advertise_prefixes(self, prefixes: List[dict]) -> None:
+        self.node.advertise_prefixes(
+            [PrefixEntry.from_wire(p) for p in prefixes]
+        )
+
+    def withdraw_prefixes(self, prefixes: List[dict]) -> None:
+        self.node.withdraw_prefixes(
+            [PrefixEntry.from_wire(p) for p in prefixes]
+        )
+
+    def get_advertised_routes(self) -> List[dict]:
+        return [
+            e.to_wire() for e in self.node.prefix_manager.get_advertised_routes()
+        ]
+
+    def get_originated_prefixes(self) -> Dict[str, dict]:
+        return self.node.prefix_manager.get_originated_prefixes()
+
+    # -------------------------------------------------------------- decision
+    # (OpenrCtrl.thrift:462-540)
+
+    def get_route_db(self) -> dict:
+        return (
+            self.node.decision.get_route_db()
+            .to_route_database(self.node.name)
+            .to_wire()
+        )
+
+    def get_route_db_computed(self, node: str) -> dict:
+        db = self.node.decision.compute_route_db_for_node(node)
+        if db is None:
+            return {"this_node_name": node, "unicast_routes": [], "mpls_routes": []}
+        return db.to_route_database(node).to_wire()
+
+    def get_decision_adjacency_dbs(
+        self, area: Optional[str] = None
+    ) -> List[dict]:
+        return [db.to_wire() for db in self.node.decision.get_adj_dbs(area)]
+
+    def get_received_routes(self) -> Dict[str, dict]:
+        return self.node.decision.get_received_routes()
+
+    def set_rib_policy(self, policy: dict) -> None:
+        import json
+
+        pol = RibPolicy.from_json(json.dumps(policy), self.node.clock)
+        if pol is None:
+            raise ValueError("rib policy ttl must be > 0")
+        self.node.decision.set_rib_policy(pol)
+
+    def get_rib_policy(self) -> Optional[dict]:
+        import json
+
+        pol = self.node.decision.get_rib_policy()
+        return json.loads(pol.to_json(self.node.clock)) if pol is not None else None
+
+    def clear_rib_policy(self) -> None:
+        self.node.decision.clear_rib_policy()
+
+    # ------------------------------------------------------------------- fib
+    # (OpenrCtrl.thrift:560-600)
+
+    def get_fib_routes(self) -> dict:
+        fib = self.node.fib
+        from openr_tpu.decision.rib import DecisionRouteDb
+
+        db = DecisionRouteDb(
+            unicast_routes=dict(fib.get_route_db()),
+            mpls_routes=dict(fib.get_mpls_route_db()),
+        )
+        return db.to_route_database(self.node.name).to_wire()
+
+    def get_unicast_routes_filtered(self, prefixes: List[str]) -> List[dict]:
+        return [
+            r.to_wire()
+            for r in self.node.fib.get_unicast_routes_filtered(prefixes)
+        ]
+
+    def fib_synced(self) -> bool:
+        return self.node.fib.synced
+
+    def get_perf_db(self) -> List[dict]:
+        return [p.to_wire() for p in self.node.fib.get_perf_db()]
+
+    # --------------------------------------------------------------- kvstore
+    # (OpenrCtrl.thrift:604-700)
+
+    def get_kv_store_key_vals_area(
+        self, keys: List[str], area: str = C.DEFAULT_AREA
+    ) -> Dict[str, dict]:
+        vals = self.node.kv_store.get_key_vals(area, keys)
+        return {k: v.to_wire() for k, v in vals.items()}
+
+    def set_kv_store_key_vals_area(
+        self, key_vals: Dict[str, dict], area: str = C.DEFAULT_AREA
+    ) -> None:
+        self.node.kv_store.set_key_vals(
+            area, {k: Value.from_wire(v) for k, v in key_vals.items()}
+        )
+
+    def dump_kv_store_area(
+        self, prefix: str = "", area: str = C.DEFAULT_AREA
+    ) -> Dict[str, dict]:
+        vals = self.node.kv_store.dump_all(area, prefix)
+        return {k: v.to_wire() for k, v in vals.items()}
+
+    def get_kv_store_area_summaries(self) -> Dict[str, dict]:
+        return {
+            a: s.to_wire() for a, s in self.node.kv_store.summaries().items()
+        }
+
+    def get_kv_store_peers_area(
+        self, area: str = C.DEFAULT_AREA
+    ) -> Dict[str, int]:
+        db = self.node.kv_store.areas[area]
+        return {name: int(p.state) for name, p in db.peers.items()}
+
+    # ----------------------------------------------------------------- spark
+
+    def get_spark_neighbors(self) -> List[dict]:
+        out = []
+        for n in self.node.spark.get_neighbors():
+            out.append(
+                {
+                    "node_name": n.node_name,
+                    "local_if_name": n.local_if_name,
+                    "remote_if_name": n.remote_if_name,
+                    "area": n.area,
+                    "state": n.state.name,
+                    "rtt_us": n.rtt_us,
+                }
+            )
+        return out
+
+    # --------------------------------------------------------------- monitor
+
+    def get_event_logs(self) -> List[str]:
+        return self.node.monitor.get_event_logs()
+
+    # ------------------------------------------------------------- streaming
+    # (OpenrCtrlHandler.h:364-399)
+
+    async def subscribe_and_get_kv_store(
+        self,
+        key_prefixes: Optional[List[str]] = None,
+        areas: Optional[List[str]] = None,
+    ) -> AsyncIterator[dict]:
+        """Snapshot + live deltas, like subscribeAndGetKvStoreFiltered.
+
+        First yielded item is a full dump Publication per area; subsequent
+        items are incremental publications from the Dispatcher.
+        """
+        prefixes = list(key_prefixes or [])
+        reader = self.node.dispatcher.get_reader(prefixes, name="ctrl.kvstream")
+        want_areas = set(areas or self.node.kv_store.areas.keys())
+        from openr_tpu.messaging.queue import QueueClosedError
+
+        try:
+            for area in sorted(want_areas):
+                key_vals = {}
+                for pref in prefixes or [""]:
+                    key_vals.update(self.node.kv_store.dump_all(area, pref))
+                yield Publication(area=area, key_vals=key_vals).to_wire()
+            while reader.size() <= STREAM_BACKLOG_LIMIT:
+                pub = await reader.get()
+                if pub.area in want_areas:
+                    yield pub.to_wire()
+        except QueueClosedError:
+            return
+        finally:
+            self.node.dispatcher.remove_reader(reader)
+
+    async def subscribe_and_get_fib(self) -> AsyncIterator[dict]:
+        """Snapshot RouteDatabase + DecisionRouteUpdate deltas
+        (subscribeAndGetFib, OpenrCtrlHandler.h:389-399)."""
+        reader = self.node.fib_route_updates_q.get_reader(name="ctrl.fibstream")
+        from openr_tpu.messaging.queue import QueueClosedError
+
+        try:
+            yield self.get_fib_routes()
+            while reader.size() <= STREAM_BACKLOG_LIMIT:
+                update = await reader.get()
+                yield update.to_route_database_delta().to_wire()
+        except QueueClosedError:
+            return
+        finally:
+            self.node.fib_route_updates_q.remove_reader(reader)
+
+    async def long_poll_kv_store_adj_area(
+        self, area: str = C.DEFAULT_AREA, snapshot: Optional[Dict[str, int]] = None
+    ) -> bool:
+        """Park up to LONG_POLL_REQ_HOLD_TIME_S until adj: keys in `area`
+        differ from the caller's snapshot {key: version}
+        (longPollKvStoreAdjArea, OpenrCtrlHandler.h:405).  Returns True if
+        adjacencies changed, False on timeout."""
+        snapshot = snapshot or {}
+
+        def changed() -> bool:
+            current = self.node.kv_store.dump_all(area, ADJ_DB_MARKER)
+            cur = {k: v.version for k, v in current.items()}
+            return cur != snapshot
+
+        if changed():
+            return True
+        reader = self.node.dispatcher.get_reader(
+            [ADJ_DB_MARKER], name="ctrl.longpoll.req"
+        )
+
+        async def wait_change():
+            from openr_tpu.messaging.queue import QueueClosedError
+
+            try:
+                while True:
+                    pub = await reader.get()
+                    if pub.area == area and changed():
+                        return True
+            except QueueClosedError:
+                return False
+
+        async def timeout():
+            await self.node.clock.sleep(C.LONG_POLL_REQ_HOLD_TIME_S)
+            return False
+
+        t_change = asyncio.ensure_future(wait_change())
+        t_timeout = asyncio.ensure_future(timeout())
+        try:
+            done, pending = await asyncio.wait(
+                {t_change, t_timeout}, return_when=asyncio.FIRST_COMPLETED
+            )
+            for p in pending:
+                p.cancel()
+            return any(d.result() for d in done)
+        finally:
+            self.node.dispatcher.remove_reader(reader)

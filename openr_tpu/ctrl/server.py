@@ -1,0 +1,162 @@
+"""OpenrCtrl TCP server — the thrift-server equivalent of this framework.
+
+The reference serves `OpenrCtrlCpp` over fbthrift Rocket on TCP :2018
+(Main.cpp:463-492, Constants.h:224).  Here the wire protocol is framed
+JSON-RPC over asyncio TCP:
+
+    frame     := u32 big-endian length | payload (UTF-8 JSON)
+    request   := {"id": int, "method": str, "params": {...}}
+    response  := {"id": int, "result": ...} | {"id": int, "error": str}
+    stream    := {"id": int, "stream": item} ... {"id": int, "done": true}
+    cancel    := {"id": int, "cancel": true}      (client → server)
+
+Method names are the handler's snake_case method names.  A method returning
+an async generator streams; anything else (sync or awaitable) returns one
+response.  Requests multiplex over one connection by id, matching Rocket's
+multiplexed request/stream channels.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+from typing import Any, Dict, Optional
+
+from openr_tpu.ctrl.handler import OpenrCtrlHandler
+
+MAX_FRAME = 64 * 1024 * 1024
+#: a stream client that hasn't drained its socket for this long is dropped,
+#: so a stalled `breeze snoop` can never force unbounded server buffering
+#: (the reference's ServerStream applies analogous backpressure)
+STREAM_DRAIN_TIMEOUT_S = 30.0
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    length = int.from_bytes(header, "big")
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    try:
+        payload = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    return json.loads(payload)
+
+
+def write_frame(writer: asyncio.StreamWriter, obj: dict) -> None:
+    payload = json.dumps(obj, default=str).encode()
+    writer.write(len(payload).to_bytes(4, "big") + payload)
+
+
+class OpenrCtrlServer:
+    """Serves one node's OpenrCtrlHandler on a TCP port."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.node = node
+        self.handler = OpenrCtrlHandler(node)
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conn_tasks: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._conn_tasks):
+            t.cancel()
+        for t in list(self._conn_tasks):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    # -- per-connection ----------------------------------------------------
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        inflight: Dict[int, asyncio.Task] = {}
+        lock = asyncio.Lock()  # serialize frame writes across request tasks
+        try:
+            while True:
+                msg = await read_frame(reader)
+                if msg is None:
+                    break
+                rid = msg.get("id")
+                if msg.get("cancel"):
+                    t = inflight.pop(rid, None)
+                    if t is not None:
+                        t.cancel()
+                    continue
+                t = asyncio.ensure_future(
+                    self._serve_request(writer, lock, msg)
+                )
+                inflight[rid] = t
+                t.add_done_callback(lambda _t, r=rid: inflight.pop(r, None))
+        finally:
+            for t in inflight.values():
+                t.cancel()
+            writer.close()
+            self._conn_tasks.discard(task)
+
+    async def _serve_request(
+        self, writer: asyncio.StreamWriter, lock: asyncio.Lock, msg: dict
+    ) -> None:
+        rid = msg.get("id")
+        method = msg.get("method", "")
+        params = msg.get("params") or {}
+        try:
+            fn = getattr(self.handler, method, None)
+            if fn is None or method.startswith("_"):
+                raise AttributeError(f"unknown method {method!r}")
+            result = fn(**params)
+            if inspect.isasyncgen(result):
+                try:
+                    async for item in result:
+                        async with lock:
+                            write_frame(writer, {"id": rid, "stream": item})
+                            await asyncio.wait_for(
+                                writer.drain(), STREAM_DRAIN_TIMEOUT_S
+                            )
+                    async with lock:
+                        write_frame(writer, {"id": rid, "done": True})
+                        await writer.drain()
+                except asyncio.TimeoutError:
+                    pass  # stalled client: drop the stream
+                finally:
+                    # run generator cleanup (detach transient readers) even
+                    # when the request task is cancelled at a yield point
+                    await asyncio.shield(result.aclose())
+                return
+            if inspect.isawaitable(result):
+                result = await result
+            async with lock:
+                write_frame(writer, {"id": rid, "result": result})
+                await writer.drain()
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionError, BrokenPipeError):
+            return
+        except Exception as e:  # noqa: BLE001 - errors cross the RPC boundary
+            try:
+                async with lock:
+                    write_frame(
+                        writer,
+                        {"id": rid, "error": f"{type(e).__name__}: {e}"},
+                    )
+                    await writer.drain()
+            except (ConnectionError, BrokenPipeError):
+                pass

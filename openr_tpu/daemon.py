@@ -1,0 +1,149 @@
+"""Daemon runner — brings up OpenrNode(s) WITH the ctrl server listening,
+the way the reference's main() finishes bring-up by serving OpenrCtrlCpp
+on the ctrl port (openr/Main.cpp:463-492).
+
+Two modes:
+
+  * ``run_node``: one node (caller supplies the network providers), ctrl
+    server on ``config.openr_ctrl_port`` — the library-level daemon.
+  * ``python -m openr_tpu --emulate N [--topology ring|line|grid]``: an
+    N-node emulated network in one process, each node's ctrl server on
+    ``base_port + i`` so breeze can target any of them.  This is the
+    moral equivalent of the reference's netns labs (openr/orie/labs/)
+    without needing root: the wire is simulated, the API plane is real
+    TCP.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.common.runtime import Clock, WallClock
+from openr_tpu.config import OpenrConfig
+from openr_tpu.ctrl.server import OpenrCtrlServer
+from openr_tpu.main import OpenrNode
+
+
+async def run_node(
+    config: OpenrConfig,
+    clock: Clock,
+    io_provider,
+    kv_transport,
+    fib_agent=None,
+    ctrl_host: str = "127.0.0.1",
+    ctrl_port: Optional[int] = None,
+) -> Tuple[OpenrNode, OpenrCtrlServer]:
+    """Start one node + its ctrl server; returns both (caller owns stop)."""
+    node = OpenrNode(
+        config=config,
+        clock=clock,
+        io_provider=io_provider,
+        kv_transport=kv_transport,
+        fib_agent=fib_agent,
+    )
+    node.start()
+    server = OpenrCtrlServer(
+        node,
+        host=ctrl_host,
+        port=config.openr_ctrl_port if ctrl_port is None else ctrl_port,
+    )
+    await server.start()
+    return node, server
+
+
+async def run_emulation(
+    n: int, topology: str, base_port: int, verbose: bool = True
+) -> None:
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import grid_edges, line_edges, ring_edges
+
+    if topology == "grid":
+        side = int(n ** 0.5)
+        if side * side != n:
+            raise SystemExit(
+                f"--topology grid needs a square node count, got {n}"
+            )
+    edges = {
+        "line": lambda: line_edges(n),
+        "ring": lambda: ring_edges(n),
+        "grid": lambda: grid_edges(int(n ** 0.5)),
+    }[topology]()
+    net = EmulatedNetwork(WallClock())
+    net.build(edges)
+    net.start()
+    servers: List[OpenrCtrlServer] = []
+    for i, (name, node) in enumerate(sorted(net.nodes.items())):
+        server = OpenrCtrlServer(node, port=base_port + i)
+        await server.start()
+        servers.append(server)
+        if verbose:
+            print(f"{name}: ctrl on 127.0.0.1:{server.port}")
+    if verbose:
+        print(f"{len(net.nodes)} nodes up; try: "
+              f"python -m openr_tpu.cli.breeze --port {base_port} spark neighbors")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover - non-unix
+            pass
+    await stop.wait()
+    for s in servers:
+        await s.stop()
+    await net.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="openr_tpu", description=__doc__)
+    p.add_argument("--config", help="OpenrConfig JSON file (single-node mode)")
+    p.add_argument("--emulate", type=int, default=0, metavar="N",
+                   help="run an N-node emulated network in-process")
+    p.add_argument("--topology", default="line",
+                   choices=["line", "ring", "grid"])
+    p.add_argument("--ctrl-base-port", type=int, default=2018)
+    args = p.parse_args(argv)
+
+    if args.emulate:
+        asyncio.run(
+            run_emulation(args.emulate, args.topology, args.ctrl_base_port)
+        )
+        return
+    if args.config:
+        # Single real node: the physical UDP/TCP network plane is not wired
+        # up yet (in-process providers only); a 1-node "network" still
+        # serves the full ctrl/CLI surface.
+        with open(args.config) as f:
+            config = OpenrConfig.from_json(f.read())
+
+        async def single():
+            from openr_tpu.emulation.network import EmulatedNetwork
+
+            net = EmulatedNetwork(WallClock())
+            net.add_node(config.node_name, config)
+            net.start()
+            node = net.nodes[config.node_name]
+            server = OpenrCtrlServer(node, port=args.ctrl_base_port)
+            await server.start()
+            print(f"{config.node_name}: ctrl on 127.0.0.1:{server.port}")
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                try:
+                    loop.add_signal_handler(sig, stop.set)
+                except NotImplementedError:  # pragma: no cover
+                    pass
+            await stop.wait()
+            await server.stop()
+            await net.stop()
+
+        asyncio.run(single())
+        return
+    p.error("need --config or --emulate N")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,16 @@ class Dispatcher(Actor):
         self._subscribers.append((tuple(key_prefixes), q))
         return reader
 
+    def remove_reader(self, reader: RQueue) -> None:
+        """Unsubscribe a transient reader (ctrl streams / long-polls); the
+        reference drops the ServerStreamPublisher on stream close
+        (OpenrCtrlHandler.h:364-399)."""
+        for i, (_, q) in enumerate(self._subscribers):
+            if q.remove_reader(reader):
+                q.close()
+                del self._subscribers[i]
+                return
+
     def start(self) -> None:
         self.spawn_queue_loop(
             self.kv_store_updates_reader, self._on_publication, "dispatcher.main"

@@ -18,7 +18,8 @@ or dryrun log-only Fib.h:352), with:
 from __future__ import annotations
 
 import asyncio
-from typing import Callable, Dict, List, Optional
+import collections
+from typing import Callable, Deque, Dict, List, Optional
 
 from openr_tpu import constants as C
 from openr_tpu.common.runtime import Actor, Clock, CounterMap
@@ -31,7 +32,7 @@ from openr_tpu.decision.rib import (
     RibUnicastEntry,
 )
 from openr_tpu.messaging.queue import RQueue, ReplicateQueue
-from openr_tpu.types import InitializationEvent, MplsRoute, UnicastRoute
+from openr_tpu.types import InitializationEvent, MplsRoute, PerfEvents, UnicastRoute
 
 
 class FibAgentError(RuntimeError):
@@ -151,6 +152,12 @@ class Fib(Actor):
         self._synced = False
         self._agent_alive_since: Optional[float] = None
         self._retry_wakeup: Optional[asyncio.Future] = None
+        #: convergence breadcrumb history, newest last (reference keeps a
+        #: kPerfBufferSize=10 ring exposed via getPerfDb,
+        #: Constants.h:204-208, if/OpenrCtrl.thrift:465)
+        self.perf_db: Deque[PerfEvents] = collections.deque(
+            maxlen=C.PERF_BUFFER_SIZE
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -198,6 +205,11 @@ class Fib(Actor):
             self.counters.set(
                 "fib.convergence_time_ms", update.perf_events.total_duration_ms()
             )
+            self.perf_db.append(update.perf_events)
+
+    def get_perf_db(self) -> List[PerfEvents]:
+        """ctrl API getPerfDb (if/OpenrCtrl.thrift:465)."""
+        return list(self.perf_db)
 
     async def _program_incremental(self, update: DecisionRouteUpdate) -> None:
         if self.dryrun:

@@ -264,8 +264,8 @@ class OpenrNode:
             config.persistent_store_path or "",
             dryrun=not config.persistent_store_path,
         )
-        # node-scoped key so several nodes/daemons sharing one store file
-        # (emulation, multi-instance hosts) never cross-contaminate
+        # key is node-scoped as defense-in-depth; the store FILE itself is
+        # single-writer (config derivation node-scopes the default path)
         self._drain_state_key = f"link-monitor-config:{self.name}"
         drain = self.persistent_store.load(self._drain_state_key)
         if drain:

@@ -192,6 +192,18 @@ class ReplicateQueue(Generic[T]):
     def get_num_readers(self) -> int:
         return len(self._readers)
 
+    def remove_reader(self, reader: RQueue[T]) -> bool:
+        """Detach one reader (transient ctrl-stream subscribers); its queue
+        is closed so a parked get() raises QueueClosedError.  Returns
+        whether the reader belonged to this queue."""
+        for i, handle in enumerate(self._reader_handles):
+            if handle is reader:
+                self._readers[i].close()
+                del self._readers[i]
+                del self._reader_handles[i]
+                return True
+        return False
+
     def get_num_writes(self) -> int:
         return self.num_writes
 

@@ -84,6 +84,7 @@ class Monitor(Actor):
         self._metrics_interval = metrics_interval_s
         self._forward = forward_fn
         self.system_metrics = SystemMetrics()
+        self._start_time = clock.now()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -126,5 +127,7 @@ class Monitor(Actor):
         cpu = self.system_metrics.cpu_pct()
         if cpu is not None:
             self.counters.set("process.cpu.pct", cpu)
-        self.counters.set("process.uptime.seconds", self.clock.now())
+        self.counters.set(
+            "process.uptime.seconds", self.clock.now() - self._start_time
+        )
         self.touch()

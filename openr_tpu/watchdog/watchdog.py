@@ -77,11 +77,20 @@ class Watchdog(Actor):
         self.counters.bump("watchdog.checks")
         now = self.clock.now()
         for actor in self._actors:
-            if actor.healthy:
+            if actor.fiber_failed:
+                # A module fiber died with an exception: the module can no
+                # longer process its queues — crash promptly (the reference
+                # aborts on a stuck evb; a dead fiber is our equivalent and
+                # is detectable immediately, no need to wait out a timeout).
+                self._crash(f"Module {actor.name} fiber died")
+                continue
+            if not actor._stopped:
                 # The asyncio analogue of the reference's no-op evb timer:
-                # a live, uncrashed actor gets its timestamp refreshed, so
-                # only crashed modules (dead fibers) read as stalled.  An
+                # a live, uncrashed actor gets its timestamp refreshed.  An
                 # idle module on a quiet network is healthy, not stuck.
+                # (A fiber deadlocked on a never-resolved await is NOT
+                # caught here — modules doing long work must touch()
+                # themselves, as spawn_queue_loop does per item.)
                 actor.touch()
             stall = now - actor.last_heartbeat
             self.counters.set(f"watchdog.stall_time_ms.{actor.name}", stall * 1000)

@@ -1,0 +1,152 @@
+"""breeze CLI tests (reference: py/openr/cli/tests/* — click CliRunner
+driving per-module commands; ours run against a real 2-node emulated
+network served over the TCP ctrl server instead of a mocked client, which
+exercises CLI + transport + handler in one pass)."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+from click.testing import CliRunner
+
+from openr_tpu.cli.breeze import breeze
+from openr_tpu.common.runtime import WallClock
+from openr_tpu.ctrl.server import OpenrCtrlServer
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import line_edges
+from openr_tpu.types import adj_key
+
+
+@pytest.fixture(scope="module")
+def live_node():
+    """A 2-node wall-clock network + ctrl server on a background loop.
+
+    The CLI runs asyncio.run() internally, so the server must live on a
+    different thread's loop — exactly the daemon-vs-CLI process split.
+    """
+    started = threading.Event()
+    stop = None
+    result = {}
+
+    def runner():
+        nonlocal stop
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        result["loop"] = loop
+        stop = asyncio.Event()
+
+        async def main():
+            clock = WallClock()
+            net = EmulatedNetwork(clock)
+            net.build(line_edges(2))
+            net.start()
+            server = OpenrCtrlServer(net.nodes["node0"], port=0)
+            await server.start()
+            result["port"] = server.port
+            result["net"] = net
+            # wait for spark establishment + adj advertisement
+            for _ in range(200):
+                if adj_key("node1") in net.nodes["node0"].kv_store.dump_all("0"):
+                    break
+                await asyncio.sleep(0.1)
+            started.set()
+            await stop.wait()
+            await server.stop()
+            await net.stop()
+
+        loop.run_until_complete(main())
+        loop.close()
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    assert started.wait(timeout=60), "live node failed to start"
+    yield result["port"]
+    result["loop"].call_soon_threadsafe(stop.set)
+    t.join(timeout=30)
+
+
+def _run(port, *args):
+    r = CliRunner().invoke(breeze, ["--port", str(port), *args], obj={})
+    assert r.exit_code == 0, r.output
+    return r.output
+
+
+def test_cli_openr_group(live_node):
+    assert _run(live_node, "openr", "node-name").strip() == "node0"
+    v = json.loads(_run(live_node, "openr", "version"))
+    assert v["version"] >= v["lowestSupportedVersion"]
+    out = _run(live_node, "openr", "init-events")
+    assert "INITIALIZING" in out
+
+
+def test_cli_config_show(live_node):
+    cfg = json.loads(_run(live_node, "config", "show"))
+    assert cfg["node_name"] == "node0"
+
+
+def test_cli_kvstore_group(live_node):
+    out = _run(live_node, "kvstore", "keys")
+    assert adj_key("node0") in out and adj_key("node1") in out
+    out = _run(live_node, "kvstore", "keys", "--prefix", "prefix:")
+    assert "adj:" not in out
+    kv = json.loads(_run(live_node, "kvstore", "key-vals", adj_key("node1")))
+    assert kv[adj_key("node1")]["originator_id"] == "node1"
+    out = _run(live_node, "kvstore", "peers")
+    assert "node1" in out and "INITIALIZED" in out
+    summ = json.loads(_run(live_node, "kvstore", "summary"))
+    assert "0" in summ
+
+
+def test_cli_decision_and_fib(live_node):
+    routes = json.loads(_run(live_node, "decision", "routes"))
+    assert routes["this_node_name"] == "node0"
+    assert routes["unicast_routes"]
+    out = _run(live_node, "decision", "adj")
+    assert "node0" in out and "-> node1" in out
+    fib = json.loads(_run(live_node, "fib", "routes"))
+    assert fib["unicast_routes"]
+    dest = fib["unicast_routes"][0]["dest"]
+    filtered = json.loads(_run(live_node, "fib", "unicast", dest))
+    assert filtered and filtered[0]["dest"] == dest
+
+
+def test_cli_lm_drain_cycle(live_node):
+    out = _run(live_node, "lm", "set-node-overload")
+    assert "drained" in out
+    links = json.loads(_run(live_node, "lm", "links"))
+    assert links["is_overloaded"] is True
+    _run(live_node, "lm", "unset-node-overload")
+    links = json.loads(_run(live_node, "lm", "links"))
+    assert links["is_overloaded"] is False
+
+
+def test_cli_spark_neighbors(live_node):
+    out = _run(live_node, "spark", "neighbors")
+    assert "node1" in out and "ESTABLISHED" in out
+
+
+def test_cli_prefixmgr_cycle(live_node):
+    _run(live_node, "prefixmgr", "advertise", "44.4.0.0/16")
+    view = _run(live_node, "prefixmgr", "view")
+    assert "44.4.0.0/16" in view
+    _run(live_node, "prefixmgr", "withdraw", "44.4.0.0/16")
+    view = _run(live_node, "prefixmgr", "view")
+    assert "44.4.0.0/16" not in view
+
+
+def test_cli_monitor_counters(live_node):
+    counters = json.loads(_run(live_node, "monitor", "counters", "--prefix", "kvstore."))
+    assert counters and all(k.startswith("kvstore.") for k in counters)
+
+
+def test_cli_kvstore_snoop_snapshot(live_node):
+    out = _run(live_node, "kvstore", "snoop", "--count", "1", "--prefix", "adj:")
+    pub = json.loads(out.strip().splitlines()[0])
+    assert adj_key("node0") in pub["key_vals"]
+
+
+def test_cli_tech_support(live_node):
+    out = _run(live_node, "tech-support")
+    for section in ("version", "routes", "kvstore-summary", "counters"):
+        assert f"= {section} =" in out

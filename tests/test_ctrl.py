@@ -1,0 +1,389 @@
+"""Ctrl API surface + transport tests.
+
+Reference models: openr/ctrl-server/tests/OpenrCtrlHandlerTest.cpp (method
+surface over live modules), LongPollTest.cpp, and the breeze client tests.
+Handler tests run in-process over a converged emulated network in virtual
+time; transport tests exercise the TCP framed-JSON server/client on a real
+socket.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu import constants as C
+from openr_tpu.common.runtime import SimClock, WallClock
+from openr_tpu.ctrl.client import OpenrCtrlClient, OpenrCtrlError
+from openr_tpu.ctrl.handler import OpenrCtrlHandler
+from openr_tpu.ctrl.server import OpenrCtrlServer
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import line_edges
+from openr_tpu.types import InitializationEvent, Value, adj_key
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+CONVERGE_S = 12.0
+
+
+async def converged_net(clock, n=3):
+    net = EmulatedNetwork(clock)
+    net.build(line_edges(n))
+    net.start()
+    await clock.run_for(CONVERGE_S)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    return net
+
+
+# ---------------------------------------------------------------------------
+# handler surface (in-process, virtual time)
+# ---------------------------------------------------------------------------
+
+
+def test_handler_info_and_counters():
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        h = OpenrCtrlHandler(net.nodes["node0"])
+        assert h.get_node_name() == "node0"
+        v = h.get_openr_version()
+        assert v["version"] >= v["lowestSupportedVersion"]
+        assert h.initialization_converged() is True
+        evs = h.get_initialization_events()
+        assert int(InitializationEvent.INITIALIZED) in evs
+        counters = h.get_counters()
+        assert counters["decision.route_build_runs"] >= 1
+        sub = h.get_regex_counters("decision.")
+        assert sub and all(k.startswith("decision.") for k in sub)
+        cfg = json.loads(h.get_running_config())
+        assert cfg["node_name"] == "node0"
+        await net.stop()
+
+    run(main())
+
+
+def test_handler_routes_and_adj_dbs():
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 3)
+        h = OpenrCtrlHandler(net.nodes["node0"])
+        rdb = h.get_route_db()
+        dests = [r["dest"] for r in rdb["unicast_routes"]]
+        assert net.loopback("node2") in dests
+        fib = h.get_fib_routes()
+        assert sorted(r["dest"] for r in fib["unicast_routes"]) == sorted(dests)
+        # route db computed for a *different* node (OpenrCtrl.thrift:482)
+        other = h.get_route_db_computed("node2")
+        other_dests = [r["dest"] for r in other["unicast_routes"]]
+        assert net.loopback("node0") in other_dests
+        adj_dbs = h.get_decision_adjacency_dbs()
+        names = {db["this_node_name"] for db in adj_dbs}
+        assert names == {"node0", "node1", "node2"}
+        filtered = h.get_unicast_routes_filtered([net.loopback("node2")])
+        assert len(filtered) == 1
+        assert h.fib_synced() is True
+        assert len(h.get_perf_db()) >= 1
+        await net.stop()
+
+    run(main())
+
+
+def test_handler_kvstore_and_neighbors():
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        h = OpenrCtrlHandler(net.nodes["node0"])
+        dump = h.dump_kv_store_area()
+        assert adj_key("node0") in dump and adj_key("node1") in dump
+        got = h.get_kv_store_key_vals_area([adj_key("node1")])
+        assert got[adj_key("node1")]["originator_id"] == "node1"
+        summaries = h.get_kv_store_area_summaries()
+        assert summaries[C.DEFAULT_AREA]["key_vals_count"] == len(dump)
+        peers = h.get_kv_store_peers_area()
+        assert "node1" in peers
+        nbrs = h.get_spark_neighbors()
+        assert [n["node_name"] for n in nbrs] == ["node1"]
+        assert nbrs[0]["state"] == "ESTABLISHED"
+        ifaces = h.get_interfaces()
+        assert ifaces["is_overloaded"] is False
+        assert ifaces["interface_details"]
+        await net.stop()
+
+    run(main())
+
+
+def test_handler_drain_and_advertise():
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 3)
+        h0 = OpenrCtrlHandler(net.nodes["node0"])
+        h1 = OpenrCtrlHandler(net.nodes["node1"])
+        # drain middle node -> node0 loses transit route to node2
+        h1.set_node_overload()
+        await clock.run_for(3)
+        assert h1.get_interfaces()["is_overloaded"] is True
+        routes = net.fib_routes("node0")
+        assert net.loopback("node2") not in routes
+        h1.unset_node_overload()
+        await clock.run_for(3)
+        assert net.loopback("node2") in net.fib_routes("node0")
+        # prefix advertise/withdraw through the API
+        h0.advertise_prefixes([{"prefix": "99.1.0.0/16"}])
+        await clock.run_for(3)
+        assert "99.1.0.0/16" in net.fib_routes("node2")
+        advertised = [p["prefix"] for p in h0.get_advertised_routes()]
+        assert "99.1.0.0/16" in advertised
+        h0.withdraw_prefixes([{"prefix": "99.1.0.0/16"}])
+        await clock.run_for(3)
+        assert "99.1.0.0/16" not in net.fib_routes("node2")
+        await net.stop()
+
+    run(main())
+
+
+def test_handler_rib_policy_roundtrip():
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        h = OpenrCtrlHandler(net.nodes["node0"])
+        assert h.get_rib_policy() is None
+        h.set_rib_policy(
+            {
+                "ttl_remaining_s": 300,
+                "statements": [
+                    {
+                        "name": "s1",
+                        "prefixes": [net.loopback("node1")],
+                        "action": {"default_weight": 3},
+                    }
+                ],
+            }
+        )
+        pol = h.get_rib_policy()
+        assert pol["statements"][0]["name"] == "s1"
+        assert 0 < pol["ttl_remaining_s"] <= 300
+        h.clear_rib_policy()
+        assert h.get_rib_policy() is None
+        with pytest.raises(ValueError):
+            h.set_rib_policy({"ttl_remaining_s": 0, "statements": []})
+        await net.stop()
+
+    run(main())
+
+
+def test_handler_kvstore_stream_snapshot_plus_delta():
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        node = net.nodes["node0"]
+        h = OpenrCtrlHandler(node)
+        items = []
+
+        async def consume():
+            async for item in h.subscribe_and_get_kv_store(
+                key_prefixes=["adj:"]
+            ):
+                items.append(item)
+
+        task = asyncio.get_running_loop().create_task(consume())
+        await clock.run_for(1)
+        # snapshot first: one publication containing both adj keys
+        assert len(items) == 1
+        assert set(items[0]["key_vals"]) == {adj_key("node0"), adj_key("node1")}
+        # a topology change streams an incremental delta
+        net.nodes["node1"].set_link_metric(
+            net.nodes["node1"].link_monitor.build_adjacency_database(
+                C.DEFAULT_AREA
+            ).adjacencies[0].if_name,
+            7777,
+        )
+        await clock.run_for(3)
+        assert len(items) >= 2
+        assert adj_key("node1") in items[-1]["key_vals"]
+        task.cancel()
+        await clock.run_for(0.1)
+        await net.stop()
+
+    run(main())
+
+
+def test_handler_fib_stream():
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        h = OpenrCtrlHandler(net.nodes["node0"])
+        items = []
+
+        async def consume():
+            async for item in h.subscribe_and_get_fib():
+                items.append(item)
+
+        task = asyncio.get_running_loop().create_task(consume())
+        await clock.run_for(1)
+        assert len(items) == 1  # snapshot RouteDatabase
+        assert "unicast_routes" in items[0]
+        net.nodes["node1"].advertise_prefixes(
+            [__import__("openr_tpu.types", fromlist=["PrefixEntry"]).PrefixEntry("55.5.0.0/16")]
+        )
+        await clock.run_for(3)
+        deltas = items[1:]
+        assert any(
+            "55.5.0.0/16" in [r["dest"] for r in d.get("unicast_routes_to_update", [])]
+            for d in deltas
+        )
+        task.cancel()
+        await clock.run_for(0.1)
+        await net.stop()
+
+    run(main())
+
+
+def test_handler_long_poll_adj():
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        node = net.nodes["node0"]
+        h = OpenrCtrlHandler(node)
+        # stale snapshot -> immediate True
+        assert await h.long_poll_kv_store_adj_area(snapshot={}) is True
+        # current snapshot -> parks; adjacency change wakes it
+        current = {
+            k: v.version
+            for k, v in node.kv_store.dump_all(C.DEFAULT_AREA, "adj:").items()
+        }
+        fut = asyncio.get_running_loop().create_task(
+            h.long_poll_kv_store_adj_area(snapshot=current)
+        )
+        await clock.run_for(1)
+        assert not fut.done()
+        node.set_node_metric_increment(50)  # bumps adj: key version
+        await clock.run_for(3)
+        assert fut.done() and fut.result() is True
+        # current snapshot + no change -> False after hold time
+        current2 = {
+            k: v.version
+            for k, v in node.kv_store.dump_all(C.DEFAULT_AREA, "adj:").items()
+        }
+        fut2 = asyncio.get_running_loop().create_task(
+            h.long_poll_kv_store_adj_area(snapshot=current2)
+        )
+        await clock.run_for(C.LONG_POLL_REQ_HOLD_TIME_S + 1)
+        assert fut2.done() and fut2.result() is False
+        await net.stop()
+
+    run(main())
+
+
+def test_stream_reader_cleanup():
+    """Transient subscribers must not leave backlogged readers behind
+    (the reference drops the ServerStreamPublisher on stream close)."""
+
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        node = net.nodes["node0"]
+        h = OpenrCtrlHandler(node)
+        before = len(node.dispatcher.get_filters())
+        gen = h.subscribe_and_get_kv_store(key_prefixes=["adj:"])
+        assert (await gen.__anext__()) is not None
+        assert len(node.dispatcher.get_filters()) == before + 1
+        await gen.aclose()
+        assert len(node.dispatcher.get_filters()) == before
+        await net.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# TCP transport (real sockets, wall clock)
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_server_unary_stream_and_error():
+    async def main():
+        clock = WallClock()
+        net = EmulatedNetwork(clock)
+        net.build(line_edges(2))
+        net.start()
+        node = net.nodes["node0"]
+        server = OpenrCtrlServer(node, port=0)
+        await server.start()
+        try:
+            async with OpenrCtrlClient(port=server.port) as client:
+                # unary
+                assert await client.call("get_node_name") == "node0"
+                counters = await client.call("get_counters")
+                assert isinstance(counters, dict)
+                # adjacencies appear once Spark establishes (~2s wall time)
+                for _ in range(100):
+                    dump = await client.call(
+                        "dump_kv_store_area", prefix="adj:", area=C.DEFAULT_AREA
+                    )
+                    if adj_key("node0") in dump:
+                        break
+                    await asyncio.sleep(0.1)
+                assert adj_key("node0") in dump
+                # concurrent unary calls multiplex over one connection
+                r = await asyncio.gather(
+                    client.call("get_node_name"),
+                    client.call("get_openr_version"),
+                    client.call("fib_synced"),
+                )
+                assert r[0] == "node0" and "version" in r[1]
+                # errors propagate
+                with pytest.raises(OpenrCtrlError):
+                    await client.call("no_such_method")
+                with pytest.raises(OpenrCtrlError):
+                    await client.call("get_kv_store_peers_area", area="nope")
+                # stream: snapshot arrives, then cancel mid-stream
+                filters_before = len(node.dispatcher.get_filters())
+                items = []
+                async for item in client.stream(
+                    "subscribe_and_get_kv_store", key_prefixes=["adj:"]
+                ):
+                    items.append(item)
+                    break  # cancels server-side
+                assert items and adj_key("node0") in items[0]["key_vals"]
+                # after cancel the transient dispatcher reader is dropped
+                for _ in range(50):
+                    if len(node.dispatcher.get_filters()) == filters_before:
+                        break
+                    await asyncio.sleep(0.1)
+                assert len(node.dispatcher.get_filters()) == filters_before
+        finally:
+            await server.stop()
+            await net.stop()
+
+    run(main())
+
+
+def test_tcp_long_poll_roundtrip():
+    async def main():
+        clock = WallClock()
+        net = EmulatedNetwork(clock)
+        net.build(line_edges(2))
+        net.start()
+        node = net.nodes["node0"]
+        server = OpenrCtrlServer(node, port=0)
+        await server.start()
+        try:
+            async with OpenrCtrlClient(port=server.port) as client:
+                assert (
+                    await client.call(
+                        "long_poll_kv_store_adj_area", snapshot={}
+                    )
+                    is True
+                )
+        finally:
+            await server.stop()
+            await net.stop()
+
+    run(main())
