@@ -161,6 +161,27 @@ def kvstore_summary(ctx: click.Context) -> None:
     _print(_call(ctx, "get_kv_store_area_summaries"))
 
 
+@kvstore.command("flood-topo")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.pass_context
+def kvstore_flood_topo(ctx: click.Context, area: str) -> None:
+    """DUAL flood-optimization spanning-tree state per root."""
+    resp = _call(ctx, "get_kv_store_flood_topo_area", area=area)
+    if not resp["enabled"]:
+        click.echo("flood optimization disabled")
+        return
+    if not resp["roots"]:
+        click.echo("no flood root discovered yet")
+        return
+    for root, info in sorted(resp["roots"].items()):
+        mark = "*" if info["is_chosen"] else " "
+        click.echo(
+            f"{mark} root={root:16} nexthop={info['nexthop'] or '-':16} "
+            f"distance={info['distance']} passive={info['passive']} "
+            f"children={','.join(info['children']) or '-'}"
+        )
+
+
 @kvstore.command("snoop")
 @click.option("--area", default=None)
 @click.option("--prefix", "prefixes", multiple=True)

@@ -94,6 +94,9 @@ class SparkConfig:
     #: minimum/maximum neighbor discovery window during initialization
     min_neighbor_discovery_interval_s: float = 2.0
     max_neighbor_discovery_interval_s: float = 10.0
+    #: advertised in the handshake so peers know whether we speak DUAL
+    #: (wired from KvStoreConfig.enable_flood_optimization by the daemon)
+    enable_flood_optimization: bool = False
 
 
 @dataclass

@@ -240,6 +240,13 @@ class OpenrCtrlHandler:
         db = self.node.kv_store.areas[area]
         return {name: int(p.state) for name, p in db.peers.items()}
 
+    def get_kv_store_flood_topo_area(
+        self, area: str = C.DEFAULT_AREA
+    ) -> Dict[str, object]:
+        """SPT infos per discovered flood root (getKvStoreFloodTopoArea)."""
+        topo = self.node.kv_store.get_flood_topo(area)
+        return {"enabled": topo is not None, "roots": topo or {}}
+
     # ----------------------------------------------------------------- spark
 
     def get_spark_neighbors(self) -> List[dict]:

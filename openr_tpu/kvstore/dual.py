@@ -1,0 +1,598 @@
+"""DUAL — Diffusing Update Algorithm forming the KvStore flood topology.
+
+Re-implementation of the reference's flood-optimization library
+(openr/kvstore/Dual.{h,cpp}; protocol spec in
+docs/Features/FloodOptimization.md; algorithm per Garcia-Luna-Aceves,
+"Loop-Free Routing Using Diffusing Computations").  Each node runs one
+`Dual` computation per discovered root; all nodes converge on a spanning
+tree (SPT) rooted at the smallest-named root with a valid route, and
+KvStore floods publications only to its SPT parent + children, reducing
+flood complexity from O(E) to O(V).
+
+State per (node, root):
+  * distance / report-distance / feasible-distance — classic DUAL triplet
+  * a five-state machine PASSIVE / ACTIVE0..3 (Dual.h:27-35)
+  * per-neighbor report-distance, expect-reply, need-to-reply
+  * `cornet` — stack of pending queries awaiting our reply
+
+Messages (if/Dual.thrift): UPDATE (report-distance change), QUERY (start
+a diffusing computation), REPLY (diffusing ack).  All emission is
+collected into a `MsgBatch` (neighbor -> DualMessages) so the caller owns
+I/O; `DualNode` subclasses plug in the wire.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+INF = 2**63 - 1  # int64 max == "unreachable" (reference uses INT64_MAX)
+
+
+class DualState(enum.Enum):
+    PASSIVE = "PASSIVE"
+    ACTIVE0 = "ACTIVE0"
+    ACTIVE1 = "ACTIVE1"
+    ACTIVE2 = "ACTIVE2"
+    ACTIVE3 = "ACTIVE3"
+
+
+class DualEvent(enum.Enum):
+    QUERY_FROM_SUCCESSOR = "QUERY_FROM_SUCCESSOR"
+    LAST_REPLY = "LAST_REPLY"
+    INCREASE_D = "INCREASE_D"
+    OTHERS = "OTHERS"
+
+
+class DualMessageType(enum.Enum):
+    UPDATE = 1
+    QUERY = 2
+    REPLY = 3
+
+
+@dataclass
+class DualMessage:
+    """One DUAL PDU (if/Dual.thrift DualMessage)."""
+
+    dst_id: str  # root the message concerns
+    distance: int
+    type: DualMessageType
+
+
+@dataclass
+class DualMessages:
+    """Batch of PDUs from one sender (if/Dual.thrift DualMessages)."""
+
+    src_id: str
+    messages: List[DualMessage] = field(default_factory=list)
+
+
+#: neighbor-id -> messages accumulated for it during one event
+MsgBatch = Dict[str, List[DualMessage]]
+
+
+@dataclass
+class DualPerRootCounters:
+    query_sent: int = 0
+    query_recv: int = 0
+    reply_sent: int = 0
+    reply_recv: int = 0
+    update_sent: int = 0
+    update_recv: int = 0
+    total_sent: int = 0
+    total_recv: int = 0
+
+
+def _add(d1: int, d2: int) -> int:
+    """Saturating distance addition."""
+    return INF if (d1 == INF or d2 == INF) else d1 + d2
+
+
+class DualStateMachine:
+    """The five-state DUAL FSM (Dual.cpp:15-62; states per the
+    Cornell/lunes93 paper).  `fc` = feasible condition held."""
+
+    def __init__(self) -> None:
+        self.state = DualState.PASSIVE
+
+    def process_event(self, event: DualEvent, fc: bool = True) -> None:
+        s, E = self.state, DualEvent
+        if s == DualState.PASSIVE:
+            if not fc:
+                self.state = (
+                    DualState.ACTIVE3
+                    if event == E.QUERY_FROM_SUCCESSOR
+                    else DualState.ACTIVE1
+                )
+        elif s == DualState.ACTIVE0:
+            if event == E.LAST_REPLY:
+                self.state = DualState.PASSIVE if fc else DualState.ACTIVE2
+        elif s == DualState.ACTIVE1:
+            if event == E.INCREASE_D:
+                self.state = DualState.ACTIVE0
+            elif event == E.LAST_REPLY:
+                self.state = DualState.PASSIVE
+            elif event == E.QUERY_FROM_SUCCESSOR:
+                self.state = DualState.ACTIVE2
+        elif s == DualState.ACTIVE2:
+            if event == E.LAST_REPLY:
+                self.state = DualState.PASSIVE if fc else DualState.ACTIVE3
+        elif s == DualState.ACTIVE3:
+            if event == E.LAST_REPLY:
+                self.state = DualState.PASSIVE
+            elif event == E.INCREASE_D:
+                self.state = DualState.ACTIVE2
+
+
+@dataclass
+class _NeighborInfo:
+    report_distance: int = INF
+    expect_reply: bool = False
+    need_to_reply: bool = False
+
+
+@dataclass
+class RouteInfo:
+    """Route-to-root state (Dual.h RouteInfo)."""
+
+    distance: int = INF
+    report_distance: int = INF
+    feasible_distance: int = INF
+    nexthop: Optional[str] = None
+    sm: DualStateMachine = field(default_factory=DualStateMachine)
+    neighbor_infos: Dict[str, _NeighborInfo] = field(default_factory=dict)
+    cornet: List[str] = field(default_factory=list)  # pending-query stack
+
+    def __str__(self) -> str:
+        return (
+            f"[{self.sm.state.value}] {self.nexthop or 'None'} "
+            f"({self.distance}, {self.report_distance}, "
+            f"{self.feasible_distance})"
+        )
+
+
+class Dual:
+    """One diffusing computation toward one root (Dual.h:66)."""
+
+    def __init__(
+        self,
+        node_id: str,
+        root_id: str,
+        local_distances: Dict[str, int],
+        nexthop_cb: Optional[
+            Callable[[Optional[str], Optional[str]], None]
+        ] = None,
+    ) -> None:
+        self.node_id = node_id
+        self.root_id = root_id
+        self.info = RouteInfo()
+        # the caller owns this table: one shared link-cost dict for every
+        # root's computation; the CALLER must record cost changes in it
+        # before invoking peer_up/peer_down (DualNode does exactly that)
+        self.local_distances = local_distances
+        self.counters: Dict[str, DualPerRootCounters] = {}
+        self.nexthop_cb = nexthop_cb
+        self.children_: Set[str] = set()
+        if node_id == root_id:
+            # I am the root: distance 0, my own nexthop
+            self.info.distance = 0
+            self.info.report_distance = 0
+            self.info.feasible_distance = 0
+            self.info.nexthop = node_id
+
+    # -- small helpers -----------------------------------------------------
+
+    def _counter(self, neighbor: str) -> DualPerRootCounters:
+        return self.counters.setdefault(neighbor, DualPerRootCounters())
+
+    def _ninfo(self, neighbor: str) -> _NeighborInfo:
+        return self.info.neighbor_infos.setdefault(neighbor, _NeighborInfo())
+
+    def _neighbor_up(self, neighbor: str) -> bool:
+        return self.local_distances.get(neighbor, INF) != INF
+
+    def _set_nexthop(self, new_nh: Optional[str]) -> None:
+        if self.info.nexthop != new_nh:
+            if self.nexthop_cb is not None:
+                self.nexthop_cb(self.info.nexthop, new_nh)
+            self.info.nexthop = new_nh
+
+    def _min_distance(self) -> int:
+        if self.node_id == self.root_id:
+            return 0
+        return min(
+            (
+                _add(ld, self._ninfo(n).report_distance)
+                for n, ld in self.local_distances.items()
+            ),
+            default=INF,
+        )
+
+    def _route_affected(self) -> bool:
+        """Did the latest report-distance/local-distance change move my
+        distance or invalidate my current nexthop?"""
+        if not self.local_distances:
+            return False
+        if self.info.nexthop == self.node_id:
+            return False  # I am the root
+        dmin = self._min_distance()
+        if self.info.distance != dmin:
+            return True
+        if dmin == INF:
+            return False
+        best = {
+            n
+            for n, ld in self.local_distances.items()
+            if _add(ld, self._ninfo(n).report_distance) == dmin
+        }
+        assert self.info.nexthop is not None
+        return self.info.nexthop not in best
+
+    def _meet_feasible_condition(self) -> Optional[tuple]:
+        """SNC: a neighbor with report-distance < my feasible-distance that
+        also attains the current minimum.  Returns (nexthop, distance)."""
+        dmin = self._min_distance()
+        for n, ld in self.local_distances.items():
+            if ld == INF:
+                continue
+            rd = self._ninfo(n).report_distance
+            if rd < self.info.feasible_distance and _add(ld, rd) == dmin:
+                return (n, dmin)
+        return None
+
+    # -- message emission --------------------------------------------------
+
+    def _emit(
+        self,
+        out: MsgBatch,
+        neighbor: str,
+        mtype: DualMessageType,
+        distance: int,
+    ) -> None:
+        out.setdefault(neighbor, []).append(
+            DualMessage(dst_id=self.root_id, distance=distance, type=mtype)
+        )
+        c = self._counter(neighbor)
+        c.total_sent += 1
+        if mtype == DualMessageType.UPDATE:
+            c.update_sent += 1
+        elif mtype == DualMessageType.QUERY:
+            c.query_sent += 1
+        else:
+            c.reply_sent += 1
+
+    def _flood_updates(self, out: MsgBatch) -> None:
+        for n, ld in self.local_distances.items():
+            if ld != INF:
+                self._emit(
+                    out, n, DualMessageType.UPDATE, self.info.report_distance
+                )
+
+    def _send_reply(self, out: MsgBatch) -> None:
+        assert self.info.cornet, "send reply with no pending query"
+        dst = self.info.cornet.pop()
+        if not self._neighbor_up(dst):
+            # link down on my end: if it is merely not-yet-up here, flush
+            # the reply at peer-up; if truly down, the peer sees the
+            # link-down event as an implicit reply
+            self._ninfo(dst).need_to_reply = True
+            return
+        self._emit(out, dst, DualMessageType.REPLY, self.info.report_distance)
+
+    # -- local vs diffusing computation ------------------------------------
+
+    def _local_computation(
+        self, new_nexthop: str, new_distance: int, out: MsgBatch
+    ) -> None:
+        rd_changed = new_distance != self.info.report_distance
+        self._set_nexthop(new_nexthop)
+        self.info.distance = new_distance
+        self.info.report_distance = new_distance
+        self.info.feasible_distance = new_distance
+        if rd_changed:
+            self._flood_updates(out)
+
+    def _diffusing_computation(self, out: MsgBatch) -> bool:
+        """Freeze on the current nexthop, raise distances to its route, and
+        query every up neighbor.  Returns False when nobody is reachable."""
+        assert self.info.nexthop is not None
+        d = _add(
+            self.local_distances[self.info.nexthop],
+            self._ninfo(self.info.nexthop).report_distance,
+        )
+        self.info.distance = d
+        self.info.report_distance = d
+        self.info.feasible_distance = d
+        any_sent = False
+        for n, ld in self.local_distances.items():
+            if ld == INF:
+                continue
+            self._emit(out, n, DualMessageType.QUERY, d)
+            self._ninfo(n).expect_reply = True
+            any_sent = True
+        return any_sent
+
+    def _try_local_or_diffusing(
+        self, event: DualEvent, need_reply: bool, out: MsgBatch
+    ) -> None:
+        if not self._route_affected():
+            if need_reply:
+                self._send_reply(out)
+            return
+        fc = self._meet_feasible_condition()
+        if self.info.nexthop is None:
+            assert fc is not None, "invalid nexthop must meet FC"
+        if fc is not None:
+            self._local_computation(fc[0], fc[1], out)
+            if need_reply:
+                self._send_reply(out)
+        else:
+            if need_reply and event != DualEvent.QUERY_FROM_SUCCESSOR:
+                # a non-successor asked: answer before going active
+                self._send_reply(out)
+            if self._diffusing_computation(out):
+                self.info.sm.process_event(event, fc=False)
+            if self.info.nexthop is not None and not self._neighbor_up(
+                self.info.nexthop
+            ):
+                self._set_nexthop(None)
+
+    # -- input events ------------------------------------------------------
+
+    def peer_up(self, neighbor: str, cost: int, out: MsgBatch) -> None:
+        if self.info.nexthop == neighbor:
+            # stale parent from a non-graceful restart: as-if peer-down.
+            # feasible-distance must also lift to INF: with no successor
+            # there is nothing to be feasible against, and a frozen low fd
+            # could otherwise leave every neighbor infeasible (FC assert)
+            self._set_nexthop(None)
+            self.info.distance = INF
+            self.info.feasible_distance = INF
+        self._ninfo(neighbor)
+        if self.info.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(DualEvent.OTHERS, False, out)
+        elif self._ninfo(neighbor).expect_reply:
+            # the neighbor I was waiting on came (back) up — treat the
+            # reconnect as the reply itself
+            self.process_reply(
+                neighbor,
+                DualMessage(
+                    dst_id=self.root_id,
+                    distance=self._ninfo(neighbor).report_distance,
+                    type=DualMessageType.REPLY,
+                ),
+                out,
+            )
+        # introduce ourselves (route advertisement) to the new neighbor
+        self._emit(
+            out, neighbor, DualMessageType.UPDATE, self.info.report_distance
+        )
+        if self._ninfo(neighbor).need_to_reply:
+            self._ninfo(neighbor).need_to_reply = False
+            self._emit(
+                out, neighbor, DualMessageType.REPLY, self.info.report_distance
+            )
+
+    def peer_down(self, neighbor: str, out: MsgBatch) -> None:
+        self.counters.pop(neighbor, None)
+        self.children_.discard(neighbor)
+        self._ninfo(neighbor).report_distance = INF
+        if self.info.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(DualEvent.INCREASE_D, False, out)
+        else:
+            self.info.sm.process_event(DualEvent.INCREASE_D)
+            if self._ninfo(neighbor).expect_reply:
+                # down == implicit reply of "unreachable"
+                self.process_reply(
+                    neighbor,
+                    DualMessage(
+                        dst_id=self.root_id,
+                        distance=INF,
+                        type=DualMessageType.REPLY,
+                    ),
+                    out,
+                )
+
+    def process_update(
+        self, neighbor: str, update: DualMessage, out: MsgBatch
+    ) -> None:
+        c = self._counter(neighbor)
+        c.update_recv += 1
+        c.total_recv += 1
+        self._ninfo(neighbor).report_distance = update.distance
+        if neighbor not in self.local_distances:
+            return  # UPDATE raced ahead of the link-up event
+        if self.info.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(DualEvent.OTHERS, False, out)
+        else:
+            # active: track live distance, keep rd/fd frozen
+            if self.info.nexthop == neighbor:
+                self.info.distance = _add(
+                    self.local_distances[neighbor], update.distance
+                )
+            self.info.sm.process_event(DualEvent.OTHERS)
+
+    def process_query(
+        self, neighbor: str, query: DualMessage, out: MsgBatch
+    ) -> None:
+        c = self._counter(neighbor)
+        c.query_recv += 1
+        c.total_recv += 1
+        self._ninfo(neighbor).report_distance = query.distance
+        self.info.cornet.append(neighbor)
+        event = (
+            DualEvent.QUERY_FROM_SUCCESSOR
+            if self.info.nexthop == neighbor
+            else DualEvent.OTHERS
+        )
+        if self.info.sm.state == DualState.PASSIVE:
+            self._try_local_or_diffusing(event, True, out)
+        else:
+            if self.info.nexthop == neighbor:
+                self.info.distance = _add(
+                    self.local_distances[neighbor],
+                    self._ninfo(neighbor).report_distance,
+                )
+            self.info.sm.process_event(event)
+            self._send_reply(out)
+
+    def process_reply(
+        self, neighbor: str, reply: DualMessage, out: MsgBatch
+    ) -> None:
+        c = self._counter(neighbor)
+        c.reply_recv += 1
+        c.total_recv += 1
+        ninfo = self._ninfo(neighbor)
+        if not ninfo.expect_reply:
+            return  # link-down already consumed this diffusion; ignore
+        ninfo.report_distance = reply.distance
+        ninfo.expect_reply = False
+        if any(i.expect_reply for i in self.info.neighbor_infos.values()):
+            return
+        # last reply: every dependent has re-converged; pick the optimum.
+        # fc is hardwired true (matching Dual.cpp) because the fresh
+        # minimum over current report-distances IS adopted below — the
+        # multi-round ACTIVE0/2 re-diffusion of full DUAL is not needed
+        # when the post-diffusion route is recomputed from scratch.
+        self.info.sm.process_event(DualEvent.LAST_REPLY, fc=True)
+        dmin, new_nh = INF, None
+        for n, ld in self.local_distances.items():
+            d = _add(ld, self._ninfo(n).report_distance)
+            if d < dmin:
+                dmin, new_nh = d, n
+        rd_changed = dmin != self.info.report_distance
+        self.info.distance = dmin
+        self.info.report_distance = dmin
+        self.info.feasible_distance = dmin
+        self._set_nexthop(new_nh)
+        if rd_changed:
+            self._flood_updates(out)
+        if self.info.cornet:
+            assert len(self.info.cornet) == 1, "one diffusion per destination"
+            self._send_reply(out)
+
+    # -- SPT accessors -----------------------------------------------------
+
+    def has_valid_route(self) -> bool:
+        return self.info.nexthop is not None and self.info.distance != INF
+
+    def add_child(self, child: str) -> None:
+        self.children_.add(child)
+
+    def remove_child(self, child: str) -> None:
+        self.children_.discard(child)
+
+    def children(self) -> Set[str]:
+        return set(self.children_)
+
+    def spt_peers(self) -> Set[str]:
+        """Parent + children — the flooding neighbor set."""
+        if not self.has_valid_route():
+            return set()
+        peers = set(self.children_)
+        if self.info.nexthop != self.node_id:
+            peers.add(self.info.nexthop)
+        return peers
+
+    def status_string(self) -> str:
+        return f"{self.root_id}::{self.node_id}: {self.info}"
+
+
+class DualNode:
+    """Multi-root container: discovers roots from the messages themselves
+    and runs one `Dual` per root (Dual.h:285).  Subclasses implement the
+    wire (`send_dual_messages`) and react to parent changes
+    (`process_nexthop_change`) — KvStore uses the latter to move itself
+    between parents' child-sets."""
+
+    def __init__(self, node_id: str, is_root: bool = False) -> None:
+        self.node_id = node_id
+        self.is_root = is_root
+        self.duals: Dict[str, Dual] = {}
+        self.local_distances: Dict[str, int] = {}
+        if is_root:
+            self._add_dual(node_id)
+
+    # -- I/O plumbing (override) -------------------------------------------
+
+    def send_dual_messages(self, neighbor: str, msgs: DualMessages) -> bool:
+        raise NotImplementedError
+
+    def process_nexthop_change(
+        self, root_id: str, old_nh: Optional[str], new_nh: Optional[str]
+    ) -> None:
+        raise NotImplementedError
+
+    # -- internals ---------------------------------------------------------
+
+    def _add_dual(self, root_id: str) -> None:
+        if root_id in self.duals:
+            return
+        self.duals[root_id] = Dual(
+            self.node_id,
+            root_id,
+            self.local_distances,
+            nexthop_cb=lambda old, new, r=root_id: self.process_nexthop_change(
+                r, old, new
+            ),
+        )
+
+    def _send_batch(self, out: MsgBatch) -> None:
+        for neighbor, msgs in out.items():
+            if msgs:
+                self.send_dual_messages(
+                    neighbor, DualMessages(src_id=self.node_id, messages=msgs)
+                )
+
+    # -- input events ------------------------------------------------------
+
+    def peer_up(self, neighbor: str, cost: int) -> None:
+        self.local_distances[neighbor] = cost
+        out: MsgBatch = {}
+        for dual in self.duals.values():
+            dual.peer_up(neighbor, cost, out)
+        self._send_batch(out)
+
+    def peer_down(self, neighbor: str) -> None:
+        self.local_distances[neighbor] = INF
+        out: MsgBatch = {}
+        for dual in self.duals.values():
+            dual.peer_down(neighbor, out)
+        self._send_batch(out)
+
+    def process_dual_messages(self, messages: DualMessages) -> None:
+        neighbor = messages.src_id
+        out: MsgBatch = {}
+        for msg in messages.messages:
+            self._add_dual(msg.dst_id)
+            dual = self.duals[msg.dst_id]
+            if msg.type == DualMessageType.UPDATE:
+                dual.process_update(neighbor, msg, out)
+            elif msg.type == DualMessageType.QUERY:
+                dual.process_query(neighbor, msg, out)
+            else:
+                dual.process_reply(neighbor, msg, out)
+        self._send_batch(out)
+
+    # -- SPT selection (multi-root arbitration) ----------------------------
+
+    def get_spt_root_id(self) -> Optional[str]:
+        """Smallest discovered root with a valid route wins
+        (Dual.cpp:738)."""
+        for root_id in sorted(self.duals):
+            if self.duals[root_id].has_valid_route():
+                return root_id
+        return None
+
+    def get_spt_peers(self, root_id: Optional[str]) -> Set[str]:
+        if root_id is None or root_id not in self.duals:
+            return set()
+        return self.duals[root_id].spt_peers()
+
+    def get_info(self, root_id: str) -> Optional[RouteInfo]:
+        dual = self.duals.get(root_id)
+        return dual.info if dual is not None else None
+
+    def status_strings(self) -> Dict[str, str]:
+        return {r: d.status_string() for r, d in self.duals.items()}

@@ -26,6 +26,7 @@ from openr_tpu import constants as C
 from openr_tpu.common.runtime import Actor, Clock, CounterMap
 from openr_tpu.common.utils import ExponentialBackoff
 from openr_tpu.config import KvStoreConfig
+from openr_tpu.kvstore.dual import DualMessages, DualNode
 from openr_tpu.kvstore.merge import dump_hashes, generate_hash, merge_key_values
 from openr_tpu.kvstore.transport import KvStoreTransport, KvStoreTransportError
 from openr_tpu.messaging.queue import RQueue, ReplicateQueue
@@ -64,6 +65,38 @@ class SelfOriginatedValue:
     ttl_refresh_task: Optional[asyncio.Task] = None
 
 
+class _KvStoreDualNode(DualNode):
+    """DUAL glued to one KvStoreDb: PDUs ride the peer transport; parent
+    changes move this node between the parents' SPT child sets (the
+    flood-topo-set exchange from the reference's flood optimization)."""
+
+    def __init__(self, db: "KvStoreDb") -> None:
+        super().__init__(db.node_name, is_root=db.config.is_flood_root)
+        self.db = db
+
+    def send_dual_messages(self, neighbor: str, msgs: DualMessages) -> bool:
+        self.db.actor.spawn(
+            self.db._send_dual_to_peer(neighbor, msgs),
+            name=f"kvstore.{self.db.area}.dual.{neighbor}",
+        )
+        return True
+
+    def process_nexthop_change(
+        self, root_id: str, old_nh: Optional[str], new_nh: Optional[str]
+    ) -> None:
+        # unset ourselves on the old parent, set on the new; both ends keep
+        # a consistent SPT so floods traverse each tree edge exactly once
+        if old_nh is not None and old_nh != self.node_id:
+            self.db._send_flood_topo_set(old_nh, root_id, set_child=False)
+        if new_nh is not None and new_nh != self.node_id:
+            self.db._send_flood_topo_set(new_nh, root_id, set_child=True)
+            # re-sync with the new parent: floods we missed while the tree
+            # was reforming are healed by a fresh anti-entropy exchange
+            # (FloodOptimization.md: "it will synchronize with its old and
+            # new parent to make sure SPT information is consistent")
+            self.db.schedule_parent_resync(new_nh)
+
+
 class KvStoreDb:
     """One area's store + peers (KvStoreDb, KvStore.h:36-560)."""
 
@@ -78,6 +111,9 @@ class KvStoreDb:
         self.area = area
         self.node_name = node_name
         self.config = config
+        self.dual: Optional[_KvStoreDualNode] = None
+        if config.enable_flood_optimization:
+            self.dual = _KvStoreDualNode(self)
         self.key_vals: Dict[str, Value] = {}
         self.expiry: Dict[str, float] = {}  # key -> deadline (clock time)
         self.peers: Dict[str, KvStorePeer] = {}
@@ -100,9 +136,11 @@ class KvStoreDb:
             existing = self.peers.get(name)
             if existing is not None:
                 # peer re-add (e.g. graceful restart): reset to IDLE for
-                # a fresh full sync
-                existing.spec = spec
+                # a fresh full sync.  Transition BEFORE adopting the new
+                # spec: leaving INITIALIZED must tear down DUAL according
+                # to the capability the old session was established with
                 self._set_peer_state(existing, KvStorePeerState.IDLE)
+                existing.spec = spec
                 existing.backoff.report_success()
             else:
                 peer = KvStorePeer(
@@ -122,6 +160,13 @@ class KvStoreDb:
             peer = self.peers.pop(name, None)
             if peer is not None and peer.sync_task is not None:
                 peer.sync_task.cancel()
+            if (
+                peer is not None
+                and self.dual is not None
+                and peer.spec.supports_flood_optimization
+                and peer.state == KvStorePeerState.INITIALIZED
+            ):
+                self.dual.peer_down(name)
         self._maybe_signal_initial_synced()
 
     def _set_peer_state(self, peer: KvStorePeer, state: KvStorePeerState) -> None:
@@ -130,8 +175,18 @@ class KvStoreDb:
         if peer.state == KvStorePeerState.INITIALIZED:
             # leaving INITIALIZED == one flap (KvStore.thrift flaps field)
             peer.flaps += 1
+            if self.dual is not None and peer.spec.supports_flood_optimization:
+                self.dual.peer_down(peer.node_name)
         peer.state = state
         peer.spec.state = state
+        if (
+            state == KvStorePeerState.INITIALIZED
+            and self.dual is not None
+            and peer.spec.supports_flood_optimization
+        ):
+            # DUAL runs over established peer sessions only; unit link cost
+            # (the flood tree minimises hops, not metric)
+            self.dual.peer_up(peer.node_name, 1)
         self.actor.counters.set(
             f"kvstore.{self.area}.peer.{peer.node_name}.state", int(state)
         )
@@ -156,31 +211,7 @@ class KvStoreDb:
         self._set_peer_state(peer, KvStorePeerState.SYNCING)
         self.actor.num_active_syncs += 1
         try:
-            hashes = dump_hashes(self.key_vals)
-            pub = await self.actor.transport.get_key_vals_filtered_area(
-                peer.node_name, self.area, hashes, self.node_name
-            )
-            self._bump("thrift.num_full_sync")
-            merged = self.merge_publication(pub, sender=peer.node_name)
-            # 3rd leg: push back keys the responder lacks/outdated
-            if pub.tobe_updated_keys:
-                back = {
-                    k: self._flood_copy(self.key_vals[k])
-                    for k in pub.tobe_updated_keys
-                    if k in self.key_vals
-                }
-                if back:
-                    await self.actor.transport.set_key_vals(
-                        peer.node_name,
-                        self.area,
-                        Publication(
-                            key_vals=back,
-                            area=self.area,
-                            node_ids=[self.node_name],
-                        ),
-                        self.node_name,
-                    )
-                    self._bump("thrift.num_finalized_sync")
+            await self._full_sync_exchange(peer.node_name)
             peer.backoff.report_success()
             self._set_peer_state(peer, KvStorePeerState.INITIALIZED)
             # widen the parallel sync window on success (KvStore.h:550)
@@ -198,6 +229,53 @@ class KvStoreDb:
             self._schedule_peer_sync(peer)
         finally:
             self.actor.num_active_syncs -= 1
+
+    async def _full_sync_exchange(self, peer_name: str) -> None:
+        """The 3-way anti-entropy exchange (hash dump -> diff -> push-back)
+        against one peer; raises KvStoreTransportError on failure."""
+        hashes = dump_hashes(self.key_vals)
+        pub = await self.actor.transport.get_key_vals_filtered_area(
+            peer_name, self.area, hashes, self.node_name
+        )
+        self._bump("thrift.num_full_sync")
+        self.merge_publication(pub, sender=peer_name)
+        # 3rd leg: push back keys the responder lacks/outdated
+        if pub.tobe_updated_keys:
+            back = {
+                k: self._flood_copy(self.key_vals[k])
+                for k in pub.tobe_updated_keys
+                if k in self.key_vals
+            }
+            if back:
+                await self.actor.transport.set_key_vals(
+                    peer_name,
+                    self.area,
+                    Publication(
+                        key_vals=back,
+                        area=self.area,
+                        node_ids=[self.node_name],
+                    ),
+                    self.node_name,
+                )
+                self._bump("thrift.num_finalized_sync")
+
+    def schedule_parent_resync(self, parent: str) -> None:
+        """Anti-entropy with a new SPT parent, without disturbing the peer
+        FSM (the session is already INITIALIZED — only the data may have
+        diverged while floods bypassed us during tree reformation)."""
+
+        async def _resync() -> None:
+            if parent not in self.peers:
+                return
+            try:
+                await self._full_sync_exchange(parent)
+                self._bump("dual.num_parent_resync")
+            except KvStoreTransportError:
+                self._bump("dual.num_parent_resync_failure")
+
+        self.actor.spawn(
+            _resync(), name=f"kvstore.{self.area}.parent_resync.{parent}"
+        )
 
     def _maybe_signal_initial_synced(self, grace_expired: bool = False) -> None:
         """Signal only after LinkMonitor told us our peers (first PeerEvent)
@@ -312,17 +390,66 @@ class KvStoreDb:
         )
         if not flood_pub.key_vals and not flood_pub.expired_keys:
             return
+        flood_set = self._flood_peers()
         for name, peer in self.peers.items():
             if name == sender:
                 continue  # dedup: never reflect to the sender
             if peer.state != KvStorePeerState.INITIALIZED:
                 continue
+            if flood_set is not None and name not in flood_set:
+                continue  # flood optimization: SPT edges only
             if name in (pub.node_ids or []):
                 continue  # path already visited this node
             self.actor.spawn(
                 self._flood_to_peer(peer, flood_pub),
                 name=f"kvstore.{self.area}.flood.{name}",
             )
+
+    def _flood_peers(self) -> Optional[Set[str]]:
+        """SPT parent+children when flood optimization has a converged
+        tree; None = flood to everyone (getFloodPeers semantics).  Peers
+        that never advertised DUAL support stay on full flooding so a
+        mixed-capability network doesn't partition."""
+        if self.dual is None:
+            return None
+        root = self.dual.get_spt_root_id()
+        if root is None:
+            return None  # no converged SPT yet: fall back to full flood
+        peers = self.dual.get_spt_peers(root)
+        peers.update(
+            name
+            for name, p in self.peers.items()
+            if not p.spec.supports_flood_optimization
+        )
+        return peers
+
+    # -- DUAL plumbing (flood optimization) --------------------------------
+
+    async def _send_dual_to_peer(self, name: str, msgs: DualMessages) -> None:
+        try:
+            await self.actor.transport.send_dual_messages(
+                name, self.area, msgs, self.node_name
+            )
+            self._bump("dual.num_pkt_sent")
+        except KvStoreTransportError:
+            # peer unreachable: its session teardown will fire peer_down
+            self._bump("dual.num_pkt_send_failure")
+
+    def _send_flood_topo_set(
+        self, parent: str, root_id: str, set_child: bool
+    ) -> None:
+        async def _send() -> None:
+            try:
+                await self.actor.transport.set_flood_topo_child(
+                    parent, self.area, root_id, self.node_name,
+                    set_child, self.node_name,
+                )
+            except KvStoreTransportError:
+                self._bump("dual.num_flood_topo_set_failure")
+
+        self.actor.spawn(
+            _send(), name=f"kvstore.{self.area}.floodtopo.{parent}"
+        )
 
     async def _flood_to_peer(self, peer: KvStorePeer, pub: Publication) -> None:
         # flood rate limit (config flood_rate, KvStore-inl.h rate limiter)
@@ -645,6 +772,27 @@ class KvStore(Actor):
             raise KvStoreTransportError(f"unknown area {area}")
         db.merge_publication(publication, sender=sender)
 
+    async def handle_dual_messages(self, area: str, messages) -> None:
+        db = self.areas.get(area)
+        if db is None or db.dual is None:
+            raise KvStoreTransportError(f"no dual in area {area}")
+        db.dual.process_dual_messages(messages)
+        self.counters.bump("kvstore.dual.num_pkt_recv")
+
+    async def handle_flood_topo_set(
+        self, area: str, root_id: str, child: str, set_child: bool
+    ) -> None:
+        db = self.areas.get(area)
+        if db is None or db.dual is None:
+            raise KvStoreTransportError(f"no dual in area {area}")
+        dual = db.dual.duals.get(root_id)
+        if dual is None:
+            return
+        if set_child:
+            dual.add_child(child)
+        else:
+            dual.remove_child(child)
+
     # -- public API (ctrl surface) -----------------------------------------
 
     def set_key_vals(self, area: str, key_vals: Dict[str, Value]) -> None:
@@ -664,6 +812,26 @@ class KvStore(Actor):
     def peer_state(self, area: str, peer: str) -> Optional[KvStorePeerState]:
         p = self.areas[area].peers.get(peer)
         return p.state if p is not None else None
+
+    def get_flood_topo(self, area: str) -> Optional[Dict[str, dict]]:
+        """SPT summary per discovered root (getKvStoreFloodTopoArea /
+        SptInfos semantics): nexthop, distance, children, chosen root.
+        None = flood optimization disabled; {} = enabled, no root
+        discovered yet."""
+        db = self.areas[area]
+        if db.dual is None:
+            return None
+        chosen = db.dual.get_spt_root_id()
+        out: Dict[str, dict] = {}
+        for root_id, dual in db.dual.duals.items():
+            out[root_id] = {
+                "passive": dual.info.sm.state.value == "PASSIVE",
+                "nexthop": dual.info.nexthop,
+                "distance": dual.info.distance,
+                "children": sorted(dual.children()),
+                "is_chosen": root_id == chosen,
+            }
+        return out
 
     # -- initialization sequencing ----------------------------------------
 

@@ -41,6 +41,25 @@ class KvStoreTransport:
         """Flood/finalize: push key-vals into the peer's store."""
         raise NotImplementedError
 
+    async def send_dual_messages(
+        self, peer_node: str, area: str, messages, sender_id: str
+    ) -> None:
+        """Deliver DUAL flood-topology PDUs to a peer (if/Dual.thrift)."""
+        raise NotImplementedError
+
+    async def set_flood_topo_child(
+        self,
+        peer_node: str,
+        area: str,
+        root_id: str,
+        child: str,
+        set_child: bool,
+        sender_id: str,
+    ) -> None:
+        """FloodTopoSet: (un)register `child` in the peer's SPT child set
+        for `root_id` (KvStore floodTopoSetParams semantics)."""
+        raise NotImplementedError
+
 
 class InProcessTransport(KvStoreTransport):
     """Registry-based transport for in-process multi-store emulation.
@@ -93,4 +112,24 @@ class InProcessTransport(KvStoreTransport):
             sender_id,
             peer_node,
             lambda store: store.handle_set_key_vals(area, publication, sender_id),
+        )
+
+    async def send_dual_messages(
+        self, peer_node, area, messages, sender_id
+    ) -> None:
+        return await self._call(
+            sender_id,
+            peer_node,
+            lambda store: store.handle_dual_messages(area, messages),
+        )
+
+    async def set_flood_topo_child(
+        self, peer_node, area, root_id, child, set_child, sender_id
+    ) -> None:
+        return await self._call(
+            sender_id,
+            peer_node,
+            lambda store: store.handle_flood_topo_set(
+                area, root_id, child, set_child
+            ),
         )

@@ -270,6 +270,7 @@ class LinkMonitor(Actor):
                     ev.node_name: PeerSpec(
                         peer_addr=ev.neighbor_addr_v6 or ev.node_name,
                         ctrl_port=ev.ctrl_port,
+                        supports_flood_optimization=ev.enable_flood_optimization,
                     )
                 },
             )

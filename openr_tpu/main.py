@@ -187,6 +187,11 @@ class OpenrNode:
             initialization_cb=on_init,
             counters=self.counters,
         )
+        # the handshake advertises our DUAL capability; single source of
+        # truth is the kvstore config
+        config.spark_config.enable_flood_optimization = (
+            config.kvstore_config.enable_flood_optimization
+        )
         self.spark = Spark(
             node_name=self.name,
             clock=clock,

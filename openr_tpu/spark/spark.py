@@ -77,6 +77,8 @@ class SparkHandshakeMsg:
     area: str = C.DEFAULT_AREA
     #: point-to-point: only this node should process the msg
     neighbor_node_name: str = ""
+    #: DUAL flood-optimization capability (KvStore flood-topo SPT)
+    enable_flood_optimization: bool = False
 
 
 @dataclass
@@ -166,6 +168,7 @@ class SparkNeighbor:
     heartbeat_hold_time_s: float = C.SPARK_HOLD_TIME_S
     gr_hold_time_s: float = C.SPARK_GR_HOLD_TIME_S
     adj_only_used_by_other_node: bool = False
+    enable_flood_optimization: bool = False
     #: True between NEIGHBOR_UP and NEIGHBOR_DOWN notifications; teardown
     #: paths call _neighbor_down unconditionally and this gates the event
     reported_up: bool = False
@@ -386,6 +389,7 @@ class Spark(Actor):
             transport_address_v4=tracked.v4_addr,
             area=neighbor.area,
             neighbor_node_name=neighbor.node_name,
+            enable_flood_optimization=self.config.enable_flood_optimization,
         )
         self.io.send(self.node_name, if_name, _pack(msg))
         self.counters.bump("spark.handshake.packets_sent")
@@ -450,6 +454,7 @@ class Spark(Actor):
                 ctrl_port=neighbor.openr_ctrl_port,
                 rtt_us=neighbor.rtt_us,
                 adj_only_used_by_other_node=neighbor.adj_only_used_by_other_node,
+                enable_flood_optimization=neighbor.enable_flood_optimization,
             )
         )
 
@@ -675,6 +680,7 @@ class Spark(Actor):
         neighbor.openr_ctrl_port = msg.openr_ctrl_port
         neighbor.transport_address_v6 = msg.transport_address_v6
         neighbor.transport_address_v4 = msg.transport_address_v4
+        neighbor.enable_flood_optimization = msg.enable_flood_optimization
         neighbor.heartbeat_hold_time_s = min(
             msg.hold_time_ms / 1000.0, self.config.hold_time_s
         )

@@ -464,6 +464,9 @@ class PeerSpec(Wire):
     state: KvStorePeerState = KvStorePeerState.IDLE
     flaps: int = 0
     num_thrift_failures: int = 0
+    #: peer advertised DUAL support in the Spark handshake; non-supporting
+    #: peers keep receiving full floods even when an SPT is converged
+    supports_flood_optimization: bool = False
 
 
 @wire_type
@@ -580,6 +583,7 @@ class NeighborEvent(Wire):
     rtt_us: int = 0
     kv_label: int = 0
     adj_only_used_by_other_node: bool = False
+    enable_flood_optimization: bool = False
 
 
 class PeerEventType(enum.IntEnum):

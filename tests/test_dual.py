@@ -1,0 +1,312 @@
+"""DUAL flood-optimization tests — SPT formation, failure reconvergence,
+multi-root arbitration (the reference's kvstore/tests/DualTest.cpp
+scenarios), plus KvStore integration showing reduced flood fan-out."""
+
+import asyncio
+from collections import deque
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import KvStoreConfig
+from openr_tpu.kvstore.dual import (
+    INF,
+    DualEvent,
+    DualMessages,
+    DualNode,
+    DualState,
+    DualStateMachine,
+)
+
+from test_kvstore import Net, mkval, run
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+
+def test_state_machine_passive_transitions():
+    sm = DualStateMachine()
+    # FC held: stay passive no matter the event
+    sm.process_event(DualEvent.OTHERS, fc=True)
+    assert sm.state == DualState.PASSIVE
+    # FC broken by a local event -> ACTIVE1
+    sm.process_event(DualEvent.INCREASE_D, fc=False)
+    assert sm.state == DualState.ACTIVE1
+    # distance increased while active local-origin -> ACTIVE0
+    sm.process_event(DualEvent.INCREASE_D)
+    assert sm.state == DualState.ACTIVE0
+    # last reply w/o FC -> ACTIVE2; then last reply w/ FC -> PASSIVE
+    sm.process_event(DualEvent.LAST_REPLY, fc=False)
+    assert sm.state == DualState.ACTIVE2
+    sm.process_event(DualEvent.LAST_REPLY, fc=True)
+    assert sm.state == DualState.PASSIVE
+    # FC broken by successor's query -> ACTIVE3
+    sm.process_event(DualEvent.QUERY_FROM_SUCCESSOR, fc=False)
+    assert sm.state == DualState.ACTIVE3
+    sm.process_event(DualEvent.INCREASE_D)
+    assert sm.state == DualState.ACTIVE2
+
+
+# ---------------------------------------------------------------------------
+# pure-library harness: synchronous message router
+# ---------------------------------------------------------------------------
+
+
+class Fabric:
+    """N DualNodes exchanging messages through a FIFO pump (stands in for
+    the wire; delivery order is deterministic)."""
+
+    def __init__(self, names, roots=()):
+        self.pending = deque()  # (dst, DualMessages)
+        self.nodes = {n: _FabricNode(self, n, n in roots) for n in names}
+        self.links = set()
+
+    def link_up(self, a, b, cost=1):
+        self.links.add(frozenset((a, b)))
+        self.nodes[a].peer_up(b, cost)
+        self.nodes[b].peer_up(a, cost)
+        self.pump()
+
+    def link_down(self, a, b):
+        self.links.discard(frozenset((a, b)))
+        self.nodes[a].peer_down(b)
+        self.nodes[b].peer_down(a)
+        self.pump()
+
+    def pump(self, limit=100_000):
+        n = 0
+        while self.pending:
+            dst, msgs = self.pending.popleft()
+            # drop traffic on dead links (message crossed a link-down)
+            if frozenset((dst, msgs.src_id)) not in self.links:
+                continue
+            self.nodes[dst].process_dual_messages(msgs)
+            n += 1
+            assert n < limit, "dual message storm"
+        return n
+
+    def assert_spt(self, root):
+        """Every node's parent chain must reach `root` loop-free with
+        hop-count distance, and parent/child sets must agree."""
+        for name, node in self.nodes.items():
+            info = node.duals[root].info
+            assert info.sm.state == DualState.PASSIVE, (name, str(info))
+            seen = [name]
+            cur = name
+            while cur != root:
+                nh = self.nodes[cur].duals[root].info.nexthop
+                assert nh is not None and nh not in seen, f"loop at {seen}"
+                # parent must list cur as its child
+                assert cur in self.nodes[nh].duals[root].children(), (
+                    f"{nh} missing child {cur}"
+                )
+                seen.append(nh)
+                cur = nh
+            assert info.distance == len(seen) - 1 or name == root
+
+
+class _FabricNode(DualNode):
+    def __init__(self, fabric, name, is_root):
+        self.fabric = fabric
+        super().__init__(name, is_root=is_root)
+
+    def send_dual_messages(self, neighbor, msgs):
+        self.fabric.pending.append((neighbor, msgs))
+        return True
+
+    def process_nexthop_change(self, root_id, old_nh, new_nh):
+        # mirror KvStore's flood-topo-set: maintain child sets on parents
+        if old_nh is not None and old_nh != self.node_id:
+            self.fabric.nodes[old_nh].duals[root_id].remove_child(self.node_id)
+        if new_nh is not None and new_nh != self.node_id:
+            self.fabric.nodes[new_nh].duals[root_id].add_child(self.node_id)
+
+
+def test_line_topology_forms_spt():
+    f = Fabric(["a", "b", "c"], roots=["a"])
+    f.link_up("a", "b")
+    f.link_up("b", "c")
+    f.assert_spt("a")
+    assert f.nodes["b"].duals["a"].info.nexthop == "a"
+    assert f.nodes["c"].duals["a"].info.nexthop == "b"
+    assert f.nodes["c"].duals["a"].info.distance == 2
+    # flooding neighbor sets = tree edges
+    assert f.nodes["a"].get_spt_peers("a") == {"b"}
+    assert f.nodes["b"].get_spt_peers("a") == {"a", "c"}
+    assert f.nodes["c"].get_spt_peers("a") == {"b"}
+
+
+def test_ring_reconverges_after_link_failure():
+    f = Fabric(["r", "x", "y", "z"], roots=["r"])
+    f.link_up("r", "x")
+    f.link_up("x", "y")
+    f.link_up("y", "z")
+    f.link_up("z", "r")
+    f.assert_spt("r")
+    # cut the link carrying x (or z); tree must reform the other way round
+    assert f.nodes["x"].duals["r"].info.nexthop == "r"
+    f.link_down("r", "x")
+    f.assert_spt("r")
+    assert f.nodes["x"].duals["r"].info.nexthop == "y"
+    assert f.nodes["x"].duals["r"].info.distance == 3
+
+
+def test_grid_converges_and_survives_node_isolation():
+    # 3x3 grid, root at a corner
+    names = [f"n{i}{j}" for i in range(3) for j in range(3)]
+    f = Fabric(names, roots=["n00"])
+    for i in range(3):
+        for j in range(3):
+            if i + 1 < 3:
+                f.link_up(f"n{i}{j}", f"n{i + 1}{j}")
+            if j + 1 < 3:
+                f.link_up(f"n{i}{j}", f"n{i}{j + 1}")
+    f.assert_spt("n00")
+    assert f.nodes["n22"].duals["n00"].info.distance == 4
+    # isolate the center node; everyone else must still have a route
+    for nbr in ("n01", "n10", "n12", "n21"):
+        f.link_down("n11", nbr)
+    for name, node in f.nodes.items():
+        if name in ("n11",):
+            assert not node.duals["n00"].has_valid_route()
+        else:
+            assert node.duals["n00"].has_valid_route(), name
+
+
+def test_multi_root_arbitration_and_failover():
+    # two roots: smallest id (r1) wins; when r1 dies, r2's tree takes over
+    f = Fabric(["r1", "r2", "m"], roots=["r1", "r2"])
+    f.link_up("r1", "m")
+    f.link_up("m", "r2")
+    assert f.nodes["m"].get_spt_root_id() == "r1"
+    # r2 is an ordinary node in r1's tree, hanging off m
+    assert f.nodes["m"].get_spt_peers("r1") == {"r1", "r2"}
+    f.link_down("r1", "m")
+    assert f.nodes["m"].get_spt_root_id() == "r2"
+    assert f.nodes["m"].get_spt_peers("r2") == {"r2"}
+
+
+def test_distance_infinity_when_root_unreachable():
+    f = Fabric(["r", "a"], roots=["r"])
+    f.link_up("r", "a")
+    assert f.nodes["a"].duals["r"].info.distance == 1
+    f.link_down("r", "a")
+    assert f.nodes["a"].duals["r"].info.distance == INF
+    assert not f.nodes["a"].duals["r"].has_valid_route()
+
+
+# ---------------------------------------------------------------------------
+# KvStore integration
+# ---------------------------------------------------------------------------
+
+
+def _dual_cfg(root=False):
+    return KvStoreConfig(enable_flood_optimization=True, is_flood_root=root)
+
+
+def test_kvstore_flood_topology_reduces_fanout():
+    async def main():
+        clock = SimClock()
+        names = ["a", "b", "c", "d"]
+        cfg = {n: _dual_cfg(root=(n == "a")) for n in names}
+        net = Net(names, clock, config=cfg)
+        # full mesh: 6 physical links, SPT will use 3
+        for i, x in enumerate(names):
+            for y in names[i + 1 :]:
+                net.peer(x, y)
+        await clock.run_for(15.0)
+        topo = net.stores["b"].get_flood_topo("0")
+        assert topo["a"]["is_chosen"]
+        assert topo["a"]["nexthop"] == "a"
+        # all non-root nodes hang directly off the root in a full mesh
+        root_topo = net.stores["a"].get_flood_topo("0")
+        assert set(root_topo["a"]["children"]) == {"b", "c", "d"}
+        calls_before = net.transport.num_calls
+        net.stores["a"].set_key_vals("0", {"k": mkval(1, "a", b"v")})
+        await clock.run_for(5.0)
+        for n in names:
+            assert net.stores[n].dump_all("0")["k"].value == b"v", n
+        spt_calls = net.transport.num_calls - calls_before
+        # root floods to its 3 children only: no b<->c<->d cross-traffic
+        assert spt_calls <= 4, spt_calls
+        await net.stop()
+
+    run(main())
+
+
+def test_kvstore_flood_falls_back_without_spt():
+    async def main():
+        clock = SimClock()
+        # flood optimization on but NO root configured anywhere: stores
+        # must fall back to flooding every peer
+        names = ["a", "b", "c"]
+        cfg = {n: _dual_cfg(root=False) for n in names}
+        net = Net(names, clock, config=cfg)
+        net.peer("a", "b")
+        net.peer("b", "c")
+        await clock.run_for(10.0)
+        net.stores["a"].set_key_vals("0", {"k": mkval(1, "a", b"v")})
+        await clock.run_for(5.0)
+        assert net.stores["c"].dump_all("0")["k"].value == b"v"
+        await net.stop()
+
+    run(main())
+
+
+def test_kvstore_mixed_capability_network_not_partitioned():
+    async def main():
+        clock = SimClock()
+        # a (root, dual) - b (dual) - c (NO flood optimization):
+        # after the a-b SPT converges, b must STILL full-flood to c
+        names = ["a", "b", "c"]
+        cfg = {
+            "a": _dual_cfg(root=True),
+            "b": _dual_cfg(),
+            "c": KvStoreConfig(),  # legacy peer
+        }
+        net = Net(names, clock, config=cfg)
+        net.peer("a", "b")
+        net.peer("b", "c")
+        await clock.run_for(15.0)
+        topo = net.stores["b"].get_flood_topo("0")
+        assert topo["a"]["is_chosen"]  # SPT converged between a and b
+        net.stores["a"].set_key_vals("0", {"k": mkval(1, "a", b"v")})
+        await clock.run_for(5.0)
+        assert net.stores["c"].dump_all("0").get("k") is not None
+        assert net.stores["c"].dump_all("0")["k"].value == b"v"
+        # and the reverse direction: c's update reaches a through b
+        net.stores["c"].set_key_vals("0", {"k2": mkval(1, "c", b"w")})
+        await clock.run_for(5.0)
+        assert net.stores["a"].dump_all("0")["k2"].value == b"w"
+        await net.stop()
+
+    run(main())
+
+
+def test_kvstore_spt_survives_peer_loss():
+    async def main():
+        clock = SimClock()
+        names = ["a", "b", "c"]
+        cfg = {n: _dual_cfg(root=(n == "a")) for n in names}
+        net = Net(names, clock, config=cfg)
+        # triangle: a-b, b-c, c-a
+        net.peer("a", "b")
+        net.peer("b", "c")
+        net.peer("c", "a")
+        await clock.run_for(15.0)
+        # b's parent is a (direct link); kill the a<->b peering
+        from openr_tpu.types import PeerEvent
+
+        net.transport.fail("a", "b")
+        net.transport.fail("b", "a")
+        net.peer_qs["a"].push(PeerEvent(area="0", peers_to_del=["b"]))
+        net.peer_qs["b"].push(PeerEvent(area="0", peers_to_del=["a"]))
+        await clock.run_for(10.0)
+        topo = net.stores["b"].get_flood_topo("0")
+        assert topo["a"]["nexthop"] == "c"  # rerouted through c
+        net.stores["a"].set_key_vals("0", {"k2": mkval(1, "a", b"w")})
+        await clock.run_for(5.0)
+        assert net.stores["b"].dump_all("0")["k2"].value == b"w"
+        await net.stop()
+
+    run(main())

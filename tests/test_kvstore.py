@@ -44,7 +44,10 @@ class Net:
         self.peer_qs = {}
         self.kv_qs = {}
         self.init_events = {n: [] for n in names}
+        per_node = config if isinstance(config, dict) else {}
         for n in names:
+            if per_node:
+                config = per_node.get(n) or KvStoreConfig()
             pub_q = ReplicateQueue(f"{n}.kvStoreUpdates")
             peer_q = ReplicateQueue(f"{n}.peerUpdates")
             kv_q = ReplicateQueue(f"{n}.kvRequests")
@@ -67,13 +70,24 @@ class Net:
             store.start()
 
     def peer(self, a, b, bidir=True):
-        """Declare b as a's peer (and vice versa)."""
+        """Declare b as a's peer (and vice versa).  The flood-optimization
+        capability bit mirrors what LinkMonitor learns from the Spark
+        handshake: it reflects the REMOTE store's config."""
+
+        def spec(remote):
+            return PeerSpec(
+                peer_addr=remote,
+                supports_flood_optimization=self.stores[
+                    remote
+                ].config.enable_flood_optimization,
+            )
+
         self.peer_qs[a].push(
-            PeerEvent(area="0", peers_to_add={b: PeerSpec(peer_addr=b)})
+            PeerEvent(area="0", peers_to_add={b: spec(b)})
         )
         if bidir:
             self.peer_qs[b].push(
-                PeerEvent(area="0", peers_to_add={a: PeerSpec(peer_addr=a)})
+                PeerEvent(area="0", peers_to_add={a: spec(a)})
             )
 
     async def stop(self):

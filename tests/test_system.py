@@ -205,3 +205,42 @@ def test_convergence_wall_clock_budget():
         await net.stop()
 
     run(main())
+
+
+def test_flood_optimization_grid_end_to_end():
+    """DUAL SPT over the full stack: handshake-advertised capability,
+    tree formation, spanning-tree flooding, reconvergence after losing a
+    tree edge (the verify-drive scenario, kept as regression)."""
+
+    def overrides(cfg):
+        cfg.kvstore_config.enable_flood_optimization = True
+        cfg.kvstore_config.is_flood_root = cfg.node_name == "node0"
+
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=overrides)
+        net.build(grid_edges(3))  # 9 nodes, 12 links
+        net.start()
+        await clock.run_for(30.0)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        tree_edges = 0
+        for name, node in net.nodes.items():
+            topo = node.kv_store.get_flood_topo("0")
+            assert topo is not None and topo["node0"]["is_chosen"], name
+            assert topo["node0"]["passive"], name
+            tree_edges += len(topo["node0"]["children"])
+        assert tree_edges == 8  # spanning tree: V-1 edges
+        # cut node1's tree uplink; SPT + routes must reconverge
+        victim = net.nodes["node1"].kv_store.get_flood_topo("0")["node0"][
+            "nexthop"
+        ]
+        net.fail_link("node1", victim)
+        await clock.run_for(30.0)
+        topo = net.nodes["node1"].kv_store.get_flood_topo("0")["node0"]
+        assert topo["passive"] and topo["nexthop"] not in (None, victim)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        await net.stop()
+
+    run(main())
