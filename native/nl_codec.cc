@@ -1,0 +1,546 @@
+// rtnetlink message codec — the native core of openr_tpu.platform.nl.
+//
+// Role (reference parity): openr/nl/NetlinkRouteMessage.{h,cpp},
+// NetlinkLinkMessage, NetlinkAddrMessage, NetlinkNeighborMessage — the
+// message build/parse layer under NetlinkProtocolSocket
+// (openr/nl/NetlinkProtocolSocket.h:99).  The reference implements a
+// libnl-free codec in C++; so do we.  This library speaks the Linux
+// rtnetlink ABI directly (linux/rtnetlink.h) and exposes a flat C ABI that
+// Python binds via ctypes (openr_tpu/platform/nl/codec.py).  All hot
+// encode/decode work happens here; Python only moves buffers.
+//
+// Capabilities:
+//   * encode RTM_NEWROUTE/DELROUTE for AF_INET/AF_INET6 unicast routes,
+//     single and multipath (RTA_MULTIPATH), with optional MPLS push
+//     encap (RTA_ENCAP/LWTUNNEL_ENCAP_MPLS) — and AF_MPLS label routes
+//     (RTA_DST label, RTA_VIA gateway, RTA_NEWDST swap stack)
+//   * encode RTM_NEWADDR/DELADDR, RTM_GETLINK/GETADDR/GETROUTE dumps
+//   * decode kernel replies/events: link, addr, route, neigh, ack/error,
+//     done — into flat structs
+
+#include <cstring>
+#include <cstdint>
+
+#include <sys/socket.h>
+#include <net/if.h>
+#include <linux/lwtunnel.h>
+#include <linux/mpls.h>
+#include <linux/mpls_iptunnel.h>
+#include <linux/netlink.h>
+#include <linux/rtnetlink.h>
+
+extern "C" {
+
+enum {
+  ONL_MAX_NEXTHOPS = 128,
+  ONL_MAX_LABELS = 16,
+  ONL_IFNAME = 32,
+};
+
+enum {  // OnlNexthop.label_action
+  ONL_LBL_NONE = 0,
+  ONL_LBL_PUSH = 1,
+  ONL_LBL_SWAP = 2,
+  ONL_LBL_PHP = 3,          // pop-and-forward: plain AF_MPLS nexthop
+  ONL_LBL_POP_LOOKUP = 4,   // pop-and-lookup (RTA_OIF lo / dev lookup)
+};
+
+enum {  // OnlMsg.kind
+  ONL_KIND_NONE = 0,
+  ONL_KIND_LINK = 1,
+  ONL_KIND_ADDR = 2,
+  ONL_KIND_ROUTE = 3,
+  ONL_KIND_NEIGH = 4,
+  ONL_KIND_ACK = 5,   // NLMSG_ERROR with error==0, or error<0 (failure)
+  ONL_KIND_DONE = 6,  // NLMSG_DONE (end of dump)
+};
+
+#pragma pack(push, 1)
+struct OnlNexthop {
+  uint8_t family;            // AF_INET/AF_INET6 of gateway; 0 = no gateway
+  uint8_t gateway[16];
+  int32_t if_index;          // -1 = unset
+  uint32_t weight;           // 0 = equal
+  uint8_t label_action;      // ONL_LBL_*
+  uint8_t label_count;
+  uint32_t labels[ONL_MAX_LABELS];
+};
+
+struct OnlRoute {
+  uint8_t family;            // AF_INET / AF_INET6 / AF_MPLS
+  uint8_t prefix_len;
+  uint8_t dst[16];           // network byte order (unused for AF_MPLS)
+  uint32_t mpls_label;       // AF_MPLS: incoming label
+  uint8_t table;             // RT_TABLE_MAIN
+  uint8_t protocol;          // e.g. 99 (openr)
+  uint8_t route_type;        // RTN_UNICAST
+  uint32_t priority;         // RTA_PRIORITY; 0 = omit
+  uint32_t nh_count;
+  OnlNexthop nh[ONL_MAX_NEXTHOPS];
+};
+
+struct OnlMsg {
+  uint16_t kind;             // ONL_KIND_*
+  uint16_t nlmsg_type;       // raw RTM_* type
+  uint32_t seq;
+  int32_t error;             // ONL_KIND_ACK: 0 ok, else -errno
+  uint8_t is_del;            // RTM_DEL* event
+  // link
+  int32_t if_index;
+  uint32_t if_flags;
+  uint8_t is_up;
+  char if_name[ONL_IFNAME];
+  // addr / neigh
+  uint8_t family;
+  uint8_t prefix_len;
+  uint8_t addr_valid;
+  uint8_t addr[16];
+  uint16_t neigh_state;
+  // route
+  OnlRoute route;
+};
+#pragma pack(pop)
+
+namespace {
+
+inline int addr_len(uint8_t family) { return family == AF_INET ? 4 : 16; }
+
+// ---- attribute writer ----------------------------------------------------
+
+struct Writer {
+  uint8_t* buf;
+  int cap;
+  int len = 0;
+  bool overflow = false;
+
+  void* claim(int n) {
+    int aligned = NLMSG_ALIGN(n);
+    if (len + aligned > cap) {
+      overflow = true;
+      return nullptr;
+    }
+    void* p = buf + len;
+    memset(p, 0, aligned);
+    len += aligned;
+    return p;
+  }
+
+  rtattr* put_attr(int type, const void* data, int dlen) {
+    auto* rta = static_cast<rtattr*>(claim(RTA_LENGTH(dlen)));
+    if (!rta) return nullptr;
+    rta->rta_type = type;
+    rta->rta_len = RTA_LENGTH(dlen);
+    if (dlen) memcpy(RTA_DATA(rta), data, dlen);
+    return rta;
+  }
+
+  rtattr* begin_nest(int type) {
+    auto* rta = static_cast<rtattr*>(claim(RTA_LENGTH(0)));
+    if (rta) rta->rta_type = type;
+    return rta;
+  }
+
+  void end_nest(rtattr* nest) {
+    if (nest) nest->rta_len = (uint16_t)((buf + len) - (uint8_t*)nest);
+  }
+};
+
+// struct rtvia has a trailing flexible address — build it by hand.
+void put_via(Writer& w, const OnlNexthop& nh) {
+  uint8_t via[2 + 16];
+  uint16_t fam = nh.family;
+  memcpy(via, &fam, 2);
+  int alen = addr_len(nh.family);
+  memcpy(via + 2, nh.gateway, alen);
+  w.put_attr(RTA_VIA, via, 2 + alen);
+}
+
+// MPLS label stack in wire format (mpls_entry: 20-bit label << 12, S-bit
+// on the last entry), for RTA_DST/RTA_NEWDST/MPLS_IPTUNNEL_DST.
+int encode_label_stack(const uint32_t* labels, int count, uint8_t* out) {
+  for (int i = 0; i < count; ++i) {
+    uint32_t entry = (labels[i] & 0xFFFFF) << MPLS_LS_LABEL_SHIFT;
+    if (i == count - 1) entry |= 1u << MPLS_LS_S_SHIFT;
+    entry = __builtin_bswap32(entry);
+    memcpy(out + 4 * i, &entry, 4);
+  }
+  return 4 * count;
+}
+
+int decode_label_stack(const uint8_t* data, int dlen, uint32_t* out, int cap) {
+  int n = 0;
+  for (int off = 0; off + 4 <= dlen && n < cap; off += 4) {
+    uint32_t entry;
+    memcpy(&entry, data + off, 4);
+    entry = __builtin_bswap32(entry);
+    out[n++] = (entry >> MPLS_LS_LABEL_SHIFT) & 0xFFFFF;
+    if (entry & (1u << MPLS_LS_S_SHIFT)) break;
+  }
+  return n;
+}
+
+// Per-nexthop attributes shared by single-path and multipath encodings.
+void put_nexthop_attrs(Writer& w, const OnlRoute& r, const OnlNexthop& nh) {
+  if (r.family == AF_MPLS) {
+    // label route: gateway via RTA_VIA, swap stack via RTA_NEWDST
+    if (nh.label_action == ONL_LBL_SWAP && nh.label_count > 0) {
+      uint8_t stack[4 * ONL_MAX_LABELS];
+      int n = encode_label_stack(nh.labels, nh.label_count, stack);
+      w.put_attr(RTA_NEWDST, stack, n);
+    }
+    if (nh.family) put_via(w, nh);
+  } else {
+    if (nh.label_action == ONL_LBL_PUSH && nh.label_count > 0) {
+      uint16_t encap_type = LWTUNNEL_ENCAP_MPLS;
+      w.put_attr(RTA_ENCAP_TYPE, &encap_type, 2);
+      rtattr* nest = w.begin_nest(RTA_ENCAP | NLA_F_NESTED);
+      uint8_t stack[4 * ONL_MAX_LABELS];
+      int n = encode_label_stack(nh.labels, nh.label_count, stack);
+      w.put_attr(MPLS_IPTUNNEL_DST, stack, n);
+      w.end_nest(nest);
+    }
+    if (nh.family) {
+      w.put_attr(RTA_GATEWAY, nh.gateway, addr_len(nh.family));
+    }
+  }
+}
+
+}  // namespace
+
+// ---- encoders ------------------------------------------------------------
+
+// Returns encoded length, or -1 on overflow / bad input.
+int onl_encode_route(const OnlRoute* r, int is_del, int replace, uint32_t seq,
+                     uint32_t pid, uint8_t* out, int cap) {
+  if (!r || r->nh_count > ONL_MAX_NEXTHOPS) return -1;
+  for (uint32_t i = 0; i < r->nh_count; ++i) {
+    if (r->nh[i].label_count > ONL_MAX_LABELS) return -1;
+  }
+  Writer w{out, cap};
+  auto* nlh = static_cast<nlmsghdr*>(w.claim(NLMSG_LENGTH(sizeof(rtmsg))));
+  if (!nlh) return -1;
+  nlh->nlmsg_type = is_del ? RTM_DELROUTE : RTM_NEWROUTE;
+  nlh->nlmsg_flags = NLM_F_REQUEST | NLM_F_ACK;
+  if (!is_del) {
+    nlh->nlmsg_flags |= NLM_F_CREATE | (replace ? NLM_F_REPLACE : 0);
+  }
+  nlh->nlmsg_seq = seq;
+  nlh->nlmsg_pid = pid;
+
+  auto* rtm = static_cast<rtmsg*>(NLMSG_DATA(nlh));
+  rtm->rtm_family = r->family;
+  rtm->rtm_table = r->table ? r->table : RT_TABLE_MAIN;
+  rtm->rtm_protocol = r->protocol;
+  rtm->rtm_scope = RT_SCOPE_UNIVERSE;
+  rtm->rtm_type = r->route_type ? r->route_type : RTN_UNICAST;
+  rtm->rtm_dst_len = r->family == AF_MPLS ? 20 : r->prefix_len;
+
+  if (r->family == AF_MPLS) {
+    uint8_t stack[4];
+    encode_label_stack(&r->mpls_label, 1, stack);
+    w.put_attr(RTA_DST, stack, 4);
+  } else {
+    w.put_attr(RTA_DST, r->dst, addr_len(r->family));
+  }
+  if (r->priority) w.put_attr(RTA_PRIORITY, &r->priority, 4);
+
+  if (r->nh_count == 1) {
+    const OnlNexthop& nh = r->nh[0];
+    put_nexthop_attrs(w, *r, nh);
+    if (nh.if_index >= 0) {
+      uint32_t oif = (uint32_t)nh.if_index;
+      w.put_attr(RTA_OIF, &oif, 4);
+    }
+  } else if (r->nh_count > 1) {
+    rtattr* nest = w.begin_nest(RTA_MULTIPATH);
+    for (uint32_t i = 0; i < r->nh_count; ++i) {
+      const OnlNexthop& nh = r->nh[i];
+      auto* rtnh = static_cast<rtnexthop*>(w.claim(sizeof(rtnexthop)));
+      if (!rtnh) return -1;
+      rtnh->rtnh_ifindex = nh.if_index >= 0 ? nh.if_index : 0;
+      rtnh->rtnh_hops = nh.weight ? (uint8_t)(nh.weight - 1) : 0;
+      put_nexthop_attrs(w, *r, nh);
+      rtnh->rtnh_len = (uint16_t)((w.buf + w.len) - (uint8_t*)rtnh);
+    }
+    w.end_nest(nest);
+  }
+
+  if (w.overflow) return -1;
+  nlh->nlmsg_len = w.len;
+  return w.len;
+}
+
+int onl_encode_addr(int is_del, uint32_t seq, uint32_t pid, int if_index,
+                    uint8_t family, const uint8_t* addr, uint8_t prefix_len,
+                    uint8_t* out, int cap) {
+  Writer w{out, cap};
+  auto* nlh = static_cast<nlmsghdr*>(w.claim(NLMSG_LENGTH(sizeof(ifaddrmsg))));
+  if (!nlh) return -1;
+  nlh->nlmsg_type = is_del ? RTM_DELADDR : RTM_NEWADDR;
+  nlh->nlmsg_flags = NLM_F_REQUEST | NLM_F_ACK | (is_del ? 0 : NLM_F_CREATE | NLM_F_REPLACE);
+  nlh->nlmsg_seq = seq;
+  nlh->nlmsg_pid = pid;
+  auto* ifa = static_cast<ifaddrmsg*>(NLMSG_DATA(nlh));
+  ifa->ifa_family = family;
+  ifa->ifa_prefixlen = prefix_len;
+  ifa->ifa_index = if_index;
+  w.put_attr(IFA_LOCAL, addr, addr_len(family));
+  w.put_attr(IFA_ADDRESS, addr, addr_len(family));
+  if (w.overflow) return -1;
+  nlh->nlmsg_len = w.len;
+  return w.len;
+}
+
+// Dump request: type is RTM_GETLINK / RTM_GETADDR / RTM_GETROUTE / RTM_GETNEIGH.
+int onl_encode_dump(uint16_t type, uint8_t family, uint32_t seq, uint32_t pid,
+                    uint8_t* out, int cap) {
+  Writer w{out, cap};
+  // GETLINK wants ifinfomsg; the others take rtgenmsg/ifaddrmsg — a
+  // zeroed ifinfomsg-sized payload with the family in byte 0 covers all.
+  auto* nlh = static_cast<nlmsghdr*>(w.claim(NLMSG_LENGTH(sizeof(ifinfomsg))));
+  if (!nlh) return -1;
+  nlh->nlmsg_type = type;
+  nlh->nlmsg_flags = NLM_F_REQUEST | NLM_F_DUMP;
+  nlh->nlmsg_seq = seq;
+  nlh->nlmsg_pid = pid;
+  auto* ifi = static_cast<ifinfomsg*>(NLMSG_DATA(nlh));
+  ifi->ifi_family = family;
+  nlh->nlmsg_len = w.len;
+  return w.len;
+}
+
+// ---- decoder -------------------------------------------------------------
+
+namespace {
+
+void decode_link(const nlmsghdr* nlh, OnlMsg& m) {
+  auto* ifi = static_cast<const ifinfomsg*>(NLMSG_DATA(nlh));
+  m.kind = ONL_KIND_LINK;
+  m.is_del = nlh->nlmsg_type == RTM_DELLINK;
+  m.if_index = ifi->ifi_index;
+  m.if_flags = ifi->ifi_flags;
+  m.is_up = (ifi->ifi_flags & IFF_UP) && (ifi->ifi_flags & IFF_RUNNING);
+  int alen = nlh->nlmsg_len - NLMSG_LENGTH(sizeof(ifinfomsg));
+  for (const rtattr* rta = IFLA_RTA(ifi); RTA_OK(rta, alen);
+       rta = RTA_NEXT(rta, alen)) {
+    if (rta->rta_type == IFLA_IFNAME) {
+      strncpy(m.if_name, static_cast<const char*>(RTA_DATA(rta)),
+              ONL_IFNAME - 1);
+    }
+  }
+}
+
+void decode_addr(const nlmsghdr* nlh, OnlMsg& m) {
+  auto* ifa = static_cast<const ifaddrmsg*>(NLMSG_DATA(nlh));
+  m.kind = ONL_KIND_ADDR;
+  m.is_del = nlh->nlmsg_type == RTM_DELADDR;
+  m.if_index = (int32_t)ifa->ifa_index;
+  m.family = ifa->ifa_family;
+  m.prefix_len = ifa->ifa_prefixlen;
+  int alen = nlh->nlmsg_len - NLMSG_LENGTH(sizeof(ifaddrmsg));
+  for (const rtattr* rta = IFA_RTA(ifa); RTA_OK(rta, alen);
+       rta = RTA_NEXT(rta, alen)) {
+    if (rta->rta_type == IFA_ADDRESS || rta->rta_type == IFA_LOCAL) {
+      memcpy(m.addr, RTA_DATA(rta), addr_len(ifa->ifa_family));
+      m.addr_valid = 1;
+      if (rta->rta_type == IFA_LOCAL) break;  // prefer IFA_LOCAL
+    }
+  }
+}
+
+void decode_nh_attrs(const rtattr* rta, int alen, OnlNexthop& nh,
+                     uint8_t family) {
+  for (; RTA_OK(rta, alen); rta = RTA_NEXT(rta, alen)) {
+    switch (rta->rta_type & ~NLA_F_NESTED) {
+      case RTA_GATEWAY:
+        nh.family = family == AF_INET ? AF_INET : AF_INET6;
+        memcpy(nh.gateway, RTA_DATA(rta), addr_len(nh.family));
+        break;
+      case RTA_VIA: {
+        const uint8_t* d = static_cast<const uint8_t*>(RTA_DATA(rta));
+        uint16_t fam;
+        memcpy(&fam, d, 2);
+        nh.family = (uint8_t)fam;
+        memcpy(nh.gateway, d + 2, addr_len(nh.family));
+        break;
+      }
+      case RTA_OIF:
+        memcpy(&nh.if_index, RTA_DATA(rta), 4);
+        break;
+      case RTA_NEWDST:
+        nh.label_action = ONL_LBL_SWAP;
+        nh.label_count = (uint8_t)decode_label_stack(
+            static_cast<const uint8_t*>(RTA_DATA(rta)),
+            (int)RTA_PAYLOAD(rta), nh.labels, ONL_MAX_LABELS);
+        break;
+      case RTA_ENCAP: {
+        int nlen = (int)RTA_PAYLOAD(rta);
+        for (const rtattr* e = static_cast<const rtattr*>(RTA_DATA(rta));
+             RTA_OK(e, nlen); e = RTA_NEXT(e, nlen)) {
+          if (e->rta_type == MPLS_IPTUNNEL_DST) {
+            nh.label_action = ONL_LBL_PUSH;
+            nh.label_count = (uint8_t)decode_label_stack(
+                static_cast<const uint8_t*>(RTA_DATA(e)),
+                (int)RTA_PAYLOAD(e), nh.labels, ONL_MAX_LABELS);
+          }
+        }
+        break;
+      }
+    }
+  }
+}
+
+void decode_route(const nlmsghdr* nlh, OnlMsg& m) {
+  auto* rtm = static_cast<const rtmsg*>(NLMSG_DATA(nlh));
+  m.kind = ONL_KIND_ROUTE;
+  m.is_del = nlh->nlmsg_type == RTM_DELROUTE;
+  OnlRoute& r = m.route;
+  r.family = rtm->rtm_family;
+  r.prefix_len = rtm->rtm_dst_len;
+  r.table = rtm->rtm_table;
+  r.protocol = rtm->rtm_protocol;
+  r.route_type = rtm->rtm_type;
+
+  OnlNexthop top{};
+  top.if_index = -1;
+  bool have_top = false;
+
+  int alen = nlh->nlmsg_len - NLMSG_LENGTH(sizeof(rtmsg));
+  for (const rtattr* rta = RTM_RTA(rtm); RTA_OK(rta, alen);
+       rta = RTA_NEXT(rta, alen)) {
+    switch (rta->rta_type & ~NLA_F_NESTED) {
+      case RTA_DST:
+        if (rtm->rtm_family == AF_MPLS) {
+          uint32_t lbl;
+          decode_label_stack(static_cast<const uint8_t*>(RTA_DATA(rta)),
+                             (int)RTA_PAYLOAD(rta), &lbl, 1);
+          r.mpls_label = lbl;
+        } else {
+          memcpy(r.dst, RTA_DATA(rta), addr_len(rtm->rtm_family));
+        }
+        break;
+      case RTA_PRIORITY:
+        memcpy(&r.priority, RTA_DATA(rta), 4);
+        break;
+      case RTA_MULTIPATH: {
+        int mlen = (int)RTA_PAYLOAD(rta);
+        for (const rtnexthop* rtnh = static_cast<const rtnexthop*>(RTA_DATA(rta));
+             mlen >= (int)sizeof(rtnexthop) && rtnh->rtnh_len >= sizeof(rtnexthop) &&
+             rtnh->rtnh_len <= mlen;
+             mlen -= NLMSG_ALIGN(rtnh->rtnh_len),
+             rtnh = reinterpret_cast<const rtnexthop*>(
+                 reinterpret_cast<const uint8_t*>(rtnh) + NLMSG_ALIGN(rtnh->rtnh_len))) {
+          if (r.nh_count >= ONL_MAX_NEXTHOPS) break;
+          OnlNexthop& nh = r.nh[r.nh_count++];
+          memset(&nh, 0, sizeof(nh));
+          nh.if_index = rtnh->rtnh_ifindex;
+          nh.weight = rtnh->rtnh_hops + 1;
+          decode_nh_attrs(reinterpret_cast<const rtattr*>(RTNH_DATA(rtnh)),
+                          rtnh->rtnh_len - sizeof(rtnexthop), nh,
+                          rtm->rtm_family);
+        }
+        break;
+      }
+      default: {
+        // top-level single-nexthop attributes
+        decode_nh_attrs(rta, RTA_LENGTH(RTA_PAYLOAD(rta)), top,
+                        rtm->rtm_family);
+        if (rta->rta_type == RTA_GATEWAY || rta->rta_type == RTA_VIA ||
+            rta->rta_type == RTA_OIF || rta->rta_type == RTA_NEWDST ||
+            (rta->rta_type & ~NLA_F_NESTED) == RTA_ENCAP) {
+          have_top = true;
+        }
+        break;
+      }
+    }
+  }
+  if (r.nh_count == 0 && have_top) {
+    r.nh[0] = top;
+    r.nh_count = 1;
+  }
+}
+
+void decode_neigh(const nlmsghdr* nlh, OnlMsg& m) {
+  auto* ndm = static_cast<const ndmsg*>(NLMSG_DATA(nlh));
+  m.kind = ONL_KIND_NEIGH;
+  m.is_del = nlh->nlmsg_type == RTM_DELNEIGH;
+  m.if_index = ndm->ndm_ifindex;
+  m.family = ndm->ndm_family;
+  m.neigh_state = ndm->ndm_state;
+  int alen = nlh->nlmsg_len - NLMSG_LENGTH(sizeof(ndmsg));
+  for (const rtattr* rta = reinterpret_cast<const rtattr*>(
+           reinterpret_cast<const uint8_t*>(ndm) + NLMSG_ALIGN(sizeof(ndmsg)));
+       RTA_OK(rta, alen); rta = RTA_NEXT(rta, alen)) {
+    if (rta->rta_type == NDA_DST) {
+      memcpy(m.addr, RTA_DATA(rta), addr_len(ndm->ndm_family));
+      m.addr_valid = 1;
+    }
+  }
+}
+
+}  // namespace
+
+// Decode a recv buffer of netlink messages into `out[0..cap)`.
+// Returns number of messages decoded (unknown types are skipped).
+// `consumed` (optional) reports bytes processed so a caller can resume
+// decoding a buffer holding more than `cap` messages.
+int onl_decode(const uint8_t* buf, int len, OnlMsg* out, int cap,
+               int* consumed) {
+  int n = 0;
+  const int total = len;
+  const nlmsghdr* nlh = reinterpret_cast<const nlmsghdr*>(buf);
+  for (; NLMSG_OK(nlh, (unsigned)len) && n < cap; nlh = NLMSG_NEXT(nlh, len)) {
+    OnlMsg& m = out[n];
+    memset(&m, 0, sizeof(m));
+    m.nlmsg_type = nlh->nlmsg_type;
+    m.seq = nlh->nlmsg_seq;
+    m.if_index = -1;
+    switch (nlh->nlmsg_type) {
+      case NLMSG_DONE:
+        m.kind = ONL_KIND_DONE;
+        ++n;
+        break;
+      case NLMSG_ERROR: {
+        auto* err = static_cast<const nlmsgerr*>(NLMSG_DATA(nlh));
+        m.kind = ONL_KIND_ACK;
+        m.error = err->error;
+        m.seq = err->msg.nlmsg_seq;  // ack carries the request's seq
+        ++n;
+        break;
+      }
+      case RTM_NEWLINK:
+      case RTM_DELLINK:
+        decode_link(nlh, m);
+        ++n;
+        break;
+      case RTM_NEWADDR:
+      case RTM_DELADDR:
+        decode_addr(nlh, m);
+        ++n;
+        break;
+      case RTM_NEWROUTE:
+      case RTM_DELROUTE:
+        decode_route(nlh, m);
+        ++n;
+        break;
+      case RTM_NEWNEIGH:
+      case RTM_DELNEIGH:
+        decode_neigh(nlh, m);
+        ++n;
+        break;
+      default:
+        break;  // skip
+    }
+  }
+  if (consumed) {
+    *consumed = NLMSG_OK(nlh, (unsigned)len)
+                    ? (int)(reinterpret_cast<const uint8_t*>(nlh) - buf)
+                    : total;
+  }
+  return n;
+}
+
+int onl_msg_size(void) { return (int)sizeof(OnlMsg); }
+int onl_route_size(void) { return (int)sizeof(OnlRoute); }
+
+}  // extern "C"

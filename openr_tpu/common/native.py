@@ -1,0 +1,52 @@
+"""Build-on-demand loader for the repo's native C++ libraries.
+
+The reference ships its native layer (openr/nl, platform) as CMake-built
+C++; here each native component is a single translation unit under
+`native/` compiled lazily into a shared object next to its source.  A
+rebuild happens when the source is newer than the cached .so (mtime), under
+an exclusive file lock so parallel test workers don't race the compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import subprocess
+from pathlib import Path
+from typing import List, Optional
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+
+_CXX = os.environ.get("CXX", "g++")
+_CXXFLAGS = ["-O2", "-g", "-fPIC", "-shared", "-std=c++17", "-Wall"]
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_native_lib(name: str, extra_flags: Optional[List[str]] = None) -> Path:
+    """Compile native/<name>.cc -> native/lib<name>.so if stale; return path."""
+    src = NATIVE_DIR / f"{name}.cc"
+    out = NATIVE_DIR / f"lib{name}.so"
+    if not src.exists():
+        raise NativeBuildError(f"missing native source {src}")
+    lock_path = NATIVE_DIR / f".{name}.lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+            return out
+        tmp = out.with_suffix(".so.tmp")
+        cmd = [_CXX, *_CXXFLAGS, *(extra_flags or []), str(src), "-o", str(tmp)]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
+            )
+        os.replace(tmp, out)
+    return out
+
+
+def load_native_lib(name: str) -> ctypes.CDLL:
+    return ctypes.CDLL(str(build_native_lib(name)))

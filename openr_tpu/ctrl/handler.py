@@ -23,6 +23,7 @@ from typing import Any, AsyncIterator, Dict, List, Optional
 
 from openr_tpu import constants as C
 from openr_tpu.decision.rib_policy import RibPolicy
+from openr_tpu.kvstore.dual import DualMessages
 from openr_tpu.types import (
     ADJ_DB_MARKER,
     PrefixEntry,
@@ -246,6 +247,45 @@ class OpenrCtrlHandler:
         """SPT infos per discovered flood root (getKvStoreFloodTopoArea)."""
         topo = self.node.kv_store.get_flood_topo(area)
         return {"enabled": topo is not None, "roots": topo or {}}
+
+    # -- KvStore peer-session RPCs (the reference's peer sync/flood runs on
+    # the same ctrl service: getKvStoreKeyValsFilteredArea / setKvStoreKeyVals
+    # / DUAL PDUs, KvStore.h:460-466) — these back TcpKvStoreTransport
+
+    async def kv_store_full_sync_area(
+        self,
+        area: str,
+        key_val_hashes: Dict[str, list],
+        sender_id: str,
+    ) -> dict:
+        pub = await self.node.kv_store.handle_full_sync_request(
+            area,
+            {k: tuple(v) for k, v in key_val_hashes.items()},
+            sender_id,
+        )
+        return pub.to_wire()
+
+    async def kv_store_set_key_vals(
+        self, area: str, publication: dict, sender_id: str
+    ) -> None:
+        await self.node.kv_store.handle_set_key_vals(
+            area, Publication.from_wire(publication), sender_id
+        )
+
+    async def kv_store_dual_messages(
+        self, area: str, messages: dict, sender_id: str
+    ) -> None:
+        await self.node.kv_store.handle_dual_messages(
+            area, DualMessages.from_wire(messages)
+        )
+
+    async def kv_store_flood_topo_set(
+        self, area: str, root_id: str, child: str, set_child: bool,
+        sender_id: str,
+    ) -> None:
+        await self.node.kv_store.handle_flood_topo_set(
+            area, root_id, child, set_child
+        )
 
     # ----------------------------------------------------------------- spark
 

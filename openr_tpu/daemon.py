@@ -97,6 +97,68 @@ async def run_emulation(
     await net.stop()
 
 
+async def run_real_node(
+    config: OpenrConfig,
+    ctrl_port: int,
+    fib_mode: str,
+    ctrl_host: str = "::",
+) -> None:
+    """Deployment mode: real UDP multicast wire (UdpIoProvider), real TCP
+    KvStore peer sessions (TcpKvStoreTransport), real kernel netlink for
+    interface events + route programming — the openr/Main.cpp bring-up
+    shape on an actual host."""
+    from openr_tpu.kvstore.transport import TcpKvStoreTransport
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.platform import (
+        NetlinkFibAgent,
+        NetlinkFibHandler,
+        RemoteFibAgent,
+    )
+    from openr_tpu.platform.nl import NetlinkProtocolSocket
+    from openr_tpu.spark.io_provider import UdpIoProvider
+
+    netlink_events_q = ReplicateQueue("netlinkEvents")
+    nl = NetlinkProtocolSocket(events_queue=netlink_events_q)
+    nl.start()
+    fib_agent = None
+    if fib_mode == "netlink":
+        fib_agent = NetlinkFibAgent(NetlinkFibHandler(nl))
+    elif fib_mode == "remote":
+        fib_agent = RemoteFibAgent(port=config.fib_config.fib_port)
+
+    clock = WallClock()
+    node = OpenrNode(
+        config=config,
+        clock=clock,
+        io_provider=UdpIoProvider(),
+        kv_transport=TcpKvStoreTransport(),
+        fib_agent=fib_agent,
+        netlink_events_queue=netlink_events_q,
+    )
+    node.start()
+    # initial kernel interface sync (LinkMonitor's periodic-sync seed,
+    # LinkMonitor.h:204-215); incremental events flow from the nl socket
+    node.link_monitor.set_interfaces(await nl.get_all_interfaces())
+    # bind wide ("::" = v4+v6): remote peers' TcpKvStoreTransport dials
+    # this port for KvStore full-sync/flooding, so loopback-only would
+    # break cross-host peering
+    server = OpenrCtrlServer(node, host=ctrl_host, port=ctrl_port)
+    await server.start()
+    print(f"{config.node_name}: ctrl on [{ctrl_host}]:{server.port} "
+          f"(fib={fib_mode})")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover - non-unix
+            pass
+    await stop.wait()
+    await server.stop()
+    await node.stop()
+    nl.close()
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="openr_tpu", description=__doc__)
     p.add_argument("--config", help="OpenrConfig JSON file (single-node mode)")
@@ -105,6 +167,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--topology", default="line",
                    choices=["line", "ring", "grid"])
     p.add_argument("--ctrl-base-port", type=int, default=2018)
+    p.add_argument("--real", action="store_true",
+                   help="with --config: real UDP/TCP/netlink planes")
+    p.add_argument("--ctrl-host", default="::",
+                   help="ctrl server bind address in --real mode")
+    p.add_argument("--fib", default="dryrun",
+                   choices=["dryrun", "netlink", "remote"],
+                   help="route programming backend in --real mode")
     args = p.parse_args(argv)
 
     if args.emulate:
@@ -113,11 +182,18 @@ def main(argv: Optional[List[str]] = None) -> None:
         )
         return
     if args.config:
-        # Single real node: the physical UDP/TCP network plane is not wired
-        # up yet (in-process providers only); a 1-node "network" still
-        # serves the full ctrl/CLI surface.
         with open(args.config) as f:
             config = OpenrConfig.from_json(f.read())
+
+        if args.real:
+            asyncio.run(
+                run_real_node(
+                    config, args.ctrl_base_port, args.fib, args.ctrl_host
+                )
+            )
+            return
+        # Without --real: a 1-node in-process "network" still serves the
+        # full ctrl/CLI surface (useful on hosts without netlink perms).
 
         async def single():
             from openr_tpu.emulation.network import EmulatedNetwork

@@ -67,6 +67,30 @@ class DualMessages:
     src_id: str
     messages: List[DualMessage] = field(default_factory=list)
 
+    def to_wire(self) -> dict:
+        return {
+            "src_id": self.src_id,
+            "messages": [
+                {"dst_id": m.dst_id, "distance": m.distance,
+                 "type": m.type.value}
+                for m in self.messages
+            ],
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "DualMessages":
+        return cls(
+            src_id=d["src_id"],
+            messages=[
+                DualMessage(
+                    dst_id=m["dst_id"],
+                    distance=m["distance"],
+                    type=DualMessageType(m["type"]),
+                )
+                for m in d.get("messages", [])
+            ],
+        )
+
 
 #: neighbor-id -> messages accumulated for it during one event
 MsgBatch = Dict[str, List[DualMessage]]

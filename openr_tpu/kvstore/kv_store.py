@@ -132,7 +132,10 @@ class KvStoreDb:
     # -- peer management (addThriftPeers/delThriftPeers) -------------------
 
     def add_peers(self, peers: Dict[str, PeerSpec]) -> None:
+        register = getattr(self.actor.transport, "register_peer", None)
         for name, spec in peers.items():
+            if register is not None:
+                register(name, spec)
             existing = self.peers.get(name)
             if existing is not None:
                 # peer re-add (e.g. graceful restart): reset to IDLE for
@@ -156,7 +159,10 @@ class KvStoreDb:
             self._schedule_peer_sync(self.peers[name])
 
     def del_peers(self, names: List[str]) -> None:
+        unregister = getattr(self.actor.transport, "unregister_peer", None)
         for name in names:
+            if unregister is not None:
+                unregister(name)
             peer = self.peers.pop(name, None)
             if peer is not None and peer.sync_task is not None:
                 peer.sync_task.cancel()

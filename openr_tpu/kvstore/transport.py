@@ -14,7 +14,7 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional, Set, Tuple
 
 from openr_tpu.common.runtime import Clock
-from openr_tpu.types import Publication
+from openr_tpu.types import PeerSpec, Publication
 
 
 class KvStoreTransportError(RuntimeError):
@@ -132,4 +132,138 @@ class InProcessTransport(KvStoreTransport):
             lambda store: store.handle_flood_topo_set(
                 area, root_id, child, set_child
             ),
+        )
+
+
+class TcpKvStoreTransport(KvStoreTransport):
+    """Real peer transport: each call is an RPC to the peer's ctrl server.
+
+    This is the reference's deployment shape — KvStore peer sessions are
+    thrift clients of the peer's OpenrCtrlCpp service (KvStore.h:460-466);
+    here they are OpenrCtrlClient connections to the peer's framed-JSON
+    ctrl server, targeted via the PeerSpec (peer_addr, ctrl_port) that
+    LinkMonitor learned from the Spark handshake.
+
+    KvStoreDb registers/unregisters specs via the duck-typed
+    `register_peer`/`unregister_peer` hooks on peer add/del.  Connections
+    are cached per peer and torn down on failure so the KvStore's backoff
+    machinery drives reconnects.
+    """
+
+    def __init__(self) -> None:
+        self._specs: Dict[str, Tuple[str, int]] = {}
+        self._clients: Dict[str, object] = {}
+        #: strong refs to detached close() tasks (loop refs are weak)
+        self._close_tasks: Set[object] = set()
+
+    # -- peer registry hooks (called by KvStoreDb) --------------------------
+
+    def register_peer(self, peer_node: str, spec: PeerSpec) -> None:
+        addr = spec.peer_addr or "127.0.0.1"
+        target = (addr, spec.ctrl_port)
+        if self._specs.get(peer_node) != target:
+            self._specs[peer_node] = target
+            self._drop_client(peer_node)
+
+    def unregister_peer(self, peer_node: str) -> None:
+        self._specs.pop(peer_node, None)
+        self._drop_client(peer_node)
+
+    def _drop_client(self, peer_node: str) -> None:
+        client = self._clients.pop(peer_node, None)
+        if client is not None:
+            import asyncio
+
+            task = asyncio.ensure_future(client.close())
+            self._close_tasks.add(task)
+
+            def _done(t, tasks=self._close_tasks):
+                tasks.discard(t)
+                t.exception()
+
+            task.add_done_callback(_done)
+
+    async def close(self) -> None:
+        for peer in list(self._clients):
+            client = self._clients.pop(peer)
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _client(self, peer_node: str):
+        from openr_tpu.ctrl.client import OpenrCtrlClient
+
+        client = self._clients.get(peer_node)
+        if client is not None:
+            return client
+        target = self._specs.get(peer_node)
+        if target is None:
+            raise KvStoreTransportError(f"no PeerSpec for {peer_node}")
+        try:
+            client = await OpenrCtrlClient(
+                host=target[0], port=target[1]
+            ).connect()
+        except OSError as e:
+            raise KvStoreTransportError(
+                f"connect to {peer_node} {target} failed: {e}"
+            ) from e
+        self._clients[peer_node] = client
+        return client
+
+    async def _call(self, peer_node: str, method: str, **params):
+        client = await self._client(peer_node)
+        try:
+            return await client.call(method, **params)
+        except (OSError, RuntimeError) as e:
+            self._drop_client(peer_node)
+            raise KvStoreTransportError(
+                f"rpc {method} to {peer_node} failed: {e}"
+            ) from e
+
+    # -- KvStoreTransport surface -------------------------------------------
+
+    async def get_key_vals_filtered_area(
+        self, peer_node, area, key_val_hashes, sender_id
+    ) -> Publication:
+        wire = await self._call(
+            peer_node,
+            "kv_store_full_sync_area",
+            area=area,
+            key_val_hashes={k: list(v) for k, v in key_val_hashes.items()},
+            sender_id=sender_id,
+        )
+        return Publication.from_wire(wire)
+
+    async def set_key_vals(self, peer_node, area, publication, sender_id) -> None:
+        await self._call(
+            peer_node,
+            "kv_store_set_key_vals",
+            area=area,
+            publication=publication.to_wire(),
+            sender_id=sender_id,
+        )
+
+    async def send_dual_messages(
+        self, peer_node, area, messages, sender_id
+    ) -> None:
+        await self._call(
+            peer_node,
+            "kv_store_dual_messages",
+            area=area,
+            messages=messages.to_wire(),
+            sender_id=sender_id,
+        )
+
+    async def set_flood_topo_child(
+        self, peer_node, area, root_id, child, set_child, sender_id
+    ) -> None:
+        await self._call(
+            peer_node,
+            "kv_store_flood_topo_set",
+            area=area,
+            root_id=root_id,
+            child=child,
+            set_child=set_child,
+            sender_id=sender_id,
         )

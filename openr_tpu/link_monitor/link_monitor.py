@@ -263,12 +263,19 @@ class LinkMonitor(Actor):
                 self._advertise_adjs_throttle()
 
     def _peer_up(self, ev: NeighborEvent) -> None:
+        # a bare fe80:: address is unroutable without a scope; qualify it
+        # with the local interface the neighbor was heard on so the KvStore
+        # transport can actually dial it (the reference carries the scope
+        # the same way in its thrift peer addr)
+        peer_addr = ev.neighbor_addr_v6 or ev.node_name
+        if peer_addr.startswith("fe80:") and "%" not in peer_addr:
+            peer_addr = f"{peer_addr}%{ev.local_if_name}"
         self.peer_updates_queue.push(
             PeerEvent(
                 area=ev.area,
                 peers_to_add={
                     ev.node_name: PeerSpec(
-                        peer_addr=ev.neighbor_addr_v6 or ev.node_name,
+                        peer_addr=peer_addr,
                         ctrl_port=ev.ctrl_port,
                         supports_flood_optimization=ev.enable_flood_optimization,
                     )

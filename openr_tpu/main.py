@@ -125,6 +125,7 @@ class OpenrNode:
         kv_transport: KvStoreTransport,
         fib_agent: Optional[FibAgent] = None,
         use_tpu_backend: Optional[bool] = None,
+        netlink_events_queue: Optional[ReplicateQueue] = None,
     ) -> None:
         self.config = config
         self.clock = clock
@@ -186,6 +187,11 @@ class OpenrNode:
             node_labels=node_labels,
             initialization_cb=on_init,
             counters=self.counters,
+            netlink_events_reader=(
+                netlink_events_queue.get_reader()
+                if netlink_events_queue is not None
+                else None
+            ),
         )
         # the handshake advertises our DUAL capability; single source of
         # truth is the kvstore config

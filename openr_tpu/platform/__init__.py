@@ -1,0 +1,14 @@
+"""openr_tpu.platform — kernel/platform I/O layer.
+
+Reference parity: openr/platform (FibService agent over netlink) +
+openr/nl (netlink protocol sockets).  The nl codec is native C++
+(native/nl_codec.cc); see openr_tpu.platform.nl.
+"""
+
+from openr_tpu.platform.fib_service import (  # noqa: F401
+    CLIENT_ID_OPENR,
+    FibServiceServer,
+    NetlinkFibAgent,
+    NetlinkFibHandler,
+    RemoteFibAgent,
+)

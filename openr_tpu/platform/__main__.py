@@ -1,0 +1,45 @@
+"""Standalone platform daemon — `python -m openr_tpu.platform`.
+
+Reference parity: the `platform_linux` binary
+(openr/platform/LinuxPlatformMain.cpp:26-69): serve FibService over the
+real kernel netlink socket on --fib-port, independent of the main daemon.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from openr_tpu.platform.fib_service import FibServiceServer, NetlinkFibHandler
+from openr_tpu.platform.nl import NetlinkProtocolSocket
+
+
+async def run(host: str, port: int) -> None:
+    nl = NetlinkProtocolSocket()
+    nl.start()
+    handler = NetlinkFibHandler(nl)
+    server = FibServiceServer(handler, host=host, port=port)
+    await server.start()
+    logging.info("FibService listening on %s:%d", host, server.port)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()
+        nl.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="openr_tpu platform daemon")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--fib-port", type=int, default=60100)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(run(args.host, args.fib_port))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""openr_tpu.platform.nl — netlink platform layer.
+
+Reference parity: openr/nl/ (NetlinkProtocolSocket + message codecs,
+~5.7k LoC C++).  Here the codec is native C++ (native/nl_codec.cc, loaded
+via ctypes) and the async socket driver is Python asyncio.
+"""
+
+from openr_tpu.platform.nl.codec import (  # noqa: F401
+    AF_INET,
+    AF_INET6,
+    AF_MPLS,
+    LabelAction,
+    NlCodec,
+    NlNexthop,
+    NlRoute,
+)
+from openr_tpu.platform.nl.nl_socket import (  # noqa: F401
+    NetlinkProtocolSocket,
+    NetlinkSocketError,
+)
+from openr_tpu.platform.nl.mock import (  # noqa: F401
+    MockNetlinkProtocolSocket,
+    NetlinkEventsInjector,
+)

@@ -1,0 +1,373 @@
+"""ctypes binding for the native rtnetlink codec (native/nl_codec.cc).
+
+Mirrors the C structs exactly (pack=1) and converts to/from the Python
+dataclasses `NlRoute`/`NlNexthop` used by the rest of the platform layer.
+Reference parity: openr/nl/NetlinkRouteMessage.h:58 and siblings.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+import ipaddress
+import socket as pysocket
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from openr_tpu.common.native import load_native_lib
+
+AF_INET = int(pysocket.AF_INET)
+AF_INET6 = int(pysocket.AF_INET6)
+AF_MPLS = 28  # linux/socket.h
+
+# rtnetlink message types (linux/rtnetlink.h)
+RTM_NEWROUTE = 24
+RTM_DELROUTE = 25
+RTM_GETROUTE = 26
+RTM_NEWLINK = 16
+RTM_DELLINK = 17
+RTM_GETLINK = 18
+RTM_NEWADDR = 20
+RTM_DELADDR = 21
+RTM_GETADDR = 22
+RTM_GETNEIGH = 30
+
+_MAX_NEXTHOPS = 128
+_MAX_LABELS = 16
+_IFNAME = 32
+
+
+class LabelAction(enum.IntEnum):
+    """MPLS nexthop label operation (Network.thrift MplsActionCode)."""
+
+    NONE = 0
+    PUSH = 1
+    SWAP = 2
+    PHP = 3
+    POP_AND_LOOKUP = 4
+
+
+class Kind(enum.IntEnum):
+    LINK = 1
+    ADDR = 2
+    ROUTE = 3
+    NEIGH = 4
+    ACK = 5
+    DONE = 6
+
+
+class _CNexthop(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("family", ctypes.c_uint8),
+        ("gateway", ctypes.c_uint8 * 16),
+        ("if_index", ctypes.c_int32),
+        ("weight", ctypes.c_uint32),
+        ("label_action", ctypes.c_uint8),
+        ("label_count", ctypes.c_uint8),
+        ("labels", ctypes.c_uint32 * _MAX_LABELS),
+    ]
+
+
+class _CRoute(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("family", ctypes.c_uint8),
+        ("prefix_len", ctypes.c_uint8),
+        ("dst", ctypes.c_uint8 * 16),
+        ("mpls_label", ctypes.c_uint32),
+        ("table", ctypes.c_uint8),
+        ("protocol", ctypes.c_uint8),
+        ("route_type", ctypes.c_uint8),
+        ("priority", ctypes.c_uint32),
+        ("nh_count", ctypes.c_uint32),
+        ("nh", _CNexthop * _MAX_NEXTHOPS),
+    ]
+
+
+class _CMsg(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [
+        ("kind", ctypes.c_uint16),
+        ("nlmsg_type", ctypes.c_uint16),
+        ("seq", ctypes.c_uint32),
+        ("error", ctypes.c_int32),
+        ("is_del", ctypes.c_uint8),
+        ("if_index", ctypes.c_int32),
+        ("if_flags", ctypes.c_uint32),
+        ("is_up", ctypes.c_uint8),
+        ("if_name", ctypes.c_char * _IFNAME),
+        ("family", ctypes.c_uint8),
+        ("prefix_len", ctypes.c_uint8),
+        ("addr_valid", ctypes.c_uint8),
+        ("addr", ctypes.c_uint8 * 16),
+        ("neigh_state", ctypes.c_uint16),
+        ("route", _CRoute),
+    ]
+
+
+@dataclass
+class NlNexthop:
+    """One route nexthop: gateway + oif + optional MPLS label op."""
+
+    gateway: Optional[str] = None  # IP address string
+    if_index: int = -1
+    weight: int = 0
+    label_action: LabelAction = LabelAction.NONE
+    labels: Tuple[int, ...] = ()
+
+
+@dataclass
+class NlRoute:
+    """A kernel route: IPv4/IPv6 `prefix` or an MPLS incoming `label`."""
+
+    prefix: Optional[str] = None  # "net/len"; None for MPLS routes
+    label: Optional[int] = None  # AF_MPLS incoming label
+    nexthops: List[NlNexthop] = field(default_factory=list)
+    protocol: int = 99  # openr's kernel route protocol id
+    table: int = 0  # 0 -> RT_TABLE_MAIN
+    priority: int = 0
+
+    @property
+    def family(self) -> int:
+        if self.label is not None:
+            return AF_MPLS
+        net = ipaddress.ip_network(self.prefix, strict=False)
+        return AF_INET if net.version == 4 else AF_INET6
+
+    def key(self) -> Tuple:
+        return (self.prefix, self.label, self.table)
+
+
+@dataclass
+class NlLink:
+    if_index: int
+    if_name: str
+    is_up: bool
+    flags: int = 0
+    is_del: bool = False
+
+
+@dataclass
+class NlAddr:
+    if_index: int
+    prefix: str  # "addr/len"
+    is_del: bool = False
+
+
+@dataclass
+class NlNeighbor:
+    if_index: int
+    address: str
+    state: int
+    is_del: bool = False
+
+
+@dataclass
+class NlAck:
+    seq: int
+    error: int  # 0 = success, else -errno
+
+
+@dataclass
+class NlDone:
+    seq: int
+
+
+def _pack_ip(addr: str, family: int) -> bytes:
+    return pysocket.inet_pton(
+        pysocket.AF_INET if family == AF_INET else pysocket.AF_INET6, addr
+    )
+
+
+def _unpack_ip(raw: bytes, family: int) -> str:
+    if family == AF_INET:
+        return pysocket.inet_ntop(pysocket.AF_INET, bytes(raw[:4]))
+    return pysocket.inet_ntop(pysocket.AF_INET6, bytes(raw[:16]))
+
+
+class NlCodec:
+    """Thin stateless wrapper over libnl_codec.so."""
+
+    def __init__(self) -> None:
+        lib = load_native_lib("nl_codec")
+        assert lib.onl_msg_size() == ctypes.sizeof(_CMsg), "ABI drift: OnlMsg"
+        assert lib.onl_route_size() == ctypes.sizeof(_CRoute), "ABI drift: OnlRoute"
+        lib.onl_encode_route.restype = ctypes.c_int
+        lib.onl_encode_addr.restype = ctypes.c_int
+        lib.onl_encode_dump.restype = ctypes.c_int
+        lib.onl_decode.restype = ctypes.c_int
+        self._lib = lib
+        self._buf = ctypes.create_string_buffer(64 * 1024)
+        self._msgs = (_CMsg * 512)()
+
+    # -- encode ------------------------------------------------------------
+
+    def _to_c_route(self, route: NlRoute) -> _CRoute:
+        c = _CRoute()
+        fam = route.family
+        c.family = fam
+        c.protocol = route.protocol
+        c.table = route.table
+        c.priority = route.priority
+        if fam == AF_MPLS:
+            c.mpls_label = route.label
+        else:
+            net = ipaddress.ip_network(route.prefix, strict=False)
+            c.prefix_len = net.prefixlen
+            raw = net.network_address.packed
+            ctypes.memmove(c.dst, raw, len(raw))
+        if len(route.nexthops) > _MAX_NEXTHOPS:
+            raise ValueError(f"too many nexthops ({len(route.nexthops)})")
+        c.nh_count = len(route.nexthops)
+        for i, nh in enumerate(route.nexthops):
+            cn = c.nh[i]
+            cn.if_index = nh.if_index
+            cn.weight = nh.weight
+            cn.label_action = int(nh.label_action)
+            if len(nh.labels) > _MAX_LABELS:
+                raise ValueError(f"label stack too deep ({len(nh.labels)})")
+            cn.label_count = len(nh.labels)
+            for j, lbl in enumerate(nh.labels):
+                cn.labels[j] = lbl
+            if nh.gateway:
+                gw = ipaddress.ip_address(nh.gateway)
+                cn.family = AF_INET if gw.version == 4 else AF_INET6
+                ctypes.memmove(cn.gateway, gw.packed, len(gw.packed))
+        return c
+
+    def encode_route(
+        self,
+        route: NlRoute,
+        is_del: bool = False,
+        replace: bool = True,
+        seq: int = 0,
+        pid: int = 0,
+    ) -> bytes:
+        c = self._to_c_route(route)
+        n = self._lib.onl_encode_route(
+            ctypes.byref(c), int(is_del), int(replace), seq, pid,
+            self._buf, len(self._buf),
+        )
+        if n < 0:
+            raise ValueError(f"route encode failed: {route}")
+        return self._buf.raw[:n]
+
+    def encode_addr(
+        self,
+        if_index: int,
+        prefix: str,
+        is_del: bool = False,
+        seq: int = 0,
+        pid: int = 0,
+    ) -> bytes:
+        iface = ipaddress.ip_interface(prefix)
+        fam = AF_INET if iface.version == 4 else AF_INET6
+        raw = iface.ip.packed
+        n = self._lib.onl_encode_addr(
+            int(is_del), seq, pid, if_index, fam,
+            (ctypes.c_uint8 * len(raw)).from_buffer_copy(raw),
+            iface.network.prefixlen, self._buf, len(self._buf),
+        )
+        if n < 0:
+            raise ValueError(f"addr encode failed: {prefix}")
+        return self._buf.raw[:n]
+
+    def encode_dump(self, rtm_type: int, family: int = 0, seq: int = 0,
+                    pid: int = 0) -> bytes:
+        n = self._lib.onl_encode_dump(rtm_type, family, seq, pid, self._buf,
+                                      len(self._buf))
+        if n < 0:
+            raise ValueError("dump encode failed")
+        return self._buf.raw[:n]
+
+    # -- decode ------------------------------------------------------------
+
+    def _from_c_route(self, c: _CRoute, is_del: bool) -> NlRoute:
+        route = NlRoute(protocol=c.protocol, table=c.table, priority=c.priority)
+        if c.family == AF_MPLS:
+            route.label = c.mpls_label
+        else:
+            net_addr = _unpack_ip(bytes(c.dst), c.family)
+            route.prefix = f"{net_addr}/{c.prefix_len}"
+        for i in range(c.nh_count):
+            cn = c.nh[i]
+            nh = NlNexthop(
+                if_index=cn.if_index,
+                weight=cn.weight,
+                label_action=LabelAction(cn.label_action),
+                labels=tuple(cn.labels[j] for j in range(cn.label_count)),
+            )
+            if cn.family in (AF_INET, AF_INET6):
+                nh.gateway = _unpack_ip(bytes(cn.gateway), cn.family)
+            route.nexthops.append(nh)
+        return route
+
+    def decode(self, data: bytes) -> List[object]:
+        """Decode a recv buffer into NlLink/NlAddr/NlRoute/NlNeighbor/
+        NlAck/NlDone events (is_del routes come back as (route, True)).
+        Buffers holding more messages than the staging array are decoded
+        in chunks via the codec's `consumed` cursor — nothing is dropped."""
+        out: List[object] = []
+        offset = 0
+        while offset < len(data):
+            consumed = ctypes.c_int(0)
+            n = self._lib.onl_decode(
+                data[offset:], len(data) - offset, self._msgs, len(self._msgs),
+                ctypes.byref(consumed),
+            )
+            self._collect(n, out)
+            if consumed.value <= 0:
+                break
+            offset += consumed.value
+        return out
+
+    def _collect(self, n: int, out: List[object]) -> None:
+        for i in range(n):
+            m = self._msgs[i]
+            kind = m.kind
+            if kind == Kind.ACK:
+                out.append(NlAck(seq=m.seq, error=m.error))
+            elif kind == Kind.DONE:
+                out.append(NlDone(seq=m.seq))
+            elif kind == Kind.LINK:
+                out.append(
+                    NlLink(
+                        if_index=m.if_index,
+                        if_name=m.if_name.decode(),
+                        is_up=bool(m.is_up),
+                        flags=m.if_flags,
+                        is_del=bool(m.is_del),
+                    )
+                )
+            elif kind == Kind.ADDR and m.addr_valid:
+                addr = _unpack_ip(bytes(m.addr), m.family)
+                out.append(
+                    NlAddr(
+                        if_index=m.if_index,
+                        prefix=f"{addr}/{m.prefix_len}",
+                        is_del=bool(m.is_del),
+                    )
+                )
+            elif kind == Kind.ROUTE:
+                route = self._from_c_route(m.route, bool(m.is_del))
+                out.append((route, bool(m.is_del)))
+            elif kind == Kind.NEIGH and m.addr_valid:
+                out.append(
+                    NlNeighbor(
+                        if_index=m.if_index,
+                        address=_unpack_ip(bytes(m.addr), m.family),
+                        state=m.neigh_state,
+                        is_del=bool(m.is_del),
+                    )
+                )
+
+
+_codec: Optional[NlCodec] = None
+
+
+def get_codec() -> NlCodec:
+    global _codec
+    if _codec is None:
+        _codec = NlCodec()
+    return _codec

@@ -1,0 +1,111 @@
+"""Fake kernel for tests — MockNetlinkProtocolSocket + NetlinkEventsInjector.
+
+Reference parity: openr/tests/mocks/MockNetlinkProtocolSocket.h and
+NetlinkEventsInjector (link-monitor/tests): an in-memory links/addrs/routes
+table implementing the same API as the real socket, with an injector that
+fakes kernel events onto the netlinkEventsQueue.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.platform.nl.codec import NlAddr, NlLink, NlRoute
+from openr_tpu.platform.nl.nl_socket import BaseNetlinkProtocolSocket
+from openr_tpu.types import InterfaceInfo
+
+
+class MockNetlinkProtocolSocket(BaseNetlinkProtocolSocket):
+    def __init__(self, events_queue: Optional[ReplicateQueue] = None) -> None:
+        self.events_queue = events_queue
+        self.links: Dict[int, NlLink] = {}
+        self.addrs: Dict[Tuple[int, str], NlAddr] = {}
+        self.routes: Dict[Tuple, NlRoute] = {}
+        self.fail = False  # failure injection
+        self.num_route_adds = 0
+        self.num_route_dels = 0
+
+    def _check(self) -> None:
+        if self.fail:
+            raise OSError("mock netlink failure injected")
+
+    # -- route/addr ops ------------------------------------------------------
+
+    async def add_route(self, route: NlRoute) -> None:
+        self._check()
+        self.routes[route.key()] = route
+        self.num_route_adds += 1
+
+    async def delete_route(self, route: NlRoute) -> None:
+        self._check()
+        self.routes.pop(route.key(), None)
+        self.num_route_dels += 1
+
+    async def add_if_address(self, if_index: int, prefix: str) -> None:
+        self._check()
+        self.addrs[(if_index, prefix)] = NlAddr(if_index=if_index, prefix=prefix)
+
+    async def del_if_address(self, if_index: int, prefix: str) -> None:
+        self._check()
+        self.addrs.pop((if_index, prefix), None)
+
+    # -- dumps ---------------------------------------------------------------
+
+    async def get_all_links(self) -> List[NlLink]:
+        self._check()
+        return list(self.links.values())
+
+    async def get_all_addrs(self) -> List[NlAddr]:
+        self._check()
+        return list(self.addrs.values())
+
+    async def get_all_routes(
+        self, protocol: Optional[int] = None
+    ) -> List[NlRoute]:
+        self._check()
+        return [
+            r
+            for r in self.routes.values()
+            if protocol is None or r.protocol == protocol
+        ]
+
+
+class NetlinkEventsInjector:
+    """Drives the mock kernel: bring links up/down, add/remove addresses,
+    publishing merged InterfaceInfo events exactly like the real socket."""
+
+    def __init__(self, nl_sock: MockNetlinkProtocolSocket) -> None:
+        self.nl = nl_sock
+
+    def _publish(self, if_index: int) -> None:
+        link = self.nl.links.get(if_index)
+        if link is None or self.nl.events_queue is None:
+            return
+        networks = [
+            a.prefix for (idx, _), a in self.nl.addrs.items() if idx == if_index
+        ]
+        self.nl.events_queue.push(
+            InterfaceInfo(
+                if_name=link.if_name,
+                is_up=link.is_up,
+                if_index=if_index,
+                networks=networks,
+            )
+        )
+
+    def set_link(self, if_index: int, if_name: str, is_up: bool) -> None:
+        self.nl.links[if_index] = NlLink(
+            if_index=if_index, if_name=if_name, is_up=is_up
+        )
+        self._publish(if_index)
+
+    def add_address(self, if_index: int, prefix: str) -> None:
+        self.nl.addrs[(if_index, prefix)] = NlAddr(
+            if_index=if_index, prefix=prefix
+        )
+        self._publish(if_index)
+
+    def del_address(self, if_index: int, prefix: str) -> None:
+        self.nl.addrs.pop((if_index, prefix), None)
+        self._publish(if_index)

@@ -15,7 +15,11 @@ multicast ff02::1:6666) plugs into the same seam for deployment.
 
 from __future__ import annotations
 
-from typing import Awaitable, Callable, Dict, List, Tuple
+import asyncio
+import json
+import socket as pysocket
+import struct
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
 from openr_tpu.common.runtime import Actor, Clock
 
@@ -105,3 +109,122 @@ class MockIoProvider(IoProvider):
 
     async def stop(self) -> None:
         await self._pump.stop()
+
+
+#: Spark's wire rendezvous — IPv6 link-local "all nodes" multicast on the
+#: UDP port the reference pins (common/Constants.h:107, kSparkMcastAddr /
+#: kSparkReportPort 6666)
+SPARK_MCAST_ADDR = "ff02::1"
+SPARK_UDP_PORT = 6666
+
+IPV6_JOIN_GROUP = getattr(pysocket, "IPV6_JOIN_GROUP", 20)
+
+
+class UdpIoProvider(IoProvider):
+    """The real network plane: one UDP socket per interface, bound to the
+    Spark port, joined to ff02::1 on that interface, sending to the
+    link-local group scoped by ifindex (IoProvider.cpp:43-88 semantics).
+
+    Payloads (the dict packets Spark exchanges) ride as JSON datagrams.
+    Interfaces are attached on demand via `add_interface` as LinkMonitor
+    tells Spark which links to track; only one node runs per provider
+    (this is deployment, not emulation).
+    """
+
+    def __init__(self, port: int = SPARK_UDP_PORT) -> None:
+        self.port = port
+        self._cb: Optional[RecvCallback] = None
+        self._node: Optional[str] = None
+        #: if_name -> (socket, ifindex)
+        self._socks: Dict[str, Tuple[pysocket.socket, int]] = {}
+        #: strong refs to in-flight delivery tasks — the loop only keeps
+        #: weak ones, so an unreferenced callback task can be GC'd mid-air
+        self._tasks: set = set()
+        self.packets_sent = 0
+        self.packets_received = 0
+
+    def register(self, node: str, cb: RecvCallback) -> None:
+        self._node = node
+        self._cb = cb
+
+    def unregister(self, node: str) -> None:
+        if self._node == node:
+            self._cb = None
+        for if_name in list(self._socks):
+            self.remove_interface(if_name)
+
+    # -- interface lifecycle -------------------------------------------------
+
+    def add_interface(self, if_name: str) -> None:
+        if if_name in self._socks:
+            return
+        if_index = pysocket.if_nametoindex(if_name)
+        sock = pysocket.socket(
+            pysocket.AF_INET6, pysocket.SOCK_DGRAM, pysocket.IPPROTO_UDP
+        )
+        sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
+        sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEPORT, 1)
+        sock.setblocking(False)
+        sock.bind(("::", self.port))
+        # join ff02::1 scoped to this interface
+        group = pysocket.inet_pton(pysocket.AF_INET6, SPARK_MCAST_ADDR)
+        sock.setsockopt(
+            pysocket.IPPROTO_IPV6, IPV6_JOIN_GROUP,
+            group + struct.pack("@I", if_index),
+        )
+        # outgoing multicast: this interface, hop limit 1, no self-loop
+        sock.setsockopt(
+            pysocket.IPPROTO_IPV6, pysocket.IPV6_MULTICAST_IF, if_index
+        )
+        sock.setsockopt(pysocket.IPPROTO_IPV6, pysocket.IPV6_MULTICAST_HOPS, 1)
+        sock.setsockopt(pysocket.IPPROTO_IPV6, pysocket.IPV6_MULTICAST_LOOP, 0)
+        self._socks[if_name] = (sock, if_index)
+        asyncio.get_running_loop().add_reader(
+            sock.fileno(), self._on_readable, if_name, sock
+        )
+
+    def remove_interface(self, if_name: str) -> None:
+        entry = self._socks.pop(if_name, None)
+        if entry is None:
+            return
+        sock, _ = entry
+        try:
+            asyncio.get_event_loop().remove_reader(sock.fileno())
+        except RuntimeError:  # pragma: no cover - loop already closed
+            pass
+        sock.close()
+
+    # -- data path -----------------------------------------------------------
+
+    def send(self, node: str, if_name: str, payload: dict) -> None:
+        entry = self._socks.get(if_name)
+        if entry is None:
+            return
+        sock, if_index = entry
+        data = json.dumps(payload, default=str).encode()
+        try:
+            sock.sendto(data, (SPARK_MCAST_ADDR, self.port, 0, if_index))
+            self.packets_sent += 1
+        except OSError:  # interface flapped away; LinkMonitor will tell us
+            pass
+
+    def _on_readable(self, if_name: str, sock: pysocket.socket) -> None:
+        loop = asyncio.get_event_loop()
+        while True:
+            try:
+                data, _addr = sock.recvfrom(65536)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            try:
+                payload = json.loads(data)
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                continue  # not ours; Spark also rate-limits/validates
+            self.packets_received += 1
+            if self._cb is not None:
+                task = asyncio.ensure_future(
+                    self._cb(if_name, payload, loop.time())
+                )
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)

@@ -282,6 +282,13 @@ class Spark(Actor):
                 continue
             up_now.add(if_name)
             if if_name not in self.interfaces:
+                # real-network providers open a socket per tracked interface
+                add_if = getattr(self.io, "add_interface", None)
+                if add_if is not None:
+                    try:
+                        add_if(if_name)
+                    except OSError:
+                        continue  # interface raced away; next update fixes it
                 tracked = _TrackedInterface(
                     if_name=if_name,
                     v6_addr=info.v6_link_local() or "",
@@ -304,6 +311,9 @@ class Spark(Actor):
         tracked = self.interfaces.pop(if_name, None)
         if tracked is None:
             return
+        remove_if = getattr(self.io, "remove_interface", None)
+        if remove_if is not None:
+            remove_if(if_name)
         for t in (tracked.hello_task, tracked.heartbeat_task):
             if t is not None:
                 t.cancel()

@@ -1,0 +1,226 @@
+"""Netlink platform layer tests: native codec round-trip, mock kernel,
+and (permission-gated) real AF_NETLINK dumps.
+
+Reference test parity: openr/nl/tests/NetlinkProtocolSocketTest.cpp and
+openr/tests/mocks/MockNetlinkProtocolSocket.h usage.
+"""
+
+import asyncio
+import socket as pysocket
+
+import pytest
+
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.platform.nl import (
+    LabelAction,
+    MockNetlinkProtocolSocket,
+    NetlinkEventsInjector,
+    NlCodec,
+    NlNexthop,
+    NlRoute,
+)
+from openr_tpu.platform.nl.codec import NlAck, NlAddr, NlLink, RTM_GETLINK
+
+
+@pytest.fixture(scope="module")
+def codec():
+    return NlCodec()
+
+
+def roundtrip_route(codec, route, **kw):
+    data = codec.encode_route(route, **kw)
+    msgs = codec.decode(data)
+    assert len(msgs) == 1
+    decoded, is_del = msgs[0]
+    return decoded, is_del
+
+
+class TestCodecRoundtrip:
+    def test_v4_single_nexthop(self, codec):
+        r = NlRoute(
+            prefix="10.1.0.0/24",
+            nexthops=[NlNexthop(gateway="10.0.0.1", if_index=3)],
+            priority=10,
+        )
+        d, is_del = roundtrip_route(codec, r)
+        assert not is_del
+        assert d.prefix == "10.1.0.0/24"
+        assert d.priority == 10
+        assert d.protocol == 99
+        assert len(d.nexthops) == 1
+        assert d.nexthops[0].gateway == "10.0.0.1"
+        assert d.nexthops[0].if_index == 3
+
+    def test_v6_multipath(self, codec):
+        r = NlRoute(
+            prefix="2001:db8:1::/64",
+            nexthops=[
+                NlNexthop(gateway="fe80::1", if_index=2, weight=1),
+                NlNexthop(gateway="fe80::2", if_index=4, weight=2),
+            ],
+        )
+        d, _ = roundtrip_route(codec, r)
+        assert d.prefix == "2001:db8:1::/64"
+        assert len(d.nexthops) == 2
+        assert {nh.gateway for nh in d.nexthops} == {"fe80::1", "fe80::2"}
+        assert {nh.if_index for nh in d.nexthops} == {2, 4}
+        assert {nh.weight for nh in d.nexthops} == {1, 2}
+
+    def test_v4_mpls_push_encap(self, codec):
+        r = NlRoute(
+            prefix="10.2.0.0/16",
+            nexthops=[
+                NlNexthop(
+                    gateway="10.0.0.2",
+                    if_index=5,
+                    label_action=LabelAction.PUSH,
+                    labels=(100101, 100102),
+                )
+            ],
+        )
+        d, _ = roundtrip_route(codec, r)
+        nh = d.nexthops[0]
+        assert nh.label_action == LabelAction.PUSH
+        assert nh.labels == (100101, 100102)
+        assert nh.gateway == "10.0.0.2"
+
+    def test_mpls_swap_route(self, codec):
+        r = NlRoute(
+            label=100200,
+            nexthops=[
+                NlNexthop(
+                    gateway="fe80::9",
+                    if_index=7,
+                    label_action=LabelAction.SWAP,
+                    labels=(100300,),
+                )
+            ],
+        )
+        d, _ = roundtrip_route(codec, r)
+        assert d.label == 100200
+        assert d.prefix is None
+        nh = d.nexthops[0]
+        assert nh.label_action == LabelAction.SWAP
+        assert nh.labels == (100300,)
+        assert nh.gateway == "fe80::9"
+
+    def test_mpls_php_route(self, codec):
+        # PHP: pop-and-forward, no NEWDST stack
+        r = NlRoute(
+            label=100400,
+            nexthops=[NlNexthop(gateway="fe80::a", if_index=2,
+                                label_action=LabelAction.PHP)],
+        )
+        d, _ = roundtrip_route(codec, r)
+        assert d.label == 100400
+        assert d.nexthops[0].gateway == "fe80::a"
+        assert d.nexthops[0].labels == ()
+
+    def test_delete_flag(self, codec):
+        r = NlRoute(prefix="10.3.0.0/24", nexthops=[NlNexthop(if_index=1)])
+        _, is_del = roundtrip_route(codec, r, is_del=True)
+        assert is_del
+
+    def test_addr_roundtrip(self, codec):
+        data = codec.encode_addr(4, "192.168.1.7/24")
+        msgs = codec.decode(data)
+        assert len(msgs) == 1
+        a = msgs[0]
+        assert isinstance(a, NlAddr)
+        assert a.if_index == 4
+        assert a.prefix == "192.168.1.7/24"
+        assert not a.is_del
+
+    def test_dump_encode(self, codec):
+        data = codec.encode_dump(RTM_GETLINK, seq=42)
+        assert len(data) >= 16
+        # nlmsg header: len, type, flags, seq
+        import struct
+
+        ln, typ, flags, seq = struct.unpack_from("=IHHI", data)
+        assert ln == len(data)
+        assert typ == RTM_GETLINK
+        assert seq == 42
+        assert flags & 0x300  # NLM_F_ROOT|NLM_F_MATCH (DUMP)
+
+    def test_large_ecmp_width(self, codec):
+        r = NlRoute(
+            prefix="10.9.0.0/24",
+            nexthops=[
+                NlNexthop(gateway=f"10.0.{i}.1", if_index=i + 1)
+                for i in range(64)
+            ],
+        )
+        d, _ = roundtrip_route(codec, r)
+        assert len(d.nexthops) == 64
+
+
+class TestMockNetlink:
+    def test_routes_and_failure_injection(self):
+        async def run():
+            nl = MockNetlinkProtocolSocket()
+            r = NlRoute(prefix="10.0.0.0/24", nexthops=[NlNexthop(if_index=1)])
+            await nl.add_route(r)
+            assert len(await nl.get_all_routes()) == 1
+            assert await nl.get_all_routes(protocol=99)
+            assert not await nl.get_all_routes(protocol=3)
+            nl.fail = True
+            with pytest.raises(OSError):
+                await nl.add_route(r)
+            nl.fail = False
+            await nl.delete_route(r)
+            assert not await nl.get_all_routes()
+
+        asyncio.run(run())
+
+    def test_injector_interface_events(self):
+        async def run():
+            q = ReplicateQueue("netlinkEvents")
+            reader = q.get_reader()
+            nl = MockNetlinkProtocolSocket(events_queue=q)
+            inj = NetlinkEventsInjector(nl)
+            inj.set_link(2, "eth0", True)
+            inj.add_address(2, "fe80::1/64")
+            ev1 = await reader.get()
+            ev2 = await reader.get()
+            assert ev1.if_name == "eth0" and ev1.is_up
+            assert ev2.networks == ["fe80::1/64"]
+            # merged view
+            infos = await nl.get_all_interfaces()
+            assert len(infos) == 1
+            assert infos[0].networks == ["fe80::1/64"]
+            inj.set_link(2, "eth0", False)
+            ev3 = await reader.get()
+            assert not ev3.is_up
+
+        asyncio.run(run())
+
+
+def _can_open_netlink() -> bool:
+    try:
+        s = pysocket.socket(pysocket.AF_NETLINK, pysocket.SOCK_RAW, 0)
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+@pytest.mark.skipif(not _can_open_netlink(), reason="no AF_NETLINK access")
+class TestRealNetlink:
+    def test_get_all_links_and_interfaces(self):
+        from openr_tpu.platform.nl import NetlinkProtocolSocket
+
+        async def run():
+            nl = NetlinkProtocolSocket()
+            try:
+                nl.start()
+                links = await nl.get_all_links()
+                # every kernel has at least loopback
+                assert any(l.if_name == "lo" for l in links)
+                infos = await nl.get_all_interfaces()
+                lo = next(i for i in infos if i.if_name == "lo")
+                assert lo.if_index > 0
+            finally:
+                nl.close()
+
+        asyncio.run(run())

@@ -1,0 +1,220 @@
+"""Platform layer tests: NetlinkFibHandler over the mock kernel, the
+FibService TCP server + RemoteFibAgent, and Fib programming end-to-end
+through the platform agent.
+
+Reference test parity: openr/platform (NetlinkFibHandler) +
+openr/fib/tests/FibTest.cpp (Fib against a real local FibService server).
+"""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import FibConfig
+from openr_tpu.decision.rib import (
+    DecisionRouteUpdate,
+    DecisionRouteUpdateType,
+    RibUnicastEntry,
+)
+from openr_tpu.fib.fib import Fib, FibAgentError
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.platform import (
+    CLIENT_ID_OPENR,
+    FibServiceServer,
+    NetlinkFibAgent,
+    NetlinkFibHandler,
+    RemoteFibAgent,
+)
+from openr_tpu.platform.nl import (
+    MockNetlinkProtocolSocket,
+    NetlinkEventsInjector,
+)
+from openr_tpu.types import (
+    MplsAction,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
+    UnicastRoute,
+)
+
+
+def make_handler():
+    nl = MockNetlinkProtocolSocket()
+    inj = NetlinkEventsInjector(nl)
+    inj.set_link(2, "eth0", True)
+    inj.set_link(3, "eth1", True)
+    return NetlinkFibHandler(nl), nl
+
+
+def uroute(dest, *hops):
+    return UnicastRoute(
+        dest=dest,
+        next_hops=[NextHop(address=a, if_name=i) for a, i in hops],
+    )
+
+
+class TestNetlinkFibHandler:
+    def test_unicast_add_delete_programs_kernel(self):
+        async def run():
+            handler, nl = make_handler()
+            await handler.add_unicast_routes(
+                CLIENT_ID_OPENR,
+                [
+                    uroute("10.1.0.0/24", ("fe80::1", "eth0")),
+                    uroute("10.2.0.0/24", ("fe80::1", "eth0"), ("fe80::2", "eth1")),
+                ],
+            )
+            kernel = await handler.get_kernel_routes()
+            assert {r.prefix for r in kernel} == {"10.1.0.0/24", "10.2.0.0/24"}
+            multi = next(r for r in kernel if r.prefix == "10.2.0.0/24")
+            assert {nh.if_index for nh in multi.nexthops} == {2, 3}
+            table = await handler.get_route_table_by_client(CLIENT_ID_OPENR)
+            assert len(table) == 2
+
+            await handler.delete_unicast_routes(CLIENT_ID_OPENR, ["10.1.0.0/24"])
+            assert len(await handler.get_kernel_routes()) == 1
+            # deleting a never-programmed prefix is tolerated
+            await handler.delete_unicast_routes(CLIENT_ID_OPENR, ["10.99.0.0/16"])
+
+        asyncio.run(run())
+
+    def test_unknown_interface_raises(self):
+        async def run():
+            handler, _ = make_handler()
+            with pytest.raises(FibAgentError):
+                await handler.add_unicast_routes(
+                    CLIENT_ID_OPENR, [uroute("10.1.0.0/24", ("fe80::1", "wat0"))]
+                )
+
+        asyncio.run(run())
+
+    def test_mpls_routes(self):
+        async def run():
+            handler, _ = make_handler()
+            route = MplsRoute(
+                top_label=100101,
+                next_hops=[
+                    NextHop(
+                        address="fe80::1",
+                        if_name="eth0",
+                        mpls_action=MplsAction(
+                            action=MplsActionCode.SWAP, swap_label=100201
+                        ),
+                    )
+                ],
+            )
+            await handler.add_mpls_routes(CLIENT_ID_OPENR, [route])
+            kernel = await handler.get_kernel_routes()
+            assert kernel[0].label == 100101
+            await handler.delete_mpls_routes(CLIENT_ID_OPENR, [100101])
+            assert not await handler.get_kernel_routes()
+
+        asyncio.run(run())
+
+    def test_sync_fib_removes_stale(self):
+        async def run():
+            handler, _ = make_handler()
+            await handler.add_unicast_routes(
+                CLIENT_ID_OPENR,
+                [
+                    uroute("10.1.0.0/24", ("fe80::1", "eth0")),
+                    uroute("10.2.0.0/24", ("fe80::1", "eth0")),
+                ],
+            )
+            await handler.sync_fib(
+                CLIENT_ID_OPENR,
+                [
+                    uroute("10.2.0.0/24", ("fe80::2", "eth1")),
+                    uroute("10.3.0.0/24", ("fe80::1", "eth0")),
+                ],
+            )
+            kernel = await handler.get_kernel_routes()
+            assert {r.prefix for r in kernel} == {"10.2.0.0/24", "10.3.0.0/24"}
+
+        asyncio.run(run())
+
+    def test_per_client_tables(self):
+        async def run():
+            handler, _ = make_handler()
+            await handler.add_unicast_routes(
+                1, [uroute("10.1.0.0/24", ("fe80::1", "eth0"))]
+            )
+            await handler.add_unicast_routes(
+                2, [uroute("10.2.0.0/24", ("fe80::1", "eth0"))]
+            )
+            assert len(await handler.get_route_table_by_client(1)) == 1
+            assert len(await handler.get_route_table_by_client(2)) == 1
+            assert not await handler.get_route_table_by_client(3)
+
+        asyncio.run(run())
+
+
+class TestFibServiceServer:
+    def test_remote_agent_end_to_end(self):
+        async def run():
+            handler, nl = make_handler()
+            server = FibServiceServer(handler)
+            await server.start()
+            agent = RemoteFibAgent(port=server.port)
+            try:
+                await agent.add_unicast_routes(
+                    [uroute("10.1.0.0/24", ("fe80::1", "eth0"))]
+                )
+                table = await agent.get_route_table()
+                assert table[0].dest == "10.1.0.0/24"
+                assert table[0].next_hops[0].address == "fe80::1"
+                assert await agent.alive_since() > 0
+                await agent.sync_fib(
+                    [uroute("10.5.0.0/24", ("fe80::2", "eth1"))], []
+                )
+                kernel = await handler.get_kernel_routes()
+                assert {r.prefix for r in kernel} == {"10.5.0.0/24"}
+                # transport error path: agent surface FibAgentError
+                await server.stop()
+                await agent.close()
+                with pytest.raises(FibAgentError):
+                    await agent.add_unicast_routes(
+                        [uroute("10.6.0.0/24", ("fe80::1", "eth0"))]
+                    )
+            finally:
+                await agent.close()
+                await server.stop()
+
+        asyncio.run(run())
+
+
+class TestFibThroughPlatform:
+    def test_fib_programs_via_netlink_agent(self):
+        """DecisionRouteUpdate -> Fib -> NetlinkFibAgent -> mock kernel."""
+
+        async def run():
+            clock = SimClock()
+            handler, nl = make_handler()
+            agent = NetlinkFibAgent(handler)
+            routes_q = ReplicateQueue("routeUpdates")
+            fib = Fib(
+                node_name="node1",
+                clock=clock,
+                config=FibConfig(),
+                agent=agent,
+                route_updates_reader=routes_q.get_reader(),
+            )
+            fib.start()
+            entry = RibUnicastEntry(
+                prefix="10.1.0.0/24",
+                nexthops=[NextHop(address="fe80::1", if_name="eth0")],
+            )
+            routes_q.push(
+                DecisionRouteUpdate(
+                    type=DecisionRouteUpdateType.FULL_SYNC,
+                    unicast_routes_to_update={"10.1.0.0/24": entry},
+                )
+            )
+            await clock.run_for(1.0)
+            kernel = await handler.get_kernel_routes()
+            assert {r.prefix for r in kernel} == {"10.1.0.0/24"}
+            assert fib.synced
+            await fib.stop()
+
+        asyncio.run(run())
