@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from openr_tpu import constants as C
+from openr_tpu.policy.policy import PolicyConfig
 from openr_tpu.types import (
     PrefixForwardingAlgorithm,
     PrefixForwardingType,
@@ -139,6 +140,8 @@ class OriginatedPrefix:
     path_preference: int = C.DEFAULT_PATH_PREFERENCE
     tags: Set[str] = field(default_factory=set)
     min_nexthop: Optional[int] = None
+    #: named policy applied at origination (OpenrConfig.thrift:375)
+    origination_policy: Optional[str] = None
 
 
 @dataclass
@@ -197,6 +200,11 @@ class OpenrConfig:
         default_factory=SegmentRoutingConfig
     )
     tpu_compute_config: TpuComputeConfig = field(default_factory=TpuComputeConfig)
+    #: named routing-policy definitions (area_policies in the reference
+    #: schema, OpenrConfig.thrift:544) referenced by
+    #: AreaConfig.import_policy / OriginatedPrefix.origination_policy;
+    #: plain dict form of openr_tpu.policy.PolicyConfig
+    policy_config: Optional[PolicyConfig] = None
     #: enable best-route redistribution across areas (PrefixManager)
     enable_best_route_selection: bool = True
     #: "" disables persistence; the literal default is node-scoped in
@@ -281,6 +289,13 @@ def _build_dataclass(klass, d):
         ft = hints.get(f.name)
         origin = typing.get_origin(ft)
         args = typing.get_args(ft)
+        # unwrap Optional[X] / Union[X, None] to X
+        if origin is typing.Union and args:
+            non_none = [a for a in args if a is not type(None)]
+            if len(non_none) == 1:
+                ft = non_none[0]
+                origin = typing.get_origin(ft)
+                args = typing.get_args(ft)
         if dataclasses.is_dataclass(ft):
             v = _build_dataclass(ft, v)
         elif isinstance(ft, type) and issubclass(ft, _enum.Enum):

@@ -99,9 +99,9 @@ async def run_emulation(
 
 async def run_real_node(
     config: OpenrConfig,
-    ctrl_port: int,
+    ctrl_port: Optional[int],
     fib_mode: str,
-    ctrl_host: str = "::",
+    ctrl_host: str = "",
 ) -> None:
     """Deployment mode: real UDP multicast wire (UdpIoProvider), real TCP
     KvStore peer sessions (TcpKvStoreTransport), real kernel netlink for
@@ -117,8 +117,17 @@ async def run_real_node(
     from openr_tpu.platform.nl import NetlinkProtocolSocket
     from openr_tpu.spark.io_provider import UdpIoProvider
 
+    # resolve the ctrl port BEFORE building the node: Spark advertises it
+    # in handshakes and remote KvStore transports dial it
+    if ctrl_port is not None:
+        config.openr_ctrl_port = ctrl_port
+    ctrl_port = config.openr_ctrl_port
+
     netlink_events_q = ReplicateQueue("netlinkEvents")
-    nl = NetlinkProtocolSocket(events_queue=netlink_events_q)
+    nl_neighbor_q = ReplicateQueue("nlNeighborEvents")
+    nl = NetlinkProtocolSocket(
+        events_queue=netlink_events_q, neighbor_events_queue=nl_neighbor_q
+    )
     nl.start()
     fib_agent = None
     if fib_mode == "netlink":
@@ -134,17 +143,19 @@ async def run_real_node(
         kv_transport=TcpKvStoreTransport(),
         fib_agent=fib_agent,
         netlink_events_queue=netlink_events_q,
+        nl_neighbor_events_queue=nl_neighbor_q,
     )
     node.start()
     # initial kernel interface sync (LinkMonitor's periodic-sync seed,
     # LinkMonitor.h:204-215); incremental events flow from the nl socket
     node.link_monitor.set_interfaces(await nl.get_all_interfaces())
-    # bind wide ("::" = v4+v6): remote peers' TcpKvStoreTransport dials
-    # this port for KvStore full-sync/flooding, so loopback-only would
-    # break cross-host peering
-    server = OpenrCtrlServer(node, host=ctrl_host, port=ctrl_port)
+    # bind wide (host=None = all interfaces, v4 AND v6 sockets — an
+    # explicit "::" would get IPV6_V6ONLY from asyncio and refuse v4):
+    # remote peers' TcpKvStoreTransport dials this port for KvStore
+    # full-sync/flooding, so loopback-only would break cross-host peering
+    server = OpenrCtrlServer(node, host=ctrl_host or None, port=ctrl_port)
     await server.start()
-    print(f"{config.node_name}: ctrl on [{ctrl_host}]:{server.port} "
+    print(f"{config.node_name}: ctrl on [{ctrl_host or '*'}]:{server.port} "
           f"(fib={fib_mode})")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -166,11 +177,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="run an N-node emulated network in-process")
     p.add_argument("--topology", default="line",
                    choices=["line", "ring", "grid"])
-    p.add_argument("--ctrl-base-port", type=int, default=2018)
+    p.add_argument("--ctrl-base-port", type=int, default=None,
+                   help="ctrl port (base port in --emulate mode); defaults "
+                        "to the config's openr_ctrl_port / 2018")
     p.add_argument("--real", action="store_true",
                    help="with --config: real UDP/TCP/netlink planes")
-    p.add_argument("--ctrl-host", default="::",
-                   help="ctrl server bind address in --real mode")
+    p.add_argument("--ctrl-host", default="",
+                   help="ctrl server bind address in --real mode "
+                        "(default: all interfaces)")
     p.add_argument("--fib", default="dryrun",
                    choices=["dryrun", "netlink", "remote"],
                    help="route programming backend in --real mode")
@@ -178,7 +192,7 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     if args.emulate:
         asyncio.run(
-            run_emulation(args.emulate, args.topology, args.ctrl_base_port)
+            run_emulation(args.emulate, args.topology, args.ctrl_base_port or 2018)
         )
         return
     if args.config:
@@ -202,7 +216,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             net.add_node(config.node_name, config)
             net.start()
             node = net.nodes[config.node_name]
-            server = OpenrCtrlServer(node, port=args.ctrl_base_port)
+            server = OpenrCtrlServer(
+                node, port=args.ctrl_base_port or config.openr_ctrl_port
+            )
             await server.start()
             print(f"{config.node_name}: ctrl on 127.0.0.1:{server.port}")
             stop = asyncio.Event()

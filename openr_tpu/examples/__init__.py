@@ -1,0 +1,1 @@
+"""Example clients of the ctrl API (reference: examples/)."""

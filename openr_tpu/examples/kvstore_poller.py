@@ -1,0 +1,87 @@
+"""KvStorePoller — fan-out LSDB scrape across many nodes.
+
+Reference parity: examples/KvStorePoller.h:15-34 + .cpp: given a list of
+(host, port) ctrl endpoints, concurrently dump every node's prefix
+databases and report which endpoints were unreachable.  Used by
+monitoring jobs that want a network-wide LSDB snapshot without running a
+daemon.
+
+Usage:
+    python -m openr_tpu.examples.kvstore_poller host1:2018 host2:2018 ...
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu import constants as C
+from openr_tpu.ctrl.client import OpenrCtrlClient
+
+
+class KvStorePoller:
+    def __init__(
+        self, endpoints: List[Tuple[str, int]], timeout_s: float = 5.0
+    ) -> None:
+        self.endpoints = endpoints
+        self.timeout_s = timeout_s
+
+    async def get_prefix_dbs(
+        self, area: str = C.DEFAULT_AREA
+    ) -> Tuple[Dict[Tuple[str, int], dict], List[Tuple[str, int]]]:
+        """Returns ({endpoint: {key: value-dict}}, [unreachable endpoints]).
+
+        Mirrors KvStorePoller::getPrefixDbs: one RPC per node, failures
+        collected rather than raised."""
+
+        async def poll(ep: Tuple[str, int]) -> Optional[dict]:
+            host, port = ep
+            try:
+                async with OpenrCtrlClient(host=host, port=port) as client:
+                    return await asyncio.wait_for(
+                        client.call(
+                            "dump_kv_store_area",
+                            prefix=C.PREFIX_DB_MARKER,
+                            area=area,
+                        ),
+                        timeout=self.timeout_s,
+                    )
+            except (OSError, asyncio.TimeoutError, RuntimeError):
+                return None
+
+        results = await asyncio.gather(*(poll(ep) for ep in self.endpoints))
+        dbs: Dict[Tuple[str, int], dict] = {}
+        unreachable: List[Tuple[str, int]] = []
+        for ep, result in zip(self.endpoints, results):
+            if result is None:
+                unreachable.append(ep)
+            else:
+                dbs[ep] = result
+        return dbs, unreachable
+
+
+def _parse_endpoint(s: str) -> Tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+async def _amain(argv: List[str]) -> None:
+    poller = KvStorePoller([_parse_endpoint(a) for a in argv])
+    dbs, unreachable = await poller.get_prefix_dbs()
+    for ep, keys in dbs.items():
+        print(f"{ep[0]}:{ep[1]}: {len(keys)} prefix keys")
+        for key in sorted(keys):
+            print(f"  {key}")
+    for ep in unreachable:
+        print(f"{ep[0]}:{ep[1]}: UNREACHABLE")
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    asyncio.run(_amain(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

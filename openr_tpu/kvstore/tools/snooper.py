@@ -1,0 +1,83 @@
+"""KvStoreSnooper — live-subscribe to a remote node's KvStore stream.
+
+Reference parity: openr/kvstore/tools/KvStoreSnooper.cpp: attach to a
+node's ctrl server, take the full snapshot, then print every delta
+publication as it floods through the store.
+
+Usage:
+    python -m openr_tpu.kvstore.tools.snooper --port 2018 [--prefix adj:]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+from typing import List, Optional
+
+from openr_tpu.ctrl.client import OpenrCtrlClient
+
+
+class KvStoreSnooper:
+    """Programmatic snooper: `snoop()` yields (is_snapshot, key, value-dict)
+    tuples; the CLI main pretty-prints them."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 2018,
+        key_prefixes: Optional[List[str]] = None,
+        area: str = "0",
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.key_prefixes = key_prefixes or []
+        self.area = area
+
+    async def snoop(self):
+        async with OpenrCtrlClient(host=self.host, port=self.port) as client:
+            first = True
+            stream = client.stream(
+                "subscribe_and_get_kv_store",
+                key_prefixes=self.key_prefixes,
+                areas=[self.area],
+            )
+            async for pub in stream:
+                for key, value in (pub.get("key_vals") or {}).items():
+                    yield first, key, value
+                for key in pub.get("expired_keys") or []:
+                    yield first, key, None
+                first = False
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    snooper = KvStoreSnooper(
+        host=args.host,
+        port=args.port,
+        key_prefixes=[args.prefix] if args.prefix else [],
+        area=args.area,
+    )
+    async for is_snapshot, key, value in snooper.snoop():
+        tag = "SNAP" if is_snapshot else "DELTA"
+        if value is None:
+            print(f"[{tag}] {key} EXPIRED")
+        else:
+            print(
+                f"[{tag}] {key} v={value.get('version')} "
+                f"from={value.get('originator_id')} ttl={value.get('ttl')}"
+            )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2018)
+    p.add_argument("--prefix", default="", help="key-prefix filter")
+    p.add_argument("--area", default="0")
+    try:
+        asyncio.run(_amain(p.parse_args()))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -155,6 +155,9 @@ class TcpKvStoreTransport(KvStoreTransport):
         self._clients: Dict[str, object] = {}
         #: strong refs to detached close() tasks (loop refs are weak)
         self._close_tasks: Set[object] = set()
+        #: serializes dials so two concurrent RPCs to an un-cached peer
+        #: can't both connect (the loser's connection would leak)
+        self._connect_lock: Optional[object] = None
 
     # -- peer registry hooks (called by KvStoreDb) --------------------------
 
@@ -192,24 +195,32 @@ class TcpKvStoreTransport(KvStoreTransport):
                 pass
 
     async def _client(self, peer_node: str):
+        import asyncio
+
         from openr_tpu.ctrl.client import OpenrCtrlClient
 
         client = self._clients.get(peer_node)
         if client is not None:
             return client
-        target = self._specs.get(peer_node)
-        if target is None:
-            raise KvStoreTransportError(f"no PeerSpec for {peer_node}")
-        try:
-            client = await OpenrCtrlClient(
-                host=target[0], port=target[1]
-            ).connect()
-        except OSError as e:
-            raise KvStoreTransportError(
-                f"connect to {peer_node} {target} failed: {e}"
-            ) from e
-        self._clients[peer_node] = client
-        return client
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            client = self._clients.get(peer_node)  # raced winner?
+            if client is not None:
+                return client
+            target = self._specs.get(peer_node)
+            if target is None:
+                raise KvStoreTransportError(f"no PeerSpec for {peer_node}")
+            try:
+                client = await OpenrCtrlClient(
+                    host=target[0], port=target[1]
+                ).connect()
+            except OSError as e:
+                raise KvStoreTransportError(
+                    f"connect to {peer_node} {target} failed: {e}"
+                ) from e
+            self._clients[peer_node] = client
+            return client
 
     async def _call(self, peer_node: str, method: str, **params):
         client = await self._client(peer_node)

@@ -42,6 +42,9 @@ from openr_tpu.kvstore.transport import KvStoreTransport
 from openr_tpu.link_monitor.link_monitor import LinkMonitor
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.monitor.monitor import Monitor
+from openr_tpu.neighbor_monitor import NeighborMonitor
+from openr_tpu.plugin import PluginArgs, PluginManager
+from openr_tpu.policy import PolicyManager
 from openr_tpu.prefix_manager.prefix_manager import PrefixManager
 from openr_tpu.spark.io_provider import IoProvider
 from openr_tpu.spark.spark import Spark
@@ -126,6 +129,7 @@ class OpenrNode:
         fib_agent: Optional[FibAgent] = None,
         use_tpu_backend: Optional[bool] = None,
         netlink_events_queue: Optional[ReplicateQueue] = None,
+        nl_neighbor_events_queue: Optional[ReplicateQueue] = None,
     ) -> None:
         self.config = config
         self.clock = clock
@@ -198,6 +202,7 @@ class OpenrNode:
         config.spark_config.enable_flood_optimization = (
             config.kvstore_config.enable_flood_optimization
         )
+        self.addr_events_q = ReplicateQueue("addrEvents")
         self.spark = Spark(
             node_name=self.name,
             clock=clock,
@@ -208,7 +213,30 @@ class OpenrNode:
             area_lookup=make_area_lookup(config),
             initialization_cb=on_init,
             counters=self.counters,
+            addr_events_reader=self.addr_events_q.get_reader(),
+            ctrl_port=config.openr_ctrl_port,
         )
+        self.neighbor_monitor = NeighborMonitor(
+            clock=clock,
+            addr_events_queue=self.addr_events_q,
+            nl_neighbor_reader=(
+                nl_neighbor_events_queue.get_reader()
+                if nl_neighbor_events_queue is not None
+                else None
+            ),
+            counters=self.counters,
+        )
+        #: extension boundary (openr/plugin): register/load before start()
+        self.plugin_manager = PluginManager()
+        self._plugin_args = PluginArgs(
+            node_name=self.name,
+            config=config,
+            prefix_updates_queue=self.prefix_updates_q,
+            route_updates_reader=self.route_updates_q.get_reader(),
+            counters=self.counters,
+            clock=clock,
+        )
+        self.policy_manager = PolicyManager(config.policy_config)
         self.prefix_manager = PrefixManager(
             node_name=self.name,
             clock=clock,
@@ -220,6 +248,12 @@ class OpenrNode:
             originated_prefixes=config.originated_prefixes,
             initialization_cb=on_init,
             counters=self.counters,
+            policy_manager=self.policy_manager,
+            area_import_policies={
+                a.area_id: a.import_policy
+                for a in config.areas
+                if a.import_policy
+            },
         )
         solver = SpfSolver(
             self.name,
@@ -308,6 +342,7 @@ class OpenrNode:
             self.kv_store,
             self.dispatcher,
             self.prefix_manager,
+            self.neighbor_monitor,
             self.spark,
             self.link_monitor,
             self.decision,
@@ -328,6 +363,7 @@ class OpenrNode:
             self.peer_updates_q,
             self.kv_request_q,
             self.log_sample_q,
+            self.addr_events_q,
         ]
         if self.watchdog is not None:
             for q in self._queues:
@@ -341,10 +377,17 @@ class OpenrNode:
         self._started = True
         for module in self._all_modules:
             module.start()
+        if self.plugin_manager.has_plugins():
+            self.spark.spawn(
+                self.plugin_manager.start_all(self._plugin_args),
+                name="plugins.start",
+            )
         self.init_tracker.on_event(InitializationEvent.AGENT_CONFIGURED)
 
     async def stop(self) -> None:
-        # close queues first, then stop modules in reverse (Main.cpp:498)
+        # plugins first (they feed prefixUpdatesQueue), then close queues,
+        # then stop modules in reverse (Main.cpp:498)
+        await self.plugin_manager.stop_all()
         for q in self._queues:
             q.close()
         for module in reversed(self._all_modules):

@@ -1,0 +1,3 @@
+from openr_tpu.neighbor_monitor.neighbor_monitor import (  # noqa: F401
+    NeighborMonitor,
+)

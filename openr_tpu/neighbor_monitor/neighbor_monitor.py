@@ -1,0 +1,57 @@
+"""NeighborMonitor — transport-address liveness watcher.
+
+Reference parity: openr/neighbor-monitor/NeighborMonitor.h — an actor
+that pushes `AddressEvent`s onto addrEventsQueue → Spark
+(Main.cpp:220-221), used for fast neighbor teardown when an address
+becomes unreachable (e.g. LAG going down) without waiting out Spark's
+heartbeat hold timer.  The OSS reference ships a stub impl; here the
+monitor is driven by kernel neighbor-table (RTM_NEWNEIGH/DELNEIGH)
+events when a netlink socket is supplied, and is directly injectable in
+tests/emulation via `report_address`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+from openr_tpu.types import AddressEvent
+
+# kernel neighbor-cache states that mean "gone" (linux/neighbour.h)
+NUD_FAILED = 0x20
+NUD_INCOMPLETE = 0x01
+
+
+class NeighborMonitor(Actor):
+    def __init__(
+        self,
+        clock: Clock,
+        addr_events_queue: ReplicateQueue,
+        nl_neighbor_reader: Optional[RQueue] = None,
+        counters: Optional[CounterMap] = None,
+    ) -> None:
+        super().__init__("neighbor_monitor", clock, counters)
+        self.addr_events_queue = addr_events_queue
+        self.nl_neighbor_reader = nl_neighbor_reader
+
+    def start(self) -> None:
+        if self.nl_neighbor_reader is not None:
+            self.spawn_queue_loop(
+                self.nl_neighbor_reader, self._on_nl_neighbor, "nbrmon.nl"
+            )
+
+    def _on_nl_neighbor(self, ev) -> None:
+        """Translate a kernel neighbor event (platform.nl NlNeighbor) into
+        an AddressEvent for Spark."""
+        unreachable = bool(ev.is_del) or bool(
+            ev.state & (NUD_FAILED | NUD_INCOMPLETE)
+        )
+        self.report_address(ev.address, is_reachable=not unreachable)
+
+    def report_address(self, address: str, is_reachable: bool) -> None:
+        """Direct injection point (tests / platform integrations)."""
+        self.counters.bump("neighbor_monitor.events")
+        self.addr_events_queue.push(
+            AddressEvent(address=address, is_reachable=is_reachable)
+        )

@@ -112,9 +112,13 @@ class NetlinkProtocolSocket(BaseNetlinkProtocolSocket):
         self,
         events_queue: Optional[ReplicateQueue] = None,
         route_protocol: int = 99,
+        neighbor_events_queue: Optional[ReplicateQueue] = None,
     ) -> None:
         self.codec = get_codec()
         self.events_queue = events_queue
+        #: raw kernel neighbor-table events (NlNeighbor) — NeighborMonitor
+        #: consumes these for address-unreachable fast teardown
+        self.neighbor_events_queue = neighbor_events_queue
         self.route_protocol = route_protocol
         self._seq = 0
         self._pending: Dict[int, asyncio.Future] = {}
@@ -209,9 +213,8 @@ class NetlinkProtocolSocket(BaseNetlinkProtocolSocket):
                 if fut and not fut.done():
                     fut.set_result(self._dump_acc.get(msg.seq, []))
             else:
-                seq = getattr(msg, "seq", None)
-                # dump replies carry the request seq in each part; the codec
-                # exposes seq only on ack/done, so append to the only open dump
+                # requests are serialized under _req_lock, so at most one
+                # dump accumulator is open — parts belong to it
                 for acc in self._dump_acc.values():
                     acc.append(msg)
                     break
@@ -245,6 +248,9 @@ class NetlinkProtocolSocket(BaseNetlinkProtocolSocket):
                 if msg.if_name:
                     info.if_name = msg.if_name
             self._publish_iface(info)
+        elif isinstance(msg, NlNeighbor):
+            if self.neighbor_events_queue is not None:
+                self.neighbor_events_queue.push(msg)
         elif isinstance(msg, NlAddr):
             info = self._ifaces.get(msg.if_index)
             if info is None:

@@ -1,0 +1,5 @@
+from openr_tpu.plugin.plugin import (  # noqa: F401
+    Plugin,
+    PluginArgs,
+    PluginManager,
+)

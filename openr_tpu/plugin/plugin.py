@@ -1,0 +1,80 @@
+"""Plugin boundary — external route-origination extensions.
+
+Reference parity: openr/plugin/Plugin.{h,cpp}: `pluginStart(PluginArgs)` /
+`vipPluginStart(VipPluginArgs)` hooks, no-ops in OSS, where PluginArgs
+hands the extension the prefixUpdatesQueue (to advertise/withdraw
+prefixes into PrefixManager) and a route-updates reader (to observe the
+computed RIB).  This is the seam BASELINE.json names for out-of-tree
+integrations.
+
+Here a plugin is any object with `async start(args)` / `async stop()`;
+the PluginManager instantiates them from dotted-path names in config
+(`plugin_modules`) or from directly registered factories, and owns their
+lifecycle alongside the daemon's.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+
+
+@dataclass
+class PluginArgs:
+    """What a plugin gets to touch (Plugin.h:20-27)."""
+
+    node_name: str
+    config: Any
+    #: push PrefixEvents here to advertise/withdraw (PrefixManager input)
+    prefix_updates_queue: ReplicateQueue
+    #: observe computed route updates (Decision output)
+    route_updates_reader: Optional[RQueue] = None
+    counters: Any = None
+    clock: Any = None
+
+
+class Plugin:
+    """Base plugin: override start/stop."""
+
+    async def start(self, args: PluginArgs) -> None:  # pragma: no cover
+        pass
+
+    async def stop(self) -> None:  # pragma: no cover
+        pass
+
+
+class PluginManager:
+    """Loads + runs plugins (pluginStart/pluginStop lifecycle)."""
+
+    def __init__(self) -> None:
+        self._factories: List[Callable[[], Plugin]] = []
+        self._active: List[Plugin] = []
+
+    def register(self, factory: Callable[[], Plugin]) -> None:
+        self._factories.append(factory)
+
+    def has_plugins(self) -> bool:
+        return bool(self._factories)
+
+    def load(self, dotted_path: str) -> None:
+        """Load `pkg.module:FactoryOrClass` (or `pkg.module.Factory`)."""
+        if ":" in dotted_path:
+            mod_name, attr = dotted_path.split(":", 1)
+        else:
+            mod_name, _, attr = dotted_path.rpartition(".")
+        module = importlib.import_module(mod_name)
+        self.register(getattr(module, attr))
+
+    async def start_all(self, args: PluginArgs) -> None:
+        for factory in self._factories:
+            plugin = factory()
+            await plugin.start(args)
+            self._active.append(plugin)
+
+    async def stop_all(self) -> None:
+        for plugin in reversed(self._active):
+            await plugin.stop()
+        self._active.clear()

@@ -1,0 +1,17 @@
+"""openr_tpu.policy — routing-policy engine.
+
+Reference parity: openr/policy/PolicyManager.{h,cpp} + the
+configerator routing_policy.thrift schema: named policies made of filter
+statements (match criteria -> action), applied by PrefixManager at prefix
+origination and at area import during redistribution.
+"""
+
+from openr_tpu.policy.policy import (  # noqa: F401
+    FilterAction,
+    FilterCriteria,
+    PolicyConfig,
+    PolicyDefinition,
+    PolicyManager,
+    PolicyStatement,
+    PrefixMatch,
+)

@@ -1,0 +1,212 @@
+"""Routing-policy engine.
+
+Reference parity: openr/policy/PolicyManager.{h,cpp} — `applyPolicy(name,
+prefixEntry, actionData, matchData) -> (entry | None, hit statement)` —
+over the configerator routing_policy.thrift model: a policy is an ordered
+list of filter statements; each statement has match criteria (prefix
+ranges, tags, area stack, IGP cost range) and an action (accept/reject +
+attribute rewrites).  First matching statement wins; no match => reject
+(the schema's implicit deny).
+
+The engine is pure and allocation-light: PrefixManager calls it per
+advertised/redistributed prefix entry (PrefixManager.cpp:953,1135).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from openr_tpu.types import (
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+
+
+@dataclass
+class PrefixMatch:
+    """One prefix-range criterion: `prefix` with optional ge/le masks —
+    the classic route-map prefix-list semantics the reference's
+    FilterCriteria prefix matching implements."""
+
+    prefix: str
+    #: minimum prefix length the candidate must have (None = exact only)
+    ge: Optional[int] = None
+    #: maximum prefix length (defaults to ge, or exact)
+    le: Optional[int] = None
+
+    def matches(self, candidate: str) -> bool:
+        try:
+            net = ipaddress.ip_network(self.prefix, strict=False)
+            cand = ipaddress.ip_network(candidate, strict=False)
+        except ValueError:
+            return False
+        if net.version != cand.version:
+            return False
+        lo = self.ge if self.ge is not None else net.prefixlen
+        hi = self.le if self.le is not None else (
+            self.ge if self.ge is not None else net.prefixlen
+        )
+        if not (lo <= cand.prefixlen <= hi):
+            return False
+        return cand.subnet_of(net) if cand.prefixlen >= net.prefixlen else False
+
+
+@dataclass
+class FilterCriteria:
+    """Match side of a statement (routing_policy.thrift FilterCriteria).
+    All configured dimensions must match (AND); an empty dimension is a
+    wildcard; `always_match` short-circuits."""
+
+    always_match: bool = False
+    prefixes: List[PrefixMatch] = field(default_factory=list)
+    #: entry must carry at least one of these tags
+    tags: List[str] = field(default_factory=list)
+    #: entry's area_stack must contain one of these areas (loop filters)
+    area_stack: List[str] = field(default_factory=list)
+    #: prefix types (PrefixType enum names, e.g. "BGP", "LOOPBACK")
+    prefix_types: List[str] = field(default_factory=list)
+    #: IGP cost window [min, max] against match-data igp_cost
+    igp_cost_min: Optional[int] = None
+    igp_cost_max: Optional[int] = None
+
+    def matches(self, entry: PrefixEntry, igp_cost: int = 0) -> bool:
+        if self.always_match:
+            return True
+        if self.prefixes and not any(
+            p.matches(entry.prefix) for p in self.prefixes
+        ):
+            return False
+        if self.tags and not (set(self.tags) & set(entry.tags)):
+            return False
+        if self.area_stack and not (
+            set(self.area_stack) & set(entry.area_stack)
+        ):
+            return False
+        if self.prefix_types and entry.type.name not in self.prefix_types:
+            return False
+        if self.igp_cost_min is not None and igp_cost < self.igp_cost_min:
+            return False
+        if self.igp_cost_max is not None and igp_cost > self.igp_cost_max:
+            return False
+        return True
+
+
+@dataclass
+class FilterAction:
+    """Action side of a statement: accept/reject + attribute rewrites
+    (the Openr* action objects of routing_policy.thrift)."""
+
+    accept: bool = True
+    set_path_preference: Optional[int] = None
+    set_source_preference: Optional[int] = None
+    set_distance: Optional[int] = None
+    add_tags: List[str] = field(default_factory=list)
+    remove_tags: List[str] = field(default_factory=list)
+    set_forwarding_type: Optional[str] = None  # "IP" | "SR_MPLS"
+    set_forwarding_algorithm: Optional[str] = None  # "SP_ECMP" | "KSP2_ED_ECMP"
+    #: BGP link-bandwidth-style weight (OpenrPolicyActionData.weight)
+    set_weight: Optional[int] = None
+
+    def apply(
+        self, entry: PrefixEntry, weight_override: Optional[int] = None
+    ) -> Optional[PrefixEntry]:
+        if not self.accept:
+            return None
+        metric_updates = {}
+        if self.set_path_preference is not None:
+            metric_updates["path_preference"] = self.set_path_preference
+        if self.set_source_preference is not None:
+            metric_updates["source_preference"] = self.set_source_preference
+        if self.set_distance is not None:
+            metric_updates["distance"] = self.set_distance
+        out = dataclasses.replace(
+            entry,
+            metrics=dataclasses.replace(entry.metrics, **metric_updates),
+            tags=set(entry.tags),
+            area_stack=list(entry.area_stack),
+        )
+        for t in self.add_tags:
+            out.tags.add(t)
+        for t in self.remove_tags:
+            out.tags.discard(t)
+        if self.set_forwarding_type is not None:
+            out.forwarding_type = PrefixForwardingType[self.set_forwarding_type]
+        if self.set_forwarding_algorithm is not None:
+            out.forwarding_algorithm = PrefixForwardingAlgorithm[
+                self.set_forwarding_algorithm
+            ]
+        weight = (
+            weight_override if weight_override is not None else self.set_weight
+        )
+        if weight is not None:
+            out.weight = weight
+        return out
+
+
+@dataclass
+class PolicyStatement:
+    name: str = ""
+    #: any criterion matching fires the statement (OR across criteria)
+    criteria: List[FilterCriteria] = field(default_factory=list)
+    action: FilterAction = field(default_factory=FilterAction)
+
+    def matches(self, entry: PrefixEntry, igp_cost: int = 0) -> bool:
+        return any(c.matches(entry, igp_cost) for c in self.criteria)
+
+
+@dataclass
+class PolicyDefinition:
+    name: str = ""
+    statements: List[PolicyStatement] = field(default_factory=list)
+
+
+@dataclass
+class PolicyConfig:
+    """Top-level config block (OpenrConfig.thrift `area_policies`
+    neighborhood): named policy definitions referenced by
+    AreaConfig.import_policy and OriginatedPrefix origination policies."""
+
+    definitions: List[PolicyDefinition] = field(default_factory=list)
+
+
+class PolicyManager:
+    """Holds all named policies; pure application function.
+
+    applyPolicy semantics (PolicyManager.h:28-36): returns the possibly
+    rewritten entry (None = rejected) plus the name of the statement that
+    matched ("" if the policy is unknown — unknown policy accepts
+    unchanged, matching the OSS shim's permissive default)."""
+
+    def __init__(self, config: Optional[PolicyConfig] = None) -> None:
+        self._policies: Dict[str, PolicyDefinition] = {}
+        if config is not None:
+            for definition in config.definitions:
+                self._policies[definition.name] = definition
+
+    def add_policy(self, definition: PolicyDefinition) -> None:
+        self._policies[definition.name] = definition
+
+    def has_policy(self, name: str) -> bool:
+        return name in self._policies
+
+    def policy_names(self) -> List[str]:
+        return sorted(self._policies)
+
+    def apply_policy(
+        self,
+        policy_name: str,
+        entry: PrefixEntry,
+        igp_cost: int = 0,
+        weight: Optional[int] = None,
+    ) -> Tuple[Optional[PrefixEntry], str]:
+        policy = self._policies.get(policy_name)
+        if policy is None:
+            return entry, ""
+        for stmt in policy.statements:
+            if stmt.matches(entry, igp_cost):
+                return stmt.action.apply(entry, weight_override=weight), stmt.name
+        return None, ""  # implicit deny

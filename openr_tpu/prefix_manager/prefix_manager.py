@@ -65,6 +65,8 @@ class PrefixManager(Actor):
         originated_prefixes: Optional[List[OriginatedPrefix]] = None,
         initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
         counters: Optional[CounterMap] = None,
+        policy_manager=None,
+        area_import_policies: Optional[Dict[str, str]] = None,
     ) -> None:
         super().__init__("prefix_manager", clock, counters)
         self.node_name = node_name
@@ -75,6 +77,11 @@ class PrefixManager(Actor):
         self.areas = areas or [C.DEFAULT_AREA]
         self.originated = {p.prefix: p for p in (originated_prefixes or [])}
         self.initialization_cb = initialization_cb
+        #: routing-policy engine (openr/policy/PolicyManager): applied at
+        #: origination (per-OriginatedPrefix policy) and at area import
+        #: during redistribution (AreaConfig.import_policy)
+        self.policy_manager = policy_manager
+        self.area_import_policies = area_import_policies or {}
         #: type -> prefix -> (entry, dst_areas)
         self.advertised: Dict[
             PrefixType, Dict[str, Tuple[PrefixEntry, Set[str]]]
@@ -84,8 +91,10 @@ class PrefixManager(Actor):
             p: set() for p in self.originated
         }
         self._originated_advertised: Set[str] = set()
-        #: redistribution state: prefix -> (entry, src_area, dst_areas)
-        self._redistributed: Dict[str, Tuple[PrefixEntry, str, Set[str]]] = {}
+        #: redistribution state: prefix -> (src_area, {dst_area: entry})
+        #: (per-area entries: each destination's import policy may rewrite
+        #: or reject the entry differently)
+        self._redistributed: Dict[str, Tuple[str, Dict[str, PrefixEntry]]] = {}
         #: (area, key) currently present in kvstore
         self._advertised_keys: Set[Tuple[str, str]] = set()
         self._synced_signaled = False
@@ -105,6 +114,11 @@ class PrefixManager(Actor):
         self.schedule(0.0, self._initial_sync)
 
     def _initial_sync(self) -> None:
+        # aggregates whose support threshold is already met (notably
+        # minimum_supporting_routes=0) advertise immediately — they must
+        # not wait for a FIB update to touch them
+        for agg, op in self.originated.items():
+            self._refresh_originated(agg, op)
         self._sync_kv_store()
         if not self._synced_signaled:
             self._synced_signaled = True
@@ -195,21 +209,27 @@ class PrefixManager(Actor):
         out = {}
         for agg in self._originated_advertised:
             op = self.originated[agg]
-            out[agg] = (
-                PrefixEntry(
-                    prefix=agg,
-                    type=PrefixType.CONFIG,
-                    forwarding_type=op.forwarding_type,
-                    forwarding_algorithm=op.forwarding_algorithm,
-                    metrics=PrefixMetrics(
-                        path_preference=op.path_preference,
-                        source_preference=op.source_preference,
-                    ),
-                    tags=set(op.tags),
-                    min_nexthop=op.min_nexthop,
+            entry = PrefixEntry(
+                prefix=agg,
+                type=PrefixType.CONFIG,
+                forwarding_type=op.forwarding_type,
+                forwarding_algorithm=op.forwarding_algorithm,
+                metrics=PrefixMetrics(
+                    path_preference=op.path_preference,
+                    source_preference=op.source_preference,
                 ),
-                set(self.areas),
+                tags=set(op.tags),
+                min_nexthop=op.min_nexthop,
             )
+            # origination policy (PrefixManager.cpp:480: applyPolicy at
+            # origination; rejected => not advertised at all)
+            policy = getattr(op, "origination_policy", None)
+            if policy and self.policy_manager is not None:
+                entry, _hit = self.policy_manager.apply_policy(policy, entry)
+                if entry is None:
+                    self.counters.bump("prefix_manager.policy.origination_rejects")
+                    continue
+            out[agg] = (entry, set(self.areas))
         return out
 
     # -- area redistribution (redistributePrefixesAcrossAreas) -------------
@@ -241,10 +261,27 @@ class PrefixManager(Actor):
             # accumulate the igp cost to reach the originator
             distance=best.metrics.distance + int(entry.igp_cost),
         )
+        # per-destination import policy (AreaConfig.import_policy,
+        # OpenrConfig.thrift:456-458; applyPolicy at area import,
+        # PrefixManager.cpp:1135): rejected areas are simply not targeted
+        per_area: Dict[str, PrefixEntry] = {}
+        for area in dst:
+            area_entry = re_entry
+            policy = self.area_import_policies.get(area)
+            if policy and self.policy_manager is not None:
+                area_entry, _hit = self.policy_manager.apply_policy(
+                    policy, re_entry, igp_cost=int(entry.igp_cost)
+                )
+                if area_entry is None:
+                    self.counters.bump("prefix_manager.policy.import_rejects")
+                    continue
+            per_area[area] = area_entry
+        if not per_area:
+            return self._withdraw_redistribution(prefix)
         prior = self._redistributed.get(prefix)
-        if prior is not None and prior[0] == re_entry and prior[2] == dst:
+        if prior is not None and prior == (src_area, per_area):
             return False
-        self._redistributed[prefix] = (re_entry, src_area, dst)
+        self._redistributed[prefix] = (src_area, per_area)
         self.counters.bump("prefix_manager.redistributed")
         return True
 
@@ -273,8 +310,8 @@ class PrefixManager(Actor):
             for area in dst_areas:
                 desired[(area, prefix_key(self.node_name, prefix))] = entry
         # cross-area redistribution
-        for prefix, (entry, _src, dst_areas) in self._redistributed.items():
-            for area in dst_areas:
+        for prefix, (_src, per_area) in self._redistributed.items():
+            for area, entry in per_area.items():
                 desired[(area, prefix_key(self.node_name, prefix))] = entry
 
         for (area, key), entry in desired.items():

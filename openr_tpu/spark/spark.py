@@ -220,6 +220,8 @@ class Spark(Actor):
         initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
         counters: Optional[CounterMap] = None,
         adj_hold_until_initialized: bool = False,
+        addr_events_reader: Optional[RQueue] = None,
+        ctrl_port: Optional[int] = None,
     ) -> None:
         super().__init__("spark", clock, counters)
         self.node_name = node_name
@@ -227,9 +229,16 @@ class Spark(Actor):
         self.io = io
         self.neighbor_updates_queue = neighbor_updates_queue
         self.interface_updates_reader = interface_updates_reader
+        #: NeighborMonitor -> Spark (addrEventsQueue, Main.cpp:220-221):
+        #: ADDRESS_UNREACHABLE fast-fails matching neighbors without
+        #: waiting out the heartbeat hold timer
+        self.addr_events_reader = addr_events_reader
         #: (neighbor_name, if_name) -> area; default places everyone in "0"
         self.area_lookup = area_lookup or (lambda _n, _i: C.DEFAULT_AREA)
         self.initialization_cb = initialization_cb
+        #: the ctrl port we advertise in handshakes — neighbors' KvStore
+        #: transports dial it, so it must be the actually-bound port
+        self.ctrl_port = ctrl_port if ctrl_port else C.OPENR_CTRL_PORT
         self.my_seq_num = 0
         self.interfaces: Dict[str, _TrackedInterface] = {}
         #: if_name -> {neighbor_name -> SparkNeighbor}
@@ -249,6 +258,12 @@ class Spark(Actor):
                 self.interface_updates_reader,
                 self._on_interface_db,
                 "spark.interfaces",
+            )
+        if self.addr_events_reader is not None:
+            self.spawn_queue_loop(
+                self.addr_events_reader,
+                self._on_address_event,
+                "spark.addr_events",
             )
         # min window: signal early if discovery already completed; max
         # window: signal unconditionally (Spark.h:558-570 bounded discovery)
@@ -397,6 +412,7 @@ class Spark(Actor):
             ),
             transport_address_v6=tracked.v6_addr,
             transport_address_v4=tracked.v4_addr,
+            openr_ctrl_port=self.ctrl_port,
             area=neighbor.area,
             neighbor_node_name=neighbor.node_name,
             enable_flood_optimization=self.config.enable_flood_optimization,
@@ -483,6 +499,23 @@ class Spark(Actor):
         if neighbor.reported_up:
             neighbor.reported_up = False
             self._notify(NeighborEventType.NEIGHBOR_DOWN, neighbor)
+
+    def _on_address_event(self, ev) -> None:
+        """NeighborMonitor fast-failure: an unreachable transport address
+        (e.g. LAG down) tears matching neighbors down immediately instead
+        of waiting for the heartbeat hold timer."""
+        if ev.is_reachable:
+            return
+        addr = ev.address
+        for by_name in list(self.neighbors.values()):
+            for neighbor in list(by_name.values()):
+                if addr in (
+                    neighbor.transport_address_v6,
+                    neighbor.transport_address_v4,
+                ):
+                    self.counters.bump("spark.addr_event_neighbor_down")
+                    self._neighbor_down(neighbor)
+                    self._erase_neighbor(neighbor)
 
     def _arm_heartbeat_hold(self, neighbor: SparkNeighbor) -> None:
         if neighbor.heartbeat_hold_task is not None:

@@ -1,0 +1,119 @@
+"""Examples + tools tests: KvStorePoller fan-out scrape, SetRibPolicy
+example, KvStoreSnooper live stream.
+
+Reference parity: examples/KvStorePoller.h, examples/SetRibPolicyExample.cpp,
+openr/kvstore/tools/KvStoreSnooper.cpp.
+"""
+
+import asyncio
+
+from openr_tpu.common.runtime import WallClock
+from openr_tpu.ctrl.client import OpenrCtrlClient
+from openr_tpu.ctrl.server import OpenrCtrlServer
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import line_edges
+from openr_tpu.examples.kvstore_poller import KvStorePoller
+from openr_tpu.examples.set_rib_policy import build_policy
+from openr_tpu.kvstore.tools.snooper import KvStoreSnooper
+
+
+async def wall_net(n=2, converge_s=8.0):
+    net = EmulatedNetwork(WallClock())
+    net.build(line_edges(n))
+    net.start()
+    deadline = asyncio.get_running_loop().time() + converge_s
+    while asyncio.get_running_loop().time() < deadline:
+        await asyncio.sleep(0.25)
+        ok, _why = net.converged_full_mesh()
+        if ok:
+            return net
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    return net
+
+
+def test_kvstore_poller_fanout_and_unreachable():
+    async def run():
+        net = await wall_net(2)
+        servers = []
+        try:
+            for name in sorted(net.nodes):
+                s = OpenrCtrlServer(net.nodes[name], port=0)
+                await s.start()
+                servers.append(s)
+            endpoints = [("127.0.0.1", s.port) for s in servers]
+            # one dead endpoint on a port nobody listens on
+            endpoints.append(("127.0.0.1", 1))
+            poller = KvStorePoller(endpoints, timeout_s=5.0)
+            dbs, unreachable = await poller.get_prefix_dbs()
+            assert unreachable == [("127.0.0.1", 1)]
+            assert len(dbs) == 2
+            # every reachable node serves the full prefix LSDB
+            for keys in dbs.values():
+                assert any(k.startswith("prefix:node0") for k in keys)
+                assert any(k.startswith("prefix:node1") for k in keys)
+        finally:
+            for s in servers:
+                await s.stop()
+            await net.stop()
+
+    asyncio.run(run())
+
+
+def test_set_rib_policy_example_shape():
+    async def run():
+        net = await wall_net(2)
+        server = OpenrCtrlServer(net.nodes["node0"], port=0)
+        await server.start()
+        try:
+            policy = build_policy(
+                prefixes=["10.0.0.0/8"],
+                area_weights={"0": 7},
+                neighbor_weights={},
+                ttl_s=60.0,
+            )
+            async with OpenrCtrlClient(port=server.port) as client:
+                await client.call("set_rib_policy", policy=policy)
+                echoed = await client.call("get_rib_policy")
+            assert echoed is not None
+            assert echoed["statements"][0]["prefixes"] == ["10.0.0.0/8"]
+            assert 0 < echoed["ttl_remaining_s"] <= 60.0
+        finally:
+            await server.stop()
+            await net.stop()
+
+    asyncio.run(run())
+
+
+def test_kvstore_snooper_snapshot_then_delta():
+    async def run():
+        net = await wall_net(2)
+        server = OpenrCtrlServer(net.nodes["node1"], port=0)
+        await server.start()
+        try:
+            snooper = KvStoreSnooper(port=server.port, key_prefixes=["adj:"])
+            seen_snapshot_keys = set()
+            got_delta = asyncio.Event()
+
+            async def consume():
+                async for is_snap, key, _value in snooper.snoop():
+                    if is_snap:
+                        seen_snapshot_keys.add(key)
+                    elif key.startswith("adj:"):
+                        got_delta.set()
+                        return
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(1.0)
+            assert any(
+                k.startswith("adj:node0") for k in seen_snapshot_keys
+            ), seen_snapshot_keys
+            # force an adjacency re-advertisement -> delta publication
+            net.nodes["node0"].link_monitor.set_link_metric("if_0_1", 77)
+            await asyncio.wait_for(got_delta.wait(), timeout=10.0)
+            task.cancel()
+        finally:
+            await server.stop()
+            await net.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,337 @@
+"""PolicyManager, Plugin boundary, and NeighborMonitor tests.
+
+Reference parity: openr/policy/PolicyManager (apply at origination +
+area import), openr/plugin/Plugin.h hooks, openr/neighbor-monitor
+AddressEvent -> Spark fast neighbor teardown.
+"""
+
+import asyncio
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.neighbor_monitor import NeighborMonitor
+from openr_tpu.plugin import Plugin, PluginArgs, PluginManager
+from openr_tpu.policy import (
+    FilterAction,
+    FilterCriteria,
+    PolicyConfig,
+    PolicyDefinition,
+    PolicyManager,
+    PolicyStatement,
+    PrefixMatch,
+)
+from openr_tpu.types import PrefixEntry, PrefixEventType
+
+
+def make_policy_manager():
+    return PolicyManager(
+        PolicyConfig(
+            definitions=[
+                PolicyDefinition(
+                    name="import-from-spine",
+                    statements=[
+                        PolicyStatement(
+                            name="reject-private",
+                            criteria=[
+                                FilterCriteria(
+                                    prefixes=[
+                                        PrefixMatch(
+                                            prefix="10.0.0.0/8", ge=8, le=32
+                                        )
+                                    ]
+                                )
+                            ],
+                            action=FilterAction(accept=False),
+                        ),
+                        PolicyStatement(
+                            name="tag-and-prefer",
+                            criteria=[FilterCriteria(always_match=True)],
+                            action=FilterAction(
+                                accept=True,
+                                set_path_preference=700,
+                                add_tags=["FROM_SPINE"],
+                            ),
+                        ),
+                    ],
+                )
+            ]
+        )
+    )
+
+
+class TestPolicyManager:
+    def test_first_match_wins_and_reject(self):
+        pm = make_policy_manager()
+        rejected, hit = pm.apply_policy(
+            "import-from-spine", PrefixEntry(prefix="10.1.0.0/24")
+        )
+        assert rejected is None
+        assert hit == "reject-private"
+
+    def test_action_rewrites_without_mutating_input(self):
+        pm = make_policy_manager()
+        entry = PrefixEntry(prefix="2001:db8::/64", tags={"ORIG"})
+        out, hit = pm.apply_policy("import-from-spine", entry)
+        assert hit == "tag-and-prefer"
+        assert out.metrics.path_preference == 700
+        assert out.tags == {"ORIG", "FROM_SPINE"}
+        # input untouched (entries are shared across areas)
+        assert entry.metrics.path_preference != 700
+        assert entry.tags == {"ORIG"}
+
+    def test_unknown_policy_accepts_unchanged(self):
+        pm = make_policy_manager()
+        entry = PrefixEntry(prefix="2001:db8::/64")
+        out, hit = pm.apply_policy("nope", entry)
+        assert out is entry
+        assert hit == ""
+
+    def test_implicit_deny_when_nothing_matches(self):
+        pm = PolicyManager(
+            PolicyConfig(
+                definitions=[
+                    PolicyDefinition(
+                        name="only-v4",
+                        statements=[
+                            PolicyStatement(
+                                name="v4",
+                                criteria=[
+                                    FilterCriteria(
+                                        prefixes=[
+                                            PrefixMatch(
+                                                prefix="0.0.0.0/0", ge=0, le=32
+                                            )
+                                        ]
+                                    )
+                                ],
+                            )
+                        ],
+                    )
+                ]
+            )
+        )
+        out, _ = pm.apply_policy("only-v4", PrefixEntry(prefix="2001:db8::/64"))
+        assert out is None
+
+    def test_prefix_range_semantics(self):
+        m = PrefixMatch(prefix="10.0.0.0/8", ge=16, le=24)
+        assert m.matches("10.1.0.0/16")
+        assert m.matches("10.1.2.0/24")
+        assert not m.matches("10.0.0.0/8")  # too short
+        assert not m.matches("10.1.2.3/32")  # too long
+        assert not m.matches("11.0.0.0/16")  # outside
+        exact = PrefixMatch(prefix="192.168.0.0/16")
+        assert exact.matches("192.168.0.0/16")
+        assert not exact.matches("192.168.1.0/24")
+
+    def test_igp_cost_window(self):
+        crit = FilterCriteria(igp_cost_min=10, igp_cost_max=100)
+        e = PrefixEntry(prefix="2001:db8::/64")
+        assert crit.matches(e, igp_cost=50)
+        assert not crit.matches(e, igp_cost=5)
+        assert not crit.matches(e, igp_cost=500)
+
+
+class TestPluginManager:
+    def test_plugin_lifecycle_and_queue_access(self):
+        class AdvertisePlugin(Plugin):
+            def __init__(self):
+                self.started = False
+
+            async def start(self, args: PluginArgs):
+                self.started = True
+                self.args = args
+                # advertise through the queue like the VIP plugin would
+                from openr_tpu.types import PrefixEvent
+
+                args.prefix_updates_queue.push(
+                    PrefixEvent(
+                        event_type=PrefixEventType.ADD_PREFIXES,
+                        prefixes=[PrefixEntry(prefix="203.0.113.0/24")],
+                    )
+                )
+
+            async def stop(self):
+                self.started = False
+
+        async def run():
+            mgr = PluginManager()
+            plugin_holder = []
+
+            def factory():
+                p = AdvertisePlugin()
+                plugin_holder.append(p)
+                return p
+
+            mgr.register(factory)
+            q = ReplicateQueue("prefixUpdates")
+            reader = q.get_reader()
+            args = PluginArgs(
+                node_name="n1", config=None, prefix_updates_queue=q
+            )
+            await mgr.start_all(args)
+            assert plugin_holder[0].started
+            ev = await reader.get()
+            assert ev.prefixes[0].prefix == "203.0.113.0/24"
+            await mgr.stop_all()
+            assert not plugin_holder[0].started
+
+        asyncio.run(run())
+
+
+class TestNeighborMonitor:
+    def test_address_events_reach_queue(self):
+        async def run():
+            clock = SimClock()
+            q = ReplicateQueue("addrEvents")
+            reader = q.get_reader()
+            mon = NeighborMonitor(clock, q)
+            mon.start()
+            mon.report_address("fe80::1", is_reachable=False)
+            ev = await reader.get()
+            assert ev.address == "fe80::1"
+            assert not ev.is_reachable
+            await mon.stop()
+
+        asyncio.run(run())
+
+    def test_nl_neighbor_translation(self):
+        from openr_tpu.platform.nl.codec import NlNeighbor
+
+        async def run():
+            clock = SimClock()
+            addr_q = ReplicateQueue("addrEvents")
+            nl_q = ReplicateQueue("nlNeigh")
+            reader = addr_q.get_reader()
+            mon = NeighborMonitor(
+                clock, addr_q, nl_neighbor_reader=nl_q.get_reader()
+            )
+            mon.start()
+            nl_q.push(NlNeighbor(if_index=2, address="fe80::9", state=0x20))
+            await clock.run_for(0.1)
+            ev = reader.try_get()
+            assert ev is not None and not ev.is_reachable
+            await mon.stop()
+
+        asyncio.run(run())
+
+
+class TestPrefixManagerPolicyIntegration:
+    def test_origination_and_import_policies(self):
+        """Origination policy rejects one aggregate; area import policy
+        rewrites path preference on redistribution into area B only."""
+        import dataclasses
+
+        from openr_tpu.config import OriginatedPrefix
+        from openr_tpu.decision.rib import DecisionRouteUpdate, RibUnicastEntry
+        from openr_tpu.prefix_manager.prefix_manager import (
+            PrefixManager,
+            deserialize_prefix_db,
+        )
+        from openr_tpu.types import KvRequestType, NextHop
+
+        policy = PolicyManager(
+            PolicyConfig(
+                definitions=[
+                    PolicyDefinition(
+                        name="no-test-nets",
+                        statements=[
+                            PolicyStatement(
+                                name="drop-test",
+                                criteria=[
+                                    FilterCriteria(
+                                        prefixes=[
+                                            PrefixMatch(
+                                                prefix="198.51.100.0/24",
+                                                ge=24,
+                                                le=32,
+                                            )
+                                        ]
+                                    )
+                                ],
+                                action=FilterAction(accept=False),
+                            ),
+                            PolicyStatement(
+                                name="rest",
+                                criteria=[FilterCriteria(always_match=True)],
+                            ),
+                        ],
+                    ),
+                    PolicyDefinition(
+                        name="b-import",
+                        statements=[
+                            PolicyStatement(
+                                name="prefer",
+                                criteria=[FilterCriteria(always_match=True)],
+                                action=FilterAction(
+                                    set_path_preference=900,
+                                    add_tags=["VIA_B_IMPORT"],
+                                ),
+                            )
+                        ],
+                    ),
+                ]
+            )
+        )
+
+        async def run():
+            clock = SimClock()
+            kv_q = ReplicateQueue("kvreq")
+            kv_r = kv_q.get_reader()
+            fib_q = ReplicateQueue("fibUpdates")
+            pm = PrefixManager(
+                node_name="me",
+                clock=clock,
+                kv_request_queue=kv_q,
+                fib_route_updates_reader=fib_q.get_reader(),
+                areas=["A", "B"],
+                originated_prefixes=[
+                    OriginatedPrefix(
+                        prefix="198.51.100.0/24",
+                        origination_policy="no-test-nets",
+                    ),
+                    OriginatedPrefix(prefix="203.0.113.0/24"),
+                ],
+                policy_manager=policy,
+                area_import_policies={"B": "b-import"},
+            )
+            pm.start()
+            await clock.run_for(0.5)
+            reqs = [kv_r.try_get() for _ in range(kv_r.size())]
+            persists = [
+                r for r in reqs if r.request_type == KvRequestType.PERSIST_KEY
+            ]
+            # the policy-rejected aggregate is never advertised
+            advertised = {deserialize_prefix_db(r.value).prefix_entries[0].prefix
+                          for r in persists}
+            assert "203.0.113.0/24" in advertised
+            assert "198.51.100.0/24" not in advertised
+
+            # redistribution A->B goes through b-import
+            entry = RibUnicastEntry(
+                prefix="10.5.0.0/24",
+                nexthops={NextHop(address="fe80::1")},
+                best_prefix_entry=PrefixEntry("10.5.0.0/24"),
+                best_area="A",
+                igp_cost=3,
+            )
+            fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update={"10.5.0.0/24": entry}
+                )
+            )
+            await clock.run_for(0.5)
+            reqs = [kv_r.try_get() for _ in range(kv_r.size())]
+            redist = [
+                r
+                for r in reqs
+                if r.request_type == KvRequestType.PERSIST_KEY
+                and "10.5.0.0" in r.key
+            ]
+            assert len(redist) == 1 and redist[0].area == "B"
+            db = deserialize_prefix_db(redist[0].value)
+            assert db.prefix_entries[0].metrics.path_preference == 900
+            assert "VIA_B_IMPORT" in db.prefix_entries[0].tags
+            await pm.stop()
+
+        asyncio.run(run())
