@@ -1,0 +1,481 @@
+"""Benchmark suite — ports of the reference's folly::Benchmark harnesses.
+
+Reference parity (SURVEY §6 / BASELINE.md):
+  * DecisionBenchmark (openr/decision/tests/DecisionBenchmark.cpp:20-80):
+    grid initial route build, adjacency-update reconvergence, prefix
+    updates — topology generators from RoutingBenchmarkUtils.cpp
+    (grid :251, 3-tier fabric :422) live in openr_tpu.emulation.topology
+  * KvStoreBenchmarkTest.cpp:676: key persist/update at 100/1k/10k keys
+  * KvStoreConvergenceBenchmark.cpp:146: multi-store flood convergence
+  * FibBenchmark.cpp: route-programming throughput
+  * PrefixManagerBenchmarkTest.cpp: advertise throughput
+  * MessagingBenchmark.cpp: queue throughput
+
+Run:  python -m benchmarks.suite [--full] [--json PATH]
+Each result prints as one JSON line {"metric", "value", "unit", ...};
+the aggregate is written to --json (default BENCH_SUITE.json).
+
+The decision benches run BOTH backends (scalar oracle and the TPU/JAX
+batched kernel) so the speedup is measured, not assumed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List
+
+
+def _best_of(fn: Callable[[], None], repeats: int = 3) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _result(metric: str, value: float, unit: str, **detail) -> Dict:
+    out = {"metric": metric, "value": round(value, 3), "unit": unit}
+    if detail:
+        out["detail"] = detail
+    print(json.dumps(out), flush=True)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Decision (DecisionBenchmark.cpp)
+# ---------------------------------------------------------------------------
+
+def _build_decision_problem(edges, prefixes_per_node: int, area: str = "0"):
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.emulation.topology import build_adj_dbs
+    from openr_tpu.types import PrefixEntry
+
+    ls = LinkState(area)
+    dbs = build_adj_dbs(edges)
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i, node in enumerate(sorted(dbs)):
+        for p in range(prefixes_per_node):
+            ps.update_prefix(
+                node, area, PrefixEntry(prefix=f"10.{(i >> 8) & 255}.{i & 255}.{p}/32")
+            )
+    return ls, ps, sorted(dbs)
+
+
+def _make_backends(root: str):
+    from openr_tpu.decision.backend import ScalarBackend, TpuBackend
+    from openr_tpu.decision.spf_solver import SpfSolver
+
+    return {
+        "scalar": ScalarBackend(SpfSolver(root)),
+        "tpu": TpuBackend(SpfSolver(root)),
+    }
+
+
+def bench_decision_initial(results: List[Dict], full: bool) -> None:
+    """BM_DecisionGridInitialUpdate: cold full route build on grids."""
+    from openr_tpu.emulation.topology import fabric_edges, grid_edges
+
+    cases = [("grid", grid_edges(4), 10), ("grid", grid_edges(8), 10)]
+    if full:
+        cases.append(("grid", grid_edges(16), 10))
+    cases.append(
+        ("fabric", fabric_edges(num_pods=4, rsws_per_pod=8, fsws_per_pod=4,
+                                num_ssws=8), 10)
+    )
+    for kind, edges, ppn in cases:
+        ls, ps, nodes = _build_decision_problem(edges, ppn)
+        n = len(nodes)
+        timings = {}
+        for name, backend in _make_backends(nodes[0]).items():
+            backend.build_route_db({"0": ls}, ps)  # warm (jit compile)
+            ls.clear_spf_cache() if hasattr(ls, "clear_spf_cache") else None
+            timings[name] = _best_of(
+                lambda b=backend: b.build_route_db({"0": ls}, ps)
+            )
+            results.append(
+                _result(
+                    f"decision_initial_{kind}{n}_{name}",
+                    timings[name] * 1000,
+                    "ms",
+                    nodes=n,
+                    prefixes=n * ppn,
+                )
+            )
+        if timings["scalar"] and timings["tpu"]:
+            _result(
+                f"decision_initial_{kind}{n}_speedup",
+                timings["scalar"] / timings["tpu"],
+                "x",
+            )
+
+
+def bench_decision_adj_update(results: List[Dict], full: bool) -> None:
+    """BM_DecisionGridAdjUpdates: reconvergence after one metric change."""
+    from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+
+    side = 16 if full else 8
+    edges = grid_edges(side)
+    ls, ps, nodes = _build_decision_problem(edges, 10)
+    dbs = build_adj_dbs(edges)
+    flip_node = nodes[1]
+    for name, backend in _make_backends(nodes[0]).items():
+        backend.build_route_db({"0": ls}, ps)  # steady state
+        toggle = [0]
+
+        def one_update(b=backend):
+            toggle[0] ^= 1
+            db = dbs[flip_node]
+            for adj in db.adjacencies:
+                adj.metric = 10 if toggle[0] else 1
+            ls.update_adjacency_database(db)
+            b.build_route_db({"0": ls}, ps)
+
+        dt = _best_of(one_update, repeats=5)
+        results.append(
+            _result(
+                f"decision_adj_update_grid{side * side}_{name}",
+                dt * 1000,
+                "ms",
+                nodes=side * side,
+            )
+        )
+
+
+def bench_decision_prefix_update(results: List[Dict], full: bool) -> None:
+    """BM_DecisionGridPrefixUpdates: prefix churn on a fixed topology."""
+    from openr_tpu.emulation.topology import grid_edges
+    from openr_tpu.types import PrefixEntry
+
+    ls, ps, nodes = _build_decision_problem(grid_edges(10), 10)
+    batch = 1000 if full else 100
+    for name, backend in _make_backends(nodes[0]).items():
+        backend.build_route_db({"0": ls}, ps)
+        seq = [0]
+
+        def churn(b=backend):
+            seq[0] += 1
+            for i in range(batch):
+                ps.update_prefix(
+                    nodes[i % len(nodes)],
+                    "0",
+                    PrefixEntry(prefix=f"172.16.{seq[0] & 255}.{i & 255}/32"),
+                )
+            b.build_route_db({"0": ls}, ps)
+
+        dt = _best_of(churn, repeats=3)
+        results.append(
+            _result(
+                f"decision_prefix_update_{batch}_{name}", dt * 1000, "ms",
+                nodes=100, prefixes_churned=batch,
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# KvStore (KvStoreBenchmarkTest.cpp, KvStoreConvergenceBenchmark.cpp)
+# ---------------------------------------------------------------------------
+
+def bench_kvstore_persist(results: List[Dict], full: bool) -> None:
+    import asyncio
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import KvStoreConfig
+    from openr_tpu.kvstore.kv_store import KvStore
+    from openr_tpu.kvstore.transport import InProcessTransport
+    from openr_tpu.messaging.queue import ReplicateQueue
+
+    sizes = [100, 1000, 10_000] if full else [100, 1000]
+    for n in sizes:
+        async def run(n=n):
+            clock = SimClock()
+            store = KvStore(
+                node_name="b0",
+                clock=clock,
+                config=KvStoreConfig(),
+                areas=["0"],
+                transport=InProcessTransport(clock),
+                publications_queue=ReplicateQueue("pubs"),
+            )
+            db = store.areas["0"]
+            payload = b"x" * 128
+            t0 = time.perf_counter()
+            for i in range(n):
+                db.persist_self_originated_key(f"prefix:b0:k{i}", payload)
+            dt = time.perf_counter() - t0
+            # update pass: same keys, new values (version bump path)
+            t0 = time.perf_counter()
+            for i in range(n):
+                db.persist_self_originated_key(f"prefix:b0:k{i}", payload + b"y")
+            dt_update = time.perf_counter() - t0
+            await store.stop()
+            return dt, dt_update
+
+        dt, dt_update = asyncio.run(run())
+        results.append(
+            _result(f"kvstore_persist_{n}", n / dt, "keys/s")
+        )
+        results.append(
+            _result(f"kvstore_update_{n}", n / dt_update, "keys/s")
+        )
+
+
+def bench_kvstore_flood_convergence(results: List[Dict], full: bool) -> None:
+    """N stores in a line; one key injected at the head; time until every
+    store holds it (virtual time = protocol latency, wall time = compute)."""
+    import asyncio
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import KvStoreConfig
+    from openr_tpu.kvstore.kv_store import KvStore
+    from openr_tpu.kvstore.transport import InProcessTransport
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.types import PeerSpec
+
+    n = 64 if full else 16
+
+    async def run():
+        clock = SimClock()
+        transport = InProcessTransport(clock, latency_s=0.001)
+        stores = []
+        for i in range(n):
+            store = KvStore(
+                node_name=f"s{i}",
+                clock=clock,
+                config=KvStoreConfig(),
+                areas=["0"],
+                transport=transport,
+                publications_queue=ReplicateQueue(f"pubs{i}"),
+            )
+            transport.register(f"s{i}", store)
+            stores.append(store)
+            store.start()
+        for i, store in enumerate(stores):
+            peers = {}
+            if i > 0:
+                peers[f"s{i - 1}"] = PeerSpec()
+            if i < n - 1:
+                peers[f"s{i + 1}"] = PeerSpec()
+            store.areas["0"].add_peers(peers)
+        await clock.run_for(5.0)
+
+        t_wall = time.perf_counter()
+        t_virtual = clock.now()
+        stores[0].areas["0"].persist_self_originated_key("prefix:s0:x", b"v")
+        while not all("prefix:s0:x" in s.areas["0"].key_vals for s in stores):
+            await clock.run_for(0.05)
+            if clock.now() - t_virtual > 60:
+                raise RuntimeError("flood did not converge")
+        wall = time.perf_counter() - t_wall
+        virtual = clock.now() - t_virtual
+        for store in stores:
+            await store.stop()
+        return wall, virtual
+
+    wall, virtual = asyncio.run(run())
+    results.append(
+        _result(
+            f"kvstore_flood_convergence_{n}",
+            virtual * 1000,
+            "virtual_ms",
+            wall_ms=round(wall * 1000, 1),
+            stores=n,
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fib (FibBenchmark.cpp)
+# ---------------------------------------------------------------------------
+
+def bench_fib_programming(results: List[Dict], full: bool) -> None:
+    import asyncio
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import FibConfig
+    from openr_tpu.decision.rib import (
+        DecisionRouteUpdate,
+        DecisionRouteUpdateType,
+        RibUnicastEntry,
+    )
+    from openr_tpu.fib.fib import Fib, MockFibAgent
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.types import NextHop
+
+    n = 10_000 if full else 2_000
+
+    async def run():
+        clock = SimClock()
+        agent = MockFibAgent(clock)
+        q = ReplicateQueue("routes")
+        fib = Fib(
+            node_name="b0",
+            clock=clock,
+            config=FibConfig(),
+            agent=agent,
+            route_updates_reader=q.get_reader(),
+        )
+        fib.start()
+        routes = {
+            f"10.{(i >> 8) & 255}.{i & 255}.0/24": RibUnicastEntry(
+                prefix=f"10.{(i >> 8) & 255}.{i & 255}.0/24",
+                nexthops=[NextHop(address="fe80::1", if_name="eth0")],
+            )
+            for i in range(n)
+        }
+        t0 = time.perf_counter()
+        q.push(
+            DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update=routes,
+            )
+        )
+        while len(agent.unicast) < n:
+            await clock.run_for(0.05)
+        dt = time.perf_counter() - t0
+        await fib.stop()
+        return dt
+
+    dt = asyncio.run(run())
+    results.append(_result(f"fib_program_{n}", n / dt, "routes/s"))
+
+
+# ---------------------------------------------------------------------------
+# PrefixManager (PrefixManagerBenchmarkTest.cpp)
+# ---------------------------------------------------------------------------
+
+def bench_prefix_manager_advertise(results: List[Dict], full: bool) -> None:
+    import asyncio
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.prefix_manager.prefix_manager import PrefixManager
+    from openr_tpu.types import (
+        PrefixEntry,
+        PrefixEvent,
+        PrefixEventType,
+    )
+
+    n = 10_000 if full else 2_000
+
+    async def run():
+        clock = SimClock()
+        kv_q = ReplicateQueue("kvreq")
+        kv_r = kv_q.get_reader()
+        prefix_q = ReplicateQueue("prefixEvents")
+        pm = PrefixManager(
+            node_name="b0",
+            clock=clock,
+            kv_request_queue=kv_q,
+            prefix_updates_reader=prefix_q.get_reader(),
+        )
+        pm.start()
+        await clock.run_for(0.1)
+        while kv_r.try_get() is not None:
+            pass
+        entries = [
+            PrefixEntry(prefix=f"10.{(i >> 8) & 255}.{i & 255}.0/24")
+            for i in range(n)
+        ]
+        t0 = time.perf_counter()
+        prefix_q.push(
+            PrefixEvent(
+                event_type=PrefixEventType.ADD_PREFIXES, prefixes=entries
+            )
+        )
+        seen = 0
+        while seen < n:
+            await clock.run_for(0.05)
+            while kv_r.try_get() is not None:
+                seen += 1
+        dt = time.perf_counter() - t0
+        await pm.stop()
+        return dt
+
+    dt = asyncio.run(run())
+    results.append(_result(f"prefix_manager_advertise_{n}", n / dt, "prefixes/s"))
+
+
+# ---------------------------------------------------------------------------
+# Messaging (MessagingBenchmark.cpp)
+# ---------------------------------------------------------------------------
+
+def bench_messaging(results: List[Dict], full: bool) -> None:
+    import asyncio
+
+    from openr_tpu.messaging.queue import ReplicateQueue
+
+    n = 200_000 if full else 50_000
+    readers = 4
+
+    async def run():
+        q = ReplicateQueue("bench")
+        rs = [q.get_reader() for _ in range(readers)]
+        t0 = time.perf_counter()
+
+        async def drain(r):
+            for _ in range(n):
+                await r.get()
+
+        tasks = [asyncio.ensure_future(drain(r)) for r in rs]
+        for i in range(n):
+            q.push(i)
+            if i % 4096 == 0:
+                await asyncio.sleep(0)  # let readers drain; bounds memory
+        await asyncio.gather(*tasks)
+        return time.perf_counter() - t0
+
+    dt = asyncio.run(run())
+    results.append(
+        _result(
+            "messaging_replicate_throughput",
+            n * readers / dt,
+            "deliveries/s",
+            items=n,
+            readers=readers,
+        )
+    )
+
+
+ALL_BENCHES = [
+    bench_decision_initial,
+    bench_decision_adj_update,
+    bench_decision_prefix_update,
+    bench_kvstore_persist,
+    bench_kvstore_flood_convergence,
+    bench_fib_programming,
+    bench_prefix_manager_advertise,
+    bench_messaging,
+]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--full", action="store_true",
+                   help="reference-scale sizes (slower)")
+    p.add_argument("--json", default="BENCH_SUITE.json")
+    p.add_argument("--only", default="",
+                   help="substring filter on bench function names")
+    args = p.parse_args()
+    results: List[Dict] = []
+    t0 = time.time()
+    for bench in ALL_BENCHES:
+        if args.only and args.only not in bench.__name__:
+            continue
+        bench(results, args.full)
+    with open(args.json, "w") as f:
+        json.dump(
+            {"results": results, "wall_s": round(time.time() - t0, 1)},
+            f,
+            indent=2,
+        )
+    print(f"# {len(results)} results -> {args.json}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -134,10 +134,15 @@ class TpuBackend(DecisionBackend):
             max_degree=D,
         )
         self.num_device_builds += 1
-        valid = np.asarray(valid)[0]
-        metric = np.asarray(metric)[0]
-        nh_out = np.asarray(nh_out)[0]
-        winners = np.asarray(winners)[0]
+        # ONE device->host fetch for all outputs: over a tunneled TPU each
+        # transfer is a full round trip, and four separate np.asarray calls
+        # cost ~4x one device_get (measured ~256ms vs ~69ms on v5e/axon) —
+        # that difference alone would blow the 10-250ms debounce budget
+        import jax
+
+        valid, metric, nh_out, winners = (
+            a[0] for a in jax.device_get((valid, metric, nh_out, winners))
+        )
 
         out_edges = topo.root_out_edges(me)
         route_db = DecisionRouteDb()

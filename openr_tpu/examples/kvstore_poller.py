@@ -35,18 +35,20 @@ class KvStorePoller:
         Mirrors KvStorePoller::getPrefixDbs: one RPC per node, failures
         collected rather than raised."""
 
-        async def poll(ep: Tuple[str, int]) -> Optional[dict]:
+        async def poll_one(ep: Tuple[str, int]) -> dict:
             host, port = ep
+            async with OpenrCtrlClient(host=host, port=port) as client:
+                return await client.call(
+                    "dump_kv_store_area",
+                    prefix=C.PREFIX_DB_MARKER,
+                    area=area,
+                )
+
+        async def poll(ep: Tuple[str, int]) -> Optional[dict]:
+            # the timeout covers connect + RPC: a SYN-blackholing endpoint
+            # must be reported unreachable, not stall the whole scrape
             try:
-                async with OpenrCtrlClient(host=host, port=port) as client:
-                    return await asyncio.wait_for(
-                        client.call(
-                            "dump_kv_store_area",
-                            prefix=C.PREFIX_DB_MARKER,
-                            area=area,
-                        ),
-                        timeout=self.timeout_s,
-                    )
+                return await asyncio.wait_for(poll_one(ep), self.timeout_s)
             except (OSError, asyncio.TimeoutError, RuntimeError):
                 return None
 

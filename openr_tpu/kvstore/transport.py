@@ -155,9 +155,11 @@ class TcpKvStoreTransport(KvStoreTransport):
         self._clients: Dict[str, object] = {}
         #: strong refs to detached close() tasks (loop refs are weak)
         self._close_tasks: Set[object] = set()
-        #: serializes dials so two concurrent RPCs to an un-cached peer
-        #: can't both connect (the loser's connection would leak)
-        self._connect_lock: Optional[object] = None
+        #: per-peer dial locks so two concurrent RPCs to an un-cached peer
+        #: can't both connect (the loser's connection would leak) — per
+        #: peer, not global, so one blackholing peer can't head-of-line
+        #: block dials to healthy peers
+        self._connect_locks: Dict[str, object] = {}
 
     # -- peer registry hooks (called by KvStoreDb) --------------------------
 
@@ -170,6 +172,7 @@ class TcpKvStoreTransport(KvStoreTransport):
 
     def unregister_peer(self, peer_node: str) -> None:
         self._specs.pop(peer_node, None)
+        self._connect_locks.pop(peer_node, None)
         self._drop_client(peer_node)
 
     def _drop_client(self, peer_node: str) -> None:
@@ -202,9 +205,8 @@ class TcpKvStoreTransport(KvStoreTransport):
         client = self._clients.get(peer_node)
         if client is not None:
             return client
-        if self._connect_lock is None:
-            self._connect_lock = asyncio.Lock()
-        async with self._connect_lock:
+        lock = self._connect_locks.setdefault(peer_node, asyncio.Lock())
+        async with lock:
             client = self._clients.get(peer_node)  # raced winner?
             if client is not None:
                 return client

@@ -25,6 +25,7 @@ INITIALIZED.
 
 from __future__ import annotations
 
+import asyncio
 import re
 from typing import Dict, List, Optional
 
@@ -369,6 +370,7 @@ class OpenrNode:
             for q in self._queues:
                 self.watchdog.add_queue(q)
         self._started = False
+        self._plugin_start_task = None
 
     # -- lifecycle (start order per Main.cpp:231-470) ----------------------
 
@@ -378,7 +380,7 @@ class OpenrNode:
         for module in self._all_modules:
             module.start()
         if self.plugin_manager.has_plugins():
-            self.spark.spawn(
+            self._plugin_start_task = self.spark.spawn(
                 self.plugin_manager.start_all(self._plugin_args),
                 name="plugins.start",
             )
@@ -386,7 +388,15 @@ class OpenrNode:
 
     async def stop(self) -> None:
         # plugins first (they feed prefixUpdatesQueue), then close queues,
-        # then stop modules in reverse (Main.cpp:498)
+        # then stop modules in reverse (Main.cpp:498).  Settle the startup
+        # task before stop_all so a plugin mid-start can't slip into
+        # _active after the list is cleared and leak un-stopped
+        if self._plugin_start_task is not None:
+            self._plugin_start_task.cancel()
+            try:
+                await self._plugin_start_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
         await self.plugin_manager.stop_all()
         for q in self._queues:
             q.close()

@@ -18,9 +18,12 @@ from openr_tpu.common.runtime import Actor, Clock, CounterMap
 from openr_tpu.messaging.queue import RQueue, ReplicateQueue
 from openr_tpu.types import AddressEvent
 
-# kernel neighbor-cache states that mean "gone" (linux/neighbour.h)
+# kernel neighbor-cache states (linux/neighbour.h).  Only NUD_FAILED means
+# resolution actually failed; NUD_INCOMPLETE is the normal transient start
+# of resolution and RTM_DELNEIGH fires on routine GC eviction of idle
+# entries — treating those as "unreachable" would flap healthy adjacencies
+NUD_REACHABLE = 0x02
 NUD_FAILED = 0x20
-NUD_INCOMPLETE = 0x01
 
 
 class NeighborMonitor(Actor):
@@ -43,11 +46,14 @@ class NeighborMonitor(Actor):
 
     def _on_nl_neighbor(self, ev) -> None:
         """Translate a kernel neighbor event (platform.nl NlNeighbor) into
-        an AddressEvent for Spark."""
-        unreachable = bool(ev.is_del) or bool(
-            ev.state & (NUD_FAILED | NUD_INCOMPLETE)
-        )
-        self.report_address(ev.address, is_reachable=not unreachable)
+        an AddressEvent for Spark.  Only definitive states are reported;
+        transient churn (INCOMPLETE, GC deletes) is ignored."""
+        if ev.is_del:
+            return
+        if ev.state & NUD_FAILED:
+            self.report_address(ev.address, is_reachable=False)
+        elif ev.state & NUD_REACHABLE:
+            self.report_address(ev.address, is_reachable=True)
 
     def report_address(self, address: str, is_reachable: bool) -> None:
         """Direct injection point (tests / platform integrations)."""

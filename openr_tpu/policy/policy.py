@@ -47,9 +47,13 @@ class PrefixMatch:
         if net.version != cand.version:
             return False
         lo = self.ge if self.ge is not None else net.prefixlen
-        hi = self.le if self.le is not None else (
-            self.ge if self.ge is not None else net.prefixlen
-        )
+        if self.le is not None:
+            hi = self.le
+        elif self.ge is not None:
+            # route-map convention: `ge N` alone means N..addrlen
+            hi = net.max_prefixlen
+        else:
+            hi = net.prefixlen  # exact match only
         if not (lo <= cand.prefixlen <= hi):
             return False
         return cand.subnet_of(net) if cand.prefixlen >= net.prefixlen else False

@@ -335,3 +335,44 @@ class TestPrefixManagerPolicyIntegration:
             await pm.stop()
 
         asyncio.run(run())
+
+
+def test_prefix_match_ge_without_le_goes_to_addrlen():
+    m = PrefixMatch(prefix="10.0.0.0/8", ge=16)
+    assert m.matches("10.1.0.0/16")
+    assert m.matches("10.1.2.3/32")
+    assert not m.matches("10.0.0.0/8")
+    m6 = PrefixMatch(prefix="2001:db8::/32", ge=48)
+    assert m6.matches("2001:db8:1::/64")
+    assert m6.matches("2001:db8::1/128")
+
+
+def test_neighbor_monitor_ignores_transient_churn():
+    import asyncio as aio
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.platform.nl.codec import NlNeighbor
+
+    async def run():
+        clock = SimClock()
+        addr_q = ReplicateQueue("addrEvents")
+        nl_q = ReplicateQueue("nlNeigh")
+        reader = addr_q.get_reader()
+        mon = NeighborMonitor(
+            clock, addr_q, nl_neighbor_reader=nl_q.get_reader()
+        )
+        mon.start()
+        # GC delete and INCOMPLETE (0x01) must NOT produce events
+        nl_q.push(NlNeighbor(if_index=2, address="fe80::9", state=0x02,
+                             is_del=True))
+        nl_q.push(NlNeighbor(if_index=2, address="fe80::9", state=0x01))
+        await clock.run_for(0.1)
+        assert reader.try_get() is None
+        # NUD_FAILED -> unreachable
+        nl_q.push(NlNeighbor(if_index=2, address="fe80::9", state=0x20))
+        await clock.run_for(0.1)
+        ev = reader.try_get()
+        assert ev is not None and not ev.is_reachable
+        await mon.stop()
+
+    aio.run(run())
