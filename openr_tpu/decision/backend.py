@@ -65,6 +65,13 @@ class TpuBackend(DecisionBackend):
         self.cand_bucket = cand_bucket
         self.num_device_builds = 0
         self.num_scalar_builds = 0
+        #: EncodedTopology cache keyed by (area, LinkState.topology_seq):
+        #: most rebuilds are prefix churn on an unchanged graph, and
+        #: re-encoding a 4096-node LSDB costs tens of ms of the debounce
+        #: budget (SURVEY §7 hard-part 4)
+        self._topo_cache: dict = {}
+        self.num_encode_hits = 0
+        self.num_encodes = 0
 
     def build_route_db(self, area_link_states, prefix_state):
         # the device kernel implements the default selection semantics
@@ -98,7 +105,14 @@ class TpuBackend(DecisionBackend):
         if not link_state.has_node(me):
             return None
 
-        topo = encode_link_state(link_state, node_buckets=self.node_buckets)
+        cache_key = (area, link_state.topology_seq)
+        topo = self._topo_cache.get(cache_key)
+        if topo is None:
+            topo = encode_link_state(link_state, node_buckets=self.node_buckets)
+            self._topo_cache = {cache_key: topo}  # one live graph per area
+            self.num_encodes += 1
+        else:
+            self.num_encode_hits += 1
         if me not in topo.node_ids:
             return None
         cands = encode_prefix_candidates(

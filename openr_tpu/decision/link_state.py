@@ -236,6 +236,11 @@ class LinkState:
         self._spf_results: Dict[Tuple[str, bool], SpfResult] = {}
         self._kth_path_results: Dict[Tuple[str, str, int], List[Path]] = {}
         self.num_spf_runs = 0
+        #: bumped on every SPF-relevant change — downstream encoders (the
+        #: device CSR bridge) key their caches on it, so prefix-only
+        #: rebuilds skip topology re-encoding entirely
+        self.topology_seq = 0
+        self._all_links_cache: Optional[Tuple[int, List[Link]]] = None
 
     # -- introspection -----------------------------------------------------
 
@@ -260,9 +265,23 @@ class LinkState:
     def links_from_node(self, node: str) -> Set[Link]:
         return self._link_map.get(node, set())
 
+    def clear_spf_memoization(self) -> None:
+        """Drop memoized SPF/k-path results without touching the graph —
+        benchmarking hook for measuring cold solves (the memo is otherwise
+        invalidated only by topology changes)."""
+        self._spf_results.clear()
+        self._kth_path_results.clear()
+
     def all_links(self) -> List[Link]:
-        """All undirected links, in canonical order (stable across calls)."""
-        return sorted(self._all_links)
+        """All undirected links, in canonical order (stable across calls).
+        Cached per topology_seq — sorting a 4096-node LSDB's link set costs
+        ~20ms, which the encoder would otherwise pay on every rebuild."""
+        cached = self._all_links_cache
+        if cached is not None and cached[0] == self.topology_seq:
+            return cached[1]
+        links = sorted(self._all_links)
+        self._all_links_cache = (self.topology_seq, links)
+        return links
 
     def ordered_links_from_node(self, node: str) -> List[Link]:
         return sorted(self._link_map.get(node, set()))
@@ -301,11 +320,15 @@ class LinkState:
         self._link_map.setdefault(link.n1, set()).add(link)
         self._link_map.setdefault(link.n2, set()).add(link)
         self._all_links.add(link)
+        # a DOWN link joining/leaving doesn't set topology_changed (no SPF
+        # impact), so invalidate the ordered-list cache structurally
+        self._all_links_cache = None
 
     def _remove_link(self, link: Link) -> None:
         self._link_map.get(link.n1, set()).discard(link)
         self._link_map.get(link.n2, set()).discard(link)
         self._all_links.discard(link)
+        self._all_links_cache = None
 
     def _update_node_overloaded(self, node: str, overloaded: bool) -> bool:
         prior = self._node_overloads.get(node)
@@ -406,6 +429,7 @@ class LinkState:
         if change.topology_changed:
             self._spf_results.clear()
             self._kth_path_results.clear()
+            self.topology_seq += 1
         return change
 
     def delete_adjacency_database(self, node: str) -> LinkStateChange:
@@ -420,6 +444,7 @@ class LinkState:
         del self._adj_dbs[node]
         self._spf_results.clear()
         self._kth_path_results.clear()
+        self.topology_seq += 1
         change.topology_changed = True
         return change
 

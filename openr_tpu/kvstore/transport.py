@@ -172,7 +172,9 @@ class TcpKvStoreTransport(KvStoreTransport):
 
     def unregister_peer(self, peer_node: str) -> None:
         self._specs.pop(peer_node, None)
-        self._connect_locks.pop(peer_node, None)
+        # the dial lock is deliberately NOT popped: an in-flight dial may
+        # hold it, and a re-registered peer must serialize behind that dial
+        # or the loser's connection leaks (locks are bounded by peers seen)
         self._drop_client(peer_node)
 
     def _drop_client(self, peer_node: str) -> None:

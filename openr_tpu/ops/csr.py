@@ -22,6 +22,7 @@ used to map nexthop bitmask lanes back to `Link` objects.
 
 from __future__ import annotations
 
+import ctypes
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -30,6 +31,30 @@ import numpy as np
 from openr_tpu.decision.link_state import Link, LinkState
 
 INF = np.float32(np.inf)
+
+#: native fill path (native/csr_bridge.cc) — the per-element expansion in C
+#: instead of Python (SURVEY §7 hard-part 4: the bridge must fit in the
+#: 10-250ms debounce budget).  None = unavailable; pure-Python fallback.
+_native = None
+
+
+def _get_native():
+    global _native
+    if _native is None:
+        try:
+            from openr_tpu.common.native import load_native_lib
+
+            lib = load_native_lib("csr_bridge")
+            lib.csr_expand_fill.restype = ctypes.c_int
+            lib.csr_failure_masks.restype = ctypes.c_int
+            _native = lib
+        except Exception:  # noqa: BLE001 - no compiler etc.
+            _native = False
+    return _native or None
+
+
+def _np_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
 
 def bucket_for(value: int, buckets: Sequence[int]) -> int:
@@ -117,23 +142,19 @@ def encode_link_state(
     padded_v = node_bucket or bucket_for(max(V, 1), node_buckets)
 
     links = link_state.all_links()
-    directed: List[Tuple[int, int, float, bool, int]] = []
+    L = len(links)
+    # one pass over the Python Link objects -> flat columns
+    col_a = np.empty(max(L, 1), np.int32)
+    col_b = np.empty(max(L, 1), np.int32)
+    col_m = np.empty(max(L, 1), np.float32)
+    col_ok = np.empty(max(L, 1), np.uint8)
     for li, link in enumerate(links):
-        m = float(link.get_max_metric())
-        ok = link.is_up()
-        if ok and m <= 0:
-            # The DAG-equality nexthop propagation assumes strictly positive
-            # metrics (a 0-cost edge would union lanes across equidistant
-            # nodes where heap Dijkstra keeps them distinct).  The reference
-            # never produces metric<=0 adjacencies; reject at the bridge.
-            raise ValueError(
-                f"non-positive metric {m} on {link}; device SPF requires "
-                "metrics >= 1"
-            )
-        a, b = node_ids[link.n1], node_ids[link.n2]
-        directed.append((a, b, m, ok, li))
-        directed.append((b, a, m, ok, li))
-    E = len(directed)
+        col_a[li] = node_ids[link.n1]
+        col_b[li] = node_ids[link.n2]
+        col_m[li] = link.get_max_metric()
+        col_ok[li] = link.is_up()
+
+    E = 2 * L
     padded_e = edge_bucket or bucket_for(
         max(E, 1), [b * edge_multiplier for b in node_buckets]
     )
@@ -142,16 +163,61 @@ def encode_link_state(
     if padded_e < E:
         raise ValueError(f"edge bucket {padded_e} < {E} directed edges")
 
-    src = np.zeros(padded_e, np.int32)
-    dst = np.zeros(padded_e, np.int32)
-    w = np.full(padded_e, INF, np.float32)
-    edge_ok = np.zeros(padded_e, bool)
-    link_index = np.full(padded_e, -1, np.int32)
-    for e, (a, b, m, ok, li) in enumerate(directed):
-        src[e], dst[e], link_index[e] = a, b, li
-        if ok:
-            w[e] = m
-            edge_ok[e] = True
+    src = np.empty(padded_e, np.int32)
+    dst = np.empty(padded_e, np.int32)
+    w = np.empty(padded_e, np.float32)
+    edge_ok_u8 = np.empty(padded_e, np.uint8)
+    link_index = np.empty(padded_e, np.int32)
+
+    native = _get_native()
+    if native is not None:
+        rc = native.csr_expand_fill(
+            L,
+            _np_ptr(col_a, ctypes.c_int32),
+            _np_ptr(col_b, ctypes.c_int32),
+            _np_ptr(col_m, ctypes.c_float),
+            _np_ptr(col_ok, ctypes.c_uint8),
+            padded_e,
+            _np_ptr(src, ctypes.c_int32),
+            _np_ptr(dst, ctypes.c_int32),
+            _np_ptr(w, ctypes.c_float),
+            _np_ptr(edge_ok_u8, ctypes.c_uint8),
+            _np_ptr(link_index, ctypes.c_int32),
+        )
+        if rc == -2:
+            # The DAG-equality nexthop propagation assumes strictly positive
+            # metrics (a 0-cost edge would union lanes across equidistant
+            # nodes where heap Dijkstra keeps them distinct).  The reference
+            # never produces metric<=0 adjacencies; reject at the bridge.
+            raise ValueError(
+                "non-positive metric on an up link; device SPF requires "
+                "metrics >= 1"
+            )
+        if rc != 0:
+            raise ValueError(f"csr_expand_fill failed rc={rc}")
+        edge_ok = edge_ok_u8.astype(bool)
+    else:
+        # vectorized Python fallback (identical semantics)
+        if np.any(col_ok[:L].astype(bool) & (col_m[:L] <= 0)):
+            raise ValueError(
+                "non-positive metric on an up link; device SPF requires "
+                "metrics >= 1"
+            )
+        src[:E:2], dst[:E:2] = col_a[:L], col_b[:L]
+        src[1:E:2], dst[1:E:2] = col_b[:L], col_a[:L]
+        m_dir = np.where(col_ok[:L].astype(bool), col_m[:L], INF)
+        w[:E:2] = m_dir
+        w[1:E:2] = m_dir
+        edge_ok_u8[:E:2] = col_ok[:L]
+        edge_ok_u8[1:E:2] = col_ok[:L]
+        link_index[:E:2] = np.arange(L, dtype=np.int32)
+        link_index[1:E:2] = np.arange(L, dtype=np.int32)
+        src[E:] = 0
+        dst[E:] = 0
+        w[E:] = INF
+        edge_ok_u8[E:] = 0
+        link_index[E:] = -1
+        edge_ok = edge_ok_u8.astype(bool)
 
     overloaded = np.zeros(padded_v, bool)
     soft = np.zeros(padded_v, np.int32)
@@ -255,6 +321,24 @@ def link_failure_batch(
     encoded once; the batch is just this mask)."""
     B = len(failed_links_per_snapshot)
     E = topo.padded_edges
+    native = _get_native()
+    if native is not None and B:
+        F = max((len(f) for f in failed_links_per_snapshot), default=0)
+        flat = np.full((B, max(F, 1)), -1, np.int32)
+        for b, failed in enumerate(failed_links_per_snapshot):
+            if failed:
+                flat[b, : len(failed)] = failed
+        mask_u8 = np.empty((B, E), np.uint8)
+        rc = native.csr_failure_masks(
+            B,
+            flat.shape[1],
+            _np_ptr(flat, ctypes.c_int32),
+            E,
+            len(topo.links),
+            _np_ptr(mask_u8, ctypes.c_uint8),
+        )
+        if rc == 0:
+            return mask_u8.astype(bool)
     mask = np.ones((B, E), bool)
     for b, failed in enumerate(failed_links_per_snapshot):
         if not failed:

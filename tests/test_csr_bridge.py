@@ -1,0 +1,131 @@
+"""CSR bridge tests: native fill vs pure-Python fallback equivalence,
+topology-seq encoder caching, and failure-mask expansion.
+
+Reference context: SURVEY §7 hard-part 4 (host<->device bridge inside the
+debounce budget); native/csr_bridge.cc is the C fill path.
+"""
+
+import numpy as np
+import pytest
+
+import openr_tpu.ops.csr as csr_mod
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.emulation.topology import (
+    build_adj_dbs,
+    grid_edges,
+    random_connected_edges,
+)
+from openr_tpu.ops.csr import encode_link_state, link_failure_batch
+
+
+def make_ls(edges):
+    ls = LinkState("0")
+    for db in build_adj_dbs(edges).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def encode_both(ls, **kw):
+    """Encode with the native path and with the fallback; return both."""
+    native = csr_mod._get_native()
+    assert native is not None, "native csr_bridge must build in CI"
+    with_native = encode_link_state(ls, **kw)
+    saved = csr_mod._native
+    csr_mod._native = False  # force fallback
+    try:
+        fallback = encode_link_state(ls, **kw)
+    finally:
+        csr_mod._native = saved
+    return with_native, fallback
+
+
+class TestNativeFill:
+    def test_native_matches_fallback(self):
+        ls = make_ls(random_connected_edges(64, 96, seed=11))
+        a, b = encode_both(ls)
+        for field in ("src", "dst", "w", "edge_ok", "link_index"):
+            np.testing.assert_array_equal(
+                getattr(a, field), getattr(b, field), err_msg=field
+            )
+        assert a.node_ids == b.node_ids
+        assert a.num_edges == b.num_edges
+
+    def test_down_link_padding_semantics(self):
+        ls = make_ls(grid_edges(3))
+        # take one link down via usability: easiest is overloading checks
+        # at encode level — verify padding region instead
+        topo, _ = encode_both(ls)
+        E = topo.num_edges
+        assert np.all(np.isinf(topo.w[E:]))
+        assert not topo.edge_ok[E:].any()
+        assert np.all(topo.link_index[E:] == -1)
+        # every valid directed edge pair shares a link id
+        li = topo.link_index[:E]
+        assert np.array_equal(li[0::2], li[1::2])
+
+    def test_non_positive_metric_rejected(self):
+        ls = make_ls([("a", "b", 1)])
+        link = ls.all_links()[0]
+        link.metric1 = 0
+        link.metric2 = 0
+        with pytest.raises(ValueError):
+            encode_link_state(ls)
+
+    def test_failure_masks_native_matches_fallback(self):
+        ls = make_ls(random_connected_edges(32, 48, seed=5))
+        topo = encode_link_state(ls)
+        fails = [[0], [1, 2], [], [len(topo.links) - 1, 0]]
+        native_mask = link_failure_batch(topo, fails)
+        saved = csr_mod._native
+        csr_mod._native = False
+        try:
+            fallback_mask = link_failure_batch(topo, fails)
+        finally:
+            csr_mod._native = saved
+        np.testing.assert_array_equal(native_mask, fallback_mask)
+
+
+class TestTopologySeqCache:
+    def test_seq_bumps_on_topology_change_only(self):
+        ls = make_ls(grid_edges(3))
+        seq0 = ls.topology_seq
+        dbs = build_adj_dbs(grid_edges(3))
+        node = sorted(dbs)[0]
+        # identical re-advertisement: no change
+        ls.update_adjacency_database(dbs[node])
+        assert ls.topology_seq == seq0
+        # metric change: topology change
+        for adj in dbs[node].adjacencies:
+            adj.metric = 42
+        ls.update_adjacency_database(dbs[node])
+        assert ls.topology_seq > seq0
+        # delete: topology change
+        seq1 = ls.topology_seq
+        ls.delete_adjacency_database(node)
+        assert ls.topology_seq > seq1
+
+    def test_backend_encoder_cache_hits_on_prefix_churn(self):
+        from openr_tpu.decision.backend import TpuBackend
+        from openr_tpu.decision.prefix_state import PrefixState
+        from openr_tpu.decision.spf_solver import SpfSolver
+        from openr_tpu.types import PrefixEntry
+
+        ls = make_ls(grid_edges(3))
+        nodes = sorted(build_adj_dbs(grid_edges(3)))
+        ps = PrefixState()
+        ps.update_prefix(nodes[-1], "0", PrefixEntry(prefix="10.0.0.0/24"))
+        backend = TpuBackend(SpfSolver(nodes[0]))
+        backend.build_route_db({"0": ls}, ps)
+        assert backend.num_encodes == 1
+        # prefix churn, same topology -> cache hit
+        ps.update_prefix(nodes[-2], "0", PrefixEntry(prefix="10.0.1.0/24"))
+        backend.build_route_db({"0": ls}, ps)
+        assert backend.num_encodes == 1
+        assert backend.num_encode_hits == 1
+        # topology change -> re-encode
+        dbs = build_adj_dbs(grid_edges(3))
+        for adj in dbs[nodes[0]].adjacencies:
+            adj.metric = 9
+        ls.update_adjacency_database(dbs[nodes[0]])
+        backend.build_route_db({"0": ls}, ps)
+        assert backend.num_encodes == 2
