@@ -53,7 +53,7 @@ def main() -> None:
 
     # ---- batched device sweep -------------------------------------------
     total = 10_240
-    chunk = 1_024
+    chunk = 2_048
     rng = np.random.default_rng(0)
     fails = rng.integers(0, len(topo.links), size=total).astype(np.int32)
 

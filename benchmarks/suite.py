@@ -96,9 +96,11 @@ def bench_decision_initial(results: List[Dict], full: bool) -> None:
             backend.build_route_db({"0": ls}, ps)  # warm (jit compile)
 
             def cold_build(b=backend):
-                # cold = no memoized SPF: that's what "initial update"
-                # measures in the reference harness
+                # cold = no memoized SPF and no cached topology encoding:
+                # that's what "initial update" measures in the reference
                 ls.clear_spf_memoization()
+                if hasattr(b, "_topo_cache"):
+                    b._topo_cache = {}
                 b.build_route_db({"0": ls}, ps)
 
             timings[name] = _best_of(cold_build)
@@ -157,7 +159,8 @@ def bench_decision_prefix_update(results: List[Dict], full: bool) -> None:
     from openr_tpu.types import PrefixEntry, PrefixMetrics
 
     batch = 1000 if full else 100
-    for name in ("scalar", "tpu"):
+    probe_nodes = sorted(_build_decision_problem(grid_edges(10), 0)[2])
+    for name in _make_backends(probe_nodes[0]):
         # fresh, identical problem per backend: churn must not accumulate
         # across backends/repeats or the comparison is apples-to-oranges
         ls, ps, nodes = _build_decision_problem(grid_edges(10), 10)

@@ -25,12 +25,17 @@
 
 extern "C" {
 
+// pad_node: node id used for padding edges' endpoints.  It must be the
+// HIGHEST padded node id so that after the encoder's dst-sort the padding
+// lands at the tail — root-out lane ranks (cumsum over src==root) would
+// otherwise be polluted for low-id roots.
 int csr_expand_fill(int32_t num_links,
                     const int32_t* a,
                     const int32_t* b,
                     const float* metric,
                     const uint8_t* ok,
                     int32_t padded_e,
+                    int32_t pad_node,
                     int32_t* src,
                     int32_t* dst,
                     float* w,
@@ -56,8 +61,8 @@ int csr_expand_fill(int32_t num_links,
     edge_ok[e + 1] = up;
   }
   for (int64_t e = E; e < padded_e; ++e) {
-    src[e] = 0;
-    dst[e] = 0;
+    src[e] = pad_node;
+    dst[e] = pad_node;
     w[e] = inf;
     edge_ok[e] = 0;
     link_index[e] = -1;
@@ -67,11 +72,14 @@ int csr_expand_fill(int32_t num_links,
 
 // Batched what-if expansion: for each snapshot s, failed_links[s*F..] lists
 // undirected link ids to fail (-1 = unused slot); writes mask[s][e] = 0 for
-// both directed edges of each failed link, 1 elsewhere.  One pass replaces
-// a Python loop over (snapshots x fails).
+// both directed edges of each failed link, 1 elsewhere.  link_edge_pos
+// ([num_links][2], from EncodedTopology) maps a link id to its directed
+// edges' positions in the dst-sorted layout.  One pass replaces a Python
+// loop over (snapshots x fails).
 int csr_failure_masks(int32_t num_snapshots,
                       int32_t fails_per_snapshot,
                       const int32_t* failed_links,
+                      const int32_t* link_edge_pos,
                       int32_t padded_e,
                       int32_t num_links,
                       uint8_t* mask) {
@@ -83,11 +91,10 @@ int csr_failure_masks(int32_t num_snapshots,
     for (int32_t f = 0; f < fails_per_snapshot; ++f) {
       const int32_t li = failed_links[(int64_t)s * fails_per_snapshot + f];
       if (li < 0 || li >= num_links) continue;
-      const int64_t e = 2 * (int64_t)li;
-      if (e + 1 < padded_e) {
-        row[e] = 0;
-        row[e + 1] = 0;
-      }
+      const int32_t e0 = link_edge_pos[2 * li];
+      const int32_t e1 = link_edge_pos[2 * li + 1];
+      if (e0 >= 0 && e0 < padded_e) row[e0] = 0;
+      if (e1 >= 0 && e1 < padded_e) row[e1] = 0;
     }
   }
   return 0;

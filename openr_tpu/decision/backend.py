@@ -105,7 +105,9 @@ class TpuBackend(DecisionBackend):
         if not link_state.has_node(me):
             return None
 
-        cache_key = (area, link_state.topology_seq)
+        # keyed on the instance too: a replaced LinkState for the same area
+        # could reach the same seq value and must not serve stale arrays
+        cache_key = (area, id(link_state), link_state.topology_seq)
         topo = self._topo_cache.get(cache_key)
         if topo is None:
             topo = encode_link_state(link_state, node_buckets=self.node_buckets)

@@ -82,6 +82,9 @@ class EncodedTopology:
     node_ids: Dict[str, int]
     id_to_node: List[str]
     links: List[Link]  # undirected link objects by link id
+    #: [L, 2] positions of each undirected link's two directed edges in
+    #: the (dst-sorted) edge arrays — what-if failure masks index this
+    link_edge_pos: np.ndarray
     num_nodes: int
     num_edges: int  # valid directed edges
 
@@ -169,6 +172,9 @@ def encode_link_state(
     edge_ok_u8 = np.empty(padded_e, np.uint8)
     link_index = np.empty(padded_e, np.int32)
 
+    # padding endpoints use the highest padded node id so the dst-sort
+    # below leaves padding at the tail (lane-rank correctness for root 0)
+    pad_node = padded_v - 1
     native = _get_native()
     if native is not None:
         rc = native.csr_expand_fill(
@@ -178,6 +184,7 @@ def encode_link_state(
             _np_ptr(col_m, ctypes.c_float),
             _np_ptr(col_ok, ctypes.c_uint8),
             padded_e,
+            pad_node,
             _np_ptr(src, ctypes.c_int32),
             _np_ptr(dst, ctypes.c_int32),
             _np_ptr(w, ctypes.c_float),
@@ -212,8 +219,8 @@ def encode_link_state(
         edge_ok_u8[1:E:2] = col_ok[:L]
         link_index[:E:2] = np.arange(L, dtype=np.int32)
         link_index[1:E:2] = np.arange(L, dtype=np.int32)
-        src[E:] = 0
-        dst[E:] = 0
+        src[E:] = pad_node
+        dst[E:] = pad_node
         w[E:] = INF
         edge_ok_u8[E:] = 0
         link_index[E:] = -1
@@ -227,6 +234,28 @@ def encode_link_state(
         overloaded[i] = link_state.is_node_overloaded(n)
         soft[i] = link_state.get_node_metric_increment(n)
 
+    # Canonical device layout: edges sorted by dst.  The SPF kernels'
+    # segment reductions then run with indices_are_sorted=True, which on
+    # TPU avoids general scatter in the relax step.  Padding edges carry
+    # src=dst=pad_node (the HIGHEST padded id, set above) so the stable
+    # sort leaves them at the tail — pads labeled 0 would sort to the
+    # front and pollute root-out lane ranks for low-id SPF roots.
+    order = np.argsort(dst, kind="stable")
+    src = src[order]
+    dst = dst[order]
+    w = w[order]
+    edge_ok = edge_ok[order]
+    link_index = link_index[order]
+    # positions of each link's two directed edges in the sorted layout:
+    # stable-argsort link_index groups pads (-1) first, then pairs per li
+    by_link = np.argsort(link_index, kind="stable")
+    pad_count = int((link_index < 0).sum())
+    link_edge_pos = (
+        by_link[pad_count:].reshape(L, 2).astype(np.int32)
+        if L
+        else np.zeros((0, 2), np.int32)
+    )
+
     return EncodedTopology(
         src=src,
         dst=dst,
@@ -239,6 +268,7 @@ def encode_link_state(
         node_ids=node_ids,
         id_to_node=names,
         links=links,
+        link_edge_pos=link_edge_pos,
         num_nodes=V,
         num_edges=E,
     )
@@ -329,10 +359,12 @@ def link_failure_batch(
             if failed:
                 flat[b, : len(failed)] = failed
         mask_u8 = np.empty((B, E), np.uint8)
+        pos = np.ascontiguousarray(topo.link_edge_pos, np.int32)
         rc = native.csr_failure_masks(
             B,
             flat.shape[1],
             _np_ptr(flat, ctypes.c_int32),
+            _np_ptr(pos, ctypes.c_int32),
             E,
             len(topo.links),
             _np_ptr(mask_u8, ctypes.c_uint8),

@@ -69,20 +69,19 @@ class PluginManager:
         self.register(getattr(module, attr))
 
     async def start_all(self, args: PluginArgs) -> None:
-        import asyncio
-
         for factory in self._factories:
             plugin = factory()
             try:
                 await plugin.start(args)
-            except asyncio.CancelledError:
-                # shutdown raced the start: the plugin may have opened
-                # resources before the cancel landed — stop it rather than
-                # strand it outside _active where stop_all can't see it
+            except BaseException:
+                # failed or cancelled mid-start: the plugin may have opened
+                # resources already — stop it rather than strand it outside
+                # _active where stop_all can't see it
                 try:
                     await plugin.stop()
-                finally:
-                    raise
+                except Exception:  # noqa: BLE001 - original error wins
+                    pass
+                raise
             self._active.append(plugin)
 
     async def stop_all(self) -> None:
