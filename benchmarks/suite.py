@@ -159,11 +159,14 @@ def bench_decision_prefix_update(results: List[Dict], full: bool) -> None:
     from openr_tpu.types import PrefixEntry, PrefixMetrics
 
     batch = 1000 if full else 100
-    probe_nodes = sorted(_build_decision_problem(grid_edges(10), 0)[2])
-    for name in _make_backends(probe_nodes[0]):
-        # fresh, identical problem per backend: churn must not accumulate
-        # across backends/repeats or the comparison is apples-to-oranges
-        ls, ps, nodes = _build_decision_problem(grid_edges(10), 10)
+    # fresh, identical problem per backend (churn must not accumulate
+    # across backends/repeats), with names driven by the backend registry
+    first = _build_decision_problem(grid_edges(10), 10)
+    names = list(_make_backends(first[2][0]))
+    problems = {names[0]: first}
+    for name in names[1:]:
+        problems[name] = _build_decision_problem(grid_edges(10), 10)
+    for name, (ls, ps, nodes) in problems.items():
         backend = _make_backends(nodes[0])[name]
         backend.build_route_db({"0": ls}, ps)
         toggle = [0]

@@ -1,0 +1,275 @@
+"""netns lab — real multi-node deployment on one machine.
+
+Reference parity: openr/orie/labs (orie_helper.sh + json2netns): every
+node is a Linux network namespace, links are veth pairs, and each
+namespace runs a REAL daemon (`python -m openr_tpu --real`): Spark
+discovers neighbors over actual IPv6 link-local UDP multicast on the
+veths, KvStore syncs over actual TCP to the neighbor's ctrl server, and
+Fib programs actual kernel routes (proto 99) into the namespace FIB via
+netlink.
+
+Requires CAP_NET_ADMIN (root).  Usage:
+
+    python -m labs.netns_lab up --topology line --nodes 3
+    python -m labs.netns_lab status
+    ip netns exec openr-lab-node0 ip route show proto 99
+    python -m labs.netns_lab down
+
+Programmatic use (tests): `NetnsLab(...)` as a context manager.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+NS_PREFIX = "openr-lab-"
+ROUTE_PROTO = "99"
+
+
+def sh(cmd: str, check: bool = True) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        shlex.split(cmd), check=check, capture_output=True, text=True
+    )
+
+
+def in_ns(ns: str, cmd: str, check: bool = True) -> subprocess.CompletedProcess:
+    return sh(f"ip netns exec {ns} {cmd}", check=check)
+
+
+def have_netns_caps() -> bool:
+    """Can we create/destroy namespaces + veths here?"""
+    probe = f"{NS_PREFIX}probe"
+    try:
+        sh(f"ip netns add {probe}")
+        sh(f"ip netns del {probe}")
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def topology_edges(kind: str, n: int) -> List[Tuple[int, int]]:
+    if kind == "line":
+        return [(i, i + 1) for i in range(n - 1)]
+    if kind == "ring":
+        return [(i, (i + 1) % n) for i in range(n)]
+    if kind == "full":
+        return [(i, j) for i in range(n) for j in range(i + 1, n)]
+    raise ValueError(f"unknown topology {kind!r}")
+
+
+@dataclass
+class NetnsLab:
+    num_nodes: int = 3
+    topology: str = "line"
+    ctrl_port: int = 2018  # same port in every namespace (isolated stacks)
+    work_dir: str = ""
+    fib_mode: str = "netlink"
+    procs: Dict[str, subprocess.Popen] = field(default_factory=dict)
+
+    def node_name(self, i: int) -> str:
+        return f"node{i}"
+
+    def ns_name(self, i: int) -> str:
+        return f"{NS_PREFIX}{self.node_name(i)}"
+
+    def originated_prefix(self, i: int) -> str:
+        return f"10.77.{i}.0/24"
+
+    # -- bring-up -----------------------------------------------------------
+
+    def up(self) -> None:
+        if not self.work_dir:
+            self.work_dir = tempfile.mkdtemp(prefix="openr_lab_")
+        for i in range(self.num_nodes):
+            # clear any leftover namespace from a crashed previous run
+            for pid in sh(
+                f"ip netns pids {self.ns_name(i)}", check=False
+            ).stdout.split():
+                sh(f"kill -9 {pid}", check=False)
+            sh(f"ip netns del {self.ns_name(i)}", check=False)
+            sh(f"ip netns add {self.ns_name(i)}")
+            in_ns(self.ns_name(i), "ip link set lo up")
+        for a, b in topology_edges(self.topology, self.num_nodes):
+            va, vb = f"ve{a}_{b}", f"ve{b}_{a}"
+            sh(f"ip link add {va} type veth peer name {vb}")
+            sh(f"ip link set {va} netns {self.ns_name(a)}")
+            sh(f"ip link set {vb} netns {self.ns_name(b)}")
+            in_ns(self.ns_name(a), f"ip link set {va} up")
+            in_ns(self.ns_name(b), f"ip link set {vb} up")
+        # let IPv6 link-local DAD settle before daemons bind multicast
+        time.sleep(1.0)
+        for i in range(self.num_nodes):
+            self.start_daemon(i)
+
+    def node_config(self, i: int) -> dict:
+        name = self.node_name(i)
+        return {
+            "node_name": name,
+            "openr_ctrl_port": self.ctrl_port,
+            "persistent_store_path": f"{self.work_dir}/{name}_store.bin",
+            "rib_policy_file": f"{self.work_dir}/{name}_rib_policy.bin",
+            "originated_prefixes": [
+                {"prefix": self.originated_prefix(i), "install_to_fib": False}
+            ],
+            # N daemons on one host must not contend for the one TPU chip;
+            # small-topology SPF is scalar-fast anyway (see benchmarks)
+            "tpu_compute_config": {"enable_tpu_spf": False},
+            # v6-only veils carrying v4 prefixes (RFC 5549)
+            "v4_over_v6_nexthop": True,
+        }
+
+    def start_daemon(self, i: int) -> None:
+        name = self.node_name(i)
+        cfg_path = f"{self.work_dir}/{name}.json"
+        with open(cfg_path, "w") as f:
+            json.dump(self.node_config(i), f)
+        log = open(f"{self.work_dir}/{name}.log", "w")
+        env = dict(os.environ)
+        # lab daemons must never touch the (single, possibly busy) TPU —
+        # any stray jax usage stays on CPU
+        env["JAX_PLATFORMS"] = "cpu"
+        self.procs[name] = subprocess.Popen(
+            [
+                "ip", "netns", "exec", self.ns_name(i),
+                sys.executable, "-m", "openr_tpu",
+                "--config", cfg_path, "--real", "--fib", self.fib_mode,
+            ],
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    def stop_daemon(self, i: int) -> None:
+        proc = self.procs.pop(self.node_name(i), None)
+        if proc is None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+
+    # -- observation ---------------------------------------------------------
+
+    def kernel_routes(self, i: int) -> List[str]:
+        out = in_ns(
+            self.ns_name(i), f"ip route show proto {ROUTE_PROTO}", check=False
+        ).stdout
+        return [line.strip() for line in out.splitlines() if line.strip()]
+
+    def breeze(self, i: int, *args: str) -> str:
+        cmd = (
+            f"{sys.executable} -m openr_tpu.cli.breeze "
+            f"--port {self.ctrl_port} " + " ".join(args)
+        )
+        return in_ns(self.ns_name(i), cmd, check=False).stdout
+
+    def converged(self) -> Tuple[bool, str]:
+        """Every node's kernel has a proto-99 route to every OTHER node's
+        originated prefix."""
+        for i in range(self.num_nodes):
+            routes = "\n".join(self.kernel_routes(i))
+            for j in range(self.num_nodes):
+                if i == j:
+                    continue
+                want = self.originated_prefix(j)
+                if want not in routes:
+                    return False, f"{self.node_name(i)} missing {want}"
+        return True, "all kernels programmed"
+
+    def wait_converged(self, timeout_s: float = 60.0) -> None:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            ok, why = self.converged()
+            if ok:
+                return
+            # surface a crashed daemon immediately instead of timing out
+            for name, proc in self.procs.items():
+                if proc.poll() is not None:
+                    log = open(f"{self.work_dir}/{name}.log").read()[-2000:]
+                    raise RuntimeError(f"daemon {name} died:\n{log}")
+            time.sleep(1.0)
+        ok, why = self.converged()
+        if not ok:
+            raise TimeoutError(f"lab did not converge: {why}")
+
+    # -- teardown ------------------------------------------------------------
+
+    def down(self) -> None:
+        for i in range(self.num_nodes):
+            self.stop_daemon(i)
+        for i in range(self.num_nodes):
+            sh(f"ip netns del {self.ns_name(i)}", check=False)
+
+    def __enter__(self) -> "NetnsLab":
+        self.up()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.down()
+
+
+def existing_lab_namespaces() -> List[str]:
+    out = sh("ip netns list", check=False).stdout
+    return [
+        line.split()[0]
+        for line in out.splitlines()
+        if line.startswith(NS_PREFIX) and "probe" not in line
+    ]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    up = sub.add_parser("up")
+    up.add_argument("--nodes", type=int, default=3)
+    up.add_argument("--topology", default="line",
+                    choices=["line", "ring", "full"])
+    up.add_argument("--fib", default="netlink")
+    sub.add_parser("down")
+    sub.add_parser("status")
+    args = p.parse_args()
+
+    if args.cmd == "up":
+        lab = NetnsLab(
+            num_nodes=args.nodes, topology=args.topology, fib_mode=args.fib
+        )
+        lab.up()
+        print(f"lab up: {args.nodes} nodes ({args.topology}), "
+              f"work dir {lab.work_dir}")
+        print("waiting for kernel-route convergence...")
+        lab.wait_converged()
+        print("converged; namespaces stay up (down with: "
+              "python -m labs.netns_lab down)")
+    elif args.cmd == "down":
+        namespaces = existing_lab_namespaces()
+        for ns in namespaces:
+            for pid in sh(f"ip netns pids {ns}", check=False).stdout.split():
+                sh(f"kill {pid}", check=False)
+            sh(f"ip netns del {ns}", check=False)
+        print(f"removed {len(namespaces)} namespaces")
+    elif args.cmd == "status":
+        for ns in existing_lab_namespaces():
+            routes = sh(
+                f"ip netns exec {ns} ip route show proto {ROUTE_PROTO}",
+                check=False,
+            ).stdout.strip()
+            print(f"{ns}:")
+            for line in routes.splitlines():
+                print(f"  {line}")
+
+
+if __name__ == "__main__":
+    main()

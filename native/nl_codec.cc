@@ -199,7 +199,11 @@ void put_nexthop_attrs(Writer& w, const OnlRoute& r, const OnlNexthop& nh) {
       w.put_attr(MPLS_IPTUNNEL_DST, stack, n);
       w.end_nest(nest);
     }
-    if (nh.family) {
+    if (nh.family && nh.family != r.family) {
+      // cross-family gateway (RFC 5549: v4 route via v6 nexthop) rides
+      // RTA_VIA; same-family uses the classic RTA_GATEWAY
+      put_via(w, nh);
+    } else if (nh.family) {
       w.put_attr(RTA_GATEWAY, nh.gateway, addr_len(nh.family));
     }
   }

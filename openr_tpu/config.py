@@ -69,6 +69,11 @@ class LinkMonitorConfig:
     linkflap_max_backoff_ms: int = 300_000
     use_rtt_metric: bool = True
     enable_perf_measurement: bool = True
+    #: which kernel interfaces participate in routing (regex full-match;
+    #: exclusion wins).  The reference scopes these per-area; the
+    #: LinkMonitor-level filter here is the tracking gate
+    include_interface_regexes: List[str] = field(default_factory=lambda: [".*"])
+    exclude_interface_regexes: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -180,6 +185,10 @@ class OpenrConfig:
     openr_ctrl_port: int = C.OPENR_CTRL_PORT
     dryrun: bool = False
     enable_v4: bool = True
+    #: RFC 5549: program IPv4 routes with IPv6 link-local nexthops
+    #: (OpenrConfig.thrift v4_over_v6_nexthop) — the deployment shape for
+    #: v6-only fabrics carrying v4 prefixes
+    v4_over_v6_nexthop: bool = False
     enable_netlink_fib_handler: bool = False
     prefix_forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
     prefix_forwarding_algorithm: PrefixForwardingAlgorithm = (

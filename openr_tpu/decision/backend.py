@@ -105,16 +105,18 @@ class TpuBackend(DecisionBackend):
         if not link_state.has_node(me):
             return None
 
-        # keyed on the instance too: a replaced LinkState for the same area
-        # could reach the same seq value and must not serve stale arrays
-        cache_key = (area, id(link_state), link_state.topology_seq)
-        topo = self._topo_cache.get(cache_key)
-        if topo is None:
-            topo = encode_link_state(link_state, node_buckets=self.node_buckets)
-            self._topo_cache = {cache_key: topo}  # one live graph per area
-            self.num_encodes += 1
-        else:
+        # the cache value pins the LinkState object itself: identity must be
+        # compared via a held reference (a bare id() could be reused by a
+        # replacement object after GC and serve stale arrays)
+        cache_key = (area, link_state.topology_seq)
+        cached = self._topo_cache.get(cache_key)
+        if cached is not None and cached[0] is link_state:
+            topo = cached[1]
             self.num_encode_hits += 1
+        else:
+            topo = encode_link_state(link_state, node_buckets=self.node_buckets)
+            self._topo_cache = {cache_key: (link_state, topo)}
+            self.num_encodes += 1
         if me not in topo.node_ids:
             return None
         cands = encode_prefix_candidates(

@@ -111,6 +111,16 @@ class LinkMonitor(Actor):
         self.serialize_adj_db = serialize_adj_db or (
             lambda db: __import__("json").dumps(db.to_wire()).encode()
         )
+        import re as _re
+
+        self._include_if_res = [
+            _re.compile(p)
+            for p in getattr(config, "include_interface_regexes", [".*"])
+        ]
+        self._exclude_if_res = [
+            _re.compile(p)
+            for p in getattr(config, "exclude_interface_regexes", [])
+        ]
         self.interfaces: Dict[str, InterfaceEntry] = {}
         #: (area, neighbor, local_if) -> AdjacencyEntry
         self.adjacencies: Dict[Tuple[str, str, str], AdjacencyEntry] = {}
@@ -165,7 +175,17 @@ class LinkMonitor(Actor):
         self._apply_interface(info)
         self._advertise_ifaces_throttle()
 
+    def _interface_allowed(self, if_name: str) -> bool:
+        """Config regex gate (OpenrConfig.thrift include/exclude interface
+        regexes): exclusion wins, then inclusion must match."""
+        for pat in self._exclude_if_res:
+            if pat.fullmatch(if_name):
+                return False
+        return any(pat.fullmatch(if_name) for pat in self._include_if_res)
+
     def _apply_interface(self, info: InterfaceInfo) -> None:
+        if not self._interface_allowed(info.if_name):
+            return
         entry = self.interfaces.get(info.if_name)
         if entry is None:
             entry = InterfaceEntry(

@@ -260,6 +260,7 @@ class OpenrNode:
             self.name,
             enable_v4=config.enable_v4,
             enable_node_segment_label=sr.enable_sr_mpls,
+            v4_over_v6_nexthop=config.v4_over_v6_nexthop,
             route_selection_algorithm=config.route_computation_rules,
         )
         use_tpu = (

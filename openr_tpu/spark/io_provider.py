@@ -19,6 +19,7 @@ import asyncio
 import json
 import socket as pysocket
 import struct
+import sys
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
 from openr_tpu.common.runtime import Actor, Clock
@@ -178,6 +179,13 @@ class UdpIoProvider(IoProvider):
         )
         sock.setsockopt(pysocket.IPPROTO_IPV6, pysocket.IPV6_MULTICAST_HOPS, 1)
         sock.setsockopt(pysocket.IPPROTO_IPV6, pysocket.IPV6_MULTICAST_LOOP, 0)
+        # arrival-interface info: with several sockets joined to ff02::1 on
+        # different interfaces of one node, the kernel delivers a copy to
+        # EACH member socket — without filtering by the packet's actual
+        # arrival interface a hello from iface A would also surface "on"
+        # iface B, fabricating a bogus adjacency there (IoProvider.cpp
+        # uses IPV6_RECVPKTINFO for exactly this)
+        sock.setsockopt(pysocket.IPPROTO_IPV6, pysocket.IPV6_RECVPKTINFO, 1)
         self._socks[if_name] = (sock, if_index)
         asyncio.get_running_loop().add_reader(
             sock.fileno(), self._on_readable, if_name, sock
@@ -210,13 +218,27 @@ class UdpIoProvider(IoProvider):
 
     def _on_readable(self, if_name: str, sock: pysocket.socket) -> None:
         loop = asyncio.get_event_loop()
+        entry = self._socks.get(if_name)
+        my_index = entry[1] if entry else -1
         while True:
             try:
-                data, _addr = sock.recvfrom(65536)
+                data, ancdata, _flags, _addr = sock.recvmsg(65536, 64)
             except (BlockingIOError, InterruptedError):
                 return
             except OSError:
                 return
+            # drop copies of packets that actually arrived on a different
+            # interface (in6_pktinfo: 16B dst addr + 4B ifindex)
+            arrival = my_index
+            for level, ctype, cdata in ancdata:
+                if (
+                    level == pysocket.IPPROTO_IPV6
+                    and ctype == getattr(pysocket, "IPV6_PKTINFO", 50)
+                    and len(cdata) >= 20
+                ):
+                    arrival = int.from_bytes(cdata[16:20], sys.byteorder)
+            if arrival != my_index:
+                continue
             try:
                 payload = json.loads(data)
             except (UnicodeDecodeError, json.JSONDecodeError):

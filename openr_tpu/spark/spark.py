@@ -295,6 +295,12 @@ class Spark(Actor):
         for if_name, info in db.interfaces.items():
             if not info.is_up:
                 continue
+            if info.v6_link_local() is None:
+                # hellos are sourced from the interface's fe80:: address
+                # (Spark.h:450 mcast semantics); an interface without one
+                # (e.g. loopback) can't run the protocol — tracking it
+                # would fabricate adjacencies from looped-back packets
+                continue
             up_now.add(if_name)
             if if_name not in self.interfaces:
                 # real-network providers open a socket per tracked interface
