@@ -241,6 +241,11 @@ class LinkState:
         #: rebuilds skip topology re-encoding entirely
         self.topology_seq = 0
         self._all_links_cache: Optional[Tuple[int, List[Link]]] = None
+        #: per-node sorted adjacency, invalidated structurally on
+        #: add/remove — run_spf iterates it so path_links order (and thus
+        #: the greedy KSP2 trace) is deterministic across runs, which the
+        #: device-backed k-path reconstruction reproduces exactly
+        self._ordered_links_cache: Dict[str, List[Link]] = {}
 
     # -- introspection -----------------------------------------------------
 
@@ -284,7 +289,11 @@ class LinkState:
         return links
 
     def ordered_links_from_node(self, node: str) -> List[Link]:
-        return sorted(self._link_map.get(node, set()))
+        cached = self._ordered_links_cache.get(node)
+        if cached is None:
+            cached = sorted(self._link_map.get(node, set()))
+            self._ordered_links_cache[node] = cached
+        return cached
 
     # -- link construction (LinkState.cpp:407-438) -------------------------
 
@@ -321,14 +330,18 @@ class LinkState:
         self._link_map.setdefault(link.n2, set()).add(link)
         self._all_links.add(link)
         # a DOWN link joining/leaving doesn't set topology_changed (no SPF
-        # impact), so invalidate the ordered-list cache structurally
+        # impact), so invalidate the ordered-list caches structurally
         self._all_links_cache = None
+        self._ordered_links_cache.pop(link.n1, None)
+        self._ordered_links_cache.pop(link.n2, None)
 
     def _remove_link(self, link: Link) -> None:
         self._link_map.get(link.n1, set()).discard(link)
         self._link_map.get(link.n2, set()).discard(link)
         self._all_links.discard(link)
         self._all_links_cache = None
+        self._ordered_links_cache.pop(link.n1, None)
+        self._ordered_links_cache.pop(link.n2, None)
 
     def _update_node_overloaded(self, node: str, overloaded: bool) -> bool:
         prior = self._node_overloads.get(node)
@@ -480,7 +493,7 @@ class LinkState:
             if self.is_node_overloaded(name) and name != root:
                 continue
 
-            for link in self.links_from_node(name):
+            for link in self.ordered_links_from_node(name):
                 other = link.get_other_node_name(name)
                 if (not link.is_up()) or other in result or link in links_to_ignore:
                     continue
@@ -518,6 +531,20 @@ class LinkState:
         return None
 
     # -- k-shortest edge-disjoint paths (LinkState.cpp:653-703) ------------
+
+    def has_kth_paths(self, src: str, dest: str, k: int) -> bool:
+        return (src, dest, k) in self._kth_path_results
+
+    def seed_kth_paths(
+        self, src: str, dest: str, k: int, paths: List[Path]
+    ) -> None:
+        """Install externally-computed k-th paths into the memo (invalidated
+        on topology change like every memoized result).  Used by the device
+        backend: the expensive masked re-solves run batched on the TPU and
+        the traced paths are seeded here, so ``get_kth_paths`` — and thus
+        the whole scalar KSP2 selection chain — never runs host Dijkstra.
+        """
+        self._kth_path_results[(src, dest, k)] = paths
 
     def get_kth_paths(self, src: str, dest: str, k: int) -> List[Path]:
         assert k >= 1

@@ -300,12 +300,31 @@ def encode_prefix_candidates(
     prefix_state,
     topo: EncodedTopology,
     area: str,
-    max_candidates: int = 8,
+    max_candidates: Optional[int] = None,
+    cand_buckets: Sequence[int] = (8, 16, 32, 64),
 ) -> EncodedPrefixCandidates:
-    """Flatten PrefixState (for one area) into padded candidate arrays."""
+    """Flatten PrefixState (for one area) into padded candidate arrays.
+
+    The candidate axis is padded to the smallest bucket in `cand_buckets`
+    that fits the widest prefix (anycast prefixes advertised by many
+    nodes), so the jit cache stays warm while wide prefixes still get the
+    device path; `max_candidates` pins the width explicitly instead.
+    Raises ValueError past the largest bucket (caller falls back scalar).
+    """
     prefixes = sorted(prefix_state.prefixes().keys())
     P = max(len(prefixes), 1)
-    C = max_candidates
+    if max_candidates is not None:
+        C = max_candidates
+    else:
+        widest = 1
+        for prefix in prefixes:
+            n = sum(
+                1
+                for (node, parea) in prefix_state.prefixes()[prefix]
+                if parea == area and node in topo.node_ids
+            )
+            widest = max(widest, n)
+        C = bucket_for(widest, cand_buckets)
     cand_node = np.zeros((P, C), np.int32)
     cand_ok = np.zeros((P, C), bool)
     drain = np.zeros((P, C), np.int32)

@@ -220,6 +220,27 @@ def batched_spf(
     return jax.vmap(one)(edge_enabled, overloaded, roots)
 
 
+@jax.jit
+def batched_spf_distances_masked(
+    src,  # [E] shared edge list
+    dst,  # [E]
+    w,  # [E]
+    edge_ok,  # [E]
+    edge_enabled,  # [B, E] per-snapshot mask
+    overloaded,  # [V] shared hard-drain bits
+    roots,  # [B]
+):
+    """Distances-only what-if batch (no nexthop-lane propagation) — the
+    KSP2 masked re-solve fan-out (LinkState.cpp:675-699: run SPF ignoring
+    links used by paths 1..k-1, one masked solve per destination).  The
+    host traces the actual k-th paths from these distance fields."""
+
+    def one(edge_en, root):
+        return spf_distances(src, dst, w, edge_ok & edge_en, overloaded, root)
+
+    return jax.vmap(one)(edge_enabled, roots)
+
+
 @functools.partial(jax.jit, static_argnames=("max_degree",))
 def batched_spf_distinct(
     src,  # [B, E] per-snapshot edge lists
