@@ -99,8 +99,8 @@ def bench_decision_initial(results: List[Dict], full: bool) -> None:
                 # cold = no memoized SPF and no cached topology encoding:
                 # that's what "initial update" measures in the reference
                 ls.clear_spf_memoization()
-                if hasattr(b, "_topo_cache"):
-                    b._topo_cache = {}
+                if hasattr(b, "_enc_cache"):
+                    b._enc_cache = {}
                 b.build_route_db({"0": ls}, ps)
 
             timings[name] = _best_of(cold_build)
@@ -194,6 +194,122 @@ def bench_decision_prefix_update(results: List[Dict], full: bool) -> None:
                 nodes=100, prefixes_churned=batch,
             )
         )
+
+
+def bench_parity_device_coverage(results: List[Dict], full: bool) -> None:
+    """BASELINE parity configs: every one must run the device path with
+    ZERO scalar fallbacks (num_scalar_builds == 0), and match the scalar
+    oracle.  The 5th config (10k what-if sweep) is bench.py's headline."""
+    from openr_tpu.decision.backend import ScalarBackend, TpuBackend
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.decision.rib_policy import (
+        RibPolicy,
+        RibPolicyStatement,
+        RibRouteActionWeight,
+    )
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.emulation.topology import (
+        build_adj_dbs,
+        fabric_edges,
+        grid_edges,
+        ring_edges,
+    )
+    from openr_tpu.types import (
+        PrefixEntry,
+        PrefixForwardingAlgorithm,
+        PrefixForwardingType,
+    )
+
+    def mk_ls(edges, area="0", **kw):
+        ls = LinkState(area)
+        for db in build_adj_dbs(edges, area=area, **kw).values():
+            ls.update_adjacency_database(db)
+        return ls
+
+    def cfg_grid16():
+        als = {"0": mk_ls(grid_edges(4))}
+        ps = PrefixState()
+        for i in range(16):
+            ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.0.{i}.0/24"))
+        return "grid16_shortest_distance", als, ps, "node0", {}
+
+    def cfg_ksp2_fabric():
+        edges = fabric_edges(num_pods=3, rsws_per_pod=4, fsws_per_pod=2,
+                             num_ssws=4)
+        als = {"0": mk_ls(edges)}
+        ps = PrefixState()
+        rsws = sorted(n for e in edges for n in e[:2] if n.startswith("rsw"))
+        for i, n in enumerate(dict.fromkeys(rsws)):
+            ps.update_prefix(n, "0", PrefixEntry(
+                f"10.{i}.0.0/24",
+                forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP))
+        return "ksp2_fabric", als, ps, "rsw0_0", {}
+
+    def cfg_multiarea_ribpolicy():
+        als = {
+            "1": mk_ls(grid_edges(3), "1"),
+            "2": mk_ls(ring_edges(6, prefix="b") + [("b0", "node0", 1)], "2"),
+        }
+        ps = PrefixState()
+        ps.update_prefix("node8", "1", PrefixEntry("10.0.0.0/24"))
+        ps.update_prefix("b3", "2", PrefixEntry("10.0.0.0/24"))
+        ps.update_prefix("b4", "2", PrefixEntry("10.1.0.0/24"))
+        policy = RibPolicy(
+            statements=[RibPolicyStatement(
+                name="prefer-area1",
+                prefixes=["10.0.0.0/24"],
+                action=RibRouteActionWeight(
+                    default_weight=1, area_to_weight={"1": 2}),
+            )],
+            valid_until=300.0,
+        )
+        return "multiarea_ribpolicy", als, ps, "node0", {"policy": policy}
+
+    def cfg_sr_mpls():
+        edges = fabric_edges(num_pods=2, rsws_per_pod=3, fsws_per_pod=2,
+                             num_ssws=2)
+        nodes = sorted({n for e in edges for n in e[:2]})
+        labels = {n: 100 + i for i, n in enumerate(nodes)}
+        als = {"0": mk_ls(edges, node_labels=labels)}
+        ps = PrefixState()
+        ps.update_prefix("rsw1_2", "0", PrefixEntry(
+            "2001:db8::/64",
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP))
+        return (
+            "sr_mpls_labels", als, ps, "rsw0_0",
+            {"solver_kwargs": {"enable_node_segment_label": True}},
+        )
+
+    all_on_device = True
+    for cfg in (cfg_grid16, cfg_ksp2_fabric, cfg_multiarea_ribpolicy,
+                cfg_sr_mpls):
+        name, als, ps, me, extra = cfg()
+        skw = extra.get("solver_kwargs", {})
+        backend = TpuBackend(SpfSolver(me, **skw))
+        db = backend.build_route_db(als, ps)
+        ref = ScalarBackend(SpfSolver(me, **skw)).build_route_db(als, ps)
+        policy = extra.get("policy")
+        if policy is not None:
+            clock = SimClock()
+            for d in (db, ref):
+                assert policy.apply_policy(d, clock) > 0
+        from openr_tpu.decision.rib import route_db_summary
+
+        match = route_db_summary(db) == route_db_summary(ref)
+        on_device = backend.num_scalar_builds == 0 and match
+        all_on_device &= on_device
+        results.append(_result(
+            f"parity_{name}_on_device", 1.0 if on_device else 0.0, "bool",
+            scalar_builds=backend.num_scalar_builds,
+            device_builds=backend.num_device_builds,
+            matches_oracle=match,
+        ))
+    results.append(_result(
+        "parity_configs_device_coverage", 1.0 if all_on_device else 0.0,
+        "fraction"))
 
 
 # ---------------------------------------------------------------------------
@@ -465,6 +581,7 @@ ALL_BENCHES = [
     bench_decision_initial,
     bench_decision_adj_update,
     bench_decision_prefix_update,
+    bench_parity_device_coverage,
     bench_kvstore_persist,
     bench_kvstore_flood_convergence,
     bench_fib_programming,

@@ -70,6 +70,51 @@ def sorted_nexthops(nhs) -> List[NextHop]:
     )
 
 
+def _nexthop_summary(nh: NextHop):
+    return (
+        nh.neighbor_node_name,
+        nh.if_name,
+        nh.address,
+        nh.metric,
+        nh.weight,
+        nh.area,
+        None
+        if nh.mpls_action is None
+        else (
+            nh.mpls_action.action,
+            nh.mpls_action.swap_label,
+            nh.mpls_action.push_labels,
+        ),
+    )
+
+
+def route_db_summary(db):
+    """Canonical comparable view of a full RouteDb — unicast AND MPLS
+    routes with every field that affects forwarding (nexthop addresses,
+    metrics, weights, label actions, igp cost, best area).  Differential
+    tests and the parity benches compare THIS, so a device-path
+    regression in any dimension fails loudly."""
+    if db is None:
+        return None
+    return {
+        "unicast": {
+            p: (
+                round(e.igp_cost, 3),
+                e.best_area,
+                e.best_prefix_entry.metrics.drain_metric
+                if e.best_prefix_entry is not None
+                else None,
+                sorted(_nexthop_summary(nh) for nh in e.nexthops),
+            )
+            for p, e in db.unicast_routes.items()
+        },
+        "mpls": {
+            label: sorted(_nexthop_summary(nh) for nh in e.nexthops)
+            for label, e in db.mpls_routes.items()
+        },
+    }
+
+
 @dataclass
 class DecisionRouteDb:
     """Full RIB keyed by prefix / label (RouteUpdate.h DecisionRouteDb)."""

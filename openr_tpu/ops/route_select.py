@@ -139,6 +139,121 @@ def batched_select_routes(
     return jax.vmap(one)(dist, nh, overloaded, soft, roots)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("max_degree", "per_area_distance")
+)
+def multi_area_spf_and_select(
+    src,  # [A, E] per-area edge lists (padded to common buckets)
+    dst,  # [A, E]
+    w,  # [A, E]
+    edge_ok,  # [A, E]
+    overloaded,  # [A, V]
+    soft,  # [A, V]
+    roots,  # [A] my node id in each area (always present: the encoder
+    #         interns `me` into every area's symbol table)
+    cand_area,  # [P, C] int32 area index of each candidate advertisement
+    cand_node,  # [P, C] int32 node id in the candidate's OWN area
+    cand_ok,  # [P, C] bool
+    drain_metric,  # [P, C] int32
+    path_pref,  # [P, C] int32
+    source_pref,  # [P, C] int32
+    distance,  # [P, C] int32
+    cand_node_in_area,  # [P, C, A] int32: candidate's node NAME resolved
+    #                     in each area's symbol table (-1 = absent) — the
+    #                     per-area nexthop computation looks winners up in
+    #                     every area, matching getNextHopsWithMetric
+    max_degree: int,
+    per_area_distance: bool,  # PER_AREA_SHORTEST_DISTANCE algorithm
+):
+    """Multi-area buildRouteDb hot loop: area = a batch dim for SPF
+    (Decision.cpp:762-773 runs per-area SPF independently), selection is
+    GLOBAL across areas (SpfSolver.cpp:456-495), per-area ECMP lane sets
+    come back separately so the host can do the cross-area min-metric
+    merge (SpfSolver.cpp:276-302) in the per-area lane→Link decode.
+
+    Returns (use [P, C], shortest [P, A], lanes [P, A, D], valid [P, A]).
+    """
+    from openr_tpu.ops.spf import spf_one
+
+    A = src.shape[0]
+
+    # 1. per-area SPF from me (vmap over distinct graphs)
+    def one_area_spf(s, d, ww, eo, ovl, root):
+        return spf_one(s, d, ww, eo, ovl, root, max_degree)
+
+    dist, nh = jax.vmap(one_area_spf)(src, dst, w, edge_ok, overloaded, roots)
+
+    # 2. global best-route selection chain (LsdbUtil.cpp:761-823)
+    cdist_own = dist[cand_area, cand_node]  # [P, C] metric in own area
+    reach = cand_ok & (cdist_own < BIG)
+    hard = overloaded[cand_area, cand_node]
+    nonhard = reach & ~hard
+    any_nonhard = jnp.any(nonhard, axis=1, keepdims=True)
+    use = jnp.where(any_nonhard, nonhard, reach)
+    drained = (drain_metric > 0) | (soft[cand_area, cand_node] > 0)
+    not_drained = (~drained).astype(jnp.int32)
+
+    def keep_max(mask, key):
+        best = jnp.max(jnp.where(mask, key, I32_MIN), axis=1, keepdims=True)
+        return mask & (key == best)
+
+    use = keep_max(use, not_drained)
+    use = keep_max(use, path_pref)
+    use = keep_max(use, source_pref)
+    if per_area_distance:
+        # min distance within each area's surviving candidates
+        same = cand_area[:, :, None] == cand_area[:, None, :]  # [P, C, C]
+        key = jnp.where(
+            use[:, None, :] & same, distance[:, None, :], I32_MAX
+        )
+        best_in_area = jnp.min(key, axis=2)  # [P, C]
+        use = use & (distance == best_in_area)
+    else:
+        best = jnp.min(
+            jnp.where(use, distance, I32_MAX), axis=1, keepdims=True
+        )
+        use = use & (distance == best)
+
+    # 3. per-area nexthop lane sets over the winner node names — but ONLY
+    # in areas that contain a winner ADVERTISEMENT (areas_with_best,
+    # SpfSolver.cpp:276-283); a border node resolvable in another area's
+    # graph must not drag that area into the merge
+    area_ids = jnp.arange(A, dtype=cand_area.dtype)
+    area_has_winner = jnp.any(
+        use[:, :, None] & (cand_area[:, :, None] == area_ids[None, None, :]),
+        axis=1,
+    )  # [P, A]
+    cnia_ok = cand_node_in_area >= 0  # [P, C, A]
+    cnia = jnp.maximum(cand_node_in_area, 0)
+    ddist = dist[jnp.arange(A)[None, None, :], cnia]  # [P, C, A]
+    dmask = (
+        use[:, :, None]
+        & cnia_ok
+        & (ddist < BIG)
+        & area_has_winner[:, None, :]
+    )
+    shortest = jnp.min(jnp.where(dmask, ddist, BIG), axis=1)  # [P, A]
+    mc = dmask & (ddist == shortest[:, None, :])  # [P, C, A] min-cost dsts
+
+    def one_area_lanes(nh_a, cnia_a, mc_a):
+        # union of min-cost winners' first-hop lanes; the einsum rides the
+        # MXU instead of a [P, C, D] select+max
+        nh_g = nh_a[cnia_a]  # [P, C, D]
+        hits = jnp.einsum(
+            "pc,pcd->pd",
+            mc_a.astype(jnp.float32),
+            nh_g.astype(jnp.float32),
+        )
+        return hits > 0
+
+    lanes = jax.vmap(one_area_lanes, in_axes=(0, 2, 2), out_axes=1)(
+        nh, cnia, mc
+    )  # [P, A, D]
+    num_nh = jnp.sum(lanes.astype(jnp.int32), axis=2)  # [P, A]
+    valid = jnp.any(mc, axis=1) & (num_nh > 0)  # [P, A]
+    return use, shortest, lanes, valid
+
+
 @functools.partial(jax.jit, static_argnames=("max_degree",))
 def spf_and_select(
     src,

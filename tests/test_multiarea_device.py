@@ -1,0 +1,337 @@
+"""Multi-area device-path differential tests.
+
+Areas are a batch dim for SPF on device (Decision.cpp:762-773); selection
+is global across areas; the cross-area min-metric nexthop merge
+(SpfSolver.cpp:276-302) happens during host lane decode.  TpuBackend must
+match ScalarBackend bit-for-bit on every multi-area config.
+"""
+
+import pytest
+
+from openr_tpu.decision.backend import ScalarBackend, TpuBackend
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.topology import (
+    build_adj_dbs,
+    fabric_edges,
+    grid_edges,
+    line_edges,
+    random_connected_edges,
+    ring_edges,
+)
+from openr_tpu.types import (
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixMetrics,
+    RouteComputationRules,
+)
+
+KSP2 = PrefixForwardingAlgorithm.KSP2_ED_ECMP
+
+
+def make_ls(edges, area, me="", **kwargs) -> LinkState:
+    ls = LinkState(area, me)
+    for db in build_adj_dbs(edges, area=area, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def _nh_view(entry):
+    return sorted(
+        (
+            nh.neighbor_node_name,
+            nh.if_name,
+            nh.metric,
+            nh.area,
+            None
+            if nh.mpls_action is None
+            else (nh.mpls_action.action, nh.mpls_action.push_labels),
+        )
+        for nh in entry.nexthops
+    )
+
+
+def _db_view(db):
+    if db is None:
+        return None
+    return {
+        p: (
+            round(e.igp_cost, 1),
+            e.best_area,
+            e.best_prefix_entry.metrics.drain_metric,
+            _nh_view(e),
+        )
+        for p, e in db.unicast_routes.items()
+    }
+
+
+def assert_match(mk_areas, ps, me, expect_device=True, **solver_kwargs):
+    """mk_areas: zero-arg factory returning fresh {area: LinkState}."""
+    scalar = ScalarBackend(SpfSolver(me, **solver_kwargs)).build_route_db(
+        mk_areas(), ps
+    )
+    backend = TpuBackend(SpfSolver(me, **solver_kwargs))
+    tpu = backend.build_route_db(mk_areas(), ps)
+    assert _db_view(tpu) == _db_view(scalar)
+    if expect_device:
+        assert backend.num_scalar_builds == 0
+        assert backend.num_device_builds == 1
+    return backend, tpu
+
+
+def two_area_factory(me="b0"):
+    """Area 1: line a0-a1-b0; area 2: ring b0-b1-b2-b3; b0 borders both."""
+
+    def mk():
+        return {
+            "1": make_ls(
+                [("a0", "a1", 1), ("a1", "b0", 1)], "1", me=me
+            ),
+            "2": make_ls(ring_edges(4, prefix="b"), "2", me=me),
+        }
+
+    return mk
+
+
+def test_two_areas_basic_differential():
+    ps = PrefixState()
+    ps.update_prefix("a0", "1", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("b2", "2", PrefixEntry("10.1.0.0/24"))
+    ps.update_prefix("b1", "2", PrefixEntry("2001:db8::/64"))
+    backend, tpu = assert_match(two_area_factory(), ps, me="b0")
+    assert "10.0.0.0/24" in tpu.unicast_routes
+    assert tpu.unicast_routes["10.0.0.0/24"].best_area == "1"
+
+
+def test_cross_area_same_prefix_min_metric_merge():
+    # the same prefix advertised in both areas: winner set spans areas and
+    # nexthops merge at the min IGP metric (SpfSolver.cpp:276-302)
+    ps = PrefixState()
+    ps.update_prefix("a1", "1", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("b1", "2", PrefixEntry("10.0.0.0/24"))
+    backend, tpu = assert_match(two_area_factory(), ps, me="b0")
+    route = tpu.unicast_routes["10.0.0.0/24"]
+    assert route.igp_cost == 1.0
+
+
+def test_cross_area_equal_metric_union():
+    # equal distance in both areas -> union of both areas' nexthops
+    def mk():
+        return {
+            "1": make_ls(line_edges(2, prefix="x"), "1", me="x0"),
+            "2": make_ls(line_edges(2, prefix="y"), "2", me="x0"),
+        }
+
+    # me = x0 is only in area 1; put it in area 2 too via a shared node
+    def mk2():
+        return {
+            "1": make_ls([("me", "p", 1)], "1", me="me"),
+            "2": make_ls([("me", "q", 1)], "2", me="me"),
+        }
+
+    ps = PrefixState()
+    ps.update_prefix("p", "1", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("q", "2", PrefixEntry("10.0.0.0/24"))
+    backend, tpu = assert_match(mk2, ps, me="me")
+    route = tpu.unicast_routes["10.0.0.0/24"]
+    assert {nh.area for nh in route.nexthops} == {"1", "2"}
+
+
+def test_per_area_shortest_distance_algorithm():
+    ps = PrefixState()
+    # different distance metrics: PER_AREA keeps each area's min
+    ps.update_prefix(
+        "a0", "1", PrefixEntry("10.0.0.0/24", metrics=PrefixMetrics(distance=5))
+    )
+    ps.update_prefix(
+        "a1", "1", PrefixEntry("10.0.0.0/24", metrics=PrefixMetrics(distance=3))
+    )
+    ps.update_prefix(
+        "b2", "2", PrefixEntry("10.0.0.0/24", metrics=PrefixMetrics(distance=9))
+    )
+    assert_match(
+        two_area_factory(),
+        ps,
+        me="b0",
+        route_selection_algorithm=(
+            RouteComputationRules.PER_AREA_SHORTEST_DISTANCE
+        ),
+    )
+
+
+def test_me_absent_from_one_area():
+    # I'm only in area 1; area 2 prefixes are unreachable for me
+    def mk():
+        return {
+            "1": make_ls(line_edges(3), "1", me="node0"),
+            "2": make_ls(ring_edges(3, prefix="z"), "2", me="node0"),
+        }
+
+    ps = PrefixState()
+    ps.update_prefix("node2", "1", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("z1", "2", PrefixEntry("10.1.0.0/24"))
+    backend, tpu = assert_match(mk, ps, me="node0")
+    assert "10.0.0.0/24" in tpu.unicast_routes
+    assert "10.1.0.0/24" not in tpu.unicast_routes
+
+
+def test_self_advertisement_in_isolated_area_suppresses_route():
+    """I advertise the prefix in an area where I have no adjacencies: the
+    self-advertisement still wins selection (metric 0 to myself) and
+    suppresses programming — scalar get_spf_result semantics preserved by
+    interning me into every area's symbol table."""
+
+    def mk():
+        return {
+            "1": make_ls(line_edges(3), "1", me="node0"),
+            # area 2 graph doesn't contain node0 at all
+            "2": make_ls([("w0", "w1", 1)], "2", me="node0"),
+        }
+
+    ps = PrefixState()
+    ps.update_prefix("node2", "1", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("node0", "2", PrefixEntry("10.0.0.0/24"))  # self, area 2
+    backend, tpu = assert_match(mk, ps, me="node0")
+    assert "10.0.0.0/24" not in tpu.unicast_routes
+
+
+def test_multiarea_with_drains():
+    def mk():
+        return {
+            "1": make_ls(
+                grid_edges(3), "1", me="node0", overloaded=["node4"]
+            ),
+            "2": make_ls(
+                ring_edges(4, prefix="b"),
+                "2",
+                me="node0",
+                soft_drained={"b2": 50},
+            ),
+        }
+
+    # node0 must exist in area 2's graph for multi-area to be interesting
+    def mk2():
+        areas = mk()
+        ls2 = LinkState("2", "node0")
+        for db in build_adj_dbs(
+            ring_edges(4, prefix="b") + [("b0", "node0", 1)],
+            area="2",
+            soft_drained={"b2": 50},
+        ).values():
+            ls2.update_adjacency_database(db)
+        areas["2"] = ls2
+        return areas
+
+    ps = PrefixState()
+    ps.update_prefix("node4", "1", PrefixEntry("10.0.0.0/24"))  # hard-drained
+    ps.update_prefix("b2", "2", PrefixEntry("10.0.0.0/24"))  # soft-drained
+    ps.update_prefix("node8", "1", PrefixEntry("10.1.0.0/24"))
+    ps.update_prefix("b1", "2", PrefixEntry("10.1.0.0/24"))
+    assert_match(mk2, ps, me="node0")
+
+
+def test_multiarea_ksp2():
+    def mk():
+        return {
+            "1": make_ls(
+                fabric_edges(num_pods=2, rsws_per_pod=2, fsws_per_pod=2),
+                "1",
+                me="rsw0_0",
+            ),
+            "2": make_ls(
+                grid_edges(3, prefix="g") + [("g0", "rsw0_0", 1)],
+                "2",
+                me="rsw0_0",
+            ),
+        }
+
+    ps = PrefixState()
+    ps.update_prefix(
+        "rsw1_1", "1", PrefixEntry("10.0.0.0/24", forwarding_algorithm=KSP2)
+    )
+    ps.update_prefix(
+        "g8", "2", PrefixEntry("10.0.0.0/24", forwarding_algorithm=KSP2)
+    )
+    ps.update_prefix(
+        "g4", "2", PrefixEntry("10.1.0.0/24", forwarding_algorithm=KSP2)
+    )
+    backend, tpu = assert_match(mk, ps, me="rsw0_0")
+    assert backend.num_scalar_builds == 0
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_multiarea_random_topologies(seed):
+    def mk():
+        e1 = random_connected_edges(12, 8, seed=seed, prefix="a")
+        e2 = random_connected_edges(10, 6, seed=seed + 100, prefix="c")
+        # splice me into both areas
+        e1.append(("a0", "me", 1))
+        e2.append(("c0", "me", 2))
+        return {
+            "1": make_ls(e1, "1", me="me"),
+            "2": make_ls(e2, "2", me="me"),
+        }
+
+    ps = PrefixState()
+    ps.update_prefix("a5", "1", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("c5", "2", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("a7", "1", PrefixEntry("10.1.0.0/24"))
+    ps.update_prefix(
+        "c3", "2", PrefixEntry("10.2.0.0/24", min_nexthop=1)
+    )
+    ps.update_prefix(
+        "a3",
+        "1",
+        PrefixEntry("10.3.0.0/24", metrics=PrefixMetrics(path_preference=900)),
+    )
+    ps.update_prefix(
+        "c7",
+        "2",
+        PrefixEntry("10.3.0.0/24", metrics=PrefixMetrics(path_preference=800)),
+    )
+    assert_match(mk, ps, me="me")
+
+
+def test_border_node_does_not_drag_in_unadvertised_area():
+    """A winner node whose NAME resolves in a second area's graph must not
+    pull that area into the nexthop merge: the scalar chain only iterates
+    areas_with_best (areas containing a winner ADVERTISEMENT,
+    SpfSolver.cpp:276-283).  Regression test for the device kernel's
+    area_has_winner mask."""
+
+    def mk():
+        # border node X is in both graphs; in area 1 it's far (5), in
+        # area 2 it's adjacent (1).  X advertises ONLY in area 1.
+        e1 = [("me", "a1", 1), ("a1", "a2", 1), ("a2", "a3", 1),
+              ("a3", "a4", 1), ("a4", "X", 1)]
+        e2 = [("me", "X", 1), ("X", "z1", 1)]
+        return {
+            "1": make_ls(e1, "1", me="me"),
+            "2": make_ls(e2, "2", me="me"),
+        }
+
+    ps = PrefixState()
+    ps.update_prefix("X", "1", PrefixEntry("10.0.0.0/24"))
+    backend, tpu = assert_match(mk, ps, me="me")
+    route = tpu.unicast_routes["10.0.0.0/24"]
+    # must route the long way through area 1, not shortcut via area 2
+    assert route.igp_cost == 5.0
+    assert {nh.area for nh in route.nexthops} == {"1"}
+
+
+def test_three_areas():
+    def mk():
+        return {
+            "1": make_ls([("me", "a1", 1), ("a1", "a2", 1)], "1", me="me"),
+            "2": make_ls([("me", "b1", 2), ("b1", "b2", 1)], "2", me="me"),
+            "3": make_ls([("me", "c1", 3)], "3", me="me"),
+        }
+
+    ps = PrefixState()
+    for n, a in (("a2", "1"), ("b2", "2"), ("c1", "3")):
+        ps.update_prefix(n, a, PrefixEntry("10.0.0.0/24"))
+        ps.update_prefix(n, a, PrefixEntry(f"10.{a}.0.0/24"))
+    backend, tpu = assert_match(mk, ps, me="me")
+    # anycast winner: igp 2 via area 1 (a2) beats area 2 (3) and area 3 (3)
+    assert tpu.unicast_routes["10.0.0.0/24"].igp_cost == 2.0
