@@ -1,15 +1,31 @@
 #!/usr/bin/env python
-"""Headline benchmark: batched SPF what-if sweep vs single-threaded scalar.
+"""Headline benchmark: the 10k x 1024-node what-if sweep.
 
-Config (BASELINE.md north star): 10k single-link-failure perturbations of a
-1024-node WAN LSDB, full SPF (distances + all-shortest-paths nexthop sets)
-from one vantage root per snapshot.  The baseline is this repo's own scalar
-Dijkstra (the reference publishes no absolute numbers — BASELINE.md),
-measured in-process on one core exactly as the reference's single-threaded
-SpfSolver would run.
+Task (BASELINE.md north star): full SPF results (f32 distances +
+all-shortest-paths first-hop lane sets) for 10,240 single-link-failure
+perturbations of a 1024-node WAN LSDB, one vantage root.
+
+Three measured engines:
+  * **native**  — single-threaded C++ heap Dijkstra (native/spf_scalar.cc),
+    the honest stand-in for the reference's SpfSolver hot loop
+    (LinkState.cpp:721-800).  This is the baseline denominator.  The
+    reference re-solves every perturbed topology (its SPF memo is
+    invalidated on each change), so the naive full sweep is its真
+    behavior; a dedup-assisted variant is reported too for transparency.
+  * **python**  — the repo's scalar oracle (pure-Python Dijkstra), shown
+    because round 1 mistakenly used it as the only denominator.
+  * **device**  — batch-minor transposed Bellman-Ford + packed-lane
+    fixed point (ops/spf.py), raw (every snapshot solved) and through
+    the what-if engine (ops/whatif.py: base aliasing + off-DAG skip +
+    dedup).  Steady-state throughput: work dispatched async, one sync —
+    over a tunneled TPU a sync round trip costs ~65ms, so single-shot
+    numbers would measure the tunnel, not the chip.  Results stay
+    device-resident (downstream route selection consumes them there);
+    the host fetch of the unique-solve tables is timed separately.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline = device engine throughput / native naive throughput.
 """
 
 import json
@@ -24,91 +40,146 @@ def main() -> None:
     from openr_tpu.decision.link_state import LinkState
     from openr_tpu.emulation.topology import build_adj_dbs, random_connected_edges
     from openr_tpu.ops.csr import encode_link_state
-    from openr_tpu.ops.spf import batched_spf_link_failures
+    from openr_tpu.ops.native_spf import NativeSpf
+    from openr_tpu.ops.whatif import LinkFailureSweep
 
     import jax
-    import jax.numpy as jnp
 
-    # ---- build the 1024-node WAN ----------------------------------------
+    # ---- the 1024-node WAN + 10,240 perturbations ------------------------
     n_nodes = 1024
+    total = 10_240
     edges = random_connected_edges(n_nodes, 2048, seed=7)
     ls = LinkState("0")
     for db in build_adj_dbs(edges).values():
         ls.update_adjacency_database(db)
     topo = encode_link_state(ls)
     D = topo.max_out_degree()
+    rng = np.random.default_rng(0)
+    fails = rng.integers(0, len(topo.links), size=total).astype(np.int32)
 
-    # ---- scalar baseline: same solve, heap Dijkstra, one thread ---------
-    # (distances + nexthop sets, identical semantics; see decision/link_state)
-    # one warm-up to stabilize allocator/caches, then best-of-3 batches of 8
+    # ---- native C++ single-threaded baseline -----------------------------
+    native = NativeSpf(topo, "node0")
+    native.sweep(fails[:32])  # warm caches
+    t0 = time.perf_counter()
+    native.sweep(fails)
+    native_naive_s = time.perf_counter() - t0
+    native_sps = total / native_naive_s
+    uniq = np.unique(fails)
+    t0 = time.perf_counter()
+    native.sweep(uniq)
+    native_dedup_s = time.perf_counter() - t0
+    native_dedup_sps = total / native_dedup_s
+
+    # ---- pure-Python oracle (round-1's flattering denominator) -----------
     ls.run_spf("node0", links_to_ignore=frozenset([topo.links[0]]))
     best = float("inf")
     for rep in range(3):
         t0 = time.perf_counter()
         for i in range(8):
-            link = topo.links[(rep * 8 + i) % len(topo.links)]
+            link = topo.links[int(fails[rep * 8 + i])]
             ls.run_spf("node0", links_to_ignore=frozenset([link]))
         best = min(best, (time.perf_counter() - t0) / 8)
-    scalar_s_per_solve = best
+    python_sps = 1.0 / best
 
-    # ---- batched device sweep -------------------------------------------
-    total = 10_240
+    # ---- device: raw sweep (every snapshot solved) -----------------------
+    import jax.numpy as jnp
+
+    from openr_tpu.ops.spf import sweep_spf_link_failures
+
     chunk = 2_048
-    rng = np.random.default_rng(0)
-    fails = rng.integers(0, len(topo.links), size=total).astype(np.int32)
-
-    src = jnp.asarray(topo.src)
-    dst = jnp.asarray(topo.dst)
-    w = jnp.asarray(topo.w)
-    edge_ok = jnp.asarray(topo.edge_ok)
-    link_index = jnp.asarray(topo.link_index)
-    ovl = jnp.tile(jnp.asarray(topo.overloaded), (chunk, 1))
-    roots = jnp.zeros(chunk, jnp.int32)
-
-    # warm the jit cache (compile excluded from the steady-state number,
-    # included in wall_s below for transparency)
-    d, _ = batched_spf_link_failures(
-        src, dst, w, edge_ok, link_index, jnp.asarray(fails[:chunk]), ovl,
-        roots, max_degree=D,
+    args = (
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.asarray(topo.link_index),
     )
-    d.block_until_ready()
+    ovl = jnp.asarray(topo.overloaded)
+    root = jnp.int32(topo.node_id("node0"))
 
+    def raw_sweep():
+        last = None
+        for off in range(0, total, chunk):
+            f = jnp.asarray(fails[off : off + chunk])
+            d, nh = sweep_spf_link_failures(
+                *args, f, ovl, root, max_degree=D, packed=True
+            )
+            last = d
+        return last
+
+    raw_sweep().block_until_ready()  # jit warm-up (excluded)
+    # measure the tunnel/dispatch sync cost once, for the detail split
+    t0 = time.perf_counter()
+    (jnp.zeros(8) + 1).block_until_ready()
+    sync_ms = (time.perf_counter() - t0) * 1000
+
+    reps = 3
     t0 = time.perf_counter()
     last = None
-    for off in range(0, total, chunk):
-        f = jnp.asarray(fails[off : off + chunk])
-        dist, nh = batched_spf_link_failures(
-            src, dst, w, edge_ok, link_index, f, ovl, roots, max_degree=D
-        )
-        last = dist
+    for _ in range(reps):
+        last = raw_sweep()
     last.block_until_ready()
-    batch_elapsed = time.perf_counter() - t0
+    device_raw_sps = reps * total / (time.perf_counter() - t0)
 
-    solves_per_sec = total / batch_elapsed
-    scalar_solves_per_sec = 1.0 / scalar_s_per_solve
-    speedup = solves_per_sec / scalar_solves_per_sec
+    # ---- device: what-if engine (base alias + off-DAG skip + dedup) ------
+    eng = LinkFailureSweep(topo, "node0")
+    res = eng.run(fails, fetch=False)
+    res.block()  # warm-up (compiles the bucket shapes)
+    t0 = time.perf_counter()
+    results = [eng.run(fails, fetch=False) for _ in range(reps)]
+    results[-1].block()
+    engine_sps = reps * total / (time.perf_counter() - t0)
+    # single-shot latency (what one cold rebuild tick would see)
+    t0 = time.perf_counter()
+    single = eng.run(fails, fetch=False)
+    single.block()
+    engine_latency_ms = (time.perf_counter() - t0) * 1000
+    # host fetch of the unique tables (tunnel-bound; reported, not part
+    # of the throughput number — downstream kernels consume on device)
+    t0 = time.perf_counter()
+    single.materialize()
+    fetch_ms = (time.perf_counter() - t0) * 1000
 
-    # sanity: one snapshot (from the warm-up run, same first chunk) must
-    # match the scalar result
-    b_check = 3
-    res = ls.run_spf(
-        "node0", links_to_ignore=frozenset([topo.links[int(fails[b_check])]])
-    )
-    kd = np.asarray(d)[b_check]
-    for node, r in res.items():
-        assert kd[topo.node_id(node)] == r.metric, f"parity failure at {node}"
+    # ---- parity: device results == native results ------------------------
+    for s in (3, 1007, 9000):
+        native.solve(failed_link=int(fails[s]))
+        finite = np.isfinite(native.dist)
+        assert np.array_equal(
+            native.dist[finite], single.dist_of(s)[finite]
+        ), f"distance parity failure at snapshot {s}"
+        assert np.array_equal(
+            native.lanes_dense(D)[finite], single.nh_of(s)[finite]
+        ), f"lane parity failure at snapshot {s}"
 
     print(
         json.dumps(
             {
-                "metric": "spf_solves_per_sec_10k_x_1024node_whatif",
-                "value": round(solves_per_sec, 1),
-                "unit": "solves/s",
-                "vs_baseline": round(speedup, 2),
+                "metric": "whatif_sweep_snapshots_per_sec_10k_x_1024node",
+                "value": round(engine_sps, 1),
+                "unit": "snapshots/s",
+                "vs_baseline": round(engine_sps / native_sps, 2),
                 "detail": {
-                    "scalar_solves_per_sec": round(scalar_solves_per_sec, 1),
+                    "native_cxx_solves_per_sec": round(native_sps, 1),
+                    "native_cxx_dedup_effective_per_sec": round(
+                        native_dedup_sps, 1
+                    ),
+                    "python_solves_per_sec": round(python_sps, 1),
+                    "device_raw_solves_per_sec": round(device_raw_sps, 1),
+                    "vs_native_raw_kernel_only": round(
+                        device_raw_sps / native_sps, 2
+                    ),
+                    "vs_native_dedup": round(
+                        engine_sps / native_dedup_sps, 2
+                    ),
+                    "vs_python": round(engine_sps / python_sps, 2),
+                    "engine_latency_ms": round(engine_latency_ms, 1),
+                    "host_fetch_unique_tables_ms": round(fetch_ms, 1),
+                    "dispatch_sync_ms": round(sync_ms, 1),
+                    "unique_device_solves": int(single.num_device_solves),
+                    "on_dag_link_fraction": round(
+                        float(eng.on_dag_links().mean()), 3
+                    ),
                     "batch_total": total,
-                    "batch_chunk": chunk,
                     "nodes": n_nodes,
                     "directed_edges": topo.num_edges,
                     "max_degree": D,

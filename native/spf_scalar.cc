@@ -1,0 +1,201 @@
+// Native single-threaded SPF baseline — the honest denominator.
+//
+// The reference's Decision hot loop is a single-threaded heap Dijkstra
+// with all-shortest-paths nexthop tracking (LinkState::runSpf,
+// /root/reference/openr/decision/LinkState.cpp:721-800, custom heap
+// LinkState.h:606-660).  BASELINE.md's north star is ">=100x vs
+// single-threaded SpfSolver" — so the batched TPU kernel must be measured
+// against THIS (a C++ Dijkstra producing identical outputs: f32 distances
+// + first-hop lane sets), not against the pure-Python oracle.  Loaded via
+// ctypes by bench.py and the parity tests.
+//
+// Graph comes in as the EncodedTopology directed edge list (dst-sorted,
+// openr_tpu/ops/csr.py) plus a CSR-by-src index built once per topology by
+// spf_scalar_prepare.  Lane semantics match the device kernel: lane r =
+// r-th directed out-edge of the root in edge order; nh[v] bit r set iff
+// some shortest path root->v leaves the root over that edge.  Node
+// hard-drain: an overloaded node is reachable but never relaxes unless it
+// is the root (LinkState.cpp:739-752).
+
+#include <cstdint>
+#include <cstring>
+#include <limits>
+
+namespace {
+
+struct HeapEntry {
+  float dist;
+  int32_t node;
+};
+
+// classic binary min-heap with lazy deletion (matches the reference's
+// DijkstraQ in role; std::priority_queue avoided to keep the hot loop
+// allocation-free across solves)
+class Heap {
+ public:
+  Heap(HeapEntry* buf) : buf_(buf), n_(0) {}
+  void push(float d, int32_t v) {
+    int64_t i = n_++;
+    while (i > 0) {
+      int64_t p = (i - 1) >> 1;
+      if (buf_[p].dist <= d) break;
+      buf_[i] = buf_[p];
+      i = p;
+    }
+    buf_[i] = {d, v};
+  }
+  bool pop(HeapEntry* out) {
+    if (n_ == 0) return false;
+    *out = buf_[0];
+    HeapEntry last = buf_[--n_];
+    int64_t i = 0;
+    for (;;) {
+      int64_t l = 2 * i + 1, r = l + 1, m = i;
+      if (l < n_ && buf_[l].dist < last.dist) m = l;
+      if (r < n_ && buf_[r].dist < (m == i ? last.dist : buf_[l].dist)) m = r;
+      if (m == i) break;
+      buf_[i] = buf_[m];
+      i = m;
+    }
+    buf_[i] = last;
+    return true;
+  }
+  void clear() { n_ = 0; }
+
+ private:
+  HeapEntry* buf_;
+  int64_t n_;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Build CSR-by-src: row_ptr[V+1], edge_order[E] = edge indices grouped by
+// src node (stable, preserving dst-sorted order within a row).  One pass
+// counting sort; call once per topology.
+int spf_scalar_prepare(int32_t num_edges,
+                       int32_t num_nodes,
+                       const int32_t* src,
+                       int32_t* row_ptr,    // [V+1]
+                       int32_t* edge_order  // [E]
+) {
+  if (num_edges < 0 || num_nodes <= 0) return -1;
+  for (int32_t v = 0; v <= num_nodes; ++v) row_ptr[v] = 0;
+  for (int32_t e = 0; e < num_edges; ++e) {
+    const int32_t s = src[e];
+    if (s < 0 || s >= num_nodes) return -1;
+    row_ptr[s + 1]++;
+  }
+  for (int32_t v = 0; v < num_nodes; ++v) row_ptr[v + 1] += row_ptr[v];
+  // temp cursor reuses a stack copy pattern: second pass fills
+  int32_t* cursor = new int32_t[num_nodes];
+  std::memcpy(cursor, row_ptr, sizeof(int32_t) * num_nodes);
+  for (int32_t e = 0; e < num_edges; ++e) edge_order[cursor[src[e]]++] = e;
+  delete[] cursor;
+  return 0;
+}
+
+// One full SPF solve (distances + lane bitmasks).  Outputs:
+//   dist[V] f32 (+inf unreachable), nh_mask[V] u64 (lane bits).
+// lane_of_edge[E]: precomputed lane index per directed edge (-1 = not a
+// root out-edge); max 64 lanes.  failed_link: undirected link id whose
+// two directed edges are skipped (-1 = none), matching the what-if sweep.
+// scratch buffers (caller-allocated, reused across solves):
+//   heap_buf[>=4E] HeapEntry-sized (16 bytes), settled[V] u8.
+int spf_scalar_solve(int32_t num_edges,
+                     int32_t num_nodes,
+                     const int32_t* dst,
+                     const float* w,
+                     const uint8_t* edge_ok,
+                     const int32_t* link_index,
+                     const uint8_t* overloaded,
+                     const int32_t* row_ptr,
+                     const int32_t* edge_order,
+                     const int32_t* lane_of_edge,
+                     int32_t root,
+                     int32_t failed_link,
+                     float* dist,
+                     uint64_t* nh_mask,
+                     void* heap_buf,
+                     uint8_t* settled) {
+  if (root < 0 || root >= num_nodes) return -1;
+  const float inf = std::numeric_limits<float>::infinity();
+  for (int32_t v = 0; v < num_nodes; ++v) {
+    dist[v] = inf;
+    nh_mask[v] = 0;
+    settled[v] = 0;
+  }
+  Heap heap(reinterpret_cast<HeapEntry*>(heap_buf));
+  heap.clear();
+  dist[root] = 0.0f;
+  heap.push(0.0f, root);
+  HeapEntry top;
+  while (heap.pop(&top)) {
+    const int32_t u = top.node;
+    if (settled[u] || top.dist > dist[u]) continue;  // stale entry
+    settled[u] = 1;
+    if (overloaded[u] && u != root) continue;  // hard-drain: no transit
+    const uint64_t mask_u = nh_mask[u];
+    for (int32_t i = row_ptr[u]; i < row_ptr[u + 1]; ++i) {
+      const int32_t e = edge_order[i];
+      if (!edge_ok[e]) continue;
+      if (failed_link >= 0 && link_index[e] == failed_link) continue;
+      const int32_t v = dst[e];
+      if (settled[v]) continue;
+      const float nd = dist[u] + w[e];
+      const int32_t lane = lane_of_edge[e];
+      const uint64_t contrib = (u == root && lane >= 0)
+                                   ? (uint64_t(1) << lane)
+                                   : mask_u;
+      if (nd < dist[v]) {
+        dist[v] = nd;
+        nh_mask[v] = contrib;
+        heap.push(nd, v);
+      } else if (nd == dist[v]) {
+        nh_mask[v] |= contrib;  // all-shortest-paths accumulation
+      }
+    }
+  }
+  return 0;
+}
+
+// Timed sweep: `num_solves` sequential single-threaded solves with
+// per-solve failed links, exactly what a single-threaded SpfSolver would
+// do for the what-if batch.  Writes a checksum so the work cannot be
+// optimized away; outputs of the LAST solve stay in dist/nh_mask for
+// parity checks.
+int spf_scalar_sweep(int32_t num_edges,
+                     int32_t num_nodes,
+                     const int32_t* dst,
+                     const float* w,
+                     const uint8_t* edge_ok,
+                     const int32_t* link_index,
+                     const uint8_t* overloaded,
+                     const int32_t* row_ptr,
+                     const int32_t* edge_order,
+                     const int32_t* lane_of_edge,
+                     int32_t root,
+                     const int32_t* failed_links,
+                     int32_t num_solves,
+                     float* dist,
+                     uint64_t* nh_mask,
+                     void* heap_buf,
+                     uint8_t* settled,
+                     double* checksum) {
+  double acc = 0.0;
+  for (int32_t s = 0; s < num_solves; ++s) {
+    int rc = spf_scalar_solve(num_edges, num_nodes, dst, w, edge_ok,
+                              link_index, overloaded, row_ptr, edge_order,
+                              lane_of_edge, root, failed_links[s], dist,
+                              nh_mask, heap_buf, settled);
+    if (rc != 0) return rc;
+    acc += dist[num_nodes - 1] == std::numeric_limits<float>::infinity()
+               ? -1.0
+               : dist[num_nodes - 1];
+  }
+  *checksum = acc;
+  return 0;
+}
+
+}  // extern "C"

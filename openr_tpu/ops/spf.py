@@ -263,3 +263,246 @@ def batched_spf_distinct(
 def hop_count_weights(w: jnp.ndarray) -> jnp.ndarray:
     """useLinkMetric=false mode: every edge costs 1 (LinkState.cpp:789)."""
     return jnp.ones_like(w)
+
+
+# ---------------------------------------------------------------------------
+# Transposed (batch-minor) sweep kernels
+# ---------------------------------------------------------------------------
+# For the big what-if sweeps the batch-LEADING layout above is wrong for
+# TPU: every relax round gathers d[b, src] as B scattered rows.  With the
+# batch axis LAST (dist [V, B]), d[src] is a contiguous-row gather and the
+# segment reductions write full [B]-wide lanes — measured ~3x on the
+# 1024-node/10k sweep, and the lane loop's [E, B, D] intermediates stay
+# coalesced.  The route-selection path keeps the batch-leading kernels
+# (tiny batches, shard_map-friendly); the sweep engine (ops/whatif.py)
+# and bench.py use these.
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters",))
+def spf_distances_sweep(
+    src,  # [E]
+    dst,  # [E]
+    w,  # [E]
+    edge_enabled,  # [E, B] bool (validity & per-snapshot mask)
+    overloaded,  # [V] shared hard-drain bits
+    root,  # scalar int32 shared root
+    max_iters: Optional[int] = None,
+):
+    """Masked Bellman-Ford fixed point, batch-minor.  Returns [V, B]."""
+    V = overloaded.shape[0]
+    B = edge_enabled.shape[1]
+    transit = _can_transit(overloaded, root)
+    src_ok = transit[src][:, None] & edge_enabled  # [E, B]
+    wcol = jnp.where(edge_enabled, w[:, None], BIG).astype(jnp.float32)
+    d0 = jnp.full((V, B), BIG, jnp.float32).at[root].set(0.0)
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        d, _, i = state
+        cand = jnp.where(src_ok, d[src] + wcol, BIG)  # [E, B] row gather
+        best = jax.ops.segment_min(
+            cand, dst, num_segments=V, indices_are_sorted=True
+        )
+        nd = jnp.minimum(d, best)
+        return nd, jnp.any(nd < d), i + 1
+
+    d, _, _ = jax.lax.while_loop(
+        cond, body, (d0, jnp.bool_(True), jnp.int32(0))
+    )
+    return d
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree", "max_iters"))
+def spf_lanes_sweep(
+    src,
+    dst,
+    w,
+    edge_enabled,  # [E, B]
+    overloaded,
+    root,
+    dist,  # [V, B] from spf_distances_sweep
+    max_degree: int,
+    max_iters: Optional[int] = None,
+):
+    """Nexthop-lane fixed point, batch-minor.  Returns [V, B, D] int8."""
+    V = overloaded.shape[0]
+    D = max_degree
+    transit = _can_transit(overloaded, root)
+    wcol = jnp.where(edge_enabled, w[:, None], BIG)
+    sp_edge = (
+        edge_enabled
+        & transit[src][:, None]
+        & (dist[dst] < BIG)
+        & (dist[src] + wcol == dist[dst])
+    )  # [E, B]
+    is_root_out = src == root
+    rank = jnp.cumsum(is_root_out.astype(jnp.int32)) - 1
+    lanes = jnp.arange(D, dtype=jnp.int32)[None, :]
+    seed = (is_root_out[:, None] & (rank[:, None] == lanes)).astype(jnp.int8)
+    # root-out contributions are constant: fold into the initial state
+    seed_mask = (sp_edge & is_root_out[:, None]).astype(jnp.int8)  # [E, B]
+    nh0 = jax.ops.segment_max(
+        seed[:, None, :] * seed_mask[:, :, None],  # [E, B, D]
+        dst,
+        num_segments=V,
+        indices_are_sorted=True,
+    )
+    prop = (sp_edge & ~is_root_out[:, None]).astype(jnp.int8)  # [E, B]
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        nh, _, i = state
+        contrib = nh[src] * prop[:, :, None]  # [E, B, D]
+        new = jax.ops.segment_max(
+            contrib, dst, num_segments=V, indices_are_sorted=True
+        )
+        new = jnp.maximum(new, nh)
+        return new, jnp.any(new != nh), i + 1
+
+    nh, _, _ = jax.lax.while_loop(
+        cond, body, (nh0, jnp.bool_(True), jnp.int32(0))
+    )
+    return nh
+
+
+#: packed-lane encoding: 6 lanes per uint32 channel, 5 bits per lane
+#: digit.  OR-propagation becomes segment_SUM + per-digit renormalize —
+#: TPU stores int8 padded to 32-bit lanes, so the naive [E, B, D] int8
+#: lane loop moves ~5.7x more bytes than these packed channels.  The
+#: digit holds the count of contributing in-edges, so it must not carry
+#: into the next digit: requires max in-degree <= 30 (checked by caller;
+#: legacy int8 path otherwise).
+LANES_PER_CHANNEL = 6
+LANE_BITS = 5
+PACKED_MAX_IN_DEGREE = 30
+
+
+def lane_channels(max_degree: int) -> int:
+    return (max_degree + LANES_PER_CHANNEL - 1) // LANES_PER_CHANNEL
+
+
+def unpack_lanes(packed: jnp.ndarray, max_degree: int) -> jnp.ndarray:
+    """[..., C] uint32 -> [..., D] int8 (works on numpy arrays too)."""
+    import numpy as np
+
+    xp = np if isinstance(packed, np.ndarray) else jnp
+    d = xp.arange(max_degree)
+    chan = d // LANES_PER_CHANNEL
+    shift = (d % LANES_PER_CHANNEL) * LANE_BITS
+    vals = packed[..., chan] >> shift.astype(packed.dtype)
+    return ((vals & ((1 << LANE_BITS) - 1)) > 0).astype(xp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree", "max_iters"))
+def spf_lanes_sweep_packed(
+    src,
+    dst,
+    w,
+    edge_enabled,  # [E, B]
+    overloaded,
+    root,
+    dist,  # [V, B]
+    max_degree: int,
+    max_iters: Optional[int] = None,
+):
+    """Packed-channel nexthop-lane fixed point.  Returns [V, B, C] uint32
+    with digits renormalized to 0/1 (decode with unpack_lanes)."""
+    V = overloaded.shape[0]
+    C = lane_channels(max_degree)
+    transit = _can_transit(overloaded, root)
+    wcol = jnp.where(edge_enabled, w[:, None], BIG)
+    sp_edge = (
+        edge_enabled
+        & transit[src][:, None]
+        & (dist[dst] < BIG)
+        & (dist[src] + wcol == dist[dst])
+    )  # [E, B]
+    is_root_out = src == root
+    rank = jnp.cumsum(is_root_out.astype(jnp.int32)) - 1
+    # per-edge seed word: lane rank's digit in its channel
+    chan_ids = jnp.arange(C, dtype=jnp.int32)[None, :]  # [1, C]
+    seed_word = jnp.where(
+        is_root_out[:, None]
+        & (rank[:, None] // LANES_PER_CHANNEL == chan_ids),
+        jnp.uint32(1) << ((rank[:, None] % LANES_PER_CHANNEL) * LANE_BITS),
+        jnp.uint32(0),
+    )  # [E, C]
+    seed_mask = (sp_edge & is_root_out[:, None]).astype(jnp.uint32)  # [E, B]
+    nh0 = jax.ops.segment_sum(
+        seed_word[:, None, :] * seed_mask[:, :, None],  # [E, B, C]
+        dst,
+        num_segments=V,
+        indices_are_sorted=True,
+    )
+    digit_lsbs = functools.reduce(
+        lambda acc, k: acc | (jnp.uint32(1) << (k * LANE_BITS)),
+        range(LANES_PER_CHANNEL),
+        jnp.uint32(0),
+    )
+    digit_mask = digit_lsbs * ((1 << LANE_BITS) - 1)  # all digit bits
+
+    def renorm(x):
+        # any nonzero digit -> exactly 1 (digits never carry: counts
+        # <= in-degree + 1 <= 31)
+        present = x | (x >> 1) | (x >> 2) | (x >> 3) | (x >> 4)
+        return present & digit_lsbs
+
+    nh0 = renorm(nh0 & digit_mask)
+    prop = (sp_edge & ~is_root_out[:, None]).astype(jnp.uint32)  # [E, B]
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        nh, _, i = state
+        contrib = nh[src] * prop[:, :, None]  # [E, B, C]
+        summed = jax.ops.segment_sum(
+            contrib, dst, num_segments=V, indices_are_sorted=True
+        )
+        new = renorm((summed + nh) & digit_mask)
+        return new, jnp.any(new != nh), i + 1
+
+    nh, _, _ = jax.lax.while_loop(
+        cond, body, (nh0, jnp.bool_(True), jnp.int32(0))
+    )
+    return nh
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree", "packed"))
+def sweep_spf_link_failures(
+    src,
+    dst,
+    w,
+    edge_ok,  # [E]
+    link_index,  # [E]
+    failed_link,  # [B] int32 (-1 = none)
+    overloaded,  # [V]
+    root,  # scalar
+    max_degree: int,
+    packed: bool = False,
+):
+    """Fused single-link-failure sweep, batch-minor: ships one int32 per
+    snapshot.  Returns (dist [V, B], nh) where nh is [V, B, D] int8, or
+    [V, B, C] uint32 packed channels when `packed` (requires max
+    in-degree <= PACKED_MAX_IN_DEGREE — caller's responsibility)."""
+    en = edge_ok[:, None] & (link_index[:, None] != failed_link[None, :])
+    dist = spf_distances_sweep(src, dst, w, en, overloaded, root)
+    if packed:
+        nh = spf_lanes_sweep_packed(
+            src, dst, w, en, overloaded, root, dist, max_degree
+        )
+    else:
+        nh = spf_lanes_sweep(
+            src, dst, w, en, overloaded, root, dist, max_degree
+        )
+    return dist, nh

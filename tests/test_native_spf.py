@@ -1,0 +1,116 @@
+"""Native scalar SPF (native/spf_scalar.cc) parity tests: the baseline
+denominator must produce exactly the Python oracle's distances and the
+device kernel's nexthop lane sets, or the benchmark ratio is meaningless.
+"""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.emulation.topology import (
+    build_adj_dbs,
+    grid_edges,
+    random_connected_edges,
+)
+from openr_tpu.ops.csr import encode_link_state
+from openr_tpu.ops.native_spf import NativeSpf
+
+
+def make_ls(edges, **kwargs) -> LinkState:
+    ls = LinkState("0")
+    for db in build_adj_dbs(edges, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def assert_native_matches_python(ls, topo, root, failed_link=-1):
+    eng = NativeSpf(topo, root)
+    dist, _ = eng.solve(failed_link=failed_link)
+    ignore = (
+        frozenset([topo.links[failed_link]])
+        if failed_link >= 0
+        else frozenset()
+    )
+    ref = ls.run_spf(root, links_to_ignore=ignore)
+    for node, r in ref.items():
+        assert dist[topo.node_id(node)] == np.float32(r.metric), node
+    reached = {topo.node_id(n) for n in ref}
+    for v in range(topo.num_nodes):
+        if v not in reached:
+            assert not np.isfinite(dist[v])
+    return eng
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_native_distances_match_python_oracle(seed):
+    edges = random_connected_edges(64, 80, seed=seed)
+    ls = make_ls(edges)
+    topo = encode_link_state(ls)
+    assert_native_matches_python(ls, topo, "node0")
+
+
+def test_native_distances_with_link_failure():
+    edges = random_connected_edges(48, 60, seed=3)
+    ls = make_ls(edges)
+    topo = encode_link_state(ls)
+    for fl in (0, 5, len(topo.links) - 1):
+        assert_native_matches_python(ls, topo, "node0", failed_link=fl)
+
+
+def test_native_overload_semantics():
+    edges = grid_edges(4)
+    ls = make_ls(edges, overloaded=["node5", "node10"])
+    topo = encode_link_state(ls)
+    assert_native_matches_python(ls, topo, "node0")
+    # overloaded root still transits
+    ls2 = make_ls(edges, overloaded=["node0"])
+    topo2 = encode_link_state(ls2)
+    assert_native_matches_python(ls2, topo2, "node0")
+
+
+@pytest.mark.parametrize("seed", [4, 5])
+def test_native_lanes_match_device_kernel(seed):
+    import jax.numpy as jnp
+
+    from openr_tpu.ops.spf import spf_one
+
+    edges = random_connected_edges(40, 50, seed=seed)
+    ls = make_ls(edges)
+    topo = encode_link_state(ls)
+    D = topo.max_out_degree()
+    eng = NativeSpf(topo, "node0")
+    for fl in (-1, 2):
+        eng.solve(failed_link=fl)
+        mask = (
+            topo.link_index != fl
+            if fl >= 0
+            else np.ones(topo.padded_edges, bool)
+        )
+        d_dev, nh_dev = spf_one(
+            jnp.asarray(topo.src),
+            jnp.asarray(topo.dst),
+            jnp.asarray(topo.w),
+            jnp.asarray(topo.edge_ok & mask),
+            jnp.asarray(topo.overloaded),
+            jnp.int32(topo.node_id("node0")),
+            D,
+        )
+        d_dev = np.asarray(d_dev)
+        nh_dev = np.asarray(nh_dev)
+        finite = np.isfinite(eng.dist)
+        assert np.array_equal(eng.dist[finite], d_dev[finite])
+        assert (d_dev[~finite] >= 3.0e38).all()
+        assert np.array_equal(eng.lanes_dense(D)[finite], nh_dev[finite])
+
+
+def test_native_sweep_checksum_and_last_solve():
+    edges = random_connected_edges(32, 40, seed=7)
+    ls = make_ls(edges)
+    topo = encode_link_state(ls)
+    eng = NativeSpf(topo, "node0")
+    fails = np.array([0, 1, 2, 3], np.int32)
+    eng.sweep(fails)
+    # last solve outputs == solve(failed_link=3)
+    dist_last = eng.dist.copy()
+    eng.solve(failed_link=3)
+    assert np.array_equal(dist_last, eng.dist)

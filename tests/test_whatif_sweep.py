@@ -1,0 +1,172 @@
+"""Transposed sweep kernels + what-if engine exactness tests.
+
+The engine's optimizations (base aliasing, off-DAG skip, dedup) must be
+invisible: every snapshot's results identical to an independent full
+solve (and to the Python oracle)."""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.emulation.topology import (
+    build_adj_dbs,
+    grid_edges,
+    random_connected_edges,
+)
+from openr_tpu.ops.csr import encode_link_state
+from openr_tpu.ops.whatif import LinkFailureSweep
+
+
+def make_topo(edges, **kwargs):
+    ls = LinkState("0")
+    for db in build_adj_dbs(edges, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls, encode_link_state(ls)
+
+
+def test_transposed_kernels_match_batch_leading():
+    import jax.numpy as jnp
+
+    from openr_tpu.ops.spf import (
+        batched_spf_link_failures,
+        sweep_spf_link_failures,
+    )
+
+    ls, topo = make_topo(random_connected_edges(32, 40, seed=9))
+    D = topo.max_out_degree()
+    fails = np.array([-1, 0, 3, 7, 11, 3], np.int32)
+    B = len(fails)
+    d_ref, nh_ref = batched_spf_link_failures(
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.asarray(topo.link_index),
+        jnp.asarray(fails),
+        jnp.tile(jnp.asarray(topo.overloaded), (B, 1)),
+        jnp.zeros(B, jnp.int32),
+        max_degree=D,
+    )
+    d_t, nh_t = sweep_spf_link_failures(
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.asarray(topo.link_index),
+        jnp.asarray(fails),
+        jnp.asarray(topo.overloaded),
+        jnp.int32(0),
+        max_degree=D,
+    )
+    assert np.array_equal(np.asarray(d_t).T, np.asarray(d_ref))
+    assert np.array_equal(
+        np.moveaxis(np.asarray(nh_t), 1, 0), np.asarray(nh_ref)
+    )
+
+
+def test_packed_lanes_match_dense():
+    import jax.numpy as jnp
+
+    from openr_tpu.ops.spf import (
+        spf_distances_sweep,
+        spf_lanes_sweep,
+        spf_lanes_sweep_packed,
+        unpack_lanes,
+    )
+
+    ls, topo = make_topo(random_connected_edges(40, 60, seed=15))
+    D = topo.max_out_degree()
+    fails = np.array([-1, 2, 9, 17], np.int32)
+    en = jnp.asarray(
+        topo.edge_ok[:, None] & (topo.link_index[:, None] != fails[None, :])
+    )
+    args = (
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        en,
+        jnp.asarray(topo.overloaded),
+        jnp.int32(0),
+    )
+    dist = spf_distances_sweep(*args)
+    dense = np.asarray(spf_lanes_sweep(*args, dist, D))
+    packed = np.asarray(spf_lanes_sweep_packed(*args, dist, D))
+    # segment_max yields int8-min (-128) for empty segments (unreachable
+    # or padding nodes); consumers only test lane > 0, so compare that
+    assert np.array_equal(unpack_lanes(packed, D), (dense > 0).astype(np.int8))
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_sweep_engine_matches_python_oracle(seed):
+    edges = random_connected_edges(48, 60, seed=seed)
+    ls, topo = make_topo(edges)
+    eng = LinkFailureSweep(topo, "node0")
+    rng = np.random.default_rng(seed)
+    fails = rng.integers(0, len(topo.links), size=40).astype(np.int32)
+    res = eng.run(fails)
+    assert res.num_snapshots == 40
+    # dedup + off-DAG skip must have collapsed the solve count
+    assert res.num_device_solves < len(np.unique(fails))
+    for s in (0, 7, 13, 39):
+        ref = ls.run_spf(
+            "node0", links_to_ignore=frozenset([topo.links[int(fails[s])]])
+        )
+        dist = res.dist_of(s)
+        for node, r in ref.items():
+            assert dist[topo.node_id(node)] == np.float32(r.metric), (s, node)
+        reached = {topo.node_id(n) for n in ref}
+        for v in range(topo.num_nodes):
+            if v not in reached:
+                assert dist[v] >= 3.0e38
+
+
+def test_off_dag_failure_aliases_base_and_is_correct():
+    # weighted random graph: a uniform grid has every link on some
+    # shortest path, so off-DAG links only exist with varied metrics
+    ls, topo = make_topo(random_connected_edges(32, 48, seed=31))
+    eng = LinkFailureSweep(topo, "node0")
+    on_dag = eng.on_dag_links()
+    assert (~on_dag).any(), "expected at least one off-DAG link"
+    off = int(np.nonzero(~on_dag)[0][0])
+    res = eng.run(np.array([off], np.int32))
+    assert res.num_device_solves == 0  # aliased to base
+    assert res.snap_row[0] == 0
+    # and the claim itself: removing that link really changes nothing
+    ref = ls.run_spf(
+        "node0", links_to_ignore=frozenset([topo.links[off]])
+    )
+    for node, r in ref.items():
+        assert res.dist_of(0)[topo.node_id(node)] == np.float32(r.metric)
+
+
+def test_sweep_engine_lane_parity_with_native():
+    from openr_tpu.ops.native_spf import NativeSpf
+
+    ls, topo = make_topo(random_connected_edges(40, 50, seed=23))
+    eng = LinkFailureSweep(topo, "node0")
+    native = NativeSpf(topo, "node0")
+    fails = np.array([0, 5, 9], np.int32)
+    res = eng.run(fails)
+    D = eng.D
+    for s, fl in enumerate(fails):
+        native.solve(failed_link=int(fl))
+        finite = np.isfinite(native.dist)
+        dist = res.dist_of(s)
+        assert np.array_equal(native.dist[finite], dist[finite])
+        assert np.array_equal(
+            native.lanes_dense(D)[finite], res.nh_of(s)[finite]
+        )
+
+
+def test_sweep_with_overloaded_nodes():
+    ls, topo = make_topo(grid_edges(4), overloaded=["node5"])
+    eng = LinkFailureSweep(topo, "node0")
+    fails = np.arange(len(topo.links), dtype=np.int32)
+    res = eng.run(fails)
+    for s in range(0, len(fails), 5):
+        ref = ls.run_spf(
+            "node0", links_to_ignore=frozenset([topo.links[s]])
+        )
+        dist = res.dist_of(s)
+        for node, r in ref.items():
+            assert dist[topo.node_id(node)] == np.float32(r.metric)
