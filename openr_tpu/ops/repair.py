@@ -1,0 +1,411 @@
+"""Warm-start (incremental-repair) SPF sweep kernels.
+
+The cold batched kernels (ops/spf.py) pay O(hop-diameter) full-edge
+relaxation rounds per snapshot.  For single-link-failure what-ifs almost
+all of every snapshot's solution is already known from the base solve:
+
+  * Removing link e can only increase the distance of a vertex v whose
+    EVERY shortest path crosses e.  Any base shortest path that crosses a
+    directed edge x→y of e has a shortest suffix from y, so v is a
+    descendant of y in the base shortest-path DAG.  Contrapositive: if v
+    is not a DAG-descendant of the head of either directed edge of e,
+    some base shortest path to v avoids e entirely, hence BOTH its
+    distance and its first-hop lane set are unchanged.
+  * Bellman-Ford converges to the exact fixed point from ANY
+    initialization that (a) is a pointwise over-estimate of the true
+    distances and (b) has d[root] = 0: every relaxation keeps the
+    over-estimate invariant (cand = d[src]+w >= true[src]+w >= true[dst])
+    and after k rounds d[v] is at most the weight of the best <=k-hop
+    path, by the standard induction.  Initializing affected vertices to
+    +inf and the rest to their (provably unchanged) base distances is
+    such an over-estimate, and the loop then converges in rounds equal to
+    the affected region's DAG depth instead of the graph's hop diameter.
+  * The first-hop lane fixed point is recomputed with RESET semantics
+    (nh[v] = seed(v) | OR over DAG in-edges (u,v) of nh[u], recomputed
+    from scratch each round rather than OR-accumulated).  On a DAG this
+    update has a UNIQUE fixed point (induction in topological order from
+    the root, whose value is pinned), so warm-starting from the base
+    lanes is safe: any stale value is overwritten, and iteration stops
+    only when a full round changes nothing.
+
+The reference instead re-runs full Dijkstra per perturbation after
+invalidating its SPF memo (LinkState.h:346-390, LinkState.cpp:721-800);
+this module is the TPU-native answer to that loop for perturbation
+sweeps.
+
+Lane sets here are bit-packed over the BATCH axis (32 snapshots per
+uint32 word): lane OR-propagation becomes pure bitwise OR with no
+digit-carry bookkeeping (unlike the 5-bit-digit channel packing the cold
+kernel uses), and moves 32x fewer bytes than int8 lanes.
+
+The host-side planner (``RepairPlan``) computes, once per (topology,
+root): the base DAG, per-node descendant bitsets (single reverse
+-topological numpy pass), per-link affected-vertex bitsets, and a
+per-link repair-depth estimate used to sort a sweep so each device chunk
+contains failures of similar depth — the relaxation loop's convergence
+test is global per chunk, so one deep snapshot would otherwise gate a
+whole chunk of shallow ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+_BIGF = np.float32(3.4e38)
+
+
+# ---------------------------------------------------------------------------
+# Host-side planning
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RepairPlan:
+    """Per-(topology, root) constants for the repair kernel."""
+
+    root_id: int
+    lanes: int  # number of root-out edges == lane count
+    vw: int  # ceil(V/32) descendant-bitset words
+    #: [L, vw] uint32 — affected-vertex bitset per undirected link
+    #: (zero row == failing this link cannot change the SPF result)
+    aff_link_words: np.ndarray
+    #: [L] int32 — upper bound on repair rounds per link (sort key)
+    repair_depth: np.ndarray
+    #: [L] bool — link has a directed edge on the base DAG
+    on_dag_link: np.ndarray
+    # pull-mode lane tables (static per topology+root)
+    din: int
+    nbr_flat: np.ndarray  # [V*Din] int32 in-neighbor per pull slot
+    pull_perm: np.ndarray  # [V*Din] int32 edge position per pull slot
+    pull_valid: np.ndarray  # [V*Din] bool
+    nbr_is_root: np.ndarray  # [V*Din] bool
+    # seed scatter: pull slots whose in-neighbor is the root
+    seed_v: np.ndarray  # [S] int32 dst node
+    seed_r: np.ndarray  # [S] int32 lane rank
+    seed_slot: np.ndarray  # [S] int32 pull-slot index
+    # base solution
+    base_dist: np.ndarray  # [V] float32
+    base_nh: np.ndarray  # [V, lanes] int8
+    transit_src_ok: np.ndarray  # [E] bool
+
+
+def build_repair_plan(topo, root_id: int, base_dist: np.ndarray,
+                      base_nh: np.ndarray) -> RepairPlan:
+    """Host-side planner.  ``base_nh`` is dense [V, >=lanes] int8 from the
+    base solve; extra all-zero columns beyond the root's out-degree are
+    dropped."""
+    V = topo.padded_nodes
+    E = topo.padded_edges
+    src, dst, w = topo.src, topo.dst, topo.w
+    edge_ok, link_index = topo.edge_ok, topo.link_index
+    L = len(topo.links)
+    vw = (V + 31) // 32
+
+    transit = (~topo.overloaded) | (np.arange(V) == root_id)
+    transit_src_ok = edge_ok & transit[src]
+
+    # base shortest-path DAG (LinkState.cpp:747-800 semantics)
+    reached = base_dist < _BIGF
+    on_edge = (
+        transit_src_ok
+        & reached[dst]
+        & (base_dist[src] + w == base_dist[dst])
+    )
+
+    # descendant bitsets: desc[v] includes v and every DAG-descendant.
+    # One reverse-topological pass: process DAG edges u->v in descending
+    # base_dist[u]; since w >= 1, dist[v] > dist[u], so desc[v] is final
+    # before any edge into u's row is processed.
+    desc = np.zeros((V, vw), np.uint32)
+    idx = np.arange(V)
+    desc[idx, idx // 32] = np.uint32(1) << (idx % 32).astype(np.uint32)
+    dag_e = np.nonzero(on_edge)[0]
+    order = np.argsort(-base_dist[src[dag_e]], kind="stable")
+    for e in dag_e[order]:
+        desc[src[e]] |= desc[dst[e]]
+
+    # hop level: max hops over shortest paths (bounds lane-propagation
+    # depth); ascending-dist pass over DAG edges
+    level = np.zeros(V, np.int32)
+    order_f = np.argsort(base_dist[src[dag_e]], kind="stable")
+    for e in dag_e[order_f]:
+        level[dst[e]] = max(level[dst[e]], level[src[e]] + 1)
+
+    # per-link affected set = union of desc(head) over its on-DAG
+    # directed edges; repair depth = deepest affected level minus the
+    # shallowest head level (+1 slack for the convergence-detect round)
+    aff = np.zeros((L, vw), np.uint32)
+    depth = np.zeros(L, np.int32)
+    on_dag_link = np.zeros(L, bool)
+    heads: dict = {}
+    for e in dag_e:
+        li = link_index[e]
+        if li < 0:
+            continue
+        on_dag_link[li] = True
+        aff[li] |= desc[dst[e]]
+        heads.setdefault(li, []).append(dst[e])
+    # expand bitset -> levels once per link (vectorized over V)
+    bit_v = np.uint32(1) << (idx % 32).astype(np.uint32)
+    for li, hs in heads.items():
+        members = (aff[li][idx // 32] & bit_v) != 0
+        top = int(level[members].max()) if members.any() else 0
+        base_l = min(int(level[h]) for h in hs)
+        depth[li] = max(1, top - base_l + 2)
+
+    # pull-mode lane tables
+    valid = edge_ok
+    din = max(1, int(np.bincount(dst[valid], minlength=V).max()))
+    nbr_flat = np.zeros(V * din, np.int32)
+    pull_perm = np.zeros(V * din, np.int32)
+    pull_valid = np.zeros(V * din, bool)
+    cnt = np.zeros(V, np.int32)
+    for e in range(E):
+        if not valid[e]:
+            continue
+        v = dst[e]
+        slot = v * din + cnt[v]
+        cnt[v] += 1
+        nbr_flat[slot] = src[e]
+        pull_perm[slot] = e
+        pull_valid[slot] = True
+    nbr_is_root = pull_valid & (nbr_flat == root_id)
+
+    # lane ranks: r-th valid directed out-edge of root, in edge order
+    root_out = np.nonzero((src == root_id) & (link_index >= 0))[0]
+    lanes = max(1, len(root_out))
+    rank_of_edge = {int(e): r for r, e in enumerate(root_out)}
+    sv, sr, ss = [], [], []
+    for slot in np.nonzero(nbr_is_root)[0]:
+        e = int(pull_perm[slot])
+        if e in rank_of_edge:
+            sv.append(slot // din)
+            sr.append(rank_of_edge[e])
+            ss.append(slot)
+    return RepairPlan(
+        root_id=root_id,
+        lanes=lanes,
+        vw=vw,
+        aff_link_words=aff,
+        repair_depth=depth,
+        on_dag_link=on_dag_link,
+        din=din,
+        nbr_flat=nbr_flat,
+        pull_perm=pull_perm,
+        pull_valid=pull_valid,
+        nbr_is_root=nbr_is_root,
+        seed_v=np.asarray(sv, np.int32),
+        seed_r=np.asarray(sr, np.int32),
+        seed_slot=np.asarray(ss, np.int32),
+        base_dist=base_dist.astype(np.float32),
+        base_nh=base_nh[:, :lanes].astype(np.int8),
+        transit_src_ok=transit_src_ok,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device kernel
+# ---------------------------------------------------------------------------
+
+
+def _repair_sweep_impl(
+    src,  # [E] int32
+    dst,  # [E] int32
+    w,  # [E] float32
+    lid,  # [E] int32 undirected link id (-1 pad)
+    transit_src_ok,  # [E] bool
+    fails,  # [B] int32 failed link per snapshot (-1 = none)
+    aff_link_table,  # [L, Vw] uint32 per-link affected-vertex bitsets
+    base_dist,  # [V] float32
+    base_nh_bits,  # [V, D] uint32 (0/1)
+    nbr_flat,  # [V*Din] int32
+    pull_perm,  # [V*Din] int32
+    pull_valid,  # [V*Din] bool
+    nbr_is_root,  # [V*Din] bool
+    seed_v,  # [S] int32
+    seed_r,  # [S] int32
+    seed_slot,  # [S] int32
+    d_lanes: int,
+    din: int,
+):
+    import jax
+    import jax.numpy as jnp
+
+    BIG = jnp.float32(3.4e38)
+    V = base_dist.shape[0]
+    B = fails.shape[0]
+    Bw = B // 32
+    D = d_lanes
+
+    # ---- per-snapshot affected bitsets, looked up ON DEVICE -----------
+    # (the table ships once at engine init; per chunk only `fails` [B]
+    # crosses the host->device link — over a tunneled TPU the [B, Vw]
+    # rows per chunk were the dominant fixed cost)
+    aff_words = aff_link_table[jnp.clip(fails, 0, None)] * (
+        (fails >= 0).astype(jnp.uint32)[:, None]
+    )  # [B, Vw]
+
+    # ---- unpack to [V, B] bool ----------------------------------------
+    words_t = aff_words.T  # [Vw, B]
+    rep = jnp.repeat(words_t, 32, axis=0)[:V]  # [V, B]
+    vbit = (jnp.arange(V, dtype=jnp.uint32) % 32)[:, None]
+    aff = ((rep >> vbit) & 1).astype(bool)  # [V, B]
+
+    d0 = jnp.where(aff, BIG, base_dist[:, None])  # [V, B]
+
+    en = lid[:, None] != fails[None, :]  # [E, B]
+    src_okc = transit_src_ok[:, None]
+    limit = jnp.int32(V)
+
+    def dcond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def dbody(state):
+        d, _, i = state
+        cand = jnp.where(en & src_okc, d[src] + w[:, None], BIG)
+        best = jax.ops.segment_min(
+            cand, dst, num_segments=V, indices_are_sorted=True
+        )
+        nd = jnp.minimum(d, best)
+        return nd, jnp.any(nd < d), i + 1
+
+    d, _, rounds_d = jax.lax.while_loop(
+        dcond, dbody, (d0, jnp.bool_(True), jnp.int32(0))
+    )
+
+    # ---- shortest-path-DAG membership, bit-packed over B --------------
+    gs = jnp.where(en & src_okc, d[src] + w[:, None], BIG)  # [E, B]
+    on = (gs == d[dst]) & (d[dst] < BIG)  # [E, B]
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+    on_bits = (
+        (on.reshape(-1, Bw, 32).astype(jnp.uint32) << shifts)
+        .sum(axis=-1)
+        .astype(jnp.uint32)
+    )  # [E, Bw] (bits disjoint: sum == OR)
+
+    on_pull = jnp.where(
+        pull_valid[:, None], on_bits[pull_perm], jnp.uint32(0)
+    )  # [V*Din, Bw]
+    seed_full = (
+        jnp.zeros((V, D, Bw), jnp.uint32)
+        .at[seed_v, seed_r]
+        .max(on_pull[seed_slot])
+    )
+    on_prop = jnp.where(nbr_is_root[:, None], jnp.uint32(0), on_pull)
+    on_prop = on_prop.reshape(V, din, 1, Bw)
+
+    # ---- warm lane init: base lanes masked off affected vertices ------
+    naff_bits = (
+        ((~aff).reshape(V, Bw, 32).astype(jnp.uint32) << shifts)
+        .sum(axis=-1)
+        .astype(jnp.uint32)
+    )  # [V, Bw]
+    base_mask = (jnp.uint32(0) - base_nh_bits)[:, :, None]  # 0 or 0xFFFF..
+    nh0 = (base_mask & naff_bits[:, None, :]) | seed_full
+
+    def lcond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def lbody(state):
+        nh, _, i = state
+        g = nh[nbr_flat].reshape(V, din, D, Bw) & on_prop
+        acc = seed_full
+        for k in range(din):
+            acc = acc | g[:, k]
+        return acc, jnp.any(acc != nh), i + 1
+
+    nh, _, rounds_l = jax.lax.while_loop(
+        lcond, lbody, (nh0, jnp.bool_(True), jnp.int32(0))
+    )
+    return d, nh, rounds_d, rounds_l
+
+
+_kernel_cache: dict = {}
+
+
+def _kernel():
+    if "jit" not in _kernel_cache:
+        import jax
+
+        _kernel_cache["jit"] = jax.jit(
+            _repair_sweep_impl, static_argnames=("d_lanes", "din")
+        )
+    return _kernel_cache["jit"]
+
+
+class RepairSweep:
+    """Device-side warm-start sweep over one (topology, root).
+
+    ``solve(fails)`` returns device arrays (dist [V, B] f32,
+    nh [V, lanes, B/32] uint32 batch-bit-packed, rounds_d, rounds_l) for
+    a batch of single-link failures.  Exact per-snapshot results — the
+    warm start is an optimization, not an approximation (see module
+    docstring)."""
+
+    def __init__(self, topo, plan: RepairPlan, device_edges=None) -> None:
+        """``device_edges``: optional (src, dst, w, link_index) device
+        arrays to reuse (the sweep engine already holds them), avoiding a
+        duplicate host->device upload + HBM copy."""
+        import jax.numpy as jnp
+
+        self.topo = topo
+        self.plan = plan
+        p = plan
+        if device_edges is None:
+            device_edges = (
+                jnp.asarray(topo.src),
+                jnp.asarray(topo.dst),
+                jnp.asarray(topo.w),
+                jnp.asarray(topo.link_index),
+            )
+        e_src, e_dst, e_w, e_lid = device_edges
+        self._const = dict(
+            aff_link_table=jnp.asarray(p.aff_link_words),
+            src=e_src,
+            dst=e_dst,
+            w=e_w,
+            lid=e_lid,
+            transit_src_ok=jnp.asarray(p.transit_src_ok),
+            base_dist=jnp.asarray(p.base_dist),
+            base_nh_bits=jnp.asarray(p.base_nh.astype(np.uint32)),
+            nbr_flat=jnp.asarray(p.nbr_flat),
+            pull_perm=jnp.asarray(p.pull_perm),
+            pull_valid=jnp.asarray(p.pull_valid),
+            nbr_is_root=jnp.asarray(p.nbr_is_root),
+            seed_v=jnp.asarray(p.seed_v),
+            seed_r=jnp.asarray(p.seed_r),
+            seed_slot=jnp.asarray(p.seed_slot),
+        )
+
+    def solve(self, fails: np.ndarray):
+        """``fails`` length must be a multiple of 32 (pad with -1)."""
+        import jax.numpy as jnp
+
+        p = self.plan
+        if len(fails) % 32:
+            raise ValueError("repair sweep batch must be a multiple of 32")
+        return _kernel()(
+            fails=jnp.asarray(fails),
+            d_lanes=p.lanes,
+            din=p.din,
+            **self._const,
+        )
+
+
+def sort_by_depth(
+    plan: RepairPlan, fails: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Order a failure batch by estimated repair depth (shallow first).
+    Returns (sorted_fails, order) with fails == sorted_fails[argsort
+    (order)] — chunks of similar depth converge together instead of the
+    deepest snapshot gating the whole batch."""
+    keys = np.where(
+        fails >= 0, plan.repair_depth[np.clip(fails, 0, None)], 0
+    )
+    order = np.argsort(keys, kind="stable")
+    return fails[order], order

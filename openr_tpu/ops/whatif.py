@@ -1,8 +1,8 @@
 """What-if sweep engine: N link-failure snapshots -> full SPF results.
 
 This is the flagship workload (BASELINE.md: 10k single-link-failure
-perturbations of a 1024-node WAN).  The engine layers three exact
-optimizations over the raw batched kernel, all semantics-preserving:
+perturbations of a 1024-node WAN).  The engine layers exact,
+semantics-preserving optimizations over the device kernels:
 
   1. **Base-solve sharing**: the unperturbed topology is solved once.
   2. **Off-DAG skip**: failing a link that lies on NO shortest path from
@@ -13,11 +13,18 @@ optimizations over the raw batched kernel, all semantics-preserving:
      memoized LinkState would also re-use such a result,
      LinkState.h:346-390 — the scalar baseline in bench.py gets the same
      courtesy so the comparison stays honest).
+  4. **Warm-start repair** (ops/repair.py): each surviving unique solve
+     is initialized from the base solution with only the provably
+     affected vertices (base-DAG descendants of the failed edge heads)
+     reset, so the relaxation loops converge in rounds equal to the
+     affected region's depth instead of the graph's hop diameter.  The
+     unique solves are sorted by estimated repair depth so each device
+     chunk converges together (the convergence test is global per
+     chunk).  Measured ~8x over the cold kernels on the 1024-node WAN.
 
-The surviving unique on-DAG failures run through the batch-minor
-transposed kernels (ops/spf.py sweep_* — measured ~3x the batch-leading
-layout on TPU) in bucketed chunks, dispatched async with one final sync
-so the tunnel round trip (~65ms on axon) is paid once, not per chunk.
+Lane sets ride bit-packed over the batch axis ([V, lanes, B/32] uint32
+words, 32 snapshots per word) — pure bitwise OR propagation, 32x less
+device traffic and host fetch than dense int8 lanes.
 
 Results come back as a unique-solve table + per-snapshot index map —
 materializing 10k copies of [V, D] lane sets would be pure HBM/host
@@ -33,9 +40,8 @@ import numpy as np
 
 from openr_tpu.ops.csr import EncodedTopology, bucket_for
 
-_BIG = np.float32(3.4e38)
-
-#: unique-solve batch buckets (jit cache stays warm across sweep sizes)
+#: unique-solve batch buckets (jit cache stays warm across sweep sizes;
+#: all multiples of 32 for the batch-bit-packed lane words)
 SOLVE_BUCKETS = (64, 256, 1024, 4096, 16384)
 
 
@@ -44,10 +50,9 @@ class SweepResult:
     """Unique-solve dist/nh tables + snapshot index map.
 
     Row 0 of the tables is always the base (unperturbed) solve; snapshot
-    s lives at row ``snap_row[s]``.  Lane sets are stored PACKED
-    ([U, V, C] uint32 channels, ops/spf.py lane encoding) when the
-    topology's in-degree allows — 5.7x less device traffic and host
-    fetch than dense int8 — and unpacked lazily per query.
+    s lives at row ``snap_row[s]``.  Lane sets come off the device
+    batch-bit-packed ([V, lanes, b/32] uint32) and are unpacked to a
+    dense [U, V, lanes] int8 host table by ``materialize()``.
 
     Results may be DEVICE-RESIDENT (``chunks`` set, host tables None):
     downstream device pipelines (route selection, reductions) consume
@@ -59,11 +64,11 @@ class SweepResult:
     snap_row: np.ndarray  # [B] int32
     num_device_solves: int  # unique on-DAG solves actually computed
     num_snapshots: int
-    max_degree: int
-    packed: bool
+    lanes: int  # lane count == root out-degree
     dist: Optional[np.ndarray] = None  # [U, V] f32 (host)
-    nh: Optional[np.ndarray] = None  # [U, V, C] u32 / [U, V, D] i8 (host)
-    #: device-resident solve chunks: (row_offset, n, dist_dev, nh_dev)
+    nh: Optional[np.ndarray] = None  # [U, V, lanes] int8 (host)
+    #: device-resident solve chunks:
+    #: (row_offset, n, dist_dev [V, b], nh_dev [V, lanes, b/32])
     chunks: Optional[List[tuple]] = None
     #: (base_dist [V], base_nh [V, lanes]) — host copies
     base: Optional[tuple] = None
@@ -79,16 +84,21 @@ class SweepResult:
         import jax
 
         V = self.base[0].shape[0]
-        lane_cols = self.base[1].shape[-1]
         U = 1 + self.num_device_solves
         self.dist = np.empty((U, V), np.float32)
-        self.nh = np.empty((U, V, lane_cols), self.base[1].dtype)
+        self.nh = np.empty((U, V, self.lanes), np.int8)
         self.dist[0] = self.base[0]
         self.nh[0] = self.base[1]
         for off, n, dist_d, nh_d in self.chunks or []:
             dist_h, nh_h = jax.device_get((dist_d, nh_d))
             self.dist[1 + off : 1 + off + n] = dist_h[:, :n].T
-            self.nh[1 + off : 1 + off + n] = np.moveaxis(nh_h[:, :n], 1, 0)
+            idx = np.arange(n)
+            bits = (
+                nh_h[:, :, idx // 32] >> (idx % 32).astype(np.uint32)
+            ) & 1  # [V, lanes, n]
+            self.nh[1 + off : 1 + off + n] = np.moveaxis(
+                bits.astype(np.int8), 2, 0
+            )
         self.chunks = None
         return self
 
@@ -97,18 +107,14 @@ class SweepResult:
         return self.dist[self.snap_row[snapshot]]
 
     def nh_of(self, snapshot: int) -> np.ndarray:
-        """Dense [V, D] int8 lane sets for one snapshot."""
+        """Dense [V, lanes] int8 first-hop lane sets for one snapshot."""
         self.materialize()
-        row = self.nh[self.snap_row[snapshot]]
-        if not self.packed:
-            return row
-        from openr_tpu.ops.spf import unpack_lanes
-
-        return unpack_lanes(row, self.max_degree)
+        return self.nh[self.snap_row[snapshot]]
 
 
 class LinkFailureSweep:
-    """Per-(topology, root) sweep engine over the transposed kernels."""
+    """Per-(topology, root) sweep engine over the warm-start repair
+    kernel (ops/repair.py), with base aliasing + off-DAG skip + dedup."""
 
     def __init__(
         self,
@@ -122,79 +128,102 @@ class LinkFailureSweep:
         self.topo = topo
         self.root = root
         self.root_id = topo.node_id(root)
+        if any(b % 32 for b in solve_buckets):
+            raise ValueError(
+                "solve_buckets must be multiples of 32 (lane words are "
+                f"batch-bit-packed): {solve_buckets}"
+            )
         self.solve_buckets = tuple(solve_buckets)
         self.max_chunk = max_chunk
-        self.D = max(topo.max_out_degree(), 1)
+        #: lane count: the root's out-degree (lane r == r-th directed
+        #: out-edge of the root in edge order)
+        self.D = max(
+            int(
+                (
+                    (topo.src == self.root_id) & (topo.link_index >= 0)
+                ).sum()
+            ),
+            1,
+        )
         from openr_tpu.ops.spf import PACKED_MAX_IN_DEGREE
 
-        # in-degree == out-degree here (every link is two directed edges)
-        self.packed = self.D <= PACKED_MAX_IN_DEGREE
+        # base solve uses the channel-packed cold kernel when in-degree
+        # allows (in-degree == out-degree here: links are edge pairs)
+        self.packed = topo.max_out_degree() <= PACKED_MAX_IN_DEGREE
         self._src = jnp.asarray(topo.src)
         self._dst = jnp.asarray(topo.dst)
         self._w = jnp.asarray(topo.w)
         self._edge_ok = jnp.asarray(topo.edge_ok)
         self._link_index = jnp.asarray(topo.link_index)
         self._overloaded = jnp.asarray(topo.overloaded)
-        self._base: Optional[tuple] = None  # (dist [V], nh [V, D])
-        self._on_dag_links: Optional[np.ndarray] = None
+        self._base: Optional[tuple] = None  # (dist [V], nh [V, D] int8)
+        self._repair = None  # lazy RepairSweep
+        self._plan = None
 
-    # -- base solve + DAG link classification ------------------------------
-
-    def _solve_chunk(self, failed: np.ndarray):
-        """Async-dispatch one bucketed chunk; returns device arrays
-        (dist [V, b], nh [V, b, D])."""
-        import jax.numpy as jnp
-
-        from openr_tpu.ops.spf import sweep_spf_link_failures
-
-        b = bucket_for(len(failed), self.solve_buckets)
-        padded = np.full(b, -1, np.int32)
-        padded[: len(failed)] = failed
-        return sweep_spf_link_failures(
-            self._src,
-            self._dst,
-            self._w,
-            self._edge_ok,
-            self._link_index,
-            jnp.asarray(padded),
-            self._overloaded,
-            jnp.int32(self.root_id),
-            max_degree=self.D,
-            packed=self.packed,
-        )
+    # -- base solve + repair plan ------------------------------------------
 
     def base_solve(self):
         """(dist [V] f32, nh [V, D] int8) for the unperturbed topology."""
         if self._base is None:
             import jax
+            import jax.numpy as jnp
 
-            dist, nh = self._solve_chunk(np.array([-1], np.int32))
+            from openr_tpu.ops.spf import (
+                sweep_spf_link_failures,
+                unpack_lanes,
+            )
+
+            dist, nh = sweep_spf_link_failures(
+                self._src,
+                self._dst,
+                self._w,
+                self._edge_ok,
+                self._link_index,
+                jnp.asarray(np.full(32, -1, np.int32)),
+                self._overloaded,
+                jnp.int32(self.root_id),
+                max_degree=self.D,
+                packed=self.packed,
+            )
             dist, nh = jax.device_get((dist, nh))
-            self._base = (dist[:, 0], nh[:, 0])
+            nh0 = nh[:, 0]
+            if self.packed:
+                nh0 = unpack_lanes(nh0, self.D)
+            self._base = (dist[:, 0], (nh0 > 0).astype(np.int8))
         return self._base
+
+    def plan(self):
+        """Host-side repair plan (built once per engine)."""
+        if self._plan is None:
+            from openr_tpu.ops.repair import build_repair_plan
+
+            base_dist, base_nh = self.base_solve()
+            self._plan = build_repair_plan(
+                self.topo, self.root_id, base_dist, base_nh
+            )
+        return self._plan
+
+    def _repair_sweep(self):
+        if self._repair is None:
+            from openr_tpu.ops.repair import RepairSweep
+
+            self._repair = RepairSweep(
+                self.topo,
+                self.plan(),
+                device_edges=(
+                    self._src,
+                    self._dst,
+                    self._w,
+                    self._link_index,
+                ),
+            )
+        return self._repair
 
     def on_dag_links(self) -> np.ndarray:
         """bool [L]: undirected links with a directed edge on some
         shortest path from the root.  Failing any OTHER link provably
         leaves the root's SPF result unchanged."""
-        if self._on_dag_links is None:
-            t = self.topo
-            dist, _ = self.base_solve()
-            transit = (~t.overloaded) | (
-                np.arange(t.padded_nodes) == self.root_id
-            )
-            on_edge = (
-                t.edge_ok
-                & transit[t.src]
-                & (dist[t.dst] < _BIG)
-                & (dist[t.src] + t.w == dist[t.dst])
-            )
-            L = len(t.links)
-            on_link = np.zeros(L, bool)
-            valid = t.link_index >= 0
-            np.logical_or.at(on_link, t.link_index[valid], on_edge[valid])
-            self._on_dag_links = on_link
-        return self._on_dag_links
+        return self.plan().on_dag_link
 
     # -- the sweep ---------------------------------------------------------
 
@@ -205,12 +234,14 @@ class LinkFailureSweep:
         failed_links = np.asarray(failed_links, np.int32)
         B = len(failed_links)
         base_dist, base_nh = self.base_solve()
-        on_dag = self.on_dag_links()
+        plan = self.plan()
+        rs = self._repair_sweep()
 
         # classify + dedup: snapshots whose failure is off-DAG (or -1)
         # alias row 0; the rest map to one row per unique link id
         effective = np.where(
-            (failed_links >= 0) & on_dag[np.clip(failed_links, 0, None)],
+            (failed_links >= 0)
+            & plan.on_dag_link[np.clip(failed_links, 0, None)],
             failed_links,
             -1,
         )
@@ -221,19 +252,35 @@ class LinkFailureSweep:
             inverse = inverse + 1
         todo = unique[1:]  # real solves
 
+        # sort unique solves by estimated repair depth so each chunk's
+        # global convergence test is gated by similar-depth snapshots
+        depth_order = np.argsort(
+            plan.repair_depth[todo], kind="stable"
+        ) if len(todo) else np.zeros(0, np.int64)
+        todo_sorted = todo[depth_order]
+        # remap: unique index u (1-based row) -> sorted position (1-based)
+        row_of_unique = np.empty(1 + len(todo), np.int32)
+        row_of_unique[0] = 0
+        row_of_unique[1 + depth_order] = 1 + np.arange(
+            len(todo), dtype=np.int32
+        )
+        snap_row = row_of_unique[inverse].astype(np.int32)
+
         # async-dispatch all chunks; nothing below waits on the device
         chunks: List[tuple] = []
-        for off in range(0, len(todo), self.max_chunk):
-            chunk = todo[off : off + self.max_chunk]
-            dist_d, nh_d = self._solve_chunk(chunk)
+        for off in range(0, len(todo_sorted), self.max_chunk):
+            chunk = todo_sorted[off : off + self.max_chunk]
+            b = bucket_for(len(chunk), self.solve_buckets)
+            padded = np.full(b, -1, np.int32)
+            padded[: len(chunk)] = chunk
+            dist_d, nh_d, _, _ = rs.solve(padded)
             chunks.append((off, len(chunk), dist_d, nh_d))
 
         result = SweepResult(
-            snap_row=inverse.astype(np.int32),
-            num_device_solves=len(todo),
+            snap_row=snap_row,
+            num_device_solves=len(todo_sorted),
             num_snapshots=B,
-            max_degree=self.D,
-            packed=self.packed,
+            lanes=self.D,
             chunks=chunks,
             base=(base_dist, base_nh),
         )

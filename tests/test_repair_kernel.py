@@ -1,0 +1,167 @@
+"""Warm-start repair kernel exactness (ops/repair.py).
+
+The repair kernel must produce bit-identical results to the cold batched
+kernel (ops/spf.py) for every snapshot: the warm start is an exact
+optimization (affected-set over-estimate + Bellman-Ford-from-over-
+estimate + unique-fixed-point reset lanes), not an approximation.
+"""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.emulation.topology import (
+    build_adj_dbs,
+    grid_edges,
+    line_edges,
+    random_connected_edges,
+)
+from openr_tpu.ops.csr import encode_link_state
+from openr_tpu.ops.repair import (
+    RepairSweep,
+    build_repair_plan,
+    sort_by_depth,
+)
+from openr_tpu.ops.whatif import LinkFailureSweep
+
+
+def make_topo(edges, **kwargs):
+    ls = LinkState("0")
+    for db in build_adj_dbs(edges, **kwargs).values():
+        ls.update_adjacency_database(db)
+    return ls, encode_link_state(ls)
+
+
+def cold_solve(topo, fails, root_id, D):
+    import jax.numpy as jnp
+
+    from openr_tpu.ops.spf import sweep_spf_link_failures
+
+    d, nh = sweep_spf_link_failures(
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.asarray(topo.link_index),
+        jnp.asarray(fails),
+        jnp.asarray(topo.overloaded),
+        jnp.int32(root_id),
+        max_degree=D,
+        packed=False,
+    )
+    return np.asarray(d), np.asarray(nh)  # [V, B], [V, B, D]
+
+
+def repair_engine(topo, root="node0"):
+    eng = LinkFailureSweep(topo, root)
+    base_dist, base_nh = eng.base_solve()
+    plan = build_repair_plan(
+        topo, topo.node_id(root), base_dist, base_nh
+    )
+    return plan, RepairSweep(topo, plan)
+
+
+def assert_repair_matches_cold(topo, fails, root="node0"):
+    plan, rs = repair_engine(topo, root)
+    B = len(fails)
+    assert B % 32 == 0
+    d, nh, _, _ = rs.solve(fails)
+    d, nh = np.asarray(d), np.asarray(nh)
+    dcold, nhcold = cold_solve(
+        topo, fails, topo.node_id(root), topo.max_out_degree()
+    )
+    assert np.array_equal(d, dcold)
+    for s in range(B):
+        dense = (
+            (nh[:, :, s // 32] >> np.uint32(s % 32)) & 1
+        ).astype(np.int8)
+        ref = (nhcold[:, s, : plan.lanes] > 0).astype(np.int8)
+        assert np.array_equal(dense, ref), f"lanes s={s} fail={fails[s]}"
+        # no lanes beyond root out-degree
+        assert not (nhcold[:, s, plan.lanes :] > 0).any()
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_repair_matches_cold_random_wan(seed):
+    _, topo = make_topo(random_connected_edges(64, 96, seed=seed))
+    rng = np.random.default_rng(seed)
+    fails = rng.integers(-1, len(topo.links), size=64).astype(np.int32)
+    assert_repair_matches_cold(topo, fails)
+
+
+def test_repair_matches_cold_grid_all_links():
+    # uniform grid: every link on some shortest path; heavy ECMP ties
+    _, topo = make_topo(grid_edges(5))
+    L = len(topo.links)
+    fails = np.full(64, -1, np.int32)
+    fails[:L] = np.arange(L)
+    assert_repair_matches_cold(topo, fails)
+
+
+def test_repair_with_overloaded_nodes():
+    _, topo = make_topo(
+        random_connected_edges(48, 64, seed=5), overloaded=["node7", "node9"]
+    )
+    rng = np.random.default_rng(5)
+    fails = rng.integers(0, len(topo.links), size=32).astype(np.int32)
+    assert_repair_matches_cold(topo, fails)
+
+
+def test_repair_disconnecting_failure():
+    # line topology: every link is a bridge; failing it disconnects the
+    # tail, whose distances must become +inf and lanes empty
+    ls, topo = make_topo(line_edges(8))
+    fails = np.full(32, -1, np.int32)
+    fails[:7] = np.arange(7)
+    assert_repair_matches_cold(topo, fails)
+    plan, rs = repair_engine(topo)
+    d, nh, _, _ = rs.solve(fails)
+    d = np.asarray(d)
+    # failing link 2 (node2-node3) cuts nodes 3.. from node0
+    for v in range(topo.num_nodes):
+        vid = topo.node_id(f"node{v}")
+        if v >= 3:
+            assert d[vid, 2] >= 3.0e38
+        else:
+            assert d[vid, 2] == v
+
+
+def test_depth_sort_preserves_results_through_engine():
+    # many duplicate failures: engine dedups, depth-sorts, and must map
+    # every snapshot back to the right row
+    ls, topo = make_topo(random_connected_edges(48, 64, seed=77))
+    eng = LinkFailureSweep(topo, "node0")
+    rng = np.random.default_rng(77)
+    fails = rng.integers(0, len(topo.links), size=200).astype(np.int32)
+    res = eng.run(fails)
+    for s in range(0, 200, 13):
+        ref = ls.run_spf(
+            "node0", links_to_ignore=frozenset([topo.links[int(fails[s])]])
+        )
+        dist = res.dist_of(s)
+        for node, r in ref.items():
+            assert dist[topo.node_id(node)] == np.float32(r.metric)
+        reached = {topo.node_id(n) for n in ref}
+        for v in range(topo.num_nodes):
+            if v not in reached:
+                assert dist[v] >= 3.0e38
+
+
+def test_sort_by_depth_roundtrip():
+    _, topo = make_topo(random_connected_edges(32, 48, seed=3))
+    plan, _ = repair_engine(topo)
+    rng = np.random.default_rng(3)
+    fails = rng.integers(-1, len(topo.links), size=100).astype(np.int32)
+    sfails, order = sort_by_depth(plan, fails)
+    assert np.array_equal(sfails[np.argsort(order, kind="stable")], fails)
+    keys = np.where(
+        sfails >= 0, plan.repair_depth[np.clip(sfails, 0, None)], 0
+    )
+    assert (np.diff(keys) >= 0).all()
+
+
+def test_batch_must_be_multiple_of_32():
+    _, topo = make_topo(random_connected_edges(16, 10, seed=1))
+    _, rs = repair_engine(topo)
+    with pytest.raises(ValueError):
+        rs.solve(np.zeros(33, np.int32))
