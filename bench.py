@@ -98,7 +98,7 @@ def main() -> None:
     t0 = time.perf_counter()
     eng.plan()
     plan_build_ms = (time.perf_counter() - t0) * 1000
-    rs = eng._repair_sweep()
+    rs = eng.repair_sweep()
 
     # measure the tunnel/dispatch sync cost once, for the detail split
     (jnp.zeros(8) + 1).block_until_ready()

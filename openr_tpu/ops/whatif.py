@@ -203,7 +203,9 @@ class LinkFailureSweep:
             )
         return self._plan
 
-    def _repair_sweep(self):
+    def repair_sweep(self):
+        """The underlying RepairSweep (public: the raw-kernel benchmark
+        drives it directly)."""
         if self._repair is None:
             from openr_tpu.ops.repair import RepairSweep
 
@@ -235,7 +237,7 @@ class LinkFailureSweep:
         B = len(failed_links)
         base_dist, base_nh = self.base_solve()
         plan = self.plan()
-        rs = self._repair_sweep()
+        rs = self.repair_sweep()
 
         # classify + dedup: snapshots whose failure is off-DAG (or -1)
         # alias row 0; the rest map to one row per unique link id

@@ -35,12 +35,32 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def padded_batch_size(mesh: Mesh, batch: int) -> int:
+    n = mesh.devices.size
+    return ((batch + n - 1) // n) * n
+
+
 def shard_batch(mesh: Mesh, *arrays):
-    """Place [B, ...] arrays with B sharded across the mesh.  B must be a
-    multiple of the mesh size (pad snapshots with no-op perturbations)."""
+    """Place [B, ...] arrays with B sharded across the mesh.
+
+    When B is not a multiple of the mesh size, every array is padded by
+    REPLICATING its last batch row — a duplicated snapshot is always a
+    semantically valid input regardless of what the array encodes, so
+    padding needs no per-array fill rules.  Callers slice kernel outputs
+    back to the original B (``padded_batch_size`` tells them the padded
+    extent)."""
     sh = batch_sharding(mesh)
-    out = tuple(jax.device_put(a, sh) for a in arrays)
-    return out if len(out) > 1 else out[0]
+    n = mesh.devices.size
+    out = []
+    for a in arrays:
+        b = a.shape[0]
+        if b % n:
+            # pad path only: pull to host once, replicate the tail row
+            a = np.asarray(a)
+            pad = np.repeat(a[-1:], padded_batch_size(mesh, b) - b, axis=0)
+            a = np.concatenate([a, pad], axis=0)
+        out.append(jax.device_put(a, sh))
+    return tuple(out) if len(out) > 1 else out[0]
 
 
 def sharded_spf_and_select(mesh: Mesh, max_degree: int):

@@ -384,3 +384,61 @@ def test_encode_rejects_zero_metric():
     ls = make_ls([("a", "b", 0)])
     with pytest.raises(ValueError, match="non-positive metric"):
         encode_link_state(ls)
+
+
+def test_shard_batch_pads_non_multiple_batches():
+    """B % mesh != 0: shard_batch pads by replicating the last snapshot;
+    kernel outputs for the real rows must match an unsharded run."""
+    from openr_tpu.parallel.mesh import (
+        make_mesh,
+        padded_batch_size,
+        shard_batch,
+        sharded_spf_and_select,
+    )
+
+    assert len(jax.devices()) == 8, jax.devices()
+    ls = make_ls(grid_edges(4))
+    ps = PrefixState()
+    ps.update_prefix("node15", "0", PrefixEntry("10.0.0.0/24"))
+    topo = encode_link_state(ls)
+    cands = encode_prefix_candidates(ps, topo, "0")
+    D = max(topo.max_out_degree(), 1)
+    mesh = make_mesh()
+    B = 13  # deliberately not a multiple of 8
+    assert padded_batch_size(mesh, B) == 16
+    mask = np.ones((B, topo.padded_edges), bool)
+    for b in range(B):
+        mask[b, np.asarray(topo.link_index) == (b % len(topo.links))] = False
+    shared = (
+        topo.src, topo.dst, topo.w, topo.edge_ok,
+    )
+    cand_args = (
+        cands.cand_node, cands.cand_ok, cands.drain_metric,
+        cands.path_pref, cands.source_pref, cands.distance,
+        cands.min_nexthop,
+    )
+    edge_en, ovl, soft, roots = shard_batch(
+        mesh,
+        mask,
+        np.tile(topo.overloaded, (B, 1)),
+        np.tile(topo.soft, (B, 1)),
+        np.zeros(B, np.int32),
+    )
+    assert edge_en.shape[0] == 16
+    kernel = sharded_spf_and_select(mesh, D)
+    out_sharded = kernel(*shared, edge_en, ovl, soft, roots, *cand_args)
+    out_plain = spf_and_select(
+        *(jnp.asarray(a) for a in shared),
+        jnp.asarray(mask),
+        jnp.tile(jnp.asarray(topo.overloaded), (B, 1)),
+        jnp.tile(jnp.asarray(topo.soft), (B, 1)),
+        jnp.zeros(B, jnp.int32),
+        *(jnp.asarray(a) for a in cand_args),
+        max_degree=D,
+    )
+    for a_s, a_p in zip(out_sharded, out_plain):
+        assert np.array_equal(np.asarray(a_s)[:B], np.asarray(a_p))
+    # padded rows replicate snapshot B-1
+    assert np.array_equal(
+        np.asarray(out_sharded[1])[B:], np.tile(np.asarray(out_plain[1])[-1], (3, 1))
+    )
