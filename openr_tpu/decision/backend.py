@@ -3,28 +3,41 @@
 The backend seam is exactly the reference's pure-compute boundary
 (SpfSolver takes LinkState/PrefixState in, RouteDb out, SpfSolver.h:136).
 `ScalarBackend` wraps the oracle SpfSolver.  `TpuBackend` runs the
-``multi_area_spf_and_select`` kernel — per-area SPF as a batch dim
-(Decision.cpp:762-773), global best-route selection, per-area ECMP lane
-sets — and decodes device outputs back into RibUnicastEntries with the
-cross-area min-metric merge (SpfSolver.cpp:276-302) done during lane
-decode.  KSP2_ED_ECMP prefixes run their masked re-solve fan-out as a
-second batched device call per area (decision/ksp2.py) with only the
-greedy path trace + label-stack assembly on the host.  Static routes and
-MPLS label routes stay scalar (O(nodes), no per-prefix fan-out).  Both
-backends must produce identical RouteDbs — enforced by differential
-tests.
+``multi_area_spf_tables`` + ``multi_area_select_from_tables`` kernels —
+per-area SPF as a batch dim (Decision.cpp:762-773), global best-route
+selection, per-area ECMP lane sets — and decodes device outputs back into
+RibUnicastEntries with the cross-area min-metric merge
+(SpfSolver.cpp:276-302) done during lane decode.  KSP2_ED_ECMP prefixes
+run their masked re-solve fan-out as a second batched device call per
+area (decision/ksp2.py) with only the greedy path trace + label-stack
+assembly on the host.  Static routes and MPLS label routes stay scalar
+(O(nodes), no per-prefix fan-out).  Both backends must produce identical
+RouteDbs — enforced by differential tests.
+
+Incremental rebuilds (Decision.cpp:908-952 parity): when Decision passes
+``changed_prefixes`` (prefix-only delta, no topology/static/policy
+change), both backends patch their previous RouteDb instead of a full
+rebuild — the TPU path reuses device-resident SPF tables and runs the
+selection kernel over ONLY the changed candidate rows (gathered to a
+bucketed [K, C] batch), the scalar path re-runs createRouteForPrefix for
+the changed set.
 """
 
 from __future__ import annotations
 
-import copy
 import ipaddress
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
 
 from openr_tpu.decision.link_state import INF, LinkState
 from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.decision.rib import DecisionRouteDb, RibUnicastEntry
-from openr_tpu.decision.spf_solver import SpfSolver, select_best_node_area
+from openr_tpu.decision.spf_solver import (
+    SpfSolver,
+    drained_entry,
+    select_best_node_area,
+)
 from openr_tpu.types import (
     NextHop,
     PrefixForwardingAlgorithm,
@@ -35,22 +48,92 @@ from openr_tpu.types import (
 #: track raw topology churn or every new degree recompiles the kernel
 DEGREE_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
+#: gathered-changed-row buckets for the incremental selection batch
+ROWSEL_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144)
+
+
+def _patch_route_db(
+    prev_db: DecisionRouteDb,
+    results: Dict[str, Optional[RibUnicastEntry]],
+    static_routes: Dict[str, RibUnicastEntry],
+) -> DecisionRouteDb:
+    """Previous RouteDb + per-changed-prefix results → new RouteDb.
+    A None result falls back to the static overlay (full-build rule:
+    static routes fill prefixes the prefix states didn't produce,
+    SpfSolver.cpp:343-349), else the route is deleted."""
+    db = DecisionRouteDb(
+        unicast_routes=dict(prev_db.unicast_routes),
+        mpls_routes=dict(prev_db.mpls_routes),
+    )
+    for prefix, entry in results.items():
+        if entry is None:
+            entry = static_routes.get(prefix)
+        if entry is None:
+            db.unicast_routes.pop(prefix, None)
+        else:
+            db.unicast_routes[prefix] = entry
+    return db
+
 
 class DecisionBackend:
     def build_route_db(
         self,
         area_link_states: Dict[str, LinkState],
         prefix_state: PrefixState,
+        changed_prefixes: Optional[Set[str]] = None,
+        force_full: bool = False,
+        cache_result: bool = True,
     ) -> Optional[DecisionRouteDb]:
+        """``changed_prefixes`` is the EXACT prefix-churn delta since the
+        previous call (None = unknown → full re-read of PrefixState).  The
+        backend may patch its previous result only when a delta is given,
+        ``force_full`` is False, and its own caches are intact (topology
+        unchanged).  ``force_full`` demands full recomputation (first
+        build, static-route or policy change) while still letting the
+        backend use the delta for internal table maintenance.
+        ``cache_result=False`` signals the caller will mutate the returned
+        db (RibPolicy) — the backend must not keep it as an incremental
+        base."""
         raise NotImplementedError
 
 
 class ScalarBackend(DecisionBackend):
     def __init__(self, solver: SpfSolver) -> None:
         self.solver = solver
+        self._last_db: Optional[DecisionRouteDb] = None
 
-    def build_route_db(self, area_link_states, prefix_state):
-        return self.solver.build_route_db(area_link_states, prefix_state)
+    def build_route_db(
+        self,
+        area_link_states,
+        prefix_state,
+        changed_prefixes=None,
+        force_full=False,
+        cache_result=True,
+    ):
+        if (
+            changed_prefixes is not None
+            and not force_full
+            and self._last_db is not None
+        ):
+            if not any(
+                ls.has_node(self.solver.my_node_name)
+                for ls in area_link_states.values()
+            ):
+                self._last_db = None
+                return None
+            results = {
+                p: self.solver.create_route_for_prefix(
+                    p, area_link_states, prefix_state
+                )
+                for p in changed_prefixes
+            }
+            db = _patch_route_db(
+                self._last_db, results, self.solver.get_static_routes()
+            )
+        else:
+            db = self.solver.build_route_db(area_link_states, prefix_state)
+        self._last_db = db if cache_result else None
+        return db
 
 
 class TpuBackend(DecisionBackend):
@@ -64,13 +147,14 @@ class TpuBackend(DecisionBackend):
         self,
         solver: SpfSolver,
         node_buckets=(16, 64, 256, 1024, 4096),
-        cand_buckets=(8, 16, 32, 64),
+        cand_buckets=(1, 2, 4, 8, 16, 32, 64),
     ) -> None:
         self.solver = solver  # scalar fallback + MPLS/static
         self.node_buckets = tuple(node_buckets)
         self.cand_buckets = tuple(cand_buckets)
         self.num_device_builds = 0
         self.num_scalar_builds = 0
+        self.num_incremental_builds = 0
         #: scalar fallbacks caused specifically by a prefix advertised by
         #: more candidates than the largest candidate bucket (VERDICT r1
         #: weak #8: the cause must be distinguishable)
@@ -86,8 +170,31 @@ class TpuBackend(DecisionBackend):
         self._ksp2_engines: dict = {}
         self.num_encode_hits = 0
         self.num_encodes = 0
+        #: device-resident per-area SPF tables, valid while (_spf_enc is
+        #: the live encoding object, _spf_degree == D) — identity is held
+        #: by reference, never by id(), to survive GC id reuse
+        self._spf_tables = None
+        self._spf_enc = None
+        self._spf_degree = None
+        #: incremental candidate table (persistent across rebuilds);
+        #: _table_synced guards against missed deltas when a build falls
+        #: back to the scalar path (the table skips that tick's churn)
+        from openr_tpu.decision.cand_table import CandidateTable
 
-    def build_route_db(self, area_link_states, prefix_state):
+        self._cand_table = CandidateTable(cand_buckets=self.cand_buckets)
+        self._table_synced = False
+        #: previous device-built RouteDb + the enc it was built against
+        self._last_db: Optional[DecisionRouteDb] = None
+        self._last_enc = None
+
+    def build_route_db(
+        self,
+        area_link_states,
+        prefix_state,
+        changed_prefixes=None,
+        force_full=False,
+        cache_result=True,
+    ):
         # the device kernel implements the enabled best-route-selection
         # semantics for both distance algorithms; anything else goes
         # through the scalar oracle for exactness
@@ -101,14 +208,25 @@ class TpuBackend(DecisionBackend):
             )
         ):
             self.num_scalar_builds += 1
+            self._last_db = None
+            self._table_synced = False
             return self.solver.build_route_db(area_link_states, prefix_state)
         try:
-            return self._build_device(area_link_states, prefix_state)
+            db = self._build_device(
+                area_link_states, prefix_state, changed_prefixes, force_full
+            )
         except ValueError:
             # e.g. a prefix with more candidates than the largest device
             # bucket — fall back rather than wedging the rebuild loop
             self.num_scalar_builds += 1
+            self._last_db = None
+            self._table_synced = False
             return self.solver.build_route_db(area_link_states, prefix_state)
+        if cache_result:
+            self._last_db = db
+        else:
+            self._last_db = None
+        return db
 
     # -- encoding (cached across prefix-churn rebuilds) --------------------
 
@@ -152,53 +270,154 @@ class TpuBackend(DecisionBackend):
             self._ksp2_engines[key] = eng
         return eng
 
+    def _spf(self, enc, max_degree: int):
+        """Device (dist [A,V], nh [A,V,D]) tables, cached per encoding."""
+        import jax.numpy as jnp
+
+        from openr_tpu.ops.route_select import multi_area_spf_tables
+
+        if (
+            self._spf_tables is not None
+            and self._spf_enc is enc
+            and self._spf_degree == max_degree
+        ):
+            return self._spf_tables
+        dist, nh = multi_area_spf_tables(
+            jnp.asarray(enc.src),
+            jnp.asarray(enc.dst),
+            jnp.asarray(enc.w),
+            jnp.asarray(enc.edge_ok),
+            jnp.asarray(enc.overloaded),
+            jnp.asarray(enc.roots),
+            max_degree=max_degree,
+        )
+        # keep soft/overloaded device-resident alongside (selection inputs)
+        soft = jnp.asarray(enc.soft)
+        ovl = jnp.asarray(enc.overloaded)
+        self._spf_tables = (dist, nh, ovl, soft)
+        self._spf_enc = enc
+        self._spf_degree = max_degree
+        return self._spf_tables
+
     # -- device build ------------------------------------------------------
 
-    def _build_device(self, area_link_states, prefix_state):
+    def _build_device(
+        self, area_link_states, prefix_state, changed_prefixes, force_full
+    ):
         import jax
         import jax.numpy as jnp
 
-        from openr_tpu.ops.csr import (
-            bucket_for,
-            encode_prefix_candidates_multi,
-        )
-        from openr_tpu.ops.route_select import multi_area_spf_and_select
+        from openr_tpu.ops.csr import bucket_for
+        from openr_tpu.ops.route_select import multi_area_select_from_tables
 
         me = self.solver.my_node_name
         if not any(ls.has_node(me) for ls in area_link_states.values()):
+            self._last_db = None
             return None
+        prev_enc = self._last_enc
         enc = self._encoded(area_link_states, me)
+        self._last_enc = enc
+
+        # table sync is driven ONLY by prefix churn; the build mode (patch
+        # vs full selection) additionally requires an unchanged topology
+        table = self._cand_table
         try:
-            cands = encode_prefix_candidates_multi(
-                prefix_state, enc, cand_buckets=self.cand_buckets
-            )
+            if changed_prefixes is not None and self._table_synced:
+                table.apply_dirty(prefix_state, changed_prefixes)
+            else:
+                table.full_sync(prefix_state)
         except ValueError:
             self.num_fallback_cand_overflow += 1
             raise
-        prefixes = cands.prefixes
+        self._table_synced = True
+        dv = table.derived(enc)
+
+        incremental = (
+            changed_prefixes is not None
+            and not force_full
+            and self._last_db is not None
+            and prev_enc is enc
+            and len(changed_prefixes) <= ROWSEL_BUCKETS[-1]
+        )
 
         D = bucket_for(max(enc.max_out_degree(), 1), DEGREE_BUCKETS)
         per_area = (
             self.solver.route_selection_algorithm
             == RouteComputationRules.PER_AREA_SHORTEST_DISTANCE
         )
-        use, shortest, lanes, valid = multi_area_spf_and_select(
-            jnp.asarray(enc.src),
-            jnp.asarray(enc.dst),
-            jnp.asarray(enc.w),
-            jnp.asarray(enc.edge_ok),
-            jnp.asarray(enc.overloaded),
-            jnp.asarray(enc.soft),
-            jnp.asarray(enc.roots),
-            jnp.asarray(cands.cand_area),
-            jnp.asarray(cands.cand_node),
-            jnp.asarray(cands.cand_ok),
-            jnp.asarray(cands.drain_metric),
-            jnp.asarray(cands.path_pref),
-            jnp.asarray(cands.source_pref),
-            jnp.asarray(cands.distance),
-            jnp.asarray(cands.cand_node_in_area),
-            max_degree=D,
+        dist, nh, ovl, soft = self._spf(enc, D)
+
+        if incremental:
+            rows = table.rows_for(changed_prefixes)
+            deleted = [
+                p for p in changed_prefixes if p not in table.pid
+            ]
+            if not rows and not deleted:
+                self.num_incremental_builds += 1
+                return self._last_db
+            results: Dict[str, Optional[RibUnicastEntry]] = {
+                p: None for p in deleted
+            }
+            if rows:
+                K = bucket_for(len(rows), ROWSEL_BUCKETS)
+                # gather changed rows into a padded [K, C] batch; padding
+                # repeats row 0 with cand_ok forced off
+                ridx = np.zeros(K, np.int64)
+                ridx[: len(rows)] = rows
+                g_ok = dv.cand_ok[ridx]
+                g_ok[len(rows):] = False
+                use, shortest, lanes, valid = multi_area_select_from_tables(
+                    dist,
+                    nh,
+                    ovl,
+                    soft,
+                    jnp.asarray(dv.cand_area[ridx]),
+                    jnp.asarray(dv.cand_node[ridx]),
+                    jnp.asarray(g_ok),
+                    jnp.asarray(dv.drain_metric[ridx]),
+                    jnp.asarray(dv.path_pref[ridx]),
+                    jnp.asarray(dv.source_pref[ridx]),
+                    jnp.asarray(dv.distance[ridx]),
+                    jnp.asarray(dv.cand_node_in_area[ridx]),
+                    per_area_distance=per_area,
+                )
+                use, shortest, lanes, valid = jax.device_get(
+                    (use, shortest, lanes, valid)
+                )
+                results.update(
+                    self._decode_rows(
+                        [(i, table.row_prefix[r]) for i, r in enumerate(rows)],
+                        use,
+                        shortest,
+                        lanes,
+                        valid,
+                        dv,
+                        np.asarray(ridx),
+                        enc,
+                        area_link_states,
+                        prefix_state,
+                    )
+                )
+            self.num_incremental_builds += 1
+            self.num_device_builds += 1
+            return _patch_route_db(
+                self._last_db, results, self.solver.get_static_routes()
+            )
+
+        # ---- full build --------------------------------------------------
+        use, shortest, lanes, valid = multi_area_select_from_tables(
+            dist,
+            nh,
+            ovl,
+            soft,
+            jnp.asarray(dv.cand_area),
+            jnp.asarray(dv.cand_node),
+            jnp.asarray(dv.cand_ok),
+            jnp.asarray(dv.drain_metric),
+            jnp.asarray(dv.path_pref),
+            jnp.asarray(dv.source_pref),
+            jnp.asarray(dv.distance),
+            jnp.asarray(dv.cand_node_in_area),
             per_area_distance=per_area,
         )
         self.num_device_builds += 1
@@ -210,19 +429,79 @@ class TpuBackend(DecisionBackend):
             (use, shortest, lanes, valid)
         )
 
+        # only rows with at least one selection winner can produce routes
+        rows_with_winners = np.nonzero(use.any(axis=1))[0]
+        row_items: List[Tuple[int, str]] = []
+        for r in rows_with_winners:
+            p = table.row_prefix[r]
+            if p is not None:
+                row_items.append((int(r), p))
+        results = self._decode_rows(
+            row_items,
+            use,
+            shortest,
+            lanes,
+            valid,
+            dv,
+            None,
+            enc,
+            area_link_states,
+            prefix_state,
+        )
+
+        route_db = DecisionRouteDb()
+        for prefix, entry in results.items():
+            if entry is not None:
+                route_db.add_unicast_route(entry)
+        # static-route overlay + MPLS labels: scalar (small)
+        for prefix, sentry in self.solver.get_static_routes().items():
+            if prefix not in route_db.unicast_routes:
+                route_db.add_unicast_route(sentry)
+        if self.solver.enable_node_segment_label:
+            self.solver._build_node_label_routes(area_link_states, route_db)
+        return route_db
+
+    # -- decode ------------------------------------------------------------
+
+    def _decode_rows(
+        self,
+        row_items: List[Tuple[int, str]],
+        use,  # [R', C] (R' = gathered batch or full cap)
+        shortest,  # [R', A]
+        lanes,  # [R', A, D]
+        valid,  # [R', A]
+        dv,
+        gather_rows: Optional[np.ndarray],  # None = row index == table row
+        enc,
+        area_link_states,
+        prefix_state,
+    ) -> Dict[str, Optional[RibUnicastEntry]]:
+        """Decode device outputs for the given (result_index, prefix)
+        pairs.  When ``gather_rows`` is set, candidate-table columns are
+        indexed by gather_rows[i]; device outputs always by i."""
+        me = self.solver.my_node_name
         all_entries = prefix_state.prefixes()
-        winner_sets = [
-            self._winner_set(p, use, cands, enc)
-            for p in range(len(prefixes))
-        ]
+        out_edges_by_area = [t.root_out_edges(me) for t in enc.topos]
+        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+
+        # winner sets per row (vectorized candidate lookup)
+        winner_sets: Dict[int, Set[Tuple[str, str]]] = {}
+        for i, prefix in row_items:
+            ti = int(gather_rows[i]) if gather_rows is not None else i
+            wset = set()
+            for c in np.nonzero(use[i])[0]:
+                ai = int(dv.cand_area[ti, c])
+                node = enc.topos[ai].id_to_node[int(dv.cand_node[ti, c])]
+                wset.add((node, enc.areas[ai]))
+            winner_sets[i] = wset
 
         # classify by the forwarding algorithm of the MIN selection winner
         # (SpfSolver.cpp:247-250) and seed the KSP2 masked re-solves as
         # one device batch per area
         ksp2_prefixes = set()
         ksp2_dests: Dict[str, list] = {}
-        for p, prefix in enumerate(prefixes):
-            wset = winner_sets[p]
+        for i, prefix in row_items:
+            wset = winner_sets[i]
             if not wset:
                 continue
             fa = all_entries[prefix][min(wset)].forwarding_algorithm
@@ -236,31 +515,29 @@ class TpuBackend(DecisionBackend):
                 dests
             )
 
-        route_db = DecisionRouteDb()
-        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
-        out_edges_by_area = [t.root_out_edges(me) for t in enc.topos]
-
-        for p, prefix in enumerate(prefixes):
-            wset = winner_sets[p]
+        results: Dict[str, Optional[RibUnicastEntry]] = {}
+        for i, prefix in row_items:
+            wset = winner_sets[i]
             if not wset:
+                results[prefix] = None
                 continue
             if prefix in ksp2_prefixes:
                 # scalar KSP2 chain over the device-seeded k-path memo —
                 # no host Dijkstra runs (decision/ksp2.py)
-                entry = self.solver.create_route_for_prefix(
+                results[prefix] = self.solver.create_route_for_prefix(
                     prefix, area_link_states, prefix_state
                 )
-                if entry is not None:
-                    route_db.add_unicast_route(entry)
                 continue
             is_v4 = ipaddress.ip_network(prefix).version == 4
             if is_v4 and not v4_ok:
+                results[prefix] = None
                 continue
             if any(n == me for (n, _a) in wset):
-                continue  # skip-if-self (SpfSolver.cpp:253-260)
-            entry = self._decode_route(
+                results[prefix] = None  # skip-if-self (SpfSolver.cpp:253)
+                continue
+            results[prefix] = self._decode_route(
                 prefix,
-                p,
+                i,
                 wset,
                 is_v4,
                 shortest,
@@ -271,26 +548,7 @@ class TpuBackend(DecisionBackend):
                 area_link_states,
                 all_entries[prefix],
             )
-            if entry is not None:
-                route_db.add_unicast_route(entry)
-
-        # static-route overlay + MPLS labels: scalar (small)
-        for prefix, sentry in self.solver.get_static_routes().items():
-            if prefix not in route_db.unicast_routes:
-                route_db.add_unicast_route(sentry)
-        if self.solver.enable_node_segment_label:
-            self.solver._build_node_label_routes(area_link_states, route_db)
-        return route_db
-
-    @staticmethod
-    def _winner_set(p, use, cands, enc):
-        out = set()
-        for c in range(cands.cand_node.shape[1]):
-            if use[p, c]:
-                ai = int(cands.cand_area[p, c])
-                node = enc.topos[ai].id_to_node[int(cands.cand_node[p, c])]
-                out.add((node, enc.areas[ai]))
-        return out
+        return results
 
     def _decode_route(
         self,
@@ -298,9 +556,9 @@ class TpuBackend(DecisionBackend):
         p,
         wset,
         is_v4,
-        shortest,  # [P, A]
-        lanes,  # [P, A, D]
-        valid,  # [P, A]
+        shortest,  # [R', A]
+        lanes,  # [R', A, D]
+        valid,  # [R', A]
         enc,
         out_edges_by_area,
         area_link_states,
@@ -357,15 +615,10 @@ class TpuBackend(DecisionBackend):
         best = entries.get(best_node_area)
         if best is None:
             return None
-        entry = copy.deepcopy(best)
         if self.solver._is_node_drained(best_node_area, area_link_states):
-            entry.metrics = type(entry.metrics)(
-                version=entry.metrics.version,
-                drain_metric=1,
-                path_preference=entry.metrics.path_preference,
-                source_preference=entry.metrics.source_preference,
-                distance=entry.metrics.distance,
-            )
+            entry = drained_entry(best)
+        else:
+            entry = best
         local_considered = any(n == me for (n, _a) in entries.keys())
         return RibUnicastEntry(
             prefix=prefix,

@@ -94,6 +94,13 @@ class Decision(Actor):
         self._unblocked = False
         self._first_build_done = False
         self._rebuild_pending = False
+        # pending-delta accumulation between debounced rebuilds
+        # (DecisionPendingUpdates, Decision.h:40-108): prefix-only deltas
+        # drive per-prefix incremental recompute (Decision.cpp:908-952)
+        self._pending_prefix_changes: Set[str] = set()
+        self._pending_topo_changed = False
+        self._pending_force_full = False
+        self._last_policy_active = False
         self._debounce = AsyncDebounce(
             self,
             config.debounce_min_ms / 1000.0,
@@ -171,7 +178,10 @@ class Decision(Actor):
                 self.pending_perf_events = adj_db.perf_events
             ls = self._get_link_state(area)
             change = ls.update_adjacency_database(adj_db)
-            return change.topology_changed or change.node_label_changed
+            if change.topology_changed or change.node_label_changed:
+                self._pending_topo_changed = True
+                return True
+            return False
         parsed = parse_prefix_key(key)
         if parsed is not None:
             origin_node, prefix = parsed
@@ -181,26 +191,35 @@ class Decision(Actor):
                 self.counters.bump("decision.parse_errors")
                 return False
             if prefix_db.delete_prefix or not prefix_db.prefix_entries:
-                return bool(
-                    self.prefix_state.delete_prefix(origin_node, area, prefix)
+                changed_set = self.prefix_state.delete_prefix(
+                    origin_node, area, prefix
                 )
-            changed = False
-            for entry in prefix_db.prefix_entries:
-                changed |= bool(
-                    self.prefix_state.update_prefix(origin_node, area, entry)
-                )
-            return changed
+            else:
+                changed_set = set()
+                for entry in prefix_db.prefix_entries:
+                    changed_set |= self.prefix_state.update_prefix(
+                        origin_node, area, entry
+                    )
+            self._pending_prefix_changes |= changed_set
+            return bool(changed_set)
         return False
 
     def _delete_key(self, area: str, key: str) -> bool:
         node = parse_adj_key(key)
         if node is not None:
             ls = self._get_link_state(area)
-            return ls.delete_adjacency_database(node).topology_changed
+            if ls.delete_adjacency_database(node).topology_changed:
+                self._pending_topo_changed = True
+                return True
+            return False
         parsed = parse_prefix_key(key)
         if parsed is not None:
             origin_node, prefix = parsed
-            return bool(self.prefix_state.delete_prefix(origin_node, area, prefix))
+            changed_set = self.prefix_state.delete_prefix(
+                origin_node, area, prefix
+            )
+            self._pending_prefix_changes |= changed_set
+            return bool(changed_set)
         return False
 
     # -- static routes (PrefixManager originated w/ install_to_fib) --------
@@ -211,6 +230,7 @@ class Decision(Actor):
             update.unicast_routes_to_delete,
         )
         self._rebuild_pending = True
+        self._pending_force_full = True
         if self._unblocked:
             self._debounce()
 
@@ -221,8 +241,33 @@ class Decision(Actor):
             return
         self._rebuild_pending = False
         t0 = self.clock.now()
+        policy_active = self.rib_policy is not None and self.rib_policy.is_active(
+            self.clock
+        )
+        # incremental recompute gating (Decision.cpp:908-952): a pure
+        # prefix-only delta lets the backend patch its previous RouteDb;
+        # topology churn, static-route changes, policy application (which
+        # mutates the returned db in place) and the first build force full
+        force_full = (
+            not self._first_build_done
+            or self._pending_force_full
+            or self._pending_topo_changed
+            or policy_active
+            or self._last_policy_active
+        )
+        changed = self._pending_prefix_changes
+        self._pending_prefix_changes = set()
+        self._pending_topo_changed = False
+        self._pending_force_full = False
+        self._last_policy_active = policy_active
+        if not force_full and changed:
+            self.counters.bump("decision.incremental_route_builds")
         new_db = self.backend.build_route_db(
-            self.area_link_states, self.prefix_state
+            self.area_link_states,
+            self.prefix_state,
+            changed_prefixes=changed if self._first_build_done else None,
+            force_full=force_full,
+            cache_result=not policy_active,
         )
         self.counters.bump("decision.route_build_runs")
         if new_db is None:
@@ -261,6 +306,7 @@ class Decision(Actor):
         self.rib_policy = policy
         self._save_rib_policy()
         self._rebuild_pending = True
+        self._pending_force_full = True
         if self._unblocked:
             self._debounce()
 
@@ -272,6 +318,7 @@ class Decision(Actor):
         if self.rib_policy_file and os.path.exists(self.rib_policy_file):
             os.unlink(self.rib_policy_file)
         self._rebuild_pending = True
+        self._pending_force_full = True
         if self._unblocked:
             self._debounce()
 

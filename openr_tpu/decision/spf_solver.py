@@ -56,6 +56,25 @@ def is_mpls_label_valid(label: int) -> bool:
     return C.MPLS_MIN_LABEL <= label <= C.MPLS_MAX_LABEL
 
 
+def drained_entry(entry: PrefixEntry) -> PrefixEntry:
+    """best-entry copy with drain_metric=1 so other areas learn this path
+    crosses a drained node (addBestPaths, SpfSolver.cpp:628-636); shares
+    every unchanged field — PrefixState never mutates entries in place,
+    so the shared references are safe and no deepcopy is needed."""
+    import dataclasses
+
+    return dataclasses.replace(
+        entry,
+        metrics=type(entry.metrics)(
+            version=entry.metrics.version,
+            drain_metric=1,
+            path_preference=entry.metrics.path_preference,
+            source_preference=entry.metrics.source_preference,
+            distance=entry.metrics.distance,
+        ),
+    )
+
+
 @dataclass
 class RouteSelectionResult:
     """Winner set of best-route selection (SpfSolver.h RouteSelectionResult)."""
@@ -314,11 +333,10 @@ class SpfSolver:
         is_v4 = ipaddress.ip_network(prefix).version == 4
         if is_v4 and not self.enable_v4 and not self.v4_over_v6_nexthop:
             return None
+        self.best_routes_cache.pop(prefix, None)
         all_entries = prefix_state.prefixes().get(prefix)
         if not all_entries:
             return None
-
-        self.best_routes_cache.pop(prefix, None)
 
         # keep only entries from nodes reachable in their own area
         prefix_entries: PrefixEntries = {}
@@ -486,18 +504,9 @@ class SpfSolver:
         if min_next_hop is not None and min_next_hop > len(next_hops):
             return None
 
-        import copy
-
-        entry = copy.deepcopy(prefix_entries[selection.best_node_area])
+        entry = prefix_entries[selection.best_node_area]
         if selection.is_best_node_drained:
-            # mark so other areas learn this path crosses a drained node
-            entry.metrics = type(entry.metrics)(
-                version=entry.metrics.version,
-                drain_metric=1,
-                path_preference=entry.metrics.path_preference,
-                source_preference=entry.metrics.source_preference,
-                distance=entry.metrics.distance,
-            )
+            entry = drained_entry(entry)
         return RibUnicastEntry(
             prefix=prefix,
             nexthops=next_hops,

@@ -139,18 +139,35 @@ def batched_select_routes(
     return jax.vmap(one)(dist, nh, overloaded, soft, roots)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("max_degree", "per_area_distance")
-)
-def multi_area_spf_and_select(
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def multi_area_spf_tables(
     src,  # [A, E] per-area edge lists (padded to common buckets)
     dst,  # [A, E]
     w,  # [A, E]
     edge_ok,  # [A, E]
     overloaded,  # [A, V]
-    soft,  # [A, V]
     roots,  # [A] my node id in each area (always present: the encoder
     #         interns `me` into every area's symbol table)
+    max_degree: int,
+):
+    """Per-area SPF from me (vmap over distinct graphs) → device-resident
+    (dist [A, V], nh [A, V, D]) tables.  Split from selection so prefix-only
+    rebuilds (Decision.cpp:908-952) reuse the cached tables and run ONLY
+    the selection kernel over changed candidate rows."""
+    from openr_tpu.ops.spf import spf_one
+
+    def one_area_spf(s, d, ww, eo, ovl, root):
+        return spf_one(s, d, ww, eo, ovl, root, max_degree)
+
+    return jax.vmap(one_area_spf)(src, dst, w, edge_ok, overloaded, roots)
+
+
+@functools.partial(jax.jit, static_argnames=("per_area_distance",))
+def multi_area_select_from_tables(
+    dist,  # [A, V] SPF distances from me, per area
+    nh,  # [A, V, D] first-hop lane sets from me, per area
+    overloaded,  # [A, V]
+    soft,  # [A, V]
     cand_area,  # [P, C] int32 area index of each candidate advertisement
     cand_node,  # [P, C] int32 node id in the candidate's OWN area
     cand_ok,  # [P, C] bool
@@ -162,28 +179,20 @@ def multi_area_spf_and_select(
     #                     in each area's symbol table (-1 = absent) — the
     #                     per-area nexthop computation looks winners up in
     #                     every area, matching getNextHopsWithMetric
-    max_degree: int,
     per_area_distance: bool,  # PER_AREA_SHORTEST_DISTANCE algorithm
 ):
-    """Multi-area buildRouteDb hot loop: area = a batch dim for SPF
-    (Decision.cpp:762-773 runs per-area SPF independently), selection is
-    GLOBAL across areas (SpfSolver.cpp:456-495), per-area ECMP lane sets
-    come back separately so the host can do the cross-area min-metric
-    merge (SpfSolver.cpp:276-302) in the per-area lane→Link decode.
+    """Multi-area buildRouteDb selection: GLOBAL across areas
+    (SpfSolver.cpp:456-495), per-area ECMP lane sets come back separately
+    so the host can do the cross-area min-metric merge
+    (SpfSolver.cpp:276-302) in the per-area lane→Link decode.  Row-
+    independent over P — callable on the full table or on a gathered
+    subset of changed rows.
 
     Returns (use [P, C], shortest [P, A], lanes [P, A, D], valid [P, A]).
     """
-    from openr_tpu.ops.spf import spf_one
+    A = dist.shape[0]
 
-    A = src.shape[0]
-
-    # 1. per-area SPF from me (vmap over distinct graphs)
-    def one_area_spf(s, d, ww, eo, ovl, root):
-        return spf_one(s, d, ww, eo, ovl, root, max_degree)
-
-    dist, nh = jax.vmap(one_area_spf)(src, dst, w, edge_ok, overloaded, roots)
-
-    # 2. global best-route selection chain (LsdbUtil.cpp:761-823)
+    # global best-route selection chain (LsdbUtil.cpp:761-823)
     cdist_own = dist[cand_area, cand_node]  # [P, C] metric in own area
     reach = cand_ok & (cdist_own < BIG)
     hard = overloaded[cand_area, cand_node]
@@ -252,6 +261,48 @@ def multi_area_spf_and_select(
     num_nh = jnp.sum(lanes.astype(jnp.int32), axis=2)  # [P, A]
     valid = jnp.any(mc, axis=1) & (num_nh > 0)  # [P, A]
     return use, shortest, lanes, valid
+
+
+def multi_area_spf_and_select(
+    src,
+    dst,
+    w,
+    edge_ok,
+    overloaded,
+    soft,
+    roots,
+    cand_area,
+    cand_node,
+    cand_ok,
+    drain_metric,
+    path_pref,
+    source_pref,
+    distance,
+    cand_node_in_area,
+    max_degree: int,
+    per_area_distance: bool,
+):
+    """Full multi-area buildRouteDb hot loop: per-area SPF tables + global
+    selection (composition of the two jits; the backend calls them
+    separately to cache SPF tables across prefix-only rebuilds)."""
+    dist, nh = multi_area_spf_tables(
+        src, dst, w, edge_ok, overloaded, roots, max_degree=max_degree
+    )
+    return multi_area_select_from_tables(
+        dist,
+        nh,
+        overloaded,
+        soft,
+        cand_area,
+        cand_node,
+        cand_ok,
+        drain_metric,
+        path_pref,
+        source_pref,
+        distance,
+        cand_node_in_area,
+        per_area_distance=per_area_distance,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("max_degree",))

@@ -1,0 +1,299 @@
+"""Per-prefix incremental rebuild — differential tests vs full rebuild.
+
+Reference parity: openr/decision/Decision.cpp:908-952 recomputes only
+changed prefixes on prefix-only deltas.  Both backends must produce a
+RouteDb identical to a from-scratch full build after ANY interleaving of
+prefix adds/updates/deletes (and topology changes, which force the full
+path)."""
+
+import random
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.decision.backend import ScalarBackend, TpuBackend
+from openr_tpu.decision.cand_table import CandidateTable
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import route_db_summary
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+from openr_tpu.types import PrefixEntry, PrefixMetrics
+
+
+def make_link_state(n=4, **kwargs):
+    edges = grid_edges(n)
+    dbs = build_adj_dbs(edges, **kwargs)
+    ls = LinkState("0", "node0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def rand_entry(rng, prefix):
+    return PrefixEntry(
+        prefix,
+        metrics=PrefixMetrics(
+            path_preference=rng.choice([500, 1000]),
+            source_preference=rng.choice([100, 200]),
+            distance=rng.randint(0, 3),
+            drain_metric=rng.choice([0, 0, 0, 1]),
+        ),
+        min_nexthop=rng.choice([None, None, None, 1, 5]),
+    )
+
+
+def churn_once(rng, ps, num_nodes, prefixes):
+    """One random prefix mutation; returns the changed-prefix set."""
+    op = rng.random()
+    prefix = rng.choice(prefixes)
+    node = f"node{rng.randrange(num_nodes)}"
+    if op < 0.6:
+        return ps.update_prefix(node, "0", rand_entry(rng, prefix))
+    if op < 0.85:
+        return ps.delete_prefix(node, "0", prefix)
+    # delete every advertisement of the prefix
+    changed = set()
+    for (n, a) in list(ps.prefixes().get(prefix, {})):
+        changed |= ps.delete_prefix(n, a, prefix)
+    return changed
+
+
+# -- CandidateTable ---------------------------------------------------------
+
+
+def test_candidate_table_dirty_equals_full():
+    """Dirty application over random churn must equal a fresh full sync
+    (per-prefix row content, not row placement)."""
+    from openr_tpu.ops.csr import encode_multi_area
+
+    rng = random.Random(11)
+    ls = make_link_state(4)
+    enc = encode_multi_area({"0": ls}, "node0")
+    prefixes = [f"10.{i}.0.0/24" for i in range(20)]
+    ps = PrefixState()
+    for p in prefixes[:10]:
+        ps.update_prefix(f"node{rng.randrange(16)}", "0", rand_entry(rng, p))
+
+    inc = CandidateTable()
+    inc.full_sync(ps)
+    inc.derived(enc)
+    for _ in range(200):
+        changed = churn_once(rng, ps, 16, prefixes)
+        inc.apply_dirty(ps, changed)
+        d_inc = inc.derived(enc)
+
+        fresh = CandidateTable()
+        fresh.full_sync(ps)
+        d_fresh = fresh.derived(enc)
+
+        def row_view(table, d, prefix):
+            r = table.pid.get(prefix)
+            if r is None:
+                return None
+            cands = []
+            for c in range(table.C):
+                if not d.cand_ok[r, c]:
+                    continue
+                cands.append(
+                    (
+                        int(d.cand_area[r, c]),
+                        int(d.cand_node[r, c]),
+                        int(d.drain_metric[r, c]),
+                        int(d.path_pref[r, c]),
+                        int(d.source_pref[r, c]),
+                        int(d.distance[r, c]),
+                        int(d.min_nexthop[r, c]),
+                        tuple(int(x) for x in d.cand_node_in_area[r, c]),
+                    )
+                )
+            return sorted(cands)
+
+        for p in prefixes:
+            assert row_view(inc, d_inc, p) == row_view(fresh, d_fresh, p), p
+
+
+def test_candidate_table_row_reuse_and_widening():
+    ps = PrefixState()
+    ps.update_prefix("node1", "0", PrefixEntry("10.0.0.0/24"))
+    t = CandidateTable()
+    t.full_sync(ps)
+    assert t.num_prefixes == 1
+    # delete frees the row
+    changed = ps.delete_prefix("node1", "0", "10.0.0.0/24")
+    t.apply_dirty(ps, changed)
+    assert t.num_prefixes == 0
+    free_before = len(t._free)
+    # new prefix reuses it
+    changed = ps.update_prefix("node2", "0", PrefixEntry("10.1.0.0/24"))
+    t.apply_dirty(ps, changed)
+    assert t.num_prefixes == 1
+    assert len(t._free) == free_before - 1
+    # widening: 3 candidates exceeds C=1, widens to bucket 4
+    assert t.C == 1
+    for n in ("node3", "node4", "node5"):
+        t.apply_dirty(
+            ps, ps.update_prefix(n, "0", PrefixEntry("10.1.0.0/24"))
+        )
+    assert t.C == 4
+    assert (t.adv_gid[t.pid["10.1.0.0/24"]] >= 0).sum() == 4
+
+
+# -- backend differentials --------------------------------------------------
+
+
+@pytest.mark.parametrize("backend_cls", [ScalarBackend, TpuBackend])
+def test_backend_incremental_matches_full(backend_cls):
+    rng = random.Random(23)
+    ls = make_link_state(4, soft_drained={"node10": 60})
+    als = {"0": ls}
+    prefixes = [f"10.{i}.0.0/24" for i in range(24)] + ["2001:db8::/64"]
+    ps = PrefixState()
+    for p in prefixes[:12]:
+        ps.update_prefix(f"node{rng.randrange(16)}", "0", rand_entry(rng, p))
+
+    backend = backend_cls(SpfSolver("node0"))
+    db = backend.build_route_db(als, ps)  # initial full
+    assert db is not None
+    for step in range(60):
+        changed = set()
+        for _ in range(rng.randint(1, 4)):
+            changed |= churn_once(rng, ps, 16, prefixes)
+        db = backend.build_route_db(als, ps, changed_prefixes=changed)
+        oracle = ScalarBackend(SpfSolver("node0")).build_route_db(als, ps)
+        assert route_db_summary(db) == route_db_summary(oracle), step
+    if backend_cls is TpuBackend:
+        assert backend.num_incremental_builds >= 50
+        assert backend.num_scalar_builds == 0
+
+
+def test_tpu_incremental_across_topology_change():
+    """Topology churn mid-sequence: Decision passes force_full, the
+    backend re-encodes, and subsequent prefix-only deltas patch again."""
+    rng = random.Random(5)
+    edges = grid_edges(4)
+    dbs = build_adj_dbs(edges)
+    ls = LinkState("0", "node0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    als = {"0": ls}
+    prefixes = [f"10.{i}.0.0/24" for i in range(10)]
+    ps = PrefixState()
+    for p in prefixes:
+        ps.update_prefix(f"node{rng.randrange(16)}", "0", rand_entry(rng, p))
+
+    backend = TpuBackend(SpfSolver("node0"))
+    backend.build_route_db(als, ps)
+    ch = churn_once(rng, ps, 16, prefixes)
+    backend.build_route_db(als, ps, changed_prefixes=ch)
+    inc_before = backend.num_incremental_builds
+    assert inc_before >= 1
+
+    # drop node15's adjacencies → topology change → force_full
+    ls.delete_adjacency_database("node15")
+    db = backend.build_route_db(als, ps, changed_prefixes=set(), force_full=True)
+    oracle = ScalarBackend(SpfSolver("node0")).build_route_db(als, ps)
+    assert route_db_summary(db) == route_db_summary(oracle)
+    assert backend.num_incremental_builds == inc_before
+
+    # prefix-only churn after the topology change patches again
+    ch = churn_once(rng, ps, 15, prefixes)
+    db = backend.build_route_db(als, ps, changed_prefixes=ch)
+    oracle = ScalarBackend(SpfSolver("node0")).build_route_db(als, ps)
+    assert route_db_summary(db) == route_db_summary(oracle)
+    assert backend.num_incremental_builds == inc_before + 1
+
+
+def test_decision_actor_incremental_builds():
+    """End-to-end through the Decision actor: prefix-only publications
+    after the first build run the incremental path and the final RouteDb
+    matches a fresh scalar oracle."""
+    import asyncio
+    import json
+
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.types import (
+        InitializationEvent,
+        PrefixDatabase,
+        Publication,
+        Value,
+        prefix_key,
+    )
+    from openr_tpu.emulation.topology import build_adj_dbs as bad
+
+    async def main():
+        clock = SimClock()
+        solver = SpfSolver("node0")
+        backend = TpuBackend(solver)
+        out_q = ReplicateQueue("routes")
+        kv_q = ReplicateQueue("kv")
+        d = Decision(
+            "node0",
+            clock,
+            DecisionConfig(debounce_min_ms=10, debounce_max_ms=250),
+            out_q,
+            kv_store_updates_reader=kv_q.get_reader(),
+            backend=backend,
+            solver=solver,
+        )
+        d.start()
+        d.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+
+        def adj_pub():
+            kvs = {}
+            for node, db in bad(grid_edges(3)).items():
+                kvs[f"adj:{node}"] = Value(
+                    version=1,
+                    originator_id=node,
+                    value=json.dumps(db.to_wire()).encode(),
+                )
+            return Publication(key_vals=kvs)
+
+        def prefix_pub(node, prefix, version=1, pp=1000):
+            pdb = PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=[
+                    PrefixEntry(
+                        prefix,
+                        metrics=PrefixMetrics(path_preference=pp),
+                    )
+                ],
+            )
+            return Publication(
+                key_vals={
+                    prefix_key(node, prefix): Value(
+                        version=version,
+                        originator_id=node,
+                        value=json.dumps(pdb.to_wire()).encode(),
+                    )
+                }
+            )
+
+        kv_q.push(adj_pub())
+        kv_q.push(prefix_pub("node8", "10.0.0.0/24"))
+        await clock.run_for(2.0)
+        assert d._first_build_done
+        base_inc = backend.num_incremental_builds
+
+        # prefix-only churn → incremental
+        kv_q.push(prefix_pub("node4", "10.1.0.0/24"))
+        await clock.run_for(2.0)
+        kv_q.push(prefix_pub("node8", "10.0.0.0/24", version=2, pp=2000))
+        kv_q.push(prefix_pub("node7", "10.2.0.0/24"))
+        await clock.run_for(2.0)
+        assert backend.num_incremental_builds >= base_inc + 2
+        assert d.counters.get("decision.incremental_route_builds") >= 2
+
+        oracle = ScalarBackend(SpfSolver("node0")).build_route_db(
+            d.area_link_states, d.prefix_state
+        )
+        assert route_db_summary(d.route_db) == route_db_summary(oracle)
+        await d.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(main())
+    finally:
+        loop.close()
