@@ -25,9 +25,10 @@ from openr_tpu.types import InitializationEvent, KvStorePeerState
 
 def _call(ctx: click.Context, method: str, **params: Any) -> Any:
     host, port = ctx.obj["host"], ctx.obj["port"]
+    tls = ctx.obj.get("tls")
 
     async def go():
-        async with OpenrCtrlClient(host=host, port=port) as client:
+        async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
             return await client.call(method, **params)
 
     return asyncio.run(go())
@@ -40,12 +41,38 @@ def _print(obj: Any) -> None:
 @click.group()
 @click.option("--host", default="127.0.0.1", help="ctrl server host")
 @click.option("--port", default=Const.OPENR_CTRL_PORT, help="ctrl server port")
+@click.option("--cert", default="", help="TLS client certificate (PEM)")
+@click.option("--key", default="", help="TLS client private key (PEM)")
+@click.option("--ca", default="", help="TLS CA bundle to verify the server")
+@click.option("--insecure-tls", is_flag=True,
+              help="TLS without server verification")
 @click.pass_context
-def breeze(ctx: click.Context, host: str, port: int) -> None:
+def breeze(
+    ctx: click.Context,
+    host: str,
+    port: int,
+    cert: str,
+    key: str,
+    ca: str,
+    insecure_tls: bool,
+) -> None:
     """breeze — CLI for Open/R-tpu (reference: py/openr/cli/breeze.py)."""
     ctx.ensure_object(dict)
     ctx.obj["host"] = host
     ctx.obj["port"] = port
+    tls = None
+    if cert or key or ca or insecure_tls:
+        from openr_tpu.common.tls import TlsConfig
+
+        tls = TlsConfig(
+            enabled=True,
+            cert_path=cert,
+            key_path=key,
+            ca_path=ca,
+            verify_server=not insecure_tls,
+            strict=True,
+        )
+    ctx.obj["tls"] = tls
 
 
 # ------------------------------------------------------------------- openr
@@ -192,9 +219,10 @@ def kvstore_snoop(
 ) -> None:
     """Live-subscribe to KvStore deltas (reference: KvStoreSnooper)."""
     host, port = ctx.obj["host"], ctx.obj["port"]
+    tls = ctx.obj.get("tls")
 
     async def go():
-        async with OpenrCtrlClient(host=host, port=port) as client:
+        async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
             seen = 0
             async for pub in client.stream(
                 "subscribe_and_get_kv_store",
@@ -294,9 +322,10 @@ def fib_unicast(ctx: click.Context, prefixes: tuple) -> None:
 def fib_snoop(ctx: click.Context, count: int) -> None:
     """Live-subscribe to FIB deltas (subscribeAndGetFib)."""
     host, port = ctx.obj["host"], ctx.obj["port"]
+    tls = ctx.obj.get("tls")
 
     async def go():
-        async with OpenrCtrlClient(host=host, port=port) as client:
+        async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
             seen = 0
             async for delta in client.stream("subscribe_and_get_fib"):
                 click.echo(json.dumps(delta, sort_keys=True, default=str))

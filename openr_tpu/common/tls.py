@@ -1,0 +1,120 @@
+"""TLS for the ctrl server and KvStore peer RPC plane.
+
+Reference parity: the reference serves thrift over TLS via wangle/fizz
+(/root/reference/openr/Main.cpp:399-416) with cert/key/CA paths from
+gflags (/root/reference/openr/common/Flags.cpp:10-37) and verifies peers
+against an acceptable-peer-name list.  Here:
+
+  * ``TlsConfig`` lives on OpenrConfig; cert/key/CA are PEM file paths
+  * the ctrl server wraps its listener with ``server_ssl_context`` —
+    which also secures KvStore peer sessions, since TcpKvStoreTransport
+    rides the ctrl RPC plane (kvstore/transport.py)
+  * mutual auth: ``require_client_cert`` makes the server demand and
+    verify a client cert against the CA (the reference's mTLS shape —
+    peers are authenticated by CA chain, not hostname, so hostname
+    checking is off by default like wangle's SSLVerifyPeerEnforce)
+  * plaintext fallback: ``enabled=False`` (the default) keeps every
+    plane on plaintext TCP — the reference's ``enable_secure_thrift``
+    off state; when enabled but cert files are missing, ``strict=False``
+    logs and falls back to plaintext instead of refusing to start
+    (lab/dev parity with --tls-ticket-less bringup), ``strict=True``
+    raises.
+
+Test certs are generated with the ``cryptography`` package (see
+tests/test_tls.py); ops deployments bring their own PEMs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import ssl
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class TlsConfig:
+    """Secure-transport knobs (reference: Flags.cpp:10-37 cert flags +
+    OpenrConfig.thrift ThriftServer config)."""
+
+    enabled: bool = False
+    cert_path: str = ""
+    key_path: str = ""
+    #: CA bundle used BOTH to verify peers (server side, when
+    #: require_client_cert) and servers (client side)
+    ca_path: str = ""
+    #: mutual auth: server demands a client certificate signed by ca_path
+    require_client_cert: bool = True
+    #: verify the server certificate on the client side (CA chain)
+    verify_server: bool = True
+    #: check the server cert's hostname/SAN — off by default: infra mTLS
+    #: authenticates by CA, and nodes dial link-local/loopback addresses
+    #: that never match SANs
+    verify_hostname: bool = False
+    #: refuse to start when enabled but certs are unusable (False = log
+    #: and fall back to plaintext)
+    strict: bool = False
+
+    def _files_ok(self, role: str) -> bool:
+        if role == "server":
+            need = [self.cert_path, self.key_path]
+            if self.require_client_cert:
+                need.append(self.ca_path)
+        else:  # client: cert/key optional (mTLS), CA only when verifying
+            need = []
+            if self.verify_server:
+                need.append(self.ca_path)
+            if self.cert_path or self.key_path:
+                need += [self.cert_path, self.key_path]
+        return all(p and os.path.exists(p) for p in need)
+
+
+def server_ssl_context(tls: Optional[TlsConfig]) -> Optional[ssl.SSLContext]:
+    """SSLContext for the ctrl listener; None = serve plaintext."""
+    if tls is None or not tls.enabled:
+        return None
+    if not tls._files_ok("server"):
+        if tls.strict:
+            raise FileNotFoundError(
+                f"tls enabled but cert/key/ca missing: cert={tls.cert_path!r} "
+                f"key={tls.key_path!r} ca={tls.ca_path!r}"
+            )
+        log.warning("tls enabled but certs missing; falling back to plaintext")
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(tls.cert_path, tls.key_path)
+    if tls.require_client_cert:
+        ctx.load_verify_locations(tls.ca_path)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(tls: Optional[TlsConfig]) -> Optional[ssl.SSLContext]:
+    """SSLContext for dialing a TLS ctrl server; None = plaintext."""
+    if tls is None or not tls.enabled:
+        return None
+    if not tls._files_ok("client"):
+        if tls.strict:
+            raise FileNotFoundError(
+                f"tls enabled but cert/key/ca missing: cert={tls.cert_path!r} "
+                f"key={tls.key_path!r} ca={tls.ca_path!r}"
+            )
+        log.warning("tls enabled but certs missing; dialing plaintext")
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    if tls.verify_server:
+        ctx.load_verify_locations(tls.ca_path)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.check_hostname = tls.verify_hostname
+    else:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    # client cert for mutual auth (ignored by servers that don't ask)
+    if tls.cert_path and tls.key_path:
+        ctx.load_cert_chain(tls.cert_path, tls.key_path)
+    return ctx

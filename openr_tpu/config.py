@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from openr_tpu import constants as C
+from openr_tpu.common.tls import TlsConfig
 from openr_tpu.policy.policy import PolicyConfig
 from openr_tpu.types import (
     PrefixForwardingAlgorithm,
@@ -209,6 +210,9 @@ class OpenrConfig:
         default_factory=SegmentRoutingConfig
     )
     tpu_compute_config: TpuComputeConfig = field(default_factory=TpuComputeConfig)
+    #: TLS for the ctrl server + KvStore peer RPC plane (reference:
+    #: thrift-over-TLS, Main.cpp:399-416; cert flags Flags.cpp:10-37)
+    tls: TlsConfig = field(default_factory=TlsConfig)
     #: named routing-policy definitions (area_policies in the reference
     #: schema, OpenrConfig.thrift:544) referenced by
     #: AreaConfig.import_policy / OriginatedPrefix.origination_policy;

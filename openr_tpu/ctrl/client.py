@@ -20,9 +20,12 @@ class OpenrCtrlError(RuntimeError):
 
 
 class OpenrCtrlClient:
-    def __init__(self, host: str = "127.0.0.1", port: int = 2018) -> None:
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 2018, tls=None
+    ) -> None:
         self.host = host
         self.port = port
+        self.tls = tls
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1)
@@ -32,8 +35,14 @@ class OpenrCtrlClient:
         self._dead = False
 
     async def connect(self) -> "OpenrCtrlClient":
+        from openr_tpu.common.tls import client_ssl_context
+
+        ctx = client_ssl_context(self.tls)
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+            self.host,
+            self.port,
+            ssl=ctx,
+            server_hostname=self.host if ctx and ctx.check_hostname else None,
         )
         self._pump_task = asyncio.ensure_future(self._pump())
         return self

@@ -53,19 +53,31 @@ def write_frame(writer: asyncio.StreamWriter, obj: dict) -> None:
 
 
 class OpenrCtrlServer:
-    """Serves one node's OpenrCtrlHandler on a TCP port."""
+    """Serves one node's OpenrCtrlHandler on a TCP port, optionally over
+    TLS (reference: thrift-over-TLS via wangle, Main.cpp:399-416 — here
+    ``tls`` is a TlsConfig; mutual auth verifies client certs against the
+    CA).  KvStore peer sessions ride this same listener, so enabling TLS
+    secures both the operator API and the LSDB sync plane."""
 
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self, node, host: str = "127.0.0.1", port: int = 0, tls=None
+    ) -> None:
         self.node = node
         self.handler = OpenrCtrlHandler(node)
         self.host = host
         self.port = port
+        self.tls = tls
+        self.tls_active = False
         self._server: Optional[asyncio.base_events.Server] = None
         self._conn_tasks: set = set()
 
     async def start(self) -> None:
+        from openr_tpu.common.tls import server_ssl_context
+
+        ctx = server_ssl_context(self.tls)
+        self.tls_active = ctx is not None
         self._server = await asyncio.start_server(
-            self._on_connection, self.host, self.port
+            self._on_connection, self.host, self.port, ssl=ctx
         )
         self.port = self._server.sockets[0].getsockname()[1]
 

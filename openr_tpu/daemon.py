@@ -49,6 +49,7 @@ async def run_node(
         node,
         host=ctrl_host,
         port=config.openr_ctrl_port if ctrl_port is None else ctrl_port,
+        tls=config.tls,
     )
     await server.start()
     return node, server
@@ -140,7 +141,7 @@ async def run_real_node(
         config=config,
         clock=clock,
         io_provider=UdpIoProvider(),
-        kv_transport=TcpKvStoreTransport(),
+        kv_transport=TcpKvStoreTransport(tls=config.tls),
         fib_agent=fib_agent,
         netlink_events_queue=netlink_events_q,
         nl_neighbor_events_queue=nl_neighbor_q,
@@ -153,10 +154,12 @@ async def run_real_node(
     # explicit "::" would get IPV6_V6ONLY from asyncio and refuse v4):
     # remote peers' TcpKvStoreTransport dials this port for KvStore
     # full-sync/flooding, so loopback-only would break cross-host peering
-    server = OpenrCtrlServer(node, host=ctrl_host or None, port=ctrl_port)
+    server = OpenrCtrlServer(
+        node, host=ctrl_host or None, port=ctrl_port, tls=config.tls
+    )
     await server.start()
     print(f"{config.node_name}: ctrl on [{ctrl_host or '*'}]:{server.port} "
-          f"(fib={fib_mode})")
+          f"(fib={fib_mode}, tls={'on' if server.tls_active else 'off'})")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -217,10 +220,13 @@ def main(argv: Optional[List[str]] = None) -> None:
             net.start()
             node = net.nodes[config.node_name]
             server = OpenrCtrlServer(
-                node, port=args.ctrl_base_port or config.openr_ctrl_port
+                node,
+                port=args.ctrl_base_port or config.openr_ctrl_port,
+                tls=config.tls,
             )
             await server.start()
-            print(f"{config.node_name}: ctrl on 127.0.0.1:{server.port}")
+            print(f"{config.node_name}: ctrl on 127.0.0.1:{server.port} "
+                  f"(tls={'on' if server.tls_active else 'off'})")
             stop = asyncio.Event()
             loop = asyncio.get_running_loop()
             for sig in (signal.SIGINT, signal.SIGTERM):

@@ -146,7 +146,7 @@ class TpuBackend(DecisionBackend):
     def __init__(
         self,
         solver: SpfSolver,
-        node_buckets=(16, 64, 256, 1024, 4096),
+        node_buckets=(16, 64, 256, 1024, 4096, 16384),
         cand_buckets=(1, 2, 4, 8, 16, 32, 64),
     ) -> None:
         self.solver = solver  # scalar fallback + MPLS/static
@@ -312,7 +312,11 @@ class TpuBackend(DecisionBackend):
 
         me = self.solver.my_node_name
         if not any(ls.has_node(me) for ls in area_link_states.values()):
+            # this tick's delta is consumed without being applied to the
+            # candidate table — mark it stale or a later apply_dirty would
+            # run selection over rows missing this churn
             self._last_db = None
+            self._table_synced = False
             return None
         prev_enc = self._last_enc
         enc = self._encoded(area_link_states, me)

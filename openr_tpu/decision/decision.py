@@ -274,7 +274,13 @@ class Decision(Actor):
             return
         if self.rib_policy is not None and self.rib_policy.is_active(self.clock):
             self.rib_policy.apply_policy(new_db, self.clock)
-        update = self.route_db.calculate_update(new_db)
+        if force_full:
+            update = self.route_db.calculate_update(new_db)
+        else:
+            # incremental contract: only the changed prefixes can differ —
+            # diff O(changed) instead of O(total) so the publication→FIB
+            # latency stays flat in total prefix count
+            update = self.route_db.calculate_update_for(new_db, changed)
         first = not self._first_build_done
         if first:
             update = DecisionRouteUpdate(

@@ -147,6 +147,33 @@ class DecisionRouteDb:
                 update.mpls_routes_to_delete.append(label)
         return update
 
+    def calculate_update_for(
+        self, new_db: "DecisionRouteDb", prefixes
+    ) -> "DecisionRouteUpdate":
+        """Diff self → new_db restricted to ``prefixes`` — O(changed), not
+        O(total).  Valid when the caller guarantees every other unicast
+        route is unchanged (the incremental-rebuild contract: backends
+        patch only the changed prefixes, Decision.cpp:908-952).  MPLS
+        routes are diffed in full (O(labels) = O(nodes), cheap relative
+        to the prefix table)."""
+        update = DecisionRouteUpdate(type=DecisionRouteUpdateType.INCREMENTAL)
+        for prefix in prefixes:
+            old = self.unicast_routes.get(prefix)
+            new = new_db.unicast_routes.get(prefix)
+            if new is None:
+                if old is not None:
+                    update.unicast_routes_to_delete.append(prefix)
+            elif old is None or not old.eq_ignoring_cost(new):
+                update.unicast_routes_to_update[prefix] = new
+        for label, mentry in new_db.mpls_routes.items():
+            old_m = self.mpls_routes.get(label)
+            if old_m is None or old_m != mentry:
+                update.mpls_routes_to_update[label] = mentry
+        for label in self.mpls_routes:
+            if label not in new_db.mpls_routes:
+                update.mpls_routes_to_delete.append(label)
+        return update
+
     def to_route_database(self, node_name: str = "") -> RouteDatabase:
         return RouteDatabase(
             this_node_name=node_name,

@@ -150,7 +150,11 @@ class TcpKvStoreTransport(KvStoreTransport):
     machinery drives reconnects.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, tls=None) -> None:
+        #: TlsConfig for peer sessions — peers' ctrl servers must run the
+        #: same TLS posture (Main.cpp:399-416: one thrift server serves
+        #: both operators and KvStore peers, so one cert config covers both)
+        self.tls = tls
         self._specs: Dict[str, Tuple[str, int]] = {}
         self._clients: Dict[str, object] = {}
         #: strong refs to detached close() tasks (loop refs are weak)
@@ -217,7 +221,7 @@ class TcpKvStoreTransport(KvStoreTransport):
                 raise KvStoreTransportError(f"no PeerSpec for {peer_node}")
             try:
                 client = await OpenrCtrlClient(
-                    host=target[0], port=target[1]
+                    host=target[0], port=target[1], tls=self.tls
                 ).connect()
             except OSError as e:
                 raise KvStoreTransportError(

@@ -124,7 +124,7 @@ def encode_link_state(
     link_state: LinkState,
     node_bucket: Optional[int] = None,
     edge_bucket: Optional[int] = None,
-    node_buckets: Sequence[int] = (16, 64, 256, 1024, 4096),
+    node_buckets: Sequence[int] = (16, 64, 256, 1024, 4096, 16384),
     edge_multiplier: int = 8,
     extra_nodes: Sequence[str] = (),
 ) -> EncodedTopology:
@@ -391,7 +391,7 @@ class EncodedMultiArea:
 def encode_multi_area(
     area_link_states,
     me: str,
-    node_buckets: Sequence[int] = (16, 64, 256, 1024, 4096),
+    node_buckets: Sequence[int] = (16, 64, 256, 1024, 4096, 16384),
     edge_multiplier: int = 8,
 ) -> EncodedMultiArea:
     """Encode all areas to common node/edge buckets so the kernel's area
@@ -429,82 +429,6 @@ def encode_multi_area(
         overloaded=np.stack([t.overloaded for t in topos]),
         soft=np.stack([t.soft for t in topos]),
         roots=np.asarray([t.node_id(me) for t in topos], np.int32),
-    )
-
-
-@dataclasses.dataclass
-class EncodedMultiAreaCandidates:
-    """[P, C] candidate tables for the multi-area selection kernel."""
-
-    cand_area: np.ndarray  # [P, C] int32 area index
-    cand_node: np.ndarray  # [P, C] int32 id in own area
-    cand_ok: np.ndarray  # [P, C] bool
-    drain_metric: np.ndarray  # [P, C] int32
-    path_pref: np.ndarray  # [P, C] int32
-    source_pref: np.ndarray  # [P, C] int32
-    distance: np.ndarray  # [P, C] int32
-    cand_node_in_area: np.ndarray  # [P, C, A] int32 (-1 = absent)
-    prefixes: List[str]
-
-
-def encode_prefix_candidates_multi(
-    prefix_state,
-    enc: EncodedMultiArea,
-    cand_buckets: Sequence[int] = (8, 16, 32, 64),
-) -> EncodedMultiAreaCandidates:
-    """Flatten PrefixState across ALL areas into padded candidate arrays.
-    Candidates advertised in unknown areas or by nodes absent from their
-    area's graph are dropped (scalar: unreachable, filtered before
-    selection — SpfSolver.cpp:195-215)."""
-    by_area = {a: i for i, a in enumerate(enc.areas)}
-    prefixes = sorted(prefix_state.prefixes().keys())
-    P = max(len(prefixes), 1)
-    A = enc.num_areas
-
-    rows: List[List[Tuple[int, int, str, object]]] = []
-    widest = 1
-    for prefix in prefixes:
-        row = []
-        for (node, parea), entry in sorted(
-            prefix_state.prefixes()[prefix].items()
-        ):
-            ai = by_area.get(parea)
-            if ai is None or node not in enc.topos[ai].node_ids:
-                continue
-            row.append((ai, enc.topos[ai].node_ids[node], node, entry))
-        rows.append(row)
-        widest = max(widest, len(row))
-    C = bucket_for(widest, cand_buckets)
-
-    cand_area = np.zeros((P, C), np.int32)
-    cand_node = np.zeros((P, C), np.int32)
-    cand_ok = np.zeros((P, C), bool)
-    drain = np.zeros((P, C), np.int32)
-    pp = np.zeros((P, C), np.int32)
-    sp = np.zeros((P, C), np.int32)
-    dist = np.zeros((P, C), np.int32)
-    cnia = np.full((P, C, A), -1, np.int32)
-    for p, row in enumerate(rows):
-        for c, (ai, nid, node, entry) in enumerate(row):
-            cand_area[p, c] = ai
-            cand_node[p, c] = nid
-            cand_ok[p, c] = True
-            drain[p, c] = entry.metrics.drain_metric
-            pp[p, c] = entry.metrics.path_preference
-            sp[p, c] = entry.metrics.source_preference
-            dist[p, c] = entry.metrics.distance
-            for a2 in range(A):
-                cnia[p, c, a2] = enc.topos[a2].node_ids.get(node, -1)
-    return EncodedMultiAreaCandidates(
-        cand_area=cand_area,
-        cand_node=cand_node,
-        cand_ok=cand_ok,
-        drain_metric=drain,
-        path_pref=pp,
-        source_pref=sp,
-        distance=dist,
-        cand_node_in_area=cnia,
-        prefixes=prefixes,
     )
 
 

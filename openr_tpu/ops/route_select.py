@@ -263,48 +263,6 @@ def multi_area_select_from_tables(
     return use, shortest, lanes, valid
 
 
-def multi_area_spf_and_select(
-    src,
-    dst,
-    w,
-    edge_ok,
-    overloaded,
-    soft,
-    roots,
-    cand_area,
-    cand_node,
-    cand_ok,
-    drain_metric,
-    path_pref,
-    source_pref,
-    distance,
-    cand_node_in_area,
-    max_degree: int,
-    per_area_distance: bool,
-):
-    """Full multi-area buildRouteDb hot loop: per-area SPF tables + global
-    selection (composition of the two jits; the backend calls them
-    separately to cache SPF tables across prefix-only rebuilds)."""
-    dist, nh = multi_area_spf_tables(
-        src, dst, w, edge_ok, overloaded, roots, max_degree=max_degree
-    )
-    return multi_area_select_from_tables(
-        dist,
-        nh,
-        overloaded,
-        soft,
-        cand_area,
-        cand_node,
-        cand_ok,
-        drain_metric,
-        path_pref,
-        source_pref,
-        distance,
-        cand_node_in_area,
-        per_area_distance=per_area_distance,
-    )
-
-
 @functools.partial(jax.jit, static_argnames=("max_degree",))
 def spf_and_select(
     src,

@@ -204,6 +204,51 @@ def test_tpu_incremental_across_topology_change():
     assert backend.num_incremental_builds == inc_before + 1
 
 
+def test_tpu_table_resync_after_me_absent_tick():
+    """A tick where the local node vanishes from every area returns None
+    BEFORE the candidate table sees that tick's prefix churn; the table
+    must be marked stale so the next build re-reads PrefixState instead
+    of serving stale candidate rows (code-review regression)."""
+    rng = random.Random(3)
+    ls = make_link_state(4)
+    als = {"0": ls}
+    ps = PrefixState()
+    ps.update_prefix(
+        "node8",
+        "0",
+        PrefixEntry("10.0.0.1/32", metrics=PrefixMetrics(path_preference=100)),
+    )
+    backend = TpuBackend(SpfSolver("node0"))
+    backend.build_route_db(als, ps)
+
+    # tick 1: node0 leaves the graph AND the prefix gains a better
+    # advertiser — the me-absent early return consumes this delta
+    saved_db = ls.get_adjacency_databases()["node0"]
+    ls.delete_adjacency_database("node0")
+    changed = ps.update_prefix(
+        "node4",
+        "0",
+        PrefixEntry("10.0.0.1/32", metrics=PrefixMetrics(path_preference=1000)),
+    )
+    assert (
+        backend.build_route_db(als, ps, changed_prefixes=changed) is None
+    )
+
+    # tick 2: node0 returns (topology change → force_full, empty delta)
+    ls.update_adjacency_database(saved_db)
+    db = backend.build_route_db(
+        als, ps, changed_prefixes=set(), force_full=True
+    )
+    oracle = ScalarBackend(SpfSolver("node0")).build_route_db(als, ps)
+    assert route_db_summary(db) == route_db_summary(oracle)
+    assert (
+        db.unicast_routes["10.0.0.1/32"].best_prefix_entry.metrics
+        .path_preference
+        == 1000
+    )
+    del rng
+
+
 def test_decision_actor_incremental_builds():
     """End-to-end through the Decision actor: prefix-only publications
     after the first build run the incremental path and the final RouteDb
