@@ -156,10 +156,12 @@ def bench_decision_initial(results: List[Dict], full: bool) -> None:
                 )
             )
         if timings.get("scalar") and timings.get("tpu"):
-            _result(
-                f"decision_initial_{kind}{n}_ppn{ppn}_speedup",
-                timings["scalar"] / timings["tpu"],
-                "x",
+            results.append(
+                _result(
+                    f"decision_initial_{kind}{n}_ppn{ppn}_speedup",
+                    timings["scalar"] / timings["tpu"],
+                    "x",
+                )
             )
 
 

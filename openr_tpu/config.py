@@ -169,7 +169,9 @@ class TpuComputeConfig:
 
     enable_tpu_spf: bool = True
     #: pad |V| and |E| up to the next bucket to stabilize compiled shapes
-    node_buckets: List[int] = field(default_factory=lambda: [16, 64, 256, 1024])
+    node_buckets: List[int] = field(
+        default_factory=lambda: [16, 64, 256, 1024, 4096, 16384]
+    )
     edge_bucket_multiplier: int = 8  # max_edges = multiplier * max_nodes
     #: nexthop bitmask words (32 neighbors per word)
     nexthop_words: int = 2

@@ -54,6 +54,12 @@ class KvStorePeer:
     flaps: int = 0
     num_failures: int = 0
     sync_task: Optional[asyncio.Task] = None
+    #: keys whose flood this peer missed while not yet INITIALIZED —
+    #: flushed when the session establishes (the reference's
+    #: pendingKeysDuringInitialization, KvStore.h:468: the peer's full
+    #: sync snapshot was diffed BEFORE these arrived, so without this
+    #: buffer the update is lost until some later full sync)
+    pending_keys: Set[str] = field(default_factory=set)
 
 
 @dataclass
@@ -193,6 +199,27 @@ class KvStoreDb:
             # DUAL runs over established peer sessions only; unit link cost
             # (the flood tree minimises hops, not metric)
             self.dual.peer_up(peer.node_name, 1)
+        if state == KvStorePeerState.INITIALIZED and peer.pending_keys:
+            # flush floods the peer missed while syncing
+            # (floodBufferedUpdates for pendingKeysDuringInitialization)
+            key_vals = {
+                k: self._flood_copy(self.key_vals[k])
+                for k in sorted(peer.pending_keys)
+                if k in self.key_vals
+            }
+            peer.pending_keys.clear()
+            if key_vals:
+                self.actor.spawn(
+                    self._flood_to_peer(
+                        peer,
+                        Publication(
+                            key_vals=key_vals,
+                            area=self.area,
+                            node_ids=[self.node_name],
+                        ),
+                    ),
+                    name=f"kvstore.{self.area}.flush.{peer.node_name}",
+                )
         self.actor.counters.set(
             f"kvstore.{self.area}.peer.{peer.node_name}.state", int(state)
         )
@@ -316,12 +343,20 @@ class KvStoreDb:
             ours = (value.version, value.originator_id, value.hash)
             if ours == (their_version, their_originator, their_hash):
                 continue
-            if (value.version, value.originator_id) >= (
-                their_version,
-                their_originator,
-            ):
+            mine_key = (value.version, value.originator_id)
+            their_key = (their_version, their_originator)
+            if mine_key > their_key:
                 newer[key] = self._flood_copy(value)
+            elif mine_key < their_key:
+                tobe_updated.append(key)
             else:
+                # same (version, originator) but different hash: the
+                # digest can't order the values, so send ours AND name
+                # the key tobe-updated — compareValues on each side
+                # settles the winner (without the push-back, an initiator
+                # whose value wins the larger-value tie-break keeps it
+                # while we never learn it: permanent divergence)
+                newer[key] = self._flood_copy(value)
                 tobe_updated.append(key)
         for key in key_val_hashes:
             if key not in self.key_vals:
@@ -401,6 +436,9 @@ class KvStoreDb:
             if name == sender:
                 continue  # dedup: never reflect to the sender
             if peer.state != KvStorePeerState.INITIALIZED:
+                # buffer for flush at session establishment — this
+                # peer's in-flight full sync snapshot predates these keys
+                peer.pending_keys.update(flood_pub.key_vals.keys())
                 continue
             if flood_set is not None and name not in flood_set:
                 continue  # flood optimization: SPT edges only

@@ -209,7 +209,6 @@ def test_tpu_table_resync_after_me_absent_tick():
     BEFORE the candidate table sees that tick's prefix churn; the table
     must be marked stale so the next build re-reads PrefixState instead
     of serving stale candidate rows (code-review regression)."""
-    rng = random.Random(3)
     ls = make_link_state(4)
     als = {"0": ls}
     ps = PrefixState()
@@ -246,7 +245,6 @@ def test_tpu_table_resync_after_me_absent_tick():
         .path_preference
         == 1000
     )
-    del rng
 
 
 def test_decision_actor_incremental_builds():

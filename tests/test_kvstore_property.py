@@ -1,0 +1,236 @@
+"""KvStore eventual-consistency property tests — randomized schedules.
+
+SURVEY §7 hard-part 5 / VERDICT r2 item 6: the reference's merge rules
+(KvStoreUtil.cpp:391 mergeKeyValues, :470 compareValues) must make every
+interleaving of merge/flood/full-sync/TTL-expiry/failure events converge
+to ONE map on every store.  Each schedule here runs REAL KvStore actors
+over the in-process transport (real peer FSM, 3-way sync, flooding,
+backoff, TTL countdown) on a virtual clock:
+
+  * 3-5 stores on a random connected topology (spanning tree + chords)
+  * peers wired in random order at random times
+  * conflicting writes: overlapping keys injected via set_key_vals with
+    random (version, originator, value, ttl_version), plus per-store
+    self-originated keys (whose owners must win back override attempts)
+  * link failures: random (src, dst) call-blackholes opened and healed
+  * peer flaps: del_peers + re-add
+  * TTL: short-lived injected keys must expire EVERYWHERE; long-lived
+    keys must survive
+
+After the schedule, everything heals and the network settles in virtual
+time; every store must hold the identical (version, originator, value,
+ttl_version) map, with every short-TTL key gone.  100+ seeds run in CI
+(virtual time makes each schedule ~wall-milliseconds).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import KvStoreConfig
+from openr_tpu.kvstore.kv_store import KvStore
+from openr_tpu.kvstore.transport import InProcessTransport
+from openr_tpu.messaging.queue import ReplicateQueue
+
+AREA = "0"
+SHORT_TTL_MS = 3_000
+LONG_TTL_MS = 3_600_000
+
+
+def snapshot(store: KvStore):
+    return {
+        k: (v.version, v.originator_id, v.value, v.ttl_version)
+        for k, v in store.areas[AREA].key_vals.items()
+    }
+
+
+def random_connected_edges(rng: random.Random, n: int):
+    """Random spanning tree + up to n extra chords."""
+    edges = set()
+    order = list(range(n))
+    rng.shuffle(order)
+    for i in range(1, n):
+        a = order[i]
+        b = order[rng.randrange(i)]
+        edges.add((min(a, b), max(a, b)))
+    for _ in range(rng.randrange(n + 1)):
+        a, b = rng.randrange(n), rng.randrange(n)
+        if a != b:
+            edges.add((min(a, b), max(a, b)))
+    return sorted(edges)
+
+
+async def run_schedule(seed: int) -> None:
+    rng = random.Random(seed)
+    clock = SimClock()
+    transport = InProcessTransport(
+        clock, latency_s=rng.choice([0.0, 0.001, 0.01])
+    )
+    n = rng.randint(3, 5)
+    names = [f"s{i}" for i in range(n)]
+    cfg = KvStoreConfig(
+        key_ttl_ms=LONG_TTL_MS, self_originated_key_ttl_ms=LONG_TTL_MS
+    )
+    stores = []
+    for name in names:
+        store = KvStore(
+            node_name=name,
+            clock=clock,
+            config=cfg,
+            areas=[AREA],
+            transport=transport,
+            publications_queue=ReplicateQueue(f"{name}.pubs"),
+        )
+        transport.register(name, store)
+        stores.append(store)
+        store.start()
+
+    edges = random_connected_edges(rng, n)
+    peer_specs = {i: {} for i in range(n)}
+    for a, b in edges:
+        peer_specs[a][names[b]] = None
+        peer_specs[b][names[a]] = None
+
+    from openr_tpu.types import PeerSpec, Value
+
+    # wire peers in random order, possibly interleaved with early writes
+    wiring = [(i, peer) for i in range(n) for peer in peer_specs[i]]
+    rng.shuffle(wiring)
+
+    failed_pairs = set()
+    short_ttl_keys = set()
+    #: (owner_name, key) pairs actually persisted — only these are
+    #: defended by their owner's _guard_self_originated
+    self_originated = set()
+
+    def inject_write(step: int) -> None:
+        store = rng.choice(stores)
+        kind = rng.random()
+        if kind < 0.45:
+            # conflicting plain key: overlapping names, random attributes
+            key = f"conf:k{rng.randrange(8)}"
+            val = Value(
+                version=rng.randint(1, 6),
+                originator_id=f"s{rng.randrange(n)}",
+                value=bytes([rng.randrange(256)]) * rng.randint(1, 3),
+                ttl=LONG_TTL_MS,
+                ttl_version=rng.randrange(3),
+            )
+            store.set_key_vals(AREA, {key: val})
+        elif kind < 0.6:
+            # short-TTL key: must be gone everywhere at the end
+            key = f"ttl:k{step}"
+            short_ttl_keys.add(key)
+            store.set_key_vals(
+                AREA,
+                {
+                    key: Value(
+                        version=1,
+                        originator_id=store.node_name,
+                        value=b"dying",
+                        ttl=SHORT_TTL_MS,
+                    )
+                },
+            )
+        elif kind < 0.8:
+            # self-originated persist (owner refreshes + defends it)
+            key = f"prefix:{store.node_name}:p{rng.randrange(3)}"
+            store.areas[AREA].persist_self_originated_key(
+                key, bytes([rng.randrange(256)])
+            )
+            self_originated.add((store.node_name, key))
+        else:
+            # override attack on someone's self-originated key: the owner
+            # must win it back with a higher version
+            victim = rng.choice(stores)
+            store.set_key_vals(
+                AREA,
+                {
+                    f"prefix:{victim.node_name}:p0": Value(
+                        version=rng.randint(1, 20),
+                        originator_id=store.node_name,
+                        value=b"squat",
+                        ttl=LONG_TTL_MS,
+                    )
+                },
+            )
+
+    def flip_failure() -> None:
+        if failed_pairs and rng.random() < 0.5:
+            failed_pairs.discard(rng.choice(sorted(failed_pairs)))
+        else:
+            a, b = rng.sample(range(n), 2)
+            failed_pairs.add((names[a], names[b]))
+        transport._failed = set(failed_pairs)
+
+    def flap_peer() -> None:
+        a, b = rng.choice(edges)
+        stores[a].areas[AREA].del_peers([names[b]])
+        stores[a].areas[AREA].add_peers({names[b]: PeerSpec()})
+
+    # schedule: wiring + ~25 events interleaved in virtual time
+    events = [("wire", w) for w in wiring]
+    for step in range(25):
+        r = rng.random()
+        if r < 0.6:
+            events.append(("write", step))
+        elif r < 0.85:
+            events.append(("fail", step))
+        else:
+            events.append(("flap", step))
+    rng.shuffle(events)
+
+    for ev, arg in events:
+        await clock.run_for(rng.random() * 2.0)
+        if ev == "wire":
+            i, peer = arg
+            stores[i].areas[AREA].add_peers({peer: PeerSpec()})
+        elif ev == "write":
+            inject_write(arg)
+        elif ev == "fail":
+            flip_failure()
+        else:
+            flap_peer()
+
+    # heal everything and settle: past the max sync backoff (256s,
+    # Constants.h / constants.py KVSTORE_SYNC_MAX_BACKOFF_S — a peer that
+    # failed repeatedly retries that late) and every short TTL
+    transport._failed = set()
+    await clock.run_for(600.0)
+
+    try:
+        base = snapshot(stores[0])
+        for store in stores[1:]:
+            assert snapshot(store) == base, (
+                f"seed {seed}: stores diverged"
+            )
+        for key in short_ttl_keys:
+            assert key not in base, f"seed {seed}: {key} survived its TTL"
+        # owners won back the self-originated keys they actually persisted
+        # (a squat on a never-persisted key name has no defender and
+        # legitimately sticks)
+        for owner, key in self_originated:
+            assert key in base, f"seed {seed}: {key} missing"
+            assert base[key][1] == owner, (
+                f"seed {seed}: {key} owned by {base[key][1]}, not {owner}"
+            )
+    finally:
+        for store in stores:
+            await store.stop()
+
+
+@pytest.mark.parametrize("chunk", range(4))
+def test_randomized_schedules(chunk):
+    """100 seeded schedules (25 per chunk for parallelism/granularity)."""
+
+    async def main():
+        for seed in range(chunk * 25, (chunk + 1) * 25):
+            await run_schedule(seed)
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(main())
+    finally:
+        loop.close()

@@ -118,7 +118,7 @@ def test_config_json_roundtrip():
     assert back.area_ids() == ["pod1", "spine"]
     assert back.decision_config.debounce_min_ms == 20
     assert back.spark_config.hold_time_s == 15.0
-    assert back.tpu_compute_config.node_buckets == [16, 64, 256, 1024]
+    assert back.tpu_compute_config.node_buckets == [16, 64, 256, 1024, 4096, 16384]
 
 
 def test_config_validation():
