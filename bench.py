@@ -177,8 +177,48 @@ def main() -> None:
     single = eng.run(fails, fetch=False)
     single.block()
     engine_latency_ms = (time.perf_counter() - t0) * 1000
+    # ---- sweep → routes: on-device selection + delta-only fetch ----------
+    # (ops/sweep_select.py): 1024 loopback prefixes selected against every
+    # snapshot ON DEVICE, diffed vs the base route table on device, and
+    # only the changed route rows cross the tunnel — the full end-to-end
+    # sweep→routes story, replacing the old multi-MB unique-table fetch
+    from openr_tpu.ops.sweep_select import SweepCandidates, SweepRouteSelector
+
+    sel = SweepRouteSelector(
+        topo,
+        "node0",
+        SweepCandidates.single_advertiser(np.arange(n_nodes)),
+        max_degree=eng.D,
+    )
+    deltas = sel.run(single)  # warm-up (compiles chunk + gather shapes)
+    t0 = time.perf_counter()
+    sweep2 = eng.run(fails, fetch=False)
+    deltas = sel.run(sweep2)
+    routes_pipeline_ms = (time.perf_counter() - t0) * 1000
+    # route parity vs native for sample snapshots (base + changed rows)
+    for s in (3, 1007, 9000):
+        native.solve(failed_link=int(fails[s]))
+        valid, metric, lanes = deltas.routes_of(s)
+        nd = native.dist[:n_nodes]
+        nl = native.lanes_dense(eng.D)[:n_nodes]
+        # valid = advertiser reachable with a first-hop set, and not the
+        # root's own prefix (skip-if-self)
+        exp_valid = (
+            np.isfinite(nd)
+            & nl.any(axis=1)
+            & (np.arange(n_nodes) != topo.node_id("node0"))
+        )
+        assert np.array_equal(valid, exp_valid), f"route valid parity {s}"
+        assert np.array_equal(metric[exp_valid], nd[exp_valid]), (
+            f"route metric parity {s}"
+        )
+        assert np.array_equal(lanes[exp_valid], nl[exp_valid]), (
+            f"route lane parity {s}"
+        )
+
     # host fetch of the unique tables (tunnel-bound; reported, not part
-    # of the throughput number — downstream kernels consume on device)
+    # of the throughput number — the routes pipeline above is what
+    # downstream consumes; this line kept for the before/after contrast)
     t0 = time.perf_counter()
     single.materialize()
     fetch_ms = (time.perf_counter() - t0) * 1000
@@ -220,6 +260,9 @@ def main() -> None:
                     "engine_latency_ms": round(engine_latency_ms, 1),
                     "base_solve_ms": round(base_solve_ms, 1),
                     "repair_plan_build_ms": round(plan_build_ms, 1),
+                    "routes_pipeline_ms": round(routes_pipeline_ms, 1),
+                    "route_deltas": int(deltas.num_deltas),
+                    "route_delta_fetch_bytes": int(deltas.fetch_bytes),
                     "host_fetch_unique_tables_ms": round(fetch_ms, 1),
                     "dispatch_sync_ms": round(sync_ms, 1),
                     "unique_device_solves": int(single.num_device_solves),

@@ -1,0 +1,403 @@
+"""Sweep → routes: on-device best-route selection over what-if solves.
+
+VERDICT r2 weak #4 / item 10: the what-if engine's SPF tables used to
+stop at distance/lane fields — downstream route selection ran on host
+after a ~2s fetch of the unique-solve tables.  This module fuses the
+selection chain (reach ▸ hard-drain fallback ▸ drain ▸ path-pref ▸
+source-pref ▸ distance ▸ igp-tie ECMP ▸ min-nexthop — the
+SpfSolver.cpp:161-312 semantics already encoded in
+``ops.route_select.select_routes_one``) onto the DEVICE-RESIDENT repair
+chunks (``ops.repair.RepairSweep`` output: dist [V, b] f32 +
+batch-bit-packed first-hop lanes [V, D, b/32]), diffs every snapshot's
+route table against the base solve ON DEVICE, and fetches ONLY the
+route deltas:
+
+  1. per chunk: one small fetch of a bit-packed changed-row mask
+     ([b, P/32] words), then
+  2. one gather fetch of exactly the changed (snapshot, prefix) route
+     rows (valid, metric, packed ECMP lanes) — payload scales with how
+     many routes actually changed, not with B x P.
+
+A single link failure on a 1024-node WAN typically changes a handful of
+routes; the full-table fetch this replaces moved U x V x D lane tables
+over the tunnel regardless.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from openr_tpu.ops.csr import EncodedTopology, bucket_for
+
+#: gathered-delta row buckets (stable jit shapes for the gather kernel)
+DELTA_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144, 1048576)
+
+
+@dataclasses.dataclass
+class SweepCandidates:
+    """Single-area [P, C] candidate table for the sweep's vantage root
+    (the sweep perturbs one area's topology; candidates resolve in it)."""
+
+    cand_node: np.ndarray  # [P, C] int32
+    cand_ok: np.ndarray  # [P, C] bool
+    drain_metric: np.ndarray  # [P, C] int32
+    path_pref: np.ndarray  # [P, C] int32
+    source_pref: np.ndarray  # [P, C] int32
+    distance: np.ndarray  # [P, C] int32
+    min_nexthop: np.ndarray  # [P, C] int32 (0 = unset)
+
+    @classmethod
+    def single_advertiser(cls, advertisers):
+        """P prefixes each advertised by one node id — the common
+        loopback-per-node shape."""
+        nodes = np.asarray(advertisers, np.int32).reshape(-1, 1)
+        P = nodes.shape[0]
+        return cls(
+            cand_node=nodes,
+            cand_ok=np.ones((P, 1), bool),
+            drain_metric=np.zeros((P, 1), np.int32),
+            path_pref=np.zeros((P, 1), np.int32),
+            source_pref=np.zeros((P, 1), np.int32),
+            distance=np.zeros((P, 1), np.int32),
+            min_nexthop=np.zeros((P, 1), np.int32),
+        )
+
+
+@dataclasses.dataclass
+class SweepRouteDeltas:
+    """Base route table + per-unique-solve route deltas.
+
+    ``snap_row[s]`` maps snapshot s to its unique-solve row (0 = base:
+    no deltas).  Rows with deltas are listed in (delta_row,
+    delta_prefix) coordinate arrays; ``routes_of(s)`` reconstructs the
+    full [P] route table of any snapshot by patching the base."""
+
+    snap_row: np.ndarray  # [B]
+    num_prefixes: int
+    max_degree: int
+    base_valid: np.ndarray  # [P] bool
+    base_metric: np.ndarray  # [P] f32
+    base_lanes: np.ndarray  # [P, D] int8
+    delta_row: np.ndarray  # [K] int32 unique-solve row (>= 1)
+    delta_prefix: np.ndarray  # [K] int32
+    delta_valid: np.ndarray  # [K] bool
+    delta_metric: np.ndarray  # [K] f32
+    delta_lanes: np.ndarray  # [K, D] int8
+    #: bytes actually moved device->host for masks + deltas
+    fetch_bytes: int = 0
+
+    def __post_init__(self):
+        order = np.argsort(self.delta_row, kind="stable")
+        for f in (
+            "delta_row",
+            "delta_prefix",
+            "delta_valid",
+            "delta_metric",
+            "delta_lanes",
+        ):
+            setattr(self, f, getattr(self, f)[order])
+        # row -> [start, end) via run-length over the sorted rows
+        self._row_slices: Dict[int, Tuple[int, int]] = {}
+        rows, counts = np.unique(self.delta_row, return_counts=True)
+        off = 0
+        for r, c in zip(rows, counts):
+            self._row_slices[int(r)] = (off, off + int(c))
+            off += int(c)
+
+    @property
+    def num_deltas(self) -> int:
+        return int(self.delta_row.shape[0])
+
+    def deltas_of_row(self, row: int):
+        s, e = self._row_slices.get(int(row), (0, 0))
+        return (
+            self.delta_prefix[s:e],
+            self.delta_valid[s:e],
+            self.delta_metric[s:e],
+            self.delta_lanes[s:e],
+        )
+
+    def routes_of(self, snapshot: int):
+        """(valid [P], metric [P], lanes [P, D]) for one snapshot."""
+        valid = self.base_valid.copy()
+        metric = self.base_metric.copy()
+        lanes = self.base_lanes.copy()
+        row = int(self.snap_row[snapshot])
+        if row != 0:
+            p, v, m, ln = self.deltas_of_row(row)
+            valid[p] = v
+            metric[p] = m
+            lanes[p] = ln
+        return valid, metric, lanes
+
+
+def _pack_bits_last(x, width: int):
+    """[..., width] int -> [..., ceil(width/32)] uint32 bit words."""
+    W = (width + 31) // 32
+    pad = W * 32 - width
+    xp = jnp.pad(x.astype(jnp.uint32), [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xp = xp.reshape(x.shape[:-1] + (W, 32))
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(xp * weights, axis=-1, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def _select_chunk(
+    dist_d,  # [V, b] f32
+    nh_packed,  # [V, D, b/32] uint32 (batch-bit-packed lanes)
+    overloaded,  # [V]
+    soft,  # [V]
+    root,  # scalar
+    cand_node,
+    cand_ok,
+    drain_metric,
+    path_pref,
+    source_pref,
+    distance,
+    min_nexthop,
+    base_valid,  # [P] bool
+    base_metric,  # [P] f32
+    base_lanes_packed,  # [P, Dw] uint32
+    max_degree: int,
+):
+    """Per-chunk batched selection + on-device delta vs base.
+
+    Returns (changed_packed [b, P/32] uint32, valid [b, P] bool,
+    metric [b, P] f32, lanes_packed [b, P, Dw] uint32) — all device
+    resident; the caller fetches changed_packed (small) and then
+    gathers only changed rows."""
+    from openr_tpu.ops.route_select import select_routes_one
+
+    b = dist_d.shape[1]
+    # unpack batch bit j from word j//32
+    widx = jnp.arange(b) // 32
+    bit = (jnp.arange(b) % 32).astype(jnp.uint32)
+    nh_b = (nh_packed[:, :, widx] >> bit) & jnp.uint32(1)  # [V, D, b]
+    nh_b = jnp.moveaxis(nh_b, 2, 0).astype(jnp.int8)  # [b, V, D]
+
+    def one(d, n):
+        valid, metric, nh_out, _num, _use = select_routes_one(
+            cand_node,
+            cand_ok,
+            drain_metric,
+            path_pref,
+            source_pref,
+            distance,
+            min_nexthop,
+            d,
+            n,
+            overloaded,
+            soft,
+            root,
+        )
+        return valid, metric, nh_out
+
+    valid, metric, nh_out = jax.vmap(one)(dist_d.T, nh_b)
+    lanes_packed = _pack_bits_last(nh_out, max_degree)  # [b, P, Dw]
+    changed = (valid != base_valid[None, :]) | (
+        valid
+        & base_valid[None, :]
+        & (
+            (metric != base_metric[None, :])
+            | jnp.any(lanes_packed != base_lanes_packed[None, :, :], axis=-1)
+        )
+    )
+    changed_packed = _pack_bits_last(changed, changed.shape[1])  # [b, Pw]
+    return changed_packed, valid, metric, lanes_packed
+
+
+@jax.jit
+def _gather_deltas(valid, metric, lanes_packed, flat_idx):
+    """Gather changed (snapshot, prefix) rows by flat index j*P + p."""
+    P = valid.shape[1]
+    j = flat_idx // P
+    p = flat_idx % P
+    return valid[j, p], metric[j, p], lanes_packed[j, p]
+
+
+class SweepRouteSelector:
+    """sweep → routes pipeline over one (topology, root, candidates)."""
+
+    def __init__(
+        self,
+        topo: EncodedTopology,
+        root: str,
+        cands: SweepCandidates,
+        max_degree: int,
+    ) -> None:
+        import jax.numpy as jnp
+
+        self.topo = topo
+        self.root_id = topo.node_id(root)
+        self.D = max_degree
+        self.Dw = (max_degree + 31) // 32
+        self.cands = cands
+        self._dev = dict(
+            overloaded=jnp.asarray(topo.overloaded),
+            soft=jnp.zeros(topo.padded_nodes, jnp.int32),
+            root=jnp.int32(self.root_id),
+            cand_node=jnp.asarray(cands.cand_node),
+            cand_ok=jnp.asarray(cands.cand_ok),
+            drain_metric=jnp.asarray(cands.drain_metric),
+            path_pref=jnp.asarray(cands.path_pref),
+            source_pref=jnp.asarray(cands.source_pref),
+            distance=jnp.asarray(cands.distance),
+            min_nexthop=jnp.asarray(cands.min_nexthop),
+        )
+        self._base = None  # (valid [P], metric [P], lanes [P, D] int8)
+        self._base_dev = None
+        #: held references to the base arrays the cache was built from
+        #: (identity by reference, never id(): ids are reused after GC)
+        self._base_key = None
+
+    # -- base route table --------------------------------------------------
+
+    def base_routes(self, base_dist: np.ndarray, base_nh: np.ndarray):
+        """Select routes for the unperturbed solve (device, one batch of
+        1); caches both host and device copies, keyed by the base-array
+        identities — a sweep from a re-built engine (new base solve)
+        must not be diffed against a stale base table."""
+        key = self._base_key
+        if (
+            self._base is not None
+            and key is not None
+            and key[0] is base_dist
+            and key[1] is base_nh
+        ):
+            return self._base
+        from openr_tpu.ops.route_select import select_routes_one
+
+        valid, metric, nh_out, _num, _use = jax.jit(select_routes_one)(
+            self._dev["cand_node"],
+            self._dev["cand_ok"],
+            self._dev["drain_metric"],
+            self._dev["path_pref"],
+            self._dev["source_pref"],
+            self._dev["distance"],
+            self._dev["min_nexthop"],
+            jnp.asarray(base_dist),
+            jnp.asarray(base_nh),
+            self._dev["overloaded"],
+            self._dev["soft"],
+            self._dev["root"],
+        )
+        lanes_packed = _pack_bits_last(nh_out, self.D)
+        self._base_dev = (
+            jnp.asarray(valid),
+            jnp.asarray(metric),
+            lanes_packed,
+        )
+        v, m, n = jax.device_get((valid, metric, nh_out))
+        self._base = (v, m, n.astype(np.int8))
+        self._base_key = (base_dist, base_nh)
+        return self._base
+
+    # -- the pipeline ------------------------------------------------------
+
+    def run(self, sweep_result) -> SweepRouteDeltas:
+        """Consume a DEVICE-RESIDENT SweepResult (fetch=False) and return
+        route deltas with delta-only host fetches."""
+        base_dist, base_nh = sweep_result.base
+        self.base_routes(base_dist, base_nh)
+        bvalid_d, bmetric_d, blanes_d = self._base_dev
+        P = self.cands.cand_node.shape[0]
+
+        fetch_bytes = 0
+        d_rows: List[np.ndarray] = []
+        d_prefix: List[np.ndarray] = []
+        d_valid: List[np.ndarray] = []
+        d_metric: List[np.ndarray] = []
+        d_lanes: List[np.ndarray] = []
+        for off, n, dist_d, nh_d in sweep_result.chunks or []:
+            changed_packed, valid, metric, lanes_packed = _select_chunk(
+                dist_d,
+                nh_d,
+                self._dev["overloaded"],
+                self._dev["soft"],
+                self._dev["root"],
+                self._dev["cand_node"],
+                self._dev["cand_ok"],
+                self._dev["drain_metric"],
+                self._dev["path_pref"],
+                self._dev["source_pref"],
+                self._dev["distance"],
+                self._dev["min_nexthop"],
+                bvalid_d,
+                bmetric_d,
+                blanes_d,
+                max_degree=self.D,
+            )
+            # fetch 1: bit-packed changed mask (b x P/32 words)
+            mask_words = jax.device_get(changed_packed)
+            fetch_bytes += mask_words.nbytes
+            bits = np.unpackbits(
+                mask_words[:, :, None].view(np.uint8), axis=-1, bitorder="little"
+            ).reshape(mask_words.shape[0], -1)[:, :P]
+            bits[n:, :] = 0  # padding rows never contribute deltas
+            j_idx, p_idx = np.nonzero(bits)
+            if not len(j_idx):
+                continue
+            # fetch 2: gather exactly the changed rows, in slices of the
+            # largest bucket when a chunk changes more rows than one
+            # gather batch holds (failures near the root can touch
+            # hundreds of routes per snapshot)
+            for gs in range(0, len(j_idx), DELTA_BUCKETS[-1]):
+                js = j_idx[gs : gs + DELTA_BUCKETS[-1]]
+                ps = p_idx[gs : gs + DELTA_BUCKETS[-1]]
+                K = bucket_for(len(js), DELTA_BUCKETS)
+                flat = np.zeros(K, np.int64)
+                flat[: len(js)] = js.astype(np.int64) * P + ps
+                gv, gm, gl = jax.device_get(
+                    _gather_deltas(
+                        valid, metric, lanes_packed, jnp.asarray(flat)
+                    )
+                )
+                fetch_bytes += gv.nbytes + gm.nbytes + gl.nbytes
+                k = len(js)
+                d_rows.append((1 + off + js).astype(np.int32))
+                d_prefix.append(ps.astype(np.int32))
+                d_valid.append(gv[:k])
+                d_metric.append(gm[:k])
+                lanes_bits = np.unpackbits(
+                    gl[:k, :, None].view(np.uint8),
+                    axis=-1,
+                    bitorder="little",
+                ).reshape(k, -1)[:, : self.D]
+                d_lanes.append(lanes_bits.astype(np.int8))
+
+        def empty(dt, shape=(0,)):
+            return np.zeros(shape, dt)
+
+        bv, bm, bl = self._base
+        return SweepRouteDeltas(
+            snap_row=sweep_result.snap_row,
+            num_prefixes=P,
+            max_degree=self.D,
+            base_valid=bv,
+            base_metric=bm,
+            base_lanes=bl,
+            delta_row=(
+                np.concatenate(d_rows) if d_rows else empty(np.int32)
+            ),
+            delta_prefix=(
+                np.concatenate(d_prefix) if d_prefix else empty(np.int32)
+            ),
+            delta_valid=(
+                np.concatenate(d_valid) if d_valid else empty(bool)
+            ),
+            delta_metric=(
+                np.concatenate(d_metric) if d_metric else empty(np.float32)
+            ),
+            delta_lanes=(
+                np.concatenate(d_lanes)
+                if d_lanes
+                else empty(np.int8, (0, self.D))
+            ),
+            fetch_bytes=fetch_bytes,
+        )

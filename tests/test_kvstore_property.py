@@ -159,11 +159,13 @@ async def run_schedule(seed: int) -> None:
 
     def flip_failure() -> None:
         if failed_pairs and rng.random() < 0.5:
-            failed_pairs.discard(rng.choice(sorted(failed_pairs)))
+            pair = rng.choice(sorted(failed_pairs))
+            failed_pairs.discard(pair)
+            transport.heal(*pair)
         else:
             a, b = rng.sample(range(n), 2)
             failed_pairs.add((names[a], names[b]))
-        transport._failed = set(failed_pairs)
+            transport.fail(names[a], names[b])
 
     def flap_peer() -> None:
         a, b = rng.choice(edges)
@@ -197,7 +199,8 @@ async def run_schedule(seed: int) -> None:
     # heal everything and settle: past the max sync backoff (256s,
     # Constants.h / constants.py KVSTORE_SYNC_MAX_BACKOFF_S — a peer that
     # failed repeatedly retries that late) and every short TTL
-    transport._failed = set()
+    for pair in sorted(failed_pairs):
+        transport.heal(*pair)
     await clock.run_for(600.0)
 
     try:
