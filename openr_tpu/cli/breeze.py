@@ -484,6 +484,314 @@ def spark_neighbors(ctx: click.Context) -> None:
         )
 
 
+# more kvstore breadth (filtered dumps / digests — KeyDumpParams options)
+
+
+@kvstore.command("keyvals-filtered")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option("--prefix", "prefixes", multiple=True,
+              help="key prefix filter (repeatable)")
+@click.option("--originator", "originators", multiple=True,
+              help="originator-id filter (repeatable)")
+@click.pass_context
+def kvstore_keyvals_filtered(
+    ctx: click.Context, area: str, prefixes: tuple, originators: tuple
+) -> None:
+    _print(_call(
+        ctx,
+        "get_kv_store_key_vals_filtered_area",
+        area=area,
+        keys=list(prefixes) or None,
+        originator_ids=list(originators) or None,
+    ))
+
+
+@kvstore.command("hashes")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option("--prefix", "prefixes", multiple=True)
+@click.pass_context
+def kvstore_hashes(ctx: click.Context, area: str, prefixes: tuple) -> None:
+    """Digest-only dump (dumpHashWithFilters)."""
+    _print(_call(
+        ctx,
+        "get_kv_store_hash_filtered_area",
+        area=area,
+        keys=list(prefixes) or None,
+    ))
+
+
+@kvstore.command("set-key")
+@click.argument("key")
+@click.argument("value")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option("--version", default=1, type=int)
+@click.option("--originator", default="breeze")
+@click.option("--ttl", default=3_600_000, type=int)
+@click.pass_context
+def kvstore_set_key(
+    ctx: click.Context,
+    key: str,
+    value: str,
+    area: str,
+    version: int,
+    originator: str,
+    ttl: int,
+) -> None:
+    _call(
+        ctx,
+        "set_kv_store_key_vals_area",
+        area=area,
+        key_vals={
+            key: {
+                "version": version,
+                "originator_id": originator,
+                "value": value.encode().hex(),
+                "_value_hex": True,
+                "ttl": ttl,
+            }
+        },
+    )
+    click.echo(f"set {key} v{version} in area {area}")
+
+
+# more decision breadth
+
+
+@decision.command("route-detail")
+@click.pass_context
+def decision_route_detail(ctx: click.Context) -> None:
+    """Routes with full selection detail (getRouteDetailDb)."""
+    _print(_call(ctx, "get_route_detail_db"))
+
+
+@decision.command("received-routes-filtered")
+@click.option("--prefix", "prefixes", multiple=True)
+@click.option("--originator", default=None)
+@click.pass_context
+def decision_received_routes_filtered(
+    ctx: click.Context, prefixes: tuple, originator: Optional[str]
+) -> None:
+    _print(_call(
+        ctx,
+        "get_received_routes_filtered",
+        prefixes=list(prefixes) or None,
+        originator=originator,
+    ))
+
+
+@decision.command("adj-filtered")
+@click.option("--node", "nodes", multiple=True)
+@click.option("--area", "areas", multiple=True)
+@click.pass_context
+def decision_adj_filtered(
+    ctx: click.Context, nodes: tuple, areas: tuple
+) -> None:
+    _print(_call(
+        ctx,
+        "get_decision_adjacencies_filtered",
+        nodes=list(nodes) or None,
+        areas=list(areas) or None,
+    ))
+
+
+# more lm breadth (adjacency metric, soft increments, drain state)
+
+
+@lm.command("drain-state")
+@click.pass_context
+def lm_drain_state(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_drain_state"))
+
+
+@lm.command("set-adj-metric")
+@click.argument("interface")
+@click.argument("node")
+@click.argument("metric", type=int)
+@click.pass_context
+def lm_set_adj_metric(
+    ctx: click.Context, interface: str, node: str, metric: int
+) -> None:
+    _call(ctx, "set_adjacency_metric", interface=interface, node=node,
+          metric=metric)
+    click.echo(f"adjacency metric {metric} set on {interface}->{node}")
+
+
+@lm.command("unset-adj-metric")
+@click.argument("interface")
+@click.argument("node")
+@click.pass_context
+def lm_unset_adj_metric(ctx: click.Context, interface: str, node: str) -> None:
+    _call(ctx, "unset_adjacency_metric", interface=interface, node=node)
+    click.echo(f"adjacency metric override removed from {interface}->{node}")
+
+
+@lm.command("set-link-increment")
+@click.argument("interface")
+@click.argument("increment", type=int)
+@click.pass_context
+def lm_set_link_increment(
+    ctx: click.Context, interface: str, increment: int
+) -> None:
+    _call(ctx, "set_interface_metric_increment", interface=interface,
+          increment=increment)
+    click.echo(f"metric increment {increment} set on {interface}")
+
+
+@lm.command("unset-link-increment")
+@click.argument("interface")
+@click.pass_context
+def lm_unset_link_increment(ctx: click.Context, interface: str) -> None:
+    _call(ctx, "unset_interface_metric_increment", interface=interface)
+    click.echo(f"metric increment removed from {interface}")
+
+
+@lm.command("set-node-increment")
+@click.argument("increment", type=int)
+@click.pass_context
+def lm_set_node_increment(ctx: click.Context, increment: int) -> None:
+    _call(ctx, "set_node_interface_metric_increment", increment=increment)
+    click.echo(f"node-wide metric increment {increment} set (soft drain)")
+
+
+@lm.command("unset-node-increment")
+@click.pass_context
+def lm_unset_node_increment(ctx: click.Context) -> None:
+    _call(ctx, "unset_node_interface_metric_increment")
+    click.echo("node-wide metric increment removed")
+
+
+# more prefixmgr breadth (types, areas, origination)
+
+
+@prefixmgr.command("originated")
+@click.pass_context
+def prefixmgr_originated(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_originated_prefixes"))
+
+
+@prefixmgr.command("view-type")
+@click.argument("prefix_type", type=int)
+@click.pass_context
+def prefixmgr_view_type(ctx: click.Context, prefix_type: int) -> None:
+    _print(_call(ctx, "get_prefixes_by_type", prefix_type=prefix_type))
+
+
+@prefixmgr.command("withdraw-type")
+@click.argument("prefix_type", type=int)
+@click.pass_context
+def prefixmgr_withdraw_type(ctx: click.Context, prefix_type: int) -> None:
+    _call(ctx, "withdraw_prefixes_by_type", prefix_type=prefix_type)
+    click.echo(f"withdrew all type-{prefix_type} prefixes")
+
+
+@prefixmgr.command("sync-type")
+@click.argument("prefix_type", type=int)
+@click.argument("prefixes", nargs=-1)
+@click.pass_context
+def prefixmgr_sync_type(
+    ctx: click.Context, prefix_type: int, prefixes: tuple
+) -> None:
+    _call(
+        ctx,
+        "sync_prefixes_by_type",
+        prefix_type=prefix_type,
+        prefixes=[{"prefix": p} for p in prefixes],
+    )
+    click.echo(f"synced {len(prefixes)} type-{prefix_type} prefix(es)")
+
+
+@prefixmgr.command("area-view")
+@click.argument("area")
+@click.pass_context
+def prefixmgr_area_view(ctx: click.Context, area: str) -> None:
+    """What this node advertises INTO one area (incl. redistribution)."""
+    _print(_call(ctx, "get_area_advertised_routes", area=area))
+
+
+# more fib breadth
+
+
+@fib.command("mpls")
+@click.option("--label", "labels", multiple=True, type=int)
+@click.pass_context
+def fib_mpls(ctx: click.Context, labels: tuple) -> None:
+    if labels:
+        _print(_call(ctx, "get_mpls_routes_filtered", labels=list(labels)))
+    else:
+        _print(_call(ctx, "get_mpls_routes"))
+
+
+# spark graceful restart
+
+
+@spark.command("graceful-restart")
+@click.pass_context
+def spark_graceful_restart(ctx: click.Context) -> None:
+    """Tell peers to hold adjacencies through our restart."""
+    _call(ctx, "flood_restarting_msg")
+    click.echo("restarting hellos flooded; peers hold adjacencies")
+
+
+# -------------------------------------------------------------- dispatcher
+
+
+@breeze.group()
+def dispatcher() -> None:
+    """KvStore-publication fan-out proxy."""
+
+
+@dispatcher.command("filters")
+@click.pass_context
+def dispatcher_filters(ctx: click.Context) -> None:
+    """Per-subscriber key-prefix filters (getDispatcherFilters)."""
+    _print(_call(ctx, "get_dispatcher_filters"))
+
+
+@dispatcher.command("subscribers")
+@click.pass_context
+def dispatcher_subscribers(ctx: click.Context) -> None:
+    """Active ctrl stream subscribers (getSubscriberInfo)."""
+    _print(_call(ctx, "get_subscriber_info"))
+
+
+# ------------------------------------------------------------ config-store
+
+
+@breeze.group("config-store")
+def config_store() -> None:
+    """Persistent config store (PersistentStore)."""
+
+
+@config_store.command("keys")
+@click.pass_context
+def config_store_keys(ctx: click.Context) -> None:
+    _print(_call(ctx, "get_config_store_keys"))
+
+
+@config_store.command("get")
+@click.argument("key")
+@click.pass_context
+def config_store_get(ctx: click.Context, key: str) -> None:
+    _print(_call(ctx, "get_config_key", key=key))
+
+
+@config_store.command("set")
+@click.argument("key")
+@click.argument("value")
+@click.pass_context
+def config_store_set(ctx: click.Context, key: str, value: str) -> None:
+    _call(ctx, "set_config_key", key=key, value=value)
+    click.echo(f"stored {key}")
+
+
+@config_store.command("erase")
+@click.argument("key")
+@click.pass_context
+def config_store_erase(ctx: click.Context, key: str) -> None:
+    erased = _call(ctx, "erase_config_key", key=key)
+    click.echo("erased" if erased else "no such key")
+
+
 # ------------------------------------------------------------ tech-support
 
 

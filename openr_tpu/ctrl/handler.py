@@ -39,9 +39,34 @@ from openr_tpu.types import (
 STREAM_BACKLOG_LIMIT = 10_000
 
 
+def _route_detail_wire(prefix: str, e) -> dict:
+    """RouteDetail wire form: the unicast route plus the selection detail
+    the plain RouteDatabase drops (getRouteDetailDb / FibDetail streams)."""
+    return {
+        "prefix": prefix,
+        "unicast_route": e.to_unicast_route().to_wire(),
+        "best_prefix_entry": e.best_prefix_entry.to_wire(),
+        "best_area": e.best_area,
+        "igp_cost": e.igp_cost,
+        "do_not_install": e.do_not_install,
+    }
+
+
 class OpenrCtrlHandler:
     def __init__(self, node) -> None:
         self.node = node
+        #: active stream subscribers: sid -> {type, since}
+        self._subscribers: Dict[int, Dict[str, Any]] = {}
+        self._next_sid = 0
+
+    def _subscriber(self, kind: str) -> int:
+        sid = self._next_sid
+        self._next_sid += 1
+        self._subscribers[sid] = {
+            "type": kind,
+            "since": self.node.clock.now(),
+        }
+        return sid
 
     # ------------------------------------------------------------------ fb303
     def get_counters(self) -> Dict[str, float]:
@@ -51,6 +76,9 @@ class OpenrCtrlHandler:
         return self.node.counters.dump(prefix)
 
     def get_node_name(self) -> str:
+        return self.node.name
+
+    def get_my_node_name(self) -> str:
         return self.node.name
 
     def get_openr_version(self) -> Dict[str, int]:
@@ -98,6 +126,45 @@ class OpenrCtrlHandler:
     def unset_node_interface_metric_increment(self) -> None:
         self.node.set_node_metric_increment(0)
 
+    def set_adjacency_metric(
+        self, interface: str, node: str, metric: int
+    ) -> None:
+        self.node.link_monitor.set_adjacency_metric(interface, node, metric)
+        self.node._persist_drain_state()
+
+    def unset_adjacency_metric(self, interface: str, node: str) -> None:
+        self.node.link_monitor.set_adjacency_metric(interface, node, None)
+        self.node._persist_drain_state()
+
+    def set_interface_metric_increment(
+        self, interface: str, increment: int
+    ) -> None:
+        self.node.link_monitor.set_link_metric_increment(interface, increment)
+        self.node._persist_drain_state()
+
+    def unset_interface_metric_increment(self, interface: str) -> None:
+        self.node.link_monitor.set_link_metric_increment(interface, 0)
+        self.node._persist_drain_state()
+
+    def set_interface_metric_increment_multi(
+        self, interfaces: List[str], increment: int
+    ) -> None:
+        for interface in interfaces:
+            self.node.link_monitor.set_link_metric_increment(
+                interface, increment
+            )
+        self.node._persist_drain_state()
+
+    def unset_interface_metric_increment_multi(
+        self, interfaces: List[str]
+    ) -> None:
+        for interface in interfaces:
+            self.node.link_monitor.set_link_metric_increment(interface, 0)
+        self.node._persist_drain_state()
+
+    def get_drain_state(self) -> dict:
+        return self.node.link_monitor.get_drain_state()
+
     def get_interfaces(self) -> Dict[str, Any]:
         lm = self.node.link_monitor
         return {
@@ -139,8 +206,102 @@ class OpenrCtrlHandler:
             e.to_wire() for e in self.node.prefix_manager.get_advertised_routes()
         ]
 
+    def get_advertised_routes_filtered(
+        self, prefixes: Optional[List[str]] = None
+    ) -> List[dict]:
+        want = set(prefixes or [])
+        return [
+            e.to_wire()
+            for e in self.node.prefix_manager.get_advertised_routes()
+            if not want or e.prefix in want
+        ]
+
+    def get_prefixes(self) -> List[dict]:
+        return self.get_advertised_routes()
+
+    def get_prefixes_by_type(self, prefix_type: int) -> List[dict]:
+        return [
+            e.to_wire()
+            for e in self.node.prefix_manager.get_by_type(
+                PrefixType(prefix_type)
+            )
+        ]
+
+    def advertise_prefixes_by_type(
+        self, prefix_type: int, prefixes: List[dict]
+    ) -> None:
+        self.node.prefix_manager.advertise(
+            [PrefixEntry.from_wire(p) for p in prefixes],
+            type=PrefixType(prefix_type),
+        )
+
+    def withdraw_prefixes_by_type(self, prefix_type: int) -> None:
+        self.node.prefix_manager.withdraw_by_type(PrefixType(prefix_type))
+
+    def sync_prefixes_by_type(
+        self, prefix_type: int, prefixes: List[dict]
+    ) -> None:
+        self.node.prefix_manager.sync_by_type(
+            PrefixType(prefix_type),
+            [PrefixEntry.from_wire(p) for p in prefixes],
+        )
+
+    def get_area_advertised_routes(self, area: str) -> List[dict]:
+        """Entries this node advertises INTO one area (the per-area view
+        of getAreaAdvertisedRoutes): advertised/originated entries whose
+        destination-area set contains `area`, plus redistributions into
+        it."""
+        return self.get_area_advertised_routes_filtered(area, None)
+
+    def get_area_advertised_routes_filtered(
+        self, area: str, prefixes: Optional[List[str]] = None
+    ) -> List[dict]:
+        pm = self.node.prefix_manager
+        want = set(prefixes or [])
+        out = []
+        for by_type in pm.advertised.values():
+            for prefix, (entry, dst_areas) in by_type.items():
+                if area in dst_areas and (not want or prefix in want):
+                    out.append(entry.to_wire())
+        for prefix, (src_area, per_area) in pm._redistributed.items():
+            entry = per_area.get(area)
+            if entry is not None and (not want or prefix in want):
+                out.append(entry.to_wire())
+        return out
+
+    def get_advertised_routes_with_origination_policy(
+        self, policy_name: str
+    ) -> List[dict]:
+        """Originated entries whose configured origination policy matches
+        (getAdvertisedRoutesWithOriginationPolicy)."""
+        pm = self.node.prefix_manager
+        out = []
+        for prefix, (entry, _sup) in pm._originated_entries().items():
+            op = pm.originated.get(prefix)
+            if op is not None and op.origination_policy == policy_name:
+                out.append(entry.to_wire())
+        return out
+
     def get_originated_prefixes(self) -> Dict[str, dict]:
         return self.node.prefix_manager.get_originated_prefixes()
+
+    # --------------------------------------------------------- config store
+    # (PersistentStore ctrl surface: getConfigKey/setConfigKey/eraseConfigKey)
+
+    def get_config_key(self, key: str):
+        val = self.node.persistent_store.load(key)
+        if val is None:
+            raise KeyError(f"no config key {key!r}")
+        return val
+
+    def set_config_key(self, key: str, value) -> None:
+        self.node.persistent_store.store(key, value)
+
+    def erase_config_key(self, key: str) -> bool:
+        return self.node.persistent_store.erase(key)
+
+    def get_config_store_keys(self) -> List[str]:
+        return self.node.persistent_store.keys()
 
     # -------------------------------------------------------------- decision
     # (OpenrCtrl.thrift:462-540)
@@ -163,8 +324,85 @@ class OpenrCtrlHandler:
     ) -> List[dict]:
         return [db.to_wire() for db in self.node.decision.get_adj_dbs(area)]
 
+    def get_decision_adjacencies_filtered(
+        self,
+        nodes: Optional[List[str]] = None,
+        areas: Optional[List[str]] = None,
+    ) -> List[dict]:
+        """AdjacencyDatabases restricted by node name and/or area
+        (getDecisionAdjacenciesFiltered / AdjacenciesFilter)."""
+        want_nodes = set(nodes or [])
+        want_areas = set(areas or [])
+        out = []
+        for a in (
+            sorted(want_areas) if want_areas else [None]
+        ):
+            for db in self.node.decision.get_adj_dbs(a):
+                if not want_nodes or db.this_node_name in want_nodes:
+                    out.append(db.to_wire())
+        return out
+
+    def get_decision_area_adjacencies_filtered(
+        self, area: str, nodes: Optional[List[str]] = None
+    ) -> List[dict]:
+        return self.get_decision_adjacencies_filtered(nodes, [area])
+
+    def get_link_monitor_adjacencies_filtered(
+        self,
+        nodes: Optional[List[str]] = None,
+        areas: Optional[List[str]] = None,
+    ) -> List[dict]:
+        """This node's OWN AdjacencyDatabases filtered by area; the node
+        filter matches this node's name (the reference filter shape)."""
+        if nodes and self.node.name not in nodes:
+            return []
+        out = []
+        for a in areas or self.node.link_monitor.area_ids:
+            out.append(
+                self.node.link_monitor.build_adjacency_database(a).to_wire()
+            )
+        return out
+
+    def get_link_monitor_area_adjacencies_filtered(
+        self, area: str, nodes: Optional[List[str]] = None
+    ) -> List[dict]:
+        return self.get_link_monitor_adjacencies_filtered(nodes, [area])
+
     def get_received_routes(self) -> Dict[str, dict]:
         return self.node.decision.get_received_routes()
+
+    def get_received_routes_filtered(
+        self,
+        prefixes: Optional[List[str]] = None,
+        originator: Optional[str] = None,
+    ) -> Dict[str, dict]:
+        """Received-route dump filtered by prefix set and/or advertising
+        node (getReceivedRoutesFiltered / ReceivedRouteFilter)."""
+        want = set(prefixes or [])
+        out = {}
+        for prefix, entries in self.node.decision.get_received_routes().items():
+            if want and prefix not in want:
+                continue
+            if originator is not None:
+                entries = {
+                    na: e
+                    for na, e in entries.items()
+                    if na.split("@", 1)[0] == originator
+                }
+                if not entries:
+                    continue
+            out[prefix] = entries
+        return out
+
+    def get_route_detail_db(self) -> List[dict]:
+        """Unicast routes with full selection detail: best entry, area,
+        igp cost (getRouteDetailDb / RouteDetailDb)."""
+        out = []
+        for prefix, e in sorted(
+            self.node.decision.get_route_db().unicast_routes.items()
+        ):
+            out.append(_route_detail_wire(prefix, e))
+        return out
 
     def set_rib_policy(self, policy: dict) -> None:
         import json
@@ -202,6 +440,23 @@ class OpenrCtrlHandler:
             for r in self.node.fib.get_unicast_routes_filtered(prefixes)
         ]
 
+    def get_unicast_routes(self) -> List[dict]:
+        return self.get_unicast_routes_filtered([])
+
+    def get_mpls_routes(self) -> List[dict]:
+        return [
+            e.to_mpls_route().to_wire()
+            for e in self.node.fib.get_mpls_route_db().values()
+        ]
+
+    def get_mpls_routes_filtered(self, labels: List[int]) -> List[dict]:
+        want = set(labels)
+        return [
+            e.to_mpls_route().to_wire()
+            for label, e in self.node.fib.get_mpls_route_db().items()
+            if label in want
+        ]
+
     def fib_synced(self) -> bool:
         return self.node.fib.synced
 
@@ -230,9 +485,106 @@ class OpenrCtrlHandler:
         vals = self.node.kv_store.dump_all(area, prefix)
         return {k: v.to_wire() for k, v in vals.items()}
 
+    def get_kv_store_key_vals(self, keys: List[str]) -> Dict[str, dict]:
+        return self.get_kv_store_key_vals_area(keys)
+
+    def set_kv_store_key_vals(self, key_vals: Dict[str, dict]) -> None:
+        self.set_kv_store_key_vals_area(key_vals)
+
+    # reference carries both spellings in OpenrCtrl.thrift
+    def set_kv_store_key_values(self, key_vals: Dict[str, dict]) -> None:
+        self.set_kv_store_key_vals_area(key_vals)
+
+    def _kv_filtered(
+        self,
+        area: str,
+        keys: Optional[List[str]],
+        originator_ids: Optional[List[str]],
+        prefix_match: bool,
+    ) -> Dict[str, Value]:
+        """KeyDumpParams semantics: `keys` are exact keys, or key PREFIXES
+        when prefix_match; optional originator filter."""
+        store = self.node.kv_store
+        if keys and not prefix_match:
+            vals = store.get_key_vals(area, keys)
+        else:
+            vals = {}
+            for pref in keys or [""]:
+                vals.update(store.dump_all(area, pref))
+        if originator_ids:
+            want = set(originator_ids)
+            vals = {k: v for k, v in vals.items() if v.originator_id in want}
+        return vals
+
+    def get_kv_store_key_vals_filtered_area(
+        self,
+        area: str = C.DEFAULT_AREA,
+        keys: Optional[List[str]] = None,
+        originator_ids: Optional[List[str]] = None,
+        prefix_match: bool = True,
+    ) -> Dict[str, dict]:
+        return {
+            k: v.to_wire()
+            for k, v in self._kv_filtered(
+                area, keys, originator_ids, prefix_match
+            ).items()
+        }
+
+    def get_kv_store_key_vals_filtered(
+        self,
+        keys: Optional[List[str]] = None,
+        originator_ids: Optional[List[str]] = None,
+        prefix_match: bool = True,
+    ) -> Dict[str, dict]:
+        return self.get_kv_store_key_vals_filtered_area(
+            C.DEFAULT_AREA, keys, originator_ids, prefix_match
+        )
+
+    def get_kv_store_hash_filtered_area(
+        self,
+        area: str = C.DEFAULT_AREA,
+        keys: Optional[List[str]] = None,
+        originator_ids: Optional[List[str]] = None,
+        prefix_match: bool = True,
+    ) -> Dict[str, dict]:
+        """Digest-only dump (dumpHashWithFilters): values stripped to
+        (version, originator, hash, ttl) for cheap anti-entropy diffing."""
+        out = {}
+        for k, v in self._kv_filtered(
+            area, keys, originator_ids, prefix_match
+        ).items():
+            w = v.to_wire()
+            w.pop("value", None)
+            w.pop("_value_hex", None)
+            out[k] = w
+        return out
+
+    def get_kv_store_hash_filtered(
+        self,
+        keys: Optional[List[str]] = None,
+        originator_ids: Optional[List[str]] = None,
+        prefix_match: bool = True,
+    ) -> Dict[str, dict]:
+        return self.get_kv_store_hash_filtered_area(
+            C.DEFAULT_AREA, keys, originator_ids, prefix_match
+        )
+
+    def get_kv_store_peers(self) -> Dict[str, int]:
+        return self.get_kv_store_peers_area()
+
     def get_kv_store_area_summaries(self) -> Dict[str, dict]:
         return {
             a: s.to_wire() for a, s in self.node.kv_store.summaries().items()
+        }
+
+    def get_kv_store_area_summary(
+        self, selected_areas: Optional[List[str]] = None
+    ) -> Dict[str, dict]:
+        want = set(selected_areas or [])
+        return {
+            a: s
+            for a, s in self.get_kv_store_area_summaries().items()
+            if not want or a in want
         }
 
     def get_kv_store_peers_area(
@@ -289,6 +641,26 @@ class OpenrCtrlHandler:
 
     # ----------------------------------------------------------------- spark
 
+    def get_neighbors(self) -> List[dict]:
+        return self.get_spark_neighbors()
+
+    def flood_restarting_msg(self) -> None:
+        """Broadcast graceful-restart hellos so peers hold adjacencies
+        (floodRestartingMsg, Spark.h:79)."""
+        self.node.spark.flood_restarting_msg()
+
+    # ------------------------------------------------------------ dispatcher
+
+    def get_dispatcher_filters(self) -> List[List[str]]:
+        return [list(f) for f in self.node.dispatcher.get_filters()]
+
+    def get_subscriber_info(self) -> List[dict]:
+        """Active stream subscribers (getSubscriberInfo)."""
+        return [
+            {"id": sid, **info}
+            for sid, info in sorted(self._subscribers.items())
+        ]
+
     def get_spark_neighbors(self) -> List[dict]:
         out = []
         for n in self.node.spark.get_neighbors():
@@ -325,6 +697,7 @@ class OpenrCtrlHandler:
         prefixes = list(key_prefixes or [])
         reader = self.node.dispatcher.get_reader(prefixes, name="ctrl.kvstream")
         want_areas = set(areas or self.node.kv_store.areas.keys())
+        sid = self._subscriber("kvstore")
         from openr_tpu.messaging.queue import QueueClosedError
 
         try:
@@ -340,12 +713,35 @@ class OpenrCtrlHandler:
         except QueueClosedError:
             return
         finally:
+            self._subscribers.pop(sid, None)
             self.node.dispatcher.remove_reader(reader)
+
+    async def subscribe_and_get_kv_store_filtered(
+        self,
+        keys: Optional[List[str]] = None,
+        areas: Optional[List[str]] = None,
+    ) -> AsyncIterator[dict]:
+        """subscribeAndGetKvStoreFiltered: KeyDumpParams-shaped alias of
+        the snapshot+delta stream (keys = key prefixes)."""
+        async for item in self.subscribe_and_get_kv_store(keys, areas):
+            yield item
+
+    async def subscribe_and_get_area_kv_stores(
+        self,
+        selected_areas: Optional[List[str]] = None,
+        keys: Optional[List[str]] = None,
+    ) -> AsyncIterator[dict]:
+        """subscribeAndGetAreaKvStores: per-area snapshots + deltas."""
+        async for item in self.subscribe_and_get_kv_store(
+            keys, selected_areas
+        ):
+            yield item
 
     async def subscribe_and_get_fib(self) -> AsyncIterator[dict]:
         """Snapshot RouteDatabase + DecisionRouteUpdate deltas
         (subscribeAndGetFib, OpenrCtrlHandler.h:389-399)."""
         reader = self.node.fib_route_updates_q.get_reader(name="ctrl.fibstream")
+        sid = self._subscriber("fib")
         from openr_tpu.messaging.queue import QueueClosedError
 
         try:
@@ -356,7 +752,58 @@ class OpenrCtrlHandler:
         except QueueClosedError:
             return
         finally:
+            self._subscribers.pop(sid, None)
             self.node.fib_route_updates_q.remove_reader(reader)
+
+    async def subscribe_and_get_fib_detail(self) -> AsyncIterator[dict]:
+        """subscribeAndGetFibDetail (OpenrCtrlHandler.h:393-399): like
+        subscribeAndGetFib but every route carries its full selection
+        detail (best entry, area, igp cost)."""
+        reader = self.node.fib_route_updates_q.get_reader(
+            name="ctrl.fibdetailstream"
+        )
+        sid = self._subscriber("fib_detail")
+        from openr_tpu.messaging.queue import QueueClosedError
+
+        try:
+            yield {
+                "snapshot": [
+                    _route_detail_wire(p, e)
+                    for p, e in sorted(self.node.fib.get_route_db().items())
+                ]
+            }
+            while reader.size() <= STREAM_BACKLOG_LIMIT:
+                update = await reader.get()
+                yield {
+                    "unicast_routes_to_update": [
+                        _route_detail_wire(p, e)
+                        for p, e in sorted(
+                            update.unicast_routes_to_update.items()
+                        )
+                    ],
+                    "unicast_routes_to_delete": list(
+                        update.unicast_routes_to_delete
+                    ),
+                    "mpls_routes_to_update": [
+                        e.to_mpls_route().to_wire()
+                        for e in update.mpls_routes_to_update.values()
+                    ],
+                    "mpls_routes_to_delete": list(
+                        update.mpls_routes_to_delete
+                    ),
+                }
+        except QueueClosedError:
+            return
+        finally:
+            self._subscribers.pop(sid, None)
+            self.node.fib_route_updates_q.remove_reader(reader)
+
+    async def long_poll_kv_store_adj(
+        self, snapshot: Optional[Dict[str, int]] = None
+    ) -> bool:
+        return await self.long_poll_kv_store_adj_area(
+            C.DEFAULT_AREA, snapshot
+        )
 
     async def long_poll_kv_store_adj_area(
         self, area: str = C.DEFAULT_AREA, snapshot: Optional[Dict[str, int]] = None

@@ -129,6 +129,13 @@ class LinkMonitor(Actor):
         self.node_metric_increment = 0
         self.link_overloads: Set[str] = set()  # if_names
         self.link_metric_overrides: Dict[str, int] = {}
+        #: per-adjacency metric override, keyed (local_if, neighbor node)
+        #: — more specific than a link override (setAdjacencyMetric,
+        #: LinkMonitor.h:118-124)
+        self.adj_metric_overrides: Dict[Tuple[str, str], int] = {}
+        #: per-interface soft-drain increment added on top of the computed
+        #: metric (setInterfaceMetricIncrement, LinkMonitor.h:135-146)
+        self.link_metric_increments: Dict[str, int] = {}
         self._link_discovered_signaled = False
         # throttles (Constants.h:95-100)
         self._advertise_ifaces_throttle = AsyncThrottle(
@@ -321,13 +328,17 @@ class LinkMonitor(Actor):
     # -- adjacency advertisement (advertiseAdjacencies) --------------------
 
     def _adjacency_metric(self, adj: AdjacencyEntry) -> int:
+        inc = self.link_metric_increments.get(adj.local_if, 0)
+        ov = self.adj_metric_overrides.get((adj.local_if, adj.neighbor))
+        if ov is not None:  # most-specific override wins
+            return ov + inc
         if adj.local_if in self.link_metric_overrides:
-            return self.link_metric_overrides[adj.local_if]
+            return self.link_metric_overrides[adj.local_if] + inc
         if adj.metric_override is not None:
-            return adj.metric_override
+            return adj.metric_override + inc
         if self.config.use_rtt_metric and adj.rtt_us > 0:
-            return rtt_to_metric(adj.rtt_us)
-        return 1
+            return rtt_to_metric(adj.rtt_us) + inc
+        return 1 + inc
 
     def build_adjacency_database(self, area: str) -> AdjacencyDatabase:
         adjacencies = []
@@ -407,12 +418,52 @@ class LinkMonitor(Actor):
             self.link_metric_overrides[if_name] = metric
             self._advertise_adjacencies()
 
+    def set_adjacency_metric(
+        self, if_name: str, node: str, metric: Optional[int]
+    ) -> None:
+        """Pin (or with None, clear) one adjacency's metric
+        (setAdjacencyMetric/unsetAdjacencyMetric)."""
+        key = (if_name, node)
+        if metric is None:
+            if self.adj_metric_overrides.pop(key, None) is not None:
+                self._advertise_adjacencies()
+        elif metric < 1:
+            # SPF requires strictly positive metrics (the device kernel's
+            # DAG-equality propagation rejects <= 0 at the bridge too)
+            raise ValueError(f"adjacency metric must be >= 1, got {metric}")
+        elif self.adj_metric_overrides.get(key) != metric:
+            self.adj_metric_overrides[key] = metric
+            self._advertise_adjacencies()
+
+    def set_link_metric_increment(
+        self, if_name: str, increment: int
+    ) -> None:
+        """Per-interface soft-drain increment; 0 clears
+        (setInterfaceMetricIncrement/unset)."""
+        if increment == 0:
+            if self.link_metric_increments.pop(if_name, None) is not None:
+                self._advertise_adjacencies()
+        elif increment < 0:
+            # a negative increment could push advertised metrics <= 0 and
+            # break SPF (the reference rejects non-positive increments)
+            raise ValueError(
+                f"metric increment must be >= 0, got {increment}"
+            )
+        elif self.link_metric_increments.get(if_name) != increment:
+            self.link_metric_increments[if_name] = increment
+            self._advertise_adjacencies()
+
     def get_drain_state(self) -> dict:
         return {
             "node_overloaded": self.node_overloaded,
             "node_metric_increment": self.node_metric_increment,
             "link_overloads": sorted(self.link_overloads),
             "link_metric_overrides": dict(self.link_metric_overrides),
+            "adj_metric_overrides": {
+                f"{i}|{n}": m
+                for (i, n), m in sorted(self.adj_metric_overrides.items())
+            },
+            "link_metric_increments": dict(self.link_metric_increments),
         }
 
     def restore_drain_state(self, state: dict) -> None:
@@ -422,4 +473,11 @@ class LinkMonitor(Actor):
         self.link_overloads = set(state.get("link_overloads", []))
         self.link_metric_overrides = dict(
             state.get("link_metric_overrides", {})
+        )
+        self.adj_metric_overrides = {
+            tuple(k.split("|", 1)): m
+            for k, m in state.get("adj_metric_overrides", {}).items()
+        }
+        self.link_metric_increments = dict(
+            state.get("link_metric_increments", {})
         )

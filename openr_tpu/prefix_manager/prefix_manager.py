@@ -376,6 +376,31 @@ class PrefixManager(Actor):
             PrefixEvent(PrefixEventType.WITHDRAW_PREFIXES, type, entries)
         )
 
+    def withdraw_by_type(self, type: PrefixType) -> None:
+        """Drop every advertisement of one source type
+        (withdrawPrefixesByType)."""
+        self._on_prefix_event(
+            PrefixEvent(PrefixEventType.WITHDRAW_PREFIXES_BY_TYPE, type, [])
+        )
+
+    def sync_by_type(
+        self,
+        type: PrefixType,
+        entries: List[PrefixEntry],
+        dst_areas: Optional[Set[str]] = None,
+    ) -> None:
+        """Replace one type's advertised set wholesale
+        (syncPrefixesByType)."""
+        self._on_prefix_event(
+            PrefixEvent(
+                PrefixEventType.SYNC_PREFIXES_BY_TYPE, type, entries, dst_areas
+            )
+        )
+
+    def get_by_type(self, type: PrefixType) -> List[PrefixEntry]:
+        """Advertised entries of one source type (getPrefixesByType)."""
+        return [e for e, _ in self.advertised.get(type, {}).values()]
+
     def get_advertised_routes(self) -> List[PrefixEntry]:
         out = []
         for by_type in self.advertised.values():

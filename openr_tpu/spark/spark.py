@@ -276,12 +276,20 @@ class Spark(Actor):
             self._signal_neighbor_discovered,
         )
 
-    async def stop_gracefully(self) -> None:
+    def flood_restarting_msg(self) -> None:
         """Broadcast restarting hellos so peers hold adjacencies through our
-        restart (floodRestartingMsg, Spark.h:79)."""
-        self._restarting = True
+        restart (floodRestartingMsg, Spark.h:79).  One-shot: the sticky
+        _restarting flag is NOT set here — over the ctrl RPC the node may
+        in fact keep running, and a permanently-set flag would make every
+        later periodic hello re-trigger the peers' GR hold (an endless
+        adjacency flap loop)."""
         for if_name in self.interfaces:
             self._send_hello(if_name, restarting=True)
+
+    async def stop_gracefully(self) -> None:
+        # actually going down: later hellos (if any) also carry restarting
+        self._restarting = True
+        self.flood_restarting_msg()
 
     async def stop(self) -> None:
         # a stopped node must leave the wire: no rx callback, no new fibers

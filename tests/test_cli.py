@@ -150,3 +150,37 @@ def test_cli_tech_support(live_node):
     out = _run(live_node, "tech-support")
     for section in ("version", "routes", "kvstore-summary", "counters"):
         assert f"= {section} =" in out
+
+
+def test_cli_kvstore_set_key_roundtrip(live_node):
+    """set-key must produce a BYTES value (the _value_hex marker) that the
+    merge path can hash and compare (code-review regression)."""
+    _run(live_node, "kvstore", "set-key", "op:canary", "hello-world")
+    kv = json.loads(_run(live_node, "kvstore", "key-vals", "op:canary"))
+    assert bytes.fromhex(kv["op:canary"]["value"]) == b"hello-world"
+
+
+def test_cli_negative_drain_values_rejected(live_node):
+    """Negative increments / non-positive adjacency metrics would feed
+    SPF negative edge weights; the RPC must reject them."""
+    r = CliRunner().invoke(
+        breeze,
+        ["--port", str(live_node), "lm", "set-link-increment", "if0", "--",
+         "-10"],
+        obj={},
+    )
+    assert r.exit_code != 0
+    r = CliRunner().invoke(
+        breeze,
+        ["--port", str(live_node), "lm", "set-adj-metric", "if0", "node1",
+         "--", "0"],
+        obj={},
+    )
+    assert r.exit_code != 0
+
+
+def test_cli_graceful_restart_rpc(live_node):
+    _run(live_node, "spark", "graceful-restart")
+    # the node keeps running; its adjacency view stays served
+    out = _run(live_node, "spark", "neighbors")
+    assert "node1" in out

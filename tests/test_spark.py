@@ -238,6 +238,32 @@ def test_graceful_restart_holds_and_recovers():
     run(main())
 
 
+def test_flood_restarting_msg_is_one_shot():
+    """The ctrl-surface GR flood must NOT set the sticky restarting flag:
+    a node that keeps running would otherwise re-trigger every peer's GR
+    hold on each periodic hello — an endless adjacency flap loop
+    (code-review regression).  The peer enters RESTART once, then the
+    continuing normal hellos re-establish the adjacency."""
+
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        wire(rig, "a", "if1", "b", "if2")
+        await clock.run_for(5.0)
+        rig.drain_events("b")
+        rig.sparks["a"].flood_restarting_msg()
+        assert rig.sparks["a"]._restarting is False  # one-shot, not sticky
+        await clock.run_for(10.0)
+        # a never went away: peer must be back ESTABLISHED, not flapping
+        assert (
+            rig.sparks["b"].get_neighbors()[0].state
+            == SparkNeighState.ESTABLISHED
+        )
+        await rig.stop()
+
+    run(main())
+
+
 def test_graceful_restart_expiry_brings_neighbor_down():
     async def main():
         clock = SimClock()
