@@ -64,6 +64,13 @@ def topology_edges(kind: str, n: int) -> List[Tuple[int, int]]:
         return [(i, (i + 1) % n) for i in range(n)]
     if kind == "full":
         return [(i, j) for i in range(n) for j in range(i + 1, n)]
+    if kind == "multiarea":
+        # two pods + spine (reference labs 201/202 shape):
+        #   pod1: 0-1-2-3   spine: 3-4   pod2: 4-5-6-7
+        # nodes 3 and 4 are the area border routers
+        if n != 8:
+            raise ValueError("multiarea topology requires exactly 8 nodes")
+        return [(i, i + 1) for i in range(7)]
     raise ValueError(f"unknown topology {kind!r}")
 
 
@@ -111,9 +118,12 @@ class NetnsLab:
         for i in range(self.num_nodes):
             self.start_daemon(i)
 
+    #: the prefix the pod2 import policy drops in the multiarea lab
+    POLICY_DROPPED_PREFIX = "10.77.1.0/24"
+
     def node_config(self, i: int) -> dict:
         name = self.node_name(i)
-        return {
+        cfg = {
             "node_name": name,
             "openr_ctrl_port": self.ctrl_port,
             "persistent_store_path": f"{self.work_dir}/{name}_store.bin",
@@ -121,12 +131,85 @@ class NetnsLab:
             "originated_prefixes": [
                 {"prefix": self.originated_prefix(i), "install_to_fib": False}
             ],
+            # faster discovery/liveness so convergence is robust under a
+            # loaded CI host (defaults: hello 20s would stretch recovery
+            # from any missed fast-init window past the test budget)
+            "spark_config": {
+                "hello_time_s": 2.0,
+                "hold_time_s": 10.0,
+                "heartbeat_time_s": 1.0,
+            },
             # N daemons on one host must not contend for the one TPU chip;
             # small-topology SPF is scalar-fast anyway (see benchmarks)
             "tpu_compute_config": {"enable_tpu_spf": False},
             # v6-only veils carrying v4 prefixes (RFC 5549)
             "v4_over_v6_nexthop": True,
         }
+        if self.topology == "multiarea":
+            cfg["areas"] = self._multiarea_areas(i)
+            if i == 4:
+                # labs-202-style policy: pod2's border rejects node1's
+                # prefix at area import; everything else passes
+                cfg["policy_config"] = {
+                    "definitions": [
+                        {
+                            "name": "pod2-import",
+                            "statements": [
+                                {
+                                    "name": "drop-node1-prefix",
+                                    "criteria": [
+                                        {
+                                            "prefixes": [
+                                                {
+                                                    "prefix": (
+                                                        self.POLICY_DROPPED_PREFIX
+                                                    )
+                                                }
+                                            ]
+                                        }
+                                    ],
+                                    "action": {"accept": False},
+                                },
+                                {
+                                    "name": "accept-rest",
+                                    "criteria": [{"always_match": True}],
+                                    "action": {"accept": True},
+                                },
+                            ],
+                        }
+                    ]
+                }
+        return cfg
+
+    def _multiarea_areas(self, i: int) -> List[dict]:
+        """pod1 = nodes 0-3, spine = 3-4, pod2 = 4-7; border nodes pin
+        each area to its interfaces (AreaConfig regexes)."""
+        if i <= 2:
+            return [{"area_id": "pod1"}]
+        if i == 3:
+            return [
+                {
+                    "area_id": "pod1",
+                    "include_interface_regexes": [r"ve3_2"],
+                },
+                {
+                    "area_id": "spine",
+                    "include_interface_regexes": [r"ve3_4"],
+                },
+            ]
+        if i == 4:
+            return [
+                {
+                    "area_id": "spine",
+                    "include_interface_regexes": [r"ve4_3"],
+                },
+                {
+                    "area_id": "pod2",
+                    "include_interface_regexes": [r"ve4_5"],
+                    "import_policy": "pod2-import",
+                },
+            ]
+        return [{"area_id": "pod2"}]
 
     def start_daemon(self, i: int) -> None:
         name = self.node_name(i)
@@ -176,18 +259,44 @@ class NetnsLab:
         )
         return in_ns(self.ns_name(i), cmd, check=False).stdout
 
+    def expected_prefixes(self, i: int) -> List[str]:
+        """Prefixes node i's kernel must hold at convergence.  In the
+        multiarea lab, pod2's interior (nodes 5-7) must NOT receive the
+        policy-dropped prefix — node4's import policy rejects it at the
+        pod2 boundary."""
+        out = []
+        for j in range(self.num_nodes):
+            if j == i:
+                continue
+            p = self.originated_prefix(j)
+            if (
+                self.topology == "multiarea"
+                and i >= 5
+                and p == self.POLICY_DROPPED_PREFIX
+            ):
+                continue
+            out.append(p)
+        return out
+
     def converged(self) -> Tuple[bool, str]:
-        """Every node's kernel has a proto-99 route to every OTHER node's
-        originated prefix."""
+        """Every node's kernel has a proto-99 route to every expected
+        prefix."""
         for i in range(self.num_nodes):
             routes = "\n".join(self.kernel_routes(i))
-            for j in range(self.num_nodes):
-                if i == j:
-                    continue
-                want = self.originated_prefix(j)
+            for want in self.expected_prefixes(i):
                 if want not in routes:
                     return False, f"{self.node_name(i)} missing {want}"
         return True, "all kernels programmed"
+
+    def log_tails(self, n_chars: int = 1200) -> str:
+        out = []
+        for name in sorted(self.procs):
+            try:
+                tail = open(f"{self.work_dir}/{name}.log").read()[-n_chars:]
+            except OSError:
+                tail = "<no log>"
+            out.append(f"----- {name} -----\n{tail}")
+        return "\n".join(out)
 
     def wait_converged(self, timeout_s: float = 60.0) -> None:
         deadline = time.time() + timeout_s
@@ -203,7 +312,9 @@ class NetnsLab:
             time.sleep(1.0)
         ok, why = self.converged()
         if not ok:
-            raise TimeoutError(f"lab did not converge: {why}")
+            raise TimeoutError(
+                f"lab did not converge: {why}\n{self.log_tails()}"
+            )
 
     # -- teardown ------------------------------------------------------------
 
@@ -236,7 +347,7 @@ def main() -> None:
     up = sub.add_parser("up")
     up.add_argument("--nodes", type=int, default=3)
     up.add_argument("--topology", default="line",
-                    choices=["line", "ring", "full"])
+                    choices=["line", "ring", "full", "multiarea"])
     up.add_argument("--fib", default="netlink")
     sub.add_parser("down")
     sub.add_parser("status")

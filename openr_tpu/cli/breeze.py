@@ -524,7 +524,8 @@ def kvstore_hashes(ctx: click.Context, area: str, prefixes: tuple) -> None:
 @click.argument("key")
 @click.argument("value")
 @click.option("--area", default=Const.DEFAULT_AREA)
-@click.option("--version", default=1, type=int)
+@click.option("--version", default=None, type=int,
+              help="default: current version + 1 (reference breeze shape)")
 @click.option("--originator", default="breeze")
 @click.option("--ttl", default=3_600_000, type=int)
 @click.pass_context
@@ -533,10 +534,17 @@ def kvstore_set_key(
     key: str,
     value: str,
     area: str,
-    version: int,
+    version: Optional[int],
     originator: str,
     ttl: int,
 ) -> None:
+    if version is None:
+        # supersede whatever is there: higher version always wins the
+        # merge (a blind v1 against an existing key would be silently
+        # discarded by the version tie-break)
+        current = _call(ctx, "get_kv_store_key_vals_area", keys=[key],
+                        area=area)
+        version = current.get(key, {}).get("version", 0) + 1
     _call(
         ctx,
         "set_kv_store_key_vals_area",
@@ -551,7 +559,17 @@ def kvstore_set_key(
             }
         },
     )
-    click.echo(f"set {key} v{version} in area {area}")
+    # confirm the merge actually kept our write (stale/losing values are
+    # dropped without error by mergeKeyValues)
+    after = _call(ctx, "get_kv_store_key_vals_area", keys=[key], area=area)
+    kept = after.get(key, {})
+    if kept.get("version") == version and kept.get("originator_id") == originator:
+        click.echo(f"set {key} v{version} in area {area}")
+    else:
+        raise click.ClickException(
+            f"merge discarded the write: {key} is at "
+            f"v{kept.get('version')} from {kept.get('originator_id')!r}"
+        )
 
 
 # more decision breadth

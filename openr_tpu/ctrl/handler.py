@@ -263,6 +263,11 @@ class OpenrCtrlHandler:
             for prefix, (entry, dst_areas) in by_type.items():
                 if area in dst_areas and (not want or prefix in want):
                     out.append(entry.to_wire())
+        # config-originated aggregates advertise into their dst areas too
+        # (the _sync_kv_store desired-set shape, prefix_manager.py)
+        for prefix, (entry, dst_areas) in pm._originated_entries().items():
+            if area in dst_areas and (not want or prefix in want):
+                out.append(entry.to_wire())
         for prefix, (src_area, per_area) in pm._redistributed.items():
             entry = per_area.get(area)
             if entry is not None and (not want or prefix in want):

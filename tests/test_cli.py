@@ -154,10 +154,17 @@ def test_cli_tech_support(live_node):
 
 def test_cli_kvstore_set_key_roundtrip(live_node):
     """set-key must produce a BYTES value (the _value_hex marker) that the
-    merge path can hash and compare (code-review regression)."""
+    merge path can hash and compare, and a SECOND set must supersede the
+    first (auto version bump — a blind v1 rewrite would be silently
+    dropped by the merge; code-review regressions)."""
     _run(live_node, "kvstore", "set-key", "op:canary", "hello-world")
     kv = json.loads(_run(live_node, "kvstore", "key-vals", "op:canary"))
     assert bytes.fromhex(kv["op:canary"]["value"]) == b"hello-world"
+    v1 = kv["op:canary"]["version"]
+    _run(live_node, "kvstore", "set-key", "op:canary", "second-write")
+    kv = json.loads(_run(live_node, "kvstore", "key-vals", "op:canary"))
+    assert bytes.fromhex(kv["op:canary"]["value"]) == b"second-write"
+    assert kv["op:canary"]["version"] == v1 + 1
 
 
 def test_cli_negative_drain_values_rejected(live_node):

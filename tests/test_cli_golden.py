@@ -125,11 +125,17 @@ def check_golden(name: str, port: int, *args: str) -> None:
     assert r.exit_code == 0, r.output
     got = canonical(r.output)
     path = FIXTURES / f"{name}.golden"
-    if REGEN or not path.exists():
+    if REGEN:
         FIXTURES.mkdir(exist_ok=True)
         path.write_text(got)
-        if REGEN:
-            return
+        return
+    # a missing fixture is a FAILURE, not an auto-bless: silently writing
+    # it here would make every first run (and any deleted/renamed/
+    # forgotten fixture) vacuously pass while asserting nothing
+    assert path.exists(), (
+        f"no golden fixture {path}; generate it deliberately with "
+        "OPENR_TPU_REGEN_FIXTURES=1 and commit it"
+    )
     want = path.read_text()
     assert got == want, (
         f"golden mismatch for {name} ({' '.join(args)}):\n"

@@ -29,7 +29,7 @@ def test_three_node_line_full_stack():
     link-local gateway (multi-hop forwarding)."""
     lab = NetnsLab(num_nodes=3, topology="line")
     with lab:
-        lab.wait_converged(timeout_s=180)
+        lab.wait_converged(timeout_s=300)
         routes0 = "\n".join(lab.kernel_routes(0))
         # direct neighbor
         assert "10.77.1.0/24" in routes0
@@ -44,3 +44,33 @@ def test_three_node_line_full_stack():
             r.split("dev ")[1].split()[0] for r in routes1 if "dev" in r
         }
         assert ifaces == {"ve1_0", "ve1_2"}, routes1
+
+
+def test_multiarea_redistribution_and_policy():
+    """8 nodes, 3 areas (pod1 0-3, spine 3-4, pod2 4-7) — reference labs
+    201 (areas) + 202 (policy) on real kernels: prefixes cross TWO area
+    borders via FIB-confirmed redistribution, and node4's pod2 import
+    policy drops node1's prefix at the boundary while the border itself
+    (which learned it in the spine area) keeps it."""
+    lab = NetnsLab(num_nodes=8, topology="multiarea")
+    with lab:
+        lab.wait_converged(timeout_s=300)
+        # cross-area chain: pod2's far leaf reaches pod1's far leaf
+        r7 = "\n".join(lab.kernel_routes(7))
+        assert "10.77.0.0/24" in r7, r7
+        # policy: the dropped prefix never enters pod2's interior...
+        assert lab.POLICY_DROPPED_PREFIX not in r7, r7
+        for i in (5, 6):
+            routes = "\n".join(lab.kernel_routes(i))
+            assert lab.POLICY_DROPPED_PREFIX not in routes, (i, routes)
+        # ...but the border node itself learned it in the spine area
+        r4 = "\n".join(lab.kernel_routes(4))
+        assert lab.POLICY_DROPPED_PREFIX in r4, r4
+        # reverse redistribution: pod1's far leaf reaches pod2's far leaf
+        r0 = "\n".join(lab.kernel_routes(0))
+        assert "10.77.7.0/24" in r0, r0
+        # border forwards pod2-bound traffic out the spine interface
+        spine_bound = [
+            r for r in lab.kernel_routes(3) if "10.77.7.0/24" in r
+        ]
+        assert spine_bound and "dev ve3_4" in spine_bound[0], spine_bound
