@@ -118,8 +118,12 @@ class NetnsLab:
         for i in range(self.num_nodes):
             self.start_daemon(i)
 
-    #: the prefix the pod2 import policy drops in the multiarea lab
-    POLICY_DROPPED_PREFIX = "10.77.1.0/24"
+    @property
+    def POLICY_DROPPED_PREFIX(self) -> str:
+        """The prefix the pod2 import policy drops in the multiarea lab
+        (node1's originated prefix — derived, so a prefix-scheme change
+        can't silently detune the policy assertions)."""
+        return self.originated_prefix(1)
 
     def node_config(self, i: int) -> dict:
         name = self.node_name(i)

@@ -560,10 +560,16 @@ def kvstore_set_key(
         },
     )
     # confirm the merge actually kept our write (stale/losing values are
-    # dropped without error by mergeKeyValues)
+    # dropped without error by mergeKeyValues) — version, originator AND
+    # value: a same-version racer with a larger value wins the tie-break
+    # while leaving version/originator looking like ours
     after = _call(ctx, "get_kv_store_key_vals_area", keys=[key], area=area)
     kept = after.get(key, {})
-    if kept.get("version") == version and kept.get("originator_id") == originator:
+    if (
+        kept.get("version") == version
+        and kept.get("originator_id") == originator
+        and kept.get("value") == value.encode().hex()
+    ):
         click.echo(f"set {key} v{version} in area {area}")
     else:
         raise click.ClickException(

@@ -256,23 +256,16 @@ class OpenrCtrlHandler:
     def get_area_advertised_routes_filtered(
         self, area: str, prefixes: Optional[List[str]] = None
     ) -> List[dict]:
-        pm = self.node.prefix_manager
         want = set(prefixes or [])
-        out = []
-        for by_type in pm.advertised.values():
-            for prefix, (entry, dst_areas) in by_type.items():
-                if area in dst_areas and (not want or prefix in want):
-                    out.append(entry.to_wire())
-        # config-originated aggregates advertise into their dst areas too
-        # (the _sync_kv_store desired-set shape, prefix_manager.py)
-        for prefix, (entry, dst_areas) in pm._originated_entries().items():
-            if area in dst_areas and (not want or prefix in want):
-                out.append(entry.to_wire())
-        for prefix, (src_area, per_area) in pm._redistributed.items():
-            entry = per_area.get(area)
-            if entry is not None and (not want or prefix in want):
-                out.append(entry.to_wire())
-        return out
+        # the exact (deduped, best-per-prefix) set the KvStore sync
+        # advertises — shared builder so this view can't drift from it
+        return [
+            entry.to_wire()
+            for (a, prefix), entry in sorted(
+                self.node.prefix_manager.desired_advertisements().items()
+            )
+            if a == area and (not want or prefix in want)
+        ]
 
     def get_advertised_routes_with_origination_policy(
         self, policy_name: str

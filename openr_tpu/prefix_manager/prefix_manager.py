@@ -290,7 +290,13 @@ class PrefixManager(Actor):
 
     # -- KvStore sync (syncKvStore, PrefixManager.cpp:617) -----------------
 
-    def _sync_kv_store(self) -> None:
+    def desired_advertisements(self) -> Dict[Tuple[str, str], PrefixEntry]:
+        """(area, prefix) → entry: everything this node advertises into
+        each area right now — API/plugin advertisements (best-per-prefix
+        across types), config-originated aggregates, and cross-area
+        redistribution.  Single source of truth for BOTH the KvStore sync
+        and the ctrl area-view (so the operator surface can never drift
+        from what is actually advertised)."""
         desired: Dict[Tuple[str, str], PrefixEntry] = {}
         # API/plugin advertisements; if the same prefix is advertised under
         # several types, resolve deterministically by best metrics (the
@@ -304,15 +310,22 @@ class PrefixManager(Actor):
                     best_per_prefix[prefix] = (rank, entry, dst_areas)
         for prefix, (_rank, entry, dst_areas) in best_per_prefix.items():
             for area in dst_areas:
-                desired[(area, prefix_key(self.node_name, prefix))] = entry
+                desired[(area, prefix)] = entry
         # config-originated aggregates
         for prefix, (entry, dst_areas) in self._originated_entries().items():
             for area in dst_areas:
-                desired[(area, prefix_key(self.node_name, prefix))] = entry
+                desired[(area, prefix)] = entry
         # cross-area redistribution
         for prefix, (_src, per_area) in self._redistributed.items():
             for area, entry in per_area.items():
-                desired[(area, prefix_key(self.node_name, prefix))] = entry
+                desired[(area, prefix)] = entry
+        return desired
+
+    def _sync_kv_store(self) -> None:
+        desired = {
+            (area, prefix_key(self.node_name, prefix)): entry
+            for (area, prefix), entry in self.desired_advertisements().items()
+        }
 
         for (area, key), entry in desired.items():
             db = PrefixDatabase(

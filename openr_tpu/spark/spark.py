@@ -246,6 +246,9 @@ class Spark(Actor):
         self._fast_init_until = clock.now() + config.min_neighbor_discovery_interval_s
         self._discovery_signaled = False
         self._restarting = False
+        #: fuzz hook: raise instead of swallowing packet parse/process
+        #: errors (setThrowParserErrors, Spark.h:88,582-584)
+        self._throw_parser_errors = False
         #: during cold start, advertise adjacencies as one-sided
         self.adj_hold = adj_hold_until_initialized
         io.register(node_name, self._on_packet)
@@ -458,16 +461,32 @@ class Spark(Actor):
             msg = _unpack(payload)
         except Exception:  # noqa: BLE001 - malformed packet
             self.counters.bump("spark.packet_parse_error")
+            if self._throw_parser_errors:
+                raise
             return
-        if msg.node_name == self.node_name:
-            return  # our own multicast echo
-        self.touch()
-        if isinstance(msg, SparkHelloMsg):
-            self._process_hello(msg, if_name, int(recv_ts * 1e6))
-        elif isinstance(msg, SparkHandshakeMsg):
-            self._process_handshake(msg, if_name)
-        elif isinstance(msg, SparkHeartbeatMsg):
-            self._process_heartbeat(msg, if_name)
+        try:
+            if msg.node_name == self.node_name:
+                return  # our own multicast echo
+            self.touch()
+            if isinstance(msg, SparkHelloMsg):
+                self._process_hello(msg, if_name, int(recv_ts * 1e6))
+            elif isinstance(msg, SparkHandshakeMsg):
+                self._process_handshake(msg, if_name)
+            elif isinstance(msg, SparkHeartbeatMsg):
+                self._process_heartbeat(msg, if_name)
+        except Exception:  # noqa: BLE001 - well-formed JSON, hostile values
+            # (e.g. string seq numbers, absurd timestamps): a crafted
+            # packet must never kill the ingress task
+            self.counters.bump("spark.packet_process_error")
+            if self._throw_parser_errors:
+                raise
+
+    def set_throw_parser_errors(self, throw: bool) -> None:
+        """Fuzz hook (Spark.h:88,582-584 setThrowParserErrors): when set,
+        malformed-packet exceptions propagate out of the ingress path so a
+        fuzzer surfaces them as crashes; in production they are counted
+        and swallowed."""
+        self._throw_parser_errors = throw
 
     # -- FSM helpers -------------------------------------------------------
 
