@@ -184,7 +184,13 @@ def bench_decision_adj_update(results: List[Dict], full: bool) -> None:
             for adj in db.adjacencies:
                 adj.metric = 10 if toggle[0] else 1
             ls.update_adjacency_database(db)
-            b.build_route_db({"0": ls}, ps)
+            # exactly what Decision passes on a topology-only delta:
+            # force_full (SPF changed) with an empty prefix-churn set, so
+            # backends keep their candidate tables instead of re-reading
+            # the whole PrefixState
+            b.build_route_db(
+                {"0": ls}, ps, changed_prefixes=set(), force_full=True
+            )
 
         dt = _best_of(one_update, repeats=5)
         results.append(

@@ -25,7 +25,6 @@ the changed set.
 
 from __future__ import annotations
 
-import ipaddress
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -42,6 +41,7 @@ from openr_tpu.types import (
     NextHop,
     PrefixForwardingAlgorithm,
     RouteComputationRules,
+    prefix_is_v4,
 )
 
 #: max-out-degree lane buckets: D is a static jit arg, so it must not
@@ -148,10 +148,17 @@ class TpuBackend(DecisionBackend):
         solver: SpfSolver,
         node_buckets=(16, 64, 256, 1024, 4096, 16384),
         cand_buckets=(1, 2, 4, 8, 16, 32, 64),
+        min_device_prefixes: int = 0,
     ) -> None:
         self.solver = solver  # scalar fallback + MPLS/static
         self.node_buckets = tuple(node_buckets)
         self.cand_buckets = tuple(cand_buckets)
+        #: below this many prefixes the scalar path runs instead: each
+        #: device build pays one host↔device round trip (~75ms over a
+        #: tunneled chip, ~1ms locally), which tiny problems can't
+        #: amortize.  0 (default) = always use the device.
+        self.min_device_prefixes = min_device_prefixes
+        self.num_small_scalar_builds = 0
         self.num_device_builds = 0
         self.num_scalar_builds = 0
         self.num_incremental_builds = 0
@@ -207,10 +214,14 @@ class TpuBackend(DecisionBackend):
                 RouteComputationRules.PER_AREA_SHORTEST_DISTANCE,
             )
         ):
-            self.num_scalar_builds += 1
-            self._last_db = None
-            self._table_synced = False
-            return self.solver.build_route_db(area_link_states, prefix_state)
+            return self._scalar_fallback(area_link_states, prefix_state)
+        if (
+            self.min_device_prefixes
+            and len(prefix_state.prefixes()) < self.min_device_prefixes
+        ):
+            return self._scalar_fallback(
+                area_link_states, prefix_state, counter="small"
+            )
         try:
             db = self._build_device(
                 area_link_states, prefix_state, changed_prefixes, force_full
@@ -218,15 +229,25 @@ class TpuBackend(DecisionBackend):
         except ValueError:
             # e.g. a prefix with more candidates than the largest device
             # bucket — fall back rather than wedging the rebuild loop
-            self.num_scalar_builds += 1
-            self._last_db = None
-            self._table_synced = False
-            return self.solver.build_route_db(area_link_states, prefix_state)
+            return self._scalar_fallback(area_link_states, prefix_state)
         if cache_result:
             self._last_db = db
         else:
             self._last_db = None
         return db
+
+    def _scalar_fallback(
+        self, area_link_states, prefix_state, counter: str = "scalar"
+    ):
+        """Delegate one build to the scalar solver and invalidate every
+        incremental base (the candidate table misses this tick's churn)."""
+        if counter == "small":
+            self.num_small_scalar_builds += 1
+        else:
+            self.num_scalar_builds += 1
+        self._last_db = None
+        self._table_synced = False
+        return self.solver.build_route_db(area_link_states, prefix_state)
 
     # -- encoding (cached across prefix-churn rebuilds) --------------------
 
@@ -488,12 +509,34 @@ class TpuBackend(DecisionBackend):
         out_edges_by_area = [t.root_out_edges(me) for t in enc.topos]
         v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
 
-        # winner sets per row (vectorized candidate lookup)
+        # vectorized pre-extraction: ONE nonzero pass each over the winner
+        # matrix and the lane tensor, plus tolist() snapshots — per-element
+        # numpy scalar indexing in the per-route loop costs ~10x plain
+        # list access at DecisionBenchmark scale
+        R = use.shape[0]
+        u_rows, u_cols = np.nonzero(use)
+        u_starts = np.searchsorted(u_rows, np.arange(R + 1))
+        l_rows, l_areas, l_lanes = np.nonzero(lanes)
+        l_starts = np.searchsorted(l_rows, np.arange(R + 1))
+        u_cols_l = u_cols.tolist()
+        l_areas_l = l_areas.tolist()
+        l_lanes_l = l_lanes.tolist()
+        valid_l = valid.tolist()
+        shortest_l = shortest.tolist()
+
+        #: nexthop-set memo: many prefixes share one advertiser (e.g. the
+        #: reference benchmark's N prefixes/node), and their ECMP sets +
+        #: igp metric are fully determined by (v4ness, lane hits, per-area
+        #: validity/metric) — build each distinct set once
+        nh_memo: Dict[tuple, Optional[tuple]] = {}
+
+        # winner sets per row
         winner_sets: Dict[int, Set[Tuple[str, str]]] = {}
         for i, prefix in row_items:
             ti = int(gather_rows[i]) if gather_rows is not None else i
             wset = set()
-            for c in np.nonzero(use[i])[0]:
+            for k in range(u_starts[i], u_starts[i + 1]):
+                c = u_cols_l[k]
                 ai = int(dv.cand_area[ti, c])
                 node = enc.topos[ai].id_to_node[int(dv.cand_node[ti, c])]
                 wset.add((node, enc.areas[ai]))
@@ -532,22 +575,25 @@ class TpuBackend(DecisionBackend):
                     prefix, area_link_states, prefix_state
                 )
                 continue
-            is_v4 = ipaddress.ip_network(prefix).version == 4
+            is_v4 = prefix_is_v4(prefix)
             if is_v4 and not v4_ok:
                 results[prefix] = None
                 continue
             if any(n == me for (n, _a) in wset):
                 results[prefix] = None  # skip-if-self (SpfSolver.cpp:253)
                 continue
+            lane_hits = tuple(
+                (l_areas_l[k], l_lanes_l[k])
+                for k in range(l_starts[i], l_starts[i + 1])
+            )
             results[prefix] = self._decode_route(
                 prefix,
-                i,
                 wset,
                 is_v4,
-                shortest,
-                lanes,
-                valid,
-                enc,
+                valid_l[i],
+                shortest_l[i],
+                lane_hits,
+                nh_memo,
                 out_edges_by_area,
                 area_link_states,
                 all_entries[prefix],
@@ -557,13 +603,12 @@ class TpuBackend(DecisionBackend):
     def _decode_route(
         self,
         prefix,
-        p,
         wset,
         is_v4,
-        shortest,  # [R', A]
-        lanes,  # [R', A, D]
-        valid,  # [R', A]
-        enc,
+        valid_row,  # [A] bools for this row
+        shortest_row,  # [A] floats for this row
+        lane_hits,  # ((area_index, lane), ...) nonzero lanes for this row
+        nh_memo,  # {(is_v4, lane_hits, valids, metrics): (nhs, metric)|None}
         out_edges_by_area,
         area_link_states,
         entries,
@@ -571,37 +616,60 @@ class TpuBackend(DecisionBackend):
         me = self.solver.my_node_name
 
         # per-area lane decode + cross-area min-metric nexthop merge
-        # (SpfSolver.cpp:276-302)
-        shortest_metric = INF
-        total_next_hops = set()
-        for ai in range(enc.num_areas):
-            if not valid[p, ai]:
-                continue
-            m = float(shortest[p, ai])
-            nhs = set()
-            for lane, (link, neighbor) in enumerate(out_edges_by_area[ai]):
-                if lane >= lanes.shape[2] or not lanes[p, ai, lane]:
+        # (SpfSolver.cpp:276-302), memoized on everything it depends on
+        memo_key = (
+            is_v4,
+            lane_hits,
+            tuple(valid_row),
+            tuple(shortest_row),
+        )
+        cached = nh_memo.get(memo_key, False)
+        if cached is not False:
+            if cached is None:
+                return None
+            total_next_hops, shortest_metric = cached
+        else:
+            shortest_metric = INF
+            total_next_hops = set()
+            by_area: Dict[int, list] = {}
+            for ai, lane in lane_hits:
+                by_area.setdefault(ai, []).append(lane)
+            for ai, lanes_hit in by_area.items():
+                if not valid_row[ai]:
                     continue
-                nhs.add(
-                    NextHop(
-                        address=(
-                            link.get_nh_v4_from_node(me)
-                            if is_v4 and not self.solver.v4_over_v6_nexthop
-                            else link.get_nh_v6_from_node(me)
-                        ),
-                        if_name=link.get_iface_from_node(me),
-                        metric=int(m),
-                        area=link.area,
-                        neighbor_node_name=neighbor,
+                m = float(shortest_row[ai])
+                out_edges = out_edges_by_area[ai]
+                nhs = set()
+                for lane in lanes_hit:
+                    if lane >= len(out_edges):
+                        continue
+                    link, neighbor = out_edges[lane]
+                    nhs.add(
+                        NextHop(
+                            address=(
+                                link.get_nh_v4_from_node(me)
+                                if is_v4
+                                and not self.solver.v4_over_v6_nexthop
+                                else link.get_nh_v6_from_node(me)
+                            ),
+                            if_name=link.get_iface_from_node(me),
+                            metric=int(m),
+                            area=link.area,
+                            neighbor_node_name=neighbor,
+                        )
                     )
-                )
-            if not nhs:
-                continue
-            if shortest_metric >= m:
-                if shortest_metric > m:
-                    shortest_metric = m
-                    total_next_hops.clear()
-                total_next_hops |= nhs
+                if not nhs:
+                    continue
+                if shortest_metric >= m:
+                    if shortest_metric > m:
+                        shortest_metric = m
+                        total_next_hops.clear()
+                    total_next_hops |= nhs
+            nh_memo[memo_key] = (
+                (total_next_hops, shortest_metric)
+                if total_next_hops
+                else None
+            )
         if not total_next_hops:
             return None
 

@@ -31,7 +31,6 @@ Semantics preserved:
 
 from __future__ import annotations
 
-import ipaddress
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -43,6 +42,7 @@ from openr_tpu.types import (
     MplsActionCode,
     NextHop,
     PrefixEntry,
+    prefix_is_v4,
     PrefixForwardingAlgorithm,
     PrefixForwardingType,
     RouteComputationRules,
@@ -330,7 +330,7 @@ class SpfSolver:
         area_link_states: Dict[str, LinkState],
         prefix_state: PrefixState,
     ) -> Optional[RibUnicastEntry]:
-        is_v4 = ipaddress.ip_network(prefix).version == 4
+        is_v4 = prefix_is_v4(prefix)
         if is_v4 and not self.enable_v4 and not self.v4_over_v6_nexthop:
             return None
         self.best_routes_cache.pop(prefix, None)

@@ -272,6 +272,9 @@ class OpenrNode:
             TpuBackend(
                 solver,
                 node_buckets=tuple(config.tpu_compute_config.node_buckets),
+                min_device_prefixes=(
+                    config.tpu_compute_config.min_device_prefixes
+                ),
             )
             if use_tpu
             else ScalarBackend(solver)

@@ -232,6 +232,13 @@ def wire_type(cls):
     return cls
 
 
+def prefix_is_v4(prefix: str) -> bool:
+    """Address family of a normalized prefix without the full ipaddress
+    parse (the per-prefix ip_network() call was ~40% of route decode at
+    10k prefixes; normalized v6 always contains ':')."""
+    return ":" not in prefix
+
+
 def normalize_prefix(prefix: str) -> str:
     """Canonicalize an IP prefix string (host bits zeroed)."""
     return str(ipaddress.ip_network(prefix, strict=False))
