@@ -253,26 +253,24 @@ def test_large_grid_emulation_scale():
     for CI wall time).  Cold-start full-mesh convergence, then
     reconvergence after failing a central link."""
 
+    async def await_converged(net, clock, rounds, step_s):
+        for _ in range(rounds):
+            await clock.run_for(step_s)
+            ok, why = net.converged_full_mesh()
+            if ok:
+                return
+        raise AssertionError(why)
+
     async def main():
         clock = SimClock()
         net = EmulatedNetwork(clock)
         net.build(grid_edges(8))
         net.start()
-        for _ in range(6):
-            await clock.run_for(10.0)
-            ok, why = net.converged_full_mesh()
-            if ok:
-                break
-        assert ok, why
+        await await_converged(net, clock, rounds=6, step_s=10.0)
         # central link failure: every pair must still converge (grid has
         # alternate paths around any single link)
         net.fail_link("node27", "node28")
-        for _ in range(8):
-            await clock.run_for(5.0)
-            ok, why = net.converged_full_mesh()
-            if ok:
-                break
-        assert ok, why
+        await await_converged(net, clock, rounds=8, step_s=5.0)
         # the direct neighbor pair now routes around the failed link
         nhs = net.fib_routes("node27")[net.loopback("node28")]
         assert nhs and "node28" not in nhs, nhs
