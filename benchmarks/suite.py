@@ -394,6 +394,70 @@ def bench_parity_device_coverage(results: List[Dict], full: bool) -> None:
         "fraction"))
 
 
+def bench_fleet_rib(results: List[Dict], full: bool) -> None:
+    """Network-wide RIB: every node's route table from one batched device
+    solve (ops/allroots.py) vs sequential scalar per-vantage passes (the
+    reference's only mode, Decision.cpp:342 per getRouteDbComputed call).
+    The scalar side measures a sample of roots and reports the measured
+    per-root cost; 'scalar_projected_s' = per_root x V is labeled as a
+    projection, not a measurement."""
+    from openr_tpu.decision.fleet import FleetRibEngine
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.emulation.topology import (
+        build_adj_dbs,
+        grid_edges,
+        random_connected_edges,
+    )
+    from openr_tpu.types import PrefixEntry
+
+    edges = (
+        random_connected_edges(1024, 2048, seed=7) if full else grid_edges(16)
+    )
+    ls = LinkState("0")
+    dbs = build_adj_dbs(edges)
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    nodes = sorted(dbs)
+    V = len(nodes)
+    ps = PrefixState()
+    for i, node in enumerate(nodes):
+        ps.update_prefix(
+            node, "0", PrefixEntry(f"10.{(i >> 8) & 255}.{i & 255}.0/24")
+        )
+    als = {"0": ls}
+
+    eng = FleetRibEngine(SpfSolver(nodes[0]))
+    assert eng.eligible(als, ps, change_seq=0)
+    eng.compute_for_node(nodes[0], als, ps, change_seq=0)  # warm/compile
+    t0 = time.perf_counter()
+    # change_seq bump = cache miss: measures a full re-solve
+    eng.compute_for_node(nodes[0], als, ps, change_seq=1)
+    batch_s = time.perf_counter() - t0
+
+    # scalar sample: fresh solver per vantage (the per-call reference shape)
+    sample = nodes[:: max(1, V // 8)][:8]
+    t0 = time.perf_counter()
+    for node in sample:
+        SpfSolver(node).build_route_db(als, ps)
+    per_root_s = (time.perf_counter() - t0) / len(sample)
+
+    results.append(
+        _result(
+            f"fleet_rib_all_roots_{V}",
+            V / batch_s,
+            "vantage_ribs/s",
+            batch_s=round(batch_s, 3),
+            scalar_per_root_ms=round(per_root_s * 1000, 2),
+            scalar_projected_s=round(per_root_s * V, 1),
+            projected_speedup=round(per_root_s * V / batch_s, 1),
+            nodes=V,
+            scalar_sample_roots=len(sample),
+        )
+    )
+
+
 def bench_p50_convergence(results: List[Dict], full: bool) -> None:
     """North-star metric 2 (BASELINE.md): p50 publication→FIB-programmed
     convergence on the device path.  Drives the REAL Decision + Fib actors
@@ -818,6 +882,7 @@ ALL_BENCHES = [
     bench_decision_adj_update,
     bench_decision_prefix_update,
     bench_parity_device_coverage,
+    bench_fleet_rib,
     bench_p50_convergence,
     bench_kvstore_persist,
     bench_kvstore_flood_convergence,

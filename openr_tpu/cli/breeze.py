@@ -588,6 +588,21 @@ def decision_route_detail(ctx: click.Context) -> None:
     _print(_call(ctx, "get_route_detail_db"))
 
 
+@decision.command("fleet-summary")
+@click.pass_context
+def decision_fleet_summary(ctx: click.Context) -> None:
+    """Every node's route counts from one batched device solve."""
+    resp = _call(ctx, "get_fleet_rib_summary")
+    if not resp["eligible"]:
+        click.echo("fleet engine not eligible (multi-area/KSP2/algorithm)")
+        return
+    click.echo(f"{'Node':20} {'Routes':8} Nexthops")
+    for name, info in sorted(resp["nodes"].items()):
+        click.echo(
+            f"{name:20} {info['num_routes']:<8} {info['total_nexthops']}"
+        )
+
+
 @decision.command("received-routes-filtered")
 @click.option("--prefix", "prefixes", multiple=True)
 @click.option("--originator", default=None)

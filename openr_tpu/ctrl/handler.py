@@ -392,6 +392,16 @@ class OpenrCtrlHandler:
             out[prefix] = entries
         return out
 
+    def get_fleet_rib_summary(self) -> dict:
+        """Every node's route counts from ONE batched device solve (the
+        controller view; net-new vs the reference's one-node-per-call
+        getRouteDbComputed)."""
+        summary = self.node.decision.get_fleet_rib_summary()
+        return {
+            "eligible": summary is not None,
+            "nodes": summary or {},
+        }
+
     def get_route_detail_db(self) -> List[dict]:
         """Unicast routes with full selection detail: best entry, area,
         igp cost (getRouteDetailDb / RouteDetailDb)."""

@@ -101,6 +101,9 @@ class Decision(Actor):
         self._pending_topo_changed = False
         self._pending_force_full = False
         self._last_policy_active = False
+        #: bumped on every LSDB change — keys the fleet-RIB table cache
+        self._change_seq = 0
+        self._fleet_engine = None
         self._debounce = AsyncDebounce(
             self,
             config.debounce_min_ms / 1000.0,
@@ -162,6 +165,7 @@ class Decision(Actor):
             changed |= self._delete_key(area, key)
         if changed:
             self.counters.bump("decision.lsdb_updates")
+            self._change_seq += 1
             self._rebuild_pending = True
             if self._unblocked:
                 self._debounce()
@@ -362,9 +366,31 @@ class Decision(Actor):
             for prefix, entries in self.prefix_state.prefixes().items()
         }
 
+    def _fleet(self):
+        if self._fleet_engine is None:
+            from openr_tpu.decision.fleet import FleetRibEngine
+
+            self._fleet_engine = FleetRibEngine(self.solver)
+        return self._fleet_engine
+
     def compute_route_db_for_node(self, node: str) -> Optional[DecisionRouteDb]:
         """What-if: the RouteDb as `node` would compute it
-        (getRouteDbComputed ctrl API)."""
+        (getRouteDbComputed ctrl API).  When the device fleet engine is
+        eligible, ALL nodes' tables come from one cached batch solve and
+        only this node's view is decoded; else a fresh scalar pass."""
+        if not isinstance(self.backend, ScalarBackend):
+            fleet = self._fleet()
+            if fleet.eligible(
+                self.area_link_states, self.prefix_state, self._change_seq
+            ):
+                db = fleet.compute_for_node(
+                    node,
+                    self.area_link_states,
+                    self.prefix_state,
+                    self._change_seq,
+                )
+                if db is not None:
+                    return db
         solver = SpfSolver(
             node,
             enable_v4=self.solver.enable_v4,
@@ -374,3 +400,19 @@ class Decision(Actor):
             route_selection_algorithm=self.solver.route_selection_algorithm,
         )
         return solver.build_route_db(self.area_link_states, self.prefix_state)
+
+    def get_fleet_rib_summary(self) -> Optional[Dict[str, dict]]:
+        """Per-node route counts for EVERY vantage point from one batched
+        device solve; None when the fleet engine isn't eligible (incl.
+        scalar-only deployments, which must never touch the device
+        stack)."""
+        if isinstance(self.backend, ScalarBackend):
+            return None
+        fleet = self._fleet()
+        if not fleet.eligible(
+            self.area_link_states, self.prefix_state, self._change_seq
+        ):
+            return None
+        return fleet.fleet_summary(
+            self.area_link_states, self.prefix_state, self._change_seq
+        )

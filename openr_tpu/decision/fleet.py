@@ -1,0 +1,220 @@
+"""Fleet RIB engine: every node's what-if RouteDb from one device batch.
+
+The ctrl API's getRouteDbComputed answers "what routes would node X
+compute?" — the reference runs a fresh scalar SpfSolver pass per call
+(Decision.cpp:342), so a fleet-wide sweep costs |V| sequential
+Dijkstras.  Here all |V| vantage points are one batched device solve
+(ops/allroots.py: root = a batch dim of the fused SPF+selection
+kernel); the tables are cached until the LSDB changes, and each ctrl
+request decodes ONLY its root.
+
+Eligibility (else the scalar path runs, exactness preserved): a single
+area, SHORTEST_DISTANCE with best-route selection, and no KSP2_ED_ECMP
+advertisements (the k-path trace is per-root host work the batch can't
+amortize yet)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from openr_tpu.decision.rib import DecisionRouteDb, RibUnicastEntry
+from openr_tpu.decision.spf_solver import (
+    SpfSolver,
+    drained_entry,
+    select_best_node_area,
+)
+from openr_tpu.types import (
+    NextHop,
+    PrefixForwardingAlgorithm,
+    RouteComputationRules,
+    prefix_is_v4,
+)
+
+
+class FleetRibEngine:
+    """Caches all-roots selection tables per LSDB change generation."""
+
+    def __init__(self, solver: SpfSolver) -> None:
+        self.solver = solver  # settings template (v4 flags, labels, algo)
+        self._cache_key = None
+        self._tables = None
+        self._topo = None
+        self._cands = None
+        self._all_entries = None
+        self._ksp2_scan = None  # (change_seq, result)
+        self.num_batched_solves = 0
+        self.num_decodes = 0
+
+    # -- eligibility -------------------------------------------------------
+
+    def eligible(self, area_link_states, prefix_state, change_seq) -> bool:
+        if len(area_link_states) != 1:
+            return False
+        s = self.solver
+        if (
+            not s.enable_best_route_selection
+            or s.route_selection_algorithm
+            != RouteComputationRules.SHORTEST_DISTANCE
+        ):
+            return False
+        # the O(P*C) KSP2 scan is cached on the same change generation
+        # as the tables — ctrl requests between LSDB changes skip it
+        if self._ksp2_scan is not None and self._ksp2_scan[0] == change_seq:
+            return self._ksp2_scan[1]
+        ok = not any(
+            entry.forwarding_algorithm
+            == PrefixForwardingAlgorithm.KSP2_ED_ECMP
+            for entries in prefix_state.prefixes().values()
+            for entry in entries.values()
+        )
+        self._ksp2_scan = (change_seq, ok)
+        return ok
+
+    # -- table computation (cached) ---------------------------------------
+
+    def _tables_for(self, area_link_states, prefix_state, change_seq):
+        from openr_tpu.ops.allroots import AllRootsRouteCompute
+        from openr_tpu.ops.csr import encode_link_state, encode_prefix_candidates
+
+        (area, ls), = area_link_states.items()
+        key = (area, ls.topology_seq, change_seq)
+        if self._cache_key == key and self._tables is not None:
+            return self._tables, self._topo, area
+        topo = encode_link_state(ls)
+        cands = encode_prefix_candidates(prefix_state, topo, area)
+        compute = AllRootsRouteCompute(topo, cands, prefixes=cands.prefixes)
+        import numpy as np
+
+        roots = np.arange(topo.num_nodes, dtype=np.int32)
+        self._tables = compute.run(roots)
+        self._topo = topo
+        self._cands = cands
+        self._all_entries = prefix_state.prefixes()
+        self._cache_key = key
+        self.num_batched_solves += 1
+        return self._tables, self._topo, area
+
+    # -- per-root decode ---------------------------------------------------
+
+    def compute_for_node(
+        self, node: str, area_link_states, prefix_state, change_seq
+    ) -> Optional[DecisionRouteDb]:
+        """The RouteDb `node` would compute, decoded from the cached
+        batch tables; None when node is unknown (caller falls back)."""
+        tables, topo, area = self._tables_for(
+            area_link_states, prefix_state, change_seq
+        )
+        if node not in topo.node_ids:
+            return None
+        self.num_decodes += 1
+        ri = tables.root_index(topo.node_id(node))
+        # the requested node's view uses ITS solver settings shape: same
+        # config as the local solver, different vantage (Decision.cpp:342)
+        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+        out_edges = topo.root_out_edges(node)
+        all_entries = self._all_entries
+        cand_node = self._cands.cand_node
+        import numpy as np
+
+        db = DecisionRouteDb()
+        valid_rows = np.nonzero(tables.valid[ri])[0]
+        use_ri = tables.use[ri]
+        lanes_ri = tables.lanes[ri]
+        for p in valid_rows:
+            prefix = tables.prefixes[p]
+            if prefix_is_v4(prefix) and not v4_ok:
+                continue
+            entries = all_entries.get(prefix)
+            if not entries:
+                continue
+            # selection winners: candidate c of prefix p → (node, area)
+            wset = {
+                (topo.id_to_node[int(cand_node[p, c])], area)
+                for c in np.nonzero(use_ri[p])[0]
+            }
+            if not wset:
+                continue
+            m = float(tables.metric[ri, p])
+            nhs = set()
+            for lane in np.nonzero(lanes_ri[p])[0]:
+                if lane >= len(out_edges):
+                    continue
+                link, neighbor = out_edges[lane]
+                nhs.add(
+                    NextHop(
+                        address=(
+                            link.get_nh_v4_from_node(node)
+                            if prefix_is_v4(prefix)
+                            and not self.solver.v4_over_v6_nexthop
+                            else link.get_nh_v6_from_node(node)
+                        ),
+                        if_name=link.get_iface_from_node(node),
+                        metric=int(m),
+                        area=link.area,
+                        neighbor_node_name=neighbor,
+                    )
+                )
+            if not nhs:
+                continue
+            best_node_area = select_best_node_area(wset, node)
+            best = entries.get(best_node_area)
+            if best is None:
+                continue
+            if SpfSolver._is_node_drained(best_node_area, area_link_states):
+                best = drained_entry(best)
+            db.add_unicast_route(
+                RibUnicastEntry(
+                    prefix=prefix,
+                    nexthops=nhs,
+                    best_prefix_entry=best,
+                    best_area=best_node_area[1],
+                    igp_cost=m,
+                    local_prefix_considered=any(
+                        n == node for (n, _a) in entries.keys()
+                    ),
+                )
+            )
+        if self.solver.enable_node_segment_label:
+            # label routes are O(V) scalar per request, vantage-specific
+            s = self._vantage_solver(node)
+            s._build_node_label_routes(area_link_states, db)
+        return db
+
+    def _vantage_solver(self, node: str) -> SpfSolver:
+        s = self.solver
+        return SpfSolver(
+            node,
+            enable_v4=s.enable_v4,
+            enable_node_segment_label=s.enable_node_segment_label,
+            enable_best_route_selection=s.enable_best_route_selection,
+            v4_over_v6_nexthop=s.v4_over_v6_nexthop,
+            route_selection_algorithm=s.route_selection_algorithm,
+        )
+
+    # -- fleet summary -----------------------------------------------------
+
+    def fleet_summary(
+        self, area_link_states, prefix_state, change_seq
+    ) -> Dict[str, dict]:
+        """Per-node route counts + total nexthops from ONE batch solve —
+        the 'what does every router see' operator view."""
+        import numpy as np
+
+        tables, topo, _area = self._tables_for(
+            area_link_states, prefix_state, change_seq
+        )
+        # same per-prefix family gate compute_for_node applies — counts
+        # must agree with the decoded RouteDbs
+        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+        include = np.asarray(
+            [v4_ok or not prefix_is_v4(p) for p in tables.prefixes], bool
+        )
+        out = {}
+        for i, rid in enumerate(tables.roots):
+            name = topo.id_to_node[int(rid)]
+            counted = tables.valid[i] & include
+            out[name] = {
+                "num_routes": int(counted.sum()),
+                "total_nexthops": int(tables.num_nh[i][counted].sum()),
+            }
+        return out

@@ -1,0 +1,151 @@
+"""Network-wide RIB computation: every node's route table in one batch.
+
+A TPU-native capability past reference parity: the reference computes a
+what-if RouteDb for ONE vantage node per ctrl call
+(getRouteDbComputed → a fresh scalar SpfSolver pass,
+OpenrCtrlHandler.h/Decision.cpp:342); a fleet-wide view (the controller
+/ tech-support use case: "what does EVERY router's RIB look like right
+now?") costs V sequential Dijkstras.  Here the root is just a batch
+dimension of the fused SPF+selection kernel (ops/route_select.py
+``spf_and_select`` vmaps the root argument), so all |V| vantage points
+solve in bucketed device batches and the per-root tables stay cached
+until the topology changes; decoding to RibUnicastEntries happens
+per-REQUESTED root only.
+
+Single-area SHORTEST_DISTANCE semantics (the fleet-view fast path);
+other configurations fall back to the scalar per-node computation in
+Decision.compute_route_db_for_node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from openr_tpu.ops.csr import EncodedTopology, bucket_for
+
+ROOT_BUCKETS = (64, 256, 1024, 4096, 16384)
+
+
+@dataclasses.dataclass
+class AllRootsTables:
+    """Host copies of every root's selection outputs."""
+
+    roots: np.ndarray  # [B] root node ids (== np.arange(V))
+    valid: np.ndarray  # [B, P] bool
+    metric: np.ndarray  # [B, P] f32
+    lanes: np.ndarray  # [B, P, D] int8  (lane r = root's r-th out-edge)
+    num_nh: np.ndarray  # [B, P] int32
+    use: np.ndarray  # [B, P, C] bool — selection-winner candidates
+    prefixes: List[str]
+
+    def root_index(self, root_id: int) -> int:
+        idx = np.nonzero(self.roots == root_id)[0]
+        if not len(idx):
+            raise KeyError(f"root {root_id} not in tables")
+        return int(idx[0])
+
+
+class AllRootsRouteCompute:
+    """Batched every-node route computation over one encoded topology.
+
+    ``cands`` is the single-area candidate table (ops.sweep_select
+    .SweepCandidates shape).  ``run()`` solves all roots; results are the
+    raw selection outputs — per-root decode to Rib entries is the
+    caller's (cheap, per-request) concern."""
+
+    def __init__(
+        self,
+        topo: EncodedTopology,
+        cands,
+        prefixes: Optional[List[str]] = None,
+        root_buckets: Sequence[int] = ROOT_BUCKETS,
+    ) -> None:
+        import jax.numpy as jnp
+
+        self.topo = topo
+        self.cands = cands
+        self.prefixes = prefixes or []
+        self.root_buckets = tuple(root_buckets)
+        self.D = max(topo.max_out_degree(), 1)
+        self._dev = dict(
+            src=jnp.asarray(topo.src),
+            dst=jnp.asarray(topo.dst),
+            w=jnp.asarray(topo.w),
+            edge_ok=jnp.asarray(topo.edge_ok),
+            overloaded=jnp.asarray(topo.overloaded),
+            soft=jnp.asarray(topo.soft),
+            cand_node=jnp.asarray(cands.cand_node),
+            cand_ok=jnp.asarray(cands.cand_ok),
+            drain_metric=jnp.asarray(cands.drain_metric),
+            path_pref=jnp.asarray(cands.path_pref),
+            source_pref=jnp.asarray(cands.source_pref),
+            distance=jnp.asarray(cands.distance),
+            min_nexthop=jnp.asarray(cands.min_nexthop),
+        )
+
+    def run(
+        self, roots: Optional[np.ndarray] = None, max_chunk: int = 4096
+    ) -> AllRootsTables:
+        """Solve SPF + selection for the given roots (default: every
+        valid node) in bucketed batches; ONE host fetch per batch."""
+        import jax
+        import jax.numpy as jnp
+
+        from openr_tpu.ops.route_select import spf_and_select
+
+        if roots is None:
+            roots = np.arange(self.topo.num_nodes, dtype=np.int32)
+        roots = np.asarray(roots, np.int32)
+        E = self.topo.padded_edges
+        V = self.topo.padded_nodes
+        P = self.cands.cand_node.shape[0]
+        C = self.cands.cand_node.shape[1]
+        out_valid = np.empty((len(roots), P), bool)
+        out_metric = np.empty((len(roots), P), np.float32)
+        out_lanes = np.empty((len(roots), P, self.D), np.int8)
+        out_num = np.empty((len(roots), P), np.int32)
+        out_use = np.empty((len(roots), P, C), bool)
+        for off in range(0, len(roots), max_chunk):
+            chunk = roots[off : off + max_chunk]
+            b = bucket_for(len(chunk), self.root_buckets)
+            padded = np.zeros(b, np.int32)
+            padded[: len(chunk)] = chunk
+            valid, metric, nh_out, num_nh, use = spf_and_select(
+                self._dev["src"],
+                self._dev["dst"],
+                self._dev["w"],
+                self._dev["edge_ok"],
+                jnp.ones((b, E), bool),
+                jnp.broadcast_to(self._dev["overloaded"], (b, V)),
+                jnp.broadcast_to(self._dev["soft"], (b, V)),
+                jnp.asarray(padded),
+                self._dev["cand_node"],
+                self._dev["cand_ok"],
+                self._dev["drain_metric"],
+                self._dev["path_pref"],
+                self._dev["source_pref"],
+                self._dev["distance"],
+                self._dev["min_nexthop"],
+                max_degree=self.D,
+            )
+            valid, metric, nh_out, num_nh, use = jax.device_get(
+                (valid, metric, nh_out, num_nh, use)
+            )
+            n = len(chunk)
+            out_valid[off : off + n] = valid[:n]
+            out_metric[off : off + n] = metric[:n]
+            out_lanes[off : off + n] = nh_out[:n]
+            out_num[off : off + n] = num_nh[:n]
+            out_use[off : off + n] = use[:n]
+        return AllRootsTables(
+            roots=roots,
+            valid=out_valid,
+            metric=out_metric,
+            lanes=out_lanes,
+            num_nh=out_num,
+            use=out_use,
+            prefixes=list(self.prefixes),
+        )

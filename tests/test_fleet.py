@@ -1,0 +1,161 @@
+"""Fleet RIB engine — every node's RouteDb from one device batch.
+
+Parity bar: for EVERY vantage node, the batch-decoded RouteDb must equal
+the scalar per-node computation (the reference's getRouteDbComputed
+semantics, Decision.cpp:342), including drains, anycast winners and
+ECMP sets; the cache must invalidate on LSDB change; ineligible
+configurations must fall back scalar."""
+
+import random
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.decision.backend import TpuBackend
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.fleet import FleetRibEngine
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import route_db_summary
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.config import DecisionConfig
+from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import (
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixMetrics,
+)
+
+
+def build_world(soft=None, overloaded=None):
+    edges = grid_edges(4)
+    dbs = build_adj_dbs(
+        edges, soft_drained=soft or {}, overloaded=overloaded or []
+    )
+    ls = LinkState("0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    rng = random.Random(4)
+    for i in range(16):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+    # anycast with preference spread + a v6 prefix
+    ps.update_prefix("node3", "0", PrefixEntry(
+        "10.100.0.0/24", metrics=PrefixMetrics(path_preference=1000)))
+    ps.update_prefix("node12", "0", PrefixEntry(
+        "10.100.0.0/24", metrics=PrefixMetrics(path_preference=1000)))
+    ps.update_prefix("node7", "0", PrefixEntry("2001:db8::/64"))
+    del rng
+    return ls, ps
+
+
+def scalar_for(node, als, ps):
+    return SpfSolver(node).build_route_db(als, ps)
+
+
+def test_fleet_matches_scalar_for_every_root():
+    ls, ps = build_world(soft={"node10": 60}, overloaded=["node5"])
+    als = {"0": ls}
+    eng = FleetRibEngine(SpfSolver("node0"))
+    assert eng.eligible(als, ps, change_seq=1)
+    for i in range(16):
+        node = f"node{i}"
+        dev = eng.compute_for_node(node, als, ps, change_seq=1)
+        oracle = scalar_for(node, als, ps)
+        assert route_db_summary(dev) == route_db_summary(oracle), node
+    assert eng.num_batched_solves == 1  # one batch served all 16 decodes
+    assert eng.num_decodes == 16
+
+
+def test_fleet_cache_invalidation_on_change_seq():
+    ls, ps = build_world()
+    als = {"0": ls}
+    eng = FleetRibEngine(SpfSolver("node0"))
+    eng.compute_for_node("node1", als, ps, change_seq=1)
+    assert eng.num_batched_solves == 1
+    eng.compute_for_node("node2", als, ps, change_seq=1)
+    assert eng.num_batched_solves == 1  # cached
+    ps.update_prefix("node9", "0", PrefixEntry("10.200.0.0/24"))
+    db = eng.compute_for_node("node1", als, ps, change_seq=2)
+    assert eng.num_batched_solves == 2  # re-solved
+    assert "10.200.0.0/24" in db.unicast_routes
+    oracle = scalar_for("node1", als, ps)
+    assert route_db_summary(db) == route_db_summary(oracle)
+
+
+def test_fleet_ineligible_on_ksp2():
+    ls, ps = build_world()
+    ps.update_prefix(
+        "node2",
+        "0",
+        PrefixEntry(
+            "10.250.0.0/24",
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        ),
+    )
+    eng = FleetRibEngine(SpfSolver("node0"))
+    assert not eng.eligible({"0": ls}, ps, change_seq=1)
+
+
+def test_decision_actor_fleet_summary():
+    """Through the Decision actor: compute_route_db_for_node uses the
+    fleet engine (one batch, many decodes) and the fleet summary reports
+    every node."""
+    ls, ps = build_world()
+    clock = SimClock()
+    solver = SpfSolver("node0")
+    d = Decision(
+        "node0",
+        clock,
+        DecisionConfig(),
+        ReplicateQueue("routes"),
+        backend=TpuBackend(solver),
+        solver=solver,
+    )
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    for i in (0, 5, 15):
+        dev = d.compute_route_db_for_node(f"node{i}")
+        oracle = scalar_for(f"node{i}", d.area_link_states, d.prefix_state)
+        assert route_db_summary(dev) == route_db_summary(oracle), i
+    assert d._fleet_engine.num_batched_solves == 1
+    summary = d.get_fleet_rib_summary()
+    assert summary is not None and len(summary) == 16
+    assert summary["node0"]["num_routes"] == len(
+        scalar_for("node0", d.area_link_states, d.prefix_state).unicast_routes
+    )
+
+
+def test_fleet_summary_applies_v4_gate():
+    """Summary counts must match the decoded RouteDbs when v4 is
+    disabled (code-review regression: the v4 family gate applies to
+    counts too)."""
+    ls, ps = build_world()
+    als = {"0": ls}
+    solver = SpfSolver("node0", enable_v4=False, v4_over_v6_nexthop=False)
+    eng = FleetRibEngine(solver)
+    summary = eng.fleet_summary(als, ps, change_seq=1)
+    db = eng.compute_for_node("node0", als, ps, change_seq=1)
+    assert summary["node0"]["num_routes"] == len(db.unicast_routes)
+    # only the single v6 prefix survives the gate (advertised by node7)
+    assert summary["node0"]["num_routes"] == 1
+
+
+def test_scalar_backend_never_touches_fleet_engine():
+    from openr_tpu.decision.backend import ScalarBackend
+
+    ls, ps = build_world()
+    clock = SimClock()
+    solver = SpfSolver("node0")
+    d = Decision(
+        "node0",
+        clock,
+        DecisionConfig(),
+        ReplicateQueue("routes"),
+        backend=ScalarBackend(solver),
+        solver=solver,
+    )
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    assert d.get_fleet_rib_summary() is None
+    d.compute_route_db_for_node("node3")  # scalar path
+    assert d._fleet_engine is None  # engine never even constructed
