@@ -588,6 +588,46 @@ def decision_route_detail(ctx: click.Context) -> None:
     _print(_call(ctx, "get_route_detail_db"))
 
 
+@decision.command("whatif")
+@click.argument("links", nargs=-1, required=True,
+                metavar="NODE1,NODE2 [NODE1,NODE2 ...]")
+@click.pass_context
+def decision_whatif(ctx: click.Context, links: tuple) -> None:
+    """Which of this node's routes change if the given links fail?"""
+    failures = []
+    for spec in links:
+        parts = spec.split(",")
+        if len(parts) != 2:
+            raise click.ClickException(f"bad link spec {spec!r}: NODE1,NODE2")
+        failures.append(parts)
+    resp = _call(ctx, "get_link_failure_whatif", link_failures=failures)
+    if not resp["eligible"]:
+        click.echo("what-if engine not eligible (multi-area/KSP2/scalar)")
+        return
+    for f in resp["failures"]:
+        link = "-".join(f["link"])
+        if "error" in f:
+            click.echo(f"{link}: {f['error']}")
+            continue
+        if not f["routes_changed"]:
+            note = (
+                "" if f["on_shortest_path_dag"]
+                else " (off every shortest path)"
+            )
+            click.echo(f"{link}: no route changes{note}")
+            continue
+        click.echo(f"{link}: {f['routes_changed']} route(s) change")
+        for ch in f["changes"]:
+            old, new = ch["old_nexthops"], ch["new_nexthops"]
+            detail = f"{','.join(old) or '-'} -> {','.join(new) or '-'}"
+            if ch["change"] == "rerouted" and sorted(old) == sorted(new):
+                detail = (
+                    f"metric {ch['old_metric']:g} -> {ch['new_metric']:g} "
+                    f"via {','.join(new)}"
+                )
+            click.echo(f"  {ch['prefix']:24} {ch['change']:9} {detail}")
+
+
 @decision.command("fleet-summary")
 @click.pass_context
 def decision_fleet_summary(ctx: click.Context) -> None:

@@ -392,6 +392,15 @@ class OpenrCtrlHandler:
             out[prefix] = entries
         return out
 
+    def get_link_failure_whatif(self, link_failures: List[List[str]]) -> dict:
+        """Per-failure route deltas from this node's vantage for a batch
+        of candidate link failures — the what-if sweep engine behind one
+        RPC (net-new vs the reference)."""
+        result = self.node.decision.get_link_failure_whatif(link_failures)
+        if result is None:
+            return {"eligible": False, "failures": []}
+        return result
+
     def get_fleet_rib_summary(self) -> dict:
         """Every node's route counts from ONE batched device solve (the
         controller view; net-new vs the reference's one-node-per-call

@@ -56,7 +56,11 @@ async def run_node(
 
 
 async def run_emulation(
-    n: int, topology: str, base_port: int, verbose: bool = True
+    n: int,
+    topology: str,
+    base_port: int,
+    verbose: bool = True,
+    use_tpu_backend: bool = False,
 ) -> None:
     from openr_tpu.emulation.network import EmulatedNetwork
     from openr_tpu.emulation.topology import grid_edges, line_edges, ring_edges
@@ -72,7 +76,7 @@ async def run_emulation(
         "ring": lambda: ring_edges(n),
         "grid": lambda: grid_edges(int(n ** 0.5)),
     }[topology]()
-    net = EmulatedNetwork(WallClock())
+    net = EmulatedNetwork(WallClock(), use_tpu_backend=use_tpu_backend)
     net.build(edges)
     net.start()
     servers: List[OpenrCtrlServer] = []
@@ -185,6 +189,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                         "to the config's openr_ctrl_port / 2018")
     p.add_argument("--real", action="store_true",
                    help="with --config: real UDP/TCP/netlink planes")
+    p.add_argument("--tpu", action="store_true",
+                   help="with --emulate: TPU decision backend (enables "
+                        "fleet-summary / whatif device features)")
     p.add_argument("--ctrl-host", default="",
                    help="ctrl server bind address in --real mode "
                         "(default: all interfaces)")
@@ -195,7 +202,12 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     if args.emulate:
         asyncio.run(
-            run_emulation(args.emulate, args.topology, args.ctrl_base_port or 2018)
+            run_emulation(
+                args.emulate,
+                args.topology,
+                args.ctrl_base_port or 2018,
+                use_tpu_backend=args.tpu,
+            )
         )
         return
     if args.config:

@@ -104,6 +104,7 @@ class Decision(Actor):
         #: bumped on every LSDB change — keys the fleet-RIB table cache
         self._change_seq = 0
         self._fleet_engine = None
+        self._whatif_engine = None
         self._debounce = AsyncDebounce(
             self,
             config.debounce_min_ms / 1000.0,
@@ -383,12 +384,15 @@ class Decision(Actor):
             if fleet.eligible(
                 self.area_link_states, self.prefix_state, self._change_seq
             ):
-                db = fleet.compute_for_node(
-                    node,
-                    self.area_link_states,
-                    self.prefix_state,
-                    self._change_seq,
-                )
+                try:
+                    db = fleet.compute_for_node(
+                        node,
+                        self.area_link_states,
+                        self.prefix_state,
+                        self._change_seq,
+                    )
+                except ValueError:  # candidate-bucket overflow → scalar
+                    db = None
                 if db is not None:
                     return db
         solver = SpfSolver(
@@ -400,6 +404,36 @@ class Decision(Actor):
             route_selection_algorithm=self.solver.route_selection_algorithm,
         )
         return solver.build_route_db(self.area_link_states, self.prefix_state)
+
+    def get_link_failure_whatif(
+        self, link_failures: List
+    ) -> Optional[dict]:
+        """'Which of MY routes change if these links fail?' — one device
+        sweep over the candidate failures (the flagship what-if engine,
+        cached per LSDB generation).  None = ineligible (scalar-only
+        backend / multi-area / KSP2)."""
+        if isinstance(self.backend, ScalarBackend):
+            return None
+        fleet = self._fleet()
+        if not fleet.eligible(
+            self.area_link_states, self.prefix_state, self._change_seq
+        ):
+            return None
+        if self._whatif_engine is None:
+            from openr_tpu.decision.whatif_api import WhatIfApiEngine
+
+            self._whatif_engine = WhatIfApiEngine(self.solver)
+        try:
+            return self._whatif_engine.run(
+                [tuple(f) for f in link_failures],
+                self.area_link_states,
+                self.prefix_state,
+                self._change_seq,
+            )
+        except ValueError:
+            # e.g. an anycast prefix wider than the largest candidate
+            # bucket — ineligible, not an RPC error
+            return None
 
     def get_fleet_rib_summary(self) -> Optional[Dict[str, dict]]:
         """Per-node route counts for EVERY vantage point from one batched
@@ -413,6 +447,9 @@ class Decision(Actor):
             self.area_link_states, self.prefix_state, self._change_seq
         ):
             return None
-        return fleet.fleet_summary(
-            self.area_link_states, self.prefix_state, self._change_seq
-        )
+        try:
+            return fleet.fleet_summary(
+                self.area_link_states, self.prefix_state, self._change_seq
+            )
+        except ValueError:  # candidate-bucket overflow → ineligible
+            return None

@@ -1,0 +1,172 @@
+"""Operator-facing what-if: 'which of MY routes change if link X fails?'
+
+Wires the flagship sweep engine (ops/whatif.py + ops/sweep_select.py)
+into the daemon: the ctrl call takes a list of candidate link failures,
+runs them as one device batch against the CURRENT LSDB from this node's
+vantage, and returns per-failure route deltas (removed / rerouted /
+metric-changed) decoded to neighbor names.  The engine (base solve +
+repair plan + selection tables) is cached per LSDB change generation,
+so an operator sweeping many links pays the setup once.
+
+Single-area SHORTEST_DISTANCE vantage (the fleet-engine eligibility);
+anything else returns eligible=False and the operator falls back to
+per-failure scalar what-ifs via getRouteDbComputed semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.types import prefix_is_v4
+
+
+class WhatIfApiEngine:
+    """Cached sweep→routes pipeline for one node's vantage."""
+
+    def __init__(self, solver: SpfSolver) -> None:
+        self.solver = solver
+        self._cache_key = None
+        self._sweep = None
+        self._selector = None
+        self._topo = None
+        self._prefixes: List[str] = []
+        self.num_engine_builds = 0
+        self.num_sweeps = 0
+
+    def _engine_for(self, area_link_states, prefix_state, change_seq):
+        from openr_tpu.ops.csr import encode_link_state, encode_prefix_candidates
+        from openr_tpu.ops.sweep_select import SweepRouteSelector
+        from openr_tpu.ops.whatif import LinkFailureSweep
+
+        (area, ls), = area_link_states.items()
+        key = (area, ls.topology_seq, change_seq)
+        if self._cache_key == key:
+            return
+        topo = encode_link_state(ls)
+        me = self.solver.my_node_name
+        # EncodedPrefixCandidates exposes the exact candidate-array schema
+        # the selector reads — no copy
+        cands = encode_prefix_candidates(prefix_state, topo, area)
+        sweep = LinkFailureSweep(topo, me)
+        self._sweep = sweep
+        self._selector = SweepRouteSelector(topo, me, cands, max_degree=sweep.D)
+        self._topo = topo
+        self._prefixes = cands.prefixes
+        #: node-pair -> undirected link ids (PARALLEL links are distinct:
+        #: link identity includes interfaces, link_state.py)
+        self._pair_links = {}
+        for i, link in enumerate(topo.links):
+            self._pair_links.setdefault(
+                frozenset((link.n1, link.n2)), []
+            ).append(i)
+        self._cache_key = key
+        self.num_engine_builds += 1
+
+    def run(
+        self,
+        link_failures: List[Tuple[str, str]],
+        area_link_states,
+        prefix_state,
+        change_seq: int,
+    ) -> Dict:
+        """One device sweep over the given candidate failures; returns
+        per-failure route deltas from this node's vantage."""
+        self._engine_for(area_link_states, prefix_state, change_seq)
+        me = self.solver.my_node_name
+        lane_names = [
+            neighbor for (_link, neighbor) in self._topo.root_out_edges(me)
+        ]
+        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+
+        fails = []
+        resolved: List[Optional[object]] = []
+        for n1, n2 in link_failures:
+            lids = self._pair_links.get(frozenset((n1, n2)), [])
+            if len(lids) == 1:
+                resolved.append(lids[0])
+                fails.append(lids[0])
+            else:
+                # 0 = unknown pair; >1 = parallel links, where failing
+                # only one would mislead (traffic shifts to the survivor)
+                resolved.append(None if not lids else len(lids))
+                fails.append(-1)
+        deltas = self._selector.run(
+            self._sweep.run(np.asarray(fails, np.int32), fetch=False)
+        )
+        self.num_sweeps += 1
+
+        def lanes_to_names(lane_row) -> List[str]:
+            return [
+                lane_names[i]
+                for i in np.nonzero(lane_row)[0]
+                if i < len(lane_names)
+            ]
+
+        base_valid = deltas.base_valid
+        out = []
+        for s, ((n1, n2), lid) in enumerate(zip(link_failures, resolved)):
+            if lid is None:
+                out.append({"link": [n1, n2], "error": "unknown link"})
+                continue
+            if fails[s] == -1:  # lid holds the parallel-link count
+                out.append(
+                    {
+                        "link": [n1, n2],
+                        "error": (
+                            f"{lid} parallel links between pair; "
+                            "single-link what-if would shift traffic to "
+                            "the survivors — not supported"
+                        ),
+                    }
+                )
+                continue
+            changes = []
+            row = int(deltas.snap_row[s])
+            if row != 0:
+                p_idx, valid, metric, lanes = deltas.deltas_of_row(row)
+                for k in range(len(p_idx)):
+                    p = int(p_idx[k])
+                    prefix = self._prefixes[p]
+                    if prefix_is_v4(prefix) and not v4_ok:
+                        continue
+                    was, now = bool(base_valid[p]), bool(valid[k])
+                    if was and not now:
+                        kind = "removed"
+                    elif now and not was:
+                        kind = "added"
+                    else:
+                        kind = "rerouted"
+                    changes.append(
+                        {
+                            "prefix": prefix,
+                            "change": kind,
+                            "old_nexthops": (
+                                lanes_to_names(deltas.base_lanes[p])
+                                if was
+                                else []
+                            ),
+                            "new_nexthops": (
+                                lanes_to_names(lanes[k]) if now else []
+                            ),
+                            "old_metric": (
+                                float(deltas.base_metric[p]) if was else None
+                            ),
+                            "new_metric": (
+                                float(metric[k]) if now else None
+                            ),
+                        }
+                    )
+            out.append(
+                {
+                    "link": [n1, n2],
+                    "on_shortest_path_dag": bool(
+                        self._sweep.on_dag_links()[lid]
+                    ),
+                    "routes_changed": len(changes),
+                    "changes": changes,
+                }
+            )
+        return {"eligible": True, "vantage": me, "failures": out}

@@ -1,0 +1,153 @@
+"""Operator what-if API — per-failure route deltas vs scalar recompute.
+
+For each candidate link failure, the API's reported changes must match
+the difference between the scalar oracle's RouteDb on the intact
+topology and on a topology with the link actually removed."""
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import DecisionConfig
+from openr_tpu.decision.backend import ScalarBackend, TpuBackend
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import PrefixEntry
+
+
+def build_decision(backend_cls=TpuBackend):
+    edges = grid_edges(4)
+    dbs = build_adj_dbs(edges)
+    ls = LinkState("0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(16):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+    solver = SpfSolver("node0")
+    d = Decision(
+        "node0",
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue("routes"),
+        backend=backend_cls(solver),
+        solver=solver,
+    )
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    return d, dbs
+
+
+def scalar_routes_without_link(d, dbs, n1, n2):
+    """Oracle: rebuild the LSDB with the link removed, solve scalar."""
+    ls = LinkState("0")
+    for node, db in dbs.items():
+        import dataclasses
+
+        filtered = dataclasses.replace(
+            db,
+            adjacencies=[
+                a
+                for a in db.adjacencies
+                if {db.this_node_name, a.other_node_name} != {n1, n2}
+            ],
+        )
+        ls.update_adjacency_database(filtered)
+    return SpfSolver("node0").build_route_db({"0": ls}, d.prefix_state)
+
+
+def routes_view(db):
+    return {
+        p: (round(e.igp_cost, 1), sorted(n.neighbor_node_name for n in e.nexthops))
+        for p, e in db.unicast_routes.items()
+    }
+
+
+def test_whatif_matches_scalar_link_removal():
+    d, dbs = build_decision()
+    base = SpfSolver("node0").build_route_db(d.area_link_states, d.prefix_state)
+    base_view = routes_view(base)
+
+    cases = [("node0", "node1"), ("node1", "node2"), ("node14", "node15")]
+    resp = d.get_link_failure_whatif([list(c) for c in cases])
+    assert resp is not None and resp["eligible"]
+    assert resp["vantage"] == "node0"
+
+    for f, (n1, n2) in zip(resp["failures"], cases):
+        oracle = scalar_routes_without_link(d, dbs, n1, n2)
+        oracle_view = routes_view(oracle)
+        expected = {}
+        for p in set(base_view) | set(oracle_view):
+            was, now = p in base_view, p in oracle_view
+            if was and not now:
+                expected[p] = ("removed", base_view[p][1], [])
+            elif now and not was:
+                expected[p] = ("added", [], oracle_view[p][1])
+            elif base_view[p] != oracle_view[p]:
+                expected[p] = ("rerouted", base_view[p][1], oracle_view[p][1])
+        got = {
+            ch["prefix"]: (
+                ch["change"],
+                sorted(ch["old_nexthops"]),
+                sorted(ch["new_nexthops"]),
+            )
+            for ch in f["changes"]
+        }
+        assert got == expected, (f["link"], got, expected)
+
+
+def test_whatif_off_dag_link_reports_no_changes():
+    """In a unit-metric grid EVERY link is on some shortest path from the
+    corner, so force one off-DAG by giving it a heavy metric: the engine
+    must classify it off the DAG and report zero route changes (base
+    aliasing), matching the scalar recompute."""
+
+    edges = [
+        (a, b, 10 if {a, b} == {"node14", "node15"} else m)
+        for (a, b, m) in grid_edges(4)
+    ]
+    dbs = build_adj_dbs(edges)
+    ls = LinkState("0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(16):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+    solver = SpfSolver("node0")
+    d = Decision(
+        "node0",
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue("routes"),
+        backend=TpuBackend(solver),
+        solver=solver,
+    )
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    resp = d.get_link_failure_whatif([["node14", "node15"]])
+    f = resp["failures"][0]
+    assert f["on_shortest_path_dag"] is False  # heavy link beats no path
+    assert f["routes_changed"] == 0
+
+
+def test_whatif_unknown_link_and_scalar_backend():
+    d, _dbs = build_decision()
+    resp = d.get_link_failure_whatif([["node0", "node15"]])  # not adjacent
+    assert resp["failures"][0]["error"] == "unknown link"
+
+    d2, _ = build_decision(backend_cls=ScalarBackend)
+    assert d2.get_link_failure_whatif([["node0", "node1"]]) is None
+
+
+def test_whatif_engine_cached_across_calls():
+    d, _dbs = build_decision()
+    d.get_link_failure_whatif([["node0", "node1"]])
+    eng = d._whatif_engine
+    assert eng.num_engine_builds == 1
+    d.get_link_failure_whatif([["node1", "node2"]])
+    assert eng.num_engine_builds == 1  # cached until LSDB changes
+    d.prefix_state.update_prefix("node3", "0", PrefixEntry("10.99.0.0/24"))
+    d._change_seq += 1
+    d.get_link_failure_whatif([["node1", "node2"]])
+    assert eng.num_engine_builds == 2
