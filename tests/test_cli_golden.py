@@ -120,6 +120,51 @@ def live_node():
     t.join(timeout=30)
 
 
+@pytest.fixture(scope="module")
+def live_tpu_node():
+    """3-node line with the TPU decision backend — serves the device
+    features (fleet-summary, whatif) the scalar fixture can't."""
+    from openr_tpu.emulation.topology import line_edges as _line
+
+    started = threading.Event()
+    stop = None
+    result = {}
+
+    def runner():
+        nonlocal stop
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        result["loop"] = loop
+        stop = asyncio.Event()
+
+        async def main():
+            clock = WallClock()
+            net = EmulatedNetwork(clock, use_tpu_backend=True)
+            net.build(_line(3))
+            net.start()
+            server = OpenrCtrlServer(net.nodes["node0"], port=0)
+            await server.start()
+            result["port"] = server.port
+            for _ in range(200):
+                if len(net.nodes["node0"].fib.get_route_db()) >= 2:
+                    break
+                await asyncio.sleep(0.1)
+            started.set()
+            await stop.wait()
+            await server.stop()
+            await net.stop()
+
+        loop.run_until_complete(main())
+        loop.close()
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    assert started.wait(timeout=60), "live tpu node failed to start"
+    yield result["port"]
+    result["loop"].call_soon_threadsafe(stop.set)
+    t.join(timeout=30)
+
+
 def check_golden(name: str, port: int, *args: str) -> None:
     r = CliRunner().invoke(breeze, ["--port", str(port), *args], obj={})
     assert r.exit_code == 0, r.output
@@ -232,6 +277,24 @@ def test_golden_received_routes_filtered(live_node):
         "received-routes-filtered",
         "--originator",
         "node1",
+    )
+
+
+def test_golden_fleet_summary(live_tpu_node):
+    check_golden(
+        "fleet_summary", live_tpu_node, "decision", "fleet-summary"
+    )
+
+
+def test_golden_whatif(live_tpu_node):
+    """Failing the node1-node2 link from node0's vantage removes the
+    route to node2's loopback (no alternative path on a line)."""
+    check_golden(
+        "decision_whatif",
+        live_tpu_node,
+        "decision",
+        "whatif",
+        "node1,node2",
     )
 
 
