@@ -76,9 +76,9 @@ def canonical(output: str) -> str:
     return json.dumps(scrub(obj), indent=2, sort_keys=True) + "\n"
 
 
-@pytest.fixture(scope="module")
-def live_node():
-    """2-node wall-clock network + ctrl server on a background loop."""
+def _live_node_fixture(num_nodes: int, use_tpu_backend: bool, ready):
+    """One background-loop node lifecycle; fixtures below parameterize
+    topology size, backend, and the readiness predicate."""
     started = threading.Event()
     stop = None
     result = {}
@@ -92,16 +92,14 @@ def live_node():
 
         async def main():
             clock = WallClock()
-            net = EmulatedNetwork(clock)
-            net.build(line_edges(2))
+            net = EmulatedNetwork(clock, use_tpu_backend=use_tpu_backend)
+            net.build(line_edges(num_nodes))
             net.start()
             server = OpenrCtrlServer(net.nodes["node0"], port=0)
             await server.start()
             result["port"] = server.port
             for _ in range(200):
-                if adj_key("node1") in net.nodes["node0"].kv_store.dump_all(
-                    "0"
-                ) and net.nodes["node0"].fib.get_route_db():
+                if ready(net):
                     break
                 await asyncio.sleep(0.1)
             started.set()
@@ -121,48 +119,24 @@ def live_node():
 
 
 @pytest.fixture(scope="module")
+def live_node():
+    """2-node wall-clock network + ctrl server on a background loop."""
+    yield from _live_node_fixture(
+        2,
+        False,
+        lambda net: adj_key("node1")
+        in net.nodes["node0"].kv_store.dump_all("0")
+        and net.nodes["node0"].fib.get_route_db(),
+    )
+
+
+@pytest.fixture(scope="module")
 def live_tpu_node():
     """3-node line with the TPU decision backend — serves the device
     features (fleet-summary, whatif) the scalar fixture can't."""
-    from openr_tpu.emulation.topology import line_edges as _line
-
-    started = threading.Event()
-    stop = None
-    result = {}
-
-    def runner():
-        nonlocal stop
-        loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(loop)
-        result["loop"] = loop
-        stop = asyncio.Event()
-
-        async def main():
-            clock = WallClock()
-            net = EmulatedNetwork(clock, use_tpu_backend=True)
-            net.build(_line(3))
-            net.start()
-            server = OpenrCtrlServer(net.nodes["node0"], port=0)
-            await server.start()
-            result["port"] = server.port
-            for _ in range(200):
-                if len(net.nodes["node0"].fib.get_route_db()) >= 2:
-                    break
-                await asyncio.sleep(0.1)
-            started.set()
-            await stop.wait()
-            await server.stop()
-            await net.stop()
-
-        loop.run_until_complete(main())
-        loop.close()
-
-    t = threading.Thread(target=runner, daemon=True)
-    t.start()
-    assert started.wait(timeout=60), "live tpu node failed to start"
-    yield result["port"]
-    result["loop"].call_soon_threadsafe(stop.set)
-    t.join(timeout=30)
+    yield from _live_node_fixture(
+        3, True, lambda net: len(net.nodes["node0"].fib.get_route_db()) >= 2
+    )
 
 
 def check_golden(name: str, port: int, *args: str) -> None:
