@@ -397,7 +397,7 @@ def bench_parity_device_coverage(results: List[Dict], full: bool) -> None:
 
 def bench_fleet_rib(results: List[Dict], full: bool) -> None:
     """Network-wide RIB: every node's route table from one batched device
-    solve (ops/allroots.py) vs sequential scalar per-vantage passes (the
+    solve (ops/fleet_tables.py) vs sequential scalar per-vantage passes (the
     reference's only mode, Decision.cpp:342 per getRouteDbComputed call).
     The scalar side measures a sample of roots and reports the measured
     per-root cost; 'scalar_projected_s' = per_root x V is labeled as a

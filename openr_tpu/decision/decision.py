@@ -414,6 +414,10 @@ class Decision(Actor):
         backend / multi-area / KSP2)."""
         if isinstance(self.backend, ScalarBackend):
             return None
+        # the sweep engine's repair plan is single-area, single-vantage
+        # machinery (unlike the fleet tables, which are multi-area)
+        if len(self.area_link_states) != 1:
+            return None
         fleet = self._fleet()
         if not fleet.eligible(
             self.area_link_states, self.prefix_state, self._change_seq

@@ -3,32 +3,37 @@
 The ctrl API's getRouteDbComputed answers "what routes would node X
 compute?" — the reference runs a fresh scalar SpfSolver pass per call
 (Decision.cpp:342), so a fleet-wide sweep costs |V| sequential
-Dijkstras.  Here all |V| vantage points are one batched device solve
-(ops/allroots.py: root = a batch dim of the fused SPF+selection
-kernel); the tables are cached until the LSDB changes, and each ctrl
-request decodes ONLY its root.
+Dijkstras.  Here all vantage points are one batched device solve
+(ops/fleet_tables.py: root = a batch dim over the multi-area SPF +
+selection kernels, with per-area absence masked exactly like the scalar
+semantics); tables are cached until the LSDB changes, and each ctrl
+request decodes ONLY its root — through the SAME decode path the
+Decision backend uses (backend._decode_rows), so fleet results can
+never drift from the live RouteDb semantics.
 
-Eligibility (else the scalar path runs, exactness preserved): a single
-area, SHORTEST_DISTANCE with best-route selection, and no KSP2_ED_ECMP
-advertisements (the k-path trace is per-root host work the batch can't
-amortize yet)."""
+Eligibility (else the scalar path runs, exactness preserved):
+SHORTEST_DISTANCE or PER_AREA_SHORTEST_DISTANCE with best-route
+selection, and no KSP2_ED_ECMP advertisements (the k-path trace is
+per-root host work the batch can't amortize yet).  Multi-area LSDBs are
+first-class: cross-area min-metric merge happens in decode, per-area
+participation comes from each root's per-area symbol-table presence.
+"""
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
-from openr_tpu.decision.rib import DecisionRouteDb, RibUnicastEntry
-from openr_tpu.decision.spf_solver import (
-    SpfSolver,
-    drained_entry,
-    select_best_node_area,
-)
+import numpy as np
+
+from openr_tpu.decision.rib import DecisionRouteDb
+from openr_tpu.decision.spf_solver import SpfSolver
 from openr_tpu.types import (
-    NextHop,
     PrefixForwardingAlgorithm,
     RouteComputationRules,
     prefix_is_v4,
 )
+
+ROOT_CHUNK = 1024
 
 
 class FleetRibEngine:
@@ -37,10 +42,7 @@ class FleetRibEngine:
     def __init__(self, solver: SpfSolver) -> None:
         self.solver = solver  # settings template (v4 flags, labels, algo)
         self._cache_key = None
-        self._tables = None
-        self._topo = None
-        self._cands = None
-        self._all_entries = None
+        self._state = None  # dict of cached tables + decode context
         self._ksp2_scan = None  # (change_seq, result)
         self.num_batched_solves = 0
         self.num_decodes = 0
@@ -48,13 +50,12 @@ class FleetRibEngine:
     # -- eligibility -------------------------------------------------------
 
     def eligible(self, area_link_states, prefix_state, change_seq) -> bool:
-        if len(area_link_states) != 1:
+        if not area_link_states:
             return False
         s = self.solver
-        if (
-            not s.enable_best_route_selection
-            or s.route_selection_algorithm
-            != RouteComputationRules.SHORTEST_DISTANCE
+        if not s.enable_best_route_selection or s.route_selection_algorithm not in (
+            RouteComputationRules.SHORTEST_DISTANCE,
+            RouteComputationRules.PER_AREA_SHORTEST_DISTANCE,
         ):
             return False
         # the O(P*C) KSP2 scan is cached on the same change generation
@@ -73,111 +74,134 @@ class FleetRibEngine:
     # -- table computation (cached) ---------------------------------------
 
     def _tables_for(self, area_link_states, prefix_state, change_seq):
-        from openr_tpu.ops.allroots import AllRootsRouteCompute
-        from openr_tpu.ops.csr import encode_link_state, encode_prefix_candidates
+        import jax
+        import jax.numpy as jnp
 
-        (area, ls), = area_link_states.items()
-        key = (area, ls.topology_seq, change_seq)
-        if self._cache_key == key and self._tables is not None:
-            return self._tables, self._topo, area
-        topo = encode_link_state(ls)
-        cands = encode_prefix_candidates(prefix_state, topo, area)
-        compute = AllRootsRouteCompute(topo, cands, prefixes=cands.prefixes)
-        import numpy as np
+        from openr_tpu.decision.backend import DEGREE_BUCKETS
+        from openr_tpu.decision.cand_table import CandidateTable
+        from openr_tpu.ops.csr import bucket_for, encode_multi_area
+        from openr_tpu.ops.fleet_tables import fleet_multi_area_tables
 
-        roots = np.arange(topo.num_nodes, dtype=np.int32)
-        self._tables = compute.run(roots)
-        self._topo = topo
-        self._cands = cands
-        self._all_entries = prefix_state.prefixes()
+        key = (
+            tuple(
+                (a, area_link_states[a].topology_seq)
+                for a in sorted(area_link_states)
+            ),
+            change_seq,
+        )
+        if self._cache_key == key and self._state is not None:
+            return self._state
+        me = self.solver.my_node_name
+        enc = encode_multi_area(area_link_states, me)
+        table = CandidateTable()
+        table.full_sync(prefix_state)
+        dv = table.derived(enc)
+        # every node participating in ANY area gets a vantage row
+        names = sorted(set().union(*[set(t.node_ids) for t in enc.topos]))
+        roots_mat = np.asarray(
+            [[t.node_ids.get(n, -1) for t in enc.topos] for n in names],
+            np.int32,
+        )
+        D = bucket_for(max(enc.max_out_degree(), 1), DEGREE_BUCKETS)
+        per_area = (
+            self.solver.route_selection_algorithm
+            == RouteComputationRules.PER_AREA_SHORTEST_DISTANCE
+        )
+        dev = dict(
+            src=jnp.asarray(enc.src),
+            dst=jnp.asarray(enc.dst),
+            w=jnp.asarray(enc.w),
+            edge_ok=jnp.asarray(enc.edge_ok),
+            overloaded=jnp.asarray(enc.overloaded),
+            soft=jnp.asarray(enc.soft),
+            cand_area=jnp.asarray(dv.cand_area),
+            cand_node=jnp.asarray(dv.cand_node),
+            cand_ok=jnp.asarray(dv.cand_ok),
+            drain_metric=jnp.asarray(dv.drain_metric),
+            path_pref=jnp.asarray(dv.path_pref),
+            source_pref=jnp.asarray(dv.source_pref),
+            distance=jnp.asarray(dv.distance),
+            cand_node_in_area=jnp.asarray(dv.cand_node_in_area),
+        )
+        B = len(names)
+        P, C = dv.cand_ok.shape
+        A = enc.num_areas
+        use = np.empty((B, P, C), bool)
+        shortest = np.empty((B, P, A), np.float32)
+        lanes = np.empty((B, P, A, D), bool)
+        valid = np.empty((B, P, A), bool)
+        for off in range(0, B, ROOT_CHUNK):
+            chunk = roots_mat[off : off + ROOT_CHUNK]
+            b = 1 << max(5, (len(chunk) - 1).bit_length())  # pow2 bucket
+            padded = np.full((b, A), -1, np.int32)
+            padded[: len(chunk)] = chunk
+            # a fully -1 pad row would make SPF roots all-absent: fine
+            u, s_, l, v = fleet_multi_area_tables(
+                roots=jnp.asarray(padded),
+                max_degree=D,
+                per_area_distance=per_area,
+                **dev,
+            )
+            u, s_, l, v = jax.device_get((u, s_, l, v))
+            n = len(chunk)
+            use[off : off + n] = u[:n]
+            shortest[off : off + n] = s_[:n]
+            lanes[off : off + n] = l[:n]
+            valid[off : off + n] = v[:n]
+        self._state = dict(
+            enc=enc,
+            dv=dv,
+            table=table,
+            names=names,
+            index={n: i for i, n in enumerate(names)},
+            use=use,
+            shortest=shortest,
+            lanes=lanes,
+            valid=valid,
+        )
         self._cache_key = key
         self.num_batched_solves += 1
-        return self._tables, self._topo, area
+        return self._state
 
-    # -- per-root decode ---------------------------------------------------
+    # -- per-root decode (the backend's own decode path) -------------------
 
     def compute_for_node(
         self, node: str, area_link_states, prefix_state, change_seq
     ) -> Optional[DecisionRouteDb]:
         """The RouteDb `node` would compute, decoded from the cached
         batch tables; None when node is unknown (caller falls back)."""
-        tables, topo, area = self._tables_for(
-            area_link_states, prefix_state, change_seq
-        )
-        if node not in topo.node_ids:
+        from openr_tpu.decision.backend import TpuBackend
+
+        st = self._tables_for(area_link_states, prefix_state, change_seq)
+        ri = st["index"].get(node)
+        if ri is None:
             return None
         self.num_decodes += 1
-        ri = tables.root_index(topo.node_id(node))
-        # the requested node's view uses ITS solver settings shape: same
-        # config as the local solver, different vantage (Decision.cpp:342)
-        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
-        out_edges = topo.root_out_edges(node)
-        all_entries = self._all_entries
-        cand_node = self._cands.cand_node
-        import numpy as np
-
+        tb = TpuBackend(self._vantage_solver(node))
+        table = st["table"]
+        row_items = [
+            (int(r), table.row_prefix[r])
+            for r in np.nonzero(st["use"][ri].any(axis=1))[0]
+            if table.row_prefix[r] is not None
+        ]
+        results = tb._decode_rows(
+            row_items,
+            st["use"][ri],
+            st["shortest"][ri],
+            st["lanes"][ri],
+            st["valid"][ri],
+            st["dv"],
+            None,
+            st["enc"],
+            area_link_states,
+            prefix_state,
+        )
         db = DecisionRouteDb()
-        valid_rows = np.nonzero(tables.valid[ri])[0]
-        use_ri = tables.use[ri]
-        lanes_ri = tables.lanes[ri]
-        for p in valid_rows:
-            prefix = tables.prefixes[p]
-            if prefix_is_v4(prefix) and not v4_ok:
-                continue
-            entries = all_entries.get(prefix)
-            if not entries:
-                continue
-            # selection winners: candidate c of prefix p → (node, area)
-            wset = {
-                (topo.id_to_node[int(cand_node[p, c])], area)
-                for c in np.nonzero(use_ri[p])[0]
-            }
-            if not wset:
-                continue
-            m = float(tables.metric[ri, p])
-            nhs = set()
-            for lane in np.nonzero(lanes_ri[p])[0]:
-                if lane >= len(out_edges):
-                    continue
-                link, neighbor = out_edges[lane]
-                nhs.add(
-                    NextHop(
-                        address=(
-                            link.get_nh_v4_from_node(node)
-                            if prefix_is_v4(prefix)
-                            and not self.solver.v4_over_v6_nexthop
-                            else link.get_nh_v6_from_node(node)
-                        ),
-                        if_name=link.get_iface_from_node(node),
-                        metric=int(m),
-                        area=link.area,
-                        neighbor_node_name=neighbor,
-                    )
-                )
-            if not nhs:
-                continue
-            best_node_area = select_best_node_area(wset, node)
-            best = entries.get(best_node_area)
-            if best is None:
-                continue
-            if SpfSolver._is_node_drained(best_node_area, area_link_states):
-                best = drained_entry(best)
-            db.add_unicast_route(
-                RibUnicastEntry(
-                    prefix=prefix,
-                    nexthops=nhs,
-                    best_prefix_entry=best,
-                    best_area=best_node_area[1],
-                    igp_cost=m,
-                    local_prefix_considered=any(
-                        n == node for (n, _a) in entries.keys()
-                    ),
-                )
-            )
+        for _prefix, entry in sorted(results.items()):
+            if entry is not None:
+                db.add_unicast_route(entry)
         if self.solver.enable_node_segment_label:
-            # label routes are O(V) scalar per request, vantage-specific
-            s = self._vantage_solver(node)
-            s._build_node_label_routes(area_link_states, db)
+            tb.solver._build_node_label_routes(area_link_states, db)
         return db
 
     def _vantage_solver(self, node: str) -> SpfSolver:
@@ -196,25 +220,66 @@ class FleetRibEngine:
     def fleet_summary(
         self, area_link_states, prefix_state, change_seq
     ) -> Dict[str, dict]:
-        """Per-node route counts + total nexthops from ONE batch solve —
-        the 'what does every router see' operator view."""
-        import numpy as np
-
-        tables, topo, _area = self._tables_for(
-            area_link_states, prefix_state, change_seq
+        """Per-node unicast route counts + total nexthops from ONE batch
+        solve — the 'what does every router see' operator view.  Applies
+        the same host-side gates the decode applies (v4 family,
+        skip-if-self, min-nexthop over the cross-area merge) so counts
+        always match compute_for_node."""
+        st = self._tables_for(area_link_states, prefix_state, change_seq)
+        dv, table = st["dv"], st["table"]
+        use, shortest, lanes, valid = (
+            st["use"],
+            st["shortest"],
+            st["lanes"],
+            st["valid"],
         )
-        # same per-prefix family gate compute_for_node applies — counts
-        # must agree with the decoded RouteDbs
-        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+        B, P, A = valid.shape
+
         include = np.asarray(
-            [v4_ok or not prefix_is_v4(p) for p in tables.prefixes], bool
+            [
+                p is not None
+                and (
+                    self.solver.enable_v4
+                    or self.solver.v4_over_v6_nexthop
+                    or not prefix_is_v4(p)
+                )
+                for p in table.row_prefix
+            ],
+            bool,
+        )  # [P]
+        # cross-area min-metric merge, vectorized (SpfSolver.cpp:276-302)
+        m = np.where(valid, shortest, np.inf)  # [B, P, A]
+        m_star = m.min(axis=2)  # [B, P]
+        at_min = valid & (m == m_star[:, :, None])
+        num_nh_area = lanes.sum(axis=3)  # [B, P, A]
+        merged = (num_nh_area * at_min).sum(axis=2)  # [B, P]
+        # per-root gates, matching _decode_route exactly:
+        #   min-nexthop req = max over THIS root's selection winners
+        #   (not all candidates — a losing advertiser's requirement must
+        #   not gate the winner's route)
+        #   skip-if-self by GLOBAL candidate identity (adv_gid interned
+        #   per advertiser name; a never-advertising root has no gid and
+        #   can never self-win)
+        adv_gid = table.adv_gid  # [P, C] (-1 = empty slot)
+        gid_of = table._node_gid
+        self_win = np.zeros((B, P), bool)
+        req = np.zeros((B, P), np.int32)
+        for i, name in enumerate(st["names"]):
+            req[i] = np.max(np.where(use[i], dv.min_nexthop, 0), axis=1)
+            g = gid_of.get(name)
+            if g is not None:
+                self_win[i] = (use[i] & (adv_gid == g)).any(axis=1)
+        route_ok = (
+            include[None, :]
+            & valid.any(axis=2)
+            & ~self_win
+            & (merged > 0)
+            & (merged >= req)
         )
         out = {}
-        for i, rid in enumerate(tables.roots):
-            name = topo.id_to_node[int(rid)]
-            counted = tables.valid[i] & include
+        for i, name in enumerate(st["names"]):
             out[name] = {
-                "num_routes": int(counted.sum()),
-                "total_nexthops": int(tables.num_nh[i][counted].sum()),
+                "num_routes": int(route_ok[i].sum()),
+                "total_nexthops": int(merged[i][route_ok[i]].sum()),
             }
         return out

@@ -104,8 +104,13 @@ class EncodedTopology:
     def root_out_edges(self, root: str) -> List[Tuple[Link, str]]:
         """Lane r of the nexthop bitmask (for SPF rooted at `root`)
         corresponds to the r-th directed edge with src == root, in edge
-        order.  Returns [(link, neighbor_node_name)] by lane."""
-        rid = self.node_ids[root]
+        order.  Returns [(link, neighbor_node_name)] by lane; a root
+        absent from this area's graph has no lanes (the fleet engine
+        decodes vantage nodes that participate in only SOME areas — their
+        absent-area slices are masked unreachable by the kernel)."""
+        rid = self.node_ids.get(root)
+        if rid is None:
+            return []
         idx = np.nonzero((self.src == rid) & (self.link_index >= 0))[0]
         return [
             (self.links[self.link_index[e]], self.id_to_node[self.dst[e]])

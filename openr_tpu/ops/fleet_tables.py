@@ -1,0 +1,83 @@
+"""Batched multi-area fleet tables: every vantage node, every area.
+
+Generalizes the fleet-RIB batch (ops/allroots.py was the single-area
+form) to multi-area LSDBs: for each root in a batch, per-area SPF runs
+with the root's PER-AREA id (-1 = the root does not participate in that
+area: its whole area slice is masked unreachable, exactly the scalar
+semantics of a node computing SPF only where it has adjacencies), then
+the global multi-area selection chain (ops.route_select
+.multi_area_select_from_tables) produces the per-root winner sets,
+per-area shortest metrics and ECMP lane sets that the host-side decode
+(the same code path the Decision backend uses) turns into RouteDbs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from openr_tpu.ops.spf import BIG
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_degree", "per_area_distance")
+)
+def fleet_multi_area_tables(
+    src,  # [A, E]
+    dst,  # [A, E]
+    w,  # [A, E]
+    edge_ok,  # [A, E]
+    overloaded,  # [A, V]
+    soft,  # [A, V]
+    roots,  # [B, A] int32 — each root's id in each area, -1 = absent
+    cand_area,  # [P, C]
+    cand_node,  # [P, C]
+    cand_ok,  # [P, C]
+    drain_metric,  # [P, C]
+    path_pref,  # [P, C]
+    source_pref,  # [P, C]
+    distance,  # [P, C]
+    cand_node_in_area,  # [P, C, A]
+    max_degree: int,
+    per_area_distance: bool,
+):
+    """Returns per-root (use [B,P,C], shortest [B,P,A], lanes [B,P,A,D],
+    valid [B,P,A])."""
+    from openr_tpu.ops.route_select import (
+        multi_area_select_from_tables,
+        multi_area_spf_tables,
+    )
+
+    def one(r):  # r: [A] per-area root ids
+        area_ok = r >= 0
+        dist, nh = multi_area_spf_tables(
+            src,
+            dst,
+            w,
+            edge_ok,
+            overloaded,
+            jnp.maximum(r, 0),
+            max_degree=max_degree,
+        )
+        # areas the root doesn't participate in contribute nothing
+        dist = jnp.where(area_ok[:, None], dist, BIG)
+        nh = jnp.where(area_ok[:, None, None], nh, jnp.int8(0))
+        return multi_area_select_from_tables(
+            dist,
+            nh,
+            overloaded,
+            soft,
+            cand_area,
+            cand_node,
+            cand_ok,
+            drain_metric,
+            path_pref,
+            source_pref,
+            distance,
+            cand_node_in_area,
+            per_area_distance=per_area_distance,
+        )
+
+    return jax.vmap(one)(roots)

@@ -212,6 +212,20 @@ def _select_chunk(
     return changed_packed, valid, metric, lanes_packed
 
 
+def _base_select(*args):
+    """Base-table selection runs EAGER (plain jnp ops, no jit): under
+    jax 0.9.0 a jitted wrapper here intermittently served a corrupted
+    executable-cache entry once other kernels had compiled first
+    ('Execution supplied 12 buffers but compiled program expected 15' —
+    reproducible fleet-kernel-then-two-selector-builds; clear_cache()
+    made it pass, pinning the wrapper cache as the culprit).  This is
+    one small solve per engine build, amortized per LSDB change, so
+    eager dispatch costs nothing measurable."""
+    from openr_tpu.ops.route_select import select_routes_one
+
+    return select_routes_one(*args)
+
+
 @jax.jit
 def _gather_deltas(valid, metric, lanes_packed, flat_idx):
     """Gather changed (snapshot, prefix) rows by flat index j*P + p."""
@@ -271,9 +285,7 @@ class SweepRouteSelector:
             and key[1] is base_nh
         ):
             return self._base
-        from openr_tpu.ops.route_select import select_routes_one
-
-        valid, metric, nh_out, _num, _use = jax.jit(select_routes_one)(
+        valid, metric, nh_out, _num, _use = _base_select(
             self._dev["cand_node"],
             self._dev["cand_ok"],
             self._dev["drain_metric"],

@@ -159,3 +159,65 @@ def test_scalar_backend_never_touches_fleet_engine():
     assert d.get_fleet_rib_summary() is None
     d.compute_route_db_for_node("node3")  # scalar path
     assert d._fleet_engine is None  # engine never even constructed
+
+
+def test_fleet_multi_area_parity_every_vantage():
+    """Two areas joined by a border: EVERY vantage node (incl. ones
+    absent from one area — the KeyError the ctrl drive caught) must
+    decode to the scalar oracle's RouteDb, and summary counts must match
+    the decoded tables."""
+    from openr_tpu.emulation.topology import ring_edges
+
+    def mk_ls(edges, area):
+        ls = LinkState(area)
+        for db in build_adj_dbs(edges, area=area).values():
+            ls.update_adjacency_database(db)
+        return ls
+
+    als = {
+        "1": mk_ls(grid_edges(3), "1"),
+        "2": mk_ls(ring_edges(6, prefix="b") + [("b0", "node0", 1)], "2"),
+    }
+    ps = PrefixState()
+    ps.update_prefix("node8", "1", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("b3", "2", PrefixEntry("10.1.0.0/24"))
+    ps.update_prefix("b4", "2", PrefixEntry("10.2.0.0/24"))
+    # anycast ACROSS areas exercises the cross-area min-metric merge
+    ps.update_prefix("node2", "1", PrefixEntry("10.77.0.0/24"))
+    ps.update_prefix("b2", "2", PrefixEntry("10.77.0.0/24"))
+
+    eng = FleetRibEngine(SpfSolver("node0"))
+    assert eng.eligible(als, ps, change_seq=1)
+    summary = eng.fleet_summary(als, ps, change_seq=1)
+    names = sorted(summary)
+    assert len(names) == 15  # 9 grid + 6 ring (node0 in both)
+    for name in names:
+        dev = eng.compute_for_node(name, als, ps, change_seq=1)
+        oracle = SpfSolver(name).build_route_db(als, ps)
+        assert route_db_summary(dev) == route_db_summary(oracle), name
+        assert summary[name]["num_routes"] == len(oracle.unicast_routes), name
+    assert eng.num_batched_solves == 1
+
+
+def test_fleet_summary_min_nexthop_gates_winners_only():
+    """A LOSING advertiser's min_nexthop requirement must not gate the
+    winner's route in the summary counts (code-review repro: node8
+    advertises with min_nexthop=4 but loses selection to node0)."""
+    ls, _ = build_world()
+    als = {"0": ls}
+    ps = PrefixState()
+    ps.update_prefix("node8", "0", PrefixEntry(
+        "10.50.0.0/24", min_nexthop=4,
+        metrics=PrefixMetrics(path_preference=100)))
+    ps.update_prefix("node3", "0", PrefixEntry(
+        "10.50.0.0/24", metrics=PrefixMetrics(path_preference=200)))
+    eng = FleetRibEngine(SpfSolver("node0"))
+    summary = eng.fleet_summary(als, ps, change_seq=1)
+    for name in ("node0", "node15"):
+        oracle = SpfSolver(name).build_route_db(als, ps)
+        db = eng.compute_for_node(name, als, ps, change_seq=1)
+        assert route_db_summary(db) == route_db_summary(oracle), name
+        assert summary[name]["num_routes"] == len(oracle.unicast_routes), (
+            name, summary[name])
+    # the winner (node3) has no min-nexthop requirement: route exists
+    assert summary["node0"]["num_routes"] == 1
