@@ -1,46 +1,51 @@
 #!/usr/bin/env python
-"""Headline benchmark: the 10k x 1024-node what-if sweep.
+"""Headline benchmark: the 10k x 1024-node what-if sweep, END TO END.
 
-Task (BASELINE.md north star): full SPF results (f32 distances +
-all-shortest-paths first-hop lane sets) for 10,240 single-link-failure
-perturbations of a 1024-node WAN LSDB, one vantage root.
+Task (BASELINE.md north star): route tables for 10,240 single-link-
+failure perturbations of a 1024-node WAN LSDB, one vantage root, 1024
+advertised prefixes.
 
-Measured engines:
-  * **native**  — single-threaded C++ heap Dijkstra (native/spf_scalar.cc),
-    the honest stand-in for the reference's SpfSolver hot loop
-    (LinkState.cpp:721-800).  This is the baseline denominator.  The
-    reference re-solves every perturbed topology (its SPF memo is
-    invalidated on each change), so the naive full sweep is its true
-    behavior; a dedup-assisted variant is reported too for transparency.
-  * **python**  — the repo's scalar oracle (pure-Python Dijkstra), shown
-    because round 1 mistakenly used it as the only denominator.
-  * **device raw** — the warm-start repair kernel (ops/repair.py): every
-    one of the 10,240 snapshots is solved independently on device (no
-    dedup, no base aliasing — duplicates and off-DAG failures are solved
-    like everything else), with snapshots depth-sorted into chunks.  The
-    warm start is exact (see ops/repair.py docstring); its one-time
-    preprocessing cost is reported separately as base_solve_ms +
-    repair_plan_build_ms (the throughput numbers are warm steady-state).
-    The COLD kernel (ops/spf.py, what round 2 reported) is kept as a
-    detail line.
-  * **device engine** — the what-if engine (ops/whatif.py): repair
-    kernel + base aliasing + off-DAG skip + dedup.  Steady-state
-    throughput: work dispatched async, one sync — over a tunneled TPU a
-    sync round trip costs ~65ms, so single-shot numbers would measure
-    the tunnel, not the chip.  Results stay device-resident (downstream
-    route selection consumes them there); the host fetch of the
-    unique-solve tables is timed separately.
+The HEADLINE is the full operator-visible pipeline — sweep in, route
+deltas out: warm-start repair SPF (ops/repair.py) + on-device route
+selection diffed against the base table (ops/sweep_select.py) with
+delta-only host fetch, chunk selection dispatched behind the next
+chunk's SPF.  SPF-tables-only throughput (what rounds 2-3 headlined) is
+reported as a detail line (VERDICT r3 weak #2).
+
+The engine runs through the SAME mesh-sharded code path the multichip
+dryrun validates (shard_map over the batch axis; on the single bench
+chip the mesh has one device).
+
+Baselines (single-threaded C++, native/spf_scalar.cc):
+  * **naive** — from-scratch heap Dijkstra per snapshot, the reference's
+    true behavior (its SPF memo is invalidated per topology change,
+    LinkState.h:346-390).  Median of NATIVE_REPS sweeps with spread
+    (VERDICT r3 weak #1: a single timing swung -33% between rounds).
+  * **dedup** — Dijkstra once per unique failed link (the courtesy the
+    reference's memo would give within one unchanged topology).
+  * **warm-start** — the SAME incremental-repair trick the device kernel
+    uses, in C++ (spf_warm_sweep: off-DAG skip + affected-region
+    Dijkstra seeded from the base solve).  The demanding apples-to-
+    apples line: it separates "TPU is fast" from "incremental beats
+    from-scratch" (VERDICT r3 missing #2).
+  * **python** — the pure-Python oracle (round-1's flattering
+    denominator, kept for transparency).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-vs_baseline = device engine throughput / native naive throughput.
+value = end-to-end snapshots->route-deltas throughput;
+vs_baseline = that / native naive median.
 """
 
 import json
+import statistics
 import sys
 import time
 
 import numpy as np
+
+NATIVE_REPS = 5
+DEVICE_REPS = 3
 
 
 def main() -> None:
@@ -64,18 +69,32 @@ def main() -> None:
     rng = np.random.default_rng(0)
     fails = rng.integers(0, len(topo.links), size=total).astype(np.int32)
 
-    # ---- native C++ single-threaded baseline -----------------------------
+    # ---- native C++ single-threaded baselines (median of N + spread) -----
     native = NativeSpf(topo, "node0")
     native.sweep(fails[:32])  # warm caches
-    t0 = time.perf_counter()
-    native.sweep(fails)
-    native_naive_s = time.perf_counter() - t0
+    naive_times = []
+    for _ in range(NATIVE_REPS):
+        t0 = time.perf_counter()
+        native.sweep(fails)
+        naive_times.append(time.perf_counter() - t0)
+    native_naive_s = statistics.median(naive_times)
     native_sps = total / native_naive_s
     uniq = np.unique(fails)
-    t0 = time.perf_counter()
-    native.sweep(uniq)
-    native_dedup_s = time.perf_counter() - t0
-    native_dedup_sps = total / native_dedup_s
+    dedup_times = []
+    for _ in range(NATIVE_REPS):
+        t0 = time.perf_counter()
+        native.sweep(uniq)
+        dedup_times.append(time.perf_counter() - t0)
+    native_dedup_sps = total / statistics.median(dedup_times)
+    # native warm-start: same incremental-repair trick as the device
+    native.warm_prepare()
+    native.warm_sweep(fails[:32])
+    warm_times = []
+    for _ in range(NATIVE_REPS):
+        t0 = time.perf_counter()
+        native.warm_sweep(fails)
+        warm_times.append(time.perf_counter() - t0)
+    native_warm_sps = total / statistics.median(warm_times)
 
     # ---- pure-Python oracle (round-1's flattering denominator) -----------
     ls.run_spf("node0", links_to_ignore=frozenset([topo.links[0]]))
@@ -91,7 +110,11 @@ def main() -> None:
     # ---- device: engine setup (base solve + repair plan) -----------------
     import jax.numpy as jnp
 
-    eng = LinkFailureSweep(topo, "node0")
+    from openr_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()  # all local devices (1 on the bench chip) —
+    # the SAME shard_map path dryrun_multichip runs on 8
+    eng = LinkFailureSweep(topo, "node0", mesh=mesh)
     t0 = time.perf_counter()
     eng.base_solve()
     base_solve_ms = (time.perf_counter() - t0) * 1000
@@ -110,28 +133,30 @@ def main() -> None:
     from openr_tpu.ops.repair import sort_by_depth
 
     chunk = 4096
+    g = eng.batch_granularity
     sfails, _ = sort_by_depth(eng.plan(), fails)
 
     def raw_sweep(fl):
         outs = []
         for off in range(0, total, chunk):
             c = fl[off : off + chunk]
-            if len(c) % 32:
+            if len(c) % g:
                 c = np.concatenate(
-                    [c, np.full(32 - len(c) % 32, -1, np.int32)]
+                    [c, np.full(g - len(c) % g, -1, np.int32)]
                 )
             outs.append(rs.solve(c))
         return outs
 
     outs = raw_sweep(sfails)
     jax.block_until_ready(outs[-1][0])  # jit warm-up (excluded)
-    reps = 3
     t0 = time.perf_counter()
-    for _ in range(reps):
+    for _ in range(DEVICE_REPS):
         outs = raw_sweep(sfails)
     jax.block_until_ready(outs[-1][0])
-    device_raw_sps = reps * total / (time.perf_counter() - t0)
-    raw_rounds = [(int(o[2]), int(o[3])) for o in outs]
+    device_raw_sps = DEVICE_REPS * total / (time.perf_counter() - t0)
+    raw_rounds = [
+        (int(np.max(o[2])), int(np.max(o[3]))) for o in outs
+    ]  # per-device maxima under the sharded kernel
 
     # ---- device cold kernel (round-2's raw path, for transparency) -------
     from openr_tpu.ops.spf import sweep_spf_link_failures
@@ -160,28 +185,30 @@ def main() -> None:
     cold_sweep().block_until_ready()
     t0 = time.perf_counter()
     last = None
-    for _ in range(reps):
+    for _ in range(DEVICE_REPS):
         last = cold_sweep()
     last.block_until_ready()
-    device_cold_sps = reps * total / (time.perf_counter() - t0)
+    device_cold_sps = DEVICE_REPS * total / (time.perf_counter() - t0)
 
-    # ---- device: what-if engine (repair + alias + off-DAG + dedup) -------
+    # ---- device: SPF-tables-only engine throughput (detail line) ---------
     res = eng.run(fails, fetch=False)
     res.block()  # warm-up (compiles the bucket shapes)
     t0 = time.perf_counter()
-    results = [eng.run(fails, fetch=False) for _ in range(reps)]
+    results = [eng.run(fails, fetch=False) for _ in range(DEVICE_REPS)]
     results[-1].block()
-    engine_sps = reps * total / (time.perf_counter() - t0)
+    engine_sps = DEVICE_REPS * total / (time.perf_counter() - t0)
     # single-shot latency (what one cold rebuild tick would see)
     t0 = time.perf_counter()
     single = eng.run(fails, fetch=False)
     single.block()
     engine_latency_ms = (time.perf_counter() - t0) * 1000
-    # ---- sweep → routes: on-device selection + delta-only fetch ----------
+
+    # ---- THE HEADLINE: sweep -> route deltas, end to end -----------------
     # (ops/sweep_select.py): 1024 loopback prefixes selected against every
-    # snapshot ON DEVICE, diffed vs the base route table on device, and
-    # only the changed route rows cross the tunnel — the full end-to-end
-    # sweep→routes story, replacing the old multi-MB unique-table fetch
+    # snapshot ON DEVICE, diffed vs the base route table on device, only
+    # changed route rows cross the tunnel; every chunk's selection kernel
+    # is dispatched before the first blocking fetch so selection of chunk
+    # k overlaps SPF of chunk k+1
     from openr_tpu.ops.sweep_select import SweepCandidates, SweepRouteSelector
 
     sel = SweepRouteSelector(
@@ -189,12 +216,26 @@ def main() -> None:
         "node0",
         SweepCandidates.single_advertiser(np.arange(n_nodes)),
         max_degree=eng.D,
+        mesh=mesh,
     )
-    deltas = sel.run(single)  # warm-up (compiles chunk + gather shapes)
+    deltas = sel.run(single)  # warm-up (compiles chunk + compact shapes)
+    # single-shot latency: what ONE operator sweep experiences
     t0 = time.perf_counter()
-    sweep2 = eng.run(fails, fetch=False)
-    deltas = sel.run(sweep2)
+    deltas = sel.run(eng.run(fails, fetch=False))
     routes_pipeline_ms = (time.perf_counter() - t0) * 1000
+    # steady-state throughput: sweep k+1's kernels are dispatched before
+    # sweep k's delta fetch blocks, so the device never idles on the
+    # host/tunnel round trip (the continuous-what-if-service shape)
+    t0 = time.perf_counter()
+    prev = None
+    for _ in range(DEVICE_REPS):
+        sw = eng.run(fails, fetch=False)
+        if prev is not None:
+            deltas = sel.run(prev)
+        prev = sw
+    deltas = sel.run(prev)
+    e2e_sps = DEVICE_REPS * total / (time.perf_counter() - t0)
+
     # route parity vs native for sample snapshots (base + changed rows)
     for s in (3, 1007, 9000):
         native.solve(failed_link=int(fails[s]))
@@ -234,29 +275,49 @@ def main() -> None:
             native.lanes_dense(eng.D)[finite], single.nh_of(s)[finite]
         ), f"lane parity failure at snapshot {s}"
 
+    def spread(ts):
+        return {
+            "median_s": round(statistics.median(ts), 4),
+            "min_s": round(min(ts), 4),
+            "max_s": round(max(ts), 4),
+            "reps": len(ts),
+        }
+
     print(
         json.dumps(
             {
-                "metric": "whatif_sweep_snapshots_per_sec_10k_x_1024node",
-                "value": round(engine_sps, 1),
+                "metric": "whatif_routes_end_to_end_per_sec_10k_x_1024node",
+                "value": round(e2e_sps, 1),
                 "unit": "snapshots/s",
-                "vs_baseline": round(engine_sps / native_sps, 2),
+                "vs_baseline": round(e2e_sps / native_sps, 2),
                 "detail": {
                     "native_cxx_solves_per_sec": round(native_sps, 1),
+                    "native_naive_spread": spread(naive_times),
                     "native_cxx_dedup_effective_per_sec": round(
                         native_dedup_sps, 1
                     ),
+                    "native_warmstart_solves_per_sec": round(
+                        native_warm_sps, 1
+                    ),
+                    "native_warm_spread": spread(warm_times),
                     "python_solves_per_sec": round(python_sps, 1),
+                    "device_spf_tables_per_sec": round(engine_sps, 1),
                     "device_raw_solves_per_sec": round(device_raw_sps, 1),
                     "device_cold_solves_per_sec": round(device_cold_sps, 1),
+                    "vs_native_spf_tables_only": round(
+                        engine_sps / native_sps, 2
+                    ),
                     "vs_native_raw_kernel_only": round(
                         device_raw_sps / native_sps, 2
                     ),
                     "vs_native_cold_kernel": round(
                         device_cold_sps / native_sps, 2
                     ),
-                    "vs_native_dedup": round(engine_sps / native_dedup_sps, 2),
-                    "vs_python": round(engine_sps / python_sps, 2),
+                    "vs_native_dedup": round(e2e_sps / native_dedup_sps, 2),
+                    "vs_native_warmstart": round(
+                        e2e_sps / native_warm_sps, 2
+                    ),
+                    "vs_python": round(e2e_sps / python_sps, 2),
                     "engine_latency_ms": round(engine_latency_ms, 1),
                     "base_solve_ms": round(base_solve_ms, 1),
                     "repair_plan_build_ms": round(plan_build_ms, 1),
@@ -274,6 +335,7 @@ def main() -> None:
                     "nodes": n_nodes,
                     "directed_edges": topo.num_edges,
                     "lanes": eng.D,
+                    "mesh_devices": int(mesh.devices.size),
                     "devices": [str(d) for d in jax.devices()],
                     "wall_s": round(time.time() - t_start, 1),
                 },

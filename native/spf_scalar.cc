@@ -198,4 +198,240 @@ int spf_scalar_sweep(int32_t num_edges,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Warm-start (incremental-repair) sweep — the CPU form of the device
+// kernel's trick (openr_tpu/ops/repair.py), so the TPU speedup can be
+// compared against a native baseline using the SAME algorithmic
+// advantage (VERDICT r3 weak #1): failing an off-DAG link provably
+// changes nothing (base aliased); otherwise only the base-DAG
+// descendants of the failed edge heads are re-solved, seeded from the
+// frontier of provably-unchanged vertices, and lane masks are rebuilt
+// for the affected region in settle order.  Exact, not approximate —
+// the same invariants as the device kernel's docstring.
+// ---------------------------------------------------------------------------
+
+// Build the warm-start context from a completed base solve.  Outputs:
+//   edge_on_dag[E] u8, dag_row_ptr[V+1] + dag_edges[E] (DAG out-CSR),
+//   in_row_ptr[V+1] + in_edge_order[E] (in-edge CSR over dst),
+//   link_on_dag[L] u8.
+int spf_warm_prepare(int32_t num_edges,
+                     int32_t num_nodes,
+                     const int32_t* src,
+                     const int32_t* dst,
+                     const float* w,
+                     const uint8_t* edge_ok,
+                     const int32_t* link_index,
+                     const uint8_t* overloaded,
+                     int32_t root,
+                     int32_t num_links,
+                     const float* base_dist,
+                     uint8_t* edge_on_dag,
+                     int32_t* dag_row_ptr,
+                     int32_t* dag_edges,
+                     int32_t* in_row_ptr,
+                     int32_t* in_edge_order,
+                     uint8_t* link_on_dag) {
+  const float inf = std::numeric_limits<float>::infinity();
+  for (int32_t l = 0; l < num_links; ++l) link_on_dag[l] = 0;
+  for (int32_t e = 0; e < num_edges; ++e) {
+    const int32_t u = src[e];
+    const bool transit = !overloaded[u] || u == root;
+    edge_on_dag[e] = edge_ok[e] && transit && base_dist[u] < inf &&
+                     base_dist[dst[e]] < inf &&
+                     base_dist[u] + w[e] == base_dist[dst[e]];
+    if (edge_on_dag[e] && link_index[e] >= 0 && link_index[e] < num_links)
+      link_on_dag[link_index[e]] = 1;
+  }
+  // DAG out-CSR by src
+  for (int32_t v = 0; v <= num_nodes; ++v) dag_row_ptr[v] = 0;
+  for (int32_t e = 0; e < num_edges; ++e)
+    if (edge_on_dag[e]) dag_row_ptr[src[e] + 1]++;
+  for (int32_t v = 0; v < num_nodes; ++v) dag_row_ptr[v + 1] += dag_row_ptr[v];
+  {
+    int32_t* cursor = new int32_t[num_nodes];
+    std::memcpy(cursor, dag_row_ptr, sizeof(int32_t) * num_nodes);
+    for (int32_t e = 0; e < num_edges; ++e)
+      if (edge_on_dag[e]) dag_edges[cursor[src[e]]++] = e;
+    delete[] cursor;
+  }
+  // in-edge CSR by dst (all usable edges)
+  for (int32_t v = 0; v <= num_nodes; ++v) in_row_ptr[v] = 0;
+  for (int32_t e = 0; e < num_edges; ++e)
+    if (edge_ok[e]) in_row_ptr[dst[e] + 1]++;
+  for (int32_t v = 0; v < num_nodes; ++v) in_row_ptr[v + 1] += in_row_ptr[v];
+  {
+    int32_t* cursor = new int32_t[num_nodes];
+    std::memcpy(cursor, in_row_ptr, sizeof(int32_t) * num_nodes);
+    for (int32_t e = 0; e < num_edges; ++e)
+      if (edge_ok[e]) in_edge_order[cursor[dst[e]]++] = e;
+    delete[] cursor;
+  }
+  return 0;
+}
+
+// Warm-start sweep: num_solves sequential warm repairs.  dist_work /
+// nh_work must arrive initialized to the base solution and are restored
+// to it after every solve (so each solve is independent).  aff[V] u8 and
+// settled[V] u8 must arrive zeroed.  Outputs: checksum (anti-DCE), plus
+// the LAST solve's results left in dist_last/nh_last when non-null (for
+// parity tests; pass nullptr in the timed path to skip the copy).
+int spf_warm_sweep(int32_t num_edges,
+                   int32_t num_nodes,
+                   const int32_t* src,
+                   const int32_t* dst,
+                   const float* w,
+                   const uint8_t* edge_ok,
+                   const int32_t* link_index,
+                   const uint8_t* overloaded,
+                   const int32_t* row_ptr,
+                   const int32_t* edge_order,
+                   const int32_t* dag_row_ptr,
+                   const int32_t* dag_edges,
+                   const int32_t* in_row_ptr,
+                   const int32_t* in_edge_order,
+                   const int32_t* lane_of_edge,
+                   int32_t root,
+                   int32_t num_links,
+                   const float* base_dist,
+                   const uint64_t* base_nh,
+                   const uint8_t* link_on_dag,
+                   const int32_t* failed_links,
+                   int32_t num_solves,
+                   float* dist_work,
+                   uint64_t* nh_work,
+                   uint8_t* aff,
+                   int32_t* aff_list,
+                   int32_t* settle_order,
+                   void* heap_buf,
+                   uint8_t* settled,
+                   float* dist_last,
+                   uint64_t* nh_last,
+                   double* checksum) {
+  const float inf = std::numeric_limits<float>::infinity();
+  Heap heap(reinterpret_cast<HeapEntry*>(heap_buf));
+  double acc = 0.0;
+  const int32_t last = num_nodes - 1;
+  for (int32_t s = 0; s < num_solves; ++s) {
+    const int32_t fl = failed_links[s];
+    if (fl < 0 || fl >= num_links || !link_on_dag[fl]) {
+      // off-DAG / no-op failure: provably identical to the base solve
+      acc += base_dist[last] == inf ? -1.0 : base_dist[last];
+      if (s == num_solves - 1 && dist_last != nullptr && nh_last != nullptr) {
+        std::memcpy(dist_last, dist_work, sizeof(float) * num_nodes);
+        std::memcpy(nh_last, nh_work, sizeof(uint64_t) * num_nodes);
+      }
+      continue;
+    }
+    // affected set = DAG descendants of the failed edges' heads
+    int32_t na = 0;
+    for (int32_t e = 0; e < num_edges; ++e) {
+      if (link_index[e] != fl) continue;
+      // cheap: links have exactly 2 directed edges; scan cost is
+      // dominated by the Dijkstra below at the bench scale
+      const int32_t u = src[e];
+      const bool transit = !overloaded[u] || u == root;
+      if (edge_ok[e] && transit && base_dist[u] < inf &&
+          base_dist[dst[e]] < inf &&
+          base_dist[u] + w[e] == base_dist[dst[e]]) {
+        const int32_t h = dst[e];
+        if (!aff[h]) {
+          aff[h] = 1;
+          aff_list[na++] = h;
+        }
+      }
+    }
+    for (int32_t i = 0; i < na; ++i) {
+      const int32_t v = aff_list[i];
+      for (int32_t j = dag_row_ptr[v]; j < dag_row_ptr[v + 1]; ++j) {
+        const int32_t d2 = dst[dag_edges[j]];
+        if (!aff[d2]) {
+          aff[d2] = 1;
+          aff_list[na++] = d2;
+        }
+      }
+    }
+    // seed: best distance into each affected vertex from the unchanged
+    // frontier (base distances are exact lower bounds that removal can
+    // only raise, so non-affected vertices are final)
+    heap.clear();
+    for (int32_t i = 0; i < na; ++i) dist_work[aff_list[i]] = inf;
+    for (int32_t i = 0; i < na; ++i) {
+      const int32_t v = aff_list[i];
+      float best = inf;
+      for (int32_t j = in_row_ptr[v]; j < in_row_ptr[v + 1]; ++j) {
+        const int32_t e = in_edge_order[j];
+        if (link_index[e] == fl) continue;
+        const int32_t u = src[e];
+        if (aff[u]) continue;
+        if (overloaded[u] && u != root) continue;
+        if (dist_work[u] == inf) continue;
+        const float nd = dist_work[u] + w[e];
+        if (nd < best) best = nd;
+      }
+      if (best < inf) {
+        dist_work[v] = best;
+        heap.push(best, v);
+      }
+    }
+    // Dijkstra restricted to the affected region
+    int32_t ns = 0;
+    HeapEntry top;
+    while (heap.pop(&top)) {
+      const int32_t u = top.node;
+      if (settled[u] || top.dist > dist_work[u]) continue;
+      settled[u] = 1;
+      settle_order[ns++] = u;
+      if (overloaded[u] && u != root) continue;
+      for (int32_t i = row_ptr[u]; i < row_ptr[u + 1]; ++i) {
+        const int32_t e = edge_order[i];
+        if (!edge_ok[e] || link_index[e] == fl) continue;
+        const int32_t v = dst[e];
+        if (!aff[v] || settled[v]) continue;
+        const float nd = dist_work[u] + w[e];
+        if (nd < dist_work[v]) {
+          dist_work[v] = nd;
+          heap.push(nd, v);
+        }
+      }
+    }
+    // lane masks for the affected region, in settle (ascending-dist)
+    // order; predecessors are either non-affected (base lanes, final)
+    // or settled earlier (strictly smaller dist since w >= 1)
+    for (int32_t i = 0; i < ns; ++i) {
+      const int32_t v = settle_order[i];
+      uint64_t mask = 0;
+      for (int32_t j = in_row_ptr[v]; j < in_row_ptr[v + 1]; ++j) {
+        const int32_t e = in_edge_order[j];
+        if (link_index[e] == fl) continue;
+        const int32_t u = src[e];
+        if (overloaded[u] && u != root) continue;
+        if (dist_work[u] == inf) continue;
+        if (dist_work[u] + w[e] != dist_work[v]) continue;
+        const int32_t lane = lane_of_edge[e];
+        mask |= (u == root && lane >= 0) ? (uint64_t(1) << lane)
+                                         : nh_work[u];
+      }
+      nh_work[v] = mask;
+    }
+    // affected but now unreachable: clear lanes
+    for (int32_t i = 0; i < na; ++i)
+      if (dist_work[aff_list[i]] == inf) nh_work[aff_list[i]] = 0;
+    acc += dist_work[last] == inf ? -1.0 : dist_work[last];
+    if (s == num_solves - 1 && dist_last != nullptr && nh_last != nullptr) {
+      std::memcpy(dist_last, dist_work, sizeof(float) * num_nodes);
+      std::memcpy(nh_last, nh_work, sizeof(uint64_t) * num_nodes);
+    }
+    // restore base state for the next solve
+    for (int32_t i = 0; i < na; ++i) {
+      const int32_t v = aff_list[i];
+      dist_work[v] = base_dist[v];
+      nh_work[v] = base_nh[v];
+      aff[v] = 0;
+      settled[v] = 0;
+    }
+  }
+  *checksum = acc;
+  return 0;
+}
+
 }  // extern "C"

@@ -15,10 +15,11 @@ against an acceptable-peer-name list.  Here:
     checking is off by default like wangle's SSLVerifyPeerEnforce)
   * plaintext fallback: ``enabled=False`` (the default) keeps every
     plane on plaintext TCP — the reference's ``enable_secure_thrift``
-    off state; when enabled but cert files are missing, ``strict=False``
-    logs and falls back to plaintext instead of refusing to start
-    (lab/dev parity with --tls-ticket-less bringup), ``strict=True``
-    raises.
+    off state; when enabled but cert files are missing, the default
+    ``strict=True`` refuses to start (fail closed, like wangle/fizz);
+    ``strict=False`` must be opted into explicitly to log-and-fall-back
+    for lab/dev bringup.  Servers export a ``ctrl.tls_active`` counter so a
+    downgrade is observable, not just one log line.
 
 Test certs are generated with the ``cryptography`` package (see
 tests/test_tls.py); ops deployments bring their own PEMs.
@@ -54,9 +55,13 @@ class TlsConfig:
     #: authenticates by CA, and nodes dial link-local/loopback addresses
     #: that never match SANs
     verify_hostname: bool = False
-    #: refuse to start when enabled but certs are unusable (False = log
-    #: and fall back to plaintext)
-    strict: bool = False
+    #: refuse to start when enabled but certs are unusable.  Defaults to
+    #: FAIL CLOSED: with tls.enabled a typo'd cert path must not
+    #: silently downgrade the plane carrying drain/set-key mutations and
+    #: the whole LSDB sync to plaintext (the reference's wangle/fizz
+    #: server likewise refuses to start).  Set strict=False explicitly
+    #: for lab bringup where plaintext fallback is acceptable.
+    strict: bool = True
 
     def _files_ok(self, role: str) -> bool:
         if role == "server":

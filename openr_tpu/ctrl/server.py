@@ -76,6 +76,14 @@ class OpenrCtrlServer:
 
         ctx = server_ssl_context(self.tls)
         self.tls_active = ctx is not None
+        # observable downgrade signal (ADVICE r3): 1 = listener is TLS,
+        # 0 = plaintext while tls was requested (only reachable with an
+        # explicit strict=False opt-in)
+        counters = getattr(self.node, "counters", None)
+        if counters is not None and self.tls is not None and getattr(
+            self.tls, "enabled", False
+        ):
+            counters.set("ctrl.tls_active", 1 if self.tls_active else 0)
         self._server = await asyncio.start_server(
             self._on_connection, self.host, self.port, ssl=ctx
         )

@@ -665,6 +665,10 @@ class TpuBackend(DecisionBackend):
                         shortest_metric = m
                         total_next_hops.clear()
                     total_next_hops |= nhs
+            # memoized value is handed to MANY RibUnicastEntry objects;
+            # freeze it so no later in-place mutation of one route's
+            # nexthops can corrupt its siblings (ADVICE r3)
+            total_next_hops = frozenset(total_next_hops)
             nh_memo[memo_key] = (
                 (total_next_hops, shortest_metric)
                 if total_next_hops

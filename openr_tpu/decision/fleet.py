@@ -39,8 +39,13 @@ ROOT_CHUNK = 1024
 class FleetRibEngine:
     """Caches all-roots selection tables per LSDB change generation."""
 
-    def __init__(self, solver: SpfSolver) -> None:
+    def __init__(self, solver: SpfSolver, mesh=None) -> None:
+        """``mesh``: optional ``jax.sharding.Mesh`` with a ``batch``
+        axis — the vantage-root batch then shards across the mesh
+        (ops.fleet_tables.sharded_fleet_tables), bit-identical to the
+        single-device kernel."""
         self.solver = solver  # settings template (v4 flags, labels, algo)
+        self.mesh = mesh
         self._cache_key = None
         self._state = None  # dict of cached tables + decode context
         self._ksp2_scan = None  # (change_seq, result)
@@ -130,18 +135,47 @@ class FleetRibEngine:
         shortest = np.empty((B, P, A), np.float32)
         lanes = np.empty((B, P, A, D), bool)
         valid = np.empty((B, P, A), bool)
+        mesh_n = self.mesh.devices.size if self.mesh is not None else 1
+        if self.mesh is not None:
+            from openr_tpu.ops.fleet_tables import sharded_fleet_tables
+            from openr_tpu.parallel.mesh import batch_sharding, replicated
+
+            rep = replicated(self.mesh)
+            dev = {k: jax.device_put(v, rep) for k, v in dev.items()}
+            fleet_fn = sharded_fleet_tables(self.mesh, D, per_area)
+            roots_sh = batch_sharding(self.mesh)
         for off in range(0, B, ROOT_CHUNK):
             chunk = roots_mat[off : off + ROOT_CHUNK]
             b = 1 << max(5, (len(chunk) - 1).bit_length())  # pow2 bucket
+            b = ((b + mesh_n - 1) // mesh_n) * mesh_n  # whole device shards
             padded = np.full((b, A), -1, np.int32)
             padded[: len(chunk)] = chunk
             # a fully -1 pad row would make SPF roots all-absent: fine
-            u, s_, l, v = fleet_multi_area_tables(
-                roots=jnp.asarray(padded),
-                max_degree=D,
-                per_area_distance=per_area,
-                **dev,
-            )
+            if self.mesh is not None:
+                u, s_, l, v = fleet_fn(
+                    jax.device_put(padded, roots_sh),
+                    dev["src"],
+                    dev["dst"],
+                    dev["w"],
+                    dev["edge_ok"],
+                    dev["overloaded"],
+                    dev["soft"],
+                    dev["cand_area"],
+                    dev["cand_node"],
+                    dev["cand_ok"],
+                    dev["drain_metric"],
+                    dev["path_pref"],
+                    dev["source_pref"],
+                    dev["distance"],
+                    dev["cand_node_in_area"],
+                )
+            else:
+                u, s_, l, v = fleet_multi_area_tables(
+                    roots=jnp.asarray(padded),
+                    max_degree=D,
+                    per_area_distance=per_area,
+                    **dev,
+                )
             u, s_, l, v = jax.device_get((u, s_, l, v))
             n = len(chunk)
             use[off : off + n] = u[:n]

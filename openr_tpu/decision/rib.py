@@ -28,6 +28,10 @@ class RibUnicastEntry:
     """One computed unicast route (RibEntry.h:60-140)."""
 
     prefix: str
+    #: SHARED-OWNERSHIP INVARIANT: the backend memoizes nexthop sets and
+    #: hands the SAME (frozen) set to many entries, and
+    #: best_prefix_entry aliases the live PrefixState entry.  Never
+    #: mutate either in place — reassign (as RibPolicy does).
     nexthops: Set[NextHop] = field(default_factory=set)
     best_prefix_entry: PrefixEntry = field(default_factory=lambda: PrefixEntry("::/0"))
     best_area: str = ""

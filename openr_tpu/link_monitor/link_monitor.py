@@ -459,10 +459,13 @@ class LinkMonitor(Actor):
             "node_metric_increment": self.node_metric_increment,
             "link_overloads": sorted(self.link_overloads),
             "link_metric_overrides": dict(self.link_metric_overrides),
-            "adj_metric_overrides": {
-                f"{i}|{n}": m
+            # list-of-[if_name, node, metric] triples: interface names
+            # are free-form, so a joined-string key could collide with a
+            # separator character and round-trip wrongly (ADVICE r3)
+            "adj_metric_overrides": [
+                [i, n, m]
                 for (i, n), m in sorted(self.adj_metric_overrides.items())
-            },
+            ],
             "link_metric_increments": dict(self.link_metric_increments),
         }
 
@@ -474,10 +477,16 @@ class LinkMonitor(Actor):
         self.link_metric_overrides = dict(
             state.get("link_metric_overrides", {})
         )
-        self.adj_metric_overrides = {
-            tuple(k.split("|", 1)): m
-            for k, m in state.get("adj_metric_overrides", {}).items()
-        }
+        raw = state.get("adj_metric_overrides", [])
+        if isinstance(raw, dict):
+            # pre-r4 persisted form: '|'-joined keys (best-effort parse)
+            self.adj_metric_overrides = {
+                tuple(k.split("|", 1)): m for k, m in raw.items()
+            }
+        else:
+            self.adj_metric_overrides = {
+                (i, n): m for i, n, m in raw
+            }
         self.link_metric_increments = dict(
             state.get("link_metric_increments", {})
         )

@@ -81,3 +81,52 @@ def fleet_multi_area_tables(
         )
 
     return jax.vmap(one)(roots)
+
+
+_sharded_cache: dict = {}
+
+
+def sharded_fleet_tables(mesh, max_degree: int, per_area_distance: bool):
+    """Root-batch-sharded fleet kernel over a device mesh.
+
+    Vantage roots are independent solves, so each device runs the exact
+    single-device program on its contiguous root shard (no collectives);
+    topology + candidate tables replicate.  Root batches must be
+    multiples of the mesh size.  Bit-identical to the unsharded kernel.
+    """
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from openr_tpu.parallel.mesh import BATCH_AXIS
+
+    key = (mesh, max_degree, per_area_distance)
+    if key in _sharded_cache:
+        return _sharded_cache[key]
+    rep = P()
+    bat = P(BATCH_AXIS)
+    body = functools.partial(
+        fleet_multi_area_tables.__wrapped__,
+        max_degree=max_degree,
+        per_area_distance=per_area_distance,
+    )
+
+    def wrapped(roots, *tables):
+        return body(*tables[:6], roots, *tables[6:])
+
+    fn = jax.jit(
+        jax.shard_map(
+            wrapped,
+            mesh=mesh,
+            in_specs=(bat, *([rep] * 14)),
+            out_specs=(
+                P(BATCH_AXIS, None, None),  # use [B, P, C]
+                P(BATCH_AXIS, None, None),  # shortest [B, P, A]
+                P(BATCH_AXIS, None, None, None),  # lanes [B, P, A, D]
+                P(BATCH_AXIS, None, None),  # valid [B, P, A]
+            ),
+            check_vma=False,
+        )
+    )
+    _sharded_cache[key] = fn
+    return fn

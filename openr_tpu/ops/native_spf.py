@@ -120,6 +120,107 @@ class NativeSpf:
             raise RuntimeError(f"spf_scalar_sweep rc={rc}")
         return checksum.value
 
+    # -- warm-start (incremental-repair) baseline --------------------------
+
+    def warm_prepare(self) -> None:
+        """Build the warm-start context (base solve + DAG CSRs) — the
+        CPU analogue of the device repair plan (ops/repair.py), so bench
+        comparisons can use the same algorithmic trick on both sides."""
+        t = self.topo
+        V, E = self.V, self.E
+        self.lib.spf_warm_prepare.restype = ctypes.c_int
+        self.lib.spf_warm_sweep.restype = ctypes.c_int
+        base_dist, base_nh = self.solve(failed_link=-1)
+        self._wbase_dist = base_dist.copy()
+        self._wbase_nh = base_nh.copy()
+        self.num_links = len(t.links)
+        self._edge_on_dag = np.zeros(E, np.uint8)
+        self._dag_row_ptr = np.zeros(V + 1, np.int32)
+        self._dag_edges = np.zeros(E, np.int32)
+        self._in_row_ptr = np.zeros(V + 1, np.int32)
+        self._in_edge_order = np.zeros(E, np.int32)
+        self.link_on_dag = np.zeros(max(self.num_links, 1), np.uint8)
+        rc = self.lib.spf_warm_prepare(
+            E, V,
+            _ptr(t.src, ctypes.c_int32),
+            _ptr(t.dst, ctypes.c_int32),
+            _ptr(t.w, ctypes.c_float),
+            _ptr(self.edge_ok_u8, ctypes.c_uint8),
+            _ptr(t.link_index, ctypes.c_int32),
+            _ptr(self.overloaded_u8, ctypes.c_uint8),
+            ctypes.c_int32(int(self.root_id)),
+            ctypes.c_int32(self.num_links),
+            _ptr(self._wbase_dist, ctypes.c_float),
+            _ptr(self._edge_on_dag, ctypes.c_uint8),
+            _ptr(self._dag_row_ptr, ctypes.c_int32),
+            _ptr(self._dag_edges, ctypes.c_int32),
+            _ptr(self._in_row_ptr, ctypes.c_int32),
+            _ptr(self._in_edge_order, ctypes.c_int32),
+            _ptr(self.link_on_dag, ctypes.c_uint8),
+        )
+        if rc != 0:
+            raise RuntimeError(f"spf_warm_prepare rc={rc}")
+        self._wdist = self._wbase_dist.copy()
+        self._wnh = self._wbase_nh.copy()
+        self._aff = np.zeros(V, np.uint8)
+        self._aff_list = np.zeros(V, np.int32)
+        self._settle_order = np.zeros(V, np.int32)
+
+    def warm_sweep(
+        self, failed_links: np.ndarray, keep_last: bool = False
+    ) -> float:
+        """Warm-start sweep over the prepared base.  Returns the
+        checksum; with ``keep_last`` the final solve's (dist, lanes)
+        land in self.dist / self.nh_mask for parity checks."""
+        if not hasattr(self, "_wdist"):
+            self.warm_prepare()
+        t = self.topo
+        # solve() shares the settled scratch and leaves it set; the warm
+        # loop's restore pass only guarantees cleanliness across its own
+        # solves
+        self._settled[:] = 0
+        self._aff[:] = 0
+        fl = np.ascontiguousarray(failed_links, np.int32)
+        checksum = ctypes.c_double(0.0)
+        null_f = ctypes.POINTER(ctypes.c_float)()
+        null_u = ctypes.POINTER(ctypes.c_uint64)()
+        rc = self.lib.spf_warm_sweep(
+            self.E, self.V,
+            _ptr(t.src, ctypes.c_int32),
+            _ptr(t.dst, ctypes.c_int32),
+            _ptr(t.w, ctypes.c_float),
+            _ptr(self.edge_ok_u8, ctypes.c_uint8),
+            _ptr(t.link_index, ctypes.c_int32),
+            _ptr(self.overloaded_u8, ctypes.c_uint8),
+            _ptr(self.row_ptr, ctypes.c_int32),
+            _ptr(self.edge_order, ctypes.c_int32),
+            _ptr(self._dag_row_ptr, ctypes.c_int32),
+            _ptr(self._dag_edges, ctypes.c_int32),
+            _ptr(self._in_row_ptr, ctypes.c_int32),
+            _ptr(self._in_edge_order, ctypes.c_int32),
+            _ptr(self.lane_of_edge, ctypes.c_int32),
+            ctypes.c_int32(int(self.root_id)),
+            ctypes.c_int32(self.num_links),
+            _ptr(self._wbase_dist, ctypes.c_float),
+            _ptr(self._wbase_nh, ctypes.c_uint64),
+            _ptr(self.link_on_dag, ctypes.c_uint8),
+            _ptr(fl, ctypes.c_int32),
+            ctypes.c_int32(len(fl)),
+            _ptr(self._wdist, ctypes.c_float),
+            _ptr(self._wnh, ctypes.c_uint64),
+            _ptr(self._aff, ctypes.c_uint8),
+            _ptr(self._aff_list, ctypes.c_int32),
+            _ptr(self._settle_order, ctypes.c_int32),
+            self._heap.ctypes.data_as(ctypes.c_void_p),
+            _ptr(self._settled, ctypes.c_uint8),
+            _ptr(self.dist, ctypes.c_float) if keep_last else null_f,
+            _ptr(self.nh_mask, ctypes.c_uint64) if keep_last else null_u,
+            ctypes.byref(checksum),
+        )
+        if rc != 0:
+            raise RuntimeError(f"spf_warm_sweep rc={rc}")
+        return checksum.value
+
     def lanes_dense(self, max_degree: Optional[int] = None) -> np.ndarray:
         """Unpack nh_mask bits into the device kernel's [V, D] int8."""
         D = max_degree or self.topo.max_out_degree()

@@ -327,6 +327,26 @@ def _repair_sweep_impl(
 
 _kernel_cache: dict = {}
 
+#: positional order of _repair_sweep_impl's array arguments
+_ARG_ORDER = (
+    "src",
+    "dst",
+    "w",
+    "lid",
+    "transit_src_ok",
+    "fails",
+    "aff_link_table",
+    "base_dist",
+    "base_nh_bits",
+    "nbr_flat",
+    "pull_perm",
+    "pull_valid",
+    "nbr_is_root",
+    "seed_v",
+    "seed_r",
+    "seed_slot",
+)
+
 
 def _kernel():
     if "jit" not in _kernel_cache:
@@ -338,6 +358,53 @@ def _kernel():
     return _kernel_cache["jit"]
 
 
+def _sharded_kernel(mesh, d_lanes: int, din: int):
+    """Batch-sharded repair kernel over a device mesh.
+
+    Snapshots are embarrassingly parallel, so each device runs the
+    EXACT single-device program on its contiguous batch shard — no
+    collectives at all, and each shard's relaxation loops converge on
+    that shard's own depth instead of a global all-reduced predicate
+    (the depth-sorted batch makes contiguous shards depth-homogeneous).
+    Results are bit-identical to the unsharded kernel: both loops reach
+    unique fixed points regardless of round count (module docstring).
+    Round counters come back per-device ([n_dev] arrays)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from openr_tpu.parallel.mesh import BATCH_AXIS
+
+    key = (mesh, d_lanes, din)
+    if key in _kernel_cache:
+        return _kernel_cache[key]
+    rep = P()
+    bat = P(BATCH_AXIS)
+
+    def body(*args):
+        d, nh, rounds_d, rounds_l = _repair_sweep_impl(
+            *args, d_lanes=d_lanes, din=din
+        )
+        return d, nh, rounds_d.reshape(1), rounds_l.reshape(1)
+
+    in_specs = tuple(bat if n == "fails" else rep for n in _ARG_ORDER)
+    fn = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(
+                P(None, BATCH_AXIS),  # dist [V, B]
+                P(None, None, BATCH_AXIS),  # nh [V, D, B/32]
+                bat,  # rounds_d per device
+                bat,  # rounds_l per device
+            ),
+            check_vma=False,
+        )
+    )
+    _kernel_cache[key] = fn
+    return fn
+
+
 class RepairSweep:
     """Device-side warm-start sweep over one (topology, root).
 
@@ -347,16 +414,24 @@ class RepairSweep:
     warm start is an optimization, not an approximation (see module
     docstring)."""
 
-    def __init__(self, topo, plan: RepairPlan, device_edges=None) -> None:
+    def __init__(
+        self, topo, plan: RepairPlan, device_edges=None, mesh=None
+    ) -> None:
         """``device_edges``: optional (src, dst, w, link_index) device
         arrays to reuse (the sweep engine already holds them), avoiding a
-        duplicate host->device upload + HBM copy."""
+        duplicate host->device upload + HBM copy.
+
+        ``mesh``: optional ``jax.sharding.Mesh`` with a ``batch`` axis —
+        the sweep batch shards across it (the SURVEY §2.3 batched-
+        topology-parallelism axis); topology/plan constants replicate.
+        Batches must then be multiples of 32 * mesh size."""
         import jax.numpy as jnp
 
         self.topo = topo
         self.plan = plan
+        self.mesh = mesh
         p = plan
-        if device_edges is None:
+        if device_edges is None or self.mesh is not None:
             device_edges = (
                 jnp.asarray(topo.src),
                 jnp.asarray(topo.dst),
@@ -381,14 +456,49 @@ class RepairSweep:
             seed_r=jnp.asarray(p.seed_r),
             seed_slot=jnp.asarray(p.seed_slot),
         )
+        if self.mesh is not None:
+            # replicate constants across the mesh once, not per call
+            import jax
+
+            from openr_tpu.parallel.mesh import replicated
+
+            rep = replicated(self.mesh)
+            self._const = {
+                k: jax.device_put(v, rep) for k, v in self._const.items()
+            }
+
+    @property
+    def batch_granularity(self) -> int:
+        """Batches must be padded to a multiple of this (bit-packed lane
+        words x contiguous per-device shards)."""
+        n = self.mesh.devices.size if self.mesh is not None else 1
+        return 32 * n
 
     def solve(self, fails: np.ndarray):
-        """``fails`` length must be a multiple of 32 (pad with -1)."""
+        """``fails`` length must be a multiple of ``batch_granularity``
+        (pad with -1)."""
+        import jax
         import jax.numpy as jnp
 
         p = self.plan
-        if len(fails) % 32:
-            raise ValueError("repair sweep batch must be a multiple of 32")
+        g = self.batch_granularity
+        if len(fails) % g:
+            raise ValueError(
+                f"repair sweep batch must be a multiple of {g}"
+            )
+        if self.mesh is not None:
+            from openr_tpu.parallel.mesh import batch_sharding
+
+            fails_d = jax.device_put(
+                np.asarray(fails, np.int32), batch_sharding(self.mesh)
+            )
+            kern = _sharded_kernel(self.mesh, p.lanes, p.din)
+            return kern(
+                *(
+                    fails_d if n == "fails" else self._const[n]
+                    for n in _ARG_ORDER
+                )
+            )
         return _kernel()(
             fails=jnp.asarray(fails),
             d_lanes=p.lanes,

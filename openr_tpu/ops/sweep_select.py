@@ -212,6 +212,46 @@ def _select_chunk(
     return changed_packed, valid, metric, lanes_packed
 
 
+_sharded_select_cache: dict = {}
+
+
+def _sharded_select_chunk(mesh, max_degree: int):
+    """Batch-sharded per-chunk selection: each device selects + diffs its
+    own contiguous snapshot shard (no collectives — snapshots are
+    independent), consuming the repair kernel's sharded outputs in place
+    so chunk tables never leave their device."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from openr_tpu.parallel.mesh import BATCH_AXIS
+
+    key = (mesh, max_degree)
+    if key in _sharded_select_cache:
+        return _sharded_select_cache[key]
+    rep = P()
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(_select_chunk.__wrapped__, max_degree=max_degree),
+            mesh=mesh,
+            in_specs=(
+                P(None, BATCH_AXIS),  # dist_d [V, b]
+                P(None, None, BATCH_AXIS),  # nh_packed [V, D, b/32]
+                *([rep] * 13),  # topology + candidate + base tables
+            ),
+            out_specs=(
+                P(BATCH_AXIS, None),  # changed_packed [b, Pw]
+                P(BATCH_AXIS, None),  # valid [b, P]
+                P(BATCH_AXIS, None),  # metric [b, P]
+                P(BATCH_AXIS, None, None),  # lanes_packed [b, P, Dw]
+            ),
+            check_vma=False,
+        )
+    )
+    _sharded_select_cache[key] = fn
+    return fn
+
+
 def _base_select(*args):
     """Base-table selection runs EAGER (plain jnp ops, no jit): under
     jax 0.9.0 a jitted wrapper here intermittently served a corrupted
@@ -235,6 +275,50 @@ def _gather_deltas(valid, metric, lanes_packed, flat_idx):
     return valid[j, p], metric[j, p], lanes_packed[j, p]
 
 
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _compact_deltas(changed_packed, valid, metric, lanes_packed, n, cap: int):
+    """On-device delta compaction: scatter every changed (snapshot,
+    prefix) row into a dense [cap] buffer ordered by flat index, plus
+    the true change count.
+
+    Over a tunneled device the mask-fetch + gather-fetch protocol costs
+    two blocking round trips per chunk; this costs ONE (count + buffer
+    in a single device_get).  ``n`` masks padding snapshots on device.
+    Rows beyond ``cap`` are dropped (mode='drop'); the caller detects
+    count > cap and falls back to the exact gather path."""
+    b, P = valid.shape
+    W = changed_packed.shape[1]
+    # unpack the changed mask back to [b, P] bools (cheap on device)
+    widx = jnp.arange(P) // 32
+    bit = (jnp.arange(P) % 32).astype(jnp.uint32)
+    changed = ((changed_packed[:, widx] >> bit) & 1).astype(bool)
+    changed = changed & (jnp.arange(b) < n)[:, None]
+    flat = changed.reshape(-1)
+    pos = jnp.cumsum(flat.astype(jnp.int32)) - 1
+    count = jnp.sum(flat.astype(jnp.int32))
+    idx = jnp.where(flat, pos, cap)  # out-of-range rows drop
+    src_flat = jnp.arange(b * P, dtype=jnp.int32)
+    comp_flat = (
+        jnp.full(cap, -1, jnp.int32).at[idx].set(src_flat, mode="drop")
+    )
+    comp_valid = (
+        jnp.zeros(cap, valid.dtype)
+        .at[idx]
+        .set(valid.reshape(-1), mode="drop")
+    )
+    comp_metric = (
+        jnp.zeros(cap, metric.dtype)
+        .at[idx]
+        .set(metric.reshape(-1), mode="drop")
+    )
+    comp_lanes = (
+        jnp.zeros((cap, lanes_packed.shape[-1]), lanes_packed.dtype)
+        .at[idx]
+        .set(lanes_packed.reshape(b * P, -1), mode="drop")
+    )
+    return count, comp_flat, comp_valid, comp_metric, comp_lanes
+
+
 class SweepRouteSelector:
     """sweep → routes pipeline over one (topology, root, candidates)."""
 
@@ -244,7 +328,11 @@ class SweepRouteSelector:
         root: str,
         cands: SweepCandidates,
         max_degree: int,
+        mesh=None,
     ) -> None:
+        """``mesh``: optional ``jax.sharding.Mesh`` with a ``batch``
+        axis; must match the producing LinkFailureSweep's mesh so the
+        per-chunk selection consumes the sharded SPF tables in place."""
         import jax.numpy as jnp
 
         self.topo = topo
@@ -252,6 +340,7 @@ class SweepRouteSelector:
         self.D = max_degree
         self.Dw = (max_degree + 31) // 32
         self.cands = cands
+        self.mesh = mesh
         self._dev = dict(
             overloaded=jnp.asarray(topo.overloaded),
             soft=jnp.zeros(topo.padded_nodes, jnp.int32),
@@ -264,6 +353,21 @@ class SweepRouteSelector:
             distance=jnp.asarray(cands.distance),
             min_nexthop=jnp.asarray(cands.min_nexthop),
         )
+        #: uncommitted single-device copies for the EAGER base select
+        #: (eager ops cannot mix mesh-replicated and plain arrays)
+        self._dev_eager = self._dev
+        if self.mesh is not None:
+            import jax
+
+            from openr_tpu.parallel.mesh import replicated
+
+            rep = replicated(self.mesh)
+            self._dev = {
+                k: jax.device_put(v, rep) for k, v in self._dev.items()
+            }
+        #: compaction buffer rows per chunk fetch; adapts upward when a
+        #: sweep changes more routes than fit (the re-fetch is exact)
+        self._cap = DELTA_BUCKETS[3]
         self._base = None  # (valid [P], metric [P], lanes [P, D] int8)
         self._base_dev = None
         #: held references to the base arrays the cache was built from
@@ -286,18 +390,18 @@ class SweepRouteSelector:
         ):
             return self._base
         valid, metric, nh_out, _num, _use = _base_select(
-            self._dev["cand_node"],
-            self._dev["cand_ok"],
-            self._dev["drain_metric"],
-            self._dev["path_pref"],
-            self._dev["source_pref"],
-            self._dev["distance"],
-            self._dev["min_nexthop"],
+            self._dev_eager["cand_node"],
+            self._dev_eager["cand_ok"],
+            self._dev_eager["drain_metric"],
+            self._dev_eager["path_pref"],
+            self._dev_eager["source_pref"],
+            self._dev_eager["distance"],
+            self._dev_eager["min_nexthop"],
             jnp.asarray(base_dist),
             jnp.asarray(base_nh),
-            self._dev["overloaded"],
-            self._dev["soft"],
-            self._dev["root"],
+            self._dev_eager["overloaded"],
+            self._dev_eager["soft"],
+            self._dev_eager["root"],
         )
         lanes_packed = _pack_bits_last(nh_out, self.D)
         self._base_dev = (
@@ -305,6 +409,13 @@ class SweepRouteSelector:
             jnp.asarray(metric),
             lanes_packed,
         )
+        if self.mesh is not None:
+            from openr_tpu.parallel.mesh import replicated
+
+            rep = replicated(self.mesh)
+            self._base_dev = tuple(
+                jax.device_put(a, rep) for a in self._base_dev
+            )
         v, m, n = jax.device_get((valid, metric, nh_out))
         self._base = (v, m, n.astype(np.int8))
         self._base_key = (base_dist, base_nh)
@@ -326,8 +437,14 @@ class SweepRouteSelector:
         d_valid: List[np.ndarray] = []
         d_metric: List[np.ndarray] = []
         d_lanes: List[np.ndarray] = []
+        # dispatch phase: queue EVERY chunk's selection + compaction
+        # kernel before the first blocking fetch, so the device pipelines
+        # chunk k+1's SPF + selection behind the host-side delta decode
+        # of chunk k, and each chunk costs ONE blocking round trip (over
+        # a tunneled TPU the round trips, not the bytes, dominate)
+        selected: List[tuple] = []
         for off, n, dist_d, nh_d in sweep_result.chunks or []:
-            changed_packed, valid, metric, lanes_packed = _select_chunk(
+            sel_args = (
                 dist_d,
                 nh_d,
                 self._dev["overloaded"],
@@ -343,45 +460,59 @@ class SweepRouteSelector:
                 bvalid_d,
                 bmetric_d,
                 blanes_d,
-                max_degree=self.D,
             )
-            # fetch 1: bit-packed changed mask (b x P/32 words)
-            mask_words = jax.device_get(changed_packed)
-            fetch_bytes += mask_words.nbytes
-            bits = np.unpackbits(
-                mask_words[:, :, None].view(np.uint8), axis=-1, bitorder="little"
-            ).reshape(mask_words.shape[0], -1)[:, :P]
-            bits[n:, :] = 0  # padding rows never contribute deltas
-            j_idx, p_idx = np.nonzero(bits)
-            if not len(j_idx):
-                continue
-            # fetch 2: gather exactly the changed rows, in slices of the
-            # largest bucket when a chunk changes more rows than one
-            # gather batch holds (failures near the root can touch
-            # hundreds of routes per snapshot)
-            for gs in range(0, len(j_idx), DELTA_BUCKETS[-1]):
-                js = j_idx[gs : gs + DELTA_BUCKETS[-1]]
-                ps = p_idx[gs : gs + DELTA_BUCKETS[-1]]
-                K = bucket_for(len(js), DELTA_BUCKETS)
-                flat = np.zeros(K, np.int64)
-                flat[: len(js)] = js.astype(np.int64) * P + ps
-                gv, gm, gl = jax.device_get(
-                    _gather_deltas(
-                        valid, metric, lanes_packed, jnp.asarray(flat)
+            if self.mesh is not None:
+                out = _sharded_select_chunk(self.mesh, self.D)(*sel_args)
+            else:
+                out = _select_chunk(*sel_args, max_degree=self.D)
+            changed_packed, valid, metric, lanes_packed = out
+            b = valid.shape[0]
+            cap = min(self._cap, b * P)
+            comp = _compact_deltas(
+                changed_packed, valid, metric, lanes_packed,
+                jnp.int32(n), cap=cap,
+            )
+            selected.append((off, n, out, cap, comp))
+        for off, n, out, cap, comp in selected:
+            changed_packed, valid, metric, lanes_packed = out
+            b = valid.shape[0]
+            count, cflat, cvalid, cmetric, clanes = jax.device_get(comp)
+            count = int(count)
+            while count > cap:
+                # rare overflow: re-compact with the next bucket that
+                # fits (the adaptive cap persists for later sweeps).
+                # count can exceed the largest bucket (a chunk holds up
+                # to b*P changeable rows); b*P is always sufficient.
+                if count > DELTA_BUCKETS[-1]:
+                    cap = b * P
+                else:
+                    cap = min(bucket_for(count, DELTA_BUCKETS), b * P)
+                self._cap = max(self._cap, cap)
+                count, cflat, cvalid, cmetric, clanes = jax.device_get(
+                    _compact_deltas(
+                        changed_packed, valid, metric, lanes_packed,
+                        jnp.int32(n), cap=cap,
                     )
                 )
-                fetch_bytes += gv.nbytes + gm.nbytes + gl.nbytes
-                k = len(js)
-                d_rows.append((1 + off + js).astype(np.int32))
-                d_prefix.append(ps.astype(np.int32))
-                d_valid.append(gv[:k])
-                d_metric.append(gm[:k])
-                lanes_bits = np.unpackbits(
-                    gl[:k, :, None].view(np.uint8),
-                    axis=-1,
-                    bitorder="little",
-                ).reshape(k, -1)[:, : self.D]
-                d_lanes.append(lanes_bits.astype(np.int8))
+                count = int(count)
+            fetch_bytes += (
+                cflat.nbytes + cvalid.nbytes + cmetric.nbytes + clanes.nbytes
+            )
+            if count == 0:
+                continue
+            flat = cflat[:count].astype(np.int64)
+            js = (flat // P).astype(np.int64)
+            ps = (flat % P).astype(np.int32)
+            d_rows.append((1 + off + js).astype(np.int32))
+            d_prefix.append(ps)
+            d_valid.append(cvalid[:count])
+            d_metric.append(cmetric[:count])
+            lanes_bits = np.unpackbits(
+                clanes[:count, :, None].view(np.uint8),
+                axis=-1,
+                bitorder="little",
+            ).reshape(count, -1)[:, : self.D]
+            d_lanes.append(lanes_bits.astype(np.int8))
 
         def empty(dt, shape=(0,)):
             return np.zeros(shape, dt)

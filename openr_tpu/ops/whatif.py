@@ -122,18 +122,31 @@ class LinkFailureSweep:
         root: str,
         solve_buckets: Sequence[int] = SOLVE_BUCKETS,
         max_chunk: int = 4096,
+        mesh=None,
     ) -> None:
+        """``mesh``: optional ``jax.sharding.Mesh`` with a ``batch``
+        axis; unique solves then shard across the mesh (bit-identical to
+        single-device — see ops/repair.py), and bucket sizes round up to
+        multiples of 32 * mesh size so every device shard keeps whole
+        bit-packed lane words."""
         import jax.numpy as jnp
 
         self.topo = topo
         self.root = root
         self.root_id = topo.node_id(root)
+        self.mesh = mesh
+        gran = 32 * (mesh.devices.size if mesh is not None else 1)
         if any(b % 32 for b in solve_buckets):
             raise ValueError(
                 "solve_buckets must be multiples of 32 (lane words are "
                 f"batch-bit-packed): {solve_buckets}"
             )
+        if gran > 32:
+            solve_buckets = sorted(
+                {((b + gran - 1) // gran) * gran for b in solve_buckets}
+            )
         self.solve_buckets = tuple(solve_buckets)
+        self.batch_granularity = gran
         self.max_chunk = max_chunk
         #: lane count: the root's out-degree (lane r == r-th directed
         #: out-edge of the root in edge order)
@@ -218,6 +231,7 @@ class LinkFailureSweep:
                     self._w,
                     self._link_index,
                 ),
+                mesh=self.mesh,
             )
         return self._repair
 

@@ -267,15 +267,34 @@ def prefix_is_v4(prefix: str) -> bool:
     return ":" not in prefix
 
 
-@functools.lru_cache(maxsize=None)
+#: generation-swapped memo for normalize_prefix: two dicts, the active
+#: one swapped out when it exceeds the cap.  The stable prefix table
+#: stays hot (every pass re-sees it, re-inserting into the fresh dict
+#: before the next swap) while churn of distinct prefixes — including a
+#: buggy/hostile peer flooding unique prefixes forever (ADVICE r3) — can
+#: retain at most 2 * _NORM_CACHE_MAX entries instead of growing
+#: monotonically the way an unbounded lru_cache did.  An LRU bound would
+#: instead flood to ~0% hits: each pass re-visits the whole table in
+#: roughly the same order.
+_NORM_CACHE_MAX = 1_000_000
+_norm_active: dict = {}
+_norm_stale: dict = {}
+
+
 def normalize_prefix(prefix: str) -> str:
-    """Canonicalize an IP prefix string (host bits zeroed).  Memoized
-    UNBOUNDED: every pass (publication build, LSDB ingest, candidate
-    encode) re-sees the whole prefix table in roughly the same order, so
-    any bound below the table size would LRU-flood to a ~0% hit rate;
-    the retained strings are bounded by the deployment's prefix count
-    (~40 MB at the 400k-prefix benchmark scale)."""
-    return str(ipaddress.ip_network(prefix, strict=False))
+    """Canonicalize an IP prefix string (host bits zeroed)."""
+    global _norm_active, _norm_stale
+    v = _norm_active.get(prefix)
+    if v is not None:
+        return v
+    v = _norm_stale.get(prefix)
+    if v is None:
+        v = str(ipaddress.ip_network(prefix, strict=False))
+    if len(_norm_active) >= _NORM_CACHE_MAX:
+        _norm_stale = _norm_active
+        _norm_active = {}
+    _norm_active[prefix] = v
+    return v
 
 
 # ---------------------------------------------------------------------------

@@ -114,3 +114,56 @@ def test_native_sweep_checksum_and_last_solve():
     dist_last = eng.dist.copy()
     eng.solve(failed_link=3)
     assert np.array_equal(dist_last, eng.dist)
+
+
+class TestWarmStart:
+    """spf_warm_sweep must equal the cold solver for EVERY failed link —
+    the warm start is an optimization, not an approximation (the same
+    bar ops/repair.py holds on device)."""
+
+    @pytest.mark.parametrize(
+        "edges_fn",
+        [
+            lambda: grid_edges(6),
+            lambda: random_connected_edges(80, 160, seed=11),
+        ],
+    )
+    def test_warm_equals_cold_for_every_link(self, edges_fn):
+        ls = make_ls(edges_fn())
+        topo = encode_link_state(ls)
+        root = sorted(topo.node_ids)[0]
+        warm = NativeSpf(topo, root)
+        warm.warm_prepare()
+        cold = NativeSpf(topo, root)
+        for li in list(range(len(topo.links))) + [-1]:
+            warm.warm_sweep(np.asarray([li], np.int32), keep_last=True)
+            wd, wn = warm.dist.copy(), warm.nh_mask.copy()
+            cd, cn = cold.solve(failed_link=li)
+            assert np.array_equal(wd, cd), li
+            assert np.array_equal(wn, cn), li
+
+    def test_warm_sweep_checksum_matches_cold(self):
+        ls = make_ls(random_connected_edges(120, 260, seed=3))
+        topo = encode_link_state(ls)
+        root = sorted(topo.node_ids)[0]
+        rng = np.random.default_rng(0)
+        fails = rng.integers(
+            0, len(topo.links), size=500
+        ).astype(np.int32)
+        warm = NativeSpf(topo, root)
+        c_warm = warm.warm_sweep(fails)
+        cold = NativeSpf(topo, root)
+        c_cold = cold.sweep(fails)
+        assert c_warm == c_cold
+
+    def test_warm_with_overloaded_node(self):
+        ls = make_ls(grid_edges(5), overloaded=["node12"])
+        topo = encode_link_state(ls)
+        warm = NativeSpf(topo, "node0")
+        warm.warm_prepare()
+        cold = NativeSpf(topo, "node0")
+        for li in range(len(topo.links)):
+            warm.warm_sweep(np.asarray([li], np.int32), keep_last=True)
+            cd, cn = cold.solve(failed_link=li)
+            assert np.array_equal(warm.dist, cd), li
+            assert np.array_equal(warm.nh_mask, cn), li

@@ -8,7 +8,11 @@ igp-tie ECMP lane union), with deltas fetched only for changed rows."""
 import numpy as np
 
 from openr_tpu.decision.link_state import LinkState
-from openr_tpu.emulation.topology import build_adj_dbs, random_connected_edges
+from openr_tpu.emulation.topology import (
+    build_adj_dbs,
+    grid_edges,
+    random_connected_edges,
+)
 from openr_tpu.ops.csr import encode_link_state
 from openr_tpu.ops.sweep_select import (
     SweepCandidates,
@@ -119,3 +123,64 @@ def test_sweep_route_deltas_sparse():
         assert deltas.snap_row[s] == 0
         v, m, ln = deltas.routes_of(int(s))
         assert np.array_equal(v, deltas.base_valid)
+
+
+def test_base_select_eager_workaround_regression():
+    """Pin the jax-0.9.0 executable-cache corruption dodge (VERDICT r3
+    weak #6): `_base_select` must run EAGER.  Minimal repro of the
+    trigger: compile the fleet kernels FIRST, then build two selectors'
+    base tables back to back — under a jitted wrapper the second build
+    intermittently drew a corrupted cache entry ('Execution supplied 12
+    buffers but compiled program expected 15').  This test (a) asserts
+    the workaround is still in place (no jit cache on _base_select) and
+    (b) drives the exact trigger sequence, asserting correct output
+    either way, so removing the workaround while the bug persists fails
+    here rather than in production sweeps.
+    """
+    import jax
+
+    from openr_tpu.decision.fleet import FleetRibEngine
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.ops import sweep_select as ss
+    from openr_tpu.types import PrefixEntry
+
+    # (a) the workaround: _base_select must not be a jit wrapper
+    assert not hasattr(ss._base_select, "lower"), (
+        "_base_select is jitted again — only safe once the jax 0.9 "
+        "executable-cache corruption (see its docstring) is fixed; "
+        "re-verify with this test's trigger sequence before removing"
+    )
+
+    # (b) the trigger sequence: fleet kernels compile first...
+    ls = LinkState("0")
+    for db in build_adj_dbs(grid_edges(4)).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(16):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+    als = {"0": ls}
+    fleet = FleetRibEngine(SpfSolver("node0"))
+    assert fleet.compute_for_node("node1", als, ps, change_seq=1) is not None
+
+    # ...then two selector base-table builds back to back
+    topo = encode_link_state(ls)
+    for root in ("node0", "node1"):
+        eng = LinkFailureSweep(topo, root)
+        sel = SweepRouteSelector(
+            topo,
+            root,
+            SweepCandidates.single_advertiser(np.arange(16)),
+            max_degree=eng.D,
+        )
+        base_dist, base_nh = eng.base_solve()
+        valid, metric, lanes = sel.base_routes(base_dist, base_nh)
+        # correct output either way: metric == base distance for every
+        # valid single-advertiser prefix, self-prefix invalid
+        rid = topo.node_id(root)
+        for p in range(16):
+            if p == rid:
+                assert not valid[p]
+                continue
+            assert valid[p], (root, p)
+            assert metric[p] == base_dist[p], (root, p)

@@ -147,15 +147,21 @@ def test_context_builders(tmp_path):
     assert server_ssl_context(TlsConfig()) is None  # disabled = plaintext
     assert server_ssl_context(tls_cfg(pki)) is not None
     assert client_ssl_context(tls_cfg(pki)) is not None
-    # fallback: enabled, certs missing, non-strict → plaintext
+    # DEFAULT is fail-closed: enabled + unusable certs must refuse to
+    # start rather than silently downgrade the mutation/LSDB plane to
+    # plaintext (ADVICE r3; the reference's wangle/fizz behavior)
     missing = TlsConfig(enabled=True, cert_path="/nope", key_path="/nope")
-    assert server_ssl_context(missing) is None
     with pytest.raises(FileNotFoundError):
-        server_ssl_context(
-            TlsConfig(
-                enabled=True, cert_path="/nope", key_path="/nope", strict=True
-            )
+        server_ssl_context(missing)
+    with pytest.raises(FileNotFoundError):
+        client_ssl_context(
+            TlsConfig(enabled=True, ca_path="/nope", strict=True)
         )
+    # lab bringup: explicit strict=False opt-in falls back to plaintext
+    lab = TlsConfig(
+        enabled=True, cert_path="/nope", key_path="/nope", strict=False
+    )
+    assert server_ssl_context(lab) is None
 
 
 def test_ctrl_rpc_mutual_tls(tmp_path):
