@@ -103,6 +103,14 @@ def init_events(ctx: click.Context) -> None:
         click.echo(InitializationEvent(e).name)
 
 
+@openr.command("init-duration")
+@click.pass_context
+def init_duration(ctx: click.Context) -> None:
+    """Milliseconds from start to INITIALIZED (errors while still
+    initializing)."""
+    click.echo(_call(ctx, "get_initialization_duration_ms"))
+
+
 # ------------------------------------------------------------------ config
 
 
@@ -115,6 +123,23 @@ def config() -> None:
 @click.pass_context
 def config_show(ctx: click.Context) -> None:
     click.echo(_call(ctx, "get_running_config"))
+
+
+@config.command("show-typed")
+@click.pass_context
+def config_show_typed(ctx: click.Context) -> None:
+    """Structured (typed-dict) running config — the
+    getRunningConfigThrift form."""
+    _print(_call(ctx, "get_running_config_thrift"))
+
+
+@config.command("dryrun")
+@click.argument("file")
+@click.pass_context
+def config_dryrun(ctx: click.Context, file: str) -> None:
+    """Load + validate FILE without applying it; prints the normalized
+    loaded content (errors raise)."""
+    click.echo(_call(ctx, "dryrun_config", file=file))
 
 
 # ----------------------------------------------------------------- monitor
@@ -253,6 +278,32 @@ def decision_routes(ctx: click.Context, node: Optional[str]) -> None:
         _print(_call(ctx, "get_route_db_computed", node=node))
     else:
         _print(_call(ctx, "get_route_db"))
+
+
+@decision.command("path")
+@click.option("--src", default="", help="source node (default: this node)")
+@click.option(
+    "--dst", default="", help="destination node or prefix (default: this node)"
+)
+@click.option("--max-hop", default=256, help="max hop count")
+@click.pass_context
+def decision_path(
+    ctx: click.Context, src: str, dst: str, max_hop: int
+) -> None:
+    """Enumerate src->dst forwarding paths over computed RouteDbs."""
+    res = _call(ctx, "get_decision_paths", src=src, dst=dst, max_hop=max_hop)
+    if res.get("error"):
+        raise click.ClickException(res["error"])
+    metric = (
+        "no route" if res["metric"] is None else f"metric {res['metric']:g}"
+    )
+    click.echo(
+        f"{res['src']} -> {res['dst']} ({res['dst_prefix']}), "
+        f"{metric}, {len(res['paths'])} path(s)"
+        + (" [truncated]" if res.get("truncated") else "")
+    )
+    for p in res["paths"]:
+        click.echo(f"  [{p['num_hops']} hops] " + " -> ".join(p["hops"]))
 
 
 @decision.command("adj")

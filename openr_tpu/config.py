@@ -173,10 +173,11 @@ class TpuComputeConfig:
         default_factory=lambda: [16, 64, 256, 1024, 4096, 16384]
     )
     edge_bucket_multiplier: int = 8  # max_edges = multiplier * max_nodes
-    #: below this many prefixes Decision computes scalar (each device
-    #: build pays one host↔device round trip tiny problems can't
-    #: amortize); 0 = always device
-    min_device_prefixes: int = 0
+    #: device-vs-scalar cutover.  None (default) = auto-calibrate from
+    #: a measured dispatch round trip at first build, so small
+    #: deployments choose the scalar path without tuning; 0 = always
+    #: device; N = scalar below N prefixes
+    min_device_prefixes: Optional[int] = None
     #: nexthop bitmask words (32 neighbors per word)
     nexthop_words: int = 2
     #: device mesh axis name for sharding what-if batches

@@ -96,8 +96,33 @@ class OpenrCtrlHandler:
     def initialization_converged(self) -> bool:
         return self.node.initialized
 
+    def get_initialization_duration_ms(self) -> int:
+        """Milliseconds from process start to INITIALIZED; raises while
+        initialization is still in progress (OpenrCtrl.thrift:302)."""
+        ms = self.node.init_tracker.initialization_duration_ms()
+        if ms is None:
+            raise ValueError("initialization not converged yet")
+        return int(ms)
+
     def get_running_config(self) -> str:
         return self.node.config.to_json()
+
+    def get_running_config_thrift(self) -> dict:
+        """Typed (structured) form of the running config — the
+        getRunningConfigThrift counterpart (OpenrCtrl.thrift:264); the
+        JSON-string form above mirrors getRunningConfig."""
+        import json as _json
+
+        return _json.loads(self.node.config.to_json())
+
+    def dryrun_config(self, file: str) -> str:
+        """Load + validate a config file WITHOUT applying it; returns
+        the normalized loaded content so the operator can diff it
+        against the file (extra/unknown fields are dropped by the
+        loader), raises on validation errors (OpenrCtrl.thrift:274)."""
+        from openr_tpu.config import OpenrConfig
+
+        return OpenrConfig.load(file).to_json()
 
     # ------------------------------------------------- drain / maintenance
     # (OpenrCtrl.thrift:333-420; LinkMonitor.h:107-150)
@@ -309,6 +334,17 @@ class OpenrCtrlHandler:
             self.node.decision.get_route_db()
             .to_route_database(self.node.name)
             .to_wire()
+        )
+
+    def get_decision_paths(
+        self, src: str = "", dst: str = "", max_hop: int = 256
+    ) -> dict:
+        """src→dst forwarding-path enumeration over computed RouteDbs
+        (the reference breeze `decision path`,
+        py/openr/cli/clis/decision.py:50); defaults resolve to this
+        node."""
+        return self.node.decision.get_decision_paths(
+            src or self.node.name, dst or self.node.name, max_hop
         )
 
     def get_route_db_computed(self, node: str) -> dict:

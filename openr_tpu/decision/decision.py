@@ -105,6 +105,7 @@ class Decision(Actor):
         self._change_seq = 0
         self._fleet_engine = None
         self._whatif_engine = None
+        self._whatif_multi_engine = None
         self._debounce = AsyncDebounce(
             self,
             config.debounce_min_ms / 1000.0,
@@ -414,21 +415,33 @@ class Decision(Actor):
         backend / multi-area / KSP2)."""
         if isinstance(self.backend, ScalarBackend):
             return None
-        # the sweep engine's repair plan is single-area, single-vantage
-        # machinery (unlike the fleet tables, which are multi-area)
-        if len(self.area_link_states) != 1:
-            return None
         fleet = self._fleet()
         if not fleet.eligible(
             self.area_link_states, self.prefix_state, self._change_seq
         ):
             return None
-        if self._whatif_engine is None:
-            from openr_tpu.decision.whatif_api import WhatIfApiEngine
+        if len(self.area_link_states) == 1:
+            # single-area vantage: warm-start repair sweep (the fastest
+            # engine)
+            if self._whatif_engine is None:
+                from openr_tpu.decision.whatif_api import WhatIfApiEngine
 
-            self._whatif_engine = WhatIfApiEngine(self.solver)
+                self._whatif_engine = WhatIfApiEngine(self.solver)
+            engine = self._whatif_engine
+        else:
+            # multi-area LSDB: fleet-family kernel (per-snapshot masked
+            # area re-solve + global selection + cross-area merge)
+            if self._whatif_multi_engine is None:
+                from openr_tpu.decision.whatif_api import (
+                    MultiAreaWhatIfEngine,
+                )
+
+                self._whatif_multi_engine = MultiAreaWhatIfEngine(
+                    self.solver
+                )
+            engine = self._whatif_multi_engine
         try:
-            return self._whatif_engine.run(
+            return engine.run(
                 [tuple(f) for f in link_failures],
                 self.area_link_states,
                 self.prefix_state,
@@ -438,6 +451,95 @@ class Decision(Actor):
             # e.g. an anycast prefix wider than the largest candidate
             # bucket — ineligible, not an RPC error
             return None
+
+    def get_decision_paths(
+        self, src: str, dst: str, max_hop: int = 256
+    ) -> dict:
+        """Enumerate loop-free src→dst forwarding paths by walking each
+        hop's COMPUTED RouteDb (the reference's `breeze decision path`
+        DFS over getRouteDbComputed, decision.py:309-360 of its CLI) —
+        here each hop's routes decode from the fleet engine's one batch
+        solve instead of a scalar Dijkstra per hop.
+
+        ``dst`` is a prefix or a node name (resolved to that node's
+        first advertised prefix, the loopback convention)."""
+        prefixes = self.prefix_state.prefixes()
+        if dst in prefixes:
+            dst_prefix = dst
+        else:
+            advertised = sorted(
+                p
+                for p, entries in prefixes.items()
+                if any(node == dst for (node, _a) in entries)
+            )
+            if not advertised:
+                return {
+                    "src": src,
+                    "dst": dst,
+                    "error": f"{dst!r} is neither a known prefix nor an "
+                    "advertising node",
+                    "paths": [],
+                }
+            dst_prefix = advertised[0]
+        advertisers = {node for (node, _a) in prefixes[dst_prefix]}
+
+        route_cache: Dict[str, object] = {}
+
+        def route_entry(node):
+            if node not in route_cache:
+                db = self.compute_route_db_for_node(node)
+                route_cache[node] = (
+                    None
+                    if db is None
+                    else db.unicast_routes.get(dst_prefix)
+                )
+            return route_cache[node]
+
+        paths: List[dict] = []
+        truncated = [False]
+
+        def dfs(cur, path, visited):
+            if len(paths) >= 1024:
+                truncated[0] = True
+                return
+            if cur in advertisers:
+                paths.append(list(path))
+                return
+            if len(path) - 1 >= max_hop:
+                truncated[0] = True
+                return
+            entry = route_entry(cur)
+            if entry is None:
+                return  # dead end: cur computes no route for dst
+            for nh in sorted(
+                {n.neighbor_node_name for n in entry.nexthops}
+            ):
+                if nh in visited:
+                    continue
+                visited.add(nh)
+                path.append(nh)
+                dfs(nh, path, visited)
+                path.pop()
+                visited.discard(nh)
+
+        src_entry = route_entry(src) if src not in advertisers else None
+        dfs(src, [src], {src})
+        # metric: the src's computed route cost; 0 when src itself
+        # advertises dst; None (not a fake zero) when src has no route
+        if src in advertisers:
+            metric = 0.0
+        elif src_entry is not None:
+            metric = float(src_entry.igp_cost)
+        else:
+            metric = None
+        return {
+            "src": src,
+            "dst": dst,
+            "dst_prefix": dst_prefix,
+            "metric": metric,
+            "truncated": truncated[0],
+            "paths": [{"hops": p, "num_hops": len(p) - 1} for p in paths],
+        }
 
     def get_fleet_rib_summary(self) -> Optional[Dict[str, dict]]:
         """Per-node route counts for EVERY vantage point from one batched

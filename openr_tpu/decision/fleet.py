@@ -287,7 +287,7 @@ class FleetRibEngine:
         at_min = valid & (m == m_star[:, :, None])
         num_nh_area = lanes.sum(axis=3)  # [B, P, A]
         merged = (num_nh_area * at_min).sum(axis=2)  # [B, P]
-        # per-root gates, matching _decode_route exactly:
+        # per-root gates, matching the backend decode exactly:
         #   min-nexthop req = max over THIS root's selection winners
         #   (not all candidates — a losing advertiser's requirement must
         #   not gate the winner's route)

@@ -67,15 +67,26 @@ class InitializationTracker:
     ]
 
     def __init__(self) -> None:
+        import time as _time
+
+        self._t0 = _time.monotonic()
         self.events: List[InitializationEvent] = [
             InitializationEvent.INITIALIZING
         ]
+        #: event -> milliseconds since start (getInitializationEvents
+        #: returns this mapping in the reference, OpenrCtrl.thrift:295)
+        self.event_ms: Dict[InitializationEvent, float] = {
+            InitializationEvent.INITIALIZING: 0.0
+        }
         self._listeners: List = []
 
     def on_event(self, ev: InitializationEvent) -> None:
+        import time as _time
+
         if ev in self.events:
             return
         self.events.append(ev)
+        self.event_ms[ev] = (_time.monotonic() - self._t0) * 1000.0
         for listener in self._listeners:
             listener(ev)
         if ev != InitializationEvent.INITIALIZED and all(
@@ -85,6 +96,11 @@ class InitializationTracker:
 
     def add_listener(self, fn) -> None:
         self._listeners.append(fn)
+
+    def initialization_duration_ms(self) -> Optional[float]:
+        """Start→INITIALIZED duration; None while still initializing
+        (getInitializationDurationMs, OpenrCtrl.thrift:302)."""
+        return self.event_ms.get(InitializationEvent.INITIALIZED)
 
     @property
     def initialized(self) -> bool:

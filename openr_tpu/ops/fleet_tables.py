@@ -83,6 +83,82 @@ def fleet_multi_area_tables(
     return jax.vmap(one)(roots)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("max_degree", "per_area_distance")
+)
+def whatif_multi_area_tables(
+    src,  # [A, E]
+    dst,  # [A, E]
+    w,  # [A, E]
+    edge_ok,  # [A, E]
+    link_index,  # [A, E] per-area undirected link ids (-1 pad)
+    overloaded,  # [A, V]
+    soft,  # [A, V]
+    roots,  # [A] my id per area (me is interned into every area)
+    fail_area,  # [B] int32 area index of the failed link (-1 = none)
+    fail_link,  # [B] int32 link id within that area
+    cand_area,  # [P, C]
+    cand_node,  # [P, C]
+    cand_ok,  # [P, C]
+    drain_metric,  # [P, C]
+    path_pref,  # [P, C]
+    source_pref,  # [P, C]
+    distance,  # [P, C]
+    cand_node_in_area,  # [P, C, A]
+    max_degree: int,
+    per_area_distance: bool,
+):
+    """Multi-area link-failure what-if from ONE vantage (me): the batch
+    axis is candidate failures instead of fleet roots — per snapshot the
+    failed link's area is re-solved with that link masked, every other
+    area solves unperturbed, and the GLOBAL selection chain runs
+    per snapshot.  This is the multi-area generalization the operator
+    what-if API needs (the reference computes any-algorithm/any-area
+    what-ifs scalar via getDecisionRouteDb, Decision.cpp:342).
+
+    Returns per-snapshot (use [B,P,C], shortest [B,P,A], lanes
+    [B,P,A,D], valid [B,P,A])."""
+    from openr_tpu.ops.route_select import (
+        multi_area_select_from_tables,
+        multi_area_spf_tables,
+    )
+
+    A = src.shape[0]
+
+    def one(fa, fl):
+        masked = (
+            (jnp.arange(A, dtype=jnp.int32)[:, None] == fa)
+            & (link_index == fl)
+            & (fl >= 0)
+        )
+        dist, nh = multi_area_spf_tables(
+            src,
+            dst,
+            w,
+            edge_ok & ~masked,
+            overloaded,
+            roots,
+            max_degree=max_degree,
+        )
+        return multi_area_select_from_tables(
+            dist,
+            nh,
+            overloaded,
+            soft,
+            cand_area,
+            cand_node,
+            cand_ok,
+            drain_metric,
+            path_pref,
+            source_pref,
+            distance,
+            cand_node_in_area,
+            per_area_distance=per_area_distance,
+        )
+
+    return jax.vmap(one)(fail_area, fail_link)
+
+
 _sharded_cache: dict = {}
 
 

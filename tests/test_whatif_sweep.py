@@ -170,3 +170,78 @@ def test_sweep_with_overloaded_nodes():
         dist = res.dist_of(s)
         for node, r in ref.items():
             assert dist[topo.node_id(node)] == np.float32(r.metric)
+
+
+class TestWarmBaseAcrossGenerations:
+    """Cross-generation warm base solve (ops.repair.warm_base_from_
+    previous): after LSDB churn the new engine's base must be BIT-EXACT
+    vs a cold solve — removals, weight increases/decreases, and link
+    additions all covered."""
+
+    def _engines(self, edges_old, edges_new):
+        ls_old, topo_old = make_topo(edges_old)
+        ls_new, topo_new = make_topo(edges_new)
+        old = LinkFailureSweep(topo_old, "node0")
+        old.base_solve()
+        warm = LinkFailureSweep(topo_new, "node0")
+        assert warm.seed_base_from(old), "seed should apply"
+        cold = LinkFailureSweep(topo_new, "node0")
+        return warm, cold
+
+    def _check(self, edges_old, edges_new):
+        warm, cold = self._engines(edges_old, edges_new)
+        wd, wn = warm.base_solve()
+        assert warm.base_was_warm
+        cd, cn = cold.base_solve()
+        assert np.array_equal(wd, cd)
+        assert np.array_equal(wn, cn)
+
+    def test_link_removal(self):
+        edges = grid_edges(6)
+        # drop two interior links (every node keeps at least one link,
+        # so the symbol tables stay identical across generations)
+        self._check(edges, edges[:20] + edges[22:])
+
+    def test_weight_increase_and_decrease(self):
+        base = [(a, b, 10) for (a, b, _w) in grid_edges(6)]
+        bumped = [
+            (a, b, 40 if i == 3 else (1 if i == 5 else w))
+            for i, (a, b, w) in enumerate(base)
+        ]
+        self._check(base, bumped)
+
+    def test_link_addition(self):
+        edges = grid_edges(6)
+        extra = edges + [("node0", "node35", 3)]
+        self._check(edges, extra)
+
+    def test_mixed_churn_sweep_still_exact(self):
+        """After a warm-seeded base, the repair sweep on the NEW
+        topology must still match the python oracle."""
+        edges = grid_edges(5)
+        churned = edges[:10] + edges[11:]
+        warm, _ = self._engines(edges, churned)
+        ls_new, topo_new = make_topo(churned)
+        L = len(topo_new.links)
+        fails = np.arange(L, dtype=np.int32)
+        res = warm.run(fails, fetch=True)
+        for li in range(0, L, 5):
+            ref = ls_new.run_spf(
+                "node0", links_to_ignore=frozenset([topo_new.links[li]])
+            )
+            d = res.dist_of(li)
+            for node, r in ref.items():
+                assert d[topo_new.node_id(node)] == r.metric, (li, node)
+
+    def test_node_set_change_falls_back_cold(self):
+        ls_old, topo_old = make_topo(grid_edges(6))
+        ls_new, topo_new = make_topo(grid_edges(5))
+        old = LinkFailureSweep(topo_old, "node0")
+        old.base_solve()
+        warm = LinkFailureSweep(topo_new, "node0")
+        assert not warm.seed_base_from(old)
+        d, _ = warm.base_solve()
+        assert not warm.base_was_warm
+        ref = ls_new.run_spf("node0")
+        for node, r in ref.items():
+            assert d[topo_new.node_id(node)] == r.metric
