@@ -177,17 +177,148 @@ def kvstore() -> None:
 
 @kvstore.command("keys")
 @click.option("--area", default=Const.DEFAULT_AREA)
-@click.option("--prefix", default="")
+@click.option("--prefix", default="", help="key-prefix filter")
+@click.option("--originator", default=None, help="originator filter")
+@click.option("--json/--no-json", "as_json", default=False,
+              help="dump as JSON instead of a table")
+@click.option("--ttl/--no-ttl", "show_ttl", default=True,
+              help="include the TTL column")
 @click.pass_context
-def kvstore_keys(ctx: click.Context, area: str, prefix: str) -> None:
+def kvstore_keys(
+    ctx: click.Context,
+    area: str,
+    prefix: str,
+    originator: Optional[str],
+    as_json: bool,
+    show_ttl: bool,
+) -> None:
     dump = _call(ctx, "dump_kv_store_area", prefix=prefix, area=area)
+    if originator:
+        dump = {
+            k: v
+            for k, v in dump.items()
+            if v.get("originator_id") == originator
+        }
+    if as_json:
+        _print(dump)
+        return
     rows = [
         (k, v.get("originator_id", ""), v.get("version", 0), v.get("ttl", 0))
         for k, v in sorted(dump.items())
     ]
-    click.echo(f"{'Key':40} {'Originator':12} {'Version':8} TTL")
+    header = f"{'Key':40} {'Originator':12} {'Version':8}"
+    click.echo(header + (" TTL" if show_ttl else ""))
     for k, orig, ver, ttl in rows:
-        click.echo(f"{k:40} {orig:12} {ver:<8} {ttl}")
+        line = f"{k:40} {orig:12} {ver:<8}"
+        click.echo(line + (f" {ttl}" if show_ttl else ""))
+
+
+@kvstore.command("areas")
+@click.pass_context
+def kvstore_areas(ctx: click.Context) -> None:
+    """Configured KvStore areas."""
+    for a in _call(ctx, "get_kv_store_areas"):
+        click.echo(a)
+
+
+@kvstore.command("kv-signature")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.pass_context
+def kvstore_signature(ctx: click.Context, area: str) -> None:
+    """Content digest of the area's store — equal digests mean two
+    replicas converged to identical content."""
+    click.echo(_call(ctx, "get_kv_store_signature", area=area))
+
+
+@kvstore.command("erase-key")
+@click.argument("key")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option("--ttl-ms", default=300, help="tombstone TTL")
+@click.pass_context
+def kvstore_erase_key(
+    ctx: click.Context, key: str, area: str, ttl_ms: int
+) -> None:
+    """Erase KEY network-wide (supersede with an empty short-TTL value)."""
+    _call(ctx, "erase_kv_store_key", key=key, area=area, ttl_ms=ttl_ms)
+    click.echo(f"erased {key}")
+
+
+@kvstore.command("kv-compare")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option("--peer", required=True, help="host:port of the peer ctrl")
+@click.pass_context
+def kvstore_compare(ctx: click.Context, area: str, peer: str) -> None:
+    """Diff this store against another node's (version/originator/hash
+    per key) — the reference's breeze kv-compare."""
+    import hashlib
+
+    here = _call(ctx, "dump_kv_store_area", prefix="", area=area)
+    host, _, port = peer.rpartition(":")
+    host = host.strip("[]")  # tolerate [v6]:port literals
+    if not port.isdigit():
+        raise click.BadParameter(
+            f"--peer must be host:port, got {peer!r}", param_hint="--peer"
+        )
+
+    async def fetch_peer():
+        async with OpenrCtrlClient(
+            host=host or "127.0.0.1", port=int(port), tls=ctx.obj.get("tls")
+        ) as client:
+            return await client.call(
+                "dump_kv_store_area", prefix="", area=area
+            )
+
+    there = asyncio.run(fetch_peer())
+
+    def sig(v):
+        return (
+            v.get("version"),
+            v.get("originator_id"),
+            hashlib.sha256(
+                (v.get("value") or "").encode()
+                if isinstance(v.get("value"), str)
+                else bytes(v.get("value") or b"")
+            ).hexdigest()[:12],
+        )
+
+    same = True
+    for k in sorted(set(here) | set(there)):
+        a, b = here.get(k), there.get(k)
+        if a is None:
+            click.echo(f"only peer : {k}")
+        elif b is None:
+            click.echo(f"only local: {k}")
+        elif sig(a) != sig(b):
+            click.echo(f"differs   : {k} local={sig(a)} peer={sig(b)}")
+        else:
+            continue
+        same = False
+    click.echo("stores match" if same else "stores differ")
+
+
+@kvstore.command("validate")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.pass_context
+def kvstore_validate(ctx: click.Context, area: str) -> None:
+    """Local consistency checks over the store (key shapes, originator
+    sanity, TTL bounds) — the reference's breeze kvstore validate."""
+    dump = _call(ctx, "dump_kv_store_area", prefix="", area=area)
+    problems = []
+    for k, v in sorted(dump.items()):
+        if not (k.startswith("adj:") or k.startswith("prefix:")):
+            problems.append(f"{k}: unrecognized key namespace")
+        if not v.get("originator_id"):
+            problems.append(f"{k}: missing originator")
+        if v.get("version", 0) <= 0:
+            problems.append(f"{k}: non-positive version")
+        ttl = v.get("ttl", 0)
+        if ttl != Const.TTL_INFINITY and ttl <= 0:
+            problems.append(f"{k}: expired/invalid ttl {ttl}")
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo(f"{len(dump)} keys validated OK")
 
 
 @kvstore.command("key-vals")

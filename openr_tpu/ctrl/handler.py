@@ -538,6 +538,49 @@ class OpenrCtrlHandler:
         vals = self.node.kv_store.dump_all(area, prefix)
         return {k: v.to_wire() for k, v in vals.items()}
 
+    def get_kv_store_areas(self) -> List[str]:
+        """Configured KvStore area ids (the reference's getAreasConfig /
+        breeze kvstore areas)."""
+        return sorted(self.node.kv_store.areas.keys())
+
+    def get_kv_store_signature(self, area: str = C.DEFAULT_AREA) -> str:
+        """Digest over the area's (key, version, originator, value-hash)
+        tuples — equal signatures mean two stores hold identical content
+        (the reference's kvSignature used by breeze kv-signature)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for k, v in sorted(self.node.kv_store.dump_all(area).items()):
+            h.update(k.encode())
+            h.update(str(v.version).encode())
+            h.update(v.originator_id.encode())
+            h.update(hashlib.sha256(v.value or b"").digest())
+        return h.hexdigest()
+
+    def erase_kv_store_key(
+        self, key: str, area: str = C.DEFAULT_AREA, ttl_ms: int = 300
+    ) -> None:
+        """Network-wide key erase: advertise the key at version+1 with
+        an empty value and a short TTL, so every replica adopts the
+        tombstone and then expires it (the reference's breeze erase-key
+        shape — eventual-consistency stores delete by superseding).
+        Raises for unknown keys."""
+        vals = self.node.kv_store.get_key_vals(area, [key])
+        if key not in vals:
+            raise KeyError(f"no key {key!r} in area {area!r}")
+        cur = vals[key]
+        self.node.kv_store.set_key_vals(
+            area,
+            {
+                key: Value(
+                    version=cur.version + 1,
+                    originator_id=self.node.name,
+                    value=b"",
+                    ttl=ttl_ms,
+                )
+            },
+        )
+
     def get_kv_store_key_vals(self, keys: List[str]) -> Dict[str, dict]:
         return self.get_kv_store_key_vals_area(keys)
 

@@ -93,10 +93,13 @@ class RepairPlan:
 
 
 def build_repair_plan(topo, root_id: int, base_dist: np.ndarray,
-                      base_nh: np.ndarray) -> RepairPlan:
+                      base_nh: np.ndarray,
+                      pull_tables=None) -> RepairPlan:
     """Host-side planner.  ``base_nh`` is dense [V, >=lanes] int8 from the
     base solve; extra all-zero columns beyond the root's out-degree are
-    dropped."""
+    dropped.  ``pull_tables``: optional precomputed
+    ``build_pull_tables`` result to reuse (they are base-independent,
+    so a warm base solve's tables carry over)."""
     V = topo.padded_nodes
     E = topo.padded_edges
     src, dst, w = topo.src, topo.dst, topo.w
@@ -156,7 +159,11 @@ def build_repair_plan(topo, root_id: int, base_dist: np.ndarray,
         base_l = min(int(level[h]) for h in hs)
         depth[li] = max(1, top - base_l + 2)
 
-    lanes, pt = build_pull_tables(topo, root_id)
+    lanes, pt = (
+        pull_tables
+        if pull_tables is not None
+        else build_pull_tables(topo, root_id)
+    )
     return RepairPlan(
         root_id=root_id,
         lanes=lanes,

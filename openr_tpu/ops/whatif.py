@@ -173,6 +173,7 @@ class LinkFailureSweep:
         self._repair = None  # lazy RepairSweep
         self._plan = None
         self._base_seed = None  # cross-generation warm init
+        self._pull_tables = None  # (lanes, tables) reused by plan()
         self.base_was_warm = False
 
     # -- base solve + repair plan ------------------------------------------
@@ -223,7 +224,9 @@ class LinkFailureSweep:
         transit = (~self.topo.overloaded) | (
             np.arange(V) == self.root_id
         )
+        # pull tables are base-independent: build once, reuse in plan()
         lanes, pt = build_pull_tables(self.topo, self.root_id)
+        self._pull_tables = (lanes, pt)
         if nh0 is None or nh0.shape[1] != lanes:
             nh0 = np.zeros((V, lanes), np.int8)
         plan = RepairPlan(
@@ -296,7 +299,11 @@ class LinkFailureSweep:
 
             base_dist, base_nh = self.base_solve()
             self._plan = build_repair_plan(
-                self.topo, self.root_id, base_dist, base_nh
+                self.topo,
+                self.root_id,
+                base_dist,
+                base_nh,
+                pull_tables=self._pull_tables,
             )
         return self._plan
 

@@ -327,3 +327,71 @@ def test_init_duration(live_node):
     )
     assert r.exit_code == 0, r.output
     assert 0 <= int(r.output.strip()) < 3_600_000
+
+
+def test_golden_kvstore_keys_json(live_node):
+    check_golden(
+        "kvstore_keys_json",
+        live_node,
+        "kvstore",
+        "keys",
+        "--json",
+        "--prefix",
+        "adj:",
+    )
+
+
+def test_golden_kvstore_areas(live_node):
+    check_golden("kvstore_areas", live_node, "kvstore", "areas")
+
+
+def test_golden_kvstore_validate(live_node):
+    check_golden("kvstore_validate", live_node, "kvstore", "validate")
+
+
+def test_kvstore_signature_and_compare(live_node):
+    """Signature is stable for identical content; kv-compare against
+    OURSELVES must report a match (both stores trivially identical)."""
+    r1 = CliRunner().invoke(
+        breeze, ["--port", str(live_node), "kvstore", "kv-signature"], obj={}
+    )
+    r2 = CliRunner().invoke(
+        breeze, ["--port", str(live_node), "kvstore", "kv-signature"], obj={}
+    )
+    assert r1.exit_code == 0 and r2.exit_code == 0
+    assert r1.output == r2.output and len(r1.output.strip()) == 64
+    rc = CliRunner().invoke(
+        breeze,
+        [
+            "--port",
+            str(live_node),
+            "kvstore",
+            "kv-compare",
+            "--peer",
+            f"127.0.0.1:{live_node}",
+        ],
+        obj={},
+    )
+    assert rc.exit_code == 0, rc.output
+    assert rc.output.strip().endswith("stores match")
+
+
+def test_kvstore_keys_originator_filter(live_node):
+    r = CliRunner().invoke(
+        breeze,
+        [
+            "--port",
+            str(live_node),
+            "kvstore",
+            "keys",
+            "--json",
+            "--originator",
+            "node1",
+        ],
+        obj={},
+    )
+    assert r.exit_code == 0, r.output
+    data = json.loads(r.output)
+    assert data and all(
+        v["originator_id"] == "node1" for v in data.values()
+    )

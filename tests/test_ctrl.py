@@ -424,3 +424,45 @@ def test_handler_config_and_init_parity_methods():
         await net.stop()
 
     run(main())
+
+
+def test_handler_kvstore_depth_methods():
+    """areas / kv-signature / erase-key: the signature changes exactly
+    when content changes, and an erase tombstone supersedes + expires
+    network-wide."""
+
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        h0 = OpenrCtrlHandler(net.nodes["node0"])
+        h1 = OpenrCtrlHandler(net.nodes["node1"])
+        assert h0.get_kv_store_areas() == ["0"]
+        # converged stores agree on the signature
+        assert h0.get_kv_store_signature() == h1.get_kv_store_signature()
+        # inject a non-self-originated key, flood it, then erase it
+        # network-wide (a LIVE self-originated key would be resurrected
+        # by its owner's TTL refresh — correct protocol behavior; erase
+        # targets stale/foreign keys)
+        h0.set_kv_store_key_vals_area(
+            {
+                "prefix:ghost": Value(
+                    version=1,
+                    originator_id="ghost",
+                    value=b"{}",
+                    ttl=300_000,
+                ).to_wire()
+            }
+        )
+        await clock.run_for(1)
+        assert "prefix:ghost" in h1.dump_kv_store_area()
+        sig0 = h0.get_kv_store_signature()
+        h0.erase_kv_store_key("prefix:ghost", ttl_ms=200)
+        await clock.run_for(1)
+        for h in (h0, h1):
+            assert "prefix:ghost" not in h.dump_kv_store_area()
+        assert h0.get_kv_store_signature() != sig0
+        with pytest.raises(KeyError):
+            h0.erase_kv_store_key("nope:key")
+        await net.stop()
+
+    run(main())
