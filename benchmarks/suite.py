@@ -958,9 +958,18 @@ def main() -> None:
         if args.only and args.only not in bench.__name__:
             continue
         bench(results, args.full)
+    import jax
+
     with open(args.json, "w") as f:
         json.dump(
-            {"results": results, "wall_s": round(time.time() - t0, 1)},
+            {
+                # the platform stamp keeps CPU smoke runs from being
+                # mistaken for device measurements
+                "devices": [str(d) for d in jax.devices()],
+                "full": args.full,
+                "results": results,
+                "wall_s": round(time.time() - t0, 1),
+            },
             f,
             indent=2,
         )

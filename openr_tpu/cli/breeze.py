@@ -252,13 +252,19 @@ def kvstore_compare(ctx: click.Context, area: str, peer: str) -> None:
     per key) — the reference's breeze kv-compare."""
     import hashlib
 
-    here = _call(ctx, "dump_kv_store_area", prefix="", area=area)
-    host, _, port = peer.rpartition(":")
-    host = host.strip("[]")  # tolerate [v6]:port literals
-    if not port.isdigit():
+    if peer.count(":") > 1 and not peer.startswith("["):
+        # a bare IPv6 literal is ambiguous: require [addr]:port
+        raise click.BadParameter(
+            f"IPv6 peers must be written [addr]:port, got {peer!r}",
+            param_hint="--peer",
+        )
+    host, sep, port = peer.rpartition(":")
+    host = host.strip("[]")  # [v6]:port literals
+    if not sep or not host or not port.isdigit():
         raise click.BadParameter(
             f"--peer must be host:port, got {peer!r}", param_hint="--peer"
         )
+    here = _call(ctx, "dump_kv_store_area", prefix="", area=area)
 
     async def fetch_peer():
         async with OpenrCtrlClient(
@@ -293,7 +299,10 @@ def kvstore_compare(ctx: click.Context, area: str, peer: str) -> None:
         else:
             continue
         same = False
-    click.echo("stores match" if same else "stores differ")
+    if not same:
+        click.echo("stores differ")
+        raise SystemExit(1)  # scriptable, like kvstore validate
+    click.echo("stores match")
 
 
 @kvstore.command("validate")
