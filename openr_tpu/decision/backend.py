@@ -51,6 +51,24 @@ DEGREE_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
 ROWSEL_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144)
 
 
+def measure_dispatch_rt_ms() -> float:
+    """Median device dispatch round trip (ms): one tiny op, blocked.
+    ~75ms over a tunneled chip, ~0.1ms collocated — the number every
+    auto device-vs-host cutover in this package calibrates against."""
+    import time
+
+    import jax.numpy as jnp
+
+    (jnp.zeros(4) + 1).block_until_ready()  # compile warm-up
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        (jnp.zeros(4) + 1).block_until_ready()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples[1] * 1000.0
+
+
 def _patch_route_db(
     prev_db: DecisionRouteDb,
     results: Dict[str, Optional[RibUnicastEntry]],
@@ -264,18 +282,7 @@ class TpuBackend(DecisionBackend):
         device', which cost small grids ~25x over scalar on a tunneled
         chip — BENCH_SUITE r3 grid16 row)."""
         if self.auto_dispatch_rt_ms is None:
-            import time
-
-            import jax.numpy as jnp
-
-            (jnp.zeros(4) + 1).block_until_ready()  # compile warm-up
-            samples = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                (jnp.zeros(4) + 1).block_until_ready()
-                samples.append(time.perf_counter() - t0)
-            samples.sort()
-            self.auto_dispatch_rt_ms = samples[1] * 1000.0
+            self.auto_dispatch_rt_ms = measure_dispatch_rt_ms()
         work = len(prefix_state.prefixes()) + 2 * sum(
             ls.num_links() for ls in area_link_states.values()
         )

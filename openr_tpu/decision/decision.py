@@ -106,6 +106,8 @@ class Decision(Actor):
         self._fleet_engine = None
         self._whatif_engine = None
         self._whatif_multi_engine = None
+        self._whatif_native_engine = None
+        self._whatif_rt_ms = None
         self._debounce = AsyncDebounce(
             self,
             config.debounce_min_ms / 1000.0,
@@ -421,13 +423,30 @@ class Decision(Actor):
         ):
             return None
         if len(self.area_link_states) == 1:
-            # single-area vantage: warm-start repair sweep (the fastest
-            # engine)
-            if self._whatif_engine is None:
-                from openr_tpu.decision.whatif_api import WhatIfApiEngine
+            # single-area vantage: pick the warm-start engine by where
+            # it runs cheapest — the native C++ sweep solves a handful
+            # of failures in microseconds, while the device path pays
+            # dispatch round trips it can only amortize over large
+            # batches (the same measured-RT calibration the backend's
+            # device cutover uses)
+            if self._use_native_whatif(len(link_failures)):
+                if self._whatif_native_engine is None:
+                    from openr_tpu.decision.whatif_api import (
+                        NativeWhatIfEngine,
+                    )
 
-                self._whatif_engine = WhatIfApiEngine(self.solver)
-            engine = self._whatif_engine
+                    self._whatif_native_engine = NativeWhatIfEngine(
+                        self.solver
+                    )
+                engine = self._whatif_native_engine
+            else:
+                if self._whatif_engine is None:
+                    from openr_tpu.decision.whatif_api import (
+                        WhatIfApiEngine,
+                    )
+
+                    self._whatif_engine = WhatIfApiEngine(self.solver)
+                engine = self._whatif_engine
         else:
             # multi-area LSDB: fleet-family kernel (per-snapshot masked
             # area re-solve + global selection + cross-area merge)
@@ -540,6 +559,33 @@ class Decision(Actor):
             "truncated": truncated[0],
             "paths": [{"hops": p, "num_hops": len(p) - 1} for p in paths],
         }
+
+    #: per-item cost of a native warm solve + numpy selection (rough;
+    #: only needs to pick the right side of a ~100x crossover)
+    NATIVE_US_PER_ITEM = 0.2
+
+    def _use_native_whatif(self, num_failures: int) -> bool:
+        """Native engine iff its estimated sweep cost undercuts the
+        device path's dispatch round trips for this query size."""
+        from openr_tpu.decision.backend import (
+            TpuBackend,
+            measure_dispatch_rt_ms,
+        )
+
+        is_tpu = isinstance(self.backend, TpuBackend)
+        rt_ms = self.backend.auto_dispatch_rt_ms if is_tpu else None
+        if rt_ms is None:
+            rt_ms = self._whatif_rt_ms or measure_dispatch_rt_ms()
+            self._whatif_rt_ms = rt_ms
+            if is_tpu:
+                # share the calibration so the backend's own cutover
+                # doesn't measure again
+                self.backend.auto_dispatch_rt_ms = rt_ms
+        (ls,) = self.area_link_states.values()
+        items = len(self.prefix_state.prefixes()) + 2 * ls.num_links()
+        native_us = max(num_failures, 1) * items * self.NATIVE_US_PER_ITEM
+        device_us = TpuBackend.DEVICE_OVERHEAD_TRIPS * rt_ms * 1000.0
+        return native_us < device_us
 
     def get_fleet_rib_summary(self) -> Optional[Dict[str, dict]]:
         """Per-node route counts for EVERY vantage point from one batched

@@ -72,6 +72,31 @@ def resolve_pair_failures(pair_links: Dict, link_failures):
     return values, errors
 
 
+def build_pair_links(links, area_index=None) -> Dict:
+    """(n1, n2) → list of link values: plain link ids, or
+    (area_index, link_id) pairs when ``area_index`` is given.  One
+    builder for every what-if engine so link-identity handling cannot
+    drift between them."""
+    out: Dict[frozenset, list] = {}
+    for i, link in enumerate(links):
+        val = i if area_index is None else (area_index, i)
+        out.setdefault(frozenset((link.n1, link.n2)), []).append(val)
+    return out
+
+
+def lane_names_for(topo, root: str) -> List[str]:
+    """Lane rank → neighbor name for decoding first-hop lane rows."""
+    return [nbr for (_link, nbr) in topo.root_out_edges(root)]
+
+
+def decode_lane_names(lane_names: List[str], row) -> List[str]:
+    return [
+        lane_names[i]
+        for i in np.nonzero(row)[0]
+        if i < len(lane_names)
+    ]
+
+
 def change_kind(was: bool, now: bool) -> str:
     if was and not now:
         return "removed"
@@ -119,11 +144,7 @@ class WhatIfApiEngine:
         self._prefixes = cands.prefixes
         #: node-pair -> undirected link ids (PARALLEL links are distinct:
         #: link identity includes interfaces, link_state.py)
-        self._pair_links = {}
-        for i, link in enumerate(topo.links):
-            self._pair_links.setdefault(
-                frozenset((link.n1, link.n2)), []
-            ).append(i)
+        self._pair_links = build_pair_links(topo.links)
         self._cache_key = key
         self.num_engine_builds += 1
 
@@ -138,9 +159,7 @@ class WhatIfApiEngine:
         per-failure route deltas from this node's vantage."""
         self._engine_for(area_link_states, prefix_state, change_seq)
         me = self.solver.my_node_name
-        lane_names = [
-            neighbor for (_link, neighbor) in self._topo.root_out_edges(me)
-        ]
+        lane_names = lane_names_for(self._topo, me)
         v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
 
         lids, errors = resolve_pair_failures(
@@ -153,11 +172,7 @@ class WhatIfApiEngine:
         self.num_sweeps += 1
 
         def lanes_to_names(lane_row) -> List[str]:
-            return [
-                lane_names[i]
-                for i in np.nonzero(lane_row)[0]
-                if i < len(lane_names)
-            ]
+            return decode_lane_names(lane_names, lane_row)
 
         base_valid = deltas.base_valid
         out = []
@@ -249,10 +264,10 @@ class MultiAreaWhatIfEngine:
         # across areas) are rejected like the single-area engine
         pair_links: Dict[frozenset, list] = {}
         for ai, t in enumerate(enc.topos):
-            for li, link in enumerate(t.links):
-                pair_links.setdefault(
-                    frozenset((link.n1, link.n2)), []
-                ).append((ai, li))
+            for pair, vals in build_pair_links(
+                t.links, area_index=ai
+            ).items():
+                pair_links.setdefault(pair, []).extend(vals)
         out_edges_by_area = [t.root_out_edges(me) for t in enc.topos]
         D = bucket_for(max(enc.max_out_degree(), 1), DEGREE_BUCKETS)
         self._state = dict(
@@ -325,8 +340,11 @@ class MultiAreaWhatIfEngine:
             soft=jnp.asarray(enc.soft),
             roots=jnp.asarray(enc.roots),
         )
+        from openr_tpu.ops.jit_guard import call_jit_guarded
+
         use, shortest, lanes, valid = jax.device_get(
-            whatif_multi_area_tables(
+            call_jit_guarded(
+                whatif_multi_area_tables,
                 fail_area=jnp.asarray(fa),
                 fail_link=jnp.asarray(fl),
                 cand_area=jnp.asarray(dv.cand_area),
@@ -458,6 +476,176 @@ class MultiAreaWhatIfEngine:
                     "link": [n1, n2],
                     "area": enc.areas[ai],
                     "on_shortest_path_dag": on_dag(ai, li),
+                    "routes_changed": len(changes),
+                    "changes": changes,
+                }
+            )
+        return {"eligible": True, "vantage": me, "failures": out}
+
+
+class NativeWhatIfEngine:
+    """Single-area what-if over the NATIVE warm-start sweep.
+
+    The C++ incremental-repair solver (native/spf_scalar.cc
+    spf_warm_sweep — the same off-DAG-skip + affected-region trick the
+    device kernel uses) solves a single-link failure in tens of
+    microseconds at 1024-node scale; over a TUNNELED device the what-if
+    device path pays 1-2 dispatch round trips (~75 ms each) before any
+    compute.  For small operator queries the native engine is therefore
+    the right backend, and Decision auto-picks it from the measured
+    dispatch round trip (the same calibration the Decision backend's
+    device cutover uses).  Output schema and selection semantics are
+    identical to WhatIfApiEngine — selection runs the numpy mirror of
+    the device chain (ops.route_select.select_routes_numpy), so the two
+    engines are interchangeable and parity-tested.
+    """
+
+    def __init__(self, solver: SpfSolver) -> None:
+        self.solver = solver
+        self._cache_key = None
+        self._ctx = None
+        self.num_engine_builds = 0
+        self.num_sweeps = 0
+
+    def _engine_for(self, area_link_states, prefix_state, change_seq):
+        from openr_tpu.ops.csr import (
+            encode_link_state,
+            encode_prefix_candidates,
+        )
+        from openr_tpu.ops.native_spf import NativeSpf
+        from openr_tpu.ops.route_select import select_routes_numpy
+
+        (area, ls), = area_link_states.items()
+        key = (area, ls.topology_seq, change_seq)
+        if self._cache_key == key:
+            return self._ctx
+        topo = encode_link_state(ls)
+        me = self.solver.my_node_name
+        cands = encode_prefix_candidates(prefix_state, topo, area)
+        native = NativeSpf(topo, me)
+        native.warm_prepare()
+        D = max(int(native.lane_of_edge.max()) + 1, 1)
+        soft = np.zeros(topo.padded_nodes, np.int32)
+        sel_args = (
+            cands.cand_node,
+            cands.cand_ok,
+            cands.drain_metric,
+            cands.path_pref,
+            cands.source_pref,
+            cands.distance,
+            cands.min_nexthop,
+        )
+        base_lanes = (
+            (
+                native._wbase_nh[:, None]
+                >> np.arange(D, dtype=np.uint64)
+            )
+            & 1
+        ).astype(np.int8)
+        bvalid, bmetric, bnh, _n, _u = select_routes_numpy(
+            *sel_args,
+            native._wbase_dist,
+            base_lanes,
+            topo.overloaded,
+            soft,
+            topo.node_id(me),
+        )
+        pair_links = build_pair_links(topo.links)
+        self._ctx = dict(
+            topo=topo,
+            native=native,
+            cands=cands,
+            D=D,
+            soft=soft,
+            sel_args=sel_args,
+            base=(bvalid, bmetric, bnh),
+            pair_links=pair_links,
+            lane_names=lane_names_for(topo, me),
+            root_id=topo.node_id(me),
+        )
+        self._cache_key = key
+        self.num_engine_builds += 1
+        return self._ctx
+
+    def run(
+        self,
+        link_failures: List[Tuple[str, str]],
+        area_link_states,
+        prefix_state,
+        change_seq: int,
+    ) -> Dict:
+        from openr_tpu.ops.route_select import select_routes_numpy
+
+        ctx = self._engine_for(area_link_states, prefix_state, change_seq)
+        me = self.solver.my_node_name
+        topo, native, D = ctx["topo"], ctx["native"], ctx["D"]
+        bvalid, bmetric, bnh = ctx["base"]
+        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+        prefixes = ctx["cands"].prefixes
+        lane_names = ctx["lane_names"]
+
+        def lanes_to_names(row) -> List[str]:
+            return decode_lane_names(lane_names, row)
+
+        lids, errors = resolve_pair_failures(
+            ctx["pair_links"], link_failures
+        )
+        self.num_sweeps += 1
+        out = []
+        for s, ((n1, n2), lid) in enumerate(zip(link_failures, lids)):
+            if lid is None:
+                out.append(errors[s])
+                continue
+            on_dag = bool(native.link_on_dag[lid])
+            changes = []
+            if on_dag:
+                native.warm_sweep(
+                    np.asarray([lid], np.int32), keep_last=True
+                )
+                lanes = native.lanes_dense(D)
+                valid, metric, nh_out, _n, _u = select_routes_numpy(
+                    *ctx["sel_args"],
+                    native.dist,
+                    lanes,
+                    topo.overloaded,
+                    ctx["soft"],
+                    ctx["root_id"],
+                )
+                diff = (valid != bvalid) | (
+                    valid
+                    & bvalid
+                    & (
+                        (metric != bmetric)
+                        | (nh_out != bnh).any(axis=1)
+                    )
+                )
+                for p in np.nonzero(diff)[0]:
+                    prefix = prefixes[p]
+                    if prefix_is_v4(prefix) and not v4_ok:
+                        continue
+                    was, now = bool(bvalid[p]), bool(valid[p])
+                    changes.append(
+                        {
+                            "prefix": prefix,
+                            "change": change_kind(was, now),
+                            "old_nexthops": (
+                                lanes_to_names(bnh[p]) if was else []
+                            ),
+                            "new_nexthops": (
+                                lanes_to_names(nh_out[p]) if now else []
+                            ),
+                            "old_metric": (
+                                float(bmetric[p]) if was else None
+                            ),
+                            "new_metric": (
+                                float(metric[p]) if now else None
+                            ),
+                        }
+                    )
+            out.append(
+                {
+                    "link": [n1, n2],
+                    "on_shortest_path_dag": on_dag,
                     "routes_changed": len(changes),
                     "changes": changes,
                 }

@@ -1,0 +1,45 @@
+"""Guard against the jax-0.9.0 executable-cache corruption.
+
+Observed failure mode (round 3, and again with the multi-area what-if
+kernel): after OTHER jitted kernel families have compiled in the same
+process, the first call of a fresh jitted function intermittently draws
+a corrupted executable-cache entry and XLA rejects the launch with
+
+    INVALID_ARGUMENT: Execution supplied N buffers but compiled program
+    expected M buffers
+
+``jax.clear_caches()`` reproducibly heals it (the recompile after the
+clear produces a correct executable).  ``call_jit_guarded`` wraps a
+risky call: on exactly this error it clears the caches and retries ONCE
+— a deterministic recompile, not a silent result change; any other
+exception (and a second failure) propagates.  The single-solve
+base-table selection in ops/sweep_select.py dodges the same bug by
+running eager; batch kernels can't afford eager dispatch, hence this
+guard.  Regression coverage: tests/test_sweep_select.py pins the eager
+workaround; tests/test_whatif_multiarea.py's cross-kernel ordering runs
+through this guard.
+"""
+
+from __future__ import annotations
+
+_SIGNATURE = "buffers but compiled program expected"
+
+
+def call_jit_guarded(fn, *args, **kwargs):
+    """Call a jitted function; heal the known cache corruption once."""
+    try:
+        return fn(*args, **kwargs)
+    except ValueError as e:  # jaxlib surfaces it as ValueError
+        if _SIGNATURE not in str(e):
+            raise
+        import logging
+
+        import jax
+
+        logging.getLogger(__name__).warning(
+            "jit executable-cache corruption detected (%s); clearing "
+            "jax caches and retrying once",
+            e,
+        )
+        jax.clear_caches()
+        return fn(*args, **kwargs)

@@ -143,7 +143,9 @@ def test_whatif_unknown_link_and_scalar_backend():
 def test_whatif_engine_cached_across_calls():
     d, _dbs = build_decision()
     d.get_link_failure_whatif([["node0", "node1"]])
-    eng = d._whatif_engine
+    # the auto choice may pick either warm-start engine; both cache per
+    # LSDB generation
+    eng = d._whatif_engine or d._whatif_native_engine
     assert eng.num_engine_builds == 1
     d.get_link_failure_whatif([["node1", "node2"]])
     assert eng.num_engine_builds == 1  # cached until LSDB changes
@@ -151,3 +153,92 @@ def test_whatif_engine_cached_across_calls():
     d._change_seq += 1
     d.get_link_failure_whatif([["node1", "node2"]])
     assert eng.num_engine_builds == 2
+
+
+def test_native_engine_matches_device_engine():
+    """NativeWhatIfEngine (C++ warm sweep + numpy selection) must give
+    BYTE-identical operator output to the device engine on the same
+    world — the two are auto-chosen per deployment, so any drift is an
+    operator-visible inconsistency."""
+    import numpy as np
+
+    from openr_tpu.decision.whatif_api import (
+        NativeWhatIfEngine,
+        WhatIfApiEngine,
+    )
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.emulation.topology import (
+        build_adj_dbs,
+        random_connected_edges,
+    )
+    from openr_tpu.types import PrefixEntry, PrefixMetrics
+
+    ls = LinkState("0")
+    for db in build_adj_dbs(
+        random_connected_edges(48, 70, seed=5),
+        soft_drained={"node7": 50},
+        overloaded=["node11"],
+    ).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(48):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+    # anycast with preference spread
+    ps.update_prefix("node3", "0", PrefixEntry(
+        "10.99.0.0/24", metrics=PrefixMetrics(path_preference=900)))
+    ps.update_prefix("node40", "0", PrefixEntry(
+        "10.99.0.0/24", metrics=PrefixMetrics(path_preference=900)))
+    als = {"0": ls}
+    failures = [("node0", "node1"), ("node5", "node9"), ("nope", "x")]
+    # every real link too, for breadth
+    from openr_tpu.ops.csr import encode_link_state
+
+    topo = encode_link_state(ls)
+    failures += [(l.n1, l.n2) for l in topo.links[:40]]
+
+    dev = WhatIfApiEngine(SpfSolver("node0")).run(failures, als, ps, 1)
+    nat = NativeWhatIfEngine(SpfSolver("node0")).run(failures, als, ps, 1)
+    assert nat == dev
+
+
+def test_decision_auto_picks_native_for_small_queries():
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.types import PrefixEntry
+
+    ls = LinkState("0")
+    for db in build_adj_dbs(grid_edges(4)).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(16):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+    backend = TpuBackend(SpfSolver("node0"))
+    d = Decision(
+        "node0", SimClock(), DecisionConfig(), ReplicateQueue(),
+        backend=backend,
+    )
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    d._change_seq = 1
+    # tunnel-like dispatch: native engine must serve the query
+    backend.auto_dispatch_rt_ms = 75.0
+    res = d.get_link_failure_whatif([("node0", "node1")])
+    assert res is not None and res["eligible"]
+    assert d._whatif_native_engine is not None
+    assert d._whatif_engine is None
+    # collocated device: large batches go to the device engine
+    backend.auto_dispatch_rt_ms = 0.01
+    res2 = d.get_link_failure_whatif([("node0", "node1")] * 24)
+    assert res2 is not None
+    assert d._whatif_engine is not None
+    # and both engines agreed on the single-failure answer
+    assert res["failures"][0] == res2["failures"][0]
