@@ -69,6 +69,15 @@ def measure_dispatch_rt_ms() -> float:
     return samples[1] * 1000.0
 
 
+def estimate_scalar_work_items(area_link_states, prefix_state) -> int:
+    """Work items (prefix rows + directed edges) for the auto cutovers'
+    scalar-cost estimate — ONE formula shared by the backend's device
+    cutover and Decision's what-if engine choice."""
+    return len(prefix_state.prefixes()) + 2 * sum(
+        ls.num_links() for ls in area_link_states.values()
+    )
+
+
 def _patch_route_db(
     prev_db: DecisionRouteDb,
     results: Dict[str, Optional[RibUnicastEntry]],
@@ -283,9 +292,7 @@ class TpuBackend(DecisionBackend):
         chip — BENCH_SUITE r3 grid16 row)."""
         if self.auto_dispatch_rt_ms is None:
             self.auto_dispatch_rt_ms = measure_dispatch_rt_ms()
-        work = len(prefix_state.prefixes()) + 2 * sum(
-            ls.num_links() for ls in area_link_states.values()
-        )
+        work = estimate_scalar_work_items(area_link_states, prefix_state)
         scalar_us = work * self.SCALAR_US_PER_ITEM
         device_us = (
             self.DEVICE_OVERHEAD_TRIPS * self.auto_dispatch_rt_ms * 1000.0

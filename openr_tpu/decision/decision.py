@@ -569,9 +569,18 @@ class Decision(Actor):
         device path's dispatch round trips for this query size."""
         from openr_tpu.decision.backend import (
             TpuBackend,
+            estimate_scalar_work_items,
             measure_dispatch_rt_ms,
         )
+        from openr_tpu.ops.native_spf import MAX_LANES
 
+        me = self.node_name
+        (ls,) = self.area_link_states.values()
+        # the native solver packs first-hop lanes into one u64 word; a
+        # vantage with more out-links than that stays on the device
+        # engine (which handles up to the largest degree bucket)
+        if len(ls.links_from_node(me)) > MAX_LANES:
+            return False
         is_tpu = isinstance(self.backend, TpuBackend)
         rt_ms = self.backend.auto_dispatch_rt_ms if is_tpu else None
         if rt_ms is None:
@@ -581,8 +590,9 @@ class Decision(Actor):
                 # share the calibration so the backend's own cutover
                 # doesn't measure again
                 self.backend.auto_dispatch_rt_ms = rt_ms
-        (ls,) = self.area_link_states.values()
-        items = len(self.prefix_state.prefixes()) + 2 * ls.num_links()
+        items = estimate_scalar_work_items(
+            self.area_link_states, self.prefix_state
+        )
         native_us = max(num_failures, 1) * items * self.NATIVE_US_PER_ITEM
         device_us = TpuBackend.DEVICE_OVERHEAD_TRIPS * rt_ms * 1000.0
         return native_us < device_us

@@ -535,16 +535,11 @@ class NativeWhatIfEngine:
             cands.distance,
             cands.min_nexthop,
         )
-        base_lanes = (
-            (
-                native._wbase_nh[:, None]
-                >> np.arange(D, dtype=np.uint64)
-            )
-            & 1
-        ).astype(np.int8)
+        base_dist, base_nh_mask = native.warm_base
+        base_lanes = native.lanes_dense(D, mask=base_nh_mask)
         bvalid, bmetric, bnh, _n, _u = select_routes_numpy(
             *sel_args,
-            native._wbase_dist,
+            base_dist,
             base_lanes,
             topo.overloaded,
             soft,

@@ -221,8 +221,21 @@ class NativeSpf:
             raise RuntimeError(f"spf_warm_sweep rc={rc}")
         return checksum.value
 
-    def lanes_dense(self, max_degree: Optional[int] = None) -> np.ndarray:
-        """Unpack nh_mask bits into the device kernel's [V, D] int8."""
+    def lanes_dense(
+        self,
+        max_degree: Optional[int] = None,
+        mask: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Unpack lane-bit masks into the device kernel's [V, D] int8.
+        Defaults to the last solve's ``nh_mask``; pass ``mask`` to
+        decode another packed array (e.g. the warm base solution) with
+        the SAME packing in one place."""
         D = max_degree or self.topo.max_out_degree()
-        bits = (self.nh_mask[:, None] >> np.arange(D, dtype=np.uint64)) & 1
+        m = self.nh_mask if mask is None else mask
+        bits = (m[:, None] >> np.arange(D, dtype=np.uint64)) & 1
         return bits.astype(np.int8)
+
+    @property
+    def warm_base(self):
+        """(base_dist [V] f32, base_nh_mask [V] u64) from warm_prepare."""
+        return self._wbase_dist, self._wbase_nh

@@ -120,3 +120,21 @@ def test_sharded_fleet_matches_scalar_for_every_root():
         want = SpfSolver(node).build_route_db(als, ps)
         assert route_db_summary(got) == route_db_summary(want), node
     assert eng.num_batched_solves == 1
+
+
+def test_sharded_multi_chunk_sweep_parity(world):
+    """Chunked dispatch under a mesh: max_chunk forces several chunks
+    per sweep; rows must land at the right offsets regardless of
+    sharded bucket padding."""
+    _ls, topo = world
+    L = len(topo.links)
+    fails = np.asarray([b % L for b in range(160)], np.int32)
+    r1 = LinkFailureSweep(topo, "node0", max_chunk=16).run(
+        fails, fetch=True
+    )
+    r8 = LinkFailureSweep(
+        topo, "node0", max_chunk=16, mesh=_mesh(8)
+    ).run(fails, fetch=True)
+    assert np.array_equal(r1.snap_row, r8.snap_row)
+    assert np.array_equal(r1.dist, r8.dist)
+    assert np.array_equal(r1.nh, r8.nh)

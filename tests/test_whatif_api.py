@@ -4,6 +4,8 @@ For each candidate link failure, the API's reported changes must match
 the difference between the scalar oracle's RouteDb on the intact
 topology and on a topology with the link actually removed."""
 
+import pytest
+
 from openr_tpu.common.runtime import SimClock
 from openr_tpu.config import DecisionConfig
 from openr_tpu.decision.backend import ScalarBackend, TpuBackend
@@ -242,3 +244,49 @@ def test_decision_auto_picks_native_for_small_queries():
     assert d._whatif_engine is not None
     # and both engines agreed on the single-failure answer
     assert res["failures"][0] == res2["failures"][0]
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_native_vs_device_engines_random_worlds(seed):
+    """Property check: on random weighted topologies with random drains
+    and anycast, the auto-selectable engines agree byte for byte."""
+    import numpy as np
+
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.decision.whatif_api import (
+        NativeWhatIfEngine,
+        WhatIfApiEngine,
+    )
+    from openr_tpu.emulation.topology import (
+        build_adj_dbs,
+        random_connected_edges,
+    )
+    from openr_tpu.ops.csr import encode_link_state
+    from openr_tpu.types import PrefixEntry, PrefixMetrics
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(24, 56))
+    edges = random_connected_edges(n, n + int(rng.integers(8, 40)), seed=seed)
+    drained = {f"node{int(rng.integers(1, n))}": 40}
+    over = [f"node{int(rng.integers(1, n))}"]
+    ls = LinkState("0")
+    for db in build_adj_dbs(
+        edges, soft_drained=drained, overloaded=over
+    ).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(n):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+    a1, a2 = rng.integers(1, n, size=2)
+    ps.update_prefix(f"node{a1}", "0", PrefixEntry(
+        "10.200.0.0/24", metrics=PrefixMetrics(source_preference=150)))
+    ps.update_prefix(f"node{a2}", "0", PrefixEntry(
+        "10.200.0.0/24", metrics=PrefixMetrics(source_preference=150)))
+    als = {"0": ls}
+    topo = encode_link_state(ls)
+    failures = [(l.n1, l.n2) for l in topo.links]
+    dev = WhatIfApiEngine(SpfSolver("node0")).run(failures, als, ps, 1)
+    nat = NativeWhatIfEngine(SpfSolver("node0")).run(failures, als, ps, 1)
+    assert nat == dev
