@@ -500,6 +500,118 @@ def fib_routes(ctx: click.Context) -> None:
     _print(_call(ctx, "get_fib_routes"))
 
 
+def _fib_agent_call(host: str, port: int, client_id: int, fn_name: str, *args):
+    """Run one RemoteFibAgent call against a (standalone) FIB agent —
+    the reference breeze fib add/del/sync commands talk to the agent on
+    fib_port directly, not to the daemon ctrl."""
+    from openr_tpu.platform.fib_service import RemoteFibAgent
+
+    async def go():
+        agent = RemoteFibAgent(host=host, port=port, client_id=client_id)
+        try:
+            return await getattr(agent, fn_name)(*args)
+        finally:
+            await agent.close()
+
+    return asyncio.run(go())
+
+
+def _fib_agent_options(fn):
+    fn = click.option(
+        "--agent-host", default="127.0.0.1", help="FIB agent host"
+    )(fn)
+    fn = click.option(
+        "--agent-port", default=60100, help="FIB agent (fib_port)"
+    )(fn)
+    fn = click.option(
+        "--client-id", default=786, help="FibService client id"
+    )(fn)
+    return fn
+
+
+def _parse_nexthops(nexthops: str):
+    """if@addr[,if@addr...] → NextHop list (the reference fib-add
+    shape)."""
+    from openr_tpu.types import NextHop
+
+    out = []
+    for tok in nexthops.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "@" in tok:
+            if_name, _, addr = tok.partition("@")
+        else:
+            if_name, addr = "", tok
+        out.append(NextHop(address=addr, if_name=if_name))
+    if not out:
+        raise click.BadParameter("no nexthops given")
+    return out
+
+
+@fib.command("add")
+@click.argument("prefix")
+@click.argument("nexthops")
+@_fib_agent_options
+def fib_add(
+    prefix: str, nexthops: str, agent_host: str, agent_port: int,
+    client_id: int,
+) -> None:
+    """Inject PREFIX with NEXTHOPS (if@addr,...) via the FIB agent."""
+    from openr_tpu.types import UnicastRoute
+
+    route = UnicastRoute(dest=prefix, next_hops=_parse_nexthops(nexthops))
+    _fib_agent_call(
+        agent_host, agent_port, client_id, "add_unicast_routes", [route]
+    )
+    click.echo(f"added {prefix}")
+
+
+@fib.command("del")
+@click.argument("prefixes", nargs=-1, required=True)
+@_fib_agent_options
+def fib_del(
+    prefixes: tuple, agent_host: str, agent_port: int, client_id: int
+) -> None:
+    """Delete PREFIXES from the FIB agent's table for this client id."""
+    _fib_agent_call(
+        agent_host, agent_port, client_id, "delete_unicast_routes",
+        list(prefixes),
+    )
+    click.echo(f"deleted {len(prefixes)} prefix(es)")
+
+
+@fib.command("routes-installed")
+@_fib_agent_options
+def fib_routes_installed(
+    agent_host: str, agent_port: int, client_id: int
+) -> None:
+    """Routes as the FIB AGENT holds them (vs the daemon's view)."""
+    routes = _fib_agent_call(
+        agent_host, agent_port, client_id, "get_route_table"
+    )
+    _print([r.to_wire() for r in routes])
+
+
+@fib.command("counters")
+@_fib_agent_options
+def fib_counters(
+    agent_host: str, agent_port: int, client_id: int
+) -> None:
+    """FIB agent counters (programmed routes, errors, keepalive)."""
+    _print(_fib_agent_call(agent_host, agent_port, client_id, "get_counters"))
+
+
+@fib.command("alive-since")
+@_fib_agent_options
+def fib_alive_since(
+    agent_host: str, agent_port: int, client_id: int
+) -> None:
+    """Agent start timestamp — Fib's keepalive uses this to detect agent
+    restarts and trigger a full resync."""
+    click.echo(_fib_agent_call(agent_host, agent_port, client_id, "alive_since"))
+
+
 @fib.command("unicast")
 @click.argument("prefixes", nargs=-1, required=True)
 @click.pass_context
@@ -867,6 +979,46 @@ def decision_adj_filtered(
 # more lm breadth (adjacency metric, soft increments, drain state)
 
 
+@lm.command("validate")
+@click.pass_context
+def lm_validate(ctx: click.Context) -> None:
+    """Link-monitor consistency: every advertised adjacency backed by an
+    ESTABLISHED neighbor on an up interface (breeze lm validate)."""
+    ifaces = _call(ctx, "get_interfaces")
+    nbrs = {
+        n.get("node_name")
+        for n in _call(ctx, "get_spark_neighbors")
+        if n.get("state") == "ESTABLISHED"
+    }
+    me = _call(ctx, "get_node_name")
+    adj_dbs = _call(ctx, "get_decision_adjacency_dbs")
+    up = {
+        name
+        for name, d in ifaces.get("interface_details", {}).items()
+        if d.get("is_up", True)
+    }
+    problems = []
+    for db in adj_dbs:
+        if db.get("this_node_name") != me:
+            continue
+        for adj in db.get("adjacencies", []):
+            if adj.get("other_node_name") not in nbrs:
+                problems.append(
+                    f"adjacency to {adj.get('other_node_name')} has no "
+                    "ESTABLISHED neighbor"
+                )
+            if up and adj.get("if_name") not in up:
+                problems.append(
+                    f"adjacency on {adj.get('if_name')} but interface "
+                    "not up"
+                )
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo("link-monitor state validated OK")
+
+
 @lm.command("drain-state")
 @click.pass_context
 def lm_drain_state(ctx: click.Context) -> None:
@@ -992,6 +1144,27 @@ def fib_mpls(ctx: click.Context, labels: tuple) -> None:
 
 
 # spark graceful restart
+
+
+@spark.command("validate")
+@click.pass_context
+def spark_validate(ctx: click.Context) -> None:
+    """Neighbor-state sanity: every discovered neighbor ESTABLISHED and
+    area-resolved (the reference's breeze spark validate)."""
+    nbrs = _call(ctx, "get_spark_neighbors")
+    problems = []
+    for n in nbrs:
+        if n.get("state") != "ESTABLISHED":
+            problems.append(
+                f"{n.get('node_name')}: state {n.get('state')}"
+            )
+        if not n.get("area"):
+            problems.append(f"{n.get('node_name')}: no negotiated area")
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo(f"{len(nbrs)} neighbor(s) validated OK")
 
 
 @spark.command("graceful-restart")

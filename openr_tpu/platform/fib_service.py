@@ -468,3 +468,6 @@ class RemoteFibAgent(FibAgent):
             UnicastRoute.from_wire(r)
             for r in await self._call("get_route_table_by_client")
         ]
+
+    async def get_counters(self) -> Dict[str, float]:
+        return dict(await self._call("get_counters"))

@@ -191,3 +191,65 @@ def test_cli_graceful_restart_rpc(live_node):
     # the node keeps running; its adjacency view stays served
     out = _run(live_node, "spark", "neighbors")
     assert "node1" in out
+
+
+def test_fib_agent_cli_commands():
+    """breeze fib add/del/routes-installed/counters/alive-since talk to
+    the FIB AGENT directly (the reference's fib add/del/sync debug
+    commands ride fib_port, not the daemon ctrl)."""
+    import asyncio
+    import threading
+
+    from click.testing import CliRunner
+
+    from openr_tpu.cli.breeze import breeze
+    from openr_tpu.platform.fib_service import (
+        FibServiceServer,
+        NetlinkFibHandler,
+    )
+    from openr_tpu.platform.nl import (
+        MockNetlinkProtocolSocket,
+        NetlinkEventsInjector,
+    )
+
+    started = threading.Event()
+    info = {}
+
+    def runner():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        info["loop"], info["stop"] = loop, stop
+
+        async def main():
+            nl = MockNetlinkProtocolSocket()
+            inj = NetlinkEventsInjector(nl)
+            inj.set_link(2, "eth0", True)
+            server = FibServiceServer(NetlinkFibHandler(nl))
+            await server.start()
+            info["port"] = server.port
+            started.set()
+            await stop.wait()
+            await server.stop()
+
+        loop.run_until_complete(main())
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    assert started.wait(10)
+    opts = ["--agent-port", str(info["port"])]
+
+    def run(*args):
+        r = CliRunner().invoke(breeze, ["fib", *args], obj={})
+        assert r.exit_code == 0, r.output
+        return r.output
+
+    assert "added" in run("add", "10.9.0.0/24", "eth0@fe80::9", *opts)
+    out = run("routes-installed", *opts)
+    assert "10.9.0.0/24" in out and "fe80::9" in out
+    assert float(run("alive-since", *opts).strip()) > 0
+    assert "deleted 1 prefix(es)" in run("del", "10.9.0.0/24", *opts)
+    assert "10.9.0.0/24" not in run("routes-installed", *opts)
+    run("counters", *opts)
+    info["loop"].call_soon_threadsafe(info["stop"].set)
+    t.join(10)

@@ -395,3 +395,11 @@ def test_kvstore_keys_originator_filter(live_node):
     assert data and all(
         v["originator_id"] == "node1" for v in data.values()
     )
+
+
+def test_golden_lm_validate(live_node):
+    check_golden("lm_validate", live_node, "lm", "validate")
+
+
+def test_golden_spark_validate(live_node):
+    check_golden("spark_validate", live_node, "spark", "validate")
