@@ -34,6 +34,22 @@ def _call(ctx: click.Context, method: str, **params: Any) -> Any:
     return asyncio.run(go())
 
 
+def _call_many(ctx: click.Context, calls) -> list:
+    """Issue several RPCs over ONE connection (one event loop + TCP/TLS
+    handshake), for commands that compose many reads."""
+    host, port = ctx.obj["host"], ctx.obj["port"]
+    tls = ctx.obj.get("tls")
+
+    async def go():
+        async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
+            out = []
+            for method, params in calls:
+                out.append(await client.call(method, **(params or {})))
+            return out
+
+    return asyncio.run(go())
+
+
 def _print(obj: Any) -> None:
     click.echo(json.dumps(obj, indent=2, sort_keys=True, default=str))
 
@@ -93,6 +109,39 @@ def version(ctx: click.Context) -> None:
 @click.pass_context
 def node_name(ctx: click.Context) -> None:
     click.echo(_call(ctx, "get_node_name"))
+
+
+@openr.command("summary")
+@click.pass_context
+def openr_summary(ctx: click.Context) -> None:
+    """One-screen node overview (breeze openr summary)."""
+    me, ver, converged, areas, nbrs, rib, fibdb, ifaces = _call_many(
+        ctx,
+        [
+            ("get_node_name", None),
+            ("get_openr_version", None),
+            ("initialization_converged", None),
+            ("get_kv_store_areas", None),
+            ("get_spark_neighbors", None),
+            ("get_route_db", None),
+            ("get_fib_routes", None),
+            ("get_interfaces", None),
+        ],
+    )
+    est = sum(1 for n in nbrs if n.get("state") == "ESTABLISHED")
+    click.echo(f"Node      : {me} (openr version {ver['version']})")
+    click.echo(f"Initialized: {converged}")
+    click.echo(f"Areas     : {', '.join(areas)}")
+    click.echo(
+        f"Neighbors : {len(nbrs)} ({est} established)"
+    )
+    click.echo(
+        f"Routes    : {len(rib.get('unicast_routes', []))} computed / "
+        f"{len(fibdb.get('unicast_routes', []))} programmed"
+    )
+    click.echo(
+        f"Drained   : {ifaces.get('is_overloaded', False)}"
+    )
 
 
 @openr.command("init-events")
@@ -211,6 +260,60 @@ def kvstore_keys(
     for k, orig, ver, ttl in rows:
         line = f"{k:40} {orig:12} {ver:<8}"
         click.echo(line + (f" {ttl}" if show_ttl else ""))
+
+
+@kvstore.command("prefixes")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option("--nodes", "node_filter", default="",
+              help="comma-separated node filter")
+@click.pass_context
+def kvstore_prefixes(
+    ctx: click.Context, area: str, node_filter: str
+) -> None:
+    """Advertised prefixes per node, decoded from prefix: keys."""
+    from openr_tpu.types import parse_prefix_key
+
+    want = (
+        {tok.strip() for tok in node_filter.split(",") if tok.strip()}
+        if node_filter
+        else None
+    )
+    dump = _call(ctx, "dump_kv_store_area", prefix="prefix:", area=area)
+    per_node: dict = {}
+    for key in dump:
+        parsed = parse_prefix_key(key)
+        if parsed is None:
+            continue
+        node, prefix = parsed
+        if want and node not in want:
+            continue
+        per_node.setdefault(node, []).append(prefix)
+    for node in sorted(per_node):
+        click.echo(f"{node}:")
+        for p in sorted(per_node[node]):
+            click.echo(f"  {p}")
+
+
+@kvstore.command("nodes")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.pass_context
+def kvstore_nodes(ctx: click.Context, area: str) -> None:
+    """Node names present in the LSDB (adj/prefix advertisements); the
+    local node is starred."""
+    from openr_tpu.types import parse_adj_key, parse_prefix_key
+
+    me = _call(ctx, "get_node_name")
+    dump = _call(ctx, "dump_kv_store_area", prefix="", area=area)
+    nodes = set()
+    for key in dump:
+        n = parse_adj_key(key)
+        if n is None:
+            parsed = parse_prefix_key(key)
+            n = parsed[0] if parsed else None
+        if n:
+            nodes.add(n)
+    for n in sorted(nodes):
+        click.echo(f"{'*' if n == me else ' '} {n}")
 
 
 @kvstore.command("areas")
@@ -446,6 +549,102 @@ def decision_path(
         click.echo(f"  [{p['num_hops']} hops] " + " -> ".join(p["hops"]))
 
 
+@decision.command("validate")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.pass_context
+def decision_validate(ctx: click.Context, area: str) -> None:
+    """Decision's LSDB view vs the KvStore source of truth: every adj /
+    prefix advertisement in the store must be reflected in Decision's
+    databases and vice versa (the reference's breeze decision
+    validate)."""
+    import json as _json
+
+    from openr_tpu.types import parse_adj_key, parse_prefix_key
+
+    dump = _call(ctx, "dump_kv_store_area", prefix="", area=area)
+    store_adj = {}
+    store_prefixes = set()
+    for key, v in dump.items():
+        n = parse_adj_key(key)
+        raw = v.get("value")
+        if n is not None and raw:
+            try:
+                blob = bytes.fromhex(raw) if v.get("_value_hex") else raw
+                db = _json.loads(blob)
+                store_adj[n] = len(db.get("adjacencies", []))
+            except Exception:
+                store_adj[n] = None
+            continue
+        parsed = parse_prefix_key(key)
+        if parsed is not None:
+            store_prefixes.add(parsed)
+    adj_dbs = _call(ctx, "get_decision_adjacency_dbs", area=area)
+    dec_adj = {
+        db.get("this_node_name"): len(db.get("adjacencies", []))
+        for db in adj_dbs
+    }
+    # {prefix: {"node@area": entry}} — flatten to (node, prefix) pairs,
+    # normalized like the store's prefix: keys (types.prefix_key zeroes
+    # host bits, so '10.0.0.1/24' advertises as '10.0.0.0/24')
+    from openr_tpu.types import normalize_prefix
+
+    received = _call(ctx, "get_received_routes")
+    dec_prefixes = {
+        (na.split("@", 1)[0], normalize_prefix(prefix))
+        for prefix, entries in received.items()
+        for na in entries
+    }
+    problems = []
+    for n, cnt in store_adj.items():
+        if n not in dec_adj:
+            problems.append(f"adj db for {n} in store but not in Decision")
+        elif cnt is not None and cnt != dec_adj[n]:
+            problems.append(
+                f"adj count mismatch for {n}: store {cnt} vs decision "
+                f"{dec_adj[n]}"
+            )
+    for n in dec_adj:
+        if n not in store_adj:
+            problems.append(f"adj db for {n} in Decision but not in store")
+    for node, prefix in sorted(store_prefixes - dec_prefixes):
+        problems.append(
+            f"prefix {prefix} from {node} in store but not in Decision"
+        )
+    for node, prefix in sorted(dec_prefixes - store_prefixes):
+        problems.append(
+            f"prefix {prefix} from {node} in Decision but not in store"
+        )
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo(
+        f"decision view validated OK ({len(store_adj)} adj dbs, "
+        f"{len(store_prefixes)} prefix advertisements)"
+    )
+
+
+@decision.command("partial-adj")
+@click.option("--area", default=None, help="area filter")
+@click.pass_context
+def decision_partial_adj(ctx: click.Context, area: Optional[str]) -> None:
+    """One-sided adjacencies (A reports B but B does not report A) —
+    usually a link mid-negotiation or a stale LSDB entry."""
+    dbs = _call(ctx, "get_decision_adjacency_dbs", area=area)
+    seen = set()
+    for db in dbs:
+        node = db.get("this_node_name")
+        for adj in db.get("adjacencies", []):
+            seen.add((node, adj.get("other_node_name")))
+    click.echo(f"Total adj (uni-directional): {len(seen)}")
+    missing = sorted(
+        (b, a) for (a, b) in seen if (b, a) not in seen
+    )
+    click.echo(f"Total partial adj: {len(missing)}")
+    for a, b in missing:
+        click.echo(f"{a} -X-> {b}")
+
+
 @decision.command("adj")
 @click.option("--area", default=None)
 @click.pass_context
@@ -619,6 +818,67 @@ def fib_unicast(ctx: click.Context, prefixes: tuple) -> None:
     _print(_call(ctx, "get_unicast_routes_filtered", prefixes=list(prefixes)))
 
 
+@fib.command("validate")
+@click.pass_context
+def fib_validate(ctx: click.Context) -> None:
+    """Programmed FIB vs Decision's computed RIB: same unicast dests and
+    nexthop sets, and the FIB synced (breeze fib validate)."""
+    rib = _call(ctx, "get_route_db")
+    fibdb = _call(ctx, "get_fib_routes")
+
+    def view(db):
+        return {
+            r["dest"]: sorted(
+                (nh.get("address"), nh.get("if_name"))
+                for nh in r.get("next_hops", [])
+            )
+            for r in db.get("unicast_routes", [])
+        }
+
+    want, got = view(rib), view(fibdb)
+    problems = []
+    if not _call(ctx, "fib_synced"):
+        problems.append("fib reports not synced")
+    for dest in sorted(set(want) | set(got)):
+        if dest not in got:
+            problems.append(f"{dest} in RIB but not programmed")
+        elif dest not in want:
+            problems.append(f"{dest} programmed but not in RIB")
+        elif want[dest] != got[dest]:
+            problems.append(f"{dest} nexthop mismatch")
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo(f"{len(got)} route(s) validated OK")
+
+
+@fib.command("sync")
+@click.argument("routes", nargs=-1)
+@_fib_agent_options
+def fib_sync(
+    routes: tuple, agent_host: str, agent_port: int, client_id: int
+) -> None:
+    """REPLACE this client's agent table with ROUTES
+    (prefix=if@addr[,if@addr...] ...); no args empties it."""
+    from openr_tpu.types import UnicastRoute
+
+    parsed = []
+    for spec in routes:
+        prefix, _, nhs = spec.partition("=")
+        if not nhs:
+            raise click.BadParameter(
+                f"route must be prefix=if@addr[,...], got {spec!r}"
+            )
+        parsed.append(
+            UnicastRoute(dest=prefix, next_hops=_parse_nexthops(nhs))
+        )
+    _fib_agent_call(
+        agent_host, agent_port, client_id, "sync_fib", parsed, []
+    )
+    click.echo(f"synced {len(parsed)} route(s)")
+
+
 @fib.command("snoop")
 @click.option("--count", default=0)
 @click.pass_context
@@ -738,6 +998,31 @@ def prefixmgr() -> None:
 @click.pass_context
 def prefixmgr_view(ctx: click.Context) -> None:
     _print(_call(ctx, "get_advertised_routes"))
+
+
+@prefixmgr.command("validate")
+@click.option("--area", default=Const.DEFAULT_AREA)
+@click.pass_context
+def prefixmgr_validate(ctx: click.Context, area: str) -> None:
+    """Every advertised prefix must be present in the KvStore under this
+    node's prefix: keys (breeze prefixmgr validate)."""
+    from openr_tpu.types import prefix_key
+
+    me = _call(ctx, "get_node_name")
+    advertised = {p["prefix"] for p in _call(ctx, "get_advertised_routes")}
+    dump = _call(
+        ctx, "dump_kv_store_area", prefix=f"prefix:{me}", area=area
+    )
+    problems = [
+        f"{p} advertised but missing from KvStore"
+        for p in sorted(advertised)
+        if prefix_key(me, p) not in dump
+    ]
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo(f"{len(advertised)} advertised prefix(es) validated OK")
 
 
 @prefixmgr.command("advertise")

@@ -403,3 +403,35 @@ def test_golden_lm_validate(live_node):
 
 def test_golden_spark_validate(live_node):
     check_golden("spark_validate", live_node, "spark", "validate")
+
+
+def test_golden_decision_partial_adj(live_node):
+    check_golden(
+        "decision_partial_adj", live_node, "decision", "partial-adj"
+    )
+
+
+def test_golden_kvstore_prefixes(live_node):
+    check_golden("kvstore_prefixes", live_node, "kvstore", "prefixes")
+
+
+def test_golden_kvstore_nodes(live_node):
+    check_golden("kvstore_nodes", live_node, "kvstore", "nodes")
+
+
+def test_golden_decision_validate(live_node):
+    check_golden("decision_validate", live_node, "decision", "validate")
+
+
+def test_golden_fib_validate(live_node):
+    check_golden("fib_validate", live_node, "fib", "validate")
+
+
+def test_golden_prefixmgr_validate(live_node):
+    check_golden(
+        "prefixmgr_validate", live_node, "prefixmgr", "validate"
+    )
+
+
+def test_golden_openr_summary(live_node):
+    check_golden("openr_summary", live_node, "openr", "summary")
