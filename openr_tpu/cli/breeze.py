@@ -550,77 +550,97 @@ def decision_path(
 
 
 @decision.command("validate")
-@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option(
+    "--area", default=None, help="area (default: every configured area)"
+)
 @click.pass_context
-def decision_validate(ctx: click.Context, area: str) -> None:
+def decision_validate(ctx: click.Context, area: Optional[str]) -> None:
     """Decision's LSDB view vs the KvStore source of truth: every adj /
     prefix advertisement in the store must be reflected in Decision's
     databases and vice versa (the reference's breeze decision
-    validate)."""
+    validate).  Multi-area nodes (e.g. an area border) validate each
+    configured area independently."""
     import json as _json
 
-    from openr_tpu.types import parse_adj_key, parse_prefix_key
+    from openr_tpu.types import (
+        normalize_prefix,
+        parse_adj_key,
+        parse_prefix_key,
+    )
 
-    dump = _call(ctx, "dump_kv_store_area", prefix="", area=area)
-    store_adj = {}
-    store_prefixes = set()
-    for key, v in dump.items():
-        n = parse_adj_key(key)
-        raw = v.get("value")
-        if n is not None and raw:
-            try:
-                blob = bytes.fromhex(raw) if v.get("_value_hex") else raw
-                db = _json.loads(blob)
-                store_adj[n] = len(db.get("adjacencies", []))
-            except Exception:
-                store_adj[n] = None
-            continue
-        parsed = parse_prefix_key(key)
-        if parsed is not None:
-            store_prefixes.add(parsed)
-    adj_dbs = _call(ctx, "get_decision_adjacency_dbs", area=area)
-    dec_adj = {
-        db.get("this_node_name"): len(db.get("adjacencies", []))
-        for db in adj_dbs
-    }
-    # {prefix: {"node@area": entry}} — flatten to (node, prefix) pairs,
+    areas = [area] if area else _call(ctx, "get_kv_store_areas")
+    # {prefix: {"node@area": entry}} — flattened per area below,
     # normalized like the store's prefix: keys (types.prefix_key zeroes
     # host bits, so '10.0.0.1/24' advertises as '10.0.0.0/24')
-    from openr_tpu.types import normalize_prefix
-
     received = _call(ctx, "get_received_routes")
-    dec_prefixes = {
-        (na.split("@", 1)[0], normalize_prefix(prefix))
-        for prefix, entries in received.items()
-        for na in entries
-    }
     problems = []
-    for n, cnt in store_adj.items():
-        if n not in dec_adj:
-            problems.append(f"adj db for {n} in store but not in Decision")
-        elif cnt is not None and cnt != dec_adj[n]:
+    tot_adj = tot_prefixes = 0
+    for a in areas:
+        dump = _call(ctx, "dump_kv_store_area", prefix="", area=a)
+        store_adj = {}
+        store_prefixes = set()
+        for key, v in dump.items():
+            n = parse_adj_key(key)
+            raw = v.get("value")
+            if n is not None and raw:
+                try:
+                    blob = (
+                        bytes.fromhex(raw) if v.get("_value_hex") else raw
+                    )
+                    db = _json.loads(blob)
+                    store_adj[n] = len(db.get("adjacencies", []))
+                except Exception:
+                    store_adj[n] = None
+                continue
+            parsed = parse_prefix_key(key)
+            if parsed is not None:
+                store_prefixes.add(parsed)
+        adj_dbs = _call(ctx, "get_decision_adjacency_dbs", area=a)
+        dec_adj = {
+            db.get("this_node_name"): len(db.get("adjacencies", []))
+            for db in adj_dbs
+        }
+        dec_prefixes = {
+            (na.split("@", 1)[0], normalize_prefix(prefix))
+            for prefix, entries in received.items()
+            for na in entries
+            if na.split("@", 1)[1] == a
+        }
+        tot_adj += len(store_adj)
+        tot_prefixes += len(store_prefixes)
+        for n, cnt in store_adj.items():
+            if n not in dec_adj:
+                problems.append(
+                    f"[{a}] adj db for {n} in store but not in Decision"
+                )
+            elif cnt is not None and cnt != dec_adj[n]:
+                problems.append(
+                    f"[{a}] adj count mismatch for {n}: store {cnt} vs "
+                    f"decision {dec_adj[n]}"
+                )
+        for n in dec_adj:
+            if n not in store_adj:
+                problems.append(
+                    f"[{a}] adj db for {n} in Decision but not in store"
+                )
+        for node, prefix in sorted(store_prefixes - dec_prefixes):
             problems.append(
-                f"adj count mismatch for {n}: store {cnt} vs decision "
-                f"{dec_adj[n]}"
+                f"[{a}] prefix {prefix} from {node} in store but not in "
+                "Decision"
             )
-    for n in dec_adj:
-        if n not in store_adj:
-            problems.append(f"adj db for {n} in Decision but not in store")
-    for node, prefix in sorted(store_prefixes - dec_prefixes):
-        problems.append(
-            f"prefix {prefix} from {node} in store but not in Decision"
-        )
-    for node, prefix in sorted(dec_prefixes - store_prefixes):
-        problems.append(
-            f"prefix {prefix} from {node} in Decision but not in store"
-        )
+        for node, prefix in sorted(dec_prefixes - store_prefixes):
+            problems.append(
+                f"[{a}] prefix {prefix} from {node} in Decision but not "
+                "in store"
+            )
     if problems:
         for line in problems:
             click.echo(f"FAIL {line}")
         raise SystemExit(1)
     click.echo(
-        f"decision view validated OK ({len(store_adj)} adj dbs, "
-        f"{len(store_prefixes)} prefix advertisements)"
+        f"decision view validated OK ({tot_adj} adj dbs, "
+        f"{tot_prefixes} prefix advertisements, "
+        f"{len(areas)} area(s))"
     )
 
 
@@ -1001,18 +1021,24 @@ def prefixmgr_view(ctx: click.Context) -> None:
 
 
 @prefixmgr.command("validate")
-@click.option("--area", default=Const.DEFAULT_AREA)
+@click.option(
+    "--area", default=None, help="area (default: every configured area)"
+)
 @click.pass_context
-def prefixmgr_validate(ctx: click.Context, area: str) -> None:
+def prefixmgr_validate(ctx: click.Context, area: Optional[str]) -> None:
     """Every advertised prefix must be present in the KvStore under this
-    node's prefix: keys (breeze prefixmgr validate)."""
+    node's prefix: keys in at least one configured area (breeze
+    prefixmgr validate)."""
     from openr_tpu.types import prefix_key
 
     me = _call(ctx, "get_node_name")
     advertised = {p["prefix"] for p in _call(ctx, "get_advertised_routes")}
-    dump = _call(
-        ctx, "dump_kv_store_area", prefix=f"prefix:{me}", area=area
-    )
+    areas = [area] if area else _call(ctx, "get_kv_store_areas")
+    dump: dict = {}
+    for a in areas:
+        dump.update(
+            _call(ctx, "dump_kv_store_area", prefix=f"prefix:{me}", area=a)
+        )
     problems = [
         f"{p} advertised but missing from KvStore"
         for p in sorted(advertised)
@@ -1190,7 +1216,11 @@ def decision_whatif(ctx: click.Context, links: tuple) -> None:
         failures.append(parts)
     resp = _call(ctx, "get_link_failure_whatif", link_failures=failures)
     if not resp["eligible"]:
-        click.echo("what-if engine not eligible (multi-area/KSP2/scalar)")
+        click.echo(
+            "what-if engine not eligible (KSP2 in use, or a scalar-only "
+            "deployment with a multi-area LSDB / a vantage fan-out "
+            "beyond the native engine's lane limit)"
+        )
         return
     for f in resp["failures"]:
         link = "-".join(f["link"])

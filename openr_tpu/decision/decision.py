@@ -411,16 +411,20 @@ class Decision(Actor):
     def get_link_failure_whatif(
         self, link_failures: List
     ) -> Optional[dict]:
-        """'Which of MY routes change if these links fail?' — one device
-        sweep over the candidate failures (the flagship what-if engine,
-        cached per LSDB generation).  None = ineligible (scalar-only
-        backend / multi-area / KSP2)."""
-        if isinstance(self.backend, ScalarBackend):
-            return None
+        """'Which of MY routes change if these links fail?' — one
+        warm-start sweep over the candidate failures (the flagship
+        what-if machinery, cached per LSDB generation).  None =
+        ineligible (KSP2 / unsupported algorithm; multi-area on a
+        scalar-only deployment, whose device kernels never load)."""
+        scalar_only = isinstance(self.backend, ScalarBackend)
         fleet = self._fleet()
         if not fleet.eligible(
             self.area_link_states, self.prefix_state, self._change_seq
         ):
+            return None
+        if scalar_only and len(self.area_link_states) != 1:
+            # the multi-area engine is device-only; a scalar deployment
+            # must never pull in the device stack
             return None
         if len(self.area_link_states) == 1:
             # single-area vantage: pick the warm-start engine by where
@@ -429,7 +433,12 @@ class Decision(Actor):
             # dispatch round trips it can only amortize over large
             # batches (the same measured-RT calibration the backend's
             # device cutover uses)
-            if self._use_native_whatif(len(link_failures)):
+            use_native = self._use_native_whatif(len(link_failures))
+            if scalar_only and not use_native:
+                # high-fanout vantage on a scalar-only deployment: the
+                # device fallback would load jax — stay ineligible
+                return None
+            if use_native:
                 if self._whatif_native_engine is None:
                     from openr_tpu.decision.whatif_api import (
                         NativeWhatIfEngine,
@@ -566,7 +575,9 @@ class Decision(Actor):
 
     def _use_native_whatif(self, num_failures: int) -> bool:
         """Native engine iff its estimated sweep cost undercuts the
-        device path's dispatch round trips for this query size."""
+        device path's dispatch round trips for this query size.  On a
+        scalar-only deployment the native engine is the ONLY eligible
+        one (no jax ever loads), so no probe runs."""
         from openr_tpu.decision.backend import (
             TpuBackend,
             estimate_scalar_work_items,
@@ -581,6 +592,8 @@ class Decision(Actor):
         # engine (which handles up to the largest degree bucket)
         if len(ls.links_from_node(me)) > MAX_LANES:
             return False
+        if isinstance(self.backend, ScalarBackend):
+            return True
         is_tpu = isinstance(self.backend, TpuBackend)
         rt_ms = self.backend.auto_dispatch_rt_ms if is_tpu else None
         if rt_ms is None:

@@ -496,7 +496,8 @@ class NativeWhatIfEngine:
     dispatch round trip (the same calibration the Decision backend's
     device cutover uses).  Output schema and selection semantics are
     identical to WhatIfApiEngine — selection runs the numpy mirror of
-    the device chain (ops.route_select.select_routes_numpy), so the two
+    the device chain (ops.np_select.select_routes_numpy, jax-free so
+    scalar-only deployments never load the device stack), so the two
     engines are interchangeable and parity-tested.
     """
 
@@ -513,7 +514,7 @@ class NativeWhatIfEngine:
             encode_prefix_candidates,
         )
         from openr_tpu.ops.native_spf import NativeSpf
-        from openr_tpu.ops.route_select import select_routes_numpy
+        from openr_tpu.ops.np_select import select_routes_numpy
 
         (area, ls), = area_link_states.items()
         key = (area, ls.topology_seq, change_seq)
@@ -569,7 +570,7 @@ class NativeWhatIfEngine:
         prefix_state,
         change_seq: int,
     ) -> Dict:
-        from openr_tpu.ops.route_select import select_routes_numpy
+        from openr_tpu.ops.np_select import select_routes_numpy
 
         ctx = self._engine_for(area_link_states, prefix_state, change_seq)
         me = self.solver.my_node_name

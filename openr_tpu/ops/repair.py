@@ -54,7 +54,9 @@ from typing import Tuple
 
 import numpy as np
 
-_BIGF = np.float32(3.4e38)
+from openr_tpu.ops.consts import BIG as _BIG_CONST
+
+_BIGF = np.float32(_BIG_CONST)
 
 
 # ---------------------------------------------------------------------------
@@ -254,7 +256,7 @@ def _repair_sweep_impl(
     import jax
     import jax.numpy as jnp
 
-    BIG = jnp.float32(3.4e38)
+    BIG = jnp.float32(_BIG_CONST)
     V = base_dist.shape[0]
     B = fails.shape[0]
     Bw = B // 32

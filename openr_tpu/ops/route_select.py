@@ -307,68 +307,7 @@ def spf_and_select(
     return jax.vmap(one)(edge_enabled, overloaded, soft, roots)
 
 
-def select_routes_numpy(
-    cand_node,  # [P, C] int32
-    cand_ok,  # [P, C] bool
-    drain_metric,  # [P, C] int32
-    path_pref,  # [P, C] int32
-    source_pref,  # [P, C] int32
-    distance,  # [P, C] int32
-    min_nexthop,  # [P, C] int32
-    dist,  # [V] f32
-    nh,  # [V, D] int8
-    overloaded,  # [V] bool
-    soft,  # [V] int32
-    root: int,
-):
-    """Host (numpy) mirror of ``select_routes_one`` — formula-for-formula
-    the same selection chain, for engines whose SPF side runs native
-    (the warm-start C++ sweep) where a device dispatch would cost more
-    than the whole solve.  Held to bit parity with the device kernel by
-    tests/test_sweep_select.py."""
-    import numpy as np
-
-    from openr_tpu.ops.spf import BIG as _BIG
-
-    BIGF = float(_BIG)
-    cdist = dist[cand_node]
-    reach = cand_ok & (cdist < BIGF)
-    hard = overloaded[cand_node]
-    nonhard = reach & ~hard
-    any_nonhard = nonhard.any(axis=1, keepdims=True)
-    use = np.where(any_nonhard, nonhard, reach)
-
-    drained = (drain_metric > 0) | (soft[cand_node] > 0)
-    not_drained = (~drained).astype(np.int32)
-    I32MIN, I32MAX = -(2**31), 2**31 - 1
-
-    def keep_max(mask, key):
-        best = np.max(np.where(mask, key, I32MIN), axis=1, keepdims=True)
-        return mask & (key == best)
-
-    def keep_min(mask, key):
-        best = np.min(np.where(mask, key, I32MAX), axis=1, keepdims=True)
-        return mask & (key == best)
-
-    use = keep_max(use, not_drained)
-    use = keep_max(use, path_pref)
-    use = keep_max(use, source_pref)
-    use = keep_min(use, distance)
-
-    self_wins = (use & (cand_node == root)).any(axis=1)
-    best_igp = np.min(np.where(use, cdist, BIGF), axis=1)
-    winners = use & (cdist == best_igp[:, None])
-    cand_nh = nh[cand_node]  # [P, C, D]
-    nh_out = np.max(
-        np.where(winners[:, :, None], cand_nh, np.int8(0)), axis=1
-    )
-    num_nh = nh_out.astype(np.int32).sum(axis=1)
-    req = np.max(np.where(use, min_nexthop, 0), axis=1)
-    valid = (
-        winners.any(axis=1)
-        & ~self_wins
-        & (best_igp < BIGF)
-        & (num_nh > 0)
-        & (num_nh >= req)
-    )
-    return valid, best_igp.astype(np.float32), nh_out, num_nh, use
+# numpy mirror of select_routes_one, re-exported for parity tests; it
+# lives in the jax-free ops.np_select so scalar-only deployments can
+# import it without loading the device stack
+from openr_tpu.ops.np_select import select_routes_numpy  # noqa: E402

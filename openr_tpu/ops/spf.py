@@ -36,7 +36,9 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-BIG = jnp.float32(3.4e38)  # effectively-infinite distance, f32-safe
+# effectively-infinite distance, f32-safe; a plain float (ops.consts)
+# so importing it never initializes a device backend
+from openr_tpu.ops.consts import BIG
 
 
 def _can_transit(overloaded: jnp.ndarray, root: jnp.ndarray) -> jnp.ndarray:

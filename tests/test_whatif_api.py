@@ -138,8 +138,15 @@ def test_whatif_unknown_link_and_scalar_backend():
     resp = d.get_link_failure_whatif([["node0", "node15"]])  # not adjacent
     assert resp["failures"][0]["error"] == "unknown link"
 
+    # scalar-only deployments now serve single-area what-if via the
+    # NATIVE engine (no jax loads) — same answers as the device path
     d2, _ = build_decision(backend_cls=ScalarBackend)
-    assert d2.get_link_failure_whatif([["node0", "node1"]]) is None
+    scalar_resp = d2.get_link_failure_whatif([["node0", "node1"]])
+    assert scalar_resp is not None and scalar_resp["eligible"]
+    assert d2._whatif_native_engine is not None
+    assert d2._whatif_engine is None  # device engine never constructed
+    tpu_resp = d.get_link_failure_whatif([["node0", "node1"]])
+    assert scalar_resp == tpu_resp
 
 
 def test_whatif_engine_cached_across_calls():
@@ -290,3 +297,58 @@ def test_native_vs_device_engines_random_worlds(seed):
     dev = WhatIfApiEngine(SpfSolver("node0")).run(failures, als, ps, 1)
     nat = NativeWhatIfEngine(SpfSolver("node0")).run(failures, als, ps, 1)
     assert nat == dev
+
+
+def test_scalar_whatif_never_touches_device_stack():
+    """A scalar-only deployment serving an operator what-if must stay
+    off the device stack entirely: no openr_tpu device module imported,
+    no PJRT backend initialized (over a tunneled TPU, backend init
+    alone stalls for seconds — this regressed once via a module-level
+    jnp constant in ops.spf pulled in through ops.route_select)."""
+    import subprocess
+    import sys
+
+    script = r"""
+import sys
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.backend import ScalarBackend
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import DecisionConfig
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.emulation.topology import grid_edges, build_adj_dbs
+from openr_tpu.types import PrefixEntry
+
+ls = LinkState("0")
+for db in build_adj_dbs(grid_edges(4)).values():
+    ls.update_adjacency_database(db)
+ps = PrefixState()
+for i in range(16):
+    ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+solver = SpfSolver("node0")
+d = Decision("node0", SimClock(), DecisionConfig(), ReplicateQueue("r"),
+             backend=ScalarBackend(solver), solver=solver)
+d.area_link_states = {"0": ls}
+d.prefix_state = ps
+resp = d.get_link_failure_whatif([["node0", "node1"]])
+assert resp and resp["eligible"], resp
+assert resp["failures"][0]["routes_changed"] > 0, resp
+for mod in ("openr_tpu.ops.spf", "openr_tpu.ops.route_select",
+            "openr_tpu.ops.repair", "openr_tpu.ops.sweep_select"):
+    assert mod not in sys.modules, f"device module leaked: {mod}"
+if "jax" in sys.modules:  # the axon shim preloads jax at startup
+    from jax._src import xla_bridge
+    assert not xla_bridge._backends, (
+        "PJRT backend initialized: %s" % list(xla_bridge._backends))
+print("CLEAN")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "CLEAN" in out.stdout
