@@ -1,0 +1,23 @@
+"""JAX platform-selection env enforcement.
+
+A site hook may force-select a tunneled accelerator platform regardless
+of ``JAX_PLATFORMS``, and its remote init can block indefinitely.  Entry
+points that must honor an explicit CPU request (bench validation runs,
+the driver's virtual-CPU-mesh dryrun) call this BEFORE the first backend
+lookup.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_platform_request() -> None:
+    """If the environment asks for a cpu-first platform list, pin jax to
+    the REQUESTED list (not cpu-only — ``cpu,tpu`` keeps its fallback)
+    before the first ``jax.devices()`` resolves a backend."""
+    requested = os.environ.get("JAX_PLATFORMS", "")
+    if requested.startswith("cpu"):
+        import jax
+
+        jax.config.update("jax_platforms", requested)
