@@ -19,39 +19,111 @@ from typing import Any, Optional
 import click
 
 from openr_tpu import constants as Const
-from openr_tpu.ctrl.client import OpenrCtrlClient
+from openr_tpu.ctrl.client import OpenrCtrlClient, OpenrCtrlError
 from openr_tpu.types import InitializationEvent, KvStorePeerState
 
 
-def _call(ctx: click.Context, method: str, **params: Any) -> Any:
+def _conn(ctx: click.Context):
+    """One shared (loop thread, connected client) per CLI invocation —
+    every _call/_call_many rides the SAME TCP/TLS connection, so
+    multi-RPC commands (openr validate, decision validate, config
+    compare) pay one handshake instead of one per request.  Torn down
+    via ctx.call_on_close when the command exits."""
+    state = ctx.obj.get("_conn")
+    if state is not None:
+        return state
+    import concurrent.futures
+    import threading
+
     host, port = ctx.obj["host"], ctx.obj["port"]
     tls = ctx.obj.get("tls")
+    loop = asyncio.new_event_loop()
+    ready: concurrent.futures.Future = concurrent.futures.Future()
 
-    async def go():
-        async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
-            return await client.call(method, **params)
+    def runner():
+        asyncio.set_event_loop(loop)
 
-    return asyncio.run(go())
+        async def connect():
+            client = OpenrCtrlClient(host=host, port=port, tls=tls)
+            await client.connect()
+            return client
+
+        try:
+            ready.set_result(loop.run_until_complete(connect()))
+        except BaseException as e:  # surfaced to the caller thread
+            ready.set_exception(e)
+            return
+        loop.run_forever()
+
+    t = threading.Thread(target=runner, daemon=True, name="breeze-conn")
+    t.start()
+    client = ready.result()
+    state = (loop, client)
+    ctx.obj["_conn"] = state
+
+    def cleanup():
+        async def close():
+            await client.close()
+
+        asyncio.run_coroutine_threadsafe(close(), loop).result(timeout=5)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        if not t.is_alive():
+            loop.close()  # silences the BaseEventLoop.__del__ warning
+        ctx.obj.pop("_conn", None)
+
+    # find the root context so nested-group commands clean up once
+    root = ctx
+    while root.parent is not None:
+        root = root.parent
+    root.call_on_close(cleanup)
+    return state
+
+
+def _call(ctx: click.Context, method: str, **params: Any) -> Any:
+    loop, client = _conn(ctx)
+    try:
+        return asyncio.run_coroutine_threadsafe(
+            client.call(method, **params), loop
+        ).result()
+    except (OSError, OpenrCtrlError) as e:
+        # a dropped connection must not poison every later RPC of a
+        # multi-call command (openr validate runs exactly when things
+        # are broken): rebuild the shared connection and retry ONCE.
+        # Server-side errors (method failures) don't match this filter
+        # and propagate unchanged.
+        if isinstance(e, OpenrCtrlError) and "connection closed" not in str(e):
+            raise
+        ctx.obj.pop("_conn", None)
+        loop, client = _conn(ctx)
+        return asyncio.run_coroutine_threadsafe(
+            client.call(method, **params), loop
+        ).result()
 
 
 def _call_many(ctx: click.Context, calls) -> list:
-    """Issue several RPCs over ONE connection (one event loop + TCP/TLS
-    handshake), for commands that compose many reads."""
-    host, port = ctx.obj["host"], ctx.obj["port"]
-    tls = ctx.obj.get("tls")
-
-    async def go():
-        async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
-            out = []
-            for method, params in calls:
-                out.append(await client.call(method, **(params or {})))
-            return out
-
-    return asyncio.run(go())
+    """Issue several RPCs over the shared connection."""
+    return [
+        _call(ctx, method, **(params or {})) for method, params in calls
+    ]
 
 
 def _print(obj: Any) -> None:
     click.echo(json.dumps(obj, indent=2, sort_keys=True, default=str))
+
+
+def _run_bounded(coro, duration: int) -> None:
+    """Run a snoop coroutine, hard-bounded by --duration seconds: the
+    timeout must fire even when the stream is completely idle (a
+    deadline check inside the async-for body would never run)."""
+
+    async def bounded():
+        try:
+            await asyncio.wait_for(coro, timeout=duration or None)
+        except asyncio.TimeoutError:
+            pass
+
+    asyncio.run(bounded())
 
 
 @click.group()
@@ -160,6 +232,74 @@ def init_duration(ctx: click.Context) -> None:
     click.echo(_call(ctx, "get_initialization_duration_ms"))
 
 
+@openr.command("validate")
+@click.option(
+    "--suppress-error/--print-all-info",
+    "suppress",
+    default=False,
+    help="print only failing modules",
+)
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def openr_validate(ctx: click.Context, suppress: bool, json_out: bool) -> None:
+    """Run EVERY module's validation checks and summarize
+    (the reference's breeze openr validate,
+    py/openr/cli/clis/openr.py): spark, link-monitor, kvstore,
+    decision, prefixmgr, fib — exit 1 if any module fails."""
+    # fetch the area list + full per-area store dumps ONCE; three of the
+    # module validators read them (the kvstore and decision checks each
+    # scan the whole store)
+    def fetch_dumps():
+        areas = _call(ctx, "get_kv_store_areas")
+        return {
+            a: _call(ctx, "dump_kv_store_area", prefix="", area=a)
+            for a in areas
+        }
+
+    try:
+        dumps = fetch_dumps()
+    except Exception:
+        dumps = None  # validators fall back to their own fetches
+    modules = [
+        ("spark", lambda: _spark_validate_problems(ctx)),
+        ("link-monitor", lambda: _lm_validate_problems(ctx)),
+        ("kvstore", lambda: _kvstore_validate_problems(ctx, None, dumps)),
+        ("decision", lambda: _decision_validate_problems(ctx, (), dumps)),
+        ("prefixmgr", lambda: _prefixmgr_validate_problems(
+            ctx, None, all_areas=sorted(dumps) if dumps else None
+        )),
+        ("fib", lambda: _fib_validate_problems(ctx)),
+    ]
+    failed = 0
+    results: dict = {}
+    for name, run in modules:
+        try:
+            problems, summary = run()
+        except Exception as e:
+            # a dead module must not stop the aggregate health report —
+            # this command's whole purpose is to run when things break
+            problems, summary = [f"validator error: {e}"], ""
+        results[name] = {
+            "ok": not problems,
+            "problems": problems,
+            "summary": summary,
+        }
+        if problems:
+            failed += 1
+            if not json_out:
+                click.echo(f"[FAIL] {name}")
+                for line in problems:
+                    click.echo(f"  {line}")
+        elif not suppress and not json_out:
+            click.echo(f"[PASS] {name}: {summary}")
+    if json_out:
+        _print({"ok": not failed, "modules": results})
+    if failed:
+        raise SystemExit(1)
+    if suppress and not json_out:
+        click.echo("all modules validated OK")
+
+
 # ------------------------------------------------------------------ config
 
 
@@ -191,6 +331,63 @@ def config_dryrun(ctx: click.Context, file: str) -> None:
     click.echo(_call(ctx, "dryrun_config", file=file))
 
 
+def _flatten_config(obj: Any, path: str = "") -> dict:
+    """{dotted.path: leaf} over a nested config dict (lists compared
+    whole — ordering is meaningful for e.g. area lists)."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            out.update(_flatten_config(v, f"{path}.{k}" if path else k))
+        return out
+    return {path: obj}
+
+
+@config.command("compare")
+@click.argument("file")
+@click.pass_context
+def config_compare(ctx: click.Context, file: str) -> None:
+    """Diff FILE (normalized through the loader, like dryrun) against
+    the RUNNING config (the reference's breeze config compare)."""
+    loaded = _flatten_config(json.loads(_call(ctx, "dryrun_config", file=file)))
+    running = _flatten_config(json.loads(_call(ctx, "get_running_config")))
+    diffs = []
+    for key in sorted(set(loaded) | set(running)):
+        a, b = running.get(key, "<absent>"), loaded.get(key, "<absent>")
+        if a != b:
+            diffs.append(f"{key}: running={a!r} file={b!r}")
+    if diffs:
+        for line in diffs:
+            click.echo(line)
+        raise SystemExit(1)
+    click.echo("configs match")
+
+
+@config.command("link-monitor")
+@click.pass_context
+def config_link_monitor(ctx: click.Context) -> None:
+    """Persisted link-monitor state (drain/overload + metric overrides)
+    from the config store — the reference's breeze config
+    link-monitor (persisted LinkMonitorState blob)."""
+    me = _call(ctx, "get_node_name")
+    try:
+        _print(_call(ctx, "get_config_key", key=f"link-monitor-config:{me}"))
+    except OpenrCtrlError as e:
+        # only the missing-key case is "clean node"; transport/server
+        # failures must propagate, not masquerade as an undrained node
+        if "no config key" not in str(e):
+            raise
+        click.echo("no persisted link-monitor state")
+
+
+@config.command("prefix-manager")
+@click.pass_context
+def config_prefix_manager(ctx: click.Context) -> None:
+    """Prefix-manager origination view (the reference's breeze config
+    prefix-manager; origination here is config-driven rather than a
+    persisted PrefixDatabase blob)."""
+    _print(_call(ctx, "get_originated_prefixes"))
+
+
 # ----------------------------------------------------------------- monitor
 
 
@@ -210,10 +407,40 @@ def monitor_counters(ctx: click.Context, prefix: str) -> None:
 
 
 @monitor.command("logs")
+@click.option("--prefix", default="", help="only logs whose text contains this")
+@click.option("--json/--no-json", "json_out", default=False)
 @click.pass_context
-def monitor_logs(ctx: click.Context) -> None:
-    for line in _call(ctx, "get_event_logs"):
-        click.echo(line)
+def monitor_logs(ctx: click.Context, prefix: str, json_out: bool) -> None:
+    logs = [
+        line
+        for line in _call(ctx, "get_event_logs")
+        if not prefix or prefix in str(line)
+    ]
+    if json_out:
+        _print(logs)
+    else:
+        for line in logs:
+            click.echo(line)
+
+
+@monitor.command("statistics")
+@click.pass_context
+def monitor_statistics(ctx: click.Context) -> None:
+    """Process-level stats (the reference's breeze monitor statistics):
+    the process.* gauges SystemMetrics publishes plus per-module
+    heartbeat counters."""
+    counters = _call(ctx, "get_counters")
+    stats = {
+        k: v
+        for k, v in sorted(counters.items())
+        if k.startswith("process.") or k.endswith(".heartbeat")
+    }
+    if not stats:
+        click.echo("no process statistics published yet")
+        return
+    width = max(len(k) for k in stats)
+    for k, v in stats.items():
+        click.echo(f"{k:<{width}}  {v}")
 
 
 # ----------------------------------------------------------------- kvstore
@@ -266,9 +493,16 @@ def kvstore_keys(
 @click.option("--area", default=Const.DEFAULT_AREA)
 @click.option("--nodes", "node_filter", default="",
               help="comma-separated node filter")
+@click.option("--prefix", "-p", "prefix_filter", default="",
+              help="exact-match prefix filter (reference -p)")
+@click.option("--json/--no-json", "json_out", default=False)
 @click.pass_context
 def kvstore_prefixes(
-    ctx: click.Context, area: str, node_filter: str
+    ctx: click.Context,
+    area: str,
+    node_filter: str,
+    prefix_filter: str,
+    json_out: bool,
 ) -> None:
     """Advertised prefixes per node, decoded from prefix: keys."""
     from openr_tpu.types import parse_prefix_key
@@ -287,7 +521,12 @@ def kvstore_prefixes(
         node, prefix = parsed
         if want and node not in want:
             continue
+        if prefix_filter and prefix != prefix_filter:
+            continue
         per_node.setdefault(node, []).append(prefix)
+    if json_out:
+        _print({n: sorted(ps) for n, ps in per_node.items()})
+        return
     for node in sorted(per_node):
         click.echo(f"{node}:")
         for p in sorted(per_node[node]):
@@ -414,23 +653,45 @@ def kvstore_compare(ctx: click.Context, area: str, peer: str) -> None:
 def kvstore_validate(ctx: click.Context, area: str) -> None:
     """Local consistency checks over the store (key shapes, originator
     sanity, TTL bounds) — the reference's breeze kvstore validate."""
-    dump = _call(ctx, "dump_kv_store_area", prefix="", area=area)
-    problems = []
-    for k, v in sorted(dump.items()):
-        if not (k.startswith("adj:") or k.startswith("prefix:")):
-            problems.append(f"{k}: unrecognized key namespace")
-        if not v.get("originator_id"):
-            problems.append(f"{k}: missing originator")
-        if v.get("version", 0) <= 0:
-            problems.append(f"{k}: non-positive version")
-        ttl = v.get("ttl", 0)
-        if ttl != Const.TTL_INFINITY and ttl <= 0:
-            problems.append(f"{k}: expired/invalid ttl {ttl}")
+    problems, summary = _kvstore_validate_problems(ctx, area)
     if problems:
         for line in problems:
             click.echo(f"FAIL {line}")
         raise SystemExit(1)
-    click.echo(f"{len(dump)} keys validated OK")
+    click.echo(f"{summary} validated OK")
+
+
+def _kvstore_validate_problems(
+    ctx: click.Context, area: Optional[str], dumps: Optional[dict] = None
+):
+    """(problems, summary) for one area, or every configured area when
+    area is None.  ``dumps`` ({area: full store dump}) skips refetching
+    when the caller already holds the stores (openr validate)."""
+    if dumps is not None and area is None:
+        areas = sorted(dumps)
+    else:
+        areas = [area] if area else _call(ctx, "get_kv_store_areas")
+    problems = []
+    total = 0
+    for a in areas:
+        dump = (
+            dumps[a]
+            if dumps is not None and a in dumps
+            else _call(ctx, "dump_kv_store_area", prefix="", area=a)
+        )
+        total += len(dump)
+        tag = f"[{a}] " if len(areas) > 1 else ""
+        for k, v in sorted(dump.items()):
+            if not (k.startswith("adj:") or k.startswith("prefix:")):
+                problems.append(f"{tag}{k}: unrecognized key namespace")
+            if not v.get("originator_id"):
+                problems.append(f"{tag}{k}: missing originator")
+            if v.get("version", 0) <= 0:
+                problems.append(f"{tag}{k}: non-positive version")
+            ttl = v.get("ttl", 0)
+            if ttl != Const.TTL_INFINITY and ttl <= 0:
+                problems.append(f"{tag}{k}: expired/invalid ttl {ttl}")
+    return problems, f"{total} keys in {len(areas)} area(s)"
 
 
 @kvstore.command("key-vals")
@@ -481,28 +742,128 @@ def kvstore_flood_topo(ctx: click.Context, area: str) -> None:
 @click.option("--area", default=None)
 @click.option("--prefix", "prefixes", multiple=True)
 @click.option("--count", default=0, help="stop after N publications (0=forever)")
+@click.option("--duration", default=0, help="stop after N seconds (0=forever)")
+@click.option(
+    "--delta/--no-delta",
+    default=True,
+    help="print incremental changes (default) or the full merged view",
+)
+@click.option(
+    "--ttl/--no-ttl", "show_ttl", default=False, help="print ttl-only updates"
+)
+@click.option(
+    "--regexes",
+    "-r",
+    multiple=True,
+    help="key regex filter (repeatable; see --match-all/--match-any)",
+)
+@click.option(
+    "--match-all/--match-any",
+    "match_all",
+    default=False,
+    help="key must match all regexes / any regex (default any)",
+)
+@click.option(
+    "--originator-ids",
+    "-o",
+    "originators",
+    multiple=True,
+    help="only changes originated by these node names",
+)
+@click.option(
+    "--print-initial/--no-print-initial",
+    default=False,
+    help="print the initial full dump before the delta stream",
+)
 @click.pass_context
 def kvstore_snoop(
-    ctx: click.Context, area: Optional[str], prefixes: tuple, count: int
+    ctx: click.Context,
+    area: Optional[str],
+    prefixes: tuple,
+    count: int,
+    duration: int,
+    delta: bool,
+    show_ttl: bool,
+    regexes: tuple,
+    match_all: bool,
+    originators: tuple,
+    print_initial: bool,
 ) -> None:
-    """Live-subscribe to KvStore deltas (reference: KvStoreSnooper)."""
+    """Live-subscribe to KvStore deltas (reference: KvStoreSnooper /
+    breeze kvstore snoop options, py/openr/cli/clis/kvstore.py)."""
+    import re as _re
+
     host, port = ctx.obj["host"], ctx.obj["port"]
     tls = ctx.obj.get("tls")
+    pats = [_re.compile(r) for r in regexes]
+
+    def key_ok(k: str) -> bool:
+        if not pats:
+            return True
+        hits = (p.search(k) is not None for p in pats)
+        return all(hits) if match_all else any(hits)
+
+    def filter_pub(pub: dict) -> dict:
+        """Apply key-regex + originator + ttl-only filters to one
+        publication's key_vals."""
+        kvs = pub.get("key_vals", pub) or {}
+        out = {}
+        for k, v in kvs.items():
+            if not key_ok(k):
+                continue
+            if originators and v.get("originator_id") not in originators:
+                continue
+            if not show_ttl and v.get("value") is None and "ttl" in v:
+                continue  # ttl-refresh only
+            out[k] = v
+        return out
 
     async def go():
+        merged: dict = {}
         async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
+            # the stream opens with ONE full-dump publication PER AREA
+            # (ctrl subscribe_and_get_kv_store), then live deltas
+            init_left = (
+                1
+                if area
+                else len(await client.call("get_kv_store_areas"))
+            )
             seen = 0
-            async for pub in client.stream(
+            stream = client.stream(
                 "subscribe_and_get_kv_store",
                 key_prefixes=list(prefixes),
                 areas=[area] if area else None,
-            ):
-                click.echo(json.dumps(pub, sort_keys=True, default=str))
-                seen += 1
-                if count and seen >= count:
-                    return
+            )
+            async for pub in stream:
+                kvs = filter_pub(pub)
+                if init_left > 0:
+                    init_left -= 1
+                    merged.update(kvs)
+                    if print_initial:
+                        click.echo(
+                            json.dumps(
+                                {**pub, "key_vals": kvs},
+                                sort_keys=True,
+                                default=str,
+                            )
+                        )
+                        seen += 1
+                        if count and seen >= count:
+                            return
+                elif kvs:
+                    merged.update(kvs)
+                    click.echo(
+                        json.dumps(
+                            kvs if delta else merged,
+                            sort_keys=True,
+                            default=str,
+                        )
+                    )
+                    seen += 1
+                    if count and seen >= count:
+                        return
 
-    asyncio.run(go())
+    _run_bounded(go(), duration)
 
 
 # ---------------------------------------------------------------- decision
@@ -515,12 +876,58 @@ def decision() -> None:
 
 @decision.command("routes")
 @click.option("--node", default=None, help="compute for another node")
+@click.option(
+    "--nodes",
+    default="",
+    help="comma-separated node list, or 'all' for every node in the LSDB",
+)
+@click.option(
+    "--labels", "-l", "labels", is_flag=True, help="show MPLS label routes only"
+)
+@click.argument("prefixes", nargs=-1)
 @click.pass_context
-def decision_routes(ctx: click.Context, node: Optional[str]) -> None:
-    if node:
-        _print(_call(ctx, "get_route_db_computed", node=node))
+def decision_routes(
+    ctx: click.Context,
+    node: Optional[str],
+    nodes: str,
+    labels: bool,
+    prefixes: tuple,
+) -> None:
+    """Computed routes; PREFIXES filter the unicast table
+    (reference options: --nodes/--labels/prefixes,
+    py/openr/cli/clis/decision.py)."""
+    if nodes == "all":
+        # adjacency dbs are per (node, area): dedupe border nodes or a
+        # multi-area node's route db would be recomputed once per area
+        node_list = sorted(
+            {
+                db["this_node_name"]
+                for db in _call(ctx, "get_decision_adjacency_dbs")
+            }
+        )
+    elif nodes:
+        node_list = [n for n in nodes.split(",") if n]
+    elif node:
+        node_list = [node]
     else:
-        _print(_call(ctx, "get_route_db"))
+        node_list = []
+
+    def filtered(db: dict) -> dict:
+        return _filter_route_db(db, ",".join(prefixes), labels)
+
+    if not node_list:
+        _print(filtered(_call(ctx, "get_route_db")))
+    elif len(node_list) == 1:
+        _print(
+            filtered(_call(ctx, "get_route_db_computed", node=node_list[0]))
+        )
+    else:
+        _print(
+            {
+                n: filtered(_call(ctx, "get_route_db_computed", node=n))
+                for n in node_list
+            }
+        )
 
 
 @decision.command("path")
@@ -529,12 +936,22 @@ def decision_routes(ctx: click.Context, node: Optional[str]) -> None:
     "--dst", default="", help="destination node or prefix (default: this node)"
 )
 @click.option("--max-hop", default=256, help="max hop count")
+@click.option(
+    "--area", default=None, help="only traverse nexthops learned in this area"
+)
 @click.pass_context
 def decision_path(
-    ctx: click.Context, src: str, dst: str, max_hop: int
+    ctx: click.Context, src: str, dst: str, max_hop: int, area: Optional[str]
 ) -> None:
     """Enumerate src->dst forwarding paths over computed RouteDbs."""
-    res = _call(ctx, "get_decision_paths", src=src, dst=dst, max_hop=max_hop)
+    res = _call(
+        ctx,
+        "get_decision_paths",
+        src=src,
+        dst=dst,
+        max_hop=max_hop,
+        area=area,
+    )
     if res.get("error"):
         raise click.ClickException(res["error"])
     metric = (
@@ -553,13 +970,51 @@ def decision_path(
 @click.option(
     "--area", default=None, help="area (default: every configured area)"
 )
+@click.option(
+    "--suppress-error/--print-all-info",
+    "suppress",
+    default=False,
+    help="print nothing on success",
+)
+@click.option("--json/--no-json", "json_out", default=False)
+@click.argument("areas_args", nargs=-1)
 @click.pass_context
-def decision_validate(ctx: click.Context, area: Optional[str]) -> None:
+def decision_validate(
+    ctx: click.Context,
+    area: Optional[str],
+    suppress: bool,
+    json_out: bool,
+    areas_args: tuple,
+) -> None:
     """Decision's LSDB view vs the KvStore source of truth: every adj /
     prefix advertisement in the store must be reflected in Decision's
     databases and vice versa (the reference's breeze decision
     validate).  Multi-area nodes (e.g. an area border) validate each
-    configured area independently."""
+    configured area independently; trailing AREA arguments restrict
+    the check (reference: validate [areas]...)."""
+    wanted = tuple(dict.fromkeys(
+        ([area] if area else []) + list(areas_args)
+    ))
+    problems, summary = _decision_validate_problems(ctx, wanted)
+    if json_out:
+        _print({"ok": not problems, "problems": problems, "summary": summary})
+        if problems:
+            raise SystemExit(1)
+        return
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    if not suppress:
+        click.echo(f"decision view validated OK ({summary})")
+
+
+def _decision_validate_problems(
+    ctx: click.Context, wanted: tuple, dumps: Optional[dict] = None
+):
+    """(problems, summary): Decision's databases vs the KvStore, for
+    the given areas (all configured areas when empty).  ``dumps`` as in
+    _kvstore_validate_problems."""
     import json as _json
 
     from openr_tpu.types import (
@@ -568,7 +1023,12 @@ def decision_validate(ctx: click.Context, area: Optional[str]) -> None:
         parse_prefix_key,
     )
 
-    areas = [area] if area else _call(ctx, "get_kv_store_areas")
+    if wanted:
+        areas = list(wanted)
+    elif dumps is not None:
+        areas = sorted(dumps)
+    else:
+        areas = _call(ctx, "get_kv_store_areas")
     # {prefix: {"node@area": entry}} — flattened per area below,
     # normalized like the store's prefix: keys (types.prefix_key zeroes
     # host bits, so '10.0.0.1/24' advertises as '10.0.0.0/24')
@@ -576,7 +1036,11 @@ def decision_validate(ctx: click.Context, area: Optional[str]) -> None:
     problems = []
     tot_adj = tot_prefixes = 0
     for a in areas:
-        dump = _call(ctx, "dump_kv_store_area", prefix="", area=a)
+        dump = (
+            dumps[a]
+            if dumps is not None and a in dumps
+            else _call(ctx, "dump_kv_store_area", prefix="", area=a)
+        )
         store_adj = {}
         store_prefixes = set()
         for key, v in dump.items():
@@ -594,6 +1058,19 @@ def decision_validate(ctx: click.Context, area: Optional[str]) -> None:
                 continue
             parsed = parse_prefix_key(key)
             if parsed is not None:
+                # a withdrawn prefix floods a deletePrefix tombstone that
+                # sits in the store until TTL expiry; Decision (rightly)
+                # drops it immediately, so only count LIVE advertisements
+                if raw:
+                    try:
+                        blob = (
+                            bytes.fromhex(raw) if v.get("_value_hex") else raw
+                        )
+                        db = _json.loads(blob)
+                        if db.get("delete_prefix"):
+                            continue
+                    except Exception:
+                        pass
                 store_prefixes.add(parsed)
         adj_dbs = _call(ctx, "get_decision_adjacency_dbs", area=a)
         dec_adj = {
@@ -633,14 +1110,9 @@ def decision_validate(ctx: click.Context, area: Optional[str]) -> None:
                 f"[{a}] prefix {prefix} from {node} in Decision but not "
                 "in store"
             )
-    if problems:
-        for line in problems:
-            click.echo(f"FAIL {line}")
-        raise SystemExit(1)
-    click.echo(
-        f"decision view validated OK ({tot_adj} adj dbs, "
-        f"{tot_prefixes} prefix advertisements, "
-        f"{len(areas)} area(s))"
+    return problems, (
+        f"{tot_adj} adj dbs, {tot_prefixes} prefix advertisements, "
+        f"{len(areas)} area(s)"
     )
 
 
@@ -667,9 +1139,67 @@ def decision_partial_adj(ctx: click.Context, area: Optional[str]) -> None:
 
 @decision.command("adj")
 @click.option("--area", default=None)
+@click.option(
+    "--nodes", default="", help="comma-separated node filter (default: all)"
+)
+@click.option(
+    "--areas", "-a", "areas_multi", multiple=True, help="area filter (repeatable)"
+)
+@click.option(
+    "--bidir/--no-bidir",
+    default=True,
+    help="only adjacencies reported by BOTH endpoints (default)",
+)
+@click.option("--json/--no-json", "json_out", default=False)
 @click.pass_context
-def decision_adj(ctx: click.Context, area: Optional[str]) -> None:
-    dbs = _call(ctx, "get_decision_adjacency_dbs", area=area)
+def decision_adj(
+    ctx: click.Context,
+    area: Optional[str],
+    nodes: str,
+    areas_multi: tuple,
+    bidir: bool,
+    json_out: bool,
+) -> None:
+    """Adjacency databases from Decision's LSDB (reference options:
+    --nodes/--areas/--bidir/--json, py/openr/cli/clis/decision.py)."""
+    want_areas = list(areas_multi) or ([area] if area else [None])
+    dbs = []
+    for a in want_areas:
+        dbs.extend(_call(ctx, "get_decision_adjacency_dbs", area=a))
+    if bidir:
+        # keep an adjacency only when its reverse is also advertised
+        # (within the same area) — one-sided entries are usually a link
+        # mid-negotiation; `partial-adj` surfaces them explicitly.
+        # The reverse-direction set is built over ALL dbs BEFORE any
+        # --nodes narrowing, or a single-node view would lose every
+        # adjacency (its peers' dbs hold the reverse entries)
+        seen = {
+            (db.get("area", ""), db["this_node_name"], adj["other_node_name"])
+            for db in dbs
+            for adj in db.get("adjacencies", [])
+        }
+        dbs = [
+            {
+                **db,
+                "adjacencies": [
+                    adj
+                    for adj in db.get("adjacencies", [])
+                    if (
+                        db.get("area", ""),
+                        adj["other_node_name"],
+                        db["this_node_name"],
+                    )
+                    in seen
+                ],
+            }
+            for db in dbs
+        ]
+    node_filter = {n for n in nodes.split(",") if n}
+    if node_filter:
+        dbs = [db for db in dbs if db["this_node_name"] in node_filter]
+    if json_out:
+        _print(dbs)
+        return
     for db in dbs:
         click.echo(
             f"{db['this_node_name']} (area {db.get('area', '')}, "
@@ -713,10 +1243,63 @@ def fib() -> None:
     """Programmed routes."""
 
 
+def _filter_route_db(db: dict, prefixes: str, labels: bool) -> dict:
+    """Apply the reference CLI's route-db filters: a comma-separated
+    exact-match dest filter, and --labels (drop the unicast table,
+    leaving the MPLS one)."""
+    want = {p for p in prefixes.split(",") if p}
+    if want:
+        db = {
+            **db,
+            "unicast_routes": [
+                r
+                for r in db.get("unicast_routes", [])
+                if r.get("dest") in want
+            ],
+        }
+    if labels:
+        db = {k: v for k, v in db.items() if k != "unicast_routes"}
+    return db
+
+
 @fib.command("routes")
+@click.option(
+    "--prefixes",
+    "-p",
+    default="",
+    help="comma-separated prefix filter (exact match)",
+)
+@click.option(
+    "--labels", "-l", "labels", is_flag=True, help="show MPLS label routes only"
+)
+@click.option("--client-id", default=None, type=int,
+              help="FIB agent client id (standalone agent tables)")
+@click.option("--agent-host", default="127.0.0.1",
+              help="FIB agent host (with --client-id)")
+@click.option("--agent-port", default=60100,
+              help="FIB agent port (with --client-id)")
 @click.pass_context
-def fib_routes(ctx: click.Context) -> None:
-    _print(_call(ctx, "get_fib_routes"))
+def fib_routes(
+    ctx: click.Context,
+    prefixes: str,
+    labels: bool,
+    client_id: Optional[int],
+    agent_host: str,
+    agent_port: int,
+) -> None:
+    """Programmed routes (reference options: --prefixes/--labels/
+    --client-id, py/openr/cli/clis/fib.py)."""
+    if client_id is not None:
+        # standalone agent table for that client id, via the agent RPC
+        # (raw list form also available as `fib routes-installed`);
+        # the -p/--labels filters apply to this view too
+        routes = _fib_agent_call(
+            agent_host, agent_port, client_id, "get_route_table"
+        )
+        db = {"unicast_routes": [r.to_wire() for r in routes]}
+        _print(_filter_route_db(db, prefixes, labels))
+        return
+    _print(_filter_route_db(_call(ctx, "get_fib_routes"), prefixes, labels))
 
 
 def _fib_agent_call(host: str, port: int, client_id: int, fn_name: str, *args):
@@ -839,10 +1422,26 @@ def fib_unicast(ctx: click.Context, prefixes: tuple) -> None:
 
 
 @fib.command("validate")
+@click.option(
+    "--suppress-error/--print-all-info",
+    "suppress",
+    default=False,
+    help="print nothing on success",
+)
 @click.pass_context
-def fib_validate(ctx: click.Context) -> None:
+def fib_validate(ctx: click.Context, suppress: bool) -> None:
     """Programmed FIB vs Decision's computed RIB: same unicast dests and
     nexthop sets, and the FIB synced (breeze fib validate)."""
+    problems, summary = _fib_validate_problems(ctx)
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    if not suppress:
+        click.echo(f"{summary} validated OK")
+
+
+def _fib_validate_problems(ctx: click.Context):
     rib = _call(ctx, "get_route_db")
     fibdb = _call(ctx, "get_fib_routes")
 
@@ -866,11 +1465,7 @@ def fib_validate(ctx: click.Context) -> None:
             problems.append(f"{dest} programmed but not in RIB")
         elif want[dest] != got[dest]:
             problems.append(f"{dest} nexthop mismatch")
-    if problems:
-        for line in problems:
-            click.echo(f"FAIL {line}")
-        raise SystemExit(1)
-    click.echo(f"{len(got)} route(s) validated OK")
+    return problems, f"{len(got)} route(s)"
 
 
 @fib.command("sync")
@@ -901,22 +1496,67 @@ def fib_sync(
 
 @fib.command("snoop")
 @click.option("--count", default=0)
+@click.option(
+    "--duration", "-d", default=0, help="stop after N seconds (0=forever)"
+)
+@click.option(
+    "--initial-dump/--no-initial-dump",
+    default=True,
+    help="print the initial route snapshot before the delta stream",
+)
+@click.option(
+    "--prefixes",
+    "-p",
+    default="",
+    help="comma-separated prefix filter on route updates",
+)
 @click.pass_context
-def fib_snoop(ctx: click.Context, count: int) -> None:
-    """Live-subscribe to FIB deltas (subscribeAndGetFib)."""
+def fib_snoop(
+    ctx: click.Context,
+    count: int,
+    duration: int,
+    initial_dump: bool,
+    prefixes: str,
+) -> None:
+    """Live-subscribe to FIB deltas (subscribeAndGetFib; reference
+    options --duration/--initial-dump/--prefixes,
+    py/openr/cli/clis/fib.py)."""
     host, port = ctx.obj["host"], ctx.obj["port"]
     tls = ctx.obj.get("tls")
+    want = {p for p in prefixes.split(",") if p}
+
+    def filter_delta(delta: dict) -> dict:
+        if not want:
+            return delta
+        out = dict(delta)
+        for k in ("unicast_routes_to_update", "unicast_routes"):
+            if k in out and isinstance(out[k], list):
+                out[k] = [
+                    r for r in out[k] if r.get("dest") in want
+                ]
+        if "unicast_routes_to_delete" in out:
+            out["unicast_routes_to_delete"] = [
+                p for p in out["unicast_routes_to_delete"] if p in want
+            ]
+        return out
 
     async def go():
         async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
             seen = 0
+            first = True
             async for delta in client.stream("subscribe_and_get_fib"):
-                click.echo(json.dumps(delta, sort_keys=True, default=str))
+                if first and not initial_dump:
+                    first = False
+                    continue
+                first = False
+                click.echo(
+                    json.dumps(filter_delta(delta), sort_keys=True, default=str)
+                )
                 seen += 1
                 if count and seen >= count:
                     return
 
-    asyncio.run(go())
+    _run_bounded(go(), duration)
 
 
 # -------------------------------------------------------------------- perf
@@ -947,44 +1587,90 @@ def lm() -> None:
 
 
 @lm.command("links")
+@click.option(
+    "--only-suppressed",
+    is_flag=True,
+    help="only interfaces held down by flap backoff",
+)
 @click.pass_context
-def lm_links(ctx: click.Context) -> None:
-    _print(_call(ctx, "get_interfaces"))
+def lm_links(ctx: click.Context, only_suppressed: bool) -> None:
+    ifaces = _call(ctx, "get_interfaces")
+    if only_suppressed:
+        ifaces = {
+            **ifaces,
+            "interface_details": {
+                n: d
+                for n, d in ifaces.get("interface_details", {}).items()
+                if d.get("is_up") and not d.get("is_active", True)
+            },
+        }
+    _print(ifaces)
 
 
 @lm.command("adj")
 @click.option("--area", default=None)
+@click.argument("areas_args", nargs=-1)
 @click.pass_context
-def lm_adj(ctx: click.Context, area: Optional[str]) -> None:
-    _print(_call(ctx, "get_link_monitor_adjacencies", area=area))
+def lm_adj(ctx: click.Context, area: Optional[str], areas_args: tuple) -> None:
+    """Link-monitor's own adjacency view; trailing AREA arguments
+    restrict it (reference: lm adj [areas]...); --area and positional
+    areas union."""
+    areas = list(
+        dict.fromkeys(([area] if area else []) + list(areas_args))
+    ) or [None]
+    out: list = []
+    for a in areas:
+        out.extend(_call(ctx, "get_link_monitor_adjacencies", area=a))
+    _print(out)
+
+
+def _confirm(yes: bool, what: str) -> None:
+    """Reference parity for --yes: mutating drain ops prompt on a TTY
+    unless --yes; non-interactive invocations proceed (so scripts and
+    tests behave like the reference's `breeze ... --yes`)."""
+    import sys as _sys
+
+    if yes or not _sys.stdin.isatty():
+        return
+    click.confirm(f"Are you sure to {what}?", abort=True)
 
 
 @lm.command("set-node-overload")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
 @click.pass_context
-def lm_set_node_overload(ctx: click.Context) -> None:
+def lm_set_node_overload(ctx: click.Context, yes: bool) -> None:
+    _confirm(yes, "set node overload (drain)")
     _call(ctx, "set_node_overload")
     click.echo("node overload set (drained)")
 
 
 @lm.command("unset-node-overload")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
 @click.pass_context
-def lm_unset_node_overload(ctx: click.Context) -> None:
+def lm_unset_node_overload(ctx: click.Context, yes: bool) -> None:
+    _confirm(yes, "unset node overload (undrain)")
     _call(ctx, "unset_node_overload")
     click.echo("node overload unset (undrained)")
 
 
 @lm.command("set-link-overload")
 @click.argument("interface")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
 @click.pass_context
-def lm_set_link_overload(ctx: click.Context, interface: str) -> None:
+def lm_set_link_overload(ctx: click.Context, interface: str, yes: bool) -> None:
+    _confirm(yes, f"set overload on {interface}")
     _call(ctx, "set_interface_overload", interface=interface)
     click.echo(f"link overload set on {interface}")
 
 
 @lm.command("unset-link-overload")
 @click.argument("interface")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
 @click.pass_context
-def lm_unset_link_overload(ctx: click.Context, interface: str) -> None:
+def lm_unset_link_overload(
+    ctx: click.Context, interface: str, yes: bool
+) -> None:
+    _confirm(yes, f"unset overload on {interface}")
     _call(ctx, "unset_interface_overload", interface=interface)
     click.echo(f"link overload unset on {interface}")
 
@@ -992,18 +1678,30 @@ def lm_unset_link_overload(ctx: click.Context, interface: str) -> None:
 @lm.command("set-link-metric")
 @click.argument("interface")
 @click.argument("metric", type=int)
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
+@click.option("--quiet", is_flag=True, help="suppress output")
 @click.pass_context
-def lm_set_link_metric(ctx: click.Context, interface: str, metric: int) -> None:
+def lm_set_link_metric(
+    ctx: click.Context, interface: str, metric: int, yes: bool, quiet: bool
+) -> None:
+    _confirm(yes, f"set metric {metric} on {interface}")
     _call(ctx, "set_interface_metric", interface=interface, metric=metric)
-    click.echo(f"metric {metric} set on {interface}")
+    if not quiet:
+        click.echo(f"metric {metric} set on {interface}")
 
 
 @lm.command("unset-link-metric")
 @click.argument("interface")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
+@click.option("--quiet", is_flag=True, help="suppress output")
 @click.pass_context
-def lm_unset_link_metric(ctx: click.Context, interface: str) -> None:
+def lm_unset_link_metric(
+    ctx: click.Context, interface: str, yes: bool, quiet: bool
+) -> None:
+    _confirm(yes, f"remove metric override from {interface}")
     _call(ctx, "unset_interface_metric", interface=interface)
-    click.echo(f"metric override removed from {interface}")
+    if not quiet:
+        click.echo(f"metric override removed from {interface}")
 
 
 # --------------------------------------------------------------- prefixmgr
@@ -1029,11 +1727,29 @@ def prefixmgr_validate(ctx: click.Context, area: Optional[str]) -> None:
     """Every advertised prefix must be present in the KvStore under this
     node's prefix: keys in at least one configured area (breeze
     prefixmgr validate)."""
+    problems, summary = _prefixmgr_validate_problems(ctx, area)
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo(f"{summary} validated OK")
+
+
+def _prefixmgr_validate_problems(
+    ctx: click.Context,
+    area: Optional[str],
+    all_areas: Optional[list] = None,
+):
     from openr_tpu.types import prefix_key
 
     me = _call(ctx, "get_node_name")
     advertised = {p["prefix"] for p in _call(ctx, "get_advertised_routes")}
-    areas = [area] if area else _call(ctx, "get_kv_store_areas")
+    if area:
+        areas = [area]
+    elif all_areas is not None:
+        areas = all_areas
+    else:
+        areas = _call(ctx, "get_kv_store_areas")
     dump: dict = {}
     for a in areas:
         dump.update(
@@ -1044,11 +1760,7 @@ def prefixmgr_validate(ctx: click.Context, area: Optional[str]) -> None:
         for p in sorted(advertised)
         if prefix_key(me, p) not in dump
     ]
-    if problems:
-        for line in problems:
-            click.echo(f"FAIL {line}")
-        raise SystemExit(1)
-    click.echo(f"{len(advertised)} advertised prefix(es) validated OK")
+    return problems, f"{len(advertised)} advertised prefix(es)"
 
 
 @prefixmgr.command("advertise")
@@ -1084,9 +1796,18 @@ def spark() -> None:
 
 
 @spark.command("neighbors")
+@click.option(
+    "--detail/--no-detail",
+    default=False,
+    help="full neighbor records instead of the summary table",
+)
+@click.option("--json/--no-json", "json_out", default=False)
 @click.pass_context
-def spark_neighbors(ctx: click.Context) -> None:
+def spark_neighbors(ctx: click.Context, detail: bool, json_out: bool) -> None:
     nbrs = _call(ctx, "get_spark_neighbors")
+    if json_out or detail:
+        _print(nbrs)
+        return
     click.echo(
         f"{'Neighbor':16} {'State':14} {'Local If':16} {'Remote If':16} "
         f"{'Area':6} RTT(us)"
@@ -1299,6 +2020,15 @@ def decision_adj_filtered(
 def lm_validate(ctx: click.Context) -> None:
     """Link-monitor consistency: every advertised adjacency backed by an
     ESTABLISHED neighbor on an up interface (breeze lm validate)."""
+    problems, _ = _lm_validate_problems(ctx)
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo("link-monitor state validated OK")
+
+
+def _lm_validate_problems(ctx: click.Context):
     ifaces = _call(ctx, "get_interfaces")
     nbrs = {
         n.get("node_name")
@@ -1327,11 +2057,7 @@ def lm_validate(ctx: click.Context) -> None:
                     f"adjacency on {adj.get('if_name')} but interface "
                     "not up"
                 )
-    if problems:
-        for line in problems:
-            click.echo(f"FAIL {line}")
-        raise SystemExit(1)
-    click.echo("link-monitor state validated OK")
+    return problems, f"{len(up)} up interface(s)"
 
 
 @lm.command("drain-state")
@@ -1344,57 +2070,89 @@ def lm_drain_state(ctx: click.Context) -> None:
 @click.argument("interface")
 @click.argument("node")
 @click.argument("metric", type=int)
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
+@click.option("--quiet", is_flag=True, help="suppress output")
 @click.pass_context
 def lm_set_adj_metric(
-    ctx: click.Context, interface: str, node: str, metric: int
+    ctx: click.Context, interface: str, node: str, metric: int, yes: bool, quiet: bool
 ) -> None:
+    _confirm(yes, f"set adjacency metric {metric} on {interface}->{node}")
     _call(ctx, "set_adjacency_metric", interface=interface, node=node,
           metric=metric)
-    click.echo(f"adjacency metric {metric} set on {interface}->{node}")
+    if not quiet:
+        click.echo(f"adjacency metric {metric} set on {interface}->{node}")
 
 
 @lm.command("unset-adj-metric")
 @click.argument("interface")
 @click.argument("node")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
+@click.option("--quiet", is_flag=True, help="suppress output")
 @click.pass_context
-def lm_unset_adj_metric(ctx: click.Context, interface: str, node: str) -> None:
+def lm_unset_adj_metric(
+    ctx: click.Context, interface: str, node: str, yes: bool, quiet: bool
+) -> None:
+    _confirm(yes, f"remove adjacency metric override from {interface}->{node}")
     _call(ctx, "unset_adjacency_metric", interface=interface, node=node)
-    click.echo(f"adjacency metric override removed from {interface}->{node}")
+    if not quiet:
+        click.echo(f"adjacency metric override removed from {interface}->{node}")
 
 
 @lm.command("set-link-increment")
 @click.argument("interface")
 @click.argument("increment", type=int)
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
+@click.option("--quiet", is_flag=True, help="suppress output")
 @click.pass_context
 def lm_set_link_increment(
-    ctx: click.Context, interface: str, increment: int
+    ctx: click.Context, interface: str, increment: int, yes: bool, quiet: bool
 ) -> None:
+    _confirm(yes, f"set metric increment {increment} on {interface}")
     _call(ctx, "set_interface_metric_increment", interface=interface,
           increment=increment)
-    click.echo(f"metric increment {increment} set on {interface}")
+    if not quiet:
+        click.echo(f"metric increment {increment} set on {interface}")
 
 
 @lm.command("unset-link-increment")
 @click.argument("interface")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
+@click.option("--quiet", is_flag=True, help="suppress output")
 @click.pass_context
-def lm_unset_link_increment(ctx: click.Context, interface: str) -> None:
+def lm_unset_link_increment(
+    ctx: click.Context, interface: str, yes: bool, quiet: bool
+) -> None:
+    _confirm(yes, f"remove metric increment from {interface}")
     _call(ctx, "unset_interface_metric_increment", interface=interface)
-    click.echo(f"metric increment removed from {interface}")
+    if not quiet:
+        click.echo(f"metric increment removed from {interface}")
 
 
 @lm.command("set-node-increment")
 @click.argument("increment", type=int)
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
+@click.option("--quiet", is_flag=True, help="suppress output")
 @click.pass_context
-def lm_set_node_increment(ctx: click.Context, increment: int) -> None:
+def lm_set_node_increment(
+    ctx: click.Context, increment: int, yes: bool, quiet: bool
+) -> None:
+    _confirm(yes, f"set node-wide metric increment {increment} (soft drain)")
     _call(ctx, "set_node_interface_metric_increment", increment=increment)
-    click.echo(f"node-wide metric increment {increment} set (soft drain)")
+    if not quiet:
+        click.echo(f"node-wide metric increment {increment} set (soft drain)")
 
 
 @lm.command("unset-node-increment")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
+@click.option("--quiet", is_flag=True, help="suppress output")
 @click.pass_context
-def lm_unset_node_increment(ctx: click.Context) -> None:
+def lm_unset_node_increment(
+    ctx: click.Context, yes: bool, quiet: bool
+) -> None:
+    _confirm(yes, "remove node-wide metric increment")
     _call(ctx, "unset_node_interface_metric_increment")
-    click.echo("node-wide metric increment removed")
+    if not quiet:
+        click.echo("node-wide metric increment removed")
 
 
 # more prefixmgr breadth (types, areas, origination)
@@ -1462,10 +2220,26 @@ def fib_mpls(ctx: click.Context, labels: tuple) -> None:
 
 
 @spark.command("validate")
+@click.option(
+    "--detail/--no-detail",
+    default=False,
+    help="also print the full neighbor dump on success",
+)
 @click.pass_context
-def spark_validate(ctx: click.Context) -> None:
+def spark_validate(ctx: click.Context, detail: bool) -> None:
     """Neighbor-state sanity: every discovered neighbor ESTABLISHED and
     area-resolved (the reference's breeze spark validate)."""
+    problems, summary = _spark_validate_problems(ctx)
+    if problems:
+        for line in problems:
+            click.echo(f"FAIL {line}")
+        raise SystemExit(1)
+    click.echo(f"{summary} validated OK")
+    if detail:
+        _print(_call(ctx, "get_spark_neighbors"))
+
+
+def _spark_validate_problems(ctx: click.Context):
     nbrs = _call(ctx, "get_spark_neighbors")
     problems = []
     for n in nbrs:
@@ -1475,17 +2249,15 @@ def spark_validate(ctx: click.Context) -> None:
             )
         if not n.get("area"):
             problems.append(f"{n.get('node_name')}: no negotiated area")
-    if problems:
-        for line in problems:
-            click.echo(f"FAIL {line}")
-        raise SystemExit(1)
-    click.echo(f"{len(nbrs)} neighbor(s) validated OK")
+    return problems, f"{len(nbrs)} neighbor(s)"
 
 
 @spark.command("graceful-restart")
+@click.option("--yes", is_flag=True, help="skip confirmation prompt")
 @click.pass_context
-def spark_graceful_restart(ctx: click.Context) -> None:
+def spark_graceful_restart(ctx: click.Context, yes: bool) -> None:
     """Tell peers to hold adjacencies through our restart."""
+    _confirm(yes, "flood restarting hellos (graceful restart)")
     _call(ctx, "flood_restarting_msg")
     click.echo("restarting hellos flooded; peers hold adjacencies")
 

@@ -198,6 +198,8 @@ class OpenrCtrlHandler:
             "interface_details": {
                 name: {
                     "is_up": e.info.is_up,
+                    # up but not active == suppressed by flap backoff
+                    "is_active": bool(e.active),
                     "metric_override": lm.link_metric_overrides.get(name),
                     "is_overloaded": name in lm.link_overloads,
                     "addresses": list(e.info.networks),
@@ -337,14 +339,15 @@ class OpenrCtrlHandler:
         )
 
     def get_decision_paths(
-        self, src: str = "", dst: str = "", max_hop: int = 256
+        self, src: str = "", dst: str = "", max_hop: int = 256,
+        area: Optional[str] = None,
     ) -> dict:
         """src→dst forwarding-path enumeration over computed RouteDbs
         (the reference breeze `decision path`,
         py/openr/cli/clis/decision.py:50); defaults resolve to this
-        node."""
+        node; ``area`` restricts hops to that area's nexthops."""
         return self.node.decision.get_decision_paths(
-            src or self.node.name, dst or self.node.name, max_hop
+            src or self.node.name, dst or self.node.name, max_hop, area
         )
 
     def get_route_db_computed(self, node: str) -> dict:

@@ -481,7 +481,8 @@ class Decision(Actor):
             return None
 
     def get_decision_paths(
-        self, src: str, dst: str, max_hop: int = 256
+        self, src: str, dst: str, max_hop: int = 256,
+        area: Optional[str] = None,
     ) -> dict:
         """Enumerate loop-free src→dst forwarding paths by walking each
         hop's COMPUTED RouteDb (the reference's `breeze decision path`
@@ -490,7 +491,9 @@ class Decision(Actor):
         solve instead of a scalar Dijkstra per hop.
 
         ``dst`` is a prefix or a node name (resolved to that node's
-        first advertised prefix, the loopback convention)."""
+        first advertised prefix, the loopback convention).  ``area``
+        restricts hop expansion to nexthops learned in that area (the
+        reference CLI's --area)."""
         prefixes = self.prefix_state.prefixes()
         if dst in prefixes:
             dst_prefix = dst
@@ -540,7 +543,11 @@ class Decision(Actor):
             if entry is None:
                 return  # dead end: cur computes no route for dst
             for nh in sorted(
-                {n.neighbor_node_name for n in entry.nexthops}
+                {
+                    n.neighbor_node_name
+                    for n in entry.nexthops
+                    if area is None or n.area == area
+                }
             ):
                 if nh in visited:
                     continue

@@ -141,7 +141,16 @@ def test_cli_monitor_counters(live_node):
 
 
 def test_cli_kvstore_snoop_snapshot(live_node):
-    out = _run(live_node, "kvstore", "snoop", "--count", "1", "--prefix", "adj:")
+    out = _run(
+        live_node,
+        "kvstore",
+        "snoop",
+        "--count",
+        "1",
+        "--prefix",
+        "adj:",
+        "--print-initial",
+    )
     pub = json.loads(out.strip().splitlines()[0])
     assert adj_key("node0") in pub["key_vals"]
 
@@ -253,3 +262,158 @@ def test_fib_agent_cli_commands():
     run("counters", *opts)
     info["loop"].call_soon_threadsafe(info["stop"].set)
     t.join(10)
+
+
+# ---- round-4 CLI option depth (reference flag parity) ----------------------
+
+
+def test_cli_openr_validate(live_node):
+    """breeze openr validate: aggregate of every module's checks
+    (reference py/openr/cli/clis/openr.py validate)."""
+    import time
+
+    # earlier tests may have planted operator keys outside the
+    # adj:/prefix: namespaces (op:canary); erase and wait for the
+    # tombstone to expire so the kvstore check sees a clean store
+    CliRunner().invoke(
+        breeze,
+        ["--port", str(live_node), "kvstore", "erase-key", "op:canary",
+         "--ttl-ms", "100"],
+        obj={},
+    )
+    for _ in range(50):
+        if "op:canary" not in _run(live_node, "kvstore", "keys"):
+            break
+        time.sleep(0.1)
+    out = _run(live_node, "openr", "validate")
+    for mod in ("spark", "link-monitor", "kvstore", "decision",
+                "prefixmgr", "fib"):
+        assert f"[PASS] {mod}" in out, out
+    # --suppress-error prints only the final OK line when all pass
+    out = _run(live_node, "openr", "validate", "--suppress-error")
+    assert out.strip() == "all modules validated OK"
+
+
+def test_cli_config_compare(live_node, tmp_path):
+    cfg = _run(live_node, "config", "show")
+    same = tmp_path / "same.json"
+    same.write_text(cfg)
+    assert "configs match" in _run(live_node, "config", "compare", str(same))
+    changed = json.loads(cfg)
+    changed["domain"] = "other-domain"
+    diff = tmp_path / "diff.json"
+    diff.write_text(json.dumps(changed))
+    r = CliRunner().invoke(
+        breeze, ["--port", str(live_node), "config", "compare", str(diff)],
+        obj={},
+    )
+    assert r.exit_code == 1
+    assert "domain" in r.output
+
+
+def test_cli_config_module_views(live_node):
+    # no drain ops issued by this test module -> no persisted LM state
+    out = _run(live_node, "config", "link-monitor")
+    assert "link-monitor" in out or "{" in out
+    _run(live_node, "config", "prefix-manager")
+
+
+def test_cli_monitor_statistics(live_node):
+    out = _run(live_node, "monitor", "statistics")
+    assert "process." in out or "no process statistics" in out
+
+
+def test_cli_decision_routes_options(live_node):
+    all_dbs = json.loads(_run(live_node, "decision", "routes", "--nodes", "all"))
+    assert set(all_dbs) == {"node0", "node1"}
+    # prefix filter: keep only node1's loopback
+    full = json.loads(_run(live_node, "decision", "routes"))
+    dests = [r["dest"] for r in full["unicast_routes"]]
+    assert dests
+    keep = dests[0]
+    filtered = json.loads(_run(live_node, "decision", "routes", keep))
+    assert [r["dest"] for r in filtered["unicast_routes"]] == [keep]
+    # --labels drops the unicast table
+    lab = json.loads(_run(live_node, "decision", "routes", "--labels"))
+    assert "unicast_routes" not in lab
+
+
+def test_cli_decision_adj_options(live_node):
+    dbs = json.loads(_run(live_node, "decision", "adj", "--json"))
+    assert {db["this_node_name"] for db in dbs} == {"node0", "node1"}
+    only0 = json.loads(
+        _run(live_node, "decision", "adj", "--json", "--nodes", "node0")
+    )
+    assert {db["this_node_name"] for db in only0} == {"node0"}
+    # a healthy 2-node line is fully bidirectional: --bidir keeps all
+    assert all(db["adjacencies"] for db in dbs)
+    # --nodes narrowing must NOT defeat the --bidir reverse check: the
+    # reverse entries live in the PEERS' dbs, which the filter removes
+    # from view (found by the r4 verify drive — a single-node view came
+    # back with zero adjacencies)
+    assert only0[0]["adjacencies"], "bidir must be computed before --nodes"
+
+
+def test_cli_decision_path_area(live_node):
+    out = _run(
+        live_node, "decision", "path", "--src", "node0", "--dst", "node1",
+        "--area", "0",
+    )
+    assert "node0 -> node1" in out
+    # nonexistent area -> no traversable nexthops -> zero paths
+    out = _run(
+        live_node, "decision", "path", "--src", "node0", "--dst", "node1",
+        "--area", "no-such-area",
+    )
+    assert "0 path(s)" in out
+
+
+def test_cli_fib_routes_options(live_node):
+    db = json.loads(_run(live_node, "fib", "routes"))
+    dests = [r["dest"] for r in db.get("unicast_routes", [])]
+    assert dests
+    keep = dests[0]
+    filtered = json.loads(_run(live_node, "fib", "routes", "-p", keep))
+    assert [r["dest"] for r in filtered["unicast_routes"]] == [keep]
+    lab = json.loads(_run(live_node, "fib", "routes", "--labels"))
+    assert "unicast_routes" not in lab
+
+
+def test_cli_lm_links_options(live_node):
+    ifaces = json.loads(_run(live_node, "lm", "links"))
+    details = ifaces["interface_details"]
+    assert all("is_active" in d for d in details.values())
+    # nothing is flap-suppressed in a steady-state lab
+    sup = json.loads(_run(live_node, "lm", "links", "--only-suppressed"))
+    assert sup["interface_details"] == {}
+
+
+def test_cli_lm_yes_quiet_flags(live_node):
+    out = _run(live_node, "lm", "set-link-metric", "if-node0-node1", "77",
+               "--yes")
+    assert "metric 77 set" in out
+    out = _run(live_node, "lm", "unset-link-metric", "if-node0-node1",
+               "--yes", "--quiet")
+    assert out.strip() == ""
+
+
+def test_cli_spark_neighbors_detail(live_node):
+    nbrs = json.loads(_run(live_node, "spark", "neighbors", "--detail"))
+    assert nbrs and nbrs[0]["node_name"] == "node1"
+    table = _run(live_node, "spark", "neighbors")
+    assert "Neighbor" in table
+
+
+def test_cli_snoop_duration_bounds_idle_stream(live_node):
+    """--duration must terminate the snoop even when NO publication ever
+    arrives (the deadline is enforced by asyncio.wait_for around the
+    stream, not by a check inside the message loop; code-review r4)."""
+    import time
+
+    t0 = time.monotonic()
+    _run(live_node, "kvstore", "snoop", "--duration", "1",
+         "--prefix", "no-such-prefix:")
+    assert time.monotonic() - t0 < 10
+    t0 = time.monotonic()
+    _run(live_node, "fib", "snoop", "--duration", "1", "--no-initial-dump")
+    assert time.monotonic() - t0 < 10
