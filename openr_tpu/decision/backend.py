@@ -358,6 +358,7 @@ class TpuBackend(DecisionBackend):
         """Device (dist [A,V], nh [A,V,D]) tables, cached per encoding."""
         import jax.numpy as jnp
 
+        from openr_tpu.ops.jit_guard import call_jit_guarded
         from openr_tpu.ops.route_select import multi_area_spf_tables
 
         if (
@@ -366,7 +367,8 @@ class TpuBackend(DecisionBackend):
             and self._spf_degree == max_degree
         ):
             return self._spf_tables
-        dist, nh = multi_area_spf_tables(
+        dist, nh = call_jit_guarded(
+            multi_area_spf_tables,
             jnp.asarray(enc.src),
             jnp.asarray(enc.dst),
             jnp.asarray(enc.w),
@@ -392,6 +394,7 @@ class TpuBackend(DecisionBackend):
         import jax.numpy as jnp
 
         from openr_tpu.ops.csr import bucket_for
+        from openr_tpu.ops.jit_guard import call_jit_guarded
         from openr_tpu.ops.route_select import multi_area_select_from_tables
 
         me = self.solver.my_node_name
@@ -454,7 +457,8 @@ class TpuBackend(DecisionBackend):
                 ridx[: len(rows)] = rows
                 g_ok = dv.cand_ok[ridx]
                 g_ok[len(rows):] = False
-                use, shortest, lanes, valid = multi_area_select_from_tables(
+                use, shortest, lanes, valid = call_jit_guarded(
+                    multi_area_select_from_tables,
                     dist,
                     nh,
                     ovl,
@@ -493,7 +497,8 @@ class TpuBackend(DecisionBackend):
             )
 
         # ---- full build --------------------------------------------------
-        use, shortest, lanes, valid = multi_area_select_from_tables(
+        use, shortest, lanes, valid = call_jit_guarded(
+            multi_area_select_from_tables,
             dist,
             nh,
             ovl,

@@ -471,3 +471,46 @@ def test_auto_cutover_picks_scalar_on_small_worlds():
     db2 = free.build_route_db({"0": ls}, ps)
     assert free.num_device_builds == 1
     assert route_db_summary(db) == route_db_summary(db2)
+
+
+def test_backend_selection_survives_jit_cache_corruption(monkeypatch):
+    """The jax-0.9 executable-cache corruption ("Execution supplied N
+    buffers but compiled program expected M") can strike the backend's
+    multi_area_select_from_tables / multi_area_spf_tables calls when
+    OTHER kernel families compiled first in the same process (observed:
+    CLI-golden + ctrl test kernels, then a small build).  The backend
+    must heal through ops.jit_guard (clear caches + retry), not fall
+    back to scalar.  Simulates the corruption deterministically by
+    failing the first call with the exact jaxlib signature."""
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.ops import route_select
+
+    ls = LinkState("0")
+    for db in build_adj_dbs(grid_edges(3)).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(9):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.{i}.0.0/24"))
+
+    real = route_select.multi_area_select_from_tables
+    calls = {"n": 0}
+
+    def corrupt_once(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError(
+                "INVALID_ARGUMENT: Execution supplied 12 buffers but "
+                "compiled program expected 14 buffers"
+            )
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(
+        route_select, "multi_area_select_from_tables", corrupt_once
+    )
+    backend = TpuBackend(SpfSolver("node0"), min_device_prefixes=0)
+    db = backend.build_route_db({"0": ls}, ps)
+    assert backend.num_device_builds == 1, "guard must heal, not fall back"
+    assert calls["n"] == 2  # failed once, retried once
+    assert db.unicast_routes
