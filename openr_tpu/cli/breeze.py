@@ -2335,11 +2335,14 @@ def tech_support(ctx: click.Context) -> None:
         ("initialization", "get_initialization_events", {}),
         ("config", "get_running_config", {}),
         ("interfaces", "get_interfaces", {}),
+        ("spark-neighbors", "get_spark_neighbors", {}),
+        ("kvstore-peers", "get_kv_store_peers", {}),
         ("adjacencies", "get_decision_adjacency_dbs", {}),
         ("routes", "get_route_db", {}),
         ("fib", "get_fib_routes", {}),
         ("kvstore-summary", "get_kv_store_area_summaries", {}),
         ("advertised-routes", "get_advertised_routes", {}),
+        ("perf-fib", "get_perf_db", {}),
         ("counters", "get_counters", {}),
         ("event-logs", "get_event_logs", {}),
     ]
@@ -2349,6 +2352,15 @@ def tech_support(ctx: click.Context) -> None:
             _print(_call(ctx, method, **params))
         except Exception as e:  # noqa: BLE001 - keep dumping other sections
             click.echo(f"<error: {e}>")
+    # the validate battery, like the reference's decision/fib validate
+    # sections (py/openr/cli/commands/tech_support.py:41-59)
+    click.echo("\n================ validate ================")
+    try:
+        ctx.invoke(openr_validate, suppress=False, json_out=False)
+    except SystemExit:
+        pass  # failures already printed per module
+    except Exception as e:  # noqa: BLE001
+        click.echo(f"<error: {e}>")
 
 
 def main() -> None:

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import json
 import time
 from typing import Dict, List, Optional
@@ -43,6 +44,13 @@ from openr_tpu.types import (
 
 #: kernel route-protocol id for routes we own (reference uses 99/openr)
 ROUTE_PROTO_OPENR = 99
+
+#: SwitchRunState (Platform.thrift:42-48; the reference enum itself
+#: skips 3 — the gap between CONFIGURED and EXITING is deliberate)
+SWITCH_RUN_STATE_UNINITIALIZED = 0
+SWITCH_RUN_STATE_INITIALIZED = 1
+SWITCH_RUN_STATE_CONFIGURED = 2
+SWITCH_RUN_STATE_EXITING = 4
 #: FibService client ids (if/Platform.thrift ClientId); openr is 786
 CLIENT_ID_OPENR = 786
 
@@ -105,6 +113,7 @@ class NetlinkFibHandler:
         self._mpls: Dict[int, Dict[int, MplsRoute]] = {}
         self._if_name_to_index: Dict[str, int] = {}
         self._if_index_to_name: Dict[int, str] = {}
+        self._neighbor_listeners: List = []
 
     async def _refresh_links(self) -> None:
         # rebuild from scratch: a flapped interface can come back with a
@@ -241,6 +250,43 @@ class NetlinkFibHandler:
     ) -> List[MplsRoute]:
         return list(self._mpls.get(client_id, {}).values())
 
+    async def add_unicast_route(
+        self, client_id: int, route: UnicastRoute
+    ) -> None:
+        """Singular convenience form (Platform.thrift:88)."""
+        await self.add_unicast_routes(client_id, [route])
+
+    async def delete_unicast_route(self, client_id: int, prefix: str) -> None:
+        """Singular convenience form (Platform.thrift:93)."""
+        await self.delete_unicast_routes(client_id, [prefix])
+
+    async def get_switch_run_state(self) -> int:
+        """SwitchRunState (Platform.thrift:42-48,78): a live netlink
+        handler is always fully CONFIGURED, like the reference's
+        NetlinkFibHandler::getSwitchRunState."""
+        return SWITCH_RUN_STATE_CONFIGURED
+
+    def register_neighbor_listener(self, cb) -> None:
+        """cb(neighbor_ips: List[str], is_up: bool) — the
+        NeighborListenerClientForFibagent.neighborsChanged equivalent
+        (Platform.thrift:146; reference invokeNeighborListeners)."""
+        self._neighbor_listeners.append(cb)
+
+    async def send_neighbor_down_info(self, neighbor_ips: List[str]) -> None:
+        """Fan a neighbor-down event out to registered listeners
+        (Platform.thrift:146, NetlinkFibHandler.cpp:697-708).  Listener
+        failures are isolated: one throwing callback must not starve the
+        others or error the peer that merely reported the event."""
+        self.counters.bump("fib.neighbor_down_notifications")
+        for cb in list(self._neighbor_listeners):
+            try:
+                cb(list(neighbor_ips), False)
+            except Exception:  # noqa: BLE001
+                logging.getLogger(__name__).exception(
+                    "neighbor-down listener failed"
+                )
+                self.counters.bump("fib.neighbor_listener_errors")
+
     async def get_kernel_routes(self) -> List[NlRoute]:
         """Dump our protocol's routes straight from the kernel."""
         return await self.nl.get_all_routes(protocol=ROUTE_PROTO_OPENR)
@@ -373,6 +419,20 @@ class FibServiceServer:
                     client_id
                 )
             ]
+        elif method == "add_unicast_route":
+            await self.handler.add_unicast_route(
+                client_id, UnicastRoute.from_wire(params["route"])
+            )
+        elif method == "delete_unicast_route":
+            await self.handler.delete_unicast_route(
+                client_id, params["prefix"]
+            )
+        elif method == "get_switch_run_state":
+            return await self.handler.get_switch_run_state()
+        elif method == "send_neighbor_down_info":
+            await self.handler.send_neighbor_down_info(
+                params["neighbor_ips"]
+            )
         elif method == "alive_since":
             return await self.handler.alive_since()
         elif method == "get_counters":
@@ -471,3 +531,15 @@ class RemoteFibAgent(FibAgent):
 
     async def get_counters(self) -> Dict[str, float]:
         return dict(await self._call("get_counters"))
+
+    async def add_unicast_route(self, route: UnicastRoute) -> None:
+        await self._call("add_unicast_route", route=route.to_wire())
+
+    async def delete_unicast_route(self, prefix: str) -> None:
+        await self._call("delete_unicast_route", prefix=prefix)
+
+    async def get_switch_run_state(self) -> int:
+        return int(await self._call("get_switch_run_state"))
+
+    async def send_neighbor_down_info(self, neighbor_ips: List[str]) -> None:
+        await self._call("send_neighbor_down_info", neighbor_ips=neighbor_ips)

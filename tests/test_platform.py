@@ -183,6 +183,53 @@ class TestFibServiceServer:
 
         asyncio.run(run())
 
+    def test_platform_thrift_parity_methods(self):
+        """The four remaining FibService methods (Platform.thrift:78-146):
+        singular add/delete, getSwitchRunState, sendNeighborDownInfo
+        fan-out to registered neighbor listeners."""
+
+        async def run():
+            from openr_tpu.platform.fib_service import (
+                SWITCH_RUN_STATE_CONFIGURED,
+            )
+
+            handler, nl = make_handler()
+            down_events = []
+            handler.register_neighbor_listener(
+                lambda ips, is_up: down_events.append((tuple(ips), is_up))
+            )
+            server = FibServiceServer(handler)
+            await server.start()
+            agent = RemoteFibAgent(port=server.port)
+            try:
+                await agent.add_unicast_route(
+                    uroute("10.9.0.0/24", ("fe80::1", "eth0"))
+                )
+                assert [r.dest for r in await agent.get_route_table()] == [
+                    "10.9.0.0/24"
+                ]
+                await agent.delete_unicast_route("10.9.0.0/24")
+                assert not await agent.get_route_table()
+                assert (
+                    await agent.get_switch_run_state()
+                    == SWITCH_RUN_STATE_CONFIGURED
+                )
+                # a throwing listener must not starve later listeners
+                def bad(ips, up):
+                    raise RuntimeError("boom")
+
+                handler._neighbor_listeners.insert(0, bad)
+                await agent.send_neighbor_down_info(["fe80::9", "fe80::a"])
+                assert down_events == [(("fe80::9", "fe80::a"), False)]
+                assert (await agent.get_counters())[
+                    "fib.neighbor_listener_errors"
+                ] == 1
+            finally:
+                await agent.close()
+                await server.stop()
+
+        asyncio.run(run())
+
 
 class TestFibThroughPlatform:
     def test_fib_programs_via_netlink_agent(self):
