@@ -91,6 +91,10 @@ class SweepRouteDeltas:
     delta_lanes: np.ndarray  # [K, D] int8
     #: bytes actually moved device->host for masks + deltas
     fetch_bytes: int = 0
+    #: blocking device->host fetch rounds this sweep cost (1 unless a
+    #: compaction buffer overflowed and was re-fetched) — the round-trip
+    #: count is the tunneled-chip latency floor, so tests pin it
+    fetch_groups: int = 0
 
     def __post_init__(self):
         order = np.argsort(self.delta_row, kind="stable")
@@ -473,10 +477,19 @@ class SweepRouteSelector:
                 jnp.int32(n), cap=cap,
             )
             selected.append((off, n, out, cap, comp))
-        for off, n, out, cap, comp in selected:
+        # fetch phase: ONE device_get over every chunk's compaction —
+        # jax.device_get async-copies all pytree leaves before blocking
+        # ("individual buffers are copied in parallel"), so the whole
+        # sweep costs a single overlapped host round trip instead of one
+        # per chunk.  Over a ~75 ms tunnel the per-chunk round trips
+        # were the e2e pipeline floor (3 chunks ~= 225 ms regardless of
+        # compute).
+        fetch_groups = 1 if selected else 0
+        fetched = jax.device_get([s[4] for s in selected])
+        for (off, n, out, cap, comp), host in zip(selected, fetched):
             changed_packed, valid, metric, lanes_packed = out
             b = valid.shape[0]
-            count, cflat, cvalid, cmetric, clanes = jax.device_get(comp)
+            count, cflat, cvalid, cmetric, clanes = host
             count = int(count)
             while count > cap:
                 # rare overflow: re-compact with the next bucket that
@@ -488,6 +501,7 @@ class SweepRouteSelector:
                 else:
                     cap = min(bucket_for(count, DELTA_BUCKETS), b * P)
                 self._cap = max(self._cap, cap)
+                fetch_groups += 1
                 count, cflat, cvalid, cmetric, clanes = jax.device_get(
                     _compact_deltas(
                         changed_packed, valid, metric, lanes_packed,
@@ -543,4 +557,5 @@ class SweepRouteSelector:
                 else empty(np.int8, (0, self.D))
             ),
             fetch_bytes=fetch_bytes,
+            fetch_groups=fetch_groups,
         )

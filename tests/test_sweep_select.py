@@ -184,3 +184,25 @@ def test_base_select_eager_workaround_regression():
                 continue
             assert valid[p], (root, p)
             assert metric[p] == base_dist[p], (root, p)
+
+
+def test_sweep_fetch_is_one_round_trip_multi_chunk():
+    """A multi-chunk sweep must cost ONE blocking device->host fetch
+    (a single device_get over all chunk compactions overlaps every
+    copy): per-chunk round trips were the e2e latency floor over a
+    tunneled chip (~75 ms x chunks).  fetch_groups counts the blocking
+    fetch rounds."""
+    topo = build_world(seed=3)
+    eng = LinkFailureSweep(topo, "node0", max_chunk=32)
+    V = topo.num_nodes
+    cands = SweepCandidates.single_advertiser(np.arange(V))
+    sel = SweepRouteSelector(topo, "node0", cands, max_degree=eng.D)
+    fails = np.arange(len(topo.links), dtype=np.int32)
+    sweep = eng.run(fails, fetch=False)
+    assert len(sweep.chunks) > 1, "test needs a multi-chunk sweep"
+    deltas = sel.run(sweep)
+    assert deltas.fetch_groups == 1
+    # parity unaffected by the fused fetch
+    v, m, ln = deltas.routes_of(0)
+    ev, em, el = scalar_routes(topo, eng, cands, fails[0])
+    assert np.array_equal(v, ev)
