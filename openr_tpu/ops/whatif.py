@@ -89,8 +89,15 @@ class SweepResult:
         self.nh = np.empty((U, V, self.lanes), np.int8)
         self.dist[0] = self.base[0]
         self.nh[0] = self.base[1]
-        for off, n, dist_d, nh_d in self.chunks or []:
-            dist_h, nh_h = jax.device_get((dist_d, nh_d))
+        # one device_get over every chunk: jax async-copies all pytree
+        # leaves before blocking, so the full-table fetch costs a single
+        # overlapped host round trip instead of one per chunk
+        fetched = jax.device_get(
+            [(dist_d, nh_d) for _off, _n, dist_d, nh_d in self.chunks or []]
+        )
+        for (off, n, _dd, _nd), (dist_h, nh_h) in zip(
+            self.chunks or [], fetched
+        ):
             self.dist[1 + off : 1 + off + n] = dist_h[:, :n].T
             idx = np.arange(n)
             bits = (
