@@ -435,3 +435,42 @@ def test_golden_prefixmgr_validate(live_node):
 
 def test_golden_openr_summary(live_node):
     check_golden("openr_summary", live_node, "openr", "summary")
+
+
+# round-4 option-depth commands
+
+
+def test_golden_openr_validate(live_node):
+    check_golden("openr_validate", live_node, "openr", "validate")
+
+
+def test_golden_openr_validate_json(live_node):
+    check_golden(
+        "openr_validate_json", live_node, "openr", "validate", "--json"
+    )
+
+
+def test_golden_decision_adj_json(live_node):
+    check_golden(
+        "decision_adj_json", live_node, "decision", "adj", "--json"
+    )
+
+
+def test_golden_decision_routes_all(live_node):
+    check_golden(
+        "decision_routes_all", live_node, "decision", "routes", "--nodes",
+        "all",
+    )
+
+
+def test_golden_spark_neighbors_detail(live_node):
+    check_golden(
+        "spark_neighbors_detail", live_node, "spark", "neighbors",
+        "--detail",
+    )
+
+
+def test_golden_config_prefix_manager(live_node):
+    check_golden(
+        "config_prefix_manager", live_node, "config", "prefix-manager"
+    )
