@@ -160,6 +160,74 @@ int spf_scalar_solve(int32_t num_edges,
   return 0;
 }
 
+// Simultaneous-set variant: skip every directed edge whose undirected
+// link id is in failed_links[0..n_failed) — "what if ALL these links
+// fail at once" (maintenance-window analysis).  n_failed is tiny
+// (operator-listed links), so a linear membership scan beats building
+// a lookup table per solve.
+int spf_scalar_solve_set(int32_t num_edges,
+                         int32_t num_nodes,
+                         const int32_t* dst,
+                         const float* w,
+                         const uint8_t* edge_ok,
+                         const int32_t* link_index,
+                         const uint8_t* overloaded,
+                         const int32_t* row_ptr,
+                         const int32_t* edge_order,
+                         const int32_t* lane_of_edge,
+                         int32_t root,
+                         const int32_t* failed_links,
+                         int32_t n_failed,
+                         float* dist,
+                         uint64_t* nh_mask,
+                         void* heap_buf,
+                         uint8_t* settled) {
+  if (root < 0 || root >= num_nodes) return -1;
+  const float inf = std::numeric_limits<float>::infinity();
+  for (int32_t v = 0; v < num_nodes; ++v) {
+    dist[v] = inf;
+    nh_mask[v] = 0;
+    settled[v] = 0;
+  }
+  Heap heap(reinterpret_cast<HeapEntry*>(heap_buf));
+  heap.clear();
+  dist[root] = 0.0f;
+  heap.push(0.0f, root);
+  HeapEntry top;
+  while (heap.pop(&top)) {
+    const int32_t u = top.node;
+    if (settled[u] || top.dist > dist[u]) continue;
+    settled[u] = 1;
+    if (overloaded[u] && u != root) continue;
+    const uint64_t mask_u = nh_mask[u];
+    for (int32_t i = row_ptr[u]; i < row_ptr[u + 1]; ++i) {
+      const int32_t e = edge_order[i];
+      if (!edge_ok[e]) continue;
+      const int32_t li = link_index[e];
+      bool skip = false;
+      for (int32_t k = 0; k < n_failed; ++k) {
+        if (li >= 0 && li == failed_links[k]) { skip = true; break; }
+      }
+      if (skip) continue;
+      const int32_t v = dst[e];
+      if (settled[v]) continue;
+      const float nd = dist[u] + w[e];
+      const int32_t lane = lane_of_edge[e];
+      const uint64_t contrib = (u == root && lane >= 0)
+                                   ? (uint64_t(1) << lane)
+                                   : mask_u;
+      if (nd < dist[v]) {
+        dist[v] = nd;
+        nh_mask[v] = contrib;
+        heap.push(nd, v);
+      } else if (nd == dist[v]) {
+        nh_mask[v] |= contrib;
+      }
+    }
+  }
+  return 0;
+}
+
 // Timed sweep: `num_solves` sequential single-threaded solves with
 // per-solve failed links, exactly what a single-threaded SpfSolver would
 // do for the what-if batch.  Writes a checksum so the work cannot be

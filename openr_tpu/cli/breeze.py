@@ -1926,8 +1926,16 @@ def decision_route_detail(ctx: click.Context) -> None:
 @decision.command("whatif")
 @click.argument("links", nargs=-1, required=True,
                 metavar="NODE1,NODE2 [NODE1,NODE2 ...]")
+@click.option(
+    "--simultaneous",
+    is_flag=True,
+    help="fail ALL listed links AT ONCE (maintenance-window analysis) "
+    "instead of one at a time",
+)
 @click.pass_context
-def decision_whatif(ctx: click.Context, links: tuple) -> None:
+def decision_whatif(
+    ctx: click.Context, links: tuple, simultaneous: bool
+) -> None:
     """Which of this node's routes change if the given links fail?"""
     failures = []
     for spec in links:
@@ -1935,16 +1943,27 @@ def decision_whatif(ctx: click.Context, links: tuple) -> None:
         if len(parts) != 2:
             raise click.ClickException(f"bad link spec {spec!r}: NODE1,NODE2")
         failures.append(parts)
-    resp = _call(ctx, "get_link_failure_whatif", link_failures=failures)
+    resp = _call(
+        ctx,
+        "get_link_failure_whatif",
+        link_failures=failures,
+        simultaneous=simultaneous,
+    )
     if not resp["eligible"]:
         click.echo(
             "what-if engine not eligible (KSP2 in use, or a scalar-only "
             "deployment with a multi-area LSDB / a vantage fan-out "
-            "beyond the native engine's lane limit)"
+            "beyond the native engine's lane limit"
+            + (", or --simultaneous on a multi-area vantage)" if simultaneous
+               else ")")
         )
         return
     for f in resp["failures"]:
-        link = "-".join(f["link"])
+        link = (
+            " + ".join("-".join(l) for l in f["links"])
+            if "links" in f
+            else "-".join(f["link"])
+        )
         if "error" in f:
             click.echo(f"{link}: {f['error']}")
             continue

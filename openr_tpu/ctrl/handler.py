@@ -431,11 +431,19 @@ class OpenrCtrlHandler:
             out[prefix] = entries
         return out
 
-    def get_link_failure_whatif(self, link_failures: List[List[str]]) -> dict:
+    def get_link_failure_whatif(
+        self,
+        link_failures: List[List[str]],
+        simultaneous: bool = False,
+    ) -> dict:
         """Per-failure route deltas from this node's vantage for a batch
         of candidate link failures — the what-if sweep engine behind one
-        RPC (net-new vs the reference)."""
-        result = self.node.decision.get_link_failure_whatif(link_failures)
+        RPC (net-new vs the reference).  With ``simultaneous`` every
+        listed link fails AT ONCE (one combined answer; single-area
+        vantages)."""
+        result = self.node.decision.get_link_failure_whatif(
+            link_failures, simultaneous=simultaneous
+        )
         if result is None:
             return {"eligible": False, "failures": []}
         return result

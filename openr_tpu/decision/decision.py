@@ -409,13 +409,16 @@ class Decision(Actor):
         return solver.build_route_db(self.area_link_states, self.prefix_state)
 
     def get_link_failure_whatif(
-        self, link_failures: List
+        self, link_failures: List, simultaneous: bool = False
     ) -> Optional[dict]:
         """'Which of MY routes change if these links fail?' — one
         warm-start sweep over the candidate failures (the flagship
-        what-if machinery, cached per LSDB generation).  None =
-        ineligible (KSP2 / unsupported algorithm; multi-area on a
-        scalar-only deployment, whose device kernels never load)."""
+        what-if machinery, cached per LSDB generation).  With
+        ``simultaneous``, ALL listed links fail AT ONCE (maintenance-
+        window analysis; single-area vantages only).  None = ineligible
+        (KSP2 / unsupported algorithm; multi-area on a scalar-only
+        deployment, whose device kernels never load; simultaneous on a
+        multi-area vantage)."""
         scalar_only = isinstance(self.backend, ScalarBackend)
         fleet = self._fleet()
         if not fleet.eligible(
@@ -426,6 +429,10 @@ class Decision(Actor):
             # the multi-area engine is device-only; a scalar deployment
             # must never pull in the device stack
             return None
+        if simultaneous and len(self.area_link_states) != 1:
+            # set-failure analysis is single-area (the multi-area
+            # kernel solves one masked link per snapshot)
+            return None
         if len(self.area_link_states) == 1:
             # single-area vantage: pick the warm-start engine by where
             # it runs cheapest — the native C++ sweep solves a handful
@@ -433,7 +440,9 @@ class Decision(Actor):
             # dispatch round trips it can only amortize over large
             # batches (the same measured-RT calibration the backend's
             # device cutover uses)
-            use_native = self._use_native_whatif(len(link_failures))
+            use_native = self._use_native_whatif(
+                1 if simultaneous else len(link_failures)
+            )
             if scalar_only and not use_native:
                 # high-fanout vantage on a scalar-only deployment: the
                 # device fallback would load jax — stay ineligible
@@ -469,11 +478,13 @@ class Decision(Actor):
                 )
             engine = self._whatif_multi_engine
         try:
+            kwargs = {"simultaneous": True} if simultaneous else {}
             return engine.run(
                 [tuple(f) for f in link_failures],
                 self.area_link_states,
                 self.prefix_state,
                 self._change_seq,
+                **kwargs,
             )
         except ValueError:
             # e.g. an anycast prefix wider than the largest candidate

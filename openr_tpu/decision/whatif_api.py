@@ -154,9 +154,13 @@ class WhatIfApiEngine:
         area_link_states,
         prefix_state,
         change_seq: int,
+        simultaneous: bool = False,
     ) -> Dict:
         """One device sweep over the given candidate failures; returns
-        per-failure route deltas from this node's vantage."""
+        per-failure route deltas from this node's vantage.  With
+        ``simultaneous`` ALL listed links fail at once (one combined
+        failure entry — maintenance-window analysis over
+        LinkFailureSweep.run_sets)."""
         self._engine_for(area_link_states, prefix_state, change_seq)
         me = self.solver.my_node_name
         lane_names = lane_names_for(self._topo, me)
@@ -165,51 +169,86 @@ class WhatIfApiEngine:
         lids, errors = resolve_pair_failures(
             self._pair_links, link_failures
         )
+
+        def lanes_to_names(lane_row) -> List[str]:
+            return decode_lane_names(lane_names, lane_row)
+
+        def changes_from_row(deltas, row: int) -> List[dict]:
+            changes = []
+            if row == 0:
+                return changes
+            base_valid = deltas.base_valid
+            p_idx, valid, metric, lanes = deltas.deltas_of_row(row)
+            for k in range(len(p_idx)):
+                p = int(p_idx[k])
+                prefix = self._prefixes[p]
+                if prefix_is_v4(prefix) and not v4_ok:
+                    continue
+                was, now = bool(base_valid[p]), bool(valid[k])
+                changes.append(
+                    {
+                        "prefix": prefix,
+                        "change": change_kind(was, now),
+                        "old_nexthops": (
+                            lanes_to_names(deltas.base_lanes[p])
+                            if was
+                            else []
+                        ),
+                        "new_nexthops": (
+                            lanes_to_names(lanes[k]) if now else []
+                        ),
+                        "old_metric": (
+                            float(deltas.base_metric[p]) if was else None
+                        ),
+                        "new_metric": float(metric[k]) if now else None,
+                    }
+                )
+            return changes
+
+        if simultaneous:
+            bad = [e for e in errors if e is not None]
+            if bad:
+                return {
+                    "eligible": True,
+                    "vantage": me,
+                    "simultaneous": True,
+                    "failures": bad,
+                }
+            fail_set = tuple(int(l) for l in lids)
+            deltas = self._selector.run(
+                self._sweep.run_sets([fail_set], fetch=False)
+            )
+            self.num_sweeps += 1
+            changes = changes_from_row(deltas, int(deltas.snap_row[0]))
+            on_dag = self._sweep.on_dag_links()
+            return {
+                "eligible": True,
+                "vantage": me,
+                "simultaneous": True,
+                "failures": [
+                    {
+                        "links": [list(f) for f in link_failures],
+                        "on_shortest_path_dag": bool(
+                            any(on_dag[l] for l in fail_set)
+                        ),
+                        "routes_changed": len(changes),
+                        "changes": changes,
+                    }
+                ],
+            }
+
         fails = [lid if lid is not None else -1 for lid in lids]
         deltas = self._selector.run(
             self._sweep.run(np.asarray(fails, np.int32), fetch=False)
         )
         self.num_sweeps += 1
 
-        def lanes_to_names(lane_row) -> List[str]:
-            return decode_lane_names(lane_names, lane_row)
-
-        base_valid = deltas.base_valid
         out = []
         for s, ((n1, n2), lid) in enumerate(zip(link_failures, lids)):
             if lid is None:
                 out.append(errors[s])
                 continue
-            changes = []
-            row = int(deltas.snap_row[s])
-            if row != 0:
-                p_idx, valid, metric, lanes = deltas.deltas_of_row(row)
-                for k in range(len(p_idx)):
-                    p = int(p_idx[k])
-                    prefix = self._prefixes[p]
-                    if prefix_is_v4(prefix) and not v4_ok:
-                        continue
-                    was, now = bool(base_valid[p]), bool(valid[k])
-                    changes.append(
-                        {
-                            "prefix": prefix,
-                            "change": change_kind(was, now),
-                            "old_nexthops": (
-                                lanes_to_names(deltas.base_lanes[p])
-                                if was
-                                else []
-                            ),
-                            "new_nexthops": (
-                                lanes_to_names(lanes[k]) if now else []
-                            ),
-                            "old_metric": (
-                                float(deltas.base_metric[p]) if was else None
-                            ),
-                            "new_metric": (
-                                float(metric[k]) if now else None
-                            ),
-                        }
-                    )
+            changes = changes_from_row(deltas, int(deltas.snap_row[s]))
             out.append(
                 {
                     "link": [n1, n2],
@@ -569,6 +608,7 @@ class NativeWhatIfEngine:
         area_link_states,
         prefix_state,
         change_seq: int,
+        simultaneous: bool = False,
     ) -> Dict:
         from openr_tpu.ops.np_select import select_routes_numpy
 
@@ -587,6 +627,80 @@ class NativeWhatIfEngine:
             ctx["pair_links"], link_failures
         )
         self.num_sweeps += 1
+
+        def select_current():
+            lanes = native.lanes_dense(D)
+            return select_routes_numpy(
+                *ctx["sel_args"],
+                native.dist,
+                lanes,
+                topo.overloaded,
+                ctx["soft"],
+                ctx["root_id"],
+            )
+
+        def diff_changes(valid, metric, nh_out) -> List[dict]:
+            diff = (valid != bvalid) | (
+                valid
+                & bvalid
+                & ((metric != bmetric) | (nh_out != bnh).any(axis=1))
+            )
+            changes = []
+            for p in np.nonzero(diff)[0]:
+                prefix = prefixes[p]
+                if prefix_is_v4(prefix) and not v4_ok:
+                    continue
+                was, now = bool(bvalid[p]), bool(valid[p])
+                changes.append(
+                    {
+                        "prefix": prefix,
+                        "change": change_kind(was, now),
+                        "old_nexthops": (
+                            lanes_to_names(bnh[p]) if was else []
+                        ),
+                        "new_nexthops": (
+                            lanes_to_names(nh_out[p]) if now else []
+                        ),
+                        "old_metric": float(bmetric[p]) if was else None,
+                        "new_metric": float(metric[p]) if now else None,
+                    }
+                )
+            return changes
+
+        if simultaneous:
+            bad = [e for e in errors if e is not None]
+            if bad:
+                return {
+                    "eligible": True,
+                    "vantage": me,
+                    "simultaneous": True,
+                    "failures": bad,
+                }
+            any_on_dag = any(native.link_on_dag[l] for l in lids)
+            if any_on_dag:
+                # native multi-link cold solve with the FULL set — an
+                # off-DAG member can carry the reroute once on-DAG
+                # members fail, so it must be removed too.  Only a set
+                # with NO on-DAG member provably changes nothing.
+                native.solve_set(list(lids))
+                valid, metric, nh_out, _n, _u = select_current()
+                changes = diff_changes(valid, metric, nh_out)
+            else:
+                changes = []
+            return {
+                "eligible": True,
+                "vantage": me,
+                "simultaneous": True,
+                "failures": [
+                    {
+                        "links": [list(f) for f in link_failures],
+                        "on_shortest_path_dag": any_on_dag,
+                        "routes_changed": len(changes),
+                        "changes": changes,
+                    }
+                ],
+            }
+
         out = []
         for s, ((n1, n2), lid) in enumerate(zip(link_failures, lids)):
             if lid is None:
@@ -598,46 +712,8 @@ class NativeWhatIfEngine:
                 native.warm_sweep(
                     np.asarray([lid], np.int32), keep_last=True
                 )
-                lanes = native.lanes_dense(D)
-                valid, metric, nh_out, _n, _u = select_routes_numpy(
-                    *ctx["sel_args"],
-                    native.dist,
-                    lanes,
-                    topo.overloaded,
-                    ctx["soft"],
-                    ctx["root_id"],
-                )
-                diff = (valid != bvalid) | (
-                    valid
-                    & bvalid
-                    & (
-                        (metric != bmetric)
-                        | (nh_out != bnh).any(axis=1)
-                    )
-                )
-                for p in np.nonzero(diff)[0]:
-                    prefix = prefixes[p]
-                    if prefix_is_v4(prefix) and not v4_ok:
-                        continue
-                    was, now = bool(bvalid[p]), bool(valid[p])
-                    changes.append(
-                        {
-                            "prefix": prefix,
-                            "change": change_kind(was, now),
-                            "old_nexthops": (
-                                lanes_to_names(bnh[p]) if was else []
-                            ),
-                            "new_nexthops": (
-                                lanes_to_names(nh_out[p]) if now else []
-                            ),
-                            "old_metric": (
-                                float(bmetric[p]) if was else None
-                            ),
-                            "new_metric": (
-                                float(metric[p]) if now else None
-                            ),
-                        }
-                    )
+                valid, metric, nh_out, _n, _u = select_current()
+                changes = diff_changes(valid, metric, nh_out)
             out.append(
                 {
                     "link": [n1, n2],

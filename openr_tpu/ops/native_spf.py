@@ -100,6 +100,27 @@ class NativeSpf:
             raise RuntimeError(f"spf_scalar_solve rc={rc}")
         return self.dist, self.nh_mask
 
+    def solve_set(
+        self, failed_links
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One solve with EVERY listed undirected link removed at once
+        (simultaneous multi-link failure; native spf_scalar_solve_set)."""
+        fl = np.ascontiguousarray(
+            np.asarray(list(failed_links), np.int32).reshape(-1)
+        )
+        rc = self.lib.spf_scalar_solve_set(
+            *self._common_args(),
+            _ptr(fl, ctypes.c_int32),
+            ctypes.c_int32(len(fl)),
+            _ptr(self.dist, ctypes.c_float),
+            _ptr(self.nh_mask, ctypes.c_uint64),
+            self._heap.ctypes.data_as(ctypes.c_void_p),
+            _ptr(self._settled, ctypes.c_uint8),
+        )
+        if rc != 0:
+            raise RuntimeError(f"spf_scalar_solve_set rc={rc}")
+        return self.dist, self.nh_mask
+
     def sweep(self, failed_links: np.ndarray) -> float:
         """num_solves sequential solves (the single-threaded what-if
         baseline).  Returns the checksum; last solve's outputs stay in

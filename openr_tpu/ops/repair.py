@@ -239,7 +239,7 @@ def _repair_sweep_impl(
     w,  # [E] float32
     lid,  # [E] int32 undirected link id (-1 pad)
     transit_src_ok,  # [E] bool
-    fails,  # [B] int32 failed link per snapshot (-1 = none)
+    fails,  # [B, K] int32 failed link SET per snapshot (-1 pads)
     aff_link_table,  # [L, Vw] uint32 per-link affected-vertex bitsets
     base_dist,  # [V] float32
     base_nh_bits,  # [V, D] uint32 (0/1)
@@ -263,11 +263,19 @@ def _repair_sweep_impl(
     D = d_lanes
 
     # ---- per-snapshot affected bitsets, looked up ON DEVICE -----------
-    # (the table ships once at engine init; per chunk only `fails` [B]
+    # (the table ships once at engine init; per chunk only `fails` [B, K]
     # crosses the host->device link — over a tunneled TPU the [B, Vw]
-    # rows per chunk were the dominant fixed cost)
-    aff_words = aff_link_table[jnp.clip(fails, 0, None)] * (
-        (fails >= 0).astype(jnp.uint32)[:, None]
+    # rows per chunk were the dominant fixed cost).
+    # A snapshot's affected set is the UNION over its failed links: if a
+    # vertex v is outside that union, no base shortest path to v crosses
+    # ANY failed link (a path crossing removed edge x->y would make v a
+    # DAG-descendant of y), so both its distance and lane set survive —
+    # the same contrapositive as the single-link case, link by link.
+    aff_k = aff_link_table[jnp.clip(fails, 0, None)] * (
+        (fails >= 0).astype(jnp.uint32)[:, :, None]
+    )  # [B, K, Vw]
+    aff_words = jax.lax.reduce(
+        aff_k, jnp.uint32(0), jnp.bitwise_or, dimensions=(1,)
     )  # [B, Vw]
 
     # ---- unpack to [V, B] bool ----------------------------------------
@@ -278,7 +286,9 @@ def _repair_sweep_impl(
 
     d0 = jnp.where(aff, BIG, base_dist[:, None])  # [V, B]
 
-    en = lid[:, None] != fails[None, :]  # [E, B]
+    # an edge is enabled iff its link id matches NO member of the
+    # snapshot's failure set (pads are -1, never equal to a real lid)
+    en = (lid[:, None, None] != fails[None, :, :]).all(axis=-1)  # [E, B]
     src_okc = transit_src_ok[:, None]
     limit = jnp.int32(V)
 
@@ -408,7 +418,9 @@ def _sharded_kernel(mesh, d_lanes: int, din: int):
         )
         return d, nh, rounds_d.reshape(1), rounds_l.reshape(1)
 
-    in_specs = tuple(bat if n == "fails" else rep for n in _ARG_ORDER)
+    in_specs = tuple(
+        P(BATCH_AXIS, None) if n == "fails" else rep for n in _ARG_ORDER
+    )
     fn = jax.jit(
         jax.shard_map(
             body,
@@ -497,14 +509,18 @@ class RepairSweep:
         return 32 * n
 
     def solve(self, fails: np.ndarray):
-        """``fails`` length must be a multiple of ``batch_granularity``
-        (pad with -1)."""
+        """``fails``: [B] single-link failures, or [B, K] simultaneous
+        failure SETS (row b fails every listed link at once; -1 pads
+        both forms).  B must be a multiple of ``batch_granularity``."""
         import jax
         import jax.numpy as jnp
 
         p = self.plan
         g = self.batch_granularity
-        if len(fails) % g:
+        fails = np.asarray(fails, np.int32)
+        if fails.ndim == 1:
+            fails = fails[:, None]
+        if fails.shape[0] % g:
             raise ValueError(
                 f"repair sweep batch must be a multiple of {g}"
             )
@@ -512,7 +528,7 @@ class RepairSweep:
             from openr_tpu.parallel.mesh import batch_sharding
 
             fails_d = jax.device_put(
-                np.asarray(fails, np.int32), batch_sharding(self.mesh)
+                fails, batch_sharding(self.mesh)
             )
             kern = _sharded_kernel(self.mesh, p.lanes, p.din)
             return kern(
@@ -620,9 +636,12 @@ def sort_by_depth(
     """Order a failure batch by estimated repair depth (shallow first).
     Returns (sorted_fails, order) with fails == sorted_fails[argsort
     (order)] — chunks of similar depth converge together instead of the
-    deepest snapshot gating the whole batch."""
-    keys = np.where(
+    deepest snapshot gating the whole batch.  For [B, K] failure SETS a
+    row's key is its deepest member (the convergence bound of the
+    union-affected region)."""
+    per_link = np.where(
         fails >= 0, plan.repair_depth[np.clip(fails, 0, None)], 0
     )
+    keys = per_link.max(axis=-1) if fails.ndim == 2 else per_link
     order = np.argsort(keys, kind="stable")
     return fails[order], order

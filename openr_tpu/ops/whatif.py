@@ -34,7 +34,7 @@ bandwidth waste when most rows alias the base.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -387,6 +387,81 @@ class LinkFailureSweep:
             b = bucket_for(len(chunk), self.solve_buckets)
             padded = np.full(b, -1, np.int32)
             padded[: len(chunk)] = chunk
+            dist_d, nh_d, _, _ = rs.solve(padded)
+            chunks.append((off, len(chunk), dist_d, nh_d))
+
+        result = SweepResult(
+            snap_row=snap_row,
+            num_device_solves=len(todo_sorted),
+            num_snapshots=B,
+            lanes=self.D,
+            chunks=chunks,
+            base=(base_dist, base_nh),
+        )
+        return result.materialize() if fetch else result
+
+    def run_sets(self, fail_sets, fetch: bool = True) -> SweepResult:
+        """Simultaneous multi-link what-if: snapshot b fails EVERY link
+        in ``fail_sets[b]`` at once (maintenance-window analysis).
+
+        ``fail_sets``: sequence of link-id iterables (or an [B, K] int32
+        array, -1 padded).  Exact per-snapshot results: the repair
+        kernel's affected region for a set is the union of per-link
+        affected bitsets (see _repair_sweep_impl; off-DAG members
+        contribute zero bitsets but their edges ARE disabled — a link
+        off the BASE DAG can still carry the reroute once on-DAG
+        members fail, so members are never dropped from a mixed set).
+        A set with NO on-DAG member provably aliases the base row (no
+        base shortest path crossed any of its links, and removals can't
+        shorten paths), and duplicate sets dedup to one device solve."""
+        plan = self.plan()
+        base_dist, base_nh = self.base_solve()
+        rs = self.repair_sweep()
+
+        eff: List[tuple] = []
+        for s in fail_sets:
+            members = sorted(
+                {
+                    int(l)
+                    for l in np.atleast_1d(np.asarray(s, np.int32))
+                    if 0 <= int(l) < len(plan.on_dag_link)
+                }
+            )
+            eff.append(tuple(members))
+        B = len(eff)
+        uniq: Dict[tuple, int] = {}
+        todo: List[tuple] = []
+        snap_row = np.zeros(B, np.int32)
+        for b, key in enumerate(eff):
+            if not any(plan.on_dag_link[l] for l in key):
+                continue  # whole set off-DAG: base alias
+            if key not in uniq:
+                uniq[key] = len(todo)
+                todo.append(key)
+        # depth-sort unique sets by deepest member (off-DAG members have
+        # depth 0 — they gate nothing)
+        depths = np.asarray(
+            [max(plan.repair_depth[list(k)]) for k in todo], np.int32
+        ) if todo else np.zeros(0, np.int32)
+        order = np.argsort(depths, kind="stable")
+        row_of_uniq = np.empty(len(todo), np.int32)
+        row_of_uniq[order] = 1 + np.arange(len(todo), dtype=np.int32)
+        for b, key in enumerate(eff):
+            if key in uniq:
+                snap_row[b] = row_of_uniq[uniq[key]]
+        todo_sorted = [todo[i] for i in order]
+        # bucket K (pad with -1) so interactive queries with 2-then-3-
+        # then-5 links reuse one compiled kernel shape per bucket
+        k_raw = max((len(k) for k in todo_sorted), default=1)
+        K = 1 << (k_raw - 1).bit_length() if k_raw > 1 else 1
+
+        chunks: List[tuple] = []
+        for off in range(0, len(todo_sorted), self.max_chunk):
+            chunk = todo_sorted[off : off + self.max_chunk]
+            b = bucket_for(len(chunk), self.solve_buckets)
+            padded = np.full((b, K), -1, np.int32)
+            for i, key in enumerate(chunk):
+                padded[i, : len(key)] = key
             dist_d, nh_d, _, _ = rs.solve(padded)
             chunks.append((off, len(chunk), dist_d, nh_d))
 

@@ -417,3 +417,14 @@ def test_cli_snoop_duration_bounds_idle_stream(live_node):
     t0 = time.monotonic()
     _run(live_node, "fib", "snoop", "--duration", "1", "--no-initial-dump")
     assert time.monotonic() - t0 < 10
+
+
+def test_cli_whatif_simultaneous(live_node):
+    """breeze decision whatif --simultaneous: all listed links fail at
+    once; on a 2-node line failing the only link withdraws node1's
+    routes."""
+    out = _run(
+        live_node, "decision", "whatif", "node0,node1", "--simultaneous"
+    )
+    assert "node0-node1" in out
+    assert "withdrawn" in out or "route(s) change" in out

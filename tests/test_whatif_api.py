@@ -352,3 +352,88 @@ print("CLEAN")
     )
     assert out.returncode == 0, out.stderr
     assert "CLEAN" in out.stdout
+
+
+# ---- simultaneous (set) failures -------------------------------------------
+
+
+def scalar_routes_without_links(d, dbs, pairs):
+    """Oracle: rebuild the LSDB with ALL listed links removed."""
+    import dataclasses
+
+    ls = LinkState("0")
+    sets = [frozenset(p) for p in pairs]
+    for node, db in dbs.items():
+        filtered = dataclasses.replace(
+            db,
+            adjacencies=[
+                a
+                for a in db.adjacencies
+                if frozenset((db.this_node_name, a.other_node_name))
+                not in sets
+            ],
+        )
+        ls.update_adjacency_database(filtered)
+    return SpfSolver("node0").build_route_db({"0": ls}, d.prefix_state)
+
+
+def apply_whatif_changes(base_view, failure):
+    got = dict(base_view)
+    for ch in failure["changes"]:
+        if ch["change"] == "withdrawn":
+            got.pop(ch["prefix"], None)
+        else:
+            got[ch["prefix"]] = (
+                round(ch["new_metric"], 1),
+                sorted(ch["new_nexthops"]),
+            )
+    return got
+
+
+@pytest.mark.parametrize("engine", ["device", "native"])
+def test_whatif_simultaneous_matches_scalar_multi_removal(engine):
+    """--simultaneous: the combined answer must equal the scalar oracle
+    with EVERY listed link removed at once, through both the device
+    (run_sets) and native (spf_scalar_solve_set) engines."""
+    d, dbs = build_decision()
+    # force the engine choice via the dispatch-RT calibration override
+    # (expensive RT -> native, free RT -> device)
+    d._whatif_rt_ms = 1000.0 if engine == "native" else 1e-6
+
+    base = SpfSolver("node0").build_route_db(
+        d.area_link_states, d.prefix_state
+    )
+    base_view = routes_view(base)
+
+    pairs = [("node0", "node1"), ("node5", "node6"), ("node10", "node14")]
+    resp = d.get_link_failure_whatif(
+        [list(p) for p in pairs], simultaneous=True
+    )
+    assert resp is not None and resp["eligible"]
+    assert resp.get("simultaneous") is True
+    (f,) = resp["failures"]
+    assert f["links"] == [list(p) for p in pairs]
+
+    oracle = routes_view(scalar_routes_without_links(d, dbs, pairs))
+    got = apply_whatif_changes(base_view, f)
+    assert got == oracle, engine
+
+
+def test_whatif_simultaneous_unknown_link_errors():
+    d, _dbs = build_decision()
+    resp = d.get_link_failure_whatif(
+        [["node0", "node1"], ["node0", "nope"]], simultaneous=True
+    )
+    assert resp["eligible"]
+    assert resp["failures"][0]["error"] == "unknown link"
+
+
+def test_whatif_simultaneous_multiarea_ineligible():
+    """Set-failure analysis is single-area; a multi-area vantage reports
+    ineligible instead of a wrong answer."""
+    d, _dbs = build_decision()
+    d.area_link_states["1"] = LinkState("1")
+    assert (
+        d.get_link_failure_whatif([["node0", "node1"]], simultaneous=True)
+        is None
+    )
