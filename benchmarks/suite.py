@@ -929,6 +929,89 @@ def bench_messaging(results: List[Dict], full: bool) -> None:
     )
 
 
+def bench_whatif_double_failures(results: List[Dict], full: bool) -> None:
+    """Exhaustive DOUBLE-failure analysis: every unordered pair of
+    links failed simultaneously (the maintenance-window question "is
+    there any second failure that partitions us?").  Pairs scale as
+    L^2/2 — the batch shape the set-repair kernel exists for; the
+    native baseline is the same exhaustive loop over
+    spf_scalar_solve_set (sampled, then extrapolated, when full=False).
+    """
+    import itertools
+
+    import numpy as np
+
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.emulation.topology import (
+        build_adj_dbs,
+        random_connected_edges,
+    )
+    from openr_tpu.ops.csr import encode_link_state
+    from openr_tpu.ops.native_spf import NativeSpf
+    from openr_tpu.ops.whatif import LinkFailureSweep
+
+    # pairs scale as L^2/2, with L = (nodes-1) tree edges + extra
+    # chords: 128 nodes + 128 chords -> L=255 -> ~32k solves (CPU
+    # smoke); --full 256+256 -> L=511 -> ~130k (a device-scale batch)
+    n_nodes, extra = (128, 128) if not full else (256, 256)
+    edges = random_connected_edges(n_nodes, extra, seed=21)
+    ls = LinkState("0")
+    for db in build_adj_dbs(edges).values():
+        ls.update_adjacency_database(db)
+    topo = encode_link_state(ls)
+    L = len(topo.links)
+    pairs = list(itertools.combinations(range(L), 2))
+
+    eng = LinkFailureSweep(topo, "node0")
+    eng.base_solve()
+    sets_mat = np.asarray(pairs, np.int32)
+    res = eng.run_sets(sets_mat, fetch=False)  # warm-up compile
+    res.block()
+    t0 = time.perf_counter()
+    res = eng.run_sets(sets_mat, fetch=False)
+    res.block()
+    device_s = time.perf_counter() - t0
+    # partition scan: pairs whose failure disconnects some node — one
+    # bool per UNIQUE solve row, then mapped through snap_row.  Only the
+    # dist chunks are fetched (one overlapped device_get); materialize()
+    # would also pull + bit-unpack the nh tables this scan never reads.
+    import jax
+
+    from openr_tpu.ops.consts import BIG
+
+    U = 1 + res.num_device_solves
+    row_partitions = np.zeros(U, bool)  # base row: connected graph
+    dists_h = jax.device_get([c[2] for c in res.chunks or []])
+    for (off, n, _dd, _nd), dist_h in zip(res.chunks or [], dists_h):
+        row_partitions[1 + off : 1 + off + n] = (
+            dist_h[: topo.num_nodes, :n] >= BIG
+        ).any(axis=0)
+    n_partitioning = int(row_partitions[res.snap_row].sum())
+
+    nat = NativeSpf(topo, "node0")
+    sample = pairs if full else pairs[:: max(1, len(pairs) // 2000)]
+    t0 = time.perf_counter()
+    for pr in sample:
+        nat.solve_set(list(pr))
+    native_s_sample = time.perf_counter() - t0
+    native_s = native_s_sample * (len(pairs) / len(sample))
+
+    results.append(
+        _result(
+            f"whatif_double_failures_L{L}",
+            len(pairs) / device_s,
+            "pairs/s",
+            pairs=len(pairs),
+            device_s=round(device_s, 3),
+            native_set_solver_s=round(native_s, 3),
+            native_sampled=not full,
+            speedup=round(native_s / device_s, 1),
+            partitioning_pairs=n_partitioning,
+            nodes=n_nodes,
+        )
+    )
+
+
 ALL_BENCHES = [
     bench_decision_initial,
     bench_decision_adj_update,
@@ -936,6 +1019,7 @@ ALL_BENCHES = [
     bench_parity_device_coverage,
     bench_fleet_rib,
     bench_p50_convergence,
+    bench_whatif_double_failures,
     bench_kvstore_persist,
     bench_kvstore_flood_convergence,
     bench_fib_programming,
@@ -952,6 +1036,12 @@ def main() -> None:
     p.add_argument("--only", default="",
                    help="substring filter on bench function names")
     args = p.parse_args()
+    # an explicit CPU request must win BEFORE the first jax import: a
+    # site hook may force-select a tunneled accelerator whose remote
+    # init blocks indefinitely (a CPU smoke run would hang forever)
+    from openr_tpu.ops.platform_env import honor_cpu_platform_request
+
+    honor_cpu_platform_request()
     results: List[Dict] = []
     t0 = time.time()
     for bench in ALL_BENCHES:
