@@ -1951,11 +1951,9 @@ def decision_whatif(
     )
     if not resp["eligible"]:
         click.echo(
-            "what-if engine not eligible (KSP2 in use, or a scalar-only "
-            "deployment with a multi-area LSDB / a vantage fan-out "
-            "beyond the native engine's lane limit"
-            + (", or --simultaneous on a multi-area vantage)" if simultaneous
-               else ")")
+            "what-if not answerable right now (no LSDB yet, or a "
+            "candidate table overflow) — KSP2/multi-area/scalar-only "
+            "configurations answer via the generic solver fallback"
         )
         return
     for f in resp["failures"]:

@@ -107,6 +107,7 @@ class Decision(Actor):
         self._whatif_engine = None
         self._whatif_multi_engine = None
         self._whatif_native_engine = None
+        self._whatif_generic_engine = None
         self._whatif_rt_ms = None
         self._debounce = AsyncDebounce(
             self,
@@ -408,6 +409,18 @@ class Decision(Actor):
         )
         return solver.build_route_db(self.area_link_states, self.prefix_state)
 
+    def _generic_whatif(self):
+        """Lazy algorithm-complete fallback engine (jax-free)."""
+        if self._whatif_generic_engine is None:
+            from openr_tpu.decision.whatif_api import (
+                GenericSolverWhatIfEngine,
+            )
+
+            self._whatif_generic_engine = GenericSolverWhatIfEngine(
+                self.solver
+            )
+        return self._whatif_generic_engine
+
     def get_link_failure_whatif(
         self, link_failures: List, simultaneous: bool = False
     ) -> Optional[dict]:
@@ -415,24 +428,41 @@ class Decision(Actor):
         warm-start sweep over the candidate failures (the flagship
         what-if machinery, cached per LSDB generation).  With
         ``simultaneous``, ALL listed links fail AT ONCE (maintenance-
-        window analysis; single-area vantages only).  None = ineligible
-        (KSP2 / unsupported algorithm; multi-area on a scalar-only
-        deployment, whose device kernels never load; simultaneous on a
-        multi-area vantage)."""
+        window analysis).  Queries the fast engines decline (KSP2 /
+        unsupported algorithms, multi-area on scalar-only deployments,
+        multi-area simultaneous) fall back to the algorithm-complete
+        GenericSolverWhatIfEngine: full solver build minus the links,
+        diffed — slower, but every configuration answers.  None only
+        when there is no LSDB yet or a build overflows the candidate
+        buckets."""
         scalar_only = isinstance(self.backend, ScalarBackend)
         fleet = self._fleet()
-        if not fleet.eligible(
-            self.area_link_states, self.prefix_state, self._change_seq
-        ):
+        if not self.area_link_states:
             return None
-        if scalar_only and len(self.area_link_states) != 1:
+        generic_reasons = (
+            # KSP2 / unsupported selection algorithm: only the full
+            # scalar solver implements it
+            not fleet.eligible(
+                self.area_link_states, self.prefix_state, self._change_seq
+            )
             # the multi-area engine is device-only; a scalar deployment
             # must never pull in the device stack
-            return None
-        if simultaneous and len(self.area_link_states) != 1:
-            # set-failure analysis is single-area (the multi-area
-            # kernel solves one masked link per snapshot)
-            return None
+            or (scalar_only and len(self.area_link_states) != 1)
+            # set-failure analysis: the multi-area kernel solves one
+            # masked link per snapshot
+            or (simultaneous and len(self.area_link_states) != 1)
+        )
+        if generic_reasons:
+            # algorithm-complete fallback: rebuild the LSDB minus the
+            # links and run the FULL solver (jax-free; slow but exact
+            # for every configuration the daemon can run)
+            return self._generic_whatif().run(
+                [tuple(f) for f in link_failures],
+                self.area_link_states,
+                self.prefix_state,
+                self._change_seq,
+                simultaneous=simultaneous,
+            )
         if len(self.area_link_states) == 1:
             # single-area vantage: pick the warm-start engine by where
             # it runs cheapest — the native C++ sweep solves a handful
@@ -444,9 +474,18 @@ class Decision(Actor):
                 1 if simultaneous else len(link_failures)
             )
             if scalar_only and not use_native:
-                # high-fanout vantage on a scalar-only deployment: the
-                # device fallback would load jax — stay ineligible
-                return None
+                # the device engine would load jax (forbidden on a
+                # scalar-only deployment) and the native engine declined
+                # (vantage fan-out beyond its lane limit, or a batch the
+                # calibration priced for the device): answer through the
+                # jax-free generic solver instead of going ineligible
+                return self._generic_whatif().run(
+                    [tuple(f) for f in link_failures],
+                    self.area_link_states,
+                    self.prefix_state,
+                    self._change_seq,
+                    simultaneous=simultaneous,
+                )
             if use_native:
                 if self._whatif_native_engine is None:
                     from openr_tpu.decision.whatif_api import (

@@ -20,8 +20,12 @@ eligibility: SHORTEST_DISTANCE / PER_AREA_SHORTEST_DISTANCE, no KSP2):
     same semantics the reference reaches scalar via getDecisionRouteDb
     (Decision.cpp:342).
 
-Anything else returns None and the operator falls back to per-failure
-scalar what-ifs via getRouteDbComputed semantics.
+Anything else (KSP2 / unsupported algorithms, multi-area on scalar-only
+deployments, multi-area simultaneous sets) answers through
+``GenericSolverWhatIfEngine``: a full solver build with the links
+actually removed, diffed against the current routes — slow but
+algorithm-complete, so every configuration the daemon can run gets a
+what-if answer.
 """
 
 from __future__ import annotations
@@ -723,3 +727,189 @@ class NativeWhatIfEngine:
                 }
             )
         return {"eligible": True, "vantage": me, "failures": out}
+
+
+class GenericSolverWhatIfEngine:
+    """Algorithm-complete what-if fallback: rebuild the LSDB with the
+    candidate links actually removed and run the FULL SpfSolver (the
+    same selection code every installed route went through), then diff
+    the route databases.
+
+    This is the slow path — one full scalar build per failure (or one
+    for a simultaneous set) — but it supports everything
+    ``build_route_db`` supports: KSP2_ED_ECMP, any
+    route_selection_algorithm, multi-area LSDBs, cross-area
+    redistribution, simultaneous sets.  jax-free, so scalar-only
+    deployments use it without loading the device stack.  It serves the
+    queries the fast engines decline (reference
+    Decision.cpp:342 getDecisionRouteDb computes any configured
+    algorithm; our fast engines cover the SHORTEST_DISTANCE family).
+    """
+
+    def __init__(self, solver) -> None:
+        self.solver = solver
+        self.num_builds = 0
+        self._cache_key = None
+        self._base_view = None
+        self._pair_links: Dict = {}
+
+    @staticmethod
+    def _pairs_map(area_link_states) -> Dict:
+        """pair -> occurrences across every area, through the SHARED
+        build_pair_links so link-identity semantics live in one place
+        (only uniqueness of the pair is consumed)."""
+        m: Dict = {}
+        for _area, ls in sorted(area_link_states.items()):
+            for pair, vals in build_pair_links(ls.all_links()).items():
+                m.setdefault(pair, []).extend(vals)
+        return m
+
+    @staticmethod
+    def _states_without(area_link_states, drop_pairs) -> Dict:
+        import dataclasses
+
+        from openr_tpu.decision.link_state import LinkState
+
+        out: Dict = {}
+        for area, ls in area_link_states.items():
+            nls = LinkState(area, ls.my_node_name)
+            for _node, db in sorted(ls.get_adjacency_databases().items()):
+                filtered = dataclasses.replace(
+                    db,
+                    adjacencies=[
+                        a
+                        for a in db.adjacencies
+                        if frozenset(
+                            (db.this_node_name, a.other_node_name)
+                        )
+                        not in drop_pairs
+                    ],
+                )
+                nls.update_adjacency_database(filtered)
+            out[area] = nls
+        return out
+
+    def run(
+        self,
+        link_failures: List[Tuple[str, str]],
+        area_link_states,
+        prefix_state,
+        change_seq: int,
+        simultaneous: bool = False,
+    ) -> Optional[Dict]:
+        me = self.solver.my_node_name
+
+        def view(db):
+            if db is None:  # vantage absent from the (modified) LSDB
+                return {}
+            return {
+                p: (
+                    float(e.igp_cost),
+                    sorted({n.neighbor_node_name for n in e.nexthops}),
+                )
+                for p, e in db.unicast_routes.items()
+            }
+
+        # base view + pair map cached per LSDB generation, like every
+        # other what-if engine
+        key = (
+            change_seq,
+            tuple(
+                (a, area_link_states[a].topology_seq)
+                for a in sorted(area_link_states)
+            ),
+        )
+        if self._cache_key != key:
+            base = self.solver.build_route_db(
+                area_link_states, prefix_state
+            )
+            self.num_builds += 1
+            if base is None:
+                return None  # no vantage in the LSDB yet -> ineligible
+            self._base_view = view(base)
+            self._pair_links = self._pairs_map(area_link_states)
+            self._cache_key = key
+        base_view = self._base_view
+        resolved, errors = resolve_pair_failures(
+            self._pair_links, link_failures
+        )
+        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+
+        def diff_against(mod_db) -> List[dict]:
+            mod_view = view(mod_db)
+            changes = []
+            for p in sorted(set(base_view) | set(mod_view)):
+                if prefix_is_v4(p) and not v4_ok:
+                    continue
+                old, new = base_view.get(p), mod_view.get(p)
+                if old == new:
+                    continue
+                changes.append(
+                    {
+                        "prefix": p,
+                        "change": change_kind(
+                            old is not None, new is not None
+                        ),
+                        "old_nexthops": old[1] if old else [],
+                        "new_nexthops": new[1] if new else [],
+                        "old_metric": old[0] if old else None,
+                        "new_metric": new[0] if new else None,
+                    }
+                )
+            return changes
+
+        def solve_without(drop_pairs) -> List[dict]:
+            mod = self._states_without(area_link_states, drop_pairs)
+            self.num_builds += 1
+            return diff_against(
+                self.solver.build_route_db(mod, prefix_state)
+            )
+
+        if simultaneous:
+            bad = [e for e in errors if e is not None]
+            if bad:
+                return {
+                    "eligible": True,
+                    "vantage": me,
+                    "engine": "generic-solver",
+                    "simultaneous": True,
+                    "failures": bad,
+                }
+            changes = solve_without(
+                {frozenset(p) for p in link_failures}
+            )
+            return {
+                "eligible": True,
+                "vantage": me,
+                "engine": "generic-solver",
+                "simultaneous": True,
+                "failures": [
+                    {
+                        "links": [list(f) for f in link_failures],
+                        "on_shortest_path_dag": bool(changes),
+                        "routes_changed": len(changes),
+                        "changes": changes,
+                    }
+                ],
+            }
+
+        out = []
+        for (n1, n2), hit, err in zip(link_failures, resolved, errors):
+            if hit is None:
+                out.append(err)
+                continue
+            changes = solve_without({frozenset((n1, n2))})
+            out.append(
+                {
+                    "link": [n1, n2],
+                    "on_shortest_path_dag": bool(changes),
+                    "routes_changed": len(changes),
+                    "changes": changes,
+                }
+            )
+        return {
+            "eligible": True,
+            "vantage": me,
+            "engine": "generic-solver",
+            "failures": out,
+        }

@@ -428,12 +428,59 @@ def test_whatif_simultaneous_unknown_link_errors():
     assert resp["failures"][0]["error"] == "unknown link"
 
 
-def test_whatif_simultaneous_multiarea_ineligible():
-    """Set-failure analysis is single-area; a multi-area vantage reports
-    ineligible instead of a wrong answer."""
-    d, _dbs = build_decision()
+def test_whatif_simultaneous_multiarea_uses_generic_engine():
+    """Set-failure analysis on a multi-area vantage (the fast engines
+    decline it) answers through the algorithm-complete generic solver
+    fallback instead of reporting ineligible."""
+    d, dbs = build_decision()
     d.area_link_states["1"] = LinkState("1")
-    assert (
-        d.get_link_failure_whatif([["node0", "node1"]], simultaneous=True)
-        is None
+    resp = d.get_link_failure_whatif(
+        [["node0", "node1"], ["node5", "node6"]], simultaneous=True
     )
+    assert resp is not None and resp["eligible"]
+    assert resp["engine"] == "generic-solver"
+    (f,) = resp["failures"]
+    # parity vs the scalar oracle with both links removed
+    base_view = routes_view(
+        SpfSolver("node0").build_route_db(d.area_link_states, d.prefix_state)
+    )
+    oracle = routes_view(
+        scalar_routes_without_links(
+            d, dbs, [("node0", "node1"), ("node5", "node6")]
+        )
+    )
+    assert apply_whatif_changes(base_view, f) == oracle
+
+
+def test_scalar_only_high_fanout_uses_generic_engine():
+    """A scalar-only vantage with more out-links than the native
+    engine's 64-lane limit must answer through the jax-free generic
+    engine, not return ineligible (code-review r4): previously this
+    configuration had NO eligible engine."""
+    star = [("node0", f"leaf{i}", 1) for i in range(70)]
+    dbs = build_adj_dbs(star)
+    ls = LinkState("0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(70):
+        ps.update_prefix(f"leaf{i}", "0", PrefixEntry(f"10.0.{i}.0/24"))
+    solver = SpfSolver("node0")
+    d = Decision(
+        "node0",
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue("routes"),
+        backend=ScalarBackend(solver),
+        solver=solver,
+    )
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    resp = d.get_link_failure_whatif([["node0", "leaf3"]])
+    assert resp is not None and resp["eligible"]
+    assert resp["engine"] == "generic-solver"
+    assert d._whatif_engine is None  # device engine never constructed
+    (f,) = resp["failures"]
+    assert f["routes_changed"] == 1
+    assert f["changes"][0]["prefix"] == "10.0.3.0/24"
+    assert f["changes"][0]["change"] == "removed"

@@ -203,3 +203,125 @@ def test_batch_bucketing_independent_of_query_size():
         many, ("a1", "b0")
     )
     assert eng.num_engine_builds == 1  # same generation, cached context
+
+
+# ---- generic-solver fallback (algorithm-complete what-if) ------------------
+
+
+def _oracle_view_without(me, ps, drop_pairs):
+    """Oracle with ALL listed pairs removed from every area at once."""
+    mutated = {
+        a: make_ls(
+            [
+                (n1, n2, m)
+                for (n1, n2, m) in edges
+                if frozenset((n1, n2)) not in drop_pairs
+            ],
+            a,
+            me=me,
+        )
+        for a, edges in AREA_EDGES.items()
+    }
+    return oracle_view(me, mutated, ps)
+
+
+def _apply_changes(base_view, failure):
+    got = {p: (m, set(nhs)) for p, (m, nhs) in base_view.items()}
+    for ch in failure["changes"]:
+        if ch["change"] == "removed":
+            got.pop(ch["prefix"], None)
+        else:
+            got[ch["prefix"]] = (
+                ch["new_metric"],
+                set(ch["new_nexthops"]),
+            )
+    return got
+
+
+def test_generic_fallback_multiarea_simultaneous():
+    """Multi-area --simultaneous (the fast engines decline it) must
+    answer through the generic solver engine with oracle parity."""
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision.backend import ScalarBackend
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.messaging.queue import ReplicateQueue
+
+    me = "b0"
+    ps = make_prefixes()
+    d = Decision(
+        me,
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue(),
+        backend=ScalarBackend(SpfSolver(me)),
+    )
+    d.area_link_states = two_area_world(me)
+    d.prefix_state = ps
+    d._change_seq = 3
+    pairs = [("a1", "b0"), ("b0", "b1")]
+    res = d.get_link_failure_whatif(
+        [list(p) for p in pairs], simultaneous=True
+    )
+    assert res is not None and res["eligible"]
+    assert res["engine"] == "generic-solver"
+    (f,) = res["failures"]
+
+    base = {
+        p: (m, set(nhs))
+        for p, (m, nhs) in oracle_view(me, two_area_world(me), ps).items()
+    }
+    want = {
+        p: (m, set(nhs))
+        for p, (m, nhs) in _oracle_view_without(
+            me, ps, {frozenset(p) for p in pairs}
+        ).items()
+    }
+    assert _apply_changes(
+        {p: (m, sorted(s)) for p, (m, s) in base.items()}, f
+    ) == want
+
+
+def test_generic_fallback_ksp2_answers():
+    """KSP2_ED_ECMP vantages (fleet-ineligible) must still answer
+    what-ifs via the generic solver engine, matching the KSP2 oracle."""
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision.backend import ScalarBackend
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.types import PrefixForwardingAlgorithm
+
+    me = "b0"
+    ps = PrefixState()
+    ps.update_prefix(
+        "b2",
+        "2",
+        PrefixEntry(
+            "10.1.0.0/24",
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        ),
+    )
+    solver = SpfSolver(me)
+    d = Decision(
+        me,
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue(),
+        backend=ScalarBackend(solver),
+        solver=solver,
+    )
+    d.area_link_states = two_area_world(me)
+    d.prefix_state = ps
+    d._change_seq = 5
+    res = d.get_link_failure_whatif([("b0", "b1")])
+    assert res is not None and res["eligible"]
+    assert res["engine"] == "generic-solver"
+    (f,) = res["failures"]
+    # KSP2 oracle diff: full solver with the link removed
+    base = oracle_view(me, two_area_world(me), ps)
+    want = _oracle_view_without(me, ps, {frozenset(("b0", "b1"))})
+    changed = {
+        p for p in set(base) | set(want) if base.get(p) != want.get(p)
+    }
+    assert {c["prefix"] for c in f["changes"]} == changed
