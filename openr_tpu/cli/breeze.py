@@ -1962,6 +1962,8 @@ def decision_whatif(
             if "links" in f
             else "-".join(f["link"])
         )
+        if f.get("links_failed"):
+            link += f" (all {f['links_failed']} links between pair)"
         if "error" in f:
             click.echo(f"{link}: {f['error']}")
             continue

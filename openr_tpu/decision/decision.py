@@ -409,6 +409,21 @@ class Decision(Actor):
         )
         return solver.build_route_db(self.area_link_states, self.prefix_state)
 
+    def _query_has_link_bundle(self, link_failures) -> bool:
+        """True when any queried pair maps to MORE than one link across
+        the LSDB (parallel links, or the pair advertised in several
+        areas) — those fail as a set, which the multi-area kernel can't
+        express, so the query routes to the generic engine."""
+        counts: Dict = {}
+        for ls in self.area_link_states.values():
+            for link in ls.all_links():
+                k = frozenset((link.n1, link.n2))
+                counts[k] = counts.get(k, 0) + 1
+        return any(
+            counts.get(frozenset((n1, n2)), 0) > 1
+            for n1, n2 in link_failures
+        )
+
     def _generic_whatif(self):
         """Lazy algorithm-complete fallback engine (jax-free)."""
         if self._whatif_generic_engine is None:
@@ -449,8 +464,16 @@ class Decision(Actor):
             # must never pull in the device stack
             or (scalar_only and len(self.area_link_states) != 1)
             # set-failure analysis: the multi-area kernel solves one
-            # masked link per snapshot
-            or (simultaneous and len(self.area_link_states) != 1)
+            # masked link per snapshot — that also rules out bundles
+            # (parallel links / pairs spanning areas), which the other
+            # engines answer as sets
+            or (
+                len(self.area_link_states) != 1
+                and (
+                    simultaneous
+                    or self._query_has_link_bundle(link_failures)
+                )
+            )
         )
         if generic_reasons:
             # algorithm-complete fallback: rebuild the LSDB minus the

@@ -44,24 +44,31 @@ from openr_tpu.types import prefix_is_v4
 FAILURE_BUCKETS = (4, 16, 64, 256)
 
 
-def resolve_pair_failures(pair_links: Dict, link_failures):
+def resolve_pair_failures(pair_links: Dict, link_failures,
+                          allow_parallel: bool = False):
     """Resolve (n1, n2) pairs against a pair→links map.  Returns
-    (values, errors), one entry per failure: values[i] is the unique
-    link value or None; errors[i] is None or a ready-to-emit error row
-    (unknown pair / ambiguous parallel links).  Shared by both what-if
-    engines so their operator-facing semantics cannot drift."""
+    (values, errors), one entry per failure; errors[i] is None or a
+    ready-to-emit error row.  Without ``allow_parallel`` values[i] is
+    the unique link value or None (pairs with multiple links error —
+    engines without set solves would mislead by failing just one).
+    With ``allow_parallel`` values[i] is ALWAYS a tuple of every link
+    between the pair (1-tuple for a unique link): the engine fails the
+    whole bundle as one simultaneous set.  Shared by every what-if
+    engine so their operator-facing semantics cannot drift."""
     values, errors = [], []
     for n1, n2 in link_failures:
         hits = pair_links.get(frozenset((n1, n2)), [])
-        if len(hits) == 1:
-            values.append(hits[0])
-            errors.append(None)
-        elif not hits:
+        if not hits:
             values.append(None)
             errors.append({"link": [n1, n2], "error": "unknown link"})
+        elif allow_parallel:
+            values.append(tuple(hits))
+            errors.append(None)
+        elif len(hits) == 1:
+            values.append(hits[0])
+            errors.append(None)
         else:
-            # parallel links (failing only one would mislead: traffic
-            # shifts to the survivors)
+            # engines without set solves reject parallel pairs
             values.append(None)
             errors.append(
                 {
@@ -69,7 +76,7 @@ def resolve_pair_failures(pair_links: Dict, link_failures):
                     "error": (
                         f"{len(hits)} parallel links between pair; "
                         "single-link what-if would shift traffic to "
-                        "the survivors — not supported"
+                        "the survivors — not supported by this engine"
                     ),
                 }
             )
@@ -170,8 +177,10 @@ class WhatIfApiEngine:
         lane_names = lane_names_for(self._topo, me)
         v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
 
-        lids, errors = resolve_pair_failures(
-            self._pair_links, link_failures
+        # allow_parallel returns every resolved failure as a tuple of
+        # link ids (a bundle fails as one simultaneous set via run_sets)
+        lid_sets, errors = resolve_pair_failures(
+            self._pair_links, link_failures, allow_parallel=True
         )
 
         def lanes_to_names(lane_row) -> List[str]:
@@ -218,7 +227,9 @@ class WhatIfApiEngine:
                     "simultaneous": True,
                     "failures": bad,
                 }
-            fail_set = tuple(int(l) for l in lids)
+            fail_set = tuple(
+                int(l) for tup in lid_sets for l in tup  # type: ignore[union-attr]
+            )
             deltas = self._selector.run(
                 self._sweep.run_sets([fail_set], fetch=False)
             )
@@ -241,28 +252,35 @@ class WhatIfApiEngine:
                 ],
             }
 
-        fails = [lid if lid is not None else -1 for lid in lids]
+        # per-failure snapshots: a parallel bundle is one snapshot that
+        # fails its whole link set; error rows become empty sets (base)
         deltas = self._selector.run(
-            self._sweep.run(np.asarray(fails, np.int32), fetch=False)
+            self._sweep.run_sets(
+                [s if s is not None else () for s in lid_sets],
+                fetch=False,
+            )
         )
         self.num_sweeps += 1
 
+        on_dag = self._sweep.on_dag_links()
         out = []
-        for s, ((n1, n2), lid) in enumerate(zip(link_failures, lids)):
-            if lid is None:
+        for s, ((n1, n2), tup) in enumerate(zip(link_failures, lid_sets)):
+            if tup is None:
                 out.append(errors[s])
                 continue
             changes = changes_from_row(deltas, int(deltas.snap_row[s]))
-            out.append(
-                {
-                    "link": [n1, n2],
-                    "on_shortest_path_dag": bool(
-                        self._sweep.on_dag_links()[lid]
-                    ),
-                    "routes_changed": len(changes),
-                    "changes": changes,
-                }
-            )
+            entry = {
+                "link": [n1, n2],
+                "on_shortest_path_dag": bool(
+                    any(on_dag[l] for l in tup)
+                ),
+                "routes_changed": len(changes),
+                "changes": changes,
+            }
+            if len(tup) > 1:
+                # the pair is a bundle (parallel links): ALL failed
+                entry["links_failed"] = len(tup)
+            out.append(entry)
         return {"eligible": True, "vantage": me, "failures": out}
 
 
@@ -627,8 +645,8 @@ class NativeWhatIfEngine:
         def lanes_to_names(row) -> List[str]:
             return decode_lane_names(lane_names, row)
 
-        lids, errors = resolve_pair_failures(
-            ctx["pair_links"], link_failures
+        lid_sets, errors = resolve_pair_failures(
+            ctx["pair_links"], link_failures, allow_parallel=True
         )
         self.num_sweeps += 1
 
@@ -680,13 +698,14 @@ class NativeWhatIfEngine:
                     "simultaneous": True,
                     "failures": bad,
                 }
-            any_on_dag = any(native.link_on_dag[l] for l in lids)
+            all_lids = [l for tup in lid_sets for l in tup]  # type: ignore[union-attr]
+            any_on_dag = any(native.link_on_dag[l] for l in all_lids)
             if any_on_dag:
                 # native multi-link cold solve with the FULL set — an
                 # off-DAG member can carry the reroute once on-DAG
                 # members fail, so it must be removed too.  Only a set
                 # with NO on-DAG member provably changes nothing.
-                native.solve_set(list(lids))
+                native.solve_set(all_lids)
                 valid, metric, nh_out, _n, _u = select_current()
                 changes = diff_changes(valid, metric, nh_out)
             else:
@@ -706,26 +725,33 @@ class NativeWhatIfEngine:
             }
 
         out = []
-        for s, ((n1, n2), lid) in enumerate(zip(link_failures, lids)):
-            if lid is None:
+        for s, ((n1, n2), tup) in enumerate(zip(link_failures, lid_sets)):
+            if tup is None:
                 out.append(errors[s])
                 continue
-            on_dag = bool(native.link_on_dag[lid])
+            on_dag = bool(any(native.link_on_dag[l] for l in tup))
             changes = []
             if on_dag:
-                native.warm_sweep(
-                    np.asarray([lid], np.int32), keep_last=True
-                )
+                if len(tup) == 1:
+                    # single link: the warm incremental sweep
+                    native.warm_sweep(
+                        np.asarray([tup[0]], np.int32), keep_last=True
+                    )
+                else:
+                    # parallel bundle: fail every member at once (cold
+                    # set solve — same removal the device engine does)
+                    native.solve_set(list(tup))
                 valid, metric, nh_out, _n, _u = select_current()
                 changes = diff_changes(valid, metric, nh_out)
-            out.append(
-                {
-                    "link": [n1, n2],
-                    "on_shortest_path_dag": on_dag,
-                    "routes_changed": len(changes),
-                    "changes": changes,
-                }
-            )
+            entry = {
+                "link": [n1, n2],
+                "on_shortest_path_dag": on_dag,
+                "routes_changed": len(changes),
+                "changes": changes,
+            }
+            if len(tup) > 1:
+                entry["links_failed"] = len(tup)
+            out.append(entry)
         return {"eligible": True, "vantage": me, "failures": out}
 
 
@@ -830,8 +856,10 @@ class GenericSolverWhatIfEngine:
             self._pair_links = self._pairs_map(area_link_states)
             self._cache_key = key
         base_view = self._base_view
+        # parallel bundles are fine here: removal is by node PAIR, which
+        # drops every parallel adjacency at once
         resolved, errors = resolve_pair_failures(
-            self._pair_links, link_failures
+            self._pair_links, link_failures, allow_parallel=True
         )
         v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
 
@@ -899,14 +927,17 @@ class GenericSolverWhatIfEngine:
                 out.append(err)
                 continue
             changes = solve_without({frozenset((n1, n2))})
-            out.append(
-                {
-                    "link": [n1, n2],
-                    "on_shortest_path_dag": bool(changes),
-                    "routes_changed": len(changes),
-                    "changes": changes,
-                }
-            )
+            entry = {
+                "link": [n1, n2],
+                "on_shortest_path_dag": bool(changes),
+                "routes_changed": len(changes),
+                "changes": changes,
+            }
+            if len(hit) > 1:
+                # bundle: parallel links in one area, or the pair's
+                # links across several areas — all removed at once
+                entry["links_failed"] = len(hit)
+            out.append(entry)
         return {
             "eligible": True,
             "vantage": me,

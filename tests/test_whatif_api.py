@@ -484,3 +484,93 @@ def test_scalar_only_high_fanout_uses_generic_engine():
     assert f["routes_changed"] == 1
     assert f["changes"][0]["prefix"] == "10.0.3.0/24"
     assert f["changes"][0]["change"] == "removed"
+
+
+def _parallel_world():
+    """a ==2 parallel links== b -- c; prefixes on b and c."""
+    from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+    def db(me, adjs):
+        return AdjacencyDatabase(
+            this_node_name=me,
+            adjacencies=[
+                Adjacency(
+                    other_node_name=o,
+                    if_name=i,
+                    metric=m,
+                    other_if_name=ri,
+                )
+                for (o, i, m, ri) in adjs
+            ],
+        )
+
+    ls = LinkState("0")
+    ls.update_adjacency_database(
+        db("a", [("b", "if_ab1", 1, "if_ba1"), ("b", "if_ab2", 2, "if_ba2")])
+    )
+    ls.update_adjacency_database(
+        db(
+            "b",
+            [
+                ("a", "if_ba1", 1, "if_ab1"),
+                ("a", "if_ba2", 2, "if_ab2"),
+                ("c", "if_bc", 1, "if_cb"),
+            ],
+        )
+    )
+    ls.update_adjacency_database(db("c", [("b", "if_cb", 1, "if_bc")]))
+    ps = PrefixState()
+    ps.update_prefix("b", "0", PrefixEntry("10.0.1.0/24"))
+    ps.update_prefix("c", "0", PrefixEntry("10.0.2.0/24"))
+    return ls, ps
+
+
+@pytest.mark.parametrize("engine", ["device", "native"])
+def test_whatif_parallel_bundle_fails_as_set(engine):
+    """A (n1, n2) pair with PARALLEL links no longer errors: the engines
+    fail the whole bundle as one simultaneous set (failing just one
+    would shift traffic to the survivors and mislead)."""
+    ls, ps = _parallel_world()
+    assert len(ls.all_links()) == 3  # 2 parallel a-b + 1 b-c
+    solver = SpfSolver("a")
+    d = Decision(
+        "a",
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue("routes"),
+        backend=(TpuBackend if engine == "device" else ScalarBackend)(
+            solver
+        ),
+        solver=solver,
+    )
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    d._whatif_rt_ms = 1000.0 if engine == "native" else 1e-6
+    resp = d.get_link_failure_whatif([["a", "b"]])
+    assert resp is not None and resp["eligible"]
+    (f,) = resp["failures"]
+    assert "error" not in f
+    assert f["links_failed"] == 2
+    # both a-b links down => b and c unreachable: both prefixes removed
+    assert f["routes_changed"] == 2
+    assert {c["prefix"] for c in f["changes"]} == {
+        "10.0.1.0/24",
+        "10.0.2.0/24",
+    }
+    assert all(c["change"] == "removed" for c in f["changes"])
+
+
+def test_whatif_parallel_bundle_generic_engine_matches():
+    """The generic solver engine answers the same bundle identically."""
+    from openr_tpu.decision.whatif_api import GenericSolverWhatIfEngine
+
+    ls, ps = _parallel_world()
+    eng = GenericSolverWhatIfEngine(SpfSolver("a"))
+    resp = eng.run([("a", "b")], {"0": ls}, ps, change_seq=1)
+    (f,) = resp["failures"]
+    assert f["links_failed"] == 2
+    assert {c["prefix"] for c in f["changes"]} == {
+        "10.0.1.0/24",
+        "10.0.2.0/24",
+    }
+    assert all(c["change"] == "removed" for c in f["changes"])

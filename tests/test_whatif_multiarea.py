@@ -325,3 +325,61 @@ def test_generic_fallback_ksp2_answers():
         p for p in set(base) | set(want) if base.get(p) != want.get(p)
     }
     assert {c["prefix"] for c in f["changes"]} == changed
+
+
+def test_multiarea_cross_area_pair_routes_to_generic_engine():
+    """A pair whose links span areas (or are parallel) can't be failed
+    by the multi-area kernel's one-masked-link snapshots; the query must
+    route to the generic engine and fail the whole bundle (code-review
+    r4: previously this errored on one deployment shape and answered on
+    others)."""
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.messaging.queue import ReplicateQueue
+
+    me = "b0"
+    ps = make_prefixes()
+    # give the a1-b0 pair a SECOND link by advertising it in area 2 too
+    area_edges = {
+        "1": AREA_EDGES["1"],
+        "2": ring_edges(4, prefix="b") + [("a1", "b0", 9)],
+    }
+    als = {
+        a: make_ls(e, a, me=me) for a, e in area_edges.items()
+    }
+    d = Decision(
+        me,
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue(),
+        backend=TpuBackend(SpfSolver(me)),
+    )
+    d.area_link_states = als
+    d.prefix_state = ps
+    d._change_seq = 9
+    resp = d.get_link_failure_whatif([("a1", "b0")])
+    assert resp is not None and resp["eligible"]
+    assert resp["engine"] == "generic-solver"
+    (f,) = resp["failures"]
+    assert f["links_failed"] == 2
+    # oracle: remove the pair everywhere
+    base = oracle_view(me, als, ps)
+    mutated = {
+        a: make_ls(
+            [
+                (n1, n2, m)
+                for (n1, n2, m) in e
+                if frozenset((n1, n2)) != frozenset(("a1", "b0"))
+            ],
+            a,
+            me=me,
+        )
+        for a, e in area_edges.items()
+    }
+    want = oracle_view(me, mutated, ps)
+    changed = {
+        p for p in set(base) | set(want) if base.get(p) != want.get(p)
+    }
+    assert {c["prefix"] for c in f["changes"]} == changed
