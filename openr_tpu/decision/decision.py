@@ -479,13 +479,16 @@ class Decision(Actor):
             # algorithm-complete fallback: rebuild the LSDB minus the
             # links and run the FULL solver (jax-free; slow but exact
             # for every configuration the daemon can run)
-            return self._generic_whatif().run(
+            result = self._generic_whatif().run(
                 [tuple(f) for f in link_failures],
                 self.area_link_states,
                 self.prefix_state,
                 self._change_seq,
                 simultaneous=simultaneous,
             )
+            if result is not None:
+                self.counters.bump("decision.whatif.engine.generic")
+            return result
         if len(self.area_link_states) == 1:
             # single-area vantage: pick the warm-start engine by where
             # it runs cheapest — the native C++ sweep solves a handful
@@ -502,13 +505,18 @@ class Decision(Actor):
                 # (vantage fan-out beyond its lane limit, or a batch the
                 # calibration priced for the device): answer through the
                 # jax-free generic solver instead of going ineligible
-                return self._generic_whatif().run(
+                result = self._generic_whatif().run(
                     [tuple(f) for f in link_failures],
                     self.area_link_states,
                     self.prefix_state,
                     self._change_seq,
                     simultaneous=simultaneous,
                 )
+                if result is not None:
+                    self.counters.bump(
+                        "decision.whatif.engine.generic"
+                    )
+                return result
             if use_native:
                 if self._whatif_native_engine is None:
                     from openr_tpu.decision.whatif_api import (
@@ -519,6 +527,7 @@ class Decision(Actor):
                         self.solver
                     )
                 engine = self._whatif_native_engine
+                engine_name = "native"
             else:
                 if self._whatif_engine is None:
                     from openr_tpu.decision.whatif_api import (
@@ -527,6 +536,7 @@ class Decision(Actor):
 
                     self._whatif_engine = WhatIfApiEngine(self.solver)
                 engine = self._whatif_engine
+                engine_name = "device"
         else:
             # multi-area LSDB: fleet-family kernel (per-snapshot masked
             # area re-solve + global selection + cross-area merge)
@@ -539,15 +549,19 @@ class Decision(Actor):
                     self.solver
                 )
             engine = self._whatif_multi_engine
+            engine_name = "multiarea"
         try:
             kwargs = {"simultaneous": True} if simultaneous else {}
-            return engine.run(
+            result = engine.run(
                 [tuple(f) for f in link_failures],
                 self.area_link_states,
                 self.prefix_state,
                 self._change_seq,
                 **kwargs,
             )
+            # counted only once an answer actually came back
+            self.counters.bump(f"decision.whatif.engine.{engine_name}")
+            return result
         except ValueError:
             # e.g. an anycast prefix wider than the largest candidate
             # bucket — ineligible, not an RPC error
