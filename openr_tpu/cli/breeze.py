@@ -1986,6 +1986,57 @@ def decision_whatif(
             click.echo(f"  {ch['prefix']:24} {ch['change']:9} {detail}")
 
 
+@decision.command("criticality")
+@click.option(
+    "--pairs",
+    default=0,
+    help="also scan up to N double-failure pairs for partition risk "
+    "(0 = links only)",
+)
+@click.option("--top", default=20, help="show the top N links")
+@click.pass_context
+def decision_criticality(ctx: click.Context, pairs: int, top: int) -> None:
+    """Rank every link by blast radius (routes withdrawn/changed if it
+    fails), optionally scanning all double failures for pairs that
+    withdraw routes NEITHER single failure does (partition risk).  One
+    batched device sweep — net-new vs the reference."""
+    resp = _call(ctx, "get_link_criticality", max_pairs=pairs)
+    if not resp["eligible"]:
+        click.echo(
+            "criticality report needs the device what-if engine "
+            "(single-area vantage, non-KSP2, --tpu deployment)"
+        )
+        return
+    click.echo(f"{'Link':28} {'On-DAG':6} {'Withdrawn':>9} {'Changed':>8}")
+    for e in resp["links"][:top]:
+        click.echo(
+            f"{'-'.join(e['link']):28} "
+            f"{'yes' if e['on_shortest_path_dag'] else 'no':6} "
+            f"{e['routes_withdrawn']:>9} {e['routes_changed']:>8}"
+        )
+    if len(resp["links"]) > top:
+        click.echo(f"... {len(resp['links']) - top} more links")
+    p = resp.get("pairs")
+    if p:
+        trunc = " (truncated)" if p["truncated"] else ""
+        click.echo(
+            f"\ndouble-failure scan: {p['checked']}/{p['total']} "
+            f"pairs{trunc}, {p['risky_count']} with partition risk"
+        )
+        for e in p["risky"][:top]:
+            la, lb = e["links"]
+            click.echo(
+                f"  {'-'.join(la)} + {'-'.join(lb)}: "
+                f"{e['routes_withdrawn']} withdrawn "
+                f"(+{e['beyond_single_failures']} beyond single failures)"
+            )
+        shown = min(top, len(p["risky"]))
+        if p["risky_count"] > shown:
+            click.echo(
+                f"  ... {p['risky_count'] - shown} more risky pair(s)"
+            )
+
+
 @decision.command("fleet-summary")
 @click.pass_context
 def decision_fleet_summary(ctx: click.Context) -> None:

@@ -448,6 +448,17 @@ class OpenrCtrlHandler:
             return {"eligible": False, "failures": []}
         return result
 
+    def get_link_criticality(self, max_pairs: int = 0) -> dict:
+        """Blast-radius ranking of every link (one device sweep) and an
+        optional exhaustive double-failure partition scan — net-new vs
+        the reference."""
+        result = self.node.decision.get_link_criticality(
+            max_pairs=max_pairs
+        )
+        if result is None:
+            return {"eligible": False, "links": [], "pairs": None}
+        return {"eligible": True, **result}
+
     def get_fleet_rib_summary(self) -> dict:
         """Every node's route counts from ONE batched device solve (the
         controller view; net-new vs the reference's one-node-per-call

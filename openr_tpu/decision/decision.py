@@ -424,6 +424,45 @@ class Decision(Actor):
             for n1, n2 in link_failures
         )
 
+    def get_link_criticality(self, max_pairs: int = 0) -> Optional[dict]:
+        """Blast-radius report: ONE device sweep failing EVERY link
+        ranks links by withdrawn/changed routes; ``max_pairs`` > 0 adds
+        an exhaustive double-failure scan (run_sets over on-DAG pairs,
+        capped) flagging pairs whose combined failure withdraws routes
+        neither single failure does — partition risk.  Net-new vs the
+        reference (its tooling answers one failure at a time); the
+        batch shape is exactly what the set-repair kernel exists for.
+        None = ineligible (device feature: scalar-only deployments and
+        multi-area vantages decline; KSP2 declines via fleet gating)."""
+        if isinstance(self.backend, ScalarBackend):
+            return None
+        if len(self.area_link_states) != 1:
+            return None
+        if not self._fleet().eligible(
+            self.area_link_states, self.prefix_state, self._change_seq
+        ):
+            return None
+        if self._whatif_engine is None:
+            from openr_tpu.decision.whatif_api import WhatIfApiEngine
+
+            self._whatif_engine = WhatIfApiEngine(self.solver)
+        from openr_tpu.decision.whatif_api import (
+            _whatif_engine_criticality,
+        )
+
+        try:
+            result = _whatif_engine_criticality(
+                self._whatif_engine,
+                self.area_link_states,
+                self.prefix_state,
+                self._change_seq,
+                max_pairs=max_pairs,
+            )
+        except ValueError:
+            return None
+        self.counters.bump("decision.criticality_reports")
+        return result
+
     def _generic_whatif(self):
         """Lazy algorithm-complete fallback engine (jax-free)."""
         if self._whatif_generic_engine is None:

@@ -284,6 +284,124 @@ class WhatIfApiEngine:
         return {"eligible": True, "vantage": me, "failures": out}
 
 
+def _whatif_engine_criticality(
+    engine: "WhatIfApiEngine",
+    area_link_states,
+    prefix_state,
+    change_seq: int,
+    max_pairs: int = 0,
+) -> Dict:
+    """Criticality report over the engine's cached sweep context."""
+    engine._engine_for(area_link_states, prefix_state, change_seq)
+    v4_ok = engine.solver.enable_v4 or engine.solver.v4_over_v6_nexthop
+    return _criticality_from_engine(
+        engine._sweep,
+        engine._selector,
+        engine._topo,
+        engine._prefixes,
+        max_pairs,
+        v4_ok,
+    )
+
+
+def _criticality_from_engine(
+    sweep, selector, topo, prefixes, max_pairs: int, v4_ok: bool
+) -> Dict:
+    """Shared criticality computation over a (sweep, selector) pair:
+    one single-failure sweep across EVERY link ranks blast radius; an
+    optional double-failure run_sets scan (capped at ``max_pairs``)
+    finds pairs whose combined failure withdraws routes that neither
+    single failure withdraws (partition risk).  Pairs with at least
+    one on-DAG member are scanned — an off-DAG link can carry the
+    reroute once its on-DAG partner fails (the canonical
+    primary+backup partition case), but a pair of two off-DAG links
+    provably changes nothing.  Counts skip v4 prefixes the node would
+    never install (same filter the what-if answers apply)."""
+    import itertools
+
+    L = len(topo.links)
+    fails = np.arange(L, dtype=np.int32)
+    deltas = selector.run(sweep.run(fails, fetch=False))
+    on_dag = sweep.on_dag_links()
+    #: prefix rows excluded from counts (v4 on a v6-only node)
+    skip_p = (
+        np.asarray([prefix_is_v4(p) for p in prefixes], bool)
+        if not v4_ok
+        else np.zeros(len(prefixes), bool)
+    )
+
+    def removed_of_row(dl, row: int):
+        if row == 0:
+            return 0, 0
+        p_idx, valid, _m, _l = dl.deltas_of_row(row)
+        keep = ~skip_p[p_idx]
+        removed = int((~valid[keep]).sum())
+        return int(keep.sum()), removed
+
+    links = []
+    single_removed = {}
+    for li in range(L):
+        changed, removed = removed_of_row(deltas, int(deltas.snap_row[li]))
+        link = topo.links[li]
+        single_removed[li] = removed
+        links.append(
+            {
+                "link": sorted((link.n1, link.n2)),
+                "on_shortest_path_dag": bool(on_dag[li]),
+                "routes_changed": changed,
+                "routes_withdrawn": removed,
+            }
+        )
+    links.sort(
+        key=lambda e: (-e["routes_withdrawn"], -e["routes_changed"],
+                       e["link"])
+    )
+
+    pairs_out = None
+    if max_pairs > 0:
+        n_off = int((~on_dag[:L]).sum())
+        # pairs with >= 1 on-DAG member, capped WITHOUT materializing
+        # the full O(L^2) product
+        def gen_pairs():
+            for a, b in itertools.combinations(range(L), 2):
+                if on_dag[a] or on_dag[b]:
+                    yield (a, b)
+
+        capped = list(itertools.islice(gen_pairs(), max_pairs))
+        total = L * (L - 1) // 2 - n_off * (n_off - 1) // 2
+        pair_deltas = selector.run(
+            sweep.run_sets(capped, fetch=False)
+        )
+        risky = []
+        for s, (a, b) in enumerate(capped):
+            _c, removed = removed_of_row(
+                pair_deltas, int(pair_deltas.snap_row[s])
+            )
+            extra = removed - single_removed[a] - single_removed[b]
+            if extra > 0:
+                la, lb = topo.links[a], topo.links[b]
+                risky.append(
+                    {
+                        "links": [
+                            sorted((la.n1, la.n2)),
+                            sorted((lb.n1, lb.n2)),
+                        ],
+                        "routes_withdrawn": removed,
+                        "beyond_single_failures": extra,
+                    }
+                )
+        risky.sort(key=lambda e: -e["beyond_single_failures"])
+        pairs_out = {
+            "checked": len(capped),
+            "total": total,
+            "truncated": len(capped) < total,
+            "risky": risky[:64],
+            "risky_count": len(risky),
+            "risky_truncated": len(risky) > 64,
+        }
+    return {"links": links, "pairs": pairs_out}
+
+
 class MultiAreaWhatIfEngine:
     """Multi-area link-failure what-if from this node's vantage.
 

@@ -18,13 +18,21 @@ from openr_tpu.emulation.topology import line_edges
 from openr_tpu.types import adj_key
 
 
-@pytest.fixture(scope="module")
-def live_node():
-    """A 2-node wall-clock network + ctrl server on a background loop.
+import contextlib
 
-    The CLI runs asyncio.run() internally, so the server must live on a
-    different thread's loop — exactly the daemon-vs-CLI process split.
-    """
+
+@contextlib.contextmanager
+def _live_ctrl_node(num_nodes=2, use_tpu_backend=False, ready=None):
+    """Background-thread network + ctrl server lifecycle (the CLI runs
+    asyncio.run() internally, so the server must live on a different
+    thread's loop — exactly the daemon-vs-CLI process split).  Yields
+    the ctrl port.  ``ready(net)`` gates startup."""
+    if ready is None:
+        def ready(net):
+            return adj_key("node1") in net.nodes["node0"].kv_store.dump_all(
+                "0"
+            )
+
     started = threading.Event()
     stop = None
     result = {}
@@ -38,16 +46,15 @@ def live_node():
 
         async def main():
             clock = WallClock()
-            net = EmulatedNetwork(clock)
-            net.build(line_edges(2))
+            net = EmulatedNetwork(clock, use_tpu_backend=use_tpu_backend)
+            net.build(line_edges(num_nodes))
             net.start()
             server = OpenrCtrlServer(net.nodes["node0"], port=0)
             await server.start()
             result["port"] = server.port
             result["net"] = net
-            # wait for spark establishment + adj advertisement
             for _ in range(200):
-                if adj_key("node1") in net.nodes["node0"].kv_store.dump_all("0"):
+                if ready(net):
                     break
                 await asyncio.sleep(0.1)
             started.set()
@@ -61,9 +68,18 @@ def live_node():
     t = threading.Thread(target=runner, daemon=True)
     t.start()
     assert started.wait(timeout=60), "live node failed to start"
-    yield result["port"]
-    result["loop"].call_soon_threadsafe(stop.set)
-    t.join(timeout=30)
+    try:
+        yield result["port"]
+    finally:
+        result["loop"].call_soon_threadsafe(stop.set)
+        t.join(timeout=30)
+
+
+@pytest.fixture(scope="module")
+def live_node():
+    """A 2-node wall-clock network + ctrl server on a background loop."""
+    with _live_ctrl_node() as port:
+        yield port
 
 
 def _run(port, *args):
@@ -428,3 +444,19 @@ def test_cli_whatif_simultaneous(live_node):
     )
     assert "node0-node1" in out
     assert "withdrawn" in out or "route(s) change" in out
+
+
+def test_cli_decision_criticality():
+    """breeze decision criticality against a TPU-backend live node."""
+    with _live_ctrl_node(
+        num_nodes=3,
+        use_tpu_backend=True,
+        ready=lambda net: len(net.nodes["node0"].fib.get_route_db()) >= 2,
+    ) as port:
+        out = _run(port, "decision", "criticality", "--pairs", "10")
+        # on a 3-node line from node0: node0-node1 withdraws 2 routes,
+        # node1-node2 withdraws 1
+        assert "node0-node1" in out and "node1-node2" in out
+        lines = [l for l in out.splitlines() if l.startswith("node")]
+        assert lines[0].startswith("node0-node1")
+        assert "double-failure scan" in out

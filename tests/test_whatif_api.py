@@ -574,3 +574,118 @@ def test_whatif_parallel_bundle_generic_engine_matches():
         "10.0.2.0/24",
     }
     assert all(c["change"] == "removed" for c in f["changes"])
+
+
+def test_link_criticality_matches_per_link_whatif():
+    """The criticality report's per-link counts must equal what the
+    per-link what-if reports, link by link."""
+    d, _dbs = build_decision()
+    crit = d.get_link_criticality()
+    assert crit is not None
+    assert len(crit["links"]) == 24  # 4x4 grid undirected links
+    # cross-check three links against the what-if answers
+    for e in crit["links"][:3]:
+        n1, n2 = e["link"]
+        resp = d.get_link_failure_whatif([[n1, n2]])
+        (f,) = resp["failures"]
+        assert f["routes_changed"] == e["routes_changed"], e
+        removed = sum(
+            1 for c in f["changes"] if c["change"] == "removed"
+        )
+        assert removed == e["routes_withdrawn"], e
+    # ranking is by withdrawn desc
+    w = [e["routes_withdrawn"] for e in crit["links"]]
+    assert w == sorted(w, reverse=True)
+
+
+def test_link_criticality_pair_scan_finds_partitions():
+    """Double-failure scan: pairs that withdraw routes beyond their
+    single failures must match a brute-force oracle on a small world."""
+    from openr_tpu.ops.native_spf import NativeSpf
+    from openr_tpu.ops.csr import encode_link_state
+
+    d, _dbs = build_decision()
+    crit = d.get_link_criticality(max_pairs=10_000)
+    p = crit["pairs"]
+    assert p is not None and not p["truncated"]
+    # oracle: for every scanned on-DAG pair, removed = prefixes whose
+    # advertiser becomes unreachable from node0 (single-advertiser
+    # world, all preferences equal)
+    ls = d.area_link_states["0"]
+    topo = encode_link_state(ls)
+    nat = NativeSpf(topo, "node0")
+    base_removed = {}
+    import itertools
+
+    import numpy as np
+
+    from openr_tpu.ops.whatif import LinkFailureSweep
+
+    eng = LinkFailureSweep(topo, "node0")
+    on_dag = eng.on_dag_links()
+    # same universe the engine scans: pairs with >= 1 on-DAG member
+    # (a pure off-DAG pair provably changes nothing)
+    pair_universe = [
+        (a, b)
+        for a, b in itertools.combinations(range(len(topo.links)), 2)
+        if on_dag[a] or on_dag[b]
+    ]
+    want_risky = 0
+    for a, b in pair_universe:
+        def removed_for(lids):
+            nd, _ = nat.solve_set(list(lids))
+            lanes = nat.lanes_dense(eng.D)
+            return sum(
+                1
+                for v in range(16)
+                if v != topo.node_id("node0")
+                and not (np.isfinite(nd[v]) and lanes[v].any())
+            )
+
+        extra = removed_for([a, b]) - removed_for([a]) - removed_for([b])
+        if extra > 0:
+            want_risky += 1
+    assert p["risky_count"] == want_risky
+
+
+def test_link_criticality_catches_primary_plus_backup_pairs():
+    """The canonical partition-risk case pairs an ON-DAG primary with
+    an OFF-DAG backup: each single failure merely reroutes (or changes
+    nothing), but together they partition.  The pair scan must include
+    on x off pairs (code-review r4: an on-DAG-only scan missed
+    exactly these)."""
+    edges = [
+        ("node0", "a", 1), ("a", "v", 1),      # cheap primary
+        ("node0", "b", 10), ("b", "v", 10),    # expensive backup
+    ]
+    dbs = build_adj_dbs(edges)
+    ls = LinkState("0")
+    for db in dbs.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for n in ("a", "b", "v"):
+        ps.update_prefix(n, "0", PrefixEntry(f"10.0.{ord(n[0])}.0/24"))
+    solver = SpfSolver("node0")
+    d = Decision(
+        "node0",
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue("routes"),
+        backend=TpuBackend(solver),
+        solver=solver,
+    )
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    crit = d.get_link_criticality(max_pairs=100)
+    # single failures withdraw NOTHING (the ring reroutes everything)
+    by_link = {tuple(e["link"]): e for e in crit["links"]}
+    assert by_link[("a", "node0")]["routes_withdrawn"] == 0
+    assert by_link[("b", "node0")]["routes_withdrawn"] == 0
+    # the (node0-a, node0-b) pair isolates node0 -> partition risk found
+    risky_pairs = {
+        frozenset(tuple(l) for l in e["links"])
+        for e in crit["pairs"]["risky"]
+    }
+    assert frozenset(
+        {("a", "node0"), ("b", "node0")}
+    ) in risky_pairs, crit["pairs"]
