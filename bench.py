@@ -226,18 +226,25 @@ def main() -> None:
     t0 = time.perf_counter()
     deltas = sel.run(eng.run(fails, fetch=False))
     routes_pipeline_ms = (time.perf_counter() - t0) * 1000
-    # steady-state throughput: sweep k+1's kernels are dispatched before
-    # sweep k's delta fetch blocks, so the device never idles on the
-    # host/tunnel round trip (the continuous-what-if-service shape)
+    # steady-state throughput: PIPELINE_DEPTH sweeps in flight via
+    # sel.start()/finish() — selection+compaction fetches ride
+    # copy_to_host_async, so the ~75 ms tunnel round trip overlaps the
+    # following sweeps' SPF+selection instead of serializing after them
+    # (the continuous-what-if-service shape; device compute per sweep is
+    # single-digit ms, so without overlap the tunnel latency IS the
+    # pipeline floor)
+    PIPELINE_DEPTH = 4
+    e2e_reps = 12
     t0 = time.perf_counter()
-    prev = None
-    for _ in range(DEVICE_REPS):
+    pend = []
+    for _ in range(e2e_reps):
         sw = eng.run(fails, fetch=False)
-        if prev is not None:
-            deltas = sel.run(prev)
-        prev = sw
-    deltas = sel.run(prev)
-    e2e_sps = DEVICE_REPS * total / (time.perf_counter() - t0)
+        pend.append(sel.start(sw))
+        if len(pend) >= PIPELINE_DEPTH:
+            deltas = pend.pop(0).finish()
+    while pend:
+        deltas = pend.pop(0).finish()
+    e2e_sps = e2e_reps * total / (time.perf_counter() - t0)
 
     # route parity vs native for sample snapshots (base + changed rows)
     for s in (3, 1007, 9000):
@@ -325,6 +332,7 @@ def main() -> None:
                     "base_solve_ms": round(base_solve_ms, 1),
                     "repair_plan_build_ms": round(plan_build_ms, 1),
                     "routes_pipeline_ms": round(routes_pipeline_ms, 1),
+                    "pipeline_depth": PIPELINE_DEPTH,
                     "route_deltas": int(deltas.num_deltas),
                     "route_delta_fetch_bytes": int(deltas.fetch_bytes),
                     "host_fetch_unique_tables_ms": round(fetch_ms, 1),

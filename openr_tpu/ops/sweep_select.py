@@ -12,11 +12,11 @@ batch-bit-packed first-hop lanes [V, D, b/32]), diffs every snapshot's
 route table against the base solve ON DEVICE, and fetches ONLY the
 route deltas:
 
-  1. per chunk: one small fetch of a bit-packed changed-row mask
-     ([b, P/32] words), then
-  2. one gather fetch of exactly the changed (snapshot, prefix) route
-     rows (valid, metric, packed ECMP lanes) — payload scales with how
-     many routes actually changed, not with B x P.
+one fused on-device compaction gathers every changed (snapshot, prefix)
+route row (valid, metric, packed ECMP lanes) — across ALL chunks — into
+a single dense buffer, so the whole sweep costs ONE blocking host fetch
+whose payload scales with how many routes actually changed, not with
+B x P or the chunk count.
 
 A single link failure on a 1024-node WAN typically changes a handful of
 routes; the full-table fetch this replaces moved U x V x D lane tables
@@ -36,7 +36,7 @@ import numpy as np
 from openr_tpu.ops.csr import EncodedTopology, bucket_for
 
 #: gathered-delta row buckets (stable jit shapes for the gather kernel)
-DELTA_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144, 1048576)
+DELTA_BUCKETS = (256, 1024, 4096, 8192, 16384, 65536, 262144, 1048576)
 
 
 @dataclasses.dataclass
@@ -270,57 +270,90 @@ def _base_select(*args):
     return select_routes_one(*args)
 
 
-@jax.jit
-def _gather_deltas(valid, metric, lanes_packed, flat_idx):
-    """Gather changed (snapshot, prefix) rows by flat index j*P + p."""
-    P = valid.shape[1]
-    j = flat_idx // P
-    p = flat_idx % P
-    return valid[j, p], metric[j, p], lanes_packed[j, p]
-
-
 @functools.partial(jax.jit, static_argnames=("cap",))
-def _compact_deltas(changed_packed, valid, metric, lanes_packed, n, cap: int):
-    """On-device delta compaction: scatter every changed (snapshot,
-    prefix) row into a dense [cap] buffer ordered by flat index, plus
-    the true change count.
+def _compact_deltas(chunks, ns, goffs, cap: int):
+    """On-device delta compaction across ALL of a sweep's chunks:
+    scatter every changed (snapshot, prefix) row — from every chunk —
+    into ONE dense [cap] buffer ordered by global flat index
+    ``(global_row * P + prefix)``, plus the true change count.
 
-    Over a tunneled device the mask-fetch + gather-fetch protocol costs
-    two blocking round trips per chunk; this costs ONE (count + buffer
-    in a single device_get).  ``n`` masks padding snapshots on device.
-    Rows beyond ``cap`` are dropped (mode='drop'); the caller detects
-    count > cap and falls back to the exact gather path."""
-    b, P = valid.shape
-    W = changed_packed.shape[1]
-    # unpack the changed mask back to [b, P] bools (cheap on device)
+    Over a tunneled device the round trips, not the bytes, dominate:
+    per-chunk mask-fetch + gather-fetch cost two blocking trips per
+    chunk; per-chunk compaction cost one ``cap`` buffer per chunk.  One
+    fused compaction costs a single count+buffer fetch for the whole
+    sweep regardless of how many chunks the greedy bucket decomposition
+    produced.
+
+    ``chunks``: tuple of (changed_packed [b, Pw], valid [b, P],
+    metric [b, P], lanes_packed [b, P, Dw]); ``ns`` masks each chunk's
+    padding snapshots; ``goffs`` are the chunks' global unique-row
+    offsets.  Rows beyond ``cap`` are dropped (mode='drop'); the caller
+    detects count > cap and re-compacts at a larger cap (exact).
+
+    Jit note: the trace is keyed by the chunk-shape TUPLE, so each
+    distinct greedy decomposition compiles once.  Decompositions are
+    deterministic per unique-count band over a small bucket set, so the
+    key space stays small in practice (a steady what-if service sees
+    one or two); if churny query sizes ever make compiles noticeable,
+    canonicalize by padding the chunk list to a fixed shape set."""
+    P = chunks[0][1].shape[1]
     widx = jnp.arange(P) // 32
     bit = (jnp.arange(P) % 32).astype(jnp.uint32)
-    changed = ((changed_packed[:, widx] >> bit) & 1).astype(bool)
-    changed = changed & (jnp.arange(b) < n)[:, None]
-    flat = changed.reshape(-1)
+    masks, row_srcs, pref_srcs, valids, metrics, lanes_rows = (
+        [], [], [], [], [], []
+    )
+    for (changed_packed, valid, metric, lanes_packed), n, goff in zip(
+        chunks, ns, goffs
+    ):
+        b = valid.shape[0]
+        changed = ((changed_packed[:, widx] >> bit) & 1).astype(bool)
+        changed = changed & (jnp.arange(b) < n)[:, None]
+        masks.append(changed.reshape(-1))
+        # (row, prefix) ride as two int32 coordinate planes rather than
+        # one flat row*P+prefix index: the flat form overflows int32 at
+        # large sweeps (5,300 uniques x 409,600 prefixes), and jax's
+        # default x64-disabled config makes int64 on device a trap
+        row = jnp.broadcast_to(
+            (goff + jnp.arange(b, dtype=jnp.int32))[:, None], (b, P)
+        )
+        pref = jnp.broadcast_to(
+            jnp.arange(P, dtype=jnp.int32)[None, :], (b, P)
+        )
+        row_srcs.append(row.reshape(-1))
+        pref_srcs.append(pref.reshape(-1))
+        valids.append(valid.reshape(-1))
+        metrics.append(metric.reshape(-1))
+        lanes_rows.append(lanes_packed.reshape(b * P, -1))
+    flat = jnp.concatenate(masks)
     pos = jnp.cumsum(flat.astype(jnp.int32)) - 1
     count = jnp.sum(flat.astype(jnp.int32))
     idx = jnp.where(flat, pos, cap)  # out-of-range rows drop
-    src_flat = jnp.arange(b * P, dtype=jnp.int32)
-    comp_flat = (
-        jnp.full(cap, -1, jnp.int32).at[idx].set(src_flat, mode="drop")
+    comp_row = (
+        jnp.full(cap, -1, jnp.int32)
+        .at[idx]
+        .set(jnp.concatenate(row_srcs), mode="drop")
+    )
+    comp_pref = (
+        jnp.full(cap, -1, jnp.int32)
+        .at[idx]
+        .set(jnp.concatenate(pref_srcs), mode="drop")
     )
     comp_valid = (
-        jnp.zeros(cap, valid.dtype)
+        jnp.zeros(cap, valids[0].dtype)
         .at[idx]
-        .set(valid.reshape(-1), mode="drop")
+        .set(jnp.concatenate(valids), mode="drop")
     )
     comp_metric = (
-        jnp.zeros(cap, metric.dtype)
+        jnp.zeros(cap, metrics[0].dtype)
         .at[idx]
-        .set(metric.reshape(-1), mode="drop")
+        .set(jnp.concatenate(metrics), mode="drop")
     )
     comp_lanes = (
-        jnp.zeros((cap, lanes_packed.shape[-1]), lanes_packed.dtype)
+        jnp.zeros((cap, lanes_rows[0].shape[-1]), lanes_rows[0].dtype)
         .at[idx]
-        .set(lanes_packed.reshape(b * P, -1), mode="drop")
+        .set(jnp.concatenate(lanes_rows, axis=0), mode="drop")
     )
-    return count, comp_flat, comp_valid, comp_metric, comp_lanes
+    return count, comp_row, comp_pref, comp_valid, comp_metric, comp_lanes
 
 
 class SweepRouteSelector:
@@ -369,9 +402,13 @@ class SweepRouteSelector:
             self._dev = {
                 k: jax.device_put(v, rep) for k, v in self._dev.items()
             }
-        #: compaction buffer rows per chunk fetch; adapts upward when a
-        #: sweep changes more routes than fit (the re-fetch is exact)
-        self._cap = DELTA_BUCKETS[3]
+        #: compaction buffer rows per SWEEP fetch (one fused buffer
+        #: across all chunks); adapts upward when a sweep changes more
+        #: routes than fit (the re-fetch is exact).  8192 deliberately:
+        #: the headline sweep changes ~5.6k routes, and over a ~6 MB/s
+        #: tunnel every doubling of the buffer costs ~17 ms per fetch
+        self._cap = 8192
+        assert self._cap in DELTA_BUCKETS
         self._base = None  # (valid [P], metric [P], lanes [P, D] int8)
         self._base_dev = None
         #: held references to the base arrays the cache was built from
@@ -427,25 +464,22 @@ class SweepRouteSelector:
 
     # -- the pipeline ------------------------------------------------------
 
-    def run(self, sweep_result) -> SweepRouteDeltas:
-        """Consume a DEVICE-RESIDENT SweepResult (fetch=False) and return
-        route deltas with delta-only host fetches."""
+    def start(self, sweep_result) -> "PendingDeltas":
+        """Dispatch phase, non-blocking: queue EVERY chunk's selection
+        kernel, then ONE fused compaction over all chunks, then BEGIN
+        the device->host copy of the compaction buffers
+        (``copy_to_host_async``) — and return a handle immediately.
+
+        ``finish()`` on the handle blocks and decodes.  Anything the
+        caller dispatches between start() and finish() (the NEXT sweep's
+        SPF in the continuous what-if loop) overlaps the tunnel round
+        trip + copy, so steady-state cost is max(compute, fetch), not
+        compute + fetch."""
         base_dist, base_nh = sweep_result.base
         self.base_routes(base_dist, base_nh)
         bvalid_d, bmetric_d, blanes_d = self._base_dev
         P = self.cands.cand_node.shape[0]
 
-        fetch_bytes = 0
-        d_rows: List[np.ndarray] = []
-        d_prefix: List[np.ndarray] = []
-        d_valid: List[np.ndarray] = []
-        d_metric: List[np.ndarray] = []
-        d_lanes: List[np.ndarray] = []
-        # dispatch phase: queue EVERY chunk's selection + compaction
-        # kernel before the first blocking fetch, so the device pipelines
-        # chunk k+1's SPF + selection behind the host-side delta decode
-        # of chunk k, and each chunk costs ONE blocking round trip (over
-        # a tunneled TPU the round trips, not the bytes, dominate)
         selected: List[tuple] = []
         for off, n, dist_d, nh_d in sweep_result.chunks or []:
             sel_args = (
@@ -469,73 +503,119 @@ class SweepRouteSelector:
                 out = _sharded_select_chunk(self.mesh, self.D)(*sel_args)
             else:
                 out = _select_chunk(*sel_args, max_degree=self.D)
-            changed_packed, valid, metric, lanes_packed = out
-            b = valid.shape[0]
-            cap = min(self._cap, b * P)
-            comp = _compact_deltas(
-                changed_packed, valid, metric, lanes_packed,
-                jnp.int32(n), cap=cap,
+            selected.append((off, n, out))
+        comp = None
+        comp_args = None
+        cap = 0
+        if selected:
+            comp_args = (
+                tuple(s[2] for s in selected),
+                tuple(jnp.int32(s[1]) for s in selected),
+                tuple(jnp.int32(s[0]) for s in selected),
             )
-            selected.append((off, n, out, cap, comp))
-        # fetch phase: ONE device_get over every chunk's compaction —
-        # jax.device_get async-copies all pytree leaves before blocking
-        # ("individual buffers are copied in parallel"), so the whole
-        # sweep costs a single overlapped host round trip instead of one
-        # per chunk.  Over a ~75 ms tunnel the per-chunk round trips
-        # were the e2e pipeline floor (3 chunks ~= 225 ms regardless of
-        # compute).
-        fetch_groups = 1 if selected else 0
-        fetched = jax.device_get([s[4] for s in selected])
-        for (off, n, out, cap, comp), host in zip(selected, fetched):
-            changed_packed, valid, metric, lanes_packed = out
-            b = valid.shape[0]
-            count, cflat, cvalid, cmetric, clanes = host
+            total_rows = sum(s[2][1].shape[0] for s in selected) * P
+            cap = min(self._cap, total_rows)
+            comp = _compact_deltas(*comp_args, cap=cap)
+            for a in comp:
+                a.copy_to_host_async()
+        # snapshot the base tuple NOW: a later start() against a rebuilt
+        # engine replaces self._base, and deltas diffed on-device against
+        # the OLD base must decode against that same base (base_routes's
+        # staleness rule); hold snap_row rather than the whole
+        # SweepResult so the chunk SPF buffers can free as soon as the
+        # device is done with them
+        return PendingDeltas(
+            self, sweep_result.snap_row, self._base, comp_args, comp,
+            cap, P,
+        )
+
+    def run(self, sweep_result) -> SweepRouteDeltas:
+        """Consume a DEVICE-RESIDENT SweepResult (fetch=False) and return
+        route deltas with a single delta-only host fetch."""
+        return self.start(sweep_result).finish()
+
+
+class PendingDeltas:
+    """In-flight sweep->routes fetch (see SweepRouteSelector.start)."""
+
+    def __init__(self, sel, snap_row, base, comp_args, comp, cap, P):
+        self._sel = sel
+        self._snap_row = snap_row
+        self._base = base  # (valid, metric, lanes) captured at start()
+        self._comp_args = comp_args
+        self._comp = comp
+        self._cap = cap
+        self._P = P
+        self._done = False
+
+    def finish(self) -> SweepRouteDeltas:
+        if self._done:
+            # a silent second finish would return an empty delta set —
+            # indistinguishable from a real "no routes changed" sweep
+            raise RuntimeError("PendingDeltas.finish() called twice")
+        self._done = True
+        sel = self._sel
+        P = self._P
+        fetch_bytes = 0
+        fetch_groups = 0
+        d_rows: List[np.ndarray] = []
+        d_prefix: List[np.ndarray] = []
+        d_valid: List[np.ndarray] = []
+        d_metric: List[np.ndarray] = []
+        d_lanes: List[np.ndarray] = []
+        if self._comp is not None:
+            cap = self._cap
+            total_rows = sum(
+                c[1].shape[0] for c in self._comp_args[0]
+            ) * P
+            fetch_groups = 1
+            count, crow, cpref, cvalid, cmetric, clanes = jax.device_get(
+                self._comp
+            )
             count = int(count)
             while count > cap:
                 # rare overflow: re-compact with the next bucket that
                 # fits (the adaptive cap persists for later sweeps).
-                # count can exceed the largest bucket (a chunk holds up
-                # to b*P changeable rows); b*P is always sufficient.
+                # count can exceed the largest bucket; total_rows is
+                # always sufficient.
                 if count > DELTA_BUCKETS[-1]:
-                    cap = b * P
+                    cap = total_rows
                 else:
-                    cap = min(bucket_for(count, DELTA_BUCKETS), b * P)
-                self._cap = max(self._cap, cap)
+                    cap = min(bucket_for(count, DELTA_BUCKETS), total_rows)
+                sel._cap = max(sel._cap, cap)
                 fetch_groups += 1
-                count, cflat, cvalid, cmetric, clanes = jax.device_get(
-                    _compact_deltas(
-                        changed_packed, valid, metric, lanes_packed,
-                        jnp.int32(n), cap=cap,
+                count, crow, cpref, cvalid, cmetric, clanes = (
+                    jax.device_get(
+                        _compact_deltas(*self._comp_args, cap=cap)
                     )
                 )
                 count = int(count)
             fetch_bytes += (
-                cflat.nbytes + cvalid.nbytes + cmetric.nbytes + clanes.nbytes
+                crow.nbytes + cpref.nbytes + cvalid.nbytes
+                + cmetric.nbytes + clanes.nbytes
             )
-            if count == 0:
-                continue
-            flat = cflat[:count].astype(np.int64)
-            js = (flat // P).astype(np.int64)
-            ps = (flat % P).astype(np.int32)
-            d_rows.append((1 + off + js).astype(np.int32))
-            d_prefix.append(ps)
-            d_valid.append(cvalid[:count])
-            d_metric.append(cmetric[:count])
-            lanes_bits = np.unpackbits(
-                clanes[:count, :, None].view(np.uint8),
-                axis=-1,
-                bitorder="little",
-            ).reshape(count, -1)[:, : self.D]
-            d_lanes.append(lanes_bits.astype(np.int8))
+            if count:
+                d_rows.append((1 + crow[:count]).astype(np.int32))
+                d_prefix.append(cpref[:count].astype(np.int32))
+                d_valid.append(cvalid[:count])
+                d_metric.append(cmetric[:count])
+                lanes_bits = np.unpackbits(
+                    clanes[:count, :, None].view(np.uint8),
+                    axis=-1,
+                    bitorder="little",
+                ).reshape(count, -1)[:, : sel.D]
+                d_lanes.append(lanes_bits.astype(np.int8))
+        self._comp = None
+        self._comp_args = None
 
         def empty(dt, shape=(0,)):
             return np.zeros(shape, dt)
 
         bv, bm, bl = self._base
         return SweepRouteDeltas(
-            snap_row=sweep_result.snap_row,
+            snap_row=self._snap_row,
             num_prefixes=P,
-            max_degree=self.D,
+            max_degree=sel.D,
             base_valid=bv,
             base_metric=bm,
             base_lanes=bl,
@@ -554,7 +634,7 @@ class SweepRouteSelector:
             delta_lanes=(
                 np.concatenate(d_lanes)
                 if d_lanes
-                else empty(np.int8, (0, self.D))
+                else empty(np.int8, (0, sel.D))
             ),
             fetch_bytes=fetch_bytes,
             fetch_groups=fetch_groups,

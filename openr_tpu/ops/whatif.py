@@ -38,11 +38,16 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from openr_tpu.ops.csr import EncodedTopology, bucket_for
+from openr_tpu.ops.csr import EncodedTopology
 
 #: unique-solve batch buckets (jit cache stays warm across sweep sizes;
-#: all multiples of 32 for the batch-bit-packed lane words)
-SOLVE_BUCKETS = (64, 256, 1024, 4096, 16384)
+#: all multiples of 32 for the batch-bit-packed lane words).  A sweep is
+#: covered by a GREEDY largest-first decomposition over these sizes
+#: (1125 uniques -> chunks of 1024+64+64, not one 4096 pad), so padding
+#: waste stays below the smallest bucket instead of scaling with the
+#: gap to the next bucket — at the headline scale one padded-to-4096
+#: chunk spent 3.6x the SPF+selection compute of the real solves.
+SOLVE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
 
 
 @dataclasses.dataclass
@@ -339,6 +344,26 @@ class LinkFailureSweep:
         leaves the root's SPF result unchanged."""
         return self.plan().on_dag_link
 
+    def _chunk_sizes(self, n: int) -> List[int]:
+        """Greedy largest-first cover of ``n`` unique solves by bucket
+        sizes (each capped at ``max_chunk``): chunk shapes stay in the
+        warm jit cache across sweeps while total padding stays below
+        the smallest bucket."""
+        usable = [b for b in self.solve_buckets if b <= self.max_chunk]
+        if not usable:
+            # max_chunk below the smallest bucket (tests force tiny
+            # chunks): honor it, rounded up to the batch granularity
+            g = self.batch_granularity
+            usable = [((self.max_chunk + g - 1) // g) * g]
+        sizes: List[int] = []
+        remaining = n
+        while remaining > 0:
+            fit = [b for b in usable if b <= remaining]
+            b = max(fit) if fit else usable[0]
+            sizes.append(b)
+            remaining -= b
+        return sizes
+
     # -- the sweep ---------------------------------------------------------
 
     def run(self, failed_links: np.ndarray, fetch: bool = True) -> SweepResult:
@@ -382,13 +407,14 @@ class LinkFailureSweep:
 
         # async-dispatch all chunks; nothing below waits on the device
         chunks: List[tuple] = []
-        for off in range(0, len(todo_sorted), self.max_chunk):
-            chunk = todo_sorted[off : off + self.max_chunk]
-            b = bucket_for(len(chunk), self.solve_buckets)
+        off = 0
+        for b in self._chunk_sizes(len(todo_sorted)):
+            chunk = todo_sorted[off : off + b]
             padded = np.full(b, -1, np.int32)
             padded[: len(chunk)] = chunk
             dist_d, nh_d, _, _ = rs.solve(padded)
             chunks.append((off, len(chunk), dist_d, nh_d))
+            off += len(chunk)
 
         result = SweepResult(
             snap_row=snap_row,
@@ -456,14 +482,15 @@ class LinkFailureSweep:
         K = 1 << (k_raw - 1).bit_length() if k_raw > 1 else 1
 
         chunks: List[tuple] = []
-        for off in range(0, len(todo_sorted), self.max_chunk):
-            chunk = todo_sorted[off : off + self.max_chunk]
-            b = bucket_for(len(chunk), self.solve_buckets)
+        off = 0
+        for b in self._chunk_sizes(len(todo_sorted)):
+            chunk = todo_sorted[off : off + b]
             padded = np.full((b, K), -1, np.int32)
             for i, key in enumerate(chunk):
                 padded[i, : len(key)] = key
             dist_d, nh_d, _, _ = rs.solve(padded)
             chunks.append((off, len(chunk), dist_d, nh_d))
+            off += len(chunk)
 
         result = SweepResult(
             snap_row=snap_row,

@@ -206,3 +206,102 @@ def test_sweep_fetch_is_one_round_trip_multi_chunk():
     v, m, ln = deltas.routes_of(0)
     ev, em, el = scalar_routes(topo, eng, cands, fails[0])
     assert np.array_equal(v, ev)
+
+
+def test_pipelined_start_finish_matches_run():
+    """The overlapped fetch path (start() + copy_to_host_async +
+    finish()) must be byte-identical to the synchronous run(), including
+    with several sweeps in flight — the steady-state what-if service
+    keeps a pipeline of pending fetches so the tunnel round trip
+    overlaps the next sweeps' SPF + selection."""
+    topo = build_world(seed=11)
+    eng = LinkFailureSweep(topo, "node0")
+    V = topo.num_nodes
+    cands = SweepCandidates.single_advertiser(np.arange(V))
+    sel = SweepRouteSelector(topo, "node0", cands, max_degree=eng.D)
+    rng = np.random.default_rng(5)
+    sweeps = [
+        rng.integers(0, len(topo.links), size=60).astype(np.int32)
+        for _ in range(4)
+    ]
+    expected = [sel.run(eng.run(f, fetch=False)) for f in sweeps]
+    # pipelined: all four in flight before the first finish
+    pend = [sel.start(eng.run(f, fetch=False)) for f in sweeps]
+    got = [p.finish() for p in pend]
+    for e, g in zip(expected, got):
+        assert np.array_equal(e.snap_row, g.snap_row)
+        assert np.array_equal(e.delta_row, g.delta_row)
+        assert np.array_equal(e.delta_prefix, g.delta_prefix)
+        assert np.array_equal(e.delta_valid, g.delta_valid)
+        assert np.array_equal(e.delta_metric, g.delta_metric)
+        assert np.array_equal(e.delta_lanes, g.delta_lanes)
+        assert g.fetch_groups == 1
+
+
+def test_greedy_chunk_decomposition_covers_and_reuses_buckets():
+    """_chunk_sizes must exactly cover the unique-solve count with
+    bucket-sized chunks, largest first, with padding below the smallest
+    bucket — 1125 uniques must NOT pad to a 4096 batch (3.6x wasted
+    SPF+selection compute at the headline scale)."""
+    topo = build_world(seed=3)
+    eng = LinkFailureSweep(topo, "node0")
+    assert eng._chunk_sizes(1125) == [1024, 64, 64]
+    assert eng._chunk_sizes(64) == [64]
+    assert eng._chunk_sizes(1) == [64]
+    assert eng._chunk_sizes(0) == []
+    assert eng._chunk_sizes(4096) == [4096]
+    assert eng._chunk_sizes(10240) == [4096, 4096, 2048]
+    for n in (1, 63, 65, 1000, 5000, 12345):
+        sizes = eng._chunk_sizes(n)
+        assert sum(sizes) >= n
+        assert sum(sizes) - n < 64  # waste below the smallest bucket
+        assert all(s in eng.solve_buckets for s in sizes)
+
+
+def test_pending_deltas_pin_their_base_across_engine_rebuilds():
+    """A PendingDeltas started against base A must decode against base A
+    even if the selector serves a rebuilt engine (base B) before
+    finish() — the on-device diff ran against A, so patching B's table
+    with A's deltas would corrupt every prefix that differs between the
+    generations (review finding on the depth-N pipeline)."""
+    edges_a = random_connected_edges(48, 96, seed=21)
+    # generation B: same node table, one link metric bumped hard enough
+    # to move base routes
+    edges_b = [
+        (u, v, (w + 900 if i == 0 else w))
+        for i, (u, v, w) in enumerate(edges_a)
+    ]
+
+    def encode(edges):
+        ls = LinkState("0")
+        for db in build_adj_dbs(edges).values():
+            ls.update_adjacency_database(db)
+        return encode_link_state(ls)
+
+    topo_a, topo_b = encode(edges_a), encode(edges_b)
+    eng_a = LinkFailureSweep(topo_a, "node0")
+    eng_b = LinkFailureSweep(topo_b, "node0")
+    V = topo_a.num_nodes
+    cands = SweepCandidates.single_advertiser(np.arange(V))
+    sel = SweepRouteSelector(topo_a, "node0", cands, max_degree=eng_a.D)
+    rng = np.random.default_rng(9)
+    fails = rng.integers(0, len(topo_a.links), size=50).astype(np.int32)
+
+    ref_sel = SweepRouteSelector(topo_a, "node0", cands, max_degree=eng_a.D)
+    expected = ref_sel.run(eng_a.run(fails, fetch=False))
+
+    pend = sel.start(eng_a.run(fails, fetch=False))
+    sel.run(eng_b.run(fails, fetch=False))  # base B replaces sel._base
+    got = pend.finish()
+    assert np.array_equal(got.base_metric, expected.base_metric)
+    assert np.array_equal(got.base_lanes, expected.base_lanes)
+    for s in range(0, 50, 7):
+        for e, g in zip(expected.routes_of(s), got.routes_of(s)):
+            assert np.array_equal(e, g)
+    # double-finish must fail loudly, not return "no changes"
+    try:
+        pend.finish()
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("second finish() did not raise")
