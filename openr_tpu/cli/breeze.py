@@ -738,6 +738,75 @@ def kvstore_flood_topo(ctx: click.Context, area: str) -> None:
         )
 
 
+@kvstore.command("decode-thrift")
+@click.option("--hex", "hex_str", default="", help="compact bytes as hex")
+@click.option(
+    "--file", "path", default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="file holding raw compact bytes",
+)
+@click.option(
+    "--kind",
+    type=click.Choice(["value", "adj", "prefix", "publication", "routes"]),
+    default="value",
+    help="struct to decode; 'value' also auto-decodes the embedded "
+    "adj/prefix payload when --key names the flood key",
+)
+@click.option(
+    "--key", default="",
+    help="flood key (adj:<node> / prefix:...) to pick the Value payload "
+    "decoder automatically",
+)
+def kvstore_decode_thrift(
+    hex_str: str, path: str, kind: str, key: str
+) -> None:
+    """Decode fbthrift-CompactSerializer bytes from a reference openr
+    network (its flooded KvStore values, or a RouteDatabase) into the
+    framework's wire JSON.  No daemon connection needed."""
+    import json as _json
+
+    from openr_tpu import interop
+
+    if bool(hex_str) == bool(path):
+        raise click.ClickException("pass exactly one of --hex / --file")
+    try:
+        if hex_str:
+            data = bytes.fromhex(hex_str.replace(" ", ""))
+        else:
+            with open(path, "rb") as f:
+                data = f.read()
+    except ValueError as e:
+        raise click.ClickException(f"bad hex input: {e}")
+    decoders = {
+        "adj": interop.decode_adjacency_database,
+        "prefix": interop.decode_prefix_database,
+        "publication": interop.decode_publication,
+        "routes": interop.decode_route_database,
+    }
+    try:
+        if kind != "value":
+            click.echo(
+                _json.dumps(decoders[kind](data).to_wire(), indent=2)
+            )
+            return
+        v = interop.decode_value(data)
+        inner = None
+        if v.value is not None:
+            if key.startswith("adj:"):
+                inner = interop.decode_adjacency_database(v.value)
+            elif key.startswith("prefix:"):
+                inner = interop.decode_prefix_database(v.value)
+    except (ValueError, KeyError, UnicodeDecodeError) as e:
+        raise click.ClickException(
+            f"not a valid compact-encoded {kind}: {e}"
+        )
+    out = v.to_wire()
+    if inner is not None:
+        out["value"] = inner.to_wire()
+        out.pop("_value_hex", None)
+    click.echo(_json.dumps(out, indent=2))
+
+
 @kvstore.command("snoop")
 @click.option("--area", default=None)
 @click.option("--prefix", "prefixes", multiple=True)

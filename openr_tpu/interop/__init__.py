@@ -1,0 +1,17 @@
+"""fbthrift wire interop: Thrift Compact protocol codec + Open/R struct
+specs, so this framework can decode (and emit) the byte-level payloads a
+reference openr network floods — see openr_tpu/interop/compact.py and
+openr_wire.py."""
+
+from openr_tpu.interop.openr_wire import (  # noqa: F401
+    decode_adjacency_database,
+    decode_prefix_database,
+    decode_publication,
+    decode_route_database,
+    decode_value,
+    encode_adjacency_database,
+    encode_prefix_database,
+    encode_publication,
+    encode_route_database,
+    encode_value,
+)

@@ -1,0 +1,342 @@
+"""Thrift Compact protocol: a spec-driven reader/writer.
+
+The reference daemon serializes every flooded LSDB payload with
+``apache::thrift::CompactSerializer`` (AdjacencyDatabase under
+``adj:<node>``, PrefixDatabase under ``prefix:...`` — LinkMonitor.h:369,
+KvStoreUtil-inl.h:20), so speaking this encoding is what makes the
+framework's data plane byte-compatible with a live openr network: our
+tools can decode its floods and emit values its nodes accept.  The RPC
+*transport* (fbthrift Rocket) remains out of scope — see README "Wire
+format"; this module is the struct layer a bridge would sit on.
+
+Implemented from the public Thrift Compact protocol spec
+(thrift/doc/specs/thrift-compact-protocol.md):
+
+  * varint       = ULEB128;  i16/i32/i64 are zigzag'd first
+  * field header = (delta << 4) | ctype for id deltas 1..15, else the
+    ctype byte followed by the zigzag-varint field id; BOOL fields fold
+    the value into the ctype (1 = true, 2 = false); 0x00 ends a struct
+  * binary       = varint length + bytes (strings are UTF-8)
+  * list/set     = (size << 4) | elem-ctype, or 0xF? + varint size when
+    size >= 15; bool elements are bytes 1/2
+  * map          = 0x00 when empty, else varint size then one
+    (key-ctype << 4) | value-ctype byte and alternating k/v
+  * double       = IEEE-754 bits, LITTLE-endian (the apache C++/Java
+    implementations' byte order, which fbthrift matches)
+
+Structs are described by specs: ``(field_id, name, type, arg)`` tuples
+where ``arg`` carries the element spec for containers or the nested
+spec for structs.  Decoding skips unknown fields, so newer peers stay
+readable (forward compatibility).
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# wire-level compact type codes (NOT the TType codes)
+CT_STOP = 0x00
+CT_BOOL_TRUE = 0x01
+CT_BOOL_FALSE = 0x02
+CT_BYTE = 0x03
+CT_I16 = 0x04
+CT_I32 = 0x05
+CT_I64 = 0x06
+CT_DOUBLE = 0x07
+CT_BINARY = 0x08
+CT_LIST = 0x09
+CT_SET = 0x0A
+CT_MAP = 0x0B
+CT_STRUCT = 0x0C
+
+#: spec type names -> compact wire type for field/element headers
+_WIRE_OF = {
+    "bool": CT_BOOL_TRUE,  # container/element form; fields special-case
+    "byte": CT_BYTE,
+    "i16": CT_I16,
+    "i32": CT_I32,
+    "i64": CT_I64,
+    "double": CT_DOUBLE,
+    "binary": CT_BINARY,
+    "string": CT_BINARY,
+    "list": CT_LIST,
+    "set": CT_SET,
+    "map": CT_MAP,
+    "struct": CT_STRUCT,
+}
+
+#: a struct spec: ordered (field_id, name, type, arg) rows.  arg is the
+#: element spec for list/set ((etype, earg)), a ((ktype, karg),
+#: (vtype, varg)) pair for maps, or the nested StructSpec for structs.
+StructSpec = Sequence[Tuple[int, str, str, Any]]
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+class CompactWriter:
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def getvalue(self) -> bytes:
+        return bytes(self._buf)
+
+    # -- primitives --------------------------------------------------------
+
+    def write_varint(self, n: int) -> None:
+        if n < 0:
+            n &= (1 << 64) - 1  # two's-complement into ULEB128
+        b = self._buf
+        while True:
+            if n < 0x80:
+                b.append(n)
+                return
+            b.append((n & 0x7F) | 0x80)
+            n >>= 7
+
+    def write_zigzag(self, n: int) -> None:
+        self.write_varint(_zigzag(n))
+
+    def write_byte(self, n: int) -> None:
+        self._buf.append(n & 0xFF)
+
+    def write_double(self, d: float) -> None:
+        self._buf += _struct.pack("<d", d)
+
+    def write_binary(self, data: bytes) -> None:
+        self.write_varint(len(data))
+        self._buf += data
+
+    # -- spec-driven struct ------------------------------------------------
+
+    def write_struct(self, spec: StructSpec, obj: Dict[str, Any]) -> None:
+        last_fid = 0
+        for fid, name, ftype, arg in spec:
+            val = obj.get(name)
+            if val is None:
+                continue  # unset / optional
+            if ftype == "bool":
+                ct = CT_BOOL_TRUE if val else CT_BOOL_FALSE
+            else:
+                ct = _WIRE_OF[ftype]
+            delta = fid - last_fid
+            if 0 < delta <= 15:
+                self.write_byte((delta << 4) | ct)
+            else:
+                self.write_byte(ct)
+                self.write_zigzag(fid)
+            last_fid = fid
+            if ftype != "bool":
+                self._write_value(ftype, arg, val)
+        self.write_byte(CT_STOP)
+
+    def _write_value(self, ftype: str, arg: Any, val: Any) -> None:
+        if ftype == "bool":
+            self.write_byte(CT_BOOL_TRUE if val else CT_BOOL_FALSE)
+        elif ftype == "byte":
+            self.write_byte(val)
+        elif ftype in ("i16", "i32", "i64"):
+            self.write_zigzag(int(val))
+        elif ftype == "double":
+            self.write_double(val)
+        elif ftype == "string":
+            self.write_binary(val.encode("utf-8"))
+        elif ftype == "binary":
+            self.write_binary(bytes(val))
+        elif ftype in ("list", "set"):
+            etype, earg = arg
+            # sets encode SORTED: fbthrift C++ serializes thrift sets
+            # from std::set (ordered), and Python set iteration order is
+            # hash-seed dependent — unsorted emission would make our
+            # bytes nondeterministic across processes and never stably
+            # match the reference's for 2+ elements
+            items = sorted(val) if ftype == "set" else list(val)
+            ect = _WIRE_OF[etype]
+            if len(items) < 15:
+                self.write_byte((len(items) << 4) | ect)
+            else:
+                self.write_byte(0xF0 | ect)
+                self.write_varint(len(items))
+            for item in items:
+                self._write_value(etype, earg, item)
+        elif ftype == "map":
+            (ktype, karg), (vtype, varg) = arg
+            items = list(val.items())
+            if not items:
+                self.write_byte(0)
+                return
+            self.write_varint(len(items))
+            self.write_byte((_WIRE_OF[ktype] << 4) | _WIRE_OF[vtype])
+            for k, v in items:
+                self._write_value(ktype, karg, k)
+                self._write_value(vtype, varg, v)
+        elif ftype == "struct":
+            self.write_struct(arg, val)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown thrift spec type {ftype!r}")
+
+
+class CompactReader:
+    def __init__(self, data: bytes) -> None:
+        self._data = data
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise ValueError("truncated compact payload")
+        out = self._data[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def read_byte(self) -> int:
+        return self._take(1)[0]
+
+    def read_varint(self) -> int:
+        shift = 0
+        out = 0
+        while True:
+            b = self.read_byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+            if shift > 70:
+                raise ValueError("varint too long")
+
+    def read_zigzag(self) -> int:
+        return _unzigzag(self.read_varint())
+
+    def read_double(self) -> float:
+        return _struct.unpack("<d", self._take(8))[0]
+
+    def read_binary(self) -> bytes:
+        return self._take(self.read_varint())
+
+    # -- spec-driven struct ------------------------------------------------
+
+    def read_struct(self, spec: StructSpec) -> Dict[str, Any]:
+        by_id = {fid: (name, ftype, arg) for fid, name, ftype, arg in spec}
+        out: Dict[str, Any] = {}
+        last_fid = 0
+        while True:
+            head = self.read_byte()
+            if head == CT_STOP:
+                return out
+            delta = (head >> 4) & 0x0F
+            ct = head & 0x0F
+            fid = last_fid + delta if delta else self.read_zigzag()
+            last_fid = fid
+            row = by_id.get(fid)
+            if ct in (CT_BOOL_TRUE, CT_BOOL_FALSE):
+                val: Any = ct == CT_BOOL_TRUE
+            elif row is not None and _WIRE_OF.get(row[1]) == ct:
+                # decode by spec ONLY when the wire type agrees — a peer
+                # that changed a field's type (or a spec mistake) must
+                # degrade to a skipped field, not desync the byte stream
+                val = self._read_value(row[1], row[2])
+            else:
+                self._skip(ct)
+                continue
+            if row is not None and (
+                row[1] == "bool" or _WIRE_OF.get(row[1]) == ct
+            ):
+                out[row[0]] = val
+            # otherwise: unknown field, or known field whose wire type
+            # disagrees with the spec — consumed/skipped, not stored
+
+    def _read_value(self, ftype: str, arg: Any) -> Any:
+        if ftype == "bool":
+            return self.read_byte() == CT_BOOL_TRUE
+        if ftype == "byte":
+            b = self.read_byte()
+            return b - 256 if b >= 128 else b
+        if ftype in ("i16", "i32", "i64"):
+            return self.read_zigzag()
+        if ftype == "double":
+            return self.read_double()
+        if ftype == "string":
+            return self.read_binary().decode("utf-8")
+        if ftype == "binary":
+            return self.read_binary()
+        if ftype in ("list", "set"):
+            etype, earg = arg
+            head = self.read_byte()
+            size = (head >> 4) & 0x0F
+            if size == 0x0F:
+                size = self.read_varint()
+            items = [self._read_value(etype, earg) for _ in range(size)]
+            return set(items) if ftype == "set" else items
+        if ftype == "map":
+            (ktype, karg), (vtype, varg) = arg
+            size = self.read_varint()
+            if size:
+                self.read_byte()  # key/value wire types
+            return {
+                self._read_value(ktype, karg): self._read_value(vtype, varg)
+                for _ in range(size)
+            }
+        if ftype == "struct":
+            return self.read_struct(arg)
+        raise ValueError(f"unknown thrift spec type {ftype!r}")
+
+    def _skip(self, ct: int) -> None:
+        """Skip one unknown value of wire type ``ct`` (forward compat).
+
+        Only container/element contexts reach the bool branch (a bool
+        STRUCT FIELD folds its value into the field header's type code
+        and both skip call sites handle that before dispatching here),
+        and container bool elements occupy one byte."""
+        if ct in (CT_BOOL_TRUE, CT_BOOL_FALSE):
+            self.read_byte()
+            return
+        if ct == CT_BYTE:
+            self.read_byte()
+        elif ct in (CT_I16, CT_I32, CT_I64):
+            self.read_varint()
+        elif ct == CT_DOUBLE:
+            self._take(8)
+        elif ct == CT_BINARY:
+            self.read_binary()
+        elif ct in (CT_LIST, CT_SET):
+            head = self.read_byte()
+            size = (head >> 4) & 0x0F
+            if size == 0x0F:
+                size = self.read_varint()
+            for _ in range(size):
+                self._skip(head & 0x0F)
+        elif ct == CT_MAP:
+            size = self.read_varint()
+            if size:
+                kv = self.read_byte()
+                for _ in range(size):
+                    self._skip((kv >> 4) & 0x0F)
+                    self._skip(kv & 0x0F)
+        elif ct == CT_STRUCT:
+            while True:
+                head = self.read_byte()
+                if head == CT_STOP:
+                    return
+                if not (head >> 4) & 0x0F:
+                    self.read_zigzag()  # long-form field id
+                inner = head & 0x0F
+                if inner in (CT_BOOL_TRUE, CT_BOOL_FALSE):
+                    continue  # field bools fold the value into the type
+                self._skip(inner)
+        else:
+            raise ValueError(f"cannot skip compact wire type {ct}")
+
+
+def encode_struct(spec: StructSpec, obj: Dict[str, Any]) -> bytes:
+    w = CompactWriter()
+    w.write_struct(spec, obj)
+    return w.getvalue()
+
+
+def decode_struct(spec: StructSpec, data: bytes) -> Dict[str, Any]:
+    return CompactReader(data).read_struct(spec)

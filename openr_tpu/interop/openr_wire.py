@@ -1,0 +1,555 @@
+"""Open/R wire-struct specs + adapters to this framework's dataclasses.
+
+Field ids mirror the reference IDL (schema compatibility):
+AdjacencyDatabase/Adjacency/PrefixEntry/PrefixDatabase/PerfEvents from
+``openr/if/Types.thrift``, Value/Publication from
+``openr/if/KvStore.thrift``, BinaryAddress/IpPrefix/NextHopThrift/
+UnicastRoute/MplsRoute/RouteDatabase/MplsAction from
+``openr/if/Network.thrift``.  Encoded bytes are what
+``apache::thrift::CompactSerializer`` produces for the same structs —
+the payloads a reference node floods in its KvStore values and serves
+from its ctrl API.
+
+Adapters convert between the thrift shapes and ``openr_tpu.types``
+dataclasses: addresses go packed-``BinaryAddress`` <-> string IPs,
+prefixes go ``IpPrefix`` <-> ``"net/len"`` strings, enums are numeric on
+the wire on both sides.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Any, Dict, Optional
+
+from openr_tpu import types as T
+from openr_tpu.interop.compact import decode_struct, encode_struct
+
+# -- struct specs (field_id, name, type, arg) -------------------------------
+
+BINARY_ADDRESS = (
+    (1, "addr", "binary", None),
+    (3, "ifName", "string", None),
+)
+
+IP_PREFIX = (
+    (1, "prefixAddress", "struct", BINARY_ADDRESS),
+    (2, "prefixLength", "i16", None),
+)
+
+PERF_EVENT = (
+    (1, "nodeName", "string", None),
+    (2, "eventDescr", "string", None),
+    (3, "unixTs", "i64", None),
+)
+
+PERF_EVENTS = ((1, "events", "list", ("struct", PERF_EVENT)),)
+
+ADJACENCY = (
+    (1, "otherNodeName", "string", None),
+    (2, "ifName", "string", None),
+    (3, "nextHopV6", "struct", BINARY_ADDRESS),
+    (4, "metric", "i32", None),
+    (5, "nextHopV4", "struct", BINARY_ADDRESS),
+    (6, "adjLabel", "i32", None),
+    (7, "isOverloaded", "bool", None),
+    (8, "rtt", "i32", None),
+    (9, "timestamp", "i64", None),
+    (10, "weight", "i64", None),
+    (11, "otherIfName", "string", None),
+    (12, "adjOnlyUsedByOtherNode", "bool", None),
+)
+
+LINK_STATUS = (
+    (1, "status", "i32", None),
+    (2, "unixTs", "i64", None),
+)
+
+LINK_STATUS_RECORDS = (
+    (1, "linkStatusMap", "map", (("string", None), ("struct", LINK_STATUS))),
+)
+
+ADJACENCY_DATABASE = (
+    (1, "thisNodeName", "string", None),
+    (2, "isOverloaded", "bool", None),
+    (3, "adjacencies", "list", ("struct", ADJACENCY)),
+    (4, "nodeLabel", "i32", None),
+    (5, "perfEvents", "struct", PERF_EVENTS),
+    (6, "area", "string", None),
+    (7, "nodeMetricIncrementVal", "i32", None),
+    (8, "linkStatusRecords", "struct", LINK_STATUS_RECORDS),
+)
+
+PREFIX_METRICS = (
+    (1, "version", "i32", None),
+    (2, "path_preference", "i32", None),
+    (3, "source_preference", "i32", None),
+    (4, "distance", "i32", None),
+    (5, "drain_metric", "i32", None),
+)
+
+PREFIX_ENTRY = (
+    (1, "prefix", "struct", IP_PREFIX),
+    (2, "type", "i32", None),
+    (4, "forwardingType", "i32", None),
+    (7, "forwardingAlgorithm", "i32", None),
+    (8, "minNexthop", "i64", None),
+    (10, "metrics", "struct", PREFIX_METRICS),
+    (11, "tags", "set", ("string", None)),
+    (12, "area_stack", "list", ("string", None)),
+    (13, "weight", "i64", None),
+)
+
+PREFIX_DATABASE = (
+    (1, "thisNodeName", "string", None),
+    (3, "prefixEntries", "list", ("struct", PREFIX_ENTRY)),
+    (4, "perfEvents", "struct", PERF_EVENTS),
+    (5, "deletePrefix", "bool", None),
+)
+
+VALUE = (
+    (1, "version", "i64", None),
+    (2, "value", "binary", None),
+    (3, "originatorId", "string", None),
+    (4, "ttl", "i64", None),
+    (5, "ttlVersion", "i64", None),
+    (6, "hash", "i64", None),
+)
+
+PUBLICATION = (
+    (2, "keyVals", "map", (("string", None), ("struct", VALUE))),
+    (3, "expiredKeys", "list", ("string", None)),
+    (4, "nodeIds", "list", ("string", None)),
+    (5, "tobeUpdatedKeys", "list", ("string", None)),
+    (7, "area", "string", None),
+    (8, "timestamp_ms", "i64", None),
+)
+
+MPLS_ACTION = (
+    (1, "action", "i32", None),
+    (2, "swapLabel", "i32", None),
+    (3, "pushLabels", "list", ("i32", None)),
+)
+
+NEXT_HOP = (
+    (1, "address", "struct", BINARY_ADDRESS),
+    (2, "weight", "i32", None),
+    (3, "mplsAction", "struct", MPLS_ACTION),
+    (51, "metric", "i32", None),
+    (53, "area", "string", None),
+    (54, "neighborNodeName", "string", None),
+)
+
+UNICAST_ROUTE = (
+    (1, "dest", "struct", IP_PREFIX),
+    (4, "nextHops", "list", ("struct", NEXT_HOP)),
+)
+
+MPLS_ROUTE = (
+    (1, "topLabel", "i32", None),
+    (4, "nextHops", "list", ("struct", NEXT_HOP)),
+)
+
+ROUTE_DATABASE = (
+    (1, "thisNodeName", "string", None),
+    (3, "perfEvents", "struct", PERF_EVENTS),
+    (4, "unicastRoutes", "list", ("struct", UNICAST_ROUTE)),
+    (5, "mplsRoutes", "list", ("struct", MPLS_ROUTE)),
+)
+
+
+# -- address/prefix conversions ---------------------------------------------
+
+
+def _addr_to_wire(ip: str, if_name: str = "") -> Optional[Dict[str, Any]]:
+    if not ip and not if_name:
+        return None
+    d: Dict[str, Any] = {
+        "addr": ipaddress.ip_address(ip).packed if ip else b""
+    }
+    if if_name:
+        d["ifName"] = if_name
+    return d
+
+
+def _addr_from_wire(d: Optional[Dict[str, Any]]) -> tuple:
+    """-> (ip string, ifName)"""
+    if not d or not d.get("addr"):
+        return "", (d or {}).get("ifName", "")
+    return (
+        ipaddress.ip_address(d["addr"]).compressed,
+        d.get("ifName", ""),
+    )
+
+
+def _prefix_to_wire(prefix: str) -> Dict[str, Any]:
+    net = ipaddress.ip_network(prefix, strict=False)
+    return {
+        "prefixAddress": {"addr": net.network_address.packed},
+        "prefixLength": net.prefixlen,
+    }
+
+
+def _prefix_from_wire(d: Dict[str, Any]) -> str:
+    ip, _ = _addr_from_wire(d["prefixAddress"])
+    return f"{ip}/{d['prefixLength']}"
+
+
+# -- AdjacencyDatabase ------------------------------------------------------
+
+
+def encode_adjacency_database(db: T.AdjacencyDatabase) -> bytes:
+    adjacencies = []
+    for a in db.adjacencies:
+        row: Dict[str, Any] = {
+            "otherNodeName": a.other_node_name,
+            "ifName": a.if_name,
+            "metric": a.metric,
+            "adjLabel": a.adj_label,
+            "isOverloaded": a.is_overloaded,
+            "rtt": a.rtt,
+            "timestamp": a.timestamp,
+            "weight": a.weight,
+            "otherIfName": a.other_if_name,
+            "adjOnlyUsedByOtherNode": a.adj_only_used_by_other_node,
+        }
+        v6 = _addr_to_wire(a.next_hop_v6)
+        v4 = _addr_to_wire(a.next_hop_v4)
+        # the reference always carries both nexthop structs
+        row["nextHopV6"] = v6 or {"addr": b""}
+        row["nextHopV4"] = v4 or {"addr": b""}
+        adjacencies.append(row)
+    obj: Dict[str, Any] = {
+        "thisNodeName": db.this_node_name,
+        "isOverloaded": db.is_overloaded,
+        "adjacencies": adjacencies,
+        "nodeLabel": db.node_label,
+        "area": db.area,
+        "nodeMetricIncrementVal": db.node_metric_increment_val,
+    }
+    if db.perf_events is not None:
+        obj["perfEvents"] = _perf_to_wire(db.perf_events)
+    if db.link_status_records is not None:
+        obj["linkStatusRecords"] = {
+            "linkStatusMap": {
+                ifn: {"status": int(st), "unixTs": ts}
+                for ifn, (st, ts) in (
+                    db.link_status_records.link_status_map.items()
+                )
+            }
+        }
+    return encode_struct(ADJACENCY_DATABASE, obj)
+
+
+def decode_adjacency_database(data: bytes) -> T.AdjacencyDatabase:
+    d = decode_struct(ADJACENCY_DATABASE, data)
+    adjacencies = []
+    for row in d.get("adjacencies", []):
+        v6, _ = _addr_from_wire(row.get("nextHopV6"))
+        v4, _ = _addr_from_wire(row.get("nextHopV4"))
+        adjacencies.append(
+            T.Adjacency(
+                other_node_name=row.get("otherNodeName", ""),
+                if_name=row.get("ifName", ""),
+                metric=row.get("metric", 1),
+                adj_label=row.get("adjLabel", 0),
+                is_overloaded=row.get("isOverloaded", False),
+                rtt=row.get("rtt", 0),
+                timestamp=row.get("timestamp", 0),
+                weight=row.get("weight", 1),
+                other_if_name=row.get("otherIfName", ""),
+                adj_only_used_by_other_node=row.get(
+                    "adjOnlyUsedByOtherNode", False
+                ),
+                next_hop_v6=v6,
+                next_hop_v4=v4,
+            )
+        )
+    lsr = None
+    if "linkStatusRecords" in d:
+        lsr = T.LinkStatusRecords(
+            link_status_map={
+                ifn: (int(st.get("status", 0)), int(st.get("unixTs", 0)))
+                for ifn, st in d["linkStatusRecords"]
+                .get("linkStatusMap", {})
+                .items()
+            }
+        )
+    return T.AdjacencyDatabase(
+        this_node_name=d.get("thisNodeName", ""),
+        is_overloaded=d.get("isOverloaded", False),
+        adjacencies=adjacencies,
+        node_label=d.get("nodeLabel", 0),
+        perf_events=_perf_from_wire(d.get("perfEvents")),
+        area=d.get("area", "0"),
+        node_metric_increment_val=d.get("nodeMetricIncrementVal", 0),
+        link_status_records=lsr,
+    )
+
+
+# -- PrefixDatabase ---------------------------------------------------------
+
+
+def _perf_to_wire(pe: T.PerfEvents) -> Dict[str, Any]:
+    return {
+        "events": [
+            {
+                "nodeName": e.node_name,
+                "eventDescr": e.event_descr,
+                "unixTs": e.unix_ts_ms,
+            }
+            for e in pe.events
+        ]
+    }
+
+
+def _perf_from_wire(d: Optional[Dict[str, Any]]) -> Optional[T.PerfEvents]:
+    if d is None:
+        return None
+    return T.PerfEvents(
+        events=[
+            T.PerfEvent(
+                node_name=e.get("nodeName", ""),
+                event_descr=e.get("eventDescr", ""),
+                unix_ts_ms=e.get("unixTs", 0),
+            )
+            for e in d.get("events", [])
+        ]
+    )
+
+
+def encode_prefix_database(db: T.PrefixDatabase) -> bytes:
+    entries = []
+    for p in db.prefix_entries:
+        row: Dict[str, Any] = {
+            "prefix": _prefix_to_wire(p.prefix),
+            "type": int(p.type),
+            "forwardingType": int(p.forwarding_type),
+            "forwardingAlgorithm": int(p.forwarding_algorithm),
+            "metrics": {
+                "version": p.metrics.version,
+                "path_preference": p.metrics.path_preference,
+                "source_preference": p.metrics.source_preference,
+                "distance": p.metrics.distance,
+                "drain_metric": p.metrics.drain_metric,
+            },
+            "tags": set(p.tags),
+            "area_stack": list(p.area_stack),
+        }
+        if p.min_nexthop is not None:
+            row["minNexthop"] = p.min_nexthop
+        if p.weight is not None:
+            row["weight"] = p.weight
+        entries.append(row)
+    obj: Dict[str, Any] = {
+        "thisNodeName": db.this_node_name,
+        "prefixEntries": entries,
+        "deletePrefix": db.delete_prefix,
+    }
+    if db.perf_events is not None:
+        obj["perfEvents"] = _perf_to_wire(db.perf_events)
+    return encode_struct(PREFIX_DATABASE, obj)
+
+
+def decode_prefix_database(data: bytes) -> T.PrefixDatabase:
+    d = decode_struct(PREFIX_DATABASE, data)
+    entries = []
+    for row in d.get("prefixEntries", []):
+        m = row.get("metrics", {})
+        entries.append(
+            T.PrefixEntry(
+                prefix=_prefix_from_wire(row["prefix"]),
+                type=T.PrefixType(row.get("type", int(T.PrefixType.LOOPBACK))),
+                forwarding_type=T.PrefixForwardingType(
+                    row.get("forwardingType", 0)
+                ),
+                forwarding_algorithm=T.PrefixForwardingAlgorithm(
+                    row.get("forwardingAlgorithm", 0)
+                ),
+                min_nexthop=row.get("minNexthop"),
+                metrics=T.PrefixMetrics(
+                    version=m.get("version", 1),
+                    drain_metric=m.get("drain_metric", 0),
+                    path_preference=m.get("path_preference", 0),
+                    source_preference=m.get("source_preference", 0),
+                    distance=m.get("distance", 0),
+                ),
+                tags=set(row.get("tags", ())),
+                area_stack=list(row.get("area_stack", ())),
+                weight=row.get("weight"),
+            )
+        )
+    return T.PrefixDatabase(
+        this_node_name=d.get("thisNodeName", ""),
+        prefix_entries=entries,
+        perf_events=_perf_from_wire(d.get("perfEvents")),
+        delete_prefix=d.get("deletePrefix", False),
+    )
+
+
+# -- KvStore Value / Publication --------------------------------------------
+
+
+def encode_value(v: T.Value) -> bytes:
+    obj: Dict[str, Any] = {
+        "version": v.version,
+        "originatorId": v.originator_id,
+        "ttl": v.ttl,
+        "ttlVersion": v.ttl_version,
+    }
+    if v.value is not None:
+        obj["value"] = v.value
+    if v.hash is not None:
+        obj["hash"] = v.hash
+    return encode_struct(VALUE, obj)
+
+
+def _value_from_wire(d: Dict[str, Any]) -> T.Value:
+    return T.Value(
+        version=d.get("version", 0),
+        originator_id=d.get("originatorId", ""),
+        value=d.get("value"),
+        ttl=d.get("ttl", -1),
+        ttl_version=d.get("ttlVersion", 0),
+        hash=d.get("hash"),
+    )
+
+
+def decode_value(data: bytes) -> T.Value:
+    return _value_from_wire(decode_struct(VALUE, data))
+
+
+def encode_publication(pub: T.Publication) -> bytes:
+    key_vals = {}
+    for k, v in pub.key_vals.items():
+        row: Dict[str, Any] = {
+            "version": v.version,
+            "originatorId": v.originator_id,
+            "ttl": v.ttl,
+            "ttlVersion": v.ttl_version,
+        }
+        if v.value is not None:
+            row["value"] = v.value
+        if v.hash is not None:
+            row["hash"] = v.hash
+        key_vals[k] = row
+    obj: Dict[str, Any] = {
+        "keyVals": key_vals,
+        "expiredKeys": list(pub.expired_keys),
+        "area": pub.area,
+    }
+    if pub.node_ids is not None:
+        obj["nodeIds"] = list(pub.node_ids)
+    if pub.tobe_updated_keys is not None:
+        obj["tobeUpdatedKeys"] = list(pub.tobe_updated_keys)
+    if pub.timestamp_ms is not None:
+        obj["timestamp_ms"] = pub.timestamp_ms
+    return encode_struct(PUBLICATION, obj)
+
+
+def decode_publication(data: bytes) -> T.Publication:
+    d = decode_struct(PUBLICATION, data)
+    return T.Publication(
+        key_vals={
+            k: _value_from_wire(v) for k, v in d.get("keyVals", {}).items()
+        },
+        expired_keys=list(d.get("expiredKeys", ())),
+        node_ids=d.get("nodeIds"),
+        tobe_updated_keys=d.get("tobeUpdatedKeys"),
+        area=d.get("area", "0"),
+        timestamp_ms=d.get("timestamp_ms"),
+    )
+
+
+# -- RouteDatabase ----------------------------------------------------------
+
+
+def _nexthop_to_wire(nh: T.NextHop) -> Dict[str, Any]:
+    row: Dict[str, Any] = {
+        "address": _addr_to_wire(nh.address, nh.if_name) or {"addr": b""},
+        "weight": nh.weight,
+        "metric": nh.metric,
+    }
+    if nh.area:
+        row["area"] = nh.area
+    if nh.neighbor_node_name:
+        row["neighborNodeName"] = nh.neighbor_node_name
+    if nh.mpls_action is not None:
+        ma: Dict[str, Any] = {"action": int(nh.mpls_action.action)}
+        if nh.mpls_action.swap_label is not None:
+            ma["swapLabel"] = nh.mpls_action.swap_label
+        if nh.mpls_action.push_labels is not None:
+            ma["pushLabels"] = list(nh.mpls_action.push_labels)
+        row["mplsAction"] = ma
+    return row
+
+
+def _nexthop_from_wire(row: Dict[str, Any]) -> T.NextHop:
+    ip, ifn = _addr_from_wire(row.get("address"))
+    ma = None
+    if "mplsAction" in row:
+        w = row["mplsAction"]
+        ma = T.MplsAction(
+            action=T.MplsActionCode(w.get("action", 0)),
+            swap_label=w.get("swapLabel"),
+            push_labels=(
+                tuple(w["pushLabels"]) if "pushLabels" in w else None
+            ),
+        )
+    return T.NextHop(
+        address=ip,
+        if_name=ifn,
+        metric=row.get("metric", 0),
+        weight=row.get("weight", 0),
+        area=row.get("area", ""),
+        neighbor_node_name=row.get("neighborNodeName", ""),
+        mpls_action=ma,
+    )
+
+
+def encode_route_database(db: T.RouteDatabase) -> bytes:
+    obj: Dict[str, Any] = {
+        "thisNodeName": db.this_node_name,
+        "unicastRoutes": [
+            {
+                "dest": _prefix_to_wire(r.dest),
+                "nextHops": [_nexthop_to_wire(nh) for nh in r.next_hops],
+            }
+            for r in db.unicast_routes
+        ],
+        "mplsRoutes": [
+            {
+                "topLabel": r.top_label,
+                "nextHops": [_nexthop_to_wire(nh) for nh in r.next_hops],
+            }
+            for r in db.mpls_routes
+        ],
+    }
+    if db.perf_events is not None:
+        obj["perfEvents"] = _perf_to_wire(db.perf_events)
+    return encode_struct(ROUTE_DATABASE, obj)
+
+
+def decode_route_database(data: bytes) -> T.RouteDatabase:
+    d = decode_struct(ROUTE_DATABASE, data)
+    return T.RouteDatabase(
+        this_node_name=d.get("thisNodeName", ""),
+        unicast_routes=[
+            T.UnicastRoute(
+                dest=_prefix_from_wire(r["dest"]),
+                next_hops=[
+                    _nexthop_from_wire(nh) for nh in r.get("nextHops", [])
+                ],
+            )
+            for r in d.get("unicastRoutes", [])
+        ],
+        mpls_routes=[
+            T.MplsRoute(
+                top_label=r.get("topLabel", 0),
+                next_hops=[
+                    _nexthop_from_wire(nh) for nh in r.get("nextHops", [])
+                ],
+            )
+            for r in d.get("mplsRoutes", [])
+        ],
+        perf_events=_perf_from_wire(d.get("perfEvents")),
+    )
