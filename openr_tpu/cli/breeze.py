@@ -1120,8 +1120,13 @@ def _decision_validate_problems(
                     blob = (
                         bytes.fromhex(raw) if v.get("_value_hex") else raw
                     )
-                    db = _json.loads(blob)
-                    store_adj[n] = len(db.get("adjacencies", []))
+                    # sniffing codec: JSON or thrift-compact payloads
+                    from openr_tpu.lsdb_codec import deserialize_adj_db
+
+                    db = deserialize_adj_db(
+                        blob if isinstance(blob, bytes) else blob.encode()
+                    )
+                    store_adj[n] = len(db.adjacencies)
                 except Exception:
                     store_adj[n] = None
                 continue
@@ -1135,8 +1140,14 @@ def _decision_validate_problems(
                         blob = (
                             bytes.fromhex(raw) if v.get("_value_hex") else raw
                         )
-                        db = _json.loads(blob)
-                        if db.get("delete_prefix"):
+                        from openr_tpu.lsdb_codec import (
+                            deserialize_prefix_db,
+                        )
+
+                        db = deserialize_prefix_db(
+                            blob if isinstance(blob, bytes) else blob.encode()
+                        )
+                        if db.delete_prefix:
                             continue
                     except Exception:
                         pass

@@ -220,6 +220,11 @@ class OpenrConfig:
     #: TLS for the ctrl server + KvStore peer RPC plane (reference:
     #: thrift-over-TLS, Main.cpp:399-416; cert flags Flags.cpp:10-37)
     tls: TlsConfig = field(default_factory=TlsConfig)
+    #: encoding of flooded LSDB value payloads (adj:/prefix: keys):
+    #: "json" (native) or "thrift-compact" (the reference's
+    #: CompactSerializer bytes — openr_tpu/interop).  Decoding always
+    #: sniffs, so mixed-format areas interoperate during migration.
+    lsdb_wire_format: str = "json"
     #: named routing-policy definitions (area_policies in the reference
     #: schema, OpenrConfig.thrift:544) referenced by
     #: AreaConfig.import_policy / OriginatedPrefix.origination_policy;
@@ -246,6 +251,13 @@ class OpenrConfig:
         d = self.decision_config
         if not (0 < d.debounce_min_ms <= d.debounce_max_ms):
             raise ValueError("invalid decision debounce window")
+        from openr_tpu.lsdb_codec import WIRE_FORMATS
+
+        if self.lsdb_wire_format not in WIRE_FORMATS:
+            raise ValueError(
+                f"lsdb_wire_format must be one of {WIRE_FORMATS}, "
+                f"got {self.lsdb_wire_format!r}"
+            )
         if self.persistent_store_path == "/tmp/openr_tpu_persistent_store.bin":
             # node-scope the default so co-hosted daemons never share a
             # store file (compaction is last-writer-wins across processes)

@@ -52,11 +52,18 @@ from openr_tpu.types import (
 
 
 def deserialize_adj_db(data: bytes) -> AdjacencyDatabase:
-    return AdjacencyDatabase.from_wire(json.loads(data.decode()))
+    """Format-sniffing (JSON or the reference's thrift-compact bytes —
+    openr_tpu.lsdb_codec), so Decision consumes floods from either
+    encoding, including a reference node's."""
+    from openr_tpu.lsdb_codec import deserialize_adj_db as _de
+
+    return _de(data)
 
 
 def deserialize_prefix_db(data: bytes) -> PrefixDatabase:
-    return PrefixDatabase.from_wire(json.loads(data.decode()))
+    from openr_tpu.lsdb_codec import deserialize_prefix_db as _de
+
+    return _de(data)
 
 
 class Decision(Actor):

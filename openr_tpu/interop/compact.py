@@ -182,10 +182,33 @@ class CompactWriter:
             raise ValueError(f"unknown thrift spec type {ftype!r}")
 
 
+#: per-spec field-id lookup cache: specs are module-level constant
+#: tuples, and rebuilding the {fid: row} dict for every decoded struct
+#: instance (every adjacency of every flooded publication on the
+#: Decision hot path) is pure waste
+_BY_ID_CACHE: Dict[int, Dict[int, tuple]] = {}
+
+
+def _by_id(spec: StructSpec) -> Dict[int, tuple]:
+    cached = _BY_ID_CACHE.get(id(spec))
+    if cached is None:
+        cached = {fid: (name, ftype, arg) for fid, name, ftype, arg in spec}
+        _BY_ID_CACHE[id(spec)] = cached
+    return cached
+
+
+#: untrusted input guard: crafted bytes like 0x1C repeated (every byte a
+#: nested-struct field header) recurse once per level — cap well above
+#: any real Open/R struct (max nesting ~4) but far below Python's
+#: recursion limit so garbage fails as ValueError, not RecursionError
+_MAX_DEPTH = 32
+
+
 class CompactReader:
     def __init__(self, data: bytes) -> None:
         self._data = data
         self._pos = 0
+        self._depth = 0
 
     def _take(self, n: int) -> bytes:
         if self._pos + n > len(self._data):
@@ -221,7 +244,21 @@ class CompactReader:
     # -- spec-driven struct ------------------------------------------------
 
     def read_struct(self, spec: StructSpec) -> Dict[str, Any]:
-        by_id = {fid: (name, ftype, arg) for fid, name, ftype, arg in spec}
+        by_id = _by_id(spec)
+        self._enter()
+        try:
+            return self._read_struct_fields(by_id)
+        finally:
+            self._depth -= 1
+
+    def _enter(self) -> None:
+        self._depth += 1
+        if self._depth > _MAX_DEPTH:
+            raise ValueError(
+                f"compact payload nests deeper than {_MAX_DEPTH} structs"
+            )
+
+    def _read_struct_fields(self, by_id: Dict[int, tuple]) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         last_fid = 0
         while True:
@@ -318,16 +355,20 @@ class CompactReader:
                     self._skip((kv >> 4) & 0x0F)
                     self._skip(kv & 0x0F)
         elif ct == CT_STRUCT:
-            while True:
-                head = self.read_byte()
-                if head == CT_STOP:
-                    return
-                if not (head >> 4) & 0x0F:
-                    self.read_zigzag()  # long-form field id
-                inner = head & 0x0F
-                if inner in (CT_BOOL_TRUE, CT_BOOL_FALSE):
-                    continue  # field bools fold the value into the type
-                self._skip(inner)
+            self._enter()
+            try:
+                while True:
+                    head = self.read_byte()
+                    if head == CT_STOP:
+                        return
+                    if not (head >> 4) & 0x0F:
+                        self.read_zigzag()  # long-form field id
+                    inner = head & 0x0F
+                    if inner in (CT_BOOL_TRUE, CT_BOOL_FALSE):
+                        continue  # field bools fold the value in the type
+                    self._skip(inner)
+            finally:
+                self._depth -= 1
         else:
             raise ValueError(f"cannot skip compact wire type {ct}")
 

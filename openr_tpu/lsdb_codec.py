@@ -1,0 +1,73 @@
+"""LSDB flood-payload codec: JSON (native) or thrift-compact (interop).
+
+KvStore ``Value.value`` payloads under ``adj:<node>`` / ``prefix:...``
+keys carry a serialized AdjacencyDatabase / PrefixDatabase.  This
+framework's native encoding is wire-JSON (1:1 with the thrift-shaped
+dataclasses, README "Wire format"); the reference encodes the same
+structs with ``apache::thrift::CompactSerializer``
+(LinkMonitor.h:369, KvStoreUtil-inl.h:20).  With
+``OpenrConfig.lsdb_wire_format = "thrift-compact"`` a daemon floods the
+reference's byte encoding instead, and DECODING always sniffs — JSON
+payloads begin with ``{`` (0x7B), compact AdjacencyDatabase/
+PrefixDatabase payloads begin with the field-1 string header (0x18,
+``thisNodeName`` is always set) — so mixed-format areas interoperate
+during a migration and a reference node's floods are readable either
+way."""
+
+from __future__ import annotations
+
+import json
+
+from openr_tpu.types import AdjacencyDatabase, PrefixDatabase
+
+#: accepted values for OpenrConfig.lsdb_wire_format
+WIRE_JSON = "json"
+WIRE_THRIFT_COMPACT = "thrift-compact"
+WIRE_FORMATS = (WIRE_JSON, WIRE_THRIFT_COMPACT)
+
+
+def _check_fmt(fmt: str) -> None:
+    if fmt not in WIRE_FORMATS:
+        raise ValueError(f"unknown lsdb_wire_format {fmt!r}")
+
+
+def serialize_adj_db(
+    db: AdjacencyDatabase, fmt: str = WIRE_JSON
+) -> bytes:
+    _check_fmt(fmt)
+    if fmt == WIRE_THRIFT_COMPACT:
+        from openr_tpu.interop import encode_adjacency_database
+
+        return encode_adjacency_database(db)
+    return json.dumps(db.to_wire()).encode()
+
+
+def serialize_prefix_db(
+    db: PrefixDatabase, fmt: str = WIRE_JSON
+) -> bytes:
+    _check_fmt(fmt)
+    if fmt == WIRE_THRIFT_COMPACT:
+        from openr_tpu.interop import encode_prefix_database
+
+        return encode_prefix_database(db)
+    return json.dumps(db.to_wire()).encode()
+
+
+def _is_json(data: bytes) -> bool:
+    return data[:1] == b"{"
+
+
+def deserialize_adj_db(data: bytes) -> AdjacencyDatabase:
+    if _is_json(data):
+        return AdjacencyDatabase.from_wire(json.loads(data.decode()))
+    from openr_tpu.interop import decode_adjacency_database
+
+    return decode_adjacency_database(data)
+
+
+def deserialize_prefix_db(data: bytes) -> PrefixDatabase:
+    if _is_json(data):
+        return PrefixDatabase.from_wire(json.loads(data.decode()))
+    from openr_tpu.interop import decode_prefix_database
+
+    return decode_prefix_database(data)

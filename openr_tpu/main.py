@@ -41,6 +41,7 @@ from openr_tpu.fib.fib import Fib, FibAgent
 from openr_tpu.kvstore.kv_store import KvStore
 from openr_tpu.kvstore.transport import KvStoreTransport
 from openr_tpu.link_monitor.link_monitor import LinkMonitor
+from openr_tpu.lsdb_codec import serialize_adj_db as _serialize_adj_db
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.monitor.monitor import Monitor
 from openr_tpu.neighbor_monitor import NeighborMonitor
@@ -213,6 +214,9 @@ class OpenrNode:
                 if netlink_events_queue is not None
                 else None
             ),
+            serialize_adj_db=(
+                lambda db: _serialize_adj_db(db, config.lsdb_wire_format)
+            ),
         )
         # the handshake advertises our DUAL capability; single source of
         # truth is the kvstore config
@@ -271,6 +275,7 @@ class OpenrNode:
                 for a in config.areas
                 if a.import_policy
             },
+            lsdb_wire_format=config.lsdb_wire_format,
         )
         solver = SpfSolver(
             self.name,

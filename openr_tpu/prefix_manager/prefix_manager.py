@@ -44,12 +44,17 @@ from openr_tpu.types import (
 )
 
 
-def serialize_prefix_db(db: PrefixDatabase) -> bytes:
-    return json.dumps(db.to_wire()).encode()
+def serialize_prefix_db(db: PrefixDatabase, fmt: str = "json") -> bytes:
+    from openr_tpu.lsdb_codec import serialize_prefix_db as _ser
+
+    return _ser(db, fmt)
 
 
 def deserialize_prefix_db(data: bytes) -> PrefixDatabase:
-    return PrefixDatabase.from_wire(json.loads(data.decode()))
+    """Format-sniffing: JSON or the reference's thrift-compact bytes."""
+    from openr_tpu.lsdb_codec import deserialize_prefix_db as _de
+
+    return _de(data)
 
 
 class PrefixManager(Actor):
@@ -67,9 +72,13 @@ class PrefixManager(Actor):
         counters: Optional[CounterMap] = None,
         policy_manager=None,
         area_import_policies: Optional[Dict[str, str]] = None,
+        lsdb_wire_format: str = "json",
     ) -> None:
         super().__init__("prefix_manager", clock, counters)
         self.node_name = node_name
+        #: flood-payload encoding ("json" | "thrift-compact") — see
+        #: openr_tpu.lsdb_codec
+        self.lsdb_wire_format = lsdb_wire_format
         self.kv_request_queue = kv_request_queue
         self.static_route_updates_queue = static_route_updates_queue
         self.prefix_updates_reader = prefix_updates_reader
@@ -338,7 +347,7 @@ class PrefixManager(Actor):
                     request_type=KvRequestType.PERSIST_KEY,
                     area=area,
                     key=key,
-                    value=serialize_prefix_db(db),
+                    value=serialize_prefix_db(db, self.lsdb_wire_format),
                 )
             )
         # withdraw keys no longer desired: stop refreshing AND flood an
@@ -362,7 +371,7 @@ class PrefixManager(Actor):
                     request_type=KvRequestType.SET_KEY,
                     area=area,
                     key=key,
-                    value=serialize_prefix_db(tombstone),
+                    value=serialize_prefix_db(tombstone, self.lsdb_wire_format),
                 )
             )
         self._advertised_keys = set(desired)

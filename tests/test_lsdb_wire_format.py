@@ -1,0 +1,118 @@
+"""LSDB flood-payload format end-to-end: thrift-compact and mixed areas.
+
+With ``OpenrConfig.lsdb_wire_format = "thrift-compact"`` every
+``adj:``/``prefix:`` KvStore value carries the reference's
+CompactSerializer byte encoding (openr_tpu/interop) instead of wire
+JSON; decoding sniffs, so a mixed-format network — half the nodes
+flooding compact, half JSON, as in a migration or federation with
+reference nodes — must converge identically."""
+
+import asyncio
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import line_edges, ring_edges
+from openr_tpu.lsdb_codec import (
+    deserialize_adj_db,
+    deserialize_prefix_db,
+    serialize_adj_db,
+    serialize_prefix_db,
+)
+from openr_tpu.types import AdjacencyDatabase, Adjacency, PrefixDatabase
+from openr_tpu.types import parse_adj_key, parse_prefix_key
+
+CONVERGE_S = 12.0
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_codec_round_trip_and_sniffing():
+    db = AdjacencyDatabase(
+        this_node_name="n1",
+        adjacencies=[
+            Adjacency(other_node_name="n2", if_name="e0", metric=3,
+                      next_hop_v6="fe80::2")
+        ],
+        area="7",
+    )
+    js = serialize_adj_db(db, "json")
+    tc = serialize_adj_db(db, "thrift-compact")
+    assert js[:1] == b"{" and tc[:1] != b"{"
+    assert deserialize_adj_db(js) == deserialize_adj_db(tc)
+    pdb = PrefixDatabase(this_node_name="n1", delete_prefix=True)
+    assert (
+        deserialize_prefix_db(serialize_prefix_db(pdb, "thrift-compact"))
+        == deserialize_prefix_db(serialize_prefix_db(pdb, "json"))
+    )
+
+
+def _flood_values(net, node):
+    return net.nodes[node].kv_store.dump_all("0")
+
+
+def test_thrift_compact_network_converges_and_floods_compact_bytes():
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(
+            clock,
+            config_overrides=lambda cfg: setattr(
+                cfg, "lsdb_wire_format", "thrift-compact"
+            ),
+        )
+        net.build(line_edges(3))
+        net.start()
+        await clock.run_for(CONVERGE_S)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        # every flooded LSDB payload is compact bytes, not JSON
+        checked = 0
+        for key, v in _flood_values(net, "node0").items():
+            if v.value is None:
+                continue
+            if parse_adj_key(key) or parse_prefix_key(key):
+                assert v.value[:1] != b"{", key
+                # and it decodes as the reference encoding
+                if parse_adj_key(key):
+                    db = deserialize_adj_db(v.value)
+                    assert db.this_node_name
+                checked += 1
+        assert checked >= 5  # 3 adj dbs + loopback prefixes
+        await net.stop()
+
+    run(main())
+
+
+def test_mixed_format_network_interoperates():
+    """Even-numbered nodes flood thrift-compact, odd flood JSON; the
+    ring must converge full-mesh either way (decode always sniffs)."""
+
+    def overrides(cfg):
+        idx = int(cfg.node_name.replace("node", ""))
+        cfg.lsdb_wire_format = (
+            "thrift-compact" if idx % 2 == 0 else "json"
+        )
+
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=overrides)
+        net.build(ring_edges(4))
+        net.start()
+        await clock.run_for(CONVERGE_S)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        vals = _flood_values(net, "node1")
+        fmts = set()
+        for key, v in vals.items():
+            n = parse_adj_key(key)
+            if n and v.value:
+                fmts.add("json" if v.value[:1] == b"{" else "compact")
+        assert fmts == {"json", "compact"}
+        await net.stop()
+
+    run(main())

@@ -434,3 +434,42 @@ def test_breeze_decode_thrift_rejects_bad_input_cleanly():
     )
     assert r2.exit_code != 0
     assert "not a valid compact" in r2.output and "Traceback" not in r2.output
+
+
+def test_crafted_deep_nesting_fails_as_value_error():
+    """Untrusted input guard: 0x1C repeated parses as one nested-struct
+    field header per byte — must fail as ValueError (clean CLI error),
+    never RecursionError (raw traceback)."""
+    import pytest
+
+    from openr_tpu.interop import decode_adjacency_database
+
+    payload = bytes([0x1C]) * 4096
+    with pytest.raises(ValueError):
+        decode_adjacency_database(payload)
+    # the CLI surfaces it as a clean click error, not a traceback
+    from click.testing import CliRunner
+
+    from openr_tpu.cli.breeze import breeze
+
+    r = CliRunner().invoke(
+        breeze,
+        ["kvstore", "decode-thrift", "--hex", payload.hex(), "--kind", "adj"],
+        obj={},
+    )
+    assert r.exit_code != 0
+    assert "not a valid compact" in r.output and "Traceback" not in r.output
+
+
+def test_unknown_wire_format_rejected():
+    import pytest
+
+    from openr_tpu.config import OpenrConfig
+    from openr_tpu.lsdb_codec import serialize_adj_db
+
+    with pytest.raises(ValueError):
+        OpenrConfig(node_name="x", lsdb_wire_format="msgpack")
+    with pytest.raises(ValueError):
+        serialize_adj_db(
+            T.AdjacencyDatabase(this_node_name="x"), "msgpack"
+        )
