@@ -21,7 +21,6 @@ batched kernels) — the seam BASELINE.json pins at the plugin boundary.
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Callable, Dict, List, Optional, Set
 

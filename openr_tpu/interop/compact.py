@@ -186,15 +186,19 @@ class CompactWriter:
 #: tuples, and rebuilding the {fid: row} dict for every decoded struct
 #: instance (every adjacency of every flooded publication on the
 #: Decision hot path) is pure waste
-_BY_ID_CACHE: Dict[int, Dict[int, tuple]] = {}
+_BY_ID_CACHE: Dict[int, tuple] = {}
 
 
 def _by_id(spec: StructSpec) -> Dict[int, tuple]:
+    # keyed by id(spec) but verified by identity AND keeping the spec
+    # referenced: a gc'd dynamic spec whose address got reused must not
+    # hit a stale entry (silent wrong-field decodes)
     cached = _BY_ID_CACHE.get(id(spec))
-    if cached is None:
-        cached = {fid: (name, ftype, arg) for fid, name, ftype, arg in spec}
-        _BY_ID_CACHE[id(spec)] = cached
-    return cached
+    if cached is not None and cached[0] is spec:
+        return cached[1]
+    by_id = {fid: (name, ftype, arg) for fid, name, ftype, arg in spec}
+    _BY_ID_CACHE[id(spec)] = (spec, by_id)
+    return by_id
 
 
 #: untrusted input guard: crafted bytes like 0x1C repeated (every byte a
@@ -345,15 +349,23 @@ class CompactReader:
             size = (head >> 4) & 0x0F
             if size == 0x0F:
                 size = self.read_varint()
-            for _ in range(size):
-                self._skip(head & 0x0F)
+            self._enter()  # crafted nested containers recurse like structs
+            try:
+                for _ in range(size):
+                    self._skip(head & 0x0F)
+            finally:
+                self._depth -= 1
         elif ct == CT_MAP:
             size = self.read_varint()
             if size:
                 kv = self.read_byte()
-                for _ in range(size):
-                    self._skip((kv >> 4) & 0x0F)
-                    self._skip(kv & 0x0F)
+                self._enter()
+                try:
+                    for _ in range(size):
+                        self._skip((kv >> 4) & 0x0F)
+                        self._skip(kv & 0x0F)
+                finally:
+                    self._depth -= 1
         elif ct == CT_STRUCT:
             self._enter()
             try:

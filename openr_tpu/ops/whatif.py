@@ -186,7 +186,8 @@ class LinkFailureSweep:
         self._plan = None
         self._base_seed = None  # cross-generation warm init
         self._pull_tables = None  # (lanes, tables) reused by plan()
-        self.base_was_warm = False
+        #: how the base solve was produced: "warm" | "native" | "device"
+        self.base_source = "unset"
 
     # -- base solve + repair plan ------------------------------------------
 
@@ -271,7 +272,17 @@ class LinkFailureSweep:
         return dist_h[:, 0], nh_bits
 
     def base_solve(self):
-        """(dist [V] f32, nh [V, D] int8) for the unperturbed topology."""
+        """(dist [V] f32, nh [V, D] int8) for the unperturbed topology.
+
+        Resolution order: cross-generation warm seed (exact repair from
+        the previous LSDB generation) ▸ native C++ Dijkstra (exact and
+        ~1 ms — the cold device kernel costs ~2.4 s of compile+solve on
+        a tunneled chip, which used to be the first-what-if-after-
+        restart latency) ▸ cold device kernel (no native lib, or root
+        degree beyond the native lane limit).  All three produce the
+        same fixed point: path distances are sequential f32 sums in
+        path order under every method, and the bench asserts native/
+        device bit parity on every run."""
         if self._base is None:
             import jax
             import jax.numpy as jnp
@@ -283,8 +294,40 @@ class LinkFailureSweep:
 
             if self._base_seed is not None:
                 self._base = self._warm_base_solve()
-                self.base_was_warm = True
+                self.base_source = "warm"
                 return self._base
+            try:
+                from openr_tpu.ops.consts import BIG
+                from openr_tpu.ops.native_spf import NativeSpf
+
+                native = NativeSpf(self.topo, self.root)
+                dist_n, _ = native.solve(failed_link=-1)
+                nh_n = native.lanes_dense(self.D)
+                # device kernels encode unreachable as BIG (f32-safe
+                # pseudo-inf); the native solver uses true inf — map to
+                # the device convention so repair seeds/diffs agree
+                dist_n = np.where(
+                    np.isfinite(dist_n), dist_n, np.float32(BIG)
+                ).astype(np.float32)
+                self._base = (dist_n, nh_n.astype(np.int8))
+                self.base_source = "native"
+                return self._base
+            except (ImportError, OSError, ValueError):
+                # benign: no native .so, or root out-degree beyond the
+                # native lane cap — the device kernel serves instead
+                self.base_source = "device"
+            except Exception:
+                # a REAL native fault (rc != 0, shape bug) must not hide
+                # behind the fallback's silence — log it, then recover
+                # via the device kernel
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "native base solve failed unexpectedly; falling back"
+                    " to the device kernel",
+                    exc_info=True,
+                )
+                self.base_source = "device"
             dist, nh = sweep_spf_link_failures(
                 self._src,
                 self._dst,
@@ -343,6 +386,11 @@ class LinkFailureSweep:
         shortest path from the root.  Failing any OTHER link provably
         leaves the root's SPF result unchanged."""
         return self.plan().on_dag_link
+
+    @property
+    def base_was_warm(self) -> bool:
+        """Derived from base_source — one source of truth."""
+        return self.base_source == "warm"
 
     def _chunk_sizes(self, n: int) -> List[int]:
         """Greedy largest-first cover of ``n`` unique solves by bucket

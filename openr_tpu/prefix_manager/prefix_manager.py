@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import copy
 import ipaddress
-import json
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from openr_tpu import constants as C

@@ -473,3 +473,15 @@ def test_unknown_wire_format_rejected():
         serialize_adj_db(
             T.AdjacencyDatabase(this_node_name="x"), "msgpack"
         )
+
+
+def test_crafted_deep_container_nesting_fails_as_value_error():
+    """0x19 repeated parses as a size-1 list-of-lists per byte in the
+    unknown-field skip path — must fail as ValueError like the struct
+    variant (the skip recursion is depth-capped too)."""
+    import pytest
+
+    from openr_tpu.interop import decode_adjacency_database
+
+    with pytest.raises(ValueError):
+        decode_adjacency_database(bytes([0x19]) * 4096)

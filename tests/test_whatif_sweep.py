@@ -245,3 +245,38 @@ class TestWarmBaseAcrossGenerations:
         ref = ls_new.run_spf("node0")
         for node, r in ref.items():
             assert d[topo_new.node_id(node)] == r.metric
+
+
+def test_native_base_solve_bit_matches_device_base(monkeypatch):
+    """The engine seeds its base solve from the native C++ Dijkstra
+    (~1 ms) instead of the cold device kernel (~2.4 s compile+solve on a
+    tunneled chip — the old first-what-if-after-restart latency).  The
+    two bases must be bit-identical, and sweeps from either base must
+    produce identical route tables."""
+    _, topo = make_topo(random_connected_edges(48, 96, seed=13))
+    eng_native = LinkFailureSweep(topo, "node0")
+    base_n = eng_native.base_solve()
+    assert eng_native.base_source == "native"
+
+    # force the device path by making the native import fail
+    import openr_tpu.ops.native_spf as native_mod
+
+    class Boom:
+        def __init__(self, *a, **k):
+            raise RuntimeError("forced device path")
+
+    monkeypatch.setattr(native_mod, "NativeSpf", Boom)
+    eng_device = LinkFailureSweep(topo, "node0")
+    base_d = eng_device.base_solve()
+    assert eng_device.base_source == "device"
+
+    assert np.array_equal(base_n[0], base_d[0])  # dist bit parity
+    assert np.array_equal(base_n[1], base_d[1])  # lane bit parity
+
+    fails = np.arange(min(48, len(topo.links)), dtype=np.int32)
+    r_n = eng_native.run(fails)
+    r_d = eng_device.run(fails)
+    assert np.array_equal(r_n.snap_row, r_d.snap_row)
+    for s in range(0, len(fails), 7):
+        assert np.array_equal(r_n.dist_of(s), r_d.dist_of(s))
+        assert np.array_equal(r_n.nh_of(s), r_d.nh_of(s))
