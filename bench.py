@@ -27,7 +27,11 @@ Baselines (single-threaded C++, native/spf_scalar.cc):
     uses, in C++ (spf_warm_sweep: off-DAG skip + affected-region
     Dijkstra seeded from the base solve).  The demanding apples-to-
     apples line: it separates "TPU is fast" from "incremental beats
-    from-scratch" (VERDICT r3 missing #2).
+    from-scratch" (VERDICT r3 missing #2).  SPF tables only.
+  * **native engine end-to-end** — C++ warm sweep + numpy selection +
+    base diff per unique on-DAG failure: the actual off-device engine
+    the Decision what-if API runs, producing ROUTES OUT like the
+    headline (and asserted to find the identical delta count).
   * **python** — the pure-Python oracle (round-1's flattering
     denominator, kept for transparency).
 
@@ -98,6 +102,59 @@ def main() -> None:
         native.warm_sweep(fails)
         warm_times.append(time.perf_counter() - t0)
     native_warm_sps = total / statistics.median(warm_times)
+
+    # ---- native ENGINE end to end: the operator alternative --------------
+    # C++ warm-start sweep per unique on-DAG failure + numpy selection +
+    # diff vs the base route table — exactly what the Decision what-if
+    # API runs when it picks the native engine, with the same dedup and
+    # off-DAG-alias courtesies the device pipeline gets (an off-DAG
+    # failure provably changes no routes).  This is the most demanding
+    # apples-to-apples denominator: same algorithm, same output.
+    from openr_tpu.ops.np_select import select_routes_numpy
+    from openr_tpu.ops.sweep_select import SweepCandidates
+    from openr_tpu.ops.whatif import root_lane_count
+
+    cands = SweepCandidates.single_advertiser(np.arange(n_nodes))
+    sel_args_np = (
+        cands.cand_node,
+        cands.cand_ok,
+        cands.drain_metric,
+        cands.path_pref,
+        cands.source_pref,
+        cands.distance,
+        cands.min_nexthop,
+    )
+    soft_np = np.zeros(topo.padded_nodes, np.int32)
+    root_np = topo.node_id("node0")
+    D_eng = root_lane_count(topo, root_np)  # == LinkFailureSweep.D
+    uniq_on = uniq[native.link_on_dag[uniq].astype(bool)]
+    bdist_n, bmask_n = native.warm_base
+    blanes_n = native.lanes_dense(D_eng, mask=bmask_n)
+    bvalid, bmetric, bnh, _, _ = select_routes_numpy(
+        *sel_args_np, bdist_n, blanes_n, topo.overloaded, soft_np, root_np
+    )
+    native_e2e_times = []
+    native_route_deltas = 0
+    for _ in range(NATIVE_REPS):
+        t0 = time.perf_counter()
+        native_route_deltas = 0
+        for link in uniq_on:
+            native.warm_sweep(
+                np.asarray([link], np.int32), keep_last=True
+            )
+            lanes = native.lanes_dense(D_eng)
+            v, m, nh, _n, _u = select_routes_numpy(
+                *sel_args_np, native.dist, lanes,
+                topo.overloaded, soft_np, root_np,
+            )
+            changed = (v != bvalid) | (
+                v & bvalid & (
+                    (m != bmetric) | (nh != bnh).any(axis=1)
+                )
+            )
+            native_route_deltas += int(changed.sum())
+        native_e2e_times.append(time.perf_counter() - t0)
+    native_e2e_sps = total / statistics.median(native_e2e_times)
 
     # ---- pure-Python oracle (round-1's flattering denominator) -----------
     ls.run_spf("node0", links_to_ignore=frozenset([topo.links[0]]))
@@ -212,12 +269,12 @@ def main() -> None:
     # changed route rows cross the tunnel; every chunk's selection kernel
     # is dispatched before the first blocking fetch so selection of chunk
     # k overlaps SPF of chunk k+1
-    from openr_tpu.ops.sweep_select import SweepCandidates, SweepRouteSelector
+    from openr_tpu.ops.sweep_select import SweepRouteSelector
 
     sel = SweepRouteSelector(
         topo,
         "node0",
-        SweepCandidates.single_advertiser(np.arange(n_nodes)),
+        cands,
         max_degree=eng.D,
         mesh=mesh,
     )
@@ -245,6 +302,14 @@ def main() -> None:
     while pend:
         deltas = pend.pop(0).finish()
     e2e_sps = e2e_reps * total / (time.perf_counter() - t0)
+
+    # the two end-to-end pipelines must find the IDENTICAL delta count —
+    # computed independently (C++ sweep + numpy select vs device repair
+    # kernel + on-device select + fused compaction)
+    assert int(deltas.num_deltas) == native_route_deltas, (
+        deltas.num_deltas,
+        native_route_deltas,
+    )
 
     # route parity vs native for sample snapshots (base + changed rows)
     for s in (3, 1007, 9000):
@@ -310,6 +375,14 @@ def main() -> None:
                         native_warm_sps, 1
                     ),
                     "native_warm_spread": spread(warm_times),
+                    "native_engine_routes_per_sec": round(
+                        native_e2e_sps, 1
+                    ),
+                    "native_engine_spread": spread(native_e2e_times),
+                    "native_engine_route_deltas": int(native_route_deltas),
+                    "vs_native_engine_e2e": round(
+                        e2e_sps / native_e2e_sps, 2
+                    ),
                     "python_solves_per_sec": round(python_sps, 1),
                     "device_spf_tables_per_sec": round(engine_sps, 1),
                     "device_raw_solves_per_sec": round(device_raw_sps, 1),

@@ -124,6 +124,15 @@ class SweepResult:
         return self.nh[self.snap_row[snapshot]]
 
 
+def root_lane_count(topo: EncodedTopology, root_id: int) -> int:
+    """Lane count for a sweep vantage: the root's out-degree (lane r ==
+    r-th directed out-edge of the root in edge order).  Shared by the
+    engine and the benchmarks so the two can never drift."""
+    return max(
+        int(((topo.src == root_id) & (topo.link_index >= 0)).sum()), 1
+    )
+
+
 class LinkFailureSweep:
     """Per-(topology, root) sweep engine over the warm-start repair
     kernel (ops/repair.py), with base aliasing + off-DAG skip + dedup."""
@@ -160,16 +169,7 @@ class LinkFailureSweep:
         self.solve_buckets = tuple(solve_buckets)
         self.batch_granularity = gran
         self.max_chunk = max_chunk
-        #: lane count: the root's out-degree (lane r == r-th directed
-        #: out-edge of the root in edge order)
-        self.D = max(
-            int(
-                (
-                    (topo.src == self.root_id) & (topo.link_index >= 0)
-                ).sum()
-            ),
-            1,
-        )
+        self.D = root_lane_count(topo, self.root_id)
         from openr_tpu.ops.spf import PACKED_MAX_IN_DEGREE
 
         # base solve uses the channel-packed cold kernel when in-degree
