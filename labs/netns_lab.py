@@ -81,6 +81,10 @@ class NetnsLab:
     ctrl_port: int = 2018  # same port in every namespace (isolated stacks)
     work_dir: str = ""
     fib_mode: str = "netlink"
+    #: LSDB flood-payload encoding: "json", "thrift-compact", or
+    #: "mixed" (even nodes compact, odd JSON — the migration shape;
+    #: decode sniffs, so the formats interoperate)
+    lsdb_wire_format: str = "json"
     procs: Dict[str, subprocess.Popen] = field(default_factory=dict)
 
     def node_name(self, i: int) -> str:
@@ -149,6 +153,12 @@ class NetnsLab:
             # v6-only veils carrying v4 prefixes (RFC 5549)
             "v4_over_v6_nexthop": True,
         }
+        if self.lsdb_wire_format == "mixed":
+            cfg["lsdb_wire_format"] = (
+                "thrift-compact" if i % 2 == 0 else "json"
+            )
+        elif self.lsdb_wire_format != "json":
+            cfg["lsdb_wire_format"] = self.lsdb_wire_format
         if self.topology == "multiarea":
             cfg["areas"] = self._multiarea_areas(i)
             if i == 4:

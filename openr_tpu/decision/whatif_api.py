@@ -704,7 +704,12 @@ class NativeWhatIfEngine:
         cands = encode_prefix_candidates(prefix_state, topo, area)
         native = NativeSpf(topo, me)
         native.warm_prepare()
-        D = max(int(native.lane_of_edge.max()) + 1, 1)
+        # shared lane-count formula (ops.whatif.root_lane_count) — a
+        # third independent implementation here could silently diverge
+        # from the device engine and the bench on padded topologies
+        from openr_tpu.ops.whatif import root_lane_count
+
+        D = root_lane_count(topo, topo.node_id(me))
         soft = np.zeros(topo.padded_nodes, np.int32)
         sel_args = (
             cands.cand_node,

@@ -105,3 +105,34 @@ def test_multiarea_whatif_and_validate_on_lab():
         ):
             out3 = lab.breeze(node, *cmd)
             assert "OK" in out3, (node, cmd, out3)
+
+
+def test_mixed_wire_format_lab_converges():
+    """Real kernels, real UDP multicast + TCP sync, MIXED LSDB flood
+    encodings: even nodes flood thrift-compact (the reference's
+    CompactSerializer bytes), odd nodes flood JSON — the migration /
+    federation shape. Every kernel must still hold routes to every
+    other node's prefix, and node1's store must visibly hold both
+    encodings."""
+    lab = NetnsLab(num_nodes=3, topology="line", lsdb_wire_format="mixed")
+    with lab:
+        lab.wait_converged(timeout_s=300)
+        for i in range(3):
+            routes = "\n".join(lab.kernel_routes(i))
+            for j in range(3):
+                if i != j:
+                    assert f"10.77.{j}.0/24" in routes, (i, routes)
+        # the store on node1 carries adj values in BOTH encodings
+        import json as _json
+
+        out = lab.breeze(1, "kvstore", "key-vals", "adj:node0",
+                         "adj:node1")
+        blobs = _json.loads(out)
+        fmts = set()
+        for key, v in blobs.items():
+            raw = v.get("value")
+            blob = bytes.fromhex(raw) if v.get("_value_hex") else (
+                raw.encode() if isinstance(raw, str) else raw
+            )
+            fmts.add("json" if blob[:1] == b"{" else "compact")
+        assert fmts == {"json", "compact"}, fmts

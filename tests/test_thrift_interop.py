@@ -485,3 +485,40 @@ def test_crafted_deep_container_nesting_fails_as_value_error():
 
     with pytest.raises(ValueError):
         decode_adjacency_database(bytes([0x19]) * 4096)
+
+
+def test_fuzz_decoder_never_crashes_on_garbage():
+    """Untrusted-input contract: ANY byte string either decodes or
+    raises ValueError — no RecursionError, no hang, no IndexError.
+    Mutated-valid payloads probe deeper than pure-random bytes."""
+    rng = random.Random(1234)
+    adj = T.AdjacencyDatabase(
+        this_node_name="n1",
+        adjacencies=[
+            T.Adjacency(
+                other_node_name="n2", if_name="e0", next_hop_v6="fe80::2"
+            )
+        ],
+    )
+    valid = encode_adjacency_database(adj)
+    cases = []
+    for _ in range(300):
+        cases.append(
+            bytes(rng.randrange(256) for _ in range(rng.randrange(0, 80)))
+        )
+    for _ in range(300):  # bit-flip / truncate / extend a valid payload
+        b = bytearray(valid)
+        op = rng.randrange(3)
+        if op == 0 and b:
+            b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+        elif op == 1 and b:
+            del b[rng.randrange(len(b)) :]
+        else:
+            b += bytes(rng.randrange(256) for _ in range(rng.randrange(8)))
+        cases.append(bytes(b))
+    for data in cases:
+        for dec in (decode_adjacency_database, decode_value):
+            try:
+                dec(data)
+            except (ValueError, UnicodeDecodeError):
+                pass  # the contract: clean parse errors only
