@@ -2003,6 +2003,18 @@ def decision_route_detail(ctx: click.Context) -> None:
     _print(_call(ctx, "get_route_detail_db"))
 
 
+def _render_whatif_changes(changes) -> None:
+    for ch in changes:
+        old, new = ch["old_nexthops"], ch["new_nexthops"]
+        detail = f"{','.join(old) or '-'} -> {','.join(new) or '-'}"
+        if ch["change"] == "rerouted" and sorted(old) == sorted(new):
+            detail = (
+                f"metric {ch['old_metric']:g} -> {ch['new_metric']:g} "
+                f"via {','.join(new)}"
+            )
+        click.echo(f"  {ch['prefix']:24} {ch['change']:9} {detail}")
+
+
 @decision.command("whatif")
 @click.argument("links", nargs=-1, required=True,
                 metavar="NODE1,NODE2 [NODE1,NODE2 ...]")
@@ -2055,15 +2067,60 @@ def decision_whatif(
             click.echo(f"{link}: no route changes{note}")
             continue
         click.echo(f"{link}: {f['routes_changed']} route(s) change")
-        for ch in f["changes"]:
-            old, new = ch["old_nexthops"], ch["new_nexthops"]
-            detail = f"{','.join(old) or '-'} -> {','.join(new) or '-'}"
-            if ch["change"] == "rerouted" and sorted(old) == sorted(new):
-                detail = (
-                    f"metric {ch['old_metric']:g} -> {ch['new_metric']:g} "
-                    f"via {','.join(new)}"
-                )
-            click.echo(f"  {ch['prefix']:24} {ch['change']:9} {detail}")
+        _render_whatif_changes(f["changes"])
+
+
+@decision.command("whatif-node")
+@click.argument("node")
+@click.option("--area", default=None, help="restrict to one area's links")
+@click.pass_context
+def decision_whatif_node(ctx: click.Context, node: str, area) -> None:
+    """Which of this node's routes change if NODE fails entirely?
+
+    Expands the target's adjacencies into its full link set and fails
+    them SIMULTANEOUSLY through the what-if set engine — the
+    maintenance question behind a drain ('what breaks if we take this
+    node down?') answered from the live LSDB without touching it."""
+    links = []
+    seen = set()
+    areas = [area] if area else _call(ctx, "get_kv_store_areas")
+    for a in areas:
+        for db in _call(ctx, "get_decision_adjacency_dbs", area=a):
+            this = db.get("this_node_name")
+            for adj in db.get("adjacencies", []):
+                other = adj.get("other_node_name")
+                if node not in (this, other):
+                    continue
+                key = tuple(sorted((this, other)))
+                if key not in seen:
+                    seen.add(key)
+                    links.append(list(key))
+    if not links:
+        raise click.ClickException(
+            f"no adjacencies found for node {node!r}"
+        )
+    resp = _call(
+        ctx,
+        "get_link_failure_whatif",
+        link_failures=links,
+        simultaneous=True,
+    )
+    if not resp["eligible"]:
+        click.echo("what-if not answerable right now")
+        return
+    (f,) = resp["failures"]
+    n_links = len(links)
+    if "error" in f:
+        click.echo(f"{node} down ({n_links} links): {f['error']}")
+        return
+    if not f["routes_changed"]:
+        click.echo(f"{node} down ({n_links} links): no route changes")
+        return
+    click.echo(
+        f"{node} down ({n_links} links): "
+        f"{f['routes_changed']} route(s) change"
+    )
+    _render_whatif_changes(f["changes"])
 
 
 @decision.command("criticality")

@@ -524,6 +524,11 @@ class RepairSweep:
             raise ValueError(
                 f"repair sweep batch must be a multiple of {g}"
             )
+        # guarded dispatch: a fresh (batch, K) jit signature after other
+        # kernel families compiled is exactly the jax-0.9 executable-
+        # cache corruption trigger (ops/jit_guard.py)
+        from openr_tpu.ops.jit_guard import call_jit_guarded
+
         if self.mesh is not None:
             from openr_tpu.parallel.mesh import batch_sharding
 
@@ -531,13 +536,15 @@ class RepairSweep:
                 fails, batch_sharding(self.mesh)
             )
             kern = _sharded_kernel(self.mesh, p.lanes, p.din)
-            return kern(
+            return call_jit_guarded(
+                kern,
                 *(
                     fails_d if n == "fails" else self._const[n]
                     for n in _ARG_ORDER
-                )
+                ),
             )
-        return _kernel()(
+        return call_jit_guarded(
+            _kernel(),
             fails=jnp.asarray(fails),
             d_lanes=p.lanes,
             din=p.din,

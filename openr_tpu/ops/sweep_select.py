@@ -480,6 +480,12 @@ class SweepRouteSelector:
         bvalid_d, bmetric_d, blanes_d = self._base_dev
         P = self.cands.cand_node.shape[0]
 
+        # guarded dispatch throughout: the jax-0.9 executable-cache
+        # corruption has been caught drawing a stale entry for these
+        # kernels when the fleet kernels compiled first in the same
+        # process (the criticality pair-scan path; ops/jit_guard.py)
+        from openr_tpu.ops.jit_guard import call_jit_guarded
+
         selected: List[tuple] = []
         for off, n, dist_d, nh_d in sweep_result.chunks or []:
             sel_args = (
@@ -500,9 +506,13 @@ class SweepRouteSelector:
                 blanes_d,
             )
             if self.mesh is not None:
-                out = _sharded_select_chunk(self.mesh, self.D)(*sel_args)
+                out = call_jit_guarded(
+                    _sharded_select_chunk(self.mesh, self.D), *sel_args
+                )
             else:
-                out = _select_chunk(*sel_args, max_degree=self.D)
+                out = call_jit_guarded(
+                    _select_chunk, *sel_args, max_degree=self.D
+                )
             selected.append((off, n, out))
         comp = None
         comp_args = None
@@ -515,7 +525,7 @@ class SweepRouteSelector:
             )
             total_rows = sum(s[2][1].shape[0] for s in selected) * P
             cap = min(self._cap, total_rows)
-            comp = _compact_deltas(*comp_args, cap=cap)
+            comp = call_jit_guarded(_compact_deltas, *comp_args, cap=cap)
             for a in comp:
                 a.copy_to_host_async()
         # snapshot the base tuple NOW: a later start() against a rebuilt

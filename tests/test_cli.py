@@ -460,3 +460,26 @@ def test_cli_decision_criticality():
         lines = [l for l in out.splitlines() if l.startswith("node")]
         assert lines[0].startswith("node0-node1")
         assert "double-failure scan" in out
+
+
+def test_criticality_after_fleet_kernels_compiled():
+    """Regression (jax-0.9 executable-cache corruption): a fleet-summary
+    on one node used to poison a LATER node's criticality report in the
+    same process — the selector's fresh _select_chunk signature drew a
+    corrupted cache entry ('supplied 15 buffers but compiled program
+    expected 17') and the swallowed ValueError surfaced as a bogus
+    'needs the device what-if engine'.  The guarded dispatch must heal
+    it (ops/jit_guard.py)."""
+    with _live_ctrl_node(
+        num_nodes=3,
+        use_tpu_backend=True,
+        ready=lambda net: len(net.nodes["node0"].fib.get_route_db()) >= 2,
+    ) as port:
+        _run(port, "decision", "fleet-summary")
+    with _live_ctrl_node(
+        num_nodes=3,
+        use_tpu_backend=True,
+        ready=lambda net: len(net.nodes["node0"].fib.get_route_db()) >= 2,
+    ) as port:
+        out = _run(port, "decision", "criticality", "--pairs", "10")
+        assert "node0-node1" in out, out

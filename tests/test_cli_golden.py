@@ -474,3 +474,15 @@ def test_golden_config_prefix_manager(live_node):
     check_golden(
         "config_prefix_manager", live_node, "config", "prefix-manager"
     )
+
+
+def test_golden_whatif_node(live_tpu_node):
+    """node1 failing entirely partitions node0 from node1 AND node2 on
+    a line — both loopbacks withdraw (the drain-simulation question)."""
+    check_golden(
+        "decision_whatif_node",
+        live_tpu_node,
+        "decision",
+        "whatif-node",
+        "node1",
+    )
