@@ -8,7 +8,9 @@ Two modes:
     server on ``config.openr_ctrl_port`` — the library-level daemon.
   * ``python -m openr_tpu --emulate N [--topology ring|line|grid]``: an
     N-node emulated network in one process, each node's ctrl server on
-    ``base_port + i`` so breeze can target any of them.  This is the
+    consecutive free ports from ``base_port`` (ports another process
+    already holds are skipped; the bring-up banner prints each node's
+    actual port) so breeze can target any of them.  This is the
     moral equivalent of the reference's netns labs (openr/orie/labs/)
     without needing root: the wire is simulated, the API plane is real
     TCP.
@@ -18,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import errno
 import signal
 from typing import Dict, List, Optional, Tuple
 
@@ -80,15 +83,33 @@ async def run_emulation(
     net.build(edges)
     net.start()
     servers: List[OpenrCtrlServer] = []
-    for i, (name, node) in enumerate(sorted(net.nodes.items())):
-        server = OpenrCtrlServer(node, port=base_port + i)
-        await server.start()
+    next_port = base_port
+    for name, node in sorted(net.nodes.items()):
+        # another process may already hold a port in the range (seen in
+        # shared CI hosts); skip forward instead of crashing mid-bringup
+        window = 64
+        for _ in range(window):
+            server = OpenrCtrlServer(node, port=next_port)
+            next_port += 1
+            try:
+                await server.start()
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE:
+                    raise  # EACCES/EMFILE etc. are not port conflicts
+                continue
+        else:
+            raise SystemExit(
+                f"no free ctrl port for {name} in "
+                f"[{next_port - window}, {next_port})"
+            )
         servers.append(server)
         if verbose:
             print(f"{name}: ctrl on 127.0.0.1:{server.port}")
     if verbose:
         print(f"{len(net.nodes)} nodes up; try: "
-              f"python -m openr_tpu.cli.breeze --port {base_port} spark neighbors")
+              f"python -m openr_tpu.cli.breeze --port {servers[0].port} "
+              "spark neighbors")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
