@@ -606,3 +606,46 @@ def test_fib_do_not_install_transition_withdraws():
         await rig.fib.stop()
 
     run(main())
+
+
+def test_emulate_bringup_skips_occupied_ports():
+    """`python -m openr_tpu --emulate N` must survive a foreign process
+    holding a port in its ctrl range: skip forward, print each node's
+    ACTUAL port, and quote the first node's real port in the hint
+    (regression: a squatted port crashed bring-up mid-way on a shared
+    host)."""
+    import re
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    squat = socket.socket()
+    squat.bind(("127.0.0.1", 0))
+    base = squat.getsockname()[1]  # node0's port is taken
+    squat.listen(1)
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "openr_tpu", "--emulate", "2",
+             "--topology", "line", "--ctrl-base-port", str(base)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        lines = []
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line.strip())
+            if "nodes up" in line:
+                break
+        out = "\n".join(lines)
+        ports = [int(m) for m in re.findall(r"127\.0\.0\.1:(\d+)", out)]
+        assert len(ports) == 2, out
+        assert base not in ports, out  # the squatted port was skipped
+        assert f"--port {ports[0]} " in out, out  # hint quotes real port
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        squat.close()
