@@ -167,7 +167,13 @@ class CompactWriter:
                 self._write_value(etype, earg, item)
         elif ftype == "map":
             (ktype, karg), (vtype, varg) = arg
-            items = list(val.items())
+            # maps encode SORTED BY KEY for the same determinism reason
+            # as sets: dict insertion order varies across processes, and
+            # self-emitted Publication/linkStatusMap bytes must be
+            # stable.  (Reference bytes are nondeterministic here anyway
+            # — fbthrift C++ KeyVals is std::unordered_map — so sorting
+            # costs no compatibility.)
+            items = sorted(val.items(), key=lambda kv: kv[0])
             if not items:
                 self.write_byte(0)
                 return
@@ -185,7 +191,15 @@ class CompactWriter:
 #: per-spec field-id lookup cache: specs are module-level constant
 #: tuples, and rebuilding the {fid: row} dict for every decoded struct
 #: instance (every adjacency of every flooded publication on the
-#: Decision hot path) is pure waste
+#: Decision hot path) is pure waste.
+#:
+#: ASSUMPTION: specs are module-level constants (openr_wire.py and the
+#: test corpus).  The cache holds a strong reference to every spec it
+#: has seen, so a caller constructing specs dynamically at runtime pins
+#: each one forever — don't do that, or decode with
+#: ``CompactReader(data)._read_struct_fields({...})`` built by hand.
+#: (Tuples don't support weakrefs, so a WeakValueDictionary can't
+#: express the bounded variant.)
 _BY_ID_CACHE: Dict[int, tuple] = {}
 
 
@@ -199,6 +213,23 @@ def _by_id(spec: StructSpec) -> Dict[int, tuple]:
     by_id = {fid: (name, ftype, arg) for fid, name, ftype, arg in spec}
     _BY_ID_CACHE[id(spec)] = (spec, by_id)
     return by_id
+
+
+#: sentinel returned by _read_value when a container's declared element
+#: wire type disagrees with the spec: the container's bytes have been
+#: consumed (stream stays in sync) but the value is untrustworthy — the
+#: field degrades to unset, matching the field-level wire-type check
+_MISMATCH = object()
+
+
+def _elem_type_ok(ect: int, etype: str) -> bool:
+    """Does a container header's element ctype match the spec type?
+
+    Bool container elements encode as one byte 0x01/0x02, and writers
+    may declare either code in the header."""
+    if etype == "bool":
+        return ect in (CT_BOOL_TRUE, CT_BOOL_FALSE)
+    return _WIRE_OF.get(etype) == ect
 
 
 #: untrusted input guard: crafted bytes like 0x1C repeated (every byte a
@@ -281,6 +312,10 @@ class CompactReader:
                 # that changed a field's type (or a spec mistake) must
                 # degrade to a skipped field, not desync the byte stream
                 val = self._read_value(row[1], row[2])
+                if val is _MISMATCH:
+                    # container whose ELEMENT type disagreed with the
+                    # spec: bytes consumed in sync, field left unset
+                    continue
             else:
                 self._skip(ct)
                 continue
@@ -311,20 +346,62 @@ class CompactReader:
             size = (head >> 4) & 0x0F
             if size == 0x0F:
                 size = self.read_varint()
+            ect = head & 0x0F
+            if size and not _elem_type_ok(ect, etype):
+                # peer changed the element type: skip the container by
+                # its DECLARED wire type so the stream stays in sync,
+                # surface the mismatch so the field degrades to unset
+                self._skip_list_elems(ect, size)
+                return _MISMATCH
             items = [self._read_value(etype, earg) for _ in range(size)]
+            if any(item is _MISMATCH for item in items):
+                return _MISMATCH  # nested container element mismatched
             return set(items) if ftype == "set" else items
         if ftype == "map":
             (ktype, karg), (vtype, varg) = arg
             size = self.read_varint()
-            if size:
-                self.read_byte()  # key/value wire types
-            return {
-                self._read_value(ktype, karg): self._read_value(vtype, varg)
-                for _ in range(size)
-            }
+            if not size:
+                return {}
+            kv = self.read_byte()  # (key-ctype << 4) | value-ctype
+            if not (
+                _elem_type_ok((kv >> 4) & 0x0F, ktype)
+                and _elem_type_ok(kv & 0x0F, vtype)
+            ):
+                self._skip_map_elems(kv, size)
+                return _MISMATCH
+            out: Dict[Any, Any] = {}
+            mismatched = False
+            for _ in range(size):
+                k = self._read_value(ktype, karg)
+                v = self._read_value(vtype, varg)
+                if k is _MISMATCH or v is _MISMATCH:
+                    mismatched = True
+                else:
+                    out[k] = v
+            return _MISMATCH if mismatched else out
         if ftype == "struct":
             return self.read_struct(arg)
         raise ValueError(f"unknown thrift spec type {ftype!r}")
+
+    def _skip_list_elems(self, ect: int, size: int) -> None:
+        """Skip ``size`` list/set elements of wire type ``ect``; crafted
+        nested containers recurse like structs, so depth-guard."""
+        self._enter()
+        try:
+            for _ in range(size):
+                self._skip(ect)
+        finally:
+            self._depth -= 1
+
+    def _skip_map_elems(self, kv: int, size: int) -> None:
+        """Skip ``size`` map entries given the packed kv-types byte."""
+        self._enter()
+        try:
+            for _ in range(size):
+                self._skip((kv >> 4) & 0x0F)
+                self._skip(kv & 0x0F)
+        finally:
+            self._depth -= 1
 
     def _skip(self, ct: int) -> None:
         """Skip one unknown value of wire type ``ct`` (forward compat).
@@ -349,23 +426,11 @@ class CompactReader:
             size = (head >> 4) & 0x0F
             if size == 0x0F:
                 size = self.read_varint()
-            self._enter()  # crafted nested containers recurse like structs
-            try:
-                for _ in range(size):
-                    self._skip(head & 0x0F)
-            finally:
-                self._depth -= 1
+            self._skip_list_elems(head & 0x0F, size)
         elif ct == CT_MAP:
             size = self.read_varint()
             if size:
-                kv = self.read_byte()
-                self._enter()
-                try:
-                    for _ in range(size):
-                        self._skip((kv >> 4) & 0x0F)
-                        self._skip(kv & 0x0F)
-                finally:
-                    self._depth -= 1
+                self._skip_map_elems(self.read_byte(), size)
         elif ct == CT_STRUCT:
             self._enter()
             try:

@@ -583,6 +583,11 @@ class PendingDeltas:
                 self._comp
             )
             count = int(count)
+            # a larger cap is a FRESH jit signature compiled after
+            # other kernel families — exactly the jax-0.9 executable
+            # -cache corruption trigger — so guard it like dispatch
+            from openr_tpu.ops.jit_guard import call_jit_guarded
+
             while count > cap:
                 # rare overflow: re-compact with the next bucket that
                 # fits (the adaptive cap persists for later sweeps).
@@ -596,7 +601,9 @@ class PendingDeltas:
                 fetch_groups += 1
                 count, crow, cpref, cvalid, cmetric, clanes = (
                     jax.device_get(
-                        _compact_deltas(*self._comp_args, cap=cap)
+                        call_jit_guarded(
+                            _compact_deltas, *self._comp_args, cap=cap
+                        )
                     )
                 )
                 count = int(count)

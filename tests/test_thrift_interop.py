@@ -522,3 +522,58 @@ def test_fuzz_decoder_never_crashes_on_garbage():
                 dec(data)
             except (ValueError, UnicodeDecodeError):
                 pass  # the contract: clean parse errors only
+
+
+def test_container_element_type_mismatch_degrades_to_unset():
+    """A peer that changed a CONTAINER's element type must not desync
+    the stream mid-payload: the container is skipped by its declared
+    wire type and the field degrades to unset, same as the field-level
+    wire-type check (ADVICE r4)."""
+    # peer now sends tags as list<i32>; our spec still says list<string>
+    peer = ((1, "tags", "list", ("i32", None)), (2, "ttl", "i64", None))
+    ours = ((1, "tags", "list", ("string", None)), (2, "ttl", "i64", None))
+    data = encode_struct(peer, {"tags": [7, 8, 9], "ttl": 42})
+    out = decode_struct(ours, data)
+    assert "tags" not in out  # mismatched container dropped...
+    assert out["ttl"] == 42  # ...without desyncing the later field
+
+    # map: peer changed the VALUE type string->i64
+    peer_m = (
+        (1, "kv", "map", (("string", None), ("i64", None))),
+        (2, "ttl", "i64", None),
+    )
+    ours_m = (
+        (1, "kv", "map", (("string", None), ("string", None))),
+        (2, "ttl", "i64", None),
+    )
+    data = encode_struct(peer_m, {"kv": {"a": 1, "b": 2}, "ttl": 9})
+    out = decode_struct(ours_m, data)
+    assert "kv" not in out and out["ttl"] == 9
+
+    # empty containers carry a declared-type byte too; same rule applies
+    # but nothing can desync — current behavior: empty map decodes {}
+    # (no kv-type byte exists on the wire to check)
+    data = encode_struct(peer_m, {"kv": {}, "ttl": 9})
+    out = decode_struct(ours_m, data)
+    assert out["kv"] == {} and out["ttl"] == 9
+
+    # nested: list<list<i32>> received against spec list<list<string>>
+    peer_n = ((1, "m", "list", ("list", ("i32", None))),)
+    ours_n = ((1, "m", "list", ("list", ("string", None))),)
+    data = encode_struct(peer_n, {"m": [[1, 2], [3]]})
+    out = decode_struct(ours_n, data)
+    assert "m" not in out
+
+
+def test_map_encoding_is_sorted_and_deterministic():
+    """Maps sort by key for the same determinism reason as sets: our
+    self-emitted Publication/linkStatusMap bytes must not vary with
+    dict insertion order across processes (ADVICE r4)."""
+    spec = ((1, "kv", "map", (("string", None), ("i64", None))),)
+    a = encode_struct(spec, {"kv": {"b": 2, "a": 1}})
+    b = encode_struct(spec, {"kv": {"a": 1, "b": 2}})
+    assert a == b
+    # key 'a' first on the wire
+    assert a == bytes(
+        [0x1B, 0x02, 0x86, 0x01, 0x61, 0x02, 0x01, 0x62, 0x04, 0x00]
+    )
