@@ -85,6 +85,10 @@ class NetnsLab:
     #: "mixed" (even nodes compact, odd JSON — the migration shape;
     #: decode sniffs, so the formats interoperate)
     lsdb_wire_format: str = "json"
+    #: peer RPC plane: "jsonrpc" or "rocket" (fbthrift Rocket framing —
+    #: the reference's wire protocol; KvStore sync + floods then ride
+    #: Compact thrift structs in rsocket frames on the ctrl port)
+    lsdb_rpc_transport: str = "jsonrpc"
     procs: Dict[str, subprocess.Popen] = field(default_factory=dict)
 
     def node_name(self, i: int) -> str:
@@ -159,6 +163,8 @@ class NetnsLab:
             )
         elif self.lsdb_wire_format != "json":
             cfg["lsdb_wire_format"] = self.lsdb_wire_format
+        if self.lsdb_rpc_transport != "jsonrpc":
+            cfg["lsdb_rpc_transport"] = self.lsdb_rpc_transport
         if self.topology == "multiarea":
             cfg["areas"] = self._multiarea_areas(i)
             if i == 4:
@@ -267,9 +273,14 @@ class NetnsLab:
         return [line.strip() for line in out.splitlines() if line.strip()]
 
     def breeze(self, i: int, *args: str) -> str:
+        # rocket mode: fbthrift Rocket owns ctrl_port (the reference
+        # shape); the JSON-RPC operator listener breeze dials sits one up
+        port = self.ctrl_port + (
+            1 if self.lsdb_rpc_transport == "rocket" else 0
+        )
         cmd = (
             f"{sys.executable} -m openr_tpu.cli.breeze "
-            f"--port {self.ctrl_port} " + " ".join(args)
+            f"--port {port} " + " ".join(args)
         )
         return in_ns(self.ns_name(i), cmd, check=False).stdout
 

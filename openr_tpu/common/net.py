@@ -1,0 +1,31 @@
+"""Shared asyncio server plumbing."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable, Optional
+
+
+async def stop_stream_server(
+    server: Optional[asyncio.base_events.Server],
+    conn_tasks: Iterable[asyncio.Task],
+) -> None:
+    """Shut down an asyncio stream server: close the listener, cancel
+    connection handlers, THEN await wait_closed().
+
+    The ordering is load-bearing: since py3.12 ``wait_closed()`` blocks
+    until every connection handler returns, so awaiting it while
+    handlers are parked in reads (live KvStore peer sessions, idle
+    operator connections) deadlocks shutdown."""
+    if server is not None:
+        server.close()
+    tasks = list(conn_tasks)
+    for t in tasks:
+        t.cancel()
+    for t in tasks:
+        try:
+            await t
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+    if server is not None:
+        await server.wait_closed()

@@ -225,6 +225,14 @@ class OpenrConfig:
     #: CompactSerializer bytes — openr_tpu/interop).  Decoding always
     #: sniffs, so mixed-format areas interoperate during migration.
     lsdb_wire_format: str = "json"
+    #: RPC plane for KvStore peer sessions + the ctrl listener peers dial:
+    #: "jsonrpc" (native framed JSON-RPC) or "rocket" (the reference's
+    #: fbthrift Rocket framing with Compact thrift structs —
+    #: openr_tpu/interop/rocket.py).  In rocket mode the daemon serves a
+    #: RocketCtrlServer on `openr_ctrl_port` (what the reference's
+    #: ThriftServer does on :2018, Main.cpp:399-416) and moves the
+    #: JSON-RPC operator listener to `openr_ctrl_port + 1`.
+    lsdb_rpc_transport: str = "jsonrpc"
     #: named routing-policy definitions (area_policies in the reference
     #: schema, OpenrConfig.thrift:544) referenced by
     #: AreaConfig.import_policy / OriginatedPrefix.origination_policy;
@@ -257,6 +265,22 @@ class OpenrConfig:
             raise ValueError(
                 f"lsdb_wire_format must be one of {WIRE_FORMATS}, "
                 f"got {self.lsdb_wire_format!r}"
+            )
+        if self.lsdb_rpc_transport not in ("jsonrpc", "rocket"):
+            raise ValueError(
+                "lsdb_rpc_transport must be 'jsonrpc' or 'rocket', "
+                f"got {self.lsdb_rpc_transport!r}"
+            )
+        if (
+            self.lsdb_rpc_transport == "rocket"
+            and self.kvstore_config.enable_flood_optimization
+        ):
+            # DUAL PDUs have no RPC in the reference KvStoreService IDL;
+            # the rocket peer transport rejects them, so this combination
+            # would silently retry dead RPCs forever — fail fast instead
+            raise ValueError(
+                "enable_flood_optimization requires lsdb_rpc_transport "
+                "'jsonrpc' (DUAL PDUs have no fbthrift-rocket RPC)"
             )
         if self.persistent_store_path == "/tmp/openr_tpu_persistent_store.bin":
             # node-scope the default so co-hosted daemons never share a

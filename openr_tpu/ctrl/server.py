@@ -90,16 +90,9 @@ class OpenrCtrlServer:
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-        for t in list(self._conn_tasks):
-            t.cancel()
-        for t in list(self._conn_tasks):
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+        from openr_tpu.common.net import stop_stream_server
+
+        await stop_stream_server(self._server, self._conn_tasks)
 
     # -- per-connection ----------------------------------------------------
 

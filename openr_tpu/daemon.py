@@ -161,12 +161,19 @@ async def run_real_node(
     elif fib_mode == "remote":
         fib_agent = RemoteFibAgent(port=config.fib_config.fib_port)
 
+    rocket_mode = config.lsdb_rpc_transport == "rocket"
+    if rocket_mode:
+        from openr_tpu.kvstore.transport import RocketKvStoreTransport
+
+        kv_transport = RocketKvStoreTransport(tls=config.tls)
+    else:
+        kv_transport = TcpKvStoreTransport(tls=config.tls)
     clock = WallClock()
     node = OpenrNode(
         config=config,
         clock=clock,
         io_provider=UdpIoProvider(),
-        kv_transport=TcpKvStoreTransport(tls=config.tls),
+        kv_transport=kv_transport,
         fib_agent=fib_agent,
         netlink_events_queue=netlink_events_q,
         nl_neighbor_events_queue=nl_neighbor_q,
@@ -179,12 +186,28 @@ async def run_real_node(
     # explicit "::" would get IPV6_V6ONLY from asyncio and refuse v4):
     # remote peers' TcpKvStoreTransport dials this port for KvStore
     # full-sync/flooding, so loopback-only would break cross-host peering
+    rocket_server = None
+    if rocket_mode:
+        # the reference shape: fbthrift Rocket owns the ctrl port (peers
+        # and thrift clients dial it); the JSON-RPC operator listener
+        # (breeze default transport) moves one port up
+        from openr_tpu.interop.ctrl_rocket import RocketCtrlServer
+
+        rocket_server = RocketCtrlServer(
+            node, host=ctrl_host or "", port=ctrl_port, tls=config.tls
+        )
+        await rocket_server.start()
+        json_port = ctrl_port + 1
+    else:
+        json_port = ctrl_port
     server = OpenrCtrlServer(
-        node, host=ctrl_host or None, port=ctrl_port, tls=config.tls
+        node, host=ctrl_host or None, port=json_port, tls=config.tls
     )
     await server.start()
     print(f"{config.node_name}: ctrl on [{ctrl_host or '*'}]:{server.port} "
-          f"(fib={fib_mode}, tls={'on' if server.tls_active else 'off'})")
+          f"(fib={fib_mode}, tls={'on' if server.tls_active else 'off'}"
+          + (f", rocket on :{rocket_server.port}" if rocket_server else "")
+          + ")")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -193,6 +216,8 @@ async def run_real_node(
         except NotImplementedError:  # pragma: no cover - non-unix
             pass
     await stop.wait()
+    if rocket_server is not None:
+        await rocket_server.stop()
     await server.stop()
     await node.stop()
     nl.close()

@@ -1,7 +1,9 @@
 """fbthrift wire interop: Thrift Compact protocol codec + Open/R struct
 specs, so this framework can decode (and emit) the byte-level payloads a
 reference openr network floods — see openr_tpu/interop/compact.py and
-openr_wire.py."""
+openr_wire.py.  The RPC *transport* lives here too: RSocket 1.0 framing
+(rsocket.py), the fbthrift Rocket layer (rocket.py), and the ctrl
+method-name adapter + server (ctrl_rocket.py)."""
 
 from openr_tpu.interop.openr_wire import (  # noqa: F401
     decode_adjacency_database,

@@ -197,7 +197,9 @@ def _prefix_from_wire(d: Dict[str, Any]) -> str:
 # -- AdjacencyDatabase ------------------------------------------------------
 
 
-def encode_adjacency_database(db: T.AdjacencyDatabase) -> bytes:
+def adjacency_database_to_wire_obj(db: T.AdjacencyDatabase) -> Dict[str, Any]:
+    """Thrift-field-name dict form (the shape fed to ADJACENCY_DATABASE),
+    reusable where the struct nests inside an RPC envelope."""
     adjacencies = []
     for a in db.adjacencies:
         row: Dict[str, Any] = {
@@ -237,11 +239,14 @@ def encode_adjacency_database(db: T.AdjacencyDatabase) -> bytes:
                 )
             }
         }
-    return encode_struct(ADJACENCY_DATABASE, obj)
+    return obj
 
 
-def decode_adjacency_database(data: bytes) -> T.AdjacencyDatabase:
-    d = decode_struct(ADJACENCY_DATABASE, data)
+def encode_adjacency_database(db: T.AdjacencyDatabase) -> bytes:
+    return encode_struct(ADJACENCY_DATABASE, adjacency_database_to_wire_obj(db))
+
+
+def adjacency_database_from_wire_obj(d: Dict[str, Any]) -> T.AdjacencyDatabase:
     adjacencies = []
     for row in d.get("adjacencies", []):
         v6, _ = _addr_from_wire(row.get("nextHopV6"))
@@ -283,6 +288,12 @@ def decode_adjacency_database(data: bytes) -> T.AdjacencyDatabase:
         area=d.get("area", "0"),
         node_metric_increment_val=d.get("nodeMetricIncrementVal", 0),
         link_status_records=lsr,
+    )
+
+
+def decode_adjacency_database(data: bytes) -> T.AdjacencyDatabase:
+    return adjacency_database_from_wire_obj(
+        decode_struct(ADJACENCY_DATABASE, data)
     )
 
 
@@ -418,20 +429,24 @@ def decode_value(data: bytes) -> T.Value:
     return _value_from_wire(decode_struct(VALUE, data))
 
 
-def encode_publication(pub: T.Publication) -> bytes:
+def value_to_wire_obj(v: T.Value) -> Dict[str, Any]:
+    row: Dict[str, Any] = {
+        "version": v.version,
+        "originatorId": v.originator_id,
+        "ttl": v.ttl,
+        "ttlVersion": v.ttl_version,
+    }
+    if v.value is not None:
+        row["value"] = v.value
+    if v.hash is not None:
+        row["hash"] = v.hash
+    return row
+
+
+def publication_to_wire_obj(pub: T.Publication) -> Dict[str, Any]:
     key_vals = {}
     for k, v in pub.key_vals.items():
-        row: Dict[str, Any] = {
-            "version": v.version,
-            "originatorId": v.originator_id,
-            "ttl": v.ttl,
-            "ttlVersion": v.ttl_version,
-        }
-        if v.value is not None:
-            row["value"] = v.value
-        if v.hash is not None:
-            row["hash"] = v.hash
-        key_vals[k] = row
+        key_vals[k] = value_to_wire_obj(v)
     obj: Dict[str, Any] = {
         "keyVals": key_vals,
         "expiredKeys": list(pub.expired_keys),
@@ -443,11 +458,14 @@ def encode_publication(pub: T.Publication) -> bytes:
         obj["tobeUpdatedKeys"] = list(pub.tobe_updated_keys)
     if pub.timestamp_ms is not None:
         obj["timestamp_ms"] = pub.timestamp_ms
-    return encode_struct(PUBLICATION, obj)
+    return obj
 
 
-def decode_publication(data: bytes) -> T.Publication:
-    d = decode_struct(PUBLICATION, data)
+def encode_publication(pub: T.Publication) -> bytes:
+    return encode_struct(PUBLICATION, publication_to_wire_obj(pub))
+
+
+def publication_from_wire_obj(d: Dict[str, Any]) -> T.Publication:
     return T.Publication(
         key_vals={
             k: _value_from_wire(v) for k, v in d.get("keyVals", {}).items()
@@ -458,6 +476,10 @@ def decode_publication(data: bytes) -> T.Publication:
         area=d.get("area", "0"),
         timestamp_ms=d.get("timestamp_ms"),
     )
+
+
+def decode_publication(data: bytes) -> T.Publication:
+    return publication_from_wire_obj(decode_struct(PUBLICATION, data))
 
 
 # -- RouteDatabase ----------------------------------------------------------
@@ -506,7 +528,7 @@ def _nexthop_from_wire(row: Dict[str, Any]) -> T.NextHop:
     )
 
 
-def encode_route_database(db: T.RouteDatabase) -> bytes:
+def route_database_to_wire_obj(db: T.RouteDatabase) -> Dict[str, Any]:
     obj: Dict[str, Any] = {
         "thisNodeName": db.this_node_name,
         "unicastRoutes": [
@@ -526,11 +548,14 @@ def encode_route_database(db: T.RouteDatabase) -> bytes:
     }
     if db.perf_events is not None:
         obj["perfEvents"] = _perf_to_wire(db.perf_events)
-    return encode_struct(ROUTE_DATABASE, obj)
+    return obj
 
 
-def decode_route_database(data: bytes) -> T.RouteDatabase:
-    d = decode_struct(ROUTE_DATABASE, data)
+def encode_route_database(db: T.RouteDatabase) -> bytes:
+    return encode_struct(ROUTE_DATABASE, route_database_to_wire_obj(db))
+
+
+def route_database_from_wire_obj(d: Dict[str, Any]) -> T.RouteDatabase:
     return T.RouteDatabase(
         this_node_name=d.get("thisNodeName", ""),
         unicast_routes=[
@@ -553,3 +578,7 @@ def decode_route_database(data: bytes) -> T.RouteDatabase:
         ],
         perf_events=_perf_from_wire(d.get("perfEvents")),
     )
+
+
+def decode_route_database(data: bytes) -> T.RouteDatabase:
+    return route_database_from_wire_obj(decode_struct(ROUTE_DATABASE, data))

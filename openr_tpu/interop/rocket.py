@@ -1,0 +1,521 @@
+"""fbthrift Rocket transport: thrift RPC over RSocket frames.
+
+This is the transport the reference speaks everywhere
+(`/root/reference/openr/Main.cpp:399-416` ThriftServer,
+`/root/reference/openr/kvstore/KvStore.h:460-466` peer clients): each
+thrift call becomes one RSocket REQUEST_RESPONSE frame whose *metadata*
+is a Compact-serialized ``RequestRpcMetadata`` (method name, protocol,
+rpc kind) and whose *data* is the Compact-serialized argument struct;
+the response is a PAYLOAD frame (NEXT|COMPLETE) carrying a
+``ResponseRpcMetadata`` plus the Compact-serialized result struct
+(field 0 = success, declared-exception fields as in the IDL).
+
+Sources: the public fbthrift rocket protocol spec
+(thrift/doc/specs/fbthrift-rocket-protocol.md) and the public
+``thrift/lib/thrift/RpcMetadata.thrift`` field numbering.  Connection
+establishment: a SETUP frame on stream 0 whose metadata is the 32-bit
+big-endian ``kRocketProtocolKey`` (= 1) followed by a Compact
+``RequestSetupMetadata``; client streams are odd ids starting at 1.
+Golden byte vectors for all of this are pinned in
+``tests/test_rocket.py`` so any framing regression is caught at the
+byte level, the same way ``tests/test_thrift_interop.py`` pins structs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from openr_tpu.interop import rsocket as rs
+from openr_tpu.interop.compact import decode_struct, encode_struct
+
+LOG = logging.getLogger(__name__)
+
+#: fbthrift's magic distinguishing its rocket dialect in SETUP metadata
+ROCKET_PROTOCOL_KEY = 1
+
+#: ProtocolId (RpcMetadata.thrift): serialization of args/result structs
+PROTOCOL_BINARY = 0
+PROTOCOL_COMPACT = 2
+
+#: RpcKind (RpcMetadata.thrift)
+RPC_SINGLE_REQUEST_SINGLE_RESPONSE = 0
+RPC_SINGLE_REQUEST_NO_RESPONSE = 1
+RPC_SINGLE_REQUEST_STREAMING_RESPONSE = 4
+
+#: mime types carried in SETUP; fbthrift sets these but dispatches on the
+#: protocol key in the metadata, so they are informational
+MIME = "text/plain"
+
+KEEPALIVE_MS = 30_000
+MAX_LIFETIME_MS = 3_600_000
+
+# -- RpcMetadata.thrift struct specs (public field numbering) --------------
+
+REQUEST_SETUP_METADATA = (
+    (1, "opaque", "map", (("string", None), ("binary", None))),
+    (2, "minVersion", "i32", None),
+    (3, "maxVersion", "i32", None),
+    (4, "dscpToReflect", "i32", None),
+    (5, "markToReflect", "i32", None),
+)
+
+REQUEST_RPC_METADATA = (
+    (1, "protocol", "i32", None),
+    (2, "name", "string", None),
+    (3, "kind", "i32", None),
+    (5, "clientTimeoutMs", "i32", None),
+    (6, "queueTimeoutMs", "i32", None),
+    (7, "priority", "i32", None),
+    (8, "otherMetadata", "map", (("string", None), ("string", None))),
+)
+
+#: PayloadResponseMetadata is an empty struct
+PAYLOAD_RESPONSE_METADATA: tuple = ()
+
+#: PayloadExceptionMetadata union — only the variants we emit/understand
+PAYLOAD_EXCEPTION_METADATA = (
+    (1, "declaredException", "struct", ()),
+    (5, "appUnknownException", "struct", ()),
+)
+
+PAYLOAD_EXCEPTION_METADATA_BASE = (
+    (1, "name_utf8", "string", None),
+    (2, "what_utf8", "string", None),
+    (3, "metadata", "struct", PAYLOAD_EXCEPTION_METADATA),
+)
+
+#: PayloadMetadata union
+PAYLOAD_METADATA = (
+    (1, "responseMetadata", "struct", PAYLOAD_RESPONSE_METADATA),
+    (2, "exceptionMetadata", "struct", PAYLOAD_EXCEPTION_METADATA_BASE),
+)
+
+RESPONSE_RPC_METADATA = (
+    (1, "load", "i64", None),
+    (2, "otherMetadata", "map", (("string", None), ("string", None))),
+    (3, "payloadMetadata", "struct", PAYLOAD_METADATA),
+)
+
+
+def encode_setup_metadata(setup: Optional[Dict[str, Any]] = None) -> bytes:
+    """SETUP metadata: u32 kRocketProtocolKey | Compact RequestSetupMetadata."""
+    body = encode_struct(
+        REQUEST_SETUP_METADATA,
+        setup if setup is not None else {"minVersion": 0, "maxVersion": 0},
+    )
+    return ROCKET_PROTOCOL_KEY.to_bytes(4, "big") + body
+
+
+def decode_setup_metadata(md: bytes) -> Dict[str, Any]:
+    if len(md) < 4 or int.from_bytes(md[:4], "big") != ROCKET_PROTOCOL_KEY:
+        raise ValueError("SETUP metadata does not carry kRocketProtocolKey")
+    return decode_struct(REQUEST_SETUP_METADATA, md[4:])
+
+
+def encode_request_metadata(
+    name: str,
+    kind: int = RPC_SINGLE_REQUEST_SINGLE_RESPONSE,
+    *,
+    protocol: int = PROTOCOL_COMPACT,
+    client_timeout_ms: Optional[int] = None,
+    other: Optional[Dict[str, str]] = None,
+) -> bytes:
+    obj: Dict[str, Any] = {"protocol": protocol, "name": name, "kind": kind}
+    if client_timeout_ms is not None:
+        obj["clientTimeoutMs"] = client_timeout_ms
+    if other:
+        obj["otherMetadata"] = other
+    return encode_struct(REQUEST_RPC_METADATA, obj)
+
+
+def encode_response_metadata(
+    *,
+    exception: Optional[Tuple[str, str, bool]] = None,
+    other: Optional[Dict[str, str]] = None,
+) -> bytes:
+    """``exception`` = (thrift type name, message, declared?)."""
+    obj: Dict[str, Any] = {}
+    if other:
+        obj["otherMetadata"] = other
+    if exception is None:
+        obj["payloadMetadata"] = {"responseMetadata": {}}
+    else:
+        name, what, declared = exception
+        obj["payloadMetadata"] = {
+            "exceptionMetadata": {
+                "name_utf8": name,
+                "what_utf8": what,
+                "metadata": (
+                    {"declaredException": {}}
+                    if declared
+                    else {"appUnknownException": {}}
+                ),
+            }
+        }
+    return encode_struct(RESPONSE_RPC_METADATA, obj)
+
+
+class RocketError(RuntimeError):
+    """Transport- or application-level rocket failure."""
+
+    def __init__(self, message: str, *, code: int = 0, name: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.name = name  # thrift exception type for declared exceptions
+
+
+@dataclass
+class RocketResponse:
+    metadata: Dict[str, Any]
+    data: bytes
+
+    @property
+    def exception(self) -> Optional[Dict[str, Any]]:
+        pm = self.metadata.get("payloadMetadata") or {}
+        return pm.get("exceptionMetadata")
+
+
+class RocketClient:
+    """Minimal fbthrift-rocket client: SETUP + multiplexed
+    request-response (+ fire-and-forget), with keepalive echo."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        ssl=None,
+        setup: Optional[dict] = None,
+        keepalive_ms: int = KEEPALIVE_MS,
+    ):
+        self.host = host
+        self.port = port
+        self._ssl = ssl
+        self._setup = setup
+        self._keepalive_ms = keepalive_ms
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1, 2)  # client streams are odd
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._pump_task: Optional[asyncio.Task] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._closed = False
+        #: terminal failure: once set, every further call fails fast
+        #: instead of parking a future nothing can resolve (a peer that
+        #: closed while we were idle must not cost the next RPC a 30 s
+        #: timeout before the transport redials)
+        self._dead: Optional[Exception] = None
+
+    async def connect(self) -> "RocketClient":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self._ssl
+        )
+        self._writer.write(
+            rs.frame_stream(
+                rs.encode_setup(
+                    keepalive_ms=self._keepalive_ms,
+                    max_lifetime_ms=MAX_LIFETIME_MS,
+                    metadata_mime=MIME,
+                    data_mime=MIME,
+                    metadata=encode_setup_metadata(self._setup),
+                )
+            )
+        )
+        await self._writer.drain()
+        self._pump_task = asyncio.create_task(self._pump())
+        # RSocket 1.0 obliges the client to emit KEEPALIVE at the
+        # interval it declared in SETUP; a spec-compliant responder may
+        # drop a silent connection after max_lifetime
+        self._keepalive_task = asyncio.create_task(self._keepalive_loop())
+        return self
+
+    async def close(self) -> None:
+        self._closed = True
+        for task in (self._pump_task, self._keepalive_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        self._fail_pending(RocketError("rocket connection closed"))
+
+    async def __aenter__(self) -> "RocketClient":
+        return await self.connect()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    def _fail_pending(self, err: Exception) -> None:
+        self._dead = err
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+
+    async def _keepalive_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self._keepalive_ms / 1000.0)
+                self._writer.write(
+                    rs.frame_stream(rs.encode_keepalive(0, respond=True))
+                )
+                await self._writer.drain()
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionError, OSError) as e:
+            self._fail_pending(RocketError(f"rocket keepalive failed: {e}"))
+
+    async def _pump(self) -> None:
+        try:
+            while True:
+                frame = await rs.read_stream_frame(self._reader)
+                if frame is None:
+                    self._fail_pending(RocketError("rocket peer closed"))
+                    return
+                if frame.ftype == rs.FT_KEEPALIVE:
+                    if frame.flags & rs.FLAG_RESPOND:
+                        self._writer.write(
+                            rs.frame_stream(
+                                rs.encode_keepalive(
+                                    frame.last_position, respond=False
+                                )
+                            )
+                        )
+                    continue
+                if frame.ftype == rs.FT_ERROR and frame.stream_id == 0:
+                    self._fail_pending(
+                        RocketError(
+                            frame.error_message, code=frame.error_code
+                        )
+                    )
+                    return
+                fut = self._pending.pop(frame.stream_id, None)
+                if fut is None or fut.done():
+                    continue
+                if frame.ftype == rs.FT_PAYLOAD:
+                    fut.set_result(frame)
+                elif frame.ftype == rs.FT_ERROR:
+                    fut.set_exception(
+                        RocketError(
+                            frame.error_message, code=frame.error_code
+                        )
+                    )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — fail callers, not the loop
+            self._fail_pending(RocketError(f"rocket pump failed: {e}"))
+
+    async def request_response(
+        self,
+        name: str,
+        data: bytes,
+        *,
+        timeout_s: float = 30.0,
+        other_metadata: Optional[Dict[str, str]] = None,
+    ) -> RocketResponse:
+        """One thrift call: returns the decoded ResponseRpcMetadata and
+        the raw result-struct bytes; raises RocketError on transport or
+        app-unknown errors (declared exceptions are returned — the
+        caller holds the result spec needed to decode them)."""
+        if self._dead is not None:
+            raise RocketError(f"rocket connection dead: {self._dead}")
+        sid = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[sid] = fut
+        md = encode_request_metadata(
+            name,
+            RPC_SINGLE_REQUEST_SINGLE_RESPONSE,
+            client_timeout_ms=int(timeout_s * 1000),
+            other=other_metadata,
+        )
+        self._writer.write(
+            rs.frame_stream(rs.encode_request_response(sid, md, data))
+        )
+        await self._writer.drain()
+        try:
+            frame: rs.Frame = await asyncio.wait_for(fut, timeout_s)
+        finally:
+            self._pending.pop(sid, None)
+        rmeta = (
+            decode_struct(RESPONSE_RPC_METADATA, frame.metadata)
+            if frame.metadata
+            else {}
+        )
+        return RocketResponse(metadata=rmeta, data=frame.data)
+
+    async def fire_and_forget(self, name: str, data: bytes) -> None:
+        if self._dead is not None:
+            raise RocketError(f"rocket connection dead: {self._dead}")
+        sid = next(self._ids)
+        md = encode_request_metadata(name, RPC_SINGLE_REQUEST_NO_RESPONSE)
+        self._writer.write(
+            rs.frame_stream(rs.encode_request_fnf(sid, md, data))
+        )
+        await self._writer.drain()
+
+
+#: server dispatch: async (method name, args bytes, peer) -> (response
+#: metadata bytes, result bytes) — the ctrl adapter builds both so the
+#: transport stays IDL-agnostic
+RocketDispatch = Callable[
+    [str, bytes, object], Awaitable[Tuple[bytes, bytes]]
+]
+
+
+class RocketServer:
+    """Serves fbthrift-rocket request-response on a TCP port.
+
+    Validates the fbthrift SETUP handshake (protocol key), echoes
+    KEEPALIVEs, runs each request concurrently, and maps dispatch
+    failures to RSocket ERROR frames.  Streams (REQUEST_STREAM) get a
+    REJECTED error — the reference CLI only needs request-response for
+    the adapted method surface."""
+
+    def __init__(
+        self,
+        dispatch: RocketDispatch,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        ssl=None,
+    ):
+        self.dispatch = dispatch
+        self.host = host
+        self.port = port
+        self._ssl = ssl
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conn_tasks: set = set()
+
+    async def start(self) -> "RocketServer":
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port, ssl=self._ssl
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        from openr_tpu.common.net import stop_stream_server
+
+        await stop_stream_server(self._server, self._conn_tasks)
+
+    async def _on_connection(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        inflight: set = set()
+        write_lock = asyncio.Lock()
+
+        async def send(frame: bytes) -> None:
+            async with write_lock:
+                writer.write(rs.frame_stream(frame))
+                await writer.drain()
+
+        try:
+            # handshake: first frame must be a valid fbthrift SETUP
+            first = await rs.read_stream_frame(reader)
+            if first is None:
+                return
+            if first.ftype != rs.FT_SETUP:
+                await send(
+                    rs.encode_error(
+                        0, rs.ERR_INVALID_SETUP, "expected SETUP frame"
+                    )
+                )
+                return
+            try:
+                decode_setup_metadata(first.metadata or b"")
+            except ValueError as e:
+                await send(rs.encode_error(0, rs.ERR_INVALID_SETUP, str(e)))
+                return
+            while True:
+                frame = await rs.read_stream_frame(reader)
+                if frame is None:
+                    return
+                if frame.ftype == rs.FT_KEEPALIVE:
+                    if frame.flags & rs.FLAG_RESPOND:
+                        await send(
+                            rs.encode_keepalive(
+                                frame.last_position, respond=False
+                            )
+                        )
+                elif frame.ftype in (
+                    rs.FT_REQUEST_RESPONSE,
+                    rs.FT_REQUEST_FNF,
+                ):
+                    t = asyncio.create_task(
+                        self._serve_request(frame, send, writer)
+                    )
+                    inflight.add(t)
+                    t.add_done_callback(inflight.discard)
+                elif frame.ftype == rs.FT_REQUEST_STREAM:
+                    await send(
+                        rs.encode_error(
+                            frame.stream_id,
+                            rs.ERR_REJECTED,
+                            "streams not supported on this endpoint",
+                        )
+                    )
+                elif frame.ftype == rs.FT_CANCEL:
+                    for t in inflight:
+                        if getattr(t, "rocket_sid", None) == frame.stream_id:
+                            t.cancel()
+                # METADATA_PUSH / others: ignorable per spec
+        except ValueError as e:
+            try:
+                await send(rs.encode_error(0, rs.ERR_CONNECTION_ERROR, str(e)))
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            for t in list(inflight):
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self._conn_tasks.discard(task)
+
+    async def _serve_request(self, frame: rs.Frame, send, writer) -> None:
+        asyncio.current_task().rocket_sid = frame.stream_id  # type: ignore[attr-defined]
+        try:
+            if not frame.metadata:
+                raise ValueError("request carries no RequestRpcMetadata")
+            req = decode_struct(REQUEST_RPC_METADATA, frame.metadata)
+            name = req.get("name") or ""
+            peer = writer.get_extra_info("peername")
+            rmeta, result = await self.dispatch(name, frame.data, peer)
+            if frame.ftype == rs.FT_REQUEST_RESPONSE:
+                await send(
+                    rs.encode_payload(
+                        frame.stream_id,
+                        rmeta,
+                        result,
+                        complete=True,
+                        next_=True,
+                    )
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — surface as rsocket error
+            LOG.warning("rocket request failed: %s", e)
+            if frame.ftype == rs.FT_REQUEST_RESPONSE:
+                try:
+                    await send(
+                        rs.encode_error(
+                            frame.stream_id,
+                            rs.ERR_APPLICATION_ERROR,
+                            str(e),
+                        )
+                    )
+                except (ConnectionError, OSError):
+                    pass

@@ -1,0 +1,318 @@
+"""RSocket 1.0 frame codec — the framing layer under fbthrift Rocket.
+
+The reference's entire RPC plane is fbthrift's "Rocket" transport: the
+ctrl server (`/root/reference/openr/Main.cpp:399-416`), every KvStore
+peer session (`/root/reference/openr/kvstore/KvStore.h:460-466`) and the
+py3 CLI client (`/root/reference/openr/py/openr/clients/openr_client.py`)
+all speak thrift RPCs over RSocket frames on TCP.  This module
+implements the RSocket 1.0 wire format from the public protocol spec
+(rsocket.io/about/protocol) — frame types, flag bits and section
+layouts follow that document; the fbthrift-specific payload contents
+live one layer up in `openr_tpu.interop.rocket`.
+
+Layout notes (all integers big-endian):
+
+  stream frame  := u24 length | frame
+  frame         := u32 stream_id | u16 (type << 10 | flags) | body
+  payload       := [u24 metadata-length | metadata] data      (M flag)
+
+Fragmentation (FOLLOWS flag) is not emitted and not reassembled: every
+thrift struct this framework exchanges is far below the default 16 MiB
+fragment threshold; a FOLLOWS frame raises so truncation can never be
+silent.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional
+
+# -- frame types (RSocket 1.0 §5.4) ---------------------------------------
+FT_SETUP = 0x01
+FT_LEASE = 0x02
+FT_KEEPALIVE = 0x03
+FT_REQUEST_RESPONSE = 0x04
+FT_REQUEST_FNF = 0x05
+FT_REQUEST_STREAM = 0x06
+FT_REQUEST_CHANNEL = 0x07
+FT_REQUEST_N = 0x08
+FT_CANCEL = 0x09
+FT_PAYLOAD = 0x0A
+FT_ERROR = 0x0B
+FT_METADATA_PUSH = 0x0C
+FT_RESUME = 0x0D
+FT_RESUME_OK = 0x0E
+FT_EXT = 0x3F
+
+#: flag bits within the 10-bit flags field.  IGNORE/METADATA are common;
+#: the rest are per-type and share bit positions.
+FLAG_IGNORE = 0x200
+FLAG_METADATA = 0x100
+FLAG_RESUME = 0x080  # SETUP
+FLAG_LEASE = 0x040  # SETUP
+FLAG_RESPOND = 0x080  # KEEPALIVE
+FLAG_FOLLOWS = 0x080  # REQUEST_*, PAYLOAD
+FLAG_COMPLETE = 0x040  # PAYLOAD, REQUEST_CHANNEL
+FLAG_NEXT = 0x020  # PAYLOAD
+
+# -- error codes (RSocket 1.0 §5.9) ---------------------------------------
+ERR_INVALID_SETUP = 0x00000001
+ERR_UNSUPPORTED_SETUP = 0x00000002
+ERR_REJECTED_SETUP = 0x00000003
+ERR_CONNECTION_ERROR = 0x00000101
+ERR_APPLICATION_ERROR = 0x00000201
+ERR_REJECTED = 0x00000202
+ERR_CANCELED = 0x00000203
+ERR_INVALID = 0x00000204
+
+MAX_FRAME = 16 * 1024 * 1024
+
+
+@dataclass
+class Frame:
+    """One decoded RSocket frame.  Fields beyond (stream_id, ftype,
+    flags, metadata, data) are type-specific and default-zero."""
+
+    stream_id: int
+    ftype: int
+    flags: int
+    metadata: Optional[bytes] = None
+    data: bytes = b""
+    # SETUP
+    major: int = 0
+    minor: int = 0
+    keepalive_ms: int = 0
+    max_lifetime_ms: int = 0
+    metadata_mime: str = ""
+    data_mime: str = ""
+    # KEEPALIVE
+    last_position: int = 0
+    # REQUEST_STREAM / REQUEST_CHANNEL / REQUEST_N
+    initial_n: int = 0
+    # ERROR
+    error_code: int = 0
+
+    @property
+    def error_message(self) -> str:
+        return self.data.decode("utf-8", "replace")
+
+
+def _header(stream_id: int, ftype: int, flags: int) -> bytes:
+    return struct.pack(">IH", stream_id, (ftype << 10) | (flags & 0x3FF))
+
+
+def _payload_sections(
+    flags: int, metadata: Optional[bytes], data: bytes
+) -> tuple:
+    """-> (flags', bytes): add METADATA flag + u24 length when present."""
+    if metadata is None:
+        return flags, data
+    if len(metadata) >= 1 << 24:
+        raise ValueError("rsocket metadata exceeds u24 length")
+    return (
+        flags | FLAG_METADATA,
+        len(metadata).to_bytes(3, "big") + metadata + data,
+    )
+
+
+def encode_setup(
+    *,
+    keepalive_ms: int,
+    max_lifetime_ms: int,
+    metadata_mime: str,
+    data_mime: str,
+    metadata: Optional[bytes] = None,
+    data: bytes = b"",
+    major: int = 1,
+    minor: int = 0,
+) -> bytes:
+    """SETUP (§5.4.1), always stream 0.  Resume/lease unsupported."""
+    flags, payload = _payload_sections(0, metadata, data)
+    mm = metadata_mime.encode("ascii")
+    dm = data_mime.encode("ascii")
+    return (
+        _header(0, FT_SETUP, flags)
+        + struct.pack(">HHII", major, minor, keepalive_ms, max_lifetime_ms)
+        + bytes([len(mm)])
+        + mm
+        + bytes([len(dm)])
+        + dm
+        + payload
+    )
+
+
+def encode_keepalive(last_position: int = 0, *, respond: bool, data: bytes = b"") -> bytes:
+    flags = FLAG_RESPOND if respond else 0
+    return (
+        _header(0, FT_KEEPALIVE, flags)
+        + struct.pack(">Q", last_position)
+        + data
+    )
+
+
+def encode_request_response(
+    stream_id: int, metadata: Optional[bytes], data: bytes
+) -> bytes:
+    flags, payload = _payload_sections(0, metadata, data)
+    return _header(stream_id, FT_REQUEST_RESPONSE, flags) + payload
+
+
+def encode_request_fnf(
+    stream_id: int, metadata: Optional[bytes], data: bytes
+) -> bytes:
+    flags, payload = _payload_sections(0, metadata, data)
+    return _header(stream_id, FT_REQUEST_FNF, flags) + payload
+
+
+def encode_request_stream(
+    stream_id: int, initial_n: int, metadata: Optional[bytes], data: bytes
+) -> bytes:
+    flags, payload = _payload_sections(0, metadata, data)
+    return (
+        _header(stream_id, FT_REQUEST_STREAM, flags)
+        + struct.pack(">I", initial_n)
+        + payload
+    )
+
+
+def encode_request_n(stream_id: int, n: int) -> bytes:
+    return _header(stream_id, FT_REQUEST_N, 0) + struct.pack(">I", n)
+
+
+def encode_cancel(stream_id: int) -> bytes:
+    return _header(stream_id, FT_CANCEL, 0)
+
+
+def encode_payload(
+    stream_id: int,
+    metadata: Optional[bytes],
+    data: bytes,
+    *,
+    complete: bool = False,
+    next_: bool = True,
+) -> bytes:
+    flags = (FLAG_COMPLETE if complete else 0) | (FLAG_NEXT if next_ else 0)
+    flags, payload = _payload_sections(flags, metadata, data)
+    return _header(stream_id, FT_PAYLOAD, flags) + payload
+
+
+def encode_error(stream_id: int, code: int, message: str = "") -> bytes:
+    return (
+        _header(stream_id, FT_ERROR, 0)
+        + struct.pack(">I", code)
+        + message.encode("utf-8")
+    )
+
+
+def _split_payload(flags: int, body: bytes) -> tuple:
+    """-> (metadata | None, data) per the M flag."""
+    if not flags & FLAG_METADATA:
+        return None, body
+    if len(body) < 3:
+        raise ValueError("truncated rsocket metadata length")
+    mlen = int.from_bytes(body[:3], "big")
+    if 3 + mlen > len(body):
+        raise ValueError("truncated rsocket metadata")
+    return body[3 : 3 + mlen], body[3 + mlen :]
+
+
+def decode_frame(raw: bytes) -> Frame:
+    """Decode one frame (without the u24 stream-length prefix).
+
+    All malformed input — truncated bodies included — raises ValueError
+    so connection handlers need exactly one except clause."""
+    try:
+        return _decode_frame(raw)
+    except (struct.error, IndexError) as e:
+        raise ValueError(f"truncated rsocket frame body: {e}") from e
+
+
+def _decode_frame(raw: bytes) -> Frame:
+    if len(raw) < 6:
+        raise ValueError("rsocket frame shorter than header")
+    stream_id, tf = struct.unpack(">IH", raw[:6])
+    if stream_id & 0x80000000:
+        raise ValueError("rsocket stream id has reserved high bit set")
+    ftype = tf >> 10
+    flags = tf & 0x3FF
+    body = raw[6:]
+    f = Frame(stream_id=stream_id, ftype=ftype, flags=flags)
+    if flags & FLAG_FOLLOWS and ftype in (
+        FT_REQUEST_RESPONSE,
+        FT_REQUEST_FNF,
+        FT_REQUEST_STREAM,
+        FT_REQUEST_CHANNEL,
+        FT_PAYLOAD,
+    ):
+        raise ValueError(
+            "rsocket fragmentation (FOLLOWS) not supported; frame exceeds "
+            "peer's fragment threshold"
+        )
+    if ftype == FT_SETUP:
+        if len(body) < 14:
+            raise ValueError("truncated SETUP frame")
+        f.major, f.minor, f.keepalive_ms, f.max_lifetime_ms = struct.unpack(
+            ">HHII", body[:12]
+        )
+        pos = 12
+        if flags & FLAG_RESUME:
+            tlen = int.from_bytes(body[pos : pos + 2], "big")
+            pos += 2 + tlen  # token ignored (resume unsupported)
+        mlen = body[pos]
+        f.metadata_mime = body[pos + 1 : pos + 1 + mlen].decode("ascii")
+        pos += 1 + mlen
+        dlen = body[pos]
+        f.data_mime = body[pos + 1 : pos + 1 + dlen].decode("ascii")
+        pos += 1 + dlen
+        f.metadata, f.data = _split_payload(flags, body[pos:])
+    elif ftype == FT_KEEPALIVE:
+        (f.last_position,) = struct.unpack(">Q", body[:8])
+        f.data = body[8:]
+    elif ftype in (FT_REQUEST_STREAM, FT_REQUEST_CHANNEL):
+        (f.initial_n,) = struct.unpack(">I", body[:4])
+        f.metadata, f.data = _split_payload(flags, body[4:])
+    elif ftype == FT_REQUEST_N:
+        (f.initial_n,) = struct.unpack(">I", body[:4])
+    elif ftype == FT_ERROR:
+        (f.error_code,) = struct.unpack(">I", body[:4])
+        f.data = body[4:]
+    elif ftype in (
+        FT_REQUEST_RESPONSE,
+        FT_REQUEST_FNF,
+        FT_PAYLOAD,
+        FT_METADATA_PUSH,
+        FT_CANCEL,
+    ):
+        f.metadata, f.data = _split_payload(flags, body)
+    else:
+        # LEASE/RESUME/EXT…: not used by fbthrift request-response; keep
+        # the raw body so callers can IGNORE-skip per the spec
+        f.data = body
+    return f
+
+
+# -- stream framing (u24 length prefix, RSocket over TCP §4) ---------------
+
+
+def frame_stream(frame: bytes) -> bytes:
+    """Prefix one frame with its u24 length for a byte-stream transport."""
+    if len(frame) > MAX_FRAME:
+        raise ValueError(f"rsocket frame too large: {len(frame)}")
+    return len(frame).to_bytes(3, "big") + frame
+
+
+async def read_stream_frame(reader) -> Optional[Frame]:
+    """Read one length-prefixed frame from an asyncio StreamReader; None
+    on clean EOF / connection drop."""
+    import asyncio
+
+    try:
+        head = await reader.readexactly(3)
+        length = int.from_bytes(head, "big")
+        if length > MAX_FRAME:
+            raise ValueError(f"rsocket frame too large: {length}")
+        raw = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    return decode_frame(raw)

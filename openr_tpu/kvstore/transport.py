@@ -206,8 +206,6 @@ class TcpKvStoreTransport(KvStoreTransport):
     async def _client(self, peer_node: str):
         import asyncio
 
-        from openr_tpu.ctrl.client import OpenrCtrlClient
-
         client = self._clients.get(peer_node)
         if client is not None:
             return client
@@ -220,15 +218,18 @@ class TcpKvStoreTransport(KvStoreTransport):
             if target is None:
                 raise KvStoreTransportError(f"no PeerSpec for {peer_node}")
             try:
-                client = await OpenrCtrlClient(
-                    host=target[0], port=target[1], tls=self.tls
-                ).connect()
+                client = await self._dial(target[0], target[1])
             except OSError as e:
                 raise KvStoreTransportError(
                     f"connect to {peer_node} {target} failed: {e}"
                 ) from e
             self._clients[peer_node] = client
             return client
+
+    async def _dial(self, host: str, port: int):
+        from openr_tpu.ctrl.client import OpenrCtrlClient
+
+        return await OpenrCtrlClient(host=host, port=port, tls=self.tls).connect()
 
     async def _call(self, peer_node: str, method: str, **params):
         client = await self._client(peer_node)
@@ -285,4 +286,110 @@ class TcpKvStoreTransport(KvStoreTransport):
             child=child,
             set_child=set_child,
             sender_id=sender_id,
+        )
+
+
+class RocketKvStoreTransport(TcpKvStoreTransport):
+    """Peer transport speaking the REFERENCE's wire protocol: fbthrift
+    Rocket framing + Compact-serialized thrift structs.
+
+    This is byte-for-byte the RPC shape a real openr node's KvStore
+    expects from a peer (`KvStore.h:460-466`: thrift clients issuing
+    getKvStoreKeyValsFilteredArea / setKvStoreKeyVals) — full sync sends
+    hash digests in KeyDumpParams.keyValHashes, flood/finalize pushes
+    KeySetParams.  Peers must serve a RocketCtrlServer on their ctrl
+    port (`lsdb_rpc_transport: "rocket"`).
+
+    DUAL flood-optimization PDUs have no RPC in the reference's
+    KvStoreService IDL (the library is legacy there — SURVEY §2.1), so
+    this transport rejects them; run the jsonrpc transport if DUAL
+    flood trees are enabled.
+    """
+
+    async def _dial(self, host: str, port: int):
+        from openr_tpu.common.tls import client_ssl_context
+        from openr_tpu.interop.rocket import RocketClient
+
+        return await RocketClient(
+            host, port, ssl=client_ssl_context(self.tls)
+        ).connect()
+
+    async def _call_rocket(self, peer_node: str, method: str, args: dict):
+        from openr_tpu.interop.ctrl_rocket import DeclaredError, rocket_call
+        from openr_tpu.interop.rocket import RocketError
+
+        client = await self._client(peer_node)
+        try:
+            return await rocket_call(client, method, args)
+        except DeclaredError as e:
+            # server-side declared exception: the connection is healthy
+            raise KvStoreTransportError(
+                f"rpc {method} to {peer_node} failed: {e}"
+            ) from e
+        except (OSError, RocketError, TimeoutError, ValueError) as e:
+            # ValueError = malformed/incompatible response bytes (codec);
+            # it must stay inside the KvStoreTransport error contract or
+            # the sync task dies and the peer sticks in SYNCING forever
+            self._drop_client(peer_node)
+            raise KvStoreTransportError(
+                f"rpc {method} to {peer_node} failed: {e}"
+            ) from e
+
+    # -- KvStoreTransport surface ------------------------------------------
+
+    async def get_key_vals_filtered_area(
+        self, peer_node, area, key_val_hashes, sender_id
+    ) -> Publication:
+        from openr_tpu.interop.openr_wire import publication_from_wire_obj
+
+        hashes = {
+            k: {
+                "version": v[0],
+                "originatorId": v[1],
+                **({"hash": v[2]} if v[2] is not None else {}),
+            }
+            for k, v in key_val_hashes.items()
+        }
+        wire = await self._call_rocket(
+            peer_node,
+            "getKvStoreKeyValsFilteredArea",
+            {
+                "filter": {"keyValHashes": hashes, "senderId": sender_id},
+                "area": area,
+            },
+        )
+        return publication_from_wire_obj(wire or {})
+
+    async def set_key_vals(self, peer_node, area, publication, sender_id) -> None:
+        from openr_tpu.interop.openr_wire import publication_to_wire_obj
+
+        pub = publication_to_wire_obj(publication)
+        set_params: dict = {
+            "keyVals": pub.get("keyVals") or {},
+            "senderId": sender_id,
+        }
+        if pub.get("nodeIds") is not None:
+            set_params["nodeIds"] = pub["nodeIds"]
+        if pub.get("timestamp_ms") is not None:
+            set_params["timestamp_ms"] = pub["timestamp_ms"]
+        await self._call_rocket(
+            peer_node,
+            "setKvStoreKeyVals",
+            {"setParams": set_params, "area": area},
+        )
+
+    async def send_dual_messages(
+        self, peer_node, area, messages, sender_id
+    ) -> None:
+        raise KvStoreTransportError(
+            "DUAL PDUs have no RPC in the reference KvStoreService IDL; "
+            "use lsdb_rpc_transport jsonrpc for flood optimization"
+        )
+
+    async def set_flood_topo_child(
+        self, peer_node, area, root_id, child, set_child, sender_id
+    ) -> None:
+        raise KvStoreTransportError(
+            "flood-topo RPCs are not part of the rocket peer surface; "
+            "use lsdb_rpc_transport jsonrpc for flood optimization"
         )

@@ -136,3 +136,33 @@ def test_mixed_wire_format_lab_converges():
             )
             fmts.add("json" if blob[:1] == b"{" else "compact")
         assert fmts == {"json", "compact"}, fmts
+
+
+def test_rocket_transport_lab_converges():
+    """The reference's FULL wire stack on real kernels: LSDB values are
+    thrift-compact (CompactSerializer bytes) AND every KvStore peer RPC
+    rides fbthrift Rocket framing (rsocket frames + Compact
+    RequestRpcMetadata) on the ctrl port — the live-sync proof the
+    round-4 review asked for, one layer short of pointing a real
+    fbthrift binary at it.  Kernel routes must converge end-to-end and
+    the rocket RPC counters must show peer sync actually used it."""
+    lab = NetnsLab(
+        num_nodes=3,
+        topology="line",
+        lsdb_wire_format="thrift-compact",
+        lsdb_rpc_transport="rocket",
+    )
+    with lab:
+        lab.wait_converged(timeout_s=300)
+        for i in range(3):
+            routes = "\n".join(lab.kernel_routes(i))
+            for j in range(3):
+                if i != j:
+                    assert f"10.77.{j}.0/24" in routes, (i, routes)
+        # transit node served rocket RPCs from both neighbors
+        import json as _json
+
+        out = lab.breeze(1, "monitor", "counters", "--prefix", "ctrl.rocket")
+        counters = _json.loads(out)
+        assert counters.get("ctrl.rocket.getKvStoreKeyValsFilteredArea", 0) >= 1, counters
+        assert counters.get("ctrl.rocket.setKvStoreKeyVals", 0) >= 1, counters

@@ -1,0 +1,506 @@
+"""fbthrift Rocket transport tests.
+
+Golden frames are HAND-ASSEMBLED from the RSocket 1.0 spec + the public
+fbthrift rocket protocol layout (kRocketProtocolKey-prefixed SETUP
+metadata, Compact RequestRpcMetadata/ResponseRpcMetadata) the way
+test_thrift_interop.py pins struct bytes — any encoder regression shows
+up at the byte level.  Then the full stack runs over real TCP: the four
+adapted ctrl methods against a live emulated node, and a two-store
+KvStore anti-entropy sync + flood where every RPC rides rocket framing
+(reference: KvStore peer thrift sessions, KvStore.h:460-466; ctrl
+ThriftServer, Main.cpp:399-416).
+"""
+
+import asyncio
+import struct
+import types as pytypes
+
+from openr_tpu import constants as C
+from openr_tpu.common.runtime import WallClock
+from openr_tpu.config import KvStoreConfig
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import line_edges
+from openr_tpu.interop import rocket, rsocket as rs
+from openr_tpu.interop.ctrl_rocket import (
+    DeclaredError,
+    RocketCtrlServer,
+    rocket_call,
+)
+from openr_tpu.kvstore.kv_store import KvStore
+from openr_tpu.kvstore.transport import RocketKvStoreTransport
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import PeerSpec, adj_key
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# golden frames (hand-assembled bytes)
+# ---------------------------------------------------------------------------
+
+
+def test_golden_setup_frame():
+    """SETUP: rsocket 1.0 header + version + timers + mimes, metadata =
+    u32 kRocketProtocolKey(1) | Compact RequestSetupMetadata."""
+    got = rs.encode_setup(
+        keepalive_ms=30000,
+        max_lifetime_ms=3600000,
+        metadata_mime="text/plain",
+        data_mime="text/plain",
+        metadata=rocket.encode_setup_metadata(),
+    )
+    md = (
+        b"\x00\x00\x00\x01"  # kRocketProtocolKey
+        b"\x25\x00"  # field 2 minVersion i32 zigzag(0)
+        b"\x15\x00"  # field 3 maxVersion
+        b"\x00"  # stop
+    )
+    want = (
+        b"\x00\x00\x00\x00"  # stream 0
+        b"\x05\x00"  # type SETUP(0x01)<<10 | METADATA(0x100)
+        b"\x00\x01\x00\x00"  # version 1.0
+        + struct.pack(">II", 30000, 3600000)
+        + b"\x0atext/plain" * 2  # metadata + data mime
+        + b"\x00\x00\x09"  # u24 metadata length
+        + md
+    )
+    assert got == want
+    f = rs.decode_frame(got)
+    assert f.ftype == rs.FT_SETUP and f.keepalive_ms == 30000
+    assert rocket.decode_setup_metadata(f.metadata) == {
+        "minVersion": 0,
+        "maxVersion": 0,
+    }
+
+
+def test_golden_request_response_frame():
+    """REQUEST_RESPONSE for getRouteDbComputed(nodeName="b"): metadata
+    is Compact RequestRpcMetadata{1:protocol=COMPACT, 2:name, 3:kind},
+    data is the Compact args struct {1: "b"}."""
+    md = rocket.encode_request_metadata("getRouteDbComputed")
+    args = b"\x18\x01b\x00"  # field 1 string "b", stop
+    got = rs.encode_request_response(1, md, args)
+    want_md = (
+        b"\x15\x04"  # 1: protocol i32 zigzag(2)=4
+        b"\x18\x12getRouteDbComputed"  # 2: name (len 18)
+        b"\x15\x00"  # 3: kind SINGLE_REQUEST_SINGLE_RESPONSE
+        b"\x00"
+    )
+    want = (
+        b"\x00\x00\x00\x01"  # stream 1 (client streams odd)
+        b"\x11\x00"  # REQUEST_RESPONSE(0x04)<<10 | METADATA
+        + len(want_md).to_bytes(3, "big")
+        + want_md
+        + args
+    )
+    assert got == want
+
+
+def test_golden_void_success_payload():
+    """setKvStoreKeyVals success: PAYLOAD NEXT|COMPLETE, metadata =
+    ResponseRpcMetadata{3: payloadMetadata{1: responseMetadata{}}},
+    data = empty result struct."""
+    md = rocket.encode_response_metadata()
+    got = rs.encode_payload(1, md, b"\x00", complete=True, next_=True)
+    want = (
+        b"\x00\x00\x00\x01"
+        b"\x29\x60"  # PAYLOAD(0x0A)<<10 | METADATA|COMPLETE|NEXT
+        b"\x00\x00\x05"  # metadata length
+        b"\x3c\x1c\x00\x00\x00"  # 3: union{1: empty struct}, stops
+        b"\x00"  # data: empty result struct
+    )
+    assert got == want
+
+
+def test_frame_codec_round_trips():
+    cases = [
+        rs.encode_keepalive(7, respond=True, data=b"ka"),
+        rs.encode_request_fnf(3, b"m", b"d"),
+        rs.encode_request_stream(5, 128, b"meta", b"data"),
+        rs.encode_request_n(5, 64),
+        rs.encode_cancel(9),
+        rs.encode_payload(5, None, b"only-data", complete=False),
+        rs.encode_error(7, rs.ERR_APPLICATION_ERROR, "boom"),
+    ]
+    k = rs.decode_frame(cases[0])
+    assert k.ftype == rs.FT_KEEPALIVE and k.flags & rs.FLAG_RESPOND
+    assert k.last_position == 7 and k.data == b"ka"
+    f = rs.decode_frame(cases[1])
+    assert (f.metadata, f.data) == (b"m", b"d")
+    s = rs.decode_frame(cases[2])
+    assert s.initial_n == 128 and s.metadata == b"meta" and s.data == b"data"
+    assert rs.decode_frame(cases[3]).initial_n == 64
+    assert rs.decode_frame(cases[4]).ftype == rs.FT_CANCEL
+    p = rs.decode_frame(cases[5])
+    assert p.metadata is None and p.data == b"only-data"
+    e = rs.decode_frame(cases[6])
+    assert e.error_code == rs.ERR_APPLICATION_ERROR
+    assert e.error_message == "boom"
+
+
+def test_fragmented_frames_rejected_not_truncated():
+    raw = rs.encode_request_response(1, b"m", b"d")
+    sid, tf = struct.unpack(">IH", raw[:6])
+    frag = struct.pack(">IH", sid, tf | rs.FLAG_FOLLOWS) + raw[6:]
+    try:
+        rs.decode_frame(frag)
+        assert False, "FOLLOWS must raise"
+    except ValueError as e:
+        assert "fragment" in str(e)
+
+
+# ---------------------------------------------------------------------------
+# live RPC: the four adapted methods against an emulated node
+# ---------------------------------------------------------------------------
+
+
+def test_rocket_ctrl_four_methods_end_to_end():
+    async def main():
+        net = EmulatedNetwork(WallClock())
+        net.build(line_edges(2))
+        net.start()
+        node = net.nodes["node0"]
+        server = RocketCtrlServer(node, port=0)
+        await server.start()
+        try:
+            # wait for spark/kvstore convergence on the wall clock
+            for _ in range(200):
+                if adj_key("node0") in node.kv_store.dump_all(
+                    C.DEFAULT_AREA, "adj:"
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            async with rocket.RocketClient("127.0.0.1", server.port) as c:
+                # 1. filtered dump (no hashes)
+                pub = await rocket_call(
+                    c,
+                    "getKvStoreKeyValsFilteredArea",
+                    {
+                        "filter": {"keys": ["adj:"]},
+                        "area": C.DEFAULT_AREA,
+                    },
+                )
+                assert adj_key("node0") in pub["keyVals"]
+                assert pub["keyVals"][adj_key("node0")]["version"] >= 1
+
+                # 2. adjacency dump
+                adjs = await rocket_call(
+                    c, "getDecisionAdjacenciesFiltered", {"filter": {}}
+                )
+                names = {a["thisNodeName"] for a in adjs}
+                assert {"node0", "node1"} <= names
+
+                # 3. computed routes for the OTHER node (global topology)
+                rdb = await rocket_call(
+                    c, "getRouteDbComputed", {"nodeName": "node1"}
+                )
+                assert rdb["thisNodeName"] == "node1"
+
+                # 4. setKvStoreKeyVals round-trips a value in
+                await rocket_call(
+                    c,
+                    "setKvStoreKeyVals",
+                    {
+                        "setParams": {
+                            "keyVals": {
+                                "test:rocket": {
+                                    "version": 9,
+                                    "originatorId": "ext",
+                                    "value": b"hello-rocket",
+                                    "ttl": 60000,
+                                    "ttlVersion": 0,
+                                }
+                            },
+                            "senderId": "test-client",
+                        },
+                        "area": C.DEFAULT_AREA,
+                    },
+                )
+                got = node.kv_store.get_key_vals(
+                    C.DEFAULT_AREA, ["test:rocket"]
+                )
+                assert got["test:rocket"].value == b"hello-rocket"
+                assert got["test:rocket"].version == 9
+
+                # declared exception: unknown area -> KvStoreError
+                try:
+                    await rocket_call(
+                        c,
+                        "getKvStoreKeyValsFilteredArea",
+                        {"filter": {}, "area": "no-such-area"},
+                    )
+                    assert False, "expected DeclaredError"
+                except DeclaredError as e:
+                    assert "no-such-area" in str(e)
+
+                # unknown method -> rsocket APPLICATION_ERROR
+                try:
+                    await c.request_response("noSuchMethod", b"\x00")
+                    assert False, "expected RocketError"
+                except rocket.RocketError as e:
+                    assert "noSuchMethod" in str(e)
+        finally:
+            await server.stop()
+            await net.stop()
+
+    run(main())
+
+
+def test_setup_without_protocol_key_rejected():
+    async def main():
+        async def nope(name, data, peer):  # pragma: no cover
+            raise AssertionError("must not dispatch")
+
+        server = await rocket.RocketServer(nope, port=0).start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.port
+            )
+            # plain rsocket SETUP without fbthrift's protocol key
+            writer.write(
+                rs.frame_stream(
+                    rs.encode_setup(
+                        keepalive_ms=1000,
+                        max_lifetime_ms=1000,
+                        metadata_mime="application/binary",
+                        data_mime="application/binary",
+                        metadata=b"\x00\x00\x00\x99",
+                    )
+                )
+            )
+            await writer.drain()
+            frame = await asyncio.wait_for(rs.read_stream_frame(reader), 5)
+            assert frame.ftype == rs.FT_ERROR
+            assert frame.error_code == rs.ERR_INVALID_SETUP
+            writer.close()
+        finally:
+            await server.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# KvStore peer plane over rocket: sync + flood with reference wire shapes
+# ---------------------------------------------------------------------------
+
+
+def make_store(name: str) -> KvStore:
+    return KvStore(
+        node_name=name,
+        clock=WallClock(),
+        config=KvStoreConfig(),
+        areas=["0"],
+        transport=RocketKvStoreTransport(),
+        publications_queue=ReplicateQueue(f"{name}.pubs"),
+    )
+
+
+async def serve_store(store: KvStore) -> RocketCtrlServer:
+    node_stub = pytypes.SimpleNamespace(kv_store=store)
+    return await RocketCtrlServer(node_stub, port=0).start()
+
+
+def test_two_stores_sync_and_flood_over_rocket():
+    async def main():
+        a, b = make_store("a"), make_store("b")
+        a.start()
+        b.start()
+        sa, sb = await serve_store(a), await serve_store(b)
+        try:
+            a.areas["0"].persist_self_originated_key("prefix:a", b"va")
+            a.areas["0"].add_peers(
+                {"b": PeerSpec(peer_addr="127.0.0.1", ctrl_port=sb.port)}
+            )
+            b.areas["0"].add_peers(
+                {"a": PeerSpec(peer_addr="127.0.0.1", ctrl_port=sa.port)}
+            )
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if "prefix:a" in b.areas["0"].key_vals:
+                    break
+            assert "prefix:a" in b.areas["0"].key_vals
+            assert b.areas["0"].key_vals["prefix:a"].value == b"va"
+
+            # flood: a new key on b reaches a via rocket setKvStoreKeyVals
+            b.areas["0"].persist_self_originated_key("prefix:b", b"vb")
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if "prefix:b" in a.areas["0"].key_vals:
+                    break
+            assert a.areas["0"].key_vals["prefix:b"].value == b"vb"
+        finally:
+            await a.stop()
+            await b.stop()
+            await a.transport.close()
+            await b.transport.close()
+            await sa.stop()
+            await sb.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# round-5 review regressions
+# ---------------------------------------------------------------------------
+
+
+def test_truncated_frame_bodies_raise_value_error():
+    """Short KEEPALIVE/ERROR/SETUP bodies must surface as ValueError
+    (one except clause in connection handlers), never struct.error."""
+    cases = [
+        struct.pack(">IH", 0, rs.FT_KEEPALIVE << 10) + b"\x00\x01",  # <8B
+        struct.pack(">IH", 1, rs.FT_ERROR << 10) + b"\x00\x02",  # <4B
+        struct.pack(">IH", 0, rs.FT_SETUP << 10) + b"\x00\x01",  # no timers
+        struct.pack(">IH", 5, rs.FT_REQUEST_N << 10) + b"\x01",  # <4B
+    ]
+    for raw in cases:
+        try:
+            rs.decode_frame(raw)
+            assert False, f"must raise: {raw!r}"
+        except ValueError:
+            pass
+
+
+def test_dead_client_fails_fast_not_timeout():
+    """A peer that closed while the client was idle must fail the NEXT
+    rpc immediately (so the kv transport redials), not after the full
+    request timeout."""
+
+    async def main():
+        async def ok(name, data, peer):
+            return rocket.encode_response_metadata(), b"\x00"
+
+        server = await rocket.RocketServer(ok, port=0).start()
+        client = await rocket.RocketClient("127.0.0.1", server.port).connect()
+        try:
+            await server.stop()  # peer goes away while client is idle
+            for _ in range(100):
+                if client._dead is not None:
+                    break
+                await asyncio.sleep(0.02)
+            t0 = asyncio.get_running_loop().time()
+            try:
+                await client.request_response("x", b"\x00", timeout_s=30.0)
+                assert False, "expected RocketError"
+            except rocket.RocketError:
+                pass
+            assert asyncio.get_running_loop().time() - t0 < 1.0
+        finally:
+            await client.close()
+
+    run(main())
+
+
+def test_client_emits_periodic_keepalives():
+    """RSocket 1.0: the client must emit KEEPALIVE at its declared
+    interval or a spec-compliant responder may drop the connection."""
+
+    async def main():
+        got = asyncio.Event()
+        count = 0
+
+        async def on_conn(reader, writer):
+            nonlocal count
+            while True:
+                frame = await rs.read_stream_frame(reader)
+                if frame is None:
+                    return
+                if frame.ftype == rs.FT_KEEPALIVE:
+                    count += 1
+                    if count >= 2:
+                        got.set()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = await rocket.RocketClient(
+            "127.0.0.1", port, keepalive_ms=50
+        ).connect()
+        try:
+            await asyncio.wait_for(got.wait(), 5)
+        finally:
+            await client.close()
+            server.close()
+            # NOT wait_closed(): py3.12 blocks it on handler completion,
+            # and the raw on_conn handler may still be parked in read
+
+    run(main())
+
+
+def test_config_rejects_rocket_with_flood_optimization():
+    from openr_tpu.config import KvStoreConfig as KvCfg, OpenrConfig
+
+    try:
+        OpenrConfig(
+            node_name="x",
+            lsdb_rpc_transport="rocket",
+            kvstore_config=KvCfg(enable_flood_optimization=True),
+        )
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "flood_optimization" in str(e)
+
+
+def test_result_spec_cache_bounded_across_calls():
+    """Each RPC must reuse the per-method result spec: compact.py's
+    _BY_ID_CACHE pins every spec it sees, so per-call spec construction
+    would leak one entry per RPC on the peer hot path."""
+
+    async def main():
+        from openr_tpu.interop import compact
+
+        async def ok(name, data, peer):
+            return rocket.encode_response_metadata(), b"\x00"
+
+        server = await rocket.RocketServer(ok, port=0).start()
+        client = await rocket.RocketClient("127.0.0.1", server.port).connect()
+        try:
+            await rocket_call(client, "setKvStoreKeyVals",
+                              {"setParams": {}, "area": "0"})
+            before = len(compact._BY_ID_CACHE)
+            for _ in range(50):
+                await rocket_call(client, "setKvStoreKeyVals",
+                                  {"setParams": {}, "area": "0"})
+            assert len(compact._BY_ID_CACHE) == before
+        finally:
+            await client.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_empty_hash_sync_gets_flood_ttl_semantics():
+    """A cold initiator's full sync (present-but-EMPTY keyValHashes map)
+    must flow through handle_full_sync_request — values arrive with the
+    flood-copy TTL decrement, same as the jsonrpc transport — not the
+    plain operator dump."""
+
+    async def main():
+        store = make_store("resp")
+        store.start()
+        server = await serve_store(store)
+        transport = RocketKvStoreTransport()
+        transport.register_peer(
+            "resp", PeerSpec(peer_addr="127.0.0.1", ctrl_port=server.port)
+        )
+        try:
+            store.areas["0"].persist_self_originated_key("k1", b"v1")
+            ttl_in_store = store.areas["0"].key_vals["k1"].ttl
+            pub = await transport.get_key_vals_filtered_area(
+                "resp", "0", {}, "cold-node"
+            )
+            assert "k1" in pub.key_vals
+            # flood-copy semantics: ttl decremented relative to stored
+            assert pub.key_vals["k1"].ttl < ttl_in_store
+            assert pub.tobe_updated_keys == []
+        finally:
+            await transport.close()
+            await store.stop()
+            await server.stop()
+
+    run(main())
