@@ -54,9 +54,13 @@ DEVICE_REPS = 3
 
 def main() -> None:
     t_start = time.time()
-    from openr_tpu.ops.platform_env import honor_cpu_platform_request
+    from openr_tpu.ops.platform_env import (
+        enable_persistent_compile_cache,
+        honor_cpu_platform_request,
+    )
 
     honor_cpu_platform_request()
+    enable_persistent_compile_cache()
     from openr_tpu.decision.link_state import LinkState
     from openr_tpu.emulation.topology import build_adj_dbs, random_connected_edges
     from openr_tpu.ops.csr import encode_link_state

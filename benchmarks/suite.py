@@ -1039,9 +1039,13 @@ def main() -> None:
     # an explicit CPU request must win BEFORE the first jax import: a
     # site hook may force-select a tunneled accelerator whose remote
     # init blocks indefinitely (a CPU smoke run would hang forever)
-    from openr_tpu.ops.platform_env import honor_cpu_platform_request
+    from openr_tpu.ops.platform_env import (
+        enable_persistent_compile_cache,
+        honor_cpu_platform_request,
+    )
 
     honor_cpu_platform_request()
+    enable_persistent_compile_cache()
     results: List[Dict] = []
     t0 = time.time()
     for bench in ALL_BENCHES:

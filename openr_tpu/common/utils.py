@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import contextlib
 from typing import Callable, Deque, Optional, Tuple
 
 from openr_tpu.common.runtime import Actor, Clock
@@ -236,3 +237,23 @@ class Throttle2Tuple:
 
     def __init__(self, pair: Tuple[float, float]):
         self.initial, self.max = pair
+
+
+@contextlib.contextmanager
+def gc_paused():
+    """Pause the cyclic collector for a large-allocation section.
+
+    Bulk LSDB ingest and full route builds allocate a few container
+    objects per advertisement/route; CPython gen-2 collections re-scan
+    the ever-growing LSDB+RIB heap mid-batch (measured 2x ingest cost
+    at 409,600 prefixes).  No-op when GC is already disabled."""
+    import gc
+
+    was_enabled = gc.isenabled()
+    if was_enabled:
+        gc.disable()
+    try:
+        yield
+    finally:
+        if was_enabled:
+            gc.enable()

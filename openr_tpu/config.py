@@ -233,6 +233,12 @@ class OpenrConfig:
     #: ThriftServer does on :2018, Main.cpp:399-416) and moves the
     #: JSON-RPC operator listener to `openr_ctrl_port + 1`.
     lsdb_rpc_transport: str = "jsonrpc"
+    #: where the JSON-RPC operator listener binds in rocket mode (the
+    #: rocket server owns openr_ctrl_port there).  None = openr_ctrl_port
+    #: + 1, or an ephemeral port when openr_ctrl_port is 0.  Co-hosted
+    #: daemons on consecutive ctrl ports must set this explicitly or the
+    #: +1 defaults collide (fail-fast EADDRINUSE at startup).
+    jsonrpc_ctrl_port: Optional[int] = None
     #: named routing-policy definitions (area_policies in the reference
     #: schema, OpenrConfig.thrift:544) referenced by
     #: AreaConfig.import_policy / OriginatedPrefix.origination_policy;

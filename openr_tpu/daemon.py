@@ -197,7 +197,12 @@ async def run_real_node(
             node, host=ctrl_host or "", port=ctrl_port, tls=config.tls
         )
         await rocket_server.start()
-        json_port = ctrl_port + 1
+        if config.jsonrpc_ctrl_port is not None:
+            json_port = config.jsonrpc_ctrl_port
+        elif ctrl_port == 0:
+            json_port = 0  # ephemeral ctrl -> ephemeral operator port
+        else:
+            json_port = ctrl_port + 1
     else:
         json_port = ctrl_port
     server = OpenrCtrlServer(

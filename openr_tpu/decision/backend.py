@@ -187,6 +187,12 @@ class TpuBackend(DecisionBackend):
         min_device_prefixes: Optional[int] = 0,
     ) -> None:
         self.solver = solver  # scalar fallback + MPLS/static
+        # AOT-equivalence with the reference's compiled binary: persist
+        # XLA executables so only the FIRST boot on a machine pays kernel
+        # compilation (~14s of cold boot at 4096-node scale)
+        from openr_tpu.ops.platform_env import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache()
         self.node_buckets = tuple(node_buckets)
         self.cand_buckets = tuple(cand_buckets)
         #: device-vs-scalar cutover.  None = AUTO-CALIBRATE: measure the

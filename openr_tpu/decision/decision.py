@@ -65,6 +65,11 @@ def deserialize_prefix_db(data: bytes) -> PrefixDatabase:
     return _de(data)
 
 
+#: process-wide latch: the long-lived-heap freeze happens once no matter
+#: how many Decision actors share the interpreter
+_GC_FROZEN = False
+
+
 class Decision(Actor):
     def __init__(
         self,
@@ -99,6 +104,9 @@ class Decision(Actor):
         self._kvstore_synced = False
         self._unblocked = False
         self._first_build_done = False
+        #: cold-boot GC pause active (see _on_publication); always
+        #: released by _end_boot_gc_window or stop()
+        self._boot_gc_paused = False
         self._rebuild_pending = False
         # pending-delta accumulation between debounced rebuilds
         # (DecisionPendingUpdates, Decision.h:40-108): prefix-only deltas
@@ -139,6 +147,16 @@ class Decision(Actor):
             self.config.unblock_initial_routes_ms / 1000.0, self._force_unblock
         )
 
+    async def stop(self) -> None:
+        if self._boot_gc_paused:
+            # never leave the process with the collector off (daemon
+            # shut down before the first build completed)
+            import gc
+
+            self._boot_gc_paused = False
+            gc.enable()
+        await super().stop()
+
     def on_initialization_event(self, ev: InitializationEvent) -> None:
         """Wired by the daemon: KVSTORE_SYNCED gates the initial build."""
         if ev == InitializationEvent.KVSTORE_SYNCED:
@@ -165,13 +183,55 @@ class Decision(Actor):
             self.area_link_states[area] = LinkState(area, self.node_name)
         return self.area_link_states[area]
 
+    #: publications at/above this many prefix keys take the native bulk
+    #: decode path (below it, batch setup costs more than it saves)
+    BULK_INGEST_MIN = 32
+
     def _on_publication(self, pub: Publication) -> None:
+        if len(pub.key_vals) >= self.BULK_INGEST_MIN:
+            # large publication (cold boot / areawide churn): gen-2
+            # collections re-scan the ever-growing LSDB heap (measured
+            # 2x ingest slowdown at 409,600 prefixes with GC running).
+            # During COLD BOOT (before the first build) the pause spans
+            # the whole ingest window — re-enabling between publications
+            # lets gen-2 scans of the growing, not-yet-frozen LSDB eat
+            # the win right back; the window ends (collect + freeze +
+            # re-enable) when the first large build completes, and the
+            # forced-unblock timer bounds it.  Steady-state large
+            # publications pause per-batch only.
+            import gc
+
+            if not self._first_build_done:
+                if not self._boot_gc_paused and gc.isenabled():
+                    gc.disable()
+                    self._boot_gc_paused = True
+                self._on_publication_inner(pub)
+                return
+            from openr_tpu.common.utils import gc_paused
+
+            with gc_paused():
+                self._on_publication_inner(pub)
+            return
+        self._on_publication_inner(pub)
+
+    def _on_publication_inner(self, pub: Publication) -> None:
         changed = False
         area = pub.area
+        bulk_items = None
+        if len(pub.key_vals) >= self.BULK_INGEST_MIN:
+            from openr_tpu.decision.ingest import get_bulk_decoder
+
+            if get_bulk_decoder() is not None:
+                bulk_items = []
         for key, value in pub.key_vals.items():
             if value.value is None:
                 continue  # ttl-refresh only
+            if bulk_items is not None and key.startswith(C.PREFIX_DB_MARKER):
+                bulk_items.append((key, value.value))
+                continue
             changed |= self._update_key(area, key, value.value)
+        if bulk_items:
+            changed |= self._bulk_update_prefix_keys(area, bulk_items)
         for key in pub.expired_keys:
             changed |= self._delete_key(area, key)
         if changed:
@@ -180,6 +240,42 @@ class Decision(Actor):
             self._rebuild_pending = True
             if self._unblocked:
                 self._debounce()
+
+    def _bulk_update_prefix_keys(self, area: str, items: List[tuple]) -> bool:
+        """Native-kernel batch ingest of ``prefix:`` values (the cold-boot
+        hot path; reference analogue: generated-C++ thrift decode feeding
+        mergeKeyValues, KvStoreUtil.cpp:391).  Semantics are identical to
+        per-key `_update_key`: rows the kernel can't express fall back to
+        the scalar path, deletes use the key's prefix, updates use the
+        payload's (canonical) prefix."""
+        from openr_tpu.decision.ingest import ST_DELETE, ST_FAST, get_bulk_decoder
+
+        dec = get_bulk_decoder()
+        status, entries = dec.decode([payload for _, payload in items])
+        changed_set = self._pending_prefix_changes
+        changed = False
+        update_changed = self.prefix_state.update_prefix_changed
+        for i, (key, payload) in enumerate(items):
+            parsed = parse_prefix_key(key)
+            if parsed is None:
+                continue  # not a prefix key after all (marker collision)
+            st = status[i]
+            if st == ST_FAST:
+                entry = entries[i]
+                origin_node = parsed[0]
+                if update_changed(origin_node, area, entry):
+                    changed_set.add(entry.prefix)
+                    changed = True
+            elif st == ST_DELETE:
+                got = self.prefix_state.delete_prefix(
+                    parsed[0], area, parsed[1]
+                )
+                if got:
+                    changed_set |= got
+                    changed = True
+            else:
+                changed |= self._update_key(area, key, payload)
+        return changed
 
     def _update_key(self, area: str, key: str, data: bytes) -> bool:
         node = parse_adj_key(key)
@@ -254,6 +350,46 @@ class Decision(Actor):
     def _rebuild_routes(self) -> None:
         if not self._unblocked:
             return
+        large = self.prefix_state.get_received_routes_count() >= 10_000
+        if large:
+            # same GC discipline as bulk ingest: a reference-scale full
+            # build allocates ~4 container objects per route and gen-2
+            # collections re-scan the whole LSDB+RIB heap mid-build
+            from openr_tpu.common.utils import gc_paused
+
+            with gc_paused():
+                self._rebuild_routes_inner()
+            if self._first_build_done:
+                self._end_boot_gc_window()
+            return
+        self._rebuild_routes_inner()
+        if self._first_build_done and self._boot_gc_paused:
+            self._end_boot_gc_window()
+
+    def _end_boot_gc_window(self) -> None:
+        """Boot steady state reached: the LSDB + first RouteDb are
+        long-lived by design — collect once (purge any cycles created
+        while the boot pause was active; the CPython-documented
+        pre-freeze step), then move the surviving heap to the permanent
+        generation so later full collections never re-scan it.  The C++
+        reference pays zero cycle-collector tax on its LSDB; gc.freeze
+        is CPython's mechanism for exactly that.  ONCE per process —
+        the latch is module-global, not per-instance, so multi-node
+        in-process deployments (EmulatedNetwork) don't repeatedly
+        freeze each other's transient heaps."""
+        import gc
+
+        global _GC_FROZEN
+        if self._boot_gc_paused:
+            self._boot_gc_paused = False
+            gc.enable()
+        if not _GC_FROZEN:
+            _GC_FROZEN = True
+            gc.collect()
+            gc.freeze()
+            self.counters.set("decision.gc_freeze_rib", 1)
+
+    def _rebuild_routes_inner(self) -> None:
         self._rebuild_pending = False
         t0 = self.clock.now()
         policy_active = self.rib_policy is not None and self.rib_policy.is_active(

@@ -29,13 +29,22 @@ class PrefixState:
     ) -> Set[str]:
         """Insert/replace one advertisement; returns changed prefixes
         (PrefixState::updatePrefix, PrefixState.cpp)."""
+        if self.update_prefix_changed(node, area, entry):
+            return {entry.prefix}
+        return set()
+
+    def update_prefix_changed(
+        self, node: str, area: str, entry: PrefixEntry
+    ) -> bool:
+        """update_prefix without the per-call set allocation — the bulk
+        ingest path calls this half a million times on cold boot."""
         key: NodeAndArea = (node, area)
         entries = self._prefixes.setdefault(entry.prefix, {})
         prior = entries.get(key)
         if prior == entry:
-            return set()
+            return False
         entries[key] = entry
-        return {entry.prefix}
+        return True
 
     def delete_prefix(self, node: str, area: str, prefix: str) -> Set[str]:
         """Remove one advertisement; returns changed prefixes."""

@@ -455,6 +455,10 @@ class RocketServer:
                     t = asyncio.create_task(
                         self._serve_request(frame, send, writer)
                     )
+                    # tag at CREATE time: a CANCEL already buffered in
+                    # the same TCP segment is processed before the task
+                    # first runs, and must still find its stream id
+                    t.rocket_sid = frame.stream_id  # type: ignore[attr-defined]
                     inflight.add(t)
                     t.add_done_callback(inflight.discard)
                 elif frame.ftype == rs.FT_REQUEST_STREAM:
@@ -486,7 +490,6 @@ class RocketServer:
             self._conn_tasks.discard(task)
 
     async def _serve_request(self, frame: rs.Frame, send, writer) -> None:
-        asyncio.current_task().rocket_sid = frame.stream_id  # type: ignore[attr-defined]
         try:
             if not frame.metadata:
                 raise ValueError("request carries no RequestRpcMetadata")

@@ -120,46 +120,56 @@ def build_repair_plan(topo, root_id: int, base_dist: np.ndarray,
         & (base_dist[src] + w == base_dist[dst])
     )
 
-    # descendant bitsets: desc[v] includes v and every DAG-descendant.
-    # One reverse-topological pass: process DAG edges u->v in descending
-    # base_dist[u]; since w >= 1, dist[v] > dist[u], so desc[v] is final
-    # before any edge into u's row is processed.
+    dag_e = np.nonzero(on_edge)[0]
+    dag_src = src[dag_e]
+    dag_dst = dst[dag_e]
+
+    # hop level: max hops over shortest paths (bounds lane-propagation
+    # depth).  Monotone fixpoint over DAG edges — converges in max-depth
+    # rounds, each a single C-level scatter (vectorized r5; the former
+    # per-edge Python pass dominated plan rebuild time under churn)
+    level = np.zeros(V, np.int32)
+    while True:
+        prev = level.copy()
+        np.maximum.at(level, dag_dst, level[dag_src] + 1)
+        if np.array_equal(level, prev):
+            break
+
+    # descendant bitsets: desc[v] includes v and every DAG-descendant;
+    # M[v] = deepest level among desc(v).  One reverse-topological pass:
+    # process DAG edges u->v in descending base_dist[u]; since w >= 1,
+    # dist[v] > dist[u], so desc[v]/M[v] are final before any edge into
+    # u's row is processed.
     desc = np.zeros((V, vw), np.uint32)
     idx = np.arange(V)
     desc[idx, idx // 32] = np.uint32(1) << (idx % 32).astype(np.uint32)
-    dag_e = np.nonzero(on_edge)[0]
-    order = np.argsort(-base_dist[src[dag_e]], kind="stable")
-    for e in dag_e[order]:
-        desc[src[e]] |= desc[dst[e]]
-
-    # hop level: max hops over shortest paths (bounds lane-propagation
-    # depth); ascending-dist pass over DAG edges
-    level = np.zeros(V, np.int32)
-    order_f = np.argsort(base_dist[src[dag_e]], kind="stable")
-    for e in dag_e[order_f]:
-        level[dst[e]] = max(level[dst[e]], level[src[e]] + 1)
+    deepest = level.copy()
+    order = np.argsort(-base_dist[dag_src], kind="stable")
+    for u, v in zip(dag_src[order].tolist(), dag_dst[order].tolist()):
+        desc[u] |= desc[v]
+        if deepest[v] > deepest[u]:
+            deepest[u] = deepest[v]
 
     # per-link affected set = union of desc(head) over its on-DAG
     # directed edges; repair depth = deepest affected level minus the
-    # shallowest head level (+1 slack for the convergence-detect round)
-    aff = np.zeros((L, vw), np.uint32)
+    # shallowest head level (+1 slack for the convergence-detect round).
+    # max-level-over-union(desc(h)) == max over heads of deepest[h], so
+    # no per-link bitset expansion is needed.
     depth = np.zeros(L, np.int32)
     on_dag_link = np.zeros(L, bool)
-    heads: dict = {}
-    for e in dag_e:
-        li = link_index[e]
-        if li < 0:
-            continue
-        on_dag_link[li] = True
-        aff[li] |= desc[dst[e]]
-        heads.setdefault(li, []).append(dst[e])
-    # expand bitset -> levels once per link (vectorized over V)
-    bit_v = np.uint32(1) << (idx % 32).astype(np.uint32)
-    for li, hs in heads.items():
-        members = (aff[li][idx // 32] & bit_v) != 0
-        top = int(level[members].max()) if members.any() else 0
-        base_l = min(int(level[h]) for h in hs)
-        depth[li] = max(1, top - base_l + 2)
+    dag_li = link_index[dag_e]
+    linked = dag_li >= 0
+    li_arr = dag_li[linked]
+    head_arr = dag_dst[linked]
+    aff = np.zeros((L, vw), np.uint32)
+    np.bitwise_or.at(aff, li_arr, desc[head_arr])
+    on_dag_link[li_arr] = True
+    top_l = np.zeros(L, np.int32)
+    np.maximum.at(top_l, li_arr, deepest[head_arr])
+    base_l = np.full(L, np.iinfo(np.int32).max, np.int32)
+    np.minimum.at(base_l, li_arr, level[head_arr])
+    has = on_dag_link
+    depth[has] = np.maximum(1, top_l[has] - base_l[has] + 2)
 
     lanes, pt = (
         pull_tables

@@ -1,0 +1,305 @@
+"""Native bulk LSDB ingest: the kernel may only be faster, never
+different.  Parity is pinned by running the same publications through
+the bulk path (native/lsdb_decode.cc) and the scalar path
+(lsdb_codec + generic from_wire) and requiring identical PrefixState.
+
+Reference analogue: the C++ thrift decode feeding mergeKeyValues
+(openr/kvstore/KvStoreUtil.cpp:391) — decode speed is an implementation
+property, semantics live in one place."""
+
+import json
+import random
+
+import pytest
+
+from openr_tpu.decision.ingest import (
+    ST_DELETE,
+    ST_FALLBACK,
+    ST_FAST,
+    BulkPrefixDecoder,
+    get_bulk_decoder,
+)
+from openr_tpu.lsdb_codec import deserialize_prefix_db, serialize_prefix_db
+from openr_tpu.types import (
+    PerfEvent,
+    PerfEvents,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+    PrefixMetrics,
+    PrefixType,
+)
+
+
+@pytest.fixture(scope="module")
+def dec():
+    d = get_bulk_decoder()
+    if d is None:
+        pytest.skip("native lsdb decoder unavailable")
+    return d
+
+
+def _random_db(rng: random.Random) -> PrefixDatabase:
+    v6 = rng.random() < 0.3
+    if v6:
+        plen = rng.randint(16, 128)
+        addr = f"2001:db8:{rng.randint(0, 0xFFFF):x}::{rng.randint(1, 0xFFFF):x}"
+        prefix = f"{addr}/{plen}"
+    else:
+        plen = rng.randint(8, 32)
+        prefix = (
+            f"{rng.randint(1, 223)}.{rng.randint(0, 255)}."
+            f"{rng.randint(0, 255)}.{rng.randint(0, 255)}/{plen}"
+        )
+    entry = PrefixEntry(
+        prefix,
+        type=rng.choice(list(PrefixType)),
+        forwarding_type=rng.choice(list(PrefixForwardingType)),
+        forwarding_algorithm=rng.choice(list(PrefixForwardingAlgorithm)),
+        min_nexthop=rng.choice([None, 1, 4]),
+        metrics=PrefixMetrics(
+            version=rng.randint(1, 3),
+            drain_metric=rng.randint(0, 1),
+            path_preference=rng.randint(0, 2000),
+            source_preference=rng.randint(0, 200),
+            distance=rng.randint(0, 8),
+        ),
+        weight=rng.choice([None, 10]),
+    )
+    return PrefixDatabase(f"node{rng.randint(0, 63)}", [entry])
+
+
+def test_fast_rows_match_scalar_decoder_exactly(dec):
+    rng = random.Random(1234)
+    dbs = [_random_db(rng) for _ in range(300)]
+    payloads = []
+    for db in dbs:
+        payloads.append(serialize_prefix_db(db, "json"))
+        payloads.append(serialize_prefix_db(db, "thrift-compact"))
+    status, entries = dec.decode(payloads)
+    fast = 0
+    for i, payload in enumerate(payloads):
+        want_db = deserialize_prefix_db(payload)
+        if status[i] == ST_FAST:
+            fast += 1
+            assert entries[i] == want_db.prefix_entries[0], (
+                i,
+                entries[i],
+                want_db.prefix_entries[0],
+            )
+        # fallback rows are allowed — scalar path serves them — but the
+        # canonical shapes must overwhelmingly hit the fast path
+    assert fast >= len(payloads) * 0.95, fast
+
+
+def test_off_shape_payloads_fall_back_not_misdecode(dec):
+    odd = [
+        # multi-entry
+        PrefixDatabase("a", [PrefixEntry("1.2.3.0/24"), PrefixEntry("1.2.4.0/24")]),
+        # tags / area_stack
+        PrefixDatabase("b", [PrefixEntry("10.0.0.0/8", tags={"x"})]),
+        PrefixDatabase("c", [PrefixEntry("10.0.0.0/8", area_stack=["0", "1"])]),
+        # perf events ride-along
+        PrefixDatabase(
+            "d",
+            [PrefixEntry("10.1.0.0/16")],
+            perf_events=PerfEvents([PerfEvent("d", "ORIGINATED", 1)]),
+        ),
+        # v4-mapped v6 (text form differs between inet_ntop and ipaddress)
+        PrefixDatabase("e", [PrefixEntry("::ffff:1.2.3.4/128")]),
+    ]
+    for db in odd:
+        for fmt in ("json", "thrift-compact"):
+            payload = serialize_prefix_db(db, fmt)
+            status, entries = dec.decode([payload])
+            assert status[0] == ST_FALLBACK, (db.this_node_name, fmt, status)
+    # garbage payloads must fall back, never crash
+    status, _ = dec.decode([b"", b"\xff\x00garbage", b"{not json", b"\x18"])
+    assert all(s == ST_FALLBACK for s in status)
+
+
+def test_delete_and_normalization(dec):
+    delete = serialize_prefix_db(PrefixDatabase("n", [], delete_prefix=True))
+    empty = serialize_prefix_db(PrefixDatabase("n", []))
+    status, _ = dec.decode([delete, empty])
+    assert status == [ST_DELETE, ST_DELETE]
+
+    # host bits zeroed + canonical v6 text, same as normalize_prefix
+    raw = json.dumps(
+        {
+            "this_node_name": "n",
+            "prefix_entries": [
+                {
+                    "prefix": "10.1.2.3/24",
+                    "type": 1,
+                    "forwarding_type": 0,
+                    "forwarding_algorithm": 0,
+                    "min_nexthop": None,
+                    "metrics": {
+                        "version": 1,
+                        "drain_metric": 0,
+                        "path_preference": 0,
+                        "source_preference": 0,
+                        "distance": 0,
+                    },
+                    "tags": [],
+                    "area_stack": [],
+                    "weight": None,
+                }
+            ],
+            "delete_prefix": False,
+        }
+    ).encode()
+    status, entries = dec.decode([raw])
+    assert status == [ST_FAST]
+    assert entries[0].prefix == "10.1.2.0/24"
+    raw6 = raw.replace(b"10.1.2.3/24", b"2001:DB8:0:0:0:0:0:5/64")
+    status, entries = dec.decode([raw6])
+    assert status == [ST_FAST]
+    assert entries[0].prefix == "2001:db8::/64"
+
+
+def test_unknown_json_fields_skipped_like_from_wire(dec):
+    obj = json.loads(
+        serialize_prefix_db(
+            PrefixDatabase("n", [PrefixEntry("10.2.0.0/16")])
+        ).decode()
+    )
+    obj["future_field"] = {"nested": [1, 2, {"x": "y"}]}
+    obj["prefix_entries"][0]["future_entry_field"] = "ok"
+    status, entries = dec.decode([json.dumps(obj).encode()])
+    assert status == [ST_FAST]
+    assert entries[0].prefix == "10.2.0.0/16"
+
+
+def test_decision_bulk_and_scalar_paths_converge_identically(monkeypatch):
+    """Drive TWO Decision instances with the same >=32-key publications —
+    one bulk (native), one scalar-forced — and require identical
+    PrefixState contents."""
+    if get_bulk_decoder() is None:
+        pytest.skip("native lsdb decoder unavailable")
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision import decision as dmod
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.types import Publication, Value, prefix_key
+
+    rng = random.Random(77)
+
+    def make_decision():
+        return dmod.Decision(
+            "node0",
+            SimClock(),
+            DecisionConfig(),
+            ReplicateQueue("routes"),
+        )
+
+    d_bulk = make_decision()
+    d_scalar = make_decision()
+
+    pubs = []
+    for p in range(3):
+        kvs = {}
+        for i in range(60):
+            db = _random_db(rng)
+            if i % 17 == 0:  # sprinkle off-shape rows into the batch
+                db.prefix_entries[0].tags = {"odd"}
+            if i % 23 == 0:
+                db = PrefixDatabase(db.this_node_name, [], delete_prefix=True)
+            fmt = "json" if i % 2 else "thrift-compact"
+            pfx = (
+                db.prefix_entries[0].prefix
+                if db.prefix_entries
+                else f"10.{p}.{i}.0/24"
+            )
+            kvs[prefix_key(db.this_node_name, pfx)] = Value(
+                version=1,
+                originator_id=db.this_node_name,
+                value=serialize_prefix_db(db, fmt),
+            )
+        pubs.append(Publication(key_vals=kvs))
+
+    assert len(pubs[0].key_vals) >= dmod.Decision.BULK_INGEST_MIN
+    for pub in pubs:
+        d_bulk._on_publication(pub)
+
+    # force the scalar path by hiding the decoder
+    monkeypatch.setattr(dmod, "Decision", dmod.Decision)  # anchor
+    import openr_tpu.decision.ingest as ing
+
+    monkeypatch.setattr(ing, "get_bulk_decoder", lambda: None)
+    for pub in pubs:
+        d_scalar._on_publication(pub)
+
+    assert d_bulk.prefix_state.prefixes() == d_scalar.prefix_state.prefixes()
+    assert (
+        d_bulk._pending_prefix_changes == d_scalar._pending_prefix_changes
+    )
+
+
+def test_missing_node_name_matches_scalar_rejection(dec):
+    """JSON payloads the scalar decoder REJECTS (no this_node_name:
+    from_wire raises) must fall back, not fast-decode — a value's effect
+    must not depend on whether it arrived in a >=32-key batch (r5
+    review)."""
+    bare = b"{}"
+    noname = json.dumps(
+        {"prefix_entries": [], "delete_prefix": True}
+    ).encode()
+    status, _ = dec.decode([bare, noname])
+    assert status == [ST_FALLBACK, ST_FALLBACK]
+    # compact WITHOUT thisNodeName is accepted by the scalar decoder
+    # (defaults to "") — the kernel mirrors that asymmetry
+    from openr_tpu.interop.compact import encode_struct
+    from openr_tpu.interop.openr_wire import PREFIX_DATABASE
+
+    compact_noname = encode_struct(PREFIX_DATABASE, {"deletePrefix": True})
+    want = deserialize_prefix_db(compact_noname)
+    assert want.delete_prefix is True  # scalar path accepts
+    status, _ = dec.decode([compact_noname])
+    assert status == [ST_DELETE]
+
+
+def test_compact_type_mismatch_falls_back(dec):
+    """A foreign encoder changing a scalar field's wire type (e.g.
+    forwardingType as binary) must fall back, never misdecode."""
+    from openr_tpu.interop.compact import encode_struct
+
+    # craft a PrefixEntry whose field 4 is a STRING (ct 8)
+    entry_spec = (
+        (1, "prefix", "struct", (
+            (1, "prefixAddress", "struct", ((1, "addr", "binary", None),)),
+            (2, "prefixLength", "i16", None),
+        )),
+        (4, "forwardingType", "string", None),
+    )
+    db_spec = (
+        (1, "thisNodeName", "string", None),
+        (3, "prefixEntries", "list", ("struct", entry_spec)),
+    )
+    payload = encode_struct(db_spec, {
+        "thisNodeName": "n",
+        "prefixEntries": [{
+            "prefix": {"prefixAddress": {"addr": b"\x0a\x00\x00\x00"},
+                       "prefixLength": 8},
+            "forwardingType": "XX",
+        }],
+    })
+    status, _ = dec.decode([payload])
+    assert status == [ST_FALLBACK]
+
+
+def test_unknown_enum_values_fall_back_like_scalar(dec):
+    """An out-of-range PrefixType/forwarding enum must not fast-decode
+    into a bare int — the scalar path raises and drops the row, so the
+    kernel defers to it (r5 review)."""
+    obj = json.loads(
+        serialize_prefix_db(
+            PrefixDatabase("n", [PrefixEntry("10.3.0.0/16")])
+        ).decode()
+    )
+    obj["prefix_entries"][0]["type"] = 99  # unknown PrefixType
+    status, entries = dec.decode([json.dumps(obj).encode()])
+    assert status == [ST_FALLBACK] and entries[0] is None
