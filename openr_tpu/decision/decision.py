@@ -122,6 +122,7 @@ class Decision(Actor):
         self._whatif_multi_engine = None
         self._whatif_native_engine = None
         self._whatif_generic_engine = None
+        self._whatif_device_build_engine = None
         self._whatif_rt_ms = None
         self._debounce = AsyncDebounce(
             self,
@@ -551,21 +552,6 @@ class Decision(Actor):
         )
         return solver.build_route_db(self.area_link_states, self.prefix_state)
 
-    def _query_has_link_bundle(self, link_failures) -> bool:
-        """True when any queried pair maps to MORE than one link across
-        the LSDB (parallel links, or the pair advertised in several
-        areas) — those fail as a set, which the multi-area kernel can't
-        express, so the query routes to the generic engine."""
-        counts: Dict = {}
-        for ls in self.area_link_states.values():
-            for link in ls.all_links():
-                k = frozenset((link.n1, link.n2))
-                counts[k] = counts.get(k, 0) + 1
-        return any(
-            counts.get(frozenset((n1, n2)), 0) > 1
-            for n1, n2 in link_failures
-        )
-
     def get_link_criticality(self, max_pairs: int = 0) -> Optional[dict]:
         """Blast-radius report: ONE device sweep failing EVERY link
         ranks links by withdrawn/changed routes; ``max_pairs`` > 0 adds
@@ -624,37 +610,29 @@ class Decision(Actor):
         warm-start sweep over the candidate failures (the flagship
         what-if machinery, cached per LSDB generation).  With
         ``simultaneous``, ALL listed links fail AT ONCE (maintenance-
-        window analysis).  Queries the fast engines decline (KSP2 /
-        unsupported algorithms, multi-area on scalar-only deployments,
-        multi-area simultaneous) fall back to the algorithm-complete
-        GenericSolverWhatIfEngine: full solver build minus the links,
-        diffed — slower, but every configuration answers.  None only
-        when there is no LSDB yet or a build overflows the candidate
+        window analysis).  Engine choice: single-area vantages pick
+        native-vs-device by measured dispatch RT; multi-area LSDBs run
+        the set-capable multi-area kernel (singles, bundles AND
+        simultaneous sets); KSP2/exotic-algorithm vantages run
+        device-backed full builds (DeviceBuildWhatIfEngine).  Only
+        scalar-only deployments beyond the native engine's reach fall
+        back to the jax-free GenericSolverWhatIfEngine.  None only when
+        there is no LSDB yet or a build overflows the candidate
         buckets."""
         scalar_only = isinstance(self.backend, ScalarBackend)
         fleet = self._fleet()
         if not self.area_link_states:
             return None
+        fleet_ok = fleet.eligible(
+            self.area_link_states, self.prefix_state, self._change_seq
+        )
         generic_reasons = (
-            # KSP2 / unsupported selection algorithm: only the full
-            # scalar solver implements it
-            not fleet.eligible(
-                self.area_link_states, self.prefix_state, self._change_seq
-            )
-            # the multi-area engine is device-only; a scalar deployment
-            # must never pull in the device stack
+            # KSP2 / unsupported selection algorithm on a SCALAR-ONLY
+            # deployment: only the jax-free full solver may serve it
+            (not fleet_ok and scalar_only)
+            # the multi-area engines are device-only; a scalar
+            # deployment must never pull in the device stack
             or (scalar_only and len(self.area_link_states) != 1)
-            # set-failure analysis: the multi-area kernel solves one
-            # masked link per snapshot — that also rules out bundles
-            # (parallel links / pairs spanning areas), which the other
-            # engines answer as sets
-            or (
-                len(self.area_link_states) != 1
-                and (
-                    simultaneous
-                    or self._query_has_link_bundle(link_failures)
-                )
-            )
         )
         if generic_reasons:
             # algorithm-complete fallback: rebuild the LSDB minus the
@@ -669,6 +647,29 @@ class Decision(Actor):
             )
             if result is not None:
                 self.counters.bump("decision.whatif.engine.generic")
+            return result
+        if not fleet_ok:
+            # KSP2 prefixes / exotic selection with a device backend:
+            # full builds minus the links on the DEVICE compute path
+            # (tables + device KSP2) — the same engines the daemon's
+            # own route builds use for these algorithms
+            if self._whatif_device_build_engine is None:
+                from openr_tpu.decision.whatif_api import (
+                    DeviceBuildWhatIfEngine,
+                )
+
+                self._whatif_device_build_engine = DeviceBuildWhatIfEngine(
+                    self.solver
+                )
+            result = self._whatif_device_build_engine.run(
+                [tuple(f) for f in link_failures],
+                self.area_link_states,
+                self.prefix_state,
+                self._change_seq,
+                simultaneous=simultaneous,
+            )
+            if result is not None:
+                self.counters.bump("decision.whatif.engine.device_build")
             return result
         if len(self.area_link_states) == 1:
             # single-area vantage: pick the warm-start engine by where

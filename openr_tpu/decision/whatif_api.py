@@ -8,24 +8,25 @@ metric-changed) decoded to neighbor names.  The engine (base solve +
 repair plan + selection tables) is cached per LSDB change generation,
 so an operator sweeping many links pays the setup once.
 
-Two device engines cover the eligible algorithms (the fleet-engine
-eligibility: SHORTEST_DISTANCE / PER_AREA_SHORTEST_DISTANCE, no KSP2):
+Three device engines cover the accelerated configurations:
 
   * ``WhatIfApiEngine`` — single-area vantage over the warm-start
     repair sweep + on-device selection (the fastest path).
   * ``MultiAreaWhatIfEngine`` — multi-area LSDBs over the fleet-family
     kernel (ops.fleet_tables.whatif_multi_area_tables): per snapshot
-    the failed link's area re-solves masked, selection is global, and
-    the cross-area min-metric merge happens in the host decode — the
-    same semantics the reference reaches scalar via getDecisionRouteDb
-    (Decision.cpp:342).
+    the failed SET of links (singles, parallel bundles, simultaneous
+    maintenance windows) is masked in each member's area, selection is
+    global, and the cross-area min-metric merge happens in the host
+    decode — the same semantics the reference reaches scalar via
+    getDecisionRouteDb (Decision.cpp:342).
+  * ``DeviceBuildWhatIfEngine`` — KSP2_ED_ECMP vantages / exotic
+    selection rules: full DEVICE builds (tables + the device KSP2
+    engine) minus the links, diffed.
 
-Anything else (KSP2 / unsupported algorithms, multi-area on scalar-only
-deployments, multi-area simultaneous sets) answers through
-``GenericSolverWhatIfEngine``: a full solver build with the links
-actually removed, diffed against the current routes — slow but
-algorithm-complete, so every configuration the daemon can run gets a
-what-if answer.
+Only scalar-only deployments outside the native engine's reach answer
+through ``GenericSolverWhatIfEngine``: a full scalar-solver build with
+the links actually removed — slow but jax-free and algorithm-complete,
+so every configuration the daemon can run gets a what-if answer.
 """
 
 from __future__ import annotations
@@ -224,6 +225,7 @@ class WhatIfApiEngine:
                 return {
                     "eligible": True,
                     "vantage": me,
+                    "engine": "device",
                     "simultaneous": True,
                     "failures": bad,
                 }
@@ -239,6 +241,7 @@ class WhatIfApiEngine:
             return {
                 "eligible": True,
                 "vantage": me,
+                "engine": "device",
                 "simultaneous": True,
                 "failures": [
                     {
@@ -281,7 +284,7 @@ class WhatIfApiEngine:
                 # the pair is a bundle (parallel links): ALL failed
                 entry["links_failed"] = len(tup)
             out.append(entry)
-        return {"eligible": True, "vantage": me, "failures": out}
+        return {"eligible": True, "vantage": me, "engine": "device", "failures": out}
 
 
 def _whatif_engine_criticality(
@@ -469,6 +472,7 @@ class MultiAreaWhatIfEngine:
         area_link_states,
         prefix_state,
         change_seq: int,
+        simultaneous: bool = False,
     ) -> Dict:
         import jax
         import jax.numpy as jnp
@@ -488,26 +492,51 @@ class MultiAreaWhatIfEngine:
         )
 
         # resolve candidate failures (shared semantics with the
-        # single-area engine)
+        # single-area engine); every value is a TUPLE of (area, link)
+        # hits — parallel bundles and simultaneous sets fail together
+        # (the kernel masks up to S links per snapshot)
         pairs, errors = resolve_pair_failures(
-            st["pair_links"], link_failures
+            st["pair_links"], link_failures, allow_parallel=True
         )
-        B = len(link_failures)
+        if simultaneous:
+            bad = [e for e in errors if e is not None]
+            if bad:
+                return {
+                    "eligible": True,
+                    "vantage": me,
+                    "engine": "multiarea",
+                    "simultaneous": True,
+                    "failures": bad,
+                }
+            # ONE snapshot failing the union of every listed link
+            union = tuple(
+                hit for tup in pairs if tup is not None for hit in tup
+            )
+            fail_sets: List[Optional[tuple]] = [union]
+        else:
+            fail_sets = pairs
+        B = len(fail_sets)
         from openr_tpu.ops.csr import bucket_for
 
         # pad the batch to a bucket STRICTLY larger than B so jit shapes
         # stay cache-stable across query sizes AND at least one -1 pad
         # row exists — that row solves the unperturbed topology and
         # doubles as the base snapshot (an explicit base row would cost
-        # the same as the padding the bucket already requires)
+        # the same as the padding the bucket already requires).  The set
+        # width S is bucketed too (most queries are single links: S=1).
         bucket = bucket_for(
             B + 1, FAILURE_BUCKETS + (max(B + 1, FAILURE_BUCKETS[-1]),)
         )
-        fa = np.full(bucket, -1, np.int32)
-        fl = np.full(bucket, -1, np.int32)
-        for i, hit in enumerate(pairs):
-            if hit is not None:
-                fa[i], fl[i] = hit
+        smax = max(
+            [len(tup) for tup in fail_sets if tup is not None] or [1]
+        )
+        S = bucket_for(smax, (1, 2, 4, 8, 16, 32, max(smax, 32)))
+        fa = np.full((bucket, S), -1, np.int32)
+        fl = np.full((bucket, S), -1, np.int32)
+        for i, tup in enumerate(fail_sets):
+            if tup is not None:
+                for s, (ai, li) in enumerate(tup):
+                    fa[i, s], fl[i, s] = ai, li
 
         kernel_args = dict(
             src=jnp.asarray(enc.src),
@@ -619,11 +648,7 @@ class MultiAreaWhatIfEngine:
                     return True
             return False
 
-        out = []
-        for s, ((n1, n2), hit) in enumerate(zip(link_failures, pairs)):
-            if hit is None:
-                out.append(errors[s])
-                continue
+        def changes_for(s) -> List[dict]:
             # changed prefixes: validity flipped, metric moved, or the
             # merged ECMP lane set moved
             diff = (route_ok[s] != route_ok[base]) | (
@@ -649,17 +674,57 @@ class MultiAreaWhatIfEngine:
                         "new_metric": float(m_star[s, p]) if now else None,
                     }
                 )
-            ai, li = hit
-            out.append(
-                {
-                    "link": [n1, n2],
-                    "area": enc.areas[ai],
-                    "on_shortest_path_dag": on_dag(ai, li),
-                    "routes_changed": len(changes),
-                    "changes": changes,
-                }
+            return changes
+
+        if simultaneous:
+            changes = changes_for(0)
+            any_on_dag = bool(
+                any(on_dag(ai, li) for ai, li in (fail_sets[0] or ()))
             )
-        return {"eligible": True, "vantage": me, "failures": out}
+            return {
+                "eligible": True,
+                "vantage": me,
+                "engine": "multiarea",
+                "simultaneous": True,
+                "failures": [
+                    {
+                        "links": [list(f) for f in link_failures],
+                        "on_shortest_path_dag": any_on_dag,
+                        "routes_changed": len(changes),
+                        "changes": changes,
+                    }
+                ],
+            }
+
+        out = []
+        for s, ((n1, n2), tup) in enumerate(zip(link_failures, pairs)):
+            if tup is None:
+                out.append(errors[s])
+                continue
+            changes = changes_for(s)
+            entry = {
+                "link": [n1, n2],
+                "area": enc.areas[tup[0][0]],
+                "on_shortest_path_dag": bool(
+                    any(on_dag(ai, li) for ai, li in tup)
+                ),
+                "routes_changed": len(changes),
+                "changes": changes,
+            }
+            if len(tup) > 1:
+                # parallel bundle (within or across areas): every member
+                # failed at once as one set
+                entry["links_failed"] = len(tup)
+                entry["areas"] = sorted(
+                    {enc.areas[ai] for ai, _ in tup}
+                )
+            out.append(entry)
+        return {
+            "eligible": True,
+            "vantage": me,
+            "engine": "multiarea",
+            "failures": out,
+        }
 
 
 class NativeWhatIfEngine:
@@ -818,6 +883,7 @@ class NativeWhatIfEngine:
                 return {
                     "eligible": True,
                     "vantage": me,
+                    "engine": "native",
                     "simultaneous": True,
                     "failures": bad,
                 }
@@ -836,6 +902,7 @@ class NativeWhatIfEngine:
             return {
                 "eligible": True,
                 "vantage": me,
+                "engine": "native",
                 "simultaneous": True,
                 "failures": [
                     {
@@ -875,7 +942,7 @@ class NativeWhatIfEngine:
             if len(tup) > 1:
                 entry["links_failed"] = len(tup)
             out.append(entry)
-        return {"eligible": True, "vantage": me, "failures": out}
+        return {"eligible": True, "vantage": me, "engine": "native", "failures": out}
 
 
 class GenericSolverWhatIfEngine:
@@ -895,12 +962,18 @@ class GenericSolverWhatIfEngine:
     algorithm; our fast engines cover the SHORTEST_DISTANCE family).
     """
 
+    engine_label = "generic-solver"
+
     def __init__(self, solver) -> None:
         self.solver = solver
         self.num_builds = 0
         self._cache_key = None
         self._base_view = None
         self._pair_links: Dict = {}
+
+    def _build(self, states, prefix_state):
+        """One full route build; subclasses swap the compute engine."""
+        return self.solver.build_route_db(states, prefix_state)
 
     @staticmethod
     def _pairs_map(area_link_states) -> Dict:
@@ -969,9 +1042,7 @@ class GenericSolverWhatIfEngine:
             ),
         )
         if self._cache_key != key:
-            base = self.solver.build_route_db(
-                area_link_states, prefix_state
-            )
+            base = self._build(area_link_states, prefix_state)
             self.num_builds += 1
             if base is None:
                 return None  # no vantage in the LSDB yet -> ineligible
@@ -1012,9 +1083,7 @@ class GenericSolverWhatIfEngine:
         def solve_without(drop_pairs) -> List[dict]:
             mod = self._states_without(area_link_states, drop_pairs)
             self.num_builds += 1
-            return diff_against(
-                self.solver.build_route_db(mod, prefix_state)
-            )
+            return diff_against(self._build(mod, prefix_state))
 
         if simultaneous:
             bad = [e for e in errors if e is not None]
@@ -1022,7 +1091,7 @@ class GenericSolverWhatIfEngine:
                 return {
                     "eligible": True,
                     "vantage": me,
-                    "engine": "generic-solver",
+                    "engine": self.engine_label,
                     "simultaneous": True,
                     "failures": bad,
                 }
@@ -1032,7 +1101,7 @@ class GenericSolverWhatIfEngine:
             return {
                 "eligible": True,
                 "vantage": me,
-                "engine": "generic-solver",
+                "engine": self.engine_label,
                 "simultaneous": True,
                 "failures": [
                     {
@@ -1064,6 +1133,46 @@ class GenericSolverWhatIfEngine:
         return {
             "eligible": True,
             "vantage": me,
-            "engine": "generic-solver",
+            "engine": self.engine_label,
             "failures": out,
         }
+
+
+class DeviceBuildWhatIfEngine(GenericSolverWhatIfEngine):
+    """What-if for configurations OUTSIDE the sweep kernels' algebra —
+    KSP2_ED_ECMP prefixes in the LSDB, exotic selection rules — served
+    by DEVICE full builds instead of the scalar solver.
+
+    Same structure as the generic fallback (rebuild the LSDB minus the
+    candidate links, diff), but each build runs through a dedicated
+    TpuBackend: SPF + selection tables on device and KSP2 prefixes on
+    the device KSP2 engine (decision/ksp2.py) — the identical compute
+    path the daemon's own route builds use for these algorithms, so
+    parity with installed routes is by construction.  O(failures)
+    device builds rather than the O(1) sweep, but every build after the
+    first reuses warm jit shapes; at reference scale that is orders of
+    magnitude faster than the per-failure scalar build (the reference
+    solves any-algorithm what-ifs scalar via getDecisionRouteDb,
+    Decision.cpp:342 — this is that surface, accelerated).
+
+    Builds that the backend itself declines (unsupported selection
+    algorithm) transparently run scalar inside TpuBackend — answers
+    never differ from GenericSolverWhatIfEngine, only their speed.
+    """
+
+    engine_label = "device-build"
+
+    def __init__(self, solver) -> None:
+        super().__init__(solver)
+        from openr_tpu.decision.backend import TpuBackend
+
+        #: dedicated backend: what-if builds on modified topologies must
+        #: never pollute the daemon backend's encoding/table caches.
+        #: min_device_prefixes=0 pins always-device (deterministic)
+        #: explicitly rather than relying on the constructor default.
+        self._backend = TpuBackend(solver, min_device_prefixes=0)
+
+    def _build(self, states, prefix_state):
+        return self._backend.build_route_db(
+            states, prefix_state, force_full=True, cache_result=False
+        )

@@ -95,8 +95,8 @@ def whatif_multi_area_tables(
     overloaded,  # [A, V]
     soft,  # [A, V]
     roots,  # [A] my id per area (me is interned into every area)
-    fail_area,  # [B] int32 area index of the failed link (-1 = none)
-    fail_link,  # [B] int32 link id within that area
+    fail_area,  # [B, S] int32 area index per failed link (-1 = none)
+    fail_link,  # [B, S] int32 link id within that area
     cand_area,  # [P, C]
     cand_node,  # [P, C]
     cand_ok,  # [P, C]
@@ -110,9 +110,11 @@ def whatif_multi_area_tables(
 ):
     """Multi-area link-failure what-if from ONE vantage (me): the batch
     axis is candidate failures instead of fleet roots — per snapshot the
-    failed link's area is re-solved with that link masked, every other
-    area solves unperturbed, and the GLOBAL selection chain runs
-    per snapshot.  This is the multi-area generalization the operator
+    failed SET of links (up to S, -1-padded; S=1 covers the single-link
+    query, larger S serves simultaneous maintenance-window sets and
+    parallel bundles) is masked in each member's own area, every other
+    area solves unperturbed, and the GLOBAL selection chain runs per
+    snapshot.  This is the multi-area generalization the operator
     what-if API needs (the reference computes any-algorithm/any-area
     what-ifs scalar via getDecisionRouteDb, Decision.cpp:342).
 
@@ -126,11 +128,15 @@ def whatif_multi_area_tables(
     A = src.shape[0]
 
     def one(fa, fl):
+        # fa, fl: [S] — OR of the S per-link masks, [A, E]
         masked = (
-            (jnp.arange(A, dtype=jnp.int32)[:, None] == fa)
-            & (link_index == fl)
-            & (fl >= 0)
-        )
+            (
+                jnp.arange(A, dtype=jnp.int32)[None, :, None]
+                == fa[:, None, None]
+            )
+            & (link_index[None] == fl[:, None, None])
+            & (fl[:, None, None] >= 0)
+        ).any(axis=0)
         dist, nh = multi_area_spf_tables(
             src,
             dst,

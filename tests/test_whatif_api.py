@@ -209,6 +209,8 @@ def test_native_engine_matches_device_engine():
 
     dev = WhatIfApiEngine(SpfSolver("node0")).run(failures, als, ps, 1)
     nat = NativeWhatIfEngine(SpfSolver("node0")).run(failures, als, ps, 1)
+    # engines self-identify; everything else must be byte-identical
+    assert nat.pop("engine") == "native" and dev.pop("engine") == "device"
     assert nat == dev
 
 
@@ -296,6 +298,8 @@ def test_native_vs_device_engines_random_worlds(seed):
     failures = [(l.n1, l.n2) for l in topo.links]
     dev = WhatIfApiEngine(SpfSolver("node0")).run(failures, als, ps, 1)
     nat = NativeWhatIfEngine(SpfSolver("node0")).run(failures, als, ps, 1)
+    # engines self-identify; everything else must be byte-identical
+    assert nat.pop("engine") == "native" and dev.pop("engine") == "device"
     assert nat == dev
 
 
@@ -429,16 +433,16 @@ def test_whatif_simultaneous_unknown_link_errors():
 
 
 def test_whatif_simultaneous_multiarea_uses_generic_engine():
-    """Set-failure analysis on a multi-area vantage (the fast engines
-    decline it) answers through the algorithm-complete generic solver
-    fallback instead of reporting ineligible."""
+    """Set-failure analysis on a multi-area vantage runs on the
+    multi-area DEVICE kernel since r5 (per-snapshot failure SETS are
+    masked on device); parity vs the scalar oracle is asserted."""
     d, dbs = build_decision()
     d.area_link_states["1"] = LinkState("1")
     resp = d.get_link_failure_whatif(
         [["node0", "node1"], ["node5", "node6"]], simultaneous=True
     )
     assert resp is not None and resp["eligible"]
-    assert resp["engine"] == "generic-solver"
+    assert resp["engine"] == "multiarea"
     (f,) = resp["failures"]
     # parity vs the scalar oracle with both links removed
     base_view = routes_view(

@@ -328,11 +328,10 @@ def test_generic_fallback_ksp2_answers():
 
 
 def test_multiarea_cross_area_pair_routes_to_generic_engine():
-    """A pair whose links span areas (or are parallel) can't be failed
-    by the multi-area kernel's one-masked-link snapshots; the query must
-    route to the generic engine and fail the whole bundle (code-review
-    r4: previously this errored on one deployment shape and answered on
-    others)."""
+    """A pair whose links span areas (or are parallel) fails as a SET on
+    the multi-area device kernel since r5 (per-snapshot multi-link
+    masks); previously the query fell back to the generic scalar
+    engine.  Whole-bundle semantics and oracle parity are pinned."""
     from openr_tpu.common.runtime import SimClock
     from openr_tpu.config import DecisionConfig
     from openr_tpu.decision.backend import TpuBackend
@@ -361,7 +360,7 @@ def test_multiarea_cross_area_pair_routes_to_generic_engine():
     d._change_seq = 9
     resp = d.get_link_failure_whatif([("a1", "b0")])
     assert resp is not None and resp["eligible"]
-    assert resp["engine"] == "generic-solver"
+    assert resp["engine"] == "multiarea"
     (f,) = resp["failures"]
     assert f["links_failed"] == 2
     # oracle: remove the pair everywhere
@@ -383,3 +382,73 @@ def test_multiarea_cross_area_pair_routes_to_generic_engine():
         p for p in set(base) | set(want) if base.get(p) != want.get(p)
     }
     assert {c["prefix"] for c in f["changes"]} == changed
+
+
+def test_ksp2_vantage_uses_device_build_engine():
+    """KSP2_ED_ECMP vantages on a DEVICE deployment answer through the
+    device-build what-if engine since r5 (full device builds minus the
+    links — tables + device KSP2), not the scalar generic fallback; the
+    diff must match the scalar KSP2 oracle exactly."""
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.types import PrefixForwardingAlgorithm
+
+    me = "b0"
+    ps = PrefixState()
+    ps.update_prefix(
+        "b2",
+        "2",
+        PrefixEntry(
+            "10.1.0.0/24",
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        ),
+    )
+    ps.update_prefix("a2", "1", PrefixEntry("10.2.0.0/24"))
+    solver = SpfSolver(me)
+    d = Decision(
+        me,
+        SimClock(),
+        DecisionConfig(),
+        ReplicateQueue(),
+        backend=TpuBackend(solver),
+        solver=solver,
+    )
+    d.area_link_states = two_area_world(me)
+    d.prefix_state = ps
+    d._change_seq = 7
+    res = d.get_link_failure_whatif([("b0", "b1")])
+    assert res is not None and res["eligible"]
+    assert res["engine"] == "device-build"
+    assert d.counters.get("decision.whatif.engine.device_build") == 1
+    (f,) = res["failures"]
+    # oracle: scalar full build with the link removed (KSP2 included)
+    base = oracle_view(me, two_area_world(me), ps)
+    want = _oracle_view_without(me, ps, {frozenset(("b0", "b1"))})
+    changed = {
+        p for p in set(base) | set(want) if base.get(p) != want.get(p)
+    }
+    assert {c["prefix"] for c in f["changes"]} == changed
+    for c in f["changes"]:
+        p = c["prefix"]
+        if want.get(p):
+            assert sorted(c["new_nexthops"]) == sorted(want[p][1]), c
+            assert c["new_metric"] == want[p][0], c
+
+    # simultaneous sets run on the same engine
+    res2 = d.get_link_failure_whatif(
+        [("b0", "b1"), ("a1", "b0")], simultaneous=True
+    )
+    assert res2["engine"] == "device-build"
+    (f2,) = res2["failures"]
+    want2 = _oracle_view_without(
+        me, ps, {frozenset(("b0", "b1")), frozenset(("a1", "b0"))}
+    )
+    changed2 = {
+        p
+        for p in set(base) | set(want2)
+        if base.get(p) != want2.get(p)
+    }
+    assert {c["prefix"] for c in f2["changes"]} == changed2
