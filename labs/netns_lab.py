@@ -64,6 +64,18 @@ def topology_edges(kind: str, n: int) -> List[Tuple[int, int]]:
         return [(i, (i + 1) % n) for i in range(n)]
     if kind == "full":
         return [(i, j) for i in range(n) for j in range(i + 1, n)]
+    if kind == "grid":
+        # cols=8 grid (redundant paths -> survives chaos churn); the
+        # 32-node lab is 8x4
+        cols = 8
+        out = []
+        for i in range(n):
+            r, c = divmod(i, cols)
+            if c + 1 < cols and i + 1 < n:
+                out.append((i, i + 1))
+            if (r + 1) * cols + c < n:
+                out.append((i, i + cols))
+        return out
     if kind == "multiarea":
         # two pods + spine (reference labs 201/202 shape):
         #   pod1: 0-1-2-3   spine: 3-4   pod2: 4-5-6-7
@@ -265,6 +277,26 @@ class NetnsLab:
             proc.wait(timeout=5)
 
     # -- observation ---------------------------------------------------------
+
+    def link_ifaces(self, a: int, b: int) -> Tuple[str, str]:
+        """(iface in a's ns, iface in b's ns) for edge (a, b)."""
+        if a > b:
+            a, b = b, a
+        return f"ve{a}_{b}", f"ve{b}_{a}"
+
+    def fail_link(self, a: int, b: int) -> None:
+        """Take the veth down on BOTH ends (kernel carrier loss — Spark
+        hold timers + LinkMonitor netlink events drive reconvergence)."""
+        va, vb = self.link_ifaces(a, b)
+        lo, hi = (a, b) if a < b else (b, a)
+        in_ns(self.ns_name(lo), f"ip link set {va} down")
+        in_ns(self.ns_name(hi), f"ip link set {vb} down")
+
+    def heal_link(self, a: int, b: int) -> None:
+        va, vb = self.link_ifaces(a, b)
+        lo, hi = (a, b) if a < b else (b, a)
+        in_ns(self.ns_name(lo), f"ip link set {va} up")
+        in_ns(self.ns_name(hi), f"ip link set {vb} up")
 
     def kernel_routes(self, i: int) -> List[str]:
         out = in_ns(

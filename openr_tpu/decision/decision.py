@@ -746,7 +746,21 @@ class Decision(Actor):
             return result
         except ValueError:
             # e.g. an anycast prefix wider than the largest candidate
-            # bucket — ineligible, not an RPC error
+            # bucket.  Multi-area queries previously ANSWERED such
+            # configurations through the generic scalar engine — keep
+            # that: a device-table overflow must not downgrade a
+            # formerly-answerable query to ineligible (r5 review).
+            if engine_name == "multiarea":
+                result = self._generic_whatif().run(
+                    [tuple(f) for f in link_failures],
+                    self.area_link_states,
+                    self.prefix_state,
+                    self._change_seq,
+                    simultaneous=simultaneous,
+                )
+                if result is not None:
+                    self.counters.bump("decision.whatif.engine.generic")
+                return result
             return None
 
     def get_decision_paths(

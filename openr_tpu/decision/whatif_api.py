@@ -413,8 +413,13 @@ class MultiAreaWhatIfEngine:
     failures plus one base snapshot as a single device batch and decodes
     only the prefixes whose merged route view changed."""
 
-    def __init__(self, solver: SpfSolver) -> None:
+    def __init__(self, solver: SpfSolver, mesh=None) -> None:
+        """``mesh``: optional ``jax.sharding.Mesh`` with a ``batch``
+        axis — failure snapshots then shard across the mesh
+        (ops.fleet_tables.sharded_whatif_tables), bit-identical to the
+        unsharded kernel."""
         self.solver = solver
+        self.mesh = mesh
         self._cache_key = None
         self._state = None
         self.num_engine_builds = 0
@@ -527,6 +532,10 @@ class MultiAreaWhatIfEngine:
         bucket = bucket_for(
             B + 1, FAILURE_BUCKETS + (max(B + 1, FAILURE_BUCKETS[-1]),)
         )
+        if self.mesh is not None:
+            # sharded dispatch splits the failure batch across devices
+            gran = self.mesh.devices.size
+            bucket = ((bucket + gran - 1) // gran) * gran
         smax = max(
             [len(tup) for tup in fail_sets if tup is not None] or [1]
         )
@@ -550,24 +559,50 @@ class MultiAreaWhatIfEngine:
         )
         from openr_tpu.ops.jit_guard import call_jit_guarded
 
-        use, shortest, lanes, valid = jax.device_get(
-            call_jit_guarded(
-                whatif_multi_area_tables,
-                fail_area=jnp.asarray(fa),
-                fail_link=jnp.asarray(fl),
-                cand_area=jnp.asarray(dv.cand_area),
-                cand_node=jnp.asarray(dv.cand_node),
-                cand_ok=jnp.asarray(dv.cand_ok),
-                drain_metric=jnp.asarray(dv.drain_metric),
-                path_pref=jnp.asarray(dv.path_pref),
-                source_pref=jnp.asarray(dv.source_pref),
-                distance=jnp.asarray(dv.distance),
-                cand_node_in_area=jnp.asarray(dv.cand_node_in_area),
-                max_degree=st["D"],
-                per_area_distance=per_area,
-                **kernel_args,
-            )
+        cand_args = dict(
+            cand_area=jnp.asarray(dv.cand_area),
+            cand_node=jnp.asarray(dv.cand_node),
+            cand_ok=jnp.asarray(dv.cand_ok),
+            drain_metric=jnp.asarray(dv.drain_metric),
+            path_pref=jnp.asarray(dv.path_pref),
+            source_pref=jnp.asarray(dv.source_pref),
+            distance=jnp.asarray(dv.distance),
+            cand_node_in_area=jnp.asarray(dv.cand_node_in_area),
         )
+        if self.mesh is not None:
+            from openr_tpu.ops.fleet_tables import sharded_whatif_tables
+            from openr_tpu.parallel.mesh import batch_sharding, replicated
+
+            rep = replicated(self.mesh)
+            bat = batch_sharding(self.mesh)
+            fn = sharded_whatif_tables(self.mesh, st["D"], per_area)
+            use, shortest, lanes, valid = jax.device_get(
+                call_jit_guarded(
+                    fn,
+                    *(
+                        jax.device_put(v, rep)
+                        for v in kernel_args.values()
+                    ),
+                    jax.device_put(jnp.asarray(fa), bat),
+                    jax.device_put(jnp.asarray(fl), bat),
+                    *(
+                        jax.device_put(v, rep)
+                        for v in cand_args.values()
+                    ),
+                )
+            )
+        else:
+            use, shortest, lanes, valid = jax.device_get(
+                call_jit_guarded(
+                    whatif_multi_area_tables,
+                    fail_area=jnp.asarray(fa),
+                    fail_link=jnp.asarray(fl),
+                    max_degree=st["D"],
+                    per_area_distance=per_area,
+                    **kernel_args,
+                    **cand_args,
+                )
+            )
         if st["base_dist"] is None:
             dist, _nh = multi_area_spf_tables(
                 kernel_args["src"],

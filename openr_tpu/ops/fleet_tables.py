@@ -212,3 +212,50 @@ def sharded_fleet_tables(mesh, max_degree: int, per_area_distance: bool):
     )
     _sharded_cache[key] = fn
     return fn
+
+
+_sharded_whatif_cache: dict = {}
+
+
+def sharded_whatif_tables(mesh, max_degree: int, per_area_distance: bool):
+    """Failure-batch-sharded multi-area what-if kernel over a device
+    mesh: each failure snapshot (a SET of masked links) is an
+    independent solve, so the batch axis shards with no collectives —
+    topology, candidate tables and link maps replicate.  The failure
+    bucket must be a multiple of the mesh size.  Bit-identical to
+    ``whatif_multi_area_tables``."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from openr_tpu.parallel.mesh import BATCH_AXIS
+
+    key = (mesh, max_degree, per_area_distance)
+    if key in _sharded_whatif_cache:
+        return _sharded_whatif_cache[key]
+    rep = P()
+    bat = P(BATCH_AXIS)
+    body = functools.partial(
+        whatif_multi_area_tables.__wrapped__,
+        max_degree=max_degree,
+        per_area_distance=per_area_distance,
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            # src dst w edge_ok link_index overloaded soft roots |
+            # fail_area fail_link | 8 candidate tables
+            in_specs=(*([rep] * 8), P(BATCH_AXIS, None), P(BATCH_AXIS, None),
+                      *([rep] * 8)),
+            out_specs=(
+                P(BATCH_AXIS, None, None),  # use [B, P, C]
+                P(BATCH_AXIS, None, None),  # shortest [B, P, A]
+                P(BATCH_AXIS, None, None, None),  # lanes [B, P, A, D]
+                P(BATCH_AXIS, None, None),  # valid [B, P, A]
+            ),
+            check_vma=False,
+        )
+    )
+    _sharded_whatif_cache[key] = fn
+    return fn

@@ -50,6 +50,14 @@ def enable_persistent_compile_cache() -> None:
     path = os.environ.get("OPENR_TPU_COMPILE_CACHE", "")
     if path.lower() == "off":
         return
+    if not path and "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        # virtual-device CPU test mode: executables cached by one
+        # XLA:CPU build can warn (or worse, SIGILL) when reloaded under
+        # different host-feature assumptions, and test runs don't need
+        # boot-time amortization — opt in explicitly via the env var
+        return
     if not path:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))

@@ -138,3 +138,44 @@ def test_sharded_multi_chunk_sweep_parity(world):
     assert np.array_equal(r1.snap_row, r8.snap_row)
     assert np.array_equal(r1.dist, r8.dist)
     assert np.array_equal(r1.nh, r8.nh)
+
+
+def test_sharded_multiarea_whatif_engine_parity():
+    """MultiAreaWhatIfEngine(mesh=...) must return the IDENTICAL result
+    dict as the unsharded engine — singles, parallel bundles, and a
+    simultaneous set all ride the failure-batch-sharded kernel
+    (ops.fleet_tables.sharded_whatif_tables)."""
+    import dataclasses
+
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.decision.whatif_api import MultiAreaWhatIfEngine
+    from openr_tpu.emulation.topology import ring_edges
+
+    me = "a0"
+
+    def make_ls(area, edges):
+        ls = LinkState(area, me)
+        for db in build_adj_dbs(edges).values():
+            ls.update_adjacency_database(dataclasses.replace(db, area=area))
+        return ls
+
+    als = {
+        "1": make_ls("1", ring_edges(5, prefix="a")),
+        "2": make_ls("2", [("a0", "b0", 1), ("b0", "b1", 1),
+                           ("b1", "b2", 1), ("a0", "b2", 4)]),
+    }
+    ps = PrefixState()
+    for node, area in (("a2", "1"), ("a3", "1"), ("b1", "2"), ("b2", "2")):
+        ps.update_prefix(node, area, PrefixEntry(f"10.{ord(node[0])}.{node[1]}.0/24"))
+    queries = [
+        ([("a0", "a1"), ("b0", "b1"), ("a2", "a3")], False),
+        ([("a0", "a1"), ("b1", "b2")], True),  # simultaneous set
+    ]
+    for failures, sim in queries:
+        r1 = MultiAreaWhatIfEngine(SpfSolver(me)).run(
+            failures, als, ps, 1, simultaneous=sim
+        )
+        r8 = MultiAreaWhatIfEngine(SpfSolver(me), mesh=_mesh(8)).run(
+            failures, als, ps, 1, simultaneous=sim
+        )
+        assert r1 == r8, (sim, r1, r8)

@@ -166,3 +166,65 @@ def test_rocket_transport_lab_converges():
         counters = _json.loads(out)
         assert counters.get("ctrl.rocket.getKvStoreKeyValsFilteredArea", 0) >= 1, counters
         assert counters.get("ctrl.rocket.setKvStoreKeyVals", 0) >= 1, counters
+
+
+def test_32_node_grid_lab_chaos_churn():
+    """32 REAL daemons in kernel namespaces (8x4 grid) — 4x the prior
+    lab scale, toward the reference's 1000-node emulation practice
+    (DeveloperGuide.md:51) — surviving randomized link churn driven at
+    the KERNEL level (veth carrier down/up -> netlink events ->
+    LinkMonitor -> reflood -> reroute), the netns analogue of the
+    in-process chaos test.  After every round and after healing all,
+    every kernel must hold proto-99 routes to every other node's
+    prefix.  The grid guarantees alternate paths around any single
+    failed link."""
+    import random
+
+    from labs.netns_lab import topology_edges
+
+    rng = random.Random(42)
+    lab = NetnsLab(num_nodes=32, topology="grid")
+    edges = topology_edges("grid", 32)
+    with lab:
+        lab.wait_converged(timeout_s=600)
+        def connected_without(down):
+            """BFS over surviving edges — the churn driver only commits
+            cuts that keep the fabric connected, making the every-pair
+            reachability invariant structural rather than seed luck."""
+            adj = {}
+            for x, y in edges:
+                if (x, y) in down:
+                    continue
+                adj.setdefault(x, []).append(y)
+                adj.setdefault(y, []).append(x)
+            seen, stack = {0}, [0]
+            while stack:
+                for nxt in adj.get(stack.pop(), []):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            return len(seen) == 32
+
+        failed = set()
+        for _ in range(5):
+            # fail up to 2 new links; heal one previously failed
+            for a, b in rng.sample(edges, 2):
+                if (a, b) not in failed and connected_without(
+                    failed | {(a, b)}
+                ):
+                    lab.fail_link(a, b)
+                    failed.add((a, b))
+            if failed and rng.random() < 0.7:
+                pair = rng.choice(sorted(failed))
+                lab.heal_link(*pair)
+                failed.discard(pair)
+            # the grid is 2-edge-connected for these cuts; every node
+            # pair must stay mutually reachable
+            lab.wait_converged(timeout_s=240)
+        for pair in sorted(failed):
+            lab.heal_link(*pair)
+        lab.wait_converged(timeout_s=240)
+        # spot-check the operator invariant checker on three nodes
+        for i in (0, 15, 31):
+            out = lab.breeze(i, "openr", "validate")
+            assert "FAIL" not in out, (i, out)

@@ -354,3 +354,40 @@ def test_chaos_random_link_churn_reconverges():
         await net.stop()
 
     run(main())
+
+
+def test_very_large_grid_256_nodes_slo():
+    """256 in-process nodes (16x16 grid) — an order of magnitude over
+    the 64-node standing point, toward the reference's 1000-node
+    emulation practice (DeveloperGuide.md:51).  SLO-asserted: the COLD
+    START of the whole fabric must reach full-mesh convergence within
+    10 s of VIRTUAL time (the reference's system tests assert <=3 s on
+    2-4 nodes, OpenrSystemTest.cpp:38; discovery staggering dominates
+    at this scale), and a central link failure must reconverge within a
+    further 5 s virtual.  Wall-clock is budgeted so a CI regression in
+    emulation throughput fails loudly instead of timing out the suite."""
+    import time as _time
+
+    async def main():
+        t0 = _time.perf_counter()
+        clock = SimClock()
+        net = EmulatedNetwork(clock)
+        net.build(grid_edges(16))
+        net.start()
+        await clock.run_for(10.0)  # the SLO window
+        ok, why = net.converged_full_mesh()
+        assert ok, f"256-node cold start missed the 10s-virtual SLO: {why}"
+        # central link failure: reroute within 5s virtual
+        net.fail_link("node119", "node120")
+        await clock.run_for(5.0)
+        ok, why = net.converged_full_mesh()
+        assert ok, f"reconvergence missed the 5s-virtual SLO: {why}"
+        nhs = net.fib_routes("node119")[net.loopback("node120")]
+        assert nhs and "node120" not in nhs, nhs
+        await net.stop()
+        wall = _time.perf_counter() - t0
+        # generous for a loaded single-core CI host; catches order-of-
+        # magnitude emulation-throughput regressions
+        assert wall < 600, f"256-node emulation took {wall:.0f}s wall"
+
+    run(main())

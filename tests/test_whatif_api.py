@@ -432,7 +432,7 @@ def test_whatif_simultaneous_unknown_link_errors():
     assert resp["failures"][0]["error"] == "unknown link"
 
 
-def test_whatif_simultaneous_multiarea_uses_generic_engine():
+def test_whatif_simultaneous_multiarea_uses_device_kernel():
     """Set-failure analysis on a multi-area vantage runs on the
     multi-area DEVICE kernel since r5 (per-snapshot failure SETS are
     masked on device); parity vs the scalar oracle is asserted."""
