@@ -52,6 +52,48 @@ NATIVE_REPS = 5
 DEVICE_REPS = 3
 
 
+def env_stamp() -> dict:
+    """Host/chip environment recorded into every bench artifact: the
+    native denominator swings ~2x across machine-days (r4 review weak
+    #3), so cross-round ratios are only comparable with the environment
+    pinned alongside them."""
+    import os
+    import platform
+
+    cpu_model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    cpu_model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    governor = ""
+    try:
+        with open(
+            "/sys/devices/system/cpu/cpu0/cpufreq/scaling_governor"
+        ) as f:
+            governor = f.read().strip()
+    except OSError:
+        pass
+    try:
+        load1, load5, _ = os.getloadavg()
+    except OSError:
+        load1 = load5 = -1.0
+    import jax
+
+    return {
+        "cpu_model": cpu_model,
+        "cpu_count": os.cpu_count(),
+        "cpu_governor": governor,
+        "loadavg_1m": round(load1, 2),
+        "loadavg_5m": round(load5, 2),
+        "python": platform.python_version(),
+        "jax": jax.__version__,
+    }
+
+
 def main() -> None:
     t_start = time.time()
     from openr_tpu.ops.platform_env import (
@@ -308,26 +350,43 @@ def main() -> None:
     # (the continuous-what-if-service shape; device compute per sweep is
     # single-digit ms, so without overlap the tunnel latency IS the
     # pipeline floor)
-    PIPELINE_DEPTH = 4
-    e2e_reps = 12
-    t0 = time.perf_counter()
-    pend = []
-    for _ in range(e2e_reps):
-        sw = eng.run(fails, fetch=False)
-        pend.append(sel.start(sw))
-        if len(pend) >= PIPELINE_DEPTH:
-            deltas = pend.pop(0).finish()
-    while pend:
-        deltas = pend.pop(0).finish()
-    e2e_sps = e2e_reps * total / (time.perf_counter() - t0)
-
     # the two end-to-end pipelines must find the IDENTICAL delta count —
     # computed independently (C++ sweep + numpy select vs device repair
-    # kernel + on-device select + fused compaction)
+    # kernel + on-device select + fused compaction); asserted on the
+    # same failure set the native engine ran
     assert int(deltas.num_deltas) == native_route_deltas, (
         deltas.num_deltas,
         native_route_deltas,
     )
+    # steady-state reps use FRESH random failure sets each (r4 review
+    # weak #5: one reused set flatters caching; the 3-minute soak's
+    # honest fresh-sets number now IS the committed headline's shape)
+    PIPELINE_DEPTH = 4
+    e2e_reps = 12
+    rng_reps = np.random.default_rng(20260730)
+    # rep 0 re-runs the native engine's failure set so the ASYNC
+    # (copy_to_host_async) pipeline path stays correctness-validated
+    # against the native delta count, not just the synchronous run
+    rep_fails = [fails] + [
+        rng_reps.integers(0, len(topo.links), size=total).astype(np.int32)
+        for _ in range(e2e_reps - 1)
+    ]
+    t0 = time.perf_counter()
+    pend = []
+    finished = []
+    for r in range(e2e_reps):
+        sw = eng.run(rep_fails[r], fetch=False)
+        pend.append(sel.start(sw))
+        if len(pend) >= PIPELINE_DEPTH:
+            finished.append(pend.pop(0).finish())
+    while pend:
+        finished.append(pend.pop(0).finish())
+    e2e_sps = e2e_reps * total / (time.perf_counter() - t0)
+    assert int(finished[0].num_deltas) == native_route_deltas, (
+        finished[0].num_deltas,
+        native_route_deltas,
+    )
+    assert all(int(d.num_deltas) >= 0 for d in finished)
 
     # route parity vs native for sample snapshots (base + changed rows)
     for s in (3, 1007, 9000):
@@ -439,6 +498,8 @@ def main() -> None:
                     "lanes": eng.D,
                     "mesh_devices": int(mesh.devices.size),
                     "devices": [str(d) for d in jax.devices()],
+                    "env": env_stamp(),
+                    "fresh_failure_sets_per_rep": True,
                     "wall_s": round(time.time() - t_start, 1),
                 },
             }

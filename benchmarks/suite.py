@@ -1054,12 +1054,15 @@ def main() -> None:
         bench(results, args.full)
     import jax
 
+    from bench import env_stamp
+
     with open(args.json, "w") as f:
         json.dump(
             {
                 # the platform stamp keeps CPU smoke runs from being
                 # mistaken for device measurements
                 "devices": [str(d) for d in jax.devices()],
+                "env": env_stamp(),
                 "full": args.full,
                 "results": results,
                 "wall_s": round(time.time() - t0, 1),
