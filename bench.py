@@ -94,6 +94,29 @@ def env_stamp() -> dict:
     }
 
 
+def build_headline_world(n_nodes: int = 1024):
+    """The benchmark's canonical world: 1024-node WAN, 2048 undirected
+    links, seed 7, one loopback prefix per node.  Shared with
+    benchmarks/soak.py so the soak can never silently measure a
+    different workload than the headline it pins (r5 review).
+    Returns (link_state, topo, cands)."""
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.emulation.topology import (
+        build_adj_dbs,
+        random_connected_edges,
+    )
+    from openr_tpu.ops.csr import encode_link_state
+    from openr_tpu.ops.sweep_select import SweepCandidates
+
+    edges = random_connected_edges(n_nodes, 2 * n_nodes, seed=7)
+    ls = LinkState("0")
+    for db in build_adj_dbs(edges).values():
+        ls.update_adjacency_database(db)
+    topo = encode_link_state(ls)
+    cands = SweepCandidates.single_advertiser(np.arange(n_nodes))
+    return ls, topo, cands
+
+
 def main() -> None:
     t_start = time.time()
     from openr_tpu.ops.platform_env import (
@@ -103,9 +126,6 @@ def main() -> None:
 
     honor_cpu_platform_request()
     enable_persistent_compile_cache()
-    from openr_tpu.decision.link_state import LinkState
-    from openr_tpu.emulation.topology import build_adj_dbs, random_connected_edges
-    from openr_tpu.ops.csr import encode_link_state
     from openr_tpu.ops.native_spf import NativeSpf
     from openr_tpu.ops.whatif import LinkFailureSweep
 
@@ -114,11 +134,7 @@ def main() -> None:
     # ---- the 1024-node WAN + 10,240 perturbations ------------------------
     n_nodes = 1024
     total = 10_240
-    edges = random_connected_edges(n_nodes, 2048, seed=7)
-    ls = LinkState("0")
-    for db in build_adj_dbs(edges).values():
-        ls.update_adjacency_database(db)
-    topo = encode_link_state(ls)
+    ls, topo, cands = build_headline_world(n_nodes)
     rng = np.random.default_rng(0)
     fails = rng.integers(0, len(topo.links), size=total).astype(np.int32)
 
@@ -160,7 +176,6 @@ def main() -> None:
     from openr_tpu.ops.sweep_select import SweepCandidates
     from openr_tpu.ops.whatif import root_lane_count
 
-    cands = SweepCandidates.single_advertiser(np.arange(n_nodes))
     sel_args_np = (
         cands.cand_node,
         cands.cand_ok,
@@ -386,7 +401,11 @@ def main() -> None:
         finished[0].num_deltas,
         native_route_deltas,
     )
-    assert all(int(d.num_deltas) >= 0 for d in finished)
+    # sanity on every fresh-set rep: a 10k random sweep of this world
+    # always changes SOME routes, and can never exceed the full table
+    assert all(
+        0 < int(d.num_deltas) <= total * n_nodes for d in finished
+    ), [int(d.num_deltas) for d in finished]
 
     # route parity vs native for sample snapshots (base + changed rows)
     for s in (3, 1007, 9000):

@@ -43,31 +43,19 @@ def main() -> None:
 
     import jax
 
-    from bench import env_stamp
-    from openr_tpu.decision.link_state import LinkState
-    from openr_tpu.emulation.topology import (
-        build_adj_dbs,
-        random_connected_edges,
-    )
-    from openr_tpu.ops.csr import encode_link_state
-    from openr_tpu.ops.sweep_select import SweepCandidates, SweepRouteSelector
+    from bench import build_headline_world, env_stamp
+    from openr_tpu.ops.sweep_select import SweepRouteSelector
     from openr_tpu.ops.whatif import LinkFailureSweep
     from openr_tpu.parallel.mesh import make_mesh
 
-    # IDENTICAL world to bench.py's headline (1024 nodes, 2048
-    # undirected links, seed 7, one loopback per node) — the soak must
-    # measure the same workload the headline quotes, or graph density
-    # changes the on-DAG fraction / dedup economics and the comparison
-    # stops being apples-to-apples (r5 review)
-    edges = random_connected_edges(args.nodes, 2 * args.nodes, seed=7)
-    ls = LinkState("0", "node0")
-    for db in build_adj_dbs(edges).values():
-        ls.update_adjacency_database(db)
-    topo = encode_link_state(ls)
+    # the SHARED headline world (bench.build_headline_world) — the soak
+    # must measure the same workload the headline quotes, or graph
+    # density changes the on-DAG fraction / dedup economics and the
+    # comparison stops being apples-to-apples (r5 review)
+    _ls, topo, cands = build_headline_world(args.nodes)
     L = len(topo.links)
     mesh = make_mesh()
     eng = LinkFailureSweep(topo, "node0", mesh=mesh)
-    cands = SweepCandidates.single_advertiser(np.arange(args.nodes))
     sel = SweepRouteSelector(
         topo, "node0", cands, max_degree=eng.D, mesh=mesh
     )
@@ -97,7 +85,12 @@ def main() -> None:
             d = pend.pop(0).finish()
             sweeps += 1
             window_sweeps += 1
-            deltas_total += int(d.num_deltas)
+            nd = int(d.num_deltas)
+            # same correctness bound as bench.py's fresh-set reps: a
+            # fresh random sweep of this world always changes SOME
+            # routes and can never exceed the full table
+            assert 0 < nd <= args.batch * args.nodes, nd
+            deltas_total += nd
             if window_sweeps == args.window:
                 dt = time.perf_counter() - window_t0
                 windows.append(args.window * args.batch / dt)

@@ -169,10 +169,14 @@ def test_rocket_ctrl_four_methods_end_to_end():
         server = RocketCtrlServer(node, port=0)
         await server.start()
         try:
-            # wait for spark/kvstore convergence on the wall clock
-            for _ in range(200):
-                if adj_key("node0") in node.kv_store.dump_all(
-                    C.DEFAULT_AREA, "adj:"
+            # wait for spark/kvstore/decision convergence on the wall
+            # clock — generous: the suite runs on a loaded single core
+            for _ in range(600):
+                adjs_seen = node.kv_store.dump_all(C.DEFAULT_AREA, "adj:")
+                if (
+                    adj_key("node0") in adjs_seen
+                    and adj_key("node1") in adjs_seen
+                    and len(node.decision.get_adj_dbs(None)) >= 2
                 ):
                     break
                 await asyncio.sleep(0.1)
