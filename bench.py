@@ -173,7 +173,6 @@ def main() -> None:
     # failure provably changes no routes).  This is the most demanding
     # apples-to-apples denominator: same algorithm, same output.
     from openr_tpu.ops.np_select import select_routes_numpy
-    from openr_tpu.ops.sweep_select import SweepCandidates
     from openr_tpu.ops.whatif import root_lane_count
 
     sel_args_np = (

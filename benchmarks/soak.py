@@ -86,10 +86,14 @@ def main() -> None:
             sweeps += 1
             window_sweeps += 1
             nd = int(d.num_deltas)
-            # same correctness bound as bench.py's fresh-set reps: a
-            # fresh random sweep of this world always changes SOME
-            # routes and can never exceed the full table
-            assert 0 < nd <= args.batch * args.nodes, nd
+            # same correctness bound as bench.py's fresh-set reps.
+            # Upper bound always holds; the >0 lower bound only at the
+            # default batch scale (a tiny --batch can legitimately draw
+            # all-off-DAG failure sets that change nothing)
+            assert 0 <= nd <= args.batch * args.nodes, nd
+            if args.batch >= 1024:
+                assert nd > 0, "large fresh sweep changed no routes"
+
             deltas_total += nd
             if window_sweeps == args.window:
                 dt = time.perf_counter() - window_t0

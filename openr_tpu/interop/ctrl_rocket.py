@@ -203,7 +203,74 @@ async def _get_kv_store_key_vals_area(node, args: Dict[str, Any]) -> dict:
     }
 
 
+#: Types.thrift:750 OpenrVersions
+OPENR_VERSIONS = (
+    (1, "version", "i32", None),
+    (2, "lowestSupportedVersion", "i32", None),
+)
+
+#: KvStore.thrift:302 PeerSpec (the response subset: addr/port/state)
+PEER_SPEC = (
+    (1, "peerAddr", "string", None),
+    (4, "ctrlPort", "i32", None),
+    (5, "state", "i32", None),
+)
+
+
+async def _get_openr_version(node, args: Dict[str, Any]) -> Dict[str, Any]:
+    from openr_tpu import constants as _C
+
+    return {
+        "version": _C.OPENR_VERSION,
+        "lowestSupportedVersion": _C.OPENR_SUPPORTED_VERSION,
+    }
+
+
+async def _get_route_db(node, args: Dict[str, Any]) -> Dict[str, Any]:
+    db = node.decision.get_route_db().to_route_database(node.name)
+    return route_database_to_wire_obj(db)
+
+
+async def _get_kv_store_peers(node, args: Dict[str, Any]) -> Dict[str, Any]:
+    area = args.get("area") or _default_area(node)
+    db = node.kv_store.areas.get(area)
+    if db is None:
+        raise DeclaredError(f"unknown area {area!r}")
+    return {
+        name: {
+            "peerAddr": peer.spec.peer_addr,
+            "ctrlPort": peer.spec.ctrl_port,
+            "state": int(peer.state),
+        }
+        for name, peer in db.peers.items()
+    }
+
+
 METHODS: Dict[str, MethodSpec] = {
+    "getOpenrVersion": MethodSpec(
+        args=(),
+        success=("struct", OPENR_VERSIONS),
+        error_name="OpenrError",
+        bind=_get_openr_version,
+    ),
+    "getRouteDb": MethodSpec(
+        args=(),
+        success=("struct", ROUTE_DATABASE),
+        error_name="OpenrError",
+        bind=_get_route_db,
+    ),
+    "getKvStorePeers": MethodSpec(
+        args=(),
+        success=("map", (("string", None), ("struct", PEER_SPEC))),
+        error_name="KvStoreError",
+        bind=_get_kv_store_peers,
+    ),
+    "getKvStorePeersArea": MethodSpec(
+        args=((1, "area", "string", None),),
+        success=("map", (("string", None), ("struct", PEER_SPEC))),
+        error_name="KvStoreError",
+        bind=_get_kv_store_peers,
+    ),
     "getKvStoreKeyValsFilteredArea": MethodSpec(
         args=(
             (1, "filter", "struct", KEY_DUMP_PARAMS),

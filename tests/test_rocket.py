@@ -508,3 +508,49 @@ def test_empty_hash_sync_gets_flood_ttl_semantics():
             await server.stop()
 
     run(main())
+
+
+def test_rocket_extra_methods_version_routedb_peers():
+    """The adapter's wider method rows: getOpenrVersion (the first call
+    every reference client makes), getRouteDb (own computed routes) and
+    getKvStorePeers[Area]."""
+
+    async def main():
+        net = EmulatedNetwork(WallClock())
+        net.build(line_edges(2))
+        net.start()
+        node = net.nodes["node0"]
+        server = RocketCtrlServer(node, port=0)
+        await server.start()
+        try:
+            for _ in range(600):
+                if node.decision.route_db.unicast_routes and (
+                    node.kv_store.areas[C.DEFAULT_AREA].peers
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            async with rocket.RocketClient("127.0.0.1", server.port) as c:
+                v = await rocket_call(c, "getOpenrVersion", {})
+                assert v["version"] >= v["lowestSupportedVersion"] > 0
+                rdb = await rocket_call(c, "getRouteDb", {})
+                assert rdb["thisNodeName"] == "node0"
+                assert rdb["unicastRoutes"], rdb
+                peers = await rocket_call(c, "getKvStorePeers", {})
+                assert "node1" in peers, peers
+                assert peers["node1"]["ctrlPort"] >= 0
+                peers_a = await rocket_call(
+                    c, "getKvStorePeersArea", {"area": C.DEFAULT_AREA}
+                )
+                assert peers_a == peers
+                try:
+                    await rocket_call(
+                        c, "getKvStorePeersArea", {"area": "nope"}
+                    )
+                    assert False, "expected DeclaredError"
+                except DeclaredError as e:
+                    assert "nope" in str(e)
+        finally:
+            await server.stop()
+            await net.stop()
+
+    run(main())
