@@ -221,3 +221,50 @@ def test_actor_tasks_pruned_on_completion():
         await a.stop()
 
     run(main())
+
+
+def test_persistent_compile_cache_gating(monkeypatch, tmp_path):
+    """enable_persistent_compile_cache: OPENR_TPU_COMPILE_CACHE=off
+    disables, an explicit path wins, and the virtual-CPU-mesh test mode
+    (xla_force_host_platform_device_count) skips by default (cross-host
+    XLA:CPU AOT reloads can warn or SIGILL)."""
+    import openr_tpu.ops.platform_env as pe
+
+    calls = []
+
+    class FakeConfig:
+        @staticmethod
+        def update(k, v):
+            calls.append((k, v))
+
+    class FakeJax:
+        config = FakeConfig()
+
+    monkeypatch.setattr(pe, "_COMPILE_CACHE_ENABLED", False)
+    import sys
+
+    monkeypatch.setitem(sys.modules, "jax", FakeJax())
+
+    # off
+    monkeypatch.setenv("OPENR_TPU_COMPILE_CACHE", "off")
+    pe.enable_persistent_compile_cache()
+    assert not calls and not pe._COMPILE_CACHE_ENABLED
+
+    # virtual-mesh mode skips when no explicit path
+    monkeypatch.delenv("OPENR_TPU_COMPILE_CACHE", raising=False)
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    pe.enable_persistent_compile_cache()
+    assert not calls and not pe._COMPILE_CACHE_ENABLED
+
+    # explicit path wins even in virtual-mesh mode
+    monkeypatch.setenv("OPENR_TPU_COMPILE_CACHE", str(tmp_path / "cc"))
+    pe.enable_persistent_compile_cache()
+    assert ("jax_compilation_cache_dir", str(tmp_path / "cc")) in calls
+    assert pe._COMPILE_CACHE_ENABLED
+    assert (tmp_path / "cc").is_dir()
+    # idempotent
+    n = len(calls)
+    pe.enable_persistent_compile_cache()
+    assert len(calls) == n
