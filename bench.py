@@ -121,10 +121,12 @@ def main() -> None:
     t_start = time.time()
     from openr_tpu.ops.platform_env import (
         enable_persistent_compile_cache,
+        fallback_to_cpu_if_unreachable,
         honor_cpu_platform_request,
     )
 
     honor_cpu_platform_request()
+    fallback_to_cpu_if_unreachable()
     enable_persistent_compile_cache()
     from openr_tpu.ops.native_spf import NativeSpf
     from openr_tpu.ops.whatif import LinkFailureSweep

@@ -35,10 +35,12 @@ def main() -> None:
 
     from openr_tpu.ops.platform_env import (
         enable_persistent_compile_cache,
+        fallback_to_cpu_if_unreachable,
         honor_cpu_platform_request,
     )
 
     honor_cpu_platform_request()
+    fallback_to_cpu_if_unreachable()
     enable_persistent_compile_cache()
 
     import jax

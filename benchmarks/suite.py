@@ -1041,10 +1041,12 @@ def main() -> None:
     # init blocks indefinitely (a CPU smoke run would hang forever)
     from openr_tpu.ops.platform_env import (
         enable_persistent_compile_cache,
+        fallback_to_cpu_if_unreachable,
         honor_cpu_platform_request,
     )
 
     honor_cpu_platform_request()
+    fallback_to_cpu_if_unreachable()
     enable_persistent_compile_cache()
     results: List[Dict] = []
     t0 = time.time()

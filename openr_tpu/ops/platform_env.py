@@ -89,3 +89,79 @@ def enable_persistent_compile_cache() -> None:
         logging.getLogger(__name__).warning(
             "persistent compile cache unavailable", exc_info=True
         )
+
+
+#: recent-success marker: a healthy probe is itself a full accelerator
+#: init (~10 s over a tunnel), so back-to-back benchmark runs reuse one
+#: verdict instead of booting the device twice per run
+_ACCEL_OK_MARKER = "/tmp/openr_tpu_accel_ok"
+_ACCEL_OK_TTL_S = 600.0
+
+
+def fallback_to_cpu_if_unreachable(timeout_s: float = 120.0) -> bool:
+    """Probe accelerator init in a SUBPROCESS; on timeout/failure pin
+    jax to CPU and return True (fell back).
+
+    A wedged tunnel (observed: a killed client's chip lease blocking
+    every later ``jax.devices()`` for hours) would otherwise hang a
+    benchmark forever; artifacts stay honest because they stamp
+    devices + env.  On timeout the child gets SIGTERM and a grace
+    period before SIGKILL — killing a PJRT client mid-claim is exactly
+    how such a lease gets wedged in the first place."""
+    import subprocess
+    import sys
+    import time as _time
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False  # explicit CPU request: nothing to probe
+    try:
+        if (
+            _time.time() - os.path.getmtime(_ACCEL_OK_MARKER)
+            < _ACCEL_OK_TTL_S
+        ):
+            return False  # probed healthy moments ago
+    except OSError:
+        pass
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import jax, jax.numpy as jnp;"
+            "(jnp.ones(8)+1).block_until_ready()",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    why = ""
+    try:
+        _out, err = proc.communicate(timeout=timeout_s)
+        ok = proc.returncode == 0
+        if not ok:
+            why = (
+                f"probe exited rc={proc.returncode}: "
+                + (err or b"").decode("utf-8", "replace").strip()[-500:]
+            )
+    except subprocess.TimeoutExpired:
+        ok = False
+        why = f"probe timed out after {timeout_s:.0f}s"
+        proc.terminate()  # graceful: let the PJRT client release its lease
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    if ok:
+        try:
+            with open(_ACCEL_OK_MARKER, "w") as f:
+                f.write(str(_time.time()))
+        except OSError:
+            pass
+        return False
+    print(
+        f"# accelerator unreachable ({why}); falling back to CPU",
+        file=sys.stderr,
+        flush=True,
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    honor_cpu_platform_request()
+    return True
