@@ -83,7 +83,10 @@ def env_stamp() -> dict:
         load1 = load5 = -1.0
     import jax
 
+    from openr_tpu.ops import platform_env as _pe
+
     return {
+        "accelerator_fallback": _pe.ACCEL_FALLBACK_ACTIVE,
         "cpu_model": cpu_model,
         "cpu_count": os.cpu_count(),
         "cpu_governor": governor,

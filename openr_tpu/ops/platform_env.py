@@ -91,6 +91,11 @@ def enable_persistent_compile_cache() -> None:
         )
 
 
+#: set True when fallback_to_cpu_if_unreachable pinned CPU this
+#: process — artifacts surface it so a CPU-fallback capture can never
+#: be mistaken for an accelerator regression
+ACCEL_FALLBACK_ACTIVE = False
+
 #: recent-success marker: a healthy probe is itself a full accelerator
 #: init (~10 s over a tunnel), so back-to-back benchmark runs reuse one
 #: verdict instead of booting the device twice per run
@@ -162,6 +167,8 @@ def fallback_to_cpu_if_unreachable(timeout_s: float = 120.0) -> bool:
         file=sys.stderr,
         flush=True,
     )
+    global ACCEL_FALLBACK_ACTIVE
+    ACCEL_FALLBACK_ACTIVE = True
     os.environ["JAX_PLATFORMS"] = "cpu"
     honor_cpu_platform_request()
     return True
