@@ -1,0 +1,36 @@
+"""Chaos engineering subsystem — deterministic fault injection, in-process
+supervision, and machine-checked invariants.
+
+The reference daemon's whole value proposition is surviving a hostile
+network: Watchdog ``fireCrash``es so a supervisor restarts the daemon, Fib
+retries the agent with exponential backoff, KvStore re-syncs peers after
+partitions.  This package composes those fragments into a testable whole:
+
+  * :class:`FaultPlan` / :class:`ChaosController` — a declarative, seeded
+    schedule of faults (partitions, asymmetric loss, peer-RPC failure and
+    latency, Spark packet drop, FibAgent bursts, device-backend failure,
+    actor crash-kill) driven by the shared clock, so every run is
+    reproducible from a seed and recorded under ``chaos.*`` counters.
+  * :class:`Supervisor` — the in-process systemd: registered as the
+    watchdog's ``fire_crash`` sink, it restarts crashed nodes with
+    exponential backoff instead of letting them die with SystemExit.
+  * :class:`InvariantChecker` — asserts LSDB eventual consistency,
+    blackhole-free FIBs, and monotonic Decision change sequence under and
+    after chaos.
+
+See docs/Robustness.md for the DSL and recovery-flow walkthrough.
+"""
+
+from openr_tpu.chaos.controller import ChaosController
+from openr_tpu.chaos.invariants import InvariantChecker, InvariantViolation
+from openr_tpu.chaos.plan import Fault, FaultPlan
+from openr_tpu.chaos.supervisor import Supervisor
+
+__all__ = [
+    "ChaosController",
+    "Fault",
+    "FaultPlan",
+    "InvariantChecker",
+    "InvariantViolation",
+    "Supervisor",
+]

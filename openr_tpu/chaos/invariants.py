@@ -1,0 +1,164 @@
+"""InvariantChecker — machine-checked safety properties under chaos.
+
+Three invariants, each a direct translation of what "the protocol
+recovered" means (DeltaPath's observation: correctness under churn is the
+hard part, not steady-state SPF):
+
+  1. **LSDB eventual consistency** — after faults heal and a convergence
+     window passes, every (non-partitioned) node's per-area key_vals agree
+     on (version, originator, hash) for every key.  Hashes cover
+     (version, originator, value) but not TTL countdown, so live TTL
+     refresh churn can't fake a divergence.
+  2. **No persisting RIB->FIB blackhole** — each node's desired route state
+     (Fib.unicast_routes) is actually programmed in its agent, and every
+     programmed nexthop leaves via an interface that is up.  A window of
+     disagreement DURING a fault is expected; persisting past the bound
+     after heal is a bug.
+  3. **Monotonic change_seq** — Decision's LSDB change sequence never goes
+     backwards within one node incarnation (restarts reset it by design;
+     the checker tracks incarnations by object identity).
+
+``sample()`` runs the cheap during-run checks; ``check_all()`` runs the
+full post-heal suite and raises :class:`InvariantViolation` with a
+node-by-node diff on failure.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+class InvariantChecker:
+    def __init__(self, net) -> None:
+        self.net = net
+        #: name -> (node object, last observed change_seq)
+        self._seq_seen: Dict[str, Tuple[object, int]] = {}
+        self.num_samples = 0
+
+    # -- during-run checks -------------------------------------------------
+
+    def sample(self) -> None:
+        """Cheap checks safe to run mid-chaos (call between clock steps)."""
+        self.num_samples += 1
+        self.check_change_seq_monotonic()
+
+    def check_change_seq_monotonic(self) -> None:
+        for name, node in self.net.nodes.items():
+            seq = node.decision._change_seq
+            prev = self._seq_seen.get(name)
+            if prev is not None and prev[0] is node and seq < prev[1]:
+                raise InvariantViolation(
+                    f"{name}: decision change_seq went backwards "
+                    f"({prev[1]} -> {seq}) within one incarnation"
+                )
+            self._seq_seen[name] = (node, seq)
+
+    # -- LSDB consistency --------------------------------------------------
+
+    @staticmethod
+    def lsdb_digest(node, area: str) -> Dict[str, Tuple[int, str, Optional[int]]]:
+        db = node.kv_store.areas[area]
+        return {
+            k: (v.version, v.originator_id, v.hash)
+            for k, v in db.key_vals.items()
+        }
+
+    def check_lsdb_converged(
+        self, nodes: Optional[Iterable[str]] = None
+    ) -> None:
+        """All named nodes (default: every node) hold identical per-area
+        digests.  Run this only for nodes in one connected component."""
+        names = sorted(nodes) if nodes is not None else sorted(self.net.nodes)
+        if len(names) < 2:
+            return
+        ref_name = names[0]
+        ref = self.net.nodes[ref_name]
+        for area in ref.kv_store.areas:
+            want = self.lsdb_digest(ref, area)
+            for name in names[1:]:
+                got = self.lsdb_digest(self.net.nodes[name], area)
+                if got == want:
+                    continue
+                missing = sorted(set(want) - set(got))[:5]
+                extra = sorted(set(got) - set(want))[:5]
+                differ = sorted(
+                    k for k in set(want) & set(got) if want[k] != got[k]
+                )[:5]
+                raise InvariantViolation(
+                    f"LSDB divergence in area {area}: {name} vs {ref_name} "
+                    f"(missing={missing} extra={extra} differ={differ})"
+                )
+
+    # -- FIB blackhole freedom ---------------------------------------------
+
+    def check_no_blackholes(self) -> None:
+        """Desired == programmed, and every programmed nexthop leaves via
+        an up interface toward a live node."""
+        live = set(self.net.nodes)
+        for name, node in self.net.nodes.items():
+            agent = self.net.agents[name]
+            desired = {
+                p
+                for p, e in node.fib.unicast_routes.items()
+                if not e.do_not_install
+            }
+            programmed = set(agent.unicast)
+            if desired != programmed:
+                raise InvariantViolation(
+                    f"{name}: FIB desired/programmed mismatch — "
+                    f"unprogrammed={sorted(desired - programmed)[:5]} "
+                    f"stale={sorted(programmed - desired)[:5]}"
+                )
+            interfaces = self.net._interfaces[name]
+            for prefix, route in agent.unicast.items():
+                for nh in route.next_hops:
+                    info = interfaces.get(nh.if_name)
+                    if info is None or not info.is_up:
+                        raise InvariantViolation(
+                            f"{name}: route {prefix} via downed/unknown "
+                            f"interface {nh.if_name}"
+                        )
+                    if (
+                        nh.neighbor_node_name
+                        and nh.neighbor_node_name not in live
+                    ):
+                        raise InvariantViolation(
+                            f"{name}: route {prefix} via dead node "
+                            f"{nh.neighbor_node_name}"
+                        )
+
+    # -- full-mesh reachability (delegates to the harness) -----------------
+
+    def check_full_mesh(self) -> None:
+        ok, why = self.net.converged_full_mesh()
+        if not ok:
+            raise InvariantViolation(f"full-mesh reachability: {why}")
+
+    # -- everything --------------------------------------------------------
+
+    def check_all(self, nodes: Optional[Iterable[str]] = None) -> None:
+        self.check_change_seq_monotonic()
+        self.check_lsdb_converged(nodes)
+        self.check_no_blackholes()
+        if nodes is None:
+            self.check_full_mesh()
+
+    def summary(self) -> List[str]:
+        """Human-readable per-node state for debugging failed runs."""
+        out = []
+        for name in sorted(self.net.nodes):
+            node = self.net.nodes[name]
+            keys = sum(
+                len(db.key_vals) for db in node.kv_store.areas.values()
+            )
+            out.append(
+                f"{name}: lsdb_keys={keys} "
+                f"fib_routes={len(node.fib.unicast_routes)} "
+                f"change_seq={node.decision._change_seq} "
+                f"initialized={node.initialized}"
+            )
+        return out

@@ -1,0 +1,232 @@
+"""FaultPlan — a declarative, reproducible schedule of faults.
+
+A plan is an ordered list of :class:`Fault` records, each naming a fault
+kind, an injection time on the shared clock, an optional duration (0 =
+one-shot), and kind-specific parameters.  Plans are plain data: build them
+with the fluent helpers, load them from JSON-ish dicts, or generate a
+randomized sweep from a seed — the same seed always yields the same plan,
+which (driven through SimClock) yields the same run.
+
+Fault kinds (dispatched by :class:`openr_tpu.chaos.controller.ChaosController`):
+
+  ``link_down(a, b)``            interface-down at both ends (netlink view)
+  ``partition(side_a, side_b)``  cut Spark AND KvStore RPC between groups
+  ``spark_loss(a, b, prob)``     asymmetric probabilistic drop a->b (Spark)
+  ``spark_drop(node)``           drop every Spark packet to/from node
+  ``kv_rpc_fail(src, dst)``      peer RPCs src->dst raise (thrift failure)
+  ``kv_rpc_latency(src, dst, extra_s)``  added peer-RPC latency src->dst
+  ``fib_burst(node)``            FibAgent raises on every call
+  ``tpu_fail(node)``             device backend fails -> scalar fallback
+  ``actor_kill(node, module)``   crash one module fiber (watchdog restarts)
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Tuple
+
+FAULT_KINDS = (
+    "link_down",
+    "partition",
+    "spark_loss",
+    "spark_drop",
+    "kv_rpc_fail",
+    "kv_rpc_latency",
+    "fib_burst",
+    "tpu_fail",
+    "actor_kill",
+)
+
+#: modules a seeded sweep may crash-kill (all are restartable: the
+#: supervisor replaces the whole node, so any module is fair game)
+KILLABLE_MODULES = ("decision", "fib", "kv_store", "link_monitor", "spark")
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str
+    at_s: float
+    duration_s: float = 0.0  # 0 = one-shot (no heal event)
+    params: Tuple[Tuple[str, Any], ...] = ()
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.at_s < 0 or self.duration_s < 0:
+            raise ValueError("fault times must be non-negative")
+
+    @property
+    def args(self) -> Dict[str, Any]:
+        return dict(self.params)
+
+    def label(self) -> str:
+        """Stable counter-key suffix identifying this fault instance."""
+        parts = []
+        for _, v in self.params:
+            if isinstance(v, (list, tuple)):
+                parts.append("+".join(str(x) for x in v))
+            else:
+                parts.append(str(v))
+        return ".".join([self.kind] + parts) if parts else self.kind
+
+
+def _f(kind: str, at: float, duration: float, **params: Any) -> Fault:
+    return Fault(
+        kind=kind,
+        at_s=at,
+        duration_s=duration,
+        params=tuple(sorted(params.items())),
+    )
+
+
+@dataclass
+class FaultPlan:
+    faults: List[Fault] = field(default_factory=list)
+
+    # -- fluent builders ---------------------------------------------------
+
+    def link_down(self, a: str, b: str, at: float, duration: float) -> "FaultPlan":
+        self.faults.append(_f("link_down", at, duration, a=a, b=b))
+        return self
+
+    def partition(
+        self,
+        side_a: Iterable[str],
+        side_b: Iterable[str],
+        at: float,
+        duration: float,
+    ) -> "FaultPlan":
+        self.faults.append(
+            _f(
+                "partition",
+                at,
+                duration,
+                side_a=tuple(sorted(side_a)),
+                side_b=tuple(sorted(side_b)),
+            )
+        )
+        return self
+
+    def spark_loss(
+        self, a: str, b: str, prob: float, at: float, duration: float
+    ) -> "FaultPlan":
+        """Asymmetric loss: packets a->b dropped with probability `prob`
+        (the reverse direction is untouched — exercise one-way visibility)."""
+        self.faults.append(_f("spark_loss", at, duration, a=a, b=b, prob=prob))
+        return self
+
+    def spark_drop(self, node: str, at: float, duration: float) -> "FaultPlan":
+        self.faults.append(_f("spark_drop", at, duration, node=node))
+        return self
+
+    def kv_rpc_fail(
+        self, src: str, dst: str, at: float, duration: float, both: bool = False
+    ) -> "FaultPlan":
+        self.faults.append(
+            _f("kv_rpc_fail", at, duration, src=src, dst=dst, both=both)
+        )
+        return self
+
+    def kv_rpc_latency(
+        self, src: str, dst: str, extra_s: float, at: float, duration: float
+    ) -> "FaultPlan":
+        self.faults.append(
+            _f("kv_rpc_latency", at, duration, src=src, dst=dst, extra_s=extra_s)
+        )
+        return self
+
+    def fib_burst(self, node: str, at: float, duration: float) -> "FaultPlan":
+        self.faults.append(_f("fib_burst", at, duration, node=node))
+        return self
+
+    def tpu_fail(self, node: str, at: float, duration: float) -> "FaultPlan":
+        self.faults.append(_f("tpu_fail", at, duration, node=node))
+        return self
+
+    def actor_kill(self, node: str, module: str, at: float) -> "FaultPlan":
+        if module not in KILLABLE_MODULES:
+            raise ValueError(
+                f"module must be one of {KILLABLE_MODULES}, got {module!r}"
+            )
+        self.faults.append(_f("actor_kill", at, 0.0, node=node, module=module))
+        return self
+
+    # -- schedule ----------------------------------------------------------
+
+    def events(self) -> List[Tuple[float, str, Fault]]:
+        """(time, "inject"|"heal", fault), sorted by time with injection
+        order as the deterministic tie-break."""
+        out: List[Tuple[float, int, str, Fault]] = []
+        for i, fault in enumerate(self.faults):
+            out.append((fault.at_s, i, "inject", fault))
+            if fault.duration_s > 0:
+                out.append((fault.at_s + fault.duration_s, i, "heal", fault))
+        out.sort(key=lambda e: (e[0], e[1], e[2]))
+        return [(t, action, fault) for t, _, action, fault in out]
+
+    def horizon_s(self) -> float:
+        """Time of the last scheduled event (inject or heal)."""
+        return max((t for t, _, _ in self.events()), default=0.0)
+
+    # -- randomized sweeps -------------------------------------------------
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        nodes: List[str],
+        edges: List[Tuple[str, str]],
+        num_faults: int = 8,
+        horizon_s: float = 60.0,
+        min_duration_s: float = 4.0,
+        max_duration_s: float = 15.0,
+        allow_kills: bool = True,
+    ) -> "FaultPlan":
+        """Random plan drawn from `seed` — every transient fault heals
+        strictly before `horizon_s` so invariants can be checked after a
+        final convergence window."""
+        rng = random.Random(seed)
+        nodes = sorted(nodes)
+        edges = sorted(tuple(sorted(e)) for e in edges)
+        plan = cls()
+        kinds = [
+            "link_down",
+            "spark_loss",
+            "spark_drop",
+            "kv_rpc_fail",
+            "kv_rpc_latency",
+            "fib_burst",
+            "tpu_fail",
+        ]
+        if allow_kills:
+            kinds.append("actor_kill")
+        for _ in range(num_faults):
+            kind = rng.choice(kinds)
+            duration = rng.uniform(min_duration_s, max_duration_s)
+            at = rng.uniform(0.0, max(horizon_s - duration - 1.0, 0.0))
+            if kind == "link_down":
+                a, b = rng.choice(edges)
+                plan.link_down(a, b, at, duration)
+            elif kind == "spark_loss":
+                a, b = rng.choice(edges)
+                if rng.random() < 0.5:
+                    a, b = b, a
+                plan.spark_loss(a, b, rng.uniform(0.3, 0.9), at, duration)
+            elif kind == "spark_drop":
+                plan.spark_drop(rng.choice(nodes), at, duration)
+            elif kind == "kv_rpc_fail":
+                a, b = rng.choice(edges)
+                plan.kv_rpc_fail(a, b, at, duration, both=rng.random() < 0.5)
+            elif kind == "kv_rpc_latency":
+                a, b = rng.choice(edges)
+                plan.kv_rpc_latency(a, b, rng.uniform(0.05, 0.5), at, duration)
+            elif kind == "fib_burst":
+                plan.fib_burst(rng.choice(nodes), at, duration)
+            elif kind == "tpu_fail":
+                plan.tpu_fail(rng.choice(nodes), at, duration)
+            else:
+                plan.actor_kill(
+                    rng.choice(nodes), rng.choice(KILLABLE_MODULES), at
+                )
+        return plan

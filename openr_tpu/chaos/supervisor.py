@@ -1,0 +1,135 @@
+"""Supervisor — in-process crash-restart recovery for OpenrNodes.
+
+The reference's Watchdog ``fireCrash``es and aborts the process, relying on
+an EXTERNAL supervisor (systemd) to restart the daemon; drain state replays
+from PersistentStore and KvStore cold-boot full sync reconverges the LSDB
+(graceful restart).  This class is that supervisor brought in-process:
+
+  * ``supervise(name, node, restart)`` re-points the node watchdog's
+    ``fire_crash`` sink at the supervisor (so a crash recovers instead of
+    raising SystemExit);
+  * on crash, the node is restarted through the ``restart`` callback (e.g.
+    ``EmulatedNetwork.restart_node``) after an exponential backoff —
+    crash-looping nodes back off up to ``max_backoff_s``, a node that
+    stayed up ``stable_after_s`` gets a fresh backoff;
+  * the replacement node re-runs the cold-start sequence: the OpenrNode
+    constructor replays drain state from PersistentStore, and the
+    supervisor additionally forces ``KvStore.request_full_sync()`` so every
+    re-learned peer session re-runs the 3-way anti-entropy exchange.
+
+Crashes and restarts are counted (``supervisor.*``) and logged in
+``crash_log`` for tests and the ctrl surface.
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable, Dict, List, Optional, Set, Tuple
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.common.utils import ExponentialBackoff
+
+#: restart: async callable (node_name) -> new node
+RestartFn = Callable[[str], Awaitable[object]]
+
+
+class Supervisor(Actor):
+    def __init__(
+        self,
+        clock: Clock,
+        counters: Optional[CounterMap] = None,
+        initial_backoff_s: float = 0.5,
+        max_backoff_s: float = 30.0,
+        stable_after_s: float = 60.0,
+    ) -> None:
+        super().__init__("supervisor", clock, counters)
+        self._initial_backoff_s = initial_backoff_s
+        self._max_backoff_s = max_backoff_s
+        self._stable_after_s = stable_after_s
+        self._restart_fns: Dict[str, RestartFn] = {}
+        self._backoffs: Dict[str, ExponentialBackoff] = {}
+        self._last_restart: Dict[str, float] = {}
+        self._restarting: Set[str] = set()
+        #: (clock time, node, reason), newest last
+        self.crash_log: List[Tuple[float, str, str]] = []
+        self.num_crashes = 0
+        self.num_restarts = 0
+        self.num_restart_failures = 0
+
+    # -- registration ------------------------------------------------------
+
+    def supervise(self, name: str, node, restart: RestartFn) -> None:
+        """Adopt `node`: its watchdog crashes now restart it via `restart`
+        instead of killing the process."""
+        self._restart_fns[name] = restart
+        self._attach(name, node)
+
+    def _attach(self, name: str, node) -> None:
+        watchdog = getattr(node, "watchdog", None)
+        if watchdog is not None:
+            watchdog.set_fire_crash(
+                lambda reason, n=name: self.on_crash(n, reason)
+            )
+
+    # -- crash path (the fire_crash sink) ----------------------------------
+
+    def on_crash(self, name: str, reason: str) -> None:
+        self.num_crashes += 1
+        self.counters.bump("supervisor.crashes")
+        self.crash_log.append((self.clock.now(), name, reason))
+        if name not in self._restart_fns:
+            self.counters.bump("supervisor.unmanaged_crashes")
+            return
+        if name in self._restarting:
+            # the watchdog fires every sweep until the node is replaced;
+            # one restart is already in flight
+            return
+        self._restarting.add(name)
+        self.spawn(self._restart(name), name=f"supervisor.restart.{name}")
+
+    async def _restart(self, name: str) -> None:
+        backoff = self._backoffs.get(name)
+        if backoff is None:
+            backoff = ExponentialBackoff(
+                self._initial_backoff_s, self._max_backoff_s, self.clock
+            )
+            self._backoffs[name] = backoff
+        last = self._last_restart.get(name)
+        if last is not None and self.clock.now() - last >= self._stable_after_s:
+            backoff.report_success()  # node was stable: not a crash loop
+        try:
+            # retry until the node is back (systemd semantics): a failed
+            # restart attempt must not leave the node dead forever
+            while True:
+                backoff.report_error()
+                delay = backoff.time_remaining_until_retry()
+                if delay > 0:
+                    await self.clock.sleep(delay)
+                self.touch()
+                try:
+                    node = await self._restart_fns[name](name)
+                except Exception:  # noqa: BLE001 - retry, don't die
+                    self.num_restart_failures += 1
+                    self.counters.bump("supervisor.restart_failures")
+                    continue
+                self._attach(name, node)
+                # graceful-restart recovery: every peer session the fresh
+                # store learns must re-run full sync; forcing it here also
+                # covers peers re-added before this call completed
+                kv = getattr(node, "kv_store", None)
+                if kv is not None and hasattr(kv, "request_full_sync"):
+                    kv.request_full_sync()
+                self._last_restart[name] = self.clock.now()
+                self.num_restarts += 1
+                self.counters.bump("supervisor.restarts")
+                self.counters.set(
+                    f"supervisor.backoff_ms.{name}",
+                    backoff.get_current_backoff() * 1000.0,
+                )
+                return
+        finally:
+            self._restarting.discard(name)
+
+    # -- introspection -----------------------------------------------------
+
+    def restarting(self) -> Set[str]:
+        return set(self._restarting)

@@ -64,6 +64,7 @@ async def run_emulation(
     base_port: int,
     verbose: bool = True,
     use_tpu_backend: bool = False,
+    supervise: bool = False,
 ) -> None:
     from openr_tpu.emulation.network import EmulatedNetwork
     from openr_tpu.emulation.topology import grid_edges, line_edges, ring_edges
@@ -82,6 +83,31 @@ async def run_emulation(
     net = EmulatedNetwork(WallClock(), use_tpu_backend=use_tpu_backend)
     net.build(edges)
     net.start()
+    supervisor = None
+    if supervise:
+        # watchdog crashes restart the affected node in place instead of
+        # killing the whole emulation (SystemExit) — the reference's
+        # systemd-restarts-the-daemon loop, in-process
+        from openr_tpu.chaos.supervisor import Supervisor
+
+        supervisor = Supervisor(net.clock)
+        supervisor.start()
+        node_servers: Dict[str, OpenrCtrlServer] = {}
+
+        def _make_restart(node_name: str):
+            async def _restart(_name: str):
+                node = await net.restart_node(node_name)
+                server = node_servers.get(node_name)
+                if server is not None:
+                    # ctrl plane follows the restart: same port, new node
+                    server.node = node
+                    server.handler.node = node
+                return node
+
+            return _restart
+
+        for name, node in net.nodes.items():
+            supervisor.supervise(name, node, _make_restart(name))
     servers: List[OpenrCtrlServer] = []
     next_port = base_port
     for name, node in sorted(net.nodes.items()):
@@ -104,6 +130,8 @@ async def run_emulation(
                 f"[{next_port - window}, {next_port})"
             )
         servers.append(server)
+        if supervisor is not None:
+            node_servers[name] = server
         if verbose:
             print(f"{name}: ctrl on 127.0.0.1:{server.port}")
     if verbose:
@@ -118,6 +146,8 @@ async def run_emulation(
         except NotImplementedError:  # pragma: no cover - non-unix
             pass
     await stop.wait()
+    if supervisor is not None:
+        await supervisor.stop()
     for s in servers:
         await s.stop()
     await net.stop()
@@ -243,6 +273,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--tpu", action="store_true",
                    help="with --emulate: TPU decision backend (enables "
                         "fleet-summary / whatif device features)")
+    p.add_argument("--supervise", action="store_true",
+                   help="with --emulate: watchdog crashes restart the "
+                        "affected node in place (crash-recovery loop) "
+                        "instead of aborting the process")
     p.add_argument("--ctrl-host", default="",
                    help="ctrl server bind address in --real mode "
                         "(default: all interfaces)")
@@ -258,6 +292,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                 args.topology,
                 args.ctrl_base_port or 2018,
                 use_tpu_backend=args.tpu,
+                supervise=args.supervise,
             )
         )
         return

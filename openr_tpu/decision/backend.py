@@ -122,6 +122,11 @@ class DecisionBackend:
         base."""
         raise NotImplementedError
 
+    def counter_snapshot(self) -> Dict[str, float]:
+        """Gauges for the Monitor's provider sweep (ctrl getCounters /
+        `breeze monitor counters decision.backend.`)."""
+        return {}
+
 
 class ScalarBackend(DecisionBackend):
     def __init__(self, solver: SpfSolver) -> None:
@@ -160,6 +165,9 @@ class ScalarBackend(DecisionBackend):
             db = self.solver.build_route_db(area_link_states, prefix_state)
         self._last_db = db if cache_result else None
         return db
+
+    def counter_snapshot(self) -> Dict[str, float]:
+        return {"decision.backend.device": 0.0}
 
 
 class TpuBackend(DecisionBackend):
@@ -214,6 +222,10 @@ class TpuBackend(DecisionBackend):
         #: more candidates than the largest candidate bucket (VERDICT r1
         #: weak #8: the cause must be distinguishable)
         self.num_fallback_cand_overflow = 0
+        #: chaos/operator-injected device outage: every build routes
+        #: through the scalar oracle until the flag clears
+        self.device_failed = False
+        self.num_fallback_injected = 0
         #: EncodedMultiArea cache keyed by ((area, topology_seq), ...):
         #: most rebuilds are prefix churn on an unchanged graph, and
         #: re-encoding a 4096-node LSDB costs tens of ms of the debounce
@@ -250,6 +262,11 @@ class TpuBackend(DecisionBackend):
         force_full=False,
         cache_result=True,
     ):
+        if self.device_failed:
+            # injected device outage (chaos tpu_fail / operator): the
+            # daemon must keep producing routes — scalar oracle takes over
+            self.num_fallback_injected += 1
+            return self._scalar_fallback(area_link_states, prefix_state)
         # the device kernel implements the enabled best-route-selection
         # semantics for both distance algorithms; anything else goes
         # through the scalar oracle for exactness
@@ -288,6 +305,32 @@ class TpuBackend(DecisionBackend):
         else:
             self._last_db = None
         return db
+
+    def inject_device_failure(self, failed: bool) -> None:
+        """Force (or clear) the device-outage path: while set, every build
+        is a `_scalar_fallback`.  Used by chaos tpu_fail and exposed for
+        operators draining a sick accelerator."""
+        self.device_failed = failed
+
+    def counter_snapshot(self) -> Dict[str, float]:
+        return {
+            "decision.backend.device": 1.0,
+            "decision.backend.device_failed": 1.0 if self.device_failed else 0.0,
+            "decision.backend.num_device_builds": float(self.num_device_builds),
+            "decision.backend.num_scalar_builds": float(self.num_scalar_builds),
+            "decision.backend.num_small_scalar_builds": float(
+                self.num_small_scalar_builds
+            ),
+            "decision.backend.num_incremental_builds": float(
+                self.num_incremental_builds
+            ),
+            "decision.backend.num_fallback_cand_overflow": float(
+                self.num_fallback_cand_overflow
+            ),
+            "decision.backend.num_fallback_injected": float(
+                self.num_fallback_injected
+            ),
+        }
 
     def _device_worth_it(self, area_link_states, prefix_state) -> bool:
         """Auto cutover: device iff the estimated scalar build cost

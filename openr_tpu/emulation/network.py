@@ -9,7 +9,7 @@ deterministically in milliseconds of wall clock.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from openr_tpu.common.runtime import Clock
 from openr_tpu.config import OpenrConfig, SparkConfig
@@ -55,9 +55,12 @@ class EmulatedNetwork:
         self.config_overrides = config_overrides or (lambda cfg: None)
         self.nodes: Dict[str, OpenrNode] = {}
         self.agents: Dict[str, MockFibAgent] = {}
+        #: per-node configs retained for supervisor restarts
+        self.configs: Dict[str, OpenrConfig] = {}
         #: node -> {if_name -> InterfaceInfo}
         self._interfaces: Dict[str, Dict[str, InterfaceInfo]] = {}
         self._edges: List[Edge] = []
+        self.num_node_restarts = 0
 
     # -- construction ------------------------------------------------------
 
@@ -81,6 +84,7 @@ class EmulatedNetwork:
         self.kv_transport.register(name, node.kv_store)
         self.nodes[name] = node
         self.agents[name] = agent
+        self.configs[name] = cfg
         self._interfaces[name] = {}
         return node
 
@@ -150,6 +154,56 @@ class EmulatedNetwork:
                 self.nodes[node].link_monitor.set_interfaces(
                     list(self._interfaces[node].values())
                 )
+
+    def partition(self, side_a: Iterable[str], side_b: Iterable[str]) -> None:
+        """Network partition: cut BOTH planes (Spark hello/heartbeat and
+        KvStore peer RPC) between every cross-side pair.  Unlike
+        `fail_link`, interfaces stay administratively up — the nodes must
+        DISCOVER the loss via hold-timer expiry and RPC failure, which is
+        the hard recovery path."""
+        for a in side_a:
+            for b in side_b:
+                self.io.partition(a, b)
+                self.kv_transport.fail(a, b)
+                self.kv_transport.fail(b, a)
+
+    def heal_partition(
+        self, side_a: Iterable[str], side_b: Iterable[str]
+    ) -> None:
+        for a in side_a:
+            for b in side_b:
+                self.io.heal(a, b)
+                self.kv_transport.heal(a, b)
+                self.kv_transport.heal(b, a)
+
+    # -- crash-restart (supervisor restart target) -------------------------
+
+    async def restart_node(self, name: str) -> OpenrNode:
+        """Stop and replace one node in place — the in-process equivalent
+        of systemd restarting a crashed daemon.  The FibAgent (the
+        "platform"/kernel) survives with its programmed routes; the fresh
+        node replays drain state from PersistentStore in its constructor,
+        re-handshakes Spark, and full-syncs its KvStore (cold boot)."""
+        old = self.nodes[name]
+        self.kv_transport.unregister(name)
+        await old.stop()  # spark.stop unregisters from the io provider
+        node = OpenrNode(
+            config=self.configs[name],
+            clock=self.clock,
+            io_provider=self.io,
+            kv_transport=self.kv_transport,
+            fib_agent=self.agents[name],
+            use_tpu_backend=self.use_tpu_backend,
+        )
+        self.kv_transport.register(name, node.kv_store)
+        self.nodes[name] = node
+        node.start()
+        node.link_monitor.set_interfaces(
+            list(self._interfaces[name].values())
+        )
+        node.advertise_prefixes([PrefixEntry(self.loopback(name))])
+        self.num_node_restarts += 1
+        return node
 
     async def stop(self) -> None:
         for node in self.nodes.values():

@@ -149,6 +149,7 @@ class Fib(Actor):
         self._backoff = ExponentialBackoff(
             C.FIB_INITIAL_BACKOFF_S, C.FIB_MAX_BACKOFF_S, clock
         )
+        self.num_retries = 0
         self._synced = False
         self._agent_alive_since: Optional[float] = None
         self._retry_wakeup: Optional[asyncio.Future] = None
@@ -293,6 +294,9 @@ class Fib(Actor):
 
     def _mark_synced(self) -> None:
         self._dirty = False
+        self.counters.set(
+            "fib.backoff_ms", self._backoff.get_current_backoff() * 1000.0
+        )
         if not self._synced:
             self._synced = True
             if self.initialization_cb is not None:
@@ -302,6 +306,9 @@ class Fib(Actor):
         self._dirty = True
         self._backoff.report_error()
         self.counters.bump("fib.programming_failures")
+        self.counters.set(
+            "fib.backoff_ms", self._backoff.get_current_backoff() * 1000.0
+        )
         if self._retry_wakeup is not None and not self._retry_wakeup.done():
             self._retry_wakeup.set_result(None)
 
@@ -314,7 +321,21 @@ class Fib(Actor):
                 await self._retry_wakeup
             await self.clock.sleep(self._backoff.get_current_backoff())
             if self._dirty:
+                self.num_retries += 1
+                self.counters.bump("fib.retries")
                 await self._sync_routes()
+
+    def retry_state(self) -> Dict[str, float]:
+        """Gauge snapshot for the Monitor's provider sweep: retry count,
+        live backoff, and dirty/synced flags — the signals a chaos run (or
+        an operator via `breeze monitor counters fib.`) watches to confirm
+        the agent-retry machinery is actually exercising."""
+        return {
+            "fib.retries": float(self.num_retries),
+            "fib.backoff_ms": self._backoff.get_current_backoff() * 1000.0,
+            "fib.dirty": 1.0 if self._dirty else 0.0,
+            "fib.synced": 1.0 if self._synced else 0.0,
+        }
 
     # -- agent keepalive (keepAliveTask, Fib.cpp:1057) ---------------------
 

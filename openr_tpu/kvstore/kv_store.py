@@ -857,6 +857,26 @@ class KvStore(Actor):
         p = self.areas[area].peers.get(peer)
         return p.state if p is not None else None
 
+    def request_full_sync(self, area: Optional[str] = None) -> int:
+        """Force every peer session (one area, or all) back through the
+        3-way anti-entropy full sync — the cold-boot / graceful-restart
+        recovery path: a supervisor restarting this daemon calls it so the
+        fresh store reconverges even for peers whose sessions were re-added
+        before the restart completed.  Backoffs are cleared (this is an
+        operator/supervisor request, not a failure).  Returns the number of
+        peers scheduled."""
+        n = 0
+        for a, db in self.areas.items():
+            if area is not None and a != area:
+                continue
+            for peer in db.peers.values():
+                db._set_peer_state(peer, KvStorePeerState.IDLE)
+                peer.backoff.report_success()
+                db._schedule_peer_sync(peer)
+                n += 1
+        self.counters.bump("kvstore.full_sync_requests")
+        return n
+
     def get_flood_topo(self, area: str) -> Optional[Dict[str, dict]]:
         """SPT summary per discovered root (getKvStoreFloodTopoArea /
         SptInfos semantics): nexthop, distance, children, chosen root.

@@ -74,7 +74,10 @@ class InProcessTransport(KvStoreTransport):
         self.latency_s = latency_s
         self._stores: Dict[str, object] = {}  # node -> KvStore actor
         self._failed: Set[Tuple[str, str]] = set()
+        #: (src, dst) -> additional directional latency (chaos injection)
+        self._extra_latency: Dict[Tuple[str, str], float] = {}
         self.num_calls = 0
+        self.num_failed_calls = 0
 
     def register(self, node: str, store) -> None:
         self._stores[node] = store
@@ -88,11 +91,21 @@ class InProcessTransport(KvStoreTransport):
     def heal(self, src: str, dst: str) -> None:
         self._failed.discard((src, dst))
 
+    def set_latency(self, src: str, dst: str, extra_s: float) -> None:
+        """Add directional src->dst RPC latency on top of the base
+        (chaos kv_rpc_latency; 0 clears)."""
+        if extra_s <= 0:
+            self._extra_latency.pop((src, dst), None)
+        else:
+            self._extra_latency[(src, dst)] = extra_s
+
     async def _call(self, src: str, dst: str, fn: Callable):
         self.num_calls += 1
-        if self.latency_s:
-            await self.clock.sleep(self.latency_s)
+        latency = self.latency_s + self._extra_latency.get((src, dst), 0.0)
+        if latency:
+            await self.clock.sleep(latency)
         if (src, dst) in self._failed or dst not in self._stores:
+            self.num_failed_calls += 1
             raise KvStoreTransportError(f"{src} -> {dst} unreachable")
         return await fn(self._stores[dst])
 

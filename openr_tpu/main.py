@@ -351,6 +351,12 @@ class OpenrNode:
                 config.monitor_config.enable_event_log_submission
             ),
         )
+        # gauge providers: Fib retry/backoff state and decision-backend
+        # build/fallback tallies become ctrl-API counters (`breeze monitor
+        # counters fib.` / `decision.backend.`) so chaos runs and operators
+        # can watch the recovery machinery work
+        self.monitor.add_counter_provider(self.fib.retry_state)
+        self.monitor.add_counter_provider(backend.counter_snapshot)
         self.watchdog: Optional[Watchdog] = None
         if config.enable_watchdog:
             wd = config.watchdog_config

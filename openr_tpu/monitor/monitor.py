@@ -85,6 +85,12 @@ class Monitor(Actor):
         self._forward = forward_fn
         self.system_metrics = SystemMetrics()
         self._start_time = clock.now()
+        #: gauge providers sampled each metrics sweep: modules whose
+        #: internal state isn't naturally counter-shaped (Fib retry/backoff,
+        #: decision-backend build/fallback tallies) register a callable
+        #: returning {counter_key: value} so the ctrl API / breeze surface
+        #: them without the modules knowing about sampling cadence
+        self._providers: List[Callable[[], Dict[str, float]]] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -120,6 +126,12 @@ class Monitor(Actor):
 
     # -- system metrics ----------------------------------------------------
 
+    def add_counter_provider(
+        self, provider: Callable[[], Dict[str, float]]
+    ) -> None:
+        """Register a gauge provider; sampled every metrics sweep."""
+        self._providers.append(provider)
+
     def sample_system_metrics(self) -> None:
         rss = self.system_metrics.rss_bytes()
         if rss is not None:
@@ -130,4 +142,10 @@ class Monitor(Actor):
         self.counters.set(
             "process.uptime.seconds", self.clock.now() - self._start_time
         )
+        for provider in self._providers:
+            try:
+                for key, value in provider().items():
+                    self.counters.set(key, value)
+            except Exception:  # noqa: BLE001 - a sick provider must not
+                self.counters.bump("monitor.provider_errors")  # kill sampling
         self.touch()

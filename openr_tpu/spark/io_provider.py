@@ -50,14 +50,24 @@ class MockIoProvider(IoProvider):
     """
 
     def __init__(self, clock: Clock) -> None:
+        import random
+
         self.clock = clock
         self._receivers: Dict[str, RecvCallback] = {}
         # (node, if) -> [(peer_node, peer_if, latency_s)]
         self._pairs: Dict[Tuple[str, str], List[Tuple[str, str, float]]] = {}
         self._pump = Actor("mock_io", clock)
         self._partitioned: set = set()
+        #: (src, dst) -> drop probability (asymmetric; chaos spark_loss)
+        self._loss: Dict[Tuple[str, str], float] = {}
+        #: nodes whose packets are dropped in BOTH directions (spark_drop)
+        self._muted: set = set()
+        #: loss coin — seeded by the chaos controller so a SimClock run's
+        #: drop pattern replays exactly from one seed
+        self._loss_rng = random.Random(0)
         self.packets_sent = 0
         self.packets_delivered = 0
+        self.packets_dropped = 0
 
     def register(self, node: str, cb: RecvCallback) -> None:
         self._receivers[node] = cb
@@ -88,10 +98,42 @@ class MockIoProvider(IoProvider):
         self._partitioned.discard((n1, n2))
         self._partitioned.discard((n2, n1))
 
+    # -- chaos hooks (openr_tpu.chaos) ------------------------------------
+
+    def seed_loss_rng(self, seed: int) -> None:
+        import random
+
+        self._loss_rng = random.Random(seed)
+
+    def set_loss(self, src: str, dst: str, prob: float) -> None:
+        """Drop src->dst packets with probability `prob` (0 clears);
+        DIRECTIONAL — the reverse path is untouched (asymmetric loss)."""
+        if prob <= 0:
+            self._loss.pop((src, dst), None)
+        else:
+            self._loss[(src, dst)] = min(prob, 1.0)
+
+    def mute(self, node: str) -> None:
+        """Drop every packet sent by or destined to `node`."""
+        self._muted.add(node)
+
+    def unmute(self, node: str) -> None:
+        self._muted.discard(node)
+
     def send(self, node: str, if_name: str, payload: dict) -> None:
         self.packets_sent += 1
+        if node in self._muted:
+            self.packets_dropped += 1
+            return
         for peer_node, peer_if, latency in self._pairs.get((node, if_name), []):
             if (node, peer_node) in self._partitioned:
+                continue
+            if peer_node in self._muted:
+                self.packets_dropped += 1
+                continue
+            loss = self._loss.get((node, peer_node))
+            if loss is not None and self._loss_rng.random() < loss:
+                self.packets_dropped += 1
                 continue
             self._pump.spawn(
                 self._deliver(peer_node, peer_if, dict(payload), latency),

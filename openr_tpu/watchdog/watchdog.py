@@ -15,7 +15,11 @@ Here modules are asyncio ``Actor``s that bump ``last_heartbeat`` via
 ``touch()``; queues are ``ReplicateQueue``s exposing ``max_backlog()``.
 ``fire_crash`` is pluggable so tests observe instead of aborting — in
 production it raises SystemExit from the watchdog fiber, the supervisor's
-restart signal.
+restart signal; ``openr_tpu.chaos.Supervisor`` re-points it via
+``set_fire_crash`` to recover in-process.  At most ONE crash fires per
+sweep (the first reason found): a single root cause — a dead module fiber
+backing up every downstream queue — must produce one restart signal, not a
+storm of them.
 """
 
 from __future__ import annotations
@@ -39,6 +43,7 @@ class Watchdog(Actor):
         max_memory_mb: int = 0,  # 0 = unlimited
         max_queue_size: int = QUEUE_BACKLOG_LIMIT,
         fire_crash: Optional[Callable[[str], None]] = None,
+        metrics: Optional[SystemMetrics] = None,
     ) -> None:
         super().__init__("watchdog", clock, counters)
         self.node_name = node_name
@@ -48,7 +53,7 @@ class Watchdog(Actor):
         self._max_queue_size = max_queue_size
         self._actors: List[Actor] = []
         self._queues: List = []
-        self._metrics = SystemMetrics()
+        self._metrics = metrics if metrics is not None else SystemMetrics()
         self._fire_crash = fire_crash or self._default_fire_crash
         self.crashed: Optional[str] = None  # first crash reason, for tests
 
@@ -59,6 +64,10 @@ class Watchdog(Actor):
 
     def add_queue(self, queue) -> None:
         self._queues.append(queue)
+
+    def set_fire_crash(self, fn: Callable[[str], None]) -> None:
+        """Re-point the crash sink (a supervisor adopting this node)."""
+        self._fire_crash = fn
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -74,15 +83,21 @@ class Watchdog(Actor):
     # -- checks (Watchdog.cpp:71-174) --------------------------------------
 
     def check(self) -> None:
+        """One sweep: refresh heartbeats + gauges for EVERYTHING, then fire
+        at most one crash (the first reason found).  A single root cause
+        trips several checks at once — the sweep must emit one restart
+        signal, not one per symptom."""
         self.counters.bump("watchdog.checks")
         now = self.clock.now()
+        crash_reason: Optional[str] = None
         for actor in self._actors:
             if actor.fiber_failed:
                 # A module fiber died with an exception: the module can no
                 # longer process its queues — crash promptly (the reference
                 # aborts on a stuck evb; a dead fiber is our equivalent and
                 # is detectable immediately, no need to wait out a timeout).
-                self._crash(f"Module {actor.name} fiber died")
+                if crash_reason is None:
+                    crash_reason = f"Module {actor.name} fiber died"
                 continue
             if not actor._stopped:
                 # The asyncio analogue of the reference's no-op evb timer:
@@ -94,25 +109,29 @@ class Watchdog(Actor):
                 actor.touch()
             stall = now - actor.last_heartbeat
             self.counters.set(f"watchdog.stall_time_ms.{actor.name}", stall * 1000)
-            if stall > self._thread_timeout:
-                self._crash(
+            if stall > self._thread_timeout and crash_reason is None:
+                crash_reason = (
                     f"Thread {actor.name} stuck for {stall:.0f}s "
                     f"(limit {self._thread_timeout:.0f}s)"
                 )
         for q in self._queues:
             backlog = q.max_backlog()
             self.counters.set(f"watchdog.queue_backlog.{q.name}", backlog)
-            if backlog > self._max_queue_size:
-                self._crash(
+            if backlog > self._max_queue_size and crash_reason is None:
+                crash_reason = (
                     f"Queue {q.name} backlog {backlog} exceeds "
                     f"{self._max_queue_size}"
                 )
         if self._max_memory_bytes:
             rss = self._metrics.rss_bytes()
-            if rss is not None and rss > self._max_memory_bytes:
-                self._crash(
-                    f"Memory {rss} exceeds limit {self._max_memory_bytes}"
-                )
+            if rss is not None:
+                self.counters.set("watchdog.rss_bytes", rss)
+                if rss > self._max_memory_bytes and crash_reason is None:
+                    crash_reason = (
+                        f"Memory {rss} exceeds limit {self._max_memory_bytes}"
+                    )
+        if crash_reason is not None:
+            self._crash(crash_reason)
 
     def _crash(self, reason: str) -> None:
         self.counters.bump("watchdog.crashes")

@@ -26,6 +26,15 @@ import asyncio  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 (-m 'not slow')"
+    )
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection test (openr_tpu.chaos)"
+    )
+
+
 @pytest.fixture
 def sim_loop():
     """Fresh event loop + SimClock per test."""

@@ -1,0 +1,131 @@
+"""Tier-1 chaos smoke: three small seeded scenarios, one per recovery
+mechanism — partition+heal (KvStore re-sync), fib-agent burst (retry with
+backoff + exported counters), actor crash (supervisor restart).  Long
+randomized sweeps live in test_chaos_sweep.py behind -m slow.
+"""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.chaos import ChaosController, FaultPlan, InvariantChecker, Supervisor
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import line_edges, ring_edges
+
+CONVERGE_S = 12.0
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def fast_watchdog(cfg):
+    cfg.watchdog_config.interval_s = 1.0
+
+
+@pytest.mark.chaos
+def test_partition_and_heal_reconverges():
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock)
+        net.build(ring_edges(4))
+        net.start()
+        checker = InvariantChecker(net)
+        plan = FaultPlan().partition(
+            ("node0",), ("node1", "node2", "node3"), at=0.0, duration=10.0
+        )
+        controller = ChaosController(net, plan, seed=11)
+        await clock.run_for(CONVERGE_S)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        controller.start()
+        await clock.run_for(5.0)
+        checker.sample()
+        # during the partition the majority component stays consistent
+        checker.check_lsdb_converged(nodes=("node1", "node2", "node3"))
+        # the isolated node lost its adjacencies: no route out, and no
+        # stale blackholed routes either
+        await clock.run_for(5.0)  # heal fires at t=10
+        await clock.run_for(15.0)  # reconverge
+        checker.check_all()
+        assert controller.done
+        dump = controller.counter_dump()
+        assert dump["chaos.injects"] == 1 and dump["chaos.heals"] == 1
+        await controller.stop()
+        await net.stop()
+
+    run(main())
+
+
+@pytest.mark.chaos
+def test_fib_agent_burst_retries_with_backoff():
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock)
+        net.build(line_edges(3))
+        net.start()
+        await clock.run_for(CONVERGE_S)
+        node1 = net.nodes["node1"]
+        plan = FaultPlan().fib_burst("node1", at=0.0, duration=6.0)
+        controller = ChaosController(net, plan, seed=5)
+        controller.start()
+        await clock.run_for(1.0)
+        # poke a route change while the agent is failing: programming
+        # fails, Fib goes dirty, backoff engages (the withdrawal reaches
+        # the agent via the 1s-delayed delete, so give it ~3.5s)
+        net.fail_link("node1", "node2")
+        await clock.run_for(3.5)
+        assert node1.counters.get("fib.programming_failures") > 0
+        assert node1.fib.retry_state()["fib.dirty"] == 1.0
+        await clock.run_for(12.0)  # burst heals at t=6; retries drain
+        assert node1.fib.retry_state()["fib.dirty"] == 0.0
+        assert node1.fib.num_retries > 0
+        # retry/backoff state is exported through the Monitor provider
+        # sweep into the node's counters (ctrl getCounters surface)
+        node1.monitor.sample_system_metrics()
+        assert node1.counters.get("fib.retries") == node1.fib.num_retries
+        assert "fib.backoff_ms" in node1.counters.dump("fib.")
+        # desired == programmed after recovery (node2 unreachable now,
+        # but nothing stale/blackholed is left programmed)
+        InvariantChecker(net).check_no_blackholes()
+        await controller.stop()
+        await net.stop()
+
+    run(main())
+
+
+@pytest.mark.chaos
+def test_actor_crash_restarts_without_systemexit():
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=fast_watchdog)
+        net.build(line_edges(2))
+        net.start()
+        supervisor = Supervisor(clock, initial_backoff_s=0.25, max_backoff_s=2.0)
+        supervisor.start()
+        for name, node in net.nodes.items():
+            supervisor.supervise(name, node, net.restart_node)
+        await clock.run_for(CONVERGE_S)
+        old = net.nodes["node0"]
+        plan = FaultPlan().actor_kill("node0", "fib", at=0.0)
+        controller = ChaosController(net, plan, seed=3)
+        controller.start()
+        # watchdog sweep (1s) notices the dead fiber -> supervisor restart
+        await clock.run_for(20.0)
+        assert supervisor.num_crashes >= 1
+        assert supervisor.num_restarts == 1
+        assert net.nodes["node0"] is not old
+        assert net.nodes["node0"].initialized
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        InvariantChecker(net).check_all()
+        await supervisor.stop()
+        await controller.stop()
+        await net.stop()
+
+    run(main())

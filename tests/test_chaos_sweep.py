@@ -1,0 +1,75 @@
+"""Long randomized chaos sweeps (-m slow): seeded FaultPlans over a ring,
+every transient fault healed before the horizon, full invariant suite at
+the end.  Tier-1 runs the fixed scenarios in test_chaos_smoke.py /
+test_chaos_recovery.py instead.
+"""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.chaos import ChaosController, FaultPlan, InvariantChecker, Supervisor
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import ring_edges
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def fast_watchdog(cfg):
+    cfg.watchdog_config.interval_s = 1.0
+
+
+async def _sweep(seed: int) -> dict:
+    clock = SimClock()
+    net = EmulatedNetwork(clock, config_overrides=fast_watchdog)
+    edges = ring_edges(6)
+    net.build(edges)
+    net.start()
+    sup = Supervisor(clock, initial_backoff_s=0.25, max_backoff_s=4.0)
+    sup.start()
+    for name, node in net.nodes.items():
+        sup.supervise(name, node, net.restart_node)
+    plan = FaultPlan.seeded(
+        seed,
+        nodes=sorted(net.nodes),
+        edges=[(a, b) for a, b, _ in edges],
+        num_faults=8,
+        horizon_s=50.0,
+    )
+    checker = InvariantChecker(net)
+    controller = ChaosController(net, plan, seed=seed)
+    await clock.run_for(15.0)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    controller.start()
+    for _ in range(12):
+        await clock.run_for(5.0)
+        checker.sample()
+    assert controller.done
+    await clock.run_for(40.0)  # post-heal convergence (incl. restarts)
+    checker.check_all()
+    dump = controller.counter_dump()
+    await sup.stop()
+    await controller.stop()
+    await net.stop()
+    return dump
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_randomized_sweep_recovers(seed):
+    run(_sweep(seed))
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_randomized_sweep_is_reproducible():
+    assert run(_sweep(9)) == run(_sweep(9))

@@ -1,0 +1,323 @@
+"""Watchdog/supervisor robustness satellites (ISSUE 1):
+
+  * regression: one crash signal per watchdog sweep, even when several
+    checks trip at once (a dead fiber backing up queues is ONE root cause);
+  * memory-cap path with a stubbed SystemMetrics (deterministic RSS);
+  * watchdog thresholds flow config JSON -> OpenrConfig -> OpenrNode;
+  * TcpKvStoreTransport._drop_client close tasks don't leak;
+  * Supervisor crash-loop backoff + drain-state replay through restart;
+  * KvStore.request_full_sync forces every peer back through full sync.
+"""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.chaos import Supervisor
+from openr_tpu.common.runtime import Actor, CounterMap, SimClock
+from openr_tpu.config import OpenrConfig
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import line_edges
+from openr_tpu.kvstore.transport import TcpKvStoreTransport
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import KvStorePeerState
+from openr_tpu.watchdog.watchdog import Watchdog
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class _CrashingActor(Actor):
+    async def run(self):
+        raise RuntimeError("boom")
+
+
+# ---------------------------------------------------------------------------
+# Watchdog: first crash per sweep short-circuits
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_once_per_sweep_on_multiple_conditions():
+    async def main():
+        clock = SimClock()
+        crashes = []
+        counters = CounterMap()
+        wd = Watchdog(
+            "node1",
+            clock,
+            counters,
+            interval_s=20,
+            max_queue_size=10,
+            fire_crash=crashes.append,
+        )
+        # two simultaneous conditions: a dead module fiber AND an
+        # over-limit queue — the sweep must report the FIRST reason only
+        dead = _CrashingActor("dead_mod", clock)
+        q = ReplicateQueue("backedUp")
+        q.get_reader()  # never drained
+        wd.add_actor(dead)
+        wd.add_queue(q)
+        dead.start()
+        for i in range(11):
+            q.push(i)
+        wd.start()
+        await clock.run_for(25)  # exactly one sweep
+        assert len(crashes) == 1
+        assert "dead_mod" in crashes[0]  # first reason in scan order wins
+        assert wd.crashed == crashes[0]
+        assert counters.get("watchdog.crashes") == 1
+        # gauges for everything are still maintained on the crashing sweep
+        assert counters.get("watchdog.queue_backlog.backedUp") == 11
+        # next sweep fires again (still broken) — one per sweep, not zero
+        await clock.run_for(20)
+        assert len(crashes) == 2
+        await dead.stop()
+        await wd.stop()
+
+    run(main())
+
+
+def test_watchdog_memory_cap_with_stubbed_metrics():
+    class StubMetrics:
+        def __init__(self):
+            self.rss = 0
+
+        def rss_bytes(self):
+            return self.rss
+
+    async def main():
+        clock = SimClock()
+        crashes = []
+        counters = CounterMap()
+        metrics = StubMetrics()
+        wd = Watchdog(
+            "node1",
+            clock,
+            counters,
+            interval_s=20,
+            max_memory_mb=100,
+            fire_crash=crashes.append,
+            metrics=metrics,
+        )
+        wd.start()
+        metrics.rss = 99 * 1024 * 1024  # under the cap: quiet
+        await clock.run_for(25)
+        assert crashes == []
+        assert counters.get("watchdog.rss_bytes") == metrics.rss
+        metrics.rss = 101 * 1024 * 1024  # over the cap: crash
+        await clock.run_for(20)
+        assert len(crashes) == 1 and "Memory" in crashes[0]
+        assert str(101 * 1024 * 1024) in crashes[0]
+        await wd.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Config wiring: thresholds flow JSON -> OpenrConfig -> node watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_thresholds_wired_from_config_json():
+    cfg = OpenrConfig.from_json(
+        """
+        {"node_name": "wired",
+         "persistent_store_path": "",
+         "rib_policy_file": "",
+         "watchdog_config": {"interval_s": 5.0,
+                             "thread_timeout_s": 42.0,
+                             "max_memory_mb": 512,
+                             "max_queue_size": 777}}
+        """
+    )
+    assert cfg.watchdog_config.interval_s == 5.0
+
+    async def main():
+        from openr_tpu.kvstore.transport import InProcessTransport
+        from openr_tpu.main import OpenrNode
+        from openr_tpu.spark.io_provider import MockIoProvider
+
+        clock = SimClock()
+        node = OpenrNode(
+            config=cfg,
+            clock=clock,
+            io_provider=MockIoProvider(clock),
+            kv_transport=InProcessTransport(clock),
+        )
+        wd = node.watchdog
+        assert wd is not None
+        assert wd._interval == 5.0
+        assert wd._thread_timeout == 42.0
+        assert wd._max_memory_bytes == 512 * 1024 * 1024
+        assert wd._max_queue_size == 777
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# TcpKvStoreTransport: dropped clients must not leak close tasks
+# ---------------------------------------------------------------------------
+
+
+def test_drop_client_close_tasks_do_not_leak():
+    class _Client:
+        def __init__(self, fail=False):
+            self.fail = fail
+            self.closed = False
+
+        async def close(self):
+            self.closed = True
+            if self.fail:
+                raise OSError("broken pipe during close")
+
+    async def main():
+        transport = TcpKvStoreTransport()
+        good, bad = _Client(), _Client(fail=True)
+        transport._clients["peer_ok"] = good
+        transport._clients["peer_bad"] = bad
+        transport._drop_client("peer_ok")
+        transport._drop_client("peer_bad")
+        assert len(transport._close_tasks) == 2  # strong refs while in flight
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert good.closed and bad.closed
+        # done-callback discards the task AND consumes the exception —
+        # nothing retained, no 'exception was never retrieved' spew
+        assert transport._close_tasks == set()
+        assert transport._clients == {}
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: crash-loop backoff + drain-state replay
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_crash_loop_backs_off():
+    async def main():
+        clock = SimClock()
+        sup = Supervisor(
+            clock, initial_backoff_s=1.0, max_backoff_s=8.0, stable_after_s=60.0
+        )
+        sup.start()
+        restarts = []
+
+        class _Node:
+            watchdog = None
+            kv_store = None
+
+        async def restart(name):
+            restarts.append(clock.now())
+            return _Node()
+
+        sup.supervise("crashy", _Node(), restart)
+        for _ in range(4):
+            sup.on_crash("crashy", "boom")
+            await clock.run_for(20.0)
+        assert len(restarts) == 4
+        gaps = [restarts[0]] + [
+            b - a for a, b in zip(restarts, restarts[1:])
+        ]
+        # each restart of a crash-looping node waits longer: 1,2,4,8 of
+        # backoff inside 20s windows -> the wait component doubles
+        waits = [g - 20.0 * i for i, g in enumerate(gaps)]
+        assert waits[0] == pytest.approx(1.0)
+        assert sup.num_crashes == 4 and sup.num_restarts == 4
+        await sup.stop()
+
+    run(main())
+
+
+def test_supervisor_restart_replays_drain_state_from_persistent_store(tmp_path):
+    def overrides(cfg):
+        cfg.watchdog_config.interval_s = 1.0
+        cfg.persistent_store_path = str(
+            tmp_path / f"store.{cfg.node_name}.bin"
+        )
+
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=overrides)
+        net.build(line_edges(2))
+        net.start()
+        sup = Supervisor(clock, initial_backoff_s=0.25, max_backoff_s=2.0)
+        sup.start()
+        for name, node in net.nodes.items():
+            sup.supervise(name, node, net.restart_node)
+        await clock.run_for(12.0)
+        # operator drains node0; intent lands in the persistent store
+        net.nodes["node0"].set_node_overload(True)
+        await clock.run_for(1.0)
+        old = net.nodes["node0"]
+        # crash it (dead fiber -> watchdog -> supervisor)
+        async def _die():
+            raise RuntimeError("chaos kill")
+
+        old.link_monitor.spawn(_die(), name="test.kill")
+        await clock.run_for(15.0)
+        fresh = net.nodes["node0"]
+        assert fresh is not old and sup.num_restarts == 1
+        # the operator's drain intent survived the crash-restart
+        assert fresh.link_monitor.get_drain_state()["node_overloaded"] is True
+        await sup.stop()
+        await net.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# TpuBackend: injected device outage -> scalar fallback
+# ---------------------------------------------------------------------------
+
+
+def test_tpu_backend_injected_outage_falls_back_scalar():
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+
+    backend = TpuBackend(SpfSolver("me"))
+    backend.inject_device_failure(True)
+    backend.build_route_db({}, PrefixState())
+    assert backend.num_fallback_injected == 1
+    snap = backend.counter_snapshot()
+    assert snap["decision.backend.device_failed"] == 1.0
+    assert snap["decision.backend.num_fallback_injected"] == 1.0
+    backend.inject_device_failure(False)
+    backend.build_route_db({}, PrefixState())
+    # outage cleared: no further injected fallbacks (empty topology still
+    # routes through the ordinary scalar path, not the injected one)
+    assert backend.num_fallback_injected == 1
+    assert backend.counter_snapshot()["decision.backend.device_failed"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# KvStore: forced cold-boot full sync
+# ---------------------------------------------------------------------------
+
+
+def test_request_full_sync_rewalks_every_peer():
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock)
+        net.build(line_edges(2))
+        net.start()
+        await clock.run_for(12.0)
+        kv = net.nodes["node0"].kv_store
+        area = next(iter(kv.areas))
+        assert kv.peer_state(area, "node1") == KvStorePeerState.INITIALIZED
+        syncs_before = kv.counters.get("kvstore.thrift.num_full_sync")
+        n = kv.request_full_sync()
+        assert n == 1
+        await clock.run_for(2.0)
+        assert kv.peer_state(area, "node1") == KvStorePeerState.INITIALIZED
+        assert kv.counters.get("kvstore.thrift.num_full_sync") > syncs_before
+        assert kv.counters.get("kvstore.full_sync_requests") == 1
+        await net.stop()
+
+    run(main())
