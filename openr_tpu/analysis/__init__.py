@@ -1,0 +1,41 @@
+"""orlint — AST-based static analysis for openr-tpu's load-bearing
+invariants.
+
+The repo's discipline rules are written down as docstring law
+(common/runtime.py: queues-only actor isolation, Clock-only timing;
+ops/jit_guard.py: guarded jit dispatch) but were previously enforced
+only by stress tests.  This package enforces them structurally:
+
+* ``python -m openr_tpu.analysis --check`` — the tier-1 gate
+* ``python -m openr_tpu.analysis --format=json`` — for tooling diffs
+* ``# orlint: disable=<rule> (<why>)`` — per-line escape hatch
+* ``analysis/baseline.json`` — grandfathered findings; ratchets down
+
+See docs/Developer_Guide.md §"Static invariants (orlint)" for each rule
+and its rationale.
+"""
+
+from openr_tpu.analysis.baseline import Baseline, BaselineEntry
+from openr_tpu.analysis.engine import (
+    analyze_modules,
+    analyze_paths,
+    analyze_source,
+    default_baseline_path,
+    repo_root,
+)
+from openr_tpu.analysis.findings import Finding, Report
+from openr_tpu.analysis.passes import all_rules, make_passes
+
+__all__ = [
+    "Baseline",
+    "BaselineEntry",
+    "Finding",
+    "Report",
+    "all_rules",
+    "analyze_modules",
+    "analyze_paths",
+    "analyze_source",
+    "default_baseline_path",
+    "make_passes",
+    "repo_root",
+]

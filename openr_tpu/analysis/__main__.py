@@ -1,0 +1,146 @@
+"""orlint CLI — ``python -m openr_tpu.analysis``.
+
+Modes:
+
+* (default)            — report findings, exit 0 regardless
+* ``--check``          — exit 1 when any unsuppressed, unbaselined
+                         finding survives (the tier-1 gate,
+                         tests/test_orlint.py)
+* ``--update-baseline``— rewrite analysis/baseline.json from the current
+                         findings (the ratchet: run after FIXING things,
+                         not instead of fixing them)
+* ``--format=json``    — machine-readable report (finding list + per-rule
+                         counts) so BENCH/verdict tooling can diff
+                         finding counts across PRs
+* ``--list-rules``     — every rule id with its one-line rationale
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from openr_tpu.analysis.baseline import Baseline
+from openr_tpu.analysis.engine import (
+    analyze_paths,
+    default_baseline_path,
+)
+from openr_tpu.analysis.passes import all_rules
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m openr_tpu.analysis",
+        description="orlint: static invariant checks for openr-tpu "
+        "(clock discipline, actor isolation, JAX kernel hygiene, "
+        "blocking-in-event-loop)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        type=Path,
+        help="files/dirs to scan (default: the openr_tpu package)",
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="exit non-zero when unbaselined findings remain",
+    )
+    ap.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        dest="fmt",
+    )
+    ap.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help=f"baseline file (default: {default_baseline_path()})",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (show grandfathered findings too)",
+    )
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings and exit",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="RULE",
+        help="restrict to specific rule id(s)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, why in all_rules().items():
+            print(f"{rule:22s} {why}")
+        return 0
+
+    baseline_path = args.baseline or default_baseline_path()
+
+    if args.update_baseline:
+        report = analyze_paths(
+            args.paths, baseline_path, use_baseline=False, rules=args.rules
+        )
+        Baseline.from_findings(report.findings).dump(baseline_path)
+        print(
+            f"orlint: baseline written to {baseline_path} "
+            f"({len(report.findings)} findings)"
+        )
+        return 0
+
+    report = analyze_paths(
+        args.paths,
+        baseline_path,
+        use_baseline=not args.no_baseline,
+        rules=args.rules,
+    )
+
+    if args.fmt == "json":
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        for f in report.findings:
+            print(f.render())
+        for e in report.stale_baseline:
+            print(
+                f"{e.path}:{e.line}: [stale-baseline] entry no longer "
+                f"matches any {e.rule} finding — remove it "
+                "(--update-baseline)"
+            )
+        counts = report.counts_by_rule()
+        summary = (
+            f"orlint: {len(report.findings)} finding(s) across "
+            f"{report.files_scanned} file(s)"
+            f" ({len(report.baselined)} baselined, "
+            f"{len(report.suppressed)} suppressed"
+            + (
+                f", {len(report.stale_baseline)} stale baseline entr"
+                + ("y" if len(report.stale_baseline) == 1 else "ies")
+                if report.stale_baseline
+                else ""
+            )
+            + ")"
+        )
+        if counts:
+            summary += " — " + ", ".join(
+                f"{r}: {n}" for r, n in counts.items()
+            )
+        print(summary)
+
+    if args.check and not report.clean:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,153 @@
+"""Shared AST plumbing for orlint passes.
+
+Passes reason about *dotted origins*: ``import time as _time`` followed by
+``_time.monotonic()`` must trip the same rule as ``time.monotonic()``, and
+``from jax import jit`` must count as ``jax.jit``.  :class:`ImportMap`
+normalizes every locally-bound name to the dotted path it was imported
+from; :func:`resolve` folds an expression's attribute chain down onto
+that.
+
+Everything here is deliberately scope-naive — one namespace per module,
+names matched textually.  That trades a sliver of precision (a local
+variable shadowing an import) for passes that stay ~50 lines each; the
+suppression mechanism absorbs the rare false positive.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional
+
+
+class ImportMap:
+    """local name -> dotted origin, from a module's import statements."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.names: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    # `import a.b` binds `a`; `import a.b as c` binds c->a.b
+                    self.names[local] = alias.name if alias.asname else local
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:  # relative import: origin unknown, skip
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.names[local] = f"{node.module}.{alias.name}"
+
+    def origin(self, name: str) -> str:
+        return self.names.get(name, name)
+
+
+def resolve(node: ast.expr, imports: ImportMap) -> Optional[str]:
+    """Dotted origin of an expression: Name or Attribute chain rooted at a
+    Name.  ``_time.monotonic`` -> ``time.monotonic``; ``self.clock.sleep``
+    -> ``self.clock.sleep`` (roots that aren't imports pass through).
+    Returns None for anything else (calls, subscripts, literals)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(imports.origin(node.id))
+    return ".".join(reversed(parts))
+
+
+def attach_parents(tree: ast.Module) -> None:
+    """Annotate every node with ``.orlint_parent`` (None at the root)."""
+    tree.orlint_parent = None  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.orlint_parent = node  # type: ignore[attr-defined]
+
+
+def parent_chain(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "orlint_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "orlint_parent", None)
+
+
+def enclosing_functions(node: ast.AST) -> List[ast.AST]:
+    """Innermost-first chain of enclosing (async) function defs."""
+    return [
+        p
+        for p in parent_chain(node)
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    for p in parent_chain(node):
+        if isinstance(p, ast.ClassDef):
+            return p
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # keep climbing: methods live inside the class
+            continue
+    return None
+
+
+def is_awaited(call: ast.Call) -> bool:
+    parent = getattr(call, "orlint_parent", None)
+    return isinstance(parent, ast.Await)
+
+
+def const_value(node: ast.expr):
+    """Constant's value, else a sentinel that equals nothing."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    return _NOT_CONST
+
+
+class _NotConst:
+    def __eq__(self, other) -> bool:  # pragma: no cover - sentinel
+        return False
+
+    def __hash__(self) -> int:  # pragma: no cover - sentinel
+        return 0
+
+
+_NOT_CONST = _NotConst()
+
+
+def all_param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def annotation_name(node: Optional[ast.expr]) -> Optional[str]:
+    """Bare class name of an annotation: ``Spark``, ``runtime.Actor`` ->
+    ``Actor``, ``Optional[KvStore]`` -> ``KvStore`` (single-arg generics
+    only; unions stay None)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if (
+            resolve(base, _EMPTY_IMPORTS) or ""
+        ).split(".")[-1] in ("Optional",):
+            return annotation_name(node.slice)
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+_EMPTY_IMPORTS = ImportMap(ast.parse(""))

@@ -1,0 +1,109 @@
+"""Grandfathered-finding baseline for orlint.
+
+The gate must start green on day one without blessing new violations, so
+pre-existing findings live in a checked-in ``baseline.json`` and are
+filtered out of ``--check``.  The contract is a *ratchet*: the baseline
+only shrinks — fix a finding, regenerate with ``--update-baseline``, and
+the meta-test (tests/test_orlint.py) fails if an entry goes stale (its
+file vanished or the offending line text no longer appears), forcing the
+dead weight out.
+
+Matching is content-based: an entry is ``(rule, path, snippet)`` where
+``snippet`` is the stripped source text of the offending line, stored
+with a count (the same line text can trip the same rule several times in
+one file).  Line numbers are recorded for humans but ignored for
+matching, so unrelated edits above a grandfathered hit don't resurrect
+it.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+from openr_tpu.analysis.findings import Finding, Report
+
+VERSION = 1
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    snippet: str
+    line: int  # advisory only; matching is by (rule, path, snippet)
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "snippet": self.snippet,
+        }
+
+
+class Baseline:
+    def __init__(self, entries: List[BaselineEntry]) -> None:
+        self.entries = entries
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return cls([])
+        entries = [
+            BaselineEntry(
+                rule=e["rule"],
+                path=e["path"],
+                snippet=e.get("snippet", ""),
+                line=int(e.get("line", 0)),
+            )
+            for e in doc.get("findings", [])
+        ]
+        return cls(entries)
+
+    @classmethod
+    def from_findings(cls, findings: List[Finding]) -> "Baseline":
+        return cls(
+            [
+                BaselineEntry(
+                    rule=f.rule, path=f.path, snippet=f.snippet, line=f.line
+                )
+                for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+            ]
+        )
+
+    def dump(self, path) -> None:
+        doc = {
+            "version": VERSION,
+            "findings": [e.to_json() for e in self.entries],
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    def apply(self, report: Report) -> None:
+        """Move baselined findings out of ``report.findings``; record
+        entries that matched nothing as stale."""
+        budget: Dict[Tuple[str, str, str], int] = {}
+        for e in self.entries:
+            budget[e.key()] = budget.get(e.key(), 0) + 1
+        active: List[Finding] = []
+        for f in report.findings:
+            k = f.key()
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                report.baselined.append(f)
+            else:
+                active.append(f)
+        report.findings = active
+        for e in self.entries:
+            if budget.get(e.key(), 0) > 0:
+                budget[e.key()] -= 1
+                report.stale_baseline.append(e)

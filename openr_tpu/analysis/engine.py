@@ -1,0 +1,125 @@
+"""orlint engine — discover files, run passes, filter, report.
+
+Two-phase execution (see passes/base.py): every pass collects
+cross-module facts over the whole file set before any pass runs, so the
+actor registry and the jitted-kernel registry see the full project no
+matter the file ordering.  Findings are then filtered through in-source
+suppressions (suppress.py) and the checked-in baseline (baseline.py);
+only what survives fails ``--check``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence
+
+from openr_tpu.analysis.baseline import Baseline
+from openr_tpu.analysis.findings import Finding, Report
+from openr_tpu.analysis.passes import make_passes
+from openr_tpu.analysis.passes.base import ParsedModule
+
+DEFAULT_BASELINE_NAME = "baseline.json"
+
+
+def repo_root() -> Path:
+    """Directory containing the ``openr_tpu`` package."""
+    import openr_tpu
+
+    return Path(openr_tpu.__file__).resolve().parent.parent
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / DEFAULT_BASELINE_NAME
+
+
+def iter_python_files(root: Path) -> Iterable[Path]:
+    if root.is_file():
+        yield root
+        return
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        yield p
+
+
+def load_modules(
+    paths: Sequence[Path], base: Optional[Path] = None
+) -> List[ParsedModule]:
+    base = base or repo_root()
+    mods: List[ParsedModule] = []
+    for root in paths:
+        for path in iter_python_files(Path(root)):
+            try:
+                rel = path.resolve().relative_to(base).as_posix()
+            except ValueError:
+                rel = path.as_posix()
+            try:
+                source = path.read_text()
+            except (OSError, UnicodeDecodeError):
+                continue
+            try:
+                mods.append(ParsedModule.parse(rel, source))
+            except SyntaxError:
+                # not ours to judge; python itself will complain louder
+                continue
+    return mods
+
+
+def analyze_modules(
+    mods: Sequence[ParsedModule],
+    baseline: Optional[Baseline] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> Report:
+    passes = make_passes()
+    ctx: dict = {}
+    for p in passes:
+        for mod in mods:
+            p.collect(mod, ctx)
+        p.finalize(ctx)
+    report = Report(files_scanned=len(mods))
+    raw: List[Finding] = []
+    for p in passes:
+        for mod in mods:
+            raw.extend(p.run(mod, ctx))
+    if rules:
+        wanted = set(rules)
+        raw = [f for f in raw if f.rule in wanted]
+    raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    for f in raw:
+        sup = next(
+            (m.suppressions for m in mods if m.rel == f.path), None
+        )
+        if sup is not None and sup.is_suppressed(f.rule, f.line):
+            report.suppressed.append(f)
+        else:
+            report.findings.append(f)
+    if baseline is not None:
+        baseline.apply(report)
+    return report
+
+
+def analyze_paths(
+    paths: Optional[Sequence[Path]] = None,
+    baseline_path: Optional[Path] = None,
+    use_baseline: bool = True,
+    rules: Optional[Sequence[str]] = None,
+) -> Report:
+    base = repo_root()
+    if not paths:
+        paths = [base / "openr_tpu"]
+    baseline = None
+    if use_baseline:
+        baseline = Baseline.load(baseline_path or default_baseline_path())
+    return analyze_modules(load_modules(paths, base), baseline, rules)
+
+
+def analyze_source(
+    source: str, rel: str = "snippet.py", context: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Analyze an in-memory snippet (test fixtures), optionally alongside
+    extra context sources.  Returns unsuppressed findings for ``rel``."""
+    mods = [ParsedModule.parse(rel, source)]
+    for i, ctx_src in enumerate(context or ()):
+        mods.append(ParsedModule.parse(f"ctx{i}.py", ctx_src))
+    report = analyze_modules(mods, baseline=None)
+    return [f for f in report.findings if f.path == rel]

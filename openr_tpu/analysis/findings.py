@@ -1,0 +1,71 @@
+"""Finding model for orlint.
+
+A :class:`Finding` is one rule violation at one source location.  Findings
+carry the *stripped text of the offending line* (``snippet``) so the
+baseline can match them content-first: line numbers drift every edit, the
+offending code mostly does not (see baseline.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  #: rule id, e.g. "clock-sleep"
+    path: str  #: repo-relative posix path
+    line: int  #: 1-based line of the offending AST node
+    col: int  #: 0-based column
+    message: str  #: human explanation, names the invariant violated
+    snippet: str = ""  #: stripped source text of `line`, for baseline matching
+
+    def key(self):
+        """Identity used for baseline matching — content-based, no column
+        (editor reformatting must not un-baseline a grandfathered hit)."""
+        return (self.rule, self.path, self.snippet)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Report:
+    """One analysis run: active findings plus what was filtered and why."""
+
+    findings: list = field(default_factory=list)  #: unsuppressed, unbaselined
+    suppressed: list = field(default_factory=list)
+    baselined: list = field(default_factory=list)
+    stale_baseline: list = field(default_factory=list)  #: entries no finding matched
+    files_scanned: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def counts_by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "files_scanned": self.files_scanned,
+            "counts": self.counts_by_rule(),
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "stale_baseline": [e.to_json() for e in self.stale_baseline],
+        }

@@ -1,0 +1,34 @@
+"""orlint pass registry.
+
+Each pass encodes one family of repo invariants (see the module
+docstrings for the law being enforced and where it's written down).
+``ALL_PASSES`` is the canonical ordering used by the engine and the CLI's
+``--list-rules``.
+"""
+
+from __future__ import annotations
+
+from openr_tpu.analysis.passes.actor_isolation import ActorIsolationPass
+from openr_tpu.analysis.passes.async_blocking import AsyncBlockingPass
+from openr_tpu.analysis.passes.base import Pass
+from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
+from openr_tpu.analysis.passes.jax_hygiene import JaxHygienePass
+
+
+def make_passes():
+    return [
+        ClockDisciplinePass(),
+        ActorIsolationPass(),
+        JaxHygienePass(),
+        AsyncBlockingPass(),
+    ]
+
+
+def all_rules():
+    out = {}
+    for p in make_passes():
+        out.update(p.rules)
+    return out
+
+
+__all__ = ["Pass", "make_passes", "all_rules"]

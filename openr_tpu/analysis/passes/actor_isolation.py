@@ -1,0 +1,227 @@
+"""Actor/queue discipline — "modules talk only through queues, no shared
+mutable state" (common/runtime.py module docstring).
+
+Every actor owns its state single-writer; the only sanctioned channels
+between modules are ``openr_tpu.messaging`` queues and the registered
+ctrl/RPC surfaces.  A direct write through an actor reference — or a
+read of another actor's ``_underscore`` internals — is a latent race the
+moment fibers interleave differently, the exact class of bug
+tests/test_race_stress.py hunts dynamically and DeltaPath-style
+dataflow analysis argues should be caught structurally.
+
+Collection (whole-project): the transitive set of ``Actor`` subclasses,
+then per-module which names/attributes are actor-typed — constructor
+results (``self.spark = Spark(..)``), parameter annotations
+(``spark: Spark``), and local bindings.  Rules:
+
+* ``actor-cross-write``    — store through an actor-typed expression that
+                             isn't ``self``: ``node.spark.foo = ..``,
+                             ``self.kv_store._db[k] = ..``
+* ``actor-private-access`` — load of a ``_private`` attribute through an
+                             actor-typed expression that isn't ``self``
+                             (reading internals couples to state the
+                             owner mutates without synchronization)
+
+Same-class access (``other: KvStore`` inside ``KvStore``) is exempt —
+``__eq__``/merge helpers touching a peer's privates is idiomatic Python,
+not a module boundary crossing.  Test harnesses and the chaos injector
+cross boundaries *on purpose*; those sites carry explicit suppressions
+so the transgression stays visible and audited.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.astutil import (
+    annotation_name,
+    enclosing_class,
+    resolve,
+)
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+_CTX_CLASSES = "actor_isolation.classes"  # class name -> set(base names)
+_CTX_ACTORS = "actor_isolation.actors"  # bare names of Actor subclasses
+
+
+class ActorIsolationPass(Pass):
+    name = "actor-isolation"
+    rules = {
+        "actor-cross-write": "mutating another actor's state bypasses the queue/RPC contract",
+        "actor-private-access": "reading another actor's _private state couples across module boundaries",
+    }
+
+    # -- phase 1: project-wide actor class hierarchy -----------------------
+
+    def collect(self, mod: ParsedModule, ctx: dict) -> None:
+        classes: Dict[str, Set[str]] = ctx.setdefault(_CTX_CLASSES, {})
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                bases = set()
+                for b in node.bases:
+                    name = annotation_name(b)
+                    if name:
+                        bases.add(name)
+                classes.setdefault(node.name, set()).update(bases)
+
+    def finalize(self, ctx: dict) -> None:
+        classes = ctx.get(_CTX_CLASSES, {})
+        actors: Set[str] = {"Actor"}
+        changed = True
+        while changed:
+            changed = False
+            for name, bases in classes.items():
+                if name not in actors and bases & actors:
+                    actors.add(name)
+                    changed = True
+        ctx[_CTX_ACTORS] = actors
+
+    # -- phase 2 -----------------------------------------------------------
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if not mod.is_protocol_plane():
+            return []
+        actors: Set[str] = ctx.get(_CTX_ACTORS, {"Actor"})
+        typed = _ActorTypedExprs(mod, actors)
+        out: List[Finding] = []
+        #: (line, base expr) already flagged as a write — the Load of
+        #: `x._db` inside `x._db[k] = v` is the same transgression, not a
+        #: second finding
+        written: Set[Tuple[int, str]] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    hit = typed.actor_base(t, skip_outermost=True)
+                    if hit is None:
+                        continue
+                    expr_src, cls = hit
+                    if typed.same_class_exempt(node, cls):
+                        continue
+                    written.add((node.lineno, expr_src))
+                    out.append(
+                        mod.finding(
+                            "actor-cross-write",
+                            node,
+                            f"write through actor-typed `{expr_src}` "
+                            f"(a {cls}) — modules talk only through "
+                            "openr_tpu.messaging queues / registered RPC "
+                            "surfaces (common/runtime.py)",
+                        )
+                    )
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                attr = node.attr
+                if not attr.startswith("_") or attr.startswith("__"):
+                    continue
+                hit = typed.actor_base(node.value, skip_outermost=False)
+                if hit is None:
+                    continue
+                expr_src, cls = hit
+                if typed.same_class_exempt(node, cls):
+                    continue
+                if (node.lineno, expr_src) in written:
+                    continue
+                out.append(
+                    mod.finding(
+                        "actor-private-access",
+                        node,
+                        f"`{expr_src}.{attr}` reads {cls} internals across "
+                        "a module boundary; use its queue or public API",
+                    )
+                )
+        return out
+
+
+class _ActorTypedExprs:
+    """Which expressions in this module statically hold actor instances."""
+
+    def __init__(self, mod: ParsedModule, actors: Set[str]) -> None:
+        self.mod = mod
+        self.actors = actors
+        #: plain names (params / locals): name -> actor class
+        self.names: Dict[str, str] = {}
+        #: self attributes: (class name, attr) -> actor class
+        self.self_attrs: Dict[Tuple[str, str], str] = {}
+        self._index()
+
+    def _index(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                for p in a.posonlyargs + a.args + a.kwonlyargs:
+                    cls = annotation_name(p.annotation)
+                    if cls in self.actors:
+                        self.names[p.arg] = cls
+            elif isinstance(node, ast.AnnAssign):
+                cls = annotation_name(node.annotation)
+                if cls in self.actors:
+                    self._bind_target(node.target, cls)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                called = resolve(node.value.func, self.mod.imports)
+                cls = called.split(".")[-1] if called else None
+                if cls in self.actors:
+                    for t in node.targets:
+                        self._bind_target(t, cls)
+
+    def _bind_target(self, target: ast.expr, cls: str) -> None:
+        if isinstance(target, ast.Name):
+            self.names[target.id] = cls
+        elif (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            owner = enclosing_class(target)
+            if owner is not None:
+                self.self_attrs[(owner.name, target.attr)] = cls
+
+    def _base_type(self, expr: ast.expr) -> Optional[Tuple[str, str]]:
+        """(source text, actor class) when `expr` is actor-typed."""
+        if isinstance(expr, ast.Name):
+            cls = self.names.get(expr.id)
+            if cls:
+                return expr.id, cls
+        elif (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            owner = enclosing_class(expr)
+            if owner is not None:
+                cls = self.self_attrs.get((owner.name, expr.attr))
+                if cls:
+                    return f"self.{expr.attr}", cls
+        return None
+
+    def actor_base(
+        self, node: ast.expr, skip_outermost: bool
+    ) -> Optional[Tuple[str, str]]:
+        """Walk down a target/value chain (Attribute/Subscript/Starred);
+        report the innermost actor-typed base.  With ``skip_outermost``
+        the node itself doesn't count — rebinding a *variable* that held
+        an actor (``x = ..``) is not a write *through* it."""
+        first = True
+        while True:
+            if not (first and skip_outermost):
+                hit = self._base_type(node)
+                if hit is not None:
+                    return hit
+            first = False
+            if isinstance(node, (ast.Attribute,)):
+                node = node.value
+            elif isinstance(node, (ast.Subscript, ast.Starred)):
+                node = node.value
+            else:
+                return None
+
+    def same_class_exempt(self, node: ast.AST, cls: str) -> bool:
+        owner = enclosing_class(node)
+        return owner is not None and owner.name == cls

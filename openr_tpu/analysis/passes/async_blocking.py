@@ -1,0 +1,103 @@
+"""Blocking-in-event-loop — every actor fiber shares ONE asyncio loop.
+
+A synchronous socket read, subprocess wait, or file open inside an
+``async def`` stalls every other module's fibers for its full duration:
+Spark misses hello deadlines, the Watchdog sees stalled heartbeats and
+fires, SimClock tests deadlock (virtual time can't advance while the
+host loop is blocked).  The reference gives each module its own thread +
+EventBase so a blocking call only hurts its own module; our asyncio port
+loses that isolation, which makes this rule load-bearing rather than
+stylistic.
+
+Rule ``async-blocking`` flags, inside any ``async def`` (nested sync
+``def``s are skipped — they're commonly handed to ``run_in_executor``):
+
+* ``subprocess.*`` / ``os.system`` / ``os.popen`` / ``os.wait*``
+* raw-socket verbs: ``.recv/.recvfrom/.recv_into/.accept/.connect/
+  .sendall(..)`` when not awaited (awaited forms are custom async
+  transports, e.g. an IoProvider's ``recv`` coroutine)
+* builtin ``open(..)`` and ``pathlib``'s ``.read_text/.write_text/
+  .read_bytes/.write_bytes``
+* ``requests.*`` / ``urllib.request.*`` HTTP clients
+
+Startup-path reads that are genuinely one-shot (config load before the
+loop is busy) carry line suppressions with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.astutil import is_awaited, resolve
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+_SOCKET_VERBS = {
+    "recv",
+    "recvfrom",
+    "recv_into",
+    "accept",
+    "connect",
+    "sendall",
+}
+_FILE_VERBS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+_BLOCKING_ROOTS = ("subprocess.", "requests.", "urllib.request.")
+_BLOCKING_EXACT = {"os.system", "os.popen", "os.wait", "os.waitpid", "open"}
+
+
+class AsyncBlockingPass(Pass):
+    name = "async-blocking"
+    rules = {
+        "async-blocking": "synchronous I/O inside async def stalls every actor on the shared loop",
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if not mod.is_protocol_plane():
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._scan_async_body(mod, node, out)
+        return out
+
+    def _scan_async_body(
+        self, mod: ParsedModule, fn: ast.AsyncFunctionDef, out: List[Finding]
+    ) -> None:
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # sync helpers may be executor-bound; nested
+                # async defs get their own top-level scan
+            stack.extend(ast.iter_child_nodes(node))
+            if isinstance(node, ast.Call):
+                self._check_call(mod, node, out)
+
+    def _check_call(
+        self, mod: ParsedModule, node: ast.Call, out: List[Finding]
+    ) -> None:
+        if is_awaited(node):
+            return
+        target = resolve(node.func, mod.imports) or ""
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) else None
+        blocking = (
+            target in _BLOCKING_EXACT
+            or target.startswith(_BLOCKING_ROOTS)
+            or attr in _SOCKET_VERBS
+            or attr in _FILE_VERBS
+        )
+        if not blocking:
+            return
+        what = target if target and "." in target else (
+            f".{attr}(..)" if attr else target
+        )
+        out.append(
+            mod.finding(
+                "async-blocking",
+                node,
+                f"`{what or 'open'}` blocks the shared event loop inside "
+                "`async def`; use the async transport, clock, or "
+                "run_in_executor",
+            )
+        )

@@ -1,0 +1,89 @@
+"""Pass interface + the per-file parse unit the engine hands to passes."""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from openr_tpu.analysis.astutil import ImportMap, attach_parents
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.suppress import Suppressions
+
+#: protocol-plane scoping: presentation/tooling trees where wall-clock and
+#: direct state access are not protocol bugs (breeze CLI formats
+#: timestamps for humans; examples are out-of-process clients; the linter
+#: itself talks about forbidden calls in strings and fixtures)
+NON_PROTOCOL_PREFIXES = (
+    "openr_tpu/cli/",
+    "openr_tpu/examples/",
+    "openr_tpu/analysis/",
+)
+
+
+@dataclass
+class ParsedModule:
+    rel: str  #: repo-relative posix path
+    module_name: str  #: dotted import path, "" when not under a package
+    source: str
+    tree: ast.Module
+    imports: ImportMap
+    suppressions: Suppressions
+    lines: List[str] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, rel: str, source: str) -> "ParsedModule":
+        tree = ast.parse(source)
+        attach_parents(tree)
+        module_name = ""
+        if rel.endswith(".py"):
+            parts = rel[:-3].split("/")
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            module_name = ".".join(parts)
+        return cls(
+            rel=rel,
+            module_name=module_name,
+            source=source,
+            tree=tree,
+            imports=ImportMap(tree),
+            suppressions=Suppressions(source),
+            lines=source.splitlines(),
+        )
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.rel,
+            line=node.lineno,
+            col=node.col_offset,
+            message=message,
+            snippet=self.snippet(node.lineno),
+        )
+
+    def is_protocol_plane(self) -> bool:
+        return not self.rel.startswith(NON_PROTOCOL_PREFIXES)
+
+
+class Pass:
+    """One invariant family.  Two-phase: every pass sees every module in
+    ``collect`` (cross-module facts: actor classes, jitted kernels), then
+    ``finalize`` closes over the collected facts, then ``run`` emits
+    findings per module."""
+
+    name = "base"
+    rules: Dict[str, str] = {}
+
+    def collect(self, mod: ParsedModule, ctx: dict) -> None:
+        return
+
+    def finalize(self, ctx: dict) -> None:
+        return
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        raise NotImplementedError

@@ -1,0 +1,102 @@
+"""Clock discipline — "all protocol-plane sleeping/timing MUST go through
+this" (common/runtime.py:Clock).
+
+SimClock tests advance virtual time event-by-event; one raw
+``asyncio.sleep(0.5)`` in an actor parks that fiber on the *host* loop
+where virtual time never reaches it, and the test either hangs or goes
+timing-dependent — exactly the nondeterminism the runtime docstring
+bans.  ``time.time()``/``time.monotonic()`` reads are the same bug on
+the read side: FSM timeouts computed from wall time diverge from the
+virtual clock.  Rules:
+
+* ``clock-sleep``     — ``time.sleep(..)`` / ``asyncio.sleep(x)`` for any
+                        x other than the literal 0 (a bare yield is a
+                        scheduling primitive, not a timed wait — SimClock
+                        itself quiesces with ``asyncio.sleep(0)``)
+* ``clock-now``       — ``time.time/monotonic/perf_counter[_ns]()``
+* ``clock-call-later``— ``<loop>.call_later(..)`` / ``.call_at(..)``:
+                        host-loop timers that SimClock cannot see
+
+The legitimate users (WallClock itself, SystemMetrics' CPU%% sampling,
+epoch timestamps for wire formats) carry line-level suppressions with
+justifications — grep ``orlint: disable=clock`` for the list.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.astutil import const_value, resolve
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+_SLEEPS = {"time.sleep", "asyncio.sleep"}
+_NOW = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+}
+_LOOP_TIMERS = {"call_later", "call_at"}
+
+
+class ClockDisciplinePass(Pass):
+    name = "clock-discipline"
+    rules = {
+        "clock-sleep": "raw sleep bypasses the injected Clock (breaks SimClock determinism)",
+        "clock-now": "raw wall-time read bypasses the injected Clock",
+        "clock-call-later": "event-loop timer bypasses the injected Clock",
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if not mod.is_protocol_plane():
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve(node.func, mod.imports)
+            if target in _SLEEPS:
+                if (
+                    target == "asyncio.sleep"
+                    and len(node.args) == 1
+                    and not node.keywords
+                    and const_value(node.args[0]) == 0
+                ):
+                    continue  # bare cooperative yield, SimClock-safe
+                out.append(
+                    mod.finding(
+                        "clock-sleep",
+                        node,
+                        f"`{target}` bypasses the injected Clock; use "
+                        "`await clock.sleep(..)` (common/runtime.py: all "
+                        "protocol-plane sleeping MUST go through Clock)",
+                    )
+                )
+            elif target in _NOW:
+                out.append(
+                    mod.finding(
+                        "clock-now",
+                        node,
+                        f"`{target}` reads host time; use `clock.now()` / "
+                        "`clock.now_ms()` so SimClock tests stay "
+                        "deterministic",
+                    )
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _LOOP_TIMERS
+            ):
+                out.append(
+                    mod.finding(
+                        "clock-call-later",
+                        node,
+                        f"`.{node.func.attr}(..)` schedules on the host "
+                        "event loop, invisible to SimClock; use "
+                        "`Actor.schedule(..)` / `clock.sleep(..)`",
+                    )
+                )
+        return out

@@ -1,0 +1,266 @@
+"""JAX kernel hygiene — the compute-plane invariants from ops/.
+
+Three failure modes this pass catches structurally:
+
+* ``jit-unguarded-call`` — calling a ``jax.jit`` product directly instead
+  of through ``call_jit_guarded``.  ops/jit_guard.py documents the
+  jax-0.9.0 executable-cache corruption ("Execution supplied N buffers
+  but compiled program expected M"): the first call of a fresh jitted
+  function after *other* kernel families compiled in-process can draw a
+  corrupted cache entry.  Any direct call site re-opens that
+  intermittent crash.  Calls *inside* another jitted body are exempt
+  (they trace inline; only the outermost dispatch touches the
+  executable cache), as are warm-up/self-test sites that carry a
+  suppression.
+
+* ``jit-traced-branch`` — Python ``if``/``while`` on a traced value
+  inside a jitted body.  Branching on a tracer either raises
+  ``TracerBoolConversionError`` at first trace or — worse — silently
+  bakes one branch into the compiled program.  Shape/dtype inspection
+  (``x.ndim``, ``x.shape``, ``len(x)``, ``isinstance``) is static and
+  allowed; parameters named in ``static_argnames`` are allowed.
+
+* ``jit-host-sync`` — ``.block_until_ready()`` / ``.item()`` /
+  ``.tolist()`` / ``np.asarray(..)`` / ``jax.device_get(..)`` inside a
+  jitted body: a host sync inside a trace is at best a silent constant-
+  fold of a tracer and at worst a ConcretizationTypeError; either way
+  the kernel stops being a pure device program.
+
+Collection is project-wide: jitted names are gathered per module
+(decorator form, ``functools.partial(jax.jit, ..)`` form, and
+``name = jax.jit(fn, ..)`` assignment form), so an importing module's
+direct call of another module's kernel is still flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from openr_tpu.analysis.astutil import (
+    enclosing_functions,
+    resolve,
+)
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+_CTX_JIT = "jax_hygiene.jitted"  # module name -> {fn name -> static argnames}
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
+_HOST_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+_HOST_SYNC_CALLS = {
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+}
+
+
+def _jit_target(node: ast.expr, imports) -> Optional[ast.expr]:
+    """For a decorator / assignment value, return the expression whose
+    product is jitted, or None.  Handles ``jax.jit``,
+    ``functools.partial(jax.jit, ..)`` and ``jax.jit(fn, ..)``."""
+    target = resolve(node, imports)
+    if target == "jax.jit":
+        return node
+    if isinstance(node, ast.Call):
+        called = resolve(node.func, imports)
+        if called == "jax.jit":
+            return node
+        if called in ("functools.partial", "partial") and node.args:
+            if resolve(node.args[0], imports) == "jax.jit":
+                return node
+    return None
+
+
+def _static_argnames(node: ast.expr) -> Set[str]:
+    names: Set[str] = set()
+    if not isinstance(node, ast.Call):
+        return names
+    for kw in node.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in vals:
+                if isinstance(v, ast.Constant):
+                    names.add(str(v.value))
+    return names
+
+
+class JaxHygienePass(Pass):
+    name = "jax-hygiene"
+    rules = {
+        "jit-unguarded-call": "direct jitted call skips call_jit_guarded (executable-cache corruption, ops/jit_guard.py)",
+        "jit-traced-branch": "Python control flow on a traced value inside a jitted body",
+        "jit-host-sync": "host synchronization inside a jitted body",
+    }
+
+    # -- phase 1: which names are jitted, per module -----------------------
+
+    def collect(self, mod: ParsedModule, ctx: dict) -> None:
+        jitted: Dict[str, Set[str]] = {}
+        #: jitted function bodies to inspect: FunctionDef -> static names
+        bodies: Dict[ast.AST, Set[str]] = {}
+        defs_by_name = {
+            n.name: n
+            for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    jt = _jit_target(dec, mod.imports)
+                    if jt is not None:
+                        statics = _static_argnames(jt)
+                        jitted[node.name] = statics
+                        bodies[node] = statics
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                jt = _jit_target(node.value, mod.imports)
+                if jt is None or resolve(node.value.func, mod.imports) != "jax.jit":
+                    continue
+                statics = _static_argnames(node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted[t.id] = statics
+                # `fn = jax.jit(_impl, ..)`: the traced body is _impl's
+                if node.value.args:
+                    impl = node.value.args[0]
+                    if isinstance(impl, ast.Name) and impl.id in defs_by_name:
+                        bodies[defs_by_name[impl.id]] = statics
+        ctx.setdefault(_CTX_JIT, {})[mod.module_name] = jitted
+        mod.tree.orlint_jit_bodies = bodies  # type: ignore[attr-defined]
+
+    # -- phase 2 -----------------------------------------------------------
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        registry: Dict[str, Dict[str, Set[str]]] = ctx.get(_CTX_JIT, {})
+        local = registry.get(mod.module_name, {})
+        # names imported from other modules that are jitted there
+        imported: Set[str] = set()
+        for name, origin in mod.imports.names.items():
+            src_mod, _, src_name = origin.rpartition(".")
+            if src_name in registry.get(src_mod, {}):
+                imported.add(name)
+        jitted_names = set(local) | imported
+        bodies: Dict[ast.AST, Set[str]] = getattr(
+            mod.tree, "orlint_jit_bodies", {}
+        )
+
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                out.extend(
+                    self._check_call(mod, node, jitted_names, bodies, registry)
+                )
+        for body, statics in bodies.items():
+            out.extend(self._check_traced_branches(mod, body, statics))
+        return out
+
+    def _in_jitted_body(self, node: ast.AST, bodies) -> bool:
+        return any(fn in bodies for fn in enclosing_functions(node))
+
+    def _check_call(
+        self, mod: ParsedModule, node: ast.Call, jitted_names, bodies, registry
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        inside_jit = self._in_jitted_body(node, bodies)
+        target = resolve(node.func, mod.imports)
+        # host sync inside a traced body
+        if inside_jit:
+            attr = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else None
+            )
+            if target in _HOST_SYNC_CALLS or attr in _HOST_SYNC_ATTRS:
+                what = target or f".{attr}(..)"
+                out.append(
+                    mod.finding(
+                        "jit-host-sync",
+                        node,
+                        f"`{what}` inside a jitted body forces a host "
+                        "sync / concretization during trace",
+                    )
+                )
+            return out
+        # direct dispatch of a jitted callable outside any trace: a bare
+        # name (local or from-imported kernel) or a dotted reference into
+        # a module whose registry says the attribute is jitted
+        direct = (
+            isinstance(node.func, ast.Name) and node.func.id in jitted_names
+        )
+        if not direct and target and "." in target:
+            src_mod, _, src_name = target.rpartition(".")
+            direct = src_name in registry.get(src_mod, {})
+        if direct:
+            shown = target or node.func.id  # type: ignore[union-attr]
+            out.append(
+                mod.finding(
+                    "jit-unguarded-call",
+                    node,
+                    f"direct call of jitted `{shown}` — route through "
+                    "call_jit_guarded (ops/jit_guard.py: executable-cache "
+                    "corruption heals only under the guard)",
+                )
+            )
+        return out
+
+    def _check_traced_branches(
+        self, mod: ParsedModule, body: ast.AST, statics: Set[str]
+    ) -> List[Finding]:
+        a = body.args
+        traced = {
+            p.arg for p in a.posonlyargs + a.args + a.kwonlyargs
+        } - statics
+        out: List[Finding] = []
+        for node in ast.walk(body):
+            if isinstance(node, (ast.If, ast.While)):
+                name = _traced_name_in_test(node.test, traced)
+                if name is not None:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(
+                        mod.finding(
+                            "jit-traced-branch",
+                            node,
+                            f"Python `{kind}` on traced `{name}` inside a "
+                            "jitted body; use jax.lax.cond/while_loop or "
+                            "mark it static_argnames",
+                        )
+                    )
+        return out
+
+
+def _traced_name_in_test(test: ast.expr, traced: Set[str]) -> Optional[str]:
+    """First traced param referenced *as a value* (not via static
+    shape/dtype inspection) in a branch test."""
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in traced):
+            continue
+        parent = getattr(node, "orlint_parent", None)
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.attr in _SHAPE_ATTRS
+        ):
+            continue
+        if isinstance(parent, ast.Call) and resolve(
+            parent.func, _no_imports()
+        ) in ("len", "isinstance"):
+            continue
+        return node.id
+    return None
+
+
+_NO_IMPORTS = None
+
+
+def _no_imports():
+    global _NO_IMPORTS
+    if _NO_IMPORTS is None:
+        from openr_tpu.analysis.astutil import ImportMap
+
+        _NO_IMPORTS = ImportMap(ast.parse(""))
+    return _NO_IMPORTS

@@ -1,0 +1,63 @@
+"""Suppression comments for orlint.
+
+Two forms, both parsed from raw source lines (no tokenizer round-trip —
+a regex over each physical line is exact enough because the marker must
+live in a ``#`` comment to be legal Python on that line):
+
+* line-level — a trailing comment on the *reported* line::
+
+      self._alive_since = time.time()  # orlint: disable=clock-now (epoch, not protocol time)
+
+  Everything after the rule list is free-form justification.  Multi-line
+  statements are reported at the statement's first line; put the comment
+  there.
+
+* file-level — anywhere in the file, on its own line or trailing::
+
+      # orlint: disable-file=clock-sleep,clock-now
+
+  Use sparingly: a file-level disable also hides *future* violations in
+  that file.  Reserved for files whose entire purpose violates a rule
+  (e.g. common/runtime.py's WallClock IS the wrapper the clock rules
+  steer everyone toward).
+
+``disable=all`` suppresses every rule at that scope.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Set
+
+_LINE_RE = re.compile(r"#\s*orlint:\s*disable=([\w\-,* ]+)")
+_FILE_RE = re.compile(r"#\s*orlint:\s*disable-file=([\w\-,* ]+)")
+
+ALL = "all"
+
+
+def _parse_rules(blob: str) -> Set[str]:
+    return {r.strip() for r in blob.split(",") if r.strip()}
+
+
+class Suppressions:
+    """Parsed suppression state for one file."""
+
+    def __init__(self, source: str) -> None:
+        self.file_rules: Set[str] = set()
+        self.line_rules: Dict[int, Set[str]] = {}
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = _FILE_RE.search(line)
+            if m:
+                self.file_rules |= _parse_rules(m.group(1))
+                continue
+            m = _LINE_RE.search(line)
+            if m:
+                self.line_rules.setdefault(lineno, set()).update(
+                    _parse_rules(m.group(1))
+                )
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if ALL in self.file_rules or rule in self.file_rules:
+            return True
+        rules = self.line_rules.get(line, ())
+        return ALL in rules or rule in rules

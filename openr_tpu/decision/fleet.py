@@ -86,6 +86,7 @@ class FleetRibEngine:
         from openr_tpu.decision.cand_table import CandidateTable
         from openr_tpu.ops.csr import bucket_for, encode_multi_area
         from openr_tpu.ops.fleet_tables import fleet_multi_area_tables
+        from openr_tpu.ops.jit_guard import call_jit_guarded
 
         key = (
             tuple(
@@ -175,7 +176,8 @@ class FleetRibEngine:
                     dev["cand_node_in_area"],
                 )
             else:
-                out = fleet_multi_area_tables(
+                out = call_jit_guarded(
+                    fleet_multi_area_tables,
                     roots=jnp.asarray(padded),
                     max_degree=D,
                     per_area_distance=per_area,

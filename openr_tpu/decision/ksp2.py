@@ -96,6 +96,7 @@ class Ksp2DeviceEngine:
         import jax.numpy as jnp
 
         from openr_tpu.ops.csr import bucket_for
+        from openr_tpu.ops.jit_guard import call_jit_guarded
         from openr_tpu.ops.spf import batched_spf_distances_masked
 
         topo = self.topo
@@ -105,7 +106,8 @@ class Ksp2DeviceEngine:
         ignore_ids = ignore_ids + [[]] * (padded - n)
         masks = link_failure_batch(topo, ignore_ids)
         roots = np.full(padded, topo.node_id(self.root), np.int32)
-        dist = batched_spf_distances_masked(
+        dist = call_jit_guarded(
+            batched_spf_distances_masked,
             jnp.asarray(topo.src),
             jnp.asarray(topo.dst),
             jnp.asarray(topo.w),

@@ -604,7 +604,8 @@ class MultiAreaWhatIfEngine:
                 )
             )
         if st["base_dist"] is None:
-            dist, _nh = multi_area_spf_tables(
+            dist, _nh = call_jit_guarded(
+                multi_area_spf_tables,
                 kernel_args["src"],
                 kernel_args["dst"],
                 kernel_args["w"],

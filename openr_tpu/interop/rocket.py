@@ -29,6 +29,7 @@ import logging
 from dataclasses import dataclass
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from openr_tpu.common.runtime import Clock, WallClock
 from openr_tpu.interop import rsocket as rs
 from openr_tpu.interop.compact import decode_struct, encode_struct
 
@@ -191,12 +192,14 @@ class RocketClient:
         ssl=None,
         setup: Optional[dict] = None,
         keepalive_ms: int = KEEPALIVE_MS,
+        clock: Optional[Clock] = None,
     ):
         self.host = host
         self.port = port
         self._ssl = ssl
         self._setup = setup
         self._keepalive_ms = keepalive_ms
+        self._clock = clock if clock is not None else WallClock()
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1, 2)  # client streams are odd
@@ -266,7 +269,7 @@ class RocketClient:
     async def _keepalive_loop(self) -> None:
         try:
             while True:
-                await asyncio.sleep(self._keepalive_ms / 1000.0)
+                await self._clock.sleep(self._keepalive_ms / 1000.0)
                 self._writer.write(
                     rs.frame_stream(rs.encode_keepalive(0, respond=True))
                 )

@@ -45,6 +45,7 @@ from openr_tpu.lsdb_codec import serialize_adj_db as _serialize_adj_db
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.monitor.monitor import Monitor
 from openr_tpu.neighbor_monitor import NeighborMonitor
+from openr_tpu.ops import jit_guard
 from openr_tpu.plugin import PluginArgs, PluginManager
 from openr_tpu.policy import PolicyManager
 from openr_tpu.prefix_manager.prefix_manager import PrefixManager
@@ -67,10 +68,11 @@ class InitializationTracker:
         InitializationEvent.PREFIX_DB_SYNCED,
     ]
 
-    def __init__(self) -> None:
-        import time as _time
+    def __init__(self, clock: Optional[Clock] = None) -> None:
+        from openr_tpu.common.runtime import WallClock
 
-        self._t0 = _time.monotonic()
+        self._clock = clock if clock is not None else WallClock()
+        self._t0 = self._clock.now()
         self.events: List[InitializationEvent] = [
             InitializationEvent.INITIALIZING
         ]
@@ -82,12 +84,10 @@ class InitializationTracker:
         self._listeners: List = []
 
     def on_event(self, ev: InitializationEvent) -> None:
-        import time as _time
-
         if ev in self.events:
             return
         self.events.append(ev)
-        self.event_ms[ev] = (_time.monotonic() - self._t0) * 1000.0
+        self.event_ms[ev] = (self._clock.now() - self._t0) * 1000.0
         for listener in self._listeners:
             listener(ev)
         if ev != InitializationEvent.INITIALIZED and all(
@@ -153,7 +153,7 @@ class OpenrNode:
         self.clock = clock
         self.name = config.node_name
         self.counters = CounterMap()
-        self.init_tracker = InitializationTracker()
+        self.init_tracker = InitializationTracker(clock)
         areas = config.area_ids()
 
         # -- queues (Main.cpp:152-226) ------------------------------------
@@ -357,6 +357,7 @@ class OpenrNode:
         # can watch the recovery machinery work
         self.monitor.add_counter_provider(self.fib.retry_state)
         self.monitor.add_counter_provider(backend.counter_snapshot)
+        self.monitor.add_counter_provider(jit_guard.counter_snapshot)
         self.watchdog: Optional[Watchdog] = None
         if config.enable_watchdog:
             wd = config.watchdog_config

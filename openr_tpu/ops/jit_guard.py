@@ -22,7 +22,20 @@ through this guard.
 
 from __future__ import annotations
 
+from typing import Dict
+
 _SIGNATURE = "buffers but compiled program expected"
+
+#: guard-trip tally, exported into Monitor's gauge sweep via
+#: `counter_snapshot` (main.py registers it with add_counter_provider)
+#: so corruption heals show up in prod counter dumps instead of only in
+#: a log line nobody tails
+_counters: Dict[str, float] = {"jit_guard.cache_clear": 0.0}
+
+
+def counter_snapshot() -> Dict[str, float]:
+    """Gauge provider for Monitor.add_counter_provider."""
+    return dict(_counters)
 
 
 def call_jit_guarded(fn, *args, **kwargs):
@@ -42,4 +55,5 @@ def call_jit_guarded(fn, *args, **kwargs):
             e,
         )
         jax.clear_caches()
+        _counters["jit_guard.cache_clear"] += 1
         return fn(*args, **kwargs)

@@ -121,7 +121,8 @@ def fallback_to_cpu_if_unreachable(timeout_s: float = 120.0) -> bool:
         return False  # explicit CPU request: nothing to probe
     try:
         if (
-            _time.time() - os.path.getmtime(_ACCEL_OK_MARKER)
+            _time.time()  # orlint: disable=clock-now (epoch, compared against file mtime)
+            - os.path.getmtime(_ACCEL_OK_MARKER)
             < _ACCEL_OK_TTL_S
         ):
             return False  # probed healthy moments ago
@@ -158,7 +159,7 @@ def fallback_to_cpu_if_unreachable(timeout_s: float = 120.0) -> bool:
     if ok:
         try:
             with open(_ACCEL_OK_MARKER, "w") as f:
-                f.write(str(_time.time()))
+                f.write(str(_time.time()))  # orlint: disable=clock-now (epoch marker-file payload)
         except OSError:
             pass
         return False

@@ -287,6 +287,7 @@ class LinkFailureSweep:
             import jax
             import jax.numpy as jnp
 
+            from openr_tpu.ops.jit_guard import call_jit_guarded
             from openr_tpu.ops.spf import (
                 sweep_spf_link_failures,
                 unpack_lanes,
@@ -328,7 +329,8 @@ class LinkFailureSweep:
                     exc_info=True,
                 )
                 self.base_source = "device"
-            dist, nh = sweep_spf_link_failures(
+            dist, nh = call_jit_guarded(
+                sweep_spf_link_failures,
                 self._src,
                 self._dst,
                 self._w,

@@ -108,7 +108,7 @@ class NetlinkFibHandler:
     def __init__(self, nl_sock: BaseNetlinkProtocolSocket) -> None:
         self.nl = nl_sock
         self.counters = CounterMap()
-        self._alive_since = time.time()
+        self._alive_since = time.time()  # orlint: disable=clock-now (epoch aliveSince for the thrift API, not protocol time)
         self._unicast: Dict[int, Dict[str, UnicastRoute]] = {}
         self._mpls: Dict[int, Dict[int, MplsRoute]] = {}
         self._if_name_to_index: Dict[str, int] = {}

@@ -99,7 +99,7 @@ class Watchdog(Actor):
                 if crash_reason is None:
                     crash_reason = f"Module {actor.name} fiber died"
                 continue
-            if not actor._stopped:
+            if actor.healthy:
                 # The asyncio analogue of the reference's no-op evb timer:
                 # a live, uncrashed actor gets its timestamp refreshed.  An
                 # idle module on a quiet network is healthy, not stuck.

@@ -1,0 +1,75 @@
+"""call_jit_guarded: error passthrough + observable guard trips.
+
+The guard exists for exactly one failure (the jax-0.9.0 executable-cache
+corruption, ops/jit_guard.py docstring); anything else must propagate
+untouched, and every heal must be visible in prod counter dumps via the
+`jit_guard.cache_clear` gauge (registered with
+Monitor.add_counter_provider in main.py).
+"""
+
+import pytest
+
+from openr_tpu.ops import jit_guard
+from openr_tpu.ops.jit_guard import call_jit_guarded, counter_snapshot
+
+
+def test_non_matching_value_error_propagates_unchanged():
+    err = ValueError("some unrelated shape problem")
+
+    def fn():
+        raise err
+
+    before = counter_snapshot()["jit_guard.cache_clear"]
+    with pytest.raises(ValueError) as ei:
+        call_jit_guarded(fn)
+    assert ei.value is err  # same object, not rewrapped
+    assert counter_snapshot()["jit_guard.cache_clear"] == before
+
+
+def test_non_value_error_propagates():
+    with pytest.raises(TypeError):
+        call_jit_guarded(lambda: (_ for _ in ()).throw(TypeError("boom")))
+
+
+def test_signature_match_clears_retries_and_counts(monkeypatch):
+    import jax
+
+    cleared = []
+    monkeypatch.setattr(jax, "clear_caches", lambda: cleared.append(True))
+
+    calls = []
+
+    def flaky():
+        calls.append(True)
+        if len(calls) == 1:
+            raise ValueError(
+                "INVALID_ARGUMENT: Execution supplied 3 buffers but "
+                "compiled program expected 5 buffers"
+            )
+        return 42
+
+    before = counter_snapshot()["jit_guard.cache_clear"]
+    assert call_jit_guarded(flaky) == 42
+    assert cleared == [True]
+    assert len(calls) == 2
+    assert counter_snapshot()["jit_guard.cache_clear"] == before + 1
+
+
+def test_second_failure_propagates(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "clear_caches", lambda: None)
+
+    def always_corrupt():
+        raise ValueError("supplied 1 buffers but compiled program expected 2")
+
+    with pytest.raises(ValueError):
+        call_jit_guarded(always_corrupt)
+
+
+def test_counter_snapshot_is_a_copy():
+    baseline = jit_guard._counters["jit_guard.cache_clear"]
+    snap = counter_snapshot()
+    snap["jit_guard.cache_clear"] += 100
+    assert jit_guard._counters["jit_guard.cache_clear"] == baseline
+    assert counter_snapshot()["jit_guard.cache_clear"] == baseline

@@ -1,0 +1,448 @@
+"""orlint self-tests + the tier-1 static-invariant gate.
+
+Three layers:
+
+* fixture snippets that must trip each rule, and the same snippets with a
+  suppression comment that must pass — the linter's own regression suite;
+* baseline machinery round-trips (dump/load/apply, stale detection) and a
+  meta-test that every checked-in ``analysis/baseline.json`` entry still
+  points at a real file whose text still contains the offending line;
+* the gate itself: ``python -m openr_tpu.analysis --check`` must exit 0
+  on the repo as committed.  A new violation anywhere in ``openr_tpu/``
+  fails THIS test — fix it or suppress it with a justification; only
+  regenerate the baseline after fixing, never instead of fixing.
+"""
+
+import json
+
+import pytest
+
+from openr_tpu.analysis import (
+    Baseline,
+    analyze_modules,
+    analyze_source,
+    default_baseline_path,
+    repo_root,
+)
+from openr_tpu.analysis.__main__ import main as orlint_main
+from openr_tpu.analysis.passes import all_rules
+from openr_tpu.analysis.passes.base import ParsedModule
+
+# ---------------------------------------------------------------------------
+# fixtures: one per rule — (source, context sources, line that must trip)
+# ---------------------------------------------------------------------------
+
+ACTOR_CTX = """\
+from openr_tpu.common.runtime import Actor
+
+class Spark(Actor):
+    pass
+
+class KvStore(Actor):
+    pass
+"""
+
+JIT_CTX = """\
+import jax
+
+@jax.jit
+def kernel(x):
+    return x * 2
+"""
+
+FIXTURES = {
+    "clock-sleep": (
+        "import asyncio\n"
+        "\n"
+        "async def retry_loop():\n"
+        "    await asyncio.sleep(0.5)\n",
+        (),
+        4,
+    ),
+    "clock-now": (
+        "import time as _time\n"
+        "\n"
+        "def deadline():\n"
+        "    return _time.monotonic() + 5.0\n",
+        (),
+        4,
+    ),
+    "clock-call-later": (
+        "def arm(loop, cb):\n"
+        "    loop.call_later(1.0, cb)\n",
+        (),
+        2,
+    ),
+    "actor-cross-write": (
+        "from ctx0 import Spark\n"
+        "\n"
+        "def poke(spark: Spark) -> None:\n"
+        "    spark.neighbors = {}\n",
+        (ACTOR_CTX,),
+        4,
+    ),
+    "actor-private-access": (
+        "from ctx0 import KvStore\n"
+        "\n"
+        "def peek(kv: KvStore):\n"
+        "    return kv._db\n",
+        (ACTOR_CTX,),
+        4,
+    ),
+    "jit-unguarded-call": (
+        "from ctx0 import kernel\n"
+        "\n"
+        "def run(v):\n"
+        "    return kernel(v)\n",
+        (JIT_CTX,),
+        4,
+    ),
+    "jit-traced-branch": (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def clamp(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n",
+        (),
+        5,
+    ),
+    "jit-host-sync": (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def bad(x):\n"
+        "    return x.block_until_ready()\n",
+        (),
+        5,
+    ),
+    "async-blocking": (
+        "class Loader:\n"
+        "    async def load(self, path):\n"
+        "        return open(path).read()\n",
+        (),
+        3,
+    ),
+}
+
+
+def test_fixtures_cover_every_rule():
+    assert set(FIXTURES) == set(all_rules())
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_trips_on_fixture(rule):
+    src, ctx, line = FIXTURES[rule]
+    findings = analyze_source(src, context=ctx)
+    assert [
+        (f.rule, f.line) for f in findings
+    ] == [(rule, line)], f"{rule} fixture: {findings}"
+    # finding carries the offending line text for baseline matching
+    assert findings[0].snippet == src.splitlines()[line - 1].strip()
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_line_suppression_silences_rule(rule):
+    src, ctx, line = FIXTURES[rule]
+    lines = src.splitlines()
+    lines[line - 1] += f"  # orlint: disable={rule} (test justification)"
+    assert analyze_source("\n".join(lines) + "\n", context=ctx) == []
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_file_suppression_silences_rule(rule):
+    src, ctx, _ = FIXTURES[rule]
+    src = f"# orlint: disable-file={rule}\n" + src
+    assert analyze_source(src, context=ctx) == []
+
+
+def test_suppressed_findings_are_reported_not_dropped():
+    src, ctx, line = FIXTURES["clock-sleep"]
+    lines = src.splitlines()
+    lines[line - 1] += "  # orlint: disable=clock-sleep (why)"
+    mods = [ParsedModule.parse("snippet.py", "\n".join(lines) + "\n")]
+    report = analyze_modules(mods)
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["clock-sleep"]
+
+
+# ---------------------------------------------------------------------------
+# negatives: the idioms each rule must NOT flag
+# ---------------------------------------------------------------------------
+
+
+def test_asyncio_sleep_zero_is_a_yield_not_a_sleep():
+    src = "import asyncio\n\nasync def f():\n    await asyncio.sleep(0)\n"
+    assert analyze_source(src) == []
+
+
+def test_clock_sleep_through_injected_clock_is_clean():
+    src = (
+        "async def f(clock):\n"
+        "    await clock.sleep(1.0)\n"
+        "    return clock.now()\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_same_class_private_access_is_exempt():
+    src = (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "class KvStore(Actor):\n"
+        "    def merge(self, other: 'KvStore'):\n"
+        "        other._db = {}\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_public_read_of_actor_attr_is_clean():
+    src = (
+        "from ctx0 import Spark\n"
+        "\n"
+        "def describe(spark: Spark):\n"
+        "    return spark.name\n"
+    )
+    assert analyze_source(src, context=(ACTOR_CTX,)) == []
+
+
+def test_call_jit_guarded_dispatch_is_clean():
+    src = (
+        "from ctx0 import kernel\n"
+        "from openr_tpu.ops.jit_guard import call_jit_guarded\n"
+        "\n"
+        "def run(v):\n"
+        "    return call_jit_guarded(kernel, v)\n"
+    )
+    assert analyze_source(src, context=(JIT_CTX,)) == []
+
+
+def test_jitted_call_inside_jitted_body_is_exempt():
+    src = (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def inner(x):\n"
+        "    return x + 1\n"
+        "\n"
+        "@jax.jit\n"
+        "def outer(x):\n"
+        "    return inner(x)\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_local_direct_jitted_call_trips():
+    src = (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    return x + 1\n"
+        "\n"
+        "def run(v):\n"
+        "    return kernel(v)\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == ["jit-unguarded-call"]
+
+
+def test_jit_assignment_form_is_tracked():
+    src = (
+        "import jax\n"
+        "\n"
+        "def _impl(x):\n"
+        "    return x + 1\n"
+        "\n"
+        "kernel = jax.jit(_impl, static_argnames=('n',))\n"
+        "\n"
+        "def run(v):\n"
+        "    return kernel(v)\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == ["jit-unguarded-call"]
+
+
+def test_shape_branch_is_static_not_traced():
+    src = (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x.ndim > 1:\n"
+        "        return x.sum()\n"
+        "    return x\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_static_argnames_param_branch_is_clean():
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "\n"
+        "@functools.partial(jax.jit, static_argnames=('n',))\n"
+        "def f(x, n):\n"
+        "    if n > 3:\n"
+        "        return x * n\n"
+        "    return x\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_awaited_recv_is_an_async_transport_not_blocking():
+    src = (
+        "class T:\n"
+        "    async def pump(self, sock):\n"
+        "        return await sock.recv(1024)\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_sync_helper_nested_in_async_def_is_skipped():
+    src = (
+        "class T:\n"
+        "    async def load(self, loop, path):\n"
+        "        def _read():\n"
+        "            return open(path).read()\n"
+        "        return await loop.run_in_executor(None, _read)\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_non_protocol_trees_are_out_of_scope():
+    src = "import time\n\ndef fmt():\n    return time.time()\n"
+    mods = [ParsedModule.parse("openr_tpu/cli/breeze.py", src)]
+    assert analyze_modules(mods).findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+
+
+def _mods_for(rule):
+    src, ctx, _ = FIXTURES[rule]
+    mods = [ParsedModule.parse("snippet.py", src)]
+    for i, c in enumerate(ctx):
+        mods.append(ParsedModule.parse(f"ctx{i}.py", c))
+    return mods
+
+
+def test_baseline_round_trip(tmp_path):
+    mods = _mods_for("clock-sleep")
+    found = analyze_modules(mods).findings
+    assert found
+    path = tmp_path / "baseline.json"
+    Baseline.from_findings(found).dump(path)
+    report = analyze_modules(mods, Baseline.load(path))
+    assert report.findings == []
+    assert [f.rule for f in report.baselined] == ["clock-sleep"]
+    assert report.stale_baseline == []
+
+
+def test_baseline_matching_survives_line_drift(tmp_path):
+    src, _, _ = FIXTURES["clock-sleep"]
+    path = tmp_path / "baseline.json"
+    found = analyze_modules([ParsedModule.parse("snippet.py", src)]).findings
+    Baseline.from_findings(found).dump(path)
+    # unrelated edit above the grandfathered hit must not resurrect it
+    drifted = "import os  # new unrelated import\n" + src
+    report = analyze_modules(
+        [ParsedModule.parse("snippet.py", drifted)], Baseline.load(path)
+    )
+    assert report.findings == []
+    assert len(report.baselined) == 1
+
+
+def test_baseline_goes_stale_when_finding_is_fixed(tmp_path):
+    src, _, line = FIXTURES["clock-sleep"]
+    path = tmp_path / "baseline.json"
+    found = analyze_modules([ParsedModule.parse("snippet.py", src)]).findings
+    Baseline.from_findings(found).dump(path)
+    fixed = src.replace("asyncio.sleep(0.5)", "clock.sleep(0.5)")
+    report = analyze_modules(
+        [ParsedModule.parse("snippet.py", fixed)], Baseline.load(path)
+    )
+    assert report.findings == []
+    assert [e.rule for e in report.stale_baseline] == ["clock-sleep"]
+
+
+def test_checked_in_baseline_entries_are_fresh():
+    """Meta-test: every baseline.json entry must still point at an
+    existing file whose text still contains the offending line — the
+    ratchet that forces dead entries out after a fix."""
+    baseline = Baseline.load(default_baseline_path())
+    root = repo_root()
+    for e in baseline.entries:
+        target = root / e.path
+        assert target.is_file(), f"baseline entry for vanished file {e.path}"
+        lines = [ln.strip() for ln in target.read_text().splitlines()]
+        assert e.snippet in lines, (
+            f"baseline entry {e.rule}@{e.path} no longer matches any line; "
+            "fix was landed — regenerate with --update-baseline"
+        )
+        assert 1 <= e.line <= len(lines), f"baseline line out of range: {e}"
+
+
+# ---------------------------------------------------------------------------
+# the gate + CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_under_check():
+    """THE tier-1 gate: the repo as committed has no unsuppressed,
+    unbaselined invariant violations."""
+    assert orlint_main(["--check"]) == 0
+
+
+def test_check_fails_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["clock-sleep"][0])
+    assert orlint_main([str(bad), "--check", "--no-baseline"]) == 1
+
+
+def test_json_format_reports_counts(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["clock-now"][0])
+    rc = orlint_main([str(bad), "--format=json", "--no-baseline"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0  # json mode without --check reports, never gates
+    assert doc["files_scanned"] == 1
+    assert doc["counts"] == {"clock-now": 1}
+    assert doc["findings"][0]["rule"] == "clock-now"
+    assert {"path", "line", "col", "message", "snippet"} <= set(
+        doc["findings"][0]
+    )
+
+
+def test_rule_filter(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["clock-now"][0] + FIXTURES["clock-call-later"][0])
+    rc = orlint_main(
+        [str(bad), "--format=json", "--no-baseline", "--rule", "clock-now"]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["counts"] == {"clock-now": 1}
+
+
+def test_list_rules(capsys):
+    assert orlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in FIXTURES:
+        assert rule in out
+
+
+def test_module_entry_point():
+    """`python -m openr_tpu.analysis --check` is what CI scripts call."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "openr_tpu.analysis", "--check"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=str(repo_root()),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
