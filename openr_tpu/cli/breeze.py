@@ -423,6 +423,97 @@ def monitor_logs(ctx: click.Context, prefix: str, json_out: bool) -> None:
             click.echo(line)
 
 
+@monitor.command("trace")
+@click.option("--trace-id", default="", help="show one trace only")
+@click.option("--limit", default=0, help="newest N spans only")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def monitor_trace(
+    ctx: click.Context, trace_id: str, limit: int, json_out: bool
+) -> None:
+    """Convergence-trace span trees (event origin → FIB ack).
+
+    Each line: indented span name, duration, node/module, and key attrs;
+    one tree per trace id, children under their parent span.  See
+    docs/Observability.md for the span taxonomy."""
+    spans = _call(ctx, "get_traces", trace_id=trace_id, limit=limit)
+    if json_out:
+        _print(spans)
+        return
+    if not spans:
+        click.echo("no completed spans (tracing disabled or no events yet)")
+        return
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    for tid, tspans in by_trace.items():
+        ids = {s["span_id"] for s in tspans}
+        children: dict = {}
+        roots = []
+        for s in sorted(tspans, key=lambda x: (x["start_ms"], x["span_id"])):
+            if s["parent_id"] and s["parent_id"] in ids:
+                children.setdefault(s["parent_id"], []).append(s)
+            else:
+                roots.append(s)
+        t0 = min(s["start_ms"] for s in tspans)
+        click.echo(f"trace {tid}:")
+
+        def render(s, depth):
+            dur = s.get("duration_ms")
+            dur_s = f"{dur:.3f}ms" if dur is not None else "open"
+            attrs = {
+                k: v
+                for k, v in (s.get("attrs") or {}).items()
+                if k not in ("trace_id",)
+            }
+            extra = (
+                " " + " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+                if attrs
+                else ""
+            )
+            click.echo(
+                f"  {'  ' * depth}+{s['start_ms'] - t0:8.3f}ms "
+                f"{s['name']}  [{s['node']}]  {dur_s}{extra}"
+            )
+            for c in children.get(s["span_id"], []):
+                render(c, depth + 1)
+
+        for r in roots:
+            render(r, 0)
+
+
+@monitor.command("histograms")
+@click.option("--prefix", default="", help="histogram-key prefix filter")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def monitor_histograms(
+    ctx: click.Context, prefix: str, json_out: bool
+) -> None:
+    """Latency percentiles (p50/p95/p99) per histogram key — e.g.
+    convergence.event_to_fib_ms, decision.spf_kernel_ms."""
+    hists = _call(ctx, "get_histograms", prefix=prefix)
+    if json_out:
+        _print(hists)
+        return
+    if not hists:
+        click.echo("no histograms observed yet")
+        return
+    width = max(len(k) for k in hists)
+    click.echo(
+        f"{'key':<{width}}  {'count':>7}  {'p50':>10}  {'p95':>10}  "
+        f"{'p99':>10}  {'max':>10}"
+    )
+    for k, h in sorted(hists.items()):
+        def fmt(v):
+            return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+        click.echo(
+            f"{k:<{width}}  {h.get('count', 0):>7}  {fmt(h.get('p50')):>10}  "
+            f"{fmt(h.get('p95')):>10}  {fmt(h.get('p99')):>10}  "
+            f"{fmt(h.get('max')):>10}"
+        )
+
+
 @monitor.command("statistics")
 @click.pass_context
 def monitor_statistics(ctx: click.Context) -> None:

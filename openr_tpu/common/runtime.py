@@ -104,11 +104,140 @@ class SimClock(Clock):
 # ---------------------------------------------------------------------------
 
 
+class Histogram:
+    """Fixed-bucket geometric latency histogram (HdrHistogram-flavored).
+
+    Bucket 0 covers [0, min_bound]; bucket i covers (edge(i-1), edge(i)]
+    with edge(i) = min_bound * growth**i; one overflow bucket absorbs
+    values beyond the last edge.  The default config spans 0.01 ms to
+    ~12 h in 160 buckets (~15% relative error per bucket), matching the
+    fb303 EXPORT_HISTOGRAM role: cheap O(1) observe on the hot path,
+    percentile estimates via in-bucket linear interpolation.
+    Two histograms with identical (min_bound, growth, buckets) merge by
+    bucket-count addition (cross-node aggregation in bench/emulation).
+    """
+
+    __slots__ = (
+        "min_bound", "growth", "edges", "counts",
+        "count", "total", "vmin", "vmax",
+    )
+
+    def __init__(
+        self,
+        min_bound: float = 0.01,
+        growth: float = 1.15,
+        num_buckets: int = 160,
+    ) -> None:
+        self.min_bound = float(min_bound)
+        self.growth = float(growth)
+        #: edges[i] == inclusive UPPER bound of bucket i
+        self.edges: List[float] = [
+            self.min_bound * self.growth ** i for i in range(num_buckets)
+        ]
+        #: one count per edge bucket + one overflow bucket
+        self.counts: List[int] = [0] * (num_buckets + 1)
+        self.count = 0
+        self.total = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+
+    def bucket_index(self, value: float) -> int:
+        """First bucket whose upper edge is >= value (overflow = last)."""
+        import bisect
+
+        if value <= self.min_bound:
+            return 0
+        return bisect.bisect_left(self.edges, value)
+
+    def bucket_bounds(self, i: int) -> tuple:
+        """(lower_exclusive, upper_inclusive) of bucket i; the overflow
+        bucket's upper bound is the observed max (inf when empty)."""
+        lo = 0.0 if i == 0 else self.edges[i - 1]
+        if i < len(self.edges):
+            return lo, self.edges[i]
+        return lo, self.vmax if self.vmax is not None else float("inf")
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[self.bucket_index(v)] += 1
+        self.count += 1
+        self.total += v
+        if self.vmin is None or v < self.vmin:
+            self.vmin = v
+        if self.vmax is None or v > self.vmax:
+            self.vmax = v
+
+    def percentile(self, pct: float) -> Optional[float]:
+        """Estimated value at `pct` (0-100): linear interpolation within
+        the containing bucket, clamped to the observed [min, max] so
+        single-valued populations report exactly that value."""
+        if self.count == 0:
+            return None
+        rank = (pct / 100.0) * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo, hi = self.bucket_bounds(i)
+                frac = min(max((rank - cum) / c, 0.0), 1.0)
+                v = lo + (hi - lo) * frac
+                return min(max(v, self.vmin), self.vmax)
+            cum += c
+        return self.vmax
+
+    def percentiles(self, pcts=(50, 95, 99)) -> Dict[str, Optional[float]]:
+        return {f"p{g:g}": self.percentile(g) for g in pcts}
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """In-place bucket-count addition; configs must match exactly."""
+        if (
+            self.min_bound != other.min_bound
+            or self.growth != other.growth
+            or len(self.counts) != len(other.counts)
+        ):
+            raise ValueError("histogram configs differ; cannot merge")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        for v in (other.vmin, other.vmax):
+            if v is None:
+                continue
+            if self.vmin is None or v < self.vmin:
+                self.vmin = v
+            if self.vmax is None or v > self.vmax:
+                self.vmax = v
+        return self
+
+    def copy(self) -> "Histogram":
+        h = Histogram(self.min_bound, self.growth, len(self.edges))
+        h.counts = list(self.counts)
+        h.count, h.total = self.count, self.total
+        h.vmin, h.vmax = self.vmin, self.vmax
+        return h
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ctrl-API / breeze wire form."""
+        out: Dict[str, Any] = {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin,
+            "max": self.vmax,
+        }
+        out.update(self.percentiles())
+        return out
+
+
 class CounterMap:
-    """Flat counter namespace; `dump()` feeds the ctrl API `getCounters`."""
+    """Flat counter namespace; `dump()` feeds the ctrl API `getCounters`.
+    Also hosts the histogram namespace (`observe`/`percentiles`) backing
+    the ctrl API `getHistograms` — latency distributions live next to the
+    gauges they explain."""
 
     def __init__(self) -> None:
         self._counters: Dict[str, float] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     def bump(self, key: str, delta: float = 1) -> None:
         self._counters[key] = self._counters.get(key, 0) + delta
@@ -124,8 +253,34 @@ class CounterMap:
             return dict(self._counters)
         return {k: v for k, v in self._counters.items() if k.startswith(prefix)}
 
+    # -- histograms --------------------------------------------------------
+
+    def observe(self, key: str, value: float) -> None:
+        """Record one sample into the named histogram (created on first
+        observe with the default bucket config)."""
+        h = self._histograms.get(key)
+        if h is None:
+            h = self._histograms[key] = Histogram()
+        h.observe(value)
+
+    def histogram(self, key: str) -> Optional[Histogram]:
+        return self._histograms.get(key)
+
+    def percentiles(self, key: str, pcts=(50, 95, 99)):
+        """{"p50": .., "p95": .., "p99": ..} or None when never observed."""
+        h = self._histograms.get(key)
+        return None if h is None else h.percentiles(pcts)
+
+    def dump_histograms(self, prefix: str = "") -> Dict[str, Dict]:
+        return {
+            k: h.snapshot()
+            for k, h in self._histograms.items()
+            if not prefix or k.startswith(prefix)
+        }
+
     def clear(self) -> None:
         self._counters.clear()
+        self._histograms.clear()
 
 
 class Actor:

@@ -130,6 +130,21 @@ class MonitorConfig:
 
 
 @dataclass
+class TracingConfig:
+    """Causal convergence tracing (openr_tpu.tracing).  Enabled by
+    default: span volume is bounded by event rate (neighbor/interface
+    flaps, rebuilds), not data scale, and the ring caps memory.  Disable
+    for a zero-overhead no-op fast path."""
+
+    enabled: bool = True
+    #: completed-span ring size per node (oldest evicted, counted)
+    max_spans: int = 4096
+    #: open-span table cap: spans started but never closed past this are
+    #: dropped and counted (`trace.dropped_spans`)
+    max_open_spans: int = 512
+
+
+@dataclass
 class OriginatedPrefix:
     """Config-originated prefix w/ optional aggregation
     (OpenrConfig.thrift:345-441)."""
@@ -212,6 +227,7 @@ class OpenrConfig:
     watchdog_config: WatchdogConfig = field(default_factory=WatchdogConfig)
     fib_config: FibConfig = field(default_factory=FibConfig)
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
+    tracing_config: TracingConfig = field(default_factory=TracingConfig)
     originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
     segment_routing_config: SegmentRoutingConfig = field(
         default_factory=SegmentRoutingConfig

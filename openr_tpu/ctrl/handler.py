@@ -799,6 +799,27 @@ class OpenrCtrlHandler:
     def get_event_logs(self) -> List[str]:
         return self.node.monitor.get_event_logs()
 
+    def get_traces(
+        self, trace_id: str = "", limit: int = 0
+    ) -> List[dict]:
+        """Completed convergence-trace spans (openr_tpu.tracing), oldest
+        first; `trace_id` narrows to one trace, `limit` keeps the newest
+        N spans.  `breeze monitor trace` renders these as trees."""
+        spans = self.node.tracer.get_spans(trace_id or None)
+        if limit:
+            spans = spans[-limit:]
+        return [s.to_wire() for s in spans]
+
+    def get_trace_ids(self) -> List[str]:
+        """Distinct trace ids currently held in the span ring."""
+        return self.node.tracer.trace_ids()
+
+    def get_histograms(self, prefix: str = "") -> Dict[str, dict]:
+        """Latency-histogram snapshots (count/sum/min/max + p50/p95/p99)
+        per key — `convergence.event_to_fib_ms`, `decision.spf_kernel_ms`
+        et al.  `breeze monitor histograms` tabulates these."""
+        return self.node.counters.dump_histograms(prefix)
+
     # ------------------------------------------------------------- streaming
     # (OpenrCtrlHandler.h:364-399)
 

@@ -65,6 +65,7 @@ async def run_emulation(
     verbose: bool = True,
     use_tpu_backend: bool = False,
     supervise: bool = False,
+    trace_export: str = "",
 ) -> None:
     from openr_tpu.emulation.network import EmulatedNetwork
     from openr_tpu.emulation.topology import grid_edges, line_edges, ring_edges
@@ -148,6 +149,12 @@ async def run_emulation(
     await stop.wait()
     if supervisor is not None:
         await supervisor.stop()
+    if trace_export:
+        # dump the whole run's span set viewer-ready (chrome://tracing /
+        # ui.perfetto.dev) before teardown
+        num = net.export_trace(trace_export)
+        if verbose:
+            print(f"wrote {num} trace events to {trace_export}")
     for s in servers:
         await s.stop()
     await net.stop()
@@ -277,6 +284,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="with --emulate: watchdog crashes restart the "
                         "affected node in place (crash-recovery loop) "
                         "instead of aborting the process")
+    p.add_argument("--trace-export", default="", metavar="PATH",
+                   help="with --emulate: on shutdown, write all nodes' "
+                        "convergence-trace spans as a Chrome-trace/"
+                        "Perfetto file")
     p.add_argument("--ctrl-host", default="",
                    help="ctrl server bind address in --real mode "
                         "(default: all interfaces)")
@@ -293,6 +304,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                 args.ctrl_base_port or 2018,
                 use_tpu_backend=args.tpu,
                 supervise=args.supervise,
+                trace_export=args.trace_export,
             )
         )
         return

@@ -84,8 +84,12 @@ class Decision(Actor):
         initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
         counters: Optional[CounterMap] = None,
         rib_policy_file: str = "",
+        tracer=None,
     ) -> None:
         super().__init__("decision", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.tracer = tracer if tracer is not None else disabled_tracer()
         self.node_name = node_name
         self.config = config
         self.route_updates_queue = route_updates_queue
@@ -100,6 +104,10 @@ class Decision(Actor):
         self.route_db = DecisionRouteDb()
         self.rib_policy: Optional[RibPolicy] = None
         self.pending_perf_events: Optional[PerfEvents] = None
+        #: trace context of the newest LSDB change awaiting the debounced
+        #: rebuild (the debounce coalesces; the span tree reflects the
+        #: LAST event, matching pending_perf_events semantics)
+        self.pending_trace_ctx = None
         # initialization gating (Decision.cpp:963-1011)
         self._kvstore_synced = False
         self._unblocked = False
@@ -218,6 +226,10 @@ class Decision(Actor):
     def _on_publication_inner(self, pub: Publication) -> None:
         changed = False
         area = pub.area
+        if pub.trace_ctx is not None:
+            # flooding-metadata context; an adj payload below may replace
+            # it with the origin-rooted one embedded in the LSDB value
+            self.pending_trace_ctx = pub.trace_ctx
         bulk_items = None
         if len(pub.key_vals) >= self.BULK_INGEST_MIN:
             from openr_tpu.decision.ingest import get_bulk_decoder
@@ -288,6 +300,11 @@ class Decision(Actor):
                 return False
             if adj_db.perf_events is not None:
                 self.pending_perf_events = adj_db.perf_events
+                if adj_db.perf_events.trace_context is not None:
+                    # payload-embedded context survives KvStore storage:
+                    # prefer it so full-sync-delivered keys still join
+                    # the originating event's trace
+                    self.pending_trace_ctx = adj_db.perf_events.trace_context
             ls = self._get_link_state(area)
             change = ls.update_adjacency_database(adj_db)
             if change.topology_changed or change.node_label_changed:
@@ -393,6 +410,16 @@ class Decision(Actor):
     def _rebuild_routes_inner(self) -> None:
         self._rebuild_pending = False
         t0 = self.clock.now()
+        trace_ctx, self.pending_trace_ctx = self.pending_trace_ctx, None
+        rebuild_span = self.tracer.start_span(
+            "decision.rebuild", trace_ctx, module="decision"
+        )
+        try:
+            self._rebuild_routes_traced(t0, trace_ctx, rebuild_span)
+        finally:
+            self.tracer.end_span(rebuild_span)
+
+    def _rebuild_routes_traced(self, t0, trace_ctx, rebuild_span) -> None:
         policy_active = self.rib_policy is not None and self.rib_policy.is_active(
             self.clock
         )
@@ -414,13 +441,36 @@ class Decision(Actor):
         self._last_policy_active = policy_active
         if not force_full and changed:
             self.counters.bump("decision.incremental_route_builds")
-        new_db = self.backend.build_route_db(
-            self.area_link_states,
-            self.prefix_state,
-            changed_prefixes=changed if self._first_build_done else None,
+        # SPF dispatch span: the backend call (scalar solve or device
+        # kernel pipeline); guarded jitted dispatches inside it record
+        # `decision.spf_kernel` child spans via the jit_guard trace scope
+        spf_span = self.tracer.start_span(
+            "decision.spf",
+            self.tracer.child_ctx(rebuild_span, trace_ctx),
+            module="decision",
+            backend=type(self.backend).__name__,
             force_full=force_full,
-            cache_result=not policy_active,
         )
+        from openr_tpu.ops import jit_guard
+
+        try:
+            with jit_guard.trace_scope(
+                self.tracer, self.tracer.child_ctx(spf_span, trace_ctx)
+            ):
+                new_db = self.backend.build_route_db(
+                    self.area_link_states,
+                    self.prefix_state,
+                    changed_prefixes=(
+                        changed if self._first_build_done else None
+                    ),
+                    force_full=force_full,
+                    cache_result=not policy_active,
+                )
+        finally:
+            self.tracer.end_span(spf_span)
+            spf_ms = spf_span.duration_ms()
+            if spf_ms is not None:
+                self.counters.observe("decision.spf_ms", spf_ms)
         self.counters.bump("decision.route_build_runs")
         if new_db is None:
             return
@@ -452,6 +502,8 @@ class Decision(Actor):
             pe.add(self.node_name, "DECISION_ROUTE_BUILD", self.clock.now_ms())
             update.perf_events = pe
             self.pending_perf_events = None
+            # Fib's programming span parents under this rebuild
+            update.trace_ctx = self.tracer.child_ctx(rebuild_span, trace_ctx)
             self.route_updates_queue.push(update)
         if first:
             self._first_build_done = True

@@ -19,6 +19,7 @@ from openr_tpu.types import (
     PrefixEntry,
     RouteDatabase,
     RouteDatabaseDelta,
+    TraceContext,
     UnicastRoute,
 )
 
@@ -203,6 +204,9 @@ class DecisionRouteUpdate:
     mpls_routes_to_update: Dict[int, RibMplsEntry] = field(default_factory=dict)
     mpls_routes_to_delete: List[int] = field(default_factory=list)
     perf_events: Optional[PerfEvents] = None
+    #: causal-trace handle from the Decision rebuild that produced this
+    #: delta; Fib parents its programming span here and closes the trace
+    trace_ctx: Optional["TraceContext"] = None
 
     def empty(self) -> bool:
         return not (

@@ -81,8 +81,20 @@ class Dispatcher(Actor):
             node_ids=pub.node_ids,
             area=pub.area,
             timestamp_ms=pub.timestamp_ms,
+            trace_ctx=pub.trace_ctx,
         )
 
     def get_filters(self) -> List[Tuple[str, ...]]:
         """ctrl surface: per-subscriber filter dump (Dispatcher.h:53)."""
         return [p for p, _ in self._subscribers]
+
+    def queue_stats(self) -> dict:
+        """Gauge provider (Monitor.add_counter_provider): depth/watermark
+        telemetry of the per-subscriber fan-out queues, which sit OUTSIDE
+        the node's primary queue list but are exactly where a slow
+        Decision consumer backs up first."""
+        out = {}
+        for _, q in self._subscribers:
+            for stat, v in q.stats().items():
+                out[f"messaging.queue.{q.name}.{stat}"] = v
+        return out

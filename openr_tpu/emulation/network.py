@@ -210,6 +210,37 @@ class EmulatedNetwork:
             await node.stop()
         await self.io.stop()
 
+    # -- observability -----------------------------------------------------
+
+    def all_spans(self, trace_id: Optional[str] = None) -> list:
+        """Completed spans across EVERY node, ordered by start time —
+        the whole-network view of a convergence trace."""
+        spans = [
+            s
+            for node in self.nodes.values()
+            for s in node.tracer.get_spans(trace_id)
+        ]
+        spans.sort(key=lambda s: (s.start_ms, s.node, s.span_id))
+        return spans
+
+    def export_trace(self, path: str) -> int:
+        """Write all nodes' spans as one Chrome-trace/Perfetto file
+        (pid = node, tid = module); returns the event count."""
+        from openr_tpu.tracing import write_chrome_trace
+
+        return write_chrome_trace(path, self.all_spans())
+
+    def merged_histogram(self, key: str):
+        """Cross-node merge of one histogram key (None when no node
+        observed it) — convergence percentiles for the whole emulation."""
+        merged = None
+        for node in self.nodes.values():
+            h = node.counters.histogram(key)
+            if h is None:
+                continue
+            merged = h.copy() if merged is None else merged.merge(h)
+        return merged
+
     # -- assertions --------------------------------------------------------
 
     def fib_routes(self, node: str) -> Dict[str, list]:

@@ -133,8 +133,12 @@ class Fib(Actor):
         initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
         counters: Optional[CounterMap] = None,
         dryrun: bool = False,
+        tracer=None,
     ) -> None:
         super().__init__("fib", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.tracer = tracer if tracer is not None else disabled_tracer()
         self.node_name = node_name
         self.config = config
         self.agent = agent
@@ -173,6 +177,38 @@ class Fib(Actor):
     # -- route update processing (processDecisionRouteUpdate) --------------
 
     async def _on_route_update(self, update: DecisionRouteUpdate) -> None:
+        span = self.tracer.start_span(
+            "fib.program",
+            update.trace_ctx,
+            module="fib",
+            routes=update.size(),
+            sync=update.type == DecisionRouteUpdateType.FULL_SYNC,
+        )
+        try:
+            await self._process_route_update(update)
+        finally:
+            self.tracer.end_span(span, synced=not self._dirty)
+            ctx = update.trace_ctx
+            if ctx is not None:
+                # trace closes here: programming acknowledged (or marked
+                # dirty for retry).  Event→FIB latency is measured from
+                # the ORIGIN's clock stamp, so a multi-node trace reports
+                # true cross-node convergence (nodes share the SimClock
+                # in emulation; wall-clock deployments inherit host skew).
+                self.counters.observe(
+                    "convergence.event_to_fib_ms",
+                    max(self.clock.now_ms() - ctx.t0_ms, 0),
+                )
+                self.tracer.instant(
+                    "fib.ack",
+                    self.tracer.child_ctx(span, ctx),
+                    module="fib",
+                    origin=ctx.origin_event,
+                    origin_node=ctx.origin_node,
+                    dirty=self._dirty,
+                )
+
+    async def _process_route_update(self, update: DecisionRouteUpdate) -> None:
         if update.type == DecisionRouteUpdateType.FULL_SYNC:
             self.unicast_routes = dict(update.unicast_routes_to_update)
             self.mpls_routes = dict(update.mpls_routes_to_update)

@@ -404,11 +404,37 @@ class KvStoreDb:
         self._guard_self_originated(result.key_vals)
         if result.key_vals:
             self._bump("received_key_vals", len(result.key_vals))
+            ctx = pub.trace_ctx
+            tracer = self.actor.tracer
+            if tracer.enabled:
+                # key arrival: continue the flood's trace when the
+                # publication carries one, else mint here — a remote
+                # arrival is itself an event origin (full-sync deltas,
+                # untraced senders)
+                if ctx is None:
+                    ctx = tracer.start_trace(
+                        "kvstore.key_arrival",
+                        module="kvstore",
+                        area=self.area,
+                        sender=sender or "",
+                        keys=len(result.key_vals),
+                    )
+                else:
+                    span = tracer.instant(
+                        "kvstore.key_arrival",
+                        ctx,
+                        module="kvstore",
+                        area=self.area,
+                        sender=sender or "",
+                        keys=len(result.key_vals),
+                    )
+                    ctx = tracer.child_ctx(span, ctx)
             self.publish(
                 Publication(
                     key_vals=dict(result.key_vals),
                     area=self.area,
                     node_ids=list(pub.node_ids or []),
+                    trace_ctx=ctx,
                 ),
                 sender=sender,
             )
@@ -428,6 +454,10 @@ class KvStoreDb:
             expired_keys=list(pub.expired_keys),
             area=self.area,
             node_ids=node_ids,
+            # flooding metadata: the trace context travels with the
+            # publication hop by hop so every receiving store/Decision
+            # joins the originating event's trace
+            trace_ctx=pub.trace_ctx,
         )
         if not flood_pub.key_vals and not flood_pub.expired_keys:
             return
@@ -539,7 +569,9 @@ class KvStoreDb:
 
     # -- self-originated keys (KvStore.h:196-215) --------------------------
 
-    def persist_self_originated_key(self, key: str, data: bytes) -> Value:
+    def persist_self_originated_key(
+        self, key: str, data: bytes, trace_ctx=None
+    ) -> Value:
         """Advertise and keep alive a locally-owned key; version guards
         against overrides from the network."""
         existing_store = self.key_vals.get(key)
@@ -574,7 +606,7 @@ class KvStoreDb:
         sov.ttl_refresh_task = self.actor.spawn(
             self._ttl_refresh_loop(key), name=f"kvstore.{self.area}.ttl.{key}"
         )
-        self._apply_local(key, value)
+        self._apply_local(key, value, trace_ctx)
         return value
 
     def set_self_originated_key(self, key: str, data: bytes, version: int) -> None:
@@ -599,15 +631,26 @@ class KvStoreDb:
         if sov is not None and sov.ttl_refresh_task is not None:
             sov.ttl_refresh_task.cancel()
 
-    def _apply_local(self, key: str, value: Value) -> None:
+    def _apply_local(self, key: str, value: Value, trace_ctx=None) -> None:
         merged = merge_key_values(self.key_vals, {key: value})
         self._refresh_expiries(merged.key_vals)
         if merged.key_vals:
+            tracer = self.actor.tracer
+            if trace_ctx is not None and tracer.enabled:
+                span = tracer.instant(
+                    "kvstore.key_advertise",
+                    trace_ctx,
+                    module="kvstore",
+                    area=self.area,
+                    key=key,
+                )
+                trace_ctx = tracer.child_ctx(span, trace_ctx)
             self.publish(
                 Publication(
                     key_vals=dict(merged.key_vals),
                     area=self.area,
                     node_ids=[],
+                    trace_ctx=trace_ctx,
                 )
             )
 
@@ -721,8 +764,12 @@ class KvStore(Actor):
         kv_request_reader: Optional[RQueue] = None,
         initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
         counters: Optional[CounterMap] = None,
+        tracer=None,
     ) -> None:
         super().__init__("kvstore", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.tracer = tracer if tracer is not None else disabled_tracer()
         self.node_name = node_name
         self.config = config
         self.transport = transport
@@ -792,7 +839,7 @@ class KvStore(Actor):
         if db is None:
             return
         if req.request_type == KvRequestType.PERSIST_KEY:
-            db.persist_self_originated_key(req.key, req.value)
+            db.persist_self_originated_key(req.key, req.value, req.trace_ctx)
         elif req.request_type == KvRequestType.SET_KEY:
             db.set_self_originated_key(req.key, req.value, req.version or 0)
         elif req.request_type == KvRequestType.CLEAR_KEY:

@@ -96,8 +96,16 @@ class LinkMonitor(Actor):
         initialization_cb: Optional[Callable[[InitializationEvent], None]] = None,
         counters: Optional[CounterMap] = None,
         serialize_adj_db: Optional[Callable[[AdjacencyDatabase], bytes]] = None,
+        tracer=None,
     ) -> None:
         super().__init__("link_monitor", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.tracer = tracer if tracer is not None else disabled_tracer()
+        #: context of the most recent traced event awaiting the throttled
+        #: adjacency advertisement (the advertisement is the span that
+        #: hands the trace to KvStore)
+        self._pending_trace_ctx = None
         self.node_name = node_name
         self.config = config
         self.interface_updates_queue = interface_updates_queue
@@ -194,6 +202,18 @@ class LinkMonitor(Actor):
         if not self._interface_allowed(info.if_name):
             return
         entry = self.interfaces.get(info.if_name)
+        if (
+            self.tracer.enabled
+            and entry is not None
+            and entry.info.is_up != info.is_up
+        ):
+            # trace origin: an interface state change (netlink event or
+            # platform sync delta) starts a convergence clock
+            self._pending_trace_ctx = self.tracer.start_trace(
+                f"link_monitor.interface_{'up' if info.is_up else 'down'}",
+                module="link_monitor",
+                if_name=info.if_name,
+            )
         if entry is None:
             entry = InterfaceEntry(
                 info=info,
@@ -245,6 +265,17 @@ class LinkMonitor(Actor):
     # -- neighbor events (LinkMonitor.h:176) -------------------------------
 
     def _on_neighbor_event(self, ev: NeighborEvent) -> None:
+        if ev.trace_ctx is not None:
+            span = self.tracer.instant(
+                "link_monitor.neighbor_event",
+                ev.trace_ctx,
+                module="link_monitor",
+                event=ev.event_type.name,
+                neighbor=ev.node_name,
+            )
+            self._pending_trace_ctx = self.tracer.child_ctx(
+                span, ev.trace_ctx
+            )
         key = (ev.area, ev.node_name, ev.local_if_name)
         if ev.event_type == NeighborEventType.NEIGHBOR_UP:
             self.adjacencies[key] = AdjacencyEntry(
@@ -376,14 +407,29 @@ class LinkMonitor(Actor):
         return db
 
     def _advertise_adjacencies(self) -> None:
+        ctx, self._pending_trace_ctx = self._pending_trace_ctx, None
+        if ctx is not None:
+            span = self.tracer.instant(
+                "link_monitor.advertise_adj",
+                ctx,
+                module="link_monitor",
+                areas=len(self.area_ids),
+            )
+            ctx = self.tracer.child_ctx(span, ctx)
         for area in self.area_ids:
             db = self.build_adjacency_database(area)
+            if db.perf_events is not None:
+                # the trace rides the flooded payload itself so remote
+                # Decisions join the SAME trace even when the key reaches
+                # them via full sync instead of an incremental flood
+                db.perf_events.trace_context = ctx
             self.kv_request_queue.push(
                 KeyValueRequest(
                     request_type=KvRequestType.PERSIST_KEY,
                     area=area,
                     key=adj_key(self.node_name),
                     value=self.serialize_adj_db(db),
+                    trace_ctx=ctx,
                 )
             )
         self.counters.bump("link_monitor.advertise_adj_db")

@@ -154,6 +154,18 @@ class OpenrNode:
         self.name = config.node_name
         self.counters = CounterMap()
         self.init_tracker = InitializationTracker(clock)
+        # causal convergence tracing: one tracer per node, shared by every
+        # pipeline stage (injected Clock ⇒ SimClock tests replay traces)
+        from openr_tpu.tracing import Tracer
+
+        self.tracer = Tracer(
+            self.name,
+            clock,
+            counters=self.counters,
+            enabled=config.tracing_config.enabled,
+            max_spans=config.tracing_config.max_spans,
+            max_open_spans=config.tracing_config.max_open_spans,
+        )
         areas = config.area_ids()
 
         # -- queues (Main.cpp:152-226) ------------------------------------
@@ -182,6 +194,7 @@ class OpenrNode:
             kv_request_reader=self.kv_request_q.get_reader(),
             initialization_cb=on_init,
             counters=self.counters,
+            tracer=self.tracer,
         )
         self.dispatcher = Dispatcher(
             clock,
@@ -217,6 +230,7 @@ class OpenrNode:
             serialize_adj_db=(
                 lambda db: _serialize_adj_db(db, config.lsdb_wire_format)
             ),
+            tracer=self.tracer,
         )
         # the handshake advertises our DUAL capability; single source of
         # truth is the kvstore config
@@ -236,6 +250,7 @@ class OpenrNode:
             counters=self.counters,
             addr_events_reader=self.addr_events_q.get_reader(),
             ctrl_port=config.openr_ctrl_port,
+            tracer=self.tracer,
         )
         self.neighbor_monitor = NeighborMonitor(
             clock=clock,
@@ -314,6 +329,7 @@ class OpenrNode:
             initialization_cb=on_init,
             counters=self.counters,
             rib_policy_file=config.rib_policy_file if config.rib_policy_file else "",
+            tracer=self.tracer,
         )
         self.init_tracker.add_listener(self.decision.on_initialization_event)
         self.fib = Fib(
@@ -326,6 +342,7 @@ class OpenrNode:
             initialization_cb=on_init,
             counters=self.counters,
             dryrun=config.dryrun,
+            tracer=self.tracer,
         )
         # -- aux services (L6): config-store, monitor, watchdog ------------
         # Drain state survives restarts via the persistent store
@@ -358,6 +375,9 @@ class OpenrNode:
         self.monitor.add_counter_provider(self.fib.retry_state)
         self.monitor.add_counter_provider(backend.counter_snapshot)
         self.monitor.add_counter_provider(jit_guard.counter_snapshot)
+        self.monitor.add_counter_provider(self.tracer.stats)
+        self.monitor.add_counter_provider(self.dispatcher.queue_stats)
+        self.monitor.add_counter_provider(self._queue_gauges)
         self.watchdog: Optional[Watchdog] = None
         if config.enable_watchdog:
             wd = config.watchdog_config
@@ -403,6 +423,16 @@ class OpenrNode:
                 self.watchdog.add_queue(q)
         self._started = False
         self._plugin_start_task = None
+
+    def _queue_gauges(self) -> Dict[str, float]:
+        """Monitor gauge provider: depth / high-watermark / writer-backlog
+        telemetry for every inter-module queue — the continuous view of
+        what the Watchdog only thresholds on."""
+        out: Dict[str, float] = {}
+        for q in self._queues:
+            for stat, v in q.stats().items():
+                out[f"messaging.queue.{q.name}.{stat}"] = v
+        return out
 
     # -- lifecycle (start order per Main.cpp:231-470) ----------------------
 

@@ -32,6 +32,9 @@ class RWQueue(Generic[T]):
         self._closed = False
         self.num_writes = 0
         self.num_reads = 0
+        #: deepest backlog ever observed (telemetry: a reader that once
+        #: fell behind is visible even after it caught up)
+        self.high_watermark = 0
 
     def size(self) -> int:
         return len(self._items)
@@ -52,6 +55,8 @@ class RWQueue(Generic[T]):
                 fut.set_result(item)
                 return True
         self._items.append(item)
+        if len(self._items) > self.high_watermark:
+            self.high_watermark = len(self._items)
         return True
 
     async def get(self) -> T:
@@ -166,6 +171,9 @@ class ReplicateQueue(Generic[T]):
         self._reader_handles: List[RQueue[T]] = []
         self._closed = False
         self.num_writes = 0
+        #: peak backlog of readers removed since creation (remove_reader /
+        #: close) — keeps high_watermark() monotonic over reader churn
+        self._hw_detached = 0
 
     def get_reader(
         self, filter_fn: Optional[Callable[[T], bool]] = None, name: str = ""
@@ -198,6 +206,9 @@ class ReplicateQueue(Generic[T]):
         whether the reader belonged to this queue."""
         for i, handle in enumerate(self._reader_handles):
             if handle is reader:
+                self._hw_detached = max(
+                    self._hw_detached, self._readers[i].high_watermark
+                )
                 self._readers[i].close()
                 del self._readers[i]
                 del self._reader_handles[i]
@@ -210,6 +221,23 @@ class ReplicateQueue(Generic[T]):
     def max_backlog(self) -> int:
         return max((q.size() for q in self._readers), default=0)
 
+    def high_watermark(self) -> int:
+        """Deepest backlog any reader (current OR removed — detached
+        readers can't regress the peak) ever accumulated."""
+        hw = max((q.high_watermark for q in self._readers), default=0)
+        return max(hw, self._hw_detached)
+
+    def stats(self) -> dict:
+        """Gauge snapshot for the Monitor's provider sweep: the queue
+        telemetry the Watchdog thresholds on, exported continuously so
+        operators see backlog growth BEFORE the crash threshold."""
+        return {
+            "depth": float(self.max_backlog()),
+            "high_watermark": float(self.high_watermark()),
+            "writes": float(self.num_writes),
+            "readers": float(len(self._readers)),
+        }
+
     def open(self) -> None:
         """Re-open a closed queue (reference ReplicateQueue::open)."""
         self._closed = False
@@ -221,6 +249,7 @@ class ReplicateQueue(Generic[T]):
         # The reference clears the reader list on close
         # (ReplicateQueue-inl.h:98-105) so a later open() starts fresh.
         for q in self._readers:
+            self._hw_detached = max(self._hw_detached, q.high_watermark)
             q.close()
         self._readers.clear()
         self._reader_handles.clear()

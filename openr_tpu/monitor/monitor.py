@@ -116,6 +116,12 @@ class Monitor(Actor):
             "timestamp_ms": sample.timestamp_ms or self.clock.now_ms(),
             **sample.attributes,
         }
+        if len(self._ring) == self._ring.maxlen:
+            # the bounded ring is about to silently drop its oldest
+            # sample; count it — `sample_dropped` only covers
+            # disabled-submission drops, so before this counter evictions
+            # were invisible to getEventLogs consumers
+            self.counters.bump("monitor.log.sample_evicted")
         self._ring.append(json.dumps(record, sort_keys=True, default=str))
         if self._forward is not None:
             self._forward(record)

@@ -22,9 +22,34 @@ through this guard.
 
 from __future__ import annotations
 
-from typing import Dict
+import contextlib
+from typing import Dict, Optional, Tuple
 
 _SIGNATURE = "buffers but compiled program expected"
+
+#: (tracer, ctx) while a traced device build runs — set by Decision around
+#: backend.build_route_db so every guarded kernel dispatch inside it
+#: records a `decision.spf_kernel` child span + `decision.spf_kernel_ms`
+#: histogram sample.  Module-global is safe: builds are synchronous on the
+#: shared event loop, and the scope is saved/restored re-entrantly.
+_trace_scope: Optional[Tuple[object, object]] = None
+
+
+@contextlib.contextmanager
+def trace_scope(tracer, ctx):
+    """Attribute guarded kernel dispatches inside the body to `ctx`.
+    A disabled/None tracer clears the scope (no per-call overhead)."""
+    global _trace_scope
+    prev = _trace_scope
+    _trace_scope = (
+        (tracer, ctx)
+        if tracer is not None and getattr(tracer, "enabled", False)
+        else None
+    )
+    try:
+        yield
+    finally:
+        _trace_scope = prev
 
 #: guard-trip tally, exported into Monitor's gauge sweep via
 #: `counter_snapshot` (main.py registers it with add_counter_provider)
@@ -39,7 +64,42 @@ def counter_snapshot() -> Dict[str, float]:
 
 
 def call_jit_guarded(fn, *args, **kwargs):
-    """Call a jitted function; heal the known cache corruption once."""
+    """Call a jitted function; heal the known cache corruption once.
+    Inside a `trace_scope`, the dispatch is recorded as a
+    `decision.spf_kernel` span (attrs: kernel name, whether this call
+    compiled — the build-vs-execute split — and whether the guard had to
+    heal) plus a `decision.spf_kernel_ms` histogram sample."""
+    scope = _trace_scope
+    if scope is not None:
+        return _call_traced(scope, fn, args, kwargs)
+    return _call(fn, args, kwargs)
+
+
+def _call_traced(scope, fn, args, kwargs):
+    tracer, ctx = scope
+    name = getattr(fn, "__name__", None) or type(fn).__name__
+    span = tracer.start_span(
+        "decision.spf_kernel", ctx, module="decision", kernel=name
+    )
+    cache_size = getattr(fn, "_cache_size", None)
+    before = cache_size() if callable(cache_size) else None
+    healed0 = _counters["jit_guard.cache_clear"]
+    try:
+        return _call(fn, args, kwargs)
+    finally:
+        if before is not None:
+            # a cache-size bump means THIS dispatch paid the XLA
+            # build (trace+compile); later dispatches are execute-only
+            span.attrs["compiled"] = cache_size() > before
+        if _counters["jit_guard.cache_clear"] > healed0:
+            span.attrs["healed"] = True
+        tracer.end_span(span)
+        dur = span.duration_ms()
+        if dur is not None:
+            tracer.observe("decision.spf_kernel_ms", dur)
+
+
+def _call(fn, args, kwargs):
     try:
         return fn(*args, **kwargs)
     except ValueError as e:  # jaxlib surfaces it as ValueError

@@ -222,8 +222,12 @@ class Spark(Actor):
         adj_hold_until_initialized: bool = False,
         addr_events_reader: Optional[RQueue] = None,
         ctrl_port: Optional[int] = None,
+        tracer=None,
     ) -> None:
         super().__init__("spark", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.tracer = tracer if tracer is not None else disabled_tracer()
         self.node_name = node_name
         self.config = config
         self.io = io
@@ -501,9 +505,19 @@ class Spark(Actor):
         return nxt
 
     def _notify(self, etype: NeighborEventType, neighbor: SparkNeighbor) -> None:
+        # trace origin: the neighbor FSM transition IS the convergence
+        # event an operator asks about ("how long did this flap take?")
+        ctx = self.tracer.start_trace(
+            f"spark.{etype.name.lower()}",
+            module="spark",
+            neighbor=neighbor.node_name,
+            if_name=neighbor.local_if_name,
+            area=neighbor.area,
+        )
         self.neighbor_updates_queue.push(
             NeighborEvent(
                 event_type=etype,
+                trace_ctx=ctx,
                 node_name=neighbor.node_name,
                 area=neighbor.area,
                 local_if_name=neighbor.local_if_name,

@@ -1,0 +1,21 @@
+"""Causal convergence tracing (openr_tpu.tracing).
+
+A `Tracer` (one per node, injected `Clock` so SimClock tests get
+deterministic timestamps) mints `TraceContext`s at event origins and
+modules record spans against contexts they receive through queue items
+and KvStore flooding metadata.  `export` renders completed spans as a
+Chrome-trace/Perfetto-compatible file.  See docs/Observability.md for
+the span taxonomy and naming conventions.
+"""
+
+from openr_tpu.tracing.export import chrome_trace_events, write_chrome_trace
+from openr_tpu.tracing.tracer import NOOP_SPAN, Span, Tracer, disabled_tracer
+
+__all__ = [
+    "NOOP_SPAN",
+    "Span",
+    "Tracer",
+    "chrome_trace_events",
+    "disabled_tracer",
+    "write_chrome_trace",
+]

@@ -1,0 +1,310 @@
+"""Tracer — causal spans across the Spark→KvStore→Decision→Fib pipeline.
+
+The reference answers "where did the convergence time go?" with
+PerfEvents breadcrumbs (Types.thrift:80-96) and fb303 counters; DeltaPath
+(PAPERS.md) argues per-update dataflow latency is *the* metric an
+incremental routing engine must expose.  This module is the generalized
+form: every stage records a `Span` (start/end on the injected `Clock`)
+linked by a `TraceContext` that rides queue items and KvStore flooding
+metadata, so one link flap yields a multi-node span tree from the Spark
+FSM transition to the Fib programming ack — inspectable via the ctrl API
+(`get_traces`), `breeze monitor trace`, or a Perfetto export.
+
+Design constraints:
+  * deterministic: ids come from a per-tracer sequence, timestamps from
+    the injected Clock — SimClock tests replay identical traces;
+  * bounded: completed spans live in a fixed ring (evictions counted),
+    spans opened but never closed are evicted past a cap and counted as
+    `trace.dropped_spans` (the chaos invariant: drops stay bounded);
+  * free when off: with `enabled=False` every entry point returns a
+    shared no-op in O(1) with no allocation — the hot path pays one
+    attribute check.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict, deque
+from typing import Any, Deque, Dict, List, Optional
+
+from openr_tpu.common.runtime import Clock, CounterMap
+from openr_tpu.types import TraceContext
+
+
+class Span:
+    """One timed stage of a trace.  `end_ms` is None while open."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id",
+        "node", "module", "start_ms", "end_ms", "attrs",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        span_id: str,
+        parent_id: str,
+        node: str,
+        module: str,
+        start_ms: float,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.node = node
+        self.module = module
+        self.start_ms = start_ms
+        self.end_ms: Optional[float] = None
+        self.attrs: Dict[str, Any] = attrs or {}
+
+    def duration_ms(self) -> Optional[float]:
+        if self.end_ms is None:
+            return None
+        return self.end_ms - self.start_ms
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "node": self.node,
+            "module": self.module,
+            "start_ms": self.start_ms,
+            "end_ms": self.end_ms,
+            "duration_ms": self.duration_ms(),
+            "attrs": dict(self.attrs),
+        }
+
+
+class _NoopSpan:
+    """Shared sentinel returned by a disabled Tracer: accepts the same
+    surface as Span but records nothing."""
+
+    __slots__ = ()
+    name = trace_id = span_id = parent_id = node = module = ""
+    start_ms = 0.0
+    end_ms: Optional[float] = None
+    attrs: Dict[str, Any] = {}
+
+    @staticmethod
+    def duration_ms() -> Optional[float]:
+        return None
+
+    @staticmethod
+    def to_wire() -> Dict[str, Any]:
+        return {}
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _SpanScope:
+    """Context manager from Tracer.span()."""
+
+    __slots__ = ("_tracer", "span")
+
+    def __init__(self, tracer: "Tracer", span) -> None:
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self):
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None and self.span is not NOOP_SPAN:
+            self.span.attrs["error"] = exc_type.__name__
+        self._tracer.end_span(self.span)
+
+
+class Tracer:
+    """Per-node span recorder.  All timing goes through the injected
+    Clock; all ids come from a local sequence (deterministic replay)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        clock: Optional[Clock] = None,
+        counters: Optional[CounterMap] = None,
+        enabled: bool = True,
+        max_spans: int = 4096,
+        max_open_spans: int = 512,
+    ) -> None:
+        if enabled and clock is None:
+            raise ValueError("an enabled Tracer needs an injected Clock")
+        self.node_name = node_name
+        self.clock = clock
+        self.counters = counters
+        self.enabled = enabled
+        self.max_spans = max_spans
+        self.max_open_spans = max_open_spans
+        self._done: Deque[Span] = deque()
+        self._open: "OrderedDict[str, Span]" = OrderedDict()
+        self._seq = itertools.count(1)
+        self.num_completed = 0
+        #: open spans evicted unfinished — the leak/overload signal the
+        #: chaos invariant bounds
+        self.num_dropped = 0
+        #: completed spans that fell off the ring (normal steady-state
+        #: turnover on a long-lived daemon)
+        self.num_evicted = 0
+
+    # -- mint / record -----------------------------------------------------
+
+    def _next_id(self) -> str:
+        return f"{self.node_name}:{next(self._seq)}"
+
+    def start_trace(
+        self, event: str, module: str = "", **attrs: Any
+    ) -> Optional[TraceContext]:
+        """Mint a new trace at an event origin.  Records the origin as an
+        instant root span and returns the propagation handle (None when
+        tracing is disabled — callers pass it through unchanged)."""
+        if not self.enabled:
+            return None
+        now = self.clock.now() * 1000.0
+        sid = self._next_id()
+        span = Span(event, sid, sid, "", self.node_name, module, now, attrs)
+        span.end_ms = now
+        self._finish(span)
+        return TraceContext(
+            trace_id=sid,
+            span_id=sid,
+            origin_node=self.node_name,
+            origin_event=event,
+            t0_ms=self.clock.now_ms(),
+        )
+
+    def start_span(
+        self,
+        name: str,
+        ctx: Optional[TraceContext] = None,
+        module: str = "",
+        **attrs: Any,
+    ):
+        """Open a span under `ctx` (fresh trace when ctx is None)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        sid = self._next_id()
+        trace_id = ctx.trace_id if ctx is not None else sid
+        parent = ctx.span_id if ctx is not None else ""
+        span = Span(
+            name, trace_id, sid, parent, self.node_name, module,
+            self.clock.now() * 1000.0, attrs,
+        )
+        self._open[sid] = span
+        while len(self._open) > self.max_open_spans:
+            _, leaked = self._open.popitem(last=False)
+            leaked.attrs["dropped"] = True
+            # seal it: a late end_span on a dropped span is a no-op, and
+            # the span never reaches the completed ring
+            leaked.end_ms = leaked.start_ms
+            self.num_dropped += 1
+            if self.counters is not None:
+                self.counters.bump("trace.dropped_spans")
+        return span
+
+    def end_span(self, span, **attrs: Any) -> None:
+        if span is NOOP_SPAN or not isinstance(span, Span):
+            return
+        if span.end_ms is not None:
+            return  # already closed (or dropped from the open table)
+        if attrs:
+            span.attrs.update(attrs)
+        span.end_ms = self.clock.now() * 1000.0
+        self._open.pop(span.span_id, None)
+        self._finish(span)
+
+    def instant(
+        self,
+        name: str,
+        ctx: Optional[TraceContext] = None,
+        module: str = "",
+        **attrs: Any,
+    ):
+        """Zero-duration span (event marker)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        span = self.start_span(name, ctx, module, **attrs)
+        self.end_span(span)
+        return span
+
+    def span(self, name: str, ctx=None, module: str = "", **attrs: Any):
+        """`with tracer.span("decision.rebuild", ctx) as sp:` scope."""
+        return _SpanScope(self, self.start_span(name, ctx, module, **attrs))
+
+    def child_ctx(
+        self, span, ctx: Optional[TraceContext] = None
+    ) -> Optional[TraceContext]:
+        """Propagation handle re-based onto `span` so the next stage's
+        span parents here; origin fields (node/event/t0) stay pinned to
+        the minting event."""
+        if span is NOOP_SPAN or not isinstance(span, Span):
+            return ctx
+        if ctx is not None:
+            return TraceContext(
+                trace_id=ctx.trace_id,
+                span_id=span.span_id,
+                origin_node=ctx.origin_node,
+                origin_event=ctx.origin_event,
+                t0_ms=ctx.t0_ms,
+            )
+        return TraceContext(
+            trace_id=span.trace_id,
+            span_id=span.span_id,
+            origin_node=self.node_name,
+            origin_event=span.name,
+            t0_ms=int(span.start_ms),
+        )
+
+    def observe(self, key: str, value: float) -> None:
+        """Histogram passthrough (None-safe) for stages that only hold a
+        tracer reference (jit_guard's kernel spans)."""
+        if self.counters is not None:
+            self.counters.observe(key, value)
+
+    def _finish(self, span: Span) -> None:
+        self._done.append(span)
+        self.num_completed += 1
+        while len(self._done) > self.max_spans:
+            self._done.popleft()
+            self.num_evicted += 1
+            if self.counters is not None:
+                self.counters.bump("trace.spans_evicted")
+
+    # -- query surface (ctrl API get_traces) -------------------------------
+
+    def get_spans(self, trace_id: Optional[str] = None) -> List[Span]:
+        """Completed spans, oldest first; optionally one trace only."""
+        if trace_id is None:
+            return list(self._done)
+        return [s for s in self._done if s.trace_id == trace_id]
+
+    def trace_ids(self) -> List[str]:
+        """Distinct trace ids present in the ring, oldest first."""
+        seen: "OrderedDict[str, None]" = OrderedDict()
+        for s in self._done:
+            seen.setdefault(s.trace_id, None)
+        return list(seen)
+
+    def stats(self) -> Dict[str, float]:
+        """Gauge provider for Monitor.add_counter_provider."""
+        return {
+            "trace.enabled": 1.0 if self.enabled else 0.0,
+            "trace.spans_completed": float(self.num_completed),
+            "trace.dropped_spans": float(self.num_dropped),
+            "trace.spans_evicted": float(self.num_evicted),
+            "trace.open_spans": float(len(self._open)),
+        }
+
+
+_DISABLED = Tracer("-", clock=None, enabled=False)
+
+
+def disabled_tracer() -> Tracer:
+    """Shared always-off tracer: the default for modules constructed
+    without one, so call sites never need a None check."""
+    return _DISABLED

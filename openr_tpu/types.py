@@ -298,8 +298,31 @@ def normalize_prefix(prefix: str) -> str:
 
 
 # ---------------------------------------------------------------------------
-# Performance-event breadcrumbs (Types.thrift:80-96)
+# Performance-event breadcrumbs (Types.thrift:80-96) + causal trace context
 # ---------------------------------------------------------------------------
+
+
+@wire_type
+@dataclass
+class TraceContext(Wire):
+    """Causal-trace propagation handle (openr_tpu.tracing).
+
+    Minted by a Tracer at an event origin (Spark neighbor up/down,
+    LinkMonitor interface event, KvStore key arrival) and carried through
+    queue items, KvStore flooding metadata (Publication.trace_ctx) and
+    flooded LSDB payloads (PerfEvents.trace_context) so every stage's
+    span — on every node the event reaches — shares one ``trace_id``.
+    ``span_id`` names the nearest upstream span (the parent for the next
+    stage); origin fields stay pinned to the minting event so the closing
+    stage (Fib programming ack) can compute end-to-end latency from
+    ``t0_ms`` without walking the tree.
+    """
+
+    trace_id: str = ""
+    span_id: str = ""
+    origin_node: str = ""
+    origin_event: str = ""
+    t0_ms: int = 0
 
 
 @wire_type
@@ -317,6 +340,10 @@ class PerfEvents(Wire):
     event appended at the back (Types.thrift:88-96)."""
 
     events: List[PerfEvent] = field(default_factory=list)
+    #: causal-trace handle riding the flooded LSDB payload: survives
+    #: KvStore storage, so even keys delivered later via full sync keep
+    #: their origin trace (openr_tpu.tracing)
+    trace_context: Optional[TraceContext] = None
 
     def add(self, node: str, descr: str, ts_ms: int) -> None:
         self.events.append(PerfEvent(node, descr, ts_ms))
@@ -512,6 +539,9 @@ class Publication(Wire):
     tobe_updated_keys: Optional[List[str]] = None
     area: str = "0"
     timestamp_ms: Optional[int] = None
+    #: flooding metadata: causal-trace handle carried hop by hop with the
+    #: publication (openr_tpu.tracing); None when tracing is disabled
+    trace_ctx: Optional[TraceContext] = None
 
 
 @wire_type
@@ -644,6 +674,8 @@ class NeighborEvent(Wire):
     kv_label: int = 0
     adj_only_used_by_other_node: bool = False
     enable_flood_optimization: bool = False
+    #: causal-trace handle minted by Spark at the FSM transition
+    trace_ctx: Optional[TraceContext] = None
 
 
 class PeerEventType(enum.IntEnum):
@@ -724,6 +756,10 @@ class KeyValueRequest:
     key: str
     value: bytes = b""
     version: Optional[int] = None
+    #: causal-trace handle from the requesting module (LinkMonitor adj
+    #: advertisement, PrefixManager) — KvStore attaches it to the
+    #: resulting local publication + flood
+    trace_ctx: Optional[TraceContext] = None
 
 
 @dataclass

@@ -100,6 +100,64 @@ def test_fib_agent_burst_retries_with_backoff():
 
 
 @pytest.mark.chaos
+def test_partition_heal_traces_close_end_to_end():
+    """Tracing under faults: after a partition heals, the re-discovery
+    event still produces a COMPLETE trace (origin span → fib.ack, no
+    open spans left in its tree) and `trace.dropped_spans` stays bounded
+    — chaos must not leak open spans."""
+
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock)
+        net.build(ring_edges(4))
+        net.start()
+        await clock.run_for(CONVERGE_S)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        plan = FaultPlan().partition(
+            ("node0",), ("node1", "node2", "node3"), at=0.0, duration=8.0
+        )
+        controller = ChaosController(net, plan, seed=17)
+        controller.start()
+        await clock.run_for(8.0)  # partition holds; spark holds expire
+        heal_mark = len(net.all_spans())
+        await clock.run_for(20.0)  # heal fired at t=8; reconverge
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        # spans recorded AFTER the heal: the rediscovered adjacency must
+        # close end-to-end (spark origin on one side, fib.ack on nodes
+        # across the former partition boundary)
+        post_heal = net.all_spans()[heal_mark:]
+        acks = [s for s in post_heal if s.name == "fib.ack"]
+        assert acks, "no fib.ack span after heal"
+        healed = [
+            s
+            for s in acks
+            if s.attrs.get("origin_node") == "node0" and s.node != "node0"
+        ]
+        assert healed, "healed event's trace never closed on the far side"
+        tid = healed[0].trace_id
+        tree = net.all_spans(trace_id=tid)
+        assert {s.node for s in tree} >= {"node0", healed[0].node}
+        assert all(s.end_ms is not None for s in tree)
+        assert any(s.name.startswith("spark.") for s in tree)
+        # drops stay bounded through the fault (no open-span leak): the
+        # partition orphans at most the in-flight rebuilds of that tick
+        for name, node in net.nodes.items():
+            assert node.tracer.num_dropped == 0, (
+                name,
+                node.tracer.stats(),
+            )
+        # and the convergence histogram kept observing through the chaos
+        merged = net.merged_histogram("convergence.event_to_fib_ms")
+        assert merged is not None and merged.count > 0
+        await controller.stop()
+        await net.stop()
+
+    run(main())
+
+
+@pytest.mark.chaos
 def test_actor_crash_restarts_without_systemexit():
     async def main():
         clock = SimClock()

@@ -156,6 +156,41 @@ def test_cli_monitor_counters(live_node):
     assert counters and all(k.startswith("kvstore.") for k in counters)
 
 
+def test_cli_monitor_trace(live_node):
+    spans = json.loads(_run(live_node, "monitor", "trace", "--json"))
+    assert spans, "a converged node should have recorded spans"
+    assert {"name", "trace_id", "span_id", "node", "start_ms"} <= set(
+        spans[0]
+    )
+    # tree rendering names traces and indents spans under them
+    out = _run(live_node, "monitor", "trace")
+    assert "trace " in out and "kvstore.key_arrival" in out
+    # narrowing to one trace returns only that trace's spans
+    tid = spans[-1]["trace_id"]
+    one = json.loads(
+        _run(live_node, "monitor", "trace", "--json", "--trace-id", tid)
+    )
+    assert one and all(s["trace_id"] == tid for s in one)
+
+
+def test_cli_monitor_histograms(live_node):
+    hists = json.loads(_run(live_node, "monitor", "histograms", "--json"))
+    assert "convergence.event_to_fib_ms" in hists
+    h = hists["convergence.event_to_fib_ms"]
+    assert h["count"] > 0 and h["p50"] is not None
+    table = _run(live_node, "monitor", "histograms")
+    assert "p50" in table and "convergence.event_to_fib_ms" in table
+    filtered = json.loads(
+        _run(
+            live_node, "monitor", "histograms", "--json",
+            "--prefix", "convergence.",
+        )
+    )
+    assert set(filtered) and all(
+        k.startswith("convergence.") for k in filtered
+    )
+
+
 def test_cli_kvstore_snoop_snapshot(live_node):
     out = _run(
         live_node,

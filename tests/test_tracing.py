@@ -1,0 +1,472 @@
+"""Convergence tracing: histogram math, tracer determinism, the no-op
+fast path, queue telemetry, monitor ring-eviction counting, Chrome-trace
+export, and the 9-node grid end-to-end acceptance (multi-node span tree
+from a link event to the FIB ack with a TPU/XLA SPF-kernel child span).
+All timing runs on SimClock — traces replay identically across hosts."""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import CounterMap, Histogram, SimClock
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.tracing import (
+    NOOP_SPAN,
+    Tracer,
+    chrome_trace_events,
+    disabled_tracer,
+    write_chrome_trace,
+)
+from openr_tpu.types import TraceContext
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# histogram math
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_bucket_boundaries(self):
+        h = Histogram(min_bound=1.0, growth=2.0, num_buckets=4)
+        assert h.edges == [1.0, 2.0, 4.0, 8.0]
+        # bucket 0 is [0, min_bound]; upper edges are inclusive
+        assert h.bucket_index(0.0) == 0
+        assert h.bucket_index(1.0) == 0
+        assert h.bucket_index(1.0001) == 1
+        assert h.bucket_index(2.0) == 1
+        assert h.bucket_index(2.0001) == 2
+        assert h.bucket_index(8.0) == 3
+        assert h.bucket_index(8.0001) == 4  # overflow bucket
+        assert h.bucket_bounds(0) == (0.0, 1.0)
+        assert h.bucket_bounds(2) == (2.0, 4.0)
+
+    def test_observe_counts_and_stats(self):
+        h = Histogram(min_bound=1.0, growth=2.0, num_buckets=4)
+        for v in (0.5, 1.5, 3.0, 3.5, 100.0):
+            h.observe(v)
+        assert h.counts == [1, 1, 2, 0, 1]
+        assert h.count == 5
+        assert h.total == pytest.approx(108.5)
+        assert h.vmin == 0.5 and h.vmax == 100.0
+
+    def test_percentile_interpolation(self):
+        h = Histogram(min_bound=1.0, growth=2.0, num_buckets=4)
+        h.observe(1.5)  # bucket 1: (1, 2]
+        h.observe(3.0)  # bucket 2: (2, 4]
+        # rank(p50) = 1 -> falls at the end of bucket 1 -> its upper edge
+        assert h.percentile(50) == pytest.approx(2.0)
+        # rank(p100) = 2 -> end of bucket 2, clamped to observed max 3.0
+        assert h.percentile(100) == pytest.approx(3.0)
+        # p25: rank .5 -> halfway through bucket 1 -> clamped to vmin 1.5
+        assert h.percentile(25) == pytest.approx(1.5)
+
+    def test_percentile_single_value_is_exact(self):
+        h = Histogram()
+        for _ in range(10):
+            h.observe(7.0)
+        # interpolation is clamped to [min, max] so a single-valued
+        # population reports exactly that value at every percentile
+        assert h.percentile(50) == 7.0
+        assert h.percentile(99) == 7.0
+        assert h.percentiles() == {"p50": 7.0, "p95": 7.0, "p99": 7.0}
+
+    def test_empty_percentile_is_none(self):
+        assert Histogram().percentile(50) is None
+        assert CounterMap().percentiles("nope") is None
+
+    def test_merge_equals_union(self):
+        a, b, u = Histogram(), Histogram(), Histogram()
+        for v in (1, 2, 3, 50):
+            a.observe(v)
+            u.observe(v)
+        for v in (0.5, 10, 200):
+            b.observe(v)
+            u.observe(v)
+        a.merge(b)
+        assert a.counts == u.counts
+        assert a.count == u.count and a.total == pytest.approx(u.total)
+        assert (a.vmin, a.vmax) == (u.vmin, u.vmax)
+        for p in (50, 95, 99):
+            assert a.percentile(p) == pytest.approx(u.percentile(p))
+
+    def test_merge_config_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            Histogram(growth=1.5).merge(Histogram(growth=2.0))
+
+    def test_counter_map_histograms(self):
+        c = CounterMap()
+        c.observe("x.ms", 5.0)
+        c.observe("x.ms", 5.0)
+        c.observe("y.ms", 1.0)
+        assert c.percentiles("x.ms")["p50"] == 5.0
+        dump = c.dump_histograms()
+        assert set(dump) == {"x.ms", "y.ms"}
+        assert dump["x.ms"]["count"] == 2
+        assert c.dump_histograms("y.") == {"y.ms": dump["y.ms"]}
+        c.clear()
+        assert c.dump_histograms() == {}
+
+
+# ---------------------------------------------------------------------------
+# tracer: deterministic spans on SimClock
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_simclock_deterministic_durations(self):
+        async def main():
+            clock = SimClock()
+            tracer = Tracer("n0", clock, counters=CounterMap())
+            ctx = tracer.start_trace("origin", module="test")
+            assert ctx.trace_id == "n0:1" and ctx.origin_node == "n0"
+            span = tracer.start_span("stage", ctx, module="test")
+
+            async def sleeper():
+                await clock.sleep(1.5)
+                tracer.end_span(span)
+
+            task = asyncio.get_running_loop().create_task(sleeper())
+            await clock.run_for(2.0)
+            await task
+            return tracer
+
+        tracer = run(main())
+        spans = tracer.get_spans()
+        assert [s.name for s in spans] == ["origin", "stage"]
+        stage = spans[1]
+        assert stage.duration_ms() == pytest.approx(1500.0)
+        assert stage.parent_id == "n0:1"
+        assert stage.trace_id == "n0:1"
+        # replay: a fresh SimClock run produces the identical trace
+        spans2 = run(main()).get_spans()
+        assert [s.to_wire() for s in spans2] == [s.to_wire() for s in spans]
+
+    def test_child_ctx_rebases_span_and_pins_origin(self):
+        clock = SimClock(start=1.0)
+        tracer = Tracer("n0", clock)
+        ctx = tracer.start_trace("origin")
+        span = tracer.start_span("mid", ctx)
+        child = tracer.child_ctx(span, ctx)
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id == span.span_id != ctx.span_id
+        assert child.origin_event == "origin"
+        assert child.t0_ms == ctx.t0_ms == 1000
+        tracer.end_span(span)
+
+    def test_ring_eviction_and_open_span_drop_counting(self):
+        clock = SimClock()
+        counters = CounterMap()
+        tracer = Tracer(
+            "n0", clock, counters=counters, max_spans=4, max_open_spans=2
+        )
+        for i in range(10):
+            tracer.instant(f"e{i}")
+        assert len(tracer.get_spans()) == 4
+        assert tracer.num_evicted == 6
+        assert counters.get("trace.spans_evicted") == 6
+        # opening past the cap drops the OLDEST open span
+        s1 = tracer.start_span("a")
+        tracer.start_span("b")
+        tracer.start_span("c")
+        assert tracer.num_dropped == 1
+        assert counters.get("trace.dropped_spans") == 1
+        # the dropped span is sealed: a late end is a no-op and it never
+        # reaches the completed ring
+        tracer.end_span(s1)
+        assert all(s.name != "a" for s in tracer.get_spans())
+        assert tracer.stats()["trace.dropped_spans"] == 1.0
+
+    def test_span_scope_records_errors(self):
+        tracer = Tracer("n0", SimClock())
+        with pytest.raises(RuntimeError):
+            with tracer.span("boom") as sp:
+                raise RuntimeError("x")
+        assert sp.attrs["error"] == "RuntimeError"
+        assert sp.end_ms is not None
+
+
+class TestNoopFastPath:
+    def test_disabled_tracer_is_free(self):
+        tracer = disabled_tracer()
+        assert tracer.start_trace("ev") is None
+        assert tracer.start_span("x") is NOOP_SPAN
+        assert tracer.instant("x") is NOOP_SPAN
+        tracer.end_span(NOOP_SPAN)  # no-op
+        assert tracer.child_ctx(NOOP_SPAN, None) is None
+        ctx = TraceContext(trace_id="t", span_id="s")
+        assert tracer.child_ctx(NOOP_SPAN, ctx) is ctx
+        with tracer.span("y") as sp:
+            assert sp is NOOP_SPAN
+        assert tracer.get_spans() == []
+        assert tracer.stats()["trace.spans_completed"] == 0.0
+
+    def test_enabled_tracer_requires_clock(self):
+        with pytest.raises(ValueError):
+            Tracer("n0", clock=None, enabled=True)
+
+    def test_disabled_pipeline_records_nothing(self):
+        """Whole-pipeline no-op: with tracing disabled the network
+        converges with zero spans, no contexts on queue items, and no
+        convergence histogram — the disabled overhead is one flag check."""
+        from openr_tpu.emulation.network import EmulatedNetwork
+        from openr_tpu.emulation.topology import line_edges
+
+        def no_tracing(cfg):
+            cfg.tracing_config.enabled = False
+
+        async def main():
+            clock = SimClock()
+            net = EmulatedNetwork(clock, config_overrides=no_tracing)
+            net.build(line_edges(3))
+            net.start()
+            await clock.run_for(12.0)
+            ok, why = net.converged_full_mesh()
+            assert ok, why
+            net.fail_link("node0", "node1")
+            await clock.run_for(5.0)
+            for node in net.nodes.values():
+                assert node.tracer.get_spans() == []
+                assert node.tracer.stats()["trace.spans_completed"] == 0.0
+                assert (
+                    node.counters.histogram("convergence.event_to_fib_ms")
+                    is None
+                )
+            await net.stop()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# queue telemetry + monitor ring eviction
+# ---------------------------------------------------------------------------
+
+
+def test_queue_high_watermark_and_stats():
+    q = ReplicateQueue("testq")
+    r = q.get_reader()
+    for i in range(5):
+        q.push(i)
+    assert q.max_backlog() == 5
+    assert q.high_watermark() == 5
+    for _ in range(5):
+        assert r.try_get() is not None
+    # backlog drained but the high watermark records the peak
+    assert q.max_backlog() == 0
+    assert q.high_watermark() == 5
+    stats = q.stats()
+    assert stats == {
+        "depth": 0.0,
+        "high_watermark": 5.0,
+        "writes": 5.0,
+        "readers": 1.0,
+    }
+    # a removed reader cannot regress the peak
+    q.remove_reader(r)
+    assert q.high_watermark() == 5
+
+
+def test_node_queue_gauges_reach_counters():
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import line_edges
+
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock)
+        net.build(line_edges(2))
+        net.start()
+        await clock.run_for(12.0)
+        node = net.nodes["node0"]
+        node.monitor.sample_system_metrics()
+        dump = node.counters.dump("messaging.queue.")
+        assert any(
+            k == "messaging.queue.kvStoreUpdates.writes" and v > 0
+            for k, v in dump.items()
+        )
+        assert "messaging.queue.routeUpdates.high_watermark" in dump
+        # dispatcher subscriber queues are covered too
+        assert any(".depth" in k and "dispatcher" not in k for k in dump)
+        assert node.counters.get("trace.enabled") == 1.0
+        await net.stop()
+
+    run(main())
+
+
+def test_monitor_counts_ring_evictions():
+    from openr_tpu.messaging.queue import ReplicateQueue as RQ
+    from openr_tpu.monitor.monitor import Monitor
+    from openr_tpu.types import LogSample
+
+    clock = SimClock()
+    q = RQ("logSamples")
+    reader = q.get_reader()
+    counters = CounterMap()
+    mon = Monitor(
+        "n0",
+        clock,
+        log_sample_reader=reader,
+        counters=counters,
+        max_event_log_size=3,
+    )
+    for i in range(5):
+        mon.process_log_sample(LogSample(event=f"e{i}"))
+    assert counters.get("monitor.log.sample_received") == 5
+    # ring holds 3; the 2 oldest fell off and are now counted
+    assert len(mon.get_event_logs()) == 3
+    assert counters.get("monitor.log.sample_evicted") == 2
+    # disabled-submission drops stay a separate counter
+    assert counters.get("monitor.log.sample_dropped") == 0
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace export
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_export_schema(tmp_path):
+    clock = SimClock()
+    tracer = Tracer("nodeA", clock)
+    ctx = tracer.start_trace("origin", module="spark")
+    span = tracer.start_span("stage", ctx, module="decision")
+    tracer.end_span(span)
+    leaked = tracer.start_span("leak", ctx)  # open: must be skipped
+    events = chrome_trace_events(tracer.get_spans())
+    # metadata records name the process/thread lanes
+    metas = [e for e in events if e["ph"] == "M"]
+    assert {m["name"] for m in metas} >= {"process_name", "thread_name"}
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 2  # origin + stage; the open span is skipped
+    for e in xs:
+        assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid", "args"}
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["dur"] >= 0
+        assert e["args"]["trace_id"] == ctx.trace_id
+    # file form: one event per line inside a single valid JSON array
+    path = tmp_path / "trace.json"
+    n = write_chrome_trace(str(path), tracer.get_spans())
+    text = path.read_text()
+    parsed = json.loads(text)
+    assert len(parsed) == n == len(events)
+    assert text.splitlines()[0] == "["
+    tracer.end_span(leaked)
+
+
+# ---------------------------------------------------------------------------
+# 9-node grid acceptance: link event -> FIB ack, TPU kernel child span
+# ---------------------------------------------------------------------------
+
+
+def _tpu_device_always(cfg):
+    cfg.tpu_compute_config.min_device_prefixes = 0
+
+
+def test_nine_node_grid_end_to_end_trace():
+    """The acceptance run: one emulated 9-node grid with tracing enabled
+    produces (a) a complete multi-node span tree from a link event to the
+    FIB ack with a `decision.spf_kernel` child span, (b) p50/p95/p99 for
+    `convergence.event_to_fib_ms` and `decision.spf_kernel_ms` via the
+    get_histograms ctrl surface, (c) a validating Chrome-trace JSONL
+    export — deterministically, on SimClock."""
+    from openr_tpu.ctrl.handler import OpenrCtrlHandler
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import grid_edges
+
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(
+            clock, use_tpu_backend=True, config_overrides=_tpu_device_always
+        )
+        net.build(grid_edges(3))
+        net.start()
+        await clock.run_for(20.0)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        net.fail_link("node0", "node1")
+        await clock.run_for(8.0)
+
+        spans = net.all_spans()
+        by_id = {s.span_id: s for s in spans}
+
+        def root_of(s):
+            seen = set()
+            while s.parent_id and s.parent_id in by_id and s.span_id not in seen:
+                seen.add(s.span_id)
+                s = by_id[s.parent_id]
+            return s
+
+        # (a) a multi-node span tree: some fib.ack on a REMOTE node whose
+        # parent chain walks back to the ORIGIN node's link event, with a
+        # TPU kernel child span inside the same trace
+        complete = []
+        for s in spans:
+            if s.name != "fib.ack":
+                continue
+            root = root_of(s)
+            trace_nodes = {t.node for t in spans if t.trace_id == s.trace_id}
+            names = {t.name for t in spans if t.trace_id == s.trace_id}
+            if (
+                root.name.startswith(("link_monitor.interface", "spark."))
+                and len(trace_nodes) >= 2
+                and "decision.spf_kernel" in names
+                and "decision.rebuild" in names
+            ):
+                complete.append((s, root, trace_nodes))
+        assert complete, "no complete multi-node link-event->FIB-ack trace"
+        s, root, trace_nodes = complete[0]
+        assert root.node != s.node or len(trace_nodes) >= 2
+        # the kernel span is a CHILD of the decision.spf dispatch span
+        kernel = next(
+            t
+            for t in spans
+            if t.trace_id == s.trace_id and t.name == "decision.spf_kernel"
+        )
+        assert by_id[kernel.parent_id].name == "decision.spf"
+        assert kernel.attrs.get("kernel")
+        # every span in the tree is closed (end-to-end completeness)
+        assert all(
+            t.end_ms is not None for t in spans if t.trace_id == s.trace_id
+        )
+
+        # (b) histograms through the ctrl surface
+        handler = OpenrCtrlHandler(net.nodes[s.node])
+        hists = handler.get_histograms()
+        for key in ("convergence.event_to_fib_ms", "decision.spf_kernel_ms"):
+            assert key in hists, f"missing histogram {key}"
+            for p in ("p50", "p95", "p99"):
+                assert hists[key][p] is not None
+        assert hists["convergence.event_to_fib_ms"]["p50"] > 0
+        # ctrl trace surface returns the same trace
+        got = handler.get_traces(trace_id=s.trace_id)
+        assert any(t["name"] == "fib.ack" for t in got)
+        assert handler.get_trace_ids()
+
+        # (c) Chrome-trace export validates
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("r", suffix=".json") as f:
+            n = net.export_trace(f.name)
+            events = json.load(open(f.name))
+            assert n == len(events) > 0
+            xs = [e for e in events if e["ph"] == "X"]
+            assert xs and all(
+                set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+                for e in xs
+            )
+            # one pid lane per emitting node
+            pids = {e["pid"] for e in events}
+            assert len(pids) >= 9
+
+        # bounded-drop invariant on the healthy path
+        for node in net.nodes.values():
+            assert node.tracer.num_dropped == 0
+        await net.stop()
+
+    run(main())
