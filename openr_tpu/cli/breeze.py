@@ -534,6 +534,95 @@ def monitor_statistics(ctx: click.Context) -> None:
         click.echo(f"{k:<{width}}  {v}")
 
 
+# ----------------------------------------------------------------- serving
+
+
+@breeze.group()
+def serving() -> None:
+    """Query-serving plane: micro-batched, cached fleet/what-if queries
+    (openr_tpu.serving; docs/Serving.md)."""
+
+
+@serving.command("stats")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def serving_stats(ctx: click.Context, json_out: bool) -> None:
+    """Serving-plane telemetry: batch/cache/shed counters, queue-wait
+    and batch-size histograms, and the live knobs."""
+    stats = _call(ctx, "get_serving_stats")
+    if json_out:
+        _print(stats)
+        return
+    click.echo(f"serving on {stats['node']} "
+               f"({'enabled' if stats['enabled'] else 'DISABLED'})")
+    cfg = stats.get("config", {})
+    click.echo(
+        "  knobs: "
+        + " ".join(f"{k}={v}" for k, v in sorted(cfg.items()))
+    )
+    counters = stats.get("counters", {})
+    if counters:
+        width = max(len(k) for k in counters)
+        for k, v in sorted(counters.items()):
+            click.echo(f"  {k:<{width}}  {v}")
+    hists = stats.get("histograms", {})
+    for k, h in sorted(hists.items()):
+        click.echo(
+            f"  {k}: count={h.get('count', 0)} p50={h.get('p50')} "
+            f"p95={h.get('p95')} p99={h.get('p99')} max={h.get('max')}"
+        )
+
+
+@serving.command("routes")
+@click.argument("node")
+@click.option("--client-id", default="", help="quota accounting id")
+@click.pass_context
+def serving_routes(ctx: click.Context, node: str, client_id: str) -> None:
+    """NODE's computed RouteDb through the serving plane (batched with
+    concurrent queries, cached per LSDB/policy generation)."""
+    _print(
+        _call(
+            ctx, "serving_route_db_computed", node=node, client_id=client_id
+        )
+    )
+
+
+@serving.command("whatif")
+@click.argument("links", nargs=-1, required=True)
+@click.option("--simultaneous", is_flag=True,
+              help="ALL listed links fail at once (one combined answer)")
+@click.option("--client-id", default="", help="quota accounting id")
+@click.pass_context
+def serving_whatif(
+    ctx: click.Context, links, simultaneous: bool, client_id: str
+) -> None:
+    """What-if through the serving plane.  LINKS are N1:N2 pairs."""
+    failures = []
+    for pair in links:
+        n1, _, n2 = pair.partition(":")
+        if not n1 or not n2:
+            raise click.UsageError(f"link must be N1:N2, got {pair!r}")
+        failures.append([n1, n2])
+    _print(
+        _call(
+            ctx,
+            "serving_link_failure_whatif",
+            link_failures=failures,
+            simultaneous=simultaneous,
+            client_id=client_id,
+        )
+    )
+
+
+@serving.command("fleet-summary")
+@click.option("--client-id", default="", help="quota accounting id")
+@click.pass_context
+def serving_fleet_summary(ctx: click.Context, client_id: str) -> None:
+    """Every node's route counts from one batched device solve, through
+    the serving plane."""
+    _print(_call(ctx, "serving_fleet_summary", client_id=client_id))
+
+
 # ----------------------------------------------------------------- kvstore
 
 

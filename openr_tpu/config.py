@@ -145,6 +145,32 @@ class TracingConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Query-serving plane knobs (openr_tpu.serving, net-new vs the
+    reference): dynamic micro-batching, content-addressed result
+    caching, and admission control for fleet/what-if queries.  See
+    docs/Serving.md."""
+
+    enabled: bool = True
+    #: flush the batch window as one device solve once this many
+    #: distinct queries are pending
+    max_batch: int = 64
+    #: ...or when the oldest pending query has waited this long
+    max_wait_ms: int = 5
+    #: bounded queue depth; arrivals beyond it trigger the shed policy
+    max_queue_depth: int = 1024
+    #: "reject_newest" refuses the arrival; "shed_oldest" evicts the
+    #: longest-waiting pending query in the arrival's favor
+    shed_policy: str = "reject_newest"
+    #: per-client token-bucket capacity (0 = unlimited)
+    quota_tokens: int = 0
+    #: tokens regained per second per client
+    quota_refill_per_s: float = 100.0
+    #: result-cache LRU bound, in (generation, query) entries (0 = off)
+    cache_entries: int = 1024
+
+
+@dataclass
 class OriginatedPrefix:
     """Config-originated prefix w/ optional aggregation
     (OpenrConfig.thrift:345-441)."""
@@ -228,6 +254,7 @@ class OpenrConfig:
     fib_config: FibConfig = field(default_factory=FibConfig)
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
     tracing_config: TracingConfig = field(default_factory=TracingConfig)
+    serving_config: ServingConfig = field(default_factory=ServingConfig)
     originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
     segment_routing_config: SegmentRoutingConfig = field(
         default_factory=SegmentRoutingConfig
@@ -281,6 +308,17 @@ class OpenrConfig:
         d = self.decision_config
         if not (0 < d.debounce_min_ms <= d.debounce_max_ms):
             raise ValueError("invalid decision debounce window")
+        s = self.serving_config
+        if s.shed_policy not in ("reject_newest", "shed_oldest"):
+            raise ValueError(
+                "serving shed_policy must be 'reject_newest' or "
+                f"'shed_oldest', got {s.shed_policy!r}"
+            )
+        if s.max_batch < 1 or s.max_queue_depth < 1 or s.max_wait_ms < 0:
+            raise ValueError(
+                "serving needs max_batch >= 1, max_queue_depth >= 1, "
+                "max_wait_ms >= 0"
+            )
         from openr_tpu.lsdb_codec import WIRE_FORMATS
 
         if self.lsdb_wire_format not in WIRE_FORMATS:

@@ -469,6 +469,50 @@ class OpenrCtrlHandler:
             "nodes": summary or {},
         }
 
+    # -------------------------------------------------------------- serving
+    # (openr_tpu.serving — micro-batched/cached/admission-controlled
+    # fleet + what-if queries; net-new vs the reference)
+
+    def get_serving_stats(self) -> dict:
+        """Serving-plane telemetry: queue/batch/cache/shed counters,
+        latency histograms, and the live config knobs
+        (`breeze serving stats`)."""
+        return self.node.serving.stats()
+
+    async def serving_route_db_computed(
+        self, node: str, client_id: str = ""
+    ) -> dict:
+        """getRouteDbComputed THROUGH the serving plane: micro-batched
+        (N concurrent vantages share one fleet batch solve), cached per
+        LSDB/policy generation, admission-controlled."""
+        return await self.node.serving.submit(
+            "route_db", {"node": node}, client_id=client_id
+        )
+
+    async def serving_link_failure_whatif(
+        self,
+        link_failures: List[List[str]],
+        simultaneous: bool = False,
+        client_id: str = "",
+    ) -> dict:
+        """get_link_failure_whatif THROUGH the serving plane: concurrent
+        distinct queries coalesce into one device sweep; identical ones
+        dedup onto one future; answers cache per generation."""
+        return await self.node.serving.submit(
+            "whatif",
+            {
+                "link_failures": [tuple(f) for f in link_failures],
+                "simultaneous": simultaneous,
+            },
+            client_id=client_id,
+        )
+
+    async def serving_fleet_summary(self, client_id: str = "") -> dict:
+        """get_fleet_rib_summary THROUGH the serving plane."""
+        return await self.node.serving.submit(
+            "fleet_summary", {}, client_id=client_id
+        )
+
     def get_route_detail_db(self) -> List[dict]:
         """Unicast routes with full selection detail: best entry, area,
         igp cost (getRouteDetailDb / RouteDetailDb)."""

@@ -123,8 +123,15 @@ class Decision(Actor):
         self._pending_topo_changed = False
         self._pending_force_full = False
         self._last_policy_active = False
-        #: bumped on every LSDB change — keys the fleet-RIB table cache
+        #: bumped on every LSDB change AND every RibPolicy set/clear —
+        #: keys the fleet-RIB / what-if table caches and the serving
+        #: plane's content-addressed result cache.  A policy flip between
+        #: two identical-LSDB queries MUST invalidate those caches (the
+        #: computed-result generation is (LSDB, policy), not LSDB alone)
         self._change_seq = 0
+        #: serving-plane invalidation hooks, called with the new change
+        #: seq whenever the computed-result generation moves
+        self._generation_listeners: List[Callable[[int], None]] = []
         self._fleet_engine = None
         self._whatif_engine = None
         self._whatif_multi_engine = None
@@ -249,10 +256,38 @@ class Decision(Actor):
             changed |= self._delete_key(area, key)
         if changed:
             self.counters.bump("decision.lsdb_updates")
-            self._change_seq += 1
+            self._bump_generation()
             self._rebuild_pending = True
             if self._unblocked:
                 self._debounce()
+
+    def _bump_generation(self) -> None:
+        """Advance the computed-result generation and notify the serving
+        plane so cached results from the previous generation are never
+        served again (the rebuild-path invalidation contract)."""
+        self._change_seq += 1
+        for listener in self._generation_listeners:
+            listener(self._change_seq)
+
+    def add_generation_listener(self, fn: Callable[[int], None]) -> None:
+        """Register a callback fired on every generation bump (LSDB
+        change or RibPolicy set/clear).  Used by openr_tpu.serving to
+        invalidate its content-addressed result cache eagerly."""
+        self._generation_listeners.append(fn)
+
+    def generation_key(self) -> tuple:
+        """Content address of the state every computed-result query
+        depends on: the change generation (LSDB churn + policy flips)
+        plus each area's topology sequence.  Two equal keys guarantee a
+        cached answer is still exact; any LSDB or policy change produces
+        a fresh key."""
+        return (
+            self._change_seq,
+            tuple(
+                (a, self.area_link_states[a].topology_seq)
+                for a in sorted(self.area_link_states)
+            ),
+        )
 
     def _bulk_update_prefix_keys(self, area: str, items: List[tuple]) -> bool:
         """Native-kernel batch ingest of ``prefix:`` values (the cold-boot
@@ -515,6 +550,10 @@ class Decision(Actor):
     def set_rib_policy(self, policy: RibPolicy) -> None:
         self.rib_policy = policy
         self._save_rib_policy()
+        # a policy flip changes what every computed-result query would
+        # return even on an identical LSDB: the fleet/what-if table
+        # caches and the serving result cache key on this generation
+        self._bump_generation()
         self._rebuild_pending = True
         self._pending_force_full = True
         if self._unblocked:
@@ -527,6 +566,7 @@ class Decision(Actor):
         self.rib_policy = None
         if self.rib_policy_file and os.path.exists(self.rib_policy_file):
             os.unlink(self.rib_policy_file)
+        self._bump_generation()
         self._rebuild_pending = True
         self._pending_force_full = True
         if self._unblocked:
@@ -573,12 +613,23 @@ class Decision(Actor):
             self._fleet_engine = FleetRibEngine(self.solver)
         return self._fleet_engine
 
+    def device_available(self) -> bool:
+        """Device compute usable for fleet/what-if answers: a device
+        backend whose accelerator is not in an (injected or real)
+        outage.  While `device_failed` is set — chaos `tpu_fail`, or an
+        operator draining a sick accelerator — every computed-result
+        query must degrade to the scalar/native paths exactly like the
+        daemon's own route builds do."""
+        return not isinstance(self.backend, ScalarBackend) and not getattr(
+            self.backend, "device_failed", False
+        )
+
     def compute_route_db_for_node(self, node: str) -> Optional[DecisionRouteDb]:
         """What-if: the RouteDb as `node` would compute it
         (getRouteDbComputed ctrl API).  When the device fleet engine is
         eligible, ALL nodes' tables come from one cached batch solve and
         only this node's view is decoded; else a fresh scalar pass."""
-        if not isinstance(self.backend, ScalarBackend):
+        if self.device_available():
             fleet = self._fleet()
             if fleet.eligible(
                 self.area_link_states, self.prefix_state, self._change_seq
@@ -614,7 +665,7 @@ class Decision(Actor):
         batch shape is exactly what the set-repair kernel exists for.
         None = ineligible (device feature: scalar-only deployments and
         multi-area vantages decline; KSP2 declines via fleet gating)."""
-        if isinstance(self.backend, ScalarBackend):
+        if not self.device_available():
             return None
         if len(self.area_link_states) != 1:
             return None
@@ -671,7 +722,7 @@ class Decision(Actor):
         back to the jax-free GenericSolverWhatIfEngine.  None only when
         there is no LSDB yet or a build overflows the candidate
         buckets."""
-        scalar_only = isinstance(self.backend, ScalarBackend)
+        scalar_only = not self.device_available()
         fleet = self._fleet()
         if not self.area_link_states:
             return None
@@ -934,7 +985,9 @@ class Decision(Actor):
         # engine (which handles up to the largest degree bucket)
         if len(ls.links_from_node(me)) > MAX_LANES:
             return False
-        if isinstance(self.backend, ScalarBackend):
+        if not self.device_available():
+            # scalar-only deployment, or the device is out: the native
+            # engine is the only warm-start option left (no jax loads)
             return True
         is_tpu = isinstance(self.backend, TpuBackend)
         rt_ms = self.backend.auto_dispatch_rt_ms if is_tpu else None
@@ -956,8 +1009,8 @@ class Decision(Actor):
         """Per-node route counts for EVERY vantage point from one batched
         device solve; None when the fleet engine isn't eligible (incl.
         scalar-only deployments, which must never touch the device
-        stack)."""
-        if isinstance(self.backend, ScalarBackend):
+        stack, and device backends in an injected/real outage)."""
+        if not self.device_available():
             return None
         fleet = self._fleet()
         if not fleet.eligible(

@@ -230,6 +230,15 @@ class EmulatedNetwork:
 
         return write_chrome_trace(path, self.all_spans())
 
+    def serving_stats(self) -> Dict[str, dict]:
+        """Per-node serving-plane stats (queue/batch/cache/shed counters
+        and knobs) — the whole-emulation view of `breeze serving stats`,
+        used by chaos runs to assert the query plane stayed healthy."""
+        return {
+            name: node.serving.stats()
+            for name, node in sorted(self.nodes.items())
+        }
+
     def merged_histogram(self, key: str):
         """Cross-node merge of one histogram key (None when no node
         observed it) — convergence percentiles for the whole emulation."""

@@ -344,6 +344,20 @@ class OpenrNode:
             dryrun=config.dryrun,
             tracer=self.tracer,
         )
+        # the serving plane fronts Decision's fleet/what-if engines with
+        # micro-batching + result caching + admission control; it
+        # registers its cache-invalidation hook on Decision's rebuild
+        # path in its constructor
+        from openr_tpu.serving.service import QueryService
+
+        self.serving = QueryService(
+            node_name=self.name,
+            clock=clock,
+            config=config.serving_config,
+            decision=self.decision,
+            counters=self.counters,
+            tracer=self.tracer,
+        )
         # -- aux services (L6): config-store, monitor, watchdog ------------
         # Drain state survives restarts via the persistent store
         # (reference: LinkMonitor loads from PersistentStore on start,
@@ -378,6 +392,7 @@ class OpenrNode:
         self.monitor.add_counter_provider(self.tracer.stats)
         self.monitor.add_counter_provider(self.dispatcher.queue_stats)
         self.monitor.add_counter_provider(self._queue_gauges)
+        self.monitor.add_counter_provider(self.serving.gauges)
         self.watchdog: Optional[Watchdog] = None
         if config.enable_watchdog:
             wd = config.watchdog_config
@@ -401,6 +416,8 @@ class OpenrNode:
             self.decision,
             self.fib,
         ]
+        if config.serving_config.enabled:
+            self._all_modules.append(self.serving)
         if self.watchdog is not None:
             self._all_modules.insert(0, self.watchdog)
             for m in self._all_modules[1:]:

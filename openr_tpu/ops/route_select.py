@@ -24,11 +24,16 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from openr_tpu.ops.spf import BIG
 
-I32_MIN = jnp.int32(-(2**31))
-I32_MAX = jnp.int32(2**31 - 1)
+# numpy (not jnp) scalars: this module is imported lazily, sometimes
+# INSIDE a jit trace (engines import kernels on first dispatch) — a
+# module-level jnp constant minted there would be a tracer and poison
+# every later compilation with an UnexpectedTracerError
+I32_MIN = np.int32(-(2**31))
+I32_MAX = np.int32(2**31 - 1)
 
 
 def select_routes_one(

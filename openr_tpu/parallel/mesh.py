@@ -20,6 +20,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 BATCH_AXIS = "batch"
 
 
+def shard_map_supported() -> bool:
+    """True when this jax exposes the stable ``jax.shard_map`` entry
+    point the sharded kernels are written against (its ``check_vma``
+    signature landed with the stable export).  Older environments only
+    carry the incompatible ``jax.experimental.shard_map`` API; the
+    sharded code paths (and their tests) gate on this instead of
+    failing at dispatch time."""
+    return hasattr(jax, "shard_map")
+
+
 def make_mesh(num_devices: Optional[int] = None) -> Mesh:
     devices = jax.devices()
     if num_devices is not None:

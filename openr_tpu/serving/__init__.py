@@ -1,0 +1,27 @@
+"""openr_tpu.serving — the query-serving plane.
+
+See serving/service.py (QueryService: micro-batching, dedup, admission
+control) and serving/cache.py (content-addressed result cache), and
+docs/Serving.md for the architecture and knobs.
+"""
+
+from openr_tpu.serving.cache import ResultCache, canonical_query
+from openr_tpu.serving.service import (
+    QueryService,
+    ServingError,
+    ServingQuotaError,
+    ServingRejectedError,
+    ServingShedError,
+    TokenBucket,
+)
+
+__all__ = [
+    "QueryService",
+    "ResultCache",
+    "ServingError",
+    "ServingQuotaError",
+    "ServingRejectedError",
+    "ServingShedError",
+    "TokenBucket",
+    "canonical_query",
+]

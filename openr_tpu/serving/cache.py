@@ -1,0 +1,110 @@
+"""Content-addressed result cache for the serving plane.
+
+Keys are ``(generation_key, canonical_query)``: the generation key is
+Decision's content address of everything a computed-result query depends
+on (LSDB change seq + per-area topology seqs + RibPolicy flips — see
+``Decision.generation_key``), and the canonical query is the normalized,
+hashable form of the request (``canonical_query`` below).  Equal keys
+therefore guarantee the cached answer is still exact — there is no TTL
+and no staleness window by construction.
+
+Two independent safety mechanisms keep stale results unreachable:
+
+* the generation is part of the key, so an entry minted before an LSDB
+  change (a partition, a policy flip) can never match a query issued
+  after it;
+* Decision's rebuild path calls ``invalidate_generation`` through the
+  registered generation listener, so superseded entries are purged
+  eagerly instead of waiting for LRU pressure (bounded memory even when
+  the LSDB churns faster than the LRU turns over).
+
+The LRU bound covers the steady state: distinct queries within one
+generation (different vantage nodes, different failure sets)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Hashable, Optional, Tuple
+
+
+def canonical_query(kind: str, params: dict) -> Tuple[Hashable, ...]:
+    """Normalize a request into its content address.
+
+    Two requests that must receive the same answer hash equal:
+    link-failure pairs are order-normalized within each pair ((a, b) ==
+    (b, a) — the engines resolve by undirected node pair), and for
+    non-simultaneous what-ifs the ORDER of independent failures is
+    irrelevant to each per-failure answer but NOT to the response shape
+    (failures come back in request order), so the failure list order is
+    preserved there and only each pair is normalized."""
+    if kind == "route_db":
+        return ("route_db", str(params["node"]))
+    if kind == "whatif":
+        pairs = tuple(
+            tuple(sorted((str(n1), str(n2))))
+            for n1, n2 in params["link_failures"]
+        )
+        simultaneous = bool(params.get("simultaneous", False))
+        if simultaneous:
+            # one combined answer: the SET of failed links is the
+            # content; ordering and duplicates are irrelevant
+            pairs = tuple(sorted(set(pairs)))
+        return ("whatif", pairs, simultaneous)
+    if kind == "fleet_summary":
+        return ("fleet_summary",)
+    raise ValueError(f"unknown serving query kind {kind!r}")
+
+
+class ResultCache:
+    """Bounded LRU over (generation, canonical query) -> result."""
+
+    def __init__(self, max_entries: int = 1024) -> None:
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[tuple, Any]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, generation: Hashable, query: Hashable):
+        """(hit, result); LRU-refreshes on hit."""
+        if self.max_entries <= 0:
+            self.misses += 1
+            return False, None
+        key = (generation, query)
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return True, self._entries[key]
+        self.misses += 1
+        return False, None
+
+    def put(self, generation: Hashable, query: Hashable, result) -> None:
+        if self.max_entries <= 0:
+            return
+        key = (generation, query)
+        self._entries[key] = result
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    def invalidate_generation(self, live_generation: Optional[Hashable] = None) -> None:
+        """Purge every entry NOT minted under ``live_generation`` (all
+        entries when None) — the Decision rebuild-path hook."""
+        if live_generation is None:
+            self.invalidations += len(self._entries)
+            self._entries.clear()
+            return
+        stale = [
+            k for k in self._entries if k[0] != live_generation
+        ]
+        for k in stale:
+            del self._entries[k]
+        self.invalidations += len(stale)
+
+    def clear(self) -> None:
+        self._entries.clear()

@@ -1,0 +1,567 @@
+"""QueryService — the serving plane for fleet / what-if queries.
+
+Every ctrl `get_route_db_computed` / what-if call used to be answered
+synchronously, one request at a time, even though the device engines
+(decision/fleet.py, decision/whatif_api.py) are *batched by
+construction*: N concurrent queries against one LSDB generation should
+be one vmapped device solve, not N.  This actor fronts those engines
+with the three mechanisms a production query plane needs:
+
+* **dynamic micro-batching** — requests accumulate in a bounded queue
+  and flush as ONE device batch when ``max_batch`` fills or
+  ``max_wait_ms`` expires (timing on the injected ``Clock``, so SimClock
+  tests replay deterministically).  Identical in-flight queries
+  deduplicate onto one future; distinct what-if queries against the same
+  generation coalesce into a single engine sweep whose per-failure rows
+  are then distributed back per request.
+* **content-addressed result cache** — LRU over (LSDB/policy generation,
+  canonicalized query); see serving/cache.py.  Invalidated eagerly by
+  Decision's rebuild path (generation listener) and structurally by the
+  generation being part of the key, and warm-start table reuse inside
+  the engines means even a cache MISS on an unchanged generation pays
+  only the incremental solve.
+* **admission control** — bounded queue depth with a configurable shed
+  policy (``reject_newest`` refuses the arrival; ``shed_oldest`` evicts
+  the longest-waiting request in its favor), per-client token quotas
+  (token bucket on the injected clock), and graceful degradation: when
+  the TPU backend is out (chaos ``tpu_outage``), queries route through
+  Decision's scalar/native paths and the shed machinery bounds the
+  backlog instead of deadlocking.
+
+Observability: ``serving.*`` counters and histograms (queue wait, batch
+size, batch solve latency, cache hit/miss, sheds) on the node
+CounterMap, a gauge provider for Monitor.add_counter_provider, and
+TraceContext propagation so a served query renders as
+``serving.enqueue → serving.batch_solve → decision.spf_kernel`` spans.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.config import ServingConfig
+from openr_tpu.serving.cache import ResultCache, canonical_query
+
+
+class ServingError(RuntimeError):
+    """Base of every admission-control refusal (maps to an RPC error)."""
+
+
+class ServingShedError(ServingError):
+    """The request was admitted but shed before solving (queue bound)."""
+
+
+class ServingRejectedError(ServingError):
+    """The request was refused at admission (queue full, reject_newest)."""
+
+
+class ServingQuotaError(ServingError):
+    """The client exceeded its token quota."""
+
+
+class TokenBucket:
+    """Per-client admission quota on the injected clock (capacity 0 =
+    unlimited).  Refill is computed lazily from elapsed clock time, so
+    SimClock tests replay deterministically."""
+
+    __slots__ = ("capacity", "refill_per_s", "tokens", "_t_last")
+
+    def __init__(self, capacity: int, refill_per_s: float, now: float) -> None:
+        self.capacity = capacity
+        self.refill_per_s = refill_per_s
+        self.tokens = float(capacity)
+        self._t_last = now
+
+    def take(self, now: float) -> bool:
+        if self.capacity <= 0:
+            return True
+        self.tokens = min(
+            float(self.capacity),
+            self.tokens + (now - self._t_last) * self.refill_per_s,
+        )
+        self._t_last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def is_full(self, now: float) -> bool:
+        """Bucket would be at capacity after refill — it carries no
+        state worth keeping (prune target)."""
+        return (
+            self.capacity <= 0
+            or self.tokens + (now - self._t_last) * self.refill_per_s
+            >= self.capacity
+        )
+
+
+class _Request:
+    """One admitted query: its canonical key, waiters, and trace span."""
+
+    __slots__ = (
+        "kind", "params", "query", "generation", "futures",
+        "t_enqueue", "span", "client_id",
+    )
+
+    def __init__(
+        self, kind: str, params: dict, query: tuple, generation,
+        t_enqueue: float, span, client_id: str,
+    ) -> None:
+        self.kind = kind
+        self.params = params
+        self.query = query
+        self.generation = generation
+        self.futures: List[asyncio.Future] = []
+        self.t_enqueue = t_enqueue
+        self.span = span
+        self.client_id = client_id
+
+    def resolve(self, result) -> None:
+        for f in self.futures:
+            if not f.done():
+                f.set_result(result)
+
+    def fail(self, exc: BaseException) -> None:
+        for f in self.futures:
+            if not f.done():
+                f.set_exception(exc)
+
+
+class QueryService(Actor):
+    """In-process query service fronting the Decision engines."""
+
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config: ServingConfig,
+        decision,
+        counters: Optional[CounterMap] = None,
+        tracer=None,
+    ) -> None:
+        super().__init__("serving", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.node_name = node_name
+        self.config = config
+        self.decision = decision
+        self.tracer = tracer if tracer is not None else disabled_tracer()
+        self.cache = ResultCache(config.cache_entries)
+        #: FIFO of distinct pending requests (dedup attaches to these)
+        self._pending: List[_Request] = []
+        #: canonical key -> pending request, for in-flight dedup
+        self._pending_by_key: Dict[tuple, _Request] = {}
+        #: set when the batch window should flush early (max_batch full)
+        self._full: Optional[asyncio.Future] = None
+        #: wakes the flush fiber when the queue goes non-empty
+        self._arrival = asyncio.Event() if _in_loop() else None
+        self._quotas: Dict[str, TokenBucket] = {}
+        self.num_batches = 0
+        self.num_requests = 0
+        self.num_shed = 0
+        self.num_rejected = 0
+        self.num_quota_rejected = 0
+        self.num_dedup_hits = 0
+        self.num_degraded = 0
+        self.num_batch_solves = 0
+        # eager cache invalidation from Decision's rebuild path
+        decision.add_generation_listener(self._on_generation_change)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._arrival is None:
+            self._arrival = asyncio.Event()
+        self.spawn(self._flush_loop(), name="serving.batcher")
+
+    async def stop(self) -> None:
+        await super().stop()
+        # never strand a waiter across shutdown: pending futures fail
+        # fast instead of hanging their ctrl connections
+        pending, self._pending = self._pending, []
+        self._pending_by_key.clear()
+        for req in pending:
+            self.tracer.end_span(req.span, shed="shutdown")
+            req.fail(ServingError("serving stopped"))
+
+    def _on_generation_change(self, _seq: int) -> None:
+        """Decision bumped the computed-result generation: purge every
+        cached answer from superseded generations (they can also never
+        match again by key, but eager purging bounds memory and makes
+        the invalidation observable)."""
+        self.cache.invalidate_generation(self.decision.generation_key())
+        self.counters.bump("serving.cache.generation_invalidations")
+
+    # -- admission + submit ------------------------------------------------
+
+    #: quota-map bound: past this many distinct clients, fully-refilled
+    #: buckets (which carry no state) are pruned — a million-client
+    #: deployment must not grow the map without bound
+    MAX_QUOTA_CLIENTS = 16384
+
+    def _check_quota(self, client_id: str) -> None:
+        cfg = self.config
+        if cfg.quota_tokens <= 0:
+            return  # unlimited: keep no per-client state at all
+        if len(self._quotas) > self.MAX_QUOTA_CLIENTS:
+            now = self.clock.now()
+            for cid in [
+                c
+                for c, b in self._quotas.items()
+                if c != client_id and b.is_full(now)
+            ]:
+                del self._quotas[cid]
+        bucket = self._quotas.get(client_id)
+        if bucket is None:
+            bucket = self._quotas[client_id] = TokenBucket(
+                cfg.quota_tokens, cfg.quota_refill_per_s, self.clock.now()
+            )
+        if not bucket.take(self.clock.now()):
+            self.num_quota_rejected += 1
+            self.counters.bump("serving.quota_rejected")
+            raise ServingQuotaError(
+                f"client {client_id!r} exceeded its token quota "
+                f"({cfg.quota_tokens} tokens, "
+                f"{cfg.quota_refill_per_s}/s refill)"
+            )
+
+    def _admit_depth(self) -> None:
+        """Queue-depth admission: only requests that need a NEW queue
+        slot pass through here (cache hits and dedup joins don't)."""
+        cfg = self.config
+        if len(self._pending) < cfg.max_queue_depth:
+            return
+        if cfg.shed_policy == "shed_oldest":
+            oldest = self._pending.pop(0)
+            self._pending_by_key.pop(oldest.query, None)
+            self._shed(oldest, "shed_oldest")
+            return
+        self.num_rejected += 1
+        self.counters.bump("serving.rejected")
+        raise ServingRejectedError(
+            f"serving queue full ({cfg.max_queue_depth} pending), "
+            "policy reject_newest"
+        )
+
+    def _shed(self, req: _Request, why: str) -> None:
+        self.num_shed += 1
+        self.counters.bump("serving.shed")
+        self.tracer.end_span(req.span, shed=why)
+        req.fail(
+            ServingShedError(
+                f"request shed under load ({why}; queue depth bound "
+                f"{self.config.max_queue_depth})"
+            )
+        )
+
+    async def submit(
+        self,
+        kind: str,
+        params: Optional[dict] = None,
+        client_id: str = "",
+        trace_ctx=None,
+    ) -> Any:
+        """Admit one query and await its (possibly batched/deduped/
+        cached) answer.  Raises ServingError subclasses on admission
+        refusal or load shed."""
+        params = params or {}
+        self.num_requests += 1
+        self.counters.bump("serving.requests")
+        query = canonical_query(kind, params)
+        client = client_id or "anon"
+        self._check_quota(client)
+        generation = self.decision.generation_key()
+        hit, cached = self.cache.get(generation, query)
+        if hit:
+            self.counters.bump("serving.cache.hits")
+            self.tracer.instant(
+                "serving.cache_hit", trace_ctx, module="serving", kind=kind
+            )
+            return cached
+        self.counters.bump("serving.cache.misses")
+        if not self.config.enabled:
+            # serving disabled by config: the actor never starts, so
+            # answer inline — the pre-serving synchronous path (still
+            # cached/quota'd, so flipping the knob is purely about the
+            # batcher)
+            result = self._solve_inline(kind, params)
+            self.cache.put(generation, query, result)
+            return result
+        inflight = self._pending_by_key.get(query)
+        if inflight is not None and inflight.generation == generation:
+            # identical in-flight query: one solve, many waiters
+            self.num_dedup_hits += 1
+            self.counters.bump("serving.dedup_hits")
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            inflight.futures.append(fut)
+            return await fut
+        self._admit_depth()
+        span = self.tracer.start_span(
+            "serving.enqueue", trace_ctx, module="serving",
+            kind=kind, client=client,
+        )
+        req = _Request(
+            kind, params, query, generation, self.clock.now(), span, client
+        )
+        fut = asyncio.get_running_loop().create_future()
+        req.futures.append(fut)
+        self._pending.append(req)
+        self._pending_by_key[query] = req
+        if self._arrival is not None:
+            self._arrival.set()
+        if (
+            len(self._pending) >= self.config.max_batch
+            and self._full is not None
+            and not self._full.done()
+        ):
+            self._full.set_result(None)
+        return await fut
+
+    # -- the micro-batcher -------------------------------------------------
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await self._arrival.wait()
+            if not self._pending:
+                self._arrival.clear()
+                continue
+            if len(self._pending) < self.config.max_batch:
+                # batch window: flush on max_wait_ms OR max_batch full
+                loop = asyncio.get_running_loop()
+                self._full = loop.create_future()
+                timer = asyncio.ensure_future(
+                    self.clock.sleep(self.config.max_wait_ms / 1000.0)
+                )
+                try:
+                    await asyncio.wait(
+                        {timer, self._full},
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                finally:
+                    timer.cancel()
+                    if not self._full.done():
+                        self._full.cancel()
+                    self._full = None
+            batch = self._pending[: self.config.max_batch]
+            del self._pending[: len(batch)]
+            for req in batch:
+                self._pending_by_key.pop(req.query, None)
+            if not self._pending:
+                self._arrival.clear()
+            self.touch()
+            self._execute_batch(batch)
+
+    def _execute_batch(self, batch: List[_Request]) -> None:
+        now = self.clock.now()
+        self.num_batches += 1
+        self.counters.bump("serving.batches")
+        self.counters.observe("serving.batch_size", float(len(batch)))
+        for req in batch:
+            self.counters.observe(
+                "serving.queue_wait_ms", (now - req.t_enqueue) * 1000.0
+            )
+            self.tracer.end_span(req.span)
+        if not self.decision.device_available():
+            # TPU outage (chaos tpu_fail / scalar-only deployment): the
+            # engines degrade to scalar/native paths inside Decision;
+            # count it so operators see the serving plane running on the
+            # fallback compute
+            self.num_degraded += 1
+            self.counters.bump("serving.degraded_batches")
+        # the batch_solve span parents under the FIRST request of the
+        # batch (the debounce-coalescing convention Decision uses)
+        bctx = self.tracer.child_ctx(batch[0].span) if batch else None
+        span = self.tracer.start_span(
+            "serving.batch_solve", bctx, module="serving",
+            batch_size=len(batch),
+        )
+        from openr_tpu.ops import jit_guard
+
+        t0 = self.clock.now()
+        try:
+            with jit_guard.trace_scope(
+                self.tracer, self.tracer.child_ctx(span)
+            ):
+                # results are keyed under the generation AT SOLVE TIME:
+                # a generation bump between enqueue and flush means the
+                # engines read the new state, so that is the generation
+                # the computed answer belongs to
+                self._solve(batch, self.decision.generation_key())
+        finally:
+            self.tracer.end_span(span)
+            self.counters.observe(
+                "serving.batch_solve_ms", (self.clock.now() - t0) * 1000.0
+            )
+
+    # -- batch execution ---------------------------------------------------
+
+    def _solve(self, batch: List[_Request], gen) -> None:
+        coalesce = {
+            id(r)
+            for r in batch
+            if r.kind == "whatif" and not r.params.get("simultaneous")
+        }
+        whatif = [r for r in batch if id(r) in coalesce]
+        rest = [r for r in batch if id(r) not in coalesce]
+        if whatif:
+            self._solve_whatif_coalesced(whatif, gen)
+        for req in rest:
+            try:
+                result = self._solve_one(req)
+            except ServingError as e:
+                req.fail(e)
+                continue
+            except Exception as e:  # noqa: BLE001 - engine errors cross
+                req.fail(ServingError(f"{type(e).__name__}: {e}"))
+                continue
+            self.cache.put(gen, req.query, result)
+            req.resolve(result)
+
+    def _solve_whatif_coalesced(self, reqs: List[_Request], gen) -> None:
+        """N distinct what-if queries, ONE engine sweep: the union of
+        every request's candidate failures solves as a single device
+        batch (per-failure snapshots are independent by construction),
+        then each request's answer is assembled from its own rows."""
+        union: List[Tuple[str, str]] = []
+        index: Dict[tuple, int] = {}
+        for req in reqs:
+            for n1, n2 in req.params["link_failures"]:
+                key = tuple(sorted((str(n1), str(n2))))
+                if key not in index:
+                    index[key] = len(union)
+                    union.append((str(n1), str(n2)))
+        if len(reqs) > 1:
+            self.counters.bump("serving.whatif_coalesced_queries", len(reqs))
+        self.num_batch_solves += 1
+        try:
+            result = self.decision.get_link_failure_whatif(
+                [list(p) for p in union]
+            )
+        except Exception as e:  # noqa: BLE001 - engine errors cross
+            err = ServingError(f"{type(e).__name__}: {e}")
+            for req in reqs:
+                req.fail(err)
+            return
+        if result is None or not result.get("eligible", False):
+            out = {"eligible": False, "failures": []}
+            for req in reqs:
+                self.cache.put(gen, req.query, out)
+                req.resolve(out)
+            return
+        rows = result["failures"]
+        meta = {
+            k: v for k, v in result.items() if k != "failures"
+        }
+        for req in reqs:
+            failures = []
+            for n1, n2 in req.params["link_failures"]:
+                failures.append(
+                    rows[index[tuple(sorted((str(n1), str(n2))))]]
+                )
+            answer = {**meta, "failures": failures}
+            self.cache.put(gen, req.query, answer)
+            req.resolve(answer)
+
+    def _solve_inline(self, kind: str, params: dict):
+        """One unbatched solve (disabled-mode path)."""
+        if kind == "whatif" and not params.get("simultaneous"):
+            result = self.decision.get_link_failure_whatif(
+                [list(p) for p in params["link_failures"]]
+            )
+            if result is None:
+                return {"eligible": False, "failures": []}
+            return result
+        req = _Request(kind, params, (), None, self.clock.now(), None, "")
+        return self._solve_one(req)
+
+    def _solve_one(self, req: _Request):
+        kind = req.kind
+        if kind == "route_db":
+            node = str(req.params["node"])
+            # the fleet engine answers EVERY vantage from one cached
+            # batch solve: a flush of K route_db requests costs one
+            # device solve + K decodes (or K scalar passes, degraded)
+            self.num_batch_solves += 1
+            db = self.decision.compute_route_db_for_node(node)
+            if db is None:
+                return {
+                    "this_node_name": node,
+                    "unicast_routes": [],
+                    "mpls_routes": [],
+                }
+            return db.to_route_database(node).to_wire()
+        if kind == "whatif":  # simultaneous sets (one combined answer)
+            self.num_batch_solves += 1
+            result = self.decision.get_link_failure_whatif(
+                [list(p) for p in req.params["link_failures"]],
+                simultaneous=True,
+            )
+            if result is None:
+                return {"eligible": False, "failures": []}
+            return result
+        if kind == "fleet_summary":
+            self.num_batch_solves += 1
+            summary = self.decision.get_fleet_rib_summary()
+            return {
+                "eligible": summary is not None,
+                "nodes": summary or {},
+            }
+        raise ServingError(f"unknown serving query kind {kind!r}")
+
+    # -- observability -----------------------------------------------------
+
+    def gauges(self) -> Dict[str, float]:
+        """Gauge provider for Monitor.add_counter_provider."""
+        looked = self.cache.hits + self.cache.misses
+        return {
+            "serving.queue_depth": float(len(self._pending)),
+            "serving.cache.entries": float(len(self.cache)),
+            "serving.cache.hit_ratio": (
+                self.cache.hits / looked if looked else 0.0
+            ),
+            "serving.cache.evictions": float(self.cache.evictions),
+            "serving.cache.invalidated_entries": float(
+                self.cache.invalidations
+            ),
+            "serving.clients": float(len(self._quotas)),
+            "serving.num_batches": float(self.num_batches),
+            "serving.num_batch_solves": float(self.num_batch_solves),
+            "serving.num_degraded_batches": float(self.num_degraded),
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        """The ctrl `get_serving_stats` payload: gauges + counters +
+        latency histograms + the live config knobs."""
+        # live gauges LAST: the Monitor's periodic provider sweep writes
+        # sampled (possibly stale) copies of these keys into the shared
+        # CounterMap; the stats RPC must report the current values
+        out: Dict[str, Any] = dict(self.counters.dump("serving."))
+        out.update(self.gauges())
+        return {
+            "node": self.node_name,
+            "enabled": self.config.enabled,
+            "counters": out,
+            "histograms": self.counters.dump_histograms("serving."),
+            "config": {
+                "max_batch": self.config.max_batch,
+                "max_wait_ms": self.config.max_wait_ms,
+                "max_queue_depth": self.config.max_queue_depth,
+                "shed_policy": self.config.shed_policy,
+                "quota_tokens": self.config.quota_tokens,
+                "quota_refill_per_s": self.config.quota_refill_per_s,
+                "cache_entries": self.config.cache_entries,
+            },
+        }
+
+
+def _in_loop() -> bool:
+    """True when constructed inside a running event loop (the daemon
+    path); tests may construct the service before a loop exists and
+    start() creates the Event then."""
+    try:
+        asyncio.get_running_loop()
+        return True
+    except RuntimeError:
+        return False

@@ -143,7 +143,18 @@ class LinkStatusEnum(enum.IntEnum):
 # ---------------------------------------------------------------------------
 
 
+#: exact-type fast path for the overwhelmingly common leaf values; an
+#: IntEnum is an int subclass so `type(v) is int` stays correct for it
+#: only via the explicit enum branch below (exact-type check excludes it)
+_WIRE_PRIMITIVES = frozenset((str, int, float, bool, bytes, type(None)))
+
+
 def _to_wire_value(v: Any) -> Any:
+    # serialization runs per route per RPC: at serving-plane rates the
+    # generic dataclass walk below is the ctrl plane's hottest loop, and
+    # nearly every value is a primitive — test its exact type first
+    if type(v) in _WIRE_PRIMITIVES:
+        return v
     if dataclasses.is_dataclass(v) and not isinstance(v, type):
         return v.to_wire()  # type: ignore[union-attr]
     if isinstance(v, enum.Enum):

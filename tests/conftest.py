@@ -33,6 +33,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection test (openr_tpu.chaos)"
     )
+    config.addinivalue_line(
+        "markers", "serving: query-serving-plane test (openr_tpu.serving)"
+    )
 
 
 @pytest.fixture

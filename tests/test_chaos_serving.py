@@ -1,0 +1,217 @@
+"""Chaos × serving: the query plane under faults.
+
+Two acceptance properties (ISSUE 4):
+
+* under a TPU outage (chaos ``tpu_fail``) the micro-batcher degrades to
+  the scalar/native compute paths AND the bounded queue sheds instead of
+  deadlocking when the bound is hit — every submitted future resolves
+  (answer or shed error) in bounded virtual time;
+* under partition/heal, results cached under generations from before the
+  partition are NEVER served after it: the LSDB change bumps the
+  generation, which both purges the cache eagerly and makes the old keys
+  unmatchable.
+"""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import ring_edges
+from openr_tpu.serving import ServingShedError
+
+from tests.test_serving import build_decision, make_serving, norm_routes
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serving]
+
+CONVERGE_S = 12.0
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        pending = asyncio.all_tasks(loop)
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        loop.close()
+
+
+def test_tpu_outage_degrades_to_scalar_and_sheds_without_deadlock():
+    """tpu_fail during a query storm: the batcher keeps answering on the
+    scalar/native paths, the queue bound sheds the overflow, and nothing
+    wedges — every future is resolved when virtual time stops."""
+
+    async def main():
+        clock = SimClock()
+        d, edges = build_decision(clock)
+        sv = make_serving(
+            clock, d,
+            max_queue_depth=4,
+            max_batch=4,
+            max_wait_ms=5,
+            shed_policy="shed_oldest",
+        )
+        sv.start()
+        # the chaos tpu_fail fault flips exactly this flag
+        d.backend.inject_device_failure(True)
+        assert not d.device_available()
+
+        pairs = [(a, b) for a, b, _m in edges][:12]
+        tasks = [
+            asyncio.ensure_future(
+                sv.submit("whatif", {"link_failures": [p]})
+            )
+            for p in pairs
+        ]
+        await clock.run_for(1.0)
+
+        assert all(t.done() for t in tasks), "serving deadlocked"
+        answered, shed = [], 0
+        for t in tasks:
+            exc = t.exception()
+            if exc is None:
+                answered.append(t.result())
+            else:
+                assert isinstance(exc, ServingShedError), exc
+                shed += 1
+        # the queue bound actually bit...
+        assert shed >= 1 and sv.num_shed == shed
+        # ...and everything that was answered came from a degraded
+        # (non-device) engine
+        assert answered, "at least the admitted window must be answered"
+        for r in answered:
+            assert r["eligible"]
+            assert r["engine"] in ("native", "generic-solver"), r["engine"]
+        assert sv.num_degraded >= 1
+        assert d.counters.get("serving.degraded_batches") >= 1
+
+        # outage heals: the device engine serves again (fresh queries —
+        # the generation is unchanged, but these pairs were shed, so
+        # they were never cached)
+        d.backend.inject_device_failure(False)
+        shed_pairs = [
+            p for p, t in zip(pairs, tasks) if t.exception() is not None
+        ]
+        tasks2 = [
+            asyncio.ensure_future(
+                sv.submit("whatif", {"link_failures": [p]})
+            )
+            for p in shed_pairs[:4]
+        ]
+        await clock.run_for(1.0)
+        for t in tasks2:
+            assert t.result()["engine"] == "device"
+
+    run(main())
+
+
+def test_route_db_queries_survive_outage_via_scalar_fallback():
+    """route_db queries during an outage answer through the per-vantage
+    scalar solver (no fleet/device solve) and still return exact
+    routes."""
+
+    async def main():
+        clock = SimClock()
+        d, _edges = build_decision(clock)
+        sv = make_serving(clock, d)
+        sv.start()
+        d.backend.inject_device_failure(True)
+        t = asyncio.ensure_future(sv.submit("route_db", {"node": "node6"}))
+        await clock.run_for(0.5)
+        got = t.result()
+        from openr_tpu.decision.spf_solver import SpfSolver
+
+        oracle = (
+            SpfSolver("node6")
+            .build_route_db(d.area_link_states, d.prefix_state)
+            .to_route_database("node6")
+            .to_wire()
+        )
+        assert norm_routes(got) == norm_routes(oracle)
+        # the fleet (device) engine was never built during the outage
+        assert d._fleet_engine is None or (
+            d._fleet_engine.num_batched_solves == 0
+        )
+
+    run(main())
+
+
+def test_partition_heal_never_serves_pre_partition_generation():
+    """EmulatedNetwork ring: a result cached before a partition must
+    never be returned after it — the generation bump purges it and makes
+    its key unmatchable; post-heal queries run against the healed
+    generation."""
+
+    async def main():
+        clock = SimClock()
+        net = EmulatedNetwork(clock)
+        net.build(ring_edges(4))
+        net.start()
+        await clock.run_for(CONVERGE_S)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+
+        n0 = net.nodes["node0"]
+        sv = n0.serving
+
+        async def query():
+            task = asyncio.ensure_future(
+                sv.submit("route_db", {"node": "node2"})
+            )
+            await clock.run_for(1.0)
+            return task.result()
+
+        gen_pre = n0.decision.generation_key()
+        pre = await query()
+        assert pre["unicast_routes"], "converged ring must route"
+        assert len(sv.cache) == 1
+        # cached: an immediate repeat is a hit, no new batch
+        batches_before = sv.num_batches
+        hit = await query()
+        assert hit == pre and sv.num_batches == batches_before
+
+        # partition node0 away; hold timers expire -> its LSDB changes
+        net.partition(("node0",), ("node1", "node2", "node3"))
+        await clock.run_for(8.0)
+        gen_mid = n0.decision.generation_key()
+        assert gen_mid != gen_pre, "partition must bump the generation"
+        # the rebuild path purged the pre-partition entries eagerly
+        assert n0.counters.get("serving.cache.generation_invalidations") > 0
+        assert len(sv.cache) == 0
+
+        mid = await query()
+        assert mid != pre, (
+            "post-partition answer must reflect the partitioned LSDB, "
+            "not the pre-partition cache"
+        )
+
+        net.heal_partition(("node0",), ("node1", "node2", "node3"))
+        await clock.run_for(25.0)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        gen_post = n0.decision.generation_key()
+        assert gen_post not in (gen_pre, gen_mid)
+        post = await query()
+        # healed topology computes the same CONTENT as before the
+        # partition, but through a fresh solve under the new generation
+        # (never the old cache entry: its generation can no longer match)
+        assert norm_routes(post) == norm_routes(pre)
+        for (gen, _q) in list(sv.cache._entries):
+            assert gen == gen_post
+
+        # the whole-emulation serving view stayed healthy through the
+        # partition: queries were answered, none shed
+        stats = net.serving_stats()
+        assert stats["node0"]["counters"]["serving.requests"] >= 4
+        assert stats["node0"]["counters"].get("serving.shed", 0) == 0
+
+        await net.stop()
+
+    run(main())

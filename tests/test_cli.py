@@ -191,6 +191,37 @@ def test_cli_monitor_histograms(live_node):
     )
 
 
+def test_cli_serving_stats_and_queries(live_node):
+    """breeze serving stats / routes / whatif against a live node: the
+    serving plane answers through the ctrl server, and its counters
+    reflect the served queries."""
+    # a served query first, so stats have something to show
+    db = json.loads(_run(live_node, "serving", "routes", "node1"))
+    assert db["this_node_name"] == "node1"
+    assert db["unicast_routes"], "node1 must compute routes"
+    wf = json.loads(_run(live_node, "serving", "whatif", "node0:node1"))
+    assert wf["eligible"] and len(wf["failures"]) == 1
+    assert wf["failures"][0]["link"] == ["node0", "node1"]
+
+    stats = json.loads(_run(live_node, "serving", "stats", "--json"))
+    assert stats["enabled"] and stats["node"] == "node0"
+    assert stats["counters"]["serving.requests"] >= 2
+    assert stats["counters"]["serving.num_batches"] >= 2
+    assert stats["config"]["max_batch"] == 64
+    assert "serving.queue_wait_ms" in stats["histograms"]
+    # repeated query = cache hit, visible in the stats surface
+    again = json.loads(_run(live_node, "serving", "routes", "node1"))
+    assert again == db
+    stats2 = json.loads(_run(live_node, "serving", "stats", "--json"))
+    assert (
+        stats2["counters"]["serving.cache.hits"]
+        > stats["counters"].get("serving.cache.hits", 0)
+    )
+    # human-readable table renders knobs + counters
+    table = _run(live_node, "serving", "stats")
+    assert "serving on node0" in table and "max_batch=64" in table
+
+
 def test_cli_kvstore_snoop_snapshot(live_node):
     out = _run(
         live_node,

@@ -395,6 +395,34 @@ def test_repo_is_clean_under_check():
     assert orlint_main(["--check"]) == 0
 
 
+def test_serving_actor_lands_in_isolation_registry():
+    """The serving plane's QueryService subclasses Actor, so the
+    project-wide actor-isolation registry must collect it — cross-actor
+    writes / _private reads against it are findings from day one, with
+    zero new baseline entries (the gate above stays empty-baselined)."""
+    from openr_tpu.analysis.engine import load_modules
+    from openr_tpu.analysis.passes.actor_isolation import (
+        _CTX_ACTORS,
+        ActorIsolationPass,
+    )
+
+    mods = load_modules([repo_root() / "openr_tpu"])
+    p = ActorIsolationPass()
+    ctx: dict = {}
+    for m in mods:
+        p.collect(m, ctx)
+    p.finalize(ctx)
+    actors = ctx[_CTX_ACTORS]
+    assert "QueryService" in actors, "serving actor missing from registry"
+    # sanity: the registry still sees the long-standing actors too
+    assert {"Decision", "KvStore", "Monitor"} <= actors
+    # and the serving tree is protocol-plane (scanned, not exempted)
+    assert any(
+        m.rel.startswith("openr_tpu/serving/") and m.is_protocol_plane()
+        for m in mods
+    )
+
+
 def test_check_fails_on_violation(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(FIXTURES["clock-sleep"][0])

@@ -1,0 +1,44 @@
+"""Tier-1 smoke: the checked-in BENCH_SERVING artifact obeys the schema
+the bench emits (shared validator — bench.validate_serving_bench), and
+holds the acceptance floor: batched serving throughput >= 3x the
+unbatched path at 64 concurrent clients.
+
+The validator lives in bench.py so the emitter and this gate can never
+drift apart; regenerate the artifact with `python bench.py --serving`.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+import bench
+
+pytestmark = pytest.mark.serving
+
+ARTIFACT = pathlib.Path(__file__).resolve().parent.parent / (
+    "BENCH_SERVING_r01.json"
+)
+
+
+def test_artifact_exists_and_matches_schema():
+    doc = json.loads(ARTIFACT.read_text())
+    bench.validate_serving_bench(doc)
+
+
+def test_batched_at_64_clients_meets_3x_floor():
+    doc = json.loads(ARTIFACT.read_text())
+    r64 = next(
+        r for r in doc["detail"]["rounds"] if r["clients"] == 64
+    )
+    assert doc["vs_baseline"] == r64["speedup_steady"]
+    assert doc["vs_baseline"] >= 3.0, (
+        "serving acceptance: batched >= 3x unbatched at 64 clients"
+    )
+
+
+def test_validator_rejects_malformed_doc():
+    doc = json.loads(ARTIFACT.read_text())
+    doc["detail"]["rounds"][0]["steady"]["qps"] = 0
+    with pytest.raises(AssertionError):
+        bench.validate_serving_bench(doc)
