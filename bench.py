@@ -199,6 +199,261 @@ def convergence_main() -> None:
     )
 
 
+RESILIENCE_SAMPLE_EVERY = 8
+RESILIENCE_BUILDS_PER_SIDE = 64
+
+
+def validate_resilience_bench(doc: dict) -> None:
+    """Schema contract for BENCH_RESILIENCE_r*.json — shared by the
+    bench emitter and the tier-1 smoke test so the artifact can never
+    drift from what the test validates.  The headline value is the
+    shadow-verification overhead on the rebuild p50, and the acceptance
+    bound (ISSUE 5) is <= 5%."""
+    assert doc["metric"] == "resilience_shadow_overhead_pct_rebuild_p50"
+    assert doc["unit"] == "pct"
+    assert isinstance(doc["value"], (int, float))
+    assert doc["value"] <= 5.0, "shadow overhead must stay <= 5% on p50"
+    d = doc["detail"]
+    assert d["rebuild_p50_ms_shadow_off"] > 0
+    assert d["rebuild_p50_ms_shadow_on"] > 0
+    assert d["rebuild_p95_ms_shadow_on"] >= d["rebuild_p50_ms_shadow_on"]
+    assert d["builds_per_side"] >= 32
+    assert d["shadow_sample_every"] >= 2
+    assert d["shadow_checks_during_run"] >= 1
+    sc = d["sdc_scenario"]
+    assert sc["detected"] is True
+    assert sc["recovered"] is True
+    assert 1 <= sc["rebuilds_to_detect"] <= d["shadow_sample_every"]
+    assert sc["shadow_mismatches"] >= 1
+    assert sc["probes"] >= 1
+    assert sc["deterministic_replay"] is True
+    for key in ("world", "env", "mode"):
+        assert key in d, key
+
+
+def _resilience_sdc_scenario():
+    """Seeded 9-node emulation with a ``tpu_corrupt`` fault: corruption
+    detected within one shadow-sample interval, device quarantined,
+    routes served from the scalar engine (InvariantChecker green
+    throughout), device restored by a half-open probe after heal.  Run
+    twice from one seed; byte-identical counter dumps prove the replay
+    contract.  Returns the scenario detail dict."""
+    import asyncio
+
+    from openr_tpu.chaos import ChaosController, FaultPlan, InvariantChecker
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import ResilienceConfig
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import grid_edges
+    from openr_tpu.types import PrefixEntry
+
+    sample_every = 2
+    victim = "node4"
+
+    def overrides(cfg):
+        cfg.watchdog_config.interval_s = 1.0
+        cfg.tpu_compute_config.min_device_prefixes = 0  # always device
+        cfg.resilience_config = ResilienceConfig(
+            shadow_sample_every=sample_every,
+            failure_threshold=2,
+            probe_backoff_initial_s=0.5,
+            probe_backoff_max_s=4.0,
+            jitter_pct=0.1,
+            seed=7,
+        )
+
+    async def one_run():
+        clock = SimClock()
+        net = EmulatedNetwork(
+            clock, use_tpu_backend=True, config_overrides=overrides
+        )
+        net.build(grid_edges(3))
+        net.start()
+        checker = InvariantChecker(net)
+        plan = FaultPlan().tpu_corrupt(victim, at=2.0, duration=10.0)
+        controller = ChaosController(net, plan, seed=7)
+        await clock.run_for(18.0)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        gov = net.nodes[victim].decision.backend.governor
+        controller.start()
+        await clock.run_for(3.0)  # corruption live at t=+2
+        rebuilds_to_detect = 0
+        for i in range(sample_every):
+            net.nodes["node0"].advertise_prefixes(
+                [PrefixEntry(f"10.99.{i}.0/24")]
+            )
+            await clock.run_for(1.5)
+            checker.sample()
+            if not gov.quarantined:
+                continue
+            rebuilds_to_detect = i + 1
+            break
+        detected = gov.quarantined
+        checker.check_no_blackholes()  # scalar engine serving, no holes
+        await clock.run_for(8.0)  # heal fires at t=+12
+        net.nodes["node0"].advertise_prefixes([PrefixEntry("10.99.8.0/24")])
+        await clock.run_for(4.0)
+        recovered = not gov.quarantined
+        await clock.run_for(8.0)
+        checker.check_all()
+        detail = {
+            "detected": detected,
+            "rebuilds_to_detect": rebuilds_to_detect,
+            "recovered": recovered,
+            "shadow_mismatches": gov.num_shadow_mismatches,
+            "probes": gov.breaker.num_probes,
+            "restores": gov.num_restores,
+        }
+        dumps = (
+            controller.counter_dump(),
+            net.nodes[victim].counters.dump("resilience."),
+        )
+        await controller.stop()
+        await net.stop()
+        return detail, dumps
+
+    def run(coro):
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(coro)
+        finally:
+            loop.close()
+
+    detail_a, dumps_a = run(one_run())
+    _detail_b, dumps_b = run(one_run())
+    detail_a["deterministic_replay"] = dumps_a == dumps_b
+    detail_a["seed"] = 7
+    detail_a["shadow_sample_every"] = sample_every
+    return detail_a
+
+
+def resilience_main() -> None:
+    """Resilience benchmark (the BENCH_RESILIENCE_r* artifact).
+
+    Part A — shadow-verification overhead on the rebuild p50: one
+    256-node LSDB, prefix-churn rebuild ticks through the SAME TpuBackend
+    incremental path the daemon runs, measured with the governor's
+    sampling off vs every-8th-build.  Sampled builds pay a full scalar
+    solve, but they are 1-in-8 tail events, so the p50 (the acceptance
+    metric: <= 5%) is expected ~flat — the artifact records the honest
+    p50 AND p95 so the tail cost is visible, not hidden.
+
+    Part B — the seeded tpu_corrupt emulation scenario (detection within
+    one sample interval, scalar serving with invariants green, probed
+    recovery, deterministic replay).  Emits one JSON line."""
+    from openr_tpu.ops.platform_env import (
+        enable_persistent_compile_cache,
+        fallback_to_cpu_if_unreachable,
+        honor_cpu_platform_request,
+    )
+
+    honor_cpu_platform_request()
+    fallback_to_cpu_if_unreachable()
+    enable_persistent_compile_cache()
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import ResilienceConfig
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.emulation.topology import (
+        build_adj_dbs,
+        random_connected_edges,
+    )
+    from openr_tpu.types import PrefixEntry
+
+    n_nodes, n_links, seed = 256, 512, 11
+    edges = random_connected_edges(n_nodes, n_links, seed=seed)
+    ls = LinkState("0", "node0")
+    for db in build_adj_dbs(edges).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(n_nodes):
+        ps.update_prefix(
+            f"node{i}", "0", PrefixEntry(f"10.{i // 256}.{i % 256}.0/24")
+        )
+    als = {"0": ls}
+    churn_prefix = "10.200.0.0/24"
+
+    def measure(sample_every: int):
+        backend = TpuBackend(
+            SpfSolver("node0"),
+            clock=SimClock(),
+            resilience=ResilienceConfig(
+                shadow_sample_every=sample_every, jitter_pct=0.0
+            ),
+        )
+        backend.build_route_db(als, ps)  # warm-up: compile + first build
+        for i in range(2):  # warm the incremental row-selection bucket too
+            if i % 2 == 0:
+                ps.update_prefix("node3", "0", PrefixEntry(churn_prefix))
+            else:
+                ps.delete_prefix("node3", "0", churn_prefix)
+            backend.build_route_db(als, ps, changed_prefixes={churn_prefix})
+        lat = []
+        for i in range(RESILIENCE_BUILDS_PER_SIDE):
+            # alternate advertise/withdraw of one prefix: a realistic
+            # prefix-churn rebuild tick (incremental device path)
+            if i % 2 == 0:
+                ps.update_prefix("node3", "0", PrefixEntry(churn_prefix))
+            else:
+                ps.delete_prefix("node3", "0", churn_prefix)
+            t0 = time.perf_counter()
+            backend.build_route_db(
+                als, ps, changed_prefixes={churn_prefix}
+            )
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        # leave the churn prefix withdrawn for the next side
+        ps.delete_prefix("node3", "0", churn_prefix)
+        lat.sort()
+        return lat, backend.governor.num_shadow_checks
+
+    lat_off, _ = measure(0)
+    lat_on, shadow_checks = measure(RESILIENCE_SAMPLE_EVERY)
+
+    def pct(lat, q):
+        return lat[min(len(lat) - 1, int(len(lat) * q))]
+
+    p50_off, p50_on = pct(lat_off, 0.50), pct(lat_on, 0.50)
+    overhead_pct = (p50_on - p50_off) / p50_off * 100.0
+
+    sdc = _resilience_sdc_scenario()
+
+    doc = {
+        "metric": "resilience_shadow_overhead_pct_rebuild_p50",
+        "value": round(overhead_pct, 2),
+        "unit": "pct",
+        "detail": {
+            "rebuild_p50_ms_shadow_off": round(p50_off, 3),
+            "rebuild_p50_ms_shadow_on": round(p50_on, 3),
+            "rebuild_p95_ms_shadow_off": round(pct(lat_off, 0.95), 3),
+            "rebuild_p95_ms_shadow_on": round(pct(lat_on, 0.95), 3),
+            "rebuild_max_ms_shadow_on": round(lat_on[-1], 3),
+            "builds_per_side": RESILIENCE_BUILDS_PER_SIDE,
+            "shadow_sample_every": RESILIENCE_SAMPLE_EVERY,
+            "shadow_checks_during_run": shadow_checks,
+            "sdc_scenario": sdc,
+            "world": {
+                "nodes": n_nodes,
+                "links": n_links,
+                "prefixes": n_nodes,
+                "topology": "random_connected",
+                "seed": seed,
+            },
+            "mode": (
+                "part A: direct TpuBackend incremental rebuild ticks "
+                "(wall clock); part B: 9-node grid SimClock emulation "
+                "with chaos tpu_corrupt"
+            ),
+            "env": env_stamp(),
+        },
+    }
+    validate_resilience_bench(doc)
+    print(json.dumps(doc))
+
+
 SERVING_CONCURRENCY = (1, 8, 64, 512)
 
 
@@ -908,4 +1163,6 @@ if __name__ == "__main__":
         sys.exit(convergence_main())
     if "--serving" in sys.argv[1:]:
         sys.exit(serving_main())
+    if "--resilience" in sys.argv[1:]:
+        sys.exit(resilience_main())
     sys.exit(main())

@@ -13,6 +13,7 @@ from openr_tpu.analysis.passes.async_blocking import AsyncBlockingPass
 from openr_tpu.analysis.passes.base import Pass
 from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
 from openr_tpu.analysis.passes.jax_hygiene import JaxHygienePass
+from openr_tpu.analysis.passes.resilience_latch import ResilienceLatchPass
 
 
 def make_passes():
@@ -21,6 +22,7 @@ def make_passes():
         ActorIsolationPass(),
         JaxHygienePass(),
         AsyncBlockingPass(),
+        ResilienceLatchPass(),
     ]
 
 

@@ -1,0 +1,97 @@
+"""Resilience-latch discipline — the device-health latch has ONE owner.
+
+``TpuBackend.device_failed`` used to be a free-for-all boolean: chaos
+flipped it, operators flipped it, and nothing guaranteed the flip was
+probed, counted, or even noticed by the serving plane.  PR 5 made the
+:class:`~openr_tpu.resilience.governor.BackendHealthGovernor` the single
+health authority: it is the only component allowed to write the latch
+(quarantine on shadow-verification mismatch / repeated dispatch failure,
+restore only after a passing probe), and everything else must go through
+its API (``force_quarantine`` / ``request_probe`` / ``force_restore``)
+so transitions are counted under ``resilience.*`` and recoveries are
+verified.
+
+Rule:
+
+* ``resilience-latch`` — assignment to a ``device_failed`` attribute, or
+  a call to ``inject_device_failure`` / ``inject_silent_corruption``,
+  anywhere outside the allowed owners: the backend itself
+  (``decision/backend.py``), the governor tree (``resilience/``), and
+  chaos fault handlers (``chaos/``).  Reads are fine —
+  ``Decision.device_available()`` exists precisely to read the latch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+#: the latch's legitimate owners (writes allowed)
+ALLOWED_PREFIXES = (
+    "openr_tpu/decision/backend.py",
+    "openr_tpu/resilience/",
+    "openr_tpu/chaos/",
+)
+
+_LATCH_ATTRS = {"device_failed"}
+_LATCH_CALLS = {"inject_device_failure", "inject_silent_corruption"}
+
+
+class ResilienceLatchPass(Pass):
+    name = "resilience-latch"
+    rules = {
+        "resilience-latch": (
+            "device-health latch written outside backend/governor/chaos "
+            "(route through BackendHealthGovernor so the transition is "
+            "counted and recovery is probed)"
+        ),
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if mod.rel.startswith(ALLOWED_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and t.attr in _LATCH_ATTRS
+                    ):
+                        out.append(
+                            mod.finding(
+                                "resilience-latch",
+                                node,
+                                f"direct write to `.{t.attr}` bypasses the "
+                                "BackendHealthGovernor; use "
+                                "force_quarantine/request_probe/"
+                                "force_restore so the transition is "
+                                "counted and recovery is probed",
+                            )
+                        )
+            elif isinstance(node, ast.Call):
+                f = node.func
+                name = (
+                    f.attr
+                    if isinstance(f, ast.Attribute)
+                    else (f.id if isinstance(f, ast.Name) else "")
+                )
+                if name in _LATCH_CALLS:
+                    out.append(
+                        mod.finding(
+                            "resilience-latch",
+                            node,
+                            f"`{name}(..)` outside backend/governor/chaos "
+                            "bypasses the health governor; route the "
+                            "fault through its API instead",
+                        )
+                    )
+        return out

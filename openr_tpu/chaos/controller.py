@@ -105,15 +105,43 @@ class ChaosController(Actor):
         if agent is not None:
             agent.fail = inject
 
-    def _tpu_fail(self, inject: bool, node: str) -> None:
+    def _device_backend(self, node: str):
         n = self.net.nodes.get(node)
-        backend = getattr(n.decision, "backend", None) if n is not None else None
-        if backend is not None and hasattr(backend, "inject_device_failure"):
+        return getattr(n.decision, "backend", None) if n is not None else None
+
+    def _tpu_fail(self, inject: bool, node: str) -> None:
+        backend = self._device_backend(node)
+        governor = getattr(backend, "governor", None)
+        if governor is not None:
+            # route the latch through the health governor: the heal is
+            # PROBED (the next build runs a shadow-verified probe solve
+            # before the device is trusted again), not flipped blind
+            if inject:
+                governor.force_quarantine(reason="chaos")
+            else:
+                governor.request_probe(reason="chaos_heal")
+        elif backend is not None and hasattr(backend, "inject_device_failure"):
             backend.inject_device_failure(inject)
         else:
             # scalar backend has no device to fail; record the no-op so a
             # seeded dump still reflects the scheduled fault
             self.counters.bump("chaos.tpu_fail.noop")
+
+    def _tpu_corrupt(self, inject: bool, node: str) -> None:
+        backend = self._device_backend(node)
+        if backend is not None and hasattr(backend, "inject_silent_corruption"):
+            backend.inject_silent_corruption(inject)
+            if not inject:
+                # the kernel stopped lying; if shadow verification had
+                # quarantined the device meanwhile, make the probe due
+                # now so recovery doesn't wait out the jittered hold
+                governor = getattr(backend, "governor", None)
+                if governor is not None:
+                    governor.request_probe(reason="chaos_heal")
+        else:
+            # scalar backend computes on the oracle itself — nothing to
+            # corrupt; record the no-op for the seeded dump
+            self.counters.bump("chaos.tpu_corrupt.noop")
 
     def _actor_kill(self, inject: bool, node: str, module: str) -> None:
         n = self.net.nodes.get(node)

@@ -17,6 +17,9 @@ Fault kinds (dispatched by :class:`openr_tpu.chaos.controller.ChaosController`):
   ``kv_rpc_latency(src, dst, extra_s)``  added peer-RPC latency src->dst
   ``fib_burst(node)``            FibAgent raises on every call
   ``tpu_fail(node)``             device backend fails -> scalar fallback
+  ``tpu_corrupt(node)``          device kernel outputs silently WRONG
+                                 (no exception) -> shadow verification
+                                 must detect and quarantine
   ``actor_kill(node, module)``   crash one module fiber (watchdog restarts)
 """
 
@@ -35,6 +38,7 @@ FAULT_KINDS = (
     "kv_rpc_latency",
     "fib_burst",
     "tpu_fail",
+    "tpu_corrupt",
     "actor_kill",
 )
 
@@ -144,6 +148,13 @@ class FaultPlan:
         self.faults.append(_f("tpu_fail", at, duration, node=node))
         return self
 
+    def tpu_corrupt(self, node: str, at: float, duration: float) -> "FaultPlan":
+        """Silent data corruption: the device kernel keeps answering but
+        its outputs are wrong-but-plausible.  Nothing raises — only the
+        governor's shadow verification can catch it."""
+        self.faults.append(_f("tpu_corrupt", at, duration, node=node))
+        return self
+
     def actor_kill(self, node: str, module: str, at: float) -> "FaultPlan":
         if module not in KILLABLE_MODULES:
             raise ValueError(
@@ -198,6 +209,7 @@ class FaultPlan:
             "kv_rpc_latency",
             "fib_burst",
             "tpu_fail",
+            "tpu_corrupt",
         ]
         if allow_kills:
             kinds.append("actor_kill")
@@ -225,6 +237,8 @@ class FaultPlan:
                 plan.fib_burst(rng.choice(nodes), at, duration)
             elif kind == "tpu_fail":
                 plan.tpu_fail(rng.choice(nodes), at, duration)
+            elif kind == "tpu_corrupt":
+                plan.tpu_corrupt(rng.choice(nodes), at, duration)
             else:
                 plan.actor_kill(
                     rng.choice(nodes), rng.choice(KILLABLE_MODULES), at

@@ -623,6 +623,82 @@ def serving_fleet_summary(ctx: click.Context, client_id: str) -> None:
     _print(_call(ctx, "serving_fleet_summary", client_id=client_id))
 
 
+# -------------------------------------------------------------- resilience
+
+
+@breeze.group()
+def resilience() -> None:
+    """Compute-plane health: circuit breakers, shadow verification,
+    quarantine/probe controls (openr_tpu.resilience; docs/Robustness.md)."""
+
+
+@resilience.command("status")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def resilience_status(ctx: click.Context, json_out: bool) -> None:
+    """Breaker + governor state for every protected edge (device
+    backend, FIB agent, KvStore peer sessions)."""
+    status = _call(ctx, "get_resilience_status")
+    if json_out:
+        _print(status)
+        return
+    click.echo(f"resilience on {status['node']}")
+    dev = status.get("device_backend", {})
+    if not dev.get("present"):
+        click.echo("  device backend: none (scalar deployment)")
+    else:
+        state = "QUARANTINED" if dev.get("quarantined") else "healthy"
+        click.echo(
+            f"  device backend: {state}"
+            + (
+                f" (reason: {dev['quarantine_reason']})"
+                if dev.get("quarantined") and dev.get("quarantine_reason")
+                else ""
+            )
+        )
+        click.echo(
+            f"    breaker={dev['breaker']['state']}"
+            f" shadow_checks={dev['shadow_checks']}"
+            f" mismatches={dev['shadow_mismatches']}"
+            f" quarantines={dev['quarantines']}"
+            f" restores={dev['restores']}"
+            f" dispatch_failures={dev['dispatch_failures']}"
+        )
+        if dev.get("last_probe"):
+            click.echo(f"    last probe: {dev['last_probe']}")
+    fib_b = status.get("fib_agent", {})
+    if fib_b:
+        click.echo(
+            f"  fib agent: breaker={fib_b['state']}"
+            f" opens={fib_b['opens']} probes={fib_b['probes']}"
+            f" short_circuits={fib_b['short_circuits']}"
+        )
+    kv = status.get("kv_transport")
+    if kv is not None:
+        for peer, b in sorted(kv.items()):
+            click.echo(
+                f"  kv peer {peer}: breaker={b['state']}"
+                f" opens={b['opens']} probes={b['probes']}"
+            )
+
+
+@resilience.command("force-quarantine")
+@click.option("--reason", default="breeze", help="recorded quarantine reason")
+@click.pass_context
+def resilience_force_quarantine(ctx: click.Context, reason: str) -> None:
+    """Drain the accelerator NOW: every compute path degrades to the
+    scalar engines until a probe passes (`force-probe`)."""
+    _print(_call(ctx, "force_quarantine", reason=reason))
+
+
+@resilience.command("force-probe")
+@click.pass_context
+def resilience_force_probe(ctx: click.Context) -> None:
+    """Run one shadow-verified probe solve right now; a pass restores a
+    quarantined device."""
+    _print(_call(ctx, "force_probe"))
+
+
 # ----------------------------------------------------------------- kvstore
 
 

@@ -171,6 +171,33 @@ class ServingConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Resilient-compute-plane knobs (openr_tpu.resilience, net-new vs
+    the reference): the BackendHealthGovernor's shadow-verification
+    sampling and the shared CircuitBreaker parameters.  See
+    docs/Robustness.md §"Resilient compute plane"."""
+
+    enabled: bool = True
+    #: shadow-verify 1 in N device builds against the scalar SPF oracle
+    #: (the first device build is always verified; 0 disables sampling —
+    #: probes still verify).  Lower = faster SDC detection, more scalar
+    #: recompute; the amortized p50 rebuild overhead stays ~0 because
+    #: sampled builds are the tail (BENCH_RESILIENCE).
+    shadow_sample_every: int = 8
+    #: consecutive device dispatch failures that open the breaker
+    failure_threshold: int = 3
+    #: open-state hold before the first half-open probe (doubles per
+    #: failed probe up to the max), jittered so a fleet quarantined by
+    #: one shared outage does not re-probe in lockstep
+    probe_backoff_initial_s: float = 1.0
+    probe_backoff_max_s: float = 30.0
+    #: +/- fraction of jitter applied to every hold draw (0 disables)
+    jitter_pct: float = 0.1
+    #: seeds the deterministic jitter RNG (chaos reproducibility)
+    seed: int = 0
+
+
+@dataclass
 class OriginatedPrefix:
     """Config-originated prefix w/ optional aggregation
     (OpenrConfig.thrift:345-441)."""
@@ -255,6 +282,7 @@ class OpenrConfig:
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
     tracing_config: TracingConfig = field(default_factory=TracingConfig)
     serving_config: ServingConfig = field(default_factory=ServingConfig)
+    resilience_config: ResilienceConfig = field(default_factory=ResilienceConfig)
     originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
     segment_routing_config: SegmentRoutingConfig = field(
         default_factory=SegmentRoutingConfig
@@ -318,6 +346,19 @@ class OpenrConfig:
             raise ValueError(
                 "serving needs max_batch >= 1, max_queue_depth >= 1, "
                 "max_wait_ms >= 0"
+            )
+        r = self.resilience_config
+        if r.shadow_sample_every < 0 or r.failure_threshold < 1:
+            raise ValueError(
+                "resilience needs shadow_sample_every >= 0 and "
+                "failure_threshold >= 1"
+            )
+        if not (
+            0 < r.probe_backoff_initial_s <= r.probe_backoff_max_s
+        ) or not (0.0 <= r.jitter_pct < 1.0):
+            raise ValueError(
+                "resilience needs 0 < probe_backoff_initial_s <= "
+                "probe_backoff_max_s and 0 <= jitter_pct < 1"
             )
         from openr_tpu.lsdb_codec import WIRE_FORMATS
 

@@ -513,6 +513,46 @@ class OpenrCtrlHandler:
             "fleet_summary", {}, client_id=client_id
         )
 
+    # ------------------------------------------------------------ resilience
+    # (openr_tpu.resilience — breaker/governor health of every
+    # external-dependency edge; net-new vs the reference)
+
+    def get_resilience_status(self) -> dict:
+        """Breaker + governor state for every protected edge: device
+        backend (quarantine/shadow-verification tallies), FIB agent,
+        and KvStore peer sessions (`breeze resilience status`)."""
+        from openr_tpu.resilience import node_resilience_status
+
+        return node_resilience_status(self.node)
+
+    def force_quarantine(self, reason: str = "operator") -> dict:
+        """Operator drain of a sick accelerator: quarantine the device
+        backend NOW — route builds, serving, and what-if all degrade to
+        the scalar engines until `force_probe` (verified) or a config
+        restart.  Raises on scalar-only deployments."""
+        gov = getattr(self.node.decision.backend, "governor", None)
+        if gov is None:
+            raise ValueError(
+                "no device backend governor on this node (scalar "
+                "deployment, or resilience disabled)"
+            )
+        gov.force_quarantine(reason=f"operator:{reason}" if reason else "operator")
+        return self.get_resilience_status()
+
+    def force_probe(self) -> dict:
+        """Run one shadow-verified probe solve against the live LSDB
+        right now; a pass restores a quarantined device.  Returns the
+        probe outcome plus the refreshed status."""
+        d = self.node.decision
+        gov = getattr(d.backend, "governor", None)
+        if gov is None:
+            raise ValueError(
+                "no device backend governor on this node (scalar "
+                "deployment, or resilience disabled)"
+            )
+        result = gov.probe_now(d.area_link_states, d.prefix_state)
+        return {"probe": result, "status": self.get_resilience_status()}
+
     def get_route_detail_db(self) -> List[dict]:
         """Unicast routes with full selection detail: best entry, area,
         igp cost (getRouteDetailDb / RouteDetailDb)."""

@@ -127,6 +127,16 @@ class DecisionBackend:
         `breeze monitor counters decision.backend.`)."""
         return {}
 
+    def take_full_replace(self) -> bool:
+        """True exactly once after a build whose result must be diffed
+        against the WHOLE previous RouteDb even on an incremental tick.
+        The quarantine swap is the one producer: when shadow
+        verification replaces corrupt device output with the scalar
+        oracle's, every entry programmed since the last verified sample
+        is suspect and a changed-prefix-only diff would leave stale
+        corrupt routes in the FIB."""
+        return False
+
 
 class ScalarBackend(DecisionBackend):
     def __init__(self, solver: SpfSolver) -> None:
@@ -193,6 +203,10 @@ class TpuBackend(DecisionBackend):
         node_buckets=(16, 64, 256, 1024, 4096, 16384),
         cand_buckets=(1, 2, 4, 8, 16, 32, 64),
         min_device_prefixes: Optional[int] = 0,
+        clock=None,
+        counters=None,
+        tracer=None,
+        resilience=None,
     ) -> None:
         self.solver = solver  # scalar fallback + MPLS/static
         # AOT-equivalence with the reference's compiled binary: persist
@@ -222,10 +236,44 @@ class TpuBackend(DecisionBackend):
         #: more candidates than the largest candidate bucket (VERDICT r1
         #: weak #8: the cause must be distinguishable)
         self.num_fallback_cand_overflow = 0
-        #: chaos/operator-injected device outage: every build routes
-        #: through the scalar oracle until the flag clears
+        #: device-outage latch: while set, every build routes through the
+        #: scalar oracle.  With a governor (the default) the ONLY writers
+        #: are the BackendHealthGovernor, chaos, and this class — the
+        #: orlint `resilience-latch` rule enforces that statically
         self.device_failed = False
         self.num_fallback_injected = 0
+        self.num_dispatch_errors = 0
+        #: chaos tpu_corrupt: perturb fetched kernel outputs WITHOUT
+        #: raising — the silent-data-corruption model the governor's
+        #: shadow verification exists to catch
+        self._sdc_inject = False
+        #: health authority (openr_tpu/resilience/governor.py): shadow
+        #: verification + circuit breaker + probed recovery.  `resilience`
+        #: is a config.ResilienceConfig (None = defaults; enabled=False
+        #: = legacy one-way latch, no governor)
+        from openr_tpu.resilience.governor import BackendHealthGovernor
+
+        self.governor = None
+        if resilience is None or resilience.enabled:
+            gov_kwargs = (
+                {}
+                if resilience is None
+                else dict(
+                    shadow_sample_every=resilience.shadow_sample_every,
+                    failure_threshold=resilience.failure_threshold,
+                    probe_backoff_initial_s=resilience.probe_backoff_initial_s,
+                    probe_backoff_max_s=resilience.probe_backoff_max_s,
+                    jitter_pct=resilience.jitter_pct,
+                    seed=resilience.seed,
+                )
+            )
+            self.governor = BackendHealthGovernor(
+                self,
+                clock=clock,
+                counters=counters,
+                tracer=tracer,
+                **gov_kwargs,
+            )
         #: EncodedMultiArea cache keyed by ((area, topology_seq), ...):
         #: most rebuilds are prefix churn on an unchanged graph, and
         #: re-encoding a 4096-node LSDB costs tens of ms of the debounce
@@ -253,6 +301,9 @@ class TpuBackend(DecisionBackend):
         #: previous device-built RouteDb + the enc it was built against
         self._last_db: Optional[DecisionRouteDb] = None
         self._last_enc = None
+        #: one-shot: set when a quarantine swap makes the whole previous
+        #: RouteDb suspect (see DecisionBackend.take_full_replace)
+        self._full_replace = False
 
     def build_route_db(
         self,
@@ -262,9 +313,23 @@ class TpuBackend(DecisionBackend):
         force_full=False,
         cache_result=True,
     ):
-        if self.device_failed:
-            # injected device outage (chaos tpu_fail / operator): the
-            # daemon must keep producing routes — scalar oracle takes over
+        gov = self.governor
+        probe = False
+        if gov is not None:
+            from openr_tpu.resilience.governor import (
+                ADMIT_PROBE,
+                ADMIT_QUARANTINED,
+            )
+
+            mode = gov.admit()
+            if mode == ADMIT_QUARANTINED:
+                # quarantined device (chaos tpu_fail, shadow-verification
+                # mismatch, or repeated dispatch failure): the daemon
+                # must keep producing routes — scalar oracle takes over
+                self.num_fallback_injected += 1
+                return self._scalar_fallback(area_link_states, prefix_state)
+            probe = mode == ADMIT_PROBE
+        elif self.device_failed:
             self.num_fallback_injected += 1
             return self._scalar_fallback(area_link_states, prefix_state)
         # the device kernel implements the enabled best-route-selection
@@ -279,38 +344,97 @@ class TpuBackend(DecisionBackend):
                 RouteComputationRules.PER_AREA_SHORTEST_DISTANCE,
             )
         ):
+            if probe:
+                gov.abort_probe()
             return self._scalar_fallback(area_link_states, prefix_state)
-        if self.min_device_prefixes is None:
-            if not self._device_worth_it(area_link_states, prefix_state):
+        try:
+            if self.min_device_prefixes is None:
+                if not self._device_worth_it(area_link_states, prefix_state):
+                    if probe:
+                        gov.abort_probe()
+                    return self._scalar_fallback(
+                        area_link_states, prefix_state, counter="small"
+                    )
+            elif (
+                self.min_device_prefixes
+                and len(prefix_state.prefixes()) < self.min_device_prefixes
+            ):
+                if probe:
+                    gov.abort_probe()
                 return self._scalar_fallback(
                     area_link_states, prefix_state, counter="small"
                 )
-        elif (
-            self.min_device_prefixes
-            and len(prefix_state.prefixes()) < self.min_device_prefixes
-        ):
-            return self._scalar_fallback(
-                area_link_states, prefix_state, counter="small"
-            )
-        try:
             db = self._build_device(
                 area_link_states, prefix_state, changed_prefixes, force_full
             )
         except ValueError:
-            # e.g. a prefix with more candidates than the largest device
-            # bucket — fall back rather than wedging the rebuild loop
+            # capacity/shape fallback (e.g. a prefix with more candidates
+            # than the largest device bucket): a DATA-scale limit, not a
+            # device-health signal — fall back without scoring the breaker
+            if probe:
+                gov.abort_probe()
             return self._scalar_fallback(area_link_states, prefix_state)
+        except Exception as e:  # noqa: BLE001 - organic dispatch failure
+            if gov is None:
+                raise  # legacy (resilience disabled): crash loud
+            # the failure trips the SAME latch chaos uses: the breaker
+            # counts it, and past the threshold the device is quarantined
+            # instead of being re-paid on every rebuild
+            self.num_dispatch_errors += 1
+            gov.record_dispatch_failure(e)
+            return self._scalar_fallback(area_link_states, prefix_state)
+        if db is None:
+            # vantage not present in any area topology: nothing was
+            # computed, nothing to verify — release an acquired probe
+            if probe:
+                gov.abort_probe()
+            return None
+        if gov is not None:
+            db, from_device = gov.after_device_build(
+                db, area_link_states, prefix_state, probe=probe
+            )
+            if not from_device:
+                # shadow verification replaced a corrupt device result
+                # with the scalar oracle's: every incremental base
+                # derived from device output is untrustworthy, and the
+                # caller must diff this build against its WHOLE previous
+                # RouteDb (corrupt entries from unsampled builds since
+                # the last verified one must be purged, not just the
+                # changed prefixes)
+                self._last_db = None
+                self._table_synced = False
+                self._full_replace = True
+                return db
         if cache_result:
             self._last_db = db
         else:
             self._last_db = None
         return db
 
+    def take_full_replace(self) -> bool:
+        fr, self._full_replace = self._full_replace, False
+        return fr
+
     def inject_device_failure(self, failed: bool) -> None:
         """Force (or clear) the device-outage path: while set, every build
-        is a `_scalar_fallback`.  Used by chaos tpu_fail and exposed for
-        operators draining a sick accelerator."""
+        is a `_scalar_fallback`.  Used by operators draining a sick
+        accelerator; clearing is an immediate FORCE-restore (chaos heals
+        go through `governor.request_probe` instead, so recovery is
+        verified by a probe solve)."""
+        if self.governor is not None:
+            if failed:
+                self.governor.force_quarantine(reason="injected")
+            else:
+                self.governor.force_restore(reason="injected_clear")
+            return
         self.device_failed = failed
+
+    def inject_silent_corruption(self, corrupt: bool) -> None:
+        """Chaos ``tpu_corrupt``: perturb fetched kernel outputs WITHOUT
+        raising — wrong-but-plausible route metrics reach the decode
+        path, modeling accelerator silent data corruption.  Detection is
+        the governor's job (shadow verification), never this flag's."""
+        self._sdc_inject = corrupt
 
     def counter_snapshot(self) -> Dict[str, float]:
         return {
@@ -330,6 +454,10 @@ class TpuBackend(DecisionBackend):
             "decision.backend.num_fallback_injected": float(
                 self.num_fallback_injected
             ),
+            "decision.backend.num_dispatch_errors": float(
+                self.num_dispatch_errors
+            ),
+            "decision.backend.sdc_injected": 1.0 if self._sdc_inject else 0.0,
         }
 
     def _device_worth_it(self, area_link_states, prefix_state) -> bool:
@@ -525,6 +653,8 @@ class TpuBackend(DecisionBackend):
                 use, shortest, lanes, valid = jax.device_get(
                     (use, shortest, lanes, valid)
                 )
+                if self._sdc_inject:
+                    shortest = self._corrupt_metrics(shortest)
                 results.update(
                     self._decode_rows(
                         [(i, table.row_prefix[r]) for i, r in enumerate(rows)],
@@ -570,6 +700,8 @@ class TpuBackend(DecisionBackend):
         use, shortest, lanes, valid = jax.device_get(
             (use, shortest, lanes, valid)
         )
+        if self._sdc_inject:
+            shortest = self._corrupt_metrics(shortest)
 
         # only rows with at least one selection winner can produce routes
         rows_with_winners = np.nonzero(use.any(axis=1))[0]
@@ -602,6 +734,19 @@ class TpuBackend(DecisionBackend):
         if self.solver.enable_node_segment_label:
             self.solver._build_node_label_routes(area_link_states, route_db)
         return route_db
+
+    @staticmethod
+    def _corrupt_metrics(shortest):
+        """The tpu_corrupt perturbation: shift every finite per-area
+        shortest-path metric by a constant.  Plausible (routes stay
+        loop-free and reachable, so FIBs never blackhole) yet provably
+        wrong — exactly the corruption class only a RIB diff against the
+        scalar oracle can catch.  Deterministic: no randomness, so a
+        seeded chaos run replays byte-identically."""
+        out = np.array(shortest, copy=True)
+        finite = np.isfinite(out)
+        out[finite] += 7.0
+        return out
 
     # -- decode ------------------------------------------------------------
 

@@ -511,6 +511,13 @@ class Decision(Actor):
             return
         if self.rib_policy is not None and self.rib_policy.is_active(self.clock):
             self.rib_policy.apply_policy(new_db, self.clock)
+        if self.backend.take_full_replace():
+            # quarantine swap: the backend replaced corrupt device output
+            # with the scalar oracle's FULL db — diff everything so
+            # corrupt entries from unsampled builds are purged from the
+            # FIB, not just this tick's changed prefixes
+            self.counters.bump("decision.quarantine_full_replaces")
+            force_full = True
         if force_full:
             update = self.route_db.calculate_update(new_db)
         else:

@@ -230,6 +230,18 @@ class EmulatedNetwork:
 
         return write_chrome_trace(path, self.all_spans())
 
+    def resilience_status(self) -> Dict[str, dict]:
+        """Per-node resilience view (breaker states, quarantine/shadow
+        tallies) — the whole-emulation `breeze resilience status`, used
+        by chaos runs to assert detection → quarantine → probed
+        recovery actually traversed the state machine."""
+        from openr_tpu.resilience import node_resilience_status
+
+        return {
+            name: node_resilience_status(node)
+            for name, node in sorted(self.nodes.items())
+        }
+
     def serving_stats(self) -> Dict[str, dict]:
         """Per-node serving-plane stats (queue/batch/cache/shed counters
         and knobs) — the whole-emulation view of `breeze serving stats`,

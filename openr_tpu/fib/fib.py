@@ -32,6 +32,7 @@ from openr_tpu.decision.rib import (
     RibUnicastEntry,
 )
 from openr_tpu.messaging.queue import RQueue, ReplicateQueue
+from openr_tpu.resilience import STATE_CLOSED, CircuitBreaker
 from openr_tpu.types import InitializationEvent, MplsRoute, PerfEvents, UnicastRoute
 
 
@@ -153,6 +154,26 @@ class Fib(Actor):
         self._backoff = ExponentialBackoff(
             C.FIB_INITIAL_BACKOFF_S, C.FIB_MAX_BACKOFF_S, clock
         )
+        #: agent-session circuit breaker (openr_tpu.resilience),
+        #: augmenting the raw backoff above: the FIRST agent failure
+        #: opens it, so incremental programming and delayed deletes
+        #: short-circuit to dirty instead of hammering a failing agent
+        #: with per-update RPCs — the retry fiber's full syncs are the
+        #: half-open probes that close it.  Retry CADENCE stays on
+        #: `_backoff` (unchanged semantics); the breaker contributes the
+        #: shared state machine + the `resilience.fib_agent.*` gauges.
+        import zlib
+
+        self.breaker = CircuitBreaker(
+            "fib_agent",
+            clock,
+            failure_threshold=1,
+            backoff_initial_s=C.FIB_INITIAL_BACKOFF_S,
+            backoff_max_s=C.FIB_MAX_BACKOFF_S,
+            jitter_pct=0.1,
+            seed=zlib.crc32(node_name.encode()),
+            counters=self.counters,
+        )
         self.num_retries = 0
         self._synced = False
         self._agent_alive_since: Optional[float] = None
@@ -253,6 +274,12 @@ class Fib(Actor):
             self.counters.bump("fib.dryrun_updates")
             self._mark_synced()
             return
+        if not self.breaker.allow_request():
+            # open breaker: the agent just failed — don't pay it another
+            # per-update RPC; mark dirty and let the retry fiber's full
+            # sync probe it on the backoff schedule
+            self._mark_dirty(agent_failed=False)
+            return
         try:
             adds = [
                 e.to_unicast_route()
@@ -276,12 +303,16 @@ class Fib(Actor):
                     lambda u=update: self._delayed_delete(u),
                 )
             self._backoff.report_success()
+            self.breaker.record_success()
             self._mark_synced()
         except FibAgentError:
             self._mark_dirty()
 
     def _delayed_delete(self, update: DecisionRouteUpdate):
         async def _run():
+            if not self.breaker.allow_request():
+                self._mark_dirty(agent_failed=False)
+                return
             try:
                 # skip deletes that were re-added as installable meanwhile
                 def still_wanted(p):
@@ -293,8 +324,10 @@ class Fib(Actor):
                     for p in update.unicast_routes_to_delete
                     if not still_wanted(p)
                 ]
+                did_rpc = False
                 if dels:
                     await self.agent.delete_unicast_routes(dels)
+                    did_rpc = True
                 mdels = [
                     l
                     for l in update.mpls_routes_to_delete
@@ -302,6 +335,13 @@ class Fib(Actor):
                 ]
                 if mdels:
                     await self.agent.delete_mpls_routes(mdels)
+                    did_rpc = True
+                if did_rpc:
+                    self.breaker.record_success()
+                else:
+                    # nothing left to delete: the agent was never
+                    # exercised — release an acquired probe unscored
+                    self.breaker.release_probe()
             except FibAgentError:
                 self._mark_dirty()
 
@@ -323,6 +363,7 @@ class Fib(Actor):
                 [e.to_mpls_route() for e in self.mpls_routes.values()],
             )
             self._backoff.report_success()
+            self.breaker.record_success()
             self.counters.bump("fib.num_sync")
             self._mark_synced()
         except FibAgentError:
@@ -338,9 +379,14 @@ class Fib(Actor):
             if self.initialization_cb is not None:
                 self.initialization_cb(InitializationEvent.FIB_SYNCED)
 
-    def _mark_dirty(self) -> None:
+    def _mark_dirty(self, agent_failed: bool = True) -> None:
         self._dirty = True
         self._backoff.report_error()
+        if agent_failed:
+            # score the breaker only on OBSERVED agent failures — a
+            # short-circuited attempt (breaker already open) is not new
+            # evidence against the agent
+            self.breaker.record_failure()
         self.counters.bump("fib.programming_failures")
         self.counters.set(
             "fib.backoff_ms", self._backoff.get_current_backoff() * 1000.0
@@ -359,6 +405,14 @@ class Fib(Actor):
             if self._dirty:
                 self.num_retries += 1
                 self.counters.bump("fib.retries")
+                # this retry IS the half-open probe when the hold has
+                # elapsed (cadence stays on `_backoff`; the breaker only
+                # scores outcomes so its hold ladder tracks failed probes)
+                if (
+                    self.breaker.state != STATE_CLOSED
+                    and self.breaker.time_until_probe_s() <= 0
+                ):
+                    self.breaker.allow_request()
                 await self._sync_routes()
 
     def retry_state(self) -> Dict[str, float]:
@@ -366,12 +420,16 @@ class Fib(Actor):
         live backoff, and dirty/synced flags — the signals a chaos run (or
         an operator via `breeze monitor counters fib.`) watches to confirm
         the agent-retry machinery is actually exercising."""
-        return {
+        out = {
             "fib.retries": float(self.num_retries),
             "fib.backoff_ms": self._backoff.get_current_backoff() * 1000.0,
             "fib.dirty": 1.0 if self._dirty else 0.0,
             "fib.synced": 1.0 if self._synced else 0.0,
         }
+        # shared resilience gauge schema (resilience.fib_agent.*): same
+        # shape as the device governor's and the kv transport's breakers
+        out.update(self.breaker.counter_snapshot())
+        return out
 
     # -- agent keepalive (keepAliveTask, Fib.cpp:1057) ---------------------
 

@@ -399,7 +399,14 @@ async def rocket_call(
     resp = await client.request_response(
         name, encode_struct(m.args, args_obj), timeout_s=timeout_s
     )
-    result = decode_struct(RESULT_SPECS[name], resp.data)
+    try:
+        result = decode_struct(RESULT_SPECS[name], resp.data)
+    except ValueError as e:
+        # the PEER's response bytes are garbage — a session-health event
+        # (RocketCodecError → teardown), not a local programming bug
+        raise rocket.RocketCodecError(
+            f"malformed response payload for {name!r}: {e}"
+        ) from e
     exc = resp.exception
     if "error" in result or exc is not None:
         msg = (result.get("error") or {}).get("message") or (

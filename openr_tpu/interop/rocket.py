@@ -169,6 +169,15 @@ class RocketError(RuntimeError):
         self.name = name  # thrift exception type for declared exceptions
 
 
+class RocketCodecError(RocketError):
+    """The PEER sent bytes this side cannot decode (malformed or
+    incompatible compact payload / response metadata).  Kept distinct
+    from bare ValueError on purpose: a ValueError out of OUR encode path
+    is a programming bug and must propagate loudly, while a peer's
+    garbage response is a session-health event (teardown + redial) —
+    the KvStore transport catch sites key on exactly this split."""
+
+
 @dataclass
 class RocketResponse:
     metadata: Dict[str, Any]
@@ -350,11 +359,16 @@ class RocketClient:
             frame: rs.Frame = await asyncio.wait_for(fut, timeout_s)
         finally:
             self._pending.pop(sid, None)
-        rmeta = (
-            decode_struct(RESPONSE_RPC_METADATA, frame.metadata)
-            if frame.metadata
-            else {}
-        )
+        try:
+            rmeta = (
+                decode_struct(RESPONSE_RPC_METADATA, frame.metadata)
+                if frame.metadata
+                else {}
+            )
+        except ValueError as e:
+            raise RocketCodecError(
+                f"malformed response metadata for {name!r}: {e}"
+            ) from e
         return RocketResponse(metadata=rmeta, data=frame.data)
 
     async def fire_and_forget(self, name: str, data: bytes) -> None:

@@ -163,7 +163,9 @@ class TcpKvStoreTransport(KvStoreTransport):
     machinery drives reconnects.
     """
 
-    def __init__(self, tls=None) -> None:
+    def __init__(self, tls=None, clock: Optional[Clock] = None, counters=None) -> None:
+        from openr_tpu.common.runtime import CounterMap, WallClock
+
         #: TlsConfig for peer sessions — peers' ctrl servers must run the
         #: same TLS posture (Main.cpp:399-416: one thrift server serves
         #: both operators and KvStore peers, so one cert config covers both)
@@ -177,6 +179,78 @@ class TcpKvStoreTransport(KvStoreTransport):
         #: peer, not global, so one blackholing peer can't head-of-line
         #: block dials to healthy peers
         self._connect_locks: Dict[str, object] = {}
+        #: clock/counters normally arrive via bind_node (OpenrNode wires
+        #: its own in its constructor); bare transports get local defaults
+        self.clock: Clock = clock if clock is not None else WallClock()
+        self.counters = counters if counters is not None else CounterMap()
+        #: per-peer session breakers (openr_tpu.resilience): N consecutive
+        #: RPC/dial failures open the circuit — calls short-circuit into
+        #: KvStoreTransportError without redialing until the jittered hold
+        #: elapses, then ONE half-open probe RPC re-establishes trust.
+        #: KvStore's own retry/backoff machinery drives the probes.
+        self._breakers: Dict[str, object] = {}
+
+    def bind_node(self, clock: Clock, counters) -> None:
+        """Adopt the owning node's clock + counter namespace (called by
+        OpenrNode: one daemon per session-ful transport instance)."""
+        self.clock = clock
+        self.counters = counters
+        self._breakers.clear()  # re-key onto the adopted clock
+
+    def _breaker(self, peer_node: str):
+        br = self._breakers.get(peer_node)
+        if br is None:
+            import zlib
+
+            from openr_tpu.resilience import CircuitBreaker
+
+            br = self._breakers[peer_node] = CircuitBreaker(
+                f"kv_peer.{peer_node}",
+                self.clock,
+                failure_threshold=3,
+                backoff_initial_s=1.0,
+                backoff_max_s=30.0,
+                jitter_pct=0.1,
+                seed=zlib.crc32(peer_node.encode()),
+                counters=self.counters,
+            )
+        return br
+
+    def _admit(self, peer_node: str):
+        br = self._breaker(peer_node)
+        if not br.allow_request():
+            self.counters.bump("kvstore.transport.short_circuit")
+            raise KvStoreTransportError(
+                f"circuit open to {peer_node} "
+                f"(probe in {br.time_until_probe_s():.3f}s)"
+            )
+        return br
+
+    def breaker_gauges(self) -> Dict[str, float]:
+        """Monitor gauge provider: fleet-level view of the per-peer
+        session breakers (per-peer detail lives in breaker_status)."""
+        states = [b.state for b in self._breakers.values()]
+        return {
+            "resilience.kv_transport.peers": float(len(self._breakers)),
+            "resilience.kv_transport.open": float(
+                sum(1 for s in states if s == "open")
+            ),
+            "resilience.kv_transport.half_open": float(
+                sum(1 for s in states if s == "half_open")
+            ),
+            "resilience.kv_transport.opens": float(
+                sum(b.num_opens for b in self._breakers.values())
+            ),
+            "resilience.kv_transport.probes": float(
+                sum(b.num_probes for b in self._breakers.values())
+            ),
+        }
+
+    def breaker_status(self) -> Dict[str, dict]:
+        """Per-peer breaker detail for `get_resilience_status`."""
+        return {
+            peer: br.status() for peer, br in sorted(self._breakers.items())
+        }
 
     # -- peer registry hooks (called by KvStoreDb) --------------------------
 
@@ -185,20 +259,25 @@ class TcpKvStoreTransport(KvStoreTransport):
         target = (addr, spec.ctrl_port)
         if self._specs.get(peer_node) != target:
             self._specs[peer_node] = target
-            self._drop_client(peer_node)
+            self._drop_client(peer_node, reason="respec")
 
     def unregister_peer(self, peer_node: str) -> None:
         self._specs.pop(peer_node, None)
         # the dial lock is deliberately NOT popped: an in-flight dial may
         # hold it, and a re-registered peer must serialize behind that dial
         # or the loser's connection leaks (locks are bounded by peers seen)
-        self._drop_client(peer_node)
+        self._drop_client(peer_node, reason="unregister")
+        self._breakers.pop(peer_node, None)
 
-    def _drop_client(self, peer_node: str) -> None:
+    def _drop_client(self, peer_node: str, reason: str = "replaced") -> None:
         client = self._clients.pop(peer_node, None)
         if client is not None:
             import asyncio
 
+            # per-reason teardown accounting: which failure class is
+            # churning sessions (`breeze monitor counters
+            # kvstore.transport.`)
+            self.counters.bump(f"kvstore.transport.teardown.{reason}")
             task = asyncio.ensure_future(client.close())
             self._close_tasks.add(task)
 
@@ -233,6 +312,7 @@ class TcpKvStoreTransport(KvStoreTransport):
             try:
                 client = await self._dial(target[0], target[1])
             except OSError as e:
+                self.counters.bump("kvstore.transport.connect_failures")
                 raise KvStoreTransportError(
                     f"connect to {peer_node} {target} failed: {e}"
                 ) from e
@@ -245,14 +325,25 @@ class TcpKvStoreTransport(KvStoreTransport):
         return await OpenrCtrlClient(host=host, port=port, tls=self.tls).connect()
 
     async def _call(self, peer_node: str, method: str, **params):
-        client = await self._client(peer_node)
+        br = self._admit(peer_node)
         try:
-            return await client.call(method, **params)
+            client = await self._client(peer_node)
+        except KvStoreTransportError:
+            br.record_failure()
+            raise
+        try:
+            result = await client.call(method, **params)
         except (OSError, RuntimeError) as e:
-            self._drop_client(peer_node)
+            br.record_failure()
+            self._drop_client(
+                peer_node,
+                reason="os_error" if isinstance(e, OSError) else "rpc_error",
+            )
             raise KvStoreTransportError(
                 f"rpc {method} to {peer_node} failed: {e}"
             ) from e
+        br.record_success()
+        return result
 
     # -- KvStoreTransport surface -------------------------------------------
 
@@ -329,24 +420,51 @@ class RocketKvStoreTransport(TcpKvStoreTransport):
 
     async def _call_rocket(self, peer_node: str, method: str, args: dict):
         from openr_tpu.interop.ctrl_rocket import DeclaredError, rocket_call
-        from openr_tpu.interop.rocket import RocketError
+        from openr_tpu.interop.rocket import RocketCodecError, RocketError
 
-        client = await self._client(peer_node)
+        br = self._admit(peer_node)
         try:
-            return await rocket_call(client, method, args)
+            client = await self._client(peer_node)
+        except KvStoreTransportError:
+            br.record_failure()
+            raise
+        try:
+            result = await rocket_call(client, method, args)
         except DeclaredError as e:
             # server-side declared exception: the connection is healthy
+            br.record_success()
             raise KvStoreTransportError(
                 f"rpc {method} to {peer_node} failed: {e}"
             ) from e
-        except (OSError, RocketError, TimeoutError, ValueError) as e:
-            # ValueError = malformed/incompatible response bytes (codec);
-            # it must stay inside the KvStoreTransport error contract or
-            # the sync task dies and the peer sticks in SYNCING forever
-            self._drop_client(peer_node)
+        except RocketCodecError as e:
+            # the PEER's response bytes are garbage — teardown + redial
+            # stays inside the KvStoreTransport error contract (or the
+            # sync task dies and the peer sticks in SYNCING forever).
+            # Bare ValueError is deliberately NOT caught any more: a
+            # ValueError out of OUR encode path is a programming bug and
+            # must crash loud, not be recycled as a transport blip.
+            br.record_failure()
+            self._drop_client(peer_node, reason="codec")
             raise KvStoreTransportError(
                 f"rpc {method} to {peer_node} failed: {e}"
             ) from e
+        except TimeoutError as e:
+            br.record_failure()
+            self._drop_client(peer_node, reason="timeout")
+            raise KvStoreTransportError(
+                f"rpc {method} to {peer_node} failed: {e}"
+            ) from e
+        except (OSError, RocketError) as e:
+            br.record_failure()
+            self._drop_client(
+                peer_node,
+                reason="os_error" if isinstance(e, OSError) else "rocket",
+            )
+            raise KvStoreTransportError(
+                f"rpc {method} to {peer_node} failed: {e}"
+            ) from e
+        br.record_success()
+        return result
 
     # -- KvStoreTransport surface ------------------------------------------
 

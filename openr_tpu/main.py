@@ -153,6 +153,16 @@ class OpenrNode:
         self.clock = clock
         self.name = config.node_name
         self.counters = CounterMap()
+        #: kept for the resilience status surface (per-peer transport
+        #: breakers live on session-ful transports); session-ful
+        #: transports also bind this node's clock+counters here so their
+        #: kvstore.transport.* / resilience.kv_peer.* counters land on
+        #: this node's ctrl surface (one daemon per transport instance —
+        #: the shared InProcessTransport has no bind hook by design)
+        self.kv_transport = kv_transport
+        bind = getattr(kv_transport, "bind_node", None)
+        if bind is not None:
+            bind(clock, self.counters)
         self.init_tracker = InitializationTracker(clock)
         # causal convergence tracing: one tracer per node, shared by every
         # pipeline stage (injected Clock ⇒ SimClock tests replay traces)
@@ -311,6 +321,14 @@ class OpenrNode:
                 min_device_prefixes=(
                     config.tpu_compute_config.min_device_prefixes
                 ),
+                # the BackendHealthGovernor (shadow verification, breaker,
+                # probed recovery) shares the node clock/counters/tracer so
+                # its resilience.* gauges and resilience.probe spans land
+                # on this node's observability surfaces
+                clock=clock,
+                counters=self.counters,
+                tracer=self.tracer,
+                resilience=config.resilience_config,
             )
             if use_tpu
             else ScalarBackend(solver)
@@ -388,6 +406,12 @@ class OpenrNode:
         # can watch the recovery machinery work
         self.monitor.add_counter_provider(self.fib.retry_state)
         self.monitor.add_counter_provider(backend.counter_snapshot)
+        governor = getattr(backend, "governor", None)
+        if governor is not None:
+            self.monitor.add_counter_provider(governor.counter_snapshot)
+        kv_gauges = getattr(kv_transport, "breaker_gauges", None)
+        if kv_gauges is not None:
+            self.monitor.add_counter_provider(kv_gauges)
         self.monitor.add_counter_provider(jit_guard.counter_snapshot)
         self.monitor.add_counter_provider(self.tracer.stats)
         self.monitor.add_counter_provider(self.dispatcher.queue_stats)

@@ -1,0 +1,386 @@
+"""BackendHealthGovernor — owns the device backend's health latch.
+
+The pitch of this system is "replace the trusted scalar Dijkstra with a
+batched device kernel" (PAPER §7).  That trade has three failure modes a
+production deployment must survive without an operator:
+
+1. **Hard outage** — dispatch raises (chaos ``tpu_fail``, a dead chip, a
+   severed tunnel).  Before this module the latch was one-way: only
+   chaos flipped ``TpuBackend.device_failed``; an organic dispatch
+   exception fell back scalar for THAT build and re-paid the failing
+   device on every subsequent rebuild.
+2. **Silent data corruption (SDC)** — the kernel returns *wrong but
+   plausible* tables (the classic large-fleet accelerator failure mode;
+   chaos ``tpu_corrupt`` models it).  Nothing raised, so nothing in the
+   old design could notice wrong routes being programmed into FIBs.
+3. **Recovery** — once the device heals, something has to notice and
+   re-trust it, and it must not re-trust a device that is still lying.
+
+The governor solves all three with ONE mechanism: a
+:class:`~openr_tpu.resilience.breaker.CircuitBreaker` around the device,
+plus **shadow verification** — a configurable sample of device builds is
+recomputed on the native/scalar SPF oracle and RIB-diffed (nexthop sets,
+igp cost, plus non-finite/NaN guards on kernel-derived metrics).  A
+mismatch or a run of dispatch failures opens the breaker: the backend is
+quarantined, ``device_failed`` goes up, and — because
+``Decision.device_available()`` reads that latch — route builds, the
+serving plane, and what-if queries all degrade to the scalar engines
+coherently.  While open, half-open probe builds (which MUST pass shadow
+verification) are the only device traffic; a passing probe restores the
+device.
+
+The governor is the ONLY writer of ``device_failed`` outside chaos and
+the backend itself — enforced statically by orlint's ``resilience-latch``
+rule (analysis/passes/resilience_latch.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.common.runtime import Clock, CounterMap, WallClock
+from openr_tpu.resilience.breaker import (
+    STATE_CLOSED,
+    CircuitBreaker,
+)
+
+#: admit() verdicts
+ADMIT_DEVICE = "device"
+ADMIT_PROBE = "probe"
+ADMIT_QUARANTINED = "quarantined"
+
+
+class BackendHealthGovernor:
+    """Health authority for one TpuBackend.
+
+    The backend calls three hooks around every build:
+
+    * :meth:`admit` — before touching the device.  ``"quarantined"``
+      routes the build to the scalar oracle; ``"probe"`` marks this
+      build as the half-open probe (it must shadow-verify to restore
+      the device); ``"device"`` is the healthy fast path.
+    * :meth:`record_dispatch_failure` — a device dispatch raised.
+      Consecutive failures past the breaker threshold quarantine.
+    * :meth:`after_device_build` — the device produced a RouteDb.
+      Sampled builds (and every probe) are shadow-verified against the
+      scalar oracle; on mismatch the device is quarantined and the
+      *scalar* RouteDb replaces the corrupt device output, so the wrong
+      answer never reaches the FIB once detected.
+    """
+
+    def __init__(
+        self,
+        backend,
+        clock: Optional[Clock] = None,
+        counters: Optional[CounterMap] = None,
+        tracer=None,
+        shadow_sample_every: int = 8,
+        failure_threshold: int = 3,
+        probe_backoff_initial_s: float = 1.0,
+        probe_backoff_max_s: float = 30.0,
+        jitter_pct: float = 0.1,
+        seed: int = 0,
+    ) -> None:
+        from openr_tpu.tracing import disabled_tracer
+
+        self.backend = backend
+        self.clock = clock if clock is not None else WallClock()
+        self.counters = counters if counters is not None else CounterMap()
+        self.tracer = tracer if tracer is not None else disabled_tracer()
+        self.shadow_sample_every = max(0, int(shadow_sample_every))
+        self.breaker = CircuitBreaker(
+            "backend",
+            self.clock,
+            failure_threshold=failure_threshold,
+            backoff_initial_s=probe_backoff_initial_s,
+            backoff_max_s=probe_backoff_max_s,
+            jitter_pct=jitter_pct,
+            seed=seed,
+            counters=self.counters,
+        )
+        #: hard latch: chaos tpu_fail / operator force_quarantine.  While
+        #: set, NO probes run (the fault owner declared the device dead);
+        #: request_probe() clears it and makes the breaker probe-eligible
+        self.injected = False
+        self.quarantine_reason = ""
+        #: device builds since the last shadow check; starts "due" so the
+        #: FIRST device build of a boot is always verified — SDC present
+        #: from cold start is caught before the first FIB sync settles
+        self._builds_since_check = self.shadow_sample_every
+        self._forced_probe = False
+        self.num_shadow_checks = 0
+        self.num_shadow_mismatches = 0
+        self.num_quarantines = 0
+        self.num_restores = 0
+        self.num_dispatch_failures = 0
+        self.last_probe: Dict[str, object] = {}
+        self.last_mismatch: Dict[str, object] = {}
+        self._sync_latch()
+
+    # -- the latch (single writer) ------------------------------------------
+
+    def _sync_latch(self) -> None:
+        self.backend.device_failed = (
+            self.injected or self.breaker.state != STATE_CLOSED
+        )
+
+    @property
+    def quarantined(self) -> bool:
+        return self.backend.device_failed
+
+    # -- build hooks ---------------------------------------------------------
+
+    def admit(self) -> str:
+        """Gate one route build's device usage."""
+        if self.injected:
+            return ADMIT_QUARANTINED
+        if self._forced_probe:
+            # operator force_probe: run the device + full verification
+            # regardless of breaker timing
+            self._forced_probe = False
+            return ADMIT_PROBE
+        if self.breaker.state == STATE_CLOSED:
+            return ADMIT_DEVICE
+        if self.breaker.allow_request():
+            return ADMIT_PROBE
+        return ADMIT_QUARANTINED
+
+    def abort_probe(self) -> None:
+        """The admitted probe never reached the device (the build bailed
+        to scalar for an eligibility reason, not a health reason):
+        release the probe slot without scoring it."""
+        self.breaker.release_probe()
+
+    def record_dispatch_failure(self, exc: Optional[BaseException] = None) -> None:
+        """A device dispatch raised (organic failure).  Counts toward the
+        breaker threshold; past it the device is quarantined instead of
+        being re-tried on every rebuild."""
+        self.num_dispatch_failures += 1
+        self.counters.bump("resilience.backend.dispatch_failures")
+        was_quarantined = self.quarantined
+        self.breaker.record_failure()
+        self._sync_latch()
+        if self.quarantined and not was_quarantined:
+            self._note_quarantine(
+                f"dispatch:{type(exc).__name__}" if exc is not None else "dispatch"
+            )
+
+    def after_device_build(
+        self, db, area_link_states, prefix_state, probe: bool = False
+    ) -> Tuple[object, bool]:
+        """Returns ``(route_db, from_device)``.  ``from_device`` is False
+        exactly when shadow verification replaced a corrupt device
+        result with the scalar oracle's — the caller must then drop its
+        incremental bases."""
+        self._builds_since_check += 1
+        due = (
+            self.shadow_sample_every > 0
+            and self._builds_since_check >= self.shadow_sample_every
+        )
+        if not probe and not due:
+            return db, True
+        self._builds_since_check = 0
+        span = self.tracer.start_span(
+            "resilience.probe" if probe else "resilience.shadow_check",
+            module="resilience",
+            probe=probe,
+        )
+        ok, scalar_db, reason = self._shadow_verify(
+            db, area_link_states, prefix_state
+        )
+        self.tracer.end_span(span, passed=ok, reason=reason)
+        if probe:
+            self.last_probe = {
+                "passed": ok,
+                "reason": reason,
+            }
+        if ok:
+            self.num_shadow_checks += 1
+            self.counters.bump("resilience.backend.shadow_checks")
+            if probe or self.breaker.state != STATE_CLOSED:
+                was_quarantined = self.quarantined
+                self.breaker.record_success()
+                self.injected = False
+                self._sync_latch()
+                if was_quarantined and not self.quarantined:
+                    self.num_restores += 1
+                    self.counters.bump("resilience.backend.restores")
+            return db, True
+        # wrong-but-plausible device output: quarantine AND serve the
+        # verified scalar answer for this build
+        self.num_shadow_checks += 1
+        self.counters.bump("resilience.backend.shadow_checks")
+        self.num_shadow_mismatches += 1
+        self.counters.bump("resilience.backend.shadow_mismatches")
+        self.last_mismatch = {"reason": reason}
+        was_quarantined = self.quarantined
+        if probe and self.breaker.state != STATE_CLOSED:
+            self.breaker.record_failure()  # failed probe: backoff doubles
+        else:
+            # sampled mismatch, or a FORCED probe that failed while the
+            # breaker was closed: proven corruption quarantines outright
+            self.breaker.force_open()
+        self._sync_latch()
+        if not was_quarantined:
+            self._note_quarantine(f"shadow:{reason}")
+        return scalar_db, False
+
+    def _note_quarantine(self, reason: str) -> None:
+        self.quarantine_reason = reason
+        self.num_quarantines += 1
+        self.counters.bump("resilience.backend.quarantines")
+
+    # -- shadow verification -------------------------------------------------
+
+    def _shadow_verify(
+        self, device_db, area_link_states, prefix_state
+    ) -> Tuple[bool, object, str]:
+        """Device RouteDb vs the scalar oracle: (ok, scalar_db, reason).
+
+        Checks, cheapest first: non-finite guard on kernel-derived
+        metrics (NaN/inf igp_cost is *never* legitimate on a reachable
+        route), then the full RIB diff — same prefix set, and per prefix
+        the same nexthop set (address/iface/metric/area) and igp cost.
+        The scalar db is computed ONCE and returned so a mismatching
+        build can be served from it without a second solve."""
+        for prefix, entry in device_db.unicast_routes.items():
+            if not math.isfinite(entry.igp_cost):
+                return False, self._scalar_db(area_link_states, prefix_state), (
+                    f"non_finite:{prefix}"
+                )
+        scalar_db = self._scalar_db(area_link_states, prefix_state)
+        dev = device_db.unicast_routes
+        ref = scalar_db.unicast_routes
+        if set(dev) != set(ref):
+            missing = sorted(set(ref) - set(dev))[:3]
+            extra = sorted(set(dev) - set(ref))[:3]
+            return False, scalar_db, f"prefix_set:missing={missing}:extra={extra}"
+        for prefix, d in dev.items():
+            r = ref[prefix]
+            if set(d.nexthops) != set(r.nexthops):
+                return False, scalar_db, f"nexthops:{prefix}"
+            if float(d.igp_cost) != float(r.igp_cost):
+                return False, scalar_db, f"igp_cost:{prefix}"
+            if d.do_not_install != r.do_not_install:
+                return False, scalar_db, f"do_not_install:{prefix}"
+        return True, scalar_db, ""
+
+    def _scalar_db(self, area_link_states, prefix_state):
+        return self.backend.solver.build_route_db(
+            area_link_states, prefix_state
+        )
+
+    # -- operator / chaos controls -------------------------------------------
+
+    def force_quarantine(self, reason: str = "operator") -> None:
+        """Hard-quarantine the device (chaos tpu_fail inject, operator
+        drain).  No probes run until request_probe/force_restore."""
+        was = self.quarantined
+        self.injected = True
+        self.breaker.force_open()
+        self._sync_latch()
+        if not was:
+            self._note_quarantine(reason)
+        else:
+            self.quarantine_reason = reason
+
+    def request_probe(self, reason: str = "heal") -> None:
+        """The fault owner healed the device: clear the hard latch and
+        make the breaker probe-eligible NOW.  The device stays
+        quarantined until a probe build passes shadow verification —
+        heals are *probed*, never trusted blindly."""
+        self.injected = False
+        self.breaker.expire_hold()
+        self.counters.bump("resilience.backend.probe_requests")
+        self._sync_latch()
+
+    def force_restore(self, reason: str = "operator") -> None:
+        """Operator force-close: trust the device immediately (the
+        legacy `inject_device_failure(False)` semantics — documented as
+        a FORCE; prefer request_probe for verified recovery)."""
+        was = self.quarantined
+        self.injected = False
+        self.breaker.force_close()
+        self._sync_latch()
+        if was:
+            self.num_restores += 1
+            self.counters.bump("resilience.backend.restores")
+
+    def probe_now(self, area_link_states, prefix_state) -> Dict[str, object]:
+        """Synchronous operator probe (`force_probe` ctrl verb): run one
+        device build against the CURRENT LSDB through the full probe
+        path (device solve + shadow verification) and report the
+        outcome.  A pass restores the device, including from an
+        injected quarantine — the operator explicitly demanded a
+        re-check."""
+        if not area_link_states or not any(
+            ls.has_node(self.backend.solver.my_node_name)
+            for ls in area_link_states.values()
+        ):
+            return {"probed": False, "reason": "no LSDB state to probe with"}
+        self.injected = False  # the operator overrides the hard latch
+        self._forced_probe = True
+        self.last_probe = {}
+        db = self.backend.build_route_db(
+            area_link_states,
+            prefix_state,
+            force_full=True,
+            cache_result=False,
+        )
+        out: Dict[str, object] = {
+            "probed": bool(self.last_probe),
+            "restored": not self.quarantined,
+            "routes": len(db.unicast_routes) if db is not None else 0,
+        }
+        out.update(self.last_probe)
+        if not self.last_probe:
+            # the build never reached the device (algorithm/scale routes
+            # every build scalar) — nothing was verified
+            out["reason"] = "build took the scalar path; nothing to probe"
+            self._forced_probe = False
+        return out
+
+    # -- observability -------------------------------------------------------
+
+    def counter_snapshot(self) -> Dict[str, float]:
+        """Gauge provider for Monitor.add_counter_provider."""
+        out = self.breaker.counter_snapshot("resilience.backend")
+        out.update(
+            {
+                "resilience.backend.quarantined": (
+                    1.0 if self.quarantined else 0.0
+                ),
+                "resilience.backend.injected": 1.0 if self.injected else 0.0,
+                "resilience.backend.shadow_checks": float(
+                    self.num_shadow_checks
+                ),
+                "resilience.backend.shadow_mismatches": float(
+                    self.num_shadow_mismatches
+                ),
+                "resilience.backend.quarantines": float(self.num_quarantines),
+                "resilience.backend.restores": float(self.num_restores),
+                "resilience.backend.dispatch_failures": float(
+                    self.num_dispatch_failures
+                ),
+            }
+        )
+        return out
+
+    def status(self) -> Dict[str, object]:
+        """The ctrl-API `get_resilience_status` device-backend block."""
+        return {
+            "present": True,
+            "quarantined": self.quarantined,
+            "injected": self.injected,
+            "quarantine_reason": self.quarantine_reason,
+            "shadow_sample_every": self.shadow_sample_every,
+            "shadow_checks": self.num_shadow_checks,
+            "shadow_mismatches": self.num_shadow_mismatches,
+            "quarantines": self.num_quarantines,
+            "restores": self.num_restores,
+            "dispatch_failures": self.num_dispatch_failures,
+            "last_probe": dict(self.last_probe),
+            "last_mismatch": dict(self.last_mismatch),
+            "breaker": self.breaker.status(),
+        }

@@ -222,6 +222,37 @@ def test_cli_serving_stats_and_queries(live_node):
     assert "serving on node0" in table and "max_batch=64" in table
 
 
+def test_cli_resilience_status_scalar_node(live_node):
+    """breeze resilience status on a scalar deployment: no device
+    governor, but the FIB agent breaker is always reported."""
+    out = _run(live_node, "resilience", "status")
+    assert "resilience on node0" in out
+    assert "device backend: none" in out
+    assert "fib agent: breaker=closed" in out
+    st = json.loads(_run(live_node, "resilience", "status", "--json"))
+    assert st["device_backend"] == {"present": False}
+    assert st["fib_agent"]["state"] == "closed"
+
+
+def test_cli_resilience_quarantine_and_probe_tpu_node():
+    """force-quarantine / force-probe / status against a TPU-backend
+    live node: the ctrl verbs drive the governor end to end."""
+    with _live_ctrl_node(num_nodes=2, use_tpu_backend=True) as port:
+        st = json.loads(_run(port, "resilience", "status", "--json"))
+        assert st["device_backend"]["present"]
+        assert not st["device_backend"]["quarantined"]
+        after = json.loads(
+            _run(port, "resilience", "force-quarantine", "--reason", "drill")
+        )
+        assert after["device_backend"]["quarantined"]
+        assert "operator:drill" in after["device_backend"]["quarantine_reason"]
+        table = _run(port, "resilience", "status")
+        assert "QUARANTINED" in table
+        probe = json.loads(_run(port, "resilience", "force-probe"))
+        assert "probe" in probe and "status" in probe
+        assert {"probed"} <= set(probe["probe"])
+
+
 def test_cli_kvstore_snoop_snapshot(live_node):
     out = _run(
         live_node,

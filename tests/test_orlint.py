@@ -124,6 +124,12 @@ FIXTURES = {
         (),
         3,
     ),
+    "resilience-latch": (
+        "def drain(backend):\n"
+        "    backend.device_failed = True\n",
+        (),
+        2,
+    ),
 }
 
 
@@ -312,6 +318,49 @@ def test_sync_helper_nested_in_async_def_is_skipped():
 def test_non_protocol_trees_are_out_of_scope():
     src = "import time\n\ndef fmt():\n    return time.time()\n"
     mods = [ParsedModule.parse("openr_tpu/cli/breeze.py", src)]
+    assert analyze_modules(mods).findings == []
+
+
+def test_resilience_latch_call_form_trips():
+    src = (
+        "def heal(node):\n"
+        "    node.decision.backend.inject_device_failure(False)\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == ["resilience-latch"]
+    src2 = "def corrupt(b):\n    b.inject_silent_corruption(True)\n"
+    assert [f.rule for f in analyze_source(src2)] == ["resilience-latch"]
+
+
+def test_resilience_latch_reads_are_clean():
+    # device_available() and counter snapshots READ the latch — only
+    # writes are owned by the governor
+    src = (
+        "def available(backend):\n"
+        "    return not getattr(backend, 'device_failed', False)\n"
+        "\n"
+        "def gauge(backend):\n"
+        "    return 1.0 if backend.device_failed else 0.0\n"
+    )
+    assert analyze_source(src) == []
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        "openr_tpu/decision/backend.py",
+        "openr_tpu/resilience/governor.py",
+        "openr_tpu/chaos/controller.py",
+    ],
+)
+def test_resilience_latch_owners_are_exempt(rel):
+    """The latch's legitimate owners (backend, governor, chaos) write it
+    freely — the rule only polices everyone else."""
+    src = (
+        "def flip(backend):\n"
+        "    backend.device_failed = True\n"
+        "    backend.inject_device_failure(True)\n"
+    )
+    mods = [ParsedModule.parse(rel, src)]
     assert analyze_modules(mods).findings == []
 
 

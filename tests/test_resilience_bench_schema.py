@@ -1,0 +1,43 @@
+"""Tier-1 smoke: the checked-in BENCH_RESILIENCE artifact obeys the
+schema the bench emits (shared validator — bench.validate_resilience_bench)
+and holds the acceptance bounds from ISSUE 5: shadow-verification
+overhead <= 5% on the rebuild p50, SDC detected within one
+shadow-sample interval, probed recovery, deterministic replay.
+
+The validator lives in bench.py so the emitter and this gate can never
+drift apart; regenerate the artifact with `python bench.py --resilience`.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+import bench
+
+ARTIFACT = pathlib.Path(__file__).resolve().parent.parent / (
+    "BENCH_RESILIENCE_r01.json"
+)
+
+
+def test_artifact_exists_and_matches_schema():
+    doc = json.loads(ARTIFACT.read_text())
+    bench.validate_resilience_bench(doc)
+
+
+def test_sdc_scenario_holds_the_acceptance_bounds():
+    doc = json.loads(ARTIFACT.read_text())
+    sc = doc["detail"]["sdc_scenario"]
+    # detection within ONE shadow-sample interval of rebuilds
+    assert sc["rebuilds_to_detect"] <= sc["shadow_sample_every"]
+    # the same seed replayed byte-identically (chaos + resilience dumps)
+    assert sc["deterministic_replay"] is True
+    # recovery went through the probe path, not a blind flip
+    assert sc["probes"] >= 1 and sc["restores"] >= 1
+
+
+def test_validator_rejects_malformed_doc():
+    doc = json.loads(ARTIFACT.read_text())
+    doc["value"] = 50.0  # a 50% p50 overhead must never pass the gate
+    with pytest.raises(AssertionError):
+        bench.validate_resilience_bench(doc)
