@@ -14,11 +14,17 @@ verified.
 Rule:
 
 * ``resilience-latch`` — assignment to a ``device_failed`` attribute, or
-  a call to ``inject_device_failure`` / ``inject_silent_corruption``,
-  anywhere outside the allowed owners: the backend itself
-  (``decision/backend.py``), the governor tree (``resilience/``), and
-  chaos fault handlers (``chaos/``).  Reads are fine —
-  ``Decision.device_available()`` exists precisely to read the latch.
+  a call to ``inject_device_failure`` / ``inject_silent_corruption``, or
+  a call to the per-device quarantine-mask mutators
+  ``quarantine_device`` / ``restore_device`` (``DevicePool`` — ISSUE 6:
+  per-chip health is governor-owned exactly like the whole-backend
+  latch; everything else goes through ``force_quarantine_device`` /
+  ``request_probe_device`` so transitions are counted and recoveries
+  probed), anywhere outside the allowed owners: the backend itself
+  (``decision/backend.py``), the pool (``parallel/mesh.py``), the
+  governor tree (``resilience/``), and chaos fault handlers
+  (``chaos/``).  Reads are fine — ``Decision.device_available()`` and
+  ``DevicePool.healthy_indices()`` exist precisely to read the state.
 """
 
 from __future__ import annotations
@@ -32,12 +38,19 @@ from openr_tpu.analysis.passes.base import ParsedModule, Pass
 #: the latch's legitimate owners (writes allowed)
 ALLOWED_PREFIXES = (
     "openr_tpu/decision/backend.py",
+    "openr_tpu/parallel/mesh.py",
     "openr_tpu/resilience/",
     "openr_tpu/chaos/",
 )
 
 _LATCH_ATTRS = {"device_failed"}
-_LATCH_CALLS = {"inject_device_failure", "inject_silent_corruption"}
+_LATCH_CALLS = {
+    "inject_device_failure",
+    "inject_silent_corruption",
+    # DevicePool per-chip quarantine-mask mutators
+    "quarantine_device",
+    "restore_device",
+}
 
 
 class ResilienceLatchPass(Pass):

@@ -109,14 +109,33 @@ class ChaosController(Actor):
         n = self.net.nodes.get(node)
         return getattr(n.decision, "backend", None) if n is not None else None
 
-    def _tpu_fail(self, inject: bool, node: str) -> None:
+    @staticmethod
+    def _resolve_device(governor, device_index):
+        """Requested chip index → pool index (modulo the pool size, so
+        one seeded plan stays meaningful across device counts), or None
+        when per-chip governance is inactive (single-chip pool) — the
+        fault then falls back to the whole-backend latch."""
+        if governor is None or device_index is None:
+            return None
+        return governor.resolve_device_index(device_index)
+
+    def _tpu_fail(self, inject: bool, node: str, device_index=None) -> None:
         backend = self._device_backend(node)
         governor = getattr(backend, "governor", None)
         if governor is not None:
             # route the latch through the health governor: the heal is
             # PROBED (the next build runs a shadow-verified probe solve
             # before the device is trusted again), not flipped blind
-            if inject:
+            dev = self._resolve_device(governor, device_index)
+            if dev is not None:
+                # per-chip outage: only chip `dev` quarantines; its
+                # shard re-packs onto the survivors and the node keeps
+                # serving on the rest of the pool
+                if inject:
+                    governor.force_quarantine_device(dev, reason="chaos")
+                else:
+                    governor.request_probe_device(dev, reason="chaos_heal")
+            elif inject:
                 governor.force_quarantine(reason="chaos")
             else:
                 governor.request_probe(reason="chaos_heal")
@@ -127,17 +146,24 @@ class ChaosController(Actor):
             # seeded dump still reflects the scheduled fault
             self.counters.bump("chaos.tpu_fail.noop")
 
-    def _tpu_corrupt(self, inject: bool, node: str) -> None:
+    def _tpu_corrupt(self, inject: bool, node: str, device_index=None) -> None:
         backend = self._device_backend(node)
         if backend is not None and hasattr(backend, "inject_silent_corruption"):
-            backend.inject_silent_corruption(inject)
+            governor = getattr(backend, "governor", None)
+            dev = self._resolve_device(governor, device_index)
+            backend.inject_silent_corruption(inject, device_index=dev)
             if not inject:
                 # the kernel stopped lying; if shadow verification had
-                # quarantined the device meanwhile, make the probe due
-                # now so recovery doesn't wait out the jittered hold
-                governor = getattr(backend, "governor", None)
+                # quarantined the device (or the one chip) meanwhile,
+                # make the probe due now so recovery doesn't wait out
+                # the jittered hold
                 if governor is not None:
-                    governor.request_probe(reason="chaos_heal")
+                    if dev is not None:
+                        governor.request_probe_device(
+                            dev, reason="chaos_heal"
+                        )
+                    else:
+                        governor.request_probe(reason="chaos_heal")
         else:
             # scalar backend computes on the oracle itself — nothing to
             # corrupt; record the no-op for the seeded dump

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterable, List, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 FAULT_KINDS = (
     "link_down",
@@ -144,15 +144,39 @@ class FaultPlan:
         self.faults.append(_f("fib_burst", at, duration, node=node))
         return self
 
-    def tpu_fail(self, node: str, at: float, duration: float) -> "FaultPlan":
-        self.faults.append(_f("tpu_fail", at, duration, node=node))
+    def tpu_fail(
+        self,
+        node: str,
+        at: float,
+        duration: float,
+        device_index: Optional[int] = None,
+    ) -> "FaultPlan":
+        """Device outage.  ``device_index`` scopes the fault to ONE chip
+        of the node's DevicePool (its shard re-packs onto the survivors;
+        the node keeps serving); None fails the whole backend."""
+        params = {"node": node}
+        if device_index is not None:
+            params["device_index"] = int(device_index)
+        self.faults.append(_f("tpu_fail", at, duration, **params))
         return self
 
-    def tpu_corrupt(self, node: str, at: float, duration: float) -> "FaultPlan":
+    def tpu_corrupt(
+        self,
+        node: str,
+        at: float,
+        duration: float,
+        device_index: Optional[int] = None,
+    ) -> "FaultPlan":
         """Silent data corruption: the device kernel keeps answering but
         its outputs are wrong-but-plausible.  Nothing raises — only the
-        governor's shadow verification can catch it."""
-        self.faults.append(_f("tpu_corrupt", at, duration, node=node))
+        governor's shadow verification can catch it.  ``device_index``
+        makes ONE chip of the pool lie (the per-chip SDC model: shadow
+        verification must pin and quarantine exactly that chip); None
+        corrupts every shard."""
+        params = {"node": node}
+        if device_index is not None:
+            params["device_index"] = int(device_index)
+        self.faults.append(_f("tpu_corrupt", at, duration, **params))
         return self
 
     def actor_kill(self, node: str, module: str, at: float) -> "FaultPlan":
@@ -193,10 +217,14 @@ class FaultPlan:
         min_duration_s: float = 4.0,
         max_duration_s: float = 15.0,
         allow_kills: bool = True,
+        num_devices: int = 0,
     ) -> "FaultPlan":
         """Random plan drawn from `seed` — every transient fault heals
         strictly before `horizon_s` so invariants can be checked after a
-        final convergence window."""
+        final convergence window.  ``num_devices`` > 0 lets tpu faults
+        target a single chip (half the draws pick a device index in
+        [0, num_devices)); 0 keeps the draw sequence byte-identical to
+        pre-per-chip plans."""
         rng = random.Random(seed)
         nodes = sorted(nodes)
         edges = sorted(tuple(sorted(e)) for e in edges)
@@ -236,9 +264,21 @@ class FaultPlan:
             elif kind == "fib_burst":
                 plan.fib_burst(rng.choice(nodes), at, duration)
             elif kind == "tpu_fail":
-                plan.tpu_fail(rng.choice(nodes), at, duration)
+                node = rng.choice(nodes)
+                dev = (
+                    rng.randrange(num_devices)
+                    if num_devices > 0 and rng.random() < 0.5
+                    else None
+                )
+                plan.tpu_fail(node, at, duration, device_index=dev)
             elif kind == "tpu_corrupt":
-                plan.tpu_corrupt(rng.choice(nodes), at, duration)
+                node = rng.choice(nodes)
+                dev = (
+                    rng.randrange(num_devices)
+                    if num_devices > 0 and rng.random() < 0.5
+                    else None
+                )
+                plan.tpu_corrupt(node, at, duration, device_index=dev)
             else:
                 plan.actor_kill(
                     rng.choice(nodes), rng.choice(KILLABLE_MODULES), at

@@ -666,6 +666,27 @@ def resilience_status(ctx: click.Context, json_out: bool) -> None:
         )
         if dev.get("last_probe"):
             click.echo(f"    last probe: {dev['last_probe']}")
+        pool = dev.get("pool")
+        if pool:
+            click.echo(
+                f"    pool: {pool['num_healthy']}/{pool['size']} "
+                "devices healthy"
+            )
+            for row in dev.get("devices", []):
+                state = "healthy" if row["healthy"] else "QUARANTINED"
+                extra = ""
+                if not row["healthy"]:
+                    br = row.get("breaker") or {}
+                    extra = (
+                        f" breaker={br.get('state', '-')}"
+                        + (" injected" if row.get("injected") else "")
+                        + (
+                            f" (reason: {row['reason']})"
+                            if row.get("reason")
+                            else ""
+                        )
+                    )
+                click.echo(f"      dev{row['device']}: {state}{extra}")
     fib_b = status.get("fib_agent", {})
     if fib_b:
         click.echo(
@@ -684,19 +705,35 @@ def resilience_status(ctx: click.Context, json_out: bool) -> None:
 
 @resilience.command("force-quarantine")
 @click.option("--reason", default="breeze", help="recorded quarantine reason")
+@click.option(
+    "--device",
+    type=int,
+    default=None,
+    help="drain ONE chip of the pool (its shard re-packs onto the "
+    "survivors; the node keeps serving); omit for the whole backend",
+)
 @click.pass_context
-def resilience_force_quarantine(ctx: click.Context, reason: str) -> None:
-    """Drain the accelerator NOW: every compute path degrades to the
-    scalar engines until a probe passes (`force-probe`)."""
-    _print(_call(ctx, "force_quarantine", reason=reason))
+def resilience_force_quarantine(
+    ctx: click.Context, reason: str, device: int
+) -> None:
+    """Drain the accelerator (or one chip) NOW: the affected compute
+    degrades/re-packs until a probe passes (`force-probe`)."""
+    _print(_call(ctx, "force_quarantine", reason=reason, device=device))
 
 
 @resilience.command("force-probe")
+@click.option(
+    "--device",
+    type=int,
+    default=None,
+    help="probe ONE chip (a quarantined chip recovers only via its own "
+    "shadow-verified probe shard); omit for the whole backend",
+)
 @click.pass_context
-def resilience_force_probe(ctx: click.Context) -> None:
+def resilience_force_probe(ctx: click.Context, device: int) -> None:
     """Run one shadow-verified probe solve right now; a pass restores a
-    quarantined device."""
-    _print(_call(ctx, "force_probe"))
+    quarantined device (or chip)."""
+    _print(_call(ctx, "force_probe", device=device))
 
 
 # ----------------------------------------------------------------- kvstore

@@ -171,6 +171,24 @@ class ServingConfig:
 
 
 @dataclass
+class ParallelConfig:
+    """Multi-chip data-parallel dispatch knobs (openr_tpu.parallel,
+    net-new vs the reference): the DevicePool that owns the live-device
+    set and shards compute batches across healthy chips.  See
+    docs/Robustness.md §"Per-device health governance"."""
+
+    enabled: bool = True
+    #: cap the pool at the first N visible jax devices (0 = all).
+    #: Requesting more than exist fails fast at pool construction.
+    max_devices: int = 0
+    #: minimum batch rows PER HEALTHY DEVICE before a dispatch shards
+    #: across the pool; below it one device wins (dispatch overhead and
+    #: per-shape compiles dominate tiny shards).  0 = always shard when
+    #: more than one chip is healthy.
+    min_shard_rows: int = 128
+
+
+@dataclass
 class ResilienceConfig:
     """Resilient-compute-plane knobs (openr_tpu.resilience, net-new vs
     the reference): the BackendHealthGovernor's shadow-verification
@@ -195,6 +213,12 @@ class ResilienceConfig:
     jitter_pct: float = 0.1
     #: seeds the deterministic jitter RNG (chaos reproducibility)
     seed: int = 0
+    #: govern health PER DEVICE when the pool has more than one chip:
+    #: sampled shard outputs are RIB-diffed per chip, a mismatching
+    #: chip is quarantined individually (its shard re-packs onto the
+    #: survivors) and recovers via its own probed breaker.  False
+    #: collapses to the PR-5 whole-backend latch.
+    per_device: bool = True
 
 
 @dataclass
@@ -283,6 +307,7 @@ class OpenrConfig:
     tracing_config: TracingConfig = field(default_factory=TracingConfig)
     serving_config: ServingConfig = field(default_factory=ServingConfig)
     resilience_config: ResilienceConfig = field(default_factory=ResilienceConfig)
+    parallel_config: ParallelConfig = field(default_factory=ParallelConfig)
     originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
     segment_routing_config: SegmentRoutingConfig = field(
         default_factory=SegmentRoutingConfig
@@ -359,6 +384,11 @@ class OpenrConfig:
             raise ValueError(
                 "resilience needs 0 < probe_backoff_initial_s <= "
                 "probe_backoff_max_s and 0 <= jitter_pct < 1"
+            )
+        p = self.parallel_config
+        if p.max_devices < 0 or p.min_shard_rows < 0:
+            raise ValueError(
+                "parallel needs max_devices >= 0 and min_shard_rows >= 0"
             )
         from openr_tpu.lsdb_codec import WIRE_FORMATS
 

@@ -525,24 +525,40 @@ class OpenrCtrlHandler:
 
         return node_resilience_status(self.node)
 
-    def force_quarantine(self, reason: str = "operator") -> dict:
+    def force_quarantine(
+        self, reason: str = "operator", device: Optional[int] = None
+    ) -> dict:
         """Operator drain of a sick accelerator: quarantine the device
         backend NOW — route builds, serving, and what-if all degrade to
         the scalar engines until `force_probe` (verified) or a config
-        restart.  Raises on scalar-only deployments."""
+        restart.  With ``device``, drain ONE chip of the pool: its
+        shard re-packs onto the survivors and the node keeps serving on
+        the rest.  Raises on scalar-only deployments."""
         gov = getattr(self.node.decision.backend, "governor", None)
         if gov is None:
             raise ValueError(
                 "no device backend governor on this node (scalar "
                 "deployment, or resilience disabled)"
             )
-        gov.force_quarantine(reason=f"operator:{reason}" if reason else "operator")
+        why = f"operator:{reason}" if reason else "operator"
+        if device is not None:
+            dev = gov.resolve_device_index(int(device))
+            if dev is None:
+                raise ValueError(
+                    "per-device governance inactive on this node "
+                    "(single-chip pool or per_device=False)"
+                )
+            gov.force_quarantine_device(dev, reason=why)
+        else:
+            gov.force_quarantine(reason=why)
         return self.get_resilience_status()
 
-    def force_probe(self) -> dict:
+    def force_probe(self, device: Optional[int] = None) -> dict:
         """Run one shadow-verified probe solve against the live LSDB
-        right now; a pass restores a quarantined device.  Returns the
-        probe outcome plus the refreshed status."""
+        right now; a pass restores a quarantined device.  With
+        ``device``, probe ONE chip (a quarantined chip earns its way
+        back via its own verified probe shard).  Returns the probe
+        outcome plus the refreshed status."""
         d = self.node.decision
         gov = getattr(d.backend, "governor", None)
         if gov is None:
@@ -550,7 +566,11 @@ class OpenrCtrlHandler:
                 "no device backend governor on this node (scalar "
                 "deployment, or resilience disabled)"
             )
-        result = gov.probe_now(d.area_link_states, d.prefix_state)
+        result = gov.probe_now(
+            d.area_link_states,
+            d.prefix_state,
+            device_index=None if device is None else int(device),
+        )
         return {"probe": result, "status": self.get_resilience_status()}
 
     def get_route_detail_db(self) -> List[dict]:

@@ -613,11 +613,21 @@ class Decision(Actor):
             for prefix, entries in self.prefix_state.prefixes().items()
         }
 
+    def _backend_pool(self):
+        """The backend's DevicePool when multi-chip dispatch is active
+        — the fleet/what-if engines then spread their batches over the
+        same health-governed chips route builds use (a quarantined
+        chip serves no computed-result queries either)."""
+        fn = getattr(self.backend, "dispatch_pool", None)
+        return fn() if fn is not None else None
+
     def _fleet(self):
         if self._fleet_engine is None:
             from openr_tpu.decision.fleet import FleetRibEngine
 
-            self._fleet_engine = FleetRibEngine(self.solver)
+            self._fleet_engine = FleetRibEngine(
+                self.solver, pool=self._backend_pool()
+            )
         return self._fleet_engine
 
     def device_available(self) -> bool:
@@ -838,7 +848,7 @@ class Decision(Actor):
                 )
 
                 self._whatif_multi_engine = MultiAreaWhatIfEngine(
-                    self.solver
+                    self.solver, pool=self._backend_pool()
                 )
             engine = self._whatif_multi_engine
             engine_name = "multiarea"

@@ -39,18 +39,25 @@ ROOT_CHUNK = 1024
 class FleetRibEngine:
     """Caches all-roots selection tables per LSDB change generation."""
 
-    def __init__(self, solver: SpfSolver, mesh=None) -> None:
+    def __init__(self, solver: SpfSolver, mesh=None, pool=None) -> None:
         """``mesh``: optional ``jax.sharding.Mesh`` with a ``batch``
         axis — the vantage-root batch then shards across the mesh
         (ops.fleet_tables.sharded_fleet_tables), bit-identical to the
-        single-device kernel."""
+        single-device kernel.  ``pool``: optional
+        :class:`~openr_tpu.parallel.mesh.DevicePool` — root chunks then
+        spread as committed per-device dispatches over the pool's
+        HEALTHY chips (the health-governed data-parallel path: a
+        quarantined chip's share re-packs onto the survivors on the
+        next solve, with no shard_map requirement)."""
         self.solver = solver  # settings template (v4 flags, labels, algo)
         self.mesh = mesh
+        self.pool = pool
         self._cache_key = None
         self._state = None  # dict of cached tables + decode context
         self._ksp2_scan = None  # (change_seq, result)
         self.num_batched_solves = 0
         self.num_decodes = 0
+        self.num_pool_dispatches = 0
 
     # -- eligibility -------------------------------------------------------
 
@@ -145,13 +152,36 @@ class FleetRibEngine:
             dev = {k: jax.device_put(v, rep) for k, v in dev.items()}
             fleet_fn = sharded_fleet_tables(self.mesh, D, per_area)
             roots_sh = batch_sharding(self.mesh)
+        # pool path (no shard_map needed): root chunks spread round-robin
+        # over the pool's HEALTHY chips as committed per-device
+        # dispatches — a quarantined chip's share re-packs onto the
+        # survivors on the next solve
+        pool_devs = None
+        chunk_rows = ROOT_CHUNK
+        per_dev_args: dict = {}
+        if self.mesh is None and self.pool is not None:
+            healthy = self.pool.healthy_indices()
+            if len(healthy) > 1:
+                pool_devs = healthy
+                chunk_rows = min(
+                    ROOT_CHUNK, max(32, -(-B // len(healthy)))
+                )
+
+        def args_on(idx):
+            if idx not in per_dev_args:
+                d = self.pool.device(idx)
+                per_dev_args[idx] = {
+                    k: jax.device_put(v, d) for k, v in dev.items()
+                }
+            return per_dev_args[idx]
+
         # dispatch every root chunk, then fetch ALL of them with one
         # device_get (async-copies each leaf before blocking): the whole
         # fleet build costs a single overlapped host round trip instead
-        # of one per ROOT_CHUNK
+        # of one per chunk
         pending: list = []
-        for off in range(0, B, ROOT_CHUNK):
-            chunk = roots_mat[off : off + ROOT_CHUNK]
+        for off in range(0, B, chunk_rows):
+            chunk = roots_mat[off : off + chunk_rows]
             b = 1 << max(5, (len(chunk) - 1).bit_length())  # pow2 bucket
             b = ((b + mesh_n - 1) // mesh_n) * mesh_n  # whole device shards
             padded = np.full((b, A), -1, np.int32)
@@ -175,6 +205,18 @@ class FleetRibEngine:
                     dev["distance"],
                     dev["cand_node_in_area"],
                 )
+            elif pool_devs is not None:
+                idx = pool_devs[(off // chunk_rows) % len(pool_devs)]
+                out = call_jit_guarded(
+                    fleet_multi_area_tables,
+                    roots=jax.device_put(
+                        jnp.asarray(padded), self.pool.device(idx)
+                    ),
+                    max_degree=D,
+                    per_area_distance=per_area,
+                    **args_on(idx),
+                )
+                self.num_pool_dispatches += 1
             else:
                 out = call_jit_guarded(
                     fleet_multi_area_tables,

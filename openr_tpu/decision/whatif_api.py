@@ -413,17 +413,23 @@ class MultiAreaWhatIfEngine:
     failures plus one base snapshot as a single device batch and decodes
     only the prefixes whose merged route view changed."""
 
-    def __init__(self, solver: SpfSolver, mesh=None) -> None:
+    def __init__(self, solver: SpfSolver, mesh=None, pool=None) -> None:
         """``mesh``: optional ``jax.sharding.Mesh`` with a ``batch``
         axis — failure snapshots then shard across the mesh
         (ops.fleet_tables.sharded_whatif_tables), bit-identical to the
-        unsharded kernel."""
+        unsharded kernel.  ``pool``: optional
+        :class:`~openr_tpu.parallel.mesh.DevicePool` — the failure
+        batch then splits contiguously over the pool's HEALTHY chips as
+        committed per-device dispatches (no shard_map requirement; a
+        quarantined chip's share re-packs onto the survivors)."""
         self.solver = solver
         self.mesh = mesh
+        self.pool = pool
         self._cache_key = None
         self._state = None
         self.num_engine_builds = 0
         self.num_sweeps = 0
+        self.num_pool_dispatches = 0
 
     def _context(self, area_link_states, prefix_state, change_seq):
         import numpy as np
@@ -592,17 +598,75 @@ class MultiAreaWhatIfEngine:
                 )
             )
         else:
-            use, shortest, lanes, valid = jax.device_get(
-                call_jit_guarded(
-                    whatif_multi_area_tables,
-                    fail_area=jnp.asarray(fa),
-                    fail_link=jnp.asarray(fl),
-                    max_degree=st["D"],
-                    per_area_distance=per_area,
-                    **kernel_args,
-                    **cand_args,
+            pool_devs = None
+            if self.pool is not None and B >= 2:
+                healthy = self.pool.healthy_indices()
+                if len(healthy) > 1:
+                    pool_devs = healthy
+            if pool_devs is not None:
+                # data-parallel over the pool: contiguous failure-row
+                # shards, one committed dispatch per healthy chip, each
+                # with its own -1 pad row (the pad row solves the
+                # unperturbed topology, so every shard carries a base —
+                # the first shard's is the one the decode diffs against)
+                shards = self.pool.shard_ranges(B, pool_devs)
+                dispatched = []
+                for idx, lo, hi in shards:
+                    n_i = hi - lo
+                    bucket_i = bucket_for(
+                        n_i + 1,
+                        FAILURE_BUCKETS
+                        + (max(n_i + 1, FAILURE_BUCKETS[-1]),),
+                    )
+                    fa_i = np.full((bucket_i, S), -1, np.int32)
+                    fl_i = np.full((bucket_i, S), -1, np.int32)
+                    fa_i[:n_i] = fa[lo:hi]
+                    fl_i[:n_i] = fl[lo:hi]
+                    d = self.pool.device(idx)
+                    out = call_jit_guarded(
+                        whatif_multi_area_tables,
+                        fail_area=jax.device_put(jnp.asarray(fa_i), d),
+                        fail_link=jax.device_put(jnp.asarray(fl_i), d),
+                        max_degree=st["D"],
+                        per_area_distance=per_area,
+                        **{
+                            k: jax.device_put(v, d)
+                            for k, v in kernel_args.items()
+                        },
+                        **{
+                            k: jax.device_put(v, d)
+                            for k, v in cand_args.items()
+                        },
+                    )
+                    dispatched.append((n_i, out))
+                    self.num_pool_dispatches += 1
+                fetched = jax.device_get([o for _n, o in dispatched])
+                parts = []
+                for k in range(4):
+                    rows = [
+                        outs[k][:n]
+                        for (n, _), outs in zip(dispatched, fetched)
+                    ]
+                    # base snapshot: the FIRST shard's pad row, placed
+                    # at index B exactly where the unsharded layout
+                    # puts it (all shards' pad rows are bit-identical —
+                    # same kernel, same unperturbed inputs)
+                    n0 = dispatched[0][0]
+                    rows.append(fetched[0][k][n0 : n0 + 1])
+                    parts.append(np.concatenate(rows, axis=0))
+                use, shortest, lanes, valid = parts
+            else:
+                use, shortest, lanes, valid = jax.device_get(
+                    call_jit_guarded(
+                        whatif_multi_area_tables,
+                        fail_area=jnp.asarray(fa),
+                        fail_link=jnp.asarray(fl),
+                        max_degree=st["D"],
+                        per_area_distance=per_area,
+                        **kernel_args,
+                        **cand_args,
+                    )
                 )
-            )
         if st["base_dist"] is None:
             dist, _nh = call_jit_guarded(
                 multi_area_spf_tables,

@@ -329,6 +329,7 @@ class OpenrNode:
                 counters=self.counters,
                 tracer=self.tracer,
                 resilience=config.resilience_config,
+                parallel=config.parallel_config,
             )
             if use_tpu
             else ScalarBackend(solver)

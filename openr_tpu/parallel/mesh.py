@@ -11,7 +11,7 @@ runs over ICI/DCN unchanged.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -30,11 +30,39 @@ def shard_map_supported() -> bool:
     return hasattr(jax, "shard_map")
 
 
-def make_mesh(num_devices: Optional[int] = None) -> Mesh:
-    devices = jax.devices()
+def make_mesh(
+    num_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """One-axis (``batch``) device mesh.
+
+    ``devices`` pins an explicit placement (survivor meshes after a
+    chip quarantine, tests that must land on specific chips); else the
+    first ``num_devices`` of ``jax.devices()`` are taken.  Requesting
+    more devices than exist raises instead of silently truncating —
+    a survivor mesh built on a miscounted pool would shard onto chips
+    the health governor never verified."""
+    if devices is not None:
+        devices = list(devices)
+        if num_devices is not None and num_devices != len(devices):
+            raise ValueError(
+                f"num_devices={num_devices} contradicts the explicit "
+                f"devices sequence of length {len(devices)}"
+            )
+        if not devices:
+            raise ValueError("make_mesh needs at least one device")
+        return Mesh(np.array(devices), (BATCH_AXIS,))
+    avail = jax.devices()
     if num_devices is not None:
-        devices = devices[:num_devices]
-    return Mesh(np.array(devices), (BATCH_AXIS,))
+        if num_devices < 1:
+            raise ValueError(f"num_devices must be >= 1, got {num_devices}")
+        if num_devices > len(avail):
+            raise ValueError(
+                f"requested num_devices={num_devices} but only "
+                f"{len(avail)} jax devices are available"
+            )
+        avail = avail[:num_devices]
+    return Mesh(np.array(avail), (BATCH_AXIS,))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -71,6 +99,157 @@ def shard_batch(mesh: Mesh, *arrays):
             a = np.concatenate([a, pad], axis=0)
         out.append(jax.device_put(a, sh))
     return tuple(out) if len(out) > 1 else out[0]
+
+
+class DevicePool:
+    """The live-device set for data-parallel dispatch — per-device
+    failure domains made first-class.
+
+    The mesh-collective kernels (shard_map) treat the device set as one
+    opaque computer: a single sick chip corrupts the collective output
+    with no way to say WHICH chip lied.  The pool instead models each
+    device as an individually health-governed shard owner: work batches
+    split into contiguous per-device shards, each dispatched as its own
+    committed computation on its own chip, so every output row is
+    attributable to exactly one device — the property the
+    BackendHealthGovernor's per-chip shadow verification and quarantine
+    are built on.
+
+    Health writes (``quarantine_device`` / ``restore_device``) are
+    owned by the resilience plane (the governor) and chaos — enforced
+    statically by orlint's ``resilience-latch`` rule, exactly like the
+    whole-backend ``device_failed`` latch.  Everything else reads.
+    """
+
+    def __init__(
+        self,
+        devices: Optional[Sequence] = None,
+        max_devices: int = 0,
+    ) -> None:
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        if max_devices and max_devices > len(devices):
+            raise ValueError(
+                f"max_devices={max_devices} exceeds the {len(devices)} "
+                "visible jax devices"
+            )
+        if max_devices:
+            devices = devices[:max_devices]
+        if not devices:
+            raise ValueError("DevicePool needs at least one device")
+        self.devices: List = devices
+        self._healthy: List[bool] = [True] * len(devices)
+        self.num_quarantines = 0
+        self.num_restores = 0
+
+    # -- read surface ------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def num_healthy(self) -> int:
+        return sum(self._healthy)
+
+    def is_healthy(self, index: int) -> bool:
+        return self._healthy[index]
+
+    def healthy_indices(self) -> List[int]:
+        return [i for i, ok in enumerate(self._healthy) if ok]
+
+    def quarantined_indices(self) -> List[int]:
+        return [i for i, ok in enumerate(self._healthy) if not ok]
+
+    def device(self, index: int):
+        return self.devices[index]
+
+    def healthy_mask(self) -> List[bool]:
+        return list(self._healthy)
+
+    def lead_index(self) -> Optional[int]:
+        """Lowest-indexed healthy device (single-device dispatch target);
+        None when every chip is quarantined."""
+        for i, ok in enumerate(self._healthy):
+            if ok:
+                return i
+        return None
+
+    # -- health mutators (resilience/chaos-owned; orlint-enforced) ---------
+
+    def quarantine_device(self, index: int) -> bool:
+        """Mark one chip unhealthy; shard packing re-packs onto the
+        survivors from the next dispatch on.  Returns True when the
+        mask actually flipped."""
+        if not self._healthy[index]:
+            return False
+        self._healthy[index] = False
+        self.num_quarantines += 1
+        return True
+
+    def restore_device(self, index: int) -> bool:
+        if self._healthy[index]:
+            return False
+        self._healthy[index] = True
+        self.num_restores += 1
+        return True
+
+    # -- shard packing -----------------------------------------------------
+
+    def shard_ranges(
+        self, n_rows: int, indices: Optional[Sequence[int]] = None
+    ) -> List[Tuple[int, int, int]]:
+        """Deterministic contiguous packing of ``n_rows`` over the given
+        device indices (default: the healthy set): ``(device_index,
+        row_lo, row_hi)`` per shard, even split with the remainder on
+        the leading shards.  Devices that would receive zero rows are
+        dropped, so tiny batches never pay empty dispatches."""
+        if indices is None:
+            indices = self.healthy_indices()
+        indices = list(indices)
+        if not indices:
+            raise ValueError("shard_ranges: no devices to pack onto")
+        n_dev = len(indices)
+        base, rem = divmod(n_rows, n_dev)
+        out: List[Tuple[int, int, int]] = []
+        lo = 0
+        for k, dev in enumerate(indices):
+            hi = lo + base + (1 if k < rem else 0)
+            if hi > lo:
+                out.append((dev, lo, hi))
+            lo = hi
+        return out
+
+    def survivor_mesh(self) -> Optional[Mesh]:
+        """Mesh over the CURRENT healthy set for the shard_map-collective
+        engines; None when the stable ``jax.shard_map`` is unavailable
+        or fewer than two chips survive (the collective path needs a
+        real mesh to beat per-device dispatch)."""
+        healthy = [self.devices[i] for i in self.healthy_indices()]
+        if len(healthy) < 2 or not shard_map_supported():
+            return None
+        return make_mesh(devices=healthy)
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "size": self.size,
+            "num_healthy": self.num_healthy,
+            "healthy_mask": self.healthy_mask(),
+            "quarantines": self.num_quarantines,
+            "restores": self.num_restores,
+            "devices": [str(d) for d in self.devices],
+        }
+
+    def counter_snapshot(self, prefix: str = "parallel.pool") -> dict:
+        return {
+            f"{prefix}.size": float(self.size),
+            f"{prefix}.healthy": float(self.num_healthy),
+            f"{prefix}.quarantines": float(self.num_quarantines),
+            f"{prefix}.restores": float(self.num_restores),
+        }
 
 
 def sharded_spf_and_select(mesh: Mesh, max_degree: int):

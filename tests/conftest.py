@@ -36,6 +36,32 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serving: query-serving-plane test (openr_tpu.serving)"
     )
+    config.addinivalue_line(
+        "markers",
+        "multichip: multi-device pool/mesh test (openr_tpu.parallel)",
+    )
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """One skip-reason summary line per run: silent version-gated skips
+    (e.g. the 7 ``jax.shard_map`` tests) used to vanish into the bare
+    skip count — this line makes a jax upgrade that un-skips them (or a
+    regression that skips more) visible in CI logs."""
+    skipped = terminalreporter.stats.get("skipped", [])
+    if not skipped:
+        return
+    reasons = {}
+    for rep in skipped:
+        reason = rep.longrepr[2] if isinstance(rep.longrepr, tuple) else str(
+            rep.longrepr
+        )
+        reason = reason.removeprefix("Skipped: ")
+        reasons[reason] = reasons.get(reason, 0) + 1
+    summary = "; ".join(
+        f"{n}x {reason!r}"
+        for reason, n in sorted(reasons.items(), key=lambda kv: (-kv[1], kv[0]))
+    )
+    terminalreporter.write_line(f"skip reasons: {summary}")
 
 
 @pytest.fixture

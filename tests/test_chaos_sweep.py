@@ -42,6 +42,10 @@ async def _sweep(seed: int) -> dict:
         edges=[(a, b) for a, b, _ in edges],
         num_faults=8,
         horizon_s=50.0,
+        # half the tpu faults draw a per-chip device_index, exercising
+        # the per-device quarantine/re-pack/probe path under the sweep
+        # (scalar-backend nodes fall back to the whole-backend latch)
+        num_devices=8,
     )
     checker = InvariantChecker(net)
     controller = ChaosController(net, plan, seed=seed)

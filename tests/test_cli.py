@@ -253,6 +253,32 @@ def test_cli_resilience_quarantine_and_probe_tpu_node():
         assert {"probed"} <= set(probe["probe"])
 
 
+def test_cli_resilience_per_device_verbs():
+    """force-quarantine/force-probe --device drive ONE chip of the pool
+    through the ctrl verbs; status renders the per-device rows."""
+    with _live_ctrl_node(num_nodes=2, use_tpu_backend=True) as port:
+        after = json.loads(
+            _run(
+                port, "resilience", "force-quarantine",
+                "--reason", "chipdrill", "--device", "2",
+            )
+        )
+        dev = after["device_backend"]
+        # one chip drained: the node-level latch stays DOWN
+        assert not dev["quarantined"]
+        assert dev["pool"]["num_healthy"] == dev["pool"]["size"] - 1
+        rows = {r["device"]: r for r in dev["devices"]}
+        assert rows[2]["healthy"] is False and rows[2]["injected"]
+        assert "operator:chipdrill" in rows[2]["reason"]
+        table = _run(port, "resilience", "status")
+        assert "devices healthy" in table
+        assert "dev2: QUARANTINED" in table
+        probe = json.loads(
+            _run(port, "resilience", "force-probe", "--device", "2")
+        )
+        assert "probe" in probe and {"probed"} <= set(probe["probe"])
+
+
 def test_cli_kvstore_snoop_snapshot(live_node):
     out = _run(
         live_node,

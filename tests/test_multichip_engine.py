@@ -12,6 +12,8 @@ that, including non-multiple batch sizes that ride the bucket padding.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.multichip
+
 from openr_tpu.decision.link_state import LinkState
 from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.emulation.topology import build_adj_dbs, grid_edges

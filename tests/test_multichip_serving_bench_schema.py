@@ -1,0 +1,62 @@
+"""Tier-1 smoke: the checked-in BENCH_MULTICHIP_SERVING artifact obeys
+the schema the bench emits (shared validator —
+bench.validate_multichip_serving_bench) and holds the ISSUE-6
+acceptance shape: serving-throughput rounds at 1/2/4/8 host devices
+plus a 7-of-8 degraded round in which one chip is quarantined and the
+serving plane KEEPS answering on the survivors
+(`serving_stayed_available`, `device_failed` false).
+
+The validator lives in bench.py so the emitter and this gate can never
+drift apart; regenerate the artifact with
+`python bench.py --multichip-serving`.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+import bench
+
+pytestmark = [pytest.mark.serving, pytest.mark.multichip]
+
+ARTIFACT = pathlib.Path(__file__).resolve().parent.parent / (
+    "BENCH_MULTICHIP_SERVING_r01.json"
+)
+
+
+def test_artifact_exists_and_matches_schema():
+    doc = json.loads(ARTIFACT.read_text())
+    bench.validate_multichip_serving_bench(doc)
+
+
+def test_degraded_round_kept_serving_on_survivors():
+    doc = json.loads(ARTIFACT.read_text())
+    deg = doc["detail"]["degraded_7of8"]
+    assert deg["healthy_devices"] == 7
+    assert deg["serving_stayed_available"] is True
+    assert deg["device_failed"] is False
+    # the 7-of-8 pool must not collapse to scalar-fallback throughput:
+    # within 2x of the full-pool round (generous — virtual host devices
+    # share physical cores, so this is a structural bound, not a perf
+    # claim)
+    r8 = next(r for r in doc["detail"]["rounds"] if r["devices"] == 8)
+    assert deg["qps"] >= r8["qps"] / 2.0
+
+
+def test_environment_triple_is_recorded():
+    """The ISSUE-6 satellite: every BENCH artifact pins platform, jax
+    version, and device count so perf points are comparable across
+    environments."""
+    doc = json.loads(ARTIFACT.read_text())
+    env = doc["detail"]["env"]
+    assert env["platform"]
+    assert env["jax"]
+    assert env["device_count"] >= 8
+
+
+def test_validator_rejects_malformed_doc():
+    doc = json.loads(ARTIFACT.read_text())
+    doc["detail"]["degraded_7of8"]["serving_stayed_available"] = False
+    with pytest.raises(AssertionError):
+        bench.validate_multichip_serving_bench(doc)

@@ -364,6 +364,45 @@ def test_resilience_latch_owners_are_exempt(rel):
     assert analyze_modules(mods).findings == []
 
 
+def test_resilience_latch_pool_mutators_trip():
+    """The per-device quarantine-mask mutators (DevicePool, ISSUE 6) are
+    governor-owned exactly like the whole-backend latch."""
+    src = "def drain(pool):\n    pool.quarantine_device(3)\n"
+    assert [f.rule for f in analyze_source(src)] == ["resilience-latch"]
+    src2 = "def heal(pool):\n    pool.restore_device(3)\n"
+    assert [f.rule for f in analyze_source(src2)] == ["resilience-latch"]
+
+
+def test_resilience_latch_pool_reads_and_governor_api_are_clean():
+    """Health READS and the governor's counted/probed per-chip API are
+    exactly what everyone else is supposed to use."""
+    src = (
+        "def watch(pool, gov):\n"
+        "    gov.force_quarantine_device(1, reason='drain')\n"
+        "    gov.request_probe_device(1)\n"
+        "    return pool.healthy_indices(), pool.is_healthy(1)\n"
+    )
+    assert analyze_source(src) == []
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        "openr_tpu/parallel/mesh.py",
+        "openr_tpu/resilience/governor.py",
+        "openr_tpu/chaos/controller.py",
+    ],
+)
+def test_resilience_latch_pool_owners_are_exempt(rel):
+    src = (
+        "def flip(pool):\n"
+        "    pool.quarantine_device(0)\n"
+        "    pool.restore_device(0)\n"
+    )
+    mods = [ParsedModule.parse(rel, src)]
+    assert analyze_modules(mods).findings == []
+
+
 # ---------------------------------------------------------------------------
 # baseline machinery
 # ---------------------------------------------------------------------------

@@ -109,6 +109,42 @@ def test_breaker_concurrent_probe_exclusion():
     assert br.allow_request()  # closed again
 
 
+def test_breaker_probe_exclusion_under_concurrent_callers():
+    """Two ACTORS racing a half-open breaker (satellite, ISSUE 6): both
+    wake at the same virtual instant once the hold elapses; exactly one
+    wins the probe slot, the loser short-circuits — deterministically
+    under SimClock (wake order is the deterministic sleep-registration
+    order, so replays are byte-identical)."""
+
+    async def main():
+        clock = SimClock()
+        br = make_breaker(clock)
+        for _ in range(3):
+            br.record_failure()
+        assert br.state == STATE_OPEN
+        outcomes = {}
+
+        async def caller(name):
+            await clock.sleep(2.0)  # both due at the same virtual time
+            outcomes[name] = br.allow_request()
+
+        t1 = asyncio.ensure_future(caller("a"))
+        t2 = asyncio.ensure_future(caller("b"))
+        await clock.run_for(3.0)
+        await asyncio.gather(t1, t2)
+        # exactly ONE probe admitted; the loser short-circuited
+        assert sorted(outcomes.values()) == [False, True]
+        assert br.state == STATE_HALF_OPEN
+        assert br.num_probes == 1 and br.num_short_circuits == 1
+        # deterministic winner: sleep-registration order
+        assert outcomes["a"] is True and outcomes["b"] is False
+        # the probe resolves; admission reopens for everyone
+        br.record_success()
+        assert br.allow_request()
+
+    run(main())
+
+
 def test_breaker_release_probe_is_unscored():
     clock = SimClock()
     br = make_breaker(clock)
