@@ -462,6 +462,321 @@ def resilience_main() -> None:
     print(json.dumps(doc))
 
 
+PIPELINE_DEVICES = (1, 8)
+PIPELINE_REBUILDS = 3
+PIPELINE_GAP_BOUND_PCT = 10.0
+
+
+def validate_pipeline_bench(doc: dict) -> None:
+    """Schema contract for BENCH_PIPELINE_r*.json — shared by the bench
+    emitter and the tier-1 smoke test (tests/test_pipeline_bench_schema).
+
+    The headline value is the UNATTRIBUTED GAP on the grid4096 full
+    rebuild: the fraction of measured end-to-end wall time NOT covered
+    by a `pipeline.{phase}.ms` sample.  The ISSUE-7 acceptance bound is
+    <= 10% — below that, the per-phase table is trustworthy enough to
+    baseline the pipelining refactor against."""
+    from openr_tpu.tracing.pipeline import PAD_PACK, PHASES
+
+    assert doc["metric"] == "pipeline_attribution_gap_pct_grid4096_rebuild"
+    assert doc["unit"] == "pct_of_rebuild_wall"
+    assert isinstance(doc["value"], (int, float))
+    assert abs(doc["value"]) <= PIPELINE_GAP_BOUND_PCT
+    d = doc["detail"]
+    rounds = d["rebuild_rounds"]
+    assert [r["devices"] for r in rounds] == list(PIPELINE_DEVICES)
+    for r in rounds:
+        assert r["rebuilds"] >= 2
+        assert r["wall_ms"] > 0
+        assert abs(r["gap_pct"]) <= PIPELINE_GAP_BOUND_PCT
+        assert r["attributed_ms"] > 0
+        phases = r["phases_ms"]
+        assert set(phases) <= set(PHASES)
+        # a full rebuild exercises the whole lifecycle: every phase
+        # must have recorded real time (delta_extract rides the diff).
+        # Exception: the 1-device legacy dispatch has no shard packing,
+        # so pad_pack legitimately records nothing there.
+        required = set(PHASES)
+        if r["devices"] == 1:
+            required.discard(PAD_PACK)
+        for phase in sorted(required):
+            assert phases.get(phase, 0.0) > 0.0, f"phase {phase} empty"
+        assert 0.0 <= r["host_share_pct"] <= 100.0
+        assert abs(
+            r["host_share_pct"] + r["device_share_pct"] - 100.0
+        ) < 0.5
+        busy = r["per_chip_busy"]
+        assert len(busy) == r["devices"]
+        for row in busy.values():
+            assert row["busy_ms"] >= 0.0
+            assert 0.0 <= row["busy_fraction"] <= 1.5  # overlap-counted
+    for key in ("fleet_round", "whatif_round"):
+        eng = d[key]
+        assert eng["devices"] == PIPELINE_DEVICES[-1]
+        assert eng["wall_ms"] > 0
+        assert eng["phases_ms"]
+        assert set(eng["phases_ms"]) <= set(PHASES)
+        assert eng["pool_dispatches"] >= eng["devices"]
+    for key in ("world", "env", "mode"):
+        assert key in d, key
+    for key in ("platform", "jax", "device_count"):
+        assert key in d["env"], f"env.{key}"
+    assert d["env"]["device_count"] >= 8
+
+
+def pipeline_main() -> None:
+    """Pipeline-attribution benchmark (BENCH_PIPELINE_r*): phase-level
+    accounting of the grid4096 full rebuild at 1 and 8 forced host
+    devices, plus fleet and what-if rounds over the 8-chip pool.
+
+    Methodology.  Each rebuild round drives PIPELINE_REBUILDS full
+    device builds (a link-metric flip between builds bumps the
+    topology seq, so every build re-encodes, re-solves the SPF tables
+    and re-runs selection — the true cold-rebuild lifecycle, not a
+    cache replay) and diffs each result against the previous RouteDb
+    (the delta_extract tail).  Wall time is measured around exactly
+    that window; attribution is the delta of every
+    `pipeline.{phase}.ms` histogram over the same window.  The
+    headline is the worst-round unattributed gap — the ISSUE-7
+    acceptance demands the phase table explain >= 90% of the wall.
+    Per-chip busy fractions come from the probe's busy ledger
+    (committed per-shard dispatch time + the blocking drain window
+    each chip had work outstanding in; on forced HOST devices chips
+    share physical cores, so fractions measure dispatch-plane
+    structure, not silicon occupancy).  The governor is disabled for
+    the measured rounds: shadow verification is a resilience cost,
+    priced separately in BENCH_RESILIENCE."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    from openr_tpu.ops.platform_env import (
+        enable_persistent_compile_cache,
+        fallback_to_cpu_if_unreachable,
+        honor_cpu_platform_request,
+    )
+
+    honor_cpu_platform_request()
+    fallback_to_cpu_if_unreachable()
+    enable_persistent_compile_cache()
+
+    from openr_tpu.common.runtime import CounterMap, WallClock
+    from openr_tpu.config import ParallelConfig, ResilienceConfig
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.fleet import FleetRibEngine
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.decision.whatif_api import MultiAreaWhatIfEngine
+    from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+    from openr_tpu.tracing import pipeline
+    from openr_tpu.types import PrefixEntry
+
+    side = 64  # grid4096: the ROADMAP's canonical scale point
+    edges = grid_edges(side)
+    adj_dbs = build_adj_dbs(edges)
+    ls = LinkState("0")
+    for db in adj_dbs.values():
+        ls.update_adjacency_database(db)
+    n_nodes = side * side
+    ps = PrefixState()
+    for i in range(n_nodes):
+        ps.update_prefix(
+            f"node{i}",
+            "0",
+            PrefixEntry(f"10.{(i >> 8) & 0xFF}.{i & 0xFF}.0/24"),
+        )
+    als = {"0": ls}
+    flip_db = adj_dbs["node0"]
+
+    def flip_topology(step: int) -> None:
+        # alternate one adjacency metric: a real topology change, so
+        # the encode cache and the device SPF tables must rebuild
+        for adj in flip_db.adjacencies:
+            adj.metric = 1 + (step % 2)
+        ls.update_adjacency_database(flip_db)
+
+    def fresh_backend(num_devices: int) -> TpuBackend:
+        return TpuBackend(
+            SpfSolver("node0"),
+            min_device_prefixes=0,  # always device
+            clock=WallClock(),
+            counters=CounterMap(),
+            resilience=ResilienceConfig(enabled=False),
+            parallel=ParallelConfig(
+                max_devices=num_devices, min_shard_rows=0
+            ),
+        )
+
+    def phase_totals(counters: CounterMap) -> dict:
+        out = {}
+        for phase in pipeline.PHASES:
+            h = counters.histogram(pipeline.hist_key(phase))
+            if h is not None:
+                out[phase] = h.total
+        return out
+
+    def rebuild_round(num_devices: int) -> dict:
+        backend = fresh_backend(num_devices)
+        probe = backend.probe
+        counters = probe.counters
+        flip_topology(0)
+        prev = backend.build_route_db(als, ps, force_full=True)  # warm
+        t0_phase = phase_totals(counters)
+        t0_busy = probe.busy_snapshot()
+        walls = []
+        t_round = time.perf_counter()
+        for step in range(1, PIPELINE_REBUILDS + 1):
+            flip_topology(step)
+            t0 = time.perf_counter()
+            db = backend.build_route_db(als, ps, force_full=True)
+            with probe.phase(pipeline.DELTA_EXTRACT):
+                update = prev.calculate_update(db)
+            walls.append((time.perf_counter() - t0) * 1000.0)
+            assert not update.empty()  # the metric flip moved routes
+            prev = db
+        wall_ms = (time.perf_counter() - t_round) * 1000.0
+        t1_phase = phase_totals(counters)
+        t1_busy = probe.busy_snapshot()
+        phases_ms = {
+            k: round(t1_phase.get(k, 0.0) - t0_phase.get(k, 0.0), 3)
+            for k in pipeline.PHASES
+            if t1_phase.get(k, 0.0) - t0_phase.get(k, 0.0) > 0.0
+        }
+        attributed = sum(phases_ms.values())
+        host_ms = sum(
+            phases_ms.get(p, 0.0) for p in pipeline.HOST_PHASES
+        )
+        device_ms = sum(
+            phases_ms.get(p, 0.0) for p in pipeline.DEVICE_PHASES
+        )
+        per_chip = {}
+        for dev in range(num_devices):
+            busy = t1_busy.get(dev, 0.0) - t0_busy.get(dev, 0.0)
+            per_chip[f"dev{dev}"] = {
+                "busy_ms": round(busy, 3),
+                "busy_fraction": round(busy / wall_ms, 4),
+            }
+        return {
+            "devices": num_devices,
+            "rebuilds": PIPELINE_REBUILDS,
+            "wall_ms": round(wall_ms, 3),
+            "rebuild_ms_each": [round(w, 3) for w in walls],
+            "attributed_ms": round(attributed, 3),
+            "gap_pct": round((wall_ms - attributed) / wall_ms * 100.0, 3),
+            "phases_ms": phases_ms,
+            "host_ms": round(host_ms, 3),
+            "device_ms": round(device_ms, 3),
+            "host_share_pct": round(host_ms / attributed * 100.0, 2),
+            "device_share_pct": round(device_ms / attributed * 100.0, 2),
+            "per_chip_busy": per_chip,
+            "routes": len(prev.unicast_routes),
+        }
+
+    def engine_round(kind: str) -> dict:
+        # fleet/what-if attribution rides a 256-node world: the point
+        # is phase coverage of the pooled dispatch paths, and a
+        # 4096-root fleet batch (4096 SPF solves) would turn the bench
+        # into a soak on host devices
+        eside = 16
+        e_edges = grid_edges(eside)
+        e_ls = LinkState("0")
+        for db in build_adj_dbs(e_edges).values():
+            e_ls.update_adjacency_database(db)
+        e_ps = PrefixState()
+        for i in range(eside * eside):
+            e_ps.update_prefix(
+                f"node{i}", "0", PrefixEntry(f"10.77.{i % 256}.0/24")
+            )
+        e_als = {"0": e_ls}
+        backend = fresh_backend(PIPELINE_DEVICES[-1])
+        probe = backend.probe
+        pool = backend.dispatch_pool()
+        assert pool is not None and pool.size == PIPELINE_DEVICES[-1]
+        solver = SpfSolver("node0")
+        if kind == "fleet":
+            eng = FleetRibEngine(solver, pool=pool, probe=probe)
+
+            def run_once(seq):
+                return eng.fleet_summary(e_als, e_ps, seq)
+        else:
+            eng = MultiAreaWhatIfEngine(solver, pool=pool, probe=probe)
+            failures = [
+                (f"node{i}", f"node{i + 1}") for i in range(0, 48)
+                if (i + 1) % eside  # same-row neighbors only
+            ]
+
+            def run_once(seq):
+                return eng.run(failures, e_als, e_ps, seq)
+
+        run_once(1)  # warm compile
+        t0_phase = phase_totals(probe.counters)
+        t0 = time.perf_counter()
+        run_once(2)  # fresh generation: tables rebuilt, real dispatches
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        t1_phase = phase_totals(probe.counters)
+        phases_ms = {
+            k: round(t1_phase.get(k, 0.0) - t0_phase.get(k, 0.0), 3)
+            for k in pipeline.PHASES
+            if t1_phase.get(k, 0.0) - t0_phase.get(k, 0.0) > 0.0
+        }
+        return {
+            "devices": PIPELINE_DEVICES[-1],
+            "world_nodes": eside * eside,
+            "wall_ms": round(wall_ms, 3),
+            "attributed_ms": round(sum(phases_ms.values()), 3),
+            "phases_ms": phases_ms,
+            "pool_dispatches": int(sum(pool.num_dispatches)),
+        }
+
+    rounds = [rebuild_round(n) for n in PIPELINE_DEVICES]
+    for r in rounds:
+        print(
+            f"# {r['devices']} device(s): wall {r['wall_ms']}ms, "
+            f"attributed {r['attributed_ms']}ms "
+            f"(gap {r['gap_pct']}%), host {r['host_share_pct']}%",
+            file=sys.stderr,
+        )
+    fleet_round = engine_round("fleet")
+    whatif_round = engine_round("whatif")
+    worst_gap = max((abs(r["gap_pct"]) for r in rounds), key=abs)
+    doc = {
+        "metric": "pipeline_attribution_gap_pct_grid4096_rebuild",
+        "value": worst_gap,
+        "unit": "pct_of_rebuild_wall",
+        "detail": {
+            "rebuild_rounds": rounds,
+            "fleet_round": fleet_round,
+            "whatif_round": whatif_round,
+            "world": {
+                "nodes": n_nodes,
+                "topology": f"grid{side}x{side}",
+                "prefixes": n_nodes,
+                "engine_world_nodes": 256,
+            },
+            "mode": (
+                "emulate (in-process LSDB, WallClock probe, 8 forced "
+                "virtual host devices sharing physical cores — per-chip "
+                "busy fractions measure dispatch-plane structure, not "
+                "silicon occupancy; device_get windows charge every "
+                "in-flight chip, so fractions can exceed wall share)"
+            ),
+            "gap_definition": (
+                "wall_ms measured around build_route_db(force_full) + "
+                "RouteDb diff; attributed_ms = delta of every "
+                "pipeline.{phase}.ms histogram total over the same "
+                "window; gap = (wall - attributed) / wall"
+            ),
+            "env": env_stamp(),
+        },
+    }
+    validate_pipeline_bench(doc)
+    print(json.dumps(doc))
+
+
 SERVING_CONCURRENCY = (1, 8, 64, 512)
 
 
@@ -1427,6 +1742,8 @@ if __name__ == "__main__":
         sys.exit(serving_main())
     if "--multichip-serving" in sys.argv[1:]:
         sys.exit(multichip_serving_main())
+    if "--pipeline" in sys.argv[1:]:
+        sys.exit(pipeline_main())
     if "--resilience" in sys.argv[1:]:
         sys.exit(resilience_main())
     sys.exit(main())

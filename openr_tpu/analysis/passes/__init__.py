@@ -13,6 +13,7 @@ from openr_tpu.analysis.passes.async_blocking import AsyncBlockingPass
 from openr_tpu.analysis.passes.base import Pass
 from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
 from openr_tpu.analysis.passes.jax_hygiene import JaxHygienePass
+from openr_tpu.analysis.passes.pipeline_phase import PipelinePhasePass
 from openr_tpu.analysis.passes.resilience_latch import ResilienceLatchPass
 
 
@@ -23,6 +24,7 @@ def make_passes():
         JaxHygienePass(),
         AsyncBlockingPass(),
         ResilienceLatchPass(),
+        PipelinePhasePass(),
     ]
 
 

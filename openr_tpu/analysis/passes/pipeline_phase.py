@@ -1,0 +1,73 @@
+"""Pipeline-phase registry discipline — ``pipeline.*`` names have ONE home.
+
+The pipeline attribution plane (``openr_tpu/tracing/pipeline.py``) is
+only useful if every phase sample lands under a name the dashboards,
+the bench schema gate, and the Prometheus exposition all agree on.  A
+free-spelled ``"pipeline.decod.ms"`` in some dispatch loop would record
+forever and alarm never.  So the registry module is the single place
+the ``pipeline.`` prefix may be spelled; everything else imports the
+constants (``pipeline.DECODE``, ``hist_key(...)``, ``span_name(...)``).
+
+Rule:
+
+* ``pipeline-phase-registry`` — a string literal (or f-string head)
+  beginning with ``pipeline.`` anywhere outside the registry module.
+  Reads through the constants are invisible to this pass by
+  construction — that is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+#: the registry itself (the only module allowed to spell the prefix) —
+#: and this pass, which must spell it to detect it
+ALLOWED_PREFIXES = (
+    "openr_tpu/tracing/pipeline.py",
+    "openr_tpu/analysis/passes/pipeline_phase.py",
+)
+
+_PREFIX = "pipeline."
+
+
+class PipelinePhasePass(Pass):
+    name = "pipeline-phase"
+    rules = {
+        "pipeline-phase-registry": (
+            "pipeline.* metric/span name spelled as a free string "
+            "(import the registry constants from "
+            "openr_tpu.tracing.pipeline so every phase sample lands "
+            "under a schema-known name)"
+        ),
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if mod.rel.startswith(ALLOWED_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            value = None
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                value = node.value
+            elif isinstance(node, ast.JoinedStr) and node.values:
+                head = node.values[0]
+                if isinstance(head, ast.Constant) and isinstance(
+                    head.value, str
+                ):
+                    value = head.value
+            if value is None or not value.startswith(_PREFIX):
+                continue
+            out.append(
+                mod.finding(
+                    "pipeline-phase-registry",
+                    node,
+                    f"free-string pipeline name {value!r}; use the "
+                    "openr_tpu.tracing.pipeline registry constants "
+                    "(PHASES / hist_key / span_name)",
+                )
+            )
+        return out

@@ -33,11 +33,31 @@ class InvariantViolation(AssertionError):
 
 
 class InvariantChecker:
-    def __init__(self, net) -> None:
+    def __init__(self, net, auto_dump: bool = True) -> None:
         self.net = net
         #: name -> (node object, last observed change_seq)
         self._seq_seen: Dict[str, Tuple[object, int]] = {}
         self.num_samples = 0
+        #: on breach, freeze every node's flight recorder (post-mortem
+        #: Chrome-trace + metrics snapshot + frame ring) BEFORE raising —
+        #: the test/operator then has the evidence the violation message
+        #: summarizes.  Nodes without a recorder are skipped.
+        self.auto_dump = auto_dump
+        self.num_breach_dumps = 0
+
+    def _breach(self, message: str) -> "InvariantViolation":
+        """Build the violation and (once per breach) dump every node's
+        flight recorder; callers ``raise self._breach(...)``."""
+        if self.auto_dump:
+            for _name, node in sorted(self.net.nodes.items()):
+                recorder = getattr(node, "flight_recorder", None)
+                if recorder is not None:
+                    try:
+                        recorder.on_invariant_breach(message)
+                        self.num_breach_dumps += 1
+                    except Exception:  # noqa: BLE001 - the violation
+                        pass  # itself must still surface
+        return InvariantViolation(message)
 
     # -- during-run checks -------------------------------------------------
 
@@ -51,7 +71,7 @@ class InvariantChecker:
             seq = node.decision._change_seq
             prev = self._seq_seen.get(name)
             if prev is not None and prev[0] is node and seq < prev[1]:
-                raise InvariantViolation(
+                raise self._breach(
                     f"{name}: decision change_seq went backwards "
                     f"({prev[1]} -> {seq}) within one incarnation"
                 )
@@ -88,7 +108,7 @@ class InvariantChecker:
                 differ = sorted(
                     k for k in set(want) & set(got) if want[k] != got[k]
                 )[:5]
-                raise InvariantViolation(
+                raise self._breach(
                     f"LSDB divergence in area {area}: {name} vs {ref_name} "
                     f"(missing={missing} extra={extra} differ={differ})"
                 )
@@ -108,7 +128,7 @@ class InvariantChecker:
             }
             programmed = set(agent.unicast)
             if desired != programmed:
-                raise InvariantViolation(
+                raise self._breach(
                     f"{name}: FIB desired/programmed mismatch — "
                     f"unprogrammed={sorted(desired - programmed)[:5]} "
                     f"stale={sorted(programmed - desired)[:5]}"
@@ -118,7 +138,7 @@ class InvariantChecker:
                 for nh in route.next_hops:
                     info = interfaces.get(nh.if_name)
                     if info is None or not info.is_up:
-                        raise InvariantViolation(
+                        raise self._breach(
                             f"{name}: route {prefix} via downed/unknown "
                             f"interface {nh.if_name}"
                         )
@@ -126,7 +146,7 @@ class InvariantChecker:
                         nh.neighbor_node_name
                         and nh.neighbor_node_name not in live
                     ):
-                        raise InvariantViolation(
+                        raise self._breach(
                             f"{name}: route {prefix} via dead node "
                             f"{nh.neighbor_node_name}"
                         )
@@ -136,7 +156,7 @@ class InvariantChecker:
     def check_full_mesh(self) -> None:
         ok, why = self.net.converged_full_mesh()
         if not ok:
-            raise InvariantViolation(f"full-mesh reachability: {why}")
+            raise self._breach(f"full-mesh reachability: {why}")
 
     # -- everything --------------------------------------------------------
 

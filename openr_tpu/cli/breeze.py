@@ -438,8 +438,25 @@ def monitor_trace(
     docs/Observability.md for the span taxonomy."""
     spans = _call(ctx, "get_traces", trace_id=trace_id, limit=limit)
     if json_out:
+        # stable shape (a plain span list) for scripts; the drop
+        # accounting rides the human rendering and `get_trace_stats`
         _print(spans)
         return
+    stats = _call(ctx, "get_trace_stats")
+    # drop accounting first: a truncated tree must never read as a
+    # complete one (dropped open spans = blind spots in what follows)
+    dropped = int(stats.get("trace.dropped_spans", 0))
+    evicted = int(stats.get("trace.spans_evicted", 0))
+    click.echo(
+        f"spans: {int(stats.get('trace.spans_completed', 0))} completed, "
+        f"{dropped} dropped, {evicted} evicted "
+        f"({int(stats.get('trace.open_spans', 0))} open)"
+    )
+    if dropped:
+        click.echo(
+            "WARNING: open spans were dropped — trees below may be "
+            "missing stages (raise tracing_config.max_open_spans)"
+        )
     if not spans:
         click.echo("no completed spans (tracing disabled or no events yet)")
         return
@@ -512,6 +529,50 @@ def monitor_histograms(
             f"{fmt(h.get('p95')):>10}  {fmt(h.get('p99')):>10}  "
             f"{fmt(h.get('max')):>10}"
         )
+
+
+@monitor.command("export")
+@click.option(
+    "--format", "fmt", default="prometheus",
+    type=click.Choice(["prometheus", "json"]),
+    help="Prometheus text exposition (scrape payload) or the raw "
+         "snapshot JSON (counters + histogram buckets)",
+)
+@click.option("--output", "-o", default="", metavar="PATH",
+              help="write to a file instead of stdout")
+@click.pass_context
+def monitor_export(ctx: click.Context, fmt: str, output: str) -> None:
+    """One point-in-time metrics snapshot of this node, export-ready:
+    generation- and env-stamped counters, per-device pipeline gauges,
+    and full histogram buckets (docs/Observability.md §metrics
+    export)."""
+    if fmt == "prometheus":
+        text = _call(ctx, "get_metrics_prometheus")
+    else:
+        import json as _json
+
+        text = _json.dumps(
+            _call(ctx, "get_metrics_snapshot"), indent=2, sort_keys=True
+        )
+    if output:
+        with open(output, "w") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+        click.echo(f"wrote {len(text)} bytes to {output}")
+    else:
+        click.echo(text, nl=not text.endswith("\n"))
+
+
+@monitor.command("flight-dump")
+@click.pass_context
+def monitor_flight_dump(ctx: click.Context) -> None:
+    """The newest flight-recorder post-mortem (chip quarantine /
+    invariant breach / watchdog crash), as JSON — see the
+    Operator_Guide runbook on reading one after a chip quarantine."""
+    doc = _call(ctx, "get_flight_recorder_dump")
+    if doc is None:
+        click.echo("no flight-recorder dump yet (and none in flight)")
+        return
+    _print(doc)
 
 
 @monitor.command("statistics")

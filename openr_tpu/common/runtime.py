@@ -170,7 +170,12 @@ class Histogram:
     def percentile(self, pct: float) -> Optional[float]:
         """Estimated value at `pct` (0-100): linear interpolation within
         the containing bucket, clamped to the observed [min, max] so
-        single-valued populations report exactly that value."""
+        single-valued populations report exactly that value.  An EMPTY
+        histogram has no percentiles by definition — every rank returns
+        None (never 0.0, which would read as a real latency), and
+        `percentiles()` returns a dict of Nones; consumers render them
+        as absent (breeze prints "-", the Prometheus exposition emits
+        only the zero `_count`)."""
         if self.count == 0:
             return None
         rank = (pct / 100.0) * self.count
@@ -190,15 +195,32 @@ class Histogram:
         return {f"p{g:g}": self.percentile(g) for g in pcts}
 
     def merge(self, other: "Histogram") -> "Histogram":
-        """In-place bucket-count addition; configs must match exactly."""
-        if (
-            self.min_bound != other.min_bound
-            or self.growth != other.growth
-            or len(self.counts) != len(other.counts)
-        ):
+        """In-place bucket-count addition.
+
+        Same (min_bound, growth) but DIFFERENT bucket counts merge by
+        widening self to the larger width: the geometric edges of the
+        narrower histogram are a prefix of the wider one's, so regular
+        buckets add positionally, and the narrower histogram's overflow
+        count lands in the merged OVERFLOW bucket (conservative — those
+        samples may truly belong in one of the newly-exposed upper
+        buckets, but the narrow histogram no longer knows; count/sum/
+        min/max stay exact either way).  Differing (min_bound, growth)
+        still raises ValueError: the edge grids are incompatible and a
+        positional add would silently mis-bin every sample."""
+        if self.min_bound != other.min_bound or self.growth != other.growth:
             raise ValueError("histogram configs differ; cannot merge")
-        for i, c in enumerate(other.counts):
+        if len(self.counts) < len(other.counts):
+            grow = len(other.counts) - len(self.counts)
+            self.edges.extend(
+                self.min_bound * self.growth ** i
+                for i in range(len(self.edges), len(other.edges))
+            )
+            overflow = self.counts.pop()
+            self.counts.extend([0] * grow)
+            self.counts.append(overflow)
+        for i, c in enumerate(other.counts[:-1]):
             self.counts[i] += c
+        self.counts[-1] += other.counts[-1]
         self.count += other.count
         self.total += other.total
         for v in (other.vmin, other.vmax):
@@ -226,6 +248,31 @@ class Histogram:
             "max": self.vmax,
         }
         out.update(self.percentiles())
+        return out
+
+    def config(self) -> Dict[str, Any]:
+        """Bucket-grid identity — two histograms merge iff these match
+        (up to width, see `merge`)."""
+        return {
+            "min_bound": self.min_bound,
+            "growth": self.growth,
+            "num_buckets": len(self.edges),
+        }
+
+    def bucket_items(self) -> List[tuple]:
+        """Nonzero ``(upper_edge_inclusive, count)`` pairs in edge
+        order; the overflow bucket reports ``inf``.  The compact form
+        the metrics-export tier serializes (160 mostly-zero buckets per
+        key would dominate every snapshot line)."""
+        out: List[tuple] = []
+        for i, c in enumerate(self.counts):
+            if c:
+                out.append(
+                    (
+                        self.edges[i] if i < len(self.edges) else float("inf"),
+                        c,
+                    )
+                )
         return out
 
 
@@ -265,6 +312,9 @@ class CounterMap:
 
     def histogram(self, key: str) -> Optional[Histogram]:
         return self._histograms.get(key)
+
+    def histogram_keys(self) -> List[str]:
+        return sorted(self._histograms)
 
     def percentiles(self, key: str, pcts=(50, 95, 99)):
         """{"p50": .., "p95": .., "p99": ..} or None when never observed."""

@@ -142,6 +142,18 @@ class TracingConfig:
     #: open-span table cap: spans started but never closed past this are
     #: dropped and counted (`trace.dropped_spans`)
     max_open_spans: int = 512
+    #: flight recorder (openr_tpu.tracing.flight_recorder): bounded
+    #: post-mortem ring that auto-dumps a Chrome-trace + metrics
+    #: snapshot on invariant breach / chip quarantine / watchdog crash.
+    #: Needs `enabled` (the span window comes from the tracer ring).
+    flight_recorder: bool = True
+    #: newest completed spans included in a dump
+    flight_recorder_spans: int = 512
+    #: counter-delta/queue-watermark frames kept in the rolling window
+    flight_recorder_frames: int = 256
+    #: directory dumps are also written to ("" = in-memory only; the
+    #: ctrl API and chaos harnesses read the in-memory copy)
+    flight_recorder_dir: str = ""
 
 
 @dataclass

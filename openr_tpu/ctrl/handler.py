@@ -918,11 +918,47 @@ class OpenrCtrlHandler:
         """Distinct trace ids currently held in the span ring."""
         return self.node.tracer.trace_ids()
 
+    def get_trace_stats(self) -> Dict[str, float]:
+        """Live tracer accounting (`trace.spans_completed`,
+        `trace.dropped_spans`, `trace.spans_evicted`, `trace.open_spans`)
+        — read directly from the tracer, not from the last Monitor gauge
+        sweep, so `breeze monitor trace` can warn about drop-induced
+        blind spots the moment they exist."""
+        return self.node.tracer.stats()
+
     def get_histograms(self, prefix: str = "") -> Dict[str, dict]:
         """Latency-histogram snapshots (count/sum/min/max + p50/p95/p99)
         per key — `convergence.event_to_fib_ms`, `decision.spf_kernel_ms`
         et al.  `breeze monitor histograms` tabulates these."""
         return self.node.counters.dump_histograms(prefix)
+
+    def get_metrics_snapshot(self) -> dict:
+        """Point-in-time metrics export (openr_tpu.monitor.metrics):
+        counters + full histogram BUCKETS, generation- and env-stamped.
+        Gauge providers are swept at capture, so the snapshot is current
+        rather than as-of the last periodic sweep.  `breeze monitor
+        export` renders this as JSON or Prometheus text exposition."""
+        from openr_tpu.monitor.metrics import MetricsSnapshot
+
+        return MetricsSnapshot.capture(self.node).to_wire()
+
+    def get_metrics_prometheus(self) -> str:
+        """This node's metrics as one Prometheus text-exposition
+        document (the scrape-endpoint payload)."""
+        from openr_tpu.monitor.metrics import (
+            MetricsSnapshot,
+            render_prometheus,
+        )
+
+        return render_prometheus([MetricsSnapshot.capture(self.node)])
+
+    def get_flight_recorder_dump(self) -> Optional[dict]:
+        """The newest flight-recorder post-mortem artifact (None when no
+        dump has fired or the recorder is disabled)."""
+        recorder = getattr(self.node, "flight_recorder", None)
+        if recorder is None:
+            return None
+        return recorder.last_dump_doc()
 
     # ------------------------------------------------------------- streaming
     # (OpenrCtrlHandler.h:364-399)

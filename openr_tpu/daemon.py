@@ -66,6 +66,8 @@ async def run_emulation(
     use_tpu_backend: bool = False,
     supervise: bool = False,
     trace_export: str = "",
+    metrics_export: str = "",
+    metrics_interval_s: float = 30.0,
 ) -> None:
     from openr_tpu.emulation.network import EmulatedNetwork
     from openr_tpu.emulation.topology import grid_edges, line_edges, ring_edges
@@ -139,6 +141,24 @@ async def run_emulation(
         print(f"{len(net.nodes)} nodes up; try: "
               f"python -m openr_tpu.cli.breeze --port {servers[0].port} "
               "spark neighbors")
+    metrics_task = None
+    metrics_writer = None
+    if metrics_export:
+        # periodic JSONL snapshot export on the network clock: one line
+        # per node per sweep (counters + histogram buckets, generation-
+        # and env-stamped) — the off-node metrics tier
+        from openr_tpu.monitor.metrics import MetricsJsonlWriter
+
+        metrics_writer = MetricsJsonlWriter(metrics_export)
+
+        async def _metrics_fiber():
+            while True:
+                await net.clock.sleep(metrics_interval_s)
+                metrics_writer.write_nodes(net.nodes.values())
+
+        metrics_task = asyncio.get_running_loop().create_task(
+            _metrics_fiber(), name="emulation.metrics_export"
+        )
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -149,6 +169,19 @@ async def run_emulation(
     await stop.wait()
     if supervisor is not None:
         await supervisor.stop()
+    if metrics_task is not None:
+        metrics_task.cancel()
+        try:
+            await metrics_task
+        except asyncio.CancelledError:
+            pass
+        # one final sweep so short runs still land a complete snapshot
+        metrics_writer.write_nodes(net.nodes.values())
+        if verbose:
+            print(
+                f"wrote {metrics_writer.num_lines} metric snapshots to "
+                f"{metrics_export}"
+            )
     if trace_export:
         # dump the whole run's span set viewer-ready (chrome://tracing /
         # ui.perfetto.dev) before teardown
@@ -288,6 +321,13 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="with --emulate: on shutdown, write all nodes' "
                         "convergence-trace spans as a Chrome-trace/"
                         "Perfetto file")
+    p.add_argument("--metrics-export", default="", metavar="PATH",
+                   help="with --emulate: periodically append one JSONL "
+                        "metrics snapshot per node (counters + histogram "
+                        "buckets, generation/env-stamped)")
+    p.add_argument("--metrics-interval", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="sweep cadence for --metrics-export")
     p.add_argument("--ctrl-host", default="",
                    help="ctrl server bind address in --real mode "
                         "(default: all interfaces)")
@@ -305,6 +345,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                 use_tpu_backend=args.tpu,
                 supervise=args.supervise,
                 trace_export=args.trace_export,
+                metrics_export=args.metrics_export,
+                metrics_interval_s=args.metrics_interval,
             )
         )
         return

@@ -208,6 +208,7 @@ class TpuBackend(DecisionBackend):
         tracer=None,
         resilience=None,
         parallel=None,
+        probe=None,
     ) -> None:
         self.solver = solver  # scalar fallback + MPLS/static
         # AOT-equivalence with the reference's compiled binary: persist
@@ -260,6 +261,24 @@ class TpuBackend(DecisionBackend):
             parallel.min_shard_rows if parallel else 128
         )
         self._pool = None
+        #: pipeline attribution (openr_tpu.tracing.pipeline): every
+        #: stage of a device build records a phase-scoped span +
+        #: `pipeline.{phase}.ms` histogram sample, and committed
+        #: per-shard dispatches charge per-chip busy time.  Built from
+        #: the injected clock/counters/tracer when not supplied;
+        #: embedders without a clock get the shared disabled probe.
+        if probe is None:
+            from openr_tpu.tracing.pipeline import (
+                PipelineProbe,
+                disabled_probe,
+            )
+
+            probe = (
+                PipelineProbe(clock, counters, tracer)
+                if clock is not None
+                else disabled_probe()
+            )
+        self.probe = probe
         #: per-device replicas of the device-resident SPF tables, keyed
         #: by device index and invalidated by table identity
         self._spf_replicas: dict = {}
@@ -659,19 +678,25 @@ class TpuBackend(DecisionBackend):
             and self._spf_degree == max_degree
         ):
             return self._spf_tables
-        dist, nh = call_jit_guarded(
-            multi_area_spf_tables,
-            jnp.asarray(enc.src),
-            jnp.asarray(enc.dst),
-            jnp.asarray(enc.w),
-            jnp.asarray(enc.edge_ok),
-            jnp.asarray(enc.overloaded),
-            jnp.asarray(enc.roots),
-            max_degree=max_degree,
-        )
+        from openr_tpu.tracing import pipeline
+
+        with self.probe.phase(pipeline.TRANSFER):
+            args = (
+                jnp.asarray(enc.src),
+                jnp.asarray(enc.dst),
+                jnp.asarray(enc.w),
+                jnp.asarray(enc.edge_ok),
+                jnp.asarray(enc.overloaded),
+                jnp.asarray(enc.roots),
+            )
+        with self.probe.phase(pipeline.DEVICE_COMPUTE):
+            dist, nh = call_jit_guarded(
+                multi_area_spf_tables, *args, max_degree=max_degree
+            )
         # keep soft/overloaded device-resident alongside (selection inputs)
-        soft = jnp.asarray(enc.soft)
-        ovl = jnp.asarray(enc.overloaded)
+        with self.probe.phase(pipeline.TRANSFER):
+            soft = jnp.asarray(enc.soft)
+            ovl = jnp.asarray(enc.overloaded)
         self._spf_tables = (dist, nh, ovl, soft)
         self._spf_enc = enc
         self._spf_degree = max_degree
@@ -730,8 +755,11 @@ class TpuBackend(DecisionBackend):
         cached = self._spf_replicas.get(dev_index)
         if cached is not None and cached[0] is tables:
             return cached[1]
+        from openr_tpu.tracing import pipeline
+
         dev = self.pool.device(dev_index)
-        rep = tuple(jax.device_put(t, dev) for t in tables)
+        with self.probe.phase(pipeline.TRANSFER, device=dev_index):
+            rep = tuple(jax.device_put(t, dev) for t in tables)
         self._spf_replicas[dev_index] = (tables, rep)
         return rep
 
@@ -744,8 +772,10 @@ class TpuBackend(DecisionBackend):
         plan size; pad rows carry cand_ok=False and decode to nothing."""
         import jax
 
+        from openr_tpu.ops import jit_guard
         from openr_tpu.ops.jit_guard import call_jit_guarded
         from openr_tpu.ops.route_select import multi_area_select_from_tables
+        from openr_tpu.tracing import pipeline
 
         width = max(hi - lo for _d, lo, hi in plan)
 
@@ -761,29 +791,50 @@ class TpuBackend(DecisionBackend):
         for dev_index, lo, hi in plan:
             dev = self.pool.device(dev_index)
             td, tn, to, ts = self._replicated_tables(dev_index, tables)
-            ok = np.zeros((width,) + dv.cand_ok.shape[1:], dv.cand_ok.dtype)
-            ok[: hi - lo] = dv.cand_ok[lo:hi]
-            out = call_jit_guarded(
-                multi_area_select_from_tables,
-                td,
-                tn,
-                to,
-                ts,
-                jax.device_put(pad(dv.cand_area, lo, hi), dev),
-                jax.device_put(pad(dv.cand_node, lo, hi), dev),
-                jax.device_put(ok, dev),
-                jax.device_put(pad(dv.drain_metric, lo, hi), dev),
-                jax.device_put(pad(dv.path_pref, lo, hi), dev),
-                jax.device_put(pad(dv.source_pref, lo, hi), dev),
-                jax.device_put(pad(dv.distance, lo, hi), dev),
-                jax.device_put(pad(dv.cand_node_in_area, lo, hi), dev),
-                per_area_distance=per_area,
-            )
+            with self.probe.phase(pipeline.PAD_PACK, device=dev_index):
+                ok = np.zeros(
+                    (width,) + dv.cand_ok.shape[1:], dv.cand_ok.dtype
+                )
+                ok[: hi - lo] = dv.cand_ok[lo:hi]
+                padded = (
+                    pad(dv.cand_area, lo, hi),
+                    pad(dv.cand_node, lo, hi),
+                    ok,
+                    pad(dv.drain_metric, lo, hi),
+                    pad(dv.path_pref, lo, hi),
+                    pad(dv.source_pref, lo, hi),
+                    pad(dv.distance, lo, hi),
+                    pad(dv.cand_node_in_area, lo, hi),
+                )
+            with self.probe.phase(pipeline.TRANSFER, device=dev_index):
+                shard_args = tuple(
+                    jax.device_put(a, dev) for a in padded
+                )
+            # a COMMITTED computation on its own chip: the kernel span
+            # and the phase sample both carry the device, so a wrong
+            # output row and a slow dispatch attribute to the same chip
+            with self.probe.phase(
+                pipeline.DEVICE_COMPUTE, device=dev_index
+            ), jit_guard.dispatch_device(dev_index):
+                out = call_jit_guarded(
+                    multi_area_select_from_tables,
+                    td,
+                    tn,
+                    to,
+                    ts,
+                    *shard_args,
+                    per_area_distance=per_area,
+                )
+            self.pool.note_dispatch(dev_index)
             dispatched.append((dev_index, hi - lo, out))
         # every shard dispatched async above; ONE blocking fetch drains
         # them all (the same single-round-trip rule the unsharded path
         # follows)
-        fetched = jax.device_get([o for _d, _n, o in dispatched])
+        with self.probe.phase(
+            pipeline.DEVICE_GET,
+            devices=[d for d, _n, _o in dispatched],
+        ):
+            fetched = jax.device_get([o for _d, _n, o in dispatched])
         parts = {k: [] for k in range(4)}
         for (dev_index, n, _), outs in zip(dispatched, fetched):
             u, s, l, v = (o[:n] for o in outs)
@@ -804,9 +855,11 @@ class TpuBackend(DecisionBackend):
         import jax
         import jax.numpy as jnp
 
+        from openr_tpu.ops import jit_guard
         from openr_tpu.ops.csr import bucket_for
         from openr_tpu.ops.jit_guard import call_jit_guarded
         from openr_tpu.ops.route_select import multi_area_select_from_tables
+        from openr_tpu.tracing import pipeline
 
         me = self.solver.my_node_name
         if not any(ls.has_node(me) for ls in area_link_states.values()):
@@ -818,22 +871,24 @@ class TpuBackend(DecisionBackend):
             self._attr_table = None
             return None
         prev_enc = self._last_enc
-        enc = self._encoded(area_link_states, me)
+        with self.probe.phase(pipeline.ENCODE):
+            enc = self._encoded(area_link_states, me)
         self._last_enc = enc
 
         # table sync is driven ONLY by prefix churn; the build mode (patch
         # vs full selection) additionally requires an unchanged topology
         table = self._cand_table
-        try:
-            if changed_prefixes is not None and self._table_synced:
-                table.apply_dirty(prefix_state, changed_prefixes)
-            else:
-                table.full_sync(prefix_state)
-        except ValueError:
-            self.num_fallback_cand_overflow += 1
-            raise
-        self._table_synced = True
-        dv = table.derived(enc)
+        with self.probe.phase(pipeline.HOST_FETCH):
+            try:
+                if changed_prefixes is not None and self._table_synced:
+                    table.apply_dirty(prefix_state, changed_prefixes)
+                else:
+                    table.full_sync(prefix_state)
+            except ValueError:
+                self.num_fallback_cand_overflow += 1
+                raise
+            self._table_synced = True
+            dv = table.derived(enc)
 
         incremental = (
             changed_prefixes is not None
@@ -880,57 +935,79 @@ class TpuBackend(DecisionBackend):
                 K = bucket_for(len(rows), ROWSEL_BUCKETS)
                 # gather changed rows into a padded [K, C] batch; padding
                 # repeats row 0 with cand_ok forced off
-                ridx = np.zeros(K, np.int64)
-                ridx[: len(rows)] = rows
-                g_ok = dv.cand_ok[ridx]
-                g_ok[len(rows):] = False
-                gathered = (
-                    dv.cand_area[ridx],
-                    dv.cand_node[ridx],
-                    g_ok,
-                    dv.drain_metric[ridx],
-                    dv.path_pref[ridx],
-                    dv.source_pref[ridx],
-                    dv.distance[ridx],
-                    dv.cand_node_in_area[ridx],
-                )
+                with self.probe.phase(pipeline.PAD_PACK):
+                    ridx = np.zeros(K, np.int64)
+                    ridx[: len(rows)] = rows
+                    g_ok = dv.cand_ok[ridx]
+                    g_ok[len(rows):] = False
+                    gathered = (
+                        dv.cand_area[ridx],
+                        dv.cand_node[ridx],
+                        g_ok,
+                        dv.drain_metric[ridx],
+                        dv.path_pref[ridx],
+                        dv.source_pref[ridx],
+                        dv.distance[ridx],
+                        dv.cand_node_in_area[ridx],
+                    )
                 if inc_dev is not None:
                     dev = self.pool.device(inc_dev)
                     t_dist, t_nh, t_ovl, t_soft = self._replicated_tables(
                         inc_dev, (dist, nh, ovl, soft)
                     )
-                    args = tuple(jax.device_put(a, dev) for a in gathered)
+                    with self.probe.phase(
+                        pipeline.TRANSFER, device=inc_dev
+                    ):
+                        args = tuple(
+                            jax.device_put(a, dev) for a in gathered
+                        )
                 else:
                     t_dist, t_nh, t_ovl, t_soft = dist, nh, ovl, soft
-                    args = tuple(jnp.asarray(a) for a in gathered)
-                use, shortest, lanes, valid = call_jit_guarded(
-                    multi_area_select_from_tables,
-                    t_dist,
-                    t_nh,
-                    t_ovl,
-                    t_soft,
-                    *args,
-                    per_area_distance=per_area,
-                )
-                use, shortest, lanes, valid = jax.device_get(
-                    (use, shortest, lanes, valid)
-                )
+                    with self.probe.phase(pipeline.TRANSFER):
+                        args = tuple(jnp.asarray(a) for a in gathered)
+                gather_dev = inc_dev if inc_dev is not None else 0
+                with self.probe.phase(
+                    pipeline.DEVICE_COMPUTE, device=gather_dev
+                ), jit_guard.dispatch_device(
+                    inc_dev if inc_dev is not None else None
+                ):
+                    use, shortest, lanes, valid = call_jit_guarded(
+                        multi_area_select_from_tables,
+                        t_dist,
+                        t_nh,
+                        t_ovl,
+                        t_soft,
+                        *args,
+                        per_area_distance=per_area,
+                    )
+                if inc_dev is not None:
+                    self.pool.note_dispatch(inc_dev)
+                with self.probe.phase(
+                    pipeline.DEVICE_GET, devices=[gather_dev]
+                ):
+                    use, shortest, lanes, valid = jax.device_get(
+                        (use, shortest, lanes, valid)
+                    )
                 if self._sdc_active_for(inc_dev if inc_dev is not None else 0):
                     shortest = self._corrupt_metrics(shortest)
-                results.update(
-                    self._decode_rows(
-                        [(i, table.row_prefix[r]) for i, r in enumerate(rows)],
-                        use,
-                        shortest,
-                        lanes,
-                        valid,
-                        dv,
-                        np.asarray(ridx),
-                        enc,
-                        area_link_states,
-                        prefix_state,
+                with self.probe.phase(pipeline.DECODE):
+                    results.update(
+                        self._decode_rows(
+                            [
+                                (i, table.row_prefix[r])
+                                for i, r in enumerate(rows)
+                            ],
+                            use,
+                            shortest,
+                            lanes,
+                            valid,
+                            dv,
+                            np.asarray(ridx),
+                            enc,
+                            area_link_states,
+                            prefix_state,
+                        )
                     )
-                )
             self.num_incremental_builds += 1
             self.num_device_builds += 1
             if inc_dev is not None and rows:
@@ -939,9 +1016,10 @@ class TpuBackend(DecisionBackend):
                 self._attr_table = table
             else:
                 self._attr_table = None
-            return _patch_route_db(
-                self._last_db, results, self.solver.get_static_routes()
-            )
+            with self.probe.phase(pipeline.DELTA_EXTRACT):
+                return _patch_route_db(
+                    self._last_db, results, self.solver.get_static_routes()
+                )
 
         # ---- full build --------------------------------------------------
         n_active = (max(table.pid.values()) + 1) if table.pid else 0
@@ -959,65 +1037,77 @@ class TpuBackend(DecisionBackend):
             self._attr_rows = None
             self._attr_table = table
         else:
-            use, shortest, lanes, valid = call_jit_guarded(
-                multi_area_select_from_tables,
-                dist,
-                nh,
-                ovl,
-                soft,
-                jnp.asarray(dv.cand_area),
-                jnp.asarray(dv.cand_node),
-                jnp.asarray(dv.cand_ok),
-                jnp.asarray(dv.drain_metric),
-                jnp.asarray(dv.path_pref),
-                jnp.asarray(dv.source_pref),
-                jnp.asarray(dv.distance),
-                jnp.asarray(dv.cand_node_in_area),
-                per_area_distance=per_area,
-            )
+            with self.probe.phase(pipeline.TRANSFER):
+                full_args = (
+                    jnp.asarray(dv.cand_area),
+                    jnp.asarray(dv.cand_node),
+                    jnp.asarray(dv.cand_ok),
+                    jnp.asarray(dv.drain_metric),
+                    jnp.asarray(dv.path_pref),
+                    jnp.asarray(dv.source_pref),
+                    jnp.asarray(dv.distance),
+                    jnp.asarray(dv.cand_node_in_area),
+                )
+            # the legacy single-dispatch path still runs on ONE chip
+            # (pool index 0) — attribute it so 1-device runs report a
+            # per-chip busy fraction too
+            with self.probe.phase(pipeline.DEVICE_COMPUTE, device=0):
+                use, shortest, lanes, valid = call_jit_guarded(
+                    multi_area_select_from_tables,
+                    dist,
+                    nh,
+                    ovl,
+                    soft,
+                    *full_args,
+                    per_area_distance=per_area,
+                )
             self.num_device_builds += 1
             # ONE device->host fetch for all outputs: over a tunneled TPU
             # each transfer is a full round trip, and four separate
             # np.asarray calls cost ~4x one device_get (measured ~256ms vs
             # ~69ms on v5e/axon) — that difference alone would blow the
             # 10-250ms debounce budget
-            use, shortest, lanes, valid = jax.device_get(
-                (use, shortest, lanes, valid)
-            )
+            with self.probe.phase(pipeline.DEVICE_GET, devices=[0]):
+                use, shortest, lanes, valid = jax.device_get(
+                    (use, shortest, lanes, valid)
+                )
             if self._sdc_active_for(0):
                 shortest = self._corrupt_metrics(shortest)
             self._attr_table = None
 
-        # only rows with at least one selection winner can produce routes
-        rows_with_winners = np.nonzero(use.any(axis=1))[0]
-        row_items: List[Tuple[int, str]] = []
-        for r in rows_with_winners:
-            p = table.row_prefix[r]
-            if p is not None:
-                row_items.append((int(r), p))
-        results = self._decode_rows(
-            row_items,
-            use,
-            shortest,
-            lanes,
-            valid,
-            dv,
-            None,
-            enc,
-            area_link_states,
-            prefix_state,
-        )
+        with self.probe.phase(pipeline.DECODE):
+            # only rows with at least one selection winner produce routes
+            rows_with_winners = np.nonzero(use.any(axis=1))[0]
+            row_items: List[Tuple[int, str]] = []
+            for r in rows_with_winners:
+                p = table.row_prefix[r]
+                if p is not None:
+                    row_items.append((int(r), p))
+            results = self._decode_rows(
+                row_items,
+                use,
+                shortest,
+                lanes,
+                valid,
+                dv,
+                None,
+                enc,
+                area_link_states,
+                prefix_state,
+            )
 
-        route_db = DecisionRouteDb()
-        for prefix, entry in results.items():
-            if entry is not None:
-                route_db.add_unicast_route(entry)
-        # static-route overlay + MPLS labels: scalar (small)
-        for prefix, sentry in self.solver.get_static_routes().items():
-            if prefix not in route_db.unicast_routes:
-                route_db.add_unicast_route(sentry)
-        if self.solver.enable_node_segment_label:
-            self.solver._build_node_label_routes(area_link_states, route_db)
+            route_db = DecisionRouteDb()
+            for prefix, entry in results.items():
+                if entry is not None:
+                    route_db.add_unicast_route(entry)
+            # static-route overlay + MPLS labels: scalar (small)
+            for prefix, sentry in self.solver.get_static_routes().items():
+                if prefix not in route_db.unicast_routes:
+                    route_db.add_unicast_route(sentry)
+            if self.solver.enable_node_segment_label:
+                self.solver._build_node_label_routes(
+                    area_link_states, route_db
+                )
         return route_db
 
     @staticmethod

@@ -518,13 +518,23 @@ class Decision(Actor):
             # FIB, not just this tick's changed prefixes
             self.counters.bump("decision.quarantine_full_replaces")
             force_full = True
-        if force_full:
-            update = self.route_db.calculate_update(new_db)
-        else:
-            # incremental contract: only the changed prefixes can differ —
-            # diff O(changed) instead of O(total) so the publication→FIB
-            # latency stays flat in total prefix count
-            update = self.route_db.calculate_update_for(new_db, changed)
+        # the RouteDb diff is the pipeline's delta-extract tail: the last
+        # host stage between device output and the FIB publication
+        probe = self._backend_probe()
+        if probe is None:
+            from openr_tpu.tracing.pipeline import disabled_probe
+
+            probe = disabled_probe()
+        from openr_tpu.tracing import pipeline as _pipeline
+
+        with probe.phase(_pipeline.DELTA_EXTRACT):
+            if force_full:
+                update = self.route_db.calculate_update(new_db)
+            else:
+                # incremental contract: only the changed prefixes can
+                # differ — diff O(changed) instead of O(total) so the
+                # publication→FIB latency stays flat in prefix count
+                update = self.route_db.calculate_update_for(new_db, changed)
         first = not self._first_build_done
         if first:
             update = DecisionRouteUpdate(
@@ -621,12 +631,22 @@ class Decision(Actor):
         fn = getattr(self.backend, "dispatch_pool", None)
         return fn() if fn is not None else None
 
+    def _backend_probe(self):
+        """The backend's PipelineProbe (None for scalar backends) — the
+        fleet/what-if engines record their phase samples and per-chip
+        busy time on the SAME ledger route builds use, so `pipeline.*`
+        histograms and `pipeline.devN.*` gauges cover the whole
+        dispatch plane."""
+        return getattr(self.backend, "probe", None)
+
     def _fleet(self):
         if self._fleet_engine is None:
             from openr_tpu.decision.fleet import FleetRibEngine
 
             self._fleet_engine = FleetRibEngine(
-                self.solver, pool=self._backend_pool()
+                self.solver,
+                pool=self._backend_pool(),
+                probe=self._backend_probe(),
             )
         return self._fleet_engine
 
@@ -848,7 +868,9 @@ class Decision(Actor):
                 )
 
                 self._whatif_multi_engine = MultiAreaWhatIfEngine(
-                    self.solver, pool=self._backend_pool()
+                    self.solver,
+                    pool=self._backend_pool(),
+                    probe=self._backend_probe(),
                 )
             engine = self._whatif_multi_engine
             engine_name = "multiarea"

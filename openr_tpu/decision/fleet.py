@@ -39,7 +39,9 @@ ROOT_CHUNK = 1024
 class FleetRibEngine:
     """Caches all-roots selection tables per LSDB change generation."""
 
-    def __init__(self, solver: SpfSolver, mesh=None, pool=None) -> None:
+    def __init__(
+        self, solver: SpfSolver, mesh=None, pool=None, probe=None
+    ) -> None:
         """``mesh``: optional ``jax.sharding.Mesh`` with a ``batch``
         axis — the vantage-root batch then shards across the mesh
         (ops.fleet_tables.sharded_fleet_tables), bit-identical to the
@@ -48,10 +50,17 @@ class FleetRibEngine:
         spread as committed per-device dispatches over the pool's
         HEALTHY chips (the health-governed data-parallel path: a
         quarantined chip's share re-packs onto the survivors on the
-        next solve, with no shard_map requirement)."""
+        next solve, with no shard_map requirement).  ``probe``: optional
+        :class:`~openr_tpu.tracing.pipeline.PipelineProbe` — fleet
+        solves then record the same phase histograms / per-chip busy
+        gauges route builds do (Decision shares the backend's probe so
+        the whole dispatch plane lands on one ledger)."""
+        from openr_tpu.tracing.pipeline import disabled_probe
+
         self.solver = solver  # settings template (v4 flags, labels, algo)
         self.mesh = mesh
         self.pool = pool
+        self.probe = probe if probe is not None else disabled_probe()
         self._cache_key = None
         self._state = None  # dict of cached tables + decode context
         self._ksp2_scan = None  # (change_seq, result)
@@ -104,38 +113,45 @@ class FleetRibEngine:
         )
         if self._cache_key == key and self._state is not None:
             return self._state
+        from openr_tpu.tracing import pipeline
+
         me = self.solver.my_node_name
-        enc = encode_multi_area(area_link_states, me)
-        table = CandidateTable()
-        table.full_sync(prefix_state)
-        dv = table.derived(enc)
-        # every node participating in ANY area gets a vantage row
-        names = sorted(set().union(*[set(t.node_ids) for t in enc.topos]))
-        roots_mat = np.asarray(
-            [[t.node_ids.get(n, -1) for t in enc.topos] for n in names],
-            np.int32,
-        )
+        with self.probe.phase(pipeline.ENCODE):
+            enc = encode_multi_area(area_link_states, me)
+        with self.probe.phase(pipeline.HOST_FETCH):
+            table = CandidateTable()
+            table.full_sync(prefix_state)
+            dv = table.derived(enc)
+            # every node participating in ANY area gets a vantage row
+            names = sorted(
+                set().union(*[set(t.node_ids) for t in enc.topos])
+            )
+            roots_mat = np.asarray(
+                [[t.node_ids.get(n, -1) for t in enc.topos] for n in names],
+                np.int32,
+            )
         D = bucket_for(max(enc.max_out_degree(), 1), DEGREE_BUCKETS)
         per_area = (
             self.solver.route_selection_algorithm
             == RouteComputationRules.PER_AREA_SHORTEST_DISTANCE
         )
-        dev = dict(
-            src=jnp.asarray(enc.src),
-            dst=jnp.asarray(enc.dst),
-            w=jnp.asarray(enc.w),
-            edge_ok=jnp.asarray(enc.edge_ok),
-            overloaded=jnp.asarray(enc.overloaded),
-            soft=jnp.asarray(enc.soft),
-            cand_area=jnp.asarray(dv.cand_area),
-            cand_node=jnp.asarray(dv.cand_node),
-            cand_ok=jnp.asarray(dv.cand_ok),
-            drain_metric=jnp.asarray(dv.drain_metric),
-            path_pref=jnp.asarray(dv.path_pref),
-            source_pref=jnp.asarray(dv.source_pref),
-            distance=jnp.asarray(dv.distance),
-            cand_node_in_area=jnp.asarray(dv.cand_node_in_area),
-        )
+        with self.probe.phase(pipeline.TRANSFER):
+            dev = dict(
+                src=jnp.asarray(enc.src),
+                dst=jnp.asarray(enc.dst),
+                w=jnp.asarray(enc.w),
+                edge_ok=jnp.asarray(enc.edge_ok),
+                overloaded=jnp.asarray(enc.overloaded),
+                soft=jnp.asarray(enc.soft),
+                cand_area=jnp.asarray(dv.cand_area),
+                cand_node=jnp.asarray(dv.cand_node),
+                cand_ok=jnp.asarray(dv.cand_ok),
+                drain_metric=jnp.asarray(dv.drain_metric),
+                path_pref=jnp.asarray(dv.path_pref),
+                source_pref=jnp.asarray(dv.source_pref),
+                distance=jnp.asarray(dv.distance),
+                cand_node_in_area=jnp.asarray(dv.cand_node_in_area),
+            )
         B = len(names)
         P, C = dv.cand_ok.shape
         A = enc.num_areas
@@ -170,65 +186,83 @@ class FleetRibEngine:
         def args_on(idx):
             if idx not in per_dev_args:
                 d = self.pool.device(idx)
-                per_dev_args[idx] = {
-                    k: jax.device_put(v, d) for k, v in dev.items()
-                }
+                with self.probe.phase(pipeline.TRANSFER, device=idx):
+                    per_dev_args[idx] = {
+                        k: jax.device_put(v, d) for k, v in dev.items()
+                    }
             return per_dev_args[idx]
+
+        from openr_tpu.ops import jit_guard
 
         # dispatch every root chunk, then fetch ALL of them with one
         # device_get (async-copies each leaf before blocking): the whole
         # fleet build costs a single overlapped host round trip instead
         # of one per chunk
         pending: list = []
+        used_devices: set = set()
         for off in range(0, B, chunk_rows):
             chunk = roots_mat[off : off + chunk_rows]
-            b = 1 << max(5, (len(chunk) - 1).bit_length())  # pow2 bucket
-            b = ((b + mesh_n - 1) // mesh_n) * mesh_n  # whole device shards
-            padded = np.full((b, A), -1, np.int32)
-            padded[: len(chunk)] = chunk
+            with self.probe.phase(pipeline.PAD_PACK):
+                b = 1 << max(5, (len(chunk) - 1).bit_length())  # pow2
+                b = ((b + mesh_n - 1) // mesh_n) * mesh_n  # whole shards
+                padded = np.full((b, A), -1, np.int32)
+                padded[: len(chunk)] = chunk
             # a fully -1 pad row would make SPF roots all-absent: fine
             if self.mesh is not None:
-                out = fleet_fn(
-                    jax.device_put(padded, roots_sh),
-                    dev["src"],
-                    dev["dst"],
-                    dev["w"],
-                    dev["edge_ok"],
-                    dev["overloaded"],
-                    dev["soft"],
-                    dev["cand_area"],
-                    dev["cand_node"],
-                    dev["cand_ok"],
-                    dev["drain_metric"],
-                    dev["path_pref"],
-                    dev["source_pref"],
-                    dev["distance"],
-                    dev["cand_node_in_area"],
-                )
+                with self.probe.phase(pipeline.DEVICE_COMPUTE):
+                    out = fleet_fn(
+                        jax.device_put(padded, roots_sh),
+                        dev["src"],
+                        dev["dst"],
+                        dev["w"],
+                        dev["edge_ok"],
+                        dev["overloaded"],
+                        dev["soft"],
+                        dev["cand_area"],
+                        dev["cand_node"],
+                        dev["cand_ok"],
+                        dev["drain_metric"],
+                        dev["path_pref"],
+                        dev["source_pref"],
+                        dev["distance"],
+                        dev["cand_node_in_area"],
+                    )
             elif pool_devs is not None:
                 idx = pool_devs[(off // chunk_rows) % len(pool_devs)]
-                out = call_jit_guarded(
-                    fleet_multi_area_tables,
-                    roots=jax.device_put(
+                args = args_on(idx)
+                with self.probe.phase(pipeline.TRANSFER, device=idx):
+                    roots_dev = jax.device_put(
                         jnp.asarray(padded), self.pool.device(idx)
-                    ),
-                    max_degree=D,
-                    per_area_distance=per_area,
-                    **args_on(idx),
-                )
+                    )
+                with self.probe.phase(
+                    pipeline.DEVICE_COMPUTE, device=idx
+                ), jit_guard.dispatch_device(idx):
+                    out = call_jit_guarded(
+                        fleet_multi_area_tables,
+                        roots=roots_dev,
+                        max_degree=D,
+                        per_area_distance=per_area,
+                        **args,
+                    )
+                self.pool.note_dispatch(idx)
+                used_devices.add(idx)
                 self.num_pool_dispatches += 1
             else:
-                out = call_jit_guarded(
-                    fleet_multi_area_tables,
-                    roots=jnp.asarray(padded),
-                    max_degree=D,
-                    per_area_distance=per_area,
-                    **dev,
-                )
+                with self.probe.phase(pipeline.DEVICE_COMPUTE, device=0):
+                    out = call_jit_guarded(
+                        fleet_multi_area_tables,
+                        roots=jnp.asarray(padded),
+                        max_degree=D,
+                        per_area_distance=per_area,
+                        **dev,
+                    )
+                used_devices.add(0)
             pending.append((off, len(chunk), out))
-        for (off, n, _out), (u, s_, l, v) in zip(
-            pending, jax.device_get([p[2] for p in pending])
+        with self.probe.phase(
+            pipeline.DEVICE_GET, devices=sorted(used_devices)
         ):
+            fetched = jax.device_get([p[2] for p in pending])
+        for (off, n, _out), (u, s_, l, v) in zip(pending, fetched):
             use[off : off + n] = u[:n]
             shortest[off : off + n] = s_[:n]
             lanes[off : off + n] = l[:n]
@@ -257,6 +291,8 @@ class FleetRibEngine:
         batch tables; None when node is unknown (caller falls back)."""
         from openr_tpu.decision.backend import TpuBackend
 
+        from openr_tpu.tracing import pipeline
+
         st = self._tables_for(area_link_states, prefix_state, change_seq)
         ri = st["index"].get(node)
         if ri is None:
@@ -264,29 +300,30 @@ class FleetRibEngine:
         self.num_decodes += 1
         tb = TpuBackend(self._vantage_solver(node))
         table = st["table"]
-        row_items = [
-            (int(r), table.row_prefix[r])
-            for r in np.nonzero(st["use"][ri].any(axis=1))[0]
-            if table.row_prefix[r] is not None
-        ]
-        results = tb._decode_rows(
-            row_items,
-            st["use"][ri],
-            st["shortest"][ri],
-            st["lanes"][ri],
-            st["valid"][ri],
-            st["dv"],
-            None,
-            st["enc"],
-            area_link_states,
-            prefix_state,
-        )
-        db = DecisionRouteDb()
-        for _prefix, entry in sorted(results.items()):
-            if entry is not None:
-                db.add_unicast_route(entry)
-        if self.solver.enable_node_segment_label:
-            tb.solver._build_node_label_routes(area_link_states, db)
+        with self.probe.phase(pipeline.DECODE):
+            row_items = [
+                (int(r), table.row_prefix[r])
+                for r in np.nonzero(st["use"][ri].any(axis=1))[0]
+                if table.row_prefix[r] is not None
+            ]
+            results = tb._decode_rows(
+                row_items,
+                st["use"][ri],
+                st["shortest"][ri],
+                st["lanes"][ri],
+                st["valid"][ri],
+                st["dv"],
+                None,
+                st["enc"],
+                area_link_states,
+                prefix_state,
+            )
+            db = DecisionRouteDb()
+            for _prefix, entry in sorted(results.items()):
+                if entry is not None:
+                    db.add_unicast_route(entry)
+            if self.solver.enable_node_segment_label:
+                tb.solver._build_node_label_routes(area_link_states, db)
         return db
 
     def _vantage_solver(self, node: str) -> SpfSolver:

@@ -413,7 +413,9 @@ class MultiAreaWhatIfEngine:
     failures plus one base snapshot as a single device batch and decodes
     only the prefixes whose merged route view changed."""
 
-    def __init__(self, solver: SpfSolver, mesh=None, pool=None) -> None:
+    def __init__(
+        self, solver: SpfSolver, mesh=None, pool=None, probe=None
+    ) -> None:
         """``mesh``: optional ``jax.sharding.Mesh`` with a ``batch``
         axis — failure snapshots then shard across the mesh
         (ops.fleet_tables.sharded_whatif_tables), bit-identical to the
@@ -421,10 +423,16 @@ class MultiAreaWhatIfEngine:
         :class:`~openr_tpu.parallel.mesh.DevicePool` — the failure
         batch then splits contiguously over the pool's HEALTHY chips as
         committed per-device dispatches (no shard_map requirement; a
-        quarantined chip's share re-packs onto the survivors)."""
+        quarantined chip's share re-packs onto the survivors).
+        ``probe``: optional
+        :class:`~openr_tpu.tracing.pipeline.PipelineProbe` sharing the
+        backend's phase/busy ledger."""
+        from openr_tpu.tracing.pipeline import disabled_probe
+
         self.solver = solver
         self.mesh = mesh
         self.pool = pool
+        self.probe = probe if probe is not None else disabled_probe()
         self._cache_key = None
         self._state = None
         self.num_engine_builds = 0
@@ -447,21 +455,25 @@ class MultiAreaWhatIfEngine:
         )
         if self._cache_key == key and self._state is not None:
             return self._state
+        from openr_tpu.tracing import pipeline
+
         me = self.solver.my_node_name
-        enc = encode_multi_area(area_link_states, me)
-        table = CandidateTable()
-        table.full_sync(prefix_state)
-        dv = table.derived(enc)
-        link_index = np.stack([t.link_index for t in enc.topos])
-        # (n1, n2) -> [(area_index, link_id)]; parallel links (within or
-        # across areas) are rejected like the single-area engine
-        pair_links: Dict[frozenset, list] = {}
-        for ai, t in enumerate(enc.topos):
-            for pair, vals in build_pair_links(
-                t.links, area_index=ai
-            ).items():
-                pair_links.setdefault(pair, []).extend(vals)
-        out_edges_by_area = [t.root_out_edges(me) for t in enc.topos]
+        with self.probe.phase(pipeline.ENCODE):
+            enc = encode_multi_area(area_link_states, me)
+        with self.probe.phase(pipeline.HOST_FETCH):
+            table = CandidateTable()
+            table.full_sync(prefix_state)
+            dv = table.derived(enc)
+            link_index = np.stack([t.link_index for t in enc.topos])
+            # (n1, n2) -> [(area_index, link_id)]; parallel links (within
+            # or across areas) are rejected like the single-area engine
+            pair_links: Dict[frozenset, list] = {}
+            for ai, t in enumerate(enc.topos):
+                for pair, vals in build_pair_links(
+                    t.links, area_index=ai
+                ).items():
+                    pair_links.setdefault(pair, []).extend(vals)
+            out_edges_by_area = [t.root_out_edges(me) for t in enc.topos]
         D = bucket_for(max(enc.max_out_degree(), 1), DEGREE_BUCKETS)
         self._state = dict(
             enc=enc,
@@ -542,39 +554,43 @@ class MultiAreaWhatIfEngine:
             # sharded dispatch splits the failure batch across devices
             gran = self.mesh.devices.size
             bucket = ((bucket + gran - 1) // gran) * gran
+        from openr_tpu.tracing import pipeline
+
         smax = max(
             [len(tup) for tup in fail_sets if tup is not None] or [1]
         )
-        S = bucket_for(smax, (1, 2, 4, 8, 16, 32, max(smax, 32)))
-        fa = np.full((bucket, S), -1, np.int32)
-        fl = np.full((bucket, S), -1, np.int32)
-        for i, tup in enumerate(fail_sets):
-            if tup is not None:
-                for s, (ai, li) in enumerate(tup):
-                    fa[i, s], fl[i, s] = ai, li
+        with self.probe.phase(pipeline.PAD_PACK):
+            S = bucket_for(smax, (1, 2, 4, 8, 16, 32, max(smax, 32)))
+            fa = np.full((bucket, S), -1, np.int32)
+            fl = np.full((bucket, S), -1, np.int32)
+            for i, tup in enumerate(fail_sets):
+                if tup is not None:
+                    for s, (ai, li) in enumerate(tup):
+                        fa[i, s], fl[i, s] = ai, li
 
-        kernel_args = dict(
-            src=jnp.asarray(enc.src),
-            dst=jnp.asarray(enc.dst),
-            w=jnp.asarray(enc.w),
-            edge_ok=jnp.asarray(enc.edge_ok),
-            link_index=jnp.asarray(st["link_index"]),
-            overloaded=jnp.asarray(enc.overloaded),
-            soft=jnp.asarray(enc.soft),
-            roots=jnp.asarray(enc.roots),
-        )
         from openr_tpu.ops.jit_guard import call_jit_guarded
 
-        cand_args = dict(
-            cand_area=jnp.asarray(dv.cand_area),
-            cand_node=jnp.asarray(dv.cand_node),
-            cand_ok=jnp.asarray(dv.cand_ok),
-            drain_metric=jnp.asarray(dv.drain_metric),
-            path_pref=jnp.asarray(dv.path_pref),
-            source_pref=jnp.asarray(dv.source_pref),
-            distance=jnp.asarray(dv.distance),
-            cand_node_in_area=jnp.asarray(dv.cand_node_in_area),
-        )
+        with self.probe.phase(pipeline.TRANSFER):
+            kernel_args = dict(
+                src=jnp.asarray(enc.src),
+                dst=jnp.asarray(enc.dst),
+                w=jnp.asarray(enc.w),
+                edge_ok=jnp.asarray(enc.edge_ok),
+                link_index=jnp.asarray(st["link_index"]),
+                overloaded=jnp.asarray(enc.overloaded),
+                soft=jnp.asarray(enc.soft),
+                roots=jnp.asarray(enc.roots),
+            )
+            cand_args = dict(
+                cand_area=jnp.asarray(dv.cand_area),
+                cand_node=jnp.asarray(dv.cand_node),
+                cand_ok=jnp.asarray(dv.cand_ok),
+                drain_metric=jnp.asarray(dv.drain_metric),
+                path_pref=jnp.asarray(dv.path_pref),
+                source_pref=jnp.asarray(dv.source_pref),
+                distance=jnp.asarray(dv.distance),
+                cand_node_in_area=jnp.asarray(dv.cand_node_in_area),
+            )
         if self.mesh is not None:
             from openr_tpu.ops.fleet_tables import sharded_whatif_tables
             from openr_tpu.parallel.mesh import batch_sharding, replicated
@@ -609,38 +625,53 @@ class MultiAreaWhatIfEngine:
                 # with its own -1 pad row (the pad row solves the
                 # unperturbed topology, so every shard carries a base —
                 # the first shard's is the one the decode diffs against)
+                from openr_tpu.ops import jit_guard
+
                 shards = self.pool.shard_ranges(B, pool_devs)
                 dispatched = []
                 for idx, lo, hi in shards:
                     n_i = hi - lo
-                    bucket_i = bucket_for(
-                        n_i + 1,
-                        FAILURE_BUCKETS
-                        + (max(n_i + 1, FAILURE_BUCKETS[-1]),),
-                    )
-                    fa_i = np.full((bucket_i, S), -1, np.int32)
-                    fl_i = np.full((bucket_i, S), -1, np.int32)
-                    fa_i[:n_i] = fa[lo:hi]
-                    fl_i[:n_i] = fl[lo:hi]
+                    with self.probe.phase(pipeline.PAD_PACK, device=idx):
+                        bucket_i = bucket_for(
+                            n_i + 1,
+                            FAILURE_BUCKETS
+                            + (max(n_i + 1, FAILURE_BUCKETS[-1]),),
+                        )
+                        fa_i = np.full((bucket_i, S), -1, np.int32)
+                        fl_i = np.full((bucket_i, S), -1, np.int32)
+                        fa_i[:n_i] = fa[lo:hi]
+                        fl_i[:n_i] = fl[lo:hi]
                     d = self.pool.device(idx)
-                    out = call_jit_guarded(
-                        whatif_multi_area_tables,
-                        fail_area=jax.device_put(jnp.asarray(fa_i), d),
-                        fail_link=jax.device_put(jnp.asarray(fl_i), d),
-                        max_degree=st["D"],
-                        per_area_distance=per_area,
-                        **{
-                            k: jax.device_put(v, d)
-                            for k, v in kernel_args.items()
-                        },
-                        **{
-                            k: jax.device_put(v, d)
-                            for k, v in cand_args.items()
-                        },
-                    )
+                    with self.probe.phase(pipeline.TRANSFER, device=idx):
+                        shard_kwargs = dict(
+                            fail_area=jax.device_put(jnp.asarray(fa_i), d),
+                            fail_link=jax.device_put(jnp.asarray(fl_i), d),
+                            **{
+                                k: jax.device_put(v, d)
+                                for k, v in kernel_args.items()
+                            },
+                            **{
+                                k: jax.device_put(v, d)
+                                for k, v in cand_args.items()
+                            },
+                        )
+                    with self.probe.phase(
+                        pipeline.DEVICE_COMPUTE, device=idx
+                    ), jit_guard.dispatch_device(idx):
+                        out = call_jit_guarded(
+                            whatif_multi_area_tables,
+                            max_degree=st["D"],
+                            per_area_distance=per_area,
+                            **shard_kwargs,
+                        )
+                    self.pool.note_dispatch(idx)
                     dispatched.append((n_i, out))
                     self.num_pool_dispatches += 1
-                fetched = jax.device_get([o for _n, o in dispatched])
+                with self.probe.phase(
+                    pipeline.DEVICE_GET,
+                    devices=[i for i, _lo, _hi in shards],
+                ):
+                    fetched = jax.device_get([o for _n, o in dispatched])
                 parts = []
                 for k in range(4):
                     rows = [
@@ -656,8 +687,8 @@ class MultiAreaWhatIfEngine:
                     parts.append(np.concatenate(rows, axis=0))
                 use, shortest, lanes, valid = parts
             else:
-                use, shortest, lanes, valid = jax.device_get(
-                    call_jit_guarded(
+                with self.probe.phase(pipeline.DEVICE_COMPUTE, device=0):
+                    pending = call_jit_guarded(
                         whatif_multi_area_tables,
                         fail_area=jnp.asarray(fa),
                         fail_link=jnp.asarray(fl),
@@ -666,53 +697,57 @@ class MultiAreaWhatIfEngine:
                         **kernel_args,
                         **cand_args,
                     )
-                )
+                with self.probe.phase(pipeline.DEVICE_GET, devices=[0]):
+                    use, shortest, lanes, valid = jax.device_get(pending)
         if st["base_dist"] is None:
-            dist, _nh = call_jit_guarded(
-                multi_area_spf_tables,
-                kernel_args["src"],
-                kernel_args["dst"],
-                kernel_args["w"],
-                kernel_args["edge_ok"],
-                kernel_args["overloaded"],
-                kernel_args["roots"],
-                max_degree=st["D"],
-            )
-            st["base_dist"] = np.asarray(jax.device_get(dist))
+            with self.probe.phase(pipeline.DEVICE_COMPUTE):
+                dist, _nh = call_jit_guarded(
+                    multi_area_spf_tables,
+                    kernel_args["src"],
+                    kernel_args["dst"],
+                    kernel_args["w"],
+                    kernel_args["edge_ok"],
+                    kernel_args["overloaded"],
+                    kernel_args["roots"],
+                    max_degree=st["D"],
+                )
+            with self.probe.phase(pipeline.DEVICE_GET):
+                st["base_dist"] = np.asarray(jax.device_get(dist))
         self.num_sweeps += 1
 
         # ---- merged route view per snapshot (SpfSolver.cpp:276-302) ----
-        B1, P, _A = valid.shape
-        m = np.where(valid, shortest, np.inf)  # [B1, P, A]
-        m_star = m.min(axis=2)  # [B1, P]
-        at_min = valid & (m == m_star[:, :, None])
-        eff_lanes = lanes & at_min[:, :, :, None]  # [B1, P, A, D]
-        merged = eff_lanes.sum(axis=(2, 3))  # nexthop count
-        req = np.max(
-            np.where(use, dv.min_nexthop[None, :, :], 0), axis=2
-        )  # [B1, P]
-        my_gid = table._node_gid.get(me)
-        if my_gid is None:
-            self_win = np.zeros((B1, P), bool)
-        else:
-            self_win = (use & (table.adv_gid[None, :, :] == my_gid)).any(
-                axis=2
+        with self.probe.phase(pipeline.DECODE):
+            B1, P, _A = valid.shape
+            m = np.where(valid, shortest, np.inf)  # [B1, P, A]
+            m_star = m.min(axis=2)  # [B1, P]
+            at_min = valid & (m == m_star[:, :, None])
+            eff_lanes = lanes & at_min[:, :, :, None]  # [B1, P, A, D]
+            merged = eff_lanes.sum(axis=(2, 3))  # nexthop count
+            req = np.max(
+                np.where(use, dv.min_nexthop[None, :, :], 0), axis=2
+            )  # [B1, P]
+            my_gid = table._node_gid.get(me)
+            if my_gid is None:
+                self_win = np.zeros((B1, P), bool)
+            else:
+                self_win = (
+                    use & (table.adv_gid[None, :, :] == my_gid)
+                ).any(axis=2)
+            v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+            include = np.asarray(
+                [
+                    p is not None and (v4_ok or not prefix_is_v4(p))
+                    for p in table.row_prefix
+                ],
+                bool,
             )
-        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
-        include = np.asarray(
-            [
-                p is not None and (v4_ok or not prefix_is_v4(p))
-                for p in table.row_prefix
-            ],
-            bool,
-        )
-        route_ok = (
-            include[None, :]
-            & valid.any(axis=2)
-            & ~self_win
-            & (merged > 0)
-            & (merged >= req)
-        )
+            route_ok = (
+                include[None, :]
+                & valid.any(axis=2)
+                & ~self_win
+                & (merged > 0)
+                & (merged >= req)
+            )
 
         base = B  # the first pad row: the unperturbed snapshot
         out_edges_by_area = st["out_edges_by_area"]
@@ -777,10 +812,11 @@ class MultiAreaWhatIfEngine:
             return changes
 
         if simultaneous:
-            changes = changes_for(0)
-            any_on_dag = bool(
-                any(on_dag(ai, li) for ai, li in (fail_sets[0] or ()))
-            )
+            with self.probe.phase(pipeline.DECODE):
+                changes = changes_for(0)
+                any_on_dag = bool(
+                    any(on_dag(ai, li) for ai, li in (fail_sets[0] or ()))
+                )
             return {
                 "eligible": True,
                 "vantage": me,
@@ -797,28 +833,29 @@ class MultiAreaWhatIfEngine:
             }
 
         out = []
-        for s, ((n1, n2), tup) in enumerate(zip(link_failures, pairs)):
-            if tup is None:
-                out.append(errors[s])
-                continue
-            changes = changes_for(s)
-            entry = {
-                "link": [n1, n2],
-                "area": enc.areas[tup[0][0]],
-                "on_shortest_path_dag": bool(
-                    any(on_dag(ai, li) for ai, li in tup)
-                ),
-                "routes_changed": len(changes),
-                "changes": changes,
-            }
-            if len(tup) > 1:
-                # parallel bundle (within or across areas): every member
-                # failed at once as one set
-                entry["links_failed"] = len(tup)
-                entry["areas"] = sorted(
-                    {enc.areas[ai] for ai, _ in tup}
-                )
-            out.append(entry)
+        with self.probe.phase(pipeline.DECODE):
+            for s, ((n1, n2), tup) in enumerate(zip(link_failures, pairs)):
+                if tup is None:
+                    out.append(errors[s])
+                    continue
+                changes = changes_for(s)
+                entry = {
+                    "link": [n1, n2],
+                    "area": enc.areas[tup[0][0]],
+                    "on_shortest_path_dag": bool(
+                        any(on_dag(ai, li) for ai, li in tup)
+                    ),
+                    "routes_changed": len(changes),
+                    "changes": changes,
+                }
+                if len(tup) > 1:
+                    # parallel bundle (within or across areas): every
+                    # member failed at once as one set
+                    entry["links_failed"] = len(tup)
+                    entry["areas"] = sorted(
+                        {enc.areas[ai] for ai, _ in tup}
+                    )
+                out.append(entry)
         return {
             "eligible": True,
             "vantage": me,

@@ -251,6 +251,46 @@ class EmulatedNetwork:
             for name, node in sorted(self.nodes.items())
         }
 
+    def metrics_snapshots(self, exclude: tuple = ()) -> list:
+        """One MetricsSnapshot per node (sorted by name) — the input to
+        `render_prometheus` / the JSONL export.  `exclude` drops counter
+        prefixes (deterministic replays pass
+        monitor.metrics.NONDETERMINISTIC_PREFIXES)."""
+        from openr_tpu.monitor.metrics import MetricsSnapshot
+
+        return [
+            MetricsSnapshot.capture(node, exclude=exclude)
+            for _name, node in sorted(self.nodes.items())
+        ]
+
+    def render_prometheus(self) -> str:
+        """The whole emulation as ONE Prometheus text-exposition
+        document (every node a `node=` label) — what a scrape of the
+        fleet would ingest."""
+        from openr_tpu.monitor.metrics import render_prometheus
+
+        return render_prometheus(self.metrics_snapshots())
+
+    def export_metrics_jsonl(self, path: str, exclude: tuple = ()) -> int:
+        """Write one snapshot line per node; returns lines written."""
+        from openr_tpu.monitor.metrics import MetricsJsonlWriter
+
+        writer = MetricsJsonlWriter(path, exclude=exclude)
+        return writer.write_nodes(self.nodes.values())
+
+    def flight_dumps(self) -> Dict[str, Optional[bytes]]:
+        """Per-node newest flight-recorder dump bytes (None = no dump
+        fired / recorder disabled) — chaos tests byte-compare these
+        across seeded replays."""
+        return {
+            name: (
+                node.flight_recorder.last_dump
+                if node.flight_recorder is not None
+                else None
+            )
+            for name, node in sorted(self.nodes.items())
+        }
+
     def merged_histogram(self, key: str):
         """Cross-node merge of one histogram key (None when no node
         observed it) — convergence percentiles for the whole emulation."""

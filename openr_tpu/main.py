@@ -418,6 +418,45 @@ class OpenrNode:
         self.monitor.add_counter_provider(self.dispatcher.queue_stats)
         self.monitor.add_counter_provider(self._queue_gauges)
         self.monitor.add_counter_provider(self.serving.gauges)
+        # pipeline attribution gauges: per-chip busy ms / utilization
+        # accumulated by the backend + fleet/what-if engines' shared
+        # PipelineProbe (pipeline.devN.*)
+        probe = getattr(backend, "probe", None)
+        if probe is not None:
+            self.monitor.add_counter_provider(probe.gauges)
+        # flight recorder: bounded post-mortem ring, auto-dumped on chip
+        # quarantine (governor hook), watchdog crash, and invariant
+        # breach (chaos harness reads node.flight_recorder)
+        self.flight_recorder = None
+        tc = config.tracing_config
+        if tc.enabled and tc.flight_recorder:
+            from openr_tpu.tracing import FlightRecorder
+
+            self.flight_recorder = FlightRecorder(
+                self.name,
+                clock,
+                self.tracer,
+                self.counters,
+                max_spans=tc.flight_recorder_spans,
+                max_frames=tc.flight_recorder_frames,
+                out_dir=tc.flight_recorder_dir,
+                queue_stats_fn=self._queue_gauges,
+                generation_fn=lambda: list(self.decision.generation_key()),
+            )
+            if governor is not None:
+                governor.add_quarantine_listener(
+                    self.flight_recorder.on_quarantine
+                )
+            # one provider does double duty: every metrics sweep appends
+            # a counter-delta/queue-watermark frame to the rolling
+            # window AND exports the recorder's own gauges
+            recorder = self.flight_recorder
+
+            def _recorder_gauges():
+                recorder.record_frame("monitor_sweep")
+                return recorder.stats()
+
+            self.monitor.add_counter_provider(_recorder_gauges)
         self.watchdog: Optional[Watchdog] = None
         if config.enable_watchdog:
             wd = config.watchdog_config
@@ -430,6 +469,12 @@ class OpenrNode:
                 max_memory_mb=wd.max_memory_mb,
                 max_queue_size=wd.max_queue_size,
             )
+            if self.flight_recorder is not None:
+                # the post-mortem freezes BEFORE fire_crash tears the
+                # node down (supervisor restart wipes in-flight state)
+                self.watchdog.add_crash_listener(
+                    self.flight_recorder.on_watchdog_crash
+                )
         self._all_modules = [
             self.monitor,
             self.kv_store,

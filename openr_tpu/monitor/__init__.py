@@ -1,0 +1,22 @@
+"""Monitor plane: event-log ring + process metrics (`monitor`) and the
+point-in-time metrics-export tier (`metrics`: `MetricsSnapshot`,
+Prometheus text exposition, deterministic JSONL writer)."""
+
+from openr_tpu.monitor.metrics import (
+    NONDETERMINISTIC_PREFIXES,
+    MetricsJsonlWriter,
+    MetricsSnapshot,
+    parse_prometheus,
+    render_prometheus,
+)
+from openr_tpu.monitor.monitor import Monitor, SystemMetrics
+
+__all__ = [
+    "MetricsJsonlWriter",
+    "MetricsSnapshot",
+    "Monitor",
+    "NONDETERMINISTIC_PREFIXES",
+    "SystemMetrics",
+    "parse_prometheus",
+    "render_prometheus",
+]

@@ -1,0 +1,299 @@
+"""Metrics export tier — point-in-time snapshots off the node.
+
+The ctrl API's ``getCounters`` answers a pull from one operator; fleet
+monitoring needs the whole metric surface (counters + gauge providers +
+histogram BUCKETS, not just percentiles) in a form external systems
+ingest.  Two renderings of one `MetricsSnapshot`:
+
+  * **Prometheus text exposition** (`render_prometheus`): counters and
+    gauges as ``gauge`` samples, fixed-bucket histograms as classic
+    Prometheus ``histogram`` families (cumulative ``_bucket{le=..}`` +
+    ``_sum`` + ``_count``), every sample labeled ``node="..."`` so one
+    scrape of an emulation covers all nodes.  `parse_prometheus` is the
+    inverse used by the round-trip test — the exposition this module
+    emits must survive its own parser exactly.
+  * **JSONL** (`MetricsJsonlWriter`): one snapshot per line, sorted
+    keys, driven by the injected Clock (``--metrics-export PATH`` in
+    ``--emulate`` mode) — under SimClock two identical seeded runs
+    write byte-identical files, which is what makes snapshot diffs a
+    usable regression instrument.
+
+Every snapshot is generation-stamped (Decision's content-address key,
+so a sample is attributable to the exact LSDB/policy state it measured)
+and env-stamped (python/jax identity; deliberately NOT loadavg or RSS —
+the stamp must be stable across replays of one seed).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: counter prefixes excluded from DETERMINISTIC exports (flight-recorder
+#: dumps, seeded-replay JSONL): process CPU/RSS and wall-clock rates
+#: differ across replays of the same seed and would break byte-diffing
+NONDETERMINISTIC_PREFIXES = ("process.",)
+
+
+def env_stamp() -> Dict[str, Any]:
+    """Replay-stable environment identity: interpreter + jax version.
+    jax attributes are read only when jax is ALREADY imported — a
+    metrics sweep must never be the thing that boots an accelerator
+    platform (same rule as the backend's pool gauges)."""
+    import platform
+    import sys
+
+    jax_mod = sys.modules.get("jax")
+    return {
+        "python": platform.python_version(),
+        "jax": getattr(jax_mod, "__version__", "") if jax_mod else "",
+    }
+
+
+class MetricsSnapshot:
+    """One node's full metric surface at one instant."""
+
+    def __init__(
+        self,
+        node: str,
+        ts_ms: int,
+        generation: Any,
+        env: Dict[str, Any],
+        counters: Dict[str, float],
+        histograms: Dict[str, Dict[str, Any]],
+    ) -> None:
+        self.node = node
+        self.ts_ms = ts_ms
+        self.generation = generation
+        self.env = env
+        self.counters = counters
+        self.histograms = histograms
+
+    @classmethod
+    def capture(
+        cls,
+        node=None,
+        *,
+        counters=None,
+        node_name: str = "",
+        clock=None,
+        generation: Any = None,
+        exclude: Tuple[str, ...] = (),
+    ) -> "MetricsSnapshot":
+        """Snapshot an OpenrNode (or a bare CounterMap).
+
+        Given a full node, the Monitor's gauge providers are swept first
+        so provider-backed gauges (backend tallies, pool health, tracer
+        drop counts, pipeline busy gauges) are current at capture time
+        instead of stale from the last periodic sweep.  ``exclude``
+        drops counter-key prefixes — deterministic exports pass
+        :data:`NONDETERMINISTIC_PREFIXES`."""
+        if node is not None:
+            counters = node.counters
+            node_name = node.name
+            clock = node.clock
+            monitor = getattr(node, "monitor", None)
+            if monitor is not None:
+                monitor.sample_providers()
+            if generation is None:
+                decision = getattr(node, "decision", None)
+                if decision is not None:
+                    generation = list(decision.generation_key())
+        if counters is None:
+            raise ValueError("capture needs a node or a CounterMap")
+        counter_vals = {
+            k: v
+            for k, v in sorted(counters.dump().items())
+            if not exclude or not k.startswith(exclude)
+        }
+        hists: Dict[str, Dict[str, Any]] = {}
+        for key in counters.histogram_keys():
+            h = counters.histogram(key)
+            snap = dict(h.config())
+            snap.update(
+                count=h.count,
+                sum=h.total,
+                min=h.vmin,
+                max=h.vmax,
+                buckets=[[edge, c] for edge, c in h.bucket_items()],
+            )
+            hists[key] = snap
+        return cls(
+            node=node_name,
+            ts_ms=int(clock.now_ms()) if clock is not None else 0,
+            generation=generation,
+            env=env_stamp(),
+            counters=counter_vals,
+            histograms=hists,
+        )
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "node": self.node,
+            "ts_ms": self.ts_ms,
+            "generation": self.generation,
+            "env": self.env,
+            "counters": self.counters,
+            "histograms": self.histograms,
+        }
+
+    def to_jsonl(self) -> str:
+        """One deterministic line: sorted keys, no float repr games
+        (json round-trips doubles exactly)."""
+        return json.dumps(
+            self.to_wire(), sort_keys=True, separators=(",", ":")
+        )
+
+
+# -- Prometheus text exposition --------------------------------------------
+
+
+def _metric_name(key: str) -> str:
+    return "openr_" + _NAME_RE.sub("_", key)
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_prometheus(snapshots: Iterable[MetricsSnapshot]) -> str:
+    """All nodes' snapshots as one text-exposition document.  Samples of
+    one metric family are contiguous under a single ``# TYPE`` header
+    (the format's grouping requirement), labeled per node."""
+    snaps = list(snapshots)
+    gauge_keys: Dict[str, List[Tuple[str, float]]] = {}
+    for s in snaps:
+        for k, v in s.counters.items():
+            gauge_keys.setdefault(k, []).append((s.node, float(v)))
+    hist_keys: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+    for s in snaps:
+        for k, h in s.histograms.items():
+            hist_keys.setdefault(k, []).append((s.node, h))
+    lines: List[str] = []
+    for key in sorted(gauge_keys):
+        name = _metric_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        for node, v in gauge_keys[key]:
+            lines.append(f'{name}{{node="{node}"}} {_fmt(v)}')
+    for key in sorted(hist_keys):
+        name = _metric_name(key)
+        lines.append(f"# TYPE {name} histogram")
+        for node, h in hist_keys[key]:
+            cum = 0
+            for edge, c in h["buckets"]:
+                cum += c
+                le = _fmt(float(edge))
+                lines.append(
+                    f'{name}_bucket{{node="{node}",le="{le}"}} {cum}'
+                )
+            if not h["buckets"] or h["buckets"][-1][0] != float("inf"):
+                lines.append(
+                    f'{name}_bucket{{node="{node}",le="+Inf"}} {h["count"]}'
+                )
+            lines.append(f'{name}_sum{{node="{node}"}} {_fmt(h["sum"])}')
+            lines.append(f'{name}_count{{node="{node}"}} {h["count"]}')
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$"
+)
+_LABEL_RE = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>[^"]*)"')
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse a text exposition back into
+    ``{metric: {"type": t, "samples": {(label items): value}}}`` —
+    strict enough that a malformed document (bad label syntax, sample
+    before its TYPE header, non-float value) raises ValueError, which is
+    the property the round-trip test leans on."""
+    metrics: Dict[str, Dict[str, Any]] = {}
+    current_family = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                raise ValueError(f"line {lineno}: malformed TYPE header")
+            _, _, name, mtype = parts
+            metrics[name] = {"type": mtype, "samples": {}}
+            current_family = name
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if (
+                name.endswith(suffix)
+                and current_family is not None
+                and name == current_family + suffix
+            ):
+                base = current_family
+                break
+        fam = metrics.get(base) or metrics.get(name)
+        if fam is None:
+            raise ValueError(
+                f"line {lineno}: sample {name} before its TYPE header"
+            )
+        labels_raw = m.group("labels") or ""
+        labels = tuple(
+            (lm.group("k"), lm.group("v"))
+            for lm in _LABEL_RE.finditer(labels_raw)
+        )
+        consumed = "".join(f'{k}="{v}",' for k, v in labels).rstrip(",")
+        if labels_raw and consumed != labels_raw.rstrip(","):
+            raise ValueError(f"line {lineno}: malformed labels {labels_raw!r}")
+        try:
+            value = float(m.group("value"))
+        except ValueError as e:
+            raise ValueError(f"line {lineno}: bad value") from e
+        fam["samples"][(name,) + labels] = value
+    return metrics
+
+
+# -- JSONL periodic writer -------------------------------------------------
+
+
+class MetricsJsonlWriter:
+    """Append-structured snapshot log: one JSON line per node per sweep.
+    The caller owns cadence (an emulation fiber sleeping on the injected
+    Clock); this class owns only deterministic serialization."""
+
+    def __init__(self, path: str, exclude: Tuple[str, ...] = ()) -> None:
+        self.path = path
+        self.exclude = exclude
+        self.num_lines = 0
+        # truncate: an export file is one run's record, not an append log
+        with open(path, "w"):
+            pass
+
+    def write_nodes(self, nodes: Iterable) -> int:
+        """Capture + append one line per node (sorted by name for a
+        stable inter-node order)."""
+        snaps = [
+            MetricsSnapshot.capture(node, exclude=self.exclude)
+            for node in sorted(nodes, key=lambda n: n.name)
+        ]
+        with open(self.path, "a") as f:
+            for s in snaps:
+                f.write(s.to_jsonl() + "\n")
+        self.num_lines += len(snaps)
+        return len(snaps)

@@ -138,6 +138,19 @@ class Monitor(Actor):
         """Register a gauge provider; sampled every metrics sweep."""
         self._providers.append(provider)
 
+    def sample_providers(self) -> None:
+        """Sweep ONLY the registered gauge providers (no process.*
+        sampling).  The metrics-export tier calls this at snapshot
+        capture so provider-backed gauges are current at the captured
+        instant — and stays deterministic under SimClock, which the
+        wall-clock process metrics are not."""
+        for provider in self._providers:
+            try:
+                for key, value in provider().items():
+                    self.counters.set(key, value)
+            except Exception:  # noqa: BLE001 - a sick provider must not
+                self.counters.bump("monitor.provider_errors")  # kill sampling
+
     def sample_system_metrics(self) -> None:
         rss = self.system_metrics.rss_bytes()
         if rss is not None:
@@ -148,10 +161,5 @@ class Monitor(Actor):
         self.counters.set(
             "process.uptime.seconds", self.clock.now() - self._start_time
         )
-        for provider in self._providers:
-            try:
-                for key, value in provider().items():
-                    self.counters.set(key, value)
-            except Exception:  # noqa: BLE001 - a sick provider must not
-                self.counters.bump("monitor.provider_errors")  # kill sampling
+        self.sample_providers()
         self.touch()

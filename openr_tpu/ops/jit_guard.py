@@ -51,6 +51,26 @@ def trace_scope(tracer, ctx):
     finally:
         _trace_scope = prev
 
+#: pool-device index of the dispatch currently being issued (None =
+#: unattributed/legacy single-device path) — set by the per-shard
+#: dispatch loops so kernel spans carry the chip like output rows do
+_dispatch_device: Optional[int] = None
+
+
+@contextlib.contextmanager
+def dispatch_device(index: Optional[int]):
+    """Attribute guarded dispatches inside the body to pool chip
+    ``index``: their `decision.spf_kernel` spans gain a ``device`` attr,
+    which the Chrome-trace exporter renders as a per-chip lane."""
+    global _dispatch_device
+    prev = _dispatch_device
+    _dispatch_device = index
+    try:
+        yield
+    finally:
+        _dispatch_device = prev
+
+
 #: guard-trip tally, exported into Monitor's gauge sweep via
 #: `counter_snapshot` (main.py registers it with add_counter_provider)
 #: so corruption heals show up in prod counter dumps instead of only in
@@ -78,8 +98,11 @@ def call_jit_guarded(fn, *args, **kwargs):
 def _call_traced(scope, fn, args, kwargs):
     tracer, ctx = scope
     name = getattr(fn, "__name__", None) or type(fn).__name__
+    attrs = {"kernel": name}
+    if _dispatch_device is not None:
+        attrs["device"] = _dispatch_device
     span = tracer.start_span(
-        "decision.spf_kernel", ctx, module="decision", kernel=name
+        "decision.spf_kernel", ctx, module="decision", **attrs
     )
     cache_size = getattr(fn, "_cache_size", None)
     before = cache_size() if callable(cache_size) else None

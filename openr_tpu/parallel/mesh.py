@@ -142,6 +142,11 @@ class DevicePool:
         self._healthy: List[bool] = [True] * len(devices)
         self.num_quarantines = 0
         self.num_restores = 0
+        #: per-chip committed-dispatch tally (route-build shards, fleet
+        #: root chunks, what-if failure shards all count here — the
+        #: pool is the shared dispatch plane), read by the pipeline
+        #: attribution gauges and `breeze resilience status`
+        self.num_dispatches: List[int] = [0] * len(devices)
 
     # -- read surface ------------------------------------------------------
 
@@ -167,6 +172,12 @@ class DevicePool:
 
     def healthy_mask(self) -> List[bool]:
         return list(self._healthy)
+
+    def note_dispatch(self, index: int) -> None:
+        """Count one committed dispatch on chip ``index`` (called by the
+        per-shard dispatch loops alongside the actual device_put/jit
+        call — the pool's view of how work actually spread)."""
+        self.num_dispatches[index] += 1
 
     def lead_index(self) -> Optional[int]:
         """Lowest-indexed healthy device (single-device dispatch target);
@@ -240,16 +251,20 @@ class DevicePool:
             "healthy_mask": self.healthy_mask(),
             "quarantines": self.num_quarantines,
             "restores": self.num_restores,
+            "dispatches": list(self.num_dispatches),
             "devices": [str(d) for d in self.devices],
         }
 
     def counter_snapshot(self, prefix: str = "parallel.pool") -> dict:
-        return {
+        out = {
             f"{prefix}.size": float(self.size),
             f"{prefix}.healthy": float(self.num_healthy),
             f"{prefix}.quarantines": float(self.num_quarantines),
             f"{prefix}.restores": float(self.num_restores),
         }
+        for i, n in enumerate(self.num_dispatches):
+            out[f"{prefix}.dev{i}.dispatches"] = float(n)
+        return out
 
 
 def sharded_spf_and_select(mesh: Mesh, max_degree: int):

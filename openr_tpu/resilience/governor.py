@@ -147,7 +147,25 @@ class BackendHealthGovernor:
         self.num_dispatch_failures = 0
         self.last_probe: Dict[str, object] = {}
         self.last_mismatch: Dict[str, object] = {}
+        #: quarantine observers (the flight recorder's auto-dump hook):
+        #: fired AFTER a quarantine transition settles, with
+        #: {"reason", "device"(per-chip) | "devices"(list) | None}
+        self._quarantine_listeners: List = []
         self._sync_latch()
+
+    def add_quarantine_listener(self, fn) -> None:
+        """Register ``fn(info: dict)`` fired on every quarantine
+        transition (whole-backend and per-chip).  Listener exceptions
+        are counted, never propagated — an observer must not break the
+        health plane it observes."""
+        self._quarantine_listeners.append(fn)
+
+    def _notify_quarantine(self, info: Dict[str, object]) -> None:
+        for fn in self._quarantine_listeners:
+            try:
+                fn(dict(info))
+            except Exception:  # noqa: BLE001 - observer must not break us
+                self.counters.bump("resilience.backend.listener_errors")
 
     # -- the latch (single writer) ------------------------------------------
 
@@ -438,6 +456,14 @@ class BackendHealthGovernor:
             "devices": list(culprits),
             "reason": reason,
         }
+        for k in culprits:
+            self._notify_quarantine(
+                {
+                    "reason": f"shadow:{reason}",
+                    "device": int(k),
+                    "devices": [int(c) for c in culprits],
+                }
+            )
         if chip_probe is not None and chip_probe not in culprits:
             # the probing chip's shard verified clean in this full RIB
             # check even though another chip was caught lying: that IS a
@@ -460,6 +486,7 @@ class BackendHealthGovernor:
         self.quarantine_reason = reason
         self.num_quarantines += 1
         self.counters.bump("resilience.backend.quarantines")
+        self._notify_quarantine({"reason": reason, "device": None})
 
     # -- shadow verification -------------------------------------------------
 
@@ -586,6 +613,9 @@ class BackendHealthGovernor:
         if pool.quarantine_device(index):
             self.num_chip_quarantines += 1
             self.counters.bump("resilience.backend.chip_quarantines")
+            self._notify_quarantine(
+                {"reason": reason, "device": int(index)}
+            )
         self._sync_latch()
         if not was and self.quarantined:
             self._note_quarantine(f"device{index}:{reason}")

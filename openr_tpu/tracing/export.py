@@ -36,6 +36,14 @@ def chrome_trace_events(spans: Iterable) -> List[Dict[str, Any]]:
             continue
         node = s.get("node", "")
         module = s.get("module") or s.get("name", "").split(".", 1)[0]
+        # chip-attributed spans (`decision.spf_kernel` shard dispatches,
+        # `resilience.probe` probes, `pipeline.device_compute`) get one
+        # lane PER CHIP so quarantine/probe/dispatch timelines line up
+        # per device in Perfetto instead of interleaving on one module
+        # track
+        device = (s.get("attrs") or {}).get("device")
+        if device is not None:
+            module = f"{module}.dev{device}"
         pid = pids.get(node)
         if pid is None:
             pid = pids[node] = len(pids) + 1

@@ -1,0 +1,195 @@
+"""Flight recorder — a bounded post-mortem ring that dumps itself.
+
+When an InvariantChecker breach, a chip quarantine, or a watchdog crash
+fires, the evidence an operator needs (the spans leading up to it, the
+counter movement, the queue watermarks) is usually GONE by the time a
+human attaches — rings rolled over, counters kept counting.  The
+recorder keeps a small per-node window of that evidence and, on a
+trigger, freezes it into one self-contained artifact:
+
+  * a Chrome-trace event list of the most recent completed spans (the
+    quarantine span tree for a lying chip is in here — `resilience.*`
+    spans carry the ``device`` attr, so Perfetto shows the chip lane);
+  * a `MetricsSnapshot` (counters + histogram buckets), with
+    wall-clock-dependent ``process.*`` gauges EXCLUDED so two seeded
+    replays of the same chaos plan produce byte-identical dumps — the
+    property that turns a post-mortem into a diffable regression
+    artifact (chaos tests assert it);
+  * the frame ring: periodic counter DELTAS + queue watermarks
+    (`record_frame` — the Watchdog calls it each sweep, so the dump
+    shows the few minutes of movement before the event, not just the
+    terminal totals).
+
+Dump targets: always in-memory (``dumps`` list + ``last_dump`` bytes,
+the ctrl/chaos-test surface); optionally a directory
+(``tracing_config.flight_recorder_dir``) where each dump lands as
+``flight_<node>_<seq>_<reason>.json`` — the seq is a deterministic
+counter, never a wall timestamp.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from openr_tpu.monitor.metrics import (
+    NONDETERMINISTIC_PREFIXES,
+    MetricsSnapshot,
+)
+from openr_tpu.tracing.export import chrome_trace_events
+
+_REASON_SAFE = re.compile(r"[^a-zA-Z0-9_.-]+")
+
+#: span attrs that reflect PROCESS-LOCAL jit-cache state (did this
+#: dispatch pay an XLA compile / a guard heal), not protocol state — a
+#: seeded replay in a warm process would legitimately differ on them,
+#: so dumps drop them to keep the byte-identical replay contract; the
+#: live trace surfaces (`get_traces`, Chrome export) keep them
+VOLATILE_SPAN_ATTRS = ("compiled", "healed")
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        node_name: str,
+        clock,
+        tracer,
+        counters,
+        max_spans: int = 512,
+        max_frames: int = 256,
+        max_dumps: int = 8,
+        out_dir: str = "",
+        queue_stats_fn: Optional[Callable[[], Dict[str, float]]] = None,
+        generation_fn: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        self.node_name = node_name
+        self.clock = clock
+        self.tracer = tracer
+        self.counters = counters
+        self.max_spans = max_spans
+        self.out_dir = out_dir
+        self._queue_stats = queue_stats_fn
+        self._generation = generation_fn
+        self._frames: Deque[Dict[str, Any]] = deque(maxlen=max_frames)
+        self._last_counters: Dict[str, float] = {}
+        self.dumps: Deque[bytes] = deque(maxlen=max_dumps)
+        self.last_dump: Optional[bytes] = None
+        self.last_reason: str = ""
+        self.num_dumps = 0
+        self._seq = 0
+
+    # -- the rolling window ------------------------------------------------
+
+    def record_frame(self, label: str = "") -> None:
+        """Append one frame: counter deltas since the previous frame +
+        current queue watermarks.  Cheap enough for every watchdog
+        sweep; deterministic under SimClock."""
+        now = dict(self.counters.dump())
+        deltas = {
+            k: v - self._last_counters.get(k, 0.0)
+            for k, v in now.items()
+            if v != self._last_counters.get(k, 0.0)
+            and not k.startswith(NONDETERMINISTIC_PREFIXES)
+        }
+        self._last_counters = now
+        frame: Dict[str, Any] = {
+            "ts_ms": int(self.clock.now_ms()),
+            "label": label,
+            "counter_deltas": dict(sorted(deltas.items())),
+        }
+        if self._queue_stats is not None:
+            frame["queue_watermarks"] = dict(
+                sorted(self._queue_stats().items())
+            )
+        self._frames.append(frame)
+
+    # -- trigger hooks (wired in main.py) ----------------------------------
+
+    def on_quarantine(self, info: Dict[str, Any]) -> None:
+        """BackendHealthGovernor quarantine listener."""
+        device = info.get("device")
+        tag = f"dev{device}" if device is not None else "backend"
+        self.dump(f"quarantine_{tag}", extra=info)
+
+    def on_watchdog_crash(self, reason: str) -> None:
+        self.dump("watchdog_crash", extra={"crash_reason": reason})
+
+    def on_invariant_breach(self, violation: str) -> None:
+        self.dump("invariant_breach", extra={"violation": violation})
+
+    # -- the dump ----------------------------------------------------------
+
+    def dump(self, reason: str, extra: Optional[Dict[str, Any]] = None) -> bytes:
+        """Freeze the window into one self-contained JSON artifact and
+        return its (deterministic) bytes."""
+        self.record_frame(label=f"dump:{reason}")
+        spans = []
+        for s in self.tracer.get_spans()[-self.max_spans:]:
+            wire = s.to_wire()
+            for attr in VOLATILE_SPAN_ATTRS:
+                wire.get("attrs", {}).pop(attr, None)
+            spans.append(wire)
+        snapshot = MetricsSnapshot.capture(
+            counters=self.counters,
+            node_name=self.node_name,
+            clock=self.clock,
+            generation=(
+                self._generation() if self._generation is not None else None
+            ),
+            exclude=NONDETERMINISTIC_PREFIXES,
+        )
+        doc = {
+            "kind": "openr_tpu_flight_recorder_dump",
+            "node": self.node_name,
+            "reason": reason,
+            "ts_ms": int(self.clock.now_ms()),
+            "seq": self._seq,
+            "extra": extra or {},
+            "chrome_trace": chrome_trace_events(spans),
+            "snapshot": snapshot.to_wire(),
+            "frames": list(self._frames),
+            "tracer": self.tracer.stats(),
+        }
+        payload = (
+            json.dumps(doc, sort_keys=True, separators=(",", ":"), default=str)
+            + "\n"
+        ).encode()
+        self.dumps.append(payload)
+        self.last_dump = payload
+        self.last_reason = reason
+        self.num_dumps += 1
+        if self.out_dir:
+            self._write_file(reason, payload)
+        self._seq += 1
+        return payload
+
+    def _write_file(self, reason: str, payload: bytes) -> None:
+        import os
+
+        safe = _REASON_SAFE.sub("_", reason) or "dump"
+        path = os.path.join(
+            self.out_dir, f"flight_{self.node_name}_{self._seq}_{safe}.json"
+        )
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(payload)
+        except OSError:
+            # a full/readonly disk must never turn a post-mortem into a
+            # second failure; the in-memory copy is still served
+            self.counters.bump("trace.flight_dump_write_errors")
+
+    # -- query surface -----------------------------------------------------
+
+    def last_dump_doc(self) -> Optional[Dict[str, Any]]:
+        if self.last_dump is None:
+            return None
+        return json.loads(self.last_dump.decode())
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "trace.flight_dumps": float(self.num_dumps),
+            "trace.flight_frames": float(len(self._frames)),
+        }

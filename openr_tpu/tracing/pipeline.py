@@ -1,0 +1,240 @@
+"""Pipeline attribution — every millisecond of a device dispatch named.
+
+BENCH_r02/r03 showed the end-to-end rebuild budget dominated by host
+work (`host_fetch_unique_tables_ms` 1696ms, `dispatch_sync_ms` 958ms)
+while the kernels took 84-150ms — but those numbers were bench-local
+stopwatches.  Before the pipelined host/device rebuild (ROADMAP) can
+overlap decode with compute, the live system must attribute every
+dispatch to a *phase* and a *chip*, continuously, through the same
+observability surfaces everything else uses.
+
+This module is the single source of truth for the phase taxonomy:
+
+=================  ========================================================
+phase              meaning
+=================  ========================================================
+``host_fetch``     reading protocol state into compute form (candidate-
+                   table sync, prefix/topology gathers — host memory only)
+``encode``         LSDB -> padded CSR encoding (``ops/csr.py``)
+``pad_pack``       bucketing/padding/shard packing of a batch
+``transfer``       host->device copies (``jax.device_put``, replicas)
+``device_compute`` a committed kernel dispatch; per-device attributable —
+                   each shard is its own dispatch on its own chip, so the
+                   sample carries a ``device`` attr exactly like rows do
+``device_get``     the blocking device->host fetch draining dispatches
+``decode``         device outputs -> RibUnicastEntries (host decode tail)
+``delta_extract``  diffing the new RouteDb against the previous one
+=================  ========================================================
+
+Surfaces: every phase sample lands in a ``pipeline.{phase}.ms``
+fixed-bucket histogram and (when tracing is on) a ``pipeline.{phase}``
+child span under the active trace scope; per-chip busy time accumulates
+into ``pipeline.devN.busy_ms`` / ``pipeline.devN.utilization`` gauges
+via :meth:`PipelineProbe.gauges` (a ``Monitor.add_counter_provider``
+provider).
+
+orlint's ``pipeline-phase-registry`` rule enforces that no other module
+spells a ``pipeline.*`` name as a free string — phase names are drawn
+from these constants or they do not exist.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Iterable, Optional
+
+# -- the phase registry (the ONLY place pipeline.* names are spelled) ------
+
+HOST_FETCH = "host_fetch"
+ENCODE = "encode"
+PAD_PACK = "pad_pack"
+TRANSFER = "transfer"
+DEVICE_COMPUTE = "device_compute"
+DEVICE_GET = "device_get"
+DECODE = "decode"
+DELTA_EXTRACT = "delta_extract"
+
+PHASES = (
+    HOST_FETCH,
+    ENCODE,
+    PAD_PACK,
+    TRANSFER,
+    DEVICE_COMPUTE,
+    DEVICE_GET,
+    DECODE,
+    DELTA_EXTRACT,
+)
+
+#: phases whose time is host-side work (the pipelining refactor's
+#: overlap candidates) vs the device round trip — the host/device split
+#: BENCH_PIPELINE reports
+HOST_PHASES = (HOST_FETCH, ENCODE, PAD_PACK, DECODE, DELTA_EXTRACT)
+DEVICE_PHASES = (TRANSFER, DEVICE_COMPUTE, DEVICE_GET)
+
+_PREFIX = "pipeline."
+
+
+def span_name(phase: str) -> str:
+    """``pipeline.{phase}`` — the child-span name for one phase scope."""
+    if phase not in PHASES:
+        raise ValueError(f"unknown pipeline phase {phase!r}")
+    return _PREFIX + phase
+
+
+def hist_key(phase: str) -> str:
+    """``pipeline.{phase}.ms`` — the fixed-bucket histogram key."""
+    if phase not in PHASES:
+        raise ValueError(f"unknown pipeline phase {phase!r}")
+    return _PREFIX + phase + ".ms"
+
+
+def device_busy_key(index: int) -> str:
+    return f"{_PREFIX}dev{int(index)}.busy_ms"
+
+
+def device_utilization_key(index: int) -> str:
+    return f"{_PREFIX}dev{int(index)}.utilization"
+
+
+class _PhaseScope:
+    """Context manager for one timed phase (allocated per phase entry;
+    the disabled probe short-circuits to a shared no-op instead)."""
+
+    __slots__ = ("_probe", "_phase", "_device", "_devices", "_span", "_t0")
+
+    def __init__(self, probe, phase, device, devices):
+        self._probe = probe
+        self._phase = phase
+        self._device = device
+        self._devices = devices
+
+    def __enter__(self):
+        probe = self._probe
+        self._t0 = probe.clock.now()
+        tracer = probe.tracer
+        if tracer is not None and tracer.enabled:
+            attrs = {}
+            if self._device is not None:
+                attrs["device"] = int(self._device)
+            self._span = tracer.start_span(
+                span_name(self._phase),
+                probe._current_ctx(),
+                module="pipeline",
+                **attrs,
+            )
+        else:
+            self._span = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        probe = self._probe
+        ms = (probe.clock.now() - self._t0) * 1000.0
+        if probe.counters is not None:
+            probe.counters.observe(hist_key(self._phase), ms)
+        if self._span is not None:
+            if exc_type is not None:
+                self._span.attrs["error"] = exc_type.__name__
+            probe.tracer.end_span(self._span)
+        if self._device is not None:
+            probe.note_busy(self._device, ms)
+        if self._devices:
+            # a blocking drain covering several in-flight chips charges
+            # the window to every one of them (the chip had committed
+            # work outstanding for the whole wait)
+            for d in self._devices:
+                probe.note_busy(d, ms)
+
+
+@contextlib.contextmanager
+def _noop_scope():
+    yield None
+
+
+class PipelineProbe:
+    """Per-node phase recorder shared by the Decision backend and the
+    fleet / what-if engines (they dispatch over the same DevicePool, so
+    their phase samples and chip-busy time belong on one ledger).
+
+    * timing goes through the injected Clock — SimClock runs observe
+      zero-width phases deterministically instead of host-jittered ones;
+    * a probe constructed without a clock is permanently disabled and
+      every ``phase(...)`` entry is a shared O(1) no-op, so library
+      embedders that never wire observability pay one attribute check;
+    * per-chip busy time: ``device=`` charges a committed per-shard
+      dispatch to its chip; ``devices=`` charges a blocking drain to
+      every chip it covered.  ``gauges()`` exports
+      ``pipeline.devN.busy_ms`` and ``pipeline.devN.utilization``
+      (busy / probe lifetime) for the Monitor provider sweep.
+    """
+
+    def __init__(self, clock=None, counters=None, tracer=None) -> None:
+        self.clock = clock
+        self.counters = counters
+        self.tracer = tracer
+        self.enabled = clock is not None and (
+            counters is not None or tracer is not None
+        )
+        self._busy_ms: Dict[int, float] = {}
+        self._t0 = clock.now() if clock is not None else 0.0
+
+    # -- phase scopes ------------------------------------------------------
+
+    def phase(
+        self,
+        phase: str,
+        device: Optional[int] = None,
+        devices: Optional[Iterable[int]] = None,
+    ):
+        """``with probe.phase(pipeline.ENCODE): ...`` — time one phase.
+
+        ``device`` marks a committed per-shard dispatch (chip-
+        attributable sample: span carries a ``device`` attr, busy time
+        charges to that chip); ``devices`` charges a blocking drain to
+        every listed chip."""
+        if not self.enabled:
+            return _noop_scope()
+        return _PhaseScope(
+            self, phase, device, list(devices) if devices else None
+        )
+
+    def _current_ctx(self):
+        """Parent pipeline spans under the active traced build (the
+        jit_guard trace scope Decision arms around backend builds) so
+        they nest beside the ``decision.spf_kernel`` spans."""
+        from openr_tpu.ops import jit_guard
+
+        scope = jit_guard._trace_scope
+        return scope[1] if scope is not None else None
+
+    # -- per-chip busy ledger ----------------------------------------------
+
+    def note_busy(self, device: int, ms: float) -> None:
+        d = int(device)
+        self._busy_ms[d] = self._busy_ms.get(d, 0.0) + ms
+
+    def busy_snapshot(self) -> Dict[int, float]:
+        """Cumulative per-chip busy ms (bench deltas subtract two of
+        these around a measured window)."""
+        return dict(self._busy_ms)
+
+    def gauges(self) -> Dict[str, float]:
+        """Monitor.add_counter_provider provider: per-chip busy ms and
+        utilization over the probe's lifetime."""
+        out: Dict[str, float] = {}
+        if not self.enabled:
+            return out
+        elapsed_ms = max((self.clock.now() - self._t0) * 1000.0, 1e-9)
+        for d in sorted(self._busy_ms):
+            busy = self._busy_ms[d]
+            out[device_busy_key(d)] = busy
+            out[device_utilization_key(d)] = min(busy / elapsed_ms, 1.0)
+        return out
+
+
+_DISABLED_PROBE = PipelineProbe()
+
+
+def disabled_probe() -> PipelineProbe:
+    """Shared always-off probe: the default for backends/engines built
+    without observability wiring, so call sites never None-check."""
+    return _DISABLED_PROBE

@@ -56,6 +56,14 @@ class Watchdog(Actor):
         self._metrics = metrics if metrics is not None else SystemMetrics()
         self._fire_crash = fire_crash or self._default_fire_crash
         self.crashed: Optional[str] = None  # first crash reason, for tests
+        #: crash observers fired BEFORE the crash sink (the flight
+        #: recorder's auto-dump: the post-mortem must be frozen before a
+        #: supervisor tears the node down); observer exceptions are
+        #: swallowed — a broken observer must not mask the crash itself
+        self._crash_listeners: List[Callable[[str], None]] = []
+
+    def add_crash_listener(self, fn: Callable[[str], None]) -> None:
+        self._crash_listeners.append(fn)
 
     # -- registration (Watchdog::addEvb / addQueue) ------------------------
 
@@ -137,6 +145,11 @@ class Watchdog(Actor):
         self.counters.bump("watchdog.crashes")
         if self.crashed is None:
             self.crashed = reason
+        for fn in self._crash_listeners:
+            try:
+                fn(reason)
+            except Exception:  # noqa: BLE001 - see _crash_listeners note
+                self.counters.bump("watchdog.listener_errors")
         self._fire_crash(reason)
 
     @staticmethod

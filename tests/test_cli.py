@@ -162,9 +162,12 @@ def test_cli_monitor_trace(live_node):
     assert {"name", "trace_id", "span_id", "node", "start_ms"} <= set(
         spans[0]
     )
-    # tree rendering names traces and indents spans under them
+    # tree rendering names traces and indents spans under them — with
+    # the drop-accounting summary first (ISSUE 7 satellite: dropped/
+    # evicted spans must be operator-visible in the trace view)
     out = _run(live_node, "monitor", "trace")
     assert "trace " in out and "kvstore.key_arrival" in out
+    assert "completed," in out and "dropped," in out and "evicted" in out
     # narrowing to one trace returns only that trace's spans
     tid = spans[-1]["trace_id"]
     one = json.loads(
@@ -189,6 +192,37 @@ def test_cli_monitor_histograms(live_node):
     assert set(filtered) and all(
         k.startswith("convergence.") for k in filtered
     )
+
+
+def test_cli_monitor_export(live_node, tmp_path):
+    """breeze monitor export: Prometheus text exposition (parsed back
+    with the strict parser) and the raw snapshot JSON."""
+    from openr_tpu.monitor.metrics import parse_prometheus
+
+    text = _run(live_node, "monitor", "export")
+    parsed = parse_prometheus(text)
+    key = ("openr_decision_route_build_runs", ("node", "node0"))
+    assert parsed["openr_decision_route_build_runs"]["samples"][key] >= 1
+    # histogram families carry buckets + sum + count
+    hist_names = [k for k, v in parsed.items() if v["type"] == "histogram"]
+    assert hist_names, "no histogram families in the exposition"
+    doc = json.loads(_run(live_node, "monitor", "export", "--format", "json"))
+    assert doc["node"] == "node0" and doc["counters"]
+    assert doc["generation"] is not None and doc["env"]["python"]
+    # --output writes the same payload to a file
+    out_file = tmp_path / "metrics.prom"
+    msg = _run(
+        live_node, "monitor", "export", "--output", str(out_file)
+    )
+    assert "wrote" in msg
+    assert parse_prometheus(out_file.read_text())
+
+
+def test_cli_monitor_flight_dump(live_node):
+    """breeze monitor flight-dump: graceful when no dump fired, full
+    JSON once one has (driven via the ctrl verb surface)."""
+    out = _run(live_node, "monitor", "flight-dump")
+    assert "no flight-recorder dump" in out
 
 
 def test_cli_serving_stats_and_queries(live_node):

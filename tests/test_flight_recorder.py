@@ -1,0 +1,327 @@
+"""Flight recorder (ISSUE 7 tentpole): the bounded post-mortem ring,
+its three auto-dump triggers (chip quarantine via the governor hook,
+watchdog crash, InvariantChecker breach), deterministic dump bytes, and
+the seeded-chaos acceptance — a ``tpu_corrupt(device_index=k)`` run
+auto-produces a dump holding chip k's quarantine span tree,
+byte-identical across two replays of one seed."""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import CounterMap, SimClock
+from openr_tpu.config import ParallelConfig, ResilienceConfig
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.tracing import FlightRecorder, Tracer
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def make_recorder(clock=None, counters=None, out_dir=""):
+    clock = clock or SimClock()
+    counters = counters if counters is not None else CounterMap()
+    tracer = Tracer("node0", clock=clock, counters=counters)
+    rec = FlightRecorder(
+        "node0", clock, tracer, counters,
+        out_dir=out_dir,
+        queue_stats_fn=lambda: {"messaging.queue.routes.depth": 2.0},
+        generation_fn=lambda: [5],
+    )
+    return rec, tracer, counters, clock
+
+
+# ---------------------------------------------------------------------------
+# the ring + dump mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_frames_record_counter_deltas_and_watermarks():
+    rec, _tracer, counters, _clock = make_recorder()
+    counters.bump("decision.route_build_runs", 2)
+    rec.record_frame("sweep")
+    counters.bump("decision.route_build_runs")
+    counters.set("process.memory.rss", 9.0)  # wall-clock noise: excluded
+    rec.record_frame("sweep")
+    frames = list(rec._frames)
+    assert frames[0]["counter_deltas"] == {"decision.route_build_runs": 2.0}
+    assert frames[1]["counter_deltas"] == {"decision.route_build_runs": 1.0}
+    assert frames[1]["queue_watermarks"] == {
+        "messaging.queue.routes.depth": 2.0
+    }
+
+
+def test_dump_is_self_contained_and_written_to_disk(tmp_path):
+    rec, tracer, counters, clock = make_recorder(out_dir=str(tmp_path))
+    span = tracer.start_span("decision.rebuild", module="decision")
+    tracer.end_span(span)
+    counters.bump("decision.route_build_runs")
+    payload = rec.dump("unit_test", extra={"device": 3})
+    doc = json.loads(payload.decode())
+    assert doc["kind"] == "openr_tpu_flight_recorder_dump"
+    assert doc["reason"] == "unit_test" and doc["extra"]["device"] == 3
+    names = [e["name"] for e in doc["chrome_trace"] if e.get("ph") == "X"]
+    assert "decision.rebuild" in names
+    assert doc["snapshot"]["counters"]["decision.route_build_runs"] == 1.0
+    assert doc["frames"][-1]["label"] == "dump:unit_test"
+    assert rec.last_dump == payload and rec.num_dumps == 1
+    files = list(tmp_path.glob("flight_node0_*_unit_test.json"))
+    assert len(files) == 1 and files[0].read_bytes() == payload
+    assert rec.last_dump_doc()["reason"] == "unit_test"
+
+
+def test_dump_strips_volatile_span_attrs_and_process_counters():
+    rec, tracer, counters, _clock = make_recorder()
+    span = tracer.start_span(
+        "decision.spf_kernel", module="decision", compiled=True, device=1
+    )
+    tracer.end_span(span, healed=True)
+    counters.set("process.cpu.pct", 55.0)
+    doc = json.loads(rec.dump("x").decode())
+    ev = [e for e in doc["chrome_trace"] if e.get("ph") == "X"][0]
+    assert "compiled" not in ev["args"] and "healed" not in ev["args"]
+    assert ev["args"]["device"] == 1  # chip attribution survives
+    assert not any(
+        k.startswith("process.") for k in doc["snapshot"]["counters"]
+    )
+
+
+def test_dump_bytes_deterministic_for_identical_state():
+    def one():
+        rec, tracer, counters, clock = make_recorder()
+        s = tracer.start_span("resilience.probe", module="resilience",
+                              device=2)
+        tracer.end_span(s, passed=False)
+        counters.bump("resilience.backend.chip_quarantines")
+        return rec.dump("quarantine_dev2")
+
+    assert one() == one()
+
+
+def test_dump_ring_is_bounded():
+    rec, _tracer, _counters, _clock = make_recorder()
+    for i in range(12):
+        rec.dump(f"r{i}")
+    assert rec.num_dumps == 12 and len(rec.dumps) == 8  # max_dumps
+
+
+# ---------------------------------------------------------------------------
+# trigger hooks
+# ---------------------------------------------------------------------------
+
+
+def test_governor_quarantine_hook_fires_a_chip_dump():
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.emulation.topology import build_adj_dbs, ring_edges
+    from openr_tpu.types import PrefixEntry
+
+    clock = SimClock()
+    counters = CounterMap()
+    tracer = Tracer("node0", clock=clock, counters=counters)
+    backend = TpuBackend(
+        SpfSolver("node0"),
+        clock=clock,
+        counters=counters,
+        tracer=tracer,
+        resilience=ResilienceConfig(shadow_sample_every=1, jitter_pct=0.0),
+        parallel=ParallelConfig(min_shard_rows=0),
+    )
+    rec = FlightRecorder("node0", clock, tracer, counters)
+    backend.governor.add_quarantine_listener(rec.on_quarantine)
+
+    ls = LinkState("0")
+    for db in build_adj_dbs(ring_edges(12)).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(12):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.7.{i}.0/24"))
+    als = {"0": ls}
+    backend.build_route_db(als, ps)
+    assert rec.num_dumps == 0
+    backend.inject_silent_corruption(True, device_index=3)
+    backend.build_route_db(als, ps, force_full=True)
+    assert backend.governor.num_chip_quarantines == 1
+    assert rec.num_dumps == 1 and rec.last_reason == "quarantine_dev3"
+    doc = rec.last_dump_doc()
+    assert doc["extra"]["device"] == 3
+    assert doc["extra"]["reason"].startswith("shadow:")
+    # the quarantine span tree is inside: the failed shadow check span
+    shadow = [
+        e for e in doc["chrome_trace"]
+        if e.get("ph") == "X" and e["name"] == "resilience.shadow_check"
+    ]
+    assert shadow and shadow[-1]["args"]["passed"] is False
+
+
+def test_watchdog_crash_dumps_before_the_crash_sink():
+    from openr_tpu.watchdog.watchdog import Watchdog
+
+    rec, _tracer, counters, clock = make_recorder()
+    order = []
+    rec_dump = rec.on_watchdog_crash
+
+    def spy_dump(reason):
+        order.append("dump")
+        rec_dump(reason)
+
+    wd = Watchdog(
+        "node0", clock, counters,
+        fire_crash=lambda reason: order.append("crash"),
+    )
+    wd.add_crash_listener(spy_dump)
+    wd._crash("Module decision fiber died")
+    assert order == ["dump", "crash"]
+    assert rec.last_reason == "watchdog_crash"
+    assert rec.last_dump_doc()["extra"]["crash_reason"] == (
+        "Module decision fiber died"
+    )
+
+
+def test_invariant_breach_dumps_every_recorded_node():
+    from openr_tpu.chaos.invariants import InvariantChecker, InvariantViolation
+
+    rec, _tracer, _counters, _clock = make_recorder()
+
+    class Node:
+        def __init__(self, recorder):
+            self.flight_recorder = recorder
+
+    class Net:
+        nodes = {"node0": Node(rec), "node1": Node(None)}
+
+        @staticmethod
+        def converged_full_mesh():
+            return False, "node0 missing route to node1"
+
+    checker = InvariantChecker(Net())
+    with pytest.raises(InvariantViolation, match="full-mesh"):
+        checker.check_full_mesh()
+    assert checker.num_breach_dumps == 1
+    assert rec.last_reason == "invariant_breach"
+    assert "missing route" in rec.last_dump_doc()["extra"]["violation"]
+
+
+def test_breach_dump_can_be_disabled():
+    from openr_tpu.chaos.invariants import InvariantChecker, InvariantViolation
+
+    rec, _tracer, _counters, _clock = make_recorder()
+
+    class Node:
+        flight_recorder = rec
+
+    class Net:
+        nodes = {"node0": Node()}
+
+        @staticmethod
+        def converged_full_mesh():
+            return False, "x"
+
+    checker = InvariantChecker(Net(), auto_dump=False)
+    with pytest.raises(InvariantViolation):
+        checker.check_full_mesh()
+    assert rec.num_dumps == 0
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos acceptance: per-chip tpu_corrupt auto-dump, byte-identical
+# across two replays of the same seed
+# ---------------------------------------------------------------------------
+
+VICTIM = "node4"
+BAD_CHIP = 3
+
+
+def _overrides(cfg):
+    cfg.tpu_compute_config.min_device_prefixes = 0
+    cfg.parallel_config = ParallelConfig(min_shard_rows=0)
+    cfg.resilience_config = ResilienceConfig(
+        shadow_sample_every=2,
+        failure_threshold=2,
+        probe_backoff_initial_s=0.5,
+        probe_backoff_max_s=4.0,
+        jitter_pct=0.1,
+        seed=7,
+    )
+
+
+async def _corrupt_until_quarantine_dump():
+    from openr_tpu.chaos import ChaosController, FaultPlan, InvariantChecker
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import grid_edges
+    from openr_tpu.types import PrefixEntry
+
+    clock = SimClock()
+    net = EmulatedNetwork(
+        clock, use_tpu_backend=True, config_overrides=_overrides
+    )
+    net.build(grid_edges(3))
+    net.start()
+    checker = InvariantChecker(net)
+    plan = FaultPlan().tpu_corrupt(
+        VICTIM, at=2.0, duration=14.0, device_index=BAD_CHIP
+    )
+    controller = ChaosController(net, plan, seed=7)
+    await clock.run_for(18.0)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    victim = net.nodes[VICTIM]
+    assert victim.flight_recorder is not None
+    # widen the candidate table so every chip's shard holds real rows
+    net.nodes["node0"].advertise_prefixes(
+        [PrefixEntry(f"10.99.{i}.0/24") for i in range(9)]
+    )
+    await clock.run_for(3.0)
+    controller.start()
+    await clock.run_for(3.0)  # corruption live on chip 3
+    gov = victim.decision.backend.governor
+    for a, b in [("node0", "node1"), ("node1", "node2")]:
+        net.fail_link(a, b)
+        await clock.run_for(2.0)
+        checker.sample()
+        if gov.num_shadow_mismatches:
+            break
+    assert gov.num_chip_quarantines >= 1
+    dumps = net.flight_dumps()
+    payload = dumps[VICTIM]
+    assert payload is not None, "quarantine did not auto-dump"
+    # other nodes saw no quarantine: no dump fired there
+    assert dumps["node0"] is None
+    await controller.stop()
+    await net.stop()
+    return payload
+
+
+@pytest.mark.chaos
+@pytest.mark.multichip
+def test_chip_quarantine_auto_dump_is_seed_deterministic():
+    a = run(_corrupt_until_quarantine_dump())
+    b = run(_corrupt_until_quarantine_dump())
+    assert a == b, "same seed must produce byte-identical dumps"
+    doc = json.loads(a.decode())
+    assert doc["node"] == VICTIM
+    assert doc["reason"] == f"quarantine_dev{BAD_CHIP}"
+    assert doc["extra"]["device"] == BAD_CHIP
+    # the quarantine span tree for chip k: the failed shadow check with
+    # its decision.spf_kernel children carrying the chip's device attr
+    events = [e for e in doc["chrome_trace"] if e.get("ph") == "X"]
+    shadow = [e for e in events if e["name"] == "resilience.shadow_check"]
+    assert shadow and shadow[-1]["args"]["passed"] is False
+    tree_id = shadow[-1]["args"]["trace_id"]
+    kernels = [
+        e for e in events
+        if e["name"] == "decision.spf_kernel"
+        and e["args"].get("device") == BAD_CHIP
+    ]
+    assert kernels, "chip k's kernel dispatches missing from the dump"
+    assert tree_id, "shadow check span lost its trace id"
+    # counters in the dump agree with the quarantine the dump explains
+    snap = doc["snapshot"]["counters"]
+    assert snap["resilience.backend.chip_quarantines"] >= 1.0

@@ -1,0 +1,267 @@
+"""Metrics export tier (ISSUE 7 tentpole): MetricsSnapshot capture,
+Prometheus text-exposition render + strict parse round-trip, the
+SimClock-deterministic JSONL writer, and the 9-node emulation
+acceptance (pipeline histograms + per-device gauges + serving/
+resilience counters all present and round-tripping)."""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import CounterMap, SimClock
+from openr_tpu.monitor.metrics import (
+    NONDETERMINISTIC_PREFIXES,
+    MetricsJsonlWriter,
+    MetricsSnapshot,
+    parse_prometheus,
+    render_prometheus,
+)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot capture
+# ---------------------------------------------------------------------------
+
+
+def make_counters():
+    c = CounterMap()
+    c.bump("decision.route_build_runs", 3)
+    c.set("resilience.backend.quarantines", 1.0)
+    c.set("process.memory.rss", 123456.0)
+    c.observe("pipeline.decode.ms", 1.5)
+    c.observe("pipeline.decode.ms", 40.0)
+    c.observe("serving.queue_wait_ms", 0.2)
+    return c
+
+
+def test_capture_from_counter_map():
+    clock = SimClock(5.0)
+    snap = MetricsSnapshot.capture(
+        counters=make_counters(), node_name="node0", clock=clock,
+        generation=[7, [["0", 3]]],
+    )
+    assert snap.node == "node0" and snap.ts_ms == 5000
+    assert snap.generation == [7, [["0", 3]]]
+    assert snap.counters["decision.route_build_runs"] == 3
+    h = snap.histograms["pipeline.decode.ms"]
+    assert h["count"] == 2 and h["min"] == 1.5 and h["max"] == 40.0
+    assert sum(c for _edge, c in h["buckets"]) == 2
+    assert h["min_bound"] > 0 and h["num_buckets"] >= 1
+    assert snap.env["python"]
+
+
+def test_capture_exclusion_drops_nondeterministic_prefixes():
+    snap = MetricsSnapshot.capture(
+        counters=make_counters(), node_name="n", clock=SimClock(),
+        exclude=NONDETERMINISTIC_PREFIXES,
+    )
+    assert "process.memory.rss" not in snap.counters
+    assert "decision.route_build_runs" in snap.counters
+
+
+def test_capture_requires_a_source():
+    with pytest.raises(ValueError):
+        MetricsSnapshot.capture()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition: render + strict parse round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_round_trip_preserves_values():
+    snap = MetricsSnapshot.capture(
+        counters=make_counters(), node_name="node0", clock=SimClock()
+    )
+    text = render_prometheus([snap])
+    parsed = parse_prometheus(text)
+    g = parsed["openr_decision_route_build_runs"]
+    assert g["type"] == "gauge"
+    key = ("openr_decision_route_build_runs", ("node", "node0"))
+    assert g["samples"][key] == 3.0
+    hist = parsed["openr_pipeline_decode_ms"]
+    assert hist["type"] == "histogram"
+    count_key = ("openr_pipeline_decode_ms_count", ("node", "node0"))
+    sum_key = ("openr_pipeline_decode_ms_sum", ("node", "node0"))
+    assert hist["samples"][count_key] == 2.0
+    assert hist["samples"][sum_key] == pytest.approx(41.5)
+    # cumulative buckets end at the total count on the +Inf edge
+    bucket_samples = [
+        (labels, v)
+        for (name, *labels), v in hist["samples"].items()
+        if name == "openr_pipeline_decode_ms_bucket"
+    ]
+    assert bucket_samples
+    cums = [v for _l, v in bucket_samples]
+    assert cums == sorted(cums) and cums[-1] == 2.0
+
+
+def test_prometheus_multi_node_groups_families():
+    snaps = []
+    for name in ("node0", "node1"):
+        c = CounterMap()
+        c.set("kvstore.keys", 4.0)
+        snaps.append(
+            MetricsSnapshot.capture(
+                counters=c, node_name=name, clock=SimClock()
+            )
+        )
+    text = render_prometheus(snaps)
+    # one TYPE header, both nodes' samples under it
+    assert text.count("# TYPE openr_kvstore_keys gauge") == 1
+    parsed = parse_prometheus(text)
+    samples = parsed["openr_kvstore_keys"]["samples"]
+    assert ("openr_kvstore_keys", ("node", "node0")) in samples
+    assert ("openr_kvstore_keys", ("node", "node1")) in samples
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "openr_orphan 1.0\n",  # sample before its TYPE header
+        "# TYPE openr_x gauge\nopenr_x{node=unquoted} 1\n",
+        "# TYPE openr_x gauge\nopenr_x notafloat\n",
+        "# TYPE openr_x\n",  # malformed header
+    ],
+)
+def test_prometheus_parser_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_prometheus(bad)
+
+
+# ---------------------------------------------------------------------------
+# JSONL writer
+# ---------------------------------------------------------------------------
+
+
+class _FakeNode:
+    def __init__(self, name, counters, clock):
+        self.name = name
+        self.counters = counters
+        self.clock = clock
+        self.monitor = None
+
+
+def test_jsonl_writer_one_sorted_line_per_node(tmp_path):
+    clock = SimClock(1.0)
+    nodes = [
+        _FakeNode("b", make_counters(), clock),
+        _FakeNode("a", make_counters(), clock),
+    ]
+    path = tmp_path / "metrics.jsonl"
+    w = MetricsJsonlWriter(str(path))
+    assert w.write_nodes(nodes) == 2
+    lines = path.read_text().splitlines()
+    assert [json.loads(ln)["node"] for ln in lines] == ["a", "b"]
+    doc = json.loads(lines[0])
+    assert doc["histograms"]["pipeline.decode.ms"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# SimClock determinism (satellite): two identical seeded emulation runs
+# produce byte-identical JSONL snapshot files
+# ---------------------------------------------------------------------------
+
+
+async def _seeded_emulation_jsonl(path: str) -> bytes:
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import line_edges
+
+    clock = SimClock()
+    net = EmulatedNetwork(clock)
+    net.build(line_edges(4))
+    net.start()
+    await clock.run_for(15.0)
+    net.fail_link("node1", "node2")
+    await clock.run_for(5.0)
+    net.restore_link("node1", "node2")
+    await clock.run_for(5.0)
+    net.export_metrics_jsonl(path, exclude=NONDETERMINISTIC_PREFIXES)
+    await net.stop()
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def test_two_seeded_runs_write_byte_identical_jsonl(tmp_path):
+    a = run(_seeded_emulation_jsonl(str(tmp_path / "a.jsonl")))
+    b = run(_seeded_emulation_jsonl(str(tmp_path / "b.jsonl")))
+    assert a, "export wrote nothing"
+    assert a == b
+    # and it is real content: every node line parses with counters
+    docs = [json.loads(ln) for ln in a.decode().splitlines()]
+    assert [d["node"] for d in docs] == ["node0", "node1", "node2", "node3"]
+    for d in docs:
+        assert d["counters"] and d["generation"] is not None
+        assert not any(
+            k.startswith(NONDETERMINISTIC_PREFIXES) for k in d["counters"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# 9-node emulation acceptance: the full exposition round-trips and
+# carries the pipeline/per-device/serving/resilience surfaces
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multichip
+def test_nine_node_emulation_prometheus_round_trip():
+    from openr_tpu.config import ParallelConfig, ResilienceConfig
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import grid_edges
+
+    def overrides(cfg):
+        cfg.tpu_compute_config.min_device_prefixes = 0  # always device
+        cfg.parallel_config = ParallelConfig(min_shard_rows=0)
+        cfg.resilience_config = ResilienceConfig(
+            shadow_sample_every=4, jitter_pct=0.0, seed=3
+        )
+
+    async def scenario():
+        clock = SimClock()
+        net = EmulatedNetwork(
+            clock, use_tpu_backend=True, config_overrides=overrides
+        )
+        net.build(grid_edges(3))
+        net.start()
+        await clock.run_for(18.0)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        # one flap so rebuild + serving + resilience surfaces all move
+        net.fail_link("node0", "node1")
+        await clock.run_for(3.0)
+        net.restore_link("node0", "node1")
+        await clock.run_for(3.0)
+        text = net.render_prometheus()
+        snaps = net.metrics_snapshots()
+        await net.stop()
+        return text, snaps
+
+    text, snaps = run(scenario())
+    assert len(snaps) == 9
+    parsed = parse_prometheus(text)  # strict: malformed would raise
+    # pipeline phase histograms (device builds ran on every node)
+    assert parsed["openr_pipeline_device_compute_ms"]["type"] == "histogram"
+    assert parsed["openr_pipeline_decode_ms"]["type"] == "histogram"
+    # per-device pipeline gauges (the probe's busy ledger, swept at
+    # capture) — every node dispatched on chip 0 at least
+    assert "openr_pipeline_dev0_busy_ms" in parsed
+    assert "openr_pipeline_dev0_utilization" in parsed
+    # existing serving + resilience counter surfaces ride along
+    assert "openr_serving_queue_depth" in parsed
+    assert "openr_resilience_backend_quarantined" in parsed
+    # tracer drop accounting is exported (satellite: operator-visible)
+    assert "openr_trace_dropped_spans" in parsed
+    assert "openr_trace_spans_evicted" in parsed
+    # every node labeled every family it reported
+    g = parsed["openr_pipeline_dev0_busy_ms"]["samples"]
+    nodes = {labels[0][1] for (_name, *labels) in g.keys()}
+    assert len(nodes) == 9

@@ -130,6 +130,12 @@ FIXTURES = {
         (),
         2,
     ),
+    "pipeline-phase-registry": (
+        "def record(counters):\n"
+        '    counters.observe("pipeline.decode.ms", 1.0)\n',
+        (),
+        2,
+    ),
 }
 
 

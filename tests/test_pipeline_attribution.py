@@ -1,0 +1,342 @@
+"""Pipeline attribution (ISSUE 7 tentpole): the phase registry, the
+PipelineProbe, the instrumented dispatch lifecycle in the Decision
+backend and the fleet/what-if engines, per-chip busy gauges, and the
+per-device Chrome-trace lanes."""
+
+import pytest
+
+from openr_tpu.common.runtime import CounterMap, SimClock
+from openr_tpu.config import ParallelConfig, ResilienceConfig
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.topology import build_adj_dbs, ring_edges
+from openr_tpu.tracing import PipelineProbe, Tracer, disabled_probe, pipeline
+from openr_tpu.types import PrefixEntry
+
+pytestmark = pytest.mark.multichip
+
+
+def make_world(n=12):
+    ls = LinkState("0")
+    for db in build_adj_dbs(ring_edges(n)).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(n):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.7.{i}.0/24"))
+    return {"0": ls}, ps
+
+
+def make_backend(clock=None, counters=None, tracer=None):
+    from openr_tpu.decision.backend import TpuBackend
+
+    return TpuBackend(
+        SpfSolver("node0"),
+        clock=clock,
+        counters=counters,
+        tracer=tracer,
+        resilience=ResilienceConfig(enabled=False),
+        parallel=ParallelConfig(min_shard_rows=0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_names_and_validation():
+    assert pipeline.span_name(pipeline.DECODE) == "pipeline.decode"
+    assert pipeline.hist_key(pipeline.HOST_FETCH) == "pipeline.host_fetch.ms"
+    with pytest.raises(ValueError):
+        pipeline.span_name("decod")
+    with pytest.raises(ValueError):
+        pipeline.hist_key("not_a_phase")
+    # host/device split covers the registry exactly, with no overlap
+    assert set(pipeline.HOST_PHASES) | set(pipeline.DEVICE_PHASES) == set(
+        pipeline.PHASES
+    )
+    assert not set(pipeline.HOST_PHASES) & set(pipeline.DEVICE_PHASES)
+
+
+def test_device_gauge_keys():
+    assert pipeline.device_busy_key(3) == "pipeline.dev3.busy_ms"
+    assert pipeline.device_utilization_key(0) == "pipeline.dev0.utilization"
+
+
+# ---------------------------------------------------------------------------
+# the probe
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_probe_is_a_noop():
+    probe = disabled_probe()
+    assert not probe.enabled
+    with probe.phase(pipeline.ENCODE) as scope:
+        assert scope is None
+    assert probe.gauges() == {}
+    # clock-less explicit construction is also disabled
+    assert not PipelineProbe(counters=CounterMap()).enabled
+
+
+def test_probe_records_histograms_spans_and_busy():
+    clock = SimClock()
+    counters = CounterMap()
+    tracer = Tracer("node0", clock=clock, counters=counters)
+    probe = PipelineProbe(clock, counters, tracer)
+    with probe.phase(pipeline.ENCODE):
+        pass
+    with probe.phase(pipeline.DEVICE_COMPUTE, device=2):
+        clock._now += 0.005  # 5 virtual ms inside the phase
+    with probe.phase(pipeline.DEVICE_GET, devices=[2, 5]):
+        clock._now += 0.001
+    h = counters.histogram(pipeline.hist_key(pipeline.ENCODE))
+    assert h is not None and h.count == 1
+    h2 = counters.histogram(pipeline.hist_key(pipeline.DEVICE_COMPUTE))
+    assert h2 is not None and h2.total == pytest.approx(5.0)
+    # spans: named pipeline.{phase}, chip-attributed where applicable
+    names = [s.name for s in tracer.get_spans()]
+    assert "pipeline.encode" in names and "pipeline.device_compute" in names
+    dc = [s for s in tracer.get_spans() if s.name == "pipeline.device_compute"]
+    assert dc[0].attrs["device"] == 2
+    # busy ledger: the committed dispatch charged dev2; the blocking
+    # drain charged both chips it covered
+    busy = probe.busy_snapshot()
+    assert busy[2] == pytest.approx(6.0)
+    assert busy[5] == pytest.approx(1.0)
+    gauges = probe.gauges()
+    assert pipeline.device_busy_key(2) in gauges
+    assert 0.0 <= gauges[pipeline.device_utilization_key(2)] <= 1.0
+
+
+def test_probe_phase_records_error_attr():
+    clock = SimClock()
+    tracer = Tracer("node0", clock=clock)
+    probe = PipelineProbe(clock, CounterMap(), tracer)
+    with pytest.raises(RuntimeError):
+        with probe.phase(pipeline.DECODE):
+            raise RuntimeError("boom")
+    sp = tracer.get_spans()[-1]
+    assert sp.name == "pipeline.decode" and sp.attrs["error"] == "RuntimeError"
+
+
+def test_probe_without_tracer_still_observes():
+    clock = SimClock()
+    counters = CounterMap()
+    probe = PipelineProbe(clock, counters)
+    with probe.phase(pipeline.TRANSFER):
+        pass
+    assert counters.histogram(pipeline.hist_key(pipeline.TRANSFER)).count == 1
+
+
+# ---------------------------------------------------------------------------
+# the instrumented backend
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_full_build_attributes_every_phase_and_chip():
+    als, ps = make_world()
+    clock = SimClock()
+    counters = CounterMap()
+    tracer = Tracer("node0", clock=clock, counters=counters)
+    backend = make_backend(clock, counters, tracer)
+    assert backend.probe.enabled
+    db = backend.build_route_db(als, ps)
+    assert db is not None and db.unicast_routes
+    # every lifecycle phase of a sharded full build recorded samples
+    for phase in (
+        pipeline.HOST_FETCH,
+        pipeline.ENCODE,
+        pipeline.PAD_PACK,
+        pipeline.TRANSFER,
+        pipeline.DEVICE_COMPUTE,
+        pipeline.DEVICE_GET,
+        pipeline.DECODE,
+    ):
+        h = counters.histogram(pipeline.hist_key(phase))
+        assert h is not None and h.count >= 1, phase
+    # device_compute samples are chip-attributed spans; the shard plan's
+    # chips and the span-attributed chips agree
+    plan_devs = {d for d, _lo, _hi in backend._attr_plan}
+    span_devs = {
+        s.attrs["device"]
+        for s in tracer.get_spans()
+        if s.name == "pipeline.device_compute" and "device" in s.attrs
+    }
+    assert span_devs == plan_devs and len(plan_devs) > 1
+    # the pool counted one committed dispatch per planned shard
+    for d in plan_devs:
+        assert backend.pool.num_dispatches[d] == 1
+    # per-chip busy gauges exist for every dispatched chip
+    gauges = backend.probe.gauges()
+    for d in plan_devs:
+        assert pipeline.device_busy_key(d) in gauges
+    # pool counter_snapshot exports the per-chip dispatch tallies
+    snap = backend.counter_snapshot()
+    assert snap["decision.backend.pool.dev0.dispatches"] >= 1.0
+
+
+def test_kernel_spans_carry_the_dispatch_device():
+    """`decision.spf_kernel` spans inside a traced build inherit the
+    pool chip from the per-shard dispatch loop (jit_guard.dispatch_device)
+    — the Chrome-trace chip lanes depend on it."""
+    from openr_tpu.ops import jit_guard
+
+    als, ps = make_world()
+    clock = SimClock()
+    counters = CounterMap()
+    tracer = Tracer("node0", clock=clock, counters=counters)
+    backend = make_backend(clock, counters, tracer)
+    with jit_guard.trace_scope(tracer, None):
+        backend.build_route_db(als, ps)
+    kernel_devs = {
+        s.attrs.get("device")
+        for s in tracer.get_spans()
+        if s.name == "decision.spf_kernel"
+    }
+    # the SPF-tables build is unattributed (replicated input), but every
+    # selection shard dispatch carries its chip
+    assert len(kernel_devs - {None}) > 1
+
+
+def test_incremental_gather_attributes_the_lead_chip():
+    als, ps = make_world()
+    clock = SimClock()
+    counters = CounterMap()
+    backend = make_backend(clock, counters)
+    backend.build_route_db(als, ps)
+    before = list(backend.pool.num_dispatches)
+    ps.update_prefix("node3", "0", PrefixEntry("10.99.3.0/24"))
+    db = backend.build_route_db(
+        als, ps, changed_prefixes={"10.99.3.0/24"}
+    )
+    assert db is not None
+    after = backend.pool.num_dispatches
+    assert sum(after) == sum(before) + 1  # ONE chip rode the gather
+    h = counters.histogram(pipeline.hist_key(pipeline.DELTA_EXTRACT))
+    assert h is not None and h.count >= 1  # the patch path is the tail
+
+
+# ---------------------------------------------------------------------------
+# the engines share the ledger
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_engine_records_phases_on_the_shared_probe():
+    from openr_tpu.decision.fleet import FleetRibEngine
+
+    als, ps = make_world()
+    clock = SimClock()
+    counters = CounterMap()
+    backend = make_backend(clock, counters)
+    pool = backend.dispatch_pool()
+    assert pool is not None
+    eng = FleetRibEngine(SpfSolver("node0"), pool=pool, probe=backend.probe)
+    summary = eng.fleet_summary(als, ps, change_seq=1)
+    assert len(summary) == 12
+    for phase in (
+        pipeline.ENCODE,
+        pipeline.HOST_FETCH,
+        pipeline.PAD_PACK,
+        pipeline.DEVICE_COMPUTE,
+        pipeline.DEVICE_GET,
+    ):
+        h = counters.histogram(pipeline.hist_key(phase))
+        assert h is not None and h.count >= 1, phase
+    # root chunks spread over the pool and were tallied there
+    assert sum(pool.num_dispatches) == eng.num_pool_dispatches > 0
+    db = eng.compute_for_node("node5", als, ps, change_seq=1)
+    assert db is not None
+    assert counters.histogram(pipeline.hist_key(pipeline.DECODE)).count >= 1
+
+
+def test_whatif_engine_records_phases_on_the_shared_probe():
+    from openr_tpu.decision.whatif_api import MultiAreaWhatIfEngine
+
+    als, ps = make_world()
+    clock = SimClock()
+    counters = CounterMap()
+    backend = make_backend(clock, counters)
+    pool = backend.dispatch_pool()
+    eng = MultiAreaWhatIfEngine(
+        SpfSolver("node0"), pool=pool, probe=backend.probe
+    )
+    failures = [(f"node{i}", f"node{i + 1}") for i in range(8)]
+    result = eng.run(failures, als, ps, change_seq=1)
+    assert result["eligible"] and len(result["failures"]) == 8
+    for phase in (
+        pipeline.PAD_PACK,
+        pipeline.TRANSFER,
+        pipeline.DEVICE_COMPUTE,
+        pipeline.DEVICE_GET,
+        pipeline.DECODE,
+    ):
+        h = counters.histogram(pipeline.hist_key(phase))
+        assert h is not None and h.count >= 1, phase
+    assert sum(pool.num_dispatches) == eng.num_pool_dispatches > 0
+
+
+def test_decision_hands_engines_the_backend_probe():
+    from openr_tpu.common.runtime import SimClock as SC
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.messaging.queue import ReplicateQueue
+
+    als, ps = make_world()
+    clock = SC()
+    solver = SpfSolver("node0")
+    backend = make_backend(clock, CounterMap())
+    d = Decision(
+        "node0",
+        clock,
+        DecisionConfig(),
+        ReplicateQueue("routes"),
+        backend=backend,
+        solver=solver,
+    )
+    d.area_link_states = als
+    d.prefix_state = ps
+    d._change_seq = 1
+    assert d._backend_probe() is backend.probe
+    assert d._fleet().probe is backend.probe
+
+
+# ---------------------------------------------------------------------------
+# per-device Chrome-trace lanes (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_emits_per_device_lanes():
+    from openr_tpu.tracing import chrome_trace_events
+
+    clock = SimClock()
+    tracer = Tracer("node0", clock=clock)
+    s0 = tracer.start_span("decision.spf_kernel", module="decision", device=0)
+    tracer.end_span(s0)
+    s1 = tracer.start_span("decision.spf_kernel", module="decision", device=3)
+    tracer.end_span(s1)
+    s2 = tracer.start_span("resilience.probe", module="resilience", device=3)
+    tracer.end_span(s2)
+    s3 = tracer.start_span("decision.rebuild", module="decision")
+    tracer.end_span(s3)
+    events = chrome_trace_events(tracer.get_spans())
+    threads = {
+        e["args"]["name"]: (e["pid"], e["tid"])
+        for e in events
+        if e.get("name") == "thread_name" and e.get("ph") == "M"
+    }
+    # chip-attributed spans get one lane per (module, chip); the plain
+    # rebuild span stays on the module lane
+    assert "decision.dev0" in threads and "decision.dev3" in threads
+    assert "resilience.dev3" in threads and "decision" in threads
+    assert threads["decision.dev0"][1] != threads["decision.dev3"][1]
+    lane_of = {}
+    for e in events:
+        if e.get("ph") == "X":
+            lane_of.setdefault((e["pid"], e["tid"]), []).append(e["name"])
+    # the two kernel spans on different chips landed on different lanes
+    kernel_lanes = [
+        lane for lane, names in lane_of.items()
+        if "decision.spf_kernel" in names
+    ]
+    assert len(kernel_lanes) == 2

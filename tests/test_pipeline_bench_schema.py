@@ -1,0 +1,70 @@
+"""Tier-1 smoke: the checked-in BENCH_PIPELINE artifact obeys the
+schema the bench emits (shared validator — bench.validate_pipeline_bench)
+and holds the ISSUE-7 acceptance shape: per-phase ms summing to within
+10% of the measured grid4096 full-rebuild wall time (no unattributed
+gap), per-chip busy fractions recorded at 1 and 8 forced host devices,
+and fleet/what-if rounds attributed over the 8-chip pool.
+
+The validator lives in bench.py so the emitter and this gate can never
+drift apart; regenerate the artifact with `python bench.py --pipeline`.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+import bench
+
+pytestmark = [pytest.mark.multichip]
+
+ARTIFACT = pathlib.Path(__file__).resolve().parent.parent / (
+    "BENCH_PIPELINE_r01.json"
+)
+
+
+def test_artifact_exists_and_matches_schema():
+    doc = json.loads(ARTIFACT.read_text())
+    bench.validate_pipeline_bench(doc)
+
+
+def test_gap_bound_is_the_acceptance_bound():
+    """The headline IS the acceptance criterion: the per-phase table
+    explains >= 90% of the end-to-end rebuild wall on grid4096."""
+    doc = json.loads(ARTIFACT.read_text())
+    assert abs(doc["value"]) <= bench.PIPELINE_GAP_BOUND_PCT
+    for r in doc["detail"]["rebuild_rounds"]:
+        assert abs(r["gap_pct"]) <= bench.PIPELINE_GAP_BOUND_PCT
+
+
+def test_per_chip_busy_fractions_at_1_and_8_devices():
+    doc = json.loads(ARTIFACT.read_text())
+    rounds = {r["devices"]: r for r in doc["detail"]["rebuild_rounds"]}
+    assert set(rounds) == set(bench.PIPELINE_DEVICES)
+    assert list(rounds[1]["per_chip_busy"]) == ["dev0"]
+    assert len(rounds[8]["per_chip_busy"]) == 8
+    # an 8-way sharded rebuild must actually occupy every chip
+    for row in rounds[8]["per_chip_busy"].values():
+        assert row["busy_fraction"] > 0.0
+
+
+def test_host_vs_device_share_recorded():
+    doc = json.loads(ARTIFACT.read_text())
+    for r in doc["detail"]["rebuild_rounds"]:
+        assert 0.0 < r["host_share_pct"] < 100.0
+        assert r["host_ms"] > 0 and r["device_ms"] > 0
+
+
+def test_environment_triple_is_recorded():
+    doc = json.loads(ARTIFACT.read_text())
+    env = doc["detail"]["env"]
+    assert env["platform"]
+    assert env["jax"]
+    assert env["device_count"] >= 8
+
+
+def test_validator_rejects_malformed_doc():
+    doc = json.loads(ARTIFACT.read_text())
+    doc["detail"]["rebuild_rounds"][0]["gap_pct"] = 55.0
+    with pytest.raises(AssertionError):
+        bench.validate_pipeline_bench(doc)

@@ -101,6 +101,72 @@ class TestHistogram:
     def test_merge_config_mismatch_raises(self):
         with pytest.raises(ValueError):
             Histogram(growth=1.5).merge(Histogram(growth=2.0))
+        with pytest.raises(ValueError):
+            Histogram(min_bound=0.01).merge(Histogram(min_bound=0.1))
+
+    # -- ISSUE 7 satellite: empty / mismatched-width behavior is DEFINED
+    # (the happy path above was the only coverage before)
+
+    def test_empty_histogram_percentiles_are_all_none(self):
+        h = Histogram()
+        assert h.percentiles() == {"p50": None, "p95": None, "p99": None}
+        snap = h.snapshot()
+        assert snap["count"] == 0 and snap["p50"] is None
+        assert snap["min"] is None and snap["max"] is None
+        assert h.bucket_items() == []
+
+    def test_merge_from_and_into_empty(self):
+        a, b = Histogram(), Histogram()
+        b.observe(3.0)
+        a.merge(b)  # empty += populated
+        assert a.count == 1 and a.percentile(50) == 3.0
+        c = Histogram()
+        a.merge(c)  # populated += empty: unchanged
+        assert a.count == 1 and a.percentile(50) == 3.0
+
+    def test_merge_mismatched_widths_widens(self):
+        # the 20-bucket grid's edges (a PREFIX of the 160-bucket grid's)
+        # top out at ~0.142; values below that add positionally exact
+        narrow = Histogram(num_buckets=20)
+        wide = Histogram(num_buckets=160)
+        for v in (0.02, 0.1):
+            narrow.observe(v)
+        for v in (2.0, 500.0):
+            wide.observe(v)
+        # wide += narrow: shared geometric edges add positionally
+        w2 = wide.copy()
+        w2.merge(narrow)
+        assert w2.count == 4 and len(w2.counts) == 161
+        assert w2.vmin == 0.02 and w2.vmax == 500.0
+        u = Histogram(num_buckets=160)
+        for v in (0.02, 0.1, 2.0, 500.0):
+            u.observe(v)
+        assert w2.counts == u.counts
+        # narrow += wide: self WIDENS to the larger grid, overflow counts
+        # stay conservative (narrow's overflow -> merged overflow)
+        n2 = Histogram(num_buckets=20)
+        n2.observe(0.05)
+        n2.observe(999.0)  # overflow of the 20-bucket grid
+        n2.merge(wide)
+        assert len(n2.counts) == 161 and len(n2.edges) == 160
+        assert n2.count == 4
+        # 999.0 sat in narrow's overflow: it stays in the MERGED
+        # overflow (conservative — the narrow grid no longer knows
+        # which of the newly-exposed buckets it belonged to)
+        assert n2.counts[-1] == 1
+        assert n2.edges == u.edges
+        assert n2.percentile(99) <= n2.vmax
+
+    def test_bucket_items_and_config(self):
+        h = Histogram()
+        h.observe(0.005)  # bucket 0
+        h.observe(1e12)  # overflow
+        items = h.bucket_items()
+        assert items[0] == (h.min_bound, 1)
+        assert items[-1] == (float("inf"), 1)
+        assert h.config() == {
+            "min_bound": 0.01, "growth": 1.15, "num_buckets": 160,
+        }
 
     def test_counter_map_histograms(self):
         c = CounterMap()
