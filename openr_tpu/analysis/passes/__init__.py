@@ -9,6 +9,7 @@ docstrings for the law being enforced and where it's written down).
 from __future__ import annotations
 
 from openr_tpu.analysis.passes.actor_isolation import ActorIsolationPass
+from openr_tpu.analysis.passes.alert_registry import AlertRegistryPass
 from openr_tpu.analysis.passes.async_blocking import AsyncBlockingPass
 from openr_tpu.analysis.passes.base import Pass
 from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
@@ -25,6 +26,7 @@ def make_passes():
         AsyncBlockingPass(),
         ResilienceLatchPass(),
         PipelinePhasePass(),
+        AlertRegistryPass(),
     ]
 
 

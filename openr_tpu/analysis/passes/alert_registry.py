@@ -1,0 +1,88 @@
+"""Alert-name registry discipline — ``health.alert.*`` has ONE home.
+
+The fleet health plane's whole value is that every alert rule is
+chaos-verified: for each name in ``openr_tpu/health/alerts.py`` there
+is a seeded fault family proving the alert fires, and a clean-run gate
+proving it doesn't fire spuriously.  A free-spelled
+``"health.alert.chip_quarntine"`` anywhere else would mint an alert
+counter no dashboard, no fidelity test, and no runbook knows about —
+firing forever into a void.  So the registry module is the single
+place the ``health.alert.`` prefix may be spelled; everything else
+goes through ``alert_counter_key(name)`` (which validates the name
+against ``ALERTS``) or the name constants.
+
+Rule (mirrors ``pipeline-phase-registry``):
+
+* ``alert-name-registry`` — a string literal (or f-string head)
+  beginning with ``health.alert.`` anywhere outside the registry
+  module.  Reads through ``alert_counter_key`` are invisible to this
+  pass by construction — that is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+#: the registry itself (the only module allowed to spell the prefix) —
+#: and this pass, which must spell it to detect it
+ALLOWED_PREFIXES = (
+    "openr_tpu/health/alerts.py",
+    "openr_tpu/analysis/passes/alert_registry.py",
+)
+
+_PREFIX = "health.alert."
+
+
+class AlertRegistryPass(Pass):
+    name = "alert-registry"
+    rules = {
+        "alert-name-registry": (
+            "health.alert.* counter name spelled as a free string "
+            "(use openr_tpu.health.alerts.alert_counter_key so every "
+            "alert name is registered, chaos-verified, and "
+            "enumerable)"
+        ),
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if mod.rel.startswith(ALLOWED_PREFIXES):
+            return []
+        # constants living inside f-strings are reported once, via their
+        # enclosing JoinedStr, not a second time as bare constants
+        inside_fstring = {
+            id(v)
+            for node in ast.walk(mod.tree)
+            if isinstance(node, ast.JoinedStr)
+            for v in node.values
+        }
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            value = None
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in inside_fstring
+            ):
+                value = node.value
+            elif isinstance(node, ast.JoinedStr) and node.values:
+                head = node.values[0]
+                if isinstance(head, ast.Constant) and isinstance(
+                    head.value, str
+                ):
+                    value = head.value
+            if value is None or not value.startswith(_PREFIX):
+                continue
+            out.append(
+                mod.finding(
+                    "alert-name-registry",
+                    node,
+                    f"free-string alert name {value!r}; use the "
+                    "openr_tpu.health.alerts registry "
+                    "(ALERTS / alert_counter_key)",
+                )
+            )
+        return out

@@ -797,6 +797,133 @@ def resilience_force_probe(ctx: click.Context, device: int) -> None:
     _print(_call(ctx, "force_probe", device=device))
 
 
+# ------------------------------------------------------------------ health
+
+
+@breeze.group()
+def health() -> None:
+    """Fleet health plane: SLO burn rates, generation skew, chip and
+    breaker rollups, active alerts (openr_tpu.health;
+    docs/Observability.md §"Fleet health plane")."""
+
+
+def _fmt_num(v, digits: int = 2) -> str:
+    return f"{v:.{digits}f}" if isinstance(v, (int, float)) else "-"
+
+
+@health.command("status")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.option("--no-refresh", is_flag=True,
+              help="render the last periodic sweep instead of sweeping now")
+@click.pass_context
+def health_status(
+    ctx: click.Context, json_out: bool, no_refresh: bool
+) -> None:
+    """The fleet rollup: SLO burn, generation skew, chips, breakers,
+    queues, crashes, and the active alert set."""
+    status = _call(ctx, "get_health_status", refresh=not no_refresh)
+    if json_out:
+        _print(status)
+        return
+    nodes = status.get("nodes", [])
+    alerts = status.get("active_alerts", [])
+    click.echo(
+        f"fleet health via {status.get('node', '?')}: "
+        f"{len(nodes)} nodes, {len(alerts)} active alerts "
+        f"(sweep {status.get('sweeps', 0)})"
+    )
+    for slo in status.get("slos", []):
+        state = "FIRING" if slo["firing"] else "ok"
+        click.echo(
+            f"  slo {slo['name']}: {slo['metric']} "
+            f"p{slo['percentile']:g}={_fmt_num(slo['value'])} "
+            f"(threshold {_fmt_num(slo['threshold'], 0)}) "
+            f"burn fast={_fmt_num(slo['fast_burn'])} "
+            f"slow={_fmt_num(slo['slow_burn'])} {state}"
+        )
+    stale = [n for n in nodes if n.get("stale")]
+    click.echo(f"  generation: {len(stale)} stale of {len(nodes)} nodes")
+    for n in nodes:
+        mark = "STALE" if n.get("stale") else "ok"
+        click.echo(
+            f"    {n['node']}: missed={n['missed_generations']} {mark}"
+        )
+    chips = status.get("chips", {})
+    click.echo(
+        f"  chips: {chips.get('healthy', 0)}/{chips.get('total', 0)} "
+        f"healthy ({chips.get('quarantined', 0)} quarantined)"
+    )
+    breakers = status.get("breakers", [])
+    click.echo(f"  breakers: {len(breakers)} not closed")
+    for b in breakers:
+        click.echo(f"    {b['node']}:{b['edge']} {b['state']}")
+    queues = status.get("queues", {})
+    click.echo(
+        f"  queues: {len(queues.get('saturated', []))} saturated "
+        f"(worst depth {_fmt_num(queues.get('worst_depth'), 0)})"
+    )
+    click.echo(f"  crashes seen: {_fmt_num(status.get('crashes_seen'), 0)}")
+    if not alerts:
+        click.echo("  active alerts: none")
+    for a in alerts:
+        click.echo(f"  ALERT [{a['severity']}] {a['name']}: {a['detail']}")
+
+
+@health.command("alerts")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.option("--log-tail", default=20, help="newest N transition-log lines")
+@click.pass_context
+def health_alerts(
+    ctx: click.Context, json_out: bool, log_tail: int
+) -> None:
+    """Active alerts + the newest alert-transition log lines."""
+    out = _call(ctx, "get_active_alerts", log_tail=log_tail)
+    if json_out:
+        _print(out)
+        return
+    active = out.get("active", [])
+    click.echo(
+        f"{len(active)} active alerts "
+        f"({out.get('fired', 0)} fired, {out.get('resolved', 0)} "
+        f"resolved, {out.get('page_dumps', 0)} page dumps)"
+    )
+    for a in active:
+        click.echo(f"  [{a['severity']}] {a['name']}: {a['description']}")
+        click.echo(f"    detail: {a['detail']}")
+    log = out.get("log", [])
+    if log:
+        click.echo("recent transitions:")
+        for line in log:
+            click.echo(f"  {line}")
+
+
+@health.command("slo")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def health_slo(ctx: click.Context, json_out: bool) -> None:
+    """The SLO table: objective, current value, fast/slow burn rates."""
+    status = _call(ctx, "get_health_status", refresh=True)
+    slos = status.get("slos", [])
+    if json_out:
+        _print(slos)
+        return
+    if not slos:
+        click.echo("no SLOs configured")
+        return
+    # one prose line per objective (no aligned columns: values vary in
+    # width run to run, which would destabilize the CLI goldens)
+    for s in slos:
+        click.echo(
+            f"{s['name']} [{s['severity']}] metric={s['metric']} "
+            f"p{s['percentile']:g} value={_fmt_num(s['value'])} "
+            f"threshold={_fmt_num(s['threshold'], 0)} "
+            f"objective={s['objective']:g} "
+            f"burn fast={_fmt_num(s['fast_burn'])} "
+            f"slow={_fmt_num(s['slow_burn'])} "
+            f"firing={'YES' if s['firing'] else 'no'}"
+        )
+
+
 # ----------------------------------------------------------------- kvstore
 
 

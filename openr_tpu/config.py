@@ -157,6 +157,54 @@ class TracingConfig:
 
 
 @dataclass
+class SloSpecConfig:
+    """One declarative SLO (config form of openr_tpu.health.slo.SloSpec).
+    ``name`` must be a registered alert name (health.alerts.ALERTS) —
+    the alert an objective fires IS its name, so the chaos fidelity
+    suite and the orlint registry can pin the full alert surface."""
+
+    name: str = ""
+    metric: str = ""
+    kind: str = "histogram_percentile"  # or "counter_threshold"
+    percentile: float = 99.0
+    threshold: float = 0.0
+    objective: float = 0.01
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    burn_threshold: float = 2.0
+
+
+@dataclass
+class HealthConfig:
+    """Fleet health plane knobs (openr_tpu.health, net-new vs the
+    reference): SLO burn-rate evaluation, cross-node rollups
+    (generation skew, chip/breaker state, queue saturation), and the
+    alert sink.  See docs/Observability.md §"Fleet health plane"."""
+
+    enabled: bool = True
+    #: sweep cadence on the injected Clock (SimClock in tests)
+    sweep_interval_s: float = 15.0
+    #: a node is STALE once it misses this many fleet generations...
+    skew_min_generations: int = 3
+    #: ...for at least this long
+    skew_hold_s: float = 30.0
+    #: messaging.queue.*.depth at/above this fires queue_saturation
+    queue_depth_threshold: float = 10_000.0
+    #: pipeline.devN.utilization max-min spread firing bound...
+    utilization_spread_threshold: float = 0.5
+    #: ...but only when the busiest chip is at least this utilized
+    #: (an idle pool's jitter must not page anyone)
+    utilization_spread_floor: float = 0.2
+    #: minimum spacing between page-alert flight-recorder dumps
+    page_dump_min_s: float = 30.0
+    #: bounded JSONL transition-log length (oldest dropped)
+    alert_log_entries: int = 4096
+    #: SLO catalog override; empty = the built-in defaults
+    #: (health.slo.default_slos)
+    slos: List[SloSpecConfig] = field(default_factory=list)
+
+
+@dataclass
 class ServingConfig:
     """Query-serving plane knobs (openr_tpu.serving, net-new vs the
     reference): dynamic micro-batching, content-addressed result
@@ -318,6 +366,7 @@ class OpenrConfig:
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
     tracing_config: TracingConfig = field(default_factory=TracingConfig)
     serving_config: ServingConfig = field(default_factory=ServingConfig)
+    health_config: HealthConfig = field(default_factory=HealthConfig)
     resilience_config: ResilienceConfig = field(default_factory=ResilienceConfig)
     parallel_config: ParallelConfig = field(default_factory=ParallelConfig)
     originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
@@ -397,6 +446,19 @@ class OpenrConfig:
                 "resilience needs 0 < probe_backoff_initial_s <= "
                 "probe_backoff_max_s and 0 <= jitter_pct < 1"
             )
+        hc = self.health_config
+        if hc.sweep_interval_s <= 0 or hc.skew_hold_s < 0:
+            raise ValueError(
+                "health needs sweep_interval_s > 0 and skew_hold_s >= 0"
+            )
+        if hc.skew_min_generations < 1 or hc.alert_log_entries < 1:
+            raise ValueError(
+                "health needs skew_min_generations >= 1 and "
+                "alert_log_entries >= 1"
+            )
+        for slo in hc.slos:
+            if not slo.name or not slo.metric:
+                raise ValueError("health slo entries need name and metric")
         p = self.parallel_config
         if p.max_devices < 0 or p.min_shard_rows < 0:
             raise ValueError(

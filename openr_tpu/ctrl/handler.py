@@ -960,6 +960,43 @@ class OpenrCtrlHandler:
             return None
         return recorder.last_dump_doc()
 
+    # --------------------------------------------------------------- health
+    # (openr_tpu.health — fleet SLO burn-rate evaluation + cross-node
+    # rollups; net-new vs the reference)
+
+    def _health(self):
+        health = getattr(self.node, "health", None)
+        if health is None:
+            raise ValueError(
+                "fleet health plane disabled on this node "
+                "(health_config.enabled=false)"
+            )
+        return health
+
+    def get_health_status(self, refresh: bool = True) -> dict:
+        """The fleet health rollup (`breeze health status`): per-node
+        generation skew, chip/breaker/queue rollups, SLO burn rates,
+        and the active alert set.  ``refresh`` runs a sweep first so
+        the answer is current rather than as-of the last periodic
+        sweep."""
+        health = self._health()
+        if refresh:
+            return health.sweep()
+        return health.status()
+
+    def get_active_alerts(self, log_tail: int = 50) -> dict:
+        """Currently-firing alerts plus the newest ``log_tail``
+        transition-log lines (`breeze health alerts`)."""
+        health = self._health()
+        log = health.alert_log()
+        return {
+            "active": health.active_alerts(),
+            "log": log[-log_tail:] if log_tail else log,
+            "fired": health.sink.num_fired,
+            "resolved": health.sink.num_resolved,
+            "page_dumps": health.sink.num_page_dumps,
+        }
+
     # ------------------------------------------------------------- streaming
     # (OpenrCtrlHandler.h:364-399)
 

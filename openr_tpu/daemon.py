@@ -68,6 +68,7 @@ async def run_emulation(
     trace_export: str = "",
     metrics_export: str = "",
     metrics_interval_s: float = 30.0,
+    health_export: str = "",
 ) -> None:
     from openr_tpu.emulation.network import EmulatedNetwork
     from openr_tpu.emulation.topology import grid_edges, line_edges, ring_edges
@@ -182,6 +183,16 @@ async def run_emulation(
                 f"wrote {metrics_writer.num_lines} metric snapshots to "
                 f"{metrics_export}"
             )
+    if health_export:
+        # one final health sweep so the log reflects end-of-run state,
+        # then the alert-transition JSONL (the fleet-health audit trail)
+        for _name, node in sorted(net.nodes.items()):
+            if node.health is not None:
+                node.health.sweep()
+                break
+        num = net.export_health_jsonl(health_export)
+        if verbose:
+            print(f"wrote {num} alert transitions to {health_export}")
     if trace_export:
         # dump the whole run's span set viewer-ready (chrome://tracing /
         # ui.perfetto.dev) before teardown
@@ -328,6 +339,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--metrics-interval", type=float, default=30.0,
                    metavar="SECONDS",
                    help="sweep cadence for --metrics-export")
+    p.add_argument("--health-export", default="", metavar="PATH",
+                   help="with --emulate: on shutdown, write the fleet "
+                        "health plane's alert-transition log (one JSON "
+                        "line per fired/resolved alert)")
     p.add_argument("--ctrl-host", default="",
                    help="ctrl server bind address in --real mode "
                         "(default: all interfaces)")
@@ -347,6 +362,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                 trace_export=args.trace_export,
                 metrics_export=args.metrics_export,
                 metrics_interval_s=args.metrics_interval,
+                health_export=args.health_export,
             )
         )
         return

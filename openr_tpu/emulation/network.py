@@ -86,7 +86,17 @@ class EmulatedNetwork:
         self.agents[name] = agent
         self.configs[name] = cfg
         self._interfaces[name] = {}
+        self._wire_fleet_health(node)
         return node
+
+    def _wire_fleet_health(self, node: OpenrNode) -> None:
+        """Give the node's health aggregator the FLEET view: under
+        emulation every node's sweep sees every node's snapshot (the
+        in-process stand-in for operators scraping ctrl
+        ``get_metrics_snapshot`` across the fleet), so `breeze health
+        status` against ANY node renders the whole-fleet rollup."""
+        if node.health is not None:
+            node.health.set_source(self.metrics_snapshots)
 
     def connect(self, a: str, b: str, latency_s: Optional[float] = None) -> None:
         """Wire a point-to-point link a<->b (interfaces auto-named)."""
@@ -197,6 +207,7 @@ class EmulatedNetwork:
         )
         self.kv_transport.register(name, node.kv_store)
         self.nodes[name] = node
+        self._wire_fleet_health(node)
         node.start()
         node.link_monitor.set_interfaces(
             list(self._interfaces[name].values())
@@ -290,6 +301,42 @@ class EmulatedNetwork:
             )
             for name, node in sorted(self.nodes.items())
         }
+
+    def health_status(self) -> Dict[str, dict]:
+        """Per-node fleet-health rollup (each node's aggregator holds
+        the FLEET view under emulation) — the whole-emulation `breeze
+        health status`."""
+        return {
+            name: (node.health.status() if node.health is not None else {})
+            for name, node in sorted(self.nodes.items())
+        }
+
+    def health_alert_logs(self) -> Dict[str, bytes]:
+        """Per-node alert-transition JSONL bytes — what the chaos
+        fidelity suite byte-compares across seeded replays."""
+        return {
+            name: (
+                node.health.sink.log_bytes()
+                if node.health is not None
+                else b""
+            )
+            for name, node in sorted(self.nodes.items())
+        }
+
+    def export_health_jsonl(self, path: str) -> int:
+        """Write the lead node's alert-transition log (one JSON line per
+        fired/resolved event) to `path`; returns lines written.  The
+        lead (sorted-first) node's aggregator sees the whole fleet, so
+        one log covers every alert — `--health-export PATH`."""
+        for _name, node in sorted(self.nodes.items()):
+            if node.health is None:
+                continue
+            payload = node.health.sink.log_bytes()
+            with open(path, "wb") as f:
+                f.write(payload)
+            return len(node.health.sink.log)
+        with open(path, "wb"):
+            return 0
 
     def merged_histogram(self, key: str):
         """Cross-node merge of one histogram key (None when no node

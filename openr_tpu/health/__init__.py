@@ -1,0 +1,60 @@
+"""openr_tpu.health — the fleet health plane.
+
+Turns the PR-7 telemetry surface (MetricsSnapshots: counters +
+histogram buckets from every node) into fleet-wide health verdicts:
+
+  * :mod:`openr_tpu.health.slo` — declarative :class:`SloSpec`s over
+    histogram percentiles / counter deltas, evaluated by the
+    multi-window **burn-rate** engine (fast window catches onset, slow
+    window filters blips; all windows on the injected Clock);
+  * :mod:`openr_tpu.health.aggregator` — the
+    :class:`FleetHealthAggregator` sweep: cross-node histogram merge
+    (PR-7 widen-on-merge semantics), generation-skew/staleness,
+    quarantined-chip and open-breaker rollups, queue-watermark
+    saturation, per-chip utilization spread, crash latching;
+  * :mod:`openr_tpu.health.alerts` — the alert-name registry (the ONLY
+    spelling of ``health.alert.*``, orlint-enforced) and the
+    :class:`AlertSink`: firing counters, deterministic JSONL transition
+    log, detection-time flight-recorder dumps for page severity.
+
+Operator surface: ctrl ``get_health_status`` / ``get_active_alerts``,
+``breeze health status|alerts|slo``, ``--emulate ... --health-export``.
+Every alert rule is chaos-verified (tests/test_health_chaos.py): a
+seeded fault family fires exactly its expected alert set, a clean run
+fires none, and replays are byte-identical.  See docs/Observability.md
+§"Fleet health plane".
+"""
+
+from __future__ import annotations
+
+from openr_tpu.health.aggregator import (
+    FleetHealthAggregator,
+    HealthMonitor,
+    generation_hash,
+    histogram_from_snapshot,
+    merge_fleet_histograms,
+)
+from openr_tpu.health.alerts import (
+    ALERTS,
+    AlertSink,
+    alert_counter_key,
+    alert_description,
+    alert_severity,
+)
+from openr_tpu.health.slo import BurnRateEvaluator, SloSpec, default_slos
+
+__all__ = [
+    "ALERTS",
+    "AlertSink",
+    "BurnRateEvaluator",
+    "FleetHealthAggregator",
+    "HealthMonitor",
+    "SloSpec",
+    "alert_counter_key",
+    "alert_description",
+    "alert_severity",
+    "default_slos",
+    "generation_hash",
+    "histogram_from_snapshot",
+    "merge_fleet_histograms",
+]

@@ -1,0 +1,489 @@
+"""FleetHealthAggregator — cross-node health verdicts from raw telemetry.
+
+PR 7 put every counter and histogram bucket of every node one
+`MetricsSnapshot` away; this module is the layer that *evaluates* them
+the way Open/R's operators watch fb303 counters across the Express
+Backbone (PAPER §1): one sweep pulls every node's snapshot (the
+emulation hands over ``EmulatedNetwork.metrics_snapshots()``; real
+deployments poll ctrl ``get_metrics_snapshot``), merges cross-node
+histograms with the PR-7 merge semantics (identical bucket grids add
+positionally; narrower grids widen), and derives the signals no single
+node can see:
+
+  * **generation skew / staleness** — each snapshot's Decision
+    ``generation`` stamp is normalized to a stable hash (the raw stamp
+    mixes node-local sequence counters, so only *change* is comparable,
+    never order).  A node whose hash stays frozen across K sweeps in
+    which other nodes advanced, for at least ``skew_hold_s``, is STALE:
+    partitioned, wedged, or serving an old LSDB.
+  * **chip / backend quarantine rollup** — fleet totals of quarantined
+    chips (``decision.backend.pool.*``) and whole-backend latches
+    (``resilience.backend.quarantined``).
+  * **breaker rollup** — every ``resilience.*.state`` gauge that is not
+    closed, named per node and edge.
+  * **queue saturation** — any ``messaging.queue.*.depth`` beyond the
+    threshold (backlog growth the Watchdog would only crash on later).
+  * **per-chip utilization spread** — ``pipeline.devN.utilization``
+    imbalance on any node's pool (a silently slow chip skews its own
+    busy fraction long before it fails a shadow check).
+  * **crash latch** — ``watchdog.crashes`` deltas, latched across node
+    restarts (a restart resets counters; the fleet must still remember
+    the crash happened).
+
+SLO specs ride the same sweep through the multi-window burn-rate
+engine (:mod:`openr_tpu.health.slo`), and everything that fires lands
+in the :class:`~openr_tpu.health.alerts.AlertSink` — counters, a
+deterministic JSONL transition log, and detection-time flight-recorder
+dumps for page severity.  All timing comes from the injected Clock, so
+two seeded SimClock replays produce byte-identical alert logs (the
+chaos fidelity suite's contract).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap, Histogram
+from openr_tpu.health.alerts import AlertSink
+from openr_tpu.health.slo import BurnRateEvaluator, SloSpec, default_slos
+
+
+def generation_hash(generation: Any) -> str:
+    """Stable 12-hex digest of a Decision generation stamp.  The stamp's
+    components are node-local counters — two nodes' stamps are not
+    ordered, and a restart resets them — so the only fleet-comparable
+    signal is *did this node's stamp change*, which a content hash
+    answers exactly."""
+    blob = json.dumps(generation, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def histogram_from_snapshot(snap: Dict[str, Any]) -> Histogram:
+    """Rebuild a mergeable Histogram from a MetricsSnapshot histogram
+    dict (bucket grid config + sparse bucket pairs) — the bridge that
+    lets the fleet rollup reuse the PR-7 ``Histogram.merge`` semantics
+    (positional add, widen-on-merge) instead of re-implementing them."""
+    h = Histogram(
+        min_bound=snap["min_bound"],
+        growth=snap["growth"],
+        num_buckets=snap["num_buckets"],
+    )
+    overflow_edge = h.edges[-1] if h.edges else 0.0
+    for edge, count in snap.get("buckets", []):
+        edge = float(edge)
+        if edge > overflow_edge:  # the serialized inf overflow bucket
+            h.counts[-1] += count
+        else:
+            h.counts[h.bucket_index(edge)] += count
+    h.count = int(snap.get("count", 0))
+    h.total = float(snap.get("sum", 0.0))
+    h.vmin = snap.get("min")
+    h.vmax = snap.get("max")
+    return h
+
+
+def merge_fleet_histograms(
+    snaps: List[Dict[str, Any]]
+) -> Dict[str, Dict[str, Any]]:
+    """Cross-node merge of every histogram key present in any snapshot.
+    Returns snapshot-shaped dicts (grid config + buckets + percentiles)
+    so downstream consumers never see the mutable Histogram objects."""
+    merged: Dict[str, Histogram] = {}
+    for s in snaps:
+        for key, hsnap in s.get("histograms", {}).items():
+            h = histogram_from_snapshot(hsnap)
+            if key in merged:
+                a, b = merged[key], h
+                # PR-7 widen-on-merge only grows the RECEIVER; merge
+                # into whichever histogram has the wider grid
+                if len(b.counts) > len(a.counts):
+                    a, b = b, a
+                merged[key] = a.merge(b)
+            else:
+                merged[key] = h
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, h in merged.items():
+        d = dict(h.config())
+        d.update(
+            count=h.count,
+            sum=h.total,
+            min=h.vmin,
+            max=h.vmax,
+            buckets=[[edge, c] for edge, c in h.bucket_items()],
+        )
+        d.update(h.percentiles((50, 95, 99)))
+        out[key] = d
+    return out
+
+
+class _NodeGenState:
+    __slots__ = (
+        "gen_hash",
+        "last_advance_ts",
+        "missed",
+        "last_crashes",
+        "start_ms",
+    )
+
+    def __init__(self, gen_hash: str, now: float) -> None:
+        self.gen_hash = gen_hash
+        self.last_advance_ts = now
+        self.missed = 0
+        self.last_crashes = 0.0
+        self.start_ms: Optional[float] = None
+
+
+class FleetHealthAggregator:
+    """One sweep loop over the fleet's snapshots; owns the derived
+    signal state, the burn-rate evaluator, and the alert sink."""
+
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        source: Callable[[], List[Any]],
+        sink: AlertSink,
+        counters: Optional[CounterMap] = None,
+        slos: Optional[List[SloSpec]] = None,
+        skew_min_generations: int = 3,
+        skew_hold_s: float = 30.0,
+        queue_depth_threshold: float = 10_000.0,
+        utilization_spread_threshold: float = 0.5,
+        utilization_spread_floor: float = 0.2,
+    ) -> None:
+        self.node_name = node_name
+        self.clock = clock
+        self._source = source
+        self.sink = sink
+        self.counters = counters if counters is not None else CounterMap()
+        self.slos = BurnRateEvaluator(
+            clock, slos if slos is not None else default_slos()
+        )
+        self.skew_min_generations = skew_min_generations
+        self.skew_hold_s = skew_hold_s
+        self.queue_depth_threshold = queue_depth_threshold
+        self.utilization_spread_threshold = utilization_spread_threshold
+        self.utilization_spread_floor = utilization_spread_floor
+        self._gen_state: Dict[str, _NodeGenState] = {}
+        self._crashes_latched = 0.0
+        self._restarts_latched = 0.0
+        self.num_sweeps = 0
+        self._last_status: Dict[str, Any] = {}
+
+    def set_source(self, source: Callable[[], List[Any]]) -> None:
+        """Re-point the snapshot source (the emulation swaps the
+        node-local default for the fleet-wide one)."""
+        self._source = source
+
+    # -- the sweep ---------------------------------------------------------
+
+    def sweep(self) -> Dict[str, Any]:
+        """Pull snapshots, derive signals, evaluate SLOs, deliver
+        alerts; returns the refreshed status rollup."""
+        self.num_sweeps += 1
+        now = self.clock.now()
+        snaps = [
+            s.to_wire() if hasattr(s, "to_wire") else dict(s)
+            for s in self._source()
+        ]
+        snaps.sort(key=lambda s: s.get("node", ""))
+        merged_counters: Dict[str, float] = {}
+        for s in snaps:
+            for k, v in s.get("counters", {}).items():
+                merged_counters[k] = merged_counters.get(k, 0.0) + float(v)
+        merged_hists = merge_fleet_histograms(snaps)
+
+        firing: Dict[str, Dict[str, Any]] = {}
+        node_rows = self._generation_signal(snaps, now, firing)
+        self._quarantine_signal(snaps, firing)
+        self._breaker_signal(snaps, firing)
+        self._queue_signal(snaps, firing)
+        self._utilization_signal(snaps, firing)
+        self._crash_signal(snaps, firing)
+        firing.update(self.slos.evaluate(merged_hists, merged_counters))
+        self.sink.report(firing)
+
+        self._last_status = {
+            "node": self.node_name,
+            "ts_ms": int(self.clock.now_ms()),
+            "sweeps": self.num_sweeps,
+            "nodes": node_rows,
+            "chips": self._chip_rollup(snaps),
+            "breakers": self._breaker_rollup(snaps),
+            "queues": self._queue_rollup(snaps),
+            "crashes_seen": self._crashes_latched,
+            "restarts_seen": self._restarts_latched,
+            "slos": self.slos.status(),
+            "active_alerts": self.sink.active_alerts(),
+        }
+        return self._last_status
+
+    # -- fleet signals -----------------------------------------------------
+
+    def _generation_signal(self, snaps, now, firing) -> List[Dict[str, Any]]:
+        advanced: List[str] = []
+        seen: List[str] = []
+        for s in snaps:
+            name = s.get("node", "")
+            seen.append(name)
+            gh = generation_hash(s.get("generation"))
+            st = self._gen_state.get(name)
+            if st is None:
+                self._gen_state[name] = _NodeGenState(gh, now)
+                advanced.append(name)
+            elif st.gen_hash != gh:
+                st.gen_hash = gh
+                advanced.append(name)
+        stale: List[str] = []
+        rows: List[Dict[str, Any]] = []
+        for name in seen:
+            st = self._gen_state[name]
+            if name in advanced:
+                st.missed = 0
+                st.last_advance_ts = now
+            elif any(a != name for a in advanced):
+                # at least one OTHER node advanced a generation while
+                # this one sat still: one missed generation (at least)
+                st.missed += 1
+            is_stale = (
+                st.missed >= self.skew_min_generations
+                and now - st.last_advance_ts >= self.skew_hold_s
+            )
+            if is_stale:
+                stale.append(name)
+            rows.append(
+                {
+                    "node": name,
+                    "generation_hash": st.gen_hash,
+                    "missed_generations": st.missed,
+                    "stale_for_s": round(now - st.last_advance_ts, 3),
+                    "stale": is_stale,
+                }
+            )
+        # forget nodes that left the fleet (decommission); a restart
+        # re-registers under the same name with a fresh hash (= advance)
+        for name in list(self._gen_state):
+            if name not in seen:
+                del self._gen_state[name]
+        if stale:
+            firing["generation_skew"] = {
+                "stale_nodes": stale,
+                "min_generations": self.skew_min_generations,
+                "hold_s": self.skew_hold_s,
+            }
+        return rows
+
+    def _chip_rollup(self, snaps) -> Dict[str, Any]:
+        total = healthy = 0
+        per_node = {}
+        for s in snaps:
+            c = s.get("counters", {})
+            size = int(c.get("decision.backend.pool.size", 0))
+            ok = int(c.get("decision.backend.pool.healthy", 0))
+            if size:
+                per_node[s["node"]] = {"size": size, "healthy": ok}
+                total += size
+                healthy += ok
+        return {
+            "total": total,
+            "healthy": healthy,
+            "quarantined": total - healthy,
+            "per_node": per_node,
+        }
+
+    def _quarantine_signal(self, snaps, firing) -> None:
+        chips = self._chip_rollup(snaps)
+        if chips["quarantined"] > 0:
+            firing["chip_quarantine"] = {
+                "quarantined": chips["quarantined"],
+                "nodes": sorted(
+                    n
+                    for n, row in chips["per_node"].items()
+                    if row["healthy"] < row["size"]
+                ),
+            }
+        latched = sorted(
+            s["node"]
+            for s in snaps
+            if s.get("counters", {}).get("resilience.backend.quarantined", 0)
+        )
+        if latched:
+            firing["backend_quarantine"] = {"nodes": latched}
+
+    _CHIP_BREAKER_RE = None  # compiled lazily below
+
+    def _breaker_rollup(self, snaps) -> List[Dict[str, Any]]:
+        """Non-closed breakers fleet-wide, EXCLUDING the device backend's
+        own breaker and its per-chip breakers — those states already
+        surface as the dedicated backend/chip quarantine alerts, and an
+        alert that fires twice under two names pages twice for one
+        incident."""
+        import re
+
+        if FleetHealthAggregator._CHIP_BREAKER_RE is None:
+            FleetHealthAggregator._CHIP_BREAKER_RE = re.compile(
+                r"^resilience\.backend(\.dev\d+)?\.state$"
+            )
+        chip_re = FleetHealthAggregator._CHIP_BREAKER_RE
+        out = []
+        for s in snaps:
+            for k, v in s.get("counters", {}).items():
+                if (
+                    k.startswith("resilience.")
+                    and k.endswith(".state")
+                    and v > 0.0
+                    and chip_re.match(k) is None
+                ):
+                    out.append(
+                        {
+                            "node": s["node"],
+                            "edge": k[len("resilience."):-len(".state")],
+                            "state": "open" if v == 1.0 else "half_open",
+                        }
+                    )
+        return out
+
+    def _breaker_signal(self, snaps, firing) -> None:
+        open_breakers = self._breaker_rollup(snaps)
+        if open_breakers:
+            firing["breaker_open"] = {
+                "count": len(open_breakers),
+                "edges": [
+                    f"{b['node']}:{b['edge']}:{b['state']}"
+                    for b in open_breakers
+                ],
+            }
+
+    def _queue_rollup(self, snaps) -> Dict[str, Any]:
+        worst_depth, worst = 0.0, ""
+        saturated = []
+        for s in snaps:
+            for k, v in s.get("counters", {}).items():
+                if not (
+                    k.startswith("messaging.queue.") and k.endswith(".depth")
+                ):
+                    continue
+                q = f"{s['node']}:{k[len('messaging.queue.'):-len('.depth')]}"
+                if v > worst_depth:
+                    worst_depth, worst = v, q
+                if v >= self.queue_depth_threshold:
+                    saturated.append({"queue": q, "depth": v})
+        return {
+            "worst_depth": worst_depth,
+            "worst_queue": worst,
+            "saturated": saturated,
+            "threshold": self.queue_depth_threshold,
+        }
+
+    def _queue_signal(self, snaps, firing) -> None:
+        sat = self._queue_rollup(snaps)["saturated"]
+        if sat:
+            firing["queue_saturation"] = {
+                "queues": [q["queue"] for q in sat],
+                "threshold": self.queue_depth_threshold,
+            }
+
+    def _utilization_signal(self, snaps, firing) -> None:
+        from openr_tpu.tracing.pipeline import parse_device_key
+
+        skewed = []
+        for s in snaps:
+            utils = []
+            for k, v in s.get("counters", {}).items():
+                parsed = parse_device_key(k)
+                if parsed is not None and parsed[1] == "utilization":
+                    utils.append(v)
+            if len(utils) < 2:
+                continue
+            spread = max(utils) - min(utils)
+            if (
+                spread >= self.utilization_spread_threshold
+                and max(utils) >= self.utilization_spread_floor
+            ):
+                skewed.append(
+                    {"node": s["node"], "spread": round(spread, 4)}
+                )
+        if skewed:
+            firing["utilization_spread"] = {
+                "nodes": skewed,
+                "threshold": self.utilization_spread_threshold,
+            }
+
+    def _crash_signal(self, snaps, firing) -> None:
+        for s in snaps:
+            name = s.get("node", "")
+            counters = s.get("counters", {})
+            crashes = float(counters.get("watchdog.crashes", 0.0))
+            st = self._gen_state.get(name)
+            if st is None:
+                continue
+            if crashes < st.last_crashes:
+                # counter went backwards: the node restarted and reset
+                # its counters — the crashes already latched stay latched
+                st.last_crashes = 0.0
+            self._crashes_latched += max(crashes - st.last_crashes, 0.0)
+            st.last_crashes = crashes
+            # a supervisor restart replaces the node (and its counters)
+            # faster than a sweep can see watchdog.crashes — the
+            # incarnation stamp INCREASING is the restart the fleet
+            # must remember (`node.start_ms`, clock-deterministic)
+            start_ms = counters.get("node.start_ms")
+            if start_ms is not None:
+                if st.start_ms is not None and start_ms > st.start_ms:
+                    self._restarts_latched += 1.0
+                st.start_ms = float(start_ms)
+        if self._crashes_latched > 0 or self._restarts_latched > 0:
+            firing["node_crash"] = {
+                "crashes_seen": self._crashes_latched,
+                "restarts_seen": self._restarts_latched,
+            }
+
+    # -- query surface -----------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The last sweep's rollup (empty before the first sweep)."""
+        return dict(self._last_status)
+
+    def active_alerts(self) -> List[Dict[str, Any]]:
+        return self.sink.active_alerts()
+
+    def alert_log(self) -> List[str]:
+        return list(self.sink.log)
+
+    def gauges(self) -> Dict[str, float]:
+        """Monitor.add_counter_provider provider."""
+        out = {
+            "health.sweeps": float(self.num_sweeps),
+            "health.crashes_seen": self._crashes_latched,
+        }
+        out.update(self.sink.gauges())
+        return out
+
+
+class HealthMonitor(Actor):
+    """The sweep driver: one fiber on the injected Clock calling
+    ``aggregator.sweep()`` every ``interval_s``.  Kept separate from
+    the aggregator so tests (and the ctrl refresh path) can sweep
+    synchronously without an actor in the way."""
+
+    def __init__(
+        self,
+        aggregator: FleetHealthAggregator,
+        clock: Clock,
+        counters: Optional[CounterMap] = None,
+        interval_s: float = 15.0,
+    ) -> None:
+        super().__init__("health", clock, counters)
+        self.aggregator = aggregator
+        self._interval = interval_s
+
+    def start(self) -> None:
+        self.spawn(self._sweep_fiber(), name="health.sweeps")
+
+    async def _sweep_fiber(self) -> None:
+        while True:
+            await self.clock.sleep(self._interval)
+            self.touch()
+            self.aggregator.sweep()

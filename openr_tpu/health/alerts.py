@@ -1,0 +1,244 @@
+"""Alert registry + sink — every fleet health alert has ONE name and
+one delivery path.
+
+The registry half mirrors ``openr_tpu/tracing/pipeline.py``: this module
+is the only place a ``health.alert.*`` counter name may be spelled
+(enforced by orlint's ``alert-name-registry`` rule).  An alert name that
+is not in :data:`ALERTS` does not exist — the aggregator refuses to fire
+it, the chaos fidelity suite cannot accidentally assert on a typo, and
+dashboards can enumerate the complete alert surface from one dict.
+
+The sink half turns per-sweep firing sets into operator surfaces:
+
+  * ``health.alert.{name}`` counters — bumped once per sweep while the
+    alert is firing, so the counter's growth rate IS the firing
+    duration in sweeps (fb303-style: watchable, rateable, diffable);
+  * a structured JSONL alert log — one line per transition (``fired`` /
+    ``resolved``), deterministic bytes under SimClock (sorted keys,
+    clock timestamps, a monotonic seq — two seeded replays must produce
+    byte-identical logs, which the chaos suite asserts);
+  * page-severity escalation: a rising page alert freezes the node's
+    flight recorder at detection time (rate-limited, and deduped to at
+    most one dump per sweep even when several page alerts rise
+    together) so the post-mortem window is captured before it rolls.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+#: severity levels, mildest first
+SEV_TICKET = "ticket"
+SEV_PAGE = "page"
+
+#: the ONLY spelling of the alert counter namespace
+ALERT_COUNTER_PREFIX = "health.alert."
+
+#: name -> (default severity, one-line description).  Adding an alert
+#: means adding it HERE (plus a chaos scenario proving it fires —
+#: tests/test_health_chaos.py is the fidelity gate).
+ALERTS: Dict[str, tuple] = {
+    "generation_skew": (
+        SEV_TICKET,
+        "a node stopped advancing Decision generations while the rest "
+        "of the fleet churned (partitioned / wedged / stale LSDB)",
+    ),
+    "chip_quarantine": (
+        SEV_PAGE,
+        "one or more accelerator chips are quarantined fleet-wide "
+        "(shadow-verification mismatch or chaos/operator drain)",
+    ),
+    "backend_quarantine": (
+        SEV_PAGE,
+        "a node's whole device backend is quarantined; its route "
+        "builds and serving degraded to the scalar engines",
+    ),
+    "breaker_open": (
+        SEV_TICKET,
+        "a circuit breaker (FIB agent, KvStore peer, device backend) "
+        "is open or probing somewhere in the fleet",
+    ),
+    "queue_saturation": (
+        SEV_TICKET,
+        "an inter-module queue's backlog exceeds the saturation "
+        "threshold (consumer wedged or overloaded)",
+    ),
+    "utilization_spread": (
+        SEV_TICKET,
+        "per-chip busy-time spread on one node exceeds the bound "
+        "(shard imbalance or a silently slow chip)",
+    ),
+    "node_crash": (
+        SEV_PAGE,
+        "a watchdog fired a crash somewhere in the fleet (module "
+        "fiber death, stall, queue overflow, or chaos kill)",
+    ),
+    "slo_convergence_p99": (
+        SEV_PAGE,
+        "publication->FIB convergence p99 is burning its error "
+        "budget on both burn-rate windows",
+    ),
+    "slo_serving_queue_wait_p95": (
+        SEV_TICKET,
+        "serving-plane queue wait p95 is burning its error budget "
+        "on both burn-rate windows",
+    ),
+}
+
+
+def alert_severity(name: str) -> str:
+    return ALERTS[name][0]
+
+
+def alert_description(name: str) -> str:
+    return ALERTS[name][1]
+
+
+def alert_counter_key(name: str) -> str:
+    """``health.alert.{name}`` — the firing counter for one alert."""
+    if name not in ALERTS:
+        raise ValueError(f"unknown alert name {name!r}")
+    return ALERT_COUNTER_PREFIX + name
+
+
+class AlertSink:
+    """Transition-edge alert delivery for one aggregator.
+
+    ``report(firing)`` is called once per sweep with the complete
+    firing set; the sink diffs it against the previous sweep's to log
+    transitions, bumps the per-alert counters, and (for rising page
+    alerts) triggers at most one flight-recorder dump per sweep,
+    rate-limited by ``page_dump_min_s`` on the injected clock.
+    """
+
+    def __init__(
+        self,
+        node_name: str,
+        clock,
+        counters,
+        flight_recorder=None,
+        log_path: str = "",
+        max_log_entries: int = 4096,
+        page_dump_min_s: float = 30.0,
+    ) -> None:
+        self.node_name = node_name
+        self.clock = clock
+        self.counters = counters
+        self.flight_recorder = flight_recorder
+        self.log_path = log_path
+        self.max_log_entries = max_log_entries
+        self.page_dump_min_s = page_dump_min_s
+        #: name -> detail dict of the rising edge (the active set)
+        self.active: Dict[str, Dict[str, Any]] = {}
+        #: JSONL transition log (deterministic bytes under SimClock)
+        self.log: List[str] = []
+        self.num_fired = 0
+        self.num_resolved = 0
+        self.num_page_dumps = 0
+        self.num_page_dumps_suppressed = 0
+        self._seq = 0
+        self._last_page_dump_ts: Optional[float] = None
+        if log_path:
+            # one run's record, not an append log (MetricsJsonlWriter rule)
+            with open(log_path, "w"):
+                pass
+
+    # -- delivery ----------------------------------------------------------
+
+    def report(self, firing: Dict[str, Dict[str, Any]]) -> None:
+        """One sweep's complete firing set: {alert_name: detail}."""
+        now_ms = int(self.clock.now_ms())
+        rising_pages: List[str] = []
+        for name in sorted(firing):
+            if name not in ALERTS:
+                raise ValueError(f"unregistered alert name {name!r}")
+            self.counters.bump(alert_counter_key(name))
+            if name not in self.active:
+                self.num_fired += 1
+                self._log_event("fired", name, now_ms, firing[name])
+                if alert_severity(name) == SEV_PAGE:
+                    rising_pages.append(name)
+            self.active[name] = dict(firing[name])
+        for name in sorted(set(self.active) - set(firing)):
+            detail = self.active.pop(name)
+            self.num_resolved += 1
+            self._log_event("resolved", name, now_ms, detail)
+        if rising_pages:
+            self._page_dump(rising_pages, now_ms)
+
+    def _log_event(
+        self, event: str, name: str, now_ms: int, detail: Dict[str, Any]
+    ) -> None:
+        line = json.dumps(
+            {
+                "event": event,
+                "name": name,
+                "severity": alert_severity(name),
+                "node": self.node_name,
+                "seq": self._seq,
+                "ts_ms": now_ms,
+                "detail": detail,
+            },
+            sort_keys=True,
+            separators=(",", ":"),
+            default=str,
+        )
+        self._seq += 1
+        self.log.append(line)
+        if len(self.log) > self.max_log_entries:
+            del self.log[: len(self.log) - self.max_log_entries]
+        if self.log_path:
+            try:
+                with open(self.log_path, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                # a full disk must not take the health plane down with it
+                self.counters.bump("health.alert_log_write_errors")
+
+    def _page_dump(self, names: List[str], now_ms: int) -> None:
+        """One detection-time post-mortem for this sweep's rising page
+        alerts (deduped: several simultaneous pages share one dump),
+        rate-limited so a flapping page can't churn the dump ring."""
+        if self.flight_recorder is None:
+            return
+        now = self.clock.now()
+        if (
+            self._last_page_dump_ts is not None
+            and now - self._last_page_dump_ts < self.page_dump_min_s
+        ):
+            self.num_page_dumps_suppressed += 1
+            self.counters.bump("health.page_dumps_suppressed")
+            return
+        self._last_page_dump_ts = now
+        self.num_page_dumps += 1
+        self.flight_recorder.dump(
+            "health_page_alert",
+            extra={"alerts": names, "detected_ts_ms": now_ms},
+        )
+
+    # -- query surface -----------------------------------------------------
+
+    def active_alerts(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "name": name,
+                "severity": alert_severity(name),
+                "description": alert_description(name),
+                "detail": dict(detail),
+            }
+            for name, detail in sorted(self.active.items())
+        ]
+
+    def log_bytes(self) -> bytes:
+        """The whole transition log as JSONL bytes — what the chaos
+        suite byte-compares across seeded replays."""
+        return ("".join(line + "\n" for line in self.log)).encode()
+
+    def gauges(self) -> Dict[str, float]:
+        return {
+            "health.alerts.active": float(len(self.active)),
+            "health.alerts.fired": float(self.num_fired),
+            "health.alerts.resolved": float(self.num_resolved),
+            "health.page_dumps": float(self.num_page_dumps),
+        }

@@ -1,0 +1,249 @@
+"""SLO specs + the multi-window burn-rate engine.
+
+An :class:`SloSpec` declares one service-level objective over the
+metric surface PR 7 exports: either a histogram percentile ("p99 of
+``convergence.event_to_fib_ms`` stays under 30s") or a counter delta
+("no more than N watchdog crashes per interval").  The
+:class:`BurnRateEvaluator` turns the fleet-merged metric stream into
+the SRE-book multi-window burn-rate signal:
+
+  * each aggregator sweep contributes one **interval sample**: how many
+    SLI events landed since the previous sweep and how many of them
+    were bad (for histogram SLIs, bucket-delta counting above the
+    threshold edge; for counter SLIs, 0/1 on the delta exceeding the
+    threshold);
+  * a window's **burn rate** is (bad/total over the window) divided by
+    the objective's error budget — burn 1.0 means "spending budget
+    exactly as fast as allowed", 10 means "budget gone in a tenth of
+    the window";
+  * the alert fires only when BOTH the fast and the slow window exceed
+    ``burn_threshold`` — the fast window catches onset quickly, the
+    slow window keeps a single bad blip from paging (Google SRE
+    workbook's multiwindow, multi-burn-rate alerts).
+
+Every timestamp comes from the injected Clock, and windows are plain
+deques of ``(ts, bad, total)`` — a SimClock replay reproduces the exact
+same burn trajectory byte for byte.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Optional, Tuple
+
+from openr_tpu.health.alerts import ALERTS, SEV_PAGE, SEV_TICKET
+
+KIND_HISTOGRAM = "histogram_percentile"
+KIND_COUNTER = "counter_threshold"
+
+SLO_KINDS = (KIND_HISTOGRAM, KIND_COUNTER)
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One declarative objective.  ``name`` doubles as the alert name
+    and must be registered in :data:`openr_tpu.health.alerts.ALERTS`."""
+
+    name: str
+    metric: str
+    kind: str = KIND_HISTOGRAM
+    #: reported-value percentile (status surface) for histogram SLIs
+    percentile: float = 99.0
+    #: an SLI event is BAD when its value exceeds this
+    threshold: float = 0.0
+    #: error budget: allowed bad fraction over the objective window
+    objective: float = 0.01
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    #: fire when BOTH windows burn at >= this multiple of budget
+    burn_threshold: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.name not in ALERTS:
+            raise ValueError(
+                f"SLO name {self.name!r} is not a registered alert "
+                "(openr_tpu.health.alerts.ALERTS)"
+            )
+        if self.kind not in SLO_KINDS:
+            raise ValueError(f"SLO kind must be one of {SLO_KINDS}")
+        if not (0.0 < self.objective <= 1.0):
+            raise ValueError("objective must be in (0, 1]")
+        if not (0.0 < self.fast_window_s <= self.slow_window_s):
+            raise ValueError("need 0 < fast_window_s <= slow_window_s")
+        if self.burn_threshold <= 0.0:
+            raise ValueError("burn_threshold must be > 0")
+
+    @property
+    def severity(self) -> str:
+        return ALERTS[self.name][0]
+
+
+def default_slos() -> Tuple[SloSpec, ...]:
+    """The built-in objective catalog (docs/Observability.md §fleet
+    health lists the rationale for each threshold)."""
+    return (
+        SloSpec(
+            name="slo_convergence_p99",
+            metric="convergence.event_to_fib_ms",
+            kind=KIND_HISTOGRAM,
+            percentile=99.0,
+            threshold=30_000.0,  # PAPER §1: sub-30s event->FIB even at WAN scale
+            objective=0.05,
+            fast_window_s=60.0,
+            slow_window_s=300.0,
+            burn_threshold=2.0,
+        ),
+        SloSpec(
+            name="slo_serving_queue_wait_p95",
+            metric="serving.queue_wait_ms",
+            kind=KIND_HISTOGRAM,
+            percentile=95.0,
+            threshold=2_000.0,
+            objective=0.05,
+            fast_window_s=60.0,
+            slow_window_s=300.0,
+            burn_threshold=2.0,
+        ),
+    )
+
+
+@dataclass
+class _SloState:
+    """Mutable evaluation state for one spec."""
+
+    #: previous sweep's cumulative (bad_count, total_count) baseline
+    last_cum: Optional[Tuple[float, float]] = None
+    #: interval samples (ts, bad, total), bounded by the slow window
+    samples: Deque[Tuple[float, float, float]] = field(default_factory=deque)
+    firing: bool = False
+    last_value: Optional[float] = None
+
+
+def _bad_total_from_histogram(hist: Optional[dict], threshold: float):
+    """Cumulative (bad, total) from one merged histogram snapshot dict:
+    bad = samples in buckets whose UPPER edge exceeds the threshold
+    (bucket-granular, conservative in the operator's favor by at most
+    one ~15%-wide bucket)."""
+    if hist is None:
+        return 0.0, 0.0
+    bad = 0.0
+    for edge, count in hist.get("buckets", []):
+        if float(edge) > threshold:
+            bad += count
+    return bad, float(hist.get("count", 0))
+
+
+class BurnRateEvaluator:
+    """Evaluates every spec once per sweep against the merged fleet
+    metric surface; owns nothing but deterministic window state."""
+
+    def __init__(self, clock, specs) -> None:
+        self.clock = clock
+        self.specs = tuple(specs)
+        names = [s.name for s in self.specs]
+        if len(names) != len(set(names)):
+            raise ValueError(f"duplicate SLO names: {names}")
+        self._state: Dict[str, _SloState] = {
+            s.name: _SloState() for s in self.specs
+        }
+
+    # -- one sweep ---------------------------------------------------------
+
+    def evaluate(
+        self,
+        merged_histograms: Dict[str, dict],
+        merged_counters: Dict[str, float],
+    ) -> Dict[str, Dict[str, Any]]:
+        """Returns {slo_name: detail} for the specs firing NOW."""
+        now = self.clock.now()
+        firing: Dict[str, Dict[str, Any]] = {}
+        for spec in self.specs:
+            st = self._state[spec.name]
+            if spec.kind == KIND_HISTOGRAM:
+                hist = merged_histograms.get(spec.metric)
+                cum = _bad_total_from_histogram(hist, spec.threshold)
+                st.last_value = (hist or {}).get(f"p{spec.percentile:g}")
+            else:
+                value = merged_counters.get(spec.metric, 0.0)
+                st.last_value = value
+                cum = (value, 1.0)  # delta-thresholded below
+            if st.last_cum is None:
+                # first sweep establishes the baseline; deltas start next
+                st.last_cum = cum
+                continue
+            if spec.kind == KIND_HISTOGRAM:
+                d_bad = max(cum[0] - st.last_cum[0], 0.0)
+                d_total = max(cum[1] - st.last_cum[1], 0.0)
+            else:
+                delta = cum[0] - st.last_cum[0]
+                d_total, d_bad = 1.0, (1.0 if delta > spec.threshold else 0.0)
+            st.last_cum = cum
+            st.samples.append((now, d_bad, d_total))
+            while st.samples and st.samples[0][0] < now - spec.slow_window_s:
+                st.samples.popleft()
+            fast = self._burn(st, spec, now, spec.fast_window_s)
+            slow = self._burn(st, spec, now, spec.slow_window_s)
+            st.firing = (
+                fast >= spec.burn_threshold and slow >= spec.burn_threshold
+            )
+            if st.firing:
+                firing[spec.name] = {
+                    "metric": spec.metric,
+                    "value": st.last_value,
+                    "threshold": spec.threshold,
+                    "fast_burn": round(fast, 4),
+                    "slow_burn": round(slow, 4),
+                }
+        return firing
+
+    @staticmethod
+    def _burn(st: _SloState, spec: SloSpec, now: float, window_s: float):
+        bad = total = 0.0
+        for ts, b, t in st.samples:
+            if ts >= now - window_s:
+                bad += b
+                total += t
+        if total <= 0.0:
+            return 0.0
+        return (bad / total) / spec.objective
+
+    # -- status surface ----------------------------------------------------
+
+    def status(self) -> list:
+        now = self.clock.now()
+        out = []
+        for spec in self.specs:
+            st = self._state[spec.name]
+            out.append(
+                {
+                    "name": spec.name,
+                    "metric": spec.metric,
+                    "kind": spec.kind,
+                    "percentile": spec.percentile,
+                    "threshold": spec.threshold,
+                    "objective": spec.objective,
+                    "severity": spec.severity,
+                    "value": st.last_value,
+                    "fast_burn": round(
+                        self._burn(st, spec, now, spec.fast_window_s), 4
+                    ),
+                    "slow_burn": round(
+                        self._burn(st, spec, now, spec.slow_window_s), 4
+                    ),
+                    "firing": st.firing,
+                }
+            )
+        return out
+
+
+__all__ = [
+    "SloSpec",
+    "BurnRateEvaluator",
+    "default_slos",
+    "KIND_HISTOGRAM",
+    "KIND_COUNTER",
+    "SLO_KINDS",
+    "SEV_PAGE",
+    "SEV_TICKET",
+]

@@ -164,6 +164,13 @@ class OpenrNode:
         if bind is not None:
             bind(clock, self.counters)
         self.init_tracker = InitializationTracker(clock)
+        # incarnation stamp on the injected Clock: a supervisor restart
+        # replaces the node (and resets every counter) faster than a
+        # fleet-health sweep can observe `watchdog.crashes`, so the
+        # aggregator latches crash/restart from this value INCREASING
+        # instead — deterministic under SimClock, trivially monotonic
+        # across restarts on one clock
+        self.counters.set("node.start_ms", float(clock.now_ms()))
         # causal convergence tracing: one tracer per node, shared by every
         # pipeline stage (injected Clock ⇒ SimClock tests replay traces)
         from openr_tpu.tracing import Tracer
@@ -457,6 +464,75 @@ class OpenrNode:
                 return recorder.stats()
 
             self.monitor.add_counter_provider(_recorder_gauges)
+        # fleet health plane: SLO burn-rate evaluation + cross-node
+        # rollups over MetricsSnapshots.  The default source is this
+        # node alone; EmulatedNetwork re-points it at the whole fleet
+        # (metrics_snapshots()), and real deployments can poll peer ctrl
+        # endpoints — the aggregator only sees snapshot dicts either way
+        self.health = None
+        self.health_monitor = None
+        hc = config.health_config
+        if hc.enabled:
+            from openr_tpu.health import (
+                AlertSink,
+                FleetHealthAggregator,
+                HealthMonitor,
+                SloSpec,
+            )
+
+            slos = (
+                [
+                    SloSpec(
+                        name=s.name,
+                        metric=s.metric,
+                        kind=s.kind,
+                        percentile=s.percentile,
+                        threshold=s.threshold,
+                        objective=s.objective,
+                        fast_window_s=s.fast_window_s,
+                        slow_window_s=s.slow_window_s,
+                        burn_threshold=s.burn_threshold,
+                    )
+                    for s in hc.slos
+                ]
+                if hc.slos
+                else None
+            )
+
+            def _own_snapshots():
+                from openr_tpu.monitor.metrics import MetricsSnapshot
+
+                return [MetricsSnapshot.capture(self)]
+
+            self.health = FleetHealthAggregator(
+                node_name=self.name,
+                clock=clock,
+                source=_own_snapshots,
+                sink=AlertSink(
+                    self.name,
+                    clock,
+                    self.counters,
+                    flight_recorder=self.flight_recorder,
+                    max_log_entries=hc.alert_log_entries,
+                    page_dump_min_s=hc.page_dump_min_s,
+                ),
+                counters=self.counters,
+                slos=slos,
+                skew_min_generations=hc.skew_min_generations,
+                skew_hold_s=hc.skew_hold_s,
+                queue_depth_threshold=hc.queue_depth_threshold,
+                utilization_spread_threshold=(
+                    hc.utilization_spread_threshold
+                ),
+                utilization_spread_floor=hc.utilization_spread_floor,
+            )
+            self.health_monitor = HealthMonitor(
+                self.health,
+                clock,
+                self.counters,
+                interval_s=hc.sweep_interval_s,
+            )
+            self.monitor.add_counter_provider(self.health.gauges)
         self.watchdog: Optional[Watchdog] = None
         if config.enable_watchdog:
             wd = config.watchdog_config
@@ -488,6 +564,8 @@ class OpenrNode:
         ]
         if config.serving_config.enabled:
             self._all_modules.append(self.serving)
+        if self.health_monitor is not None:
+            self._all_modules.append(self.health_monitor)
         if self.watchdog is not None:
             self._all_modules.insert(0, self.watchdog)
             for m in self._all_modules[1:]:

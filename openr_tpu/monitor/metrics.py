@@ -155,6 +155,102 @@ def _metric_name(key: str) -> str:
     return "openr_" + _NAME_RE.sub("_", key)
 
 
+#: per-device gauge keys (``<head>.dev<N>.<tail>``) are promoted to ONE
+#: labeled family per (head, tail) with a ``device="N"`` label — a fleet
+#: dashboard graphs `openr_pipeline_device_busy_ms` across chips instead
+#: of discovering `_dev0_`/`_dev1_`/... families one by one.  Internal
+#: dotted counter names are UNCHANGED; this is a rendering-layer mapping.
+_DEV_RE = re.compile(r"^(?P<head>.+?)\.dev(?P<idx>\d+)\.(?P<tail>.+)$")
+
+
+def _device_family(key: str) -> Optional[Tuple[str, str]]:
+    """(family_internal_key, device_index_str) for a per-device gauge
+    key, else None.  The family key spells the device segment as
+    ``.device.`` — e.g. ``pipeline.dev3.busy_ms`` ->
+    (``pipeline.device.busy_ms``, "3")."""
+    m = _DEV_RE.match(key)
+    if m is None:
+        return None
+    return f"{m.group('head')}.device.{m.group('tail')}", m.group("idx")
+
+
+_DESCRIPTIONS: Optional[Dict[str, str]] = None
+
+
+def _build_descriptions() -> Dict[str, str]:
+    """The metric-description registry behind ``# HELP`` emission:
+    known counter/histogram families only — an undocumented counter
+    renders without HELP rather than with a made-up one.  Names are
+    derived through the owning registries (pipeline phases, alert
+    names), never re-spelled."""
+    from openr_tpu.health.alerts import ALERTS, alert_counter_key
+    from openr_tpu.tracing import pipeline as _pl
+
+    d = {
+        "convergence.event_to_fib_ms": (
+            "end-to-end convergence latency: origin event to FIB ack"
+        ),
+        "decision.spf_ms": "one SPF solve inside a Decision rebuild",
+        "serving.queue_wait_ms": (
+            "serving-plane queue wait before a query joins a batch"
+        ),
+        "serving.batch_solve_ms": "one micro-batched device solve",
+        "trace.dropped_spans": (
+            "open spans dropped at the open-span cap (trace blind spots)"
+        ),
+        "trace.spans_evicted": (
+            "completed spans evicted from the bounded ring"
+        ),
+        "monitor.log.sample_received": "log samples drained by Monitor",
+        "watchdog.crashes": "crashes fired by the watchdog",
+        "resilience.backend.quarantined": (
+            "1 while the whole device backend is quarantined"
+        ),
+        "resilience.backend.shadow_checks": (
+            "device builds shadow-verified against the scalar oracle"
+        ),
+        "resilience.backend.shadow_mismatches": (
+            "shadow checks that caught wrong device output"
+        ),
+        "decision.backend.pool.size": "chips in the device pool",
+        "decision.backend.pool.healthy": "healthy chips in the pool",
+        "health.sweeps": "fleet health aggregator sweeps",
+        "health.alerts.active": "currently-firing fleet health alerts",
+    }
+    for phase in _pl.PHASES:
+        d[_pl.hist_key(phase)] = (
+            f"milliseconds in the {phase} pipeline phase per dispatch"
+        )
+    busy_fam = _device_family(_pl.device_busy_key(0))
+    util_fam = _device_family(_pl.device_utilization_key(0))
+    if busy_fam is not None:
+        d[busy_fam[0]] = "cumulative committed-dispatch busy ms per chip"
+    if util_fam is not None:
+        d[util_fam[0]] = "busy fraction of the probe lifetime per chip"
+    d["decision.backend.pool.device.dispatches"] = (
+        "committed dispatches per chip"
+    )
+    d["resilience.backend.device.state"] = (
+        "per-chip breaker state (0 closed, 1 open, 2 half-open)"
+    )
+    for name in ALERTS:
+        d[alert_counter_key(name)] = (
+            "firing-sweep counter for fleet health alert: "
+            + ALERTS[name][1]
+        )
+    return d
+
+
+def metric_description(key: str) -> Optional[str]:
+    """One-line HELP text for a known family's INTERNAL dotted key
+    (device families use the ``.device.`` spelling); None when the
+    family is not in the registry."""
+    global _DESCRIPTIONS
+    if _DESCRIPTIONS is None:
+        _DESCRIPTIONS = _build_descriptions()
+    return _DESCRIPTIONS.get(key)
+
+
 def _fmt(v: float) -> str:
     if v != v:  # NaN
         return "NaN"
@@ -170,25 +266,45 @@ def _fmt(v: float) -> str:
 def render_prometheus(snapshots: Iterable[MetricsSnapshot]) -> str:
     """All nodes' snapshots as one text-exposition document.  Samples of
     one metric family are contiguous under a single ``# TYPE`` header
-    (the format's grouping requirement), labeled per node."""
+    (the format's grouping requirement), labeled per node; per-device
+    gauges collapse into one family per (head, tail) with a
+    ``device="N"`` label; families in the description registry get a
+    ``# HELP`` line the strict parser preserves."""
     snaps = list(snapshots)
-    gauge_keys: Dict[str, List[Tuple[str, float]]] = {}
+    # family internal key -> [(label items, value)]; labels beyond
+    # node= come from the per-device promotion
+    gauge_keys: Dict[str, List[Tuple[Tuple[Tuple[str, str], ...], float]]] = {}
     for s in snaps:
         for k, v in s.counters.items():
-            gauge_keys.setdefault(k, []).append((s.node, float(v)))
+            fam = _device_family(k)
+            if fam is not None:
+                key, labels = fam[0], (("node", s.node), ("device", fam[1]))
+            else:
+                key, labels = k, (("node", s.node),)
+            gauge_keys.setdefault(key, []).append((labels, float(v)))
     hist_keys: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
     for s in snaps:
         for k, h in s.histograms.items():
             hist_keys.setdefault(k, []).append((s.node, h))
     lines: List[str] = []
+
+    def _header(key: str, mtype: str) -> str:
+        name = _metric_name(key)
+        help_text = metric_description(key)
+        if help_text is not None:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        return name
+
+    def _labels(items: Tuple[Tuple[str, str], ...]) -> str:
+        return ",".join(f'{k}="{v}"' for k, v in items)
+
     for key in sorted(gauge_keys):
-        name = _metric_name(key)
-        lines.append(f"# TYPE {name} gauge")
-        for node, v in gauge_keys[key]:
-            lines.append(f'{name}{{node="{node}"}} {_fmt(v)}')
+        name = _header(key, "gauge")
+        for labels, v in gauge_keys[key]:
+            lines.append(f"{name}{{{_labels(labels)}}} {_fmt(v)}")
     for key in sorted(hist_keys):
-        name = _metric_name(key)
-        lines.append(f"# TYPE {name} histogram")
+        name = _header(key, "histogram")
         for node, h in hist_keys[key]:
             cum = 0
             for edge, c in h["buckets"]:
@@ -215,22 +331,32 @@ _LABEL_RE = re.compile(r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>[^"]*)"')
 
 def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
     """Parse a text exposition back into
-    ``{metric: {"type": t, "samples": {(label items): value}}}`` —
+    ``{metric: {"type": t, "samples": {(label items): value}, ...}}``
+    (families with a ``# HELP`` line carry its text under ``"help"``) —
     strict enough that a malformed document (bad label syntax, sample
-    before its TYPE header, non-float value) raises ValueError, which is
-    the property the round-trip test leans on."""
+    before its TYPE header, malformed HELP, non-float value) raises
+    ValueError, which is the property the round-trip test leans on."""
     metrics: Dict[str, Dict[str, Any]] = {}
     current_family = None
     for lineno, raw in enumerate(text.splitlines(), 1):
         line = raw.strip()
         if not line:
             continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) != 4:
+                raise ValueError(f"line {lineno}: malformed HELP line")
+            _, _, name, help_text = parts
+            fam = metrics.setdefault(name, {"type": None, "samples": {}})
+            fam["help"] = help_text
+            continue
         if line.startswith("# TYPE "):
             parts = line.split()
             if len(parts) != 4:
                 raise ValueError(f"line {lineno}: malformed TYPE header")
             _, _, name, mtype = parts
-            metrics[name] = {"type": mtype, "samples": {}}
+            fam = metrics.setdefault(name, {"type": None, "samples": {}})
+            fam["type"] = mtype
             current_family = name
             continue
         if line.startswith("#"):
@@ -249,7 +375,8 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
                 base = current_family
                 break
         fam = metrics.get(base) or metrics.get(name)
-        if fam is None:
+        if fam is None or fam.get("type") is None:
+            # a bare HELP line does not open a family for samples
             raise ValueError(
                 f"line {lineno}: sample {name} before its TYPE header"
             )

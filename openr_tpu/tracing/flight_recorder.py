@@ -63,6 +63,7 @@ class FlightRecorder:
         out_dir: str = "",
         queue_stats_fn: Optional[Callable[[], Dict[str, float]]] = None,
         generation_fn: Optional[Callable[[], Any]] = None,
+        trigger_min_interval_ms: int = 250,
     ) -> None:
         self.node_name = node_name
         self.clock = clock
@@ -79,6 +80,17 @@ class FlightRecorder:
         self.last_reason: str = ""
         self.num_dumps = 0
         self._seq = 0
+        #: TRIGGERED dumps (the on_* hooks) landing within this window
+        #: of the previous one are coalesced: several listeners firing
+        #: in one Monitor sweep (a quarantine tripping an invariant
+        #: breach) describe ONE incident window — dumping it twice
+        #: doubles the ring churn and buys nothing.  Explicit dump()
+        #: calls (ctrl/operator/chaos harness) are never suppressed.
+        self.trigger_min_interval_ms = trigger_min_interval_ms
+        self._last_trigger_ms: Optional[int] = None
+        self.num_suppressed = 0
+        #: reasons coalesced into the previous dump since it fired
+        self.suppressed_reasons: List[str] = []
 
     # -- the rolling window ------------------------------------------------
 
@@ -111,13 +123,34 @@ class FlightRecorder:
         """BackendHealthGovernor quarantine listener."""
         device = info.get("device")
         tag = f"dev{device}" if device is not None else "backend"
-        self.dump(f"quarantine_{tag}", extra=info)
+        self.trigger_dump(f"quarantine_{tag}", extra=info)
 
     def on_watchdog_crash(self, reason: str) -> None:
-        self.dump("watchdog_crash", extra={"crash_reason": reason})
+        self.trigger_dump("watchdog_crash", extra={"crash_reason": reason})
 
     def on_invariant_breach(self, violation: str) -> None:
-        self.dump("invariant_breach", extra={"violation": violation})
+        self.trigger_dump("invariant_breach", extra={"violation": violation})
+
+    def trigger_dump(
+        self, reason: str, extra: Optional[Dict[str, Any]] = None
+    ) -> Optional[bytes]:
+        """Rate-limited/deduped dump for automatic triggers: when a
+        second trigger lands within ``trigger_min_interval_ms`` of the
+        previous one (same Monitor sweep, same incident window), it is
+        coalesced — counted, its reason recorded — instead of dumped
+        again.  Returns the dump bytes, or None when coalesced."""
+        now_ms = int(self.clock.now_ms())
+        if (
+            self._last_trigger_ms is not None
+            and now_ms - self._last_trigger_ms < self.trigger_min_interval_ms
+        ):
+            self.num_suppressed += 1
+            self.suppressed_reasons.append(reason)
+            self.counters.bump("trace.flight_dumps_suppressed")
+            return None
+        self._last_trigger_ms = now_ms
+        self.suppressed_reasons = []
+        return self.dump(reason, extra=extra)
 
     # -- the dump ----------------------------------------------------------
 
@@ -192,4 +225,5 @@ class FlightRecorder:
         return {
             "trace.flight_dumps": float(self.num_dumps),
             "trace.flight_frames": float(len(self._frames)),
+            "trace.flight_dumps_suppressed": float(self.num_suppressed),
         }

@@ -96,6 +96,24 @@ def device_utilization_key(index: int) -> str:
     return f"{_PREFIX}dev{int(index)}.utilization"
 
 
+import re as _re  # noqa: E402 - registry-local, keeps the prefix here
+
+_DEVICE_KEY_RE = _re.compile(
+    _re.escape(_PREFIX) + r"dev(?P<idx>\d+)\.(?P<kind>busy_ms|utilization)$"
+)
+
+
+def parse_device_key(key: str):
+    """Inverse of the device gauge spellings: ``(index, kind)`` for a
+    ``pipeline.devN.busy_ms`` / ``.utilization`` key, else None — so
+    consumers (the fleet health aggregator's utilization-spread signal)
+    match per-chip gauges without re-spelling the prefix."""
+    m = _DEVICE_KEY_RE.match(key)
+    if m is None:
+        return None
+    return int(m.group("idx")), m.group("kind")
+
+
 class _PhaseScope:
     """Context manager for one timed phase (allocated per phase entry;
     the disabled probe short-circuits to a shared no-op instead)."""

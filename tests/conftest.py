@@ -40,6 +40,10 @@ def pytest_configure(config):
         "markers",
         "multichip: multi-device pool/mesh test (openr_tpu.parallel)",
     )
+    config.addinivalue_line(
+        "markers",
+        "health: fleet-health-plane test (openr_tpu.health)",
+    )
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

@@ -256,6 +256,43 @@ def test_cli_serving_stats_and_queries(live_node):
     assert "serving on node0" in table and "max_batch=64" in table
 
 
+def test_cli_health_status_alerts_slo(live_node):
+    """breeze health status/alerts/slo against a live node: the fleet
+    rollup (both emulated nodes), the SLO catalog, and an empty alert
+    surface on a healthy network."""
+    status = json.loads(_run(live_node, "health", "status", "--json"))
+    assert status["node"] == "node0" and status["sweeps"] >= 1
+    assert {r["node"] for r in status["nodes"]} == {"node0", "node1"}
+    assert status["active_alerts"] == []
+    assert {s["name"] for s in status["slos"]} == {
+        "slo_convergence_p99",
+        "slo_serving_queue_wait_p95",
+    }
+    human = _run(live_node, "health", "status")
+    assert "fleet health via node0: 2 nodes, 0 active alerts" in human
+    assert "active alerts: none" in human
+    alerts = json.loads(_run(live_node, "health", "alerts", "--json"))
+    assert alerts["active"] == [] and alerts["log"] == []
+    assert "0 active alerts (0 fired, 0 resolved, 0 page dumps)" in _run(
+        live_node, "health", "alerts"
+    )
+    slo_lines = _run(live_node, "health", "slo").splitlines()
+    assert any(
+        line.startswith("slo_convergence_p99 [page]") for line in slo_lines
+    )
+    slo_json = json.loads(_run(live_node, "health", "slo", "--json"))
+    assert all(s["firing"] is False for s in slo_json)
+    # the no-refresh path serves the last sweep without adding one
+    cached = json.loads(
+        _run(live_node, "health", "status", "--json", "--no-refresh")
+    )
+    cached2 = json.loads(
+        _run(live_node, "health", "status", "--json", "--no-refresh")
+    )
+    assert cached2["sweeps"] - cached["sweeps"] <= 1  # periodic only
+    assert {r["node"] for r in cached["nodes"]} == {"node0", "node1"}
+
+
 def test_cli_resilience_status_scalar_node(live_node):
     """breeze resilience status on a scalar deployment: no device
     governor, but the FIB agent breaker is always reported."""

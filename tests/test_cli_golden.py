@@ -76,7 +76,8 @@ def canonical(output: str) -> str:
     return json.dumps(scrub(obj), indent=2, sort_keys=True) + "\n"
 
 
-def _live_node_fixture(num_nodes: int, use_tpu_backend: bool, ready):
+def _live_node_fixture(num_nodes: int, use_tpu_backend: bool, ready,
+                       edges_fn=None):
     """One background-loop node lifecycle; fixtures below parameterize
     topology size, backend, and the readiness predicate."""
     started = threading.Event()
@@ -93,7 +94,9 @@ def _live_node_fixture(num_nodes: int, use_tpu_backend: bool, ready):
         async def main():
             clock = WallClock()
             net = EmulatedNetwork(clock, use_tpu_backend=use_tpu_backend)
-            net.build(line_edges(num_nodes))
+            net.build(
+                edges_fn() if edges_fn is not None else line_edges(num_nodes)
+            )
             net.start()
             server = OpenrCtrlServer(net.nodes["node0"], port=0)
             await server.start()
@@ -136,6 +139,21 @@ def live_tpu_node():
     features (fleet-summary, whatif) the scalar fixture can't."""
     yield from _live_node_fixture(
         3, True, lambda net: len(net.nodes["node0"].fib.get_route_db()) >= 2
+    )
+
+
+@pytest.fixture(scope="module")
+def live_fleet_node():
+    """9-node grid with the TPU decision backend — the fleet the
+    `breeze health` goldens render a rollup of (ISSUE 8 acceptance:
+    fleet rollup against a live 9-node emulation)."""
+    from openr_tpu.emulation.topology import grid_edges
+
+    yield from _live_node_fixture(
+        9,
+        True,
+        lambda net: len(net.nodes["node0"].fib.get_route_db()) >= 8,
+        edges_fn=lambda: grid_edges(3),
     )
 
 
@@ -486,3 +504,20 @@ def test_golden_whatif_node(live_tpu_node):
         "whatif-node",
         "node1",
     )
+
+
+# ISSUE 8: fleet health plane goldens against the live 9-node grid
+
+
+def test_golden_health_status(live_fleet_node):
+    """The fleet rollup: all 9 nodes' generation rows, SLO burn lines,
+    chip/breaker/queue state, zero active alerts on a healthy fleet."""
+    check_golden("health_status", live_fleet_node, "health", "status")
+
+
+def test_golden_health_alerts(live_fleet_node):
+    check_golden("health_alerts", live_fleet_node, "health", "alerts")
+
+
+def test_golden_health_slo(live_fleet_node):
+    check_golden("health_slo", live_fleet_node, "health", "slo")

@@ -111,6 +111,42 @@ def test_dump_ring_is_bounded():
     assert rec.num_dumps == 12 and len(rec.dumps) == 8  # max_dumps
 
 
+def test_simultaneous_triggers_coalesce_into_one_dump():
+    """ISSUE 8 satellite: two listeners firing in one Monitor sweep (a
+    chip quarantine whose fallout also breaches an invariant) describe
+    ONE incident window — the second trigger is coalesced, counted,
+    and its reason recorded, instead of double-dumping the ring."""
+    rec, tracer, counters, clock = make_recorder()
+    span = tracer.start_span("resilience.shadow_check", module="resilience")
+    tracer.end_span(span, passed=False)
+    # same SimClock instant = same sweep: quarantine then breach
+    rec.on_quarantine({"device": 3, "reason": "shadow:prefixes"})
+    rec.on_invariant_breach("node0: FIB desired/programmed mismatch")
+    assert rec.num_dumps == 1
+    assert rec.last_reason == "quarantine_dev3"
+    assert rec.num_suppressed == 1
+    assert rec.suppressed_reasons == ["invariant_breach"]
+    assert counters.get("trace.flight_dumps_suppressed") == 1.0
+    assert rec.stats()["trace.flight_dumps_suppressed"] == 1.0
+    # past the dedupe window a fresh trigger dumps again
+    async def advance():
+        await clock.run_for(1.0)
+
+    run(advance())
+    rec.on_watchdog_crash("Module decision fiber died")
+    assert rec.num_dumps == 2 and rec.last_reason == "watchdog_crash"
+    assert rec.suppressed_reasons == []
+
+
+def test_explicit_dump_calls_are_never_suppressed():
+    """The operator/ctrl/chaos-harness dump() path stays unconditional
+    — only the automatic trigger hooks dedupe."""
+    rec, _tracer, _counters, _clock = make_recorder()
+    rec.dump("a")
+    rec.dump("b")
+    assert rec.num_dumps == 2 and rec.num_suppressed == 0
+
+
 # ---------------------------------------------------------------------------
 # trigger hooks
 # ---------------------------------------------------------------------------

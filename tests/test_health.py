@@ -1,0 +1,712 @@
+"""Fleet health plane units (ISSUE 8 tentpole): SLO burn-rate window
+math, cross-node histogram merge through the fleet rollup (incl. the
+PR-7 widen-on-merge path with mismatched bucket widths), generation-
+skew detection, the alert sink's transition edges / counters /
+deterministic JSONL / page-dump dedupe, and the derived fleet signals
+from synthetic snapshots."""
+
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import CounterMap, Histogram, SimClock
+from openr_tpu.health import (
+    ALERTS,
+    AlertSink,
+    BurnRateEvaluator,
+    FleetHealthAggregator,
+    SloSpec,
+    alert_counter_key,
+    default_slos,
+    generation_hash,
+    histogram_from_snapshot,
+    merge_fleet_histograms,
+)
+from openr_tpu.health.slo import KIND_COUNTER
+
+pytestmark = [pytest.mark.health]
+
+
+# ---------------------------------------------------------------------------
+# snapshot plumbing helpers
+# ---------------------------------------------------------------------------
+
+
+def hist_snap(values, num_buckets=160):
+    h = Histogram(num_buckets=num_buckets)
+    for v in values:
+        h.observe(v)
+    d = dict(h.config())
+    d.update(
+        count=h.count,
+        sum=h.total,
+        min=h.vmin,
+        max=h.vmax,
+        buckets=[[e, c] for e, c in h.bucket_items()],
+    )
+    return d
+
+
+def snap(node, counters=None, histograms=None, generation=None):
+    return {
+        "node": node,
+        "ts_ms": 0,
+        "generation": generation if generation is not None else [0],
+        "env": {},
+        "counters": counters or {},
+        "histograms": histograms or {},
+    }
+
+
+def make_sink(clock=None, recorder=None, **kw):
+    return AlertSink(
+        "agg0", clock or SimClock(), CounterMap(),
+        flight_recorder=recorder, **kw,
+    )
+
+
+def make_agg(clock, source, sink=None, slos=(), **kw):
+    sink = sink or make_sink(clock)
+    return (
+        FleetHealthAggregator(
+            node_name="agg0",
+            clock=clock,
+            source=source,
+            sink=sink,
+            slos=list(slos),
+            **kw,
+        ),
+        sink,
+    )
+
+
+# ---------------------------------------------------------------------------
+# histogram reconstruction + cross-node merge (satellite: mismatched
+# bucket widths exercise PR-7 widen-on-merge through the fleet rollup)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_from_snapshot_round_trips():
+    h = Histogram()
+    for v in (0.5, 12.0, 480.0, 1e9):  # last lands in overflow
+        h.observe(v)
+    d = hist_snap((0.5, 12.0, 480.0, 1e9))
+    back = histogram_from_snapshot(d)
+    assert back.count == h.count and back.total == h.total
+    assert back.counts == h.counts
+    assert back.percentile(50) == h.percentile(50)
+
+
+def test_fleet_merge_sums_counts_across_nodes():
+    snaps = [
+        snap("a", histograms={"x.ms": hist_snap([1.0, 2.0])}),
+        snap("b", histograms={"x.ms": hist_snap([1000.0])}),
+    ]
+    merged = merge_fleet_histograms(snaps)["x.ms"]
+    assert merged["count"] == 3
+    assert merged["min"] == 1.0 and merged["max"] == 1000.0
+    assert merged["p99"] <= 1000.0
+
+
+def test_fleet_merge_widens_mismatched_bucket_widths():
+    """Node A exports the default 160-bucket grid, node B a 200-bucket
+    grid (same min_bound/growth): the rollup must widen to 200 and
+    place every sample, whichever order the nodes arrive in."""
+    wide_val = Histogram().edges[-1] * 2  # beyond the narrow grid
+    for order in ((160, 200), (200, 160)):
+        snaps = [
+            snap("a", histograms={"k": hist_snap([5.0], num_buckets=order[0])}),
+            snap(
+                "b",
+                histograms={"k": hist_snap([wide_val], num_buckets=order[1])},
+            ),
+        ]
+        merged = merge_fleet_histograms(snaps)["k"]
+        assert merged["num_buckets"] == 200
+        assert merged["count"] == 2
+        assert merged["max"] == wide_val
+        assert sum(c for _e, c in merged["buckets"]) == 2
+
+
+def test_fleet_merge_incompatible_grids_raise():
+    a = snap("a", histograms={"k": hist_snap([1.0])})
+    b = snap("b", histograms={"k": hist_snap([1.0])})
+    b["histograms"]["k"]["growth"] = 2.0
+    with pytest.raises(ValueError):
+        merge_fleet_histograms([a, b])
+
+
+def test_aggregator_slo_sees_cross_node_widened_merge():
+    """The widen path through the WHOLE rollup: two nodes with
+    different grid widths feed one SLO whose bad samples live only in
+    the wide node's upper buckets."""
+    clock = SimClock()
+    wide_val = Histogram().edges[-1] * 2
+    calls = {"n": 0}
+
+    def source():
+        calls["n"] += 1
+        # second sweep adds one bad (wide) + many good samples
+        if calls["n"] == 1:
+            a_vals, b_vals = [1.0], [2.0]
+        else:
+            a_vals, b_vals = [1.0] * 3, [2.0, wide_val]
+        return [
+            snap("a", histograms={"m": hist_snap(a_vals, 160)}),
+            snap("b", histograms={"m": hist_snap(b_vals, 200)}),
+        ]
+
+    spec = SloSpec(
+        name="slo_convergence_p99", metric="m", threshold=1e6,
+        objective=0.01, fast_window_s=10, slow_window_s=10,
+        burn_threshold=1.0,
+    )
+    agg, sink = make_agg(clock, source, slos=[spec])
+    agg.sweep()
+    clock._now += 1.0
+    agg.sweep()
+    assert [a["name"] for a in sink.active_alerts()] == [
+        "slo_convergence_p99"
+    ]
+    detail = sink.active[spec.name]
+    assert detail["fast_burn"] >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+def bad_total_samples(evaluator, name):
+    return list(evaluator._state[name].samples)
+
+
+def test_burn_rate_fires_only_when_both_windows_burn():
+    clock = SimClock()
+    spec = SloSpec(
+        name="slo_convergence_p99", metric="m", threshold=10.0,
+        objective=0.1, fast_window_s=2.0, slow_window_s=10.0,
+        burn_threshold=2.0,
+    )
+    ev = BurnRateEvaluator(clock, [spec])
+
+    def sweep(values):
+        return ev.evaluate({"m": hist_snap(values)}, {})
+
+    # baseline
+    assert sweep([1.0]) == {}
+    history = [1.0]
+    # 8 clean intervals fill the slow window with good samples
+    for _ in range(8):
+        clock._now += 1.0
+        history.append(1.0)
+        assert sweep(list(history)) == {}
+    # one bad interval: fast window (2s) is now 100% bad -> burn 10,
+    # but the slow window is ~1/10 bad -> burn ~1 < 2: no alert
+    clock._now += 1.0
+    history.append(1000.0)
+    assert sweep(list(history)) == {}
+    # sustained badness pushes the slow window over too
+    for _ in range(3):
+        clock._now += 1.0
+        history.append(1000.0)
+    firing = sweep(list(history))
+    assert "slo_convergence_p99" in firing
+    assert firing["slo_convergence_p99"]["fast_burn"] >= 2.0
+    assert firing["slo_convergence_p99"]["slow_burn"] >= 2.0
+    # recovery: clean intervals age the badness out of the fast window
+    for _ in range(6):
+        clock._now += 1.0
+        history.append(1.0)
+        out = sweep(list(history))
+    assert out == {}
+
+
+def test_burn_rate_counter_kind_thresholds_deltas():
+    clock = SimClock()
+    spec = SloSpec(
+        name="slo_convergence_p99", metric="c", kind=KIND_COUNTER,
+        threshold=0.0, objective=0.5, fast_window_s=5.0,
+        slow_window_s=5.0, burn_threshold=1.0,
+    )
+    ev = BurnRateEvaluator(clock, [spec])
+    assert ev.evaluate({}, {"c": 0.0}) == {}  # baseline
+    clock._now += 1.0
+    assert ev.evaluate({}, {"c": 0.0}) == {}  # no delta
+    clock._now += 1.0
+    firing = ev.evaluate({}, {"c": 2.0})  # delta 2 > 0
+    assert "slo_convergence_p99" in firing
+
+
+def test_empty_window_burns_zero():
+    clock = SimClock()
+    spec = SloSpec(
+        name="slo_convergence_p99", metric="m", threshold=10.0,
+        objective=0.01, fast_window_s=1.0, slow_window_s=2.0,
+    )
+    ev = BurnRateEvaluator(clock, [spec])
+    ev.evaluate({}, {})  # metric never observed anywhere
+    clock._now += 1.0
+    assert ev.evaluate({}, {}) == {}
+    st = ev.status()[0]
+    assert st["fast_burn"] == 0.0 and st["firing"] is False
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError, match="registered alert"):
+        SloSpec(name="not_an_alert", metric="m")
+    with pytest.raises(ValueError, match="kind"):
+        SloSpec(name="slo_convergence_p99", metric="m", kind="bogus")
+    with pytest.raises(ValueError, match="objective"):
+        SloSpec(name="slo_convergence_p99", metric="m", objective=0.0)
+    with pytest.raises(ValueError, match="fast_window"):
+        SloSpec(
+            name="slo_convergence_p99", metric="m",
+            fast_window_s=10.0, slow_window_s=5.0,
+        )
+    for spec in default_slos():
+        assert spec.name in ALERTS  # catalog stays registry-pinned
+
+
+# ---------------------------------------------------------------------------
+# generation skew / staleness
+# ---------------------------------------------------------------------------
+
+
+def test_generation_hash_is_stable_and_content_sensitive():
+    g = [3, [["0", 7]]]
+    assert generation_hash(g) == generation_hash([3, [["0", 7]]])
+    assert generation_hash(g) != generation_hash([4, [["0", 7]]])
+    assert len(generation_hash(g)) == 12
+
+
+def test_generation_skew_fires_for_the_lagging_node_only():
+    clock = SimClock()
+    gens = {"a": 0, "b": 0}
+
+    def source():
+        return [
+            snap("a", generation=[gens["a"]]),
+            snap("b", generation=[gens["b"]]),
+        ]
+
+    agg, sink = make_agg(
+        clock, source, skew_min_generations=3, skew_hold_s=5.0
+    )
+    agg.sweep()  # registers both
+    for i in range(4):
+        clock._now += 2.0
+        gens["a"] += 1  # a churns; b frozen
+        agg.sweep()
+    assert [a["name"] for a in sink.active_alerts()] == ["generation_skew"]
+    assert sink.active["generation_skew"]["stale_nodes"] == ["b"]
+    rows = {r["node"]: r for r in agg.status()["nodes"]}
+    assert rows["b"]["stale"] and not rows["a"]["stale"]
+    assert rows["b"]["missed_generations"] >= 3
+    # b advancing again resolves the alert
+    clock._now += 2.0
+    gens["b"] += 1
+    agg.sweep()
+    assert sink.active_alerts() == []
+    assert json.loads(agg.alert_log()[-1])["event"] == "resolved"
+
+
+def test_generation_skew_needs_both_miss_count_and_hold_time():
+    """Three fast misses inside the hold window must NOT page — the
+    hold filters sweep-cadence jitter exactly like the slow burn
+    window filters blips."""
+    clock = SimClock()
+    gens = {"a": 0, "b": 0}
+
+    def source():
+        return [
+            snap("a", generation=[gens["a"]]),
+            snap("b", generation=[gens["b"]]),
+        ]
+
+    agg, sink = make_agg(
+        clock, source, skew_min_generations=3, skew_hold_s=60.0
+    )
+    agg.sweep()
+    for _ in range(4):
+        clock._now += 1.0  # only 4s elapse, hold is 60s
+        gens["a"] += 1
+        agg.sweep()
+    assert sink.active_alerts() == []
+
+
+def test_quiet_fleet_never_reads_as_stale():
+    clock = SimClock()
+    source = lambda: [snap("a"), snap("b")]  # noqa: E731
+    agg, sink = make_agg(
+        clock, source, skew_min_generations=1, skew_hold_s=0.0
+    )
+    for _ in range(5):
+        agg.sweep()
+        clock._now += 10.0
+    assert sink.active_alerts() == []  # nobody advanced, nobody lags
+
+
+def test_restarted_node_counts_as_advanced_not_stale():
+    clock = SimClock()
+    gen = {"b": [1, "incarnation1"]}
+
+    def source():
+        return [snap("a", generation=[9]), snap("b", generation=gen["b"])]
+
+    agg, sink = make_agg(
+        clock, source, skew_min_generations=2, skew_hold_s=1.0
+    )
+    agg.sweep()
+    clock._now += 5.0
+    gen["b"] = [0, "incarnation2"]  # restart: counters reset, hash changes
+    agg.sweep()
+    rows = {r["node"]: r for r in agg.status()["nodes"]}
+    assert rows["b"]["missed_generations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# derived fleet signals from synthetic snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_chip_and_backend_quarantine_rollup():
+    clock = SimClock()
+
+    def source():
+        return [
+            snap(
+                "a",
+                counters={
+                    "decision.backend.pool.size": 8.0,
+                    "decision.backend.pool.healthy": 7.0,
+                },
+            ),
+            snap(
+                "b",
+                counters={
+                    "decision.backend.pool.size": 8.0,
+                    "decision.backend.pool.healthy": 8.0,
+                    "resilience.backend.quarantined": 1.0,
+                },
+            ),
+        ]
+
+    agg, sink = make_agg(clock, source)
+    status = agg.sweep()
+    names = sorted(a["name"] for a in sink.active_alerts())
+    assert names == ["backend_quarantine", "chip_quarantine"]
+    assert sink.active["chip_quarantine"]["nodes"] == ["a"]
+    assert sink.active["backend_quarantine"]["nodes"] == ["b"]
+    assert status["chips"] == {
+        "total": 16,
+        "healthy": 15,
+        "quarantined": 1,
+        "per_node": {
+            "a": {"size": 8, "healthy": 7},
+            "b": {"size": 8, "healthy": 8},
+        },
+    }
+
+
+def test_breaker_rollup_excludes_backend_and_chip_breakers():
+    clock = SimClock()
+
+    def source():
+        return [
+            snap(
+                "a",
+                counters={
+                    "resilience.fib_agent.state": 1.0,
+                    "resilience.kv_peer.node9.state": 2.0,
+                    # covered by the dedicated quarantine alerts:
+                    "resilience.backend.state": 1.0,
+                    "resilience.backend.dev3.state": 1.0,
+                    # closed breakers never roll up
+                    "resilience.other.state": 0.0,
+                },
+            )
+        ]
+
+    agg, sink = make_agg(clock, source)
+    status = agg.sweep()
+    assert [a["name"] for a in sink.active_alerts()] == ["breaker_open"]
+    edges = sorted(b["edge"] for b in status["breakers"])
+    assert edges == ["fib_agent", "kv_peer.node9"]
+    assert {b["state"] for b in status["breakers"]} == {
+        "open",
+        "half_open",
+    }
+
+
+def test_queue_saturation_threshold():
+    clock = SimClock()
+    depth = {"v": 10.0}
+
+    def source():
+        return [
+            snap(
+                "a",
+                counters={"messaging.queue.routeUpdates.depth": depth["v"]},
+            )
+        ]
+
+    agg, sink = make_agg(clock, source, queue_depth_threshold=100.0)
+    agg.sweep()
+    assert sink.active_alerts() == []
+    depth["v"] = 250.0
+    agg.sweep()
+    assert [a["name"] for a in sink.active_alerts()] == ["queue_saturation"]
+    assert sink.active["queue_saturation"]["queues"] == [
+        "a:routeUpdates"
+    ]
+    depth["v"] = 3.0
+    agg.sweep()
+    assert sink.active_alerts() == []
+
+
+def test_utilization_spread_needs_floor_and_spread():
+    from openr_tpu.tracing.pipeline import device_utilization_key
+
+    clock = SimClock()
+    utils = {"vals": [0.01, 0.02]}
+
+    def source():
+        return [
+            snap(
+                "a",
+                counters={
+                    device_utilization_key(i): v
+                    for i, v in enumerate(utils["vals"])
+                },
+            )
+        ]
+
+    agg, sink = make_agg(
+        clock, source,
+        utilization_spread_threshold=0.5,
+        utilization_spread_floor=0.2,
+    )
+    agg.sweep()
+    assert sink.active_alerts() == []  # idle jitter under the floor
+    utils["vals"] = [0.95, 0.1]  # one hot chip, one cold: imbalance
+    agg.sweep()
+    assert [a["name"] for a in sink.active_alerts()] == [
+        "utilization_spread"
+    ]
+    assert sink.active["utilization_spread"]["nodes"][0]["node"] == "a"
+
+
+def test_crash_latch_survives_node_counter_reset():
+    clock = SimClock()
+    crashes = {"v": 0.0}
+
+    def source():
+        return [snap("a", counters={"watchdog.crashes": crashes["v"]})]
+
+    agg, sink = make_agg(clock, source)
+    agg.sweep()
+    assert sink.active_alerts() == []
+    crashes["v"] = 1.0
+    agg.sweep()
+    assert [a["name"] for a in sink.active_alerts()] == ["node_crash"]
+    crashes["v"] = 0.0  # the node restarted; its counters reset
+    agg.sweep()
+    # the fleet still remembers the crash
+    assert [a["name"] for a in sink.active_alerts()] == ["node_crash"]
+    assert agg.status()["crashes_seen"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# alert sink: edges, counters, determinism, page-dump dedupe
+# ---------------------------------------------------------------------------
+
+
+def test_sink_edges_counters_and_log():
+    clock = SimClock(1.0)
+    sink = make_sink(clock)
+    sink.report({"breaker_open": {"count": 1}})
+    sink.report({"breaker_open": {"count": 1}})
+    sink.report({})
+    assert sink.num_fired == 1 and sink.num_resolved == 1
+    # counter bumps once per FIRING sweep (2), not per edge
+    assert sink.counters.get(alert_counter_key("breaker_open")) == 2.0
+    events = [json.loads(line) for line in sink.log]
+    assert [e["event"] for e in events] == ["fired", "resolved"]
+    assert events[0]["severity"] == "ticket"
+    assert events[0]["seq"] == 0 and events[1]["seq"] == 1
+
+
+def test_sink_rejects_unregistered_names():
+    sink = make_sink()
+    with pytest.raises(ValueError, match="unregistered"):
+        sink.report({"definitely_not_an_alert": {}})
+
+
+def test_sink_log_bytes_deterministic():
+    def one():
+        clock = SimClock(2.0)
+        sink = make_sink(clock)
+        sink.report({"node_crash": {"crashes_seen": 1.0}})
+        clock._now += 3.0
+        sink.report({})
+        return sink.log_bytes()
+
+    assert one() == one() and one()
+
+
+class _FakeRecorder:
+    def __init__(self):
+        self.reasons = []
+
+    def dump(self, reason, extra=None):
+        self.reasons.append((reason, extra))
+        return b"{}"
+
+
+def test_page_alerts_dump_once_per_sweep_and_rate_limit():
+    clock = SimClock()
+    rec = _FakeRecorder()
+    sink = make_sink(clock, recorder=rec, page_dump_min_s=30.0)
+    # two page alerts rising in ONE sweep -> one dump naming both
+    sink.report(
+        {
+            "chip_quarantine": {"quarantined": 1},
+            "node_crash": {"crashes_seen": 1.0},
+            "breaker_open": {"count": 1},  # ticket: never dumps
+        }
+    )
+    assert len(rec.reasons) == 1
+    reason, extra = rec.reasons[0]
+    assert reason == "health_page_alert"
+    assert extra["alerts"] == ["chip_quarantine", "node_crash"]
+    # resolve + re-fire inside the rate-limit window: suppressed
+    sink.report({})
+    clock._now += 5.0
+    sink.report({"chip_quarantine": {"quarantined": 1}})
+    assert len(rec.reasons) == 1 and sink.num_page_dumps_suppressed == 1
+    # past the window a fresh page dumps again
+    sink.report({})
+    clock._now += 31.0
+    sink.report({"node_crash": {"crashes_seen": 2.0}})
+    assert len(rec.reasons) == 2
+
+
+def test_ticket_alerts_never_dump():
+    rec = _FakeRecorder()
+    sink = make_sink(recorder=rec)
+    sink.report({"generation_skew": {"stale_nodes": ["b"]}})
+    assert rec.reasons == []
+
+
+def test_sink_gauges_and_aggregator_gauges():
+    clock = SimClock()
+    agg, sink = make_agg(clock, lambda: [snap("a")])
+    agg.sweep()
+    g = agg.gauges()
+    assert g["health.sweeps"] == 1.0
+    assert g["health.alerts.active"] == 0.0
+    assert alert_counter_key("node_crash") == "health.alert.node_crash"
+
+
+def test_alert_log_is_bounded():
+    clock = SimClock()
+    sink = make_sink(clock, max_log_entries=4)
+    for i in range(6):
+        clock._now += 1.0
+        sink.report({"breaker_open": {"count": i}})
+        sink.report({})
+    assert len(sink.log) == 4
+    assert sink.num_fired == 6
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_health_config_validation_and_slo_overrides():
+    from openr_tpu.config import HealthConfig, OpenrConfig, SloSpecConfig
+
+    with pytest.raises(ValueError, match="sweep_interval"):
+        OpenrConfig(health_config=HealthConfig(sweep_interval_s=0.0))
+    with pytest.raises(ValueError, match="name and metric"):
+        OpenrConfig(
+            health_config=HealthConfig(slos=[SloSpecConfig(name="x")])
+        )
+    cfg = OpenrConfig(
+        health_config=HealthConfig(
+            slos=[
+                SloSpecConfig(
+                    name="slo_convergence_p99",
+                    metric="convergence.event_to_fib_ms",
+                    threshold=500.0,
+                )
+            ]
+        )
+    )
+    # round-trips through JSON like every other config block
+    back = OpenrConfig.from_json(cfg.to_json())
+    assert back.health_config.slos[0].threshold == 500.0
+    assert back.health_config.enabled is True
+
+
+def test_export_health_jsonl(tmp_path, sim_loop):
+    """EmulatedNetwork.export_health_jsonl (the --health-export
+    surface): the lead node's alert-transition log lands as JSONL."""
+    loop, clock = sim_loop
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import line_edges
+
+    async def scenario():
+        net = EmulatedNetwork(clock)
+        net.build(line_edges(2))
+        net.start()
+        await clock.run_for(10.0)
+        path = str(tmp_path / "alerts.jsonl")
+        assert net.export_health_jsonl(path) == 0  # clean run: empty
+        assert open(path).read() == ""
+        # force one transition through the lead node's sink
+        net.nodes["node0"].health.sink.report(
+            {"breaker_open": {"count": 1}}
+        )
+        assert net.export_health_jsonl(path) == 1
+        doc = json.loads(open(path).read().strip())
+        assert doc["name"] == "breaker_open" and doc["event"] == "fired"
+        await net.stop()
+
+    loop.run_until_complete(scenario())
+
+
+def test_node_health_wiring(sim_loop):
+    """OpenrNode builds the aggregator from config; disabled config
+    builds none and the ctrl verbs raise."""
+    loop, clock = sim_loop
+    from openr_tpu.config import OpenrConfig
+    from openr_tpu.ctrl.handler import OpenrCtrlHandler
+    from openr_tpu.emulation.network import EmulatedNetwork
+
+    async def scenario():
+        net = EmulatedNetwork(clock)
+        net.add_node("solo")
+        net.config_overrides = lambda cfg: setattr(
+            cfg.health_config, "enabled", False
+        )
+        net.add_node("dark")
+        net.start()
+        await clock.run_for(8.0)
+        node = net.nodes["solo"]
+        assert node.health is not None
+        handler = OpenrCtrlHandler(node)
+        status = handler.get_health_status()
+        assert status["sweeps"] >= 1
+        # the emulation re-pointed the source at the FLEET
+        assert {r["node"] for r in status["nodes"]} == {"solo", "dark"}
+        alerts = handler.get_active_alerts()
+        assert alerts["active"] == [] and alerts["log"] == []
+        dark = OpenrCtrlHandler(net.nodes["dark"])
+        with pytest.raises(ValueError, match="disabled"):
+            dark.get_health_status()
+        await net.stop()
+
+    loop.run_until_complete(scenario())

@@ -1,0 +1,69 @@
+"""Tier-1 smoke: the checked-in BENCH_HEALTH artifact obeys the schema
+the bench emits (shared validator — bench.validate_health_bench) and
+holds the ISSUE-8 acceptance shape: aggregator sweep overhead on the
+serving p50 bounded <= 2%, and the fault-injection -> alert
+detection-latency distribution recorded per fault family over the
+seeded 9-node sweep (every injection detected, replay deterministic).
+
+The validator lives in bench.py so the emitter and this gate can never
+drift apart; regenerate the artifact with `python bench.py --health`.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+import bench
+
+pytestmark = [pytest.mark.health]
+
+ARTIFACT = pathlib.Path(__file__).resolve().parent.parent / (
+    "BENCH_HEALTH_r01.json"
+)
+
+
+def test_artifact_exists_and_matches_schema():
+    doc = json.loads(ARTIFACT.read_text())
+    bench.validate_health_bench(doc)
+
+
+def test_overhead_bound_is_the_acceptance_bound():
+    doc = json.loads(ARTIFACT.read_text())
+    assert doc["value"] <= bench.HEALTH_OVERHEAD_BOUND_PCT
+
+
+def test_detection_covers_every_fault_family():
+    doc = json.loads(ARTIFACT.read_text())
+    det = doc["detail"]["detection"]
+    assert set(det) == set(bench.HEALTH_FAULT_FAMILIES)
+    # each family detected on every seed, with its registered alert
+    from openr_tpu.health.alerts import ALERTS
+
+    for family, row in det.items():
+        assert row["detected"] == row["samples"]
+        assert row["alert"] in ALERTS
+        assert row["p50_ms"] >= 0.0
+
+
+def test_replay_determinism_recorded():
+    doc = json.loads(ARTIFACT.read_text())
+    assert doc["detail"]["deterministic_replay"] is True
+
+
+def test_environment_triple_is_recorded():
+    doc = json.loads(ARTIFACT.read_text())
+    env = doc["detail"]["env"]
+    assert env["platform"] and env["jax"]
+    assert env["device_count"] >= 1
+
+
+def test_validator_rejects_malformed_doc():
+    doc = json.loads(ARTIFACT.read_text())
+    doc["value"] = 55.0
+    with pytest.raises(AssertionError):
+        bench.validate_health_bench(doc)
+    doc = json.loads(ARTIFACT.read_text())
+    del doc["detail"]["detection"]["partition"]
+    with pytest.raises(AssertionError):
+        bench.validate_health_bench(doc)

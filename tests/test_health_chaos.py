@@ -1,0 +1,402 @@
+"""Chaos-verified alert fidelity (ISSUE 8 acceptance): every alert rule
+is provably wired to a real failure mode before anyone trusts it.
+
+For each seeded FaultPlan fault family the suite asserts the EXACT
+expected alert set fires within a bounded number of sweeps on a live
+9-node grid emulation; a clean seeded run fires ZERO alerts (the
+false-positive gate); and two replays of one seed produce byte-identical
+alert JSONL (the same contract the chaos counter dumps and flight
+recorder already honor).
+
+Fault family -> expected alert set:
+
+  partition                     {generation_skew}        (resolves on heal)
+  tpu_corrupt(device_index=3)   {chip_quarantine}        (resolves on probe)
+  fib_burst                     {breaker_open}           (resolves on heal)
+  actor_kill + supervisor       {node_crash}             (latched: crashes
+                                                          don't un-happen)
+  degraded convergence SLO      {slo_convergence_p99}    (+ page dump)
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.chaos import ChaosController, FaultPlan, Supervisor
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import ParallelConfig, ResilienceConfig, SloSpecConfig
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import grid_edges
+from openr_tpu.types import PrefixEntry
+
+pytestmark = [pytest.mark.health, pytest.mark.chaos]
+
+SEED = 7
+CONVERGE_S = 18.0
+SWEEP_S = 2.0
+#: alert must land within this many aggregator sweeps of fault onset
+DETECTION_SWEEP_BOUND = 8
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def health_overrides(cfg, tpu=False):
+    hc = cfg.health_config
+    hc.sweep_interval_s = SWEEP_S
+    hc.skew_min_generations = 2
+    hc.skew_hold_s = 4.0
+    cfg.watchdog_config.interval_s = 1.0
+    if tpu:
+        cfg.tpu_compute_config.min_device_prefixes = 0  # always device
+        cfg.parallel_config = ParallelConfig(min_shard_rows=0)
+        cfg.resilience_config = ResilienceConfig(
+            shadow_sample_every=2,
+            failure_threshold=2,
+            probe_backoff_initial_s=0.5,
+            probe_backoff_max_s=4.0,
+            jitter_pct=0.1,
+            seed=SEED,
+        )
+
+
+def fired_names(net, watcher="node0"):
+    h = net.nodes[watcher].health
+    return sorted({json.loads(line)["name"] for line in h.alert_log()})
+
+
+def active_names(net, watcher="node0"):
+    return sorted(
+        a["name"] for a in net.nodes[watcher].health.active_alerts()
+    )
+
+
+async def converge(net, clock):
+    await clock.run_for(CONVERGE_S)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+
+
+async def sweeps_until(net, clock, predicate, bound=DETECTION_SWEEP_BOUND):
+    """Advance one sweep interval at a time until `predicate(net)`;
+    returns the sweep count consumed.  Failing the bound fails the
+    detection-latency acceptance for the family under test."""
+    for i in range(bound):
+        if predicate(net):
+            return i
+        await clock.run_for(SWEEP_S)
+    assert predicate(net), (
+        f"expected alerts not present within {bound} sweeps; "
+        f"fired={fired_names(net)}"
+    )
+    return bound
+
+
+# ---------------------------------------------------------------------------
+# false-positive gate: a clean seeded run fires ZERO alerts
+# ---------------------------------------------------------------------------
+
+
+def test_clean_run_fires_zero_alerts():
+    async def scenario():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=health_overrides)
+        net.build(grid_edges(3))
+        net.start()
+        await converge(net, clock)
+        # ordinary life: prefix churn, an uneventful link flap, idle time
+        for i in range(3):
+            net.nodes["node0"].advertise_prefixes(
+                [PrefixEntry(f"10.90.{i}.0/24")]
+            )
+            await clock.run_for(4.0)
+        net.fail_link("node0", "node1")
+        await clock.run_for(4.0)
+        net.restore_link("node0", "node1")
+        await clock.run_for(20.0)
+        for name, node in net.nodes.items():
+            assert node.health.alert_log() == [], (
+                f"{name} logged alerts on a clean run"
+            )
+            assert node.health.active_alerts() == []
+        status = net.nodes["node0"].health.status()
+        assert status["sweeps"] >= 10
+        assert all(not s["firing"] for s in status["slos"])
+        await net.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# fault family: partition -> generation_skew, resolved on heal
+# ---------------------------------------------------------------------------
+
+
+def test_partition_fires_exactly_generation_skew():
+    async def scenario():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=health_overrides)
+        net.build(grid_edges(3))
+        net.start()
+        await converge(net, clock)
+        others = [f"node{i}" for i in range(8)]
+        plan = FaultPlan().partition(others, ["node8"], at=1.0, duration=16.0)
+        controller = ChaosController(net, plan, seed=SEED)
+        controller.start()
+        await clock.run_for(2.0)
+        # LSDB churn on the majority side that node8 cannot see
+        for i in range(DETECTION_SWEEP_BOUND):
+            if "generation_skew" in active_names(net):
+                break
+            net.nodes["node0"].advertise_prefixes(
+                [PrefixEntry(f"10.91.{i}.0/24")]
+            )
+            await clock.run_for(SWEEP_S)
+        assert active_names(net) == ["generation_skew"]
+        h = net.nodes["node0"].health
+        assert h.sink.active["generation_skew"]["stale_nodes"] == ["node8"]
+        # heal at t=+17; node8 full-syncs and advances again -> resolved
+        await clock.run_for(10.0)
+        for i in range(4):
+            net.nodes["node0"].advertise_prefixes(
+                [PrefixEntry(f"10.92.{i}.0/24")]
+            )
+            await clock.run_for(SWEEP_S)
+        assert active_names(net) == []
+        assert fired_names(net) == ["generation_skew"]
+        events = [json.loads(line)["event"] for line in h.alert_log()]
+        assert events == ["fired", "resolved"]
+        await controller.stop()
+        await net.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# fault family: per-chip silent corruption -> chip_quarantine, probed back
+# ---------------------------------------------------------------------------
+
+VICTIM = "node4"
+BAD_CHIP = 3
+
+
+async def _chip_corrupt_run():
+    clock = SimClock()
+    net = EmulatedNetwork(
+        clock,
+        use_tpu_backend=True,
+        config_overrides=lambda cfg: health_overrides(cfg, tpu=True),
+    )
+    net.build(grid_edges(3))
+    net.start()
+    await converge(net, clock)
+    # widen the candidate table so every chip's shard holds real rows
+    net.nodes["node0"].advertise_prefixes(
+        [PrefixEntry(f"10.99.{i}.0/24") for i in range(9)]
+    )
+    await clock.run_for(3.0)
+    plan = FaultPlan().tpu_corrupt(
+        VICTIM, at=2.0, duration=14.0, device_index=BAD_CHIP
+    )
+    controller = ChaosController(net, plan, seed=SEED)
+    controller.start()
+    await clock.run_for(3.0)  # corruption live on chip 3
+    gov = net.nodes[VICTIM].decision.backend.governor
+    detect_sweeps = 0
+    for a, b in [("node0", "node1"), ("node1", "node2")]:
+        net.fail_link(a, b)
+        await clock.run_for(SWEEP_S)
+        detect_sweeps += 1
+        if gov.num_shadow_mismatches:
+            break
+    assert gov.num_chip_quarantines >= 1
+    await sweeps_until(
+        net, clock, lambda n: "chip_quarantine" in active_names(n)
+    )
+    assert active_names(net) == ["chip_quarantine"]
+    h = net.nodes["node0"].health
+    assert h.sink.active["chip_quarantine"]["nodes"] == [VICTIM]
+    chips = h.status()["chips"]
+    assert chips["quarantined"] == 1
+    assert chips["per_node"][VICTIM]["healthy"] == chips["per_node"][VICTIM][
+        "size"
+    ] - 1
+    # page severity: the watcher froze a detection-time post-mortem
+    assert h.sink.num_page_dumps == 1
+    # heal at t=+16 requests a probe; churn drives the probe build and
+    # the chip earns its way back -> alert resolves
+    await clock.run_for(14.0)
+    for i in range(6):
+        if active_names(net) == []:
+            break
+        net.nodes["node0"].advertise_prefixes(
+            [PrefixEntry(f"10.93.{i}.0/24")]
+        )
+        await clock.run_for(SWEEP_S)
+    assert active_names(net) == []
+    assert fired_names(net) == ["chip_quarantine"]
+    log = h.sink.log_bytes()
+    await controller.stop()
+    await net.stop()
+    return log
+
+
+@pytest.mark.multichip
+def test_chip_corrupt_fires_exactly_chip_quarantine_and_replays():
+    """The per-chip SDC family AND the determinism acceptance: two
+    replays of one seed produce byte-identical alert JSONL."""
+    log_a = run(_chip_corrupt_run())
+    log_b = run(_chip_corrupt_run())
+    assert log_a == log_b, "same seed must produce byte-identical logs"
+    events = [json.loads(line) for line in log_a.decode().splitlines()]
+    assert [e["event"] for e in events] == ["fired", "resolved"]
+    assert events[0]["name"] == "chip_quarantine"
+    assert events[0]["severity"] == "page"
+
+
+# ---------------------------------------------------------------------------
+# fault family: fib-agent burst -> breaker_open, resolved after heal
+# ---------------------------------------------------------------------------
+
+
+def test_fib_burst_fires_exactly_breaker_open():
+    async def scenario():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=health_overrides)
+        net.build(grid_edges(3))
+        net.start()
+        await converge(net, clock)
+        plan = FaultPlan().fib_burst(VICTIM, at=1.0, duration=8.0)
+        controller = ChaosController(net, plan, seed=SEED)
+        controller.start()
+        await clock.run_for(1.5)  # burst live at t=+1
+        # route churn forces FIB programming attempts into the burst
+        detect = DETECTION_SWEEP_BOUND
+        for i in range(DETECTION_SWEEP_BOUND):
+            if "breaker_open" in active_names(net):
+                detect = i
+                break
+            net.nodes["node0"].advertise_prefixes(
+                [PrefixEntry(f"10.94.{i}.0/24")]
+            )
+            await clock.run_for(SWEEP_S)
+        assert detect <= DETECTION_SWEEP_BOUND
+        assert active_names(net) == ["breaker_open"]
+        h = net.nodes["node0"].health
+        edges = h.sink.active["breaker_open"]["edges"]
+        assert any(VICTIM in e and "fib_agent" in e for e in edges)
+        # heal at t=+9: retries probe the breaker closed -> resolved
+        await clock.run_for(12.0)
+        net.nodes["node0"].advertise_prefixes([PrefixEntry("10.94.1.0/24")])
+        for _ in range(6):
+            if active_names(net) == []:
+                break
+            await clock.run_for(SWEEP_S)
+        assert active_names(net) == []
+        assert fired_names(net) == ["breaker_open"]
+        await controller.stop()
+        await net.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# fault family: crash-kill under supervision -> node_crash (latched)
+# ---------------------------------------------------------------------------
+
+
+def test_actor_kill_fires_exactly_node_crash():
+    async def scenario():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=health_overrides)
+        net.build(grid_edges(3))
+        net.start()
+        supervisor = Supervisor(
+            clock, initial_backoff_s=0.25, max_backoff_s=5.0
+        )
+        supervisor.start()
+        for name, node in net.nodes.items():
+            supervisor.supervise(name, node, net.restart_node)
+        await converge(net, clock)
+        plan = FaultPlan().actor_kill(VICTIM, "decision", at=1.0)
+        controller = ChaosController(net, plan, seed=SEED)
+        controller.start()
+        detect = await sweeps_until(
+            net, clock, lambda n: "node_crash" in active_names(n)
+        )
+        assert detect <= DETECTION_SWEEP_BOUND
+        assert supervisor.num_restarts >= 1
+        assert active_names(net) == ["node_crash"]
+        h = net.nodes["node0"].health
+        detail = h.sink.active["node_crash"]
+        assert detail["crashes_seen"] + detail["restarts_seen"] >= 1
+        # crashes do not un-happen: still latched after full recovery
+        await clock.run_for(20.0)
+        assert active_names(net) == ["node_crash"]
+        assert fired_names(net) == ["node_crash"]
+        await supervisor.stop()
+        await controller.stop()
+        await net.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate family: degraded convergence objective pages + dumps
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_convergence_slo_burns_and_pages():
+    """With the convergence p99 objective tightened below real protocol
+    latency, sustained flap churn must burn both windows, page, and
+    freeze a detection-time flight dump — proving the burn-rate engine
+    is wired to the real SLI, not a synthetic."""
+
+    def overrides(cfg):
+        health_overrides(cfg)
+        cfg.health_config.slos = [
+            SloSpecConfig(
+                name="slo_convergence_p99",
+                metric="convergence.event_to_fib_ms",
+                threshold=50.0,  # impossibly tight: protocol time is ~1s
+                objective=0.05,
+                fast_window_s=4.0,
+                slow_window_s=8.0,
+                burn_threshold=2.0,
+            )
+        ]
+
+    async def scenario():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=overrides)
+        net.build(grid_edges(3))
+        net.start()
+        await converge(net, clock)
+        edges = [("node0", "node1"), ("node3", "node4"), ("node6", "node7")]
+        for i in range(DETECTION_SWEEP_BOUND):
+            if "slo_convergence_p99" in active_names(net):
+                break
+            a, b = edges[i % len(edges)]
+            net.fail_link(a, b)
+            await clock.run_for(SWEEP_S)
+            net.restore_link(a, b)
+            await clock.run_for(SWEEP_S)
+        assert "slo_convergence_p99" in active_names(net)
+        h = net.nodes["node0"].health
+        detail = h.sink.active["slo_convergence_p99"]
+        assert detail["fast_burn"] >= 2.0 and detail["slow_burn"] >= 2.0
+        assert detail["value"] > 50.0
+        # page severity -> detection-time post-mortem on the watcher
+        assert h.sink.num_page_dumps == 1
+        assert net.nodes["node0"].flight_recorder.last_reason == (
+            "health_page_alert"
+        )
+        await net.stop()
+
+    run(scenario())

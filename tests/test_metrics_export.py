@@ -131,11 +131,108 @@ def test_prometheus_multi_node_groups_families():
         "# TYPE openr_x gauge\nopenr_x{node=unquoted} 1\n",
         "# TYPE openr_x gauge\nopenr_x notafloat\n",
         "# TYPE openr_x\n",  # malformed header
+        "# HELP openr_x\n",  # malformed HELP (no text)
+        "# HELP openr_x a doc\nopenr_x 1\n",  # HELP alone opens no family
     ],
 )
 def test_prometheus_parser_rejects_malformed(bad):
     with pytest.raises(ValueError):
         parse_prometheus(bad)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8 satellites: per-device gauges promoted to ONE labeled family,
+# and # HELP emission from the metric-description registry — both must
+# survive the strict parser round trip
+# ---------------------------------------------------------------------------
+
+
+def test_device_gauges_render_as_one_labeled_family():
+    from openr_tpu.tracing.pipeline import (
+        device_busy_key,
+        device_utilization_key,
+    )
+
+    c = CounterMap()
+    for dev in range(3):
+        c.set(device_busy_key(dev), 100.0 * (dev + 1))
+        c.set(device_utilization_key(dev), 0.1 * (dev + 1))
+    c.set("decision.backend.pool.dev1.dispatches", 7.0)
+    c.set("resilience.backend.dev2.state", 1.0)
+    c.set("decision.route_build_runs", 3.0)  # un-promoted control
+    snap = MetricsSnapshot.capture(
+        counters=c, node_name="node0", clock=SimClock()
+    )
+    text = render_prometheus([snap])
+    # one TYPE header for the whole device family, not one per chip
+    assert text.count("# TYPE openr_pipeline_device_busy_ms gauge") == 1
+    assert "openr_pipeline_dev0_busy_ms" not in text
+    parsed = parse_prometheus(text)
+    busy = parsed["openr_pipeline_device_busy_ms"]["samples"]
+    assert len(busy) == 3
+    for dev in range(3):
+        key = (
+            "openr_pipeline_device_busy_ms",
+            ("node", "node0"),
+            ("device", str(dev)),
+        )
+        assert busy[key] == 100.0 * (dev + 1)
+    pool = parsed["openr_decision_backend_pool_device_dispatches"]["samples"]
+    assert pool[
+        (
+            "openr_decision_backend_pool_device_dispatches",
+            ("node", "node0"),
+            ("device", "1"),
+        )
+    ] == 7.0
+    res = parsed["openr_resilience_backend_device_state"]["samples"]
+    assert dict(list(res)[0][1:])["device"] == "2"
+    # non-device keys untouched
+    assert (
+        "openr_decision_route_build_runs",
+        ("node", "node0"),
+    ) in parsed["openr_decision_route_build_runs"]["samples"]
+
+
+def test_help_lines_emitted_and_preserved_by_parser():
+    c = CounterMap()
+    c.observe("convergence.event_to_fib_ms", 12.0)
+    c.set("watchdog.crashes", 0.0)
+    c.set("some.unknown.counter", 1.0)
+    snap = MetricsSnapshot.capture(
+        counters=c, node_name="n", clock=SimClock()
+    )
+    text = render_prometheus([snap])
+    assert "# HELP openr_watchdog_crashes " in text
+    # HELP precedes TYPE for the same family (exposition-format order)
+    lines = text.splitlines()
+    h = lines.index(
+        "# HELP openr_convergence_event_to_fib_ms "
+        "end-to-end convergence latency: origin event to FIB ack"
+    )
+    assert lines[h + 1].startswith(
+        "# TYPE openr_convergence_event_to_fib_ms histogram"
+    )
+    parsed = parse_prometheus(text)
+    assert parsed["openr_convergence_event_to_fib_ms"]["help"] == (
+        "end-to-end convergence latency: origin event to FIB ack"
+    )
+    # an unregistered family renders with no HELP and no invented text
+    assert "# HELP openr_some_unknown_counter" not in text
+    assert "help" not in parsed["openr_some_unknown_counter"]
+    # the alert-name registry feeds HELP for health.alert.* counters
+    from openr_tpu.health.alerts import alert_counter_key
+
+    c2 = CounterMap()
+    c2.bump(alert_counter_key("chip_quarantine"))
+    text2 = render_prometheus(
+        [
+            MetricsSnapshot.capture(
+                counters=c2, node_name="n", clock=SimClock()
+            )
+        ]
+    )
+    assert "# HELP openr_health_alert_chip_quarantine " in text2
 
 
 # ---------------------------------------------------------------------------
@@ -252,16 +349,24 @@ def test_nine_node_emulation_prometheus_round_trip():
     assert parsed["openr_pipeline_device_compute_ms"]["type"] == "histogram"
     assert parsed["openr_pipeline_decode_ms"]["type"] == "histogram"
     # per-device pipeline gauges (the probe's busy ledger, swept at
-    # capture) — every node dispatched on chip 0 at least
-    assert "openr_pipeline_dev0_busy_ms" in parsed
-    assert "openr_pipeline_dev0_utilization" in parsed
+    # capture): ONE labeled family per (head, tail), device="N" labels
+    # (ISSUE 8 satellite) — every node dispatched on chip 0 at least
+    busy = parsed["openr_pipeline_device_busy_ms"]["samples"]
+    util = parsed["openr_pipeline_device_utilization"]["samples"]
+    assert any(dict(labels).get("device") == "0" for (_n, *labels) in busy)
+    assert any(dict(labels).get("device") == "0" for (_n, *labels) in util)
+    # the dotted per-chip spelling no longer leaks as its own family
+    assert "openr_pipeline_dev0_busy_ms" not in parsed
     # existing serving + resilience counter surfaces ride along
     assert "openr_serving_queue_depth" in parsed
     assert "openr_resilience_backend_quarantined" in parsed
     # tracer drop accounting is exported (satellite: operator-visible)
     assert "openr_trace_dropped_spans" in parsed
     assert "openr_trace_spans_evicted" in parsed
+    # known families carry their registry HELP text through the parser
+    assert parsed["openr_convergence_event_to_fib_ms"]["help"]
+    # fleet health plane gauges ride the same surface
+    assert "openr_health_sweeps" in parsed
     # every node labeled every family it reported
-    g = parsed["openr_pipeline_dev0_busy_ms"]["samples"]
-    nodes = {labels[0][1] for (_name, *labels) in g.keys()}
+    nodes = {dict(labels).get("node") for (_name, *labels) in busy}
     assert len(nodes) == 9

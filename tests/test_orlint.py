@@ -136,6 +136,12 @@ FIXTURES = {
         (),
         2,
     ),
+    "alert-name-registry": (
+        "def fire(counters):\n"
+        '    counters.bump("health.alert.chip_quarantine")\n',
+        (),
+        2,
+    ),
 }
 
 
@@ -377,6 +383,39 @@ def test_resilience_latch_pool_mutators_trip():
     assert [f.rule for f in analyze_source(src)] == ["resilience-latch"]
     src2 = "def heal(pool):\n    pool.restore_device(3)\n"
     assert [f.rule for f in analyze_source(src2)] == ["resilience-latch"]
+
+
+def test_alert_registry_fstring_head_trips():
+    """A dynamically-built alert name is exactly the bug the rule
+    exists for — the f-string HEAD carries the prefix."""
+    src = (
+        "def fire(counters, name):\n"
+        '    counters.bump(f"health.alert.{name}")\n'
+    )
+    assert [f.rule for f in analyze_source(src)] == ["alert-name-registry"]
+
+
+def test_alert_registry_owner_module_is_exempt():
+    """The registry itself (health/alerts.py) spells the prefix — the
+    rule only polices everyone else."""
+    src = 'ALERT_COUNTER_PREFIX = "health.alert."\n'
+    mods = [ParsedModule.parse("openr_tpu/health/alerts.py", src)]
+    assert analyze_modules(mods).findings == []
+    # the same text anywhere else trips
+    mods2 = [ParsedModule.parse("openr_tpu/health/aggregator.py", src)]
+    assert [f.rule for f in analyze_modules(mods2).findings] == [
+        "alert-name-registry"
+    ]
+
+
+def test_alert_registry_reads_through_the_api_are_clean():
+    src = (
+        "from openr_tpu.health.alerts import alert_counter_key\n"
+        "\n"
+        "def fire(counters):\n"
+        '    counters.bump(alert_counter_key("chip_quarantine"))\n'
+    )
+    assert analyze_source(src) == []
 
 
 def test_resilience_latch_pool_reads_and_governor_api_are_clean():
