@@ -476,7 +476,7 @@ def validate_pipeline_bench(doc: dict) -> None:
     by a `pipeline.{phase}.ms` sample.  The ISSUE-7 acceptance bound is
     <= 10% — below that, the per-phase table is trustworthy enough to
     baseline the pipelining refactor against."""
-    from openr_tpu.tracing.pipeline import PAD_PACK, PHASES
+    from openr_tpu.tracing.pipeline import PAD_PACK, PHASES, WARM_PHASES
 
     assert doc["metric"] == "pipeline_attribution_gap_pct_grid4096_rebuild"
     assert doc["unit"] == "pct_of_rebuild_wall"
@@ -494,9 +494,12 @@ def validate_pipeline_bench(doc: dict) -> None:
         assert set(phases) <= set(PHASES)
         # a full rebuild exercises the whole lifecycle: every phase
         # must have recorded real time (delta_extract rides the diff).
-        # Exception: the 1-device legacy dispatch has no shard packing,
-        # so pad_pack legitimately records nothing there.
-        required = set(PHASES)
+        # Exceptions: the 1-device legacy dispatch has no shard packing,
+        # so pad_pack legitimately records nothing there; and the
+        # warm_plan/warm_repair phases only fire on warm-start
+        # generation-delta rebuilds (BENCH_WARMSTART), never on the
+        # cold rebuild lifecycle this artifact measures.
+        required = set(PHASES) - set(WARM_PHASES)
         if r["devices"] == 1:
             required.discard(PAD_PACK)
         for phase in sorted(required):
@@ -1727,6 +1730,384 @@ def health_main() -> None:
     print(json.dumps(doc))
 
 
+WARMSTART_GENERATIONS = 24
+WARMSTART_PARITY_EVERY = 8
+WARMSTART_SWEEP_WARM = 2048
+WARMSTART_SWEEP_COLD = 256
+#: BENCH_SUITE_p50_r05.json grid4096 p50 publication→FIB — the round-5
+#: cold-path baseline the warm rebuild must beat
+WARMSTART_COLD_P50_REFERENCE_MS = 127.172
+
+
+def validate_warmstart_bench(doc: dict) -> None:
+    """Schema contract for BENCH_WARMSTART_r*.json — shared by the bench
+    emitter and the tier-1 smoke test (tests/test_warmstart_bench_schema).
+
+    The headline value is the warm generation-delta rebuild p50
+    (publication→FIB equivalent: build + RouteDb diff) on grid4096,
+    which must beat BOTH the in-run cold rebuild p50 and the round-5
+    127ms reference.  The sweep block pins device warm-vs-cold
+    incrementality (warm must win) and records the native C++ warm
+    baseline; the device-beats-native gate applies whenever a real
+    accelerator is attached (on a cpu-platform run the 'device' kernel
+    IS host XLA, so that comparison measures compilers, not the
+    architecture — the artifact records it honestly instead of gating)."""
+    assert doc["metric"] == (
+        "warmstart_rebuild_p50_publication_to_fib_ms_grid4096"
+    )
+    assert doc["unit"] == "ms"
+    assert 0 < doc["value"] < WARMSTART_COLD_P50_REFERENCE_MS
+    d = doc["detail"]
+    rb = d["rebuild"]
+    assert rb["warm_p50_ms"] == doc["value"]
+    assert rb["warm_p50_ms"] < rb["cold_p50_ms"]
+    assert rb["warm_p95_ms"] >= rb["warm_p50_ms"]
+    assert rb["cold_p50_ms"] > 0
+    assert rb["generations"] >= 16
+    # every generation in the sweep is a pure perturbation: the warm
+    # path must take ALL of them (hit ratio 1.0), with the selective
+    # patch engaged and the counters recorded for the operator surface
+    assert rb["warm_hits"] == rb["generations"]
+    assert rb["warm_selective_builds"] == rb["generations"]
+    assert rb["cold_fallbacks"] == 0
+    assert rb["warm_purges"] == 0
+    assert rb["encode_patches"] >= 1
+    assert rb["parity_checks"] >= 2
+    assert rb["parity_ok"] is True
+    assert rb["reference_cold_p50_ms_r05"] == WARMSTART_COLD_P50_REFERENCE_MS
+    assert rb["speedup_vs_cold"] > 1.0
+    sw = d["sweep"]
+    assert sw["device_warm_solves_per_sec"] > 0
+    assert sw["device_cold_solves_per_sec"] > 0
+    assert sw["native_warm_solves_per_sec"] > 0
+    assert (
+        sw["device_warm_solves_per_sec"] > sw["device_cold_solves_per_sec"]
+    ), "warm-start must beat the cold kernel on the same sweep"
+    assert sw["warm_solves"] >= 1024 and sw["cold_solves"] >= 128
+    if d["env"]["platform"] != "cpu":
+        assert (
+            sw["device_warm_solves_per_sec"]
+            > sw["native_warm_solves_per_sec"]
+        ), "an attached accelerator must beat the native warm sweep"
+    for key in ("world", "env", "mode"):
+        assert key in d, key
+    for key in ("platform", "jax", "device_count"):
+        assert key in d["env"], f"env.{key}"
+    assert d["env"]["device_count"] >= 1
+
+
+def warmstart_main(seed: int = 7) -> None:
+    """Warm-start benchmark (BENCH_WARMSTART_r*): the ISSUE-9
+    generation-delta rebuild path on grid4096.
+
+    Part A — rebuild p50: one TpuBackend with the warm context enabled
+    and one with it disabled replay the SAME seeded link-metric
+    perturbation sweep (one random link flips its metric per
+    generation).  Each generation is measured publication→FIB
+    equivalent: ``build_route_db(force_full=True, warm_delta=True)``
+    plus the RouteDb diff Decision would publish (O(changed) for the
+    warm-selective path, full for cold).  Every WARMSTART_PARITY_EVERY
+    generations the warm RIB is asserted equal to the cold device build
+    AND the scalar oracle.
+
+    Part B — sweep solves/s: the single-link-failure repair sweep
+    (ops/repair.RepairSweep, depth-sorted chunks) vs the cold
+    batch-minor kernel on the same grid4096 world, plus the native C++
+    warm-start sweep (spf_warm_sweep) as the cross-engine baseline."""
+    from openr_tpu.ops.platform_env import (
+        enable_persistent_compile_cache,
+        fallback_to_cpu_if_unreachable,
+        honor_cpu_platform_request,
+    )
+
+    honor_cpu_platform_request()
+    fallback_to_cpu_if_unreachable()
+    enable_persistent_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from openr_tpu.common.runtime import CounterMap, WallClock
+    from openr_tpu.config import ParallelConfig, ResilienceConfig
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import SpfSolver
+    from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+    from openr_tpu.types import PrefixEntry
+
+    side = 64  # grid4096: the ROADMAP's canonical scale point
+    edges = grid_edges(side)
+    adj_dbs = build_adj_dbs(edges)
+    ls = LinkState("0", "node0")
+    for db in adj_dbs.values():
+        ls.update_adjacency_database(db)
+    n_nodes = side * side
+    ps = PrefixState()
+    for i in range(n_nodes):
+        ps.update_prefix(
+            f"node{i}",
+            "0",
+            PrefixEntry(f"10.{(i >> 8) & 0xFF}.{i & 0xFF}.0/24"),
+        )
+    als = {"0": ls}
+    rng = np.random.default_rng(seed)
+
+    def make_backend(warm: bool) -> TpuBackend:
+        return TpuBackend(
+            SpfSolver("node0"),
+            min_device_prefixes=0,
+            clock=WallClock(),
+            counters=CounterMap(),
+            resilience=ResilienceConfig(enabled=False),
+            parallel=ParallelConfig(max_devices=1, min_shard_rows=0),
+            warm_rebuild=warm,
+        )
+
+    def norm_db(db):
+        return {
+            p: (
+                sorted(
+                    (nh.neighbor_node_name, nh.metric) for nh in e.nexthops
+                ),
+                float(e.igp_cost),
+            )
+            for p, e in db.unicast_routes.items()
+        }
+
+    # ---- part A: the generation sweep --------------------------------
+    warm_be = make_backend(True)
+    cold_be = make_backend(False)
+    prev_warm = warm_be.build_route_db(als, ps, force_full=True)
+    prev_cold = cold_be.build_route_db(als, ps, force_full=True)
+    # one unmeasured perturbation warms every jit shape both sides use
+    node_names = sorted(adj_dbs)
+
+    def perturb(step: int) -> None:
+        victim = node_names[int(rng.integers(len(node_names)))]
+        db = adj_dbs[victim]
+        a = db.adjacencies[int(rng.integers(len(db.adjacencies)))]
+        a.metric = 1 + (a.metric % 3)  # cycles 1→2→3→1: always a change
+        ls.update_adjacency_database(db)
+
+    # unmeasured warm-up perturbations: compile the warm kernels' shape
+    # buckets (sub-edge + gathered-selection) before the timed window
+    for step in range(-4, 0):
+        perturb(step)
+        warm_be.build_route_db(
+            als, ps, changed_prefixes=set(), force_full=True,
+            warm_delta=True,
+        )
+        warm_be.take_last_changed_prefixes()
+        cold_be.build_route_db(
+            als, ps, changed_prefixes=set(), force_full=True
+        )
+    w0, s0 = warm_be.num_warm_builds, warm_be.num_warm_selective_builds
+    f0, p0 = warm_be.num_warm_cold_fallbacks, warm_be.num_warm_purges
+    e0 = warm_be.num_encode_patches
+    warm_lat, cold_lat = [], []
+    parity_checks = 0
+    parity_ok = True
+    depths, rounds = [], []
+    for gen in range(WARMSTART_GENERATIONS):
+        perturb(gen)
+        t0 = time.perf_counter()
+        db_w = warm_be.build_route_db(
+            als,
+            ps,
+            changed_prefixes=set(),
+            force_full=True,
+            warm_delta=True,
+        )
+        changed = warm_be.take_last_changed_prefixes()
+        if changed is not None:
+            update = prev_warm.calculate_update_for(db_w, changed)
+        else:
+            update = prev_warm.calculate_update(db_w)
+        warm_lat.append((time.perf_counter() - t0) * 1000.0)
+        prev_warm = db_w
+        depths.append(warm_be.warm_last_est_depth)
+        rounds.append(warm_be.warm_last_rounds)
+        t0 = time.perf_counter()
+        db_c = cold_be.build_route_db(
+            als, ps, changed_prefixes=set(), force_full=True
+        )
+        cold_update = prev_cold.calculate_update(db_c)
+        cold_lat.append((time.perf_counter() - t0) * 1000.0)
+        prev_cold = db_c
+        # the two engines must agree on WHAT changed, not just the state
+        assert set(update.unicast_routes_to_update) <= set(
+            db_w.unicast_routes
+        )
+        if gen % WARMSTART_PARITY_EVERY == 0:
+            parity_checks += 1
+            scalar = SpfSolver("node0").build_route_db(als, ps)
+            parity_ok = parity_ok and (
+                norm_db(db_w) == norm_db(db_c) == norm_db(scalar)
+            )
+        print(
+            f"# gen {gen}: warm {warm_lat[-1]:.1f}ms "
+            f"(depth {depths[-1]}, rounds {rounds[-1]}, "
+            f"changed {len(changed) if changed is not None else 'all'}) "
+            f"cold {cold_lat[-1]:.1f}ms",
+            file=sys.stderr,
+        )
+
+    def pct(lat, q):
+        srt = sorted(lat)
+        return srt[min(len(srt) - 1, int(len(srt) * q))]
+
+    warm_p50, cold_p50 = pct(warm_lat, 0.5), pct(cold_lat, 0.5)
+
+    # ---- part B: the repair-sweep comparison -------------------------
+    from openr_tpu.ops.csr import encode_link_state
+    from openr_tpu.ops.repair import sort_by_depth
+    from openr_tpu.ops.spf import sweep_spf_link_failures
+    from openr_tpu.ops.whatif import LinkFailureSweep
+
+    topo = encode_link_state(ls)
+    eng = LinkFailureSweep(topo, "node0")
+    eng.base_solve()
+    plan = eng.plan()
+    rs = eng.repair_sweep()
+    g = rs.batch_granularity
+    fails = rng.integers(
+        0, len(topo.links), size=WARMSTART_SWEEP_WARM
+    ).astype(np.int32)
+    sfails, _ = sort_by_depth(plan, fails)
+    chunk = 1024
+
+    def warm_sweep_once():
+        outs = []
+        for off in range(0, len(sfails), chunk):
+            c = sfails[off : off + chunk]
+            if len(c) % g:
+                c = np.concatenate(
+                    [c, np.full(g - len(c) % g, -1, np.int32)]
+                )
+            outs.append(rs.solve(c))
+        return outs
+
+    jax.block_until_ready(warm_sweep_once())  # compile warm-up
+    t0 = time.perf_counter()
+    jax.block_until_ready(warm_sweep_once())
+    device_warm_sps = WARMSTART_SWEEP_WARM / (time.perf_counter() - t0)
+
+    cold_args = (
+        jnp.asarray(topo.src),
+        jnp.asarray(topo.dst),
+        jnp.asarray(topo.w),
+        jnp.asarray(topo.edge_ok),
+        jnp.asarray(topo.link_index),
+    )
+    ovl = jnp.asarray(topo.overloaded)
+    root = jnp.int32(topo.node_id("node0"))
+    cold_fails = fails[:WARMSTART_SWEEP_COLD]
+
+    def cold_sweep_once():
+        return sweep_spf_link_failures(
+            *cold_args,
+            jnp.asarray(cold_fails),
+            ovl,
+            root,
+            max_degree=topo.max_out_degree(),
+            packed=False,
+        )
+
+    jax.block_until_ready(cold_sweep_once())
+    t0 = time.perf_counter()
+    jax.block_until_ready(cold_sweep_once())
+    device_cold_sps = WARMSTART_SWEEP_COLD / (time.perf_counter() - t0)
+
+    from openr_tpu.ops.native_spf import NativeSpf
+
+    native = NativeSpf(topo, "node0")
+    native.warm_prepare()
+    native.warm_sweep(fails[:32])
+    t0 = time.perf_counter()
+    native.warm_sweep(fails)
+    native_warm_sps = WARMSTART_SWEEP_WARM / (time.perf_counter() - t0)
+
+    env = env_stamp()
+    doc = {
+        "metric": "warmstart_rebuild_p50_publication_to_fib_ms_grid4096",
+        "value": round(warm_p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(cold_p50 / warm_p50, 2),
+        "detail": {
+            "rebuild": {
+                "warm_p50_ms": round(warm_p50, 3),
+                "warm_p95_ms": round(pct(warm_lat, 0.95), 3),
+                "warm_max_ms": round(max(warm_lat), 3),
+                "cold_p50_ms": round(cold_p50, 3),
+                "cold_p95_ms": round(pct(cold_lat, 0.95), 3),
+                "speedup_vs_cold": round(cold_p50 / warm_p50, 2),
+                "generations": WARMSTART_GENERATIONS,
+                "warm_hits": warm_be.num_warm_builds - w0,
+                "warm_selective_builds": (
+                    warm_be.num_warm_selective_builds - s0
+                ),
+                "cold_fallbacks": warm_be.num_warm_cold_fallbacks - f0,
+                "warm_purges": warm_be.num_warm_purges - p0,
+                "encode_patches": warm_be.num_encode_patches - e0,
+                "est_depth_max": max(depths),
+                "warm_rounds_max": max(r for pair in rounds for r in pair),
+                "parity_checks": parity_checks,
+                "parity_ok": parity_ok,
+                "reference_cold_p50_ms_r05": (
+                    WARMSTART_COLD_P50_REFERENCE_MS
+                ),
+                "reference_note": (
+                    "BENCH_SUITE_p50_r05.json grid4096 "
+                    "p50_publication_to_fib_ms (TPU v5e capture, "
+                    "2026-07-30); the in-run cold_p50_ms is the "
+                    "same-host apples-to-apples denominator"
+                ),
+            },
+            "sweep": {
+                "device_warm_solves_per_sec": round(device_warm_sps, 1),
+                "device_cold_solves_per_sec": round(device_cold_sps, 1),
+                "native_warm_solves_per_sec": round(native_warm_sps, 1),
+                "warm_vs_cold": round(
+                    device_warm_sps / device_cold_sps, 2
+                ),
+                "warm_vs_native": round(
+                    device_warm_sps / native_warm_sps, 3
+                ),
+                "warm_solves": WARMSTART_SWEEP_WARM,
+                "cold_solves": WARMSTART_SWEEP_COLD,
+                "native_reference_note": (
+                    "BENCH_r04 native warm-start was ~420k solves/s on "
+                    "the 1024-node WAN world; this sweep re-measures "
+                    "BOTH engines on grid4096 in THIS environment.  On "
+                    "platform=cpu the device kernel is host XLA sharing "
+                    "the native baseline's silicon, so beating native "
+                    "is only gated when a real accelerator is attached "
+                    "(see validate_warmstart_bench)."
+                ),
+            },
+            "world": {
+                "nodes": n_nodes,
+                "links": len(topo.links),
+                "prefixes": n_nodes,
+                "topology": f"grid{side}x{side}",
+                "seed": seed,
+            },
+            "mode": (
+                "emulate (in-process LSDB, WallClock backends; part A "
+                "measures build_route_db(force_full, warm_delta) + the "
+                "RouteDb diff Decision publishes, one random link-metric "
+                "perturbation per generation; part B sweeps single-link "
+                "failures through the repair kernel vs the cold kernel "
+                "vs native C++ warm-start)"
+            ),
+            "env": env,
+        },
+    }
+    validate_warmstart_bench(doc)
+    print(json.dumps(doc))
+
+
 def main() -> None:
     t_start = time.time()
     from openr_tpu.ops.platform_env import (
@@ -2137,17 +2518,87 @@ def main() -> None:
     )
 
 
+class _Tee:
+    """stdout tee for --out: bench modes print exactly one JSON artifact
+    line to stdout (progress goes to stderr), so mirroring stdout into
+    the artifact file gives every mode shared output-path handling."""
+
+    def __init__(self, *streams) -> None:
+        self._streams = streams
+
+    def write(self, data: str) -> int:
+        n = 0
+        for s in self._streams:
+            n = s.write(data)
+        return n
+
+    def flush(self) -> None:
+        for s in self._streams:
+            s.flush()
+
+
+#: one dispatch table for every bench mode — a new mode registers here
+#: (and nowhere else) and inherits the shared env_stamp/seed/--out
+#: handling.  Values: (runner, accepts_seed, help text).
+BENCH_MODES = {
+    "convergence": (convergence_main, False, "9-node flap convergence percentiles (virtual time)"),
+    "serving": (serving_main, False, "micro-batched serving plane vs unbatched scalar"),
+    "multichip-serving": (multichip_serving_main, False, "fleet serving over a 1/2/4/8-chip DevicePool"),
+    "pipeline": (pipeline_main, False, "phase-level attribution of the grid4096 rebuild"),
+    "resilience": (resilience_main, False, "shadow-verification overhead + seeded SDC scenario"),
+    "health": (health_main, False, "fleet health sweep overhead + detection latency"),
+    "warm-start": (warmstart_main, True, "generation-delta warm rebuild vs cold + native warm sweep"),
+}
+
+
+def _cli(argv) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bench.py",
+        description=(
+            "openr-tpu benchmark suite.  With no mode flag, runs the "
+            "headline 10k x 1024-node what-if sweep."
+        ),
+    )
+    group = parser.add_mutually_exclusive_group()
+    for name, (_fn, _seeded, help_text) in BENCH_MODES.items():
+        group.add_argument(
+            f"--{name}",
+            dest=name.replace("-", "_"),
+            action="store_true",
+            help=help_text,
+        )
+    parser.add_argument(
+        "--out",
+        metavar="PATH",
+        default=None,
+        help="also write the emitted JSON line(s) to PATH",
+    )
+    parser.add_argument(
+        "--seed",
+        type=int,
+        default=7,
+        help="world/perturbation seed for modes that take one",
+    )
+    args = parser.parse_args(argv)
+    runner = main
+    for name, (fn, seeded, _help) in BENCH_MODES.items():
+        if getattr(args, name.replace("-", "_")):
+            runner = (
+                (lambda fn=fn, s=args.seed: fn(seed=s)) if seeded else fn
+            )
+            break
+    if args.out:
+        with open(args.out, "w") as f:
+            real = sys.stdout
+            sys.stdout = _Tee(real, f)
+            try:
+                return runner() or 0
+            finally:
+                sys.stdout = real
+    return runner() or 0
+
+
 if __name__ == "__main__":
-    if "--convergence" in sys.argv[1:]:
-        sys.exit(convergence_main())
-    if "--serving" in sys.argv[1:]:
-        sys.exit(serving_main())
-    if "--multichip-serving" in sys.argv[1:]:
-        sys.exit(multichip_serving_main())
-    if "--pipeline" in sys.argv[1:]:
-        sys.exit(pipeline_main())
-    if "--resilience" in sys.argv[1:]:
-        sys.exit(resilience_main())
-    if "--health" in sys.argv[1:]:
-        sys.exit(health_main())
-    sys.exit(main())
+    sys.exit(_cli(sys.argv[1:]))

@@ -50,6 +50,10 @@ DEGREE_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
 #: gathered-changed-row buckets for the incremental selection batch
 ROWSEL_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144)
 
+#: sub-edge buckets for the bounded warm-repair kernel (the perturbed
+#: frontier's in-edge count, padded so the jit cache stays stable)
+SUB_EDGE_BUCKETS = (1024, 8192, 65536, 524288)
+
 
 def measure_dispatch_rt_ms() -> float:
     """Median device dispatch round trip (ms): one tiny op, blocked.
@@ -109,6 +113,7 @@ class DecisionBackend:
         changed_prefixes: Optional[Set[str]] = None,
         force_full: bool = False,
         cache_result: bool = True,
+        warm_delta: bool = False,
     ) -> Optional[DecisionRouteDb]:
         """``changed_prefixes`` is the EXACT prefix-churn delta since the
         previous call (None = unknown → full re-read of PrefixState).  The
@@ -119,7 +124,15 @@ class DecisionBackend:
         backend use the delta for internal table maintenance.
         ``cache_result=False`` signals the caller will mutate the returned
         db (RibPolicy) — the backend must not keep it as an incremental
-        base."""
+        base.  ``warm_delta`` is Decision's perturbation classification
+        of THIS tick's topology churn: True means every pending topology
+        change was a link weight/up-down or drain flip (no node or area
+        entered/left the LSDB) and nothing else forced the full build —
+        a warm-capable backend may then rebuild its device state
+        incrementally from the previous generation, PROVIDED the result
+        is identical to a cold full build.  The hint is advisory; the
+        backend re-verifies structural compatibility against its own
+        caches before trusting it."""
         raise NotImplementedError
 
     def counter_snapshot(self) -> Dict[str, float]:
@@ -137,6 +150,16 @@ class DecisionBackend:
         corrupt routes in the FIB."""
         return False
 
+    def take_last_changed_prefixes(self) -> Optional[Set[str]]:
+        """One-shot: the exact prefix set the LAST build could have
+        changed, when the backend produced that build by PATCHING its
+        previous RouteDb (warm-selective generation-delta rebuild) —
+        every other prefix is object-identical to the previous
+        generation's entry, so the caller may diff O(changed) instead of
+        O(total) even on a topology tick.  None = no such guarantee
+        (full rebuild, scalar path): diff everything."""
+        return None
+
 
 class ScalarBackend(DecisionBackend):
     def __init__(self, solver: SpfSolver) -> None:
@@ -150,6 +173,7 @@ class ScalarBackend(DecisionBackend):
         changed_prefixes=None,
         force_full=False,
         cache_result=True,
+        warm_delta=False,
     ):
         if (
             changed_prefixes is not None
@@ -209,6 +233,7 @@ class TpuBackend(DecisionBackend):
         resilience=None,
         parallel=None,
         probe=None,
+        warm_rebuild: bool = True,
     ) -> None:
         self.solver = solver  # scalar fallback + MPLS/static
         # AOT-equivalence with the reference's compiled binary: persist
@@ -282,6 +307,10 @@ class TpuBackend(DecisionBackend):
         #: per-device replicas of the device-resident SPF tables, keyed
         #: by device index and invalidated by table identity
         self._spf_replicas: dict = {}
+        #: pool health generation the replica cache was built under —
+        #: a quarantine/restore re-packs shard ownership, and replicas
+        #: pinned to unhealthy chips are dropped at the next dispatch
+        self._replica_health_seq = -1
         #: attribution of the LAST device build's freshly-computed rows:
         #: either a contiguous shard plan [(device, row_lo, row_hi)]
         #: (full builds) or an explicit row->device map (incremental
@@ -335,6 +364,47 @@ class TpuBackend(DecisionBackend):
         self._spf_tables = None
         self._spf_enc = None
         self._spf_degree = None
+        #: warm-start generation-delta rebuild (the ISSUE-9 tentpole):
+        #: the previous generation's SPF tables stay device-resident
+        #: (plus small host mirrors for delta planning), and a
+        #: warm-eligible topology tick re-relaxes only the perturbed
+        #: frontier instead of re-running the cold hop-diameter solve.
+        #: The context is PURGED — and the next device build forced
+        #: through shadow verification — on anything that makes it
+        #: suspect: corruption injection, a quarantine re-pack, the
+        #: full-replace swap, or a structural/shape change.
+        self._warm_enabled = bool(warm_rebuild)
+        self._warm_ctx = None  # dict(enc, dist, nh, degree, tables)
+        self._warm_changed_nodes = None  # [A, V] bool vs previous gen
+        self._warm_base_enc = None  # ctx enc the last warm solve diffed
+        self._warm_solved = False  # this build's tables came in warm
+        self._warm_rounds = None  # (rounds_d, rounds_l) device scalars
+        self._last_changed_prefixes: Optional[Set[str]] = None
+        self.num_warm_builds = 0
+        self.num_warm_subgraph_builds = 0
+        self.num_warm_selective_builds = 0
+        self.num_warm_cold_fallbacks = 0
+        self.num_warm_purges = 0
+        self.num_encode_patches = 0
+        self.warm_last_est_depth = 0
+        self.warm_last_reset_nodes = 0
+        self.warm_last_rounds = (0, 0)
+        self._warm_purge_reasons: Dict[str, int] = {}
+        self._warm_fallback_reasons: Dict[str, int] = {}
+        #: KSP2 prefixes seen by the most recent decodes: their routes
+        #: depend on the WHOLE topology (k-shortest re-solves), so the
+        #: warm-selective patch path declines while any are present
+        self._ksp2_present = False
+        if self.governor is not None:
+            # any quarantine transition (whole-backend or per-chip)
+            # re-packs shard ownership and makes device residency
+            # suspect — purge the warm context so the next generation
+            # rebuilds cold and scalar-verified
+            self.governor.add_quarantine_listener(
+                lambda info: self._purge_warm(
+                    f"quarantine:{info.get('reason', '')}"
+                )
+            )
         #: incremental candidate table (persistent across rebuilds);
         #: _table_synced guards against missed deltas when a build falls
         #: back to the scalar path (the table skips that tick's churn)
@@ -356,9 +426,11 @@ class TpuBackend(DecisionBackend):
         changed_prefixes=None,
         force_full=False,
         cache_result=True,
+        warm_delta=False,
     ):
         gov = self.governor
         probe = False
+        self._last_changed_prefixes = None
         if gov is not None:
             from openr_tpu.resilience.governor import (
                 ADMIT_PROBE,
@@ -409,7 +481,11 @@ class TpuBackend(DecisionBackend):
                     area_link_states, prefix_state, counter="small"
                 )
             db = self._build_device(
-                area_link_states, prefix_state, changed_prefixes, force_full
+                area_link_states,
+                prefix_state,
+                changed_prefixes,
+                force_full,
+                warm_delta=warm_delta,
             )
         except ValueError:
             # capacity/shape fallback (e.g. a prefix with more candidates
@@ -449,6 +525,11 @@ class TpuBackend(DecisionBackend):
                 self._last_db = None
                 self._table_synced = False
                 self._full_replace = True
+                # the swap proves the device (or a chip) lied: nothing
+                # device-resident is trustworthy as a warm base, and the
+                # patched-changed-set guarantee no longer holds either
+                self._last_changed_prefixes = None
+                self._purge_warm("full_replace")
                 return db
         if cache_result:
             self._last_db = db
@@ -459,6 +540,48 @@ class TpuBackend(DecisionBackend):
     def take_full_replace(self) -> bool:
         fr, self._full_replace = self._full_replace, False
         return fr
+
+    def take_last_changed_prefixes(self) -> Optional[Set[str]]:
+        out, self._last_changed_prefixes = self._last_changed_prefixes, None
+        return out
+
+    # -- warm-start generation-delta context -------------------------------
+
+    def _purge_warm(self, reason: str, suspect: bool = True) -> None:
+        """Drop the warm-rebuild context (previous generation's tables +
+        host mirrors) and force the next device build through shadow
+        verification.  Triggers: corruption injection (``tpu_corrupt``,
+        whole-backend or chip-scoped), any quarantine re-pack, the
+        full-replace swap, and structural/shape deltas.  ``suspect``
+        (the default) additionally drops the device-resident SPF table
+        cache and its per-chip replicas, so the next device build truly
+        solves COLD — we never reuse device state a corruption event
+        may have touched.  Size/housekeeping purges pass suspect=False
+        and keep the (trusted) tables.  Idempotent — only an actual
+        drop counts as a purge."""
+        key = reason.split(":", 1)[0]
+        self._warm_purge_reasons[key] = (
+            self._warm_purge_reasons.get(key, 0) + 1
+        )
+        if suspect:
+            self._spf_tables = None
+            self._spf_enc = None
+            self._spf_degree = None
+            self._spf_replicas = {}
+        if self._warm_ctx is None and self._warm_changed_nodes is None:
+            return
+        self._warm_ctx = None
+        self._warm_changed_nodes = None
+        self._warm_base_enc = None
+        self.num_warm_purges += 1
+        if self.governor is not None:
+            self.governor.request_shadow_check(reason)
+
+    def _warm_fallback(self, reason: str) -> None:
+        self.num_warm_cold_fallbacks += 1
+        self._warm_fallback_reasons[reason] = (
+            self._warm_fallback_reasons.get(reason, 0) + 1
+        )
 
     # -- the device pool (per-chip failure domains) ------------------------
 
@@ -558,6 +681,15 @@ class TpuBackend(DecisionBackend):
             self._sdc_devices.add(int(device_index))
         else:
             self._sdc_devices.discard(int(device_index))
+        if corrupt:
+            # a lying accelerator means nothing device-resident can seed
+            # a warm rebuild: the next generation solves cold, and the
+            # governor is asked to shadow-verify it
+            self._purge_warm(
+                "tpu_corrupt"
+                if device_index is None
+                else f"tpu_corrupt:dev{int(device_index)}"
+            )
 
     def _sdc_active_for(self, device_index: int) -> bool:
         return self._sdc_inject or device_index in self._sdc_devices
@@ -585,6 +717,38 @@ class TpuBackend(DecisionBackend):
             ),
             "decision.backend.sdc_injected": (
                 1.0 if (self._sdc_inject or self._sdc_devices) else 0.0
+            ),
+            # warm-start generation-delta rebuild telemetry (ISSUE 9):
+            # warm_hit_ratio = warm table solves / warm-classified
+            # topology ticks — the operator's first read on whether the
+            # fleet's churn profile is actually warm-eligible
+            "decision.backend.warm_enabled": 1.0 if self._warm_enabled else 0.0,
+            "decision.backend.warm_context_ready": (
+                1.0 if self._warm_ctx is not None else 0.0
+            ),
+            "decision.backend.warm_builds": float(self.num_warm_builds),
+            "decision.backend.warm_subgraph_builds": float(
+                self.num_warm_subgraph_builds
+            ),
+            "decision.backend.warm_selective_builds": float(
+                self.num_warm_selective_builds
+            ),
+            "decision.backend.warm_cold_fallbacks": float(
+                self.num_warm_cold_fallbacks
+            ),
+            "decision.backend.warm_purges": float(self.num_warm_purges),
+            "decision.backend.warm_encode_patches": float(
+                self.num_encode_patches
+            ),
+            "decision.backend.warm_hit_ratio": (
+                self.num_warm_builds
+                / max(1, self.num_warm_builds + self.num_warm_cold_fallbacks)
+            ),
+            "decision.backend.warm_last_est_depth": float(
+                self.warm_last_est_depth
+            ),
+            "decision.backend.warm_last_reset_nodes": float(
+                self.warm_last_reset_nodes
             ),
         }
         if self._pool is not None:
@@ -642,9 +806,23 @@ class TpuBackend(DecisionBackend):
         ):
             self.num_encode_hits += 1
             return cached[1]
-        enc = encode_multi_area(
-            area_link_states, me, node_buckets=self.node_buckets
-        )
+        enc = None
+        if self._warm_enabled and self._enc_cache:
+            # perturbation ticks (the overwhelming topology-churn class)
+            # refresh only the weight/validity/drain columns and share
+            # every layout array with the previous encoding — the full
+            # re-sort/re-intern/re-expand pass is most of the warm
+            # rebuild's host budget at 4096 nodes
+            from openr_tpu.ops.csr import patch_encoded_multi_area
+
+            (prev_ls, prev_enc) = next(iter(self._enc_cache.values()))
+            enc = patch_encoded_multi_area(prev_enc, area_link_states, me)
+            if enc is not None:
+                self.num_encode_patches += 1
+        if enc is None:
+            enc = encode_multi_area(
+                area_link_states, me, node_buckets=self.node_buckets
+            )
         self._enc_cache = {
             cache_key: (
                 [area_link_states[a] for a in sorted(area_link_states)],
@@ -665,8 +843,16 @@ class TpuBackend(DecisionBackend):
             self._ksp2_engines[key] = eng
         return eng
 
-    def _spf(self, enc, max_degree: int):
-        """Device (dist [A,V], nh [A,V,D]) tables, cached per encoding."""
+    def _spf(self, enc, max_degree: int, warm_delta: bool = False):
+        """Device (dist [A,V], nh [A,V,D]) tables, cached per encoding.
+
+        On a topology tick, a warm-eligible delta (``warm_delta`` hint +
+        structural compatibility against the retained previous
+        generation) re-relaxes only the perturbed frontier from the
+        previous generation's device-resident tables (the ISSUE-9
+        warm-start path); everything else solves cold.  Either way the
+        new generation's tables (plus small host mirrors for the NEXT
+        delta's planning) are retained as the warm context."""
         import jax.numpy as jnp
 
         from openr_tpu.ops.jit_guard import call_jit_guarded
@@ -680,19 +866,36 @@ class TpuBackend(DecisionBackend):
             return self._spf_tables
         from openr_tpu.tracing import pipeline
 
-        with self.probe.phase(pipeline.TRANSFER):
-            args = (
-                jnp.asarray(enc.src),
-                jnp.asarray(enc.dst),
-                jnp.asarray(enc.w),
-                jnp.asarray(enc.edge_ok),
-                jnp.asarray(enc.overloaded),
-                jnp.asarray(enc.roots),
-            )
-        with self.probe.phase(pipeline.DEVICE_COMPUTE):
-            dist, nh = call_jit_guarded(
-                multi_area_spf_tables, *args, max_degree=max_degree
-            )
+        self._warm_solved = False
+        self._warm_changed_nodes = None
+        self._warm_rounds = None
+        dist = nh = None
+        if self._warm_enabled and warm_delta and self._warm_ctx is not None:
+            dist, nh = self._warm_spf(enc, max_degree)
+        elif self._warm_enabled and warm_delta:
+            # warm-classified tick but the context was purged (corruption,
+            # quarantine re-pack, full replace): this build solves cold
+            # and re-establishes the context
+            self._warm_fallback("no_context")
+        elif self._warm_enabled and self._warm_ctx is not None:
+            # a topology tick the hint classified cold (structural,
+            # static/policy coincidence, first build): count it so the
+            # warm-hit ratio reflects reality
+            self._warm_fallback("unclassified")
+        if dist is None:
+            with self.probe.phase(pipeline.TRANSFER):
+                args = (
+                    jnp.asarray(enc.src),
+                    jnp.asarray(enc.dst),
+                    jnp.asarray(enc.w),
+                    jnp.asarray(enc.edge_ok),
+                    jnp.asarray(enc.overloaded),
+                    jnp.asarray(enc.roots),
+                )
+            with self.probe.phase(pipeline.DEVICE_COMPUTE):
+                dist, nh = call_jit_guarded(
+                    multi_area_spf_tables, *args, max_degree=max_degree
+                )
         # keep soft/overloaded device-resident alongside (selection inputs)
         with self.probe.phase(pipeline.TRANSFER):
             soft = jnp.asarray(enc.soft)
@@ -700,7 +903,225 @@ class TpuBackend(DecisionBackend):
         self._spf_tables = (dist, nh, ovl, soft)
         self._spf_enc = enc
         self._spf_degree = max_degree
+        if self._warm_enabled:
+            self._refresh_warm_ctx(enc, max_degree)
         return self._spf_tables
+
+    #: warm-context host mirrors beyond this size are not worth the
+    #: per-generation fetch (the warm win targets the debounce budget)
+    WARM_MAX_TABLE_BYTES = 64 << 20
+
+    def _warm_spf(self, enc, max_degree: int):
+        """Attempt the generation-delta warm solve.  Returns (dist, nh)
+        device tables, or (None, None) after counting a cold fallback."""
+        import jax
+        import jax.numpy as jnp
+
+        from openr_tpu.ops.jit_guard import call_jit_guarded
+        from openr_tpu.ops.repair import plan_generation_delta
+        from openr_tpu.ops.route_select import warm_multi_area_spf_tables
+        from openr_tpu.tracing import pipeline
+
+        ctx = self._warm_ctx
+        with self.probe.phase(pipeline.WARM_PLAN):
+            if ctx["degree"] != max_degree:
+                self._warm_fallback("degree_bucket")
+                return None, None
+            old_enc = ctx["enc"]
+            if old_enc.areas != enc.areas:
+                self._warm_fallback("structural")
+                return None, None
+            if ctx["dist"] is None:
+                # lazily materialize the previous generation's host
+                # mirrors — cold builds store device references only, so
+                # the common cold path pays no fetch; by the time a
+                # warm delta needs them the tables are long since ready
+                dist_h, nh_h = jax.device_get(ctx["tables"])
+                ctx["dist"] = np.asarray(dist_h)
+                ctx["nh"] = np.asarray(nh_h)
+            plans = []
+            for ai, (old_topo, new_topo) in enumerate(
+                zip(old_enc.topos, enc.topos)
+            ):
+                if new_topo.padded_edges != old_topo.padded_edges:
+                    plans = None
+                    self._warm_fallback("edge_bucket")
+                    break
+                delta = plan_generation_delta(
+                    old_topo,
+                    int(enc.roots[ai]),
+                    ctx["dist"][ai],
+                    new_topo,
+                )
+                if delta is None:
+                    plans = None
+                    self._warm_fallback("structural")
+                    break
+                plans.append(delta)
+            if plans is None:
+                return None, None
+            reset = np.stack([p.reset for p in plans])
+            lane_keep = np.asarray(
+                [p.lanes_compatible for p in plans], bool
+            )
+            self.warm_last_est_depth = max(p.est_depth for p in plans)
+            self.warm_last_reset_nodes = int(sum(p.num_reset for p in plans))
+            # bounded-subgraph eligibility: pure weakening (no edge got
+            # cheaper/added) with an unchanged root lane basis — then
+            # the per-round working set is the perturbed frontier's
+            # in-edges, independent of topology size
+            use_sub = all(
+                (not p.has_improvements) and p.lanes_compatible
+                for p in plans
+            )
+            sub_args = None
+            if use_sub:
+                sub_args = self._pack_sub_edges(enc, plans)
+        prev_dist, prev_nh = ctx["tables"]
+        if sub_args is not None:
+            from openr_tpu.ops.route_select import (
+                warm_multi_area_subgraph_tables,
+            )
+
+            with self.probe.phase(pipeline.TRANSFER):
+                args = tuple(jnp.asarray(a) for a in sub_args) + (
+                    prev_dist,
+                    prev_nh,
+                    jnp.asarray(reset),
+                )
+            with self.probe.phase(pipeline.WARM_REPAIR):
+                dist, nh, rounds_d, rounds_l = call_jit_guarded(
+                    warm_multi_area_subgraph_tables,
+                    *args,
+                    max_degree=max_degree,
+                )
+            self.num_warm_subgraph_builds += 1
+        else:
+            with self.probe.phase(pipeline.TRANSFER):
+                args = (
+                    jnp.asarray(enc.src),
+                    jnp.asarray(enc.dst),
+                    jnp.asarray(enc.w),
+                    jnp.asarray(enc.edge_ok),
+                    jnp.asarray(enc.overloaded),
+                    jnp.asarray(enc.roots),
+                    prev_dist,
+                    prev_nh,
+                    jnp.asarray(reset),
+                    jnp.asarray(lane_keep),
+                )
+            with self.probe.phase(pipeline.WARM_REPAIR):
+                dist, nh, rounds_d, rounds_l = call_jit_guarded(
+                    warm_multi_area_spf_tables, *args, max_degree=max_degree
+                )
+        self._warm_solved = True
+        self._warm_base_enc = old_enc
+        self._warm_rounds = (rounds_d, rounds_l)
+        self.num_warm_builds += 1
+        return dist, nh
+
+    def _pack_sub_edges(self, enc, plans):
+        """[A, Es]-bucketed sub-edge arrays (src, dst, w, ok, lane rank)
+        for the bounded warm-repair kernel.  Positions come dst-sorted
+        from the planner; pads keep dst non-decreasing and carry
+        ok=False so the kernel's segment reductions ignore them."""
+        es_max = max(
+            (len(p.sub_edges) for p in plans), default=0
+        )
+        buckets = [
+            b
+            for b in SUB_EDGE_BUCKETS
+            if b < enc.topos[0].padded_edges
+        ] + [enc.topos[0].padded_edges]
+        es_pad = next(b for b in buckets if b >= max(es_max, 1))
+        A = enc.num_areas
+        V = enc.topos[0].padded_nodes
+        src_sub = np.zeros((A, es_pad), np.int32)
+        dst_sub = np.full((A, es_pad), V - 1, np.int32)
+        w_sub = np.full((A, es_pad), np.float32(np.inf), np.float32)
+        ok_sub = np.zeros((A, es_pad), bool)
+        rank_sub = np.full((A, es_pad), -1, np.int32)
+        for ai, (topo, plan) in enumerate(zip(enc.topos, plans)):
+            pos = plan.sub_edges
+            n = len(pos)
+            if not n:
+                continue
+            root = int(enc.roots[ai])
+            transit = (~topo.overloaded) | (
+                np.arange(V) == root
+            )
+            okf = topo.edge_ok & transit[topo.src]
+            rank_full = np.full(topo.padded_edges, -1, np.int32)
+            root_out = np.nonzero(
+                (topo.src == root) & (topo.link_index >= 0)
+            )[0]
+            rank_full[root_out] = np.arange(len(root_out), dtype=np.int32)
+            src_sub[ai, :n] = topo.src[pos]
+            dst_sub[ai, :n] = topo.dst[pos]
+            w_sub[ai, :n] = topo.w[pos]
+            ok_sub[ai, :n] = okf[pos]
+            rank_sub[ai, :n] = rank_full[pos]
+            # keep dst non-decreasing through the pad tail
+            dst_sub[ai, n:] = max(int(topo.dst[pos[-1]]), 0)
+        return src_sub, dst_sub, w_sub, ok_sub, rank_sub
+
+    def _refresh_warm_ctx(self, enc, max_degree: int) -> None:
+        """Retain THIS generation's tables as the next delta's warm base.
+        Cold builds store device references ONLY (zero added fetch/sync
+        on the cold path; host mirrors materialize lazily at the next
+        warm delta's planning).  Warm builds fetch the new mirrors
+        immediately — the selective-selection path needs the
+        changed-node diff before it can pick its rows."""
+        import jax
+
+        from openr_tpu.tracing import pipeline
+
+        dist_d, nh_d = self._spf_tables[0], self._spf_tables[1]
+        table_bytes = int(
+            np.prod(dist_d.shape) * 4 + np.prod(nh_d.shape)
+        )
+        if table_bytes > self.WARM_MAX_TABLE_BYTES:
+            # housekeeping, not suspicion: the tables stay trusted and
+            # cached; only warm seeding is declined at this size
+            self._purge_warm("table_too_large", suspect=False)
+            return
+        dist_h = nh_h = None
+        prev = self._warm_ctx
+        if self._warm_solved:
+            with self.probe.phase(pipeline.WARM_PLAN):
+                dist_h, nh_h = jax.device_get((dist_d, nh_d))
+                dist_h = np.asarray(dist_h)
+                nh_h = np.asarray(nh_h)
+                if (
+                    prev is not None
+                    and prev["dist"] is not None
+                    and prev["dist"].shape == dist_h.shape
+                    and prev["nh"].shape == nh_h.shape
+                ):
+                    # per-node change mask vs the previous generation —
+                    # selection outputs can only move for prefixes whose
+                    # candidate rows read a changed (dist, lane, drain)
+                    # cell
+                    changed = (prev["dist"] != dist_h) | (
+                        prev["nh"] != nh_h
+                    ).any(axis=2)
+                    changed |= prev["enc"].overloaded != enc.overloaded
+                    changed |= prev["enc"].soft != enc.soft
+                    self._warm_changed_nodes = changed
+                if self._warm_rounds is not None:
+                    rd, rl = jax.device_get(self._warm_rounds)
+                    self.warm_last_rounds = (
+                        int(np.max(rd)),
+                        int(np.max(rl)),
+                    )
+                    self._warm_rounds = None
+        self._warm_ctx = {
+            "enc": enc,
+            "dist": dist_h,
+            "nh": nh_h,
+            "degree": max_degree,
+            "tables": (dist_d, nh_d),
+        }
 
     # -- multi-chip dispatch ----------------------------------------------
 
@@ -749,9 +1170,21 @@ class TpuBackend(DecisionBackend):
 
     def _replicated_tables(self, dev_index: int, tables: tuple) -> tuple:
         """Per-device replica of the device-resident SPF tables, cached
-        by table identity so steady-state rebuilds pay zero copies."""
+        by table identity so steady-state rebuilds pay zero copies.  A
+        pool health transition (quarantine/restore) re-packs shard
+        ownership via ``DevicePool.shard_ranges`` — replicas pinned to
+        now-unhealthy chips are dropped here so stale HBM residency
+        never outlives the re-pack."""
         import jax
 
+        pool = self.pool
+        if self._replica_health_seq != pool.health_seq:
+            self._spf_replicas = {
+                k: v
+                for k, v in self._spf_replicas.items()
+                if pool.is_healthy(k)
+            }
+            self._replica_health_seq = pool.health_seq
         cached = self._spf_replicas.get(dev_index)
         if cached is not None and cached[0] is tables:
             return cached[1]
@@ -849,8 +1282,132 @@ class TpuBackend(DecisionBackend):
 
     # -- device build ------------------------------------------------------
 
+    def _select_rows_gathered(
+        self,
+        rows,
+        tables,
+        dv,
+        per_area,
+        table,
+        enc,
+        area_link_states,
+        prefix_state,
+    ):
+        """Gather the given candidate-table rows into a padded [K, C]
+        batch, run the selection kernel as ONE committed dispatch (the
+        pool's lead healthy chip, or the armed probe chip), decode, and
+        return ``(results, inc_dev)``.  Shared by the prefix-churn
+        incremental path and the warm-selective generation-delta path —
+        both re-select only the rows that can have moved."""
+        import jax
+        import jax.numpy as jnp
+
+        from openr_tpu.ops import jit_guard
+        from openr_tpu.ops.csr import bucket_for
+        from openr_tpu.ops.jit_guard import call_jit_guarded
+        from openr_tpu.ops.route_select import multi_area_select_from_tables
+        from openr_tpu.tracing import pipeline
+
+        dist, nh, ovl, soft = tables
+        inc_dev = None
+        # selective gathers ride ONE chip: the pool's lead healthy
+        # device, or the armed probe chip (a quarantined chip earning
+        # its way back must exercise real work, and its output is
+        # shadow-verified before anything is served)
+        if self._use_pool():
+            devices, probe = self._dispatch_device_set()
+            inc_dev = probe if probe is not None else devices[0]
+            if self.governor is not None:
+                self.governor.confirm_plan([inc_dev])
+        K = bucket_for(len(rows), ROWSEL_BUCKETS)
+        # gather changed rows into a padded [K, C] batch; padding
+        # repeats row 0 with cand_ok forced off
+        with self.probe.phase(pipeline.PAD_PACK):
+            ridx = np.zeros(K, np.int64)
+            ridx[: len(rows)] = rows
+            g_ok = dv.cand_ok[ridx]
+            g_ok[len(rows):] = False
+            gathered = (
+                dv.cand_area[ridx],
+                dv.cand_node[ridx],
+                g_ok,
+                dv.drain_metric[ridx],
+                dv.path_pref[ridx],
+                dv.source_pref[ridx],
+                dv.distance[ridx],
+                dv.cand_node_in_area[ridx],
+            )
+        if inc_dev is not None:
+            dev = self.pool.device(inc_dev)
+            t_dist, t_nh, t_ovl, t_soft = self._replicated_tables(
+                inc_dev, (dist, nh, ovl, soft)
+            )
+            with self.probe.phase(pipeline.TRANSFER, device=inc_dev):
+                args = tuple(jax.device_put(a, dev) for a in gathered)
+        else:
+            t_dist, t_nh, t_ovl, t_soft = dist, nh, ovl, soft
+            with self.probe.phase(pipeline.TRANSFER):
+                args = tuple(jnp.asarray(a) for a in gathered)
+        gather_dev = inc_dev if inc_dev is not None else 0
+        with self.probe.phase(
+            pipeline.DEVICE_COMPUTE, device=gather_dev
+        ), jit_guard.dispatch_device(
+            inc_dev if inc_dev is not None else None
+        ):
+            use, shortest, lanes, valid = call_jit_guarded(
+                multi_area_select_from_tables,
+                t_dist,
+                t_nh,
+                t_ovl,
+                t_soft,
+                *args,
+                per_area_distance=per_area,
+            )
+        if inc_dev is not None:
+            self.pool.note_dispatch(inc_dev)
+        with self.probe.phase(pipeline.DEVICE_GET, devices=[gather_dev]):
+            use, shortest, lanes, valid = jax.device_get(
+                (use, shortest, lanes, valid)
+            )
+        if self._sdc_active_for(inc_dev if inc_dev is not None else 0):
+            shortest = self._corrupt_metrics(shortest)
+        with self.probe.phase(pipeline.DECODE):
+            results = self._decode_rows(
+                [(i, table.row_prefix[r]) for i, r in enumerate(rows)],
+                use,
+                shortest,
+                lanes,
+                valid,
+                dv,
+                np.asarray(ridx),
+                enc,
+                area_link_states,
+                prefix_state,
+            )
+        return results, inc_dev
+
+    def _warm_affected_rows(self, dv, table):
+        """Candidate-table rows whose selection inputs can have moved in
+        the last warm generation delta: any candidate whose (area, node)
+        cell — own-area id or cross-area resolution — changed distance,
+        lanes, or drain state.  Every other row provably reproduces its
+        previous selection output, so the patch path skips it."""
+        ch = self._warm_changed_nodes  # [A, V] bool
+        row_hit = (ch[dv.cand_area, dv.cand_node] & dv.cand_ok).any(axis=1)
+        cnia = dv.cand_node_in_area  # [P, C, A]
+        ok3 = (cnia >= 0) & dv.cand_ok[:, :, None]
+        a_idx = np.arange(ch.shape[0])[None, None, :]
+        hit3 = ok3 & ch[a_idx, np.maximum(cnia, 0)]
+        row_hit |= hit3.any(axis=(1, 2))
+        return np.nonzero(row_hit)[0]
+
     def _build_device(
-        self, area_link_states, prefix_state, changed_prefixes, force_full
+        self,
+        area_link_states,
+        prefix_state,
+        changed_prefixes,
+        force_full,
+        warm_delta=False,
     ):
         import jax
         import jax.numpy as jnp
@@ -903,7 +1460,10 @@ class TpuBackend(DecisionBackend):
             self.solver.route_selection_algorithm
             == RouteComputationRules.PER_AREA_SHORTEST_DISTANCE
         )
-        dist, nh, ovl, soft = self._spf(enc, D)
+        # patch-path eligibility must be judged against the PRE-build
+        # RouteDb base (warm-selective needs _last_db built on prev_enc)
+        patch_base = self._last_db
+        dist, nh, ovl, soft = self._spf(enc, D, warm_delta=warm_delta)
 
         if incremental:
             rows = table.rows_for(changed_prefixes)
@@ -921,93 +1481,20 @@ class TpuBackend(DecisionBackend):
             }
             inc_dev = None
             if rows:
-                # incremental gathers ride ONE chip: the pool's lead
-                # healthy device, or the armed probe chip (a quarantined
-                # chip earning its way back must exercise real work, and
-                # its output is shadow-verified before anything is
-                # served).  Deleted-only ticks dispatch nothing, so they
-                # must not arm a probe a build would never exercise.
-                if self._use_pool():
-                    devices, probe = self._dispatch_device_set()
-                    inc_dev = probe if probe is not None else devices[0]
-                    if self.governor is not None:
-                        self.governor.confirm_plan([inc_dev])
-                K = bucket_for(len(rows), ROWSEL_BUCKETS)
-                # gather changed rows into a padded [K, C] batch; padding
-                # repeats row 0 with cand_ok forced off
-                with self.probe.phase(pipeline.PAD_PACK):
-                    ridx = np.zeros(K, np.int64)
-                    ridx[: len(rows)] = rows
-                    g_ok = dv.cand_ok[ridx]
-                    g_ok[len(rows):] = False
-                    gathered = (
-                        dv.cand_area[ridx],
-                        dv.cand_node[ridx],
-                        g_ok,
-                        dv.drain_metric[ridx],
-                        dv.path_pref[ridx],
-                        dv.source_pref[ridx],
-                        dv.distance[ridx],
-                        dv.cand_node_in_area[ridx],
-                    )
-                if inc_dev is not None:
-                    dev = self.pool.device(inc_dev)
-                    t_dist, t_nh, t_ovl, t_soft = self._replicated_tables(
-                        inc_dev, (dist, nh, ovl, soft)
-                    )
-                    with self.probe.phase(
-                        pipeline.TRANSFER, device=inc_dev
-                    ):
-                        args = tuple(
-                            jax.device_put(a, dev) for a in gathered
-                        )
-                else:
-                    t_dist, t_nh, t_ovl, t_soft = dist, nh, ovl, soft
-                    with self.probe.phase(pipeline.TRANSFER):
-                        args = tuple(jnp.asarray(a) for a in gathered)
-                gather_dev = inc_dev if inc_dev is not None else 0
-                with self.probe.phase(
-                    pipeline.DEVICE_COMPUTE, device=gather_dev
-                ), jit_guard.dispatch_device(
-                    inc_dev if inc_dev is not None else None
-                ):
-                    use, shortest, lanes, valid = call_jit_guarded(
-                        multi_area_select_from_tables,
-                        t_dist,
-                        t_nh,
-                        t_ovl,
-                        t_soft,
-                        *args,
-                        per_area_distance=per_area,
-                    )
-                if inc_dev is not None:
-                    self.pool.note_dispatch(inc_dev)
-                with self.probe.phase(
-                    pipeline.DEVICE_GET, devices=[gather_dev]
-                ):
-                    use, shortest, lanes, valid = jax.device_get(
-                        (use, shortest, lanes, valid)
-                    )
-                if self._sdc_active_for(inc_dev if inc_dev is not None else 0):
-                    shortest = self._corrupt_metrics(shortest)
-                with self.probe.phase(pipeline.DECODE):
-                    results.update(
-                        self._decode_rows(
-                            [
-                                (i, table.row_prefix[r])
-                                for i, r in enumerate(rows)
-                            ],
-                            use,
-                            shortest,
-                            lanes,
-                            valid,
-                            dv,
-                            np.asarray(ridx),
-                            enc,
-                            area_link_states,
-                            prefix_state,
-                        )
-                    )
+                # deleted-only ticks dispatch nothing, so they must not
+                # arm a probe a build would never exercise — the helper
+                # (which arms at most one) only runs when rows exist
+                gathered_results, inc_dev = self._select_rows_gathered(
+                    rows,
+                    (dist, nh, ovl, soft),
+                    dv,
+                    per_area,
+                    table,
+                    enc,
+                    area_link_states,
+                    prefix_state,
+                )
+                results.update(gathered_results)
             self.num_incremental_builds += 1
             self.num_device_builds += 1
             if inc_dev is not None and rows:
@@ -1020,6 +1507,71 @@ class TpuBackend(DecisionBackend):
                 return _patch_route_db(
                     self._last_db, results, self.solver.get_static_routes()
                 )
+
+        # ---- warm-selective rebuild (generation-delta topology tick) -----
+        # the warm solve already re-relaxed only the perturbed frontier;
+        # the changed-node diff now bounds which candidate rows can have
+        # moved, and everything else patches through from the previous
+        # RouteDb — selection, decode and the publication diff all stay
+        # O(perturbation), not O(total prefixes)
+        if (
+            self._warm_solved
+            and self._warm_changed_nodes is not None
+            and patch_base is not None
+            and prev_enc is self._warm_base_enc
+            and self._table_synced
+            and not self._ksp2_present
+            and not self.solver.enable_node_segment_label
+        ):
+            with self.probe.phase(pipeline.WARM_PLAN):
+                affected = self._warm_affected_rows(dv, table)
+                churn_rows = (
+                    table.rows_for(changed_prefixes)
+                    if changed_prefixes
+                    else []
+                )
+                deleted = [
+                    p
+                    for p in (changed_prefixes or ())
+                    if p not in table.pid
+                ]
+                sel_rows = sorted(set(affected.tolist()) | set(churn_rows))
+            if len(sel_rows) <= ROWSEL_BUCKETS[-1]:
+                results = {p: None for p in deleted}
+                inc_dev = None
+                if sel_rows:
+                    gathered_results, inc_dev = self._select_rows_gathered(
+                        sel_rows,
+                        (dist, nh, ovl, soft),
+                        dv,
+                        per_area,
+                        table,
+                        enc,
+                        area_link_states,
+                        prefix_state,
+                    )
+                    results.update(gathered_results)
+                self.num_warm_selective_builds += 1
+                self.num_device_builds += 1
+                if inc_dev is not None and sel_rows:
+                    self._attr_rows = {int(r): inc_dev for r in sel_rows}
+                    self._attr_plan = None
+                    self._attr_table = table
+                else:
+                    self._attr_table = None
+                changed_out = {
+                    table.row_prefix[r]
+                    for r in sel_rows
+                    if table.row_prefix[r] is not None
+                }
+                changed_out.update(deleted)
+                self._last_changed_prefixes = changed_out
+                with self.probe.phase(pipeline.DELTA_EXTRACT):
+                    return _patch_route_db(
+                        patch_base,
+                        results,
+                        self.solver.get_static_routes(),
+                    )
 
         # ---- full build --------------------------------------------------
         n_active = (max(table.pid.values()) + 1) if table.pid else 0
@@ -1076,6 +1628,10 @@ class TpuBackend(DecisionBackend):
             self._attr_table = None
 
         with self.probe.phase(pipeline.DECODE):
+            # a full decode re-derives KSP2 presence from scratch (the
+            # warm-selective patch path declines while any KSP2 prefix
+            # is live, and _decode_rows re-raises the flag on discovery)
+            self._ksp2_present = False
             # only rows with at least one selection winner produce routes
             rows_with_winners = np.nonzero(use.any(axis=1))[0]
             row_items: List[Tuple[int, str]] = []
@@ -1273,6 +1829,7 @@ class TpuBackend(DecisionBackend):
                 local_prefix_considered=local_considered,
             )
         if ksp2_prefixes:
+            self._ksp2_present = True
             for a, dests in sorted(ksp2_dests.items()):
                 ai = enc.area_index(a)
                 self._ksp2_engine(

@@ -121,6 +121,13 @@ class Decision(Actor):
         # drive per-prefix incremental recompute (Decision.cpp:908-952)
         self._pending_prefix_changes: Set[str] = set()
         self._pending_topo_changed = False
+        #: a pending topology change is STRUCTURAL (a node or area
+        #: entered/left the LSDB) rather than a perturbation (link
+        #: weight/up-down, overload/drain flip).  Perturbation-only
+        #: ticks are warm-rebuild eligible: the backend may re-relax
+        #: only the perturbed frontier from the previous generation's
+        #: device tables instead of a cold full solve (ISSUE 9).
+        self._pending_topo_structural = False
         self._pending_force_full = False
         self._last_policy_active = False
         #: bumped on every LSDB change AND every RibPolicy set/clear —
@@ -340,10 +347,18 @@ class Decision(Actor):
                     # prefer it so full-sync-delivered keys still join
                     # the originating event's trace
                     self.pending_trace_ctx = adj_db.perf_events.trace_context
+            # structural classification BEFORE the update: a node's
+            # first adjacency advertisement (or a fresh area) changes
+            # the symbol table — warm rebuilds only survive pure
+            # perturbations of an unchanged node set
+            new_area = area not in self.area_link_states
             ls = self._get_link_state(area)
+            new_node = not ls.has_node(node)
             change = ls.update_adjacency_database(adj_db)
             if change.topology_changed or change.node_label_changed:
                 self._pending_topo_changed = True
+                if new_area or new_node:
+                    self._pending_topo_structural = True
                 return True
             return False
         parsed = parse_prefix_key(key)
@@ -374,6 +389,8 @@ class Decision(Actor):
             ls = self._get_link_state(area)
             if ls.delete_adjacency_database(node).topology_changed:
                 self._pending_topo_changed = True
+                # a node left the LSDB: the symbol table shrinks
+                self._pending_topo_structural = True
                 return True
             return False
         parsed = parse_prefix_key(key)
@@ -469,13 +486,29 @@ class Decision(Actor):
             or policy_active
             or self._last_policy_active
         )
+        # warm-rebuild hint (ISSUE 9): every pending topology change is a
+        # perturbation (no node/area structural churn) and nothing ELSE
+        # forced the full build — the backend may then rebuild its device
+        # state incrementally from the previous generation, provided its
+        # own caches corroborate (it re-verifies structural compatibility)
+        warm_delta = (
+            self._first_build_done
+            and self._pending_topo_changed
+            and not self._pending_topo_structural
+            and not self._pending_force_full
+            and not policy_active
+            and not self._last_policy_active
+        )
         changed = self._pending_prefix_changes
         self._pending_prefix_changes = set()
         self._pending_topo_changed = False
+        self._pending_topo_structural = False
         self._pending_force_full = False
         self._last_policy_active = policy_active
         if not force_full and changed:
             self.counters.bump("decision.incremental_route_builds")
+        if warm_delta:
+            self.counters.bump("decision.warm_delta_builds")
         # SPF dispatch span: the backend call (scalar solve or device
         # kernel pipeline); guarded jitted dispatches inside it record
         # `decision.spf_kernel` child spans via the jit_guard trace scope
@@ -500,6 +533,7 @@ class Decision(Actor):
                     ),
                     force_full=force_full,
                     cache_result=not policy_active,
+                    warm_delta=warm_delta,
                 )
         finally:
             self.tracer.end_span(spf_span)
@@ -528,7 +562,23 @@ class Decision(Actor):
         from openr_tpu.tracing import pipeline as _pipeline
 
         with probe.phase(_pipeline.DELTA_EXTRACT):
+            warm_changed = None
             if force_full:
+                # a warm-selective backend build PATCHED the previous
+                # RouteDb and reports exactly which prefixes could have
+                # moved — every other entry is object-identical, so the
+                # diff stays O(perturbation) even on a topology tick
+                take = getattr(
+                    self.backend, "take_last_changed_prefixes", None
+                )
+                if take is not None:
+                    warm_changed = take()
+            if force_full and warm_changed is not None:
+                self.counters.bump("decision.warm_selective_diffs")
+                update = self.route_db.calculate_update_for(
+                    new_db, warm_changed
+                )
+            elif force_full:
                 update = self.route_db.calculate_update(new_db)
             else:
                 # incremental contract: only the changed prefixes can

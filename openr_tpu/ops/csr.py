@@ -279,6 +279,77 @@ def encode_link_state(
     )
 
 
+def patch_encoded_topology(
+    old: "EncodedTopology", link_state: LinkState, me: Optional[str] = None
+) -> Optional["EncodedTopology"]:
+    """O(links) re-encode of a PERTURBED topology: when the node symbol
+    table and the undirected link identity set are unchanged (link
+    weight / up-down / overload / soft-drain churn — the warm-rebuild
+    classes), only the weight/validity/drain columns are refreshed and
+    every layout array (src/dst/link_index, the dst-sort order,
+    link_edge_pos, the symbol tables) is shared with the previous
+    encoding.  Returns None on any structural change (node or link
+    add/remove, identity drift) — the caller re-encodes cold.  The full
+    encoder re-sorts, re-interns and re-expands everything on each
+    topology tick; at 4096 nodes that is most of the warm rebuild's
+    host budget."""
+    names = set(link_state.get_adjacency_databases().keys())
+    if me is not None:
+        names.add(me)
+    if names != set(old.node_ids.keys()):
+        return None
+    links = link_state.all_links()
+    L = len(links)
+    if L != len(old.links):
+        return None
+    for li in range(L):
+        if links[li]._key != old.links[li]._key:
+            return None
+
+    col_m = np.empty(max(L, 1), np.float32)
+    col_ok = np.empty(max(L, 1), np.uint8)
+    for li, link in enumerate(links):
+        col_m[li] = link.get_max_metric()
+        col_ok[li] = link.is_up()
+    if np.any(col_ok[:L].astype(bool) & (col_m[:L] <= 0)):
+        raise ValueError(
+            "non-positive metric on an up link; device SPF requires "
+            "metrics >= 1"
+        )
+    w = np.full(old.padded_edges, INF, np.float32)
+    edge_ok = np.zeros(old.padded_edges, bool)
+    if L:
+        pos = old.link_edge_pos  # [L, 2] positions in the dst-sorted layout
+        m_dir = np.where(col_ok[:L].astype(bool), col_m[:L], INF)
+        ok_dir = col_ok[:L].astype(bool)
+        for side in (0, 1):
+            w[pos[:, side]] = m_dir
+            edge_ok[pos[:, side]] = ok_dir
+
+    overloaded = np.zeros(old.padded_nodes, bool)
+    soft = np.zeros(old.padded_nodes, np.int32)
+    for n, i in old.node_ids.items():
+        overloaded[i] = link_state.is_node_overloaded(n)
+        soft[i] = link_state.get_node_metric_increment(n)
+
+    return EncodedTopology(
+        src=old.src,
+        dst=old.dst,
+        w=w,
+        edge_ok=edge_ok,
+        overloaded=overloaded,
+        soft=soft,
+        node_ok=old.node_ok,
+        link_index=old.link_index,
+        node_ids=old.node_ids,
+        id_to_node=old.id_to_node,
+        links=links,
+        link_edge_pos=old.link_edge_pos,
+        num_nodes=old.num_nodes,
+        num_edges=old.num_edges,
+    )
+
+
 @dataclasses.dataclass
 class EncodedPrefixCandidates:
     """Per-prefix candidate advertisements → device arrays.
@@ -434,6 +505,37 @@ def encode_multi_area(
         overloaded=np.stack([t.overloaded for t in topos]),
         soft=np.stack([t.soft for t in topos]),
         roots=np.asarray([t.node_id(me) for t in topos], np.int32),
+    )
+
+
+def patch_encoded_multi_area(
+    prev: EncodedMultiArea, area_link_states, me: str
+) -> Optional[EncodedMultiArea]:
+    """Multi-area wrapper over :func:`patch_encoded_topology`: every
+    area must patch (same area set, per-area node/link identity
+    unchanged) or the whole attempt declines (None) and the caller runs
+    ``encode_multi_area`` cold.  The stacked [A, ...] device views are
+    restacked from the patched per-area arrays; layout arrays stay
+    shared with the previous encoding."""
+    areas = sorted(area_link_states.keys())
+    if areas != prev.areas:
+        return None
+    topos = []
+    for a, old_topo in zip(areas, prev.topos):
+        patched = patch_encoded_topology(old_topo, area_link_states[a], me)
+        if patched is None:
+            return None
+        topos.append(patched)
+    return EncodedMultiArea(
+        areas=areas,
+        topos=topos,
+        src=prev.src,
+        dst=prev.dst,
+        w=np.stack([t.w for t in topos]),
+        edge_ok=np.stack([t.edge_ok for t in topos]),
+        overloaded=np.stack([t.overloaded for t in topos]),
+        soft=np.stack([t.soft for t in topos]),
+        roots=prev.roots,
     )
 
 

@@ -49,8 +49,10 @@ whole chunk of shallow ones.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Tuple
+import hashlib
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -188,6 +190,89 @@ def build_repair_plan(topo, root_id: int, base_dist: np.ndarray,
         transit_src_ok=transit_src_ok,
         **pt,
     )
+
+
+def topology_content_hash(topo, root_id: Optional[int] = None) -> str:
+    """Stable content address of everything the repair planner (and the
+    warm-rebuild classifier) reads from an encoded topology: the node
+    symbol table, the directed edge lists with weights/validity/link ids,
+    and the node drain bits — plus the SPF root when given.  Two
+    topologies with equal hashes produce identical base solves and
+    identical repair plans, whatever their ``topology_seq`` says (the
+    seq bumps on ANY LSDB churn; the hash only moves when the encoded
+    graph does)."""
+    h = hashlib.sha256()
+    h.update("\x00".join(topo.id_to_node).encode())
+    for arr in (
+        topo.src,
+        topo.dst,
+        topo.w,
+        topo.edge_ok,
+        topo.link_index,
+        topo.overloaded,
+        topo.soft,
+    ):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    if root_id is not None:
+        h.update(int(root_id).to_bytes(8, "little", signed=True))
+    return h.hexdigest()
+
+
+#: content-addressed RepairPlan memo: repeated what-if sweeps over an
+#: unchanged LSDB (the common serving pattern — the change seq bumps on
+#: every prefix churn, but the GRAPH is usually identical) skip the
+#: planner re-pass entirely.  Tiny: a handful of (topology, root) worlds
+#: are live at once, and a stale entry is merely unused memory.
+_PLAN_CACHE_CAP = 8
+_plan_cache: "collections.OrderedDict[tuple, RepairPlan]" = (
+    collections.OrderedDict()
+)
+num_plan_cache_hits = 0
+num_plan_cache_misses = 0
+
+
+def build_repair_plan_cached(
+    topo,
+    root_id: int,
+    base_dist: np.ndarray,
+    base_nh: np.ndarray,
+    pull_tables=None,
+) -> RepairPlan:
+    """``build_repair_plan`` behind a content-hash memo.
+
+    The key covers the full planner input: topology content + root +
+    the base solution bytes (the base solve is itself a pure function of
+    (topology, root), so the base hash is belt-and-braces against a
+    caller handing a foreign base).  A hit returns the SAME RepairPlan
+    object — planner outputs are never mutated by consumers."""
+    global num_plan_cache_hits, num_plan_cache_misses
+    key = (
+        topology_content_hash(topo, root_id),
+        hashlib.sha256(
+            np.ascontiguousarray(base_dist, np.float32).tobytes()
+        ).hexdigest(),
+        hashlib.sha256(
+            np.ascontiguousarray(base_nh, np.int8).tobytes()
+        ).hexdigest(),
+    )
+    plan = _plan_cache.get(key)
+    if plan is not None:
+        _plan_cache.move_to_end(key)
+        num_plan_cache_hits += 1
+        return plan
+    num_plan_cache_misses += 1
+    plan = build_repair_plan(
+        topo, root_id, base_dist, base_nh, pull_tables=pull_tables
+    )
+    _plan_cache[key] = plan
+    while len(_plan_cache) > _PLAN_CACHE_CAP:
+        _plan_cache.popitem(last=False)
+    return plan
+
+
+def plan_cache_stats() -> Tuple[int, int]:
+    """(hits, misses) since process start — bench/test introspection."""
+    return num_plan_cache_hits, num_plan_cache_misses
 
 
 def build_pull_tables(topo, root_id: int):
@@ -645,6 +730,203 @@ def warm_base_from_previous(
     lanes_same = lane_sig(new_topo) == lane_sig(old_topo)
     nh0 = old_plan.base_nh if lanes_same else None
     return d0, nh0, lanes_same
+
+
+@dataclasses.dataclass
+class GenerationDelta:
+    """Host-planned warm-rebuild inputs for ONE area's topology delta
+    (old generation → new generation).  Produced by
+    :func:`plan_generation_delta`; consumed by the warm kernels
+    (ops/route_select.warm_multi_area_spf_tables)."""
+
+    #: [V] bool — vertices whose distance may have INCREASED (reset to
+    #: BIG in the warm seed).  Distance decreases need no reset: the old
+    #: value stays a valid over-estimate and relaxation lowers it.
+    reset: np.ndarray
+    #: root out-edge signature unchanged — previous lanes are a valid
+    #: warm init (reset semantics make ANY init safe; this only speeds
+    #: convergence)
+    lanes_compatible: bool
+    #: BFS depth of the affected region on the old DAG — the expected
+    #: warm convergence bound (counters/bench detail, not a limiter)
+    est_depth: int
+    #: number of reset vertices / perturbed directed edges (telemetry)
+    num_reset: int
+    num_perturbed_edges: int
+    #: the delta contains an ADDED or CHEAPENED edge (incl. overload
+    #: clears / links up): distances may DECREASE outside the reset set,
+    #: so the bounded subgraph kernel is ineligible (the full-edge warm
+    #: kernel still applies — improvements only relax downward from a
+    #: valid over-estimate)
+    has_improvements: bool
+    #: positions (into the NEW topology's dst-sorted edge arrays) of
+    #: every edge whose head is in the reset set — the bounded repair
+    #: kernel's entire per-round working set.  For a pure-weakening
+    #: delta this subgraph is provably sufficient: no vertex outside
+    #: the reset set changes distance OR lanes (see
+    #: plan_generation_delta's docstring).
+    sub_edges: np.ndarray
+
+
+def _min_weight_edge_keys(topo, ok: np.ndarray, V: int):
+    """(sorted int64 keys src*V+dst, min weight per key) over the
+    enabled directed edges — the vectorized (u, v) → min-w map both
+    sides of a generation diff are compared through."""
+    key = topo.src[ok].astype(np.int64) * V + topo.dst[ok].astype(np.int64)
+    w = topo.w[ok].astype(np.float32)
+    order = np.argsort(key, kind="stable")
+    key = key[order]
+    w = w[order]
+    uniq, starts = np.unique(key, return_index=True)
+    wmin = np.minimum.reduceat(w, starts) if len(key) else w
+    return uniq, wmin
+
+
+def plan_generation_delta(
+    old_topo,
+    root_id: int,
+    old_dist: np.ndarray,
+    new_topo,
+) -> Optional[GenerationDelta]:
+    """Classify one area's LSDB delta and plan the warm rebuild.
+
+    Returns None when the delta is STRUCTURAL — different node symbol
+    tables or padded node shape — and the caller must rebuild cold.
+    Everything else (link weight changes, link up/down, overload flips,
+    added/removed parallel adjacencies) is warm-eligible:
+
+      * removed-or-weakened directed edges that lie on the OLD
+        shortest-path DAG mark their heads' DAG descendants for reset
+        (a vertex outside every such descendant set keeps a surviving
+        old shortest path, so its old distance remains exact and its
+        old lanes remain the reset-semantics fixed point unless an
+        improvement reaches it — which relaxation handles without a
+        reset).  Overload flips ride the same classification: an
+        overloaded node's out-edges leave the transit-enabled edge map,
+        exactly like link removals.
+      * added/cheapened edges need NO reset (distances only decrease;
+        the over-estimate invariant survives).
+
+    For a PURE-WEAKENING delta (``has_improvements`` False) the plan
+    additionally carries the bounded repair subgraph (``sub_edges``):
+    every edge whose head is in the reset set.  That subgraph is exact,
+    not heuristic — outside the reset set NOTHING changes:
+
+      * distances: a vertex outside every perturbed on-DAG edge's
+        descendant set keeps a surviving old shortest path (upper
+        bound), and pure weakening can only raise distances (lower
+        bound), so its distance is pinned;
+      * lanes: an old-DAG edge into an outside vertex keeps both
+        endpoint distances and its weight (a perturbed on-DAG edge's
+        head would be IN the reset set), and no new DAG edge can appear
+        at an outside vertex (optimality gives dist[b] <= dist[a] + w
+        always; equality can only be NEWLY achieved if the left side
+        falls, which weakening forbids) — so its reset-semantics lane
+        input set, hence its lane fixed point, is unchanged.
+
+    This is the Bounded-Dijkstra-style per-source pruning from the
+    DeltaPath literature adapted to the dense device kernel: the
+    per-round relaxation working set shrinks from the full edge list to
+    the perturbed frontier's in-edges.
+
+    The descendant sweep is a frontier BFS over the old DAG — cost
+    O(depth x |DAG|) numpy, independent of the reset-set encoding (no
+    per-link bitset tables are built; this runs per generation in
+    Decision's hot path)."""
+    if new_topo.id_to_node != old_topo.id_to_node:
+        return None
+    V = old_topo.padded_nodes
+    if new_topo.padded_nodes != V:
+        return None
+    if old_dist.shape[0] != V:
+        return None
+
+    def transit_ok(topo):
+        transit = (~topo.overloaded) | (np.arange(V) == root_id)
+        return topo.edge_ok & transit[topo.src]
+
+    old_ok = transit_ok(old_topo)
+    new_ok = transit_ok(new_topo)
+    old_keys, old_w = _min_weight_edge_keys(old_topo, old_ok, V)
+    new_keys, new_w = _min_weight_edge_keys(new_topo, new_ok, V)
+    # removed-or-weakened: old (u, v) absent from the new map, or
+    # present only at a strictly larger weight
+    pos = np.searchsorted(new_keys, old_keys)
+    pos_c = np.clip(pos, 0, max(len(new_keys) - 1, 0))
+    present = (
+        (pos < len(new_keys)) & (new_keys[pos_c] == old_keys)
+        if len(new_keys)
+        else np.zeros(len(old_keys), bool)
+    )
+    survived = np.zeros(len(old_keys), bool)
+    if len(new_keys):
+        survived = present & (new_w[pos_c] <= old_w)
+    perturbed = ~survived
+    # improvements: an enabled (u, v) that is new, or cheaper than the
+    # old map's entry — distances may then DECREASE anywhere downstream
+    opos = np.searchsorted(old_keys, new_keys)
+    opos_c = np.clip(opos, 0, max(len(old_keys) - 1, 0))
+    in_old = (
+        (opos < len(old_keys)) & (old_keys[opos_c] == new_keys)
+        if len(old_keys)
+        else np.zeros(len(new_keys), bool)
+    )
+    has_improvements = bool(
+        (~in_old).any()
+        or (len(old_keys) and (new_w < old_w[opos_c])[in_old].any())
+    )
+
+    # old shortest-path DAG (same membership rule as build_repair_plan)
+    reached = old_dist < _BIGF
+    on_edge = (
+        old_ok
+        & reached[old_topo.dst]
+        & (old_dist[old_topo.src] + old_topo.w == old_dist[old_topo.dst])
+    )
+    dag_src = old_topo.src[on_edge]
+    dag_dst = old_topo.dst[on_edge]
+
+    # reset seeds: heads of perturbed directed edges that were ON the
+    # old DAG (an off-DAG removal provably changes nothing)
+    seed = np.zeros(V, bool)
+    if perturbed.any():
+        pk = old_keys[perturbed]
+        dag_keys = dag_src.astype(np.int64) * V + dag_dst.astype(np.int64)
+        on_dag_perturbed = np.isin(dag_keys, pk)
+        seed[dag_dst[on_dag_perturbed]] = True
+
+    reset = np.zeros(V, bool)
+    frontier = seed.copy()
+    depth = 0
+    while frontier.any():
+        reset |= frontier
+        depth += 1
+        nxt = np.zeros(V, bool)
+        hit = frontier[dag_src]
+        if hit.any():
+            nxt[dag_dst[hit]] = True
+        frontier = nxt & ~reset
+    reset[root_id] = False  # the root's distance is pinned at 0
+
+    def lane_sig(topo):
+        es = np.nonzero((topo.src == root_id) & (topo.link_index >= 0))[0]
+        return [
+            (int(topo.dst[e]), float(topo.w[e]), bool(topo.edge_ok[e]))
+            for e in es
+        ]
+
+    return GenerationDelta(
+        reset=reset,
+        lanes_compatible=lane_sig(new_topo) == lane_sig(old_topo),
+        est_depth=depth,
+        num_reset=int(reset.sum()),
+        num_perturbed_edges=int(perturbed.sum()),
+        has_improvements=has_improvements,
+        # positions are ascending into the dst-sorted layout, so the
+        # gathered sub-edge list keeps dst sorted (the kernels' segment
+        # reductions rely on it)
+        sub_edges=np.nonzero(reset[new_topo.dst])[0].astype(np.int32),
+    )
 
 
 def sort_by_depth(

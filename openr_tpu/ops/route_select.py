@@ -167,6 +167,73 @@ def multi_area_spf_tables(
     return jax.vmap(one_area_spf)(src, dst, w, edge_ok, overloaded, roots)
 
 
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def warm_multi_area_spf_tables(
+    src,  # [A, E] the NEW generation's edge lists
+    dst,  # [A, E]
+    w,  # [A, E]
+    edge_ok,  # [A, E]
+    overloaded,  # [A, V]
+    roots,  # [A]
+    prev_dist,  # [A, V] previous generation's device-resident distances
+    prev_nh,  # [A, V, D] previous generation's lane tables
+    reset,  # [A, V] bool per-area affected-vertex masks (host-planned)
+    lane_keep,  # [A] bool — per-area root out-edge signature unchanged
+    max_degree: int,
+):
+    """Generation-delta warm rebuild of the per-area SPF tables: the warm
+    Bellman-Ford + reset-semantics lane kernels (ops/spf.py) vmapped over
+    areas, seeded from the previous generation's tables with only the
+    host-classified affected vertices reset.  Exact — converges to the
+    same tables ``multi_area_spf_tables`` computes cold, in rounds
+    bounded by the perturbed region's DAG depth instead of the hop
+    diameter.  Returns (dist [A, V], nh [A, V, D], rounds_d [A],
+    rounds_l [A])."""
+    from openr_tpu.ops.spf import warm_spf_one
+
+    def one_area(s, d, ww, eo, ovl, root, pd, pn, rs, lk):
+        return warm_spf_one(
+            s, d, ww, eo, ovl, root, pd, pn, rs, lk, max_degree
+        )
+
+    return jax.vmap(one_area)(
+        src, dst, w, edge_ok, overloaded, roots,
+        prev_dist, prev_nh, reset, lane_keep,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def warm_multi_area_subgraph_tables(
+    src_sub,  # [A, Es] sub-edge endpoints (pad: ok_sub False)
+    dst_sub,  # [A, Es] ascending per area
+    w_sub,  # [A, Es]
+    ok_sub,  # [A, Es] edge_ok & transit[src], host-precomputed
+    rank_sub,  # [A, Es] root-out lane rank (-1 = none)
+    prev_dist,  # [A, V]
+    prev_nh,  # [A, V, D]
+    reset,  # [A, V] bool
+    max_degree: int,
+):
+    """Bounded-subgraph warm rebuild (pure-weakening deltas): the
+    per-round relaxation working set is each area's reset-region
+    in-edge list, not the full edge set — the per-source search-space
+    pruning that makes small perturbations of huge graphs cost
+    O(frontier), independent of topology size.  Exact under the
+    pure-weakening precondition (ops/repair.plan_generation_delta).
+    Returns (dist [A, V], nh [A, V, D], rounds_d [A], rounds_l [A])."""
+    from openr_tpu.ops.spf import warm_subgraph_repair_one
+
+    def one_area(ss, ds, ws, oks, rks, pd, pn, rs):
+        return warm_subgraph_repair_one(
+            ss, ds, ws, oks, rks, pd, pn, rs, max_degree
+        )
+
+    return jax.vmap(one_area)(
+        src_sub, dst_sub, w_sub, ok_sub, rank_sub,
+        prev_dist, prev_nh, reset,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("per_area_distance",))
 def multi_area_select_from_tables(
     dist,  # [A, V] SPF distances from me, per area

@@ -268,6 +268,261 @@ def hop_count_weights(w: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Warm-start (generation-delta) kernels
+# ---------------------------------------------------------------------------
+# The cold kernels above pay O(hop-diameter) relaxation rounds from an
+# all-BIG init on every topology generation.  Decision's generation-delta
+# rebuild path (decision/backend.py) instead seeds the solve from the
+# PREVIOUS generation's fixed point with only the provably-affected
+# vertices reset to BIG (ops/repair.py:plan_generation_delta derives the
+# reset set on the host):
+#
+#   * distances: Bellman-Ford converges to the exact fixed point from ANY
+#     pointwise over-estimate with d[root] = 0 (ops/repair.py module
+#     docstring).  Keeping an unaffected vertex's old distance is such an
+#     over-estimate (its old shortest path survives un-weakened); resetting
+#     affected vertices restores the invariant for the rest.  Convergence
+#     then takes rounds proportional to the perturbed region's DAG depth,
+#     not the graph's hop diameter.
+#   * lanes: recomputed with RESET semantics — each round REPLACES nh[v]
+#     with seed(v) | OR over current DAG in-edges, never OR-accumulating
+#     onto the previous round.  On the (new) shortest-path DAG this update
+#     has a unique fixed point reached from ANY init (induction in
+#     topological order from the pinned root), so warm-starting from the
+#     previous generation's lanes is safe: stale bits are overwritten, and
+#     unaffected subtrees stop changing immediately.
+#
+# Both loops run WARM_UNROLL relaxation rounds per while_loop iteration:
+# on small per-generation problem sizes the per-iteration dispatch
+# overhead of a device while_loop dominates the actual relax math, and
+# extra rounds past the fixed point are exact no-ops, so unrolling only
+# amortizes overhead without changing the result.  The stop checks stay
+# valid under unrolling: the distance loop is monotone (no change over a
+# block implies the fixed point), and for the reset-semantics lane loop a
+# block-periodic state must equal the unique fixed point (after depth-k
+# rounds every depth<=k vertex is final; a period would otherwise persist
+# past full convergence).
+
+#: relaxation rounds per while_loop iteration in the warm kernels
+WARM_UNROLL = 16
+
+
+def warm_spf_distances(
+    src,
+    dst,
+    w,
+    edge_ok,
+    overloaded,
+    root,
+    d0,  # [V] f32 over-estimate seed (affected vertices = BIG)
+    max_iters: Optional[int] = None,
+):
+    """Warm-started masked Bellman-Ford.  Returns [V] f32 — bit-identical
+    to ``spf_distances`` (same fixed-point equations, and integral link
+    metrics make every f32 path sum exact)."""
+    V = overloaded.shape[0]
+    w = jnp.where(edge_ok, w, BIG).astype(jnp.float32)
+    dist0 = d0.astype(jnp.float32).at[root].set(0.0)
+    transit = _can_transit(overloaded, root)
+    src_ok = transit[src] & edge_ok
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    def relax(d):
+        cand = jnp.where(src_ok, d[src] + w, BIG)
+        best = jax.ops.segment_min(
+            cand, dst, num_segments=V, indices_are_sorted=True
+        )
+        return jnp.minimum(d, best)
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        d, _, i = state
+        nd = d
+        for _ in range(WARM_UNROLL):
+            nd = relax(nd)
+        return nd, jnp.any(nd < d), i + WARM_UNROLL
+
+    dist, _, rounds = jax.lax.while_loop(
+        cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
+    )
+    return dist, rounds
+
+
+def spf_nexthop_lanes_reset(
+    src,
+    dst,
+    w,
+    edge_ok,
+    overloaded,
+    root,
+    dist,  # [V] converged distances (warm or cold)
+    nh0,  # [V, D] int8 warm lane seed (any value is safe; see above)
+    max_degree: int,
+    max_iters: Optional[int] = None,
+):
+    """Reset-semantics nexthop-lane fixed point, warm-startable.
+    Returns ([V, D] int8, rounds) — the same unique fixed point
+    ``spf_nexthop_lanes`` reaches (identical seed construction and DAG)."""
+    V = overloaded.shape[0]
+    D = max_degree
+    sp_edge = shortest_path_dag(src, dst, w, edge_ok, overloaded, root, dist)
+    is_root_out = src == root
+    rank = jnp.cumsum(is_root_out.astype(jnp.int32)) - 1
+    lanes = jnp.arange(D, dtype=jnp.int32)[None, :]
+    seed = (is_root_out[:, None] & (rank[:, None] == lanes)).astype(jnp.int8)
+    seed_mask = (sp_edge & is_root_out)[:, None].astype(jnp.int8)
+    seed_part = jax.ops.segment_max(
+        seed * seed_mask, dst, num_segments=V, indices_are_sorted=True
+    )
+    prop_mask = (sp_edge & ~is_root_out)[:, None].astype(jnp.int8)
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    def step(nh):
+        contrib = nh[src] * prop_mask
+        new = jax.ops.segment_max(
+            contrib, dst, num_segments=V, indices_are_sorted=True
+        )
+        # RESET: seed | in-edge OR, replacing (not accumulating onto)
+        # the previous round's value — the warm-start safety property
+        return jnp.maximum(new, seed_part)
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        nh, _, i = state
+        new = nh
+        for _ in range(WARM_UNROLL):
+            new = step(new)
+        return new, jnp.any(new != nh), i + WARM_UNROLL
+
+    nh, _, rounds = jax.lax.while_loop(
+        cond, body, (nh0.astype(jnp.int8), jnp.bool_(True), jnp.int32(0))
+    )
+    return nh, rounds
+
+
+def warm_subgraph_repair_one(
+    src_sub,  # [Es] int32 — edges whose head is in the reset set
+    dst_sub,  # [Es] int32 (ascending: gathered from the dst-sorted list)
+    w_sub,  # [Es] float32
+    ok_sub,  # [Es] bool — edge_ok & transit[src], host-precomputed
+    rank_sub,  # [Es] int32 root-out lane rank (-1 = not a root-out edge)
+    prev_dist,  # [V]
+    prev_nh,  # [V, D] int8
+    reset,  # [V] bool
+    max_degree: int,
+):
+    """Bounded repair for a PURE-WEAKENING generation delta: only the
+    reset region re-relaxes, and every per-round reduction runs over the
+    compact sub-edge list instead of the full edge set.  Exactness is
+    argued in ops/repair.plan_generation_delta — outside the reset set
+    neither distances nor lanes can change, so reading boundary values
+    straight from the previous generation's vectors is sound.  Returns
+    (dist [V], nh [V, D] int8, rounds_d, rounds_l)."""
+    V = prev_dist.shape[0]
+    D = max_degree
+    d0 = jnp.where(reset, BIG, prev_dist.astype(jnp.float32))
+    w_sub = jnp.where(ok_sub, w_sub, BIG).astype(jnp.float32)
+    limit = jnp.int32(V)
+
+    def relax(d):
+        cand = jnp.where(ok_sub, d[src_sub] + w_sub, BIG)
+        best = jax.ops.segment_min(
+            cand, dst_sub, num_segments=V, indices_are_sorted=True
+        )
+        return jnp.where(reset, jnp.minimum(d, best), d)
+
+    def dcond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def dbody(state):
+        d, _, i = state
+        nd = d
+        for _ in range(WARM_UNROLL):
+            nd = relax(nd)
+        return nd, jnp.any(nd < d), i + WARM_UNROLL
+
+    dist, _, rounds_d = jax.lax.while_loop(
+        dcond, dbody, (d0, jnp.bool_(True), jnp.int32(0))
+    )
+
+    # lane repair over the same subgraph: the new shortest-path DAG's
+    # in-edges of reset vertices, seeded by root-out lane ranks
+    on = ok_sub & (dist[dst_sub] < BIG) & (
+        dist[src_sub] + w_sub == dist[dst_sub]
+    )
+    lanes = jnp.arange(D, dtype=jnp.int32)[None, :]
+    seed_mat = (
+        (rank_sub[:, None] == lanes) & on[:, None]
+    ).astype(jnp.int8)  # [Es, D]
+    seed_part = jax.ops.segment_max(
+        seed_mat, dst_sub, num_segments=V, indices_are_sorted=True
+    )
+    prop = (on & (rank_sub < 0))[:, None].astype(jnp.int8)
+    nh_start = jnp.where(
+        reset[:, None], jnp.int8(0), prev_nh.astype(jnp.int8)
+    )
+
+    def lstep(nh):
+        contrib = nh[src_sub] * prop
+        new = jax.ops.segment_max(
+            contrib, dst_sub, num_segments=V, indices_are_sorted=True
+        )
+        new = jnp.maximum(new, seed_part)
+        return jnp.where(reset[:, None], new, nh)
+
+    def lcond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def lbody(state):
+        nh, _, i = state
+        new = nh
+        for _ in range(WARM_UNROLL):
+            new = lstep(new)
+        return new, jnp.any(new != nh), i + WARM_UNROLL
+
+    nh, _, rounds_l = jax.lax.while_loop(
+        lcond, lbody, (nh_start, jnp.bool_(True), jnp.int32(0))
+    )
+    return dist, nh, rounds_d, rounds_l
+
+
+def warm_spf_one(
+    src,
+    dst,
+    w,
+    edge_ok,
+    overloaded,
+    root,
+    prev_dist,  # [V] previous generation's distances
+    prev_nh,  # [V, D] previous generation's lanes
+    reset,  # [V] bool — vertices whose distance may have increased
+    lane_keep,  # scalar bool — root out-edge signature unchanged
+    max_degree: int,
+):
+    """(dist [V], nh [V, D] int8, rounds_d, rounds_l) for one topology,
+    warm-started from the previous generation's solution."""
+    d0 = jnp.where(reset, BIG, prev_dist)
+    dist, rounds_d = warm_spf_distances(
+        src, dst, w, edge_ok, overloaded, root, d0
+    )
+    nh0 = jnp.where(
+        lane_keep & ~reset[:, None], prev_nh.astype(jnp.int8), jnp.int8(0)
+    )
+    nh, rounds_l = spf_nexthop_lanes_reset(
+        src, dst, w, edge_ok, overloaded, root, dist, nh0, max_degree
+    )
+    return dist, nh, rounds_d, rounds_l
+
+
+# ---------------------------------------------------------------------------
 # Transposed (batch-minor) sweep kernels
 # ---------------------------------------------------------------------------
 # For the big what-if sweeps the batch-LEADING layout above is wrong for

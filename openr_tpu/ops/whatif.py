@@ -350,12 +350,18 @@ class LinkFailureSweep:
         return self._base
 
     def plan(self):
-        """Host-side repair plan (built once per engine)."""
+        """Host-side repair plan (built once per engine; content-hash
+        memoized across engines).  The what-if API rebuilds its engine
+        on EVERY Decision change generation — which bumps on prefix
+        churn too — so repeated sweeps over an unchanged graph used to
+        re-pay the full DAG/descendant-bitset planner pass.  The memo
+        key is the topology content (ops.repair.topology_content_hash),
+        not the generation counter, so only real graph changes replan."""
         if self._plan is None:
-            from openr_tpu.ops.repair import build_repair_plan
+            from openr_tpu.ops.repair import build_repair_plan_cached
 
             base_dist, base_nh = self.base_solve()
-            self._plan = build_repair_plan(
+            self._plan = build_repair_plan_cached(
                 self.topo,
                 self.root_id,
                 base_dist,

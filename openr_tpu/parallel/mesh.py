@@ -142,6 +142,13 @@ class DevicePool:
         self._healthy: List[bool] = [True] * len(devices)
         self.num_quarantines = 0
         self.num_restores = 0
+        #: monotonic health-mask generation: bumps on every quarantine /
+        #: restore.  Consumers holding per-chip device-resident state
+        #: (the backend's SPF-table replicas, the warm-rebuild context)
+        #: compare it against the value they captured to detect that the
+        #: shard packing re-packed underneath them and stale per-chip
+        #: residency must be dropped.
+        self.health_seq = 0
         #: per-chip committed-dispatch tally (route-build shards, fleet
         #: root chunks, what-if failure shards all count here — the
         #: pool is the shared dispatch plane), read by the pipeline
@@ -197,6 +204,7 @@ class DevicePool:
             return False
         self._healthy[index] = False
         self.num_quarantines += 1
+        self.health_seq += 1
         return True
 
     def restore_device(self, index: int) -> bool:
@@ -204,6 +212,7 @@ class DevicePool:
             return False
         self._healthy[index] = True
         self.num_restores += 1
+        self.health_seq += 1
         return True
 
     # -- shard packing -----------------------------------------------------

@@ -297,6 +297,16 @@ class BackendHealthGovernor:
             self._chip_breaker(chip).release_probe()
             self._armed_chip_probe = None
 
+    def request_shadow_check(self, reason: str = "") -> None:
+        """Make the NEXT device build shadow-verification due regardless
+        of where the sampling counter stands.  The warm-rebuild context
+        purge calls this: after any event that makes device-resident
+        state suspect (corruption injection, quarantine re-pack, a
+        full-replace swap), the first build off the purge must be
+        verified against the scalar oracle, not merely sampled."""
+        self._builds_since_check = self.shadow_sample_every
+        self.counters.bump("resilience.backend.shadow_check_requests")
+
     def record_dispatch_failure(self, exc: Optional[BaseException] = None) -> None:
         """A device dispatch raised (organic failure).  Counts toward the
         breaker threshold; past it the device is quarantined instead of

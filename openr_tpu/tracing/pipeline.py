@@ -24,6 +24,12 @@ phase              meaning
 ``device_get``     the blocking device->host fetch draining dispatches
 ``decode``         device outputs -> RibUnicastEntries (host decode tail)
 ``delta_extract``  diffing the new RouteDb against the previous one
+``warm_plan``      host-side generation-delta classification + warm-seed
+                   planning (reset-set BFS, encode patch bookkeeping,
+                   warm-context maintenance — decision/backend.py)
+``warm_repair``    the warm-start repair kernel dispatch: re-relaxing
+                   only the perturbed frontier from the previous
+                   generation's device-resident tables
 =================  ========================================================
 
 Surfaces: every phase sample lands in a ``pipeline.{phase}.ms``
@@ -53,6 +59,8 @@ DEVICE_COMPUTE = "device_compute"
 DEVICE_GET = "device_get"
 DECODE = "decode"
 DELTA_EXTRACT = "delta_extract"
+WARM_PLAN = "warm_plan"
+WARM_REPAIR = "warm_repair"
 
 PHASES = (
     HOST_FETCH,
@@ -63,13 +71,20 @@ PHASES = (
     DEVICE_GET,
     DECODE,
     DELTA_EXTRACT,
+    WARM_PLAN,
+    WARM_REPAIR,
 )
+
+#: phases only the warm-start generation-delta rebuild exercises — a
+#: cold full rebuild legitimately records nothing under them, so bench
+#: attribution gates treat them as optional coverage
+WARM_PHASES = (WARM_PLAN, WARM_REPAIR)
 
 #: phases whose time is host-side work (the pipelining refactor's
 #: overlap candidates) vs the device round trip — the host/device split
 #: BENCH_PIPELINE reports
-HOST_PHASES = (HOST_FETCH, ENCODE, PAD_PACK, DECODE, DELTA_EXTRACT)
-DEVICE_PHASES = (TRANSFER, DEVICE_COMPUTE, DEVICE_GET)
+HOST_PHASES = (HOST_FETCH, ENCODE, PAD_PACK, DECODE, DELTA_EXTRACT, WARM_PLAN)
+DEVICE_PHASES = (TRANSFER, DEVICE_COMPUTE, DEVICE_GET, WARM_REPAIR)
 
 _PREFIX = "pipeline."
 

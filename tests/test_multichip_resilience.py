@@ -161,6 +161,69 @@ def test_one_corrupt_chip_is_quarantined_individually():
     assert norm_db(db2) == oracle
 
 
+def test_device_scoped_corrupt_purges_warm_context_and_repacks():
+    """ISSUE-9 purge semantics, per-chip scope: a ``tpu_corrupt``
+    targeting ONE chip during a warm-rebuild regime invalidates the
+    warm context, the next build is cold and scalar-verified (catching
+    the lying chip, which quarantines INDIVIDUALLY while its shard
+    re-packs), and warm rebuilds resume on the survivors — with the
+    quarantined chip's stale table replica dropped."""
+    from openr_tpu.emulation.topology import build_adj_dbs as _adj
+
+    adj = _adj(ring_edges(6))
+    ls = LinkState("0", "node0")
+    for db in adj.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(6):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.7.{i}.0/24"))
+    als = {"0": ls}
+    backend = make_backend(SimClock(), shadow_sample_every=100)
+    gov = backend.governor
+
+    def perturb(metric):
+        db = adj["node3"]
+        db.adjacencies[0].metric = metric
+        ls.update_adjacency_database(db)
+
+    backend.build_route_db(als, ps)  # first build (verified, cold)
+    perturb(2)
+    backend.build_route_db(
+        als, ps, changed_prefixes=set(), force_full=True, warm_delta=True
+    )
+    assert backend.num_warm_builds == 1
+    assert backend._warm_ctx is not None
+    # chip-scoped corruption: warm context purged IMMEDIATELY, and the
+    # purge arms a forced shadow check for the next device build
+    backend.inject_silent_corruption(True, device_index=3)
+    assert backend._warm_ctx is None
+    assert backend.num_warm_purges == 1
+    db = backend.build_route_db(als, ps, force_full=True)
+    assert gov.num_shadow_mismatches == 1
+    assert gov.num_chip_quarantines == 1 and not backend.device_failed
+    assert not backend.pool.is_healthy(3)
+    assert norm_db(db) == norm_db(SpfSolver("node0").build_route_db(als, ps))
+    backend.inject_silent_corruption(False, device_index=3)
+    # next perturbation: cold (context purged; the quarantine listener
+    # purged again — idempotent), then the re-established context warms
+    perturb(3)
+    backend.build_route_db(
+        als, ps, changed_prefixes=set(), force_full=True, warm_delta=True
+    )
+    assert backend.num_warm_builds == 1
+    assert backend._warm_fallback_reasons.get("no_context", 0) >= 1
+    # the re-pack dropped the quarantined chip's table replica
+    assert 3 not in backend._spf_replicas
+    perturb(4)
+    db = backend.build_route_db(
+        als, ps, changed_prefixes=set(), force_full=True, warm_delta=True
+    )
+    assert backend.num_warm_builds == 2
+    assert norm_db(db) == norm_db(SpfSolver("node0").build_route_db(als, ps))
+    assert backend._warm_purge_reasons.get("tpu_corrupt", 0) >= 1
+    assert backend._warm_purge_reasons.get("quarantine", 0) >= 1
+
+
 def test_chip_probe_spans_carry_the_device_attr():
     """`resilience.probe` spans gain a `device` attr (ISSUE 6 tracing
     surface): per-chip probes are distinguishable in a trace."""

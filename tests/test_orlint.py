@@ -149,6 +149,26 @@ def test_fixtures_cover_every_rule():
     assert set(FIXTURES) == set(all_rules())
 
 
+def test_pipeline_registry_rule_covers_warm_phase_names():
+    """ISSUE-9 satellite: the new warm_plan/warm_repair names are
+    registry-governed like every other phase — a free spelling anywhere
+    outside the registry trips pipeline-phase-registry."""
+    for spelled in (
+        '"pipeline.warm_plan.ms"',
+        '"pipeline.warm_repair.ms"',
+        '"pipeline.warm_repair"',
+    ):
+        src = f"def record(counters):\n    counters.observe({spelled}, 1.0)\n"
+        findings = analyze_source(src)
+        assert [f.rule for f in findings] == ["pipeline-phase-registry"], (
+            spelled
+        )
+    # and the registry itself exposes them (no free spelling needed)
+    from openr_tpu.tracing import pipeline
+
+    assert pipeline.hist_key(pipeline.WARM_PLAN).startswith("pipeline.")
+
+
 @pytest.mark.parametrize("rule", sorted(FIXTURES))
 def test_rule_trips_on_fixture(rule):
     src, ctx, line = FIXTURES[rule]

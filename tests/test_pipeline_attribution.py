@@ -59,6 +59,65 @@ def test_registry_names_and_validation():
     assert not set(pipeline.HOST_PHASES) & set(pipeline.DEVICE_PHASES)
 
 
+def test_warm_phases_registered():
+    """ISSUE-9 satellite: the warm-start rebuild phases are first-class
+    registry members (hist/span spellings come from the registry, the
+    host/device split covers them, and the cold-lifecycle bench treats
+    them as optional coverage via WARM_PHASES)."""
+    assert pipeline.WARM_PLAN in pipeline.PHASES
+    assert pipeline.WARM_REPAIR in pipeline.PHASES
+    assert set(pipeline.WARM_PHASES) == {
+        pipeline.WARM_PLAN, pipeline.WARM_REPAIR
+    }
+    assert pipeline.span_name(pipeline.WARM_REPAIR) == "pipeline.warm_repair"
+    assert pipeline.hist_key(pipeline.WARM_PLAN) == "pipeline.warm_plan.ms"
+    assert pipeline.WARM_PLAN in pipeline.HOST_PHASES
+    assert pipeline.WARM_REPAIR in pipeline.DEVICE_PHASES
+
+
+def test_warm_rebuild_records_warm_phases():
+    """A warm generation-delta rebuild lands samples under BOTH warm
+    phases (plus the shared lifecycle phases), so BENCH_PIPELINE-style
+    attribution stays fully explained on warm ticks."""
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.emulation.topology import build_adj_dbs
+
+    clock = SimClock()
+    counters = CounterMap()
+    from openr_tpu.decision.backend import TpuBackend
+    from openr_tpu.decision.spf_solver import SpfSolver
+
+    backend = TpuBackend(
+        SpfSolver("node0"),
+        clock=clock,
+        counters=counters,
+        resilience=ResilienceConfig(enabled=False),
+        parallel=ParallelConfig(max_devices=1),
+    )
+    adj = build_adj_dbs(ring_edges(8))
+    ls = LinkState("0", "node0")
+    for db in adj.values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(8):
+        ps.update_prefix(f"node{i}", "0", PrefixEntry(f"10.9.{i}.0/24"))
+    als = {"0": ls}
+    backend.build_route_db(als, ps, force_full=True)
+    for phase in pipeline.WARM_PHASES:
+        h = counters.histogram(pipeline.hist_key(phase))
+        assert h is None or h.count == 0  # cold build: no warm samples
+    adj["node2"].adjacencies[0].metric = 5
+    ls.update_adjacency_database(adj["node2"])
+    backend.build_route_db(
+        als, ps, changed_prefixes=set(), force_full=True, warm_delta=True
+    )
+    assert backend.num_warm_builds == 1
+    for phase in pipeline.WARM_PHASES:
+        h = counters.histogram(pipeline.hist_key(phase))
+        assert h is not None and h.count >= 1, phase
+
+
 def test_device_gauge_keys():
     assert pipeline.device_busy_key(3) == "pipeline.dev3.busy_ms"
     assert pipeline.device_utilization_key(0) == "pipeline.dev0.utilization"

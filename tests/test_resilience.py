@@ -578,6 +578,94 @@ def test_tpu_corrupt_detect_quarantine_recover_deterministic():
     assert "chaos.inject.tpu_corrupt.node4" in chaos_dump
 
 
+async def _warm_purge_run():
+    """ISSUE-9 purge semantics under chaos: ``tpu_corrupt`` landing
+    DURING a warm-rebuild regime invalidates the warm context — the
+    next device build is cold AND scalar-verified — and warm rebuilds
+    resume after probed recovery.  Returns the counters a replay must
+    reproduce byte-identically."""
+    from openr_tpu.chaos import ChaosController, FaultPlan, InvariantChecker
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import grid_edges
+    from openr_tpu.types import PrefixEntry
+
+    clock = SimClock()
+    net = EmulatedNetwork(
+        clock, use_tpu_backend=True, config_overrides=corrupt_overrides
+    )
+    net.build(grid_edges(3))
+    net.start()
+    checker = InvariantChecker(net)
+    plan = FaultPlan().tpu_corrupt(VICTIM, at=8.0, duration=10.0)
+    controller = ChaosController(net, plan, seed=13)
+
+    await clock.run_for(18.0)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    victim = net.nodes[VICTIM]
+    backend = victim.decision.backend
+    gov = backend.governor
+    # a link flap before the fault: a warm-classified perturbation tick
+    # — the warm rebuild engages and flows through shadow verification
+    # like any other build (sample_every=2 on a warm regime)
+    controller.start()  # fault fires at t=+8
+    net.fail_link("node0", "node1")
+    await clock.run_for(3.0)
+    net.restore_link("node0", "node1")
+    await clock.run_for(3.0)
+    warm_before_fault = backend.num_warm_builds
+    assert warm_before_fault >= 1, "perturbation ticks must warm-rebuild"
+    assert gov.num_shadow_mismatches == 0
+    await clock.run_for(3.0)  # corruption live at t=+8
+    # the injection purged the warm context immediately
+    assert backend._warm_ctx is None
+    assert backend._warm_purge_reasons.get("tpu_corrupt", 0) >= 1
+    purges_at_fault = backend.num_warm_purges
+    # drive a rebuild during the corrupt window: the purge armed a
+    # forced shadow check, so the FIRST corrupt device build is caught
+    net.nodes["node0"].advertise_prefixes([PrefixEntry("10.98.0.0/24")])
+    await clock.run_for(1.5)
+    checker.sample()
+    assert gov.num_shadow_mismatches >= 1
+    assert gov.quarantined
+    checker.check_no_blackholes()
+    # heal at t=+18; probe restores; a fresh perturbation warms again
+    await clock.run_for(12.0)
+    net.nodes["node0"].advertise_prefixes([PrefixEntry("10.98.1.0/24")])
+    await clock.run_for(4.0)
+    assert not gov.quarantined
+    net.fail_link("node1", "node2")
+    await clock.run_for(4.0)
+    # the first post-purge device build re-solved cold and
+    # re-established the context; by now warm rebuilds have resumed
+    assert backend.num_warm_builds > warm_before_fault
+    assert backend._warm_ctx is not None
+    await clock.run_for(6.0)
+    checker.check_all()
+    stats = (
+        backend.num_warm_builds,
+        backend.num_warm_purges - purges_at_fault,
+        sorted(backend._warm_purge_reasons.items()),
+        sorted(backend._warm_fallback_reasons.items()),
+        gov.num_shadow_mismatches,
+    )
+    dumps = (
+        controller.counter_dump(),
+        victim.counters.dump("resilience."),
+        stats,
+    )
+    await controller.stop()
+    await net.stop()
+    return dumps
+
+
+@pytest.mark.chaos
+def test_tpu_corrupt_purges_warm_context_deterministic():
+    a = run(_warm_purge_run())
+    b = run(_warm_purge_run())
+    assert a == b  # byte-identical seeded replay (ISSUE-9 acceptance)
+
+
 @pytest.mark.chaos
 def test_tpu_corrupt_on_scalar_backend_is_a_counted_noop():
     from openr_tpu.chaos import ChaosController, FaultPlan
