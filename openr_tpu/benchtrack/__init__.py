@@ -1,0 +1,63 @@
+"""openr_tpu.benchtrack — the bench-artifact trajectory observatory.
+
+Every performance claim this repo makes lives in a checked-in
+``BENCH_*_rNN.json`` artifact.  benchtrack is the subsystem that reads
+them **as a trajectory** instead of as isolated files:
+
+  * :mod:`openr_tpu.benchtrack.manifest` — the declarative artifact
+    manifest: one :class:`ArtifactSpec` per family (filename pattern →
+    schema validator → headline metrics with a direction and a
+    regression tolerance).  An artifact matching no manifest entry is
+    an ORPHAN and fails the check — every artifact must say what it
+    measures and how to judge it.
+  * :mod:`openr_tpu.benchtrack.timeline` — discovery + the cross-round
+    trajectory timeline (``--report``, ctrl ``get_bench_trajectory``,
+    ``breeze monitor trajectory``).
+  * :mod:`openr_tpu.benchtrack.ratchet` — the orlint-style
+    content-matched ratchet (``benchtrack_ratchet.json``): each
+    ratcheted headline metric is pinned to a blessed value and the
+    sha256 of the artifact it came from.  ``--check`` fails when the
+    latest round regresses past its tolerance, when the blessed
+    artifact's content drifted without a ratchet update, or when a
+    headline metric was never blessed; improvements move the ratchet
+    only through an explicit ``--update-ratchet``.
+
+CLI: ``python -m openr_tpu.benchtrack --check|--report|--update-ratchet``.
+This is the gate every future perf PR reports through — see
+docs/Benchmarks.md for the workflow.
+"""
+
+from __future__ import annotations
+
+from openr_tpu.benchtrack.manifest import (
+    MANIFEST,
+    ArtifactSpec,
+    HeadlineMetric,
+    extract,
+    repo_root,
+    spec_for,
+)
+from openr_tpu.benchtrack.ratchet import (
+    RATCHET_FILE,
+    CheckResult,
+    load_ratchet,
+    run_check,
+    update_ratchet,
+)
+from openr_tpu.benchtrack.timeline import build_timeline, discover
+
+__all__ = [
+    "MANIFEST",
+    "ArtifactSpec",
+    "CheckResult",
+    "HeadlineMetric",
+    "RATCHET_FILE",
+    "build_timeline",
+    "discover",
+    "extract",
+    "load_ratchet",
+    "repo_root",
+    "run_check",
+    "spec_for",
+    "update_ratchet",
+]

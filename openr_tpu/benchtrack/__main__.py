@@ -1,0 +1,97 @@
+"""CLI: ``python -m openr_tpu.benchtrack --check|--report|--update-ratchet``.
+
+``--check`` is the PR gate (exit 1 on any problem: orphan artifacts,
+schema violations, missing env stamps, ratchet regressions/drift);
+``--report`` prints the cross-round trajectory timeline;
+``--update-ratchet`` deliberately re-blesses every ratcheted headline
+metric from its latest round.  See docs/Benchmarks.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from openr_tpu.benchtrack.ratchet import run_check, update_ratchet
+from openr_tpu.benchtrack.timeline import build_timeline, render_timeline
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m openr_tpu.benchtrack",
+        description="bench-artifact trajectory observatory",
+    )
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument(
+        "--check",
+        action="store_true",
+        help="validate every artifact against the manifest + ratchet",
+    )
+    group.add_argument(
+        "--report",
+        action="store_true",
+        help="print the cross-round trajectory timeline",
+    )
+    group.add_argument(
+        "--update-ratchet",
+        action="store_true",
+        help="re-bless every ratcheted headline metric (deliberate!)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format",
+    )
+    parser.add_argument(
+        "--root",
+        type=Path,
+        default=None,
+        help="artifact root (default: the repo root)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.report:
+        timeline = build_timeline(args.root)
+        if args.format == "json":
+            print(json.dumps(timeline, indent=2))
+        else:
+            print(render_timeline(timeline), end="")
+        return 0
+
+    if args.update_ratchet:
+        doc = update_ratchet(args.root)
+        if args.format == "json":
+            print(json.dumps(doc, indent=2))
+        else:
+            print(
+                f"blessed {len(doc['entries'])} headline metric(s) into "
+                "benchtrack_ratchet.json"
+            )
+        return 0
+
+    res = run_check(args.root)
+    if args.format == "json":
+        print(json.dumps(res.to_json(), indent=2))
+    else:
+        for p in res.problems:
+            where = p.get("artifact") or p.get("metric") or ""
+            fam = p.get("family") or "-"
+            print(f"FAIL [{p['kind']}] {fam} {where}: {p['detail']}")
+        for imp in res.improvements:
+            print(
+                f"note [improvement] {imp['family']} {imp['metric']}: "
+                f"{imp['blessed']} -> {imp['current']} ({imp['note']})"
+            )
+        print(
+            f"benchtrack: {res.artifacts_checked} artifact(s) in "
+            f"{res.families_checked} family(ies): "
+            + ("OK" if res.ok else f"{len(res.problems)} problem(s)")
+        )
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

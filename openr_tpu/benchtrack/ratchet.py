@@ -1,0 +1,297 @@
+"""The content-matched bench ratchet (orlint-style, for perf).
+
+``benchtrack_ratchet.json`` (repo root, beside the artifacts) pins one
+BLESSED value per ratcheted headline metric, together with the round,
+filename and sha256 of the artifact it came from.  ``--check`` then
+enforces:
+
+  * **regression** — the latest round's value is worse than the blessed
+    value by more than the manifest tolerance → fail.  This is the gate
+    a perf PR trips when it slows a headline down.
+  * **content drift** — the artifact the blessing points at was edited
+    in place (sha mismatch) without re-blessing → fail.  Values are
+    matched to content, not filenames, so a quietly-rewritten artifact
+    can't keep an old blessing alive.
+  * **ratchet missing / stale** — a ratcheted metric without a blessing
+    (new family: bless it deliberately), or a blessing whose family or
+    metric no longer exists (dead weight: remove it) → fail.
+
+Improvements NEVER move the ratchet implicitly: ``--check`` reports
+them and keeps passing; only ``--update-ratchet`` re-blesses — the same
+one-way contract orlint's baseline has (analysis/baseline.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.benchtrack.manifest import (
+    MANIFEST,
+    extract,
+    repo_root,
+)
+from openr_tpu.benchtrack.timeline import Discovery, discover
+
+RATCHET_FILE = "benchtrack_ratchet.json"
+VERSION = 1
+
+
+def sha256_of(path: Path) -> str:
+    return hashlib.sha256(path.read_bytes()).hexdigest()
+
+
+def ratchet_path(root: Optional[Path] = None) -> Path:
+    return (root or repo_root()) / RATCHET_FILE
+
+
+def load_ratchet(root: Optional[Path] = None) -> dict:
+    path = ratchet_path(root)
+    try:
+        return json.loads(path.read_text())
+    except FileNotFoundError:
+        return {"version": VERSION, "entries": []}
+
+
+@dataclass
+class CheckResult:
+    ok: bool = True
+    #: each problem: {"kind", "family", ...} — kinds: orphan, invalid,
+    #: schema, env_missing, ratchet_missing, content_drift, stale,
+    #: regression
+    problems: List[dict] = field(default_factory=list)
+    #: headline metrics currently better than their blessing (passing;
+    #: run --update-ratchet to lock the gain in)
+    improvements: List[dict] = field(default_factory=list)
+    families_checked: int = 0
+    artifacts_checked: int = 0
+
+    def add(self, **problem) -> None:
+        self.problems.append(problem)
+        self.ok = False
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "problems": self.problems,
+            "improvements": self.improvements,
+            "families_checked": self.families_checked,
+            "artifacts_checked": self.artifacts_checked,
+        }
+
+
+def _entry_index(ratchet: dict) -> Dict[Tuple[str, str], dict]:
+    return {
+        (e["family"], e["metric"]): e for e in ratchet.get("entries", [])
+    }
+
+
+def run_check(
+    root: Optional[Path] = None, disc: Optional[Discovery] = None
+) -> CheckResult:
+    """The full --check pass: orphans, schemas, env stamps, ratchet."""
+    root = root or repo_root()
+    disc = disc or discover(root)
+    res = CheckResult()
+    for orphan in disc.orphans:
+        res.add(
+            kind="orphan",
+            family=None,
+            artifact=orphan,
+            detail="matches no manifest entry (add an ArtifactSpec)",
+        )
+    specs = {s.family: s for s in MANIFEST}
+    for family, points in sorted(disc.rounds.items()):
+        spec = specs[family]
+        res.families_checked += 1
+        for p in points:
+            res.artifacts_checked += 1
+            if p.doc is None:
+                res.add(
+                    kind="invalid",
+                    family=family,
+                    artifact=p.name,
+                    detail=f"unparseable JSON: {p.parse_error}",
+                )
+                continue
+            from openr_tpu.benchtrack.manifest import env_triple
+
+            if spec.requires_env and env_triple(p.doc, spec) is None:
+                res.add(
+                    kind="env_missing",
+                    family=family,
+                    artifact=p.name,
+                    detail=(
+                        "missing platform/jax/device_count env stamp "
+                        f"at {spec.env_path}"
+                    ),
+                )
+        latest = points[-1]
+        if latest.doc is None:
+            continue
+        # the schema gate binds the LATEST round (schemas evolve with
+        # their validators; older rounds stay parse+manifest-matched)
+        for label, fn in (("schema", spec.validate),
+                          ("acceptance", spec.acceptance)):
+            if fn is None:
+                continue
+            try:
+                fn(latest.doc)
+            except Exception as e:  # validators raise AssertionError etc.
+                res.add(
+                    kind=label,
+                    family=family,
+                    artifact=latest.name,
+                    detail=f"{type(e).__name__}: {e}",
+                )
+
+    ratchet = load_ratchet(root)
+    idx = _entry_index(ratchet)
+    ratcheted_keys = set()
+    for spec in MANIFEST:
+        points = disc.rounds.get(spec.family, [])
+        latest = points[-1] if points else None
+        for h in spec.ratcheted():
+            if latest is None:
+                continue  # family not present in this checkout
+            ratcheted_keys.add((spec.family, h.key))
+            entry = idx.get((spec.family, h.key))
+            if entry is None:
+                res.add(
+                    kind="ratchet_missing",
+                    family=spec.family,
+                    metric=h.key,
+                    detail=(
+                        "ratcheted headline metric has no blessing — "
+                        "run --update-ratchet to bless it deliberately"
+                    ),
+                )
+                continue
+            blessed_path = root / entry["artifact"]
+            if not blessed_path.exists():
+                res.add(
+                    kind="stale",
+                    family=spec.family,
+                    metric=h.key,
+                    detail=(
+                        f"blessed artifact {entry['artifact']} is gone "
+                        "— re-bless with --update-ratchet"
+                    ),
+                )
+                continue
+            if sha256_of(blessed_path) != entry.get("sha256"):
+                res.add(
+                    kind="content_drift",
+                    family=spec.family,
+                    metric=h.key,
+                    artifact=entry["artifact"],
+                    detail=(
+                        "blessed artifact content changed without a "
+                        "ratchet update (content-matched blessing)"
+                    ),
+                )
+                continue
+            if latest.doc is None:
+                continue
+            try:
+                current = extract(latest.doc, h.key)
+            except (KeyError, IndexError, TypeError):
+                res.add(
+                    kind="schema",
+                    family=spec.family,
+                    artifact=latest.name,
+                    detail=f"headline metric {h.key} missing",
+                )
+                continue
+            blessed = float(entry["value"])
+            if not isinstance(current, (int, float)):
+                res.add(
+                    kind="schema",
+                    family=spec.family,
+                    artifact=latest.name,
+                    detail=f"headline metric {h.key} is not numeric",
+                )
+                continue
+            if h.regressed(blessed, float(current)):
+                res.add(
+                    kind="regression",
+                    family=spec.family,
+                    metric=h.key,
+                    artifact=latest.name,
+                    blessed=blessed,
+                    current=float(current),
+                    bound=round(h.worst_allowed(blessed), 6),
+                    detail=(
+                        f"{h.key} regressed past tolerance: blessed "
+                        f"{blessed} (r{entry['round']:02d}), current "
+                        f"{current}, worst allowed "
+                        f"{round(h.worst_allowed(blessed), 4)}"
+                    ),
+                )
+            elif h.improved(blessed, float(current)) and abs(
+                float(current) - blessed
+            ) > abs(blessed) * 1e-3:
+                res.improvements.append(
+                    {
+                        "family": spec.family,
+                        "metric": h.key,
+                        "blessed": blessed,
+                        "current": float(current),
+                        "note": "run --update-ratchet to lock this in",
+                    }
+                )
+    for key, entry in sorted(idx.items()):
+        if key not in ratcheted_keys:
+            res.add(
+                kind="stale",
+                family=entry["family"],
+                metric=entry["metric"],
+                detail=(
+                    "blessing matches no ratcheted manifest metric "
+                    "with artifacts present — remove the dead entry "
+                    "via --update-ratchet"
+                ),
+            )
+    return res
+
+
+def update_ratchet(
+    root: Optional[Path] = None, disc: Optional[Discovery] = None
+) -> dict:
+    """Re-bless every ratcheted headline metric from its family's
+    latest round and write ``benchtrack_ratchet.json``."""
+    root = root or repo_root()
+    disc = disc or discover(root)
+    entries: List[dict] = []
+    for spec in MANIFEST:
+        points = disc.rounds.get(spec.family, [])
+        latest = points[-1] if points else None
+        if latest is None or latest.doc is None:
+            continue
+        for h in spec.ratcheted():
+            try:
+                value = extract(latest.doc, h.key)
+            except (KeyError, IndexError, TypeError):
+                continue
+            if not isinstance(value, (int, float)):
+                continue
+            entries.append(
+                {
+                    "family": spec.family,
+                    "metric": h.key,
+                    "direction": h.direction,
+                    "tolerance_pct": h.tolerance_pct,
+                    "tolerance_abs": h.tolerance_abs,
+                    "value": value,
+                    "round": latest.round,
+                    "artifact": latest.name,
+                    "sha256": sha256_of(latest.path),
+                }
+            )
+    doc = {"version": VERSION, "entries": entries}
+    path = ratchet_path(root)
+    path.write_text(json.dumps(doc, indent=2) + "\n")
+    return doc

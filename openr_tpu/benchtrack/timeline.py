@@ -1,0 +1,216 @@
+"""Artifact discovery + the cross-round trajectory timeline.
+
+``discover`` maps every checked-in artifact onto its manifest family
+(collecting orphans); ``build_timeline`` turns that into the
+JSON-able trajectory report ``--report``, ctrl ``get_bench_trajectory``
+and ``breeze monitor trajectory`` all render: per family, the rounds in
+order with their headline values and round-over-round deltas.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.benchtrack.manifest import (
+    HIGHER,
+    MANIFEST,
+    ArtifactSpec,
+    env_triple,
+    extract,
+    repo_root,
+    spec_for,
+)
+
+#: files the orphan sweep considers bench artifacts
+ARTIFACT_GLOBS = ("BENCH_*.json", "MULTICHIP_*.json")
+
+
+@dataclass
+class RoundPoint:
+    """One artifact file of one family."""
+
+    family: str
+    round: int
+    path: Path
+    doc: Optional[dict] = None
+    parse_error: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.path.name
+
+
+@dataclass
+class Discovery:
+    rounds: Dict[str, List[RoundPoint]] = field(default_factory=dict)
+    orphans: List[str] = field(default_factory=list)
+
+    def latest(self, family: str) -> Optional[RoundPoint]:
+        pts = self.rounds.get(family)
+        return pts[-1] if pts else None
+
+
+def artifact_files(root: Path) -> List[Path]:
+    out: List[Path] = []
+    for pattern in ARTIFACT_GLOBS:
+        out.extend(root.glob(pattern))
+    return sorted(set(out))
+
+
+def discover(root: Optional[Path] = None) -> Discovery:
+    """Read every artifact under ``root``, grouped per family and
+    sorted by round.  Unparseable files keep their ``parse_error``;
+    files matching no manifest pattern land in ``orphans``."""
+    root = root or repo_root()
+    disc = Discovery()
+    # the ratchet file itself is not an artifact
+    skip = {"benchtrack_ratchet.json"}
+    for path in artifact_files(root):
+        if path.name in skip:
+            continue
+        hit = spec_for(path.name)
+        if hit is None:
+            disc.orphans.append(path.name)
+            continue
+        spec, rnd = hit
+        point = RoundPoint(family=spec.family, round=rnd, path=path)
+        try:
+            point.doc = json.loads(path.read_text())
+        except ValueError as e:
+            point.parse_error = str(e)
+        disc.rounds.setdefault(spec.family, []).append(point)
+    for pts in disc.rounds.values():
+        pts.sort(key=lambda p: p.round)
+    return disc
+
+
+def _headline_values(spec: ArtifactSpec, doc: dict) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for h in spec.headline:
+        try:
+            out[h.key] = extract(doc, h.key)
+        except (KeyError, IndexError, TypeError):
+            out[h.key] = None
+    return out
+
+
+def build_timeline(root: Optional[Path] = None) -> dict:
+    """The trajectory report: every family's rounds, headline values,
+    and round-over-round deltas (sign-aware: ``better`` follows the
+    metric's direction)."""
+    root = root or repo_root()
+    disc = discover(root)
+    families: Dict[str, dict] = {}
+    for spec in MANIFEST:
+        points = disc.rounds.get(spec.family, [])
+        if not points:
+            continue
+        directions = {h.key: h.direction for h in spec.headline}
+        ratcheted = {h.key for h in spec.ratcheted()}
+        rounds = []
+        prev_values: Dict[str, object] = {}
+        for p in points:
+            if p.doc is None:
+                rounds.append(
+                    {
+                        "round": p.round,
+                        "artifact": p.name,
+                        "parse_error": p.parse_error,
+                    }
+                )
+                continue
+            values = _headline_values(spec, p.doc)
+            deltas = {}
+            for key, val in values.items():
+                prev = prev_values.get(key)
+                if (
+                    isinstance(val, (int, float))
+                    and isinstance(prev, (int, float))
+                    and prev
+                ):
+                    pct = (val - prev) / abs(prev) * 100.0
+                    better = (
+                        pct >= 0 if directions[key] == HIGHER else pct <= 0
+                    )
+                    deltas[key] = {
+                        "pct": round(pct, 2),
+                        "better": better,
+                    }
+            rounds.append(
+                {
+                    "round": p.round,
+                    "artifact": p.name,
+                    "metric": (
+                        p.doc.get("metric")
+                        or p.doc.get("parsed", {}).get("metric")
+                    ),
+                    "values": values,
+                    "deltas": deltas,
+                    "env": env_triple(p.doc, spec),
+                }
+            )
+            prev_values.update(
+                {
+                    k: v
+                    for k, v in values.items()
+                    if isinstance(v, (int, float))
+                }
+            )
+        families[spec.family] = {
+            "description": spec.description,
+            "directions": directions,
+            "ratcheted": sorted(ratcheted),
+            "rounds": rounds,
+        }
+    return {"families": families, "orphans": disc.orphans}
+
+
+def render_timeline(timeline: dict) -> str:
+    """Human rendering of :func:`build_timeline` (also what ``breeze
+    monitor trajectory`` prints)."""
+    lines: List[str] = []
+    for family, info in timeline["families"].items():
+        lines.append(f"{family}: {info['description']}")
+        for key, direction in info["directions"].items():
+            gated = key in info["ratcheted"]
+            trail: List[str] = []
+            for r in info["rounds"]:
+                if "parse_error" in r:
+                    trail.append(f"r{r['round']:02d}=<unparseable>")
+                    continue
+                val = r["values"].get(key)
+                if val is None:
+                    continue
+                delta = r["deltas"].get(key)
+                arrow = ""
+                if delta is not None:
+                    arrow = (
+                        f" ({'+' if delta['pct'] >= 0 else ''}"
+                        f"{delta['pct']}%"
+                        f"{'' if delta['better'] else ' WORSE'})"
+                    )
+                if isinstance(val, float):
+                    val = round(val, 3)
+                trail.append(f"r{r['round']:02d}={val}{arrow}")
+            if not trail:
+                continue
+            tag = "ratcheted" if gated else "tracked"
+            lines.append(
+                f"  {key} [{direction} is better, {tag}]: "
+                + "  ->  ".join(trail)
+            )
+    if timeline["orphans"]:
+        lines.append(
+            "ORPHAN artifacts (no manifest entry): "
+            + ", ".join(timeline["orphans"])
+        )
+    return "\n".join(lines) + "\n"
+
+
+def round_from_name(name: str) -> Optional[int]:
+    m = re.search(r"_r(\d+)\.json$", name)
+    return int(m.group(1)) if m else None

@@ -575,6 +575,44 @@ def monitor_flight_dump(ctx: click.Context) -> None:
     _print(doc)
 
 
+@monitor.command("trajectory")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def monitor_trajectory(ctx: click.Context, json_out: bool) -> None:
+    """Cross-round bench-artifact trajectory + ratchet verdict
+    (openr_tpu.benchtrack): every BENCH family's headline metrics round
+    over round, which are ratcheted, and whether the latest rounds sit
+    within their blessed tolerances.  See docs/Benchmarks.md for the
+    artifact/ratchet workflow."""
+    doc = _call(ctx, "get_bench_trajectory")
+    if json_out:
+        _print(doc)
+        return
+    from openr_tpu.benchtrack.timeline import render_timeline
+
+    click.echo(render_timeline(doc), nl=False)
+    check = doc.get("check") or {}
+    problems = check.get("problems", [])
+    improvements = check.get("improvements", [])
+    for p in problems:
+        where = p.get("artifact") or p.get("metric") or ""
+        click.echo(
+            f"CHECK FAIL [{p.get('kind')}] {p.get('family') or '-'} "
+            f"{where}: {p.get('detail')}"
+        )
+    for imp in improvements:
+        click.echo(
+            f"improvement: {imp['family']} {imp['metric']} "
+            f"{imp['blessed']} -> {imp['current']} ({imp['note']})"
+        )
+    click.echo(
+        "ratchet check: "
+        + ("OK" if check.get("ok") else f"{len(problems)} problem(s)")
+        + f" ({check.get('artifacts_checked', 0)} artifacts in "
+        f"{check.get('families_checked', 0)} families)"
+    )
+
+
 @monitor.command("statistics")
 @click.pass_context
 def monitor_statistics(ctx: click.Context) -> None:

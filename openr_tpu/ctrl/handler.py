@@ -960,6 +960,18 @@ class OpenrCtrlHandler:
             return None
         return recorder.last_dump_doc()
 
+    def get_bench_trajectory(self) -> dict:
+        """The cross-round bench-artifact trajectory
+        (openr_tpu.benchtrack): per-family rounds with headline values
+        and round-over-round deltas, plus the ratchet --check verdict.
+        `breeze monitor trajectory` renders this; the artifacts are
+        read from the repo checkout this daemon runs from."""
+        from openr_tpu.benchtrack import build_timeline, run_check
+
+        timeline = build_timeline()
+        timeline["check"] = run_check().to_json()
+        return timeline
+
     # --------------------------------------------------------------- health
     # (openr_tpu.health — fleet SLO burn-rate evaluation + cross-node
     # rollups; net-new vs the reference)

@@ -44,9 +44,15 @@ class EmulatedNetwork:
         clock: Clock,
         link_latency_s: float = 0.002,
         kv_latency_s: float = 0.002,
-        use_tpu_backend: bool = False,
+        use_tpu_backend: Optional[bool] = False,
         config_overrides=None,
     ) -> None:
+        # use_tpu_backend=None defers to each node's config
+        # (tpu_compute_config.enable_tpu_spf), so config_overrides can
+        # give ONE observer node the device backend while the rest of a
+        # large fleet runs the scalar path (the trajectory bench suite's
+        # shape: a thousand jitted backends in one process would measure
+        # the harness, not the system)
         self.clock = clock
         self.io = MockIoProvider(clock)
         self.kv_transport = InProcessTransport(clock, latency_s=kv_latency_s)

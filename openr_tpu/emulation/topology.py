@@ -1,13 +1,25 @@
-"""Synthetic topology generators (grid, fat-tree/fabric, ring, line).
+"""Synthetic topology generators (grid, fat-tree/fabric, ring, line)
+and the topology-class catalog the trajectory bench suite sweeps.
 
 Ported in spirit from the reference benchmark generators
 (openr/decision/tests/RoutingBenchmarkUtils.cpp:251 createGrid, :422
 3-tier fabric) — used by unit tests, the system emulation, and bench.py.
+
+The :data:`TOPOLOGY_CLASSES` table is the one registry of benchable
+topology classes: each row builds a deterministic edge list from
+``(class, scale, seed)`` (``scale`` is a target node count the class
+rounds to its structural grain), exposes the derived structural
+parameters for tests, and carries the class's publication→FIB
+convergence SLO (openr_tpu.health.slo reads it for per-class
+objectives).  `bench.py --suite` sweeps every non-multi-area class.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+import math
+import random
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
 
 from openr_tpu.types import Adjacency, AdjacencyDatabase
 
@@ -138,8 +150,6 @@ def random_connected_edges(
 ) -> List[Edge]:
     """Random connected graph: spanning tree + `extra_edges` chords.
     Deterministic per seed; used for WAN-like what-if sweeps."""
-    import random
-
     rng = random.Random(seed)
     nodes = [f"{prefix}{i}" for i in range(n)]
     edges: List[Edge] = []
@@ -163,3 +173,304 @@ def random_connected_edges(
         edges.append((nodes[i], nodes[j], rng.randint(1, 10)))
         added += 1
     return edges
+
+
+# --------------------------------------------------------------------------
+# topology-class catalog (bench.py --suite, tests/test_topology_classes.py)
+
+
+def multipod_fattree_edges(
+    num_pods: int = 4,
+    rsws_per_pod: int = 24,
+    fsws_per_pod: int = 4,
+    ssws_per_pod: int = 4,
+    num_spines: int = 16,
+) -> List[Edge]:
+    """Multi-pod fat-tree: each pod is an instance of the 3-tier fabric
+    (rack rsw → fabric fsw → pod-spine ssw, rsw-fsw and fsw-ssw full
+    bipartite inside the pod), pods joined by a super-spine layer —
+    every pod-spine ``ssw{p}_{s}`` uplinks to the super-spines ``k``
+    with ``k % ssws_per_pod == s``, so pods share the spine plane on
+    disjoint slices (the PAPER's DC-fabric shape at multi-pod scale).
+    Uniform metric 1: path diversity comes from structure, so ECMP
+    lanes stress the selection kernels."""
+    edges: List[Edge] = []
+    for p in range(num_pods):
+        fsws = [f"fsw{p}_{f}" for f in range(fsws_per_pod)]
+        ssws = [f"ssw{p}_{s}" for s in range(ssws_per_pod)]
+        for r in range(rsws_per_pod):
+            rsw = f"rsw{p}_{r}"
+            for fsw in fsws:
+                edges.append((rsw, fsw, 1))
+        for fsw in fsws:
+            for ssw in ssws:
+                edges.append((fsw, ssw, 1))
+        for s, ssw in enumerate(ssws):
+            for k in range(num_spines):
+                if k % ssws_per_pod == s:
+                    edges.append((ssw, f"spine{k}", 1))
+    return edges
+
+
+def wan_hierarchy_edges(
+    num_backbone: int = 32,
+    num_metros: int = 62,
+    metro_size: int = 16,
+    backbone_extra: int = 32,
+    seed: int = 0,
+) -> List[Edge]:
+    """WAN hierarchy: metro access rings dual-homed onto a sparse
+    backbone mesh, with ASYMMETRIC long-haul metrics (a->b and b->a
+    drawn independently — the Express-Backbone shape where forward and
+    reverse paths legitimately differ).  Deterministic per seed.
+
+    Structure: ``core{i}`` backbone = random spanning tree +
+    ``backbone_extra`` chords, metrics 10..100 per direction;
+    ``m{j}_{k}`` metro rings, metrics 1..5 symmetric; each metro homes
+    its ring node 0 and its antipode onto two distinct cores (metrics
+    5..20 per direction)."""
+    rng = random.Random(seed)
+    cores = [f"core{i}" for i in range(num_backbone)]
+    edges: List[Edge] = []
+
+    def asym(a: str, b: str, lo: int, hi: int) -> None:
+        # two explicit directed entries: build_adj_dbs pass 1 keeps both
+        edges.append((a, b, rng.randint(lo, hi)))
+        edges.append((b, a, rng.randint(lo, hi)))
+
+    for i in range(1, num_backbone):
+        asym(cores[rng.randrange(i)], cores[i], 10, 100)
+    max_chords = num_backbone * (num_backbone - 1) // 2 - (num_backbone - 1)
+    seen = {
+        (min(a, b), max(a, b))
+        for a, b, _ in edges
+    }
+    added = 0
+    while added < min(backbone_extra, max_chords):
+        i, j = rng.randrange(num_backbone), rng.randrange(num_backbone)
+        if i == j:
+            continue
+        key = (min(cores[i], cores[j]), max(cores[i], cores[j]))
+        if key in seen:
+            continue
+        seen.add(key)
+        asym(cores[i], cores[j], 10, 100)
+        added += 1
+    for m in range(num_metros):
+        ring = [f"m{m}_{k}" for k in range(metro_size)]
+        for k in range(metro_size):
+            w = rng.randint(1, 5)
+            edges.append((ring[k], ring[(k + 1) % metro_size], w))
+        # dual-homing: ring node 0 and its antipode onto distinct cores
+        c1 = rng.randrange(num_backbone)
+        c2 = (c1 + 1 + rng.randrange(num_backbone - 1)) % num_backbone
+        asym(ring[0], cores[c1], 5, 20)
+        asym(ring[metro_size // 2], cores[c2], 5, 20)
+    return edges
+
+
+def _grid_params(scale: int) -> Dict[str, int]:
+    side = max(2, math.isqrt(max(scale, 4)))
+    return {
+        "side": side,
+        "nodes": side * side,
+        "undirected_edges": 2 * side * (side - 1),
+    }
+
+
+_FATTREE_RSWS, _FATTREE_FSWS, _FATTREE_SSWS = 24, 4, 4
+_FATTREE_POD = _FATTREE_RSWS + _FATTREE_FSWS + _FATTREE_SSWS  # 32/pod
+_FATTREE_SPINES = 16
+
+
+def _fattree_params(scale: int) -> Dict[str, int]:
+    pods = max(2, round((scale - _FATTREE_SPINES) / _FATTREE_POD))
+    per_pod_edges = (
+        _FATTREE_RSWS * _FATTREE_FSWS  # rack <-> fabric, full bipartite
+        + _FATTREE_FSWS * _FATTREE_SSWS  # fabric <-> pod-spine
+        + _FATTREE_SPINES  # pod-spine slices cover every super-spine once
+    )
+    return {
+        "pods": pods,
+        "rsws_per_pod": _FATTREE_RSWS,
+        "fsws_per_pod": _FATTREE_FSWS,
+        "ssws_per_pod": _FATTREE_SSWS,
+        "spines": _FATTREE_SPINES,
+        "nodes": pods * _FATTREE_POD + _FATTREE_SPINES,
+        "undirected_edges": pods * per_pod_edges,
+    }
+
+
+_WAN_METRO_SIZE = 16
+
+
+def _wan_params(scale: int) -> Dict[str, int]:
+    backbone = max(4, scale // 32)
+    metros = max(1, (scale - backbone) // _WAN_METRO_SIZE)
+    return {
+        "backbone": backbone,
+        "metros": metros,
+        "metro_size": _WAN_METRO_SIZE,
+        "backbone_extra": backbone,
+        "nodes": backbone + metros * _WAN_METRO_SIZE,
+        # spanning tree + chords + rings + 2 homing links per metro
+        "undirected_edges": (
+            (backbone - 1)
+            + min(
+                backbone,
+                backbone * (backbone - 1) // 2 - (backbone - 1),
+            )
+            + metros * (_WAN_METRO_SIZE + 2)
+        ),
+    }
+
+
+def _build_grid(scale: int, seed: int) -> List[Edge]:
+    del seed  # structural class: the grid is seed-invariant by design
+    return grid_edges(_grid_params(scale)["side"])
+
+
+def _build_fattree(scale: int, seed: int) -> List[Edge]:
+    del seed  # structural class: uniform-metric fabric, seed-invariant
+    return multipod_fattree_edges(
+        num_pods=_fattree_params(scale)["pods"],
+        rsws_per_pod=_FATTREE_RSWS,
+        fsws_per_pod=_FATTREE_FSWS,
+        ssws_per_pod=_FATTREE_SSWS,
+        num_spines=_FATTREE_SPINES,
+    )
+
+
+def _build_wan(scale: int, seed: int) -> List[Edge]:
+    p = _wan_params(scale)
+    return wan_hierarchy_edges(
+        num_backbone=p["backbone"],
+        num_metros=p["metros"],
+        metro_size=p["metro_size"],
+        backbone_extra=p["backbone_extra"],
+        seed=seed,
+    )
+
+
+def wan_area_of(node: str) -> str:
+    """Area assignment for the multi-area WAN variant: the backbone is
+    area "0", each metro ring its own area (gateway ring members are
+    the ABRs — their homing links live in area "0")."""
+    if node.startswith("core"):
+        return "0"
+    return "metro" + node[1:].split("_", 1)[0]
+
+
+def wan_multi_area_dbs(
+    scale: int, seed: int
+) -> Dict[str, Dict[str, AdjacencyDatabase]]:
+    """The multi-area WAN world as per-area AdjacencyDatabase maps:
+    intra-metro ring edges land in the metro's area, backbone mesh AND
+    metro-homing links in area "0" (the gateway ring nodes appear in
+    both — the ABR model the cross-area redistribution tests want)."""
+    by_area: Dict[str, List[Edge]] = {}
+    for a, b, m in _build_wan(scale, seed):
+        area_a, area_b = wan_area_of(a), wan_area_of(b)
+        area = area_a if area_a == area_b else "0"
+        by_area.setdefault(area, []).append((a, b, m))
+    return {
+        area: build_adj_dbs(edges, area=area)
+        for area, edges in sorted(by_area.items())
+    }
+
+
+@dataclass(frozen=True)
+class TopologyClass:
+    """One registered topology class.  ``build(scale, seed)`` must be
+    deterministic — the same arguments always yield the identical edge
+    list (structural classes ignore ``seed`` by design and say so in
+    their description)."""
+
+    name: str
+    description: str
+    build: Callable[[int, int], List[Edge]]
+    #: derived structural parameters for a target scale, including the
+    #: exact "nodes" and "undirected_edges" counts tests pin
+    params: Callable[[int], Dict[str, int]]
+    #: per-class publication→FIB p99 objective (virtual ms) — WAN
+    #: hierarchies tolerate more than low-diameter fabrics
+    convergence_slo_ms: float = 30_000.0
+    #: multi-area variants are exercised through per-area LSDBs (unit
+    #: tests, what-if engines), not the single-area protocol emulation
+    multi_area: bool = False
+    area_of: Optional[Callable[[str], str]] = None
+    seed_sensitive: bool = True
+
+
+TOPOLOGY_CLASSES: Dict[str, TopologyClass] = {
+    c.name: c
+    for c in (
+        TopologyClass(
+            name="grid",
+            description=(
+                "flat n x n grid (RoutingBenchmarkUtils createGrid) — "
+                "the historical bench baseline class; seed-invariant"
+            ),
+            build=_build_grid,
+            params=_grid_params,
+            convergence_slo_ms=10_000.0,
+            seed_sensitive=False,
+        ),
+        TopologyClass(
+            name="fattree_multipod",
+            description=(
+                "multi-pod fat-tree: 3-tier pods (rack/fabric/pod-"
+                "spine) joined by a super-spine layer, uniform metrics "
+                "— DC-fabric path diversity; seed-invariant"
+            ),
+            build=_build_fattree,
+            params=_fattree_params,
+            convergence_slo_ms=10_000.0,
+            seed_sensitive=False,
+        ),
+        TopologyClass(
+            name="wan_hierarchy",
+            description=(
+                "WAN hierarchy: metro rings dual-homed onto a sparse "
+                "backbone mesh with asymmetric long-haul metrics"
+            ),
+            build=_build_wan,
+            params=_wan_params,
+            convergence_slo_ms=20_000.0,
+        ),
+        TopologyClass(
+            name="wan_multi_area",
+            description=(
+                "the WAN hierarchy with areas: backbone = area 0, one "
+                "area per metro, gateway ring nodes as ABRs (per-area "
+                "LSDBs via wan_multi_area_dbs)"
+            ),
+            build=_build_wan,
+            params=_wan_params,
+            convergence_slo_ms=20_000.0,
+            multi_area=True,
+            area_of=wan_area_of,
+        ),
+    )
+}
+
+
+def topology_nodes(edges: List[Edge]) -> List[str]:
+    """Sorted distinct node names of an edge list."""
+    return sorted({n for a, b, _m in edges for n in (a, b)})
+
+
+def is_connected(edges: List[Edge]) -> bool:
+    """Union-find connectivity over the undirected edge set."""
+    parent: Dict[str, str] = {}
+
+    def find(x: str) -> str:
+        while parent.setdefault(x, x) != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b, _m in edges:
+        parent[find(a)] = find(b)
+    roots = {find(n) for n in parent}
+    return len(roots) <= 1

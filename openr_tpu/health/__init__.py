@@ -41,7 +41,12 @@ from openr_tpu.health.alerts import (
     alert_description,
     alert_severity,
 )
-from openr_tpu.health.slo import BurnRateEvaluator, SloSpec, default_slos
+from openr_tpu.health.slo import (
+    BurnRateEvaluator,
+    SloSpec,
+    default_slos,
+    slos_for_topology_class,
+)
 
 __all__ = [
     "ALERTS",
@@ -57,4 +62,5 @@ __all__ = [
     "generation_hash",
     "histogram_from_snapshot",
     "merge_fleet_histograms",
+    "slos_for_topology_class",
 ]

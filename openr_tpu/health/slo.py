@@ -79,16 +79,21 @@ class SloSpec:
         return ALERTS[self.name][0]
 
 
-def default_slos() -> Tuple[SloSpec, ...]:
+def default_slos(convergence_threshold_ms: float = 30_000.0) -> Tuple[SloSpec, ...]:
     """The built-in objective catalog (docs/Observability.md §fleet
-    health lists the rationale for each threshold)."""
+    health lists the rationale for each threshold).
+    ``convergence_threshold_ms`` lets a topology-class-aware deployment
+    tighten the convergence objective (see
+    :func:`slos_for_topology_class`)."""
     return (
         SloSpec(
             name="slo_convergence_p99",
             metric="convergence.event_to_fib_ms",
             kind=KIND_HISTOGRAM,
             percentile=99.0,
-            threshold=30_000.0,  # PAPER §1: sub-30s event->FIB even at WAN scale
+            # PAPER §1: sub-30s event->FIB even at WAN scale is the
+            # catalog ceiling; per-class defaults are tighter
+            threshold=convergence_threshold_ms,
             objective=0.05,
             fast_window_s=60.0,
             slow_window_s=300.0,
@@ -106,6 +111,20 @@ def default_slos() -> Tuple[SloSpec, ...]:
             burn_threshold=2.0,
         ),
     )
+
+
+def slos_for_topology_class(topology_class: str) -> Tuple[SloSpec, ...]:
+    """The default catalog with the convergence objective tightened to
+    the topology class's registered publication→FIB SLO
+    (emulation.topology.TOPOLOGY_CLASSES) — a low-diameter fabric is
+    held to a tighter event→FIB bound than a long-haul WAN hierarchy.
+    Unknown class names keep the 30s catalog ceiling."""
+    from openr_tpu.emulation.topology import TOPOLOGY_CLASSES
+
+    row = TOPOLOGY_CLASSES.get(topology_class)
+    if row is None:
+        return default_slos()
+    return default_slos(convergence_threshold_ms=row.convergence_slo_ms)
 
 
 @dataclass
@@ -241,6 +260,7 @@ __all__ = [
     "SloSpec",
     "BurnRateEvaluator",
     "default_slos",
+    "slos_for_topology_class",
     "KIND_HISTOGRAM",
     "KIND_COUNTER",
     "SLO_KINDS",

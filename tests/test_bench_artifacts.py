@@ -1,0 +1,97 @@
+"""Consolidated bench-artifact gates, driven by the benchtrack manifest.
+
+One parametrized suite replaces the six per-family
+``test_*_bench_schema.py`` files: for every family in
+``openr_tpu.benchtrack.manifest.MANIFEST`` the LATEST round must match
+its shared validator (the same one its bench emitter runs, so artifact
+and gate can never drift) plus its acceptance floors, and the
+family's validator must actually REJECT a minimally-spoiled document.
+
+The meta-sweep closes the orphan gap: every checked-in ``BENCH_*.json``
+must parse, match a manifest entry, and carry the
+platform/jax/device_count env stamp unless its entry explicitly
+grandfathers a pre-env-stamp capture.  Regenerate any artifact with the
+bench mode named in its manifest description.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from openr_tpu.benchtrack import run_check
+from openr_tpu.benchtrack.manifest import MANIFEST, env_triple, spec_for
+from openr_tpu.benchtrack.timeline import artifact_files, discover
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+DISC = discover(ROOT)
+
+
+def _family_params(require_spoil=False):
+    out = []
+    for spec in MANIFEST:
+        if require_spoil and (spec.spoil is None or spec.validate is None):
+            continue
+        marks = [getattr(pytest.mark, m) for m in spec.markers]
+        out.append(pytest.param(spec, id=spec.family, marks=marks))
+    return out
+
+
+@pytest.mark.parametrize("spec", _family_params())
+def test_latest_round_matches_schema_and_acceptance(spec):
+    latest = DISC.latest(spec.family)
+    assert latest is not None, (
+        f"no artifacts for family {spec.family} — either restore them "
+        "or remove the manifest entry"
+    )
+    assert latest.doc is not None, latest.parse_error
+    if spec.validate is not None:
+        spec.validate(latest.doc)
+    if spec.acceptance is not None:
+        spec.acceptance(latest.doc)
+
+
+@pytest.mark.parametrize("spec", _family_params(require_spoil=True))
+def test_validator_rejects_malformed_doc(spec):
+    latest = DISC.latest(spec.family)
+    assert latest is not None
+    doc = json.loads(latest.path.read_text())
+    spec.spoil(doc)
+    with pytest.raises((AssertionError, KeyError)):
+        spec.validate(doc)
+
+
+@pytest.mark.parametrize(
+    "name",
+    [p.name for p in artifact_files(ROOT) if p.name.startswith("BENCH_")],
+)
+def test_every_bench_artifact_parses_and_is_manifested(name):
+    """The orphan meta-sweep: parses as JSON, matches a manifest entry,
+    carries the env stamp its entry requires."""
+    hit = spec_for(name)
+    assert hit is not None, (
+        f"{name} matches no manifest entry (add an ArtifactSpec to "
+        "openr_tpu/benchtrack/manifest.py)"
+    )
+    spec, rnd = hit
+    assert rnd >= 1
+    doc = json.loads((ROOT / name).read_text())
+    if spec.requires_env:
+        triple = env_triple(doc, spec)
+        assert triple is not None, (
+            f"{name}: missing platform/jax/device_count at "
+            f"{spec.env_path}"
+        )
+        assert triple["device_count"] >= 1
+
+
+def test_no_orphan_artifacts():
+    assert DISC.orphans == [], DISC.orphans
+
+
+def test_benchtrack_check_passes_on_checked_in_artifacts():
+    """The --check gate itself must be green at HEAD: schemas, env
+    stamps, no orphans, and every ratcheted headline within tolerance
+    of its blessing (benchtrack_ratchet.json)."""
+    res = run_check(ROOT)
+    assert res.ok, json.dumps(res.problems, indent=2)

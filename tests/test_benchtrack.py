@@ -1,0 +1,205 @@
+"""benchtrack unit tests: the content-matched ratchet's one-way
+contract, orphan detection, the timeline, and the CLI surfaces.
+
+The ISSUE-10 acceptance pair lives here: ``--check`` FAILS on a
+synthetically regressed artifact (headline metric past its manifest
+tolerance) and passes again only after an explicit
+``--update-ratchet``.
+"""
+
+import json
+import pathlib
+import shutil
+
+import pytest
+
+from openr_tpu.benchtrack import (
+    load_ratchet,
+    run_check,
+    update_ratchet,
+)
+from openr_tpu.benchtrack.__main__ import main as benchtrack_main
+from openr_tpu.benchtrack.manifest import HeadlineMetric, extract
+from openr_tpu.benchtrack.timeline import build_timeline, render_timeline
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+#: a small virtual-time artifact (deterministic, 15% ratchet tolerance)
+CONV = "BENCH_CONVERGENCE_r01.json"
+RESIL = "BENCH_RESILIENCE_r01.json"
+
+
+@pytest.fixture
+def mini_root(tmp_path):
+    """A miniature artifact root: two real families + a fresh ratchet
+    blessing, isolated from the repo's own ratchet file."""
+    for name in (CONV, RESIL):
+        shutil.copy(REPO / name, tmp_path / name)
+    update_ratchet(tmp_path)
+    return tmp_path
+
+
+def _write_regressed_round(root, factor=3.0):
+    """A schema-VALID convergence round r02 whose p50 regressed by
+    ``factor`` — the ratchet, not the validator, must catch it."""
+    doc = json.loads((root / CONV).read_text())
+    d = doc["detail"]
+    for key in ("p50_ms", "p95_ms", "p99_ms", "max_ms"):
+        d[key] = round(d[key] * factor, 2)
+    doc["value"] = d["p50_ms"]
+    path = root / "BENCH_CONVERGENCE_r02.json"
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def test_headline_metric_bounds():
+    lower = HeadlineMetric("value", "lower", tolerance_pct=10.0)
+    assert not lower.regressed(100.0, 109.0)
+    assert lower.regressed(100.0, 111.0)
+    assert lower.improved(100.0, 90.0)
+    higher = HeadlineMetric("value", "higher", tolerance_abs=5.0)
+    assert not higher.regressed(100.0, 96.0)
+    assert higher.regressed(100.0, 94.0)
+    with pytest.raises(ValueError):
+        HeadlineMetric("value", "sideways")
+
+
+def test_extract_dotted_paths():
+    doc = {"a": {"b": [{"c": 7}]}}
+    assert extract(doc, "a.b.0.c") == 7
+    with pytest.raises(KeyError):
+        extract(doc, "a.x")
+
+
+def test_check_green_on_blessed_mini_root(mini_root):
+    res = run_check(mini_root)
+    assert res.ok, res.problems
+    assert res.families_checked == 2
+    assert not res.improvements
+
+
+def test_check_fails_on_regressed_round_then_passes_after_update(
+    mini_root,
+):
+    """THE acceptance pair: a new round regressing a ratcheted headline
+    past tolerance fails --check; --update-ratchet (the deliberate
+    re-blessing) makes it pass again."""
+    _write_regressed_round(mini_root)
+    res = run_check(mini_root)
+    assert not res.ok
+    kinds = {p["kind"] for p in res.problems}
+    assert kinds == {"regression"}, res.problems
+    [prob] = res.problems
+    assert prob["family"] == "convergence"
+    assert prob["current"] > prob["bound"] > prob["blessed"]
+    update_ratchet(mini_root)
+    res = run_check(mini_root)
+    assert res.ok, res.problems
+
+
+def test_improvement_passes_but_does_not_move_ratchet(mini_root):
+    doc = json.loads((mini_root / CONV).read_text())
+    d = doc["detail"]
+    for key in ("p50_ms", "p95_ms", "p99_ms", "max_ms"):
+        d[key] = round(d[key] / 2.0, 2)
+    doc["value"] = d["p50_ms"]
+    (mini_root / "BENCH_CONVERGENCE_r02.json").write_text(json.dumps(doc))
+    res = run_check(mini_root)
+    assert res.ok
+    assert any(
+        i["family"] == "convergence" for i in res.improvements
+    ), "an improvement should be reported, pending --update-ratchet"
+    blessed = {
+        (e["family"], e["metric"]): e["value"]
+        for e in load_ratchet(mini_root)["entries"]
+    }
+    assert blessed[("convergence", "value")] == json.loads(
+        (REPO / CONV).read_text()
+    )["value"], "the blessing must only move via --update-ratchet"
+
+
+def test_content_drift_of_blessed_artifact_fails(mini_root):
+    """Editing the blessed artifact in place — even WITHOUT regressing
+    the headline — breaks the content match."""
+    doc = json.loads((mini_root / CONV).read_text())
+    doc["detail"]["note"] = "quietly rewritten"
+    (mini_root / CONV).write_text(json.dumps(doc))
+    res = run_check(mini_root)
+    assert not res.ok
+    assert any(p["kind"] == "content_drift" for p in res.problems), (
+        res.problems
+    )
+
+
+def test_missing_blessing_fails(mini_root):
+    (mini_root / "benchtrack_ratchet.json").unlink()
+    res = run_check(mini_root)
+    assert not res.ok
+    assert {p["kind"] for p in res.problems} == {"ratchet_missing"}
+
+
+def test_stale_blessing_fails(mini_root):
+    """Blessings for artifacts that vanished are dead weight the check
+    forces out (the orlint stale-baseline contract)."""
+    for path in mini_root.glob("BENCH_CONVERGENCE_*.json"):
+        path.unlink()
+    res = run_check(mini_root)
+    assert not res.ok
+    assert any(p["kind"] == "stale" for p in res.problems), res.problems
+
+
+def test_orphan_artifact_fails(mini_root):
+    (mini_root / "BENCH_BOGUS_r01.json").write_text("{}")
+    res = run_check(mini_root)
+    assert not res.ok
+    assert any(p["kind"] == "orphan" for p in res.problems)
+
+
+def test_unparseable_artifact_fails(mini_root):
+    (mini_root / "BENCH_CONVERGENCE_r02.json").write_text("{nope")
+    res = run_check(mini_root)
+    assert not res.ok
+    assert any(p["kind"] == "invalid" for p in res.problems)
+
+
+def test_env_stamp_required_by_manifest(mini_root):
+    doc = json.loads((mini_root / CONV).read_text())
+    del doc["detail"]["env"]["platform"]
+    (mini_root / "BENCH_CONVERGENCE_r02.json").write_text(json.dumps(doc))
+    res = run_check(mini_root)
+    assert any(p["kind"] == "env_missing" for p in res.problems), (
+        res.problems
+    )
+
+
+def test_timeline_rounds_and_deltas(mini_root):
+    _write_regressed_round(mini_root, factor=2.0)
+    tl = build_timeline(mini_root)
+    conv = tl["families"]["convergence"]
+    assert [r["round"] for r in conv["rounds"]] == [1, 2]
+    delta = conv["rounds"][1]["deltas"]["value"]
+    assert delta["pct"] == pytest.approx(100.0, abs=1.0)
+    assert delta["better"] is False
+    text = render_timeline(tl)
+    assert "convergence" in text and "WORSE" in text
+    assert "value [lower is better, ratcheted]" in text
+
+
+def test_cli_check_report_update(mini_root, capsys):
+    root = str(mini_root)
+    assert benchtrack_main(["--check", "--root", root]) == 0
+    _write_regressed_round(mini_root)
+    assert benchtrack_main(["--check", "--root", root]) == 1
+    out = capsys.readouterr().out
+    assert "regression" in out
+    assert (
+        benchtrack_main(["--update-ratchet", "--root", root]) == 0
+    )
+    assert "blessed" in capsys.readouterr().out
+    assert (
+        benchtrack_main(["--check", "--format", "json", "--root", root])
+        == 0
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True
+    assert benchtrack_main(["--report", "--root", root]) == 0
+    assert "convergence" in capsys.readouterr().out

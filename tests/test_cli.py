@@ -293,6 +293,22 @@ def test_cli_health_status_alerts_slo(live_node):
     assert {r["node"] for r in cached["nodes"]} == {"node0", "node1"}
 
 
+def test_cli_monitor_trajectory(live_node):
+    """breeze monitor trajectory: the benchtrack timeline over the
+    checked-in artifacts, served by ctrl get_bench_trajectory, with the
+    ratchet verdict appended."""
+    doc = json.loads(_run(live_node, "monitor", "trajectory", "--json"))
+    assert "families" in doc and "check" in doc
+    assert doc["orphans"] == []
+    conv = doc["families"]["convergence"]
+    assert conv["rounds"] and conv["rounds"][0]["round"] == 1
+    assert conv["ratcheted"] == ["value"]
+    assert doc["check"]["ok"] is True, doc["check"]["problems"]
+    human = _run(live_node, "monitor", "trajectory")
+    assert "ratchet check: OK" in human
+    assert "convergence" in human and "ratcheted" in human
+
+
 def test_cli_resilience_status_scalar_node(live_node):
     """breeze resilience status on a scalar deployment: no device
     governor, but the FIB agent breaker is always reported."""

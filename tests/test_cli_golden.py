@@ -521,3 +521,14 @@ def test_golden_health_alerts(live_fleet_node):
 
 def test_golden_health_slo(live_fleet_node):
     check_golden("health_slo", live_fleet_node, "health", "slo")
+
+
+# ISSUE 10: the benchtrack trajectory render (numbers canonicalized, so
+# the golden pins the SHAPE: families, ratcheted metrics, round trail,
+# check verdict — not the values, which move with artifact rounds)
+
+
+def test_golden_monitor_trajectory(live_node):
+    check_golden(
+        "monitor_trajectory", live_node, "monitor", "trajectory"
+    )

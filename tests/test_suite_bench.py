@@ -1,0 +1,85 @@
+"""Trajectory suite sweep: the tier-1 256-node smoke variant, the
+byte-identical replay contract, and the slow-marked full-scale sweep.
+
+The checked-in BENCH_TRAJECTORY artifact is schema-gated through the
+benchtrack manifest (tests/test_bench_artifacts.py); these tests prove
+the SWEEP itself live — a seeded chaos flap/drain run through the
+SimClock emulation converges, scores only chaos-driven samples, fires
+zero unexpected alerts, warm-starts every perturbation tick, and
+replays byte for byte from one seed.
+"""
+
+import pytest
+
+import bench
+
+pytestmark = [pytest.mark.chaos]
+
+
+def test_smoke_suite_sweep_256_grid():
+    """The tier-1 smoke variant: the grid class at 256 nodes (the
+    full-scale 1k+ sweeps are `slow`)."""
+    detail, fingerprint = bench.suite_sweep_class(
+        "grid",
+        bench.SUITE_SMOKE_SCALE,
+        bench.SUITE_SEED,
+        flaps=4,
+        drains=1,
+        phase_shares=False,
+    )
+    assert detail["nodes"] == 256
+    conv = detail["convergence"]
+    assert conv["samples"] > 0
+    assert 0 < conv["p50_ms"] <= conv["p95_ms"] <= conv["p99_ms"]
+    assert conv["p99_ms"] <= detail["slo"]["convergence_slo_ms"]
+    assert detail["slo"]["p99_within_slo"] is True
+    # every flap/drain tick must take the warm generation-delta path
+    assert detail["warm"]["hits"] >= 1
+    assert detail["warm"]["hit_ratio"] == 1.0
+    assert detail["warm"]["cold_fallbacks"] == 0
+    # chaos-clean fidelity: a flap/drain sweep on a path-redundant
+    # class fires NO health alerts
+    assert detail["alerts"]["unexpected"] == 0
+    assert detail["alerts"]["health_sweeps"] >= 1
+    assert fingerprint
+
+
+def test_smoke_replay_byte_identical():
+    """SimClock determinism: two sweeps from one seed produce the
+    identical fingerprint (alert JSONL + chaos counter dump +
+    convergence histogram buckets) AND the identical detail block."""
+    runs = [
+        bench.suite_sweep_class(
+            "grid", 64, 11, flaps=3, drains=1, phase_shares=False
+        )
+        for _ in range(2)
+    ]
+    assert runs[0][1] == runs[1][1]
+    assert runs[0][0] == runs[1][0]
+
+
+def test_distinct_seeds_change_the_sweep():
+    """The seed is load-bearing: a different seed must pick a
+    different flap/drain schedule (fingerprints diverge)."""
+    a = bench.suite_sweep_class(
+        "grid", 64, 11, flaps=3, drains=1, phase_shares=False
+    )
+    b = bench.suite_sweep_class(
+        "grid", 64, 12, flaps=3, drains=1, phase_shares=False
+    )
+    assert a[1] != b[1]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("cls", bench.SUITE_CLASSES)
+def test_full_scale_suite_sweep(cls):
+    """The 1k+-node per-class sweep the checked-in artifact records —
+    hours-class on a loaded host, hence `slow`."""
+    detail, _fp = bench.suite_sweep_class(
+        cls, bench.SUITE_FULL_SCALE, bench.SUITE_SEED
+    )
+    assert detail["nodes"] >= bench.SUITE_MIN_FULL_NODES
+    assert detail["convergence"]["samples"] > 0
+    assert detail["alerts"]["unexpected"] == 0
+    assert detail["warm"]["hit_ratio"] >= 0.9
+    assert detail["pipeline_phase_share_pct"]

@@ -1,0 +1,156 @@
+"""Topology-class catalog: determinism + structural invariants.
+
+Every registered class must (a) be byte-deterministic from
+``(class, scale, seed)`` — the trajectory bench's replay contract rides
+on it, (b) produce exactly the node/edge counts its ``params`` table
+derives, (c) be connected, and (d) hold class-specific shape
+invariants (bisection sanity: cutting the joining layer actually
+severs the hierarchy it joins).
+"""
+
+import pytest
+
+from openr_tpu.emulation.topology import (
+    TOPOLOGY_CLASSES,
+    build_adj_dbs,
+    is_connected,
+    multipod_fattree_edges,
+    topology_nodes,
+    wan_area_of,
+    wan_hierarchy_edges,
+    wan_multi_area_dbs,
+)
+
+SCALES = (64, 256)
+
+
+def undirected(edges):
+    return {frozenset((a, b)) for a, b, _m in edges}
+
+
+@pytest.mark.parametrize("name", sorted(TOPOLOGY_CLASSES))
+@pytest.mark.parametrize("scale", SCALES)
+def test_same_seed_identical_edge_list(name, scale):
+    row = TOPOLOGY_CLASSES[name]
+    assert row.build(scale, 7) == row.build(scale, 7)
+    if row.seed_sensitive:
+        # a different seed must actually reshuffle a seeded class
+        assert row.build(scale, 7) != row.build(scale, 8)
+    else:
+        # structural classes document seed-invariance — hold them to it
+        assert row.build(scale, 7) == row.build(scale, 8)
+
+
+@pytest.mark.parametrize("name", sorted(TOPOLOGY_CLASSES))
+@pytest.mark.parametrize("scale", SCALES)
+def test_node_edge_counts_match_params(name, scale):
+    row = TOPOLOGY_CLASSES[name]
+    edges = row.build(scale, 7)
+    p = row.params(scale)
+    assert len(topology_nodes(edges)) == p["nodes"]
+    assert len(undirected(edges)) == p["undirected_edges"]
+    # the class must land in the scale's ballpark, not a token graph
+    assert p["nodes"] >= scale * 0.75
+
+
+@pytest.mark.parametrize("name", sorted(TOPOLOGY_CLASSES))
+def test_connected(name):
+    row = TOPOLOGY_CLASSES[name]
+    assert is_connected(row.build(SCALES[0], 7))
+
+
+def test_fattree_bisection_and_tiers():
+    """Cutting every super-spine must disconnect pods from each other
+    (the super-spine layer IS the inter-pod bisection), and each tier
+    must have the full bipartite degree the pod design promises."""
+    edges = multipod_fattree_edges(
+        num_pods=3, rsws_per_pod=4, fsws_per_pod=2, ssws_per_pod=2,
+        num_spines=4,
+    )
+    assert is_connected(edges)
+    no_spine = [
+        (a, b, m)
+        for a, b, m in edges
+        if not a.startswith("spine") and not b.startswith("spine")
+    ]
+    pod0 = [e for e in no_spine if e[0].startswith(("rsw0", "fsw0", "ssw0"))]
+    assert not is_connected(no_spine), "pods must only join via spines"
+    assert is_connected(pod0), "a pod must stay internally connected"
+    deg = {}
+    for a, b, _m in edges:
+        deg[a] = deg.get(a, 0) + 1
+        deg[b] = deg.get(b, 0) + 1
+    for p in range(3):
+        for r in range(4):
+            assert deg[f"rsw{p}_{r}"] == 2  # one uplink per pod fsw
+        for f in range(2):
+            assert deg[f"fsw{p}_{f}"] == 4 + 2  # racks below + spines up
+    for k in range(4):
+        assert deg[f"spine{k}"] == 3  # one pod-spine per pod
+
+
+def test_wan_hierarchy_shape_and_asymmetry():
+    edges = wan_hierarchy_edges(
+        num_backbone=8, num_metros=4, metro_size=6, backbone_extra=4,
+        seed=11,
+    )
+    assert is_connected(edges)
+    # long-haul metrics are drawn per direction: at least one backbone
+    # pair must come out asymmetric at this size
+    directed = {(a, b): m for a, b, m in edges}
+    core_pairs = [
+        (a, b)
+        for (a, b) in directed
+        if a.startswith("core") and b.startswith("core")
+    ]
+    assert core_pairs
+    assert any(
+        directed[(a, b)] != directed.get((b, a), directed[(a, b)])
+        for a, b in core_pairs
+    ), "backbone metrics should be asymmetric"
+    # every metro dual-homes: removing the backbone leaves each ring
+    # intact but disconnects metros from each other
+    no_core = [
+        (a, b, m)
+        for a, b, m in edges
+        if not a.startswith("core") and not b.startswith("core")
+    ]
+    assert not is_connected(no_core)
+    m0 = [e for e in no_core if e[0].startswith("m0_")]
+    assert is_connected(m0), "a metro ring must stay internally connected"
+    for m in range(4):
+        homing = [
+            (a, b)
+            for a, b, _ in edges
+            if a.startswith(f"m{m}_") and b.startswith("core")
+        ]
+        assert len({b for _a, b in homing}) == 2, (
+            f"metro {m} must dual-home onto two distinct cores"
+        )
+
+
+def test_wan_multi_area_dbs_are_abr_shaped():
+    dbs = wan_multi_area_dbs(128, seed=7)
+    assert "0" in dbs and len(dbs) >= 2
+    p = TOPOLOGY_CLASSES["wan_multi_area"].params(128)
+    assert len([a for a in dbs if a.startswith("metro")]) == p["metros"]
+    for area, area_dbs in dbs.items():
+        for name, db in area_dbs.items():
+            assert db.area == area
+        if not area.startswith("metro"):
+            continue
+        # exactly the ring members, and the two gateways also speak
+        # area 0 (the ABR contract)
+        members = set(area_dbs)
+        assert all(wan_area_of(n) == area for n in members)
+        gateways = members & set(dbs["0"])
+        assert len(gateways) == 2, (area, sorted(gateways))
+
+
+def test_adj_dbs_build_from_every_class():
+    """build_adj_dbs accepts every class's edge list (asymmetric WAN
+    entries included) and yields one db per node."""
+    for name, row in TOPOLOGY_CLASSES.items():
+        edges = row.build(64, 7)
+        dbs = build_adj_dbs(edges)
+        assert set(dbs) == set(topology_nodes(edges)), name
