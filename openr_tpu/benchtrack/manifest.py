@@ -352,12 +352,24 @@ MANIFEST: Tuple[ArtifactSpec, ...] = (
         pattern=r"BENCH_PIPELINE_r(\d+)\.json",
         description=(
             "phase-level attribution of the grid4096 rebuild: the "
-            "unattributed-gap headline (bench.py --pipeline)"
+            "unattributed-gap headline plus the rebuild walls the "
+            "streamed pipeline is gated on (bench.py --pipeline)"
         ),
         validate=_v("pipeline"),
         headline=(
             # the gap lives near zero: judge it on absolute points
             HeadlineMetric("value", LOWER, tolerance_abs=5.0),
+            # the ISSUE-11 wall gates: the 3-rebuild wall at 1 and 8
+            # devices (r01: 1721ms / 1885ms; the streamed + dense-SPF
+            # pipeline must never regress toward the dispatch-sync era)
+            HeadlineMetric(
+                "detail.rebuild_rounds.0.wall_ms", LOWER,
+                tolerance_pct=30.0,
+            ),
+            HeadlineMetric(
+                "detail.rebuild_rounds.1.wall_ms", LOWER,
+                tolerance_pct=30.0,
+            ),
         ),
         markers=("multichip",),
         spoil=_spoil_pipeline,

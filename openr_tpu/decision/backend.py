@@ -54,6 +54,18 @@ ROWSEL_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144)
 #: frontier's in-edge count, padded so the jit cache stays stable)
 SUB_EDGE_BUCKETS = (1024, 8192, 65536, 524288)
 
+#: in-flight dispatch slots per chip in the streamed double-buffer
+#: loops: shard N+1's pad/transfer overlaps shard N's solve, but no
+#: chip ever queues more than this many undrained dispatches — the
+#: DevicePool in-flight ledger enforces it per chip, so a committed
+#: dispatch never waits on an UNRELATED chip's backlog
+STREAM_SLOTS = 2
+
+#: delta-fetch cutover: when more than this fraction of a shard's rows
+#: changed, the compacted gather stops paying for itself (two fetch
+#: rounds + gather dispatch vs one full fetch) — fetch the full shard
+DELTA_FETCH_MAX_FRACTION = 0.5
+
 
 def measure_dispatch_rt_ms() -> float:
     """Median device dispatch round trip (ms): one tiny op, blocked.
@@ -418,6 +430,36 @@ class TpuBackend(DecisionBackend):
         #: one-shot: set when a quarantine swap makes the whole previous
         #: RouteDb suspect (see DecisionBackend.take_full_replace)
         self._full_replace = False
+        #: on-device generation-delta context for COLD/full rebuilds
+        #: (the warm-start take_last_changed_prefixes pattern extended
+        #: to the full-build path): the previous full build's selection
+        #: outputs stay device-resident per shard, the next full build
+        #: runs the fused select+diff kernel, and only changed rows
+        #: cross the host boundary.  Purged with the warm context on any
+        #: suspicion event, and dropped whenever a build is not a
+        #: full-table build (incremental/warm-selective patches make the
+        #: resident outputs stale for their rows).
+        self._prev_sel = None
+        #: probe chip of the most recent full-dispatch plan (a failed
+        #: probe shard must not mid-stream re-pack — the whole build
+        #: falls back so the governor scores the probe)
+        self._plan_probe = None
+        #: per-shard device outputs of the stream in progress (set by
+        #: `_stream_row_shards` on clean completion, consumed by
+        #: `_retain_prev_sel`)
+        self._stream_outs = None
+        #: test seams for the streamed dispatcher: `_stream_pick`
+        #: overrides completion-order selection (fn(pending) -> index)
+        #: so reassembly is provably order-independent;  `_stream_fault`
+        #: (fn(device_index), called inside the drain's try block)
+        #: injects a mid-stream chip failure — both None in production
+        self._stream_pick = None
+        self._stream_fault = None
+        self.num_stream_builds = 0
+        self.num_stream_repacks = 0
+        self.num_delta_builds = 0
+        self.num_delta_rows_fetched = 0
+        self.num_delta_rows_skipped = 0
 
     def build_route_db(
         self,
@@ -568,6 +610,9 @@ class TpuBackend(DecisionBackend):
             self._spf_enc = None
             self._spf_degree = None
             self._spf_replicas = {}
+            # the full-build delta context is device residency too: a
+            # suspect device must not vouch for "row unchanged"
+            self._prev_sel = None
         if self._warm_ctx is None and self._warm_changed_nodes is None:
             return
         self._warm_ctx = None
@@ -750,6 +795,21 @@ class TpuBackend(DecisionBackend):
             "decision.backend.warm_last_reset_nodes": float(
                 self.warm_last_reset_nodes
             ),
+            # streamed-pipeline + on-device delta-extraction telemetry
+            # (ISSUE 11): delta_rows_skipped / (fetched + skipped) is
+            # the fraction of the route table that never crossed the
+            # host boundary on full rebuilds
+            "decision.backend.stream_builds": float(self.num_stream_builds),
+            "decision.backend.stream_repacks": float(
+                self.num_stream_repacks
+            ),
+            "decision.backend.delta_builds": float(self.num_delta_builds),
+            "decision.backend.delta_rows_fetched": float(
+                self.num_delta_rows_fetched
+            ),
+            "decision.backend.delta_rows_skipped": float(
+                self.num_delta_rows_skipped
+            ),
         }
         if self._pool is not None:
             # only report pool gauges once the pool actually exists — a
@@ -785,6 +845,7 @@ class TpuBackend(DecisionBackend):
         self._last_db = None
         self._table_synced = False
         self._attr_table = None  # nothing device-computed to attribute
+        self._prev_sel = None  # resident outputs no longer match _last_db
         return self.solver.build_route_db(area_link_states, prefix_state)
 
     # -- encoding (cached across prefix-churn rebuilds) --------------------
@@ -883,19 +944,45 @@ class TpuBackend(DecisionBackend):
             # warm-hit ratio reflects reality
             self._warm_fallback("unclassified")
         if dist is None:
-            with self.probe.phase(pipeline.TRANSFER):
-                args = (
-                    jnp.asarray(enc.src),
-                    jnp.asarray(enc.dst),
-                    jnp.asarray(enc.w),
-                    jnp.asarray(enc.edge_ok),
-                    jnp.asarray(enc.overloaded),
-                    jnp.asarray(enc.roots),
+            if enc.has_dense:
+                # dense in-edge gather formulation: the cold fixpoints
+                # run without scatter (the segment loops were ~95% of a
+                # grid4096 cold rebuild wall on host platforms, hiding
+                # inside the device_get barrier — BENCH_PIPELINE_r01)
+                from openr_tpu.ops.route_select import (
+                    multi_area_spf_tables_dense,
                 )
-            with self.probe.phase(pipeline.DEVICE_COMPUTE):
-                dist, nh = call_jit_guarded(
-                    multi_area_spf_tables, *args, max_degree=max_degree
-                )
+
+                with self.probe.phase(pipeline.TRANSFER):
+                    args = (
+                        jnp.asarray(enc.in_src),
+                        jnp.asarray(enc.in_w),
+                        jnp.asarray(enc.in_ok),
+                        jnp.asarray(enc.in_rank),
+                        jnp.asarray(enc.in_has),
+                        jnp.asarray(enc.overloaded),
+                        jnp.asarray(enc.roots),
+                    )
+                with self.probe.phase(pipeline.DEVICE_COMPUTE):
+                    dist, nh = call_jit_guarded(
+                        multi_area_spf_tables_dense,
+                        *args,
+                        max_degree=max_degree,
+                    )
+            else:
+                with self.probe.phase(pipeline.TRANSFER):
+                    args = (
+                        jnp.asarray(enc.src),
+                        jnp.asarray(enc.dst),
+                        jnp.asarray(enc.w),
+                        jnp.asarray(enc.edge_ok),
+                        jnp.asarray(enc.overloaded),
+                        jnp.asarray(enc.roots),
+                    )
+                with self.probe.phase(pipeline.DEVICE_COMPUTE):
+                    dist, nh = call_jit_guarded(
+                        multi_area_spf_tables, *args, max_degree=max_degree
+                    )
         # keep soft/overloaded device-resident alongside (selection inputs)
         with self.probe.phase(pipeline.TRANSFER):
             soft = jnp.asarray(enc.soft)
@@ -1138,18 +1225,20 @@ class TpuBackend(DecisionBackend):
 
     def _plan_full_dispatch(self, n_rows: int, n_active: int):
         """Shard plan [(device, row_lo, row_hi)] for a full selection
-        batch, or None for the legacy single-dispatch path (one visible
-        device / parallel disabled).  Boundaries split the ACTIVE row
-        range (rows actually holding prefixes) evenly — prefixes fill
-        the candidate table head-first, so splitting raw bucket
-        capacity would hand real work to the lead chips and dead
-        padding to the rest; the dead tail rides the last shard.
-        `min_shard_rows` collapses tiny batches onto the lead chip —
-        dispatch overhead and per-shape compiles dominate below it —
-        but an armed probe chip always keeps a shard (the probe must
-        actually exercise the chip)."""
+        batch.  Boundaries split the ACTIVE row range (rows actually
+        holding prefixes) evenly — prefixes fill the candidate table
+        head-first, so splitting raw bucket capacity would hand real
+        work to the lead chips and dead padding to the rest; the dead
+        tail rides the last shard.  `min_shard_rows` collapses tiny
+        batches onto the lead chip — dispatch overhead and per-shape
+        compiles dominate below it — but an armed probe chip always
+        keeps a shard (the probe must actually exercise the chip).
+        Single-chip pools plan ONE shard on the lead chip, so every
+        full build flows through the same streamed dispatcher."""
+        self._plan_probe = None
         if not self._use_pool():
-            return None
+            lead = self.pool.lead_index()
+            return [(lead if lead is not None else 0, 0, n_rows)]
         devices, probe = self._dispatch_device_set()
         msr = self._min_shard_rows
         if msr > 0 and len(devices) > 1:
@@ -1166,6 +1255,7 @@ class TpuBackend(DecisionBackend):
         plan[-1] = (dev, lo, n_rows)
         if self.governor is not None:
             self.governor.confirm_plan([d for d, _lo, _hi in plan])
+        self._plan_probe = probe
         return plan
 
     def _replicated_tables(self, dev_index: int, tables: tuple) -> tuple:
@@ -1196,18 +1286,52 @@ class TpuBackend(DecisionBackend):
         self._spf_replicas[dev_index] = (tables, rep)
         return rep
 
-    def _dispatch_row_shards(self, dv, tables, per_area, plan):
-        """Dispatch the selection kernel once per planned shard, each a
-        COMMITTED computation on its own chip so every output row is
-        attributable to exactly one device, then fetch all shards with
-        ONE blocking device_get and reassemble in row order.  Shards
-        pad to a common row count so the jit cache sees one shape per
-        plan size; pad rows carry cand_ok=False and decode to nothing."""
+    def _stream_row_shards(self, dv, tables, per_area, plan, delta_ctx):
+        """Streamed, double-buffered shard dispatch — the replacement
+        for the old dispatch-all-then-ONE-blocking-device_get barrier
+        that BENCH_PIPELINE_r01 indicted (device_get ~1.5s of a ~1.7s
+        grid4096 wall).
+
+        * **double buffer**: shard N+1's pad/transfer/dispatch runs
+          while shard N solves (dispatches are async); the DevicePool
+          in-flight ledger caps undrained work per chip at STREAM_SLOTS
+          so a committed dispatch never queues behind — or waits on —
+          an unrelated chip.
+        * **streamed completion**: shards drain one at a time in
+          COMPLETION order (``is_ready`` poll, then a per-shard
+          ``stream_drain`` wait charged ONLY to the completing chip);
+          the caller decodes each shard while the rest still solve.
+        * **on-device delta extraction** (``delta_ctx``): the fused
+          select+diff kernel compares this generation's outputs against
+          the previous build's device-resident outputs; only the
+          changed-row mask and a compacted gather of changed rows cross
+          the host boundary (``device_select`` phase) — full tables are
+          fetched only when most of a shard moved.
+        * **mid-stream re-pack**: a shard failing at drain time
+          quarantines ITS chip (``governor.record_stream_failure``) and
+          re-dispatches exactly its row range onto the lead survivor —
+          no rows dropped, none duplicated; a failing PROBE shard
+          raises instead (the whole build falls back so the governor
+          scores the probe).
+
+        Yields per-shard dicts in completion order:
+        ``{"dev", "lo", "hi", "use", "shortest", "lanes", "valid",
+        "rows"}`` — ``rows`` is None on a full fetch (arrays cover the
+        whole shard) or the LOCAL changed-row indices (arrays compacted
+        to that order).  Each shard pads to a common row count so the
+        jit cache sees one shape per plan size; pad rows carry
+        cand_ok=False and decode to nothing."""
         import jax
+        import jax.numpy as jnp
 
         from openr_tpu.ops import jit_guard
+        from openr_tpu.ops.csr import bucket_for
         from openr_tpu.ops.jit_guard import call_jit_guarded
-        from openr_tpu.ops.route_select import multi_area_select_from_tables
+        from openr_tpu.ops.route_select import (
+            gather_selection_rows,
+            multi_area_select_delta_from_tables,
+            multi_area_select_from_tables,
+        )
         from openr_tpu.tracing import pipeline
 
         width = max(hi - lo for _d, lo, hi in plan)
@@ -1220,8 +1344,7 @@ class TpuBackend(DecisionBackend):
             out[hi - lo :] = a[lo]
             return out
 
-        dispatched = []
-        for dev_index, lo, hi in plan:
+        def dispatch(dev_index, lo, hi, use_delta):
             dev = self.pool.device(dev_index)
             td, tn, to, ts = self._replicated_tables(dev_index, tables)
             with self.probe.phase(pipeline.PAD_PACK, device=dev_index):
@@ -1243,42 +1366,216 @@ class TpuBackend(DecisionBackend):
                 shard_args = tuple(
                     jax.device_put(a, dev) for a in padded
                 )
+                if use_delta:
+                    nc_dev = jax.device_put(
+                        delta_ctx["node_changed"], dev
+                    )
             # a COMMITTED computation on its own chip: the kernel span
             # and the phase sample both carry the device, so a wrong
             # output row and a slow dispatch attribute to the same chip
             with self.probe.phase(
                 pipeline.DEVICE_COMPUTE, device=dev_index
             ), jit_guard.dispatch_device(dev_index):
-                out = call_jit_guarded(
-                    multi_area_select_from_tables,
-                    td,
-                    tn,
-                    to,
-                    ts,
-                    *shard_args,
-                    per_area_distance=per_area,
-                )
-            self.pool.note_dispatch(dev_index)
-            dispatched.append((dev_index, hi - lo, out))
-        # every shard dispatched async above; ONE blocking fetch drains
-        # them all (the same single-round-trip rule the unsharded path
-        # follows)
-        with self.probe.phase(
-            pipeline.DEVICE_GET,
-            devices=[d for d, _n, _o in dispatched],
-        ):
-            fetched = jax.device_get([o for _d, _n, o in dispatched])
-        parts = {k: [] for k in range(4)}
-        for (dev_index, n, _), outs in zip(dispatched, fetched):
-            u, s, l, v = (o[:n] for o in outs)
+                if use_delta:
+                    u, s, l, v, ch = call_jit_guarded(
+                        multi_area_select_delta_from_tables,
+                        td,
+                        tn,
+                        to,
+                        ts,
+                        *shard_args,
+                        *delta_ctx["shards"][(dev_index, lo, hi)],
+                        nc_dev,
+                        per_area_distance=per_area,
+                    )
+                    outs, ch = (u, s, l, v), ch
+                else:
+                    outs = call_jit_guarded(
+                        multi_area_select_from_tables,
+                        td,
+                        tn,
+                        to,
+                        ts,
+                        *shard_args,
+                        per_area_distance=per_area,
+                    )
+                    ch = None
+            self.pool.note_inflight(dev_index)
+            # start the device->host copy of whatever the drain will
+            # read FIRST (the tiny changed mask on delta shards, the
+            # full outputs otherwise): a streamed completion's bytes
+            # are in flight before the host ever blocks on them
+            for o in (ch,) if ch is not None else outs:
+                o.copy_to_host_async()
+            return {
+                "dev": dev_index,
+                "lo": lo,
+                "hi": hi,
+                "outs": outs,
+                "ch": ch,
+            }
+
+        def full_fetch(rec):
+            dev_index = rec["dev"]
+            n = rec["hi"] - rec["lo"]
+            with self.probe.phase(pipeline.DEVICE_GET, device=dev_index):
+                u, s, l, v = jax.device_get(rec["outs"])
+            u, s, l, v = u[:n], s[:n], l[:n], v[:n]
             if self._sdc_active_for(dev_index):
                 # per-chip silent corruption: only THIS chip's rows lie
                 s = self._corrupt_metrics(s)
-            for k, o in enumerate((u, s, l, v)):
-                parts[k].append(o)
-        return tuple(
-            np.concatenate(parts[k], axis=0) for k in range(4)
-        )
+            return u, s, l, v
+
+        def drain(rec, allow_repack=True):
+            dev_index = rec["dev"]
+            watch = (rec["ch"],) if rec["ch"] is not None else rec["outs"]
+            try:
+                # the wait window charges ONLY the completing chip —
+                # never the other in-flight chips (honest utilization
+                # under overlap; the r01 mode note documented the old
+                # barrier's overcount)
+                with self.probe.phase(
+                    pipeline.STREAM_DRAIN, device=dev_index
+                ):
+                    if self._stream_fault is not None:
+                        self._stream_fault(dev_index)
+                    for o in watch:
+                        o.block_until_ready()
+            except Exception as e:  # noqa: BLE001 - chip failure mid-stream
+                self.pool.note_complete(dev_index)
+                self.num_dispatch_errors += 1
+                gov = self.governor
+                if (
+                    not allow_repack
+                    or gov is None
+                    or dev_index == self._plan_probe
+                ):
+                    raise
+                gov.record_stream_failure(dev_index, e)
+                survivors = [
+                    d
+                    for d in self.pool.healthy_indices()
+                    if d != dev_index
+                ]
+                if not survivors:
+                    raise
+                # re-pack EXACTLY this shard's row range onto the lead
+                # survivor and resume the stream: no rows dropped, none
+                # duplicated.  The quarantine purged the delta context,
+                # so the retry always full-fetches.
+                self.num_stream_repacks += 1
+                redo = dispatch(
+                    survivors[0], rec["lo"], rec["hi"], use_delta=False
+                )
+                return drain(redo, allow_repack=False)
+            self.pool.note_complete(dev_index)
+            n = rec["hi"] - rec["lo"]
+            out = {"dev": dev_index, "lo": rec["lo"], "hi": rec["hi"]}
+            if rec["ch"] is None:
+                u, s, l, v = full_fetch(rec)
+                out.update(
+                    use=u, shortest=s, lanes=l, valid=v, rows=None
+                )
+                return out
+            # delta shard: fetch the tiny changed mask, then move ONLY
+            # the changed rows (plus host-forced churn rows) across the
+            # boundary — compacted when few, full when most moved
+            with self.probe.phase(pipeline.DEVICE_GET, device=dev_index):
+                ch = np.asarray(jax.device_get(rec["ch"]))[:n]
+            rows = np.nonzero(ch)[0]
+            force = delta_ctx["force_rows"]
+            if force is not None:
+                local = force[
+                    (force >= rec["lo"]) & (force < rec["hi"])
+                ] - rec["lo"]
+                if len(local):
+                    rows = np.union1d(rows, local)
+            self.num_delta_rows_fetched += len(rows)
+            self.num_delta_rows_skipped += n - len(rows)
+            if len(rows) == 0:
+                out.update(
+                    use=None, shortest=None, lanes=None, valid=None,
+                    rows=rows,
+                )
+                return out
+            if len(rows) > DELTA_FETCH_MAX_FRACTION * n:
+                u, s, l, v = full_fetch(rec)
+                out.update(
+                    use=u[rows],
+                    shortest=s[rows],
+                    lanes=l[rows],
+                    valid=v[rows],
+                    rows=rows,
+                )
+                return out
+            K = bucket_for(len(rows), ROWSEL_BUCKETS)
+            idx = np.zeros(K, np.int64)
+            idx[: len(rows)] = rows
+            dev = self.pool.device(dev_index)
+            with self.probe.phase(
+                pipeline.DEVICE_SELECT, device=dev_index
+            ), jit_guard.dispatch_device(dev_index):
+                g = call_jit_guarded(
+                    gather_selection_rows,
+                    *rec["outs"],
+                    jax.device_put(jnp.asarray(idx), dev),
+                )
+            with self.probe.phase(pipeline.DEVICE_GET, device=dev_index):
+                gu, gs, gl, gv = jax.device_get(g)
+            k = len(rows)
+            gu, gs, gl, gv = gu[:k], gs[:k], gl[:k], gv[:k]
+            if self._sdc_active_for(dev_index):
+                gs = self._corrupt_metrics(gs)
+            out.update(use=gu, shortest=gs, lanes=gl, valid=gv, rows=rows)
+            return out
+
+        self.num_stream_builds += 1
+        clean_outs: Dict[tuple, tuple] = {}
+        repacks_before = self.num_stream_repacks
+        pending: List[dict] = []
+        for dev_index, lo, hi in plan:
+            # double-buffer slot gate: drain this chip's oldest work
+            # before queueing more than STREAM_SLOTS dispatches on it
+            while self.pool.inflight(dev_index) >= STREAM_SLOTS:
+                sel = next(
+                    j
+                    for j, r in enumerate(pending)
+                    if r["dev"] == dev_index
+                )
+                yield drain(pending.pop(sel))
+            pending.append(
+                dispatch(dev_index, lo, hi, delta_ctx is not None)
+            )
+        while pending:
+            # completion order: drain any shard that is already done;
+            # only when none are ready block on the oldest dispatch
+            if self._stream_pick is not None:
+                sel = self._stream_pick(pending)
+            else:
+                sel = 0
+                for j, r in enumerate(pending):
+                    if all(
+                        o.is_ready()
+                        for o in (
+                            (r["ch"],) if r["ch"] is not None else r["outs"]
+                        )
+                    ):
+                        sel = j
+                        break
+            rec = pending.pop(sel)
+            key = (rec["dev"], rec["lo"], rec["hi"])
+            outs = rec["outs"]
+            drained = drain(rec)
+            if self.num_stream_repacks == repacks_before:
+                # device-resident outputs retained as the NEXT build's
+                # delta base (only on clean streams: a mid-stream
+                # quarantine already purged residency as suspect)
+                clean_outs[key] = outs
+            yield drained
+        if self.num_stream_repacks == repacks_before:
+            self._stream_outs = clean_outs
+        else:
+            self._stream_outs = None
 
     # -- device build ------------------------------------------------------
 
@@ -1365,7 +1662,7 @@ class TpuBackend(DecisionBackend):
             )
         if inc_dev is not None:
             self.pool.note_dispatch(inc_dev)
-        with self.probe.phase(pipeline.DEVICE_GET, devices=[gather_dev]):
+        with self.probe.phase(pipeline.DEVICE_GET, device=gather_dev):
             use, shortest, lanes, valid = jax.device_get(
                 (use, shortest, lanes, valid)
             )
@@ -1385,6 +1682,86 @@ class TpuBackend(DecisionBackend):
                 prefix_state,
             )
         return results, inc_dev
+
+    def _delta_ctx_for(
+        self, plan, D: int, enc, dv, changed_prefixes, exact_churn: bool
+    ):
+        """Eligibility + context for on-device delta extraction on a
+        FULL build — the warm-start ``take_last_changed_prefixes``
+        pattern extended to the cold path.  A row may patch through
+        from the previous RouteDb only when everything its decode
+        depends on is pinned: the previous build's selection outputs
+        (device-resident, same shard plan), a layout-shared encoding
+        chain (same symbol tables and root-out lane order), an exact
+        prefix-churn delta (entry-object content the candidate columns
+        don't encode — forwarding algorithm, labels — can only move
+        with churn), identical static routes, no live KSP2 prefixes
+        (their routes read the WHOLE topology) and no MPLS label pass.
+        Probe builds decline: a probing chip must be exercised and
+        attributable end to end, not vouch for 'unchanged'."""
+        prev = self._prev_sel
+        if (
+            prev is None
+            or self._last_db is None
+            or not exact_churn
+            or self._plan_probe is not None
+            or self._ksp2_present
+            or self.solver.enable_node_segment_label
+        ):
+            return None
+        if (
+            prev["degree"] != D
+            or prev["shape"] != dv.cand_ok.shape
+            or prev["plan"] != tuple(plan)
+        ):
+            return None
+        prev_enc = prev["enc"]
+        if prev_enc.src is not enc.src or prev_enc.areas != enc.areas:
+            return None
+        statics = self.solver.get_static_routes()
+        snap = prev["statics"]
+        if len(snap) != len(statics) or any(
+            snap.get(k) is not v for k, v in statics.items()
+        ):
+            return None
+        # drain-state deltas: decode wraps the winning entry via
+        # LinkState drain lookups, so rows touching a node whose
+        # overload/soft-drain state moved must re-decode even when
+        # their selection outputs are identical (the kernel folds this
+        # mask into its changed-row computation)
+        node_changed = (prev_enc.overloaded != enc.overloaded) | (
+            prev_enc.soft != enc.soft
+        )
+        force = None
+        if changed_prefixes:
+            rows = self._cand_table.rows_for(changed_prefixes)
+            if rows:
+                force = np.asarray(sorted(rows), np.int64)
+        return {
+            "shards": prev["shards"],
+            "node_changed": node_changed,
+            "force_rows": force,
+        }
+
+    def _retain_prev_sel(self, plan, D: int, enc, dv) -> bool:
+        """Retain this build's device-resident selection outputs as the
+        next full build's delta base.  Returns True when the stream was
+        clean (no mid-stream re-pack) — also the caller's signal that
+        the shard plan attribution is trustworthy."""
+        outs = self._stream_outs
+        self._stream_outs = None
+        if outs is None or len(outs) != len(plan):
+            self._prev_sel = None
+            return False
+        self._prev_sel = {
+            "plan": tuple(plan),
+            "degree": D,
+            "shape": dv.cand_ok.shape,
+            "shards": outs,
+            "enc": enc,
+            "statics": dict(self.solver.get_static_routes()),
+        }
+        return True
 
     def _warm_affected_rows(self, dv, table):
         """Candidate-table rows whose selection inputs can have moved in
@@ -1409,13 +1786,7 @@ class TpuBackend(DecisionBackend):
         force_full,
         warm_delta=False,
     ):
-        import jax
-        import jax.numpy as jnp
-
-        from openr_tpu.ops import jit_guard
         from openr_tpu.ops.csr import bucket_for
-        from openr_tpu.ops.jit_guard import call_jit_guarded
-        from openr_tpu.ops.route_select import multi_area_select_from_tables
         from openr_tpu.tracing import pipeline
 
         me = self.solver.my_node_name
@@ -1436,8 +1807,15 @@ class TpuBackend(DecisionBackend):
         # vs full selection) additionally requires an unchanged topology
         table = self._cand_table
         with self.probe.phase(pipeline.HOST_FETCH):
+            # exact_churn: the table was patched from a KNOWN prefix
+            # delta — the precondition for the full-build delta-decode
+            # path (a full_sync may reassign rows and admits churn the
+            # device's changed-row compare cannot see)
+            exact_churn = (
+                changed_prefixes is not None and self._table_synced
+            )
             try:
-                if changed_prefixes is not None and self._table_synced:
+                if exact_churn:
                     table.apply_dirty(prefix_state, changed_prefixes)
                 else:
                     table.full_sync(prefix_state)
@@ -1503,6 +1881,10 @@ class TpuBackend(DecisionBackend):
                 self._attr_table = table
             else:
                 self._attr_table = None
+            # a patched build leaves the resident full-table outputs
+            # stale for its rows — they can no longer vouch for the
+            # next full build's delta
+            self._prev_sel = None
             with self.probe.phase(pipeline.DELTA_EXTRACT):
                 return _patch_route_db(
                     self._last_db, results, self.solver.get_static_routes()
@@ -1566,6 +1948,7 @@ class TpuBackend(DecisionBackend):
                 }
                 changed_out.update(deleted)
                 self._last_changed_prefixes = changed_out
+                self._prev_sel = None  # patched build: outputs stale
                 with self.probe.phase(pipeline.DELTA_EXTRACT):
                     return _patch_route_db(
                         patch_base,
@@ -1573,85 +1956,126 @@ class TpuBackend(DecisionBackend):
                         self.solver.get_static_routes(),
                     )
 
-        # ---- full build --------------------------------------------------
+        # ---- full build (streamed pipeline, ISSUE 11) --------------------
+        # the selection batch shards row-contiguously across the pool's
+        # healthy chips (one shard on the lead chip for single-chip
+        # pools), every shard a committed per-device dispatch so a wrong
+        # row is attributable to exactly one device; shards drain as
+        # STREAMED completions — decode of shard N overlaps the solve of
+        # the shards still in flight instead of waiting on a fetch
+        # barrier
         n_active = (max(table.pid.values()) + 1) if table.pid else 0
         plan = self._plan_full_dispatch(dv.cand_ok.shape[0], n_active)
-        if plan is not None:
-            # multi-chip: the selection batch shards row-contiguously
-            # across the pool's healthy chips (plus at most one probing
-            # chip), every shard a committed per-device dispatch so a
-            # wrong row is attributable to exactly one device
-            use, shortest, lanes, valid = self._dispatch_row_shards(
-                dv, (dist, nh, ovl, soft), per_area, plan
-            )
+        delta_ctx = self._delta_ctx_for(
+            plan, D, enc, dv, changed_prefixes, exact_churn
+        )
+        if delta_ctx is not None:
+            deleted = [
+                p
+                for p in (changed_prefixes or ())
+                if p not in table.pid
+            ]
+            results = {p: None for p in deleted}
+            decoded_rows: List[int] = []
+            shard_devs: Dict[int, int] = {}
+            for shard in self._stream_row_shards(
+                dv, (dist, nh, ovl, soft), per_area, plan, delta_ctx
+            ):
+                rows = shard["rows"]
+                if rows is None or not len(rows):
+                    continue
+                global_rows = rows + shard["lo"]
+                with self.probe.phase(pipeline.DECODE):
+                    row_items = [
+                        (i, table.row_prefix[r])
+                        for i, r in enumerate(global_rows)
+                        if table.row_prefix[r] is not None
+                    ]
+                    results.update(
+                        self._decode_rows(
+                            row_items,
+                            shard["use"],
+                            shard["shortest"],
+                            shard["lanes"],
+                            shard["valid"],
+                            dv,
+                            global_rows,
+                            enc,
+                            area_link_states,
+                            prefix_state,
+                        )
+                    )
+                for r in global_rows:
+                    shard_devs[int(r)] = shard["dev"]
+                decoded_rows.extend(int(r) for r in global_rows)
             self.num_device_builds += 1
+            self.num_delta_builds += 1
+            clean = self._retain_prev_sel(plan, D, enc, dv)
+            if self._use_pool() and decoded_rows and clean:
+                self._attr_rows = shard_devs
+                self._attr_plan = None
+                self._attr_table = table
+            else:
+                self._attr_table = None
+            changed_out = {
+                table.row_prefix[r]
+                for r in decoded_rows
+                if table.row_prefix[r] is not None
+            }
+            changed_out.update(deleted)
+            self._last_changed_prefixes = changed_out
+            with self.probe.phase(pipeline.DELTA_EXTRACT):
+                return _patch_route_db(
+                    patch_base, results, self.solver.get_static_routes()
+                )
+
+        # a full decode re-derives KSP2 presence from scratch (the
+        # warm-selective patch path declines while any KSP2 prefix is
+        # live, and _decode_rows re-raises the flag on discovery)
+        self._ksp2_present = False
+        results = {}
+        for shard in self._stream_row_shards(
+            dv, (dist, nh, ovl, soft), per_area, plan, None
+        ):
+            with self.probe.phase(pipeline.DECODE):
+                use = shard["use"]
+                lo = shard["lo"]
+                # only rows with at least one selection winner produce
+                # routes; decode runs per shard, overlapping the solves
+                # still in flight
+                local_winners = np.nonzero(use.any(axis=1))[0]
+                row_items = []
+                for i in local_winners:
+                    p = table.row_prefix[lo + int(i)]
+                    if p is not None:
+                        row_items.append((int(i), p))
+                results.update(
+                    self._decode_rows(
+                        row_items,
+                        use,
+                        shard["shortest"],
+                        shard["lanes"],
+                        shard["valid"],
+                        dv,
+                        np.arange(lo, shard["hi"]),
+                        enc,
+                        area_link_states,
+                        prefix_state,
+                    )
+                )
+        self.num_device_builds += 1
+        clean = self._retain_prev_sel(plan, D, enc, dv)
+        if self._use_pool() and clean:
             self._attr_plan = plan
             self._attr_rows = None
             self._attr_table = table
         else:
-            with self.probe.phase(pipeline.TRANSFER):
-                full_args = (
-                    jnp.asarray(dv.cand_area),
-                    jnp.asarray(dv.cand_node),
-                    jnp.asarray(dv.cand_ok),
-                    jnp.asarray(dv.drain_metric),
-                    jnp.asarray(dv.path_pref),
-                    jnp.asarray(dv.source_pref),
-                    jnp.asarray(dv.distance),
-                    jnp.asarray(dv.cand_node_in_area),
-                )
-            # the legacy single-dispatch path still runs on ONE chip
-            # (pool index 0) — attribute it so 1-device runs report a
-            # per-chip busy fraction too
-            with self.probe.phase(pipeline.DEVICE_COMPUTE, device=0):
-                use, shortest, lanes, valid = call_jit_guarded(
-                    multi_area_select_from_tables,
-                    dist,
-                    nh,
-                    ovl,
-                    soft,
-                    *full_args,
-                    per_area_distance=per_area,
-                )
-            self.num_device_builds += 1
-            # ONE device->host fetch for all outputs: over a tunneled TPU
-            # each transfer is a full round trip, and four separate
-            # np.asarray calls cost ~4x one device_get (measured ~256ms vs
-            # ~69ms on v5e/axon) — that difference alone would blow the
-            # 10-250ms debounce budget
-            with self.probe.phase(pipeline.DEVICE_GET, devices=[0]):
-                use, shortest, lanes, valid = jax.device_get(
-                    (use, shortest, lanes, valid)
-                )
-            if self._sdc_active_for(0):
-                shortest = self._corrupt_metrics(shortest)
+            # single-chip pool, or a mid-stream re-pack moved rows off
+            # the planned chips: don't attribute what the plan no
+            # longer describes
             self._attr_table = None
 
         with self.probe.phase(pipeline.DECODE):
-            # a full decode re-derives KSP2 presence from scratch (the
-            # warm-selective patch path declines while any KSP2 prefix
-            # is live, and _decode_rows re-raises the flag on discovery)
-            self._ksp2_present = False
-            # only rows with at least one selection winner produce routes
-            rows_with_winners = np.nonzero(use.any(axis=1))[0]
-            row_items: List[Tuple[int, str]] = []
-            for r in rows_with_winners:
-                p = table.row_prefix[r]
-                if p is not None:
-                    row_items.append((int(r), p))
-            results = self._decode_rows(
-                row_items,
-                use,
-                shortest,
-                lanes,
-                valid,
-                dv,
-                None,
-                enc,
-                area_link_states,
-                prefix_state,
-            )
-
             route_db = DecisionRouteDb()
             for prefix, entry in results.items():
                 if entry is not None:

@@ -64,9 +64,39 @@ class FleetRibEngine:
         self._cache_key = None
         self._state = None  # dict of cached tables + decode context
         self._ksp2_scan = None  # (change_seq, result)
+        #: pool health generation the collective mesh was derived under
+        #: (PR-6 remnant: engines given BOTH a mesh and a pool re-derive
+        #: the mesh from DevicePool.survivor_mesh() whenever the healthy
+        #: set changes, so the shard_map-collective path re-packs on
+        #: chip quarantine exactly like the committed-dispatch path)
+        self._mesh_health_seq = None
+        self._mesh_requested = mesh is not None
+        #: previous generation's delta base (device-resident chunk
+        #: outputs + host tables + kernel-input pins)
+        self._prev_gen = None
         self.num_batched_solves = 0
         self.num_decodes = 0
         self.num_pool_dispatches = 0
+        self.num_delta_solves = 0
+        self.num_delta_roots_fetched = 0
+        self.num_delta_roots_skipped = 0
+
+    def _active_mesh(self):
+        """The collective mesh for this solve.  With no pool, the
+        constructor's mesh is pinned.  With a pool, the mesh re-derives
+        from ``DevicePool.survivor_mesh()`` on every health transition:
+        a chip quarantine re-packs the collective onto the survivors
+        (or, when fewer than two chips survive / shard_map is
+        unavailable, drops to the committed-dispatch pool path), and a
+        restore re-admits the chip."""
+        if not self._mesh_requested:
+            return None
+        if self.pool is None:
+            return self.mesh
+        if self._mesh_health_seq != self.pool.health_seq:
+            self.mesh = self.pool.survivor_mesh()
+            self._mesh_health_seq = self.pool.health_seq
+        return self.mesh
 
     # -- eligibility -------------------------------------------------------
 
@@ -155,19 +185,16 @@ class FleetRibEngine:
         B = len(names)
         P, C = dv.cand_ok.shape
         A = enc.num_areas
-        use = np.empty((B, P, C), bool)
-        shortest = np.empty((B, P, A), np.float32)
-        lanes = np.empty((B, P, A, D), bool)
-        valid = np.empty((B, P, A), bool)
-        mesh_n = self.mesh.devices.size if self.mesh is not None else 1
-        if self.mesh is not None:
+        mesh = self._active_mesh()
+        mesh_n = mesh.devices.size if mesh is not None else 1
+        if mesh is not None:
             from openr_tpu.ops.fleet_tables import sharded_fleet_tables
             from openr_tpu.parallel.mesh import batch_sharding, replicated
 
-            rep = replicated(self.mesh)
+            rep = replicated(mesh)
             dev = {k: jax.device_put(v, rep) for k, v in dev.items()}
-            fleet_fn = sharded_fleet_tables(self.mesh, D, per_area)
-            roots_sh = batch_sharding(self.mesh)
+            fleet_fn = sharded_fleet_tables(mesh, D, per_area)
+            roots_sh = batch_sharding(mesh)
         # pool path (no shard_map needed): root chunks spread round-robin
         # over the pool's HEALTHY chips as committed per-device
         # dispatches — a quarantined chip's share re-packs onto the
@@ -175,13 +202,30 @@ class FleetRibEngine:
         pool_devs = None
         chunk_rows = ROOT_CHUNK
         per_dev_args: dict = {}
-        if self.mesh is None and self.pool is not None:
+        if mesh is None and self.pool is not None:
             healthy = self.pool.healthy_indices()
             if len(healthy) > 1:
                 pool_devs = healthy
                 chunk_rows = min(
                     ROOT_CHUNK, max(32, -(-B // len(healthy)))
                 )
+        # dense kernel args when the encoding carries the in-edge
+        # planes (the scatter-free SPF formulation); also the
+        # precondition for the on-device generation delta
+        dense_keys = None
+        if enc.has_dense:
+            with self.probe.phase(pipeline.TRANSFER):
+                dev = dict(
+                    dev,
+                    in_src=jnp.asarray(enc.in_src),
+                    in_w=jnp.asarray(enc.in_w),
+                    in_ok=jnp.asarray(enc.in_ok),
+                    in_rank=jnp.asarray(enc.in_rank),
+                    in_has=jnp.asarray(enc.in_has),
+                )
+                for k in ("src", "dst", "w", "edge_ok"):
+                    dev.pop(k)
+            dense_keys = True
 
         def args_on(idx):
             if idx not in per_dev_args:
@@ -192,15 +236,37 @@ class FleetRibEngine:
                     }
             return per_dev_args[idx]
 
+        from openr_tpu.decision.backend import STREAM_SLOTS
         from openr_tpu.ops import jit_guard
+        from openr_tpu.ops.fleet_tables import (
+            fleet_multi_area_tables_dense,
+            fleet_multi_area_tables_dense_delta,
+        )
+        from openr_tpu.ops.route_select import gather_selection_rows
 
-        # dispatch every root chunk, then fetch ALL of them with one
-        # device_get (async-copies each leaf before blocking): the whole
-        # fleet build costs a single overlapped host round trip instead
-        # of one per chunk
-        pending: list = []
-        used_devices: set = set()
-        for off in range(0, B, chunk_rows):
+        # on-device generation delta: when the previous generation's
+        # chunk outputs are device-resident and every decode input is
+        # provably equivalent, each chunk solves with the fused
+        # solve+diff kernel and only CHANGED roots' rows cross the host
+        # boundary — the unchanged rows patch through from the previous
+        # generation's host tables
+        delta = self._fleet_delta_ctx(
+            enc, dv, table, names, roots_mat, chunk_rows, pool_devs,
+            mesh, D,
+        )
+        if delta is not None:
+            use = delta["use"].copy()
+            shortest = delta["shortest"].copy()
+            lanes = delta["lanes"].copy()
+            valid = delta["valid"].copy()
+            self.num_delta_solves += 1
+        else:
+            use = np.empty((B, P, C), bool)
+            shortest = np.empty((B, P, A), np.float32)
+            lanes = np.empty((B, P, A, D), bool)
+            valid = np.empty((B, P, A), bool)
+
+        def dispatch_chunk(off):
             chunk = roots_mat[off : off + chunk_rows]
             with self.probe.phase(pipeline.PAD_PACK):
                 b = 1 << max(5, (len(chunk) - 1).bit_length())  # pow2
@@ -208,7 +274,9 @@ class FleetRibEngine:
                 padded = np.full((b, A), -1, np.int32)
                 padded[: len(chunk)] = chunk
             # a fully -1 pad row would make SPF roots all-absent: fine
-            if self.mesh is not None:
+            idx = None
+            ch = None
+            if mesh is not None:
                 with self.probe.phase(pipeline.DEVICE_COMPUTE):
                     out = fleet_fn(
                         jax.device_put(padded, roots_sh),
@@ -227,46 +295,144 @@ class FleetRibEngine:
                         dev["distance"],
                         dev["cand_node_in_area"],
                     )
-            elif pool_devs is not None:
-                idx = pool_devs[(off // chunk_rows) % len(pool_devs)]
-                args = args_on(idx)
-                with self.probe.phase(pipeline.TRANSFER, device=idx):
-                    roots_dev = jax.device_put(
-                        jnp.asarray(padded), self.pool.device(idx)
+            else:
+                if pool_devs is not None:
+                    idx = pool_devs[(off // chunk_rows) % len(pool_devs)]
+                    args = args_on(idx)
+                    with self.probe.phase(pipeline.TRANSFER, device=idx):
+                        roots_dev = jax.device_put(
+                            jnp.asarray(padded), self.pool.device(idx)
+                        )
+                else:
+                    idx = 0
+                    args = dev
+                    roots_dev = jnp.asarray(padded)
+                extra = {}
+                if delta is not None:
+                    kernel = fleet_multi_area_tables_dense_delta
+                    pu, ps, pl, pv = delta["chunks"][off]
+                    extra = dict(
+                        prev_use=pu,
+                        prev_shortest=ps,
+                        prev_lanes=pl,
+                        prev_valid=pv,
                     )
+                elif dense_keys:
+                    kernel = fleet_multi_area_tables_dense
+                else:
+                    kernel = fleet_multi_area_tables
                 with self.probe.phase(
                     pipeline.DEVICE_COMPUTE, device=idx
-                ), jit_guard.dispatch_device(idx):
+                ), jit_guard.dispatch_device(
+                    idx if pool_devs is not None else None
+                ):
                     out = call_jit_guarded(
-                        fleet_multi_area_tables,
+                        kernel,
                         roots=roots_dev,
                         max_degree=D,
                         per_area_distance=per_area,
                         **args,
+                        **extra,
                     )
-                self.pool.note_dispatch(idx)
-                used_devices.add(idx)
-                self.num_pool_dispatches += 1
-            else:
-                with self.probe.phase(pipeline.DEVICE_COMPUTE, device=0):
-                    out = call_jit_guarded(
-                        fleet_multi_area_tables,
-                        roots=jnp.asarray(padded),
-                        max_degree=D,
-                        per_area_distance=per_area,
-                        **dev,
+                if delta is not None:
+                    out, ch = out[:4], out[4]
+                if self.pool is not None and pool_devs is not None:
+                    self.pool.note_inflight(idx)
+                    self.num_pool_dispatches += 1
+                for o in (ch,) if ch is not None else out:
+                    o.copy_to_host_async()
+            return {
+                "off": off,
+                "n": len(chunk),
+                "idx": idx,
+                "out": out,
+                "ch": ch,
+            }
+
+        def drain_chunk(rec):
+            off, n, idx = rec["off"], rec["n"], rec["idx"]
+            if idx is not None:
+                # streamed completion: the wait charges ONLY this chip
+                with self.probe.phase(pipeline.STREAM_DRAIN, device=idx):
+                    for o in (
+                        (rec["ch"],) if rec["ch"] is not None else rec["out"]
+                    ):
+                        o.block_until_ready()
+                if self.pool is not None and pool_devs is not None:
+                    self.pool.note_complete(idx)
+            if rec["ch"] is not None:
+                with self.probe.phase(pipeline.DEVICE_GET, device=idx):
+                    ch = np.asarray(jax.device_get(rec["ch"]))[:n]
+                rows = np.nonzero(ch)[0]
+                self.num_delta_roots_fetched += len(rows)
+                self.num_delta_roots_skipped += n - len(rows)
+                if not len(rows):
+                    return
+                from openr_tpu.decision.backend import ROWSEL_BUCKETS
+                from openr_tpu.ops.csr import bucket_for
+
+                k = bucket_for(len(rows), ROWSEL_BUCKETS)
+                idx_arr = np.zeros(k, np.int64)
+                idx_arr[: len(rows)] = rows
+                with self.probe.phase(
+                    pipeline.DEVICE_SELECT, device=idx
+                ), jit_guard.dispatch_device(
+                    idx if pool_devs is not None else None
+                ):
+                    g = call_jit_guarded(
+                        gather_selection_rows,
+                        *rec["out"],
+                        jnp.asarray(idx_arr),
                     )
-                used_devices.add(0)
-            pending.append((off, len(chunk), out))
-        with self.probe.phase(
-            pipeline.DEVICE_GET, devices=sorted(used_devices)
-        ):
-            fetched = jax.device_get([p[2] for p in pending])
-        for (off, n, _out), (u, s_, l, v) in zip(pending, fetched):
+                with self.probe.phase(pipeline.DEVICE_GET, device=idx):
+                    gu, gs, gl, gv = jax.device_get(g)
+                m = len(rows)
+                use[off + rows] = gu[:m]
+                shortest[off + rows] = gs[:m]
+                lanes[off + rows] = gl[:m]
+                valid[off + rows] = gv[:m]
+                return
+            with self.probe.phase(pipeline.DEVICE_GET, device=idx):
+                u, s_, l, v = jax.device_get(rec["out"])
             use[off : off + n] = u[:n]
             shortest[off : off + n] = s_[:n]
             lanes[off : off + n] = l[:n]
             valid[off : off + n] = v[:n]
+
+        # streamed dispatch: chunk N+1's pad/transfer overlaps chunk
+        # N's solve; the in-flight slot gate keeps any one chip's
+        # undrained backlog bounded, and chunks drain in COMPLETION
+        # order so host-side assembly overlaps the solves still in
+        # flight
+        pending: list = []
+        chunk_outs: dict = {}
+        for off in range(0, B, chunk_rows):
+            if pool_devs is not None:
+                idx = pool_devs[(off // chunk_rows) % len(pool_devs)]
+                while self.pool.inflight(idx) >= STREAM_SLOTS:
+                    sel = next(
+                        j
+                        for j, r in enumerate(pending)
+                        if r["idx"] == idx
+                    )
+                    early = pending.pop(sel)
+                    chunk_outs[early["off"]] = early["out"]
+                    drain_chunk(early)
+            pending.append(dispatch_chunk(off))
+        while pending:
+            sel = 0
+            for j, r in enumerate(pending):
+                if r["idx"] is not None and all(
+                    o.is_ready()
+                    for o in (
+                        (r["ch"],) if r["ch"] is not None else r["out"]
+                    )
+                ):
+                    sel = j
+                    break
+            rec = pending.pop(sel)
+            chunk_outs[rec["off"]] = rec["out"]
+            drain_chunk(rec)
         self._state = dict(
             enc=enc,
             dv=dv,
@@ -278,9 +444,74 @@ class FleetRibEngine:
             lanes=lanes,
             valid=valid,
         )
+        self._retain_fleet_delta(
+            enc, dv, table, names, roots_mat, chunk_rows, pool_devs,
+            mesh, D, chunk_outs, use, shortest, lanes, valid,
+        )
         self._cache_key = key
         self.num_batched_solves += 1
         return self._state
+
+    #: device-resident fleet outputs beyond this size are not retained
+    #: as a delta base (mirrors TpuBackend.WARM_MAX_TABLE_BYTES)
+    DELTA_MAX_TABLE_BYTES = 64 << 20
+
+    def _fleet_delta_ctx(
+        self, enc, dv, table, names, roots_mat, chunk_rows, pool_devs,
+        mesh, D,
+    ):
+        """Eligibility for the fleet generation delta: the previous
+        generation's device-resident chunk outputs may vouch for
+        'root unchanged' only when every KERNEL INPUT mapping is
+        equivalent — same vantage list and per-area root ids, same
+        symbol tables (value equality: the fleet engine re-encodes per
+        generation), same candidate row->prefix mapping and shapes,
+        same chunk decomposition and chip assignment.  Decode inputs
+        read fresh state per request (prefix entries, drain lookups,
+        min_nexthop), so they impose no additional pinning."""
+        prev = self._prev_gen
+        if prev is None or mesh is not None or not enc.has_dense:
+            return None
+        if (
+            prev["degree"] != D
+            or prev["chunk_rows"] != chunk_rows
+            or prev["pool_devs"] != pool_devs
+            or prev["names"] != names
+            or not np.array_equal(prev["roots_mat"], roots_mat)
+            or prev["shape"] != dv.cand_ok.shape
+            or prev["row_prefix"] != table.row_prefix
+            or prev["id_to_node"]
+            != [t.id_to_node for t in enc.topos]
+        ):
+            return None
+        return prev
+
+    def _retain_fleet_delta(
+        self, enc, dv, table, names, roots_mat, chunk_rows, pool_devs,
+        mesh, D, chunk_outs, use, shortest, lanes, valid,
+    ) -> None:
+        if mesh is not None or not enc.has_dense or not chunk_outs:
+            self._prev_gen = None
+            return
+        table_bytes = use.nbytes + shortest.nbytes + lanes.nbytes + valid.nbytes
+        if table_bytes > self.DELTA_MAX_TABLE_BYTES:
+            self._prev_gen = None
+            return
+        self._prev_gen = dict(
+            degree=D,
+            chunk_rows=chunk_rows,
+            pool_devs=list(pool_devs) if pool_devs is not None else None,
+            names=list(names),
+            roots_mat=roots_mat,
+            shape=dv.cand_ok.shape,
+            row_prefix=list(table.row_prefix),
+            id_to_node=[t.id_to_node for t in enc.topos],
+            chunks=chunk_outs,
+            use=use,
+            shortest=shortest,
+            lanes=lanes,
+            valid=valid,
+        )
 
     # -- per-root decode (the backend's own decode path) -------------------
 

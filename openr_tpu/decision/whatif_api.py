@@ -435,9 +435,25 @@ class MultiAreaWhatIfEngine:
         self.probe = probe if probe is not None else disabled_probe()
         self._cache_key = None
         self._state = None
+        #: PR-6 remnant: with BOTH a mesh and a pool, the collective
+        #: mesh re-derives from DevicePool.survivor_mesh() on every
+        #: health transition, so the shard_map path re-packs on chip
+        #: quarantine exactly like the committed-dispatch path
+        self._mesh_health_seq = None
+        self._mesh_requested = mesh is not None
         self.num_engine_builds = 0
         self.num_sweeps = 0
         self.num_pool_dispatches = 0
+
+    def _active_mesh(self):
+        if not self._mesh_requested:
+            return None
+        if self.pool is None:
+            return self.mesh
+        if self._mesh_health_seq != self.pool.health_seq:
+            self.mesh = self.pool.survivor_mesh()
+            self._mesh_health_seq = self.pool.health_seq
+        return self.mesh
 
     def _context(self, area_link_states, prefix_state, change_seq):
         import numpy as np
@@ -550,9 +566,10 @@ class MultiAreaWhatIfEngine:
         bucket = bucket_for(
             B + 1, FAILURE_BUCKETS + (max(B + 1, FAILURE_BUCKETS[-1]),)
         )
-        if self.mesh is not None:
+        mesh = self._active_mesh()
+        if mesh is not None:
             # sharded dispatch splits the failure batch across devices
-            gran = self.mesh.devices.size
+            gran = mesh.devices.size
             bucket = ((bucket + gran - 1) // gran) * gran
         from openr_tpu.tracing import pipeline
 
@@ -591,13 +608,13 @@ class MultiAreaWhatIfEngine:
                 distance=jnp.asarray(dv.distance),
                 cand_node_in_area=jnp.asarray(dv.cand_node_in_area),
             )
-        if self.mesh is not None:
+        if mesh is not None:
             from openr_tpu.ops.fleet_tables import sharded_whatif_tables
             from openr_tpu.parallel.mesh import batch_sharding, replicated
 
-            rep = replicated(self.mesh)
-            bat = batch_sharding(self.mesh)
-            fn = sharded_whatif_tables(self.mesh, st["D"], per_area)
+            rep = replicated(mesh)
+            bat = batch_sharding(mesh)
+            fn = sharded_whatif_tables(mesh, st["D"], per_area)
             use, shortest, lanes, valid = jax.device_get(
                 call_jit_guarded(
                     fn,
@@ -624,7 +641,10 @@ class MultiAreaWhatIfEngine:
                 # shards, one committed dispatch per healthy chip, each
                 # with its own -1 pad row (the pad row solves the
                 # unperturbed topology, so every shard carries a base —
-                # the first shard's is the one the decode diffs against)
+                # the first shard's is the one the decode diffs against).
+                # Shards drain as STREAMED completions (is_ready poll +
+                # per-shard stream_drain charged only to the completing
+                # chip) instead of one all-chip device_get barrier.
                 from openr_tpu.ops import jit_guard
 
                 shards = self.pool.shard_ranges(B, pool_devs)
@@ -664,25 +684,45 @@ class MultiAreaWhatIfEngine:
                             per_area_distance=per_area,
                             **shard_kwargs,
                         )
-                    self.pool.note_dispatch(idx)
-                    dispatched.append((n_i, out))
+                    self.pool.note_inflight(idx)
+                    for o in out:
+                        o.copy_to_host_async()
+                    dispatched.append((idx, n_i, out))
                     self.num_pool_dispatches += 1
-                with self.probe.phase(
-                    pipeline.DEVICE_GET,
-                    devices=[i for i, _lo, _hi in shards],
-                ):
-                    fetched = jax.device_get([o for _n, o in dispatched])
+                fetched_by_pos: Dict[int, tuple] = {}
+                pending_shards = list(enumerate(dispatched))
+                while pending_shards:
+                    sel = 0
+                    for j, (_p, r) in enumerate(pending_shards):
+                        if all(o.is_ready() for o in r[2]):
+                            sel = j
+                            break
+                    pos, rec = pending_shards.pop(sel)
+                    idx, _n_i, out = rec
+                    with self.probe.phase(
+                        pipeline.STREAM_DRAIN, device=idx
+                    ):
+                        for o in out:
+                            o.block_until_ready()
+                    self.pool.note_complete(idx)
+                    with self.probe.phase(
+                        pipeline.DEVICE_GET, device=idx
+                    ):
+                        fetched_by_pos[pos] = jax.device_get(out)
+                fetched = [
+                    fetched_by_pos[i] for i in range(len(dispatched))
+                ]
                 parts = []
                 for k in range(4):
                     rows = [
                         outs[k][:n]
-                        for (n, _), outs in zip(dispatched, fetched)
+                        for (_i, n, _), outs in zip(dispatched, fetched)
                     ]
                     # base snapshot: the FIRST shard's pad row, placed
                     # at index B exactly where the unsharded layout
                     # puts it (all shards' pad rows are bit-identical —
                     # same kernel, same unperturbed inputs)
-                    n0 = dispatched[0][0]
+                    n0 = dispatched[0][1]
                     rows.append(fetched[0][k][n0 : n0 + 1])
                     parts.append(np.concatenate(rows, axis=0))
                 use, shortest, lanes, valid = parts
@@ -697,7 +737,7 @@ class MultiAreaWhatIfEngine:
                         **kernel_args,
                         **cand_args,
                     )
-                with self.probe.phase(pipeline.DEVICE_GET, devices=[0]):
+                with self.probe.phase(pipeline.DEVICE_GET, device=0):
                     use, shortest, lanes, valid = jax.device_get(pending)
         if st["base_dist"] is None:
             with self.probe.phase(pipeline.DEVICE_COMPUTE):

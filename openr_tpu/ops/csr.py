@@ -32,6 +32,11 @@ from openr_tpu.decision.link_state import Link, LinkState
 
 INF = np.float32(np.inf)
 
+#: in-degree buckets for the dense in-edge matrix (K axis).  Beyond the
+#: largest bucket the dense formulation is declined (fields stay None)
+#: and the SPF kernels fall back to the edge-list segment reductions.
+IN_DEGREE_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
 #: native fill path (native/csr_bridge.cc) — the per-element expansion in C
 #: instead of Python (SURVEY §7 hard-part 4: the bridge must fit in the
 #: 10-250ms debounce budget).  None = unavailable; pure-Python fallback.
@@ -88,6 +93,35 @@ class EncodedTopology:
     num_nodes: int
     num_edges: int  # valid directed edges
 
+    # dense in-edge matrix (the gather formulation of the SPF fixpoint):
+    # slot (v, k) holds the k-th directed edge INTO v in dst-sorted edge
+    # order.  The relax step then reads ``d[in_src] + in_w`` and
+    # min-reduces over K — pure gathers + a dense reduction, no scatter
+    # (the scatter-based segment fixpoint was ~95% of a grid4096 cold
+    # rebuild wall on host platforms).  ``in_rank`` carries the src
+    # node's out-edge rank of that edge (root-independent: rank among
+    # edges sharing the same src, in edge order), which IS the nexthop
+    # lane id whenever in_src == root.  ``in_edge_pos`` maps each
+    # edge-list position to its flat V*K slot (-1 for padding edges) so
+    # the O(links) patch path refreshes in_w/in_ok without re-deriving
+    # the layout.  All None when the max in-degree exceeds
+    # IN_DEGREE_BUCKETS (segment-kernel fallback).
+    in_src: Optional[np.ndarray] = None  # [V, K] int32
+    in_w: Optional[np.ndarray] = None  # [V, K] float32 (INF pad/down)
+    in_ok: Optional[np.ndarray] = None  # [V, K] bool
+    in_rank: Optional[np.ndarray] = None  # [V, K] int32 (-1 = no lane)
+    in_edge_pos: Optional[np.ndarray] = None  # [E] int64 flat slot (-1)
+    #: [V] bool — v appears in the padded dst[] at all (real OR padding
+    #: edge).  The segment kernels leave int8-min (-128) in lane rows of
+    #: absent dsts (empty segments); the dense kernels replicate that
+    #: exactly so warm contexts seeded from either formulation are
+    #: bit-interchangeable.
+    in_has: Optional[np.ndarray] = None
+
+    @property
+    def has_dense(self) -> bool:
+        return self.in_src is not None
+
     @property
     def padded_nodes(self) -> int:
         return int(self.overloaded.shape[0])
@@ -125,6 +159,66 @@ class EncodedTopology:
         return int(counts.max())
 
 
+def build_in_edge_matrix(
+    src: np.ndarray,
+    dst: np.ndarray,
+    w: np.ndarray,
+    edge_ok: np.ndarray,
+    link_index: np.ndarray,
+    padded_v: int,
+    in_degree_bucket: Optional[int] = None,
+):
+    """Dense in-edge layout for dst-sorted edge arrays.
+
+    Returns ``(in_src, in_w, in_ok, in_rank, in_edge_pos, in_has)`` or
+    None when the max in-degree exceeds the largest bucket (segment
+    fallback).
+    Every REAL edge (``link_index >= 0``) owns a slot — down links
+    included, so a later patch that revives them only flips ``in_ok``;
+    padding slots read ``in_ok=False, in_w=INF`` and gather node 0."""
+    valid = np.nonzero(link_index >= 0)[0]
+    n = len(valid)
+    if n:
+        counts = np.bincount(dst[valid], minlength=padded_v)
+        max_in = int(counts.max())
+    else:
+        max_in = 0
+    try:
+        K = in_degree_bucket or bucket_for(max(max_in, 1), IN_DEGREE_BUCKETS)
+    except ValueError:
+        return None
+    if K < max_in:
+        return None
+    in_src = np.zeros((padded_v, K), np.int32)
+    in_w = np.full((padded_v, K), INF, np.float32)
+    in_ok = np.zeros((padded_v, K), bool)
+    in_rank = np.full((padded_v, K), -1, np.int32)
+    in_edge_pos = np.full(src.shape[0], -1, np.int64)
+    if n:
+        d = dst[valid]
+        # edges are dst-sorted, so each dst's run is contiguous: slot k
+        # = position within the run (first-occurrence searchsorted)
+        run_start = np.searchsorted(d, d, side="left")
+        slot = np.arange(n) - run_start
+        flat = d.astype(np.int64) * K + slot
+        in_edge_pos[valid] = flat
+        s = src[valid]
+        # out-edge rank per edge: index among same-src edges in edge
+        # order (stable sort by src preserves position order) — the lane
+        # id the nexthop kernels seed when src == root
+        order = np.argsort(s, kind="stable")
+        s_sorted = s[order]
+        first = np.searchsorted(s_sorted, s_sorted, side="left")
+        rank = np.empty(n, np.int32)
+        rank[order] = (np.arange(n) - first).astype(np.int32)
+        in_src.flat[flat] = s
+        in_w.flat[flat] = w[valid]
+        in_ok.flat[flat] = edge_ok[valid]
+        in_rank.flat[flat] = rank
+    in_has = np.bincount(dst, minlength=padded_v) > 0
+    return in_src, in_w, in_ok, in_rank, in_edge_pos, in_has
+
+
 def encode_link_state(
     link_state: LinkState,
     node_bucket: Optional[int] = None,
@@ -132,6 +226,7 @@ def encode_link_state(
     node_buckets: Sequence[int] = (16, 64, 256, 1024, 4096, 16384),
     edge_multiplier: int = 8,
     extra_nodes: Sequence[str] = (),
+    in_degree_bucket: Optional[int] = None,
 ) -> EncodedTopology:
     """Encode one LinkState area graph.
 
@@ -261,6 +356,13 @@ def encode_link_state(
         else np.zeros((0, 2), np.int32)
     )
 
+    dense = build_in_edge_matrix(
+        src, dst, w, edge_ok, link_index, padded_v, in_degree_bucket
+    )
+    in_src = in_w = in_ok = in_rank = in_edge_pos = in_has = None
+    if dense is not None:
+        in_src, in_w, in_ok, in_rank, in_edge_pos, in_has = dense
+
     return EncodedTopology(
         src=src,
         dst=dst,
@@ -276,6 +378,12 @@ def encode_link_state(
         link_edge_pos=link_edge_pos,
         num_nodes=V,
         num_edges=E,
+        in_src=in_src,
+        in_w=in_w,
+        in_ok=in_ok,
+        in_rank=in_rank,
+        in_edge_pos=in_edge_pos,
+        in_has=in_has,
     )
 
 
@@ -332,6 +440,19 @@ def patch_encoded_topology(
         overloaded[i] = link_state.is_node_overloaded(n)
         soft[i] = link_state.get_node_metric_increment(n)
 
+    # dense in-edge refresh: the layout (in_src/in_rank/in_edge_pos) is
+    # identity-shared; only the weight/validity planes re-scatter from
+    # the freshly patched edge columns — O(links), like the rest of the
+    # patch path
+    in_w = in_ok = None
+    if old.has_dense:
+        pos = old.in_edge_pos
+        m = pos >= 0
+        in_w = np.full_like(old.in_w, INF)
+        in_ok = np.zeros_like(old.in_ok)
+        in_w.flat[pos[m]] = w[m]
+        in_ok.flat[pos[m]] = edge_ok[m]
+
     return EncodedTopology(
         src=old.src,
         dst=old.dst,
@@ -347,6 +468,12 @@ def patch_encoded_topology(
         link_edge_pos=old.link_edge_pos,
         num_nodes=old.num_nodes,
         num_edges=old.num_edges,
+        in_src=old.in_src,
+        in_w=in_w,
+        in_ok=in_ok,
+        in_rank=old.in_rank,
+        in_edge_pos=old.in_edge_pos,
+        in_has=old.in_has,
     )
 
 
@@ -452,6 +579,17 @@ class EncodedMultiArea:
     overloaded: np.ndarray  # [A, V]
     soft: np.ndarray  # [A, V]
     roots: np.ndarray  # [A] my node id per area
+    #: stacked dense in-edge planes (None when any area declined the
+    #: dense layout — the SPF dispatch then uses the segment kernels)
+    in_src: Optional[np.ndarray] = None  # [A, V, K]
+    in_w: Optional[np.ndarray] = None  # [A, V, K]
+    in_ok: Optional[np.ndarray] = None  # [A, V, K]
+    in_rank: Optional[np.ndarray] = None  # [A, V, K]
+    in_has: Optional[np.ndarray] = None  # [A, V]
+
+    @property
+    def has_dense(self) -> bool:
+        return self.in_src is not None
 
     @property
     def num_areas(self) -> int:
@@ -505,6 +643,31 @@ def encode_multi_area(
         overloaded=np.stack([t.overloaded for t in topos]),
         soft=np.stack([t.soft for t in topos]),
         roots=np.asarray([t.node_id(me) for t in topos], np.int32),
+        **_stack_dense(topos),
+    )
+
+
+def _stack_dense(topos: List[EncodedTopology]) -> dict:
+    """Stack per-area dense in-edge planes to a common K bucket; {} of
+    Nones when any area declined the dense layout."""
+    if not topos or not all(t.has_dense for t in topos):
+        return {}
+    K = max(t.in_src.shape[1] for t in topos)
+
+    def widen(a, fill):
+        pad = K - a.shape[1]
+        if not pad:
+            return a
+        return np.concatenate(
+            [a, np.full((a.shape[0], pad), fill, a.dtype)], axis=1
+        )
+
+    return dict(
+        in_src=np.stack([widen(t.in_src, 0) for t in topos]),
+        in_w=np.stack([widen(t.in_w, INF) for t in topos]),
+        in_ok=np.stack([widen(t.in_ok, False) for t in topos]),
+        in_rank=np.stack([widen(t.in_rank, -1) for t in topos]),
+        in_has=np.stack([t.in_has for t in topos]),
     )
 
 
@@ -526,6 +689,25 @@ def patch_encoded_multi_area(
         if patched is None:
             return None
         topos.append(patched)
+    dense = {}
+    if prev.has_dense and all(t.has_dense for t in topos):
+        K = prev.in_src.shape[2]
+
+        def widen(a, fill):
+            pad = K - a.shape[1]
+            if not pad:
+                return a
+            return np.concatenate(
+                [a, np.full((a.shape[0], pad), fill, a.dtype)], axis=1
+            )
+
+        dense = dict(
+            in_src=prev.in_src,  # layout shared with the previous gen
+            in_rank=prev.in_rank,
+            in_has=prev.in_has,
+            in_w=np.stack([widen(t.in_w, INF) for t in topos]),
+            in_ok=np.stack([widen(t.in_ok, False) for t in topos]),
+        )
     return EncodedMultiArea(
         areas=areas,
         topos=topos,
@@ -536,6 +718,7 @@ def patch_encoded_multi_area(
         overloaded=np.stack([t.overloaded for t in topos]),
         soft=np.stack([t.soft for t in topos]),
         roots=prev.roots,
+        **dense,
     )
 
 

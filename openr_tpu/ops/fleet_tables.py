@@ -86,6 +86,134 @@ def fleet_multi_area_tables(
 @functools.partial(
     jax.jit, static_argnames=("max_degree", "per_area_distance")
 )
+def fleet_multi_area_tables_dense(
+    in_src,  # [A, V, K] dense in-edge planes (ops/csr.py)
+    in_w,  # [A, V, K]
+    in_ok,  # [A, V, K]
+    in_rank,  # [A, V, K]
+    in_has,  # [A, V]
+    overloaded,  # [A, V]
+    soft,  # [A, V]
+    roots,  # [B, A]
+    cand_area,
+    cand_node,
+    cand_ok,
+    drain_metric,
+    path_pref,
+    source_pref,
+    distance,
+    cand_node_in_area,
+    max_degree: int,
+    per_area_distance: bool,
+):
+    """Dense (gather-formulation) twin of :func:`fleet_multi_area_tables`
+    — same outputs, no scatter in the per-root SPF fixpoints.  The
+    dense in-edge planes are root-independent, so the whole vantage
+    batch shares them."""
+    from openr_tpu.ops.route_select import (
+        multi_area_select_from_tables,
+        multi_area_spf_tables_dense,
+    )
+
+    def one(r):  # r: [A] per-area root ids
+        area_ok = r >= 0
+        dist, nh = multi_area_spf_tables_dense(
+            in_src,
+            in_w,
+            in_ok,
+            in_rank,
+            in_has,
+            overloaded,
+            jnp.maximum(r, 0),
+            max_degree=max_degree,
+        )
+        dist = jnp.where(area_ok[:, None], dist, BIG)
+        nh = jnp.where(area_ok[:, None, None], nh, jnp.int8(0))
+        return multi_area_select_from_tables(
+            dist,
+            nh,
+            overloaded,
+            soft,
+            cand_area,
+            cand_node,
+            cand_ok,
+            drain_metric,
+            path_pref,
+            source_pref,
+            distance,
+            cand_node_in_area,
+            per_area_distance=per_area_distance,
+        )
+
+    return jax.vmap(one)(roots)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_degree", "per_area_distance")
+)
+def fleet_multi_area_tables_dense_delta(
+    in_src,
+    in_w,
+    in_ok,
+    in_rank,
+    in_has,
+    overloaded,
+    soft,
+    roots,  # [B, A]
+    cand_area,
+    cand_node,
+    cand_ok,
+    drain_metric,
+    path_pref,
+    source_pref,
+    distance,
+    cand_node_in_area,
+    prev_use,  # [B, P, C] previous generation's chunk outputs
+    prev_shortest,  # [B, P, A]
+    prev_lanes,  # [B, P, A, D]
+    prev_valid,  # [B, P, A]
+    max_degree: int,
+    per_area_distance: bool,
+):
+    """Fleet tables + on-device generation delta: solve the vantage
+    chunk, diff every ROOT row against the previous generation's
+    device-resident outputs, and return ``(use, shortest, lanes, valid,
+    changed [B] bool)`` — the host fetches the tiny mask and then only
+    the changed roots' rows (compacted), so a small perturbation's
+    fleet refresh moves route deltas over the boundary instead of the
+    whole [B, P] table."""
+    use, shortest, lanes, valid = fleet_multi_area_tables_dense(
+        in_src,
+        in_w,
+        in_ok,
+        in_rank,
+        in_has,
+        overloaded,
+        soft,
+        roots,
+        cand_area,
+        cand_node,
+        cand_ok,
+        drain_metric,
+        path_pref,
+        source_pref,
+        distance,
+        cand_node_in_area,
+        max_degree=max_degree,
+        per_area_distance=per_area_distance,
+    )
+    changed = (
+        jnp.any(use != prev_use, axis=(1, 2))
+        | jnp.any(valid != prev_valid, axis=(1, 2))
+        | jnp.any(shortest != prev_shortest, axis=(1, 2))
+        | jnp.any(lanes != prev_lanes, axis=(1, 2, 3))
+    )
+    return use, shortest, lanes, valid, changed
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_degree", "per_area_distance")
+)
 def whatif_multi_area_tables(
     src,  # [A, E]
     dst,  # [A, E]

@@ -168,6 +168,35 @@ def multi_area_spf_tables(
 
 
 @functools.partial(jax.jit, static_argnames=("max_degree",))
+def multi_area_spf_tables_dense(
+    in_src,  # [A, V, K] dense in-edge sources (ops/csr.py)
+    in_w,  # [A, V, K]
+    in_ok,  # [A, V, K]
+    in_rank,  # [A, V, K] out-edge rank of each in-edge (-1 = none)
+    in_has,  # [A, V]
+    overloaded,  # [A, V]
+    roots,  # [A]
+    max_degree: int,
+):
+    """Dense (gather-formulation) twin of :func:`multi_area_spf_tables`:
+    same (dist [A, V], nh [A, V, D]) tables, computed without scatter —
+    the relax/propagate steps are gathers + dense reductions over the
+    encoder's in-edge matrix (ops/spf.py dense kernels).  Bit-parity
+    with the segment kernels is test-enforced; the backend picks this
+    path whenever the encoding carries the dense planes."""
+    from openr_tpu.ops.spf import dense_spf_one
+
+    def one_area(isrc, iw, iok, irk, ihs, ovl, root):
+        return dense_spf_one(
+            isrc, iw, iok, irk, ihs, ovl, root, max_degree
+        )
+
+    return jax.vmap(one_area)(
+        in_src, in_w, in_ok, in_rank, in_has, overloaded, roots
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
 def warm_multi_area_spf_tables(
     src,  # [A, E] the NEW generation's edge lists
     dst,  # [A, E]
@@ -333,6 +362,87 @@ def multi_area_select_from_tables(
     num_nh = jnp.sum(lanes.astype(jnp.int32), axis=2)  # [P, A]
     valid = jnp.any(mc, axis=1) & (num_nh > 0)  # [P, A]
     return use, shortest, lanes, valid
+
+
+@functools.partial(jax.jit, static_argnames=("per_area_distance",))
+def multi_area_select_delta_from_tables(
+    dist,  # [A, V]
+    nh,  # [A, V, D]
+    overloaded,  # [A, V]
+    soft,  # [A, V]
+    cand_area,  # [P, C]
+    cand_node,  # [P, C]
+    cand_ok,  # [P, C]
+    drain_metric,  # [P, C]
+    path_pref,  # [P, C]
+    source_pref,  # [P, C]
+    distance,  # [P, C]
+    cand_node_in_area,  # [P, C, A]
+    prev_use,  # [P, C] previous generation's selection outputs
+    prev_shortest,  # [P, A]
+    prev_lanes,  # [P, A, D]
+    prev_valid,  # [P, A]
+    node_changed,  # [A, V] bool — nodes whose drain inputs (overloaded /
+    #                soft) moved since the previous generation; rows
+    #                touching one must re-decode even when their
+    #                selection OUTPUTS are identical, because the host
+    #                decode wraps the winning entry in drained_entry()
+    #                from LinkState, not from these outputs
+    per_area_distance: bool,
+):
+    """Fused selection + on-device generation delta: run the full
+    selection chain, then diff every row against the PREVIOUS
+    generation's outputs on device — the DeltaPath move that lets route
+    *deltas* cross the host boundary instead of full (use, shortest,
+    lanes, valid) tables.  Returns ``(use, shortest, lanes, valid,
+    changed [P] bool)``; only ``changed`` needs to be fetched eagerly —
+    the caller then gathers the changed rows (compacted) or falls back
+    to a full fetch when most of the table moved."""
+    use, shortest, lanes, valid = multi_area_select_from_tables(
+        dist,
+        nh,
+        overloaded,
+        soft,
+        cand_area,
+        cand_node,
+        cand_ok,
+        drain_metric,
+        path_pref,
+        source_pref,
+        distance,
+        cand_node_in_area,
+        per_area_distance=per_area_distance,
+    )
+    changed = (
+        jnp.any(use != prev_use, axis=1)
+        | jnp.any(valid != prev_valid, axis=1)
+        | jnp.any(shortest != prev_shortest, axis=1)
+        | jnp.any(lanes != prev_lanes, axis=(1, 2))
+    )
+    # drain-state touches (see node_changed note above)
+    touch_own = jnp.any(
+        node_changed[cand_area, cand_node] & cand_ok, axis=1
+    )
+    A = dist.shape[0]
+    cnia_ok = (cand_node_in_area >= 0) & cand_ok[:, :, None]
+    a_idx = jnp.arange(A, dtype=cand_area.dtype)[None, None, :]
+    touch_x = jnp.any(
+        cnia_ok
+        & node_changed[a_idx, jnp.maximum(cand_node_in_area, 0)],
+        axis=(1, 2),
+    )
+    changed = changed | touch_own | touch_x
+    return use, shortest, lanes, valid, changed
+
+
+@jax.jit
+def gather_selection_rows(use, shortest, lanes, valid, idx):
+    """On-device compaction of changed selection rows: ``idx`` [G] is
+    the (bucket-padded) changed-row index list; the gathered slices are
+    what actually crosses the host boundary on a delta build."""
+    return tuple(
+        jnp.take(a, idx, axis=0) for a in (use, shortest, lanes, valid)
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("max_degree",))

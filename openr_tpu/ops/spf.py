@@ -268,6 +268,145 @@ def hop_count_weights(w: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Dense in-edge (gather) kernels
+# ---------------------------------------------------------------------------
+# The segment-reduction fixpoints above lower to general scatter on host
+# platforms, where each relaxation round costs a serial pass over the
+# edge list — BENCH_PIPELINE_r01 measured the two loops at ~95% of a
+# grid4096 cold rebuild (~505ms hiding inside the device_get barrier).
+# The dense formulation consumes the encoder's [V, K] in-edge matrix
+# (ops/csr.py build_in_edge_matrix): the relax step is a pure gather
+# ``d[in_src] + in_w`` plus a dense min over K, and lane propagation is a
+# gather + dense max — no scatter anywhere, vectorizing cleanly on CPU
+# and mapping to plain gather/reduce ops on TPU.  Both loops compute the
+# same fixed points as their segment twins (bit-parity enforced by
+# tests/test_stream_delta.py) and unroll DENSE_UNROLL rounds per
+# while_loop iteration to amortize loop-carry overhead — extra rounds
+# past the fixed point are exact no-ops.
+
+#: relaxation rounds per while_loop iteration in the dense kernels
+DENSE_UNROLL = 8
+
+
+def dense_spf_distances(
+    in_src,  # [V, K] int32 in-edge sources (0 on padding slots)
+    in_w,  # [V, K] f32 (INF on padding/down slots)
+    in_ok,  # [V, K] bool
+    overloaded,  # [V] bool
+    root,  # scalar int32
+    max_iters: Optional[int] = None,
+) -> jnp.ndarray:
+    """Single-source shortest distances over the dense in-edge matrix.
+    Returns [V] f32 with BIG for unreachable nodes — bit-identical to
+    :func:`spf_distances` (same relaxation equations, integral metrics
+    keep every f32 path sum exact)."""
+    V = in_src.shape[0]
+    transit = _can_transit(overloaded, root)
+    ok = in_ok & transit[in_src]
+    ww = jnp.where(ok, in_w, BIG).astype(jnp.float32)
+    dist0 = jnp.full((V,), BIG, jnp.float32).at[root].set(0.0)
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    def relax(d):
+        cand = jnp.min(d[in_src] + ww, axis=1)
+        return jnp.minimum(d, cand)
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        d, _, i = state
+        nd = d
+        for _ in range(DENSE_UNROLL):
+            nd = relax(nd)
+        return nd, jnp.any(nd < d), i + DENSE_UNROLL
+
+    dist, _, _ = jax.lax.while_loop(
+        cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
+    )
+    return dist
+
+
+def dense_spf_nexthop_lanes(
+    in_src,  # [V, K]
+    in_w,  # [V, K]
+    in_ok,  # [V, K]
+    in_rank,  # [V, K] int32 out-edge rank of the in-edge (-1 = none)
+    in_has,  # [V] bool — v appears in the padded edge list's dst[] at all
+    overloaded,  # [V]
+    root,
+    dist,  # [V] from dense_spf_distances
+    max_degree: int,
+    max_iters: Optional[int] = None,
+) -> jnp.ndarray:
+    """All-shortest-paths first-hop sets as [V, D] int8 over the dense
+    in-edge matrix — BIT-IDENTICAL to :func:`spf_nexthop_lanes`,
+    including the int8-min fill the segment reduction leaves on dsts
+    absent from the edge list (``in_has`` masks them), so warm contexts
+    seeded from either formulation interchange freely.  ``in_rank`` is
+    root-independent (rank among same-src edges in edge order), so the
+    seed for any root is just ``in_src == root``."""
+    V, _K = in_src.shape
+    D = max_degree
+    transit = _can_transit(overloaded, root)
+    ok = in_ok & transit[in_src]
+    ww = jnp.where(ok, in_w, BIG)
+    # on-DAG in-edges: reached dst whose distance equals src dist + w
+    sp = ok & (dist[in_src] + ww == dist[:, None]) & (dist[:, None] < BIG)
+    is_root = in_src == root
+    lanes = jnp.arange(D, dtype=jnp.int32)[None, None, :]
+    seed = (
+        (sp & is_root)[:, :, None] & (in_rank[:, :, None] == lanes)
+    ).astype(jnp.int8)
+    empty = jnp.full((V, D), jnp.iinfo(jnp.int8).min, jnp.int8)
+    nh0 = jnp.where(in_has[:, None], jnp.max(seed, axis=1), empty)
+    prop = (sp & ~is_root)[:, :, None].astype(jnp.int8)  # [V, K, 1]
+    limit = jnp.int32(max_iters if max_iters is not None else V)
+
+    def relax(nh):
+        contrib = jnp.max(nh[in_src] * prop, axis=1)
+        return jnp.where(
+            in_has[:, None], jnp.maximum(nh, contrib), nh
+        )
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < limit)
+
+    def body(state):
+        nh, _, i = state
+        new = nh
+        for _ in range(DENSE_UNROLL):
+            new = relax(new)
+        return new, jnp.any(new != nh), i + DENSE_UNROLL
+
+    nh, _, _ = jax.lax.while_loop(
+        cond, body, (nh0, jnp.bool_(True), jnp.int32(0))
+    )
+    return nh
+
+
+def dense_spf_one(
+    in_src,
+    in_w,
+    in_ok,
+    in_rank,
+    in_has,
+    overloaded,
+    root,
+    max_degree: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(dist [V], nexthop lanes [V, D]) over the dense in-edge matrix."""
+    dist = dense_spf_distances(in_src, in_w, in_ok, overloaded, root)
+    nh = dense_spf_nexthop_lanes(
+        in_src, in_w, in_ok, in_rank, in_has, overloaded, root, dist,
+        max_degree,
+    )
+    return dist, nh
+
+
+# ---------------------------------------------------------------------------
 # Warm-start (generation-delta) kernels
 # ---------------------------------------------------------------------------
 # The cold kernels above pay O(hop-diameter) relaxation rounds from an

@@ -154,6 +154,16 @@ class DevicePool:
         #: pool is the shared dispatch plane), read by the pipeline
         #: attribution gauges and `breeze resilience status`
         self.num_dispatches: List[int] = [0] * len(devices)
+        #: per-chip in-flight slot ledger for the streamed dispatch
+        #: loops: a dispatch occupies a slot (`note_inflight`) until its
+        #: streamed completion drains it (`note_complete`), so a
+        #: committed dispatch never queues behind — or waits on — an
+        #: UNRELATED chip: the double-buffer loop checks `inflight()`
+        #: per chip and drains only that chip's oldest work.
+        self.num_inflight: List[int] = [0] * len(devices)
+        #: high-watermark of concurrent in-flight dispatches per chip —
+        #: the observable proof the double-buffer loop actually overlaps
+        self.max_inflight: List[int] = [0] * len(devices)
 
     # -- read surface ------------------------------------------------------
 
@@ -185,6 +195,22 @@ class DevicePool:
         per-shard dispatch loops alongside the actual device_put/jit
         call — the pool's view of how work actually spread)."""
         self.num_dispatches[index] += 1
+
+    def note_inflight(self, index: int) -> None:
+        """A committed dispatch on chip ``index`` entered flight (its
+        outputs are not yet drained).  Counts the dispatch too."""
+        self.num_dispatches[index] += 1
+        self.num_inflight[index] += 1
+        if self.num_inflight[index] > self.max_inflight[index]:
+            self.max_inflight[index] = self.num_inflight[index]
+
+    def note_complete(self, index: int) -> None:
+        """Chip ``index``'s oldest in-flight dispatch was drained."""
+        if self.num_inflight[index] > 0:
+            self.num_inflight[index] -= 1
+
+    def inflight(self, index: int) -> int:
+        return self.num_inflight[index]
 
     def lead_index(self) -> Optional[int]:
         """Lowest-indexed healthy device (single-device dispatch target);
@@ -261,6 +287,8 @@ class DevicePool:
             "quarantines": self.num_quarantines,
             "restores": self.num_restores,
             "dispatches": list(self.num_dispatches),
+            "inflight": list(self.num_inflight),
+            "max_inflight": list(self.max_inflight),
             "devices": [str(d) for d in self.devices],
         }
 
@@ -273,6 +301,9 @@ class DevicePool:
         }
         for i, n in enumerate(self.num_dispatches):
             out[f"{prefix}.dev{i}.dispatches"] = float(n)
+            out[f"{prefix}.dev{i}.max_inflight"] = float(
+                self.max_inflight[i]
+            )
         return out
 
 

@@ -327,6 +327,33 @@ class BackendHealthGovernor:
                 f"dispatch:{type(exc).__name__}" if exc is not None else "dispatch"
             )
 
+    def record_stream_failure(
+        self, index: int, exc: Optional[BaseException] = None
+    ) -> None:
+        """ONE chip's streamed shard failed at drain time.  Unlike the
+        old all-shard fetch barrier (where a raise was unattributable
+        and scored the whole-backend breaker), a streamed completion
+        names the failing chip: quarantine IT individually so the
+        in-progress build re-packs its rows onto the survivors, and
+        leave recovery to the normal per-chip half-open probe cycle —
+        no fault owner needs to heal it first."""
+        reason = (
+            f"stream:{type(exc).__name__}" if exc is not None else "stream"
+        )
+        self._chip_breaker(index).force_open()
+        self._chip_reasons[index] = reason
+        self.num_dispatch_failures += 1
+        self.counters.bump("resilience.backend.dispatch_failures")
+        was = self.quarantined
+        pool = self.backend.pool
+        if pool.quarantine_device(index):
+            self.num_chip_quarantines += 1
+            self.counters.bump("resilience.backend.chip_quarantines")
+            self._notify_quarantine({"reason": reason, "device": int(index)})
+        self._sync_latch()
+        if not was and self.quarantined:
+            self._note_quarantine(f"device{index}:{reason}")
+
     def after_device_build(
         self, db, area_link_states, prefix_state, probe: bool = False
     ) -> Tuple[object, bool]:

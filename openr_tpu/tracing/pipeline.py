@@ -30,6 +30,13 @@ phase              meaning
 ``warm_repair``    the warm-start repair kernel dispatch: re-relaxing
                    only the perturbed frontier from the previous
                    generation's device-resident tables
+``stream_drain``   waiting for ONE in-flight shard to complete in the
+                   streamed-completion dispatch loop; per-device
+                   attributable — the window charges only the chip whose
+                   shard it drained, never unrelated in-flight chips
+``device_select``  the on-device delta-extraction dispatch: the fused
+                   selection+changed-row kernel and the compacted
+                   changed-row gather that replaces a full-table fetch
 =================  ========================================================
 
 Surfaces: every phase sample lands in a ``pipeline.{phase}.ms``
@@ -61,6 +68,8 @@ DECODE = "decode"
 DELTA_EXTRACT = "delta_extract"
 WARM_PLAN = "warm_plan"
 WARM_REPAIR = "warm_repair"
+STREAM_DRAIN = "stream_drain"
+DEVICE_SELECT = "device_select"
 
 PHASES = (
     HOST_FETCH,
@@ -73,6 +82,8 @@ PHASES = (
     DELTA_EXTRACT,
     WARM_PLAN,
     WARM_REPAIR,
+    STREAM_DRAIN,
+    DEVICE_SELECT,
 )
 
 #: phases only the warm-start generation-delta rebuild exercises — a
@@ -80,11 +91,25 @@ PHASES = (
 #: attribution gates treat them as optional coverage
 WARM_PHASES = (WARM_PLAN, WARM_REPAIR)
 
+#: phases only the on-device delta-extraction path exercises: a build
+#: whose generation delta is too wide (or whose previous outputs were
+#: purged) fetches full tables and legitimately records nothing here
+DELTA_PHASES = (DEVICE_SELECT,)
+
 #: phases whose time is host-side work (the pipelining refactor's
 #: overlap candidates) vs the device round trip — the host/device split
-#: BENCH_PIPELINE reports
+#: BENCH_PIPELINE reports.  ``stream_drain`` counts as device time: it
+#: is the host blocked on one chip's in-flight shard (the streamed
+#: replacement for the old all-shard device_get barrier).
 HOST_PHASES = (HOST_FETCH, ENCODE, PAD_PACK, DECODE, DELTA_EXTRACT, WARM_PLAN)
-DEVICE_PHASES = (TRANSFER, DEVICE_COMPUTE, DEVICE_GET, WARM_REPAIR)
+DEVICE_PHASES = (
+    TRANSFER,
+    DEVICE_COMPUTE,
+    DEVICE_GET,
+    WARM_REPAIR,
+    STREAM_DRAIN,
+    DEVICE_SELECT,
+)
 
 _PREFIX = "pipeline."
 
@@ -171,9 +196,13 @@ class _PhaseScope:
         if self._device is not None:
             probe.note_busy(self._device, ms)
         if self._devices:
-            # a blocking drain covering several in-flight chips charges
-            # the window to every one of them (the chip had committed
-            # work outstanding for the whole wait)
+            # a TRUE all-chip barrier charges the window to every chip
+            # it covered.  The streamed-completion dispatch loops never
+            # take this path any more — each stream_drain window passes
+            # ``device=`` and charges ONLY the completing chip, so
+            # pipeline.devN.utilization stays honest under overlap
+            # (BENCH_PIPELINE_r01's mode note about fractions exceeding
+            # wall share documented exactly this former overcount).
             for d in self._devices:
                 probe.note_busy(d, ms)
 

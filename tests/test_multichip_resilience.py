@@ -136,6 +136,48 @@ def test_full_build_shards_across_the_pool_with_parity():
     assert norm_db(db) == norm_db(SpfSolver("node0").build_route_db(als, ps))
 
 
+def test_mid_stream_chip_failure_quarantines_and_recovery_is_probed():
+    """ISSUE-11 satellite: a shard failing at streamed drain time
+    quarantines ITS chip via ``governor.record_stream_failure`` (unlike
+    the old unattributable barrier raise, which scored the WHOLE-backend
+    breaker), the build re-packs its exact row range onto survivors with
+    no rows dropped or duplicated, and the chip earns its way back
+    through the normal per-chip half-open probe cycle — no fault owner
+    heal needed."""
+    clock = SimClock()
+    als, ps = make_world()
+    backend = make_backend(clock)
+    fired = []
+
+    def fault(dev_index):
+        if dev_index == 2 and not fired:
+            fired.append(dev_index)
+            raise RuntimeError("injected stream failure")
+
+    backend._stream_fault = fault
+    db = backend.build_route_db(als, ps)
+    assert fired == [2]
+    assert backend.num_stream_repacks == 1
+    assert not backend.pool.is_healthy(2)
+    assert norm_db(db) == norm_db(
+        SpfSolver("node0").build_route_db(als, ps)
+    )
+    backend._stream_fault = None
+    # the next build excludes the chip and stays correct
+    db2 = backend.build_route_db(als, ps)
+    assert 2 not in {d for d, _lo, _hi in (backend._attr_plan or ())}
+    assert norm_db(db2) == norm_db(
+        SpfSolver("node0").build_route_db(als, ps)
+    )
+    # after the breaker hold elapses, the chip probes back in on its
+    # own (NOT injected-latched like chaos tpu_fail) and is restored
+    clock._now += 60.0
+    for _ in range(4):
+        backend.build_route_db(als, ps)
+        clock._now += 60.0
+    assert backend.pool.is_healthy(2)
+
+
 def test_one_corrupt_chip_is_quarantined_individually():
     als, ps = make_world()
     backend = make_backend(SimClock())

@@ -163,10 +163,28 @@ def test_pipeline_registry_rule_covers_warm_phase_names():
         assert [f.rule for f in findings] == ["pipeline-phase-registry"], (
             spelled
         )
+
+
+def test_pipeline_registry_rule_covers_stream_phase_names():
+    """ISSUE-11 satellite: the streaming phases (stream_drain,
+    device_select) are registry-governed — a free spelling anywhere
+    outside the registry trips pipeline-phase-registry."""
+    for spelled in (
+        '"pipeline.stream_drain.ms"',
+        '"pipeline.device_select.ms"',
+        '"pipeline.stream_drain"',
+    ):
+        src = f"def record(counters):\n    counters.observe({spelled}, 1.0)\n"
+        findings = analyze_source(src)
+        assert [f.rule for f in findings] == ["pipeline-phase-registry"], (
+            spelled
+        )
     # and the registry itself exposes them (no free spelling needed)
     from openr_tpu.tracing import pipeline
 
     assert pipeline.hist_key(pipeline.WARM_PLAN).startswith("pipeline.")
+    assert pipeline.hist_key(pipeline.STREAM_DRAIN).startswith("pipeline.")
+    assert pipeline.span_name(pipeline.DEVICE_SELECT).startswith("pipeline.")
 
 
 @pytest.mark.parametrize("rule", sorted(FIXTURES))

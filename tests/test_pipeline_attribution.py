@@ -118,6 +118,43 @@ def test_warm_rebuild_records_warm_phases():
         assert h is not None and h.count >= 1, phase
 
 
+def test_stream_phases_registered():
+    """ISSUE-11 satellite: the streaming phases are first-class registry
+    members — stream_drain is device time (the host blocked on ONE
+    chip's in-flight shard), device_select is the on-device
+    delta-extraction dispatch, and the bench treats DELTA_PHASES as
+    optional coverage exactly like WARM_PHASES."""
+    assert pipeline.STREAM_DRAIN in pipeline.PHASES
+    assert pipeline.DEVICE_SELECT in pipeline.PHASES
+    assert pipeline.DELTA_PHASES == (pipeline.DEVICE_SELECT,)
+    assert pipeline.span_name(pipeline.STREAM_DRAIN) == "pipeline.stream_drain"
+    assert pipeline.hist_key(pipeline.DEVICE_SELECT) == (
+        "pipeline.device_select.ms"
+    )
+    assert pipeline.STREAM_DRAIN in pipeline.DEVICE_PHASES
+    assert pipeline.DEVICE_SELECT in pipeline.DEVICE_PHASES
+
+
+def test_streamed_build_attributes_drain_to_completing_chip():
+    """Each stream_drain span carries exactly ONE device attr (the
+    completing chip) — never the whole in-flight set the old device_get
+    barrier charged."""
+    als, ps = make_world()
+    clock = SimClock()
+    counters = CounterMap()
+    tracer = Tracer("node0", clock=clock, counters=counters)
+    backend = make_backend(clock, counters, tracer)
+    backend.build_route_db(als, ps)
+    drains = [
+        s for s in tracer.get_spans() if s.name == "pipeline.stream_drain"
+    ]
+    assert drains, "streamed build recorded no stream_drain spans"
+    plan_devs = {d for d, _lo, _hi in backend._attr_plan}
+    assert {s.attrs["device"] for s in drains} == plan_devs
+    # one drain window per shard; busy ledger covers every planned chip
+    assert len(drains) == len(plan_devs)
+
+
 def test_device_gauge_keys():
     assert pipeline.device_busy_key(3) == "pipeline.dev3.busy_ms"
     assert pipeline.device_utilization_key(0) == "pipeline.dev0.utilization"
