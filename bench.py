@@ -2728,6 +2728,405 @@ def suite_main(seed: Optional[int] = None) -> None:
     print(json.dumps(doc))
 
 
+# ---------------------------------------------------------------------------
+# rolling-restart survival (ISSUE 12): BENCH_ROLLING_r*
+# ---------------------------------------------------------------------------
+
+ROLLING_CLASS = "grid"
+ROLLING_SCALE = 64
+ROLLING_SMOKE_SCALE = 36
+ROLLING_SEED = 11
+ROLLING_DOWN_S = 5.0
+ROLLING_SETTLE_S = 6.0
+
+
+def validate_rolling_bench(doc: dict) -> None:
+    """Schema contract for BENCH_ROLLING_r*.json — shared by the bench
+    emitter, the tier-1 artifact gate and the benchtrack manifest.  The
+    headline is the STRUCTURAL warm-hit ratio over a rolling-restart
+    sweep (every non-observer node bounced exactly once through the
+    supervisor's storm-guarded queue): before the slot-stable encode it
+    was 0 by construction.  The publication→FIB percentiles must hold
+    the per-class SLO for the whole upgrade, the health plane must stay
+    silent, and the seeded smoke must replay byte-identically."""
+    assert doc["metric"] == "rolling_restart_structural_warm_hit_ratio"
+    assert doc["unit"] == "ratio"
+    d = doc["detail"]
+    assert d["topology_class"] == ROLLING_CLASS
+    sweep = d["sweep"]
+    # every node except the measurement observer bounces exactly once,
+    # and the restart-storm guard keeps the fleet from going down at
+    # once (default cap: 1 in-flight restart)
+    assert sweep["nodes_bounced"] == d["nodes"] - 1
+    assert sweep["restarts"] == sweep["nodes_bounced"]
+    assert sweep["max_concurrent_observed"] == 1
+    assert sweep["crashes"] == 0, "deliberate restarts must not latch"
+    w = d["warm"]
+    assert 0.0 <= w["structural_hit_ratio"] <= 1.0
+    assert doc["value"] == w["structural_hit_ratio"]
+    # each bounce produces at least one structural tick at the observer
+    # (leave + rejoin, possibly debounce-coalesced)
+    assert w["structural_hits"] >= sweep["nodes_bounced"]
+    assert w["slot_patches"] >= w["structural_hits"]
+    conv = d["convergence"]
+    assert conv["samples"] > 0
+    assert (
+        0
+        < conv["p50_ms"]
+        <= conv["p95_ms"]
+        <= conv["p99_ms"]
+        <= conv["max_ms"]
+    )
+    slo = d["slo"]
+    assert slo["convergence_slo_ms"] > 0
+    assert slo["p99_within_slo"] is (
+        conv["p99_ms"] <= slo["convergence_slo_ms"]
+    )
+    assert slo["p99_within_slo"], (
+        f"p99 {conv['p99_ms']}ms blew the per-class SLO "
+        f"{slo['convergence_slo_ms']}ms mid-upgrade"
+    )
+    alerts = d["alerts"]
+    assert alerts["unexpected"] == 0, (
+        f"unexpected health alerts fired during the upgrade: {alerts}"
+    )
+    assert d["serving"]["queries"] > 0, "the sweep must run under load"
+    assert d["smoke"]["nodes"] <= ROLLING_SMOKE_SCALE
+    assert d["deterministic_replay"] is True
+    for key in ("seed", "mode", "env"):
+        assert key in d, key
+    for key in ("platform", "jax", "device_count"):
+        assert key in d["env"], f"env.{key}"
+
+
+def rolling_sweep_world(
+    scale: int,
+    seed: int,
+    down_s: float = ROLLING_DOWN_S,
+    settle_s: float = ROLLING_SETTLE_S,
+):
+    """One rolling-restart survival round through the SimClock protocol
+    emulation: boot a grid-class fleet (scalar decision path + ONE
+    device-backend observer carrying warm rebuild, the health plane and
+    the per-class SLO catalog — the suite's shape), converge, then
+    bounce every non-observer node exactly once via the supervisor's
+    storm-guarded deliberate-restart queue, with a down window past the
+    Spark hold timer (neighbors must really observe the leave) and a
+    serving-query load riding the observer throughout.
+
+    Returns ``(detail, fingerprint)`` — fingerprint covers the bounce
+    log, the supervisor restart log, the health alert JSONL and the
+    convergence histogram buckets: two runs from one seed must match
+    byte for byte."""
+    import asyncio
+    import random as _random
+    import zlib
+
+    from openr_tpu.chaos import RollingRestartSweep, Supervisor
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.config import SloSpecConfig
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import (
+        TOPOLOGY_CLASSES,
+        topology_nodes,
+    )
+    from openr_tpu.health.slo import slos_for_topology_class
+    from openr_tpu.types import PrefixEntry
+
+    row = TOPOLOGY_CLASSES[ROLLING_CLASS]
+    edges = row.build(scale, seed)
+    names = topology_nodes(edges)
+    observer = names[0]
+    rng = _random.Random(
+        zlib.crc32(b"rolling") ^ (seed * 2654435761)
+    )
+    anchors = sorted(rng.sample(names, min(SUITE_ANCHORS, len(names))))
+    anchor_prefix = {a: f"10.212.{i}.0/24" for i, a in enumerate(anchors)}
+    slo_specs = slos_for_topology_class(ROLLING_CLASS)
+
+    def overrides(cfg):
+        is_obs = cfg.node_name == observer
+        cfg.tpu_compute_config.enable_tpu_spf = is_obs
+        if is_obs:
+            cfg.tpu_compute_config.min_device_prefixes = 0
+        hc = cfg.health_config
+        hc.enabled = is_obs
+        hc.sweep_interval_s = 5.0
+        hc.slos = [
+            SloSpecConfig(
+                name=s.name,
+                metric=s.metric,
+                kind=s.kind,
+                percentile=s.percentile,
+                threshold=s.threshold,
+                objective=s.objective,
+                fast_window_s=s.fast_window_s,
+                slow_window_s=s.slow_window_s,
+                burn_threshold=s.burn_threshold,
+            )
+            for s in slo_specs
+        ]
+
+    async def run():
+        clock = SimClock()
+        net = EmulatedNetwork(
+            clock, use_tpu_backend=None, config_overrides=overrides
+        )
+        net.build(edges)
+        net.start(advertise_loopbacks=False)
+        for a in anchors:
+            net.nodes[a].advertise_prefixes([PrefixEntry(anchor_prefix[a])])
+        all_prefixes = set(anchor_prefix.values())
+
+        def anchors_routed():
+            for name, node in net.nodes.items():
+                want = all_prefixes - {anchor_prefix.get(name)}
+                if want - set(net.fib_routes(name)):
+                    return False
+            return True
+
+        converged = False
+        for _ in range(30):
+            await clock.run_for(4.0)
+            if anchors_routed():
+                converged = True
+                break
+        assert converged, f"rolling@{scale}: anchors never converged"
+
+        # baseline reset: only sweep-driven convergence is scored; the
+        # incarnation stamp survives (a reset start_ms would read as a
+        # crash to the health plane's latch)
+        for node in net.nodes.values():
+            start_ms = node.counters.get("node.start_ms")
+            node.counters.clear()
+            node.counters.set("node.start_ms", start_ms)
+        obs = net.nodes[observer]
+        be = obs.decision.backend
+        sh0 = dict(be._warm_class_builds)
+        sf0 = dict(be._warm_class_fallbacks)
+        slot0 = be.num_encode_slot_patches
+        purge0 = be.num_warm_purges
+        t_mark_ms = clock.now_ms()
+
+        supervisor = Supervisor(clock)
+
+        async def restart_and_readvertise(name):
+            # a production daemon re-reads its configured prefixes at
+            # boot; the anchor advertisements are harness-owned config,
+            # so the harness restores them on the replacement node
+            node = await net.restart_node(name)
+            if name in anchor_prefix:
+                node.advertise_prefixes(
+                    [PrefixEntry(anchor_prefix[name])]
+                )
+            return node
+
+        sweep = RollingRestartSweep(
+            net,
+            supervisor,
+            seed=seed,
+            down_s=down_s,
+            settle_s=settle_s,
+            skip=(observer,),
+            restart_fn=restart_and_readvertise,
+        )
+        serving_stats = {"queries": 0, "errors": 0}
+        serving_alive = [True]
+
+        async def serving_load():
+            # "under serving load": a route_db query per tick against
+            # the observer's serving plane, vantage rotating over the
+            # anchors — rides the device fleet engine while the sweep
+            # churns under it
+            i = 0
+            while serving_alive[0]:
+                target = anchors[i % len(anchors)]
+                try:
+                    await obs.serving.submit(
+                        "route_db", {"node": target}, client_id="bench"
+                    )
+                    serving_stats["queries"] += 1
+                except Exception:  # noqa: BLE001 - shed/quota under churn
+                    serving_stats["errors"] += 1
+                i += 1
+                await clock.sleep(3.0)
+
+        load_task = asyncio.ensure_future(serving_load())
+        sweep_task = asyncio.ensure_future(sweep.run())
+        while not sweep_task.done():
+            await clock.run_for(2.0)
+        sweep_task.result()
+        settled = False
+        for _ in range(20):
+            await clock.run_for(4.0)
+            if anchors_routed():
+                settled = True
+                break
+        serving_alive[0] = False
+        await clock.run_for(4.0)
+        load_task.cancel()
+        assert settled, (
+            f"rolling@{scale}: anchors lost after the upgrade completed"
+        )
+
+        # publication→FIB at the STABLE vantage (the observer): a
+        # freshly reborn node's full sync re-delivers keys whose
+        # embedded trace contexts join their ORIGINAL origin events
+        # (PR-3 semantics), so its convergence samples measure key age,
+        # not propagation — the upgrade's latency story is what the
+        # surviving vantage experienced while the fleet churned under
+        # it
+        conv = obs.counters.histogram("convergence.event_to_fib_ms")
+        assert conv is not None and conv.count > 0
+        pct = conv.percentiles()
+
+        s_hits = be._warm_class_builds["structural"] - sh0["structural"]
+        s_fb = (
+            be._warm_class_fallbacks["structural"] - sf0["structural"]
+        )
+        p_hits = (
+            be._warm_class_builds["perturbation"] - sh0["perturbation"]
+        )
+
+        health = obs.health
+        fired_after_mark = []
+        if health is not None:
+            for line in health.alert_log():
+                e = json.loads(line)
+                if e["event"] == "fired" and e["ts_ms"] >= t_mark_ms:
+                    fired_after_mark.append(e["name"])
+        unexpected = sorted(fired_after_mark)
+
+        detail = {
+            "topology_class": ROLLING_CLASS,
+            "scale": scale,
+            "nodes": len(names),
+            "links": len({tuple(sorted((a, b))) for a, b, _m in edges}),
+            "seed": seed,
+            "observer": observer,
+            "anchors": len(anchors),
+            "virtual_s": round(clock.now(), 1),
+            "sweep": {
+                "nodes_bounced": sweep.num_bounced,
+                "down_s": down_s,
+                "settle_s": settle_s,
+                "restarts": supervisor.num_restarts,
+                "requested": supervisor.num_requested_restarts,
+                "crashes": supervisor.num_crashes,
+                "max_concurrent_observed": (
+                    supervisor.max_observed_concurrency
+                ),
+            },
+            "warm": {
+                "structural_hits": s_hits,
+                "structural_fallbacks": s_fb,
+                "structural_hit_ratio": round(
+                    s_hits / max(1, s_hits + s_fb), 3
+                ),
+                "perturbation_hits": p_hits,
+                "slot_patches": be.num_encode_slot_patches - slot0,
+                "slot_declines": dict(be._slot_decline_reasons),
+                "purges": be.num_warm_purges - purge0,
+            },
+            "convergence": {
+                "vantage": observer,
+                "p50_ms": round(pct["p50"], 2),
+                "p95_ms": round(pct["p95"], 2),
+                "p99_ms": round(pct["p99"], 2),
+                "max_ms": round(conv.vmax, 2),
+                "samples": conv.count,
+            },
+            "slo": {
+                "convergence_slo_ms": row.convergence_slo_ms,
+                "p99_within_slo": (
+                    round(pct["p99"], 2) <= row.convergence_slo_ms
+                ),
+            },
+            "alerts": {
+                "fired": len(fired_after_mark),
+                "unexpected": len(unexpected),
+                "unexpected_names": unexpected,
+                "health_sweeps": (
+                    health.num_sweeps if health is not None else 0
+                ),
+            },
+            "serving": dict(serving_stats),
+        }
+        fingerprint = b"\n".join(
+            [
+                sweep.fingerprint(),
+                health.sink.log_bytes() if health is not None else b"",
+                json.dumps(
+                    sorted(conv.bucket_items()), sort_keys=True
+                ).encode(),
+            ]
+        )
+        await net.stop()
+        return detail, fingerprint
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(run())
+    finally:
+        loop.close()
+
+
+def rolling_main(seed: Optional[int] = None) -> None:
+    """Rolling-restart survival benchmark (BENCH_ROLLING_r*): bounce
+    every non-observer node of a grid-class fleet exactly once through
+    the supervisor's storm-guarded queue, under serving load, and prove
+    the system never goes cold — structural warm-hit ratio as the
+    headline (0 before the slot-stable encode), publication→FIB p99
+    held within the per-class SLO for the entire upgrade, zero health
+    alerts, and the seeded smoke replayed twice for byte-identical
+    determinism.  Emits one JSON line."""
+    seed = ROLLING_SEED if seed is None else seed
+    t0 = time.time()
+    detail, _fp = rolling_sweep_world(ROLLING_SCALE, seed)
+    detail["wall_s"] = round(time.time() - t0, 1)
+    print(
+        f"# rolling grid@{detail['nodes']}: bounced "
+        f"{detail['sweep']['nodes_bounced']} structural warm-hit "
+        f"{detail['warm']['structural_hit_ratio']} p99 "
+        f"{detail['convergence']['p99_ms']}ms ({detail['wall_s']}s wall)",
+        file=sys.stderr,
+    )
+    d1, fp1 = rolling_sweep_world(ROLLING_SMOKE_SCALE, seed)
+    _d2, fp2 = rolling_sweep_world(ROLLING_SMOKE_SCALE, seed)
+    doc = {
+        "metric": "rolling_restart_structural_warm_hit_ratio",
+        "value": detail["warm"]["structural_hit_ratio"],
+        "unit": "ratio",
+        "detail": {
+            **detail,
+            "smoke": {
+                "scale": ROLLING_SMOKE_SCALE,
+                "nodes": d1["nodes"],
+                "nodes_bounced": d1["sweep"]["nodes_bounced"],
+                "structural_hit_ratio": (
+                    d1["warm"]["structural_hit_ratio"]
+                ),
+                "convergence": d1["convergence"],
+            },
+            "deterministic_replay": fp1 == fp2,
+            "mode": (
+                "emulate (SimClock, full OpenrNodes; scalar fleet + one "
+                "device-backend observer with warm rebuild, health plane "
+                "and per-class SLOs; every non-observer node bounced "
+                "once via the supervisor's storm-guarded queue, down "
+                "window past the Spark hold timer, serving load riding "
+                "the observer; virtual-ms percentiles.  Class params "
+                "derive from --scale: the 1k-node rerun of this sweep "
+                "is owed on faster iron — wall cost scales ~N^2 in the "
+                "in-process emulation)"
+            ),
+            "env": env_stamp(),
+        },
+    }
+    validate_rolling_bench(doc)
+    print(json.dumps(doc))
+
+
 def main() -> None:
     t_start = time.time()
     from openr_tpu.ops.platform_env import (
@@ -3172,6 +3571,7 @@ BENCH_MODES = {
     "health": (health_main, "world 11, detection (7,11,13)", "fleet health sweep overhead + detection latency"),
     "warm-start": (warmstart_main, "perturbations 7", "generation-delta warm rebuild vs cold + native warm sweep"),
     "suite": (suite_main, "sweeps 7", "topology-class trajectory: seeded chaos sweeps at 1k+ nodes per class"),
+    "rolling": (rolling_main, "sweep 11", "rolling-restart survival: every node bounced once, structural warm-hit + SLO hold"),
 }
 
 

@@ -16,6 +16,7 @@ from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
 from openr_tpu.analysis.passes.jax_hygiene import JaxHygienePass
 from openr_tpu.analysis.passes.pipeline_phase import PipelinePhasePass
 from openr_tpu.analysis.passes.resilience_latch import ResilienceLatchPass
+from openr_tpu.analysis.passes.slot_table import SlotTablePass
 
 
 def make_passes():
@@ -25,6 +26,7 @@ def make_passes():
         JaxHygienePass(),
         AsyncBlockingPass(),
         ResilienceLatchPass(),
+        SlotTablePass(),
         PipelinePhasePass(),
         AlertRegistryPass(),
     ]

@@ -1,0 +1,100 @@
+"""Slot-table discipline — the structural encode state has ONE owner.
+
+The slot-stable encode (ISSUE 12, ``ops/csr.py``) keeps node slots and
+edge rows stable across LSDB membership churn: tombstoned slots, a
+free-list, and in-place row revival.  That state is only coherent as a
+CHAIN — every generation must be produced by the csr patch functions
+from its predecessor, and the decision backend is the only component
+that drives the chain (it owns the encoding cache, the decline
+accounting, and the warm-context compatibility proof).  A third party
+calling the slot mutators — or fabricating tombstone metadata on an
+encoding — would hand the warm kernels a layout the reset-frontier
+planner never vouched for: silently wrong routes, not a crash.
+
+Rule:
+
+* ``slot-table`` — a call to ``patch_encoded_topology_slots`` /
+  ``patch_encoded_multi_area_slots``, or an assignment to the
+  ``tombstoned_nodes`` / ``tombstoned_links`` / ``slot_changed``
+  attributes of an encoding, anywhere outside the owners: the encoder
+  itself (``ops/csr.py``) and the decision backend
+  (``decision/backend.py``).  Reads are fine — the warm planner, the
+  selective-selection path and tests all inspect the metadata.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+#: the slot chain's legitimate owners (calls + metadata writes allowed)
+ALLOWED_PREFIXES = (
+    "openr_tpu/ops/csr.py",
+    "openr_tpu/decision/backend.py",
+)
+
+_SLOT_CALLS = {
+    "patch_encoded_topology_slots",
+    "patch_encoded_multi_area_slots",
+}
+_SLOT_ATTRS = {"tombstoned_nodes", "tombstoned_links", "slot_changed"}
+
+
+class SlotTablePass(Pass):
+    name = "slot-table"
+    rules = {
+        "slot-table": (
+            "slot-table mutator used outside ops/csr + decision/backend "
+            "(the structural encode chain has one owner; route "
+            "membership churn through the backend's encoding cache)"
+        ),
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if mod.rel.startswith(ALLOWED_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and t.attr in _SLOT_ATTRS
+                    ):
+                        out.append(
+                            mod.finding(
+                                "slot-table",
+                                node,
+                                f"write to `.{t.attr}` fabricates slot "
+                                "metadata the warm planner never "
+                                "vouched for; only the csr patch "
+                                "functions may produce it",
+                            )
+                        )
+            elif isinstance(node, ast.Call):
+                f = node.func
+                name = (
+                    f.attr
+                    if isinstance(f, ast.Attribute)
+                    else (f.id if isinstance(f, ast.Name) else "")
+                )
+                if name in _SLOT_CALLS:
+                    out.append(
+                        mod.finding(
+                            "slot-table",
+                            node,
+                            f"`{name}(..)` outside ops/csr + "
+                            "decision/backend breaks the slot chain's "
+                            "single-owner discipline; go through the "
+                            "backend's encoding cache",
+                        )
+                    )
+        return out

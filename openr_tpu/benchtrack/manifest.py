@@ -189,6 +189,11 @@ def _spoil_trajectory(doc: dict) -> None:
     doc["detail"]["classes"]["grid"]["nodes"] = 64
 
 
+def _spoil_rolling(doc: dict) -> None:
+    # an upgrade that fired an alert must never pass the gate
+    doc["detail"]["alerts"]["unexpected"] = 1
+
+
 # -- acceptance floors moved out of the six per-family test files
 
 
@@ -250,6 +255,17 @@ def _accept_trajectory(doc: dict) -> None:
         assert row["alerts"]["unexpected"] == 0, name
         assert row["warm"]["hit_ratio"] >= 0.9, name
     assert doc["detail"]["deterministic_replay"] is True
+
+
+def _accept_rolling(doc: dict) -> None:
+    # the ISSUE-12 acceptance floor: a rolling upgrade must stay WARM
+    # (before the slot-stable encode this ratio was 0 by construction)
+    d = doc["detail"]
+    assert d["warm"]["structural_hit_ratio"] > 0.8
+    assert d["alerts"]["unexpected"] == 0
+    assert d["slo"]["p99_within_slo"] is True
+    assert d["deterministic_replay"] is True
+    assert d["sweep"]["crashes"] == 0
 
 
 def _v(name: str) -> Callable[[dict], None]:
@@ -452,6 +468,26 @@ MANIFEST: Tuple[ArtifactSpec, ...] = (
         ),
         spoil=_spoil_trajectory,
         acceptance=_accept_trajectory,
+    ),
+    ArtifactSpec(
+        family="rolling",
+        pattern=r"BENCH_ROLLING_r(\d+)\.json",
+        description=(
+            "rolling-restart survival: every non-observer node bounced "
+            "once through the supervisor's storm-guarded queue under "
+            "serving load — structural warm-hit ratio, per-class SLO "
+            "hold, zero alerts, byte-identical replay "
+            "(bench.py --rolling)"
+        ),
+        validate=_v("rolling"),
+        headline=(
+            HeadlineMetric("value", HIGHER, tolerance_pct=5.0),
+            HeadlineMetric(
+                "detail.convergence.p99_ms", LOWER, tolerance_pct=25.0
+            ),
+        ),
+        spoil=_spoil_rolling,
+        acceptance=_accept_rolling,
     ),
 )
 

@@ -24,6 +24,7 @@ See docs/Robustness.md for the DSL and recovery-flow walkthrough.
 from openr_tpu.chaos.controller import ChaosController
 from openr_tpu.chaos.invariants import InvariantChecker, InvariantViolation
 from openr_tpu.chaos.plan import Fault, FaultPlan
+from openr_tpu.chaos.rolling import RollingRestartSweep
 from openr_tpu.chaos.supervisor import Supervisor
 
 __all__ = [
@@ -32,5 +33,6 @@ __all__ = [
     "FaultPlan",
     "InvariantChecker",
     "InvariantViolation",
+    "RollingRestartSweep",
     "Supervisor",
 ]

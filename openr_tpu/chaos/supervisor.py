@@ -17,19 +17,42 @@ from PersistentStore and KvStore cold-boot full sync reconverges the LSDB
     supervisor additionally forces ``KvStore.request_full_sync()`` so every
     re-learned peer session re-runs the 3-way anti-entropy exchange.
 
+Restart-storm guard (ISSUE 12): at most ``max_concurrent_restarts``
+(default 1) restarts are in flight at any instant; further crashes and
+requests queue FIFO in arrival order — deterministic under SimClock, so
+a seeded rolling-restart sweep can never bounce the whole fleet at once
+no matter how fast faults arrive.  ``request_restart(name, down_s=...)``
+is the DELIBERATE path (a rolling fleet upgrade): it rides the same
+queue and concurrency cap, optionally holds the node down for
+``down_s`` (via the registered ``stop`` callback) so neighbors actually
+observe the leave, and is counted under ``supervisor.requested_restarts``
+— it never touches the crash latch or the crash log.
+
 Crashes and restarts are counted (``supervisor.*``) and logged in
-``crash_log`` for tests and the ctrl surface.
+``crash_log`` / ``restart_log`` for tests, fingerprints and the ctrl
+surface.
 """
 
 from __future__ import annotations
 
-from typing import Awaitable, Callable, Dict, List, Optional, Set, Tuple
+from typing import (
+    Awaitable,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Set,
+    Tuple,
+)
 
 from openr_tpu.common.runtime import Actor, Clock, CounterMap
 from openr_tpu.common.utils import ExponentialBackoff
 
 #: restart: async callable (node_name) -> new node
 RestartFn = Callable[[str], Awaitable[object]]
+#: stop: async callable (node_name) -> None — takes the node down
+#: without replacing it (the deliberate-restart down window)
+StopFn = Callable[[str], Awaitable[None]]
 
 
 class Supervisor(Actor):
@@ -40,27 +63,47 @@ class Supervisor(Actor):
         initial_backoff_s: float = 0.5,
         max_backoff_s: float = 30.0,
         stable_after_s: float = 60.0,
+        max_concurrent_restarts: int = 1,
     ) -> None:
         super().__init__("supervisor", clock, counters)
         self._initial_backoff_s = initial_backoff_s
         self._max_backoff_s = max_backoff_s
         self._stable_after_s = stable_after_s
+        self._max_concurrent = max(1, int(max_concurrent_restarts))
         self._restart_fns: Dict[str, RestartFn] = {}
+        self._stop_fns: Dict[str, StopFn] = {}
         self._backoffs: Dict[str, ExponentialBackoff] = {}
         self._last_restart: Dict[str, float] = {}
+        #: queued or in-flight (the on_crash dedupe set)
         self._restarting: Set[str] = set()
+        #: FIFO of (name, kind, down_s) awaiting a free slot
+        self._queue: List[Tuple[str, str, float]] = []
+        self._active = 0
         #: (clock time, node, reason), newest last
         self.crash_log: List[Tuple[float, str, str]] = []
+        #: (clock time, node, kind) of COMPLETED restarts, newest last
+        self.restart_log: List[Tuple[float, str, str]] = []
         self.num_crashes = 0
         self.num_restarts = 0
         self.num_restart_failures = 0
+        self.num_requested_restarts = 0
+        self.max_observed_concurrency = 0
 
     # -- registration ------------------------------------------------------
 
-    def supervise(self, name: str, node, restart: RestartFn) -> None:
+    def supervise(
+        self,
+        name: str,
+        node,
+        restart: RestartFn,
+        stop: Optional[StopFn] = None,
+    ) -> None:
         """Adopt `node`: its watchdog crashes now restart it via `restart`
-        instead of killing the process."""
+        instead of killing the process.  `stop` (optional) enables
+        deliberate down-window restarts via :meth:`request_restart`."""
         self._restart_fns[name] = restart
+        if stop is not None:
+            self._stop_fns[name] = stop
         self._attach(name, node)
 
     def _attach(self, name: str, node) -> None:
@@ -81,12 +124,116 @@ class Supervisor(Actor):
             return
         if name in self._restarting:
             # the watchdog fires every sweep until the node is replaced;
-            # one restart is already in flight
+            # one restart is already queued or in flight
             return
-        self._restarting.add(name)
-        self.spawn(self._restart(name), name=f"supervisor.restart.{name}")
+        self._enqueue(name, "crash", 0.0)
 
-    async def _restart(self, name: str) -> None:
+    # -- deliberate restarts (rolling upgrades) ----------------------------
+
+    def request_restart(self, name: str, down_s: float = 0.0) -> bool:
+        """Queue a deliberate restart (rolling upgrade semantics): the
+        node goes down for ``down_s`` (0 = immediate replace), then is
+        rebuilt through the registered restart callback — same queue,
+        same concurrency cap as crash recovery, no crash latch.
+        Returns False when the node is unmanaged or already queued."""
+        if name not in self._restart_fns:
+            return False
+        if name in self._restarting:
+            return False
+        self.num_requested_restarts += 1
+        self.counters.bump("supervisor.requested_restarts")
+        self._enqueue(name, "request", down_s)
+        return True
+
+    # -- the storm-guarded queue -------------------------------------------
+
+    def _enqueue(self, name: str, kind: str, down_s: float) -> None:
+        self._restarting.add(name)
+        self._queue.append((name, kind, down_s))
+        self.counters.set(
+            "supervisor.restart_queue_depth", float(len(self._queue))
+        )
+        self._pump()
+
+    def _pump(self) -> None:
+        while self._active < self._max_concurrent and self._queue:
+            name, kind, down_s = self._queue.pop(0)
+            self._active += 1
+            self.max_observed_concurrency = max(
+                self.max_observed_concurrency, self._active
+            )
+            self.counters.set(
+                "supervisor.restarts_in_flight", float(self._active)
+            )
+            self.spawn(
+                self._run_restart(name, kind, down_s),
+                name=f"supervisor.restart.{name}",
+            )
+        self.counters.set(
+            "supervisor.restart_queue_depth", float(len(self._queue))
+        )
+
+    async def _run_restart(self, name: str, kind: str, down_s: float) -> None:
+        try:
+            if kind == "request":
+                await self._requested_restart(name, down_s)
+            else:
+                await self._crash_restart(name)
+        finally:
+            self._active -= 1
+            self._restarting.discard(name)
+            self.counters.set(
+                "supervisor.restarts_in_flight", float(self._active)
+            )
+            self._pump()
+
+    async def _finish_restart(self, name: str, kind: str, node) -> None:
+        self._attach(name, node)
+        if kind == "request":
+            # mark the fresh incarnation as OPERATOR-EXPECTED: the
+            # health plane's crash latch reads the marker out of the
+            # node's own counter snapshot and books this incarnation
+            # bump under expected_restarts instead of paging — a
+            # shepherded rolling upgrade must not look like a crash
+            # loop (unexplained restarts still latch)
+            counters = getattr(node, "counters", None)
+            if counters is not None:
+                start_ms = counters.get("node.start_ms")
+                if start_ms is not None:
+                    counters.set(
+                        "node.restart_expected_ms", float(start_ms)
+                    )
+        # graceful-restart recovery: every peer session the fresh
+        # store learns must re-run full sync; forcing it here also
+        # covers peers re-added before this call completed
+        kv = getattr(node, "kv_store", None)
+        if kv is not None and hasattr(kv, "request_full_sync"):
+            kv.request_full_sync()
+        self._last_restart[name] = self.clock.now()
+        self.num_restarts += 1
+        self.counters.bump("supervisor.restarts")
+        self.restart_log.append((self.clock.now(), name, kind))
+
+    async def _requested_restart(self, name: str, down_s: float) -> None:
+        stop = self._stop_fns.get(name)
+        if stop is not None and down_s > 0:
+            await stop(name)
+            await self.clock.sleep(down_s)
+        self.touch()
+        # retry like the crash path: a failed attempt must not leave the
+        # node down forever (systemd Restart= semantics)
+        while True:
+            try:
+                node = await self._restart_fns[name](name)
+            except Exception:  # noqa: BLE001 - retry, don't die
+                self.num_restart_failures += 1
+                self.counters.bump("supervisor.restart_failures")
+                await self.clock.sleep(self._initial_backoff_s)
+                continue
+            await self._finish_restart(name, "request", node)
+            return
+
+    async def _crash_restart(self, name: str) -> None:
         backoff = self._backoffs.get(name)
         if backoff is None:
             backoff = ExponentialBackoff(
@@ -96,40 +243,31 @@ class Supervisor(Actor):
         last = self._last_restart.get(name)
         if last is not None and self.clock.now() - last >= self._stable_after_s:
             backoff.report_success()  # node was stable: not a crash loop
-        try:
-            # retry until the node is back (systemd semantics): a failed
-            # restart attempt must not leave the node dead forever
-            while True:
-                backoff.report_error()
-                delay = backoff.time_remaining_until_retry()
-                if delay > 0:
-                    await self.clock.sleep(delay)
-                self.touch()
-                try:
-                    node = await self._restart_fns[name](name)
-                except Exception:  # noqa: BLE001 - retry, don't die
-                    self.num_restart_failures += 1
-                    self.counters.bump("supervisor.restart_failures")
-                    continue
-                self._attach(name, node)
-                # graceful-restart recovery: every peer session the fresh
-                # store learns must re-run full sync; forcing it here also
-                # covers peers re-added before this call completed
-                kv = getattr(node, "kv_store", None)
-                if kv is not None and hasattr(kv, "request_full_sync"):
-                    kv.request_full_sync()
-                self._last_restart[name] = self.clock.now()
-                self.num_restarts += 1
-                self.counters.bump("supervisor.restarts")
-                self.counters.set(
-                    f"supervisor.backoff_ms.{name}",
-                    backoff.get_current_backoff() * 1000.0,
-                )
-                return
-        finally:
-            self._restarting.discard(name)
+        # retry until the node is back (systemd semantics): a failed
+        # restart attempt must not leave the node dead forever
+        while True:
+            backoff.report_error()
+            delay = backoff.time_remaining_until_retry()
+            if delay > 0:
+                await self.clock.sleep(delay)
+            self.touch()
+            try:
+                node = await self._restart_fns[name](name)
+            except Exception:  # noqa: BLE001 - retry, don't die
+                self.num_restart_failures += 1
+                self.counters.bump("supervisor.restart_failures")
+                continue
+            await self._finish_restart(name, "crash", node)
+            self.counters.set(
+                f"supervisor.backoff_ms.{name}",
+                backoff.get_current_backoff() * 1000.0,
+            )
+            return
 
     # -- introspection -----------------------------------------------------
 
     def restarting(self) -> Set[str]:
         return set(self._restarting)
+
+    def queue_depth(self) -> int:
+        return len(self._queue)

@@ -786,6 +786,33 @@ def resilience_status(ctx: click.Context, json_out: bool) -> None:
                         )
                     )
                 click.echo(f"      dev{row['device']}: {state}{extra}")
+    warm = status.get("warm")
+    if warm:
+        state = "ready" if warm.get("context_ready") else "cold"
+        click.echo(
+            f"  warm rebuild: {state}"
+            f" encode_patches={warm['encode_patches']}"
+            f" slot_patches={warm['encode_slot_patches']}"
+            f" purges={warm['purges']}"
+        )
+        for cls, row in sorted(warm.get("by_class", {}).items()):
+            reasons = "".join(
+                f" {k}={v}"
+                for k, v in sorted(row["fallback_reasons"].items())
+            )
+            click.echo(
+                f"    {cls}: hit_ratio={row['hit_ratio']}"
+                f" hits={row['hits']} fallbacks={row['fallbacks']}"
+                + reasons
+            )
+        declines = warm.get("slot_declines") or {}
+        if declines:
+            click.echo(
+                "    slot declines:"
+                + "".join(
+                    f" {k}={v}" for k, v in sorted(declines.items())
+                )
+            )
     fib_b = status.get("fib_agent", {})
     if fib_b:
         click.echo(

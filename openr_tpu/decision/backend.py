@@ -126,6 +126,7 @@ class DecisionBackend:
         force_full: bool = False,
         cache_result: bool = True,
         warm_delta: bool = False,
+        structural_delta: bool = False,
     ) -> Optional[DecisionRouteDb]:
         """``changed_prefixes`` is the EXACT prefix-churn delta since the
         previous call (None = unknown → full re-read of PrefixState).  The
@@ -144,7 +145,13 @@ class DecisionBackend:
         incrementally from the previous generation, PROVIDED the result
         is identical to a cold full build.  The hint is advisory; the
         backend re-verifies structural compatibility against its own
-        caches before trusting it."""
+        caches before trusting it.  ``structural_delta`` is the
+        membership-churn classification (a node or area entered/left
+        the LSDB and nothing else forced the build): a slot-capable
+        backend may then patch its encoding in place (tombstones +
+        free-list) and seed the warm kernels from the surviving region;
+        declines fall back to a cold re-encode with a counted reason.
+        The two hints are mutually exclusive."""
         raise NotImplementedError
 
     def counter_snapshot(self) -> Dict[str, float]:
@@ -186,6 +193,7 @@ class ScalarBackend(DecisionBackend):
         force_full=False,
         cache_result=True,
         warm_delta=False,
+        structural_delta=False,
     ):
         if (
             changed_prefixes is not None
@@ -403,6 +411,28 @@ class TpuBackend(DecisionBackend):
         self.warm_last_rounds = (0, 0)
         self._warm_purge_reasons: Dict[str, int] = {}
         self._warm_fallback_reasons: Dict[str, int] = {}
+        #: warm telemetry split by delta class (ISSUE 12): a rolling
+        #: fleet upgrade lives on the STRUCTURAL ratio; drowning it in
+        #: the (much more frequent) perturbation ticks would hide a
+        #: cold-wall regression from the operator
+        self._warm_class_builds: Dict[str, int] = {
+            "perturbation": 0,
+            "structural": 0,
+        }
+        self._warm_class_fallbacks: Dict[str, int] = {
+            "perturbation": 0,
+            "structural": 0,
+        }
+        self._warm_class_fallback_reasons: Dict[str, Dict[str, int]] = {
+            "perturbation": {},
+            "structural": {},
+        }
+        #: slot-stable encode telemetry: structural-membership patches
+        #: applied in place vs declined-to-cold (with the reason)
+        self.num_encode_slot_patches = 0
+        self._slot_decline_reasons: Dict[str, int] = {}
+        #: encode kind of the live encoding ("cold"/"patch"/"slot")
+        self._last_encode_kind = "cold"
         #: KSP2 prefixes seen by the most recent decodes: their routes
         #: depend on the WHOLE topology (k-shortest re-solves), so the
         #: warm-selective patch path declines while any are present
@@ -469,6 +499,7 @@ class TpuBackend(DecisionBackend):
         force_full=False,
         cache_result=True,
         warm_delta=False,
+        structural_delta=False,
     ):
         gov = self.governor
         probe = False
@@ -527,7 +558,11 @@ class TpuBackend(DecisionBackend):
                 prefix_state,
                 changed_prefixes,
                 force_full,
-                warm_delta=warm_delta,
+                delta_class=(
+                    "structural"
+                    if structural_delta
+                    else ("perturbation" if warm_delta else None)
+                ),
             )
         except ValueError:
             # capacity/shape fallback (e.g. a prefix with more candidates
@@ -622,11 +657,22 @@ class TpuBackend(DecisionBackend):
         if self.governor is not None:
             self.governor.request_shadow_check(reason)
 
-    def _warm_fallback(self, reason: str) -> None:
+    def _warm_fallback(
+        self, reason: str, delta_class: Optional[str] = None
+    ) -> None:
         self.num_warm_cold_fallbacks += 1
         self._warm_fallback_reasons[reason] = (
             self._warm_fallback_reasons.get(reason, 0) + 1
         )
+        if delta_class in self._warm_class_fallbacks:
+            self._warm_class_fallbacks[delta_class] += 1
+            by = self._warm_class_fallback_reasons[delta_class]
+            by[reason] = by.get(reason, 0) + 1
+
+    def _warm_hit(self, delta_class: Optional[str]) -> None:
+        self.num_warm_builds += 1
+        if delta_class in self._warm_class_builds:
+            self._warm_class_builds[delta_class] += 1
 
     # -- the device pool (per-chip failure domains) ------------------------
 
@@ -795,6 +841,40 @@ class TpuBackend(DecisionBackend):
             "decision.backend.warm_last_reset_nodes": float(
                 self.warm_last_reset_nodes
             ),
+            # ISSUE-12 split: the structural (membership-churn) ratio is
+            # what a rolling fleet upgrade lives on; perturbation ticks
+            # must not be allowed to mask a structural cold wall
+            "decision.backend.warm_builds.perturbation": float(
+                self._warm_class_builds["perturbation"]
+            ),
+            "decision.backend.warm_builds.structural": float(
+                self._warm_class_builds["structural"]
+            ),
+            "decision.backend.warm_cold_fallbacks.perturbation": float(
+                self._warm_class_fallbacks["perturbation"]
+            ),
+            "decision.backend.warm_cold_fallbacks.structural": float(
+                self._warm_class_fallbacks["structural"]
+            ),
+            "decision.backend.warm_hit_ratio.perturbation": (
+                self._warm_class_builds["perturbation"]
+                / max(
+                    1,
+                    self._warm_class_builds["perturbation"]
+                    + self._warm_class_fallbacks["perturbation"],
+                )
+            ),
+            "decision.backend.warm_hit_ratio.structural": (
+                self._warm_class_builds["structural"]
+                / max(
+                    1,
+                    self._warm_class_builds["structural"]
+                    + self._warm_class_fallbacks["structural"],
+                )
+            ),
+            "decision.backend.warm_encode_slot_patches": float(
+                self.num_encode_slot_patches
+            ),
             # streamed-pipeline + on-device delta-extraction telemetry
             # (ISSUE 11): delta_rows_skipped / (fetched + skipped) is
             # the fraction of the route table that never crossed the
@@ -811,6 +891,17 @@ class TpuBackend(DecisionBackend):
                 self.num_delta_rows_skipped
             ),
         }
+        for reason, n in sorted(self._slot_decline_reasons.items()):
+            out[f"decision.backend.slot_decline.{reason}"] = float(n)
+        for cls, reasons in sorted(
+            self._warm_class_fallback_reasons.items()
+        ):
+            for reason, n in sorted(reasons.items()):
+                out[
+                    f"decision.backend.warm_fallback.{cls}.{reason}"
+                ] = float(n)
+        for reason, n in sorted(self._warm_purge_reasons.items()):
+            out[f"decision.backend.warm_purge.{reason}"] = float(n)
         if self._pool is not None:
             # only report pool gauges once the pool actually exists — a
             # Monitor sweep must never be the thing that boots jax
@@ -868,18 +959,31 @@ class TpuBackend(DecisionBackend):
             self.num_encode_hits += 1
             return cached[1]
         enc = None
+        self._last_encode_kind = "cold"
         if self._warm_enabled and self._enc_cache:
             # perturbation ticks (the overwhelming topology-churn class)
-            # refresh only the weight/validity/drain columns and share
-            # every layout array with the previous encoding — the full
-            # re-sort/re-intern/re-expand pass is most of the warm
-            # rebuild's host budget at 4096 nodes
-            from openr_tpu.ops.csr import patch_encoded_multi_area
+            # refresh only the weight/validity/drain columns; membership
+            # churn (node join/leave, link add/remove — a rolling
+            # restart's delta class) takes the slot-stable structural
+            # patch.  Both share every layout array with the previous
+            # encoding — the full re-sort/re-intern/re-expand pass is
+            # most of the warm rebuild's host budget at 4096 nodes.
+            from openr_tpu.ops.csr import patch_encoded_multi_area_slots
 
             (prev_ls, prev_enc) = next(iter(self._enc_cache.values()))
-            enc = patch_encoded_multi_area(prev_enc, area_link_states, me)
+            enc, kind, reason = patch_encoded_multi_area_slots(
+                prev_enc, area_link_states, me
+            )
             if enc is not None:
-                self.num_encode_patches += 1
+                self._last_encode_kind = kind
+                if kind == "slot":
+                    self.num_encode_slot_patches += 1
+                else:
+                    self.num_encode_patches += 1
+            elif reason is not None:
+                self._slot_decline_reasons[reason] = (
+                    self._slot_decline_reasons.get(reason, 0) + 1
+                )
         if enc is None:
             enc = encode_multi_area(
                 area_link_states, me, node_buckets=self.node_buckets
@@ -904,16 +1008,36 @@ class TpuBackend(DecisionBackend):
             self._ksp2_engines[key] = eng
         return eng
 
-    def _spf(self, enc, max_degree: int, warm_delta: bool = False):
+    #: per-platform cold-SPF kernel preference (the ROADMAP policy
+    #: hook): maps a jax backend platform name ("cpu"/"tpu"/"gpu", or
+    #: "default") to "dense" (the gather in-edge formulation) or
+    #: "segment" (the ``indices_are_sorted`` segment-reduction path).
+    #: Unset platforms use dense whenever the encoding carries the
+    #: in-edge matrix — the behavior every host-platform bench was
+    #: measured under; both kernels are kept bit-parity-tested, so a
+    #: TPU profiling result flips one entry here, not a code path.
+    KERNEL_PREFERENCE: Dict[str, str] = {}
+
+    def _spf_kernel_preference(self) -> str:
+        import jax
+
+        pref = self.KERNEL_PREFERENCE.get(jax.default_backend())
+        if pref is None:
+            pref = self.KERNEL_PREFERENCE.get("default", "dense")
+        return pref
+
+    def _spf(self, enc, max_degree: int, delta_class=None):
         """Device (dist [A,V], nh [A,V,D]) tables, cached per encoding.
 
-        On a topology tick, a warm-eligible delta (``warm_delta`` hint +
-        structural compatibility against the retained previous
-        generation) re-relaxes only the perturbed frontier from the
-        previous generation's device-resident tables (the ISSUE-9
-        warm-start path); everything else solves cold.  Either way the
-        new generation's tables (plus small host mirrors for the NEXT
-        delta's planning) are retained as the warm context."""
+        On a topology tick, a warm-eligible delta (the ``delta_class``
+        hint — "perturbation" or "structural" — plus structural
+        compatibility against the retained previous generation)
+        re-relaxes only the perturbed frontier from the previous
+        generation's device-resident tables (the ISSUE-9 warm-start
+        path; ISSUE 12 extends it to slot-stable membership churn);
+        everything else solves cold.  Either way the new generation's
+        tables (plus small host mirrors for the NEXT delta's planning)
+        are retained as the warm context."""
         import jax.numpy as jnp
 
         from openr_tpu.ops.jit_guard import call_jit_guarded
@@ -931,20 +1055,24 @@ class TpuBackend(DecisionBackend):
         self._warm_changed_nodes = None
         self._warm_rounds = None
         dist = nh = None
-        if self._warm_enabled and warm_delta and self._warm_ctx is not None:
-            dist, nh = self._warm_spf(enc, max_degree)
-        elif self._warm_enabled and warm_delta:
+        if (
+            self._warm_enabled
+            and delta_class is not None
+            and self._warm_ctx is not None
+        ):
+            dist, nh = self._warm_spf(enc, max_degree, delta_class)
+        elif self._warm_enabled and delta_class is not None:
             # warm-classified tick but the context was purged (corruption,
             # quarantine re-pack, full replace): this build solves cold
             # and re-establishes the context
-            self._warm_fallback("no_context")
+            self._warm_fallback("no_context", delta_class)
         elif self._warm_enabled and self._warm_ctx is not None:
-            # a topology tick the hint classified cold (structural,
-            # static/policy coincidence, first build): count it so the
-            # warm-hit ratio reflects reality
+            # a topology tick the hint classified cold (static/policy
+            # coincidence, first build): count it so the warm-hit ratio
+            # reflects reality
             self._warm_fallback("unclassified")
         if dist is None:
-            if enc.has_dense:
+            if enc.has_dense and self._spf_kernel_preference() != "segment":
                 # dense in-edge gather formulation: the cold fixpoints
                 # run without scatter (the segment loops were ~95% of a
                 # grid4096 cold rebuild wall on host platforms, hiding
@@ -998,7 +1126,7 @@ class TpuBackend(DecisionBackend):
     #: per-generation fetch (the warm win targets the debounce budget)
     WARM_MAX_TABLE_BYTES = 64 << 20
 
-    def _warm_spf(self, enc, max_degree: int):
+    def _warm_spf(self, enc, max_degree: int, delta_class=None):
         """Attempt the generation-delta warm solve.  Returns (dist, nh)
         device tables, or (None, None) after counting a cold fallback."""
         import jax
@@ -1012,11 +1140,11 @@ class TpuBackend(DecisionBackend):
         ctx = self._warm_ctx
         with self.probe.phase(pipeline.WARM_PLAN):
             if ctx["degree"] != max_degree:
-                self._warm_fallback("degree_bucket")
+                self._warm_fallback("degree_bucket", delta_class)
                 return None, None
             old_enc = ctx["enc"]
             if old_enc.areas != enc.areas:
-                self._warm_fallback("structural")
+                self._warm_fallback("structural", delta_class)
                 return None, None
             if ctx["dist"] is None:
                 # lazily materialize the previous generation's host
@@ -1032,17 +1160,31 @@ class TpuBackend(DecisionBackend):
             ):
                 if new_topo.padded_edges != old_topo.padded_edges:
                     plans = None
-                    self._warm_fallback("edge_bucket")
+                    self._warm_fallback("edge_bucket", delta_class)
                     break
+                # slot-patched chain: layout identity between the two
+                # generations is proven by ARRAY identity (the slot
+                # patch shares src/dst/link_index with its base), so
+                # symbol renames are tolerated and membership-churned
+                # slots ride the forced reset set (tombstoned rows
+                # seed at +inf)
+                trust = (
+                    new_topo.src is old_topo.src
+                    and new_topo.link_index is old_topo.link_index
+                )
                 delta = plan_generation_delta(
                     old_topo,
                     int(enc.roots[ai]),
                     ctx["dist"][ai],
                     new_topo,
+                    force_reset=(
+                        new_topo.slot_changed if trust else None
+                    ),
+                    trust_layout=trust,
                 )
                 if delta is None:
                     plans = None
-                    self._warm_fallback("structural")
+                    self._warm_fallback("structural", delta_class)
                     break
                 plans.append(delta)
             if plans is None:
@@ -1104,7 +1246,7 @@ class TpuBackend(DecisionBackend):
         self._warm_solved = True
         self._warm_base_enc = old_enc
         self._warm_rounds = (rounds_d, rounds_l)
-        self.num_warm_builds += 1
+        self._warm_hit(delta_class)
         return dist, nh
 
     def _pack_sub_edges(self, enc, plans):
@@ -1194,6 +1336,13 @@ class TpuBackend(DecisionBackend):
                     ).any(axis=2)
                     changed |= prev["enc"].overloaded != enc.overloaded
                     changed |= prev["enc"].soft != enc.soft
+                    # slot-membership churn: a renamed slot can keep
+                    # identical dist/lanes (replacement node, same
+                    # links) yet its NAME — which decode embeds in
+                    # routes — changed; force its rows to re-select
+                    for ai, t in enumerate(enc.topos):
+                        if t.slot_changed is not None:
+                            changed[ai] |= t.slot_changed
                     self._warm_changed_nodes = changed
                 if self._warm_rounds is not None:
                     rd, rl = jax.device_get(self._warm_rounds)
@@ -1732,6 +1881,14 @@ class TpuBackend(DecisionBackend):
         node_changed = (prev_enc.overloaded != enc.overloaded) | (
             prev_enc.soft != enc.soft
         )
+        # slot-membership churn since the delta base: renamed slots can
+        # keep byte-identical selection outputs while their decoded
+        # route contents (names, link objects) moved — their rows must
+        # re-decode (tombstone/revive flips change dist and are caught
+        # by the kernel's output diff regardless)
+        for ai, t in enumerate(enc.topos):
+            if t.slot_changed is not None:
+                node_changed[ai] |= t.slot_changed
         force = None
         if changed_prefixes:
             rows = self._cand_table.rows_for(changed_prefixes)
@@ -1784,7 +1941,7 @@ class TpuBackend(DecisionBackend):
         prefix_state,
         changed_prefixes,
         force_full,
-        warm_delta=False,
+        delta_class=None,
     ):
         from openr_tpu.ops.csr import bucket_for
         from openr_tpu.tracing import pipeline
@@ -1841,7 +1998,7 @@ class TpuBackend(DecisionBackend):
         # patch-path eligibility must be judged against the PRE-build
         # RouteDb base (warm-selective needs _last_db built on prev_enc)
         patch_base = self._last_db
-        dist, nh, ovl, soft = self._spf(enc, D, warm_delta=warm_delta)
+        dist, nh, ovl, soft = self._spf(enc, D, delta_class=delta_class)
 
         if incremental:
             rows = table.rows_for(changed_prefixes)

@@ -121,12 +121,15 @@ class Decision(Actor):
         # drive per-prefix incremental recompute (Decision.cpp:908-952)
         self._pending_prefix_changes: Set[str] = set()
         self._pending_topo_changed = False
-        #: a pending topology change is STRUCTURAL (a node or area
-        #: entered/left the LSDB) rather than a perturbation (link
-        #: weight/up-down, overload/drain flip).  Perturbation-only
-        #: ticks are warm-rebuild eligible: the backend may re-relax
-        #: only the perturbed frontier from the previous generation's
-        #: device tables instead of a cold full solve (ISSUE 9).
+        #: a pending topology change is STRUCTURAL (a node, area or
+        #: LINK entered/left the LSDB — the membership-churn class a
+        #: rolling restart, autoscaling event or adjacency withdrawal
+        #: produces) rather than a perturbation (weight/up-down flips
+        #: on an unchanged membership, overload/drain flips).
+        #: Perturbation ticks warm-start via the O(links) encode patch
+        #: (ISSUE 9); structural ticks warm-start via the slot-stable
+        #: encode (tombstones + free-list) and the generation-delta
+        #: reset frontier (ISSUE 12).
         self._pending_topo_structural = False
         self._pending_force_full = False
         self._last_policy_active = False
@@ -349,15 +352,25 @@ class Decision(Actor):
                     self.pending_trace_ctx = adj_db.perf_events.trace_context
             # structural classification BEFORE the update: a node's
             # first adjacency advertisement (or a fresh area) changes
-            # the symbol table — warm rebuilds only survive pure
-            # perturbations of an unchanged node set
+            # the symbol table, and a link entering/leaving the LSDB
+            # (a neighbor withdrawing its side of an adjacency when a
+            # peer bounces — the rolling-restart delta class) changes
+            # the edge-row membership.  Both route through the
+            # slot-stable structural warm path; only pure
+            # weight/drain/up-down flips stay perturbation-class.
             new_area = area not in self.area_link_states
             ls = self._get_link_state(area)
             new_node = not ls.has_node(node)
+            links_before = ls.num_links()
             change = ls.update_adjacency_database(adj_db)
             if change.topology_changed or change.node_label_changed:
                 self._pending_topo_changed = True
-                if new_area or new_node:
+                if (
+                    new_area
+                    or new_node
+                    or change.added_links
+                    or ls.num_links() != links_before
+                ):
                     self._pending_topo_structural = True
                 return True
             return False
@@ -499,6 +512,22 @@ class Decision(Actor):
             and not policy_active
             and not self._last_policy_active
         )
+        # structural warm hint (ISSUE 12): node/area membership churn —
+        # the delta class a rolling restart, autoscaling event or LSDB
+        # key expiry produces.  The backend routes it through the
+        # slot-stable encode patch + the generation-delta reset frontier
+        # (tombstoned slots reset to +inf) instead of a cold re-encode;
+        # its own caches still re-verify compatibility, and any decline
+        # (slot exhaustion, area membership change) rebuilds cold with a
+        # counted reason.
+        structural_delta = (
+            self._first_build_done
+            and self._pending_topo_changed
+            and self._pending_topo_structural
+            and not self._pending_force_full
+            and not policy_active
+            and not self._last_policy_active
+        )
         changed = self._pending_prefix_changes
         self._pending_prefix_changes = set()
         self._pending_topo_changed = False
@@ -509,6 +538,8 @@ class Decision(Actor):
             self.counters.bump("decision.incremental_route_builds")
         if warm_delta:
             self.counters.bump("decision.warm_delta_builds")
+        if structural_delta:
+            self.counters.bump("decision.structural_delta_builds")
         # SPF dispatch span: the backend call (scalar solve or device
         # kernel pipeline); guarded jitted dispatches inside it record
         # `decision.spf_kernel` child spans via the jit_guard trace scope
@@ -534,6 +565,7 @@ class Decision(Actor):
                     force_full=force_full,
                     cache_result=not policy_active,
                     warm_delta=warm_delta,
+                    structural_delta=structural_delta,
                 )
         finally:
             self.tracer.end_span(spf_span)

@@ -67,6 +67,9 @@ class EmulatedNetwork:
         self._interfaces: Dict[str, Dict[str, InterfaceInfo]] = {}
         self._edges: List[Edge] = []
         self.num_node_restarts = 0
+        #: nodes taken down by stop_node and not yet replaced (a
+        #: deliberate-restart down window; restart_node skips the stop)
+        self._stopped: set = set()
 
     # -- construction ------------------------------------------------------
 
@@ -194,15 +197,29 @@ class EmulatedNetwork:
 
     # -- crash-restart (supervisor restart target) -------------------------
 
+    async def stop_node(self, name: str) -> None:
+        """Take one node DOWN without replacing it — the first half of a
+        deliberate restart with a real down window (rolling upgrade):
+        neighbors must observe the leave via Spark hold-timer expiry,
+        exactly as a drained-and-rebooted production node looks.  Pair
+        with :meth:`restart_node` to bring it back."""
+        node = self.nodes[name]
+        self.kv_transport.unregister(name)
+        await node.stop()
+        self._stopped.add(name)
+
     async def restart_node(self, name: str) -> OpenrNode:
         """Stop and replace one node in place — the in-process equivalent
         of systemd restarting a crashed daemon.  The FibAgent (the
         "platform"/kernel) survives with its programmed routes; the fresh
         node replays drain state from PersistentStore in its constructor,
         re-handshakes Spark, and full-syncs its KvStore (cold boot)."""
-        old = self.nodes[name]
-        self.kv_transport.unregister(name)
-        await old.stop()  # spark.stop unregisters from the io provider
+        if name in self._stopped:
+            self._stopped.discard(name)
+        else:
+            old = self.nodes[name]
+            self.kv_transport.unregister(name)
+            await old.stop()  # spark.stop unregisters from the io provider
         node = OpenrNode(
             config=self.configs[name],
             clock=self.clock,

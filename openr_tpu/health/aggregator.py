@@ -169,6 +169,9 @@ class FleetHealthAggregator:
         self._gen_state: Dict[str, _NodeGenState] = {}
         self._crashes_latched = 0.0
         self._restarts_latched = 0.0
+        #: supervisor-stamped (operator-requested) incarnation bumps:
+        #: remembered and surfaced, never alert-worthy
+        self._expected_restarts_latched = 0.0
         self.num_sweeps = 0
         self._last_status: Dict[str, Any] = {}
 
@@ -215,6 +218,7 @@ class FleetHealthAggregator:
             "queues": self._queue_rollup(snaps),
             "crashes_seen": self._crashes_latched,
             "restarts_seen": self._restarts_latched,
+            "expected_restarts_seen": self._expected_restarts_latched,
             "slos": self.slos.status(),
             "active_alerts": self.sink.active_alerts(),
         }
@@ -432,7 +436,17 @@ class FleetHealthAggregator:
             start_ms = counters.get("node.start_ms")
             if start_ms is not None:
                 if st.start_ms is not None and start_ms > st.start_ms:
-                    self._restarts_latched += 1.0
+                    # an incarnation the SUPERVISOR stamped as
+                    # operator-requested (rolling upgrade) is expected:
+                    # tracked, never paged.  Any other incarnation bump
+                    # is an unexplained restart and latches.
+                    expected = counters.get("node.restart_expected_ms")
+                    if expected is not None and float(expected) == float(
+                        start_ms
+                    ):
+                        self._expected_restarts_latched += 1.0
+                    else:
+                        self._restarts_latched += 1.0
                 st.start_ms = float(start_ms)
         if self._crashes_latched > 0 or self._restarts_latched > 0:
             firing["node_crash"] = {
@@ -457,6 +471,9 @@ class FleetHealthAggregator:
         out = {
             "health.sweeps": float(self.num_sweeps),
             "health.crashes_seen": self._crashes_latched,
+            "health.expected_restarts_seen": (
+                self._expected_restarts_latched
+            ),
         }
         out.update(self.sink.gauges())
         return out

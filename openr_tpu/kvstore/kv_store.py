@@ -595,7 +595,7 @@ class KvStoreDb:
             originator_id=self.node_name,
             value=data,
             ttl=self.config.self_originated_key_ttl_ms,
-            ttl_version=0,
+            ttl_version=self._ttl_clock(),
         )
         value.hash = generate_hash(value)
         sov = SelfOriginatedValue(value=value)
@@ -619,10 +619,26 @@ class KvStoreDb:
             originator_id=self.node_name,
             value=data,
             ttl=self.config.self_originated_key_ttl_ms,
-            ttl_version=0,
+            ttl_version=self._ttl_clock(),
         )
         value.hash = generate_hash(value)
         self._apply_local(key, value)
+
+    def _ttl_clock(self) -> int:
+        """Incarnation-monotone ttl_version seed: the refresh-interval
+        count since the epoch of the injected clock.  A restarted
+        node's ttl clock must EXCEED its previous incarnation's — the
+        fleet's copies carry the old incarnation's ttl_version, the
+        3-way sync's hash digest (version, originator, hash) cannot see
+        the divergence, and refreshes with a lower ttl_version are
+        dropped as stale until the fleet's copies silently age out one
+        TTL after the restart.  Seeding from time (the previous
+        incarnation advanced its clock at the same 1-per-interval rate
+        it was alive) keeps the fresh clock ahead without any protocol
+        change; `_guard_self_originated`'s fast-forward stays as the
+        belt for restarts inside a single interval tick."""
+        interval_ms = max(self.config.self_originated_key_ttl_ms / 4, 1)
+        return int(self.actor.clock.now_ms() // interval_ms) + 1
 
     def erase_self_originated_key(self, key: str) -> None:
         """Stop refreshing; the network expires the key naturally
@@ -656,23 +672,55 @@ class KvStoreDb:
 
     def _guard_self_originated(self, accepted: Dict[str, Value]) -> None:
         """If the network overrode one of our self-originated keys, bump our
-        version above the interloper and re-advertise."""
+        version above the interloper and re-advertise.
+
+        The override has two faces: an INTERLOPER (another originator
+        claiming our key) and our own PREVIOUS INCARNATION — after a
+        restart we re-originate at version 1 while the network still
+        remembers the old incarnation's higher version.  Without
+        re-origination the fossil wins every merge, our TTL refreshes
+        are rejected as stale, nobody else refreshes the fossil either,
+        and the key starves fleet-wide one TTL after the restart — a
+        rolling upgrade would silently withdraw every bounced node's
+        prefixes ~5 minutes later.  Both cases adopt a version above
+        the override and re-advertise our CURRENT data (the reference's
+        checkSelfAdjustKey semantics)."""
         for key, value in accepted.items():
             sov = self.self_originated.get(key)
             if sov is None:
                 continue
-            if value.originator_id != self.node_name:
-                new_value = Value(
-                    version=value.version + 1,
-                    originator_id=self.node_name,
-                    value=sov.value.value,
-                    ttl=sov.value.ttl,
-                    ttl_version=0,
-                )
-                new_value.hash = generate_hash(new_value)
-                sov.value = new_value
-                self._apply_local(key, new_value)
+            if value.originator_id == self.node_name:
+                ours = sov.value
+                if value.value is None:
+                    continue  # ttl-only refresh, not an override
+                if value.version == ours.version and value.hash == ours.hash:
+                    # the same advertisement — but a restarted node's
+                    # TTL-VERSION clock starts over at 0 while the
+                    # fleet's copies carry the previous incarnation's
+                    # higher ttl_version, so every refresh we send is
+                    # rejected as stale until the fleet's copies age
+                    # out (one TTL after the bounce).  Fast-forward our
+                    # clock past the fossil's so the next refresh is
+                    # accepted everywhere.
+                    if value.ttl_version > ours.ttl_version:
+                        ours.ttl_version = value.ttl_version
+                        self._bump("self_originated_ttl_fastforward")
+                    continue
+                if value.version < ours.version:
+                    continue  # our own advertisement echoing back
+                self._bump("self_originated_incarnation_guard")
+            else:
                 self._bump("self_originated_key_guard")
+            new_value = Value(
+                version=value.version + 1,
+                originator_id=self.node_name,
+                value=sov.value.value,
+                ttl=sov.value.ttl,
+                ttl_version=0,
+            )
+            new_value.hash = generate_hash(new_value)
+            sov.value = new_value
+            self._apply_local(key, new_value)
 
     async def _ttl_refresh_loop(self, key: str) -> None:
         """Bump ttlVersion at 1/4 of the TTL interval

@@ -118,6 +118,25 @@ class EncodedTopology:
     #: bit-interchangeable.
     in_has: Optional[np.ndarray] = None
 
+    # -- slot-stable structural state (ISSUE 12) ---------------------------
+    # The slot patch path (:func:`patch_encoded_topology_slots`) keeps
+    # node slots and edge rows STABLE across membership churn: a node
+    # that leaves the LSDB keeps its slot (tombstoned) and its links'
+    # rows (edge_ok=False, w=INF — exactly a down link, so lane ranks
+    # never move); a rejoin revives them in place.  Only ops/csr and the
+    # decision backend may produce encodings carrying these fields (the
+    # orlint `slot-table` rule enforces it).
+    #: names present in the symbol table but absent from the current LSDB
+    tombstoned_nodes: frozenset = frozenset()
+    #: undirected link ids whose rows are tombstoned (no current link)
+    tombstoned_links: frozenset = frozenset()
+    #: [V] bool — slots whose MEMBERSHIP changed in the patch that
+    #: produced this encoding (newly tombstoned, revived, or renamed);
+    #: None on cold encodes and pure perturbation patches.  The warm
+    #: rebuild forces these slots into the reset set and the selective
+    #: selection path treats them as changed nodes.
+    slot_changed: Optional[np.ndarray] = None
+
     @property
     def has_dense(self) -> bool:
         return self.in_src is not None
@@ -474,6 +493,250 @@ def patch_encoded_topology(
         in_rank=old.in_rank,
         in_edge_pos=old.in_edge_pos,
         in_has=old.in_has,
+    )
+
+
+def patch_encoded_topology_slots(
+    old: "EncodedTopology", link_state: LinkState, me: Optional[str] = None
+) -> Tuple[Optional["EncodedTopology"], Optional[str]]:
+    """Slot-stable structural patch: membership churn (node join/leave,
+    link add/remove — the delta class a rolling restart or autoscaling
+    event produces continuously) re-encodes in O(links) with every
+    layout array identity-shared, instead of the full re-sort/re-intern/
+    re-expand pass.
+
+    Mechanics:
+
+      * a node that LEAVES the LSDB keeps its slot — it is tombstoned,
+        and each of its links' edge rows is invalidated in place
+        (``edge_ok=False, w=INF``: byte-for-byte a down link, so lane
+        ranks, the dst-sort order and the dense in-edge layout never
+        move);
+      * a node that REJOINS (the rolling-restart case) revives its slot
+        and its links reclaim their retained rows by link identity key;
+      * a genuinely NEW name takes a slot from the free-list of
+        tombstoned slots (deterministic: lowest slot first; the evicted
+        tombstone's name is forgotten — a cold re-encode is the GC) and
+        its links reclaim tombstoned rows joining the same slot
+        endpoints (the replacement-node pattern: new name, same
+        physical neighbors).
+
+    Declines — ``(None, reason)`` — fall back to a cold re-encode with
+    the reason counted by the backend:
+
+      * ``slot_exhaustion``: a new name with no tombstoned slot free;
+      * ``new_link``: a current link with neither an identity-key match
+        nor a same-endpoints tombstoned row pair (genuinely new
+        topology needs new rows, which would break the dst-sorted
+        layout the segment kernels rely on).
+
+    Same contract as :func:`patch_encoded_topology`: weight/validity/
+    drain planes are fresh arrays; src/dst/link_index/link_edge_pos,
+    the dense in-edge layout and (rename-free) the symbol tables are
+    shared with the previous encoding."""
+    names = set(link_state.get_adjacency_databases().keys())
+    if me is not None:
+        names.add(me)
+    old_names = set(old.node_ids.keys())
+    joins = sorted(names - old_names)
+    node_ids = old.node_ids
+    id_to_node = old.id_to_node
+    renamed_slots: List[int] = []
+    if joins:
+        # free-list: slots of tombstoned names that are not rejoining
+        # this tick, lowest slot first (deterministic across replays)
+        free = sorted(
+            old.node_ids[n] for n in old.tombstoned_nodes if n not in names
+        )
+        if len(free) < len(joins):
+            return None, "slot_exhaustion"
+        node_ids = dict(old.node_ids)
+        id_to_node = list(old.id_to_node)
+        for name in joins:
+            slot = free.pop(0)
+            del node_ids[id_to_node[slot]]
+            node_ids[name] = slot
+            id_to_node[slot] = name
+            renamed_slots.append(slot)
+
+    # -- link row assignment: identity key first, then same-endpoints
+    # -- reclaim of tombstoned rows for new keys
+    links_now = link_state.all_links()
+    n_rows = len(old.links)
+    assigned: Dict[int, Link] = {}
+    key_to_li = {lk._key: li for li, lk in enumerate(old.links)}
+    unmatched: List[Link] = []
+    for lk in links_now:
+        li = key_to_li.get(lk._key)
+        if li is not None and li not in assigned:
+            assigned[li] = lk
+        else:
+            unmatched.append(lk)
+    if unmatched:
+        pos = old.link_edge_pos
+        avail: Dict[Tuple[int, int], List[int]] = {}
+        for li in range(n_rows):
+            if li in assigned:
+                continue
+            e0 = pos[li, 0]
+            pair = (int(old.src[e0]), int(old.dst[e0]))
+            avail.setdefault((min(pair), max(pair)), []).append(li)
+        for lk in unmatched:
+            a = node_ids.get(lk.n1)
+            b = node_ids.get(lk.n2)
+            if a is None or b is None:
+                return None, "new_link"
+            cand = avail.get((min(a, b), max(a, b)))
+            if not cand:
+                return None, "new_link"
+            assigned[cand.pop(0)] = lk
+
+    col_m = np.full(max(n_rows, 1), INF, np.float32)
+    col_ok = np.zeros(max(n_rows, 1), bool)
+    new_links = list(old.links)
+    for li, lk in assigned.items():
+        new_links[li] = lk
+        col_m[li] = lk.get_max_metric()
+        col_ok[li] = lk.is_up()
+    if np.any(col_ok[:n_rows] & (col_m[:n_rows] <= 0)):
+        raise ValueError(
+            "non-positive metric on an up link; device SPF requires "
+            "metrics >= 1"
+        )
+    w = np.full(old.padded_edges, INF, np.float32)
+    edge_ok = np.zeros(old.padded_edges, bool)
+    if n_rows:
+        pos = old.link_edge_pos
+        m_dir = np.where(col_ok[:n_rows], col_m[:n_rows], INF)
+        for side in (0, 1):
+            w[pos[:, side]] = m_dir
+            edge_ok[pos[:, side]] = col_ok[:n_rows]
+
+    overloaded = np.zeros(old.padded_nodes, bool)
+    soft = np.zeros(old.padded_nodes, np.int32)
+    for n, i in node_ids.items():
+        # tombstoned names read the LinkState defaults (False / 0)
+        overloaded[i] = link_state.is_node_overloaded(n)
+        soft[i] = link_state.get_node_metric_increment(n)
+
+    in_w = in_ok = None
+    if old.has_dense:
+        epos = old.in_edge_pos
+        m = epos >= 0
+        in_w = np.full_like(old.in_w, INF)
+        in_ok = np.zeros_like(old.in_ok)
+        in_w.flat[epos[m]] = w[m]
+        in_ok.flat[epos[m]] = edge_ok[m]
+
+    tombstoned_nodes = frozenset(set(node_ids) - names)
+    tombstoned_links = frozenset(
+        li for li in range(n_rows) if li not in assigned
+    )
+    slot_changed = np.zeros(old.padded_nodes, bool)
+    for name in (old.tombstoned_nodes ^ tombstoned_nodes):
+        nid = node_ids.get(name)
+        if nid is not None:
+            slot_changed[nid] = True
+    slot_changed[renamed_slots] = True
+    # links whose tombstone state flipped mark both endpoint slots —
+    # belt and braces for the selective-selection changed-node mask
+    # (dist/lane diffs catch them too)
+    for li in (old.tombstoned_links ^ tombstoned_links):
+        e0 = old.link_edge_pos[li, 0]
+        slot_changed[int(old.src[e0])] = True
+        slot_changed[int(old.dst[e0])] = True
+
+    return (
+        EncodedTopology(
+            src=old.src,
+            dst=old.dst,
+            w=w,
+            edge_ok=edge_ok,
+            overloaded=overloaded,
+            soft=soft,
+            node_ok=old.node_ok,
+            link_index=old.link_index,
+            node_ids=node_ids,
+            id_to_node=id_to_node,
+            links=new_links,
+            link_edge_pos=old.link_edge_pos,
+            num_nodes=old.num_nodes,
+            num_edges=old.num_edges,
+            in_src=old.in_src,
+            in_w=in_w,
+            in_ok=in_ok,
+            in_rank=old.in_rank,
+            in_edge_pos=old.in_edge_pos,
+            in_has=old.in_has,
+            tombstoned_nodes=tombstoned_nodes,
+            tombstoned_links=tombstoned_links,
+            slot_changed=slot_changed,
+        ),
+        None,
+    )
+
+
+def patch_encoded_multi_area_slots(
+    prev: EncodedMultiArea, area_link_states, me: str
+) -> Tuple[Optional[EncodedMultiArea], str, Optional[str]]:
+    """Structural-capable multi-area patch: per area, try the pure
+    perturbation patch first (weight/drain churn on an unchanged
+    membership), then the slot-stable structural patch.  Returns
+    ``(enc, kind, reason)`` — kind is ``"patch"`` (every area took the
+    perturbation path), ``"slot"`` (at least one area took the slot
+    path) or ``"cold"`` (enc None; reason names the decline:
+    ``area_change``, ``slot_exhaustion``, ``new_link``)."""
+    areas = sorted(area_link_states.keys())
+    if areas != prev.areas:
+        return None, "cold", "area_change"
+    topos = []
+    any_slot = False
+    for a, old_topo in zip(areas, prev.topos):
+        patched = None
+        if not old_topo.tombstoned_nodes and not old_topo.tombstoned_links:
+            patched = patch_encoded_topology(old_topo, area_link_states[a], me)
+        if patched is None:
+            patched, reason = patch_encoded_topology_slots(
+                old_topo, area_link_states[a], me
+            )
+            if patched is None:
+                return None, "cold", reason
+            any_slot = True
+        topos.append(patched)
+    dense = {}
+    if prev.has_dense and all(t.has_dense for t in topos):
+        K = prev.in_src.shape[2]
+
+        def widen(a, fill):
+            pad = K - a.shape[1]
+            if not pad:
+                return a
+            return np.concatenate(
+                [a, np.full((a.shape[0], pad), fill, a.dtype)], axis=1
+            )
+
+        dense = dict(
+            in_src=prev.in_src,  # layout shared with the previous gen
+            in_rank=prev.in_rank,
+            in_has=prev.in_has,
+            in_w=np.stack([widen(t.in_w, INF) for t in topos]),
+            in_ok=np.stack([widen(t.in_ok, False) for t in topos]),
+        )
+    return (
+        EncodedMultiArea(
+            areas=areas,
+            topos=topos,
+            src=prev.src,
+            dst=prev.dst,
+            w=np.stack([t.w for t in topos]),
+            edge_ok=np.stack([t.edge_ok for t in topos]),
+            overloaded=np.stack([t.overloaded for t in topos]),
+            soft=np.stack([t.soft for t in topos]),
+            roots=prev.roots,
+            **dense,
+        ),
+        "slot" if any_slot else "patch",
+        None,
     )
 
 

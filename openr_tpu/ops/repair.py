@@ -787,6 +787,8 @@ def plan_generation_delta(
     root_id: int,
     old_dist: np.ndarray,
     new_topo,
+    force_reset: Optional[np.ndarray] = None,
+    trust_layout: bool = False,
 ) -> Optional[GenerationDelta]:
     """Classify one area's LSDB delta and plan the warm rebuild.
 
@@ -832,8 +834,19 @@ def plan_generation_delta(
     The descendant sweep is a frontier BFS over the old DAG — cost
     O(depth x |DAG|) numpy, independent of the reset-set encoding (no
     per-link bitset tables are built; this runs per generation in
-    Decision's hot path)."""
-    if new_topo.id_to_node != old_topo.id_to_node:
+    Decision's hot path).
+
+    ``trust_layout`` (slot-stable structural deltas, ISSUE 12): the
+    caller has proven layout identity between the two encodings (the
+    new topology was slot-patched from the old — same src/dst/
+    link_index array OBJECTS), so the symbol-table equality check is
+    skipped: tombstoned slots keep their names and the graph-as-slots
+    diff below is complete regardless of per-slot identity.  Slots
+    whose membership/identity changed ride ``force_reset`` ([V] bool):
+    they are seeded into the reset BFS and their old distances are
+    never trusted as over-estimates (a renamed slot's previous
+    occupant's distance says nothing about the new node)."""
+    if not trust_layout and new_topo.id_to_node != old_topo.id_to_node:
         return None
     V = old_topo.padded_nodes
     if new_topo.padded_nodes != V:
@@ -887,8 +900,14 @@ def plan_generation_delta(
     dag_dst = old_topo.dst[on_edge]
 
     # reset seeds: heads of perturbed directed edges that were ON the
-    # old DAG (an off-DAG removal provably changes nothing)
+    # old DAG (an off-DAG removal provably changes nothing), plus any
+    # caller-forced slots (membership/identity churn: their old
+    # distances are not valid over-estimates, and their old-DAG
+    # descendants may have routed through them)
     seed = np.zeros(V, bool)
+    if force_reset is not None:
+        seed |= force_reset.astype(bool)
+        seed[root_id] = False
     if perturbed.any():
         pk = old_keys[perturbed]
         dag_keys = dag_src.astype(np.int64) * V + dag_dst.astype(np.int64)

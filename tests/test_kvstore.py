@@ -356,6 +356,160 @@ def test_repersist_identical_data_is_noop():
     run(main())
 
 
+def test_restarted_originator_reclaims_its_own_fossil_key():
+    """Incarnation guard (ISSUE 12): a restarted node re-originates at
+    version 1 while the network still holds its previous incarnation's
+    higher-version key.  Without re-origination the fossil wins every
+    merge, the fresh node's TTL refreshes are rejected as stale, and
+    the key starves fleet-wide one TTL after the restart — a rolling
+    upgrade would silently withdraw every bounced node's prefixes.
+    The guard must adopt a version above the fossil and re-advertise
+    the CURRENT data."""
+
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b"], clock)
+        net.peer("a", "b")
+        await clock.run_for(5.0)
+        # two generations of adj:a -> the fleet remembers version 2
+        for data in (b"gen1", b"gen2"):
+            net.kv_qs["a"].push(
+                KeyValueRequest(
+                    KvRequestType.PERSIST_KEY, "0", "adj:a", data
+                )
+            )
+            await clock.run_for(1.0)
+        assert net.stores["b"].dump_all("0")["adj:a"].version == 2
+        # "a" restarts: fresh store, empty, re-advertises at version 1
+        await net.stores["a"].stop()
+        net.transport.unregister("a")
+        pub_q = ReplicateQueue("a.kvStoreUpdates")
+        peer_q = ReplicateQueue("a.peerUpdates")
+        kv_q = ReplicateQueue("a.kvRequests")
+        fresh = KvStore(
+            node_name="a",
+            clock=clock,
+            config=KvStoreConfig(),
+            areas=["0"],
+            transport=net.transport,
+            publications_queue=pub_q,
+            peer_updates_reader=peer_q.get_reader(),
+            kv_request_reader=kv_q.get_reader(),
+        )
+        net.transport.register("a", fresh)
+        net.stores["a"] = fresh
+        net.pubs["a"] = pub_q
+        net.peer_qs["a"] = peer_q
+        net.kv_qs["a"] = kv_q
+        fresh.start()
+        net.peer("a", "b")
+        kv_q.push(
+            KeyValueRequest(
+                KvRequestType.PERSIST_KEY, "0", "adj:a", b"gen3"
+            )
+        )
+        await clock.run_for(10.0)
+        # the fossil (v2, gen2) flooded back; the guard must have
+        # re-originated the CURRENT data above it, fleet-wide
+        for store in ("a", "b"):
+            val = net.stores[store].dump_all("0")["adj:a"]
+            assert val.value == b"gen3", store
+            assert val.version == 3, store
+        assert (
+            net.stores["a"].counters.get(
+                "kvstore.self_originated_incarnation_guard"
+            )
+            >= 1
+        )
+        # and the reclaimed key stays ALIVE past the fossil's ttl (the
+        # fresh incarnation's refreshes are accepted again)
+        short = KvStoreConfig()
+        await clock.run_for(short.key_ttl_ms / 1000.0 + 5.0)
+        assert net.stores["b"].dump_all("0")["adj:a"].value == b"gen3"
+        await net.stop()
+
+    run(main())
+
+
+def test_restarted_originator_ttl_clock_stays_monotone():
+    """Second face of the incarnation problem: the restarted node
+    re-advertises the IDENTICAL key (same version, same data) but a
+    zero-seeded ttl_version clock would restart at 0 — every refresh it
+    sends would be rejected as stale against the fleet's
+    higher-ttl_version copies, which then silently age out one TTL
+    after the bounce (the 3-way sync's hash digest cannot see the
+    divergence, so nothing heals it).  The incarnation-monotone ttl
+    clock (`_ttl_clock`) must keep the fresh refreshes ahead of the
+    fossil's."""
+
+    async def main():
+        clock = SimClock()
+        net = Net(["a", "b"], clock)
+        net.peer("a", "b")
+        await clock.run_for(5.0)
+        net.kv_qs["a"].push(
+            KeyValueRequest(
+                KvRequestType.PERSIST_KEY, "0", "prefix:a", b"lo"
+            )
+        )
+        # let several refresh intervals pass so the fleet's ttl_version
+        # is well above a fresh incarnation's
+        ttl_s = KvStoreConfig().key_ttl_ms / 1000.0
+        await clock.run_for(ttl_s * 1.5)
+        assert net.stores["b"].dump_all("0")["prefix:a"].ttl_version >= 4
+        # "a" restarts and re-advertises the IDENTICAL data
+        await net.stores["a"].stop()
+        net.transport.unregister("a")
+        pub_q = ReplicateQueue("a.kvStoreUpdates")
+        peer_q = ReplicateQueue("a.peerUpdates")
+        kv_q = ReplicateQueue("a.kvRequests")
+        fresh = KvStore(
+            node_name="a",
+            clock=clock,
+            config=KvStoreConfig(),
+            areas=["0"],
+            transport=net.transport,
+            publications_queue=pub_q,
+            peer_updates_reader=peer_q.get_reader(),
+            kv_request_reader=kv_q.get_reader(),
+        )
+        net.transport.register("a", fresh)
+        net.stores["a"] = fresh
+        net.pubs["a"] = pub_q
+        net.peer_qs["a"] = peer_q
+        net.kv_qs["a"] = kv_q
+        fresh.start()
+        # the daemon's ordering: the reborn node advertises its own
+        # keys at boot, THEN Spark discovers neighbors and peers the
+        # store — the fossil arrives by full sync after the sov exists
+        kv_q.push(
+            KeyValueRequest(
+                KvRequestType.PERSIST_KEY, "0", "prefix:a", b"lo"
+            )
+        )
+        await clock.run_for(1.0)
+        # the fresh incarnation's ttl clock already exceeds the
+        # fossil's (time-seeded: the old incarnation advanced it at the
+        # same one-per-interval rate it was alive)
+        fossil_ttlv = net.stores["b"].dump_all("0")["prefix:a"].ttl_version
+        sov = net.stores["a"].areas["0"].self_originated["prefix:a"]
+        assert sov.value.ttl_version > fossil_ttlv
+        net.peer("a", "b")
+        await clock.run_for(10.0)
+        # the key must survive well past the fossil's remaining TTL:
+        # the fresh incarnation's refreshes are accepted fleet-wide
+        await clock.run_for(ttl_s * 1.5)
+        assert net.stores["b"].dump_all("0").get("prefix:a") is not None
+        assert net.stores["b"].dump_all("0")["prefix:a"].value == b"lo"
+        assert (
+            net.stores["b"].dump_all("0")["prefix:a"].ttl_version
+            > fossil_ttlv
+        )
+        await net.stop()
+
+    run(main())
+
+
 def test_flap_counter_counts_once_per_flap():
     async def main():
         clock = SimClock()

@@ -130,6 +130,12 @@ FIXTURES = {
         (),
         2,
     ),
+    "slot-table": (
+        "def churn(enc, ls):\n"
+        "    return patch_encoded_topology_slots(enc, ls, 'me')\n",
+        (),
+        2,
+    ),
     "pipeline-phase-registry": (
         "def record(counters):\n"
         '    counters.observe("pipeline.decode.ms", 1.0)\n',
@@ -421,6 +427,60 @@ def test_resilience_latch_pool_mutators_trip():
     assert [f.rule for f in analyze_source(src)] == ["resilience-latch"]
     src2 = "def heal(pool):\n    pool.restore_device(3)\n"
     assert [f.rule for f in analyze_source(src2)] == ["resilience-latch"]
+
+
+def test_slot_table_mutator_calls_trip():
+    """Slot-stable structural patches (ISSUE 12) are backend-owned —
+    anyone else calling them breaks the encode chain's single-owner
+    discipline."""
+    src = (
+        "def churn(enc, ls):\n"
+        "    return patch_encoded_topology_slots(enc, ls, 'me')\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == ["slot-table"]
+    src2 = (
+        "def churn(prev, als):\n"
+        "    from openr_tpu.ops import csr\n"
+        "    return csr.patch_encoded_multi_area_slots(prev, als, 'me')\n"
+    )
+    assert [f.rule for f in analyze_source(src2)] == ["slot-table"]
+
+
+def test_slot_table_metadata_writes_trip_reads_are_clean():
+    src = (
+        "def fabricate(enc):\n"
+        "    enc.tombstoned_nodes = frozenset({'ghost'})\n"
+        "    enc.slot_changed = None\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == [
+        "slot-table",
+        "slot-table",
+    ]
+    # reads are how the warm planner and tests consume the metadata
+    src2 = (
+        "def inspect(enc):\n"
+        "    return (enc.tombstoned_nodes, enc.tombstoned_links,\n"
+        "            enc.slot_changed)\n"
+    )
+    assert analyze_source(src2) == []
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        "openr_tpu/ops/csr.py",
+        "openr_tpu/decision/backend.py",
+    ],
+)
+def test_slot_table_owners_are_exempt(rel):
+    src = (
+        "def patch(old, ls):\n"
+        "    enc, reason = patch_encoded_topology_slots(old, ls, 'me')\n"
+        "    enc.slot_changed = None\n"
+        "    return enc\n"
+    )
+    mods = [ParsedModule.parse(rel, src)]
+    assert analyze_modules(mods).findings == []
 
 
 def test_alert_registry_fstring_head_trips():

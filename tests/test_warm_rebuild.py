@@ -268,12 +268,14 @@ def test_warm_parity_with_prefix_churn_on_same_tick():
     assert norm_db(db_w) == norm_db(SpfSolver("node0").build_route_db(als, ps))
 
 
-def test_structural_delta_falls_back_cold_with_parity():
+def test_structural_delta_unhinted_stays_cold_with_parity():
     adj, ls, ps = make_world()
     als = {"0": ls}
     warm = make_backend(warm=True)
     warm.build_route_db(als, ps, force_full=True)
-    # node removal: Decision would classify structural (warm_delta=False)
+    # node removal WITHOUT a delta hint (a static-route change
+    # coinciding with the churn, say): the build stays cold — and the
+    # slot-patched encoding it runs on must still match the oracle
     ls.delete_adjacency_database("node15")
     db_w = warm.build_route_db(
         als, ps, changed_prefixes=set(), force_full=True, warm_delta=False
@@ -281,15 +283,55 @@ def test_structural_delta_falls_back_cold_with_parity():
     assert norm_db(db_w) == norm_db(SpfSolver("node0").build_route_db(als, ps))
     assert warm.num_warm_builds == 0
     assert warm.num_warm_cold_fallbacks >= 1
-    # even a LYING warm_delta hint must not break: the backend's own
-    # classifier sees the symbol-table change and declines
+    # ISSUE 12: membership churn with a delta hint (even the legacy
+    # warm_delta spelling) now WARMS through the slot-stable encode —
+    # the backend's own classifier proves layout identity and seeds
+    # the tombstoned region, and the result stays bit-parity
     ls.delete_adjacency_database("node14")
     db_w = warm.build_route_db(
         als, ps, changed_prefixes=set(), force_full=True, warm_delta=True
     )
     assert norm_db(db_w) == norm_db(SpfSolver("node0").build_route_db(als, ps))
-    assert warm.num_warm_builds == 0
-    assert warm._warm_fallback_reasons.get("structural", 0) >= 1
+    assert warm.num_warm_builds == 1
+    assert warm.num_encode_slot_patches >= 1
+
+
+def test_structural_delta_hint_warms_and_splits_counters():
+    adj, ls, ps = make_world()
+    als = {"0": ls}
+    warm = make_backend(warm=True)
+    warm.build_route_db(als, ps, force_full=True)
+    # leave: Decision classifies structural → the slot patch tombstones
+    # the node in place and the warm solve repairs only its region
+    ls.delete_adjacency_database("node15")
+    db_w = warm.build_route_db(
+        als,
+        ps,
+        changed_prefixes=set(),
+        force_full=True,
+        structural_delta=True,
+    )
+    assert norm_db(db_w) == norm_db(SpfSolver("node0").build_route_db(als, ps))
+    assert warm._warm_class_builds["structural"] == 1
+    assert warm._warm_class_builds["perturbation"] == 0
+    # rejoin: the same node re-advertises identical adjacencies — its
+    # slot and rows revive, improvements relax from the over-estimate
+    ls.update_adjacency_database(adj["node15"])
+    for n in ("node11", "node14"):
+        ls.update_adjacency_database(adj[n])
+    db_w = warm.build_route_db(
+        als,
+        ps,
+        changed_prefixes=set(),
+        force_full=True,
+        structural_delta=True,
+    )
+    assert norm_db(db_w) == norm_db(SpfSolver("node0").build_route_db(als, ps))
+    assert warm._warm_class_builds["structural"] == 2
+    assert warm.num_warm_cold_fallbacks == 0
+    snap = warm.counter_snapshot()
+    assert snap["decision.backend.warm_hit_ratio.structural"] == 1.0
+    assert snap["decision.backend.warm_encode_slot_patches"] >= 2
 
 
 # ---------------------------------------------------------------------------
