@@ -3127,6 +3127,473 @@ def rolling_main(seed: Optional[int] = None) -> None:
     print(json.dumps(doc))
 
 
+# ===========================================================================
+# --streaming: snapshot+delta fan-out at 10k+ subscribers (ISSUE 13)
+# ===========================================================================
+
+STREAMING_SEED = 11
+STREAMING_SUBS = 10_000
+STREAMING_CHURN_PER_TICK = 64
+STREAMING_TICKS = 24
+STREAMING_SMOKE_SUBS = 64
+STREAMING_SMOKE_TICKS = 12
+#: pull-mode cohort left undrained until the end: their 16-deep queues
+#: overflow over the tick run, proving shed_oldest-to-resync escalation
+STREAMING_OVERFLOW_COHORT = 32
+
+
+def validate_streaming_bench(doc: dict) -> None:
+    """Schema contract for BENCH_STREAMING_r*.json — shared by the
+    bench emitter, the tier-1 artifact gate and the benchtrack
+    manifest.  The headline is wall-clock fan-out throughput (delivered
+    emissions/s) over a 10k+ subscriber churn sweep under seeded chaos
+    (partition/heal mid-sweep); generation correctness is gated hard:
+    zero monotone-invariant violations, the stalled subscriber's single
+    merged delta reproducing the live db, no pre-partition generation
+    ever emitted, zero unexpected alerts, byte-identical seeded
+    replays."""
+    assert doc["metric"] == "streaming_fanout_emissions_per_sec"
+    assert doc["unit"] == "emissions/s"
+    d = doc["detail"]
+    subs = d["subscribers"]
+    assert subs["peak"] >= 10_000, "the sweep must reach 10k+ subscribers"
+    assert subs["churned"] > 0
+    fan = d["fanout"]
+    assert fan["emissions"] > 0 and fan["wall_s"] > 0
+    assert doc["value"] == fan["emissions_per_sec"] > 0
+    assert fan["deltas"] > 0 and fan["snapshots"] > 0
+    st = d["staleness_ms"]
+    assert st["samples"] > 0
+    assert 0 <= st["p50"] <= st["p95"] <= st["p99"] <= st["max"]
+    rs = d["resyncs"]
+    assert rs["count"] >= 1, "the overflow cohort must have resynced"
+    assert rs["overflow_cohort_resynced"] >= 1
+    assert 0.0 <= rs["rate"] <= 1.0
+    assert rs["shed_deltas"] >= 1
+    md = d["merged_delta"]
+    assert md["skipped_generations"] >= 3
+    assert md["emissions"] == 1, "one merged delta, never a replay of N"
+    assert md["kind_ok"] is True, "the merged window must be ONE delta"
+    assert md["parity"] is True
+    part = d["partition"]
+    assert part["post_heal_emissions"] > 0
+    assert part["pre_partition_generation_emissions"] == 0
+    assert d["invariant_violations"] == 0
+    assert d["alerts"]["unexpected"] == 0, d["alerts"]
+    assert d["smoke"]["subscribers"] == STREAMING_SMOKE_SUBS
+    assert d["deterministic_replay"] is True
+    for key in ("seed", "mode", "env"):
+        assert key in d, key
+    for key in ("platform", "jax", "device_count"):
+        assert key in d["env"], f"env.{key}"
+
+
+def streaming_fanout_world(n_subs: int, seed: int, ticks: int):
+    """One watch-plane fan-out round through the SimClock protocol
+    emulation: a 9-node grid converges, node0's StreamingService takes
+    ``n_subs`` push subscribers (vantages rotating over the other 8
+    nodes, a quarter of them prefix-filtered) plus a pull-mode overflow
+    cohort and one deliberately stalled probe, then a seeded churn
+    sweep drives ``ticks`` generations (prefix churn + a mid-sweep
+    partition/heal of node8) while subscribers attach/detach each tick.
+
+    Returns ``(detail, fingerprint)`` — the fingerprint covers the
+    probe subscribers' full emission logs and every node's alert JSONL:
+    two runs from one seed must match byte for byte."""
+    import asyncio
+    import random as _random
+    import zlib
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.emulation.network import EmulatedNetwork
+    from openr_tpu.emulation.topology import grid_edges
+    from openr_tpu.serving import apply_emission
+    from openr_tpu.types import PrefixEntry
+
+    rng = _random.Random(zlib.crc32(b"streaming") ^ (seed * 2654435761))
+
+    def overrides(cfg):
+        s = cfg.serving_config
+        s.stream_publish_min_ms = 5
+        s.stream_publish_max_ms = 20
+        # shallow queues so the never-drained overflow cohort provably
+        # escalates to resync within the tick budget
+        s.stream_queue_depth = 8
+        s.quota_tokens = 50
+        s.quota_refill_per_s = 100.0
+        # pull-mode cohorts are drained at the END of the sweep; the
+        # stall detacher must not reap them mid-measurement
+        s.stream_stall_detach_s = 300.0
+
+    def canon_rows(rows) -> str:
+        return json.dumps(
+            {"|".join(map(str, k)): v for k, v in rows.items()},
+            sort_keys=True,
+            default=str,
+        )
+
+    async def run():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=overrides)
+        net.build(grid_edges(3))
+        net.start()
+        for _ in range(10):
+            await clock.run_for(4.0)
+            if net.converged_full_mesh()[0]:
+                break
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+
+        n0 = net.nodes["node0"]
+        st = n0.streaming
+        vantages = [f"node{i}" for i in range(1, 9)]
+
+        delivered = [0]
+        monotone_regressions = [0]
+        pre_partition_emissions = [0]
+        post_heal_emissions = [0]
+        partition_seq = [None]
+        healed_at_emission = [None]
+
+        def make_deliver(record: Optional[list] = None):
+            state = {"last": -1}
+
+            def deliver(e):
+                delivered[0] += 1
+                if e["seq"] < state["last"]:
+                    monotone_regressions[0] += 1
+                state["last"] = e["seq"]
+                if (
+                    partition_seq[0] is not None
+                    and e["seq"] <= partition_seq[0]
+                ):
+                    pre_partition_emissions[0] += 1
+                if healed_at_emission[0] is not None:
+                    post_heal_emissions[0] += 1
+                if record is not None:
+                    record.append(e)
+
+            return deliver
+
+        live: list = []  # (sub_id, client) attach order, churn pool
+        attached_total = 0
+
+        def attach_one(i: int, record: Optional[list] = None):
+            nonlocal attached_total
+            filters = ("10.220.",) if i % 4 == 0 else ()
+            sid = st.subscribe(
+                "route_db",
+                {"node": vantages[i % len(vantages)]},
+                client_id=f"w{i}",
+                prefix_filters=filters,
+                deliver=make_deliver(record),
+            )
+            live.append((sid, f"w{i}"))
+            attached_total += 1
+            return sid
+
+        # probe subscribers: full emission logs (the determinism
+        # fingerprint) + applied-state parity at the end
+        probe_logs = [[] for _ in range(4)]
+        probe_ids = [
+            attach_one(i, record=probe_logs[i]) for i in range(4)
+        ]
+        for i in range(4, n_subs):
+            attach_one(i)
+        # pull-mode cohorts: the overflow cohort never polls until the
+        # end; the stalled probe polls exactly once after skipping >= 3
+        # generations
+        overflow_ids = [
+            st.subscribe(
+                "route_db",
+                {"node": vantages[i % len(vantages)]},
+                client_id=f"ov{i}",
+            )
+            for i in range(STREAMING_OVERFLOW_COHORT)
+        ]
+        stalled_id = st.subscribe(
+            "route_db", {"node": "node3"}, client_id="stalled"
+        )
+
+        async def poll1(sid, hold=0.1):
+            # SimClock discipline: the poll must park on a task while
+            # run_for advances virtual time
+            t = asyncio.ensure_future(st.next_emission(sid, hold_s=hold))
+            await clock.run_for(max(hold * 4, 0.5))
+            return t.result()
+
+        stalled_snap = await poll1(stalled_id)
+        assert stalled_snap["type"] == "snapshot"
+        stalled_state = apply_emission({}, stalled_snap)
+        stalled_cursor = stalled_snap["seq"]
+        # prime the overflow cohort's cursors (first contact = the
+        # subscribe snapshot); they never drain again until the end
+        for sid in overflow_ids:
+            e = await poll1(sid)
+            assert e["type"] == "snapshot"
+        merged_stats = {}
+
+        peak = len(st._subs)
+        churned = 0
+        side_a = [f"node{i}" for i in range(8)]
+        t0 = time.time()
+        for tick in range(ticks):
+            n0.advertise_prefixes([PrefixEntry(f"10.220.{tick}.0/24")])
+            await clock.run_for(1.0)
+            if tick == ticks // 3:
+                # mid-sweep partition: node8's hold-timer leave is a
+                # structural (full-window) generation at node0
+                partition_seq[0] = n0.decision.generation_key()[0]
+                net.partition(side_a, ["node8"])
+                await clock.run_for(4.0)
+            if tick == (2 * ticks) // 3:
+                net.heal_partition(side_a, ["node8"])
+                await clock.run_for(8.0)
+                healed_at_emission[0] = delivered[0]
+            if tick == 5:
+                # the stalled probe drains once mid-sweep, BEFORE its
+                # queue overflows: >= 3 skipped generations must fold
+                # into exactly ONE merged delta reproducing live
+                skipped = (
+                    n0.decision.generation_key()[0] - stalled_cursor
+                )
+                merged = await poll1(stalled_id)
+                emitted = 0
+                if merged is not None:
+                    emitted = 1
+                    stalled_state = apply_emission(stalled_state, merged)
+                more = await poll1(stalled_id)
+                _g, live_db = n0.serving.snapshot_for(
+                    "route_db", {"node": "node3"}
+                )
+                want = {
+                    ("u", r["dest"]): r
+                    for r in live_db["unicast_routes"]
+                }
+                want.update(
+                    {
+                        ("m", r["top_label"]): r
+                        for r in live_db["mpls_routes"]
+                    }
+                )
+                merged_stats = {
+                    "skipped_generations": skipped,
+                    "emissions": emitted,
+                    "kind_ok": (
+                        merged is not None
+                        and merged["type"] == "delta"
+                        and merged["merged_generations"] >= 3
+                        and more is None
+                    ),
+                    "parity": (
+                        canon_rows(stalled_state) == canon_rows(want)
+                    ),
+                }
+            # subscriber churn: seeded detach + fresh attach
+            for _ in range(min(STREAMING_CHURN_PER_TICK, len(live) - 8)):
+                idx = rng.randrange(4, len(live))  # never the probes
+                sid, _client = live.pop(idx)
+                st.unsubscribe(sid)
+                churned += 1
+            for j in range(STREAMING_CHURN_PER_TICK):
+                attach_one(attached_total)
+            peak = max(peak, len(st._subs))
+        await clock.run_for(4.0)
+        wall_s = time.time() - t0
+
+        # the overflow cohort: shallow queues over `ticks` generations
+        # must have escalated to snapshot resync
+        overflow_resyncs = 0
+        for sid in overflow_ids:
+            e = await poll1(sid)
+            if e is not None and e["type"] == "snapshot" and e[
+                "reason"
+            ].startswith("resync"):
+                overflow_resyncs += 1
+
+        # probe parity: every probe's applied state matches live
+        probe_parity = True
+        for i, log in enumerate(probe_logs):
+            state: dict = {}
+            for e in log:
+                state = apply_emission(state, e)
+            _g, db = n0.serving.snapshot_for(
+                "route_db", {"node": vantages[i % len(vantages)]}
+            )
+            wrows = {("u", r["dest"]): r for r in db["unicast_routes"]}
+            wrows.update(
+                {("m", r["top_label"]): r for r in db["mpls_routes"]}
+            )
+            if probe_ids[i] in st._subs and st._subs[
+                probe_ids[i]
+            ].prefix_filters:
+                wrows = {
+                    k: v
+                    for k, v in wrows.items()
+                    if k[0] != "u" or k[1].startswith("10.220.")
+                }
+            if canon_rows(state) != canon_rows(wrows):
+                probe_parity = False
+
+        c = n0.counters
+        stale_h = c.histogram("streaming.staleness_ms")
+        pct = stale_h.percentiles() if stale_h is not None else {}
+        emissions = int(c.get("streaming.emissions"))
+        resyncs = int(c.get("streaming.resyncs"))
+        fired = []
+        for _name, node in sorted(net.nodes.items()):
+            if node.health is not None:
+                for line in node.health.alert_log():
+                    e = json.loads(line)
+                    if e["event"] == "fired":
+                        fired.append(e["name"])
+
+        detail = {
+            "nodes": 9,
+            "seed": seed,
+            "ticks": ticks,
+            "virtual_s": round(clock.now(), 1),
+            "subscribers": {
+                "peak": peak,
+                "attached_total": attached_total
+                + STREAMING_OVERFLOW_COHORT
+                + 1,
+                "churned": churned,
+                "final": len(st._subs),
+                "quota_clients_final": len(n0.serving._quotas),
+            },
+            "feeds": len(st._feeds),
+            "fanout": {
+                "emissions": emissions,
+                "delivered": delivered[0],
+                "wall_s": round(wall_s, 3),
+                "emissions_per_sec": round(delivered[0] / wall_s, 1),
+                "deltas": int(c.get("streaming.deltas")),
+                "snapshots": int(c.get("streaming.snapshots")),
+                "coalesced": int(
+                    c.get("streaming.coalesced_emissions")
+                ),
+            },
+            "staleness_ms": {
+                "p50": round(pct.get("p50", 0.0), 3),
+                "p95": round(pct.get("p95", 0.0), 3),
+                "p99": round(pct.get("p99", 0.0), 3),
+                "max": round(stale_h.vmax if stale_h else 0.0, 3),
+                "samples": stale_h.count if stale_h else 0,
+            },
+            "resyncs": {
+                "count": resyncs,
+                "rate": round(resyncs / max(1, emissions), 5),
+                "shed_deltas": int(c.get("streaming.shed_deltas")),
+                "overflow_cohort_resynced": overflow_resyncs,
+            },
+            "merged_delta": {
+                **merged_stats,
+                "parity": merged_stats.get("parity", False)
+                and probe_parity,
+            },
+            "partition": {
+                "partition_seq": partition_seq[0],
+                "pre_partition_generation_emissions": (
+                    pre_partition_emissions[0]
+                ),
+                "post_heal_emissions": (
+                    delivered[0] - (healed_at_emission[0] or 0)
+                ),
+                "monotone_regressions": monotone_regressions[0],
+            },
+            "invariant_violations": int(
+                c.get("streaming.invariant_violations")
+            ),
+            "alerts": {
+                "fired": len(fired),
+                "unexpected": len(fired),
+                "unexpected_names": sorted(fired),
+            },
+        }
+        fingerprint = b"\n".join(
+            [
+                json.dumps(
+                    [
+                        [
+                            json.dumps(e, sort_keys=True, default=str)
+                            for e in log
+                        ]
+                        for log in probe_logs
+                    ]
+                ).encode(),
+                *(
+                    log
+                    for _n, log in sorted(
+                        net.health_alert_logs().items()
+                    )
+                ),
+            ]
+        )
+        await net.stop()
+        return detail, fingerprint
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(run())
+    finally:
+        loop.close()
+
+
+def streaming_main(seed: Optional[int] = None) -> None:
+    """Watch-plane fan-out benchmark (BENCH_STREAMING_r*): 10k+ push
+    subscribers with per-tick churn on one node's StreamingService,
+    under a seeded chaos sweep (mid-sweep partition/heal of node8), with
+    generation correctness gated hard — see validate_streaming_bench.
+    Emits one JSON line."""
+    seed = STREAMING_SEED if seed is None else seed
+    t0 = time.time()
+    detail, _fp = streaming_fanout_world(
+        STREAMING_SUBS, seed, STREAMING_TICKS
+    )
+    detail["wall_s"] = round(time.time() - t0, 1)
+    print(
+        f"# streaming fan-out: {detail['subscribers']['peak']} subs peak "
+        f"{detail['fanout']['emissions_per_sec']} emissions/s "
+        f"p99 staleness {detail['staleness_ms']['p99']}ms virtual "
+        f"resync rate {detail['resyncs']['rate']} "
+        f"({detail['wall_s']}s wall)",
+        file=sys.stderr,
+    )
+    d1, fp1 = streaming_fanout_world(
+        STREAMING_SMOKE_SUBS, seed, STREAMING_SMOKE_TICKS
+    )
+    _d2, fp2 = streaming_fanout_world(
+        STREAMING_SMOKE_SUBS, seed, STREAMING_SMOKE_TICKS
+    )
+    doc = {
+        "metric": "streaming_fanout_emissions_per_sec",
+        "value": detail["fanout"]["emissions_per_sec"],
+        "unit": "emissions/s",
+        "detail": {
+            **detail,
+            "smoke": {
+                "subscribers": STREAMING_SMOKE_SUBS,
+                "ticks": STREAMING_SMOKE_TICKS,
+                "emissions": d1["fanout"]["emissions"],
+                "resyncs": d1["resyncs"]["count"],
+            },
+            "deterministic_replay": fp1 == fp2,
+            "mode": (
+                "emulate (SimClock, 9-node grid, full OpenrNodes; "
+                "scalar decision path; 10k+ push subscribers with "
+                "seeded per-tick churn on node0's StreamingService, "
+                "pull-mode overflow cohort + one stalled probe; "
+                "mid-sweep partition/heal of node8; staleness in "
+                "virtual ms, fan-out throughput in wall seconds)"
+            ),
+            "env": env_stamp(),
+        },
+    }
+    validate_streaming_bench(doc)
+    print(json.dumps(doc))
+
+
 def main() -> None:
     t_start = time.time()
     from openr_tpu.ops.platform_env import (
@@ -3572,6 +4039,7 @@ BENCH_MODES = {
     "warm-start": (warmstart_main, "perturbations 7", "generation-delta warm rebuild vs cold + native warm sweep"),
     "suite": (suite_main, "sweeps 7", "topology-class trajectory: seeded chaos sweeps at 1k+ nodes per class"),
     "rolling": (rolling_main, "sweep 11", "rolling-restart survival: every node bounced once, structural warm-hit + SLO hold"),
+    "streaming": (streaming_main, "sweep 11", "watch-plane fan-out: 10k+ subscriber churn under chaos, snapshot+delta generation correctness"),
 }
 
 

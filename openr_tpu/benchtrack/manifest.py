@@ -194,6 +194,12 @@ def _spoil_rolling(doc: dict) -> None:
     doc["detail"]["alerts"]["unexpected"] = 1
 
 
+def _spoil_streaming(doc: dict) -> None:
+    # a single monotone-invariant violation (a stale/reordered emission
+    # reached a subscriber) must never pass the gate
+    doc["detail"]["invariant_violations"] = 1
+
+
 # -- acceptance floors moved out of the six per-family test files
 
 
@@ -255,6 +261,20 @@ def _accept_trajectory(doc: dict) -> None:
         assert row["alerts"]["unexpected"] == 0, name
         assert row["warm"]["hit_ratio"] >= 0.9, name
     assert doc["detail"]["deterministic_replay"] is True
+
+
+def _accept_streaming(doc: dict) -> None:
+    # the ISSUE-13 acceptance floor: 10k+ subscriber churn with
+    # generation correctness gated hard
+    d = doc["detail"]
+    assert d["subscribers"]["peak"] >= 10_000
+    assert d["invariant_violations"] == 0
+    assert d["merged_delta"]["parity"] is True
+    assert d["merged_delta"]["skipped_generations"] >= 3
+    assert d["partition"]["pre_partition_generation_emissions"] == 0
+    assert d["resyncs"]["rate"] < 0.5, "a resync loop is a failure mode"
+    assert d["alerts"]["unexpected"] == 0
+    assert d["deterministic_replay"] is True
 
 
 def _accept_rolling(doc: dict) -> None:
@@ -488,6 +508,30 @@ MANIFEST: Tuple[ArtifactSpec, ...] = (
         ),
         spoil=_spoil_rolling,
         acceptance=_accept_rolling,
+    ),
+    ArtifactSpec(
+        family="streaming",
+        pattern=r"BENCH_STREAMING_r(\d+)\.json",
+        description=(
+            "watch-plane fan-out: 10k+ push subscribers with seeded "
+            "per-tick churn under mid-sweep partition/heal — fan-out "
+            "throughput, p99 snapshot staleness, resync rate, "
+            "generation correctness gated hard (bench.py --streaming)"
+        ),
+        validate=_v("streaming"),
+        headline=(
+            # wall-clock fan-out throughput (machine-dependent, wide
+            # tolerance like the serving qps headline)
+            HeadlineMetric("value", HIGHER, tolerance_pct=40.0),
+            # p99 bump→delivery staleness in VIRTUAL ms (debounce +
+            # drain discipline; deterministic up to churn schedule)
+            HeadlineMetric(
+                "detail.staleness_ms.p99", LOWER, tolerance_pct=25.0
+            ),
+        ),
+        markers=("serving", "streaming"),
+        spoil=_spoil_streaming,
+        acceptance=_accept_streaming,
     ),
 )
 

@@ -722,6 +722,69 @@ def serving_fleet_summary(ctx: click.Context, client_id: str) -> None:
     _print(_call(ctx, "serving_fleet_summary", client_id=client_id))
 
 
+@serving.command("stream-stats")
+@click.pass_context
+def serving_stream_stats(ctx: click.Context) -> None:
+    """Watch-plane telemetry: subscriber/feed/emission/resync counters
+    and the staleness histogram (the `serving watch` runbook surface)."""
+    _print(_call(ctx, "get_streaming_stats"))
+
+
+@serving.command("watch")
+@click.argument("node")
+@click.option(
+    "--deltas",
+    default=0,
+    help="follow this many delta emissions after the snapshot (0 = "
+    "snapshot only)",
+)
+@click.option("--duration", default=0, help="stop after N seconds (0=forever)")
+@click.option(
+    "--prefix",
+    "prefixes",
+    multiple=True,
+    help="only stream routes whose destination starts with this "
+    "(repeatable)",
+)
+@click.option("--client-id", default="", help="quota accounting id")
+@click.pass_context
+def serving_watch(
+    ctx: click.Context,
+    node: str,
+    deltas: int,
+    duration: int,
+    prefixes: tuple,
+    client_id: str,
+) -> None:
+    """Watch NODE's computed RouteDb: one generation-stamped snapshot,
+    then coalesced deltas on every generation bump (a slow terminal
+    skipping generations gets ONE merged delta, or a snapshot resync —
+    never a stale or reordered one).  docs/Serving.md §streaming."""
+    host, port = ctx.obj["host"], ctx.obj["port"]
+    tls = ctx.obj.get("tls")
+
+    async def go():
+        seen_deltas = 0
+        async with OpenrCtrlClient(host=host, port=port, tls=tls) as client:
+            stream = client.stream(
+                "subscribe_and_get_serving_route_db",
+                node=node,
+                prefix_filters=list(prefixes),
+                client_id=client_id,
+            )
+            async for emission in stream:
+                click.echo(
+                    json.dumps(emission, indent=2, sort_keys=True,
+                               default=str)
+                )
+                if emission.get("type") == "delta":
+                    seen_deltas += 1
+                if seen_deltas >= deltas:
+                    return
+
+    _run_bounded(go(), duration)
+
+
 # -------------------------------------------------------------- resilience
 
 

@@ -226,8 +226,29 @@ class ServingConfig:
     quota_tokens: int = 0
     #: tokens regained per second per client
     quota_refill_per_s: float = 100.0
+    #: quota client-table bound: past this many distinct clients,
+    #: fully-refilled buckets (which carry no state) are pruned.  A
+    #: 10k-subscriber churn sweep must be able to size this to its
+    #: churn rate instead of retaining dead buckets to a hardcoded 16k
+    max_quota_clients: int = 16384
     #: result-cache LRU bound, in (generation, query) entries (0 = off)
     cache_entries: int = 1024
+
+    # -- streaming subscription tier (serving/streaming.py) ---------------
+    #: bounded per-subscriber delta queue; overflow sheds the oldest
+    #: entry and escalates the subscriber to a snapshot resync
+    stream_queue_depth: int = 16
+    #: publish debounce window on generation bumps (doubles min -> max,
+    #: the Decision rebuild-debounce discipline)
+    stream_publish_min_ms: int = 10
+    stream_publish_max_ms: int = 100
+    #: a subscriber that neither polls nor accepts a push delivery for
+    #: this long is detached (its quota bucket pruned eagerly)
+    stream_stall_detach_s: float = 30.0
+    #: admission bound on concurrent subscribers per node
+    stream_max_subscribers: int = 65536
+    #: long-poll hold when a subscriber's delta queue is empty
+    stream_poll_hold_s: float = 20.0
 
 
 @dataclass
@@ -432,6 +453,22 @@ class OpenrConfig:
             raise ValueError(
                 "serving needs max_batch >= 1, max_queue_depth >= 1, "
                 "max_wait_ms >= 0"
+            )
+        if (
+            s.max_quota_clients < 1
+            or s.stream_queue_depth < 1
+            or s.stream_max_subscribers < 1
+        ):
+            raise ValueError(
+                "serving needs max_quota_clients >= 1, "
+                "stream_queue_depth >= 1, stream_max_subscribers >= 1"
+            )
+        if not (0 < s.stream_publish_min_ms <= s.stream_publish_max_ms):
+            raise ValueError("invalid serving stream publish window")
+        if s.stream_stall_detach_s <= 0 or s.stream_poll_hold_s <= 0:
+            raise ValueError(
+                "serving needs stream_stall_detach_s > 0 and "
+                "stream_poll_hold_s > 0"
             )
         r = self.resilience_config
         if r.shadow_sample_every < 0 or r.failure_threshold < 1:

@@ -513,6 +513,45 @@ class OpenrCtrlHandler:
             "fleet_summary", {}, client_id=client_id
         )
 
+    # ----------------------------------------------------- serving/streaming
+    # (openr_tpu.serving.streaming — snapshot + generation-correct
+    # coalesced deltas for route watchers; net-new vs the reference,
+    # whose subscription surfaces stream KvStore/FIB, not computed RIBs)
+
+    def get_streaming_stats(self) -> dict:
+        """Watch-plane telemetry: subscriber/feed/emission/resync
+        counters, staleness histogram, live knobs
+        (`breeze serving watch` / operators)."""
+        return self.node.streaming.stats()
+
+    async def subscribe_and_get_serving_route_db(
+        self,
+        node: str,
+        prefix_filters: Optional[List[str]] = None,
+        client_id: str = "",
+    ) -> AsyncIterator[dict]:
+        """Server-stream: ONE generation-stamped snapshot of `node`'s
+        computed RouteDb, then coalesced deltas on every Decision
+        generation bump (a slow reader skipping N generations receives
+        one merged delta, or a snapshot resync after queue overflow —
+        never a stale or reordered update)."""
+        streaming = self.node.streaming
+        sub_id = streaming.subscribe(
+            "route_db",
+            {"node": node},
+            client_id=client_id,
+            prefix_filters=tuple(prefix_filters or ()),
+        )
+        sid = self._subscriber("serving_route_db")
+        try:
+            while True:
+                emission = await streaming.next_emission(sub_id)
+                if emission is not None:
+                    yield emission
+        finally:
+            self._subscribers.pop(sid, None)
+            streaming.unsubscribe(sub_id)
+
     # ------------------------------------------------------------ resilience
     # (openr_tpu.resilience — breaker/governor health of every
     # external-dependency edge; net-new vs the reference)

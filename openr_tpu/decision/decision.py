@@ -140,8 +140,10 @@ class Decision(Actor):
         #: computed-result generation is (LSDB, policy), not LSDB alone)
         self._change_seq = 0
         #: serving-plane invalidation hooks, called with the new change
-        #: seq whenever the computed-result generation moves
-        self._generation_listeners: List[Callable[[int], None]] = []
+        #: seq whenever the computed-result generation moves; entries
+        #: are (priority, registration index, fn) and fire in ascending
+        #: order — see add_generation_listener
+        self._generation_listeners: List[tuple] = []
         self._fleet_engine = None
         self._whatif_engine = None
         self._whatif_multi_engine = None
@@ -276,14 +278,42 @@ class Decision(Actor):
         plane so cached results from the previous generation are never
         served again (the rebuild-path invalidation contract)."""
         self._change_seq += 1
-        for listener in self._generation_listeners:
+        for _prio, _order, listener in self._generation_listeners:
             listener(self._change_seq)
 
-    def add_generation_listener(self, fn: Callable[[int], None]) -> None:
+    def add_generation_listener(
+        self, fn: Callable[[int], None], priority: int = 0
+    ) -> None:
         """Register a callback fired on every generation bump (LSDB
-        change or RibPolicy set/clear).  Used by openr_tpu.serving to
-        invalidate its content-addressed result cache eagerly."""
-        self._generation_listeners.append(fn)
+        change or RibPolicy set/clear).  Listeners fire in ascending
+        ``(priority, registration order)`` — the order is STABLE, so
+        cache-PURGING listeners (QueryService's result-cache
+        invalidation, default priority 0) always run before listeners
+        that MINT new state from the fresh generation (the streaming
+        tier's publish scheduler registers at priority 10): a snapshot
+        computed inside a later listener can never race a purge of its
+        own generation's entries."""
+        entry = (priority, len(self._generation_listeners), fn)
+        self._generation_listeners.append(entry)
+        self._generation_listeners.sort(key=lambda e: (e[0], e[1]))
+
+    def pending_delta_hint(self) -> tuple:
+        """``(full, changed_prefixes)`` — the delta class of the
+        un-rebuilt LSDB window, read by generation listeners (the
+        streaming tier) AT BUMP TIME to scope their own diffs.  ``full``
+        is True when a topology/policy/static change is pending: such a
+        tick can move routes for ANY prefix at ANY vantage.  When False,
+        only the returned prefixes' advertisements changed, so no other
+        prefix's computed route (at any vantage) can differ — the
+        per-prefix delta discipline ``take_last_changed_prefixes``
+        applies to the publication diff, extended to the watch plane.
+        The returned set is live; callers must copy, not hold."""
+        full = (
+            self._pending_topo_changed
+            or self._pending_force_full
+            or not self._first_build_done
+        )
+        return full, self._pending_prefix_changes
 
     def generation_key(self) -> tuple:
         """Content address of the state every computed-result query
@@ -651,10 +681,12 @@ class Decision(Actor):
         self._save_rib_policy()
         # a policy flip changes what every computed-result query would
         # return even on an identical LSDB: the fleet/what-if table
-        # caches and the serving result cache key on this generation
+        # caches and the serving result cache key on this generation.
+        # force_full is set BEFORE the bump so pending_delta_hint reads
+        # "full" inside the listeners this bump fires
+        self._pending_force_full = True
         self._bump_generation()
         self._rebuild_pending = True
-        self._pending_force_full = True
         if self._unblocked:
             self._debounce()
 
@@ -665,9 +697,9 @@ class Decision(Actor):
         self.rib_policy = None
         if self.rib_policy_file and os.path.exists(self.rib_policy_file):
             os.unlink(self.rib_policy_file)
+        self._pending_force_full = True
         self._bump_generation()
         self._rebuild_pending = True
-        self._pending_force_full = True
         if self._unblocked:
             self._debounce()
 

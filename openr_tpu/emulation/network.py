@@ -285,6 +285,16 @@ class EmulatedNetwork:
             for name, node in sorted(self.nodes.items())
         }
 
+    def streaming_stats(self) -> Dict[str, dict]:
+        """Per-node watch-plane stats (subscriber/feed/emission/resync
+        counters) — the whole-emulation `breeze serving stream-stats`,
+        used by chaos runs to assert the fan-out plane never violated
+        the monotone-generation invariant."""
+        return {
+            name: node.streaming.stats()
+            for name, node in sorted(self.nodes.items())
+        }
+
     def metrics_snapshots(self, exclude: tuple = ()) -> list:
         """One MetricsSnapshot per node (sorted by name) — the input to
         `render_prometheus` / the JSONL export.  `exclude` drops counter

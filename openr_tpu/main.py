@@ -384,6 +384,22 @@ class OpenrNode:
             counters=self.counters,
             tracer=self.tracer,
         )
+        # the streaming tier (watch plane) registers its publish
+        # scheduler at a LATER listener priority than the QueryService
+        # cache purge above: purge-before-publish is the generation-
+        # correctness ordering contract (serving/streaming.py)
+        from openr_tpu.serving.streaming import StreamingService
+
+        self.streaming = StreamingService(
+            node_name=self.name,
+            clock=clock,
+            config=config.serving_config,
+            decision=self.decision,
+            query_service=self.serving,
+            counters=self.counters,
+            tracer=self.tracer,
+            breaker_seed=config.resilience_config.seed,
+        )
         # -- aux services (L6): config-store, monitor, watchdog ------------
         # Drain state survives restarts via the persistent store
         # (reference: LinkMonitor loads from PersistentStore on start,
@@ -425,6 +441,7 @@ class OpenrNode:
         self.monitor.add_counter_provider(self.dispatcher.queue_stats)
         self.monitor.add_counter_provider(self._queue_gauges)
         self.monitor.add_counter_provider(self.serving.gauges)
+        self.monitor.add_counter_provider(self.streaming.gauges)
         # pipeline attribution gauges: per-chip busy ms / utilization
         # accumulated by the backend + fleet/what-if engines' shared
         # PipelineProbe (pipeline.devN.*)
@@ -564,6 +581,7 @@ class OpenrNode:
         ]
         if config.serving_config.enabled:
             self._all_modules.append(self.serving)
+            self._all_modules.append(self.streaming)
         if self.health_monitor is not None:
             self._all_modules.append(self.health_monitor)
         if self.watchdog is not None:

@@ -195,6 +195,19 @@ def _build_descriptions() -> Dict[str, str]:
             "serving-plane queue wait before a query joins a batch"
         ),
         "serving.batch_solve_ms": "one micro-batched device solve",
+        "streaming.staleness_ms": (
+            "delta age at delivery: oldest merged generation's mint to "
+            "its emission to the subscriber"
+        ),
+        "streaming.subscribers": "attached watch-plane subscribers",
+        "streaming.num_resyncs": (
+            "snapshot resyncs (queue overflow / transport failure "
+            "escalations)"
+        ),
+        "streaming.num_invariant_violations": (
+            "emissions refused by the monotone-generation check "
+            "(must stay 0)"
+        ),
         "trace.dropped_spans": (
             "open spans dropped at the open-span cap (trace blind spots)"
         ),

@@ -1,8 +1,10 @@
 """openr_tpu.serving — the query-serving plane.
 
 See serving/service.py (QueryService: micro-batching, dedup, admission
-control) and serving/cache.py (content-addressed result cache), and
-docs/Serving.md for the architecture and knobs.
+control), serving/cache.py (content-addressed result cache), and
+serving/streaming.py (StreamingService: snapshot + generation-correct
+delta fan-out for route watchers), and docs/Serving.md for the
+architecture and knobs.
 """
 
 from openr_tpu.serving.cache import ResultCache, canonical_query
@@ -14,6 +16,12 @@ from openr_tpu.serving.service import (
     ServingShedError,
     TokenBucket,
 )
+from openr_tpu.serving.streaming import (
+    StreamingInvariantError,
+    StreamingService,
+    StreamingUnknownSubscriberError,
+    apply_emission,
+)
 
 __all__ = [
     "QueryService",
@@ -22,6 +30,10 @@ __all__ = [
     "ServingQuotaError",
     "ServingRejectedError",
     "ServingShedError",
+    "StreamingInvariantError",
+    "StreamingService",
+    "StreamingUnknownSubscriberError",
     "TokenBucket",
+    "apply_emission",
     "canonical_query",
 ]

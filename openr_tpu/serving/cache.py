@@ -61,6 +61,12 @@ class ResultCache:
     def __init__(self, max_entries: int = 1024) -> None:
         self.max_entries = max_entries
         self._entries: "OrderedDict[tuple, Any]" = OrderedDict()
+        #: generation -> {query, ...} — the invalidation index, so a
+        #: generation bump purges in O(entries purged), not O(entries
+        #: resident) (a full-dict scan per bump is O(cache) work on the
+        #: rebuild hot path; at 10k streaming subscribers the bump rate
+        #: is the LSDB churn rate)
+        self._by_gen: dict = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -88,23 +94,34 @@ class ResultCache:
         key = (generation, query)
         self._entries[key] = result
         self._entries.move_to_end(key)
+        self._by_gen.setdefault(generation, set()).add(query)
         while len(self._entries) > self.max_entries:
-            self._entries.popitem(last=False)
+            (g, q), _ = self._entries.popitem(last=False)
+            self._unindex(g, q)
             self.evictions += 1
+
+    def _unindex(self, generation: Hashable, query: Hashable) -> None:
+        queries = self._by_gen.get(generation)
+        if queries is not None:
+            queries.discard(query)
+            if not queries:
+                del self._by_gen[generation]
 
     def invalidate_generation(self, live_generation: Optional[Hashable] = None) -> None:
         """Purge every entry NOT minted under ``live_generation`` (all
-        entries when None) — the Decision rebuild-path hook."""
+        entries when None) — the Decision rebuild-path hook.  Costs
+        O(entries purged) via the generation index; entries under the
+        live generation are untouched (and unscanned)."""
         if live_generation is None:
             self.invalidations += len(self._entries)
             self._entries.clear()
+            self._by_gen.clear()
             return
-        stale = [
-            k for k in self._entries if k[0] != live_generation
-        ]
-        for k in stale:
-            del self._entries[k]
-        self.invalidations += len(stale)
+        for gen in [g for g in self._by_gen if g != live_generation]:
+            for q in self._by_gen.pop(gen):
+                del self._entries[(gen, q)]
+                self.invalidations += 1
 
     def clear(self) -> None:
         self._entries.clear()
+        self._by_gen.clear()

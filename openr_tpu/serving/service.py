@@ -196,16 +196,18 @@ class QueryService(Actor):
 
     # -- admission + submit ------------------------------------------------
 
-    #: quota-map bound: past this many distinct clients, fully-refilled
-    #: buckets (which carry no state) are pruned — a million-client
-    #: deployment must not grow the map without bound
-    MAX_QUOTA_CLIENTS = 16384
-
-    def _check_quota(self, client_id: str) -> None:
+    def check_quota(self, client_id: str) -> None:
+        """Charge one token against `client_id`'s bucket; raises
+        ServingQuotaError when exhausted.  Public: the streaming tier's
+        subscribe/poll admissions ride the same buckets, so a client
+        cannot dodge its quota by switching surfaces.  Past
+        ``serving_config.max_quota_clients`` distinct clients,
+        fully-refilled buckets (which carry no state) are pruned — a
+        million-client deployment must not grow the map without bound."""
         cfg = self.config
         if cfg.quota_tokens <= 0:
             return  # unlimited: keep no per-client state at all
-        if len(self._quotas) > self.MAX_QUOTA_CLIENTS:
+        if len(self._quotas) > cfg.max_quota_clients:
             now = self.clock.now()
             for cid in [
                 c
@@ -226,6 +228,17 @@ class QueryService(Actor):
                 f"({cfg.quota_tokens} tokens, "
                 f"{cfg.quota_refill_per_s}/s refill)"
             )
+
+    def prune_client(self, client_id: str) -> None:
+        """Eagerly drop `client_id`'s quota bucket if it carries no
+        state (fully refilled) — called on subscriber disconnect so a
+        churn of short-lived watchers doesn't retain dead buckets until
+        the max_quota_clients threshold sweep.  A part-spent bucket is
+        kept: dropping it would refund the spend to a reconnecting
+        client."""
+        bucket = self._quotas.get(client_id)
+        if bucket is not None and bucket.is_full(self.clock.now()):
+            del self._quotas[client_id]
 
     def _admit_depth(self) -> None:
         """Queue-depth admission: only requests that need a NEW queue
@@ -271,7 +284,7 @@ class QueryService(Actor):
         self.counters.bump("serving.requests")
         query = canonical_query(kind, params)
         client = client_id or "anon"
-        self._check_quota(client)
+        self.check_quota(client)
         generation = self.decision.generation_key()
         hit, cached = self.cache.get(generation, query)
         if hit:
@@ -464,6 +477,26 @@ class QueryService(Actor):
             self.cache.put(gen, req.query, answer)
             req.resolve(answer)
 
+    def snapshot_for(self, kind: str, params: Optional[dict] = None):
+        """``(generation_key, result)`` — one SYNCHRONOUS cache-or-solve,
+        the streaming tier's snapshot/delta mint.  No awaits between the
+        generation read and the solve, so the stamp is exact by
+        construction (single-loop atomicity): the returned result was
+        computed under exactly the returned generation.  Cache hits and
+        misses ride the shared content-addressed cache, so 10k watchers
+        of one vantage cost one solve per generation."""
+        params = params or {}
+        query = canonical_query(kind, params)
+        generation = self.decision.generation_key()
+        hit, cached = self.cache.get(generation, query)
+        if hit:
+            self.counters.bump("serving.cache.hits")
+            return generation, cached
+        self.counters.bump("serving.cache.misses")
+        result = self._solve_inline(kind, params)
+        self.cache.put(generation, query, result)
+        return generation, result
+
     def _solve_inline(self, kind: str, params: dict):
         """One unbatched solve (disabled-mode path)."""
         if kind == "whatif" and not params.get("simultaneous"):
@@ -551,6 +584,7 @@ class QueryService(Actor):
                 "shed_policy": self.config.shed_policy,
                 "quota_tokens": self.config.quota_tokens,
                 "quota_refill_per_s": self.config.quota_refill_per_s,
+                "max_quota_clients": self.config.max_quota_clients,
                 "cache_entries": self.config.cache_entries,
             },
         }

@@ -1,0 +1,749 @@
+"""StreamingService — the watch plane: snapshot + generation-correct deltas.
+
+Production fleets *watch* routes; they don't poll them (Open/R's KvStore
+is itself a subscription fabric).  This actor turns the pull-only
+QueryService into a subscription tier: a client registers interest in a
+feed (a route-db vantage or a what-if scenario handle, optionally
+narrowed by prefix filters), receives ONE cached snapshot stamped with
+its generation key, then coalesced deltas on every Decision generation
+bump — ship the *change* per generation, never the world (the DeltaPath
+incremental-delta discipline, extended from the publication diff to the
+fan-out plane).
+
+The core robustness contract is **generation-correct coalescing**:
+
+* every emission carries the monotone generation seq it was computed
+  under; each subscriber carries a last-delivered cursor, and the
+  monotone-generation invariant (delta ``to_seq`` strictly above the
+  cursor, snapshot ``seq`` at or above it) is CHECKED at every emission
+  — a stale, reordered or pre-partition generation can never be
+  streamed, it raises and counts instead;
+* a slow subscriber skipping N generations receives ONE merged delta:
+  its queued per-generation entries fold per-prefix last-writer-wins in
+  seq order (deletions preserved — a later update revives, a later
+  delete wins), so applying the single emission reproduces the live
+  route-db exactly;
+* when the bounded per-subscriber queue overflows, the oldest entry is
+  shed and the subscriber escalates to a snapshot RESYNC (the merged
+  tail no longer reconstructs the window) — degradation is always
+  "fresh snapshot", never "silent gap".
+
+Backpressure rides the existing admission control: subscribe/poll
+charge the SAME per-client TokenBucket quotas the query plane uses,
+subscriber count is bounded, a subscriber that neither polls nor
+accepts a push delivery within the stall window is detached (its quota
+bucket pruned eagerly), and each push transport is protected by a PR-5
+CircuitBreaker — a throwing transport trips its breaker, deliveries
+short-circuit while it holds, and the queue-overflow path escalates the
+subscriber to resync when the transport heals.
+
+Fan-out efficiency: diffs are computed once per FEED per publish tick
+(10k watchers of one vantage share one solve via the content-addressed
+cache and one delta entry object); per-subscriber work is an append +
+an O(delta) merge at drain time.  Prefix-scoped subscribers filter at
+emission.  The publish tick scopes its diff by Decision's
+``pending_delta_hint``: prefix-only LSDB windows diff only the changed
+prefixes (sound at every vantage — no other prefix's route can move),
+topology/policy windows diff everything.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.common.utils import AsyncDebounce
+from openr_tpu.config import ServingConfig
+from openr_tpu.serving.cache import canonical_query
+from openr_tpu.serving.service import (
+    QueryService,
+    ServingError,
+    ServingRejectedError,
+)
+
+
+class StreamingInvariantError(ServingError):
+    """An emission would violate the monotone-generation invariant."""
+
+
+class StreamingUnknownSubscriberError(ServingError):
+    """The subscription id is gone (detached, or never existed)."""
+
+
+#: feed kinds a subscriber may watch
+KINDS = ("route_db", "whatif")
+
+
+def _row_key(kind: str, row: dict):
+    return row["dest"] if kind == "u" else row["top_label"]
+
+
+class _DeltaEntry:
+    """One generation window's changes for one feed, shared immutably by
+    every subscriber attached to that feed."""
+
+    __slots__ = ("seq", "generation", "updated", "removed", "t_mint")
+
+    def __init__(self, seq, generation, updated, removed, t_mint) -> None:
+        self.seq = seq
+        self.generation = generation
+        #: ("u", dest) / ("m", label) / ("scenario",) -> wire row
+        self.updated: Dict[tuple, Any] = updated
+        self.removed: set = removed
+        self.t_mint = t_mint
+
+
+class _Feed:
+    """One watched query: the diff base shared by its subscribers."""
+
+    __slots__ = ("key", "kind", "params", "last_seq", "last_rows", "subs")
+
+    def __init__(self, key: tuple, kind: str, params: dict) -> None:
+        self.key = key
+        self.kind = kind
+        self.params = params
+        self.last_seq = -1
+        #: row key -> wire row, the last published state
+        self.last_rows: Dict[tuple, Any] = {}
+        self.subs: set = set()
+
+
+class StreamSubscriber:
+    """Per-subscriber state: cursor, bounded delta queue, transport."""
+
+    __slots__ = (
+        "sub_id", "client_id", "feed", "prefix_filters", "cursor_seq",
+        "queue", "needs_resync", "resync_reason", "last_live_t",
+        "waiter", "deliver", "breaker", "detached",
+        "num_snapshots", "num_deltas", "num_resyncs",
+    )
+
+    def __init__(
+        self, sub_id: int, client_id: str, feed: _Feed,
+        prefix_filters: Tuple[str, ...], now: float,
+    ) -> None:
+        self.sub_id = sub_id
+        self.client_id = client_id
+        self.feed = feed
+        self.prefix_filters = prefix_filters
+        #: last generation seq delivered; -1 = snapshot not yet sent
+        self.cursor_seq = -1
+        self.queue: deque = deque()
+        self.needs_resync = False
+        self.resync_reason = ""
+        self.last_live_t = now
+        #: parked long-poll waiter (at most one)
+        self.waiter: Optional[asyncio.Future] = None
+        #: push transport (None = pull/long-poll subscriber)
+        self.deliver: Optional[Callable[[dict], None]] = None
+        self.breaker = None
+        self.detached = False
+        self.num_snapshots = 0
+        self.num_deltas = 0
+        self.num_resyncs = 0
+
+    def wants(self, dest: str) -> bool:
+        if not self.prefix_filters:
+            return True
+        return any(dest.startswith(f) for f in self.prefix_filters)
+
+
+def apply_emission(rows: Dict[tuple, Any], emission: dict) -> Dict[tuple, Any]:
+    """Apply one wire emission to a client-side row map (``("u", dest)``
+    / ``("m", label)`` -> wire row) and return the new map — the
+    reference client reducer, used by tests and the bench parity proof:
+    snapshot replaces, delta patches (updates then removals can't
+    conflict: the merge already resolved last-writer-wins)."""
+    if emission["type"] == "snapshot":
+        db = emission["route_db"]
+        out: Dict[tuple, Any] = {}
+        for row in db.get("unicast_routes", []):
+            out[("u", row["dest"])] = row
+        for row in db.get("mpls_routes", []):
+            out[("m", row["top_label"])] = row
+        return out
+    out = dict(rows)
+    for row in emission.get("unicast_updated", []):
+        out[("u", row["dest"])] = row
+    for dest in emission.get("unicast_removed", []):
+        out.pop(("u", dest), None)
+    for row in emission.get("mpls_updated", []):
+        out[("m", row["top_label"])] = row
+    for label in emission.get("mpls_removed", []):
+        out.pop(("m", label), None)
+    if "scenario" in emission:
+        out[("scenario",)] = emission["scenario"]
+    return out
+
+
+class StreamingService(Actor):
+    """Subscription tier over QueryService (see module docstring)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config: ServingConfig,
+        decision,
+        query_service: QueryService,
+        counters: Optional[CounterMap] = None,
+        tracer=None,
+        breaker_seed: int = 0,
+    ) -> None:
+        super().__init__("streaming", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.node_name = node_name
+        self.config = config
+        self.decision = decision
+        self.qs = query_service
+        self.tracer = tracer if tracer is not None else disabled_tracer()
+        self.breaker_seed = breaker_seed
+        self._subs: Dict[int, StreamSubscriber] = {}
+        self._feeds: Dict[tuple, _Feed] = {}
+        self._next_sub_id = 0
+        #: accumulated un-published delta window (see pending_delta_hint)
+        self._window_full = False
+        self._window_prefixes: set = set()
+        self._dirty = False
+        #: clock time of the window's FIRST bump — entries minted from
+        #: the window carry it, so staleness_ms measures bump→delivery
+        #: (debounce included), not publish→delivery
+        self._window_t0 = 0.0
+        self._started = False
+        self.num_publish_ticks = 0
+        self.num_emissions = 0
+        self.num_resyncs = 0
+        self.num_shed = 0
+        self.num_detached_stalled = 0
+        self.num_invariant_violations = 0
+        self._debounce = AsyncDebounce(
+            self,
+            config.stream_publish_min_ms / 1000.0,
+            config.stream_publish_max_ms / 1000.0,
+            self._publish_tick,
+        )
+        # the publish scheduler runs AFTER QueryService's cache purge
+        # (priority 10 vs the purge listener's default 0): a snapshot
+        # minted from the fresh generation is never raced by the purge
+        decision.add_generation_listener(self._on_generation_bump, priority=10)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._started = True
+        self.spawn(self._housekeeping_loop(), name="streaming.housekeeper")
+        if self._dirty:
+            self._debounce()
+
+    async def stop(self) -> None:
+        await super().stop()
+        for sub in list(self._subs.values()):
+            self._detach(sub, "shutdown")
+
+    async def _housekeeping_loop(self) -> None:
+        interval = max(self.config.stream_stall_detach_s / 2.0, 0.5)
+        while True:
+            await self.clock.sleep(interval)
+            self.touch()
+            self._detach_stalled()
+
+    # -- subscription management -------------------------------------------
+
+    def subscribe(
+        self,
+        kind: str,
+        params: Optional[dict] = None,
+        client_id: str = "",
+        prefix_filters: Tuple[str, ...] = (),
+        deliver: Optional[Callable[[dict], None]] = None,
+    ) -> int:
+        """Register interest; returns the subscription id.  Charges one
+        quota token; raises ServingRejectedError at the subscriber
+        bound.  With ``deliver``, emissions PUSH through the callable
+        (breaker-protected); otherwise the subscriber long-polls via
+        :meth:`next_emission`.  The first emission is always the
+        snapshot."""
+        if kind not in KINDS:
+            raise ServingError(f"unknown streaming feed kind {kind!r}")
+        params = params or {}
+        client = client_id or "anon"
+        if len(self._subs) >= self.config.stream_max_subscribers:
+            self.counters.bump("streaming.rejected_subscribers")
+            raise ServingRejectedError(
+                f"subscriber bound reached "
+                f"({self.config.stream_max_subscribers})"
+            )
+        self.qs.check_quota(client)
+        if not self._subs and self._dirty:
+            # a window accumulated while nobody watched: its age is
+            # meaningless staleness for a subscriber that just arrived,
+            # and no publish was ever scheduled for it (bumps only
+            # debounce while subscribers exist) — restamp and flush it
+            # now so it can't ride shotgun on the next live window
+            self._window_t0 = self.clock.now()
+            if self._started:
+                self._debounce()
+        key = canonical_query(kind, params)
+        feed = self._feeds.get(key)
+        if feed is None:
+            feed = self._feeds[key] = _Feed(key, kind, dict(params))
+        sub_id = self._next_sub_id
+        self._next_sub_id += 1
+        sub = StreamSubscriber(
+            sub_id, client, feed, tuple(prefix_filters), self.clock.now()
+        )
+        if deliver is not None:
+            from openr_tpu.resilience import CircuitBreaker
+
+            sub.deliver = deliver
+            sub.breaker = CircuitBreaker(
+                f"streaming.sub{sub_id}",
+                self.clock,
+                seed=self.breaker_seed,
+                counters=CounterMap(),  # per-sub counters stay private
+            )
+        feed.subs.add(sub_id)
+        self._subs[sub_id] = sub
+        self.counters.bump("streaming.subscribes")
+        self.tracer.instant(
+            "streaming.subscribe", None, module="streaming",
+            kind=kind, client=client,
+        )
+        if deliver is not None:
+            # push transports get their snapshot immediately
+            self._drain_push(sub)
+        return sub_id
+
+    def unsubscribe(self, sub_id: int) -> None:
+        sub = self._subs.get(sub_id)
+        if sub is not None:
+            self._detach(sub, "unsubscribe")
+
+    def _detach(self, sub: StreamSubscriber, why: str) -> None:
+        if sub.detached:
+            return
+        sub.detached = True
+        sub.queue.clear()
+        sub.feed.subs.discard(sub.sub_id)
+        self._subs.pop(sub.sub_id, None)
+        if not sub.feed.subs:
+            # last watcher gone: drop the feed's diff base
+            self._feeds.pop(sub.feed.key, None)
+        if sub.waiter is not None and not sub.waiter.done():
+            sub.waiter.set_exception(
+                StreamingUnknownSubscriberError(f"detached: {why}")
+            )
+        # eager quota-bucket prune (a churn of short-lived watchers must
+        # not retain dead buckets until the threshold sweep)
+        self.qs.prune_client(sub.client_id)
+        self.counters.bump(f"streaming.detach.{why}")
+
+    def _detach_stalled(self) -> None:
+        bound = self.config.stream_stall_detach_s
+        now = self.clock.now()
+        for sub in list(self._subs.values()):
+            live = sub.waiter is not None and not sub.waiter.done()
+            if not live and now - sub.last_live_t > bound:
+                self.num_detached_stalled += 1
+                self._detach(sub, "stalled")
+
+    # -- the delta feed ----------------------------------------------------
+
+    def _on_generation_bump(self, _seq: int) -> None:
+        full, prefixes = self.decision.pending_delta_hint()
+        if full:
+            self._window_full = True
+        else:
+            self._window_prefixes |= prefixes
+        if not self._dirty:
+            self._window_t0 = self.clock.now()
+        self._dirty = True
+        if self._started and self._subs:
+            self._debounce()
+
+    def _publish_tick(self) -> None:
+        """Debounce fired: mint one delta entry per watched feed from
+        the CURRENT generation and fan it out.  Runs synchronously on
+        the loop — no await between the generation read and the diffs,
+        so every entry's stamp is exact."""
+        if not self._dirty:
+            return
+        window_full, window_prefixes = self._window_full, self._window_prefixes
+        window_t0 = self._window_t0
+        self._window_full, self._window_prefixes = False, set()
+        self._dirty = False
+        if not self._subs:
+            return
+        self.num_publish_ticks += 1
+        self.counters.bump("streaming.publish_ticks")
+        span = self.tracer.start_span(
+            "streaming.publish", None, module="streaming",
+            feeds=len(self._feeds), full=window_full,
+        )
+        try:
+            now = window_t0
+            for feed in list(self._feeds.values()):
+                if not feed.subs:
+                    continue
+                try:
+                    gen, result = self.qs.snapshot_for(feed.kind, feed.params)
+                except ServingError:
+                    # admission refusal / engine error: leave the feed's
+                    # base untouched; the next tick (or a resync) heals
+                    self.counters.bump("streaming.feed_solve_errors")
+                    self._dirty = True
+                    continue
+                seq = gen[0]
+                if seq <= feed.last_seq:
+                    continue  # raced an older debounce; nothing newer
+                rows = self._result_rows(feed.kind, result)
+                entry = self._diff(
+                    feed, rows, gen, seq, window_full, window_prefixes, now
+                )
+                feed.last_seq = seq
+                feed.last_rows = rows
+                if entry is None:
+                    continue
+                self.counters.bump("streaming.deltas_minted")
+                for sid in list(feed.subs):
+                    sub = self._subs.get(sid)
+                    if sub is not None:
+                        self._enqueue(sub, entry)
+        finally:
+            self.tracer.end_span(span)
+        self._detach_stalled()
+
+    @staticmethod
+    def _result_rows(kind: str, result) -> Dict[tuple, Any]:
+        if kind == "whatif":
+            return {("scenario",): result}
+        rows: Dict[tuple, Any] = {}
+        for row in result.get("unicast_routes", []):
+            rows[("u", row["dest"])] = row
+        for row in result.get("mpls_routes", []):
+            rows[("m", row["top_label"])] = row
+        return rows
+
+    @staticmethod
+    def _diff(
+        feed: _Feed, rows, gen, seq, window_full, window_prefixes, now
+    ) -> Optional[_DeltaEntry]:
+        """The per-feed delta for this window, or None (no change).
+        Prefix-only windows compare only the changed prefixes' rows —
+        the publication-diff O(perturbation) discipline."""
+        updated: Dict[tuple, Any] = {}
+        removed: set = set()
+        old = feed.last_rows
+        if window_full or feed.kind == "whatif" or feed.last_seq < 0:
+            keys = set(old) | set(rows)
+        else:
+            keys = {
+                k
+                for k in set(old) | set(rows)
+                if k[0] != "u" or k[1] in window_prefixes
+            }
+        for k in keys:
+            new_row = rows.get(k)
+            if new_row is None:
+                if k in old:
+                    removed.add(k)
+            elif old.get(k) != new_row:
+                updated[k] = new_row
+        if not updated and not removed:
+            return None
+        return _DeltaEntry(seq, gen, updated, removed, now)
+
+    def _enqueue(self, sub: StreamSubscriber, entry: _DeltaEntry) -> None:
+        sub.queue.append(entry)
+        if len(sub.queue) > self.config.stream_queue_depth:
+            # shed the OLDEST entry and escalate: the remaining tail no
+            # longer reconstructs the subscriber's window, so its next
+            # drain must be a snapshot resync, never a gapped delta
+            sub.queue.popleft()
+            self.num_shed += 1
+            self.counters.bump("streaming.shed_deltas")
+            if not sub.needs_resync:
+                sub.needs_resync = True
+                sub.resync_reason = "queue_overflow"
+        if sub.waiter is not None and not sub.waiter.done():
+            sub.waiter.set_result(None)
+        elif sub.deliver is not None:
+            self._drain_push(sub)
+
+    # -- emission ----------------------------------------------------------
+
+    def _check_monotone(
+        self, sub: StreamSubscriber, seq: int, snapshot: bool
+    ) -> None:
+        """THE invariant: emissions never go backward.  A delta must
+        advance the cursor strictly; a snapshot may re-assert the
+        current generation (resync) but never an older one."""
+        ok = seq >= sub.cursor_seq if snapshot else seq > sub.cursor_seq
+        if not ok:
+            self.num_invariant_violations += 1
+            self.counters.bump("streaming.invariant_violations")
+            raise StreamingInvariantError(
+                f"emission seq {seq} vs cursor {sub.cursor_seq} "
+                f"(snapshot={snapshot}) on sub {sub.sub_id}"
+            )
+
+    def _emit_snapshot(self, sub: StreamSubscriber, reason: str) -> dict:
+        gen, result = self.qs.snapshot_for(sub.feed.kind, sub.feed.params)
+        seq = gen[0]
+        self._check_monotone(sub, seq, snapshot=True)
+        rows = self._result_rows(sub.feed.kind, result)
+        # the snapshot supersedes everything queued at or below its seq
+        # (and nothing above it can be queued: entries mint from the
+        # same monotone generation stream)
+        sub.queue.clear()
+        sub.needs_resync = False
+        sub.resync_reason = ""
+        sub.cursor_seq = seq
+        sub.num_snapshots += 1
+        # keep the shared feed base fresh so the next delta diffs from
+        # at least this generation
+        if seq > sub.feed.last_seq:
+            sub.feed.last_seq = seq
+            sub.feed.last_rows = rows
+        if reason.startswith("resync"):
+            sub.num_resyncs += 1
+            self.num_resyncs += 1
+            self.counters.bump("streaming.resyncs")
+        self.counters.bump("streaming.snapshots")
+        if sub.feed.kind == "whatif":
+            body: Dict[str, Any] = {"scenario": result}
+        else:
+            body = {
+                "route_db": {
+                    **result,
+                    "unicast_routes": [
+                        r
+                        for r in result.get("unicast_routes", [])
+                        if sub.wants(r["dest"])
+                    ],
+                }
+            }
+        return {
+            "type": "snapshot",
+            "kind": sub.feed.kind,
+            "seq": seq,
+            "generation": list(gen),
+            "reason": reason,
+            **body,
+        }
+
+    def _merge_queued(self, sub: StreamSubscriber):
+        """Fold the queued window into ONE merged delta: per-key
+        last-writer-wins in seq order, deletions preserved."""
+        updated: Dict[tuple, Any] = {}
+        removed: set = set()
+        first = sub.queue[0]
+        last = first
+        n = 0
+        while sub.queue:
+            entry = sub.queue.popleft()
+            last = entry
+            n += 1
+            for k, row in entry.updated.items():
+                updated[k] = row
+                removed.discard(k)
+            for k in entry.removed:
+                removed.add(k)
+                updated.pop(k, None)
+        return updated, removed, first, last, n
+
+    def _emit_delta(self, sub: StreamSubscriber) -> Optional[dict]:
+        updated, removed, first, last, n = self._merge_queued(sub)
+        self._check_monotone(sub, last.seq, snapshot=False)
+        from_seq = sub.cursor_seq
+        sub.cursor_seq = last.seq
+        if sub.feed.kind == "whatif":
+            scenario = updated.get(("scenario",))
+            if scenario is None:
+                return None
+            body: Dict[str, Any] = {"scenario": scenario}
+        else:
+            u_up = [
+                row
+                for k, row in sorted(updated.items())
+                if k[0] == "u" and sub.wants(k[1])
+            ]
+            u_rm = sorted(
+                k[1] for k in removed if k[0] == "u" and sub.wants(k[1])
+            )
+            m_up = [
+                row for k, row in sorted(updated.items()) if k[0] == "m"
+            ]
+            m_rm = sorted(k[1] for k in removed if k[0] == "m")
+            if not (u_up or u_rm or m_up or m_rm):
+                self.counters.bump("streaming.filtered_empty")
+                return None
+            body = {
+                "unicast_updated": u_up,
+                "unicast_removed": u_rm,
+                "mpls_updated": m_up,
+                "mpls_removed": m_rm,
+            }
+        staleness_ms = (self.clock.now() - first.t_mint) * 1000.0
+        self.counters.observe("streaming.staleness_ms", staleness_ms)
+        if n > 1:
+            self.counters.bump("streaming.coalesced_emissions")
+            self.counters.bump("streaming.merged_generations", n)
+        sub.num_deltas += 1
+        self.counters.bump("streaming.deltas")
+        return {
+            "type": "delta",
+            "kind": sub.feed.kind,
+            "from_seq": from_seq,
+            "seq": last.seq,
+            "generation": list(last.generation),
+            "merged_generations": n,
+            "staleness_ms": round(staleness_ms, 3),
+            **body,
+        }
+
+    def _next_emission_now(self, sub: StreamSubscriber) -> Optional[dict]:
+        """The synchronous drain step: snapshot (first contact or
+        resync), else the merged delta, else None (nothing pending)."""
+        sub.last_live_t = self.clock.now()
+        emission = None
+        if sub.cursor_seq < 0:
+            emission = self._emit_snapshot(sub, "subscribe")
+        elif sub.needs_resync:
+            emission = self._emit_snapshot(
+                sub, f"resync:{sub.resync_reason or 'requested'}"
+            )
+        elif sub.queue:
+            emission = self._emit_delta(sub)
+        if emission is not None:
+            self.num_emissions += 1
+            self.counters.bump("streaming.emissions")
+        return emission
+
+    async def next_emission(
+        self, sub_id: int, hold_s: Optional[float] = None
+    ) -> Optional[dict]:
+        """Long-poll: the next emission for `sub_id`, parking up to
+        ``hold_s`` (default ``stream_poll_hold_s``) when nothing is
+        pending; None on hold expiry (the long-poll heartbeat).  Each
+        poll charges one quota token — backpressure rides admission."""
+        sub = self._subs.get(sub_id)
+        if sub is None:
+            raise StreamingUnknownSubscriberError(f"unknown sub {sub_id}")
+        self.qs.check_quota(sub.client_id)
+        emission = self._next_emission_now(sub)
+        if emission is not None:
+            return emission
+        if sub.waiter is not None and not sub.waiter.done():
+            raise ServingError(f"sub {sub_id} already has a parked poll")
+        loop = asyncio.get_running_loop()
+        sub.waiter = loop.create_future()
+        hold = self.config.stream_poll_hold_s if hold_s is None else hold_s
+        timer = asyncio.ensure_future(self.clock.sleep(hold))
+        try:
+            await asyncio.wait(
+                {timer, sub.waiter}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if sub.waiter.done() and sub.waiter.exception() is not None:
+                raise sub.waiter.exception()
+        finally:
+            timer.cancel()
+            if not sub.waiter.done():
+                sub.waiter.cancel()
+            sub.waiter = None
+        if sub.detached:
+            raise StreamingUnknownSubscriberError(f"sub {sub_id} detached")
+        return self._next_emission_now(sub)
+
+    def _drain_push(self, sub: StreamSubscriber) -> None:
+        """Deliver everything pending through the push transport, one
+        emission per breaker-gated attempt.  A throwing transport trips
+        the breaker (deliveries short-circuit while it holds — entries
+        keep queueing and overflow escalates to resync) and the
+        delivered-but-lost emission is replaced by a resync, never
+        silently dropped."""
+        while not sub.detached and (
+            sub.queue or sub.needs_resync or sub.cursor_seq < 0
+        ):
+            if not sub.breaker.allow_request():
+                self.counters.bump("streaming.push_short_circuits")
+                return
+            emission = self._next_emission_now(sub)
+            if emission is None:
+                sub.breaker.release_probe()
+                return
+            try:
+                sub.deliver(emission)
+            except Exception:  # noqa: BLE001 - transport failures expected
+                sub.breaker.record_failure()
+                self.counters.bump("streaming.push_failures")
+                # the emission advanced the cursor but never arrived:
+                # the only generation-correct recovery is a resync once
+                # the transport heals
+                sub.needs_resync = True
+                sub.resync_reason = "transport_failure"
+                return
+            sub.breaker.record_success()
+
+    def pump(self) -> None:
+        """Re-attempt push delivery for every subscriber whose breaker
+        may have re-closed (tests and the bench call this after healing
+        a transport; production push surfaces poll it on their own
+        cadence)."""
+        for sub in list(self._subs.values()):
+            if sub.deliver is not None:
+                self._drain_push(sub)
+
+    # -- observability -----------------------------------------------------
+
+    def gauges(self) -> Dict[str, float]:
+        return {
+            "streaming.subscribers": float(len(self._subs)),
+            "streaming.feeds": float(len(self._feeds)),
+            "streaming.num_emissions": float(self.num_emissions),
+            "streaming.num_resyncs": float(self.num_resyncs),
+            "streaming.num_shed": float(self.num_shed),
+            "streaming.num_detached_stalled": float(
+                self.num_detached_stalled
+            ),
+            "streaming.num_invariant_violations": float(
+                self.num_invariant_violations
+            ),
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        """The ctrl `get_streaming_stats` payload."""
+        out: Dict[str, Any] = dict(self.counters.dump("streaming."))
+        out.update(self.gauges())
+        return {
+            "node": self.node_name,
+            "counters": out,
+            "histograms": self.counters.dump_histograms("streaming."),
+            "config": {
+                "stream_queue_depth": self.config.stream_queue_depth,
+                "stream_publish_min_ms": self.config.stream_publish_min_ms,
+                "stream_publish_max_ms": self.config.stream_publish_max_ms,
+                "stream_stall_detach_s": self.config.stream_stall_detach_s,
+                "stream_max_subscribers": (
+                    self.config.stream_max_subscribers
+                ),
+                "stream_poll_hold_s": self.config.stream_poll_hold_s,
+            },
+            "feeds": [
+                {
+                    "kind": f.kind,
+                    "params": {
+                        k: list(v) if isinstance(v, (list, tuple)) else v
+                        for k, v in f.params.items()
+                    },
+                    "subscribers": len(f.subs),
+                    "last_seq": f.last_seq,
+                }
+                for f in sorted(
+                    self._feeds.values(), key=lambda f: repr(f.key)
+                )
+            ],
+        }

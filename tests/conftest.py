@@ -44,6 +44,10 @@ def pytest_configure(config):
         "markers",
         "health: fleet-health-plane test (openr_tpu.health)",
     )
+    config.addinivalue_line(
+        "markers",
+        "streaming: watch-plane test (openr_tpu.serving.streaming)",
+    )
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

@@ -1,0 +1,350 @@
+"""Chaos × streaming: the watch plane under faults (ISSUE 13).
+
+Acceptance properties:
+
+* **partition/heal never streams a pre-partition generation**: every
+  emission a subscriber sees carries a monotone generation seq; after
+  the partition bumps the generation, no emission may re-assert a
+  pre-partition one — and the applied emission chain reproduces the
+  live route-db byte-identically at every checkpoint;
+* **mid-stream chip quarantine keeps deltas flowing**: a seeded
+  ``tpu_corrupt(device_index=…)`` quarantines exactly one chip of the
+  victim's pool while its subscribers keep receiving survivor-computed
+  deltas that match the scalar oracle;
+* **a clean seeded run fires ZERO alerts** with streaming load attached
+  (the health false-positive gate, extended to the watch plane);
+* **byte-identical seeded replays**: two runs of one seeded scenario
+  produce byte-identical emission logs (the chaos reproducibility
+  contract the counter dumps, alert JSONL and flight recorder already
+  honor).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.chaos import ChaosController, FaultPlan
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import ParallelConfig, ResilienceConfig
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import grid_edges, ring_edges
+from openr_tpu.serving import apply_emission
+from openr_tpu.types import PrefixEntry
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serving, pytest.mark.streaming]
+
+SEED = 7
+CONVERGE_S = 18.0
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        pending = asyncio.all_tasks(loop)
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        loop.close()
+
+
+def fast_stream_overrides(cfg):
+    """Tight publish window so chaos scenarios see emissions promptly."""
+    cfg.serving_config.stream_publish_min_ms = 5
+    cfg.serving_config.stream_publish_max_ms = 20
+
+
+class Collector:
+    """Push transport: records every emission and the running applied
+    state (the reference client reducer)."""
+
+    def __init__(self) -> None:
+        self.emissions = []
+        self.state = {}
+
+    def __call__(self, emission: dict) -> None:
+        self.emissions.append(emission)
+        self.state = apply_emission(self.state, emission)
+
+    def seqs(self):
+        return [e["seq"] for e in self.emissions]
+
+    def log_bytes(self) -> bytes:
+        return b"\n".join(
+            json.dumps(e, sort_keys=True, default=str).encode()
+            for e in self.emissions
+        )
+
+
+def live_rows(node, vantage: str):
+    _gen, res = node.serving.snapshot_for("route_db", {"node": vantage})
+    rows = {("u", r["dest"]): r for r in res["unicast_routes"]}
+    rows.update({("m", r["top_label"]): r for r in res["mpls_routes"]})
+    return rows
+
+
+def canon(rows) -> str:
+    return json.dumps(
+        {"|".join(map(str, k)): v for k, v in rows.items()},
+        sort_keys=True,
+        default=str,
+    )
+
+
+# ---------------------------------------------------------------------------
+# partition/heal: generation correctness end to end
+# ---------------------------------------------------------------------------
+
+
+async def _partition_heal_run():
+    clock = SimClock()
+    net = EmulatedNetwork(clock, config_overrides=fast_stream_overrides)
+    net.build(ring_edges(4))
+    net.start()
+    await clock.run_for(CONVERGE_S)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+
+    n0 = net.nodes["node0"]
+    watcher = Collector()
+    n0.streaming.subscribe(
+        "route_db", {"node": "node2"}, client_id="chaos", deliver=watcher
+    )
+    assert watcher.emissions[0]["type"] == "snapshot"
+    assert canon(watcher.state) == canon(live_rows(n0, "node2"))
+
+    # pre-partition churn: a couple of ordinary deltas
+    for i in range(2):
+        net.nodes["node2"].advertise_prefixes(
+            [PrefixEntry(f"10.80.{i}.0/24")]
+        )
+        await clock.run_for(2.0)
+    seq_pre = n0.decision.generation_key()[0]
+    n_pre = len(watcher.emissions)
+
+    # partition node0 away; hold timers expire -> its LSDB changes
+    net.partition(("node0",), ("node1", "node2", "node3"))
+    await clock.run_for(10.0)
+    assert n0.decision.generation_key()[0] > seq_pre
+    assert len(watcher.emissions) > n_pre, (
+        "the partition's own LSDB change must stream as a delta"
+    )
+    # THE property: nothing emitted after the partition carries a
+    # pre-partition generation
+    for e in watcher.emissions[n_pre:]:
+        assert e["seq"] > seq_pre, e
+    assert canon(watcher.state) == canon(live_rows(n0, "node2"))
+
+    net.heal_partition(("node0",), ("node1", "node2", "node3"))
+    await clock.run_for(25.0)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    await clock.run_for(2.0)
+
+    # monotone end to end, applied state byte-identical to live
+    seqs = watcher.seqs()
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert canon(watcher.state) == canon(live_rows(n0, "node2"))
+    stats = net.streaming_stats()
+    assert (
+        stats["node0"]["counters"].get(
+            "streaming.num_invariant_violations", 0
+        )
+        == 0
+    )
+    log = watcher.log_bytes()
+    await net.stop()
+    return log
+
+
+def test_partition_heal_never_streams_pre_partition_generation():
+    """Partition/heal generation correctness AND the determinism
+    acceptance: two seeded replays produce byte-identical emission
+    logs."""
+    log_a = run(_partition_heal_run())
+    log_b = run(_partition_heal_run())
+    assert log_a == log_b, "same scenario must replay byte-identically"
+
+
+# ---------------------------------------------------------------------------
+# mid-stream chip quarantine: deltas keep flowing from survivors
+# ---------------------------------------------------------------------------
+
+VICTIM = "node4"
+BAD_CHIP = 3
+
+
+def tpu_overrides(cfg):
+    fast_stream_overrides(cfg)
+    cfg.tpu_compute_config.min_device_prefixes = 0  # always device
+    cfg.parallel_config = ParallelConfig(min_shard_rows=0)
+    cfg.resilience_config = ResilienceConfig(
+        shadow_sample_every=2,
+        failure_threshold=2,
+        probe_backoff_initial_s=0.5,
+        probe_backoff_max_s=4.0,
+        jitter_pct=0.1,
+        seed=SEED,
+    )
+
+
+@pytest.mark.multichip
+def test_chip_quarantine_mid_stream_keeps_survivor_deltas_flowing():
+    async def scenario():
+        clock = SimClock()
+        net = EmulatedNetwork(
+            clock, use_tpu_backend=True, config_overrides=tpu_overrides
+        )
+        net.build(grid_edges(3))
+        net.start()
+        await clock.run_for(CONVERGE_S)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+        # widen the candidate table so every chip's shard holds rows
+        net.nodes["node0"].advertise_prefixes(
+            [PrefixEntry(f"10.99.{i}.0/24") for i in range(9)]
+        )
+        await clock.run_for(3.0)
+
+        victim = net.nodes[VICTIM]
+        watcher = Collector()
+        victim.streaming.subscribe(
+            "route_db", {"node": "node0"}, client_id="chaos",
+            deliver=watcher,
+        )
+        assert watcher.emissions[0]["type"] == "snapshot"
+
+        plan = FaultPlan().tpu_corrupt(
+            VICTIM, at=2.0, duration=60.0, device_index=BAD_CHIP
+        )
+        controller = ChaosController(net, plan, seed=SEED)
+        controller.start()
+        await clock.run_for(3.0)  # corruption live on chip 3
+
+        # LSDB churn drives shadow-checked rebuilds until the chip is
+        # caught, AND streams deltas to the watcher throughout
+        gov = victim.decision.backend.governor
+        for a, b in [("node0", "node1"), ("node1", "node2")]:
+            net.fail_link(a, b)
+            await clock.run_for(2.5)
+            if gov.num_shadow_mismatches:
+                break
+        assert gov.num_chip_quarantines >= 1, "chip 3 must quarantine"
+        n_at_quarantine = len(watcher.emissions)
+        # the victim's pool keeps serving on 7 survivors: the DEVICE
+        # path stays up for its watchers
+        assert victim.decision.device_available()
+
+        # mid-stream deltas AFTER the quarantine, computed by survivors
+        # (advertised AWAY from the watched vantage, so node0's computed
+        # routes actually gain the prefixes)
+        for i in range(3):
+            net.nodes["node8"].advertise_prefixes(
+                [PrefixEntry(f"10.81.{i}.0/24")]
+            )
+            await clock.run_for(2.0)
+        assert len(watcher.emissions) > n_at_quarantine, (
+            "deltas must keep flowing from the surviving chips"
+        )
+
+        # the applied stream matches the SCALAR oracle (the corrupted
+        # chip's lies never reached a subscriber)
+        from openr_tpu.decision.spf_solver import SpfSolver
+
+        oracle = (
+            SpfSolver("node0")
+            .build_route_db(
+                victim.decision.area_link_states,
+                victim.decision.prefix_state,
+            )
+            .to_route_database("node0")
+            .to_wire()
+        )
+        want = {("u", r["dest"]): r for r in oracle["unicast_routes"]}
+        want.update(
+            {("m", r["top_label"]): r for r in oracle["mpls_routes"]}
+        )
+        got = {
+            k: v for k, v in watcher.state.items() if k[0] in ("u", "m")
+        }
+        assert canon(got) == canon(want)
+
+        seqs = watcher.seqs()
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert victim.streaming.num_invariant_violations == 0
+        await controller.stop()
+        await net.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# false-positive gate: clean seeded run with streaming load -> ZERO alerts
+# ---------------------------------------------------------------------------
+
+
+def test_clean_run_with_streaming_load_fires_zero_alerts():
+    def overrides(cfg):
+        fast_stream_overrides(cfg)
+        cfg.health_config.sweep_interval_s = 2.0
+        cfg.health_config.skew_min_generations = 2
+        cfg.health_config.skew_hold_s = 4.0
+        cfg.watchdog_config.interval_s = 1.0
+
+    async def scenario():
+        clock = SimClock()
+        net = EmulatedNetwork(clock, config_overrides=overrides)
+        net.build(grid_edges(3))
+        net.start()
+        await clock.run_for(CONVERGE_S)
+        ok, why = net.converged_full_mesh()
+        assert ok, why
+
+        n0 = net.nodes["node0"]
+        watchers = []
+        for i in range(8):
+            w = Collector()
+            n0.streaming.subscribe(
+                "route_db",
+                {"node": f"node{i % 4}"},
+                client_id=f"w{i}",
+                deliver=w,
+            )
+            watchers.append(w)
+        # ordinary life: prefix churn, a link flap, subscriber churn
+        for i in range(3):
+            net.nodes["node0"].advertise_prefixes(
+                [PrefixEntry(f"10.90.{i}.0/24")]
+            )
+            await clock.run_for(4.0)
+        churn = n0.streaming.subscribe(
+            "route_db", {"node": "node1"}, client_id="churn"
+        )
+        n0.streaming.unsubscribe(churn)
+        net.fail_link("node0", "node1")
+        await clock.run_for(4.0)
+        net.restore_link("node0", "node1")
+        await clock.run_for(20.0)
+
+        for name, node in net.nodes.items():
+            assert node.health.alert_log() == [], (
+                f"{name} logged alerts on a clean streaming run"
+            )
+        assert all(len(w.emissions) >= 2 for w in watchers), (
+            "every watcher saw its snapshot plus churn deltas"
+        )
+        for w in watchers:
+            seqs = w.seqs()
+            assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        stats = n0.streaming.stats()
+        assert stats["counters"].get(
+            "streaming.num_invariant_violations", 0
+        ) == 0
+        await net.stop()
+
+    run(scenario())

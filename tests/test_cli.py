@@ -256,6 +256,25 @@ def test_cli_serving_stats_and_queries(live_node):
     assert "serving on node0" in table and "max_batch=64" in table
 
 
+def test_cli_serving_watch_snapshot_and_stream_stats(live_node):
+    """breeze serving watch NODE --deltas 0: one generation-stamped
+    snapshot through the ctrl server-stream, then exit; stream-stats
+    reflects the (now departed) subscriber."""
+    out = _run(live_node, "serving", "watch", "node1", "--deltas", "0")
+    snap = json.loads(out)
+    assert snap["type"] == "snapshot" and snap["kind"] == "route_db"
+    assert snap["reason"] == "subscribe"
+    assert isinstance(snap["seq"], int) and snap["generation"]
+    assert snap["route_db"]["this_node_name"] == "node1"
+    assert snap["route_db"]["unicast_routes"]
+    stats = json.loads(_run(live_node, "serving", "stream-stats"))
+    assert stats["node"] == "node0"
+    assert stats["counters"]["streaming.snapshots"] >= 1
+    assert stats["counters"].get("streaming.num_invariant_violations", 0) == 0
+    # the watch unsubscribed on exit: no subscriber retained
+    assert stats["counters"]["streaming.subscribers"] == 0
+
+
 def test_cli_health_status_alerts_slo(live_node):
     """breeze health status/alerts/slo against a live node: the fleet
     rollup (both emulated nodes), the SLO catalog, and an empty alert

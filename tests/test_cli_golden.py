@@ -47,6 +47,8 @@ VOLATILE_KEYS = {
     "metric",  # rtt-derived under the wall clock (use_rtt_metric)
     "igp_cost",
     "value",  # serialized adj/prefix blobs embed timestamps + rtt
+    "generation",  # streaming emission stamps: change-seq dependent
+    "seq",
 }
 
 
@@ -269,6 +271,15 @@ def test_golden_received_routes_filtered(live_node):
         "received-routes-filtered",
         "--originator",
         "node1",
+    )
+
+
+def test_golden_serving_watch(live_node):
+    """`breeze serving watch NODE --deltas 0`: the generation-stamped
+    snapshot emission (ISSUE 13 — the watch plane's CLI surface)."""
+    check_golden(
+        "serving_watch", live_node, "serving", "watch", "node1",
+        "--deltas", "0",
     )
 
 

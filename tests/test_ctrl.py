@@ -246,6 +246,50 @@ def test_handler_fib_stream():
     run(main())
 
 
+def test_handler_serving_route_db_stream():
+    """subscribe_and_get_serving_route_db: generation-stamped snapshot
+    first, then a coalesced delta per generation bump; cancelling the
+    stream unsubscribes (no subscriber leak)."""
+
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 3)
+        node = net.nodes["node0"]
+        h = OpenrCtrlHandler(node)
+        items = []
+
+        async def consume():
+            async for item in h.subscribe_and_get_serving_route_db(
+                "node2", client_id="ctrl-test"
+            ):
+                items.append(item)
+
+        task = asyncio.get_running_loop().create_task(consume())
+        await clock.run_for(1)
+        assert len(items) == 1
+        assert items[0]["type"] == "snapshot"
+        assert items[0]["route_db"]["this_node_name"] == "node2"
+        seq0 = items[0]["seq"]
+        # an LSDB change streams a delta carrying a LATER generation
+        net.nodes["node1"].advertise_prefixes(
+            [__import__("openr_tpu.types", fromlist=["PrefixEntry"])
+             .PrefixEntry("55.6.0.0/16")]
+        )
+        await clock.run_for(3)
+        assert len(items) >= 2
+        delta = items[-1]
+        assert delta["type"] == "delta" and delta["seq"] > seq0
+        assert "55.6.0.0/16" in [
+            r["dest"] for r in delta["unicast_updated"]
+        ]
+        task.cancel()
+        await clock.run_for(0.1)
+        assert len(node.streaming._subs) == 0, "cancel must unsubscribe"
+        await net.stop()
+
+    run(main())
+
+
 def test_handler_long_poll_adj():
     async def main():
         clock = SimClock()

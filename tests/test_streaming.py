@@ -1,0 +1,507 @@
+"""StreamingService — the watch plane's generation-correctness contract.
+
+Covers (ISSUE 13):
+* snapshot-then-delta: one cached generation-stamped snapshot, then
+  per-generation deltas whose application reproduces the live route-db
+  byte-identically;
+* generation-correct coalescing: a stalled subscriber skipping >= 3
+  generations receives exactly ONE merged delta (per-prefix last-writer-
+  wins, deletions preserved) that still reproduces the live db;
+* shed_oldest-to-resync escalation at the bounded queue, monotone-
+  generation invariant enforcement at emission;
+* breaker-protected push transports, stall detach, prefix filters,
+  long-poll heartbeat;
+* satellite fixes: generation-listener ordering (cache purge before
+  snapshot-minting listeners), ResultCache's O(purged) generation
+  index, config-tunable quota-table bound + eager disconnect prune.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import ServingConfig
+from openr_tpu.decision.backend import ScalarBackend
+from openr_tpu.serving import (
+    QueryService,
+    ResultCache,
+    ServingQuotaError,
+    StreamingInvariantError,
+    StreamingService,
+    StreamingUnknownSubscriberError,
+    apply_emission,
+)
+from openr_tpu.types import PrefixEntry
+
+from tests.test_serving import build_decision, make_serving, run
+
+pytestmark = [pytest.mark.serving, pytest.mark.streaming]
+
+
+def make_streaming(clock, d, sv, **overrides):
+    return StreamingService(
+        "node0", clock, sv.config, d, sv, counters=d.counters
+    )
+
+
+def world(clock, **serving_overrides):
+    d, edges = build_decision(clock, backend_cls=ScalarBackend)
+    sv = make_serving(clock, d, **serving_overrides)
+    st = make_streaming(clock, d, sv)
+    sv.start()
+    st.start()
+    return d, sv, st
+
+
+def bump_prefix(d, prefix, node="node5", withdraw=False):
+    """One prefix-only generation bump (the LSDB-churn delta class)."""
+    if withdraw:
+        changed = d.prefix_state.delete_prefix(node, "0", prefix)
+        d._pending_prefix_changes |= changed or {prefix}
+    else:
+        d.prefix_state.update_prefix(node, "0", PrefixEntry(prefix))
+        d._pending_prefix_changes.add(prefix)
+    d._bump_generation()
+
+
+def live_rows(sv, vantage="node3"):
+    _gen, res = sv.snapshot_for("route_db", {"node": vantage})
+    rows = {("u", r["dest"]): r for r in res["unicast_routes"]}
+    rows.update({("m", r["top_label"]): r for r in res["mpls_routes"]})
+    return rows
+
+
+async def poll(clock, st, sub, duration=1.0, hold=None):
+    """One long-poll round; pass a short `hold` when a None heartbeat
+    is the expected outcome (the default hold outlives the test)."""
+    t = asyncio.ensure_future(st.next_emission(sub, hold_s=hold))
+    await clock.run_for(duration)
+    return t.result()
+
+
+def canon(rows):
+    """Byte-comparable form of a client row map (tuple keys joined)."""
+    return json.dumps(
+        {"|".join(map(str, k)): v for k, v in rows.items()},
+        sort_keys=True,
+        default=str,
+    )
+
+
+# ---------------------------------------------------------------------------
+# snapshot + per-generation deltas
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_then_deltas_reproduce_live_db():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock)
+        sub = st.subscribe("route_db", {"node": "node3"}, client_id="c1")
+        snap = await poll(clock, st, sub)
+        assert snap["type"] == "snapshot" and snap["reason"] == "subscribe"
+        assert snap["seq"] == d.generation_key()[0]
+        assert snap["generation"] == list(d.generation_key())
+        state = apply_emission({}, snap)
+        assert canon(state) == canon(live_rows(sv))
+        # a second subscriber's snapshot is a cache HIT (one solve per
+        # generation no matter how many watchers)
+        misses_before = d.counters.get("serving.cache.misses")
+        sub2 = st.subscribe("route_db", {"node": "node3"}, client_id="c2")
+        snap2 = await poll(clock, st, sub2)
+        assert snap2["route_db"] == snap["route_db"]
+        assert d.counters.get("serving.cache.misses") == misses_before
+        # three generations, polled promptly: three distinct deltas
+        for i in range(3):
+            bump_prefix(d, f"10.200.{i}.0/24")
+            delta = await poll(clock, st, sub)
+            assert delta["type"] == "delta"
+            assert delta["merged_generations"] == 1
+            assert delta["unicast_updated"][0]["dest"] == f"10.200.{i}.0/24"
+            state = apply_emission(state, delta)
+        assert canon(state) == canon(live_rows(sv))
+        assert st.num_invariant_violations == 0
+
+    run(main())
+
+
+def test_stalled_subscriber_gets_exactly_one_merged_delta():
+    """THE acceptance bar: a subscriber skipping >= 3 generations gets
+    ONE merged delta — last-writer-wins per prefix, deletions preserved
+    both ways — whose application reproduces the live db."""
+
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock)
+        sub = st.subscribe("route_db", {"node": "node3"}, client_id="c1")
+        state = apply_emission({}, await poll(clock, st, sub))
+
+        # 5 generations while the subscriber stalls:
+        #   A: added then REMOVED       -> must arrive as a deletion
+        #   B: removed then RE-ADDED    -> must arrive as an update
+        #   C: plain add                -> update
+        bump_prefix(d, "10.201.0.0/24")  # A add
+        await clock.run_for(0.5)
+        bump_prefix(d, "10.202.0.0/24")  # B add
+        await clock.run_for(0.5)
+        bump_prefix(d, "10.202.0.0/24", withdraw=True)  # B remove
+        await clock.run_for(0.5)
+        bump_prefix(d, "10.202.0.0/24")  # B re-add
+        await clock.run_for(0.5)
+        bump_prefix(d, "10.201.0.0/24", withdraw=True)  # A remove
+        await clock.run_for(0.5)
+        bump_prefix(d, "10.203.0.0/24")  # C add
+        await clock.run_for(0.5)
+
+        cursor_before = st._subs[sub].cursor_seq
+        assert st._subs[sub].queue, "deltas queued while stalled"
+        delta = await poll(clock, st, sub)
+        assert delta["type"] == "delta"
+        assert delta["merged_generations"] >= 3
+        assert delta["from_seq"] == cursor_before
+        assert delta["seq"] > delta["from_seq"]
+        assert "10.201.0.0/24" in delta["unicast_removed"]
+        updated = {r["dest"] for r in delta["unicast_updated"]}
+        assert {"10.202.0.0/24", "10.203.0.0/24"} <= updated
+        assert "10.201.0.0/24" not in updated
+        state = apply_emission(state, delta)
+        assert canon(state) == canon(live_rows(sv))
+        # exactly ONE emission covered the window: nothing else queued
+        assert not st._subs[sub].queue
+        assert await poll(clock, st, sub, 0.5, hold=0.2) is None  # heartbeat
+        assert st.num_invariant_violations == 0
+
+    run(main())
+
+
+def test_queue_overflow_sheds_oldest_and_escalates_to_resync():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock)
+        sv.config.stream_queue_depth = 2  # shared config object
+        sub = st.subscribe("route_db", {"node": "node3"}, client_id="c1")
+        state = apply_emission({}, await poll(clock, st, sub))
+        for i in range(5):
+            bump_prefix(d, f"10.204.{i}.0/24")
+            await clock.run_for(0.5)
+        assert st.num_shed >= 1
+        assert d.counters.get("streaming.shed_deltas") >= 1
+        emission = await poll(clock, st, sub)
+        assert emission["type"] == "snapshot"
+        assert emission["reason"] == "resync:queue_overflow"
+        state = apply_emission(state, emission)
+        assert canon(state) == canon(live_rows(sv))
+        assert st.num_resyncs == 1
+        # after the resync the subscriber is back on the delta path
+        bump_prefix(d, "10.205.0.0/24")
+        nxt = await poll(clock, st, sub)
+        assert nxt["type"] == "delta"
+        assert st.num_invariant_violations == 0
+
+    run(main())
+
+
+def test_monotone_generation_invariant_enforced_at_emission():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock)
+        sub = st.subscribe("route_db", {"node": "node3"}, client_id="c1")
+        await poll(clock, st, sub)
+        bump_prefix(d, "10.206.0.0/24")
+        await clock.run_for(0.5)
+        # sabotage: pretend the subscriber already saw a FUTURE
+        # generation — the emission must refuse, not deliver stale
+        st._subs[sub].cursor_seq = d.generation_key()[0] + 100
+        with pytest.raises(StreamingInvariantError):
+            st._next_emission_now(st._subs[sub])
+        assert st.num_invariant_violations == 1
+        assert d.counters.get("streaming.invariant_violations") == 1
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# satellite: generation-listener ordering (purge before publish)
+# ---------------------------------------------------------------------------
+
+
+def test_generation_listeners_fire_in_stable_priority_order():
+    clock = SimClock()
+    d, _edges = build_decision(clock, backend_cls=ScalarBackend)
+    order = []
+    d.add_generation_listener(lambda s: order.append("late"), priority=10)
+    d.add_generation_listener(lambda s: order.append("purge_a"))
+    d.add_generation_listener(lambda s: order.append("purge_b"))
+    d._bump_generation()
+    # priority wins; equal priorities keep REGISTRATION order (stable)
+    assert order == ["purge_a", "purge_b", "late"]
+
+
+def test_query_service_purge_registers_before_streaming_publish():
+    """The wiring contract: QueryService's cache purge (priority 0)
+    always precedes StreamingService's publish scheduler (priority 10)
+    regardless of construction order quirks — a snapshot minted from
+    the fresh generation can never be raced by the purge."""
+    clock = SimClock()
+    d, _edges = build_decision(clock, backend_cls=ScalarBackend)
+    sv = make_serving(clock, d)
+    st = make_streaming(clock, d, sv)
+    owners = [
+        type(fn.__self__).__name__
+        for _prio, _order, fn in d._generation_listeners
+        if hasattr(fn, "__self__")
+    ]
+    assert owners.index("QueryService") < owners.index("StreamingService")
+    # and functionally: on a bump, the purge runs before the streaming
+    # listener observes the bump (the cache holds no superseded entry
+    # by the time the publish window is scheduled)
+    sv.cache.put(("old",), ("q",), {"stale": True})
+    seen = []
+    d.add_generation_listener(
+        lambda s: seen.append(len(sv.cache)), priority=10
+    )
+    bump_prefix(d, "10.207.0.0/24")
+    assert seen == [0], "purge must precede later-priority listeners"
+    assert st._dirty
+
+
+# ---------------------------------------------------------------------------
+# satellite: ResultCache generation index
+# ---------------------------------------------------------------------------
+
+
+def test_cache_invalidation_retains_live_generation_entries():
+    c = ResultCache(max_entries=16)
+    for i in range(4):
+        c.put(("gen_a",), ("q", i), i)
+    for i in range(3):
+        c.put(("gen_b",), ("q", i), 100 + i)
+    c.invalidate_generation(("gen_b",))
+    assert c.invalidations == 4
+    assert len(c) == 3
+    for i in range(3):
+        hit, got = c.get(("gen_b",), ("q", i))
+        assert hit and got == 100 + i
+    hit, _ = c.get(("gen_a",), ("q", 0))
+    assert not hit
+    # the index follows LRU evictions: no stale index entry may dangle
+    small = ResultCache(max_entries=2)
+    small.put(("g1",), ("a",), 1)
+    small.put(("g1",), ("b",), 2)
+    small.put(("g2",), ("c",), 3)  # evicts ("g1", "a")
+    assert small.evictions == 1
+    small.invalidate_generation(("g2",))  # must not KeyError on ("g1","a")
+    assert small.invalidations == 1 and len(small) == 1
+    # full purge (None) clears the index too
+    small.invalidate_generation(None)
+    assert len(small) == 0
+    small.put(("g3",), ("d",), 4)
+    assert len(small) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: quota-table bound is config-tunable + eager disconnect prune
+# ---------------------------------------------------------------------------
+
+
+def test_quota_bucket_pruned_eagerly_on_unsubscribe():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock, quota_tokens=5, quota_refill_per_s=1.0)
+        sub = st.subscribe("route_db", {"node": "node3"}, client_id="gone")
+        assert "gone" in sv._quotas
+        await clock.run_for(10.0)  # bucket fully refills
+        st.unsubscribe(sub)
+        assert "gone" not in sv._quotas, "refilled bucket must prune"
+        # a part-spent bucket survives disconnect (dropping it would
+        # refund the spend to a reconnecting client)
+        sub2 = st.subscribe("route_db", {"node": "node3"}, client_id="busy")
+        st.unsubscribe(sub2)
+        assert "busy" in sv._quotas
+
+    run(main())
+
+
+def test_quota_client_table_bound_is_config_tunable():
+    async def main():
+        clock = SimClock()
+        d, edges = build_decision(clock, backend_cls=ScalarBackend)
+        sv = make_serving(
+            clock, d, quota_tokens=100, max_quota_clients=3
+        )
+        assert sv.config.max_quota_clients == 3
+        for i in range(4):
+            sv.check_quota(f"client{i}")
+        assert len(sv._quotas) == 4
+        await clock.run_for(5.0)  # everyone refills
+        # the NEXT admission crosses the (tunable) threshold and prunes
+        # every refilled bucket except the caller's
+        sv.check_quota("client_new")
+        assert set(sv._quotas) == {"client_new"}
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# prefix filters, long-poll heartbeat, stall detach, push breaker
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_filters_scope_snapshot_and_deltas():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock)
+        sub = st.subscribe(
+            "route_db",
+            {"node": "node3"},
+            client_id="c1",
+            prefix_filters=("10.210.",),
+        )
+        snap = await poll(clock, st, sub)
+        assert snap["route_db"]["unicast_routes"] == []
+        bump_prefix(d, "10.210.7.0/24")
+        delta = await poll(clock, st, sub)
+        assert [r["dest"] for r in delta["unicast_updated"]] == [
+            "10.210.7.0/24"
+        ]
+        # a non-matching change produces NO emission (heartbeat instead)
+        bump_prefix(d, "10.211.0.0/24")
+        assert await poll(clock, st, sub, 1.0, hold=0.5) is None
+        assert d.counters.get("streaming.filtered_empty") >= 1
+
+    run(main())
+
+
+def test_long_poll_parks_and_wakes_on_bump():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock)
+        sub = st.subscribe("route_db", {"node": "node3"}, client_id="c1")
+        await poll(clock, st, sub)
+        # park with nothing pending; a bump mid-hold wakes the poll
+        t = asyncio.ensure_future(st.next_emission(sub, hold_s=30.0))
+        await clock.run_for(2.0)
+        assert not t.done()
+        bump_prefix(d, "10.212.0.0/24")
+        await clock.run_for(1.0)
+        assert t.done() and t.result()["type"] == "delta"
+        # and an idle hold expires to the None heartbeat
+        t2 = asyncio.ensure_future(st.next_emission(sub, hold_s=3.0))
+        await clock.run_for(4.0)
+        assert t2.result() is None
+
+    run(main())
+
+
+def test_stalled_subscriber_detaches_after_window():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock, quota_tokens=50)
+        sv.config.stream_stall_detach_s = 5.0
+        sub = st.subscribe("route_db", {"node": "node3"}, client_id="c1")
+        await poll(clock, st, sub)
+        await clock.run_for(20.0)  # never polls again
+        assert st.num_detached_stalled == 1
+        assert sub not in st._subs
+        assert "c1" not in sv._quotas, "detach prunes the quota bucket"
+        with pytest.raises(StreamingUnknownSubscriberError):
+            await st.next_emission(sub)
+        # a parked long-poll counts as LIVE: it must not detach
+        sub2 = st.subscribe("route_db", {"node": "node3"}, client_id="c2")
+        await poll(clock, st, sub2)
+        t = asyncio.ensure_future(st.next_emission(sub2, hold_s=60.0))
+        await clock.run_for(20.0)
+        assert sub2 in st._subs
+        t.cancel()
+
+    run(main())
+
+
+def test_push_transport_breaker_trips_and_resyncs_on_heal():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock)
+        delivered = []
+        healthy = [True]
+
+        def deliver(emission):
+            if not healthy[0]:
+                raise ConnectionError("transport down")
+            delivered.append(emission)
+
+        sub = st.subscribe(
+            "route_db", {"node": "node3"}, client_id="c1", deliver=deliver
+        )
+        assert delivered and delivered[0]["type"] == "snapshot"
+        state = apply_emission({}, delivered[0])
+        bump_prefix(d, "10.213.0.0/24")
+        await clock.run_for(0.5)
+        assert delivered[-1]["type"] == "delta"
+        state = apply_emission(state, delivered[-1])
+
+        # transport starts throwing: breaker trips, deliveries stop
+        healthy[0] = False
+        n_before = len(delivered)
+        for i in range(4):
+            bump_prefix(d, f"10.214.{i}.0/24")
+            await clock.run_for(0.5)
+        assert len(delivered) == n_before
+        assert d.counters.get("streaming.push_failures") >= 1
+        breaker = st._subs[sub].breaker
+        assert breaker.state != "closed"
+
+        # heal; wait out the jittered hold, then pump the probe through
+        healthy[0] = True
+        await clock.run_for(40.0)
+        st.pump()
+        # the lost window arrives as a RESYNC snapshot, never a gap
+        assert delivered[-1]["type"] == "snapshot"
+        assert delivered[-1]["reason"].startswith("resync:")
+        state = apply_emission(state, delivered[-1])
+        assert canon(state) == canon(live_rows(sv))
+        assert breaker.state == "closed"
+        assert st.num_invariant_violations == 0
+
+    run(main())
+
+
+def test_subscriber_bound_and_quota_admission():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock, quota_tokens=2, quota_refill_per_s=0.1)
+        sv.config.stream_max_subscribers = 2
+        st.subscribe("route_db", {"node": "node1"}, client_id="a")
+        st.subscribe("route_db", {"node": "node2"}, client_id="b")
+        from openr_tpu.serving import ServingRejectedError
+
+        with pytest.raises(ServingRejectedError):
+            st.subscribe("route_db", {"node": "node3"}, client_id="c")
+        assert d.counters.get("streaming.rejected_subscribers") == 1
+        # polls charge the SAME bucket the query plane uses
+        sv.config.stream_max_subscribers = 10
+        s = st.subscribe("route_db", {"node": "node3"}, client_id="q")
+        await poll(clock, st, s)  # token 2 of 2 (subscribe took one)
+        with pytest.raises(ServingQuotaError):
+            await st.next_emission(s)
+
+    run(main())
+
+
+def test_whatif_feed_snapshots_and_is_quiet_without_changes():
+    async def main():
+        clock = SimClock()
+        d, sv, st = world(clock)
+        pairs = [["node0", "node1"]]
+        sub = st.subscribe(
+            "whatif", {"link_failures": pairs}, client_id="c1"
+        )
+        snap = await poll(clock, st, sub)
+        assert snap["type"] == "snapshot" and "scenario" in snap
+        # a prefix bump that doesn't change the scenario answer is
+        # filtered at the diff: heartbeat, not a spurious delta
+        d._bump_generation()
+        assert await poll(clock, st, sub, 1.0, hold=0.5) is None
+
+    run(main())
