@@ -521,6 +521,7 @@ def validate_pipeline_bench(doc: dict) -> None:
         PAD_PACK,
         PHASES,
         STREAM_DRAIN,
+        SWEEP_PHASES,
         WARM_PHASES,
     )
 
@@ -544,9 +545,15 @@ def validate_pipeline_bench(doc: dict) -> None:
         # a full rebuild exercises the whole lifecycle: every phase
         # must have recorded real time (delta_extract rides the diff).
         # warm_plan/warm_repair fire only on warm-start rebuilds
-        # (BENCH_WARMSTART) and device_select only on delta builds —
+        # (BENCH_WARMSTART), device_select only on delta builds, and
+        # the sweep phases only in the capacity-sweep orchestrator —
         # never on the cold lifecycle these rounds measure.
-        required = set(PHASES) - set(WARM_PHASES) - set(DELTA_PHASES)
+        required = (
+            set(PHASES)
+            - set(WARM_PHASES)
+            - set(DELTA_PHASES)
+            - set(SWEEP_PHASES)
+        )
         if not streamed:
             required.discard(STREAM_DRAIN)
             if r["devices"] == 1:
@@ -3161,6 +3168,20 @@ def validate_streaming_bench(doc: dict) -> None:
     fan = d["fanout"]
     assert fan["emissions"] > 0 and fan["wall_s"] > 0
     assert doc["value"] == fan["emissions_per_sec"] > 0
+    # the emissions/s regression guard (ISSUE-14 satellite): the
+    # shared-wire-encode fan-out loop must never regress to an
+    # order-of-magnitude-slower per-subscriber rebuild path.  An
+    # absolute floor (r01 measured ~69k/s on this class of host; the
+    # benchtrack ratchet holds the fine-grained line)
+    assert fan["emissions_per_sec"] >= 5_000, (
+        "fan-out throughput collapsed an order of magnitude"
+    )
+    if "shared_encode" in fan:
+        # emitted from the shared-wire-encode era on: the delta body
+        # must be rendered once per feed entry, shared across the
+        # subscriber fan-out
+        se = fan["shared_encode"]
+        assert se["shared_payloads"] > se["rendered_payloads"] > 0
     assert fan["deltas"] > 0 and fan["snapshots"] > 0
     st = d["staleness_ms"]
     assert st["samples"] > 0
@@ -3473,6 +3494,17 @@ def streaming_fanout_world(n_subs: int, seed: int, ticks: int):
                 "coalesced": int(
                     c.get("streaming.coalesced_emissions")
                 ),
+                # shared-wire-encode evidence (ISSUE-14 satellite):
+                # delta bodies rendered once per feed entry, shared by
+                # reference across the unfiltered subscriber fan-out
+                "shared_encode": {
+                    "rendered_payloads": int(
+                        c.get("streaming.rendered_payloads")
+                    ),
+                    "shared_payloads": int(
+                        c.get("streaming.shared_payloads")
+                    ),
+                },
             },
             "staleness_ms": {
                 "p50": round(pct.get("p50", 0.0), 3),
@@ -3592,6 +3624,374 @@ def streaming_main(seed: Optional[int] = None) -> None:
     }
     validate_streaming_bench(doc)
     print(json.dumps(doc))
+
+
+SWEEP_SEED = 7
+SWEEP_GRID_SIDE = 64  # 4096 nodes, 8064 links: the grid4096 class
+SWEEP_SHARD = 1024
+SWEEP_COMBOS_PER_WORLD = 512
+SWEEP_RESUME_KILL_AFTER = 3
+
+
+def validate_sweep_bench(doc: dict) -> None:
+    """Schema contract for BENCH_SWEEP_r*.json — shared by the bench
+    emitter, the tier-1 artifact gate and the benchtrack manifest.
+
+    The ISSUE-14 acceptance: 100k+ scenarios on a grid4096-class
+    topology end to end in ONE round, per-phase pipeline attribution
+    proving the sweep is DEVICE-bound (not decode- or spill-bound),
+    spill-file row count + peak host-resident rows recorded
+    in-artifact, and a kill-after-shard-K resume reproducing the
+    uninterrupted ranked summary byte for byte."""
+    from openr_tpu.tracing.pipeline import (
+        DECODE,
+        DEVICE_PHASES,
+        HOST_PHASES,
+        STREAM_DRAIN,
+        SWEEP_REDUCE,
+        SWEEP_SHARD_SOLVE,
+    )
+
+    assert doc["metric"] == "sweep_scenarios_per_sec_grid4096"
+    assert doc["unit"] == "scenarios/s"
+    d = doc["detail"]
+    assert d["world"]["nodes"] == SWEEP_GRID_SIDE * SWEEP_GRID_SIDE
+    sc = d["scenarios"]
+    assert sc["total"] >= 100_000, "the acceptance floor is 100k+"
+    assert sc["singles"] > 0 and sc["worlds"] >= 2
+    assert sc["device_solves"] > 0
+    sh = d["shards"]
+    assert sh["completed"] == sh["total"] >= 2
+    assert sh["scenarios_per_shard"] >= 1
+    th = d["throughput"]
+    assert doc["value"] == th["scenarios_per_sec"] > 0
+    assert th["wall_s"] > 0
+    sp = d["spill"]
+    assert sp["rows"] == sc["total"], "every scenario spills exactly once"
+    assert sp["segments_sealed"] >= 1 and sp["bytes"] > 0
+    # the never-host-resident claim: peak rows in host memory bounded
+    # by ONE shard, never the sweep
+    assert 0 < sp["peak_host_rows"] <= sh["scenarios_per_shard"]
+    att = d["attribution"]
+    phases = att["phases_ms"]
+    assert phases.get(SWEEP_SHARD_SOLVE, 0.0) > 0.0
+    assert phases.get(STREAM_DRAIN, 0.0) > 0.0
+    assert phases.get(SWEEP_REDUCE, 0.0) > 0.0
+    assert phases.get(DECODE, 0.0) > 0.0
+    host = sum(phases.get(p, 0.0) for p in HOST_PHASES)
+    device = sum(phases.get(p, 0.0) for p in DEVICE_PHASES)
+    assert att["device_share_pct"] == round(
+        device / max(host + device, 1e-9) * 100.0, 2
+    )
+    assert att["device_bound"] is True
+    assert att["device_share_pct"] > 50.0, (
+        "the sweep must be device-bound"
+    )
+    for p, bound in ((DECODE, 25.0), (SWEEP_REDUCE, 25.0)):
+        share = phases.get(p, 0.0) / max(host + device, 1e-9) * 100.0
+        assert share < bound, f"{p} share {share:.1f}% — not device-bound"
+    assert 0.0 <= att["gap_pct"] <= 30.0, (
+        "un-attributed wall beyond the loop-overhead allowance"
+    )
+    pc = d["plan_cache"]
+    assert pc["hits"] >= 1, (
+        "world engine replicas must HIT the content-hash plan cache"
+    )
+    assert pc["size"] <= pc["cap"]
+    rs = d["resume"]
+    assert rs["proof_scenarios"] >= 8_000
+    assert rs["killed_after_shards"] >= 1
+    assert rs["resumed_shards"] == rs["killed_after_shards"]
+    assert rs["checkpoint_verified"] is True
+    assert rs["summary_byte_identical"] is True
+    rk = d["ranked"]
+    assert rk["criticality_rows"] >= 1
+    assert rk["worst_case"] is not None
+    for key in ("seed", "mode", "env"):
+        assert key in d, key
+    for key in ("platform", "jax", "device_count"):
+        assert key in d["env"], f"env.{key}"
+    assert d["env"]["device_count"] >= 8
+
+
+def _sweep_bench_world(n_side: int):
+    from openr_tpu.decision.link_state import LinkState
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+    from openr_tpu.types import PrefixEntry
+
+    ls = LinkState("0")
+    for db in build_adj_dbs(grid_edges(n_side)).values():
+        ls.update_adjacency_database(db)
+    ps = PrefixState()
+    for i in range(n_side * n_side):
+        ps.update_prefix(
+            f"node{i}", "0",
+            PrefixEntry(f"10.{i // 256}.{i % 256}.0/24"),
+        )
+    return {"0": ls}, ps
+
+
+def sweep_main(seed: Optional[int] = None) -> None:
+    """Capacity-planning sweep benchmark (BENCH_SWEEP_r*): 100k+
+    scenarios (single-link failures x drain states x metric
+    perturbations + bounded 2-node-domain combos) on the grid4096
+    class, sharded as committed per-device dispatches over an 8-chip
+    DevicePool, spilled + checkpointed + rank-reduced end to end; plus
+    the kill-after-shard-K resume proof on a single-world sub-sweep.
+    Emits one JSON line."""
+    import os
+    import shutil
+    import tempfile
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    seed = SWEEP_SEED if seed is None else seed
+
+    from openr_tpu.common.runtime import CounterMap, WallClock
+    from openr_tpu.ops import repair
+    from openr_tpu.parallel.mesh import DevicePool
+    from openr_tpu.sweep import ScenarioSpec, SweepExecutor, SweepInputs
+    from openr_tpu.sweep.spill import CheckpointManifest, SpillReader
+    from openr_tpu.tracing import pipeline
+    from openr_tpu.tracing.pipeline import PipelineProbe
+
+    als, ps = _sweep_bench_world(SWEEP_GRID_SIDE)
+    clock = WallClock()
+    counters = CounterMap()
+    probe = PipelineProbe(clock, counters)
+    pool = DevicePool()
+
+    def inputs():
+        return SweepInputs(
+            area_link_states=als,
+            prefix_state=ps,
+            change_seq=1,
+            root="node0",
+            pool=pool,
+            probe=probe,
+        )
+
+    def phase_totals() -> dict:
+        out = {}
+        for phase in pipeline.PHASES:
+            h = counters.histogram(pipeline.hist_key(phase))
+            if h is not None:
+                out[phase] = h.total
+        return out
+
+    def make_ex(spill_dir):
+        return SweepExecutor(
+            inputs,
+            spill_dir,
+            clock=clock,
+            counters=counters,
+            shard_scenarios=SWEEP_SHARD,
+            inflight=2,
+        )
+
+    # the headline grammar: 12 worlds x 8064 single-link failures +
+    # 512 seeded 2-node-domain combos per world = 102,912 scenarios
+    spec = ScenarioSpec(
+        drain_node_sets=(
+            (),
+            ("node2080",),            # center drain
+            ("node1032",),            # off-center drain
+            ("node1032", "node2080"),  # double maintenance window
+        ),
+        metric_perturbations=(
+            (r"node1[0-9]{3}", 2.0),  # mid-band cost-up
+            (r"node2[0-9]{3}", 8.0),  # deep cost-out
+        ),
+        combo_k=2,
+        max_combo_scenarios=SWEEP_COMBOS_PER_WORLD,
+        combo_seed=seed,
+    )
+    tmp = tempfile.mkdtemp(prefix="openr_sweep_bench.")
+    try:
+        ex = make_ex(os.path.join(tmp, "headline"))
+        t0 = time.time()
+        rep = ex.prepare(spec)
+        prepare_s = time.time() - t0
+        print(
+            f"# sweep: {rep['scenarios']} scenarios in {rep['shards']} "
+            f"shards over {pool.num_healthy} devices "
+            f"(enumerate {prepare_s:.1f}s)",
+            file=sys.stderr,
+        )
+        p0 = phase_totals()
+        t0 = time.time()
+        ex.run()
+        wall_s = time.time() - t0
+        p1 = phase_totals()
+        phases_ms = {
+            k: round(p1.get(k, 0.0) - p0.get(k, 0.0), 3)
+            for k in pipeline.PHASES
+            if p1.get(k, 0.0) - p0.get(k, 0.0) > 0.0
+        }
+        host = sum(
+            phases_ms.get(p, 0.0) for p in pipeline.HOST_PHASES
+        )
+        device = sum(
+            phases_ms.get(p, 0.0) for p in pipeline.DEVICE_PHASES
+        )
+        attributed = host + device
+        status = ex.status()
+        summary = ex.summary()
+        plan_gauges = repair.plan_cache_gauges()
+        per_device = [int(n) for n in pool.num_dispatches]
+        print(
+            f"# sweep: {status['scenarios_completed']} scenarios in "
+            f"{wall_s:.1f}s ({status['scenarios_completed'] / wall_s:.0f}"
+            f"/s), {status['device_solves']} device solves, "
+            f"device share "
+            f"{device / max(attributed, 1e-9) * 100.0:.1f}%",
+            file=sys.stderr,
+        )
+
+        # ---- the resume proof: kill after shard K, resume, compare --
+        proof_spec = ScenarioSpec()  # identity world, 8064 singles
+        exf = make_ex(os.path.join(tmp, "proof_full"))
+        exf.prepare(proof_spec)
+        exf.run()
+        exk = make_ex(os.path.join(tmp, "proof_kill"))
+        exk.prepare(proof_spec)
+        exk.run(stop_after_shards=SWEEP_RESUME_KILL_AFTER)
+        killed = len(exk.completed)
+        exr = make_ex(os.path.join(tmp, "proof_kill"))
+        rrep = exr.prepare(proof_spec)
+        # checkpoint verification: the manifest's committed shards are
+        # exactly what the kill left, and the spill holds their rows
+        cp = CheckpointManifest(os.path.join(tmp, "proof_kill"))
+        committed = cp.completed_shards()
+        replayed = sum(
+            1
+            for _ in SpillReader(os.path.join(tmp, "proof_kill")).rows(
+                shard_filter=set(committed)
+            )
+        )
+        checkpoint_verified = (
+            sorted(committed) == sorted(range(killed))
+            and replayed == sum(m["rows"] for m in committed.values())
+        )
+        exr.run()
+        resume = {
+            "proof_scenarios": len(exf.scenarios),
+            "killed_after_shards": killed,
+            "resumed_shards": rrep["resumed_shards"],
+            "checkpoint_verified": checkpoint_verified,
+            "summary_byte_identical": (
+                exr.summary()["summary_digest"]
+                == exf.summary()["summary_digest"]
+            ),
+        }
+        print(
+            f"# sweep resume proof: killed after {killed} shards, "
+            f"resumed {rrep['resumed_shards']}, byte-identical "
+            f"{resume['summary_byte_identical']}",
+            file=sys.stderr,
+        )
+        ranked = summary["summary"]
+        doc = {
+            "metric": "sweep_scenarios_per_sec_grid4096",
+            "value": round(status["scenarios_completed"] / wall_s, 1),
+            "unit": "scenarios/s",
+            "detail": {
+                "world": {
+                    "topology": f"grid{SWEEP_GRID_SIDE}x{SWEEP_GRID_SIDE}",
+                    "nodes": SWEEP_GRID_SIDE * SWEEP_GRID_SIDE,
+                    "links": 2
+                    * SWEEP_GRID_SIDE
+                    * (SWEEP_GRID_SIDE - 1),
+                    "prefixes": SWEEP_GRID_SIDE * SWEEP_GRID_SIDE,
+                    "vantage": "node0",
+                },
+                "scenarios": {
+                    "total": status["scenarios_completed"],
+                    "singles": 12 * 2 * SWEEP_GRID_SIDE
+                    * (SWEEP_GRID_SIDE - 1),
+                    "combos": status["scenarios_completed"]
+                    - 12 * 2 * SWEEP_GRID_SIDE * (SWEEP_GRID_SIDE - 1),
+                    "worlds": 12,
+                    "device_solves": status["device_solves"],
+                    "alias_rows": ranked["alias_rows"],
+                    "zero_delta": ranked["zero_delta"],
+                },
+                "shards": {
+                    "total": status["shards_total"],
+                    "completed": status["shards_completed"],
+                    "scenarios_per_shard": SWEEP_SHARD,
+                    "repacked": status["repacked_shards"],
+                    "per_device_dispatches": per_device,
+                },
+                "throughput": {
+                    "scenarios_per_sec": round(
+                        status["scenarios_completed"] / wall_s, 1
+                    ),
+                    "device_solves_per_sec": round(
+                        status["device_solves"] / wall_s, 1
+                    ),
+                    "wall_s": round(wall_s, 1),
+                    "prepare_s": round(prepare_s, 1),
+                },
+                "spill": status["spill"],
+                "attribution": {
+                    "phases_ms": phases_ms,
+                    "attributed_ms": round(attributed, 1),
+                    "host_ms": round(host, 1),
+                    "device_ms": round(device, 1),
+                    "device_share_pct": round(
+                        device / max(attributed, 1e-9) * 100.0, 2
+                    ),
+                    "device_bound": device
+                    / max(attributed, 1e-9)
+                    > 0.5,
+                    "gap_pct": round(
+                        max(
+                            (wall_s * 1000.0 - attributed)
+                            / (wall_s * 1000.0)
+                            * 100.0,
+                            0.0,
+                        ),
+                        2,
+                    ),
+                },
+                "plan_cache": {
+                    "hits": int(plan_gauges["plan_cache.hits"]),
+                    "misses": int(plan_gauges["plan_cache.misses"]),
+                    "evictions": int(
+                        plan_gauges["plan_cache.evictions"]
+                    ),
+                    "size": int(plan_gauges["plan_cache.size"]),
+                    "cap": int(plan_gauges["plan_cache.cap"]),
+                },
+                "resume": resume,
+                "ranked": {
+                    "criticality_rows": len(ranked["criticality"]),
+                    "top_links": ranked["criticality"][:5],
+                    "worst_case": ranked["worst_case"],
+                    "spof_count": len(ranked["spof_links"]),
+                    "summary_digest": summary["summary_digest"],
+                },
+                "seed": seed,
+                "mode": (
+                    "standalone executor (WallClock) over a synthetic "
+                    "grid4096 LSDB; 8 forced host devices (virtual "
+                    "chips share physical cores — per-device scaling "
+                    "is structural, the throughput is the one-host "
+                    "number); warm-repair solve + on-device selection "
+                    "per shard, streamed FIFO drains"
+                ),
+                "env": env_stamp(),
+            },
+        }
+        validate_sweep_bench(doc)
+        print(json.dumps(doc))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> None:
@@ -4040,6 +4440,7 @@ BENCH_MODES = {
     "suite": (suite_main, "sweeps 7", "topology-class trajectory: seeded chaos sweeps at 1k+ nodes per class"),
     "rolling": (rolling_main, "sweep 11", "rolling-restart survival: every node bounced once, structural warm-hit + SLO hold"),
     "streaming": (streaming_main, "sweep 11", "watch-plane fan-out: 10k+ subscriber churn under chaos, snapshot+delta generation correctness"),
+    "sweep": (sweep_main, "grammar 7", "capacity-planning sweep: 100k+ scenarios on grid4096, sharded/spilled/resumable, ranked risk summary"),
 }
 
 

@@ -17,6 +17,7 @@ from openr_tpu.analysis.passes.jax_hygiene import JaxHygienePass
 from openr_tpu.analysis.passes.pipeline_phase import PipelinePhasePass
 from openr_tpu.analysis.passes.resilience_latch import ResilienceLatchPass
 from openr_tpu.analysis.passes.slot_table import SlotTablePass
+from openr_tpu.analysis.passes.sweep_ownership import SweepOwnershipPass
 
 
 def make_passes():
@@ -29,6 +30,7 @@ def make_passes():
         SlotTablePass(),
         PipelinePhasePass(),
         AlertRegistryPass(),
+        SweepOwnershipPass(),
     ]
 
 

@@ -1,0 +1,74 @@
+"""Sweep spill/checkpoint discipline — durable sweep state has ONE owner.
+
+The capacity-sweep resume contract (docs/Developer_Guide.md) hangs on a
+strict commit ordering: rows durable in the spill BEFORE the checkpoint
+manifest records their shard, and the manifest only ever reset against
+a matching scenario-set hash.  A stray ``commit_shard`` / ``spill_rows``
+/ ``reset`` call from outside the executor would let state bypass that
+ordering — a checkpoint claiming rows the spill doesn't hold, or a
+manifest reset that orphans committed rows — and the failure mode is
+silent until a resume replays garbage.
+
+Rule:
+
+* ``sweep-spill-ownership`` — a call to the spill/checkpoint mutators
+  (``spill_rows``, ``commit_shard``, or ``CheckpointManifest``'s
+  ``reset``) anywhere outside ``openr_tpu/sweep/``.  Reads
+  (``SpillReader``, ``completed_shards``, ``matches``, ``stats``) are
+  fine everywhere.  ``reset`` is matched only as an attribute call on a
+  name containing ``checkpoint``/``manifest`` — plain ``x.reset()`` on
+  unrelated objects must not trip.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+ALLOWED_PREFIXES = ("openr_tpu/sweep/",)
+
+_MUTATOR_CALLS = {"spill_rows", "commit_shard"}
+_RESET_RECEIVER_HINTS = ("checkpoint", "manifest")
+
+
+class SweepOwnershipPass(Pass):
+    name = "sweep-ownership"
+    rules = {
+        "sweep-spill-ownership": (
+            "sweep spill/checkpoint mutator called outside "
+            "openr_tpu/sweep/ (route durable sweep state through the "
+            "executor so the spill-before-checkpoint commit ordering "
+            "holds)"
+        ),
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if mod.rel.startswith(ALLOWED_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            name = f.attr
+            hit = name in _MUTATOR_CALLS
+            if name == "reset" and isinstance(f.value, ast.Name):
+                recv = f.value.id.lower()
+                hit = any(h in recv for h in _RESET_RECEIVER_HINTS)
+            if hit:
+                out.append(
+                    mod.finding(
+                        "sweep-spill-ownership",
+                        node,
+                        f"`{name}(..)` outside openr_tpu/sweep/ bypasses "
+                        "the executor's spill-before-checkpoint commit "
+                        "ordering; drive sweeps through SweepExecutor/"
+                        "SweepService instead",
+                    )
+                )
+        return out

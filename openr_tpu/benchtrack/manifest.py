@@ -200,6 +200,12 @@ def _spoil_streaming(doc: dict) -> None:
     doc["detail"]["invariant_violations"] = 1
 
 
+def _spoil_sweep(doc: dict) -> None:
+    # a resume that fails to reproduce the uninterrupted ranked summary
+    # byte for byte must never pass the gate
+    doc["detail"]["resume"]["summary_byte_identical"] = False
+
+
 # -- acceptance floors moved out of the six per-family test files
 
 
@@ -275,6 +281,20 @@ def _accept_streaming(doc: dict) -> None:
     assert d["resyncs"]["rate"] < 0.5, "a resync loop is a failure mode"
     assert d["alerts"]["unexpected"] == 0
     assert d["deterministic_replay"] is True
+
+
+def _accept_sweep(doc: dict) -> None:
+    # the ISSUE-14 acceptance floor: 100k+ scenarios end to end in one
+    # round, device-bound attribution, byte-identical mid-sweep resume
+    d = doc["detail"]
+    assert d["scenarios"]["total"] >= 100_000
+    assert d["attribution"]["device_bound"] is True
+    assert d["attribution"]["device_share_pct"] > 50.0
+    assert d["resume"]["summary_byte_identical"] is True
+    assert d["resume"]["checkpoint_verified"] is True
+    assert d["spill"]["rows"] == d["scenarios"]["total"]
+    assert d["spill"]["peak_host_rows"] <= d["shards"]["scenarios_per_shard"]
+    assert d["plan_cache"]["hits"] >= 1
 
 
 def _accept_rolling(doc: dict) -> None:
@@ -532,6 +552,32 @@ MANIFEST: Tuple[ArtifactSpec, ...] = (
         markers=("serving", "streaming"),
         spoil=_spoil_streaming,
         acceptance=_accept_streaming,
+    ),
+    ArtifactSpec(
+        family="sweep",
+        pattern=r"BENCH_SWEEP_r(\d+)\.json",
+        description=(
+            "capacity-planning sweep orchestrator: 100k+ scenarios "
+            "(failures x drains x metric perturbations + bounded "
+            "2-domain combos) on grid4096, sharded per-device, "
+            "spilled + checkpointed, ranked risk summary, "
+            "kill-and-resume byte-identity (bench.py --sweep)"
+        ),
+        validate=_v("sweep"),
+        headline=(
+            # end-to-end scenario throughput (machine-dependent, wide
+            # tolerance like the serving/streaming headlines)
+            HeadlineMetric("value", HIGHER, tolerance_pct=40.0),
+            # how device-bound the sweep is (informational trajectory)
+            HeadlineMetric(
+                "detail.attribution.device_share_pct",
+                HIGHER,
+                ratchet=False,
+            ),
+        ),
+        markers=("sweep", "multichip"),
+        spoil=_spoil_sweep,
+        acceptance=_accept_sweep,
     ),
 )
 

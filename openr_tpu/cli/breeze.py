@@ -785,6 +785,141 @@ def serving_watch(
     _run_bounded(go(), duration)
 
 
+# ------------------------------------------------------------------- sweep
+
+
+@breeze.group()
+def sweep() -> None:
+    """Capacity-planning sweeps: declarative what-if scenario grammars
+    sharded over the device pool (openr_tpu.sweep; docs/Sweeps.md)."""
+
+
+@sweep.command("run")
+@click.option(
+    "--drain",
+    "drains",
+    multiple=True,
+    help="drain-state world variant: comma-separated node names "
+    "(repeatable; an empty string is the identity world)",
+)
+@click.option(
+    "--metric-scale",
+    "metric_scales",
+    multiple=True,
+    help="metric perturbation world variant PATTERN:FACTOR (links "
+    "whose endpoints both match the regex get their metric scaled)",
+)
+@click.option("--combo-k", default=None, type=int,
+              help="failure-domain combination order (nodes as domains)")
+@click.option("--max-combos", default=None, type=int,
+              help="bound on enumerated k-combinations per world")
+@click.option("--no-resume", is_flag=True,
+              help="ignore any matching checkpoint and start fresh")
+@click.pass_context
+def sweep_run(
+    ctx: click.Context, drains, metric_scales, combo_k, max_combos,
+    no_resume,
+) -> None:
+    """Launch (or resume) a capacity sweep on the connected node."""
+    params: dict = {}
+    if drains:
+        params["drain_node_sets"] = [
+            [n for n in d.split(",") if n] for d in drains
+        ]
+    if metric_scales:
+        perturbations = []
+        for spec in metric_scales:
+            pattern, _, factor = spec.rpartition(":")
+            if not pattern or not factor:
+                raise click.UsageError(
+                    f"metric scale must be PATTERN:FACTOR, got {spec!r}"
+                )
+            perturbations.append(
+                {"pattern": pattern, "factor": float(factor)}
+            )
+        params["metric_perturbations"] = perturbations
+    if combo_k is not None:
+        params["combo_k"] = combo_k
+    if max_combos is not None:
+        params["max_combo_scenarios"] = max_combos
+    if no_resume:
+        params["resume"] = False
+    _print(_call(ctx, "start_sweep", params=params))
+
+
+@sweep.command("status")
+@click.pass_context
+def sweep_status(ctx: click.Context) -> None:
+    """Progress of the current (or last) sweep."""
+    st = _call(ctx, "get_sweep_status")
+    click.echo(
+        f"sweep on {st['node']}: {st['state']}"
+        + (f" ({st['error']})" if st.get("error") else "")
+    )
+    if "scenarios_total" in st:
+        click.echo(
+            f"  scenarios {st['scenarios_completed']}/"
+            f"{st['scenarios_total']}  shards "
+            f"{st['shards_completed']}/{st['shards_total']}"
+            f"  resumed={st['resumed_shards']}"
+            f" repacked={st['repacked_shards']}"
+            f" device_solves={st['device_solves']}"
+        )
+        spill = st.get("spill") or {}
+        if spill:
+            click.echo(
+                f"  spill rows={spill.get('rows')} "
+                f"segments={spill.get('segments_sealed')} "
+                f"bytes={spill.get('bytes')} "
+                f"peak_host_rows={spill.get('peak_host_rows')}"
+            )
+
+
+@sweep.command("summary")
+@click.option("--top", default=10, help="criticality rows to print")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def sweep_summary(ctx: click.Context, top: int, json_out: bool) -> None:
+    """The ranked risk summary (live during a sweep, final after)."""
+    doc = _call(ctx, "get_sweep_summary")
+    if json_out:
+        _print(doc)
+        return
+    summary = doc.get("summary")
+    if not summary:
+        click.echo(f"no sweep summary on {doc.get('node')} "
+                   f"(state {doc.get('state')})")
+        return
+    click.echo(
+        f"sweep {doc.get('sweep_id')} on {doc['node']}: "
+        f"{doc['state']}{' (complete)' if doc.get('complete') else ''}"
+    )
+    click.echo(
+        f"  scenarios={summary['scenarios']} "
+        f"zero_delta={summary['zero_delta']} "
+        f"spof_links={len(summary['spof_links'])}"
+    )
+    worst = summary.get("worst_case")
+    if worst:
+        click.echo(
+            f"  worst case: {worst['withdrawn']} routes withdrawn "
+            f"({worst['world']}; failure {worst['failure']})"
+        )
+    for row in summary["criticality"][:top]:
+        click.echo(
+            f"  {'-'.join(row['link']):<24} worst={row['worst_withdrawn']}"
+            f" total={row['total_withdrawn']} scen={row['scenarios']}"
+        )
+
+
+@sweep.command("cancel")
+@click.pass_context
+def sweep_cancel(ctx: click.Context) -> None:
+    """Stop the running sweep at the next shard boundary (committed
+    shards stay durable for a later resume)."""
+    _print(_call(ctx, "cancel_sweep"))
+
+
 # -------------------------------------------------------------- resilience
 
 

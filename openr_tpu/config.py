@@ -252,6 +252,53 @@ class ServingConfig:
 
 
 @dataclass
+class MetricPerturbationConfig:
+    """One metric-perturbation world variant of the sweep grammar:
+    links whose BOTH endpoints full-match ``pattern`` have their
+    metrics scaled by ``factor`` (the cost-out / cost-up shape)."""
+
+    pattern: str = ".*"
+    factor: float = 2.0
+
+
+@dataclass
+class SweepConfig:
+    """Capacity-planning sweep orchestrator knobs (openr_tpu.sweep,
+    net-new vs the reference): the declarative scenario grammar
+    defaults, shard packing, the bounded result spill and the ranked
+    summary.  See docs/Sweeps.md."""
+
+    enabled: bool = True
+    #: scenarios per committed per-device shard dispatch
+    shard_scenarios: int = 1024
+    #: rows per sealed spill segment (JSONL)
+    spill_segment_rows: int = 8192
+    #: spill/checkpoint directory ("" = /tmp/openr_tpu_sweep.{node} —
+    #: node-scoped exactly like the persistent store: single-writer)
+    spill_dir: str = ""
+    #: ranked-summary table depth (top-K worst scenarios / links)
+    summary_top_k: int = 64
+    #: failure-domain combination order for the grammar default
+    #: (nodes as domains; < 2 disables combinations)
+    combo_k: int = 0
+    #: explicit bound on enumerated k-combinations per world
+    max_combo_scenarios: int = 0
+    #: drain-state world variants (each entry: node names drained)
+    drain_node_sets: List[List[str]] = field(default_factory=lambda: [[]])
+    #: metric-perturbation world variants (identity always included)
+    metric_perturbations: List[MetricPerturbationConfig] = field(
+        default_factory=list
+    )
+    #: shards concurrently in flight on the streamed drain path
+    inflight_shards: int = 2
+    #: breather between committed shards on the service fiber: the
+    #: daemon's other actors interleave with a long sweep instead of
+    #: starving behind it (SimClock chaos scenarios stretch it so
+    #: faults land mid-sweep deterministically)
+    inter_shard_pause_s: float = 0.01
+
+
+@dataclass
 class ParallelConfig:
     """Multi-chip data-parallel dispatch knobs (openr_tpu.parallel,
     net-new vs the reference): the DevicePool that owns the live-device
@@ -355,6 +402,13 @@ class TpuComputeConfig:
     nexthop_words: int = 2
     #: device mesh axis name for sharding what-if batches
     batch_axis: str = "batch"
+    #: content-hash RepairPlan cache bound (ops.repair
+    #: build_repair_plan_cached), in (topology, root, base) entries.
+    #: Sweeps over many drain/metric worlds churn this cache; the LRU
+    #: cap bounds host memory and `decision.backend.plan_cache.*`
+    #: gauges make hit/eviction behavior observable.  0 keeps the
+    #: library default.
+    plan_cache_entries: int = 16
 
 
 @dataclass
@@ -390,6 +444,7 @@ class OpenrConfig:
     health_config: HealthConfig = field(default_factory=HealthConfig)
     resilience_config: ResilienceConfig = field(default_factory=ResilienceConfig)
     parallel_config: ParallelConfig = field(default_factory=ParallelConfig)
+    sweep_config: SweepConfig = field(default_factory=SweepConfig)
     originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
     segment_routing_config: SegmentRoutingConfig = field(
         default_factory=SegmentRoutingConfig
@@ -501,6 +556,40 @@ class OpenrConfig:
             raise ValueError(
                 "parallel needs max_devices >= 0 and min_shard_rows >= 0"
             )
+        sw = self.sweep_config
+        if (
+            sw.shard_scenarios < 1
+            or sw.spill_segment_rows < 1
+            or sw.summary_top_k < 1
+            or sw.inflight_shards < 1
+        ):
+            raise ValueError(
+                "sweep needs shard_scenarios >= 1, spill_segment_rows "
+                ">= 1, summary_top_k >= 1, inflight_shards >= 1"
+            )
+        if sw.combo_k < 0 or sw.max_combo_scenarios < 0:
+            raise ValueError(
+                "sweep needs combo_k >= 0 and max_combo_scenarios >= 0"
+            )
+        if sw.inter_shard_pause_s < 0:
+            raise ValueError("sweep needs inter_shard_pause_s >= 0")
+        for m in sw.metric_perturbations:
+            if m.factor <= 0:
+                raise ValueError(
+                    f"sweep metric perturbation factor must be > 0, "
+                    f"got {m.factor}"
+                )
+            import re as _re
+
+            try:
+                _re.compile(m.pattern)
+            except _re.error as e:
+                raise ValueError(
+                    f"invalid sweep metric perturbation pattern "
+                    f"{m.pattern!r}: {e}"
+                ) from None
+        if self.tpu_compute_config.plan_cache_entries < 0:
+            raise ValueError("plan_cache_entries must be >= 0")
         from openr_tpu.lsdb_codec import WIRE_FORMATS
 
         if self.lsdb_wire_format not in WIRE_FORMATS:

@@ -552,6 +552,40 @@ class OpenrCtrlHandler:
             self._subscribers.pop(sid, None)
             streaming.unsubscribe(sub_id)
 
+    # ----------------------------------------------------------------- sweep
+    # (openr_tpu.sweep — capacity-planning scenario sweeps over the
+    # what-if compute plane; net-new vs the reference)
+
+    def start_sweep(self, params: Optional[dict] = None) -> dict:
+        """Launch (or resume) a capacity-planning sweep: the declarative
+        scenario grammar from sweep_config, overridden per request
+        (`breeze sweep run`).  One sweep at a time per node; a killed or
+        cancelled sweep resumes from its last committed shard."""
+        from openr_tpu.sweep import SweepError
+
+        try:
+            return self.node.sweep.start_sweep(params)
+        except SweepError as e:
+            return {"state": "refused", "error": str(e)}
+
+    def get_sweep_status(self) -> dict:
+        """Progress of the current (or last) sweep: shards/scenarios
+        completed, resume/repack tallies, spill stats
+        (`breeze sweep status`)."""
+        return self.node.sweep.get_sweep_status()
+
+    def get_sweep_summary(self) -> dict:
+        """The ranked risk summary so far: worst-case reachability
+        loss, SPOF list, per-link criticality ranking — live during the
+        sweep, final once complete (`breeze sweep summary`)."""
+        return self.node.sweep.get_sweep_summary()
+
+    def cancel_sweep(self) -> dict:
+        """Stop the running sweep at the next shard boundary; committed
+        shards stay durable for a later resume (`breeze sweep
+        cancel`)."""
+        return self.node.sweep.cancel_sweep()
+
     # ------------------------------------------------------------ resilience
     # (openr_tpu.resilience — breaker/governor health of every
     # external-dependency edge; net-new vs the reference)

@@ -254,8 +254,15 @@ class TpuBackend(DecisionBackend):
         parallel=None,
         probe=None,
         warm_rebuild: bool = True,
+        plan_cache_entries: int = 0,
     ) -> None:
         self.solver = solver  # scalar fallback + MPLS/static
+        if plan_cache_entries:
+            # bound the content-hash RepairPlan memo (ops.repair) the
+            # what-if/sweep planners ride; 0 keeps the library default
+            from openr_tpu.ops.repair import set_plan_cache_cap
+
+            set_plan_cache_cap(plan_cache_entries)
         # AOT-equivalence with the reference's compiled binary: persist
         # XLA executables so only the FIRST boot on a machine pays kernel
         # compilation (~14s of cold boot at 4096-node scale)
@@ -902,6 +909,14 @@ class TpuBackend(DecisionBackend):
                 ] = float(n)
         for reason, n in sorted(self._warm_purge_reasons.items()):
             out[f"decision.backend.warm_purge.{reason}"] = float(n)
+        # content-hash RepairPlan cache (ops.repair): the what-if and
+        # capacity-sweep planners' reuse surface — hits prove prefix
+        # churn isn't restarting planning, evictions + size prove the
+        # config cap holds under world churn
+        from openr_tpu.ops.repair import plan_cache_gauges
+
+        for k, v in plan_cache_gauges().items():
+            out[f"decision.backend.{k}"] = v
         if self._pool is not None:
             # only report pool gauges once the pool actually exists — a
             # Monitor sweep must never be the thing that boots jax

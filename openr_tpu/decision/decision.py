@@ -775,6 +775,30 @@ class Decision(Actor):
             self.backend, "device_failed", False
         )
 
+    def capacity_sweep_inputs(self) -> dict:
+        """Everything the capacity-sweep executor (openr_tpu.sweep)
+        reads per context build, as one public surface: the live LSDB +
+        prefix state + change generation, the backend's DevicePool /
+        PipelineProbe / health governor (the sweep dispatches over the
+        same health-governed chips route builds use), and the
+        selection-rule flag its multi-area decode needs.  The kwargs of
+        :class:`openr_tpu.sweep.executor.SweepInputs`."""
+        from openr_tpu.types import RouteComputationRules
+
+        return {
+            "area_link_states": self.area_link_states,
+            "prefix_state": self.prefix_state,
+            "change_seq": self._change_seq,
+            "root": self.solver.my_node_name,
+            "pool": self._backend_pool(),
+            "probe": self._backend_probe(),
+            "governor": getattr(self.backend, "governor", None),
+            "per_area_distance": (
+                self.solver.route_selection_algorithm
+                == RouteComputationRules.PER_AREA_SHORTEST_DISTANCE
+            ),
+        }
+
     def compute_route_db_for_node(self, node: str) -> Optional[DecisionRouteDb]:
         """What-if: the RouteDb as `node` would compute it
         (getRouteDbComputed ctrl API).  When the device fleet engine is

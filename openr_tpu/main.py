@@ -337,6 +337,9 @@ class OpenrNode:
                 tracer=self.tracer,
                 resilience=config.resilience_config,
                 parallel=config.parallel_config,
+                plan_cache_entries=(
+                    config.tpu_compute_config.plan_cache_entries
+                ),
             )
             if use_tpu
             else ScalarBackend(solver)
@@ -400,6 +403,19 @@ class OpenrNode:
             tracer=self.tracer,
             breaker_seed=config.resilience_config.seed,
         )
+        # the capacity-planning sweep orchestrator (openr_tpu.sweep):
+        # declarative what-if scenario sweeps sharded over the same
+        # health-governed DevicePool route builds use
+        from openr_tpu.sweep import SweepService
+
+        self.sweep = SweepService(
+            node_name=self.name,
+            clock=clock,
+            config=config.sweep_config,
+            decision=self.decision,
+            counters=self.counters,
+            tracer=self.tracer,
+        )
         # -- aux services (L6): config-store, monitor, watchdog ------------
         # Drain state survives restarts via the persistent store
         # (reference: LinkMonitor loads from PersistentStore on start,
@@ -442,6 +458,7 @@ class OpenrNode:
         self.monitor.add_counter_provider(self._queue_gauges)
         self.monitor.add_counter_provider(self.serving.gauges)
         self.monitor.add_counter_provider(self.streaming.gauges)
+        self.monitor.add_counter_provider(self.sweep.gauges)
         # pipeline attribution gauges: per-chip busy ms / utilization
         # accumulated by the backend + fleet/what-if engines' shared
         # PipelineProbe (pipeline.devN.*)
@@ -582,6 +599,8 @@ class OpenrNode:
         if config.serving_config.enabled:
             self._all_modules.append(self.serving)
             self._all_modules.append(self.streaming)
+        if config.sweep_config.enabled:
+            self._all_modules.append(self.sweep)
         if self.health_monitor is not None:
             self._all_modules.append(self.health_monitor)
         if self.watchdog is not None:

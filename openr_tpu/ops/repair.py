@@ -221,14 +221,35 @@ def topology_content_hash(topo, root_id: Optional[int] = None) -> str:
 #: content-addressed RepairPlan memo: repeated what-if sweeps over an
 #: unchanged LSDB (the common serving pattern — the change seq bumps on
 #: every prefix churn, but the GRAPH is usually identical) skip the
-#: planner re-pass entirely.  Tiny: a handful of (topology, root) worlds
-#: are live at once, and a stale entry is merely unused memory.
-_PLAN_CACHE_CAP = 8
+#: planner re-pass entirely.  LRU-bounded: capacity sweeps enumerate
+#: many (drain, metric) counterfactual worlds, each a distinct
+#: (topology, root, base) entry whose ``aff_link_words`` bitsets are
+#: megabytes at 4k-node scale — without the cap a long sweep would
+#: grow the cache one plan per world per churn generation.  The cap is
+#: config-tunable (``tpu_compute_config.plan_cache_entries`` →
+#: :func:`set_plan_cache_cap`) and hit/eviction/size behavior exports
+#: as ``decision.backend.plan_cache.*`` gauges.
+_PLAN_CACHE_DEFAULT_CAP = 8
+_plan_cache_cap = _PLAN_CACHE_DEFAULT_CAP
 _plan_cache: "collections.OrderedDict[tuple, RepairPlan]" = (
     collections.OrderedDict()
 )
 num_plan_cache_hits = 0
 num_plan_cache_misses = 0
+num_plan_cache_evictions = 0
+
+
+def set_plan_cache_cap(cap: int) -> int:
+    """Bound the content-hash plan cache to ``cap`` entries (0 restores
+    the library default), trimming oldest entries immediately; returns
+    the effective cap.  Owned by the Decision backend's config wiring —
+    tests and benches may call it directly."""
+    global _plan_cache_cap, num_plan_cache_evictions
+    _plan_cache_cap = int(cap) if cap and cap > 0 else _PLAN_CACHE_DEFAULT_CAP
+    while len(_plan_cache) > _plan_cache_cap:
+        _plan_cache.popitem(last=False)
+        num_plan_cache_evictions += 1
+    return _plan_cache_cap
 
 
 def build_repair_plan_cached(
@@ -265,14 +286,29 @@ def build_repair_plan_cached(
         topo, root_id, base_dist, base_nh, pull_tables=pull_tables
     )
     _plan_cache[key] = plan
-    while len(_plan_cache) > _PLAN_CACHE_CAP:
+    global num_plan_cache_evictions
+    while len(_plan_cache) > _plan_cache_cap:
         _plan_cache.popitem(last=False)
+        num_plan_cache_evictions += 1
     return plan
 
 
 def plan_cache_stats() -> Tuple[int, int]:
     """(hits, misses) since process start — bench/test introspection."""
     return num_plan_cache_hits, num_plan_cache_misses
+
+
+def plan_cache_gauges() -> dict:
+    """The plan-cache observability surface, spelled WITHOUT a prefix —
+    the Decision backend namespaces it under
+    ``decision.backend.plan_cache.*`` in its counter snapshot."""
+    return {
+        "plan_cache.hits": float(num_plan_cache_hits),
+        "plan_cache.misses": float(num_plan_cache_misses),
+        "plan_cache.evictions": float(num_plan_cache_evictions),
+        "plan_cache.size": float(len(_plan_cache)),
+        "plan_cache.cap": float(_plan_cache_cap),
+    }
 
 
 def build_pull_tables(topo, root_id: int):

@@ -558,6 +558,15 @@ class PendingDeltas:
         self._P = P
         self._done = False
 
+    def is_ready(self) -> bool:
+        """True when every compaction buffer has completed on device —
+        ``finish()`` would then return without blocking on compute.
+        The streamed sweep executor polls this to drain whichever
+        in-flight shard lands first."""
+        if self._comp is None:
+            return True
+        return all(a.is_ready() for a in self._comp)
+
     def finish(self) -> SweepRouteDeltas:
         if self._done:
             # a silent second finish would return an empty delta set —

@@ -75,6 +75,28 @@ class StreamingUnknownSubscriberError(ServingError):
 #: feed kinds a subscriber may watch
 KINDS = ("route_db", "whatif")
 
+#: delta-body fields (everything else in an emission is envelope) —
+#: the shared-wire-encode split point
+_BODY_FIELDS = (
+    "unicast_updated",
+    "unicast_removed",
+    "mpls_updated",
+    "mpls_removed",
+    "scenario_updated",
+    "scenario_removed",
+    "scenario_meta",
+)
+
+
+def canonical_wire(doc) -> bytes:
+    """Canonical JSON bytes (sorted keys, no whitespace) — the wire
+    spelling shared encodes splice fragments of."""
+    import json as _json
+
+    return _json.dumps(
+        doc, sort_keys=True, separators=(",", ":"), default=str
+    ).encode()
+
 
 def _row_key(kind: str, row: dict):
     return row["dest"] if kind == "u" else row["top_label"]
@@ -82,17 +104,29 @@ def _row_key(kind: str, row: dict):
 
 class _DeltaEntry:
     """One generation window's changes for one feed, shared immutably by
-    every subscriber attached to that feed."""
+    every subscriber attached to that feed.
 
-    __slots__ = ("seq", "generation", "updated", "removed", "t_mint")
+    ``rendered_body`` / ``encoded_body`` are the shared-wire-encode
+    caches (PR-13 remnant (b)): the delta BODY — row lists and their
+    canonical JSON bytes — is built at most ONCE per entry and shared
+    by reference across every unfiltered single-window subscriber, so
+    the fan-out loop's per-subscriber work is an envelope, not a
+    payload rebuild + re-serialization."""
+
+    __slots__ = (
+        "seq", "generation", "updated", "removed", "t_mint",
+        "rendered_body", "encoded_body",
+    )
 
     def __init__(self, seq, generation, updated, removed, t_mint) -> None:
         self.seq = seq
         self.generation = generation
-        #: ("u", dest) / ("m", label) / ("scenario",) -> wire row
+        #: ("u", dest) / ("m", label) / ("w", key) / ("wmeta",) -> row
         self.updated: Dict[tuple, Any] = updated
         self.removed: set = removed
         self.t_mint = t_mint
+        self.rendered_body: Optional[dict] = None
+        self.encoded_body: Optional[bytes] = None
 
 
 class _Feed:
@@ -152,11 +186,23 @@ class StreamSubscriber:
 
 def apply_emission(rows: Dict[tuple, Any], emission: dict) -> Dict[tuple, Any]:
     """Apply one wire emission to a client-side row map (``("u", dest)``
-    / ``("m", label)`` -> wire row) and return the new map — the
-    reference client reducer, used by tests and the bench parity proof:
-    snapshot replaces, delta patches (updates then removals can't
-    conflict: the merge already resolved last-writer-wins)."""
+    / ``("m", label)`` / scenario rows -> wire row) and return the new
+    map — the reference client reducer, used by tests and the bench
+    parity proof: snapshot replaces, delta patches (updates then
+    removals can't conflict: the merge already resolved
+    last-writer-wins).  What-if feeds patch per-SCENARIO-ROW (the
+    shared sweep row model, openr_tpu.sweep.rows) instead of replacing
+    the whole scenario result."""
+    from openr_tpu.sweep.rows import (
+        SCENARIO_META,
+        SCENARIO_ROW,
+        scenario_row_key,
+        scenario_rows,
+    )
+
     if emission["type"] == "snapshot":
+        if emission.get("kind") == "whatif":
+            return scenario_rows(emission["scenario"])
         db = emission["route_db"]
         out: Dict[tuple, Any] = {}
         for row in db.get("unicast_routes", []):
@@ -173,8 +219,12 @@ def apply_emission(rows: Dict[tuple, Any], emission: dict) -> Dict[tuple, Any]:
         out[("m", row["top_label"])] = row
     for label in emission.get("mpls_removed", []):
         out.pop(("m", label), None)
-    if "scenario" in emission:
-        out[("scenario",)] = emission["scenario"]
+    for row in emission.get("scenario_updated", []):
+        out[(SCENARIO_ROW, scenario_row_key(row))] = row
+    for key in emission.get("scenario_removed", []):
+        out.pop((SCENARIO_ROW, key), None)
+    if "scenario_meta" in emission:
+        out[(SCENARIO_META,)] = emission["scenario_meta"]
     return out
 
 
@@ -213,6 +263,9 @@ class StreamingService(Actor):
         #: (debounce included), not publish→delivery
         self._window_t0 = 0.0
         self._started = False
+        #: the entry backing the LAST minted delta (shared-body fast
+        #: path) — read synchronously by the wire encoder, single-loop
+        self._emission_entry: Optional[_DeltaEntry] = None
         self.num_publish_ticks = 0
         self.num_emissions = 0
         self.num_resyncs = 0
@@ -259,15 +312,28 @@ class StreamingService(Actor):
         client_id: str = "",
         prefix_filters: Tuple[str, ...] = (),
         deliver: Optional[Callable[[dict], None]] = None,
+        deliver_wire: Optional[Callable[[bytes], None]] = None,
     ) -> int:
         """Register interest; returns the subscription id.  Charges one
         quota token; raises ServingRejectedError at the subscriber
         bound.  With ``deliver``, emissions PUSH through the callable
-        (breaker-protected); otherwise the subscriber long-polls via
+        (breaker-protected); ``deliver_wire`` instead pushes canonical
+        JSON BYTES whose delta body is encoded once per feed entry and
+        shared across subscribers (the shared-wire-encode fan-out
+        path).  Otherwise the subscriber long-polls via
         :meth:`next_emission`.  The first emission is always the
         snapshot."""
         if kind not in KINDS:
             raise ServingError(f"unknown streaming feed kind {kind!r}")
+        if deliver_wire is not None:
+            if deliver is not None:
+                raise ServingError(
+                    "pass deliver OR deliver_wire, not both"
+                )
+            svc = self
+
+            def deliver(emission, _dw=deliver_wire):
+                _dw(svc._encode_emission(emission))
         params = params or {}
         client = client_id or "anon"
         if len(self._subs) >= self.config.stream_max_subscribers:
@@ -419,7 +485,12 @@ class StreamingService(Actor):
     @staticmethod
     def _result_rows(kind: str, result) -> Dict[tuple, Any]:
         if kind == "whatif":
-            return {("scenario",): result}
+            # per-SCENARIO-ROW decomposition (the shared sweep row
+            # model): a change to one failure's answer emits that row,
+            # never the whole scenario result (PR-13 remnant (a))
+            from openr_tpu.sweep.rows import scenario_rows
+
+            return scenario_rows(result)
         rows: Dict[tuple, Any] = {}
         for row in result.get("unicast_routes", []):
             rows[("u", row["dest"])] = row
@@ -555,38 +626,83 @@ class StreamingService(Actor):
                 updated.pop(k, None)
         return updated, removed, first, last, n
 
+    def _body_for(
+        self,
+        kind: str,
+        updated: Dict[tuple, Any],
+        removed: set,
+        sub: Optional[StreamSubscriber],
+    ) -> Optional[Dict[str, Any]]:
+        """Render one delta body (sorted row lists); ``sub=None``
+        renders the unfiltered shared view.  None = nothing visible."""
+        if kind == "whatif":
+            from openr_tpu.sweep.rows import SCENARIO_META, SCENARIO_ROW
+
+            rows = [
+                row
+                for k, row in sorted(updated.items())
+                if k[0] == SCENARIO_ROW
+            ]
+            rm = sorted(k[1] for k in removed if k[0] == SCENARIO_ROW)
+            meta = updated.get((SCENARIO_META,))
+            if not rows and not rm and meta is None:
+                return None
+            body: Dict[str, Any] = {
+                "scenario_updated": rows,
+                "scenario_removed": rm,
+            }
+            if meta is not None:
+                body["scenario_meta"] = meta
+            return body
+        def wants(dest: str) -> bool:
+            return sub is None or sub.wants(dest)
+
+        u_up = [
+            row
+            for k, row in sorted(updated.items())
+            if k[0] == "u" and wants(k[1])
+        ]
+        u_rm = sorted(
+            k[1] for k in removed if k[0] == "u" and wants(k[1])
+        )
+        m_up = [row for k, row in sorted(updated.items()) if k[0] == "m"]
+        m_rm = sorted(k[1] for k in removed if k[0] == "m")
+        if not (u_up or u_rm or m_up or m_rm):
+            return None
+        return {
+            "unicast_updated": u_up,
+            "unicast_removed": u_rm,
+            "mpls_updated": m_up,
+            "mpls_removed": m_rm,
+        }
+
     def _emit_delta(self, sub: StreamSubscriber) -> Optional[dict]:
         updated, removed, first, last, n = self._merge_queued(sub)
         self._check_monotone(sub, last.seq, snapshot=False)
         from_seq = sub.cursor_seq
         sub.cursor_seq = last.seq
-        if sub.feed.kind == "whatif":
-            scenario = updated.get(("scenario",))
-            if scenario is None:
-                return None
-            body: Dict[str, Any] = {"scenario": scenario}
+        self._emission_entry = None
+        if n == 1 and not sub.prefix_filters:
+            # the shared fan-out fast path: a single-window unfiltered
+            # delta's body is rendered ONCE per entry and shared by
+            # reference across every such subscriber (PR-13 remnant (b))
+            if last.rendered_body is None:
+                last.rendered_body = self._body_for(
+                    sub.feed.kind, last.updated, last.removed, None
+                )
+                self.counters.bump("streaming.rendered_payloads")
+            else:
+                self.counters.bump("streaming.shared_payloads")
+            body = last.rendered_body
+            self._emission_entry = last
         else:
-            u_up = [
-                row
-                for k, row in sorted(updated.items())
-                if k[0] == "u" and sub.wants(k[1])
-            ]
-            u_rm = sorted(
-                k[1] for k in removed if k[0] == "u" and sub.wants(k[1])
-            )
-            m_up = [
-                row for k, row in sorted(updated.items()) if k[0] == "m"
-            ]
-            m_rm = sorted(k[1] for k in removed if k[0] == "m")
-            if not (u_up or u_rm or m_up or m_rm):
+            body = self._body_for(sub.feed.kind, updated, removed, sub)
+            if body is not None:
+                self.counters.bump("streaming.rendered_payloads")
+        if body is None:
+            if sub.feed.kind != "whatif":
                 self.counters.bump("streaming.filtered_empty")
-                return None
-            body = {
-                "unicast_updated": u_up,
-                "unicast_removed": u_rm,
-                "mpls_updated": m_up,
-                "mpls_removed": m_rm,
-            }
+            return None
         staleness_ms = (self.clock.now() - first.t_mint) * 1000.0
         self.counters.observe("streaming.staleness_ms", staleness_ms)
         if n > 1:
@@ -605,10 +721,42 @@ class StreamingService(Actor):
             **body,
         }
 
+    def _encode_emission(self, emission: dict) -> bytes:
+        """Canonical JSON bytes for the emission minted LAST (wire push
+        path).  Delta bodies from the shared fast path are encoded at
+        most once per feed entry; the per-subscriber cost is the
+        envelope fragment plus a byte splice.  The spliced bytes parse
+        back to exactly the emission dict (fragment key order differs
+        from a whole-document sort; JSON object key order carries no
+        meaning on this wire)."""
+        entry = self._emission_entry
+        if (
+            emission.get("type") == "delta"
+            and entry is not None
+            and entry.rendered_body is not None
+        ):
+            if entry.encoded_body is None:
+                entry.encoded_body = canonical_wire(entry.rendered_body)[
+                    1:-1
+                ]
+                self.counters.bump("streaming.wire.body_encodes")
+            else:
+                self.counters.bump("streaming.wire.shared_encodes")
+            env = {
+                k: v for k, v in emission.items() if k not in _BODY_FIELDS
+            }
+            env_b = canonical_wire(env)
+            if entry.encoded_body:
+                return env_b[:-1] + b"," + entry.encoded_body + b"}"
+            return env_b
+        self.counters.bump("streaming.wire.full_encodes")
+        return canonical_wire(emission)
+
     def _next_emission_now(self, sub: StreamSubscriber) -> Optional[dict]:
         """The synchronous drain step: snapshot (first contact or
         resync), else the merged delta, else None (nothing pending)."""
         sub.last_live_t = self.clock.now()
+        self._emission_entry = None
         emission = None
         if sub.cursor_seq < 0:
             emission = self._emit_snapshot(sub, "subscribe")

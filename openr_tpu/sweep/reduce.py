@@ -1,0 +1,178 @@
+"""Online ranked-risk reducer — the sweep's summary in bounded memory.
+
+Rows stream in shard by shard; the reducer keeps only:
+
+* per-link criticality aggregates (O(links): worst/total routes
+  withdrawn, scenario counts) for the criticality ranking;
+* the SPOF set (links whose SINGLE failure withdraws at least one
+  route in ANY world — the classic single-point-of-failure list);
+* a bounded top-K worst-scenario table (worst-case reachability loss);
+* per-world and whole-sweep tallies.
+
+Every ranking is deterministically tie-broken (count desc, then link /
+hash asc), and the summary is pure row content — no clocks, no ids —
+so an uninterrupted run and a kill-and-resume run produce byte-equal
+summaries, which the resume tests and the bench assert via
+``summary_digest``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional
+
+from openr_tpu.sweep.scenario import canonical_json
+
+
+def _link_key(pair) -> str:
+    return "|".join(sorted(map(str, pair)))
+
+
+class SweepReducer:
+    def __init__(self, top_k: int = 64) -> None:
+        self.top_k = top_k
+        self.scenarios = 0
+        self.zero_delta = 0
+        self.error_rows = 0
+        self.device_rows = 0
+        self.alias_rows = 0
+        self.total_withdrawn = 0
+        self.total_changed = 0
+        self.by_world: Dict[str, dict] = {}
+        #: link key -> aggregates (bounded by the link universe)
+        self.links: Dict[str, dict] = {}
+        #: link keys whose single-link failure withdrew routes
+        self.spof: set = set()
+        #: bounded worst-scenario table entries:
+        #: (withdrawn, changed, hash, world, failure)
+        self._worst: List[tuple] = []
+
+    # -- feeding -----------------------------------------------------------
+
+    def feed(self, rows: List[dict]) -> None:
+        for row in rows:
+            self._feed_one(row)
+
+    def _feed_one(self, row: dict) -> None:
+        self.scenarios += 1
+        world = row.get("world", "-")
+        w = self.by_world.setdefault(
+            world,
+            {"scenarios": 0, "withdrawn": 0, "changed": 0, "worst": 0},
+        )
+        w["scenarios"] += 1
+        if row.get("solve") == "error":
+            self.error_rows += 1
+            return
+        if row.get("solve") == "alias":
+            self.alias_rows += 1
+        else:
+            self.device_rows += 1
+        withdrawn = int(row.get("withdrawn", 0))
+        changed = int(row.get("changed", 0))
+        if changed == 0:
+            self.zero_delta += 1
+        self.total_withdrawn += withdrawn
+        self.total_changed += changed
+        w["withdrawn"] += withdrawn
+        w["changed"] += changed
+        w["worst"] = max(w["worst"], withdrawn)
+        failure = row.get("failure", [])
+        single = len(failure) == 1 and not row.get("domains")
+        for pair in failure:
+            key = _link_key(pair)
+            agg = self.links.setdefault(
+                key,
+                {
+                    "scenarios": 0,
+                    "worst_withdrawn": 0,
+                    "total_withdrawn": 0,
+                    "single_withdrawn": 0,
+                },
+            )
+            agg["scenarios"] += 1
+            agg["total_withdrawn"] += withdrawn
+            agg["worst_withdrawn"] = max(agg["worst_withdrawn"], withdrawn)
+            if single:
+                agg["single_withdrawn"] = max(
+                    agg["single_withdrawn"], withdrawn
+                )
+        if single and withdrawn > 0:
+            self.spof.add(_link_key(failure[0]))
+        if withdrawn > 0 or changed > 0:
+            self._note_worst(
+                (
+                    -withdrawn,
+                    -changed,
+                    row.get("hash", ""),
+                    world,
+                    [list(p) for p in failure],
+                )
+            )
+
+    def _note_worst(self, entry: tuple) -> None:
+        # small K: insertion into a sorted list beats a heap with
+        # deterministic tie-breaking for free
+        self._worst.append(entry)
+        self._worst.sort()
+        del self._worst[self.top_k :]
+
+    # -- the ranked summary ------------------------------------------------
+
+    def summary(self) -> dict:
+        ranking = sorted(
+            self.links.items(),
+            key=lambda kv: (
+                -kv[1]["worst_withdrawn"],
+                -kv[1]["total_withdrawn"],
+                kv[0],
+            ),
+        )[: self.top_k]
+        worst = [
+            {
+                "withdrawn": -e[0],
+                "changed": -e[1],
+                "hash": e[2],
+                "world": e[3],
+                "failure": e[4],
+            }
+            for e in self._worst
+        ]
+        return {
+            "scenarios": self.scenarios,
+            "zero_delta": self.zero_delta,
+            "error_rows": self.error_rows,
+            "device_rows": self.device_rows,
+            "alias_rows": self.alias_rows,
+            "total_withdrawn": self.total_withdrawn,
+            "total_changed": self.total_changed,
+            "worst_case": (worst[0] if worst else None),
+            "worst_scenarios": worst,
+            "spof_links": sorted(self.spof),
+            "criticality": [
+                {"link": k.split("|"), **v} for k, v in ranking
+            ],
+            "worlds": {
+                k: dict(v) for k, v in sorted(self.by_world.items())
+            },
+        }
+
+    def summary_digest(self) -> str:
+        """sha256 of the canonical summary — the byte-identity handle
+        the resume proof compares."""
+        return hashlib.sha256(
+            canonical_json(self.summary()).encode()
+        ).hexdigest()
+
+
+def replay_reducer(
+    reader, completed: set, top_k: int = 64
+) -> Optional[SweepReducer]:
+    """Rebuild a reducer from the spill's COMMITTED shards (the resume
+    path: one streaming pass, bounded memory).  Returns the reducer and
+    relies on the caller to verify replayed row counts against the
+    checkpoint manifest."""
+    red = SweepReducer(top_k=top_k)
+    for row in reader.rows(shard_filter=completed):
+        red._feed_one(row)
+    return red

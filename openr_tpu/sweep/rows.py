@@ -1,0 +1,75 @@
+"""Scenario row model — ONE spelling for what-if result rows.
+
+A what-if answer is a list of per-failure entries (``failures``) plus
+answer-level metadata.  Historically the streaming watch plane treated
+the WHOLE answer as one opaque row, so any change re-emitted the full
+scenario result to every subscriber (ROADMAP PR-13 remnant (a)).  The
+sweep plane needs the same decomposition to spill and diff per-scenario
+results, so the row model lives here and both consume it:
+
+* ``scenario_rows(result)`` — explode a what-if answer into a keyed row
+  map: one row per failure entry (keyed by its link pair / link set)
+  plus one ``meta`` row for the answer-level fields;
+* ``diff_scenario_rows(old, new)`` — the row differ: (updated keys ->
+  row, removed keys);
+* ``scenario_row_key(entry)`` — the stable per-entry key.
+
+The streaming tier's what-if feeds emit only the rows this differ
+reports changed; capacity dashboards watching a running sweep through
+``StreamingService`` therefore receive per-scenario-row deltas instead
+of whole-result re-emissions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+#: key namespace for scenario rows inside a feed's row map (the
+#: streaming tier prefixes unicast rows "u" and mpls rows "m")
+SCENARIO_ROW = "w"
+SCENARIO_META = "wmeta"
+
+
+def scenario_row_key(entry: dict) -> str:
+    """Stable content key for one per-failure entry: the sorted link
+    pair (single failures / error rows) or the sorted pair list
+    (simultaneous sets)."""
+    if "link" in entry:
+        return "|".join(sorted(map(str, entry["link"])))
+    if "links" in entry:
+        return ";".join(
+            sorted("|".join(sorted(map(str, p))) for p in entry["links"])
+        )
+    return "?"
+
+
+def scenario_rows(result: Any) -> Dict[tuple, Any]:
+    """Explode a what-if answer into the keyed row map the differ (and
+    the streaming feed base) consumes.  Non-dict or failure-less
+    answers collapse to a single meta row, so degraded answers still
+    stream coherently."""
+    if not isinstance(result, dict):
+        return {(SCENARIO_META,): result}
+    rows: Dict[tuple, Any] = {}
+    meta = {k: v for k, v in result.items() if k != "failures"}
+    rows[(SCENARIO_META,)] = meta
+    for entry in result.get("failures", []) or []:
+        if isinstance(entry, dict):
+            rows[(SCENARIO_ROW, scenario_row_key(entry))] = entry
+    return rows
+
+
+def diff_scenario_rows(
+    old: Dict[tuple, Any], new: Dict[tuple, Any]
+) -> Tuple[Dict[tuple, Any], set]:
+    """(updated, removed) between two keyed row maps — the shared row
+    differ (streaming publish ticks and sweep status feeds)."""
+    updated: Dict[tuple, Any] = {}
+    removed: set = set()
+    for k, row in new.items():
+        if old.get(k) != row:
+            updated[k] = row
+    for k in old:
+        if k not in new:
+            removed.add(k)
+    return updated, removed

@@ -1,0 +1,297 @@
+"""Declarative scenario grammar for capacity-planning sweeps.
+
+A *scenario* is one counterfactual world the sweep solves: a set of
+simultaneous link failures, evaluated under a *world variant* — a
+drain-state assignment (nodes taken out of transit, the maintenance
+shape) crossed with a metric perturbation (links whose metrics are
+scaled, the cost-out shape).  The grammar enumerates the classic
+capacity-planning cross product:
+
+    (all single-link failures  +  bounded k-failure-domain combos)
+        x  drain states  x  metric perturbations
+
+Identity is **content-addressed**: every scenario's hash is the sha256
+of its canonical JSON content (node NAMES and link PAIRS, never slot or
+link ids), so two enumerations of the same grammar over the same LSDB
+produce the same scenario set whatever order they walked it in — the
+executor sorts by ``(world key, hash)`` and shards contiguously, which
+is what makes a checkpointed sweep resumable and its ranked summary
+byte-reproducible.
+
+k-failure-domain combinations treat each NODE as a failure domain (its
+incident links fail together — the node-failure shape); the explicit
+bound draws a deterministic seeded sample over the sorted domain
+universe, so the combination subset is a pure function of
+``(domains, k, bound, seed)`` and never of enumeration order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import json
+import random
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def canonical_json(doc) -> str:
+    """THE canonical encoding for everything the sweep hashes or spills
+    (sorted keys, no whitespace): two runs agree byte for byte or not
+    at all."""
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+def content_hash(doc) -> str:
+    return hashlib.sha256(canonical_json(doc).encode()).hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class World:
+    """One (drain state, metric perturbation) variant the scenario's
+    failures are evaluated under."""
+
+    #: node names taken out of transit (hard drain), sorted
+    drained_nodes: Tuple[str, ...] = ()
+    #: (pattern, factor): metrics of links whose BOTH endpoints
+    #: full-match the regex are scaled by factor; None = identity
+    metric: Optional[Tuple[str, float]] = None
+
+    def content(self) -> dict:
+        return {
+            "drained_nodes": list(self.drained_nodes),
+            "metric": (
+                None
+                if self.metric is None
+                else {"pattern": self.metric[0], "factor": self.metric[1]}
+            ),
+        }
+
+    def key(self) -> str:
+        """Stable world label (groups scenarios for shard packing and
+        the per-world summary rollup)."""
+        drain = ",".join(self.drained_nodes) or "-"
+        if self.metric is None:
+            metric = "-"
+        else:
+            metric = f"{self.metric[0]}x{self.metric[1]:g}"
+        return f"drain[{drain}]|metric[{metric}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One content-addressed counterfactual."""
+
+    world: World
+    #: failed links as sorted (n1, n2) name pairs, sorted
+    failed_links: Tuple[Tuple[str, str], ...]
+    #: failure domains (node names) this scenario is the combination
+    #: of; empty for plain link-failure scenarios
+    domains: Tuple[str, ...] = ()
+
+    def content(self) -> dict:
+        return {
+            "world": self.world.content(),
+            "failed_links": [list(p) for p in self.failed_links],
+            "domains": list(self.domains),
+        }
+
+    @property
+    def hash(self) -> str:
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = content_hash(self.content())
+            # frozen dataclass: route around __setattr__ for the memo
+            object.__setattr__(self, "_hash", h)
+        return h
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """The declarative grammar (config defaults live in
+    ``sweep_config``; ``start_sweep`` params override per sweep)."""
+
+    #: enumerate every single-link failure per world
+    single_link_failures: bool = True
+    #: failure-domain combination order (nodes as domains); < 2 = off
+    combo_k: int = 0
+    #: explicit bound on enumerated k-combinations per world (0 = none
+    #: even when combo_k >= 2 — the bound is mandatory by construction)
+    max_combo_scenarios: int = 0
+    #: seeds the deterministic combination draw
+    combo_seed: int = 0
+    #: drain-state variants; the identity (no drain) world must be
+    #: listed explicitly if wanted — the default is identity only
+    drain_node_sets: Tuple[Tuple[str, ...], ...] = ((),)
+    #: metric perturbation variants as (pattern, factor); the identity
+    #: variant is always included
+    metric_perturbations: Tuple[Tuple[str, float], ...] = ()
+
+    def content(self) -> dict:
+        return {
+            "single_link_failures": self.single_link_failures,
+            "combo_k": self.combo_k,
+            "max_combo_scenarios": self.max_combo_scenarios,
+            "combo_seed": self.combo_seed,
+            "drain_node_sets": [list(s) for s in self.drain_node_sets],
+            "metric_perturbations": [
+                {"pattern": p, "factor": f}
+                for p, f in self.metric_perturbations
+            ],
+        }
+
+    @classmethod
+    def from_params(cls, config, params: Optional[dict]) -> "ScenarioSpec":
+        """Spec from the ``sweep_config`` defaults overridden by a
+        ``start_sweep`` params dict (the ctrl/CLI surface)."""
+        params = dict(params or {})
+        drain = params.get(
+            "drain_node_sets",
+            [list(s) for s in getattr(config, "drain_node_sets", [[]])],
+        )
+        metric = params.get("metric_perturbations")
+        if metric is None:
+            metric = [
+                {"pattern": m.pattern, "factor": m.factor}
+                for m in getattr(config, "metric_perturbations", [])
+            ]
+        return cls(
+            single_link_failures=bool(
+                params.get("single_link_failures", True)
+            ),
+            combo_k=int(params.get("combo_k", config.combo_k)),
+            max_combo_scenarios=int(
+                params.get(
+                    "max_combo_scenarios", config.max_combo_scenarios
+                )
+            ),
+            combo_seed=int(params.get("combo_seed", 0)),
+            drain_node_sets=tuple(
+                tuple(sorted(set(map(str, s)))) for s in drain
+            )
+            or ((),),
+            metric_perturbations=tuple(
+                (str(m["pattern"]), float(m["factor"])) for m in metric
+            ),
+        )
+
+
+def worlds_of(spec: ScenarioSpec) -> List[World]:
+    """The world variants, in deterministic grammar order (drain outer,
+    metric inner; identity metric first)."""
+    metrics: List[Optional[Tuple[str, float]]] = [None]
+    metrics += [m for m in spec.metric_perturbations]
+    out: List[World] = []
+    for drain in spec.drain_node_sets:
+        for metric in metrics:
+            out.append(World(tuple(sorted(drain)), metric))
+    return out
+
+
+def _sorted_pairs(pairs: Sequence[Tuple[str, str]]) -> List[Tuple[str, str]]:
+    return sorted(tuple(sorted(p)) for p in pairs)
+
+
+def enumerate_scenarios(
+    spec: ScenarioSpec,
+    link_pairs: Sequence[Tuple[str, str]],
+    node_links: Optional[Dict[str, Sequence[Tuple[str, str]]]] = None,
+) -> List[Scenario]:
+    """Deterministic enumeration over the live LSDB's link pairs.
+
+    ``link_pairs``: the (n1, n2) node pairs carrying at least one link.
+    ``node_links``: node -> incident pairs (the failure-domain map);
+    derived from ``link_pairs`` when omitted.  The result is sorted by
+    ``(world key, scenario hash)`` — the canonical execution order."""
+    pairs = _sorted_pairs(set(tuple(sorted(p)) for p in link_pairs))
+    if node_links is None:
+        node_links = {}
+        for a, b in pairs:
+            node_links.setdefault(a, []).append((a, b))
+            node_links.setdefault(b, []).append((a, b))
+    out: List[Scenario] = []
+    for world in worlds_of(spec):
+        if spec.single_link_failures:
+            for p in pairs:
+                out.append(Scenario(world, (p,)))
+        if spec.combo_k >= 2 and spec.max_combo_scenarios > 0:
+            domains = sorted(node_links)
+            combos = _draw_combos(
+                domains,
+                spec.combo_k,
+                spec.max_combo_scenarios,
+                spec.combo_seed,
+            )
+            for combo in combos:
+                failed = set()
+                for n in combo:
+                    failed.update(
+                        tuple(sorted(p)) for p in node_links[n]
+                    )
+                if not failed:
+                    continue
+                out.append(
+                    Scenario(
+                        world,
+                        tuple(sorted(failed)),
+                        domains=tuple(combo),
+                    )
+                )
+    out.sort(key=lambda s: (s.world.key(), s.hash))
+    return out
+
+
+def _draw_combos(
+    domains: List[str], k: int, bound: int, seed: int
+) -> List[Tuple[str, ...]]:
+    """A deterministic sample of at most ``bound`` k-combinations over
+    the SORTED domain list: exhaustive when the universe fits the
+    bound, else a seeded draw — a pure function of (domains, k, bound,
+    seed), independent of any enumeration order."""
+    n = len(domains)
+    if n < k:
+        return []
+    total = 1
+    for i in range(k):
+        total = total * (n - i) // (i + 1)
+    if total <= bound:
+        return [tuple(c) for c in itertools.combinations(domains, k)]
+    rng = random.Random(
+        int.from_bytes(
+            hashlib.sha256(
+                canonical_json([domains, k, bound, seed]).encode()
+            ).digest()[:8],
+            "big",
+        )
+    )
+    seen = set()
+    out: List[Tuple[str, ...]] = []
+    # rejection draw: k distinct indices per combo; the universe is
+    # far larger than the bound here, so collisions are rare
+    while len(out) < bound:
+        combo = tuple(sorted(rng.sample(range(n), k)))
+        if combo in seen:
+            continue
+        seen.add(combo)
+        out.append(tuple(domains[i] for i in combo))
+    out.sort()
+    return out
+
+
+def scenario_set_hash(spec: ScenarioSpec, scenarios: List[Scenario]) -> str:
+    """Content address of the WHOLE sweep: the grammar plus every
+    scenario hash in canonical order.  The checkpoint manifest pins it,
+    so a resume against a drifted grammar or LSDB is refused instead of
+    silently mixing two different sweeps' rows."""
+    h = hashlib.sha256()
+    h.update(canonical_json(spec.content()).encode())
+    for s in scenarios:
+        h.update(s.hash.encode())
+    return h.hexdigest()
+
+
+def metric_matcher(pattern: str):
+    """Compiled full-match predicate over a link's endpoint pair."""
+    rx = re.compile(pattern)
+    return lambda a, b: rx.fullmatch(a) is not None and rx.fullmatch(b) is not None

@@ -1,0 +1,181 @@
+"""SweepService — the capacity-planning sweep as a daemon actor.
+
+One sweep at a time per node: ``start_sweep`` enumerates the grammar
+(config defaults overridden by the request params), prepares or resumes
+the checkpointed executor, and runs it on a background fiber that
+yields to the loop between shard commits — the daemon keeps serving
+routes, queries and watches while a 100k-scenario sweep grinds through
+the DevicePool.  ``get_sweep_status`` / ``get_sweep_summary`` read the
+live executor; ``cancel_sweep`` stops at the next shard boundary
+(committed shards stay durable, so a cancelled sweep resumes exactly
+like a killed one).
+
+Surfaces: ctrl verbs ``start_sweep`` / ``get_sweep_status`` /
+``get_sweep_summary`` / ``cancel_sweep``; ``breeze sweep
+run|status|summary|cancel``; ``sweep.*`` counters and the
+``sweep.shard_solve_ms`` / ``sweep.reduce_ms`` histograms on the node
+CounterMap, plus the ``pipeline.sweep_shard_solve`` /
+``pipeline.sweep_reduce`` phase attribution on the backend's shared
+PipelineProbe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.sweep.executor import SweepError, SweepExecutor, SweepInputs
+from openr_tpu.sweep.scenario import ScenarioSpec
+
+
+class SweepService(Actor):
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config,
+        decision,
+        counters: Optional[CounterMap] = None,
+        tracer=None,
+    ) -> None:
+        super().__init__("sweep", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.node_name = node_name
+        self.config = config
+        self.decision = decision
+        self.tracer = tracer if tracer is not None else disabled_tracer()
+        self.executor: Optional[SweepExecutor] = None
+        self.state = "idle"  # idle|running|done|failed|cancelled
+        self.error = ""
+        self._run_task = None
+        self.num_sweeps_started = 0
+
+    # -- inputs ------------------------------------------------------------
+
+    def _inputs(self) -> SweepInputs:
+        return SweepInputs(**self.decision.capacity_sweep_inputs())
+
+    def _spill_dir(self) -> str:
+        base = self.config.spill_dir
+        if base:
+            return base
+        # node-scoped default, same discipline as the persistent store:
+        # two daemons must never interleave one spill directory
+        return f"/tmp/openr_tpu_sweep.{self.node_name}"
+
+    # -- ctrl verbs ---------------------------------------------------------
+
+    def start_sweep(self, params: Optional[dict] = None) -> dict:
+        """Prepare (or resume) and launch one sweep.  Raises SweepError
+        while another sweep is running, or when the grammar/vantage is
+        unusable."""
+        if self.state == "running":
+            raise SweepError(
+                f"sweep {self.executor.sweep_id} is already running"
+            )
+        params = dict(params or {})
+        spec = ScenarioSpec.from_params(self.config, params)
+        ex = SweepExecutor(
+            self._inputs,
+            str(params.get("spill_dir") or self._spill_dir()),
+            clock=self.clock,
+            counters=self.counters,
+            shard_scenarios=int(
+                params.get("shard_scenarios", self.config.shard_scenarios)
+            ),
+            segment_rows=self.config.spill_segment_rows,
+            top_k=self.config.summary_top_k,
+            inflight=self.config.inflight_shards,
+        )
+        report = ex.prepare(spec, resume=bool(params.get("resume", True)))
+        self.executor = ex
+        self.state = "running"
+        self.error = ""
+        self.num_sweeps_started += 1
+        self.counters.bump("sweep.sweeps_started")
+        self.tracer.instant(
+            "sweep.start", None, module="sweep",
+            sweep_id=ex.sweep_id, scenarios=len(ex.scenarios),
+        )
+        self._run_task = self.spawn(self._run(ex), name="sweep.run")
+        return {**report, "state": self.state}
+
+    async def _run(self, ex: SweepExecutor) -> None:
+        span = self.tracer.start_span(
+            "sweep.run", None, module="sweep", sweep_id=ex.sweep_id
+        )
+        loop_clock = self.clock
+
+        # run() is synchronous compute; the yield callback can't await,
+        # so shard boundaries hand control back by running the executor
+        # in steps from this fiber instead
+        try:
+            while not ex.cancelled and ex.pending_shards():
+                ex.run(stop_after_shards=1)
+                self.touch()
+                # a small breather per committed shard: the daemon's
+                # other actors (and chaos, in SimClock runs) interleave
+                # with a long sweep instead of starving behind it
+                await loop_clock.sleep(
+                    self.config.inter_shard_pause_s
+                )
+            self.state = "cancelled" if ex.cancelled else "done"
+            if ex.cancelled:
+                self.counters.bump("sweep.sweeps_cancelled")
+            else:
+                self.counters.bump("sweep.sweeps_completed")
+        except SweepError as e:
+            self.state = "failed"
+            self.error = str(e)
+            self.counters.bump("sweep.sweeps_failed")
+        finally:
+            self.tracer.end_span(span, state=self.state)
+
+    def get_sweep_status(self) -> dict:
+        out: Dict[str, Any] = {
+            "node": self.node_name,
+            "state": self.state,
+            "error": self.error,
+            "sweeps_started": self.num_sweeps_started,
+        }
+        if self.executor is not None:
+            out.update(self.executor.status())
+        return out
+
+    def get_sweep_summary(self) -> dict:
+        if self.executor is None:
+            return {
+                "node": self.node_name,
+                "state": self.state,
+                "complete": False,
+                "summary": None,
+            }
+        return {
+            "node": self.node_name,
+            "state": self.state,
+            **self.executor.summary(),
+        }
+
+    def cancel_sweep(self) -> dict:
+        if self.executor is not None and self.state == "running":
+            self.executor.cancelled = True
+        return {"node": self.node_name, "state": self.state}
+
+    # -- observability -------------------------------------------------------
+
+    def gauges(self) -> Dict[str, float]:
+        ex = self.executor
+        return {
+            "sweep.running": 1.0 if self.state == "running" else 0.0,
+            "sweep.scenarios_total": float(
+                len(ex.scenarios) if ex is not None else 0
+            ),
+            "sweep.scenarios_done": float(
+                ex.reducer.scenarios if ex is not None else 0
+            ),
+            "sweep.shards_done": float(
+                len(ex.completed) if ex is not None else 0
+            ),
+            "sweep.sweeps_started": float(self.num_sweeps_started),
+        }

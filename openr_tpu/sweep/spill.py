@@ -1,0 +1,265 @@
+"""Bounded result spill + the resumable checkpoint manifest.
+
+A 100k-scenario sweep produces result rows that must never be
+host-resident in bulk (millions of rows at fleet scale).  Rows stream
+into JSONL *segments* under the sweep's spill directory, rotated every
+``segment_rows`` rows, with an ``index.json`` describing every SEALED
+segment (row count + sha256).  The online reducer consumes rows as they
+are produced; nothing re-reads the spill on the happy path.
+
+**Checkpoint commit ordering** (the resume invariant, enforced here and
+documented in docs/Developer_Guide.md): a shard is only recorded in
+``checkpoint.json`` after its rows are durably in the spill (written,
+flushed, fsynced).  Both the index and the checkpoint are replaced
+atomically (tmp + rename).  A killed sweep therefore resumes from the
+last COMMITTED shard: rows of a half-written shard may exist in the
+spill, but they are filtered out on resume because every row carries
+its shard id and only committed shard ids are replayed.
+
+Only this package mutates spill/checkpoint state — orlint's
+``sweep-spill-ownership`` rule enforces it statically (the mutators are
+``spill_rows`` / ``seal`` / ``commit_shard`` / ``reset``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterator, List, Optional
+
+from openr_tpu.sweep.scenario import canonical_json
+
+INDEX_NAME = "index.json"
+CHECKPOINT_NAME = "checkpoint.json"
+SEGMENT_FMT = "rows-{:05d}.jsonl"
+
+
+def _atomic_write(path: str, text: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class SpillWriter:
+    """Append-only JSONL segment writer with an atomic index."""
+
+    def __init__(self, directory: str, segment_rows: int = 8192) -> None:
+        if segment_rows < 1:
+            raise ValueError("segment_rows must be >= 1")
+        self.directory = directory
+        self.segment_rows = segment_rows
+        os.makedirs(directory, exist_ok=True)
+        self._segments: List[dict] = []
+        self._seg_index = 0
+        self._seg_rows = 0
+        self._seg_hash = hashlib.sha256()
+        self._seg_file = None
+        self.rows_written = 0
+        self.bytes_written = 0
+        #: high-watermark of rows held in host memory at once (one
+        #: shard's batch) — the bench records it to prove the spill
+        #: keeps the sweep out of host-resident-rows territory
+        self.peak_host_rows = 0
+        self._load_index()
+
+    # -- index -------------------------------------------------------------
+
+    def _index_path(self) -> str:
+        return os.path.join(self.directory, INDEX_NAME)
+
+    def _load_index(self) -> None:
+        try:
+            with open(self._index_path()) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        self._segments = list(doc.get("segments", []))
+        self._seg_index = len(self._segments)
+        self.rows_written = sum(s["rows"] for s in self._segments)
+        self.bytes_written = sum(s["bytes"] for s in self._segments)
+
+    def _write_index(self) -> None:
+        _atomic_write(
+            self._index_path(),
+            canonical_json(
+                {
+                    "segments": self._segments,
+                    "segment_rows": self.segment_rows,
+                }
+            ),
+        )
+
+    # -- mutators (sweep-package-owned; orlint sweep-spill-ownership) ------
+
+    def spill_rows(self, rows: List[dict]) -> None:
+        """Append one shard's rows (canonical JSONL), rotating segments
+        at the row bound; flush + fsync before returning so a
+        subsequent checkpoint commit never references volatile rows."""
+        self.peak_host_rows = max(self.peak_host_rows, len(rows))
+        for row in rows:
+            if self._seg_file is None:
+                self._open_segment()
+            line = canonical_json(row) + "\n"
+            data = line.encode()
+            self._seg_file.write(line)
+            self._seg_hash.update(data)
+            self._seg_rows += 1
+            self.rows_written += 1
+            self.bytes_written += len(data)
+            if self._seg_rows >= self.segment_rows:
+                self.seal()
+        if self._seg_file is not None:
+            self._seg_file.flush()
+            os.fsync(self._seg_file.fileno())
+
+    def _open_segment(self) -> None:
+        name = SEGMENT_FMT.format(self._seg_index)
+        self._seg_name = name
+        self._seg_file = open(os.path.join(self.directory, name), "w")
+        self._seg_rows = 0
+        self._seg_hash = hashlib.sha256()
+
+    def seal(self) -> None:
+        """Close the open segment and record it in the index."""
+        if self._seg_file is None:
+            return
+        self._seg_file.flush()
+        os.fsync(self._seg_file.fileno())
+        self._seg_file.close()
+        self._segments.append(
+            {
+                "name": self._seg_name,
+                "rows": self._seg_rows,
+                "bytes": os.path.getsize(
+                    os.path.join(self.directory, self._seg_name)
+                ),
+                "sha256": self._seg_hash.hexdigest(),
+            }
+        )
+        self._seg_file = None
+        self._seg_index += 1
+        self._seg_rows = 0
+        self._write_index()
+
+    def close(self) -> None:
+        self.seal()
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "rows": self.rows_written,
+            "bytes": self.bytes_written,
+            "segments_sealed": len(self._segments),
+            "open_segment_rows": self._seg_rows,
+            "peak_host_rows": self.peak_host_rows,
+        }
+
+
+class SpillReader:
+    """Stream rows back out of a spill directory (resume replay and the
+    summary/offline analysis path) — one row at a time, never a bulk
+    load."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+
+    def segment_names(self) -> List[str]:
+        sealed = []
+        try:
+            with open(os.path.join(self.directory, INDEX_NAME)) as f:
+                sealed = [s["name"] for s in json.load(f)["segments"]]
+        except (OSError, ValueError, KeyError):
+            pass
+        # the open (unsealed) segment, if any, sorts after the sealed
+        # ones by construction of the name format
+        try:
+            names = sorted(
+                n
+                for n in os.listdir(self.directory)
+                if n.startswith("rows-") and n.endswith(".jsonl")
+            )
+        except OSError:
+            names = []
+        return sealed + [n for n in names if n not in sealed]
+
+    def rows(self, shard_filter=None) -> Iterator[dict]:
+        """Yield rows, optionally filtered to a set of shard ids (the
+        resume replay reads only COMMITTED shards' rows)."""
+        for name in self.segment_names():
+            try:
+                f = open(os.path.join(self.directory, name))
+            except OSError:
+                continue
+            with f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail of a killed run
+                    if (
+                        shard_filter is not None
+                        and row.get("shard") not in shard_filter
+                    ):
+                        continue
+                    yield row
+
+
+class CheckpointManifest:
+    """The committed-shard ledger a killed sweep resumes from."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.doc: Optional[dict] = None
+        self._load()
+
+    def _path(self) -> str:
+        return os.path.join(self.directory, CHECKPOINT_NAME)
+
+    def _load(self) -> None:
+        try:
+            with open(self._path()) as f:
+                self.doc = json.load(f)
+        except (OSError, ValueError):
+            self.doc = None
+
+    # -- mutators (sweep-package-owned; orlint sweep-spill-ownership) ------
+
+    def reset(self, sweep_id: str, set_hash: str, spec: dict, total: int) -> None:
+        """Begin a fresh sweep: any prior manifest for a DIFFERENT
+        scenario set is replaced."""
+        self.doc = {
+            "sweep_id": sweep_id,
+            "set_hash": set_hash,
+            "spec": spec,
+            "total_scenarios": total,
+            "shards": {},
+        }
+        _atomic_write(self._path(), canonical_json(self.doc))
+
+    def commit_shard(self, shard_id: int, meta: dict) -> None:
+        """Record one COMPLETED shard.  Callers must have spilled the
+        shard's rows (flushed + fsynced) first — commit ordering is the
+        resume invariant."""
+        if self.doc is None:
+            raise RuntimeError("commit_shard before reset()")
+        self.doc["shards"][str(shard_id)] = meta
+        _atomic_write(self._path(), canonical_json(self.doc))
+
+    # -- read surface ------------------------------------------------------
+
+    def matches(self, set_hash: str) -> bool:
+        return self.doc is not None and self.doc.get("set_hash") == set_hash
+
+    def completed_shards(self) -> Dict[int, dict]:
+        if self.doc is None:
+            return {}
+        return {int(k): v for k, v in self.doc.get("shards", {}).items()}

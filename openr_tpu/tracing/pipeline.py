@@ -37,6 +37,13 @@ phase              meaning
 ``device_select``  the on-device delta-extraction dispatch: the fused
                    selection+changed-row kernel and the compacted
                    changed-row gather that replaces a full-table fetch
+``sweep_shard_solve``  one committed capacity-sweep shard dispatch: the
+                   warm-repair solve + on-device selection of a
+                   scenario batch on its assigned chip
+                   (openr_tpu.sweep.executor); device-attributed
+``sweep_reduce``   the sweep's host tail per committed shard: spill
+                   append + checkpoint commit + the online ranked
+                   reducer
 =================  ========================================================
 
 Surfaces: every phase sample lands in a ``pipeline.{phase}.ms``
@@ -70,6 +77,8 @@ WARM_PLAN = "warm_plan"
 WARM_REPAIR = "warm_repair"
 STREAM_DRAIN = "stream_drain"
 DEVICE_SELECT = "device_select"
+SWEEP_SHARD_SOLVE = "sweep_shard_solve"
+SWEEP_REDUCE = "sweep_reduce"
 
 PHASES = (
     HOST_FETCH,
@@ -84,6 +93,8 @@ PHASES = (
     WARM_REPAIR,
     STREAM_DRAIN,
     DEVICE_SELECT,
+    SWEEP_SHARD_SOLVE,
+    SWEEP_REDUCE,
 )
 
 #: phases only the warm-start generation-delta rebuild exercises — a
@@ -96,12 +107,25 @@ WARM_PHASES = (WARM_PLAN, WARM_REPAIR)
 #: purged) fetches full tables and legitimately records nothing here
 DELTA_PHASES = (DEVICE_SELECT,)
 
+#: phases only the capacity-sweep orchestrator exercises
+#: (openr_tpu.sweep) — route-build lifecycles record nothing here, so
+#: bench attribution gates treat them as optional coverage too
+SWEEP_PHASES = (SWEEP_SHARD_SOLVE, SWEEP_REDUCE)
+
 #: phases whose time is host-side work (the pipelining refactor's
 #: overlap candidates) vs the device round trip — the host/device split
 #: BENCH_PIPELINE reports.  ``stream_drain`` counts as device time: it
 #: is the host blocked on one chip's in-flight shard (the streamed
 #: replacement for the old all-shard device_get barrier).
-HOST_PHASES = (HOST_FETCH, ENCODE, PAD_PACK, DECODE, DELTA_EXTRACT, WARM_PLAN)
+HOST_PHASES = (
+    HOST_FETCH,
+    ENCODE,
+    PAD_PACK,
+    DECODE,
+    DELTA_EXTRACT,
+    WARM_PLAN,
+    SWEEP_REDUCE,
+)
 DEVICE_PHASES = (
     TRANSFER,
     DEVICE_COMPUTE,
@@ -109,6 +133,7 @@ DEVICE_PHASES = (
     WARM_REPAIR,
     STREAM_DRAIN,
     DEVICE_SELECT,
+    SWEEP_SHARD_SOLVE,
 )
 
 _PREFIX = "pipeline."

@@ -48,6 +48,10 @@ def pytest_configure(config):
         "markers",
         "streaming: watch-plane test (openr_tpu.serving.streaming)",
     )
+    config.addinivalue_line(
+        "markers",
+        "sweep: capacity-planning sweep test (openr_tpu.sweep)",
+    )
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

@@ -1,0 +1,174 @@
+"""Chaos × capacity sweep: the orchestrator under chip faults (ISSUE 14).
+
+Acceptance: a seeded ``tpu_corrupt(device_index=…)`` landing MID-SWEEP
+quarantines exactly one chip of the victim's pool while the sweep keeps
+going — dispatches re-pack onto the survivors, every scenario completes,
+and the network-level invariants (no blackholes, monotone change_seq)
+hold throughout.
+
+Determinism: the faulted run and a fault-free control run drive the
+IDENTICAL virtual-time schedule (same churn, same link events); only
+the corruption differs.  The sweep's ranked summary must be byte-equal
+across the two — the sweep kernels never consume the corrupted backend
+outputs, and scenario identity is content-addressed, never
+device-addressed, so losing a chip changes WHERE shards solve, not what
+they produce.
+"""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.chaos import ChaosController, FaultPlan, InvariantChecker
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import ParallelConfig, ResilienceConfig
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import grid_edges
+from openr_tpu.types import PrefixEntry
+
+pytestmark = [pytest.mark.chaos, pytest.mark.sweep, pytest.mark.multichip]
+
+SEED = 7
+CONVERGE_S = 18.0
+VICTIM = "node4"
+BAD_CHIP = 3
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        pending = asyncio.all_tasks(loop)
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        loop.close()
+
+
+def overrides(tmp_path):
+    def apply(cfg):
+        cfg.tpu_compute_config.min_device_prefixes = 0  # always device
+        cfg.parallel_config = ParallelConfig(min_shard_rows=0)
+        cfg.resilience_config = ResilienceConfig(
+            shadow_sample_every=2,
+            failure_threshold=2,
+            probe_backoff_initial_s=0.5,
+            probe_backoff_max_s=4.0,
+            jitter_pct=0.1,
+            seed=SEED,
+        )
+        cfg.sweep_config.shard_scenarios = 1
+        # stretch the sweep over ~14 virtual seconds so the corruption,
+        # the detection rebuilds and the quarantine all land MID-sweep
+        cfg.sweep_config.inter_shard_pause_s = 0.8
+        cfg.sweep_config.spill_dir = str(
+            tmp_path / f"sweep.{cfg.node_name}"
+        )
+
+    return apply
+
+
+async def _sweep_under_schedule(tmp_path, inject: bool) -> str:
+    """One seeded scenario (identical schedule either way); returns the
+    final ranked-summary digest."""
+    clock = SimClock()
+    net = EmulatedNetwork(
+        clock, use_tpu_backend=True, config_overrides=overrides(tmp_path)
+    )
+    net.build(grid_edges(3))
+    net.start()
+    await clock.run_for(CONVERGE_S)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    # widen the candidate table so every chip's shard holds rows
+    net.nodes["node0"].advertise_prefixes(
+        [PrefixEntry(f"10.99.{i}.0/24") for i in range(9)]
+    )
+    await clock.run_for(3.0)
+
+    victim = net.nodes[VICTIM]
+    checker = InvariantChecker(net)
+    controller = None
+    if inject:
+        plan = FaultPlan().tpu_corrupt(
+            VICTIM, at=1.0, duration=200.0, device_index=BAD_CHIP
+        )
+        controller = ChaosController(net, plan, seed=SEED)
+        controller.start()
+
+    rep = victim.sweep.start_sweep(
+        {"combo_k": 2, "max_combo_scenarios": 12, "combo_seed": SEED}
+    )
+    assert rep["state"] == "running"
+    assert rep["shards"] == 24, "one scenario per shard spans the fault"
+    await clock.run_for(2.0)
+    assert victim.sweep.state == "running", "the fault must land MID-sweep"
+
+    # the FIXED churn schedule (identical in both runs): link flaps
+    # drive shadow-checked full device rebuilds while the sweep commits
+    # shards — in the faulted run they catch chip 3 lying.  Both links
+    # are restored on the same schedule, so the two runs' sweeps see
+    # the identical topology timeline.
+    for a, b in [("node0", "node1"), ("node1", "node2")]:
+        net.fail_link(a, b)
+        await clock.run_for(2.0)
+        net.restore_link(a, b)
+        await clock.run_for(2.0)
+
+    if inject:
+        gov = victim.decision.backend.governor
+        assert gov.num_shadow_mismatches >= 1, (
+            "shadow verification must catch the corrupted chip"
+        )
+        assert gov.num_chip_quarantines >= 1, "chip 3 must quarantine"
+        pool = victim.decision.backend.dispatch_pool()
+        assert pool.quarantined_indices() == [BAD_CHIP], (
+            "exactly the corrupted chip quarantines"
+        )
+        assert victim.decision.device_available(), (
+            "7 survivors keep the device plane up"
+        )
+        assert victim.sweep.state == "running", (
+            "the quarantine must land while shards are still pending"
+        )
+
+    for _ in range(200):
+        if victim.sweep.state != "running":
+            break
+        await clock.run_for(0.5)
+    assert victim.sweep.state == "done", victim.sweep.error
+    status = victim.sweep.get_sweep_status()
+    assert status["scenarios_completed"] == status["scenarios_total"] == 24
+    assert status["spill"]["rows"] == 24
+    summary = victim.sweep.get_sweep_summary()
+    assert summary["complete"] is True
+
+    if inject:
+        # post-quarantine shards dispatched on survivors only
+        pool = victim.decision.backend.dispatch_pool()
+        assert BAD_CHIP in pool.quarantined_indices()
+
+    # network invariants held through the whole scenario
+    checker.check_change_seq_monotonic()
+    checker.check_no_blackholes()
+    if controller is not None:
+        await controller.stop()
+    digest = summary["summary_digest"]
+    await net.stop()
+    return digest
+
+
+def test_tpu_corrupt_mid_sweep_quarantines_one_chip_sweep_completes(
+    tmp_path,
+):
+    """THE ISSUE-14 chaos acceptance (see module docstring)."""
+    faulted = run(_sweep_under_schedule(tmp_path / "faulted", True))
+    clean = run(_sweep_under_schedule(tmp_path / "clean", False))
+    assert faulted == clean, (
+        "a chip quarantine mid-sweep must change WHERE shards solve, "
+        "never what they produce"
+    )

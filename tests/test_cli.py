@@ -256,6 +256,45 @@ def test_cli_serving_stats_and_queries(live_node):
     assert "serving on node0" in table and "max_batch=64" in table
 
 
+def test_cli_sweep_run_status_summary(live_node):
+    """breeze sweep run/status/summary/cancel against a live node: the
+    capacity-sweep orchestrator runs through the ctrl server and its
+    ranked summary surfaces (ISSUE 14).  The 2-node world has ONE link
+    — a 3-world grammar still proves the end-to-end plumbing."""
+    import time
+
+    rep = json.loads(
+        _run(
+            live_node,
+            "sweep",
+            "run",
+            "--drain", "",
+            "--drain", "node1",
+            "--metric-scale", "node.*:5",
+            "--no-resume",
+        )
+    )
+    assert rep["state"] == "running"
+    assert rep["scenarios"] == 4  # 1 link x (2 drains x 2 metrics)
+    for _ in range(100):
+        st_out = _run(live_node, "sweep", "status")
+        if "done" in st_out.splitlines()[0]:
+            break
+        time.sleep(0.2)
+    assert "scenarios 4/4" in st_out
+    doc = json.loads(_run(live_node, "sweep", "summary", "--json"))
+    assert doc["complete"] is True
+    assert doc["summary"]["scenarios"] == 4
+    # node1 drained: node0's single prefix route to node1 is gone in
+    # that world's base, and failing the only link in the identity
+    # world withdraws it -> the link ranks as a SPOF
+    assert doc["summary"]["spof_links"] == ["node0|node1"]
+    table = _run(live_node, "sweep", "summary")
+    assert "worst case" in table
+    out = json.loads(_run(live_node, "sweep", "cancel"))
+    assert out["state"] == "done"  # nothing running: cancel is a no-op
+
+
 def test_cli_serving_watch_snapshot_and_stream_stats(live_node):
     """breeze serving watch NODE --deltas 0: one generation-stamped
     snapshot through the ctrl server-stream, then exit; stream-stats
@@ -271,7 +310,17 @@ def test_cli_serving_watch_snapshot_and_stream_stats(live_node):
     assert stats["node"] == "node0"
     assert stats["counters"]["streaming.snapshots"] >= 1
     assert stats["counters"].get("streaming.num_invariant_violations", 0) == 0
-    # the watch unsubscribed on exit: no subscriber retained
+    # the watch unsubscribed on exit: no subscriber retained.  The
+    # server-side detach runs when the stream's cancellation lands on
+    # the node loop — asynchronous wrt a FRESH stats connection, so
+    # assert the eventual state, not the first sample
+    import time
+
+    for _ in range(50):
+        if stats["counters"]["streaming.subscribers"] == 0:
+            break
+        time.sleep(0.1)
+        stats = json.loads(_run(live_node, "serving", "stream-stats"))
     assert stats["counters"]["streaming.subscribers"] == 0
 
 

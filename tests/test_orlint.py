@@ -148,6 +148,12 @@ FIXTURES = {
         (),
         2,
     ),
+    "sweep-spill-ownership": (
+        "def shortcut(spill, rows):\n"
+        "    spill.spill_rows(rows)\n",
+        (),
+        2,
+    ),
 }
 
 
@@ -427,6 +433,35 @@ def test_resilience_latch_pool_mutators_trip():
     assert [f.rule for f in analyze_source(src)] == ["resilience-latch"]
     src2 = "def heal(pool):\n    pool.restore_device(3)\n"
     assert [f.rule for f in analyze_source(src2)] == ["resilience-latch"]
+
+
+def test_sweep_ownership_owners_are_exempt():
+    """The sweep package writes its own spill/checkpoint state freely —
+    the rule polices everyone else (ISSUE 14)."""
+    src = (
+        "def commit(spill, checkpoint, rows):\n"
+        "    spill.spill_rows(rows)\n"
+        "    checkpoint.commit_shard(0, {'rows': len(rows)})\n"
+        "    checkpoint.reset('id', 'hash', {}, 1)\n"
+    )
+    mods = [ParsedModule.parse("openr_tpu/sweep/executor.py", src)]
+    assert analyze_modules(mods).findings == []
+    assert [f.rule for f in analyze_source(src)] == [
+        "sweep-spill-ownership"
+    ] * 3
+
+
+def test_sweep_ownership_reset_needs_checkpoint_receiver():
+    """Plain ``x.reset()`` on unrelated objects must not trip — only a
+    receiver whose name marks it as the checkpoint manifest does."""
+    src = (
+        "def clear(breaker, manifest):\n"
+        "    breaker.reset()\n"
+        "    manifest.reset('id', 'hash', {}, 1)\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == [
+        "sweep-spill-ownership"
+    ]
 
 
 def test_slot_table_mutator_calls_trip():
