@@ -16,11 +16,15 @@ and its rationale.
 """
 
 from openr_tpu.analysis.baseline import Baseline, BaselineEntry
+from openr_tpu.analysis.callgraph import ModuleSummary, Project
 from openr_tpu.analysis.engine import (
     analyze_modules,
     analyze_paths,
     analyze_source,
+    build_project,
     default_baseline_path,
+    default_cache_path,
+    load_modules,
     repo_root,
 )
 from openr_tpu.analysis.findings import Finding, Report
@@ -30,12 +34,17 @@ __all__ = [
     "Baseline",
     "BaselineEntry",
     "Finding",
+    "ModuleSummary",
+    "Project",
     "Report",
     "all_rules",
     "analyze_modules",
     "analyze_paths",
     "analyze_source",
+    "build_project",
     "default_baseline_path",
+    "default_cache_path",
+    "load_modules",
     "make_passes",
     "repo_root",
 ]

@@ -5,14 +5,23 @@ Modes:
 * (default)            — report findings, exit 0 regardless
 * ``--check``          — exit 1 when any unsuppressed, unbaselined
                          finding survives (the tier-1 gate,
-                         tests/test_orlint.py)
+                         tests/test_orlint.py; canonical invocation:
+                         ``python -m openr_tpu.analysis --check --cache``)
+* ``--cache``          — serve unchanged files from the content-hash
+                         result cache (cache.py; warm runs re-parse
+                         zero files)
 * ``--update-baseline``— rewrite analysis/baseline.json from the current
                          findings (the ratchet: run after FIXING things,
                          not instead of fixing them)
 * ``--format=json``    — machine-readable report (finding list + per-rule
                          counts) so BENCH/verdict tooling can diff
                          finding counts across PRs
-* ``--list-rules``     — every rule id with its one-line rationale
+* ``--format=github``  — GitHub Actions ``::error file=..,line=..``
+                         annotations, one per finding
+* ``--list-rules``     — every rule id with its pass family and one-line
+                         rationale
+* ``--explain RULE``   — the rule's rationale plus a minimal tripping
+                         snippet and its fixed twin
 """
 
 from __future__ import annotations
@@ -27,8 +36,38 @@ from openr_tpu.analysis.baseline import Baseline
 from openr_tpu.analysis.engine import (
     analyze_paths,
     default_baseline_path,
+    default_cache_path,
 )
-from openr_tpu.analysis.passes import all_rules
+from openr_tpu.analysis.passes import (
+    all_rules,
+    make_passes,
+    rule_example,
+)
+
+
+def _explain(rule: str) -> int:
+    rules = all_rules()
+    if rule not in rules:
+        print(f"orlint: unknown rule {rule!r} (see --list-rules)")
+        return 2
+    found = rule_example(rule)
+    print(f"{rule} [{found[0] if found else '?'}]")
+    print(f"  {rules[rule]}")
+    if found is None:  # pragma: no cover - meta-test enforces coverage
+        print("  (no example registered)")
+        return 0
+    _, ex = found
+    print("\ntrips:\n")
+    for ln in ex["trip"].rstrip("\n").splitlines():
+        print(f"    {ln}")
+    print("\nfixed:\n")
+    for ln in ex["fix"].rstrip("\n").splitlines():
+        print(f"    {ln}")
+    print(
+        "\nsuppress (only with a written justification):\n"
+        f"    ... # orlint: disable={rule} (<why this site is legitimate>)"
+    )
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -36,7 +75,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m openr_tpu.analysis",
         description="orlint: static invariant checks for openr-tpu "
         "(clock discipline, actor isolation, JAX kernel hygiene, "
-        "blocking-in-event-loop)",
+        "blocking-in-event-loop, replay determinism)",
     )
     ap.add_argument(
         "paths",
@@ -51,7 +90,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "github"),
         default="text",
         dest="fmt",
     )
@@ -78,15 +117,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         metavar="RULE",
         help="restrict to specific rule id(s)",
     )
+    ap.add_argument(
+        "--cache",
+        action="store_true",
+        help="use the per-file result cache (warm runs re-parse zero "
+        "unchanged files; invalidated by file hash, rule-set version, "
+        "and the project facts digest)",
+    )
+    ap.add_argument(
+        "--cache-path",
+        type=Path,
+        default=None,
+        help=f"cache file (default: {default_cache_path()})",
+    )
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--explain",
+        metavar="RULE",
+        default=None,
+        help="show a rule's rationale with a minimal trip/fix example",
+    )
     args = ap.parse_args(argv)
 
+    if args.explain:
+        return _explain(args.explain)
+
     if args.list_rules:
-        for rule, why in all_rules().items():
-            print(f"{rule:22s} {why}")
+        for p in make_passes():
+            for rule, why in p.rules.items():
+                print(f"{rule:24s} [{p.name}] {why}")
         return 0
 
     baseline_path = args.baseline or default_baseline_path()
+    cache_path = None
+    if args.cache or args.cache_path is not None:
+        cache_path = args.cache_path or default_cache_path()
 
     if args.update_baseline:
         report = analyze_paths(
@@ -104,10 +169,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline_path,
         use_baseline=not args.no_baseline,
         rules=args.rules,
+        cache_path=cache_path,
     )
 
     if args.fmt == "json":
         print(json.dumps(report.to_json(), indent=2))
+    elif args.fmt == "github":
+        for f in report.findings:
+            print(f.render_github())
     else:
         for f in report.findings:
             print(f.render())
@@ -121,6 +190,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         summary = (
             f"orlint: {len(report.findings)} finding(s) across "
             f"{report.files_scanned} file(s)"
+        )
+        if cache_path is not None:
+            summary += f" ({report.files_parsed} parsed)"
+        summary += (
             f" ({len(report.baselined)} baselined, "
             f"{len(report.suppressed)} suppressed"
             + (

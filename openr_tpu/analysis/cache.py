@@ -1,0 +1,118 @@
+"""Per-file content-hash result cache — keeps the lint gate sub-linear.
+
+``--check`` over the whole repo parses every file and resolves a
+project-wide call graph; as the repo grows that cost grows with it, and
+the tier-1 gate pays it on every run.  The cache makes the warm path
+cheap with a sound invalidation story in three keys:
+
+* **file hash** — each entry is keyed by the sha256 of the file's
+  source.  Content change ⇒ that entry is dead.
+* **rule-set signature** — a hash over every registered rule id + its
+  rationale text + the cache format version.  Adding/renaming/bumping
+  any rule invalidates EVERYTHING (findings were computed under a
+  different law).
+* **project facts digest** — a hash over every module's serializable
+  summary (analysis/callgraph.py).  Findings are interprocedural, so a
+  file's cached findings are only valid while the cross-module facts
+  they were computed under are byte-identical.  Same digest ⇒ a file
+  whose content did not change cannot have different findings; changed
+  digest ⇒ full re-run (sound, and still one edit away from warm).
+
+What a warm hit skips: ``ast.parse``, the summary walk, and every pass —
+the entry carries the file's raw findings and its parsed suppression
+spec, so the engine only replays filtering/baseline bookkeeping.  The
+acceptance bar (tests/test_orlint.py): a warm ``--cache`` check
+re-parses ZERO unchanged files.
+
+The cache lives at ``<repo_root>/.orlint_cache.json`` (gitignored),
+written atomically (tmp + rename) so concurrent runs never read torn
+state — a torn/alien file is treated as empty, never an error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+CACHE_FORMAT = 1
+DEFAULT_CACHE_NAME = ".orlint_cache.json"
+
+
+def ruleset_signature() -> str:
+    """Hash of the active rule set: ids + rationale text + format.  Any
+    rule addition/removal/rewording produces a new signature, which is
+    the ``--cache`` invalidation contract for rule-set bumps."""
+    from openr_tpu.analysis.passes import all_rules
+
+    doc = {"format": CACHE_FORMAT, "rules": dict(sorted(all_rules().items()))}
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+def source_hash(source: str) -> str:
+    return hashlib.sha256(source.encode()).hexdigest()
+
+
+class ResultCache:
+    """The on-disk document plus lookup/store bookkeeping."""
+
+    def __init__(self, path: str, doc: Optional[dict] = None) -> None:
+        self.path = path
+        self.doc = doc if isinstance(doc, dict) else {}
+
+    @classmethod
+    def load(cls, path) -> "ResultCache":
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        if not isinstance(doc, dict):
+            doc = {}
+        return cls(str(path), doc)
+
+    @property
+    def valid(self) -> bool:
+        return (
+            self.doc.get("format") == CACHE_FORMAT
+            and self.doc.get("ruleset") == ruleset_signature()
+        )
+
+    @property
+    def project_digest(self) -> str:
+        return self.doc.get("project_digest", "") if self.valid else ""
+
+    def entry(self, rel: str, content_hash: str) -> Optional[dict]:
+        """The stored entry for ``rel`` iff it matches ``content_hash``
+        under the current rule set."""
+        if not self.valid:
+            return None
+        e = self.doc.get("files", {}).get(rel)
+        if isinstance(e, dict) and e.get("hash") == content_hash:
+            return e
+        return None
+
+    def replace(self, project_digest: str, files: Dict[str, dict]) -> None:
+        self.doc = {
+            "format": CACHE_FORMAT,
+            "ruleset": ruleset_signature(),
+            "project_digest": project_digest,
+            "files": files,
+        }
+
+    def save(self) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self.doc, f, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, self.path)
+        except OSError:
+            # a read-only checkout must not fail the lint run
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

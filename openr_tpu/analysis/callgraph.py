@@ -1,0 +1,715 @@
+"""Project-wide symbol table + call graph — orlint's interprocedural spine.
+
+Per-file passes (PR 2) can only judge a call site by what the site says.
+The determinism family (passes/determinism.py) needs more: a raw
+``datetime.now()`` three helpers away from an actor run loop breaks
+byte-identical replay just as surely as one written inside the loop, and
+an unsorted ``set`` iteration is only a replay bug when the loop body
+eventually *reaches* a digest/spill/wire sink.  Both are reachability
+questions over the whole project, so this module grows orlint from a
+per-file linter into a project-wide engine:
+
+* :class:`ModuleSummary` — the serializable cross-module facts of ONE
+  file: class defs (bases, methods, constructor-assignment attribute
+  types), function defs, per-function call references, jitted kernel
+  names.  Summaries are pure data (canonical-JSON round-trip), which is
+  what makes the ``--cache`` result cache sound: a file whose summary is
+  byte-identical cannot have changed what any OTHER file's findings
+  depend on (see cache.py).
+
+* :class:`Project` — the symbol table + call graph assembled from every
+  summary: bare-name class hierarchy (``subclasses_of`` — the actor
+  registry generalized), a qualname function index, resolved call
+  edges, and BFS reachability with *barrier classes* (calls dispatched
+  through an injected ``Clock`` receiver are the sanctioned discipline,
+  so traversal stops at the barrier — that is exactly why a wall-clock
+  read behind ``self.clock.now()`` does not trip
+  ``wallclock-reachability``).
+
+Resolution is deliberately bare-name / single-namespace, same trade as
+astutil.py: a sliver of precision for an engine that stays small and a
+suppression mechanism that absorbs the rare false positive.  Over- and
+under-approximation are both possible; every edge the graph *does* draw
+comes from an explicit syntactic pattern listed in ``_CallIndexer``.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from openr_tpu.analysis.astutil import ImportMap, annotation_name, resolve
+
+# call-ref kinds (compact, serialization-stable):
+#   ["n", target, line]        plain/dotted call: helper(..), time.monotonic(..)
+#   ["s", method, line]        self.method(..)
+#   ["a", attr, method, line]  self.attr.method(..)
+#   ["v", var, method, line]   var.method(..) — var may be locally typed
+#   ["m", method, line]        method call on an untypable receiver
+CallRef = List  # [kind, *parts, line]
+
+#: pseudo-function holding a module's top-level calls
+MODULE_BODY = "<module>"
+
+#: builtin container constructors that bind an "unordered" local type
+_SET_CTORS = {"set", "frozenset"}
+_DICT_CTORS = {"dict", "collections.defaultdict", "collections.Counter"}
+_ORDERED_ANNOTATIONS = {"OrderedDict"}
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    bases: List[str] = field(default_factory=list)  # bare base names
+    #: constructor-assignment attribute types: attr -> class ref (bare
+    #: internal name, dotted external like "hashlib.sha256", or the
+    #: builtin markers "set"/"dict")
+    attrs: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> local qual
+
+    def to_json(self) -> dict:
+        return {
+            "bases": self.bases,
+            "attrs": self.attrs,
+            "methods": self.methods,
+        }
+
+    @classmethod
+    def from_json(cls, name: str, doc: dict) -> "ClassInfo":
+        return cls(
+            name=name,
+            bases=list(doc.get("bases", [])),
+            attrs=dict(doc.get("attrs", {})),
+            methods=dict(doc.get("methods", {})),
+        )
+
+
+@dataclass
+class FunctionInfo:
+    name: str  # bare function/method name
+    cls: str  # enclosing class bare name, "" for module functions
+    line: int
+    end_line: int
+    calls: List[CallRef] = field(default_factory=list)
+    #: locally-typed names: var -> class ref (annotations + ctor bindings)
+    var_types: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "cls": self.cls,
+            "line": self.line,
+            "end_line": self.end_line,
+            "calls": self.calls,
+            "var_types": self.var_types,
+        }
+
+    @classmethod
+    def from_json(cls, local_qual: str, doc: dict) -> "FunctionInfo":
+        # the summary dict key is the LOCAL qualname ("Cls.meth" / "fn" /
+        # "<module>"); the bare name is its last segment — reconstructing
+        # it wrong silently empties the (cls, method) index, which is why
+        # test_orlint_determinism pins full Project-edge round-trip equality
+        return cls(
+            name=local_qual.rsplit(".", 1)[-1],
+            cls=doc.get("cls", ""),
+            line=int(doc.get("line", 0)),
+            end_line=int(doc.get("end_line", 0)),
+            calls=[list(c) for c in doc.get("calls", [])],
+            var_types=dict(doc.get("var_types", {})),
+        )
+
+
+@dataclass
+class ModuleSummary:
+    """The cross-module facts of one file — everything any pass may read
+    about a module it did not parse.  Keep this complete: the cache's
+    soundness argument is "same summaries ⇒ same cross-module context"."""
+
+    module: str  # dotted import path ("" outside a package)
+    rel: str
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    #: "Cls.meth" / "fn" / "<module>" -> FunctionInfo
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    #: jitted kernel names -> sorted static argnames (jax_hygiene registry)
+    jitted: Dict[str, List[str]] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "module": self.module,
+            "rel": self.rel,
+            "classes": {k: c.to_json() for k, c in sorted(self.classes.items())},
+            "functions": {
+                k: f.to_json() for k, f in sorted(self.functions.items())
+            },
+            "jitted": {k: sorted(v) for k, v in sorted(self.jitted.items())},
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ModuleSummary":
+        return cls(
+            module=doc.get("module", ""),
+            rel=doc.get("rel", ""),
+            classes={
+                k: ClassInfo.from_json(k, v)
+                for k, v in doc.get("classes", {}).items()
+            },
+            functions={
+                k: FunctionInfo.from_json(k, v)
+                for k, v in doc.get("functions", {}).items()
+            },
+            jitted={k: list(v) for k, v in doc.get("jitted", {}).items()},
+        )
+
+    def content_hash(self) -> str:
+        return hashlib.sha256(
+            json.dumps(self.to_json(), sort_keys=True, separators=(",", ":"))
+            .encode()
+        ).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# building a summary from a parsed module
+# ---------------------------------------------------------------------------
+
+
+def _class_ref(node: ast.expr, imports: ImportMap) -> Optional[str]:
+    """What a constructor call binds: bare internal class name, dotted
+    external ("hashlib.sha256"), or the builtin set/dict markers."""
+    target = resolve(node, imports)
+    if not target:
+        return None
+    if target in _SET_CTORS:
+        return "set"
+    if target in _DICT_CTORS:
+        return "dict"
+    if "." in target:
+        head = target.split(".", 1)[0]
+        # imported/external dotted reference: keep the dots so sink
+        # matching can see "hashlib.sha256"; internal classes resolve by
+        # their bare tail at graph time
+        return target if head not in ("self",) else None
+    return target
+
+
+def _annotation_type(node: Optional[ast.expr]) -> Optional[str]:
+    """Class ref for a parameter/variable annotation, with set/dict
+    container annotations folded to the builtin markers."""
+    name = annotation_name(node)
+    if name is None and isinstance(node, ast.Subscript):
+        name = annotation_name(node.value)
+    if name is None:
+        return None
+    low = name.lower()
+    if name in ("Set", "FrozenSet", "AbstractSet", "MutableSet") or low == "set":
+        return "set"
+    if name in ("Dict", "Mapping", "MutableMapping", "DefaultDict", "Counter") or low == "dict":
+        return "dict"
+    return name
+
+
+class _CallIndexer(ast.NodeVisitor):
+    """One walk of a module: classes, functions-of-record, call refs.
+
+    Nested defs and lambdas are *flattened* into their enclosing
+    function-of-record — defining a closure is treated as (potentially)
+    calling it, which over-approximates reachability in exactly the
+    conservative direction the determinism rules want."""
+
+    def __init__(self, module_name: str, rel: str, tree: ast.Module,
+                 imports: ImportMap) -> None:
+        self.summary = ModuleSummary(module=module_name, rel=rel)
+        self.imports = imports
+        self._class_stack: List[ClassInfo] = []
+        self._func_stack: List[FunctionInfo] = []
+        mod_fn = FunctionInfo(name=MODULE_BODY, cls="", line=0, end_line=0)
+        self.summary.functions[MODULE_BODY] = mod_fn
+        self._module_fn = mod_fn
+        self.visit(tree)
+
+    # -- scopes ------------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        info = ClassInfo(name=node.name)
+        for b in node.bases:
+            name = annotation_name(b)
+            if name:
+                info.bases.append(name)
+        prev = self.summary.classes.get(node.name)
+        if prev is None:
+            self.summary.classes[node.name] = info
+        else:  # same-name class redefinition: merge conservatively
+            prev.bases.extend(b for b in info.bases if b not in prev.bases)
+            info = prev
+        self._class_stack.append(info)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _enter_function(self, node) -> None:
+        if self._func_stack:  # nested def: flatten into the outer record
+            self._record_param_types(node, self._func_stack[-1])
+            self.generic_visit(node)
+            return
+        cls = self._class_stack[-1] if self._class_stack else None
+        qual = f"{cls.name}.{node.name}" if cls else node.name
+        info = FunctionInfo(
+            name=node.name,
+            cls=cls.name if cls else "",
+            line=node.lineno,
+            end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+        )
+        self._record_param_types(node, info)
+        if cls is not None:
+            cls.methods.setdefault(node.name, qual)
+        # first definition wins (same-name redefinitions are rare and the
+        # first is what most callers bound at import time)
+        self.summary.functions.setdefault(qual, info)
+        self._func_stack.append(info)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def _record_param_types(self, node, info: FunctionInfo) -> None:
+        a = node.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            t = _annotation_type(p.annotation)
+            if t:
+                info.var_types.setdefault(p.arg, t)
+
+    visit_FunctionDef = _enter_function
+    visit_AsyncFunctionDef = _enter_function
+
+    # -- bindings ----------------------------------------------------------
+
+    @property
+    def _fn(self) -> FunctionInfo:
+        return self._func_stack[-1] if self._func_stack else self._module_fn
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._bind(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        t = _annotation_type(node.annotation)
+        if t:
+            self._bind_ref([node.target], t)
+        self.generic_visit(node)
+
+    def _bind(self, targets: Sequence[ast.expr], value: ast.expr) -> None:
+        # `x = given or Default(..)` / `x = a if cond else b`: any branch
+        # that resolves to a class binds (first resolvable wins — the
+        # branches of real fallback chains construct the same family)
+        if isinstance(value, ast.BoolOp):
+            for v in value.values:
+                self._bind(targets, v)
+            return
+        if isinstance(value, ast.IfExp):
+            self._bind(targets, value.body)
+            self._bind(targets, value.orelse)
+            return
+        ref: Optional[str] = None
+        if isinstance(value, ast.Call):
+            ref = _class_ref(value.func, self.imports)
+            if ref is not None and "." not in ref and ref not in ("set", "dict"):
+                # plain-name call: only a Title-case name plausibly
+                # constructs; helper() results stay untyped
+                if not ref[:1].isupper():
+                    ref = None
+        elif isinstance(value, ast.SetComp) or (
+            isinstance(value, ast.Set)
+        ):
+            ref = "set"
+        elif isinstance(value, (ast.Dict, ast.DictComp)):
+            ref = "dict"
+        elif isinstance(value, ast.Name):
+            # alias of an already-typed local (incl. annotated params):
+            # `clock = self._clock or fallback` is NOT this shape — only a
+            # plain name copy propagates
+            ref = self._fn.var_types.get(value.id)
+        if ref is not None:
+            self._bind_ref(targets, ref)
+
+    def _bind_ref(self, targets: Sequence[ast.expr], ref: str) -> None:
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self._fn.var_types.setdefault(t.id, ref)
+            elif (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and self._class_stack
+            ):
+                self._class_stack[-1].attrs.setdefault(t.attr, ref)
+
+    # -- parameter-to-attribute propagation happens via _bind: in
+    #    `self.clock = clock`, the RHS Name's type comes from var_types
+    #    (annotated params are registered there at function entry).
+
+    # -- calls -------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._fn.calls.append(self._call_ref(node))
+        # callback harvesting: a function/method REFERENCE passed as an
+        # argument is treated as potentially called by the receiver —
+        # that is how every actor fiber is born (`spawn_queue_loop(q,
+        # self._process)`, `schedule(5.0, self._sample)`, listener
+        # registration) and the conservative direction reachability wants
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            ref = callable_ref_for(arg, self.imports)
+            if ref is not None:
+                self._fn.calls.append(ref)
+        self.generic_visit(node)
+
+    def _call_ref(self, node: ast.Call) -> CallRef:
+        return call_ref_for(node, self.imports)
+
+
+def call_ref_for(node: ast.Call, imports: ImportMap) -> CallRef:
+    """Classify one call site into a serializable CallRef (shared with
+    passes that resolve individual sites, e.g. unordered-emission)."""
+    line = node.lineno
+    target = resolve(node.func, imports)
+    if target is not None:
+        parts = target.split(".")
+        if parts[0] == "self":
+            if len(parts) == 2:
+                return ["s", parts[1], line]
+            if len(parts) == 3:
+                return ["a", parts[1], parts[2], line]
+            return ["m", parts[-1], line]
+        if len(parts) == 1:
+            return ["n", target, line]
+        # `var.method()` where var is a plain (non-imported) local name is
+        # a typed-receiver candidate; imported roots stay dotted targets
+        root = node.func
+        chain: List[str] = []
+        while isinstance(root, ast.Attribute):
+            chain.append(root.attr)
+            root = root.value
+        if (
+            isinstance(root, ast.Name)
+            and root.id not in imports.names
+            and len(chain) == 1
+        ):
+            return ["v", root.id, chain[0], line]
+        return ["n", target, line]
+    if isinstance(node.func, ast.Attribute):
+        return ["m", node.func.attr, line]
+    return ["n", "<dynamic>", line]
+
+
+def callable_ref_for(expr: ast.expr, imports: ImportMap) -> Optional[CallRef]:
+    """CallRef for a bare callable *reference* (an uncalled Name or
+    attribute handed to a spawner/listener), or None.  Data arguments
+    resolve to targets no sink or function index matches, so the
+    over-approximation stays cheap."""
+    if isinstance(expr, ast.Name):
+        return ["n", imports.origin(expr.id), expr.lineno]
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        root = expr.value.id
+        if root == "self":
+            return ["s", expr.attr, expr.lineno]
+        if root in imports.names:  # `module.fn` reference
+            return ["n", f"{imports.origin(root)}.{expr.attr}", expr.lineno]
+        return ["v", root, expr.attr, expr.lineno]
+    return None
+
+
+def summarize_module(
+    module_name: str, rel: str, tree: ast.Module, imports: ImportMap,
+    jitted: Optional[Dict[str, Iterable[str]]] = None,
+) -> ModuleSummary:
+    idx = _CallIndexer(module_name, rel, tree, imports)
+    if jitted:
+        idx.summary.jitted = {k: sorted(v) for k, v in jitted.items()}
+    return idx.summary
+
+
+# ---------------------------------------------------------------------------
+# the project: symbol table + call graph
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Reach:
+    """Why a function is reachable: the root and the hop count."""
+
+    root: str
+    hops: int
+
+
+class Project:
+    """Symbol table + call graph over every module summary."""
+
+    def __init__(self, summaries: Sequence[ModuleSummary]) -> None:
+        self.summaries: Dict[str, ModuleSummary] = {s.rel: s for s in summaries}
+        self._by_module: Dict[str, ModuleSummary] = {
+            s.module: s for s in summaries if s.module
+        }
+        #: bare class name -> [(module, ClassInfo)]
+        self.classes: Dict[str, List[Tuple[str, ClassInfo]]] = {}
+        #: function qualname ("module.Cls.fn" / "module.fn") -> FunctionInfo
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: (bare class, method) -> [qualname]
+        self.methods: Dict[Tuple[str, str], List[str]] = {}
+        self._subclass_cache: Dict[str, Set[str]] = {}
+        self._untyped_cache: Dict[str, List[str]] = {}
+        for s in summaries:
+            for cname, cinfo in s.classes.items():
+                self.classes.setdefault(cname, []).append((s.module, cinfo))
+            for local_qual, finfo in s.functions.items():
+                qual = f"{s.module}.{local_qual}" if s.module else local_qual
+                self.functions[qual] = finfo
+                if finfo.cls:
+                    self.methods.setdefault(
+                        (finfo.cls, finfo.name), []
+                    ).append(qual)
+        #: resolved adjacency: qualname -> {target} where target is an
+        #: internal qualname or an external dotted/bare string
+        self.edges: Dict[str, Set[str]] = {}
+        for s in summaries:
+            for local_qual, finfo in s.functions.items():
+                qual = f"{s.module}.{local_qual}" if s.module else local_qual
+                self.edges[qual] = {
+                    t
+                    for ref in finfo.calls
+                    for t in self.resolve_ref(s, finfo, ref)
+                }
+
+    # -- symbol table ------------------------------------------------------
+
+    def subclasses_of(self, base: str) -> Set[str]:
+        """Transitive subclasses by bare name, including ``base`` itself —
+        the generalized actor-registry query."""
+        cached = self._subclass_cache.get(base)
+        if cached is not None:
+            return cached
+        out: Set[str] = {base}
+        changed = True
+        while changed:
+            changed = False
+            for name, infos in self.classes.items():
+                if name in out:
+                    continue
+                if any(set(i.bases) & out for _, i in infos):
+                    out.add(name)
+                    changed = True
+        self._subclass_cache[base] = out
+        return out
+
+    def jitted_registry(self) -> Dict[str, Dict[str, Set[str]]]:
+        """module name -> {jitted fn -> static argnames} (jax_hygiene)."""
+        return {
+            s.module: {k: set(v) for k, v in s.jitted.items()}
+            for s in self.summaries.values()
+        }
+
+    def attr_type(self, cls: str, attr: str) -> Optional[str]:
+        for _, info in self.classes.get(cls, ()):  # first binding wins
+            ref = info.attrs.get(attr)
+            if ref:
+                return ref
+        return None
+
+    def _method_quals(self, cls: str, method: str) -> List[str]:
+        """Resolve ``cls.method`` through the bare-name base chain (the
+        statically-declared class only — overrides in subclasses are NOT
+        edges; that asymmetry is what makes Clock a real barrier)."""
+        seen: Set[str] = set()
+        frontier = [cls]
+        while frontier:
+            cur = frontier.pop(0)
+            if cur in seen:
+                continue
+            seen.add(cur)
+            quals = self.methods.get((cur, method))
+            if quals:
+                return quals
+            for _, info in self.classes.get(cur, ()):
+                frontier.extend(info.bases)
+        return []
+
+    # -- edge resolution ---------------------------------------------------
+
+    def resolve_ref(
+        self, s: ModuleSummary, fn: FunctionInfo, ref: CallRef
+    ) -> List[str]:
+        """CallRef -> graph targets.  Internal functions resolve to their
+        qualname; anything else stays a dotted/bare external string (still
+        matchable by sink registries); untypable method calls become
+        ``?.method``."""
+        kind = ref[0]
+        if kind == "n":
+            target = ref[1]
+            if "." not in target:
+                local = s.functions.get(target)
+                if local is not None and target != MODULE_BODY:
+                    return [f"{s.module}.{target}" if s.module else target]
+                if target in s.classes:
+                    return self._ctor_targets(s.module, target)
+                return [target]
+            # dotted: exact function? class ctor? external.
+            if target in self.functions:
+                return [target]
+            mod, _, tail = target.rpartition(".")
+            src = self._summary_for_module(mod)
+            if src is not None:
+                if tail in src.functions:
+                    return [target]
+                if tail in src.classes:
+                    return self._ctor_targets(mod, tail)
+            # bare-tail class ctor via from-import: `Foo()` resolved to
+            # "pkg.mod.Foo" lands here when pkg.mod defines class Foo
+            if self.classes.get(tail):
+                return self._ctor_targets_by_name(tail)
+            return [target]
+        if kind == "s":
+            if fn.cls:
+                quals = self._method_quals(fn.cls, ref[1])
+                if quals:
+                    return quals
+                # an attribute of self holding a callable (debounce /
+                # throttle objects) — fall through to the attr type
+                cls_ref = self.attr_type(fn.cls, ref[1])
+                if cls_ref is not None:
+                    return self._typed_method(cls_ref, "__call__")
+            return self._untyped_method(ref[1])
+        if kind == "a":
+            attr, method = ref[1], ref[2]
+            cls_ref = self.attr_type(fn.cls, attr) if fn.cls else None
+            return self._typed_method(cls_ref, method)
+        if kind == "v":
+            var, method = ref[1], ref[2]
+            return self._typed_method(fn.var_types.get(var), method)
+        if kind == "m":
+            return self._untyped_method(ref[1])
+        return []
+
+    #: by-name dispatch cap: an untypable receiver's method call edges to
+    #: every project class defining that name, but only while the name
+    #: stays distinctive — ubiquitous names (get, items, append..) would
+    #: otherwise wire the whole graph together
+    NAME_DISPATCH_CAP = 6
+
+    def _untyped_method(self, method: str) -> List[str]:
+        cached = self._untyped_cache.get(method)
+        if cached is not None:
+            return cached
+        owners = [
+            quals
+            for (_cls, m), quals in self.methods.items()
+            if m == method
+        ]
+        if owners and len(owners) <= self.NAME_DISPATCH_CAP:
+            out = sorted({q for quals in owners for q in quals})
+            out.append(f"?.{method}")  # keep the sink-matchable marker
+        else:
+            out = [f"?.{method}"]
+        self._untyped_cache[method] = out
+        return out
+
+    def _typed_method(self, cls_ref: Optional[str], method: str) -> List[str]:
+        if cls_ref is None or cls_ref in ("set", "dict"):
+            return self._untyped_method(method)
+        if "." in cls_ref:  # external dotted type: keep dotted for sinks
+            return [f"{cls_ref}.{method}"]
+        quals = self._method_quals(cls_ref, method)
+        if quals:
+            return quals
+        return [f"{cls_ref}.{method}" if cls_ref[:1].isupper() else f"?.{method}"]
+
+    def _ctor_targets(self, module: str, cls: str) -> List[str]:
+        quals = self._method_quals(cls, "__init__")
+        return quals or [f"{module}.{cls}.__init__" if module else f"{cls}.__init__"]
+
+    def _ctor_targets_by_name(self, cls: str) -> List[str]:
+        return self._method_quals(cls, "__init__") or [f"{cls}.__init__"]
+
+    def _summary_for_module(self, module: str) -> Optional[ModuleSummary]:
+        return self._by_module.get(module)
+
+    # -- reachability ------------------------------------------------------
+
+    def owner_class(self, qual: str) -> str:
+        fn = self.functions.get(qual)
+        return fn.cls if fn is not None else ""
+
+    def reachable_from(
+        self,
+        roots: Iterable[str],
+        barrier: Optional[Callable[[str], bool]] = None,
+    ) -> Dict[str, Reach]:
+        """BFS over resolved edges from ``roots`` (function qualnames).
+        Returns every reachable *internal* function with its closest root
+        and hop count.  ``barrier(qual)`` stops traversal INTO a node
+        (the node is neither reported nor expanded)."""
+        out: Dict[str, Reach] = {}
+        frontier: List[Tuple[str, str, int]] = []
+        for r in sorted(set(roots)):
+            if r in self.functions and r not in out:
+                out[r] = Reach(root=r, hops=0)
+                frontier.append((r, r, 0))
+        while frontier:
+            cur, root, hops = frontier.pop(0)
+            for t in sorted(self.edges.get(cur, ())):
+                if t not in self.functions or t in out:
+                    continue
+                if barrier is not None and barrier(t):
+                    continue
+                out[t] = Reach(root=root, hops=hops + 1)
+                frontier.append((t, root, hops + 1))
+        return out
+
+    def targets_reach(
+        self,
+        targets: Iterable[str],
+        goal: Callable[[str], bool],
+        _memo: Optional[Dict[str, bool]] = None,
+    ) -> Optional[str]:
+        """Does any of ``targets`` (graph target strings) reach a target
+        satisfying ``goal``?  Returns the first matched goal target (for
+        the finding message) or None.  ``_memo`` caches per-node verdicts
+        across queries within one analysis run."""
+        memo = _memo if _memo is not None else {}
+        for t in sorted(set(targets)):
+            hit = self._reaches_goal(t, goal, memo, set())
+            if hit is not None:
+                return hit
+        return None
+
+    def _reaches_goal(
+        self,
+        node: str,
+        goal: Callable[[str], bool],
+        memo: Dict[str, bool],
+        on_path: Set[str],
+    ) -> Optional[str]:
+        if goal(node):
+            return node
+        if node not in self.functions:
+            return None
+        if node in memo:
+            return memo[node] if isinstance(memo[node], str) else None
+        if node in on_path:  # recursion cycle
+            return None
+        on_path.add(node)
+        for t in sorted(self.edges.get(node, ())):
+            hit = self._reaches_goal(t, goal, memo, on_path)
+            if hit is not None:
+                memo[node] = hit
+                on_path.discard(node)
+                return hit
+        on_path.discard(node)
+        memo[node] = False
+        return None
+
+
+def project_digest(summaries: Iterable[ModuleSummary]) -> str:
+    """One hash over every module's facts — the cache's cross-module
+    validity token (cache.py): findings computed under a digest are
+    reusable only under the same digest."""
+    doc = {s.rel: s.content_hash() for s in summaries}
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()

@@ -1,22 +1,41 @@
-"""orlint engine — discover files, run passes, filter, report.
+"""orlint engine — discover files, build the project, run passes, report.
 
-Two-phase execution (see passes/base.py): every pass collects
-cross-module facts over the whole file set before any pass runs, so the
-actor registry and the jitted-kernel registry see the full project no
-matter the file ordering.  Findings are then filtered through in-source
-suppressions (suppress.py) and the checked-in baseline (baseline.py);
-only what survives fails ``--check``.
+Since the call-graph engine the execution shape is:
+
+1. every module parses into a :class:`ParsedModule` and contributes a
+   serializable :class:`~openr_tpu.analysis.callgraph.ModuleSummary`;
+2. ONE :class:`~openr_tpu.analysis.callgraph.Project` (symbol table +
+   call graph) is assembled from the summaries and published to every
+   pass through ``ctx`` (passes/base.py) — no pass runs its own
+   project-wide walk;
+3. passes run per module; findings filter through in-source
+   suppressions (suppress.py) and the checked-in baseline (baseline.py);
+   only what survives fails ``--check``.
+
+With ``cache_path`` set (the ``--cache`` flag), step 1 is served from
+the per-file content-hash result cache (cache.py): a file whose hash,
+rule-set signature and project-facts digest all match skips parse,
+summary AND passes — its findings replay from the cache.  A content
+change whose summary is byte-identical re-runs just that file; a summary
+change re-runs everything (cross-module facts moved).
 """
 
 from __future__ import annotations
 
 from pathlib import Path
-from typing import Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from openr_tpu.analysis.baseline import Baseline
+from openr_tpu.analysis.cache import ResultCache, source_hash
+from openr_tpu.analysis.callgraph import (
+    ModuleSummary,
+    Project,
+    project_digest,
+)
 from openr_tpu.analysis.findings import Finding, Report
 from openr_tpu.analysis.passes import make_passes
-from openr_tpu.analysis.passes.base import ParsedModule
+from openr_tpu.analysis.passes.base import CTX_PROJECT, ParsedModule
+from openr_tpu.analysis.suppress import Suppressions
 
 DEFAULT_BASELINE_NAME = "baseline.json"
 
@@ -32,6 +51,12 @@ def default_baseline_path() -> Path:
     return Path(__file__).resolve().parent / DEFAULT_BASELINE_NAME
 
 
+def default_cache_path() -> Path:
+    from openr_tpu.analysis.cache import DEFAULT_CACHE_NAME
+
+    return repo_root() / DEFAULT_CACHE_NAME
+
+
 def iter_python_files(root: Path) -> Iterable[Path]:
     if root.is_file():
         yield root
@@ -42,11 +67,9 @@ def iter_python_files(root: Path) -> Iterable[Path]:
         yield p
 
 
-def load_modules(
-    paths: Sequence[Path], base: Optional[Path] = None
-) -> List[ParsedModule]:
-    base = base or repo_root()
-    mods: List[ParsedModule] = []
+def _iter_sources(
+    paths: Sequence[Path], base: Path
+) -> Iterable[Tuple[str, str]]:
     for root in paths:
         for path in iter_python_files(Path(root)):
             try:
@@ -54,41 +77,59 @@ def load_modules(
             except ValueError:
                 rel = path.as_posix()
             try:
-                source = path.read_text()
+                yield rel, path.read_text()
             except (OSError, UnicodeDecodeError):
                 continue
-            try:
-                mods.append(ParsedModule.parse(rel, source))
-            except SyntaxError:
-                # not ours to judge; python itself will complain louder
-                continue
+
+
+def load_modules(
+    paths: Sequence[Path], base: Optional[Path] = None
+) -> List[ParsedModule]:
+    base = base or repo_root()
+    mods: List[ParsedModule] = []
+    for rel, source in _iter_sources(paths, base):
+        try:
+            mods.append(ParsedModule.parse(rel, source))
+        except SyntaxError:
+            # not ours to judge; python itself will complain louder
+            continue
     return mods
 
 
-def analyze_modules(
-    mods: Sequence[ParsedModule],
-    baseline: Optional[Baseline] = None,
-    rules: Optional[Sequence[str]] = None,
+def build_project(mods: Sequence[ParsedModule]) -> Project:
+    return Project([m.summary() for m in mods])
+
+
+def _run_passes(
+    passes, mod: ParsedModule, ctx: dict
+) -> List[Finding]:
+    out: List[Finding] = []
+    for p in passes:
+        out.extend(p.run(mod, ctx))
+    return out
+
+
+def _assemble_report(
+    per_file: Dict[str, Tuple[List[Finding], Suppressions]],
+    files_scanned: int,
+    files_parsed: int,
+    baseline: Optional[Baseline],
+    rules: Optional[Sequence[str]],
 ) -> Report:
-    passes = make_passes()
-    ctx: dict = {}
-    for p in passes:
-        for mod in mods:
-            p.collect(mod, ctx)
-        p.finalize(ctx)
-    report = Report(files_scanned=len(mods))
+    report = Report(
+        files_scanned=files_scanned, files_parsed=files_parsed
+    )
     raw: List[Finding] = []
-    for p in passes:
-        for mod in mods:
-            raw.extend(p.run(mod, ctx))
+    sup_by_rel: Dict[str, Suppressions] = {}
+    for rel, (findings, sup) in per_file.items():
+        raw.extend(findings)
+        sup_by_rel[rel] = sup
     if rules:
         wanted = set(rules)
         raw = [f for f in raw if f.rule in wanted]
     raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     for f in raw:
-        sup = next(
-            (m.suppressions for m in mods if m.rel == f.path), None
-        )
+        sup = sup_by_rel.get(f.path)
         if sup is not None and sup.is_suppressed(f.rule, f.line):
             report.suppressed.append(f)
         else:
@@ -98,11 +139,28 @@ def analyze_modules(
     return report
 
 
+def analyze_modules(
+    mods: Sequence[ParsedModule],
+    baseline: Optional[Baseline] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> Report:
+    passes = make_passes()
+    ctx: dict = {CTX_PROJECT: build_project(mods)}
+    per_file = {
+        mod.rel: (_run_passes(passes, mod, ctx), mod.suppressions)
+        for mod in mods
+    }
+    return _assemble_report(
+        per_file, len(mods), len(mods), baseline, rules
+    )
+
+
 def analyze_paths(
     paths: Optional[Sequence[Path]] = None,
     baseline_path: Optional[Path] = None,
     use_baseline: bool = True,
     rules: Optional[Sequence[str]] = None,
+    cache_path: Optional[Path] = None,
 ) -> Report:
     base = repo_root()
     if not paths:
@@ -110,7 +168,100 @@ def analyze_paths(
     baseline = None
     if use_baseline:
         baseline = Baseline.load(baseline_path or default_baseline_path())
-    return analyze_modules(load_modules(paths, base), baseline, rules)
+    if cache_path is None:
+        return analyze_modules(load_modules(paths, base), baseline, rules)
+    return _analyze_cached(paths, base, baseline, rules, cache_path)
+
+
+# ---------------------------------------------------------------------------
+# the --cache path (see cache.py for the invalidation contract)
+# ---------------------------------------------------------------------------
+
+
+def _analyze_cached(
+    paths: Sequence[Path],
+    base: Path,
+    baseline: Optional[Baseline],
+    rules: Optional[Sequence[str]],
+    cache_path: Path,
+) -> Report:
+    cache = ResultCache.load(cache_path)
+    sources = list(_iter_sources(paths, base))
+    hashes: Dict[str, str] = {}
+    summaries: Dict[str, ModuleSummary] = {}
+    parsed: Dict[str, ParsedModule] = {}
+    cached_entries: Dict[str, dict] = {}
+    files_parsed = 0
+
+    def _parse(rel: str, source: str) -> Optional[ParsedModule]:
+        nonlocal files_parsed
+        pm = parsed.get(rel)
+        if pm is None:
+            try:
+                pm = ParsedModule.parse(rel, source)
+            except SyntaxError:
+                return None
+            files_parsed += 1
+            parsed[rel] = pm
+        return pm
+
+    ordered: List[Tuple[str, str]] = []
+    for rel, source in sources:
+        h = source_hash(source)
+        hashes[rel] = h
+        entry = cache.entry(rel, h)
+        if entry is not None:
+            cached_entries[rel] = entry
+            summaries[rel] = ModuleSummary.from_json(entry["summary"])
+            ordered.append((rel, source))
+        else:
+            pm = _parse(rel, source)
+            if pm is None:
+                continue  # syntax error: skipped exactly like load_modules
+            summaries[rel] = pm.summary()
+            ordered.append((rel, source))
+
+    digest = project_digest(summaries.values())
+    per_file: Dict[str, Tuple[List[Finding], Suppressions]] = {}
+    new_files: Dict[str, dict] = {}
+    passes = None
+    ctx: Optional[dict] = None
+
+    def _ensure_ctx():
+        nonlocal passes, ctx
+        if ctx is None:
+            passes = make_passes()
+            ctx = {CTX_PROJECT: Project(list(summaries.values()))}
+        return passes, ctx
+
+    facts_unchanged = digest == cache.project_digest
+    for rel, source in ordered:
+        entry = cached_entries.get(rel)
+        if facts_unchanged and entry is not None and "findings" in entry:
+            findings = [Finding.from_json(d) for d in entry["findings"]]
+            sup = Suppressions.from_spec(entry.get("suppressions", {}))
+        else:
+            # either this file changed, or the project facts moved under
+            # everyone — both require a live run for this module
+            ps, c = _ensure_ctx()
+            pm = _parse(rel, source)
+            if pm is None:
+                continue
+            findings = _run_passes(ps, pm, c)
+            sup = pm.suppressions
+        per_file[rel] = (findings, sup)
+        new_files[rel] = {
+            "hash": hashes[rel],
+            "summary": summaries[rel].to_json(),
+            "findings": [f.to_json() for f in findings],
+            "suppressions": sup.to_spec(),
+        }
+
+    cache.replace(digest, new_files)
+    cache.save()
+    return _assemble_report(
+        per_file, len(per_file), files_parsed, baseline, rules
+    )
 
 
 def analyze_source(

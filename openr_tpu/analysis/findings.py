@@ -36,8 +36,36 @@ class Finding:
             "snippet": self.snippet,
         }
 
+    @classmethod
+    def from_json(cls, doc: Dict[str, Any]) -> "Finding":
+        return cls(
+            rule=doc["rule"],
+            path=doc["path"],
+            line=int(doc.get("line", 0)),
+            col=int(doc.get("col", 0)),
+            message=doc.get("message", ""),
+            snippet=doc.get("snippet", ""),
+        )
+
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def render_github(self) -> str:
+        """GitHub Actions workflow-command annotation (``--format=github``).
+        Newlines/percents URL-escape per the workflow-command grammar."""
+
+        def esc(s: str, *, prop: bool = False) -> str:
+            s = s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+            if prop:
+                s = s.replace(":", "%3A").replace(",", "%2C")
+            return s
+
+        return (
+            f"::error file={esc(self.path, prop=True)},"
+            f"line={self.line},col={self.col},"
+            f"title={esc('orlint ' + self.rule, prop=True)}"
+            f"::{esc(self.message)}"
+        )
 
 
 @dataclass
@@ -49,6 +77,9 @@ class Report:
     baselined: list = field(default_factory=list)
     stale_baseline: list = field(default_factory=list)  #: entries no finding matched
     files_scanned: int = 0
+    #: how many files were actually ast.parse'd this run (< files_scanned
+    #: when the ``--cache`` result cache serves warm entries)
+    files_parsed: int = 0
 
     @property
     def clean(self) -> bool:
@@ -63,6 +94,7 @@ class Report:
     def to_json(self) -> Dict[str, Any]:
         return {
             "files_scanned": self.files_scanned,
+            "files_parsed": self.files_parsed,
             "counts": self.counts_by_rule(),
             "findings": [f.to_json() for f in self.findings],
             "suppressed": len(self.suppressed),

@@ -8,11 +8,14 @@ docstrings for the law being enforced and where it's written down).
 
 from __future__ import annotations
 
+from typing import Dict, Optional, Tuple
+
 from openr_tpu.analysis.passes.actor_isolation import ActorIsolationPass
 from openr_tpu.analysis.passes.alert_registry import AlertRegistryPass
 from openr_tpu.analysis.passes.async_blocking import AsyncBlockingPass
 from openr_tpu.analysis.passes.base import Pass
 from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
+from openr_tpu.analysis.passes.determinism import DeterminismPass
 from openr_tpu.analysis.passes.jax_hygiene import JaxHygienePass
 from openr_tpu.analysis.passes.pipeline_phase import PipelinePhasePass
 from openr_tpu.analysis.passes.resilience_latch import ResilienceLatchPass
@@ -31,14 +34,38 @@ def make_passes():
         PipelinePhasePass(),
         AlertRegistryPass(),
         SweepOwnershipPass(),
+        DeterminismPass(),
     ]
 
 
-def all_rules():
+def all_rules() -> Dict[str, str]:
     out = {}
     for p in make_passes():
         out.update(p.rules)
     return out
 
 
-__all__ = ["Pass", "make_passes", "all_rules"]
+def rule_families() -> Dict[str, str]:
+    """rule id -> pass (family) name, for ``--list-rules``."""
+    out = {}
+    for p in make_passes():
+        for rule in p.rules:
+            out[rule] = p.name
+    return out
+
+
+def rule_example(rule: str) -> Optional[Tuple[str, Dict]]:
+    """(family, {"trip","fix","context"?}) for ``--explain <rule>``."""
+    for p in make_passes():
+        if rule in p.examples:
+            return p.name, p.examples[rule]
+    return None
+
+
+__all__ = [
+    "Pass",
+    "all_rules",
+    "make_passes",
+    "rule_example",
+    "rule_families",
+]

@@ -9,10 +9,12 @@ moment fibers interleave differently, the exact class of bug
 tests/test_race_stress.py hunts dynamically and DeltaPath-style
 dataflow analysis argues should be caught structurally.
 
-Collection (whole-project): the transitive set of ``Actor`` subclasses,
-then per-module which names/attributes are actor-typed — constructor
-results (``self.spark = Spark(..)``), parameter annotations
-(``spark: Spark``), and local bindings.  Rules:
+Collection (whole-project): the transitive set of ``Actor`` subclasses
+comes from the shared symbol table (``project(ctx).subclasses_of``, the
+call-graph engine — no per-pass project walk), then per-module which
+names/attributes are actor-typed — constructor results
+(``self.spark = Spark(..)``), parameter annotations (``spark: Spark``),
+and local bindings.  Rules:
 
 * ``actor-cross-write``    — store through an actor-typed expression that
                              isn't ``self``: ``node.spark.foo = ..``,
@@ -40,10 +42,7 @@ from openr_tpu.analysis.astutil import (
     resolve,
 )
 from openr_tpu.analysis.findings import Finding
-from openr_tpu.analysis.passes.base import ParsedModule, Pass
-
-_CTX_CLASSES = "actor_isolation.classes"  # class name -> set(base names)
-_CTX_ACTORS = "actor_isolation.actors"  # bare names of Actor subclasses
+from openr_tpu.analysis.passes.base import ParsedModule, Pass, project
 
 
 class ActorIsolationPass(Pass):
@@ -52,38 +51,51 @@ class ActorIsolationPass(Pass):
         "actor-cross-write": "mutating another actor's state bypasses the queue/RPC contract",
         "actor-private-access": "reading another actor's _private state couples across module boundaries",
     }
-
-    # -- phase 1: project-wide actor class hierarchy -----------------------
-
-    def collect(self, mod: ParsedModule, ctx: dict) -> None:
-        classes: Dict[str, Set[str]] = ctx.setdefault(_CTX_CLASSES, {})
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef):
-                bases = set()
-                for b in node.bases:
-                    name = annotation_name(b)
-                    if name:
-                        bases.add(name)
-                classes.setdefault(node.name, set()).update(bases)
-
-    def finalize(self, ctx: dict) -> None:
-        classes = ctx.get(_CTX_CLASSES, {})
-        actors: Set[str] = {"Actor"}
-        changed = True
-        while changed:
-            changed = False
-            for name, bases in classes.items():
-                if name not in actors and bases & actors:
-                    actors.add(name)
-                    changed = True
-        ctx[_CTX_ACTORS] = actors
-
-    # -- phase 2 -----------------------------------------------------------
+    _EXAMPLE_CTX = (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "class Spark(Actor):\n"
+        "    pass\n"
+    )
+    examples = {
+        "actor-cross-write": {
+            "trip": (
+                "from ctx0 import Spark\n"
+                "\n"
+                "def poke(spark: Spark) -> None:\n"
+                "    spark.neighbors = {}\n"
+            ),
+            "fix": (
+                "from ctx0 import Spark\n"
+                "\n"
+                "async def poke(spark: Spark) -> None:\n"
+                "    await spark.queue.put(('reset_neighbors',))\n"
+            ),
+            "context": (_EXAMPLE_CTX,),
+        },
+        "actor-private-access": {
+            "trip": (
+                "from ctx0 import Spark\n"
+                "\n"
+                "def peek(spark: Spark):\n"
+                "    return spark._neighbors\n"
+            ),
+            "fix": (
+                "from ctx0 import Spark\n"
+                "\n"
+                "def peek(spark: Spark):\n"
+                "    return spark.neighbor_snapshot()\n"
+            ),
+            "context": (_EXAMPLE_CTX,),
+        },
+    }
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
         if not mod.is_protocol_plane():
             return []
-        actors: Set[str] = ctx.get(_CTX_ACTORS, {"Actor"})
+        # project-wide transitive Actor hierarchy, by bare class name —
+        # served by the shared symbol table since the call-graph engine
+        actors: Set[str] = project(ctx).subclasses_of("Actor")
         typed = _ActorTypedExprs(mod, actors)
         out: List[Finding] = []
         #: (line, base expr) already flagged as a write — the Load of

@@ -11,7 +11,8 @@ place the ``health.alert.`` prefix may be spelled; everything else
 goes through ``alert_counter_key(name)`` (which validates the name
 against ``ALERTS``) or the name constants.
 
-Rule (mirrors ``pipeline-phase-registry``):
+Rule (mirrors ``pipeline-phase-registry``; both ride the shared
+string-literal index + declarative base in registry_strings.py):
 
 * ``alert-name-registry`` — a string literal (or f-string head)
   beginning with ``health.alert.`` anywhere outside the registry
@@ -21,11 +22,7 @@ Rule (mirrors ``pipeline-phase-registry``):
 
 from __future__ import annotations
 
-import ast
-from typing import List
-
-from openr_tpu.analysis.findings import Finding
-from openr_tpu.analysis.passes.base import ParsedModule, Pass
+from openr_tpu.analysis.passes.registry_strings import StringPrefixRegistryPass
 
 #: the registry itself (the only module allowed to spell the prefix) —
 #: and this pass, which must spell it to detect it
@@ -37,8 +34,9 @@ ALLOWED_PREFIXES = (
 _PREFIX = "health.alert."
 
 
-class AlertRegistryPass(Pass):
+class AlertRegistryPass(StringPrefixRegistryPass):
     name = "alert-registry"
+    rule = "alert-name-registry"
     rules = {
         "alert-name-registry": (
             "health.alert.* counter name spelled as a free string "
@@ -47,42 +45,24 @@ class AlertRegistryPass(Pass):
             "enumerable)"
         ),
     }
-
-    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
-        if mod.rel.startswith(ALLOWED_PREFIXES):
-            return []
-        # constants living inside f-strings are reported once, via their
-        # enclosing JoinedStr, not a second time as bare constants
-        inside_fstring = {
-            id(v)
-            for node in ast.walk(mod.tree)
-            if isinstance(node, ast.JoinedStr)
-            for v in node.values
-        }
-        out: List[Finding] = []
-        for node in ast.walk(mod.tree):
-            value = None
-            if (
-                isinstance(node, ast.Constant)
-                and isinstance(node.value, str)
-                and id(node) not in inside_fstring
-            ):
-                value = node.value
-            elif isinstance(node, ast.JoinedStr) and node.values:
-                head = node.values[0]
-                if isinstance(head, ast.Constant) and isinstance(
-                    head.value, str
-                ):
-                    value = head.value
-            if value is None or not value.startswith(_PREFIX):
-                continue
-            out.append(
-                mod.finding(
-                    "alert-name-registry",
-                    node,
-                    f"free-string alert name {value!r}; use the "
-                    "openr_tpu.health.alerts registry "
-                    "(ALERTS / alert_counter_key)",
-                )
-            )
-        return out
+    prefix = _PREFIX
+    allowed_prefixes = ALLOWED_PREFIXES
+    what = "alert name"
+    hint = (
+        "use the openr_tpu.health.alerts registry "
+        "(ALERTS / alert_counter_key)"
+    )
+    examples = {
+        "alert-name-registry": {
+            "trip": (
+                "def fire(counters):\n"
+                '    counters.bump("health.alert.chip_quarantine")\n'
+            ),
+            "fix": (
+                "from openr_tpu.health.alerts import alert_counter_key\n"
+                "\n"
+                "def fire(counters):\n"
+                '    counters.bump(alert_counter_key("chip_quarantine"))\n'
+            ),
+        },
+    }

@@ -51,6 +51,22 @@ class AsyncBlockingPass(Pass):
     rules = {
         "async-blocking": "synchronous I/O inside async def stalls every actor on the shared loop",
     }
+    examples = {
+        "async-blocking": {
+            "trip": (
+                "class Loader:\n"
+                "    async def load(self, path):\n"
+                "        return open(path).read()\n"
+            ),
+            "fix": (
+                "class Loader:\n"
+                "    async def load(self, loop, path):\n"
+                "        def _read():\n"
+                "            return open(path).read()\n"
+                "        return await loop.run_in_executor(None, _read)\n"
+            ),
+        },
+    }
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
         if not mod.is_protocol_plane():

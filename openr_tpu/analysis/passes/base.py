@@ -1,12 +1,24 @@
-"""Pass interface + the per-file parse unit the engine hands to passes."""
+"""Pass interface + the per-file parse unit the engine hands to passes.
+
+Since the call-graph engine (analysis/callgraph.py) the contract is:
+
+* the engine builds ONE :class:`~openr_tpu.analysis.callgraph.Project`
+  (symbol table + call graph) from every module's serializable summary
+  and publishes it in the shared ``ctx`` — passes query it via
+  :func:`project` instead of each running its own project-wide AST walk;
+* ``Pass.run(mod, ctx)`` stays per-module and may use ``mod``'s AST
+  freely (a module being run is always parsed; cached modules skip
+  ``run`` entirely — see cache.py).
+"""
 
 from __future__ import annotations
 
 import ast
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 from openr_tpu.analysis.astutil import ImportMap, attach_parents
+from openr_tpu.analysis.callgraph import ModuleSummary, Project, summarize_module
 from openr_tpu.analysis.findings import Finding
 from openr_tpu.analysis.suppress import Suppressions
 
@@ -19,6 +31,14 @@ NON_PROTOCOL_PREFIXES = (
     "openr_tpu/examples/",
     "openr_tpu/analysis/",
 )
+
+#: ctx key the engine publishes the Project under
+CTX_PROJECT = "project"
+
+
+def project(ctx: dict) -> Project:
+    """The shared symbol table + call graph for this analysis run."""
+    return ctx[CTX_PROJECT]
 
 
 @dataclass
@@ -51,6 +71,51 @@ class ParsedModule:
             lines=source.splitlines(),
         )
 
+    def summary(self) -> ModuleSummary:
+        """This module's serializable cross-module facts (cached)."""
+        cached = getattr(self, "_orlint_summary", None)
+        if cached is None:
+            from openr_tpu.analysis.passes.jax_hygiene import collect_jitted
+
+            jitted, _bodies = collect_jitted(self.tree, self.imports)
+            cached = summarize_module(
+                self.module_name, self.rel, self.tree, self.imports,
+                jitted=jitted,
+            )
+            self._orlint_summary = cached
+        return cached
+
+    def string_literals(self) -> List[Tuple[ast.AST, str]]:
+        """Every string constant + f-string head in the module, one walk,
+        shared by the prefix-registry passes: ``(node, text)`` where an
+        f-string is reported ONCE via its JoinedStr head and its inner
+        constants are excluded (the f-string-head dedupe)."""
+        cached = getattr(self, "_orlint_strings", None)
+        if cached is not None:
+            return cached
+        inside_fstring = {
+            id(v)
+            for node in ast.walk(self.tree)
+            if isinstance(node, ast.JoinedStr)
+            for v in node.values
+        }
+        out: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in inside_fstring
+            ):
+                out.append((node, node.value))
+            elif isinstance(node, ast.JoinedStr) and node.values:
+                head = node.values[0]
+                if isinstance(head, ast.Constant) and isinstance(
+                    head.value, str
+                ):
+                    out.append((node, head.value))
+        self._orlint_strings = out
+        return out
+
     def snippet(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1].strip()
@@ -66,24 +131,35 @@ class ParsedModule:
             snippet=self.snippet(node.lineno),
         )
 
+    def finding_at(self, rule: str, line: int, message: str) -> Finding:
+        """Finding anchored to a line number (call-graph passes work from
+        summaries whose call refs carry lines, not AST nodes)."""
+        return Finding(
+            rule=rule,
+            path=self.rel,
+            line=line,
+            col=0,
+            message=message,
+            snippet=self.snippet(line),
+        )
+
     def is_protocol_plane(self) -> bool:
         return not self.rel.startswith(NON_PROTOCOL_PREFIXES)
 
 
 class Pass:
-    """One invariant family.  Two-phase: every pass sees every module in
-    ``collect`` (cross-module facts: actor classes, jitted kernels), then
-    ``finalize`` closes over the collected facts, then ``run`` emits
-    findings per module."""
+    """One invariant family.  ``run`` emits findings per module; every
+    cross-module fact comes from the shared :func:`project` (symbol
+    table + call graph) the engine built before any pass ran.
+
+    ``examples`` powers the ``--explain <rule>`` CLI: per rule a minimal
+    tripping snippet and its fixed twin (validated by a meta-test — the
+    trip must trip exactly that rule, the fix must be clean)."""
 
     name = "base"
     rules: Dict[str, str] = {}
-
-    def collect(self, mod: ParsedModule, ctx: dict) -> None:
-        return
-
-    def finalize(self, ctx: dict) -> None:
-        return
+    #: rule -> {"trip": src, "fix": src, "context": (extra srcs,)}
+    examples: Dict[str, Dict] = {}
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
         raise NotImplementedError

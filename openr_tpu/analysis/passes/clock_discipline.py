@@ -50,6 +50,43 @@ class ClockDisciplinePass(Pass):
         "clock-now": "raw wall-time read bypasses the injected Clock",
         "clock-call-later": "event-loop timer bypasses the injected Clock",
     }
+    examples = {
+        "clock-sleep": {
+            "trip": (
+                "import asyncio\n"
+                "\n"
+                "async def retry_loop():\n"
+                "    await asyncio.sleep(0.5)\n"
+            ),
+            "fix": (
+                "async def retry_loop(clock):\n"
+                "    await clock.sleep(0.5)\n"
+            ),
+        },
+        "clock-now": {
+            "trip": (
+                "import time\n"
+                "\n"
+                "def deadline():\n"
+                "    return time.monotonic() + 5.0\n"
+            ),
+            "fix": (
+                "def deadline(clock):\n"
+                "    return clock.now() + 5.0\n"
+            ),
+        },
+        "clock-call-later": {
+            "trip": (
+                "def arm(loop, cb):\n"
+                "    loop.call_later(1.0, cb)\n"
+            ),
+            "fix": (
+                "async def arm(clock, cb):\n"
+                "    await clock.sleep(1.0)\n"
+                "    cb()\n"
+            ),
+        },
+    }
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
         if not mod.is_protocol_plane():

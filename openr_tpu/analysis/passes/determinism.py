@@ -1,0 +1,532 @@
+"""Replay-determinism family — byte-identical seeded replay, enforced
+statically (rule family 9, docs/Developer_Guide.md).
+
+Every artifact this repo ships stakes its correctness claim on
+byte-identical seeded replay: chaos acceptance runs compare two replays
+of the same FaultPlan byte for byte, the sweep's kill-and-resume proof
+compares ``summary_digest`` values, the streaming plane's chaos tests
+compare emission logs, and the health plane compares alert-transition
+JSONL.  One unsorted ``set`` iteration feeding any of those sinks — or
+one wall-clock read on a path a replay executes — breaks the gate weeks
+later, in whichever PR happens to perturb hash seeds or arrival order.
+DeltaPath-style incremental engines (PAPERS.md) are only trustworthy
+when delta/merge order is deterministic; these rules make the ordering
+contract structural instead of tribal.
+
+Rules (all interprocedural, riding analysis/callgraph.py):
+
+* ``unordered-emission`` — iterating a ``set``/``dict`` (or a
+  ``.items()``/``.keys()``/``.values()`` view) without an explicit
+  order, where the loop body reaches a **declared determinism sink**
+  (digest / spill / wire / alert-log — see ``SINK_FUNCTIONS`` /
+  ``SINK_METHODS`` below).  ``sorted(...)`` around the iterable is the
+  sanctioned spelling.  Python dicts iterate in insertion order, which
+  is an accident of arrival, not content — two nodes merging the same
+  facts in different orders emit different bytes.
+
+* ``wallclock-reachability`` — the interprocedural upgrade of
+  clock-discipline: an undisciplined ``time.*`` / ``datetime.now``
+  read is flagged when the function containing it is *reachable from a
+  replay-critical root* (actor run loops, the sweep reducer/spill
+  plane, streaming emission, alert/metrics export), no matter how many
+  helpers deep.  Calls dispatched through a ``Clock``-typed receiver
+  are the sanctioned discipline and form a traversal **barrier** — the
+  same read behind an injected Clock does not trip.
+
+* ``unseeded-random`` — global-state randomness (``random.random()``,
+  ``np.random.*`` module draws, unseeded ``random.Random()`` /
+  ``default_rng()``) outside the seeded-Generator plumbing every
+  chaos/emulation component uses (``random.Random(seed)``).
+
+* ``unstable-sort-key`` — ordering by ``id(...)`` or runtime
+  ``hash(...)``: object identity changes every process, and str hashes
+  change with PYTHONHASHSEED, so the "stable" order is stable only
+  within one run — exactly what a replay diff catches, eventually.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from openr_tpu.analysis.astutil import (
+    enclosing_class,
+    enclosing_functions,
+    resolve,
+)
+from openr_tpu.analysis.callgraph import (
+    FunctionInfo,
+    ModuleSummary,
+    Reach,
+    call_ref_for,
+)
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass, project
+
+# ---------------------------------------------------------------------------
+# the determinism SINK registry — where replayed bytes are minted.
+# Each entry names a function/method whose input ORDER becomes output
+# bytes: feed it from an unordered iteration and two replays disagree.
+# ---------------------------------------------------------------------------
+
+#: fully-qualified functions (internal qualnames or external dotted)
+SINK_FUNCTIONS = {
+    # THE canonical encoding for everything the sweep hashes or spills
+    "openr_tpu.sweep.scenario.canonical_json",
+    "openr_tpu.sweep.scenario.content_hash",
+    # streaming wire spelling shared encodes splice fragments of
+    "openr_tpu.serving.streaming.canonical_wire",
+}
+
+#: external callable families that digest their call ORDER
+SINK_FUNCTION_PREFIXES = ("hashlib.",)
+
+#: distinctive method names (receiver often untypable statically):
+#: sweep spill + checkpoint commit, metrics JSONL export, streaming
+#: wire delivery, alert transition log, digest finalization
+SINK_METHODS = {
+    "spill_rows",
+    "commit_shard",
+    "write_nodes",
+    "to_jsonl",
+    "deliver_wire",
+    "summary_digest",
+    "hexdigest",
+    "_log_event",
+}
+
+#: bare-name callables (callback parameters by convention)
+SINK_BARE = {"deliver_wire"}
+
+
+def is_sink(target: str) -> bool:
+    if target in SINK_FUNCTIONS or target in SINK_BARE:
+        return True
+    if target.startswith(SINK_FUNCTION_PREFIXES):
+        return True
+    if "." in target:
+        return target.rsplit(".", 1)[-1] in SINK_METHODS
+    return False
+
+
+# ---------------------------------------------------------------------------
+# replay-critical ROOTS — what a seeded replay re-executes.
+# ---------------------------------------------------------------------------
+
+#: Actor-subclass methods that are fiber entry points: ``run`` (the main
+#: fiber), ``start`` (which spawns the queue loops / timer callbacks),
+#: and ``__init__`` (which registers debounce/listener callbacks) —
+#: callback harvesting in callgraph.py turns those registrations into
+#: edges, so everything an actor wires up is replay-critical
+ACTOR_LOOP_METHODS = ("run", "start", "__init__")
+
+#: module trees that ARE emission/reduction planes: every function in
+#: them must behave identically across replays
+ROOT_MODULE_PREFIXES = (
+    "openr_tpu.sweep.reduce.",
+    "openr_tpu.sweep.spill.",
+    "openr_tpu.sweep.executor.",
+    "openr_tpu.serving.streaming.",
+    "openr_tpu.health.alerts.",
+    "openr_tpu.monitor.metrics.",
+)
+
+#: classes whose method calls are the *sanctioned* time discipline —
+#: traversal stops at the barrier (subclasses resolved transitively)
+BARRIER_CLASSES = ("Clock",)
+
+#: undisciplined wall-time reads (superset of clock-now: datetime too)
+WALLCLOCK_TARGETS = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+}
+
+#: random module-level draws that touch global state
+_RANDOM_GLOBAL = {
+    "betavariate", "choice", "choices", "expovariate", "gauss",
+    "getrandbits", "lognormvariate", "normalvariate", "paretovariate",
+    "randint", "random", "randrange", "sample", "seed", "shuffle",
+    "triangular", "uniform", "vonmisesvariate", "weibullvariate",
+    "randbytes",
+}
+
+#: numpy.random names that are seeded-Generator plumbing, not draws
+_NP_RANDOM_PLUMBING = {
+    "default_rng", "Generator", "RandomState", "SeedSequence",
+    "BitGenerator", "PCG64", "PCG64DXSM", "Philox", "MT19937", "SFC64",
+}
+
+_CTX_REACH = "determinism.reach"  #: qualname -> Reach, lazily built
+_CTX_SINK_MEMO = "determinism.sink_memo"
+
+_DICT_VIEWS = ("items", "keys", "values")
+
+
+class DeterminismPass(Pass):
+    name = "determinism"
+    rules = {
+        "unordered-emission": (
+            "set/dict iterated without an explicit order while the loop "
+            "body reaches a digest/spill/wire/alert sink (breaks "
+            "byte-identical replay)"
+        ),
+        "wallclock-reachability": (
+            "undisciplined wall-clock read reachable from a "
+            "replay-critical root (actor loop / reducer / emission "
+            "path) — inject a Clock"
+        ),
+        "unseeded-random": (
+            "global-state randomness outside seeded-Generator plumbing "
+            "(replays draw different values)"
+        ),
+        "unstable-sort-key": (
+            "ordering by id()/hash() of non-content values — stable "
+            "only within one process, never across replays"
+        ),
+    }
+
+    examples = {
+        "unordered-emission": {
+            "trip": (
+                "from openr_tpu.sweep.scenario import canonical_json\n"
+                "\n"
+                "def emit(rows: dict, out):\n"
+                "    for k, v in rows.items():\n"
+                "        out.append(canonical_json({k: v}))\n"
+            ),
+            "fix": (
+                "from openr_tpu.sweep.scenario import canonical_json\n"
+                "\n"
+                "def emit(rows: dict, out):\n"
+                "    for k, v in sorted(rows.items()):\n"
+                "        out.append(canonical_json({k: v}))\n"
+            ),
+        },
+        "wallclock-reachability": {
+            "trip": (
+                "from openr_tpu.common.runtime import Actor\n"
+                "from datetime import datetime\n"
+                "\n"
+                "class Poller(Actor):\n"
+                "    async def run(self):\n"
+                "        self._tick()\n"
+                "\n"
+                "    def _tick(self):\n"
+                "        return self._stamp()\n"
+                "\n"
+                "    def _stamp(self):\n"
+                "        return datetime.now()\n"
+            ),
+            "fix": (
+                "from openr_tpu.common.runtime import Actor, Clock\n"
+                "\n"
+                "class Poller(Actor):\n"
+                "    def __init__(self, clock: Clock):\n"
+                "        self.clock = clock\n"
+                "\n"
+                "    async def run(self):\n"
+                "        self._tick()\n"
+                "\n"
+                "    def _tick(self):\n"
+                "        return self._stamp()\n"
+                "\n"
+                "    def _stamp(self):\n"
+                "        return self.clock.now()\n"
+            ),
+        },
+        "unseeded-random": {
+            "trip": (
+                "import random\n"
+                "\n"
+                "def jitter():\n"
+                "    return random.random()\n"
+            ),
+            "fix": (
+                "import random\n"
+                "\n"
+                "def jitter(seed: int):\n"
+                "    return random.Random(seed).random()\n"
+            ),
+        },
+        "unstable-sort-key": {
+            "trip": (
+                "def order(rows):\n"
+                "    return sorted(rows, key=id)\n"
+            ),
+            "fix": (
+                "def order(rows):\n"
+                "    return sorted(rows, key=lambda r: r.name)\n"
+            ),
+        },
+    }
+
+    # -- shared project queries (lazy, memoized in ctx) --------------------
+
+    def _reach(self, ctx: dict) -> Dict[str, Reach]:
+        reach = ctx.get(_CTX_REACH)
+        if reach is None:
+            proj = project(ctx)
+            actors = proj.subclasses_of("Actor")
+            barrier_owners: Set[str] = set()
+            for b in BARRIER_CLASSES:
+                barrier_owners |= proj.subclasses_of(b)
+            roots = [
+                qual
+                for qual, fn in proj.functions.items()
+                if (fn.cls in actors and fn.name in ACTOR_LOOP_METHODS)
+                or qual.startswith(ROOT_MODULE_PREFIXES)
+            ]
+            reach = proj.reachable_from(
+                roots,
+                barrier=lambda q: proj.owner_class(q) in barrier_owners,
+            )
+            ctx[_CTX_REACH] = reach
+        return reach
+
+    def _sink_memo(self, ctx: dict) -> Dict[str, bool]:
+        return ctx.setdefault(_CTX_SINK_MEMO, {})
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        out: List[Finding] = []
+        summary = mod.summary()
+        # wallclock-reachability is deliberately NOT protocol-plane
+        # gated: the whole point is catching a helper in a tree the
+        # per-site rules exempt, reached from a replay root.
+        out.extend(self._wallclock(mod, summary, ctx))
+        if mod.is_protocol_plane():
+            out.extend(self._unordered_emission(mod, summary, ctx))
+            out.extend(self._unseeded_random(mod))
+            out.extend(self._unstable_sort_key(mod))
+        out.sort(key=lambda f: (f.line, f.col, f.rule))
+        return out
+
+    # -- wallclock-reachability -------------------------------------------
+
+    def _wallclock(
+        self, mod: ParsedModule, summary: ModuleSummary, ctx: dict
+    ) -> List[Finding]:
+        reach = self._reach(ctx)
+        out: List[Finding] = []
+        for local_qual, fn in summary.functions.items():
+            qual = (
+                f"{summary.module}.{local_qual}"
+                if summary.module
+                else local_qual
+            )
+            r = reach.get(qual)
+            if r is None:
+                continue
+            for ref in fn.calls:
+                if ref[0] == "n" and ref[1] in WALLCLOCK_TARGETS:
+                    hops = (
+                        f"{r.hops} call hop{'s' if r.hops != 1 else ''}"
+                    )
+                    out.append(
+                        mod.finding_at(
+                            "wallclock-reachability",
+                            ref[-1],
+                            f"`{ref[1]}` is {hops} from replay-critical "
+                            f"root `{r.root}`; a replay re-executes this "
+                            "path — read time from the injected Clock",
+                        )
+                    )
+        return out
+
+    # -- unordered-emission ------------------------------------------------
+
+    def _fn_info_for(
+        self, node: ast.AST, summary: ModuleSummary
+    ) -> Optional[FunctionInfo]:
+        fns = enclosing_functions(node)
+        if not fns:
+            return summary.functions.get("<module>")
+        outer = fns[-1]
+        cls = enclosing_class(outer)
+        key = f"{cls.name}.{outer.name}" if cls is not None else outer.name
+        return summary.functions.get(key)
+
+    def _unordered_desc(
+        self,
+        it: ast.expr,
+        fn: Optional[FunctionInfo],
+        summary: ModuleSummary,
+        mod: ParsedModule,
+    ) -> Optional[str]:
+        """Why this iterable has no defined order, or None if it does."""
+        if isinstance(it, ast.Call):
+            f = it.func
+            if isinstance(f, ast.Attribute) and f.attr in _DICT_VIEWS:
+                return f"`.{f.attr}()` view"
+            target = resolve(f, mod.imports)
+            if target in ("set", "frozenset"):
+                return f"`{target}(...)`"
+            return None  # sorted(...), list(...) of a sorted, helpers
+        if isinstance(it, (ast.Set, ast.SetComp)):
+            return "set literal"
+        ref: Optional[str] = None
+        shown = ""
+        if isinstance(it, ast.Name):
+            shown = it.id
+            if fn is not None:
+                ref = fn.var_types.get(it.id)
+        elif (
+            isinstance(it, ast.Attribute)
+            and isinstance(it.value, ast.Name)
+            and it.value.id == "self"
+        ):
+            shown = f"self.{it.attr}"
+            cls = enclosing_class(it)
+            if cls is not None:
+                cinfo = summary.classes.get(cls.name)
+                if cinfo is not None:
+                    ref = cinfo.attrs.get(it.attr)
+        if ref == "set":
+            return f"set `{shown}`"
+        if ref == "dict":
+            return f"dict `{shown}`"
+        return None
+
+    def _unordered_emission(
+        self, mod: ParsedModule, summary: ModuleSummary, ctx: dict
+    ) -> List[Finding]:
+        proj = project(ctx)
+        memo = self._sink_memo(ctx)
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            fn = self._fn_info_for(node, summary)
+            desc = self._unordered_desc(node.iter, fn, summary, mod)
+            if desc is None:
+                continue
+            hit = self._loop_reaches_sink(node, fn, summary, proj, memo, mod)
+            if hit is None:
+                continue
+            out.append(
+                mod.finding(
+                    "unordered-emission",
+                    node,
+                    f"iterating {desc} without an explicit order, and the "
+                    f"loop body reaches determinism sink `{hit}` — wrap "
+                    "the iterable in sorted(..) so two replays emit "
+                    "identical bytes",
+                )
+            )
+        return out
+
+    def _loop_reaches_sink(
+        self,
+        loop: ast.AST,
+        fn: Optional[FunctionInfo],
+        summary: ModuleSummary,
+        proj,
+        memo: Dict[str, bool],
+        mod: ParsedModule,
+    ) -> Optional[str]:
+        targets: Set[str] = set()
+        fn = fn or FunctionInfo(name="<module>", cls="", line=0, end_line=0)
+        for stmt in list(loop.body) + list(getattr(loop, "orelse", [])):
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    ref = call_ref_for(sub, mod.imports)
+                    targets.update(proj.resolve_ref(summary, fn, ref))
+        if not targets:
+            return None
+        return proj.targets_reach(targets, is_sink, _memo=memo)
+
+    # -- unseeded-random ---------------------------------------------------
+
+    def _unseeded_random(self, mod: ParsedModule) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve(node.func, mod.imports)
+            if not target:
+                continue
+            msg = None
+            if target == "random.Random" and not node.args and not node.keywords:
+                msg = (
+                    "`random.Random()` without a seed draws from OS "
+                    "entropy; pass an explicit seed (the chaos/emulation "
+                    "pattern: `random.Random(seed)`)"
+                )
+            elif (
+                target.startswith("random.")
+                and target.split(".", 1)[1] in _RANDOM_GLOBAL
+            ):
+                msg = (
+                    f"`{target}` mutates/draws the process-global RNG; "
+                    "replays and concurrent draws interleave — use a "
+                    "seeded `random.Random(seed)` instance"
+                )
+            elif target.startswith("numpy.random."):
+                tail = target.split(".")[-1]
+                if tail in ("default_rng", "RandomState"):
+                    if not node.args and not node.keywords:
+                        msg = (
+                            f"`{target}()` without a seed; pass one so "
+                            "device-side draws replay"
+                        )
+                elif tail not in _NP_RANDOM_PLUMBING:
+                    msg = (
+                        f"`{target}` draws numpy's global RNG; use a "
+                        "seeded `numpy.random.default_rng(seed)` Generator"
+                    )
+            if msg is not None:
+                out.append(mod.finding("unseeded-random", node, msg))
+        return out
+
+    # -- unstable-sort-key -------------------------------------------------
+
+    def _unstable_sort_key(self, mod: ParsedModule) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve(node.func, mod.imports)
+            is_order_call = target in ("sorted", "min", "max") or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "sort"
+            )
+            if not is_order_call:
+                continue
+            for kw in node.keywords:
+                if kw.arg != "key":
+                    continue
+                bad = self._identity_key(kw.value)
+                if bad is not None:
+                    out.append(
+                        mod.finding(
+                            "unstable-sort-key",
+                            node,
+                            f"ordering by `{bad}` — object identity / "
+                            "runtime hashes differ across processes, so "
+                            "the order never replays; key on content "
+                            "(name, tuple of fields) instead",
+                        )
+                    )
+        return out
+
+    def _identity_key(self, key: ast.expr) -> Optional[str]:
+        if isinstance(key, ast.Name) and key.id in ("id", "hash"):
+            return key.id
+        if isinstance(key, ast.Lambda):
+            for sub in ast.walk(key.body):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id in ("id", "hash")
+                ):
+                    return f"{sub.func.id}(..)"
+        return None

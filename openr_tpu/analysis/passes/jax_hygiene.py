@@ -28,23 +28,25 @@ Three failure modes this pass catches structurally:
 
 Collection is project-wide: jitted names are gathered per module
 (decorator form, ``functools.partial(jax.jit, ..)`` form, and
-``name = jax.jit(fn, ..)`` assignment form), so an importing module's
-direct call of another module's kernel is still flagged.
+``name = jax.jit(fn, ..)`` assignment form) by :func:`collect_jitted` —
+which also feeds every module's summary, so the cross-module registry
+now rides the shared symbol table (``project(ctx).jitted_registry()``)
+instead of a per-pass collect walk, and an importing module's direct
+call of another module's kernel is still flagged.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from openr_tpu.analysis.astutil import (
+    ImportMap,
     enclosing_functions,
     resolve,
 )
 from openr_tpu.analysis.findings import Finding
-from openr_tpu.analysis.passes.base import ParsedModule, Pass
-
-_CTX_JIT = "jax_hygiene.jitted"  # module name -> {fn name -> static argnames}
+from openr_tpu.analysis.passes.base import ParsedModule, Pass, project
 
 _SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
 _HOST_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
@@ -89,6 +91,45 @@ def _static_argnames(node: ast.expr) -> Set[str]:
     return names
 
 
+def collect_jitted(
+    tree: ast.Module, imports: ImportMap
+) -> Tuple[Dict[str, Set[str]], Dict[ast.AST, Set[str]]]:
+    """One module's jitted surface: ``{name -> static argnames}`` (what
+    the project summary publishes) and ``{FunctionDef -> statics}`` for
+    the traced bodies this pass inspects locally."""
+    jitted: Dict[str, Set[str]] = {}
+    bodies: Dict[ast.AST, Set[str]] = {}
+    defs_by_name = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                jt = _jit_target(dec, imports)
+                if jt is not None:
+                    statics = _static_argnames(jt)
+                    jitted[node.name] = statics
+                    bodies[node] = statics
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            jt = _jit_target(node.value, imports)
+            if jt is None or resolve(node.value.func, imports) != "jax.jit":
+                continue
+            statics = _static_argnames(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    jitted[t.id] = statics
+            # `fn = jax.jit(_impl, ..)`: the traced body is _impl's
+            if node.value.args:
+                impl = node.value.args[0]
+                if isinstance(impl, ast.Name) and impl.id in defs_by_name:
+                    bodies[defs_by_name[impl.id]] = statics
+    return jitted, bodies
+
+
 class JaxHygienePass(Pass):
     name = "jax-hygiene"
     rules = {
@@ -96,48 +137,76 @@ class JaxHygienePass(Pass):
         "jit-traced-branch": "Python control flow on a traced value inside a jitted body",
         "jit-host-sync": "host synchronization inside a jitted body",
     }
-
-    # -- phase 1: which names are jitted, per module -----------------------
-
-    def collect(self, mod: ParsedModule, ctx: dict) -> None:
-        jitted: Dict[str, Set[str]] = {}
-        #: jitted function bodies to inspect: FunctionDef -> static names
-        bodies: Dict[ast.AST, Set[str]] = {}
-        defs_by_name = {
-            n.name: n
-            for n in ast.walk(mod.tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        }
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.FunctionDef):
-                for dec in node.decorator_list:
-                    jt = _jit_target(dec, mod.imports)
-                    if jt is not None:
-                        statics = _static_argnames(jt)
-                        jitted[node.name] = statics
-                        bodies[node] = statics
-            elif isinstance(node, ast.Assign) and isinstance(
-                node.value, ast.Call
-            ):
-                jt = _jit_target(node.value, mod.imports)
-                if jt is None or resolve(node.value.func, mod.imports) != "jax.jit":
-                    continue
-                statics = _static_argnames(node.value)
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        jitted[t.id] = statics
-                # `fn = jax.jit(_impl, ..)`: the traced body is _impl's
-                if node.value.args:
-                    impl = node.value.args[0]
-                    if isinstance(impl, ast.Name) and impl.id in defs_by_name:
-                        bodies[defs_by_name[impl.id]] = statics
-        ctx.setdefault(_CTX_JIT, {})[mod.module_name] = jitted
-        mod.tree.orlint_jit_bodies = bodies  # type: ignore[attr-defined]
-
-    # -- phase 2 -----------------------------------------------------------
+    _EXAMPLE_CTX = (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    return x * 2\n"
+    )
+    examples = {
+        "jit-unguarded-call": {
+            "trip": (
+                "from ctx0 import kernel\n"
+                "\n"
+                "def run(v):\n"
+                "    return kernel(v)\n"
+            ),
+            "fix": (
+                "from ctx0 import kernel\n"
+                "from openr_tpu.ops.jit_guard import call_jit_guarded\n"
+                "\n"
+                "def run(v):\n"
+                "    return call_jit_guarded(kernel, v)\n"
+            ),
+            "context": (_EXAMPLE_CTX,),
+        },
+        "jit-traced-branch": {
+            "trip": (
+                "import jax\n"
+                "\n"
+                "@jax.jit\n"
+                "def clamp(x):\n"
+                "    if x > 0:\n"
+                "        return x\n"
+                "    return -x\n"
+            ),
+            "fix": (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "\n"
+                "@jax.jit\n"
+                "def clamp(x):\n"
+                "    return jnp.abs(x)\n"
+            ),
+        },
+        "jit-host-sync": {
+            "trip": (
+                "import jax\n"
+                "\n"
+                "@jax.jit\n"
+                "def bad(x):\n"
+                "    return x.block_until_ready()\n"
+            ),
+            "fix": (
+                "import jax\n"
+                "from openr_tpu.ops.jit_guard import call_jit_guarded\n"
+                "\n"
+                "@jax.jit\n"
+                "def good(x):\n"
+                "    return x * 2\n"
+                "\n"
+                "def run(x):\n"
+                "    return call_jit_guarded(good, x).block_until_ready()\n"
+            ),
+        },
+    }
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
-        registry: Dict[str, Dict[str, Set[str]]] = ctx.get(_CTX_JIT, {})
+        #: cross-module jitted names ride the shared symbol table
+        registry: Dict[str, Dict[str, Set[str]]] = project(
+            ctx
+        ).jitted_registry()
         local = registry.get(mod.module_name, {})
         # names imported from other modules that are jitted there
         imported: Set[str] = set()
@@ -146,9 +215,8 @@ class JaxHygienePass(Pass):
             if src_name in registry.get(src_mod, {}):
                 imported.add(name)
         jitted_names = set(local) | imported
-        bodies: Dict[ast.AST, Set[str]] = getattr(
-            mod.tree, "orlint_jit_bodies", {}
-        )
+        #: jitted function bodies to inspect (local to this module's AST)
+        _, bodies = collect_jitted(mod.tree, mod.imports)
 
         out: List[Finding] = []
         for node in ast.walk(mod.tree):

@@ -14,15 +14,14 @@ Rule:
   beginning with ``pipeline.`` anywhere outside the registry module.
   Reads through the constants are invisible to this pass by
   construction — that is the point.
+
+Implementation rides the shared string-literal index + declarative base
+in registry_strings.py (one walk serves every prefix-registry rule).
 """
 
 from __future__ import annotations
 
-import ast
-from typing import List
-
-from openr_tpu.analysis.findings import Finding
-from openr_tpu.analysis.passes.base import ParsedModule, Pass
+from openr_tpu.analysis.passes.registry_strings import StringPrefixRegistryPass
 
 #: the registry itself (the only module allowed to spell the prefix) —
 #: and this pass, which must spell it to detect it
@@ -34,8 +33,9 @@ ALLOWED_PREFIXES = (
 _PREFIX = "pipeline."
 
 
-class PipelinePhasePass(Pass):
+class PipelinePhasePass(StringPrefixRegistryPass):
     name = "pipeline-phase"
+    rule = "pipeline-phase-registry"
     rules = {
         "pipeline-phase-registry": (
             "pipeline.* metric/span name spelled as a free string "
@@ -44,30 +44,24 @@ class PipelinePhasePass(Pass):
             "under a schema-known name)"
         ),
     }
-
-    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
-        if mod.rel.startswith(ALLOWED_PREFIXES):
-            return []
-        out: List[Finding] = []
-        for node in ast.walk(mod.tree):
-            value = None
-            if isinstance(node, ast.Constant) and isinstance(node.value, str):
-                value = node.value
-            elif isinstance(node, ast.JoinedStr) and node.values:
-                head = node.values[0]
-                if isinstance(head, ast.Constant) and isinstance(
-                    head.value, str
-                ):
-                    value = head.value
-            if value is None or not value.startswith(_PREFIX):
-                continue
-            out.append(
-                mod.finding(
-                    "pipeline-phase-registry",
-                    node,
-                    f"free-string pipeline name {value!r}; use the "
-                    "openr_tpu.tracing.pipeline registry constants "
-                    "(PHASES / hist_key / span_name)",
-                )
-            )
-        return out
+    prefix = _PREFIX
+    allowed_prefixes = ALLOWED_PREFIXES
+    what = "pipeline name"
+    hint = (
+        "use the openr_tpu.tracing.pipeline registry constants "
+        "(PHASES / hist_key / span_name)"
+    )
+    examples = {
+        "pipeline-phase-registry": {
+            "trip": (
+                "def record(counters):\n"
+                '    counters.observe("pipeline.decode.ms", 1.0)\n'
+            ),
+            "fix": (
+                "from openr_tpu.tracing import pipeline\n"
+                "\n"
+                "def record(counters):\n"
+                "    counters.observe(pipeline.hist_key(pipeline.DECODE), 1.0)\n"
+            ),
+        },
+    }

@@ -1,0 +1,45 @@
+"""Shared machinery for the name-registry passes.
+
+Two rule families (``pipeline-phase-registry``, ``alert-name-registry``)
+enforce the same law: a dotted metric/span/alert prefix has ONE home
+module; everywhere else must import the registry constants instead of
+free-spelling a name no dashboard or fidelity test knows about.  Both
+used to run their own full-AST string scan; they now share ONE per-module
+string-literal index (``ParsedModule.string_literals()`` — constants plus
+f-string heads with the inner-constant dedupe) and this declarative base.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+
+class StringPrefixRegistryPass(Pass):
+    """Flag any string literal (or f-string head) starting with
+    ``prefix`` outside ``allowed_prefixes`` (the registry module itself,
+    plus the pass module that must spell the prefix to police it)."""
+
+    prefix = ""
+    allowed_prefixes: Tuple[str, ...] = ()
+    rule = ""
+    what = "name"  # e.g. "pipeline name" / "alert name"
+    hint = ""  # "use the ... registry (...)" tail of the message
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if mod.rel.startswith(self.allowed_prefixes):
+            return []
+        out: List[Finding] = []
+        for node, value in mod.string_literals():
+            if not value.startswith(self.prefix):
+                continue
+            out.append(
+                mod.finding(
+                    self.rule,
+                    node,
+                    f"free-string {self.what} {value!r}; {self.hint}",
+                )
+            )
+        return out

@@ -62,6 +62,18 @@ class ResilienceLatchPass(Pass):
             "counted and recovery is probed)"
         ),
     }
+    examples = {
+        "resilience-latch": {
+            "trip": (
+                "def drain(backend):\n"
+                "    backend.device_failed = True\n"
+            ),
+            "fix": (
+                "def drain(governor):\n"
+                "    governor.force_quarantine(reason='drain')\n"
+            ),
+        },
+    }
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
         if mod.rel.startswith(ALLOWED_PREFIXES):

@@ -52,6 +52,18 @@ class SlotTablePass(Pass):
             "membership churn through the backend's encoding cache)"
         ),
     }
+    examples = {
+        "slot-table": {
+            "trip": (
+                "def churn(enc, ls):\n"
+                "    return patch_encoded_topology_slots(enc, ls, 'me')\n"
+            ),
+            "fix": (
+                "def churn(backend, ls):\n"
+                "    return backend.build_route_db(ls, warm_delta=None)\n"
+            ),
+        },
+    }
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
         if mod.rel.startswith(ALLOWED_PREFIXES):

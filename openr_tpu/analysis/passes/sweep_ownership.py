@@ -44,6 +44,18 @@ class SweepOwnershipPass(Pass):
             "holds)"
         ),
     }
+    examples = {
+        "sweep-spill-ownership": {
+            "trip": (
+                "def shortcut(spill, rows):\n"
+                "    spill.spill_rows(rows)\n"
+            ),
+            "fix": (
+                "def shortcut(service, spec):\n"
+                "    return service.start_sweep(spec)\n"
+            ),
+        },
+    }
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
         if mod.rel.startswith(ALLOWED_PREFIXES):

@@ -61,3 +61,23 @@ class Suppressions:
             return True
         rules = self.line_rules.get(line, ())
         return ALL in rules or rule in rules
+
+    # -- cache round-trip (cache.py stores the parsed spec so a warm hit
+    #    can classify findings without re-reading the source) -------------
+
+    def to_spec(self) -> Dict[str, object]:
+        return {
+            "file": sorted(self.file_rules),
+            "lines": {
+                str(k): sorted(v) for k, v in sorted(self.line_rules.items())
+            },
+        }
+
+    @classmethod
+    def from_spec(cls, spec: Dict) -> "Suppressions":
+        self = cls("")
+        self.file_rules = set(spec.get("file", ()))
+        self.line_rules = {
+            int(k): set(v) for k, v in spec.get("lines", {}).items()
+        }
+        return self

@@ -110,7 +110,10 @@ async def run_emulation(
 
             return _restart
 
-        for name, node in net.nodes.items():
+        # sorted: supervision registration order feeds the restart
+        # queue's FIFO tie-break — keep it name-derived (orlint
+        # unordered-emission)
+        for name, node in sorted(net.nodes.items()):
             supervisor.supervise(name, node, _make_restart(name))
     servers: List[OpenrCtrlServer] = []
     next_port = base_port

@@ -139,7 +139,10 @@ class KvStoreDb:
 
     def add_peers(self, peers: Dict[str, PeerSpec]) -> None:
         register = getattr(self.actor.transport, "register_peer", None)
-        for name, spec in peers.items():
+        # sorted: registration order drives session/full-sync scheduling
+        # order, which must not depend on the caller's dict construction
+        # (orlint unordered-emission)
+        for name, spec in sorted(peers.items()):
             if register is not None:
                 register(name, spec)
             existing = self.peers.get(name)
@@ -462,7 +465,10 @@ class KvStoreDb:
         if not flood_pub.key_vals and not flood_pub.expired_keys:
             return
         flood_set = self._flood_peers()
-        for name, peer in self.peers.items():
+        # sorted: flood fan-out order is the emission order every peer's
+        # arrival sequence (and the SimClock event schedule) inherits —
+        # name-derived, not session-table order (orlint unordered-emission)
+        for name, peer in sorted(self.peers.items()):
             if name == sender:
                 continue  # dedup: never reflect to the sender
             if peer.state != KvStorePeerState.INITIALIZED:
@@ -685,7 +691,10 @@ class KvStoreDb:
         prefixes ~5 minutes later.  Both cases adopt a version above
         the override and re-advertise our CURRENT data (the reference's
         checkSelfAdjustKey semantics)."""
-        for key, value in accepted.items():
+        # sorted: re-origination order is re-advertise (flood) order —
+        # keep it content-derived, not arrival-derived (orlint
+        # unordered-emission)
+        for key, value in sorted(accepted.items()):
             sov = self.self_originated.get(key)
             if sov is None:
                 continue
@@ -961,10 +970,13 @@ class KvStore(Actor):
         operator/supervisor request, not a failure).  Returns the number of
         peers scheduled."""
         n = 0
-        for a, db in self.areas.items():
+        # sorted (areas, then peer names): full-sync scheduling order is
+        # an emission order — a restarted node must reconverge along the
+        # same sequence every replay (orlint unordered-emission)
+        for a, db in sorted(self.areas.items()):
             if area is not None and a != area:
                 continue
-            for peer in db.peers.values():
+            for _pname, peer in sorted(db.peers.items()):
                 db._set_peer_state(peer, KvStorePeerState.IDLE)
                 peer.backoff.report_success()
                 db._schedule_peer_sync(peer)

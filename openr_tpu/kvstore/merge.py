@@ -156,9 +156,16 @@ def merge_key_values(
 ) -> MergeResult:
     """Merge incoming key-vals into `store` in place; returns the accepted
     delta (to announce/flood) and per-key rejection reasons
-    (KvStoreUtil.cpp:391-466)."""
+    (KvStoreUtil.cpp:391-466).
+
+    Keys merge in SORTED order, not arrival order: the accepted delta's
+    iteration order becomes the flooded publication's wire order, and
+    arrival order is an accident of the sender's dict construction —
+    two stores merging the same facts must flood the same bytes
+    (orlint unordered-emission; regression: tests/test_kvstore_merge.py
+    canonical-flood-order test)."""
     result = MergeResult()
-    for key, value in key_vals.items():
+    for key, value in sorted(key_vals.items()):
         if key_filter is not None and not key_filter(key, value):
             result.no_merge_reasons[key] = KvStoreNoMergeReason.NO_MATCHED_KEY
             continue

@@ -54,7 +54,7 @@ class SystemMetrics:
             cpu = sum(os.times()[:2])  # user + system
         except OSError:
             return None
-        wall = time.monotonic()  # orlint: disable=clock-now (CPU%% is a real-time rate; virtual time would skew it)
+        wall = time.monotonic()  # orlint: disable=clock-now,wallclock-reachability (CPU%% is a real-time rate; virtual time would skew it, and the value feeds gauges, never replay-compared bytes)
         pct = None
         if self._last_cpu is not None and wall > self._last_wall:
             pct = 100.0 * (cpu - self._last_cpu) / (wall - self._last_wall)

@@ -476,7 +476,11 @@ class SweepExecutor:
         for scen in scenarios:
             by_world.setdefault(scen.world.key(), []).append(scen)
         t0 = self.clock.now() if self.clock is not None else 0.0
-        for _wkey, items in by_world.items():
+        # sorted is an identity here (scenarios arrive (world key, hash)-
+        # sorted, so insertion order == sorted order) but makes the
+        # solve/spill order provably content-derived (orlint
+        # unordered-emission)
+        for _wkey, items in sorted(by_world.items()):
             world = items[0].world
             fail_sets = []
             errors = []

@@ -524,3 +524,37 @@ def test_flap_counter_counts_once_per_flap():
         await net.stop()
 
     run(main())
+
+
+def test_flood_fanout_order_is_name_sorted_not_session_order():
+    """ISSUE-15 regression (orlint unordered-emission): flood fan-out
+    iterated the live session table, so the emission order every peer's
+    arrival sequence inherits was session-ADD order — stable across
+    replays only because both replays happened to re-add peers
+    identically.  The fan-out now walks peers in sorted name order
+    regardless of how the session table was built."""
+
+    async def main():
+        clock = SimClock()
+        net = Net(["hub", "s3", "s1", "s2"], clock)
+        for spoke in ("s3", "s1", "s2"):  # deliberately unsorted add order
+            net.peer("hub", spoke)
+        await clock.run_for(5.0)
+        hub = net.stores["hub"]
+        order = []
+        orig_spawn = hub.spawn
+
+        def spy(coro, name=""):
+            if ".flood." in name:
+                order.append(name.rsplit(".", 1)[-1])
+            return orig_spawn(coro, name)
+
+        hub.spawn = spy
+        hub.set_key_vals("0", {"zz": mkval(1, "hub")})
+        await clock.run_for(1.0)
+        hub.spawn = orig_spawn
+        assert order, "no flood fan-out observed"
+        assert order == sorted(order), order
+        await net.stop()
+
+    run(main())

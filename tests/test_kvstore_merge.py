@@ -194,3 +194,29 @@ def test_merge_convergence_property():
             for k, val in store.items()
         }
         assert got == base
+
+
+def test_merge_order_is_canonical_not_arrival_order():
+    """ISSUE-15 regression (orlint unordered-emission): the accepted
+    delta's iteration order becomes the flooded publication's wire
+    order, and before the fix it was the INCOMING dict's insertion
+    order — stable across seeded replays only by the accident that both
+    replays reconstruct identical arrival order.  Two stores merging
+    the same facts delivered in different orders emitted different
+    bytes.  Now the merge iterates sorted keys, so the accepted delta
+    (and anything serialized from it) is content-ordered."""
+    vals = {f"k{i:02d}": v(version=i + 1, value=b"x%d" % i) for i in range(8)}
+    forward = dict(sorted(vals.items()))
+    backward = dict(sorted(vals.items(), reverse=True))
+    assert list(forward) != list(backward)  # genuinely different arrival
+
+    s1, s2 = {}, {}
+    r1 = merge_key_values(s1, forward)
+    r2 = merge_key_values(s2, backward)
+    # identical accepted content AND identical iteration order
+    assert list(r1.key_vals) == list(r2.key_vals) == sorted(vals)
+    # the stores converge byte-identically too (same insertion order)
+    assert list(s1) == list(s2)
+    assert {k: val.hash for k, val in s1.items()} == {
+        k: val.hash for k, val in s2.items()
+    }

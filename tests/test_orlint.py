@@ -21,11 +21,13 @@ from openr_tpu.analysis import (
     Baseline,
     analyze_modules,
     analyze_source,
+    build_project,
     default_baseline_path,
+    load_modules,
     repo_root,
 )
 from openr_tpu.analysis.__main__ import main as orlint_main
-from openr_tpu.analysis.passes import all_rules
+from openr_tpu.analysis.passes import all_rules, rule_example, rule_families
 from openr_tpu.analysis.passes.base import ParsedModule
 
 # ---------------------------------------------------------------------------
@@ -151,6 +153,46 @@ FIXTURES = {
     "sweep-spill-ownership": (
         "def shortcut(spill, rows):\n"
         "    spill.spill_rows(rows)\n",
+        (),
+        2,
+    ),
+    # -- replay-determinism family (ISSUE 15) ------------------------------
+    "unordered-emission": (
+        "from openr_tpu.sweep.scenario import canonical_json\n"
+        "\n"
+        "def emit(rows, out):\n"
+        "    for key, val in rows.items():\n"
+        "        out.append(canonical_json({key: val}))\n",
+        (),
+        4,
+    ),
+    "wallclock-reachability": (
+        "from openr_tpu.common.runtime import Actor\n"
+        "from datetime import datetime\n"
+        "\n"
+        "class Poller(Actor):\n"
+        "    async def run(self):\n"
+        "        self._tick()\n"
+        "\n"
+        "    def _tick(self):\n"
+        "        return self._stamp()\n"
+        "\n"
+        "    def _stamp(self):\n"
+        "        return datetime.now()\n",
+        (),
+        12,
+    ),
+    "unseeded-random": (
+        "import random\n"
+        "\n"
+        "def jitter():\n"
+        "    return random.random()\n",
+        (),
+        4,
+    ),
+    "unstable-sort-key": (
+        "def order(rows):\n"
+        "    return sorted(rows, key=id)\n",
         (),
         2,
     ),
@@ -657,36 +699,37 @@ def test_checked_in_baseline_entries_are_fresh():
 
 def test_repo_is_clean_under_check():
     """THE tier-1 gate: the repo as committed has no unsuppressed,
-    unbaselined invariant violations."""
-    assert orlint_main(["--check"]) == 0
+    unbaselined invariant violations.  ``--cache`` is part of the
+    canonical invocation (ISSUE 15): correctness must be identical with
+    the result cache in the loop."""
+    assert orlint_main(["--check", "--cache"]) == 0
 
 
-def test_serving_actor_lands_in_isolation_registry():
-    """The serving plane's QueryService subclasses Actor, so the
-    project-wide actor-isolation registry must collect it — cross-actor
-    writes / _private reads against it are findings from day one, with
-    zero new baseline entries (the gate above stays empty-baselined)."""
-    from openr_tpu.analysis.engine import load_modules
-    from openr_tpu.analysis.passes.actor_isolation import (
-        _CTX_ACTORS,
-        ActorIsolationPass,
-    )
-
+def test_actor_registry_rides_the_symbol_table():
+    """The project-wide Actor registry is now a symbol-table query
+    (callgraph.Project.subclasses_of) — the serving/streaming/sweep
+    actors must all land in it, with zero new baseline entries (the gate
+    above stays empty-baselined)."""
     mods = load_modules([repo_root() / "openr_tpu"])
-    p = ActorIsolationPass()
-    ctx: dict = {}
-    for m in mods:
-        p.collect(m, ctx)
-    p.finalize(ctx)
-    actors = ctx[_CTX_ACTORS]
+    proj = build_project(mods)
+    actors = proj.subclasses_of("Actor")
     assert "QueryService" in actors, "serving actor missing from registry"
-    # sanity: the registry still sees the long-standing actors too
-    assert {"Decision", "KvStore", "Monitor"} <= actors
+    assert {
+        "Decision",
+        "KvStore",
+        "Monitor",
+        "StreamingService",
+        "SweepService",
+    } <= actors
     # and the serving tree is protocol-plane (scanned, not exempted)
     assert any(
         m.rel.startswith("openr_tpu/serving/") and m.is_protocol_plane()
         for m in mods
     )
+    # the jitted-kernel registry rides the same summaries (jax_hygiene
+    # consolidation): spot-check a known kernel family
+    jitted = proj.jitted_registry()
+    assert any(v for v in jitted.values()), "no jitted kernels collected"
 
 
 def test_check_fails_on_violation(tmp_path):
@@ -721,19 +764,84 @@ def test_rule_filter(tmp_path, capsys):
 
 
 def test_list_rules(capsys):
+    """Every rule with its pass FAMILY tag + one-line description
+    (ISSUE-15 satellite: the determinism family must be discoverable)."""
     assert orlint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in FIXTURES:
         assert rule in out
+    for family in ("determinism", "clock-discipline", "actor-isolation"):
+        assert f"[{family}]" in out
+    families = rule_families()
+    assert families["unordered-emission"] == "determinism"
+    assert families["clock-sleep"] == "clock-discipline"
+
+
+def test_github_format_emits_error_annotations(tmp_path, capsys):
+    """``--format=github``: one ``::error`` workflow command per finding
+    (JSON mode untouched — covered above)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["clock-now"][0])
+    rc = orlint_main([str(bad), "--format=github", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    (line,) = [ln for ln in out.splitlines() if ln]
+    assert line.startswith("::error file=")
+    assert "line=4" in line
+    assert "title=orlint clock-now" in line
+    assert "::`time.monotonic` reads host time" in line
+    # gating semantics match text mode
+    assert orlint_main([str(bad), "--format=github", "--no-baseline", "--check"]) == 1
+
+
+def test_explain_prints_trip_and_fix(capsys):
+    assert orlint_main(["--explain", "unordered-emission"]) == 0
+    out = capsys.readouterr().out
+    assert "unordered-emission [determinism]" in out
+    assert "trips:" in out and "fixed:" in out
+    assert "sorted(rows.items())" in out
+    assert "orlint: disable=unordered-emission" in out
+
+
+def test_explain_unknown_rule_fails(capsys):
+    assert orlint_main(["--explain", "no-such-rule"]) == 2
+    assert "unknown rule" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_every_rule_ships_a_validated_explain_example(rule):
+    """META-TEST (ISSUE-15 satellite): every registered rule MUST carry
+    an ``--explain`` example whose trip snippet actually trips the rule
+    and whose fixed twin is completely clean — the next contributor
+    cannot add a rule without documentation that provably works."""
+    found = rule_example(rule)
+    assert found is not None, f"rule {rule} has no --explain example"
+    _family, ex = found
+    ctx = tuple(ex.get("context", ()))
+    tripped = {f.rule for f in analyze_source(ex["trip"], context=ctx)}
+    assert rule in tripped, f"{rule} example trip does not trip: {tripped}"
+    fixed = analyze_source(ex["fix"], context=ctx)
+    assert fixed == [], f"{rule} example fix is not clean: {fixed}"
+
+
+def test_fixture_and_example_coverage_is_total():
+    """META-TEST: a rule without BOTH a trip fixture (FIXTURES — which
+    the parametrized trip/suppression tests consume) and an --explain
+    example fails here by name, not by silent omission."""
+    rules = set(all_rules())
+    assert set(FIXTURES) == rules
+    missing = {r for r in rules if rule_example(r) is None}
+    assert not missing, f"rules without --explain examples: {missing}"
 
 
 def test_module_entry_point():
-    """`python -m openr_tpu.analysis --check` is what CI scripts call."""
+    """`python -m openr_tpu.analysis --check --cache` is THE canonical
+    tier-1 invocation CI scripts call."""
     import subprocess
     import sys
 
     proc = subprocess.run(
-        [sys.executable, "-m", "openr_tpu.analysis", "--check"],
+        [sys.executable, "-m", "openr_tpu.analysis", "--check", "--cache"],
         capture_output=True,
         text=True,
         timeout=300,

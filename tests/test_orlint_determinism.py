@@ -1,0 +1,510 @@
+"""Call-graph engine + replay-determinism family + result cache (ISSUE 15).
+
+Three layers:
+
+* callgraph.py units: summary round-trip, constructor-assignment
+  attribute typing, callback harvesting, reachability with hop counts
+  and the Clock barrier;
+* determinism rule behavior beyond the FIXTURES smoke in test_orlint.py:
+  the acceptance pair (a wall-clock call two hops from an actor run loop
+  trips ``wallclock-reachability``; the same call behind an injected
+  Clock does not), unordered-emission's sink transitivity and its
+  sanctioned ``sorted(..)`` spelling, seeded-vs-global randomness,
+  identity sort keys;
+* the ``--cache`` contract: a warm run re-parses ZERO unchanged files, a
+  content edit re-runs exactly the edited file when the cross-module
+  facts are unchanged, and a summary change or rule-set bump re-runs
+  everything — with findings byte-equal to the uncached engine.
+"""
+
+import json
+
+import pytest
+
+from openr_tpu.analysis import (
+    analyze_modules,
+    analyze_paths,
+    analyze_source,
+    build_project,
+)
+from openr_tpu.analysis.callgraph import ModuleSummary
+from openr_tpu.analysis.passes.base import ParsedModule
+
+# ---------------------------------------------------------------------------
+# call graph units
+# ---------------------------------------------------------------------------
+
+GRAPH_SRC = """\
+from openr_tpu.common.runtime import Actor
+
+class Helper:
+    def work(self):
+        return inner()
+
+def inner():
+    return 1
+
+class Node(Actor):
+    def __init__(self):
+        self.helper = Helper()
+        self.register(self.on_tick)
+
+    async def run(self):
+        self.helper.work()
+
+    def on_tick(self):
+        return inner()
+"""
+
+
+def _project_for(*sources):
+    mods = [
+        ParsedModule.parse(f"m{i}.py", src) for i, src in enumerate(sources)
+    ]
+    return build_project(mods), mods
+
+
+def test_summary_round_trip_and_digest_stability():
+    pm = ParsedModule.parse("m0.py", GRAPH_SRC)
+    s = pm.summary()
+    doc = s.to_json()
+    restored = ModuleSummary.from_json(json.loads(json.dumps(doc)))
+    assert restored.to_json() == doc
+    assert restored.content_hash() == s.content_hash()
+    # the facts a pass would query
+    assert s.classes["Node"].bases == ["Actor"]
+    assert s.classes["Node"].attrs["helper"] == "Helper"
+    assert "Node.run" in s.functions and "inner" in s.functions
+
+
+def test_project_round_trip_is_edge_identical_over_the_repo():
+    """Cache soundness hangs on this: a Project built from JSON-round-
+    tripped summaries must resolve EXACTLY the same call edges as one
+    built from fresh parses — otherwise a ``--cache`` run with any warm
+    entries analyzes a different program than a cold run (the bug this
+    test pins: FunctionInfo reconstruction corrupted the method index,
+    so by-name/typed dispatch silently vanished on warm paths)."""
+    from openr_tpu.analysis import load_modules, repo_root
+
+    mods = load_modules([repo_root() / "openr_tpu"])
+    fresh = [m.summary() for m in mods]
+    rt = [
+        ModuleSummary.from_json(json.loads(json.dumps(s.to_json())))
+        for s in fresh
+    ]
+    from openr_tpu.analysis.callgraph import Project
+
+    p1, p2 = Project(fresh), Project(rt)
+    assert p1.methods.keys() == p2.methods.keys()
+    assert p1.functions.keys() == p2.functions.keys()
+    assert p1.edges == p2.edges
+
+
+def test_constructor_attr_typing_resolves_method_edges():
+    proj, _ = _project_for(GRAPH_SRC)
+    edges = proj.edges["m0.Node.run"]
+    assert "m0.Helper.work" in edges
+    # and the method's own body chains on
+    assert "m0.inner" in proj.edges["m0.Helper.work"]
+
+
+def test_callback_harvesting_makes_registration_an_edge():
+    """`self.register(self.on_tick)` — passing a bound method is how
+    every fiber/listener is born; it must be a call edge."""
+    proj, _ = _project_for(GRAPH_SRC)
+    assert "m0.Node.on_tick" in proj.edges["m0.Node.__init__"]
+
+
+def test_reachability_reports_root_and_hops():
+    proj, _ = _project_for(GRAPH_SRC)
+    reach = proj.reachable_from(["m0.Node.run"])
+    assert reach["m0.Helper.work"].hops == 1
+    assert reach["m0.inner"].hops == 2
+    assert reach["m0.inner"].root == "m0.Node.run"
+    assert "m0.Node.on_tick" not in reach  # only registered from __init__
+
+
+def test_subclasses_of_is_transitive():
+    proj, _ = _project_for(
+        "class A:\n    pass\n\nclass B(A):\n    pass\n\nclass C(B):\n    pass\n"
+    )
+    assert proj.subclasses_of("A") == {"A", "B", "C"}
+
+
+# ---------------------------------------------------------------------------
+# wallclock-reachability: the acceptance pair
+# ---------------------------------------------------------------------------
+
+#: a Clock lookalike whose now() IS a wall-clock read — the barrier test
+#: needs the forbidden call to live INSIDE the injected-clock class
+CLOCK_CTX = """\
+import time
+
+class Clock:
+    def now(self):
+        return time.monotonic()
+"""
+
+BEHIND_CLOCK = """\
+from openr_tpu.common.runtime import Actor
+from ctx0 import Clock
+
+class Poller(Actor):
+    def __init__(self, clock: Clock):
+        self.clock = clock
+
+    async def run(self):
+        return self._stamp()
+
+    def _stamp(self):
+        return self.clock.now()
+"""
+
+
+def _all_findings(snippet, *ctx):
+    mods = [ParsedModule.parse("snippet.py", snippet)]
+    for i, src in enumerate(ctx):
+        mods.append(ParsedModule.parse(f"ctx{i}.py", src))
+    return analyze_modules(mods).findings
+
+
+def test_wallclock_two_hops_from_run_loop_trips():
+    """Acceptance: `datetime.now()` two call hops below an actor run
+    loop trips, and the message names the root and the distance."""
+    src = (
+        "from openr_tpu.common.runtime import Actor\n"
+        "from datetime import datetime\n"
+        "\n"
+        "class Poller(Actor):\n"
+        "    async def run(self):\n"
+        "        self._tick()\n"
+        "\n"
+        "    def _tick(self):\n"
+        "        return self._stamp()\n"
+        "\n"
+        "    def _stamp(self):\n"
+        "        return datetime.now()\n"
+    )
+    hits = [
+        f for f in analyze_source(src) if f.rule == "wallclock-reachability"
+    ]
+    assert [f.line for f in hits] == [12]
+    assert "2 call hops" in hits[0].message
+    assert "snippet.Poller.run" in hits[0].message
+
+
+def test_wallclock_behind_injected_clock_is_a_barrier():
+    """Acceptance: the SAME wall-clock read behind an injected Clock
+    does not trip anywhere — Clock-subclass methods are the sanctioned
+    discipline and traversal stops at the barrier."""
+    hits = [
+        f
+        for f in _all_findings(BEHIND_CLOCK, CLOCK_CTX)
+        if f.rule == "wallclock-reachability"
+    ]
+    assert hits == []
+
+
+def test_wallclock_barrier_is_the_clock_name_not_luck():
+    """Control for the barrier test: the identical wiring through a
+    class NOT named into the Clock hierarchy DOES trip (inside the
+    helper class, reached from the actor loop)."""
+    ctx = CLOCK_CTX.replace("class Clock:", "class Stamper:")
+    src = BEHIND_CLOCK.replace("Clock", "Stamper")
+    hits = [
+        f
+        for f in _all_findings(src, ctx)
+        if f.rule == "wallclock-reachability"
+    ]
+    assert [(f.path, f.line) for f in hits] == [("ctx0.py", 5)]
+
+
+def test_wallclock_unreachable_helper_is_clean():
+    """No root reaches it ⇒ the interprocedural rule stays quiet (the
+    per-site clock-now rule still governs protocol-plane sites)."""
+    src = (
+        "from datetime import datetime\n"
+        "\n"
+        "def stamp():\n"
+        "    return datetime.now()\n"
+    )
+    assert [
+        f.rule for f in analyze_source(src) if f.rule == "wallclock-reachability"
+    ] == []
+
+
+# ---------------------------------------------------------------------------
+# unordered-emission: sinks, transitivity, sanctioned spellings
+# ---------------------------------------------------------------------------
+
+
+def test_unordered_emission_set_param_feeding_digest_trips():
+    src = (
+        "import hashlib\n"
+        "\n"
+        "def digest(tags: set):\n"
+        "    h = hashlib.sha256()\n"
+        "    for t in tags:\n"
+        "        h.update(str(t).encode())\n"
+        "    return h.hexdigest()\n"
+    )
+    hits = [f for f in analyze_source(src) if f.rule == "unordered-emission"]
+    assert [f.line for f in hits] == [5]
+    assert "set `tags`" in hits[0].message
+
+
+def test_unordered_emission_transitive_through_helper():
+    """The loop body's call chain — not just the direct call — reaches
+    the sink (the call-graph upgrade the per-file linter couldn't do)."""
+    src = (
+        "from openr_tpu.sweep.scenario import canonical_json\n"
+        "\n"
+        "def _encode(row):\n"
+        "    return canonical_json(row)\n"
+        "\n"
+        "def emit(rows, out):\n"
+        "    for k, v in rows.items():\n"
+        "        out.append(_encode({k: v}))\n"
+    )
+    hits = [f for f in analyze_source(src) if f.rule == "unordered-emission"]
+    assert [f.line for f in hits] == [7]
+    assert "canonical_json" in hits[0].message
+
+
+def test_unordered_emission_sorted_is_the_sanctioned_spelling():
+    src = (
+        "from openr_tpu.sweep.scenario import canonical_json\n"
+        "\n"
+        "def emit(rows, out):\n"
+        "    for key, val in sorted(rows.items()):\n"
+        "        out.append(canonical_json({key: val}))\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_unordered_iteration_without_a_sink_is_not_a_finding():
+    src = (
+        "def tally(rows):\n"
+        "    n = 0\n"
+        "    for _k, v in rows.items():\n"
+        "        n += v\n"
+        "    return n\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_unordered_emission_self_attr_set_trips():
+    src = (
+        "from openr_tpu.sweep.scenario import canonical_json\n"
+        "\n"
+        "class Reducer:\n"
+        "    def __init__(self):\n"
+        "        self.spof = set()\n"
+        "\n"
+        "    def summary(self, out):\n"
+        "        for link in self.spof:\n"
+        "            out.append(canonical_json(link))\n"
+    )
+    hits = [f for f in analyze_source(src) if f.rule == "unordered-emission"]
+    assert [f.line for f in hits] == [8]
+    assert "set `self.spof`" in hits[0].message
+
+
+def test_unordered_emission_deliver_wire_callback_is_a_sink():
+    src = (
+        "def fanout(subs: dict, payload, deliver_wire):\n"
+        "    for sub in subs.values():\n"
+        "        deliver_wire(payload)\n"
+    )
+    hits = [f for f in analyze_source(src) if f.rule == "unordered-emission"]
+    assert [f.line for f in hits] == [2]
+
+
+# ---------------------------------------------------------------------------
+# unseeded-random / unstable-sort-key
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_random_instances_are_clean():
+    src = (
+        "import random\n"
+        "\n"
+        "def draws(seed: int):\n"
+        "    rng = random.Random(seed)\n"
+        "    return rng.random(), rng.randint(0, 7)\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_unseeded_random_instance_and_global_seed_trip():
+    src = (
+        "import random\n"
+        "\n"
+        "def setup():\n"
+        "    random.seed(42)\n"
+        "    return random.Random()\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == [
+        "unseeded-random",
+        "unseeded-random",
+    ]
+
+
+def test_numpy_global_draw_trips_but_seeded_generator_is_clean():
+    src = (
+        "import numpy as np\n"
+        "\n"
+        "def noise(n):\n"
+        "    return np.random.rand(n)\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == ["unseeded-random"]
+    clean = (
+        "import numpy as np\n"
+        "\n"
+        "def noise(n, seed):\n"
+        "    return np.random.default_rng(seed).random(n)\n"
+    )
+    assert analyze_source(clean) == []
+
+
+def test_unstable_sort_key_lambda_and_method_forms():
+    src = (
+        "def order(rows, cohorts):\n"
+        "    rows.sort(key=lambda r: hash(r))\n"
+        "    return max(cohorts, key=id)\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == [
+        "unstable-sort-key",
+        "unstable-sort-key",
+    ]
+
+
+def test_content_sort_keys_are_clean():
+    src = (
+        "def order(rows):\n"
+        "    rows.sort(key=lambda r: (r.name, r.seq))\n"
+        "    return sorted(rows, key=str)\n"
+    )
+    assert analyze_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# the --cache contract
+# ---------------------------------------------------------------------------
+
+A_SRC = "import time\n\ndef f():\n    return time.time()\n"
+B_SRC = "def g():\n    return 1\n"
+
+
+def _tree(tmp_path):
+    d = tmp_path / "src"
+    d.mkdir(exist_ok=True)
+    (d / "a.py").write_text(A_SRC)
+    (d / "b.py").write_text(B_SRC)
+    return d, tmp_path / "cache.json"
+
+
+def test_cache_warm_run_parses_zero_files(tmp_path):
+    d, cache = _tree(tmp_path)
+    r1 = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r1.files_parsed == 2
+    assert [f.rule for f in r1.findings] == ["clock-now"]
+    r2 = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r2.files_parsed == 0, "warm run must re-parse zero files"
+    assert [f.key() for f in r2.findings] == [f.key() for f in r1.findings]
+    # and matches the uncached engine byte for byte
+    r3 = analyze_paths([d], use_baseline=False)
+    assert [f.to_json() for f in r3.findings] == [
+        f.to_json() for f in r2.findings
+    ]
+
+
+def test_cache_content_edit_reruns_only_that_file(tmp_path):
+    """An edit whose module summary is unchanged (a string constant —
+    constants carry no cross-module facts) re-runs exactly one file and
+    still surfaces the new finding."""
+    d, cache = _tree(tmp_path)
+    analyze_paths([d], use_baseline=False, cache_path=cache)
+    # module-level constant: no function extents move, no calls change —
+    # the summary (cross-module facts) is byte-identical
+    (d / "b.py").write_text(B_SRC + "\n'pipeline.decode.ms'\n")
+    r = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r.files_parsed == 1
+    assert sorted(f.rule for f in r.findings) == [
+        "clock-now",
+        "pipeline-phase-registry",
+    ]
+
+
+def test_cache_summary_change_reruns_everything(tmp_path):
+    """Adding a function changes the project facts digest — every file's
+    interprocedural findings could have moved, so everything re-runs."""
+    d, cache = _tree(tmp_path)
+    analyze_paths([d], use_baseline=False, cache_path=cache)
+    (d / "b.py").write_text(B_SRC + "\ndef h():\n    return g()\n")
+    r = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r.files_parsed == 2
+
+
+def test_cache_ruleset_bump_invalidates_everything(tmp_path):
+    d, cache = _tree(tmp_path)
+    analyze_paths([d], use_baseline=False, cache_path=cache)
+    doc = json.loads(cache.read_text())
+    doc["ruleset"] = "0" * 64  # a rule-set version bump
+    cache.write_text(json.dumps(doc))
+    r = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r.files_parsed == 2
+    assert [f.rule for f in r.findings] == ["clock-now"]
+
+
+def test_cache_tolerates_garbage_file(tmp_path):
+    d, cache = _tree(tmp_path)
+    cache.write_text("{ not json")
+    r = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r.files_parsed == 2
+    assert [f.rule for f in r.findings] == ["clock-now"]
+
+
+def test_cache_preserves_suppressions(tmp_path):
+    d = tmp_path / "src"
+    d.mkdir()
+    (d / "a.py").write_text(
+        "import time\n\ndef f():\n"
+        "    return time.time()  # orlint: disable=clock-now (why)\n"
+    )
+    cache = tmp_path / "cache.json"
+    r1 = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r1.findings == [] and len(r1.suppressed) == 1
+    r2 = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r2.files_parsed == 0
+    assert r2.findings == [] and len(r2.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# the determinism pass and the repo itself
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        "openr_tpu/kvstore/merge.py",
+        "openr_tpu/kvstore/kv_store.py",
+        "openr_tpu/sweep/executor.py",
+        "openr_tpu/daemon.py",
+    ],
+)
+def test_cleaned_modules_stay_clean(rel):
+    """The ISSUE-15 cleanup pinned: the modules whose unordered
+    emissions were fixed must stay free of determinism findings."""
+    from openr_tpu.analysis import load_modules, repo_root
+
+    mods = load_modules([repo_root() / "openr_tpu"])
+    report = analyze_modules(mods)
+    offenders = [
+        f
+        for f in report.findings
+        if f.path == rel
+        and f.rule in ("unordered-emission", "unstable-sort-key")
+    ]
+    assert offenders == []
