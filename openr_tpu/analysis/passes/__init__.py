@@ -18,6 +18,7 @@ from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
 from openr_tpu.analysis.passes.determinism import DeterminismPass
 from openr_tpu.analysis.passes.jax_hygiene import JaxHygienePass
 from openr_tpu.analysis.passes.pipeline_phase import PipelinePhasePass
+from openr_tpu.analysis.passes.protection_table import ProtectionTablePass
 from openr_tpu.analysis.passes.resilience_latch import ResilienceLatchPass
 from openr_tpu.analysis.passes.slot_table import SlotTablePass
 from openr_tpu.analysis.passes.sweep_ownership import SweepOwnershipPass
@@ -34,6 +35,7 @@ def make_passes():
         PipelinePhasePass(),
         AlertRegistryPass(),
         SweepOwnershipPass(),
+        ProtectionTablePass(),
         DeterminismPass(),
     ]
 

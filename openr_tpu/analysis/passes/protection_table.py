@@ -1,0 +1,92 @@
+"""Protection-table ownership — FIB patches apply through ONE gate.
+
+The fast-reroute contract (docs/Robustness.md §fast-reroute) is that a
+minted patch reaches the FIB only via Decision's generation-exact apply
+path: ``_maybe_apply_protection`` checks the table generation against
+the PREVIOUS generation key, refuses inside a dirty window, and arms
+the warm-solve confirm.  A table mutator or ``apply_patch`` call from
+anywhere else could install a patch minted for a different LSDB
+generation — precisely the wrong-route window the staleness discipline
+exists to make impossible — or flip the table lifecycle under the
+service's mint fiber.
+
+Rule:
+
+* ``protection-table`` — a call to a protection-table mutator
+  (``apply_patch``, ``begin_mint``, ``mark_ready``, ``mark_stale``,
+  ``abort_mint``, ``purge_table``) anywhere outside
+  ``openr_tpu/protection/`` or ``openr_tpu/decision/decision.py``.
+  Reads (``lookup``, ``status``, ``classify_pairs``, the ctrl verbs)
+  are fine everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+ALLOWED_PREFIXES = (
+    "openr_tpu/protection/",
+    "openr_tpu/decision/decision.py",
+)
+
+_MUTATOR_CALLS = {
+    "apply_patch",
+    "begin_mint",
+    "mark_ready",
+    "mark_stale",
+    "abort_mint",
+    "purge_table",
+}
+
+
+class ProtectionTablePass(Pass):
+    name = "protection-table"
+    rules = {
+        "protection-table": (
+            "protection-table mutator called outside openr_tpu/"
+            "protection/ or decision/decision.py (patches must apply "
+            "through Decision's generation-exact apply path so a stale "
+            "patch can never reach the FIB)"
+        ),
+    }
+    examples = {
+        "protection-table": {
+            "trip": (
+                "def shortcut(table, doc, prefix_state):\n"
+                "    table.apply_patch(doc, prefix_state)\n"
+            ),
+            "fix": (
+                "def shortcut(decision):\n"
+                "    # fail the link in the LSDB; Decision's apply path\n"
+                "    # serves the patch generation-exactly\n"
+                "    decision.kvstore_sync()\n"
+            ),
+        },
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if mod.rel.startswith(ALLOWED_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr in _MUTATOR_CALLS:
+                out.append(
+                    mod.finding(
+                        "protection-table",
+                        node,
+                        f"`{f.attr}(..)` outside openr_tpu/protection/ "
+                        "bypasses Decision's generation-exact apply "
+                        "gate; fail the link in the LSDB (or drive the "
+                        "ProtectionService) instead",
+                    )
+                )
+        return out

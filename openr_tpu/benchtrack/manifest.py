@@ -206,6 +206,12 @@ def _spoil_sweep(doc: dict) -> None:
     doc["detail"]["resume"]["summary_byte_identical"] = False
 
 
+def _spoil_frr(doc: dict) -> None:
+    # an applied patch that broke scalar-oracle RIB parity must never
+    # pass the gate
+    doc["detail"]["apply"]["scalar_parity"] = False
+
+
 # -- acceptance floors moved out of the six per-family test files
 
 
@@ -295,6 +301,20 @@ def _accept_sweep(doc: dict) -> None:
     assert d["spill"]["rows"] == d["scenarios"]["total"]
     assert d["spill"]["peak_host_rows"] <= d["shards"]["scenarios_per_shard"]
     assert d["plan_cache"]["hits"] >= 1
+
+
+def _accept_frr(doc: dict) -> None:
+    # the ISSUE-16 acceptance floor: protected failure convergence is a
+    # LOOKUP — p99 of the patched publication→FIB path >= 10x under the
+    # warm-rebuild reference, zero confirm mismatches, the fallback
+    # ledger exercised, and a killed mint resuming byte-identically
+    d = doc["detail"]
+    assert d["speedup"]["vs_reference_warm_p50"] >= 10.0
+    assert d["apply"]["mismatches"] == 0
+    assert d["apply"]["scalar_parity"] is True
+    assert d["fallbacks"]["stale"] >= 1
+    assert d["fallbacks"]["miss"] >= 1
+    assert d["resume"]["table_hash_byte_identical"] is True
 
 
 def _accept_rolling(doc: dict) -> None:
@@ -578,6 +598,35 @@ MANIFEST: Tuple[ArtifactSpec, ...] = (
         markers=("sweep", "multichip"),
         spoil=_spoil_sweep,
         acceptance=_accept_sweep,
+    ),
+    ArtifactSpec(
+        family="frr",
+        pattern=r"BENCH_FRR_r(\d+)\.json",
+        description=(
+            "fast-reroute protection tier: publication→FIB p99 of a "
+            "protected single-link flap served from the minted "
+            "128-link patch table on grid4096 (real Decision + Fib "
+            "actors), vs the warm-rebuild reference; stale/unminted "
+            "fallback ledger + kill-and-resume mint identity "
+            "(bench.py --frr)"
+        ),
+        validate=_v("frr"),
+        headline=(
+            # wall-clock apply latency (machine-dependent, wide
+            # tolerance like the other wall-clock headlines)
+            HeadlineMetric("value", LOWER, tolerance_pct=40.0),
+            # how far under the warm reference the tier sits
+            # (informational trajectory; the 10x floor gates via
+            # acceptance, not the ratchet)
+            HeadlineMetric(
+                "detail.speedup.vs_reference_warm_p50",
+                HIGHER,
+                ratchet=False,
+            ),
+        ),
+        markers=("protection",),
+        spoil=_spoil_frr,
+        acceptance=_accept_frr,
     ),
 )
 

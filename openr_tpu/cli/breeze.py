@@ -920,6 +920,86 @@ def sweep_cancel(ctx: click.Context) -> None:
     _print(_call(ctx, "cancel_sweep"))
 
 
+# -------------------------------------------------------------- protection
+
+
+@breeze.group()
+def protection() -> None:
+    """Fast-reroute protection tier: sweep-minted per-link FIB patches
+    (openr_tpu.protection; docs/Robustness.md §fast-reroute)."""
+
+
+@protection.command("status")
+@click.pass_context
+def protection_status(ctx: click.Context) -> None:
+    """Table state, mint/apply history, and store cache stats."""
+    st = _call(ctx, "get_protection_status")
+    if st.get("state") == "disabled":
+        click.echo("protection tier disabled")
+        return
+    click.echo(
+        f"protection on {st['node']}: {st['state']}"
+        + (f" ({st['error']})" if st.get("error") else "")
+    )
+    click.echo(
+        f"  patches={st['patches']} eligible={st['eligible']}"
+        f" mints={st['num_mints']} purges={st['num_purges']}"
+        f" applied={st['applied']}"
+    )
+    mint = st.get("last_mint")
+    if mint:
+        click.echo(
+            f"  last mint: {mint['patches']} patches"
+            f" ({mint['eligible']} eligible) in {mint['mint_ms']}ms"
+            f" table={mint['table_hash'][:12]}"
+            f"{' resumed' if mint.get('resumed') else ''}"
+        )
+    applied = st.get("last_applied")
+    if applied:
+        click.echo(
+            f"  last apply: {applied['key']}"
+            f" sets={applied['sets']} deletes={applied['deletes']}"
+            f" in {applied['apply_ms']}ms"
+        )
+    store = st.get("store") or {}
+    if store:
+        click.echo(
+            f"  store: indexed={store.get('patches_indexed')}"
+            f" cached={store.get('cached')}"
+            f"/{store.get('max_host_patches')}"
+            f" hits={store.get('cache_hits')}"
+            f" disk_loads={store.get('disk_loads')}"
+        )
+
+
+@protection.command("table")
+@click.option("--key", default=None,
+              help="decode one patch (a link key 'a|b' or 'srlg:NAME')")
+@click.option("--limit", default=64, help="keys to list")
+@click.pass_context
+def protection_table(
+    ctx: click.Context, key: Optional[str], limit: int
+) -> None:
+    """The minted patch table: key listing, or one decoded patch."""
+    doc = _call(ctx, "get_protection_table", key=key, limit=limit)
+    if doc.get("state") == "disabled":
+        click.echo("protection tier disabled")
+        return
+    if key is not None:
+        patch = doc.get("patch")
+        if patch is None:
+            click.echo(f"no patch for {key!r} on {doc['node']}")
+            return
+        _print(patch)
+        return
+    click.echo(
+        f"protection table on {doc['node']}: {doc['state']}"
+        f" ({doc['total']} patches)"
+    )
+    for k in doc.get("keys", []):
+        click.echo(f"  {k}")
+
+
 # -------------------------------------------------------------- resilience
 
 

@@ -262,6 +262,18 @@ class MetricPerturbationConfig:
 
 
 @dataclass
+class SrlgGroupConfig:
+    """One shared-risk link group (SRLG): the named member links share
+    fate (conduit, linecard, optical span) and fail TOGETHER.  Folded
+    into the sweep scenario grammar as a failure domain, and the
+    protection tier mints one per-SRLG FibPatch per group."""
+
+    name: str = ""
+    #: member links as [node_a, node_b] endpoint pairs
+    links: List[List[str]] = field(default_factory=list)
+
+
+@dataclass
 class SweepConfig:
     """Capacity-planning sweep orchestrator knobs (openr_tpu.sweep,
     net-new vs the reference): the declarative scenario grammar
@@ -289,6 +301,9 @@ class SweepConfig:
     metric_perturbations: List[MetricPerturbationConfig] = field(
         default_factory=list
     )
+    #: shared-risk link groups folded into the grammar as failure
+    #: domains (one all-members-fail scenario per group per world)
+    srlg_groups: List[SrlgGroupConfig] = field(default_factory=list)
     #: shards concurrently in flight on the streamed drain path
     inflight_shards: int = 2
     #: breather between committed shards on the service fiber: the
@@ -296,6 +311,34 @@ class SweepConfig:
     #: starving behind it (SimClock chaos scenarios stretch it so
     #: faults land mid-sweep deterministically)
     inter_shard_pause_s: float = 0.01
+
+
+@dataclass
+class ProtectionConfig:
+    """Fast-reroute protection tier knobs (openr_tpu.protection,
+    net-new vs the reference): per-link FibPatches minted from the
+    single-link-failure slice of the sweep grammar after every Decision
+    generation bump, applied at detection time on a generation-exact
+    hit.  See docs/Robustness.md §"Fast-reroute protection tier"."""
+
+    enabled: bool = False
+    #: scenarios per committed mint shard dispatch
+    shard_scenarios: int = 256
+    #: debounce after a generation bump before (re)minting — LSDB churn
+    #: bursts coalesce into one mint of the settled generation
+    mint_debounce_s: float = 0.2
+    #: breather between committed mint shards on the service fiber
+    inter_shard_pause_s: float = 0.01
+    #: host-memory bound on decoded patches held in the store's LRU
+    #: cache; the rest stay spilled on disk and load on lookup
+    max_host_patches: int = 1024
+    #: protection store directory ("" = /tmp/openr_tpu_protection.{node},
+    #: node-scoped: single-writer, same discipline as the sweep spill)
+    store_dir: str = ""
+    #: bound the protected-link universe to the first N canonically
+    #: sorted link pairs (0 = protect every link); flaps outside the
+    #: bound fall back warm and count protection.fallback.miss
+    max_links: int = 0
 
 
 @dataclass
@@ -445,6 +488,9 @@ class OpenrConfig:
     resilience_config: ResilienceConfig = field(default_factory=ResilienceConfig)
     parallel_config: ParallelConfig = field(default_factory=ParallelConfig)
     sweep_config: SweepConfig = field(default_factory=SweepConfig)
+    protection_config: ProtectionConfig = field(
+        default_factory=ProtectionConfig
+    )
     originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
     segment_routing_config: SegmentRoutingConfig = field(
         default_factory=SegmentRoutingConfig
@@ -588,6 +634,34 @@ class OpenrConfig:
                     f"invalid sweep metric perturbation pattern "
                     f"{m.pattern!r}: {e}"
                 ) from None
+        seen_srlg = set()
+        for g in sw.srlg_groups:
+            if not g.name:
+                raise ValueError("sweep srlg_groups entries need a name")
+            if g.name in seen_srlg:
+                raise ValueError(f"duplicate sweep srlg group {g.name!r}")
+            seen_srlg.add(g.name)
+            for pair in g.links:
+                if len(pair) != 2 or pair[0] == pair[1]:
+                    raise ValueError(
+                        f"srlg group {g.name!r} link {pair!r} must be "
+                        "two distinct node names"
+                    )
+        pr = self.protection_config
+        if (
+            pr.shard_scenarios < 1
+            or pr.max_host_patches < 1
+            or pr.max_links < 0
+        ):
+            raise ValueError(
+                "protection needs shard_scenarios >= 1, "
+                "max_host_patches >= 1, max_links >= 0"
+            )
+        if pr.mint_debounce_s < 0 or pr.inter_shard_pause_s < 0:
+            raise ValueError(
+                "protection needs mint_debounce_s >= 0 and "
+                "inter_shard_pause_s >= 0"
+            )
         if self.tpu_compute_config.plan_cache_entries < 0:
             raise ValueError("plan_cache_entries must be >= 0")
         from openr_tpu.lsdb_codec import WIRE_FORMATS

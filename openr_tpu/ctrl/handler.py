@@ -586,6 +586,29 @@ class OpenrCtrlHandler:
         cancel`)."""
         return self.node.sweep.cancel_sweep()
 
+    # ------------------------------------------------------------ protection
+    # (openr_tpu.protection — fast-reroute FIB patch tier minted from
+    # the single-link failure sweep; net-new vs the reference)
+
+    def get_protection_status(self) -> dict:
+        """Protection-table state: generation pinned, patch counts,
+        last mint/apply, store cache stats (`breeze protection
+        status`)."""
+        svc = getattr(self.node, "protection", None)
+        if svc is None:
+            return {"state": "disabled"}
+        return svc.get_protection_status()
+
+    def get_protection_table(
+        self, key: Optional[str] = None, limit: int = 64
+    ) -> dict:
+        """The minted patch table: key listing, or one decoded patch
+        for `key` (`breeze protection table [--key]`)."""
+        svc = getattr(self.node, "protection", None)
+        if svc is None:
+            return {"state": "disabled"}
+        return svc.get_protection_table(key=key, limit=limit)
+
     # ------------------------------------------------------------ resilience
     # (openr_tpu.resilience — breaker/governor health of every
     # external-dependency edge; net-new vs the reference)

@@ -132,6 +132,19 @@ class Decision(Actor):
         #: reset frontier (ISSUE 12).
         self._pending_topo_structural = False
         self._pending_force_full = False
+        #: fast-reroute protection tier (a ProtectionService, wired by
+        #: the daemon when protection_config.enabled; None otherwise)
+        self.protection = None
+        #: sorted (n1, n2) pairs the un-rebuilt LSDB window reported
+        #: DOWN, and whether it carried ANY other topology change —
+        #: the protection classifier's inputs, reset with the other
+        #: pending-delta state at rebuild time
+        self._pending_down_pairs: Set[tuple] = set()
+        self._pending_other_change = False
+        #: an applied-but-unconfirmed protection patch: what the FIB
+        #: currently holds on top of route_db, awaiting the confirming
+        #: warm solve ({"generation", "entries", "deletes"})
+        self._frr_outstanding: Optional[dict] = None
         self._last_policy_active = False
         #: bumped on every LSDB change AND every RibPolicy set/clear —
         #: keys the fleet-RIB / what-if table caches and the serving
@@ -243,6 +256,11 @@ class Decision(Actor):
         self._on_publication_inner(pub)
 
     def _on_publication_inner(self, pub: Publication) -> None:
+        # the generation this publication transitions FROM — the
+        # identity a protection patch must have been minted at
+        prev_key = (
+            self.generation_key() if self.protection is not None else None
+        )
         changed = False
         area = pub.area
         if pub.trace_ctx is not None:
@@ -270,6 +288,8 @@ class Decision(Actor):
             self.counters.bump("decision.lsdb_updates")
             self._bump_generation()
             self._rebuild_pending = True
+            if prev_key is not None:
+                self._maybe_apply_protection(prev_key)
             if self._unblocked:
                 self._debounce()
 
@@ -314,6 +334,163 @@ class Decision(Actor):
             or not self._first_build_done
         )
         return full, self._pending_prefix_changes
+
+    def rebuild_settled(self) -> bool:
+        """True when the computed RIB reflects the current LSDB (first
+        build done, no rebuild pending) — the protection tier only
+        mints from a settled generation, so a patch's base RIB is
+        exactly ``route_db``."""
+        return self._first_build_done and not self._rebuild_pending
+
+    # -- fast-reroute protection (apply + confirm authority) ----------------
+
+    def _maybe_apply_protection(self, prev_key: tuple) -> None:
+        """Classify the just-ingested publication; on a protected
+        single-failure event with a generation-exact protection hit,
+        publish the precomputed FIB patch IMMEDIATELY — failure
+        convergence becomes a table lookup.  The debounced warm solve
+        that follows is the confirming authority (``_confirm_frr``).
+        Every refusal is counted ``protection.fallback.<reason>`` and
+        degrades to the warm path, never to a wrong answer."""
+        svc = self.protection
+        pairs = self._pending_down_pairs
+        if svc is None or not pairs:
+            return
+        if not self._first_build_done or not self._unblocked:
+            return
+        patch_key = svc.classify_pairs(pairs)
+        if patch_key is None:
+            svc.note_fallback("multi_failure")
+            return
+        if (
+            self._pending_other_change
+            or self._pending_force_full
+            or self._pending_prefix_changes
+            or self._frr_outstanding is not None
+        ):
+            # the un-rebuilt window carries MORE than this link-down
+            # (or a prior patch is still unconfirmed): the patch's base
+            # RIB assumption does not hold
+            svc.note_fallback("stale")
+            return
+        status, doc = svc.lookup(prev_key, patch_key)
+        if status != "hit":
+            svc.note_fallback(status)
+            return
+        t0 = self.clock.now()
+        made = svc.apply_patch(doc, self.prefix_state)
+        if made is None:
+            svc.note_fallback("miss")
+            return
+        entries, deletes = made
+        from openr_tpu.tracing import pipeline as _pipeline
+        from openr_tpu.tracing.pipeline import disabled_probe
+
+        probe = self._backend_probe()
+        if probe is None:
+            probe = disabled_probe()
+        span = self.tracer.start_span(
+            "decision.frr_apply", self.pending_trace_ctx, module="decision"
+        )
+        try:
+            with probe.phase(_pipeline.PROTECTION_APPLY):
+                update = DecisionRouteUpdate(
+                    type=DecisionRouteUpdateType.INCREMENTAL,
+                    frr=True,
+                    frr_generation=self._change_seq,
+                )
+                for prefix, entry in entries.items():
+                    old = self.route_db.unicast_routes.get(prefix)
+                    if old is None or not old.eq_ignoring_cost(entry):
+                        update.unicast_routes_to_update[prefix] = entry
+                update.unicast_routes_to_delete = [
+                    p for p in deletes if p in self.route_db.unicast_routes
+                ]
+                # record what the FIB holds ON TOP of route_db until
+                # the confirming warm solve reconciles it; route_db
+                # itself is NOT mutated (warm backends patch from it)
+                self._frr_outstanding = {
+                    "generation": self._change_seq,
+                    "entries": dict(update.unicast_routes_to_update),
+                    "deletes": list(update.unicast_routes_to_delete),
+                }
+                if not update.empty():
+                    # pending_trace_ctx is NOT consumed: the confirming
+                    # rebuild parents its own span on the same event,
+                    # and child_ctx preserves t0 so Fib's convergence
+                    # histogram measures event -> patched, not apply
+                    update.trace_ctx = self.tracer.child_ctx(
+                        span, self.pending_trace_ctx
+                    )
+                    self.route_updates_queue.push(update)
+        finally:
+            self.tracer.end_span(span)
+        apply_ms = (self.clock.now() - t0) * 1000.0
+        self.counters.bump("decision.frr_applied")
+        self.counters.observe("decision.frr_apply_ms", apply_ms)
+        svc.note_applied(
+            patch_key,
+            len(self._frr_outstanding["entries"]),
+            len(self._frr_outstanding["deletes"]),
+            apply_ms,
+        )
+
+    def _confirm_frr(
+        self, update: DecisionRouteUpdate, new_db: DecisionRouteDb
+    ) -> DecisionRouteUpdate:
+        """The confirm-authority step: the warm solve's ``new_db`` is
+        the truth; the FIB currently holds ``route_db ⊕ patch``.  On a
+        generation-exact divergence the patch LIED — purge the table,
+        dump the flight recorder, and replace the whole RIB (no
+        incremental delta from a lying table is trusted).  Otherwise
+        reconcile the diff (computed against route_db alone) so the FIB
+        lands exactly on ``new_db``; confirmed patch entries drop out
+        of the push instead of being re-programmed."""
+        frr, self._frr_outstanding = self._frr_outstanding, None
+        svc = self.protection
+        exact = frr["generation"] == self._change_seq
+        mismatched = []
+        for prefix, entry in frr["entries"].items():
+            got = new_db.unicast_routes.get(prefix)
+            if got is None or not got.eq_ignoring_cost(entry):
+                mismatched.append(prefix)
+        for prefix in frr["deletes"]:
+            if prefix in new_db.unicast_routes:
+                mismatched.append(prefix)
+        if exact and mismatched:
+            self.counters.bump("decision.frr_mismatches")
+            if svc is not None:
+                svc.on_mismatch(sorted(mismatched))
+            return DecisionRouteUpdate(
+                type=DecisionRouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update=dict(new_db.unicast_routes),
+                mpls_routes_to_update=dict(new_db.mpls_routes),
+            )
+        if svc is not None:
+            svc.note_confirm(exact)
+        deletes = set(update.unicast_routes_to_delete)
+        for prefix, entry in frr["entries"].items():
+            truth = new_db.unicast_routes.get(prefix)
+            if truth is None:
+                deletes.add(prefix)
+            elif truth.eq_ignoring_cost(entry):
+                update.unicast_routes_to_update.pop(prefix, None)
+                deletes.discard(prefix)
+            else:
+                update.unicast_routes_to_update[prefix] = truth
+                deletes.discard(prefix)
+        for prefix in frr["deletes"]:
+            if prefix in frr["entries"]:
+                continue
+            truth = new_db.unicast_routes.get(prefix)
+            if truth is None:
+                # the FIB already dropped it with the patch
+                deletes.discard(prefix)
+            else:
+                update.unicast_routes_to_update[prefix] = truth
+                deletes.discard(prefix)
+        update.unicast_routes_to_delete = sorted(deletes)
+        return update
 
     def generation_key(self) -> tuple:
         """Content address of the state every computed-result query
@@ -402,6 +579,16 @@ class Decision(Actor):
                     or ls.num_links() != links_before
                 ):
                     self._pending_topo_structural = True
+                if self.protection is not None:
+                    for lk in change.down_links:
+                        self._pending_down_pairs.add(
+                            tuple(sorted((lk.n1, lk.n2)))
+                        )
+                    if (
+                        change.other_topology_change
+                        or change.node_label_changed
+                    ):
+                        self._pending_other_change = True
                 return True
             return False
         parsed = parse_prefix_key(key)
@@ -434,6 +621,7 @@ class Decision(Actor):
                 self._pending_topo_changed = True
                 # a node left the LSDB: the symbol table shrinks
                 self._pending_topo_structural = True
+                self._pending_other_change = True
                 return True
             return False
         parsed = parse_prefix_key(key)
@@ -563,6 +751,8 @@ class Decision(Actor):
         self._pending_topo_changed = False
         self._pending_topo_structural = False
         self._pending_force_full = False
+        self._pending_down_pairs = set()
+        self._pending_other_change = False
         self._last_policy_active = policy_active
         if not force_full and changed:
             self.counters.bump("decision.incremental_route_builds")
@@ -614,6 +804,10 @@ class Decision(Actor):
             # FIB, not just this tick's changed prefixes
             self.counters.bump("decision.quarantine_full_replaces")
             force_full = True
+            if self.protection is not None:
+                # purge-on-suspicion: the device path just produced
+                # corrupt output — nothing it minted is trusted either
+                self.protection.purge_table("full_replace")
         # the RouteDb diff is the pipeline's delta-extract tail: the last
         # host stage between device output and the FIB publication
         probe = self._backend_probe()
@@ -647,6 +841,8 @@ class Decision(Actor):
                 # differ — diff O(changed) instead of O(total) so the
                 # publication→FIB latency stays flat in prefix count
                 update = self.route_db.calculate_update_for(new_db, changed)
+        if self._frr_outstanding is not None:
+            update = self._confirm_frr(update, new_db)
         first = not self._first_build_done
         if first:
             update = DecisionRouteUpdate(

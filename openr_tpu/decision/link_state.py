@@ -218,6 +218,15 @@ class LinkStateChange:
     link_attributes_changed: bool = False
     node_label_changed: bool = False
     added_links: List[Link] = field(default_factory=list)
+    #: usable links that went DOWN in this update (a clean up->down
+    #: flip, or an up link leaving the LSDB — one side withdrawing its
+    #: adjacency).  The protection tier's failure classifier reads this:
+    #: a tick whose ONLY topology change is down_links is patch-servable
+    down_links: List[Link] = field(default_factory=list)
+    #: any OTHER SPF-relevant change (link up/add, metric shift,
+    #: overload/drain flip, node-metric increment, node membership) —
+    #: such a tick is never served from a protection patch
+    other_topology_change: bool = False
 
 
 class LinkState:
@@ -362,12 +371,12 @@ class LinkState:
         prior_db = self._adj_dbs.get(node, AdjacencyDatabase(node, area=self.area))
         self._adj_dbs[node] = new_db
 
-        change.topology_changed |= self._update_node_overloaded(
-            node, new_db.is_overloaded
-        )
-        change.topology_changed |= (
-            prior_db.node_metric_increment_val != new_db.node_metric_increment_val
-        )
+        if self._update_node_overloaded(node, new_db.is_overloaded):
+            change.topology_changed = True
+            change.other_topology_change = True
+        if prior_db.node_metric_increment_val != new_db.node_metric_increment_val:
+            change.topology_changed = True
+            change.other_topology_change = True
         self._node_metric_increments[node] = new_db.node_metric_increment_val
         change.node_label_changed = prior_db.node_label != new_db.node_label
 
@@ -382,7 +391,9 @@ class LinkState:
                 j >= len(old_links) or new_links[i] < old_links[j]
             ):
                 nl = new_links[i]
-                change.topology_changed |= nl.is_up()
+                if nl.is_up():
+                    change.topology_changed = True
+                    change.other_topology_change = True
                 self._add_link(nl)
                 change.added_links.append(nl)
                 i += 1
@@ -391,17 +402,25 @@ class LinkState:
                 i >= len(new_links) or old_links[j] < new_links[i]
             ):
                 ol = old_links[j]
-                change.topology_changed |= ol.is_up()
+                if ol.is_up():
+                    change.topology_changed = True
+                    change.down_links.append(ol)
                 self._remove_link(ol)
                 j += 1
                 continue
             # same link identity: diff attributes in place on the live object
             nl, ol = new_links[i], old_links[j]
             if nl.get_metric_from_node(node) != ol.get_metric_from_node(node):
-                change.topology_changed |= ol.set_metric_from_node(
+                if ol.set_metric_from_node(
                     node, nl.get_metric_from_node(node)
-                )
+                ):
+                    change.topology_changed = True
+                    change.other_topology_change = True
             if nl.is_up() != ol.is_up():
+                if ol.is_up():
+                    change.down_links.append(ol)
+                else:
+                    change.other_topology_change = True
                 ol.usable = nl.usable
                 change.topology_changed = True
             if nl.get_overload_from_node(node) != ol.get_overload_from_node(node):
@@ -409,7 +428,10 @@ class LinkState:
                 # topology change (Link::setOverloadFromNode, LinkState.cpp:159)
                 was_up = ol.is_up()
                 ol.set_overload_from_node(node, nl.get_overload_from_node(node))
-                change.topology_changed |= was_up != ol.is_up()
+                if was_up != ol.is_up():
+                    # operator drain, not a failure: never patch-served
+                    change.topology_changed = True
+                    change.other_topology_change = True
             if nl.get_adj_label_from_node(node) != ol.get_adj_label_from_node(node):
                 change.link_attributes_changed = True
                 if ol._side(node) == 1:
@@ -459,6 +481,9 @@ class LinkState:
         self._kth_path_results.clear()
         self.topology_seq += 1
         change.topology_changed = True
+        # a node leaving the LSDB fails ALL its links at once — outside
+        # the single-link protection envelope by construction
+        change.other_topology_change = True
         return change
 
     # -- SPF (LinkState.cpp:721-807) ---------------------------------------

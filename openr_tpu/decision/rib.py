@@ -207,6 +207,13 @@ class DecisionRouteUpdate:
     #: causal-trace handle from the Decision rebuild that produced this
     #: delta; Fib parents its programming span here and closes the trace
     trace_ctx: Optional["TraceContext"] = None
+    #: fast-reroute provenance: True when this delta is a precomputed
+    #: protection patch published ahead of the confirming warm solve.
+    #: ``frr_generation`` is the Decision change_seq the patch was
+    #: applied AT — the streaming tier and Fib stamp it so monotone
+    #: generation ordering holds across the patch and its confirm
+    frr: bool = False
+    frr_generation: int = 0
 
     def empty(self) -> bool:
         return not (

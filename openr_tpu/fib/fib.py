@@ -209,6 +209,8 @@ class Fib(Actor):
             await self._process_route_update(update)
         finally:
             self.tracer.end_span(span, synced=not self._dirty)
+            if update.frr:
+                self.counters.bump("fib.frr_patches_applied")
             ctx = update.trace_ctx
             if ctx is not None:
                 # trace closes here: programming acknowledged (or marked
@@ -220,6 +222,14 @@ class Fib(Actor):
                     "convergence.event_to_fib_ms",
                     max(self.clock.now_ms() - ctx.t0_ms, 0),
                 )
+                if update.frr:
+                    # protection-tier fast path: the same event→FIB
+                    # latency, broken out so the bench can compare the
+                    # patched path against the warm-solve path
+                    self.counters.observe(
+                        "convergence.frr_event_to_fib_ms",
+                        max(self.clock.now_ms() - ctx.t0_ms, 0),
+                    )
                 self.tracer.instant(
                     "fib.ack",
                     self.tracer.child_ctx(span, ctx),

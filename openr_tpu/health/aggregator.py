@@ -205,6 +205,7 @@ class FleetHealthAggregator:
         self._queue_signal(snaps, firing)
         self._utilization_signal(snaps, firing)
         self._crash_signal(snaps, firing)
+        self._protection_signal(snaps, firing)
         firing.update(self.slos.evaluate(merged_hists, merged_counters))
         self.sink.report(firing)
 
@@ -453,6 +454,22 @@ class FleetHealthAggregator:
                 "crashes_seen": self._crashes_latched,
                 "restarts_seen": self._restarts_latched,
             }
+
+    def _protection_signal(self, snaps, firing) -> None:
+        """A fast-reroute patch that diverged from its confirming warm
+        solve briefly installed a wrong route — cumulative counter, so
+        the page stays active until the node restarts (deliberate: a
+        mismatch means the mint envelope has a hole and a human must
+        look)."""
+        rows = []
+        for s in snaps:
+            n = float(
+                s.get("counters", {}).get("protection.mismatches", 0.0)
+            )
+            if n > 0:
+                rows.append({"node": s["node"], "mismatches": n})
+        if rows:
+            firing["protection_mismatch"] = {"nodes": rows}
 
     # -- query surface -----------------------------------------------------
 

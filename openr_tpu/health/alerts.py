@@ -74,6 +74,12 @@ ALERTS: Dict[str, tuple] = {
         "a watchdog fired a crash somewhere in the fleet (module "
         "fiber death, stall, queue overflow, or chaos kill)",
     ),
+    "protection_mismatch": (
+        SEV_PAGE,
+        "a fast-reroute patch a node applied to its FIB diverged from "
+        "the confirming warm solve (the table was purged and the RIB "
+        "full-synced, but a wrong route was briefly installed)",
+    ),
     "slo_convergence_p99": (
         SEV_PAGE,
         "publication->FIB convergence p99 is burning its error "

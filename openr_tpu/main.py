@@ -498,6 +498,28 @@ class OpenrNode:
                 return recorder.stats()
 
             self.monitor.add_counter_provider(_recorder_gauges)
+        # fast-reroute protection tier (openr_tpu.protection): after
+        # each generation bump a debounced mint runs the single-link
+        # (+ SRLG) failure slice of the sweep grammar and compacts it
+        # into per-link FIB patches; a protected failure then converges
+        # by table lookup (decision.frr_applied) with the warm solve as
+        # the confirming authority
+        self.protection = None
+        pc = config.protection_config
+        if pc.enabled:
+            from openr_tpu.protection import ProtectionService
+
+            self.protection = ProtectionService(
+                node_name=self.name,
+                clock=clock,
+                config=pc,
+                decision=self.decision,
+                counters=self.counters,
+                tracer=self.tracer,
+                flight_recorder=self.flight_recorder,
+                srlg_groups=config.sweep_config.srlg_groups,
+            )
+            self.monitor.add_counter_provider(self.protection.gauges)
         # fleet health plane: SLO burn-rate evaluation + cross-node
         # rollups over MetricsSnapshots.  The default source is this
         # node alone; EmulatedNetwork re-points it at the whole fleet
@@ -601,6 +623,8 @@ class OpenrNode:
             self._all_modules.append(self.streaming)
         if config.sweep_config.enabled:
             self._all_modules.append(self.sweep)
+        if self.protection is not None:
+            self._all_modules.append(self.protection)
         if self.health_monitor is not None:
             self._all_modules.append(self.health_monitor)
         if self.watchdog is not None:

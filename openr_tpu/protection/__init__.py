@@ -1,0 +1,48 @@
+"""Fast-reroute protection tier: sweep-minted per-link FIB patches.
+
+The capacity sweep already prices every single-link failure the fabric
+can throw at us; this package spends that same batched device pass
+minting a per-link (and per-SRLG) table of compacted FIB patches, so a
+protected failure converges by table lookup — publish the precomputed
+patch, then let the normal warm solve confirm it — instead of waiting
+on a solve.  See ``docs/Robustness.md`` §fast-reroute.
+"""
+
+from openr_tpu.protection.builder import ProtectionBuildError, ProtectionBuilder
+from openr_tpu.protection.patch import (
+    STATE_EMPTY,
+    STATE_MINTING,
+    STATE_READY,
+    STATE_STALE,
+    FibPatchError,
+    ProtectionTable,
+    generation_doc,
+    link_patch_key,
+    make_ineligible_patch,
+    make_patch,
+    materialize_patch,
+    patch_hash,
+    patch_key_for_scenario,
+)
+from openr_tpu.protection.service import ProtectionService
+from openr_tpu.protection.store import ProtectionStore
+
+__all__ = [
+    "STATE_EMPTY",
+    "STATE_MINTING",
+    "STATE_READY",
+    "STATE_STALE",
+    "FibPatchError",
+    "ProtectionBuildError",
+    "ProtectionBuilder",
+    "ProtectionService",
+    "ProtectionStore",
+    "ProtectionTable",
+    "generation_doc",
+    "link_patch_key",
+    "make_ineligible_patch",
+    "make_patch",
+    "materialize_patch",
+    "patch_hash",
+    "patch_key_for_scenario",
+]

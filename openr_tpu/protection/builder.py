@@ -1,0 +1,299 @@
+"""Minting: compact a single-link-failure sweep into FIB patches.
+
+The builder is a *rider* on the capacity sweep's executor, not a second
+solve path: it runs the single-link (+ SRLG) slice of the scenario
+grammar as one batched device sweep via
+:class:`openr_tpu.sweep.executor.SweepExecutor`, and consumes each
+world group's drained route deltas through the SAME
+``reduce.world_deltas`` pass the reducer's row extraction reads
+(``executor.delta_consumer``) — one device pass, two consumers.  Per
+scenario it compacts the delta into a :class:`FibPatch` document and
+persists it per shard through ``executor.commit_hook`` under the
+sweep's own durability ordering, so a killed mint resumes from the last
+committed shard on both the checkpoint and the patch store.
+
+**Compaction exactness.**  A patch row must reproduce — byte for byte
+against ``eq_ignoring_cost`` — the RIB entry the warm solve would
+compute for the failed world, WITHOUT running best-route selection at
+apply time.  That is only sound where selection is invariant under the
+topology change, so compaction is deliberately conservative: any
+scenario touching a prefix outside the provable envelope mints an
+INELIGIBLE tombstone (apply falls back warm) rather than a guess:
+
+* single-advertiser prefixes only (the best-route winner cannot flip);
+* advertiser != vantage (skip-if-self handled by the warm path);
+* SP_ECMP only (KSP2 recomputes disjoint paths per topology);
+* nexthop lanes decode from the UNfailed base topology's out-edges
+  (a single remote link failure never changes the vantage's lanes; a
+  failed ADJACENT link's lane simply never appears in the surviving
+  selection mask);
+* the device ``valid`` lane is trusted as-is — the fused selection
+  kernel already applied drain/preference/min-nexthop semantics;
+* the advertiser's drain flag is baked at mint time (generation-exact
+  application guarantees it still holds at apply time).
+
+Global ineligibility (whole table serves nothing): multi-area LSDB,
+an active RIB policy, node segment labels (MPLS routes are outside the
+patch envelope).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from openr_tpu.protection.patch import (
+    generation_doc,
+    make_ineligible_patch,
+    make_patch,
+    patch_key_for_scenario,
+)
+from openr_tpu.sweep.executor import SweepExecutor
+from openr_tpu.sweep.reduce import world_deltas
+from openr_tpu.sweep.scenario import ScenarioSpec
+from openr_tpu.types import PrefixForwardingAlgorithm, prefix_is_v4
+
+
+class ProtectionBuildError(RuntimeError):
+    """The mint cannot proceed (no LSDB, multi-area, generation moved
+    mid-mint)."""
+
+
+class ProtectionBuilder:
+    def __init__(
+        self,
+        inputs_fn,
+        store,
+        solver,
+        spill_dir: str,
+        clock=None,
+        counters=None,
+        shard_scenarios: int = 256,
+        srlg_groups: Tuple = (),
+        max_links: int = 0,
+        policy_active_fn: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        self.inputs_fn = inputs_fn
+        self.store = store
+        self.solver = solver
+        self.spill_dir = spill_dir
+        self.clock = clock
+        self.counters = counters
+        self.shard_scenarios = shard_scenarios
+        self.srlg_groups = tuple(srlg_groups)
+        self.max_links = max_links
+        self.policy_active_fn = policy_active_fn
+        self.executor: Optional[SweepExecutor] = None
+        self.generation: Optional[Tuple] = None
+        self.generation_doc: Optional[dict] = None
+        self.set_hash = ""
+        #: shard id -> compacted patch docs awaiting the commit hook
+        self._buffers: Dict[int, List[dict]] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _generation_of(self, inputs) -> Tuple:
+        return (
+            inputs.change_seq,
+            tuple(
+                (a, inputs.area_link_states[a].topology_seq)
+                for a in sorted(inputs.area_link_states)
+            ),
+        )
+
+    def prepare(self, resume: bool = True) -> dict:
+        inputs = self.inputs_fn()
+        if not inputs.area_link_states:
+            raise ProtectionBuildError("no LSDB yet — nothing to protect")
+        if len(inputs.area_link_states) > 1:
+            raise ProtectionBuildError(
+                "protection tier is single-area only (multi-area LSDB)"
+            )
+        self.generation = self._generation_of(inputs)
+        self.generation_doc = generation_doc(self.generation)
+        spec = ScenarioSpec(
+            single_link_failures=True,
+            combo_k=0,
+            max_single_link_scenarios=self.max_links,
+            srlg_groups=self.srlg_groups,
+        )
+        ex = SweepExecutor(
+            self.inputs_fn,
+            self.spill_dir,
+            clock=self.clock,
+            counters=self.counters,
+            shard_scenarios=self.shard_scenarios,
+        )
+        ex.delta_consumer = self._consume
+        ex.commit_hook = self._commit
+        report = ex.prepare(spec, resume=resume)
+        self.set_hash = report["set_hash"]
+        resumed = bool(resume and ex.completed) and self.store.resume(
+            self.generation_doc, self.set_hash, ex.completed
+        )
+        if not resumed:
+            if ex.completed:
+                # the sweep checkpoint resumed but the patch store
+                # cannot back it (wiped, drifted) — fresh mint
+                report = ex.prepare(spec, resume=False)
+            self.store.begin(self.generation_doc, self.set_hash)
+        self.executor = ex
+        self._buffers.clear()
+        return dict(report, resumed=resumed)
+
+    def step(self, shards: int = 1) -> None:
+        """Run ``shards`` more shards of the mint.  Refuses to touch
+        the device if the LSDB moved past the minting generation —
+        shards of two generations must never mix in one table."""
+        if self.executor is None:
+            raise ProtectionBuildError("step before prepare")
+        if self._generation_of(self.inputs_fn()) != self.generation:
+            raise ProtectionBuildError("generation moved mid-mint")
+        self.executor.run(stop_after_shards=shards)
+
+    def finished(self) -> bool:
+        return self.executor is not None and not self.executor.pending_shards()
+
+    def finalize(self) -> dict:
+        if not self.finished():
+            raise ProtectionBuildError("finalize before the mint finished")
+        table_hash = self.store.commit_ready()
+        patches, eligible = self.store.counts()
+        return {
+            "table_hash": table_hash,
+            "patches": patches,
+            "eligible": eligible,
+            "set_hash": self.set_hash,
+        }
+
+    # -- executor riders ----------------------------------------------------
+
+    def _consume(self, ctx, shard_id: int, group, deltas) -> None:
+        from openr_tpu.tracing import pipeline
+        from openr_tpu.tracing.pipeline import disabled_probe
+
+        inputs = ctx["inputs"]
+        probe = inputs.probe if inputs.probe is not None else disabled_probe()
+        with probe.phase(pipeline.PROTECTION_MINT):
+            buf = self._buffers.setdefault(shard_id, [])
+            glob = self._global_reason()
+            topo = ctx["topo"]
+            root = ctx["root"]
+            out_edges = topo.root_out_edges(root)
+            prefixes = ctx["cands"].prefixes
+            pmap = inputs.prefix_state.prefixes()
+            (_, ls), = inputs.area_link_states.items()
+            for scen, solve, _r, delta in world_deltas(group, deltas):
+                buf.append(
+                    self._compact(
+                        scen,
+                        solve,
+                        delta,
+                        deltas,
+                        glob,
+                        root,
+                        out_edges,
+                        prefixes,
+                        pmap,
+                        ls,
+                    )
+                )
+
+    def _commit(self, shard_id: int) -> None:
+        self.store.put_shard(shard_id, self._buffers.pop(shard_id, []))
+
+    # -- compaction ---------------------------------------------------------
+
+    def _global_reason(self) -> str:
+        if self.policy_active_fn is not None and self.policy_active_fn():
+            return "rib_policy"
+        if getattr(self.solver, "enable_node_segment_label", False):
+            return "node_segment_label"
+        return ""
+
+    def _compact(
+        self,
+        scen,
+        solve: str,
+        delta,
+        deltas,
+        glob: str,
+        root: str,
+        out_edges,
+        prefixes,
+        pmap,
+        ls,
+    ) -> dict:
+        key = patch_key_for_scenario(scen)
+        if glob:
+            return make_ineligible_patch(key, glob)
+        if solve == "error":
+            return make_ineligible_patch(key, "unresolved_links")
+        if solve == "alias":
+            # the failure aliased to the base world: a valid EMPTY patch
+            return make_patch(key, [], [])
+        p_idx, valid, metric, lanes = delta
+        v4_ok = self.solver.enable_v4 or self.solver.v4_over_v6_nexthop
+        sets: List[dict] = []
+        deletes: List[str] = []
+        for j in range(len(p_idx)):
+            pi = int(p_idx[j])
+            prefix = prefixes[pi]
+            entries = pmap.get(prefix) or {}
+            if len(entries) != 1:
+                return make_ineligible_patch(key, "multi_advertiser")
+            (adv, p_area), entry = next(iter(entries.items()))
+            if adv == root:
+                return make_ineligible_patch(key, "self_advertised")
+            if (
+                entry.forwarding_algorithm
+                == PrefixForwardingAlgorithm.KSP2_ED_ECMP
+            ):
+                return make_ineligible_patch(key, "ksp2")
+            is_v4 = prefix_is_v4(prefix)
+            if is_v4 and not v4_ok:
+                # never installed, failed world or not
+                continue
+            if not bool(valid[j]):
+                if bool(deltas.base_valid[pi]):
+                    deletes.append(prefix)
+                continue
+            m = float(metric[j])
+            nhs = []
+            for lane in np.nonzero(lanes[j])[0].tolist():
+                if lane >= len(out_edges):
+                    continue
+                link, neighbor = out_edges[lane]
+                addr = (
+                    link.get_nh_v4_from_node(root)
+                    if is_v4 and not self.solver.v4_over_v6_nexthop
+                    else link.get_nh_v6_from_node(root)
+                )
+                nhs.append(
+                    [
+                        neighbor,
+                        addr,
+                        link.get_iface_from_node(root),
+                        int(m),
+                        link.area,
+                    ]
+                )
+            if not nhs:
+                return make_ineligible_patch(key, "no_nexthops")
+            nhs.sort()
+            drained = (
+                ls.is_node_overloaded(adv)
+                or ls.get_node_metric_increment(adv) != 0
+            )
+            sets.append(
+                {
+                    "prefix": prefix,
+                    "advertiser": adv,
+                    "area": p_area,
+                    "igp_cost": m,
+                    "drained": bool(drained),
+                    "nexthops": nhs,
+                }
+            )
+        return make_patch(key, sets, deletes)

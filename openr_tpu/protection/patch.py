@@ -1,0 +1,294 @@
+"""FIB patches and the per-node protection table.
+
+A :class:`FibPatch` is the compacted per-failure route delta the
+protection tier mints ahead of time: for ONE protected failure (a
+single link, or one SRLG risk group) it records exactly which prefixes
+change and what their new nexthop sets are, plus the prefixes whose
+routes disappear.  It is plain data — canonical-JSON documents, content
+hashable — so the table is spillable, resumable and byte-reproducible.
+
+The :class:`ProtectionTable` owns the lifecycle discipline the whole
+tier hangs on:
+
+* a patch is generation-exact: it was minted FROM LSDB generation G and
+  protects exactly the transition G -> G+1.  ``lookup`` refuses
+  anything else (``stale``);
+* a mid-mint table never serves (``minting``);
+* a purge-on-suspicion (quarantine, corruption, full replace, confirm
+  mismatch) empties the table — protection silently degrades to the
+  warm-solve path, never to a wrong answer.
+
+The mutators (``begin_mint`` / ``mark_ready`` / ``mark_stale`` /
+``abort_mint`` / ``purge_table``) are orlint-guarded (rule
+``protection-table``): only this package and ``decision/decision.py``
+may drive them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.decision.rib import RibUnicastEntry
+from openr_tpu.decision.spf_solver import drained_entry
+from openr_tpu.sweep.scenario import canonical_json, content_hash, srlg_domain
+from openr_tpu.common.runtime import CounterMap
+from openr_tpu.types import NextHop
+
+# -- table states ------------------------------------------------------------
+
+STATE_EMPTY = "empty"
+STATE_MINTING = "minting"
+STATE_READY = "ready"
+#: the LSDB moved past the table's generation; patches stay on disk
+#: (an in-flight event whose prev generation matches still hits) but
+#: the table wants a re-mint
+STATE_STALE = "stale"
+
+
+def link_patch_key(pair) -> str:
+    """Patch key of a single protected link — the reducer's
+    ``_link_key`` convention, so criticality rankings and protection
+    tables index links identically."""
+    return "|".join(sorted(map(str, pair)))
+
+
+def patch_key_for_scenario(scenario) -> str:
+    """The table key a sweep scenario's patch files under: the SRLG
+    domain label for risk-group scenarios, the link key otherwise."""
+    if scenario.domains:
+        return scenario.domains[0]
+    return link_patch_key(scenario.failed_links[0])
+
+
+def generation_doc(key: Tuple) -> dict:
+    """Canonical-JSON form of a Decision ``generation_key()`` —
+    ``(change_seq, ((area, topology_seq), ...))`` — the identity a
+    minted table is content-addressed to."""
+    change_seq, areas = key
+    return {
+        "change_seq": int(change_seq),
+        "areas": [[a, int(s)] for a, s in areas],
+    }
+
+
+def generation_key_from_doc(doc: dict) -> Tuple:
+    return (
+        int(doc["change_seq"]),
+        tuple((a, int(s)) for a, s in doc["areas"]),
+    )
+
+
+# -- patch documents ---------------------------------------------------------
+
+
+def make_patch(
+    key: str,
+    sets: List[dict],
+    deletes: List[str],
+) -> dict:
+    """An eligible patch document.  ``sets`` rows carry everything
+    ``materialize_patch`` needs to rebuild a RibUnicastEntry against
+    the LIVE PrefixState at apply time:
+
+    ``{"prefix", "advertiser", "area", "igp_cost", "drained",
+    "nexthops": [[neighbor, address, if_name, metric, area], ...]}``
+    """
+    return {
+        "key": key,
+        "eligible": True,
+        "reason": "",
+        "sets": sorted(sets, key=lambda r: r["prefix"]),
+        "deletes": sorted(deletes),
+    }
+
+
+def make_ineligible_patch(key: str, reason: str) -> dict:
+    """A tombstone: this failure CANNOT be served from a patch (KSP2
+    prefix, multi-advertiser, unresolved links, ...) — apply falls back
+    to the warm solve, counted ``protection.fallback.miss``."""
+    return {
+        "key": key,
+        "eligible": False,
+        "reason": reason,
+        "sets": [],
+        "deletes": [],
+    }
+
+
+def patch_hash(doc: dict) -> str:
+    return content_hash(doc)
+
+
+def materialize_patch(
+    doc: dict, prefix_state
+) -> Optional[Tuple[Dict[str, RibUnicastEntry], List[str]]]:
+    """Rebuild RIB entries from a patch document against the LIVE
+    PrefixState.  Generation-exact application guarantees the state is
+    the one the patch was minted from; if any advertised entry has
+    nevertheless vanished (defensive: should be unreachable under the
+    discipline), returns None and the caller falls back warm."""
+    prefixes_map = prefix_state.prefixes()
+    updates: Dict[str, RibUnicastEntry] = {}
+    for row in doc["sets"]:
+        entries = prefixes_map.get(row["prefix"])
+        if not entries:
+            return None
+        entry = entries.get((row["advertiser"], row["area"]))
+        if entry is None:
+            return None
+        nhs = frozenset(
+            NextHop(
+                address=addr,
+                if_name=if_name,
+                metric=int(metric),
+                area=nh_area,
+                neighbor_node_name=neighbor,
+            )
+            for neighbor, addr, if_name, metric, nh_area in row["nexthops"]
+        )
+        if not nhs:
+            return None
+        best = drained_entry(entry) if row["drained"] else entry
+        updates[row["prefix"]] = RibUnicastEntry(
+            prefix=row["prefix"],
+            nexthops=nhs,
+            best_prefix_entry=best,
+            best_area=row["area"],
+            igp_cost=float(row["igp_cost"]),
+            local_prefix_considered=False,
+        )
+    return updates, list(doc["deletes"])
+
+
+# -- the table ---------------------------------------------------------------
+
+
+class ProtectionTable:
+    """State machine + lookup surface over a :class:`ProtectionStore`.
+
+    ``lookup(prev_key, patch_key)`` returns ``(status, doc)`` where
+    status is one of ``hit | miss | stale | minting`` — the staleness
+    matrix the apply path counts fallbacks by.  Note that the STALE
+    state does NOT by itself refuse a lookup: a table minted at
+    generation G is marked stale the moment the LSDB bumps to G+1 —
+    which is exactly the failure event it protects.  The gate is
+    generation EQUALITY with the event's previous generation."""
+
+    def __init__(self, store, counters: Optional[CounterMap] = None) -> None:
+        self.store = store
+        self.counters = counters if counters is not None else CounterMap()
+        self.state = STATE_EMPTY
+        #: generation key tuple the READY/STALE table was minted from
+        self.generation: Optional[Tuple] = None
+        self.set_hash = ""
+        self.table_hash = ""
+        self.patches = 0
+        self.eligible = 0
+        self.num_mints = 0
+        self.num_purges = 0
+        self.last_purge_reason = ""
+
+    # -- mutators (orlint rule protection-table) ----------------------------
+
+    def begin_mint(self, generation_key: Tuple, set_hash: str) -> None:
+        self.state = STATE_MINTING
+        self.generation = generation_key
+        self.set_hash = set_hash
+        self.table_hash = ""
+        self.patches = 0
+        self.eligible = 0
+
+    def mark_ready(self, table_hash: str, patches: int, eligible: int) -> None:
+        self.state = STATE_READY
+        self.table_hash = table_hash
+        self.patches = patches
+        self.eligible = eligible
+        self.num_mints += 1
+        self.counters.bump("protection.mints")
+
+    def mark_stale(self) -> None:
+        if self.state == STATE_READY:
+            self.state = STATE_STALE
+
+    def abort_mint(self) -> None:
+        """The LSDB moved mid-mint: the partial store stays on disk (it
+        is generation-pinned, a future resume against the same
+        generation can pick it up) but the table serves nothing."""
+        if self.state == STATE_MINTING:
+            self.state = STATE_EMPTY
+            self.generation = None
+            self.counters.bump("protection.mint_aborts")
+
+    def purge_table(self, reason: str) -> None:
+        """Purge-on-suspicion: quarantine, corruption, full replace or
+        confirm mismatch — drop everything, on disk included."""
+        self.state = STATE_EMPTY
+        self.generation = None
+        self.set_hash = ""
+        self.table_hash = ""
+        self.patches = 0
+        self.eligible = 0
+        self.num_purges += 1
+        self.last_purge_reason = reason
+        self.counters.bump("protection.purges")
+        self.counters.bump(f"protection.purge.{reason}")
+        self.store.wipe()
+
+    # -- lookup -------------------------------------------------------------
+
+    def lookup(self, prev_key: Tuple, patch_key: str):
+        """(status, doc): ``hit`` iff the table holds an ELIGIBLE patch
+        minted from exactly ``prev_key``."""
+        if self.state == STATE_MINTING:
+            return "minting", None
+        if self.state == STATE_EMPTY:
+            return "miss", None
+        if self.generation != prev_key:
+            return "stale", None
+        doc = self.store.lookup(patch_key)
+        if doc is None or not doc.get("eligible"):
+            return "miss", None
+        return "hit", doc
+
+    # -- introspection ------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "state": self.state,
+            "generation": (
+                None
+                if self.generation is None
+                else generation_doc(self.generation)
+            ),
+            "set_hash": self.set_hash,
+            "table_hash": self.table_hash,
+            "patches": self.patches,
+            "eligible": self.eligible,
+            "num_mints": self.num_mints,
+            "num_purges": self.num_purges,
+            "last_purge_reason": self.last_purge_reason,
+        }
+
+
+__all__ = [
+    "STATE_EMPTY",
+    "STATE_MINTING",
+    "STATE_READY",
+    "STATE_STALE",
+    "FibPatchError",
+    "ProtectionTable",
+    "canonical_json",
+    "generation_doc",
+    "generation_key_from_doc",
+    "link_patch_key",
+    "make_ineligible_patch",
+    "make_patch",
+    "materialize_patch",
+    "patch_hash",
+    "patch_key_for_scenario",
+    "srlg_domain",
+]
+
+
+class FibPatchError(RuntimeError):
+    """A patch document failed validation at load/apply time."""

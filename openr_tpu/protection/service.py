@@ -1,0 +1,332 @@
+"""ProtectionService — the fast-reroute protection tier as a daemon
+actor.
+
+After every Decision generation bump the service schedules a re-mint
+(debounced, so a churn burst mints once): the
+:class:`openr_tpu.protection.builder.ProtectionBuilder` runs the
+single-link (+ SRLG) failure slice of the sweep grammar as one batched
+device sweep on a background fiber that yields between shard commits —
+the daemon keeps serving while the table mints.  The table serves the
+Decision apply path (``decision._maybe_apply_protection``) through
+``classify_pairs`` / ``lookup`` / ``apply_patch``, and every refusal
+reason lands in ``protection.fallback.*``.
+
+Staleness discipline:
+
+* the generation listener (priority 20, AFTER cache purges and the
+  streaming tier) marks the table stale and the mint dirty on every
+  bump — the sitting table still serves the ONE event whose previous
+  generation matches exactly (that event IS the failure it protects);
+* a mint aborts between shards the moment the generation moves
+  (``protection.mint_aborts``) — two generations never mix in a table;
+* quarantine (the governor's listener), corruption full-replaces and
+  confirm mismatches purge the table AND its on-disk store
+  (purge-on-suspicion) and trigger a flight-recorder dump.
+
+Surfaces: ctrl verbs ``get_protection_status`` /
+``get_protection_table``; ``breeze protection status|table``;
+``protection.*`` counters (mints, fallbacks, applies, mismatches) and
+the ``pipeline.protection_mint`` / ``pipeline.protection_apply`` phase
+attribution.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.protection.builder import ProtectionBuildError, ProtectionBuilder
+from openr_tpu.protection.patch import (
+    ProtectionTable,
+    link_patch_key,
+    materialize_patch,
+)
+from openr_tpu.protection.store import ProtectionStore
+from openr_tpu.sweep.executor import SweepError, SweepInputs
+from openr_tpu.sweep.scenario import normalize_srlg_groups, srlg_domain
+
+
+class ProtectionService(Actor):
+    def __init__(
+        self,
+        node_name: str,
+        clock: Clock,
+        config,
+        decision,
+        counters: Optional[CounterMap] = None,
+        tracer=None,
+        flight_recorder=None,
+        srlg_groups=(),
+    ) -> None:
+        super().__init__("protection", clock, counters)
+        from openr_tpu.tracing import disabled_tracer
+
+        self.node_name = node_name
+        self.config = config
+        self.decision = decision
+        self.tracer = tracer if tracer is not None else disabled_tracer()
+        self.flight_recorder = flight_recorder
+        self.srlg_groups = normalize_srlg_groups(srlg_groups)
+        #: exact SRLG pair-set -> patch key: a multi-link event is
+        #: protected iff its failed pairs ARE one configured risk group
+        self._srlg_by_pairset = {
+            frozenset(pairs): srlg_domain(name)
+            for name, pairs in self.srlg_groups
+        }
+        self.table = ProtectionTable(
+            ProtectionStore(
+                self._store_dir(), max_host_patches=config.max_host_patches
+            ),
+            counters=self.counters,
+        )
+        self.builder: Optional[ProtectionBuilder] = None
+        self._dirty = True
+        self._abort_requested = False
+        self.error = ""
+        self.num_applied = 0
+        self.last_applied: Optional[dict] = None
+        self.last_mint: Optional[dict] = None
+
+    # -- wiring --------------------------------------------------------------
+
+    def _store_dir(self) -> str:
+        base = self.config.store_dir
+        if base:
+            return base
+        return f"/tmp/openr_tpu_protection.{self.node_name}"
+
+    def start(self) -> None:
+        self.decision.protection = self
+        # priority 20: AFTER the serving plane's cache purges (0) and
+        # the streaming tier's publish scheduler (10) — staleness
+        # marking must never outrun a purge of its own generation
+        self.decision.add_generation_listener(
+            self._on_generation, priority=20
+        )
+        governor = getattr(self.decision.backend, "governor", None)
+        if governor is not None:
+            governor.add_quarantine_listener(self._on_quarantine)
+        self.spawn(self._mint_loop(), name="protection.mint")
+
+    def _on_generation(self, _change_seq: int) -> None:
+        self.table.mark_stale()
+        self._dirty = True
+
+    def _on_quarantine(self, info: dict) -> None:
+        """Purge-on-suspicion: a chip was quarantined — any patch it
+        helped mint is untrusted.  The in-flight mint (if any) aborts
+        at its next shard boundary and re-mints on the survivors."""
+        self.table.purge_table("quarantine")
+        self._abort_requested = True
+        self._dirty = True
+        if self.flight_recorder is not None:
+            self.flight_recorder.trigger_dump(
+                "protection_purge_quarantine", extra=dict(info)
+            )
+
+    # -- minting -------------------------------------------------------------
+
+    def _make_builder(self) -> ProtectionBuilder:
+        import os
+
+        return ProtectionBuilder(
+            lambda: SweepInputs(**self.decision.capacity_sweep_inputs()),
+            self.table.store,
+            self.decision.solver,
+            os.path.join(self._store_dir(), "sweep"),
+            clock=self.clock,
+            counters=self.counters,
+            shard_scenarios=self.config.shard_scenarios,
+            srlg_groups=self.srlg_groups,
+            max_links=self.config.max_links,
+            policy_active_fn=lambda: (
+                self.decision.rib_policy is not None
+                and self.decision.rib_policy.is_active(self.clock)
+            ),
+        )
+
+    async def _mint_loop(self) -> None:
+        tick = max(self.config.mint_debounce_s, 0.05)
+        while True:
+            await self.clock.sleep(tick)
+            self.touch()
+            if not self._dirty:
+                continue
+            if not self.decision.rebuild_settled():
+                continue
+            self._dirty = False
+            self._abort_requested = False
+            try:
+                await self._mint_once()
+            except (ProtectionBuildError, SweepError) as e:
+                self.error = str(e)
+                self.counters.bump("protection.mint_failed")
+
+    async def _mint_once(self) -> None:
+        t0 = self.clock.now()
+        span = self.tracer.start_span(
+            "protection.mint", None, module="protection"
+        )
+        builder = self._make_builder()
+        aborted = False
+        try:
+            key = self.decision.generation_key()
+            report = builder.prepare(resume=True)
+            self.table.begin_mint(builder.generation, builder.set_hash)
+            self.builder = builder
+            while not builder.finished():
+                if (
+                    self._abort_requested
+                    or self.decision.generation_key() != key
+                ):
+                    aborted = True
+                    self.table.abort_mint()
+                    return
+                builder.step(1)
+                self.touch()
+                await self.clock.sleep(self.config.inter_shard_pause_s)
+            if self.decision.generation_key() != key:
+                aborted = True
+                self.table.abort_mint()
+                return
+            final = builder.finalize()
+            self.table.mark_ready(
+                final["table_hash"], final["patches"], final["eligible"]
+            )
+            mint_ms = (self.clock.now() - t0) * 1000.0
+            self.counters.observe("protection.mint_wall_ms", mint_ms)
+            self.last_mint = {
+                "generation": self.table.status()["generation"],
+                "table_hash": final["table_hash"],
+                "patches": final["patches"],
+                "eligible": final["eligible"],
+                "mint_ms": round(mint_ms, 3),
+                "resumed": report.get("resumed", False),
+            }
+            self.error = ""
+        except ProtectionBuildError:
+            self.table.abort_mint()
+            raise
+        finally:
+            self.tracer.end_span(span, aborted=aborted)
+
+    def mint_now(self) -> dict:
+        """Synchronous full mint (bench / test harness path): prepare,
+        run every shard, seal.  The async fiber discipline (abort on
+        generation move) is the caller's concern here."""
+        builder = self._make_builder()
+        report = builder.prepare(resume=True)
+        self.table.begin_mint(builder.generation, builder.set_hash)
+        self.builder = builder
+        while not builder.finished():
+            builder.step(1)
+        final = builder.finalize()
+        self.table.mark_ready(
+            final["table_hash"], final["patches"], final["eligible"]
+        )
+        self._dirty = False
+        return dict(report, **final)
+
+    # -- the apply surface (called by decision._maybe_apply_protection) -----
+
+    def classify_pairs(self, pairs) -> Optional[str]:
+        """The patch key a down-pair set is protected under: the link
+        key for one pair, the SRLG domain for an exact risk-group
+        match, None (unprotected multi-failure) otherwise."""
+        pairset = frozenset(tuple(sorted(p)) for p in pairs)
+        if len(pairset) == 1:
+            return link_patch_key(next(iter(pairset)))
+        return self._srlg_by_pairset.get(pairset)
+
+    def lookup(self, prev_key, patch_key: str):
+        return self.table.lookup(prev_key, patch_key)
+
+    def apply_patch(self, doc: dict, prefix_state):
+        return materialize_patch(doc, prefix_state)
+
+    def note_fallback(self, reason: str) -> None:
+        self.counters.bump("protection.fallbacks")
+        self.counters.bump(f"protection.fallback.{reason}")
+
+    def note_applied(
+        self, patch_key: str, sets: int, deletes: int, apply_ms: float
+    ) -> None:
+        self.num_applied += 1
+        self.counters.bump("protection.applied")
+        self.last_applied = {
+            "key": patch_key,
+            "sets": sets,
+            "deletes": deletes,
+            "apply_ms": round(apply_ms, 3),
+        }
+
+    def note_confirm(self, exact: bool) -> None:
+        self.counters.bump(
+            "protection.confirms"
+            if exact
+            else "protection.confirm_superseded"
+        )
+
+    def on_mismatch(self, prefixes) -> None:
+        """The confirming warm solve diverged from an applied patch:
+        the worst protection outcome — purge everything and dump the
+        flight recorder around the evidence."""
+        self.counters.bump("protection.mismatches")
+        self.table.purge_table("mismatch")
+        self._dirty = True
+        if self.flight_recorder is not None:
+            self.flight_recorder.trigger_dump(
+                "protection_mismatch",
+                extra={"prefixes": list(prefixes)[:64]},
+            )
+
+    def purge_table(self, reason: str) -> None:
+        self.table.purge_table(reason)
+        self._dirty = True
+
+    # -- ctrl surface --------------------------------------------------------
+
+    def get_protection_status(self) -> dict:
+        out = {
+            "node": self.node_name,
+            "error": self.error,
+            "applied": self.num_applied,
+            "last_applied": self.last_applied,
+            "last_mint": self.last_mint,
+            "store": self.table.store.stats(),
+        }
+        out.update(self.table.status())
+        return out
+
+    def get_protection_table(
+        self, key: Optional[str] = None, limit: int = 64
+    ) -> dict:
+        """The minted table: one decoded patch for ``key``, else the
+        key listing (bounded by ``limit``)."""
+        if key is not None:
+            doc = self.table.store.lookup(key)
+            return {
+                "node": self.node_name,
+                "key": key,
+                "patch": doc,
+            }
+        keys = self.table.store.keys()
+        return {
+            "node": self.node_name,
+            "state": self.table.state,
+            "total": len(keys),
+            "keys": keys[: max(0, limit)],
+        }
+
+    # -- observability -------------------------------------------------------
+
+    def gauges(self) -> Dict[str, float]:
+        return {
+            "protection.ready": (
+                1.0 if self.table.state == "ready" else 0.0
+            ),
+            "protection.patches": float(self.table.patches),
+            "protection.eligible": float(self.table.eligible),
+            "protection.table_mints": float(self.table.num_mints),
+            "protection.table_purges": float(self.table.num_purges),
+        }

@@ -1,0 +1,275 @@
+"""Spill-backed, host-memory-bounded protection patch store.
+
+The full patch table for a big fabric (one patch per protected link,
+each patch up to thousands of route rows) must never be host-resident
+in bulk.  Patches land on disk as per-shard JSONL files
+(``patches-NNNNN.jsonl``, one patch document per line, tmp+rename so a
+re-run of the same shard overwrites idempotently) under a
+``protection-manifest.json`` that pins the minting generation and
+scenario-set hash.  In memory the store keeps only:
+
+* a key -> (file, byte offset) index (O(patches) small tuples);
+* an LRU cache of DECODED patch documents bounded by
+  ``max_host_patches`` — the apply path's working set.
+
+**Durability ordering** mirrors the sweep spill's resume invariant: the
+shard file is written, fsynced and renamed into place BEFORE the shard
+is recorded in the manifest, and the executor's checkpoint commit runs
+after the store commit (the ``commit_hook`` rider fires between spill
+and checkpoint) — so every shard the checkpoint claims is backed by
+durable patches, and a kill-during-mint resumes from the last committed
+shard on both ledgers.
+
+This store deliberately REIMPLEMENTS its atomic-write discipline rather
+than borrowing ``sweep.spill.SpillWriter``: the spill mutators are
+sweep-package-owned (orlint rule ``sweep-spill-ownership``) and their
+segment/rotation model does not fit keyed random access.
+
+``table_hash`` is the byte-identity handle chaos tests and the bench
+compare: the content hash of the manifest's per-shard content hashes
+(plus the set hash), a pure function of the minted patch set however
+many kills and resumes produced it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.sweep.scenario import canonical_json, content_hash
+
+MANIFEST_NAME = "protection-manifest.json"
+SHARD_FMT = "patches-{:05d}.jsonl"
+
+
+def _atomic_write(path: str, text: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class ProtectionStore:
+    def __init__(self, directory: str, max_host_patches: int = 1024) -> None:
+        if max_host_patches < 1:
+            raise ValueError("max_host_patches must be >= 1")
+        self.directory = directory
+        self.max_host_patches = max_host_patches
+        os.makedirs(directory, exist_ok=True)
+        self.manifest: Optional[dict] = None
+        #: patch key -> (shard file name, byte offset of its line)
+        self._index: Dict[str, Tuple[str, int]] = {}
+        self._cache: "OrderedDict[str, dict]" = OrderedDict()
+        self.lookups = 0
+        self.cache_hits = 0
+        self.disk_loads = 0
+        self._load_manifest()
+
+    # -- manifest ----------------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST_NAME)
+
+    def _load_manifest(self) -> None:
+        try:
+            with open(self._manifest_path()) as f:
+                self.manifest = json.load(f)
+        except (OSError, ValueError):
+            self.manifest = None
+
+    def _write_manifest(self) -> None:
+        _atomic_write(self._manifest_path(), canonical_json(self.manifest))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def begin(self, generation: dict, set_hash: str) -> None:
+        """Fresh mint: wipe whatever was here and pin the identity."""
+        self.wipe()
+        self.manifest = {
+            "generation": generation,
+            "set_hash": set_hash,
+            "state": "minting",
+            "table_hash": "",
+            "shards": {},
+        }
+        self._write_manifest()
+
+    def resume(self, generation: dict, set_hash: str, shard_ids) -> bool:
+        """True iff the on-disk store matches (generation, set_hash) and
+        holds every shard in ``shard_ids`` (the executor checkpoint's
+        committed set) — in which case the key index is rebuilt from
+        those shard files and minting continues where it stopped.  Any
+        mismatch means a fresh mint."""
+        self._load_manifest()
+        m = self.manifest
+        if (
+            m is None
+            or m.get("generation") != generation
+            or m.get("set_hash") != set_hash
+            or m.get("state") not in ("minting", "ready")
+        ):
+            return False
+        have = set(m.get("shards", {}))
+        need = {str(s) for s in shard_ids}
+        if not need <= have:
+            return False
+        self._index.clear()
+        self._cache.clear()
+        for sid in sorted(have, key=int):
+            if not self._index_shard_file(SHARD_FMT.format(int(sid))):
+                return False
+        m["state"] = "minting"
+        self._write_manifest()
+        return True
+
+    def put_shard(self, shard_id: int, docs: List[dict]) -> None:
+        """Durably record one shard's patch documents (tmp + fsync +
+        rename: idempotent under crash re-runs of the same shard), then
+        record it in the manifest with its content hash."""
+        if self.manifest is None:
+            raise RuntimeError("put_shard before begin()")
+        name = SHARD_FMT.format(shard_id)
+        path = os.path.join(self.directory, name)
+        tmp = path + ".tmp"
+        offsets: List[Tuple[str, int]] = []
+        with open(tmp, "w") as f:
+            pos = 0
+            for doc in docs:
+                line = canonical_json(doc) + "\n"
+                offsets.append((doc["key"], pos))
+                f.write(line)
+                pos += len(line.encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        eligible = sum(1 for d in docs if d.get("eligible"))
+        self.manifest["shards"][str(shard_id)] = {
+            "rows": len(docs),
+            "eligible": eligible,
+            "sha256": content_hash([d for d in docs]),
+        }
+        self._write_manifest()
+        for key, off in offsets:
+            self._index[key] = (name, off)
+        for doc in docs:
+            self._cache_put(doc["key"], doc)
+
+    def commit_ready(self) -> str:
+        """Seal the mint: compute and pin the table hash (a pure
+        function of the per-shard content hashes + set hash, so clean
+        and kill-resumed mints of the same generation agree byte for
+        byte)."""
+        if self.manifest is None:
+            raise RuntimeError("commit_ready before begin()")
+        table_hash = content_hash(
+            {
+                "set_hash": self.manifest["set_hash"],
+                "shards": {
+                    sid: meta["sha256"]
+                    for sid, meta in self.manifest["shards"].items()
+                },
+            }
+        )
+        self.manifest["state"] = "ready"
+        self.manifest["table_hash"] = table_hash
+        self._write_manifest()
+        return table_hash
+
+    def wipe(self) -> None:
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            names = []
+        for n in names:
+            if n == MANIFEST_NAME or (
+                n.startswith("patches-")
+                and (n.endswith(".jsonl") or n.endswith(".jsonl.tmp"))
+            ):
+                try:
+                    os.remove(os.path.join(self.directory, n))
+                except OSError:
+                    pass
+        self.manifest = None
+        self._index.clear()
+        self._cache.clear()
+
+    # -- read surface ------------------------------------------------------
+
+    def lookup(self, key: str) -> Optional[dict]:
+        """The decoded patch document for ``key``, or None.  Cache hit
+        is O(1); miss seeks the shard file at the indexed offset — one
+        line read, never a bulk load."""
+        self.lookups += 1
+        doc = self._cache.get(key)
+        if doc is not None:
+            self._cache.move_to_end(key)
+            self.cache_hits += 1
+            return doc
+        loc = self._index.get(key)
+        if loc is None:
+            return None
+        name, off = loc
+        try:
+            with open(os.path.join(self.directory, name)) as f:
+                f.seek(off)
+                line = f.readline()
+        except OSError:
+            return None
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            return None
+        self.disk_loads += 1
+        self._cache_put(key, doc)
+        return doc
+
+    def keys(self) -> List[str]:
+        return sorted(self._index)
+
+    def counts(self) -> Tuple[int, int]:
+        """(total patches, eligible patches) from the manifest ledger."""
+        if self.manifest is None:
+            return 0, 0
+        total = sum(m["rows"] for m in self.manifest["shards"].values())
+        eligible = sum(
+            m["eligible"] for m in self.manifest["shards"].values()
+        )
+        return total, eligible
+
+    def stats(self) -> dict:
+        return {
+            "patches_indexed": len(self._index),
+            "cached": len(self._cache),
+            "max_host_patches": self.max_host_patches,
+            "lookups": self.lookups,
+            "cache_hits": self.cache_hits,
+            "disk_loads": self.disk_loads,
+        }
+
+    # -- cache -------------------------------------------------------------
+
+    def _cache_put(self, key: str, doc: dict) -> None:
+        self._cache[key] = doc
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.max_host_patches:
+            self._cache.popitem(last=False)
+
+    def _index_shard_file(self, name: str) -> bool:
+        path = os.path.join(self.directory, name)
+        try:
+            with open(path) as f:
+                pos = 0
+                for line in f:
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        return False
+                    self._index[doc["key"]] = (name, pos)
+                    pos += len(line.encode())
+        except OSError:
+            return False
+        return True

@@ -138,6 +138,14 @@ class SweepExecutor:
         self.num_device_solves = 0
         self.num_repacked_shards = 0
         self.generations_observed: set = set()
+        #: optional rider on the drained single-area deltas
+        #: (ctx, shard_id, group, deltas) — the protection tier's patch
+        #: compaction consumes the SAME drained pass the reducer's row
+        #: extraction reads (reduce.world_deltas), never a second sweep
+        self.delta_consumer = None
+        #: optional per-shard durability rider, called between the spill
+        #: append and the checkpoint commit (same crash discipline)
+        self.commit_hook = None
 
     # -- preparation / resume ----------------------------------------------
 
@@ -542,6 +550,10 @@ class SweepExecutor:
                     pipeline.STREAM_DRAIN, device=handle.device_index
                 ):
                     deltas = g["pending"].finish()
+                if self.delta_consumer is not None:
+                    self.delta_consumer(
+                        self._ctx, handle.shard_id, g, deltas
+                    )
                 with self._probe.phase(pipeline.DECODE):
                     rows.extend(
                         self._rows_single(handle.shard_id, g, deltas)
@@ -561,42 +573,34 @@ class SweepExecutor:
     # -- row extraction -----------------------------------------------------
 
     def _rows_single(self, shard_id, group, deltas) -> List[dict]:
+        from openr_tpu.sweep.reduce import world_deltas
+
         stats_of_row: Dict[int, tuple] = {}
-
-        def row_stats(r: int) -> tuple:
-            hit = stats_of_row.get(r)
-            if hit is not None:
-                return hit
-            p_idx, valid, metric, _lanes = deltas.deltas_of_row(r)
-            was = deltas.base_valid[p_idx]
-            withdrawn = int((~valid & was).sum())
-            added = int((valid & ~was).sum())
-            both = valid & was
-            inc = 0.0
-            if both.any():
-                diffs = metric[both] - deltas.base_metric[p_idx[both]]
-                if len(diffs):
-                    inc = float(max(float(diffs.max()), 0.0))
-            stats = (len(p_idx), withdrawn, added, round(inc, 3))
-            stats_of_row[r] = stats
-            return stats
-
         rows = []
-        for k, (scen, is_err) in enumerate(
-            zip(group["items"], group["errors"])
-        ):
-            if is_err:
+        for scen, solve, r, delta in world_deltas(group, deltas):
+            if solve == "error":
                 rows.append(self._row(shard_id, scen, None, "error"))
                 continue
-            r = int(deltas.snap_row[k])
-            rows.append(
-                self._row(
-                    shard_id,
-                    scen,
-                    (0, 0, 0, 0.0) if r == 0 else row_stats(r),
-                    "alias" if r == 0 else "device",
+            if solve == "alias":
+                rows.append(
+                    self._row(shard_id, scen, (0, 0, 0, 0.0), "alias")
                 )
-            )
+                continue
+            stats = stats_of_row.get(r)
+            if stats is None:
+                p_idx, valid, metric, _lanes = delta
+                was = deltas.base_valid[p_idx]
+                withdrawn = int((~valid & was).sum())
+                added = int((valid & ~was).sum())
+                both = valid & was
+                inc = 0.0
+                if both.any():
+                    diffs = metric[both] - deltas.base_metric[p_idx[both]]
+                    if len(diffs):
+                        inc = float(max(float(diffs.max()), 0.0))
+                stats = (len(p_idx), withdrawn, added, round(inc, 3))
+                stats_of_row[r] = stats
+            rows.append(self._row(shard_id, scen, stats, "device"))
         return rows
 
     def _rows_multi(self, shard_id, group) -> List[dict]:
@@ -762,6 +766,12 @@ class SweepExecutor:
             # ordering invariant: rows durable in the spill BEFORE the
             # checkpoint records the shard (docs/Developer_Guide.md)
             self.spill.spill_rows(rows)
+            if self.commit_hook is not None:
+                # riders (the protection store) persist their per-shard
+                # artifacts under the same order: durable before the
+                # checkpoint records the shard, so a crash between the
+                # two re-runs the shard and overwrites idempotently
+                self.commit_hook(handle.shard_id)
             self.checkpoint.commit_shard(
                 handle.shard_id,
                 {

@@ -28,6 +28,37 @@ def _link_key(pair) -> str:
     return "|".join(sorted(map(str, pair)))
 
 
+def world_deltas(group, deltas):
+    """Per-scenario route-delta rows of ONE drained single-area world
+    group, as a reusable iterator — the single pass both the reducer's
+    row extraction and the protection tier's patch compaction consume,
+    so riding consumers never force a second device sweep.
+
+    ``group`` is an executor world group (``items`` + parallel
+    ``errors``); ``deltas`` is its drained
+    :class:`openr_tpu.ops.sweep_select.SweepRouteDeltas`.  Yields
+    ``(scenario, solve, row, delta)`` tuples in scenario order:
+
+    * ``solve == "error"``: the scenario's failed links weren't
+      resolvable against this context (topology drifted) — ``row`` is 0
+      and ``delta`` is None;
+    * ``solve == "alias"``: the failure aliased to the base world
+      (zero route delta) — ``row`` is 0 and ``delta`` is None;
+    * ``solve == "device"``: ``row`` is the scenario's unique snapshot
+      row (> 0; scenarios may share one) and ``delta`` is its
+      ``deltas_of_row`` slice ``(p_idx, valid, metric, lanes)``.
+    """
+    for k, (scen, is_err) in enumerate(zip(group["items"], group["errors"])):
+        if is_err:
+            yield scen, "error", 0, None
+            continue
+        r = int(deltas.snap_row[k])
+        if r == 0:
+            yield scen, "alias", 0, None
+        else:
+            yield scen, "device", r, deltas.deltas_of_row(r)
+
+
 class SweepReducer:
     def __init__(self, top_k: int = 64) -> None:
         self.top_k = top_k

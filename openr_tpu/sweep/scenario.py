@@ -114,6 +114,11 @@ class ScenarioSpec:
 
     #: enumerate every single-link failure per world
     single_link_failures: bool = True
+    #: bound on enumerated single-link failures per world: the first N
+    #: pairs in canonical (sorted) order; 0 = no bound.  The protection
+    #: tier's ``max_links`` maps here — links past the bound simply get
+    #: no patch (counted as ``protection.fallback.miss`` at apply time)
+    max_single_link_scenarios: int = 0
     #: failure-domain combination order (nodes as domains); < 2 = off
     combo_k: int = 0
     #: explicit bound on enumerated k-combinations per world (0 = none
@@ -127,9 +132,16 @@ class ScenarioSpec:
     #: metric perturbation variants as (pattern, factor); the identity
     #: variant is always included
     metric_perturbations: Tuple[Tuple[str, float], ...] = ()
+    #: shared-risk link groups as failure domains: ``(name, ((a, b),
+    #: ...))`` entries whose member links fail TOGETHER — one scenario
+    #: per group per world, intersected with the live link pairs at
+    #: enumeration time (a group none of whose links exist is skipped).
+    #: Configured via ``sweep_config.srlg_groups``; the protection tier
+    #: mints per-SRLG patches from exactly these scenarios.
+    srlg_groups: Tuple[Tuple[str, Tuple[Tuple[str, str], ...]], ...] = ()
 
     def content(self) -> dict:
-        return {
+        doc = {
             "single_link_failures": self.single_link_failures,
             "combo_k": self.combo_k,
             "max_combo_scenarios": self.max_combo_scenarios,
@@ -140,6 +152,17 @@ class ScenarioSpec:
                 for p, f in self.metric_perturbations
             ],
         }
+        if self.max_single_link_scenarios:
+            doc["max_single_link_scenarios"] = self.max_single_link_scenarios
+        if self.srlg_groups:
+            # only present when configured, so every pre-SRLG grammar's
+            # content hash (and thus its resumable checkpoints) is
+            # preserved verbatim — regression-tested
+            doc["srlg_groups"] = [
+                {"name": name, "links": [list(p) for p in pairs]}
+                for name, pairs in self.srlg_groups
+            ]
+        return doc
 
     @classmethod
     def from_params(cls, config, params: Optional[dict]) -> "ScenarioSpec":
@@ -155,6 +178,12 @@ class ScenarioSpec:
             metric = [
                 {"pattern": m.pattern, "factor": m.factor}
                 for m in getattr(config, "metric_perturbations", [])
+            ]
+        srlg = params.get("srlg_groups")
+        if srlg is None:
+            srlg = [
+                {"name": g.name, "links": [list(p) for p in g.links]}
+                for g in getattr(config, "srlg_groups", [])
             ]
         return cls(
             single_link_failures=bool(
@@ -174,7 +203,36 @@ class ScenarioSpec:
             metric_perturbations=tuple(
                 (str(m["pattern"]), float(m["factor"])) for m in metric
             ),
+            srlg_groups=normalize_srlg_groups(srlg),
         )
+
+
+def normalize_srlg_groups(groups) -> Tuple:
+    """Canonical SRLG tuple form from config objects, params dicts or
+    already-normalized ``(name, pairs)`` tuples (idempotent): per group
+    the member pairs are endpoint-sorted, deduplicated and sorted;
+    groups sort by name — so one risk-group definition has exactly one
+    content identity however it was spelled."""
+    out = []
+    for g in groups or ():
+        if isinstance(g, dict):
+            name, links = str(g["name"]), g["links"]
+        elif isinstance(g, (tuple, list)):
+            name, links = str(g[0]), g[1]
+        else:
+            name, links = str(g.name), g.links
+        pairs = tuple(
+            sorted(set(tuple(sorted(map(str, p))) for p in links))
+        )
+        out.append((name, pairs))
+    out.sort()
+    return tuple(out)
+
+
+def srlg_domain(name: str) -> str:
+    """The failure-domain label an SRLG scenario carries — also the
+    protection table's patch key for a per-SRLG patch."""
+    return f"srlg:{name}"
 
 
 def worlds_of(spec: ScenarioSpec) -> List[World]:
@@ -213,8 +271,20 @@ def enumerate_scenarios(
     out: List[Scenario] = []
     for world in worlds_of(spec):
         if spec.single_link_failures:
-            for p in pairs:
+            bound = spec.max_single_link_scenarios
+            for p in (pairs[:bound] if bound else pairs):
                 out.append(Scenario(world, (p,)))
+        if spec.srlg_groups:
+            live = set(pairs)
+            for name, group_pairs in spec.srlg_groups:
+                failed = tuple(
+                    sorted(p for p in group_pairs if p in live)
+                )
+                if not failed:
+                    continue
+                out.append(
+                    Scenario(world, failed, domains=(srlg_domain(name),))
+                )
         if spec.combo_k >= 2 and spec.max_combo_scenarios > 0:
             domains = sorted(node_links)
             combos = _draw_combos(

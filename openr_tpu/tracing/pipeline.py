@@ -44,6 +44,15 @@ phase              meaning
 ``sweep_reduce``   the sweep's host tail per committed shard: spill
                    append + checkpoint commit + the online ranked
                    reducer
+``protection_mint``  compacting one committed protection shard's
+                   per-world route deltas into per-link FibPatches and
+                   persisting them to the protection store
+                   (openr_tpu.protection.builder); host tail riding the
+                   sweep executor's drained deltas
+``protection_apply``  the fast-reroute hot path: generation-exact
+                   patch lookup + RibUnicastEntry materialization +
+                   RIB splice + publish on a protected link-down event
+                   (decision/decision.py)
 =================  ========================================================
 
 Surfaces: every phase sample lands in a ``pipeline.{phase}.ms``
@@ -79,6 +88,8 @@ STREAM_DRAIN = "stream_drain"
 DEVICE_SELECT = "device_select"
 SWEEP_SHARD_SOLVE = "sweep_shard_solve"
 SWEEP_REDUCE = "sweep_reduce"
+PROTECTION_MINT = "protection_mint"
+PROTECTION_APPLY = "protection_apply"
 
 PHASES = (
     HOST_FETCH,
@@ -95,6 +106,8 @@ PHASES = (
     DEVICE_SELECT,
     SWEEP_SHARD_SOLVE,
     SWEEP_REDUCE,
+    PROTECTION_MINT,
+    PROTECTION_APPLY,
 )
 
 #: phases only the warm-start generation-delta rebuild exercises — a
@@ -112,6 +125,12 @@ DELTA_PHASES = (DEVICE_SELECT,)
 #: bench attribution gates treat them as optional coverage too
 SWEEP_PHASES = (SWEEP_SHARD_SOLVE, SWEEP_REDUCE)
 
+#: phases only the fast-reroute protection tier exercises
+#: (openr_tpu.protection): nodes with the tier disabled — and every
+#: rebuild that isn't a protected link-down event — legitimately record
+#: nothing here, so attribution gates treat them as optional coverage
+PROTECTION_PHASES = (PROTECTION_MINT, PROTECTION_APPLY)
+
 #: phases whose time is host-side work (the pipelining refactor's
 #: overlap candidates) vs the device round trip — the host/device split
 #: BENCH_PIPELINE reports.  ``stream_drain`` counts as device time: it
@@ -125,6 +144,8 @@ HOST_PHASES = (
     DELTA_EXTRACT,
     WARM_PLAN,
     SWEEP_REDUCE,
+    PROTECTION_MINT,
+    PROTECTION_APPLY,
 )
 DEVICE_PHASES = (
     TRANSFER,

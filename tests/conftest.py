@@ -52,6 +52,11 @@ def pytest_configure(config):
         "markers",
         "sweep: capacity-planning sweep test (openr_tpu.sweep)",
     )
+    config.addinivalue_line(
+        "markers",
+        "protection: fast-reroute protection-tier test "
+        "(openr_tpu.protection)",
+    )
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

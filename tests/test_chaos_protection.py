@@ -1,0 +1,281 @@
+"""Chaos × fast-reroute protection tier (ISSUE 16).
+
+Acceptance, on a seeded 9-node grid with a TPU-backed vantage whose
+protection tier is live:
+
+* a protected single-link flap converges from the minted table —
+  ``decision.frr_applied`` fires, the confirming warm solve agrees
+  (zero mismatches), and the vantage's RIB has scalar-oracle parity;
+* a flap landing on a STALE table (the LSDB moved, no re-mint yet)
+  falls back warm — counted, never applied — and the RIB still has
+  parity;
+* a seeded ``tpu_corrupt(device_index=3)`` landing MID-MINT purges the
+  table (purge-on-suspicion via the governor's quarantine listener),
+  quarantines exactly chip 3, and the next mint completes on the 7
+  survivors with a READY table;
+* every scenario's end state is byte-identical across two replays of
+  the same virtual-time schedule (route summary + table hash +
+  protection counters), because patch identity is content-addressed
+  and minting follows the sweep's deterministic shard order.
+
+The 64-node grid8 variant of the protected flap runs the same
+assertions at fabric scale (slow tier).
+"""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from openr_tpu.chaos import ChaosController, FaultPlan, InvariantChecker
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import ParallelConfig, ProtectionConfig, ResilienceConfig
+from openr_tpu.decision.backend import ScalarBackend
+from openr_tpu.decision.rib import route_db_summary
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import grid_edges
+from openr_tpu.sweep.scenario import canonical_json
+
+pytestmark = [pytest.mark.chaos, pytest.mark.protection, pytest.mark.multichip]
+
+SEED = 7
+CONVERGE_S = 18.0
+VANTAGE = "node4"
+BAD_CHIP = 3
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        pending = asyncio.all_tasks(loop)
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        loop.close()
+
+
+def overrides(tmp_path, vantage=VANTAGE, slow_mint=False):
+    def apply(cfg):
+        cfg.tpu_compute_config.min_device_prefixes = 0  # always device
+        cfg.parallel_config = ParallelConfig(min_shard_rows=0)
+        cfg.resilience_config = ResilienceConfig(
+            shadow_sample_every=2,
+            failure_threshold=2,
+            probe_backoff_initial_s=0.5,
+            probe_backoff_max_s=4.0,
+            jitter_pct=0.1,
+            seed=SEED,
+        )
+        if cfg.node_name == vantage:
+            cfg.protection_config = ProtectionConfig(
+                enabled=True,
+                store_dir=str(tmp_path / f"prot.{cfg.node_name}"),
+                mint_debounce_s=0.2,
+                # slow_mint stretches a 12-link mint over ~10 virtual
+                # seconds so the chaos corruption + quarantine land
+                # MID-mint
+                shard_scenarios=1 if slow_mint else 4,
+                inter_shard_pause_s=0.8 if slow_mint else 0.01,
+            )
+
+    return apply
+
+
+async def booted_grid(tmp_path, n=3, slow_mint=False):
+    clock = SimClock()
+    net = EmulatedNetwork(
+        clock,
+        use_tpu_backend=True,
+        config_overrides=overrides(tmp_path, slow_mint=slow_mint),
+    )
+    net.build(grid_edges(n))
+    net.start()
+    await clock.run_for(CONVERGE_S)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    return clock, net
+
+
+async def wait_table_ready(clock, svc, budget_s=60.0):
+    for _ in range(int(budget_s / 0.5)):
+        if svc.table.state == "ready":
+            return
+        await clock.run_for(0.5)
+    raise AssertionError(
+        f"table never went ready: {svc.table.state} {svc.error!r}"
+    )
+
+
+def vantage_parity(net):
+    d = net.nodes[VANTAGE].decision
+    oracle = ScalarBackend(SpfSolver(VANTAGE)).build_route_db(
+        d.area_link_states, d.prefix_state
+    )
+    assert route_db_summary(d.route_db) == route_db_summary(oracle)
+
+
+def end_state_digest(net) -> str:
+    """Everything the scenario is allowed to vary: the vantage RIB, the
+    minted table identity and the protection counter ledger."""
+    d = net.nodes[VANTAGE].decision
+    svc = net.nodes[VANTAGE].protection
+    doc = {
+        "routes": route_db_summary(d.route_db),
+        "table": svc.table.status(),
+        "counters": {
+            k: v
+            for k, v in sorted(d.counters.dump().items())
+            if k.startswith(("protection.", "decision.frr"))
+        },
+    }
+    return hashlib.sha256(canonical_json(doc).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# scenario (a): protected flap converges from the table, with parity
+# ---------------------------------------------------------------------------
+
+
+async def _protected_flap(tmp_path, n=3) -> str:
+    clock, net = await booted_grid(tmp_path, n=n)
+    svc = net.nodes[VANTAGE].protection
+    assert svc is not None, "vantage must boot the protection tier"
+    checker = InvariantChecker(net)
+    await wait_table_ready(clock, svc)
+    assert svc.table.eligible > 0
+
+    d = net.nodes[VANTAGE].decision
+    # a REMOTE link (the vantage keeps its own lanes): protected flap
+    net.fail_link("node0", "node1")
+    await clock.run_for(4.0)
+    assert d.counters.get("decision.frr_applied") == 1
+    assert d.counters.get("decision.frr_mismatches") == 0
+    assert d.counters.get("protection.confirms") >= 1
+    assert net.nodes[VANTAGE].fib.counters.get("fib.frr_patches_applied") == 1
+    vantage_parity(net)
+
+    # the tier re-mints for the new topology and keeps protecting
+    await wait_table_ready(clock, svc)
+    checker.check_change_seq_monotonic()
+    checker.check_no_blackholes()
+    digest = end_state_digest(net)
+    await net.stop()
+    return digest
+
+
+def test_protected_flap_converges_from_table_with_parity(tmp_path):
+    a = run(_protected_flap(tmp_path / "a"))
+    b = run(_protected_flap(tmp_path / "b"))
+    assert a == b, "seeded replays must be byte-identical"
+
+
+@pytest.mark.slow
+def test_protected_flap_at_grid8_scale(tmp_path):
+    a = run(_protected_flap(tmp_path / "a", n=8))
+    b = run(_protected_flap(tmp_path / "b", n=8))
+    assert a == b, "seeded replays must be byte-identical"
+
+
+# ---------------------------------------------------------------------------
+# scenario (b): stale table falls back warm
+# ---------------------------------------------------------------------------
+
+
+async def _stale_fallback(tmp_path) -> str:
+    clock, net = await booted_grid(tmp_path)
+    svc = net.nodes[VANTAGE].protection
+    await wait_table_ready(clock, svc)
+    d = net.nodes[VANTAGE].decision
+
+    # two failures inside ONE debounce/mint window: the first applies
+    # from the table; the second arrives while the table is stale for
+    # its (new) previous generation and must fall back warm.  The
+    # window is long in virtual time (mint wall >> flap spacing), so
+    # the race is deterministic.
+    net.fail_link("node0", "node1")
+    await clock.run_for(0.05)
+    net.fail_link("node2", "node5")
+    await clock.run_for(6.0)
+    assert d.counters.get("protection.fallbacks") >= 1, (
+        "the second flap must refuse the stale table"
+    )
+    assert (
+        d.counters.get("protection.fallback.stale")
+        + d.counters.get("protection.fallback.minting")
+        + d.counters.get("protection.fallback.miss")
+        >= 1
+    )
+    assert d.counters.get("decision.frr_mismatches") == 0
+    vantage_parity(net)
+    await wait_table_ready(clock, svc)
+    digest = end_state_digest(net)
+    await net.stop()
+    return digest
+
+
+def test_stale_table_falls_back_warm(tmp_path):
+    a = run(_stale_fallback(tmp_path / "a"))
+    b = run(_stale_fallback(tmp_path / "b"))
+    assert a == b, "seeded replays must be byte-identical"
+
+
+# ---------------------------------------------------------------------------
+# scenario (c): tpu_corrupt mid-mint — purge, quarantine chip 3, re-mint
+# ---------------------------------------------------------------------------
+
+
+async def _corrupt_mid_mint(tmp_path) -> str:
+    clock, net = await booted_grid(tmp_path, slow_mint=True)
+    svc = net.nodes[VANTAGE].protection
+    d = net.nodes[VANTAGE].decision
+    await wait_table_ready(clock, svc)
+
+    # arm the chaos: chip 3 starts lying 1s from now, for long enough
+    # to span the whole scenario
+    plan = FaultPlan().tpu_corrupt(
+        VANTAGE, at=1.0, duration=200.0, device_index=BAD_CHIP
+    )
+    controller = ChaosController(net, plan, seed=SEED)
+    controller.start()
+
+    # dirty the table: the re-mint (1 scenario/shard, 0.8s pauses)
+    # stretches over ~10 virtual seconds
+    net.fail_link("node0", "node1")
+    await clock.run_for(2.0)
+    # shadow-checked full rebuilds catch the lying chip while the mint
+    # is still walking its shards
+    net.fail_link("node1", "node2")
+    await clock.run_for(2.0)
+    net.restore_link("node1", "node2")
+    await clock.run_for(6.0)
+
+    gov = net.nodes[VANTAGE].decision.backend.governor
+    assert gov.num_chip_quarantines >= 1, "chip 3 must quarantine"
+    pool = net.nodes[VANTAGE].decision.backend.dispatch_pool()
+    assert pool.quarantined_indices() == [BAD_CHIP], (
+        "exactly the corrupted chip quarantines"
+    )
+    assert d.counters.get("protection.purge.quarantine") >= 1, (
+        "quarantine must purge the table (purge-on-suspicion)"
+    )
+
+    # the next mint completes on the 7 survivors
+    await wait_table_ready(clock, svc)
+    assert svc.table.eligible > 0
+    vantage_parity(net)
+    await controller.stop()
+    digest = end_state_digest(net)
+    await net.stop()
+    return digest
+
+
+def test_tpu_corrupt_mid_mint_purges_quarantines_and_reminnts(tmp_path):
+    a = run(_corrupt_mid_mint(tmp_path / "a"))
+    b = run(_corrupt_mid_mint(tmp_path / "b"))
+    assert a == b, "seeded replays must be byte-identical"

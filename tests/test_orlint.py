@@ -156,6 +156,12 @@ FIXTURES = {
         (),
         2,
     ),
+    "protection-table": (
+        "def shortcut(table, doc, prefix_state):\n"
+        "    table.apply_patch(doc, prefix_state)\n",
+        (),
+        2,
+    ),
     # -- replay-determinism family (ISSUE 15) ------------------------------
     "unordered-emission": (
         "from openr_tpu.sweep.scenario import canonical_json\n"
@@ -491,6 +497,44 @@ def test_sweep_ownership_owners_are_exempt():
     assert [f.rule for f in analyze_source(src)] == [
         "sweep-spill-ownership"
     ] * 3
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        "openr_tpu/protection/service.py",
+        "openr_tpu/decision/decision.py",
+    ],
+)
+def test_protection_table_owners_are_exempt(rel):
+    """The protection package and Decision's apply path mutate the
+    table freely — the rule polices everyone else (ISSUE 16)."""
+    src = (
+        "def lifecycle(table, doc, prefix_state):\n"
+        "    table.begin_mint({'seq': 1}, 'hash')\n"
+        "    table.mark_ready('hash', 4, 4)\n"
+        "    table.mark_stale()\n"
+        "    table.abort_mint()\n"
+        "    table.purge_table('mismatch')\n"
+        "    table.apply_patch(doc, prefix_state)\n"
+    )
+    mods = [ParsedModule.parse(rel, src)]
+    assert analyze_modules(mods).findings == []
+    assert [f.rule for f in analyze_source(src)] == [
+        "protection-table"
+    ] * 6
+
+
+def test_protection_table_reads_are_clean():
+    """Lookups, status and classification are read-only everywhere —
+    only mutation is gated."""
+    src = (
+        "def watch(svc, prev_key):\n"
+        "    status, doc = svc.lookup(prev_key, 'a|b')\n"
+        "    svc.classify_pairs({('a', 'b')})\n"
+        "    return svc.get_protection_status()\n"
+    )
+    assert analyze_source(src) == []
 
 
 def test_sweep_ownership_reset_needs_checkpoint_receiver():

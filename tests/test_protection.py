@@ -1,0 +1,786 @@
+"""Fast-reroute protection tier (ISSUE 16).
+
+Covers:
+
+* the patch table lifecycle + staleness matrix: generation-exact
+  lookups only; MINTING/EMPTY/mismatched-generation lookups refuse with
+  the right fallback reason; purge wipes the store;
+* the spill-backed store: per-shard durability, the host-memory LRU
+  bound (decoded patches beyond ``max_host_patches`` load from disk),
+  resume against a matching manifest, the table-hash identity;
+* the scenario-grammar satellites: SRLG groups fold into enumeration
+  with deterministic content identity, the single-link bound, and the
+  regression that pre-existing specs hash EXACTLY as before the new
+  fields (content() only grows keys when they're set);
+* ``world_deltas`` as the shared one-pass iterator: the builder's
+  delta consumer sees the same scenario stream the reducer's spill rows
+  record;
+* LinkStateChange failure classification: clean up→down flips land in
+  ``down_links``; adds/metric/overload/node-leave set
+  ``other_topology_change`` (never patch-served);
+* the Decision apply path end-to-end on a real mint: protected flap →
+  patch published at detection (``decision.frr_applied``, INCREMENTAL
+  + frr-stamped) with scalar-oracle RIB parity after the confirming
+  warm solve; stale table falls back warm; multi-failure falls back;
+  a corrupted patch trips the confirm → FULL_SYNC + mismatch counter +
+  table purge; SRLG flap (both members in one publication) applies the
+  per-SRLG patch;
+* builder discipline: generation move mid-mint refuses to touch the
+  device; kill-after-shard-K resume reproduces the clean mint's
+  table hash byte-for-byte; global ineligibility (rib policy / node
+  segment labels) mints tombstones that fall back at apply.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.config import DecisionConfig, ProtectionConfig
+from openr_tpu.decision.backend import ScalarBackend, TpuBackend
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.rib import route_db_summary
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.protection import (
+    ProtectionBuildError,
+    ProtectionBuilder,
+    ProtectionService,
+    ProtectionStore,
+    ProtectionTable,
+    link_patch_key,
+    make_ineligible_patch,
+    make_patch,
+)
+from openr_tpu.sweep import SweepInputs
+from openr_tpu.sweep.scenario import (
+    ScenarioSpec,
+    enumerate_scenarios,
+    normalize_srlg_groups,
+    scenario_set_hash,
+    srlg_domain,
+)
+from openr_tpu.types import (
+    InitializationEvent,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixMetrics,
+    Publication,
+    Value,
+    prefix_key,
+)
+
+pytestmark = [pytest.mark.protection]
+
+N = 3
+EDGES = grid_edges(N)
+PAIRS = [
+    ("node0", "node1"),
+    ("node1", "node2"),
+    ("node2", "node3"),
+    ("node0", "node3"),
+]
+
+GEN = {"change_seq": 5, "areas": [["0", 7]]}
+GEN_KEY = (5, (("0", 7),))
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        pending = asyncio.all_tasks(loop)
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# table lifecycle + staleness matrix
+# ---------------------------------------------------------------------------
+
+
+def make_table(tmp_path, **kw):
+    return ProtectionTable(
+        ProtectionStore(str(tmp_path / "store"), **kw)
+    )
+
+
+def seed_ready(table, key="a|b"):
+    # the table state machine and the store lifecycle are driven
+    # side by side, the way the builder drives them
+    table.begin_mint(GEN_KEY, "sh")
+    table.store.begin(GEN, "sh")
+    table.store.put_shard(0, [make_patch(key, [], [])])
+    th = table.store.commit_ready()
+    table.mark_ready(th, 1, 1)
+    return th
+
+
+def test_table_lookup_is_generation_exact(tmp_path):
+    t = make_table(tmp_path)
+    # EMPTY refuses as miss
+    assert t.lookup(GEN_KEY, "a|b")[0] == "miss"
+    t.begin_mint(GEN_KEY, "sh")
+    t.store.begin(GEN, "sh")
+    assert t.state == "minting"
+    assert t.lookup(GEN_KEY, "a|b")[0] == "minting"
+    t.store.put_shard(0, [make_patch("a|b", [], [])])
+    th = t.store.commit_ready()
+    t.mark_ready(th, 1, 1)
+    # generation-exact hit — even after the table is marked STALE,
+    # because the generation listeners fire BEFORE the apply path runs
+    # for the very event the table protects
+    t.mark_stale()
+    assert t.state == "stale"
+    status, doc = t.lookup(GEN_KEY, "a|b")
+    assert status == "hit" and doc["eligible"]
+    # any other previous-generation key refuses as stale
+    assert t.lookup((6, (("0", 8),)), "a|b")[0] == "stale"
+    # unknown link refuses as miss
+    assert t.lookup(GEN_KEY, "x|y")[0] == "miss"
+    # ineligible doc refuses as miss (reason preserved for operators)
+    t.begin_mint(GEN_KEY, "sh")
+    t.store.begin(GEN, "sh")
+    t.store.put_shard(0, [make_ineligible_patch("a|b", "ksp2")])
+    t.mark_ready(t.store.commit_ready(), 1, 0)
+    assert t.lookup(GEN_KEY, "a|b")[0] == "miss"
+
+
+def test_purge_and_abort_reset_table_and_store(tmp_path):
+    t = make_table(tmp_path)
+    seed_ready(t)
+    t.purge_table("mismatch")
+    assert t.state == "empty" and t.patches == 0
+    assert t.store.lookup("a|b") is None, "purge wipes the disk store"
+    # abort mid-mint: MINTING -> EMPTY, partial shards stay on disk
+    # for a later resume
+    t.begin_mint(GEN_KEY, "sh")
+    t.store.begin(GEN, "sh")
+    t.store.put_shard(0, [make_patch("a|b", [], [])])
+    t.abort_mint()
+    assert t.state == "empty"
+    assert t.store.lookup("a|b") is not None
+
+
+# ---------------------------------------------------------------------------
+# store: durability, LRU bound, resume, identity
+# ---------------------------------------------------------------------------
+
+
+def test_store_lru_bound_and_disk_loads(tmp_path):
+    s = ProtectionStore(str(tmp_path), max_host_patches=4)
+    s.begin(GEN, "sh")
+    docs = [make_patch(f"k{i:02d}|x", [], []) for i in range(16)]
+    s.put_shard(0, docs[:8])
+    s.put_shard(1, docs[8:])
+    assert s.stats()["cached"] == 4, "decoded cache bounded"
+    assert len(s.keys()) == 16, "index covers everything on disk"
+    for d in docs:
+        got = s.lookup(d["key"])
+        assert got == d
+    st = s.stats()
+    assert st["disk_loads"] >= 12, "evicted patches reload from disk"
+    assert st["cached"] == 4
+
+
+def test_store_resume_requires_matching_identity(tmp_path):
+    s = ProtectionStore(str(tmp_path))
+    s.begin(GEN, "sh")
+    s.put_shard(0, [make_patch("a|b", [], [])])
+    s2 = ProtectionStore(str(tmp_path))
+    assert s2.resume(GEN, "sh", [0])
+    assert s2.lookup("a|b") is not None, "index rebuilt from shard files"
+    # generation or set-hash drift refuses the resume
+    assert not ProtectionStore(str(tmp_path)).resume(
+        {"change_seq": 6, "areas": [["0", 7]]}, "sh", [0]
+    )
+    assert not ProtectionStore(str(tmp_path)).resume(GEN, "other", [0])
+    # a shard the checkpoint claims but the store lacks refuses
+    assert not ProtectionStore(str(tmp_path)).resume(GEN, "sh", [0, 1])
+
+
+def test_table_hash_is_content_pure(tmp_path):
+    docs = [make_patch("a|b", [], ["10.0.0.0/24"]), make_patch("c|d", [], [])]
+    hashes = []
+    for sub in ("x", "y"):
+        s = ProtectionStore(str(tmp_path / sub))
+        s.begin(GEN, "sh")
+        s.put_shard(0, docs[:1])
+        s.put_shard(1, docs[1:])
+        hashes.append(s.commit_ready())
+    assert hashes[0] == hashes[1]
+    # different content, different identity
+    s = ProtectionStore(str(tmp_path / "z"))
+    s.begin(GEN, "sh")
+    s.put_shard(0, [make_patch("a|b", [], [])])
+    s.put_shard(1, docs[1:])
+    assert s.commit_ready() != hashes[0]
+
+
+# ---------------------------------------------------------------------------
+# scenario grammar satellites
+# ---------------------------------------------------------------------------
+
+
+def test_pre_existing_specs_hash_exactly_as_before():
+    """The new fields only appear in content() when set — every
+    checkpoint/plan hash minted before this PR must still match."""
+    spec = ScenarioSpec(drain_node_sets=((), ("node2",)))
+    doc = spec.content()
+    assert "srlg_groups" not in doc
+    assert "max_single_link_scenarios" not in doc
+    bounded = ScenarioSpec(
+        drain_node_sets=((), ("node2",)), max_single_link_scenarios=2
+    )
+    assert "max_single_link_scenarios" in bounded.content()
+    scens = enumerate_scenarios(spec, PAIRS)
+    assert scenario_set_hash(spec, scens) == scenario_set_hash(
+        ScenarioSpec(drain_node_sets=((), ("node2",))), scens
+    )
+
+
+def test_single_link_bound_truncates_canonically():
+    spec = ScenarioSpec(max_single_link_scenarios=2)
+    scens = enumerate_scenarios(spec, list(reversed(PAIRS)))
+    singles = [s for s in scens if not s.domains]
+    assert len(singles) == 2
+    # the bound applies to the canonically sorted pair order, not the
+    # caller's enumeration order
+    assert {s.failed_links[0] for s in singles} == set(
+        sorted(tuple(sorted(p)) for p in PAIRS)[:2]
+    )
+
+
+def test_srlg_groups_fold_into_grammar_with_stable_identity():
+    groups = normalize_srlg_groups(
+        [
+            {"name": "conduit7", "links": [PAIRS[1], PAIRS[0]]},
+            {"name": "span2", "links": [PAIRS[2]]},
+        ]
+    )
+    spec = ScenarioSpec(srlg_groups=groups)
+    a = enumerate_scenarios(spec, PAIRS)
+    b = enumerate_scenarios(spec, list(reversed(PAIRS)))
+    assert [s.hash for s in a] == [s.hash for s in b]
+    srlg = [s for s in a if s.domains]
+    assert {s.domains[0] for s in srlg} == {
+        "srlg:conduit7",
+        "srlg:span2",
+    }
+    by_dom = {s.domains[0]: s for s in srlg}
+    assert set(by_dom["srlg:conduit7"].failed_links) == {
+        tuple(sorted(PAIRS[0])),
+        tuple(sorted(PAIRS[1])),
+    }
+    # spelling variations normalize to ONE content identity
+    groups2 = normalize_srlg_groups(
+        [
+            {"name": "span2", "links": [tuple(reversed(PAIRS[2]))]},
+            {"name": "conduit7", "links": [PAIRS[0], PAIRS[1], PAIRS[0]]},
+        ]
+    )
+    assert groups2 == groups
+    assert scenario_set_hash(spec, a) == scenario_set_hash(
+        ScenarioSpec(srlg_groups=groups2), b
+    )
+    # a group whose members are all absent from the live topology
+    # enumerates nothing (dead conduit, no scenario)
+    ghost = normalize_srlg_groups(
+        [{"name": "ghost", "links": [("nodeX", "nodeY")]}]
+    )
+    assert not [
+        s
+        for s in enumerate_scenarios(
+            ScenarioSpec(srlg_groups=ghost), PAIRS
+        )
+        if s.domains
+    ]
+
+
+# ---------------------------------------------------------------------------
+# LinkStateChange failure classification
+# ---------------------------------------------------------------------------
+
+
+def make_link_state(n=3):
+    ls = LinkState("0", "node0")
+    for db in build_adj_dbs(grid_edges(n)).values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def fresh_db(node, n=3):
+    # never mutate the object the LSDB holds by reference
+    return build_adj_dbs(grid_edges(n))[node]
+
+
+def test_clean_link_down_lands_in_down_links():
+    ls = make_link_state()
+    db = fresh_db("node1")
+    db.adjacencies = [
+        a for a in db.adjacencies if a.other_node_name != "node2"
+    ]
+    change = ls.update_adjacency_database(db)
+    assert change.topology_changed
+    assert [
+        tuple(sorted((lk.n1, lk.n2))) for lk in change.down_links
+    ] == [("node1", "node2")]
+    assert not change.other_topology_change
+
+
+def test_link_add_metric_and_overload_are_other_changes():
+    ls = make_link_state()
+    # metric change
+    db = fresh_db("node1")
+    db.adjacencies[0].metric += 5
+    change = ls.update_adjacency_database(db)
+    assert change.other_topology_change and not change.down_links
+    # node overload flip (operator drain, never patch-served)
+    db = fresh_db("node1")
+    db.adjacencies[0].metric += 5
+    db.is_overloaded = True
+    change = ls.update_adjacency_database(db)
+    assert change.other_topology_change and not change.down_links
+    # node leaving the LSDB fails ALL its links: outside the envelope
+    change = ls.delete_adjacency_database("node3")
+    assert change.other_topology_change and not change.down_links
+
+
+# ---------------------------------------------------------------------------
+# decision end-to-end harness
+# ---------------------------------------------------------------------------
+
+
+def adj_pub(version=1, drops=()):
+    """drops: (a, b) pairs; node a's DB omits its adjacency to b."""
+    kvs = {}
+    for node, db in build_adj_dbs(EDGES).items():
+        gone = {b for a, b in drops if a == node}
+        if gone:
+            db.adjacencies = [
+                a for a in db.adjacencies if a.other_node_name not in gone
+            ]
+        kvs[f"adj:{node}"] = Value(
+            version=version,
+            originator_id=node,
+            value=json.dumps(db.to_wire()).encode(),
+        )
+    return Publication(key_vals=kvs)
+
+
+def prefix_pub(node, prefix, version=1, pp=1000):
+    pdb = PrefixDatabase(
+        this_node_name=node,
+        prefix_entries=[
+            PrefixEntry(prefix, metrics=PrefixMetrics(path_preference=pp))
+        ],
+    )
+    return Publication(
+        key_vals={
+            prefix_key(node, prefix): Value(
+                version=version,
+                originator_id=node,
+                value=json.dumps(pdb.to_wire()).encode(),
+            )
+        }
+    )
+
+
+async def booted_decision(clock, tmp_path, srlg_groups=(), **pcfg):
+    solver = SpfSolver("node0")
+    backend = TpuBackend(solver)
+    out_q = ReplicateQueue("routes")
+    kv_q = ReplicateQueue("kv")
+    d = Decision(
+        "node0",
+        clock,
+        DecisionConfig(debounce_min_ms=10, debounce_max_ms=250),
+        out_q,
+        kv_store_updates_reader=kv_q.get_reader(),
+        backend=backend,
+        solver=solver,
+    )
+    d.backend.auto_dispatch_rt_ms = 0.0
+    reader = out_q.get_reader()
+    d.start()
+    d.on_initialization_event(InitializationEvent.KVSTORE_SYNCED)
+    kv_q.push(adj_pub())
+    for i in range(1, N * N):
+        kv_q.push(prefix_pub(f"node{i}", f"10.{i}.0.0/24"))
+    await clock.run_for(2.0)
+    assert d._first_build_done
+    svc = ProtectionService(
+        "node0",
+        clock,
+        ProtectionConfig(
+            enabled=True, store_dir=str(tmp_path / "prot"), **pcfg
+        ),
+        d,
+        counters=d.counters,
+        srlg_groups=srlg_groups,
+    )
+    d.protection = svc
+    d.add_generation_listener(svc._on_generation, priority=20)
+    return d, svc, kv_q, reader
+
+
+def drain(reader):
+    out = []
+    while True:
+        u = reader.try_get()
+        if u is None:
+            return out
+        out.append(u)
+
+
+def scalar_oracle(d):
+    return ScalarBackend(SpfSolver("node0")).build_route_db(
+        d.area_link_states, d.prefix_state
+    )
+
+
+def test_protected_flap_applies_patch_with_scalar_parity(tmp_path):
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+        rep = svc.mint_now()
+        assert rep["eligible"] == len(EDGES), "every grid link eligible"
+        drain(reader)
+        kv_q.push(adj_pub(version=2, drops=[("node1", "node2")]))
+        await clock.run_for(2.0)
+        updates = drain(reader)
+        # the patch published FIRST, at detection, incremental + stamped
+        assert updates and updates[0].frr
+        assert updates[0].type.name == "INCREMENTAL"
+        assert not updates[0].empty()
+        assert all(not u.frr for u in updates[1:])
+        assert d.counters.get("decision.frr_applied") == 1
+        assert d.counters.get("decision.frr_mismatches") == 0
+        # the confirming warm solve agreed exactly
+        assert d.counters.get("protection.confirms") == 1
+        assert route_db_summary(d.route_db) == route_db_summary(
+            scalar_oracle(d)
+        )
+        await d.stop()
+
+    run(main())
+
+
+def test_stale_table_falls_back_warm_and_still_converges(tmp_path):
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+        svc.mint_now()
+        kv_q.push(adj_pub(version=2, drops=[("node1", "node2")]))
+        await clock.run_for(2.0)
+        assert d.counters.get("decision.frr_applied") == 1
+        # NO re-mint: the second flap's previous generation no longer
+        # matches the table → refuse stale, converge warm, stay correct
+        kv_q.push(
+            adj_pub(
+                version=3, drops=[("node1", "node2"), ("node3", "node6")]
+            )
+        )
+        await clock.run_for(2.0)
+        assert d.counters.get("decision.frr_applied") == 1
+        assert d.counters.get("protection.fallback.stale") == 1
+        assert route_db_summary(d.route_db) == route_db_summary(
+            scalar_oracle(d)
+        )
+        await d.stop()
+
+    run(main())
+
+
+def test_multi_failure_and_bounded_miss_fall_back(tmp_path):
+    async def main():
+        clock = SimClock()
+        # bound the table to 2 links: most flaps miss
+        d, svc, kv_q, reader = await booted_decision(
+            clock, tmp_path, max_links=2
+        )
+        rep = svc.mint_now()
+        assert rep["patches"] == 2
+        # two unrelated links in one event: unprotected multi-failure
+        kv_q.push(
+            adj_pub(
+                version=2, drops=[("node1", "node2"), ("node3", "node6")]
+            )
+        )
+        await clock.run_for(2.0)
+        assert d.counters.get("protection.fallback.multi_failure") == 1
+        svc.mint_now()
+        # node5-node8 sorts far past the 2-link bound: miss
+        kv_q.push(
+            adj_pub(
+                version=3,
+                drops=[
+                    ("node1", "node2"),
+                    ("node3", "node6"),
+                    ("node5", "node8"),
+                ],
+            )
+        )
+        await clock.run_for(2.0)
+        assert d.counters.get("protection.fallback.miss") == 1
+        assert d.counters.get("decision.frr_applied") == 0
+        assert route_db_summary(d.route_db) == route_db_summary(
+            scalar_oracle(d)
+        )
+        await d.stop()
+
+    run(main())
+
+
+def test_corrupted_patch_trips_confirm_full_sync_and_purge(tmp_path):
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+        svc.mint_now()
+        # poison one minted patch: skew every nexthop's metric (the
+        # confirm compares nexthop sets via eq_ignoring_cost, so a
+        # wrong METRIC inside the nexthop is a real divergence)
+        key = link_patch_key(("node1", "node2"))
+        doc = svc.table.store.lookup(key)
+        assert doc["sets"], "the failure moves routes at the vantage"
+        for row in doc["sets"]:
+            for nh in row["nexthops"]:
+                nh[3] = int(nh[3]) + 1000
+        drain(reader)
+        kv_q.push(adj_pub(version=2, drops=[("node1", "node2")]))
+        await clock.run_for(2.0)
+        updates = drain(reader)
+        assert updates[0].frr
+        assert d.counters.get("decision.frr_mismatches") == 1
+        assert d.counters.get("protection.mismatches") == 1
+        # the confirm replaced the whole RIB
+        assert any(
+            u.type.name == "FULL_SYNC" for u in updates[1:]
+        ), [u.type.name for u in updates]
+        # purge-on-suspicion: the poisoned table is gone
+        assert svc.table.state == "empty"
+        assert route_db_summary(d.route_db) == route_db_summary(
+            scalar_oracle(d)
+        )
+        await d.stop()
+
+    run(main())
+
+
+def test_srlg_flap_applies_the_group_patch(tmp_path):
+    async def main():
+        clock = SimClock()
+        groups = normalize_srlg_groups(
+            [
+                {
+                    "name": "conduit7",
+                    "links": [("node1", "node2"), ("node4", "node5")],
+                }
+            ]
+        )
+        d, svc, kv_q, reader = await booted_decision(
+            clock, tmp_path, srlg_groups=groups
+        )
+        rep = svc.mint_now()
+        assert rep["patches"] == len(EDGES) + 1
+        assert (
+            svc.table.store.lookup(srlg_domain("conduit7")) is not None
+        )
+        drain(reader)
+        # the conduit is cut: BOTH member links fail in one publication
+        kv_q.push(
+            adj_pub(
+                version=2, drops=[("node1", "node2"), ("node4", "node5")]
+            )
+        )
+        await clock.run_for(2.0)
+        updates = drain(reader)
+        assert updates and updates[0].frr
+        assert d.counters.get("decision.frr_applied") == 1
+        assert d.counters.get("decision.frr_mismatches") == 0
+        assert d.counters.get("protection.confirms") == 1
+        assert route_db_summary(d.route_db) == route_db_summary(
+            scalar_oracle(d)
+        )
+        await d.stop()
+
+    run(main())
+
+
+def test_quarantine_purges_table_and_requests_abort(tmp_path):
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+        svc.mint_now()
+        assert svc.table.state == "ready"
+        svc._on_quarantine({"device": 3, "reason": "shadow_mismatch"})
+        assert svc.table.state == "empty"
+        assert svc._abort_requested and svc._dirty
+        assert d.counters.get("protection.purge.quarantine") == 1
+        # the next flap finds no table and falls back warm
+        kv_q.push(adj_pub(version=2, drops=[("node1", "node2")]))
+        await clock.run_for(2.0)
+        assert d.counters.get("protection.fallback.miss") == 1
+        assert route_db_summary(d.route_db) == route_db_summary(
+            scalar_oracle(d)
+        )
+        await d.stop()
+
+    run(main())
+
+
+def test_service_mint_loop_runs_on_sim_clock(tmp_path):
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+        # undo the manual wiring; start() owns it
+        d._generation_listeners = [
+            e for e in d._generation_listeners if e[2] is not svc._on_generation
+        ]
+        svc.start()
+        await clock.run_for(5.0)
+        assert svc.table.state == "ready", svc.error
+        first_hash = svc.table.table_hash
+        # a topology change re-mints (debounced) a DIFFERENT table
+        kv_q.push(adj_pub(version=2, drops=[("node1", "node2")]))
+        await clock.run_for(5.0)
+        assert svc.table.state == "ready"
+        assert svc.table.table_hash != first_hash
+        assert svc.table.num_mints == 2
+        await svc.stop()
+        await d.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# builder discipline
+# ---------------------------------------------------------------------------
+
+
+def make_builder(tmp_path, d, sub="b", **kw):
+    return ProtectionBuilder(
+        lambda: SweepInputs(**d.capacity_sweep_inputs()),
+        ProtectionStore(str(tmp_path / sub / "store")),
+        d.solver,
+        str(tmp_path / sub / "sweep"),
+        counters=d.counters,
+        **kw,
+    )
+
+
+def test_generation_move_mid_mint_refuses_the_device(tmp_path):
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+        b = make_builder(tmp_path, d, shard_scenarios=4)
+        b.prepare(resume=False)
+        b.step(1)
+        assert not b.finished()
+        d._change_seq += 1
+        with pytest.raises(ProtectionBuildError):
+            b.step(1)
+        await d.stop()
+
+    run(main())
+
+
+def test_kill_after_shard_resume_reproduces_table_hash(tmp_path):
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+        clean = make_builder(tmp_path, d, "clean", shard_scenarios=4)
+        clean.prepare(resume=False)
+        while not clean.finished():
+            clean.step(1)
+        clean_hash = clean.finalize()["table_hash"]
+
+        killed = make_builder(tmp_path, d, "killed", shard_scenarios=4)
+        rep = killed.prepare(resume=True)
+        assert rep["shards"] == 3
+        killed.step(1)  # killed after shard 0
+
+        resumed = make_builder(tmp_path, d, "killed", shard_scenarios=4)
+        rep = resumed.prepare(resume=True)
+        assert rep["resumed"] and rep["resumed_shards"] == 1
+        while not resumed.finished():
+            resumed.step(1)
+        final = resumed.finalize()
+        assert final["table_hash"] == clean_hash, (
+            "kill+resume must mint byte-identical patch content"
+        )
+        await d.stop()
+
+    run(main())
+
+
+def test_global_ineligibility_mints_tombstones(tmp_path):
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+        b = make_builder(
+            tmp_path, d, "pol", policy_active_fn=lambda: True
+        )
+        b.prepare(resume=False)
+        while not b.finished():
+            b.step(1)
+        final = b.finalize()
+        assert final["patches"] == len(EDGES) and final["eligible"] == 0
+        doc = b.store.lookup(link_patch_key(("node1", "node2")))
+        assert not doc["eligible"] and doc["reason"] == "rib_policy"
+        await d.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# world_deltas: one pass, two consumers
+# ---------------------------------------------------------------------------
+
+
+def test_builder_rider_sees_the_reducer_scenario_stream(tmp_path):
+    """The delta consumer (builder's mint) and the spill rows (the
+    reducer's durable record) come off ONE device pass and must agree
+    on the scenario stream, per shard."""
+    from openr_tpu.sweep import SpillReader, SweepExecutor
+
+    async def main():
+        clock = SimClock()
+        d, svc, kv_q, reader = await booted_decision(clock, tmp_path)
+
+        seen = {}
+
+        def consume(ctx, shard_id, group, deltas):
+            from openr_tpu.sweep.reduce import world_deltas
+
+            seen.setdefault(shard_id, []).extend(
+                scen.hash for scen, _s, _r, _d in world_deltas(group, deltas)
+            )
+
+        ex = SweepExecutor(
+            lambda: SweepInputs(**d.capacity_sweep_inputs()),
+            str(tmp_path / "wd"),
+            clock=clock,
+            counters=d.counters,
+            shard_scenarios=4,
+        )
+        ex.delta_consumer = consume
+        ex.prepare(ScenarioSpec(single_link_failures=True, combo_k=0))
+        ex.run()
+        rows = list(SpillReader(str(tmp_path / "wd")).rows())
+        by_shard = {}
+        for r in rows:
+            by_shard.setdefault(r["shard"], []).append(r["hash"])
+        assert seen == by_shard
+        await d.stop()
+
+    run(main())
