@@ -27,7 +27,13 @@ from openr_tpu.analysis.engine import (
     load_modules,
     repo_root,
 )
-from openr_tpu.analysis.findings import Finding, Report
+from openr_tpu.analysis.findings import (
+    Finding,
+    Report,
+    StaleSuppression,
+    findings_from_sarif,
+    render_sarif,
+)
 from openr_tpu.analysis.passes import all_rules, make_passes
 
 __all__ = [
@@ -37,6 +43,9 @@ __all__ = [
     "ModuleSummary",
     "Project",
     "Report",
+    "StaleSuppression",
+    "findings_from_sarif",
+    "render_sarif",
     "all_rules",
     "analyze_modules",
     "analyze_paths",

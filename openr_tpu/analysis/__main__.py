@@ -18,6 +18,11 @@ Modes:
                          finding counts across PRs
 * ``--format=github``  — GitHub Actions ``::error file=..,line=..``
                          annotations, one per finding
+* ``--format=sarif``   — SARIF 2.1.0 (code-scanning upload format;
+                         round-trips through findings_from_sarif)
+* ``--fix-stale-suppressions`` — rewrite source files removing
+                         suppression comments whose rules no longer
+                         fire (``--check`` reports them as warnings)
 * ``--list-rules``     — every rule id with its pass family and one-line
                          rationale
 * ``--explain RULE``   — the rule's rationale plus a minimal tripping
@@ -37,12 +42,15 @@ from openr_tpu.analysis.engine import (
     analyze_paths,
     default_baseline_path,
     default_cache_path,
+    repo_root,
 )
+from openr_tpu.analysis.findings import render_sarif
 from openr_tpu.analysis.passes import (
     all_rules,
     make_passes,
     rule_example,
 )
+from openr_tpu.analysis.suppress import strip_stale
 
 
 def _explain(rule: str) -> int:
@@ -90,9 +98,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument(
         "--format",
-        choices=("text", "json", "github"),
+        choices=("text", "json", "github", "sarif"),
         default="text",
         dest="fmt",
+    )
+    ap.add_argument(
+        "--fix-stale-suppressions",
+        action="store_true",
+        help="rewrite files removing suppression comments whose rules "
+        "no longer fire, then exit",
     )
     ap.add_argument(
         "--baseline",
@@ -164,6 +178,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 0
 
+    if args.fix_stale_suppressions and args.rules:
+        print(
+            "orlint: --fix-stale-suppressions needs a full run — a "
+            "--rule filter proves nothing about absent findings"
+        )
+        return 2
+
     report = analyze_paths(
         args.paths,
         baseline_path,
@@ -172,8 +193,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         cache_path=cache_path,
     )
 
+    if args.fix_stale_suppressions:
+        by_path: dict = {}
+        for s in report.stale_suppressions:
+            by_path.setdefault(s.path, []).append((s.line, s.rules))
+        edited_files = 0
+        base = repo_root()
+        for rel, entries in sorted(by_path.items()):
+            path = Path(rel)
+            if not path.is_absolute():
+                path = base / rel
+            new_text, edits = strip_stale(path.read_text(), entries)
+            if edits:
+                path.write_text(new_text)
+                edited_files += 1
+                print(f"orlint: {rel}: removed {edits} stale marker(s)")
+        print(
+            f"orlint: {len(report.stale_suppressions)} stale "
+            f"suppression(s) across {edited_files} file(s) fixed"
+        )
+        return 0
+
     if args.fmt == "json":
         print(json.dumps(report.to_json(), indent=2))
+    elif args.fmt == "sarif":
+        print(json.dumps(render_sarif(report, all_rules()), indent=2))
     elif args.fmt == "github":
         for f in report.findings:
             print(f.render_github())
@@ -186,6 +230,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"matches any {e.rule} finding — remove it "
                 "(--update-baseline)"
             )
+        for s in report.stale_suppressions:
+            print(f"warning: {s.render()}")
         counts = report.counts_by_rule()
         summary = (
             f"orlint: {len(report.findings)} finding(s) across "
@@ -200,6 +246,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f", {len(report.stale_baseline)} stale baseline entr"
                 + ("y" if len(report.stale_baseline) == 1 else "ies")
                 if report.stale_baseline
+                else ""
+            )
+            + (
+                f", {len(report.stale_suppressions)} stale "
+                "suppression(s)"
+                if report.stale_suppressions
                 else ""
             )
             + ")"

@@ -57,7 +57,13 @@ MODULE_BODY = "<module>"
 #: builtin container constructors that bind an "unordered" local type
 _SET_CTORS = {"set", "frozenset"}
 _DICT_CTORS = {"dict", "collections.defaultdict", "collections.Counter"}
+_LIST_CTORS = {"list", "collections.deque"}
 _ORDERED_ANNOTATIONS = {"OrderedDict"}
+
+#: the builtin mutable-container markers (attr/var types that are not a
+#: class name) — the atomicity family treats exactly these as
+#: "actor-owned container" (passes/atomicity.py)
+CONTAINER_MARKERS = ("set", "dict", "list")
 
 
 @dataclass
@@ -96,15 +102,33 @@ class FunctionInfo:
     calls: List[CallRef] = field(default_factory=list)
     #: locally-typed names: var -> class ref (annotations + ctor bindings)
     var_types: Dict[str, str] = field(default_factory=dict)
+    #: suspension facts (passes/atomicity.py): whether this is an async
+    #: def, the call refs that appear under an ``await``, and whether the
+    #: body suspends unconditionally of any callee (awaiting a bare
+    #: future/task, ``async for``, ``async with``).  Serialized so the
+    #: interprocedural suspends-fixpoint is a pure function of summaries
+    #: — which is what keeps the result cache's project_digest sound.
+    is_async: bool = False
+    awaited: List[CallRef] = field(default_factory=list)
+    suspends: bool = False
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "cls": self.cls,
             "line": self.line,
             "end_line": self.end_line,
             "calls": self.calls,
             "var_types": self.var_types,
         }
+        # truthy-only keys keep summaries compact and byte-stable for the
+        # (majority) sync functions
+        if self.is_async:
+            out["is_async"] = True
+        if self.awaited:
+            out["awaited"] = self.awaited
+        if self.suspends:
+            out["suspends"] = True
+        return out
 
     @classmethod
     def from_json(cls, local_qual: str, doc: dict) -> "FunctionInfo":
@@ -119,6 +143,9 @@ class FunctionInfo:
             end_line=int(doc.get("end_line", 0)),
             calls=[list(c) for c in doc.get("calls", [])],
             var_types=dict(doc.get("var_types", {})),
+            is_async=bool(doc.get("is_async", False)),
+            awaited=[list(c) for c in doc.get("awaited", [])],
+            suspends=bool(doc.get("suspends", False)),
         )
 
 
@@ -185,6 +212,8 @@ def _class_ref(node: ast.expr, imports: ImportMap) -> Optional[str]:
         return "set"
     if target in _DICT_CTORS:
         return "dict"
+    if target in _LIST_CTORS:
+        return "list"
     if "." in target:
         head = target.split(".", 1)[0]
         # imported/external dotted reference: keep the dots so sink
@@ -207,6 +236,8 @@ def _annotation_type(node: Optional[ast.expr]) -> Optional[str]:
         return "set"
     if name in ("Dict", "Mapping", "MutableMapping", "DefaultDict", "Counter") or low == "dict":
         return "dict"
+    if name in ("List", "MutableSequence", "Deque") or low == "list":
+        return "list"
     return name
 
 
@@ -259,6 +290,7 @@ class _CallIndexer(ast.NodeVisitor):
             cls=cls.name if cls else "",
             line=node.lineno,
             end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
         )
         self._record_param_types(node, info)
         if cls is not None:
@@ -311,7 +343,7 @@ class _CallIndexer(ast.NodeVisitor):
         ref: Optional[str] = None
         if isinstance(value, ast.Call):
             ref = _class_ref(value.func, self.imports)
-            if ref is not None and "." not in ref and ref not in ("set", "dict"):
+            if ref is not None and "." not in ref and ref not in CONTAINER_MARKERS:
                 # plain-name call: only a Title-case name plausibly
                 # constructs; helper() results stay untyped
                 if not ref[:1].isupper():
@@ -322,6 +354,8 @@ class _CallIndexer(ast.NodeVisitor):
             ref = "set"
         elif isinstance(value, (ast.Dict, ast.DictComp)):
             ref = "dict"
+        elif isinstance(value, (ast.List, ast.ListComp)):
+            ref = "list"
         elif isinstance(value, ast.Name):
             # alias of an already-typed local (incl. annotated params):
             # `clock = self._clock or fallback` is NOT this shape — only a
@@ -363,6 +397,25 @@ class _CallIndexer(ast.NodeVisitor):
 
     def _call_ref(self, node: ast.Call) -> CallRef:
         return call_ref_for(node, self.imports)
+
+    # -- suspension facts --------------------------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._fn.awaited.append(call_ref_for(node.value, self.imports))
+        else:
+            # awaiting a bare future/task/gather-result: suspension is not
+            # attributable to a callee — the function suspends, period
+            self._fn.suspends = True
+        self.generic_visit(node)
+
+    def visit_AsyncFor(self, node: ast.AsyncFor) -> None:
+        self._fn.suspends = True
+        self.generic_visit(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._fn.suspends = True
+        self.generic_visit(node)
 
 
 def call_ref_for(node: ast.Call, imports: ImportMap) -> CallRef:
@@ -455,6 +508,7 @@ class Project:
         self.methods: Dict[Tuple[str, str], List[str]] = {}
         self._subclass_cache: Dict[str, Set[str]] = {}
         self._untyped_cache: Dict[str, List[str]] = {}
+        self._suspends_cache: Optional[Dict[str, bool]] = None
         for s in summaries:
             for cname, cinfo in s.classes.items():
                 self.classes.setdefault(cname, []).append((s.module, cinfo))
@@ -610,7 +664,7 @@ class Project:
         return out
 
     def _typed_method(self, cls_ref: Optional[str], method: str) -> List[str]:
-        if cls_ref is None or cls_ref in ("set", "dict"):
+        if cls_ref is None or cls_ref in CONTAINER_MARKERS:
             return self._untyped_method(method)
         if "." in cls_ref:  # external dotted type: keep dotted for sinks
             return [f"{cls_ref}.{method}"]
@@ -628,6 +682,72 @@ class Project:
 
     def _summary_for_module(self, module: str) -> Optional[ModuleSummary]:
         return self._by_module.get(module)
+
+    # -- suspension analysis -----------------------------------------------
+
+    def _override_expand(self, targets: Iterable[str]) -> Set[str]:
+        """Widen internal method targets with their subclass overrides.
+        ``await self.clock.sleep(..)`` statically resolves to the Clock
+        base (whose stub body never suspends) — but at runtime a
+        SimClock/WallClock override runs, and THOSE suspend.  Suspension
+        is a may-property, so dynamic dispatch must widen; contrast the
+        determinism barrier, where the same asymmetry is deliberate."""
+        out = set(targets)
+        for t in targets:
+            fn = self.functions.get(t)
+            if fn is None or not fn.cls:
+                continue
+            for sub in self.subclasses_of(fn.cls):
+                out.update(self.methods.get((sub, fn.name), ()))
+        return out
+
+    def suspension_verdicts(self) -> Dict[str, bool]:
+        """qualname -> "awaiting this internal function can yield control
+        to another fiber".  Least fixpoint over the awaited-call edges: a
+        function suspends iff its body suspends unconditionally (bare
+        future, ``async for``/``async with``) or some awaited call
+        resolves to an external target (unknown callee ⇒ conservatively
+        suspends) or to an internal suspender.  The complement is the
+        precision the atomicity family buys: ``await self._helper()``
+        where the helper never reaches a real suspension primitive is NOT
+        a turn boundary."""
+        if self._suspends_cache is not None:
+            return self._suspends_cache
+        sus: Dict[str, bool] = {}
+        awaited_tgts: Dict[str, Set[str]] = {}
+        for s in self.summaries.values():
+            for local_qual, fn in s.functions.items():
+                qual = f"{s.module}.{local_qual}" if s.module else local_qual
+                sus[qual] = bool(fn.suspends)
+                if fn.awaited:
+                    awaited_tgts[qual] = self._override_expand({
+                        t
+                        for ref in fn.awaited
+                        for t in self.resolve_ref(s, fn, ref)
+                    })
+        changed = True
+        while changed:
+            changed = False
+            for qual, tgts in awaited_tgts.items():
+                if sus.get(qual):
+                    continue
+                for t in tgts:
+                    if t not in self.functions or sus.get(t):
+                        sus[qual] = True
+                        changed = True
+                        break
+        self._suspends_cache = sus
+        return sus
+
+    def targets_suspend(self, targets: Iterable[str]) -> bool:
+        """Would awaiting a call that resolves to ``targets`` suspend?
+        External/unresolved targets conservatively do; internal method
+        targets are widened with their subclass overrides."""
+        sus = self.suspension_verdicts()
+        return any(
+            t not in self.functions or sus.get(t)
+            for t in self._override_expand(set(targets))
+        )
 
     # -- reachability ------------------------------------------------------
 

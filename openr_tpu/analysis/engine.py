@@ -32,10 +32,10 @@ from openr_tpu.analysis.callgraph import (
     Project,
     project_digest,
 )
-from openr_tpu.analysis.findings import Finding, Report
+from openr_tpu.analysis.findings import Finding, Report, StaleSuppression
 from openr_tpu.analysis.passes import make_passes
 from openr_tpu.analysis.passes.base import CTX_PROJECT, ParsedModule
-from openr_tpu.analysis.suppress import Suppressions
+from openr_tpu.analysis.suppress import ALL, Suppressions
 
 DEFAULT_BASELINE_NAME = "baseline.json"
 
@@ -109,6 +109,35 @@ def _run_passes(
     return out
 
 
+def _stale_suppressions_for(
+    rel: str, findings: List[Finding], sup: Suppressions
+) -> List[StaleSuppression]:
+    """Suppression rules in ``rel`` that no RAW finding matches any more.
+    Computed from the pre-suppression finding list: a marker is live iff
+    removing it would surface something.  Only meaningful on a full run
+    (every pass executed) — callers must skip this under a --rule filter."""
+    by_line: Dict[int, set] = {}
+    fired: set = set()
+    for f in findings:
+        by_line.setdefault(f.line, set()).add(f.rule)
+        fired.add(f.rule)
+
+    def _dead(rule: str, hit: set) -> bool:
+        # disable=all is live while ANY finding hits its scope
+        return not hit if rule == ALL else rule not in hit
+
+    out: List[StaleSuppression] = []
+    for line, marked in sorted(sup.line_rules.items()):
+        hit = by_line.get(line, set())
+        stale = tuple(sorted(r for r in marked if _dead(r, hit)))
+        if stale:
+            out.append(StaleSuppression(path=rel, line=line, rules=stale))
+    stale_file = tuple(sorted(r for r in sup.file_rules if _dead(r, fired)))
+    if stale_file:
+        out.append(StaleSuppression(path=rel, line=0, rules=stale_file))
+    return out
+
+
 def _assemble_report(
     per_file: Dict[str, Tuple[List[Finding], Suppressions]],
     files_scanned: int,
@@ -124,6 +153,11 @@ def _assemble_report(
     for rel, (findings, sup) in per_file.items():
         raw.extend(findings)
         sup_by_rel[rel] = sup
+        if not rules:
+            report.stale_suppressions.extend(
+                _stale_suppressions_for(rel, findings, sup)
+            )
+    report.stale_suppressions.sort(key=lambda s: (s.path, s.line))
     if rules:
         wanted = set(rules)
         raw = [f for f in raw if f.rule in wanted]

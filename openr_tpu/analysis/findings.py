@@ -9,7 +9,7 @@ offending code mostly does not (see baseline.py).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict
+from typing import Any, Dict, List, Tuple
 
 
 @dataclass(frozen=True)
@@ -68,6 +68,33 @@ class Finding:
         )
 
 
+@dataclass(frozen=True)
+class StaleSuppression:
+    """A suppression comment no raw finding uses any more.
+
+    ``line`` is the marker's own physical line for the line-level form,
+    0 for the file-level ``disable-file`` form.  ``rules`` lists only
+    the STALE subset of the marker's rule list — a marker naming two
+    rules of which one still fires is reported (and rewritten) for the
+    dead rule alone."""
+
+    path: str
+    line: int
+    rules: Tuple[str, ...]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"path": self.path, "line": self.line, "rules": list(self.rules)}
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}" if self.line else self.path
+        scope = "suppression" if self.line else "file-level suppression"
+        return (
+            f"{where}: [stale-suppression] {scope} for "
+            f"{', '.join(self.rules)} no longer matches any finding — "
+            "remove it (--fix-stale-suppressions)"
+        )
+
+
 @dataclass
 class Report:
     """One analysis run: active findings plus what was filtered and why."""
@@ -76,6 +103,9 @@ class Report:
     suppressed: list = field(default_factory=list)
     baselined: list = field(default_factory=list)
     stale_baseline: list = field(default_factory=list)  #: entries no finding matched
+    #: suppression comments whose rules no longer fire (audited only on
+    #: full runs — a --rule filter proves nothing about absent findings)
+    stale_suppressions: List[StaleSuppression] = field(default_factory=list)
     files_scanned: int = 0
     #: how many files were actually ast.parse'd this run (< files_scanned
     #: when the ``--cache`` result cache serves warm entries)
@@ -100,4 +130,102 @@ class Report:
             "suppressed": len(self.suppressed),
             "baselined": len(self.baselined),
             "stale_baseline": [e.to_json() for e in self.stale_baseline],
+            "stale_suppressions": [
+                s.to_json() for s in self.stale_suppressions
+            ],
         }
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 (--format=sarif): the interchange format code-scanning UIs
+# ingest.  The emitter keeps full Finding fidelity (snippet rides in the
+# region) so findings_from_sarif() round-trips byte-exactly — the
+# contract tests/test_orlint.py pins.
+# ---------------------------------------------------------------------------
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def render_sarif(report: Report, rule_meta: Dict[str, str]) -> Dict[str, Any]:
+    """One SARIF run for this report.  ``rule_meta`` maps rule id to its
+    one-line rationale (passes.all_rules()); only rules that actually
+    fired are listed in the driver, keeping the document proportional to
+    the findings."""
+    fired = sorted({f.rule for f in report.findings})
+    rule_index = {rule: i for i, rule in enumerate(fired)}
+    results = []
+    for f in report.findings:
+        results.append(
+            {
+                "ruleId": f.rule,
+                "ruleIndex": rule_index[f.rule],
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path,
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {
+                                "startLine": f.line,
+                                # SARIF columns are 1-based; Finding.col
+                                # is the AST's 0-based offset
+                                "startColumn": f.col + 1,
+                                "snippet": {"text": f.snippet},
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "orlint",
+                        "rules": [
+                            {
+                                "id": rule,
+                                "shortDescription": {
+                                    "text": rule_meta.get(rule, "")
+                                },
+                            }
+                            for rule in fired
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def findings_from_sarif(doc: Dict[str, Any]) -> List[Finding]:
+    """Inverse of :func:`render_sarif` — used by the round-trip test and
+    by tooling that diffs finding sets across SARIF uploads."""
+    out: List[Finding] = []
+    for run in doc.get("runs", ()):
+        for res in run.get("results", ()):
+            loc = (res.get("locations") or [{}])[0].get(
+                "physicalLocation", {}
+            )
+            region = loc.get("region", {})
+            out.append(
+                Finding(
+                    rule=res.get("ruleId", ""),
+                    path=loc.get("artifactLocation", {}).get("uri", ""),
+                    line=int(region.get("startLine", 0)),
+                    col=int(region.get("startColumn", 1)) - 1,
+                    message=res.get("message", {}).get("text", ""),
+                    snippet=region.get("snippet", {}).get("text", ""),
+                )
+            )
+    return out

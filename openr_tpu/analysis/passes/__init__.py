@@ -13,6 +13,7 @@ from typing import Dict, Optional, Tuple
 from openr_tpu.analysis.passes.actor_isolation import ActorIsolationPass
 from openr_tpu.analysis.passes.alert_registry import AlertRegistryPass
 from openr_tpu.analysis.passes.async_blocking import AsyncBlockingPass
+from openr_tpu.analysis.passes.atomicity import AtomicityPass
 from openr_tpu.analysis.passes.base import Pass
 from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
 from openr_tpu.analysis.passes.determinism import DeterminismPass
@@ -37,6 +38,7 @@ def make_passes():
         SweepOwnershipPass(),
         ProtectionTablePass(),
         DeterminismPass(),
+        AtomicityPass(),
     ]
 
 

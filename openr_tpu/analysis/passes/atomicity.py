@@ -1,0 +1,688 @@
+"""Await-point atomicity family — actor turns must be interleaving-safe
+(rule family 11, docs/Developer_Guide.md).
+
+The replay-determinism family (passes/determinism.py) proves that ONE
+schedule replays byte-identically; nothing proved that the digests are
+the same under a DIFFERENT legal schedule.  An actor turn that reads
+``self`` state, suspends (``await``), and then acts on the pre-suspension
+read is exactly such a schedule dependence: between the read and the
+write any other fiber may run and update the same state, so the outcome
+is decided by dispatch order, not by content.  Before EmulatedNetwork
+can be sharded across workers (ROADMAP), every such window has to be
+closed — this pass finds them statically; ``openr_tpu.chaos.schedule``
+hunts the same class dynamically by perturbing the dispatch order.
+
+A *suspension point* is anything that can yield control to another
+fiber: a bare ``await fut``, ``async for`` / ``async with``, or an
+awaited call whose callee **transitively** suspends — computed
+interprocedurally over the Project symbol table
+(``Project.suspension_verdicts``).  The flip side is the precision this
+family needs to stay quiet: ``await self._helper()`` where the helper
+never reaches a real suspension primitive is NOT a turn boundary and
+does not trip anything.
+
+Rules (scoped to ``Actor`` subclasses on the protocol plane):
+
+* ``await-atomicity`` — read-modify-write on ``self`` state straddling
+  a suspension without re-validation.  Two shapes: check-then-act (a
+  guard on ``self.X`` whose dependent write lands after an ``await``
+  with no re-check) and stale RMW (a local read from ``self.X`` before
+  the suspension written back after it).  Sanctioned spelling:
+  re-validate after the await (read ``self.X`` again), or restructure
+  so the turn does not suspend between check and act.
+
+* ``await-aliasing`` — a mutable actor-owned container (``self.X`` of
+  set/dict/list type) handed BY REFERENCE to another actor or callback
+  across a turn boundary: as an argument to a suspending awaited call
+  on a non-``self`` receiver, or to a queue/handoff method (``push`` /
+  ``put`` / ``publish``) whose consumer runs in a later turn.  The
+  receiver observes future mutations, not the handoff-time state.
+  Sanctioned spelling: pass a snapshot — ``dict(self.X)`` /
+  ``list(self.X)`` / ``set(self.X)``.
+
+* ``await-iteration`` — iterating an actor-owned container while the
+  loop body suspends: another turn may mutate the container
+  mid-iteration (``RuntimeError: dictionary changed size`` at best, a
+  silently skewed traversal at worst).  Sanctioned spelling: iterate a
+  snapshot — ``list(self.X)`` / ``sorted(self.X)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from openr_tpu.analysis.callgraph import (
+    CONTAINER_MARKERS,
+    FunctionInfo,
+    ModuleSummary,
+    Project,
+    call_ref_for,
+)
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass, project
+
+#: container methods that mutate their receiver (treated as writes; like
+#: AugAssign they consume the pre-state unconditionally, so they do NOT
+#: count as re-validation)
+_MUTATORS = {
+    "add", "append", "appendleft", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault",
+    "update",
+}
+
+#: methods that hand their arguments to another fiber even without an
+#: ``await`` at the call site: queue producers and listener/callback
+#: registration — the consumer runs in a later turn
+_HANDOFF_METHODS = {"push", "put", "put_nowait", "publish", "add_listener"}
+
+#: marker -> sanctioned snapshot spelling, for the aliasing message
+_SNAPSHOT_SPELLING = {"dict": "dict(...)", "set": "set(...)", "list": "list(...)"}
+
+_DICT_VIEWS = ("items", "keys", "values")
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _names_in(expr: Optional[ast.AST]) -> Set[str]:
+    if expr is None:
+        return set()
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+class _TurnScan:
+    """Flow-sensitive event scan of one async actor method.
+
+    Models the body as a stream of READ / WRITE / SUSPEND events over
+    ``self`` attributes, in approximate execution order.  Guard frames
+    (pushed per ``if``/``while`` test) track which attributes the
+    current branch's behavior was decided by; a SUSPEND marks them
+    straddled, a later READ of the attribute re-validates, and a WRITE
+    while straddled is the finding.  Locals bound from ``self`` state
+    go stale at a SUSPEND; writing one back is the RMW shape."""
+
+    def __init__(self, owner: "AtomicityPass", mod: ParsedModule,
+                 proj: Project, summary: ModuleSummary,
+                 fn_info: Optional[FunctionInfo]) -> None:
+        self.owner = owner
+        self.mod = mod
+        self.proj = proj
+        self.summary = summary
+        self.fn_info = fn_info
+        #: guard frames: attr -> {"guard": test line, "suspend": line|None}
+        self.frames: List[Dict[str, Dict[str, Optional[int]]]] = []
+        #: local var -> {"attr": source attr, "line": read line,
+        #:               "stale": suspend line|None}
+        self.locals_from: Dict[str, Dict[str, Optional[int]]] = {}
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[str, int, str]] = set()
+
+    # -- suspension oracle -------------------------------------------------
+
+    def call_suspends(self, call: ast.Call) -> bool:
+        ref = call_ref_for(call, self.mod.imports)
+        if self.fn_info is not None:
+            targets = self.proj.resolve_ref(self.summary, self.fn_info, ref)
+        else:
+            targets = []
+        return self.proj.targets_suspend(targets) if targets else True
+
+    # -- event stream ------------------------------------------------------
+
+    def _expr_events(self, expr: Optional[ast.AST]) -> Iterator[Tuple]:
+        """(kind, ...) events of one expression in approximate execution
+        order.  Pure — applying them to the flow state is ``_emit``'s
+        job, which lets guard collection reuse this walk."""
+        if expr is None:
+            return
+        if isinstance(expr, ast.Await):
+            if isinstance(expr.value, ast.Call):
+                yield from self._call_events(expr.value, awaited=True)
+            else:
+                # bare future/task: unconditionally a turn boundary
+                yield ("suspend", expr.lineno)
+            return
+        if isinstance(expr, ast.Call):
+            yield from self._call_events(expr, awaited=False)
+            return
+        if _is_self_attr(expr) and isinstance(expr.ctx, ast.Load):
+            yield ("read", expr.attr, expr.lineno)
+            return
+        if isinstance(expr, (ast.Lambda, ast.GeneratorExp)):
+            return  # deferred bodies execute at an unknown time
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                yield from self._expr_events(child)
+            elif isinstance(child, ast.comprehension):
+                yield from self._expr_events(child.iter)
+                for cond in child.ifs:
+                    yield from self._expr_events(cond)
+
+    def _call_events(self, call: ast.Call, awaited: bool) -> Iterator[Tuple]:
+        f = call.func
+        receiver_attr: Optional[str] = None
+        if isinstance(f, ast.Attribute):
+            if _is_self_attr(f.value):
+                receiver_attr = f.value.attr
+            elif not (isinstance(f.value, ast.Name) and f.value.id == "self"):
+                yield from self._expr_events(f.value)
+        elif not isinstance(f, ast.Name):
+            yield from self._expr_events(f)
+        for a in call.args:
+            yield from self._expr_events(
+                a.value if isinstance(a, ast.Starred) else a
+            )
+        for kw in call.keywords:
+            yield from self._expr_events(kw.value)
+        if receiver_attr is not None:
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                # like AugAssign: consumes pre-state, does not re-validate
+                yield ("write", receiver_attr, call.lineno, None)
+            else:
+                yield ("read", receiver_attr, f.lineno)
+        if awaited and self.call_suspends(call):
+            yield ("suspend", call.lineno)
+
+    def _emit(self, expr: Optional[ast.AST]) -> None:
+        for ev in self._expr_events(expr):
+            if ev[0] == "read":
+                self._on_read(ev[1])
+            elif ev[0] == "suspend":
+                self._on_suspend(ev[1])
+            else:
+                self._on_write(ev[1], ev[2], ev[3])
+
+    def _guard_attrs(self, test: ast.AST) -> Set[str]:
+        return {ev[1] for ev in self._expr_events(test) if ev[0] == "read"}
+
+    # -- flow state --------------------------------------------------------
+
+    def _on_read(self, attr: str) -> None:
+        for frame in self.frames:
+            ent = frame.get(attr)
+            if ent is not None:
+                ent["suspend"] = None  # re-validated
+        # NOTE: locals stay stale — re-reading self.X does not refresh a
+        # variable that still holds the pre-suspension value
+
+    def _on_suspend(self, line: int) -> None:
+        for frame in self.frames:
+            for ent in frame.values():
+                if ent["suspend"] is None:
+                    ent["suspend"] = line  # first straddling suspension
+        for info in self.locals_from.values():
+            if info["stale"] is None:
+                info["stale"] = line
+
+    def _on_write(self, attr: str, line: int,
+                  value: Optional[ast.AST]) -> None:
+        for frame in reversed(self.frames):
+            ent = frame.get(attr)
+            if ent is not None and ent["suspend"] is not None:
+                self._add(
+                    "await-atomicity", attr, line,
+                    f"`self.{attr}` is checked at line {ent['guard']} and "
+                    f"written at line {line}, but the turn suspends at "
+                    f"line {ent['suspend']} in between — by write time the "
+                    f"check is stale (another fiber may have updated "
+                    f"`self.{attr}`); re-validate after the await",
+                )
+                break
+        for name in _names_in(value):
+            info = self.locals_from.get(name)
+            if (
+                info is not None
+                and info["attr"] == attr
+                and info["stale"] is not None
+            ):
+                self._add(
+                    "await-atomicity", attr, line,
+                    f"read-modify-write on `self.{attr}` straddles a "
+                    f"suspension: local `{name}` was read from it at line "
+                    f"{info['line']}, the turn suspends at line "
+                    f"{info['stale']}, and the stale value is written back "
+                    f"at line {line} — concurrent updates are lost; "
+                    f"re-read `self.{attr}` after the await",
+                )
+                break
+        for frame in self.frames:
+            ent = frame.get(attr)
+            if ent is not None:
+                ent["suspend"] = None  # the write establishes our version
+
+    def _add(self, rule: str, attr: str, line: int, message: str) -> None:
+        key = (rule, line, attr)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(self.mod.finding_at(rule, line, message))
+
+    # fork/merge of the mutable staleness (If branches are exclusive —
+    # a suspension in the body must not straddle the orelse)
+
+    def _snap(self):
+        return (
+            [{a: e["suspend"] for a, e in fr.items()} for fr in self.frames],
+            {n: i["stale"] for n, i in self.locals_from.items()},
+        )
+
+    def _restore(self, snap) -> None:
+        frames, stales = snap
+        for fr, saved in zip(self.frames, frames):
+            for a, v in saved.items():
+                if a in fr:
+                    fr[a]["suspend"] = v
+        for n, v in stales.items():
+            if n in self.locals_from:
+                self.locals_from[n]["stale"] = v
+
+    def _merge(self, snap) -> None:
+        frames, stales = snap
+        for fr, other in zip(self.frames, frames):
+            for a, v in other.items():
+                if a in fr and fr[a]["suspend"] is None:
+                    fr[a]["suspend"] = v
+        for n, v in stales.items():
+            info = self.locals_from.get(n)
+            if info is not None and info["stale"] is None:
+                info["stale"] = v
+
+    # -- statements --------------------------------------------------------
+
+    def scan(self, stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs run at an unknown time
+        if isinstance(st, ast.Assign):
+            self._emit(st.value)
+            if len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
+                src = self._attr_source(st.value)
+                name = st.targets[0].id
+                if src is not None:
+                    self.locals_from[name] = {
+                        "attr": src, "line": st.lineno, "stale": None,
+                    }
+                else:
+                    self.locals_from.pop(name, None)
+            for t in st.targets:
+                self._assign_target(t, st.value, st.lineno)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._emit(st.value)
+                self._assign_target(st.target, st.value, st.lineno)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._emit(st.value)
+            t = st.target
+            if _is_self_attr(t):
+                self._on_write(t.attr, st.lineno, st.value)
+            elif isinstance(t, ast.Subscript):
+                self._emit(t.slice)
+                if _is_self_attr(t.value):
+                    self._on_write(t.value.attr, st.lineno, st.value)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                if _is_self_attr(t):
+                    self._on_write(t.attr, st.lineno, None)
+                elif isinstance(t, ast.Subscript):
+                    self._emit(t.slice)
+                    if _is_self_attr(t.value):
+                        self._on_write(t.value.attr, st.lineno, None)
+            return
+        if isinstance(st, (ast.Expr, ast.Return, ast.Raise)):
+            self._emit(getattr(st, "value", None) or getattr(st, "exc", None))
+            return
+        if isinstance(st, ast.Assert):
+            self._emit(st.test)
+            return
+        if isinstance(st, ast.If):
+            self._emit(st.test)
+            self._push_guard(st.test)
+            pre = self._snap()
+            self.scan(st.body)
+            after_body = self._snap()
+            self._restore(pre)
+            self.scan(st.orelse)
+            self._merge(after_body)
+            self.frames.pop()
+            return
+        if isinstance(st, ast.While):
+            self._emit(st.test)
+            self._push_guard(st.test)
+            # scan twice with the test re-emitted at the back edge: the
+            # second pass sees cross-iteration straddles (a suspension
+            # late in iteration N is live at the top of iteration N+1)
+            self.scan(st.body)
+            self._emit(st.test)
+            self.scan(st.body)
+            self.frames.pop()
+            self.scan(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self.owner.check_iteration(self, st)
+            if isinstance(st, ast.AsyncFor):
+                self._on_suspend(st.lineno)
+            self._emit(st.iter)
+            for name in _names_in(st.target):
+                self.locals_from.pop(name, None)
+            self.scan(st.body)
+            self.scan(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            if isinstance(st, ast.AsyncWith):
+                self._on_suspend(st.lineno)
+            for item in st.items:
+                self._emit(item.context_expr)
+            self.scan(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.scan(st.body)
+            for h in st.handlers:
+                self.scan(h.body)
+            self.scan(st.orelse)
+            self.scan(st.finalbody)
+            return
+        # anything else: conservatively walk child expressions/statements
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._emit(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _push_guard(self, test: ast.AST) -> None:
+        self.frames.append({
+            a: {"guard": test.lineno, "suspend": None}
+            for a in self._guard_attrs(test)
+        })
+
+    def _assign_target(self, t: ast.expr, value: Optional[ast.AST],
+                       line: int) -> None:
+        if _is_self_attr(t):
+            self._on_write(t.attr, line, value)
+        elif isinstance(t, ast.Attribute) and _is_self_attr(t.value):
+            # self.X.field = v mutates the object held by self.X
+            self._on_write(t.value.attr, line, value)
+        elif isinstance(t, ast.Subscript):
+            self._emit(t.slice)
+            if _is_self_attr(t.value):
+                self._on_write(t.value.attr, line, value)
+            else:
+                self._emit(t.value)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._assign_target(e, value, line)
+
+    def _attr_source(self, v: Optional[ast.AST]) -> Optional[str]:
+        """The ``self`` attribute a local's value derives from, or None.
+        An awaited value is fresh by construction (it was produced after
+        the suspension)."""
+        if v is None or isinstance(v, ast.Await):
+            return None
+        if _is_self_attr(v):
+            return v.attr
+        if isinstance(v, ast.Attribute):
+            return self._attr_source(v.value)
+        if isinstance(v, ast.Subscript):
+            return self._attr_source(v.value)
+        if isinstance(v, ast.Call):
+            f = v.func
+            if (
+                isinstance(f, ast.Attribute)
+                and _is_self_attr(f.value)
+                and f.attr not in _MUTATORS
+            ):
+                return f.value.attr
+            return None
+        if isinstance(v, ast.BinOp):
+            return self._attr_source(v.left) or self._attr_source(v.right)
+        return None
+
+
+class AtomicityPass(Pass):
+    name = "atomicity"
+    rules = {
+        "await-atomicity": (
+            "read-modify-write on actor state straddles a suspension "
+            "point without re-validation (check-then-act across an "
+            "await) — the outcome depends on fiber dispatch order"
+        ),
+        "await-aliasing": (
+            "mutable actor-owned container handed by reference to "
+            "another actor/callback across a turn boundary — the "
+            "receiver sees future mutations; pass a snapshot"
+        ),
+        "await-iteration": (
+            "iteration over an actor-owned container spans a suspension "
+            "that can mutate it mid-loop — iterate a snapshot "
+            "(list(...)/sorted(...))"
+        ),
+    }
+
+    examples = {
+        "await-atomicity": {
+            "trip": (
+                "from openr_tpu.common.runtime import Actor\n"
+                "\n"
+                "class Cache(Actor):\n"
+                "    async def lookup(self, key):\n"
+                "        if key not in self._entries:\n"
+                "            value = await self._fetch(key)\n"
+                "            self._entries[key] = value\n"
+                "        return self._entries[key]\n"
+            ),
+            "fix": (
+                "from openr_tpu.common.runtime import Actor\n"
+                "\n"
+                "class Cache(Actor):\n"
+                "    async def lookup(self, key):\n"
+                "        if key not in self._entries:\n"
+                "            value = await self._fetch(key)\n"
+                "            if key not in self._entries:\n"
+                "                self._entries[key] = value\n"
+                "        return self._entries[key]\n"
+            ),
+        },
+        "await-aliasing": {
+            "trip": (
+                "from openr_tpu.common.runtime import Actor\n"
+                "\n"
+                "class Publisher(Actor):\n"
+                "    def __init__(self, updates_q):\n"
+                "        self._routes = {}\n"
+                "        self._q = updates_q\n"
+                "\n"
+                "    def publish(self):\n"
+                "        self._q.push(self._routes)\n"
+            ),
+            "fix": (
+                "from openr_tpu.common.runtime import Actor\n"
+                "\n"
+                "class Publisher(Actor):\n"
+                "    def __init__(self, updates_q):\n"
+                "        self._routes = {}\n"
+                "        self._q = updates_q\n"
+                "\n"
+                "    def publish(self):\n"
+                "        self._q.push(dict(self._routes))\n"
+            ),
+        },
+        "await-iteration": {
+            "trip": (
+                "from openr_tpu.common.runtime import Actor\n"
+                "\n"
+                "class Flusher(Actor):\n"
+                "    def __init__(self):\n"
+                "        self._pending = {}\n"
+                "\n"
+                "    async def flush(self):\n"
+                "        for key, value in self._pending.items():\n"
+                "            await self._send(key, value)\n"
+            ),
+            "fix": (
+                "from openr_tpu.common.runtime import Actor\n"
+                "\n"
+                "class Flusher(Actor):\n"
+                "    def __init__(self):\n"
+                "        self._pending = {}\n"
+                "\n"
+                "    async def flush(self):\n"
+                "        for key, value in sorted(self._pending.items()):\n"
+                "            await self._send(key, value)\n"
+            ),
+        },
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if not mod.is_protocol_plane():
+            return []
+        summary = mod.summary()
+        if not summary.classes:
+            return []
+        proj = project(ctx)
+        actors = proj.subclasses_of("Actor")
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            # the Actor base itself IS the scheduler — its bookkeeping is
+            # the turn machinery, not a turn
+            if node.name not in actors or node.name == "Actor":
+                continue
+            findings.extend(self._check_class(mod, proj, summary, node))
+        findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return findings
+
+    # -- per-class ---------------------------------------------------------
+
+    def _check_class(self, mod: ParsedModule, proj: Project,
+                     summary: ModuleSummary,
+                     cls: ast.ClassDef) -> List[Finding]:
+        out: List[Finding] = []
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fn_info = summary.functions.get(f"{cls.name}.{item.name}")
+            scan = _TurnScan(self, mod, proj, summary, fn_info)
+            if isinstance(item, ast.AsyncFunctionDef):
+                scan.scan(item.body)
+            out.extend(scan.findings)
+            out.extend(self._check_aliasing(mod, proj, cls.name, item, scan))
+        return out
+
+    # -- await-aliasing ----------------------------------------------------
+
+    def _check_aliasing(self, mod: ParsedModule, proj: Project,
+                        cls_name: str, fn: ast.AST,
+                        scan: _TurnScan) -> List[Finding]:
+        out: List[Finding] = []
+        awaited_calls = {
+            id(n.value)
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)
+        }
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            is_self_method = isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name
+            ) and f.value.id == "self"
+            handoff = (
+                isinstance(f, ast.Attribute)
+                and f.attr in _HANDOFF_METHODS
+            )
+            suspending_escape = (
+                id(call) in awaited_calls
+                and not is_self_method
+                and scan.call_suspends(call)
+            )
+            if not (handoff or suspending_escape):
+                continue
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                if not _is_self_attr(arg):
+                    continue
+                marker = proj.attr_type(cls_name, arg.attr)
+                if marker not in CONTAINER_MARKERS:
+                    continue
+                desc = ast.unparse(f) if hasattr(ast, "unparse") else "call"
+                snap = _SNAPSHOT_SPELLING.get(marker, "a copy")
+                verb = (
+                    "handed to the queue/callback"
+                    if handoff else "held across the suspension by"
+                )
+                out.append(mod.finding(
+                    "await-aliasing", arg,
+                    f"actor-owned {marker} `self.{arg.attr}` escapes by "
+                    f"reference — {verb} `{desc}(...)`, whose consumer "
+                    f"runs in a later turn and observes future mutations "
+                    f"instead of the handoff-time state; pass a snapshot "
+                    f"(`{snap.replace('...', f'self.{arg.attr}')}`)",
+                ))
+        return out
+
+    # -- await-iteration ---------------------------------------------------
+
+    def check_iteration(self, scan: _TurnScan,
+                        st: "ast.For | ast.AsyncFor") -> None:
+        attr = self._iterated_attr(st.iter)
+        if attr is None:
+            return
+        marker = scan.proj.attr_type(scan.fn_info.cls if scan.fn_info
+                                     else "", attr)
+        if marker not in CONTAINER_MARKERS:
+            return
+        susp = self._first_suspension(scan, st.body)
+        if susp is None:
+            return
+        scan._add(
+            "await-iteration", attr, st.lineno,
+            f"iterating actor-owned {marker} `self.{attr}` while the loop "
+            f"body suspends at line {susp} — another fiber may mutate it "
+            f"mid-iteration (RuntimeError, or a traversal that silently "
+            f"skews); iterate a snapshot: `list(self.{attr})` / "
+            f"`sorted(...)`",
+        )
+
+    @staticmethod
+    def _iterated_attr(it: ast.expr) -> Optional[str]:
+        if _is_self_attr(it):
+            return it.attr
+        if (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr in _DICT_VIEWS
+            and _is_self_attr(it.func.value)
+            and not it.args
+        ):
+            return it.func.value.attr
+        return None
+
+    def _first_suspension(self, scan: _TurnScan,
+                          stmts: Sequence[ast.stmt]) -> Optional[int]:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.AsyncFor, ast.AsyncWith)):
+                return st.lineno
+            for node in ast.walk(st):
+                if isinstance(node, (ast.AsyncFor, ast.AsyncWith)):
+                    return node.lineno
+                if isinstance(node, ast.Await):
+                    if not isinstance(node.value, ast.Call):
+                        return node.lineno
+                    if scan.call_suspends(node.value):
+                        return node.lineno
+        return None

@@ -1,8 +1,8 @@
 """Suppression comments for orlint.
 
-Two forms, both parsed from raw source lines (no tokenizer round-trip —
-a regex over each physical line is exact enough because the marker must
-live in a ``#`` comment to be legal Python on that line):
+Two forms, both parsed from the file's COMMENT tokens (a raw line scan
+would also match marker text quoted inside string literals — this very
+docstring would silently disable rules for this file):
 
 * line-level — a trailing comment on the *reported* line::
 
@@ -26,8 +26,10 @@ live in a ``#`` comment to be legal Python on that line):
 
 from __future__ import annotations
 
+import io
 import re
-from typing import Dict, Set
+import tokenize
+from typing import Dict, Iterable, Optional, Set, Tuple
 
 _LINE_RE = re.compile(r"#\s*orlint:\s*disable=([\w\-,* ]+)")
 _FILE_RE = re.compile(r"#\s*orlint:\s*disable-file=([\w\-,* ]+)")
@@ -39,13 +41,33 @@ def _parse_rules(blob: str) -> Set[str]:
     return {r.strip() for r in blob.split(",") if r.strip()}
 
 
+def _comment_lines(source: str) -> Optional[Set[int]]:
+    """Line numbers holding a real ``#`` comment token — the only places
+    a suppression marker is honored (marker text inside a string literal
+    is documentation, not a directive).  None when the source does not
+    tokenize (syntax errors, truncated fixtures): the caller falls back
+    to the permissive every-line scan rather than dropping suppressions
+    on the floor."""
+    try:
+        return {
+            tok.start[0]
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+            if tok.type == tokenize.COMMENT
+        }
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+
+
 class Suppressions:
     """Parsed suppression state for one file."""
 
     def __init__(self, source: str) -> None:
         self.file_rules: Set[str] = set()
         self.line_rules: Dict[int, Set[str]] = {}
+        comments = _comment_lines(source)
         for lineno, line in enumerate(source.splitlines(), start=1):
+            if comments is not None and lineno not in comments:
+                continue
             m = _FILE_RE.search(line)
             if m:
                 self.file_rules |= _parse_rules(m.group(1))
@@ -81,3 +103,79 @@ class Suppressions:
             int(k): set(v) for k, v in spec.get("lines", {}).items()
         }
         return self
+
+
+# ---------------------------------------------------------------------------
+# stale-suppression rewriting (--fix-stale-suppressions)
+# ---------------------------------------------------------------------------
+
+#: marker + its rule list, for narrowing a partially-stale marker
+_LINE_EDIT_RE = re.compile(r"(#\s*orlint:\s*disable=)([\w\-,* ]+)")
+_FILE_EDIT_RE = re.compile(r"(#\s*orlint:\s*disable-file=)([\w\-,* ]+)")
+#: the whole comment through end-of-line (justification included), for
+#: removing a fully-stale marker
+_LINE_STRIP_RE = re.compile(r"\s*#\s*orlint:\s*disable=[\w\-,* ]+.*$")
+_FILE_STRIP_RE = re.compile(r"\s*#\s*orlint:\s*disable-file=[\w\-,* ]+.*$")
+
+
+def _rewrite_marker(line: str, stale: Set[str], edit_re, strip_re):
+    """Drop ``stale`` rules from the marker on ``line``.  Returns the
+    rewritten line, or None when the line should be deleted (the marker
+    was the only thing on it)."""
+    m = edit_re.search(line)
+    if m is None:
+        return line
+    blob = m.group(2)
+    remaining = sorted(_parse_rules(blob) - stale)
+    if remaining:
+        # the rule-list charclass eats the gap before any justification —
+        # splice the narrowed list in front of the blob's own trailing
+        # whitespace so `=a,b (why)` narrows to `=a (why)`, not `=a(why)`
+        trail = blob[len(blob.rstrip()) :]
+        return (
+            line[: m.start(2)] + ",".join(remaining) + trail + line[m.end(2) :]
+        )
+    stripped = strip_re.sub("", line).rstrip()
+    return stripped if stripped.strip() else None
+
+
+def strip_stale(
+    source: str, entries: Iterable[Tuple[int, Iterable[str]]]
+) -> Tuple[str, int]:
+    """Rewrite ``source`` removing the stale rules named by ``entries``
+    ((marker line, stale rules); line 0 = the file-level form).  A marker
+    whose rule list empties out is removed whole — justification comment
+    included; a marker-only line is deleted.  Returns (new source, number
+    of markers edited)."""
+    line_stale: Dict[int, Set[str]] = {}
+    file_stale: Set[str] = set()
+    for lineno, rules in entries:
+        if lineno:
+            line_stale.setdefault(lineno, set()).update(rules)
+        else:
+            file_stale.update(rules)
+    comments = _comment_lines(source)
+    out = []
+    edited = 0
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        new_line = line
+        if comments is not None and lineno not in comments:
+            out.append(line)
+            continue
+        if file_stale and _FILE_EDIT_RE.search(line):
+            new_line = _rewrite_marker(
+                line, file_stale, _FILE_EDIT_RE, _FILE_STRIP_RE
+            )
+        elif lineno in line_stale:
+            new_line = _rewrite_marker(
+                line, line_stale[lineno], _LINE_EDIT_RE, _LINE_STRIP_RE
+            )
+        if new_line != line:
+            edited += 1
+            if new_line is None:
+                continue
+        out.append(new_line)
+    text = "\n".join(out)
+    if source.endswith("\n") and not text.endswith("\n"):
+        text += "\n"
+    return text, edited

@@ -25,14 +25,32 @@ from openr_tpu.chaos.controller import ChaosController
 from openr_tpu.chaos.invariants import InvariantChecker, InvariantViolation
 from openr_tpu.chaos.plan import Fault, FaultPlan
 from openr_tpu.chaos.rolling import RollingRestartSweep
+from openr_tpu.chaos.schedule import (
+    DivergenceReport,
+    SchedulePerturber,
+    ScheduleRun,
+    ScheduleSweep,
+    collect_replay_digests,
+    first_divergence,
+    run_schedules,
+    run_world,
+)
 from openr_tpu.chaos.supervisor import Supervisor
 
 __all__ = [
     "ChaosController",
+    "DivergenceReport",
     "Fault",
     "FaultPlan",
     "InvariantChecker",
     "InvariantViolation",
     "RollingRestartSweep",
+    "SchedulePerturber",
+    "ScheduleRun",
+    "ScheduleSweep",
     "Supervisor",
+    "collect_replay_digests",
+    "first_divergence",
+    "run_schedules",
+    "run_world",
 ]

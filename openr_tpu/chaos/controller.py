@@ -45,6 +45,9 @@ class ChaosController(Actor):
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        # a fault at plan-time T must cover everything that happens at T
+        # on every legal schedule — not race the tick's other fibers
+        self.clock.mark_prologue("chaos.plan")
         self.spawn(self._run_plan(), name="chaos.plan")
 
     async def _run_plan(self) -> None:

@@ -1,0 +1,297 @@
+"""Deterministic schedule-perturbation race detector (ISSUE 17 tentpole,
+dynamic half — docs/Robustness.md §schedule perturbation).
+
+Seeded replay (chaos/controller.py) proves one schedule reproduces
+byte-for-byte.  This module asks the stronger question the ROADMAP's
+sharded-emulation item needs answered: do the replay-sensitive digests
+depend on WHICH legal schedule ran?  A fiber wakeup order that differs
+between two hosts (or two worker shards) must not change kvstore
+contents, FIB routes, alert logs, or any content-addressed digest — if
+it does, some actor turn is order-dependent, which is exactly the bug
+class the static half (analysis/passes/atomicity.py) flags at the AST
+level.
+
+Mechanics: a :class:`SchedulePerturber` is a seeded RNG hooked into the
+two dispatch-order levers the runtime has —
+
+* ``SimClock.run_until`` wakes all sleepers due at the same virtual
+  instant in a seeded-permuted order instead of FIFO registration order
+  (``set_perturber``), and
+* ``ReplicateQueue.push`` replicates to readers in a seeded-permuted
+  order instead of registration order
+  (``messaging.queue.set_delivery_perturber``).
+
+Both permutations are pure functions of the seed: the whole system stays
+single-threaded and deterministic, so any divergence REPLAYS from its
+seed — the report is debuggable, not a flake.  The perturber also keeps
+a turn log (virtual time + fiber label of every wakeup it dispatched) so
+a digest divergence can be minimized to the first diverging actor turn.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import json
+import random
+import re
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional, Sequence, Tuple
+
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.messaging import queue as _queue_mod
+
+
+class SchedulePerturber:
+    """Seeded permuter of same-instant wakeups and queue deliveries.
+
+    One instance serves one run: its RNG consumption order is itself a
+    deterministic function of the run, so re-running with the same seed
+    reproduces the exact schedule (the divergence-replay contract)."""
+
+    def __init__(self, seed: int) -> None:
+        self.seed = seed
+        self._rng = random.Random(seed)
+        #: (virtual time, fiber label) of every wakeup dispatched, in
+        #: dispatch order — the actor-turn log divergences minimize to
+        self.turns: List[Tuple[float, str]] = []
+
+    # -- SimClock hook -----------------------------------------------------
+
+    def order_wakeups(self, batch: List) -> List:
+        """Permute one same-instant wakeup batch (heap entries)."""
+        if len(batch) > 1:
+            self._rng.shuffle(batch)
+        return batch
+
+    def note_turn(self, t: float, label: str) -> None:
+        self.turns.append((t, label))
+
+    # -- ReplicateQueue hook -----------------------------------------------
+
+    def order_deliveries(self, readers: List) -> List:
+        """Permute the reader delivery order of one push."""
+        self._rng.shuffle(readers)
+        return readers
+
+    def nearest_turn(self, t: float) -> Optional[Tuple[float, str]]:
+        """Last dispatched turn at or before virtual time ``t``."""
+        if not self.turns:
+            return None
+        times = [x[0] for x in self.turns]
+        i = bisect.bisect_right(times, t)
+        return self.turns[i - 1] if i else self.turns[0]
+
+
+# ---------------------------------------------------------------------------
+# replay-digest collection
+# ---------------------------------------------------------------------------
+
+
+def _canon(doc) -> bytes:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+
+
+def _value_wire(val) -> Dict:
+    wire = val.to_wire()
+    # Remaining TTL decrements per flood hop, so it records which flood
+    # path won the race to this node — transport metadata, not replicated
+    # content.  The LSDB convergence invariant (chaos/invariants.py
+    # lsdb_digest) already excludes it for the same reason.
+    wire.pop("ttl", None)
+    return wire
+
+
+def collect_replay_digests(net) -> Dict[str, bytes]:
+    """The replay-sensitive artifacts of one EmulatedNetwork run, keyed
+    by artifact name, as canonical bytes.  Byte-equality across perturbed
+    schedules is the acceptance bar; each artifact is line-oriented so a
+    mismatch minimizes to a first diverging line."""
+    out: Dict[str, bytes] = {}
+    for name, node in sorted(net.nodes.items()):
+        dump = {
+            area: {
+                key: _value_wire(val)
+                for key, val in sorted(db.dump_all().items())
+            }
+            for area, db in sorted(node.kv_store.areas.items())
+        }
+        out[f"kvstore/{name}"] = b"\n".join(
+            _canon({k: v}) for a in sorted(dump) for k, v in dump[a].items()
+        )
+        out[f"fib/{name}"] = _canon(net.fib_routes(name))
+    for name, log in net.health_alert_logs().items():
+        out[f"alerts/{name}"] = log
+    for name, stats in net.streaming_stats().items():
+        out[f"streaming/{name}"] = _canon(stats)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the K-schedule sweep harness
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScheduleRun:
+    """One world execution under one schedule (seed None = canonical)."""
+
+    seed: Optional[int]
+    digests: Dict[str, bytes]
+    turns: List[Tuple[float, str]] = field(default_factory=list)
+
+
+@dataclass
+class DivergenceReport:
+    """A schedule-order dependence, minimized to its first symptom."""
+
+    seed: int
+    artifact: str
+    line_index: int
+    baseline_line: str
+    perturbed_line: str
+    #: (virtual time, fiber label) of the last perturbed-run wakeup at or
+    #: before the diverging artifact line's timestamp (None when the
+    #: artifact carries no parseable time)
+    turn: Optional[Tuple[float, str]]
+
+    def render(self) -> str:
+        lines = [
+            f"schedule divergence under perturbation seed {self.seed}",
+            f"  artifact : {self.artifact} (first diverging line "
+            f"{self.line_index})",
+            f"  baseline : {self.baseline_line or '<absent>'}",
+            f"  perturbed: {self.perturbed_line or '<absent>'}",
+        ]
+        if self.turn is not None:
+            t, label = self.turn
+            lines.append(
+                f"  first diverging actor turn: t={t:g} fiber={label or '?'}"
+            )
+        lines.append(
+            f"  replay: rerun the world with SchedulePerturber"
+            f"(seed={self.seed}) — the schedule is deterministic"
+        )
+        return "\n".join(lines)
+
+
+#: timestamp spellings inside artifact lines, tried in order: millisecond
+#: JSON keys ("ts_ms"/"t0_ms"/...: 1500 — alert logs, trace spans), then
+#: second-granularity JSON keys ("t"/"ts"/"time": 1.5) and bare "t=1.5"
+_TIME_MS_RE = re.compile(
+    r'"(?:ts_ms|t0_ms|time_ms|unix_ts_ms)":\s*(-?\d+(?:\.\d+)?)'
+)
+_TIME_RE = re.compile(r'(?:"(?:t|ts|time)":\s*|\bt=)(-?\d+(?:\.\d+)?)')
+
+
+def _line_time(line: str) -> Optional[float]:
+    m = _TIME_MS_RE.search(line)
+    if m:
+        return float(m.group(1)) / 1000.0
+    m = _TIME_RE.search(line)
+    return float(m.group(1)) if m else None
+
+
+def first_divergence(
+    baseline: ScheduleRun, perturbed: ScheduleRun,
+    perturber: Optional[SchedulePerturber] = None,
+) -> Optional[DivergenceReport]:
+    """Compare two runs' digests; minimize the first mismatch to a line
+    and (when the artifact carries timestamps) to the nearest actor turn
+    of the perturbed schedule."""
+    names = sorted(set(baseline.digests) | set(perturbed.digests))
+    for name in names:
+        a = baseline.digests.get(name, b"")
+        b = perturbed.digests.get(name, b"")
+        if a == b:
+            continue
+        a_lines = a.decode(errors="replace").splitlines()
+        b_lines = b.decode(errors="replace").splitlines()
+        idx = 0
+        for idx in range(max(len(a_lines), len(b_lines))):
+            la = a_lines[idx] if idx < len(a_lines) else ""
+            lb = b_lines[idx] if idx < len(b_lines) else ""
+            if la != lb:
+                break
+        la = a_lines[idx] if idx < len(a_lines) else ""
+        lb = b_lines[idx] if idx < len(b_lines) else ""
+        turn = None
+        if perturber is not None:
+            t = _line_time(lb) or _line_time(la)
+            if t is not None:
+                turn = perturber.nearest_turn(t)
+            elif perturber.turns:
+                turn = perturber.turns[-1]
+        return DivergenceReport(
+            seed=perturbed.seed if perturbed.seed is not None else -1,
+            artifact=name,
+            line_index=idx,
+            baseline_line=la,
+            perturbed_line=lb,
+            turn=turn,
+        )
+    return None
+
+
+@dataclass
+class ScheduleSweep:
+    baseline: ScheduleRun
+    runs: List[ScheduleRun]
+    divergences: List[DivergenceReport]
+
+    @property
+    def identical(self) -> bool:
+        return not self.divergences
+
+    def render(self) -> str:
+        if self.identical:
+            return (
+                f"{len(self.runs)} perturbed schedule(s): all replay "
+                f"digests byte-identical to the canonical schedule"
+            )
+        return "\n\n".join(d.render() for d in self.divergences)
+
+
+World = Callable[[SimClock], Awaitable[Dict[str, bytes]]]
+
+
+def run_world(world: World, seed: Optional[int]) -> ScheduleRun:
+    """Execute ``world`` on a fresh loop + SimClock under one schedule.
+    ``world`` drives the clock itself and returns its replay digests."""
+    clock = SimClock()
+    perturber: Optional[SchedulePerturber] = None
+    if seed is not None:
+        perturber = SchedulePerturber(seed)
+        clock.set_perturber(perturber)
+        _queue_mod.set_delivery_perturber(perturber)
+    loop = asyncio.new_event_loop()
+    try:
+        digests = loop.run_until_complete(world(clock))
+    finally:
+        _queue_mod.set_delivery_perturber(None)
+        loop.close()
+    return ScheduleRun(
+        seed=seed,
+        digests=digests,
+        turns=list(perturber.turns) if perturber is not None else [],
+    )
+
+
+def run_schedules(world: World, seeds: Sequence[int]) -> ScheduleSweep:
+    """The race detector: run ``world`` under the canonical schedule and
+    under one perturbed schedule per seed; require byte-identical replay
+    digests; minimize any mismatch to its first diverging actor turn."""
+    baseline = run_world(world, None)
+    runs: List[ScheduleRun] = []
+    divergences: List[DivergenceReport] = []
+    for seed in seeds:
+        perturber_probe = SchedulePerturber(seed)  # for nearest_turn only
+        run = run_world(world, seed)
+        perturber_probe.turns = run.turns
+        runs.append(run)
+        report = first_divergence(baseline, run, perturber_probe)
+        if report is not None:
+            divergences.append(report)
+    return ScheduleSweep(
+        baseline=baseline, runs=runs, divergences=divergences
+    )

@@ -33,6 +33,24 @@ class Clock:
     async def sleep(self, delay: float) -> None:
         raise NotImplementedError
 
+    def mark_observer(self, label: str) -> None:
+        """Declare the fiber named `label` an OBSERVER: a sampler that
+        reads cross-module state without feeding the protocol plane
+        (monitoring sweeps).  On SimClock, observer wakeups dispatch
+        after every same-instant mutator wakeup, so what a sampler sees
+        at virtual time T is the settled post-T state on EVERY legal
+        schedule — not whichever side of a same-tick race the dispatch
+        order happened to land on.  No-op on wall clocks, where ties
+        have no deterministic order to begin with."""
+
+    def mark_prologue(self, label: str) -> None:
+        """Declare the fiber named `label` a PROLOGUE: an environment
+        driver (fault injection) whose effects at virtual time T must
+        apply to ALL of tick T.  On SimClock, prologue wakeups dispatch
+        before every same-instant mutator wakeup — a fault injected at T
+        covers a packet sent at T on every legal schedule, never "did
+        the fault fiber happen to run first".  No-op on wall clocks."""
+
 
 class WallClock(Clock):
     def now(self) -> float:
@@ -57,6 +75,26 @@ class SimClock(Clock):
         self._heap: List = []
         self._seq = itertools.count()
         self.activity = 0  # bumped by sleepers waking; used for quiescing
+        #: optional schedule perturber (openr_tpu.chaos.schedule): when
+        #: installed, same-instant wakeups dispatch in a seeded-permuted
+        #: order instead of FIFO registration order — the race detector's
+        #: lever.  None = canonical schedule, byte-for-byte as before.
+        self._perturber = None
+        #: fiber labels whose wakeups defer past every same-instant
+        #: mutator wakeup (Clock.mark_observer)
+        self._observer_labels: set = set()
+        #: fiber labels whose wakeups precede every same-instant mutator
+        #: wakeup (Clock.mark_prologue)
+        self._prologue_labels: set = set()
+
+    def set_perturber(self, perturber) -> None:
+        self._perturber = perturber
+
+    def mark_observer(self, label: str) -> None:
+        self._observer_labels.add(label)
+
+    def mark_prologue(self, label: str) -> None:
+        self._prologue_labels.add(label)
 
     def now(self) -> float:
         return self._now
@@ -66,7 +104,11 @@ class SimClock(Clock):
             await asyncio.sleep(0)
             return
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        heapq.heappush(self._heap, (self._now + delay, next(self._seq), fut))
+        task = asyncio.current_task()
+        label = task.get_name() if task is not None else ""
+        heapq.heappush(
+            self._heap, (self._now + delay, next(self._seq), label, fut)
+        )
         await fut
 
     async def _settle(self) -> None:
@@ -83,20 +125,62 @@ class SimClock(Clock):
     async def run_until(self, deadline: float) -> None:
         await self._settle()
         while self._heap and self._heap[0][0] <= deadline:
-            t, _, fut = heapq.heappop(self._heap)
-            self._now = max(self._now, t)
-            if not fut.done():
-                self.activity += 1
-                fut.set_result(None)
-            await self._settle()
+            # All wakeups due at the same virtual instant form one batch:
+            # mutators dispatch first (registration order canonically,
+            # seeded-permuted under a perturber); observer-labelled fibers
+            # (mark_observer) defer until no mutator wakeup remains at
+            # this instant, so a monitoring sweep at T samples the settled
+            # post-T state on every legal schedule.  Fibers re-arming at
+            # the same instant join the next batch before time advances.
+            t0 = self._heap[0][0]
+            self._now = max(self._now, t0)
+            observers: List = []
+            while True:
+                prologue: List = []
+                batch: List = []
+                while self._heap and self._heap[0][0] == t0:
+                    entry = heapq.heappop(self._heap)
+                    if entry[2] in self._observer_labels:
+                        observers.append(entry)
+                    elif entry[2] in self._prologue_labels:
+                        prologue.append(entry)
+                    else:
+                        batch.append(entry)
+                if not prologue and not batch:
+                    break
+                # prologue fibers (fault injectors) run first, label-
+                # ordered and unperturbed — their effects at t0 cover
+                # every mutator wakeup at t0 on every legal schedule
+                prologue.sort(key=lambda e: e[2])
+                await self._dispatch(prologue, perturb=False)
+                await self._dispatch(batch)
+            # Observers dispatch label-ordered and are NEVER perturbed:
+            # their relative order vs mutators is pinned (after), and
+            # label order pins sampler-vs-sampler (a health sweep never
+            # sees this tick's watchdog crash on any schedule).
+            observers.sort(key=lambda e: e[2])
+            await self._dispatch(observers, perturb=False)
         self._now = max(self._now, deadline)
         await self._settle()
+
+    async def _dispatch(self, batch: List, perturb: bool = True) -> None:
+        """Wake one batch, one settle round per wakeup (same cadence as
+        the original single-pop dispatch)."""
+        if perturb and self._perturber is not None:
+            batch = self._perturber.order_wakeups(batch)
+        for t, _, label, fut in batch:
+            if not fut.done():
+                self.activity += 1
+                if self._perturber is not None:
+                    self._perturber.note_turn(t, label)
+                fut.set_result(None)
+            await self._settle()
 
     async def run_for(self, duration: float) -> None:
         await self.run_until(self._now + duration)
 
     def pending_timers(self) -> int:
-        return sum(1 for _, _, f in self._heap if not f.done())
+        return sum(1 for _, _, _, f in self._heap if not f.done())
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,32 @@ def write_frame(writer: asyncio.StreamWriter, obj: dict) -> None:
     writer.write(len(payload).to_bytes(4, "big") + payload)
 
 
+async def drain_bounded(writer: asyncio.StreamWriter) -> None:
+    """``writer.drain()`` bounded by STREAM_DRAIN_TIMEOUT_S.
+
+    Deliberately NOT ``asyncio.wait_for``: on Python < 3.12 wait_for
+    swallows task cancellation when the inner future completes in the
+    same event-loop pass (bpo-42130).  Every stream write suspends here
+    for at least one pass, and a watch client that reads an emission and
+    disconnects lands the connection task's EOF-cancel in exactly that
+    window — the lost cancellation left the stream's request task parked
+    in its long-poll forever, leaking the subscriber (and its quota)
+    until the server shut down.  ``asyncio.wait`` re-raises cancellation
+    unconditionally, so the race cannot eat it."""
+    fut = asyncio.ensure_future(writer.drain())
+    try:
+        done, _ = await asyncio.wait({fut}, timeout=STREAM_DRAIN_TIMEOUT_S)
+    except asyncio.CancelledError:
+        fut.cancel()
+        raise
+    if not done:
+        fut.cancel()
+        raise asyncio.TimeoutError(
+            f"drain stalled beyond {STREAM_DRAIN_TIMEOUT_S}s"
+        )
+    fut.result()  # surface ConnectionError/BrokenPipeError as before
+
+
 class OpenrCtrlServer:
     """Serves one node's OpenrCtrlHandler on a TCP port, optionally over
     TLS (reference: thrift-over-TLS via wangle, Main.cpp:399-416 — here
@@ -141,9 +167,7 @@ class OpenrCtrlServer:
                     async for item in result:
                         async with lock:
                             write_frame(writer, {"id": rid, "stream": item})
-                            await asyncio.wait_for(
-                                writer.drain(), STREAM_DRAIN_TIMEOUT_S
-                            )
+                            await drain_bounded(writer)
                     async with lock:
                         write_frame(writer, {"id": rid, "done": True})
                         await writer.drain()

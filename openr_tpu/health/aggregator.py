@@ -514,6 +514,9 @@ class HealthMonitor(Actor):
         self._interval = interval_s
 
     def start(self) -> None:
+        # the sweep is a pure sampler: run it after every same-instant
+        # mutator so what it observes at T is schedule-independent
+        self.clock.mark_observer("health.sweeps")
         self.spawn(self._sweep_fiber(), name="health.sweeps")
 
     async def _sweep_fiber(self) -> None:

@@ -102,9 +102,14 @@ class LinkMonitor(Actor):
         from openr_tpu.tracing import disabled_tracer
 
         self.tracer = tracer if tracer is not None else disabled_tracer()
-        #: context of the most recent traced event awaiting the throttled
+        #: context of the EARLIEST traced event awaiting the throttled
         #: adjacency advertisement (the advertisement is the span that
-        #: hands the trace to KvStore)
+        #: hands the trace to KvStore).  Earliest — not most recent: when
+        #: several events coalesce into one advertisement, last-writer-
+        #: wins would embed whichever event's fiber happened to run last
+        #: into the flooded value bytes (schedule-dependent LSDB hash);
+        #: picking min (t0_ms, trace_id) is order-free, and the earliest
+        #: cause is the right start for the convergence clock anyway.
         self._pending_trace_ctx = None
         self.node_name = node_name
         self.config = config
@@ -209,11 +214,11 @@ class LinkMonitor(Actor):
         ):
             # trace origin: an interface state change (netlink event or
             # platform sync delta) starts a convergence clock
-            self._pending_trace_ctx = self.tracer.start_trace(
+            self._note_pending_ctx(self.tracer.start_trace(
                 f"link_monitor.interface_{'up' if info.is_up else 'down'}",
                 module="link_monitor",
                 if_name=info.if_name,
-            )
+            ))
         if entry is None:
             entry = InterfaceEntry(
                 info=info,
@@ -264,6 +269,16 @@ class LinkMonitor(Actor):
 
     # -- neighbor events (LinkMonitor.h:176) -------------------------------
 
+    def _note_pending_ctx(self, ctx) -> None:
+        """Fold one traced cause into the pending advertisement's context
+        by min (t0_ms, trace_id) — deterministic under any processing
+        order of same-instant events."""
+        if ctx is None:
+            return
+        cur = self._pending_trace_ctx
+        if cur is None or (ctx.t0_ms, ctx.trace_id) < (cur.t0_ms, cur.trace_id):
+            self._pending_trace_ctx = ctx
+
     def _on_neighbor_event(self, ev: NeighborEvent) -> None:
         if ev.trace_ctx is not None:
             span = self.tracer.instant(
@@ -273,9 +288,9 @@ class LinkMonitor(Actor):
                 event=ev.event_type.name,
                 neighbor=ev.node_name,
             )
-            self._pending_trace_ctx = self.tracer.child_ctx(
+            self._note_pending_ctx(self.tracer.child_ctx(
                 span, ev.trace_ctx
-            )
+            ))
         key = (ev.area, ev.node_name, ev.local_if_name)
         if ev.event_type == NeighborEventType.NEIGHBOR_UP:
             self.adjacencies[key] = AdjacencyEntry(

@@ -16,6 +16,17 @@ from typing import Any, Callable, Deque, Generic, List, Optional, TypeVar
 
 T = TypeVar("T")
 
+#: optional schedule perturber (openr_tpu.chaos.schedule): when installed,
+#: ReplicateQueue.push delivers to readers in a seeded-permuted order
+#: instead of registration order — same-tick delivery jitter for the race
+#: detector.  None = canonical order, byte-for-byte as before.
+_delivery_perturber = None
+
+
+def set_delivery_perturber(perturber) -> None:
+    global _delivery_perturber
+    _delivery_perturber = perturber
+
 
 class QueueClosedError(RuntimeError):
     """Raised from get() once a closed queue has fully drained."""
@@ -192,7 +203,10 @@ class ReplicateQueue(Generic[T]):
             return 0
         self.num_writes += 1
         n = 0
-        for q in self._readers:
+        readers = self._readers
+        if _delivery_perturber is not None and len(readers) > 1:
+            readers = _delivery_perturber.order_deliveries(list(readers))
+        for q in readers:
             if q.push(item):
                 n += 1
         return n

@@ -20,6 +20,7 @@ import json
 import socket as pysocket
 import struct
 import sys
+import zlib
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
 from openr_tpu.common.runtime import Actor, Clock
@@ -50,8 +51,6 @@ class MockIoProvider(IoProvider):
     """
 
     def __init__(self, clock: Clock) -> None:
-        import random
-
         self.clock = clock
         self._receivers: Dict[str, RecvCallback] = {}
         # (node, if) -> [(peer_node, peer_if, latency_s)]
@@ -62,9 +61,13 @@ class MockIoProvider(IoProvider):
         self._loss: Dict[Tuple[str, str], float] = {}
         #: nodes whose packets are dropped in BOTH directions (spark_drop)
         self._muted: set = set()
-        #: loss coin — seeded by the chaos controller so a SimClock run's
-        #: drop pattern replays exactly from one seed
-        self._loss_rng = random.Random(0)
+        #: loss-coin salt — seeded by the chaos controller.  The coin is a
+        #: hash of (salt, src, dst, virtual time, payload), NOT a stateful
+        #: RNG draw: a shared RNG stream is consumed in packet-SEND order,
+        #: so which packets die would depend on fiber dispatch order and
+        #: the drop pattern would differ between legal schedules of the
+        #: same seed (caught by the chaos schedule-perturbation sweep).
+        self._loss_salt = b"0"
         self.packets_sent = 0
         self.packets_delivered = 0
         self.packets_dropped = 0
@@ -101,9 +104,16 @@ class MockIoProvider(IoProvider):
     # -- chaos hooks (openr_tpu.chaos) ------------------------------------
 
     def seed_loss_rng(self, seed: int) -> None:
-        import random
+        self._loss_salt = str(seed).encode()
 
-        self._loss_rng = random.Random(seed)
+    def _loss_coin(self, src: str, dst: str, payload: dict) -> float:
+        """Uniform [0,1) coin that is a pure function of the packet: the
+        same packet gets the same verdict on every legal schedule."""
+        blob = json.dumps(
+            [src, dst, self.clock.now(), payload],
+            sort_keys=True, default=str,
+        ).encode()
+        return zlib.crc32(self._loss_salt + blob) / 2**32
 
     def set_loss(self, src: str, dst: str, prob: float) -> None:
         """Drop src->dst packets with probability `prob` (0 clears);
@@ -132,7 +142,9 @@ class MockIoProvider(IoProvider):
                 self.packets_dropped += 1
                 continue
             loss = self._loss.get((node, peer_node))
-            if loss is not None and self._loss_rng.random() < loss:
+            if loss is not None and self._loss_coin(
+                node, peer_node, payload
+            ) < loss:
                 self.packets_dropped += 1
                 continue
             self._pump.spawn(

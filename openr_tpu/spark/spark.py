@@ -243,7 +243,15 @@ class Spark(Actor):
         #: the ctrl port we advertise in handshakes — neighbors' KvStore
         #: transports dial it, so it must be the actually-bound port
         self.ctrl_port = ctrl_port if ctrl_port else C.OPENR_CTRL_PORT
-        self.my_seq_num = 0
+        #: hello sequence PER INTERFACE, not node-global: each interface's
+        #: hello/heartbeat fiber advances only its own stream, so the seq
+        #: a packet carries is a pure function of that interface's send
+        #: history — a node-global counter is bumped by sibling-interface
+        #: fibers in dispatch order, making wire bytes (and everything
+        #: downstream of a seeded loss coin over them) schedule-dependent.
+        #: Keyed by if_name on the actor (not the tracked entry) so the
+        #: stream stays monotonic across interface flaps.
+        self.my_seq_num: Dict[str, int] = {}
         self.interfaces: Dict[str, _TrackedInterface] = {}
         #: if_name -> {neighbor_name -> SparkNeighbor}
         self.neighbors: Dict[str, Dict[str, SparkNeighbor]] = {}
@@ -384,7 +392,7 @@ class Spark(Actor):
                     _pack(
                         SparkHeartbeatMsg(
                             node_name=self.node_name,
-                            seq_num=self.my_seq_num,
+                            seq_num=self.my_seq_num.get(if_name, 0),
                             hold_time_ms=int(self.config.hold_time_s * 1000),
                             adj_only_used_by_other_node=self.adj_hold,
                         )
@@ -396,7 +404,7 @@ class Spark(Actor):
     ) -> None:
         if if_name not in self.interfaces:
             return
-        self.my_seq_num += 1
+        self.my_seq_num[if_name] = self.my_seq_num.get(if_name, 0) + 1
         infos: Dict[str, ReflectedNeighborInfo] = {}
         for neighbor in self.neighbors.get(if_name, {}).values():
             if neighbor.state == SparkNeighState.IDLE:
@@ -409,7 +417,7 @@ class Spark(Actor):
         msg = SparkHelloMsg(
             node_name=self.node_name,
             if_name=if_name,
-            seq_num=self.my_seq_num,
+            seq_num=self.my_seq_num[if_name],
             neighbor_infos=infos,
             solicit_response=solicit,
             restarting=restarting or self._restarting,
@@ -662,8 +670,14 @@ class Spark(Actor):
             neighbor.seq_num = msg.seq_num
             if ts is None:
                 return  # neighbor doesn't see us yet
-            # guard against hellos reflecting our previous incarnation
-            if ts.seq_num >= self.my_seq_num:
+            # guard against hellos reflecting our previous incarnation.
+            # Strict: a current-incarnation reflection can at most equal
+            # the last seq we sent on this interface (we increment before
+            # sending), so only a *greater* reflected seq is stale.  With
+            # ``>=`` a peer echoing our latest hello — the steady-state
+            # case once fast-init's solicited bumps stop — would park us
+            # in WARM until hello phase happened to drift.
+            if ts.seq_num > self.my_seq_num.get(if_name, 0):
                 return
             self._start_negotiation(if_name, neighbor)
             self._transition(neighbor, SparkNeighEvent.HELLO_RCVD_INFO)

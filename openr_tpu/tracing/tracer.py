@@ -11,8 +11,13 @@ FSM transition to the Fib programming ack — inspectable via the ctrl API
 (`get_traces`), `breeze monitor trace`, or a Perfetto export.
 
 Design constraints:
-  * deterministic: ids come from a per-tracer sequence, timestamps from
-    the injected Clock — SimClock tests replay identical traces;
+  * deterministic: trace ids are derived from the minting event's content
+    (node, event, virtual time, attrs) and span ids from a per-trace
+    sequence — never from a node-global mint counter, whose value would
+    depend on how concurrent traces interleave.  Ids ride TraceContext
+    into flooded KvStore values, so they must replay identically under
+    ANY fiber schedule, not just the canonical one (the chaos
+    schedule-perturbation sweep enforces this byte-for-byte);
   * bounded: completed spans live in a fixed ring (evictions counted),
     spans opened but never closed are evicted past a cap and counted as
     `trace.dropped_spans` (the chaos invariant: drops stay bounded);
@@ -23,7 +28,8 @@ Design constraints:
 
 from __future__ import annotations
 
-import itertools
+import json
+import zlib
 from collections import OrderedDict, deque
 from typing import Any, Deque, Dict, List, Optional
 
@@ -122,7 +128,9 @@ class _SpanScope:
 
 class Tracer:
     """Per-node span recorder.  All timing goes through the injected
-    Clock; all ids come from a local sequence (deterministic replay)."""
+    Clock; all ids are content-derived (hash of event + virtual time +
+    attrs, with per-trace span counters), so two runs that record the
+    same spans mint the same ids regardless of interleaving."""
 
     def __init__(
         self,
@@ -143,7 +151,15 @@ class Tracer:
         self.max_open_spans = max_open_spans
         self._done: Deque[Span] = deque()
         self._open: "OrderedDict[str, Span]" = OrderedDict()
-        self._seq = itertools.count(1)
+        #: per-trace span counters (LRU-bounded): span ids must NOT come
+        #: from a node-global sequence — concurrent traces interleave
+        #: their allocations there, so the ids (which ride TraceContext
+        #: into flooded kvstore values) would depend on fiber dispatch
+        #: order.  A per-trace counter follows only the trace's own
+        #: causal chain, which replays identically under any schedule.
+        self._span_seq: "OrderedDict[str, int]" = OrderedDict()
+        #: minted trace ids (LRU-bounded) for collision disambiguation
+        self._minted: "OrderedDict[str, int]" = OrderedDict()
         self.num_completed = 0
         #: open spans evicted unfinished — the leak/overload signal the
         #: chaos invariant bounds
@@ -154,8 +170,30 @@ class Tracer:
 
     # -- mint / record -----------------------------------------------------
 
-    def _next_id(self) -> str:
-        return f"{self.node_name}:{next(self._seq)}"
+    def _mint_trace_id(self, event: str, attrs: Dict[str, Any]) -> str:
+        """Trace identity = the minting event's content, so a trace gets
+        the same id on every legal schedule (and on every shard of a
+        replayed run).  Distinct same-content events at the same virtual
+        instant are indistinguishable, so the collision suffix is
+        order-free too."""
+        blob = json.dumps(
+            [event, self.clock.now_ms(), attrs], sort_keys=True, default=repr
+        )
+        tid = f"{self.node_name}:{zlib.crc32(blob.encode()):08x}"
+        n = self._minted.get(tid, 0) + 1
+        self._minted[tid] = n
+        self._minted.move_to_end(tid)
+        while len(self._minted) > self.max_spans:
+            self._minted.popitem(last=False)
+        return tid if n == 1 else f"{tid}.{n}"
+
+    def _next_span_id(self, trace_id: str) -> str:
+        n = self._span_seq.get(trace_id, 0) + 1
+        self._span_seq[trace_id] = n
+        self._span_seq.move_to_end(trace_id)
+        while len(self._span_seq) > self.max_spans:
+            self._span_seq.popitem(last=False)
+        return f"{trace_id}.{self.node_name}.{n}"
 
     def start_trace(
         self, event: str, module: str = "", **attrs: Any
@@ -166,7 +204,7 @@ class Tracer:
         if not self.enabled:
             return None
         now = self.clock.now() * 1000.0
-        sid = self._next_id()
+        sid = self._mint_trace_id(event, attrs)
         span = Span(event, sid, sid, "", self.node_name, module, now, attrs)
         span.end_ms = now
         self._finish(span)
@@ -188,9 +226,13 @@ class Tracer:
         """Open a span under `ctx` (fresh trace when ctx is None)."""
         if not self.enabled:
             return NOOP_SPAN
-        sid = self._next_id()
-        trace_id = ctx.trace_id if ctx is not None else sid
-        parent = ctx.span_id if ctx is not None else ""
+        if ctx is not None:
+            trace_id = ctx.trace_id
+            sid = self._next_span_id(trace_id)
+            parent = ctx.span_id
+        else:
+            sid = trace_id = self._mint_trace_id(name, attrs)
+            parent = ""
         span = Span(
             name, trace_id, sid, parent, self.node_name, module,
             self.clock.now() * 1000.0, attrs,

@@ -80,6 +80,12 @@ class Watchdog(Actor):
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        # the check is a sampler (heartbeat ages, queue depths, fiber
+        # death): run it after every same-instant mutator so a crash
+        # verdict at T is schedule-independent — a kill landing at the
+        # same tick is detected THIS sweep on every legal schedule,
+        # never "this sweep or next" by dispatch-order luck
+        self.clock.mark_observer("watchdog.loop")
         self.spawn(self._watch_fiber(), "watchdog.loop")
 
     async def _watch_fiber(self) -> None:

@@ -510,3 +510,40 @@ def test_handler_kvstore_depth_methods():
         await net.stop()
 
     run(main())
+
+
+def test_stream_drain_cancellation_not_swallowed():
+    """Cancelling the stream's request task in the same event-loop pass
+    where an emission's drain completes must still cancel it.
+    asyncio.wait_for swallows cancellation in exactly that window on
+    Python < 3.12 (bpo-42130), and a watch client that reads one
+    emission and disconnects lands the connection task's EOF-cancel
+    there — the lost cancellation parked the request task in its
+    long-poll forever, leaking the stream subscriber.  drain_bounded
+    must re-raise on every phasing of cancel vs drain completion."""
+
+    from openr_tpu.ctrl.server import drain_bounded
+
+    class _Writer:
+        async def drain(self):
+            return None
+
+    async def main():
+        for steps in (1, 2, 3):
+
+            async def use():
+                await drain_bounded(_Writer())
+                await asyncio.sleep(3600)  # the long-poll park
+
+            t = asyncio.ensure_future(use())
+            for _ in range(steps):
+                await asyncio.sleep(0)
+            t.cancel()
+            done, _ = await asyncio.wait({t}, timeout=2.0)
+            assert done, (
+                f"cancellation swallowed at phasing {steps}; "
+                "request task still parked"
+            )
+            assert t.cancelled(), f"phasing {steps}: {t}"
+
+    run(main())

@@ -19,13 +19,17 @@ import pytest
 
 from openr_tpu.analysis import (
     Baseline,
+    StaleSuppression,
     analyze_modules,
     analyze_source,
     build_project,
     default_baseline_path,
+    findings_from_sarif,
     load_modules,
+    render_sarif,
     repo_root,
 )
+from openr_tpu.analysis.suppress import strip_stale
 from openr_tpu.analysis.__main__ import main as orlint_main
 from openr_tpu.analysis.passes import all_rules, rule_example, rule_families
 from openr_tpu.analysis.passes.base import ParsedModule
@@ -201,6 +205,45 @@ FIXTURES = {
         "    return sorted(rows, key=id)\n",
         (),
         2,
+    ),
+    # -- await-atomicity family (ISSUE 17) ---------------------------------
+    "await-atomicity": (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "class Cache(Actor):\n"
+        "    async def lookup(self, key):\n"
+        "        if key not in self._entries:\n"
+        "            value = await self._fetch(key)\n"
+        "            self._entries[key] = value\n"
+        "        return self._entries[key]\n",
+        (),
+        7,
+    ),
+    "await-aliasing": (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "class Publisher(Actor):\n"
+        "    def __init__(self, updates_q):\n"
+        "        self._routes = {}\n"
+        "        self._q = updates_q\n"
+        "\n"
+        "    def publish(self):\n"
+        "        self._q.push(self._routes)\n",
+        (),
+        9,
+    ),
+    "await-iteration": (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "class Flusher(Actor):\n"
+        "    def __init__(self):\n"
+        "        self._pending = {}\n"
+        "\n"
+        "    async def flush(self):\n"
+        "        for key, value in self._pending.items():\n"
+        "            await self._send(key, value)\n",
+        (),
+        8,
     ),
 }
 
@@ -892,3 +935,247 @@ def test_module_entry_point():
         cwd=str(repo_root()),
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# stale-suppression audit (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _report_for(src, rules=None):
+    return analyze_modules([ParsedModule.parse("m.py", src)], rules=rules)
+
+
+def test_stale_suppression_detected_when_rule_never_fires():
+    """A marker naming a rule that does not fire on its line is dead
+    weight hiding future violations — the audit names it precisely."""
+    src = (
+        "import time\n"
+        "\n"
+        "def f():\n"
+        "    return time.monotonic()  # orlint: disable=clock-sleep (wrong rule)\n"
+    )
+    report = _report_for(src)
+    # the marker suppressed nothing: the clock-now finding survives
+    assert [f.rule for f in report.findings] == ["clock-now"]
+    assert report.stale_suppressions == [
+        StaleSuppression(path="m.py", line=4, rules=("clock-sleep",))
+    ]
+
+
+def test_live_suppression_is_not_stale():
+    src = (
+        "import time\n"
+        "\n"
+        "def f():\n"
+        "    return time.monotonic()  # orlint: disable=clock-now (why)\n"
+    )
+    report = _report_for(src)
+    assert report.findings == [] and len(report.suppressed) == 1
+    assert report.stale_suppressions == []
+
+
+def test_partially_stale_marker_reports_only_the_dead_rule():
+    src = (
+        "import time\n"
+        "\n"
+        "def f():\n"
+        "    return time.monotonic()  # orlint: disable=clock-now,clock-sleep (why)\n"
+    )
+    report = _report_for(src)
+    assert report.findings == []
+    assert report.stale_suppressions == [
+        StaleSuppression(path="m.py", line=4, rules=("clock-sleep",))
+    ]
+
+
+def test_disable_all_is_live_while_anything_fires():
+    live = (
+        "import time\n"
+        "\n"
+        "def f():\n"
+        "    return time.monotonic()  # orlint: disable=all (why)\n"
+    )
+    assert _report_for(live).stale_suppressions == []
+    dead = "def f():\n    return 1  # orlint: disable=all (nothing fires)\n"
+    assert _report_for(dead).stale_suppressions == [
+        StaleSuppression(path="m.py", line=2, rules=("all",))
+    ]
+
+
+def test_file_level_stale_suppression_reports_line_zero():
+    src = (
+        "# orlint: disable-file=clock-sleep\n"
+        "\n"
+        "def f():\n"
+        "    return 1\n"
+    )
+    assert _report_for(src).stale_suppressions == [
+        StaleSuppression(path="m.py", line=0, rules=("clock-sleep",))
+    ]
+
+
+def test_rule_filter_skips_the_stale_audit():
+    """Under --rule only some passes ran: a marker for an unexecuted
+    rule would look dead without being dead.  No audit, no false calls."""
+    src = "def f():\n    return 1  # orlint: disable=clock-sleep (x)\n"
+    assert _report_for(src).stale_suppressions != []
+    assert _report_for(src, rules=["clock-now"]).stale_suppressions == []
+
+
+def test_docstring_marker_is_documentation_not_a_directive():
+    """Marker text inside a string literal neither suppresses nor
+    registers in the audit — only real COMMENT tokens count."""
+    src = (
+        '"""Docs show: use `x  # orlint: disable=clock-now (why)` here,\n'
+        "or `# orlint: disable-file=clock-now` for whole files.\n"
+        '"""\n'
+        "import time\n"
+        "\n"
+        "def f():\n"
+        "    return time.monotonic()\n"
+    )
+    report = _report_for(src)
+    assert [f.rule for f in report.findings] == ["clock-now"]
+    assert report.suppressed == []
+    assert report.stale_suppressions == []
+
+
+def test_strip_stale_narrows_and_removes_markers():
+    src = (
+        "import time\n"
+        "\n"
+        "def f():\n"
+        "    return time.monotonic()  # orlint: disable=clock-now,clock-sleep (epoch)\n"
+        "\n"
+        "def g():\n"
+        "    return 1  # orlint: disable=clock-sleep (fully stale)\n"
+    )
+    out, edits = strip_stale(
+        src, [(4, ("clock-sleep",)), (7, ("clock-sleep",))]
+    )
+    assert edits == 2
+    lines = out.splitlines()
+    # partially stale: narrowed to the live rule, justification kept
+    assert lines[3] == "    return time.monotonic()  # orlint: disable=clock-now (epoch)"
+    # fully stale: the whole comment goes, the code stays
+    assert lines[6] == "    return 1"
+
+
+def test_strip_stale_deletes_marker_only_lines_and_file_markers():
+    src = (
+        "# orlint: disable-file=clock-sleep,clock-now\n"
+        "def f():\n"
+        "    return 1\n"
+    )
+    out, edits = strip_stale(src, [(0, ("clock-sleep", "clock-now"))])
+    assert edits == 1
+    assert out == "def f():\n    return 1\n"
+    # narrowing keeps the marker line with the surviving rule
+    out2, _ = strip_stale(src, [(0, ("clock-sleep",))])
+    assert out2.splitlines()[0] == "# orlint: disable-file=clock-now"
+
+
+def test_strip_stale_leaves_docstring_examples_alone():
+    src = (
+        '"""Use `# orlint: disable-file=clock-sleep` sparingly."""\n'
+        "def f():\n"
+        "    return 1\n"
+    )
+    out, edits = strip_stale(src, [(0, ("clock-sleep",))])
+    assert edits == 0
+    assert out == src
+
+
+def test_check_warns_on_stale_suppressions_but_stays_green(tmp_path, capsys):
+    f = tmp_path / "m.py"
+    f.write_text("def f():\n    return 1  # orlint: disable=clock-now (stale)\n")
+    rc = orlint_main([str(f), "--check", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0, "stale suppressions warn, they do not gate"
+    assert "[stale-suppression]" in out
+    assert "1 stale suppression(s)" in out
+
+
+def test_fix_stale_suppressions_cli_rewrites_files(tmp_path, capsys):
+    f = tmp_path / "m.py"
+    f.write_text(
+        "import time\n"
+        "\n"
+        "def f():\n"
+        "    return time.monotonic()  # orlint: disable=clock-now (live)\n"
+        "\n"
+        "def g():\n"
+        "    return 1  # orlint: disable=clock-sleep (stale)\n"
+    )
+    rc = orlint_main([str(f), "--fix-stale-suppressions", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "removed 1 stale marker(s)" in out
+    text = f.read_text()
+    assert "disable=clock-now (live)" in text, "live marker must survive"
+    assert "clock-sleep" not in text
+    # the tree is now audit-clean
+    rc = orlint_main([str(f), "--check", "--no-baseline"])
+    assert rc == 0
+    assert "[stale-suppression]" not in capsys.readouterr().out
+
+
+def test_fix_stale_suppressions_refuses_rule_filter(capsys):
+    rc = orlint_main(
+        ["--fix-stale-suppressions", "--rule", "clock-now"]
+    )
+    assert rc == 2
+    assert "full run" in capsys.readouterr().out
+
+
+def test_repo_has_no_stale_suppressions():
+    """The one-time sweep, pinned: every suppression comment in the
+    repo still suppresses something real."""
+    report = analyze_modules(load_modules([repo_root() / "openr_tpu"]))
+    assert report.stale_suppressions == []
+
+
+# ---------------------------------------------------------------------------
+# SARIF (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_round_trips_findings_exactly():
+    src = FIXTURES["clock-now"][0] + FIXTURES["clock-call-later"][0]
+    report = _report_for(src)
+    assert len(report.findings) == 2
+    doc = render_sarif(report, all_rules())
+    assert doc["version"] == "2.1.0"
+    assert findings_from_sarif(doc) == report.findings
+
+
+def test_sarif_driver_lists_only_fired_rules_with_rationale():
+    report = _report_for(FIXTURES["clock-now"][0])
+    doc = render_sarif(report, all_rules())
+    (run,) = doc["runs"]
+    rules = run["tool"]["driver"]["rules"]
+    assert [r["id"] for r in rules] == ["clock-now"]
+    assert rules[0]["shortDescription"]["text"] == all_rules()["clock-now"]
+    (res,) = run["results"]
+    assert res["ruleId"] == "clock-now" and res["ruleIndex"] == 0
+    region = res["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] == 4
+    assert region["startColumn"] >= 1  # SARIF columns are 1-based
+
+
+def test_sarif_cli_output_parses(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["clock-now"][0])
+    rc = orlint_main([str(bad), "--format=sarif", "--no-baseline"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["version"] == "2.1.0"
+    (found,) = findings_from_sarif(doc)
+    assert found.rule == "clock-now" and found.line == 4
+    # gating semantics match text mode
+    assert (
+        orlint_main([str(bad), "--format=sarif", "--no-baseline", "--check"])
+        == 1
+    )
+    capsys.readouterr()

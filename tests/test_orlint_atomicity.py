@@ -1,0 +1,189 @@
+"""Atomicity pass (rule family 11) — interprocedural behavior + the
+cache contract it adds (ISSUE 17 tentpole, static half).
+
+The per-rule trip/suppression fixtures and the validated ``--explain``
+examples live in ``test_orlint.py`` (the FIXTURES meta-suite covers
+every registered rule).  This file pins the parts that depend on the
+PROJECT, not just the snippet:
+
+* suspension is interprocedural — an awaited call suspends (or not)
+  according to the callee's own body, through helpers and overrides;
+* the ``--cache`` contract extends to suspension facts: editing a
+  HELPER so it starts suspending must invalidate the cached atomicity
+  verdict of an UNCHANGED caller file, because the per-function
+  ``suspends`` flag rides in the module summary and therefore in the
+  project facts digest.
+"""
+
+from openr_tpu.analysis import analyze_paths, analyze_source
+
+# ---------------------------------------------------------------------------
+# interprocedural suspension
+# ---------------------------------------------------------------------------
+
+ACTOR_CTX = """\
+from openr_tpu.common.runtime import Actor
+
+class Spark(Actor):
+    pass
+"""
+
+
+def _rules(findings):
+    return [(f.rule, f.line) for f in findings]
+
+
+def test_awaiting_non_suspending_internal_helper_is_clean():
+    """``await helper()`` where the helper's body never yields is NOT a
+    suspension point — the turn is still atomic, no finding."""
+    src = (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "async def classify(key):\n"
+        "    return len(key)\n"
+        "\n"
+        "class Cache(Actor):\n"
+        "    async def lookup(self, key):\n"
+        "        if key not in self._entries:\n"
+        "            kind = await classify(key)\n"
+        "            self._entries[key] = kind\n"
+        "        return self._entries[key]\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_transitively_suspending_helper_trips():
+    """The same caller trips once the helper suspends — two hops deep,
+    through a helper that itself only awaits another suspender."""
+    src = (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "async def fetch(store, key):\n"
+        "    return await store.rpc_get(key)\n"
+        "\n"
+        "async def classify(store, key):\n"
+        "    return await fetch(store, key)\n"
+        "\n"
+        "class Cache(Actor):\n"
+        "    async def lookup(self, key):\n"
+        "        if key not in self._entries:\n"
+        "            kind = await classify(self._store, key)\n"
+        "            self._entries[key] = kind\n"
+        "        return self._entries[key]\n"
+    )
+    assert _rules(analyze_source(src)) == [("await-atomicity", 13)]
+
+
+def test_revalidation_after_await_is_clean():
+    """Reading the guarded attribute again after the suspension is the
+    sanctioned fix — the stale pre-await verdict is refreshed."""
+    src = (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "class Cache(Actor):\n"
+        "    async def lookup(self, key):\n"
+        "        if key not in self._entries:\n"
+        "            value = await self._fetch(key)\n"
+        "            if key not in self._entries:\n"
+        "                self._entries[key] = value\n"
+        "        return self._entries[key]\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_suspension_is_a_may_property_across_overrides():
+    """An awaited method resolved through a base class suspends if ANY
+    override suspends — the abstract base's stub body must not launder
+    the subclass's sleep into a non-suspension."""
+    src = (
+        "from openr_tpu.common.runtime import Actor\n"
+        "\n"
+        "class Backend:\n"
+        "    async def fetch(self, key):\n"
+        "        raise NotImplementedError\n"
+        "\n"
+        "class RpcBackend(Backend):\n"
+        "    async def fetch(self, key):\n"
+        "        return await self.transport.call(key)\n"
+        "\n"
+        "class Cache(Actor):\n"
+        "    def __init__(self, backend: Backend):\n"
+        "        self._backend = backend\n"
+        "\n"
+        "    async def lookup(self, key):\n"
+        "        if key not in self._entries:\n"
+        "            value = await self._backend.fetch(key)\n"
+        "            self._entries[key] = value\n"
+        "        return self._entries[key]\n"
+    )
+    assert _rules(analyze_source(src)) == [("await-atomicity", 18)]
+
+
+# ---------------------------------------------------------------------------
+# the --cache contract: suspension facts ride in the project digest
+# ---------------------------------------------------------------------------
+
+CALLER_SRC = (
+    "from openr_tpu.common.runtime import Actor\n"
+    "from helpers import classify\n"
+    "\n"
+    "class Cache(Actor):\n"
+    "    async def lookup(self, key):\n"
+    "        if key not in self._entries:\n"
+    "            kind = await classify(key)\n"
+    "            self._entries[key] = kind\n"
+    "        return self._entries[key]\n"
+)
+
+HELPER_PURE = "async def classify(key):\n    return len(key)\n"
+
+HELPER_SUSPENDS = (
+    "import asyncio\n"
+    "\n"
+    "async def classify(key):\n"
+    "    await asyncio.sleep(0)  # orlint: disable=clock-sleep (fixture)\n"
+    "    return len(key)\n"
+)
+
+
+def test_cache_helper_turning_suspending_invalidates_caller(
+    tmp_path, monkeypatch
+):
+    """The suspension-summary digest contract: cache.py keys cached
+    per-file findings on the PROJECT facts digest, and a function's
+    ``suspends`` flag is part of its summary — so a helper edit that
+    flips the flag must re-run the unchanged caller and surface the
+    atomicity finding its cached (clean) verdict would have hidden."""
+    d = tmp_path / "src"
+    d.mkdir()
+    # root the analysis at the tree so rel paths ("caller.py") double as
+    # module names and `from helpers import classify` resolves in-tree
+    from openr_tpu.analysis import engine
+
+    monkeypatch.setattr(engine, "repo_root", lambda: d)
+    (d / "caller.py").write_text(CALLER_SRC)
+    (d / "helpers.py").write_text(HELPER_PURE)
+    cache = tmp_path / "cache.json"
+
+    r1 = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r1.files_parsed == 2
+    assert r1.findings == []  # helper is pure: the await never yields
+
+    # warm re-run: nothing changed, nothing re-parsed, still clean
+    r2 = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r2.files_parsed == 0
+    assert r2.findings == []
+
+    # the helper starts suspending; caller.py is byte-identical, but its
+    # cached verdict is stale — the digest shift must force a live run
+    (d / "helpers.py").write_text(HELPER_SUSPENDS)
+    r3 = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r3.files_parsed == 2, "caller must re-run on suspension shift"
+    assert [(f.path, f.rule) for f in r3.findings] == [
+        ("caller.py", "await-atomicity")
+    ]
+
+    # and the new verdict is itself cached: warm run, same finding
+    r4 = analyze_paths([d], use_baseline=False, cache_path=cache)
+    assert r4.files_parsed == 0
+    assert [f.key() for f in r4.findings] == [f.key() for f in r3.findings]

@@ -1,0 +1,340 @@
+"""Schedule-perturbation race detector (ISSUE 17 tentpole, dynamic half).
+
+Three layers:
+
+* a deliberately order-dependent toy actor that BOTH halves of the race
+  tier must catch — the static ``await-atomicity`` pass on its source,
+  and the dynamic sweep as a digest divergence minimized to a seed and a
+  first diverging actor turn;
+* unit coverage of the machinery: divergence minimization, turn-log
+  lookup, perturbed-run replay determinism, and the SimClock dispatch
+  classes (prologue / mutator / observer) the detector relies on;
+* the acceptance gate: the 9-node chaos world (the same topology, fault
+  plan, and supervisor wiring as ``test_chaos_recovery``) must produce
+  byte-identical replay digests under perturbed schedules — 3 seeds in
+  tier-1, the full K=32 sweep under ``-m slow``.
+"""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.analysis import analyze_source
+from openr_tpu.chaos import (
+    ChaosController,
+    FaultPlan,
+    InvariantChecker,
+    SchedulePerturber,
+    ScheduleRun,
+    Supervisor,
+    collect_replay_digests,
+    first_divergence,
+    run_schedules,
+    run_world,
+)
+from openr_tpu.chaos.schedule import _canon, _line_time
+from openr_tpu.common.runtime import SimClock
+from openr_tpu.emulation.network import EmulatedNetwork
+from openr_tpu.emulation.topology import grid_edges
+
+# ---------------------------------------------------------------------------
+# the toy order-dependent actor — one source, caught by both halves
+# ---------------------------------------------------------------------------
+
+TOY_SOURCE = '''\
+from openr_tpu.common.runtime import Actor
+
+
+class LeaseActor(Actor):
+    """Deliberately order-dependent toy: check-then-act on actor state
+    straddles a suspension point with no re-validation."""
+
+    def __init__(self, clock):
+        super().__init__("lease", clock)
+        self.owner = None
+
+    async def claim(self, who, delay_s):
+        if self.owner is None:
+            await self.clock.sleep(delay_s)
+            self.owner = who
+'''
+
+_ns: dict = {}
+exec(compile(TOY_SOURCE, "toy_lease.py", "exec"), _ns)
+LeaseActor = _ns["LeaseActor"]
+
+#: a perturbation seed whose first same-instant shuffle swaps the two
+#: claim fibers (pinned: the perturber's RNG stream is deterministic)
+FLIP_SEED = 1
+
+
+async def toy_world(clock):
+    """Two fibers race LeaseActor.claim at the same virtual instant; the
+    winner depends on same-instant wakeup order — the bug the detector
+    must surface as a digest divergence."""
+    actor = LeaseActor(clock)
+    loop = asyncio.get_event_loop()
+    tasks = [
+        loop.create_task(actor.claim(who, 1.0), name=f"claim.{who}")
+        for who in ("alpha", "beta")
+    ]
+    await clock.run_until(2.0)
+    await asyncio.gather(*tasks)
+    return {"toy/owner": _canon({"owner": actor.owner, "t": 1.0})}
+
+
+def test_toy_race_caught_statically():
+    findings = analyze_source(TOY_SOURCE, rel="toy_lease.py")
+    assert [(f.rule, f.line) for f in findings] == [("await-atomicity", 15)]
+
+
+def test_toy_race_caught_dynamically_with_minimized_report():
+    sweep = run_schedules(toy_world, [FLIP_SEED])
+    assert not sweep.identical
+    (report,) = sweep.divergences
+    assert report.seed == FLIP_SEED
+    assert report.artifact == "toy/owner"
+    assert report.line_index == 0
+    assert "beta" in report.baseline_line
+    assert "alpha" in report.perturbed_line
+    # minimized to the first diverging actor turn of the perturbed run
+    assert report.turn is not None
+    t, label = report.turn
+    assert t == 1.0
+    assert label.startswith("claim.")
+    text = report.render()
+    assert f"seed={FLIP_SEED}" in text
+    assert "first diverging actor turn" in text
+    assert "replay: rerun" in text
+
+
+def test_perturbed_run_replays_deterministically():
+    """The divergence-replay contract: a perturbed schedule is itself a
+    pure function of its seed — rerunning reproduces digests AND the
+    turn log byte-for-byte, so every report is debuggable, not a flake."""
+    a = run_world(toy_world, FLIP_SEED)
+    b = run_world(toy_world, FLIP_SEED)
+    assert a.digests == b.digests
+    assert a.turns == b.turns
+    assert a.turns, "perturbed run must record its actor-turn log"
+
+
+# ---------------------------------------------------------------------------
+# divergence minimization units
+# ---------------------------------------------------------------------------
+
+
+def test_first_divergence_none_when_identical():
+    run = ScheduleRun(seed=None, digests={"x": b"same"})
+    assert first_divergence(run, ScheduleRun(seed=3, digests={"x": b"same"})) is None
+
+
+def test_first_divergence_minimizes_to_line_and_turn():
+    baseline = ScheduleRun(
+        seed=None,
+        digests={"alerts/n0": b'{"ts_ms":1000,"kind":"a"}\n{"ts_ms":30000,"kind":"b"}'},
+    )
+    perturbed = ScheduleRun(
+        seed=9,
+        digests={"alerts/n0": b'{"ts_ms":1000,"kind":"a"}\n{"ts_ms":30000,"kind":"c"}'},
+    )
+    probe = SchedulePerturber(9)
+    probe.turns = [(0.5, "boot"), (29.75, "health.sweeps"), (31.0, "late")]
+    report = first_divergence(baseline, perturbed, probe)
+    assert report is not None
+    assert report.artifact == "alerts/n0"
+    assert report.line_index == 1
+    # ts_ms is milliseconds: 30000 -> t=30.0, whose nearest dispatched
+    # turn at-or-before is the health sweep, not the later wakeup
+    assert report.turn == (29.75, "health.sweeps")
+
+
+def test_first_divergence_reports_earliest_artifact_by_name():
+    baseline = ScheduleRun(seed=None, digests={"a": b"1", "z": b"1"})
+    perturbed = ScheduleRun(seed=2, digests={"a": b"1", "z": b"2"})
+    report = first_divergence(baseline, perturbed)
+    assert report.artifact == "z"
+    assert report.baseline_line == "1"
+    assert report.perturbed_line == "2"
+
+
+def test_line_time_parses_ms_and_s_spellings():
+    assert _line_time('{"ts_ms": 30000}') == 30.0
+    assert _line_time('{"t": 1.5}') == 1.5
+    assert _line_time("wakeup t=2.25 fiber=x") == 2.25
+    assert _line_time("no timestamp here") is None
+
+
+def test_nearest_turn_bisects_turn_log():
+    p = SchedulePerturber(0)
+    assert p.nearest_turn(1.0) is None
+    p.turns = [(1.0, "a"), (2.0, "b"), (2.0, "c"), (5.0, "d")]
+    assert p.nearest_turn(0.5) == (1.0, "a")  # before first: clamp to it
+    assert p.nearest_turn(2.0) == (2.0, "c")  # last turn AT the instant
+    assert p.nearest_turn(9.0) == (5.0, "d")
+
+
+# ---------------------------------------------------------------------------
+# SimClock dispatch classes — the ordering contract the detector perturbs
+# ---------------------------------------------------------------------------
+
+
+def _dispatch_order(seed, marks=()):
+    """Run four same-instant fibers, returning their dispatch order."""
+
+    async def world(clock):
+        for kind, label in marks:
+            getattr(clock, f"mark_{kind}")(label)
+        order = []
+
+        async def fiber(name):
+            await clock.sleep(1.0)
+            order.append(name)
+
+        loop = asyncio.get_event_loop()
+        tasks = [
+            loop.create_task(fiber(n), name=n)
+            for n in ("m1", "obs", "env", "m2")
+        ]
+        await clock.run_until(2.0)
+        await asyncio.gather(*tasks)
+        return {"order": _canon(order)}
+
+    return run_world(world, seed).digests["order"]
+
+
+def test_canonical_dispatch_is_registration_order_without_marks():
+    assert _dispatch_order(None) == _canon(["m1", "obs", "env", "m2"])
+
+
+@pytest.mark.parametrize("seed", [None, 1, 2, 3, 4, 5])
+def test_prologue_first_observer_last_on_every_schedule(seed):
+    """mark_prologue fibers run before, and mark_observer fibers after,
+    every same-instant mutator — on the canonical schedule and under any
+    perturbation seed (only the mutator order is ever permuted)."""
+    marks = (("prologue", "env"), ("observer", "obs"))
+    order = _dispatch_order(seed, marks)
+    decoded = order.decode()
+    assert decoded.index("env") < decoded.index("m1")
+    assert decoded.index("env") < decoded.index("m2")
+    assert decoded.index("obs") > decoded.index("m1")
+    assert decoded.index("obs") > decoded.index("m2")
+
+
+# ---------------------------------------------------------------------------
+# order-independence regressions for fixes the detector surfaced
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_ids_are_content_derived_not_mint_ordered():
+    """Regression (found by the perturbation sweep): trace/span ids came
+    from a node-global counter, so the same spans minted in a different
+    interleaving got different ids — and the ids are embedded in kvstore
+    values.  Ids must be a function of content, not of mint order."""
+    from openr_tpu.tracing.tracer import Tracer
+
+    def mint(order):
+        clock = SimClock()
+        tracer = Tracer("n0", clock)
+        ctxs = {}
+        for name in order:
+            ctxs[name] = tracer.start_trace(name, attrs={"k": name})
+        return {name: ctx.trace_id for name, ctx in ctxs.items()}
+
+    assert mint(["adj", "prefix"]) == mint(["prefix", "adj"])
+
+
+def test_spark_loss_coin_is_content_pure():
+    """Regression (found by the perturbation sweep): the loss decision
+    drew from a stateful RNG in SEND order, so permuting same-tick sends
+    flipped which hello got dropped.  The coin must be a pure function
+    of (salt, src, dst, time, payload) — same packet, same verdict, in
+    any order."""
+    from openr_tpu.spark.io_provider import MockIoProvider
+
+    io = MockIoProvider(SimClock())
+    io.seed_loss_rng(7)
+    c1 = io._loss_coin("n0", "n1", {"seq": 0})
+    io._loss_coin("n1", "n2", {"seq": 1})  # interleave another draw
+    c2 = io._loss_coin("n0", "n1", {"seq": 0})
+    assert c1 == c2, "same packet must draw the same coin every time"
+    assert 0.0 <= c1 < 1.0
+    # a different seed moves the coin (the salt participates)
+    io2 = MockIoProvider(SimClock())
+    io2.seed_loss_rng(8)
+    assert io2._loss_coin("n0", "n1", {"seq": 0}) != c1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: 9-node chaos world, byte-identical across schedules
+# ---------------------------------------------------------------------------
+
+SEED = 7
+LEFT = ("node0", "node3", "node6")
+RIGHT = ("node1", "node2", "node4", "node5", "node7", "node8")
+
+
+def _chaos_overrides(cfg):
+    cfg.watchdog_config.interval_s = 1.0
+
+
+def _build_plan():
+    plan = FaultPlan()
+    plan.partition(LEFT, RIGHT, at=2.0, duration=12.0)
+    plan.spark_loss("node1", "node2", prob=0.5, at=3.0, duration=8.0)
+    plan.kv_rpc_latency("node1", "node4", extra_s=0.2, at=2.0, duration=10.0)
+    plan.fib_burst("node4", at=4.0, duration=6.0)
+    plan.actor_kill("node4", "decision", at=6.0)
+    return plan
+
+
+async def chaos_world(clock):
+    """The 9-node chaos acceptance world (mirrors test_chaos_recovery):
+    converge, run the full fault plan under supervision, heal, then
+    collect every replay-sensitive digest."""
+    net = EmulatedNetwork(clock, config_overrides=_chaos_overrides)
+    net.build(grid_edges(3))
+    net.start()
+    supervisor = Supervisor(clock, initial_backoff_s=0.25, max_backoff_s=5.0)
+    supervisor.start()
+    for name, node in net.nodes.items():
+        supervisor.supervise(name, node, net.restart_node)
+    controller = ChaosController(net, _build_plan(), seed=SEED)
+    await clock.run_for(18.0)
+    ok, why = net.converged_full_mesh()
+    assert ok, why
+    controller.start()
+    for _ in range(8):
+        await clock.run_for(2.5)
+    await clock.run_for(30.0)
+    checker = InvariantChecker(net)
+    checker.check_all()
+    digests = collect_replay_digests(net)
+    digests["chaos/counters"] = _canon(controller.counter_dump())
+    await supervisor.stop()
+    await controller.stop()
+    await net.stop()
+    return digests
+
+
+def _assert_stable(seeds):
+    sweep = run_schedules(chaos_world, seeds)
+    assert sweep.identical, "\n" + sweep.render()
+    # the digests are substantive, not vacuously empty
+    assert any(
+        name.startswith("kvstore/") and digest
+        for name, digest in sweep.baseline.digests.items()
+    )
+    for run in sweep.runs:
+        assert run.turns, "perturbed runs must log actor turns"
+
+
+@pytest.mark.chaos
+def test_chaos_world_digests_stable_under_3_schedules():
+    _assert_stable([1, 2, 3])
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_world_digests_stable_under_32_schedules():
+    _assert_stable(list(range(1, 33)))

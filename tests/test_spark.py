@@ -198,6 +198,37 @@ def test_reconnect_after_partition_reestablishes():
     run(main())
 
 
+def test_discovery_with_offset_hello_phase_after_fast_init():
+    """Two peers discovering each other AFTER the fast-init window, with
+    hello phases offset by half a period, must still reach ESTABLISHED.
+
+    With offset phase every hello reflects the peer's *latest* seq (the
+    reflection is minted after the latest hello was heard), so a stale-
+    incarnation guard of ``>=`` instead of ``>`` parks both sides in
+    WARM forever: no solicited bumps (fast-init is over), no heartbeats
+    (nothing ESTABLISHED on the interface), and the phase never drifts.
+    This is the netns-lab churn hang in miniature — real daemons start
+    staggered, so their steady-state hello phases are always offset."""
+
+    async def main():
+        clock = SimClock()
+        rig = Rig(clock, ["a", "b"])
+        # let the 1s fast-init window lapse with no interfaces up: every
+        # hello from here on is periodic (2s) and unsolicited
+        await clock.run_for(1.5)
+        rig.io.connect_pair("a", "if1", "b", "if2", 0.001)
+        rig.up_interface("a", "if1")
+        await clock.run_for(0.5)  # stagger b's hello loop by half a slot
+        rig.up_interface("b", "if2")
+        await clock.run_for(12.0)
+        for n in ("a", "b"):
+            states = [x.state for x in rig.sparks[n].get_neighbors()]
+            assert states == [SparkNeighState.ESTABLISHED], (n, states)
+        await rig.stop()
+
+    run(main())
+
+
 def test_graceful_restart_holds_and_recovers():
     async def main():
         clock = SimClock()

@@ -193,7 +193,8 @@ class TestTracer:
             clock = SimClock()
             tracer = Tracer("n0", clock, counters=CounterMap())
             ctx = tracer.start_trace("origin", module="test")
-            assert ctx.trace_id == "n0:1" and ctx.origin_node == "n0"
+            assert ctx.trace_id.startswith("n0:")
+            assert ctx.origin_node == "n0"
             span = tracer.start_span("stage", ctx, module="test")
 
             async def sleeper():
@@ -210,8 +211,8 @@ class TestTracer:
         assert [s.name for s in spans] == ["origin", "stage"]
         stage = spans[1]
         assert stage.duration_ms() == pytest.approx(1500.0)
-        assert stage.parent_id == "n0:1"
-        assert stage.trace_id == "n0:1"
+        assert stage.parent_id == spans[0].span_id
+        assert stage.trace_id == spans[0].trace_id
         # replay: a fresh SimClock run produces the identical trace
         spans2 = run(main()).get_spans()
         assert [s.to_wire() for s in spans2] == [s.to_wire() for s in spans]
