@@ -4606,6 +4606,301 @@ def frr_main(seed: Optional[int] = None) -> None:
     print(json.dumps(doc))
 
 
+# ---------------------------------------------------------------------------
+# fleet compute fabric bench (--fleet-sweep / --fleet-streaming)
+# ---------------------------------------------------------------------------
+
+FLEET_BENCH_NODES = ("fab0", "fab1", "fab2")
+FLEET_BENCH_SIDE = 4
+
+
+def validate_fleet_bench(doc: dict) -> None:
+    """Schema contract for BENCH_FLEET_r*.json — shared by the bench
+    emitter, the tier-1 artifact gate and the benchtrack manifest.
+
+    The ISSUE-19 acceptance, in-artifact: the 3-node fleet sweep's
+    merged summary digest is byte-equal to the single-node run of the
+    same scenario set; a mid-sweep node kill re-packs ONLY the victim's
+    worlds onto survivors and still converges to the byte-identical
+    digest AND fleet manifest; a mid-stream node kill migrates exactly
+    the victim's watchers to their hash successors with zero
+    monotone-generation invariant violations and no pre-migration
+    generation re-emitted; a maintenance drain hands off cleanly (zero
+    residual subscribers on the drained daemon); the whole chaos
+    schedule replays byte-identically on the virtual clock."""
+    assert doc["metric"] == "fleet_sweep_merged_scenarios_per_s_3node"
+    assert doc["unit"] == "scenarios/s"
+    assert doc["value"] > 0
+    d = doc["detail"]
+    sw = d["sweep"]
+    assert sw["nodes"] == len(FLEET_BENCH_NODES)
+    assert sw["worlds"] >= 8
+    assert sw["scenarios"] >= sw["worlds"]
+    assert doc["value"] == sw["merged_scenarios_per_s"]
+    assert sw["single_node_digest"]
+    assert sw["fleet_digest"] == sw["single_node_digest"]
+    assert sw["summary_digest_equal"] is True
+    k = sw["kill"]
+    assert k["victim"] in FLEET_BENCH_NODES
+    assert k["repacked_worlds"] >= 1
+    assert k["rounds"] >= 2
+    assert k["digest_equal"] is True
+    assert k["manifest_byte_identical"] is True
+    st = d["streaming"]
+    assert st["watchers"] >= 8
+    assert st["migrated_watchers"] >= 1
+    assert st["invariant_violations"] == 0
+    assert st["pre_migration_generation_emissions"] == 0
+    assert st["deterministic_replay"] is True
+    dr = st["drain"]
+    assert dr["migrated_watchers"] >= 1
+    assert dr["invariant_violations"] == 0
+    assert dr["residual_subscribers"] == 0
+    for key in ("seed", "mode", "env"):
+        assert key in d, key
+    for key in ("platform", "jax", "device_count"):
+        assert key in d["env"], f"env.{key}"
+    assert d["env"]["device_count"] >= 1
+
+
+def _fleet_bench_doc(seed: Optional[int]) -> dict:
+    """Measure both fleet halves over one FleetFabric world and build
+    the combined BENCH_FLEET document.  Everything runs on the SimClock
+    (chaos schedules are replayable); only the headline merge rate is
+    wall-clock."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from openr_tpu.common.runtime import SimClock
+    from openr_tpu.emulation.fabric import FleetFabric
+    from openr_tpu.sweep import SweepExecutor
+    from openr_tpu.sweep.scenario import ScenarioSpec
+
+    seed = 7 if seed is None else int(seed)
+    params = {
+        "drain_node_sets": [
+            [], ["node5"], ["node7"], ["node3"], ["node11"], ["node13"],
+        ],
+        "metric_perturbations": [{"pattern": "node.*", "factor": 2.0}],
+        "combo_k": 2,
+        "max_combo_scenarios": 8,
+        "combo_seed": seed,
+    }
+    root = tempfile.mkdtemp(prefix="bench_fleet_")
+
+    def make_fabric(sub: str) -> "tuple":
+        clock = SimClock()
+        fab = FleetFabric(
+            clock,
+            spill_root=f"{root}/{sub}",
+            node_names=FLEET_BENCH_NODES,
+            n_side=FLEET_BENCH_SIDE,
+            sweep_overrides={
+                "shard_scenarios": 8, "inter_shard_pause_s": 0.05,
+            },
+        )
+        return clock, fab
+
+    async def drive_sweep(fab, clock, kill=False):
+        """Pump one fleet sweep to completion; with ``kill``, crash the
+        first member seen with a running sub-sweep (rendezvous decides
+        who holds worlds under this grammar, so the victim is picked by
+        observation, not by name)."""
+        fab.coordinator.prepare(params)
+        fab.coordinator.start()
+        victim = None
+        for _ in range(20000):
+            await clock.run_for(0.05)
+            st = fab.coordinator.status()
+            if kill and victim is None:
+                running = [
+                    t["node"] for t in st["assignments"]
+                    if t["state"] == "running"
+                ]
+                if running:
+                    victim = running[0]
+                    await fab.kill_node(victim)
+            if fab.coordinator.state != "running":
+                break
+        assert fab.coordinator.state == "done", fab.coordinator.state
+        if kill:
+            assert victim is not None, "kill window never opened"
+        s = fab.coordinator.summary()
+        return (
+            s["summary_digest"],
+            fab.coordinator.manifest_bytes(),
+            fab.coordinator.status(),
+            victim,
+        )
+
+    async def sweep_half():
+        # single-node reference: the same grammar through one executor
+        clock, fab = make_fabric("single")
+        fab.start()
+        await clock.run_for(2.0)
+        svc = fab.nodes["fab0"].sweep
+        spec = ScenarioSpec.from_params(svc.config, params)
+        ex = SweepExecutor(
+            svc._inputs, f"{root}/single/ref", clock=clock,
+            shard_scenarios=64,
+        )
+        ex.prepare(spec, resume=False)
+        ex.run()
+        single_digest = ex.reducer.summary_digest()
+        await fab.stop()
+
+        # the clean 3-node fleet run (wall-clocked for the headline)
+        clock, fab = make_fabric("clean")
+        fab.start()
+        await clock.run_for(2.0)
+        t0 = time.perf_counter()
+        digest, manifest, st, _ = await drive_sweep(fab, clock)
+        wall_s = time.perf_counter() - t0
+        await fab.stop()
+
+        # the chaos run: kill one member while its sub-sweep runs
+        clock, fab = make_fabric("killed")
+        fab.start()
+        await clock.run_for(2.0)
+        kdigest, kmanifest, kst, victim = await drive_sweep(
+            fab, clock, kill=True
+        )
+        await fab.stop()
+        return {
+            "nodes": len(FLEET_BENCH_NODES),
+            "worlds": st["worlds_total"],
+            "scenarios": st["scenarios_total"],
+            "merge_wall_ms": round(wall_s * 1000.0, 1),
+            "merged_scenarios_per_s": round(
+                st["scenarios_total"] / wall_s, 1
+            ),
+            "single_node_digest": single_digest,
+            "fleet_digest": digest,
+            "summary_digest_equal": digest == single_digest,
+            "kill": {
+                "victim": victim,
+                "repacked_worlds": kst["repacked_worlds"],
+                "rounds": kst["rounds"],
+                "digest_equal": kdigest == digest,
+                "manifest_byte_identical": kmanifest == manifest,
+            },
+        }
+
+    async def stream_scenario(sub: str, drain_instead: bool = False):
+        clock, fab = make_fabric(sub)
+        fab.start()
+        await clock.run_for(2.0)
+        n_watch = 12
+        watchers = [
+            fab.router.watch("route_db", {"node": f"node{i}"})
+            for i in range(n_watch)
+        ]
+        await clock.run_for(1.0)
+        fab.announce_prefix("node2", "10.99.0.0/24")
+        await clock.run_for(2.0)
+        placement = {}
+        for w in watchers:
+            placement.setdefault(w.serving_node, []).append(w)
+        victim = max(placement, key=lambda n: len(placement[n]))
+        if drain_instead:
+            fab.drain_node(victim)
+        else:
+            await fab.kill_node(victim)
+        await clock.run_for(1.0)
+        fab.announce_prefix("node0", "10.98.0.0/24")
+        await clock.run_for(2.0)
+        out = {
+            "watchers": n_watch,
+            "victim": victim,
+            "migrated_watchers": len(placement[victim]),
+            "invariant_violations": fab.router.invariant_violations(),
+            "pre_migration_generation_emissions": (
+                fab.router.pre_migration_re_emissions()
+            ),
+            "log": b"\x00".join(w.log_bytes() for w in watchers),
+        }
+        if drain_instead:
+            stats = fab.nodes[victim].streaming.stats()
+            out["residual_subscribers"] = sum(
+                f["subscribers"] for f in stats["feeds"]
+            )
+        await fab.stop()
+        return out
+
+    async def streaming_half():
+        a = await stream_scenario("skill_a")
+        b = await stream_scenario("skill_b")
+        dr = await stream_scenario("sdrain", drain_instead=True)
+        return {
+            "watchers": a["watchers"],
+            "victim": a["victim"],
+            "migrated_watchers": a["migrated_watchers"],
+            "invariant_violations": a["invariant_violations"],
+            "pre_migration_generation_emissions": (
+                a["pre_migration_generation_emissions"]
+            ),
+            "deterministic_replay": (
+                a["victim"] == b["victim"] and a["log"] == b["log"]
+            ),
+            "drain": {
+                "victim": dr["victim"],
+                "migrated_watchers": dr["migrated_watchers"],
+                "invariant_violations": dr["invariant_violations"],
+                "residual_subscribers": dr["residual_subscribers"],
+            },
+        }
+
+    try:
+        sweep_detail = asyncio.run(sweep_half())
+        streaming_detail = asyncio.run(streaming_half())
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "metric": "fleet_sweep_merged_scenarios_per_s_3node",
+        "value": sweep_detail["merged_scenarios_per_s"],
+        "unit": "scenarios/s",
+        "detail": {
+            "sweep": sweep_detail,
+            "streaming": streaming_detail,
+            "seed": seed,
+            "mode": (
+                "3 fleet members (serving+streaming+sweep) over one "
+                "shared scalar decision on a grid16 LSDB, SimClock; "
+                "content-derived world assignment (rendezvous over the "
+                "scenario-set hash), sub-sweeps merged through the "
+                "feed-order-independent reducer; chaos = mid-sweep "
+                "member kill + mid-stream kill/drain via the fleet "
+                "membership plane"
+            ),
+            "env": env_stamp(),
+        },
+    }
+
+
+def fleet_sweep_main(seed: Optional[int] = None) -> None:
+    """Fleet compute-fabric benchmark (BENCH_FLEET_r*), sweep-first
+    entry point.  The fabric's two halves share the membership/
+    directory core, so either entry point measures BOTH and emits the
+    one combined artifact — benching a half alone would skip exactly
+    the coupling the acceptance gates (a membership transition must
+    re-pack worlds AND migrate watchers off the same event)."""
+    doc = _fleet_bench_doc(seed)
+    try:
+        validate_fleet_bench(doc)
+    except AssertionError:
+        print(json.dumps(doc), file=sys.stderr, flush=True)
+        raise
+    print(json.dumps(doc))
+
+
+def fleet_streaming_main(seed: Optional[int] = None) -> None:
+    """Fleet compute-fabric benchmark (BENCH_FLEET_r*), streaming-first
+    entry point — same combined measurement as --fleet-sweep (see
+    fleet_sweep_main for why the halves are never benched apart)."""
+    fleet_sweep_main(seed)
+
+
 def main() -> None:
     t_start = time.time()
     from openr_tpu.ops.platform_env import (
@@ -5054,6 +5349,8 @@ BENCH_MODES = {
     "streaming": (streaming_main, "sweep 11", "watch-plane fan-out: 10k+ subscriber churn under chaos, snapshot+delta generation correctness"),
     "sweep": (sweep_main, "grammar 7", "capacity-planning sweep: 100k+ scenarios on grid4096, sharded/spilled/resumable, ranked risk summary"),
     "frr": (frr_main, "flap sample 7", "fast-reroute protection tier: protected-flap publication→FIB percentiles vs the warm path on grid4096"),
+    "fleet-sweep": (fleet_sweep_main, "grammar 7", "fleet fabric: 3-node sharded sweep digest parity + mid-sweep kill repack (emits the combined fleet artifact)"),
+    "fleet-streaming": (fleet_streaming_main, "grammar 7", "fleet fabric: consistent-hash watcher migration under kill/drain (emits the combined fleet artifact)"),
 }
 
 

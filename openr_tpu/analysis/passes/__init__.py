@@ -17,6 +17,7 @@ from openr_tpu.analysis.passes.atomicity import AtomicityPass
 from openr_tpu.analysis.passes.base import Pass
 from openr_tpu.analysis.passes.clock_discipline import ClockDisciplinePass
 from openr_tpu.analysis.passes.determinism import DeterminismPass
+from openr_tpu.analysis.passes.fleet_directory import FleetDirectoryPass
 from openr_tpu.analysis.passes.jax_hygiene import JaxHygienePass
 from openr_tpu.analysis.passes.pipeline_phase import PipelinePhasePass
 from openr_tpu.analysis.passes.protection_table import ProtectionTablePass
@@ -36,6 +37,7 @@ def make_passes():
         PipelinePhasePass(),
         AlertRegistryPass(),
         SweepOwnershipPass(),
+        FleetDirectoryPass(),
         ProtectionTablePass(),
         DeterminismPass(),
         AtomicityPass(),

@@ -1,0 +1,97 @@
+"""Fleet membership/directory discipline — liveness has ONE writer.
+
+The fleet fabric's whole correctness story (docs/Fleet.md) is that
+world assignment and feed ownership are PURE FUNCTIONS of the live-node
+set: the coordinator re-packs and the stream router migrates exactly
+when membership transitions, and the health plane pages/tickets off the
+same transitions.  A stray ``node_down`` / ``drain_node`` call from an
+arbitrary module would mutate the live set behind the fabric's back —
+assignments silently recomputed against a set nobody else observed,
+watchers migrated with no alert edge, the membership seq desynced from
+the transition that caused it.
+
+Rule:
+
+* ``fleet-directory`` — a call to the membership mutators
+  (``node_down``, ``node_up``, ``drain_node``, ``undrain_node``)
+  anywhere outside ``openr_tpu/fleet/`` (the owner), ``openr_tpu/chaos/``
+  and ``openr_tpu/emulation/`` (fault injection crosses the boundary on
+  purpose).  Reads (``live_nodes``, ``is_live``, ``status``) are fine
+  everywhere.  The generic-sounding names are matched only as attribute
+  calls on a receiver whose name hints at the fleet (``membership``,
+  ``fleet``, ``nodeset``) — ``x.node_up()`` on unrelated objects must
+  not trip.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from openr_tpu.analysis.findings import Finding
+from openr_tpu.analysis.passes.base import ParsedModule, Pass
+
+ALLOWED_PREFIXES = (
+    "openr_tpu/fleet/",
+    "openr_tpu/chaos/",
+    "openr_tpu/emulation/",
+)
+
+_MUTATOR_CALLS = {"node_down", "node_up", "drain_node", "undrain_node"}
+_RECEIVER_HINTS = ("membership", "fleet", "nodeset")
+
+
+class FleetDirectoryPass(Pass):
+    name = "fleet-directory"
+    rules = {
+        "fleet-directory": (
+            "fleet membership mutator called outside openr_tpu/fleet/ "
+            "(liveness is single-writer: assignment, migration and the "
+            "node-loss alerts all key off the membership seq)"
+        ),
+    }
+    examples = {
+        "fleet-directory": {
+            "trip": (
+                "def evict(membership, name):\n"
+                "    membership.node_down(name)\n"
+            ),
+            "fix": (
+                "def evict(membership, name):\n"
+                "    return membership.status()['live']\n"
+            ),
+        },
+    }
+
+    def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
+        if mod.rel.startswith(ALLOWED_PREFIXES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            name = f.attr
+            if name not in _MUTATOR_CALLS:
+                continue
+            hit = True
+            if isinstance(f.value, ast.Name):
+                recv = f.value.id.lower()
+                hit = any(h in recv for h in _RECEIVER_HINTS)
+            elif isinstance(f.value, ast.Attribute):
+                recv = f.value.attr.lower()
+                hit = any(h in recv for h in _RECEIVER_HINTS)
+            if hit:
+                out.append(
+                    mod.finding(
+                        "fleet-directory",
+                        node,
+                        f"`{name}(..)` outside openr_tpu/fleet/ mutates "
+                        "the live-node set behind the fabric's back; "
+                        "drive membership through FleetMembership (fleet/"
+                        "chaos/emulation tiers only)",
+                    )
+                )
+        return out

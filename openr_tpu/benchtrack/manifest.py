@@ -317,6 +317,37 @@ def _accept_frr(doc: dict) -> None:
     assert d["resume"]["table_hash_byte_identical"] is True
 
 
+def _spoil_fleet(doc: dict) -> None:
+    # the two fleet laws, both broken: a cross-node merge whose digest
+    # diverged from the single-node run, and a watcher migration that
+    # emitted a non-monotone generation — neither may ever pass
+    doc["detail"]["sweep"]["summary_digest_equal"] = False
+    doc["detail"]["streaming"]["invariant_violations"] = 1
+
+
+def _accept_fleet(doc: dict) -> None:
+    # the ISSUE-19 acceptance floor: the fleet sweep digest is
+    # byte-equal to single-node whatever the node count, a mid-sweep
+    # kill re-packs only the victim's worlds and still converges to the
+    # byte-identical digest AND manifest, and a mid-stream kill/drain
+    # migrates watchers with zero monotone violations and nothing from
+    # before the migration re-emitted
+    d = doc["detail"]
+    sw = d["sweep"]
+    assert sw["summary_digest_equal"] is True
+    assert sw["fleet_digest"] == sw["single_node_digest"] != ""
+    assert sw["kill"]["repacked_worlds"] >= 1
+    assert sw["kill"]["digest_equal"] is True
+    assert sw["kill"]["manifest_byte_identical"] is True
+    st = d["streaming"]
+    assert st["migrated_watchers"] >= 1
+    assert st["invariant_violations"] == 0
+    assert st["pre_migration_generation_emissions"] == 0
+    assert st["drain"]["invariant_violations"] == 0
+    assert st["drain"]["residual_subscribers"] == 0
+    assert st["deterministic_replay"] is True
+
+
 def _accept_rolling(doc: dict) -> None:
     # the ISSUE-12 acceptance floor: a rolling upgrade must stay WARM
     # (before the slot-stable encode this ratio was 0 by construction)
@@ -627,6 +658,36 @@ MANIFEST: Tuple[ArtifactSpec, ...] = (
         markers=("protection",),
         spoil=_spoil_frr,
         acceptance=_accept_frr,
+    ),
+    ArtifactSpec(
+        family="fleet",
+        pattern=r"BENCH_FLEET_r(\d+)\.json",
+        description=(
+            "fleet compute fabric: 3-node rendezvous-sharded capacity "
+            "sweep merged to the single-node digest (plus a mid-sweep "
+            "member kill re-packing only the victim's worlds), and "
+            "consistent-hash watcher migration under member kill/drain "
+            "with the monotone-generation invariant gated hard "
+            "(bench.py --fleet-sweep / --fleet-streaming; one combined "
+            "artifact — the halves share the membership plane)"
+        ),
+        validate=_v("fleet"),
+        headline=(
+            # wall-clock merge throughput of the 3-node sweep
+            # (machine-dependent, wide tolerance like the other
+            # wall-clock headlines)
+            HeadlineMetric("value", HIGHER, tolerance_pct=40.0),
+            # how much work a member kill forces back onto survivors
+            # (informational trajectory; grammar growth moves it)
+            HeadlineMetric(
+                "detail.sweep.kill.repacked_worlds",
+                LOWER,
+                ratchet=False,
+            ),
+        ),
+        markers=("fleet",),
+        spoil=_spoil_fleet,
+        acceptance=_accept_fleet,
     ),
 )
 

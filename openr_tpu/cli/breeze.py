@@ -873,6 +873,23 @@ def sweep_status(ctx: click.Context) -> None:
                 f"bytes={spill.get('bytes')} "
                 f"peak_host_rows={spill.get('peak_host_rows')}"
             )
+    fleet = st.get("fleet")
+    if fleet:
+        click.echo(
+            f"fleet {fleet.get('fleet_id')}: {fleet.get('state')}"
+            f"  nodes {fleet.get('nodes_live')}/{fleet.get('nodes_total')}"
+            f"  worlds {fleet.get('worlds_merged')}/"
+            f"{fleet.get('worlds_total')}"
+            f"  scenarios {fleet.get('scenarios_merged')}/"
+            f"{fleet.get('scenarios_total')}"
+            f"  repacked={fleet.get('repacked_worlds')}"
+            f" rounds={fleet.get('rounds')}"
+        )
+        for row in fleet.get("assignments", ()):
+            click.echo(
+                f"  {row['node']} r{row['round']}: {row['state']}"
+                f"  worlds={row['worlds']} scenarios={row['scenarios']}"
+            )
 
 
 @sweep.command("summary")

@@ -342,6 +342,24 @@ class ProtectionConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Fleet compute fabric knobs (openr_tpu.fleet, net-new vs the
+    reference): cross-node sweep sharding + the consistent-hash feed
+    directory over the member nodes.  See docs/Fleet.md."""
+
+    enabled: bool = False
+    #: fleet member node names (the NodeSet universe); empty + enabled
+    #: is a config error — a fleet of zero nodes can own nothing
+    member_nodes: List[str] = field(default_factory=list)
+    #: root of the fleet's spill/manifest tree ("" = /tmp/openr_tpu_fleet)
+    spill_root: str = ""
+    #: coordinator scheduling-pass cadence
+    poll_interval_s: float = 0.02
+    #: fleet-level ranked-summary depth (matches the sweep default)
+    summary_top_k: int = 64
+
+
+@dataclass
 class ParallelConfig:
     """Multi-chip data-parallel dispatch knobs (openr_tpu.parallel,
     net-new vs the reference): the DevicePool that owns the live-device
@@ -491,6 +509,7 @@ class OpenrConfig:
     protection_config: ProtectionConfig = field(
         default_factory=ProtectionConfig
     )
+    fleet_config: FleetConfig = field(default_factory=FleetConfig)
     originated_prefixes: List[OriginatedPrefix] = field(default_factory=list)
     segment_routing_config: SegmentRoutingConfig = field(
         default_factory=SegmentRoutingConfig
@@ -647,6 +666,19 @@ class OpenrConfig:
                         f"srlg group {g.name!r} link {pair!r} must be "
                         "two distinct node names"
                     )
+        fl = self.fleet_config
+        if fl.poll_interval_s <= 0 or fl.summary_top_k < 1:
+            raise ValueError(
+                "fleet needs poll_interval_s > 0 and summary_top_k >= 1"
+            )
+        if len(set(fl.member_nodes)) != len(fl.member_nodes):
+            raise ValueError(
+                f"duplicate fleet member nodes: {fl.member_nodes}"
+            )
+        if fl.enabled and not fl.member_nodes:
+            raise ValueError(
+                "fleet_config.enabled needs at least one member node"
+            )
         pr = self.protection_config
         if (
             pr.shard_scenarios < 1

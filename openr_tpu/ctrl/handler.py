@@ -586,6 +586,20 @@ class OpenrCtrlHandler:
         cancel`)."""
         return self.node.sweep.cancel_sweep()
 
+    # ----------------------------------------------------------------- fleet
+    # (openr_tpu.fleet — cross-node sweep sharding + the consistent-
+    # hash feed directory; net-new vs the reference)
+
+    def get_fleet_status(self) -> dict:
+        """Fleet-fabric view from this member: membership, world
+        assignment rounds, merge progress (`breeze sweep status`
+        renders the per-node rows).  "disabled" when this node carries
+        no fleet coordinator attachment."""
+        fleet = getattr(self.node, "fleet", None)
+        if fleet is None:
+            return {"state": "disabled"}
+        return fleet.status()
+
     # ------------------------------------------------------------ protection
     # (openr_tpu.protection — fast-reroute FIB patch tier minted from
     # the single-link failure sweep; net-new vs the reference)

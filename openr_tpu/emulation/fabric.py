@@ -1,0 +1,255 @@
+"""FleetFabric — the multi-node fleet harness on SimClock.
+
+N *fleet* nodes (serving + streaming + sweep services) over ONE shared
+Decision holding the fleet tables, plus the fleet tier itself:
+membership, feed directory, stream router and sweep coordinator.  The
+shared decision is the deployment shape the fleet assumes — every
+member serves the same generation-stamped tables, which is what makes
+generation seqs COMPARABLE across nodes (the monotone invariant across
+a watcher migration is meaningless otherwise) and sub-sweep rows
+mergeable into one content-addressed summary.
+
+The decision is driven exclusively through its public surfaces — the
+kv-store publication queue for topology/prefix churn (per-key version
+counters, withdrawals via ``expired_keys``) and the initialization
+event for the sync gate — the same discipline as a real daemon, so the
+harness exercises the production ingest path, not a test backdoor.
+
+Chaos verbs: ``kill_node`` stops a member's services and marks it down
+(a crash — watchers migrate, its sweep worlds re-pack);
+``drain_node`` marks it drained while its daemon stays up (maintenance
+— clean subscription hand-off).  All timing rides the SimClock.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from openr_tpu.common.runtime import Clock, CounterMap
+from openr_tpu.config import DecisionConfig, ServingConfig, SweepConfig
+from openr_tpu.decision.backend import ScalarBackend
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.emulation.topology import build_adj_dbs, grid_edges
+from openr_tpu.fleet import (
+    FeedDirectory,
+    FleetMembership,
+    FleetStreamRouter,
+    FleetSweepCoordinator,
+)
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.serving import QueryService, StreamingService
+from openr_tpu.sweep import SweepService
+from openr_tpu.types import (
+    InitializationEvent,
+    PrefixDatabase,
+    PrefixEntry,
+    Publication,
+    Value,
+    adj_key,
+    prefix_key,
+)
+
+
+class _FabricNode:
+    """One fleet member: serving + streaming + sweep over the shared
+    decision, its own counters."""
+
+    def __init__(self, name, clock, decision, serving_cfg, sweep_cfg):
+        self.name = name
+        self.counters = CounterMap()
+        self.serving = QueryService(
+            name, clock, serving_cfg, decision, counters=self.counters
+        )
+        self.streaming = StreamingService(
+            name, clock, serving_cfg, decision, self.serving,
+            counters=self.counters,
+        )
+        self.sweep = SweepService(
+            name, clock, sweep_cfg, decision, counters=self.counters
+        )
+        self.running = False
+
+    def start(self) -> None:
+        self.serving.start()
+        self.streaming.start()
+        self.sweep.start()
+        self.running = True
+
+    async def stop(self) -> None:
+        if not self.running:
+            return
+        self.running = False
+        await self.streaming.stop()
+        await self.serving.stop()
+        await self.sweep.stop()
+
+
+class FleetFabric:
+    """The whole fleet in one process, virtual time."""
+
+    def __init__(
+        self,
+        clock: Clock,
+        spill_root: str,
+        node_names: Sequence[str] = ("fab0", "fab1", "fab2"),
+        n_side: int = 4,
+        serving_overrides: Optional[dict] = None,
+        sweep_overrides: Optional[dict] = None,
+        coordinator_poll_s: float = 0.02,
+    ) -> None:
+        self.clock = clock
+        self.n_side = n_side
+        self.counters = CounterMap()
+        # -- the shared decision, fed through its public queue surface
+        self.routes_q = ReplicateQueue("fleet.routes")
+        self.kv_q = ReplicateQueue("fleet.kvpubs")
+        self.static_q = ReplicateQueue("fleet.static")
+        solver = SpfSolver("node0")
+        self.decision = Decision(
+            node_name="node0",
+            clock=clock,
+            config=DecisionConfig(),
+            route_updates_queue=self.routes_q,
+            kv_store_updates_reader=self.kv_q.get_reader(),
+            static_routes_reader=self.static_q.get_reader(),
+            solver=solver,
+            backend=ScalarBackend(solver),
+        )
+        #: per prefix-key version counter — churn bumps monotonically,
+        #: the KvStore conflict-resolution law
+        self._versions: Dict[str, int] = {}
+        serving_cfg = ServingConfig(**(serving_overrides or {}))
+        self.nodes: Dict[str, _FabricNode] = {}
+        for name in node_names:
+            sweep_cfg = SweepConfig(
+                spill_dir=f"{spill_root}/local.{name}",
+                **(sweep_overrides or {}),
+            )
+            self.nodes[name] = _FabricNode(
+                name, clock, self.decision, serving_cfg, sweep_cfg
+            )
+        # -- the fleet tier over the members
+        self.membership = FleetMembership(
+            node_names, counters=self.counters
+        )
+        self.directory = FeedDirectory(self.membership)
+        self.router = FleetStreamRouter(
+            self.directory,
+            {n: fab.streaming for n, fab in self.nodes.items()},
+            counters=self.counters,
+        )
+        self.coordinator = FleetSweepCoordinator(
+            clock,
+            self.membership,
+            {n: fab.sweep for n, fab in self.nodes.items()},
+            spill_root=f"{spill_root}/fleet",
+            counters=self.counters,
+            poll_interval_s=coordinator_poll_s,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the decision + every member, publish the grid topology
+        and per-node prefixes, release the sync gate.  Call inside a
+        running loop, then ``await clock.run_for(..)`` to converge."""
+        self.decision.start()
+        for fab in self.nodes.values():
+            fab.start()
+        edges = grid_edges(self.n_side)
+        dbs = build_adj_dbs(edges)
+        self.kv_q.push(
+            Publication(
+                key_vals={
+                    adj_key(name): self._adj_value(db)
+                    for name, db in dbs.items()
+                },
+                area="0",
+            )
+        )
+        for i in range(self.n_side * self.n_side):
+            self.announce_prefix(f"node{i}", f"10.{i}.0.0/24")
+        self.decision.on_initialization_event(
+            InitializationEvent.KVSTORE_SYNCED
+        )
+
+    async def stop(self) -> None:
+        self.coordinator.cancel()
+        await self.coordinator.stop()
+        for fab in self.nodes.values():
+            await fab.stop()
+        await self.decision.stop()
+
+    # -- LSDB churn (public publication path only) -------------------------
+
+    @staticmethod
+    def _adj_value(db) -> Value:
+        return Value(
+            version=1,
+            originator_id=db.this_node_name,
+            value=json.dumps(db.to_wire()).encode(),
+            ttl=300000,
+        )
+
+    def announce_prefix(self, node: str, prefix: str) -> None:
+        """Advertise (or re-advertise at a bumped version: churn) one
+        prefix for one topology node."""
+        key = prefix_key(node, prefix)
+        version = self._versions.get(key, 0) + 1
+        self._versions[key] = version
+        db = PrefixDatabase(
+            this_node_name=node,
+            prefix_entries=[PrefixEntry(prefix)],
+            area="0",
+        )
+        self.kv_q.push(
+            Publication(
+                key_vals={
+                    key: Value(
+                        version=version,
+                        originator_id=node,
+                        value=json.dumps(db.to_wire()).encode(),
+                        ttl=300000,
+                    )
+                },
+                area="0",
+            )
+        )
+
+    def withdraw_prefix(self, node: str, prefix: str) -> None:
+        self.kv_q.push(
+            Publication(
+                expired_keys=[prefix_key(node, prefix)], area="0"
+            )
+        )
+
+    # -- chaos verbs -------------------------------------------------------
+
+    async def kill_node(self, name: str) -> None:
+        """Crash one member: its services stop (subscriptions die with
+        the daemon) and membership marks it down — watchers migrate to
+        hash successors, its unmerged sweep worlds re-pack."""
+        await self.nodes[name].stop()
+        self.membership.node_down(name, reason="chaos-kill")
+
+    def drain_node(self, name: str) -> None:
+        """Maintenance-drain one member: daemon stays up, membership
+        marks it drained — clean hand-off of its watchers/worlds."""
+        self.membership.drain_node(name)
+
+    async def restore_node(self, name: str) -> None:
+        fab = self.nodes[name]
+        if not fab.running:
+            fab.start()
+        self.membership.node_up(name)
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "membership": self.membership.status(),
+            "router": self.router.status(),
+            "coordinator": self.coordinator.status(),
+        }

@@ -1,0 +1,59 @@
+"""Fleet compute fabric (ISSUE 19) — the tier above one node.
+
+Every earlier plane stops at a single daemon: a capacity sweep runs on
+ONE node's DevicePool, a watcher must dial the node that holds its
+feed.  This package is the cross-node tier both were designed for, two
+halves over one membership/directory core:
+
+* :mod:`openr_tpu.fleet.assignment` — rendezvous hashing: ownership is
+  a pure function of (content key, live-node set), so reassignment on
+  membership change is content-derived and minimal, never
+  arrival-ordered;
+* :mod:`openr_tpu.fleet.membership` — ``FleetMembership``, the single
+  writer of node liveness/drain state (NodeSet underneath — the
+  node-level DevicePool), feeding listeners and the health plane
+  (``fleet_node_loss`` pages, ``fleet_drain_migration`` tickets);
+* :mod:`openr_tpu.fleet.directory` — ``FeedDirectory`` +
+  ``FleetStreamRouter``: any live node serves a watcher's feed; node
+  death/drain migrates subscribers to the hash successor, who resyncs
+  with a fresh generation-stamped snapshot then deltas, the monotone-
+  generation invariant checked ACROSS the migration;
+* :mod:`openr_tpu.fleet.coordinator` — ``FleetSweepCoordinator``:
+  world-granular sweep sharding across N nodes' pools, merged through
+  the feed-order-independent reducer (merged digest byte-equal to a
+  single-node run), dead-node worlds re-packed onto survivors with a
+  pure-content fleet manifest that stays byte-identical to an
+  uninterrupted run's.
+
+Failure-domain hierarchy: chip < node.  A dead chip re-packs one shard
+inside its node's executor; a dead node re-packs whole worlds across
+the fleet and migrates its watchers.  See docs/Fleet.md.
+"""
+
+from openr_tpu.fleet.assignment import (
+    assign_worlds,
+    owner_of,
+    rank_members,
+    rendezvous_score,
+)
+from openr_tpu.fleet.coordinator import FleetSweepCoordinator
+from openr_tpu.fleet.directory import (
+    FeedDirectory,
+    FleetStreamRouter,
+    FleetWatcher,
+    feed_key,
+)
+from openr_tpu.fleet.membership import FleetMembership
+
+__all__ = [
+    "FeedDirectory",
+    "FleetMembership",
+    "FleetStreamRouter",
+    "FleetSweepCoordinator",
+    "FleetWatcher",
+    "assign_worlds",
+    "feed_key",
+    "owner_of",
+    "rank_members",
+    "rendezvous_score",
+]

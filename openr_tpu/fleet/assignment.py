@@ -1,0 +1,73 @@
+"""Content-derived world assignment — rendezvous hashing, pure functions.
+
+The fleet's one assignment law: who owns what is a PURE FUNCTION of
+``(content key, live-node set)``.  No arrival order, no coordinator
+state, no rebalance history — two coordinators (or one coordinator
+before and after a crash) looking at the same scenario-set hash and the
+same live set compute byte-identical assignments.  Rendezvous (highest
+random weight) hashing gives that plus minimal reshuffle: when a node
+dies, ONLY the keys it owned move (each to its second-ranked member);
+everything else stays put, which is what keeps a mid-sweep node kill
+from perturbing the surviving nodes' work.
+
+Both fleet halves consume the same primitives: the sweep coordinator
+assigns ``World.key()`` strings salted by the scenario-set hash, the
+feed directory assigns canonical feed keys salted by the directory
+namespace.  See docs/Fleet.md §"The assignment function".
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Sequence, Tuple
+
+
+def rendezvous_score(salt: str, key: str, member: str) -> int:
+    """The HRW weight of ``member`` for ``key`` under ``salt`` — the
+    integer value of the first 16 bytes of
+    ``sha256(f"{salt}|{key}|{member}")``.  128 bits: collisions are
+    not a practical concern, but ties still break by member name so
+    the function stays total."""
+    h = hashlib.sha256(
+        f"{salt}|{key}|{member}".encode("utf-8")
+    ).digest()
+    return int.from_bytes(h[:16], "big")
+
+
+def rank_members(
+    salt: str, key: str, members: Sequence[str]
+) -> List[str]:
+    """Members ordered by descending rendezvous score (name-ascending
+    on the astronomically unlikely tie).  Index 0 is the owner; index
+    1 is where the key migrates when the owner dies."""
+    return sorted(
+        members,
+        key=lambda m: (-rendezvous_score(salt, key, m), m),
+    )
+
+
+def owner_of(salt: str, key: str, members: Sequence[str]) -> str:
+    """The highest-ranked member for ``key`` (raises on an empty
+    member set — callers decide what "nobody is live" means)."""
+    ranked = rank_members(salt, key, members)
+    if not ranked:
+        raise ValueError(f"owner_of({key!r}): no live members")
+    return ranked[0]
+
+
+def assign_worlds(
+    set_hash: str,
+    world_keys: Sequence[str],
+    live_nodes: Sequence[str],
+) -> Dict[str, Tuple[str, ...]]:
+    """Pack sweep worlds onto live nodes: ``{node: (world_key, ...)}``,
+    worlds in canonical (sorted) order per node, nodes with no worlds
+    omitted.  Salted by the scenario-set hash so two different sweeps
+    over the same topology shuffle independently."""
+    if not live_nodes:
+        raise ValueError("assign_worlds: no live nodes")
+    out: Dict[str, List[str]] = {}
+    for wk in sorted(set(world_keys)):
+        node = owner_of(set_hash, wk, live_nodes)
+        out.setdefault(node, []).append(wk)
+    return {n: tuple(ws) for n, ws in sorted(out.items())}

@@ -1,0 +1,445 @@
+"""FleetSweepCoordinator — one capacity sweep across N nodes' pools.
+
+The single-node SweepService already packs scenarios by (world, hash)
+into committed, resumable shards; the coordinator lifts that one tier:
+it enumerates the FULL scenario set once, content-derives the
+world→node assignment (assignment.py: pure function of
+(scenario_set_hash, live set)), drives each node's SweepService with a
+``world_filter`` sub-sweep solved from ONE shared vantage, and merges
+every node's spill stream through the feed-order-independent
+SweepReducer — so the merged summary digest is byte-equal to a
+single-node run of the same set, whatever the node count or feed
+interleaving.
+
+Failure domains compose: a dead CHIP re-packs its shard inside one
+node's executor (PR-8); a dead NODE is the domain above it — the
+coordinator discards the dead node's *unmerged* spill entirely (a
+partial spill would force row-level dedup; world-granular re-solve is
+deterministic and duplicate-free), re-packs ALL its incomplete worlds
+onto the survivors as the next assignment round, and keeps merged work
+untouched.  The fleet manifest is pure content — (set hash, completed
+worlds, totals) in canonical JSON — so at completion its bytes are
+identical to an uninterrupted run's, whatever the kill history; the
+operational world→spill routing that replay needs lives in a separate
+sidecar, explicitly NOT part of the byte-identity contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.fleet.assignment import assign_worlds
+from openr_tpu.fleet.membership import FleetMembership
+from openr_tpu.sweep import (
+    ScenarioSpec,
+    SpillReader,
+    SweepError,
+    SweepReducer,
+    enumerate_scenarios,
+    scenario_set_hash,
+)
+from openr_tpu.sweep.scenario import canonical_json
+
+MANIFEST_NAME = "fleet_manifest.json"
+ROUTING_NAME = "fleet_routing.json"
+
+
+class _Task:
+    """One (node, round, world set) sub-sweep assignment."""
+
+    __slots__ = (
+        "node", "round", "worlds", "scenarios", "state", "spill_dir",
+    )
+
+    def __init__(self, node, rnd, worlds, scenarios, spill_dir) -> None:
+        self.node = node
+        self.round = rnd
+        self.worlds: Tuple[str, ...] = worlds
+        self.scenarios = scenarios
+        #: pending|running|merged|lost
+        self.state = "pending"
+        self.spill_dir = spill_dir
+
+
+class FleetSweepCoordinator(Actor):
+    """Drives one fleet sweep over the member nodes' SweepServices.
+
+    ``services`` maps fleet node name -> that node's SweepService.
+    ``prepare`` enumerates + assigns (resuming from the fleet manifest
+    when it matches); ``run`` pumps until every world is merged or the
+    sweep is cancelled.  Everything the coordinator touches on a
+    SweepService is its public ctrl surface — start_sweep /
+    get_sweep_status / state — so a real deployment swaps the direct
+    references for ctrl RPC without changing this logic.
+    """
+
+    def __init__(
+        self,
+        clock: Clock,
+        membership: FleetMembership,
+        services: Dict[str, object],
+        spill_root: str,
+        counters: Optional[CounterMap] = None,
+        top_k: int = 64,
+        poll_interval_s: float = 0.02,
+    ) -> None:
+        super().__init__("fleet", clock, counters)
+        self.membership = membership
+        self.services = dict(services)
+        self.spill_root = spill_root
+        self.top_k = top_k
+        self.poll_interval_s = poll_interval_s
+        self.state = "idle"  # idle|running|done|cancelled|failed
+        self.error = ""
+        self.fleet_id = ""
+        self.set_hash = ""
+        self.params: dict = {}
+        self.vantage = ""
+        self.worlds_total = 0
+        self.scenarios_total = 0
+        self.world_scenarios: Dict[str, int] = {}
+        self.completed_worlds: set = set()
+        self.tasks: List[_Task] = []
+        self.rounds = 0
+        self.repacked_worlds = 0
+        self.reducer = SweepReducer(top_k=top_k)
+        self._cancelled = False
+        #: node -> the task currently running on it
+        self._running: Dict[str, _Task] = {}
+
+    # -- manifest ----------------------------------------------------------
+
+    def _dir(self) -> str:
+        return os.path.join(self.spill_root, self.fleet_id)
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self._dir(), MANIFEST_NAME)
+
+    def _routing_path(self) -> str:
+        return os.path.join(self._dir(), ROUTING_NAME)
+
+    def _atomic_write(self, path: str, text: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def manifest_doc(self) -> dict:
+        """Pure content: identical bytes for identical progress,
+        whatever the node count or kill history."""
+        return {
+            "fleet_set_hash": self.set_hash,
+            "scenarios_total": self.scenarios_total,
+            "worlds_total": self.worlds_total,
+            "completed_worlds": sorted(self.completed_worlds),
+        }
+
+    def manifest_bytes(self) -> bytes:
+        return canonical_json(self.manifest_doc()).encode()
+
+    def _write_manifest(self) -> None:
+        self._atomic_write(
+            self._manifest_path(), canonical_json(self.manifest_doc())
+        )
+
+    def _write_routing(self) -> None:
+        # operational sidecar (NOT content): which spill dir replays
+        # which merged worlds on resume
+        doc = {
+            "fleet_set_hash": self.set_hash,
+            "merged": [
+                {
+                    "node": t.node,
+                    "round": t.round,
+                    "spill_dir": t.spill_dir,
+                    "worlds": list(t.worlds),
+                }
+                for t in self.tasks
+                if t.state == "merged"
+            ],
+        }
+        self._atomic_write(
+            self._routing_path(),
+            json.dumps(doc, indent=1, sort_keys=True),
+        )
+
+    # -- preparation -------------------------------------------------------
+
+    def prepare(self, params: Optional[dict] = None, resume: bool = True) -> dict:
+        """Enumerate the full set, derive the assignment, and (when the
+        fleet manifest matches) resume: merged worlds replay from their
+        recorded spills, everything else re-packs over the CURRENT live
+        set."""
+        params = dict(params or {})
+        params.pop("world_filter", None)  # the coordinator owns filters
+        live = self.membership.live_nodes()
+        if not live:
+            raise SweepError("fleet sweep: no live nodes")
+        lead = self.services[live[0]]
+        spec = ScenarioSpec.from_params(lead.config, params)
+        pairs = lead.enumeration_pairs()
+        scenarios = enumerate_scenarios(spec, pairs)
+        if not scenarios:
+            raise SweepError("fleet sweep: grammar enumerates zero scenarios")
+        self.params = params
+        self.vantage = str(
+            params.get("root")
+            or lead.decision.capacity_sweep_inputs()["root"]
+        )
+        self.set_hash = scenario_set_hash(spec, scenarios)
+        self.fleet_id = self.set_hash[:16]
+        self.world_scenarios = {}
+        for s in scenarios:
+            wk = s.world.key()
+            self.world_scenarios[wk] = self.world_scenarios.get(wk, 0) + 1
+        self.worlds_total = len(self.world_scenarios)
+        self.scenarios_total = len(scenarios)
+        self.completed_worlds = set()
+        self.tasks = []
+        self.rounds = 0
+        self.repacked_worlds = 0
+        self.reducer = SweepReducer(top_k=self.top_k)
+        self._cancelled = False
+        self._running = {}
+        os.makedirs(self._dir(), exist_ok=True)
+        resumed_worlds = 0
+        if resume:
+            resumed_worlds = self._resume_from_manifest()
+        pending = [
+            wk
+            for wk in sorted(self.world_scenarios)
+            if wk not in self.completed_worlds
+        ]
+        if pending:
+            self._assign_round(pending, live)
+        self.state = "running" if pending else "done"
+        for svc in self.services.values():
+            svc.attach_fleet(self.status)
+        self._write_manifest()
+        self.counters.bump("fleet.sweeps_prepared")
+        return {
+            "fleet_id": self.fleet_id,
+            "set_hash": self.set_hash,
+            "scenarios": self.scenarios_total,
+            "worlds": self.worlds_total,
+            "nodes": len(live),
+            "resumed_worlds": resumed_worlds,
+            "state": self.state,
+        }
+
+    def _resume_from_manifest(self) -> int:
+        try:
+            with open(self._manifest_path(), encoding="utf-8") as f:
+                man = json.load(f)
+            with open(self._routing_path(), encoding="utf-8") as f:
+                routing = json.load(f)
+        except (OSError, ValueError):
+            return 0
+        if man.get("fleet_set_hash") != self.set_hash:
+            return 0
+        if routing.get("fleet_set_hash") != self.set_hash:
+            return 0
+        completed = set(man.get("completed_worlds", ()))
+        replayed: set = set()
+        max_round = -1
+        for entry in routing.get("merged", ()):
+            worlds = tuple(entry.get("worlds", ()))
+            if not worlds or not set(worlds) <= completed:
+                continue
+            try:
+                rows = list(SpillReader(entry["spill_dir"]).rows())
+            except OSError:
+                continue
+            self.reducer.feed(rows)
+            t = _Task(
+                entry.get("node", "?"),
+                int(entry.get("round", 0)),
+                worlds,
+                sum(self.world_scenarios.get(w, 0) for w in worlds),
+                entry["spill_dir"],
+            )
+            t.state = "merged"
+            self.tasks.append(t)
+            replayed |= set(worlds)
+            max_round = max(max_round, t.round)
+        self.completed_worlds = replayed
+        self.rounds = max_round + 1
+        if replayed:
+            self.counters.bump("fleet.resumed_worlds", len(replayed))
+        return len(replayed)
+
+    def _assign_round(
+        self, worlds: List[str], live: Tuple[str, ...]
+    ) -> None:
+        rnd = self.rounds
+        self.rounds += 1
+        for node, wks in assign_worlds(
+            self.set_hash, worlds, live
+        ).items():
+            self.tasks.append(
+                _Task(
+                    node,
+                    rnd,
+                    wks,
+                    sum(self.world_scenarios[w] for w in wks),
+                    os.path.join(self._dir(), f"{node}.r{rnd}"),
+                )
+            )
+
+    # -- the pump ----------------------------------------------------------
+
+    def _pump(self) -> None:
+        """One scheduling pass: repack lost work, merge finished work,
+        launch pending work on idle live nodes."""
+        # 1. a running task on a node that left the live set is LOST:
+        #    its spill is discarded (never merged) and every one of its
+        #    worlds re-packs over the survivors as a fresh round
+        lost_worlds: List[str] = []
+        for t in self.tasks:
+            if t.state == "running" and not self.membership.is_live(t.node):
+                t.state = "lost"
+                self._running.pop(t.node, None)
+                lost_worlds.extend(t.worlds)
+        # pending tasks stranded on dead nodes re-pack the same way
+        for t in self.tasks:
+            if t.state == "pending" and not self.membership.is_live(t.node):
+                t.state = "lost"
+                lost_worlds.extend(t.worlds)
+        if lost_worlds:
+            live = self.membership.live_nodes()
+            if not live:
+                self.state = "failed"
+                self.error = "fleet sweep: no survivors to re-pack onto"
+                return
+            self.repacked_worlds += len(set(lost_worlds))
+            self.counters.bump(
+                "fleet.repacked_worlds", len(set(lost_worlds))
+            )
+            self._assign_round(sorted(set(lost_worlds)), live)
+        # 2. merge every finished sub-sweep (order never matters: the
+        #    reducer is feed-order-independent)
+        for node, t in list(self._running.items()):
+            svc = self.services[node]
+            if not self.membership.is_live(node):
+                continue  # handled as lost next pass
+            if svc.state == "done":
+                rows = list(SpillReader(t.spill_dir).rows())
+                self.reducer.feed(rows)
+                t.state = "merged"
+                self.completed_worlds |= set(t.worlds)
+                self._running.pop(node)
+                self._write_manifest()
+                self._write_routing()
+                self.counters.bump("fleet.merged_worlds", len(t.worlds))
+            elif svc.state in ("failed", "cancelled"):
+                # treat like a lost node: re-solve its worlds elsewhere
+                t.state = "lost"
+                self._running.pop(node)
+                live = [
+                    n
+                    for n in self.membership.live_nodes()
+                    if n != node
+                ] or list(self.membership.live_nodes())
+                self.repacked_worlds += len(t.worlds)
+                self._assign_round(sorted(t.worlds), tuple(live))
+        # 3. launch pending tasks on idle live nodes, earliest round
+        #    first (a node's repack work queues behind its current task)
+        for t in self.tasks:
+            if t.state != "pending":
+                continue
+            if not self.membership.is_live(t.node):
+                continue
+            if t.node in self._running:
+                continue
+            svc = self.services[t.node]
+            if svc.state == "running":
+                continue
+            svc.start_sweep(
+                {
+                    **self.params,
+                    "world_filter": list(t.worlds),
+                    "spill_dir": t.spill_dir,
+                    "root": self.vantage,
+                    "resume": True,
+                }
+            )
+            t.state = "running"
+            self._running[t.node] = t
+            self.counters.bump("fleet.subsweeps_started")
+
+    async def run(self) -> None:
+        """Pump until the whole set is merged (or cancel/failure)."""
+        while self.state == "running" and not self._cancelled:
+            self._pump()
+            if len(self.completed_worlds) == self.worlds_total:
+                self.state = "done"
+                self._write_manifest()
+                break
+            if self.state == "failed":
+                break
+            self.touch()
+            await self.clock.sleep(self.poll_interval_s)
+        if self._cancelled and self.state == "running":
+            self.state = "cancelled"
+        self.counters.bump(f"fleet.sweeps_{self.state}")
+
+    def cancel(self) -> dict:
+        self._cancelled = True
+        for node, _t in self._running.items():
+            self.services[node].cancel_sweep()
+        return {"state": self.state}
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        live = self.membership.live_nodes()
+        return {
+            "fleet_id": self.fleet_id,
+            "set_hash": self.set_hash,
+            "state": self.state,
+            "nodes_live": len(live),
+            "nodes_total": len(self.membership.names),
+            "worlds_total": self.worlds_total,
+            "worlds_merged": len(self.completed_worlds),
+            "scenarios_total": self.scenarios_total,
+            "scenarios_merged": self.reducer.scenarios,
+            "repacked_worlds": self.repacked_worlds,
+            "rounds": self.rounds,
+            "assignments": [
+                {
+                    "node": t.node,
+                    "round": t.round,
+                    "worlds": len(t.worlds),
+                    "scenarios": t.scenarios,
+                    "state": t.state,
+                }
+                for t in self.tasks
+            ],
+        }
+
+    def summary(self) -> dict:
+        complete = self.state == "done"
+        return {
+            "fleet_id": self.fleet_id,
+            "set_hash": self.set_hash,
+            "state": self.state,
+            "complete": complete,
+            "summary": self.reducer.summary() if complete else None,
+            "summary_digest": (
+                self.reducer.summary_digest() if complete else ""
+            ),
+        }
+
+    def gauges(self) -> Dict[str, float]:
+        return {
+            "fleet.running": 1.0 if self.state == "running" else 0.0,
+            "fleet.worlds_total": float(self.worlds_total),
+            "fleet.worlds_merged": float(len(self.completed_worlds)),
+            "fleet.repacked_worlds": float(self.repacked_worlds),
+            "fleet.rounds": float(self.rounds),
+        }

@@ -1,0 +1,260 @@
+"""FeedDirectory + FleetStreamRouter — the consistent-hash watch plane.
+
+A watcher of vantage X should be served by ANY node holding the fleet
+tables, not the one node it happened to dial.  ``FeedDirectory`` maps
+each canonical feed key to its owner by rendezvous hash over the LIVE
+serving nodes (assignment.py's one law: pure function of (key, live
+set)).  ``FleetStreamRouter`` holds the fleet's watchers, subscribes
+each to its owner's StreamingService (PR-13 push transport), and on
+every membership transition re-derives ownership: a watcher whose
+serving node died or drained migrates to the hash successor, who pushes
+a fresh generation-stamped snapshot and then deltas — resync riding the
+existing snapshot+delta machinery.
+
+The migration invariant (checked per watcher, per emission): the
+monotone-generation contract HOLDS ACROSS the migration — a delta's seq
+is strictly above the cursor, a snapshot's at or above it, and no
+generation older than the migration floor (the cursor at hand-off) is
+ever re-emitted.  The chaos tier proves zero violations under node
+kills; the fleet bench ratchets it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.common.runtime import CounterMap
+from openr_tpu.fleet.assignment import owner_of, rank_members
+from openr_tpu.fleet.membership import FleetMembership
+from openr_tpu.serving import apply_emission, canonical_query
+
+#: salt namespacing feed-key hashes away from sweep-world hashes
+DIRECTORY_SALT = "fleet.feeds"
+
+
+def feed_key(kind: str, params: dict) -> str:
+    """The directory's content address for one feed: the serving
+    plane's canonical query (order-normalized), stringified so it can
+    salt a rendezvous hash."""
+    return str(canonical_query(kind, dict(params or {})))
+
+
+class FeedDirectory:
+    """Who serves which feed, derived — never stored.
+
+    Ownership is recomputed from the live set on every lookup, so the
+    directory cannot drift from membership: a dead node stops owning
+    its feeds the instant membership marks it down.
+    """
+
+    def __init__(self, membership: FleetMembership) -> None:
+        self.membership = membership
+
+    def owner(self, kind: str, params: dict) -> Optional[str]:
+        """The live node serving this feed (None when nothing is
+        live)."""
+        live = self.membership.live_nodes()
+        if not live:
+            return None
+        return owner_of(DIRECTORY_SALT, feed_key(kind, params), live)
+
+    def owners(self, kind: str, params: dict, k: int = 2) -> Tuple[str, ...]:
+        """The first ``k`` ranked live nodes — index 0 serves, index 1
+        is the migration successor the runbook points operators at."""
+        live = self.membership.live_nodes()
+        return tuple(
+            rank_members(DIRECTORY_SALT, feed_key(kind, params), live)[:k]
+        )
+
+
+class FleetWatcher:
+    """One fleet-level subscriber: a push transport recording every
+    emission, the applied client state, and the migration-invariant
+    bookkeeping.  Violations are COUNTED, never raised — raising inside
+    a deliver callback would poison the publisher's fan-out fiber."""
+
+    def __init__(self, watcher_id: int, kind: str, params: dict,
+                 client_id: str) -> None:
+        self.watcher_id = watcher_id
+        self.kind = kind
+        self.params = dict(params or {})
+        self.client_id = client_id
+        self.emissions: List[dict] = []
+        self.state: Dict[tuple, object] = {}
+        #: last generation seq applied; -1 = nothing yet
+        self.cursor_seq = -1
+        #: cursor at the most recent hand-off — nothing older than this
+        #: may ever be emitted again
+        self.migration_floor = -1
+        self.migrations = 0
+        self.invariant_violations = 0
+        self.pre_migration_re_emissions = 0
+        self.serving_node: Optional[str] = None
+        self.sub_id: Optional[int] = None
+
+    def deliver(self, emission: dict) -> None:
+        seq = int(emission["seq"])
+        snapshot = emission.get("type") == "snapshot"
+        ok = (
+            seq >= self.cursor_seq if snapshot else seq > self.cursor_seq
+        )
+        if not ok:
+            self.invariant_violations += 1
+        if seq < self.migration_floor:
+            self.pre_migration_re_emissions += 1
+        self.emissions.append(emission)
+        self.state = apply_emission(self.state, emission)
+        self.cursor_seq = max(self.cursor_seq, seq)
+
+    def note_migration(self) -> None:
+        self.migration_floor = max(self.migration_floor, self.cursor_seq)
+        self.migrations += 1
+
+    def seqs(self) -> List[int]:
+        return [int(e["seq"]) for e in self.emissions]
+
+    def log_bytes(self) -> bytes:
+        import json
+
+        return b"\n".join(
+            json.dumps(e, sort_keys=True, default=str).encode()
+            for e in self.emissions
+        )
+
+
+class FleetStreamRouter:
+    """Places fleet watchers on their directory owners and migrates
+    them when membership moves.
+
+    ``streaming_services`` maps fleet node name -> that node's
+    StreamingService (all holding the same fleet tables — the shared
+    decision is what makes generation seqs comparable across nodes, so
+    the monotone invariant is meaningful across a migration).
+    """
+
+    def __init__(
+        self,
+        directory: FeedDirectory,
+        streaming_services: Dict[str, object],
+        counters: Optional[CounterMap] = None,
+    ) -> None:
+        self.directory = directory
+        self.services = dict(streaming_services)
+        self.counters = counters if counters is not None else CounterMap()
+        self.watchers: List[FleetWatcher] = []
+        self._next_id = 0
+        self.num_migrations = 0
+        self.num_orphaned = 0
+        self.directory.membership.add_listener(self._on_membership)
+
+    # -- watch surface -----------------------------------------------------
+
+    def watch(
+        self,
+        kind: str,
+        params: Optional[dict] = None,
+        client_id: str = "",
+        prefix_filters: Tuple[str, ...] = (),
+    ) -> FleetWatcher:
+        """Create a fleet watcher and attach it to its directory owner
+        (snapshot pushes synchronously on subscribe)."""
+        w = FleetWatcher(
+            self._next_id, kind, dict(params or {}),
+            client_id or f"fleet-w{self._next_id}",
+        )
+        w.prefix_filters = tuple(prefix_filters)
+        self._next_id += 1
+        self.watchers.append(w)
+        self.counters.bump("fleet.directory.watches")
+        self._attach(w)
+        return w
+
+    def unwatch(self, w: FleetWatcher) -> None:
+        self._detach(w, unsubscribe=True)
+        if w in self.watchers:
+            self.watchers.remove(w)
+
+    # -- placement ---------------------------------------------------------
+
+    def _attach(self, w: FleetWatcher) -> None:
+        owner = self.directory.owner(w.kind, w.params)
+        if owner is None:
+            w.serving_node = None
+            w.sub_id = None
+            self.num_orphaned += 1
+            self.counters.bump("fleet.directory.orphaned")
+            return
+        svc = self.services[owner]
+        w.sub_id = svc.subscribe(
+            w.kind,
+            dict(w.params),
+            client_id=w.client_id,
+            prefix_filters=getattr(w, "prefix_filters", ()),
+            deliver=w.deliver,
+        )
+        w.serving_node = owner
+
+    def _detach(self, w: FleetWatcher, unsubscribe: bool) -> None:
+        if (
+            unsubscribe
+            and w.serving_node is not None
+            and w.sub_id is not None
+        ):
+            self.services[w.serving_node].unsubscribe(w.sub_id)
+        w.serving_node = None
+        w.sub_id = None
+
+    def _on_membership(self, event: dict) -> None:
+        """Re-derive every watcher's owner against the new live set and
+        move the ones whose placement changed.  A crashed node's
+        subscriptions die with its daemon (no unsubscribe RPC to a
+        corpse); a drained node is still up, so its subscriptions are
+        detached cleanly before hand-off."""
+        for w in list(self.watchers):
+            owner = self.directory.owner(w.kind, w.params)
+            if owner == w.serving_node:
+                continue
+            old = w.serving_node
+            # up, not live: a DRAINED node's daemon still answers, so
+            # its subscription must be detached (or it keeps pushing
+            # alongside the successor); a crashed node's died with it
+            clean = old is not None and self.directory.membership.is_up(
+                old
+            )
+            self._detach(w, unsubscribe=clean)
+            if owner is None:
+                self.num_orphaned += 1
+                self.counters.bump("fleet.directory.orphaned")
+                continue
+            if old is not None:
+                # a real hand-off: pin the floor BEFORE the successor's
+                # snapshot pushes, so the re-emission audit sees it
+                w.note_migration()
+                self.num_migrations += 1
+                self.counters.bump("fleet.directory.migrations")
+            self._attach(w)
+
+    # -- observability -----------------------------------------------------
+
+    def invariant_violations(self) -> int:
+        return sum(w.invariant_violations for w in self.watchers)
+
+    def pre_migration_re_emissions(self) -> int:
+        return sum(w.pre_migration_re_emissions for w in self.watchers)
+
+    def status(self) -> dict:
+        placement: Dict[str, int] = {}
+        for w in self.watchers:
+            placement[w.serving_node or "-"] = (
+                placement.get(w.serving_node or "-", 0) + 1
+            )
+        return {
+            "watchers": len(self.watchers),
+            "placement": dict(sorted(placement.items())),
+            "migrations": self.num_migrations,
+            "orphaned": self.num_orphaned,
+            "invariant_violations": self.invariant_violations(),
+            "pre_migration_re_emissions": (
+                self.pre_migration_re_emissions()
+            ),
+        }

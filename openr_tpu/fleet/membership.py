@@ -1,0 +1,134 @@
+"""FleetMembership — the fleet's single writer of node liveness.
+
+Wraps ``openr_tpu.parallel.nodes.NodeSet`` (the node-level DevicePool
+analogue) behind the mutator surface orlint's ``fleet-directory`` rule
+owns: ONLY the fleet/chaos/emulation tiers may call ``node_down`` /
+``node_up`` / ``drain_node`` / ``undrain_node``.  Every transition
+bumps the membership seq, notifies registered listeners (the sweep
+coordinator re-packs, the stream router migrates), and feeds the
+health plane: an unexpected down is a PAGE (``fleet_node_loss``), a
+drain is a TICKET (``fleet_drain_migration`` — the migration is the
+expected behaviour, the ticket just audits it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from openr_tpu.common.runtime import CounterMap
+from openr_tpu.parallel.nodes import NodeSet
+
+
+class FleetMembership:
+    """Liveness + drain state for the fleet's member nodes.
+
+    The read surface (``live_nodes`` above all) is what the
+    content-derived assignment and directory hashes consume; the write
+    surface is orlint-owned.  Listeners fire synchronously AFTER the
+    transition commits, in registration order, with an event dict —
+    consumers that need async work schedule it themselves.
+    """
+
+    def __init__(
+        self,
+        names: Sequence[str],
+        counters: Optional[CounterMap] = None,
+    ) -> None:
+        self.nodes = NodeSet(names)
+        self.counters = counters if counters is not None else CounterMap()
+        self._listeners: List[Callable[[dict], None]] = []
+
+    # -- read surface ------------------------------------------------------
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return self.nodes.names
+
+    @property
+    def membership_seq(self) -> int:
+        return self.nodes.membership_seq
+
+    def live_nodes(self) -> Tuple[str, ...]:
+        return self.nodes.live_nodes()
+
+    def is_live(self, name: str) -> bool:
+        return self.nodes.is_live(name)
+
+    def is_up(self, name: str) -> bool:
+        """Up ≠ live: a drained node is up (its daemon answers — clean
+        subscription hand-off) but not live (it owns nothing)."""
+        return self.nodes.is_up(name)
+
+    def add_listener(self, cb: Callable[[dict], None]) -> None:
+        self._listeners.append(cb)
+
+    # -- transitions (fleet-directory rule: fleet/chaos/emulation only) ----
+
+    def node_down(self, name: str, reason: str = "crash") -> bool:
+        if not self.nodes.mark_down(name):
+            return False
+        self.counters.bump("fleet.membership.node_down")
+        self._notify("node_down", name, reason)
+        return True
+
+    def node_up(self, name: str, reason: str = "restart") -> bool:
+        if not self.nodes.mark_up(name):
+            return False
+        self.counters.bump("fleet.membership.node_up")
+        self._notify("node_up", name, reason)
+        return True
+
+    def drain_node(self, name: str, reason: str = "maintenance") -> bool:
+        if not self.nodes.mark_drained(name):
+            return False
+        self.counters.bump("fleet.membership.drain")
+        self._notify("node_drained", name, reason)
+        return True
+
+    def undrain_node(self, name: str, reason: str = "maintenance") -> bool:
+        if not self.nodes.clear_drained(name):
+            return False
+        self.counters.bump("fleet.membership.undrain")
+        self._notify("node_undrained", name, reason)
+        return True
+
+    def _notify(self, event: str, name: str, reason: str) -> None:
+        ev = {
+            "event": event,
+            "node": name,
+            "reason": reason,
+            "membership_seq": self.nodes.membership_seq,
+            "live": list(self.nodes.live_nodes()),
+        }
+        for cb in list(self._listeners):
+            cb(ev)
+
+    # -- health plane ------------------------------------------------------
+
+    def health_firing(self) -> Dict[str, dict]:
+        """The fleet's contribution to the AlertSink firing set: a PAGE
+        while any member is down (node-loss is the failure domain above
+        the chip — see health/alerts.py), a TICKET while any member is
+        drained (the watcher/world migration is EXPECTED; the ticket
+        audits that it completed)."""
+        firing: Dict[str, dict] = {}
+        down = self.nodes.down_nodes()
+        if down:
+            firing["fleet_node_loss"] = {
+                "nodes": list(down),
+                "live": len(self.nodes.live_nodes()),
+            }
+        drained = self.nodes.drained_nodes()
+        if drained:
+            firing["fleet_drain_migration"] = {
+                "nodes": list(drained),
+            }
+        return firing
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        return self.nodes.status()
+
+    def counter_snapshot(self) -> dict:
+        return self.nodes.counter_snapshot(prefix="fleet.membership")

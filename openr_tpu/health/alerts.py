@@ -80,6 +80,19 @@ ALERTS: Dict[str, tuple] = {
         "the confirming warm solve (the table was purged and the RIB "
         "full-synced, but a wrong route was briefly installed)",
     ),
+    "fleet_node_loss": (
+        SEV_PAGE,
+        "a fleet member node is DOWN (the failure domain above the "
+        "chip): its sweep worlds re-pack onto survivors and its "
+        "watchers migrate to hash successors, but capacity is lost "
+        "until the node returns",
+    ),
+    "fleet_drain_migration": (
+        SEV_TICKET,
+        "a fleet member node is drained for maintenance — its "
+        "watchers/worlds migrated by design; the ticket audits that "
+        "the hand-off completed and the drain is not forgotten",
+    ),
     "slo_convergence_p99": (
         SEV_PAGE,
         "publication->FIB convergence p99 is burning its error "

@@ -1,0 +1,144 @@
+"""Node-level health set — the failure domain ABOVE the chip.
+
+``DevicePool`` (mesh.py) models per-chip failure domains inside one
+daemon; the fleet compute fabric (openr_tpu.fleet) needs the same
+discipline one level up: which NODES are alive, which are drained for
+maintenance, and a monotonic membership generation consumers compare to
+detect that assignment re-packed underneath them.  ``NodeSet`` is that
+primitive — a pure bookkeeping structure with DevicePool's shape
+(healthy mask, seq, deterministic ordering) at node granularity.
+
+Ownership: the fabric's membership plane (``FleetMembership``) is the
+only writer — the fleet/chaos/emulation tiers drive IT, and orlint's
+``fleet-directory`` rule enforces the boundary at the membership
+surface, exactly like ``resilience-latch`` does for the chip mask.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+
+class NodeSet:
+    """The fleet's member nodes with per-node liveness + drain state.
+
+    A node is *live* when it is up and not drained: live nodes receive
+    sweep-world assignments and feed-directory ownership.  ``down`` is
+    the crash shape (unexpected — alerts page); ``drained`` is the
+    maintenance shape (expected — its load migrates quietly).  Both
+    bump ``membership_seq`` so any consumer holding an assignment can
+    detect the re-pack.
+    """
+
+    def __init__(self, names: Sequence[str]) -> None:
+        names = [str(n) for n in names]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate node names: {names}")
+        if not names:
+            raise ValueError("NodeSet needs at least one node")
+        #: deterministic member order (sorted once, never by arrival)
+        self.names: Tuple[str, ...] = tuple(sorted(names))
+        self._up: Dict[str, bool] = {n: True for n in self.names}
+        self._drained: Dict[str, bool] = {n: False for n in self.names}
+        #: monotonic membership generation: bumps on every down/up/
+        #: drain/undrain transition (the node-level ``health_seq``)
+        self.membership_seq = 0
+        self.num_downs = 0
+        self.num_restores = 0
+        self.num_drains = 0
+
+    # -- read surface ------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return len(self.names)
+
+    def is_up(self, name: str) -> bool:
+        return self._up[name]
+
+    def is_drained(self, name: str) -> bool:
+        return self._drained[name]
+
+    def is_live(self, name: str) -> bool:
+        return self._up[name] and not self._drained[name]
+
+    def live_nodes(self) -> Tuple[str, ...]:
+        """The sorted live set — the ONLY membership input the fleet's
+        content-derived assignment and directory hashes consume."""
+        return tuple(n for n in self.names if self.is_live(n))
+
+    def down_nodes(self) -> Tuple[str, ...]:
+        return tuple(n for n in self.names if not self._up[n])
+
+    def drained_nodes(self) -> Tuple[str, ...]:
+        return tuple(
+            n for n in self.names if self._up[n] and self._drained[n]
+        )
+
+    # -- transitions (membership-plane owned) ------------------------------
+
+    def mark_down(self, name: str) -> bool:
+        if not self._up[name]:
+            return False
+        self._up[name] = False
+        self.num_downs += 1
+        self.membership_seq += 1
+        return True
+
+    def mark_up(self, name: str) -> bool:
+        if self._up[name]:
+            return False
+        self._up[name] = True
+        self._drained[name] = False
+        self.num_restores += 1
+        self.membership_seq += 1
+        return True
+
+    def mark_drained(self, name: str) -> bool:
+        if self._drained[name] or not self._up[name]:
+            return False
+        self._drained[name] = True
+        self.num_drains += 1
+        self.membership_seq += 1
+        return True
+
+    def clear_drained(self, name: str) -> bool:
+        if not self._drained[name]:
+            return False
+        self._drained[name] = False
+        self.membership_seq += 1
+        return True
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "size": self.size,
+            "live": list(self.live_nodes()),
+            "down": list(self.down_nodes()),
+            "drained": list(self.drained_nodes()),
+            "membership_seq": self.membership_seq,
+            "downs": self.num_downs,
+            "restores": self.num_restores,
+            "drains": self.num_drains,
+        }
+
+    def counter_snapshot(self, prefix: str = "parallel.nodes") -> dict:
+        return {
+            f"{prefix}.size": float(self.size),
+            f"{prefix}.live": float(len(self.live_nodes())),
+            f"{prefix}.downs": float(self.num_downs),
+            f"{prefix}.drains": float(self.num_drains),
+            f"{prefix}.membership_seq": float(self.membership_seq),
+        }
+
+
+def node_shard_counts(n_items: int, nodes: Sequence[str]) -> List[int]:
+    """DevicePool.shard_ranges' even-split law at node granularity:
+    ``n_items`` over ``len(nodes)`` with the remainder on the leading
+    nodes (deterministic in the given node order)."""
+    nodes = list(nodes)
+    if not nodes:
+        raise ValueError("node_shard_counts: no nodes to pack onto")
+    base, rem = divmod(n_items, len(nodes))
+    return [base + (1 if k < rem else 0) for k in range(len(nodes))]

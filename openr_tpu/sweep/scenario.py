@@ -139,6 +139,13 @@ class ScenarioSpec:
     #: Configured via ``sweep_config.srlg_groups``; the protection tier
     #: mints per-SRLG patches from exactly these scenarios.
     srlg_groups: Tuple[Tuple[str, Tuple[Tuple[str, str], ...]], ...] = ()
+    #: restrict enumeration to the worlds whose ``World.key()`` is
+    #: listed (sorted, deduplicated); empty = every world.  The fleet
+    #: coordinator slices one fleet-wide grammar into per-node
+    #: sub-sweeps with exactly this knob — each node enumerates the
+    #: SAME worlds the fleet assignment gave it, and the slice identity
+    #: is content-addressed like everything else.
+    world_filter: Tuple[str, ...] = ()
 
     def content(self) -> dict:
         doc = {
@@ -162,6 +169,11 @@ class ScenarioSpec:
                 {"name": name, "links": [list(p) for p in pairs]}
                 for name, pairs in self.srlg_groups
             ]
+        if self.world_filter:
+            # only present when configured (the srlg_groups discipline):
+            # every unfiltered grammar's content hash — and thus its
+            # resumable checkpoints — is preserved verbatim
+            doc["world_filter"] = list(self.world_filter)
         return doc
 
     @classmethod
@@ -204,6 +216,9 @@ class ScenarioSpec:
                 (str(m["pattern"]), float(m["factor"])) for m in metric
             ),
             srlg_groups=normalize_srlg_groups(srlg),
+            world_filter=tuple(
+                sorted(set(map(str, params.get("world_filter", ()))))
+            ),
         )
 
 
@@ -269,7 +284,10 @@ def enumerate_scenarios(
             node_links.setdefault(a, []).append((a, b))
             node_links.setdefault(b, []).append((a, b))
     out: List[Scenario] = []
+    flt = set(spec.world_filter)
     for world in worlds_of(spec):
+        if flt and world.key() not in flt:
+            continue
         if spec.single_link_failures:
             bound = spec.max_single_link_scenarios
             for p in (pairs[:bound] if bound else pairs):

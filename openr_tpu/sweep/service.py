@@ -50,11 +50,29 @@ class SweepService(Actor):
         self.error = ""
         self._run_task = None
         self.num_sweeps_started = 0
+        #: vantage override for the CURRENT sweep (a fleet sub-sweep
+        #: must solve from the fleet's vantage, not this node's own)
+        self._root_override: str = ""
+        #: fleet status provider (FleetSweepCoordinator.attach wires
+        #: it); when set, get_sweep_status carries the per-node fleet
+        #: assignment rows `breeze sweep status` renders
+        self._fleet_status_fn = None
 
     # -- inputs ------------------------------------------------------------
 
     def _inputs(self) -> SweepInputs:
-        return SweepInputs(**self.decision.capacity_sweep_inputs())
+        kwargs = self.decision.capacity_sweep_inputs()
+        if self._root_override:
+            kwargs = {**kwargs, "root": self._root_override}
+        return SweepInputs(**kwargs)
+
+    def enumeration_pairs(self):
+        """The canonically sorted link pairs the grammar enumerates
+        over, from this node's live sweep inputs.  Public so the fleet
+        coordinator can pre-enumerate the FULL scenario set (for the
+        content-derived world assignment) without reaching into the
+        service's input plumbing."""
+        return SweepExecutor._all_pairs(self._inputs())
 
     def _spill_dir(self) -> str:
         base = self.config.spill_dir
@@ -75,6 +93,7 @@ class SweepService(Actor):
                 f"sweep {self.executor.sweep_id} is already running"
             )
         params = dict(params or {})
+        self._root_override = str(params.get("root", ""))
         spec = ScenarioSpec.from_params(self.config, params)
         ex = SweepExecutor(
             self._inputs,
@@ -132,6 +151,14 @@ class SweepService(Actor):
         finally:
             self.tracer.end_span(span, state=self.state)
 
+    def attach_fleet(self, status_fn) -> None:
+        """Wire the fleet coordinator's status provider onto this node
+        (``None`` detaches): ``get_sweep_status`` then carries a
+        ``fleet`` section with the cross-node assignment rows, so
+        ``breeze sweep status`` against ANY member shows the whole
+        fleet sweep — not just the local node's shards."""
+        self._fleet_status_fn = status_fn
+
     def get_sweep_status(self) -> dict:
         out: Dict[str, Any] = {
             "node": self.node_name,
@@ -141,6 +168,8 @@ class SweepService(Actor):
         }
         if self.executor is not None:
             out.update(self.executor.status())
+        if self._fleet_status_fn is not None:
+            out["fleet"] = self._fleet_status_fn()
         return out
 
     def get_sweep_summary(self) -> dict:

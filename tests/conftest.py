@@ -57,6 +57,10 @@ def pytest_configure(config):
         "protection: fast-reroute protection-tier test "
         "(openr_tpu.protection)",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet-compute-fabric test (openr_tpu.fleet)",
+    )
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

@@ -295,6 +295,58 @@ def test_cli_sweep_run_status_summary(live_node):
     assert out["state"] == "done"  # nothing running: cancel is a no-op
 
 
+#: a mid-repack fleet sweep, frozen (the coordinator itself is proven
+#: in tests/test_fleet_fabric.py — this exercises the ctrl + breeze
+#: rendering path for the per-node assignment rows, ISSUE 19)
+FLEET_SWEEP_STATUS = {
+    "fleet_id": "0ddfab1e00c0ffee",
+    "set_hash": "0ddfab1e00c0ffee" * 4,
+    "state": "running",
+    "nodes_live": 2,
+    "nodes_total": 3,
+    "worlds_total": 8,
+    "worlds_merged": 5,
+    "scenarios_total": 96,
+    "scenarios_merged": 60,
+    "repacked_worlds": 2,
+    "rounds": 2,
+    "assignments": [
+        {"node": "fab0", "round": 0, "worlds": 3, "scenarios": 36,
+         "state": "merged"},
+        {"node": "fab1", "round": 0, "worlds": 2, "scenarios": 24,
+         "state": "lost"},
+        {"node": "fab2", "round": 0, "worlds": 3, "scenarios": 36,
+         "state": "merged"},
+        {"node": "fab0", "round": 1, "worlds": 1, "scenarios": 12,
+         "state": "merged"},
+        {"node": "fab2", "round": 1, "worlds": 1, "scenarios": 12,
+         "state": "running"},
+    ],
+}
+
+
+def test_cli_sweep_status_renders_fleet_assignment_rows():
+    """`breeze sweep status` with an active fleet sweep appends the
+    coordinator header and one row per (node, round) assignment."""
+
+    def ready(net):
+        net.nodes["node0"].sweep.attach_fleet(
+            lambda: dict(FLEET_SWEEP_STATUS)
+        )
+        return adj_key("node1") in net.nodes["node0"].kv_store.dump_all(
+            "0"
+        )
+
+    with _live_ctrl_node(ready=ready) as port:
+        out = _run(port, "sweep", "status")
+        assert "fleet 0ddfab1e00c0ffee: running" in out
+        assert "nodes 2/3" in out and "worlds 5/8" in out
+        assert "scenarios 60/96" in out
+        assert "repacked=2 rounds=2" in out
+        assert "fab1 r0: lost  worlds=2 scenarios=24" in out
+        assert "fab2 r1: running  worlds=1 scenarios=12" in out
+
+
 def test_cli_serving_watch_snapshot_and_stream_stats(live_node):
     """breeze serving watch NODE --deltas 0: one generation-stamped
     snapshot through the ctrl server-stream, then exit; stream-stats

@@ -543,3 +543,31 @@ def test_golden_monitor_trajectory(live_node):
     check_golden(
         "monitor_trajectory", live_node, "monitor", "trajectory"
     )
+
+
+# ISSUE 19: the fleet sweep's per-node assignment rows in `breeze sweep
+# status` (numbers canonicalized; the golden pins the block SHAPE —
+# header line + one row per (node, round) assignment).  The status
+# payload is frozen (tests/test_cli.py's FLEET_SWEEP_STATUS): the
+# coordinator itself is proven in tests/test_fleet_fabric.py.
+
+
+@pytest.fixture(scope="module")
+def live_fleet_sweep_node():
+    from tests.test_cli import FLEET_SWEEP_STATUS
+
+    def ready(net):
+        net.nodes["node0"].sweep.attach_fleet(
+            lambda: dict(FLEET_SWEEP_STATUS)
+        )
+        return adj_key("node1") in net.nodes["node0"].kv_store.dump_all(
+            "0"
+        )
+
+    yield from _live_node_fixture(2, False, ready)
+
+
+def test_golden_sweep_status_fleet(live_fleet_sweep_node):
+    check_golden(
+        "sweep_status_fleet", live_fleet_sweep_node, "sweep", "status"
+    )

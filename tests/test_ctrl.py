@@ -433,6 +433,32 @@ def test_tcp_long_poll_roundtrip():
     run(main())
 
 
+def test_handler_fleet_status_verb():
+    """get_fleet_status: "disabled" on a node with no fleet attachment
+    (every node outside a fleet deployment); a node carrying one serves
+    its coordinator's status verbatim."""
+
+    async def main():
+        clock = SimClock()
+        net = await converged_net(clock, 2)
+        node = net.nodes["node0"]
+        h = OpenrCtrlHandler(node)
+        assert h.get_fleet_status() == {"state": "disabled"}
+
+        class _Fleet:
+            def status(self):
+                return {"state": "running", "fleet_id": "0ddfab1e"}
+
+        node.fleet = _Fleet()
+        try:
+            assert h.get_fleet_status()["fleet_id"] == "0ddfab1e"
+        finally:
+            del node.fleet
+        await net.stop()
+
+    run(main())
+
+
 def test_handler_config_and_init_parity_methods():
     """dryrunConfig / getRunningConfigThrift / getInitializationDurationMs
     equivalents (OpenrCtrl.thrift:264,274,302)."""

@@ -1,0 +1,467 @@
+"""Fleet compute fabric (ISSUE 19) — cross-node sweep sharding + the
+consistent-hash feed directory.
+
+The contracts under test (docs/Fleet.md):
+
+* assignment is a PURE FUNCTION of (content key, live-node set):
+  content-derived, arrival-order independent, minimal reshuffle on
+  membership change (a dead node's keys move, nobody else's);
+* a fleet sweep's merged summary digest is byte-equal to a single-node
+  run of the same scenario set; a mid-sweep node kill re-packs ONLY the
+  victim's worlds onto survivors and the final fleet manifest stays
+  byte-identical to an uninterrupted run's;
+* a node kill mid-stream migrates exactly its watchers to their hash
+  successors with ZERO monotone-generation violations and no
+  pre-migration generation re-emitted; a drain hands off cleanly while
+  the daemon stays up; seeded replays are byte-identical;
+* membership transitions feed the health plane: node loss PAGES,
+  drain migration TICKETS, restoration resolves both.
+
+Small scale runs in tier-1; the fleet-scale variant is ``-m slow``.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from openr_tpu.common.runtime import CounterMap, SimClock
+from openr_tpu.emulation.fabric import FleetFabric
+from openr_tpu.fleet import (
+    FeedDirectory,
+    FleetMembership,
+    assign_worlds,
+    owner_of,
+    rank_members,
+)
+from openr_tpu.health.alerts import AlertSink, alert_counter_key
+from openr_tpu.parallel.nodes import NodeSet, node_shard_counts
+
+pytestmark = [pytest.mark.fleet]
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        pending = asyncio.all_tasks(loop)
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        loop.close()
+
+
+SWEEP_PARAMS = {
+    "drain_node_sets": [[], ["node5"], ["node7"], ["node3"]],
+    "metric_perturbations": [{"pattern": "node.*", "factor": 2.0}],
+}
+
+
+def make_fabric(clock, tmp_path, **kwargs):
+    kwargs.setdefault("n_side", 3)
+    kwargs.setdefault(
+        "sweep_overrides",
+        {"shard_scenarios": 2, "inter_shard_pause_s": 0.2},
+    )
+    return FleetFabric(clock, spill_root=str(tmp_path), **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# assignment: pure, content-derived, minimal reshuffle
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_owner_is_pure_and_arrival_order_independent():
+    nodes = ["fab2", "fab0", "fab1"]
+    for key in ("drain[]|metric[]", "drain[node5]|metric[]", "x"):
+        a = owner_of("salt", key, nodes)
+        b = owner_of("salt", key, list(reversed(nodes)))
+        assert a == b
+        assert a in nodes
+    # ranking is a permutation of the members and salt-sensitive
+    r = rank_members("salt", "k", nodes)
+    assert sorted(r) == sorted(nodes)
+    assert any(
+        rank_members("other-salt", k, nodes) != rank_members("salt", k, nodes)
+        for k in ("k1", "k2", "k3", "k4", "k5")
+    )
+
+
+def test_assignment_reshuffle_is_minimal_on_node_loss():
+    worlds = [f"drain[node{i}]|metric[]" for i in range(40)]
+    live = ("fab0", "fab1", "fab2", "fab3")
+    before = assign_worlds("hash", worlds, live)
+    assert sorted(w for ws in before.values() for w in ws) == sorted(worlds)
+    dead = "fab1"
+    after = assign_worlds(
+        "hash", worlds, tuple(n for n in live if n != dead)
+    )
+    # every world the dead node did NOT own stays put; only its worlds
+    # moved (each to its second-ranked member)
+    for node, ws in before.items():
+        if node == dead:
+            continue
+        assert set(ws) <= set(after.get(node, ()))
+    for w in before.get(dead, ()):
+        new_owner = owner_of(
+            "hash", w, tuple(n for n in live if n != dead)
+        )
+        assert new_owner == rank_members("hash", w, live)[1]
+    # a different set hash shuffles independently (content-derived)
+    assert assign_worlds("other", worlds, live) != before
+
+
+# ---------------------------------------------------------------------------
+# node-level health: NodeSet + FleetMembership
+# ---------------------------------------------------------------------------
+
+
+def test_nodeset_transitions_bump_membership_seq():
+    ns = NodeSet(["b", "a", "c"])
+    assert ns.names == ("a", "b", "c")  # sorted, never arrival order
+    assert ns.live_nodes() == ("a", "b", "c")
+    assert ns.mark_down("b") and not ns.mark_down("b")
+    assert ns.live_nodes() == ("a", "c")
+    assert ns.down_nodes() == ("b",)
+    seq = ns.membership_seq
+    assert ns.mark_drained("c") and ns.membership_seq == seq + 1
+    assert ns.live_nodes() == ("a",)
+    assert ns.drained_nodes() == ("c",)
+    assert not ns.mark_drained("b")  # down nodes can't drain
+    assert ns.mark_up("b") and ns.clear_drained("c")
+    assert ns.live_nodes() == ("a", "b", "c")
+    with pytest.raises(ValueError):
+        NodeSet(["a", "a"])
+    assert node_shard_counts(7, ["a", "b", "c"]) == [3, 2, 2]
+
+
+def test_membership_listeners_and_health_firing():
+    counters = CounterMap()
+    m = FleetMembership(["fab0", "fab1", "fab2"], counters=counters)
+    events = []
+    m.add_listener(events.append)
+    assert m.health_firing() == {}
+    assert m.node_down("fab1", reason="chaos")
+    assert m.drain_node("fab2")
+    assert [e["event"] for e in events] == ["node_down", "node_drained"]
+    assert events[0]["live"] == ["fab0", "fab2"]
+    firing = m.health_firing()
+    assert firing["fleet_node_loss"]["nodes"] == ["fab1"]
+    assert firing["fleet_drain_migration"]["nodes"] == ["fab2"]
+    assert m.node_up("fab1") and m.undrain_node("fab2")
+    assert m.health_firing() == {}
+    assert counters.get("fleet.membership.node_down") == 1
+
+
+def test_fleet_alerts_fire_and_resolve_through_the_sink():
+    """The health satellite: node loss PAGES, a drain TICKETS, and the
+    expected migration resolves quietly once membership heals."""
+    clock = SimClock(1.0)
+    m = FleetMembership(["fab0", "fab1"])
+    sink = AlertSink("agg", clock, CounterMap())
+    m.node_down("fab1")
+    sink.report(m.health_firing())
+    assert [a["name"] for a in sink.active_alerts()] == ["fleet_node_loss"]
+    assert sink.counters.get(alert_counter_key("fleet_node_loss")) == 1.0
+    m.node_up("fab1")
+    sink.report(m.health_firing())
+    assert sink.active_alerts() == []
+    m.drain_node("fab0")
+    sink.report(m.health_firing())
+    assert [a["name"] for a in sink.active_alerts()] == [
+        "fleet_drain_migration"
+    ]
+    m.undrain_node("fab0")
+    sink.report(m.health_firing())
+    assert sink.active_alerts() == []
+    events = [json.loads(line) for line in sink.log]
+    assert [e["event"] for e in events] == [
+        "fired", "resolved", "fired", "resolved",
+    ]
+    assert events[0]["severity"] == "page"
+    assert events[2]["severity"] == "ticket"
+
+
+def test_feed_directory_tracks_live_set():
+    m = FleetMembership(["fab0", "fab1", "fab2"])
+    d = FeedDirectory(m)
+    params = {"node": "node3"}
+    first, successor = d.owners("route_db", params, k=2)
+    assert d.owner("route_db", params) == first
+    m.node_down(first)
+    assert d.owner("route_db", params) == successor
+    m.node_down(successor)
+    last = d.owner("route_db", params)
+    assert last is not None and last not in (first, successor)
+    m.node_down(last)
+    assert d.owner("route_db", params) is None
+    assert d.owners("route_db", params) == ()
+
+
+# ---------------------------------------------------------------------------
+# cross-node sweep: digest parity, node-kill repack, manifest identity
+# ---------------------------------------------------------------------------
+
+
+async def _drive_fleet_sweep(fab, clock, kill=None):
+    """Run one fleet sweep to completion; optionally kill a node the
+    moment it has a running sub-sweep.  Returns (digest, manifest
+    bytes, status)."""
+    fab.coordinator.prepare(SWEEP_PARAMS)
+    fab.coordinator.start()
+    hit = False
+    for _ in range(5000):
+        await clock.run_for(0.05)
+        st = fab.coordinator.status()
+        if kill and not hit and any(
+            t["node"] == kill and t["state"] == "running"
+            for t in st["assignments"]
+        ):
+            await fab.kill_node(kill)
+            hit = True
+        if fab.coordinator.state != "running":
+            break
+    assert fab.coordinator.state == "done", fab.coordinator.state
+    s = fab.coordinator.summary()
+    assert s["complete"] and s["summary"]["scenarios"] > 0
+    return s["summary_digest"], fab.coordinator.manifest_bytes(), (
+        fab.coordinator.status()
+    )
+
+
+def test_fleet_sweep_digest_matches_single_node_run(tmp_path):
+    async def main():
+        clock = SimClock()
+        fab = make_fabric(clock, tmp_path / "fleet")
+        fab.start()
+        await clock.run_for(2.0)
+        digest, _man, st = await _drive_fleet_sweep(fab, clock)
+        assert st["worlds_merged"] == st["worlds_total"] == 8
+        assert st["nodes_live"] == 3 and st["rounds"] == 1
+        # the single-node reference: same grammar, one executor
+        from openr_tpu.sweep import SweepExecutor
+        from openr_tpu.sweep.scenario import ScenarioSpec
+
+        svc = fab.nodes["fab0"].sweep
+        spec = ScenarioSpec.from_params(svc.config, SWEEP_PARAMS)
+        ex = SweepExecutor(
+            svc._inputs, str(tmp_path / "single"), clock=clock,
+            shard_scenarios=8,
+        )
+        ex.prepare(spec, resume=False)
+        ex.run()
+        assert ex.reducer.summary_digest() == digest
+        # every member's status carries the fleet rows
+        for fnode in fab.nodes.values():
+            fleet_st = fnode.sweep.get_sweep_status()["fleet"]
+            assert fleet_st["state"] == "done"
+            rows = fleet_st["assignments"]
+            assert rows and {r["node"] for r in rows} <= set(fab.nodes)
+        await fab.stop()
+
+    run(main())
+
+
+@pytest.mark.chaos
+def test_node_kill_mid_sweep_repacks_only_its_worlds(tmp_path):
+    async def run_one(root, kill=None):
+        clock = SimClock()
+        fab = make_fabric(clock, root)
+        fab.start()
+        await clock.run_for(2.0)
+        out = await _drive_fleet_sweep(fab, clock, kill=kill)
+        await fab.stop()
+        return out
+
+    async def main():
+        d0, m0, st0 = await run_one(tmp_path / "clean")
+        d1, m1, st1 = await run_one(tmp_path / "killed", kill="fab1")
+        # the victim's running worlds re-packed onto survivors as a new
+        # round; nobody else's work moved
+        lost = [t for t in st1["assignments"] if t["state"] == "lost"]
+        assert lost and all(t["node"] == "fab1" for t in lost)
+        assert st1["repacked_worlds"] == sum(t["worlds"] for t in lost)
+        assert st1["rounds"] == 2 and st0["rounds"] == 1
+        assert {
+            t["node"] for t in st1["assignments"] if t["round"] == 1
+        } <= {"fab0", "fab2"}
+        # merged digest AND fleet manifest byte-identical to the
+        # uninterrupted run
+        assert d1 == d0
+        assert m1 == m0
+        assert json.loads(m0)["completed_worlds"] == sorted(
+            json.loads(m0)["completed_worlds"]
+        )
+
+    run(main())
+
+
+def test_fleet_manifest_resumes_merged_worlds(tmp_path):
+    """A coordinator restart against the same spill root replays merged
+    worlds from their recorded spills instead of re-solving them."""
+
+    async def main():
+        clock = SimClock()
+        fab = make_fabric(clock, tmp_path)
+        fab.start()
+        await clock.run_for(2.0)
+        digest, _man, _st = await _drive_fleet_sweep(fab, clock)
+        # a fresh coordinator over the same members + spill root
+        from openr_tpu.fleet import FleetSweepCoordinator
+
+        c2 = FleetSweepCoordinator(
+            clock,
+            fab.membership,
+            {n: f.sweep for n, f in fab.nodes.items()},
+            spill_root=str(tmp_path) + "/fleet",
+        )
+        rep = c2.prepare(SWEEP_PARAMS)
+        assert rep["resumed_worlds"] == rep["worlds"] == 8
+        assert rep["state"] == "done"
+        assert c2.summary()["summary_digest"] == digest
+        assert c2.manifest_bytes() == fab.coordinator.manifest_bytes()
+        await fab.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# feed directory: migration on kill/drain, invariants, seeded replay
+# ---------------------------------------------------------------------------
+
+
+async def _stream_scenario(root, drain_instead=False):
+    """Six watchers over the fleet; churn, kill (or drain) the busiest
+    serving node, churn again.  Returns the fabric + watchers + victim
+    for assertions, after stopping everything."""
+    clock = SimClock()
+    fab = make_fabric(clock, root)
+    fab.start()
+    await clock.run_for(2.0)
+    watchers = [
+        fab.router.watch("route_db", {"node": f"node{i}"})
+        for i in range(6)
+    ]
+    await clock.run_for(1.0)
+    fab.announce_prefix("node2", "10.99.0.0/24")
+    await clock.run_for(2.0)
+    placement = {}
+    for w in watchers:
+        placement.setdefault(w.serving_node, []).append(w)
+    victim = max(placement, key=lambda n: len(placement[n]))
+    pre_cursor = {w.watcher_id: w.cursor_seq for w in watchers}
+    if drain_instead:
+        fab.drain_node(victim)
+    else:
+        await fab.kill_node(victim)
+    await clock.run_for(1.0)
+    fab.announce_prefix("node0", "10.98.0.0/24")
+    await clock.run_for(2.0)
+    logs = b"\x00".join(w.log_bytes() for w in watchers)
+    await fab.stop()
+    return fab, watchers, victim, placement, pre_cursor, logs
+
+
+@pytest.mark.chaos
+def test_node_kill_migrates_watchers_with_zero_violations(tmp_path):
+    async def main():
+        fab, ws, victim, placement, pre, _logs = await _stream_scenario(
+            tmp_path
+        )
+        # exactly the victim's watchers migrated, to their successors
+        for w in ws:
+            if w in placement[victim]:
+                assert w.migrations == 1
+                assert w.serving_node is not None
+                assert w.serving_node != victim
+                assert w.serving_node == fab.directory.owner(
+                    w.kind, w.params
+                )
+            else:
+                assert w.migrations == 0
+        # the fleet invariants: zero monotone violations, nothing from
+        # before the migration re-emitted, every cursor still advanced
+        assert fab.router.invariant_violations() == 0
+        assert fab.router.pre_migration_re_emissions() == 0
+        for w in ws:
+            assert w.cursor_seq >= pre[w.watcher_id]
+            assert w.emissions[0]["type"] == "snapshot"
+        # the per-node StreamingServices agree
+        for fnode in fab.nodes.values():
+            assert fnode.streaming.num_invariant_violations == 0
+        assert fab.router.status()["migrations"] == len(
+            placement[victim]
+        )
+
+    run(main())
+
+
+@pytest.mark.chaos
+def test_node_drain_hands_off_cleanly_and_kill_replays_identically(
+    tmp_path,
+):
+    async def main():
+        # drain: daemon stays up, hand-off unsubscribes the old node
+        fab, ws, victim, placement, _pre, _logs = await _stream_scenario(
+            tmp_path / "drain", drain_instead=True
+        )
+        assert fab.router.invariant_violations() == 0
+        assert fab.router.pre_migration_re_emissions() == 0
+        assert all(
+            w.serving_node != victim for w in placement[victim]
+        )
+        # the drained daemon carries no fleet subscribers anymore
+        stats = fab.nodes[victim].streaming.stats()
+        assert sum(f["subscribers"] for f in stats["feeds"]) == 0
+        # seeded replay: the whole kill scenario twice, byte-identical
+        _f1, _w1, v1, _p1, _c1, log_a = await _stream_scenario(
+            tmp_path / "replay_a"
+        )
+        _f2, _w2, v2, _p2, _c2, log_b = await _stream_scenario(
+            tmp_path / "replay_b"
+        )
+        assert v1 == v2
+        assert log_a == log_b
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# fleet scale (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_scale_sweep_with_kill(tmp_path):
+    """Five members, a bigger grammar, a mid-sweep kill — the same
+    byte-identity law at fleet scale."""
+
+    async def run_one(root, kill=None):
+        clock = SimClock()
+        fab = FleetFabric(
+            clock,
+            spill_root=str(root),
+            node_names=tuple(f"fab{i}" for i in range(5)),
+            n_side=4,
+            sweep_overrides={
+                "shard_scenarios": 8, "inter_shard_pause_s": 0.05,
+            },
+        )
+        fab.start()
+        await clock.run_for(2.0)
+        out = await _drive_fleet_sweep(fab, clock, kill=kill)
+        await fab.stop()
+        return out
+
+    async def main():
+        d0, m0, _s0 = await run_one(tmp_path / "clean")
+        d1, m1, st1 = await run_one(tmp_path / "killed", kill="fab2")
+        assert d1 == d0 and m1 == m0
+        assert st1["repacked_worlds"] > 0
+
+    run(main())

@@ -160,6 +160,12 @@ FIXTURES = {
         (),
         2,
     ),
+    "fleet-directory": (
+        "def evict(membership, name):\n"
+        "    membership.node_down(name)\n",
+        (),
+        2,
+    ),
     "protection-table": (
         "def shortcut(table, doc, prefix_state):\n"
         "    table.apply_patch(doc, prefix_state)\n",
@@ -578,6 +584,45 @@ def test_protection_table_reads_are_clean():
         "    return svc.get_protection_status()\n"
     )
     assert analyze_source(src) == []
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        "openr_tpu/fleet/coordinator.py",
+        "openr_tpu/chaos/controller.py",
+        "openr_tpu/emulation/fabric.py",
+    ],
+)
+def test_fleet_directory_owners_are_exempt(rel):
+    """The fleet tier owns membership; chaos and the emulation fabric
+    cross the boundary on purpose (ISSUE 19) — the rule polices
+    everyone else."""
+    src = (
+        "def churn(membership):\n"
+        "    membership.node_down('fab1')\n"
+        "    membership.drain_node('fab2')\n"
+        "    membership.undrain_node('fab2')\n"
+        "    membership.node_up('fab1')\n"
+    )
+    mods = [ParsedModule.parse(rel, src)]
+    assert analyze_modules(mods).findings == []
+    assert [f.rule for f in analyze_source(src)] == [
+        "fleet-directory"
+    ] * 4
+
+
+def test_fleet_directory_needs_membership_receiver():
+    """The mutator names are generic enough that an unrelated receiver
+    (``link.node_up()``) must not trip — only fleet-hinted receivers
+    do; reads stay clean everywhere."""
+    src = (
+        "def poke(link, fleet_membership):\n"
+        "    link.node_up()\n"
+        "    fleet_membership.node_up('fab0')\n"
+        "    return fleet_membership.live_nodes()\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == ["fleet-directory"]
 
 
 def test_sweep_ownership_reset_needs_checkpoint_receiver():

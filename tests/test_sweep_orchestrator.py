@@ -345,6 +345,88 @@ def test_kill_after_shard_k_resumes_byte_identically(tmp_path):
     assert resumed.resumed_shards == K
 
 
+def test_world_filter_slices_preserve_grammar_and_hashes():
+    """The fleet's slicing knob: a ``world_filter`` sub-spec enumerates
+    exactly the listed worlds' scenarios with UNCHANGED per-scenario
+    hashes, and the unfiltered spec's content (hence every pre-fleet
+    checkpoint hash) is byte-preserved — the field only appears when
+    set."""
+    import dataclasses
+
+    full = enumerate_scenarios(SPEC, PAIRS)
+    worlds = sorted({s.world.key() for s in full})
+    assert len(worlds) == 4
+    assert "world_filter" not in SPEC.content()
+    picked = set(worlds[:2])
+    sub = dataclasses.replace(SPEC, world_filter=tuple(sorted(picked)))
+    assert "world_filter" in sub.content()
+    sliced = enumerate_scenarios(sub, PAIRS)
+    assert {s.world.key() for s in sliced} == picked
+    assert [s.hash for s in sliced] == [
+        s.hash for s in full if s.world.key() in picked
+    ]
+    # the slices partition the set: no overlap, no loss
+    rest = dataclasses.replace(
+        SPEC, world_filter=tuple(sorted(set(worlds) - picked))
+    )
+    assert len(sliced) + len(enumerate_scenarios(rest, PAIRS)) == len(full)
+
+
+def test_cross_node_merge_digest_invariant_to_split_and_interleaving(
+    tmp_path,
+):
+    """THE fleet sweep law: for EVERY node-count split of the world set
+    (content-derived assignment over 1..4 nodes) and EVERY feed
+    interleaving of the per-node spill streams, the merged reducer
+    digest is byte-equal to the single-node run's."""
+    import dataclasses
+
+    from openr_tpu.fleet import assign_worlds
+
+    clock = SimClock()
+    d, _edges = build_decision(clock)
+    single, _ = make_executor(tmp_path, "single", clock=clock, d=d)
+    single.prepare(SPEC)
+    single.run()
+    want = single.summary()["summary_digest"]
+    worlds = sorted(
+        {
+            s.world.key()
+            for s in enumerate_scenarios(
+                SPEC, SweepExecutor._all_pairs(single.inputs_fn())
+            )
+        }
+    )
+    for n_nodes in (1, 2, 3, 4):
+        nodes = tuple(f"n{i}" for i in range(n_nodes))
+        assignment = assign_worlds(f"split{n_nodes}", worlds, nodes)
+        streams = []
+        for node, wks in assignment.items():
+            ex, _ = make_executor(
+                tmp_path, f"s{n_nodes}.{node}", clock=clock, d=d
+            )
+            ex.prepare(dataclasses.replace(SPEC, world_filter=wks))
+            ex.run()
+            streams.append(list(SpillReader(ex.spill_dir).rows()))
+        # node order, reversed, and row-level round-robin interleave
+        for feed_plan in (
+            streams,
+            list(reversed(streams)),
+            [
+                [rows[i]]
+                for i in range(max(len(s) for s in streams))
+                for rows in streams
+                if i < len(rows)
+            ],
+        ):
+            reducer = SweepReducer(top_k=64)
+            for chunk in feed_plan:
+                reducer.feed(chunk)
+            assert reducer.summary_digest() == want, (
+                f"split over {n_nodes} nodes diverged"
+            )
+
+
 def test_mismatched_scenario_set_starts_fresh_with_clean_spill(tmp_path):
     ex, d = make_executor(tmp_path, "x")
     ex.prepare(SPEC)
