@@ -4627,7 +4627,20 @@ def validate_fleet_bench(doc: dict) -> None:
     monotone-generation invariant violations and no pre-migration
     generation re-emitted; a maintenance drain hands off cleanly (zero
     residual subscribers on the drained daemon); the whole chaos
-    schedule replays byte-identically on the virtual clock."""
+    schedule replays byte-identically on the virtual clock.
+
+    The ISSUE-20 liveness tier rides the same artifact: an UNANNOUNCED
+    kill is concluded from heartbeat silence alone within the TTL
+    bound (p50/max over phase-shifted samples), and the sweep still
+    merges to the byte-identical digest with zero stream violations;
+    an asymmetric partition's stale-epoch pushes are fenced, never
+    double-delivered; stale-epoch sweep dispatches are fenced and
+    re-packed; a straggling member's worlds re-pack first-committed-
+    wins with the digest unchanged; a heartbeating-but-raising member
+    is gray-demoted without crashing the coordinator; a flapping
+    member is damped with ownership churn bounded to <=2 moves per
+    flap cycle.  Every liveness chaos schedule replays
+    byte-identically."""
     assert doc["metric"] == "fleet_sweep_merged_scenarios_per_s_3node"
     assert doc["unit"] == "scenarios/s"
     assert doc["value"] > 0
@@ -4656,6 +4669,50 @@ def validate_fleet_bench(doc: dict) -> None:
     assert dr["migrated_watchers"] >= 1
     assert dr["invariant_violations"] == 0
     assert dr["residual_subscribers"] == 0
+    # -- the ISSUE-20 liveness tier: self-hosted membership ------------
+    lv = d["liveness"]
+    hb = lv["heartbeat"]
+    assert 0 < hb["interval_s"] < hb["suspect_after_s"] < hb["ttl_s"]
+    det = lv["detection"]
+    assert det["samples"] >= 3
+    assert 0 < det["p50_s"] <= det["max_s"] <= det["bound_s"]
+    uk = lv["unannounced_kill"]
+    assert uk["victim"] in FLEET_BENCH_NODES
+    assert uk["detection_s"] > 0
+    assert uk["suspects_seen"] >= 1
+    assert uk["repacked_worlds"] >= 1
+    assert uk["digest_equal"] is True
+    assert uk["manifest_byte_identical"] is True
+    assert uk["invariant_violations"] == 0
+    assert uk["pre_migration_generation_emissions"] == 0
+    assert uk["deterministic_replay"] is True
+    sb = lv["split_brain"]
+    assert sb["victim"] in FLEET_BENCH_NODES
+    assert sb["fenced_stream_deliveries"] >= 1
+    assert sb["invariant_violations"] == 0
+    assert sb["double_pushes"] == 0
+    assert sb["healed_stale_subscriptions"] == 0
+    assert sb["deterministic_replay"] is True
+    fe = lv["epoch_fence"]
+    assert fe["fenced_worlds"] >= 1
+    assert fe["digest_equal"] is True
+    assert fe["manifest_byte_identical"] is True
+    sg = lv["straggler"]
+    assert sg["straggler_repacks"] >= 1
+    assert sg["duplicate_completions"] >= 1
+    assert sg["digest_equal"] is True
+    assert sg["manifest_byte_identical"] is True
+    gr = lv["gray_failure"]
+    assert gr["victim"] in FLEET_BENCH_NODES
+    assert gr["demotions"] >= 1
+    assert gr["coordinator_crashes"] == 0
+    assert gr["ticket_firing"] is True
+    assert gr["digest_equal"] is True
+    fl = lv["flap"]
+    assert fl["flap_damped"] >= 1
+    assert fl["flap_cycles"] >= 2
+    assert fl["max_watcher_migrations"] <= 2 * fl["flap_cycles"]
+    assert fl["invariant_violations"] == 0
     for key in ("seed", "mode", "env"):
         assert key in d, key
     for key in ("platform", "jax", "device_count"):
@@ -4689,7 +4746,7 @@ def _fleet_bench_doc(seed: Optional[int]) -> dict:
     }
     root = tempfile.mkdtemp(prefix="bench_fleet_")
 
-    def make_fabric(sub: str) -> "tuple":
+    def make_fabric(sub: str, **kw) -> "tuple":
         clock = SimClock()
         fab = FleetFabric(
             clock,
@@ -4699,6 +4756,7 @@ def _fleet_bench_doc(seed: Optional[int]) -> dict:
             sweep_overrides={
                 "shard_scenarios": 8, "inter_shard_pause_s": 0.05,
             },
+            **kw,
         )
         return clock, fab
 
@@ -4767,7 +4825,7 @@ def _fleet_bench_doc(seed: Optional[int]) -> dict:
             fab, clock, kill=True
         )
         await fab.stop()
-        return {
+        return digest, manifest, {
             "nodes": len(FLEET_BENCH_NODES),
             "worlds": st["worlds_total"],
             "scenarios": st["scenarios_total"],
@@ -4851,9 +4909,403 @@ def _fleet_bench_doc(seed: Optional[int]) -> dict:
             },
         }
 
+    # -- the ISSUE-20 liveness tier: compressed heartbeat timers so the
+    #    suspicion machine runs its whole arc inside seconds of virtual
+    #    time (the production defaults only stretch the same schedule)
+    fast_liveness = {
+        "heartbeat_interval_s": 0.1,
+        "suspect_after_s": 0.25,
+        "heartbeat_ttl_s": 0.5,
+        "tick_s": 0.05,
+    }
+
+    async def detect_once(sub: str, k: int) -> float:
+        """Kill one member UNANNOUNCED at a phase offset off the
+        heartbeat grid and time how long heartbeat silence alone takes
+        to conclude the death (suspect -> TTL expiry -> down)."""
+        clock, fab = make_fabric(
+            sub, liveness_overrides=dict(fast_liveness)
+        )
+        fab.start()
+        await clock.run_for(2.0 + 0.013 + 0.037 * k)
+        victim = FLEET_BENCH_NODES[k % len(FLEET_BENCH_NODES)]
+        await fab.kill_node_unannounced(victim)
+        t_kill = clock.now()
+        t_detect = None
+        for _ in range(400):
+            await clock.run_for(0.01)
+            if not fab.membership.is_live(victim):
+                t_detect = clock.now()
+                break
+        assert t_detect is not None, "liveness never concluded the kill"
+        await fab.stop()
+        return round(t_detect - t_kill, 6)
+
+    async def unannounced_scenario(sub: str) -> dict:
+        """The detection-tier acceptance: a mid-sweep member killed
+        with membership told NOTHING — heartbeat silence re-packs its
+        worlds and migrates its watchers, digest/manifest byte-equal."""
+        clock, fab = make_fabric(
+            sub, liveness_overrides=dict(fast_liveness)
+        )
+        fab.start()
+        await clock.run_for(2.0)
+        watchers = [
+            fab.router.watch("route_db", {"node": f"node{i}"})
+            for i in range(8)
+        ]
+        await clock.run_for(1.0)
+        fab.coordinator.prepare(params)
+        fab.coordinator.start()
+        victim = t_kill = t_detect = None
+        for _ in range(20000):
+            await clock.run_for(0.05)
+            st = fab.coordinator.status()
+            if victim is None:
+                running = sorted(
+                    t["node"] for t in st["assignments"]
+                    if t["state"] == "running"
+                )
+                if running:
+                    victim = running[0]
+                    await fab.kill_node_unannounced(victim)
+                    t_kill = clock.now()
+            elif t_detect is None and not fab.membership.is_live(victim):
+                t_detect = clock.now()
+                # churn after detection: the migrated watchers must
+                # keep applying deltas with the invariants intact
+                fab.announce_prefix("node0", "10.95.0.0/24")
+            if fab.coordinator.state != "running":
+                break
+        assert fab.coordinator.state == "done", fab.coordinator.state
+        assert victim is not None and t_detect is not None
+        await clock.run_for(1.0)
+        st = fab.coordinator.status()
+        out = {
+            "victim": victim,
+            "detection_s": round(t_detect - t_kill, 6),
+            "suspects_seen": fab.counters.get("fleet.membership.suspect"),
+            "repacked_worlds": st["repacked_worlds"],
+            "digest": fab.coordinator.summary()["summary_digest"],
+            "manifest": fab.coordinator.manifest_bytes(),
+            "violations": fab.router.invariant_violations(),
+            "re_emissions": fab.router.pre_migration_re_emissions(),
+            "log": b"\x00".join(w.log_bytes() for w in watchers),
+        }
+        await fab.stop()
+        return out
+
+    async def split_brain_scenario(sub: str) -> dict:
+        """Asymmetric partition: the victim's heartbeats stop REACHING
+        the tracker while its services keep pushing — every stale-epoch
+        delivery must be fenced, never applied, never doubled."""
+        clock, fab = make_fabric(
+            sub, liveness_overrides=dict(fast_liveness)
+        )
+        fab.start()
+        await clock.run_for(2.0)
+        watchers = [
+            fab.router.watch("route_db", {"node": f"node{i}"})
+            for i in range(12)
+        ]
+        await clock.run_for(1.0)
+        placement = {}
+        for w in watchers:
+            placement.setdefault(w.serving_node, []).append(w)
+        victim = max(sorted(placement), key=lambda n: len(placement[n]))
+        fab.partition_asymmetric(victim)
+        await clock.run_for(1.0)
+        assert not fab.membership.is_live(victim)
+        assert fab.nodes[victim].running  # daemon alive: asymmetric
+        # churn: EVERY service pushes, including the stale owner
+        fab.announce_prefix("node1", "10.94.0.0/24")
+        await clock.run_for(1.0)
+        out = {
+            "victim": victim,
+            "fenced_stream": fab.router.fenced_deliveries(),
+            "violations": fab.router.invariant_violations(),
+            "re_emissions": fab.router.pre_migration_re_emissions(),
+        }
+        fab.heal_partition(victim)
+        await clock.run_for(1.0)
+        out["healed_live"] = fab.membership.is_live(victim)
+        out["stale_after_heal"] = (
+            fab.router.status()["stale_subscriptions"]
+        )
+        fab.announce_prefix("node2", "10.93.0.0/24")
+        await clock.run_for(1.0)
+        out["violations"] = fab.router.invariant_violations()
+        out["log"] = b"\x00".join(w.log_bytes() for w in watchers)
+        await fab.stop()
+        return out
+
+    async def epoch_fence_scenario(sub: str) -> dict:
+        """Dispatches stamped under a pre-kill epoch are refused by the
+        receivers (counted, returned, never raised) and re-packed at
+        the current epoch — the digest contract survives the fence."""
+        clock, fab = make_fabric(sub)
+        fab.start()
+        await clock.run_for(2.0)
+        fab.coordinator.prepare(params)
+        holder = sorted({t.node for t in fab.coordinator.tasks})[0]
+        await fab.kill_node(holder)
+        fab.coordinator.start()
+        for _ in range(20000):
+            await clock.run_for(0.05)
+            if fab.coordinator.state != "running":
+                break
+        assert fab.coordinator.state == "done", fab.coordinator.state
+        st = fab.coordinator.status()
+        out = {
+            "fenced_worlds": st["fenced_worlds"],
+            "sweep_fence_rejections": sum(
+                f.counters.get("fleet.fenced.sweep_rejected") or 0
+                for f in fab.nodes.values()
+            ),
+            "digest": fab.coordinator.summary()["summary_digest"],
+            "manifest": fab.coordinator.manifest_bytes(),
+        }
+        await fab.stop()
+        return out
+
+    async def straggler_scenario(sub: str) -> dict:
+        """The busiest member turns slow mid-round; its unfinished
+        worlds re-pack past ``straggler_deadline_s`` WITHOUT declaring
+        it dead, and merge reconciles first-committed-wins."""
+        clock, fab = make_fabric(
+            sub,
+            # above the busiest member's natural round (~1.2s virtual:
+            # half the 384-scenario grammar at 8/shard x 0.05s), below
+            # the wedged member's never-finishing round
+            coordinator_overrides={"straggler_deadline_s": 2.0},
+        )
+        fab.start()
+        await clock.run_for(2.0)
+        fab.coordinator.prepare(params)
+        counts = {}
+        for t in fab.coordinator.tasks:
+            counts[t.node] = counts.get(t.node, 0) + len(t.worlds)
+        slow = max(sorted(counts), key=lambda n: counts[n])
+        fab.nodes[slow].sweep.config.inter_shard_pause_s = 60.0
+        fab.coordinator.start()
+        for _ in range(20000):
+            await clock.run_for(0.05)
+            if fab.coordinator.state != "running":
+                break
+        assert fab.coordinator.state == "done", fab.coordinator.state
+        st = fab.coordinator.status()
+        out = {
+            "straggler": slow,
+            "straggler_repacks": st["straggler_repacks"],
+            "repacked_worlds": st["straggler_repacked_worlds"],
+            "duplicate_completions": st["duplicate_completions"],
+            "digest": fab.coordinator.summary()["summary_digest"],
+            "manifest": fab.coordinator.manifest_bytes(),
+        }
+        await fab.stop()
+        return out
+
+    async def gray_scenario(sub: str) -> dict:
+        """Gray failure: heartbeats keep flowing while the victim's
+        sweep ctrl surface raises on every touch — the breaker + strike
+        policy demotes it to drained, the survivors finish."""
+        clock, fab = make_fabric(sub)
+        fab.start()
+        await clock.run_for(2.0)
+        fab.coordinator.prepare(params)
+        fab.coordinator.start()
+        victim = None
+        for _ in range(20000):
+            await clock.run_for(0.05)
+            st = fab.coordinator.status()
+            if victim is None:
+                running = sorted(
+                    t["node"] for t in st["assignments"]
+                    if t["state"] == "running"
+                )
+                if running:
+                    victim = running[0]
+                    fab.gray_sweep_failure(victim)
+            if fab.coordinator.state != "running":
+                break
+        assert fab.coordinator.state == "done", fab.coordinator.state
+        assert victim is not None
+        firing = fab.membership.health_firing()
+        out = {
+            "victim": victim,
+            "demotions": fab.counters.get("fleet.gray.demotions"),
+            "ctrl_errors": fab.counters.get("fleet.ctrl.errors"),
+            "crashes": fab.counters.get("fleet.crash") or 0,
+            "drained_still_up": (
+                fab.membership.is_up(victim)
+                and not fab.membership.is_live(victim)
+            ),
+            "ticket_firing": "fleet_gray_failure" in firing,
+            "digest": fab.coordinator.summary()["summary_digest"],
+            "manifest": fab.coordinator.manifest_bytes(),
+        }
+        await fab.stop()
+        return out
+
+    async def flap_scenario(sub: str) -> dict:
+        """A member bouncing inside the flap window is DAMPED with an
+        exponential hold, bounding ownership churn to <=2 moves per
+        flap cycle (one out, one back)."""
+        cycles = 2
+        clock, fab = make_fabric(
+            sub,
+            liveness_overrides={
+                **fast_liveness,
+                "flap_hold_base_s": 1.0,
+                "flap_hold_max_s": 4.0,
+                "flap_window_s": 30.0,
+            },
+        )
+        fab.start()
+        await clock.run_for(2.0)
+        watchers = [
+            fab.router.watch("route_db", {"node": f"node{i}"})
+            for i in range(12)
+        ]
+        await clock.run_for(1.0)
+        placement = {}
+        for w in watchers:
+            placement.setdefault(w.serving_node, []).append(w)
+        victim = max(sorted(placement), key=lambda n: len(placement[n]))
+        epoch0 = fab.membership.epoch
+        for _ in range(cycles):
+            fab.heartbeat_stall(victim)
+            await clock.run_for(0.8)  # past the TTL: down
+            fab.heal_heartbeat(victim)
+            await clock.run_for(0.3)
+        # ride out the exponential hold of the damped rejoin, plus the
+        # tick that readmits once the hold expires with beats flowing
+        await clock.run_for(3.0)
+        assert fab.membership.is_live(victim)
+        out = {
+            "victim": victim,
+            "flap_cycles": cycles,
+            "flap_damped": fab.counters.get("fleet.flap_damped"),
+            "epoch_bumps": fab.membership.epoch - epoch0,
+            "max_watcher_migrations": max(
+                w.migrations for w in watchers
+            ),
+            "violations": fab.router.invariant_violations(),
+        }
+        await fab.stop()
+        return out
+
+    async def liveness_half(clean_digest, clean_manifest) -> dict:
+        det = [
+            await detect_once(f"live_det{k}", k) for k in range(5)
+        ]
+        det_sorted = sorted(det)
+        uk_a = await unannounced_scenario("live_uk_a")
+        uk_b = await unannounced_scenario("live_uk_b")
+        sb_a = await split_brain_scenario("live_sb_a")
+        sb_b = await split_brain_scenario("live_sb_b")
+        fe = await epoch_fence_scenario("live_fence")
+        sg = await straggler_scenario("live_strag")
+        gr = await gray_scenario("live_gray")
+        fl = await flap_scenario("live_flap")
+        return {
+            "heartbeat": {
+                "interval_s": fast_liveness["heartbeat_interval_s"],
+                "suspect_after_s": fast_liveness["suspect_after_s"],
+                "ttl_s": fast_liveness["heartbeat_ttl_s"],
+                "tick_s": fast_liveness["tick_s"],
+            },
+            "detection": {
+                "samples": len(det),
+                "p50_s": det_sorted[len(det_sorted) // 2],
+                "max_s": det_sorted[-1],
+                # TTL from the last pre-kill beat + one tracker tick +
+                # the harness sampling step
+                "bound_s": round(
+                    fast_liveness["heartbeat_ttl_s"]
+                    + fast_liveness["tick_s"]
+                    + 0.02,
+                    6,
+                ),
+            },
+            "unannounced_kill": {
+                "victim": uk_a["victim"],
+                "detection_s": uk_a["detection_s"],
+                "suspects_seen": uk_a["suspects_seen"],
+                "repacked_worlds": uk_a["repacked_worlds"],
+                "digest_equal": uk_a["digest"] == clean_digest,
+                "manifest_byte_identical": (
+                    uk_a["manifest"] == clean_manifest
+                ),
+                "invariant_violations": uk_a["violations"],
+                "pre_migration_generation_emissions": (
+                    uk_a["re_emissions"]
+                ),
+                "deterministic_replay": (
+                    uk_a["victim"] == uk_b["victim"]
+                    and uk_a["detection_s"] == uk_b["detection_s"]
+                    and uk_a["digest"] == uk_b["digest"]
+                    and uk_a["manifest"] == uk_b["manifest"]
+                    and uk_a["log"] == uk_b["log"]
+                ),
+            },
+            "split_brain": {
+                "victim": sb_a["victim"],
+                "fenced_stream_deliveries": sb_a["fenced_stream"],
+                "invariant_violations": sb_a["violations"],
+                "double_pushes": sb_a["re_emissions"],
+                "healed_rejoined": sb_a["healed_live"],
+                "healed_stale_subscriptions": sb_a["stale_after_heal"],
+                "deterministic_replay": (
+                    sb_a["victim"] == sb_b["victim"]
+                    and sb_a["log"] == sb_b["log"]
+                ),
+            },
+            "epoch_fence": {
+                "fenced_worlds": fe["fenced_worlds"],
+                "sweep_fence_rejections": fe["sweep_fence_rejections"],
+                "digest_equal": fe["digest"] == clean_digest,
+                "manifest_byte_identical": (
+                    fe["manifest"] == clean_manifest
+                ),
+            },
+            "straggler": {
+                "straggler": sg["straggler"],
+                "straggler_repacks": sg["straggler_repacks"],
+                "repacked_worlds": sg["repacked_worlds"],
+                "duplicate_completions": sg["duplicate_completions"],
+                "digest_equal": sg["digest"] == clean_digest,
+                "manifest_byte_identical": (
+                    sg["manifest"] == clean_manifest
+                ),
+            },
+            "gray_failure": {
+                "victim": gr["victim"],
+                "demotions": gr["demotions"],
+                "ctrl_errors": gr["ctrl_errors"],
+                "coordinator_crashes": gr["crashes"],
+                "drained_still_up": gr["drained_still_up"],
+                "ticket_firing": gr["ticket_firing"],
+                "digest_equal": gr["digest"] == clean_digest,
+            },
+            "flap": {
+                "victim": fl["victim"],
+                "flap_cycles": fl["flap_cycles"],
+                "flap_damped": fl["flap_damped"],
+                "epoch_bumps": fl["epoch_bumps"],
+                "max_watcher_migrations": fl["max_watcher_migrations"],
+                "invariant_violations": fl["violations"],
+            },
+        }
+
     try:
-        sweep_detail = asyncio.run(sweep_half())
+        clean_digest, clean_manifest, sweep_detail = asyncio.run(
+            sweep_half()
+        )
         streaming_detail = asyncio.run(streaming_half())
+        liveness_detail = asyncio.run(
+            liveness_half(clean_digest, clean_manifest)
+        )
     finally:
         shutil.rmtree(root, ignore_errors=True)
     return {
@@ -4863,6 +5315,7 @@ def _fleet_bench_doc(seed: Optional[int]) -> dict:
         "detail": {
             "sweep": sweep_detail,
             "streaming": streaming_detail,
+            "liveness": liveness_detail,
             "seed": seed,
             "mode": (
                 "3 fleet members (serving+streaming+sweep) over one "
@@ -4871,7 +5324,10 @@ def _fleet_bench_doc(seed: Optional[int]) -> dict:
                 "scenario-set hash), sub-sweeps merged through the "
                 "feed-order-independent reducer; chaos = mid-sweep "
                 "member kill + mid-stream kill/drain via the fleet "
-                "membership plane"
+                "membership plane, plus the ISSUE-20 liveness tier "
+                "(compressed heartbeat timers): unannounced kill, "
+                "asymmetric partition, stale-epoch fencing, straggler "
+                "re-pack, gray-failure demotion, flap damping"
             ),
             "env": env_stamp(),
         },
@@ -4898,6 +5354,15 @@ def fleet_streaming_main(seed: Optional[int] = None) -> None:
     """Fleet compute-fabric benchmark (BENCH_FLEET_r*), streaming-first
     entry point — same combined measurement as --fleet-sweep (see
     fleet_sweep_main for why the halves are never benched apart)."""
+    fleet_sweep_main(seed)
+
+
+def fleet_liveness_main(seed: Optional[int] = None) -> None:
+    """Fleet compute-fabric benchmark (BENCH_FLEET_r*), liveness-first
+    entry point — same combined measurement as --fleet-sweep: the
+    liveness tier's kill-detection/fencing/straggler/gray/flap
+    scenarios share the membership plane the other halves gate, so the
+    one artifact carries all three sections."""
     fleet_sweep_main(seed)
 
 
@@ -5351,6 +5816,7 @@ BENCH_MODES = {
     "frr": (frr_main, "flap sample 7", "fast-reroute protection tier: protected-flap publication→FIB percentiles vs the warm path on grid4096"),
     "fleet-sweep": (fleet_sweep_main, "grammar 7", "fleet fabric: 3-node sharded sweep digest parity + mid-sweep kill repack (emits the combined fleet artifact)"),
     "fleet-streaming": (fleet_streaming_main, "grammar 7", "fleet fabric: consistent-hash watcher migration under kill/drain (emits the combined fleet artifact)"),
+    "fleet-liveness": (fleet_liveness_main, "grammar 7", "fleet liveness: heartbeat kill-detection latency, epoch fencing, straggler/gray digest parity, flap damping (emits the combined fleet artifact)"),
 }
 
 

@@ -10,7 +10,7 @@ assignments silently recomputed against a set nobody else observed,
 watchers migrated with no alert edge, the membership seq desynced from
 the transition that caused it.
 
-Rule:
+Rules:
 
 * ``fleet-directory`` — a call to the membership mutators
   (``node_down``, ``node_up``, ``drain_node``, ``undrain_node``)
@@ -21,6 +21,15 @@ Rule:
   calls on a receiver whose name hints at the fleet (``membership``,
   ``fleet``, ``nodeset``) — ``x.node_up()`` on unrelated objects must
   not trip.
+* ``fleet-liveness`` (ISSUE 20) — the epoch/suspicion/damping mutators
+  (``bump_epoch``, ``mark_suspect``, ``clear_suspect``,
+  ``set_damped_until``, ``record_incarnation``) called anywhere outside
+  ``openr_tpu/fleet/`` itself.  STRICTER than fleet-directory: chaos
+  and the emulation harness are NOT exempt — they perturb the heartbeat
+  PLANE (stall a beacon, drop a publication, reincarnate) and the
+  LivenessTracker must conclude the epoch bump or suspicion itself.  A
+  harness that writes the fencing token directly is testing its own
+  wiring, not the detector.
 """
 
 from __future__ import annotations
@@ -37,8 +46,19 @@ ALLOWED_PREFIXES = (
     "openr_tpu/emulation/",
 )
 
+#: the liveness tier's mutators are single-writer inside the fleet
+#: package itself — even chaos/emulation only drive the heartbeat plane
+LIVENESS_ALLOWED_PREFIXES = ("openr_tpu/fleet/",)
+
 _MUTATOR_CALLS = {"node_down", "node_up", "drain_node", "undrain_node"}
-_RECEIVER_HINTS = ("membership", "fleet", "nodeset")
+_LIVENESS_MUTATORS = {
+    "bump_epoch",
+    "mark_suspect",
+    "clear_suspect",
+    "set_damped_until",
+    "record_incarnation",
+}
+_RECEIVER_HINTS = ("membership", "fleet", "nodeset", "liveness", "tracker")
 
 
 class FleetDirectoryPass(Pass):
@@ -48,6 +68,12 @@ class FleetDirectoryPass(Pass):
             "fleet membership mutator called outside openr_tpu/fleet/ "
             "(liveness is single-writer: assignment, migration and the "
             "node-loss alerts all key off the membership seq)"
+        ),
+        "fleet-liveness": (
+            "fleet epoch/suspicion/damping mutator called outside "
+            "openr_tpu/fleet/ (the fencing token and suspicion state "
+            "have ONE writer — the liveness tracker; chaos perturbs "
+            "the heartbeat plane, never these)"
         ),
     }
     examples = {
@@ -61,10 +87,22 @@ class FleetDirectoryPass(Pass):
                 "    return membership.status()['live']\n"
             ),
         },
+        "fleet-liveness": {
+            "trip": (
+                "def fence(membership):\n"
+                "    membership.bump_epoch()\n"
+            ),
+            "fix": (
+                "def fence(membership):\n"
+                "    return membership.epoch\n"
+            ),
+        },
     }
 
     def run(self, mod: ParsedModule, ctx: dict) -> List[Finding]:
-        if mod.rel.startswith(ALLOWED_PREFIXES):
+        dir_exempt = mod.rel.startswith(ALLOWED_PREFIXES)
+        liveness_exempt = mod.rel.startswith(LIVENESS_ALLOWED_PREFIXES)
+        if dir_exempt and liveness_exempt:
             return []
         out: List[Finding] = []
         for node in ast.walk(mod.tree):
@@ -74,7 +112,27 @@ class FleetDirectoryPass(Pass):
             if not isinstance(f, ast.Attribute):
                 continue
             name = f.attr
-            if name not in _MUTATOR_CALLS:
+            if name in _MUTATOR_CALLS:
+                if dir_exempt:
+                    continue
+                rule = "fleet-directory"
+                msg = (
+                    f"`{name}(..)` outside openr_tpu/fleet/ mutates "
+                    "the live-node set behind the fabric's back; "
+                    "drive membership through FleetMembership (fleet/"
+                    "chaos/emulation tiers only)"
+                )
+            elif name in _LIVENESS_MUTATORS:
+                if liveness_exempt:
+                    continue
+                rule = "fleet-liveness"
+                msg = (
+                    f"`{name}(..)` outside openr_tpu/fleet/ writes the "
+                    "epoch/suspicion/damping state the LivenessTracker "
+                    "single-writes; perturb the heartbeat plane (stall/"
+                    "partition/reincarnate) and let the tracker conclude"
+                )
+            else:
                 continue
             hit = True
             if isinstance(f.value, ast.Name):
@@ -84,14 +142,5 @@ class FleetDirectoryPass(Pass):
                 recv = f.value.attr.lower()
                 hit = any(h in recv for h in _RECEIVER_HINTS)
             if hit:
-                out.append(
-                    mod.finding(
-                        "fleet-directory",
-                        node,
-                        f"`{name}(..)` outside openr_tpu/fleet/ mutates "
-                        "the live-node set behind the fabric's back; "
-                        "drive membership through FleetMembership (fleet/"
-                        "chaos/emulation tiers only)",
-                    )
-                )
+                out.append(mod.finding(rule, node, msg))
         return out

@@ -318,11 +318,15 @@ def _accept_frr(doc: dict) -> None:
 
 
 def _spoil_fleet(doc: dict) -> None:
-    # the two fleet laws, both broken: a cross-node merge whose digest
-    # diverged from the single-node run, and a watcher migration that
-    # emitted a non-monotone generation — neither may ever pass
+    # the fleet laws, broken: a cross-node merge whose digest diverged
+    # from the single-node run, a watcher migration that emitted a
+    # non-monotone generation, and (ISSUE 20) an unannounced kill whose
+    # post-detection merge diverged — none may ever pass
     doc["detail"]["sweep"]["summary_digest_equal"] = False
     doc["detail"]["streaming"]["invariant_violations"] = 1
+    liveness = doc["detail"].get("liveness")
+    if liveness is not None:
+        liveness["unannounced_kill"]["digest_equal"] = False
 
 
 def _accept_fleet(doc: dict) -> None:
@@ -346,6 +350,30 @@ def _accept_fleet(doc: dict) -> None:
     assert st["drain"]["invariant_violations"] == 0
     assert st["drain"]["residual_subscribers"] == 0
     assert st["deterministic_replay"] is True
+    # the ISSUE-20 liveness floor: an UNANNOUNCED kill concluded from
+    # heartbeat silence alone inside the TTL bound, worlds re-packed
+    # and digest unchanged; stale-epoch work fenced (never doubled);
+    # straggler re-pack first-committed-wins; a gray member demoted
+    # without crashing the pump; a flapping member damped with churn
+    # bounded to <=2 ownership moves per flap cycle
+    lv = d["liveness"]
+    assert lv["detection"]["max_s"] <= lv["detection"]["bound_s"]
+    uk = lv["unannounced_kill"]
+    assert uk["digest_equal"] is True
+    assert uk["manifest_byte_identical"] is True
+    assert uk["invariant_violations"] == 0
+    assert uk["deterministic_replay"] is True
+    assert lv["split_brain"]["fenced_stream_deliveries"] >= 1
+    assert lv["split_brain"]["double_pushes"] == 0
+    assert lv["epoch_fence"]["fenced_worlds"] >= 1
+    assert lv["epoch_fence"]["digest_equal"] is True
+    assert lv["straggler"]["straggler_repacks"] >= 1
+    assert lv["straggler"]["digest_equal"] is True
+    assert lv["gray_failure"]["demotions"] >= 1
+    assert lv["gray_failure"]["coordinator_crashes"] == 0
+    fl = lv["flap"]
+    assert fl["flap_damped"] >= 1
+    assert fl["max_watcher_migrations"] <= 2 * fl["flap_cycles"]
 
 
 def _accept_rolling(doc: dict) -> None:
@@ -667,9 +695,13 @@ MANIFEST: Tuple[ArtifactSpec, ...] = (
             "sweep merged to the single-node digest (plus a mid-sweep "
             "member kill re-packing only the victim's worlds), and "
             "consistent-hash watcher migration under member kill/drain "
-            "with the monotone-generation invariant gated hard "
-            "(bench.py --fleet-sweep / --fleet-streaming; one combined "
-            "artifact — the halves share the membership plane)"
+            "with the monotone-generation invariant gated hard, plus "
+            "the self-hosted liveness tier (ISSUE 20): unannounced-"
+            "kill detection from heartbeat silence alone, stale-epoch "
+            "fencing, straggler re-pack, gray-failure demotion and "
+            "flap damping (bench.py --fleet-sweep / --fleet-streaming "
+            "/ --fleet-liveness; one combined artifact — the halves "
+            "share the membership plane)"
         ),
         validate=_v("fleet"),
         headline=(
@@ -681,6 +713,14 @@ MANIFEST: Tuple[ArtifactSpec, ...] = (
             # (informational trajectory; grammar growth moves it)
             HeadlineMetric(
                 "detail.sweep.kill.repacked_worlds",
+                LOWER,
+                ratchet=False,
+            ),
+            # virtual-clock heartbeat kill-detection latency under the
+            # compressed bench timers (deterministic; tracked, the TTL
+            # bound is gated by acceptance rather than ratcheted)
+            HeadlineMetric(
+                "detail.liveness.detection.p50_s",
                 LOWER,
                 ratchet=False,
             ),

@@ -937,6 +937,74 @@ def sweep_cancel(ctx: click.Context) -> None:
     _print(_call(ctx, "cancel_sweep"))
 
 
+# ------------------------------------------------------------------- fleet
+
+
+def render_fleet_status(doc: dict) -> list:
+    """Render ``get_fleet_status`` into lines — module-level so the
+    runbook columns (suspicion state, incarnation, heartbeat age,
+    damping clock, epoch) are unit-testable without a node.  The
+    liveness table is the first stop of the "fleet disagrees about who
+    is alive" runbook: suspect = missed refreshes (still owns), damped
+    = flapping (held out on purpose), drained + gray reason = failing
+    work while heartbeating."""
+    if doc.get("state") == "disabled":
+        return ["fleet tier disabled"]
+    lines = []
+    if doc.get("fleet_id") is not None:
+        lines.append(
+            f"fleet {doc.get('fleet_id') or '-'}: {doc.get('state')}"
+            f"  epoch={doc.get('epoch')}"
+            f"  nodes {doc.get('nodes_live')}/{doc.get('nodes_total')}"
+            f"  worlds {doc.get('worlds_merged')}/{doc.get('worlds_total')}"
+            f"  fenced={doc.get('fenced_worlds')}"
+            f" stragglers={doc.get('straggler_repacks')}"
+            f" dup={doc.get('duplicate_completions')}"
+        )
+        strikes = doc.get("strikes") or {}
+        for node, per in sorted(strikes.items()):
+            tally = " ".join(f"{k}={v}" for k, v in sorted(per.items()))
+            lines.append(f"  strikes {node}: {tally}")
+    liveness = doc.get("liveness")
+    if liveness:
+        lines.append(
+            f"liveness epoch={liveness.get('epoch')}"
+            f"  suspect_after={liveness.get('suspect_after_s')}s"
+            f"  ttl={liveness.get('heartbeat_ttl_s')}s"
+        )
+        for name, row in sorted((liveness.get("members") or {}).items()):
+            lines.append(
+                f"  {name}: {row.get('state')}"
+                f"  inc={row.get('incarnation')}"
+                f"  hb_age={row.get('heartbeat_age_s')}s"
+                f"  damped_for={row.get('damped_for_s')}s"
+                f"  flaps={row.get('flaps_in_window')}"
+            )
+    if not lines:
+        lines.append(f"fleet: {doc.get('state')}")
+    return lines
+
+
+@breeze.group()
+def fleet() -> None:
+    """Fleet membership + liveness: heartbeat-derived suspicion, epoch
+    fencing, flap damping (openr_tpu.fleet; docs/Fleet.md and the
+    Operator_Guide "fleet disagrees about who is alive" runbook)."""
+
+
+@fleet.command("status")
+@click.option("--json/--no-json", "json_out", default=False)
+@click.pass_context
+def fleet_status(ctx: click.Context, json_out: bool) -> None:
+    """Membership / suspicion / damping columns from this member."""
+    doc = _call(ctx, "get_fleet_status")
+    if json_out:
+        _print(doc)
+        return
+    for line in render_fleet_status(doc):
+        click.echo(line)
+
+
 # -------------------------------------------------------------- protection
 
 

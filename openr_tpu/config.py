@@ -357,6 +357,34 @@ class FleetConfig:
     poll_interval_s: float = 0.02
     #: fleet-level ranked-summary depth (matches the sweep default)
     summary_top_k: int = 64
+    # -- heartbeat liveness (ISSUE 20): members advertise TTL-bearing
+    #    fleet:member:<name> keys; the tracker folds refresh/expiry
+    #    into membership via the suspicion state machine.  Validation
+    #    enforces interval < suspect_after < ttl.
+    heartbeat_interval_s: float = 0.5
+    #: no refresh for this long -> suspect (still live, still owns)
+    suspect_after_s: float = 1.25
+    #: no refresh for this long -> down (TTL expiry; ownership moves)
+    heartbeat_ttl_s: float = 2.5
+    #: rejoins inside this window count as flaps
+    flap_window_s: float = 30.0
+    #: flap damping: exponential hold base/cap before readmission
+    flap_hold_base_s: float = 2.0
+    flap_hold_max_s: float = 60.0
+    #: damping-jitter seed (name-salted per member, breaker-style)
+    liveness_seed: int = 0
+    #: suspicion-tick cadence
+    liveness_tick_s: float = 0.25
+    #: re-pack a live member's unfinished worlds after this long
+    #: without declaring it dead (0 disables the straggler policy)
+    straggler_deadline_s: float = 0.0
+    #: failed/timed-out/raising sub-sweeps before a heartbeating
+    #: member is demoted to drained (gray failure)
+    gray_strike_threshold: int = 3
+    #: per-member ctrl-call circuit breaker (PR-5 CircuitBreaker)
+    ctrl_failure_threshold: int = 3
+    ctrl_backoff_initial_s: float = 0.5
+    ctrl_backoff_max_s: float = 8.0
 
 
 @dataclass
@@ -678,6 +706,38 @@ class OpenrConfig:
         if fl.enabled and not fl.member_nodes:
             raise ValueError(
                 "fleet_config.enabled needs at least one member node"
+            )
+        if not (
+            0 < fl.heartbeat_interval_s
+            < fl.suspect_after_s
+            < fl.heartbeat_ttl_s
+        ):
+            raise ValueError(
+                "fleet liveness needs 0 < heartbeat_interval_s < "
+                "suspect_after_s < heartbeat_ttl_s"
+            )
+        if (
+            fl.flap_window_s <= 0
+            or fl.flap_hold_base_s <= 0
+            or fl.flap_hold_max_s < fl.flap_hold_base_s
+            or fl.liveness_tick_s <= 0
+        ):
+            raise ValueError(
+                "fleet flap damping needs flap_window_s > 0, "
+                "flap_hold_base_s > 0, flap_hold_max_s >= base, "
+                "liveness_tick_s > 0"
+            )
+        if (
+            fl.straggler_deadline_s < 0
+            or fl.gray_strike_threshold < 1
+            or fl.ctrl_failure_threshold < 1
+            or fl.ctrl_backoff_initial_s <= 0
+            or fl.ctrl_backoff_max_s < fl.ctrl_backoff_initial_s
+        ):
+            raise ValueError(
+                "fleet ctrl discipline needs straggler_deadline_s >= 0, "
+                "gray_strike_threshold >= 1, ctrl_failure_threshold >= 1, "
+                "0 < ctrl_backoff_initial_s <= ctrl_backoff_max_s"
             )
         pr = self.protection_config
         if (

@@ -593,12 +593,19 @@ class OpenrCtrlHandler:
     def get_fleet_status(self) -> dict:
         """Fleet-fabric view from this member: membership, world
         assignment rounds, merge progress (`breeze sweep status`
-        renders the per-node rows).  "disabled" when this node carries
-        no fleet coordinator attachment."""
+        renders the per-node rows; `breeze fleet status` the liveness
+        columns).  "disabled" when this node carries no fleet
+        coordinator attachment.  When a LivenessTracker is attached
+        (``node.fleet_liveness``), the response carries its per-member
+        suspicion/incarnation/damping view under ``liveness``."""
         fleet = getattr(self.node, "fleet", None)
-        if fleet is None:
+        liveness = getattr(self.node, "fleet_liveness", None)
+        if fleet is None and liveness is None:
             return {"state": "disabled"}
-        return fleet.status()
+        out = fleet.status() if fleet is not None else {"state": "liveness-only"}
+        if liveness is not None:
+            out["liveness"] = liveness.status()
+        return out
 
     # ------------------------------------------------------------ protection
     # (openr_tpu.protection — fast-reroute FIB patch tier minted from

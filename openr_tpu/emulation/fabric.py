@@ -18,7 +18,18 @@ harness exercises the production ingest path, not a test backdoor.
 Chaos verbs: ``kill_node`` stops a member's services and marks it down
 (a crash — watchers migrate, its sweep worlds re-pack);
 ``drain_node`` marks it drained while its daemon stays up (maintenance
-— clean subscription hand-off).  All timing rides the SimClock.
+— clean subscription hand-off).  ISSUE 20 adds the self-hosted
+liveness plane — per-member ``MemberBeacon`` heartbeats feeding one
+``LivenessTracker`` — and the chaos verbs that perturb it WITHOUT
+telling membership anything: ``kill_node_unannounced`` (services die,
+no membership call — the tracker must conclude the death from
+heartbeat silence), ``heartbeat_stall`` / ``heal_heartbeat`` (daemon
+fine, beacon wedged), ``partition_asymmetric`` (the member's
+heartbeats stop REACHING the tracker while its services keep running —
+the split-brain shape epoch fencing exists for), and
+``gray_sweep_failure`` (heartbeats fine, ctrl surface raising — the
+coordinator's strike policy must demote it).  All timing rides the
+SimClock.
 """
 
 from __future__ import annotations
@@ -37,6 +48,8 @@ from openr_tpu.fleet import (
     FleetMembership,
     FleetStreamRouter,
     FleetSweepCoordinator,
+    LivenessTracker,
+    MemberBeacon,
 )
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.serving import QueryService, StreamingService
@@ -50,6 +63,46 @@ from openr_tpu.types import (
     adj_key,
     prefix_key,
 )
+
+
+class _CtrlSurface:
+    """The coordinator's view of one member's sweep ctrl surface: a
+    thin proxy the gray-failure chaos verb can fault.  When faulted,
+    every ctrl verb (and the ``state`` read) raises ConnectionError —
+    the member is alive and heartbeating, its ctrl plane is not — which
+    is exactly the shape the coordinator's per-member breaker + strike
+    policy must absorb (never a coordinator crash)."""
+
+    def __init__(self, svc) -> None:
+        self._svc = svc
+        self.fault = ""
+
+    def _check(self) -> None:
+        if self.fault:
+            raise ConnectionError(f"ctrl fault injected: {self.fault}")
+
+    @property
+    def state(self):
+        self._check()
+        return self._svc.state
+
+    def start_sweep(self, params=None):
+        self._check()
+        return self._svc.start_sweep(params)
+
+    def cancel_sweep(self):
+        self._check()
+        return self._svc.cancel_sweep()
+
+    def get_sweep_status(self):
+        self._check()
+        return self._svc.get_sweep_status()
+
+    def __getattr__(self, name):
+        # non-verb reads (config, enumeration_pairs, decision,
+        # attach_fleet, ...) pass through unfaulted: the gray failure
+        # under test is the WORK surface, not module wiring
+        return getattr(self._svc, name)
 
 
 class _FabricNode:
@@ -98,6 +151,8 @@ class FleetFabric:
         serving_overrides: Optional[dict] = None,
         sweep_overrides: Optional[dict] = None,
         coordinator_poll_s: float = 0.02,
+        liveness_overrides: Optional[dict] = None,
+        coordinator_overrides: Optional[dict] = None,
     ) -> None:
         self.clock = clock
         self.n_side = n_side
@@ -140,14 +195,52 @@ class FleetFabric:
             {n: fab.streaming for n, fab in self.nodes.items()},
             counters=self.counters,
         )
+        #: the coordinator talks to members through faultable ctrl
+        #: proxies — gray_sweep_failure flips one member's to raising
+        self.ctrl: Dict[str, _CtrlSurface] = {
+            n: _CtrlSurface(fab.sweep) for n, fab in self.nodes.items()
+        }
         self.coordinator = FleetSweepCoordinator(
             clock,
             self.membership,
-            {n: fab.sweep for n, fab in self.nodes.items()},
+            dict(self.ctrl),
             spill_root=f"{spill_root}/fleet",
             counters=self.counters,
             poll_interval_s=coordinator_poll_s,
+            **(coordinator_overrides or {}),
         )
+        # -- the self-hosted liveness plane: beacons -> (partition
+        #    gate) -> tracker -> membership transitions
+        liveness_kw = dict(liveness_overrides or {})
+        self.liveness = LivenessTracker(
+            clock, self.membership, counters=self.counters, **liveness_kw
+        )
+        #: members whose heartbeats are partitioned AWAY from the
+        #: tracker (their services keep running: asymmetric partition)
+        self._hb_blocked: set = set()
+        self.beacons: Dict[str, MemberBeacon] = {
+            name: MemberBeacon(
+                name,
+                clock,
+                publish=(
+                    lambda pub, n=name: self._hb_publish(n, pub)
+                ),
+                heartbeat_interval_s=self.liveness.heartbeat_interval_s,
+                heartbeat_ttl_s=self.liveness.heartbeat_ttl_s,
+                counters=self.counters,
+            )
+            for name in node_names
+        }
+
+    def _hb_publish(self, name: str, pub: Publication) -> None:
+        """The heartbeat bus, with the partition gate in the middle: a
+        blocked member's refreshes are dropped before the tracker ever
+        sees them — from the fleet's vantage the member has gone silent
+        while (asymmetrically) its own services still run and push."""
+        if name in self._hb_blocked:
+            self.counters.bump("fleet.hb_dropped")
+            return
+        self.liveness.on_publication(pub)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -158,6 +251,9 @@ class FleetFabric:
         self.decision.start()
         for fab in self.nodes.values():
             fab.start()
+        for beacon in self.beacons.values():
+            beacon.start()  # first beat fires inside run()
+        self.liveness.start()
         edges = grid_edges(self.n_side)
         dbs = build_adj_dbs(edges)
         self.kv_q.push(
@@ -178,6 +274,9 @@ class FleetFabric:
     async def stop(self) -> None:
         self.coordinator.cancel()
         await self.coordinator.stop()
+        await self.liveness.stop()
+        for beacon in self.beacons.values():
+            await beacon.stop()
         for fab in self.nodes.values():
             await fab.stop()
         await self.decision.stop()
@@ -228,11 +327,60 @@ class FleetFabric:
     # -- chaos verbs -------------------------------------------------------
 
     async def kill_node(self, name: str) -> None:
-        """Crash one member: its services stop (subscriptions die with
-        the daemon) and membership marks it down — watchers migrate to
-        hash successors, its unmerged sweep worlds re-pack."""
+        """Crash one member, ANNOUNCED: its services stop
+        (subscriptions die with the daemon), its beacon stalls, and
+        membership marks it down — watchers migrate to hash
+        successors, its unmerged sweep worlds re-pack."""
         await self.nodes[name].stop()
+        self.beacons[name].stall()
         self.membership.node_down(name, reason="chaos-kill")
+
+    async def kill_node_unannounced(self, name: str) -> None:
+        """Crash one member and tell membership NOTHING: services stop,
+        the beacon stalls, and the liveness tracker must conclude the
+        death from heartbeat silence alone (suspect at
+        ``suspect_after_s``, down at TTL expiry) — the detection-tier
+        acceptance scenario."""
+        await self.nodes[name].stop()
+        self.beacons[name].stall()
+        self.counters.bump("fleet.chaos.unannounced_kills")
+
+    def heartbeat_stall(self, name: str) -> None:
+        """Wedge one member's beacon: daemon alive and serving, no
+        refreshes — the tracker must declare it down anyway (then fence
+        whatever the stale owner keeps doing)."""
+        self.beacons[name].stall()
+
+    def heal_heartbeat(self, name: str) -> None:
+        """Un-wedge + reincarnate the beacon (a same-incarnation rejoin
+        after the fleet declared it down would be refused)."""
+        self.beacons[name].reincarnate()
+        self.beacons[name].beat_now()
+
+    def partition_asymmetric(self, name: str) -> None:
+        """Asymmetric partition: the member's heartbeats stop REACHING
+        the tracker while its services keep running and pushing.  The
+        fleet declares it down and re-derives ownership; the isolated
+        member's stale-epoch pushes/dispatches must be fenced, not
+        double-delivered."""
+        self._hb_blocked.add(name)
+        self.counters.bump("fleet.chaos.partitions")
+
+    def heal_partition(self, name: str) -> None:
+        self._hb_blocked.discard(name)
+        self.beacons[name].reincarnate()
+        self.beacons[name].beat_now()
+
+    def gray_sweep_failure(self, name: str) -> None:
+        """Gray failure: heartbeats keep flowing, but the member's
+        sweep ctrl surface raises on every touch — the coordinator's
+        breaker + strike policy must demote it to drained
+        (``fleet_gray_failure`` ticket), not crash and not wait."""
+        self.ctrl[name].fault = "gray_sweep_failure"
+        self.counters.bump("fleet.chaos.gray_faults")
+
+    def heal_gray(self, name: str) -> None:
+        self.ctrl[name].fault = ""
 
     def drain_node(self, name: str) -> None:
         """Maintenance-drain one member: daemon stays up, membership
@@ -243,13 +391,17 @@ class FleetFabric:
         fab = self.nodes[name]
         if not fab.running:
             fab.start()
+        self.beacons[name].reincarnate()
         self.membership.node_up(name)
+        self.beacons[name].beat_now()
 
     # -- observability -----------------------------------------------------
 
     def status(self) -> dict:
         return {
+            "epoch": self.membership.epoch,
             "membership": self.membership.status(),
+            "liveness": self.liveness.status(),
             "router": self.router.status(),
             "coordinator": self.coordinator.status(),
         }

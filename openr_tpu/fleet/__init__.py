@@ -1,4 +1,4 @@
-"""Fleet compute fabric (ISSUE 19) — the tier above one node.
+"""Fleet compute fabric (ISSUE 19/20) — the tier above one node.
 
 Every earlier plane stops at a single daemon: a capacity sweep runs on
 ONE node's DevicePool, a watcher must dial the node that holds its
@@ -12,18 +12,29 @@ halves over one membership/directory core:
 * :mod:`openr_tpu.fleet.membership` — ``FleetMembership``, the single
   writer of node liveness/drain state (NodeSet underneath — the
   node-level DevicePool), feeding listeners and the health plane
-  (``fleet_node_loss`` pages, ``fleet_drain_migration`` tickets);
+  (``fleet_node_loss`` pages, ``fleet_drain_migration`` /
+  ``fleet_gray_failure`` tickets), and minting the monotone **epoch**
+  every ownership derivation is fenced against;
+* :mod:`openr_tpu.fleet.liveness` — ``MemberBeacon`` +
+  ``LivenessTracker`` (ISSUE 20): heartbeat-derived membership over the
+  TTL-bearing ``fleet:member:<name>`` key family — suspicion state
+  machine (up → suspect → down at TTL expiry), incarnation-monotone
+  rejoin, deterministic flap damping.  The fleet detects death itself
+  instead of being told;
 * :mod:`openr_tpu.fleet.directory` — ``FeedDirectory`` +
   ``FleetStreamRouter``: any live node serves a watcher's feed; node
   death/drain migrates subscribers to the hash successor, who resyncs
   with a fresh generation-stamped snapshot then deltas, the monotone-
-  generation invariant checked ACROSS the migration;
+  generation invariant checked ACROSS the migration; deliveries are
+  epoch-fenced, resyncs coalesce per epoch bump;
 * :mod:`openr_tpu.fleet.coordinator` — ``FleetSweepCoordinator``:
   world-granular sweep sharding across N nodes' pools, merged through
   the feed-order-independent reducer (merged digest byte-equal to a
   single-node run), dead-node worlds re-packed onto survivors with a
   pure-content fleet manifest that stays byte-identical to an
-  uninterrupted run's.
+  uninterrupted run's; per-member ctrl breakers, epoch-stamped
+  dispatches, straggler re-packs with first-committed-wins duplicate
+  reconciliation, gray-failure strike demotion.
 
 Failure-domain hierarchy: chip < node.  A dead chip re-packs one shard
 inside its node's executor; a dead node re-packs whole worlds across
@@ -43,7 +54,13 @@ from openr_tpu.fleet.directory import (
     FleetWatcher,
     feed_key,
 )
-from openr_tpu.fleet.membership import FleetMembership
+from openr_tpu.fleet.liveness import (
+    LivenessTracker,
+    MemberBeacon,
+    heartbeat_value,
+    parse_heartbeat,
+)
+from openr_tpu.fleet.membership import FleetMembership, MembershipView
 
 __all__ = [
     "FeedDirectory",
@@ -51,9 +68,14 @@ __all__ = [
     "FleetStreamRouter",
     "FleetSweepCoordinator",
     "FleetWatcher",
+    "LivenessTracker",
+    "MemberBeacon",
+    "MembershipView",
     "assign_worlds",
     "feed_key",
+    "heartbeat_value",
     "owner_of",
+    "parse_heartbeat",
     "rank_members",
     "rendezvous_score",
 ]

@@ -13,15 +13,41 @@ interleaving.
 
 Failure domains compose: a dead CHIP re-packs its shard inside one
 node's executor (PR-8); a dead NODE is the domain above it — the
-coordinator discards the dead node's *unmerged* spill entirely (a
-partial spill would force row-level dedup; world-granular re-solve is
-deterministic and duplicate-free), re-packs ALL its incomplete worlds
-onto the survivors as the next assignment round, and keeps merged work
-untouched.  The fleet manifest is pure content — (set hash, completed
-worlds, totals) in canonical JSON — so at completion its bytes are
-identical to an uninterrupted run's, whatever the kill history; the
+coordinator discards the dead node's *unmerged* spill entirely,
+re-packs ALL its incomplete worlds onto the survivors as the next
+assignment round, and keeps merged work untouched.  ISSUE 20 hardens
+the three trust boundaries that remained:
+
+* **RPC discipline** — every cross-node ctrl touch (state polls,
+  launches, cancels, even the local spill read for a remote task)
+  rides a per-member PR-5 ``CircuitBreaker``: a raising ctrl surface
+  costs a failure + a gray strike and is retried under exponential
+  backoff, never propagated into the pump (the PR-19 merge loop died
+  on the first member exception).
+* **Epoch fencing** — every assignment round is stamped with the
+  membership epoch it was derived under and dispatched as
+  ``fleet_epoch``; the receiving SweepService rejects stale-epoch work
+  (``fleet.fenced.sweep``, returned not raised) and the coordinator
+  re-derives those worlds under the current epoch.  A coordinator
+  acting on a stale view can therefore never start work the current
+  composition didn't derive.
+* **Stragglers + gray failure** — a member that holds a round past
+  ``straggler_deadline_s`` has its unfinished worlds re-packed onto
+  the OTHER survivors *without* being declared dead; whichever copy
+  commits a world first wins (first-committed-wins by world key — the
+  loser's rows are dropped at merge, so the digest is byte-identical
+  whether the straggler finishes late, never, or twice).  Strikes from
+  failed/timed-out/raising sub-sweeps accumulate per member; at
+  ``gray_strike_threshold`` the member — heartbeating, answering,
+  failing — is demoted to drained (``fleet_gray_failure`` ticket).
+
+The fleet manifest is pure content — (set hash, completed worlds,
+totals) in canonical JSON — so at completion its bytes are identical
+to an uninterrupted run's, whatever the kill/straggler history; the
 operational world→spill routing that replay needs lives in a separate
-sidecar, explicitly NOT part of the byte-identity contract.
+sidecar (now carrying the worlds actually MERGED from each spill, so a
+resume after a partial first-committed merge replays exactly those),
+explicitly NOT part of the byte-identity contract.
 """
 
 from __future__ import annotations
@@ -33,6 +59,7 @@ from typing import Dict, List, Optional, Tuple
 from openr_tpu.common.runtime import Actor, Clock, CounterMap
 from openr_tpu.fleet.assignment import assign_worlds
 from openr_tpu.fleet.membership import FleetMembership
+from openr_tpu.resilience.breaker import CircuitBreaker
 from openr_tpu.sweep import (
     ScenarioSpec,
     SpillReader,
@@ -46,22 +73,36 @@ from openr_tpu.sweep.scenario import canonical_json
 MANIFEST_NAME = "fleet_manifest.json"
 ROUTING_NAME = "fleet_routing.json"
 
+#: sentinel: a ctrl call that was short-circuited or failed (breaker
+#: bookkeeping already done) — callers skip and retry next pump
+_CTRL_UNAVAILABLE = object()
+
 
 class _Task:
     """One (node, round, world set) sub-sweep assignment."""
 
     __slots__ = (
         "node", "round", "worlds", "scenarios", "state", "spill_dir",
+        "epoch", "launched_at", "merged_worlds", "straggled",
     )
 
-    def __init__(self, node, rnd, worlds, scenarios, spill_dir) -> None:
+    def __init__(self, node, rnd, worlds, scenarios, spill_dir, epoch=0):
         self.node = node
         self.round = rnd
         self.worlds: Tuple[str, ...] = worlds
         self.scenarios = scenarios
-        #: pending|running|merged|lost
+        #: pending|running|merged|lost|fenced|duplicate
         self.state = "pending"
         self.spill_dir = spill_dir
+        #: membership epoch this assignment was derived under — the
+        #: fencing stamp dispatched as ``fleet_epoch``
+        self.epoch = epoch
+        self.launched_at = 0.0
+        #: the worlds actually fed from this spill (first-committed-
+        #: wins may merge a strict subset); the routing sidecar records
+        #: these so resume replays exactly what was merged
+        self.merged_worlds: Tuple[str, ...] = ()
+        self.straggled = False
 
 
 class FleetSweepCoordinator(Actor):
@@ -73,7 +114,8 @@ class FleetSweepCoordinator(Actor):
     sweep is cancelled.  Everything the coordinator touches on a
     SweepService is its public ctrl surface — start_sweep /
     get_sweep_status / state — so a real deployment swaps the direct
-    references for ctrl RPC without changing this logic.
+    references for ctrl RPC without changing this logic; the per-member
+    breaker is exactly where that RPC's timeout/backoff would live.
     """
 
     def __init__(
@@ -85,6 +127,12 @@ class FleetSweepCoordinator(Actor):
         counters: Optional[CounterMap] = None,
         top_k: int = 64,
         poll_interval_s: float = 0.02,
+        straggler_deadline_s: float = 0.0,
+        gray_strike_threshold: int = 3,
+        ctrl_failure_threshold: int = 3,
+        ctrl_backoff_initial_s: float = 0.5,
+        ctrl_backoff_max_s: float = 8.0,
+        ctrl_seed: int = 0,
     ) -> None:
         super().__init__("fleet", clock, counters)
         self.membership = membership
@@ -92,6 +140,14 @@ class FleetSweepCoordinator(Actor):
         self.spill_root = spill_root
         self.top_k = top_k
         self.poll_interval_s = poll_interval_s
+        #: 0 disables the straggler policy (a deadline must be chosen
+        #: against the grammar size; config.py carries the knob)
+        self.straggler_deadline_s = straggler_deadline_s
+        self.gray_strike_threshold = gray_strike_threshold
+        self.ctrl_failure_threshold = ctrl_failure_threshold
+        self.ctrl_backoff_initial_s = ctrl_backoff_initial_s
+        self.ctrl_backoff_max_s = ctrl_backoff_max_s
+        self.ctrl_seed = ctrl_seed
         self.state = "idle"  # idle|running|done|cancelled|failed
         self.error = ""
         self.fleet_id = ""
@@ -105,10 +161,71 @@ class FleetSweepCoordinator(Actor):
         self.tasks: List[_Task] = []
         self.rounds = 0
         self.repacked_worlds = 0
+        self.fenced_worlds = 0
+        self.straggler_repacks = 0
+        self.straggler_repacked_worlds = 0
+        self.duplicate_completions = 0
+        self.duplicate_rows_dropped = 0
         self.reducer = SweepReducer(top_k=top_k)
         self._cancelled = False
         #: node -> the task currently running on it
         self._running: Dict[str, _Task] = {}
+        #: per-member ctrl breakers (lazy — a member may join late)
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        #: per-member per-capability gray-failure strikes
+        self._strikes: Dict[str, Dict[str, int]] = {}
+
+    # -- ctrl discipline ---------------------------------------------------
+
+    def _breaker(self, node: str) -> CircuitBreaker:
+        br = self._breakers.get(node)
+        if br is None:
+            br = self._breakers[node] = CircuitBreaker(
+                f"fleet.ctrl.{node}",
+                self.clock,
+                failure_threshold=self.ctrl_failure_threshold,
+                backoff_initial_s=self.ctrl_backoff_initial_s,
+                backoff_max_s=self.ctrl_backoff_max_s,
+                seed=self.ctrl_seed,
+                counters=self.counters,
+            )
+        return br
+
+    def _member_call(self, node: str, what: str, fn):
+        """One breaker-gated ctrl touch.  A raising member costs a
+        breaker failure + a gray strike and returns the unavailable
+        sentinel — the pump skips and retries under backoff; nothing a
+        member does can take the coordinator fiber down."""
+        br = self._breaker(node)
+        if not br.allow_request():
+            self.counters.bump("fleet.ctrl.short_circuits")
+            return _CTRL_UNAVAILABLE
+        try:
+            out = fn()
+        except Exception as exc:  # noqa: BLE001 — the trust boundary
+            br.record_failure()
+            self.counters.bump("fleet.ctrl.errors")
+            self.error = f"{node}.{what}: {exc}"
+            self._strike(node, "ctrl")
+            return _CTRL_UNAVAILABLE
+        br.record_success()
+        return out
+
+    def _strike(self, node: str, capability: str) -> None:
+        """Gray-failure accounting: a member that answers (or at least
+        heartbeats) but keeps failing its work accrues strikes; at the
+        threshold it is demoted to drained — serves streams, owns no
+        worlds — and the ``fleet_gray_failure`` ticket fires via
+        membership.health_firing()."""
+        per = self._strikes.setdefault(node, {})
+        per[capability] = per.get(capability, 0) + 1
+        self.counters.bump("fleet.gray.strikes")
+        total = sum(per.values())
+        if total >= self.gray_strike_threshold and self.membership.is_live(
+            node
+        ):
+            self.membership.drain_node(node, reason="gray_failure")
+            self.counters.bump("fleet.gray.demotions")
 
     # -- manifest ----------------------------------------------------------
 
@@ -149,7 +266,9 @@ class FleetSweepCoordinator(Actor):
 
     def _write_routing(self) -> None:
         # operational sidecar (NOT content): which spill dir replays
-        # which merged worlds on resume
+        # which MERGED worlds on resume — merged_worlds, not the
+        # assignment, because first-committed-wins may have dropped a
+        # straggler's duplicate subset
         doc = {
             "fleet_set_hash": self.set_hash,
             "merged": [
@@ -157,7 +276,7 @@ class FleetSweepCoordinator(Actor):
                     "node": t.node,
                     "round": t.round,
                     "spill_dir": t.spill_dir,
-                    "worlds": list(t.worlds),
+                    "worlds": list(t.merged_worlds or t.worlds),
                 }
                 for t in self.tasks
                 if t.state == "merged"
@@ -177,6 +296,7 @@ class FleetSweepCoordinator(Actor):
         set."""
         params = dict(params or {})
         params.pop("world_filter", None)  # the coordinator owns filters
+        params.pop("fleet_epoch", None)  # ... and fencing stamps
         live = self.membership.live_nodes()
         if not live:
             raise SweepError("fleet sweep: no live nodes")
@@ -203,9 +323,15 @@ class FleetSweepCoordinator(Actor):
         self.tasks = []
         self.rounds = 0
         self.repacked_worlds = 0
+        self.fenced_worlds = 0
+        self.straggler_repacks = 0
+        self.straggler_repacked_worlds = 0
+        self.duplicate_completions = 0
+        self.duplicate_rows_dropped = 0
         self.reducer = SweepReducer(top_k=self.top_k)
         self._cancelled = False
         self._running = {}
+        self._strikes = {}
         os.makedirs(self._dir(), exist_ok=True)
         resumed_worlds = 0
         if resume:
@@ -219,7 +345,7 @@ class FleetSweepCoordinator(Actor):
             self._assign_round(pending, live)
         self.state = "running" if pending else "done"
         for svc in self.services.values():
-            svc.attach_fleet(self.status)
+            svc.attach_fleet(self.status, epoch_fn=self._current_epoch)
         self._write_manifest()
         self.counters.bump("fleet.sweeps_prepared")
         return {
@@ -231,6 +357,9 @@ class FleetSweepCoordinator(Actor):
             "resumed_worlds": resumed_worlds,
             "state": self.state,
         }
+
+    def _current_epoch(self) -> int:
+        return self.membership.epoch
 
     def _resume_from_manifest(self) -> int:
         try:
@@ -251,8 +380,16 @@ class FleetSweepCoordinator(Actor):
             worlds = tuple(entry.get("worlds", ()))
             if not worlds or not set(worlds) <= completed:
                 continue
+            want = set(worlds)
             try:
-                rows = list(SpillReader(entry["spill_dir"]).rows())
+                rows = [
+                    r
+                    for r in SpillReader(entry["spill_dir"]).rows()
+                    # the sidecar's worlds are what was MERGED from
+                    # this spill; a straggler's duplicate rows for
+                    # worlds committed elsewhere must not replay
+                    if r.get("world") in want
+                ]
             except OSError:
                 continue
             self.reducer.feed(rows)
@@ -264,6 +401,7 @@ class FleetSweepCoordinator(Actor):
                 entry["spill_dir"],
             )
             t.state = "merged"
+            t.merged_worlds = worlds
             self.tasks.append(t)
             replayed |= set(worlds)
             max_round = max(max_round, t.round)
@@ -278,6 +416,7 @@ class FleetSweepCoordinator(Actor):
     ) -> None:
         rnd = self.rounds
         self.rounds += 1
+        epoch = self.membership.epoch
         for node, wks in assign_worlds(
             self.set_hash, worlds, live
         ).items():
@@ -288,14 +427,16 @@ class FleetSweepCoordinator(Actor):
                     wks,
                     sum(self.world_scenarios[w] for w in wks),
                     os.path.join(self._dir(), f"{node}.r{rnd}"),
+                    epoch=epoch,
                 )
             )
 
     # -- the pump ----------------------------------------------------------
 
     def _pump(self) -> None:
-        """One scheduling pass: repack lost work, merge finished work,
-        launch pending work on idle live nodes."""
+        """One scheduling pass: repack lost work, merge finished work
+        (first-committed-wins), repack stragglers, launch pending work
+        on idle live nodes."""
         # 1. a running task on a node that left the live set is LOST:
         #    its spill is discarded (never merged) and every one of its
         #    worlds re-packs over the survivors as a fresh round
@@ -311,45 +452,120 @@ class FleetSweepCoordinator(Actor):
                 t.state = "lost"
                 lost_worlds.extend(t.worlds)
         if lost_worlds:
+            lost_fresh = sorted(
+                set(lost_worlds) - self.completed_worlds
+            )
             live = self.membership.live_nodes()
             if not live:
                 self.state = "failed"
                 self.error = "fleet sweep: no survivors to re-pack onto"
                 return
-            self.repacked_worlds += len(set(lost_worlds))
-            self.counters.bump(
-                "fleet.repacked_worlds", len(set(lost_worlds))
-            )
-            self._assign_round(sorted(set(lost_worlds)), live)
+            if lost_fresh:
+                self.repacked_worlds += len(lost_fresh)
+                self.counters.bump(
+                    "fleet.repacked_worlds", len(lost_fresh)
+                )
+                self._assign_round(lost_fresh, live)
         # 2. merge every finished sub-sweep (order never matters: the
-        #    reducer is feed-order-independent)
+        #    reducer is feed-order-independent; duplicates are dropped
+        #    world-granularly — first committed wins)
         for node, t in list(self._running.items()):
-            svc = self.services[node]
             if not self.membership.is_live(node):
                 continue  # handled as lost next pass
-            if svc.state == "done":
-                rows = list(SpillReader(t.spill_dir).rows())
-                self.reducer.feed(rows)
+            state = self._member_call(
+                node, "state", lambda s=self.services[node]: s.state
+            )
+            if state is _CTRL_UNAVAILABLE:
+                continue
+            if state == "done":
+                fresh = [
+                    w for w in t.worlds if w not in self.completed_worlds
+                ]
+                if not fresh:
+                    # a straggler whose every world was already
+                    # committed by its re-pack: nothing to merge
+                    t.state = "duplicate"
+                    self._running.pop(node)
+                    self.duplicate_completions += 1
+                    self.counters.bump("fleet.duplicate_completions")
+                    continue
+                want = set(fresh)
+                rows = self._member_call(
+                    node,
+                    "spill",
+                    lambda d=t.spill_dir: list(SpillReader(d).rows()),
+                )
+                if rows is _CTRL_UNAVAILABLE:
+                    continue
+                kept = [r for r in rows if r.get("world") in want]
+                dropped = len(rows) - len(kept)
+                if dropped:
+                    self.duplicate_rows_dropped += dropped
+                    self.counters.bump(
+                        "fleet.duplicate_rows_dropped", dropped
+                    )
+                self.reducer.feed(kept)
                 t.state = "merged"
-                self.completed_worlds |= set(t.worlds)
+                t.merged_worlds = tuple(fresh)
+                self.completed_worlds |= want
                 self._running.pop(node)
                 self._write_manifest()
                 self._write_routing()
-                self.counters.bump("fleet.merged_worlds", len(t.worlds))
-            elif svc.state in ("failed", "cancelled"):
-                # treat like a lost node: re-solve its worlds elsewhere
+                self.counters.bump("fleet.merged_worlds", len(fresh))
+            elif state in ("failed", "cancelled"):
+                # gray signal: the member is alive (we just asked it)
+                # but its sweep died — strike it, re-solve elsewhere
                 t.state = "lost"
                 self._running.pop(node)
+                if state == "failed":
+                    self._strike(node, "sweep")
                 live = [
                     n
                     for n in self.membership.live_nodes()
                     if n != node
                 ] or list(self.membership.live_nodes())
-                self.repacked_worlds += len(t.worlds)
-                self._assign_round(sorted(t.worlds), tuple(live))
+                redo = sorted(set(t.worlds) - self.completed_worlds)
+                if redo:
+                    self.repacked_worlds += len(redo)
+                    self._assign_round(redo, tuple(live))
+        # 2b. stragglers: a live member holding a round past the
+        #     deadline has its unfinished worlds re-packed onto the
+        #     OTHER survivors — without waiting for it to die; the
+        #     merge step's first-committed-wins reconciles whichever
+        #     copy lands first
+        if self.straggler_deadline_s > 0:
+            now = self.clock.now()
+            for node, t in list(self._running.items()):
+                if t.straggled:
+                    continue
+                if now - t.launched_at <= self.straggler_deadline_s:
+                    continue
+                others = tuple(
+                    n
+                    for n in self.membership.live_nodes()
+                    if n != node
+                )
+                unfinished = sorted(
+                    set(t.worlds) - self.completed_worlds
+                )
+                if not others or not unfinished:
+                    continue
+                t.straggled = True
+                self.straggler_repacks += 1
+                self.straggler_repacked_worlds += len(unfinished)
+                self.counters.bump(
+                    "fleet.straggler_repacked_worlds", len(unfinished)
+                )
+                self._strike(node, "straggler")
+                self._assign_round(unfinished, others)
         # 3. launch pending tasks on idle live nodes, earliest round
-        #    first (a node's repack work queues behind its current task)
-        for t in self.tasks:
+        #    first (a node's repack work queues behind its current
+        #    task).  Launches are epoch-stamped; the RECEIVER fences
+        #    stale ones (counted, returned, never raised) and the
+        #    coordinator re-derives those worlds under the current
+        #    epoch.
+        fenced: List[str] = []
+        for t in list(self.tasks):
             if t.state != "pending":
                 continue
             if not self.membership.is_live(t.node):
@@ -357,20 +573,57 @@ class FleetSweepCoordinator(Actor):
             if t.node in self._running:
                 continue
             svc = self.services[t.node]
-            if svc.state == "running":
-                continue
-            svc.start_sweep(
-                {
-                    **self.params,
-                    "world_filter": list(t.worlds),
-                    "spill_dir": t.spill_dir,
-                    "root": self.vantage,
-                    "resume": True,
-                }
+            state = self._member_call(
+                t.node, "state", lambda s=svc: s.state
             )
+            if state is _CTRL_UNAVAILABLE or state == "running":
+                continue
+            res = self._member_call(
+                t.node,
+                "start_sweep",
+                lambda s=svc, task=t: s.start_sweep(
+                    {
+                        **self.params,
+                        "world_filter": list(task.worlds),
+                        "spill_dir": task.spill_dir,
+                        "root": self.vantage,
+                        "resume": True,
+                        "fleet_epoch": task.epoch,
+                    }
+                ),
+            )
+            if res is _CTRL_UNAVAILABLE:
+                continue
+            if isinstance(res, dict) and res.get("fenced"):
+                t.state = "fenced"
+                self.fenced_worlds += len(t.worlds)
+                self.counters.bump("fleet.fenced.sweep")
+                fenced.extend(t.worlds)
+                continue
             t.state = "running"
+            t.launched_at = self.clock.now()
             self._running[t.node] = t
             self.counters.bump("fleet.subsweeps_started")
+        if fenced:
+            redo = sorted(set(fenced) - self.completed_worlds)
+            live = self.membership.live_nodes()
+            if redo and live:
+                self._assign_round(redo, live)
+
+    def _cancel_leftovers(self) -> None:
+        """The set completed while stragglers still run their (now
+        fully duplicate) copies: cancel them — their committed shards
+        stay durable, their rows are never fed."""
+        for node, t in list(self._running.items()):
+            self._member_call(
+                node,
+                "cancel_sweep",
+                lambda s=self.services[node]: s.cancel_sweep(),
+            )
+            t.state = "duplicate"
+            self._running.pop(node, None)
+            self.duplicate_completions += 1
+            self.counters.bump("fleet.duplicate_completions")
 
     async def run(self) -> None:
         """Pump until the whole set is merged (or cancel/failure)."""
@@ -378,6 +631,7 @@ class FleetSweepCoordinator(Actor):
             self._pump()
             if len(self.completed_worlds) == self.worlds_total:
                 self.state = "done"
+                self._cancel_leftovers()
                 self._write_manifest()
                 break
             if self.state == "failed":
@@ -391,7 +645,11 @@ class FleetSweepCoordinator(Actor):
     def cancel(self) -> dict:
         self._cancelled = True
         for node, _t in self._running.items():
-            self.services[node].cancel_sweep()
+            self._member_call(
+                node,
+                "cancel_sweep",
+                lambda s=self.services[node]: s.cancel_sweep(),
+            )
         return {"state": self.state}
 
     # -- observability -----------------------------------------------------
@@ -402,6 +660,7 @@ class FleetSweepCoordinator(Actor):
             "fleet_id": self.fleet_id,
             "set_hash": self.set_hash,
             "state": self.state,
+            "epoch": self.membership.epoch,
             "nodes_live": len(live),
             "nodes_total": len(self.membership.names),
             "worlds_total": self.worlds_total,
@@ -409,7 +668,19 @@ class FleetSweepCoordinator(Actor):
             "scenarios_total": self.scenarios_total,
             "scenarios_merged": self.reducer.scenarios,
             "repacked_worlds": self.repacked_worlds,
+            "fenced_worlds": self.fenced_worlds,
+            "straggler_repacks": self.straggler_repacks,
+            "straggler_repacked_worlds": self.straggler_repacked_worlds,
+            "duplicate_completions": self.duplicate_completions,
+            "duplicate_rows_dropped": self.duplicate_rows_dropped,
             "rounds": self.rounds,
+            "strikes": {
+                n: dict(sorted(per.items()))
+                for n, per in sorted(self._strikes.items())
+            },
+            "breakers": {
+                n: br.state for n, br in sorted(self._breakers.items())
+            },
             "assignments": [
                 {
                     "node": t.node,
@@ -417,6 +688,7 @@ class FleetSweepCoordinator(Actor):
                     "worlds": len(t.worlds),
                     "scenarios": t.scenarios,
                     "state": t.state,
+                    "epoch": t.epoch,
                 }
                 for t in self.tasks
             ],
@@ -441,5 +713,10 @@ class FleetSweepCoordinator(Actor):
             "fleet.worlds_total": float(self.worlds_total),
             "fleet.worlds_merged": float(len(self.completed_worlds)),
             "fleet.repacked_worlds": float(self.repacked_worlds),
+            "fleet.fenced_worlds": float(self.fenced_worlds),
+            "fleet.straggler_repacks": float(self.straggler_repacks),
+            "fleet.duplicate_completions": float(
+                self.duplicate_completions
+            ),
             "fleet.rounds": float(self.rounds),
         }

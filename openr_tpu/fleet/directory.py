@@ -6,10 +6,23 @@ each canonical feed key to its owner by rendezvous hash over the LIVE
 serving nodes (assignment.py's one law: pure function of (key, live
 set)).  ``FleetStreamRouter`` holds the fleet's watchers, subscribes
 each to its owner's StreamingService (PR-13 push transport), and on
-every membership transition re-derives ownership: a watcher whose
+every membership EPOCH BUMP re-derives ownership: a watcher whose
 serving node died or drained migrates to the hash successor, who pushes
 a fresh generation-stamped snapshot and then deltas — resync riding the
 existing snapshot+delta machinery.
+
+**Epoch fencing (ISSUE 20).**  Every subscription's deliver path is
+stamped with the membership epoch it was derived under; once the router
+re-derives at a newer epoch, anything the OLD subscription still pushes
+is rejected at the watcher's door (``fleet.fenced.stream``, counted
+never raised).  This closes the split-brain window structurally: a
+partitioned-but-alive old owner — one the fleet declared down, whose
+daemon never heard the unsubscribe — can push forever and never lands a
+double delivery.  (PR 19 closed one instance of this bug class with an
+``is_up``-vs-``is_live`` predicate at detach time; the fence makes the
+whole class unreachable.)  Subscriptions on unreachable daemons are
+remembered and garbage-collected with a real unsubscribe when the node
+is next reachable.
 
 The migration invariant (checked per watcher, per emission): the
 monotone-generation contract HOLDS ACROSS the migration — a delta's seq
@@ -91,6 +104,14 @@ class FleetWatcher:
         self.pre_migration_re_emissions = 0
         self.serving_node: Optional[str] = None
         self.sub_id: Optional[int] = None
+        #: the epoch the CURRENT placement was derived under — the
+        #: fencing token each subscription's deliver closure compares
+        self.fence_epoch = -1
+        #: stale-epoch deliveries rejected at this watcher's door
+        self.fenced = 0
+        #: subscriptions left behind on daemons that were unreachable
+        #: at hand-off: (node, sub_id) — GC'd when the node is next up
+        self.stale_subs: List[Tuple[str, int]] = []
 
     def deliver(self, emission: dict) -> None:
         seq = int(emission["seq"])
@@ -145,6 +166,14 @@ class FleetStreamRouter:
         self._next_id = 0
         self.num_migrations = 0
         self.num_orphaned = 0
+        #: the last epoch a re-derivation pass ran under — membership
+        #: may fire several listener events per migration (suspicion
+        #: edges, multi-transition verbs); placement is re-derived once
+        #: per EPOCH, not once per firing
+        self._resync_epoch = self.directory.membership.epoch
+        #: owner_of evaluations performed by membership-driven resyncs
+        #: (the coalescing regression gauge: one per watcher per epoch)
+        self.owner_derivations = 0
         self.directory.membership.add_listener(self._on_membership)
 
     # -- watch surface -----------------------------------------------------
@@ -176,48 +205,93 @@ class FleetStreamRouter:
 
     # -- placement ---------------------------------------------------------
 
+    def _fenced_deliver(self, w: FleetWatcher, epoch: int):
+        """Wrap the watcher's deliver with the epoch stamp the
+        subscription was derived under.  Once the watcher moves to a
+        newer epoch, anything this closure still receives — a
+        partitioned old owner that never heard the unsubscribe — is
+        rejected and counted, never raised and never applied."""
+
+        def deliver(emission: dict) -> None:
+            if w.fence_epoch != epoch:
+                w.fenced += 1
+                self.counters.bump("fleet.fenced.stream")
+                return
+            w.deliver(emission)
+
+        return deliver
+
     def _attach(self, w: FleetWatcher) -> None:
         owner = self.directory.owner(w.kind, w.params)
         if owner is None:
             w.serving_node = None
             w.sub_id = None
+            w.fence_epoch = self.directory.membership.epoch
             self.num_orphaned += 1
             self.counters.bump("fleet.directory.orphaned")
             return
         svc = self.services[owner]
+        epoch = self.directory.membership.epoch
+        w.fence_epoch = epoch
         w.sub_id = svc.subscribe(
             w.kind,
             dict(w.params),
             client_id=w.client_id,
             prefix_filters=getattr(w, "prefix_filters", ()),
-            deliver=w.deliver,
+            deliver=self._fenced_deliver(w, epoch),
         )
         w.serving_node = owner
 
     def _detach(self, w: FleetWatcher, unsubscribe: bool) -> None:
-        if (
-            unsubscribe
-            and w.serving_node is not None
-            and w.sub_id is not None
-        ):
-            self.services[w.serving_node].unsubscribe(w.sub_id)
+        if w.serving_node is not None and w.sub_id is not None:
+            if unsubscribe:
+                self.services[w.serving_node].unsubscribe(w.sub_id)
+            else:
+                # the daemon was unreachable at hand-off: its
+                # subscription may well still exist (partition, not
+                # crash) — remember it for GC; the fence keeps its
+                # pushes out in the meantime
+                w.stale_subs.append((w.serving_node, w.sub_id))
         w.serving_node = None
         w.sub_id = None
 
+    def _gc_stale_subs(self, w: FleetWatcher) -> None:
+        """Unsubscribe leftovers on daemons that are reachable again
+        (a partition healed, a drained node re-admitted)."""
+        keep: List[Tuple[str, int]] = []
+        for node, sub_id in w.stale_subs:
+            if self.directory.membership.is_up(node):
+                self.services[node].unsubscribe(sub_id)
+                self.counters.bump("fleet.directory.stale_unsubscribed")
+            else:
+                keep.append((node, sub_id))
+        w.stale_subs = keep
+
     def _on_membership(self, event: dict) -> None:
-        """Re-derive every watcher's owner against the new live set and
-        move the ones whose placement changed.  A crashed node's
-        subscriptions die with its daemon (no unsubscribe RPC to a
-        corpse); a drained node is still up, so its subscriptions are
-        detached cleanly before hand-off."""
+        """Re-derive placement — once per epoch bump.  Suspicion edges
+        and duplicate listener firings arrive at an unchanged epoch and
+        are coalesced away (the live set they would re-derive against
+        is identical); one migration therefore produces exactly one
+        resync per affected watcher, however many events it threw."""
+        epoch = int(event.get("epoch", self.directory.membership.epoch))
+        if epoch == self._resync_epoch:
+            return
+        self._resync_epoch = epoch
         for w in list(self.watchers):
+            self._gc_stale_subs(w)
             owner = self.directory.owner(w.kind, w.params)
+            self.owner_derivations += 1
             if owner == w.serving_node:
+                # placement unchanged: the fence stamp stays with the
+                # surviving subscription (its closure compares against
+                # w.fence_epoch, both still the derivation epoch), so
+                # it keeps delivering without a resync
                 continue
             old = w.serving_node
             # up, not live: a DRAINED node's daemon still answers, so
             # its subscription must be detached (or it keeps pushing
-            # alongside the successor); a crashed node's died with it
+            # alongside the successor); a crashed or partitioned one
+            # can't hear us — the fence holds it off until GC
             clean = old is not None and self.directory.membership.is_up(
                 old
             )
@@ -242,6 +316,9 @@ class FleetStreamRouter:
     def pre_migration_re_emissions(self) -> int:
         return sum(w.pre_migration_re_emissions for w in self.watchers)
 
+    def fenced_deliveries(self) -> int:
+        return sum(w.fenced for w in self.watchers)
+
     def status(self) -> dict:
         placement: Dict[str, int] = {}
         for w in self.watchers:
@@ -253,6 +330,11 @@ class FleetStreamRouter:
             "placement": dict(sorted(placement.items())),
             "migrations": self.num_migrations,
             "orphaned": self.num_orphaned,
+            "epoch": self._resync_epoch,
+            "fenced_deliveries": self.fenced_deliveries(),
+            "stale_subscriptions": sum(
+                len(w.stale_subs) for w in self.watchers
+            ),
             "invariant_violations": self.invariant_violations(),
             "pre_migration_re_emissions": (
                 self.pre_migration_re_emissions()

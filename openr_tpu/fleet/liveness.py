@@ -1,0 +1,410 @@
+"""Heartbeat-derived fleet membership — liveness without an oracle.
+
+PR 19's membership plane was injected: the harness *told* the fleet who
+died.  This module is the detection tier, dogfooding the protocol the
+fleet already routes with: every member advertises a TTL-bearing
+``fleet:member:<name>`` key (types.py key family; KvStore grows the
+matching ``advertise_fleet_heartbeat`` origination surface), stamped
+with its incarnation via the PR-12 ``node.start_ms`` discipline and
+refreshed on the injected Clock at ``heartbeat_interval_s``.  The
+``LivenessTracker`` folds key arrival / TTL expiry into
+``FleetMembership`` transitions through a suspicion state machine:
+
+    up ──(suspect_after_s without a refresh)──► suspect
+    suspect ──(refresh arrives)──► up
+    suspect ──(heartbeat_ttl_s without a refresh)──► down
+
+Rejoin from ``down`` requires a STRICTLY higher incarnation — a zombie
+instance replaying its old incarnation's heartbeats is counted
+(``fleet.liveness.stale_incarnation``) and ignored, exactly the
+self-originated-key guard the KvStore applies to restarted daemons.
+A node that bounces repeatedly is **flap-damped**: an exponentially
+growing hold (deterministic seeded jitter, breaker-style name-salted
+rng) keeps it out of the live set while its heartbeats keep arriving
+(``fleet.flap_damped``), so assignment churn is hysteresis-bounded.
+
+Suspicion and damping are bookkeeping over an UNCHANGED live set; only
+the up/down/drain transitions bump the membership epoch (the fencing
+token every ownership derivation is stamped with — see membership.py).
+The tracker is the single writer of suspicion state and damping clocks
+(orlint ``fleet-liveness``): chaos never mutates them directly, it
+perturbs the heartbeat PLANE (stall, partition, reincarnate) and the
+tracker must conclude the rest.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import zlib
+from typing import Callable, Dict, List, Optional
+
+from openr_tpu.common.runtime import Actor, Clock, CounterMap
+from openr_tpu.fleet.membership import FleetMembership
+from openr_tpu.types import (
+    Publication,
+    Value,
+    fleet_member_key,
+    parse_fleet_member_key,
+)
+
+
+def heartbeat_value(
+    node: str, incarnation: int, seq: int, ttl_ms: int
+) -> Value:
+    """One heartbeat as a KvStore value: version carries the refresh
+    seq (monotone per incarnation), the payload the incarnation stamp."""
+    return Value(
+        version=int(seq),
+        originator_id=node,
+        value=json.dumps(
+            {"incarnation": int(incarnation), "node": node, "seq": int(seq)},
+            sort_keys=True,
+        ).encode(),
+        ttl=int(ttl_ms),
+    )
+
+
+def parse_heartbeat(value: Value) -> Optional[dict]:
+    """Decode a ``fleet:member:*`` value; None when malformed (a
+    malformed heartbeat must never poison the tracker fiber)."""
+    if value.value is None:
+        return None
+    try:
+        body = json.loads(value.value.decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if "incarnation" not in body:
+        return None
+    return {
+        "incarnation": int(body["incarnation"]),
+        "seq": int(body.get("seq", value.version)),
+    }
+
+
+class MemberBeacon(Actor):
+    """One member's heartbeat publisher: refreshes its
+    ``fleet:member:<name>`` key every ``heartbeat_interval_s`` on the
+    injected Clock, incarnation-stamped (``node.start_ms`` discipline —
+    minted from the clock at start, strictly advanced on reincarnate).
+
+    Chaos drives the failure modes: ``stall()`` keeps the daemon alive
+    but stops refreshes (the unannounced-kill / gray-network signal);
+    ``reincarnate()`` models the supervisor restarting the process (the
+    only way back in once the fleet declared this incarnation dead).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        clock: Clock,
+        publish: Callable[[Publication], None],
+        heartbeat_interval_s: float = 0.5,
+        heartbeat_ttl_s: float = 2.5,
+        incarnation: Optional[int] = None,
+        counters: Optional[CounterMap] = None,
+    ) -> None:
+        super().__init__(f"fleet.beacon.{name}", clock, counters)
+        self.member = name
+        self.publish = publish
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.ttl_ms = max(int(heartbeat_ttl_s * 1000.0), 1)
+        #: the node.start_ms incarnation stamp
+        self.incarnation = (
+            int(clock.now_ms()) if incarnation is None else int(incarnation)
+        )
+        self.seq = 0
+        self.stalled = False
+
+    def stall(self) -> None:
+        """Stop refreshing (daemon alive, heartbeats gone — what an
+        unannounced kill, a wedged fiber or a dead NIC all look like)."""
+        self.stalled = True
+        self.counters.bump("fleet.beacon.stalls")
+
+    def resume(self) -> None:
+        self.stalled = False
+
+    def reincarnate(self) -> int:
+        """Supervisor restart: a strictly higher incarnation (the fleet
+        will not readmit the old one once it was declared down)."""
+        self.incarnation = max(int(self.clock.now_ms()), self.incarnation + 1)
+        self.seq = 0
+        self.stalled = False
+        self.counters.bump("fleet.beacon.reincarnations")
+        return self.incarnation
+
+    def beat_now(self) -> None:
+        """Publish one refresh immediately (also the first beat at
+        start, so a fresh member is visible within one dispatch)."""
+        self.seq += 1
+        self.publish(
+            Publication(
+                key_vals={
+                    fleet_member_key(self.member): heartbeat_value(
+                        self.member, self.incarnation, self.seq, self.ttl_ms
+                    )
+                },
+                area="0",
+            )
+        )
+        self.counters.bump("fleet.beacon.beats")
+
+    async def run(self) -> None:
+        while True:
+            if not self.stalled:
+                self.beat_now()
+            self.touch()
+            await self.clock.sleep(self.heartbeat_interval_s)
+
+
+class _MemberLiveness:
+    """Tracker-side bookkeeping for one member."""
+
+    __slots__ = (
+        "name", "incarnation", "seq", "last_hb", "damped_until", "flaps",
+    )
+
+    def __init__(self, name: str, now: float) -> None:
+        self.name = name
+        #: last ACCEPTED incarnation (-1 = never heard)
+        self.incarnation = -1
+        self.seq = -1
+        #: start-time grace: a member that never beats is detected via
+        #: the same suspect→down path as one that stopped
+        self.last_hb = now
+        self.damped_until = 0.0
+        #: accepted-rejoin times inside the flap window
+        self.flaps: List[float] = []
+
+
+class LivenessTracker(Actor):
+    """Folds heartbeat arrival/expiry into membership transitions.
+
+    Consumes ``fleet:member:*`` publications (``on_publication`` — the
+    fabric's heartbeat bus, or a KvStore drain loop in a real
+    deployment) and runs a periodic suspicion tick.  All membership
+    writes happen HERE (single-writer): announced chaos verbs still
+    mutate membership directly — the tracker reconciles by reading
+    membership state before acting, so an announced kill and a detected
+    one converge on the same transitions.
+    """
+
+    def __init__(
+        self,
+        clock: Clock,
+        membership: FleetMembership,
+        heartbeat_interval_s: float = 0.5,
+        suspect_after_s: float = 1.25,
+        heartbeat_ttl_s: float = 2.5,
+        flap_window_s: float = 30.0,
+        flap_hold_base_s: float = 2.0,
+        flap_hold_max_s: float = 60.0,
+        jitter_pct: float = 0.1,
+        seed: int = 0,
+        tick_s: float = 0.25,
+        counters: Optional[CounterMap] = None,
+    ) -> None:
+        super().__init__("fleet.liveness", clock, counters)
+        assert heartbeat_interval_s < suspect_after_s < heartbeat_ttl_s, (
+            "liveness needs heartbeat_interval < suspect_after < ttl"
+        )
+        self.membership = membership
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.suspect_after_s = suspect_after_s
+        self.heartbeat_ttl_s = heartbeat_ttl_s
+        self.flap_window_s = flap_window_s
+        self.flap_hold_base_s = flap_hold_base_s
+        self.flap_hold_max_s = flap_hold_max_s
+        self.jitter_pct = jitter_pct
+        self.seed = seed
+        self.tick_s = tick_s
+        self._m: Dict[str, _MemberLiveness] = {}
+        #: per-member damping jitter rng, breaker-style name-salted so
+        #: a fleet sharing one seed still de-syncs deterministically
+        self._rngs: Dict[str, random.Random] = {}
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _ensure(self, name: str) -> _MemberLiveness:
+        m = self._m.get(name)
+        if m is None:
+            m = self._m[name] = _MemberLiveness(name, self.clock.now())
+        return m
+
+    def _rng(self, name: str) -> random.Random:
+        rng = self._rngs.get(name)
+        if rng is None:
+            rng = self._rngs[name] = random.Random(
+                (self.seed << 32) ^ zlib.crc32(name.encode())
+            )
+        return rng
+
+    def record_incarnation(self, name: str, incarnation: int) -> None:
+        """Adopt an accepted incarnation (single-writer: tracker only)."""
+        self._ensure(name).incarnation = int(incarnation)
+
+    def set_damped_until(self, name: str, until: float) -> None:
+        """Arm/clear one member's damping hold (single-writer: tracker
+        only — chaos perturbs the heartbeat plane, never this clock)."""
+        self._ensure(name).damped_until = float(until)
+
+    # -- heartbeat ingress -------------------------------------------------
+
+    def on_publication(self, pub: Publication) -> None:
+        for key, value in (pub.key_vals or {}).items():
+            node = parse_fleet_member_key(key)
+            if node is None:
+                continue
+            hb = parse_heartbeat(value)
+            if hb is None:
+                self.counters.bump("fleet.liveness.malformed")
+                continue
+            self.on_heartbeat(node, hb["incarnation"], hb["seq"])
+        for key in pub.expired_keys or ():
+            node = parse_fleet_member_key(key)
+            if node is not None:
+                self._expire(node, reason="heartbeat_key_expired")
+
+    def on_heartbeat(self, node: str, incarnation: int, seq: int) -> None:
+        if node not in self.membership.names:
+            return
+        now = self.clock.now()
+        m = self._ensure(node)
+        if incarnation < m.incarnation:
+            # a zombie instance replaying an old incarnation — never a
+            # refresh, whatever the membership state
+            self.counters.bump("fleet.liveness.stale_incarnation")
+            return
+        if self.membership.is_live(node):
+            if incarnation > m.incarnation:
+                self.record_incarnation(node, incarnation)
+            m.last_hb = now
+            m.seq = seq
+            if node in self.membership.suspects():
+                self.membership.clear_suspect(node)
+                self.counters.bump("fleet.liveness.recoveries")
+            return
+        if self.membership.is_up(node):
+            # drained: deliberate demotion — refresh bookkeeping only,
+            # heartbeats must not undrain a node the operator (or the
+            # gray-failure policy) took out of rotation
+            if incarnation > m.incarnation:
+                self.record_incarnation(node, incarnation)
+            m.last_hb = now
+            m.seq = seq
+            return
+        # down.  While a damping hold is armed, refreshes keep the
+        # bookkeeping warm but do NOT readmit (the tick does, once the
+        # hold expires and the node is still beating).
+        if m.damped_until > now:
+            if incarnation > m.incarnation:
+                self.record_incarnation(node, incarnation)
+            m.last_hb = now
+            m.seq = seq
+            return
+        # rejoin: strictly higher incarnation than the one the fleet
+        # declared dead (same discipline as the KvStore ttl clock)
+        if incarnation <= m.incarnation:
+            self.counters.bump("fleet.liveness.stale_incarnation")
+            return
+        self.record_incarnation(node, incarnation)
+        m.last_hb = now
+        m.seq = seq
+        m.flaps = [
+            t for t in m.flaps if now - t <= self.flap_window_s
+        ] + [now]
+        if len(m.flaps) >= 2:
+            # flapping: exponential hold before re-entering the live
+            # set, deterministic seeded jitter (one draw per damping)
+            hold = min(
+                self.flap_hold_base_s * (2.0 ** (len(m.flaps) - 2)),
+                self.flap_hold_max_s,
+            )
+            if self.jitter_pct:
+                hold *= 1.0 + self.jitter_pct * self._rng(node).uniform(
+                    -1.0, 1.0
+                )
+            self.set_damped_until(node, now + hold)
+            self.counters.bump("fleet.flap_damped")
+            return
+        self._readmit(node, reason="heartbeat_rejoin")
+
+    # -- suspicion tick ----------------------------------------------------
+
+    def _expire(self, node: str, reason: str) -> None:
+        if self.membership.is_live(node) or self.membership.is_up(node):
+            self.membership.node_down(node, reason=reason)
+            self.counters.bump("fleet.liveness.expiries")
+
+    def _readmit(self, node: str, reason: str) -> None:
+        self.membership.node_up(node, reason=reason)
+        self.counters.bump("fleet.liveness.rejoins")
+
+    def tick(self) -> None:
+        now = self.clock.now()
+        for name in self.membership.names:
+            m = self._ensure(name)
+            if self.membership.is_live(name):
+                age = now - m.last_hb
+                if age > self.heartbeat_ttl_s:
+                    self._expire(name, reason="heartbeat_expired")
+                elif age > self.suspect_after_s:
+                    self.membership.mark_suspect(name)
+            elif self.membership.is_up(name):
+                # drained: death-while-drained still detected
+                if now - m.last_hb > self.heartbeat_ttl_s:
+                    self._expire(name, reason="heartbeat_expired")
+            elif m.damped_until > 0.0:
+                if now >= m.damped_until:
+                    self.set_damped_until(name, 0.0)
+                    if now - m.last_hb <= self.suspect_after_s:
+                        self._readmit(name, reason="damping_hold_expired")
+                    # else: stopped beating during the hold — stays
+                    # down, the next valid rejoin starts over
+
+    async def run(self) -> None:
+        while True:
+            self.tick()
+            self.touch()
+            await self.clock.sleep(self.tick_s)
+
+    # -- observability -----------------------------------------------------
+
+    def member_state(self, name: str) -> str:
+        now = self.clock.now()
+        if self.membership.is_live(name):
+            return (
+                "suspect" if name in self.membership.suspects() else "live"
+            )
+        if self.membership.is_up(name):
+            return "drained"
+        m = self._m.get(name)
+        if m is not None and m.damped_until > now:
+            return "damped"
+        return "down"
+
+    def status(self) -> dict:
+        """The ``breeze fleet status`` liveness columns: per-member
+        state / incarnation / heartbeat age / damping clock, plus the
+        epoch every ownership derivation is fenced against."""
+        now = self.clock.now()
+        members = {}
+        for name in self.membership.names:
+            m = self._ensure(name)
+            members[name] = {
+                "state": self.member_state(name),
+                "incarnation": m.incarnation,
+                "seq": m.seq,
+                "heartbeat_age_s": round(now - m.last_hb, 6),
+                "damped_for_s": round(max(m.damped_until - now, 0.0), 6),
+                "flaps_in_window": len(
+                    [t for t in m.flaps if now - t <= self.flap_window_s]
+                ),
+            }
+        return {
+            "epoch": self.membership.epoch,
+            "suspect_after_s": self.suspect_after_s,
+            "heartbeat_ttl_s": self.heartbeat_ttl_s,
+            "members": members,
+        }

@@ -8,15 +8,45 @@ bumps the membership seq, notifies registered listeners (the sweep
 coordinator re-packs, the stream router migrates), and feeds the
 health plane: an unexpected down is a PAGE (``fleet_node_loss``), a
 drain is a TICKET (``fleet_drain_migration`` — the migration is the
-expected behaviour, the ticket just audits it).
+expected behaviour, the ticket just audits it), and a gray-failure
+demotion is its own TICKET (``fleet_gray_failure``).
+
+ISSUE 20 adds the **epoch**: a monotone counter bumped exactly when the
+live-node COMPOSITION changes (down/up/drain/undrain — not suspicion,
+which is bookkeeping over an unchanged live set).  Everything ownership
+is derived from — stream subscriptions, sweep ``world_filter``
+dispatches — is stamped with the epoch it was derived under, and
+receivers reject stale-epoch work (``fleet.fenced.*``): the split-brain
+window where a partitioned-but-alive old owner works alongside its
+successor is fenced structurally, not predicate-by-predicate.  The
+epoch/suspicion mutators (``bump_epoch`` / ``mark_suspect`` /
+``clear_suspect``) are single-writer inside ``openr_tpu/fleet/`` —
+orlint's ``fleet-liveness`` rule; even chaos drives them only through
+the heartbeat plane.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from openr_tpu.common.runtime import CounterMap
 from openr_tpu.parallel.nodes import NodeSet
+
+
+@dataclass(frozen=True)
+class MembershipView:
+    """One consistent read of the fleet's composition, epoch-stamped.
+
+    ``epoch`` is the fencing token: any ownership derivation (watcher
+    placement, world assignment) made from this view carries it, and is
+    rejected by receivers once a newer epoch exists."""
+
+    epoch: int
+    live: Tuple[str, ...]
+    suspects: Tuple[str, ...]
+    down: Tuple[str, ...]
+    drained: Tuple[str, ...]
 
 
 class FleetMembership:
@@ -37,6 +67,15 @@ class FleetMembership:
         self.nodes = NodeSet(names)
         self.counters = counters if counters is not None else CounterMap()
         self._listeners: List[Callable[[dict], None]] = []
+        #: monotone composition-change counter (the fencing token)
+        self._epoch = 0
+        self._last_live: Tuple[str, ...] = self.nodes.live_nodes()
+        #: suspicion bookkeeping (liveness tracker writes) — suspects
+        #: STAY live: suspicion is a warning, only TTL expiry demotes
+        self._suspects: set = set()
+        #: node -> reason for the current drain (gray demotions fire
+        #: their own ticket via health_firing)
+        self._drain_reasons: Dict[str, str] = {}
 
     # -- read surface ------------------------------------------------------
 
@@ -47,6 +86,10 @@ class FleetMembership:
     @property
     def membership_seq(self) -> int:
         return self.nodes.membership_seq
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
 
     def live_nodes(self) -> Tuple[str, ...]:
         return self.nodes.live_nodes()
@@ -59,6 +102,18 @@ class FleetMembership:
         subscription hand-off) but not live (it owns nothing)."""
         return self.nodes.is_up(name)
 
+    def suspects(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._suspects))
+
+    def view(self) -> MembershipView:
+        return MembershipView(
+            epoch=self._epoch,
+            live=self.nodes.live_nodes(),
+            suspects=self.suspects(),
+            down=self.nodes.down_nodes(),
+            drained=self.nodes.drained_nodes(),
+        )
+
     def add_listener(self, cb: Callable[[dict], None]) -> None:
         self._listeners.append(cb)
 
@@ -67,6 +122,7 @@ class FleetMembership:
     def node_down(self, name: str, reason: str = "crash") -> bool:
         if not self.nodes.mark_down(name):
             return False
+        self._suspects.discard(name)
         self.counters.bump("fleet.membership.node_down")
         self._notify("node_down", name, reason)
         return True
@@ -74,6 +130,8 @@ class FleetMembership:
     def node_up(self, name: str, reason: str = "restart") -> bool:
         if not self.nodes.mark_up(name):
             return False
+        self._suspects.discard(name)
+        self._drain_reasons.pop(name, None)
         self.counters.bump("fleet.membership.node_up")
         self._notify("node_up", name, reason)
         return True
@@ -81,6 +139,7 @@ class FleetMembership:
     def drain_node(self, name: str, reason: str = "maintenance") -> bool:
         if not self.nodes.mark_drained(name):
             return False
+        self._drain_reasons[name] = reason
         self.counters.bump("fleet.membership.drain")
         self._notify("node_drained", name, reason)
         return True
@@ -88,17 +147,51 @@ class FleetMembership:
     def undrain_node(self, name: str, reason: str = "maintenance") -> bool:
         if not self.nodes.clear_drained(name):
             return False
+        self._drain_reasons.pop(name, None)
         self.counters.bump("fleet.membership.undrain")
         self._notify("node_undrained", name, reason)
         return True
 
+    # -- epoch + suspicion (fleet-liveness rule: openr_tpu/fleet/ ONLY) ----
+
+    def bump_epoch(self) -> int:
+        """Advance the fencing token.  Called internally on every
+        composition change; single-writer inside openr_tpu/fleet/."""
+        self._epoch += 1
+        self.counters.set("fleet.membership.epoch", float(self._epoch))
+        return self._epoch
+
+    def mark_suspect(self, name: str, reason: str = "missed_refresh") -> bool:
+        """Suspicion bookkeeping (LivenessTracker writes): the node
+        missed heartbeat refreshes but its TTL has not expired.  The
+        live set — and therefore the epoch — is unchanged."""
+        if name in self._suspects or not self.nodes.is_live(name):
+            return False
+        self._suspects.add(name)
+        self.counters.bump("fleet.membership.suspect")
+        self._notify("node_suspect", name, reason)
+        return True
+
+    def clear_suspect(self, name: str, reason: str = "refreshed") -> bool:
+        if name not in self._suspects:
+            return False
+        self._suspects.discard(name)
+        self.counters.bump("fleet.membership.unsuspect")
+        self._notify("node_unsuspect", name, reason)
+        return True
+
     def _notify(self, event: str, name: str, reason: str) -> None:
+        live = self.nodes.live_nodes()
+        if live != self._last_live:
+            self._last_live = live
+            self.bump_epoch()
         ev = {
             "event": event,
             "node": name,
             "reason": reason,
             "membership_seq": self.nodes.membership_seq,
-            "live": list(self.nodes.live_nodes()),
+            "epoch": self._epoch,
+            "live": list(live),
         }
         for cb in list(self._listeners):
             cb(ev)
@@ -110,7 +203,9 @@ class FleetMembership:
         while any member is down (node-loss is the failure domain above
         the chip — see health/alerts.py), a TICKET while any member is
         drained (the watcher/world migration is EXPECTED; the ticket
-        audits that it completed)."""
+        audits that it completed), and a separate TICKET while any
+        drain was a gray-failure demotion (heartbeats fine, work
+        failing — the runbook's "fleet disagrees" case)."""
         firing: Dict[str, dict] = {}
         down = self.nodes.down_nodes()
         if down:
@@ -123,12 +218,22 @@ class FleetMembership:
             firing["fleet_drain_migration"] = {
                 "nodes": list(drained),
             }
+        gray = sorted(
+            n for n, r in self._drain_reasons.items()
+            if r == "gray_failure" and n in drained
+        )
+        if gray:
+            firing["fleet_gray_failure"] = {"nodes": gray}
         return firing
 
     # -- observability -----------------------------------------------------
 
     def status(self) -> dict:
-        return self.nodes.status()
+        out = self.nodes.status()
+        out["epoch"] = self._epoch
+        out["suspects"] = list(self.suspects())
+        out["drain_reasons"] = dict(sorted(self._drain_reasons.items()))
+        return out
 
     def counter_snapshot(self) -> dict:
         return self.nodes.counter_snapshot(prefix="fleet.membership")

@@ -93,6 +93,15 @@ ALERTS: Dict[str, tuple] = {
         "watchers/worlds migrated by design; the ticket audits that "
         "the hand-off completed and the drain is not forgotten",
     ),
+    "fleet_gray_failure": (
+        SEV_TICKET,
+        "a fleet member was demoted to drained by the gray-failure "
+        "strike policy: its heartbeats (and often its ctrl surface) "
+        "still answer but its sweep work keeps failing or timing out "
+        "— the 'fleet disagrees about who is alive' runbook case; "
+        "worlds re-packed onto survivors, node needs investigation "
+        "before undrain",
+    ),
     "slo_convergence_p99": (
         SEV_PAGE,
         "publication->FIB convergence p99 is burning its error "

@@ -957,6 +957,58 @@ class KvStore(Actor):
     def summaries(self) -> Dict[str, KvStoreAreaSummary]:
         return {a: db.summary() for a, db in self.areas.items()}
 
+    # -- fleet-liveness heartbeat key family (openr_tpu.fleet.liveness) ----
+
+    def advertise_fleet_heartbeat(self, area: str, incarnation: int) -> Value:
+        """Advertise this daemon's ``fleet:member:<name>`` liveness key:
+        a TTL-bearing self-originated key whose payload carries the
+        incarnation stamp (the PR-12 ``node.start_ms`` discipline).  The
+        existing self-originated TTL refresh loop IS the heartbeat — an
+        unchanged incarnation re-persist is a version no-op network-wide,
+        and key expiry is exactly the liveness tracker's death signal."""
+        import json as _json
+
+        from openr_tpu.types import fleet_member_key
+
+        payload = _json.dumps(
+            {"incarnation": int(incarnation), "node": self.node_name},
+            sort_keys=True,
+        ).encode()
+        self.counters.bump("kvstore.fleet_heartbeat_advertised")
+        return self.areas[area].persist_self_originated_key(
+            fleet_member_key(self.node_name), payload
+        )
+
+    def fleet_member_heartbeats(self, area: str) -> Dict[str, dict]:
+        """The fleet-liveness read surface: every unexpired
+        ``fleet:member:*`` key in the area, parsed to
+        ``{node: {incarnation, version, ttl_version, originator}}``."""
+        import json as _json
+
+        from openr_tpu.types import (
+            FLEET_MEMBER_MARKER,
+            parse_fleet_member_key,
+        )
+
+        out: Dict[str, dict] = {}
+        for key, value in self.areas[area].dump_all(
+            FLEET_MEMBER_MARKER
+        ).items():
+            node = parse_fleet_member_key(key)
+            if node is None or value.value is None:
+                continue
+            try:
+                body = _json.loads(value.value.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            out[node] = {
+                "incarnation": int(body.get("incarnation", 0)),
+                "version": value.version,
+                "ttl_version": value.ttl_version,
+                "originator": value.originator_id,
+            }
+        return out
+
     def peer_state(self, area: str, peer: str) -> Optional[KvStorePeerState]:
         p = self.areas[area].peers.get(peer)
         return p.state if p is not None else None

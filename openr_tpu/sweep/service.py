@@ -57,6 +57,12 @@ class SweepService(Actor):
         #: it); when set, get_sweep_status carries the per-node fleet
         #: assignment rows `breeze sweep status` renders
         self._fleet_status_fn = None
+        #: fleet epoch provider (attach_fleet wires it): when a
+        #: dispatched ``fleet_epoch`` stamp is older than the current
+        #: membership epoch, the sweep is FENCED — rejected before the
+        #: executor touches disk, counted, returned (never raised)
+        self._fleet_epoch_fn = None
+        self.num_sweeps_fenced = 0
 
     # -- inputs ------------------------------------------------------------
 
@@ -93,6 +99,24 @@ class SweepService(Actor):
                 f"sweep {self.executor.sweep_id} is already running"
             )
         params = dict(params or {})
+        fleet_epoch = params.pop("fleet_epoch", None)
+        if fleet_epoch is not None and self._fleet_epoch_fn is not None:
+            current = self._fleet_epoch_fn()
+            if int(fleet_epoch) < current:
+                # stale-epoch work: the membership composition changed
+                # between derivation and dispatch — a coordinator (or a
+                # partitioned stale one) acting on an old view.  Reject
+                # structurally: no executor, no spill, just a counted
+                # refusal the dispatcher re-derives from.
+                self.num_sweeps_fenced += 1
+                self.counters.bump("fleet.fenced.sweep_rejected")
+                return {
+                    "node": self.node_name,
+                    "state": "fenced",
+                    "fenced": True,
+                    "dispatch_epoch": int(fleet_epoch),
+                    "current_epoch": current,
+                }
         self._root_override = str(params.get("root", ""))
         spec = ScenarioSpec.from_params(self.config, params)
         ex = SweepExecutor(
@@ -151,13 +175,17 @@ class SweepService(Actor):
         finally:
             self.tracer.end_span(span, state=self.state)
 
-    def attach_fleet(self, status_fn) -> None:
+    def attach_fleet(self, status_fn, epoch_fn=None) -> None:
         """Wire the fleet coordinator's status provider onto this node
         (``None`` detaches): ``get_sweep_status`` then carries a
         ``fleet`` section with the cross-node assignment rows, so
         ``breeze sweep status`` against ANY member shows the whole
-        fleet sweep — not just the local node's shards."""
+        fleet sweep — not just the local node's shards.  ``epoch_fn``
+        (the membership epoch read) arms stale-epoch fencing on
+        ``start_sweep``: dispatches stamped with an older epoch are
+        refused with a ``fenced`` response instead of starting."""
         self._fleet_status_fn = status_fn
+        self._fleet_epoch_fn = epoch_fn
 
     def get_sweep_status(self) -> dict:
         out: Dict[str, Any] = {
@@ -165,6 +193,7 @@ class SweepService(Actor):
             "state": self.state,
             "error": self.error,
             "sweeps_started": self.num_sweeps_started,
+            "sweeps_fenced": self.num_sweeps_fenced,
         }
         if self.executor is not None:
             out.update(self.executor.status())

@@ -825,4 +825,23 @@ def parse_prefix_key(key: str) -> Optional[Tuple[str, str]]:
     return node, rest[:-1]
 
 
+#: fleet-liveness heartbeat key family (openr_tpu.fleet.liveness): each
+#: member advertises ``fleet:member:<name>`` as a TTL-bearing key whose
+#: value carries its incarnation (the PR-12 ``node.start_ms`` stamp) and
+#: a per-incarnation heartbeat seq — membership is DERIVED from key
+#: arrival/TTL-expiry, the same eventually-consistent machinery the
+#: fleet routes with
+FLEET_MEMBER_MARKER = "fleet:member:"
+
+
+def fleet_member_key(node: str) -> str:
+    return f"{FLEET_MEMBER_MARKER}{node}"
+
+
+def parse_fleet_member_key(key: str) -> Optional[str]:
+    if not key.startswith(FLEET_MEMBER_MARKER):
+        return None
+    return key[len(FLEET_MEMBER_MARKER):]
+
+
 _ENUM_REGISTRY.extend(_all_enums())

@@ -1,0 +1,1026 @@
+"""Self-hosted fleet membership (ISSUE 20) — heartbeat liveness,
+epoch-fenced ownership, and the partition/gray-failure-hardened
+coordinator.
+
+The contracts under test (docs/Fleet.md, "Liveness" section):
+
+* membership is heartbeat-derived: a TTL-bearing ``fleet:member:*``
+  key per member, incarnation-stamped; the tracker's suspicion machine
+  walks up -> suspect (``suspect_after_s``) -> down (``heartbeat_ttl_s``),
+  rejoin needs a STRICTLY higher incarnation, and a bouncing node is
+  flap-damped with a deterministic exponential hold;
+* ownership is epoch-fenced: subscriptions and sweep dispatches carry
+  the epoch they derived under and receivers reject stale-epoch work —
+  counted (``fleet.fenced.stream`` / ``fleet.fenced.sweep``), never
+  raised, never double-applied;
+* the coordinator trusts no member: every ctrl touch rides a
+  per-member breaker, a straggler's worlds re-pack without waiting for
+  death (first-committed-wins keeps the digest byte-identical), and a
+  heartbeating-but-failing member is demoted to drained with the
+  ``fleet_gray_failure`` ticket;
+* an UNANNOUNCED kill is detected from heartbeat silence alone with
+  zero invariant violations and a merged digest byte-equal to a clean
+  run; seeded replays of every chaos scenario are byte-identical.
+"""
+
+import asyncio
+import dataclasses
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from openr_tpu.common.runtime import CounterMap, SimClock
+from openr_tpu.emulation.fabric import FleetFabric
+from openr_tpu.fleet import (
+    FleetMembership,
+    FleetSweepCoordinator,
+    LivenessTracker,
+    MemberBeacon,
+    MembershipView,
+    heartbeat_value,
+    parse_heartbeat,
+)
+from openr_tpu.fleet.coordinator import _CTRL_UNAVAILABLE
+from openr_tpu.health.alerts import AlertSink, alert_counter_key
+from openr_tpu.types import Publication, Value, fleet_member_key
+
+pytestmark = [pytest.mark.fleet]
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        pending = asyncio.all_tasks(loop)
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(
+                asyncio.gather(*pending, return_exceptions=True)
+            )
+        loop.close()
+
+
+SWEEP_PARAMS = {
+    "drain_node_sets": [[], ["node5"], ["node7"], ["node3"]],
+    "metric_perturbations": [{"pattern": "node.*", "factor": 2.0}],
+}
+
+#: liveness timers compressed for virtual-time tests; the invariant
+#: interval < suspect_after < ttl still holds
+FAST_LIVENESS = {
+    "heartbeat_interval_s": 0.1,
+    "suspect_after_s": 0.25,
+    "heartbeat_ttl_s": 0.5,
+    "tick_s": 0.05,
+}
+
+
+def make_fabric(clock, tmp_path, **kwargs):
+    kwargs.setdefault("n_side", 3)
+    kwargs.setdefault(
+        "sweep_overrides",
+        {"shard_scenarios": 2, "inter_shard_pause_s": 0.2},
+    )
+    return FleetFabric(clock, spill_root=str(tmp_path), **kwargs)
+
+
+def make_tracker(clock, names=("a", "b"), **overrides):
+    counters = CounterMap()
+    membership = FleetMembership(list(names), counters=counters)
+    kw = dict(FAST_LIVENESS)
+    kw.update(overrides)
+    tracker = LivenessTracker(clock, membership, counters=counters, **kw)
+    return membership, tracker, counters
+
+
+# ---------------------------------------------------------------------------
+# heartbeat codec + beacon
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_codec_roundtrip_and_malformed():
+    v = heartbeat_value("fab1", 4200, 7, 2500)
+    assert v.version == 7 and v.originator_id == "fab1" and v.ttl == 2500
+    assert parse_heartbeat(v) == {"incarnation": 4200, "seq": 7}
+    # seq falls back to the value version when the payload omits it
+    legacy = Value(
+        version=3,
+        originator_id="fab1",
+        value=json.dumps({"incarnation": 9}).encode(),
+        ttl=2500,
+    )
+    assert parse_heartbeat(legacy) == {"incarnation": 9, "seq": 3}
+    # malformed heartbeats must parse to None, never raise
+    for bad in (
+        Value(version=1, originator_id="x", value=None, ttl=1),
+        Value(version=1, originator_id="x", value=b"\xff\xfe", ttl=1),
+        Value(version=1, originator_id="x", value=b"not json", ttl=1),
+        Value(version=1, originator_id="x", value=b"{\"seq\": 1}", ttl=1),
+    ):
+        assert parse_heartbeat(bad) is None
+
+
+def test_member_beacon_incarnation_and_stall():
+    clock = SimClock(5.0)
+    pubs = []
+    b = MemberBeacon(
+        "fab1",
+        clock,
+        publish=pubs.append,
+        heartbeat_interval_s=0.1,
+        heartbeat_ttl_s=0.5,
+    )
+    # node.start_ms discipline: incarnation minted from the clock
+    assert b.incarnation == 5000 and b.seq == 0
+    b.beat_now()
+    b.beat_now()
+    assert len(pubs) == 2
+    hb = parse_heartbeat(pubs[-1].key_vals[fleet_member_key("fab1")])
+    assert hb == {"incarnation": 5000, "seq": 2}
+    b.stall()
+    assert b.stalled
+    # restart inside the same clock millisecond: incarnation must still
+    # STRICTLY advance (the fleet refuses same-incarnation rejoins)
+    assert b.reincarnate() == 5001
+    assert b.seq == 0 and not b.stalled
+    clock._now = 10.0
+    assert b.reincarnate() == 10000
+
+
+# ---------------------------------------------------------------------------
+# suspicion machine: up -> suspect -> down, incarnation-monotone rejoin
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_suspicion_machine_and_ttl_expiry():
+    clock = SimClock()
+    m, tr, counters = make_tracker(clock)
+    tr.on_heartbeat("a", 100, 1)
+    tr.on_heartbeat("b", 100, 1)
+    assert m.epoch == 0
+    # a misses refreshes past suspect_after: SUSPECT, still live, and
+    # the epoch does not move (the live set is unchanged)
+    clock._now += 0.3
+    tr.on_heartbeat("b", 100, 2)
+    tr.tick()
+    assert m.suspects() == ("a",) and m.is_live("a")
+    assert tr.member_state("a") == "suspect" and m.epoch == 0
+    # a refresh clears suspicion
+    tr.on_heartbeat("a", 100, 2)
+    assert m.suspects() == () and tr.member_state("a") == "live"
+    assert counters.get("fleet.liveness.recoveries") == 1
+    # silence past the TTL: DOWN, epoch bumps
+    clock._now += 0.3
+    tr.on_heartbeat("b", 100, 3)
+    tr.tick()
+    clock._now += 0.3
+    tr.on_heartbeat("b", 100, 4)
+    tr.tick()
+    assert not m.is_live("a") and tr.member_state("a") == "down"
+    assert m.epoch == 1
+    assert counters.get("fleet.liveness.expiries") == 1
+    # a zombie replaying the dead incarnation is counted and refused
+    tr.on_heartbeat("a", 100, 5)
+    assert not m.is_live("a")
+    assert counters.get("fleet.liveness.stale_incarnation") == 1
+    # a strictly higher incarnation readmits (first flap: no damping)
+    tr.on_heartbeat("a", 101, 1)
+    assert m.is_live("a") and m.epoch == 2
+    assert counters.get("fleet.liveness.rejoins") == 1
+
+
+def test_tracker_publication_ingress_expiry_and_malformed():
+    clock = SimClock()
+    m, tr, counters = make_tracker(clock)
+    tr.on_publication(
+        Publication(
+            key_vals={
+                fleet_member_key("a"): heartbeat_value("a", 7, 1, 500),
+                # malformed value: counted, never raised
+                fleet_member_key("b"): Value(
+                    version=1, originator_id="b", value=b"junk", ttl=500
+                ),
+                # non-fleet keys are ignored
+                "adj:node0": Value(
+                    version=1, originator_id="x", value=b"{}", ttl=500
+                ),
+            },
+            area="0",
+        )
+    )
+    assert tr._m["a"].incarnation == 7
+    assert counters.get("fleet.liveness.malformed") == 1
+    # a heartbeat for a node outside the fleet is ignored, no KeyError
+    tr.on_heartbeat("not-a-member", 1, 1)
+    # the KvStore TTL-expiry notification is the death signal
+    tr.on_publication(
+        Publication(expired_keys=[fleet_member_key("b")], area="0")
+    )
+    assert not m.is_live("b") and m.epoch == 1
+    assert counters.get("fleet.liveness.expiries") == 1
+
+
+# ---------------------------------------------------------------------------
+# flap damping: exponential, deterministic, held out while beating
+# ---------------------------------------------------------------------------
+
+
+def _bounce_twice(seed):
+    """Bounce node a through two full down/rejoin cycles; returns the
+    (membership, tracker, counters, damped_until) after the second
+    rejoin attempt armed the damping hold."""
+    clock = SimClock()
+    m, tr, counters = make_tracker(
+        clock, flap_hold_base_s=2.0, flap_hold_max_s=60.0, seed=seed
+    )
+    tr.on_heartbeat("a", 100, 1)
+    tr.on_heartbeat("b", 100, 1)
+    clock._now += 0.6
+    tr.on_heartbeat("b", 100, 2)
+    tr.tick()  # a down (flap cycle 1)
+    assert not m.is_live("a")
+    tr.on_heartbeat("a", 101, 1)  # first rejoin: immediate
+    assert m.is_live("a")
+    clock._now += 0.6
+    tr.on_heartbeat("b", 100, 3)
+    tr.tick()  # a down (flap cycle 2)
+    assert not m.is_live("a")
+    tr.on_heartbeat("a", 102, 1)  # second rejoin inside the window: DAMPED
+    return clock, m, tr, counters, tr._m["a"].damped_until
+
+
+def test_flap_damping_exponential_deterministic_and_released_by_tick():
+    clock, m, tr, counters, damped_until = _bounce_twice(seed=0)
+    assert not m.is_live("a") and tr.member_state("a") == "damped"
+    assert counters.get("fleet.flap_damped") == 1
+    # hold = base * 2^(flaps-2) +/- 10% jitter
+    hold = damped_until - clock.now()
+    assert 2.0 * 0.9 <= hold <= 2.0 * 1.1
+    # deterministic: same seed draws the same hold; another seed differs
+    assert _bounce_twice(seed=0)[4] == damped_until
+    assert _bounce_twice(seed=3)[4] != damped_until
+    # refreshes during the hold keep bookkeeping warm but do NOT readmit
+    clock._now += 0.2
+    tr.on_heartbeat("a", 102, 2)
+    tr.tick()
+    assert not m.is_live("a") and tr.member_state("a") == "damped"
+    # once the hold elapses and the node is still beating, the tick
+    # readmits it
+    while not m.is_live("a"):
+        clock._now += 0.1
+        tr.on_heartbeat("a", 102, tr._m["a"].seq + 1)
+        tr.tick()
+        assert clock.now() < damped_until + 1.0, "hold never released"
+    assert tr._m["a"].damped_until == 0.0
+    assert counters.get("fleet.liveness.rejoins") == 2
+    assert tr.status()["members"]["a"]["flaps_in_window"] == 2
+
+
+# ---------------------------------------------------------------------------
+# membership view + epoch semantics, gray-failure health plane
+# ---------------------------------------------------------------------------
+
+
+def test_membership_view_epoch_semantics_and_gray_alert():
+    clock = SimClock()
+    counters = CounterMap()
+    m = FleetMembership(["a", "b", "c"], counters=counters)
+    v = m.view()
+    assert isinstance(v, MembershipView)
+    assert v.epoch == 0 and v.live == ("a", "b", "c") and v.suspects == ()
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        v.epoch = 99
+    # suspicion is bookkeeping over an unchanged live set: no epoch bump
+    assert m.mark_suspect("b")
+    assert not m.mark_suspect("b")  # idempotent
+    assert m.epoch == 0 and m.view().suspects == ("b",)
+    assert m.clear_suspect("b") and m.epoch == 0
+    # composition changes bump the epoch exactly once each
+    assert m.node_down("b") and m.epoch == 1
+    assert m.drain_node("c", reason="gray_failure") and m.epoch == 2
+    firing = m.health_firing()
+    assert firing["fleet_node_loss"]["nodes"] == ["b"]
+    assert firing["fleet_drain_migration"]["nodes"] == ("c",) or firing[
+        "fleet_drain_migration"
+    ]["nodes"] == ["c"]
+    assert firing["fleet_gray_failure"] == {"nodes": ["c"]}
+    # the registry knows the ticket; the sink accepts the firing set
+    sink = AlertSink("agg", clock, CounterMap())
+    sink.report(firing)
+    assert sink.counters.get(alert_counter_key("fleet_gray_failure")) == 1.0
+    assert sink.counters.get(alert_counter_key("fleet_node_loss")) == 1.0
+    # undrain clears the gray ticket (and bumps the epoch again)
+    assert m.undrain_node("c") and m.epoch == 3
+    assert "fleet_gray_failure" not in m.health_firing()
+    assert m.status()["drain_reasons"] == {}
+
+
+# ---------------------------------------------------------------------------
+# the KvStore origination surface: the TTL refresh loop IS the heartbeat
+# ---------------------------------------------------------------------------
+
+
+def test_kvstore_heartbeat_surface_is_version_noop_per_incarnation():
+    from openr_tpu.config import KvStoreConfig
+    from openr_tpu.kvstore.kv_store import KvStore
+    from openr_tpu.kvstore.transport import InProcessTransport
+    from openr_tpu.messaging.queue import ReplicateQueue
+
+    async def main():
+        clock = SimClock(1.0)
+        pub_q = ReplicateQueue("hb.kvStoreUpdates")
+        peer_q = ReplicateQueue("hb.peerUpdates")
+        kv_q = ReplicateQueue("hb.kvRequests")
+        store = KvStore(
+            node_name="n1",
+            clock=clock,
+            config=KvStoreConfig(),
+            areas=["0"],
+            transport=InProcessTransport(clock),
+            publications_queue=pub_q,
+            peer_updates_reader=peer_q.get_reader(),
+            kv_request_reader=kv_q.get_reader(),
+            initialization_cb=lambda ev: None,
+        )
+        store.start()
+        v1 = store.advertise_fleet_heartbeat("0", incarnation=1000)
+        assert v1.version == 1
+        # same incarnation re-advertised: a version NO-OP network-wide
+        # (the periodic refresh must not churn versions)
+        v2 = store.advertise_fleet_heartbeat("0", incarnation=1000)
+        assert v2.version == 1
+        # a restart's higher incarnation is a real new version
+        v3 = store.advertise_fleet_heartbeat("0", incarnation=2000)
+        assert v3.version == 2
+        hbs = store.fleet_member_heartbeats("0")
+        assert hbs == {
+            "n1": {
+                "incarnation": 2000,
+                "version": 2,
+                "ttl_version": v3.ttl_version,
+                "originator": "n1",
+            }
+        }
+        assert (
+            store.counters.get("kvstore.fleet_heartbeat_advertised") == 3
+        )
+        await store.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# coordinator ctrl discipline: breaker + gray strikes (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_member_call_breaker_and_gray_demotion(tmp_path):
+    clock = SimClock()
+    counters = CounterMap()
+    m = FleetMembership(["a", "b"], counters=counters)
+    coord = FleetSweepCoordinator(
+        clock,
+        m,
+        services={},
+        spill_root=str(tmp_path),
+        counters=counters,
+        ctrl_failure_threshold=3,
+        ctrl_backoff_initial_s=0.5,
+        ctrl_backoff_max_s=0.5,
+        gray_strike_threshold=3,
+    )
+
+    def boom():
+        raise ConnectionError("ctrl plane gone")
+
+    # three raising touches: three failures, three strikes, sentinel
+    # every time — the pump never sees the exception
+    for _ in range(3):
+        assert coord._member_call("a", "state", boom) is _CTRL_UNAVAILABLE
+    assert counters.get("fleet.ctrl.errors") == 3
+    assert counters.get("fleet.gray.strikes") == 3
+    assert coord.status()["strikes"] == {"a": {"ctrl": 3}}
+    # at the strike threshold the member is demoted to DRAINED: still
+    # up (it answers, or at least heartbeats), owns nothing
+    assert not m.is_live("a") and m.is_up("a")
+    assert counters.get("fleet.gray.demotions") == 1
+    assert m.health_firing()["fleet_gray_failure"] == {"nodes": ["a"]}
+    # the breaker is now open: the next touch short-circuits without
+    # invoking the member at all
+    def must_not_run():
+        raise AssertionError("short-circuited call must not execute")
+
+    assert (
+        coord._member_call("a", "state", must_not_run) is _CTRL_UNAVAILABLE
+    )
+    assert counters.get("fleet.ctrl.short_circuits") == 1
+    assert coord.status()["breakers"]["a"] == "open"
+    # past the backoff hold, a successful probe closes the breaker
+    clock._now += 1.0
+    assert coord._member_call("a", "state", lambda: "idle") == "idle"
+    assert coord.status()["breakers"]["a"] == "closed"
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing: the sweep service refuses stale-epoch dispatches
+# ---------------------------------------------------------------------------
+
+
+def test_service_fences_stale_epoch_dispatch(tmp_path):
+    async def main():
+        clock = SimClock()
+        fab = make_fabric(clock, tmp_path)  # never started: fence is sync
+        svc = fab.nodes["fab0"].sweep
+        svc.attach_fleet(lambda: {}, epoch_fn=lambda: 3)
+        res = svc.start_sweep({**SWEEP_PARAMS, "fleet_epoch": 2})
+        assert res["fenced"] and res["state"] == "fenced"
+        assert res["dispatch_epoch"] == 2 and res["current_epoch"] == 3
+        # counted and returned — never raised, never started
+        assert svc.state == "idle" and svc.num_sweeps_fenced == 1
+        assert svc.get_sweep_status()["sweeps_fenced"] == 1
+        assert (
+            fab.nodes["fab0"].counters.get("fleet.fenced.sweep_rejected")
+            == 1
+        )
+
+    run(main())
+
+
+async def _drive_to_done(fab, clock, max_steps=6000):
+    for _ in range(max_steps):
+        await clock.run_for(0.05)
+        if fab.coordinator.state != "running":
+            break
+    assert fab.coordinator.state == "done", fab.coordinator.state
+    s = fab.coordinator.summary()
+    return s["summary_digest"], fab.coordinator.manifest_bytes()
+
+
+async def _clean_sweep(root, **fab_kwargs):
+    """The uninterrupted reference run every chaos digest compares to."""
+    clock = SimClock()
+    fab = make_fabric(clock, root, **fab_kwargs)
+    fab.start()
+    await clock.run_for(2.0)
+    fab.coordinator.prepare(SWEEP_PARAMS)
+    fab.coordinator.start()
+    digest, manifest = await _drive_to_done(fab, clock)
+    await fab.stop()
+    return digest, manifest
+
+
+def test_stale_epoch_sweep_dispatch_fenced_then_repacked(tmp_path):
+    """Tasks assigned under epoch E and dispatched after the epoch
+    moved are FENCED by the receiving services (never run), counted,
+    and re-derived under the current epoch — the digest still matches
+    an uninterrupted run byte-for-byte."""
+
+    async def main():
+        d0, m0 = await _clean_sweep(tmp_path / "clean")
+        clock = SimClock()
+        fab = make_fabric(clock, tmp_path / "fenced")
+        fab.start()
+        await clock.run_for(2.0)
+        fab.coordinator.prepare(SWEEP_PARAMS)  # assigns at epoch 0
+        await fab.kill_node("fab1")  # epoch 0 -> 1 before any launch
+        fab.coordinator.start()
+        d1, m1 = await _drive_to_done(fab, clock)
+        st = fab.coordinator.status()
+        # the survivors' epoch-0 dispatches were refused at the door
+        assert st["fenced_worlds"] > 0
+        assert fab.counters.get("fleet.fenced.sweep") >= 1
+        fenced_rows = [
+            t for t in st["assignments"] if t["state"] == "fenced"
+        ]
+        assert fenced_rows and all(t["epoch"] == 0 for t in fenced_rows)
+        assert (
+            sum(f.sweep.num_sweeps_fenced for f in fab.nodes.values()) >= 1
+        )
+        # the dead node's worlds re-packed; everything merged exactly once
+        assert st["repacked_worlds"] > 0
+        assert st["worlds_merged"] == st["worlds_total"]
+        assert st["scenarios_merged"] == st["scenarios_total"]
+        assert d1 == d0 and m1 == m0
+        await fab.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# the detection-tier acceptance: unannounced kill, heartbeat silence only
+# ---------------------------------------------------------------------------
+
+
+async def _unannounced_kill_scenario(root):
+    clock = SimClock()
+    fab = make_fabric(clock, root, liveness_overrides=dict(FAST_LIVENESS))
+    fab.start()
+    await clock.run_for(2.0)
+    watchers = [
+        fab.router.watch("route_db", {"node": f"node{i}"})
+        for i in range(6)
+    ]
+    await clock.run_for(1.0)
+    fab.coordinator.prepare(SWEEP_PARAMS)
+    fab.coordinator.start()
+    victim = None
+    t_kill = t_detect = None
+    for _ in range(8000):
+        await clock.run_for(0.05)
+        st = fab.coordinator.status()
+        if victim is None:
+            running = sorted(
+                t["node"]
+                for t in st["assignments"]
+                if t["state"] == "running"
+            )
+            if running:
+                victim = running[0]
+                await fab.kill_node_unannounced(victim)
+                t_kill = clock.now()
+        elif t_detect is None and not fab.membership.is_live(victim):
+            t_detect = clock.now()
+            # churn after detection: the migrated watchers must keep
+            # applying deltas with the invariants intact
+            fab.announce_prefix("node0", "10.98.0.0/24")
+        if fab.coordinator.state != "running":
+            break
+    assert fab.coordinator.state == "done"
+    assert victim is not None and t_detect is not None
+    await clock.run_for(1.0)
+    logs = b"\x00".join(w.log_bytes() for w in watchers)
+    st = fab.coordinator.status()
+    out = {
+        "victim": victim,
+        "detection_s": round(t_detect - t_kill, 6),
+        "digest": fab.coordinator.summary()["summary_digest"],
+        "manifest": fab.coordinator.manifest_bytes(),
+        "logs": logs,
+        "status": st,
+        "violations": fab.router.invariant_violations(),
+        "re_emissions": fab.router.pre_migration_re_emissions(),
+        "victim_watchers": [
+            (w.migrations, w.serving_node)
+            for w in watchers
+            if w.serving_node == victim or victim in [
+                n for n, _s in w.stale_subs
+            ] or (w.migrations and w.emissions)
+        ],
+        "watchers": [
+            (w.migrations, w.serving_node) for w in watchers
+        ],
+        "suspects_seen": fab.counters.get("fleet.membership.suspect"),
+        "gray_demotions": fab.counters.get("fleet.gray.demotions"),
+    }
+    await fab.stop()
+    return out
+
+
+@pytest.mark.chaos
+def test_unannounced_kill_detected_by_heartbeat_silence_alone(tmp_path):
+    async def main():
+        d0, m0 = await _clean_sweep(
+            tmp_path / "clean",
+            liveness_overrides=dict(FAST_LIVENESS),
+        )
+        a = await _unannounced_kill_scenario(tmp_path / "killed")
+        # detection from heartbeat silence ALONE: bounded by the TTL
+        # plus one tick plus the harness sampling step — and the node
+        # passed through suspicion first
+        assert 0.25 <= a["detection_s"] <= 0.75, a["detection_s"]
+        assert a["suspects_seen"] >= 1
+        # death is not gray failure: no strikes, no demotion
+        assert a["gray_demotions"] == 0
+        # the victim's unmerged worlds re-packed; the merged digest and
+        # manifest are byte-equal to the uninterrupted run
+        assert a["status"]["repacked_worlds"] > 0
+        assert a["status"]["worlds_merged"] == a["status"]["worlds_total"]
+        assert a["digest"] == d0 and a["manifest"] == m0
+        # zero invariant violations across the migration
+        assert a["violations"] == 0 and a["re_emissions"] == 0
+        for migrations, serving in a["watchers"]:
+            assert serving is not None and serving != a["victim"]
+            assert migrations <= 1
+        # byte-identical seeded replay of the whole scenario
+        b = await _unannounced_kill_scenario(tmp_path / "replay")
+        assert (a["victim"], a["detection_s"]) == (
+            b["victim"],
+            b["detection_s"],
+        )
+        assert a["digest"] == b["digest"]
+        assert a["manifest"] == b["manifest"]
+        assert a["logs"] == b["logs"]
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# split brain: asymmetric partition, stale-epoch stream pushes fenced
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_asymmetric_partition_fences_stale_stream_pushes(tmp_path):
+    async def main():
+        clock = SimClock()
+        fab = make_fabric(
+            clock, tmp_path, liveness_overrides=dict(FAST_LIVENESS)
+        )
+        fab.start()
+        await clock.run_for(2.0)
+        watchers = [
+            fab.router.watch("route_db", {"node": f"node{i}"})
+            for i in range(6)
+        ]
+        await clock.run_for(1.0)
+        placement = {}
+        for w in watchers:
+            placement.setdefault(w.serving_node, []).append(w)
+        victim = max(
+            sorted(placement), key=lambda n: len(placement[n])
+        )
+        epoch0 = fab.membership.epoch
+        # the victim's heartbeats stop REACHING the tracker; its
+        # services keep running and pushing — the split-brain shape
+        fab.partition_asymmetric(victim)
+        await clock.run_for(1.0)
+        assert not fab.membership.is_live(victim)
+        assert fab.nodes[victim].running  # daemon alive: asymmetric
+        assert fab.membership.epoch == epoch0 + 1
+        assert fab.counters.get("fleet.hb_dropped") > 0
+        # the watchers migrated off; the dead-to-us daemon could not be
+        # unsubscribed, so its subscriptions linger behind the fence
+        for w in placement[victim]:
+            assert w.serving_node != victim and w.migrations == 1
+        assert fab.router.status()["stale_subscriptions"] >= len(
+            placement[victim]
+        )
+        # churn: EVERY service pushes, including the stale owner — its
+        # deliveries are fenced (counted), never applied, never doubled
+        fab.announce_prefix("node1", "10.97.0.0/24")
+        await clock.run_for(1.0)
+        assert fab.router.fenced_deliveries() > 0
+        assert fab.counters.get("fleet.fenced.stream") > 0
+        assert fab.router.invariant_violations() == 0
+        assert fab.router.pre_migration_re_emissions() == 0
+        # heal: a higher-incarnation rejoin readmits the member and the
+        # next resync garbage-collects the stale subscriptions
+        fab.heal_partition(victim)
+        await clock.run_for(1.0)
+        assert fab.membership.is_live(victim)
+        assert fab.membership.epoch == epoch0 + 2
+        assert fab.router.status()["stale_subscriptions"] == 0
+        assert (
+            fab.counters.get("fleet.directory.stale_unsubscribed")
+            >= len(placement[victim])
+        )
+        fab.announce_prefix("node2", "10.96.0.0/24")
+        await clock.run_for(1.0)
+        assert fab.router.invariant_violations() == 0
+        assert fab.router.pre_migration_re_emissions() == 0
+        await fab.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# stragglers: re-pack without waiting for death, first-committed-wins
+# ---------------------------------------------------------------------------
+
+
+async def _straggler_run(root, pause_s):
+    """One fleet sweep where the busiest member turns slow mid-round
+    (``pause_s`` between shards).  Returns (digest, manifest, status)."""
+    clock = SimClock()
+    fab = make_fabric(
+        clock,
+        root,
+        sweep_overrides={"shard_scenarios": 2, "inter_shard_pause_s": 0.2},
+        # above the busiest member's natural round (~4.8s: 4 worlds x
+        # 12 scenarios / 2 per shard x 0.2s), below any slowed round
+        coordinator_overrides={"straggler_deadline_s": 6.0},
+    )
+    fab.start()
+    await clock.run_for(2.0)
+    fab.coordinator.prepare(SWEEP_PARAMS)
+    if pause_s is not None:
+        counts = {}
+        for t in fab.coordinator.tasks:
+            counts[t.node] = counts.get(t.node, 0) + len(t.worlds)
+        slow = max(sorted(counts), key=lambda n: counts[n])
+        fab.nodes[slow].sweep.config.inter_shard_pause_s = pause_s
+    fab.coordinator.start()
+    digest, manifest = await _drive_to_done(fab, clock)
+    st = fab.coordinator.status()
+    await fab.stop()
+    return digest, manifest, st
+
+
+@pytest.mark.chaos
+def test_straggler_repack_is_first_committed_wins(tmp_path):
+    async def main():
+        d0, m0, st0 = await _straggler_run(tmp_path / "clean", None)
+        assert st0["straggler_repacks"] == 0
+        # the straggler NEVER finishes: its unfinished worlds re-packed
+        # onto the others past the deadline, its leftover copy cancelled
+        # as a duplicate at completion
+        d1, m1, st1 = await _straggler_run(tmp_path / "never", 60.0)
+        assert st1["straggler_repacks"] >= 1
+        assert st1["straggler_repacked_worlds"] >= 1
+        assert st1["duplicate_completions"] >= 1
+        assert any(
+            "straggler" in per for per in st1["strikes"].values()
+        )
+        # the straggler finishes LATE: both copies exist, merge keeps
+        # the first-committed world and drops the duplicate
+        d2, m2, st2 = await _straggler_run(tmp_path / "late", 0.4)
+        assert st2["straggler_repacks"] >= 1
+        # whichever way the race lands, the content contract holds:
+        # every scenario merged exactly once, digest and manifest
+        # byte-identical to the clean run
+        for d, m, st in ((d1, m1, st1), (d2, m2, st2)):
+            assert st["worlds_merged"] == st["worlds_total"]
+            assert st["scenarios_merged"] == st["scenarios_total"]
+            assert d == d0 and m == m0
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# gray failure: heartbeats fine, ctrl surface raising — demote, don't die
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_gray_failure_mid_round_demotes_and_survivors_finish(tmp_path):
+    async def main():
+        d0, m0 = await _clean_sweep(tmp_path / "clean")
+        clock = SimClock()
+        fab = make_fabric(clock, tmp_path / "gray")
+        fab.start()
+        await clock.run_for(2.0)
+        fab.coordinator.prepare(SWEEP_PARAMS)
+        fab.coordinator.start()
+        victim = None
+        for _ in range(6000):
+            await clock.run_for(0.05)
+            st = fab.coordinator.status()
+            if victim is None:
+                running = sorted(
+                    t["node"]
+                    for t in st["assignments"]
+                    if t["state"] == "running"
+                )
+                if running:
+                    victim = running[0]
+                    fab.gray_sweep_failure(victim)
+            if fab.coordinator.state != "running":
+                break
+        # the sweep COMPLETED on the survivors; the coordinator fiber
+        # absorbed every member exception through the breaker
+        assert fab.coordinator.state == "done"
+        assert fab.counters.get("fleet.crash") == 0
+        assert fab.counters.get("fleet.ctrl.errors") >= 3
+        # the heartbeating-but-failing member was demoted to drained
+        assert victim is not None
+        assert not fab.membership.is_live(victim)
+        assert fab.membership.is_up(victim)
+        assert fab.counters.get("fleet.gray.demotions") >= 1
+        assert fab.membership.status()["drain_reasons"][victim] == (
+            "gray_failure"
+        )
+        st = fab.coordinator.status()
+        assert victim in st["strikes"]
+        firing = fab.membership.health_firing()
+        assert firing["fleet_gray_failure"]["nodes"] == [victim]
+        sink = AlertSink("agg", clock, CounterMap())
+        sink.report(firing)
+        assert (
+            sink.counters.get(alert_counter_key("fleet_gray_failure"))
+            == 1.0
+        )
+        # content contract intact
+        assert fab.coordinator.summary()["summary_digest"] == d0
+        assert fab.coordinator.manifest_bytes() == m0
+        await fab.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# flapping node: damping bounds ownership churn; byte-identical replay
+# ---------------------------------------------------------------------------
+
+
+async def _flap_scenario(root):
+    clock = SimClock()
+    fab = make_fabric(
+        clock,
+        root,
+        liveness_overrides={
+            **FAST_LIVENESS,
+            "flap_hold_base_s": 1.0,
+            "flap_hold_max_s": 4.0,
+            "flap_window_s": 30.0,
+        },
+    )
+    fab.start()
+    await clock.run_for(2.0)
+    watchers = [
+        fab.router.watch("route_db", {"node": f"node{i}"})
+        for i in range(6)
+    ]
+    await clock.run_for(1.0)
+    placement = {}
+    for w in watchers:
+        placement.setdefault(w.serving_node, []).append(w)
+    victim = max(sorted(placement), key=lambda n: len(placement[n]))
+    epoch0 = fab.membership.epoch
+    # -- cycle A: a bounce that straddles ONLY suspect_after — the node
+    #    goes suspect, recovers, and nothing moves (suspicion is
+    #    bookkeeping, not a composition change)
+    fab.heartbeat_stall(victim)
+    await clock.run_for(0.3)
+    assert victim in fab.membership.suspects()
+    assert fab.membership.is_live(victim)
+    fab.beacons[victim].resume()
+    fab.beacons[victim].beat_now()
+    await clock.run_for(0.2)
+    assert fab.membership.suspects() == ()
+    assert fab.membership.epoch == epoch0
+    assert all(w.migrations == 0 for w in watchers)
+    # -- cycles B, C: full bounces past the TTL.  The first rejoin is
+    #    immediate; the second inside the flap window is DAMPED.
+    fab.announce_prefix("node2", "10.95.0.0/24")
+    await clock.run_for(0.5)
+    for _cycle in range(2):
+        fab.heartbeat_stall(victim)
+        await clock.run_for(0.8)
+        assert not fab.membership.is_live(victim)
+        fab.heal_heartbeat(victim)
+        await clock.run_for(0.2)
+    # second rejoin attempt armed the damping hold: the node stays out
+    # while its heartbeats keep arriving, and the watchers stay PUT
+    assert fab.counters.get("fleet.flap_damped") == 1
+    assert not fab.membership.is_live(victim)
+    assert fab.liveness.member_state(victim) == "damped"
+    moves_mid_damp = [w.migrations for w in placement[victim]]
+    fab.announce_prefix("node0", "10.94.0.0/24")
+    await clock.run_for(0.5)
+    assert [w.migrations for w in placement[victim]] == moves_mid_damp
+    # the hold (~1s) elapses while the beacon keeps beating: readmitted
+    await clock.run_for(1.5)
+    assert fab.membership.is_live(victim)
+    fab.announce_prefix("node1", "10.93.0.0/24")
+    await clock.run_for(0.5)
+    # churn bound: <=2 ownership moves per full flap cycle (out + back),
+    # and zero for everyone else
+    for w in watchers:
+        if w in placement[victim]:
+            assert w.migrations == 4  # 2 full cycles x (out + back)
+        else:
+            assert w.migrations == 0
+    # down(B) + up(B) + down(C) + up(after hold) = 4 epoch bumps
+    assert fab.membership.epoch == epoch0 + 4
+    assert fab.router.invariant_violations() == 0
+    assert fab.router.pre_migration_re_emissions() == 0
+    logs = b"\x00".join(w.log_bytes() for w in watchers)
+    damped = fab.counters.get("fleet.flap_damped")
+    await fab.stop()
+    return victim, logs, damped, fab.membership.epoch
+
+
+@pytest.mark.chaos
+def test_flapping_node_damping_bounds_churn_and_replays_identically(
+    tmp_path,
+):
+    async def main():
+        v1, log_a, damped_a, ep_a = await _flap_scenario(tmp_path / "a")
+        v2, log_b, damped_b, ep_b = await _flap_scenario(tmp_path / "b")
+        assert (v1, damped_a, ep_a) == (v2, damped_b, ep_b)
+        assert log_a == log_b
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# router resync coalescing: one derivation pass per epoch bump
+# ---------------------------------------------------------------------------
+
+
+def test_router_resync_coalesced_once_per_epoch_bump(tmp_path):
+    async def main():
+        clock = SimClock()
+        fab = make_fabric(
+            clock, tmp_path, liveness_overrides=dict(FAST_LIVENESS)
+        )
+        fab.start()
+        await clock.run_for(2.0)
+        watchers = [
+            fab.router.watch("route_db", {"node": f"node{i}"})
+            for i in range(6)
+        ]
+        await clock.run_for(1.0)
+        assert fab.router.owner_derivations == 0
+        placement = {}
+        for w in watchers:
+            placement.setdefault(w.serving_node, []).append(w)
+        victim = max(sorted(placement), key=lambda n: len(placement[n]))
+        epoch0 = fab.membership.epoch
+        # one stalled beacon throws TWO membership events (suspect,
+        # then down) — but only ONE epoch bump, so placement re-derives
+        # exactly once per watcher
+        fab.heartbeat_stall(victim)
+        await clock.run_for(1.0)
+        assert not fab.membership.is_live(victim)
+        assert fab.membership.epoch == epoch0 + 1
+        assert fab.counters.get("fleet.membership.suspect") >= 1
+        assert fab.router.owner_derivations == len(watchers)
+        for w in watchers:
+            if w in placement[victim]:
+                assert w.migrations == 1
+                assert (
+                    len(
+                        [
+                            e
+                            for e in w.emissions
+                            if e.get("type") == "snapshot"
+                        ]
+                    )
+                    == 2
+                )
+            else:
+                assert w.migrations == 0
+        await fab.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# observability: the ctrl verb + breeze rendering
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_status_verb_and_breeze_render():
+    from openr_tpu.cli.breeze import render_fleet_status
+    from openr_tpu.ctrl.handler import OpenrCtrlHandler
+
+    assert render_fleet_status({"state": "disabled"}) == [
+        "fleet tier disabled"
+    ]
+    # a node with only the liveness plane attached still answers
+    clock = SimClock()
+    m, tr, _counters = make_tracker(clock, names=("fab0", "fab1"))
+    tr.on_heartbeat("fab0", 1000, 1)
+    handler = OpenrCtrlHandler(
+        SimpleNamespace(fleet=None, fleet_liveness=tr)
+    )
+    doc = handler.get_fleet_status()
+    assert doc["state"] == "liveness-only"
+    assert doc["liveness"]["members"]["fab0"]["state"] == "live"
+    lines = render_fleet_status(doc)
+    assert any("fab0: live" in ln and "inc=1000" in ln for ln in lines)
+    assert any("suspect_after=0.25s" in ln for ln in lines)
+    # neither plane attached: disabled
+    bare = OpenrCtrlHandler(SimpleNamespace())
+    assert bare.get_fleet_status() == {"state": "disabled"}
+    # the full coordinator document renders the runbook columns
+    doc = {
+        "fleet_id": "abc123",
+        "state": "running",
+        "epoch": 3,
+        "nodes_live": 2,
+        "nodes_total": 3,
+        "worlds_merged": 5,
+        "worlds_total": 8,
+        "fenced_worlds": 2,
+        "straggler_repacks": 1,
+        "duplicate_completions": 1,
+        "strikes": {"fab1": {"ctrl": 2, "straggler": 1}},
+        "liveness": {
+            "epoch": 3,
+            "suspect_after_s": 1.25,
+            "heartbeat_ttl_s": 2.5,
+            "members": {
+                "fab1": {
+                    "state": "damped",
+                    "incarnation": 7,
+                    "heartbeat_age_s": 0.2,
+                    "damped_for_s": 1.5,
+                    "flaps_in_window": 2,
+                }
+            },
+        },
+    }
+    lines = render_fleet_status(doc)
+    assert any(
+        "epoch=3" in ln and "worlds 5/8" in ln and "fenced=2" in ln
+        for ln in lines
+    )
+    assert any("strikes fab1: ctrl=2 straggler=1" in ln for ln in lines)
+    assert any(
+        "fab1: damped" in ln and "damped_for=1.5s" in ln for ln in lines
+    )

@@ -166,6 +166,12 @@ FIXTURES = {
         (),
         2,
     ),
+    "fleet-liveness": (
+        "def fence(membership):\n"
+        "    membership.bump_epoch()\n",
+        (),
+        2,
+    ),
     "protection-table": (
         "def shortcut(table, doc, prefix_state):\n"
         "    table.apply_patch(doc, prefix_state)\n",
@@ -623,6 +629,46 @@ def test_fleet_directory_needs_membership_receiver():
         "    return fleet_membership.live_nodes()\n"
     )
     assert [f.rule for f in analyze_source(src)] == ["fleet-directory"]
+
+
+def test_fleet_liveness_single_writer_is_fleet_package_only():
+    """The epoch/suspicion/damping mutators (ISSUE 20) are STRICTER
+    than fleet-directory: only openr_tpu/fleet/ itself is exempt.
+    Chaos and the emulation fabric — exempt from fleet-directory —
+    must perturb the heartbeat plane and let the tracker conclude,
+    so the same source trips fleet-liveness there."""
+    src = (
+        "def force(membership, tracker):\n"
+        "    membership.bump_epoch()\n"
+        "    membership.mark_suspect('fab1')\n"
+        "    tracker.set_damped_until('fab1', 99.0)\n"
+        "    tracker.record_incarnation('fab1', 7)\n"
+    )
+    owner = [ParsedModule.parse("openr_tpu/fleet/liveness.py", src)]
+    assert analyze_modules(owner).findings == []
+    for rel in (
+        "openr_tpu/chaos/controller.py",
+        "openr_tpu/emulation/fabric.py",
+        "openr_tpu/serving/query.py",
+    ):
+        mods = [ParsedModule.parse(rel, src)]
+        assert [f.rule for f in analyze_modules(mods).findings] == [
+            "fleet-liveness"
+        ] * 4, rel
+
+
+def test_fleet_liveness_needs_fleet_receiver_and_reads_are_clean():
+    """Receiver-hint discipline carries over: ``clock.bump_epoch()`` on
+    an unrelated object must not trip, and the read surface (``epoch``,
+    ``suspects()``, ``member_state``) stays clean everywhere."""
+    src = (
+        "def poke(sim, liveness_tracker, membership):\n"
+        "    sim.bump_epoch()\n"
+        "    liveness_tracker.record_incarnation('fab0', 3)\n"
+        "    liveness_tracker.member_state('fab0')\n"
+        "    return membership.epoch, membership.suspects()\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == ["fleet-liveness"]
 
 
 def test_sweep_ownership_reset_needs_checkpoint_receiver():
